@@ -1,0 +1,47 @@
+"""Test configuration: force an 8-device virtual CPU mesh (SURVEY.md §4 pattern —
+multi-"node" behavior tested in one process, like the reference's DistributedQueryRunner
+boots coordinator+workers in one JVM, testing/trino-testing/DistributedQueryRunner.java:108).
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tpch_sf001():
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    return TpchConnector(sf=0.01)
+
+
+@pytest.fixture(scope="session")
+def engine(tpch_sf001):
+    from trino_tpu import Engine
+
+    e = Engine()
+    e.register_catalog("tpch", tpch_sf001)
+    return e
+
+
+@pytest.fixture(scope="session")
+def tpch_pandas(tpch_sf001):
+    """Host-side oracle: full TPC-H tables as pandas DataFrames (decoded)."""
+    import numpy as np
+    import pandas as pd
+
+    tables = {}
+    for t in tpch_sf001.tables():
+        frames = []
+        for split in tpch_sf001.splits(t):
+            page = tpch_sf001.generate(split)
+            frames.append(pd.DataFrame(page.to_numpy(tpch_sf001.dictionaries(t))))
+        tables[t] = pd.concat(frames, ignore_index=True)
+    return tables

@@ -1,0 +1,113 @@
+"""TPC-H Q1 end-to-end over a hand-built plan, validated against a pandas oracle
+(SURVEY.md §4: the reference cross-checks DistributedQueryRunner results against H2)."""
+
+import numpy as np
+import pytest
+
+from trino_tpu.page import Schema
+from trino_tpu.sql import plan as P
+from trino_tpu.sql.ir import Call, Constant, FieldRef
+from trino_tpu.types import BIGINT, DecimalType, parse_date_literal
+from trino_tpu.connectors.tpch import TPCH_SCHEMAS
+
+DEC2 = DecimalType.of(15, 2)
+DEC4 = DecimalType.of(18, 4)
+DEC6 = DecimalType.of(18, 6)
+
+
+def build_q1_plan():
+    cols = ("l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+            "l_discount", "l_tax", "l_shipdate")
+    lineitem = TPCH_SCHEMAS["lineitem"]
+    scan_schema = Schema(tuple(lineitem.field(c) for c in cols))
+    scan = P.TableScan("tpch", "lineitem", cols, scan_schema)
+
+    ship = FieldRef(6, scan_schema.fields[6].type, "l_shipdate")
+    cutoff = parse_date_literal("1998-12-01") - 90
+    filt = P.Filter(scan, Call("lte", (ship, Constant(cutoff, ship.type)), __import__(
+        "trino_tpu.types", fromlist=["BOOLEAN"]).BOOLEAN))
+
+    rf = FieldRef(0, scan_schema.fields[0].type, "l_returnflag")
+    ls = FieldRef(1, scan_schema.fields[1].type, "l_linestatus")
+    qty = FieldRef(2, DEC2, "l_quantity")
+    price = FieldRef(3, DEC2, "l_extendedprice")
+    disc = FieldRef(4, DEC2, "l_discount")
+    tax = FieldRef(5, DEC2, "l_tax")
+    one2 = Constant(100, DEC2)  # literal 1 at scale 2
+    disc_price = Call("multiply", (price, Call("subtract", (one2, disc), DEC2)), DEC4)
+    charge = Call("multiply", (disc_price, Call("add", (one2, tax), DEC2)), DEC6)
+
+    proj_schema = Schema.of(
+        ("l_returnflag", rf.type), ("l_linestatus", ls.type), ("qty", DEC2),
+        ("price", DEC2), ("disc_price", DEC4), ("charge", DEC6), ("disc", DEC2),
+    )
+    proj = P.Project(filt, (rf, ls, qty, price, disc_price, charge, disc), proj_schema)
+
+    aggs = (
+        P.AggSpec("sum", FieldRef(2, DEC2), "sum_qty", DEC2),
+        P.AggSpec("sum", FieldRef(3, DEC2), "sum_base_price", DEC2),
+        P.AggSpec("sum", FieldRef(4, DEC4), "sum_disc_price", DEC4),
+        P.AggSpec("sum", FieldRef(5, DEC6), "sum_charge", DEC6),
+        P.AggSpec("avg", FieldRef(2, DEC2), "avg_qty", DEC2),
+        P.AggSpec("avg", FieldRef(3, DEC2), "avg_price", DEC2),
+        P.AggSpec("avg", FieldRef(6, DEC2), "avg_disc", DEC2),
+        P.AggSpec("count_star", None, "count_order", BIGINT),
+    )
+    agg_schema = Schema(
+        (proj_schema.fields[0], proj_schema.fields[1])
+        + tuple(__import__("trino_tpu.page", fromlist=["Field"]).Field(a.name, a.type) for a in aggs)
+    )
+    agg = P.Aggregate(proj, (0, 1), aggs, agg_schema, capacity=64)
+    sort = P.Sort(agg, (P.SortKey(0), P.SortKey(1)))
+    return P.Output(sort, tuple(f.name for f in agg_schema.fields))
+
+
+def oracle_q1(tpch_pandas):
+    li = tpch_pandas["lineitem"]
+    cutoff = np.datetime64("1998-12-01") - np.timedelta64(90, "D")
+    df = li[li["l_shipdate"].to_numpy().astype("datetime64[D]") <= cutoff].copy()
+    df["disc_price"] = df.l_extendedprice * (1 - df.l_discount)
+    df["charge"] = df.disc_price * (1 + df.l_tax)
+    g = df.groupby(["l_returnflag", "l_linestatus"], as_index=False).agg(
+        sum_qty=("l_quantity", "sum"),
+        sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"),
+        sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"),
+        avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"),
+        count_order=("l_quantity", "size"),
+    )
+    return g.sort_values(["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+
+
+def test_q1(engine, tpch_pandas):
+    result = engine.execute_plan(build_q1_plan())
+    expected = oracle_q1(tpch_pandas)
+    got = result.to_pandas()
+    assert len(got) == len(expected) > 0
+    assert list(got["l_returnflag"]) == list(expected["l_returnflag"])
+    assert list(got["l_linestatus"]) == list(expected["l_linestatus"])
+    for col in ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge", "count_order"):
+        np.testing.assert_allclose(
+            got[col].to_numpy(np.float64), expected[col].to_numpy(np.float64),
+            rtol=1e-9, err_msg=col)
+    for col in ("avg_qty", "avg_price", "avg_disc"):
+        np.testing.assert_allclose(
+            got[col].to_numpy(np.float64), expected[col].to_numpy(np.float64),
+            atol=0.01, err_msg=col)  # engine rounds decimal avg to column scale
+
+
+def test_lineitem_rowcount_plausible(tpch_pandas):
+    n = len(tpch_pandas["lineitem"])
+    orders = len(tpch_pandas["orders"])
+    assert orders * 1 <= n <= orders * 7
+    assert abs(n / orders - 4.0) < 0.1  # mean lines/order ≈ 4
+
+
+def test_referential_integrity(tpch_pandas):
+    li = tpch_pandas["lineitem"]
+    assert li["l_orderkey"].isin(tpch_pandas["orders"]["o_orderkey"]).all()
+    assert li["l_partkey"].between(1, len(tpch_pandas["part"])).all()
+    assert li["l_suppkey"].between(1, len(tpch_pandas["supplier"])).all()
+    assert tpch_pandas["orders"]["o_custkey"].isin(tpch_pandas["customer"]["c_custkey"]).all()

@@ -1,0 +1,19 @@
+"""trino_tpu — a TPU-native distributed SQL query engine.
+
+A ground-up re-design of the capabilities of the reference engine (see /root/repo/SURVEY.md):
+SQL -> analyzer -> cost-based planner -> fragmented distributed plan, executed as jit-compiled
+XLA/Pallas kernels over fixed-capacity columnar pages in HBM, with hash-partitioned exchanges
+mapped to all-to-all collectives on the ICI mesh.
+
+int64/float64 columns require jax x64 mode; enable it before the first jax computation.
+"""
+
+import jax
+
+# SQL semantics need 64-bit integers (bigint, short decimals) and float64 (double).
+jax.config.update("jax_enable_x64", True)
+
+from .engine import Engine, Session  # noqa: E402
+
+__all__ = ["Engine", "Session"]
+__version__ = "0.1.0"

@@ -1,0 +1,420 @@
+"""TPC-H connector: deterministic on-device data generation.
+
+Reference: plugin/trino-tpch (TpchConnectorFactory; rows generated per split on the fly by the
+external io.trino.tpch:tpch dbgen port — plugin/trino-tpch/pom.xml:59-60,
+TpchPageSourceProvider.java:63-68).  The TPU re-design generates rows *on device* as pure
+functions of the global row index (splitmix64 counter-based RNG), so a "table scan" is itself a
+jit-compiled kernel producing HBM-resident pages — no host IO, no transfer.
+
+Faithfulness: schemas, cardinalities, key referential integrity, value ranges and the
+dbgen *formula-derived* columns (p_retailprice, l_suppkey distribution, l_extendedprice =
+qty * retailprice(partkey)) follow the public TPC-H specification; free-text columns
+(comments, addresses) and the exact dbgen text-pool/seed streams are NOT replicated, so
+absolute query results differ from official dbgen answer sets.  Tests therefore validate
+against a host-side oracle over the SAME generated data (SURVEY.md §4's H2-oracle pattern).
+
+Strings are dictionary-encoded at generation (dict ids on device, dictionaries host-side);
+per-row-unique strings (names keyed by primary key) use the key itself as the id with a lazy
+formatter dictionary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..page import Field, Page, Schema
+from ..types import BIGINT, DATE, DOUBLE, INTEGER, DecimalType, VarcharType, parse_date_literal
+
+__all__ = ["TpchConnector", "TPCH_SCHEMAS", "Dictionary"]
+
+DEC152 = DecimalType.of(15, 2)
+V = VarcharType.of
+
+# -- dictionaries -------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Dictionary:
+    """Host-side id->string mapping for a dictionary-encoded varchar column."""
+
+    values: Optional[np.ndarray] = None  # small enum dictionaries
+    formatter: Optional[Callable[[np.ndarray], np.ndarray]] = None  # key-derived names
+
+    def decode(self, ids: np.ndarray) -> np.ndarray:
+        if self.values is not None:
+            return self.values[ids]
+        return self.formatter(ids)
+
+    def lookup(self, s: str) -> int:
+        """Literal string -> id (planner-side constant resolution)."""
+        if self.values is None:
+            raise KeyError(f"cannot look up {s!r} in formatter dictionary")
+        hits = np.nonzero(self.values == s)[0]
+        if len(hits) == 0:
+            return -1  # compares unequal to every id
+        return int(hits[0])
+
+    def match(self, pred: Callable[[str], bool]) -> np.ndarray:
+        """Boolean lookup table over ids (LIKE / complex string predicates)."""
+        if self.values is None:
+            raise KeyError("cannot enumerate a formatter dictionary")
+        return np.array([bool(pred(str(v))) for v in self.values])
+
+
+def _enum(*vals):
+    return Dictionary(values=np.array(vals))
+
+
+def _fmt(pattern):
+    return Dictionary(formatter=lambda ids: np.char.mod(pattern, ids))
+
+
+SEGMENTS = _enum("AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD")
+PRIORITIES = _enum("1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW")
+INSTRUCTIONS = _enum("DELIVER IN PERSON", "COLLECT COD", "NONE", "TAKE BACK RETURN")
+MODES = _enum("REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB")
+RFLAG = _enum("A", "N", "R")
+LSTATUS = _enum("F", "O")
+OSTATUS = _enum("F", "O", "P")
+NATIONS = [  # (name, regionkey) — TPC-H spec 4.2.3
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1), ("EGYPT", 4),
+    ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3), ("INDIA", 2), ("INDONESIA", 2),
+    ("IRAN", 4), ("IRAQ", 4), ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0),
+    ("MOROCCO", 0), ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3), ("UNITED KINGDOM", 3),
+    ("UNITED STATES", 1),
+]
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+NATION_DICT = Dictionary(values=np.array([n for n, _ in NATIONS]))
+REGION_DICT = Dictionary(values=np.array(REGIONS))
+# p_type = "<syllable1> <syllable2> <syllable3>" — spec 4.2.2.13
+TYPE_S1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+TYPE_S2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+TYPE_S3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+PTYPES = _enum(*[f"{a} {b} {c}" for a in TYPE_S1 for b in TYPE_S2 for c in TYPE_S3])
+CONTAINERS = _enum(*[f"{a} {b}" for a in ["SM", "LG", "MED", "JUMBO", "WRAP"]
+                     for b in ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]])
+BRANDS = _enum(*[f"Brand#{m}{n}" for m in range(1, 6) for n in range(1, 6)])
+MFGRS = _enum(*[f"Manufacturer#{m}" for m in range(1, 6)])
+
+STARTDATE = parse_date_literal("1992-01-01")
+CURRENTDATE = parse_date_literal("1995-06-17")
+ENDDATE = parse_date_literal("1998-08-02")
+
+# -- RNG ----------------------------------------------------------------------------------
+
+
+def _rand(stream: int, idx):
+    """Counter-based uniform int64 stream: value = mix(stream_salt, index)."""
+    from ..ops.hashing import splitmix64
+
+    salt = jnp.int64(np.int64((stream * 0x9E3779B97F4A7C15 + 0x2545F4914F6CDD1D) & 0x7FFFFFFFFFFFFFFF))
+    return splitmix64(idx.astype(jnp.int64) ^ salt)
+
+
+def _uniform(stream, idx, lo, hi):
+    """Uniform integer in [lo, hi] inclusive."""
+    return (jnp.abs(_rand(stream, idx)) % (hi - lo + 1) + lo)
+
+
+# -- schemas ------------------------------------------------------------------------------
+
+TPCH_SCHEMAS: dict[str, Schema] = {
+    "lineitem": Schema.of(
+        ("l_orderkey", BIGINT), ("l_partkey", BIGINT), ("l_suppkey", BIGINT),
+        ("l_linenumber", INTEGER), ("l_quantity", DEC152), ("l_extendedprice", DEC152),
+        ("l_discount", DEC152), ("l_tax", DEC152), ("l_returnflag", V(1)),
+        ("l_linestatus", V(1)), ("l_shipdate", DATE), ("l_commitdate", DATE),
+        ("l_receiptdate", DATE), ("l_shipinstruct", V(25)), ("l_shipmode", V(10)),
+        ("l_comment", V(44)),
+    ),
+    "orders": Schema.of(
+        ("o_orderkey", BIGINT), ("o_custkey", BIGINT), ("o_orderstatus", V(1)),
+        ("o_totalprice", DEC152), ("o_orderdate", DATE), ("o_orderpriority", V(15)),
+        ("o_clerk", V(15)), ("o_shippriority", INTEGER), ("o_comment", V(79)),
+    ),
+    "customer": Schema.of(
+        ("c_custkey", BIGINT), ("c_name", V(25)), ("c_address", V(40)),
+        ("c_nationkey", BIGINT), ("c_phone", V(15)), ("c_acctbal", DEC152),
+        ("c_mktsegment", V(10)), ("c_comment", V(117)),
+    ),
+    "part": Schema.of(
+        ("p_partkey", BIGINT), ("p_name", V(55)), ("p_mfgr", V(25)), ("p_brand", V(10)),
+        ("p_type", V(25)), ("p_size", INTEGER), ("p_container", V(10)),
+        ("p_retailprice", DEC152), ("p_comment", V(23)),
+    ),
+    "supplier": Schema.of(
+        ("s_suppkey", BIGINT), ("s_name", V(25)), ("s_address", V(40)),
+        ("s_nationkey", BIGINT), ("s_phone", V(15)), ("s_acctbal", DEC152),
+        ("s_comment", V(101)),
+    ),
+    "partsupp": Schema.of(
+        ("ps_partkey", BIGINT), ("ps_suppkey", BIGINT), ("ps_availqty", INTEGER),
+        ("ps_supplycost", DEC152), ("ps_comment", V(199)),
+    ),
+    "nation": Schema.of(
+        ("n_nationkey", BIGINT), ("n_name", V(25)), ("n_regionkey", BIGINT),
+        ("n_comment", V(152)),
+    ),
+    "region": Schema.of(
+        ("r_regionkey", BIGINT), ("r_name", V(25)), ("r_comment", V(152)),
+    ),
+}
+
+DICTIONARIES: dict[str, dict[str, Dictionary]] = {
+    "lineitem": {"l_returnflag": RFLAG, "l_linestatus": LSTATUS, "l_shipinstruct": INSTRUCTIONS,
+                 "l_shipmode": MODES, "l_comment": _fmt("line comment %d")},
+    "orders": {"o_orderstatus": OSTATUS, "o_orderpriority": PRIORITIES,
+               "o_clerk": _fmt("Clerk#%09d"), "o_comment": _fmt("order comment %d")},
+    "customer": {"c_name": _fmt("Customer#%09d"), "c_address": _fmt("addr %d"),
+                 "c_phone": _fmt("phone-%011d"), "c_mktsegment": SEGMENTS,
+                 "c_comment": _fmt("customer comment %d")},
+    "part": {"p_name": _fmt("part name %d"), "p_mfgr": MFGRS, "p_brand": BRANDS,
+             "p_type": PTYPES, "p_container": CONTAINERS, "p_comment": _fmt("part comment %d")},
+    "supplier": {"s_name": _fmt("Supplier#%09d"), "s_address": _fmt("saddr %d"),
+                 "s_phone": _fmt("sphone-%011d"), "s_comment": _fmt("supplier comment %d")},
+    "partsupp": {"ps_comment": _fmt("partsupp comment %d")},
+    "nation": {"n_name": NATION_DICT, "n_comment": _fmt("nation comment %d")},
+    "region": {"r_name": REGION_DICT, "r_comment": _fmt("region comment %d")},
+}
+
+# table base cardinalities at SF1 (spec 4.2.5); lineitem is derived from orders
+BASE_ROWS = {
+    "orders": 1_500_000, "customer": 150_000, "part": 200_000, "supplier": 10_000,
+    "partsupp": 800_000, "nation": 25, "region": 5,
+}
+LINES_PER_ORDER_MAX = 7
+
+
+def _retailprice_raw(partkey):
+    """p_retailprice in cents — spec 4.2.3 formula, exact."""
+    pk = partkey.astype(jnp.int64)
+    return 90000 + (pk // 10) % 20001 + 100 * (pk % 1000)
+
+
+def _supplier_for(partkey, supplier_count, i):
+    """i-th (0..3) supplier of a part — spec 4.2.3 partsupp formula, exact."""
+    pk = partkey.astype(jnp.int64)
+    s = jnp.int64(supplier_count)
+    return (pk + (i * (s // 4 + (pk - 1) // s))) % s + 1
+
+
+# -- generators ---------------------------------------------------------------------------
+
+
+def gen_orders(sf: float, lo: int, hi: int):
+    """Rows [lo, hi) of orders; returns dict of arrays (all rows valid)."""
+    i = jnp.arange(lo, hi, dtype=jnp.int64)
+    okey = i + 1
+    ccount = int(BASE_ROWS["customer"] * sf)
+    cols = {
+        "o_orderkey": okey,
+        "o_custkey": _uniform(11, okey, 1, max(ccount, 1)),
+        "o_orderdate": _uniform(12, okey, STARTDATE, ENDDATE - 151).astype(jnp.int32),
+        "o_orderpriority": _uniform(13, okey, 0, 4).astype(jnp.int32),
+        "o_clerk": _uniform(14, okey, 1, max(int(1000 * sf), 1)).astype(jnp.int32),
+        "o_shippriority": jnp.zeros_like(okey, jnp.int32),
+        "o_comment": (okey % (1 << 31)).astype(jnp.int32),
+        "o_totalprice": _uniform(15, okey, 85_000, 55_000_000),  # cents
+    }
+    # orderstatus: F if orderdate old enough that all lines shipped, O if all open, else P
+    od = cols["o_orderdate"]
+    cols["o_orderstatus"] = jnp.where(
+        od + 121 < CURRENTDATE, 0, jnp.where(od > CURRENTDATE, 1, 2)
+    ).astype(jnp.int32)
+    return cols, None
+
+
+def lines_per_order(okey):
+    return 1 + (jnp.abs(_rand(20, okey)) % LINES_PER_ORDER_MAX)
+
+
+def gen_lineitem(sf: float, order_lo: int, order_hi: int):
+    """Line items of orders [order_lo, order_hi); capacity 7/order with a valid mask."""
+    n_orders = order_hi - order_lo
+    r = jnp.arange(n_orders * LINES_PER_ORDER_MAX, dtype=jnp.int64)
+    okey = order_lo + r // LINES_PER_ORDER_MAX + 1
+    lineno = (r % LINES_PER_ORDER_MAX).astype(jnp.int64)
+    valid = lineno < lines_per_order(okey)
+    uid = okey * 8 + lineno  # unique per line, stable across splits
+    pcount = int(BASE_ROWS["part"] * sf)
+    scount = int(BASE_ROWS["supplier"] * sf)
+    partkey = _uniform(21, uid, 1, max(pcount, 1))
+    qty = _uniform(22, uid, 1, 50)
+    odate = _uniform(12, okey, STARTDATE, ENDDATE - 151)  # same stream as orders!
+    shipdate = odate + _uniform(23, uid, 1, 121)
+    commitdate = odate + _uniform(24, uid, 30, 90)
+    receiptdate = shipdate + _uniform(25, uid, 1, 30)
+    returnable = receiptdate <= CURRENTDATE
+    cols = {
+        "l_orderkey": okey,
+        "l_partkey": partkey,
+        "l_suppkey": _supplier_for(partkey, max(scount, 1), _uniform(26, uid, 0, 3)),
+        "l_linenumber": (lineno + 1).astype(jnp.int32),
+        "l_quantity": qty * 100,  # decimal(15,2) raw
+        "l_extendedprice": qty * _retailprice_raw(partkey),
+        "l_discount": _uniform(27, uid, 0, 10),
+        "l_tax": _uniform(28, uid, 0, 8),
+        "l_returnflag": jnp.where(returnable, _uniform(29, uid, 0, 1), 2).astype(jnp.int32),
+        "l_linestatus": jnp.where(shipdate > CURRENTDATE, 1, 0).astype(jnp.int32),
+        "l_shipdate": shipdate.astype(jnp.int32),
+        "l_commitdate": commitdate.astype(jnp.int32),
+        "l_receiptdate": receiptdate.astype(jnp.int32),
+        "l_shipinstruct": _uniform(30, uid, 0, 3).astype(jnp.int32),
+        "l_shipmode": _uniform(31, uid, 0, 6).astype(jnp.int32),
+        "l_comment": (uid % (1 << 31)).astype(jnp.int32),
+    }
+    return cols, valid
+
+
+def gen_customer(sf, lo, hi):
+    i = jnp.arange(lo, hi, dtype=jnp.int64)
+    key = i + 1
+    return {
+        "c_custkey": key,
+        "c_name": (key % (1 << 31)).astype(jnp.int32),
+        "c_address": (key % (1 << 31)).astype(jnp.int32),
+        "c_nationkey": _uniform(41, key, 0, 24),
+        "c_phone": (key % (1 << 31)).astype(jnp.int32),
+        "c_acctbal": _uniform(42, key, -99_999, 999_999),
+        "c_mktsegment": _uniform(43, key, 0, 4).astype(jnp.int32),
+        "c_comment": (key % (1 << 31)).astype(jnp.int32),
+    }, None
+
+
+def gen_part(sf, lo, hi):
+    i = jnp.arange(lo, hi, dtype=jnp.int64)
+    key = i + 1
+    return {
+        "p_partkey": key,
+        "p_name": (key % (1 << 31)).astype(jnp.int32),
+        "p_mfgr": ((_uniform(51, key, 1, 5)) - 1).astype(jnp.int32),
+        "p_brand": (_uniform(51, key, 1, 5) * 5 + _uniform(52, key, 1, 5) - 6).astype(jnp.int32),
+        "p_type": _uniform(53, key, 0, 149).astype(jnp.int32),
+        "p_size": _uniform(54, key, 1, 50).astype(jnp.int32),
+        "p_container": _uniform(55, key, 0, 39).astype(jnp.int32),
+        "p_retailprice": _retailprice_raw(key),
+        "p_comment": (key % (1 << 31)).astype(jnp.int32),
+    }, None
+
+
+def gen_supplier(sf, lo, hi):
+    i = jnp.arange(lo, hi, dtype=jnp.int64)
+    key = i + 1
+    return {
+        "s_suppkey": key,
+        "s_name": (key % (1 << 31)).astype(jnp.int32),
+        "s_address": (key % (1 << 31)).astype(jnp.int32),
+        "s_nationkey": _uniform(61, key, 0, 24),
+        "s_phone": (key % (1 << 31)).astype(jnp.int32),
+        "s_acctbal": _uniform(62, key, -99_999, 999_999),
+        "s_comment": (key % (1 << 31)).astype(jnp.int32),
+    }, None
+
+
+def gen_partsupp(sf, lo, hi):
+    i = jnp.arange(lo, hi, dtype=jnp.int64)
+    partkey = i // 4 + 1
+    scount = max(int(BASE_ROWS["supplier"] * sf), 1)
+    return {
+        "ps_partkey": partkey,
+        "ps_suppkey": _supplier_for(partkey, scount, i % 4),
+        "ps_availqty": _uniform(71, i, 1, 9999).astype(jnp.int32),
+        "ps_supplycost": _uniform(72, i, 100, 100_000),
+        "ps_comment": (i % (1 << 31)).astype(jnp.int32),
+    }, None
+
+
+def gen_nation(sf, lo, hi):
+    i = jnp.arange(lo, hi, dtype=jnp.int64)
+    rkeys = jnp.asarray(np.array([r for _, r in NATIONS], dtype=np.int64))[i]
+    return {
+        "n_nationkey": i,
+        "n_name": i.astype(jnp.int32),
+        "n_regionkey": rkeys,
+        "n_comment": i.astype(jnp.int32),
+    }, None
+
+
+def gen_region(sf, lo, hi):
+    i = jnp.arange(lo, hi, dtype=jnp.int64)
+    return {
+        "r_regionkey": i,
+        "r_name": i.astype(jnp.int32),
+        "r_comment": i.astype(jnp.int32),
+    }, None
+
+
+_GENERATORS = {
+    "orders": gen_orders, "lineitem": gen_lineitem, "customer": gen_customer,
+    "part": gen_part, "supplier": gen_supplier, "partsupp": gen_partsupp,
+    "nation": gen_nation, "region": gen_region,
+}
+
+
+# -- connector SPI ------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TpchSplit:
+    table: str
+    lo: int  # row range (order range for lineitem)
+    hi: int
+
+
+class TpchConnector:
+    """Connector over generated TPC-H data (see trino_tpu.spi for the SPI contract)."""
+
+    name = "tpch"
+
+    def __init__(self, sf: float = 1.0, split_rows: int = 1 << 20):
+        self.sf = sf
+        self.split_rows = split_rows
+
+    # metadata ---------------------------------------------------------------
+    def tables(self):
+        return list(TPCH_SCHEMAS)
+
+    def schema(self, table: str) -> Schema:
+        return TPCH_SCHEMAS[table]
+
+    def dictionaries(self, table: str) -> dict[str, Dictionary]:
+        return DICTIONARIES[table]
+
+    def row_count(self, table: str) -> int:
+        if table == "lineitem":  # expected ~4/order; exact count is data-dependent
+            return int(BASE_ROWS["orders"] * self.sf) * 4
+        if table in ("nation", "region"):
+            return BASE_ROWS[table]
+        return int(BASE_ROWS[table] * self.sf)
+
+    # splits -----------------------------------------------------------------
+    def splits(self, table: str, n_hint: int = 0) -> list[TpchSplit]:
+        if table == "lineitem":
+            n = int(BASE_ROWS["orders"] * self.sf)
+            step = max(self.split_rows // LINES_PER_ORDER_MAX, 1)
+        else:
+            n = self.row_count(table)
+            step = self.split_rows
+        return [TpchSplit(table, lo, min(lo + step, n)) for lo in range(0, n, step)]
+
+    # page source ------------------------------------------------------------
+    def generate(self, split: TpchSplit, columns=None) -> Page:
+        """Jit-compiled page generation for one split (shape class = split size)."""
+        schema = TPCH_SCHEMAS[split.table]
+        names = columns if columns is not None else schema.names
+        out_schema = Schema(tuple(schema.field(n) for n in names))
+        cols, valid = _jit_generate(split.table, self.sf, split.lo, split.hi, tuple(names))
+        return Page(out_schema, cols, tuple(None for _ in cols), valid)
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def _jit_generate(table: str, sf: float, lo: int, hi: int, names: tuple):
+    cols, valid = _GENERATORS[table](sf, lo, hi)
+    return tuple(cols[n] for n in names), valid

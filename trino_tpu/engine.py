@@ -1,0 +1,49 @@
+"""Engine facade: catalogs + session + query entry point.
+
+Mirrors the coordinator entry path of the reference (dispatcher/DispatchManager.java:176 →
+execution/SqlQueryExecution.java) minus the HTTP/queueing layers (those live in
+trino_tpu.server): parse → analyze → plan → optimize → execute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Optional
+
+__all__ = ["Engine", "Session"]
+
+_query_ids = itertools.count(1)
+
+
+@dataclasses.dataclass
+class Session:
+    """reference: core/trino-main .../Session.java (subset)."""
+
+    catalog: Optional[str] = None
+    schema: Optional[str] = None
+    user: str = "user"
+    properties: dict = dataclasses.field(default_factory=dict)
+
+
+class Engine:
+    def __init__(self):
+        self.catalogs: dict = {}
+
+    def register_catalog(self, name: str, connector) -> None:
+        self.catalogs[name] = connector
+
+    def create_session(self, catalog: Optional[str] = None, schema: str = "default") -> Session:
+        return Session(catalog=catalog, schema=schema)
+
+    # -- plan-level execution (SQL front-end sits on top, sql/frontend.py) --------------
+    def execute_plan(self, plan):
+        from .exec.local_executor import LocalExecutor
+
+        return LocalExecutor(self.catalogs).execute(plan)
+
+    def execute_sql(self, sql: str, session: Optional[Session] = None):
+        from .sql.frontend import compile_sql
+
+        plan = compile_sql(sql, self, session or Session())
+        return self.execute_plan(plan)

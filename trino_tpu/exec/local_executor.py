@@ -1,0 +1,442 @@
+"""Single-device fused-pipeline executor.
+
+The reference pumps pages through an operator chain one page at a time
+(operator/Driver.java:283,372-481) with per-operator compiled bytecode.  The TPU re-design
+*fuses a whole pipeline into one jit-compiled step function* per page-shape class: scan
+generation, filter, projections and the aggregation/join-build sink all trace into a single
+XLA program, so elementwise work fuses into the scatter/gather kernels and pages never leave
+HBM between "operators".  The Python driver loop only sequences splits and carries the
+accumulated state pytree (the moral equivalent of Driver.process's loop, but per-split
+instead of per-operator-call).
+
+Pipeline boundaries match the reference's: an Aggregate or Join-build is a sink that
+materializes state (reference: HashAggregationOperator / HashBuilderOperator); everything
+between sources and sinks is streaming.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..connectors.tpch import Dictionary
+from ..ops import hashagg
+from ..ops.hashjoin import JoinTable, build_insert, build_table_init, probe
+from ..page import Field, Page, Schema
+from ..types import BIGINT, DOUBLE, BOOLEAN, DecimalType, Type
+from ..sql import plan as P
+from ..sql.ir import Call, Constant, Expr, FieldRef, evaluate, evaluate_predicate
+
+__all__ = ["LocalExecutor", "MaterializedResult"]
+
+DEFAULT_GROUP_CAPACITY = 1 << 16
+MAX_GROUP_CAPACITY = 1 << 24
+
+
+@dataclasses.dataclass
+class MaterializedResult:
+    """Host-side query result (reference: testing MaterializedResult)."""
+
+    names: tuple
+    types: tuple
+    columns: list  # numpy arrays, decoded (strings as objects, decimals as floats)
+    raw_columns: list  # undecoded numpy arrays (dict ids / scaled ints)
+
+    def __len__(self):
+        return 0 if not self.columns else len(self.columns[0])
+
+    def rows(self):
+        return list(zip(*self.columns))
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame({n: c for n, c in zip(self.names, self.columns)})
+
+
+@dataclasses.dataclass
+class _Stream:
+    """A streaming pipeline segment: a source of raw pages + a fused transform."""
+
+    schema: Schema
+    dicts: tuple  # Dictionary|None per channel
+    pages: Callable  # () -> iterator of raw source Pages
+    transform: Callable  # (cols, nulls, valid) -> (cols, nulls, valid); jit-traceable
+
+
+class LocalExecutor:
+    """Executes a plan tree on the local device set (one chip or CPU)."""
+
+    def __init__(self, catalogs: dict):
+        self.catalogs = catalogs
+
+    # ------------------------------------------------------------------ public
+    def execute(self, node: P.PlanNode) -> MaterializedResult:
+        page, dicts = self._execute_to_page(node)
+        return _materialize(page, dicts)
+
+    # ---------------------------------------------------------------- internal
+    def _execute_to_page(self, node: P.PlanNode):
+        """Run a (sub)plan to completion, returning one host-side Page + dicts."""
+        if isinstance(node, P.Output):
+            child, dicts = self._execute_to_page(node.child)
+            return Page(node.schema, child.columns, child.null_masks, child.valid), dicts
+        if isinstance(node, P.Sort):
+            child, dicts = self._execute_to_page(node.child)
+            return _sort_page(child, node.keys), dicts
+        if isinstance(node, P.Limit):
+            child, dicts = self._execute_to_page(node.child)
+            return _limit_page(child, node.count), dicts
+        if isinstance(node, P.Aggregate):
+            return self._run_aggregate(node)
+        # streaming leaf reached directly (scan/filter/project/join-probe): materialize
+        stream = self._compile_stream(node)
+        return _concat_stream(stream), stream.dicts
+
+    # -- streaming segment compilation ---------------------------------------
+    def _compile_stream(self, node: P.PlanNode) -> _Stream:
+        if isinstance(node, P.TableScan):
+            conn = self.catalogs[node.catalog]
+            dicts = tuple(conn.dictionaries(node.table).get(c) for c in node.columns)
+            splits = conn.splits(node.table)
+
+            def pages(conn=conn, splits=splits, node=node):
+                for s in splits:
+                    yield conn.generate(s, node.columns)
+
+            return _Stream(node.schema, dicts, pages, lambda c, n, v: (c, n, v))
+
+        if isinstance(node, P.Filter):
+            up = self._compile_stream(node.child)
+            pred = node.predicate
+
+            def transform(cols, nulls, valid, up=up, pred=pred):
+                cols, nulls, valid = up.transform(cols, nulls, valid)
+                return cols, nulls, evaluate_predicate(pred, cols, nulls, valid)
+
+            return _Stream(up.schema, up.dicts, up.pages, transform)
+
+        if isinstance(node, P.Project):
+            up = self._compile_stream(node.child)
+            dicts = tuple(
+                up.dicts[e.index] if isinstance(e, FieldRef) else None for e in node.exprs
+            )
+
+            def transform(cols, nulls, valid, up=up, exprs=node.exprs):
+                cols, nulls, valid = up.transform(cols, nulls, valid)
+                out = [evaluate(e, cols, nulls) for e in exprs]
+                return tuple(v for v, _ in out), tuple(n for _, n in out), valid
+
+            return _Stream(node.schema, dicts, up.pages, transform)
+
+        if isinstance(node, P.Join):
+            return self._compile_join(node)
+
+        if isinstance(node, P.Values):
+            page = _values_page(node)
+            return _Stream(node.schema, tuple(None for _ in node.schema.fields),
+                           lambda: iter([page]), lambda c, n, v: (c, n, v))
+
+        if isinstance(node, (P.Aggregate, P.Sort, P.Limit, P.Output)):
+            # blocking sub-plan feeding a streaming consumer: run it, emit its one page
+            page, dicts = self._execute_to_page(node)
+
+            def pages(page=page):
+                yield page
+
+            return _Stream(node.schema, dicts, pages, lambda c, n, v: (c, n, v))
+
+        raise NotImplementedError(f"node {type(node).__name__}")
+
+    # -- aggregation sink ----------------------------------------------------
+    def _run_aggregate(self, node: P.Aggregate):
+        stream = self._compile_stream(node.child)
+        child_schema = stream.schema
+        key_types = tuple(child_schema.fields[i].type for i in node.keys)
+
+        # expand avg -> (sum, count); build accumulator specs
+        acc_specs, acc_exprs, acc_kinds = [], [], []
+        for spec in node.aggs:
+            for kind, dtype, init in _accumulators_for(spec):
+                acc_specs.append((dtype, init))
+                acc_exprs.append(spec.arg)
+                acc_kinds.append(kind)
+
+        capacity = node.capacity or DEFAULT_GROUP_CAPACITY
+        if not node.keys:
+            return self._run_global_aggregate(node, stream, acc_exprs, acc_kinds)
+
+        while True:
+            state = hashagg.groupby_init(
+                capacity, tuple(t.dtype for t in key_types), acc_specs
+            )
+
+            @jax.jit
+            def step(state, page, stream=stream, node=node, key_types=key_types,
+                     acc_exprs=acc_exprs, acc_kinds=acc_kinds):
+                cols, nulls, valid = stream.transform(
+                    page.columns, page.null_masks, page.valid_mask()
+                )
+                key_vals = tuple(cols[i] for i in node.keys)
+                inputs = [
+                    (None, None) if e is None else evaluate(e, cols, nulls) for e in acc_exprs
+                ]
+                return hashagg.groupby_insert(
+                    state, key_vals, key_types, valid, inputs, acc_kinds
+                )
+
+            for page in stream.pages():
+                state = step(state, page)
+            if not bool(state.overflow) or capacity >= MAX_GROUP_CAPACITY:
+                break
+            capacity *= 4  # next capacity bucket (reference: FlatHash#rehash)
+
+        occupied, keys, accs = hashagg.agg_finalize(state)
+        occ = np.asarray(occupied)
+        key_cols = [np.asarray(k)[occ] for k in keys]
+        acc_cols = [np.asarray(a)[occ] for a in accs]
+        out_cols = key_cols + _finalize_aggs(node.aggs, acc_cols, len(occ.nonzero()[0]))
+        arrays = [jnp.asarray(c) for c in out_cols]
+        page = Page(node.schema, tuple(arrays), tuple(None for _ in arrays), None)
+        dicts = tuple(stream.dicts[i] for i in node.keys) + tuple(None for _ in node.aggs)
+        return page, dicts
+
+    def _run_global_aggregate(self, node, stream, acc_exprs, acc_kinds):
+        """Ungrouped aggregation (reference: AggregationOperator) — pure jnp reductions."""
+
+        @jax.jit
+        def step(state, page, stream=stream, acc_exprs=acc_exprs, acc_kinds=acc_kinds):
+            cols, nulls, valid = stream.transform(page.columns, page.null_masks, page.valid_mask())
+            out = []
+            for st, e, kind in zip(state, acc_exprs, acc_kinds):
+                if kind == "count_star":
+                    out.append(st + jnp.sum(valid, dtype=st.dtype))
+                    continue
+                v, nu = evaluate(e, cols, nulls)
+                mask = valid if nu is None else (valid & ~nu)
+                if kind == "count":
+                    out.append(st + jnp.sum(mask, dtype=st.dtype))
+                elif kind == "sum":
+                    out.append(st + jnp.sum(jnp.where(mask, v, 0), dtype=st.dtype))
+                elif kind == "min":
+                    out.append(jnp.minimum(st, jnp.min(jnp.where(mask, v, hashagg._extreme(st.dtype, 1)))))
+                elif kind == "max":
+                    out.append(jnp.maximum(st, jnp.max(jnp.where(mask, v, hashagg._extreme(st.dtype, -1)))))
+                else:
+                    raise NotImplementedError(kind)
+            return tuple(out)
+
+        acc_specs = []
+        for spec in node.aggs:
+            acc_specs.extend(_accumulators_for(spec))
+        state = tuple(
+            jnp.asarray(init if init is not None else 0, dtype)
+            for _, dtype, init in acc_specs
+        )
+        # min/max identity
+        state = tuple(
+            jnp.asarray(hashagg._extreme(dtype, 1 if kind == "min" else -1), dtype)
+            if kind in ("min", "max") else st
+            for st, (kind, dtype, _) in zip(state, acc_specs)
+        )
+        for page in stream.pages():
+            state = step(state, page)
+        acc_cols = [np.asarray(s)[None] for s in state]
+        out_cols = _finalize_aggs(node.aggs, acc_cols, 1)
+        arrays = [jnp.asarray(c) for c in out_cols]
+        page = Page(node.schema, tuple(arrays), tuple(None for _ in arrays), None)
+        return page, tuple(None for _ in node.aggs)
+
+    # -- join ---------------------------------------------------------------
+    def _compile_join(self, node: P.Join) -> _Stream:
+        build_page, build_dicts = self._execute_to_page_streamed(node.right)
+        probe_stream = self._compile_stream(node.left)
+        build_key_types = tuple(node.right.schema.fields[i].type for i in node.right_keys)
+        table = self._build_join_table(build_page, node.right_keys, build_key_types)
+        semi = node.kind in ("semi", "anti")
+
+        def transform(cols, nulls, valid, up=probe_stream, node=node, table=table):
+            cols, nulls, valid = up.transform(cols, nulls, valid)
+            keys = tuple(cols[i] for i in node.left_keys)
+            row_ids, matched = probe(table, keys, build_key_types, valid)
+            if node.kind == "inner":
+                valid = valid & matched
+            elif node.kind == "semi":
+                valid = valid & matched
+            elif node.kind == "anti":
+                valid = valid & ~matched
+            if semi:
+                return cols, nulls, valid
+            bcols, bnulls = _gather_build(table, row_ids, matched, node.kind)
+            out_cols = tuple(cols) + bcols
+            out_nulls = tuple(nulls) + bnulls
+            if node.filter is not None:
+                valid = evaluate_predicate(node.filter, out_cols, out_nulls, valid)
+            return out_cols, out_nulls, valid
+
+        dicts = (probe_stream.dicts if semi
+                 else probe_stream.dicts + build_dicts)
+        return _Stream(node.schema, dicts, probe_stream.pages, transform)
+
+    def _execute_to_page_streamed(self, node):
+        """Materialize a sub-plan into one device page (join build side)."""
+        if isinstance(node, (P.Aggregate, P.Sort, P.Limit, P.Output)):
+            return self._execute_to_page(node)
+        stream = self._compile_stream(node)
+        return _concat_stream(stream), stream.dicts
+
+    def _build_join_table(self, build_page: Page, key_channels, key_types):
+        n = build_page.capacity
+        capacity = max(1 << max(n - 1, 1).bit_length(), 16) * 2
+        table = build_table_init(capacity, build_page)
+        keys = tuple(build_page.columns[i] for i in key_channels)
+        return jax.jit(build_insert, static_argnums=(2,))(
+            table, keys, key_types, build_page.valid_mask()
+        )
+
+
+# -- helpers ------------------------------------------------------------------------------
+
+
+def _accumulators_for(spec: P.AggSpec):
+    """(kind, dtype, init) accumulator list for one agg call."""
+    t = spec.type
+    if spec.kind == "count_star" or spec.kind == "count":
+        return [(spec.kind, jnp.int64, 0)]
+    if spec.kind == "sum":
+        dtype = jnp.float64 if t.is_floating else jnp.int64
+        return [("sum", dtype, 0)]
+    if spec.kind == "avg":
+        in_t = spec.arg.type
+        dtype = jnp.float64 if in_t.is_floating else jnp.int64
+        return [("sum", dtype, 0), ("count", jnp.int64, 0)]
+    if spec.kind in ("min", "max"):
+        dtype = spec.arg.type.dtype
+        init = None
+        return [(spec.kind, dtype, hashagg._extreme(dtype, 1 if spec.kind == "min" else -1))]
+    raise NotImplementedError(spec.kind)
+
+
+def _finalize_aggs(aggs, acc_cols, n_groups):
+    """Combine accumulator columns into final output columns (host-side, small)."""
+    out = []
+    i = 0
+    for spec in aggs:
+        if spec.kind == "avg":
+            s, c = acc_cols[i], acc_cols[i + 1]
+            i += 2
+            c_safe = np.where(c == 0, 1, c)
+            if isinstance(spec.type, DecimalType):
+                q, r = np.divmod(np.abs(s), c_safe)
+                val = (q + (2 * r >= c_safe)) * np.sign(s)
+                out.append(val.astype(np.int64))
+            else:
+                out.append((s / c_safe).astype(np.float64))
+        else:
+            col = acc_cols[i]
+            i += 1
+            out.append(col.astype(np.dtype(spec.type.dtype)))
+    return out
+
+
+def _concat_stream(stream: _Stream) -> Page:
+    """Materialize a streaming segment into a single device page (compacted)."""
+    parts = []
+    step = jax.jit(lambda page, stream=stream: stream.transform(
+        page.columns, page.null_masks, page.valid_mask()))
+    for page in stream.pages():
+        parts.append(step(page))
+    if not parts:
+        cols = tuple(jnp.zeros((0,), f.type.dtype) for f in stream.schema.fields)
+        return Page(stream.schema, cols, tuple(None for _ in cols), None)
+    ncols = len(parts[0][0])
+    # host-side compaction between pipeline-breaking stages
+    cols_np, nulls_np = [], []
+    valids = [np.asarray(v) for _, _, v in parts]
+    for ci in range(ncols):
+        cols_np.append(np.concatenate([np.asarray(p[0][ci])[v] for p, v in zip(parts, valids)]))
+        have_null = any(p[1][ci] is not None for p in parts)
+        if have_null:
+            nulls_np.append(np.concatenate([
+                (np.asarray(p[1][ci]) if p[1][ci] is not None
+                 else np.zeros_like(v))[v]
+                for p, v in zip(parts, valids)
+            ]))
+        else:
+            nulls_np.append(None)
+    cols = tuple(jnp.asarray(c) for c in cols_np)
+    nulls = tuple(None if n is None else jnp.asarray(n) for n in nulls_np)
+    return Page(stream.schema, cols, nulls, None)
+
+
+def _gather_build(table: JoinTable, row_ids, matched, kind):
+    """Fetch build-side columns for probe matches; unmatched rows -> nulls (left join)."""
+    safe = jnp.where(matched, row_ids, 0)
+    cols, nulls = [], []
+    for c, nmask in zip(table.build_columns, table.build_null_masks):
+        cols.append(c[safe])
+        base = jnp.zeros_like(matched) if nmask is None else nmask[safe]
+        nulls.append((base | ~matched) if kind == "left" else (None if nmask is None else base))
+    return tuple(cols), tuple(nulls)
+
+
+def _values_page(node: P.Values) -> Page:
+    cols = []
+    for ci, f in enumerate(node.schema.fields):
+        cols.append(jnp.asarray(np.array([r[ci] for r in node.rows]), f.type.dtype))
+    return Page(node.schema, tuple(cols), tuple(None for _ in cols), None)
+
+
+def _sort_page(page: Page, keys) -> Page:
+    """Host-side lexicographic sort (result sets; large distributed sort is separate)."""
+    valid = np.asarray(page.valid_mask())
+    cols = [np.asarray(c)[valid] for c in page.columns]
+    nulls = [None if n is None else np.asarray(n)[valid] for n in page.null_masks]
+    order = np.arange(len(cols[0]) if cols else 0)
+    for k in reversed(keys):
+        c = cols[k.channel][order]
+        kind = "stable"
+        idx = np.argsort(c, kind=kind)
+        if not k.ascending:
+            idx = idx[::-1]
+            # keep stability under descending: argsort of negated where possible
+            if np.issubdtype(c.dtype, np.number):
+                idx = np.argsort(-c.astype(np.float64), kind=kind)
+        order = order[idx]
+    new_cols = tuple(jnp.asarray(c[order]) for c in cols)
+    new_nulls = tuple(None if n is None else jnp.asarray(n[order]) for n in nulls)
+    return Page(page.schema, new_cols, new_nulls, None)
+
+
+def _limit_page(page: Page, count: int) -> Page:
+    valid = np.asarray(page.valid_mask())
+    cols = tuple(jnp.asarray(np.asarray(c)[valid][:count]) for c in page.columns)
+    nulls = tuple(
+        None if n is None else jnp.asarray(np.asarray(n)[valid][:count]) for n in page.null_masks
+    )
+    return Page(page.schema, cols, nulls, None)
+
+
+def _materialize(page: Page, dicts) -> MaterializedResult:
+    valid = np.asarray(page.valid_mask())
+    names, types, columns, raw = [], [], [], []
+    for i, f in enumerate(page.schema.fields):
+        arr = np.asarray(page.columns[i])[valid]
+        raw.append(arr)
+        dec = arr
+        if isinstance(f.type, DecimalType):
+            dec = arr.astype(np.float64) / (10**f.type.scale)
+        elif f.type.is_string and dicts[i] is not None:
+            dec = dicts[i].decode(arr)
+        if page.null_masks[i] is not None:
+            nm = np.asarray(page.null_masks[i])[valid]
+            dec = np.array([None if m else v for v, m in zip(dec.tolist(), nm)], dtype=object) \
+                if nm.any() else dec
+        names.append(f.name)
+        types.append(f.type)
+        columns.append(dec)
+    return MaterializedResult(tuple(names), tuple(types), columns, raw)

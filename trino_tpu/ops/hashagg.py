@@ -1,0 +1,170 @@
+"""Hash aggregation: vectorized open-addressing group-by over fixed-capacity tables.
+
+Reference design: HashAggregationOperator (operator/HashAggregationOperator.java:46) →
+FlatGroupByHash/FlatHash (operator/FlatHash.java:57-59, probe/insert :271-396) assigns dense
+group ids per input row (Work<int[]> getGroupIds(Page), operator/GroupByHash.java:125), then
+GroupedAggregators scatter per-group state updates.
+
+TPU re-design (no per-row control flow, everything jit-compiled):
+- keys are packed to one int64 word per row (ops/hashing.pack_keys);
+- the table is a fixed-capacity int64 array; insertion is a *deterministic parallel claim*:
+  per probe round, rows gather their slot, matching rows finish, rows seeing EMPTY contend
+  with scatter-min (min over distinct packed keys is a deterministic winner), losers advance
+  to the next slot (linear probing).  MAX_PROBES rounds of gather+scatter replace the
+  reference's per-row CAS loop;
+- aggregation state is a struct-of-arrays indexed by slot; updates are masked segment
+  scatter-adds (XLA lowers these to efficient sorted-scatter on TPU);
+- the table never rehashes inside a trace: capacity is a static bucket chosen by the planner
+  (reference rehashes dynamically, FlatHash#rehash — here a capacity overflow sets a flag the
+  driver can observe to re-run the batch against the next capacity bucket, keeping shapes
+  static for XLA).
+
+State is a pytree, so multi-page accumulation runs as `state = step(state, page)` inside jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hashing import EMPTY_KEY, pack_keys, splitmix64
+
+__all__ = ["GroupByState", "groupby_init", "groupby_insert", "AGG_INITS", "agg_update", "agg_finalize"]
+
+MAX_PROBES = 64
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class GroupByState:
+    """Open-addressing table + per-slot aggregate accumulators."""
+
+    table: jnp.ndarray  # [capacity+1] int64 packed keys; EMPTY_KEY = free; last slot = overflow sink
+    key_cols: tuple  # per-key original column values captured at insert ([capacity+1] each)
+    accs: tuple  # per-aggregate accumulator arrays ([capacity+1, ...])
+    overflow: jnp.ndarray  # bool scalar: some row failed to place within MAX_PROBES
+
+    def tree_flatten(self):
+        return (self.table, self.key_cols, self.accs, self.overflow), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def capacity(self) -> int:
+        return self.table.shape[0] - 1
+
+
+def groupby_init(capacity: int, key_dtypes, acc_specs) -> GroupByState:
+    """acc_specs: sequence of (dtype, init_scalar) per accumulator array."""
+    table = jnp.full((capacity + 1,), EMPTY_KEY, dtype=jnp.int64)
+    key_cols = tuple(jnp.zeros((capacity + 1,), dt) for dt in key_dtypes)
+    accs = tuple(jnp.full((capacity + 1,), init, dtype=dt) for dt, init in acc_specs)
+    return GroupByState(table, key_cols, accs, jnp.zeros((), bool))
+
+
+def _probe_insert(table, packed, valid):
+    """Assign each valid row a slot whose table word == its packed key; claim empty slots
+    deterministically. Returns (table, slot[int32], placed[bool])."""
+    C = table.shape[0] - 1
+    h0 = splitmix64(packed)
+    n = packed.shape[0]
+    slot = jnp.full((n,), C, jnp.int32)  # default: overflow sink
+    placed = ~valid  # invalid rows are trivially "done" (routed to sink)
+
+    def body(p, carry):
+        table, slot, placed = carry
+        idx = (jnp.abs(h0 + p) % C).astype(jnp.int32)
+        idx = jnp.where(placed, C, idx)
+        cur = table[idx]
+        hit = (cur == packed) & ~placed
+        slot = jnp.where(hit, idx, slot)
+        placed = placed | hit
+        contend = (cur == EMPTY_KEY) & ~placed
+        sidx = jnp.where(contend, idx, C).astype(jnp.int32)
+        table = table.at[sidx].min(jnp.where(contend, packed, EMPTY_KEY))
+        # sink slot may have been clobbered by routed writes; restore
+        table = table.at[C].set(EMPTY_KEY)
+        cur2 = table[idx]
+        won = (cur2 == packed) & ~placed
+        slot = jnp.where(won, idx, slot)
+        placed = placed | won
+        return table, slot, placed
+
+    table, slot, placed = jax.lax.fori_loop(0, MAX_PROBES, body, (table, slot, placed))
+    return table, slot, placed
+
+
+def groupby_insert(state: GroupByState, key_vals: Sequence, key_types, valid,
+                   agg_inputs: Sequence, agg_updates: Sequence[str]) -> GroupByState:
+    """One page of input → updated state.
+
+    agg_inputs[i]: (value_array|None, input_null_mask|None); agg_updates[i]: update kind
+    ('sum','count','min','max','count_star').
+    """
+    packed, exact = pack_keys(key_vals, key_types)
+    table, slot, placed = _probe_insert(state.table, packed, valid)
+    overflow = state.overflow | jnp.any(valid & ~placed)
+    live = valid & placed
+
+    # capture original key values per slot (idempotent writes: same key -> same value)
+    key_cols = tuple(
+        kc.at[jnp.where(live, slot, kc.shape[0] - 1)].set(jnp.where(live, kv, kc[-1]))
+        for kc, kv in zip(state.key_cols, key_vals)
+    )
+    accs = tuple(
+        agg_update(acc, kind, slot, live, vals_nulls)
+        for acc, kind, vals_nulls in zip(state.accs, agg_updates, agg_inputs)
+    )
+    return GroupByState(table, key_cols, accs, overflow)
+
+
+def agg_update(acc, kind, slot, live, vals_nulls):
+    vals, nulls = vals_nulls if vals_nulls is not None else (None, None)
+    mask = live if (nulls is None or vals is None) else (live & ~nulls)
+    sink = acc.shape[0] - 1
+    idx = jnp.where(mask, slot, sink)
+    if kind == "count_star":
+        return acc.at[idx].add(jnp.where(live, 1, 0).astype(acc.dtype))
+    if kind == "count":
+        return acc.at[idx].add(jnp.where(mask, 1, 0).astype(acc.dtype))
+    if kind == "sum":
+        return acc.at[idx].add(jnp.where(mask, vals, 0).astype(acc.dtype))
+    if kind == "min":
+        big = _extreme(acc.dtype, +1)
+        return acc.at[idx].min(jnp.where(mask, vals, big).astype(acc.dtype))
+    if kind == "max":
+        small = _extreme(acc.dtype, -1)
+        return acc.at[idx].max(jnp.where(mask, vals, small).astype(acc.dtype))
+    raise NotImplementedError(kind)
+
+
+def _extreme(dtype, sign):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.inf * sign
+    info = jnp.iinfo(dtype)
+    return info.max if sign > 0 else info.min
+
+
+AGG_INITS = {
+    "sum": 0,
+    "count": 0,
+    "count_star": 0,
+    "min": None,  # filled with dtype max
+    "max": None,  # filled with dtype min
+}
+
+
+def agg_finalize(state: GroupByState):
+    """Returns (group_valid[capacity] bool, key_cols, accs) with the overflow sink dropped."""
+    C = state.capacity
+    occupied = state.table[:C] != EMPTY_KEY
+    keys = tuple(k[:C] for k in state.key_cols)
+    accs = tuple(a[:C] for a in state.accs)
+    return occupied, keys, accs

@@ -1,0 +1,123 @@
+"""Hash join build + probe kernels.
+
+Reference: HashBuilderOperator builds a PagesIndex + open-addressing JoinHash
+(operator/join/spilling/HashBuilderOperator.java:68, join/JoinHash.java:28,
+join/DefaultPagesHash.java:159-197 — note its batch probe getAddressIndex(int[],Page,long[])
+is already vectorized in spirit); LookupJoinOperator probes per page
+(join/spilling/LookupJoinOperator.java:43, JoinProbe.advanceNextPosition:76).
+
+TPU re-design:
+- build side is a fixed-capacity int64 table of packed keys (ops/hashing.pack_keys) claimed
+  with the same deterministic scatter-min protocol as hashagg; a parallel ``rows`` array maps
+  slot -> build row index;
+- probe is gather-only (no scatter): MAX_PROBES rounds of table lookup inside one jitted
+  kernel, whole page at a time — the batch analog of DefaultPagesHash.getAddressIndex;
+- build columns stay as device arrays; matches gather them by row id (the PagesIndex analog);
+- duplicate build keys are detected at build time (``dup_count > 0``); the executor falls
+  back to an expanding multi-match strategy for those (reference handles them via position
+  links, join/PositionLinks.java — our equivalent is planned: sorted multi-probe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .hashing import EMPTY_KEY, pack_keys, splitmix64
+
+__all__ = ["JoinTable", "build_table_init", "build_insert", "probe", "MAX_PROBES"]
+
+MAX_PROBES = 64
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class JoinTable:
+    table: jnp.ndarray  # [capacity+1] packed keys
+    rows: jnp.ndarray  # [capacity+1] int32 build row index per slot
+    build_columns: tuple  # full build-side columns (device)
+    build_null_masks: tuple
+    n_build_rows: jnp.ndarray  # int32 scalar
+    dup_count: jnp.ndarray  # int32 scalar: valid build rows minus occupied slots
+    overflow: jnp.ndarray  # bool scalar
+
+    def tree_flatten(self):
+        return (
+            (self.table, self.rows, self.build_columns, self.build_null_masks,
+             self.n_build_rows, self.dup_count, self.overflow),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def capacity(self):
+        return self.table.shape[0] - 1
+
+
+def build_table_init(capacity: int, build_page) -> JoinTable:
+    return JoinTable(
+        table=jnp.full((capacity + 1,), EMPTY_KEY, jnp.int64),
+        rows=jnp.full((capacity + 1,), 2**31 - 1, jnp.int32),  # min-claim: first row wins
+        build_columns=build_page.columns,
+        build_null_masks=build_page.null_masks,
+        n_build_rows=jnp.zeros((), jnp.int32),
+        dup_count=jnp.zeros((), jnp.int32),
+        overflow=jnp.zeros((), bool),
+    )
+
+
+def build_insert(jt: JoinTable, key_cols, key_types, valid) -> JoinTable:
+    """Insert build rows (SQL join keys are never NULL-matching: rows with NULL keys are
+    dropped by the caller via ``valid``)."""
+    from .hashagg import _probe_insert
+
+    packed, _ = pack_keys(key_cols, key_types)
+    packed = jnp.where(valid, packed, EMPTY_KEY - 1)
+    table, slot, placed = _probe_insert(jt.table, packed, valid)
+    live = valid & placed
+    C = jt.capacity
+    row_idx = jnp.arange(packed.shape[0], dtype=jnp.int32)
+    sidx = jnp.where(live, slot, C).astype(jnp.int32)
+    # min: first build row wins deterministically for duplicate keys
+    rows = jt.rows.at[sidx].min(jnp.where(live, row_idx, jnp.int32(2**31 - 1)))
+    rows = rows.at[C].set(0)
+    n_valid = jnp.sum(valid, dtype=jnp.int32)
+    occupied = jnp.sum(table[:C] != EMPTY_KEY, dtype=jnp.int32)
+    return JoinTable(
+        table=table,
+        rows=rows,
+        build_columns=jt.build_columns,
+        build_null_masks=jt.build_null_masks,
+        n_build_rows=jt.n_build_rows + n_valid,
+        dup_count=jt.n_build_rows + n_valid - occupied,
+        overflow=jt.overflow | jnp.any(valid & ~placed),
+    )
+
+
+def probe(jt: JoinTable, key_cols, key_types, valid):
+    """Gather-only probe: returns (build_row_ids[int32], matched[bool]) per probe row."""
+    packed, _ = pack_keys(key_cols, key_types)
+    C = jt.capacity
+    h0 = splitmix64(packed)
+    n = packed.shape[0]
+    row_ids = jnp.zeros((n,), jnp.int32)
+    matched = jnp.zeros((n,), bool)
+    done = ~valid
+
+    def body(p, carry):
+        row_ids, matched, done = carry
+        idx = (jnp.abs(h0 + p) % C).astype(jnp.int32)
+        cur = jt.table[idx]
+        hit = (cur == packed) & ~done
+        row_ids = jnp.where(hit, jt.rows[idx], row_ids)
+        matched = matched | hit
+        done = done | hit | (cur == EMPTY_KEY)
+        return row_ids, matched, done
+
+    row_ids, matched, done = jax.lax.fori_loop(0, MAX_PROBES, body, (row_ids, matched, done))
+    return row_ids, matched

@@ -1,0 +1,179 @@
+"""Relational plan nodes.
+
+Mirrors the reference's plan-node vocabulary (core/trino-main .../sql/planner/plan — 66 node
+types; we grow toward that set) with positional (channel-based) expressions like the
+reference's post-LocalExecutionPlanner form: every node exposes an output ``Schema`` and its
+expressions are FieldRefs into the child's output channels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..page import Schema
+from ..types import Type
+from .ir import Expr
+
+__all__ = ["PlanNode", "TableScan", "Filter", "Project", "AggSpec", "Aggregate",
+           "SortKey", "Sort", "Limit", "Join", "Values", "Output"]
+
+
+class PlanNode:
+    schema: Schema
+
+    @property
+    def children(self) -> tuple:
+        return ()
+
+
+@dataclasses.dataclass(frozen=True)
+class TableScan(PlanNode):
+    """reference: sql/planner/plan/TableScanNode.java"""
+
+    catalog: str
+    table: str
+    columns: tuple  # column names in the connector table
+    schema: Schema
+
+
+@dataclasses.dataclass(frozen=True)
+class Filter(PlanNode):
+    """reference: sql/planner/plan/FilterNode.java"""
+
+    child: PlanNode
+    predicate: Expr
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Project(PlanNode):
+    """reference: sql/planner/plan/ProjectNode.java"""
+
+    child: PlanNode
+    exprs: tuple  # Expr per output channel
+    schema: Schema
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class AggSpec:
+    """One aggregate call (reference: plan/AggregationNode.Aggregation)."""
+
+    kind: str  # count_star | count | sum | avg | min | max
+    arg: Optional[Expr]  # channel expr into child schema (None for count_star)
+    name: str
+    type: Type
+    distinct: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Aggregate(PlanNode):
+    """reference: sql/planner/plan/AggregationNode.java; keys are child channel indices."""
+
+    child: PlanNode
+    keys: tuple  # int channel indices
+    aggs: tuple  # AggSpec...
+    schema: Schema  # key fields then agg fields
+    capacity: int = 0  # group-table capacity bucket; 0 = planner default
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class SortKey:
+    channel: int
+    ascending: bool = True
+    nulls_first: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Sort(PlanNode):
+    """reference: sql/planner/plan/SortNode.java"""
+
+    child: PlanNode
+    keys: tuple  # SortKey...
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Limit(PlanNode):
+    """reference: sql/planner/plan/LimitNode.java"""
+
+    child: PlanNode
+    count: int
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Join(PlanNode):
+    """reference: sql/planner/plan/JoinNode.java; equi-join with optional residual filter.
+
+    ``distribution``: 'partitioned' | 'replicated' (reference: DistributionType chosen by
+    DetermineJoinDistributionType.java:51).
+    """
+
+    kind: str  # inner | left | semi | anti
+    left: PlanNode  # probe side
+    right: PlanNode  # build side
+    left_keys: tuple  # channel indices into left schema
+    right_keys: tuple  # channel indices into right schema
+    schema: Schema  # left fields then right fields (semi/anti: left only)
+    filter: Optional[Expr] = None  # over concatenated channels
+    distribution: str = "replicated"
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclasses.dataclass(frozen=True)
+class Values(PlanNode):
+    """reference: sql/planner/plan/ValuesNode.java; rows of python literals."""
+
+    rows: tuple
+    schema: Schema
+
+
+@dataclasses.dataclass(frozen=True)
+class Output(PlanNode):
+    """reference: sql/planner/plan/OutputNode.java; renames channels for the client."""
+
+    child: PlanNode
+    names: tuple
+
+    @property
+    def schema(self):
+        from ..page import Field
+
+        return Schema(tuple(Field(n, f.type) for n, f in zip(self.names, self.child.schema.fields)))
+
+    @property
+    def children(self):
+        return (self.child,)

@@ -1,0 +1,188 @@
+"""SQL type system, TPU-first.
+
+Mirrors the reference's ``core/trino-spi/src/main/java/io/trino/spi/type`` (Type.java:31,
+TypeOperators.java:71) but re-designed for XLA: every SQL type maps to a fixed-width device
+representation (a jnp dtype + static metadata).  Variable-width VARCHAR is dictionary-encoded
+(int32 ids + host-side dictionary), mirroring the reference's DictionaryBlock
+(spi/block/DictionaryBlock.java) but made the *primary* string representation because the TPU
+has no efficient variable-width path.
+
+Decimals are fixed-point scaled integers (int64), mirroring the reference's short-decimal
+representation (spi/type/DecimalType.java / Int128 long decimals); precision>18 is not yet
+supported.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, ClassVar
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Type",
+    "BIGINT",
+    "INTEGER",
+    "SMALLINT",
+    "TINYINT",
+    "DOUBLE",
+    "REAL",
+    "BOOLEAN",
+    "DATE",
+    "VARCHAR",
+    "TIMESTAMP",
+    "DecimalType",
+    "CharType",
+    "VarcharType",
+    "UNKNOWN",
+    "common_super_type",
+    "parse_date_literal",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Type:
+    """A SQL type with a fixed-width device representation.
+
+    ``dtype`` is the jnp storage dtype of a column of this type.  ``null_value`` is the
+    sentinel stored in masked-out lanes (never observable through the null mask).
+    """
+
+    name: str
+    dtype: Any
+    comparable: bool = True
+    orderable: bool = True
+
+    _registry: ClassVar[dict[str, "Type"]] = {}
+
+    def __str__(self) -> str:  # pragma: no cover - debugging aid
+        return self.name
+
+    # -- classification helpers -------------------------------------------------
+    @property
+    def is_integer(self) -> bool:
+        return self.name in ("bigint", "integer", "smallint", "tinyint")
+
+    @property
+    def is_floating(self) -> bool:
+        return self.name in ("double", "real")
+
+    @property
+    def is_decimal(self) -> bool:
+        return isinstance(self, DecimalType)
+
+    @property
+    def is_string(self) -> bool:
+        return isinstance(self, (VarcharType, CharType))
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.is_integer or self.is_floating or self.is_decimal
+
+    def zero(self):
+        return np.zeros((), dtype=self.dtype)[()]
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalType(Type):
+    """decimal(p, s) as a scaled int64 (short decimal).
+
+    Reference: spi/type/DecimalType.java; arithmetic rules follow
+    spi/type/DecimalOperators semantics for the subset we support.
+    """
+
+    precision: int = 18
+    scale: int = 0
+
+    def __post_init__(self):
+        if self.precision > 18:
+            raise NotImplementedError("long decimals (precision>18) not supported yet")
+
+    @staticmethod
+    def of(precision: int, scale: int) -> "DecimalType":
+        return DecimalType(
+            name=f"decimal({precision},{scale})", dtype=jnp.int64, precision=precision, scale=scale
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class VarcharType(Type):
+    """varchar(n); stored as int32 dictionary ids (see page.Column.dictionary)."""
+
+    length: int | None = None
+
+    @staticmethod
+    def of(length: int | None = None) -> "VarcharType":
+        name = "varchar" if length is None else f"varchar({length})"
+        return VarcharType(name=name, dtype=jnp.int32, length=length)
+
+
+@dataclasses.dataclass(frozen=True)
+class CharType(Type):
+    length: int = 1
+
+    @staticmethod
+    def of(length: int) -> "CharType":
+        return CharType(name=f"char({length})", dtype=jnp.int32, length=length)
+
+
+BIGINT = Type("bigint", jnp.int64)
+INTEGER = Type("integer", jnp.int32)
+SMALLINT = Type("smallint", jnp.int16)
+TINYINT = Type("tinyint", jnp.int8)
+DOUBLE = Type("double", jnp.float64)
+REAL = Type("real", jnp.float32)
+BOOLEAN = Type("boolean", jnp.bool_)
+# days since 1970-01-01, mirroring spi/type/DateType.java
+DATE = Type("date", jnp.int32)
+# microseconds since epoch (timestamp(6)), mirroring spi/type/TimestampType.java short form
+TIMESTAMP = Type("timestamp(6)", jnp.int64)
+VARCHAR = VarcharType.of(None)
+UNKNOWN = Type("unknown", jnp.int8, comparable=False, orderable=False)
+
+_NUMERIC_LADDER = ["tinyint", "smallint", "integer", "bigint", "real", "double"]
+
+
+def common_super_type(a: Type, b: Type) -> Type:
+    """Least common super type for implicit coercion.
+
+    Mirrors io.trino.type.TypeCoercion#getCommonSuperType (core/trino-main
+    .../type/TypeCoercion.java) for the supported subset.
+    """
+    if a.name == b.name:
+        return a
+    if a.is_decimal and b.is_decimal:
+        scale = max(a.scale, b.scale)
+        intd = max(a.precision - a.scale, b.precision - b.scale)
+        return DecimalType.of(min(intd + scale, 18), scale)
+    if a.is_decimal and b.is_integer:
+        return common_super_type(a, DecimalType.of(18, 0))
+    if b.is_decimal and a.is_integer:
+        return common_super_type(DecimalType.of(18, 0), b)
+    if a.is_decimal and b.is_floating:
+        return DOUBLE
+    if b.is_decimal and a.is_floating:
+        return DOUBLE
+    if a.name in _NUMERIC_LADDER and b.name in _NUMERIC_LADDER:
+        idx = max(_NUMERIC_LADDER.index(a.name), _NUMERIC_LADDER.index(b.name))
+        return [TINYINT, SMALLINT, INTEGER, BIGINT, REAL, DOUBLE][idx]
+    if a.is_string and b.is_string:
+        return VARCHAR
+    if a.name == "unknown":
+        return b
+    if b.name == "unknown":
+        return a
+    raise TypeError(f"no common super type for {a} and {b}")
+
+
+_EPOCH = np.datetime64("1970-01-01", "D")
+
+
+def parse_date_literal(text: str) -> int:
+    """'1995-03-15' -> days since epoch (int)."""
+    return int((np.datetime64(text, "D") - _EPOCH).astype(np.int64))
+
+
+def date_to_string(days: int) -> str:
+    return str(_EPOCH + np.timedelta64(int(days), "D"))
