@@ -1,0 +1,220 @@
+"""TPC-H queries from SQL text, validated against pandas oracles over the same generated
+data (SURVEY.md §4: H2QueryRunner cross-check pattern)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+def run(engine, sql):
+    return engine.execute_sql(sql, engine.create_session("tpch")).to_pandas()
+
+
+def assert_frames_close(got: pd.DataFrame, exp: pd.DataFrame, atol=1e-6, rtol=1e-9):
+    assert len(got) == len(exp), f"row count {len(got)} != {len(exp)}"
+    assert len(got.columns) == len(exp.columns)
+    for gcol, ecol in zip(got.columns, exp.columns):
+        g, e = got[gcol].to_numpy(), exp[ecol].to_numpy()
+        if g.dtype == object or e.dtype == object:
+            assert list(g) == list(e), f"column {gcol}"
+        else:
+            np.testing.assert_allclose(g.astype(np.float64), e.astype(np.float64),
+                                       atol=atol, rtol=rtol, err_msg=f"column {gcol}")
+
+
+D = np.datetime64
+
+
+def dcol(df, col):
+    return df[col].to_numpy().astype("datetime64[D]")
+
+
+def test_q1(engine, tpch_pandas):
+    got = run(engine, """
+        select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+               sum(l_extendedprice) as sum_base_price,
+               sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+               sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+               avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
+               avg(l_discount) as avg_disc, count(*) as count_order
+        from lineitem
+        where l_shipdate <= date '1998-12-01' - interval '90' day
+        group by l_returnflag, l_linestatus
+        order by l_returnflag, l_linestatus""")
+    li = tpch_pandas["lineitem"]
+    df = li[dcol(li, "l_shipdate") <= D("1998-12-01") - np.timedelta64(90, "D")].copy()
+    df["dp"] = df.l_extendedprice * (1 - df.l_discount)
+    df["ch"] = df.dp * (1 + df.l_tax)
+    exp = df.groupby(["l_returnflag", "l_linestatus"], as_index=False).agg(
+        sum_qty=("l_quantity", "sum"), sum_base=("l_extendedprice", "sum"),
+        sum_dp=("dp", "sum"), sum_ch=("ch", "sum"), avg_qty=("l_quantity", "mean"),
+        avg_price=("l_extendedprice", "mean"), avg_disc=("l_discount", "mean"),
+        cnt=("dp", "size")).sort_values(["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+    assert_frames_close(got, exp, atol=0.01)
+
+
+def test_q6(engine, tpch_pandas):
+    got = run(engine, """
+        select sum(l_extendedprice * l_discount) as revenue
+        from lineitem
+        where l_shipdate >= date '1994-01-01'
+          and l_shipdate < date '1994-01-01' + interval '1' year
+          and l_discount between 0.05 and 0.07
+          and l_quantity < 24""")
+    li = tpch_pandas["lineitem"]
+    m = ((dcol(li, "l_shipdate") >= D("1994-01-01"))
+         & (dcol(li, "l_shipdate") < D("1995-01-01"))
+         & (li.l_discount >= 0.05) & (li.l_discount <= 0.07) & (li.l_quantity < 24))
+    exp = (li[m].l_extendedprice * li[m].l_discount).sum()
+    np.testing.assert_allclose(got["revenue"][0], exp, rtol=1e-9)
+
+
+def test_q3(engine, tpch_pandas):
+    got = run(engine, """
+        select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+               o_orderdate, o_shippriority
+        from customer, orders, lineitem
+        where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+          and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+          and l_shipdate > date '1995-03-15'
+        group by l_orderkey, o_orderdate, o_shippriority
+        order by revenue desc, o_orderdate
+        limit 10""")
+    c, o, li = tpch_pandas["customer"], tpch_pandas["orders"], tpch_pandas["lineitem"]
+    c2 = c[c.c_mktsegment == "BUILDING"]
+    o2 = o[dcol(o, "o_orderdate") < D("1995-03-15")]
+    l2 = li[dcol(li, "l_shipdate") > D("1995-03-15")].copy()
+    j = l2.merge(o2, left_on="l_orderkey", right_on="o_orderkey").merge(
+        c2, left_on="o_custkey", right_on="c_custkey")
+    j["rev"] = j.l_extendedprice * (1 - j.l_discount)
+    exp = (j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"], as_index=False)
+           .agg(revenue=("rev", "sum"))
+           .sort_values(["revenue", "o_orderdate"], ascending=[False, True])
+           .head(10).reset_index(drop=True))
+    exp = exp[["l_orderkey", "revenue", "o_orderdate", "o_shippriority"]]
+    got2 = got.drop(columns=["o_orderdate"])
+    exp2 = exp.drop(columns=["o_orderdate"])
+    assert_frames_close(got2, exp2, rtol=1e-9)
+    # dates come back as day-numbers; compare against epoch days
+    exp_days = (exp["o_orderdate"].to_numpy().astype("datetime64[D]")
+                - D("1970-01-01")).astype(np.int64)
+    np.testing.assert_array_equal(got["o_orderdate"].to_numpy().astype(np.int64), exp_days)
+
+
+def test_q5(engine, tpch_pandas):
+    got = run(engine, """
+        select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue
+        from customer, orders, lineitem, supplier, nation, region
+        where c_custkey = o_custkey and l_orderkey = o_orderkey
+          and l_suppkey = s_suppkey and c_nationkey = s_nationkey
+          and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+          and r_name = 'ASIA' and o_orderdate >= date '1994-01-01'
+          and o_orderdate < date '1994-01-01' + interval '1' year
+        group by n_name order by revenue desc""")
+    t = tpch_pandas
+    o2 = t["orders"][(dcol(t["orders"], "o_orderdate") >= D("1994-01-01"))
+                     & (dcol(t["orders"], "o_orderdate") < D("1995-01-01"))]
+    r2 = t["region"][t["region"].r_name == "ASIA"]
+    j = (t["lineitem"].merge(o2, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(t["customer"], left_on="o_custkey", right_on="c_custkey")
+         .merge(t["supplier"], left_on="l_suppkey", right_on="s_suppkey"))
+    j = j[j.c_nationkey == j.s_nationkey]
+    j = j.merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey")
+    j = j.merge(r2, left_on="n_regionkey", right_on="r_regionkey")
+    j["rev"] = j.l_extendedprice * (1 - j.l_discount)
+    exp = (j.groupby("n_name", as_index=False).agg(revenue=("rev", "sum"))
+           .sort_values("revenue", ascending=False).reset_index(drop=True))
+    assert_frames_close(got, exp, rtol=1e-9)
+
+
+def test_q10(engine, tpch_pandas):
+    got = run(engine, """
+        select c_custkey, c_name, sum(l_extendedprice * (1 - l_discount)) as revenue,
+               c_acctbal, n_name
+        from customer, orders, lineitem, nation
+        where c_custkey = o_custkey and l_orderkey = o_orderkey
+          and o_orderdate >= date '1993-10-01'
+          and o_orderdate < date '1993-10-01' + interval '3' month
+          and l_returnflag = 'R' and c_nationkey = n_nationkey
+        group by c_custkey, c_name, c_acctbal, n_name
+        order by revenue desc
+        limit 20""")
+    t = tpch_pandas
+    o2 = t["orders"][(dcol(t["orders"], "o_orderdate") >= D("1993-10-01"))
+                     & (dcol(t["orders"], "o_orderdate") < D("1994-01-01"))]
+    l2 = t["lineitem"][t["lineitem"].l_returnflag == "R"]
+    j = (l2.merge(o2, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(t["customer"], left_on="o_custkey", right_on="c_custkey")
+         .merge(t["nation"], left_on="c_nationkey", right_on="n_nationkey"))
+    j["rev"] = j.l_extendedprice * (1 - j.l_discount)
+    exp = (j.groupby(["c_custkey", "c_name", "c_acctbal", "n_name"], as_index=False)
+           .agg(revenue=("rev", "sum"))
+           .sort_values("revenue", ascending=False).head(20).reset_index(drop=True))
+    exp = exp[["c_custkey", "c_name", "revenue", "c_acctbal", "n_name"]]
+    assert_frames_close(got, exp, rtol=1e-9)
+
+
+def test_q12(engine, tpch_pandas):
+    got = run(engine, """
+        select l_shipmode,
+               sum(case when o_orderpriority = '1-URGENT' or o_orderpriority = '2-HIGH'
+                        then 1 else 0 end) as high_line_count,
+               sum(case when o_orderpriority <> '1-URGENT' and o_orderpriority <> '2-HIGH'
+                        then 1 else 0 end) as low_line_count
+        from orders, lineitem
+        where o_orderkey = l_orderkey and l_shipmode in ('MAIL', 'SHIP')
+          and l_commitdate < l_receiptdate and l_shipdate < l_commitdate
+          and l_receiptdate >= date '1994-01-01'
+          and l_receiptdate < date '1994-01-01' + interval '1' year
+        group by l_shipmode order by l_shipmode""")
+    t = tpch_pandas
+    li = t["lineitem"]
+    m = (li.l_shipmode.isin(["MAIL", "SHIP"])
+         & (dcol(li, "l_commitdate") < dcol(li, "l_receiptdate"))
+         & (dcol(li, "l_shipdate") < dcol(li, "l_commitdate"))
+         & (dcol(li, "l_receiptdate") >= D("1994-01-01"))
+         & (dcol(li, "l_receiptdate") < D("1995-01-01")))
+    j = li[m].merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+    j["high"] = j.o_orderpriority.isin(["1-URGENT", "2-HIGH"]).astype(int)
+    j["low"] = 1 - j.high
+    exp = (j.groupby("l_shipmode", as_index=False).agg(
+        high_line_count=("high", "sum"), low_line_count=("low", "sum"))
+        .sort_values("l_shipmode").reset_index(drop=True))
+    assert_frames_close(got, exp)
+
+
+def test_q14(engine, tpch_pandas):
+    got = run(engine, """
+        select 100.00 * sum(case when p_type like 'PROMO%'
+                                 then l_extendedprice * (1 - l_discount) else 0 end)
+               / sum(l_extendedprice * (1 - l_discount)) as promo_revenue
+        from lineitem, part
+        where l_partkey = p_partkey and l_shipdate >= date '1995-09-01'
+          and l_shipdate < date '1995-09-01' + interval '1' month""")
+    t = tpch_pandas
+    li = t["lineitem"]
+    m = (dcol(li, "l_shipdate") >= D("1995-09-01")) & (dcol(li, "l_shipdate") < D("1995-10-01"))
+    j = li[m].merge(t["part"], left_on="l_partkey", right_on="p_partkey")
+    rev = j.l_extendedprice * (1 - j.l_discount)
+    promo = rev.where(j.p_type.str.startswith("PROMO"), 0.0)
+    exp = 100.0 * promo.sum() / rev.sum()
+    np.testing.assert_allclose(got["promo_revenue"][0], exp, rtol=1e-6)
+
+
+def test_simple_select_limit(engine, tpch_pandas):
+    got = run(engine, "select n_name, n_regionkey from nation order by n_name limit 5")
+    exp = tpch_pandas["nation"].sort_values("n_name").head(5).reset_index(drop=True)
+    assert list(got["n_name"]) == list(exp["n_name"])
+    np.testing.assert_array_equal(got["n_regionkey"].to_numpy(), exp["n_regionkey"].to_numpy())
+
+
+def test_explicit_join(engine, tpch_pandas):
+    got = run(engine, """
+        select n_name, count(*) as cnt
+        from supplier join nation on s_nationkey = n_nationkey
+        group by n_name order by cnt desc, n_name limit 5""")
+    t = tpch_pandas
+    j = t["supplier"].merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey")
+    exp = (j.groupby("n_name", as_index=False).size().rename(columns={"size": "cnt"})
+           .sort_values(["cnt", "n_name"], ascending=[False, True]).head(5).reset_index(drop=True))
+    assert_frames_close(got, exp)

@@ -387,6 +387,40 @@ class TpchConnector:
     def dictionaries(self, table: str) -> dict[str, Dictionary]:
         return DICTIONARIES[table]
 
+    def primary_key(self, table: str) -> tuple:
+        return {
+            "lineitem": ("l_orderkey", "l_linenumber"),
+            "orders": ("o_orderkey",),
+            "customer": ("c_custkey",),
+            "part": ("p_partkey",),
+            "supplier": ("s_suppkey",),
+            "partsupp": ("ps_partkey", "ps_suppkey"),
+            "nation": ("n_nationkey",),
+            "region": ("r_regionkey",),
+        }[table]
+
+    def column_range(self, table: str, column: str):
+        """(min, max) value bounds for stats-aware key packing (reference analog:
+        connector stats via spi/statistics; tpch stats in TpchMetadata)."""
+        key_max = {
+            "l_orderkey": int(BASE_ROWS["orders"] * self.sf),
+            "o_orderkey": int(BASE_ROWS["orders"] * self.sf),
+            "o_custkey": int(BASE_ROWS["customer"] * self.sf),
+            "c_custkey": int(BASE_ROWS["customer"] * self.sf),
+            "l_partkey": int(BASE_ROWS["part"] * self.sf),
+            "p_partkey": int(BASE_ROWS["part"] * self.sf),
+            "ps_partkey": int(BASE_ROWS["part"] * self.sf),
+            "l_suppkey": int(BASE_ROWS["supplier"] * self.sf),
+            "s_suppkey": int(BASE_ROWS["supplier"] * self.sf),
+            "ps_suppkey": int(BASE_ROWS["supplier"] * self.sf),
+            "c_nationkey": 24, "s_nationkey": 24, "n_nationkey": 24,
+            "n_regionkey": 4, "r_regionkey": 4,
+            "l_linenumber": LINES_PER_ORDER_MAX,
+        }
+        if column in key_max:
+            return (0, key_max[column])
+        return (None, None)
+
     def row_count(self, table: str) -> int:
         if table == "lineitem":  # expected ~4/order; exact count is data-dependent
             return int(BASE_ROWS["orders"] * self.sf) * 4

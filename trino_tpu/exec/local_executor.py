@@ -87,7 +87,7 @@ class LocalExecutor:
             return Page(node.schema, child.columns, child.null_masks, child.valid), dicts
         if isinstance(node, P.Sort):
             child, dicts = self._execute_to_page(node.child)
-            return _sort_page(child, node.keys), dicts
+            return _sort_page(child, node.keys, dicts), dicts
         if isinstance(node, P.Limit):
             child, dicts = self._execute_to_page(node.child)
             return _limit_page(child, node.count), dicts
@@ -263,6 +263,9 @@ class LocalExecutor:
             cols, nulls, valid = up.transform(cols, nulls, valid)
             keys = tuple(cols[i] for i in node.left_keys)
             row_ids, matched = probe(table, keys, build_key_types, valid)
+            for i in node.left_keys:  # NULL keys never match (SQL equi-join semantics)
+                if nulls[i] is not None:
+                    matched = matched & ~nulls[i]
             if node.kind == "inner":
                 valid = valid & matched
             elif node.kind == "semi":
@@ -292,11 +295,24 @@ class LocalExecutor:
     def _build_join_table(self, build_page: Page, key_channels, key_types):
         n = build_page.capacity
         capacity = max(1 << max(n - 1, 1).bit_length(), 16) * 2
-        table = build_table_init(capacity, build_page)
         keys = tuple(build_page.columns[i] for i in key_channels)
-        return jax.jit(build_insert, static_argnums=(2,))(
-            table, keys, key_types, build_page.valid_mask()
-        )
+        # join keys never match NULL: drop null-keyed build rows
+        valid = build_page.valid_mask()
+        for ch in key_channels:
+            nm = build_page.null_masks[ch]
+            if nm is not None:
+                valid = valid & ~nm
+        while True:
+            table = build_table_init(capacity, build_page)
+            table = jax.jit(build_insert, static_argnums=(2,))(table, keys, key_types, valid)
+            if not bool(table.overflow):
+                break
+            capacity *= 4
+        if int(table.dup_count) > 0:
+            raise NotImplementedError(
+                "duplicate join keys on build side not supported yet "
+                "(planner should have chosen the unique-key side; see RelPlan.unique_sets)")
+        return table
 
 
 # -- helpers ------------------------------------------------------------------------------
@@ -391,22 +407,27 @@ def _values_page(node: P.Values) -> Page:
     return Page(node.schema, tuple(cols), tuple(None for _ in cols), None)
 
 
-def _sort_page(page: Page, keys) -> Page:
-    """Host-side lexicographic sort (result sets; large distributed sort is separate)."""
+def _sort_page(page: Page, keys, dicts=None) -> Page:
+    """Host-side lexicographic sort (result sets; large distributed sort is separate).
+
+    Dictionary-encoded string channels sort by *decoded string order*, not id order
+    (ids are assigned in dictionary, not collation, order)."""
     valid = np.asarray(page.valid_mask())
     cols = [np.asarray(c)[valid] for c in page.columns]
     nulls = [None if n is None else np.asarray(n)[valid] for n in page.null_masks]
+    sort_cols = list(cols)
+    for k in keys:
+        d = dicts[k.channel] if dicts is not None else None
+        if d is not None and page.schema.fields[k.channel].type.is_string:
+            sort_cols[k.channel] = d.decode(cols[k.channel]).astype(str)
     order = np.arange(len(cols[0]) if cols else 0)
     for k in reversed(keys):
-        c = cols[k.channel][order]
-        kind = "stable"
-        idx = np.argsort(c, kind=kind)
+        c = sort_cols[k.channel][order]
+        if not np.issubdtype(c.dtype, np.number):
+            _, c = np.unique(c, return_inverse=True)  # string -> collation rank
         if not k.ascending:
-            idx = idx[::-1]
-            # keep stability under descending: argsort of negated where possible
-            if np.issubdtype(c.dtype, np.number):
-                idx = np.argsort(-c.astype(np.float64), kind=kind)
-        order = order[idx]
+            c = -c.astype(np.int64 if np.issubdtype(c.dtype, np.integer) else np.float64)
+        order = order[np.argsort(c, kind="stable")]
     new_cols = tuple(jnp.asarray(c[order]) for c in cols)
     new_nulls = tuple(None if n is None else jnp.asarray(n[order]) for n in nulls)
     return Page(page.schema, new_cols, new_nulls, None)

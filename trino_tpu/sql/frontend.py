@@ -1,0 +1,873 @@
+"""Analyzer + logical planner: AST -> channel-based plan tree.
+
+Compresses the reference's pipeline — StatementAnalyzer (sql/analyzer/StatementAnalyzer.java:449)
+/ ExpressionAnalyzer (type resolution + coercions), QueryPlanner/RelationPlanner
+(sql/planner/QueryPlanner.java), PredicatePushDown (optimizations/PredicatePushDown.java:113)
+and the CBO's join ordering/build-side choice (iterative/rule/ReorderJoins.java:98,
+DetermineJoinDistributionType.java:51) — into one pass sized for the supported subset:
+
+- FROM relations (incl. comma joins) are flattened; WHERE equi-conjuncts become hash-join
+  conditions; single-relation conjuncts push down to their scan; the join tree is built
+  greedily: largest relation (connector row-count stat) is the probe spine, connected
+  relations join build-side smallest-first;
+- string literals are resolved to dictionary ids at plan time (eq/IN via Dictionary.lookup,
+  LIKE via an id->bool lookup table — the planner-side replacement for the reference's
+  LikeMatcher NFA, likematcher/LikeMatcher.java:26);
+- decimal arithmetic follows the reference's short-decimal rules (spi/type/DecimalType;
+  deviation: decimal division yields DOUBLE, long decimals are capped at p=18 for now);
+- GROUP BY plans to Project(keys+agg args) -> Aggregate, with HAVING/ORDER BY resolved
+  against group keys and aggregate calls by AST equality;
+- uncorrelated IN (SELECT ...) plans to a semi join; NOT IN to anti join.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+import numpy as np
+
+from ..page import Field, Schema
+from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, UNKNOWN, DecimalType, Type,
+                     VarcharType, common_super_type, parse_date_literal)
+from . import ir
+from . import parser as A
+from . import plan as P
+
+__all__ = ["compile_sql", "SemanticError"]
+
+
+class SemanticError(ValueError):
+    pass
+
+
+AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
+
+
+@dataclasses.dataclass
+class ColumnInfo:
+    alias: Optional[str]  # relation alias
+    name: str  # column name
+    type: Type
+    dict: object = None  # Dictionary | None
+
+
+@dataclasses.dataclass
+class RelPlan:
+    node: P.PlanNode
+    cols: list  # ColumnInfo per channel
+    unique_sets: list = dataclasses.field(default_factory=list)
+    # unique_sets: frozensets of channel indices known unique (PKs, group-by keys); used to
+    # keep hash-join build sides duplicate-free (reference analog: stats-based CBO choosing
+    # build side, DetermineJoinDistributionType.java:51)
+
+
+def compile_sql(sql: str, engine, session) -> P.PlanNode:
+    ast = A.parse(sql)
+    return Planner(engine, session).plan_query(ast)
+
+
+class Planner:
+    def __init__(self, engine, session):
+        self.engine = engine
+        self.session = session
+
+    # ---------------------------------------------------------------- query planning
+    def plan_query(self, q: A.Select) -> P.PlanNode:
+        rel, out_names, out_exprs_ast = self._plan_select(q)
+        node = rel.node
+        # ORDER BY: resolve against output channels (alias / ordinal / select-expr match)
+        if q.order_by:
+            keys = []
+            for s in q.order_by:
+                ch = self._resolve_output_channel(s.expr, out_names, out_exprs_ast)
+                keys.append(P.SortKey(ch, s.ascending, bool(s.nulls_first)))
+            node = P.Sort(node, tuple(keys))
+        if q.limit is not None:
+            node = P.Limit(node, q.limit)
+        return P.Output(node, tuple(out_names))
+
+    def _plan_select(self, q: A.Select):
+        rel = self._plan_from(q)
+        # expand stars
+        items = []
+        for it in q.items:
+            if isinstance(it.expr, A.Star):
+                for i, c in enumerate(rel.cols):
+                    items.append(A.SelectItem(A.Identifier(
+                        (c.alias, c.name) if c.alias else (c.name,)), None))
+            else:
+                items.append(it)
+
+        has_group = bool(q.group_by)
+        agg_calls = []
+        for it in items:
+            _collect_aggs(it.expr, agg_calls)
+        if q.having is not None:
+            _collect_aggs(q.having, agg_calls)
+        for s in q.order_by:
+            _collect_aggs(s.expr, agg_calls)
+
+        if has_group or agg_calls:
+            rel, out_names, out_exprs_ast = self._plan_aggregation(q, rel, items, agg_calls)
+        else:
+            exprs, dicts, names = [], [], []
+            for i, it in enumerate(items):
+                e, d = self.translate(it.expr, rel.cols)
+                exprs.append(e)
+                dicts.append(d)
+                names.append(it.alias or _derive_name(it.expr, i))
+            schema = Schema(tuple(Field(n, e.type) for n, e in zip(names, exprs)))
+            node = P.Project(rel.node, tuple(exprs), schema)
+            rel = RelPlan(node, [ColumnInfo(None, n, e.type, d)
+                                 for n, e, d in zip(names, exprs, dicts)])
+            out_names = names
+            out_exprs_ast = [it.expr for it in items]
+        if q.distinct:
+            n = len(rel.cols)
+            schema = Schema(tuple(Field(c.name, c.type) for c in rel.cols))
+            rel = RelPlan(P.Aggregate(rel.node, tuple(range(n)), (), schema), rel.cols,
+                          [frozenset(range(n))])
+        return rel, out_names, out_exprs_ast
+
+    # ---------------------------------------------------------------- FROM / joins
+    def _plan_from(self, q: A.Select) -> RelPlan:
+        if q.from_ is None:
+            schema = Schema.of(("dummy", BIGINT))
+            return RelPlan(P.Values(((0,),), schema), [ColumnInfo(None, "dummy", BIGINT)])
+        relations: list[tuple] = []  # (RelPlan, rows_estimate)
+        explicit_joins: list = []
+        self._flatten_from(q.from_, relations, explicit_joins)
+        conjuncts = _split_conjuncts(q.where)
+
+        if explicit_joins:
+            # explicit JOIN ... ON syntax: left-deep in written order
+            rel = self._plan_explicit(q.from_)
+            remaining = []
+            for c in conjuncts:
+                ch = self._try_translate(c, rel.cols)
+                if ch is None:
+                    raise SemanticError(f"cannot resolve predicate {c}")
+                remaining.append(ch)
+            node = rel.node
+            for pred in remaining:
+                node = P.Filter(node, pred)
+            return RelPlan(node, rel.cols)
+
+        # comma-join planning with pushdown + greedy ordering
+        rels = [r for r, _ in relations]
+        sizes = [s for _, s in relations]
+        # push single-relation conjuncts onto their relation
+        residual = []
+        for c in conjuncts:
+            placed = False
+            for i, r in enumerate(rels):
+                e = self._try_translate(c, r.cols)
+                if e is not None:
+                    rels[i] = RelPlan(P.Filter(r.node, e), r.cols, r.unique_sets)
+                    placed = True
+                    break
+            if not placed:
+                residual.append(c)
+        if len(rels) == 1:
+            node = rels[0].node
+            for c in residual:
+                e, _ = self.translate(c, rels[0].cols)
+                node = P.Filter(node, e)
+            return RelPlan(node, rels[0].cols)
+
+        # greedy join: start from largest relation as probe spine
+        order = sorted(range(len(rels)), key=lambda i: -sizes[i])
+        current = rels[order[0]]
+        joined = {order[0]}
+        pending = [i for i in order[1:]]
+        while pending:
+            # connected candidates, preferring unique-key (PK) build sides, then smallest
+            candidates = []
+            for i in pending:
+                cand = rels[i]
+                eqs, rest = _find_equi_conjuncts(self, residual, current, cand)
+                if not eqs:
+                    continue
+                build_chs = frozenset(
+                    e.index for _, e in eqs if isinstance(e, ir.FieldRef))
+                unique = any(u <= build_chs for u in cand.unique_sets)
+                candidates.append((not unique, sizes[i], i, eqs, rest))
+            if not candidates:
+                raise SemanticError("cross join between unconnected relations not supported yet")
+            _, _, i, eqs, rest = min(candidates, key=lambda c: (c[0], c[1]))
+            current = self._make_join("inner", current, rels[i], eqs)
+            residual = rest
+            joined.add(i)
+            pending.remove(i)
+        node = current.node
+        still = []
+        for c in residual:
+            e = self._try_translate(c, current.cols)
+            if e is None:
+                still.append(c)
+            else:
+                node = P.Filter(node, e)
+        if still:
+            raise SemanticError(f"unresolvable predicates: {still}")
+        return RelPlan(node, current.cols)
+
+    def _flatten_from(self, node, relations, explicit_joins):
+        if isinstance(node, A.JoinRef):
+            if node.kind == "cross" and node.on is None:
+                self._flatten_from(node.left, relations, explicit_joins)
+                self._flatten_from(node.right, relations, explicit_joins)
+            else:
+                explicit_joins.append(node)
+        else:
+            rel = self._plan_relation(node)
+            relations.append((rel, self._estimate_rows(node)))
+
+    def _plan_explicit(self, node) -> RelPlan:
+        if not isinstance(node, A.JoinRef):
+            return self._plan_relation(node)
+        left = self._plan_explicit(node.left)
+        right = self._plan_explicit(node.right)
+        conjuncts = _split_conjuncts(node.on)
+        eqs, residual = [], []
+        for c in conjuncts:
+            pair = self._match_equi(c, left, right)
+            if pair is not None:
+                eqs.append(pair)
+            else:
+                residual.append(c)
+        if not eqs:
+            raise SemanticError("non-equi explicit join not supported yet")
+        rel = self._make_join(node.kind, left, right, eqs)
+        out = rel.node
+        for c in residual:
+            e, _ = self.translate(c, rel.cols)
+            out = P.Filter(out, e)
+        return RelPlan(out, rel.cols)
+
+    def _plan_relation(self, node) -> RelPlan:
+        if isinstance(node, A.TableRef):
+            catalog = self.session.catalog or "tpch"
+            name = node.name[-1]
+            conn = self.engine.catalogs.get(node.name[0], None)
+            if conn is not None and len(node.name) > 1:
+                catalog = node.name[0]
+            conn = self.engine.catalogs[catalog]
+            schema = conn.schema(name)
+            dicts = conn.dictionaries(name)
+            alias = node.alias or name
+            scan = P.TableScan(catalog, name, schema.names, schema)
+            cols = [ColumnInfo(alias, f.name, f.type, dicts.get(f.name))
+                    for f in schema.fields]
+            unique_sets = []
+            if hasattr(conn, "primary_key"):
+                try:
+                    pk = conn.primary_key(name)
+                    unique_sets.append(frozenset(schema.index(c) for c in pk))
+                except KeyError:
+                    pass
+            return RelPlan(scan, cols, unique_sets)
+        if isinstance(node, A.SubqueryRef):
+            rel, out_names, _ = self._plan_select(node.query)
+            sub = node.query
+            plan_node = rel.node
+            if sub.order_by:
+                keys = []
+                for s in sub.order_by:
+                    ch = self._resolve_output_channel(s.expr, out_names, [None] * len(out_names))
+                    keys.append(P.SortKey(ch, s.ascending, bool(s.nulls_first)))
+                plan_node = P.Sort(plan_node, tuple(keys))
+            if sub.limit is not None:
+                plan_node = P.Limit(plan_node, sub.limit)
+            alias = node.alias
+            cols = [ColumnInfo(alias, n, c.type, c.dict)
+                    for n, c in zip(out_names, rel.cols)]
+            return RelPlan(plan_node, cols)
+        raise SemanticError(f"unsupported relation {node}")
+
+    def _estimate_rows(self, node) -> int:
+        if isinstance(node, A.TableRef):
+            catalog = self.session.catalog or "tpch"
+            conn = self.engine.catalogs.get(node.name[0] if len(node.name) > 1 else catalog,
+                                            self.engine.catalogs.get(catalog))
+            try:
+                return conn.row_count(node.name[-1])
+            except Exception:
+                return 1 << 20
+        return 1 << 20
+
+    def _match_equi(self, conjunct, left: RelPlan, right: RelPlan):
+        """a.x = b.y with sides in different relations -> (left_expr, right_expr)."""
+        if not (isinstance(conjunct, A.BinaryOp) and conjunct.op == "eq"):
+            return None
+        l_in_left = self._try_translate(conjunct.left, left.cols)
+        r_in_right = self._try_translate(conjunct.right, right.cols)
+        if l_in_left is not None and r_in_right is not None:
+            return (l_in_left, r_in_right)
+        l_in_right = self._try_translate(conjunct.left, right.cols)
+        r_in_left = self._try_translate(conjunct.right, left.cols)
+        if l_in_right is not None and r_in_left is not None:
+            return (r_in_left, l_in_right)
+        return None
+
+    def _make_join(self, kind, probe: RelPlan, build: RelPlan, eqs) -> RelPlan:
+        probe_node, build_node = probe.node, build.node
+        pkeys, bkeys = [], []
+        for pe, be in eqs:
+            t = common_super_type(pe.type, be.type)
+            pe = _coerce(pe, t)
+            be = _coerce(be, t)
+            pch, probe_node = _ensure_channel(probe_node, pe, probe.cols)
+            bch, build_node = _ensure_channel(build_node, be, build.cols)
+            pkeys.append(pch)
+            bkeys.append(bch)
+        schema = Schema(tuple(
+            [Field(f"l{i}", c.type) for i, c in enumerate(probe.cols)]
+            + [Field(f"r{i}", c.type) for i, c in enumerate(build.cols)]
+        ))
+        node = P.Join(kind, probe_node, build_node, tuple(pkeys), tuple(bkeys), schema)
+        cols = list(probe.cols) + list(build.cols)
+        # a many-to-one join preserves probe-row multiplicity -> probe unique sets survive
+        return RelPlan(node, cols, list(probe.unique_sets))
+
+    # ---------------------------------------------------------------- aggregation
+    def _plan_aggregation(self, q, rel: RelPlan, items, agg_calls):
+        group_asts = []
+        for g in q.group_by:
+            if isinstance(g, A.NumberLit):
+                group_asts.append(items[int(g.text) - 1].expr)
+            elif isinstance(g, A.Identifier) and len(g.parts) == 1 and \
+                    self._try_translate(g, rel.cols) is None:
+                # alias reference
+                match = [it.expr for it in items if it.alias == g.parts[0]]
+                if not match:
+                    raise SemanticError(f"cannot resolve group key {g}")
+                group_asts.append(match[0])
+            else:
+                group_asts.append(g)
+
+        key_exprs, key_dicts = [], []
+        for g in group_asts:
+            e, d = self.translate(g, rel.cols)
+            key_exprs.append(e)
+            key_dicts.append(d)
+
+        # dedup aggregate calls structurally
+        uniq_aggs = []
+        for a in agg_calls:
+            if a not in uniq_aggs:
+                uniq_aggs.append(a)
+
+        proj_exprs = list(key_exprs)
+        specs = []
+        for j, a in enumerate(uniq_aggs):
+            kind, arg_ast = _agg_kind(a)
+            if arg_ast is None:
+                specs.append(P.AggSpec("count_star", None, f"agg{j}", BIGINT))
+            else:
+                e, _ = self.translate(arg_ast, rel.cols)
+                ch = len(proj_exprs)
+                proj_exprs.append(e)
+                specs.append(P.AggSpec(kind, ir.FieldRef(ch, e.type), f"agg{j}",
+                                       _agg_type(kind, e.type)))
+        proj_schema = Schema(tuple(Field(f"c{i}", e.type) for i, e in enumerate(proj_exprs)))
+        proj = P.Project(rel.node, tuple(proj_exprs), proj_schema)
+        agg_schema = Schema(tuple(
+            [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
+            + [Field(s.name, s.type) for s in specs]
+        ))
+        agg = P.Aggregate(proj, tuple(range(len(key_exprs))), tuple(specs), agg_schema)
+        agg_cols = ([ColumnInfo(None, f"k{i}", e.type, d)
+                     for i, (e, d) in enumerate(zip(key_exprs, key_dicts))]
+                    + [ColumnInfo(None, s.name, s.type, None) for s in specs])
+        agg_unique = [frozenset(range(len(key_exprs)))] if key_exprs else []
+
+        post = _PostAggScope(group_asts, uniq_aggs, agg_cols, self)
+        node = agg
+        if q.having is not None:
+            node = P.Filter(node, post.translate(q.having))
+        out_exprs, out_names = [], []
+        for i, it in enumerate(items):
+            out_exprs.append(post.translate(it.expr))
+            out_names.append(it.alias or _derive_name(it.expr, i))
+        out_schema = Schema(tuple(Field(n, e.type) for n, e in zip(out_names, out_exprs)))
+        node = P.Project(node, tuple(out_exprs), out_schema)
+        cols = []
+        for n, e in zip(out_names, out_exprs):
+            d = None
+            if isinstance(e, ir.FieldRef):
+                d = agg_cols[e.index].dict
+            cols.append(ColumnInfo(None, n, e.type, d))
+        # remap unique key channels through the output projection
+        out_unique = []
+        for u in agg_unique:
+            mapped = [i for i, e in enumerate(out_exprs)
+                      if isinstance(e, ir.FieldRef) and e.index in u]
+            if len({out_exprs[i].index for i in mapped}) == len(u):
+                out_unique.append(frozenset(mapped))
+        return RelPlan(node, cols, out_unique), out_names, [it.expr for it in items]
+
+    # ---------------------------------------------------------------- expression translation
+    def _try_translate(self, ast, cols):
+        try:
+            e, _ = self.translate(ast, cols)
+            return e
+        except SemanticError:
+            return None
+
+    def translate(self, ast, cols) -> tuple:
+        """AST expr -> (ir.Expr, Dictionary|None)."""
+        t = self._translate(ast, cols)
+        return t
+
+    def _translate(self, ast, cols):
+        if isinstance(ast, A.NumberLit):
+            return _literal_number(ast.text), None
+        if isinstance(ast, A.StringLit):
+            raise SemanticError(f"string literal {ast.value!r} outside comparison context")
+        if isinstance(ast, A.DateLit):
+            return ir.Constant(parse_date_literal(ast.value), DATE), None
+        if isinstance(ast, A.NullLit):
+            return ir.Constant(None, UNKNOWN), None
+        if isinstance(ast, A.BoolLit):
+            return ir.Constant(ast.value, BOOLEAN), None
+        if isinstance(ast, A.Identifier):
+            ch = _resolve_column(ast, cols)
+            c = cols[ch]
+            return ir.FieldRef(ch, c.type, c.name), c.dict
+        if isinstance(ast, A.UnaryOp):
+            if ast.op == "not":
+                e, _ = self._translate(ast.operand, cols)
+                return ir.Call("not", (e,), BOOLEAN), None
+            e, _ = self._translate(ast.operand, cols)
+            return ir.Call("negate", (e,), e.type), None
+        if isinstance(ast, A.BinaryOp):
+            return self._translate_binary(ast, cols)
+        if isinstance(ast, A.Between):
+            v, vd = self._translate(ast.value, cols)
+            lo = self._translate_vs(ast.low, v, vd, cols)
+            hi = self._translate_vs(ast.high, v, vd, cols)
+            t = common_super_type(common_super_type(v.type, lo.type), hi.type)
+            e = ir.Call("between", (_coerce(v, t), _coerce(lo, t), _coerce(hi, t)), BOOLEAN)
+            if ast.negated:
+                e = ir.Call("not", (e,), BOOLEAN)
+            return e, None
+        if isinstance(ast, A.InList):
+            v, vd = self._translate(ast.value, cols)
+            lits = [self._translate_vs(item, v, vd, cols) for item in ast.items]
+            t = v.type
+            for l in lits:
+                t = common_super_type(t, l.type)
+            e = ir.Call("in", tuple([_coerce(v, t)] + [_coerce(l, t) for l in lits]), BOOLEAN)
+            if ast.negated:
+                e = ir.Call("not", (e,), BOOLEAN)
+            return e, None
+        if isinstance(ast, A.Like):
+            return self._translate_like(ast, cols)
+        if isinstance(ast, A.IsNull):
+            v, _ = self._translate(ast.value, cols)
+            e = ir.Call("is_null", (v,), BOOLEAN)
+            if ast.negated:
+                e = ir.Call("not", (e,), BOOLEAN)
+            return e, None
+        if isinstance(ast, A.CaseExpr):
+            return self._translate_case(ast, cols)
+        if isinstance(ast, A.Cast):
+            v, d = self._translate(ast.value, cols)
+            t = _type_from_name(ast.type_name, ast.params)
+            return _coerce(v, t), (d if t.is_string else None)
+        if isinstance(ast, A.Extract):
+            v, _ = self._translate(ast.value, cols)
+            if ast.field not in ("year", "month", "day"):
+                raise SemanticError(f"extract({ast.field}) not supported")
+            return ir.Call(f"extract_{ast.field}", (v,), BIGINT), None
+        if isinstance(ast, A.FuncCall):
+            return self._translate_func(ast, cols)
+        raise SemanticError(f"unsupported expression {ast}")
+
+    def _translate_vs(self, ast, other: ir.Expr, other_dict, cols) -> ir.Expr:
+        """Translate ``ast`` in the context of comparison against ``other`` (resolves string
+        literals to dictionary ids)."""
+        if isinstance(ast, A.StringLit):
+            if other.type.is_string and other_dict is not None:
+                return ir.Constant(other_dict.lookup(ast.value), other.type)
+            if other.type.name == "date":
+                return ir.Constant(parse_date_literal(ast.value), DATE)
+            raise SemanticError(f"cannot compare string literal to {other.type}")
+        e, _ = self._translate(ast, cols)
+        return e
+
+    def _translate_binary(self, ast: A.BinaryOp, cols):
+        op = ast.op
+        if op in ("and", "or"):
+            l, _ = self._translate(ast.left, cols)
+            r, _ = self._translate(ast.right, cols)
+            return ir.Call(op, (l, r), BOOLEAN), None
+        if op in ("eq", "neq", "lt", "lte", "gt", "gte"):
+            # string-literal side gets dictionary resolution
+            if isinstance(ast.right, A.StringLit) and not isinstance(ast.left, A.StringLit):
+                l, ld = self._translate(ast.left, cols)
+                r = self._translate_vs(ast.right, l, ld, cols)
+            elif isinstance(ast.left, A.StringLit) and not isinstance(ast.right, A.StringLit):
+                r, rd = self._translate(ast.right, cols)
+                l = self._translate_vs(ast.left, r, rd, cols)
+            else:
+                l, _ = self._translate(ast.left, cols)
+                r, _ = self._translate(ast.right, cols)
+            t = common_super_type(l.type, r.type)
+            if t.is_string and op not in ("eq", "neq"):
+                raise SemanticError("ordering comparison on strings not supported yet")
+            return ir.Call(op, (_coerce(l, t), _coerce(r, t)), BOOLEAN), None
+        # arithmetic, incl. date +/- interval constant folding
+        l_const_date = isinstance(ast.left, A.DateLit)
+        r_interval = isinstance(ast.right, A.IntervalLit)
+        if r_interval:
+            l, _ = self._translate(ast.left, cols)
+            days = _interval_days(ast.right)
+            if days is not None:
+                delta = days if op == "add" else -days
+                if isinstance(l, ir.Constant):
+                    return ir.Constant(l.value + delta, DATE), None
+                return ir.Call("add", (l, ir.Constant(delta, INTEGER)), DATE), None
+            months = _interval_months(ast.right)
+            if isinstance(l, ir.Constant):
+                return ir.Constant(_add_months_const(l.value, months if op == "add" else -months), DATE), None
+            raise SemanticError("runtime date +/- month interval not supported yet")
+        l, _ = self._translate(ast.left, cols)
+        r, _ = self._translate(ast.right, cols)
+        return _arith(op, l, r), None
+
+    def _translate_like(self, ast: A.Like, cols):
+        v, d = self._translate(ast.value, cols)
+        if not isinstance(ast.pattern, A.StringLit):
+            raise SemanticError("only literal LIKE patterns supported")
+        if d is None:
+            raise SemanticError("LIKE on non-dictionary expression not supported")
+        pat = ast.pattern.value
+        rx = re.compile("^" + "".join(
+            ".*" if ch == "%" else "." if ch == "_" else re.escape(ch) for ch in pat) + "$")
+        lut = d.match(lambda s: bool(rx.match(s)))
+        e = ir.Call("lut", (v, ir.Constant(lut, BOOLEAN)), BOOLEAN)
+        if ast.negated:
+            e = ir.Call("not", (e,), BOOLEAN)
+        return e, None
+
+    def _translate_case(self, ast: A.CaseExpr, cols):
+        whens = []
+        for cond, val in ast.whens:
+            if ast.operand is not None:
+                cond = A.BinaryOp("eq", ast.operand, cond)
+            c, _ = self._translate(cond, cols)
+            v, _ = self._translate(val, cols)
+            whens.append((c, v))
+        default = None
+        if ast.default is not None:
+            default, _ = self._translate(ast.default, cols)
+        t = whens[0][1].type
+        for _, v in whens[1:]:
+            t = common_super_type(t, v.type)
+        if default is not None:
+            t = common_super_type(t, default.type)
+        out = _coerce(default, t) if default is not None else ir.Constant(None, t)
+        for c, v in reversed(whens):
+            out = ir.Call("if", (c, _coerce(v, t), out), t)
+        return out, None
+
+    def _translate_func(self, ast: A.FuncCall, cols):
+        name = ast.name
+        if name in AGG_FUNCS:
+            raise SemanticError(f"aggregate {name} in scalar context")
+        if name in ("abs", "sqrt", "floor", "ceil", "ceiling", "exp", "ln", "round"):
+            args = [self._translate(a, cols)[0] for a in ast.args]
+            op = "ceil" if name == "ceiling" else name
+            t = args[0].type if name in ("abs", "round") else DOUBLE
+            if name in ("floor", "ceil", "ceiling"):
+                t = args[0].type if args[0].type.is_integer else BIGINT
+                if isinstance(args[0].type, DecimalType) or args[0].type.is_floating:
+                    return ir.Call(op, (_coerce(args[0], DOUBLE),), DOUBLE), None
+            return ir.Call(op, tuple(args), t), None
+        if name in ("greatest", "least"):
+            args = [self._translate(a, cols)[0] for a in ast.args]
+            t = args[0].type
+            for a in args[1:]:
+                t = common_super_type(t, a.type)
+            return ir.Call(name, tuple(_coerce(a, t) for a in args), t), None
+        if name == "coalesce":
+            args = [self._translate(a, cols)[0] for a in ast.args]
+            t = args[0].type
+            for a in args[1:]:
+                t = common_super_type(t, a.type)
+            return ir.Call("coalesce", tuple(_coerce(a, t) for a in args), t), None
+        raise SemanticError(f"function {name} not supported")
+
+    # ---------------------------------------------------------------- output resolution
+    def _resolve_output_channel(self, expr, out_names, out_exprs_ast) -> int:
+        if isinstance(expr, A.NumberLit):
+            return int(expr.text) - 1
+        if isinstance(expr, A.Identifier) and len(expr.parts) == 1:
+            if expr.parts[0] in out_names:
+                return out_names.index(expr.parts[0])
+        for i, e in enumerate(out_exprs_ast):
+            if e == expr:
+                return i
+        # single-part identifier that matches an output column name suffix
+        if isinstance(expr, A.Identifier):
+            for i, e in enumerate(out_exprs_ast):
+                if isinstance(e, A.Identifier) and e.parts[-1] == expr.parts[-1]:
+                    return i
+        raise SemanticError(f"ORDER BY expression not in output: {expr}")
+
+
+# ---------------------------------------------------------------------- helpers
+
+
+class _PostAggScope:
+    """Rewrites post-aggregation expressions over (group keys + agg calls) channels."""
+
+    def __init__(self, group_asts, agg_asts, agg_cols, planner):
+        self.group_asts = group_asts
+        self.agg_asts = agg_asts
+        self.agg_cols = agg_cols
+        self.planner = planner
+
+    def translate(self, ast) -> ir.Expr:
+        for i, g in enumerate(self.group_asts):
+            if ast == g:
+                c = self.agg_cols[i]
+                return ir.FieldRef(i, c.type, c.name)
+        for j, a in enumerate(self.agg_asts):
+            if ast == a:
+                ch = len(self.group_asts) + j
+                c = self.agg_cols[ch]
+                return ir.FieldRef(ch, c.type, c.name)
+        # recurse structurally
+        if isinstance(ast, A.BinaryOp):
+            l = self.translate(ast.left)
+            r = self.translate(ast.right)
+            if ast.op in ("and", "or"):
+                return ir.Call(ast.op, (l, r), BOOLEAN)
+            if ast.op in ("eq", "neq", "lt", "lte", "gt", "gte"):
+                t = common_super_type(l.type, r.type)
+                return ir.Call(ast.op, (_coerce(l, t), _coerce(r, t)), BOOLEAN)
+            return _arith(ast.op, l, r)
+        if isinstance(ast, A.NumberLit):
+            return _literal_number(ast.text)
+        if isinstance(ast, A.UnaryOp) and ast.op == "negate":
+            e = self.translate(ast.operand)
+            return ir.Call("negate", (e,), e.type)
+        if isinstance(ast, A.Cast):
+            return _coerce(self.translate(ast.value), _type_from_name(ast.type_name, ast.params))
+        raise SemanticError(f"expression must appear in GROUP BY: {ast}")
+
+
+def _collect_aggs(ast, out: list):
+    if isinstance(ast, A.FuncCall) and ast.name in AGG_FUNCS:
+        out.append(ast)
+        return
+    for f in dataclasses.fields(ast) if dataclasses.is_dataclass(ast) else ():
+        v = getattr(ast, f.name)
+        if isinstance(v, A.Node):
+            _collect_aggs(v, out)
+        elif isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, A.Node):
+                    _collect_aggs(x, out)
+                elif isinstance(x, tuple):
+                    for y in x:
+                        if isinstance(y, A.Node):
+                            _collect_aggs(y, out)
+
+
+def _agg_kind(ast: A.FuncCall):
+    name = ast.name
+    if name == "count":
+        if not ast.args or isinstance(ast.args[0], A.Star):
+            return "count_star", None
+        return "count", ast.args[0]
+    return name, ast.args[0]
+
+
+def _agg_type(kind: str, in_type: Type) -> Type:
+    if kind in ("count", "count_star"):
+        return BIGINT
+    if kind == "sum":
+        if isinstance(in_type, DecimalType):
+            return DecimalType.of(18, in_type.scale)
+        return DOUBLE if in_type.is_floating else BIGINT
+    if kind == "avg":
+        if isinstance(in_type, DecimalType):
+            return in_type
+        return DOUBLE
+    return in_type  # min/max
+
+
+def _split_conjuncts(where) -> list:
+    if where is None:
+        return []
+    if isinstance(where, A.BinaryOp) and where.op == "and":
+        return _split_conjuncts(where.left) + _split_conjuncts(where.right)
+    return [where]
+
+
+def _find_equi_conjuncts(planner: Planner, conjuncts, left: RelPlan, right: RelPlan):
+    eqs, rest = [], []
+    for c in conjuncts:
+        pair = planner._match_equi(c, left, right)
+        if pair is not None:
+            eqs.append(pair)
+        else:
+            rest.append(c)
+    return eqs, rest
+
+
+def _ensure_channel(node: P.PlanNode, expr: ir.Expr, cols):
+    """Join keys must be plain channels; wrap in a Project if the key is computed."""
+    if isinstance(expr, ir.FieldRef):
+        return expr.index, node
+    schema = node.schema
+    exprs = tuple(ir.FieldRef(i, f.type, f.name) for i, f in enumerate(schema.fields)) + (expr,)
+    new_schema = Schema(tuple(schema.fields) + (Field(f"jk{len(schema.fields)}", expr.type),))
+    return len(schema.fields), P.Project(node, exprs, new_schema)
+
+
+def _resolve_column(ident: A.Identifier, cols) -> int:
+    parts = ident.parts
+    if len(parts) >= 2:
+        alias, name = parts[-2], parts[-1]
+        for i, c in enumerate(cols):
+            if c.alias == alias and c.name == name:
+                return i
+        raise SemanticError(f"column {'.'.join(parts)} not found")
+    name = parts[0]
+    hits = [i for i, c in enumerate(cols) if c.name == name]
+    if len(hits) == 1:
+        return hits[0]
+    if not hits:
+        raise SemanticError(f"column {name} not found")
+    raise SemanticError(f"column {name} is ambiguous")
+
+
+def _literal_number(text: str) -> ir.Constant:
+    if "e" in text.lower():
+        return ir.Constant(float(text), DOUBLE)
+    if "." in text:
+        frac = text.split(".")[1]
+        scale = len(frac)
+        digits = text.replace(".", "").lstrip("0") or "0"
+        return ir.Constant(int(text.replace(".", "")), DecimalType.of(max(len(digits), scale + 1), scale))
+    v = int(text)
+    return ir.Constant(v, INTEGER if -(2**31) <= v < 2**31 else BIGINT)
+
+
+def _coerce(e: ir.Expr, t: Type) -> ir.Expr:
+    if e.type.name == t.name:
+        return e
+    if isinstance(e, ir.Constant) and e.value is None:
+        return ir.Constant(None, t)
+    if isinstance(t, DecimalType) and isinstance(e.type, DecimalType):
+        if isinstance(e, ir.Constant):
+            diff = t.scale - e.type.scale
+            v = e.value * (10**diff) if diff >= 0 else round(e.value / 10**-diff)
+            return ir.Constant(v, t)
+        return ir.Call("cast", (e,), t)
+    if isinstance(e, ir.Constant) and not isinstance(e.value, np.ndarray):
+        # fold constant casts
+        if isinstance(t, DecimalType):
+            if e.type.is_integer:
+                return ir.Constant(int(e.value) * 10**t.scale, t)
+            if e.type.is_floating:
+                return ir.Constant(round(e.value * 10**t.scale), t)
+        if t.is_floating:
+            if isinstance(e.type, DecimalType):
+                return ir.Constant(e.value / 10**e.type.scale, t)
+            return ir.Constant(float(e.value), t)
+        if t.is_integer:
+            return ir.Constant(int(e.value), t)
+    return ir.Call("cast", (e,), t)
+
+
+def _arith(op: str, l: ir.Expr, r: ir.Expr) -> ir.Expr:
+    lt, rt = l.type, r.type
+    if lt.name == "date" or rt.name == "date":
+        if op in ("add", "subtract") and (lt.name == "date") != (rt.name == "date"):
+            return ir.Call(op, (l, r), DATE)
+        if op == "subtract" and lt.name == rt.name == "date":
+            return ir.Call(op, (l, r), BIGINT)
+        raise SemanticError(f"invalid date arithmetic {op}")
+    if isinstance(lt, DecimalType) and rt.is_integer:
+        r = _coerce(r, DecimalType.of(18, 0))
+        rt = r.type
+    if isinstance(rt, DecimalType) and lt.is_integer:
+        l = _coerce(l, DecimalType.of(18, 0))
+        lt = l.type
+    if isinstance(lt, DecimalType) and isinstance(rt, DecimalType):
+        if op in ("add", "subtract"):
+            s = max(lt.scale, rt.scale)
+            t = DecimalType.of(min(max(lt.precision - lt.scale, rt.precision - rt.scale) + s + 1, 18), s)
+            return ir.Call(op, (_coerce(l, DecimalType.of(18, s)), _coerce(r, DecimalType.of(18, s))), t)
+        if op == "multiply":
+            s = lt.scale + rt.scale
+            if s > 12:
+                return ir.Call("multiply", (_coerce(l, DOUBLE), _coerce(r, DOUBLE)), DOUBLE)
+            return ir.Call(op, (l, r), DecimalType.of(min(lt.precision + rt.precision, 18), s))
+        if op == "divide":
+            # deviation: decimal division computes in double (documented in module docstring)
+            return ir.Call("divide", (_coerce(l, DOUBLE), _coerce(r, DOUBLE)), DOUBLE)
+        if op == "modulus":
+            s = max(lt.scale, rt.scale)
+            return ir.Call(op, (_coerce(l, DecimalType.of(18, s)), _coerce(r, DecimalType.of(18, s))),
+                           DecimalType.of(18, s))
+    t = common_super_type(lt, rt)
+    if op == "divide" and t.is_integer:
+        return ir.Call(op, (_coerce(l, t), _coerce(r, t)), t)
+    return ir.Call(op, (_coerce(l, t), _coerce(r, t)), t)
+
+
+def _type_from_name(name: str, params) -> Type:
+    from ..types import BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, SMALLINT, TINYINT
+
+    m = {"bigint": BIGINT, "integer": INTEGER, "int": INTEGER, "smallint": SMALLINT,
+         "tinyint": TINYINT, "double": DOUBLE, "real": REAL, "boolean": BOOLEAN, "date": DATE}
+    if name in m:
+        return m[name]
+    if name == "decimal":
+        p = params[0] if params else 18
+        s = params[1] if len(params) > 1 else 0
+        return DecimalType.of(min(p, 18), s)
+    if name in ("varchar", "char"):
+        return VarcharType.of(params[0] if params else None)
+    raise SemanticError(f"unknown type {name}")
+
+
+def _derive_name(ast, i: int) -> str:
+    if isinstance(ast, A.Identifier):
+        return ast.parts[-1]
+    return f"_col{i}"
+
+
+def _interval_days(iv: A.IntervalLit):
+    unit = iv.unit
+    n = int(iv.value) * (-1 if iv.negative else 1)
+    if unit == "day":
+        return n
+    if unit == "week":
+        return n * 7
+    return None
+
+
+def _interval_months(iv: A.IntervalLit) -> int:
+    n = int(iv.value) * (-1 if iv.negative else 1)
+    if iv.unit == "month":
+        return n
+    if iv.unit == "year":
+        return n * 12
+    raise SemanticError(f"interval unit {iv.unit}")
+
+
+def _add_months_const(days: int, months: int) -> int:
+    d = np.datetime64("1970-01-01", "D") + np.timedelta64(int(days), "D")
+    month = np.datetime64(d, "M")
+    dom = (d - np.datetime64(month, "D")).astype(int)
+    out = np.datetime64(month + np.timedelta64(months, "M"), "D") + np.timedelta64(int(dom), "D")
+    return int((out - np.datetime64("1970-01-01", "D")).astype(np.int64))

@@ -1,0 +1,635 @@
+"""SQL lexer + recursive-descent parser.
+
+Reference: ANTLR grammar core/trino-grammar/.../SqlBase.g4 (1,554 lines) + AstBuilder
+(core/trino-parser/.../parser/AstBuilder.java, 317 AST classes).  This is a hand-written
+recursive-descent/Pratt parser over the query subset (SELECT with joins, grouping, subqueries,
+set-less DML comes later); AST nodes are frozen dataclasses so structural equality works for
+GROUP BY / ORDER BY matching (the reference relies on ExpressionTreeRewriter equality too).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+__all__ = ["parse", "ParseError"]
+
+
+class ParseError(ValueError):
+    pass
+
+
+# ----------------------------------------------------------------------------- AST nodes
+@dataclasses.dataclass(frozen=True)
+class Node:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Identifier(Node):
+    parts: tuple  # qualified name parts, lowercased
+
+
+@dataclasses.dataclass(frozen=True)
+class NumberLit(Node):
+    text: str
+
+
+@dataclasses.dataclass(frozen=True)
+class StringLit(Node):
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DateLit(Node):
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class IntervalLit(Node):
+    value: str
+    unit: str
+    negative: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class NullLit(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class BoolLit(Node):
+    value: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class Star(Node):
+    qualifier: tuple = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryOp(Node):
+    op: str
+    left: Node
+    right: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class UnaryOp(Node):
+    op: str
+    operand: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class FuncCall(Node):
+    name: str
+    args: tuple
+    distinct: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class CaseExpr(Node):
+    operand: Optional[Node]
+    whens: tuple  # ((cond, value), ...)
+    default: Optional[Node]
+
+
+@dataclasses.dataclass(frozen=True)
+class Between(Node):
+    value: Node
+    low: Node
+    high: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InList(Node):
+    value: Node
+    items: tuple
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InSubquery(Node):
+    value: Node
+    query: "Select"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Exists(Node):
+    query: "Select"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarSubquery(Node):
+    query: "Select"
+
+
+@dataclasses.dataclass(frozen=True)
+class Like(Node):
+    value: Node
+    pattern: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNull(Node):
+    value: Node
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Cast(Node):
+    value: Node
+    type_name: str
+    params: tuple = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Extract(Node):
+    field: str
+    value: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class SelectItem(Node):
+    expr: Node
+    alias: Optional[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class TableRef(Node):
+    name: tuple
+    alias: Optional[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class SubqueryRef(Node):
+    query: "Select"
+    alias: Optional[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinRef(Node):
+    kind: str  # inner | left | right | full | cross
+    left: Node
+    right: Node
+    on: Optional[Node]
+
+
+@dataclasses.dataclass(frozen=True)
+class SortItem(Node):
+    expr: Node
+    ascending: bool = True
+    nulls_first: Optional[bool] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Select(Node):
+    items: tuple
+    from_: Optional[Node]
+    where: Optional[Node]
+    group_by: tuple
+    having: Optional[Node]
+    order_by: tuple
+    limit: Optional[int]
+    distinct: bool = False
+
+
+# ----------------------------------------------------------------------------- lexer
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|--[^\n]*)
+  | (?P<number>\d+(\.\d*)?([eE][+-]?\d+)?|\.\d+)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*|"(?:[^"]|"")*")
+  | (?P<op><=|>=|<>|!=|\|\||[-+*/%(),.;<>=])
+    """,
+    re.VERBOSE,
+)
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit", "as", "and",
+    "or", "not", "in", "exists", "between", "like", "is", "null", "case", "when", "then",
+    "else", "end", "cast", "extract", "join", "inner", "left", "right", "full", "outer",
+    "cross", "on", "distinct", "date", "interval", "asc", "desc", "nulls", "first",
+    "last", "true", "false", "all", "any", "union", "except", "intersect", "with", "substring", "for",
+}
+
+
+@dataclasses.dataclass
+class Token:
+    kind: str  # number | string | ident | keyword | op | eof
+    value: str
+    pos: int
+
+
+def tokenize(sql: str) -> list:
+    out, pos = [], 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            raise ParseError(f"unexpected character {sql[pos]!r} at {pos}")
+        pos = m.end()
+        if m.lastgroup == "ws":
+            continue
+        text = m.group()
+        if m.lastgroup == "ident":
+            if text.startswith('"'):
+                out.append(Token("ident", text[1:-1].replace('""', '"'), m.start()))
+            elif text.lower() in KEYWORDS:
+                out.append(Token("keyword", text.lower(), m.start()))
+            else:
+                out.append(Token("ident", text.lower(), m.start()))
+        elif m.lastgroup == "string":
+            out.append(Token("string", text[1:-1].replace("''", "'"), m.start()))
+        else:
+            out.append(Token(m.lastgroup, text, m.start()))
+    out.append(Token("eof", "", len(sql)))
+    return out
+
+
+# ----------------------------------------------------------------------------- parser
+class Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # token helpers
+    def peek(self, offset=0) -> Token:
+        return self.tokens[min(self.i + offset, len(self.tokens) - 1)]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        self.i += 1
+        return t
+
+    def accept(self, *values) -> Optional[Token]:
+        t = self.peek()
+        if t.kind in ("keyword", "op") and t.value in values:
+            return self.next()
+        return None
+
+    def expect(self, *values) -> Token:
+        t = self.accept(*values)
+        if t is None:
+            raise ParseError(f"expected {values} at pos {self.peek().pos}, got {self.peek().value!r}")
+        return t
+
+    def expect_kind(self, kind) -> Token:
+        t = self.peek()
+        if t.kind != kind:
+            raise ParseError(f"expected {kind} at pos {t.pos}, got {t.value!r}")
+        return self.next()
+
+    # entry
+    def parse_statement(self) -> Select:
+        q = self.parse_select()
+        self.accept(";")
+        if self.peek().kind != "eof":
+            raise ParseError(f"trailing input at pos {self.peek().pos}: {self.peek().value!r}")
+        return q
+
+    def parse_select(self) -> Select:
+        self.expect("select")
+        distinct = bool(self.accept("distinct"))
+        self.accept("all")
+        items = [self.parse_select_item()]
+        while self.accept(","):
+            items.append(self.parse_select_item())
+        from_ = None
+        if self.accept("from"):
+            from_ = self.parse_table_ref()
+            while self.accept(","):
+                right = self.parse_table_ref()
+                from_ = JoinRef("cross", from_, right, None)
+        where = self.parse_expr() if self.accept("where") else None
+        group_by = ()
+        if self.accept("group"):
+            self.expect("by")
+            group_by = [self.parse_expr()]
+            while self.accept(","):
+                group_by.append(self.parse_expr())
+            group_by = tuple(group_by)
+        having = self.parse_expr() if self.accept("having") else None
+        order_by = ()
+        if self.accept("order"):
+            self.expect("by")
+            order_by = [self.parse_sort_item()]
+            while self.accept(","):
+                order_by.append(self.parse_sort_item())
+            order_by = tuple(order_by)
+        limit = None
+        if self.accept("limit"):
+            limit = int(self.expect_kind("number").value)
+        return Select(tuple(items), from_, where, group_by, having, tuple(order_by), limit, distinct)
+
+    def parse_select_item(self) -> SelectItem:
+        if self.peek().value == "*" and self.peek().kind == "op":
+            self.next()
+            return SelectItem(Star(), None)
+        expr = self.parse_expr()
+        alias = None
+        if self.accept("as"):
+            alias = self.expect_kind("ident").value
+        elif self.peek().kind == "ident":
+            alias = self.next().value
+        return SelectItem(expr, alias)
+
+    def parse_table_ref(self) -> Node:
+        left = self.parse_table_primary()
+        while True:
+            if self.accept("cross"):
+                self.expect("join")
+                right = self.parse_table_primary()
+                left = JoinRef("cross", left, right, None)
+                continue
+            kind = None
+            if self.accept("inner"):
+                kind = "inner"
+            elif self.accept("left"):
+                self.accept("outer")
+                kind = "left"
+            elif self.accept("right"):
+                self.accept("outer")
+                kind = "right"
+            elif self.accept("full"):
+                self.accept("outer")
+                kind = "full"
+            elif self.peek().value == "join":
+                kind = "inner"
+            if kind is None:
+                return left
+            self.expect("join")
+            right = self.parse_table_primary()
+            self.expect("on")
+            on = self.parse_expr()
+            left = JoinRef(kind, left, right, on)
+
+    def parse_table_primary(self) -> Node:
+        if self.accept("("):
+            if self.peek().value == "select":
+                q = self.parse_select()
+                self.expect(")")
+                alias = self._table_alias()
+                return SubqueryRef(q, alias)
+            ref = self.parse_table_ref()
+            self.expect(")")
+            return ref
+        name = [self.expect_kind("ident").value]
+        while self.accept("."):
+            name.append(self.expect_kind("ident").value)
+        return TableRef(tuple(name), self._table_alias())
+
+    def _table_alias(self) -> Optional[str]:
+        if self.accept("as"):
+            return self.expect_kind("ident").value
+        if self.peek().kind == "ident":
+            return self.next().value
+        return None
+
+    def parse_sort_item(self) -> SortItem:
+        expr = self.parse_expr()
+        asc = True
+        if self.accept("asc"):
+            asc = True
+        elif self.accept("desc"):
+            asc = False
+        nulls_first = None
+        if self.accept("nulls"):
+            nulls_first = bool(self.accept("first"))
+            if nulls_first is False:
+                self.expect("last")
+        return SortItem(expr, asc, nulls_first)
+
+    # expressions (precedence climbing)
+    def parse_expr(self) -> Node:
+        return self.parse_or()
+
+    def parse_or(self) -> Node:
+        left = self.parse_and()
+        while self.accept("or"):
+            left = BinaryOp("or", left, self.parse_and())
+        return left
+
+    def parse_and(self) -> Node:
+        left = self.parse_not()
+        while self.accept("and"):
+            left = BinaryOp("and", left, self.parse_not())
+        return left
+
+    def parse_not(self) -> Node:
+        if self.accept("not"):
+            return UnaryOp("not", self.parse_not())
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> Node:
+        left = self.parse_additive()
+        while True:
+            negated = False
+            if self.peek().value == "not" and self.peek().kind == "keyword":
+                nxt = self.peek(1).value
+                if nxt in ("between", "in", "like"):
+                    self.next()
+                    negated = True
+            if self.accept("between"):
+                low = self.parse_additive()
+                self.expect("and")
+                high = self.parse_additive()
+                left = Between(left, low, high, negated)
+                continue
+            if self.accept("in"):
+                self.expect("(")
+                if self.peek().value == "select":
+                    q = self.parse_select()
+                    self.expect(")")
+                    left = InSubquery(left, q, negated)
+                else:
+                    items = [self.parse_expr()]
+                    while self.accept(","):
+                        items.append(self.parse_expr())
+                    self.expect(")")
+                    left = InList(left, tuple(items), negated)
+                continue
+            if self.accept("like"):
+                left = Like(left, self.parse_additive(), negated)
+                continue
+            if self.accept("is"):
+                neg = bool(self.accept("not"))
+                self.expect("null")
+                left = IsNull(left, neg)
+                continue
+            op = self.accept("=", "<>", "!=", "<", "<=", ">", ">=")
+            if op:
+                opname = {"=": "eq", "<>": "neq", "!=": "neq", "<": "lt", "<=": "lte",
+                          ">": "gt", ">=": "gte"}[op.value]
+                right = self.parse_additive()
+                left = BinaryOp(opname, left, right)
+                continue
+            return left
+
+    def parse_additive(self) -> Node:
+        left = self.parse_multiplicative()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("+", "-"):
+                self.next()
+                left = BinaryOp("add" if t.value == "+" else "subtract",
+                                left, self.parse_multiplicative())
+            elif t.kind == "op" and t.value == "||":
+                self.next()
+                left = FuncCall("concat", (left, self.parse_multiplicative()))
+            else:
+                return left
+
+    def parse_multiplicative(self) -> Node:
+        left = self.parse_unary()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("*", "/", "%"):
+                self.next()
+                op = {"*": "multiply", "/": "divide", "%": "modulus"}[t.value]
+                left = BinaryOp(op, left, self.parse_unary())
+            else:
+                return left
+
+    def parse_unary(self) -> Node:
+        if self.accept("-"):
+            return UnaryOp("negate", self.parse_unary())
+        if self.accept("+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self) -> Node:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            return NumberLit(t.value)
+        if t.kind == "string":
+            self.next()
+            return StringLit(t.value)
+        if t.kind == "keyword":
+            if t.value == "null":
+                self.next()
+                return NullLit()
+            if t.value in ("true", "false"):
+                self.next()
+                return BoolLit(t.value == "true")
+            if t.value == "date":
+                self.next()
+                return DateLit(self.expect_kind("string").value)
+            if t.value == "interval":
+                self.next()
+                neg = bool(self.accept("-"))
+                val = self.expect_kind("string").value
+                unit = self.next().value.lower().rstrip("s")
+                return IntervalLit(val, unit, neg)
+            if t.value == "case":
+                return self.parse_case()
+            if t.value == "cast":
+                self.next()
+                self.expect("(")
+                v = self.parse_expr()
+                self.expect("as")
+                tname, params = self.parse_type_name()
+                self.expect(")")
+                return Cast(v, tname, params)
+            if t.value == "extract":
+                self.next()
+                self.expect("(")
+                field = self.next().value.lower()
+                self.expect("from")
+                v = self.parse_expr()
+                self.expect(")")
+                return Extract(field, v)
+            if t.value == "substring":
+                self.next()
+                self.expect("(")
+                v = self.parse_expr()
+                if not self.accept("from"):
+                    self.expect(",")
+                start = self.parse_expr()
+                length = None
+                if self.accept("for") or self.accept(","):
+                    length = self.parse_expr()
+                self.expect(")")
+                args = (v, start) + ((length,) if length is not None else ())
+                return FuncCall("substring", args)
+            if t.value == "exists":
+                self.next()
+                self.expect("(")
+                q = self.parse_select()
+                self.expect(")")
+                return Exists(q)
+            if t.value == "not" and self.peek(1).value == "exists":
+                self.next(), self.next()
+                self.expect("(")
+                q = self.parse_select()
+                self.expect(")")
+                return Exists(q, negated=True)
+        if t.kind == "op" and t.value == "(":
+            self.next()
+            if self.peek().value == "select":
+                q = self.parse_select()
+                self.expect(")")
+                return ScalarSubquery(q)
+            e = self.parse_expr()
+            self.expect(")")
+            return e
+        if t.kind == "ident":
+            # function call or (qualified) identifier
+            if self.peek(1).kind == "op" and self.peek(1).value == "(":
+                name = self.next().value
+                self.expect("(")
+                distinct = bool(self.accept("distinct"))
+                args: tuple = ()
+                if self.peek().value == "*" and self.peek().kind == "op":
+                    self.next()
+                    args = (Star(),)
+                elif not (self.peek().kind == "op" and self.peek().value == ")"):
+                    arg_list = [self.parse_expr()]
+                    while self.accept(","):
+                        arg_list.append(self.parse_expr())
+                    args = tuple(arg_list)
+                self.expect(")")
+                return FuncCall(name, args, distinct)
+            parts = [self.next().value]
+            while self.peek().kind == "op" and self.peek().value == "." and self.peek(1).kind == "ident":
+                self.next()
+                parts.append(self.next().value)
+            return Identifier(tuple(parts))
+        raise ParseError(f"unexpected token {t.value!r} at pos {t.pos}")
+
+    def parse_case(self) -> CaseExpr:
+        self.expect("case")
+        operand = None
+        if self.peek().value != "when":
+            operand = self.parse_expr()
+        whens = []
+        while self.accept("when"):
+            cond = self.parse_expr()
+            self.expect("then")
+            whens.append((cond, self.parse_expr()))
+        default = self.parse_expr() if self.accept("else") else None
+        self.expect("end")
+        return CaseExpr(operand, tuple(whens), default)
+
+    def parse_type_name(self):
+        t = self.next()
+        name = t.value.lower()
+        params = []
+        if self.accept("("):
+            params.append(int(self.expect_kind("number").value))
+            while self.accept(","):
+                params.append(int(self.expect_kind("number").value))
+            self.expect(")")
+        return name, tuple(params)
+
+
+def parse(sql: str) -> Select:
+    """Parse one SQL query statement (reference: SqlParser.createStatement,
+    core/trino-parser/.../parser/SqlParser.java:56)."""
+    return Parser(sql).parse_statement()
