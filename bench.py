@@ -1,0 +1,100 @@
+"""Benchmark: TPC-H Q1 at SF1 on the local accelerator vs a CPU columnar baseline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Protocol mirrors the reference's benchto macro setup (2 prewarm + timed runs, SURVEY.md §6:
+testing/trino-benchto-benchmarks/.../tpch.yaml): value = Q1 input rows/sec on one chip,
+vs_baseline = speedup over a numpy/pandas vectorized CPU evaluation of the same query on
+the same generated data.
+"""
+
+import json
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+SF = float(__import__("os").environ.get("BENCH_SF", "1"))
+Q1 = """
+    select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+           sum(l_extendedprice) as sum_base_price,
+           sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+           sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+           avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
+           avg(l_discount) as avg_disc, count(*) as count_order
+    from lineitem where l_shipdate <= date '1998-12-01' - interval '90' day
+    group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus"""
+
+
+def main():
+    from trino_tpu import Engine
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    conn = TpchConnector(sf=SF, split_rows=1 << 21)
+    engine = Engine()
+    engine.register_catalog("tpch", conn)
+    session = engine.create_session("tpch")
+
+    # input cardinality (generated lineitem rows)
+    n_rows = 0
+    for s in conn.splits("lineitem"):
+        page = conn.generate(s, ["l_orderkey"])
+        n_rows += int(np.asarray(page.num_rows()))
+
+    # engine timing: 2 prewarm + 3 timed (median)
+    for _ in range(2):
+        engine.execute_sql(Q1, session)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        engine.execute_sql(Q1, session)
+        times.append(time.perf_counter() - t0)
+    engine_t = sorted(times)[1]
+
+    # CPU baseline: vectorized numpy over the same columns (host-side)
+    cols = ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+            "l_discount", "l_tax", "l_shipdate"]
+    host = {c: [] for c in cols}
+    for s in conn.splits("lineitem"):
+        page = conn.generate(s, cols)
+        valid = np.asarray(page.valid_mask())
+        for c in cols:
+            host[c].append(np.asarray(page.column(c))[valid])
+    host = {c: np.concatenate(v) for c, v in host.items()}
+
+    def cpu_q1():
+        cutoff = (np.datetime64("1998-12-01") - np.timedelta64(90, "D")
+                  - np.datetime64("1970-01-01")).astype(np.int64)
+        m = host["l_shipdate"] <= cutoff
+        rf, ls = host["l_returnflag"][m], host["l_linestatus"][m]
+        qty, price = host["l_quantity"][m], host["l_extendedprice"][m]
+        disc, tax = host["l_discount"][m], host["l_tax"][m]
+        gid = rf * 2 + ls
+        dp = price * (100 - disc)
+        ch = dp * (100 + tax)
+        out = []
+        for g in np.unique(gid):
+            mm = gid == g
+            out.append((qty[mm].sum(), price[mm].sum(), dp[mm].sum(), ch[mm].sum(),
+                        mm.sum()))
+        return out
+
+    cpu_q1()  # warm caches
+    t0 = time.perf_counter()
+    cpu_q1()
+    cpu_t = time.perf_counter() - t0
+
+    value = n_rows / engine_t
+    print(json.dumps({
+        "metric": f"tpch_sf{SF:g}_q1_rows_per_sec_per_chip",
+        "value": round(value),
+        "unit": "rows/s",
+        "vs_baseline": round(cpu_t / engine_t, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Distributed (8-virtual-worker SPMD) execution vs local single-device results.
+
+Mirrors the reference's DistributedQueryRunner-vs-H2 pattern (SURVEY.md §4): the same query
+runs on the worker mesh and on one device; results must match exactly.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+
+from trino_tpu.parallel.mesh import worker_mesh
+
+
+QUERIES = {
+    "q1": """
+        select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+               sum(l_extendedprice) as sum_base_price,
+               sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+               sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+               avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
+               avg(l_discount) as avg_disc, count(*) as count_order
+        from lineitem where l_shipdate <= date '1998-12-01' - interval '90' day
+        group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus""",
+    "q3": """
+        select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+               o_orderdate, o_shippriority
+        from customer, orders, lineitem
+        where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+          and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+          and l_shipdate > date '1995-03-15'
+        group by l_orderkey, o_orderdate, o_shippriority
+        order by revenue desc, o_orderdate limit 10""",
+    "q5": """
+        select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue
+        from customer, orders, lineitem, supplier, nation, region
+        where c_custkey = o_custkey and l_orderkey = o_orderkey
+          and l_suppkey = s_suppkey and c_nationkey = s_nationkey
+          and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+          and r_name = 'ASIA' and o_orderdate >= date '1994-01-01'
+          and o_orderdate < date '1994-01-01' + interval '1' year
+        group by n_name order by revenue desc""",
+    "q6": """
+        select sum(l_extendedprice * l_discount) as revenue
+        from lineitem
+        where l_shipdate >= date '1994-01-01'
+          and l_shipdate < date '1994-01-01' + interval '1' year
+          and l_discount between 0.05 and 0.07 and l_quantity < 24""",
+    "scan_filter": """
+        select o_orderkey, o_totalprice from orders
+        where o_orderdate >= date '1998-01-01' and o_custkey < 50
+        order by o_orderkey limit 50""",
+}
+
+
+def _frames_equal(a: pd.DataFrame, b: pd.DataFrame):
+    assert len(a) == len(b)
+    for ca, cb in zip(a.columns, b.columns):
+        ga, gb = a[ca].to_numpy(), b[cb].to_numpy()
+        if ga.dtype == object or gb.dtype == object:
+            assert list(ga) == list(gb), ca
+        else:
+            np.testing.assert_allclose(ga.astype(np.float64), gb.astype(np.float64),
+                                       rtol=1e-12, err_msg=ca)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    return worker_mesh(8)
+
+
+@pytest.mark.parametrize("name", list(QUERIES))
+def test_distributed_matches_local(engine, mesh8, name):
+    sql = QUERIES[name]
+    session = engine.create_session("tpch")
+    local = engine.execute_sql(sql, session).to_pandas()
+    dist = engine.execute_sql(sql, session, distributed=True, mesh=mesh8).to_pandas()
+    _frames_equal(dist, local)
+
+
+def test_distributed_on_subset_mesh(engine):
+    """Mesh smaller than the device count (2 workers)."""
+    mesh = worker_mesh(2)
+    session = engine.create_session("tpch")
+    local = engine.execute_sql(QUERIES["q6"], session).to_pandas()
+    dist = engine.execute_sql(QUERIES["q6"], session, distributed=True, mesh=mesh).to_pandas()
+    _frames_equal(dist, local)

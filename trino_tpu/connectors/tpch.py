@@ -208,10 +208,12 @@ def _supplier_for(partkey, supplier_count, i):
 # -- generators ---------------------------------------------------------------------------
 
 
-def gen_orders(sf: float, lo: int, hi: int):
-    """Rows [lo, hi) of orders; returns dict of arrays (all rows valid)."""
-    i = jnp.arange(lo, hi, dtype=jnp.int64)
+def gen_orders(sf: float, lo, length: int, n: int = 0):
+    """``length`` rows of orders starting at row ``lo`` (``lo`` may be a traced scalar —
+    scans run inside shard_map with per-device offsets); rows >= n masked out."""
+    i = jnp.arange(length, dtype=jnp.int64) + lo
     okey = i + 1
+    valid = (i < n) if n else None
     ccount = int(BASE_ROWS["customer"] * sf)
     cols = {
         "o_orderkey": okey,
@@ -228,20 +230,22 @@ def gen_orders(sf: float, lo: int, hi: int):
     cols["o_orderstatus"] = jnp.where(
         od + 121 < CURRENTDATE, 0, jnp.where(od > CURRENTDATE, 1, 2)
     ).astype(jnp.int32)
-    return cols, None
+    return cols, valid
 
 
 def lines_per_order(okey):
     return 1 + (jnp.abs(_rand(20, okey)) % LINES_PER_ORDER_MAX)
 
 
-def gen_lineitem(sf: float, order_lo: int, order_hi: int):
-    """Line items of orders [order_lo, order_hi); capacity 7/order with a valid mask."""
-    n_orders = order_hi - order_lo
-    r = jnp.arange(n_orders * LINES_PER_ORDER_MAX, dtype=jnp.int64)
+def gen_lineitem(sf: float, order_lo, length: int, n: int = 0):
+    """Line items of ``length`` orders starting at order row ``order_lo``; capacity
+    7/order with a valid mask."""
+    r = jnp.arange(length * LINES_PER_ORDER_MAX, dtype=jnp.int64)
     okey = order_lo + r // LINES_PER_ORDER_MAX + 1
     lineno = (r % LINES_PER_ORDER_MAX).astype(jnp.int64)
     valid = lineno < lines_per_order(okey)
+    if n:
+        valid = valid & (okey <= n)
     uid = okey * 8 + lineno  # unique per line, stable across splits
     pcount = int(BASE_ROWS["part"] * sf)
     scount = int(BASE_ROWS["supplier"] * sf)
@@ -273,9 +277,10 @@ def gen_lineitem(sf: float, order_lo: int, order_hi: int):
     return cols, valid
 
 
-def gen_customer(sf, lo, hi):
-    i = jnp.arange(lo, hi, dtype=jnp.int64)
+def gen_customer(sf, lo, length: int, n: int = 0):
+    i = jnp.arange(length, dtype=jnp.int64) + lo
     key = i + 1
+    valid = (i < n) if n else None
     return {
         "c_custkey": key,
         "c_name": (key % (1 << 31)).astype(jnp.int32),
@@ -285,12 +290,13 @@ def gen_customer(sf, lo, hi):
         "c_acctbal": _uniform(42, key, -99_999, 999_999),
         "c_mktsegment": _uniform(43, key, 0, 4).astype(jnp.int32),
         "c_comment": (key % (1 << 31)).astype(jnp.int32),
-    }, None
+    }, valid
 
 
-def gen_part(sf, lo, hi):
-    i = jnp.arange(lo, hi, dtype=jnp.int64)
+def gen_part(sf, lo, length: int, n: int = 0):
+    i = jnp.arange(length, dtype=jnp.int64) + lo
     key = i + 1
+    valid = (i < n) if n else None
     return {
         "p_partkey": key,
         "p_name": (key % (1 << 31)).astype(jnp.int32),
@@ -301,12 +307,13 @@ def gen_part(sf, lo, hi):
         "p_container": _uniform(55, key, 0, 39).astype(jnp.int32),
         "p_retailprice": _retailprice_raw(key),
         "p_comment": (key % (1 << 31)).astype(jnp.int32),
-    }, None
+    }, valid
 
 
-def gen_supplier(sf, lo, hi):
-    i = jnp.arange(lo, hi, dtype=jnp.int64)
+def gen_supplier(sf, lo, length: int, n: int = 0):
+    i = jnp.arange(length, dtype=jnp.int64) + lo
     key = i + 1
+    valid = (i < n) if n else None
     return {
         "s_suppkey": key,
         "s_name": (key % (1 << 31)).astype(jnp.int32),
@@ -315,11 +322,12 @@ def gen_supplier(sf, lo, hi):
         "s_phone": (key % (1 << 31)).astype(jnp.int32),
         "s_acctbal": _uniform(62, key, -99_999, 999_999),
         "s_comment": (key % (1 << 31)).astype(jnp.int32),
-    }, None
+    }, valid
 
 
-def gen_partsupp(sf, lo, hi):
-    i = jnp.arange(lo, hi, dtype=jnp.int64)
+def gen_partsupp(sf, lo, length: int, n: int = 0):
+    i = jnp.arange(length, dtype=jnp.int64) + lo
+    valid = (i < n) if n else None
     partkey = i // 4 + 1
     scount = max(int(BASE_ROWS["supplier"] * sf), 1)
     return {
@@ -328,27 +336,28 @@ def gen_partsupp(sf, lo, hi):
         "ps_availqty": _uniform(71, i, 1, 9999).astype(jnp.int32),
         "ps_supplycost": _uniform(72, i, 100, 100_000),
         "ps_comment": (i % (1 << 31)).astype(jnp.int32),
-    }, None
+    }, valid
 
 
-def gen_nation(sf, lo, hi):
-    i = jnp.arange(lo, hi, dtype=jnp.int64)
-    rkeys = jnp.asarray(np.array([r for _, r in NATIONS], dtype=np.int64))[i]
+def gen_nation(sf, lo, length: int, n: int = 0):
+    i = jnp.arange(length, dtype=jnp.int64) + lo
+    valid = i < 25
+    rkeys = jnp.asarray(np.array([r for _, r in NATIONS], dtype=np.int64))[jnp.clip(i, 0, 24)]
     return {
         "n_nationkey": i,
         "n_name": i.astype(jnp.int32),
         "n_regionkey": rkeys,
         "n_comment": i.astype(jnp.int32),
-    }, None
+    }, valid
 
 
-def gen_region(sf, lo, hi):
-    i = jnp.arange(lo, hi, dtype=jnp.int64)
+def gen_region(sf, lo, length: int, n: int = 0):
+    i = jnp.arange(length, dtype=jnp.int64) + lo
     return {
         "r_regionkey": i,
         "r_name": i.astype(jnp.int32),
         "r_comment": i.astype(jnp.int32),
-    }, None
+    }, i < 5
 
 
 _GENERATORS = {
@@ -430,25 +439,48 @@ class TpchConnector:
 
     # splits -----------------------------------------------------------------
     def splits(self, table: str, n_hint: int = 0) -> list[TpchSplit]:
+        """Equal-size split ranges (one XLA shape class for the whole scan; trailing rows
+        masked via the generator's ``n`` bound)."""
         if table == "lineitem":
             n = int(BASE_ROWS["orders"] * self.sf)
             step = max(self.split_rows // LINES_PER_ORDER_MAX, 1)
         else:
             n = self.row_count(table)
             step = self.split_rows
-        return [TpchSplit(table, lo, min(lo + step, n)) for lo in range(0, n, step)]
+        step = min(step, n) or 1
+        nsplits = -(-n // step)
+        if n_hint:
+            nsplits = -(-nsplits // n_hint) * n_hint  # round up to a multiple (SPMD batches)
+        return [TpchSplit(table, lo, lo + step) for lo in (s * step for s in range(nsplits))]
 
     # page source ------------------------------------------------------------
+    def table_bound(self, table: str) -> int:
+        """Mask bound: orders-count for lineitem, row count otherwise."""
+        if table == "lineitem":
+            return int(BASE_ROWS["orders"] * self.sf)
+        return self.row_count(table)
+
     def generate(self, split: TpchSplit, columns=None) -> Page:
         """Jit-compiled page generation for one split (shape class = split size)."""
         schema = TPCH_SCHEMAS[split.table]
         names = columns if columns is not None else schema.names
         out_schema = Schema(tuple(schema.field(n) for n in names))
-        cols, valid = _jit_generate(split.table, self.sf, split.lo, split.hi, tuple(names))
+        cols, valid = _jit_generate(split.table, self.sf, split.lo, split.hi - split.lo,
+                                    self.table_bound(split.table), tuple(names))
         return Page(out_schema, cols, tuple(None for _ in cols), valid)
 
+    def generate_traced(self, table: str, lo, length: int, columns):
+        """Trace-time generation with traced ``lo`` and static ``length`` (for
+        in-shard_map sharded scans): returns (cols tuple, valid)."""
+        return _generate_cols(table, self.sf, lo, length, self.table_bound(table),
+                              tuple(columns))
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
-def _jit_generate(table: str, sf: float, lo: int, hi: int, names: tuple):
-    cols, valid = _GENERATORS[table](sf, lo, hi)
-    return tuple(cols[n] for n in names), valid
+
+def _generate_cols(table, sf, lo, length, n, names):
+    cols, valid = _GENERATORS[table](sf, lo, length, n)
+    return tuple(cols[c] for c in names), valid
+
+
+@partial(jax.jit, static_argnums=(0, 1, 3, 4, 5))
+def _jit_generate(table: str, sf: float, lo: int, length: int, n: int, names: tuple):
+    return _generate_cols(table, sf, lo, length, n, names)

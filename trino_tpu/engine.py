@@ -37,13 +37,18 @@ class Engine:
         return Session(catalog=catalog, schema=schema)
 
     # -- plan-level execution (SQL front-end sits on top, sql/frontend.py) --------------
-    def execute_plan(self, plan):
+    def execute_plan(self, plan, distributed: bool = False, mesh=None):
+        if distributed:
+            from .exec.distributed import DistributedExecutor
+
+            return DistributedExecutor(self.catalogs, mesh=mesh).execute(plan)
         from .exec.local_executor import LocalExecutor
 
         return LocalExecutor(self.catalogs).execute(plan)
 
-    def execute_sql(self, sql: str, session: Optional[Session] = None):
+    def execute_sql(self, sql: str, session: Optional[Session] = None,
+                    distributed: bool = False, mesh=None):
         from .sql.frontend import compile_sql
 
         plan = compile_sql(sql, self, session or Session())
-        return self.execute_plan(plan)
+        return self.execute_plan(plan, distributed=distributed, mesh=mesh)

@@ -1,0 +1,388 @@
+"""SPMD distributed executor over a worker mesh.
+
+The reference distributes a fragmented plan as stages of HTTP-connected tasks
+(scheduler/PipelinedQueryScheduler.java:163; exchange data plane SURVEY.md §3.4).  The TPU
+re-design runs one SPMD program over a 1-D worker mesh via shard_map:
+
+- **sharded scan** (≈ split/data parallelism, SourcePartitionedScheduler.java:55): each
+  worker generates/reads its own equal-shaped split, offset by its mesh position;
+- **streaming fragment** (scan+filter+project+broadcast-join probe) traces into ONE jitted
+  per-worker step — same fusion as the local executor;
+- **broadcast join** (FIXED_BROADCAST, DetermineJoinDistributionType.java:51): the build
+  table is built once and closed over — shard_map replicates it to every worker (the
+  all-gather the reference does by POSTing the build side to every task);
+- **partial aggregation** accumulates into per-worker group tables with NO exchange of raw
+  rows (reference: partial-aggregation stage inserted by AddExchanges.java:145);
+- **final aggregation**: group-table *entries* are hash-exchanged all-to-all so each worker
+  owns a disjoint key range, then merged (reference: FIXED_HASH exchange + final
+  aggregation; ops/exchange.py is the PagePartitioner/ExchangeOperator analog).
+
+Distributed-specific state (group tables) lives as [n_workers, ...] arrays sharded on the
+leading axis, so the whole multi-batch loop stays jit-compiled with no host round-trips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as PS
+from jax import shard_map
+
+from ..ops import hashagg
+from ..ops.exchange import bucketize, exchange_all_to_all, partition_ids
+from ..ops.hashing import EMPTY_KEY, pack_keys
+from ..page import Field, Page, Schema
+from ..parallel.mesh import WORKER_AXIS, worker_mesh
+from ..sql import plan as P
+from ..sql.ir import evaluate, evaluate_predicate
+from .local_executor import (DEFAULT_GROUP_CAPACITY, MAX_GROUP_CAPACITY, LocalExecutor,
+                             MaterializedResult, _accumulators_for, _finalize_aggs,
+                             _gather_build, _limit_page, _materialize, _sort_page)
+
+__all__ = ["DistributedExecutor"]
+
+# merge kind for re-aggregating exchanged accumulator entries
+_MERGE_KIND = {"sum": "sum", "count": "sum", "count_star": "sum", "min": "min", "max": "max"}
+
+
+@dataclasses.dataclass
+class _DStream:
+    """A distributed streaming fragment: per-worker scan source + fused transform."""
+
+    schema: Schema
+    dicts: tuple
+    scan_lo_batches: list  # list of np.ndarray [n_workers] of per-worker row offsets
+    scan_fn: Callable  # (lo_scalar) -> (cols, nulls, valid); traced per worker
+    transform: Callable  # (cols, nulls, valid) -> (cols, nulls, valid)
+
+
+class DistributedExecutor:
+    """Executes plans SPMD across the mesh; falls back to LocalExecutor for blocking
+    sub-plans (join build sides, small inputs)."""
+
+    def __init__(self, catalogs: dict, mesh=None):
+        self.catalogs = catalogs
+        self.mesh = mesh if mesh is not None else worker_mesh()
+        self.n_workers = self.mesh.devices.size
+        self.local = LocalExecutor(catalogs)
+
+    # ------------------------------------------------------------------ public
+    def execute(self, node: P.PlanNode) -> MaterializedResult:
+        page, dicts = self._execute_to_page(node)
+        return _materialize(page, dicts)
+
+    # ---------------------------------------------------------------- plan walk
+    def _execute_to_page(self, node: P.PlanNode):
+        if isinstance(node, P.Output):
+            child, dicts = self._execute_to_page(node.child)
+            return Page(node.schema, child.columns, child.null_masks, child.valid), dicts
+        if isinstance(node, P.Sort):
+            child, dicts = self._execute_to_page(node.child)
+            return _sort_page(child, node.keys, dicts), dicts
+        if isinstance(node, P.Limit):
+            child, dicts = self._execute_to_page(node.child)
+            return _limit_page(child, node.count), dicts
+        if isinstance(node, P.Aggregate):
+            return self._run_aggregate(node)
+        stream = self._compile_stream(node)
+        if stream is None:
+            return self.local._execute_to_page(node)
+        return self._materialize_dstream(stream)
+
+    # ---------------------------------------------------------------- streaming
+    def _compile_stream(self, node: P.PlanNode) -> Optional[_DStream]:
+        """Build the distributed streaming fragment, or None if the fragment has no
+        distributable scan spine (executor then falls back to local)."""
+        if isinstance(node, P.TableScan):
+            conn = self.catalogs[node.catalog]
+            if not hasattr(conn, "generate_traced"):
+                return None
+            dicts = tuple(conn.dictionaries(node.table).get(c) for c in node.columns)
+            splits = conn.splits(node.table, n_hint=self.n_workers)
+            step = splits[0].hi - splits[0].lo
+            n_batches = len(splits) // self.n_workers
+            lo_batches = [
+                np.asarray([splits[b * self.n_workers + d].lo for d in range(self.n_workers)],
+                           dtype=np.int64)
+                for b in range(n_batches)
+            ]
+
+            def scan_fn(lo, conn=conn, node=node, step=step):
+                cols, valid = conn.generate_traced(node.table, lo, step, node.columns)
+                nulls = tuple(None for _ in cols)
+                if valid is None:
+                    valid = jnp.ones(cols[0].shape, bool)
+                return cols, nulls, valid
+
+            return _DStream(node.schema, dicts, lo_batches, scan_fn, lambda c, n, v: (c, n, v))
+
+        if isinstance(node, P.Filter):
+            up = self._compile_stream(node.child)
+            if up is None:
+                return None
+
+            def transform(cols, nulls, valid, up=up, pred=node.predicate):
+                cols, nulls, valid = up.transform(cols, nulls, valid)
+                return cols, nulls, evaluate_predicate(pred, cols, nulls, valid)
+
+            return dataclasses.replace(up, transform=transform)
+
+        if isinstance(node, P.Project):
+            up = self._compile_stream(node.child)
+            if up is None:
+                return None
+            from ..sql.ir import FieldRef
+
+            dicts = tuple(up.dicts[e.index] if isinstance(e, FieldRef) else None
+                          for e in node.exprs)
+
+            def transform(cols, nulls, valid, up=up, exprs=node.exprs):
+                cols, nulls, valid = up.transform(cols, nulls, valid)
+                out = [evaluate(e, cols, nulls) for e in exprs]
+                return tuple(v for v, _ in out), tuple(n for _, n in out), valid
+
+            return _DStream(node.schema, dicts, up.scan_lo_batches, up.scan_fn, transform)
+
+        if isinstance(node, P.Join):
+            up = self._compile_stream(node.left)
+            if up is None:
+                return None
+            # build side: local (blocking) execution; table closed over -> replicated
+            build_page, build_dicts = self.local._execute_to_page_streamed(node.right)
+            build_key_types = tuple(node.right.schema.fields[i].type for i in node.right_keys)
+            table = self.local._build_join_table(build_page, node.right_keys, build_key_types)
+            semi = node.kind in ("semi", "anti")
+            from ..ops.hashjoin import probe
+
+            def transform(cols, nulls, valid, up=up, node=node, table=table,
+                          build_key_types=build_key_types, semi=semi):
+                cols, nulls, valid = up.transform(cols, nulls, valid)
+                keys = tuple(cols[i] for i in node.left_keys)
+                row_ids, matched = probe(table, keys, build_key_types, valid)
+                for i in node.left_keys:
+                    if nulls[i] is not None:
+                        matched = matched & ~nulls[i]
+                if node.kind == "anti":
+                    valid = valid & ~matched
+                else:
+                    valid = valid & matched if node.kind in ("inner", "semi") else valid
+                if semi:
+                    return cols, nulls, valid
+                bcols, bnulls = _gather_build(table, row_ids, matched, node.kind)
+                out_cols = tuple(cols) + bcols
+                out_nulls = tuple(nulls) + bnulls
+                if node.filter is not None:
+                    valid = evaluate_predicate(node.filter, out_cols, out_nulls, valid)
+                return out_cols, out_nulls, valid
+
+            dicts = up.dicts if semi else up.dicts + build_dicts
+            return _DStream(node.schema, dicts, up.scan_lo_batches, up.scan_fn, transform)
+
+        return None
+
+    # ---------------------------------------------------------------- aggregation
+    def _run_aggregate(self, node: P.Aggregate):
+        stream = self._compile_stream(node.child)
+        if stream is None:
+            return self.local._run_aggregate(node)
+        child_schema = stream.schema
+        key_types = tuple(child_schema.fields[i].type for i in node.keys)
+        if not node.keys:
+            return self._run_global_aggregate(node, stream)
+
+        acc_specs, acc_exprs, acc_kinds = [], [], []
+        for spec in node.aggs:
+            for kind, dtype, init in _accumulators_for(spec):
+                acc_specs.append((dtype, init))
+                acc_exprs.append(spec.arg)
+                acc_kinds.append(kind)
+        merge_kinds = [_MERGE_KIND[k] for k in acc_kinds]
+
+        mesh = self.mesh
+        W = self.n_workers
+        sharded = NamedSharding(mesh, PS(WORKER_AXIS))
+        capacity = node.capacity or DEFAULT_GROUP_CAPACITY
+
+        while True:
+            state = self._global_state_init(capacity, key_types, acc_specs)
+
+            @partial(shard_map, mesh=mesh, in_specs=(PS(WORKER_AXIS), PS(WORKER_AXIS)),
+                     out_specs=PS(WORKER_AXIS))
+            def step(state_g, lo_g, stream=stream, node=node, key_types=key_types,
+                     acc_exprs=acc_exprs, acc_kinds=acc_kinds):
+                state = jax.tree.map(lambda x: x[0], state_g,
+                                     is_leaf=lambda x: x is None)
+                cols, nulls, valid = stream.scan_fn(lo_g[0])
+                cols, nulls, valid = stream.transform(cols, nulls, valid)
+                key_vals = tuple(cols[i] for i in node.keys)
+                inputs = [(None, None) if e is None else evaluate(e, cols, nulls)
+                          for e in acc_exprs]
+                new = hashagg.groupby_insert(state, key_vals, key_types, valid, inputs,
+                                             acc_kinds)
+                return jax.tree.map(lambda x: x[None], new, is_leaf=lambda x: x is None)
+
+            step = jax.jit(step)
+            for lo in stream.scan_lo_batches:
+                state = step(state, jax.device_put(lo, sharded))
+
+            merged = self._merge_states(state, key_types, acc_specs, merge_kinds, capacity)
+            overflow = bool(np.any(np.asarray(merged.overflow))) or bool(
+                np.any(np.asarray(state.overflow)))
+            if not overflow or capacity >= MAX_GROUP_CAPACITY:
+                break
+            capacity *= 4
+
+        # concat per-worker final partitions on host
+        table_np = np.asarray(merged.table)  # [W, C+1]
+        occ = table_np[:, :capacity] != EMPTY_KEY
+        key_cols = [np.concatenate([np.asarray(k)[w, :capacity][occ[w]] for w in range(W)])
+                    for k in merged.key_cols]
+        acc_cols = [np.concatenate([np.asarray(a)[w, :capacity][occ[w]] for w in range(W)])
+                    for a in merged.accs]
+        out_cols = key_cols + _finalize_aggs(node.aggs, acc_cols, occ.sum())
+        arrays = [jnp.asarray(c) for c in out_cols]
+        page = Page(node.schema, tuple(arrays), tuple(None for _ in arrays), None)
+        dicts = tuple(stream.dicts[i] for i in node.keys) + tuple(None for _ in node.aggs)
+        return page, dicts
+
+    def _global_state_init(self, capacity, key_types, acc_specs) -> hashagg.GroupByState:
+        """[n_workers, ...] sharded state with identical empty contents per worker."""
+        W = self.n_workers
+        sharded = NamedSharding(self.mesh, PS(WORKER_AXIS))
+
+        def tile(x):
+            return jax.device_put(jnp.broadcast_to(x[None], (W,) + x.shape), sharded)
+
+        local = hashagg.groupby_init(capacity, tuple(t.dtype for t in key_types), acc_specs)
+        return jax.tree.map(tile, local, is_leaf=lambda x: x is None)
+
+    def _merge_states(self, state, key_types, acc_specs, merge_kinds, capacity):
+        """Hash-exchange group entries across workers and re-insert (final aggregation)."""
+        W = self.n_workers
+        bucket = capacity  # worst case: every local group routes to one worker
+
+        @partial(shard_map, mesh=self.mesh, in_specs=PS(WORKER_AXIS),
+                 out_specs=PS(WORKER_AXIS))
+        def merge(state_g):
+            state = jax.tree.map(lambda x: x[0], state_g, is_leaf=lambda x: x is None)
+            C = state.capacity
+            occupied = state.table[:C] != EMPTY_KEY
+            keys = tuple(k[:C] for k in state.key_cols)
+            accs = tuple(a[:C] for a in state.accs)
+            pid = partition_ids(keys, W)
+            packed_cols, packed_valid, _ = bucketize(
+                keys + accs, occupied, pid, W, bucket)
+            recv_cols, recv_valid = exchange_all_to_all(packed_cols, packed_valid,
+                                                        WORKER_AXIS, W)
+            rkeys = recv_cols[:len(keys)]
+            raccs = recv_cols[len(keys):]
+            fresh = hashagg.groupby_init(C, tuple(t.dtype for t in key_types), acc_specs)
+            merged = hashagg.groupby_insert(
+                fresh, rkeys, key_types, recv_valid,
+                [(a, None) for a in raccs], merge_kinds)
+            merged = dataclasses.replace(merged, overflow=merged.overflow | state.overflow)
+            return jax.tree.map(lambda x: x[None], merged, is_leaf=lambda x: x is None)
+
+        return jax.jit(merge)(state)
+
+    def _run_global_aggregate(self, node, stream: _DStream):
+        """Ungrouped aggregation: per-worker jnp reductions + psum/pmin/pmax across the
+        mesh (reference: partial+final AggregationOperator pair)."""
+        acc_specs, acc_exprs, acc_kinds = [], [], []
+        for spec in node.aggs:
+            for kind, dtype, init in _accumulators_for(spec):
+                acc_specs.append((dtype, init))
+                acc_exprs.append(spec.arg)
+                acc_kinds.append(kind)
+
+        mesh = self.mesh
+        W = self.n_workers
+        sharded = NamedSharding(mesh, PS(WORKER_AXIS))
+        state = tuple(
+            jax.device_put(
+                jnp.broadcast_to(
+                    jnp.asarray(hashagg._extreme(dt, 1 if k == "min" else -1)
+                                if k in ("min", "max") else (init or 0), dt)[None], (W,)),
+                sharded)
+            for (dt, init), k in zip(acc_specs, acc_kinds)
+        )
+
+        @partial(shard_map, mesh=mesh, in_specs=(PS(WORKER_AXIS), PS(WORKER_AXIS)),
+                 out_specs=PS(WORKER_AXIS))
+        def step(state_g, lo_g, stream=stream, acc_exprs=acc_exprs, acc_kinds=acc_kinds):
+            st = tuple(s[0] for s in state_g)
+            cols, nulls, valid = stream.scan_fn(lo_g[0])
+            cols, nulls, valid = stream.transform(cols, nulls, valid)
+            out = []
+            for s, e, kind in zip(st, acc_exprs, acc_kinds):
+                if kind == "count_star":
+                    out.append(s + jnp.sum(valid, dtype=s.dtype))
+                    continue
+                v, nu = evaluate(e, cols, nulls)
+                mask = valid if nu is None else (valid & ~nu)
+                if kind == "count":
+                    out.append(s + jnp.sum(mask, dtype=s.dtype))
+                elif kind == "sum":
+                    out.append(s + jnp.sum(jnp.where(mask, v, 0), dtype=s.dtype))
+                elif kind == "min":
+                    out.append(jnp.minimum(s, jnp.min(jnp.where(mask, v, hashagg._extreme(s.dtype, 1)))))
+                elif kind == "max":
+                    out.append(jnp.maximum(s, jnp.max(jnp.where(mask, v, hashagg._extreme(s.dtype, -1)))))
+            return tuple(o[None] for o in out)
+
+        step = jax.jit(step)
+        for lo in stream.scan_lo_batches:
+            state = step(state, jax.device_put(lo, sharded))
+
+        # cross-worker combine on host (W scalars)
+        finals = []
+        for s, kind in zip(state, acc_kinds):
+            v = np.asarray(s)
+            if kind in ("sum", "count", "count_star"):
+                finals.append(v.sum(axis=0, keepdims=False)[None] if v.ndim == 0 else
+                              np.asarray([v.sum()]))
+            elif kind == "min":
+                finals.append(np.asarray([v.min()]))
+            else:
+                finals.append(np.asarray([v.max()]))
+        out_cols = _finalize_aggs(node.aggs, finals, 1)
+        arrays = [jnp.asarray(c) for c in out_cols]
+        page = Page(node.schema, tuple(arrays), tuple(None for _ in arrays), None)
+        return page, tuple(None for _ in node.aggs)
+
+    # ---------------------------------------------------------------- materialize
+    def _materialize_dstream(self, stream: _DStream):
+        """Run a streaming-only fragment and concat per-worker results on the host."""
+        mesh = self.mesh
+        sharded = NamedSharding(mesh, PS(WORKER_AXIS))
+
+        @partial(shard_map, mesh=mesh, in_specs=PS(WORKER_AXIS),
+                 out_specs=PS(WORKER_AXIS))
+        def run(lo_g, stream=stream):
+            cols, nulls, valid = stream.scan_fn(lo_g[0])
+            cols, nulls, valid = stream.transform(cols, nulls, valid)
+            nulls = tuple(jnp.zeros(c.shape, bool) if n is None else n
+                          for c, n in zip(cols, nulls))
+            return (tuple(c[None] for c in cols), tuple(n[None] for n in nulls),
+                    valid[None])
+
+        run = jax.jit(run)
+        parts_cols, parts_nulls, parts_valid = [], [], []
+        for lo in stream.scan_lo_batches:
+            cols, nulls, valid = run(jax.device_put(lo, sharded))
+            v = np.asarray(valid).reshape(-1)
+            parts_valid.append(v)
+            parts_cols.append([np.asarray(c).reshape(-1)[v] for c in cols])
+            parts_nulls.append([np.asarray(n).reshape(-1)[v] for n in nulls])
+        ncols = len(stream.schema.fields)
+        cols = tuple(jnp.asarray(np.concatenate([p[i] for p in parts_cols]))
+                     for i in range(ncols))
+        nulls_np = [np.concatenate([p[i] for p in parts_nulls]) for i in range(ncols)]
+        nulls = tuple(jnp.asarray(n) if n.any() else None for n in nulls_np)
+        page = Page(stream.schema, cols, nulls, None)
+        return page, stream.dicts

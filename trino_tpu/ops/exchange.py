@@ -1,0 +1,72 @@
+"""Hash-partitioned exchange: the all-to-all shuffle kernel.
+
+Reference data plane: PartitionedOutputOperator hash-routes each row to an output partition
+(operator/output/PagePartitioner.java:134) into per-partition buffers
+(execution/buffer/PartitionedOutputBuffer.java:42) pulled over HTTP by the consumer's
+ExchangeOperator (operator/ExchangeOperator.java:50, HttpPageBufferClient.java:100).
+
+TPU re-design (runs *inside* shard_map, SURVEY.md §2.8 mapping):
+- partition id = hash(keys) mod n_workers (same hash family as the reference's
+  partitioned exchange);
+- rows are bucketed into a fixed [n_workers, bucket] send tensor (stable sort by partition
+  + within-partition offsets — a compaction, not a gather per partition, so one XLA sort
+  covers all partitions);
+- ``jax.lax.all_to_all`` over the worker axis swaps buckets so worker w receives every
+  row whose key hashes to w — the ICI replacement for the HTTP long-poll;
+- fixed bucket capacity keeps shapes static; overflowing rows are dropped AND reported in
+  an overflow flag so the driver can re-run the batch with a bigger bucket (the moral
+  equivalent of exchange backpressure, OutputBuffer#isFull).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .hashing import hash_columns
+
+__all__ = ["partition_ids", "bucketize", "exchange_all_to_all"]
+
+
+def partition_ids(key_cols, n_partitions: int) -> jnp.ndarray:
+    """Row -> partition id in [0, n_partitions)."""
+    h = hash_columns(key_cols)
+    return (jnp.abs(h) % n_partitions).astype(jnp.int32)
+
+
+def bucketize(cols, valid, pid, n_partitions: int, bucket: int):
+    """Pack rows into a [n_partitions * bucket] send layout.
+
+    Returns (packed_cols, packed_valid, overflow): row r of partition p lands at
+    p * bucket + rank_of_r_within_p; slots beyond a partition's row count are invalid.
+    """
+    n = pid.shape[0]
+    sort_key = jnp.where(valid, pid, n_partitions)  # invalid rows sort to the end
+    order = jnp.argsort(sort_key, stable=True)
+    sorted_pid = sort_key[order]
+    # rank within partition: position minus index of first row of that partition
+    starts = jnp.searchsorted(sorted_pid, jnp.arange(n_partitions + 1))
+    rank = jnp.arange(n) - starts[jnp.clip(sorted_pid, 0, n_partitions)]
+    dest_ok = (sorted_pid < n_partitions) & (rank < bucket)
+    counts = starts[1:] - starts[:-1]
+    overflow = jnp.any(counts > bucket)
+    size = n_partitions * bucket
+    dest = jnp.where(dest_ok, sorted_pid * bucket + rank, size)  # size = drop slot
+    out_valid = jnp.zeros((size + 1,), bool).at[dest].set(dest_ok)[:size]
+    packed = tuple(
+        jnp.zeros((size + 1,), c.dtype).at[dest].set(c[order])[:size] for c in cols
+    )
+    return packed, out_valid, overflow
+
+
+def exchange_all_to_all(packed_cols, packed_valid, axis_name: str, n_partitions: int):
+    """Swap partition buckets across the mesh axis (must run inside shard_map).
+
+    Input/output layout: [n_partitions * bucket] rows; after the exchange, this worker
+    holds the rows every peer routed to it.
+    """
+
+    def a2a(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=True)
+
+    return tuple(a2a(c) for c in packed_cols), a2a(packed_valid)

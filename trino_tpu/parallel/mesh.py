@@ -1,0 +1,36 @@
+"""Device mesh management.
+
+The reference's unit of distribution is a worker node addressed over HTTP
+(node/CoordinatorNodeManager.java:56); ours is a position on a jax device Mesh — exchanges
+ride ICI collectives instead of HTTP (SURVEY.md §2.8 "TPU-native equivalent").  A 1-D mesh
+axis "w" (workers) plays the role of the worker set for hash-partitioned (FIXED_HASH) and
+broadcast (FIXED_BROADCAST) distributions; multi-host slices extend the same mesh over DCN
+via jax.distributed.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["worker_mesh", "WORKER_AXIS", "replicated", "row_sharded"]
+
+WORKER_AXIS = "w"
+
+
+def worker_mesh(n_workers: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the local device set (or an explicit device list)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_workers is not None:
+        devices = devices[:n_workers]
+    return Mesh(np.asarray(devices), (WORKER_AXIS,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def row_sharded(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(WORKER_AXIS))
