@@ -1,0 +1,200 @@
+"""TPC-H queries with subqueries / multi-aliases / OR-factored predicates vs pandas
+oracles (second batch: Q4, Q7, Q8, Q11, Q18, Q19)."""
+
+import numpy as np
+import pandas as pd
+
+from tests.test_sql_tpch import assert_frames_close, dcol, run, D
+
+
+def test_q4(engine, tpch_pandas):
+    got = run(engine, """
+        select o_orderpriority, count(*) as order_count
+        from orders
+        where o_orderdate >= date '1993-07-01'
+          and o_orderdate < date '1993-07-01' + interval '3' month
+          and exists (select * from lineitem
+                      where l_orderkey = o_orderkey and l_commitdate < l_receiptdate)
+        group by o_orderpriority order by o_orderpriority""")
+    t = tpch_pandas
+    o = t["orders"]
+    o2 = o[(dcol(o, "o_orderdate") >= D("1993-07-01"))
+           & (dcol(o, "o_orderdate") < D("1993-10-01"))]
+    li = t["lineitem"]
+    ok = li[dcol(li, "l_commitdate") < dcol(li, "l_receiptdate")]["l_orderkey"].unique()
+    o3 = o2[o2.o_orderkey.isin(ok)]
+    exp = (o3.groupby("o_orderpriority", as_index=False).size()
+           .rename(columns={"size": "order_count"})
+           .sort_values("o_orderpriority").reset_index(drop=True))
+    assert_frames_close(got, exp)
+
+
+def test_q7(engine, tpch_pandas):
+    got = run(engine, """
+        select supp_nation, cust_nation, l_year, sum(volume) as revenue
+        from (select n1.n_name as supp_nation, n2.n_name as cust_nation,
+                     extract(year from l_shipdate) as l_year,
+                     l_extendedprice * (1 - l_discount) as volume
+              from supplier, lineitem, orders, customer, nation n1, nation n2
+              where s_suppkey = l_suppkey and o_orderkey = l_orderkey
+                and c_custkey = o_custkey and s_nationkey = n1.n_nationkey
+                and c_nationkey = n2.n_nationkey
+                and ((n1.n_name = 'FRANCE' and n2.n_name = 'GERMANY')
+                     or (n1.n_name = 'GERMANY' and n2.n_name = 'FRANCE'))
+                and l_shipdate between date '1995-01-01' and date '1996-12-31'
+             ) as shipping
+        group by supp_nation, cust_nation, l_year
+        order by supp_nation, cust_nation, l_year""")
+    t = tpch_pandas
+    li = t["lineitem"]
+    li2 = li[(dcol(li, "l_shipdate") >= D("1995-01-01"))
+             & (dcol(li, "l_shipdate") <= D("1996-12-31"))]
+    j = (li2.merge(t["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+         .merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+         .merge(t["customer"], left_on="o_custkey", right_on="c_custkey")
+         .merge(t["nation"].rename(columns={"n_name": "supp_nation"}),
+                left_on="s_nationkey", right_on="n_nationkey")
+         .merge(t["nation"].rename(columns={"n_name": "cust_nation"}),
+                left_on="c_nationkey", right_on="n_nationkey"))
+    j = j[((j.supp_nation == "FRANCE") & (j.cust_nation == "GERMANY"))
+          | ((j.supp_nation == "GERMANY") & (j.cust_nation == "FRANCE"))]
+    j = j.copy()
+    j["l_year"] = dcol(j, "l_shipdate").astype("datetime64[Y]").astype(int) + 1970
+    j["volume"] = j.l_extendedprice * (1 - j.l_discount)
+    exp = (j.groupby(["supp_nation", "cust_nation", "l_year"], as_index=False)
+           .agg(revenue=("volume", "sum"))
+           .sort_values(["supp_nation", "cust_nation", "l_year"]).reset_index(drop=True))
+    assert_frames_close(got, exp, rtol=1e-9)
+
+
+def test_q8(engine, tpch_pandas):
+    got = run(engine, """
+        select o_year,
+               sum(case when nation = 'BRAZIL' then volume else 0 end) / sum(volume)
+                   as mkt_share
+        from (select extract(year from o_orderdate) as o_year,
+                     l_extendedprice * (1 - l_discount) as volume, n2.n_name as nation
+              from part, supplier, lineitem, orders, customer, nation n1, nation n2, region
+              where p_partkey = l_partkey and s_suppkey = l_suppkey
+                and l_orderkey = o_orderkey and o_custkey = c_custkey
+                and c_nationkey = n1.n_nationkey and n1.n_regionkey = r_regionkey
+                and r_name = 'AMERICA' and s_nationkey = n2.n_nationkey
+                and o_orderdate between date '1995-01-01' and date '1996-12-31'
+                and p_type = 'ECONOMY ANODIZED STEEL'
+             ) as all_nations
+        group by o_year order by o_year""")
+    t = tpch_pandas
+    o = t["orders"]
+    o2 = o[(dcol(o, "o_orderdate") >= D("1995-01-01"))
+           & (dcol(o, "o_orderdate") <= D("1996-12-31"))]
+    p2 = t["part"][t["part"].p_type == "ECONOMY ANODIZED STEEL"]
+    j = (t["lineitem"].merge(p2, left_on="l_partkey", right_on="p_partkey")
+         .merge(t["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+         .merge(o2, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(t["customer"], left_on="o_custkey", right_on="c_custkey")
+         .merge(t["nation"].add_suffix("_1"), left_on="c_nationkey",
+                right_on="n_nationkey_1")
+         .merge(t["region"], left_on="n_regionkey_1", right_on="r_regionkey")
+         .merge(t["nation"].add_suffix("_2"), left_on="s_nationkey",
+                right_on="n_nationkey_2"))
+    j = j[j.r_name == "AMERICA"].copy()
+    j["o_year"] = dcol(j, "o_orderdate").astype("datetime64[Y]").astype(int) + 1970
+    j["volume"] = j.l_extendedprice * (1 - j.l_discount)
+    j["bra"] = j.volume.where(j.n_name_2 == "BRAZIL", 0.0)
+    g = j.groupby("o_year", as_index=False).agg(bra=("bra", "sum"), vol=("volume", "sum"))
+    g["mkt_share"] = g.bra / g.vol
+    exp = g[["o_year", "mkt_share"]].sort_values("o_year").reset_index(drop=True)
+    assert_frames_close(got, exp, rtol=1e-6)
+
+
+def test_q11(engine, tpch_pandas):
+    got = run(engine, """
+        select ps_partkey, sum(ps_supplycost * ps_availqty) as value
+        from partsupp, supplier, nation
+        where ps_suppkey = s_suppkey and s_nationkey = n_nationkey
+          and n_name = 'GERMANY'
+        group by ps_partkey
+        having sum(ps_supplycost * ps_availqty) >
+               (select sum(ps_supplycost * ps_availqty) * 0.0001
+                from partsupp, supplier, nation
+                where ps_suppkey = s_suppkey and s_nationkey = n_nationkey
+                  and n_name = 'GERMANY')
+        order by value desc limit 100""")
+    t = tpch_pandas
+    j = (t["partsupp"].merge(t["supplier"], left_on="ps_suppkey", right_on="s_suppkey")
+         .merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey"))
+    j = j[j.n_name == "GERMANY"].copy()
+    j["v"] = j.ps_supplycost * j.ps_availqty
+    g = j.groupby("ps_partkey", as_index=False).agg(value=("v", "sum"))
+    thresh = j.v.sum() * 0.0001
+    exp = (g[g.value > thresh].sort_values("value", ascending=False)
+           .head(100).reset_index(drop=True))
+    assert_frames_close(got, exp, rtol=1e-9)
+
+
+def test_q18(engine, tpch_pandas):
+    got = run(engine, """
+        select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+               sum(l_quantity) as total_qty
+        from customer, orders, lineitem
+        where o_orderkey in (select l_orderkey from lineitem
+                             group by l_orderkey having sum(l_quantity) > 212)
+          and c_custkey = o_custkey and o_orderkey = l_orderkey
+        group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+        order by o_totalprice desc, o_orderdate limit 100""")
+    t = tpch_pandas
+    li = t["lineitem"]
+    big = li.groupby("l_orderkey").agg(q=("l_quantity", "sum"))
+    big_keys = big[big.q > 212].index
+    j = (li[li.l_orderkey.isin(big_keys)]
+         .merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+         .merge(t["customer"], left_on="o_custkey", right_on="c_custkey"))
+    exp = (j.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate", "o_totalprice"],
+                     as_index=False).agg(total_qty=("l_quantity", "sum"))
+           .sort_values(["o_totalprice", "o_orderdate"], ascending=[False, True])
+           .head(100).reset_index(drop=True))
+    exp = exp[["c_name", "c_custkey", "o_orderkey", "o_orderdate", "o_totalprice",
+               "total_qty"]]
+    got2 = got.drop(columns=["o_orderdate"])
+    exp2 = exp.drop(columns=["o_orderdate"])
+    assert_frames_close(got2, exp2, rtol=1e-9)
+
+
+def test_q19(engine, tpch_pandas):
+    got = run(engine, """
+        select sum(l_extendedprice * (1 - l_discount)) as revenue
+        from lineitem, part
+        where (p_partkey = l_partkey and p_brand = 'Brand#12'
+               and p_container in ('SM CASE', 'SM BOX', 'SM PACK', 'SM PKG')
+               and l_quantity >= 1 and l_quantity <= 11 and p_size between 1 and 5
+               and l_shipmode in ('AIR', 'AIR REG')
+               and l_shipinstruct = 'DELIVER IN PERSON')
+           or (p_partkey = l_partkey and p_brand = 'Brand#23'
+               and p_container in ('MED BAG', 'MED BOX', 'MED PKG', 'MED PACK')
+               and l_quantity >= 10 and l_quantity <= 20 and p_size between 1 and 10
+               and l_shipmode in ('AIR', 'AIR REG')
+               and l_shipinstruct = 'DELIVER IN PERSON')
+           or (p_partkey = l_partkey and p_brand = 'Brand#34'
+               and p_container in ('LG CASE', 'LG BOX', 'LG PACK', 'LG PKG')
+               and l_quantity >= 20 and l_quantity <= 30 and p_size between 1 and 15
+               and l_shipmode in ('AIR', 'AIR REG')
+               and l_shipinstruct = 'DELIVER IN PERSON')""")
+    t = tpch_pandas
+    j = t["lineitem"].merge(t["part"], left_on="l_partkey", right_on="p_partkey")
+    j = j[(j.l_shipmode.isin(["AIR", "AIR REG"]))
+          & (j.l_shipinstruct == "DELIVER IN PERSON")]
+    m1 = ((j.p_brand == "Brand#12")
+          & j.p_container.isin(["SM CASE", "SM BOX", "SM PACK", "SM PKG"])
+          & (j.l_quantity >= 1) & (j.l_quantity <= 11)
+          & (j.p_size >= 1) & (j.p_size <= 5))
+    m2 = ((j.p_brand == "Brand#23")
+          & j.p_container.isin(["MED BAG", "MED BOX", "MED PKG", "MED PACK"])
+          & (j.l_quantity >= 10) & (j.l_quantity <= 20)
+          & (j.p_size >= 1) & (j.p_size <= 10))
+    m3 = ((j.p_brand == "Brand#34")
+          & j.p_container.isin(["LG CASE", "LG BOX", "LG PACK", "LG PKG"])
+          & (j.l_quantity >= 20) & (j.l_quantity <= 30)
+          & (j.p_size >= 1) & (j.p_size <= 15))
+    sel = j[m1 | m2 | m3]
+    exp = (sel.l_extendedprice * (1 - sel.l_discount)).sum()
+    np.testing.assert_allclose(got["revenue"][0], exp, rtol=1e-9)

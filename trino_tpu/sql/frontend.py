@@ -140,6 +140,15 @@ class Planner:
         explicit_joins: list = []
         self._flatten_from(q.from_, relations, explicit_joins)
         conjuncts = _split_conjuncts(q.where)
+        # subquery predicates (IN/EXISTS/correlated scalar) apply after the base join tree
+        sub_conjs = [c for c in conjuncts if _has_subquery(c)]
+        conjuncts = [c for c in conjuncts if not _has_subquery(c)]
+        rel = self._plan_from_base(relations, explicit_joins, conjuncts, q)
+        for c in sub_conjs:
+            rel = self._apply_subquery_conjunct(c, rel)
+        return rel
+
+    def _plan_from_base(self, relations, explicit_joins, conjuncts, q) -> RelPlan:
 
         if explicit_joins:
             # explicit JOIN ... ON syntax: left-deep in written order
@@ -153,7 +162,7 @@ class Planner:
             node = rel.node
             for pred in remaining:
                 node = P.Filter(node, pred)
-            return RelPlan(node, rel.cols)
+            return RelPlan(node, rel.cols, rel.unique_sets)
 
         # comma-join planning with pushdown + greedy ordering
         rels = [r for r, _ in relations]
@@ -175,7 +184,7 @@ class Planner:
             for c in residual:
                 e, _ = self.translate(c, rels[0].cols)
                 node = P.Filter(node, e)
-            return RelPlan(node, rels[0].cols)
+            return RelPlan(node, rels[0].cols, rels[0].unique_sets)
 
         # greedy join: start from largest relation as probe spine
         order = sorted(range(len(rels)), key=lambda i: -sizes[i])
@@ -211,7 +220,194 @@ class Planner:
                 node = P.Filter(node, e)
         if still:
             raise SemanticError(f"unresolvable predicates: {still}")
-        return RelPlan(node, current.cols)
+        return RelPlan(node, current.cols, current.unique_sets)
+
+    # ---------------------------------------------------------------- subquery predicates
+    def _apply_subquery_conjunct(self, c, rel: RelPlan) -> RelPlan:
+        """Plan one IN/EXISTS/scalar-subquery predicate against the joined relation.
+
+        Reference: subquery planning + decorrelation in SubqueryPlanner/
+        TransformCorrelated* rules (sql/planner/SubqueryPlanner.java,
+        iterative/rule/TransformCorrelated*.java) — here specialized to the equi-correlated
+        patterns (semi/anti joins; correlated scalar aggregates join on their correlation
+        keys)."""
+        neg = False
+        while isinstance(c, A.UnaryOp) and c.op == "not":
+            neg = not neg
+            c = c.operand
+        if isinstance(c, A.InSubquery):
+            inner, names, _ = self._plan_select(c.query)
+            if len(inner.cols) != 1:
+                raise SemanticError("IN subquery must produce one column")
+            value, _ = self.translate(c.value, rel.cols)
+            negated = c.negated != neg
+            return self._semi_anti_join(rel, inner, [(value, ir.FieldRef(
+                0, inner.cols[0].type, inner.cols[0].name))], negated)
+        if isinstance(c, A.Exists):
+            negated = c.negated != neg
+            return self._plan_exists(c.query, rel, negated)
+        if isinstance(c, A.BinaryOp) and c.op in ("eq", "neq", "lt", "lte", "gt", "gte"):
+            # correlated scalar aggregate comparison (uncorrelated ones fold in translate)
+            sub = c.right if isinstance(c.right, A.ScalarSubquery) else c.left
+            other_ast = c.left if sub is c.right else c.right
+            if not isinstance(sub, A.ScalarSubquery):
+                raise SemanticError(f"unsupported subquery predicate {c}")
+            op = c.op if sub is c.right else _flip_cmp(c.op)
+            if neg:
+                op = {"eq": "neq", "neq": "eq", "lt": "gte", "lte": "gt",
+                      "gt": "lte", "gte": "lt"}[op]
+            try:  # uncorrelated: fold eagerly
+                const = self._eager_scalar(sub.query)
+                other, od = self.translate(other_ast, rel.cols)
+                t = common_super_type(other.type, const.type)
+                return RelPlan(P.Filter(rel.node, ir.Call(
+                    op, (_coerce(other, t), _coerce(const, t)), BOOLEAN)),
+                    rel.cols, rel.unique_sets)
+            except SemanticError:
+                pass
+            rel2, agg_ch = self._join_correlated_agg(sub.query, rel)
+            other, _ = self.translate(other_ast, rel2.cols[:len(rel.cols)])
+            agg_col = rel2.cols[agg_ch]
+            t = common_super_type(other.type, agg_col.type)
+            pred = ir.Call(op, (_coerce(other, t),
+                                _coerce(ir.FieldRef(agg_ch, agg_col.type), t)), BOOLEAN)
+            return RelPlan(P.Filter(rel2.node, pred), rel2.cols, rel2.unique_sets)
+        raise SemanticError(f"unsupported subquery predicate {c}")
+
+    def _semi_anti_join(self, rel: RelPlan, inner: RelPlan, pairs, negated: bool) -> RelPlan:
+        """rel ⋉/▷ inner on (outer_expr = inner_expr) pairs; build side deduplicated."""
+        # project inner to its key columns, then distinct (unique build keys)
+        key_exprs = [be for _, be in pairs]
+        schema = Schema(tuple(Field(f"sk{i}", e.type) for i, e in enumerate(key_exprs)))
+        build = P.Project(inner.node, tuple(key_exprs), schema)
+        build = P.Aggregate(build, tuple(range(len(key_exprs))), (), schema)
+        probe_node = rel.node
+        pkeys, bkeys = [], []
+        for i, (pe, be) in enumerate(pairs):
+            t = common_super_type(pe.type, be.type)
+            pch, probe_node = _ensure_channel(probe_node, _coerce(pe, t), rel.cols)
+            pkeys.append(pch)
+            bkeys.append(i)
+        kind = "anti" if negated else "semi"
+        join = P.Join(kind, probe_node, build, tuple(pkeys), tuple(bkeys),
+                      probe_node.schema)
+        # semi/anti output keeps all probe channels (incl. any helper join-key channels;
+        # harmless — downstream refers to the original ones)
+        cols = list(rel.cols) + [ColumnInfo(None, f.name, f.type)
+                                 for f in probe_node.schema.fields[len(rel.cols):]]
+        return RelPlan(join, cols, rel.unique_sets)
+
+    def _plan_exists(self, q: A.Select, rel: RelPlan, negated: bool) -> RelPlan:
+        inner_cols = self._inner_columns(q.from_)
+        inner_only, corr_pairs_ast = [], []
+        for cj in _split_conjuncts(q.where):
+            if self._resolves(cj, inner_cols):
+                inner_only.append(cj)
+                continue
+            pair = self._split_correlated_equi(cj, rel.cols, inner_cols)
+            if pair is None:
+                raise SemanticError(f"unsupported correlated predicate {cj}")
+            corr_pairs_ast.append(pair)
+        if not corr_pairs_ast:
+            # uncorrelated EXISTS: evaluate once
+            sub = dataclasses.replace(q, items=(A.SelectItem(A.NumberLit("1"), None),),
+                                      where=_and_all(inner_only), limit=1,
+                                      order_by=(), group_by=q.group_by)
+            res = self.engine.execute_plan(self.plan_query(sub))
+            exists = len(res) > 0
+            keep = exists != negated
+            if keep:
+                return rel
+            return RelPlan(P.Filter(rel.node, ir.Constant(False, BOOLEAN)),
+                           rel.cols, rel.unique_sets)
+        inner_sel = dataclasses.replace(
+            q, items=tuple(A.SelectItem(inner_ast, None) for _, inner_ast in corr_pairs_ast),
+            where=_and_all(inner_only), group_by=(), having=None, order_by=(), limit=None)
+        inner_rel, _, _ = self._plan_select(inner_sel)
+        pairs = []
+        for i, (outer_ast, _) in enumerate(corr_pairs_ast):
+            oe, _ = self.translate(outer_ast, rel.cols)
+            c = inner_rel.cols[i]
+            pairs.append((oe, ir.FieldRef(i, c.type, c.name)))
+        return self._semi_anti_join(rel, inner_rel, pairs, negated)
+
+    def _inner_columns(self, from_) -> list:
+        """Column scope of a subquery's FROM without planning its joins."""
+        relations, explicit = [], []
+        self._flatten_from(from_, relations, explicit)
+        cols = []
+        for r, _ in relations:
+            cols.extend(r.cols)
+        for j in explicit:
+            for side in (j.left, j.right):
+                if not isinstance(side, A.JoinRef):
+                    cols.extend(self._plan_relation(side).cols)
+        return cols
+
+    def _resolves(self, ast, cols) -> bool:
+        return self._try_translate(ast, cols) is not None
+
+    def _split_correlated_equi(self, cj, outer_cols, inner_cols):
+        """a = b with one side outer, one side inner -> (outer_ast, inner_ast)."""
+        if not (isinstance(cj, A.BinaryOp) and cj.op == "eq"):
+            return None
+        l_inner = self._resolves(cj.left, inner_cols)
+        r_inner = self._resolves(cj.right, inner_cols)
+        l_outer = self._resolves(cj.left, outer_cols)
+        r_outer = self._resolves(cj.right, outer_cols)
+        if l_inner and not l_outer and r_outer and not r_inner:
+            return (cj.right, cj.left)
+        if r_inner and not r_outer and l_outer and not l_inner:
+            return (cj.left, cj.right)
+        return None
+
+    def _eager_scalar(self, q: A.Select) -> ir.Constant:
+        """Execute an uncorrelated scalar subquery at plan time -> Constant.
+
+        (The reference plans these as joins — EnforceSingleRowNode; eager evaluation is
+        equivalent for uncorrelated subqueries and keeps fragments simple.)"""
+        plan = self.plan_query(q)  # raises SemanticError if correlated (unresolved cols)
+        res = self.engine.execute_plan(plan)
+        if len(res) != 1 or len(res.columns) != 1:
+            raise SemanticError("scalar subquery must return exactly one value")
+        t = res.types[0]
+        raw = res.raw_columns[0][0]
+        return ir.Constant(raw.item() if hasattr(raw, "item") else raw, t)
+
+    def _join_correlated_agg(self, q: A.Select, rel: RelPlan):
+        """Decorrelate `(select agg(..) from .. where inner.k = outer.k and ..)`:
+        plan the inner as GROUP BY its correlation keys, inner-join on them.
+        Returns (joined rel, channel of the aggregate value)."""
+        if len(q.items) != 1 or q.group_by:
+            raise SemanticError("unsupported correlated subquery shape")
+        inner_cols = self._inner_columns(q.from_)
+        inner_only, corr_pairs_ast = [], []
+        for cj in _split_conjuncts(q.where):
+            if self._resolves(cj, inner_cols):
+                inner_only.append(cj)
+                continue
+            pair = self._split_correlated_equi(cj, rel.cols, inner_cols)
+            if pair is None:
+                raise SemanticError(f"unsupported correlated predicate {cj}")
+            corr_pairs_ast.append(pair)
+        if not corr_pairs_ast:
+            raise SemanticError("not correlated")
+        inner_sel = dataclasses.replace(
+            q,
+            items=tuple(A.SelectItem(ia, f"ck{i}") for i, (_, ia) in enumerate(corr_pairs_ast))
+            + (A.SelectItem(q.items[0].expr, "aggv"),),
+            where=_and_all(inner_only),
+            group_by=tuple(ia for _, ia in corr_pairs_ast),
+            having=None, order_by=(), limit=None)
+        inner_rel, _, _ = self._plan_select(inner_sel)
+        eqs = []
+        for i, (outer_ast, _) in enumerate(corr_pairs_ast):
+            oe, _ = self.translate(outer_ast, rel.cols)
+            c = inner_rel.cols[i]
+            eqs.append((oe, ir.FieldRef(i, c.type, c.name)))
+        joined = self._make_join("inner", rel, inner_rel, eqs)
+        agg_ch = len(rel.cols) + len(corr_pairs_ast)
+        return joined, agg_ch
 
     def _flatten_from(self, node, relations, explicit_joins):
         if isinstance(node, A.JoinRef):
@@ -484,6 +680,8 @@ class Planner:
             return ir.Call(f"extract_{ast.field}", (v,), BIGINT), None
         if isinstance(ast, A.FuncCall):
             return self._translate_func(ast, cols)
+        if isinstance(ast, A.ScalarSubquery):
+            return self._eager_scalar(ast.query), None
         raise SemanticError(f"unsupported expression {ast}")
 
     def _translate_vs(self, ast, other: ir.Expr, other_dict, cols) -> ir.Expr:
@@ -658,6 +856,8 @@ class _PostAggScope:
             return ir.Call("negate", (e,), e.type)
         if isinstance(ast, A.Cast):
             return _coerce(self.translate(ast.value), _type_from_name(ast.type_name, ast.params))
+        if isinstance(ast, A.ScalarSubquery):
+            return self.planner._eager_scalar(ast.query)
         raise SemanticError(f"expression must appear in GROUP BY: {ast}")
 
 
@@ -665,6 +865,8 @@ def _collect_aggs(ast, out: list):
     if isinstance(ast, A.FuncCall) and ast.name in AGG_FUNCS:
         out.append(ast)
         return
+    if isinstance(ast, (A.ScalarSubquery, A.InSubquery, A.Exists, A.SubqueryRef, A.Select)):
+        return  # subquery scopes own their aggregates
     for f in dataclasses.fields(ast) if dataclasses.is_dataclass(ast) else ():
         v = getattr(ast, f.name)
         if isinstance(v, A.Node):
@@ -703,11 +905,60 @@ def _agg_type(kind: str, in_type: Type) -> Type:
 
 
 def _split_conjuncts(where) -> list:
+    """AND-split, factoring conjuncts common to every OR branch out of ORs (needed for
+    Q19-style `(k = j and ...) or (k = j and ...)` so the equi-join condition surfaces;
+    reference: ExtractCommonPredicatesExpressionRewriter)."""
     if where is None:
         return []
     if isinstance(where, A.BinaryOp) and where.op == "and":
         return _split_conjuncts(where.left) + _split_conjuncts(where.right)
+    if isinstance(where, A.BinaryOp) and where.op == "or":
+        branches = _split_disjuncts(where)
+        branch_conjs = [_split_conjuncts(b) for b in branches]
+        common = [c for c in branch_conjs[0] if all(c in bc for bc in branch_conjs[1:])]
+        if common:
+            rest_branches = []
+            for bc in branch_conjs:
+                rest = [c for c in bc if c not in common]
+                rest_branches.append(_and_all(rest) or A.BoolLit(True))
+            out = list(common)
+            if not all(isinstance(r, A.BoolLit) and r.value for r in rest_branches):
+                rem = rest_branches[0]
+                for r in rest_branches[1:]:
+                    rem = A.BinaryOp("or", rem, r)
+                out.append(rem)
+            return out
     return [where]
+
+
+def _split_disjuncts(e) -> list:
+    if isinstance(e, A.BinaryOp) and e.op == "or":
+        return _split_disjuncts(e.left) + _split_disjuncts(e.right)
+    return [e]
+
+
+def _and_all(conjs):
+    if not conjs:
+        return None
+    out = conjs[0]
+    for c in conjs[1:]:
+        out = A.BinaryOp("and", out, c)
+    return out
+
+
+def _has_subquery(ast) -> bool:
+    if isinstance(ast, (A.InSubquery, A.Exists, A.ScalarSubquery)):
+        return True
+    if isinstance(ast, A.BinaryOp) and ast.op in ("eq", "neq", "lt", "lte", "gt", "gte"):
+        # comparison against a subquery is a subquery conjunct ONLY if one side is one
+        return isinstance(ast.left, A.ScalarSubquery) or isinstance(ast.right, A.ScalarSubquery)
+    if isinstance(ast, A.UnaryOp) and ast.op == "not":
+        return _has_subquery(ast.operand)
+    return False
+
+
+def _flip_cmp(op: str) -> str:
+    return {"eq": "eq", "neq": "neq", "lt": "gt", "lte": "gte", "gt": "lt", "gte": "lte"}[op]
 
 
 def _find_equi_conjuncts(planner: Planner, conjuncts, left: RelPlan, right: RelPlan):
