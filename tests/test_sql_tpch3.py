@@ -1,0 +1,279 @@
+"""TPC-H queries batch 3 (Q2, Q9, Q13, Q15, Q16, Q17, Q20, Q21, Q22) vs pandas oracles:
+multi-match joins, left outer join, correlated EXISTS with non-equi residuals,
+count(distinct), substring over dictionaries, views."""
+
+import numpy as np
+import pandas as pd
+
+from tests.test_sql_tpch import assert_frames_close, dcol, run, D
+
+
+def _round_half_up(x, scale=2):
+    """Decimal HALF_UP rounding at `scale`, matching the engine's decimal avg."""
+    f = 10 ** scale
+    return np.sign(x) * np.floor(np.abs(x) * f + 0.5) / f
+
+
+def test_q2(engine, tpch_pandas):
+    got = run(engine, """
+        select s_acctbal, s_name, n_name, p_partkey, p_mfgr, s_address, s_phone, s_comment
+        from part, supplier, partsupp, nation, region
+        where p_partkey = ps_partkey and s_suppkey = ps_suppkey and p_size = 15
+          and p_type like '%BRASS' and s_nationkey = n_nationkey
+          and n_regionkey = r_regionkey and r_name = 'EUROPE'
+          and ps_supplycost = (
+              select min(ps_supplycost) from partsupp, supplier, nation, region
+              where p_partkey = ps_partkey and s_suppkey = ps_suppkey
+                and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+                and r_name = 'EUROPE')
+        order by s_acctbal desc, n_name, s_name, p_partkey
+        limit 100""")
+    t = tpch_pandas
+    j = (t["partsupp"].merge(t["supplier"], left_on="ps_suppkey", right_on="s_suppkey")
+         .merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey")
+         .merge(t["region"], left_on="n_regionkey", right_on="r_regionkey"))
+    eu = j[j.r_name == "EUROPE"]
+    mins = eu.groupby("ps_partkey").agg(minc=("ps_supplycost", "min"))
+    full = eu.merge(t["part"], left_on="ps_partkey", right_on="p_partkey")
+    full = full[(full.p_size == 15) & full.p_type.str.endswith("BRASS")]
+    full = full.merge(mins, left_on="p_partkey", right_index=True)
+    full = full[full.ps_supplycost == full.minc]
+    exp = (full[["s_acctbal", "s_name", "n_name", "p_partkey", "p_mfgr", "s_address",
+                 "s_phone", "s_comment"]]
+           .sort_values(["s_acctbal", "n_name", "s_name", "p_partkey"],
+                        ascending=[False, True, True, True])
+           .head(100).reset_index(drop=True))
+    assert_frames_close(got, exp)
+
+
+def test_q9(engine, tpch_pandas):
+    got = run(engine, """
+        select nation, o_year, sum(amount) as sum_profit
+        from (select n_name as nation, extract(year from o_orderdate) as o_year,
+                     l_extendedprice * (1 - l_discount) - ps_supplycost * l_quantity
+                         as amount
+              from part, supplier, lineitem, partsupp, orders, nation
+              where s_suppkey = l_suppkey and ps_suppkey = l_suppkey
+                and ps_partkey = l_partkey and p_partkey = l_partkey
+                and o_orderkey = l_orderkey and s_nationkey = n_nationkey
+                and p_name like '%green%') as profit
+        group by nation, o_year
+        order by nation, o_year desc""")
+    t = tpch_pandas
+    p2 = t["part"][t["part"].p_name.str.contains("green")]
+    j = (t["lineitem"].merge(p2, left_on="l_partkey", right_on="p_partkey")
+         .merge(t["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+         .merge(t["partsupp"], left_on=["l_partkey", "l_suppkey"],
+                right_on=["ps_partkey", "ps_suppkey"])
+         .merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+         .merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey")).copy()
+    j["o_year"] = dcol(j, "o_orderdate").astype("datetime64[Y]").astype(int) + 1970
+    j["amount"] = j.l_extendedprice * (1 - j.l_discount) - j.ps_supplycost * j.l_quantity
+    exp = (j.groupby(["n_name", "o_year"], as_index=False)
+           .agg(sum_profit=("amount", "sum"))
+           .rename(columns={"n_name": "nation"})
+           .sort_values(["nation", "o_year"], ascending=[True, False])
+           .reset_index(drop=True))
+    assert_frames_close(got, exp, rtol=1e-9)
+
+
+def test_q13(engine, tpch_pandas):
+    got = run(engine, """
+        select c_count, count(*) as custdist
+        from (select c_custkey, count(o_orderkey) as c_count
+              from customer left outer join orders on c_custkey = o_custkey
+                   and o_comment not like '%special%requests%'
+              group by c_custkey) as c_orders (c_custkey, c_count)
+        group by c_count
+        order by custdist desc, c_count desc""")
+    t = tpch_pandas
+    o2 = t["orders"][~t["orders"].o_comment.str.match(".*special.*requests.*")]
+    j = t["customer"].merge(o2, left_on="c_custkey", right_on="o_custkey", how="left")
+    cc = j.groupby("c_custkey").agg(c_count=("o_orderkey", "count"))
+    exp = (cc.groupby("c_count", as_index=False).size()
+           .rename(columns={"size": "custdist"})
+           .sort_values(["custdist", "c_count"], ascending=[False, False])
+           .reset_index(drop=True))
+    assert_frames_close(got, exp)
+
+
+def test_q15(engine, tpch_pandas):
+    engine.execute_sql("""
+        create view revenue0 as
+            select l_suppkey as supplier_no,
+                   sum(l_extendedprice * (1 - l_discount)) as total_revenue
+            from lineitem
+            where l_shipdate >= date '1996-01-01' and l_shipdate < date '1996-04-01'
+            group by l_suppkey""")
+    try:
+        got = run(engine, """
+            select s_suppkey, s_name, s_address, s_phone, total_revenue
+            from supplier, revenue0
+            where s_suppkey = supplier_no
+              and total_revenue = (select max(total_revenue) from revenue0)
+            order by s_suppkey""")
+    finally:
+        engine.execute_sql("drop view revenue0")
+    t = tpch_pandas
+    li = t["lineitem"]
+    li2 = li[(dcol(li, "l_shipdate") >= D("1996-01-01"))
+             & (dcol(li, "l_shipdate") < D("1996-04-01"))].copy()
+    li2["rev"] = li2.l_extendedprice * (1 - li2.l_discount)
+    rev = li2.groupby("l_suppkey", as_index=False).agg(total_revenue=("rev", "sum"))
+    top = rev[rev.total_revenue == rev.total_revenue.max()]
+    exp = (top.merge(t["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+           [["s_suppkey", "s_name", "s_address", "s_phone", "total_revenue"]]
+           .sort_values("s_suppkey").reset_index(drop=True))
+    assert_frames_close(got, exp, rtol=1e-9)
+
+
+def test_q16(engine, tpch_pandas):
+    got = run(engine, """
+        select p_brand, p_type, p_size, count(distinct ps_suppkey) as supplier_cnt
+        from partsupp, part
+        where p_partkey = ps_partkey and p_brand <> 'Brand#45'
+          and p_type not like 'MEDIUM POLISHED%'
+          and p_size in (49, 14, 23, 45, 19, 3, 36, 9)
+          and ps_suppkey not in (select s_suppkey from supplier
+                                 where s_comment like '%Customer%Complaints%')
+        group by p_brand, p_type, p_size
+        order by supplier_cnt desc, p_brand, p_type, p_size""")
+    t = tpch_pandas
+    bad = t["supplier"][t["supplier"].s_comment.str.match(
+        ".*Customer.*Complaints.*")].s_suppkey
+    p2 = t["part"][(t["part"].p_brand != "Brand#45")
+                   & ~t["part"].p_type.str.match("MEDIUM POLISHED.*")
+                   & t["part"].p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])]
+    j = t["partsupp"].merge(p2, left_on="ps_partkey", right_on="p_partkey")
+    j = j[~j.ps_suppkey.isin(bad)]
+    exp = (j.groupby(["p_brand", "p_type", "p_size"], as_index=False)
+           .agg(supplier_cnt=("ps_suppkey", "nunique"))
+           .sort_values(["supplier_cnt", "p_brand", "p_type", "p_size"],
+                        ascending=[False, True, True, True])
+           .reset_index(drop=True))
+    exp = exp[["p_brand", "p_type", "p_size", "supplier_cnt"]]
+    assert_frames_close(got, exp)
+
+
+def test_q17(engine, tpch_pandas):
+    got = run(engine, """
+        select sum(l_extendedprice) / 7.0 as avg_yearly
+        from lineitem, part
+        where p_partkey = l_partkey and p_brand = 'Brand#23' and p_container = 'MED BOX'
+          and l_quantity < (select 0.2 * avg(l_quantity) from lineitem
+                            where l_partkey = p_partkey)""")
+    t = tpch_pandas
+    li = t["lineitem"]
+    # engine's decimal avg rounds HALF_UP at the input scale (2)
+    avgq = _round_half_up(li.groupby("l_partkey").l_quantity.mean(), 2)
+    p2 = t["part"][(t["part"].p_brand == "Brand#23") & (t["part"].p_container == "MED BOX")]
+    j = li.merge(p2, left_on="l_partkey", right_on="p_partkey")
+    j = j.merge(avgq.rename("avgq"), left_on="l_partkey", right_index=True)
+    sel = j[j.l_quantity < 0.2 * j.avgq]
+    exp = sel.l_extendedprice.sum() / 7.0
+    np.testing.assert_allclose(got["avg_yearly"][0], exp, rtol=1e-9)
+
+
+def test_q20(engine, tpch_pandas):
+    got = run(engine, """
+        select s_name, s_address
+        from supplier, nation
+        where s_suppkey in (
+              select ps_suppkey from partsupp
+              where ps_partkey in (select p_partkey from part
+                                   where p_name like 'forest%')
+                and ps_availqty > (
+                    select 0.5 * sum(l_quantity) from lineitem
+                    where l_partkey = ps_partkey and l_suppkey = ps_suppkey
+                      and l_shipdate >= date '1994-01-01'
+                      and l_shipdate < date '1994-01-01' + interval '1' year))
+          and s_nationkey = n_nationkey and n_name = 'CANADA'
+        order by s_name""")
+    t = tpch_pandas
+    fparts = t["part"][t["part"].p_name.str.startswith("forest")].p_partkey
+    li = t["lineitem"]
+    li2 = li[(dcol(li, "l_shipdate") >= D("1994-01-01"))
+             & (dcol(li, "l_shipdate") < D("1995-01-01"))]
+    sums = li2.groupby(["l_partkey", "l_suppkey"]).agg(q=("l_quantity", "sum"))
+    ps = t["partsupp"][t["partsupp"].ps_partkey.isin(fparts)]
+    ps = ps.merge(sums, left_on=["ps_partkey", "ps_suppkey"], right_index=True)
+    good = ps[ps.ps_availqty > 0.5 * ps.q].ps_suppkey.unique()
+    s2 = (t["supplier"].merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey"))
+    s2 = s2[(s2.n_name == "CANADA") & s2.s_suppkey.isin(good)]
+    exp = s2[["s_name", "s_address"]].sort_values("s_name").reset_index(drop=True)
+    assert_frames_close(got, exp)
+
+
+def test_q21(engine, tpch_pandas):
+    got = run(engine, """
+        select s_name, count(*) as numwait
+        from supplier, lineitem l1, orders, nation
+        where s_suppkey = l1.l_suppkey and o_orderkey = l1.l_orderkey
+          and o_orderstatus = 'F' and l1.l_receiptdate > l1.l_commitdate
+          and exists (select * from lineitem l2
+                      where l2.l_orderkey = l1.l_orderkey
+                        and l2.l_suppkey <> l1.l_suppkey)
+          and not exists (select * from lineitem l3
+                          where l3.l_orderkey = l1.l_orderkey
+                            and l3.l_suppkey <> l1.l_suppkey
+                            and l3.l_receiptdate > l3.l_commitdate)
+          and s_nationkey = n_nationkey and n_name = 'SAUDI ARABIA'
+        group by s_name
+        order by numwait desc, s_name
+        limit 100""")
+    t = tpch_pandas
+    li = t["lineitem"]
+    l1 = li[dcol(li, "l_receiptdate") > dcol(li, "l_commitdate")]
+    j = (l1.merge(t["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+         .merge(t["orders"], left_on="l_orderkey", right_on="o_orderkey")
+         .merge(t["nation"], left_on="s_nationkey", right_on="n_nationkey"))
+    j = j[(j.o_orderstatus == "F") & (j.n_name == "SAUDI ARABIA")]
+    grp = li.groupby("l_orderkey").l_suppkey
+    other = (grp.nunique() > 1).rename("has_other").to_frame()
+    mn = grp.min().rename("mn")
+    other["mn"] = mn
+    j = j.merge(other, left_on="l_orderkey", right_index=True)
+    # exists l2: some other supplier in the order
+    exists2 = j.has_other | (j.mn != j.l_suppkey)
+    late = li[dcol(li, "l_receiptdate") > dcol(li, "l_commitdate")]
+    lgrp = late.groupby("l_orderkey").l_suppkey
+    lother = (lgrp.nunique() > 1).rename("lhas").to_frame()
+    lother["lmn"] = lgrp.min().rename("lmn")
+    j = j.merge(lother, left_on="l_orderkey", right_index=True, how="left")
+    exists3 = j.lhas.fillna(False).astype(bool) | (
+        j.lmn.notna() & (j.lmn != j.l_suppkey))
+    sel = j[exists2 & ~exists3]
+    exp = (sel.groupby("s_name", as_index=False).size()
+           .rename(columns={"size": "numwait"})
+           .sort_values(["numwait", "s_name"], ascending=[False, True])
+           .head(100).reset_index(drop=True))
+    assert_frames_close(got, exp)
+
+
+def test_q22(engine, tpch_pandas):
+    got = run(engine, """
+        select cntrycode, count(*) as numcust, sum(c_acctbal) as totacctbal
+        from (select substring(c_phone, 1, 2) as cntrycode, c_acctbal
+              from customer
+              where substring(c_phone, 1, 2) in ('13', '31', '23', '29', '30', '18', '17')
+                and c_acctbal > (select avg(c_acctbal) from customer
+                                 where c_acctbal > 0.00
+                                   and substring(c_phone, 1, 2) in
+                                       ('13', '31', '23', '29', '30', '18', '17'))
+                and not exists (select * from orders
+                                where o_custkey = c_custkey)) as custsale
+        group by cntrycode
+        order by cntrycode""")
+    t = tpch_pandas
+    c = t["customer"].copy()
+    c["cntrycode"] = c.c_phone.str[:2]
+    codes = ["13", "31", "23", "29", "30", "18", "17"]
+    pool = c[c.cntrycode.isin(codes)]
+    # engine's decimal avg rounds HALF_UP at scale 2
+    thresh = _round_half_up(pool[pool.c_acctbal > 0].c_acctbal.mean(), 2)
+    has_orders = set(t["orders"].o_custkey)
+    sel = pool[(pool.c_acctbal > thresh) & ~pool.c_custkey.isin(has_orders)]
+    exp = (sel.groupby("cntrycode", as_index=False)
+           .agg(numcust=("c_custkey", "size"), totacctbal=("c_acctbal", "sum"))
+           .sort_values("cntrycode").reset_index(drop=True))
+    assert_frames_close(got, exp, rtol=1e-9)

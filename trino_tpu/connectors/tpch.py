@@ -66,6 +66,15 @@ class Dictionary:
             raise KeyError("cannot enumerate a formatter dictionary")
         return np.array([bool(pred(str(v))) for v in self.values])
 
+    def map_values(self, fn: Callable[[str], str]):
+        """String function over the dictionary: returns (id->new_id lut, new Dictionary)
+        — string compute happens once per distinct value at plan time, never on device."""
+        if self.values is None:
+            raise KeyError("cannot enumerate a formatter dictionary")
+        mapped = np.array([fn(str(v)) for v in self.values])
+        uniq, inv = np.unique(mapped, return_inverse=True)
+        return inv.astype(np.int32), Dictionary(values=uniq)
+
 
 def _enum(*vals):
     return Dictionary(values=np.array(vals))
@@ -93,6 +102,36 @@ NATIONS = [  # (name, regionkey) — TPC-H spec 4.2.3
 REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
 NATION_DICT = Dictionary(values=np.array([n for n, _ in NATIONS]))
 REGION_DICT = Dictionary(values=np.array(REGIONS))
+# p_name = color words — spec 4.2.2.13 picks 5 of 92 colors; we pick 2 so the dictionary
+# stays enumerable (92^2 values) while LIKE '%green%' / 'forest%' predicates stay selective
+COLORS = [
+    "almond", "antique", "aquamarine", "azure", "beige", "bisque", "black", "blanched",
+    "blue", "blush", "brown", "burlywood", "burnished", "chartreuse", "chiffon",
+    "chocolate", "coral", "cornflower", "cornsilk", "cream", "cyan", "dark", "deep",
+    "dim", "dodger", "drab", "firebrick", "floral", "forest", "frosted", "gainsboro",
+    "ghost", "goldenrod", "green", "grey", "honeydew", "hot", "indian", "ivory", "khaki",
+    "lace", "lavender", "lawn", "lemon", "light", "lime", "linen", "magenta", "maroon",
+    "medium", "metallic", "midnight", "mint", "misty", "moccasin", "navajo", "navy",
+    "olive", "orange", "orchid", "pale", "papaya", "peach", "peru", "pink", "plum",
+    "powder", "puff", "purple", "red", "rose", "rosy", "royal", "saddle", "salmon",
+    "sandy", "seashell", "sienna", "sky", "slate", "smoke", "snow", "spring", "steel",
+    "tan", "thistle", "tomato", "turquoise", "violet", "wheat", "white", "yellow",
+]
+PNAMES = _enum(*[f"{a} {b}" for a in COLORS for b in COLORS])
+# comments: mostly filler, a deterministic fraction carrying the markers TPC-H predicates
+# look for (Q13 '%special%requests%', Q16 '%Customer%Complaints%')
+O_COMMENTS = _enum(*[
+    f"furiously special packages wake requests {i}" if i % 32 == 0
+    else f"quietly final deposits nag {i}"
+    for i in range(4096)])
+S_COMMENTS = _enum(*[
+    f"slyly Customer pending Complaints {i}" if i % 64 == 0
+    else f"blithely regular packages boost {i}"
+    for i in range(2048)])
+# c_phone = "CC-..." with country code 10+nationkey (spec 4.2.2.9); id = nationkey*400+s
+PHONE_SUFFIXES = 400
+PHONES = _enum(*[f"{10 + nk}-{(s * 7) % 1000:03d}-{(s * 13) % 1000:03d}-{s:04d}"
+                 for nk in range(25) for s in range(PHONE_SUFFIXES)])
 # p_type = "<syllable1> <syllable2> <syllable3>" — spec 4.2.2.13
 TYPE_S1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
 TYPE_S2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
@@ -171,14 +210,14 @@ DICTIONARIES: dict[str, dict[str, Dictionary]] = {
     "lineitem": {"l_returnflag": RFLAG, "l_linestatus": LSTATUS, "l_shipinstruct": INSTRUCTIONS,
                  "l_shipmode": MODES, "l_comment": _fmt("line comment %d")},
     "orders": {"o_orderstatus": OSTATUS, "o_orderpriority": PRIORITIES,
-               "o_clerk": _fmt("Clerk#%09d"), "o_comment": _fmt("order comment %d")},
+               "o_clerk": _fmt("Clerk#%09d"), "o_comment": O_COMMENTS},
     "customer": {"c_name": _fmt("Customer#%09d"), "c_address": _fmt("addr %d"),
-                 "c_phone": _fmt("phone-%011d"), "c_mktsegment": SEGMENTS,
+                 "c_phone": PHONES, "c_mktsegment": SEGMENTS,
                  "c_comment": _fmt("customer comment %d")},
-    "part": {"p_name": _fmt("part name %d"), "p_mfgr": MFGRS, "p_brand": BRANDS,
+    "part": {"p_name": PNAMES, "p_mfgr": MFGRS, "p_brand": BRANDS,
              "p_type": PTYPES, "p_container": CONTAINERS, "p_comment": _fmt("part comment %d")},
     "supplier": {"s_name": _fmt("Supplier#%09d"), "s_address": _fmt("saddr %d"),
-                 "s_phone": _fmt("sphone-%011d"), "s_comment": _fmt("supplier comment %d")},
+                 "s_phone": _fmt("sphone-%011d"), "s_comment": S_COMMENTS},
     "partsupp": {"ps_comment": _fmt("partsupp comment %d")},
     "nation": {"n_name": NATION_DICT, "n_comment": _fmt("nation comment %d")},
     "region": {"r_name": REGION_DICT, "r_comment": _fmt("region comment %d")},
@@ -215,14 +254,18 @@ def gen_orders(sf: float, lo, length: int, n: int = 0):
     okey = i + 1
     valid = (i < n) if n else None
     ccount = int(BASE_ROWS["customer"] * sf)
+    ck = _uniform(11, okey, 1, max(ccount, 1))
+    # custkeys divisible by 3 never order (spec 4.2.3: "C_CUSTKEY must not be divisible
+    # by three") -> a third of customers are orderless, keeping Q13/Q22 anti-joins live
+    ck = jnp.maximum(ck - (ck % 3 == 0), 1)
     cols = {
         "o_orderkey": okey,
-        "o_custkey": _uniform(11, okey, 1, max(ccount, 1)),
+        "o_custkey": ck,
         "o_orderdate": _uniform(12, okey, STARTDATE, ENDDATE - 151).astype(jnp.int32),
         "o_orderpriority": _uniform(13, okey, 0, 4).astype(jnp.int32),
         "o_clerk": _uniform(14, okey, 1, max(int(1000 * sf), 1)).astype(jnp.int32),
         "o_shippriority": jnp.zeros_like(okey, jnp.int32),
-        "o_comment": (okey % (1 << 31)).astype(jnp.int32),
+        "o_comment": _uniform(16, okey, 0, 4095).astype(jnp.int32),
         "o_totalprice": _uniform(15, okey, 85_000, 55_000_000),  # cents
     }
     # orderstatus: F if orderdate old enough that all lines shipped, O if all open, else P
@@ -281,12 +324,14 @@ def gen_customer(sf, lo, length: int, n: int = 0):
     i = jnp.arange(length, dtype=jnp.int64) + lo
     key = i + 1
     valid = (i < n) if n else None
+    nationkey = _uniform(41, key, 0, 24)
     return {
         "c_custkey": key,
         "c_name": (key % (1 << 31)).astype(jnp.int32),
         "c_address": (key % (1 << 31)).astype(jnp.int32),
-        "c_nationkey": _uniform(41, key, 0, 24),
-        "c_phone": (key % (1 << 31)).astype(jnp.int32),
+        "c_nationkey": nationkey,
+        "c_phone": (nationkey * PHONE_SUFFIXES
+                    + _uniform(44, key, 0, PHONE_SUFFIXES - 1)).astype(jnp.int32),
         "c_acctbal": _uniform(42, key, -99_999, 999_999),
         "c_mktsegment": _uniform(43, key, 0, 4).astype(jnp.int32),
         "c_comment": (key % (1 << 31)).astype(jnp.int32),
@@ -299,7 +344,7 @@ def gen_part(sf, lo, length: int, n: int = 0):
     valid = (i < n) if n else None
     return {
         "p_partkey": key,
-        "p_name": (key % (1 << 31)).astype(jnp.int32),
+        "p_name": _uniform(56, key, 0, len(COLORS) ** 2 - 1).astype(jnp.int32),
         "p_mfgr": ((_uniform(51, key, 1, 5)) - 1).astype(jnp.int32),
         "p_brand": (_uniform(51, key, 1, 5) * 5 + _uniform(52, key, 1, 5) - 6).astype(jnp.int32),
         "p_type": _uniform(53, key, 0, 149).astype(jnp.int32),
@@ -321,7 +366,7 @@ def gen_supplier(sf, lo, length: int, n: int = 0):
         "s_nationkey": _uniform(61, key, 0, 24),
         "s_phone": (key % (1 << 31)).astype(jnp.int32),
         "s_acctbal": _uniform(62, key, -99_999, 999_999),
-        "s_comment": (key % (1 << 31)).astype(jnp.int32),
+        "s_comment": _uniform(63, key, 0, 2047).astype(jnp.int32),
     }, valid
 
 

@@ -138,13 +138,23 @@ class DistributedExecutor:
                 return None
             from ..sql.ir import FieldRef
 
-            dicts = tuple(up.dicts[e.index] if isinstance(e, FieldRef) else None
-                          for e in node.exprs)
+            planner_dicts = node.dicts or tuple(None for _ in node.exprs)
+            dicts = tuple(
+                pd if pd is not None
+                else (up.dicts[e.index] if isinstance(e, FieldRef) else None)
+                for pd, e in zip(planner_dicts, node.exprs))
 
             def transform(cols, nulls, valid, up=up, exprs=node.exprs):
                 cols, nulls, valid = up.transform(cols, nulls, valid)
                 out = [evaluate(e, cols, nulls) for e in exprs]
-                return tuple(v for v, _ in out), tuple(n for _, n in out), valid
+                import jax.numpy as jnp
+
+                vs = tuple(jnp.broadcast_to(v, valid.shape) if v.ndim == 0 else v
+                           for v, _ in out)
+                ns = tuple(None if n is None
+                           else (jnp.broadcast_to(n, valid.shape) if n.ndim == 0 else n)
+                           for _, n in out)
+                return vs, ns, valid
 
             return _DStream(node.schema, dicts, up.scan_lo_batches, up.scan_fn, transform)
 
@@ -152,10 +162,24 @@ class DistributedExecutor:
             up = self._compile_stream(node.left)
             if up is None:
                 return None
+            # residual match filters change left/semi/anti semantics (match condition,
+            # not post-filter) — only inner joins can apply them post-gather here;
+            # other shapes fall back to the local multi-match executor
+            if node.filter is not None and node.kind != "inner":
+                return None
+            if node.null_aware and node.kind == "anti":
+                return None  # NOT IN 3VL handled by the local executor for now
             # build side: local (blocking) execution; table closed over -> replicated
             build_page, build_dicts = self.local._execute_to_page_streamed(node.right)
             build_key_types = tuple(node.right.schema.fields[i].type for i in node.right_keys)
-            table = self.local._build_join_table(build_page, node.right_keys, build_key_types)
+            table = None
+            if build_page.capacity > 0:
+                table = self.local._build_join_table(build_page, node.right_keys,
+                                                     build_key_types)
+            if table is None:
+                # duplicate build keys (or empty build) need the multi-match strategy,
+                # which is data-dependent-shape -> local fallback for now
+                return None
             semi = node.kind in ("semi", "anti")
             from ..ops.hashjoin import probe
 

@@ -25,7 +25,8 @@ import numpy as np
 
 from ..connectors.tpch import Dictionary
 from ..ops import hashagg
-from ..ops.hashjoin import JoinTable, build_insert, build_table_init, probe
+from ..ops.hashjoin import (JoinTable, MultiJoinTable, build_insert, build_table_init,
+                            expand_counts, multi_build, probe, probe_slots)
 from ..page import Field, Page, Schema
 from ..types import BIGINT, DOUBLE, BOOLEAN, DecimalType, Type
 from ..sql import plan as P
@@ -66,13 +67,31 @@ class _Stream:
     dicts: tuple  # Dictionary|None per channel
     pages: Callable  # () -> iterator of raw source Pages
     transform: Callable  # (cols, nulls, valid) -> (cols, nulls, valid); jit-traceable
+    _jitted: Callable = None  # cached jit of transform applied to a Page
+
+    def jitted(self):
+        """Jit-compiled page->(cols,nulls,valid) function, cached on the stream so
+        repeated executions of a cached plan reuse the XLA executable."""
+        if self._jitted is None:
+            self._jitted = jax.jit(lambda page: self.transform(
+                page.columns, page.null_masks, page.valid_mask()))
+        return self._jitted
 
 
 class LocalExecutor:
-    """Executes a plan tree on the local device set (one chip or CPU)."""
+    """Executes a plan tree on the local device set (one chip or CPU).
+
+    Compiled pipelines (fused stream transforms, jitted aggregation steps, join build
+    tables) are cached per plan-node identity: re-executing a cached plan skips both
+    tracing and XLA compilation (reference analog: PageFunctionCompiler's bytecode caches,
+    sql/gen/PageFunctionCompiler.java:103).  Valid while connector data is immutable —
+    true for generator connectors; mutating connectors must invalidate the engine's plan
+    cache."""
 
     def __init__(self, catalogs: dict):
         self.catalogs = catalogs
+        self._stream_cache: dict = {}  # id(node) -> (node, _Stream)
+        self._agg_cache: dict = {}  # id(node) -> compiled aggregation artifacts
 
     # ------------------------------------------------------------------ public
     def execute(self, node: P.PlanNode) -> MaterializedResult:
@@ -99,6 +118,15 @@ class LocalExecutor:
 
     # -- streaming segment compilation ---------------------------------------
     def _compile_stream(self, node: P.PlanNode) -> _Stream:
+        hit = self._stream_cache.get(id(node))
+        if hit is not None:
+            return hit[1]
+        stream = self._compile_stream_uncached(node)
+        # the strong node ref keeps id() stable for the cache lifetime
+        self._stream_cache[id(node)] = (node, stream)
+        return stream
+
+    def _compile_stream_uncached(self, node: P.PlanNode) -> _Stream:
         if isinstance(node, P.TableScan):
             conn = self.catalogs[node.catalog]
             dicts = tuple(conn.dictionaries(node.table).get(c) for c in node.columns)
@@ -122,14 +150,24 @@ class LocalExecutor:
 
         if isinstance(node, P.Project):
             up = self._compile_stream(node.child)
+            planner_dicts = node.dicts or tuple(None for _ in node.exprs)
             dicts = tuple(
-                up.dicts[e.index] if isinstance(e, FieldRef) else None for e in node.exprs
+                pd if pd is not None
+                else (up.dicts[e.index] if isinstance(e, FieldRef) else None)
+                for pd, e in zip(planner_dicts, node.exprs)
             )
 
             def transform(cols, nulls, valid, up=up, exprs=node.exprs):
                 cols, nulls, valid = up.transform(cols, nulls, valid)
                 out = [evaluate(e, cols, nulls) for e in exprs]
-                return tuple(v for v, _ in out), tuple(n for _, n in out), valid
+                # constant expressions evaluate to scalars: broadcast to row count so
+                # downstream consumers (join keys, exchanges) see real columns
+                vs = tuple(jnp.broadcast_to(v, valid.shape) if v.ndim == 0 else v
+                           for v, _ in out)
+                ns = tuple(None if n is None
+                           else (jnp.broadcast_to(n, valid.shape) if n.ndim == 0 else n)
+                           for _, n in out)
+                return vs, ns, valid
 
             return _Stream(node.schema, dicts, up.pages, transform)
 
@@ -153,10 +191,13 @@ class LocalExecutor:
         raise NotImplementedError(f"node {type(node).__name__}")
 
     # -- aggregation sink ----------------------------------------------------
-    def _run_aggregate(self, node: P.Aggregate):
+    def _agg_compiled(self, node: P.Aggregate):
+        """Per-node compiled aggregation artifacts (cached across executions)."""
+        hit = self._agg_cache.get(id(node))
+        if hit is not None:
+            return hit[1:]
         stream = self._compile_stream(node.child)
-        child_schema = stream.schema
-        key_types = tuple(child_schema.fields[i].type for i in node.keys)
+        key_types = tuple(stream.schema.fields[i].type for i in node.keys)
 
         # expand avg -> (sum, count); build accumulator specs
         acc_specs, acc_exprs, acc_kinds = [], [], []
@@ -166,6 +207,26 @@ class LocalExecutor:
                 acc_exprs.append(spec.arg)
                 acc_kinds.append(kind)
 
+        @jax.jit
+        def step(state, page, stream=stream, node=node, key_types=key_types,
+                 acc_exprs=acc_exprs, acc_kinds=acc_kinds):
+            cols, nulls, valid = stream.transform(
+                page.columns, page.null_masks, page.valid_mask()
+            )
+            key_vals = tuple(cols[i] for i in node.keys)
+            inputs = [
+                (None, None) if e is None else evaluate(e, cols, nulls) for e in acc_exprs
+            ]
+            return hashagg.groupby_insert(
+                state, key_vals, key_types, valid, inputs, acc_kinds
+            )
+
+        out = (stream, key_types, acc_specs, acc_exprs, acc_kinds, step)
+        self._agg_cache[id(node)] = (node,) + out
+        return out
+
+    def _run_aggregate(self, node: P.Aggregate):
+        stream, key_types, acc_specs, acc_exprs, acc_kinds, step = self._agg_compiled(node)
         capacity = node.capacity or DEFAULT_GROUP_CAPACITY
         if not node.keys:
             return self._run_global_aggregate(node, stream, acc_exprs, acc_kinds)
@@ -174,21 +235,6 @@ class LocalExecutor:
             state = hashagg.groupby_init(
                 capacity, tuple(t.dtype for t in key_types), acc_specs
             )
-
-            @jax.jit
-            def step(state, page, stream=stream, node=node, key_types=key_types,
-                     acc_exprs=acc_exprs, acc_kinds=acc_kinds):
-                cols, nulls, valid = stream.transform(
-                    page.columns, page.null_masks, page.valid_mask()
-                )
-                key_vals = tuple(cols[i] for i in node.keys)
-                inputs = [
-                    (None, None) if e is None else evaluate(e, cols, nulls) for e in acc_exprs
-                ]
-                return hashagg.groupby_insert(
-                    state, key_vals, key_types, valid, inputs, acc_kinds
-                )
-
             for page in stream.pages():
                 state = step(state, page)
             if not bool(state.overflow) or capacity >= MAX_GROUP_CAPACITY:
@@ -207,6 +253,10 @@ class LocalExecutor:
 
     def _run_global_aggregate(self, node, stream, acc_exprs, acc_kinds):
         """Ungrouped aggregation (reference: AggregationOperator) — pure jnp reductions."""
+        hit = self._agg_cache.get(("global", id(node)))
+        if hit is not None:
+            step = hit[1]
+            return self._finish_global(node, stream, acc_exprs, acc_kinds, step)
 
         @jax.jit
         def step(state, page, stream=stream, acc_exprs=acc_exprs, acc_kinds=acc_kinds):
@@ -230,6 +280,10 @@ class LocalExecutor:
                     raise NotImplementedError(kind)
             return tuple(out)
 
+        self._agg_cache[("global", id(node))] = (node, step)
+        return self._finish_global(node, stream, acc_exprs, acc_kinds, step)
+
+    def _finish_global(self, node, stream, acc_exprs, acc_kinds, step):
         acc_specs = []
         for spec in node.aggs:
             acc_specs.extend(_accumulators_for(spec))
@@ -256,8 +310,16 @@ class LocalExecutor:
         build_page, build_dicts = self._execute_to_page_streamed(node.right)
         probe_stream = self._compile_stream(node.left)
         build_key_types = tuple(node.right.schema.fields[i].type for i in node.right_keys)
-        table = self._build_join_table(build_page, node.right_keys, build_key_types)
         semi = node.kind in ("semi", "anti")
+        build_has_null, build_nonempty = _build_null_stats(build_page, node.right_keys)
+
+        table = None
+        if node.filter is None and build_page.capacity > 0:
+            table = self._build_join_table(build_page, node.right_keys, build_key_types)
+        if table is None:
+            # duplicate build keys or residual join filter -> multi-match strategy
+            return self._compile_multi_join(node, build_page, build_dicts, probe_stream,
+                                            build_key_types)
 
         def transform(cols, nulls, valid, up=probe_stream, node=node, table=table):
             cols, nulls, valid = up.transform(cols, nulls, valid)
@@ -272,18 +334,115 @@ class LocalExecutor:
                 valid = valid & matched
             elif node.kind == "anti":
                 valid = valid & ~matched
+                valid = _null_aware_anti(node, valid, nulls, build_has_null,
+                                         build_nonempty)
             if semi:
                 return cols, nulls, valid
             bcols, bnulls = _gather_build(table, row_ids, matched, node.kind)
             out_cols = tuple(cols) + bcols
             out_nulls = tuple(nulls) + bnulls
-            if node.filter is not None:
-                valid = evaluate_predicate(node.filter, out_cols, out_nulls, valid)
             return out_cols, out_nulls, valid
 
         dicts = (probe_stream.dicts if semi
                  else probe_stream.dicts + build_dicts)
         return _Stream(node.schema, dicts, probe_stream.pages, transform)
+
+    def _compile_multi_join(self, node: P.Join, build_page, build_dicts, probe_stream,
+                            build_key_types) -> _Stream:
+        """Join with duplicate build keys and/or a residual match filter.
+
+        Reference: position-linked JoinHash chains (operator/join/JoinHash.java:145) with
+        JoinFilterFunction evaluated per candidate match.  Here: slot-grouped build layout
+        (ops/hashjoin.multi_build) + searchsorted expansion; output page size is
+        data-dependent, so the expansion crosses a host sync per page and re-jits per
+        power-of-two output bucket (shape-class caching keeps recompiles bounded)."""
+        semi = node.kind in ("semi", "anti")
+        if build_page.capacity == 0:
+            # empty build: pad one never-matching dummy row so gathers stay well-defined
+            cols = tuple(jnp.zeros((1,), f.type.dtype) for f in node.right.schema.fields)
+            build_page = Page(node.right.schema, cols, tuple(None for _ in cols),
+                              jnp.zeros((1,), bool))
+        capacity = max(1 << max(build_page.capacity - 1, 1).bit_length(), 16) * 2
+        mt = multi_build(capacity, build_page, node.right_keys, build_key_types)
+
+        @jax.jit
+        def count_step(page, mt, up=probe_stream, node=node):
+            cols, nulls, valid = up.transform(page.columns, page.null_masks,
+                                              page.valid_mask())
+            keys = tuple(cols[i] for i in node.left_keys)
+            kvalid = valid
+            for i in node.left_keys:
+                if nulls[i] is not None:
+                    kvalid = kvalid & ~nulls[i]
+            slot, matched = probe_slots(mt.table, keys, build_key_types, kvalid)
+            matched = matched & kvalid
+            cnt = jnp.where(matched, mt.counts[slot], 0)
+            if node.kind == "left":
+                out_cnt = jnp.where(valid, jnp.maximum(cnt, 1), 0)
+            else:
+                out_cnt = cnt
+            incl = jnp.cumsum(out_cnt, dtype=jnp.int32)
+            return cols, nulls, valid, slot, matched, cnt, out_cnt, incl
+
+        def expand_step(size, cols, nulls, valid, slot, matched, cnt, out_cnt, incl, mt,
+                        node=node):
+            pidx, k, in_range = expand_counts(incl, out_cnt, size)
+            is_match = matched[pidx] & (k < cnt[pidx]) & in_range
+            brow = mt.order[jnp.clip(mt.starts[slot[pidx]] + k, 0, mt.order.shape[0] - 1)]
+            brow = jnp.where(is_match, brow, 0)
+            ocols = tuple(c[pidx] for c in cols) + tuple(c[brow] for c in mt.build_columns)
+            onulls = tuple(None if n is None else n[pidx] for n in nulls) + tuple(
+                None if n is None else n[brow] for n in mt.build_null_masks)
+            if node.filter is not None:
+                passed = evaluate_predicate(node.filter, ocols, onulls, is_match)
+            else:
+                passed = is_match
+            n_probe = valid.shape[0]
+            if semi:
+                mark = jnp.zeros((n_probe,), jnp.int32).at[pidx].max(
+                    passed.astype(jnp.int32))
+                return mark.astype(bool)
+            if node.kind == "left":
+                any_pass = jnp.zeros((n_probe,), jnp.int32).at[pidx].max(
+                    passed.astype(jnp.int32)).astype(bool)
+                keep = passed | ((k == 0) & ~any_pass[pidx] & in_range & valid[pidx])
+                onulls = onulls[:len(cols)] + tuple(
+                    (jnp.zeros_like(passed) if n is None else n) | ~passed
+                    for n in onulls[len(cols):])
+                return ocols, onulls, keep
+            return ocols, onulls, passed  # inner
+
+        # ONE jit object per join stream: jax caches executables per static `size`
+        # bucket internally, so power-of-two padding bounds recompiles
+        expand_jit = jax.jit(expand_step, static_argnums=0)
+
+        build_has_null, build_nonempty = _build_null_stats(build_page, node.right_keys)
+
+        def pages(probe_stream=probe_stream):
+            for page in probe_stream.pages():
+                cols, nulls, valid, slot, matched, cnt, out_cnt, incl = count_step(page, mt)
+                if semi and node.filter is None:
+                    if node.kind == "semi":
+                        v = valid & matched
+                    else:
+                        v = _null_aware_anti(node, valid & ~matched, nulls,
+                                             build_has_null, build_nonempty)
+                    yield Page(probe_stream.schema, cols, nulls, v)
+                    continue
+                total = int(incl[-1]) if incl.shape[0] else 0
+                size = max(1 << max(total - 1, 1).bit_length(), 1024)
+                out = expand_jit(size, cols, nulls, valid, slot, matched, cnt, out_cnt,
+                                 incl, mt)
+                if semi:
+                    mark = out
+                    v = valid & mark if node.kind == "semi" else valid & ~mark
+                    yield Page(probe_stream.schema, cols, nulls, v)
+                else:
+                    ocols, onulls, ovalid = out
+                    yield Page(node.schema, ocols, onulls, ovalid)
+
+        dicts = (probe_stream.dicts if semi else probe_stream.dicts + build_dicts)
+        return _Stream(node.schema, dicts, pages, lambda c, n, v: (c, n, v))
 
     def _execute_to_page_streamed(self, node):
         """Materialize a sub-plan into one device page (join build side)."""
@@ -309,9 +468,7 @@ class LocalExecutor:
                 break
             capacity *= 4
         if int(table.dup_count) > 0:
-            raise NotImplementedError(
-                "duplicate join keys on build side not supported yet "
-                "(planner should have chosen the unique-key side; see RelPlan.unique_sets)")
+            return None  # caller falls back to the multi-match strategy
         return table
 
 
@@ -362,8 +519,7 @@ def _finalize_aggs(aggs, acc_cols, n_groups):
 def _concat_stream(stream: _Stream) -> Page:
     """Materialize a streaming segment into a single device page (compacted)."""
     parts = []
-    step = jax.jit(lambda page, stream=stream: stream.transform(
-        page.columns, page.null_masks, page.valid_mask()))
+    step = stream.jitted()
     for page in stream.pages():
         parts.append(step(page))
     if not parts:
@@ -387,6 +543,34 @@ def _concat_stream(stream: _Stream) -> Page:
     cols = tuple(jnp.asarray(c) for c in cols_np)
     nulls = tuple(None if n is None else jnp.asarray(n) for n in nulls_np)
     return Page(stream.schema, cols, nulls, None)
+
+
+def _build_null_stats(build_page: Page, key_channels):
+    """(build_has_null_key, build_nonempty) — host-side, for null-aware anti joins."""
+    valid = np.asarray(build_page.valid_mask()) if build_page.capacity else \
+        np.zeros((0,), bool)
+    nonempty = bool(valid.any())
+    has_null = False
+    for ch in key_channels:
+        nm = build_page.null_masks[ch]
+        if nm is not None and bool((np.asarray(nm) & valid).any()):
+            has_null = True
+    return has_null, nonempty
+
+
+def _null_aware_anti(node, anti_valid, nulls, build_has_null, build_nonempty):
+    """NOT IN three-valued logic (reference: null-aware anti joins): a NULL among the
+    build keys, or a NULL probe key vs a non-empty build, makes the predicate UNKNOWN
+    (row rejected).  NOT EXISTS anti joins (null_aware=False) skip this."""
+    if not node.null_aware:
+        return anti_valid
+    if build_has_null:
+        return jnp.zeros_like(anti_valid)
+    if build_nonempty:
+        for i in node.left_keys:
+            if nulls[i] is not None:
+                anti_valid = anti_valid & ~nulls[i]
+    return anti_valid
 
 
 def _gather_build(table: JoinTable, row_ids, matched, kind):

@@ -27,7 +27,8 @@ import jax.numpy as jnp
 
 from .hashing import EMPTY_KEY, pack_keys, splitmix64
 
-__all__ = ["JoinTable", "build_table_init", "build_insert", "probe", "MAX_PROBES"]
+__all__ = ["JoinTable", "build_table_init", "build_insert", "probe", "MAX_PROBES",
+           "MultiJoinTable", "multi_build", "probe_slots", "expand_counts"]
 
 MAX_PROBES = 64
 
@@ -121,3 +122,120 @@ def probe(jt: JoinTable, key_cols, key_types, valid):
 
     row_ids, matched, done = jax.lax.fori_loop(0, MAX_PROBES, body, (row_ids, matched, done))
     return row_ids, matched
+
+
+# ---------------------------------------------------------------------------- multi-match
+# Duplicate build keys: the reference chains same-key rows through position links
+# (operator/join/PositionLinks.java, JoinHash.java:145).  The TPU equivalent groups build
+# rows contiguously by hash slot (argsort by slot = the "links", but as one dense gatherable
+# layout): slot -> (start, count) into a row-order array.  Probe finds the slot; match
+# expansion is a searchsorted over the per-probe-row cumulative match counts — every step is
+# a dense gather/scan that XLA maps onto the TPU without scalar loops.
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class MultiJoinTable:
+    table: jnp.ndarray  # [capacity+1] packed keys
+    counts: jnp.ndarray  # [capacity+1] int32 build rows per slot (sink = 0)
+    starts: jnp.ndarray  # [capacity+1] int32 exclusive prefix sum over slots
+    order: jnp.ndarray  # [n_rows] int32 build row ids grouped by slot
+    build_columns: tuple
+    build_null_masks: tuple
+    overflow: jnp.ndarray  # bool scalar
+
+    def tree_flatten(self):
+        return (
+            (self.table, self.counts, self.starts, self.order, self.build_columns,
+             self.build_null_masks, self.overflow),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def capacity(self):
+        return self.table.shape[0] - 1
+
+
+def _multi_build_step(table0, key_cols, key_types, valid):
+    from .hashagg import _probe_insert
+
+    packed, _ = pack_keys(key_cols, key_types)
+    packed = jnp.where(valid, packed, EMPTY_KEY - 1)
+    table, slot, placed = _probe_insert(table0, packed, valid)
+    C = table.shape[0] - 1
+    live = valid & placed
+    slot_v = jnp.where(live, slot, C).astype(jnp.int32)
+    counts = jnp.zeros((C + 1,), jnp.int32).at[slot_v].add(
+        jnp.where(live, jnp.int32(1), jnp.int32(0)))
+    counts = counts.at[C].set(0)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)[:-1]])
+    # rows grouped by slot; invalid rows (slot == C, the max) sort to the tail and are
+    # never addressed because counts exclude them
+    order = jnp.argsort(slot_v, stable=True).astype(jnp.int32)
+    overflow = jnp.any(valid & ~placed)
+    return table, counts, starts, order, overflow
+
+
+_multi_build_jit = jax.jit(_multi_build_step, static_argnums=(2,))
+
+
+def multi_build(capacity: int, build_page, key_channels, key_types) -> MultiJoinTable:
+    """Host-driven build with capacity-bucket growth (reference: FlatHash#rehash)."""
+    key_cols = tuple(build_page.columns[i] for i in key_channels)
+    valid = build_page.valid_mask()
+    for ch in key_channels:
+        nm = build_page.null_masks[ch]
+        if nm is not None:
+            valid = valid & ~nm
+    step = _multi_build_jit
+    while True:
+        table0 = jnp.full((capacity + 1,), EMPTY_KEY, jnp.int64)
+        table, counts, starts, order, overflow = step(table0, key_cols, key_types, valid)
+        if not bool(overflow):
+            break
+        capacity *= 4
+    return MultiJoinTable(table, counts, starts, order, build_page.columns,
+                          build_page.null_masks, overflow)
+
+
+def probe_slots(table, key_cols, key_types, valid):
+    """Gather-only probe returning (slot[int32], matched[bool]) per probe row."""
+    packed, _ = pack_keys(key_cols, key_types)
+    C = table.shape[0] - 1
+    h0 = splitmix64(packed)
+    n = packed.shape[0]
+    slot = jnp.zeros((n,), jnp.int32)
+    matched = jnp.zeros((n,), bool)
+    done = ~valid
+
+    def body(p, carry):
+        slot, matched, done = carry
+        idx = (jnp.abs(h0 + p) % C).astype(jnp.int32)
+        cur = table[idx]
+        hit = (cur == packed) & ~done
+        slot = jnp.where(hit, idx, slot)
+        matched = matched | hit
+        done = done | hit | (cur == EMPTY_KEY)
+        return slot, matched, done
+
+    slot, matched, done = jax.lax.fori_loop(0, MAX_PROBES, body, (slot, matched, done))
+    return slot, matched
+
+
+def expand_counts(incl, out_counts, size: int):
+    """Map expanded row index -> (probe row index, within-group ordinal k, in-range).
+
+    ``incl`` = inclusive cumsum of per-probe-row output counts; ``size`` is the static
+    output capacity (>= incl[-1], padded to a shape bucket by the caller)."""
+    n = incl.shape[0]
+    i = jnp.arange(size, dtype=jnp.int32)
+    pidx = jnp.clip(jnp.searchsorted(incl, i, side="right"), 0, n - 1).astype(jnp.int32)
+    excl = incl[pidx] - out_counts[pidx]
+    k = i - excl
+    in_range = i < incl[n - 1]
+    return pidx, k, in_range

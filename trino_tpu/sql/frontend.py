@@ -72,21 +72,29 @@ class Planner:
     def __init__(self, engine, session):
         self.engine = engine
         self.session = session
+        self.ctes: dict = {}  # name -> (column_aliases, Select AST)
 
     # ---------------------------------------------------------------- query planning
     def plan_query(self, q: A.Select) -> P.PlanNode:
-        rel, out_names, out_exprs_ast = self._plan_select(q)
-        node = rel.node
-        # ORDER BY: resolve against output channels (alias / ordinal / select-expr match)
-        if q.order_by:
-            keys = []
-            for s in q.order_by:
-                ch = self._resolve_output_channel(s.expr, out_names, out_exprs_ast)
-                keys.append(P.SortKey(ch, s.ascending, bool(s.nulls_first)))
-            node = P.Sort(node, tuple(keys))
-        if q.limit is not None:
-            node = P.Limit(node, q.limit)
-        return P.Output(node, tuple(out_names))
+        # WITH bindings are lexically scoped: inner definitions shadow outer ones and
+        # vanish when the scope closes (reference: StatementAnalyzer's Scope chain)
+        saved = self.ctes
+        self.ctes = {**saved, **{name: (cols, sub) for name, cols, sub in q.ctes}}
+        try:
+            rel, out_names, out_exprs_ast = self._plan_select(q)
+            node = rel.node
+            # ORDER BY: resolve against output channels (alias/ordinal/select-expr match)
+            if q.order_by:
+                keys = []
+                for s in q.order_by:
+                    ch = self._resolve_output_channel(s.expr, out_names, out_exprs_ast)
+                    keys.append(P.SortKey(ch, s.ascending, bool(s.nulls_first)))
+                node = P.Sort(node, tuple(keys))
+            if q.limit is not None:
+                node = P.Limit(node, q.limit)
+            return P.Output(node, tuple(out_names))
+        finally:
+            self.ctes = saved
 
     def _plan_select(self, q: A.Select):
         rel = self._plan_from(q)
@@ -119,7 +127,7 @@ class Planner:
                 dicts.append(d)
                 names.append(it.alias or _derive_name(it.expr, i))
             schema = Schema(tuple(Field(n, e.type) for n, e in zip(names, exprs)))
-            node = P.Project(rel.node, tuple(exprs), schema)
+            node = P.Project(rel.node, tuple(exprs), schema, tuple(dicts))
             rel = RelPlan(node, [ColumnInfo(None, n, e.type, d)
                                  for n, e, d in zip(names, exprs, dicts)])
             out_names = names
@@ -236,13 +244,16 @@ class Planner:
             neg = not neg
             c = c.operand
         if isinstance(c, A.InSubquery):
-            inner, names, _ = self._plan_select(c.query)
+            # _plan_subquery_rel applies the subquery's ORDER BY/LIMIT (a LIMITed IN-list
+            # is order-sensitive and must not build on the full table)
+            inner = self._plan_subquery_rel(c.query, None)
             if len(inner.cols) != 1:
                 raise SemanticError("IN subquery must produce one column")
             value, _ = self.translate(c.value, rel.cols)
             negated = c.negated != neg
             return self._semi_anti_join(rel, inner, [(value, ir.FieldRef(
-                0, inner.cols[0].type, inner.cols[0].name))], negated)
+                0, inner.cols[0].type, inner.cols[0].name))], negated,
+                null_aware=True)
         if isinstance(c, A.Exists):
             negated = c.negated != neg
             return self._plan_exists(c.query, rel, negated)
@@ -256,41 +267,52 @@ class Planner:
             if neg:
                 op = {"eq": "neq", "neq": "eq", "lt": "gte", "lte": "gt",
                       "gt": "lte", "gte": "lt"}[op]
-            try:  # uncorrelated: fold eagerly
-                const = self._eager_scalar(sub.query)
+            # uncorrelated subqueries fold eagerly; ONLY the correlation probe (planning)
+            # may fail over to decorrelation — cardinality/translation errors are real
+            try:
+                plan = self.plan_query(sub.query)
+            except SemanticError:
+                plan = None  # correlated: unresolvable outer references
+            if plan is not None:
+                const = self._scalar_from_plan(plan)
                 other, od = self.translate(other_ast, rel.cols)
                 t = common_super_type(other.type, const.type)
                 return RelPlan(P.Filter(rel.node, ir.Call(
                     op, (_coerce(other, t), _coerce(const, t)), BOOLEAN)),
                     rel.cols, rel.unique_sets)
-            except SemanticError:
-                pass
-            rel2, agg_ch = self._join_correlated_agg(sub.query, rel)
+            rel2, agg_expr = self._join_correlated_agg(sub.query, rel)
             other, _ = self.translate(other_ast, rel2.cols[:len(rel.cols)])
-            agg_col = rel2.cols[agg_ch]
-            t = common_super_type(other.type, agg_col.type)
-            pred = ir.Call(op, (_coerce(other, t),
-                                _coerce(ir.FieldRef(agg_ch, agg_col.type), t)), BOOLEAN)
+            t = common_super_type(other.type, agg_expr.type)
+            pred = ir.Call(op, (_coerce(other, t), _coerce(agg_expr, t)), BOOLEAN)
             return RelPlan(P.Filter(rel2.node, pred), rel2.cols, rel2.unique_sets)
         raise SemanticError(f"unsupported subquery predicate {c}")
 
-    def _semi_anti_join(self, rel: RelPlan, inner: RelPlan, pairs, negated: bool) -> RelPlan:
-        """rel ⋉/▷ inner on (outer_expr = inner_expr) pairs; build side deduplicated."""
-        # project inner to its key columns, then distinct (unique build keys)
-        key_exprs = [be for _, be in pairs]
+    def _semi_anti_join(self, rel: RelPlan, inner: RelPlan, pairs, negated: bool,
+                        null_aware: bool = False) -> RelPlan:
+        """rel ⋉/▷ inner on (outer_expr = inner_expr) pairs.
+
+        ``null_aware`` (IN/NOT IN semantics): NULLs among the build keys must make
+        NOT IN yield UNKNOWN for otherwise-unmatched rows (reference: null-aware anti
+        join in SemiJoinNode planning).  The group-by dedup erases null masks, so
+        null-aware builds skip it and let the executor's hash table dedup instead."""
+        # coerce BOTH sides to the common key type (packed-key equality is exact, so a
+        # scale/width mismatch would silently never match), project inner to its key
+        # columns, then distinct (unique build keys)
+        types = [common_super_type(pe.type, be.type) for pe, be in pairs]
+        key_exprs = [_coerce(be, t) for (_, be), t in zip(pairs, types)]
         schema = Schema(tuple(Field(f"sk{i}", e.type) for i, e in enumerate(key_exprs)))
         build = P.Project(inner.node, tuple(key_exprs), schema)
-        build = P.Aggregate(build, tuple(range(len(key_exprs))), (), schema)
+        if not null_aware:
+            build = P.Aggregate(build, tuple(range(len(key_exprs))), (), schema)
         probe_node = rel.node
         pkeys, bkeys = [], []
-        for i, (pe, be) in enumerate(pairs):
-            t = common_super_type(pe.type, be.type)
+        for i, ((pe, _), t) in enumerate(zip(pairs, types)):
             pch, probe_node = _ensure_channel(probe_node, _coerce(pe, t), rel.cols)
             pkeys.append(pch)
             bkeys.append(i)
         kind = "anti" if negated else "semi"
         join = P.Join(kind, probe_node, build, tuple(pkeys), tuple(bkeys),
-                      probe_node.schema)
+                      probe_node.schema, null_aware=null_aware)
         # semi/anti output keeps all probe channels (incl. any helper join-key channels;
         # harmless — downstream refers to the original ones)
         cols = list(rel.cols) + [ColumnInfo(None, f.name, f.type)
@@ -298,22 +320,52 @@ class Planner:
         return RelPlan(join, cols, rel.unique_sets)
 
     def _plan_exists(self, q: A.Select, rel: RelPlan, negated: bool) -> RelPlan:
+        if q.having is not None:
+            raise SemanticError("HAVING inside correlated EXISTS not supported yet")
+        if q.limit == 0:
+            # EXISTS (... LIMIT 0) is constant-false
+            keep = negated
+            return rel if keep else RelPlan(
+                P.Filter(rel.node, ir.Constant(False, BOOLEAN)), rel.cols, rel.unique_sets)
+        if not q.group_by:
+            aggs: list = []
+            for it in q.items:
+                if not isinstance(it.expr, A.Star):
+                    _collect_aggs(it.expr, aggs)
+            if aggs:
+                # an ungrouped aggregate query yields exactly one row regardless of
+                # input: EXISTS is constant-true
+                keep = not negated
+                return rel if keep else RelPlan(
+                    P.Filter(rel.node, ir.Constant(False, BOOLEAN)),
+                    rel.cols, rel.unique_sets)
+        # GROUP BY without HAVING does not change row existence; drop it below
         inner_cols = self._inner_columns(q.from_)
-        inner_only, corr_pairs_ast = [], []
+        inner_only, corr_pairs_ast, residual_ast = [], [], []
         for cj in _split_conjuncts(q.where):
             if self._resolves(cj, inner_cols):
                 inner_only.append(cj)
                 continue
             pair = self._split_correlated_equi(cj, rel.cols, inner_cols)
             if pair is None:
-                raise SemanticError(f"unsupported correlated predicate {cj}")
+                residual_ast.append(cj)
+                continue
             corr_pairs_ast.append(pair)
+        if residual_ast:
+            # non-equi correlated predicates (Q21's l2.l_suppkey <> l1.l_suppkey) ride the
+            # join as a residual match filter over probe+build channels; the build side
+            # stays un-deduplicated (every inner row is a match candidate)
+            if not corr_pairs_ast:
+                raise SemanticError("correlated EXISTS without an equi conjunct")
+            inner_rel = self._plan_from(dataclasses.replace(q, where=_and_all(inner_only)))
+            return self._semi_anti_join_residual(rel, inner_rel, corr_pairs_ast,
+                                                 residual_ast, negated)
         if not corr_pairs_ast:
             # uncorrelated EXISTS: evaluate once
             sub = dataclasses.replace(q, items=(A.SelectItem(A.NumberLit("1"), None),),
                                       where=_and_all(inner_only), limit=1,
                                       order_by=(), group_by=q.group_by)
-            res = self.engine.execute_plan(self.plan_query(sub))
+            res = self.engine.execute_plan(self.plan_query(sub), cache=False)
             exists = len(res) > 0
             keep = exists != negated
             if keep:
@@ -331,6 +383,35 @@ class Planner:
             pairs.append((oe, ir.FieldRef(i, c.type, c.name)))
         return self._semi_anti_join(rel, inner_rel, pairs, negated)
 
+    def _semi_anti_join_residual(self, rel: RelPlan, inner_rel: RelPlan, pairs_ast,
+                                 residual_ast, negated: bool) -> RelPlan:
+        """Semi/anti join with per-candidate residual filter (reference:
+        JoinFilterFunction on semijoins; executed by the multi-match probe)."""
+        probe_node, build_node = rel.node, inner_rel.node
+        pkeys, bkeys = [], []
+        for outer_ast, inner_ast in pairs_ast:
+            oe, _ = self.translate(outer_ast, rel.cols)
+            be, _ = self.translate(inner_ast, inner_rel.cols)
+            t = common_super_type(oe.type, be.type)
+            pch, probe_node = _ensure_channel(probe_node, _coerce(oe, t), rel.cols)
+            bch, build_node = _ensure_channel(build_node, _coerce(be, t), inner_rel.cols)
+            pkeys.append(pch)
+            bkeys.append(bch)
+        probe_cols = list(rel.cols) + [ColumnInfo(None, "", f.type)
+                                       for f in probe_node.schema.fields[len(rel.cols):]]
+        build_cols = list(inner_rel.cols) + [
+            ColumnInfo(None, "", f.type)
+            for f in build_node.schema.fields[len(inner_rel.cols):]]
+        comb = probe_cols + build_cols
+        filt = None
+        for c in residual_ast:
+            e, _ = self.translate(c, comb)
+            filt = e if filt is None else ir.Call("and", (filt, e), BOOLEAN)
+        kind = "anti" if negated else "semi"
+        join = P.Join(kind, probe_node, build_node, tuple(pkeys), tuple(bkeys),
+                      probe_node.schema, filter=filt)
+        return RelPlan(join, probe_cols, rel.unique_sets)
+
     def _inner_columns(self, from_) -> list:
         """Column scope of a subquery's FROM without planning its joins."""
         relations, explicit = [], []
@@ -339,25 +420,37 @@ class Planner:
         for r, _ in relations:
             cols.extend(r.cols)
         for j in explicit:
-            for side in (j.left, j.right):
-                if not isinstance(side, A.JoinRef):
-                    cols.extend(self._plan_relation(side).cols)
+            cols.extend(self._join_ref_columns(j))
+        return cols
+
+    def _join_ref_columns(self, j: A.JoinRef) -> list:
+        """All leaf-relation columns under a (possibly nested) explicit-join tree."""
+        cols = []
+        for side in (j.left, j.right):
+            if isinstance(side, A.JoinRef):
+                cols.extend(self._join_ref_columns(side))
+            else:
+                cols.extend(self._plan_relation(side).cols)
         return cols
 
     def _resolves(self, ast, cols) -> bool:
         return self._try_translate(ast, cols) is not None
 
     def _split_correlated_equi(self, cj, outer_cols, inner_cols):
-        """a = b with one side outer, one side inner -> (outer_ast, inner_ast)."""
+        """a = b with one side outer, one side inner -> (outer_ast, inner_ast).
+
+        SQL scoping: a name resolvable in the inner scope binds there even if the outer
+        scope also has it (StatementAnalyzer's scope chain) — so the inner-resolvable side
+        is the inner one, and the other side must resolve in the outer scope."""
         if not (isinstance(cj, A.BinaryOp) and cj.op == "eq"):
             return None
         l_inner = self._resolves(cj.left, inner_cols)
         r_inner = self._resolves(cj.right, inner_cols)
         l_outer = self._resolves(cj.left, outer_cols)
         r_outer = self._resolves(cj.right, outer_cols)
-        if l_inner and not l_outer and r_outer and not r_inner:
+        if l_inner and not r_inner and r_outer:
             return (cj.right, cj.left)
-        if r_inner and not r_outer and l_outer and not l_inner:
+        if r_inner and not l_inner and l_outer:
             return (cj.left, cj.right)
         return None
 
@@ -367,7 +460,10 @@ class Planner:
         (The reference plans these as joins — EnforceSingleRowNode; eager evaluation is
         equivalent for uncorrelated subqueries and keeps fragments simple.)"""
         plan = self.plan_query(q)  # raises SemanticError if correlated (unresolved cols)
-        res = self.engine.execute_plan(plan)
+        return self._scalar_from_plan(plan)
+
+    def _scalar_from_plan(self, plan) -> ir.Constant:
+        res = self.engine.execute_plan(plan, cache=False)
         if len(res) != 1 or len(res.columns) != 1:
             raise SemanticError("scalar subquery must return exactly one value")
         t = res.types[0]
@@ -376,10 +472,22 @@ class Planner:
 
     def _join_correlated_agg(self, q: A.Select, rel: RelPlan):
         """Decorrelate `(select agg(..) from .. where inner.k = outer.k and ..)`:
-        plan the inner as GROUP BY its correlation keys, inner-join on them.
-        Returns (joined rel, channel of the aggregate value)."""
+        plan the inner as GROUP BY its correlation keys, LEFT-join on them (an outer
+        row with an empty group must see the aggregate over an empty input: NULL for
+        sum/avg/min/max — which any comparison rejects — and 0 for count; reference:
+        TransformCorrelatedScalarAggregationToJoin + AggregationNode default values).
+        Returns (joined rel, ir expression for the aggregate value)."""
         if len(q.items) != 1 or q.group_by:
             raise SemanticError("unsupported correlated subquery shape")
+        item_expr = q.items[0].expr
+        item_aggs: list = []
+        _collect_aggs(item_expr, item_aggs)
+        is_bare_count = (isinstance(item_expr, A.FuncCall) and item_expr.name == "count")
+        if any(a.name == "count" for a in item_aggs) and not is_bare_count:
+            # count nested inside a larger expression: the empty-group value would be
+            # expr(count=0, ...) which NULL-propagation cannot reproduce
+            raise SemanticError(
+                "correlated subquery mixing count() into an expression not supported yet")
         inner_cols = self._inner_columns(q.from_)
         inner_only, corr_pairs_ast = [], []
         for cj in _split_conjuncts(q.where):
@@ -395,7 +503,7 @@ class Planner:
         inner_sel = dataclasses.replace(
             q,
             items=tuple(A.SelectItem(ia, f"ck{i}") for i, (_, ia) in enumerate(corr_pairs_ast))
-            + (A.SelectItem(q.items[0].expr, "aggv"),),
+            + (A.SelectItem(q.items[0].expr, "#aggv"),),  # '#' keeps it un-referenceable
             where=_and_all(inner_only),
             group_by=tuple(ia for _, ia in corr_pairs_ast),
             having=None, order_by=(), limit=None)
@@ -405,9 +513,17 @@ class Planner:
             oe, _ = self.translate(outer_ast, rel.cols)
             c = inner_rel.cols[i]
             eqs.append((oe, ir.FieldRef(i, c.type, c.name)))
-        joined = self._make_join("inner", rel, inner_rel, eqs)
-        agg_ch = len(rel.cols) + len(corr_pairs_ast)
-        return joined, agg_ch
+        joined = self._make_join("left", rel, inner_rel, eqs)
+        # locate the aggregate channel by name: _make_join may have appended helper
+        # channels to the probe side (computed/coerced correlation keys), shifting the
+        # build-side columns right
+        agg_ch = next(i for i, c in enumerate(joined.cols) if c.name == "#aggv")
+        agg_col = joined.cols[agg_ch]
+        agg_expr: ir.Expr = ir.FieldRef(agg_ch, agg_col.type)
+        if is_bare_count:
+            agg_expr = ir.Call("coalesce",
+                               (agg_expr, ir.Constant(0, agg_col.type)), agg_col.type)
+        return joined, agg_expr
 
     def _flatten_from(self, node, relations, explicit_joins):
         if isinstance(node, A.JoinRef):
@@ -435,17 +551,44 @@ class Planner:
                 residual.append(c)
         if not eqs:
             raise SemanticError("non-equi explicit join not supported yet")
+        if node.kind == "left":
+            # ON residuals are match conditions, not post-filters, for outer joins.
+            # Build-side-only conjuncts push below the join (a build row failing one can
+            # never match — reference: PredicatePushDown's outer-join inner-side push);
+            # the rest become the join's residual match filter.
+            push, keep = [], []
+            for c in residual:
+                (push if self._resolves(c, right.cols) else keep).append(c)
+            for c in push:
+                e, _ = self.translate(c, right.cols)
+                right = RelPlan(P.Filter(right.node, e), right.cols, right.unique_sets)
+            rel = self._make_join("left", left, right, eqs)
+            if keep:
+                filt = None
+                for c in keep:
+                    e, _ = self.translate(c, rel.cols)
+                    filt = e if filt is None else ir.Call("and", (filt, e), BOOLEAN)
+                rel = RelPlan(dataclasses.replace(rel.node, filter=filt), rel.cols,
+                              rel.unique_sets)
+            return rel
         rel = self._make_join(node.kind, left, right, eqs)
         out = rel.node
         for c in residual:
             e, _ = self.translate(c, rel.cols)
             out = P.Filter(out, e)
-        return RelPlan(out, rel.cols)
+        return RelPlan(out, rel.cols, rel.unique_sets)
 
     def _plan_relation(self, node) -> RelPlan:
         if isinstance(node, A.TableRef):
             catalog = self.session.catalog or "tpch"
             name = node.name[-1]
+            if len(node.name) == 1:
+                # CTE / view expansion (reference: StatementAnalyzer WITH resolution +
+                # view expansion in analyzeView)
+                view = self.ctes.get(name) or getattr(self.engine, "views", {}).get(name)
+                if view is not None:
+                    cols, sub = view
+                    return self._plan_subquery_rel(sub, node.alias or name, cols)
             conn = self.engine.catalogs.get(node.name[0], None)
             if conn is not None and len(node.name) > 1:
                 catalog = node.name[0]
@@ -465,22 +608,35 @@ class Planner:
                     pass
             return RelPlan(scan, cols, unique_sets)
         if isinstance(node, A.SubqueryRef):
-            rel, out_names, _ = self._plan_select(node.query)
-            sub = node.query
-            plan_node = rel.node
-            if sub.order_by:
-                keys = []
-                for s in sub.order_by:
-                    ch = self._resolve_output_channel(s.expr, out_names, [None] * len(out_names))
-                    keys.append(P.SortKey(ch, s.ascending, bool(s.nulls_first)))
-                plan_node = P.Sort(plan_node, tuple(keys))
-            if sub.limit is not None:
-                plan_node = P.Limit(plan_node, sub.limit)
-            alias = node.alias
-            cols = [ColumnInfo(alias, n, c.type, c.dict)
-                    for n, c in zip(out_names, rel.cols)]
-            return RelPlan(plan_node, cols)
+            return self._plan_subquery_rel(node.query, node.alias, node.columns)
         raise SemanticError(f"unsupported relation {node}")
+
+    def _plan_subquery_rel(self, sub: A.Select, alias, columns=()) -> RelPlan:
+        saved = self.ctes
+        self.ctes = {**saved, **{name: (cols_, s) for name, cols_, s in sub.ctes}}
+        try:
+            return self._plan_subquery_rel_inner(sub, alias, columns)
+        finally:
+            self.ctes = saved
+
+    def _plan_subquery_rel_inner(self, sub: A.Select, alias, columns=()) -> RelPlan:
+        rel, out_names, _ = self._plan_select(sub)
+        plan_node = rel.node
+        if sub.order_by:
+            keys = []
+            for s in sub.order_by:
+                ch = self._resolve_output_channel(s.expr, out_names, [None] * len(out_names))
+                keys.append(P.SortKey(ch, s.ascending, bool(s.nulls_first)))
+            plan_node = P.Sort(plan_node, tuple(keys))
+        if sub.limit is not None:
+            plan_node = P.Limit(plan_node, sub.limit)
+        if columns:
+            if len(columns) != len(out_names):
+                raise SemanticError("column alias list length mismatch")
+            out_names = list(columns)
+        cols = [ColumnInfo(alias, n, c.type, c.dict)
+                for n, c in zip(out_names, rel.cols)]
+        return RelPlan(plan_node, cols)
 
     def _estimate_rows(self, node) -> int:
         if isinstance(node, A.TableRef):
@@ -507,7 +663,8 @@ class Planner:
             return (r_in_left, l_in_right)
         return None
 
-    def _make_join(self, kind, probe: RelPlan, build: RelPlan, eqs) -> RelPlan:
+    def _make_join(self, kind, probe: RelPlan, build: RelPlan, eqs,
+                   filter_expr=None) -> RelPlan:
         probe_node, build_node = probe.node, build.node
         pkeys, bkeys = [], []
         for pe, be in eqs:
@@ -518,12 +675,19 @@ class Planner:
             bch, build_node = _ensure_channel(build_node, be, build.cols)
             pkeys.append(pch)
             bkeys.append(bch)
+        # computed join keys append helper channels to either side: the runtime emits the
+        # full child schemas, so planner-side cols must cover them (anonymous, unresolvable)
+        probe_cols = list(probe.cols) + [ColumnInfo(None, "", f.type)
+                                         for f in probe_node.schema.fields[len(probe.cols):]]
+        build_cols = list(build.cols) + [ColumnInfo(None, "", f.type)
+                                         for f in build_node.schema.fields[len(build.cols):]]
         schema = Schema(tuple(
-            [Field(f"l{i}", c.type) for i, c in enumerate(probe.cols)]
-            + [Field(f"r{i}", c.type) for i, c in enumerate(build.cols)]
+            [Field(f"l{i}", c.type) for i, c in enumerate(probe_cols)]
+            + [Field(f"r{i}", c.type) for i, c in enumerate(build_cols)]
         ))
-        node = P.Join(kind, probe_node, build_node, tuple(pkeys), tuple(bkeys), schema)
-        cols = list(probe.cols) + list(build.cols)
+        node = P.Join(kind, probe_node, build_node, tuple(pkeys), tuple(bkeys), schema,
+                      filter=filter_expr)
+        cols = probe_cols + build_cols
         # a many-to-one join preserves probe-row multiplicity -> probe unique sets survive
         return RelPlan(node, cols, list(probe.unique_sets))
 
@@ -555,25 +719,58 @@ class Planner:
             if a not in uniq_aggs:
                 uniq_aggs.append(a)
 
-        proj_exprs = list(key_exprs)
-        specs = []
-        for j, a in enumerate(uniq_aggs):
-            kind, arg_ast = _agg_kind(a)
-            if arg_ast is None:
-                specs.append(P.AggSpec("count_star", None, f"agg{j}", BIGINT))
-            else:
-                e, _ = self.translate(arg_ast, rel.cols)
-                ch = len(proj_exprs)
-                proj_exprs.append(e)
-                specs.append(P.AggSpec(kind, ir.FieldRef(ch, e.type), f"agg{j}",
-                                       _agg_type(kind, e.type)))
-        proj_schema = Schema(tuple(Field(f"c{i}", e.type) for i, e in enumerate(proj_exprs)))
-        proj = P.Project(rel.node, tuple(proj_exprs), proj_schema)
-        agg_schema = Schema(tuple(
-            [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
-            + [Field(s.name, s.type) for s in specs]
-        ))
-        agg = P.Aggregate(proj, tuple(range(len(key_exprs))), tuple(specs), agg_schema)
+        # DISTINCT aggregates (min/max ignore distinct): rewrite agg(distinct x) GROUP BY k
+        # into a pre-aggregation on (k, x) followed by plain agg(x) GROUP BY k (reference:
+        # iterative/rule/SingleDistinctAggregationToGroupBy.java)
+        distinct_aggs = [a for a in uniq_aggs
+                         if a.distinct and a.name not in ("min", "max")]
+        if distinct_aggs:
+            if len(uniq_aggs) != len(distinct_aggs) or \
+                    len({a.args for a in distinct_aggs}) != 1:
+                raise SemanticError(
+                    "mixed distinct/non-distinct or multi-argument distinct aggregates "
+                    "not supported yet")
+            arg_ast = distinct_aggs[0].args[0]
+            de, _ = self.translate(arg_ast, rel.cols)
+            proj_exprs = list(key_exprs) + [de]
+            proj_schema = Schema(tuple(Field(f"c{i}", e.type)
+                                       for i, e in enumerate(proj_exprs)))
+            proj = P.Project(rel.node, tuple(proj_exprs), proj_schema,
+                             tuple(key_dicts) + (None,))
+            dist = P.Aggregate(proj, tuple(range(len(proj_exprs))), (), proj_schema)
+            specs = []
+            for j, a in enumerate(uniq_aggs):
+                kind, _ = _agg_kind(a)
+                specs.append(P.AggSpec(kind, ir.FieldRef(len(key_exprs), de.type),
+                                       f"agg{j}", _agg_type(kind, de.type)))
+            agg_schema = Schema(tuple(
+                [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
+                + [Field(s.name, s.type) for s in specs]
+            ))
+            agg = P.Aggregate(dist, tuple(range(len(key_exprs))), tuple(specs), agg_schema)
+        else:
+            proj_exprs = list(key_exprs)
+            specs = []
+            for j, a in enumerate(uniq_aggs):
+                kind, arg_ast = _agg_kind(a)
+                if arg_ast is None:
+                    specs.append(P.AggSpec("count_star", None, f"agg{j}", BIGINT))
+                else:
+                    e, _ = self.translate(arg_ast, rel.cols)
+                    ch = len(proj_exprs)
+                    proj_exprs.append(e)
+                    specs.append(P.AggSpec(kind, ir.FieldRef(ch, e.type), f"agg{j}",
+                                           _agg_type(kind, e.type)))
+            proj_schema = Schema(tuple(Field(f"c{i}", e.type)
+                                       for i, e in enumerate(proj_exprs)))
+            proj = P.Project(rel.node, tuple(proj_exprs), proj_schema,
+                             tuple(key_dicts)
+                             + tuple(None for _ in range(len(proj_exprs) - len(key_exprs))))
+            agg_schema = Schema(tuple(
+                [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
+                + [Field(s.name, s.type) for s in specs]
+            ))
+            agg = P.Aggregate(proj, tuple(range(len(key_exprs))), tuple(specs), agg_schema)
         agg_cols = ([ColumnInfo(None, f"k{i}", e.type, d)
                      for i, (e, d) in enumerate(zip(key_exprs, key_dicts))]
                     + [ColumnInfo(None, s.name, s.type, None) for s in specs])
@@ -588,13 +785,14 @@ class Planner:
             out_exprs.append(post.translate(it.expr))
             out_names.append(it.alias or _derive_name(it.expr, i))
         out_schema = Schema(tuple(Field(n, e.type) for n, e in zip(out_names, out_exprs)))
-        node = P.Project(node, tuple(out_exprs), out_schema)
         cols = []
         for n, e in zip(out_names, out_exprs):
             d = None
             if isinstance(e, ir.FieldRef):
                 d = agg_cols[e.index].dict
             cols.append(ColumnInfo(None, n, e.type, d))
+        node = P.Project(node, tuple(out_exprs), out_schema,
+                         tuple(c.dict for c in cols))
         # remap unique key channels through the output projection
         out_unique = []
         for u in agg_unique:
@@ -797,6 +995,21 @@ class Planner:
             for a in args[1:]:
                 t = common_super_type(t, a.type)
             return ir.Call("coalesce", tuple(_coerce(a, t) for a in args), t), None
+        if name == "substring":
+            # string functions over dictionary columns compile to an id->id lookup table
+            # plus a derived dictionary (planner-side; device only maps ids — the
+            # dictionary-processing analog of DictionaryAwarePageProjection.java)
+            v, d = self._translate(ast.args[0], cols)
+            if d is None or d.values is None:
+                raise SemanticError("substring requires an enumerable dictionary column")
+            if not all(isinstance(a, A.NumberLit) for a in ast.args[1:]):
+                raise SemanticError("substring start/length must be literals")
+            start = int(ast.args[1].text)
+            length = int(ast.args[2].text) if len(ast.args) > 2 else None
+            end = None if length is None else start - 1 + length
+            lut, nd = d.map_values(lambda s: s[start - 1:end])
+            t = VarcharType.of(length)
+            return ir.Call("lut", (v, ir.Constant(lut, t)), t), nd
         raise SemanticError(f"function {name} not supported")
 
     # ---------------------------------------------------------------- output resolution

@@ -170,6 +170,7 @@ class TableRef(Node):
 class SubqueryRef(Node):
     query: "Select"
     alias: Optional[str]
+    columns: tuple = ()  # derived-table column alias list: x (a, b, ...)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -197,6 +198,7 @@ class Select(Node):
     order_by: tuple
     limit: Optional[int]
     distinct: bool = False
+    ctes: tuple = ()  # WITH clause: ((name, column_aliases, Select), ...)
 
 
 # ----------------------------------------------------------------------------- lexer
@@ -287,11 +289,54 @@ class Parser:
 
     # entry
     def parse_statement(self) -> Select:
+        ctes = []
+        if self.accept("with"):
+            while True:
+                name = self.expect_kind("ident").value
+                cols = self._column_alias_list()
+                self.expect("as")
+                self.expect("(")
+                sub = self.parse_select()
+                self.expect(")")
+                ctes.append((name, cols, sub))
+                if not self.accept(","):
+                    break
         q = self.parse_select()
+        if ctes:
+            q = dataclasses.replace(q, ctes=tuple(ctes))
         self.accept(";")
         if self.peek().kind != "eof":
             raise ParseError(f"trailing input at pos {self.peek().pos}: {self.peek().value!r}")
         return q
+
+    def _column_alias_list(self) -> tuple:
+        if not (self.peek().kind == "op" and self.peek().value == "("
+                and self.peek(1).kind == "ident" and self.peek(2).kind == "op"
+                and self.peek(2).value in (",", ")")):
+            return ()
+        self.next()
+        cols = [self.expect_kind("ident").value]
+        while self.accept(","):
+            cols.append(self.expect_kind("ident").value)
+        self.expect(")")
+        return tuple(cols)
+
+    def parse_subquery(self) -> Select:
+        """A parenthesized query body (SELECT, optionally with its own WITH clause)."""
+        ctes = []
+        if self.accept("with"):
+            while True:
+                name = self.expect_kind("ident").value
+                cols = self._column_alias_list()
+                self.expect("as")
+                self.expect("(")
+                sub = self.parse_select()
+                self.expect(")")
+                ctes.append((name, cols, sub))
+                if not self.accept(","):
+                    break
+        q = self.parse_select()
+        return dataclasses.replace(q, ctes=tuple(ctes)) if ctes else q
 
     def parse_select(self) -> Select:
         self.expect("select")
@@ -371,11 +416,12 @@ class Parser:
 
     def parse_table_primary(self) -> Node:
         if self.accept("("):
-            if self.peek().value == "select":
-                q = self.parse_select()
+            if self.peek().value in ("select", "with"):
+                q = self.parse_subquery()
                 self.expect(")")
                 alias = self._table_alias()
-                return SubqueryRef(q, alias)
+                cols = self._column_alias_list() if alias else ()
+                return SubqueryRef(q, alias, cols)
             ref = self.parse_table_ref()
             self.expect(")")
             return ref

@@ -55,11 +55,17 @@ class Filter(PlanNode):
 
 @dataclasses.dataclass(frozen=True)
 class Project(PlanNode):
-    """reference: sql/planner/plan/ProjectNode.java"""
+    """reference: sql/planner/plan/ProjectNode.java
+
+    ``dicts``: optional planner-resolved Dictionary per output channel (None entries =
+    derive from child for plain FieldRefs).  Dictionary-typed projections (substring and
+    friends compile to id->id lookup tables) produce NEW dictionaries only the planner
+    knows — the executor's channel-level dictionary tracking reads them from here."""
 
     child: PlanNode
     exprs: tuple  # Expr per output channel
     schema: Schema
+    dicts: tuple = ()
 
     @property
     def children(self):
@@ -147,6 +153,7 @@ class Join(PlanNode):
     schema: Schema  # left fields then right fields (semi/anti: left only)
     filter: Optional[Expr] = None  # over concatenated channels
     distribution: str = "replicated"
+    null_aware: bool = False  # IN/NOT IN 3VL semantics (NULL build keys -> UNKNOWN)
 
     @property
     def children(self):
