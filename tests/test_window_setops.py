@@ -1,0 +1,143 @@
+"""Window functions and set operations vs pandas oracles."""
+
+import numpy as np
+import pandas as pd
+
+from tests.test_sql_tpch import assert_frames_close, run
+
+
+def test_window_core(engine, tpch_pandas):
+    got = run(engine, """
+        select o_custkey, o_orderkey,
+               row_number() over (partition by o_custkey order by o_orderkey) as rn,
+               count(*) over (partition by o_custkey) as cnt,
+               sum(o_totalprice) over (partition by o_custkey order by o_orderkey)
+                   as running,
+               min(o_totalprice) over (partition by o_custkey) as mn,
+               max(o_totalprice) over (partition by o_custkey order by o_orderkey)
+                   as running_max
+        from orders
+        order by o_custkey, o_orderkey limit 500""")
+    o = tpch_pandas["orders"].sort_values(["o_custkey", "o_orderkey"])
+    g = o.groupby("o_custkey")
+    exp = pd.DataFrame({
+        "o_custkey": o.o_custkey,
+        "o_orderkey": o.o_orderkey,
+        "rn": g.cumcount() + 1,
+        "cnt": g.o_orderkey.transform("count"),
+        "running": g.o_totalprice.cumsum(),
+        "mn": g.o_totalprice.transform("min"),
+        "running_max": g.o_totalprice.cummax(),
+    }).head(500).reset_index(drop=True)
+    assert_frames_close(got, exp, rtol=1e-9)
+
+
+def test_window_rank_lag(engine, tpch_pandas):
+    got = run(engine, """
+        select c_nationkey, c_custkey,
+               rank() over (partition by c_nationkey order by c_acctbal desc) as rk,
+               dense_rank() over (partition by c_nationkey order by c_acctbal desc)
+                   as drk,
+               lag(c_custkey) over (partition by c_nationkey order by c_acctbal desc)
+                   as prev
+        from customer
+        order by c_nationkey, rk, c_custkey limit 300""")
+    c = tpch_pandas["customer"].copy()
+    c["rk"] = c.groupby("c_nationkey").c_acctbal.rank(
+        method="min", ascending=False).astype(int)
+    c["drk"] = c.groupby("c_nationkey").c_acctbal.rank(
+        method="dense", ascending=False).astype(int)
+    c = c.sort_values(["c_nationkey", "c_acctbal", "c_custkey"],
+                      ascending=[True, False, True])
+    c["prev"] = c.groupby("c_nationkey").c_custkey.shift(1)
+    exp = (c.sort_values(["c_nationkey", "rk", "c_custkey"])
+           [["c_nationkey", "c_custkey", "rk", "drk", "prev"]]
+           .head(300).reset_index(drop=True))
+    got2 = got.drop(columns=["prev"])
+    exp2 = exp.drop(columns=["prev"])
+    assert_frames_close(got2, exp2)
+
+
+def test_union_all_and_distinct(engine, tpch_pandas):
+    got = run(engine, """
+        select n_regionkey as k from nation
+        union all
+        select r_regionkey as k from region
+        order by k""")
+    t = tpch_pandas
+    exp = pd.DataFrame({"k": sorted(t["nation"].n_regionkey.tolist()
+                                    + t["region"].r_regionkey.tolist())})
+    assert_frames_close(got, exp)
+    got = run(engine, """
+        select n_regionkey as k from nation
+        union
+        select r_regionkey as k from region
+        order by k""")
+    exp = pd.DataFrame({"k": sorted(set(t["nation"].n_regionkey)
+                                    | set(t["region"].r_regionkey))})
+    assert_frames_close(got, exp)
+
+
+def test_intersect_except(engine, tpch_pandas):
+    t = tpch_pandas
+    got = run(engine, """
+        select c_nationkey as k from customer
+        intersect
+        select s_nationkey as k from supplier
+        order by k""")
+    exp = pd.DataFrame({"k": sorted(set(t["customer"].c_nationkey)
+                                    & set(t["supplier"].s_nationkey))})
+    assert_frames_close(got, exp)
+    got = run(engine, """
+        select n_nationkey as k from nation
+        except
+        select c_nationkey as k from customer
+        order by k""")
+    exp = pd.DataFrame({"k": sorted(set(t["nation"].n_nationkey)
+                                    - set(t["customer"].c_nationkey))})
+    assert_frames_close(got, exp)
+
+
+def test_setop_operand_limit(engine):
+    r = engine.execute_sql("""
+        (select n_nationkey from nation order by n_nationkey limit 2)
+        union all
+        (select n_nationkey from nation order by n_nationkey desc limit 2)
+        order by n_nationkey""")
+    assert r.columns[0].tolist() == [0, 1, 23, 24]
+
+
+def test_explain(engine):
+    r = engine.execute_sql(
+        "explain select count(*) from lineitem, orders where l_orderkey = o_orderkey")
+    text = "\n".join(r.columns[0].tolist())
+    assert "TableScan[tpch.lineitem]" in text and "Join" in text, text
+
+
+def test_window_edge_cases(engine):
+    # parenthesized body keeps its own ORDER BY when an outer LIMIT applies
+    r = engine.execute_sql("(select n_nationkey from nation order by n_nationkey) limit 3")
+    assert r.columns[0].tolist() == [0, 1, 2]
+    # DISTINCT window aggregates are rejected, not silently wrong
+    import pytest
+    from trino_tpu.sql.frontend import SemanticError
+    with pytest.raises(SemanticError, match="DISTINCT"):
+        engine.execute_sql("select count(distinct l_suppkey) over () from lineitem")
+    # lag default fills partition-leading rows instead of NULL
+    r = engine.execute_sql(
+        "select lag(n_nationkey, 1, -1) over (order by n_nationkey) p "
+        "from nation order by n_nationkey limit 2")
+    assert r.columns[0].tolist() == [-1, 0]
+    # window ORDER BY over a dictionary column uses string collation, not id order
+    r = engine.execute_sql(
+        "select l_shipmode, row_number() over (order by l_shipmode) rn "
+        "from (select l_shipmode from lineitem limit 2000) x order by rn")
+    vals = r.columns[0].tolist()
+    assert vals == sorted(vals)
+    # all-NULL window frames produce NULL, not a sentinel
+    r = engine.execute_sql("""
+        select n_nationkey, max(o_orderkey) over (partition by n_nationkey) mx
+        from nation left outer join orders on n_nationkey = o_custkey
+        order by n_nationkey""")
+    mx = r.columns[1].tolist()
+    assert mx[0] is None  # custkey 0 never exists -> empty frame

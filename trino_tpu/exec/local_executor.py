@@ -17,6 +17,7 @@ between sources and sinks is streaming.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Callable, Optional
 
 import jax
@@ -112,6 +113,8 @@ class LocalExecutor:
             return _limit_page(child, node.count), dicts
         if isinstance(node, P.Aggregate):
             return self._run_aggregate(node)
+        if isinstance(node, P.Window):
+            return self._run_window(node)
         # streaming leaf reached directly (scan/filter/project/join-probe): materialize
         stream = self._compile_stream(node)
         return _concat_stream(stream), stream.dicts
@@ -174,12 +177,25 @@ class LocalExecutor:
         if isinstance(node, P.Join):
             return self._compile_join(node)
 
+        if isinstance(node, P.Union):
+            subs = [self._compile_stream(c) for c in node.inputs]
+
+            def pages(subs=subs, node=node):
+                for s in subs:
+                    jt = s.jitted()
+                    for pg in s.pages():
+                        cols, nulls, valid = jt(pg)
+                        yield Page(node.schema, cols, nulls, valid)
+
+            dicts = subs[0].dicts
+            return _Stream(node.schema, dicts, pages, lambda c, n, v: (c, n, v))
+
         if isinstance(node, P.Values):
             page = _values_page(node)
             return _Stream(node.schema, tuple(None for _ in node.schema.fields),
                            lambda: iter([page]), lambda c, n, v: (c, n, v))
 
-        if isinstance(node, (P.Aggregate, P.Sort, P.Limit, P.Output)):
+        if isinstance(node, (P.Aggregate, P.Sort, P.Limit, P.Output, P.Window)):
             # blocking sub-plan feeding a streaming consumer: run it, emit its one page
             page, dicts = self._execute_to_page(node)
 
@@ -241,11 +257,15 @@ class LocalExecutor:
                 break
             capacity *= 4  # next capacity bucket (reference: FlatHash#rehash)
 
-        occupied, keys, accs = hashagg.agg_finalize(state)
-        occ = np.asarray(occupied)
-        key_cols = [np.asarray(k)[occ] for k in keys]
-        acc_cols = [np.asarray(a)[occ] for a in accs]
-        out_cols = key_cols + _finalize_aggs(node.aggs, acc_cols, len(occ.nonzero()[0]))
+        # compact occupied groups ON DEVICE before any host transfer: the table is
+        # capacity-sized but group counts are usually tiny, and device->host bandwidth
+        # (not FLOPs) dominates on tunneled links
+        n_groups = int(hashagg.group_count(state))
+        bucket = max(1 << max(n_groups - 1, 1).bit_length(), 64)
+        keys, accs = hashagg.compact_groups(state, bucket)
+        key_cols = [np.asarray(k[:n_groups]) for k in keys]
+        acc_cols = [np.asarray(a[:n_groups]) for a in accs]
+        out_cols = key_cols + _finalize_aggs(node.aggs, acc_cols, n_groups)
         arrays = [jnp.asarray(c) for c in out_cols]
         page = Page(node.schema, tuple(arrays), tuple(None for _ in arrays), None)
         dicts = tuple(stream.dicts[i] for i in node.keys) + tuple(None for _ in node.aggs)
@@ -304,6 +324,35 @@ class LocalExecutor:
         arrays = [jnp.asarray(c) for c in out_cols]
         page = Page(node.schema, tuple(arrays), tuple(None for _ in arrays), None)
         return page, tuple(None for _ in node.aggs)
+
+    # -- window functions ----------------------------------------------------
+    def _run_window(self, node: P.Window):
+        """Blocking window evaluation: materialize, sort, segmented scans, scatter back
+        (ops/window.py; reference: WindowOperator over a sorted PagesIndex)."""
+        page, dicts = self._execute_to_page_streamed(node.child)
+        n = page.capacity
+        spec_dicts = tuple(
+            dicts[s.arg] if s.kind in ("min", "max", "lag", "lead", "first_value",
+                                       "last_value") and s.arg is not None else None
+            for s in node.specs)
+        if n == 0:
+            cols = tuple(page.columns) + tuple(
+                jnp.zeros((0,), s.type.dtype) for s in node.specs)
+            return (Page(node.schema, cols,
+                         tuple(page.null_masks) + tuple(None for _ in node.specs), None),
+                    tuple(dicts) + spec_dicts)
+
+        hit = self._agg_cache.get(("window", id(node)))
+        if hit is None:
+            kernel = jax.jit(lambda cols, nulls, specs=node.specs:
+                             _window_kernel(specs, cols, nulls))
+            self._agg_cache[("window", id(node))] = (node, kernel)
+        else:
+            kernel = hit[1]
+        out_cols, out_nulls = kernel(page.columns, page.null_masks)
+        cols = tuple(page.columns) + out_cols
+        nulls = tuple(page.null_masks) + out_nulls
+        return Page(node.schema, cols, nulls, page.valid), tuple(dicts) + spec_dicts
 
     # -- join ---------------------------------------------------------------
     def _compile_join(self, node: P.Join) -> _Stream:
@@ -446,7 +495,7 @@ class LocalExecutor:
 
     def _execute_to_page_streamed(self, node):
         """Materialize a sub-plan into one device page (join build side)."""
-        if isinstance(node, (P.Aggregate, P.Sort, P.Limit, P.Output)):
+        if isinstance(node, (P.Aggregate, P.Sort, P.Limit, P.Output, P.Window)):
             return self._execute_to_page(node)
         stream = self._compile_stream(node)
         return _concat_stream(stream), stream.dicts
@@ -516,33 +565,47 @@ def _finalize_aggs(aggs, acc_cols, n_groups):
     return out
 
 
+@partial(jax.jit, static_argnums=(3,))
+def _compact_part(cols, nulls, valid, size: int):
+    """Gather valid rows into dense ``size``-bounded arrays (device-side)."""
+    idx = jnp.nonzero(valid, size=size, fill_value=0)[0]
+    out_cols = tuple(c[idx] for c in cols)
+    out_nulls = tuple(None if n is None else n[idx] for n in nulls)
+    return out_cols, out_nulls
+
+
 def _concat_stream(stream: _Stream) -> Page:
-    """Materialize a streaming segment into a single device page (compacted)."""
+    """Materialize a streaming segment into a single device page (compacted).
+
+    Compaction runs ON DEVICE (nonzero-gather per page, then a device concat): pages
+    never cross to the host between pipeline-breaking stages — device->host bandwidth
+    is the scarce resource, not FLOPs (reference analog: pages stay in worker memory
+    between operators)."""
     parts = []
     step = stream.jitted()
     for page in stream.pages():
-        parts.append(step(page))
+        cols, nulls, valid = step(page)
+        n = int(jnp.sum(valid))  # one scalar sync per page to size the shape bucket
+        if n == 0:
+            continue
+        bucket = max(1 << max(n - 1, 1).bit_length(), 1024)
+        ccols, cnulls = _compact_part(cols, nulls, valid, min(bucket, valid.shape[0]))
+        parts.append((ccols, cnulls, n))
     if not parts:
         cols = tuple(jnp.zeros((0,), f.type.dtype) for f in stream.schema.fields)
         return Page(stream.schema, cols, tuple(None for _ in cols), None)
     ncols = len(parts[0][0])
-    # host-side compaction between pipeline-breaking stages
-    cols_np, nulls_np = [], []
-    valids = [np.asarray(v) for _, _, v in parts]
+    cols_out, nulls_out = [], []
     for ci in range(ncols):
-        cols_np.append(np.concatenate([np.asarray(p[0][ci])[v] for p, v in zip(parts, valids)]))
-        have_null = any(p[1][ci] is not None for p in parts)
-        if have_null:
-            nulls_np.append(np.concatenate([
-                (np.asarray(p[1][ci]) if p[1][ci] is not None
-                 else np.zeros_like(v))[v]
-                for p, v in zip(parts, valids)
-            ]))
+        cols_out.append(jnp.concatenate([ccols[ci][:n] for ccols, _, n in parts]))
+        if any(cnulls[ci] is not None for _, cnulls, _ in parts):
+            nulls_out.append(jnp.concatenate([
+                (cnulls[ci] if cnulls[ci] is not None
+                 else jnp.zeros((ccols[ci].shape[0],), bool))[:n]
+                for ccols, cnulls, n in parts]))
         else:
-            nulls_np.append(None)
-    cols = tuple(jnp.asarray(c) for c in cols_np)
-    nulls = tuple(None if n is None else jnp.asarray(n) for n in nulls_np)
-    return Page(stream.schema, cols, nulls, None)
+            nulls_out.append(None)
+    return Page(stream.schema, tuple(cols_out), tuple(nulls_out), None)
 
 
 def _build_null_stats(build_page: Page, key_channels):
@@ -645,3 +708,156 @@ def _materialize(page: Page, dicts) -> MaterializedResult:
         types.append(f.type)
         columns.append(dec)
     return MaterializedResult(tuple(names), tuple(types), columns, raw)
+
+
+def _window_kernel(specs, cols, nulls):
+    """Evaluate all window specs over one materialized page (ops/window primitives).
+
+    Sort permutations are shared across specs with the same (partition, order) clause
+    (reference: WindowOperator groups functions by window specification)."""
+    from ..ops import window as W
+
+    n = cols[0].shape[0]
+    cache: dict = {}
+
+    def keyed(ch):
+        """(indicator, value) sort/segment columns for a possibly-nullable channel:
+        NULL rows group together and sort by the indicator, not the fill value."""
+        nm = nulls[ch]
+        if nm is None:
+            return [(None, cols[ch])]
+        return [(nm, jnp.where(nm, jnp.zeros((), cols[ch].dtype), cols[ch]))]
+
+    out_cols, out_nulls = [], []
+    for s in specs:
+        ck = (s.partition, s.order)
+        if ck not in cache:
+            kcols, desc = [], []
+            for c in s.partition:
+                for ind, v in keyed(c):
+                    if ind is not None:
+                        kcols.append(ind)
+                        desc.append(False)
+                    kcols.append(v)
+                    desc.append(False)
+            for k in s.order:
+                for ind, v in keyed(k.channel):
+                    if ind is not None:
+                        # nulls_first -> null indicator sorts first (descending bool)
+                        kcols.append(ind)
+                        desc.append(bool(k.nulls_first))
+                    kcols.append(v)
+                    desc.append(not k.ascending)
+            if kcols:
+                perm = W.window_order(kcols, desc)
+            else:
+                perm = jnp.arange(n, dtype=jnp.int32)
+
+            def seg_cols(channels):
+                out = []
+                for c in channels:
+                    for ind, v in keyed(c):
+                        if ind is not None:
+                            out.append(ind[perm])
+                        out.append(v[perm])
+                return out
+
+            if s.partition:
+                part_new = W.segments(seg_cols(s.partition))
+            else:
+                part_new = jnp.zeros((n,), bool).at[0].set(True)
+            if s.order:
+                peer_new = part_new | W.segments(
+                    seg_cols([k.channel for k in s.order]))
+            else:
+                peer_new = part_new
+            cache[ck] = (perm, part_new, peer_new)
+        perm, part_new, peer_new = cache[ck]
+        framed = bool(s.order)  # ORDER BY -> running frame; else whole partition
+
+        vals = None
+        vmask = None  # True where the input value counts
+        if s.arg is not None:
+            vals = cols[s.arg][perm]
+            nm = nulls[s.arg]
+            vmask = None if nm is None else ~nm[perm]
+
+        null_out = None
+        if s.kind == "row_number":
+            res = W.row_number(part_new)
+        elif s.kind == "rank":
+            res = W.rank(part_new, peer_new)
+        elif s.kind == "dense_rank":
+            res = W.dense_rank(part_new, peer_new)
+        elif s.kind in ("count", "count_star"):
+            ones = jnp.ones((n,), jnp.int64)
+            if s.kind == "count" and vmask is not None:
+                ones = jnp.where(vmask, 1, 0)
+            res = (W.segmented_scan_sum(ones, part_new, peer_new) if framed
+                   else W.partition_total(ones, part_new))
+        elif s.kind in ("sum", "avg"):
+            acc_dt = jnp.float64 if s.type.is_floating else jnp.int64
+            v = vals if vmask is None else jnp.where(vmask, vals, 0)
+            total = (W.segmented_scan_sum(v, part_new, peer_new, acc_dt) if framed
+                     else W.partition_total(v, part_new, acc_dt))
+            nn_cnt = None
+            if vmask is not None:
+                nn = jnp.where(vmask, 1, 0)
+                nn_cnt = (W.segmented_scan_sum(nn, part_new, peer_new) if framed
+                          else W.partition_total(nn, part_new))
+                null_out = nn_cnt == 0  # all-NULL frame -> NULL, not 0
+            if s.kind == "sum":
+                res = total
+            else:
+                cnt = nn_cnt
+                if cnt is None:
+                    ones = jnp.ones((n,), jnp.int64)
+                    cnt = (W.segmented_scan_sum(ones, part_new, peer_new) if framed
+                           else W.partition_total(ones, part_new))
+                cnt_safe = jnp.maximum(cnt, 1)
+                if s.type.is_floating:
+                    res = total / cnt_safe
+                else:  # decimal avg: HALF_UP like the aggregation path
+                    q, r = jnp.divmod(jnp.abs(total), cnt_safe)
+                    res = ((q + (2 * r >= cnt_safe)) * jnp.sign(total))
+        elif s.kind in ("min", "max"):
+            v = vals
+            if vmask is not None:
+                ident = hashagg._extreme(vals.dtype, 1 if s.kind == "min" else -1)
+                v = jnp.where(vmask, vals, ident)
+                nn = jnp.where(vmask, 1, 0)
+                nn_cnt = (W.segmented_scan_sum(nn, part_new, peer_new) if framed
+                          else W.partition_total(nn, part_new))
+                null_out = nn_cnt == 0  # all-NULL frame -> NULL, not the sentinel
+            res = W.segmented_scan_minmax(v, part_new,
+                                          peer_new if framed else part_new, s.kind)
+        elif s.kind in ("lag", "lead"):
+            off = s.offset if s.kind == "lag" else -s.offset
+            fill = (jnp.zeros((), vals.dtype) if s.default is None
+                    else jnp.asarray(s.default, vals.dtype))
+            res, miss = W.shift_in_partition(vals, part_new, off, fill)
+            if s.default is None:
+                null_out = miss
+            else:
+                res = jnp.where(miss, fill, res)
+                null_out = jnp.zeros((n,), bool)
+            if vmask is not None:
+                shifted_null, _ = W.shift_in_partition(
+                    (~vmask), part_new, off, jnp.zeros((), bool))
+                null_out = null_out | (shifted_null & ~miss)
+        elif s.kind in ("first_value", "last_value"):
+            idx = (W._starts(part_new) if s.kind == "first_value"
+                   else W._ends(peer_new if framed else part_new))
+            res = vals[idx]
+            if vmask is not None:
+                null_out = ~vmask[idx]
+        else:
+            raise NotImplementedError(s.kind)
+
+        out = jnp.zeros((n,), res.dtype).at[perm].set(res.astype(res.dtype))
+        out_cols.append(out.astype(s.type.dtype))
+        if null_out is not None:
+            out_nulls.append(jnp.zeros((n,), bool).at[perm].set(null_out))
+        else:
+            out_nulls.append(None)
+    return tuple(out_cols), tuple(out_nulls)

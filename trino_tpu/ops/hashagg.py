@@ -168,3 +168,26 @@ def agg_finalize(state: GroupByState):
     keys = tuple(k[:C] for k in state.key_cols)
     accs = tuple(a[:C] for a in state.accs)
     return occupied, keys, accs
+
+
+def group_count(state: GroupByState):
+    """Occupied-slot count (device scalar; ONE host sync to size the compaction)."""
+    C = state.capacity
+    return jnp.sum(state.table[:C] != EMPTY_KEY, dtype=jnp.int32)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def compact_groups(state: GroupByState, size: int):
+    """Gather the occupied groups into dense ``size``-bounded arrays ON DEVICE.
+
+    The hash table is capacity-sized but real group counts are usually tiny
+    (Q1: 6 groups in a 65k table) — transferring the full table to the host
+    dominates query time on low-bandwidth device links, so compaction must
+    happen before any device->host copy.  ``size`` is a power-of-two bucket
+    (cached executable per bucket)."""
+    C = state.capacity
+    occupied = state.table[:C] != EMPTY_KEY
+    idx = jnp.nonzero(occupied, size=size, fill_value=0)[0]
+    keys = tuple(k[:C][idx] for k in state.key_cols)
+    accs = tuple(a[:C][idx] for a in state.accs)
+    return keys, accs

@@ -1,0 +1,123 @@
+"""Window function kernels: sorted segmented scans over partitions.
+
+Reference: WindowOperator (operator/WindowOperator.java) sorts a PagesIndex by
+(partition, order) keys and runs per-partition WindowFunction state machines row by row
+(operator/window/*).  The TPU re-design computes ALL rows of a window function at once:
+
+- one stable multi-key argsort puts partition rows adjacent and peer rows adjacent;
+- partition / peer-group boundaries become boolean change masks;
+- ranking functions are arithmetic over boundary prefix sums (cummax/cumsum);
+- framed aggregates (default RANGE UNBOUNDED PRECEDING .. CURRENT ROW) are segmented
+  prefix scans gathered at each row's peer-group end;
+- results scatter back through the inverse permutation.
+
+Everything is a dense sort/scan/gather — no per-row control flow, so XLA maps it onto
+the TPU vector units directly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["window_order", "segments", "row_number", "rank", "dense_rank",
+           "segmented_scan_sum", "segmented_scan_minmax", "partition_total",
+           "shift_in_partition"]
+
+
+def window_order(key_cols, descending_flags):
+    """Stable lexicographic sort permutation over key columns (first key primary)."""
+    n = key_cols[0].shape[0]
+    perm = jnp.arange(n, dtype=jnp.int32)
+    for col, desc in reversed(list(zip(key_cols, descending_flags))):
+        k = col[perm]
+        if desc:
+            if jnp.issubdtype(k.dtype, jnp.floating):
+                k = -k
+            else:
+                k = -k.astype(jnp.int64)
+        perm = perm[jnp.argsort(k, stable=True)]
+    return perm
+
+
+def segments(sorted_key_cols):
+    """Boundary mask over sorted rows: True where a new group starts (row 0 included)."""
+    n = sorted_key_cols[0].shape[0]
+    new = jnp.zeros((n,), bool).at[0].set(True)
+    for c in sorted_key_cols:
+        new = new | jnp.concatenate([jnp.ones((1,), bool), c[1:] != c[:-1]])
+    return new
+
+
+def _starts(new):
+    """Per-row index of its group's first row (cummax of marked starts)."""
+    idx = jnp.arange(new.shape[0], dtype=jnp.int32)
+    return jax.lax.cummax(jnp.where(new, idx, 0))
+
+
+def _ends(new):
+    """Per-row index of its group's last row (reverse cummin of marked ends)."""
+    n = new.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    is_last = jnp.concatenate([new[1:], jnp.ones((1,), bool)])
+    marked = jnp.where(is_last, idx, n - 1)
+    return jnp.flip(jax.lax.cummin(jnp.flip(marked)))
+
+
+def row_number(part_new):
+    idx = jnp.arange(part_new.shape[0], dtype=jnp.int64)
+    return idx - _starts(part_new) + 1
+
+
+def rank(part_new, peer_new):
+    return (_starts(peer_new) - _starts(part_new) + 1).astype(jnp.int64)
+
+
+def dense_rank(part_new, peer_new):
+    d = jnp.cumsum(peer_new.astype(jnp.int64))
+    return d - d[_starts(part_new)] + 1
+
+
+def segmented_scan_sum(vals, part_new, peer_new, dtype=None):
+    """Running sum per row over RANGE UNBOUNDED PRECEDING .. CURRENT ROW (peers share
+    the value at their group's last row)."""
+    v = vals if dtype is None else vals.astype(dtype)
+    csum = jnp.cumsum(v)
+    start = _starts(part_new)
+    base = jnp.where(start > 0, csum[jnp.maximum(start - 1, 0)], jnp.zeros((), v.dtype))
+    return csum[_ends(peer_new)] - base
+
+
+def segmented_scan_minmax(vals, part_new, peer_new, kind: str):
+    """Running min/max with partition resets via an associative segmented scan."""
+    seg_id = jnp.cumsum(part_new.astype(jnp.int32))
+    op = jnp.minimum if kind == "min" else jnp.maximum
+
+    def combine(a, b):
+        sa, va = a
+        sb, vb = b
+        same = sa == sb
+        return sb, jnp.where(same, op(va, vb), vb)
+
+    _, scanned = jax.lax.associative_scan(combine, (seg_id, vals))
+    return scanned[_ends(peer_new)]
+
+
+def partition_total(vals, part_new, dtype=None):
+    """Whole-partition aggregate broadcast to every partition row (no ORDER BY frame)."""
+    v = vals if dtype is None else vals.astype(dtype)
+    csum = jnp.cumsum(v)
+    start = _starts(part_new)
+    base = jnp.where(start > 0, csum[jnp.maximum(start - 1, 0)], jnp.zeros((), v.dtype))
+    return csum[_ends(part_new)] - base
+
+
+def shift_in_partition(vals, part_new, offset: int, default):
+    """lag (offset>0) / lead (offset<0) within the partition, sorted order."""
+    n = vals.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    src = idx - offset
+    src_clamped = jnp.clip(src, 0, n - 1)
+    seg_id = jnp.cumsum(part_new.astype(jnp.int32))
+    ok = (src >= 0) & (src < n) & (seg_id[src_clamped] == seg_id)
+    return jnp.where(ok, vals[src_clamped], default), ~ok

@@ -73,6 +73,7 @@ class Planner:
         self.engine = engine
         self.session = session
         self.ctes: dict = {}  # name -> (column_aliases, Select AST)
+        self._last_projection = None  # source scope of the latest final projection
 
     # ---------------------------------------------------------------- query planning
     def plan_query(self, q: A.Select) -> P.PlanNode:
@@ -83,11 +84,18 @@ class Planner:
         try:
             rel, out_names, out_exprs_ast = self._plan_select(q)
             node = rel.node
-            # ORDER BY: resolve against output channels (alias/ordinal/select-expr match)
+            # ORDER BY: resolve against output channels (alias/ordinal/select-expr
+            # match); unmatched expressions over the source scope become hidden sort
+            # channels appended to the final projection (reference: QueryPlanner's
+            # ORDER BY scope includes the FROM relation)
             if q.order_by:
                 keys = []
                 for s in q.order_by:
-                    ch = self._resolve_output_channel(s.expr, out_names, out_exprs_ast)
+                    try:
+                        ch = self._resolve_output_channel(s.expr, out_names,
+                                                          out_exprs_ast)
+                    except SemanticError:
+                        node, ch = self._add_hidden_sort_channel(node, s.expr)
                     keys.append(P.SortKey(ch, s.ascending, bool(s.nulls_first)))
                 node = P.Sort(node, tuple(keys))
             if q.limit is not None:
@@ -96,7 +104,25 @@ class Planner:
         finally:
             self.ctes = saved
 
-    def _plan_select(self, q: A.Select):
+    def _add_hidden_sort_channel(self, node, expr):
+        """Append an ORDER-BY-only expression as an extra channel of the final
+        projection (the Output node's name list hides it from the client)."""
+        src = self._last_projection
+        if src is None or not isinstance(node, P.Project):
+            raise SemanticError(f"ORDER BY expression not in output: {expr}")
+        source_cols = src
+        e, d = self.translate(expr, source_cols)
+        exprs = tuple(node.exprs) + (e,)
+        dicts = (tuple(node.dicts) if node.dicts else
+                 tuple(None for _ in node.exprs)) + (d,)
+        schema = Schema(tuple(node.schema.fields)
+                        + (Field(f"#s{len(node.exprs)}", e.type),))
+        return P.Project(node.child, exprs, schema, dicts), len(node.exprs)
+
+    def _plan_select(self, q):
+        if isinstance(q, A.SetOp):
+            return self._plan_setop(q)
+        self._last_projection = None
         rel = self._plan_from(q)
         # expand stars
         items = []
@@ -117,9 +143,18 @@ class Planner:
         for s in q.order_by:
             _collect_aggs(s.expr, agg_calls)
 
+        win_calls = []
+        for it in items:
+            _collect_windows(it.expr, win_calls)
+
         if has_group or agg_calls:
+            if win_calls:
+                raise SemanticError(
+                    "window functions over aggregated queries not supported yet")
             rel, out_names, out_exprs_ast = self._plan_aggregation(q, rel, items, agg_calls)
         else:
+            if win_calls:
+                rel, items = self._plan_windows(rel, items, win_calls)
             exprs, dicts, names = [], [], []
             for i, it in enumerate(items):
                 e, d = self.translate(it.expr, rel.cols)
@@ -128,6 +163,7 @@ class Planner:
                 names.append(it.alias or _derive_name(it.expr, i))
             schema = Schema(tuple(Field(n, e.type) for n, e in zip(names, exprs)))
             node = P.Project(rel.node, tuple(exprs), schema, tuple(dicts))
+            self._last_projection = rel.cols  # source scope for hidden ORDER BY columns
             rel = RelPlan(node, [ColumnInfo(None, n, e.type, d)
                                  for n, e, d in zip(names, exprs, dicts)])
             out_names = names
@@ -137,7 +173,170 @@ class Planner:
             schema = Schema(tuple(Field(c.name, c.type) for c in rel.cols))
             rel = RelPlan(P.Aggregate(rel.node, tuple(range(n)), (), schema), rel.cols,
                           [frozenset(range(n))])
+            self._last_projection = None  # DISTINCT output: no hidden ORDER BY columns
         return rel, out_names, out_exprs_ast
+
+    # ---------------------------------------------------------------- set operations
+    def _plan_setop(self, q: A.SetOp):
+        """UNION/INTERSECT/EXCEPT (reference: SetOperationNodeTranslator — union all is
+        a UnionNode; distinct variants add an aggregation; intersect/except become
+        semi/anti joins over all output channels).
+
+        Deviation: NULL rows are compared by the equi-join rule (NULL != NULL), not the
+        set-operation DISTINCT rule (NULL == NULL) — a known limitation until group-by
+        keys carry null masks."""
+        lrel, lnames, _ = self._plan_operand(q.left)
+        rrel, rnames, _ = self._plan_operand(q.right)
+        if len(lrel.cols) != len(rrel.cols):
+            raise SemanticError("set operation operands have different column counts")
+        types = [common_super_type(lc.type, rc.type)
+                 for lc, rc in zip(lrel.cols, rrel.cols)]
+        for lc, rc, t in zip(lrel.cols, rrel.cols, types):
+            if t.is_string and lc.dict is not rc.dict:
+                raise SemanticError(
+                    "set operations over differently-encoded string columns not "
+                    "supported yet (dictionary merge)")
+        schema = Schema(tuple(Field(n, t) for n, t in zip(lnames, types)))
+
+        def coerced(rel):
+            exprs = tuple(_coerce(ir.FieldRef(i, c.type), t)
+                          for i, (c, t) in enumerate(zip(rel.cols, types)))
+            if all(isinstance(e, ir.FieldRef) for e in exprs) and \
+                    len(rel.cols) == len(rel.node.schema):
+                return rel.node
+            return P.Project(rel.node, exprs, schema,
+                             tuple(c.dict for c in rel.cols))
+
+        lnode, rnode = coerced(lrel), coerced(rrel)
+        cols = [ColumnInfo(None, n, t, lc.dict)
+                for n, t, lc in zip(lnames, types, lrel.cols)]
+        if q.kind == "union":
+            node = P.Union((lnode, rnode), schema)
+            rel = RelPlan(node, cols)
+            if not q.all:
+                rel = RelPlan(P.Aggregate(node, tuple(range(len(cols))), (), schema),
+                              cols, [frozenset(range(len(cols)))])
+        else:
+            if q.all:
+                raise SemanticError(f"{q.kind} ALL not supported yet")
+            probe = RelPlan(P.Aggregate(lnode, tuple(range(len(cols))), (), schema),
+                            cols, [frozenset(range(len(cols)))])
+            inner = RelPlan(rnode, [ColumnInfo(None, f"r{i}", t)
+                                    for i, t in enumerate(types)])
+            pairs = [(ir.FieldRef(i, t), ir.FieldRef(i, t))
+                     for i, t in enumerate(types)]
+            rel = self._semi_anti_join(probe, inner, pairs, q.kind == "except")
+        return rel, list(lnames), [None] * len(lnames)
+
+    # ---------------------------------------------------------------- window functions
+    WINDOW_FUNCS = {"row_number", "rank", "dense_rank", "sum", "avg", "min", "max",
+                    "count", "lag", "lead", "first_value", "last_value"}
+
+    def _plan_windows(self, rel: RelPlan, items, win_calls):
+        """Plan window calls: extend the relation with partition/order/arg channels,
+        add a Window node, and rewrite the calls to references of its output channels
+        (reference: QueryPlanner#planWindowFunctions -> plan/WindowNode)."""
+        uniq = []
+        for w in win_calls:
+            if w not in uniq:
+                uniq.append(w)
+        base_n = len(rel.cols)
+        proj_exprs = [ir.FieldRef(i, c.type, c.name) for i, c in enumerate(rel.cols)]
+        proj_dicts = [c.dict for c in rel.cols]
+
+        def channel_of(ast):
+            e, d = self.translate(ast, rel.cols)
+            if isinstance(e, ir.FieldRef):
+                return e.index, e.type, d
+            proj_exprs.append(e)
+            proj_dicts.append(d)
+            return len(proj_exprs) - 1, e.type, d
+
+        specs, out_info = [], []
+        for j, w in enumerate(uniq):
+            name = w.func.name
+            if name not in self.WINDOW_FUNCS:
+                raise SemanticError(f"window function {name} not supported")
+            if w.func.distinct:
+                raise SemanticError(
+                    f"DISTINCT in window aggregate {name} not supported yet")
+            pchs = tuple(channel_of(p)[0] for p in w.partition_by)
+            order = []
+            for s in w.order_by:
+                och, _, od = channel_of(s.expr)
+                if od is not None and od.values is not None:
+                    # dictionary ids are not collation-ordered: order by a projected
+                    # id->collation-rank channel instead (same reason _sort_page
+                    # decodes before sorting)
+                    ranks = np.empty(len(od.values), np.int32)
+                    ranks[np.argsort(od.values)] = np.arange(len(od.values), dtype=np.int32)
+                    proj_exprs.append(ir.Call(
+                        "lut", (proj_exprs[och], ir.Constant(ranks, INTEGER)), INTEGER))
+                    proj_dicts.append(None)
+                    och = len(proj_exprs) - 1
+                nf = s.nulls_first if s.nulls_first is not None else not s.ascending
+                order.append(P.SortKey(och, s.ascending, nf))
+            order = tuple(order)
+            arg_ch, arg_t, arg_d = None, None, None
+            kind = name
+            if name == "count" and (not w.func.args
+                                    or isinstance(w.func.args[0], A.Star)):
+                kind = "count_star"
+            elif name in ("row_number", "rank", "dense_rank"):
+                if w.func.args:
+                    raise SemanticError(f"{name} takes no arguments")
+            else:
+                if not w.func.args:
+                    raise SemanticError(f"window function {name} needs an argument")
+                arg_ch, arg_t, arg_d = channel_of(w.func.args[0])
+            offset, default = 1, None
+            if name in ("lag", "lead"):
+                if len(w.func.args) > 1:
+                    if not isinstance(w.func.args[1], A.NumberLit):
+                        raise SemanticError("lag/lead offset must be a literal")
+                    offset = int(w.func.args[1].text)
+                if len(w.func.args) > 2:
+                    dflt, _ = self.translate(w.func.args[2], rel.cols)
+                    if isinstance(dflt, ir.Call) and dflt.op == "negate" and \
+                            isinstance(dflt.args[0], ir.Constant):
+                        dflt = ir.Constant(-dflt.args[0].value, dflt.type)
+                    dflt = _coerce(dflt, arg_t)
+                    if not isinstance(dflt, ir.Constant):
+                        raise SemanticError("lag/lead default must be a literal")
+                    default = dflt.value
+            if kind in ("row_number", "rank", "dense_rank", "count", "count_star"):
+                t = BIGINT
+            elif kind in ("sum", "avg"):
+                t = _agg_type(kind, arg_t)
+            else:
+                t = arg_t
+            specs.append(P.WindowSpec(kind, arg_ch, pchs, order, f"#w{j}", t, offset,
+                                      default))
+            out_info.append((f"#w{j}", t,
+                             arg_d if kind in ("min", "max", "lag", "lead",
+                                               "first_value", "last_value") else None))
+
+        proj_schema = Schema(tuple(Field(f"c{i}", e.type)
+                                   for i, e in enumerate(proj_exprs)))
+        proj = P.Project(rel.node, tuple(proj_exprs), proj_schema, tuple(proj_dicts))
+        win_schema = Schema(tuple(proj_schema.fields)
+                            + tuple(Field(n, t) for n, t, _ in out_info))
+        win = P.Window(proj, tuple(specs), win_schema)
+        cols = (list(rel.cols)
+                + [ColumnInfo(None, "", f.type)
+                   for f in proj_schema.fields[base_n:]]
+                + [ColumnInfo(None, n, t, d) for n, t, d in out_info])
+        mapping = {w: A.Identifier((f"#w{j}",)) for j, w in enumerate(uniq)}
+        new_items = [A.SelectItem(_replace_nodes(it.expr, mapping), it.alias)
+                     for it in items]
+        return RelPlan(win, cols, rel.unique_sets), new_items
+
+    def _plan_operand(self, side):
+        """A set-operation operand; parenthesized operands may carry ORDER BY/LIMIT."""
+        if side.order_by or side.limit is not None:
+            rel = self._plan_subquery_rel(side, None)
+            return rel, [c.name for c in rel.cols], [None] * len(rel.cols)
+        return self._plan_select(side)
 
     # ---------------------------------------------------------------- FROM / joins
     def _plan_from(self, q: A.Select) -> RelPlan:
@@ -1078,8 +1277,9 @@ def _collect_aggs(ast, out: list):
     if isinstance(ast, A.FuncCall) and ast.name in AGG_FUNCS:
         out.append(ast)
         return
-    if isinstance(ast, (A.ScalarSubquery, A.InSubquery, A.Exists, A.SubqueryRef, A.Select)):
-        return  # subquery scopes own their aggregates
+    if isinstance(ast, (A.ScalarSubquery, A.InSubquery, A.Exists, A.SubqueryRef, A.Select,
+                        A.WindowCall)):
+        return  # subquery scopes own their aggregates; sum() OVER is a window, not an agg
     for f in dataclasses.fields(ast) if dataclasses.is_dataclass(ast) else ():
         v = getattr(ast, f.name)
         if isinstance(v, A.Node):
@@ -1092,6 +1292,43 @@ def _collect_aggs(ast, out: list):
                     for y in x:
                         if isinstance(y, A.Node):
                             _collect_aggs(y, out)
+
+
+def _collect_windows(ast, out: list):
+    if isinstance(ast, A.WindowCall):
+        out.append(ast)
+        return
+    if isinstance(ast, (A.ScalarSubquery, A.InSubquery, A.Exists, A.SubqueryRef, A.Select)):
+        return
+    for f in dataclasses.fields(ast) if dataclasses.is_dataclass(ast) else ():
+        v = getattr(ast, f.name)
+        if isinstance(v, A.Node):
+            _collect_windows(v, out)
+        elif isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, A.Node):
+                    _collect_windows(x, out)
+
+
+def _replace_nodes(ast, mapping: dict):
+    """Structurally rebuild an AST with ``mapping`` substitutions (frozen dataclasses)."""
+    if ast in mapping:
+        return mapping[ast]
+    if not dataclasses.is_dataclass(ast):
+        return ast
+    changes = {}
+    for f in dataclasses.fields(ast):
+        v = getattr(ast, f.name)
+        if isinstance(v, A.Node):
+            nv = _replace_nodes(v, mapping)
+            if nv is not v:
+                changes[f.name] = nv
+        elif isinstance(v, tuple):
+            nv = tuple(_replace_nodes(x, mapping) if isinstance(x, A.Node) else x
+                       for x in v)
+            if nv != v:
+                changes[f.name] = nv
+    return dataclasses.replace(ast, **changes) if changes else ast
 
 
 def _agg_kind(ast: A.FuncCall):
@@ -1305,7 +1542,7 @@ def _type_from_name(name: str, params) -> Type:
 
 
 def _derive_name(ast, i: int) -> str:
-    if isinstance(ast, A.Identifier):
+    if isinstance(ast, A.Identifier) and not ast.parts[-1].startswith("#"):
         return ast.parts[-1]
     return f"_col{i}"
 

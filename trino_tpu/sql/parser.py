@@ -89,6 +89,15 @@ class FuncCall(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class WindowCall(Node):
+    """func(args) OVER (PARTITION BY ... ORDER BY ...)."""
+
+    func: "FuncCall"
+    partition_by: tuple
+    order_by: tuple  # SortItem...
+
+
+@dataclasses.dataclass(frozen=True)
 class CaseExpr(Node):
     operand: Optional[Node]
     whens: tuple  # ((cond, value), ...)
@@ -201,6 +210,21 @@ class Select(Node):
     ctes: tuple = ()  # WITH clause: ((name, column_aliases, Select), ...)
 
 
+@dataclasses.dataclass(frozen=True)
+class SetOp(Node):
+    """UNION / INTERSECT / EXCEPT query body (reference: sql/tree/Union.java etc.)."""
+
+    kind: str  # union | intersect | except
+    all: bool
+    left: Node  # Select | SetOp
+    right: Node
+    order_by: tuple = ()
+    limit: Optional[int] = None
+    ctes: tuple = ()
+    group_by: tuple = ()  # always empty; present for shape-compat with Select
+    having: Optional[Node] = None
+
+
 # ----------------------------------------------------------------------------- lexer
 _TOKEN_RE = re.compile(
     r"""
@@ -218,7 +242,8 @@ KEYWORDS = {
     "or", "not", "in", "exists", "between", "like", "is", "null", "case", "when", "then",
     "else", "end", "cast", "extract", "join", "inner", "left", "right", "full", "outer",
     "cross", "on", "distinct", "date", "interval", "asc", "desc", "nulls", "first",
-    "last", "true", "false", "all", "any", "union", "except", "intersect", "with", "substring", "for",
+    "last", "true", "false", "all", "any", "union", "except", "intersect", "with",
+    "substring", "for", "over", "partition",
 }
 
 
@@ -289,21 +314,7 @@ class Parser:
 
     # entry
     def parse_statement(self) -> Select:
-        ctes = []
-        if self.accept("with"):
-            while True:
-                name = self.expect_kind("ident").value
-                cols = self._column_alias_list()
-                self.expect("as")
-                self.expect("(")
-                sub = self.parse_select()
-                self.expect(")")
-                ctes.append((name, cols, sub))
-                if not self.accept(","):
-                    break
-        q = self.parse_select()
-        if ctes:
-            q = dataclasses.replace(q, ctes=tuple(ctes))
+        q = self.parse_subquery()
         self.accept(";")
         if self.peek().kind != "eof":
             raise ParseError(f"trailing input at pos {self.peek().pos}: {self.peek().value!r}")
@@ -322,7 +333,8 @@ class Parser:
         return tuple(cols)
 
     def parse_subquery(self) -> Select:
-        """A parenthesized query body (SELECT, optionally with its own WITH clause)."""
+        """A query body: optional WITH, then SELECTs joined by set operations, then
+        ORDER BY/LIMIT applying to the whole body."""
         ctes = []
         if self.accept("with"):
             while True:
@@ -330,13 +342,66 @@ class Parser:
                 cols = self._column_alias_list()
                 self.expect("as")
                 self.expect("(")
-                sub = self.parse_select()
+                sub = self.parse_subquery()
                 self.expect(")")
                 ctes.append((name, cols, sub))
                 if not self.accept(","):
                     break
-        q = self.parse_select()
-        return dataclasses.replace(q, ctes=tuple(ctes)) if ctes else q
+        q = self.parse_query_body()
+        if ctes:
+            q = dataclasses.replace(q, ctes=tuple(ctes))
+        return q
+
+    def parse_query_body(self):
+        left = self.parse_intersect_term()
+        while True:
+            if self.accept("union"):
+                kind = "union"
+            elif self.accept("except"):
+                kind = "except"
+            else:
+                break
+            all_ = bool(self.accept("all"))
+            self.accept("distinct")
+            right = self.parse_intersect_term()
+            left = SetOp(kind, all_, left, right)
+        # trailing ORDER BY / LIMIT bind to the whole query body (set op or plain select)
+        order_by = []
+        if self.accept("order"):
+            self.expect("by")
+            order_by = [self.parse_sort_item()]
+            while self.accept(","):
+                order_by.append(self.parse_sort_item())
+        limit = None
+        if self.accept("limit"):
+            limit = int(self.expect_kind("number").value)
+        if order_by or limit is not None:
+            if left.order_by or left.limit is not None:
+                # a parenthesized operand carries its own ORDER BY/LIMIT: wrap it as a
+                # derived table so both clauses apply ('(... order by a) limit 3' takes
+                # the 3 smallest, not 3 arbitrary rows)
+                left = Select((SelectItem(Star(), None),), SubqueryRef(left, None),
+                              None, (), None, (), None)
+            left = dataclasses.replace(left, order_by=tuple(order_by), limit=limit)
+        return left
+
+    def parse_intersect_term(self):
+        left = self.parse_query_primary()
+        while True:
+            if not self.accept("intersect"):
+                return left
+            all_ = bool(self.accept("all"))
+            self.accept("distinct")
+            right = self.parse_query_primary()
+            left = SetOp("intersect", all_, left, right)
+
+    def parse_query_primary(self):
+        if self.peek().kind == "op" and self.peek().value == "(":
+            self.next()
+            q = self.parse_subquery()
+            self.expect(")")
+            return q
+        return self.parse_select()
 
     def parse_select(self) -> Select:
         self.expect("select")
@@ -360,17 +425,9 @@ class Parser:
                 group_by.append(self.parse_expr())
             group_by = tuple(group_by)
         having = self.parse_expr() if self.accept("having") else None
-        order_by = ()
-        if self.accept("order"):
-            self.expect("by")
-            order_by = [self.parse_sort_item()]
-            while self.accept(","):
-                order_by.append(self.parse_sort_item())
-            order_by = tuple(order_by)
-        limit = None
-        if self.accept("limit"):
-            limit = int(self.expect_kind("number").value)
-        return Select(tuple(items), from_, where, group_by, having, tuple(order_by), limit, distinct)
+        # ORDER BY / LIMIT are parsed by parse_query_body (they bind to the whole query
+        # body so set-operation operands don't capture them)
+        return Select(tuple(items), from_, where, group_by, having, (), None, distinct)
 
     def parse_select_item(self) -> SelectItem:
         if self.peek().value == "*" and self.peek().kind == "op":
@@ -490,7 +547,7 @@ class Parser:
             if self.accept("in"):
                 self.expect("(")
                 if self.peek().value == "select":
-                    q = self.parse_select()
+                    q = self.parse_subquery()
                     self.expect(")")
                     left = InSubquery(left, q, negated)
                 else:
@@ -607,19 +664,19 @@ class Parser:
             if t.value == "exists":
                 self.next()
                 self.expect("(")
-                q = self.parse_select()
+                q = self.parse_subquery()
                 self.expect(")")
                 return Exists(q)
             if t.value == "not" and self.peek(1).value == "exists":
                 self.next(), self.next()
                 self.expect("(")
-                q = self.parse_select()
+                q = self.parse_subquery()
                 self.expect(")")
                 return Exists(q, negated=True)
         if t.kind == "op" and t.value == "(":
             self.next()
             if self.peek().value == "select":
-                q = self.parse_select()
+                q = self.parse_subquery()
                 self.expect(")")
                 return ScalarSubquery(q)
             e = self.parse_expr()
@@ -641,7 +698,24 @@ class Parser:
                         arg_list.append(self.parse_expr())
                     args = tuple(arg_list)
                 self.expect(")")
-                return FuncCall(name, args, distinct)
+                fc = FuncCall(name, args, distinct)
+                if self.accept("over"):
+                    self.expect("(")
+                    partition = []
+                    if self.accept("partition"):
+                        self.expect("by")
+                        partition = [self.parse_expr()]
+                        while self.accept(","):
+                            partition.append(self.parse_expr())
+                    order = []
+                    if self.accept("order"):
+                        self.expect("by")
+                        order = [self.parse_sort_item()]
+                        while self.accept(","):
+                            order.append(self.parse_sort_item())
+                    self.expect(")")
+                    return WindowCall(fc, tuple(partition), tuple(order))
+                return fc
             parts = [self.next().value]
             while self.peek().kind == "op" and self.peek().value == "." and self.peek(1).kind == "ident":
                 self.next()

@@ -16,7 +16,8 @@ from ..types import Type
 from .ir import Expr
 
 __all__ = ["PlanNode", "TableScan", "Filter", "Project", "AggSpec", "Aggregate",
-           "SortKey", "Sort", "Limit", "Join", "Values", "Output"]
+           "SortKey", "Sort", "Limit", "Join", "Union", "Values", "Output",
+           "WindowSpec", "Window"]
 
 
 class PlanNode:
@@ -158,6 +159,49 @@ class Join(PlanNode):
     @property
     def children(self):
         return (self.left, self.right)
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowSpec:
+    """One window function call (reference: plan/WindowNode.Function)."""
+
+    kind: str  # row_number | rank | dense_rank | sum | avg | min | max | count |
+    # count_star | lag | lead | first_value | last_value
+    arg: Optional[int]  # child channel (None for row_number/rank/.../count_star)
+    partition: tuple  # child channel indices
+    order: tuple  # SortKey over child channels
+    name: str
+    type: Type
+    offset: int = 1  # lag/lead distance
+    default: object = None  # lag/lead third argument (raw constant), None = NULL
+
+
+@dataclasses.dataclass(frozen=True)
+class Window(PlanNode):
+    """reference: sql/planner/plan/WindowNode.java; output = child channels + one
+    channel per spec."""
+
+    child: PlanNode
+    specs: tuple  # WindowSpec...
+    schema: Schema
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Union(PlanNode):
+    """UNION ALL: concatenates child streams (reference: sql/planner/plan/UnionNode.java;
+    distinct/intersect/except are planned as aggregation/joins on top, like the
+    reference's SetOperationNodeTranslator)."""
+
+    inputs: tuple  # PlanNode...
+    schema: Schema
+
+    @property
+    def children(self):
+        return self.inputs
 
 
 @dataclasses.dataclass(frozen=True)

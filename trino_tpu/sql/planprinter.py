@@ -1,0 +1,69 @@
+"""EXPLAIN plan rendering.
+
+Reference: sql/planner/planprinter/PlanPrinter.java (text mode).  Channel-based plans
+print one operator per line with indentation, output schema, and the operator-specific
+details (predicates, join keys, aggregate calls).
+"""
+
+from __future__ import annotations
+
+from . import plan as P
+
+__all__ = ["format_plan"]
+
+
+def format_plan(node: P.PlanNode) -> str:
+    lines: list = []
+    _fmt(node, lines, 0)
+    return "\n".join(lines)
+
+
+def _schema_str(node: P.PlanNode) -> str:
+    fields = node.schema.fields
+    inner = ", ".join(f"{f.name}:{f.type.name}" for f in fields[:8])
+    if len(fields) > 8:
+        inner += f", ... {len(fields) - 8} more"
+    return f"[{inner}]"
+
+
+def _fmt(node: P.PlanNode, lines: list, depth: int) -> None:
+    pad = "    " * depth
+    if isinstance(node, P.Output):
+        lines.append(f"{pad}Output[{', '.join(node.names)}]")
+    elif isinstance(node, P.Sort):
+        keys = ", ".join(
+            f"${k.channel} {'ASC' if k.ascending else 'DESC'}" for k in node.keys)
+        lines.append(f"{pad}Sort[{keys}]")
+    elif isinstance(node, P.Limit):
+        lines.append(f"{pad}Limit[{node.count}]")
+    elif isinstance(node, P.Aggregate):
+        keys = ", ".join(f"${k}" for k in node.keys)
+        aggs = ", ".join(f"{s.name} := {s.kind}({s.arg if s.arg is not None else '*'})"
+                         for s in node.aggs)
+        what = " DISTINCT" if not node.aggs else ""
+        lines.append(f"{pad}Aggregate{what}[keys = [{keys}], {aggs}] => "
+                     f"{_schema_str(node)}")
+    elif isinstance(node, P.Join):
+        keys = ", ".join(f"${l} = ${r}" for l, r in zip(node.left_keys, node.right_keys))
+        extra = f", filter: {node.filter}" if node.filter is not None else ""
+        na = ", null-aware" if node.null_aware else ""
+        lines.append(f"{pad}{node.kind.capitalize()}Join[{keys}{extra}{na}, "
+                     f"{node.distribution}] => {_schema_str(node)}")
+    elif isinstance(node, P.Filter):
+        lines.append(f"{pad}Filter[{node.predicate}]")
+    elif isinstance(node, P.Project):
+        exprs = ", ".join(f"{f.name} := {e}"
+                          for f, e in zip(node.schema.fields[:6], node.exprs[:6]))
+        more = " ..." if len(node.exprs) > 6 else ""
+        lines.append(f"{pad}Project[{exprs}{more}]")
+    elif isinstance(node, P.TableScan):
+        lines.append(f"{pad}TableScan[{node.catalog}.{node.table}] => "
+                     f"{_schema_str(node)}")
+    elif isinstance(node, P.Union):
+        lines.append(f"{pad}Union => {_schema_str(node)}")
+    elif isinstance(node, P.Values):
+        lines.append(f"{pad}Values[{len(node.rows)} rows]")
+    else:
+        lines.append(f"{pad}{type(node).__name__} => {_schema_str(node)}")
+    for c in node.children:
+        _fmt(c, lines, depth + 1)
