@@ -1,0 +1,102 @@
+"""Memory connector, DDL/DML statements, null-aware grouping and sorting."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def mem_engine(tpch_sf001):
+    from trino_tpu import Engine
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    e = Engine()
+    e.register_catalog("tpch", tpch_sf001)
+    e.register_catalog("memory", MemoryConnector())
+    return e
+
+
+def test_create_insert_select(mem_engine):
+    e = mem_engine
+    e.execute_sql("create table t (a bigint, b varchar, c decimal(10,2), d date)")
+    e.execute_sql("insert into t values (1, 'x', 1.50, date '2020-01-02'), "
+                  "(2, 'y', 2.25, date '2021-03-04'), (3, null, null, null)")
+    r = e.execute_sql("select * from t order by a")
+    assert r.columns[0].tolist() == [1, 2, 3]
+    assert r.columns[1].tolist() == ["x", "y", None]
+    assert r.columns[2].tolist()[:2] == [1.5, 2.25]
+    assert r.columns[2][2] is None
+
+
+def test_null_group_and_sort(mem_engine):
+    e = mem_engine
+    e.execute_sql("create table t (a bigint, b varchar, c decimal(10,2))")
+    e.execute_sql("insert into t values (1, 'x', 1.50), (2, 'y', 2.25), "
+                  "(3, null, null), (4, null, 5.00)")
+    r = e.execute_sql(
+        "select b, sum(c) s, count(*) n from t group by b order by b nulls first")
+    assert len(r) == 3
+    assert r.columns[0][0] is None  # NULLs form one group, placed first
+    assert r.columns[2][0] == 2
+    assert abs(r.columns[1][0] - 5.0) < 1e-9
+    r = e.execute_sql("select b from t group by b order by b")
+    assert r.columns[0].tolist() == ["x", "y", None]  # default NULLS LAST
+
+
+def test_ctas_and_cross_catalog_join(mem_engine):
+    e = mem_engine
+    e.execute_sql("create table amerika as "
+                  "select n_name, n_regionkey from nation where n_regionkey = 1")
+    r = e.execute_sql("select count(*) c from amerika")
+    assert r.columns[0][0] == 5
+    r = e.execute_sql("select a.n_name, r_name from amerika a, region "
+                      "where a.n_regionkey = r_regionkey order by a.n_name")
+    assert r.columns[1].tolist() == ["AMERICA"] * 5
+    e.execute_sql("drop table amerika")
+
+
+def test_insert_select_and_partial_columns(mem_engine):
+    e = mem_engine
+    e.execute_sql("create table t (a bigint, b varchar)")
+    e.execute_sql("insert into t (a) values (7)")
+    e.execute_sql("insert into t select n_nationkey, n_name from nation "
+                  "where n_nationkey < 2")
+    r = e.execute_sql("select a, b from t order by a")
+    assert r.columns[0].tolist() == [0, 1, 7]
+    assert r.columns[1].tolist() == ["ALGERIA", "ARGENTINA", None]
+
+
+def test_drop_and_if_exists(mem_engine):
+    e = mem_engine
+    e.execute_sql("create table t (a bigint)")
+    e.execute_sql("drop table t")
+    with pytest.raises(Exception):
+        e.execute_sql("select * from t")
+    e.execute_sql("drop table if exists t")
+    e.execute_sql("create table if not exists t2 (a bigint)")
+    e.execute_sql("create table if not exists t2 (a bigint)")
+
+
+def test_explain_analyze(mem_engine):
+    r = mem_engine.execute_sql("explain analyze select count(*) from nation")
+    text = "\n".join(r.columns[0].tolist())
+    assert "executed in" in text and "1 output rows" in text
+
+
+def test_ctas_if_not_exists_no_duplicate(mem_engine):
+    e = mem_engine
+    e.execute_sql("create table c1 as select n_nationkey from nation")
+    e.execute_sql("create table if not exists c1 as select n_nationkey from nation")
+    r = e.execute_sql("select count(*) c from c1")
+    assert r.columns[0][0] == 25  # second CTAS skipped the insert entirely
+
+
+def test_unknown_catalog_qualifier(mem_engine):
+    from trino_tpu.sql.frontend import SemanticError
+
+    with pytest.raises(SemanticError, match="memry"):
+        mem_engine.execute_sql("select * from memry.t")
+
+
+def test_drop_missing_table_message(mem_engine):
+    with pytest.raises(ValueError, match="does not exist"):
+        mem_engine.execute_sql("drop table never_created")

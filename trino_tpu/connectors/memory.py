@@ -1,0 +1,147 @@
+"""In-memory writable connector.
+
+Reference: plugin/trino-memory (MemoryPagesStore.java:43 keeps pages on heap; the
+connector serves CREATE TABLE / INSERT / SELECT for tests and small reference data).
+Host-side numpy column store; string columns are dictionary-encoded on insert (ids into
+a growable per-column Dictionary), so scans hand the device pure fixed-width arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..page import Page, Schema
+from ..types import DecimalType, parse_date_literal
+from .tpch import Dictionary
+
+__all__ = ["MemoryConnector"]
+
+SPLIT_ROWS = 1 << 20
+
+
+class _GrowableDict:
+    """Mutable value<->id mapping materializing an immutable Dictionary view."""
+
+    def __init__(self):
+        self.values: list = []
+        self.ids: dict = {}
+
+    def encode(self, vals):
+        out = np.empty(len(vals), np.int32)
+        for i, v in enumerate(vals):
+            if v is None:
+                out[i] = 0  # masked by the null bitmap
+                continue
+            v = str(v)
+            ix = self.ids.get(v)
+            if ix is None:
+                ix = len(self.values)
+                self.ids[v] = ix
+                self.values.append(v)
+            out[i] = ix
+        return out
+
+    def view(self) -> Dictionary:
+        return Dictionary(values=np.array(self.values if self.values else [""],
+                                          dtype=object))
+
+
+@dataclasses.dataclass
+class _MemTable:
+    schema: Schema
+    columns: list  # np arrays (string cols: int32 dict ids)
+    nulls: list  # np bool arrays | None
+    growable: dict  # column name -> _GrowableDict (string columns)
+
+
+@dataclasses.dataclass(frozen=True)
+class MemorySplit:
+    table: str
+    lo: int
+    hi: int
+
+
+class MemoryConnector:
+    name = "memory"
+
+    def __init__(self):
+        self._tables: dict = {}
+
+    # metadata ---------------------------------------------------------------
+    def tables(self):
+        return list(self._tables)
+
+    def schema(self, table: str) -> Schema:
+        return self._tables[table].schema
+
+    def dictionaries(self, table: str) -> dict:
+        t = self._tables[table]
+        return {name: gd.view() for name, gd in t.growable.items()}
+
+    def row_count(self, table: str) -> int:
+        t = self._tables[table]
+        return 0 if not t.columns else len(t.columns[0])
+
+    def column_range(self, table: str, column: str):
+        return (None, None)
+
+    # DDL/DML ----------------------------------------------------------------
+    def create_table(self, table: str, schema: Schema, if_not_exists=False) -> bool:
+        """Returns False when IF NOT EXISTS skipped an existing table."""
+        if table in self._tables:
+            if if_not_exists:
+                return False
+            raise ValueError(f"table {table} already exists")
+        growable = {f.name: _GrowableDict() for f in schema.fields if f.type.is_string}
+        self._tables[table] = _MemTable(
+            schema, [np.empty((0,), np.dtype(f.type.dtype)) for f in schema.fields],
+            [None] * len(schema.fields), growable)
+        return True
+
+    def drop_table(self, table: str, if_exists=False) -> None:
+        if table not in self._tables:
+            if if_exists:
+                return
+            raise ValueError(f"table {table} does not exist")
+        del self._tables[table]
+
+    def append(self, table: str, decoded_columns, null_flags=None) -> None:
+        """Append decoded host values (strings as python str, decimals as raw scaled
+        ints, dates as epoch days)."""
+        t = self._tables[table]
+        n = len(decoded_columns[0]) if decoded_columns else 0
+        for i, f in enumerate(t.schema.fields):
+            vals = decoded_columns[i]
+            nulls = np.array([v is None for v in vals], bool) if \
+                null_flags is None else np.asarray(null_flags[i], bool)
+            if f.type.is_string:
+                arr = t.growable[f.name].encode(vals)
+            else:
+                arr = np.array([0 if v is None else v for v in vals],
+                               np.dtype(f.type.dtype))
+            t.columns[i] = np.concatenate([t.columns[i], arr])
+            if nulls.any() or t.nulls[i] is not None:
+                prev = (t.nulls[i] if t.nulls[i] is not None
+                        else np.zeros(len(t.columns[i]) - n, bool))
+                t.nulls[i] = np.concatenate([prev, nulls])
+
+    # scan -------------------------------------------------------------------
+    def splits(self, table: str, n_hint: int = 0):
+        n = self.row_count(table)
+        return [MemorySplit(table, lo, min(lo + SPLIT_ROWS, n))
+                for lo in range(0, n, SPLIT_ROWS)]
+
+    def generate(self, split: MemorySplit, columns=None) -> Page:
+        t = self._tables[split.table]
+        names = columns if columns is not None else t.schema.names
+        out_schema = Schema(tuple(t.schema.field(n) for n in names))
+        cols, nulls = [], []
+        for n in names:
+            i = t.schema.index(n)
+            cols.append(jnp.asarray(t.columns[i][split.lo:split.hi]))
+            nm = t.nulls[i]
+            nulls.append(None if nm is None else jnp.asarray(nm[split.lo:split.hi]))
+        return Page(out_schema, tuple(cols), tuple(nulls), None)

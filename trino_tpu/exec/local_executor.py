@@ -230,11 +230,12 @@ class LocalExecutor:
                 page.columns, page.null_masks, page.valid_mask()
             )
             key_vals = tuple(cols[i] for i in node.keys)
+            key_nulls = tuple(nulls[i] for i in node.keys)
             inputs = [
                 (None, None) if e is None else evaluate(e, cols, nulls) for e in acc_exprs
             ]
             return hashagg.groupby_insert(
-                state, key_vals, key_types, valid, inputs, acc_kinds
+                state, key_vals, key_types, valid, inputs, acc_kinds, key_nulls
             )
 
         out = (stream, key_types, acc_specs, acc_exprs, acc_kinds, step)
@@ -262,12 +263,15 @@ class LocalExecutor:
         # (not FLOPs) dominates on tunneled links
         n_groups = int(hashagg.group_count(state))
         bucket = max(1 << max(n_groups - 1, 1).bit_length(), 64)
-        keys, accs = hashagg.compact_groups(state, bucket)
+        keys, key_nulls, accs = hashagg.compact_groups(state, bucket)
         key_cols = [np.asarray(k[:n_groups]) for k in keys]
+        key_null_cols = [np.asarray(kn[:n_groups]) for kn in key_nulls]
         acc_cols = [np.asarray(a[:n_groups]) for a in accs]
         out_cols = key_cols + _finalize_aggs(node.aggs, acc_cols, n_groups)
         arrays = [jnp.asarray(c) for c in out_cols]
-        page = Page(node.schema, tuple(arrays), tuple(None for _ in arrays), None)
+        out_nulls = tuple(jnp.asarray(kn) if kn.any() else None for kn in key_null_cols
+                          ) + tuple(None for _ in node.aggs)
+        page = Page(node.schema, tuple(arrays), out_nulls, None)
         dicts = tuple(stream.dicts[i] for i in node.keys) + tuple(None for _ in node.aggs)
         return page, dicts
 
@@ -670,11 +674,24 @@ def _sort_page(page: Page, keys, dicts=None) -> Page:
     order = np.arange(len(cols[0]) if cols else 0)
     for k in reversed(keys):
         c = sort_cols[k.channel][order]
+        nm_k = nulls[k.channel]
+        if nm_k is not None and len(c):
+            # NULL rows hold arbitrary fill values: pin them all to one value so the
+            # secondary-key order among NULL rows survives this stable pass
+            c = c.copy()
+            c[nm_k[order]] = c[0]
         if not np.issubdtype(c.dtype, np.number):
             _, c = np.unique(c, return_inverse=True)  # string -> collation rank
         if not k.ascending:
             c = -c.astype(np.int64 if np.issubdtype(c.dtype, np.integer) else np.float64)
         order = order[np.argsort(c, kind="stable")]
+        nm = nulls[k.channel]
+        if nm is not None:
+            # null placement outranks the value ordering for this key
+            ind = nm[order].astype(np.int8)
+            if k.nulls_first:
+                ind = -ind
+            order = order[np.argsort(ind, kind="stable")]
     new_cols = tuple(jnp.asarray(c[order]) for c in cols)
     new_nulls = tuple(None if n is None else jnp.asarray(n[order]) for n in nulls)
     return Page(page.schema, new_cols, new_nulls, None)

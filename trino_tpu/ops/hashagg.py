@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..types import BOOLEAN as _BOOL_KEY
 from .hashing import EMPTY_KEY, pack_keys, splitmix64
 
 __all__ = ["GroupByState", "groupby_init", "groupby_insert", "AGG_INITS", "agg_update", "agg_finalize"]
@@ -46,11 +47,12 @@ class GroupByState:
 
     table: jnp.ndarray  # [capacity+1] int64 packed keys; EMPTY_KEY = free; last slot = overflow sink
     key_cols: tuple  # per-key original column values captured at insert ([capacity+1] each)
+    key_nulls: tuple  # per-key null flag per slot (SQL GROUP BY: NULLs form ONE group)
     accs: tuple  # per-aggregate accumulator arrays ([capacity+1, ...])
     overflow: jnp.ndarray  # bool scalar: some row failed to place within MAX_PROBES
 
     def tree_flatten(self):
-        return (self.table, self.key_cols, self.accs, self.overflow), None
+        return (self.table, self.key_cols, self.key_nulls, self.accs, self.overflow), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -65,8 +67,9 @@ def groupby_init(capacity: int, key_dtypes, acc_specs) -> GroupByState:
     """acc_specs: sequence of (dtype, init_scalar) per accumulator array."""
     table = jnp.full((capacity + 1,), EMPTY_KEY, dtype=jnp.int64)
     key_cols = tuple(jnp.zeros((capacity + 1,), dt) for dt in key_dtypes)
+    key_nulls = tuple(jnp.zeros((capacity + 1,), bool) for _ in key_dtypes)
     accs = tuple(jnp.full((capacity + 1,), init, dtype=dt) for dt, init in acc_specs)
-    return GroupByState(table, key_cols, accs, jnp.zeros((), bool))
+    return GroupByState(table, key_cols, key_nulls, accs, jnp.zeros((), bool))
 
 
 def _probe_insert(table, packed, valid):
@@ -102,13 +105,32 @@ def _probe_insert(table, packed, valid):
 
 
 def groupby_insert(state: GroupByState, key_vals: Sequence, key_types, valid,
-                   agg_inputs: Sequence, agg_updates: Sequence[str]) -> GroupByState:
+                   agg_inputs: Sequence, agg_updates: Sequence[str],
+                   key_nulls: Sequence = None) -> GroupByState:
     """One page of input → updated state.
 
     agg_inputs[i]: (value_array|None, input_null_mask|None); agg_updates[i]: update kind
-    ('sum','count','min','max','count_star').
+    ('sum','count','min','max','count_star'); key_nulls[i]: null mask of key i or None
+    (SQL GROUP BY treats all NULLs as one group — the null flag joins the packed key
+    and masked values keep NULL rows from colliding with a real value).
     """
-    packed, exact = pack_keys(key_vals, key_types)
+    if key_nulls is None:
+        key_nulls = tuple(None for _ in key_vals)
+    pack_cols, pack_types = [], []
+    masked_vals = []
+    for kv, kt, kn in zip(key_vals, key_types, key_nulls):
+        if kn is None:
+            masked_vals.append(kv)
+            pack_cols.append(kv)
+            pack_types.append(kt)
+        else:
+            mv = jnp.where(kn, jnp.zeros((), kv.dtype), kv)
+            masked_vals.append(mv)
+            pack_cols.append(kn.astype(jnp.int8))
+            pack_types.append(_BOOL_KEY)
+            pack_cols.append(mv)
+            pack_types.append(kt)
+    packed, exact = pack_keys(tuple(pack_cols), tuple(pack_types))
     table, slot, placed = _probe_insert(state.table, packed, valid)
     overflow = state.overflow | jnp.any(valid & ~placed)
     live = valid & placed
@@ -116,13 +138,18 @@ def groupby_insert(state: GroupByState, key_vals: Sequence, key_types, valid,
     # capture original key values per slot (idempotent writes: same key -> same value)
     key_cols = tuple(
         kc.at[jnp.where(live, slot, kc.shape[0] - 1)].set(jnp.where(live, kv, kc[-1]))
-        for kc, kv in zip(state.key_cols, key_vals)
+        for kc, kv in zip(state.key_cols, masked_vals)
+    )
+    state_knulls = tuple(
+        sk if kn is None else
+        sk.at[jnp.where(live, slot, sk.shape[0] - 1)].set(jnp.where(live, kn, sk[-1]))
+        for sk, kn in zip(state.key_nulls, key_nulls)
     )
     accs = tuple(
         agg_update(acc, kind, slot, live, vals_nulls)
         for acc, kind, vals_nulls in zip(state.accs, agg_updates, agg_inputs)
     )
-    return GroupByState(table, key_cols, accs, overflow)
+    return GroupByState(table, key_cols, state_knulls, accs, overflow)
 
 
 def agg_update(acc, kind, slot, live, vals_nulls):
@@ -170,6 +197,7 @@ def agg_finalize(state: GroupByState):
     return occupied, keys, accs
 
 
+
 def group_count(state: GroupByState):
     """Occupied-slot count (device scalar; ONE host sync to size the compaction)."""
     C = state.capacity
@@ -189,5 +217,6 @@ def compact_groups(state: GroupByState, size: int):
     occupied = state.table[:C] != EMPTY_KEY
     idx = jnp.nonzero(occupied, size=size, fill_value=0)[0]
     keys = tuple(k[:C][idx] for k in state.key_cols)
+    key_nulls = tuple(kn[:C][idx] for kn in state.key_nulls)
     accs = tuple(a[:C][idx] for a in state.accs)
-    return keys, accs
+    return keys, key_nulls, accs

@@ -1,0 +1,229 @@
+"""Coordinator HTTP server: the client statement protocol.
+
+Reference: the v1 statement protocol — POST /v1/statement returns a queued query with a
+``nextUri``; the client follows nextUri until results are exhausted
+(dispatcher/QueuedStatementResource.java:110,170, server/protocol/ExecutingStatementResource,
+client paging loop StatementClientV1.java:403).  Query lifecycle mirrors QueryStateMachine
+(execution/QueryState.java:21: QUEUED -> PLANNING -> RUNNING -> FINISHING -> FINISHED/FAILED).
+
+Implementation: stdlib ThreadingHTTPServer + a thread-pool dispatch (the reference's
+dispatch executor); results are paged DATA_ROWS_PER_FETCH rows per GET like the
+reference's token-addressed result pages (server/TaskResource.java:331 token protocol).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+__all__ = ["CoordinatorServer"]
+
+DATA_ROWS_PER_FETCH = 4096
+
+_qids = itertools.count(1)
+
+
+@dataclasses.dataclass
+class _Query:
+    query_id: str
+    sql: str
+    state: str = "QUEUED"  # QUEUED|PLANNING|RUNNING|FINISHED|FAILED|CANCELED
+    error: Optional[str] = None
+    columns: Optional[list] = None  # [{name, type}]
+    rows: Optional[list] = None  # list of row tuples (json-ready)
+    created_at: float = dataclasses.field(default_factory=time.time)
+    finished_at: Optional[float] = None
+    lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
+
+
+def _json_value(v):
+    import numpy as np
+
+    if v is None:
+        return None
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    if isinstance(v, np.str_):
+        return str(v)
+    return v
+
+
+class CoordinatorServer:
+    """Serves an Engine over the statement protocol (one process = coordinator role;
+    the worker data plane is the SPMD mesh inside the engine, reference:
+    CoordinatorModule vs WorkerModule role split)."""
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 8080,
+                 dispatch_threads: int = 4):
+        self.engine = engine
+        self.host = host
+        self.port = port
+        self.queries: dict = {}
+        self._pool = ThreadPoolExecutor(max_workers=dispatch_threads,
+                                        thread_name_prefix="dispatch")
+        # the Engine (plan caches, executor state, memory-connector writes) is not
+        # thread-safe: queries queue concurrently but EXECUTE serially (the
+        # single-device analog of the reference's per-query resource-group admission)
+        self._engine_lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------------
+    def start(self) -> None:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path != "/v1/statement":
+                    self._send(404, {"error": "not found"})
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                sql = self.rfile.read(n).decode()
+                session_catalog = self.headers.get("X-Trino-Catalog")
+                q = server._submit(sql, session_catalog)
+                self._send(200, server._queued_response(q))
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                # /v1/statement/executing/{id}/{token}
+                if len(parts) == 5 and parts[:3] == ["v1", "statement", "executing"]:
+                    qid, token = parts[3], int(parts[4])
+                    q = server.queries.get(qid)
+                    if q is None:
+                        self._send(404, {"error": f"unknown query {qid}"})
+                        return
+                    self._send(200, server._results_response(q, token))
+                    return
+                if len(parts) == 3 and parts[:2] == ["v1", "query"]:
+                    q = server.queries.get(parts[2])
+                    if q is None:
+                        self._send(404, {"error": "unknown query"})
+                        return
+                    self._send(200, server._query_info(q))
+                    return
+                if parts == ["v1", "info"]:
+                    self._send(200, {"coordinator": True, "running": True,
+                                     "nodeVersion": {"version": "trino-tpu-0"}})
+                    return
+                self._send(404, {"error": "not found"})
+
+            def do_DELETE(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) >= 3 and parts[:2] == ["v1", "statement"]:
+                    q = server.queries.get(parts[-1]) or server.queries.get(parts[2])
+                    if q is not None and q.state not in ("FINISHED", "FAILED"):
+                        q.state = "CANCELED"
+                    self._send(204, {})
+                    return
+                self._send(404, {"error": "not found"})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        self._pool.shutdown(wait=False)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- dispatch -----------------------------------------------------------------
+    def _submit(self, sql: str, catalog: Optional[str]) -> _Query:
+        q = _Query(query_id=f"q{next(_qids)}", sql=sql)
+        self.queries[q.query_id] = q
+        self._pool.submit(self._run, q, catalog)
+        return q
+
+    def _run(self, q: _Query, catalog: Optional[str]) -> None:
+        try:
+            with self._engine_lock:
+                if q.state == "CANCELED":  # canceled while queued: never execute
+                    return
+                q.state = "PLANNING"
+                session = self.engine.create_session(catalog)
+                q.state = "RUNNING"
+                res = self.engine.execute_sql(q.sql, session)
+            if q.state == "CANCELED":
+                return
+            if res is None:  # DDL
+                q.columns = [{"name": "result", "type": "boolean"}]
+                q.rows = [[True]]
+            else:
+                q.columns = [{"name": n, "type": t.name}
+                             for n, t in zip(res.names, res.types)]
+                q.rows = [[_json_value(v) for v in row] for row in res.rows()]
+            q.state = "FINISHED"
+        except Exception as e:  # noqa: BLE001 - protocol surface reports all failures
+            q.error = f"{type(e).__name__}: {e}"
+            q.state = "FAILED"
+            traceback.print_exc()
+        finally:
+            q.finished_at = time.time()
+
+    # -- responses ----------------------------------------------------------------
+    def _queued_response(self, q: _Query) -> dict:
+        return {
+            "id": q.query_id,
+            "nextUri": f"{self.url}/v1/statement/executing/{q.query_id}/0",
+            "stats": {"state": q.state},
+        }
+
+    def _results_response(self, q: _Query, token: int) -> dict:
+        if q.state == "FAILED":
+            return {"id": q.query_id, "stats": {"state": q.state},
+                    "error": {"message": q.error}}
+        if q.state == "CANCELED":  # terminal: no nextUri, client stops polling
+            return {"id": q.query_id, "stats": {"state": q.state},
+                    "error": {"message": "query was canceled"}}
+        if q.state not in ("FINISHED",):
+            # still running: client re-polls the same token (long-poll analog)
+            return {"id": q.query_id, "stats": {"state": q.state},
+                    "nextUri": f"{self.url}/v1/statement/executing/{q.query_id}/{token}"}
+        lo = token * DATA_ROWS_PER_FETCH
+        hi = lo + DATA_ROWS_PER_FETCH
+        out = {
+            "id": q.query_id,
+            "columns": q.columns,
+            "data": q.rows[lo:hi],
+            "stats": {"state": q.state, "totalRows": len(q.rows)},
+        }
+        if hi < len(q.rows):
+            out["nextUri"] = (
+                f"{self.url}/v1/statement/executing/{q.query_id}/{token + 1}")
+        return out
+
+    def _query_info(self, q: _Query) -> dict:
+        return {
+            "queryId": q.query_id,
+            "state": q.state,
+            "query": q.sql,
+            "error": q.error,
+            "elapsedMs": round(((q.finished_at or time.time()) - q.created_at) * 1000),
+        }

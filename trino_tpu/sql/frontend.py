@@ -274,7 +274,8 @@ class Planner:
                         "lut", (proj_exprs[och], ir.Constant(ranks, INTEGER)), INTEGER))
                     proj_dicts.append(None)
                     och = len(proj_exprs) - 1
-                nf = s.nulls_first if s.nulls_first is not None else not s.ascending
+                # Trino's default null ordering is NULLS LAST regardless of direction
+                nf = s.nulls_first if s.nulls_first is not None else False
                 order.append(P.SortKey(och, s.ascending, nf))
             order = tuple(order)
             arg_ch, arg_t, arg_d = None, None, None
@@ -779,7 +780,6 @@ class Planner:
 
     def _plan_relation(self, node) -> RelPlan:
         if isinstance(node, A.TableRef):
-            catalog = self.session.catalog or "tpch"
             name = node.name[-1]
             if len(node.name) == 1:
                 # CTE / view expansion (reference: StatementAnalyzer WITH resolution +
@@ -788,10 +788,7 @@ class Planner:
                 if view is not None:
                     cols, sub = view
                     return self._plan_subquery_rel(sub, node.alias or name, cols)
-            conn = self.engine.catalogs.get(node.name[0], None)
-            if conn is not None and len(node.name) > 1:
-                catalog = node.name[0]
-            conn = self.engine.catalogs[catalog]
+            catalog, conn = self._resolve_table(node.name)
             schema = conn.schema(name)
             dicts = conn.dictionaries(name)
             alias = node.alias or name
@@ -837,12 +834,28 @@ class Planner:
                 for n, c in zip(out_names, rel.cols)]
         return RelPlan(plan_node, cols)
 
+    def _resolve_table(self, name_parts) -> tuple:
+        """(catalog, connector) for a table name: qualified name wins, then the session
+        catalog, then any catalog exposing the table (reference: MetadataManager's
+        catalog resolution against the session)."""
+        name = name_parts[-1]
+        if len(name_parts) > 1:
+            if name_parts[0] not in self.engine.catalogs:
+                raise SemanticError(f"catalog {name_parts[0]} is not registered")
+            return name_parts[0], self.engine.catalogs[name_parts[0]]
+        cat = self.session.catalog or "tpch"
+        conn = self.engine.catalogs.get(cat)
+        if conn is not None and name in conn.tables():
+            return cat, conn
+        for cn, c in self.engine.catalogs.items():
+            if name in c.tables():
+                return cn, c
+        raise SemanticError(f"table {name} not found in any catalog")
+
     def _estimate_rows(self, node) -> int:
         if isinstance(node, A.TableRef):
-            catalog = self.session.catalog or "tpch"
-            conn = self.engine.catalogs.get(node.name[0] if len(node.name) > 1 else catalog,
-                                            self.engine.catalogs.get(catalog))
             try:
+                _, conn = self._resolve_table(node.name)
                 return conn.row_count(node.name[-1])
             except Exception:
                 return 1 << 20

@@ -211,6 +211,53 @@ class Select(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class CreateTable(Node):
+    """CREATE TABLE name (col type, ...) | CREATE TABLE name AS query."""
+
+    name: str
+    columns: tuple  # ((name, type_name, params), ...); empty for CTAS
+    as_query: Optional[Node] = None
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InsertInto(Node):
+    name: str
+    columns: tuple  # explicit column list or ()
+    query: Node  # Select/SetOp; VALUES lists parse to Select over Values
+
+
+@dataclasses.dataclass(frozen=True)
+class ValuesRows(Node):
+    rows: tuple  # tuple of tuples of literal expressions
+
+
+@dataclasses.dataclass(frozen=True)
+class DropTable(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateView(Node):
+    name: str
+    query: Node
+    or_replace: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropView(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Explain(Node):
+    query: Node
+    analyze: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class SetOp(Node):
     """UNION / INTERSECT / EXCEPT query body (reference: sql/tree/Union.java etc.)."""
 
@@ -243,7 +290,8 @@ KEYWORDS = {
     "else", "end", "cast", "extract", "join", "inner", "left", "right", "full", "outer",
     "cross", "on", "distinct", "date", "interval", "asc", "desc", "nulls", "first",
     "last", "true", "false", "all", "any", "union", "except", "intersect", "with",
-    "substring", "for", "over", "partition",
+    "substring", "for", "over", "partition", "create", "table", "insert", "into",
+    "values", "drop", "view", "replace", "if", "explain", "analyze",
 }
 
 
@@ -313,12 +361,73 @@ class Parser:
         return self.next()
 
     # entry
-    def parse_statement(self) -> Select:
-        q = self.parse_subquery()
+    def parse_statement(self) -> Node:
+        q = self._parse_statement_body()
         self.accept(";")
         if self.peek().kind != "eof":
             raise ParseError(f"trailing input at pos {self.peek().pos}: {self.peek().value!r}")
         return q
+
+    def _parse_statement_body(self) -> Node:
+        if self.accept("explain"):
+            analyze = bool(self.accept("analyze"))
+            return Explain(self._parse_statement_body(), analyze)
+        if self.accept("create"):
+            or_replace = False
+            if self.accept("or"):
+                self.expect("replace")
+                or_replace = True
+            if self.accept("view"):
+                name = self.expect_kind("ident").value
+                self.expect("as")
+                return CreateView(name, self.parse_subquery(), or_replace)
+            self.expect("table")
+            ine = False
+            if self.accept("if"):
+                self.expect("not")
+                self.expect("exists")
+                ine = True
+            name = self.expect_kind("ident").value
+            if self.accept("as"):
+                return CreateTable(name, (), self.parse_subquery(), ine)
+            self.expect("(")
+            cols = []
+            while True:
+                cn = self.expect_kind("ident").value
+                tn, params = self.parse_type_name()
+                cols.append((cn, tn, params))
+                if not self.accept(","):
+                    break
+            self.expect(")")
+            return CreateTable(name, tuple(cols), None, ine)
+        if self.accept("insert"):
+            self.expect("into")
+            name = self.expect_kind("ident").value
+            cols = self._column_alias_list()
+            if self.accept("values"):
+                rows = []
+                while True:
+                    self.expect("(")
+                    row = [self.parse_expr()]
+                    while self.accept(","):
+                        row.append(self.parse_expr())
+                    self.expect(")")
+                    rows.append(tuple(row))
+                    if not self.accept(","):
+                        break
+                return InsertInto(name, cols, ValuesRows(tuple(rows)))
+            return InsertInto(name, cols, self.parse_subquery())
+        if self.accept("drop"):
+            is_view = bool(self.accept("view"))
+            if not is_view:
+                self.expect("table")
+            ie = False
+            if self.accept("if"):
+                self.expect("exists")
+                ie = True
+            name = self.expect_kind("ident").value
+            return (DropView(name, ie) if is_view else DropTable(name, ie))
+        return self.parse_subquery()
 
     def _column_alias_list(self) -> tuple:
         if not (self.peek().kind == "op" and self.peek().value == "("
