@@ -120,3 +120,36 @@ def test_constant_join_key(engine):
     r = engine.execute_sql(
         "select count(*) c from nation join region on r_regionkey = 0")
     assert r.columns[0][0] == 25
+
+
+def test_dynamic_filter_split_pruning(tpch_sf001):
+    """Inner/semi joins prune probe splits outside the build-key domain."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    conn = TpchConnector(sf=0.01, split_rows=1 << 12)
+    e = Engine()
+    e.register_catalog("tpch", conn)
+    calls = {"n": 0}
+    orig = conn.generate
+
+    def counting(split, columns=None):
+        if split.table == "lineitem":
+            calls["n"] += 1
+        return orig(split, columns)
+
+    conn.generate = counting
+    n_splits = len(conn.splits("lineitem"))
+    assert n_splits > 10
+    r = e.execute_sql("select count(*) c from lineitem where l_orderkey in "
+                      "(select o_orderkey from orders where o_orderkey < 100)")
+    assert calls["n"] <= 2
+    r2 = e.execute_sql("select count(*) c from lineitem, orders "
+                       "where l_orderkey = o_orderkey and o_orderkey < 100")
+    assert r.columns[0][0] == r2.columns[0][0] > 0
+    # outer/anti joins must NOT prune
+    calls["n"] = 0
+    r3 = e.execute_sql("select count(*) c from lineitem where l_orderkey not in "
+                       "(select o_orderkey from orders where o_orderkey >= 100)")
+    assert calls["n"] == n_splits
+    assert r3.columns[0][0] == r.columns[0][0]

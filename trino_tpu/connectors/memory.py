@@ -14,7 +14,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..page import Page, Schema
-from ..types import DecimalType, parse_date_literal
 from .tpch import Dictionary
 
 __all__ = ["MemoryConnector"]

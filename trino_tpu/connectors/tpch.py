@@ -498,6 +498,24 @@ class TpchConnector:
             nsplits = -(-nsplits // n_hint) * n_hint  # round up to a multiple (SPMD batches)
         return [TpchSplit(table, lo, lo + step) for lo in (s * step for s in range(nsplits))]
 
+    def split_range(self, split: TpchSplit, column: str):
+        """(min, max) of ``column`` within a split, or None if unknown — row-derived key
+        columns are monotone in the row index, so split ranges are exact (the reference
+        analog: per-split TupleDomain stats used by dynamic-filter split pruning,
+        server/DynamicFilterService.java:101)."""
+        if split.table == "lineitem" and column == "l_orderkey":
+            return (split.lo + 1, split.hi)
+        monotone = {"orders": "o_orderkey", "customer": "c_custkey",
+                    "part": "p_partkey", "supplier": "s_suppkey"}
+        if monotone.get(split.table) == column:
+            return (split.lo + 1, split.hi)  # 1-based keys over the row range
+        if split.table in ("nation", "region") and column in ("n_nationkey",
+                                                              "r_regionkey"):
+            return (split.lo, split.hi - 1)  # 0-based keys
+        if split.table == "partsupp" and column == "ps_partkey":
+            return (split.lo // 4 + 1, split.hi // 4 + 1)
+        return None
+
     # page source ------------------------------------------------------------
     def table_bound(self, table: str) -> int:
         """Mask bound: orders-count for lineitem, row count otherwise."""

@@ -61,6 +61,17 @@ class MaterializedResult:
 
 
 @dataclasses.dataclass
+class _ScanInfo:
+    """Provenance of a stream's page source: lets joins prune probe splits against
+    build-side key domains (reference: DynamicFilterService split pruning)."""
+
+    conn: object
+    splits: list
+    scan_columns: tuple  # column names requested from the connector
+    columns: tuple  # per OUTPUT channel: source column name | None (through projects)
+
+
+@dataclasses.dataclass
 class _Stream:
     """A streaming pipeline segment: a source of raw pages + a fused transform."""
 
@@ -68,6 +79,7 @@ class _Stream:
     dicts: tuple  # Dictionary|None per channel
     pages: Callable  # () -> iterator of raw source Pages
     transform: Callable  # (cols, nulls, valid) -> (cols, nulls, valid); jit-traceable
+    scan_info: Optional[_ScanInfo] = None
     _jitted: Callable = None  # cached jit of transform applied to a Page
 
     def jitted(self):
@@ -109,6 +121,11 @@ class LocalExecutor:
             child, dicts = self._execute_to_page(node.child)
             return _sort_page(child, node.keys, dicts), dicts
         if isinstance(node, P.Limit):
+            if isinstance(node.child, P.Sort):
+                # TopN fusion (reference: LimitPushDown rewrites Sort+Limit to
+                # TopNOperator): select the top N before the full ordering
+                child, dicts = self._execute_to_page(node.child.child)
+                return _topn_page(child, node.child.keys, node.count, dicts), dicts
             child, dicts = self._execute_to_page(node.child)
             return _limit_page(child, node.count), dicts
         if isinstance(node, P.Aggregate):
@@ -139,7 +156,8 @@ class LocalExecutor:
                 for s in splits:
                     yield conn.generate(s, node.columns)
 
-            return _Stream(node.schema, dicts, pages, lambda c, n, v: (c, n, v))
+            si = _ScanInfo(conn, splits, tuple(node.columns), tuple(node.columns))
+            return _Stream(node.schema, dicts, pages, lambda c, n, v: (c, n, v), si)
 
         if isinstance(node, P.Filter):
             up = self._compile_stream(node.child)
@@ -149,7 +167,7 @@ class LocalExecutor:
                 cols, nulls, valid = up.transform(cols, nulls, valid)
                 return cols, nulls, evaluate_predicate(pred, cols, nulls, valid)
 
-            return _Stream(up.schema, up.dicts, up.pages, transform)
+            return _Stream(up.schema, up.dicts, up.pages, transform, up.scan_info)
 
         if isinstance(node, P.Project):
             up = self._compile_stream(node.child)
@@ -172,7 +190,12 @@ class LocalExecutor:
                            for _, n in out)
                 return vs, ns, valid
 
-            return _Stream(node.schema, dicts, up.pages, transform)
+            si = None
+            if up.scan_info is not None:
+                si = dataclasses.replace(up.scan_info, columns=tuple(
+                    up.scan_info.columns[e.index] if isinstance(e, FieldRef) else None
+                    for e in node.exprs))
+            return _Stream(node.schema, dicts, up.pages, transform, si)
 
         if isinstance(node, P.Join):
             return self._compile_join(node)
@@ -365,6 +388,14 @@ class LocalExecutor:
         build_key_types = tuple(node.right.schema.fields[i].type for i in node.right_keys)
         semi = node.kind in ("semi", "anti")
         build_has_null, build_nonempty = _build_null_stats(build_page, node.right_keys)
+        if node.kind in ("inner", "semi") and node.filter is None:
+            # dynamic filtering: prune probe splits outside the build keys' min/max
+            # domain (reference: DynamicFilterService.createDynamicFilter:260 narrowing
+            # probe-side scans; here domains prune whole splits via connector ranges)
+            pruned = _dynamic_pruned_pages(probe_stream, node, build_page)
+            if pruned is not None:
+                probe_stream = dataclasses.replace(probe_stream, pages=pruned,
+                                                   _jitted=None)
 
         table = None
         if node.filter is None and build_page.capacity > 0:
@@ -612,6 +643,50 @@ def _concat_stream(stream: _Stream) -> Page:
     return Page(stream.schema, tuple(cols_out), tuple(nulls_out), None)
 
 
+def _dynamic_pruned_pages(probe_stream: _Stream, node, build_page: Page):
+    """Page source skipping probe splits disjoint from the build keys' value domain
+    (inner/semi joins only — outer/anti joins must keep unmatched probe rows).
+    Returns None when no pruning is possible."""
+    si = probe_stream.scan_info
+    if si is None or not hasattr(si.conn, "split_range"):
+        return None
+    bvalid = np.asarray(build_page.valid_mask()) if build_page.capacity else \
+        np.zeros((0,), bool)
+    if not bvalid.any():
+        return lambda: iter(())  # empty build: no probe row can match
+    domains = {}
+    for pch, bch in zip(node.left_keys, node.right_keys):
+        col = si.columns[pch] if pch < len(si.columns) else None
+        if col is None:
+            continue
+        f = node.right.schema.fields[bch]
+        if f.type.is_string or f.type.is_floating:
+            continue
+        vals = np.asarray(build_page.columns[bch])[bvalid]
+        nm = build_page.null_masks[bch]
+        if nm is not None:
+            vals = vals[~np.asarray(nm)[bvalid]]
+        if len(vals) == 0:
+            continue
+        domains[col] = (int(vals.min()), int(vals.max()))
+    if not domains:
+        return None
+    conn, splits, scan_cols = si.conn, si.splits, si.scan_columns
+
+    def pages():
+        for s in splits:
+            skip = False
+            for col, (lo, hi) in domains.items():
+                rng = conn.split_range(s, col)
+                if rng is not None and (rng[1] < lo or rng[0] > hi):
+                    skip = True
+                    break
+            if not skip:
+                yield conn.generate(s, list(scan_cols))
+
+    return pages
+
+
 def _build_null_stats(build_page: Page, key_channels):
     """(build_has_null_key, build_nonempty) — host-side, for null-aware anti joins."""
     valid = np.asarray(build_page.valid_mask()) if build_page.capacity else \
@@ -695,6 +770,34 @@ def _sort_page(page: Page, keys, dicts=None) -> Page:
     new_cols = tuple(jnp.asarray(c[order]) for c in cols)
     new_nulls = tuple(None if n is None else jnp.asarray(n[order]) for n in nulls)
     return Page(page.schema, new_cols, new_nulls, None)
+
+
+def _topn_page(page: Page, keys, count: int, dicts=None) -> Page:
+    """ORDER BY + LIMIT: argpartition down to ~count candidates on the primary key,
+    then full lexicographic sort of the survivors (host-side; result-set sized)."""
+    valid = np.asarray(page.valid_mask())
+    n = int(valid.sum())
+    if n > max(4 * count, 1024) and len(keys) >= 1:
+        k0 = keys[0]
+        c = np.asarray(page.columns[k0.channel])[valid]
+        nm = page.null_masks[k0.channel]
+        d = dicts[k0.channel] if dicts is not None else None
+        if nm is None and d is None and np.issubdtype(c.dtype, np.number):
+            v = c if k0.ascending else (
+                -c.astype(np.int64) if np.issubdtype(c.dtype, np.integer)
+                else -c.astype(np.float64))
+            # ties on the primary key require keeping ALL rows equal to the cutoff
+            cutoff = np.partition(v, count - 1)[count - 1]
+            keep_local = v <= cutoff
+            idx = np.nonzero(valid)[0][keep_local]
+            mask = np.zeros_like(valid)
+            mask[idx] = True
+            page = Page(page.schema,
+                        tuple(jnp.asarray(np.asarray(col)[mask])
+                              for col in page.columns),
+                        tuple(None if m is None else jnp.asarray(np.asarray(m)[mask])
+                              for m in page.null_masks), None)
+    return _limit_page(_sort_page(page, keys, dicts), count)
 
 
 def _limit_page(page: Page, count: int) -> Page:

@@ -131,10 +131,17 @@ class CoordinatorServer:
 
             def do_DELETE(self):
                 parts = self.path.strip("/").split("/")
-                if len(parts) >= 3 and parts[:2] == ["v1", "statement"]:
-                    q = server.queries.get(parts[-1]) or server.queries.get(parts[2])
-                    if q is not None and q.state not in ("FINISHED", "FAILED"):
-                        q.state = "CANCELED"
+                qid = None
+                if len(parts) >= 5 and parts[:3] == ["v1", "statement", "executing"]:
+                    qid = parts[3]  # DELETE on a nextUri (StatementClientV1 cancel)
+                elif len(parts) == 3 and parts[:2] == ["v1", "statement"]:
+                    qid = parts[2]
+                if qid is not None:
+                    q = server.queries.get(qid)
+                    if q is not None:
+                        with q.lock:
+                            if q.state not in ("FINISHED", "FAILED"):
+                                q.state = "CANCELED"
                     self._send(204, {})
                     return
                 self._send(404, {"error": "not found"})
@@ -161,31 +168,54 @@ class CoordinatorServer:
         self._pool.submit(self._run, q, catalog)
         return q
 
+    def _set_state(self, q: _Query, new: str) -> bool:
+        """Transition unless a cancel already landed (q.lock guards the race between
+        DELETE and the dispatch thread — the reference's StateMachine CAS semantics)."""
+        with q.lock:
+            if q.state == "CANCELED":
+                return False
+            q.state = new
+            return True
+
     def _run(self, q: _Query, catalog: Optional[str]) -> None:
         try:
             with self._engine_lock:
-                if q.state == "CANCELED":  # canceled while queued: never execute
-                    return
-                q.state = "PLANNING"
+                if not self._set_state(q, "PLANNING"):
+                    return  # canceled while queued: never execute
                 session = self.engine.create_session(catalog)
-                q.state = "RUNNING"
+                if not self._set_state(q, "RUNNING"):
+                    return
                 res = self.engine.execute_sql(q.sql, session)
-            if q.state == "CANCELED":
-                return
             if res is None:  # DDL
-                q.columns = [{"name": "result", "type": "boolean"}]
-                q.rows = [[True]]
+                columns = [{"name": "result", "type": "boolean"}]
+                rows = [[True]]
             else:
-                q.columns = [{"name": n, "type": t.name}
-                             for n, t in zip(res.names, res.types)]
-                q.rows = [[_json_value(v) for v in row] for row in res.rows()]
-            q.state = "FINISHED"
+                columns = [{"name": n, "type": t.name}
+                           for n, t in zip(res.names, res.types)]
+                rows = [[_json_value(v) for v in row] for row in res.rows()]
+            with q.lock:
+                if q.state != "CANCELED":
+                    q.columns = columns
+                    q.rows = rows
+                    q.state = "FINISHED"
         except Exception as e:  # noqa: BLE001 - protocol surface reports all failures
-            q.error = f"{type(e).__name__}: {e}"
-            q.state = "FAILED"
+            with q.lock:
+                if q.state != "CANCELED":
+                    q.error = f"{type(e).__name__}: {e}"
+                    q.state = "FAILED"
             traceback.print_exc()
         finally:
             q.finished_at = time.time()
+            self._evict_finished()
+
+    def _evict_finished(self, keep: int = 100) -> None:
+        """Bound coordinator memory: retain only the most recent terminal queries'
+        results (reference: QueryTracker expiration)."""
+        done = [q for q in self.queries.values()
+                if q.state in ("FINISHED", "FAILED", "CANCELED")]
+        done.sort(key=lambda q: q.finished_at or 0)
+        for q in done[:-keep] if len(done) > keep else []:
+            self.queries.pop(q.query_id, None)
 
     # -- responses ----------------------------------------------------------------
     def _queued_response(self, q: _Query) -> dict:
