@@ -1,0 +1,96 @@
+"""Scalar function library (reference: operator/scalar/* — the high-traffic subset)."""
+
+import numpy as np
+import pytest
+
+
+def one(engine, sql):
+    return engine.execute_sql(sql).rows()[0]
+
+
+def test_math(engine):
+    p, m, s, t, r = one(engine, "select power(2, 10) p, mod(10, 3) m, sign(-5) s, "
+                        "trunc(3.9) t, round(2.567, 2) r from region limit 1")
+    assert p == 1024.0 and m == 1 and s == -1
+    assert abs(t - 3.0) < 1e-9 and abs(r - 2.57) < 1e-9
+    l, lt, sn, pi = one(engine, "select ln(exp(2.0)) l, log10(1000) lt, sin(0) s, "
+                        "pi() p from region limit 1")
+    assert abs(l - 2.0) < 1e-12 and abs(lt - 3.0) < 1e-12 and sn == 0.0
+    assert abs(pi - np.pi) < 1e-12
+
+
+def test_string_functions(engine):
+    u, n, rv = one(engine, "select upper(n_name) u, length(n_name) n, "
+                   "reverse(n_name) rv from nation where n_nationkey = 0")
+    assert (u, n, rv) == ("ALGERIA", 7, "AIREGLA")
+    sp, sw, rp = one(engine, "select strpos(n_name, 'GER') sp, "
+                     "starts_with(n_name, 'ALG') sw, replace(n_name, 'A', '@') rp "
+                     "from nation where n_nationkey = 0")
+    assert (sp, bool(sw), rp) == (3, True, "@LGERI@")
+    c1, c2 = one(engine, "select concat('pre-', n_name) c1, n_name || '-post' c2 "
+                 "from nation where n_nationkey = 0")
+    assert (c1, c2) == ("pre-ALGERIA", "ALGERIA-post")
+    lp, rp2 = one(engine, "select lpad(n_name, 10, '.') lp, rpad(n_name, 3) rp "
+                  "from nation where n_nationkey = 0")
+    assert (lp, rp2) == ("...ALGERIA", "ALG")
+
+
+def test_date_functions(engine, tpch_pandas):
+    import pandas as pd
+
+    got = engine.execute_sql(
+        "select o_orderdate d, date_trunc('month', o_orderdate) m, "
+        "date_trunc('year', o_orderdate) y, quarter(o_orderdate) q, "
+        "day_of_week(o_orderdate) dw, day_of_year(o_orderdate) dy "
+        "from orders order by o_orderkey limit 50")
+    base = np.datetime64("1970-01-01")
+    for d, m, y, q, dw, dy in got.rows():
+        ts = pd.Timestamp(base + np.timedelta64(int(d), "D"))
+        assert pd.Timestamp(base + np.timedelta64(int(m), "D")) == ts.replace(day=1)
+        assert pd.Timestamp(base + np.timedelta64(int(y), "D")) == ts.replace(
+            month=1, day=1)
+        assert q == (ts.month - 1) // 3 + 1
+        assert dw == ts.isoweekday()
+        assert dy == ts.dayofyear
+
+
+def test_conditional(engine):
+    z, nz, i = one(engine, "select nullif(n_nationkey, 0) z, nullif(n_nationkey, 9) nz,"
+                   " if(n_nationkey = 0, 'zero', 'other') i "
+                   "from nation where n_nationkey = 0")
+    assert z is None and nz == 0 and i == "zero"
+    r = engine.execute_sql(
+        "select case when n_nationkey < 5 then 'low' when n_nationkey < 15 then 'mid' "
+        "else 'high' end b, count(*) c from nation group by 1 order by 1")
+    assert dict(r.rows()) == {"high": 10, "low": 5, "mid": 10}
+
+
+def test_string_case_order(engine):
+    # CASE-derived string dictionaries sort by collation in ORDER BY
+    r = engine.execute_sql(
+        "select distinct case when n_nationkey < 5 then 'b-low' else 'a-high' end v "
+        "from nation order by v")
+    assert r.columns[0].tolist() == ["a-high", "b-low"]
+
+
+def test_review_fixes(engine):
+    # nullif with NULL second argument returns the first argument
+    r = engine.execute_sql(
+        "select nullif(n_nationkey, nullif(0, 0)) v from nation where n_nationkey = 2")
+    assert r.columns[0][0] == 2
+    # round half away from zero
+    r = engine.execute_sql("select round(0.125, 2) a, round(2.5) b, round(-2.5) c "
+                           "from region limit 1")
+    a, b, c = r.rows()[0]
+    assert abs(a - 0.13) < 1e-9 and b == 3 and c == -3
+    # lpad repeating multi-char pattern; empty pad rejected
+    r = engine.execute_sql("select lpad(n_name, 12, 'xy') v from nation "
+                           "where n_nationkey = 0")
+    assert r.columns[0][0] == "xyxyxALGERIA"
+    from trino_tpu.sql.frontend import SemanticError
+    with pytest.raises(SemanticError, match="must not be empty"):
+        engine.execute_sql("select lpad(n_name, 12, '') from nation")
+    # width_bucket
+    r = engine.execute_sql(
+        "select width_bucket(5.5, 0, 10, 5) w from region limit 1")
+    assert r.columns[0][0] == 3

@@ -105,36 +105,62 @@ class LocalExecutor:
         self.catalogs = catalogs
         self._stream_cache: dict = {}  # id(node) -> (node, _Stream)
         self._agg_cache: dict = {}  # id(node) -> compiled aggregation artifacts
+        self.stats: dict = {}  # id(node) -> {"rows": int, "wall_s": float}
 
     # ------------------------------------------------------------------ public
     def execute(self, node: P.PlanNode) -> MaterializedResult:
+        self.stats = {}
         page, dicts = self._execute_to_page(node)
         return _materialize(page, dicts)
+
+    def _record(self, node, page, t0) -> None:
+        """Blocking-operator stats (reference: OperatorStats via OperationTimer,
+        operator/OperatorContext.java).  Streaming operators fuse into their sink, so
+        stats attach at pipeline-breaker granularity, and wall times are CUMULATIVE
+        over the operator's subtree (each breaker includes everything beneath it)."""
+        import time as _time
+
+        s = self.stats.setdefault(id(node), {"rows": 0, "wall_s": 0.0})
+        s["rows"] = int(np.asarray(page.valid_mask()).sum()) if page.capacity else 0
+        s["wall_s"] += _time.perf_counter() - t0
 
     # ---------------------------------------------------------------- internal
     def _execute_to_page(self, node: P.PlanNode):
         """Run a (sub)plan to completion, returning one host-side Page + dicts."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         if isinstance(node, P.Output):
             child, dicts = self._execute_to_page(node.child)
             return Page(node.schema, child.columns, child.null_masks, child.valid), dicts
         if isinstance(node, P.Sort):
             child, dicts = self._execute_to_page(node.child)
-            return _sort_page(child, node.keys, dicts), dicts
+            page = _sort_page(child, node.keys, dicts)
+            self._record(node, page, t0)
+            return page, dicts
         if isinstance(node, P.Limit):
             if isinstance(node.child, P.Sort):
                 # TopN fusion (reference: LimitPushDown rewrites Sort+Limit to
                 # TopNOperator): select the top N before the full ordering
                 child, dicts = self._execute_to_page(node.child.child)
-                return _topn_page(child, node.child.keys, node.count, dicts), dicts
+                page = _topn_page(child, node.child.keys, node.count, dicts)
+                self._record(node, page, t0)
+                return page, dicts
             child, dicts = self._execute_to_page(node.child)
             return _limit_page(child, node.count), dicts
         if isinstance(node, P.Aggregate):
-            return self._run_aggregate(node)
+            page, dicts = self._run_aggregate(node)
+            self._record(node, page, t0)
+            return page, dicts
         if isinstance(node, P.Window):
-            return self._run_window(node)
+            page, dicts = self._run_window(node)
+            self._record(node, page, t0)
+            return page, dicts
         # streaming leaf reached directly (scan/filter/project/join-probe): materialize
         stream = self._compile_stream(node)
-        return _concat_stream(stream), stream.dicts
+        page = _concat_stream(stream)
+        self._record(node, page, t0)
+        return page, stream.dicts
 
     # -- streaming segment compilation ---------------------------------------
     def _compile_stream(self, node: P.PlanNode) -> _Stream:
@@ -782,7 +808,9 @@ def _topn_page(page: Page, keys, count: int, dicts=None) -> Page:
         c = np.asarray(page.columns[k0.channel])[valid]
         nm = page.null_masks[k0.channel]
         d = dicts[k0.channel] if dicts is not None else None
-        if nm is None and d is None and np.issubdtype(c.dtype, np.number):
+        if nm is None and d is None and np.issubdtype(c.dtype, np.number) and not (
+                np.issubdtype(c.dtype, np.floating) and np.isnan(c).any()):
+            # (NaN keys skip the prefilter: partition would poison the cutoff)
             v = c if k0.ascending else (
                 -c.astype(np.int64) if np.issubdtype(c.dtype, np.integer)
                 else -c.astype(np.float64))

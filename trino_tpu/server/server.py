@@ -76,6 +76,7 @@ class CoordinatorServer:
         # thread-safe: queries queue concurrently but EXECUTE serially (the
         # single-device analog of the reference's per-query resource-group admission)
         self._engine_lock = threading.Lock()
+        self._queries_lock = threading.Lock()  # guards the queries registry itself
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -164,7 +165,8 @@ class CoordinatorServer:
     # -- dispatch -----------------------------------------------------------------
     def _submit(self, sql: str, catalog: Optional[str]) -> _Query:
         q = _Query(query_id=f"q{next(_qids)}", sql=sql)
-        self.queries[q.query_id] = q
+        with self._queries_lock:
+            self.queries[q.query_id] = q
         self._pool.submit(self._run, q, catalog)
         return q
 
@@ -211,11 +213,12 @@ class CoordinatorServer:
     def _evict_finished(self, keep: int = 100) -> None:
         """Bound coordinator memory: retain only the most recent terminal queries'
         results (reference: QueryTracker expiration)."""
-        done = [q for q in self.queries.values()
-                if q.state in ("FINISHED", "FAILED", "CANCELED")]
-        done.sort(key=lambda q: q.finished_at or 0)
-        for q in done[:-keep] if len(done) > keep else []:
-            self.queries.pop(q.query_id, None)
+        with self._queries_lock:
+            done = [q for q in self.queries.values()
+                    if q.state in ("FINISHED", "FAILED", "CANCELED")]
+            done.sort(key=lambda q: q.finished_at or 0)
+            for q in done[:-keep] if len(done) > keep else []:
+                self.queries.pop(q.query_id, None)
 
     # -- responses ----------------------------------------------------------------
     def _queued_response(self, q: _Query) -> dict:

@@ -1085,9 +1085,14 @@ class Planner:
             return _coerce(v, t), (d if t.is_string else None)
         if isinstance(ast, A.Extract):
             v, _ = self._translate(ast.value, cols)
-            if ast.field not in ("year", "month", "day"):
+            field = {"dow": "day_of_week", "doy": "day_of_year",
+                     "day_of_week": "day_of_week", "day_of_year": "day_of_year"}.get(
+                ast.field, ast.field)
+            if field in ("day_of_week", "day_of_year"):
+                return ir.Call(field, (v,), BIGINT), None
+            if field not in ("year", "month", "day", "quarter"):
                 raise SemanticError(f"extract({ast.field}) not supported")
-            return ir.Call(f"extract_{ast.field}", (v,), BIGINT), None
+            return ir.Call(f"extract_{field}", (v,), BIGINT), None
         if isinstance(ast, A.FuncCall):
             return self._translate_func(ast, cols)
         if isinstance(ast, A.ScalarSubquery):
@@ -1162,6 +1167,31 @@ class Planner:
         return e, None
 
     def _translate_case(self, ast: A.CaseExpr, cols):
+        # string-literal result branches build a small derived dictionary so values stay
+        # ids on device (reference analog: VARCHAR constants in generated projections)
+        value_asts = [v for _, v in ast.whens] + (
+            [ast.default] if ast.default is not None else [])
+        if all(isinstance(v, (A.StringLit, A.NullLit)) for v in value_asts) and any(
+                isinstance(v, A.StringLit) for v in value_asts):
+            from ..connectors.tpch import Dictionary
+
+            uniq = sorted({v.value for v in value_asts if isinstance(v, A.StringLit)})
+            d = Dictionary(values=np.array(uniq, dtype=object))
+            t = VarcharType.of(None)
+
+            def as_const(v):
+                if isinstance(v, A.NullLit):
+                    return ir.Constant(None, t)
+                return ir.Constant(uniq.index(v.value), t)
+
+            out = (as_const(ast.default) if ast.default is not None
+                   else ir.Constant(None, t))
+            for cond, val in reversed(ast.whens):
+                if ast.operand is not None:
+                    cond = A.BinaryOp("eq", ast.operand, cond)
+                c, _ = self._translate(cond, cols)
+                out = ir.Call("if", (c, as_const(val), out), t)
+            return out, d
         whens = []
         for cond, val in ast.whens:
             if ast.operand is not None:
@@ -1182,19 +1212,142 @@ class Planner:
             out = ir.Call("if", (c, _coerce(v, t), out), t)
         return out, None
 
+    _STRING_MAP_FUNCS = {
+        "upper": str.upper, "lower": str.lower, "trim": str.strip,
+        "ltrim": str.lstrip, "rtrim": str.rstrip,
+        "reverse": lambda s: s[::-1],
+    }
+    _MATH_DOUBLE_FUNCS = ("sqrt", "exp", "ln", "log10", "log2", "sin", "cos", "tan",
+                          "asin", "acos", "atan", "cbrt", "degrees", "radians")
+
     def _translate_func(self, ast: A.FuncCall, cols):
         name = ast.name
         if name in AGG_FUNCS:
             raise SemanticError(f"aggregate {name} in scalar context")
-        if name in ("abs", "sqrt", "floor", "ceil", "ceiling", "exp", "ln", "round"):
+        if name == "round" and len(ast.args) == 2:
+            v, _ = self._translate(ast.args[0], cols)
+            if not isinstance(ast.args[1], A.NumberLit):
+                raise SemanticError("round() scale must be a literal")
+            n = int(ast.args[1].text)
+            return ir.Call("round_n", (_coerce(v, DOUBLE),), DOUBLE, meta=(n,)), None
+        if name in ("abs", "sqrt", "floor", "ceil", "ceiling", "exp", "ln", "round",
+                    "sign", "trunc") and name not in self._STRING_MAP_FUNCS:
             args = [self._translate(a, cols)[0] for a in ast.args]
             op = "ceil" if name == "ceiling" else name
-            t = args[0].type if name in ("abs", "round") else DOUBLE
+            t = args[0].type if name in ("abs", "round", "sign", "trunc") else DOUBLE
             if name in ("floor", "ceil", "ceiling"):
                 t = args[0].type if args[0].type.is_integer else BIGINT
                 if isinstance(args[0].type, DecimalType) or args[0].type.is_floating:
                     return ir.Call(op, (_coerce(args[0], DOUBLE),), DOUBLE), None
+            if name in ("round", "trunc") and isinstance(args[0].type, DecimalType):
+                # raw scaled ints would round/truncate in raw units; compute in double
+                # (documented deviation, like decimal division)
+                return ir.Call(op, (_coerce(args[0], DOUBLE),), DOUBLE), None
+            if name == "sqrt" or (name in ("exp", "ln")):
+                return ir.Call(op, (_coerce(args[0], DOUBLE),), DOUBLE), None
             return ir.Call(op, tuple(args), t), None
+        if name in self._MATH_DOUBLE_FUNCS:
+            v, _ = self._translate(ast.args[0], cols)
+            return ir.Call(name, (_coerce(v, DOUBLE),), DOUBLE), None
+        if name in ("power", "pow"):
+            a, _ = self._translate(ast.args[0], cols)
+            b, _ = self._translate(ast.args[1], cols)
+            return ir.Call("power", (_coerce(a, DOUBLE), _coerce(b, DOUBLE)),
+                           DOUBLE), None
+        if name == "atan2":
+            a, _ = self._translate(ast.args[0], cols)
+            b, _ = self._translate(ast.args[1], cols)
+            return ir.Call("atan2", (_coerce(a, DOUBLE), _coerce(b, DOUBLE)),
+                           DOUBLE), None
+        if name == "mod":
+            a, _ = self._translate(ast.args[0], cols)
+            b, _ = self._translate(ast.args[1], cols)
+            return _arith("modulus", a, b), None
+        if name == "pi":
+            import math
+
+            return ir.Constant(math.pi, DOUBLE), None
+        if name == "width_bucket":
+            args = [self._translate(a, cols)[0] for a in ast.args]
+            return ir.Call("width_bucket",
+                           (_coerce(args[0], DOUBLE), _coerce(args[1], DOUBLE),
+                            _coerce(args[2], DOUBLE), _coerce(args[3], BIGINT)),
+                           BIGINT), None
+        if name == "nullif":
+            a, ad = self._translate(ast.args[0], cols)
+            b, _ = self._translate(ast.args[1], cols)
+            t = common_super_type(a.type, b.type)
+            return ir.Call("nullif", (_coerce(a, t), _coerce(b, t)), t), ad
+        if name == "if":
+            whens = ((ast.args[0], ast.args[1]),)
+            default = ast.args[2] if len(ast.args) > 2 else None
+            return self._translate_case(A.CaseExpr(None, whens, default), cols)
+        if name in ("year", "month", "day", "quarter"):
+            v, _ = self._translate(ast.args[0], cols)
+            return ir.Call(f"extract_{name}", (v,), BIGINT), None
+        if name in ("day_of_week", "dow"):
+            v, _ = self._translate(ast.args[0], cols)
+            return ir.Call("day_of_week", (v,), BIGINT), None
+        if name in ("day_of_year", "doy"):
+            v, _ = self._translate(ast.args[0], cols)
+            return ir.Call("day_of_year", (v,), BIGINT), None
+        if name == "date_trunc":
+            if not isinstance(ast.args[0], A.StringLit):
+                raise SemanticError("date_trunc unit must be a literal")
+            unit = ast.args[0].value.lower()
+            if unit not in ("year", "quarter", "month", "week", "day"):
+                raise SemanticError(f"date_trunc unit {unit} not supported")
+            v, _ = self._translate(ast.args[1], cols)
+            return ir.Call(f"date_trunc_{unit}", (v,), DATE), None
+        if name == "current_date":
+            import datetime
+
+            return ir.Constant((datetime.date.today()
+                                - datetime.date(1970, 1, 1)).days, DATE), None
+        if name in self._STRING_MAP_FUNCS:
+            v, d = self._require_dict(ast.args[0], cols, name)
+            lut, nd = d.map_values(self._STRING_MAP_FUNCS[name])
+            return ir.Call("lut", (v, ir.Constant(lut, v.type)), v.type), nd
+        if name == "length":
+            v, d = self._require_dict(ast.args[0], cols, name)
+            table = np.array([len(str(s)) for s in d.values], np.int64)
+            return ir.Call("lut", (v, ir.Constant(table, BIGINT)), BIGINT), None
+        if name == "strpos":
+            v, d = self._require_dict(ast.args[0], cols, name)
+            pat = self._literal_str(ast.args[1], name)
+            table = np.array([str(s).find(pat) + 1 for s in d.values], np.int64)
+            return ir.Call("lut", (v, ir.Constant(table, BIGINT)), BIGINT), None
+        if name == "starts_with":
+            v, d = self._require_dict(ast.args[0], cols, name)
+            pat = self._literal_str(ast.args[1], name)
+            lutb = d.match(lambda s: s.startswith(pat))
+            return ir.Call("lut", (v, ir.Constant(lutb, BOOLEAN)), BOOLEAN), None
+        if name == "replace":
+            v, d = self._require_dict(ast.args[0], cols, name)
+            pat = self._literal_str(ast.args[1], name)
+            rep = self._literal_str(ast.args[2], name) if len(ast.args) > 2 else ""
+            lut, nd = d.map_values(lambda s: s.replace(pat, rep))
+            return ir.Call("lut", (v, ir.Constant(lut, v.type)), v.type), nd
+        if name in ("lpad", "rpad"):
+            v, d = self._require_dict(ast.args[0], cols, name)
+            if not isinstance(ast.args[1], A.NumberLit):
+                raise SemanticError(f"{name} size must be a literal")
+            size = int(ast.args[1].text)
+            fill = self._literal_str(ast.args[2], name) if len(ast.args) > 2 else " "
+            if not fill:
+                raise SemanticError(f"{name} padding string must not be empty")
+
+            def pad(s, left=(name == "lpad"), size=size, fill=fill):
+                if len(s) >= size:
+                    return s[:size]
+                padding = (fill * size)[:size - len(s)]  # repeating pattern fill
+                return padding + s if left else s + padding
+
+            lut, nd = d.map_values(pad)
+            t = VarcharType.of(size)
+            return ir.Call("lut", (v, ir.Constant(lut, t)), t), nd
+        if name == "concat":
+            return self._translate_concat(ast.args, cols)
         if name in ("greatest", "least"):
             args = [self._translate(a, cols)[0] for a in ast.args]
             t = args[0].type
@@ -1207,6 +1360,9 @@ class Planner:
             for a in args[1:]:
                 t = common_super_type(t, a.type)
             return ir.Call("coalesce", tuple(_coerce(a, t) for a in args), t), None
+        if name == "substr":
+            ast = dataclasses.replace(ast, name="substring")
+            name = "substring"
         if name == "substring":
             # string functions over dictionary columns compile to an id->id lookup table
             # plus a derived dictionary (planner-side; device only maps ids — the
@@ -1223,6 +1379,42 @@ class Planner:
             t = VarcharType.of(length)
             return ir.Call("lut", (v, ir.Constant(lut, t)), t), nd
         raise SemanticError(f"function {name} not supported")
+
+    def _require_dict(self, arg_ast, cols, fname):
+        v, d = self._translate(arg_ast, cols)
+        if d is None or d.values is None:
+            raise SemanticError(
+                f"{fname} requires an enumerable dictionary-encoded string column")
+        return v, d
+
+    @staticmethod
+    def _literal_str(arg_ast, fname) -> str:
+        if not isinstance(arg_ast, A.StringLit):
+            raise SemanticError(f"{fname} pattern arguments must be string literals")
+        return arg_ast.value
+
+    def _translate_concat(self, args, cols):
+        """concat / ||: one dictionary column combined with any number of string
+        literals (two dictionary columns would need a product dictionary)."""
+        parts = []  # ("lit", str) | ("col", expr, dict)
+        for a in args:
+            if isinstance(a, A.StringLit):
+                parts.append(("lit", a.value))
+                continue
+            v, d = self._require_dict(a, cols, "concat")
+            parts.append(("col", v, d))
+        col_parts = [p for p in parts if p[0] == "col"]
+        if len(col_parts) != 1:
+            raise SemanticError(
+                "concat supports exactly one string column plus literals for now")
+        _, v, d = col_parts[0]
+        prefix = "".join(p[1] for p in parts[:parts.index(col_parts[0])]
+                         if p[0] == "lit")
+        suffix = "".join(p[1] for p in parts[parts.index(col_parts[0]) + 1:]
+                         if p[0] == "lit")
+        lut, nd = d.map_values(lambda s: f"{prefix}{s}{suffix}")
+        t = VarcharType.of(None)
+        return ir.Call("lut", (v, ir.Constant(lut, t)), t), nd
 
     # ---------------------------------------------------------------- output resolution
     def _resolve_output_channel(self, expr, out_names, out_exprs_ast) -> int:

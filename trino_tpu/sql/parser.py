@@ -791,6 +791,11 @@ class Parser:
             e = self.parse_expr()
             self.expect(")")
             return e
+        if t.kind == "keyword" and t.value in ("replace", "if", "left", "right") \
+                and self.peek(1).kind == "op" and self.peek(1).value == "(":
+            # keywords that are also builtin function names in call position
+            t = Token("ident", t.value, t.pos)
+            self.tokens[self.i] = t
         if t.kind == "ident":
             # function call or (qualified) identifier
             if self.peek(1).kind == "op" and self.peek(1).value == "(":

@@ -12,9 +12,11 @@ from . import plan as P
 __all__ = ["format_plan"]
 
 
-def format_plan(node: P.PlanNode) -> str:
+def format_plan(node: P.PlanNode, stats: dict = None) -> str:
+    """``stats``: optional id(node) -> {rows, wall_s} from an EXPLAIN ANALYZE run
+    (reference: PlanPrinter's textDistributedPlan with OperatorStats)."""
     lines: list = []
-    _fmt(node, lines, 0)
+    _fmt(node, lines, 0, stats or {})
     return "\n".join(lines)
 
 
@@ -26,8 +28,9 @@ def _schema_str(node: P.PlanNode) -> str:
     return f"[{inner}]"
 
 
-def _fmt(node: P.PlanNode, lines: list, depth: int) -> None:
+def _fmt(node: P.PlanNode, lines: list, depth: int, stats: dict) -> None:
     pad = "    " * depth
+    before = len(lines)
     if isinstance(node, P.Output):
         lines.append(f"{pad}Output[{', '.join(node.names)}]")
     elif isinstance(node, P.Sort):
@@ -65,5 +68,8 @@ def _fmt(node: P.PlanNode, lines: list, depth: int) -> None:
         lines.append(f"{pad}Values[{len(node.rows)} rows]")
     else:
         lines.append(f"{pad}{type(node).__name__} => {_schema_str(node)}")
+    s = stats.get(id(node))
+    if s is not None and len(lines) > before:
+        lines[before] += f"  [rows: {s['rows']}, {s['wall_s'] * 1000:.1f} ms]"
     for c in node.children:
-        _fmt(c, lines, depth + 1)
+        _fmt(c, lines, depth + 1, stats)
