@@ -87,3 +87,23 @@ def test_distributed_on_subset_mesh(engine):
     local = engine.execute_sql(QUERIES["q6"], session).to_pandas()
     dist = engine.execute_sql(QUERIES["q6"], session, distributed=True, mesh=mesh).to_pandas()
     _frames_equal(dist, local)
+
+
+def test_partitioned_join_matches_local(engine):
+    """Hash-partitioned (all-to-all) join distribution vs broadcast/local results."""
+    import numpy as np
+
+    from trino_tpu.exec.distributed import DistributedExecutor
+    from trino_tpu.sql.frontend import compile_sql
+
+    s = engine.create_session("tpch")
+    q = ("select l_orderkey, count(*) n, sum(l_quantity) q from lineitem, orders "
+         "where l_orderkey = o_orderkey and o_orderdate < date '1994-01-01' "
+         "group by l_orderkey order by l_orderkey limit 50")
+    local = engine.execute_sql(q, s).to_pandas()
+    ex = DistributedExecutor(engine.catalogs, partition_threshold=8)
+    dist = ex.execute(compile_sql(q, engine, s)).to_pandas()
+    assert len(dist) == len(local)
+    for c in local.columns:
+        np.testing.assert_allclose(dist[c].to_numpy().astype(float),
+                                   local[c].to_numpy().astype(float), rtol=1e-9)

@@ -50,6 +50,27 @@ __all__ = ["DistributedExecutor"]
 _MERGE_KIND = {"sum": "sum", "count": "sum", "count_star": "sum", "min": "min", "max": "max"}
 
 
+def _has_duplicate_keys(build_page: Page, key_channels, key_types) -> bool:
+    """Host-side duplicate-key check on the materialized build page (cheaper than
+    building a throwaway device hash table just to read its dup counter)."""
+    valid = np.asarray(build_page.valid_mask())
+    for ch in key_channels:
+        nm = build_page.null_masks[ch]
+        if nm is not None:
+            valid = valid & ~np.asarray(nm)
+    n = int(valid.sum())
+    if n == 0:
+        return False
+    keys = tuple(build_page.columns[ch] for ch in key_channels)
+    packed, exact = pack_keys(keys, key_types)
+    vals = np.asarray(packed)[valid]
+    if not exact:
+        # fingerprint packing: collisions could mask as dups; be conservative and
+        # report dups so the caller takes the general (multi-match-capable) path
+        return len(np.unique(vals)) < n
+    return len(np.unique(vals)) < n
+
+
 @dataclasses.dataclass
 class _DStream:
     """A distributed streaming fragment: per-worker scan source + fused transform."""
@@ -65,11 +86,15 @@ class DistributedExecutor:
     """Executes plans SPMD across the mesh; falls back to LocalExecutor for blocking
     sub-plans (join build sides, small inputs)."""
 
-    def __init__(self, catalogs: dict, mesh=None):
+    def __init__(self, catalogs: dict, mesh=None, partition_threshold: int = 1 << 17):
         self.catalogs = catalogs
         self.mesh = mesh if mesh is not None else worker_mesh()
         self.n_workers = self.mesh.devices.size
         self.local = LocalExecutor(catalogs)
+        # build sides at/above this row count join PARTITIONED (all-to-all probe
+        # exchange) instead of broadcast (reference: DetermineJoinDistributionType's
+        # size-based choice, iterative/rule/DetermineJoinDistributionType.java:51)
+        self.partition_threshold = partition_threshold
 
     # ------------------------------------------------------------------ public
     def execute(self, node: P.PlanNode) -> MaterializedResult:
@@ -169,16 +194,21 @@ class DistributedExecutor:
                 return None
             if node.null_aware and node.kind == "anti":
                 return None  # NOT IN 3VL handled by the local executor for now
-            # build side: local (blocking) execution; table closed over -> replicated
+            # build side: local (blocking) execution
             build_page, build_dicts = self.local._execute_to_page_streamed(node.right)
             build_key_types = tuple(node.right.schema.fields[i].type for i in node.right_keys)
-            table = None
-            if build_page.capacity > 0:
-                table = self.local._build_join_table(build_page, node.right_keys,
-                                                     build_key_types)
-            if table is None:
+            if build_page.capacity == 0 or _has_duplicate_keys(
+                    build_page, node.right_keys, build_key_types):
                 # duplicate build keys (or empty build) need the multi-match strategy,
                 # which is data-dependent-shape -> local fallback for now
+                return None
+            n_build = int(np.asarray(build_page.valid_mask()).sum())
+            if n_build >= self.partition_threshold and not node.null_aware:
+                return self._compile_partitioned_join(node, up, build_page, build_dicts,
+                                                      build_key_types)
+            table = self.local._build_join_table(build_page, node.right_keys,
+                                                 build_key_types)
+            if table is None:
                 return None
             semi = node.kind in ("semi", "anti")
             from ..ops.hashjoin import probe
@@ -208,6 +238,113 @@ class DistributedExecutor:
             return _DStream(node.schema, dicts, up.scan_lo_batches, up.scan_fn, transform)
 
         return None
+
+    # ---------------------------------------------------------------- partitioned join
+    def _compile_partitioned_join(self, node: P.Join, up: _DStream, build_page,
+                                  build_dicts, build_key_types) -> _DStream:
+        """Hash-partitioned join: probe rows are routed all-to-all by key hash so each
+        worker probes only its key range against a small per-worker table (SURVEY §2.8
+        mapping #3: FIXED_HASH exchange -> jax.lax.all_to_all over the ICI mesh).
+
+        v1 scope: the build INPUT arrays are replicated (each worker slices its own
+        partition and builds a table 1/W the size); a multi-host build would route the
+        build rows through the same exchange."""
+        from ..ops.hashjoin import JoinTable, probe
+
+        W = self.n_workers
+        semi = node.kind in ("semi", "anti")
+
+        # host-side: split build rows by the SAME hash the probe exchange uses, and
+        # build each worker's table ONCE here (with the overflow-retry loop) rather
+        # than rebuilding inside the traced per-batch transform
+        bvalid = np.asarray(build_page.valid_mask())
+        for ch in node.right_keys:
+            nm = build_page.null_masks[ch]
+            if nm is not None:
+                bvalid = bvalid & ~np.asarray(nm)
+        bkeys = tuple(build_page.columns[ch] for ch in node.right_keys)
+        pid = np.asarray(partition_ids(bkeys, W))
+        pid = np.where(bvalid, pid, W)
+        cap_b = 16
+        sel = [np.nonzero(pid == w)[0] for w in range(W)]
+        cap_b = max(1 << max(max(len(s) for s in sel) - 1, 1).bit_length(), 16)
+        ncols_b = len(build_page.columns)
+
+        def worker_page(w):
+            cols, nulls = [], []
+            for ci in range(ncols_b):
+                col = np.asarray(build_page.columns[ci])
+                out = np.zeros((cap_b,), col.dtype)
+                out[:len(sel[w])] = col[sel[w]]
+                cols.append(jnp.asarray(out))
+                nm = build_page.null_masks[ci]
+                if nm is None:
+                    nulls.append(None)
+                else:
+                    o = np.zeros((cap_b,), bool)
+                    o[:len(sel[w])] = np.asarray(nm)[sel[w]]
+                    nulls.append(jnp.asarray(o))
+            wvalid = jnp.asarray(np.arange(cap_b) < len(sel[w]))
+            return Page(node.right.schema, tuple(cols), tuple(nulls), wvalid)
+
+        tables = [self.local._build_join_table(worker_page(w), node.right_keys,
+                                               build_key_types) for w in range(W)]
+        assert all(t is not None for t in tables)  # dup-free checked by the caller
+        # stack into [W, ...] arrays closed over (replicated); workers slice their own
+        table_g = jax.tree.map(lambda *xs: None if xs[0] is None else jnp.stack(xs),
+                               *tables, is_leaf=lambda x: x is None)
+
+        def transform(cols, nulls, valid, up=up, node=node):
+            cols, nulls, valid = up.transform(cols, nulls, valid)
+            n = valid.shape[0]
+            pkeys = tuple(cols[i] for i in node.left_keys)
+            rpid = partition_ids(pkeys, W)
+            # NULL probe keys never match but must SURVIVE for left/anti: route them
+            # (to their hash bucket) like any other row; matching excludes them below.
+            # bucket = n guarantees no overflow drops at the cost of a W-times padded
+            # receive tensor; an adaptive ~2n/W bucket needs an overflow side-channel
+            # the stream contract doesn't carry yet.
+            payload = list(cols)
+            null_slots = []
+            for ci, nm in enumerate(nulls):
+                if nm is not None:
+                    null_slots.append(ci)
+                    payload.append(nm)
+            packed, pvalid, _ = bucketize(tuple(payload), valid, rpid, W, n)
+            recv, recv_valid = exchange_all_to_all(packed, pvalid, WORKER_AXIS, W)
+            rcols = list(recv[:len(cols)])
+            rnulls = [None] * len(cols)
+            for j, ci in enumerate(null_slots):
+                rnulls[ci] = recv[len(cols) + j]
+            # this worker's pre-built table slice
+            w = jax.lax.axis_index(WORKER_AXIS)
+            jt = jax.tree.map(lambda x: None if x is None else x[w], table_g,
+                              is_leaf=lambda x: x is None)
+            rkeys = tuple(rcols[i] for i in node.left_keys)
+            kvalid = recv_valid
+            for i in node.left_keys:
+                if rnulls[i] is not None:
+                    kvalid = kvalid & ~rnulls[i]
+            row_ids, matched = probe(jt, rkeys, build_key_types, kvalid)
+            matched = matched & kvalid
+            if node.kind in ("inner", "semi"):
+                out_valid = recv_valid & matched
+            elif node.kind == "anti":
+                out_valid = recv_valid & ~matched
+            else:  # left
+                out_valid = recv_valid
+            if semi:
+                return tuple(rcols), tuple(rnulls), out_valid
+            gcols, gnulls = _gather_build(jt, row_ids, matched, node.kind)
+            out_cols = tuple(rcols) + gcols
+            out_nulls = tuple(rnulls) + gnulls
+            if node.filter is not None:  # inner-only here (guard in the caller)
+                out_valid = evaluate_predicate(node.filter, out_cols, out_nulls,
+                                               out_valid)
+            return (out_cols, out_nulls, out_valid)
+
+        dicts = up.dicts if semi else up.dicts + build_dicts
+        return _DStream(node.schema, dicts, up.scan_lo_batches, up.scan_fn, transform)
 
     # ---------------------------------------------------------------- aggregation
     def _run_aggregate(self, node: P.Aggregate):
