@@ -91,3 +91,24 @@ def test_groupby_inside_jit_scan():
     occ = np.asarray(occ)
     got = dict(zip(np.asarray(k)[occ].tolist(), np.asarray(s)[occ].tolist()))
     assert got == {1: 2, 2: 6, 5: 5}
+
+
+def test_partitioned_aggregation_fallback(tpch_sf001, monkeypatch):
+    """Group counts beyond the capacity ceiling fall back to Grace-style partitioned
+    passes with exact results (was: silent row dropping at MAX_GROUP_CAPACITY)."""
+    import trino_tpu.exec.local_executor as LE
+    from trino_tpu import Engine
+
+    monkeypatch.setattr(LE, "DEFAULT_GROUP_CAPACITY", 256)
+    monkeypatch.setattr(LE, "MAX_GROUP_CAPACITY", 4096)
+    e = Engine()
+    e.register_catalog("tpch", tpch_sf001)
+    # 15000 orders -> 15000 groups > 4096 ceiling
+    r = e.execute_sql("select o_orderkey, count(*) c from orders group by o_orderkey")
+    assert len(r) == 15000
+    assert set(r.columns[1].tolist()) == {1}
+    r = e.execute_sql(
+        "select o_custkey, count(*) c, sum(o_totalprice) s from orders "
+        "group by o_custkey order by o_custkey")
+    import numpy as np
+    assert int(np.sum(r.columns[1])) == 15000

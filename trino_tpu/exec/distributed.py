@@ -64,10 +64,8 @@ def _has_duplicate_keys(build_page: Page, key_channels, key_types) -> bool:
     keys = tuple(build_page.columns[ch] for ch in key_channels)
     packed, exact = pack_keys(keys, key_types)
     vals = np.asarray(packed)[valid]
-    if not exact:
-        # fingerprint packing: collisions could mask as dups; be conservative and
-        # report dups so the caller takes the general (multi-match-capable) path
-        return len(np.unique(vals)) < n
+    # for inexact (fingerprint) packing a hash collision reads as a duplicate, which
+    # is the conservative direction: the caller falls back to the general path
     return len(np.unique(vals)) < n
 
 
@@ -265,7 +263,6 @@ class DistributedExecutor:
         bkeys = tuple(build_page.columns[ch] for ch in node.right_keys)
         pid = np.asarray(partition_ids(bkeys, W))
         pid = np.where(bvalid, pid, W)
-        cap_b = 16
         sel = [np.nonzero(pid == w)[0] for w in range(W)]
         cap_b = max(1 << max(max(len(s) for s in sel) - 1, 1).bit_length(), 16)
         ncols_b = len(build_page.columns)
@@ -287,9 +284,26 @@ class DistributedExecutor:
             wvalid = jnp.asarray(np.arange(cap_b) < len(sel[w]))
             return Page(node.right.schema, tuple(cols), tuple(nulls), wvalid)
 
-        tables = [self.local._build_join_table(worker_page(w), node.right_keys,
-                                               build_key_types) for w in range(W)]
-        assert all(t is not None for t in tables)  # dup-free checked by the caller
+        # build every worker's table at ONE shared capacity (per-worker retry loops
+        # could diverge in capacity and break the jnp.stack below); grow all together
+        # on any overflow
+        from ..ops.hashjoin import build_insert, build_table_init
+
+        wpages = [worker_page(w) for w in range(W)]
+        capacity = max(2 * cap_b, 32)
+        while True:
+            tables = []
+            overflow = False
+            for wp in wpages:
+                jt = build_table_init(capacity, wp)
+                jt = jax.jit(build_insert, static_argnums=(2,))(
+                    jt, tuple(wp.columns[ch] for ch in node.right_keys),
+                    build_key_types, wp.valid_mask())
+                overflow = overflow or bool(jt.overflow)
+                tables.append(jt)
+            if not overflow:
+                break
+            capacity *= 4
         # stack into [W, ...] arrays closed over (replicated); workers slice their own
         table_g = jax.tree.map(lambda *xs: None if xs[0] is None else jnp.stack(xs),
                                *tables, is_leaf=lambda x: x is None)

@@ -303,10 +303,19 @@ class LocalExecutor:
             )
             for page in stream.pages():
                 state = step(state, page)
-            if not bool(state.overflow) or capacity >= MAX_GROUP_CAPACITY:
+            if not bool(state.overflow):
                 break
+            if capacity >= MAX_GROUP_CAPACITY:
+                # group count exceeds the device-memory capacity ceiling: fall back to
+                # partitioned passes (the HBM analog of the reference's
+                # SpillableHashAggregationBuilder — re-stream per key partition
+                # instead of spilling state to disk)
+                return self._run_aggregate_partitioned(node, parts=4)
             capacity *= 4  # next capacity bucket (reference: FlatHash#rehash)
 
+        return self._finalize_groups(node, stream, state)
+
+    def _finalize_groups(self, node: P.Aggregate, stream, state):
         # compact occupied groups ON DEVICE before any host transfer: the table is
         # capacity-sized but group counts are usually tiny, and device->host bandwidth
         # (not FLOPs) dominates on tunneled links
@@ -323,6 +332,64 @@ class LocalExecutor:
         page = Page(node.schema, tuple(arrays), out_nulls, None)
         dicts = tuple(stream.dicts[i] for i in node.keys) + tuple(None for _ in node.aggs)
         return page, dicts
+
+    def _run_aggregate_partitioned(self, node: P.Aggregate, parts: int):
+        """Grace-style partitioned aggregation: P passes over the input, pass p keeping
+        only rows whose key hash routes to partition p; results concatenate (disjoint
+        key sets).  Trades scan recompute for bounded table memory."""
+        from ..ops.exchange import partition_ids
+
+        stream, key_types, acc_specs, acc_exprs, acc_kinds, _ = self._agg_compiled(node)
+
+        @jax.jit
+        def pstep(state, page, p, stream=stream, node=node, key_types=key_types,
+                  acc_exprs=acc_exprs, acc_kinds=acc_kinds, parts=parts):
+            cols, nulls, valid = stream.transform(
+                page.columns, page.null_masks, page.valid_mask())
+            key_vals = tuple(cols[i] for i in node.keys)
+            key_nulls = tuple(nulls[i] for i in node.keys)
+            # canonicalize NULL key lanes before hashing, exactly like groupby_insert:
+            # the SQL NULL group must land in ONE partition
+            routed = tuple(kv if kn is None else jnp.where(kn, jnp.zeros((), kv.dtype),
+                                                           kv)
+                           for kv, kn in zip(key_vals, key_nulls))
+            valid = valid & (partition_ids(routed, parts) == p)
+            inputs = [(None, None) if e is None else evaluate(e, cols, nulls)
+                      for e in acc_exprs]
+            return hashagg.groupby_insert(state, key_vals, key_types, valid, inputs,
+                                          acc_kinds, key_nulls)
+
+        pages_out, dicts = [], None
+        for p in range(parts):
+            capacity = MAX_GROUP_CAPACITY // 4
+            while True:
+                state = hashagg.groupby_init(
+                    capacity, tuple(t.dtype for t in key_types), acc_specs)
+                for page in stream.pages():
+                    state = pstep(state, page, jnp.int32(p))
+                if not bool(state.overflow):
+                    break
+                if capacity >= MAX_GROUP_CAPACITY:
+                    if parts >= 1 << 16:
+                        raise MemoryError(
+                            f"aggregation exceeds {MAX_GROUP_CAPACITY} groups per "
+                            f"partition even at {parts} partitions")
+                    # a partition still blew the ceiling: restart with more partitions
+                    return self._run_aggregate_partitioned(node, parts * 4)
+                capacity *= 4
+            page, dicts = self._finalize_groups(node, stream, state)
+            pages_out.append(page)
+        cols = tuple(jnp.concatenate([p.columns[i] for p in pages_out])
+                     for i in range(len(node.schema.fields)))
+        nulls = []
+        for i in range(len(node.schema.fields)):
+            if any(p.null_masks[i] is not None for p in pages_out):
+                nulls.append(jnp.concatenate([
+                    p.null_masks[i] if p.null_masks[i] is not None
+                    else jnp.zeros((p.capacity,), bool) for p in pages_out]))
+            else:
+                nulls.append(None)
+        return Page(node.schema, cols, tuple(nulls), None), dicts
 
     def _run_global_aggregate(self, node, stream, acc_exprs, acc_kinds):
         """Ungrouped aggregation (reference: AggregationOperator) — pure jnp reductions."""
