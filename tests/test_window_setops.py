@@ -141,3 +141,58 @@ def test_window_edge_cases(engine):
         order by n_nationkey""")
     mx = r.columns[1].tolist()
     assert mx[0] is None  # custkey 0 never exists -> empty frame
+
+
+def test_rollup_cube_grouping_sets(engine):
+    r = engine.execute_sql(
+        "select count(*) c from nation group by rollup (n_regionkey, n_nationkey)")
+    assert len(r) == 25 + 5 + 1
+    r = engine.execute_sql(
+        "select n_regionkey, count(*) c from nation "
+        "group by grouping sets ((n_regionkey), ()) order by n_regionkey nulls last")
+    assert len(r) == 6
+    assert r.columns[0][5] is None and r.columns[1][5] == 25
+    r = engine.execute_sql("select l_returnflag, l_linestatus, sum(l_quantity) q "
+                           "from lineitem group by cube (l_returnflag, l_linestatus)")
+    n_pairs = len(engine.execute_sql(
+        "select distinct l_returnflag, l_linestatus from lineitem").rows())
+    n_rf = len(engine.execute_sql("select distinct l_returnflag from lineitem").rows())
+    n_ls = len(engine.execute_sql("select distinct l_linestatus from lineitem").rows())
+    assert len(r) == n_pairs + n_rf + n_ls + 1
+    # grand total equals ungrouped sum
+    total = engine.execute_sql("select sum(l_quantity) q from lineitem").columns[0][0]
+    vals = [q for rf, ls, q in r.rows() if rf is None and ls is None]
+    assert len(vals) == 1 and abs(vals[0] - total) < 1e-6
+
+
+def test_cross_and_theta_joins(engine):
+    r = engine.execute_sql("select count(*) c from nation, region")
+    assert r.columns[0][0] == 125
+    r = engine.execute_sql(
+        "select count(*) c from nation join region on n_regionkey < r_regionkey")
+    per = dict(engine.execute_sql(
+        "select n_regionkey, count(*) c from nation group by n_regionkey").rows())
+    assert r.columns[0][0] == sum(cnt * (4 - rk) for rk, cnt in per.items())
+    r = engine.execute_sql("select count(*) c from nation cross join region "
+                           "where n_regionkey = r_regionkey")
+    assert r.columns[0][0] == 25
+
+
+def test_grouping_sets_edge_cases(engine):
+    # star expansion over cross/theta joins skips helper key channels
+    r = engine.execute_sql("select * from nation, region limit 3")
+    assert len(r.names) == 7
+    # ordinals and aliases resolve inside grouping elements
+    r = engine.execute_sql(
+        "select n_regionkey rk, count(*) c from nation group by rollup(1)")
+    assert len(r) == 6
+    r = engine.execute_sql(
+        "select n_regionkey rk, count(*) c from nation group by rollup(rk)")
+    assert len(r) == 6
+    # rollup/cube/grouping/sets stay valid identifiers
+    r = engine.execute_sql("select r_name sets from region order by sets limit 1")
+    assert r.names == ("sets",)
+    # equi-connected pending pairs join before any cross product
+    r = engine.execute_sql("select count(*) c from region, customer, nation "
+                           "where c_nationkey = n_nationkey")
+    assert r.columns[0][0] == 1500 * 5

@@ -129,6 +129,8 @@ class Planner:
         for it in q.items:
             if isinstance(it.expr, A.Star):
                 for i, c in enumerate(rel.cols):
+                    if not c.name:
+                        continue  # anonymous helper channels (computed join keys)
                     items.append(A.SelectItem(A.Identifier(
                         (c.alias, c.name) if c.alias else (c.name,)), None))
             else:
@@ -412,7 +414,36 @@ class Planner:
                 unique = any(u <= build_chs for u in cand.unique_sets)
                 candidates.append((not unique, sizes[i], i, eqs, rest))
             if not candidates:
-                raise SemanticError("cross join between unconnected relations not supported yet")
+                # no pending relation connects to the spine; join equi-connected
+                # PENDING pairs first so cross products happen over the smallest
+                # possible component results
+                pair = None
+                for ii in pending:
+                    for jj in pending:
+                        if ii == jj:
+                            continue
+                        eqs2, rest2 = _find_equi_conjuncts(self, residual,
+                                                           rels[ii], rels[jj])
+                        if eqs2:
+                            pair = (ii, jj, eqs2, rest2)
+                            break
+                    if pair:
+                        break
+                if pair is not None:
+                    ii, jj, eqs2, rest2 = pair
+                    rels[ii] = self._make_join("inner", rels[ii], rels[jj], eqs2)
+                    sizes[ii] = max(sizes[ii], sizes[jj])
+                    residual = rest2
+                    pending.remove(jj)
+                    continue
+                # genuinely unconnected: CROSS JOIN the smallest pending relation
+                # (constant-key join -> full multi-match expansion; theta predicates
+                # apply afterwards as filters — reference: JoinNode with CROSS type)
+                i = min(pending, key=lambda i: sizes[i])
+                current = self._make_cross_join(current, rels[i])
+                joined.add(i)
+                pending.remove(i)
+                continue
             _, _, i, eqs, rest = min(candidates, key=lambda c: (c[0], c[1]))
             current = self._make_join("inner", current, rels[i], eqs)
             residual = rest
@@ -750,7 +781,16 @@ class Planner:
             else:
                 residual.append(c)
         if not eqs:
-            raise SemanticError("non-equi explicit join not supported yet")
+            if node.kind != "inner":
+                raise SemanticError("non-equi outer joins not supported yet")
+            # theta join: cross product then filter (reference: cross JoinNode with
+            # the predicate as a post-join filter)
+            rel = self._make_cross_join(left, right)
+            out = rel.node
+            for c in residual:
+                e, _ = self.translate(c, rel.cols)
+                out = P.Filter(out, e)
+            return RelPlan(out, rel.cols, rel.unique_sets)
         if node.kind == "left":
             # ON residuals are match conditions, not post-filters, for outer joins.
             # Build-side-only conjuncts push below the join (a build row failing one can
@@ -875,6 +915,12 @@ class Planner:
             return (r_in_left, l_in_right)
         return None
 
+    def _make_cross_join(self, probe: RelPlan, build: RelPlan) -> RelPlan:
+        """Cross product: a constant-key equi join — every probe row matches every
+        build row through the multi-match expansion."""
+        one = ir.Constant(1, BIGINT)
+        return self._make_join("inner", probe, build, [(one, one)])
+
     def _make_join(self, kind, probe: RelPlan, build: RelPlan, eqs,
                    filter_expr=None) -> RelPlan:
         probe_node, build_node = probe.node, build.node
@@ -905,19 +951,9 @@ class Planner:
 
     # ---------------------------------------------------------------- aggregation
     def _plan_aggregation(self, q, rel: RelPlan, items, agg_calls):
-        group_asts = []
-        for g in q.group_by:
-            if isinstance(g, A.NumberLit):
-                group_asts.append(items[int(g.text) - 1].expr)
-            elif isinstance(g, A.Identifier) and len(g.parts) == 1 and \
-                    self._try_translate(g, rel.cols) is None:
-                # alias reference
-                match = [it.expr for it in items if it.alias == g.parts[0]]
-                if not match:
-                    raise SemanticError(f"cannot resolve group key {g}")
-                group_asts.append(match[0])
-            else:
-                group_asts.append(g)
+        if len(q.group_by) == 1 and isinstance(q.group_by[0], A.GroupingSets):
+            return self._plan_grouping_sets(q, rel, items, agg_calls, q.group_by[0])
+        group_asts = [self._resolve_group_ast(g, items, rel) for g in q.group_by]
 
         key_exprs, key_dicts = [], []
         for g in group_asts:
@@ -961,23 +997,8 @@ class Planner:
             ))
             agg = P.Aggregate(dist, tuple(range(len(key_exprs))), tuple(specs), agg_schema)
         else:
-            proj_exprs = list(key_exprs)
-            specs = []
-            for j, a in enumerate(uniq_aggs):
-                kind, arg_ast = _agg_kind(a)
-                if arg_ast is None:
-                    specs.append(P.AggSpec("count_star", None, f"agg{j}", BIGINT))
-                else:
-                    e, _ = self.translate(arg_ast, rel.cols)
-                    ch = len(proj_exprs)
-                    proj_exprs.append(e)
-                    specs.append(P.AggSpec(kind, ir.FieldRef(ch, e.type), f"agg{j}",
-                                           _agg_type(kind, e.type)))
-            proj_schema = Schema(tuple(Field(f"c{i}", e.type)
-                                       for i, e in enumerate(proj_exprs)))
-            proj = P.Project(rel.node, tuple(proj_exprs), proj_schema,
-                             tuple(key_dicts)
-                             + tuple(None for _ in range(len(proj_exprs) - len(key_exprs))))
+            proj, key_exprs, key_dicts, uniq_aggs, specs = self._build_agg_projection(
+                rel, group_asts, agg_calls)
             agg_schema = Schema(tuple(
                 [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
                 + [Field(s.name, s.type) for s in specs]
@@ -987,9 +1008,57 @@ class Planner:
                      for i, (e, d) in enumerate(zip(key_exprs, key_dicts))]
                     + [ColumnInfo(None, s.name, s.type, None) for s in specs])
         agg_unique = [frozenset(range(len(key_exprs)))] if key_exprs else []
+        return self._finish_aggregation(q, agg, items, group_asts, uniq_aggs,
+                                        agg_cols, agg_unique)
 
+    def _resolve_group_ast(self, g, items, rel: RelPlan):
+        """GROUP BY element resolution: ordinals and select-list aliases bind before
+        source columns (reference: StatementAnalyzer's groupingElement analysis)."""
+        if isinstance(g, A.NumberLit):
+            return items[int(g.text) - 1].expr
+        if isinstance(g, A.Identifier) and len(g.parts) == 1 and \
+                self._try_translate(g, rel.cols) is None:
+            match = [it.expr for it in items if it.alias == g.parts[0]]
+            if not match:
+                raise SemanticError(f"cannot resolve group key {g}")
+            return match[0]
+        return g
+
+    def _build_agg_projection(self, rel: RelPlan, key_asts, agg_calls):
+        """(proj node, key_exprs, key_dicts, uniq_aggs, specs): the shared input
+        projection of group keys + aggregate arguments."""
+        key_exprs, key_dicts = [], []
+        for g in key_asts:
+            e, d = self.translate(g, rel.cols)
+            key_exprs.append(e)
+            key_dicts.append(d)
+        uniq_aggs = []
+        for a in agg_calls:
+            if a not in uniq_aggs:
+                uniq_aggs.append(a)
+        proj_exprs = list(key_exprs)
+        specs = []
+        for j, a in enumerate(uniq_aggs):
+            kind, arg_ast = _agg_kind(a)
+            if arg_ast is None:
+                specs.append(P.AggSpec("count_star", None, f"agg{j}", BIGINT))
+            else:
+                e, _ = self.translate(arg_ast, rel.cols)
+                ch = len(proj_exprs)
+                proj_exprs.append(e)
+                specs.append(P.AggSpec(kind, ir.FieldRef(ch, e.type), f"agg{j}",
+                                       _agg_type(kind, e.type)))
+        proj_schema = Schema(tuple(Field(f"c{i}", e.type)
+                                   for i, e in enumerate(proj_exprs)))
+        proj = P.Project(rel.node, tuple(proj_exprs), proj_schema,
+                         tuple(key_dicts) + tuple(
+                             None for _ in range(len(proj_exprs) - len(key_exprs))))
+        return proj, key_exprs, key_dicts, uniq_aggs, specs
+
+    def _finish_aggregation(self, q, node, items, group_asts, uniq_aggs, agg_cols,
+                            agg_unique):
+        """Shared tail: HAVING + output projection over (group keys + agg calls)."""
         post = _PostAggScope(group_asts, uniq_aggs, agg_cols, self)
-        node = agg
         if q.having is not None:
             node = P.Filter(node, post.translate(q.having))
         out_exprs, out_names = [], []
@@ -1013,6 +1082,61 @@ class Planner:
             if len({out_exprs[i].index for i in mapped}) == len(u):
                 out_unique.append(frozenset(mapped))
         return RelPlan(node, cols, out_unique), out_names, [it.expr for it in items]
+
+    def _plan_grouping_sets(self, q, rel: RelPlan, items, agg_calls, gs):
+        """GROUP BY ROLLUP/CUBE/GROUPING SETS: one aggregation per set over a shared
+        input projection, projected to a uniform layout (absent keys become typed
+        NULLs) and UNION ALLed (reference: GroupIdOperator feeding one aggregation;
+        the union-of-aggregations form is equivalent and keeps each table small)."""
+        if gs.kind == "rollup":
+            all_asts = [self._resolve_group_ast(g, items, rel) for g in gs.exprs]
+            sets = [tuple(range(k)) for k in range(len(all_asts), -1, -1)]
+        elif gs.kind == "cube":
+            all_asts = [self._resolve_group_ast(g, items, rel) for g in gs.exprs]
+            n = len(all_asts)
+            sets = [tuple(i for i in range(n) if mask >> i & 1)
+                    for mask in range((1 << n) - 1, -1, -1)]
+        else:
+            all_asts, sets = [], []
+            for s in gs.sets:
+                idxs = []
+                for e in s:
+                    e = self._resolve_group_ast(e, items, rel)
+                    if e not in all_asts:
+                        all_asts.append(e)
+                    idxs.append(all_asts.index(e))
+                sets.append(tuple(idxs))
+
+        proj, key_exprs, key_dicts, uniq_aggs, specs = self._build_agg_projection(
+            rel, all_asts, agg_calls)
+        if any(a.distinct for a in uniq_aggs):
+            raise SemanticError("DISTINCT aggregates with grouping sets not supported")
+        uni_schema = Schema(tuple(
+            [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
+            + [Field(s.name, s.type) for s in specs]))
+        branches = []
+        for s in sets:
+            schema_s = Schema(tuple(
+                [Field(f"k{i}", key_exprs[i].type) for i in s]
+                + [Field(sp.name, sp.type) for sp in specs]))
+            agg_n = P.Aggregate(proj, s, tuple(specs), schema_s)
+            uni_exprs = []
+            for i, ke in enumerate(key_exprs):
+                if i in s:
+                    uni_exprs.append(ir.FieldRef(s.index(i), ke.type))
+                else:
+                    uni_exprs.append(ir.Constant(None, ke.type))
+            for j, sp in enumerate(specs):
+                uni_exprs.append(ir.FieldRef(len(s) + j, sp.type))
+            branches.append(P.Project(agg_n, tuple(uni_exprs), uni_schema,
+                                      tuple(key_dicts)
+                                      + tuple(None for _ in specs)))
+        node = P.Union(tuple(branches), uni_schema)
+        agg_cols = ([ColumnInfo(None, f"k{i}", e.type, d)
+                     for i, (e, d) in enumerate(zip(key_exprs, key_dicts))]
+                    + [ColumnInfo(None, sp.name, sp.type, None) for sp in specs])
+        return self._finish_aggregation(q, node, items, all_asts, uniq_aggs,
+                                        agg_cols, [])
 
     # ---------------------------------------------------------------- expression translation
     def _try_translate(self, ast, cols):

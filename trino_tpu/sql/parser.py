@@ -191,6 +191,16 @@ class JoinRef(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class GroupingSets(Node):
+    """GROUP BY ROLLUP/CUBE/GROUPING SETS; ``sets`` holds explicit sets for
+    kind='sets', or the column list for rollup/cube (expanded by the planner)."""
+
+    kind: str  # rollup | cube | sets
+    exprs: tuple  # column list (rollup/cube)
+    sets: tuple = ()  # tuple of tuples (kind='sets')
+
+
+@dataclasses.dataclass(frozen=True)
 class SortItem(Node):
     expr: Node
     ascending: bool = True
@@ -292,6 +302,8 @@ KEYWORDS = {
     "last", "true", "false", "all", "any", "union", "except", "intersect", "with",
     "substring", "for", "over", "partition", "create", "table", "insert", "into",
     "values", "drop", "view", "replace", "if", "explain", "analyze",
+    # rollup/cube/grouping/sets stay contextual (matched by value in GROUP BY),
+    # so they remain usable as identifiers
 }
 
 
@@ -529,10 +541,14 @@ class Parser:
         group_by = ()
         if self.accept("group"):
             self.expect("by")
-            group_by = [self.parse_expr()]
-            while self.accept(","):
-                group_by.append(self.parse_expr())
-            group_by = tuple(group_by)
+            gs = self._parse_grouping_element()
+            if gs is not None:
+                group_by = (gs,)
+            else:
+                group_by = [self.parse_expr()]
+                while self.accept(","):
+                    group_by.append(self.parse_expr())
+                group_by = tuple(group_by)
         having = self.parse_expr() if self.accept("having") else None
         # ORDER BY / LIMIT are parsed by parse_query_body (they bind to the whole query
         # body so set-operation operands don't capture them)
@@ -601,6 +617,39 @@ class Parser:
             return self.expect_kind("ident").value
         if self.peek().kind == "ident":
             return self.next().value
+        return None
+
+    def _parse_grouping_element(self):
+        t = self.peek()
+        if t.value in ("rollup", "cube") and self.peek(1).value == "(":
+            self.next()
+            kind = t.value
+            self.expect("(")
+            exprs = [self.parse_expr()]
+            while self.accept(","):
+                exprs.append(self.parse_expr())
+            self.expect(")")
+            return GroupingSets(kind, tuple(exprs))
+        if self.peek().value == "grouping" and self.peek(1).value == "sets":
+            self.next()
+            self.next()
+            self.expect("(")
+            sets = []
+            while True:
+                if self.accept("("):
+                    one = []
+                    if not (self.peek().kind == "op" and self.peek().value == ")"):
+                        one = [self.parse_expr()]
+                        while self.accept(","):
+                            one.append(self.parse_expr())
+                    self.expect(")")
+                    sets.append(tuple(one))
+                else:
+                    sets.append((self.parse_expr(),))
+                if not self.accept(","):
+                    break
+            self.expect(")")
+            return GroupingSets("sets", (), tuple(sets))
         return None
 
     def parse_sort_item(self) -> SortItem:
