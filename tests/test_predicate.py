@@ -1,0 +1,239 @@
+"""TupleDomain / Domain / ValueSet algebra + DomainTranslator + split pruning.
+
+Reference test models: core/trino-spi/src/test/java/io/trino/spi/predicate/
+TestTupleDomain.java, TestDomain.java, TestSortedRangeSet.java, and the
+DomainTranslator tests in trino-main.
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu.spi.predicate import (Domain, EquatableValueSet, Range, SortedRangeSet,
+                                     TupleDomain)
+from trino_tpu.sql import ir
+from trino_tpu.sql.domain_translator import extract_domains, split_conjuncts
+from trino_tpu.types import BIGINT, DATE, DOUBLE, VarcharType
+
+
+def test_range_basics():
+    r = Range.between(1, 10)
+    assert r.contains_value(1) and r.contains_value(10) and not r.contains_value(11)
+    assert Range.greater_than(5).contains_value(6)
+    assert not Range.greater_than(5).contains_value(5)
+    assert Range.less_than_or_equal(5).contains_value(5)
+    with pytest.raises(ValueError):
+        Range(5, False, 5, False)
+    assert Range.between(1, 5).overlaps(Range.between(5, 9))
+    assert not Range.between(1, 5).overlaps(Range.greater_than(5))
+    assert Range.between(1, 5).intersect(Range.between(3, 9)) == Range.between(3, 5)
+    assert Range.between(1, 5).intersect(Range.greater_than(5)) is None
+    assert Range.between(1, 3).span(Range.between(7, 9)) == Range.between(1, 9)
+
+
+def test_sorted_range_set_normalization():
+    s = SortedRangeSet.of(Range.between(5, 9), Range.between(1, 3), Range.between(2, 6))
+    assert s.ranges == (Range.between(1, 9),)
+    s2 = SortedRangeSet.of(Range.between(1, 2), Range.between(5, 6))
+    assert len(s2.ranges) == 2
+    # adjacency merges: [1,2] U (2,3] = [1,3]
+    s3 = SortedRangeSet.of(Range.between(1, 2), Range(2, False, 3, True))
+    assert s3.ranges == (Range.between(1, 3),)
+
+
+def test_sorted_range_set_ops():
+    a = SortedRangeSet.of(Range.between(1, 5), Range.between(10, 20))
+    b = SortedRangeSet.of(Range.between(4, 12))
+    i = a.intersect(b)
+    assert i.ranges == (Range.between(4, 5), Range.between(10, 12))
+    u = a.union(b)
+    assert u.ranges == (Range.between(1, 20),)
+    c = a.complement()
+    assert c.contains_value(6) and not c.contains_value(3) and c.contains_value(21)
+    assert c.complement().ranges == a.ranges
+    assert SortedRangeSet.none().complement().is_all
+    assert SortedRangeSet.all_().complement().is_none
+
+
+def test_sorted_range_set_values_and_bounds():
+    s = SortedRangeSet.of_values([3, 1, 2, 3])
+    assert s.is_discrete and s.values == [1, 2, 3]
+    assert s.bounds() == (1, 3)
+    assert SortedRangeSet.of(Range.less_than(5)).bounds() == (None, 5)
+
+
+def test_equatable_value_set():
+    a = EquatableValueSet.of_values([1, 2, 3])
+    b = EquatableValueSet.of_values([2, 3, 4])
+    assert a.intersect(b).entries == frozenset({2, 3})
+    assert a.union(b).entries == frozenset({1, 2, 3, 4})
+    nb = b.complement()
+    assert a.intersect(nb).entries == frozenset({1})
+    assert a.union(nb).complement().entries == frozenset({4})  # union misses only 4
+    assert nb.contains_value(9) and not nb.contains_value(2)
+
+
+def test_domain_algebra():
+    d1 = Domain.from_range(Range.between(1, 10))
+    d2 = Domain.from_range(Range.between(5, 20))
+    assert d1.intersect(d2).values.ranges == (Range.between(5, 10),)
+    assert d1.union(d2).values.ranges == (Range.between(1, 20),)
+    assert not d1.null_allowed
+    nn = Domain.not_null()
+    assert d1.intersect(nn).includes_value(5) and not d1.intersect(nn).includes_value(None)
+    on = Domain.only_null()
+    assert on.includes_value(None) and not on.includes_value(1)
+    assert Domain.single_value(7).complement().includes_value(None)
+    assert Domain.all_().complement().is_none
+    assert d1.overlaps_range(10, 30) and not d1.overlaps_range(11, 30)
+    disc = Domain.multiple_values([5, 50], orderable=False)
+    assert disc.overlaps_range(40, 60) and not disc.overlaps_range(10, 40)
+
+
+def test_tuple_domain():
+    t1 = TupleDomain.with_column_domains({"a": Domain.from_range(Range.between(1, 10))})
+    t2 = TupleDomain.with_column_domains({"a": Domain.from_range(Range.between(5, 20)),
+                                          "b": Domain.single_value(3)})
+    ti = t1.intersect(t2)
+    assert ti.domain("a").values.ranges == (Range.between(5, 10),)
+    assert ti.domain("b").is_single_value
+    # contradiction -> NONE
+    t3 = TupleDomain.with_column_domains({"a": Domain.from_range(Range.between(11, 20))})
+    assert t1.intersect(t3).is_none
+    assert not t1.overlaps(t3)
+    assert t1.overlaps(t2)
+    # column-wise union keeps only shared columns
+    u = t1.column_wise_union(t2)
+    assert u.domain("b") is None
+    assert u.domain("a").values.ranges == (Range.between(1, 20),)
+    assert t1.includes_row({"a": 5}) and not t1.includes_row({"a": 0})
+    assert not t1.includes_row({"a": None})
+    # transform_keys merging
+    tt = t2.transform_keys(lambda k: "x")
+    assert tt.is_none or tt.domain("x") is not None
+
+
+def test_tuple_domain_equality_hash():
+    t1 = TupleDomain.with_column_domains({"a": Domain.single_value(1)})
+    t2 = TupleDomain.with_column_domains({"a": Domain.single_value(1)})
+    assert t1 == t2 and hash(t1) == hash(t2)
+    assert TupleDomain.all_() == TupleDomain({})
+    assert TupleDomain.none() == TupleDomain(None)
+
+
+def _f(idx, ty=BIGINT):
+    return ir.FieldRef(idx, ty)
+
+
+def _c(v, ty=BIGINT):
+    return ir.Constant(v, ty)
+
+
+def _call(op, *args):
+    from trino_tpu.types import BOOLEAN
+
+    return ir.Call(op, tuple(args), BOOLEAN)
+
+
+def test_domain_translator_comparisons():
+    conj = [_call("gt", _f(0), _c(5)), _call("lte", _f(0), _c(10)),
+            _call("eq", _f(1), _c(3))]
+    res = extract_domains(conj)
+    td = res.tuple_domain
+    assert res.residuals == []
+    assert td.domain(0).values.ranges == (Range(5, False, 10, True),)
+    assert td.domain(1).is_single_value
+    # flipped constant-first comparison
+    res2 = extract_domains([_call("lt", _c(5), _f(0))])
+    assert res2.tuple_domain.domain(0).values.ranges == (Range.greater_than(5),)
+
+
+def test_domain_translator_between_in_null():
+    res = extract_domains([
+        _call("between", _f(0), _c(1), _c(9)),
+        _call("in", _f(1), _c(2), _c(4), _c(6)),
+        _call("not", _call("is_null", _f(2))),
+    ])
+    td = res.tuple_domain
+    assert td.domain(0).values.ranges == (Range.between(1, 9),)
+    assert td.domain(1).values.values == [2, 4, 6]
+    assert td.domain(2) == Domain.not_null()
+
+
+def test_domain_translator_or_and_residual():
+    res = extract_domains([
+        _call("or", _call("eq", _f(0), _c(1)), _call("eq", _f(0), _c(5))),
+        _call("eq", _f(1), _f(2)),  # untranslatable -> residual
+    ])
+    assert res.tuple_domain.domain(0).values.values == [1, 5]
+    assert len(res.residuals) == 1
+
+
+def test_domain_translator_neq_and_lut():
+    res = extract_domains([_call("neq", _f(0), _c(7))])
+    d = res.tuple_domain.domain(0)
+    assert d.includes_value(6) and not d.includes_value(7) and not d.includes_value(None)
+    # lut over dictionary ids
+    vt = VarcharType.of(10)
+    table = np.array([False, True, True, False])
+    res2 = extract_domains([ir.Call("lut", (ir.FieldRef(3, vt), ir.Constant(table, vt)),
+                                    vt)])
+    d2 = res2.tuple_domain.domain(3)
+    assert d2.values.values == [1, 2]
+
+
+def test_contradiction_prunes_everything():
+    res = extract_domains([_call("gt", _f(0), _c(10)), _call("lt", _f(0), _c(5))])
+    assert res.tuple_domain.is_none
+
+
+def test_static_split_pruning_tpch():
+    """WHERE over a monotone key must skip disjoint splits entirely."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    conn = TpchConnector(sf=0.01, split_rows=1 << 10)
+    calls = []
+    orig = conn.generate
+
+    def counting(split, columns=None):
+        calls.append(split)
+        return orig(split, columns)
+
+    conn.generate = counting
+    e = Engine()
+    e.register_catalog("tpch", conn)
+    s = e.create_session("tpch")
+    nsplits = len(conn.splits("orders"))
+    assert nsplits > 2
+    r = e.execute_sql("select count(*) from orders where o_orderkey <= 100", s).rows()
+    assert r[0][0] == 100
+    assert len(calls) < nsplits  # pruned
+
+
+def test_null_admitting_domains_never_prune(tmp_path):
+    """IS NULL / OR IS NULL predicates must not skip splits via min/max stats —
+    stats carry no null information (regression: null-admitting Domain pruned
+    row groups and dropped every NULL row)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from trino_tpu import Engine
+    from trino_tpu.connectors.parquet import ParquetConnector
+
+    n = 5000
+    vals = [None if i % 11 == 0 else i % 200 for i in range(n)]
+    pq.write_table(pa.table({"val": pa.array(vals, pa.int64())}),
+                   str(tmp_path / "events.parquet"), row_group_size=500)
+    e = Engine()
+    e.register_catalog("pq", ParquetConnector(str(tmp_path)))
+    s = e.create_session("pq")
+    expect_null = sum(1 for v in vals if v is None)
+    expect_or = sum(1 for v in vals if v is None or v > 100)
+    r1 = e.execute_sql("select count(*) from events where val is null", s).rows()
+    assert r1[0][0] == expect_null
+    r2 = e.execute_sql("select count(*) from events where val > 100 or val is null",
+                       s).rows()
+    assert r2[0][0] == expect_or
+    # and non-null range predicates still prune correctly
+    r3 = e.execute_sql("select count(*) from events where val = 150", s).rows()
+    assert r3[0][0] == sum(1 for v in vals if v == 150)

@@ -70,6 +70,7 @@ class _PqTable:
     n_row_groups: int
     dicts: dict  # column -> Dictionary (string columns; table-wide)
     id_maps: dict  # column -> {value: id}
+    metadata: object  # pyarrow FileMetaData (cached footer; row-group stats)
 
 
 class ParquetConnector:
@@ -111,7 +112,8 @@ class ParquetConnector:
                 dicts[fld.name] = Dictionary(values=np.array(uniq or [""], dtype=object))
                 id_maps[fld.name] = {v: i for i, v in enumerate(uniq)}
         t = _PqTable(path, Schema(tuple(fields)), pf.schema_arrow,
-                     pf.metadata.num_rows, pf.metadata.num_row_groups, dicts, id_maps)
+                     pf.metadata.num_rows, pf.metadata.num_row_groups, dicts, id_maps,
+                     pf.metadata)
         self._tables[table] = t
         return t
 
@@ -126,6 +128,33 @@ class ParquetConnector:
 
     def column_range(self, table: str, column: str):
         return (None, None)
+
+    def split_range(self, split: ParquetSplit, column: str):
+        """Per-row-group min/max statistics, feeding TupleDomain split pruning and
+        dynamic filters (reference: lib/trino-parquet predicate/TupleDomainParquetPredicate
+        — row groups skipped when stats are disjoint from the effective predicate)."""
+        t = self._open(split.table)
+        if column in t.dicts:
+            return None  # engine domains over dictionary ids; stats are raw strings
+        rg = t.metadata.row_group(split.row_group)
+        for ci in range(rg.num_columns):
+            col = rg.column(ci)
+            if col.path_in_schema == column:
+                st = col.statistics
+                if st is None or not st.has_min_max:
+                    return None
+                lo, hi = st.min, st.max
+                ty = t.schema.field(column).type
+                if ty.name == "date":
+                    import datetime
+
+                    epoch = datetime.date(1970, 1, 1)
+                    if isinstance(lo, datetime.date):
+                        lo, hi = (lo - epoch).days, (hi - epoch).days
+                if isinstance(lo, (int, float)) and isinstance(hi, (int, float)):
+                    return (lo, hi)
+                return None
+        return None
 
     # -- scan --------------------------------------------------------------------
     def splits(self, table: str, n_hint: int = 0):

@@ -193,7 +193,9 @@ class LocalExecutor:
                 cols, nulls, valid = up.transform(cols, nulls, valid)
                 return cols, nulls, evaluate_predicate(pred, cols, nulls, valid)
 
-            return _Stream(up.schema, up.dicts, up.pages, transform, up.scan_info)
+            pruned = _static_pruned_stream(up, pred)
+            pages, si = pruned if pruned is not None else (up.pages, up.scan_info)
+            return _Stream(up.schema, up.dicts, pages, transform, si)
 
         if isinstance(node, P.Project):
             up = self._compile_stream(node.child)
@@ -736,6 +738,44 @@ def _concat_stream(stream: _Stream) -> Page:
     return Page(stream.schema, tuple(cols_out), tuple(nulls_out), None)
 
 
+def _static_pruned_stream(up: _Stream, pred):
+    """Compile-time split pruning from the pushed-down predicate's TupleDomain
+    (reference: DomainTranslator.getExtractionResult feeding connector split pruning
+    via ConnectorMetadata.applyFilter / per-split TupleDomain stats).  Returns
+    (pages, scan_info) with the pruned split list, or None when nothing prunes."""
+    si = up.scan_info
+    if si is None or not hasattr(si.conn, "split_range"):
+        return None
+    from ..sql.domain_translator import (domain_to_split_pruner, extract_domains,
+                                         split_conjuncts)
+
+    td = extract_domains(split_conjuncts(pred)).tuple_domain
+    if td.is_none:
+        return (lambda: iter(()), dataclasses.replace(si, splits=[]))
+    if td.is_all:
+        return None
+    by_col: dict = {}
+    for ch, dom in td.domains.items():
+        col = si.columns[ch] if ch < len(si.columns) else None
+        # float stats exclude NaN (parquet spec), so NaN-holding splits could be
+        # wrongly pruned — never prune on floating columns
+        if col is not None and not up.schema.fields[ch].type.is_floating:
+            by_col[col] = dom.intersect(by_col[col]) if col in by_col else dom
+    if not by_col:
+        return None
+    keep = domain_to_split_pruner(by_col, si.conn)
+    kept = [s for s in si.splits if keep(s)]
+    if len(kept) == len(si.splits):
+        return None
+    conn, scan_cols = si.conn, si.scan_columns
+
+    def pages(conn=conn, kept=kept, scan_cols=scan_cols):
+        for s in kept:
+            yield conn.generate(s, list(scan_cols))
+
+    return pages, dataclasses.replace(si, splits=kept)
+
+
 def _dynamic_pruned_pages(probe_stream: _Stream, node, build_page: Page):
     """Page source skipping probe splits disjoint from the build keys' value domain
     (inner/semi joins only — outer/anti joins must keep unmatched probe rows).
@@ -747,6 +787,9 @@ def _dynamic_pruned_pages(probe_stream: _Stream, node, build_page: Page):
         np.zeros((0,), bool)
     if not bvalid.any():
         return lambda: iter(())  # empty build: no probe row can match
+    from ..spi.predicate import UNION_LIMIT, Domain, Range
+    from ..sql.domain_translator import domain_to_split_pruner
+
     domains = {}
     for pch, bch in zip(node.left_keys, node.right_keys):
         col = si.columns[pch] if pch < len(si.columns) else None
@@ -761,20 +804,23 @@ def _dynamic_pruned_pages(probe_stream: _Stream, node, build_page: Page):
             vals = vals[~np.asarray(nm)[bvalid]]
         if len(vals) == 0:
             continue
-        domains[col] = (int(vals.min()), int(vals.max()))
+        # small build sides collect an exact discrete domain, large ones the
+        # min/max span (reference: DynamicFilterSourceOperator's value-set ->
+        # min/max fallback at its size limits)
+        uniq = np.unique(vals)
+        if len(uniq) <= UNION_LIMIT:
+            domains[col] = Domain.multiple_values([int(v) for v in uniq])
+        else:
+            domains[col] = Domain.from_range(
+                Range.between(int(vals.min()), int(vals.max())))
     if not domains:
         return None
+    keep = domain_to_split_pruner(domains, si.conn)
     conn, splits, scan_cols = si.conn, si.splits, si.scan_columns
 
     def pages():
         for s in splits:
-            skip = False
-            for col, (lo, hi) in domains.items():
-                rng = conn.split_range(s, col)
-                if rng is not None and (rng[1] < lo or rng[0] > hi):
-                    skip = True
-                    break
-            if not skip:
+            if keep(s):
                 yield conn.generate(s, list(scan_cols))
 
     return pages

@@ -1,0 +1,1 @@
+"""Engine/connector boundary — the TPU build's analog of core/trino-spi."""

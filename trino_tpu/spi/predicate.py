@@ -1,0 +1,551 @@
+"""Constraint algebra: TupleDomain / Domain / ValueSet.
+
+The reference's predicate-pushdown currency (spi/predicate/TupleDomain.java:57,
+spi/predicate/Domain.java:40, spi/predicate/SortedRangeSet.java,
+EquatableValueSet.java, AllOrNoneValueSet.java).  Engine-side, host-only, and
+shape-static: domains describe *value sets per column* and are used for predicate
+pushdown, split pruning, Parquet row-group pruning, and dynamic filtering — they
+never touch the device.
+
+Values are python scalars (ints for bigint/date/decimal-raw, floats, strs).
+Orderable types use ``SortedRangeSet``; types with only equality semantics
+(dictionary ids, whose order does not follow the decoded value order) use
+``EquatableValueSet``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+# Cap on how many disjoint ranges a domain keeps before collapsing to its span
+# (reference: Domain.DEFAULT_UNION_LIMIT + simplify in DomainCoercer usage).
+UNION_LIMIT = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class Range:
+    """A contiguous value range; ``None`` bound = unbounded
+    (reference: spi/predicate/Range.java)."""
+
+    low: Any  # None = -inf
+    low_inclusive: bool
+    high: Any  # None = +inf
+    high_inclusive: bool
+
+    def __post_init__(self):
+        if self.low is not None and self.high is not None:
+            if self.low > self.high:
+                raise ValueError(f"empty range {self}")
+            if self.low == self.high and not (self.low_inclusive and self.high_inclusive):
+                raise ValueError(f"empty range {self}")
+
+    # constructors ----------------------------------------------------------
+    @staticmethod
+    def all_() -> "Range":
+        return Range(None, False, None, False)
+
+    @staticmethod
+    def equal(v) -> "Range":
+        return Range(v, True, v, True)
+
+    @staticmethod
+    def greater_than(v) -> "Range":
+        return Range(v, False, None, False)
+
+    @staticmethod
+    def greater_than_or_equal(v) -> "Range":
+        return Range(v, True, None, False)
+
+    @staticmethod
+    def less_than(v) -> "Range":
+        return Range(None, False, v, False)
+
+    @staticmethod
+    def less_than_or_equal(v) -> "Range":
+        return Range(None, False, v, True)
+
+    @staticmethod
+    def between(lo, hi) -> "Range":
+        return Range(lo, True, hi, True)
+
+    # predicates ------------------------------------------------------------
+    @property
+    def is_all(self) -> bool:
+        return self.low is None and self.high is None
+
+    @property
+    def is_single_value(self) -> bool:
+        return (self.low is not None and self.low == self.high
+                and self.low_inclusive and self.high_inclusive)
+
+    def contains_value(self, v) -> bool:
+        if self.low is not None:
+            if v < self.low or (v == self.low and not self.low_inclusive):
+                return False
+        if self.high is not None:
+            if v > self.high or (v == self.high and not self.high_inclusive):
+                return False
+        return True
+
+    def overlaps(self, other: "Range") -> bool:
+        return not (self._strictly_before(other) or other._strictly_before(self))
+
+    def _strictly_before(self, other: "Range") -> bool:
+        if self.high is None or other.low is None:
+            return False
+        if self.high < other.low:
+            return True
+        return self.high == other.low and not (self.high_inclusive and other.low_inclusive)
+
+    def intersect(self, other: "Range") -> Optional["Range"]:
+        lo, loi = self.low, self.low_inclusive
+        if other.low is not None and (lo is None or other.low > lo
+                                      or (other.low == lo and not other.low_inclusive)):
+            lo, loi = other.low, other.low_inclusive
+        hi, hii = self.high, self.high_inclusive
+        if other.high is not None and (hi is None or other.high < hi
+                                       or (other.high == hi and not other.high_inclusive)):
+            hi, hii = other.high, other.high_inclusive
+        try:
+            return Range(lo, loi, hi, hii)
+        except ValueError:
+            return None
+
+    def _adjacent_or_overlapping(self, other: "Range") -> bool:
+        """True when union of the two is a single contiguous range.  Exact for the
+        discrete-adjacency case only when callers pre-sort (used by union builder)."""
+        if self.overlaps(other):
+            return True
+        # touching bounds like (a, x] [x, b)
+        if self.high is not None and other.low is not None and self.high == other.low \
+                and (self.high_inclusive or other.low_inclusive):
+            return True
+        if other.high is not None and self.low is not None and other.high == self.low \
+                and (other.high_inclusive or self.low_inclusive):
+            return True
+        return False
+
+    def span(self, other: "Range") -> "Range":
+        lo, loi = self.low, self.low_inclusive
+        if lo is not None and (other.low is None or other.low < lo
+                               or (other.low == lo and other.low_inclusive)):
+            lo, loi = other.low, other.low_inclusive
+        hi, hii = self.high, self.high_inclusive
+        if hi is not None and (other.high is None or other.high > hi
+                               or (other.high == hi and other.high_inclusive)):
+            hi, hii = other.high, other.high_inclusive
+        return Range(lo, loi, hi, hii)
+
+    def __repr__(self):
+        lo = "(-inf" if self.low is None else ("[" if self.low_inclusive else "(") + repr(self.low)
+        hi = "+inf)" if self.high is None else repr(self.high) + ("]" if self.high_inclusive else ")")
+        return f"{lo}, {hi}"
+
+
+class ValueSet:
+    """Base for the three value-set encodings (reference: spi/predicate/ValueSet.java)."""
+
+    is_none: bool
+    is_all: bool
+
+    def union(self, other): ...
+    def intersect(self, other): ...
+    def complement(self): ...
+    def contains_value(self, v) -> bool: ...
+
+
+@dataclasses.dataclass(frozen=True)
+class SortedRangeSet(ValueSet):
+    """Disjoint sorted ranges over an orderable type
+    (reference: spi/predicate/SortedRangeSet.java)."""
+
+    ranges: tuple  # tuple[Range], sorted, disjoint, non-adjacent
+
+    @staticmethod
+    def none() -> "SortedRangeSet":
+        return SortedRangeSet(())
+
+    @staticmethod
+    def all_() -> "SortedRangeSet":
+        return SortedRangeSet((Range.all_(),))
+
+    @staticmethod
+    def of(*ranges: Range) -> "SortedRangeSet":
+        return SortedRangeSet(_normalize(list(ranges)))
+
+    @staticmethod
+    def of_values(values) -> "SortedRangeSet":
+        return SortedRangeSet.of(*(Range.equal(v) for v in set(values)))
+
+    @property
+    def is_none(self) -> bool:
+        return not self.ranges
+
+    @property
+    def is_all(self) -> bool:
+        return len(self.ranges) == 1 and self.ranges[0].is_all
+
+    @property
+    def is_discrete(self) -> bool:
+        return all(r.is_single_value for r in self.ranges)
+
+    @property
+    def values(self) -> list:
+        assert self.is_discrete
+        return [r.low for r in self.ranges]
+
+    def bounds(self):
+        """(min, max) span bounds; None on an unbounded side."""
+        if self.is_none:
+            return None
+        return self.ranges[0].low, self.ranges[-1].high
+
+    def contains_value(self, v) -> bool:
+        return any(r.contains_value(v) for r in self.ranges)
+
+    def union(self, other: "SortedRangeSet") -> "SortedRangeSet":
+        return SortedRangeSet(_normalize(list(self.ranges) + list(other.ranges)))
+
+    def intersect(self, other: "SortedRangeSet") -> "SortedRangeSet":
+        out, i, j = [], 0, 0
+        a, b = self.ranges, other.ranges
+        while i < len(a) and j < len(b):
+            r = a[i].intersect(b[j])
+            if r is not None:
+                out.append(r)
+            if a[i]._strictly_before(b[j]):
+                i += 1
+            elif b[j]._strictly_before(a[i]):
+                j += 1
+            else:
+                # advance whichever ends first
+                ah, bh = a[i].high, b[j].high
+                if ah is None:
+                    j += 1
+                elif bh is None:
+                    i += 1
+                elif ah < bh or (ah == bh and not a[i].high_inclusive):
+                    i += 1
+                else:
+                    j += 1
+        return SortedRangeSet(tuple(out))
+
+    def complement(self) -> "SortedRangeSet":
+        if self.is_none:
+            return SortedRangeSet.all_()
+        out = []
+        prev_high, prev_hii = None, False  # start at -inf
+        first = self.ranges[0]
+        if first.low is not None:
+            out.append(Range(None, False, first.low, not first.low_inclusive))
+        for k in range(len(self.ranges) - 1):
+            cur, nxt = self.ranges[k], self.ranges[k + 1]
+            out.append(Range(cur.high, not cur.high_inclusive,
+                             nxt.low, not nxt.low_inclusive))
+        last = self.ranges[-1]
+        if last.high is not None:
+            out.append(Range(last.high, not last.high_inclusive, None, False))
+        return SortedRangeSet(tuple(out))
+
+    def simplify(self, limit: int = UNION_LIMIT) -> "SortedRangeSet":
+        if len(self.ranges) <= limit:
+            return self
+        span = self.ranges[0]
+        for r in self.ranges[1:]:
+            span = span.span(r)
+        return SortedRangeSet((span,))
+
+    def __repr__(self):
+        return "{" + ", ".join(map(repr, self.ranges)) + "}"
+
+
+def _normalize(ranges: list) -> tuple:
+    if not ranges:
+        return ()
+    key = lambda r: ((r.low is not None, r.low), not r.low_inclusive)
+    ranges = sorted(ranges, key=key)
+    out = [ranges[0]]
+    for r in ranges[1:]:
+        if out[-1]._adjacent_or_overlapping(r):
+            out[-1] = out[-1].span(r)
+        else:
+            out.append(r)
+    return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class EquatableValueSet(ValueSet):
+    """Discrete include/exclude set for equality-only types — dictionary ids here
+    (reference: spi/predicate/EquatableValueSet.java)."""
+
+    inclusive: bool
+    entries: frozenset
+
+    @staticmethod
+    def none() -> "EquatableValueSet":
+        return EquatableValueSet(True, frozenset())
+
+    @staticmethod
+    def all_() -> "EquatableValueSet":
+        return EquatableValueSet(False, frozenset())
+
+    @staticmethod
+    def of_values(values) -> "EquatableValueSet":
+        return EquatableValueSet(True, frozenset(values))
+
+    @property
+    def is_none(self) -> bool:
+        return self.inclusive and not self.entries
+
+    @property
+    def is_all(self) -> bool:
+        return not self.inclusive and not self.entries
+
+    @property
+    def is_discrete(self) -> bool:
+        return self.inclusive
+
+    @property
+    def values(self) -> list:
+        assert self.inclusive
+        return sorted(self.entries)
+
+    def bounds(self):
+        return None  # not orderable
+
+    def contains_value(self, v) -> bool:
+        return (v in self.entries) == self.inclusive
+
+    def union(self, other: "EquatableValueSet") -> "EquatableValueSet":
+        a, b = self, other
+        if a.inclusive and b.inclusive:
+            return EquatableValueSet(True, a.entries | b.entries)
+        if not a.inclusive and not b.inclusive:
+            return EquatableValueSet(False, a.entries & b.entries)
+        if a.inclusive:
+            a, b = b, a  # a exclusive, b inclusive
+        return EquatableValueSet(False, a.entries - b.entries)
+
+    def intersect(self, other: "EquatableValueSet") -> "EquatableValueSet":
+        a, b = self, other
+        if a.inclusive and b.inclusive:
+            return EquatableValueSet(True, a.entries & b.entries)
+        if not a.inclusive and not b.inclusive:
+            return EquatableValueSet(False, a.entries | b.entries)
+        if not a.inclusive:
+            a, b = b, a  # a inclusive, b exclusive
+        return EquatableValueSet(True, a.entries - b.entries)
+
+    def complement(self) -> "EquatableValueSet":
+        return EquatableValueSet(not self.inclusive, self.entries)
+
+    def simplify(self, limit: int = UNION_LIMIT) -> "EquatableValueSet":
+        if self.inclusive and len(self.entries) > limit:
+            return EquatableValueSet.all_()
+        return self
+
+    def __repr__(self):
+        op = "IN" if self.inclusive else "NOT IN"
+        return f"{op} {sorted(self.entries)!r}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Domain:
+    """Value set + null admission for one column
+    (reference: spi/predicate/Domain.java:40)."""
+
+    values: ValueSet
+    null_allowed: bool
+
+    # constructors ----------------------------------------------------------
+    @staticmethod
+    def all_(orderable: bool = True) -> "Domain":
+        return Domain(SortedRangeSet.all_() if orderable else EquatableValueSet.all_(), True)
+
+    @staticmethod
+    def none(orderable: bool = True) -> "Domain":
+        return Domain(SortedRangeSet.none() if orderable else EquatableValueSet.none(), False)
+
+    @staticmethod
+    def only_null(orderable: bool = True) -> "Domain":
+        return Domain(SortedRangeSet.none() if orderable else EquatableValueSet.none(), True)
+
+    @staticmethod
+    def not_null(orderable: bool = True) -> "Domain":
+        return Domain(SortedRangeSet.all_() if orderable else EquatableValueSet.all_(), False)
+
+    @staticmethod
+    def single_value(v, orderable: bool = True) -> "Domain":
+        vs = SortedRangeSet.of(Range.equal(v)) if orderable else EquatableValueSet.of_values([v])
+        return Domain(vs, False)
+
+    @staticmethod
+    def multiple_values(vals, orderable: bool = True) -> "Domain":
+        vs = SortedRangeSet.of_values(vals) if orderable else EquatableValueSet.of_values(vals)
+        return Domain(vs, False)
+
+    @staticmethod
+    def from_range(r: Range) -> "Domain":
+        return Domain(SortedRangeSet.of(r), False)
+
+    # predicates ------------------------------------------------------------
+    @property
+    def is_none(self) -> bool:
+        return self.values.is_none and not self.null_allowed
+
+    @property
+    def is_all(self) -> bool:
+        return self.values.is_all and self.null_allowed
+
+    @property
+    def is_single_value(self) -> bool:
+        if self.null_allowed:
+            return self.values.is_none  # only-null
+        if isinstance(self.values, SortedRangeSet):
+            return len(self.values.ranges) == 1 and self.values.ranges[0].is_single_value
+        return self.values.inclusive and len(self.values.entries) == 1
+
+    def includes_value(self, v) -> bool:
+        """v may be None (SQL NULL)."""
+        if v is None:
+            return self.null_allowed
+        return self.values.contains_value(v)
+
+    def overlaps_range(self, lo, hi) -> bool:
+        """Does the domain intersect the closed value interval [lo, hi]?  Used for
+        split/row-group pruning against min/max stats.  Conservative (True) for
+        equatable sets without discrete values."""
+        if self.values.is_none:
+            return False
+        if isinstance(self.values, SortedRangeSet):
+            probe = Range.between(lo, hi)
+            return any(r.overlaps(probe) for r in self.values.ranges)
+        if self.values.is_discrete:
+            return any(lo <= v <= hi for v in self.values.values)
+        return True
+
+    # algebra ---------------------------------------------------------------
+    def union(self, other: "Domain") -> "Domain":
+        return Domain(self.values.union(other.values),
+                      self.null_allowed or other.null_allowed)
+
+    def intersect(self, other: "Domain") -> "Domain":
+        return Domain(self.values.intersect(other.values),
+                      self.null_allowed and other.null_allowed)
+
+    def complement(self) -> "Domain":
+        return Domain(self.values.complement(), not self.null_allowed)
+
+    def simplify(self, limit: int = UNION_LIMIT) -> "Domain":
+        return Domain(self.values.simplify(limit), self.null_allowed)
+
+    def __repr__(self):
+        return f"Domain({self.values!r}{', NULL' if self.null_allowed else ''})"
+
+
+class TupleDomain:
+    """Conjunction of per-column domains; NONE = provably empty relation
+    (reference: spi/predicate/TupleDomain.java:57).  Keys are column names."""
+
+    __slots__ = ("domains",)
+
+    def __init__(self, domains: Optional[dict]):
+        # None => NONE (contradiction). {} => ALL.
+        if domains is not None:
+            domains = {k: d for k, d in domains.items() if not d.is_all}
+            if any(d.is_none for d in domains.values()):
+                domains = None
+        self.domains = domains
+
+    @staticmethod
+    def all_() -> "TupleDomain":
+        return TupleDomain({})
+
+    @staticmethod
+    def none() -> "TupleDomain":
+        return TupleDomain(None)
+
+    @staticmethod
+    def with_column_domains(domains: dict) -> "TupleDomain":
+        return TupleDomain(dict(domains))
+
+    @property
+    def is_none(self) -> bool:
+        return self.domains is None
+
+    @property
+    def is_all(self) -> bool:
+        return self.domains == {}
+
+    def domain(self, column) -> Optional[Domain]:
+        if self.is_none:
+            return None
+        return self.domains.get(column)
+
+    def intersect(self, other: "TupleDomain") -> "TupleDomain":
+        if self.is_none or other.is_none:
+            return TupleDomain.none()
+        out = dict(self.domains)
+        for k, d in other.domains.items():
+            out[k] = out[k].intersect(d) if k in out else d
+        return TupleDomain(out)
+
+    def column_wise_union(self, other: "TupleDomain") -> "TupleDomain":
+        """Loose upper bound of the disjunction (reference:
+        TupleDomain.columnWiseUnion) — only columns constrained on BOTH sides
+        stay constrained."""
+        if self.is_none:
+            return other
+        if other.is_none:
+            return self
+        out = {}
+        for k in self.domains.keys() & other.domains.keys():
+            out[k] = self.domains[k].union(other.domains[k])
+        return TupleDomain(out)
+
+    def overlaps(self, other: "TupleDomain") -> bool:
+        return not self.intersect(other).is_none
+
+    def includes_row(self, row: dict) -> bool:
+        """row: column -> value (None = NULL); unmentioned columns unconstrained."""
+        if self.is_none:
+            return False
+        return all(d.includes_value(row.get(k)) for k, d in self.domains.items())
+
+    def filter_columns(self, keep) -> "TupleDomain":
+        if self.is_none:
+            return self
+        return TupleDomain({k: d for k, d in self.domains.items() if keep(k)})
+
+    def transform_keys(self, fn) -> "TupleDomain":
+        """Remap column keys; dropping a key (fn returns None) loosens the constraint."""
+        if self.is_none:
+            return self
+        out = {}
+        for k, d in self.domains.items():
+            nk = fn(k)
+            if nk is not None:
+                out[nk] = d.intersect(out[nk]) if nk in out else d
+        return TupleDomain(out)
+
+    def simplify(self, limit: int = UNION_LIMIT) -> "TupleDomain":
+        if self.is_none:
+            return self
+        return TupleDomain({k: d.simplify(limit) for k, d in self.domains.items()})
+
+    def __eq__(self, other):
+        return isinstance(other, TupleDomain) and self.domains == other.domains
+
+    def __hash__(self):
+        if self.domains is None:
+            return hash(None)
+        return hash(frozenset(self.domains.items()))
+
+    def __repr__(self):
+        if self.is_none:
+            return "TupleDomain.NONE"
+        if self.is_all:
+            return "TupleDomain.ALL"
+        return "TupleDomain(" + ", ".join(f"{k}: {d!r}" for k, d in
+                                          sorted(self.domains.items())) + ")"

@@ -1,0 +1,167 @@
+"""IR predicate ⇄ TupleDomain extraction.
+
+Reference: sql/planner/DomainTranslator.java — `getExtractionResult` walks a
+predicate and splits it into (TupleDomain, remainingExpression).  Here the input
+is a list of IR conjuncts (channel-resolved), and the TupleDomain is keyed by
+input channel index.  Dictionary-encoded string columns produce
+EquatableValueSet domains over dictionary ids (including `lut` predicates, the
+planner's compiled form of LIKE / string comparisons over dictionary columns).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..spi.predicate import Domain, Range, SortedRangeSet, TupleDomain
+from . import ir
+
+__all__ = ["ExtractionResult", "extract_domains", "split_conjuncts"]
+
+
+class ExtractionResult:
+    """(tuple_domain keyed by channel, residual conjuncts that must still be
+    evaluated row-wise).  Mirrors DomainTranslator.ExtractionResult."""
+
+    def __init__(self, tuple_domain: TupleDomain, residuals: list):
+        self.tuple_domain = tuple_domain
+        self.residuals = residuals
+
+
+def split_conjuncts(e) -> list:
+    if e is None:
+        return []
+    if isinstance(e, ir.Call) and e.op == "and":
+        out = []
+        for a in e.args:
+            out.extend(split_conjuncts(a))
+        return out
+    return [e]
+
+
+def extract_domains(conjuncts) -> ExtractionResult:
+    domains: dict[int, Domain] = {}
+    residuals = []
+    for c in conjuncts:
+        d = _conjunct_domain(c)
+        if d is None:
+            residuals.append(c)
+            continue
+        ch, dom = d
+        domains[ch] = domains[ch].intersect(dom) if ch in domains else dom
+        # domains are a *complete* representation of these conjuncts (no residual
+        # needed): every translated form below is null-rejecting or explicitly
+        # null-handling, matching WHERE semantics (NULL -> row dropped).
+    return ExtractionResult(TupleDomain(domains), residuals)
+
+
+def _is_orderable(t) -> bool:
+    # dictionary ids carry no value order -> equality-only domains
+    return not t.is_string
+
+
+def _const_value(e):
+    if not isinstance(e, ir.Constant):
+        return None
+    v = e.value
+    if isinstance(v, np.ndarray):
+        return None
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (int, float, str, bool)):
+        return v
+    return None
+
+
+_FLIP = {"lt": "gt", "lte": "gte", "gt": "lt", "gte": "lte", "eq": "eq", "neq": "neq"}
+
+
+def _conjunct_domain(c):
+    """Translate one conjunct into (channel, Domain), or None if untranslatable."""
+    if not isinstance(c, ir.Call):
+        return None
+    op, args = c.op, c.args
+
+    if op == "not" and len(args) == 1 and isinstance(args[0], ir.Call) \
+            and args[0].op == "is_null" and isinstance(args[0].args[0], ir.FieldRef):
+        f = args[0].args[0]
+        return f.index, Domain.not_null(_is_orderable(f.type))
+
+    if op == "is_null" and isinstance(args[0], ir.FieldRef):
+        f = args[0]
+        return f.index, Domain.only_null(_is_orderable(f.type))
+
+    if op in _FLIP and len(args) == 2:
+        a, b = args
+        if isinstance(b, ir.FieldRef) and isinstance(a, ir.Constant):
+            a, b = b, a
+            op = _FLIP[op]
+        if not (isinstance(a, ir.FieldRef) and isinstance(b, ir.Constant)):
+            return None
+        v = _const_value(b)
+        if v is None:
+            return None
+        orderable = _is_orderable(a.type)
+        if op == "eq":
+            return a.index, Domain.single_value(v, orderable)
+        if op == "neq":
+            # `col <> v` in WHERE semantics also rejects NULL
+            return a.index, Domain(Domain.single_value(v, orderable).values.complement(), False)
+        if not orderable:
+            return None
+        r = {"lt": Range.less_than, "lte": Range.less_than_or_equal,
+             "gt": Range.greater_than, "gte": Range.greater_than_or_equal}[op](v)
+        return a.index, Domain.from_range(r)
+
+    if op == "between" and isinstance(args[0], ir.FieldRef) and _is_orderable(args[0].type):
+        lo, hi = _const_value(args[1]), _const_value(args[2])
+        if lo is None or hi is None or lo > hi:
+            return None
+        return args[0].index, Domain.from_range(Range.between(lo, hi))
+
+    if op == "in" and isinstance(args[0], ir.FieldRef):
+        vals = [_const_value(a) for a in args[1:]]
+        if any(v is None for v in vals):
+            return None
+        f = args[0]
+        return f.index, Domain.multiple_values(vals, _is_orderable(f.type))
+
+    if op == "lut" and isinstance(args[0], ir.FieldRef) and len(args) == 2 \
+            and isinstance(args[1], ir.Constant) \
+            and isinstance(args[1].value, np.ndarray) and args[1].value.dtype == bool:
+        # dictionary-id predicate: table[id] says whether the id passes
+        ids = np.nonzero(args[1].value)[0]
+        f = args[0]
+        return f.index, Domain.multiple_values([int(i) for i in ids], False)
+
+    if op == "or" and len(args) == 2:
+        l, r = _conjunct_domain(args[0]), _conjunct_domain(args[1])
+        if l is not None and r is not None and l[0] == r[0]:
+            return l[0], l[1].union(r[1])
+        return None
+
+    return None
+
+
+def domain_to_split_pruner(domains_by_column: dict, conn):
+    """Build a predicate over splits: False = split provably contains no matching
+    row.  Uses the connector's per-split min/max (`split_range`) — the engine-side
+    analog of the reference's TupleDomain-driven split pruning
+    (spi/connector/ConnectorSplitManager + dynamic filter pruning,
+    server/DynamicFilterService.java:101)."""
+    # Null-admitting domains cannot prune: min/max stats say nothing about NULLs
+    # (the reference likewise prunes only when Domain.isNullAllowed is false or the
+    # stats track null counts — ours don't).
+    prunable = {c: d for c, d in domains_by_column.items()
+                if not d.null_allowed
+                and (isinstance(d.values, SortedRangeSet) or d.values.is_discrete)}
+
+    def keep(split) -> bool:
+        for col, dom in prunable.items():
+            rng = conn.split_range(split, col)
+            if rng is not None and not dom.overlaps_range(rng[0], rng[1]):
+                return False
+        return True
+
+    return keep
