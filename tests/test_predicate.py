@@ -237,3 +237,28 @@ def test_null_admitting_domains_never_prune(tmp_path):
     # and non-null range predicates still prune correctly
     r3 = e.execute_sql("select count(*) from events where val = 150", s).rows()
     assert r3[0][0] == sum(1 for v in vals if v == 150)
+
+
+def test_direct_groupby_late_null_page(tmp_path):
+    """Direct-indexed group-by frozen from a null-free first page must fall back
+    (not merge NULLs into a real group) when a later page introduces NULL keys
+    (regression: NULL rows landed in the value-lo group)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from trino_tpu import Engine
+    from trino_tpu.connectors.parquet import ParquetConnector
+
+    ks = [1 + (i % 3) for i in range(900)] + \
+         [None if i % 5 == 0 else 1 + (i % 3) for i in range(900)]
+    pq.write_table(pa.table({"k": pa.array(ks, pa.int64())}),
+                   str(tmp_path / "t.parquet"), row_group_size=900)
+    e = Engine()
+    e.register_catalog("pq", ParquetConnector(str(tmp_path)))
+    s = e.create_session("pq")
+    rows = e.execute_sql("select k, count(*) c from t group by k order by k", s).rows()
+    import collections
+
+    expect = collections.Counter(ks)
+    got = {k: c for k, c in rows}
+    assert got == dict(expect)

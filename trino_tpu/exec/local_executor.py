@@ -121,7 +121,10 @@ class LocalExecutor:
         import time as _time
 
         s = self.stats.setdefault(id(node), {"rows": 0, "wall_s": 0.0})
-        s["rows"] = int(np.asarray(page.valid_mask()).sum()) if page.capacity else 0
+        # keep the row count ON DEVICE (async dispatch): forcing it here would pay a
+        # device->host RTT per operator on the normal query path; EXPLAIN ANALYZE
+        # materializes lazily when formatting
+        s["rows"] = jnp.sum(page.valid_mask(), dtype=jnp.int64) if page.capacity else 0
         s["wall_s"] += _time.perf_counter() - t0
 
     # ---------------------------------------------------------------- internal
@@ -293,17 +296,99 @@ class LocalExecutor:
         self._agg_cache[id(node)] = (node,) + out
         return out
 
+    def _key_ranges(self, stream, node):
+        """Static (lo, hi) bounds per group key channel, from dictionaries, type, or
+        connector stats (reference: stats-driven GroupByHash sizing +
+        BigintGroupByHash fast-path selection, operator/GroupByHash.java:90)."""
+        si = stream.scan_info
+        table_name = None
+        if si is not None and si.splits and hasattr(si.splits[0], "table"):
+            table_name = si.splits[0].table
+        out = []
+        for i in node.keys:
+            t = stream.schema.fields[i].type
+            d = stream.dicts[i]
+            if d is not None and getattr(d, "values", None) is not None:
+                out.append((0, max(len(d.values) - 1, 0)))
+            elif t.name == "boolean":
+                out.append((0, 1))
+            elif t.is_floating:
+                out.append(None)
+            else:
+                rng = None
+                if (si is not None and i < len(si.columns)
+                        and si.columns[i] is not None and table_name is not None
+                        and hasattr(si.conn, "column_range")):
+                    r = si.conn.column_range(table_name, si.columns[i])
+                    if r and r[0] is not None and r[1] is not None:
+                        rng = (int(r[0]), int(r[1]))
+                out.append(rng)
+        return tuple(out)
+
+    def _direct_step(self, node, cfg, stream, key_types, acc_exprs, acc_kinds):
+        """Jitted direct-indexed insert step (cached per (node, cfg))."""
+        hit = self._agg_cache.get(("direct", id(node), cfg))
+        if hit is not None:
+            return hit[1]
+
+        @jax.jit
+        def dstep(state, page, stream=stream, node=node, cfg=cfg,
+                  acc_exprs=acc_exprs, acc_kinds=acc_kinds):
+            cols, nulls, valid = stream.transform(
+                page.columns, page.null_masks, page.valid_mask()
+            )
+            key_vals = tuple(cols[i] for i in node.keys)
+            key_nulls = tuple(nulls[i] for i in node.keys)
+            inputs = [
+                (None, None) if e is None else evaluate(e, cols, nulls) for e in acc_exprs
+            ]
+            return hashagg.direct_groupby_insert(
+                state, cfg, key_vals, valid, inputs, acc_kinds, key_nulls
+            )
+
+        self._agg_cache[("direct", id(node), cfg)] = (node, dstep)
+        return dstep
+
     def _run_aggregate(self, node: P.Aggregate):
         stream, key_types, acc_specs, acc_exprs, acc_kinds, step = self._agg_compiled(node)
         capacity = node.capacity or DEFAULT_GROUP_CAPACITY
         if not node.keys:
             return self._run_global_aggregate(node, stream, acc_exprs, acc_kinds)
 
+        # direct-indexed fast path: slot = packed key when static ranges are narrow
+        # (reference: BigintGroupByHash, operator/GroupByHash.java:90-99)
+        import itertools
+
+        page_iter = iter(stream.pages())
+        first = next(page_iter, None)
+        cfg = None
+        if first is not None:
+            key_ranges = self._key_ranges(stream, node)
+            if all(r is not None for r in key_ranges):
+                _, onulls, _ = jax.eval_shape(
+                    lambda c, n, v: stream.transform(c, n, v),
+                    first.columns, first.null_masks, first.valid_mask())
+                key_nullable = tuple(onulls[i] is not None for i in node.keys)
+                cfg = hashagg.direct_config(key_ranges, key_nullable)
+        pages_once = itertools.chain([first], page_iter) if first is not None else ()
+
         while True:
+            if cfg is not None:
+                state = hashagg.direct_groupby_init(
+                    cfg, tuple(t.dtype for t in key_types), acc_specs)
+                dstep = self._direct_step(node, cfg, stream, key_types, acc_exprs,
+                                          acc_kinds)
+                for page in pages_once:
+                    state = dstep(state, page)
+                if not bool(state.overflow):
+                    break
+                cfg = None  # stale stats put keys out of range: hash mode
+                pages_once = stream.pages()
+                continue
             state = hashagg.groupby_init(
                 capacity, tuple(t.dtype for t in key_types), acc_specs
             )
-            for page in stream.pages():
+            for page in pages_once:
                 state = step(state, page)
             if not bool(state.overflow):
                 break
@@ -314,6 +399,7 @@ class LocalExecutor:
                 # instead of spilling state to disk)
                 return self._run_aggregate_partitioned(node, parts=4)
             capacity *= 4  # next capacity bucket (reference: FlatHash#rehash)
+            pages_once = stream.pages()
 
         return self._finalize_groups(node, stream, state)
 
@@ -324,12 +410,17 @@ class LocalExecutor:
         n_groups = int(hashagg.group_count(state))
         bucket = max(1 << max(n_groups - 1, 1).bit_length(), 64)
         keys, key_nulls, accs = hashagg.compact_groups(state, bucket)
-        key_cols = [np.asarray(k[:n_groups]) for k in keys]
-        key_null_cols = [np.asarray(kn[:n_groups]) for kn in key_nulls]
-        acc_cols = [np.asarray(a[:n_groups]) for a in accs]
+        nk = len(keys)
+        got = _host(list(keys) + list(key_nulls) + list(accs))
+        key_cols = [k[:n_groups] for k in got[:nk]]
+        key_null_cols = [kn[:n_groups] for kn in got[nk:2 * nk]]
+        acc_cols = [a[:n_groups] for a in got[2 * nk:]]
+        # keep the (tiny) aggregate output on the host: downstream breakers
+        # (sort/limit/materialize) are host-side, and a jitted parent transform
+        # device-puts automatically — pushing eagerly would buy extra round-trips
         out_cols = key_cols + _finalize_aggs(node.aggs, acc_cols, n_groups)
-        arrays = [jnp.asarray(c) for c in out_cols]
-        out_nulls = tuple(jnp.asarray(kn) if kn.any() else None for kn in key_null_cols
+        arrays = [np.asarray(c) for c in out_cols]
+        out_nulls = tuple(kn if kn.any() else None for kn in key_null_cols
                           ) + tuple(None for _ in node.aggs)
         page = Page(node.schema, tuple(arrays), out_nulls, None)
         dicts = tuple(stream.dicts[i] for i in node.keys) + tuple(None for _ in node.aggs)
@@ -711,16 +802,31 @@ def _concat_stream(stream: _Stream) -> Page:
     never cross to the host between pipeline-breaking stages — device->host bandwidth
     is the scarce resource, not FLOPs (reference analog: pages stay in worker memory
     between operators)."""
-    parts = []
     step = stream.jitted()
+    parts = []
+    staged, sums = [], []
+
+    def _drain():
+        # one batched host sync per chunk of pages (per-page int() pays a
+        # device->host RTT per page on tunneled links); chunking bounds how many
+        # uncompacted pages sit on device at once
+        for (cols, nulls, valid), n in zip(staged, [int(c) for c in _host(sums)]):
+            if n == 0:
+                continue
+            bucket = max(1 << max(n - 1, 1).bit_length(), 1024)
+            ccols, cnulls = _compact_part(cols, nulls, valid,
+                                          min(bucket, valid.shape[0]))
+            parts.append((ccols, cnulls, n))
+        staged.clear()
+        sums.clear()
+
     for page in stream.pages():
         cols, nulls, valid = step(page)
-        n = int(jnp.sum(valid))  # one scalar sync per page to size the shape bucket
-        if n == 0:
-            continue
-        bucket = max(1 << max(n - 1, 1).bit_length(), 1024)
-        ccols, cnulls = _compact_part(cols, nulls, valid, min(bucket, valid.shape[0]))
-        parts.append((ccols, cnulls, n))
+        staged.append((cols, nulls, valid))
+        sums.append(jnp.sum(valid, dtype=jnp.int32))
+        if len(staged) >= 8:
+            _drain()
+    _drain()
     if not parts:
         cols = tuple(jnp.zeros((0,), f.type.dtype) for f in stream.schema.fields)
         return Page(stream.schema, cols, tuple(None for _ in cols), None)
@@ -872,14 +978,38 @@ def _values_page(node: P.Values) -> Page:
     return Page(node.schema, tuple(cols), tuple(None for _ in cols), None)
 
 
+def _host(arrays):
+    """Device->host transfer of many arrays with ONE round-trip of latency: start
+    async copies for every array first, then materialize.  On tunneled/remote
+    device links each serial np.asarray pays a full RTT (~100ms); batching is the
+    difference between interactive and glacial result paths."""
+    for a in arrays:
+        if hasattr(a, "copy_to_host_async"):
+            try:
+                a.copy_to_host_async()
+            except Exception:
+                pass
+    return [None if a is None else np.asarray(a) for a in arrays]
+
+
+def _host_page(page: Page):
+    """(valid, cols, nulls) as numpy, fetched in one batched transfer.  A page with
+    no validity mask gets a host-side ones() — no device fetch fabricated for it."""
+    nc = len(page.columns)
+    got = _host(list(page.columns) + list(page.null_masks))
+    valid = (np.ones((page.capacity,), bool) if page.valid is None
+             else _host([page.valid])[0])
+    return valid, got[:nc], got[nc:]
+
+
 def _sort_page(page: Page, keys, dicts=None) -> Page:
     """Host-side lexicographic sort (result sets; large distributed sort is separate).
 
     Dictionary-encoded string channels sort by *decoded string order*, not id order
     (ids are assigned in dictionary, not collation, order)."""
-    valid = np.asarray(page.valid_mask())
-    cols = [np.asarray(c)[valid] for c in page.columns]
-    nulls = [None if n is None else np.asarray(n)[valid] for n in page.null_masks]
+    valid, pcols, pnulls = _host_page(page)
+    cols = [c[valid] for c in pcols]
+    nulls = [None if n is None else n[valid] for n in pnulls]
     sort_cols = list(cols)
     for k in keys:
         d = dicts[k.channel] if dicts is not None else None
@@ -906,20 +1036,22 @@ def _sort_page(page: Page, keys, dicts=None) -> Page:
             if k.nulls_first:
                 ind = -ind
             order = order[np.argsort(ind, kind="stable")]
-    new_cols = tuple(jnp.asarray(c[order]) for c in cols)
-    new_nulls = tuple(None if n is None else jnp.asarray(n[order]) for n in nulls)
+    # stay on the host: downstream consumers (limit/materialize) are host-side too,
+    # so pushing back to the device would just buy extra round-trips
+    new_cols = tuple(c[order] for c in cols)
+    new_nulls = tuple(None if n is None else n[order] for n in nulls)
     return Page(page.schema, new_cols, new_nulls, None)
 
 
 def _topn_page(page: Page, keys, count: int, dicts=None) -> Page:
     """ORDER BY + LIMIT: argpartition down to ~count candidates on the primary key,
     then full lexicographic sort of the survivors (host-side; result-set sized)."""
-    valid = np.asarray(page.valid_mask())
+    valid, pcols, pnulls = _host_page(page)
     n = int(valid.sum())
     if n > max(4 * count, 1024) and len(keys) >= 1:
         k0 = keys[0]
-        c = np.asarray(page.columns[k0.channel])[valid]
-        nm = page.null_masks[k0.channel]
+        c = pcols[k0.channel][valid]
+        nm = pnulls[k0.channel]
         d = dicts[k0.channel] if dicts is not None else None
         if nm is None and d is None and np.issubdtype(c.dtype, np.number) and not (
                 np.issubdtype(c.dtype, np.floating) and np.isnan(c).any()):
@@ -934,35 +1066,31 @@ def _topn_page(page: Page, keys, count: int, dicts=None) -> Page:
             mask = np.zeros_like(valid)
             mask[idx] = True
             page = Page(page.schema,
-                        tuple(jnp.asarray(np.asarray(col)[mask])
-                              for col in page.columns),
-                        tuple(None if m is None else jnp.asarray(np.asarray(m)[mask])
-                              for m in page.null_masks), None)
+                        tuple(col[mask] for col in pcols),
+                        tuple(None if m is None else m[mask] for m in pnulls), None)
     return _limit_page(_sort_page(page, keys, dicts), count)
 
 
 def _limit_page(page: Page, count: int) -> Page:
-    valid = np.asarray(page.valid_mask())
-    cols = tuple(jnp.asarray(np.asarray(c)[valid][:count]) for c in page.columns)
-    nulls = tuple(
-        None if n is None else jnp.asarray(np.asarray(n)[valid][:count]) for n in page.null_masks
-    )
+    valid, pcols, pnulls = _host_page(page)
+    cols = tuple(c[valid][:count] for c in pcols)
+    nulls = tuple(None if n is None else n[valid][:count] for n in pnulls)
     return Page(page.schema, cols, nulls, None)
 
 
 def _materialize(page: Page, dicts) -> MaterializedResult:
-    valid = np.asarray(page.valid_mask())
+    valid, pcols, pnulls = _host_page(page)
     names, types, columns, raw = [], [], [], []
     for i, f in enumerate(page.schema.fields):
-        arr = np.asarray(page.columns[i])[valid]
+        arr = pcols[i][valid]
         raw.append(arr)
         dec = arr
         if isinstance(f.type, DecimalType):
             dec = arr.astype(np.float64) / (10**f.type.scale)
         elif f.type.is_string and dicts[i] is not None:
             dec = dicts[i].decode(arr)
-        if page.null_masks[i] is not None:
-            nm = np.asarray(page.null_masks[i])[valid]
+        if pnulls[i] is not None:
+            nm = pnulls[i][valid]
             dec = np.array([None if m else v for v, m in zip(dec.tolist(), nm)], dtype=object) \
                 if nm.any() else dec
         names.append(f.name)

@@ -35,9 +35,17 @@ import numpy as np
 from ..types import BOOLEAN as _BOOL_KEY
 from .hashing import EMPTY_KEY, pack_keys, splitmix64
 
-__all__ = ["GroupByState", "groupby_init", "groupby_insert", "AGG_INITS", "agg_update", "agg_finalize"]
+__all__ = ["GroupByState", "groupby_init", "groupby_insert", "AGG_INITS", "agg_update",
+           "agg_finalize", "DirectConfig", "direct_config", "direct_groupby_init",
+           "direct_groupby_insert"]
 
 MAX_PROBES = 64
+
+# Direct-index mode bounds (reference: BigintGroupByHash fast path when the single
+# key is a small bigint, operator/GroupByHash.java:90-99 — generalized here to any
+# key set whose packed width is statically small).
+DIRECT_BITS_MAX = 20  # <= 1M slots: slot = packed key, no probing at all
+ONEHOT_CAP_MAX = 128  # <= 128 slots: masked-reduce aggregation, no scatter at all
 
 
 @jax.tree_util.register_pytree_node_class
@@ -70,6 +78,152 @@ def groupby_init(capacity: int, key_dtypes, acc_specs) -> GroupByState:
     key_nulls = tuple(jnp.zeros((capacity + 1,), bool) for _ in key_dtypes)
     accs = tuple(jnp.full((capacity + 1,), init, dtype=dt) for dt, init in acc_specs)
     return GroupByState(table, key_cols, key_nulls, accs, jnp.zeros((), bool))
+
+
+@dataclasses.dataclass(frozen=True)
+class DirectConfig:
+    """Static layout of a direct-indexed group-by: per key (nullable, lo, hi,
+    value_bits), most-significant first.  slot = bit-concatenation of
+    [null_flag?, (value - lo)] fields; total_bits <= DIRECT_BITS_MAX."""
+
+    entries: tuple  # ((nullable, lo, hi, value_bits), ...) aligned with keys
+    total_bits: int
+
+    @property
+    def capacity(self) -> int:
+        return 1 << self.total_bits
+
+
+def direct_config(key_ranges, key_nullable, max_bits: int = DIRECT_BITS_MAX):
+    """Build a DirectConfig, or None when ranges are unknown/too wide.
+
+    key_ranges: per key (lo, hi) inclusive value bounds or None;
+    key_nullable: per key, whether a null mask is present at trace time.
+    """
+    entries, total = [], 0
+    for rng, nullable in zip(key_ranges, key_nullable):
+        if rng is None or rng[0] is None or rng[1] is None:
+            return None
+        lo, hi = int(rng[0]), int(rng[1])
+        if hi < lo:
+            hi = lo
+        vb = max(int(hi - lo).bit_length(), 1)
+        total += vb + (1 if nullable else 0)
+        entries.append((bool(nullable), lo, hi, vb))
+    if total > max_bits:
+        return None
+    return DirectConfig(tuple(entries), total)
+
+
+def direct_groupby_init(cfg: DirectConfig, key_dtypes, acc_specs) -> GroupByState:
+    """Direct-mode state: key columns are PRE-FILLED by unpacking each slot index
+    (packing is injective), so inserts never scatter key captures."""
+    C = cfg.capacity
+    table = jnp.full((C + 1,), EMPTY_KEY, dtype=jnp.int64)
+    slots = jnp.arange(C + 1, dtype=jnp.int64)
+    key_cols, key_nulls = [], []
+    shift = cfg.total_bits
+    for (nullable, lo, hi, vb), dt in zip(cfg.entries, key_dtypes):
+        if nullable:
+            shift -= 1
+            flag = ((slots >> shift) & 1).astype(bool)
+        else:
+            flag = jnp.zeros((C + 1,), bool)
+        shift -= vb
+        field = (slots >> shift) & ((1 << vb) - 1)
+        val = (field + lo).astype(dt)
+        # null rows pack a masked value of 0 -> field (0 - lo) & mask; the value
+        # lane is garbage for them but the null flag marks the group as NULL
+        key_cols.append(jnp.where(flag, jnp.zeros((), dt), val))
+        key_nulls.append(flag)
+    accs = tuple(jnp.full((C + 1,), init, dtype=dt) for dt, init in acc_specs)
+    return GroupByState(table, tuple(key_cols), tuple(key_nulls), accs,
+                        jnp.zeros((), bool))
+
+
+def _direct_slot(cfg: DirectConfig, key_vals, key_nulls, valid):
+    """(slot[int32], in_range[bool]) — slot is the packed key; rows outside the
+    static ranges raise the overflow flag (stale stats) so the caller can fall
+    back to hash mode."""
+    n = key_vals[0].shape[0]
+    acc = jnp.zeros((n,), jnp.int64)
+    ok = jnp.ones((n,), bool)
+    for (nullable, lo, hi, vb), kv, kn in zip(cfg.entries, key_vals, key_nulls):
+        isnull = kn if kn is not None else jnp.zeros((n,), bool)
+        mv = jnp.where(isnull, jnp.zeros((), kv.dtype), kv) if kn is not None else kv
+        v64 = mv.astype(jnp.int64)
+        ok = ok & (isnull | ((v64 >= lo) & (v64 <= hi)))
+        if nullable:
+            acc = (acc << 1) | isnull.astype(jnp.int64)
+        elif kn is not None:
+            # the config was frozen from a page WITHOUT a null mask on this key;
+            # a later page introduced one (no flag bit reserved) — route NULL rows
+            # to overflow so the caller falls back to hash mode instead of merging
+            # them into the value-`lo` group
+            ok = ok & ~isnull
+        acc = (acc << vb) | ((v64 - lo) & ((1 << vb) - 1))
+    return acc.astype(jnp.int32), ok
+
+
+def direct_groupby_insert(state: GroupByState, cfg: DirectConfig, key_vals,
+                          valid, agg_inputs, agg_updates,
+                          key_nulls=None) -> GroupByState:
+    """One page -> updated direct-mode state.  No probing: slot = packed key.
+    Capacities <= ONEHOT_CAP_MAX aggregate via masked reductions over a
+    [rows, capacity] one-hot — zero scatters, MXU/VPU-friendly, fast to compile."""
+    if key_nulls is None:
+        key_nulls = tuple(None for _ in key_vals)
+    C = cfg.capacity
+    slot, ok = _direct_slot(cfg, key_vals, key_nulls, valid)
+    live = valid & ok
+    overflow = state.overflow | jnp.any(valid & ~ok)
+
+    if C <= ONEHOT_CAP_MAX:
+        lanes = jnp.arange(C, dtype=jnp.int32)
+        onehot = (slot[:, None] == lanes[None, :]) & live[:, None]  # [n, C]
+        occ = jnp.any(onehot, axis=0)
+        table = jnp.where(jnp.concatenate([occ, jnp.zeros((1,), bool)]),
+                          jnp.arange(C + 1, dtype=jnp.int64), state.table)
+        accs = tuple(
+            _onehot_agg_update(acc, kind, onehot, vals_nulls)
+            for acc, kind, vals_nulls in zip(state.accs, agg_updates, agg_inputs)
+        )
+        return GroupByState(table, state.key_cols, state.key_nulls, accs, overflow)
+
+    idx = jnp.where(live, slot, C)
+    table = state.table.at[idx].set(jnp.where(live, idx.astype(jnp.int64), EMPTY_KEY))
+    table = table.at[C].set(EMPTY_KEY)
+    accs = tuple(
+        agg_update(acc, kind, slot, live, vals_nulls)
+        for acc, kind, vals_nulls in zip(state.accs, agg_updates, agg_inputs)
+    )
+    return GroupByState(table, state.key_cols, state.key_nulls, accs, overflow)
+
+
+def _onehot_agg_update(acc, kind, onehot, vals_nulls):
+    """Aggregate one page into [capacity]-wide accumulators via masked reductions
+    over the one-hot (plus the overflow sink kept untouched at the end)."""
+    vals, nulls = vals_nulls if vals_nulls is not None else (None, None)
+    C = onehot.shape[1]
+    mask = onehot if (nulls is None or vals is None) else (onehot & ~nulls[:, None])
+    if kind in ("count_star", "count"):
+        m = onehot if kind == "count_star" else mask
+        delta = jnp.sum(m, axis=0).astype(acc.dtype)
+        return acc.at[:C].add(delta)
+    if kind == "sum":
+        delta = jnp.sum(jnp.where(mask, vals[:, None], 0), axis=0).astype(acc.dtype)
+        return acc.at[:C].add(delta)
+    if kind == "min":
+        big = _extreme(acc.dtype, +1)
+        page_min = jnp.min(jnp.where(mask, vals[:, None].astype(acc.dtype), big),
+                           axis=0)
+        return acc.at[:C].min(page_min)
+    if kind == "max":
+        small = _extreme(acc.dtype, -1)
+        page_max = jnp.max(jnp.where(mask, vals[:, None].astype(acc.dtype), small),
+                           axis=0)
+        return acc.at[:C].max(page_max)
+    raise NotImplementedError(kind)
 
 
 def _probe_insert(table, packed, valid):
@@ -116,21 +270,32 @@ def groupby_insert(state: GroupByState, key_vals: Sequence, key_types, valid,
     """
     if key_nulls is None:
         key_nulls = tuple(None for _ in key_vals)
-    pack_cols, pack_types = [], []
-    masked_vals = []
-    for kv, kt, kn in zip(key_vals, key_types, key_nulls):
-        if kn is None:
-            masked_vals.append(kv)
-            pack_cols.append(kv)
-            pack_types.append(kt)
-        else:
-            mv = jnp.where(kn, jnp.zeros((), kv.dtype), kv)
+    # The packed layout must be IDENTICAL for every page of one aggregation, or the
+    # same key value lands in different slots across pages whose null-mask structure
+    # differs (e.g. parquet row groups with and without NULLs).  Single-key: no flag
+    # bit ever — the NULL group routes to a reserved sentinel word (keeps the exact
+    # single-64-bit-key packing).  Multi-key: a flag bit per key, always present.
+    if len(key_vals) == 1:
+        kv, kt, kn = key_vals[0], key_types[0], key_nulls[0]
+        mv = jnp.where(kn, jnp.zeros((), kv.dtype), kv) if kn is not None else kv
+        masked_vals = [mv]
+        packed, exact = pack_keys((mv,), (kt,))
+        if kn is not None:
+            # EMPTY_KEY is the free-slot marker (its remap target is EMPTY_KEY-1);
+            # EMPTY_KEY-2 is the NULL group's reserved word
+            packed = jnp.where(kn, EMPTY_KEY - 2, packed)
+    else:
+        pack_cols, pack_types = [], []
+        masked_vals = []
+        for kv, kt, kn in zip(key_vals, key_types, key_nulls):
+            mv = kv if kn is None else jnp.where(kn, jnp.zeros((), kv.dtype), kv)
             masked_vals.append(mv)
-            pack_cols.append(kn.astype(jnp.int8))
+            pack_cols.append(jnp.zeros(kv.shape, jnp.int8) if kn is None
+                             else kn.astype(jnp.int8))
             pack_types.append(_BOOL_KEY)
             pack_cols.append(mv)
             pack_types.append(kt)
-    packed, exact = pack_keys(tuple(pack_cols), tuple(pack_types))
+        packed, exact = pack_keys(tuple(pack_cols), tuple(pack_types))
     table, slot, placed = _probe_insert(state.table, packed, valid)
     overflow = state.overflow | jnp.any(valid & ~placed)
     live = valid & placed

@@ -235,7 +235,6 @@ class SortedRangeSet(ValueSet):
         if self.is_none:
             return SortedRangeSet.all_()
         out = []
-        prev_high, prev_hii = None, False  # start at -inf
         first = self.ranges[0]
         if first.low is not None:
             out.append(Range(None, False, first.low, not first.low_inclusive))

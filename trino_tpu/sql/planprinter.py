@@ -70,6 +70,7 @@ def _fmt(node: P.PlanNode, lines: list, depth: int, stats: dict) -> None:
         lines.append(f"{pad}{type(node).__name__} => {_schema_str(node)}")
     s = stats.get(id(node))
     if s is not None and len(lines) > before:
-        lines[before] += f"  [rows: {s['rows']}, {s['wall_s'] * 1000:.1f} ms]"
+        # row counts may still live on device (deferred device->host sync)
+        lines[before] += f"  [rows: {int(s['rows'])}, {s['wall_s'] * 1000:.1f} ms]"
     for c in node.children:
         _fmt(c, lines, depth + 1, stats)
