@@ -1,14 +1,17 @@
-"""Benchmark: TPC-H Q1 at SF1 on the local accelerator vs a CPU columnar baseline.
+"""Benchmark: the TPC-H north-star suite (Q1/Q3/Q9/Q18) on the local accelerator
+vs a vectorized CPU (numpy/pandas) evaluation of the same queries on the same data.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Protocol mirrors the reference's benchto macro setup (2 prewarm + timed runs, SURVEY.md §6:
-testing/trino-benchto-benchmarks/.../tpch.yaml): value = Q1 input rows/sec on one chip,
-vs_baseline = speedup over a numpy/pandas vectorized CPU evaluation of the same query on
-the same generated data.
+Protocol mirrors the reference's benchto macro setup (2 prewarm + timed runs,
+SURVEY.md §6: testing/trino-benchto-benchmarks/.../tpch.yaml): per query, 2 prewarm
++ 3 timed runs, median taken.  value = summed TPC-H input rows / summed median
+wall-clock (rows/sec on one chip); vs_baseline = geometric-mean per-query speedup
+over the CPU baseline.  BENCH_SF overrides the scale factor (default 1).
 """
 
 import json
+import os
 import time
 
 import jax
@@ -17,8 +20,11 @@ jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
 
-SF = float(__import__("os").environ.get("BENCH_SF", "1"))
-Q1 = """
+SF = float(os.environ.get("BENCH_SF", "1"))
+RUNS = int(os.environ.get("BENCH_RUNS", "3"))
+
+QUERIES = {
+    "q1": """
     select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
            sum(l_extendedprice) as sum_base_price,
            sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
@@ -26,7 +32,135 @@ Q1 = """
            avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
            avg(l_discount) as avg_disc, count(*) as count_order
     from lineitem where l_shipdate <= date '1998-12-01' - interval '90' day
-    group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus"""
+    group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus""",
+    "q3": """
+    select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+           o_orderdate, o_shippriority
+    from customer, orders, lineitem
+    where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+      and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+      and l_shipdate > date '1995-03-15'
+    group by l_orderkey, o_orderdate, o_shippriority
+    order by revenue desc, o_orderdate limit 10""",
+    "q9": """
+    select nation, o_year, sum(amount) as sum_profit from (
+      select n_name as nation, extract(year from o_orderdate) as o_year,
+        l_extendedprice * (1 - l_discount) - ps_supplycost * l_quantity as amount
+      from part, supplier, lineitem, partsupp, orders, nation
+      where s_suppkey = l_suppkey and ps_suppkey = l_suppkey and ps_partkey = l_partkey
+        and p_partkey = l_partkey and o_orderkey = l_orderkey
+        and s_nationkey = n_nationkey and p_name like '%green%') as profit
+    group by nation, o_year order by nation, o_year desc""",
+    "q18": """
+    select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice, sum(l_quantity)
+    from customer, orders, lineitem
+    where o_orderkey in (select l_orderkey from lineitem group by l_orderkey
+                         having sum(l_quantity) > 300)
+      and c_custkey = o_custkey and o_orderkey = l_orderkey
+    group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+    order by o_totalprice desc, o_orderdate limit 100""",
+}
+
+# TPC-H input rows touched per query (the tables each query scans)
+QUERY_TABLES = {
+    "q1": ["lineitem"],
+    "q3": ["customer", "orders", "lineitem"],
+    "q9": ["part", "supplier", "lineitem", "partsupp", "orders", "nation"],
+    "q18": ["customer", "orders", "lineitem"],
+}
+
+
+def _host_tables(conn, tables):
+    """Pull the generated TPC-H columns to host numpy (baseline input; transfer
+    time is NOT part of either measurement)."""
+    import pandas as pd
+
+    out = {}
+    for t in set(tables):
+        schema = conn.schema(t)
+        dicts = conn.dictionaries(t)
+        cols = {}
+        for f in schema.fields:
+            parts = []
+            for sp in conn.splits(t):
+                page = conn.generate(sp, [f.name])
+                valid = np.asarray(page.valid_mask())
+                arr = np.asarray(page.column(f.name))[valid]
+                parts.append(arr)
+            arr = np.concatenate(parts)
+            d = dicts.get(f.name)
+            if d is not None:
+                arr = d.decode(arr)
+            cols[f.name] = arr
+        out[t] = pd.DataFrame(cols)
+    return out
+
+
+def cpu_q1(T):
+    df = T["lineitem"]
+    cutoff = (np.datetime64("1998-12-01") - np.timedelta64(90, "D")
+              - np.datetime64("1970-01-01")).astype(np.int64)
+    m = df[df["l_shipdate"].to_numpy() <= cutoff]
+    disc = m["l_discount"].to_numpy() / 100.0
+    tax = m["l_tax"].to_numpy() / 100.0
+    price = m["l_extendedprice"].to_numpy() / 100.0
+    g = m.assign(dp=price * (1 - disc), ch=price * (1 - disc) * (1 + tax),
+                 qty=m["l_quantity"].to_numpy() / 100.0, pr=price, dc=disc)
+    r = g.groupby(["l_returnflag", "l_linestatus"]).agg(
+        sum_qty=("qty", "sum"), sum_base=("pr", "sum"), sum_dp=("dp", "sum"),
+        sum_ch=("ch", "sum"), avg_qty=("qty", "mean"), avg_pr=("pr", "mean"),
+        avg_dc=("dc", "mean"), cnt=("dp", "size")).reset_index()
+    return r.sort_values(["l_returnflag", "l_linestatus"])
+
+
+def cpu_q3(T):
+    c = T["customer"]; o = T["orders"]; l = T["lineitem"]
+    cutoff = (np.datetime64("1995-03-15") - np.datetime64("1970-01-01")).astype(np.int64)
+    c2 = c[c["c_mktsegment"] == "BUILDING"][["c_custkey"]]
+    o2 = o[o["o_orderdate"].to_numpy() < cutoff][
+        ["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority"]]
+    l2 = l[l["l_shipdate"].to_numpy() > cutoff][
+        ["l_orderkey", "l_extendedprice", "l_discount"]]
+    j = o2.merge(c2, left_on="o_custkey", right_on="c_custkey")
+    j = l2.merge(j, left_on="l_orderkey", right_on="o_orderkey")
+    rev = (j["l_extendedprice"].to_numpy() / 100.0) * (1 - j["l_discount"].to_numpy() / 100.0)
+    j = j.assign(revenue=rev)
+    r = j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])["revenue"].sum().reset_index()
+    return r.sort_values(["revenue", "o_orderdate"], ascending=[False, True]).head(10)
+
+
+def cpu_q9(T):
+    p = T["part"]; s = T["supplier"]; l = T["lineitem"]
+    ps = T["partsupp"]; o = T["orders"]; n = T["nation"]
+    p2 = p[p["p_name"].astype(str).str.contains("green")][["p_partkey"]]
+    j = l.merge(p2, left_on="l_partkey", right_on="p_partkey")
+    j = j.merge(s[["s_suppkey", "s_nationkey"]], left_on="l_suppkey", right_on="s_suppkey")
+    j = j.merge(ps[["ps_partkey", "ps_suppkey", "ps_supplycost"]],
+                left_on=["l_partkey", "l_suppkey"], right_on=["ps_partkey", "ps_suppkey"])
+    j = j.merge(o[["o_orderkey", "o_orderdate"]], left_on="l_orderkey", right_on="o_orderkey")
+    j = j.merge(n[["n_nationkey", "n_name"]], left_on="s_nationkey", right_on="n_nationkey")
+    amount = (j["l_extendedprice"].to_numpy() / 100.0) * (1 - j["l_discount"].to_numpy() / 100.0) \
+        - (j["ps_supplycost"].to_numpy() / 100.0) * (j["l_quantity"].to_numpy() / 100.0)
+    year = (j["o_orderdate"].to_numpy().astype("datetime64[D]")).astype("datetime64[Y]").astype(int) + 1970
+    j = j.assign(amount=amount, o_year=year)
+    r = j.groupby(["n_name", "o_year"])["amount"].sum().reset_index()
+    return r.sort_values(["n_name", "o_year"], ascending=[True, False])
+
+
+def cpu_q18(T):
+    c = T["customer"]; o = T["orders"]; l = T["lineitem"]
+    qty = l.groupby("l_orderkey")["l_quantity"].sum()
+    big = qty[qty > 30000].index  # l_quantity is a scaled decimal (x100)
+    o2 = o[o["o_orderkey"].isin(big)]
+    j = o2.merge(c[["c_custkey", "c_name"]], left_on="o_custkey", right_on="c_custkey")
+    j = j.merge(l[["l_orderkey", "l_quantity"]], left_on="o_orderkey", right_on="l_orderkey")
+    r = j.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate", "o_totalprice"])[
+        "l_quantity"].sum().reset_index()
+    return r.sort_values(["o_totalprice", "o_orderdate"],
+                         ascending=[False, True]).head(100)
+
+
+CPU_QUERIES = {"q1": cpu_q1, "q3": cpu_q3, "q9": cpu_q9, "q18": cpu_q18}
 
 
 def main():
@@ -38,61 +172,40 @@ def main():
     engine.register_catalog("tpch", conn)
     session = engine.create_session("tpch")
 
-    # input cardinality (generated lineitem rows)
-    n_rows = 0
-    for s in conn.splits("lineitem"):
-        page = conn.generate(s, ["l_orderkey"])
-        n_rows += int(np.asarray(page.num_rows()))
+    row_counts = {t: conn.row_count(t) for t in
+                  {t for ts in QUERY_TABLES.values() for t in ts}}
 
-    # engine timing: 2 prewarm + 3 timed (median)
-    for _ in range(2):
-        engine.execute_sql(Q1, session)
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        engine.execute_sql(Q1, session)
-        times.append(time.perf_counter() - t0)
-    engine_t = sorted(times)[1]
+    engine_times = {}
+    for name, sql in QUERIES.items():
+        for _ in range(2):
+            engine.execute_sql(sql, session)
+        times = []
+        for _ in range(RUNS):
+            t0 = time.perf_counter()
+            engine.execute_sql(sql, session)
+            times.append(time.perf_counter() - t0)
+        engine_times[name] = sorted(times)[len(times) // 2]
 
-    # CPU baseline: vectorized numpy over the same columns (host-side)
-    cols = ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
-            "l_discount", "l_tax", "l_shipdate"]
-    host = {c: [] for c in cols}
-    for s in conn.splits("lineitem"):
-        page = conn.generate(s, cols)
-        valid = np.asarray(page.valid_mask())
-        for c in cols:
-            host[c].append(np.asarray(page.column(c))[valid])
-    host = {c: np.concatenate(v) for c, v in host.items()}
+    T = _host_tables(conn, [t for ts in QUERY_TABLES.values() for t in ts])
+    cpu_times = {}
+    for name, fn in CPU_QUERIES.items():
+        fn(T)  # warm
+        times = []
+        for _ in range(RUNS):
+            t0 = time.perf_counter()
+            fn(T)
+            times.append(time.perf_counter() - t0)
+        cpu_times[name] = sorted(times)[len(times) // 2]
 
-    def cpu_q1():
-        cutoff = (np.datetime64("1998-12-01") - np.timedelta64(90, "D")
-                  - np.datetime64("1970-01-01")).astype(np.int64)
-        m = host["l_shipdate"] <= cutoff
-        rf, ls = host["l_returnflag"][m], host["l_linestatus"][m]
-        qty, price = host["l_quantity"][m], host["l_extendedprice"][m]
-        disc, tax = host["l_discount"][m], host["l_tax"][m]
-        gid = rf * 2 + ls
-        dp = price * (100 - disc)
-        ch = dp * (100 + tax)
-        out = []
-        for g in np.unique(gid):
-            mm = gid == g
-            out.append((qty[mm].sum(), price[mm].sum(), dp[mm].sum(), ch[mm].sum(),
-                        mm.sum()))
-        return out
-
-    cpu_q1()  # warm caches
-    t0 = time.perf_counter()
-    cpu_q1()
-    cpu_t = time.perf_counter() - t0
-
-    value = n_rows / engine_t
+    total_rows = sum(sum(row_counts[t] for t in QUERY_TABLES[q]) for q in QUERIES)
+    total_t = sum(engine_times.values())
+    speedups = [cpu_times[q] / engine_times[q] for q in QUERIES]
+    geomean = float(np.exp(np.mean(np.log(speedups))))
     print(json.dumps({
-        "metric": f"tpch_sf{SF:g}_q1_rows_per_sec_per_chip",
-        "value": round(value),
+        "metric": f"tpch_sf{SF:g}_q1_q3_q9_q18_rows_per_sec_per_chip",
+        "value": round(total_rows / total_t),
         "unit": "rows/s",
-        "vs_baseline": round(cpu_t / engine_t, 3),
+        "vs_baseline": round(geomean, 3),
     }))
 
 

@@ -26,7 +26,10 @@ import numpy as np
 
 from ..connectors.tpch import Dictionary
 from ..ops import hashagg
-from ..ops.hashjoin import (JoinTable, MultiJoinTable, build_insert, build_table_init,
+from ..ops.hashjoin import (DIRECT_JOIN_RANGE_MAX, DirectJoinTable,
+                            DirectMultiJoinTable, JoinTable, MultiJoinTable,
+                            build_insert, build_table_init, direct_build,
+                            direct_multi_build, direct_probe, direct_probe_slots,
                             expand_counts, multi_build, probe, probe_slots)
 from ..page import Field, Page, Schema
 from ..types import BIGINT, DOUBLE, BOOLEAN, DecimalType, Type
@@ -370,6 +373,25 @@ class LocalExecutor:
                     first.columns, first.null_masks, first.valid_mask())
                 key_nullable = tuple(onulls[i] is not None for i in node.keys)
                 cfg = hashagg.direct_config(key_ranges, key_nullable)
+            if cfg is None and node.capacity is None:
+                # hash mode: size the initial table from the key-range product and
+                # the input row bound so huge group counts don't crawl through
+                # grow-by-4x retries, each a fresh compile (reference: stats-driven
+                # GroupByHash expectedSize)
+                est = 1
+                for r in key_ranges:
+                    if r is None or est > MAX_GROUP_CAPACITY:
+                        break
+                    est *= max(int(r[1]) - int(r[0]) + 1, 1)
+                else:
+                    si = stream.scan_info
+                    if si is not None and si.splits \
+                            and hasattr(si.conn, "row_count") \
+                            and hasattr(si.splits[0], "table"):
+                        est = min(est, int(si.conn.row_count(si.splits[0].table)))
+                    target = 1 << max(2 * est - 1, 1).bit_length()
+                    capacity = max(capacity,
+                                   min(target, MAX_GROUP_CAPACITY))
         pages_once = itertools.chain([first], page_iter) if first is not None else ()
 
         while True:
@@ -583,18 +605,23 @@ class LocalExecutor:
                 probe_stream = dataclasses.replace(probe_stream, pages=pruned,
                                                    _jitted=None)
 
+        span = self._direct_join_span(build_page, node.right_keys, build_key_types)
         table = None
         if node.filter is None and build_page.capacity > 0:
-            table = self._build_join_table(build_page, node.right_keys, build_key_types)
+            table = self._build_join_table(build_page, node.right_keys,
+                                           build_key_types, span)
         if table is None:
             # duplicate build keys or residual join filter -> multi-match strategy
             return self._compile_multi_join(node, build_page, build_dicts, probe_stream,
-                                            build_key_types)
+                                            build_key_types, span)
 
         def transform(cols, nulls, valid, up=probe_stream, node=node, table=table):
             cols, nulls, valid = up.transform(cols, nulls, valid)
             keys = tuple(cols[i] for i in node.left_keys)
-            row_ids, matched = probe(table, keys, build_key_types, valid)
+            if isinstance(table, DirectJoinTable):
+                row_ids, matched = direct_probe(table, keys[0], valid)
+            else:
+                row_ids, matched = probe(table, keys, build_key_types, valid)
             for i in node.left_keys:  # NULL keys never match (SQL equi-join semantics)
                 if nulls[i] is not None:
                     matched = matched & ~nulls[i]
@@ -618,7 +645,7 @@ class LocalExecutor:
         return _Stream(node.schema, dicts, probe_stream.pages, transform)
 
     def _compile_multi_join(self, node: P.Join, build_page, build_dicts, probe_stream,
-                            build_key_types) -> _Stream:
+                            build_key_types, span=None) -> _Stream:
         """Join with duplicate build keys and/or a residual match filter.
 
         Reference: position-linked JoinHash chains (operator/join/JoinHash.java:145) with
@@ -632,8 +659,13 @@ class LocalExecutor:
             cols = tuple(jnp.zeros((1,), f.type.dtype) for f in node.right.schema.fields)
             build_page = Page(node.right.schema, cols, tuple(None for _ in cols),
                               jnp.zeros((1,), bool))
-        capacity = max(1 << max(build_page.capacity - 1, 1).bit_length(), 16) * 2
-        mt = multi_build(capacity, build_page, node.right_keys, build_key_types)
+        mt = None
+        if span is not None:
+            mt = jax.jit(direct_multi_build, static_argnums=(0, 1, 3))(
+                span[0], span[1], build_page, node.right_keys[0])
+        if mt is None:
+            capacity = max(1 << max(build_page.capacity - 1, 1).bit_length(), 16) * 2
+            mt = multi_build(capacity, build_page, node.right_keys, build_key_types)
 
         @jax.jit
         def count_step(page, mt, up=probe_stream, node=node):
@@ -644,7 +676,10 @@ class LocalExecutor:
             for i in node.left_keys:
                 if nulls[i] is not None:
                     kvalid = kvalid & ~nulls[i]
-            slot, matched = probe_slots(mt.table, keys, build_key_types, kvalid)
+            if isinstance(mt, DirectMultiJoinTable):
+                slot, matched = direct_probe_slots(mt, keys[0], kvalid)
+            else:
+                slot, matched = probe_slots(mt.table, keys, build_key_types, kvalid)
             matched = matched & kvalid
             cnt = jnp.where(matched, mt.counts[slot], 0)
             if node.kind == "left":
@@ -721,7 +756,29 @@ class LocalExecutor:
         stream = self._compile_stream(node)
         return _concat_stream(stream), stream.dicts
 
-    def _build_join_table(self, build_page: Page, key_channels, key_types):
+    def _direct_join_span(self, build_page: Page, key_channels, key_types):
+        """(lo, span) when the build keys form a single dense integer range small
+        enough for direct addressing, else None.  Bounds come from the build page
+        itself (exact, no stats needed) — one batched host sync."""
+        if len(key_channels) != 1 or key_types[0].is_floating \
+                or build_page.capacity == 0:
+            return None
+        ch = key_channels[0]
+        valid = build_page.valid_mask()
+        nm = build_page.null_masks[ch]
+        if nm is not None:
+            valid = valid & ~nm
+        k64 = build_page.columns[ch].astype(jnp.int64)
+        imax, imin = jnp.iinfo(jnp.int64).max, jnp.iinfo(jnp.int64).min
+        got = _host([jnp.min(jnp.where(valid, k64, imax)),
+                     jnp.max(jnp.where(valid, k64, imin)),
+                     jnp.sum(valid, dtype=jnp.int64)])
+        kmin, kmax, nlive = (int(x) for x in got)
+        if nlive == 0 or kmax - kmin + 1 > DIRECT_JOIN_RANGE_MAX:
+            return None
+        return kmin, kmax - kmin + 1
+
+    def _build_join_table(self, build_page: Page, key_channels, key_types, span=None):
         n = build_page.capacity
         capacity = max(1 << max(n - 1, 1).bit_length(), 16) * 2
         keys = tuple(build_page.columns[i] for i in key_channels)
@@ -731,6 +788,12 @@ class LocalExecutor:
             nm = build_page.null_masks[ch]
             if nm is not None:
                 valid = valid & ~nm
+        if span is not None:
+            dt = jax.jit(direct_build, static_argnums=(0, 1, 3))(
+                span[0], span[1], build_page, key_channels[0])
+            if int(dt.dup_count) > 0:
+                return None  # caller falls back to the multi-match strategy
+            return dt
         while True:
             table = build_table_init(capacity, build_page)
             table = jax.jit(build_insert, static_argnums=(2,))(table, keys, key_types, valid)
@@ -993,13 +1056,14 @@ def _host(arrays):
 
 
 def _host_page(page: Page):
-    """(valid, cols, nulls) as numpy, fetched in one batched transfer.  A page with
+    """(valid, cols, nulls) as numpy, fetched in ONE batched transfer.  A page with
     no validity mask gets a host-side ones() — no device fetch fabricated for it."""
     nc = len(page.columns)
-    got = _host(list(page.columns) + list(page.null_masks))
-    valid = (np.ones((page.capacity,), bool) if page.valid is None
-             else _host([page.valid])[0])
-    return valid, got[:nc], got[nc:]
+    has_valid = page.valid is not None
+    got = _host(list(page.columns) + list(page.null_masks)
+                + ([page.valid] if has_valid else []))
+    valid = got[-1] if has_valid else np.ones((page.capacity,), bool)
+    return valid, got[:nc], got[nc:nc + len(page.null_masks)]
 
 
 def _sort_page(page: Page, keys, dicts=None) -> Page:

@@ -44,7 +44,7 @@ MAX_PROBES = 64
 # Direct-index mode bounds (reference: BigintGroupByHash fast path when the single
 # key is a small bigint, operator/GroupByHash.java:90-99 — generalized here to any
 # key set whose packed width is statically small).
-DIRECT_BITS_MAX = 20  # <= 1M slots: slot = packed key, no probing at all
+DIRECT_BITS_MAX = 24  # <= 16M slots: slot = packed key, no probing at all
 ONEHOT_CAP_MAX = 128  # <= 128 slots: masked-reduce aggregation, no scatter at all
 
 
@@ -235,8 +235,14 @@ def _probe_insert(table, packed, valid):
     slot = jnp.full((n,), C, jnp.int32)  # default: overflow sink
     placed = ~valid  # invalid rows are trivially "done" (routed to sink)
 
-    def body(p, carry):
-        table, slot, placed = carry
+    def cond(carry):
+        p, table, slot, placed = carry
+        # early exit once every row is placed: typical inserts finish in 1-3
+        # rounds, far below the MAX_PROBES worst case
+        return (p < MAX_PROBES) & ~jnp.all(placed)
+
+    def body(carry):
+        p, table, slot, placed = carry
         idx = (jnp.abs(h0 + p) % C).astype(jnp.int32)
         idx = jnp.where(placed, C, idx)
         cur = table[idx]
@@ -252,9 +258,10 @@ def _probe_insert(table, packed, valid):
         won = (cur2 == packed) & ~placed
         slot = jnp.where(won, idx, slot)
         placed = placed | won
-        return table, slot, placed
+        return p + 1, table, slot, placed
 
-    table, slot, placed = jax.lax.fori_loop(0, MAX_PROBES, body, (table, slot, placed))
+    _, table, slot, placed = jax.lax.while_loop(
+        cond, body, (jnp.zeros((), jnp.int32), table, slot, placed))
     return table, slot, placed
 
 
@@ -282,7 +289,11 @@ def groupby_insert(state: GroupByState, key_vals: Sequence, key_types, valid,
         packed, exact = pack_keys((mv,), (kt,))
         if kn is not None:
             # EMPTY_KEY is the free-slot marker (its remap target is EMPTY_KEY-1);
-            # EMPTY_KEY-2 is the NULL group's reserved word
+            # EMPTY_KEY-2 is the NULL group's reserved word.  A real key equal to
+            # the sentinel joins the existing EMPTY_KEY-1 remap pool instead of
+            # being merged with the NULL group (same accepted int64-max-adjacent
+            # collision class as pack_keys' EMPTY_KEY remap).
+            packed = jnp.where(packed == EMPTY_KEY - 2, EMPTY_KEY - 1, packed)
             packed = jnp.where(kn, EMPTY_KEY - 2, packed)
     else:
         pack_cols, pack_types = [], []

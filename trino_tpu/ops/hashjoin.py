@@ -28,7 +28,9 @@ import jax.numpy as jnp
 from .hashing import EMPTY_KEY, pack_keys, splitmix64
 
 __all__ = ["JoinTable", "build_table_init", "build_insert", "probe", "MAX_PROBES",
-           "MultiJoinTable", "multi_build", "probe_slots", "expand_counts"]
+           "MultiJoinTable", "multi_build", "probe_slots", "expand_counts",
+           "DirectJoinTable", "direct_build", "direct_probe", "DirectMultiJoinTable",
+           "direct_multi_build", "direct_probe_slots", "DIRECT_JOIN_RANGE_MAX"]
 
 MAX_PROBES = 64
 
@@ -110,18 +112,140 @@ def probe(jt: JoinTable, key_cols, key_types, valid):
     matched = jnp.zeros((n,), bool)
     done = ~valid
 
-    def body(p, carry):
-        row_ids, matched, done = carry
+    def cond(carry):
+        p, row_ids, matched, done = carry
+        return (p < MAX_PROBES) & ~jnp.all(done)
+
+    def body(carry):
+        p, row_ids, matched, done = carry
         idx = (jnp.abs(h0 + p) % C).astype(jnp.int32)
         cur = jt.table[idx]
         hit = (cur == packed) & ~done
         row_ids = jnp.where(hit, jt.rows[idx], row_ids)
         matched = matched | hit
         done = done | hit | (cur == EMPTY_KEY)
-        return row_ids, matched, done
+        return p + 1, row_ids, matched, done
 
-    row_ids, matched, done = jax.lax.fori_loop(0, MAX_PROBES, body, (row_ids, matched, done))
+    _, row_ids, matched, done = jax.lax.while_loop(
+        cond, body, (jnp.zeros((), jnp.int32), row_ids, matched, done))
     return row_ids, matched
+
+
+# ---------------------------------------------------------------------------- direct index
+# Dense single-key joins (TPC-H joins are mostly PK-FK on dense integer keys):
+# slot = key - lo, no hashing, no probe rounds — build is one scatter, probe is one
+# gather.  The analog of the reference's array-based lookup when join keys are
+# small integers (BigintGroupByHash / direct PagesHash addressing ideas applied to
+# joins; reference hashes always, we exploit the static key range instead).
+
+DIRECT_JOIN_RANGE_MAX = 1 << 26  # <= 64M slots (256MB of int32 rows)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DirectJoinTable:
+    """Unique-key direct-address join table: rows[key - lo] = build row id."""
+
+    rows: jnp.ndarray  # [R+1] int32 build row per slot (min-claim)
+    occ: jnp.ndarray  # [R+1] bool
+    build_columns: tuple
+    build_null_masks: tuple
+    dup_count: jnp.ndarray  # int32 scalar
+    lo: int  # static
+
+    def tree_flatten(self):
+        return ((self.rows, self.occ, self.build_columns, self.build_null_masks,
+                 self.dup_count), self.lo)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, lo=aux)
+
+
+def direct_build(lo: int, span: int, build_page, key_channel: int) -> DirectJoinTable:
+    """span = hi - lo + 1 slots; rows outside [lo, hi] cannot exist (lo/hi measured
+    from the build page itself)."""
+    key = build_page.columns[key_channel]
+    valid = build_page.valid_mask()
+    nm = build_page.null_masks[key_channel]
+    if nm is not None:
+        valid = valid & ~nm
+    slot = (key.astype(jnp.int64) - lo).astype(jnp.int32)
+    live = valid & (slot >= 0) & (slot < span)
+    idx = jnp.where(live, slot, span)
+    n = key.shape[0]
+    row_idx = jnp.arange(n, dtype=jnp.int32)
+    rows = jnp.full((span + 1,), 2**31 - 1, jnp.int32).at[idx].min(
+        jnp.where(live, row_idx, jnp.int32(2**31 - 1)))
+    occ = jnp.zeros((span + 1,), bool).at[idx].max(live)
+    occ = occ.at[span].set(False)
+    dup = jnp.sum(live, dtype=jnp.int32) - jnp.sum(occ[:span], dtype=jnp.int32)
+    return DirectJoinTable(rows, occ, build_page.columns, build_page.null_masks,
+                           dup, lo)
+
+
+def direct_probe(dt: DirectJoinTable, key_col, valid):
+    """(build_row_ids, matched) — one gather, no rounds."""
+    span = dt.occ.shape[0] - 1
+    slot = (key_col.astype(jnp.int64) - dt.lo).astype(jnp.int32)
+    inr = (slot >= 0) & (slot < span)
+    cslot = jnp.clip(slot, 0, span - 1)
+    matched = valid & inr & dt.occ[cslot]
+    row_ids = jnp.where(matched, dt.rows[cslot], 0)
+    return row_ids, matched
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DirectMultiJoinTable:
+    """Duplicate-capable direct-address join layout: slot = key - lo,
+    counts/starts/order exactly as MultiJoinTable (searchsorted expansion reuses
+    the same machinery)."""
+
+    counts: jnp.ndarray  # [span+1] int32 (sink = 0)
+    starts: jnp.ndarray  # [span+1] int32 exclusive prefix sum
+    order: jnp.ndarray  # [n_rows] int32 build rows grouped by slot
+    build_columns: tuple
+    build_null_masks: tuple
+    lo: int  # static
+
+    def tree_flatten(self):
+        return ((self.counts, self.starts, self.order, self.build_columns,
+                 self.build_null_masks), self.lo)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, lo=aux)
+
+
+def direct_multi_build(lo: int, span: int, build_page,
+                       key_channel: int) -> DirectMultiJoinTable:
+    key = build_page.columns[key_channel]
+    valid = build_page.valid_mask()
+    nm = build_page.null_masks[key_channel]
+    if nm is not None:
+        valid = valid & ~nm
+    slot = (key.astype(jnp.int64) - lo).astype(jnp.int32)
+    live = valid & (slot >= 0) & (slot < span)
+    slot_v = jnp.where(live, slot, span)
+    counts = jnp.zeros((span + 1,), jnp.int32).at[slot_v].add(
+        jnp.where(live, jnp.int32(1), jnp.int32(0)))
+    counts = counts.at[span].set(0)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)[:-1]])
+    order = jnp.argsort(slot_v, stable=True).astype(jnp.int32)
+    return DirectMultiJoinTable(counts, starts, order, build_page.columns,
+                                build_page.null_masks, lo)
+
+
+def direct_probe_slots(dt: DirectMultiJoinTable, key_col, valid):
+    """(slot, matched) compatible with the MultiJoinTable expansion path."""
+    span = dt.counts.shape[0] - 1
+    slot = (key_col.astype(jnp.int64) - dt.lo).astype(jnp.int32)
+    inr = (slot >= 0) & (slot < span)
+    cslot = jnp.clip(slot, 0, span - 1)
+    matched = valid & inr & (dt.counts[cslot] > 0)
+    return jnp.where(matched, cslot, 0), matched
 
 
 # ---------------------------------------------------------------------------- multi-match
@@ -213,17 +337,22 @@ def probe_slots(table, key_cols, key_types, valid):
     matched = jnp.zeros((n,), bool)
     done = ~valid
 
-    def body(p, carry):
-        slot, matched, done = carry
+    def cond(carry):
+        p, slot, matched, done = carry
+        return (p < MAX_PROBES) & ~jnp.all(done)
+
+    def body(carry):
+        p, slot, matched, done = carry
         idx = (jnp.abs(h0 + p) % C).astype(jnp.int32)
         cur = table[idx]
         hit = (cur == packed) & ~done
         slot = jnp.where(hit, idx, slot)
         matched = matched | hit
         done = done | hit | (cur == EMPTY_KEY)
-        return slot, matched, done
+        return p + 1, slot, matched, done
 
-    slot, matched, done = jax.lax.fori_loop(0, MAX_PROBES, body, (slot, matched, done))
+    _, slot, matched, done = jax.lax.while_loop(
+        cond, body, (jnp.zeros((), jnp.int32), slot, matched, done))
     return slot, matched
 
 
