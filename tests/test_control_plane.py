@@ -1,0 +1,200 @@
+"""Query lifecycle, resource groups, session properties, events, tracing, and the
+system connector.
+
+Reference test models: TestQueryStateMachine, TestInternalResourceGroup,
+TestSystemSessionProperties, connector/system tests.
+"""
+
+import threading
+import time
+
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.execution.eventlistener import EventListener
+from trino_tpu.execution.query_state import QueryState, QueryStateMachine
+from trino_tpu.execution.resourcegroups import (QueryQueueFullError, ResourceGroup,
+                                                ResourceGroupManager)
+from trino_tpu.execution.statemachine import StateMachine
+
+
+def _engine():
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.001, split_rows=1 << 12))
+    return e
+
+
+def test_state_machine_listeners_and_terminal():
+    sm = StateMachine("t", "A", terminal_states=["C"])
+    seen = []
+    sm.add_state_change_listener(seen.append)
+    assert seen == ["A"]  # fires with current state on registration
+    assert sm.set("B")
+    assert sm.compare_and_set("B", "C")
+    assert not sm.set("A")  # terminal
+    assert seen == ["A", "B", "C"]
+    assert sm.is_terminal
+
+
+def test_query_state_machine_flow():
+    q = QueryStateMachine("q1", "select 1")
+    for s in (QueryState.DISPATCHING, QueryState.PLANNING, QueryState.RUNNING,
+              QueryState.FINISHING, QueryState.FINISHED):
+        assert q.transition(s)
+    assert q.is_done and q.state == QueryState.FINISHED
+    assert q.info().wall_s is not None
+    q2 = QueryStateMachine("q2", "select 1")
+    q2.fail("boom")
+    assert q2.state == QueryState.FAILED and q2.error == "boom"
+
+
+def test_engine_tracks_queries_and_fires_events():
+    e = _engine()
+    s = e.create_session("tpch")
+    events = []
+
+    class L(EventListener):
+        def query_created(self, ev):
+            events.append(("created", ev.query_id))
+
+        def query_completed(self, ev):
+            events.append(("completed", ev.query_id, ev.state, ev.rows))
+
+    e.event_listeners.add(L())
+    r = e.execute_sql("select count(*) from nation", s)
+    assert r.rows()[0][0] == 25
+    infos = [q.info() for q in e.query_tracker.all_queries()]
+    assert any(i.state == "FINISHED" and i.rows == 1 for i in infos)
+    kinds = [ev[0] for ev in events]
+    assert kinds == ["created", "completed"]
+    assert events[1][2] == "FINISHED" and events[1][3] == 1
+    # failures are tracked too
+    with pytest.raises(Exception):
+        e.execute_sql("select no_such_column from nation", s)
+    infos = [q.info() for q in e.query_tracker.all_queries()]
+    assert any(i.state == "FAILED" and i.error for i in infos)
+    assert events[-1][2] == "FAILED"
+
+
+def test_resource_group_queueing_and_fairness():
+    mgr = ResourceGroupManager(ResourceGroup("global", hard_concurrency_limit=1))
+    g = mgr.get_or_create("global.user")
+    order = []
+    started = [threading.Event() for _ in range(3)]
+
+    def mk(i):
+        def start():
+            order.append(i)
+            started[i].set()
+        return start
+
+    mgr.submit(g, mk(0))
+    mgr.submit(g, mk(1))  # queued (limit 1)
+    mgr.submit(g, mk(2))  # queued
+    assert order == [0]
+    mgr.finish(g)  # releases slot -> starts 1
+    assert order == [0, 1]
+    mgr.finish(g)
+    assert order == [0, 1, 2]
+    mgr.finish(g)
+    info = {i["name"]: i for i in mgr.info()}
+    assert info["global.user"]["running"] == 0 and info["global.user"]["queued"] == 0
+
+
+def test_resource_group_queue_full():
+    mgr = ResourceGroupManager(ResourceGroup("global", hard_concurrency_limit=1))
+    g = mgr.get_or_create("global.u")
+    g.max_queued = 1
+    mgr.submit(g, lambda: None)
+    mgr.submit(g, lambda: None)
+    with pytest.raises(QueryQueueFullError):
+        mgr.submit(g, lambda: None)
+
+
+def test_engine_concurrent_queries_respect_admission():
+    e = _engine()
+    e.resource_groups.root.hard_concurrency_limit = 2
+    s = e.create_session("tpch")
+    e.execute_sql("select count(*) from region", s)  # compile once
+    results = []
+
+    def run():
+        r = e.execute_sql("select count(*) from region", s)
+        results.append(r.rows()[0][0])
+
+    ts = [threading.Thread(target=run) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert results == [5, 5, 5, 5]
+
+
+def test_session_properties_sql():
+    e = _engine()
+    s = e.create_session("tpch")
+    e.execute_sql("set session task_concurrency = 4", s)
+    assert e.session_properties.get(s, "task_concurrency") == 4
+    e.execute_sql("set session join_distribution_type = 'BROADCAST'", s)
+    assert e.session_properties.get(s, "join_distribution_type") == "BROADCAST"
+    rows = e.execute_sql("show session", s).rows()
+    d = {r[0]: r[1] for r in rows}
+    assert d["task_concurrency"] == "4"
+    e.execute_sql("reset session task_concurrency", s)
+    assert e.session_properties.get(s, "task_concurrency") == 8
+    with pytest.raises(ValueError):
+        e.execute_sql("set session no_such_prop = 1", s)
+    with pytest.raises(ValueError):
+        e.execute_sql("set session task_concurrency = 'abc'", s)
+
+
+def test_show_statements():
+    e = _engine()
+    s = e.create_session("tpch")
+    cats = [r[0] for r in e.execute_sql("show catalogs", s).rows()]
+    assert "tpch" in cats and "system" in cats
+    tabs = [r[0] for r in e.execute_sql("show tables", s).rows()]
+    assert "lineitem" in tabs
+    cols = e.execute_sql("show columns from nation", s).rows()
+    assert ("n_name", "varchar(25)") in [(c, t) for c, t in cols]
+    fns = [r[0] for r in e.execute_sql("show functions", s).rows()]
+    assert "sum" in fns and "substring" in fns
+
+
+def test_system_tables():
+    e = _engine()
+    s = e.create_session("tpch")
+    e.execute_sql("select count(*) from region", s)
+    rows = e.execute_sql(
+        "select state, count(*) c from system.queries group by state order by state",
+        s).rows()
+    states = {r[0] for r in rows}
+    assert "FINISHED" in states
+    cats = e.execute_sql("select catalog_name from system.catalogs order by 1", s).rows()
+    assert ("system",) in cats and ("tpch",) in cats
+    t = e.execute_sql(
+        "select table_name from system.tables where table_catalog = 'tpch' order by 1",
+        s).rows()
+    assert ("lineitem",) in t
+    rg = e.execute_sql("select name, running from system.resource_groups", s).rows()
+    assert any(r[0] == "global" for r in rg)
+    # re-execution sees NEW queries (dictionaries grow in place, plans stay valid)
+    n1 = e.execute_sql("select count(*) from system.queries", s).rows()[0][0]
+    e.execute_sql("select count(*) from nation", s)
+    n2 = e.execute_sql("select count(*) from system.queries", s).rows()[0][0]
+    assert n2 > n1
+
+
+def test_tracing_spans():
+    e = _engine()
+    s = e.create_session("tpch")
+    e.execute_sql("select count(*) from part", s)
+    qid = [q.query_id for q in e.query_tracker.all_queries()][-1]
+    spans = e.tracer.spans_for(qid)
+    names = {sp.name for sp in spans}
+    assert {"query", "planner", "execution"} <= names
+    q = next(sp for sp in spans if sp.name == "query")
+    pl = next(sp for sp in spans if sp.name == "planner")
+    assert pl.parent_id == q.span_id
+    assert q.duration_s is not None and q.status == "OK"

@@ -1,0 +1,187 @@
+"""System connector: engine runtime state as queryable tables.
+
+Reference: connector/system/ (GlobalSystemConnector) — system.runtime.queries,
+system.runtime.nodes, system.metadata.catalogs etc., backed live by coordinator
+state.  Flat table namespace here: `queries`, `nodes`, `catalogs`, `tables`,
+`resource_groups`.
+
+Pages are built fresh per scan (the stream cache re-invokes `generate`), padded
+to power-of-two buckets so row-count drift doesn't force an XLA recompile per
+query.  String columns keep ONE persistent Dictionary per column whose values
+array grows in place — plans captured at compile time keep decoding correctly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..page import Field, Page, Schema
+from ..types import BIGINT, DOUBLE, VarcharType
+from .tpch import Dictionary
+
+__all__ = ["SystemConnector"]
+
+_V = VarcharType.of(None)
+
+SCHEMAS = {
+    "queries": Schema((
+        Field("query_id", _V), Field("state", _V), Field("user", _V),
+        Field("catalog", _V), Field("resource_group", _V), Field("query", _V),
+        Field("rows", BIGINT), Field("queued_s", DOUBLE), Field("wall_s", DOUBLE),
+        Field("error", _V),
+    )),
+    "nodes": Schema((
+        Field("node_id", _V), Field("http_uri", _V), Field("node_version", _V),
+        Field("coordinator", BIGINT), Field("state", _V),
+    )),
+    "catalogs": Schema((
+        Field("catalog_name", _V), Field("connector_name", _V),
+    )),
+    "tables": Schema((
+        Field("table_catalog", _V), Field("table_name", _V), Field("table_rows", BIGINT),
+    )),
+    "resource_groups": Schema((
+        Field("name", _V), Field("running", BIGINT), Field("queued", BIGINT),
+        Field("hard_concurrency_limit", BIGINT), Field("max_queued", BIGINT),
+        Field("scheduling_weight", BIGINT),
+    )),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class SystemSplit:
+    table: str
+
+
+class _Growable:
+    """value<->id map exposing ONE Dictionary whose array grows in place."""
+
+    def __init__(self):
+        self.ids: dict = {}
+        self.values: list = []
+        self.dictionary = Dictionary(values=np.array([""], dtype=object))
+
+    def encode(self, vals):
+        out = np.empty(len(vals), np.int32)
+        grew = False
+        for i, v in enumerate(vals):
+            if v is None:
+                out[i] = 0
+                continue
+            v = str(v)
+            ix = self.ids.get(v)
+            if ix is None:
+                ix = len(self.values)
+                self.ids[v] = ix
+                self.values.append(v)
+                grew = True
+            out[i] = ix
+        if grew or len(self.dictionary.values) != max(len(self.values), 1):
+            self.dictionary.values = np.array(self.values or [""], dtype=object)
+        return out
+
+
+class SystemConnector:
+    name = "system"
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._dicts: dict = {}  # (table, column) -> _Growable
+
+    # -- metadata ----------------------------------------------------------------
+    def tables(self):
+        return sorted(SCHEMAS)
+
+    def schema(self, table: str) -> Schema:
+        return SCHEMAS[table]
+
+    def dictionaries(self, table: str) -> dict:
+        # encode the CURRENT rows first: string literals in predicates resolve to
+        # dictionary ids at plan time, so values must be present before planning
+        rows = self._rows(table)
+        schema = SCHEMAS[table]
+        out = {}
+        for ci, f in enumerate(schema.fields):
+            if f.type.is_string:
+                g = self._growable(table, f.name)
+                g.encode([r[ci] for r in rows])
+                out[f.name] = g.dictionary
+        return out
+
+    def _growable(self, table, column) -> _Growable:
+        g = self._dicts.get((table, column))
+        if g is None:
+            g = _Growable()
+            self._dicts[(table, column)] = g
+        return g
+
+    def row_count(self, table: str) -> int:
+        return len(self._rows(table))
+
+    def column_range(self, table: str, column: str):
+        return (None, None)
+
+    def splits(self, table: str, n_hint: int = 0):
+        return [SystemSplit(table)]
+
+    # -- data --------------------------------------------------------------------
+    def _rows(self, table: str) -> list[tuple]:
+        e = self.engine
+        if table == "queries":
+            out = []
+            for q in e.query_tracker.all_queries():
+                i = q.info()
+                out.append((i.query_id, i.state, i.user, i.catalog, i.resource_group,
+                            i.sql, i.rows, i.queued_s, i.wall_s, i.error))
+            return out
+        if table == "nodes":
+            import jax
+
+            return [(f"{d.platform}-{d.id}", "local://in-process", "trino-tpu-0.1",
+                     1 if d.id == 0 else 0, "active") for d in jax.devices()]
+        if table == "catalogs":
+            return [(name, getattr(c, "name", type(c).__name__))
+                    for name, c in sorted(e.catalogs.items())]
+        if table == "tables":
+            out = []
+            for cname, c in sorted(e.catalogs.items()):
+                for t in c.tables():
+                    try:
+                        n = c.row_count(t)
+                    except Exception:
+                        n = None
+                    out.append((cname, t, n))
+            return out
+        if table == "resource_groups":
+            return [(g["name"], g["running"], g["queued"], g["hard_concurrency_limit"],
+                     g["max_queued"], g["scheduling_weight"])
+                    for g in e.resource_groups.info()]
+        raise KeyError(table)
+
+    def generate(self, split: SystemSplit, columns=None) -> Page:
+        schema = SCHEMAS[split.table]
+        names = columns if columns is not None else schema.names
+        rows = self._rows(split.table)
+        n = len(rows)
+        cap = max(1 << max(n - 1, 1).bit_length(), 16)  # pow2 bucket, min 16
+        out_schema = Schema(tuple(schema.field(c) for c in names))
+        cols, nulls = [], []
+        for cname in names:
+            ci = schema.index(cname)
+            f = schema.fields[ci]
+            vals = [r[ci] for r in rows]
+            nullmask = np.array([v is None for v in vals] + [True] * (cap - n))
+            if f.type.is_string:
+                ids = self._growable(split.table, cname).encode(vals)
+                arr = np.zeros(cap, np.int32)
+                arr[:n] = ids
+            else:
+                arr = np.zeros(cap, np.asarray(jnp.zeros(0, f.type.dtype)).dtype)
+                arr[:n] = [0 if v is None else v for v in vals]
+            cols.append(jnp.asarray(arr))
+            nulls.append(jnp.asarray(nullmask) if nullmask.any() else None)
+        valid = jnp.asarray(np.arange(cap) < n)
+        return Page(out_schema, tuple(cols), tuple(nulls), valid)

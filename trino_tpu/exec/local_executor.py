@@ -253,11 +253,19 @@ class LocalExecutor:
                            lambda: iter([page]), lambda c, n, v: (c, n, v))
 
         if isinstance(node, (P.Aggregate, P.Sort, P.Limit, P.Output, P.Window)):
-            # blocking sub-plan feeding a streaming consumer: run it, emit its one page
+            # blocking sub-plan feeding a streaming consumer: run it, emit its one
+            # page.  The first execution (needed for dictionary metadata) is reused
+            # once; later executions re-run the child so volatile sources (system
+            # tables) and post-DML state stay fresh across cached-plan re-runs.
             page, dicts = self._execute_to_page(node)
+            cell = [page]
 
-            def pages(page=page):
-                yield page
+            def pages(cell=cell, self=self, node=node):
+                if cell:
+                    yield cell.pop()
+                else:
+                    pg, _ = self._execute_to_page(node)
+                    yield pg
 
             return _Stream(node.schema, dicts, pages, lambda c, n, v: (c, n, v))
 

@@ -1,0 +1,83 @@
+"""Lightweight tracing spans (OpenTelemetry-shaped, dependency-free).
+
+Reference: the coordinator opens spans per query phase — dispatch
+(dispatcher/DispatchManager.java:190), planning/execution
+(execution/SqlQueryExecution.java:478-481) — via airlift's TracingModule
+(server/Server.java:113) and ScopedSpan/TrinoAttributes (tracing/).  Here spans
+record to an in-memory tracer; an OTLP exporter can consume `Tracer.finished`
+without engine changes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+__all__ = ["Span", "Tracer", "NOOP_TRACER"]
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: int
+    parent_id: Optional[int]
+    start_s: float
+    end_s: Optional[float] = None
+    attributes: dict = dataclasses.field(default_factory=dict)
+    status: str = "OK"
+
+    @property
+    def duration_s(self) -> Optional[float]:
+        return None if self.end_s is None else self.end_s - self.start_s
+
+
+class Tracer:
+    def __init__(self, max_finished: int = 10_000):
+        self._lock = threading.Lock()
+        self._next_id = 1
+        self.max_finished = max_finished
+        self.finished: list[Span] = []
+        self._local = threading.local()
+
+    def _current(self) -> Optional[Span]:
+        return getattr(self._local, "span", None)
+
+    @contextlib.contextmanager
+    def span(self, name: str, trace_id: str = "", **attributes):
+        parent = self._current()
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        s = Span(name=name, trace_id=trace_id or (parent.trace_id if parent else ""),
+                 span_id=sid, parent_id=parent.span_id if parent else None,
+                 start_s=time.time(), attributes=dict(attributes))
+        self._local.span = s
+        try:
+            yield s
+        except BaseException as e:
+            s.status = f"ERROR: {type(e).__name__}"
+            raise
+        finally:
+            s.end_s = time.time()
+            self._local.span = parent
+            with self._lock:
+                self.finished.append(s)
+                if len(self.finished) > self.max_finished:
+                    del self.finished[:len(self.finished) - self.max_finished]
+
+    def spans_for(self, trace_id: str) -> list[Span]:
+        with self._lock:
+            return [s for s in self.finished if s.trace_id == trace_id]
+
+
+class _NoopTracer(Tracer):
+    @contextlib.contextmanager
+    def span(self, name: str, trace_id: str = "", **attributes):
+        yield Span(name, trace_id, 0, None, time.time())
+
+
+NOOP_TRACER = _NoopTracer()

@@ -262,6 +262,23 @@ class DropView(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class SetSession(Node):
+    name: str
+    value: object  # literal node
+
+
+@dataclasses.dataclass(frozen=True)
+class ResetSession(Node):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Show(Node):
+    what: str  # 'session' | 'catalogs' | 'tables' | 'columns' | 'functions'
+    target: str = ""  # table name for SHOW COLUMNS
+
+
+@dataclasses.dataclass(frozen=True)
 class Explain(Node):
     query: Node
     analyze: bool = False
@@ -381,6 +398,12 @@ class Parser:
         return q
 
     def _parse_statement_body(self) -> Node:
+        # SET/RESET/SHOW match contextually by identifier value (reference grammar:
+        # SqlBase.g4 setSession/showSession etc.) so these words stay usable as
+        # ordinary identifiers elsewhere
+        t = self.peek()
+        if t.kind == "ident" and t.value in ("set", "reset", "show"):
+            return self._parse_session_statement()
         if self.accept("explain"):
             analyze = bool(self.accept("analyze"))
             return Explain(self._parse_statement_body(), analyze)
@@ -440,6 +463,49 @@ class Parser:
             name = self.expect_kind("ident").value
             return (DropView(name, ie) if is_view else DropTable(name, ie))
         return self.parse_subquery()
+
+    def _parse_session_statement(self) -> Node:
+        kw = self.next().value
+        if kw == "set":
+            self._expect_ident("session")
+            name = self.expect_kind("ident").value
+            self.expect("=")
+            val = self.parse_expr()
+            if isinstance(val, NumberLit):
+                v = float(val.text) if ("." in val.text or "e" in val.text.lower()) \
+                    else int(val.text)
+            elif isinstance(val, StringLit):
+                v = val.value
+            elif isinstance(val, BoolLit):
+                v = val.value
+            elif isinstance(val, Identifier):
+                v = val.parts[-1]  # bare words like AUTOMATIC
+            else:
+                raise ParseError("SET SESSION value must be a literal")
+            return SetSession(name, v)
+        if kw == "reset":
+            self._expect_ident("session")
+            return ResetSession(self.expect_kind("ident").value)
+        # SHOW ...
+        t = self.next()
+        what = t.value
+        if what == "session":
+            return Show("session")
+        if what == "catalogs":
+            return Show("catalogs")
+        if what == "tables":
+            return Show("tables")
+        if what == "functions":
+            return Show("functions")
+        if what == "columns":
+            self.expect("from")
+            return Show("columns", self.expect_kind("ident").value)
+        raise ParseError(f"unsupported SHOW {what!r}")
+
+    def _expect_ident(self, value: str) -> None:
+        t = self.next()
+        if not (t.kind == "ident" and t.value == value):
+            raise ParseError(f"expected {value!r} at pos {t.pos}, got {t.value!r}")
 
     def _column_alias_list(self) -> tuple:
         if not (self.peek().kind == "op" and self.peek().value == "("
