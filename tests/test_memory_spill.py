@@ -1,0 +1,89 @@
+"""Memory accounting and Grace-partitioned (spill-analog) fallbacks.
+
+Reference test models: TestMemoryPools, the spilling join/aggregation tests
+(io.trino.operator join/spilling, SpillableHashAggregationBuilder tests).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.exec.local_executor import LocalExecutor
+from trino_tpu.memory import (AggregatedMemoryContext, MemoryPool,
+                              MemoryPoolExhaustedError)
+from trino_tpu.sql.frontend import compile_sql
+
+
+def test_memory_pool_reserve_free():
+    pool = MemoryPool(max_bytes=1000)
+    assert pool.try_reserve(600, "a")
+    assert not pool.try_reserve(600, "b")
+    pool.free(600, "a")
+    assert pool.try_reserve(600, "b")
+    with pytest.raises(MemoryPoolExhaustedError):
+        pool.reserve(600, "c")
+    info = pool.info()
+    assert info["reserved"] == 600 and info["by_tag"]["b"] == 600
+
+
+def test_memory_contexts_hierarchy():
+    pool = MemoryPool(max_bytes=1000)
+    root = AggregatedMemoryContext(pool=pool, tag="query")
+    op1 = root.new_child("op1").new_local()
+    op2 = root.new_child("op2").new_local()
+    op1.set_bytes(300)
+    op2.set_bytes(400)
+    assert root.bytes == 700 and pool.reserved == 700
+    assert not op2.try_set_bytes(800)  # would exceed the pool
+    assert op2.bytes == 400
+    op1.close()
+    assert root.bytes == 400 and pool.reserved == 400
+
+
+def _q(sql, pool_bytes=None):
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.01, split_rows=1 << 12))
+    s = e.create_session("tpch")
+    plan = compile_sql(sql, e, s)
+    pool = None if pool_bytes is None else MemoryPool(max_bytes=pool_bytes)
+    ex = LocalExecutor(e.catalogs, memory_pool=pool)
+    res = ex.execute(plan)
+    return res.rows(), ex
+
+
+def test_tiny_pool_join_matches_unlimited():
+    sql = """select o_orderpriority, count(*) c from orders, lineitem
+             where o_orderkey = l_orderkey and l_quantity < 2500
+             group by o_orderpriority order by o_orderpriority"""
+    full, _ = _q(sql)
+    small, ex = _q(sql, pool_bytes=200_000)  # forces partitioned join + agg
+    assert small == full
+
+
+def test_tiny_pool_left_join_matches_unlimited():
+    sql = """select count(*), count(o_orderkey) from orders
+             left join customer on o_custkey = c_custkey and c_acctbal > 5000"""
+    # left join keeps unmatched probe rows once across partitions
+    full, _ = _q(sql)
+    small, _ = _q(sql, pool_bytes=150_000)
+    assert small == full
+
+
+def test_tiny_pool_semi_join_matches_unlimited():
+    sql = """select count(*) from lineitem
+             where l_orderkey in (select o_orderkey from orders
+                                  where o_totalprice > 20000000)"""
+    full, _ = _q(sql)
+    small, _ = _q(sql, pool_bytes=150_000)
+    assert small == full
+
+
+def test_group_by_spills_to_partitioned():
+    # many groups + a pool too small for the hash table: partitioned passes
+    sql = """select l_orderkey, count(*) c from lineitem
+             group by l_orderkey order by c desc, l_orderkey limit 5"""
+    full, _ = _q(sql)
+    small, _ = _q(sql, pool_bytes=100_000)
+    assert small == full

@@ -104,11 +104,17 @@ class LocalExecutor:
     true for generator connectors; mutating connectors must invalidate the engine's plan
     cache."""
 
-    def __init__(self, catalogs: dict):
+    def __init__(self, catalogs: dict, memory_pool=None):
+        from ..memory import MemoryPool
+
         self.catalogs = catalogs
         self._stream_cache: dict = {}  # id(node) -> (node, _Stream)
         self._agg_cache: dict = {}  # id(node) -> compiled aggregation artifacts
         self.stats: dict = {}  # id(node) -> {"rows": int, "wall_s": float}
+        # HBM accounting: operators reserve before allocating device state and
+        # switch to partitioned (Grace) strategies when the pool says no
+        # (reference: MemoryPool + MemoryRevokingScheduler -> spill)
+        self.memory_pool = memory_pool if memory_pool is not None else MemoryPool()
 
     # ------------------------------------------------------------------ public
     def execute(self, node: P.PlanNode) -> MaterializedResult:
@@ -402,36 +408,63 @@ class LocalExecutor:
                                    min(target, MAX_GROUP_CAPACITY))
         pages_once = itertools.chain([first], page_iter) if first is not None else ()
 
-        while True:
-            if cfg is not None:
-                state = hashagg.direct_groupby_init(
-                    cfg, tuple(t.dtype for t in key_types), acc_specs)
-                dstep = self._direct_step(node, cfg, stream, key_types, acc_exprs,
-                                          acc_kinds)
+        # memory gate: group-by state is device-resident; if it cannot fit the
+        # pool, go to partitioned passes (the HBM spill analog).  Reservation is
+        # re-checked on every capacity growth.
+        key_w = sum(np.dtype(t.dtype).itemsize + 1 for t in key_types)
+        acc_w = sum(np.dtype(dt).itemsize for dt, _ in acc_specs)
+        state_bytes = lambda cap: (cap + 1) * (8 + key_w + acc_w)
+        if cfg is not None and not self.memory_pool.try_reserve(
+                state_bytes(cfg.capacity), "group-by"):
+            cfg = None  # direct table too large: try the (smaller) hash table
+        reserved = 0 if cfg is None else state_bytes(cfg.capacity)
+        if cfg is None:
+            if not self.memory_pool.try_reserve(state_bytes(capacity), "group-by"):
+                return self._run_aggregate_partitioned(node, parts=4)
+            reserved = state_bytes(capacity)
+
+        try:
+            while True:
+                if cfg is not None:
+                    state = hashagg.direct_groupby_init(
+                        cfg, tuple(t.dtype for t in key_types), acc_specs)
+                    dstep = self._direct_step(node, cfg, stream, key_types, acc_exprs,
+                                              acc_kinds)
+                    for page in pages_once:
+                        state = dstep(state, page)
+                    if not bool(state.overflow):
+                        break
+                    # stale stats put keys out of range: hash mode
+                    self.memory_pool.free(reserved, "group-by")
+                    cfg, reserved = None, 0
+                    if not self.memory_pool.try_reserve(state_bytes(capacity),
+                                                        "group-by"):
+                        return self._run_aggregate_partitioned(node, parts=4)
+                    reserved = state_bytes(capacity)
+                    pages_once = stream.pages()
+                    continue
+                state = hashagg.groupby_init(
+                    capacity, tuple(t.dtype for t in key_types), acc_specs
+                )
                 for page in pages_once:
-                    state = dstep(state, page)
+                    state = step(state, page)
                 if not bool(state.overflow):
                     break
-                cfg = None  # stale stats put keys out of range: hash mode
+                grown = capacity * 4
+                if capacity >= MAX_GROUP_CAPACITY or not self.memory_pool.try_reserve(
+                        state_bytes(grown) - state_bytes(capacity), "group-by"):
+                    # group count exceeds the device-memory/capacity ceiling: fall
+                    # back to partitioned passes (the HBM analog of the reference's
+                    # SpillableHashAggregationBuilder — re-stream per key partition
+                    # instead of spilling state to disk)
+                    return self._run_aggregate_partitioned(node, parts=4)
+                reserved += state_bytes(grown) - state_bytes(capacity)
+                capacity = grown  # next capacity bucket (reference: FlatHash#rehash)
                 pages_once = stream.pages()
-                continue
-            state = hashagg.groupby_init(
-                capacity, tuple(t.dtype for t in key_types), acc_specs
-            )
-            for page in pages_once:
-                state = step(state, page)
-            if not bool(state.overflow):
-                break
-            if capacity >= MAX_GROUP_CAPACITY:
-                # group count exceeds the device-memory capacity ceiling: fall back to
-                # partitioned passes (the HBM analog of the reference's
-                # SpillableHashAggregationBuilder — re-stream per key partition
-                # instead of spilling state to disk)
-                return self._run_aggregate_partitioned(node, parts=4)
-            capacity *= 4  # next capacity bucket (reference: FlatHash#rehash)
-            pages_once = stream.pages()
 
-        return self._finalize_groups(node, stream, state)
+            return self._finalize_groups(node, stream, state)
+        finally:
+            self.memory_pool.free(reserved, "group-by")
 
     def _finalize_groups(self, node: P.Aggregate, stream, state):
         # compact occupied groups ON DEVICE before any host transfer: the table is
@@ -602,8 +635,6 @@ class LocalExecutor:
         build_page, build_dicts = self._execute_to_page_streamed(node.right)
         probe_stream = self._compile_stream(node.left)
         build_key_types = tuple(node.right.schema.fields[i].type for i in node.right_keys)
-        semi = node.kind in ("semi", "anti")
-        build_has_null, build_nonempty = _build_null_stats(build_page, node.right_keys)
         if node.kind in ("inner", "semi") and node.filter is None:
             # dynamic filtering: prune probe splits outside the build keys' min/max
             # domain (reference: DynamicFilterService.createDynamicFilter:260 narrowing
@@ -613,6 +644,31 @@ class LocalExecutor:
                 probe_stream = dataclasses.replace(probe_stream, pages=pruned,
                                                    _jitted=None)
 
+        # memory gate: build-side state (columns + table/order layout) is
+        # device-resident and pinned by the stream cache.  When it cannot fit the
+        # pool, switch to the Grace-partitioned strategy (the HBM analog of the
+        # reference's spilling join, operator/join/spilling/HashBuilderOperator.java)
+        need = _page_bytes(build_page) * 3
+        partitionable = (node.kind in ("inner", "left", "semi") and node.left_keys
+                         and node.filter is None)
+        if not self.memory_pool.try_reserve(need, "join-build"):
+            if partitionable:
+                parts, free = 2, max(self.memory_pool.free_bytes(), 1)
+                while need // parts > free // 2 and parts < 64:
+                    parts *= 2
+                return self._compile_partitioned_local_join(
+                    node, build_page, build_dicts, probe_stream, build_key_types,
+                    parts)
+            # non-partitionable join shapes proceed best-effort (the pool is
+            # advisory; XLA raises if HBM is truly exhausted)
+
+        return self._join_with_build(node, build_page, build_dicts, probe_stream,
+                                     build_key_types)
+
+    def _join_with_build(self, node: P.Join, build_page, build_dicts, probe_stream,
+                         build_key_types) -> _Stream:
+        semi = node.kind in ("semi", "anti")
+        build_has_null, build_nonempty = _build_null_stats(build_page, node.right_keys)
         span = self._direct_join_span(build_page, node.right_keys, build_key_types)
         table = None
         if node.filter is None and build_page.capacity > 0:
@@ -755,6 +811,63 @@ class LocalExecutor:
                     yield Page(node.schema, ocols, onulls, ovalid)
 
         dicts = (probe_stream.dicts if semi else probe_stream.dicts + build_dicts)
+        return _Stream(node.schema, dicts, pages, lambda c, n, v: (c, n, v))
+
+    def _compile_partitioned_local_join(self, node: P.Join, build_page, build_dicts,
+                                        probe_stream, build_key_types,
+                                        parts: int) -> _Stream:
+        """Grace-partitioned join: hash-partition BOTH sides on the join keys and
+        process one partition's build table at a time, re-streaming the probe per
+        partition (reference: the spilling join's partition-at-a-time consumption,
+        operator/join/spilling/PartitionedConsumption.java).  Each probe row
+        belongs to exactly one partition, so inner/left/semi semantics hold
+        part-locally; trades probe recompute for bounded build memory."""
+        from ..ops.exchange import partition_ids
+
+        bkeys = tuple(build_page.columns[i] for i in node.right_keys)
+        bknulls = tuple(build_page.null_masks[i] for i in node.right_keys)
+        routed = tuple(kv if kn is None else jnp.where(kn, jnp.zeros((), kv.dtype), kv)
+                       for kv, kn in zip(bkeys, bknulls))
+        bpid = partition_ids(routed, parts)
+        bvalid = build_page.valid_mask()
+        # one batched sync for every partition's build row count
+        counts = [int(c) for c in _host(
+            [jnp.sum(bvalid & (bpid == p), dtype=jnp.int32) for p in range(parts)])]
+
+        compact = jax.jit(_compact_part, static_argnums=3)
+
+        def build_part(p: int) -> Page:
+            n = counts[p]
+            bucket = max(1 << max(n - 1, 1).bit_length(), 16)
+            ccols, cnulls = compact(build_page.columns, build_page.null_masks,
+                                    bvalid & (bpid == p), bucket)
+            return Page(build_page.schema, ccols, cnulls,
+                        jnp.arange(bucket) < n)
+
+        def probe_part(p: int) -> _Stream:
+            def transform(cols, nulls, valid, up=probe_stream, node=node, p=p):
+                cols, nulls, valid = up.transform(cols, nulls, valid)
+                keys = tuple(cols[i] for i in node.left_keys)
+                knulls = tuple(nulls[i] for i in node.left_keys)
+                rt = tuple(kv if kn is None
+                           else jnp.where(kn, jnp.zeros((), kv.dtype), kv)
+                           for kv, kn in zip(keys, knulls))
+                return cols, nulls, valid & (partition_ids(rt, parts) == p)
+
+            return _Stream(probe_stream.schema, probe_stream.dicts,
+                           probe_stream.pages, transform)
+
+        def pages(self=self, node=node):
+            for p in range(parts):
+                sub = self._join_with_build(node, build_part(p), build_dicts,
+                                            probe_part(p), build_key_types)
+                jt = sub.jitted()
+                for page in sub.pages():
+                    cols, nulls, valid = jt(page)
+                    yield Page(node.schema, cols, nulls, valid)
+
+        semi = node.kind in ("semi", "anti")
+        dicts = probe_stream.dicts if semi else probe_stream.dicts + build_dicts
         return _Stream(node.schema, dicts, pages, lambda c, n, v: (c, n, v))
 
     def _execute_to_page_streamed(self, node):
@@ -1047,6 +1160,15 @@ def _values_page(node: P.Values) -> Page:
     for ci, f in enumerate(node.schema.fields):
         cols.append(jnp.asarray(np.array([r[ci] for r in node.rows]), f.type.dtype))
     return Page(node.schema, tuple(cols), tuple(None for _ in cols), None)
+
+
+def _page_bytes(page: Page) -> int:
+    """Device bytes held by a page's columns + null masks."""
+    total = 0
+    for c in page.columns:
+        total += page.capacity * np.dtype(c.dtype).itemsize
+    total += sum(page.capacity for n in page.null_masks if n is not None)
+    return total
 
 
 def _host(arrays):

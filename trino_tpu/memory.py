@@ -1,0 +1,151 @@
+"""Memory accounting: hierarchical contexts + a device memory pool.
+
+Reference: lib/trino-memory-context (AggregatedMemoryContext / LocalMemoryContext,
+memory/context/), the node-level pool with per-query tracking
+(memory/MemoryPool.java:46), and the revocation trigger
+(execution/MemoryRevokingScheduler.java).  The TPU translation: the scarce
+resource is HBM; "spill" means switching an operator to its partitioned
+re-streaming strategy (Grace agg/join) instead of writing state to disk — the
+pool's job is to say WHEN, before an XLA allocation fails.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+__all__ = ["MemoryPool", "AggregatedMemoryContext", "LocalMemoryContext",
+           "MemoryPoolExhaustedError", "device_memory_budget"]
+
+
+class MemoryPoolExhaustedError(MemoryError):
+    pass
+
+
+def device_memory_budget(fraction: float = 0.75) -> int:
+    """Usable bytes of accelerator memory (fraction of HBM; conservative CPU
+    default when the backend exposes no stats)."""
+    import jax
+
+    try:
+        d = jax.devices()[0]
+        stats = d.memory_stats()
+        if stats:
+            limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+            if limit:
+                return int(limit * fraction)
+    except Exception:
+        pass
+    return 4 << 30  # CPU / unknown backend default
+
+
+class MemoryPool:
+    """Node-level pool: operators reserve before allocating device state
+    (reference: MemoryPool.reserve / tryReserve)."""
+
+    def __init__(self, max_bytes: Optional[int] = None):
+        self.max_bytes = max_bytes if max_bytes is not None else device_memory_budget()
+        self.reserved = 0
+        self._lock = threading.Lock()
+        self._by_tag: dict[str, int] = {}
+
+    def try_reserve(self, nbytes: int, tag: str = "") -> bool:
+        with self._lock:
+            if self.reserved + nbytes > self.max_bytes:
+                return False
+            self.reserved += nbytes
+            if tag:
+                self._by_tag[tag] = self._by_tag.get(tag, 0) + nbytes
+            return True
+
+    def reserve(self, nbytes: int, tag: str = "") -> None:
+        if not self.try_reserve(nbytes, tag):
+            raise MemoryPoolExhaustedError(
+                f"memory pool exhausted: requested {nbytes} bytes, "
+                f"{self.max_bytes - self.reserved} free of {self.max_bytes}")
+
+    def free(self, nbytes: int, tag: str = "") -> None:
+        with self._lock:
+            self.reserved = max(self.reserved - nbytes, 0)
+            if tag and tag in self._by_tag:
+                self._by_tag[tag] = max(self._by_tag[tag] - nbytes, 0)
+
+    def free_bytes(self) -> int:
+        with self._lock:
+            return self.max_bytes - self.reserved
+
+    def info(self) -> dict:
+        with self._lock:
+            return {"max_bytes": self.max_bytes, "reserved": self.reserved,
+                    "by_tag": dict(self._by_tag)}
+
+
+class AggregatedMemoryContext:
+    """Parent context summing children (reference: AggregatedMemoryContext).
+    The root aggregated context feeds a MemoryPool."""
+
+    def __init__(self, pool: Optional[MemoryPool] = None,
+                 parent: Optional["AggregatedMemoryContext"] = None, tag: str = ""):
+        self.pool = pool
+        self.parent = parent
+        self.tag = tag
+        self.bytes = 0
+        self._lock = threading.Lock()
+
+    def new_child(self, tag: str = "") -> "AggregatedMemoryContext":
+        return AggregatedMemoryContext(parent=self, tag=tag or self.tag)
+
+    def new_local(self, tag: str = "") -> "LocalMemoryContext":
+        return LocalMemoryContext(self, tag or self.tag)
+
+    def _update(self, delta: int) -> None:
+        with self._lock:
+            self.bytes += delta
+        if self.parent is not None:
+            self.parent._update(delta)
+        elif self.pool is not None:
+            if delta > 0:
+                self.pool.reserve(delta, self.tag)
+            elif delta < 0:
+                self.pool.free(-delta, self.tag)
+
+    def try_update(self, delta: int) -> bool:
+        """Reserve without raising; used for spill decisions."""
+        root = self
+        while root.parent is not None:
+            root = root.parent
+        if delta > 0 and root.pool is not None \
+                and not root.pool.try_reserve(delta, self.tag):
+            return False
+        node = self
+        while node is not None:
+            with node._lock:
+                node.bytes += delta
+            node = node.parent
+        if delta < 0 and root.pool is not None:
+            root.pool.free(-delta, self.tag)
+        return True
+
+
+class LocalMemoryContext:
+    """Leaf context with setBytes semantics (reference: LocalMemoryContext)."""
+
+    def __init__(self, parent: AggregatedMemoryContext, tag: str = ""):
+        self.parent = parent
+        self.tag = tag
+        self.bytes = 0
+
+    def set_bytes(self, nbytes: int) -> None:
+        delta = nbytes - self.bytes
+        self.bytes = nbytes
+        self.parent._update(delta)
+
+    def try_set_bytes(self, nbytes: int) -> bool:
+        delta = nbytes - self.bytes
+        if self.parent.try_update(delta):
+            self.bytes = nbytes
+            return True
+        return False
+
+    def close(self) -> None:
+        self.set_bytes(0)
