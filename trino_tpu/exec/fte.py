@@ -1,0 +1,323 @@
+"""Fault-tolerant execution: retryable tasks over a spooled exchange.
+
+Reference architecture (SURVEY.md §2.6/§3.5): the FTE scheduler
+(scheduler/faulttolerant/EventDrivenFaultTolerantQueryScheduler.java:209) makes
+the TASK the retryable unit — its input is a replayable TaskDescriptor
+(splits), its output is written through the Exchange SPI to durable spooled
+storage (spi/exchange/ExchangeManager.java, plugin/trino-exchange-filesystem/
+FileSystemExchangeManager.java); a failed task re-runs from its descriptor and
+duplicate attempt output is deduplicated
+(operator/DeduplicatingDirectExchangeBuffer.java).  Failure injection hooks
+mirror execution/FailureInjector.java:53.
+
+TPU translation: a task = a partial aggregation over a split subset, jit-run on
+the accelerator; its compacted partial-state page spools to the local
+filesystem with an atomic first-commit-wins rename; the downstream stage merges
+spooled partials (count->sum etc.) and the rest of the plan runs locally.
+Plans without a scan-fed aggregation run non-fault-tolerantly (the retry unit
+needs replayable inputs + mergeable outputs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import os
+import random
+import zlib
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import hashagg
+from ..page import Page
+from ..sql import plan as P
+from .local_executor import LocalExecutor, _finalize_aggs, _host, _materialize
+
+__all__ = ["FailureInjector", "InjectedFailure", "SpoolingExchange",
+           "FaultTolerantExecutor", "serialize_page", "deserialize_page"]
+
+_MERGE_KIND = {"sum": "sum", "count": "sum", "count_star": "sum",
+               "min": "min", "max": "max"}
+
+_MAGIC = b"TTPG"
+
+
+# ---------------------------------------------------------------------------- page serde
+def serialize_page(columns: list, null_masks: list, compress: bool = True) -> bytes:
+    """Framed page wire format: magic, codec flag, CRC32, length, npz payload
+    (reference: PagesSerdeUtil.java:47 header + XXH64 checksum :84 with LZ4/ZSTD;
+    zlib is the in-tree codec here)."""
+    buf = io.BytesIO()
+    arrays = {}
+    for i, c in enumerate(columns):
+        arrays[f"c{i}"] = np.asarray(c)
+        if null_masks[i] is not None:
+            arrays[f"n{i}"] = np.asarray(null_masks[i])
+    np.savez(buf, ncols=np.int64(len(columns)), **arrays)
+    payload = buf.getvalue()
+    codec = 1 if compress else 0
+    if compress:
+        payload = zlib.compress(payload, 1)
+    crc = zlib.crc32(payload)
+    head = _MAGIC + bytes([codec]) + crc.to_bytes(4, "little") \
+        + len(payload).to_bytes(8, "little")
+    return head + payload
+
+
+def deserialize_page(data: bytes):
+    """-> (columns, null_masks) as numpy arrays; raises on checksum mismatch."""
+    if data[:4] != _MAGIC:
+        raise ValueError("bad page frame magic")
+    codec = data[4]
+    crc = int.from_bytes(data[5:9], "little")
+    length = int.from_bytes(data[9:17], "little")
+    payload = data[17:17 + length]
+    if zlib.crc32(payload) != crc:
+        raise ValueError("page frame checksum mismatch")
+    if codec == 1:
+        payload = zlib.decompress(payload)
+    z = np.load(io.BytesIO(payload))
+    n = int(z["ncols"])
+    cols = [z[f"c{i}"] for i in range(n)]
+    nulls = [z[f"n{i}"] if f"n{i}" in z.files else None for i in range(n)]
+    return cols, nulls
+
+
+# ---------------------------------------------------------------------------- injection
+class InjectedFailure(RuntimeError):
+    pass
+
+
+class FailureInjector:
+    """Deterministic fault injection at named points (reference:
+    execution/FailureInjector.java:53-57 — TASK_FAILURE,
+    TASK_MANAGEMENT_REQUEST_FAILURE, GET_RESULTS_FAILURE...)."""
+
+    def __init__(self):
+        self._plans: dict = {}  # (task_id, point) -> remaining failure count
+
+    def inject(self, task_id: int, point: str, times: int = 1) -> None:
+        self._plans[(task_id, point)] = times
+
+    def maybe_fail(self, task_id: int, point: str) -> None:
+        left = self._plans.get((task_id, point), 0)
+        if left > 0:
+            self._plans[(task_id, point)] = left - 1
+            raise InjectedFailure(f"injected {point} on task {task_id}")
+
+
+# ---------------------------------------------------------------------------- spooling
+class SpoolingExchange:
+    """Filesystem spool: one directory per exchange; each task commits exactly one
+    output file via atomic rename (first commit wins — duplicate retry output is
+    dropped, reference: DeduplicatingDirectExchangeBuffer)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _final(self, task_id: int) -> str:
+        return os.path.join(self.directory, f"task_{task_id}.page")
+
+    def commit(self, task_id: int, attempt: int, data: bytes) -> bool:
+        """Returns False when an earlier attempt already committed."""
+        if os.path.exists(self._final(task_id)):
+            return False
+        tmp = os.path.join(self.directory,
+                           f".task_{task_id}.attempt_{attempt}.{random.random():.9f}")
+        with open(tmp, "wb") as f:
+            f.write(data)
+        try:
+            os.rename(tmp, self._final(task_id))  # atomic on POSIX
+            return True
+        except OSError:
+            os.unlink(tmp)
+            return False
+
+    def is_committed(self, task_id: int) -> bool:
+        return os.path.exists(self._final(task_id))
+
+    def read(self, task_id: int) -> bytes:
+        with open(self._final(task_id), "rb") as f:
+            return f.read()
+
+
+# ---------------------------------------------------------------------------- executor
+@dataclasses.dataclass(frozen=True)
+class TaskDescriptor:
+    """Replayable task input (reference:
+    scheduler/faulttolerant/TaskDescriptorStorage.java:66)."""
+
+    task_id: int
+    splits: tuple
+
+
+class FaultTolerantExecutor:
+    """Executes plans with task-level retries when the plan has a scan-fed
+    aggregation (the common analytics shape); other plans run locally without
+    retries.  max_attempts mirrors the reference's task retry policy
+    (RetryPolicy.TASK, task_retry_attempts_per_task)."""
+
+    def __init__(self, catalogs: dict, spool_dir: str,
+                 injector: Optional[FailureInjector] = None,
+                 max_attempts: int = 4, splits_per_task: int = 2):
+        self.catalogs = catalogs
+        self.spool_dir = spool_dir
+        self.injector = injector or FailureInjector()
+        self.max_attempts = max_attempts
+        self.splits_per_task = splits_per_task
+        self.local = LocalExecutor(catalogs)
+        self._exchange_seq = 0
+        self.task_attempts: dict[int, int] = {}  # observability: task -> attempts used
+
+    # -- public ----------------------------------------------------------------
+    def execute(self, plan: P.PlanNode):
+        agg = self._find_fte_aggregate(plan)
+        if agg is None:
+            return self.local.execute(plan)
+        merged_page, dicts = self._run_fte_aggregate(agg)
+        # run the rest of the plan with the aggregate's result substituted
+        orig = self.local._execute_to_page
+
+        def patched(node, _orig=orig, agg=agg, page=merged_page, dicts=dicts):
+            if node is agg:
+                return page, dicts
+            return _orig(node)
+
+        self.local._execute_to_page = patched
+        try:
+            self.local.stats = {}
+            page, dd = self.local._execute_to_page(plan)
+            return _materialize(page, dd)
+        finally:
+            self.local._execute_to_page = orig
+
+    # -- task planning ----------------------------------------------------------
+    def _find_fte_aggregate(self, node):
+        """Topmost Aggregate whose child is a pure stream over one scan."""
+        if isinstance(node, P.Aggregate) and node.keys:
+            stream = self.local._compile_stream(node.child)
+            if stream.scan_info is not None and stream.scan_info.splits:
+                return node
+            return None
+        for c in node.children:
+            found = self._find_fte_aggregate(c)
+            if found is not None:
+                return found
+        return None
+
+    # -- stage 1: partial aggregation tasks -------------------------------------
+    def _run_fte_aggregate(self, node: P.Aggregate):
+        stream, key_types, acc_specs, acc_exprs, acc_kinds, step = \
+            self.local._agg_compiled(node)
+        si = stream.scan_info
+        splits = list(si.splits)
+        tasks = [TaskDescriptor(i, tuple(splits[j] for j in
+                                         range(i * self.splits_per_task,
+                                               min((i + 1) * self.splits_per_task,
+                                                   len(splits)))))
+                 for i in range((len(splits) + self.splits_per_task - 1)
+                                // self.splits_per_task)]
+        self._exchange_seq += 1
+        exchange = SpoolingExchange(
+            os.path.join(self.spool_dir, f"exchange_{self._exchange_seq}"))
+
+        for task in tasks:
+            self._run_task_with_retries(task, exchange, node, stream, key_types,
+                                        acc_specs, step)
+
+        return self._merge_spooled(exchange, tasks, node, stream, key_types,
+                                   acc_specs, acc_kinds)
+
+    def _run_task_with_retries(self, task, exchange, node, stream, key_types,
+                               acc_specs, step):
+        last_error = None
+        for attempt in range(self.max_attempts):
+            self.task_attempts[task.task_id] = attempt + 1
+            try:
+                self.injector.maybe_fail(task.task_id, "TASK_FAILURE")
+                data = self._execute_task(task, node, stream, key_types, acc_specs,
+                                          step)
+                self.injector.maybe_fail(task.task_id, "TASK_GET_RESULTS_FAILURE")
+                exchange.commit(task.task_id, attempt, data)
+                # a post-commit failure must not duplicate output on retry
+                self.injector.maybe_fail(task.task_id, "POST_COMMIT_FAILURE")
+                return
+            except InjectedFailure as e:
+                last_error = e
+                if exchange.is_committed(task.task_id):
+                    return  # output durable; the retry would dedup anyway
+                continue
+        raise RuntimeError(
+            f"task {task.task_id} failed after {self.max_attempts} attempts: "
+            f"{last_error}")
+
+    def _execute_task(self, task: TaskDescriptor, node, stream, key_types, acc_specs,
+                      step) -> bytes:
+        """Partial aggregation over the task's splits -> serialized partial page
+        (keys + raw accumulator columns)."""
+        si = stream.scan_info
+        capacity = node.capacity or 1 << 16
+        while True:
+            state = hashagg.groupby_init(capacity, tuple(t.dtype for t in key_types),
+                                         acc_specs)
+            for split in task.splits:
+                page = si.conn.generate(split, list(si.scan_columns))
+                state = step(state, page)
+            if not bool(state.overflow):
+                break
+            capacity *= 4
+        n_groups = int(hashagg.group_count(state))
+        bucket = max(1 << max(n_groups - 1, 1).bit_length(), 64)
+        keys, key_nulls, accs = hashagg.compact_groups(state, bucket)
+        got = _host(list(keys) + list(key_nulls) + list(accs))
+        nk = len(keys)
+        cols = [g[:n_groups] for g in got[:nk]] + [g[:n_groups] for g in got[2 * nk:]]
+        nulls = [g[:n_groups] for g in got[nk:2 * nk]] + [None] * len(accs)
+        nulls = [n if (n is not None and n.any()) else None for n in nulls]
+        return serialize_page(cols, nulls)
+
+    # -- stage 2: merge ----------------------------------------------------------
+    def _merge_spooled(self, exchange, tasks, node, stream, key_types, acc_specs,
+                       acc_kinds):
+        merge_kinds = [_MERGE_KIND[k] for k in acc_kinds]
+        nk = len(node.keys)
+        capacity = 1 << 16
+        while True:
+            state = hashagg.groupby_init(capacity, tuple(t.dtype for t in key_types),
+                                         acc_specs)
+            overflow = False
+            for task in tasks:
+                cols, nulls = deserialize_page(exchange.read(task.task_id))
+                kcols = tuple(jnp.asarray(c) for c in cols[:nk])
+                knulls = tuple(None if n is None else jnp.asarray(n)
+                               for n in nulls[:nk])
+                accs = [(jnp.asarray(c), None) for c in cols[nk:]]
+                valid = jnp.ones((cols[0].shape[0],), bool) if cols[0].shape[0] \
+                    else jnp.zeros((0,), bool)
+                if cols[0].shape[0] == 0:
+                    continue
+                state = hashagg.groupby_insert(state, kcols, key_types, valid,
+                                               accs, merge_kinds, knulls)
+            overflow = bool(state.overflow)
+            if not overflow:
+                break
+            capacity *= 4
+
+        n_groups = int(hashagg.group_count(state))
+        bucket = max(1 << max(n_groups - 1, 1).bit_length(), 64)
+        keys, key_nulls, accs = hashagg.compact_groups(state, bucket)
+        got = _host(list(keys) + list(key_nulls) + list(accs))
+        key_cols = [k[:n_groups] for k in got[:nk]]
+        key_null_cols = [kn[:n_groups] for kn in got[nk:2 * nk]]
+        acc_cols = [a[:n_groups] for a in got[2 * nk:]]
+        out_cols = key_cols + _finalize_aggs(node.aggs, acc_cols, n_groups)
+        arrays = [np.asarray(c) for c in out_cols]
+        out_nulls = tuple(kn if kn.any() else None for kn in key_null_cols) \
+            + tuple(None for _ in node.aggs)
+        page = Page(node.schema, tuple(arrays), out_nulls, None)
+        dicts = tuple(stream.dicts[i] for i in node.keys) \
+            + tuple(None for _ in node.aggs)
+        return page, dicts
