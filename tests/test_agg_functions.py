@@ -1,0 +1,95 @@
+"""Variance/stddev family, bool_and/bool_or, arbitrary, approx_distinct.
+
+Reference: operator/aggregation (VarianceAggregation, BooleanAndAggregation,
+ApproximateCountDistinctAggregation, ArbitraryAggregationFunction) — results
+validated against numpy on the same generated data.
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.tpch import TpchConnector
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.01, split_rows=1 << 12))
+    return e, e.create_session("tpch")
+
+
+def _lineitem_np(e):
+    conn = e.catalogs["tpch"]
+    cols = {c: [] for c in ["l_quantity", "l_returnflag", "l_orderkey"]}
+    for sp in conn.splits("lineitem"):
+        page = conn.generate(sp, list(cols))
+        valid = np.asarray(page.valid_mask())
+        for c in cols:
+            cols[c].append(np.asarray(page.column(c))[valid])
+    return {c: np.concatenate(v) for c, v in cols.items()}
+
+
+def test_variance_stddev_global(eng):
+    e, s = eng
+    r = e.execute_sql("""select var_pop(l_quantity), var_samp(l_quantity),
+                                stddev_pop(l_quantity), stddev(l_quantity),
+                                variance(l_quantity)
+                         from lineitem""", s).rows()[0]
+    q = _lineitem_np(e)["l_quantity"] / 100.0  # decimal(15,2) raw -> value
+    assert np.isclose(r[0], np.var(q), rtol=1e-9)
+    assert np.isclose(r[1], np.var(q, ddof=1), rtol=1e-9)
+    assert np.isclose(r[2], np.std(q), rtol=1e-9)
+    assert np.isclose(r[3], np.std(q, ddof=1), rtol=1e-9)
+    assert np.isclose(r[4], np.var(q, ddof=1), rtol=1e-9)
+
+
+def test_variance_grouped(eng):
+    e, s = eng
+    rows = e.execute_sql("""select l_returnflag, var_pop(l_quantity)
+                            from lineitem group by l_returnflag
+                            order by l_returnflag""", s).rows()
+    d = _lineitem_np(e)
+    conn = e.catalogs["tpch"]
+    rf_dict = conn.dictionaries("lineitem")["l_returnflag"]
+    q = d["l_quantity"] / 100.0
+    for flag, got in rows:
+        fid = rf_dict.lookup(flag)
+        expect = np.var(q[d["l_returnflag"] == fid])
+        assert np.isclose(got, expect, rtol=1e-9), flag
+
+
+def test_bool_and_or(eng):
+    e, s = eng
+    r = e.execute_sql("""select bool_and(l_quantity > 0), bool_or(l_quantity > 4900),
+                                every(l_quantity > 2500)
+                         from lineitem""", s).rows()[0]
+    q = _lineitem_np(e)["l_quantity"]
+    assert r[0] == bool((q > 0).all())
+    assert r[1] == bool((q > 490000).any())
+    assert r[2] == bool((q > 250000).all())
+
+
+def test_approx_distinct_and_arbitrary(eng):
+    e, s = eng
+    r = e.execute_sql("select approx_distinct(l_orderkey) from lineitem", s).rows()[0]
+    d = _lineitem_np(e)
+    assert r[0] == len(np.unique(d["l_orderkey"]))
+    rows = e.execute_sql("""select l_returnflag, approx_distinct(l_orderkey)
+                            from lineitem group by l_returnflag
+                            order by l_returnflag""", s).rows()
+    conn = e.catalogs["tpch"]
+    rf_dict = conn.dictionaries("lineitem")["l_returnflag"]
+    for flag, got in rows:
+        fid = rf_dict.lookup(flag)
+        assert got == len(np.unique(d["l_orderkey"][d["l_returnflag"] == fid]))
+    arb = e.execute_sql("select arbitrary(l_orderkey), any_value(l_orderkey) "
+                        "from lineitem where l_orderkey = 7", s).rows()[0]
+    assert arb == (7, 7)
+
+
+def test_var_samp_single_row_is_undefined(eng):
+    e, s = eng
+    r = e.execute_sql("""select var_samp(l_quantity) from lineitem
+                         where l_orderkey = 1 and l_linenumber = 1""", s).rows()[0]
+    assert np.isnan(r[0])  # <2 samples (SQL NULL; surfaced as NaN)

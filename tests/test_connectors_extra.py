@@ -1,0 +1,59 @@
+"""Blackhole + ORC connectors.
+
+Reference test models: plugin/trino-blackhole tests, lib/trino-orc reader tests.
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.blackhole import BlackHoleConnector
+from trino_tpu.connectors.orc import OrcConnector
+from trino_tpu.page import Field, Schema
+from trino_tpu.types import BIGINT, DOUBLE
+
+
+def test_blackhole_scan_and_insert():
+    e = Engine()
+    bh = BlackHoleConnector()
+    bh.create_table("events", Schema((Field("id", BIGINT), Field("v", DOUBLE))),
+                    rows_per_page=100, pages_per_split=2, splits=3)
+    e.register_catalog("blackhole", bh)
+    s = e.create_session("blackhole")
+    r = e.execute_sql("select count(*), min(id), max(id) from events", s).rows()
+    assert r[0] == (600, 0, 599)
+    # inserts are swallowed
+    bh.append("events", [np.arange(5), np.zeros(5)])
+    r2 = e.execute_sql("select count(*) from events", s).rows()
+    assert r2[0][0] == 600
+    assert bh._tables["events"].inserted_rows == 5
+
+
+def test_orc_connector(tmp_path):
+    import pyarrow as pa
+    from pyarrow import orc
+
+    n = 3000
+    tbl = pa.table({
+        "id": pa.array(range(n), pa.int64()),
+        "price": pa.array([float(i) * 0.5 for i in range(n)], pa.float64()),
+        "tag": pa.array([None if i % 7 == 0 else f"tag{i % 5}" for i in range(n)]),
+    })
+    orc.write_table(tbl, str(tmp_path / "sales.orc"), stripe_size=64 * 1024)
+    e = Engine()
+    e.register_catalog("orc", OrcConnector(str(tmp_path)))
+    s = e.create_session("orc")
+    r = e.execute_sql("select count(*), sum(id) from sales", s).rows()
+    assert r[0] == (n, sum(range(n)))
+    r2 = e.execute_sql(
+        "select tag, count(*) c from sales where id < 700 group by tag order by tag",
+        s).rows()
+    import collections
+
+    expect = collections.Counter(None if i % 7 == 0 else f"tag{i % 5}"
+                                 for i in range(700))
+    got = {k: c for k, c in r2}
+    assert got == dict(expect)
+    r3 = e.execute_sql("select sum(price) from sales where tag = 'tag1'", s).rows()
+    expect3 = sum(i * 0.5 for i in range(n) if i % 7 != 0 and i % 5 == 1)
+    assert abs(r3[0][0] - expect3) < 1e-6

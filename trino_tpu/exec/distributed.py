@@ -47,7 +47,8 @@ from .local_executor import (DEFAULT_GROUP_CAPACITY, MAX_GROUP_CAPACITY, LocalEx
 __all__ = ["DistributedExecutor"]
 
 # merge kind for re-aggregating exchanged accumulator entries
-_MERGE_KIND = {"sum": "sum", "count": "sum", "count_star": "sum", "min": "min", "max": "max"}
+_MERGE_KIND = {"sum": "sum", "count": "sum", "count_star": "sum", "min": "min",
+               "max": "max", "sum_sq": "sum"}
 
 
 def _has_duplicate_keys(build_page: Page, key_channels, key_types) -> bool:

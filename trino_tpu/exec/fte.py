@@ -39,7 +39,7 @@ __all__ = ["FailureInjector", "InjectedFailure", "SpoolingExchange",
            "FaultTolerantExecutor", "serialize_page", "deserialize_page"]
 
 _MERGE_KIND = {"sum": "sum", "count": "sum", "count_star": "sum",
-               "min": "min", "max": "max"}
+               "min": "min", "max": "max", "sum_sq": "sum"}
 
 _MAGIC = b"TTPG"
 

@@ -568,6 +568,10 @@ class LocalExecutor:
                     out.append(st + jnp.sum(mask, dtype=st.dtype))
                 elif kind == "sum":
                     out.append(st + jnp.sum(jnp.where(mask, v, 0), dtype=st.dtype))
+                elif kind == "sum_sq":
+                    vv = v.astype(st.dtype)
+                    out.append(st + jnp.sum(jnp.where(mask, vv * vv, 0),
+                                            dtype=st.dtype))
                 elif kind == "min":
                     out.append(jnp.minimum(st, jnp.min(jnp.where(mask, v, hashagg._extreme(st.dtype, 1)))))
                 elif kind == "max":
@@ -943,8 +947,20 @@ def _accumulators_for(spec: P.AggSpec):
         return [("sum", dtype, 0), ("count", jnp.int64, 0)]
     if spec.kind in ("min", "max"):
         dtype = spec.arg.type.dtype
-        init = None
         return [(spec.kind, dtype, hashagg._extreme(dtype, 1 if spec.kind == "min" else -1))]
+    if spec.kind in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+        # (sum, sum of squares, count) — the reference's VarianceState
+        # (operator/aggregation/state/VarianceState.java keeps mean/m2; sums are
+        # the merge-friendly equivalent for partial aggregation)
+        return [("sum", jnp.float64, 0), ("sum_sq", jnp.float64, 0),
+                ("count", jnp.int64, 0)]
+    if spec.kind == "bool_and":
+        return [("min", jnp.int8, hashagg._extreme(jnp.int8, 1))]
+    if spec.kind == "bool_or":
+        return [("max", jnp.int8, hashagg._extreme(jnp.int8, -1))]
+    if spec.kind == "arbitrary":
+        dtype = spec.arg.type.dtype
+        return [("min", dtype, hashagg._extreme(dtype, 1))]
     raise NotImplementedError(spec.kind)
 
 
@@ -963,6 +979,17 @@ def _finalize_aggs(aggs, acc_cols, n_groups):
                 out.append(val.astype(np.int64))
             else:
                 out.append((s / c_safe).astype(np.float64))
+        elif spec.kind in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+            s, ssq, c = acc_cols[i], acc_cols[i + 1], acc_cols[i + 2]
+            i += 3
+            c_safe = np.where(c == 0, 1, c).astype(np.float64)
+            m2 = np.maximum(ssq - s * s / c_safe, 0.0)  # clamp fp cancellation
+            if spec.kind.endswith("_pop"):
+                var = m2 / c_safe
+            else:
+                var = m2 / np.where(c < 2, 1, c - 1)
+                var = np.where(c < 2, np.nan, var)  # samp undefined below 2 rows
+            out.append(np.sqrt(var) if spec.kind.startswith("stddev") else var)
         else:
             col = acc_cols[i]
             i += 1

@@ -213,6 +213,10 @@ def _onehot_agg_update(acc, kind, onehot, vals_nulls):
     if kind == "sum":
         delta = jnp.sum(jnp.where(mask, vals[:, None], 0), axis=0).astype(acc.dtype)
         return acc.at[:C].add(delta)
+    if kind == "sum_sq":
+        v = vals.astype(acc.dtype)
+        delta = jnp.sum(jnp.where(mask, (v * v)[:, None], 0), axis=0)
+        return acc.at[:C].add(delta)
     if kind == "min":
         big = _extreme(acc.dtype, +1)
         page_min = jnp.min(jnp.where(mask, vals[:, None].astype(acc.dtype), big),
@@ -339,6 +343,9 @@ def agg_update(acc, kind, slot, live, vals_nulls):
         return acc.at[idx].add(jnp.where(mask, 1, 0).astype(acc.dtype))
     if kind == "sum":
         return acc.at[idx].add(jnp.where(mask, vals, 0).astype(acc.dtype))
+    if kind == "sum_sq":
+        v = vals.astype(acc.dtype)
+        return acc.at[idx].add(jnp.where(mask, v * v, 0))
     if kind == "min":
         big = _extreme(acc.dtype, +1)
         return acc.at[idx].min(jnp.where(mask, vals, big).astype(acc.dtype))

@@ -42,7 +42,10 @@ class SemanticError(ValueError):
     pass
 
 
-AGG_FUNCS = {"count", "sum", "avg", "min", "max"}
+AGG_FUNCS = {"count", "sum", "avg", "min", "max",
+             "stddev", "stddev_pop", "stddev_samp", "variance", "var_pop", "var_samp",
+             "approx_distinct", "bool_and", "bool_or", "every", "arbitrary",
+             "any_value"}
 
 
 @dataclasses.dataclass
@@ -971,7 +974,8 @@ class Planner:
         # into a pre-aggregation on (k, x) followed by plain agg(x) GROUP BY k (reference:
         # iterative/rule/SingleDistinctAggregationToGroupBy.java)
         distinct_aggs = [a for a in uniq_aggs
-                         if a.distinct and a.name not in ("min", "max")]
+                         if (a.distinct or a.name == "approx_distinct")
+                         and a.name not in ("min", "max")]
         if distinct_aggs:
             if len(uniq_aggs) != len(distinct_aggs) or \
                     len({a.args for a in distinct_aggs}) != 1:
@@ -989,6 +993,12 @@ class Planner:
             specs = []
             for j, a in enumerate(uniq_aggs):
                 kind, _ = _agg_kind(a)
+                if kind == "approx_distinct":
+                    # approx_distinct(x) = count(distinct x) over the pre-aggregated
+                    # distinct groups (exact — a valid "approximation"; reference:
+                    # ApproximateCountDistinctAggregation returns estimates, ours
+                    # exercises the same distinct-rewrite machinery)
+                    kind = "count"
                 specs.append(P.AggSpec(kind, ir.FieldRef(len(key_exprs), de.type),
                                        f"agg{j}", _agg_type(kind, de.type)))
             agg_schema = Schema(tuple(
@@ -1044,6 +1054,10 @@ class Planner:
                 specs.append(P.AggSpec("count_star", None, f"agg{j}", BIGINT))
             else:
                 e, _ = self.translate(arg_ast, rel.cols)
+                if kind in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+                    # sums of raw scaled-decimal ints would square the scale;
+                    # variance is computed over double values
+                    e = _coerce(e, DOUBLE)
                 ch = len(proj_exprs)
                 proj_exprs.append(e)
                 specs.append(P.AggSpec(kind, ir.FieldRef(ch, e.type), f"agg{j}",
@@ -1660,8 +1674,12 @@ def _replace_nodes(ast, mapping: dict):
     return dataclasses.replace(ast, **changes) if changes else ast
 
 
+_AGG_ALIASES = {"every": "bool_and", "any_value": "arbitrary",
+                "variance": "var_samp", "stddev": "stddev_samp"}
+
+
 def _agg_kind(ast: A.FuncCall):
-    name = ast.name
+    name = _AGG_ALIASES.get(ast.name, ast.name)
     if name == "count":
         if not ast.args or isinstance(ast.args[0], A.Star):
             return "count_star", None
@@ -1670,7 +1688,7 @@ def _agg_kind(ast: A.FuncCall):
 
 
 def _agg_type(kind: str, in_type: Type) -> Type:
-    if kind in ("count", "count_star"):
+    if kind in ("count", "count_star", "approx_distinct"):
         return BIGINT
     if kind == "sum":
         if isinstance(in_type, DecimalType):
@@ -1680,7 +1698,11 @@ def _agg_type(kind: str, in_type: Type) -> Type:
         if isinstance(in_type, DecimalType):
             return in_type
         return DOUBLE
-    return in_type  # min/max
+    if kind in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+        return DOUBLE
+    if kind in ("bool_and", "bool_or"):
+        return BOOLEAN
+    return in_type  # min/max/arbitrary
 
 
 def _split_conjuncts(where) -> list:
