@@ -1,0 +1,182 @@
+"""TPC-DS connector + canonical store-sales star queries vs a pandas oracle.
+
+Reference: plugin/trino-tpcds + testing/trino-benchto-benchmarks tpcds suite;
+correctness checked the way the engine suites use H2 (pandas here) as oracle.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.tpcds import TpcdsConnector
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = Engine()
+    e.register_catalog("tpcds", TpcdsConnector(sf=SF, split_rows=1 << 14))
+    return e, e.create_session("tpcds")
+
+
+@pytest.fixture(scope="module")
+def host(eng):
+    e, _ = eng
+    conn = e.catalogs["tpcds"]
+    out = {}
+    for t in ("store_sales", "date_dim", "item", "promotion",
+              "customer_demographics"):
+        schema = conn.schema(t)
+        dicts = conn.dictionaries(t)
+        cols = {}
+        for f in schema.fields:
+            parts = []
+            for sp in conn.splits(t):
+                pg = conn.generate(sp, [f.name])
+                parts.append(np.asarray(pg.column(f.name)))
+            arr = np.concatenate(parts)
+            d = dicts.get(f.name)
+            if d is not None:
+                arr = d.decode(arr)
+            cols[f.name] = arr
+        out[t] = pd.DataFrame(cols)
+    return out
+
+
+def test_generators_cover_schemas(eng):
+    e, _ = eng
+    conn = e.catalogs["tpcds"]
+    from trino_tpu.connectors.tpcds import GENERATORS, SCHEMAS
+
+    for t, schema in SCHEMAS.items():
+        cols = GENERATORS[t](SF, 0, 4)
+        assert set(cols) == set(schema.names), t
+
+
+def test_row_counts(eng):
+    e, s = eng
+    r = e.execute_sql("select count(*) from store_sales", s).rows()
+    assert r[0][0] == int(2_880_000 * SF)
+    assert e.execute_sql("select count(*) from customer_demographics", s
+                         ).rows()[0][0] == 1_920_800
+    assert e.execute_sql("select count(*) from date_dim", s).rows()[0][0] == 4748
+
+
+def test_q42_category_report(eng, host):
+    e, s = eng
+    got = e.execute_sql("""
+        select d_year, i_category_id, i_category, sum(ss_ext_sales_price) total
+        from date_dim, store_sales, item
+        where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+          and i_manager_id = 1 and d_moy = 11 and d_year = 2000
+        group by d_year, i_category_id, i_category
+        order by total desc, d_year, i_category_id limit 100""", s).rows()
+    ss, dd, it = host["store_sales"], host["date_dim"], host["item"]
+    j = ss.merge(dd[(dd.d_moy == 11) & (dd.d_year == 2000)][["d_date_sk", "d_year"]],
+                 left_on="ss_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(it[it.i_manager_id == 1][["i_item_sk", "i_category_id",
+                                          "i_category"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    exp = (j.assign(v=j.ss_ext_sales_price / 100.0)
+           .groupby(["d_year", "i_category_id", "i_category"])["v"].sum()
+           .reset_index().sort_values(["v", "d_year", "i_category_id"],
+                                      ascending=[False, True, True]).head(100))
+    assert len(got) == len(exp)
+    for row, (_, er) in zip(got, exp.iterrows()):
+        assert row[0] == er.d_year and row[1] == er.i_category_id \
+            and row[2] == er.i_category
+        assert abs(float(row[3]) - er.v) < 1e-6
+
+
+def test_q55_brand_revenue(eng, host):
+    e, s = eng
+    got = e.execute_sql("""
+        select i_brand_id, i_brand, sum(ss_ext_sales_price) ext_price
+        from date_dim, store_sales, item
+        where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+          and i_manager_id = 28 and d_moy = 11 and d_year = 1999
+        group by i_brand_id, i_brand
+        order by ext_price desc, i_brand_id limit 100""", s).rows()
+    ss, dd, it = host["store_sales"], host["date_dim"], host["item"]
+    j = ss.merge(dd[(dd.d_moy == 11) & (dd.d_year == 1999)][["d_date_sk"]],
+                 left_on="ss_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(it[it.i_manager_id == 28][["i_item_sk", "i_brand_id", "i_brand"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    exp = (j.assign(v=j.ss_ext_sales_price / 100.0)
+           .groupby(["i_brand_id", "i_brand"])["v"].sum().reset_index()
+           .sort_values(["v", "i_brand_id"], ascending=[False, True]).head(100))
+    assert len(got) == len(exp)
+    for row, (_, er) in zip(got, exp.iterrows()):
+        assert row[0] == er.i_brand_id and row[1] == er.i_brand
+        assert abs(float(row[2]) - er.v) < 1e-6
+
+
+def test_q3_brand_by_year(eng, host):
+    e, s = eng
+    got = e.execute_sql("""
+        select d_year, i_brand_id, i_brand, sum(ss_ext_sales_price) sum_agg
+        from date_dim, store_sales, item
+        where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+          and i_manufact_id = 28 and d_moy = 11
+        group by d_year, i_brand_id, i_brand
+        order by d_year, sum_agg desc, i_brand_id limit 100""", s).rows()
+    ss, dd, it = host["store_sales"], host["date_dim"], host["item"]
+    j = ss.merge(dd[dd.d_moy == 11][["d_date_sk", "d_year"]],
+                 left_on="ss_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(it[it.i_manufact_id == 28][["i_item_sk", "i_brand_id", "i_brand"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    exp = (j.assign(v=j.ss_ext_sales_price / 100.0)
+           .groupby(["d_year", "i_brand_id", "i_brand"])["v"].sum().reset_index()
+           .sort_values(["d_year", "v", "i_brand_id"],
+                        ascending=[True, False, True]).head(100))
+    assert len(got) == len(exp)
+    for row, (_, er) in zip(got, exp.iterrows()):
+        assert row[0] == er.d_year and row[1] == er.i_brand_id
+        assert abs(float(row[3]) - er.v) < 1e-6
+
+
+def test_q7_demographic_averages(eng, host):
+    e, s = eng
+    got = e.execute_sql("""
+        select i_item_id, avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+               avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+        from store_sales, customer_demographics, date_dim, item, promotion
+        where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+          and ss_cdemo_sk = cd_demo_sk and ss_promo_sk = p_promo_sk
+          and cd_gender = 'M' and cd_marital_status = 'S'
+          and cd_education_status = 'College'
+          and (p_channel_email = 'N' or p_channel_event = 'N')
+          and d_year = 2000
+        group by i_item_id order by i_item_id limit 100""", s).rows()
+    ss, dd, it = host["store_sales"], host["date_dim"], host["item"]
+    cd, pr = host["customer_demographics"], host["promotion"]
+    j = ss.merge(dd[dd.d_year == 2000][["d_date_sk"]],
+                 left_on="ss_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(it[["i_item_sk", "i_item_id"]], left_on="ss_item_sk",
+                right_on="i_item_sk")
+    cdf = cd[(cd.cd_gender == "M") & (cd.cd_marital_status == "S")
+             & (cd.cd_education_status == "College")][["cd_demo_sk"]]
+    j = j.merge(cdf, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+    prf = pr[(pr.p_channel_email == "N") | (pr.p_channel_event == "N")][["p_promo_sk"]]
+    j = j.merge(prf, left_on="ss_promo_sk", right_on="p_promo_sk")
+    exp = (j.groupby("i_item_id")
+           .agg(agg1=("ss_quantity", "mean"), agg2=("ss_list_price", "mean"),
+                agg3=("ss_coupon_amt", "mean"), agg4=("ss_sales_price", "mean"))
+           .reset_index().sort_values("i_item_id").head(100))
+    assert len(got) == len(exp)
+    for row, (_, er) in zip(got, exp.iterrows()):
+        assert row[0] == er.i_item_id
+        assert abs(float(row[1]) - er.agg1) < 1e-9  # int avg: exact double
+        # decimal averages round half-up at scale 2
+        for gi, ev in ((2, er.agg2), (3, er.agg3), (4, er.agg4)):
+            assert abs(float(row[gi]) - ev / 100.0) <= 0.005 + 1e-9
+
+
+def test_split_pruning_on_date_dim(eng):
+    e, s = eng
+    conn = e.catalogs["tpcds"]
+    r = e.execute_sql(
+        "select count(*) from date_dim where d_date_sk < 2450100", s).rows()
+    assert r[0][0] == 100

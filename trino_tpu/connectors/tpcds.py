@@ -1,0 +1,582 @@
+"""TPC-DS connector: deterministic on-device data generation.
+
+Reference: plugin/trino-tpcds (TpcdsConnectorFactory; rows generated per split by
+the external `tpcds` generator library, analogous to plugin/trino-tpch —
+SURVEY.md §2.11).  Like the TPC-H connector, every column is a jit-compiled
+function of the global row index (counter-based splitmix64 streams), so a scan
+is itself a TPU kernel and any split regenerates identically.
+
+Covered tables (the store-sales star schema driving the canonical reporting
+queries Q3/Q7/Q19/Q42/Q52/Q55): store_sales, date_dim, item, customer,
+customer_address, customer_demographics, store, promotion.  Schemas follow the
+TPC-DS spec; value distributions are simplified (uniform over spec domains)
+where the official generator uses weighted text corpora — row counts scale per
+the spec's SF table (store_sales ≈ 2.88M rows/SF).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..page import Field, Page, Schema
+from ..types import BIGINT, DATE, INTEGER, DecimalType, VarcharType
+from .tpch import Dictionary, _enum, _rand, _uniform, parse_date_literal
+
+__all__ = ["TpcdsConnector"]
+
+D72 = DecimalType.of(7, 2)
+V = VarcharType.of(None)
+
+# spec row counts at SF1 (scaled tables scale linearly; small dims are fixed)
+BASE_ROWS = {
+    "store_sales": 2_880_000,
+    "customer": 100_000,
+    "customer_address": 50_000,
+    "item": 18_000,
+    "promotion": 300,
+    "store": 12,
+}
+DATE_LO = parse_date_literal("1990-01-01")
+DATE_HI = parse_date_literal("2002-12-31")
+N_DATES = DATE_HI - DATE_LO + 1  # date_dim rows (sk = julian-style day index)
+JULIAN_BASE = 2450000  # d_date_sk offset so sks look spec-like
+
+GENDERS = _enum("M", "F")
+MARITAL = _enum("M", "S", "D", "W", "U")
+EDUCATION = _enum("Primary", "Secondary", "College", "2 yr Degree", "4 yr Degree",
+                  "Advanced Degree", "Unknown")
+CREDIT = _enum("Low Risk", "High Risk", "Unknown", "Good")
+CATEGORIES = _enum("Home", "Books", "Electronics", "Jewelry", "Music", "Shoes",
+                   "Sports", "Women", "Men", "Children")
+N_CAT = 10
+BRAND_DICT = _enum(*[f"corpbrand #{i}" for i in range(1, 101)])
+CLASSES = _enum(*[f"class{i:02d}" for i in range(50)])
+MANAGERS = _enum(*[f"Manager {i}" for i in range(1, 101)])
+STATES = _enum("TN", "CA", "TX", "NY", "OH", "GA", "IL", "WA", "NC", "VA")
+COUNTIES = _enum(*[f"{w} County" for w in
+                   ("Williamson", "Ziebach", "Walker", "Daviess", "Barrow",
+                    "Franklin", "Luce", "Richland", "Oglethorpe", "Mobile")])
+CITIES = _enum(*[f"City{i:03d}" for i in range(200)])
+FIRST_NAMES = _enum(*[f"First{i:03d}" for i in range(512)])
+LAST_NAMES = _enum(*[f"Last{i:03d}" for i in range(512)])
+STORE_NAMES = _enum("ese", "anti", "ought", "able", "pri", "cally", "ation", "eing",
+                    "n st", "bar", "cal", "ought2")
+PROMO_NAMES = _enum(*[f"promo{i:03d}" for i in range(300)])
+ITEM_IDS = _enum(*[f"AAAAAAAA{i:08d}" for i in range(BASE_ROWS["item"])])
+CHANNELS = _enum("N", "Y")
+
+# customer_demographics: the spec's full cross product of attribute domains
+CD_GENDER, CD_MARITAL, CD_EDU = 2, 5, 7
+CD_PURCHASE = 20  # purchase estimate buckets (500..10000 step 500)
+CD_CREDIT = 4
+CD_DEP, CD_EMP, CD_COLL = 7, 7, 7
+CD_ROWS = CD_GENDER * CD_MARITAL * CD_EDU * CD_PURCHASE * CD_CREDIT \
+    * CD_DEP * CD_EMP * CD_COLL  # 1,920,800 (spec row count)
+
+
+def _schema(*fields):
+    return Schema(tuple(Field(n, t) for n, t in fields))
+
+
+SCHEMAS = {
+    "date_dim": _schema(
+        ("d_date_sk", BIGINT), ("d_date_id", BIGINT), ("d_date", DATE),
+        ("d_month_seq", INTEGER), ("d_week_seq", INTEGER), ("d_quarter_seq", INTEGER),
+        ("d_year", INTEGER), ("d_dow", INTEGER), ("d_moy", INTEGER),
+        ("d_dom", INTEGER), ("d_qoy", INTEGER), ("d_fy_year", INTEGER),
+        ("d_day_name", V), ("d_holiday", V), ("d_weekend", V),
+        ("d_following_holiday", V), ("d_first_dom", INTEGER),
+        ("d_last_dom", INTEGER), ("d_same_day_ly", INTEGER),
+        ("d_same_day_lq", INTEGER), ("d_current_day", V), ("d_current_week", V),
+        ("d_current_month", V), ("d_current_quarter", V), ("d_current_year", V),
+    ),
+    "item": _schema(
+        ("i_item_sk", BIGINT), ("i_item_id", V), ("i_rec_start_date", DATE),
+        ("i_rec_end_date", DATE), ("i_item_desc", V), ("i_current_price", D72),
+        ("i_wholesale_cost", D72), ("i_brand_id", INTEGER), ("i_brand", V),
+        ("i_class_id", INTEGER), ("i_class", V), ("i_category_id", INTEGER),
+        ("i_category", V), ("i_manufact_id", INTEGER), ("i_manufact", V),
+        ("i_size", V), ("i_formulation", V), ("i_color", V), ("i_units", V),
+        ("i_container", V), ("i_manager_id", INTEGER), ("i_product_name", V),
+    ),
+    "customer": _schema(
+        ("c_customer_sk", BIGINT), ("c_customer_id", BIGINT),
+        ("c_current_cdemo_sk", BIGINT), ("c_current_hdemo_sk", BIGINT),
+        ("c_current_addr_sk", BIGINT), ("c_first_shipto_date_sk", BIGINT),
+        ("c_first_sales_date_sk", BIGINT), ("c_salutation", V),
+        ("c_first_name", V), ("c_last_name", V), ("c_preferred_cust_flag", V),
+        ("c_birth_day", INTEGER), ("c_birth_month", INTEGER),
+        ("c_birth_year", INTEGER), ("c_birth_country", V), ("c_login", V),
+        ("c_email_address", V), ("c_last_review_date_sk", BIGINT),
+    ),
+    "customer_address": _schema(
+        ("ca_address_sk", BIGINT), ("ca_address_id", BIGINT),
+        ("ca_street_number", INTEGER), ("ca_street_name", V),
+        ("ca_street_type", V), ("ca_suite_number", V), ("ca_city", V),
+        ("ca_county", V), ("ca_state", V), ("ca_zip", INTEGER), ("ca_country", V),
+        ("ca_gmt_offset", DecimalType.of(5, 2)), ("ca_location_type", V),
+    ),
+    "customer_demographics": _schema(
+        ("cd_demo_sk", BIGINT), ("cd_gender", V), ("cd_marital_status", V),
+        ("cd_education_status", V), ("cd_purchase_estimate", INTEGER),
+        ("cd_credit_rating", V), ("cd_dep_count", INTEGER),
+        ("cd_dep_employed_count", INTEGER), ("cd_dep_college_count", INTEGER),
+    ),
+    "store": _schema(
+        ("s_store_sk", BIGINT), ("s_store_id", BIGINT), ("s_rec_start_date", DATE),
+        ("s_rec_end_date", DATE), ("s_closed_date_sk", BIGINT), ("s_store_name", V),
+        ("s_number_employees", INTEGER), ("s_floor_space", INTEGER),
+        ("s_hours", V), ("s_manager", V), ("s_market_id", INTEGER),
+        ("s_geography_class", V), ("s_market_desc", V), ("s_market_manager", V),
+        ("s_division_id", INTEGER), ("s_division_name", V), ("s_company_id", INTEGER),
+        ("s_company_name", V), ("s_street_number", INTEGER), ("s_street_name", V),
+        ("s_street_type", V), ("s_suite_number", V), ("s_city", V), ("s_county", V),
+        ("s_state", V), ("s_zip", INTEGER), ("s_country", V),
+        ("s_gmt_offset", DecimalType.of(5, 2)), ("s_tax_precentage", D72),
+    ),
+    "promotion": _schema(
+        ("p_promo_sk", BIGINT), ("p_promo_id", BIGINT), ("p_start_date_sk", BIGINT),
+        ("p_end_date_sk", BIGINT), ("p_item_sk", BIGINT), ("p_cost", D72),
+        ("p_response_target", INTEGER), ("p_promo_name", V), ("p_channel_dmail", V),
+        ("p_channel_email", V), ("p_channel_catalog", V), ("p_channel_tv", V),
+        ("p_channel_radio", V), ("p_channel_press", V), ("p_channel_event", V),
+        ("p_channel_demo", V), ("p_channel_details", V), ("p_purpose", V),
+        ("p_discount_active", V),
+    ),
+    "store_sales": _schema(
+        ("ss_sold_date_sk", BIGINT), ("ss_sold_time_sk", BIGINT),
+        ("ss_item_sk", BIGINT), ("ss_customer_sk", BIGINT), ("ss_cdemo_sk", BIGINT),
+        ("ss_hdemo_sk", BIGINT), ("ss_addr_sk", BIGINT), ("ss_store_sk", BIGINT),
+        ("ss_promo_sk", BIGINT), ("ss_ticket_number", BIGINT),
+        ("ss_quantity", INTEGER), ("ss_wholesale_cost", D72), ("ss_list_price", D72),
+        ("ss_sales_price", D72), ("ss_ext_discount_amt", D72),
+        ("ss_ext_sales_price", D72), ("ss_ext_wholesale_cost", D72),
+        ("ss_ext_list_price", D72), ("ss_ext_tax", D72), ("ss_coupon_amt", D72),
+        ("ss_net_paid", D72), ("ss_net_paid_inc_tax", D72), ("ss_net_profit", D72),
+    ),
+}
+
+DAY_NAMES = _enum("Sunday", "Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+                  "Saturday")
+YN = _enum("N", "Y")
+
+DICTS = {
+    "date_dim": {"d_day_name": DAY_NAMES, "d_holiday": YN, "d_weekend": YN,
+                 "d_following_holiday": YN, "d_current_day": YN,
+                 "d_current_week": YN, "d_current_month": YN,
+                 "d_current_quarter": YN, "d_current_year": YN},
+    "item": {"i_item_id": ITEM_IDS, "i_item_desc": ITEM_IDS, "i_brand": BRAND_DICT,
+             "i_class": CLASSES, "i_category": CATEGORIES, "i_manufact": BRAND_DICT,
+             "i_size": _enum("small", "medium", "large", "extra large", "petite",
+                             "economy", "N/A"),
+             "i_formulation": ITEM_IDS, "i_color": _enum(
+                 "red", "green", "blue", "yellow", "purple", "white", "black",
+                 "orange", "pink", "brown"),
+             "i_units": _enum("Each", "Dozen", "Case", "Pallet", "Gross", "Box"),
+             "i_container": _enum("Unknown"), "i_product_name": ITEM_IDS},
+    "customer": {"c_salutation": _enum("Mr.", "Mrs.", "Ms.", "Dr.", "Miss", "Sir"),
+                 "c_first_name": FIRST_NAMES, "c_last_name": LAST_NAMES,
+                 "c_preferred_cust_flag": YN,
+                 "c_birth_country": _enum("UNITED STATES", "CANADA", "MEXICO",
+                                          "GERMANY", "JAPAN", "BRAZIL", "INDIA"),
+                 "c_login": FIRST_NAMES, "c_email_address": FIRST_NAMES},
+    "customer_address": {"ca_street_name": CITIES,
+                         "ca_street_type": _enum("Street", "Ave", "Blvd", "Way",
+                                                 "Court", "Lane"),
+                         "ca_suite_number": _enum(*[f"Suite {i}" for i in range(50)]),
+                         "ca_city": CITIES, "ca_county": COUNTIES,
+                         "ca_state": STATES,
+                         "ca_country": _enum("United States"),
+                         "ca_location_type": _enum("apartment", "condo",
+                                                   "single family")},
+    "customer_demographics": {"cd_gender": GENDERS, "cd_marital_status": MARITAL,
+                              "cd_education_status": EDUCATION,
+                              "cd_credit_rating": CREDIT},
+    "store": {"s_store_name": STORE_NAMES, "s_hours": _enum("8AM-8PM", "8AM-4PM",
+                                                            "8AM-12AM"),
+              "s_manager": MANAGERS, "s_geography_class": _enum("Unknown"),
+              "s_market_desc": COUNTIES, "s_market_manager": MANAGERS,
+              "s_division_name": _enum("Unknown"), "s_company_name": _enum("Unknown"),
+              "s_street_name": CITIES, "s_street_type": _enum("Street", "Ave"),
+              "s_suite_number": _enum(*[f"Suite {i}" for i in range(50)]),
+              "s_city": CITIES, "s_county": COUNTIES, "s_state": STATES,
+              "s_country": _enum("United States")},
+    "promotion": {"p_promo_name": PROMO_NAMES, "p_channel_dmail": CHANNELS,
+                  "p_channel_email": CHANNELS, "p_channel_catalog": CHANNELS,
+                  "p_channel_tv": CHANNELS, "p_channel_radio": CHANNELS,
+                  "p_channel_press": CHANNELS, "p_channel_event": CHANNELS,
+                  "p_channel_demo": CHANNELS, "p_channel_details": PROMO_NAMES,
+                  "p_purpose": _enum("Unknown"), "p_discount_active": CHANNELS},
+    "store_sales": {},
+}
+
+
+def _ymd(days):
+    """Civil (year, month, day, dow, doy) from days-since-epoch (device)."""
+    from ..sql.ir import _extract_ymd
+
+    return _extract_ymd(days)
+
+
+# -- per-table generators (row index -> columns) ------------------------------------------
+def gen_date_dim(sf, lo, length, n=0):
+    i = jnp.arange(length, dtype=jnp.int64) + lo
+    days = (DATE_LO + i).astype(jnp.int32)
+    y, m, d = _ymd(days)
+    dow = ((days.astype(jnp.int64) + 4) % 7).astype(jnp.int32)  # 1970-01-01 = Thursday
+    qoy = ((m - 1) // 3 + 1).astype(jnp.int32)
+    month_seq = ((y - 1900) * 12 + (m - 1)).astype(jnp.int32)
+    week_seq = ((DATE_LO + i) // 7).astype(jnp.int32)
+    return {
+        "d_date_sk": JULIAN_BASE + i,
+        "d_date_id": i,
+        "d_date": days,
+        "d_month_seq": month_seq,
+        "d_week_seq": week_seq,
+        "d_quarter_seq": ((y - 1900) * 4 + qoy - 1).astype(jnp.int32),
+        "d_year": y.astype(jnp.int32),
+        "d_dow": dow,
+        "d_moy": m.astype(jnp.int32),
+        "d_dom": d.astype(jnp.int32),
+        "d_qoy": qoy,
+        "d_fy_year": y.astype(jnp.int32),
+        "d_day_name": dow.astype(jnp.int32),
+        "d_holiday": (jnp.logical_and(m == 12, d == 25)).astype(jnp.int32),
+        "d_weekend": (jnp.logical_or(dow == 0, dow == 6)).astype(jnp.int32),
+        "d_following_holiday": (jnp.logical_and(m == 12, d == 26)).astype(jnp.int32),
+        "d_first_dom": (days - d + 1).astype(jnp.int32),
+        "d_last_dom": (days + 27).astype(jnp.int32),
+        "d_same_day_ly": (days - 365).astype(jnp.int32),
+        "d_same_day_lq": (days - 91).astype(jnp.int32),
+        "d_current_day": jnp.zeros(length, jnp.int32),
+        "d_current_week": jnp.zeros(length, jnp.int32),
+        "d_current_month": jnp.zeros(length, jnp.int32),
+        "d_current_quarter": jnp.zeros(length, jnp.int32),
+        "d_current_year": jnp.zeros(length, jnp.int32),
+    }
+
+
+def gen_item(sf, lo, length, n=0):
+    i = jnp.arange(length, dtype=jnp.int64) + lo
+    sk = i + 1
+    brand_id = _uniform(101, sk, 1, 100).astype(jnp.int32)
+    class_id = _uniform(102, sk, 1, 50).astype(jnp.int32)
+    cat_id = (sk % N_CAT).astype(jnp.int32) + 1
+    manu_id = _uniform(104, sk, 1, 100).astype(jnp.int32)
+    price = _uniform(105, sk, 99, 9999)
+    return {
+        "i_item_sk": sk,
+        "i_item_id": (i % BASE_ROWS["item"]).astype(jnp.int32),
+        "i_rec_start_date": jnp.full(length, DATE_LO, jnp.int32),
+        "i_rec_end_date": jnp.full(length, DATE_HI, jnp.int32),
+        "i_item_desc": (i % BASE_ROWS["item"]).astype(jnp.int32),
+        "i_current_price": price,
+        "i_wholesale_cost": (price * 6) // 10,
+        "i_brand_id": brand_id,
+        "i_brand": brand_id - 1,
+        "i_class_id": class_id,
+        "i_class": class_id - 1,
+        "i_category_id": cat_id,
+        "i_category": cat_id - 1,
+        "i_manufact_id": manu_id,
+        "i_manufact": manu_id - 1,
+        "i_size": (sk % 7).astype(jnp.int32),
+        "i_formulation": (i % BASE_ROWS["item"]).astype(jnp.int32),
+        "i_color": (sk % 10).astype(jnp.int32),
+        "i_units": (sk % 6).astype(jnp.int32),
+        "i_container": jnp.zeros(length, jnp.int32),
+        "i_manager_id": _uniform(106, sk, 1, 100).astype(jnp.int32),
+        "i_product_name": (i % BASE_ROWS["item"]).astype(jnp.int32),
+    }
+
+
+def gen_customer(sf, lo, length, n=0):
+    i = jnp.arange(length, dtype=jnp.int64) + lo
+    sk = i + 1
+    n_addr = max(int(BASE_ROWS["customer_address"] * sf), 1)
+    return {
+        "c_customer_sk": sk,
+        "c_customer_id": sk,
+        "c_current_cdemo_sk": _uniform(201, sk, 1, CD_ROWS),
+        "c_current_hdemo_sk": _uniform(202, sk, 1, 7200),
+        "c_current_addr_sk": _uniform(203, sk, 1, n_addr),
+        "c_first_shipto_date_sk": JULIAN_BASE + _uniform(204, sk, 0, N_DATES - 1),
+        "c_first_sales_date_sk": JULIAN_BASE + _uniform(205, sk, 0, N_DATES - 1),
+        "c_salutation": (sk % 6).astype(jnp.int32),
+        "c_first_name": (_uniform(206, sk, 0, 511)).astype(jnp.int32),
+        "c_last_name": (_uniform(207, sk, 0, 511)).astype(jnp.int32),
+        "c_preferred_cust_flag": (sk % 2).astype(jnp.int32),
+        "c_birth_day": _uniform(208, sk, 1, 28).astype(jnp.int32),
+        "c_birth_month": _uniform(209, sk, 1, 12).astype(jnp.int32),
+        "c_birth_year": _uniform(210, sk, 1930, 1990).astype(jnp.int32),
+        "c_birth_country": (sk % 7).astype(jnp.int32),
+        "c_login": (_uniform(206, sk, 0, 511)).astype(jnp.int32),
+        "c_email_address": (_uniform(206, sk, 0, 511)).astype(jnp.int32),
+        "c_last_review_date_sk": JULIAN_BASE + _uniform(211, sk, 0, N_DATES - 1),
+    }
+
+
+def gen_customer_address(sf, lo, length, n=0):
+    i = jnp.arange(length, dtype=jnp.int64) + lo
+    sk = i + 1
+    return {
+        "ca_address_sk": sk,
+        "ca_address_id": sk,
+        "ca_street_number": _uniform(301, sk, 1, 999).astype(jnp.int32),
+        "ca_street_name": (_uniform(302, sk, 0, 199)).astype(jnp.int32),
+        "ca_street_type": (sk % 6).astype(jnp.int32),
+        "ca_suite_number": (sk % 50).astype(jnp.int32),
+        "ca_city": (_uniform(303, sk, 0, 199)).astype(jnp.int32),
+        "ca_county": (sk % 10).astype(jnp.int32),
+        "ca_state": (_uniform(304, sk, 0, 9)).astype(jnp.int32),
+        "ca_zip": _uniform(305, sk, 10000, 99999).astype(jnp.int32),
+        "ca_country": jnp.zeros(length, jnp.int32),
+        "ca_gmt_offset": jnp.full(length, -500, jnp.int64),
+        "ca_location_type": (sk % 3).astype(jnp.int32),
+    }
+
+
+def gen_customer_demographics(sf, lo, length, n=0):
+    i = jnp.arange(length, dtype=jnp.int64) + lo
+    sk = i + 1
+    # cross-product decomposition of the demo key (spec: cd is the full cross join)
+    r = i
+    gender = (r % CD_GENDER).astype(jnp.int32); r = r // CD_GENDER
+    marital = (r % CD_MARITAL).astype(jnp.int32); r = r // CD_MARITAL
+    edu = (r % CD_EDU).astype(jnp.int32); r = r // CD_EDU
+    purchase = (r % CD_PURCHASE).astype(jnp.int32); r = r // CD_PURCHASE
+    credit = (r % CD_CREDIT).astype(jnp.int32); r = r // CD_CREDIT
+    dep = (r % CD_DEP).astype(jnp.int32); r = r // CD_DEP
+    emp = (r % CD_EMP).astype(jnp.int32); r = r // CD_EMP
+    coll = (r % CD_COLL).astype(jnp.int32)
+    return {
+        "cd_demo_sk": sk,
+        "cd_gender": gender,
+        "cd_marital_status": marital,
+        "cd_education_status": edu,
+        "cd_purchase_estimate": (purchase + 1) * 500,
+        "cd_credit_rating": credit,
+        "cd_dep_count": dep,
+        "cd_dep_employed_count": emp,
+        "cd_dep_college_count": coll,
+    }
+
+
+def gen_store(sf, lo, length, n=0):
+    i = jnp.arange(length, dtype=jnp.int64) + lo
+    sk = i + 1
+    return {
+        "s_store_sk": sk,
+        "s_store_id": sk,
+        "s_rec_start_date": jnp.full(length, DATE_LO, jnp.int32),
+        "s_rec_end_date": jnp.full(length, DATE_HI, jnp.int32),
+        "s_closed_date_sk": jnp.zeros(length, jnp.int64),
+        "s_store_name": (i % 12).astype(jnp.int32),
+        "s_number_employees": _uniform(401, sk, 200, 300).astype(jnp.int32),
+        "s_floor_space": _uniform(402, sk, 5_000_000, 9_999_999).astype(jnp.int32),
+        "s_hours": (sk % 3).astype(jnp.int32),
+        "s_manager": (_uniform(403, sk, 0, 99)).astype(jnp.int32),
+        "s_market_id": _uniform(404, sk, 1, 10).astype(jnp.int32),
+        "s_geography_class": jnp.zeros(length, jnp.int32),
+        "s_market_desc": (sk % 10).astype(jnp.int32),
+        "s_market_manager": (_uniform(405, sk, 0, 99)).astype(jnp.int32),
+        "s_division_id": jnp.ones(length, jnp.int32),
+        "s_division_name": jnp.zeros(length, jnp.int32),
+        "s_company_id": jnp.ones(length, jnp.int32),
+        "s_company_name": jnp.zeros(length, jnp.int32),
+        "s_street_number": _uniform(406, sk, 1, 999).astype(jnp.int32),
+        "s_street_name": (_uniform(407, sk, 0, 199)).astype(jnp.int32),
+        "s_street_type": (sk % 2).astype(jnp.int32),
+        "s_suite_number": (sk % 50).astype(jnp.int32),
+        "s_city": (_uniform(408, sk, 0, 199)).astype(jnp.int32),
+        "s_county": (sk % 10).astype(jnp.int32),
+        "s_state": (sk % 10).astype(jnp.int32),
+        "s_zip": _uniform(409, sk, 10000, 99999).astype(jnp.int32),
+        "s_country": jnp.zeros(length, jnp.int32),
+        "s_gmt_offset": jnp.full(length, -500, jnp.int64),
+        "s_tax_precentage": _uniform(410, sk, 0, 11),
+    }
+
+
+def gen_promotion(sf, lo, length, n=0):
+    i = jnp.arange(length, dtype=jnp.int64) + lo
+    sk = i + 1
+    n_item = max(int(BASE_ROWS["item"] * sf), 1)
+    start = JULIAN_BASE + _uniform(501, sk, 0, N_DATES - 60)
+    return {
+        "p_promo_sk": sk,
+        "p_promo_id": sk,
+        "p_start_date_sk": start,
+        "p_end_date_sk": start + _uniform(502, sk, 10, 60),
+        "p_item_sk": _uniform(503, sk, 1, n_item),
+        "p_cost": jnp.full(length, 100000, jnp.int64),
+        "p_response_target": jnp.ones(length, jnp.int32),
+        "p_promo_name": (i % 300).astype(jnp.int32),
+        "p_channel_dmail": (sk % 2).astype(jnp.int32),
+        "p_channel_email": ((sk // 2) % 2).astype(jnp.int32),
+        "p_channel_catalog": ((sk // 4) % 2).astype(jnp.int32),
+        "p_channel_tv": ((sk // 8) % 2).astype(jnp.int32),
+        "p_channel_radio": ((sk // 16) % 2).astype(jnp.int32),
+        "p_channel_press": ((sk // 32) % 2).astype(jnp.int32),
+        "p_channel_event": ((sk // 64) % 2).astype(jnp.int32),
+        "p_channel_demo": ((sk // 128) % 2).astype(jnp.int32),
+        "p_channel_details": (i % 300).astype(jnp.int32),
+        "p_purpose": jnp.zeros(length, jnp.int32),
+        "p_discount_active": (sk % 2).astype(jnp.int32),
+    }
+
+
+def gen_store_sales(sf, lo, length, n=0):
+    i = jnp.arange(length, dtype=jnp.int64) + lo
+    n_item = max(int(BASE_ROWS["item"] * sf), 1)
+    n_cust = max(int(BASE_ROWS["customer"] * sf), 1)
+    n_addr = max(int(BASE_ROWS["customer_address"] * sf), 1)
+    n_store = max(int(round(BASE_ROWS["store"] * max(sf, 1 / 12))), 1)
+    n_promo = max(int(BASE_ROWS["promotion"] * max(sf, 1 / 300)), 1)
+    qty = _uniform(601, i, 1, 100).astype(jnp.int32)
+    wholesale = _uniform(602, i, 100, 10000)  # cents
+    markup = _uniform(603, i, 100, 200)  # percent of wholesale
+    list_price = (wholesale * markup) // 100
+    discount = _uniform(604, i, 0, 90)  # percent off list
+    sales_price = (list_price * (100 - discount)) // 100
+    q64 = qty.astype(jnp.int64)
+    ext_list = list_price * q64
+    ext_sales = sales_price * q64
+    ext_wholesale = wholesale * q64
+    ext_discount = ext_list - ext_sales
+    tax = (ext_sales * 8) // 100
+    coupon = jnp.where(_uniform(605, i, 0, 9) == 0, ext_sales // 10, 0)
+    net_paid = ext_sales - coupon
+    return {
+        "ss_sold_date_sk": JULIAN_BASE + _uniform(606, i, 0, N_DATES - 1),
+        "ss_sold_time_sk": _uniform(607, i, 28800, 75600),
+        "ss_item_sk": _uniform(608, i, 1, n_item),
+        "ss_customer_sk": _uniform(609, i, 1, n_cust),
+        "ss_cdemo_sk": _uniform(610, i, 1, CD_ROWS),
+        "ss_hdemo_sk": _uniform(611, i, 1, 7200),
+        "ss_addr_sk": _uniform(612, i, 1, n_addr),
+        "ss_store_sk": _uniform(613, i, 1, n_store),
+        "ss_promo_sk": _uniform(614, i, 1, n_promo),
+        "ss_ticket_number": i // 12 + 1,
+        "ss_quantity": qty,
+        "ss_wholesale_cost": wholesale,
+        "ss_list_price": list_price,
+        "ss_sales_price": sales_price,
+        "ss_ext_discount_amt": ext_discount,
+        "ss_ext_sales_price": ext_sales,
+        "ss_ext_wholesale_cost": ext_wholesale,
+        "ss_ext_list_price": ext_list,
+        "ss_ext_tax": tax,
+        "ss_coupon_amt": coupon,
+        "ss_net_paid": net_paid,
+        "ss_net_paid_inc_tax": net_paid + tax,
+        "ss_net_profit": net_paid - ext_wholesale,
+    }
+
+
+GENERATORS = {
+    "date_dim": gen_date_dim,
+    "item": gen_item,
+    "customer": gen_customer,
+    "customer_address": gen_customer_address,
+    "customer_demographics": gen_customer_demographics,
+    "store": gen_store,
+    "promotion": gen_promotion,
+    "store_sales": gen_store_sales,
+}
+
+_PK = {"date_dim": ("d_date_sk",), "item": ("i_item_sk",),
+       "customer": ("c_customer_sk",), "customer_address": ("ca_address_sk",),
+       "customer_demographics": ("cd_demo_sk",), "store": ("s_store_sk",),
+       "promotion": ("p_promo_sk",)}
+
+_MONOTONE_PK = {"date_dim": "d_date_sk", "item": "i_item_sk",
+                "customer": "c_customer_sk", "customer_address": "ca_address_sk",
+                "customer_demographics": "cd_demo_sk", "store": "s_store_sk",
+                "promotion": "p_promo_sk"}
+
+
+@dataclasses.dataclass(frozen=True)
+class TpcdsSplit:
+    table: str
+    lo: int
+    hi: int
+
+
+class TpcdsConnector:
+    name = "tpcds"
+
+    def __init__(self, sf: float = 1.0, split_rows: int = 1 << 20):
+        self.sf = sf
+        self.split_rows = split_rows
+
+    def tables(self):
+        return sorted(SCHEMAS)
+
+    def schema(self, table: str) -> Schema:
+        return SCHEMAS[table]
+
+    def dictionaries(self, table: str) -> dict:
+        return dict(DICTS[table])
+
+    def primary_key(self, table: str) -> tuple:
+        if table in _PK:
+            return _PK[table]
+        raise KeyError(table)
+
+    def row_count(self, table: str) -> int:
+        if table == "date_dim":
+            return N_DATES
+        if table == "customer_demographics":
+            return CD_ROWS
+        if table == "store":
+            return max(int(round(BASE_ROWS["store"] * max(self.sf, 1 / 12))), 1)
+        if table == "promotion":
+            return max(int(BASE_ROWS["promotion"] * max(self.sf, 1 / 300)), 1)
+        return max(int(BASE_ROWS[table] * self.sf), 1)
+
+    def column_range(self, table: str, column: str):
+        pk = _MONOTONE_PK.get(table)
+        if pk == column:
+            base = JULIAN_BASE if table == "date_dim" else 1
+            off = 0 if table == "date_dim" else -1
+            return (base, base + self.row_count(table) + off - (0 if off else 1))
+        return (None, None)
+
+    def splits(self, table: str, n_hint: int = 0):
+        n = self.row_count(table)
+        step = min(self.split_rows, max(n, 1))
+        nsplits = -(-n // step)
+        return [TpcdsSplit(table, s * step, min((s + 1) * step, n))
+                for s in range(nsplits)]
+
+    def split_range(self, split: TpcdsSplit, column: str):
+        pk = _MONOTONE_PK.get(split.table)
+        if pk == column:
+            base = JULIAN_BASE if split.table == "date_dim" else 1
+            return (base + split.lo, base + split.hi - 1)
+        return None
+
+    def generate(self, split: TpcdsSplit, columns=None) -> Page:
+        schema = SCHEMAS[split.table]
+        names = tuple(columns) if columns is not None else schema.names
+        length = split.hi - split.lo
+        cols = _jit_generate(split.table, self.sf, split.lo, length, names)
+        out_schema = Schema(tuple(schema.field(c) for c in names))
+        return Page(out_schema, cols, tuple(None for _ in cols), None)
+
+
+@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def _jit_generate(table: str, sf: float, lo: int, length: int, names: tuple):
+    all_cols = GENERATORS[table](sf, lo, length)
+    schema = SCHEMAS[table]
+    out = []
+    for c in names:
+        v = all_cols[c]
+        out.append(v.astype(schema.field(c).type.dtype))
+    return tuple(out)
