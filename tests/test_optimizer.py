@@ -1,0 +1,48 @@
+"""Scan column pruning (reference: PruneTableScanColumns rule)."""
+
+from trino_tpu import Engine
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.sql import plan as P
+from trino_tpu.sql.frontend import compile_sql
+
+
+def _scans(node, out):
+    if isinstance(node, P.TableScan):
+        out.append(node)
+    for c in node.children:
+        _scans(c, out)
+
+
+def test_q1_scan_reads_only_referenced_columns():
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.001, split_rows=1 << 11))
+    s = e.create_session("tpch")
+    plan = compile_sql("""
+        select l_returnflag, l_linestatus, sum(l_quantity), count(*)
+        from lineitem where l_shipdate <= date '1998-09-02'
+        group by l_returnflag, l_linestatus order by 1, 2""", e, s)
+    scans = []
+    _scans(plan, scans)
+    assert len(scans) == 1
+    assert set(scans[0].columns) == {"l_returnflag", "l_linestatus", "l_quantity",
+                                     "l_shipdate"}
+    # and the result is still right
+    r = e.execute_sql("""select l_returnflag, l_linestatus, sum(l_quantity), count(*)
+        from lineitem where l_shipdate <= date '1998-09-02'
+        group by l_returnflag, l_linestatus order by 1, 2""", s).rows()
+    assert len(r) >= 3 and all(len(row) == 4 for row in r)
+
+
+def test_join_query_prunes_each_side():
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.001, split_rows=1 << 11))
+    s = e.create_session("tpch")
+    plan = compile_sql("""
+        select o_orderpriority, count(*) from orders, customer
+        where o_custkey = c_custkey and c_acctbal > 0
+        group by o_orderpriority order by 1""", e, s)
+    scans = []
+    _scans(plan, scans)
+    by_table = {sc.table: set(sc.columns) for sc in scans}
+    assert by_table["orders"] <= {"o_custkey", "o_orderpriority"}
+    assert by_table["customer"] <= {"c_custkey", "c_acctbal"}

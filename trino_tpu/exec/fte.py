@@ -265,7 +265,7 @@ class FaultTolerantExecutor:
                                          acc_specs)
             for split in task.splits:
                 page = si.conn.generate(split, list(si.scan_columns))
-                state = step(state, page)
+                state = step(state, page, stream.aux)
             if not bool(state.overflow):
                 break
             capacity *= 4

@@ -76,21 +76,29 @@ class _ScanInfo:
 
 @dataclasses.dataclass
 class _Stream:
-    """A streaming pipeline segment: a source of raw pages + a fused transform."""
+    """A streaming pipeline segment: a source of raw pages + a fused transform.
+
+    ``aux`` carries the segment's device-resident state (join tables, build
+    columns) and is passed to the transform as a JIT ARGUMENT.  It must never be
+    closed over: an executable with a large embedded constant degrades EVERY
+    subsequent dispatch in the session (~70ms/call measured on tunneled TPU —
+    the single biggest perf cliff found in this engine)."""
 
     schema: Schema
     dicts: tuple  # Dictionary|None per channel
     pages: Callable  # () -> iterator of raw source Pages
-    transform: Callable  # (cols, nulls, valid) -> (cols, nulls, valid); jit-traceable
+    transform: Callable  # (cols, nulls, valid, aux) -> (cols, nulls, valid)
     scan_info: Optional[_ScanInfo] = None
+    aux: tuple = ()  # pytree of device state threaded through jit as an argument
     _jitted: Callable = None  # cached jit of transform applied to a Page
 
     def jitted(self):
         """Jit-compiled page->(cols,nulls,valid) function, cached on the stream so
         repeated executions of a cached plan reuse the XLA executable."""
         if self._jitted is None:
-            self._jitted = jax.jit(lambda page: self.transform(
-                page.columns, page.null_masks, page.valid_mask()))
+            f = jax.jit(lambda page, aux: self.transform(
+                page.columns, page.null_masks, page.valid_mask(), aux))
+            self._jitted = lambda page: f(page, self.aux)
         return self._jitted
 
 
@@ -195,19 +203,19 @@ class LocalExecutor:
                     yield conn.generate(s, node.columns)
 
             si = _ScanInfo(conn, splits, tuple(node.columns), tuple(node.columns))
-            return _Stream(node.schema, dicts, pages, lambda c, n, v: (c, n, v), si)
+            return _Stream(node.schema, dicts, pages, lambda c, n, v, aux: (c, n, v), si)
 
         if isinstance(node, P.Filter):
             up = self._compile_stream(node.child)
             pred = node.predicate
 
-            def transform(cols, nulls, valid, up=up, pred=pred):
-                cols, nulls, valid = up.transform(cols, nulls, valid)
+            def transform(cols, nulls, valid, aux, up=up, pred=pred):
+                cols, nulls, valid = up.transform(cols, nulls, valid, aux)
                 return cols, nulls, evaluate_predicate(pred, cols, nulls, valid)
 
             pruned = _static_pruned_stream(up, pred)
             pages, si = pruned if pruned is not None else (up.pages, up.scan_info)
-            return _Stream(up.schema, up.dicts, pages, transform, si)
+            return _Stream(up.schema, up.dicts, pages, transform, si, aux=up.aux)
 
         if isinstance(node, P.Project):
             up = self._compile_stream(node.child)
@@ -218,8 +226,8 @@ class LocalExecutor:
                 for pd, e in zip(planner_dicts, node.exprs)
             )
 
-            def transform(cols, nulls, valid, up=up, exprs=node.exprs):
-                cols, nulls, valid = up.transform(cols, nulls, valid)
+            def transform(cols, nulls, valid, aux, up=up, exprs=node.exprs):
+                cols, nulls, valid = up.transform(cols, nulls, valid, aux)
                 out = [evaluate(e, cols, nulls) for e in exprs]
                 # constant expressions evaluate to scalars: broadcast to row count so
                 # downstream consumers (join keys, exchanges) see real columns
@@ -235,7 +243,7 @@ class LocalExecutor:
                 si = dataclasses.replace(up.scan_info, columns=tuple(
                     up.scan_info.columns[e.index] if isinstance(e, FieldRef) else None
                     for e in node.exprs))
-            return _Stream(node.schema, dicts, up.pages, transform, si)
+            return _Stream(node.schema, dicts, up.pages, transform, si, aux=up.aux)
 
         if isinstance(node, P.Join):
             return self._compile_join(node)
@@ -251,12 +259,12 @@ class LocalExecutor:
                         yield Page(node.schema, cols, nulls, valid)
 
             dicts = subs[0].dicts
-            return _Stream(node.schema, dicts, pages, lambda c, n, v: (c, n, v))
+            return _Stream(node.schema, dicts, pages, lambda c, n, v, aux: (c, n, v))
 
         if isinstance(node, P.Values):
             page = _values_page(node)
             return _Stream(node.schema, tuple(None for _ in node.schema.fields),
-                           lambda: iter([page]), lambda c, n, v: (c, n, v))
+                           lambda: iter([page]), lambda c, n, v, aux: (c, n, v))
 
         if isinstance(node, (P.Aggregate, P.Sort, P.Limit, P.Output, P.Window)):
             # blocking sub-plan feeding a streaming consumer: run it, emit its one
@@ -273,7 +281,7 @@ class LocalExecutor:
                     pg, _ = self._execute_to_page(node)
                     yield pg
 
-            return _Stream(node.schema, dicts, pages, lambda c, n, v: (c, n, v))
+            return _Stream(node.schema, dicts, pages, lambda c, n, v, aux: (c, n, v))
 
         raise NotImplementedError(f"node {type(node).__name__}")
 
@@ -295,10 +303,10 @@ class LocalExecutor:
                 acc_kinds.append(kind)
 
         @jax.jit
-        def step(state, page, stream=stream, node=node, key_types=key_types,
+        def step(state, page, aux, stream=stream, node=node, key_types=key_types,
                  acc_exprs=acc_exprs, acc_kinds=acc_kinds):
             cols, nulls, valid = stream.transform(
-                page.columns, page.null_masks, page.valid_mask()
+                page.columns, page.null_masks, page.valid_mask(), aux
             )
             key_vals = tuple(cols[i] for i in node.keys)
             key_nulls = tuple(nulls[i] for i in node.keys)
@@ -349,10 +357,10 @@ class LocalExecutor:
             return hit[1]
 
         @jax.jit
-        def dstep(state, page, stream=stream, node=node, cfg=cfg,
+        def dstep(state, page, aux, stream=stream, node=node, cfg=cfg,
                   acc_exprs=acc_exprs, acc_kinds=acc_kinds):
             cols, nulls, valid = stream.transform(
-                page.columns, page.null_masks, page.valid_mask()
+                page.columns, page.null_masks, page.valid_mask(), aux
             )
             key_vals = tuple(cols[i] for i in node.keys)
             key_nulls = tuple(nulls[i] for i in node.keys)
@@ -383,29 +391,41 @@ class LocalExecutor:
             key_ranges = self._key_ranges(stream, node)
             if all(r is not None for r in key_ranges):
                 _, onulls, _ = jax.eval_shape(
-                    lambda c, n, v: stream.transform(c, n, v),
-                    first.columns, first.null_masks, first.valid_mask())
+                    lambda c, n, v, aux: stream.transform(c, n, v, aux),
+                    first.columns, first.null_masks, first.valid_mask(),
+                    stream.aux)
                 key_nullable = tuple(onulls[i] is not None for i in node.keys)
                 cfg = hashagg.direct_config(key_ranges, key_nullable)
-            if cfg is None and node.capacity is None:
-                # hash mode: size the initial table from the key-range product and
-                # the input row bound so huge group counts don't crawl through
-                # grow-by-4x retries, each a fresh compile (reference: stats-driven
-                # GroupByHash expectedSize)
-                est = 1
+            if cfg is None and not node.capacity:
+                # hash mode: size the initial table from the key-range product
+                # and/or the input row bound so huge group counts don't crawl
+                # through grow-by-4x retries, each a full re-stream (reference:
+                # stats-driven GroupByHash expectedSize).  Estimates saturate —
+                # an overflowing product still sizes to the cap.
+                est = None
+                prod = 1
                 for r in key_ranges:
-                    if r is None or est > MAX_GROUP_CAPACITY:
+                    if r is None:
+                        prod = None
                         break
-                    est *= max(int(r[1]) - int(r[0]) + 1, 1)
-                else:
-                    si = stream.scan_info
-                    if si is not None and si.splits \
-                            and hasattr(si.conn, "row_count") \
-                            and hasattr(si.splits[0], "table"):
-                        est = min(est, int(si.conn.row_count(si.splits[0].table)))
+                    prod = min(prod * max(int(r[1]) - int(r[0]) + 1, 1),
+                               MAX_GROUP_CAPACITY)
+                if prod is not None:
+                    est = prod
+                si = stream.scan_info
+                if si is not None and si.splits \
+                        and hasattr(si.conn, "row_count") \
+                        and hasattr(si.splits[0], "table"):
+                    bound = int(si.conn.row_count(si.splits[0].table))
+                    est = bound if est is None else min(est, bound)
+                if est is not None:
+                    # cap the stats-derived size: estimates overshoot true NDV
+                    # (post-filter group counts are unknown); growth-on-overflow
+                    # covers undershoots
+                    # modest cap: in-loop rehash makes undershoot cheap, while an
+                    # oversized table costs a long cold compile
                     target = 1 << max(2 * est - 1, 1).bit_length()
-                    capacity = max(capacity,
-                                   min(target, MAX_GROUP_CAPACITY))
+                    capacity = max(capacity, min(target, 1 << 20))
         pages_once = itertools.chain([first], page_iter) if first is not None else ()
 
         # memory gate: group-by state is device-resident; if it cannot fit the
@@ -417,11 +437,11 @@ class LocalExecutor:
         if cfg is not None and not self.memory_pool.try_reserve(
                 state_bytes(cfg.capacity), "group-by"):
             cfg = None  # direct table too large: try the (smaller) hash table
-        reserved = 0 if cfg is None else state_bytes(cfg.capacity)
+        resv = {"bytes": 0 if cfg is None else state_bytes(cfg.capacity)}
         if cfg is None:
             if not self.memory_pool.try_reserve(state_bytes(capacity), "group-by"):
                 return self._run_aggregate_partitioned(node, parts=4)
-            reserved = state_bytes(capacity)
+            resv = {"bytes": state_bytes(capacity)}
 
         try:
             while True:
@@ -431,7 +451,7 @@ class LocalExecutor:
                     dstep = self._direct_step(node, cfg, stream, key_types, acc_exprs,
                                               acc_kinds)
                     for page in pages_once:
-                        state = dstep(state, page)
+                        state = dstep(state, page, stream.aux)
                     if not bool(state.overflow):
                         break
                     # stale stats put keys out of range: hash mode
@@ -446,25 +466,117 @@ class LocalExecutor:
                 state = hashagg.groupby_init(
                     capacity, tuple(t.dtype for t in key_types), acc_specs
                 )
-                for page in pages_once:
-                    state = step(state, page)
+                state = self._run_hash_inserts(node, stream, key_types, acc_exprs,
+                                               acc_kinds, state, pages_once,
+                                               state_bytes, resv)
+                # growth happens INSIDE the insert loop (snapshot + rehash + chunk
+                # replay); a still-set overflow means the capacity/memory ceiling:
+                # fall back to partitioned passes (the HBM analog of the
+                # reference's SpillableHashAggregationBuilder)
                 if not bool(state.overflow):
                     break
-                grown = capacity * 4
-                if capacity >= MAX_GROUP_CAPACITY or not self.memory_pool.try_reserve(
-                        state_bytes(grown) - state_bytes(capacity), "group-by"):
-                    # group count exceeds the device-memory/capacity ceiling: fall
-                    # back to partitioned passes (the HBM analog of the reference's
-                    # SpillableHashAggregationBuilder — re-stream per key partition
-                    # instead of spilling state to disk)
-                    return self._run_aggregate_partitioned(node, parts=4)
-                reserved += state_bytes(grown) - state_bytes(capacity)
-                capacity = grown  # next capacity bucket (reference: FlatHash#rehash)
-                pages_once = stream.pages()
+                return self._run_aggregate_partitioned(node, parts=4)
 
             return self._finalize_groups(node, stream, state)
         finally:
-            self.memory_pool.free(reserved, "group-by")
+            self.memory_pool.free(resv["bytes"], "group-by")
+
+    def _run_hash_inserts(self, node, stream, key_types, acc_exprs, acc_kinds,
+                          state, pages_iter, state_bytes, resv):
+        """Insert a page stream into hash-mode group-by state, compacting live
+        rows first when pages are sparse.  TPU scatters cost by page WIDTH (sink
+        writes included), so a 5%-selective filter over a 4M-row page pays 20x
+        the scatter it needs — compact with a cheap gather, then scatter at the
+        live-row bucket (reference analog: SelectedPositions feeding the
+        aggregator, operator/project/SelectedPositions.java).  Live-row counts
+        sync to the host in CHUNKS: on tunneled devices every sync costs an RTT."""
+        arts = self._agg_cache.get(("hashpage", id(node)))
+        if arts is None:
+            @jax.jit
+            def prepare(page, aux, stream=stream, node=node, acc_exprs=acc_exprs):
+                cols, nulls, valid = stream.transform(
+                    page.columns, page.null_masks, page.valid_mask(), aux)
+                keys = tuple(cols[i] for i in node.keys)
+                knulls = tuple(nulls[i] for i in node.keys)
+                inputs = tuple((None, None) if e is None else evaluate(e, cols, nulls)
+                               for e in acc_exprs)
+                return keys, knulls, inputs, valid, jnp.sum(valid, dtype=jnp.int32)
+
+            @jax.jit
+            def insert_compact(state, keys, knulls, inputs, n, key_types=key_types,
+                               acc_kinds=acc_kinds):
+                valid = jnp.arange(keys[0].shape[0], dtype=jnp.int32) < n
+                return hashagg.groupby_insert(state, keys, key_types, valid, inputs,
+                                              acc_kinds, knulls)
+
+            @jax.jit
+            def insert_masked(state, keys, knulls, inputs, valid,
+                              key_types=key_types, acc_kinds=acc_kinds):
+                return hashagg.groupby_insert(state, keys, key_types, valid, inputs,
+                                              acc_kinds, knulls)
+
+            arts = (node, prepare, insert_compact, insert_masked)
+            self._agg_cache[("hashpage", id(node))] = arts
+        _, prepare, insert_compact, insert_masked = arts
+        staged: list = []
+
+        def insert_chunk(state, counts):
+            for (keys, knulls, inputs, valid, _), n in zip(staged, counts):
+                if n == 0:
+                    continue
+                width = valid.shape[0]
+                bucket = max(1 << max(n - 1, 1).bit_length(), 1024)
+                if bucket * 2 >= width:
+                    # dense page: compaction would not shrink it meaningfully
+                    state = insert_masked(state, keys, knulls, inputs, valid)
+                    continue
+                cols_list = list(keys) + [v for v, _ in inputs if v is not None]
+                nulls_list = list(knulls) + [nu for v, nu in inputs if v is not None]
+                ccols, cnulls = _compact_part(tuple(cols_list), tuple(nulls_list),
+                                              valid, bucket)
+                nk = len(keys)
+                rest_v, rest_n = list(ccols[nk:]), list(cnulls[nk:])
+                cinputs = []
+                for v, nu in inputs:
+                    if v is None:
+                        cinputs.append((None, None))
+                    else:
+                        cinputs.append((rest_v.pop(0), rest_n.pop(0)))
+                state = insert_compact(state, ccols[:nk], cnulls[:nk],
+                                       tuple(cinputs), jnp.int32(n))
+            return state
+
+        def drain(state):
+            if not staged:
+                return state, False
+            counts = [int(c) for c in _host([st[-1] for st in staged])]
+            while True:
+                # snapshot-and-replay growth (reference: FlatHash#rehash): jax
+                # arrays are immutable, so the pre-chunk state is a free snapshot;
+                # on overflow, rehash it into a 4x table and replay ONLY this
+                # chunk — never the whole input stream
+                start_state = state
+                state = insert_chunk(state, counts)
+                if not bool(state.overflow):
+                    staged.clear()
+                    return state, False
+                grown = start_state.capacity * 4
+                delta = state_bytes(grown) - state_bytes(start_state.capacity)
+                if grown > MAX_GROUP_CAPACITY or not self.memory_pool.try_reserve(
+                        delta, "group-by"):
+                    staged.clear()
+                    return state, True  # ceiling: caller falls back to partitioned
+                resv["bytes"] += delta
+                state = hashagg.rehash(start_state, grown, tuple(acc_kinds))
+
+        for page in pages_iter:
+            staged.append(prepare(page, stream.aux))
+            if len(staged) >= 4:
+                state, ceiling = drain(state)
+                if ceiling:
+                    return state
+        state, _ = drain(state)
+        return state
 
     def _finalize_groups(self, node: P.Aggregate, stream, state):
         # compact occupied groups ON DEVICE before any host transfer: the table is
@@ -498,10 +610,10 @@ class LocalExecutor:
         stream, key_types, acc_specs, acc_exprs, acc_kinds, _ = self._agg_compiled(node)
 
         @jax.jit
-        def pstep(state, page, p, stream=stream, node=node, key_types=key_types,
+        def pstep(state, page, p, aux, stream=stream, node=node, key_types=key_types,
                   acc_exprs=acc_exprs, acc_kinds=acc_kinds, parts=parts):
             cols, nulls, valid = stream.transform(
-                page.columns, page.null_masks, page.valid_mask())
+                page.columns, page.null_masks, page.valid_mask(), aux)
             key_vals = tuple(cols[i] for i in node.keys)
             key_nulls = tuple(nulls[i] for i in node.keys)
             # canonicalize NULL key lanes before hashing, exactly like groupby_insert:
@@ -522,7 +634,7 @@ class LocalExecutor:
                 state = hashagg.groupby_init(
                     capacity, tuple(t.dtype for t in key_types), acc_specs)
                 for page in stream.pages():
-                    state = pstep(state, page, jnp.int32(p))
+                    state = pstep(state, page, jnp.int32(p), stream.aux)
                 if not bool(state.overflow):
                     break
                 if capacity >= MAX_GROUP_CAPACITY:
@@ -555,8 +667,10 @@ class LocalExecutor:
             return self._finish_global(node, stream, acc_exprs, acc_kinds, step)
 
         @jax.jit
-        def step(state, page, stream=stream, acc_exprs=acc_exprs, acc_kinds=acc_kinds):
-            cols, nulls, valid = stream.transform(page.columns, page.null_masks, page.valid_mask())
+        def step(state, page, aux, stream=stream, acc_exprs=acc_exprs,
+                 acc_kinds=acc_kinds):
+            cols, nulls, valid = stream.transform(page.columns, page.null_masks,
+                                                  page.valid_mask(), aux)
             out = []
             for st, e, kind in zip(state, acc_exprs, acc_kinds):
                 if kind == "count_star":
@@ -598,7 +712,7 @@ class LocalExecutor:
             for st, (kind, dtype, _) in zip(state, acc_specs)
         )
         for page in stream.pages():
-            state = step(state, page)
+            state = step(state, page, stream.aux)
         acc_cols = [np.asarray(s)[None] for s in state]
         out_cols = _finalize_aggs(node.aggs, acc_cols, 1)
         arrays = [jnp.asarray(c) for c in out_cols]
@@ -683,8 +797,9 @@ class LocalExecutor:
             return self._compile_multi_join(node, build_page, build_dicts, probe_stream,
                                             build_key_types, span)
 
-        def transform(cols, nulls, valid, up=probe_stream, node=node, table=table):
-            cols, nulls, valid = up.transform(cols, nulls, valid)
+        def transform(cols, nulls, valid, aux, up=probe_stream, node=node):
+            up_aux, table = aux
+            cols, nulls, valid = up.transform(cols, nulls, valid, up_aux)
             keys = tuple(cols[i] for i in node.left_keys)
             if isinstance(table, DirectJoinTable):
                 row_ids, matched = direct_probe(table, keys[0], valid)
@@ -710,7 +825,16 @@ class LocalExecutor:
 
         dicts = (probe_stream.dicts if semi
                  else probe_stream.dicts + build_dicts)
-        return _Stream(node.schema, dicts, probe_stream.pages, transform)
+        # propagate probe-side scan provenance: downstream aggregations use it for
+        # row-bound table sizing, and further joins for dynamic split pruning
+        si = None
+        if probe_stream.scan_info is not None:
+            n_build = 0 if semi else len(build_page.columns)
+            si = dataclasses.replace(
+                probe_stream.scan_info,
+                columns=tuple(probe_stream.scan_info.columns) + (None,) * n_build)
+        return _Stream(node.schema, dicts, probe_stream.pages, transform, si,
+                       aux=(probe_stream.aux, table))
 
     def _compile_multi_join(self, node: P.Join, build_page, build_dicts, probe_stream,
                             build_key_types, span=None) -> _Stream:
@@ -736,9 +860,9 @@ class LocalExecutor:
             mt = multi_build(capacity, build_page, node.right_keys, build_key_types)
 
         @jax.jit
-        def count_step(page, mt, up=probe_stream, node=node):
+        def count_step(page, mt, up_aux, up=probe_stream, node=node):
             cols, nulls, valid = up.transform(page.columns, page.null_masks,
-                                              page.valid_mask())
+                                              page.valid_mask(), up_aux)
             keys = tuple(cols[i] for i in node.left_keys)
             kvalid = valid
             for i in node.left_keys:
@@ -793,7 +917,8 @@ class LocalExecutor:
 
         def pages(probe_stream=probe_stream):
             for page in probe_stream.pages():
-                cols, nulls, valid, slot, matched, cnt, out_cnt, incl = count_step(page, mt)
+                cols, nulls, valid, slot, matched, cnt, out_cnt, incl = \
+                    count_step(page, mt, probe_stream.aux)
                 if semi and node.filter is None:
                     if node.kind == "semi":
                         v = valid & matched
@@ -815,7 +940,7 @@ class LocalExecutor:
                     yield Page(node.schema, ocols, onulls, ovalid)
 
         dicts = (probe_stream.dicts if semi else probe_stream.dicts + build_dicts)
-        return _Stream(node.schema, dicts, pages, lambda c, n, v: (c, n, v))
+        return _Stream(node.schema, dicts, pages, lambda c, n, v, aux: (c, n, v))
 
     def _compile_partitioned_local_join(self, node: P.Join, build_page, build_dicts,
                                         probe_stream, build_key_types,
@@ -849,8 +974,8 @@ class LocalExecutor:
                         jnp.arange(bucket) < n)
 
         def probe_part(p: int) -> _Stream:
-            def transform(cols, nulls, valid, up=probe_stream, node=node, p=p):
-                cols, nulls, valid = up.transform(cols, nulls, valid)
+            def transform(cols, nulls, valid, aux, up=probe_stream, node=node, p=p):
+                cols, nulls, valid = up.transform(cols, nulls, valid, aux)
                 keys = tuple(cols[i] for i in node.left_keys)
                 knulls = tuple(nulls[i] for i in node.left_keys)
                 rt = tuple(kv if kn is None
@@ -859,7 +984,7 @@ class LocalExecutor:
                 return cols, nulls, valid & (partition_ids(rt, parts) == p)
 
             return _Stream(probe_stream.schema, probe_stream.dicts,
-                           probe_stream.pages, transform)
+                           probe_stream.pages, transform, aux=probe_stream.aux)
 
         def pages(self=self, node=node):
             for p in range(parts):
@@ -872,7 +997,7 @@ class LocalExecutor:
 
         semi = node.kind in ("semi", "anti")
         dicts = probe_stream.dicts if semi else probe_stream.dicts + build_dicts
-        return _Stream(node.schema, dicts, pages, lambda c, n, v: (c, n, v))
+        return _Stream(node.schema, dicts, pages, lambda c, n, v, aux: (c, n, v))
 
     def _execute_to_page_streamed(self, node):
         """Materialize a sub-plan into one device page (join build side)."""
@@ -1041,18 +1166,41 @@ def _concat_stream(stream: _Stream) -> Page:
     if not parts:
         cols = tuple(jnp.zeros((0,), f.type.dtype) for f in stream.schema.fields)
         return Page(stream.schema, cols, tuple(None for _ in cols), None)
+    # ONE jitted dispatch for the whole multi-column concat: on tunneled devices a
+    # host sync anywhere in the session makes every dispatch pay an RTT, so
+    # column-by-column top-level concats are ~70ms each
     ncols = len(parts[0][0])
+    has_null = tuple(any(cnulls[ci] is not None for _, cnulls, _ in parts)
+                     for ci in range(ncols))
+    ns = jnp.asarray([n for _, _, n in parts], jnp.int32)
+    cols_out, nulls_out, valid = _concat_all(
+        tuple((ccols, cnulls) for ccols, cnulls, _ in parts), ns, has_null)
+    return Page(stream.schema, cols_out, nulls_out, valid)
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _concat_all(part_arrays, ns, has_null):
+    """ONE dispatch for the whole multi-column concat (on tunneled devices every
+    dispatch pays an RTT once any host sync has happened in the session).  Parts
+    keep their pow2 bucket shapes — live-row counts stay TRACED (a validity mask
+    marks the tail padding), so the executable caches per bucket-shape
+    combination instead of recompiling per exact row count."""
     cols_out, nulls_out = [], []
+    ncols = len(part_arrays[0][0])
     for ci in range(ncols):
-        cols_out.append(jnp.concatenate([ccols[ci][:n] for ccols, _, n in parts]))
-        if any(cnulls[ci] is not None for _, cnulls, _ in parts):
-            nulls_out.append(jnp.concatenate([
-                (cnulls[ci] if cnulls[ci] is not None
-                 else jnp.zeros((ccols[ci].shape[0],), bool))[:n]
-                for ccols, cnulls, n in parts]))
+        cols_out.append(jnp.concatenate(
+            [ccols[ci] for (ccols, cnulls) in part_arrays]))
+        if has_null[ci]:
+            nulls_out.append(jnp.concatenate(
+                [(cnulls[ci] if cnulls[ci] is not None
+                  else jnp.zeros((ccols[ci].shape[0],), bool))
+                 for (ccols, cnulls) in part_arrays]))
         else:
             nulls_out.append(None)
-    return Page(stream.schema, tuple(cols_out), tuple(nulls_out), None)
+    valid = jnp.concatenate(
+        [jnp.arange(part[0][0].shape[0], dtype=jnp.int32) < ns[i]
+         for i, part in enumerate(part_arrays)])
+    return tuple(cols_out), tuple(nulls_out), valid
 
 
 def _static_pruned_stream(up: _Stream, pred):

@@ -371,6 +371,63 @@ AGG_INITS = {
 }
 
 
+_REHASH_KIND = {"sum": "sum", "count": "sum", "count_star": "sum",
+                "min": "min", "max": "max", "sum_sq": "sum"}
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def rehash(state: GroupByState, new_capacity: int, acc_kinds: tuple = ()) -> GroupByState:
+    """Re-insert every occupied entry into a larger table (reference:
+    FlatHash#rehash).  Accumulators re-insert as partial values (count -> sum).
+    Keeps growth at one table-sized pass instead of re-streaming the input."""
+    C = state.capacity
+    occupied = state.table[:C] != EMPTY_KEY
+    keys = tuple(k[:C] for k in state.key_cols)
+    knulls = tuple(kn[:C] for kn in state.key_nulls)
+    accs = tuple(a[:C] for a in state.accs)
+    fresh = GroupByState(
+        table=jnp.full((new_capacity + 1,), EMPTY_KEY, dtype=jnp.int64),
+        key_cols=tuple(jnp.zeros((new_capacity + 1,), k.dtype) for k in state.key_cols),
+        key_nulls=tuple(jnp.zeros((new_capacity + 1,), bool) for _ in state.key_nulls),
+        accs=tuple(jnp.full((new_capacity + 1,), _init_for(kind, a.dtype), a.dtype)
+                   for kind, a in zip(acc_kinds, state.accs)),
+        overflow=jnp.zeros((), bool),
+    )
+    key_types = tuple(_DTYPE_KEY_TYPE(k.dtype) for k in keys)
+    merge = [_REHASH_KIND[k] for k in acc_kinds]
+    return groupby_insert(fresh, keys, key_types, occupied,
+                          [(a, None) for a in accs], merge, knulls)
+
+
+def _init_for(kind: str, dtype):
+    if kind == "min":
+        return _extreme(dtype, +1)
+    if kind == "max":
+        return _extreme(dtype, -1)
+    return 0
+
+
+class _KT:
+    """Minimal Type stand-in for rehash key packing.  pack_keys reads only
+    `.name` (bit width class) and dtype-driven conversion, so mapping the
+    stored dtype back to its widest type class reproduces the original packed
+    layout exactly (int64 -> 64-bit path, int32/date/dict ids -> 32, ...)."""
+
+    _NAMES = {"int64": "bigint", "int32": "integer", "int16": "smallint",
+              "int8": "tinyint", "bool": "boolean", "float64": "double",
+              "float32": "real"}
+
+    def __init__(self, dtype):
+        self.dtype = dtype
+        self.name = self._NAMES.get(np.dtype(dtype).name, "bigint")
+        self.is_string = False
+        self.is_floating = np.issubdtype(np.dtype(dtype), np.floating)
+
+
+def _DTYPE_KEY_TYPE(dtype):
+    return _KT(dtype)
+
+
 def agg_finalize(state: GroupByState):
     """Returns (group_valid[capacity] bool, key_cols, accs) with the overflow sink dropped."""
     C = state.capacity

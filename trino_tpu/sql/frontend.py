@@ -103,7 +103,9 @@ class Planner:
                 node = P.Sort(node, tuple(keys))
             if q.limit is not None:
                 node = P.Limit(node, q.limit)
-            return P.Output(node, tuple(out_names))
+            from .optimizer import prune_columns
+
+            return prune_columns(P.Output(node, tuple(out_names)))
         finally:
             self.ctes = saved
 

@@ -1,0 +1,186 @@
+"""Plan rewrites that run after logical planning.
+
+Reference: the iterative optimizer's column-pruning rules
+(sql/planner/iterative/rule/PruneTableScanColumns.java, PruneProjectionColumns,
+PruneJoinColumns, ...) — every node should produce only the channels its
+consumers reference.  On this engine the win is direct compute: generator
+connectors synthesize every requested column on device and file connectors
+decode them, so unreferenced columns cost real kernel time (the reference
+mostly saves IO).
+
+`prune_columns(root)` propagates required channel sets top-down and returns a
+rewritten tree with scans narrowed and FieldRef indices remapped.  Nodes whose
+channel algebra isn't modeled (Window, Values, set operations with computed
+dictionaries...) conservatively require everything below them — correct, just
+unpruned.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from . import ir
+from . import plan as P
+from ..page import Schema
+
+__all__ = ["prune_columns"]
+
+
+def _expr_channels(expr, out: set) -> None:
+    if isinstance(expr, ir.FieldRef):
+        out.add(expr.index)
+    elif isinstance(expr, ir.Call):
+        for a in expr.args:
+            _expr_channels(a, out)
+
+
+def _remap_expr(expr, mapping: dict):
+    if isinstance(expr, ir.FieldRef):
+        return dataclasses.replace(expr, index=mapping[expr.index])
+    if isinstance(expr, ir.Call):
+        return dataclasses.replace(
+            expr, args=tuple(_remap_expr(a, mapping) for a in expr.args))
+    return expr
+
+
+def prune_columns(root: P.PlanNode) -> P.PlanNode:
+    node, mapping = _prune(root, None)
+    return node
+
+
+def _identity(node):
+    """(node, no mapping) — children keep their full layout (required=all), but
+    deeper prunable chains still shrink inside them."""
+    kids = node.children
+    if kids:
+        node = _replace_children(node, tuple(_prune(c, None)[0] for c in kids))
+    return node, None
+
+
+def _prune(node: P.PlanNode, required):
+    """required: set of needed output channels of ``node`` (None = all).
+    Returns (new_node, mapping old_channel -> new_channel or None for identity)."""
+    n_out = len(node.schema.fields)
+    if required is None:
+        required = set(range(n_out))
+
+    if isinstance(node, P.Output):
+        child_req = set(range(len(node.names)))
+        child, m = _prune(node.child, _closed(node.child, child_req))
+        # Output renames the first len(names) child channels; pruning keeps
+        # relative order, so names still line up
+        return dataclasses.replace(node, child=child), None
+
+    if isinstance(node, P.Sort):
+        child_req = set(required) | {k.channel for k in node.keys}
+        child, m = _prune(node.child, _closed(node.child, child_req))
+        if m:
+            keys = tuple(dataclasses.replace(k, channel=m[k.channel])
+                         for k in node.keys)
+            return P.Sort(child, keys), m
+        return P.Sort(child, node.keys), m
+
+    if isinstance(node, P.Limit):
+        child, m = _prune(node.child, _closed(node.child, set(required)))
+        return dataclasses.replace(node, child=child), m
+
+    if isinstance(node, P.Filter):
+        child_req = set(required)
+        _expr_channels(node.predicate, child_req)
+        child, m = _prune(node.child, _closed(node.child, child_req))
+        pred = _remap_expr(node.predicate, m) if m else node.predicate
+        return P.Filter(child, pred), m
+
+    if isinstance(node, P.Project):
+        keep = sorted(required)
+        child_req: set = set()
+        for i in keep:
+            _expr_channels(node.exprs[i], child_req)
+        child, m = _prune(node.child, _closed(node.child, child_req))
+        cm = m or {}
+        exprs = tuple(_remap_expr(node.exprs[i], cm) if cm else node.exprs[i]
+                      for i in keep)
+        dicts = (tuple(node.dicts[i] for i in keep) if node.dicts else None)
+        schema = Schema(tuple(node.schema.fields[i] for i in keep))
+        mapping = {old: new for new, old in enumerate(keep)}
+        if len(keep) == n_out:
+            mapping = None
+        return P.Project(child, exprs, schema, dicts), mapping
+
+    if isinstance(node, P.TableScan):
+        keep = sorted(required)
+        if len(keep) == n_out or not keep:
+            return node, None
+        scan = P.TableScan(node.catalog, node.table,
+                           tuple(node.columns[i] for i in keep),
+                           Schema(tuple(node.schema.fields[i] for i in keep)))
+        return scan, {old: new for new, old in enumerate(keep)}
+
+    if isinstance(node, P.Aggregate):
+        # outputs stay intact (keys + agg layout is load-bearing); prune below
+        child_req: set = set(node.keys)
+        for spec in node.aggs:
+            if spec.arg is not None:
+                _expr_channels(spec.arg, child_req)
+        child, m = _prune(node.child, _closed(node.child, child_req))
+        if m:
+            keys = tuple(m[k] for k in node.keys)
+            aggs = tuple(
+                spec if spec.arg is None
+                else dataclasses.replace(spec, arg=_remap_expr(spec.arg, m))
+                for spec in node.aggs)
+            return dataclasses.replace(node, child=child, keys=keys, aggs=aggs), None
+        return dataclasses.replace(node, child=child), None
+
+    if isinstance(node, P.Join):
+        semi = node.kind in ("semi", "anti")
+        n_left = len(node.left.schema.fields)
+        left_req = {c for c in required if c < n_left} | set(node.left_keys)
+        right_req = (set() if semi else
+                     {c - n_left for c in required if c >= n_left})
+        right_req |= set(node.right_keys)
+        if node.filter is not None:
+            fch: set = set()
+            _expr_channels(node.filter, fch)
+            left_req |= {c for c in fch if c < n_left}
+            right_req |= {c - n_left for c in fch if c >= n_left}
+        left, lm = _prune(node.left, _closed(node.left, left_req))
+        right, rm = _prune(node.right, _closed(node.right, right_req))
+        n_right = len(node.right.schema.fields)
+        lmf = lm if lm else {c: c for c in range(n_left)}
+        rmf = rm if rm else {c: c for c in range(n_right)}
+        new_n_left = len(left.schema.fields)
+        comb = dict(lmf)
+        for c, nc in rmf.items():
+            comb[n_left + c] = new_n_left + nc
+        left_keys = tuple(lmf[c] for c in node.left_keys)
+        right_keys = tuple(rmf[c] for c in node.right_keys)
+        filt = _remap_expr(node.filter, comb) if node.filter is not None else None
+        if semi:
+            schema = left.schema
+        else:
+            schema = Schema(tuple(left.schema.fields) + tuple(right.schema.fields))
+        out_map = None if all(comb.get(i, i) == i for i in range(n_out)) else comb
+        return dataclasses.replace(
+            node, left=left, right=right, left_keys=left_keys,
+            right_keys=right_keys, schema=schema, filter=filt), out_map
+
+    # Window / Union / Values / anything else: conservatively keep everything
+    return _identity(node)
+
+
+def _closed(child, req: set):
+    """Clamp a requirement set to the child's channel space."""
+    n = len(child.schema.fields)
+    return {c for c in req if 0 <= c < n} or set(range(min(n, 1)))
+
+
+def _replace_children(node: P.PlanNode, new_kids: tuple) -> P.PlanNode:
+    if isinstance(node, (P.Filter, P.Project, P.Aggregate, P.Sort, P.Limit,
+                         P.Window, P.Output)):
+        return dataclasses.replace(node, child=new_kids[0])
+    if isinstance(node, P.Join):
+        return dataclasses.replace(node, left=new_kids[0], right=new_kids[1])
+    if isinstance(node, P.Union):
+        return dataclasses.replace(node, inputs=tuple(new_kids))
+    return node
