@@ -100,3 +100,30 @@ def test_unknown_catalog_qualifier(mem_engine):
 def test_drop_missing_table_message(mem_engine):
     with pytest.raises(ValueError, match="does not exist"):
         mem_engine.execute_sql("drop table never_created")
+
+
+def test_delete_and_update():
+    """Row-level DML (reference: ConnectorMergeSink delete/update surface)."""
+    import numpy as np
+
+    from trino_tpu import Engine
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    e = Engine()
+    e.register_catalog("memory", MemoryConnector())
+    s = e.create_session("memory")
+    e.execute_sql("create table emp (id bigint, name varchar, salary decimal(10,2))", s)
+    e.execute_sql("""insert into emp values (1, 'ann', 100.00), (2, 'bob', 200.00),
+                     (3, 'cat', 300.00), (4, 'dan', 400.00)""", s)
+    e.execute_sql("update emp set salary = salary * 2 where id >= 3", s)
+    r = e.execute_sql("select id, salary from emp order by id", s).rows()
+    assert [(i, float(v)) for i, v in r] == [(1, 100.0), (2, 200.0), (3, 600.0),
+                                            (4, 800.0)]
+    e.execute_sql("update emp set name = 'zed', salary = 1.50 where id = 1", s)
+    r = e.execute_sql("select name, salary from emp where id = 1", s).rows()
+    assert r == [("zed", 1.5)]
+    e.execute_sql("delete from emp where salary > 500", s)
+    r = e.execute_sql("select id from emp order by id", s).rows()
+    assert [x[0] for x in r] == [1, 2]
+    e.execute_sql("delete from emp", s)
+    assert e.execute_sql("select count(*) from emp", s).rows()[0][0] == 0

@@ -127,6 +127,39 @@ class MemoryConnector:
                         else np.zeros(len(t.columns[i]) - n, bool))
                 t.nulls[i] = np.concatenate([prev, nulls])
 
+    def delete_rows(self, table: str, mask) -> int:
+        """Remove rows where mask is True (reference: ConnectorMergeSink delete
+        path; the memory connector applies it eagerly)."""
+        t = self._tables[table]
+        keep = ~np.asarray(mask, bool)
+        for i in range(len(t.columns)):
+            t.columns[i] = t.columns[i][keep]
+            if t.nulls[i] is not None:
+                t.nulls[i] = t.nulls[i][keep]
+        return int((~keep).sum())
+
+    def update_rows(self, table: str, mask, decoded_values: dict) -> int:
+        """Assign decoded values on rows where mask is True (strings re-encode
+        through the table-wide growable dictionary)."""
+        t = self._tables[table]
+        m = np.asarray(mask, bool)
+        for col, vals in decoded_values.items():
+            i = t.schema.index(col)
+            f = t.schema.fields[i]
+            vals = np.asarray(vals, object)
+            nulls = np.array([v is None for v in vals], bool)
+            if f.type.is_string:
+                arr = t.growable[f.name].encode(list(vals))
+            else:
+                arr = np.array([0 if v is None else v for v in vals],
+                               np.dtype(f.type.dtype))
+            t.columns[i] = np.where(m, arr, t.columns[i]).astype(t.columns[i].dtype)
+            if nulls.any() or t.nulls[i] is not None:
+                prev = t.nulls[i] if t.nulls[i] is not None else \
+                    np.zeros(len(t.columns[i]), bool)
+                t.nulls[i] = np.where(m, nulls, prev)
+        return int(m.sum())
+
     # scan -------------------------------------------------------------------
     def splits(self, table: str, n_hint: int = 0):
         n = self.row_count(table)

@@ -262,6 +262,19 @@ class DropView(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class Delete(Node):
+    table: str
+    where: object = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Update(Node):
+    table: str
+    assignments: tuple  # ((column, expr), ...)
+    where: object = None
+
+
+@dataclasses.dataclass(frozen=True)
 class SetSession(Node):
     name: str
     value: object  # literal node
@@ -452,6 +465,26 @@ class Parser:
                         break
                 return InsertInto(name, cols, ValuesRows(tuple(rows)))
             return InsertInto(name, cols, self.parse_subquery())
+        t = self.peek()
+        if t.kind == "ident" and t.value == "delete":
+            self.next()
+            self.expect("from")
+            name = self.expect_kind("ident").value
+            where = self.parse_expr() if self.accept("where") else None
+            return Delete(name, where)
+        if t.kind == "ident" and t.value == "update":
+            self.next()
+            name = self.expect_kind("ident").value
+            self._expect_ident("set")
+            assigns = []
+            while True:
+                col = self.expect_kind("ident").value
+                self.expect("=")
+                assigns.append((col, self.parse_expr()))
+                if not self.accept(","):
+                    break
+            where = self.parse_expr() if self.accept("where") else None
+            return Update(name, tuple(assigns), where)
         if self.accept("drop"):
             is_view = bool(self.accept("view"))
             if not is_view:
