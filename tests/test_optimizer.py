@@ -46,3 +46,28 @@ def test_join_query_prunes_each_side():
     by_table = {sc.table: set(sc.columns) for sc in scans}
     assert by_table["orders"] <= {"o_custkey", "o_orderpriority"}
     assert by_table["customer"] <= {"c_custkey", "c_acctbal"}
+
+
+def test_limit_short_circuits_scan():
+    """LIMIT over a streaming child stops pulling pages early
+    (reference: LimitOperator)."""
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    e = Engine()
+    conn = TpchConnector(sf=0.1, split_rows=1 << 12)
+    calls = []
+    orig = conn.generate
+
+    def counting(split, columns=None):
+        calls.append(split)
+        return orig(split, columns)
+
+    conn.generate = counting
+    e.register_catalog("tpch", conn)
+    s = e.create_session("tpch")
+    nsplits = len(conn.splits("orders"))
+    assert nsplits > 8
+    r = e.execute_sql("select o_orderkey from orders where o_orderkey > 5 limit 7",
+                      s).rows()
+    assert len(r) == 7 and all(k > 5 for (k,) in r)
+    assert len(calls) <= 2  # stopped after the first page(s)

@@ -166,6 +166,13 @@ class LocalExecutor:
                 page = _topn_page(child, node.child.keys, node.count, dicts)
                 self._record(node, page, t0)
                 return page, dicts
+            if not isinstance(node.child, (P.Aggregate, P.Sort, P.Output, P.Window,
+                                           P.Limit)):
+                # streaming child: stop pulling pages once the limit is reached
+                # (reference: LimitOperator short-circuits the pipeline)
+                page, dicts = self._limited_stream_page(node)
+                self._record(node, page, t0)
+                return page, dicts
             child, dicts = self._execute_to_page(node.child)
             return _limit_page(child, node.count), dicts
         if isinstance(node, P.Aggregate):
@@ -998,6 +1005,38 @@ class LocalExecutor:
         semi = node.kind in ("semi", "anti")
         dicts = probe_stream.dicts if semi else probe_stream.dicts + build_dicts
         return _Stream(node.schema, dicts, pages, lambda c, n, v, aux: (c, n, v))
+
+    def _limited_stream_page(self, node: P.Limit):
+        """LIMIT over a streaming child: pull pages only until `count` live rows
+        exist, then stop the source entirely (reference: LimitOperator ending the
+        pipeline early — the big win is scans that never run)."""
+        stream = self._compile_stream(node.child)
+        step = stream.jitted()
+        parts, total = [], 0
+        for page in stream.pages():
+            cols, nulls, valid = step(page)
+            n = int(jnp.sum(valid, dtype=jnp.int32))
+            if n == 0:
+                continue
+            n = min(n, node.count - total)
+            bucket = max(1 << max(n - 1, 1).bit_length(), 1024)
+            ccols, cnulls = _compact_part(cols, nulls, valid,
+                                          min(bucket, valid.shape[0]))
+            parts.append((ccols, cnulls, n))
+            total += n
+            if total >= node.count:
+                break
+        if not parts:
+            cols = tuple(jnp.zeros((0,), f.type.dtype) for f in stream.schema.fields)
+            return Page(stream.schema, cols, tuple(None for _ in cols), None), \
+                stream.dicts
+        ncols = len(parts[0][0])
+        has_null = tuple(any(cnulls[ci] is not None for _, cnulls, _ in parts)
+                         for ci in range(ncols))
+        ns = jnp.asarray([n for _, _, n in parts], jnp.int32)
+        cols_out, nulls_out, valid = _concat_all(
+            tuple((ccols, cnulls) for ccols, cnulls, _ in parts), ns, has_null)
+        return Page(stream.schema, cols_out, nulls_out, valid), stream.dicts
 
     def _execute_to_page_streamed(self, node):
         """Materialize a sub-plan into one device page (join build side)."""
