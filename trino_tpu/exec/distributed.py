@@ -78,7 +78,9 @@ class _DStream:
     dicts: tuple
     scan_lo_batches: list  # list of np.ndarray [n_workers] of per-worker row offsets
     scan_fn: Callable  # (lo_scalar) -> (cols, nulls, valid); traced per worker
-    transform: Callable  # (cols, nulls, valid) -> (cols, nulls, valid)
+    transform: Callable  # (cols, nulls, valid, aux) -> (cols, nulls, valid)
+    aux: tuple = ()  # device state (join tables) threaded as a jit ARGUMENT —
+    # closed-over constants degrade every later dispatch on tunneled TPUs
 
 
 class DistributedExecutor:
@@ -143,15 +145,16 @@ class DistributedExecutor:
                     valid = jnp.ones(cols[0].shape, bool)
                 return cols, nulls, valid
 
-            return _DStream(node.schema, dicts, lo_batches, scan_fn, lambda c, n, v: (c, n, v))
+            return _DStream(node.schema, dicts, lo_batches, scan_fn,
+                            lambda c, n, v, aux: (c, n, v))
 
         if isinstance(node, P.Filter):
             up = self._compile_stream(node.child)
             if up is None:
                 return None
 
-            def transform(cols, nulls, valid, up=up, pred=node.predicate):
-                cols, nulls, valid = up.transform(cols, nulls, valid)
+            def transform(cols, nulls, valid, aux, up=up, pred=node.predicate):
+                cols, nulls, valid = up.transform(cols, nulls, valid, aux)
                 return cols, nulls, evaluate_predicate(pred, cols, nulls, valid)
 
             return dataclasses.replace(up, transform=transform)
@@ -168,8 +171,8 @@ class DistributedExecutor:
                 else (up.dicts[e.index] if isinstance(e, FieldRef) else None)
                 for pd, e in zip(planner_dicts, node.exprs))
 
-            def transform(cols, nulls, valid, up=up, exprs=node.exprs):
-                cols, nulls, valid = up.transform(cols, nulls, valid)
+            def transform(cols, nulls, valid, aux, up=up, exprs=node.exprs):
+                cols, nulls, valid = up.transform(cols, nulls, valid, aux)
                 out = [evaluate(e, cols, nulls) for e in exprs]
                 import jax.numpy as jnp
 
@@ -180,7 +183,8 @@ class DistributedExecutor:
                            for _, n in out)
                 return vs, ns, valid
 
-            return _DStream(node.schema, dicts, up.scan_lo_batches, up.scan_fn, transform)
+            return _DStream(node.schema, dicts, up.scan_lo_batches, up.scan_fn, transform,
+                            aux=up.aux)
 
         if isinstance(node, P.Join):
             up = self._compile_stream(node.left)
@@ -212,9 +216,10 @@ class DistributedExecutor:
             semi = node.kind in ("semi", "anti")
             from ..ops.hashjoin import probe
 
-            def transform(cols, nulls, valid, up=up, node=node, table=table,
+            def transform(cols, nulls, valid, aux, up=up, node=node,
                           build_key_types=build_key_types, semi=semi):
-                cols, nulls, valid = up.transform(cols, nulls, valid)
+                up_aux, table = aux
+                cols, nulls, valid = up.transform(cols, nulls, valid, up_aux)
                 keys = tuple(cols[i] for i in node.left_keys)
                 row_ids, matched = probe(table, keys, build_key_types, valid)
                 for i in node.left_keys:
@@ -234,7 +239,8 @@ class DistributedExecutor:
                 return out_cols, out_nulls, valid
 
             dicts = up.dicts if semi else up.dicts + build_dicts
-            return _DStream(node.schema, dicts, up.scan_lo_batches, up.scan_fn, transform)
+            return _DStream(node.schema, dicts, up.scan_lo_batches, up.scan_fn, transform,
+                            aux=(up.aux, table))
 
         return None
 
@@ -309,8 +315,9 @@ class DistributedExecutor:
         table_g = jax.tree.map(lambda *xs: None if xs[0] is None else jnp.stack(xs),
                                *tables, is_leaf=lambda x: x is None)
 
-        def transform(cols, nulls, valid, up=up, node=node):
-            cols, nulls, valid = up.transform(cols, nulls, valid)
+        def transform(cols, nulls, valid, aux, up=up, node=node):
+            up_aux, table_g = aux
+            cols, nulls, valid = up.transform(cols, nulls, valid, up_aux)
             n = valid.shape[0]
             pkeys = tuple(cols[i] for i in node.left_keys)
             rpid = partition_ids(pkeys, W)
@@ -359,7 +366,8 @@ class DistributedExecutor:
             return (out_cols, out_nulls, out_valid)
 
         dicts = up.dicts if semi else up.dicts + build_dicts
-        return _DStream(node.schema, dicts, up.scan_lo_batches, up.scan_fn, transform)
+        return _DStream(node.schema, dicts, up.scan_lo_batches, up.scan_fn, transform,
+                            aux=(up.aux, table_g))
 
     # ---------------------------------------------------------------- aggregation
     def _run_aggregate(self, node: P.Aggregate):
@@ -387,14 +395,15 @@ class DistributedExecutor:
         while True:
             state = self._global_state_init(capacity, key_types, acc_specs)
 
-            @partial(shard_map, mesh=mesh, in_specs=(PS(WORKER_AXIS), PS(WORKER_AXIS)),
+            @partial(shard_map, mesh=mesh,
+                     in_specs=(PS(WORKER_AXIS), PS(WORKER_AXIS), PS()),
                      out_specs=PS(WORKER_AXIS))
-            def step(state_g, lo_g, stream=stream, node=node, key_types=key_types,
-                     acc_exprs=acc_exprs, acc_kinds=acc_kinds):
+            def step(state_g, lo_g, aux, stream=stream, node=node,
+                     key_types=key_types, acc_exprs=acc_exprs, acc_kinds=acc_kinds):
                 state = jax.tree.map(lambda x: x[0], state_g,
                                      is_leaf=lambda x: x is None)
                 cols, nulls, valid = stream.scan_fn(lo_g[0])
-                cols, nulls, valid = stream.transform(cols, nulls, valid)
+                cols, nulls, valid = stream.transform(cols, nulls, valid, aux)
                 key_vals = tuple(cols[i] for i in node.keys)
                 inputs = [(None, None) if e is None else evaluate(e, cols, nulls)
                           for e in acc_exprs]
@@ -404,7 +413,7 @@ class DistributedExecutor:
 
             step = jax.jit(step)
             for lo in stream.scan_lo_batches:
-                state = step(state, jax.device_put(lo, sharded))
+                state = step(state, jax.device_put(lo, sharded), stream.aux)
 
             merged = self._merge_states(state, key_types, acc_specs, merge_kinds, capacity)
             overflow = bool(np.any(np.asarray(merged.overflow))) or bool(
@@ -488,12 +497,14 @@ class DistributedExecutor:
             for (dt, init), k in zip(acc_specs, acc_kinds)
         )
 
-        @partial(shard_map, mesh=mesh, in_specs=(PS(WORKER_AXIS), PS(WORKER_AXIS)),
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(PS(WORKER_AXIS), PS(WORKER_AXIS), PS()),
                  out_specs=PS(WORKER_AXIS))
-        def step(state_g, lo_g, stream=stream, acc_exprs=acc_exprs, acc_kinds=acc_kinds):
+        def step(state_g, lo_g, aux, stream=stream, acc_exprs=acc_exprs,
+                 acc_kinds=acc_kinds):
             st = tuple(s[0] for s in state_g)
             cols, nulls, valid = stream.scan_fn(lo_g[0])
-            cols, nulls, valid = stream.transform(cols, nulls, valid)
+            cols, nulls, valid = stream.transform(cols, nulls, valid, aux)
             out = []
             for s, e, kind in zip(st, acc_exprs, acc_kinds):
                 if kind == "count_star":
@@ -513,7 +524,7 @@ class DistributedExecutor:
 
         step = jax.jit(step)
         for lo in stream.scan_lo_batches:
-            state = step(state, jax.device_put(lo, sharded))
+            state = step(state, jax.device_put(lo, sharded), stream.aux)
 
         # cross-worker combine on host (W scalars)
         finals = []
@@ -537,11 +548,11 @@ class DistributedExecutor:
         mesh = self.mesh
         sharded = NamedSharding(mesh, PS(WORKER_AXIS))
 
-        @partial(shard_map, mesh=mesh, in_specs=PS(WORKER_AXIS),
+        @partial(shard_map, mesh=mesh, in_specs=(PS(WORKER_AXIS), PS()),
                  out_specs=PS(WORKER_AXIS))
-        def run(lo_g, stream=stream):
+        def run(lo_g, aux, stream=stream):
             cols, nulls, valid = stream.scan_fn(lo_g[0])
-            cols, nulls, valid = stream.transform(cols, nulls, valid)
+            cols, nulls, valid = stream.transform(cols, nulls, valid, aux)
             nulls = tuple(jnp.zeros(c.shape, bool) if n is None else n
                           for c, n in zip(cols, nulls))
             return (tuple(c[None] for c in cols), tuple(n[None] for n in nulls),
@@ -550,7 +561,7 @@ class DistributedExecutor:
         run = jax.jit(run)
         parts_cols, parts_nulls, parts_valid = [], [], []
         for lo in stream.scan_lo_batches:
-            cols, nulls, valid = run(jax.device_put(lo, sharded))
+            cols, nulls, valid = run(jax.device_put(lo, sharded), stream.aux)
             v = np.asarray(valid).reshape(-1)
             parts_valid.append(v)
             parts_cols.append([np.asarray(c).reshape(-1)[v] for c in cols])
