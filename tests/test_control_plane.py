@@ -198,3 +198,27 @@ def test_tracing_spans():
     pl = next(sp for sp in spans if sp.name == "planner")
     assert pl.parent_id == q.span_id
     assert q.duration_s is not None and q.status == "OK"
+
+
+def test_prepared_statements():
+    """PREPARE / EXECUTE [USING ...] / DEALLOCATE PREPARE (reference:
+    QueryPreparer + session prepared statements)."""
+    e = _engine()
+    s = e.create_session("tpch")
+    e.execute_sql("prepare q from select count(*) from orders where o_orderkey <= ?",
+                  s)
+    r = e.execute_sql("execute q using 50", s).rows()
+    assert r[0][0] == 50
+    r = e.execute_sql("execute q using 10", s).rows()
+    assert r[0][0] == 10
+    e.execute_sql("prepare seg from "
+                  "select count(*) from customer where c_mktsegment = ?", s)
+    n = e.execute_sql("execute seg using 'BUILDING'", s).rows()[0][0]
+    direct = e.execute_sql(
+        "select count(*) from customer where c_mktsegment = 'BUILDING'", s).rows()[0][0]
+    assert n == direct
+    e.execute_sql("deallocate prepare q", s)
+    with pytest.raises(Exception):
+        e.execute_sql("execute q using 5", s)
+    with pytest.raises(Exception):
+        e.execute_sql("deallocate prepare nope", s)

@@ -262,6 +262,23 @@ class DropView(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class Prepare(Node):
+    name: str
+    sql: str  # statement text (re-parsed with parameters substituted at EXECUTE)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutePrepared(Node):
+    name: str
+    parameters: tuple  # literal nodes
+
+
+@dataclasses.dataclass(frozen=True)
+class Deallocate(Node):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
 class Delete(Node):
     table: str
     where: object = None
@@ -319,7 +336,7 @@ _TOKEN_RE = re.compile(
   | (?P<number>\d+(\.\d*)?([eE][+-]?\d+)?|\.\d+)
   | (?P<string>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*|"(?:[^"]|"")*")
-  | (?P<op><=|>=|<>|!=|\|\||[-+*/%(),.;<>=])
+  | (?P<op><=|>=|<>|!=|\|\||[-+*/%(),.;<>=?])
     """,
     re.VERBOSE,
 )
@@ -372,8 +389,18 @@ def tokenize(sql: str) -> list:
 # ----------------------------------------------------------------------------- parser
 class Parser:
     def __init__(self, sql: str):
+        self.sql = sql
         self.tokens = tokenize(sql)
         self.i = 0
+
+    def _remaining_text(self) -> str:
+        """Raw source from the current token to the end (PREPARE bodies)."""
+        t = self.peek()
+        if t.kind == "eof":
+            raise ParseError("PREPARE requires a statement body")
+        text = self.sql[t.pos:].strip()
+        self.i = len(self.tokens) - 1  # consume to EOF
+        return text.rstrip(";").strip()
 
     # token helpers
     def peek(self, offset=0) -> Token:
@@ -466,6 +493,29 @@ class Parser:
                 return InsertInto(name, cols, ValuesRows(tuple(rows)))
             return InsertInto(name, cols, self.parse_subquery())
         t = self.peek()
+        if t.kind == "ident" and t.value == "prepare":
+            self.next()
+            name = self.expect_kind("ident").value
+            self.expect("from")
+            # capture the remaining raw text (reference: prepared statements store
+            # the statement AST; parameters (?) substitute at EXECUTE)
+            rest = self._remaining_text()
+            return Prepare(name, rest)
+        if t.kind == "ident" and t.value == "execute" and \
+                self.peek(1).kind == "ident":
+            self.next()
+            name = self.expect_kind("ident").value
+            params = []
+            if self.peek().kind == "ident" and self.peek().value == "using":
+                self.next()
+                params.append(self.parse_expr())
+                while self.accept(","):
+                    params.append(self.parse_expr())
+            return ExecutePrepared(name, tuple(params))
+        if t.kind == "ident" and t.value == "deallocate":
+            self.next()
+            self._expect_ident("prepare")
+            return Deallocate(self.expect_kind("ident").value)
         if t.kind == "ident" and t.value == "delete":
             self.next()
             self.expect("from")
