@@ -20,8 +20,26 @@ jax.config.update("jax_enable_x64", True)
 # in a long-lived server JVM (sql/gen/PageFunctionCompiler.java:103).  Opt out with
 # TRINO_TPU_NO_COMPILE_CACHE=1.
 if not _os.environ.get("TRINO_TPU_NO_COMPILE_CACHE"):
+    def _machine_tag() -> str:
+        # CPU AOT entries embed target-machine features; loading them on a
+        # different host risks SIGILL (xla cpu_aot_loader warns).  Key the cache
+        # by a cheap machine fingerprint so each host population is disjoint.
+        import hashlib
+        import platform
+
+        probe = platform.machine() + platform.processor()
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    if line.startswith("flags"):
+                        probe += line
+                        break
+        except OSError:
+            pass
+        return hashlib.sha1(probe.encode()).hexdigest()[:12]
+
     _cache_dir = _os.environ.get("JAX_COMPILATION_CACHE_DIR") or _os.path.join(
-        _os.path.expanduser("~"), ".cache", "trino_tpu", "xla")
+        _os.path.expanduser("~"), ".cache", "trino_tpu", f"xla-{_machine_tag()}")
     try:
         _os.makedirs(_cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
