@@ -222,3 +222,14 @@ def test_prepared_statements():
         e.execute_sql("execute q using 5", s)
     with pytest.raises(Exception):
         e.execute_sql("deallocate prepare nope", s)
+
+
+def test_show_stats():
+    e = _engine()
+    s = e.create_session("tpch")
+    rows = e.execute_sql("show stats for orders", s).rows()
+    by_col = {r[0]: r for r in rows}
+    assert "o_orderkey" in by_col
+    assert by_col[""][4] != ""  # summary row carries the row count
+    lo, hi = by_col["o_orderkey"][2], by_col["o_orderkey"][3]
+    assert lo in ("0", "1") and int(hi) > 0

@@ -583,6 +583,9 @@ class Parser:
         if what == "columns":
             self.expect("from")
             return Show("columns", self.expect_kind("ident").value)
+        if what == "stats":
+            self.expect("for")
+            return Show("stats", self.expect_kind("ident").value)
         raise ParseError(f"unsupported SHOW {what!r}")
 
     def _expect_ident(self, value: str) -> None:
