@@ -94,3 +94,32 @@ def test_review_fixes(engine):
     r = engine.execute_sql(
         "select width_bucket(5.5, 0, 10, 5) w from region limit 1")
     assert r.columns[0][0] == 3
+
+
+def test_string_and_date_function_additions(engine):
+    """regexp_like, split_part, position(IN), codepoint, date_add, date_diff
+    (reference: JoniRegexpFunctions, StringFunctions, DateTimeFunctions)."""
+    s = engine.create_session("tpch")
+    e = engine
+    assert e.execute_sql(
+        "select count(*) from nation where regexp_like(n_name, '^.*IA$')", s
+    ).rows()[0][0] == 7
+    assert e.execute_sql(
+        "select split_part(n_name, 'I', 2) from nation where n_name = 'INDIA'", s
+    ).rows() == [("ND",)]
+    assert e.execute_sql(
+        "select position('I' in n_name) from nation where n_name = 'ALGERIA'", s
+    ).rows() == [(6,)]
+    assert e.execute_sql("select codepoint('A')", s).rows() == [(65,)]
+    assert e.execute_sql(
+        "select date_add('month', 2, date '1995-12-31') = date '1996-02-29'", s
+    ).rows() == [(True,)]
+    assert e.execute_sql(
+        "select date_add('year', 1, date '1996-02-29') = date '1997-02-28'", s
+    ).rows() == [(True,)]
+    assert e.execute_sql(
+        "select date_diff('month', date '1995-01-15', date '1995-03-14')", s
+    ).rows() == [(1,)]
+    assert e.execute_sql(
+        "select date_diff('week', date '1995-01-01', date '1995-01-15')", s
+    ).rows() == [(2,)]

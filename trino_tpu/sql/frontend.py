@@ -1452,6 +1452,41 @@ class Planner:
             v, d = self._require_dict(ast.args[0], cols, name)
             table = np.array([len(str(s)) for s in d.values], np.int64)
             return ir.Call("lut", (v, ir.Constant(table, BIGINT)), BIGINT), None
+        if name == "regexp_like":
+            # dictionary-domain regex (reference: operator/scalar/JoniRegexpFunctions;
+            # strings are dict ids, so the pattern evaluates once per distinct value)
+            import re as _re
+
+            v, d = self._require_dict(ast.args[0], cols, name)
+            pat = _re.compile(self._literal_str(ast.args[1], name))
+            lutb = d.match(lambda s: bool(pat.search(s)))
+            return ir.Call("lut", (v, ir.Constant(lutb, BOOLEAN)), BOOLEAN), None
+        if name == "split_part":
+            v, d = self._require_dict(ast.args[0], cols, name)
+            delim = self._literal_str(ast.args[1], name)
+            if not isinstance(ast.args[2], A.NumberLit):
+                raise SemanticError("split_part index must be a literal")
+            ix = int(ast.args[2].text)
+
+            def part(s, delim=delim, ix=ix):
+                ps = str(s).split(delim)
+                return ps[ix - 1] if 0 < ix <= len(ps) else ""
+
+            lut, nd = d.map_values(part)
+            return ir.Call("lut", (v, ir.Constant(lut, v.type)), v.type), nd
+        if name == "codepoint":
+            sval = self._literal_str(ast.args[0], name)
+            return ir.Constant(ord(sval[0]), BIGINT), None
+        if name in ("date_add", "date_diff"):
+            unit = self._literal_str(ast.args[0], name).lower()
+            if unit not in ("day", "week", "month", "year"):
+                raise SemanticError(f"{name} unit {unit!r} not supported")
+            a, _ = self._translate(ast.args[1], cols)
+            b, _ = self._translate(ast.args[2], cols)
+            if name == "date_add":
+                return ir.Call("date_add_unit", (_coerce(a, BIGINT), b), DATE,
+                               meta=(unit,)), None
+            return ir.Call("date_diff_unit", (a, b), BIGINT, meta=(unit,)), None
         if name == "strpos":
             v, d = self._require_dict(ast.args[0], cols, name)
             pat = self._literal_str(ast.args[1], name)

@@ -1000,6 +1000,16 @@ class Parser:
         if t.kind == "ident":
             # function call or (qualified) identifier
             if self.peek(1).kind == "op" and self.peek(1).value == "(":
+                if t.value == "position":
+                    # POSITION(x IN y) special form -> strpos(y, x); the needle
+                    # parses below comparison level so IN stays the separator
+                    self.next()
+                    self.expect("(")
+                    needle = self.parse_additive()
+                    self.expect("in")
+                    hay = self.parse_expr()
+                    self.expect(")")
+                    return FuncCall("strpos", (hay, needle))
                 name = self.next().value
                 self.expect("(")
                 distinct = bool(self.accept("distinct"))
