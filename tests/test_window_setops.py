@@ -196,3 +196,36 @@ def test_grouping_sets_edge_cases(engine):
     r = engine.execute_sql("select count(*) c from region, customer, nation "
                            "where c_nationkey = n_nationkey")
     assert r.columns[0][0] == 1500 * 5
+
+
+def test_ranking_window_additions(engine):
+    """ntile / percent_rank / cume_dist / nth_value vs pandas
+    (reference: NTileFunction, PercentRankFunction, CumulativeDistributionFunction,
+    NthValueFunction)."""
+    import numpy as np
+
+    e = engine
+    s = e.create_session("tpch")
+    rows = e.execute_sql("""
+        select n_regionkey, n_nationkey,
+               ntile(2) over (partition by n_regionkey order by n_nationkey) b,
+               percent_rank() over (partition by n_regionkey order by n_nationkey) pr,
+               cume_dist() over (partition by n_regionkey order by n_nationkey) cd,
+               nth_value(n_nationkey, 2)
+                   over (partition by n_regionkey order by n_nationkey) nv
+        from nation order by n_regionkey, n_nationkey""", s).rows()
+    import collections
+
+    by_region = collections.defaultdict(list)
+    for r in rows:
+        by_region[r[0]].append(r)
+    for reg, rs in by_region.items():
+        size = len(rs)
+        assert size == 5  # TPC-H: 5 nations per region
+        for i, r in enumerate(rs):
+            rn = i + 1
+            # ntile(2) over 5 rows: bucket 1 gets 3 rows, bucket 2 gets 2
+            assert r[2] == (1 if rn <= 3 else 2), r
+            assert abs(r[3] - i / (size - 1)) < 1e-12
+            assert abs(r[4] - rn / size) < 1e-12
+            assert r[5] == rs[1][1]  # 2nd nationkey of the region

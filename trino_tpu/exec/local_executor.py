@@ -1642,6 +1642,35 @@ def _window_kernel(specs, cols, nulls):
                 shifted_null, _ = W.shift_in_partition(
                     (~vmask), part_new, off, jnp.zeros((), bool))
                 null_out = null_out | (shifted_null & ~miss)
+        elif s.kind in ("percent_rank", "cume_dist"):
+            size = W.partition_total(jnp.ones((n,), jnp.int64), part_new)
+            if s.kind == "percent_rank":
+                rk = W.rank(part_new, peer_new)
+                res = jnp.where(size > 1,
+                                (rk - 1) / jnp.maximum(size - 1, 1), 0.0)
+            else:
+                pos = W._ends(peer_new) - W._starts(part_new) + 1
+                res = pos / size
+        elif s.kind == "ntile":
+            # reference: NTileFunction — the first (size % n) buckets take one
+            # extra row
+            nb = s.offset
+            size = W.partition_total(jnp.ones((n,), jnp.int64), part_new)
+            rn = W.row_number(part_new)
+            q, r = size // nb, size % nb
+            boundary = r * (q + 1)
+            res = jnp.where(rn <= boundary,
+                            (rn - 1) // jnp.maximum(q + 1, 1),
+                            r + (rn - 1 - boundary) // jnp.maximum(q, 1)) + 1
+        elif s.kind == "nth_value":
+            k = s.offset
+            starts = W._starts(part_new)
+            size = W.partition_total(jnp.ones((n,), jnp.int64), part_new)
+            idx = jnp.minimum(starts + (k - 1), n - 1)
+            res = vals[idx]
+            null_out = size < k  # partition shorter than k -> NULL
+            if vmask is not None:
+                null_out = null_out | ~vmask[idx]
         elif s.kind in ("first_value", "last_value"):
             idx = (W._starts(part_new) if s.kind == "first_value"
                    else W._ends(peer_new if framed else part_new))

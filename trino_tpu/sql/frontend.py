@@ -237,7 +237,8 @@ class Planner:
 
     # ---------------------------------------------------------------- window functions
     WINDOW_FUNCS = {"row_number", "rank", "dense_rank", "sum", "avg", "min", "max",
-                    "count", "lag", "lead", "first_value", "last_value"}
+                    "count", "lag", "lead", "first_value", "last_value",
+                    "percent_rank", "cume_dist", "ntile", "nth_value"}
 
     def _plan_windows(self, rel: RelPlan, items, win_calls):
         """Plan window calls: extend the relation with partition/order/arg channels,
@@ -290,14 +291,30 @@ class Planner:
             if name == "count" and (not w.func.args
                                     or isinstance(w.func.args[0], A.Star)):
                 kind = "count_star"
-            elif name in ("row_number", "rank", "dense_rank"):
+            elif name in ("row_number", "rank", "dense_rank", "percent_rank",
+                          "cume_dist"):
                 if w.func.args:
                     raise SemanticError(f"{name} takes no arguments")
+            elif name == "ntile":
+                if len(w.func.args) != 1 or not isinstance(w.func.args[0],
+                                                           A.NumberLit):
+                    raise SemanticError("ntile bucket count must be a literal")
             else:
                 if not w.func.args:
                     raise SemanticError(f"window function {name} needs an argument")
                 arg_ch, arg_t, arg_d = channel_of(w.func.args[0])
             offset, default = 1, None
+            if name == "ntile":
+                offset = int(w.func.args[0].text)
+                if offset <= 0:
+                    raise SemanticError("ntile bucket count must be positive")
+            if name == "nth_value":
+                if len(w.func.args) != 2 or not isinstance(w.func.args[1],
+                                                           A.NumberLit):
+                    raise SemanticError("nth_value offset must be a literal")
+                offset = int(w.func.args[1].text)
+                if offset <= 0:
+                    raise SemanticError("nth_value offset must be positive")
             if name in ("lag", "lead"):
                 if len(w.func.args) > 1:
                     if not isinstance(w.func.args[1], A.NumberLit):
@@ -312,8 +329,11 @@ class Planner:
                     if not isinstance(dflt, ir.Constant):
                         raise SemanticError("lag/lead default must be a literal")
                     default = dflt.value
-            if kind in ("row_number", "rank", "dense_rank", "count", "count_star"):
+            if kind in ("row_number", "rank", "dense_rank", "count", "count_star",
+                        "ntile"):
                 t = BIGINT
+            elif kind in ("percent_rank", "cume_dist"):
+                t = DOUBLE
             elif kind in ("sum", "avg"):
                 t = _agg_type(kind, arg_t)
             else:
@@ -322,7 +342,8 @@ class Planner:
                                       default))
             out_info.append((f"#w{j}", t,
                              arg_d if kind in ("min", "max", "lag", "lead",
-                                               "first_value", "last_value") else None))
+                                               "first_value", "last_value",
+                                               "nth_value") else None))
 
         proj_schema = Schema(tuple(Field(f"c{i}", e.type)
                                    for i, e in enumerate(proj_exprs)))
