@@ -123,3 +123,23 @@ def test_string_and_date_function_additions(engine):
     assert e.execute_sql(
         "select date_diff('week', date '1995-01-01', date '1995-01-15')", s
     ).rows() == [(2,)]
+
+
+def test_try_cast():
+    """TRY_CAST returns NULL on conversion failure (reference: TryCastFunction)."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    e = Engine()
+    e.register_catalog("memory", MemoryConnector())
+    s = e.create_session("memory")
+    e.execute_sql("create table t (v varchar)", s)
+    e.execute_sql("insert into t values ('12'), ('x'), ('3.5'), (''), ('  7 ')", s)
+    assert e.execute_sql("select try_cast(v as bigint) from t", s).rows() == \
+        [(12,), (None,), (None,), (None,), (7,)]
+    assert e.execute_sql("select try_cast(v as double) from t", s).rows() == \
+        [(12.0,), (None,), (3.5,), (None,), (7.0,)]
+    assert e.execute_sql("select count(try_cast(v as bigint)) from t", s
+                         ).rows()[0][0] == 2
+    # numeric-to-numeric try_cast reduces to plain coercion
+    assert e.execute_sql("select try_cast(5 as double)", s).rows() == [(5.0,)]

@@ -235,6 +235,41 @@ class Planner:
             rel = self._semi_anti_join(probe, inner, pairs, q.kind == "except")
         return rel, list(lnames), [None] * len(lnames)
 
+    def _try_cast(self, value_ast, t, cols):
+        """TRY_CAST: NULL on conversion failure (reference:
+        operator/scalar/TryCastFunction).  String sources convert per distinct
+        dictionary value through parse-or-NULL lookup tables; numeric-to-numeric
+        casts cannot fail in this engine and reduce to plain coercion."""
+        v, d = self._translate(value_ast, cols)
+        if not v.type.is_string:
+            return _coerce(v, t), None
+        if d is None or getattr(d, "values", None) is None:
+            raise SemanticError("try_cast needs a dictionary-backed string source")
+
+        def parse_one(s):
+            s = str(s).strip()
+            try:
+                if t.is_floating:
+                    return float(s)
+                if isinstance(t, DecimalType):
+                    from decimal import Decimal
+
+                    return int(Decimal(s).scaleb(t.scale))
+                return int(s)
+            except Exception:
+                return None
+
+        parsed = [parse_one(s) for s in d.values]
+        import numpy as _np
+
+        vals = _np.array([0 if p is None else p for p in parsed],
+                         _np.dtype(t.dtype))
+        nulls = _np.array([p is None for p in parsed])
+        out = ir.Call("lut", (v, ir.Constant(vals, t)), t)
+        isnull = ir.Call("lut", (v, ir.Constant(nulls, BOOLEAN)), BOOLEAN)
+        # fold the null lut through an if: NULL value when parse failed
+        return ir.Call("null_if_flag", (out, isnull), t), None
+
     # ---------------------------------------------------------------- window functions
     WINDOW_FUNCS = {"row_number", "rank", "dense_rank", "sum", "avg", "min", "max",
                     "count", "lag", "lead", "first_value", "last_value",
@@ -1241,8 +1276,10 @@ class Planner:
         if isinstance(ast, A.CaseExpr):
             return self._translate_case(ast, cols)
         if isinstance(ast, A.Cast):
-            v, d = self._translate(ast.value, cols)
             t = _type_from_name(ast.type_name, ast.params)
+            if getattr(ast, "safe", False):
+                return self._try_cast(ast.value, t, cols)
+            v, d = self._translate(ast.value, cols)
             return _coerce(v, t), (d if t.is_string else None)
         if isinstance(ast, A.Extract):
             v, _ = self._translate(ast.value, cols)

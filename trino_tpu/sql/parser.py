@@ -155,6 +155,7 @@ class Cast(Node):
     value: Node
     type_name: str
     params: tuple = ()
+    safe: bool = False  # TRY_CAST: NULL instead of failure
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1000,6 +1001,14 @@ class Parser:
         if t.kind == "ident":
             # function call or (qualified) identifier
             if self.peek(1).kind == "op" and self.peek(1).value == "(":
+                if t.value == "try_cast":
+                    self.next()
+                    self.expect("(")
+                    v = self.parse_expr()
+                    self.expect("as")
+                    tname, params = self.parse_type_name()
+                    self.expect(")")
+                    return Cast(v, tname, params, safe=True)
                 if t.value == "position":
                     # POSITION(x IN y) special form -> strpos(y, x); the needle
                     # parses below comparison level so IN stays the separator
