@@ -241,17 +241,20 @@ def test_null_admitting_domains_never_prune(tmp_path):
 
 def test_direct_groupby_late_null_page(tmp_path):
     """Direct-indexed group-by frozen from a null-free first page must fall back
-    (not merge NULLs into a real group) when a later page introduces NULL keys
-    (regression: NULL rows landed in the value-lo group)."""
+    (not merge NULLs into a real group, and not crash in the recoverable
+    fallback — round-1 ADVICE high finding) when a later page introduces NULL
+    keys.  A dictionary-backed string key makes _key_ranges non-None, so the
+    direct path is actually taken (an int64 parquet column has no dictionary
+    and no column_range, so it would silently run plain hash mode)."""
     import pyarrow as pa
     import pyarrow.parquet as pq
 
     from trino_tpu import Engine
     from trino_tpu.connectors.parquet import ParquetConnector
 
-    ks = [1 + (i % 3) for i in range(900)] + \
-         [None if i % 5 == 0 else 1 + (i % 3) for i in range(900)]
-    pq.write_table(pa.table({"k": pa.array(ks, pa.int64())}),
+    ks = [["a", "b", "c"][i % 3] for i in range(900)] + \
+         [None if i % 5 == 0 else ["a", "b", "c"][i % 3] for i in range(900)]
+    pq.write_table(pa.table({"k": pa.array(ks, pa.string())}),
                    str(tmp_path / "t.parquet"), row_group_size=900)
     e = Engine()
     e.register_catalog("pq", ParquetConnector(str(tmp_path)))

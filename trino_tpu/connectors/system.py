@@ -100,16 +100,21 @@ class SystemConnector:
 
     def dictionaries(self, table: str) -> dict:
         # encode the CURRENT rows first: string literals in predicates resolve to
-        # dictionary ids at plan time, so values must be present before planning
-        rows = self._rows(table)
-        schema = SCHEMAS[table]
-        out = {}
-        for ci, f in enumerate(schema.fields):
-            if f.type.is_string:
-                g = self._growable(table, f.name)
-                g.encode([r[ci] for r in rows])
-                out[f.name] = g.dictionary
-        return out
+        # dictionary ids at plan time, so values must be present before planning.
+        # Growth is serialized with planning via the engine's plan lock so a
+        # concurrent execution can never grow a dictionary between a planner's
+        # LUT construction and its version snapshot (which would cache a plan
+        # whose recorded version is newer than its embedded LUTs).
+        with self.engine._plan_lock:
+            rows = self._rows(table)
+            schema = SCHEMAS[table]
+            out = {}
+            for ci, f in enumerate(schema.fields):
+                if f.type.is_string:
+                    g = self._growable(table, f.name)
+                    g.encode([r[ci] for r in rows])
+                    out[f.name] = g.dictionary
+            return out
 
     def _growable(self, table, column) -> _Growable:
         g = self._dicts.get((table, column))
@@ -117,6 +122,14 @@ class SystemConnector:
             g = _Growable()
             self._dicts[(table, column)] = g
         return g
+
+    def plan_version(self) -> int:
+        """Growable dictionaries grow in place across queries, while cached
+        plans embed string-predicate LUTs sized to the dictionary at plan time
+        — a newly-added id would gather past the LUT bound (jnp clips) and
+        silently mis-evaluate.  The engine keys its plan cache on this value,
+        so any growth forces a replan with fresh LUTs."""
+        return sum(len(g.values) for g in self._dicts.values())
 
     def row_count(self, table: str) -> int:
         return len(self._rows(table))
@@ -162,6 +175,10 @@ class SystemConnector:
         raise KeyError(table)
 
     def generate(self, split: SystemSplit, columns=None) -> Page:
+        with self.engine._plan_lock:  # growth serialized with planning (see dictionaries)
+            return self._generate_locked(split, columns)
+
+    def _generate_locked(self, split: SystemSplit, columns=None) -> Page:
         schema = SCHEMAS[split.table]
         names = columns if columns is not None else schema.names
         rows = self._rows(split.table)

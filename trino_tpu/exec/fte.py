@@ -171,9 +171,19 @@ class FaultTolerantExecutor:
         self.local = LocalExecutor(catalogs)
         self._exchange_seq = 0
         self.task_attempts: dict[int, int] = {}  # observability: task -> attempts used
+        # the substitution below patches the shared LocalExecutor instance;
+        # concurrent FTE queries would race on the patch/restore pair, so FTE
+        # execution is serialized (admission allows concurrency at the engine)
+        import threading
+
+        self._lock = threading.Lock()
 
     # -- public ----------------------------------------------------------------
     def execute(self, plan: P.PlanNode):
+        with self._lock:
+            return self._execute_locked(plan)
+
+    def _execute_locked(self, plan: P.PlanNode):
         agg = self._find_fte_aggregate(plan)
         if agg is None:
             return self.local.execute(plan)

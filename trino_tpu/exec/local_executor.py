@@ -124,6 +124,34 @@ class LocalExecutor:
         # (reference: MemoryPool + MemoryRevokingScheduler -> spill)
         self.memory_pool = memory_pool if memory_pool is not None else MemoryPool()
 
+    def forget_plan(self, plan: P.PlanNode) -> None:
+        """Evict compiled artifacts for a plan the engine is replacing (its
+        version-stale plan-cache path).  Cache keys are id(node) or tuples
+        containing one; entries pin node objects, jit executables, and device
+        arrays, so a replan without eviction would leak a full compiled copy."""
+        ids = set()
+
+        def walk(n):
+            ids.add(id(n))
+            for c in n.children:
+                walk(c)
+
+        walk(plan)
+
+        def dead(key):
+            if isinstance(key, tuple):
+                return any(k in ids for k in key if isinstance(k, int))
+            return key in ids
+
+        for cache in (self._stream_cache, self._agg_cache):
+            # list() snapshots the keys atomically (C-level, GIL-held) so a
+            # concurrent query inserting into the same dict cannot raise
+            # "dictionary changed size during iteration"; pop() tolerates keys
+            # already gone.  A running query that held the evicted node just
+            # re-inserts on its next access.
+            for key in [k for k in list(cache) if dead(k)]:
+                cache.pop(key, None)
+
     # ------------------------------------------------------------------ public
     def execute(self, node: P.PlanNode) -> MaterializedResult:
         self.stats = {}
@@ -462,12 +490,12 @@ class LocalExecutor:
                     if not bool(state.overflow):
                         break
                     # stale stats put keys out of range: hash mode
-                    self.memory_pool.free(reserved, "group-by")
-                    cfg, reserved = None, 0
+                    self.memory_pool.free(resv["bytes"], "group-by")
+                    cfg, resv["bytes"] = None, 0
                     if not self.memory_pool.try_reserve(state_bytes(capacity),
                                                         "group-by"):
                         return self._run_aggregate_partitioned(node, parts=4)
-                    reserved = state_bytes(capacity)
+                    resv["bytes"] = state_bytes(capacity)
                     pages_once = stream.pages()
                     continue
                 state = hashagg.groupby_init(
@@ -1663,12 +1691,15 @@ def _window_kernel(specs, cols, nulls):
                             (rn - 1) // jnp.maximum(q + 1, 1),
                             r + (rn - 1 - boundary) // jnp.maximum(q, 1)) + 1
         elif s.kind == "nth_value":
+            # default frame RANGE UNBOUNDED PRECEDING..CURRENT ROW: a row whose
+            # frame holds fewer than k rows yields NULL (reference:
+            # operator/window/NthValueFunction.java frame bounds check)
             k = s.offset
             starts = W._starts(part_new)
-            size = W.partition_total(jnp.ones((n,), jnp.int64), part_new)
+            frame_size = W._ends(peer_new) - starts + 1
             idx = jnp.minimum(starts + (k - 1), n - 1)
             res = vals[idx]
-            null_out = size < k  # partition shorter than k -> NULL
+            null_out = frame_size < k  # frame shorter than k -> NULL
             if vmask is not None:
                 null_out = null_out | ~vmask[idx]
         elif s.kind in ("first_value", "last_value"):
