@@ -6,6 +6,9 @@ boots coordinator+workers in one JVM, testing/trino-testing/DistributedQueryRunn
 import os
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# JAX_PLATFORMS=cpu as an ENV VAR hangs the axon plugin's discovery at the
+# first device use; drop it and select cpu via jax.config below (which works)
+os.environ.pop("JAX_PLATFORMS", None)
 
 import jax
 

@@ -51,6 +51,37 @@ QUERIES = {
         select o_orderkey, o_totalprice from orders
         where o_orderdate >= date '1998-01-01' and o_custkey < 50
         order by o_orderkey limit 50""",
+    # north-star suite completion (round-1 VERDICT weak #3: Q9/Q18 shapes fell
+    # back to local because of Project-above-Aggregate and null-aware semi)
+    "q9": """
+        select nation, o_year, sum(amount) as sum_profit from (
+          select n_name as nation, extract(year from o_orderdate) as o_year,
+            l_extendedprice * (1 - l_discount) - ps_supplycost * l_quantity as amount
+          from part, supplier, lineitem, partsupp, orders, nation
+          where s_suppkey = l_suppkey and ps_suppkey = l_suppkey and ps_partkey = l_partkey
+            and p_partkey = l_partkey and o_orderkey = l_orderkey
+            and s_nationkey = n_nationkey and p_name like '%green%') as profit
+        group by nation, o_year order by nation, o_year desc""",
+    "q18": """
+        select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice, sum(l_quantity)
+        from customer, orders, lineitem
+        where o_orderkey in (select l_orderkey from lineitem group by l_orderkey
+                             having sum(l_quantity) > 100)
+          and c_custkey = o_custkey and o_orderkey = l_orderkey
+        group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+        order by o_totalprice desc, o_orderdate limit 100""",
+    # streaming topN without an aggregate: per-worker device topN + host merge
+    "topn_stream": """
+        select l_orderkey, l_extendedprice from lineitem
+        order by l_extendedprice desc, l_orderkey limit 7""",
+    # residual join filter on a non-inner join (match condition, not post-filter)
+    "left_filter": """
+        select count(*) c, sum(o_totalprice) sp from orders
+        left join customer on o_custkey = c_custkey and c_acctbal > 5000""",
+    # NOT IN with an empty build set: every probe row survives
+    "anti_empty": """
+        select count(*) c from orders where o_custkey not in
+        (select c_custkey from customer where c_acctbal > 99999999)""",
 }
 
 
@@ -87,6 +118,36 @@ def test_distributed_on_subset_mesh(engine):
     local = engine.execute_sql(QUERIES["q6"], session).to_pandas()
     dist = engine.execute_sql(QUERIES["q6"], session, distributed=True, mesh=mesh).to_pandas()
     _frames_equal(dist, local)
+
+
+def test_distributed_not_in_empty_build_null_probe(engine, mesh8):
+    """NOT IN against an EMPTY set is TRUE even for a NULL probe key (3VL:
+    there is nothing to compare against) — NULL-keyed probe rows must survive,
+    matching local (regression: distributed dropped them unconditionally)."""
+    sql = ("select count(*) c from orders where "
+           "(case when o_custkey < 5 then null else o_custkey end) not in "
+           "(select c_custkey from customer where c_acctbal > 99999999)")
+    session = engine.create_session("tpch")
+    local = engine.execute_sql(sql, session).to_pandas()
+    dist = engine.execute_sql(sql, session, distributed=True, mesh=mesh8).to_pandas()
+    _frames_equal(dist, local)
+    # every orders row survives, including the NULL-keyed ones
+    n_orders = engine.execute_sql("select count(*) c from orders",
+                                  session).to_pandas().iloc[0, 0]
+    assert int(local.iloc[0, 0]) == int(n_orders)
+
+
+def test_distributed_null_aware_anti_with_null_build(engine, mesh8):
+    """NOT IN whose subquery yields a NULL: 3VL makes every membership test
+    unknown, so zero rows survive — distributed must agree with local."""
+    sql = ("select count(*) c from orders where o_custkey not in "
+           "(select case when c_custkey < 5 then null else c_custkey end "
+           " from customer)")
+    session = engine.create_session("tpch")
+    local = engine.execute_sql(sql, session).to_pandas()
+    dist = engine.execute_sql(sql, session, distributed=True, mesh=mesh8).to_pandas()
+    _frames_equal(dist, local)
+    assert int(local.iloc[0, 0]) == 0
 
 
 def test_partitioned_join_matches_local(engine):

@@ -235,8 +235,13 @@ def _probe_insert(table, packed, valid):
     deterministically. Returns (table, slot[int32], placed[bool])."""
     C = table.shape[0] - 1
     h0 = splitmix64(packed)
-    n = packed.shape[0]
-    slot = jnp.full((n,), C, jnp.int32)  # default: overflow sink
+    # derive every loop carry from the (possibly device-varying) inputs: under
+    # shard_map a fresh constant (a groupby_init table built inside the traced
+    # program, a zeros slot vector) is "unvarying" and the while_loop rejects
+    # the carry once the body mixes it with per-worker data.  Adding a zeroed
+    # varying term is a no-op numerically but inherits the varying axis.
+    table = table + (packed[:1] & 0)
+    slot = (h0 * 0 + C).astype(jnp.int32)  # default: overflow sink
     placed = ~valid  # invalid rows are trivially "done" (routed to sink)
 
     def cond(carry):

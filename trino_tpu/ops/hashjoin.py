@@ -107,9 +107,11 @@ def probe(jt: JoinTable, key_cols, key_types, valid):
     packed, _ = pack_keys(key_cols, key_types)
     C = jt.capacity
     h0 = splitmix64(packed)
-    n = packed.shape[0]
-    row_ids = jnp.zeros((n,), jnp.int32)
-    matched = jnp.zeros((n,), bool)
+    # derive the loop carries from the (possibly device-varying) probe inputs:
+    # under shard_map, fresh constants are "unvarying" and the while_loop would
+    # reject the carry when the body mixes them with per-worker data
+    row_ids = (h0 * 0).astype(jnp.int32)
+    matched = valid & False
     done = ~valid
 
     def cond(carry):
@@ -332,9 +334,10 @@ def probe_slots(table, key_cols, key_types, valid):
     packed, _ = pack_keys(key_cols, key_types)
     C = table.shape[0] - 1
     h0 = splitmix64(packed)
-    n = packed.shape[0]
-    slot = jnp.zeros((n,), jnp.int32)
-    matched = jnp.zeros((n,), bool)
+    # carries derive from probe inputs so they inherit shard_map's varying axis
+    # (see probe() above)
+    slot = (h0 * 0).astype(jnp.int32)
+    matched = valid & False
     done = ~valid
 
     def cond(carry):
