@@ -71,3 +71,143 @@ def test_limit_short_circuits_scan():
                       s).rows()
     assert len(r) == 7 and all(k > 5 for (k,) in r)
     assert len(calls) <= 2  # stopped after the first page(s)
+
+
+# ---------------------------------------------------------------------------- CBO
+# reference: cost/FilterStatsCalculator.java, cost/JoinStatsRule.java,
+# iterative/rule/ReorderJoins.java:98, DetermineJoinDistributionType.java:51
+
+
+def _sf1_engine():
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=1))
+    return e
+
+
+Q9 = """
+    select nation, o_year, sum(amount) as sum_profit from (
+      select n_name as nation, extract(year from o_orderdate) as o_year,
+        l_extendedprice * (1 - l_discount) - ps_supplycost * l_quantity as amount
+      from part, supplier, lineitem, partsupp, orders, nation
+      where s_suppkey = l_suppkey and ps_suppkey = l_suppkey and ps_partkey = l_partkey
+        and p_partkey = l_partkey and o_orderkey = l_orderkey
+        and s_nationkey = n_nationkey and p_name like '%green%') as profit
+    group by nation, o_year order by nation, o_year desc"""
+
+
+def _join_chain(plan):
+    """Innermost-first list of (build table | None, distribution) along the spine."""
+    from trino_tpu.sql import plan as P
+
+    chain = []
+
+    def walk(n):
+        if isinstance(n, P.Join):
+            walk(n.left)
+            t = None
+            b = n.right
+            while b is not None and not isinstance(b, P.TableScan):
+                b = b.children[0] if b.children else None
+            if isinstance(b, P.TableScan):
+                t = b.table
+            chain.append((t, n.distribution))
+            return
+        for c in n.children:
+            walk(c)
+
+    walk(plan)
+    return chain
+
+
+def test_cbo_join_order_filters_first():
+    """The selective LIKE-filtered part relation joins before the big
+    unfiltered orders/partsupp builds: greedy minimum-output ordering over
+    connector stats (reference: ReorderJoins over TableStatistics)."""
+    from trino_tpu.sql.frontend import compile_sql
+
+    e = _sf1_engine()
+    s = e.create_session("tpch")
+    chain = _join_chain(compile_sql(Q9, e, s))
+    tables = [t for t, _ in chain]
+    assert tables.index("part") < tables.index("orders")
+    assert tables.index("part") < tables.index("partsupp")
+
+
+def test_cbo_distribution_hints_scale_with_stats():
+    """Big builds (orders at SF1) plan partitioned; small builds (nation,
+    filtered part) stay replicated; the session property forces either way."""
+    from trino_tpu.sql.frontend import compile_sql
+
+    e = _sf1_engine()
+    s = e.create_session("tpch")
+    dist = dict(_join_chain(compile_sql(Q9, e, s)))
+    assert dist["orders"] == "partitioned"
+    assert dist["partsupp"] == "partitioned"
+    assert dist["nation"] == "replicated"
+    assert dist["part"] == "replicated"
+
+    q = "select count(*) c from lineitem, orders where l_orderkey = o_orderkey"
+    s2 = e.create_session("tpch")
+    e.execute_sql("set session join_distribution_type = 'BROADCAST'", s2)
+    assert _join_chain(compile_sql(q, e, s2))[0][1] == "broadcast"
+    e.execute_sql("set session join_distribution_type = 'PARTITIONED'", s2)
+    assert _join_chain(compile_sql(q, e, s2))[0][1] == "partitioned"
+
+
+def test_filter_selectivity_estimates():
+    """Selectivity formulas vs the stats they read (FilterStatsCalculator)."""
+    from trino_tpu.spi.statistics import ColumnStats
+    from trino_tpu.sql import ir
+    from trino_tpu.sql.stats import RelStats, filter_selectivity
+    from trino_tpu.types import BIGINT
+
+    stats = RelStats(1000.0, [ColumnStats(ndv=100, lo=0, hi=999)], 1000.0)
+    f = ir.FieldRef(0, BIGINT)
+    c = lambda v: ir.Constant(v, BIGINT)
+    eq = ir.Call("eq", (f, c(5)), BIGINT)
+    assert abs(filter_selectivity(eq, stats) - 0.01) < 1e-9
+    out_of_range = ir.Call("eq", (f, c(5000)), BIGINT)
+    assert filter_selectivity(out_of_range, stats) == 0.0
+    rng = ir.Call("lt", (f, c(250)), BIGINT)
+    assert 0.2 < filter_selectivity(rng, stats) < 0.3
+    both = ir.Call("and", (eq, rng), BIGINT)
+    assert abs(filter_selectivity(both, stats)
+               - filter_selectivity(eq, stats) * filter_selectivity(rng, stats)) < 1e-12
+    bet = ir.Call("between", (f, c(100), c(199)), BIGINT)
+    assert 0.05 < filter_selectivity(bet, stats) < 0.15
+
+
+def test_join_stats_containment_and_ndv():
+    """Unique-build joins use FK containment (composite PKs defeat the NDV
+    independence assumption); non-unique joins use the NDV formula."""
+    from trino_tpu.spi.statistics import ColumnStats
+    from trino_tpu.sql.stats import RelStats, join_stats
+
+    lineitem = RelStats(6_000_000.0, [ColumnStats(ndv=200_000),
+                                      ColumnStats(ndv=10_000)], 6_000_000.0)
+    partsupp = RelStats(800_000.0, [ColumnStats(ndv=200_000),
+                                    ColumnStats(ndv=10_000)], 800_000.0)
+    out = join_stats(lineitem, partsupp, [0, 1], [0, 1], build_unique=True)
+    assert out.rows == 6_000_000.0  # unfiltered PK build keeps every probe row
+    filtered = partsupp.scaled(0.1)
+    out2 = join_stats(lineitem, filtered, [0, 1], [0, 1], build_unique=True)
+    assert abs(out2.rows - 600_000.0) < 1.0
+    # non-unique: NDV formula on the dominant clause
+    a = RelStats(1000.0, [ColumnStats(ndv=100)], 1000.0)
+    b = RelStats(500.0, [ColumnStats(ndv=50)], 500.0)
+    out3 = join_stats(a, b, [0], [0])
+    assert abs(out3.rows - 1000.0 * 500.0 / 100.0) < 1.0
+
+
+def test_show_stats_uses_table_stats():
+    """SHOW STATS surfaces the same TableStats the CBO reads (tpch analytic
+    stats: date ranges, key NDVs)."""
+    e = _sf1_engine()
+    s = e.create_session("tpch")
+    rows = e.execute_sql("show stats for orders", s).rows()
+    by_col = {r[0]: r for r in rows}
+    assert by_col["o_orderkey"][1] == "1500001" or by_col["o_orderkey"][1] == "1500000"
+    assert by_col["o_orderdate"][2] != ""  # date range known
+    assert rows[-1][4] == "1500000"  # summary row_count

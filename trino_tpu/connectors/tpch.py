@@ -482,6 +482,44 @@ class TpchConnector:
             return BASE_ROWS[table]
         return int(BASE_ROWS[table] * self.sf)
 
+    def table_stats(self, table: str):
+        """Analytic TableStats for the CBO (reference: TpchMetadata's statistics
+        support feeding spi/statistics/TableStatistics): key ranges/NDVs from
+        column_range, dictionary columns exact, plus the generator's known date
+        spans and value domains that column_range doesn't carry."""
+        from ..spi.statistics import ColumnStats, TableStats
+
+        rows = float(self.row_count(table))
+        schema = self.schema(table)
+        dicts = self.dictionaries(table)
+        extra = {
+            # generator domains (see _gen_orders/_gen_lineitem above)
+            "o_orderdate": (STARTDATE, ENDDATE - 151),
+            "l_shipdate": (STARTDATE + 1, ENDDATE - 151 + 121),
+            "l_commitdate": (STARTDATE + 30, ENDDATE - 151 + 90),
+            "l_receiptdate": (STARTDATE + 2, ENDDATE - 151 + 151),
+            "l_quantity": (100, 5000), "l_discount": (0, 10), "l_tax": (0, 8),
+            "c_acctbal": (-99999, 999999), "s_acctbal": (-99999, 999999),
+            "ps_supplycost": (100, 100000), "ps_availqty": (1, 9999),
+        }
+        columns = {}
+        for f in schema.fields:
+            lo = hi = ndv = None
+            r = self.column_range(table, f.name)
+            if r and r[0] is not None:
+                lo, hi = float(r[0]), float(r[1])
+                ndv = hi - lo + 1  # dense integer keys
+            elif f.name in extra:
+                lo, hi = (float(v) for v in extra[f.name])
+                ndv = hi - lo + 1 if not f.type.is_floating else None
+            d = dicts.get(f.name)
+            if d is not None and getattr(d, "values", None) is not None:
+                ndv = float(len(d.values))
+            if ndv is not None:
+                ndv = min(ndv, rows)
+            columns[f.name] = ColumnStats(ndv=ndv, lo=lo, hi=hi)
+        return TableStats(rows, columns)
+
     # splits -----------------------------------------------------------------
     def splits(self, table: str, n_hint: int = 0) -> list[TpchSplit]:
         """Equal-size split ranges (one XLA shape class for the whole scan; trailing rows

@@ -279,8 +279,15 @@ class DistributedExecutor:
             # NOT IN 3VL facts, host-side (shared with the local executor's
             # null-aware anti: _build_null_stats / _null_aware_anti)
             build_null_stats = _build_null_stats(build_page, node.right_keys)
+            # distribution: the planner's stats-driven hint (CBO,
+            # DetermineJoinDistributionType) decides when present; AUTOMATIC
+            # plans ('replicated' hint) fall back to the actual build size
             n_build = int(np.asarray(build_page.valid_mask()).sum())
-            if n_build >= self.partition_threshold:
+            hint = getattr(node, "distribution", "replicated")
+            partitioned = (hint == "partitioned"
+                           or (hint != "broadcast"
+                               and n_build >= self.partition_threshold))
+            if partitioned:
                 return self._compile_partitioned_join(node, up, build_page, build_dicts,
                                                       build_key_types,
                                                       build_null_stats)

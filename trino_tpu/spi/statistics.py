@@ -1,0 +1,80 @@
+"""Table/column statistics SPI (reference: io.trino.spi.statistics —
+TableStatistics/ColumnStatistics flowing from ConnectorMetadata.getTableStatistics
+into the cost-based optimizer, core/trino-main/.../cost/*).
+
+Connectors expose ``table_stats(table) -> TableStats``; connectors without the
+method still contribute through ``connector_table_stats``'s assembly from the
+older surfaces (``row_count``, ``column_range``, dictionaries), so every catalog
+yields at least row counts and key ranges.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+__all__ = ["ColumnStats", "TableStats", "connector_table_stats"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnStats:
+    """Per-column statistics (reference: spi/statistics/ColumnStatistics.java)."""
+
+    ndv: Optional[float] = None  # distinct-value estimate
+    lo: Optional[float] = None  # min value (numeric-comparable domain)
+    hi: Optional[float] = None  # max value
+    null_fraction: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class TableStats:
+    """Reference: spi/statistics/TableStatistics.java."""
+
+    row_count: Optional[float] = None
+    columns: dict = dataclasses.field(default_factory=dict)  # name -> ColumnStats
+
+    def column(self, name: str) -> ColumnStats:
+        return self.columns.get(name, ColumnStats())
+
+
+def connector_table_stats(conn, table: str) -> TableStats:
+    """Assemble TableStats from a connector: its ``table_stats`` method when
+    present, else the legacy ``row_count``/``column_range``/dictionary surfaces
+    (dense integer key ranges make ndv ~ hi-lo+1 a good estimate; dictionary
+    columns have exact ndv = dictionary size)."""
+    if hasattr(conn, "table_stats"):
+        try:
+            return conn.table_stats(table)
+        except Exception:
+            pass
+    rows = None
+    if hasattr(conn, "row_count"):
+        try:
+            rows = float(conn.row_count(table))
+        except Exception:
+            rows = None
+    columns = {}
+    try:
+        schema = conn.schema(table)
+        dicts = conn.dictionaries(table) if hasattr(conn, "dictionaries") else {}
+    except Exception:
+        return TableStats(rows, {})
+    for f in schema.fields:
+        lo = hi = ndv = None
+        if hasattr(conn, "column_range"):
+            try:
+                r = conn.column_range(table, f.name)
+                if r and r[0] is not None and r[1] is not None:
+                    lo, hi = float(r[0]), float(r[1])
+                    if not f.type.is_floating:
+                        # dense integer key ranges: ndv ~ span (TPC-H keys)
+                        ndv = hi - lo + 1
+            except Exception:
+                pass
+        d = dicts.get(f.name)
+        if d is not None and getattr(d, "values", None) is not None:
+            ndv = float(len(d.values))
+        if rows is not None:
+            ndv = min(ndv, rows) if ndv is not None else None
+        columns[f.name] = ColumnStats(ndv=ndv, lo=lo, hi=hi)
+    return TableStats(rows, columns)

@@ -435,10 +435,15 @@ class Planner:
                 node = P.Filter(node, pred)
             return RelPlan(node, rel.cols, rel.unique_sets)
 
-        # comma-join planning with pushdown + greedy ordering
+        from .stats import filter_selectivity, join_stats
+
+        # comma-join planning with pushdown + cost-ranked ordering (reference:
+        # stats-driven join ordering, iterative/rule/ReorderJoins.java:98 —
+        # greedy minimum-intermediate-cardinality over connector statistics)
         rels = [r for r, _ in relations]
-        sizes = [s for _, s in relations]
-        # push single-relation conjuncts onto their relation
+        rstats = [s for _, s in relations]
+        # push single-relation conjuncts onto their relation, scaling its stats
+        # by the predicate's estimated selectivity (cost/FilterStatsCalculator)
         residual = []
         for c in conjuncts:
             placed = False
@@ -446,6 +451,7 @@ class Planner:
                 e = self._try_translate(c, r.cols)
                 if e is not None:
                     rels[i] = RelPlan(P.Filter(r.node, e), r.cols, r.unique_sets)
+                    rstats[i] = rstats[i].scaled(filter_selectivity(e, rstats[i]))
                     placed = True
                     break
             if not placed:
@@ -457,13 +463,22 @@ class Planner:
                 node = P.Filter(node, e)
             return RelPlan(node, rels[0].cols, rels[0].unique_sets)
 
-        # greedy join: start from largest relation as probe spine
-        order = sorted(range(len(rels)), key=lambda i: -sizes[i])
+        def _key_channels(eqs):
+            return ([pe.index if isinstance(pe, ir.FieldRef) else None
+                     for pe, _ in eqs],
+                    [be.index if isinstance(be, ir.FieldRef) else None
+                     for _, be in eqs])
+
+        # probe spine = largest estimated post-filter relation; each step joins
+        # the connected candidate whose estimated OUTPUT cardinality is lowest
+        # (unique-key build as the tiebreak — duplicate builds force the
+        # multi-match strategy at runtime)
+        order = sorted(range(len(rels)), key=lambda i: -rstats[i].rows)
         current = rels[order[0]]
+        cur_stats = rstats[order[0]]
         joined = {order[0]}
         pending = [i for i in order[1:]]
         while pending:
-            # connected candidates, preferring unique-key (PK) build sides, then smallest
             candidates = []
             for i in pending:
                 cand = rels[i]
@@ -473,7 +488,11 @@ class Planner:
                 build_chs = frozenset(
                     e.index for _, e in eqs if isinstance(e, ir.FieldRef))
                 unique = any(u <= build_chs for u in cand.unique_sets)
-                candidates.append((not unique, sizes[i], i, eqs, rest))
+                pks, bks = _key_channels(eqs)
+                est = join_stats(cur_stats, rstats[i], pks, bks,
+                                 build_unique=unique)
+                candidates.append((est.rows, not unique, rstats[i].rows, i, eqs,
+                                   rest, est))
             if not candidates:
                 # no pending relation connects to the spine; join equi-connected
                 # PENDING pairs first so cross products happen over the smallest
@@ -492,21 +511,33 @@ class Planner:
                         break
                 if pair is not None:
                     ii, jj, eqs2, rest2 = pair
-                    rels[ii] = self._make_join("inner", rels[ii], rels[jj], eqs2)
-                    sizes[ii] = max(sizes[ii], sizes[jj])
+                    pks, bks = _key_channels(eqs2)
+                    est2 = join_stats(rstats[ii], rstats[jj], pks, bks)
+                    rels[ii] = self._make_join(
+                        "inner", rels[ii], rels[jj], eqs2,
+                        build_rows=rstats[jj].rows if rstats[jj].known else None)
+                    rstats[ii] = est2
                     residual = rest2
                     pending.remove(jj)
                     continue
                 # genuinely unconnected: CROSS JOIN the smallest pending relation
                 # (constant-key join -> full multi-match expansion; theta predicates
                 # apply afterwards as filters — reference: JoinNode with CROSS type)
-                i = min(pending, key=lambda i: sizes[i])
+                i = min(pending, key=lambda i: rstats[i].rows)
                 current = self._make_cross_join(current, rels[i])
+                from .stats import RelStats
+
+                cur_stats = RelStats(cur_stats.rows * rstats[i].rows,
+                                     list(cur_stats.cols) + list(rstats[i].cols))
                 joined.add(i)
                 pending.remove(i)
                 continue
-            _, _, i, eqs, rest = min(candidates, key=lambda c: (c[0], c[1]))
-            current = self._make_join("inner", current, rels[i], eqs)
+            _, _, _, i, eqs, rest, est = min(
+                candidates, key=lambda c: (c[0], c[1], c[2]))
+            current = self._make_join(
+                "inner", current, rels[i], eqs,
+                build_rows=rstats[i].rows if rstats[i].known else None)
+            cur_stats = est
             residual = rest
             joined.add(i)
             pending.remove(i)
@@ -826,7 +857,7 @@ class Planner:
                 explicit_joins.append(node)
         else:
             rel = self._plan_relation(node)
-            relations.append((rel, self._estimate_rows(node)))
+            relations.append((rel, self._estimate_stats(node, rel)))
 
     def _plan_explicit(self, node) -> RelPlan:
         if not isinstance(node, A.JoinRef):
@@ -953,14 +984,20 @@ class Planner:
                 return cn, c
         raise SemanticError(f"table {name} not found in any catalog")
 
-    def _estimate_rows(self, node) -> int:
-        if isinstance(node, A.TableRef):
+    def _estimate_stats(self, node, rel):
+        """RelStats for a base relation (reference: cost/StatsCalculator — scan
+        stats flow from connector TableStatistics; subqueries get unknowns)."""
+        from ..spi.statistics import connector_table_stats
+        from .stats import scan_stats, unknown_stats
+
+        if isinstance(node, A.TableRef) and isinstance(rel.node, P.TableScan):
             try:
                 _, conn = self._resolve_table(node.name)
-                return conn.row_count(node.name[-1])
+                ts = connector_table_stats(conn, node.name[-1])
+                return scan_stats(ts, rel.node.columns)
             except Exception:
-                return 1 << 20
-        return 1 << 20
+                pass
+        return unknown_stats(len(rel.cols))
 
     def _match_equi(self, conjunct, left: RelPlan, right: RelPlan):
         """a.x = b.y with sides in different relations -> (left_expr, right_expr)."""
@@ -982,8 +1019,26 @@ class Planner:
         one = ir.Constant(1, BIGINT)
         return self._make_join("inner", probe, build, [(one, one)])
 
+    PARTITIONED_JOIN_THRESHOLD = 1 << 17  # estimated build rows; mirrors the
+    # distributed executor's actual-size default (DetermineJoinDistributionType)
+
+    def _join_distribution(self, build_rows) -> str:
+        """'replicated' | 'partitioned' | 'broadcast' (forced) from the session's
+        join_distribution_type + estimated build cardinality (reference:
+        iterative/rule/DetermineJoinDistributionType.java:51 — AUTOMATIC sizes
+        the decision from stats; explicit settings force it)."""
+        props = getattr(self.session, "properties", None) or {}
+        mode = str(props.get("join_distribution_type", "AUTOMATIC")).upper()
+        if mode == "BROADCAST":
+            return "broadcast"
+        if mode == "PARTITIONED":
+            return "partitioned"
+        if build_rows is not None and build_rows >= self.PARTITIONED_JOIN_THRESHOLD:
+            return "partitioned"
+        return "replicated"
+
     def _make_join(self, kind, probe: RelPlan, build: RelPlan, eqs,
-                   filter_expr=None) -> RelPlan:
+                   filter_expr=None, build_rows=None) -> RelPlan:
         probe_node, build_node = probe.node, build.node
         pkeys, bkeys = [], []
         for pe, be in eqs:
@@ -1005,7 +1060,8 @@ class Planner:
             + [Field(f"r{i}", c.type) for i, c in enumerate(build_cols)]
         ))
         node = P.Join(kind, probe_node, build_node, tuple(pkeys), tuple(bkeys), schema,
-                      filter=filter_expr)
+                      filter=filter_expr,
+                      distribution=self._join_distribution(build_rows))
         cols = probe_cols + build_cols
         # a many-to-one join preserves probe-row multiplicity -> probe unique sets survive
         return RelPlan(node, cols, list(probe.unique_sets))

@@ -142,8 +142,10 @@ class Limit(PlanNode):
 class Join(PlanNode):
     """reference: sql/planner/plan/JoinNode.java; equi-join with optional residual filter.
 
-    ``distribution``: 'partitioned' | 'replicated' (reference: DistributionType chosen by
-    DetermineJoinDistributionType.java:51).
+    ``distribution``: 'replicated' (auto/default — the executor may still pick the
+    partitioned strategy from the actual build size) | 'partitioned' (stats-driven
+    or session-forced) | 'broadcast' (session-forced replication).  Reference:
+    DistributionType chosen by DetermineJoinDistributionType.java:51.
     """
 
     kind: str  # inner | left | semi | anti

@@ -1,0 +1,200 @@
+"""Planner-side cardinality estimation over IR predicates (reference:
+core/trino-main cost/ — FilterStatsCalculator.java, JoinStatsRule.java,
+PlanNodeStatsEstimate; coefficients follow the reference's conventions:
+UNKNOWN_FILTER_COEFFICIENT = 0.9, unestimatable comparisons ~ 0.25).
+
+Estimates are HINTS: they rank join orders and pick join distributions; the
+runtime still self-corrects (capacity growth, actual-size distribution
+thresholds), so a bad estimate costs performance, never correctness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..spi.statistics import ColumnStats, TableStats
+from . import ir
+
+__all__ = ["RelStats", "scan_stats", "filter_selectivity", "join_stats"]
+
+UNKNOWN_FILTER_COEFFICIENT = 0.9  # reference: FilterStatsCalculator
+COMPARISON_COEFFICIENT = 0.25  # un-estimatable range predicate
+DEFAULT_ROWS = float(1 << 20)  # relations with no stats (subqueries, views)
+
+
+@dataclasses.dataclass
+class RelStats:
+    """Cardinality + per-channel column stats for a RelPlan under construction."""
+
+    rows: float
+    cols: list  # ColumnStats per channel (aligned with RelPlan.cols)
+    base_rows: Optional[float] = None  # pre-filter table cardinality (FK
+    # containment: a unique-key build filtered to rows/base_rows keeps that
+    # fraction of probe matches)
+    known: bool = True  # False for stat-less relations (subqueries/views):
+    # their DEFAULT_ROWS placeholder must rank orderings but NOT drive
+    # distribution decisions (a fabricated 1M estimate would force tiny
+    # derived-table builds onto the partitioned path)
+
+    def col(self, ch: int) -> ColumnStats:
+        if 0 <= ch < len(self.cols) and self.cols[ch] is not None:
+            return self.cols[ch]
+        return ColumnStats()
+
+    def scaled(self, selectivity: float) -> "RelStats":
+        """Post-filter stats: rows scale; NDVs cap at the new row count."""
+        rows = max(self.rows * selectivity, 1.0)
+        cols = [None if c is None else dataclasses.replace(
+            c, ndv=None if c.ndv is None else min(c.ndv, rows))
+            for c in self.cols]
+        return RelStats(rows, cols, self.base_rows, self.known)
+
+
+def scan_stats(table_stats: TableStats, field_names) -> RelStats:
+    rows = table_stats.row_count if table_stats.row_count is not None else DEFAULT_ROWS
+    return RelStats(float(rows), [table_stats.column(n) for n in field_names],
+                    float(rows), known=table_stats.row_count is not None)
+
+
+def unknown_stats(n_cols: int, rows: float = DEFAULT_ROWS) -> RelStats:
+    return RelStats(rows, [ColumnStats()] * n_cols, rows, known=False)
+
+
+# ---------------------------------------------------------------------------- selectivity
+def _const_val(e) -> Optional[float]:
+    if isinstance(e, ir.Constant) and isinstance(e.value, (int, float, bool)):
+        return float(e.value)
+    return None
+
+
+def _field_ch(e) -> Optional[int]:
+    return e.index if isinstance(e, ir.FieldRef) else None
+
+
+def _range_fraction(c: ColumnStats, lo: Optional[float], hi: Optional[float]) -> float:
+    """Fraction of [c.lo, c.hi] covered by [lo, hi] (uniformity assumption —
+    reference: StatisticRange.overlapPercentWith)."""
+    if c.lo is None or c.hi is None:
+        return COMPARISON_COEFFICIENT
+    span = c.hi - c.lo
+    if span <= 0:
+        # single-valued column: the predicate either keeps or drops everything
+        keep = (lo is None or lo <= c.lo) and (hi is None or hi >= c.hi)
+        return 1.0 if keep else 0.0
+    lo_eff = c.lo if lo is None else max(lo, c.lo)
+    hi_eff = c.hi if hi is None else min(hi, c.hi)
+    if hi_eff < lo_eff:
+        return 0.0
+    return min(max((hi_eff - lo_eff) / span, 0.0), 1.0)
+
+
+def filter_selectivity(e, stats: RelStats) -> float:
+    """Estimated fraction of rows satisfying IR predicate ``e``."""
+    if isinstance(e, ir.Constant):
+        if e.value is None:
+            return 0.0
+        return 1.0 if e.value else 0.0
+    if not isinstance(e, ir.Call):
+        return UNKNOWN_FILTER_COEFFICIENT
+    op, args = e.op, e.args
+    if op == "and":
+        s = 1.0
+        for a in args:
+            s *= filter_selectivity(a, stats)
+        return s
+    if op == "or":
+        s = 0.0
+        for a in args:
+            sa = filter_selectivity(a, stats)
+            s = s + sa - s * sa
+        return min(s, 1.0)
+    if op == "not":
+        return max(1.0 - filter_selectivity(args[0], stats), 0.0)
+    if op == "is_null":
+        ch = _field_ch(args[0])
+        return stats.col(ch).null_fraction if ch is not None else 0.1
+    if op in ("eq", "neq", "lt", "lte", "gt", "gte") and len(args) == 2:
+        ch, cv = _field_ch(args[0]), _const_val(args[1])
+        flip = {"lt": "gt", "lte": "gte", "gt": "lt", "gte": "lte"}
+        if ch is None and _field_ch(args[1]) is not None:
+            ch, cv = _field_ch(args[1]), _const_val(args[0])
+            op = flip.get(op, op)
+        if ch is None:
+            return COMPARISON_COEFFICIENT if op != "eq" else 0.1
+        c = stats.col(ch)
+        if op == "eq":
+            if cv is not None and c.lo is not None and c.hi is not None \
+                    and not (c.lo <= cv <= c.hi):
+                return 0.0
+            return 1.0 / c.ndv if c.ndv else 0.1
+        if op == "neq":
+            return 1.0 - (1.0 / c.ndv if c.ndv else 0.1)
+        if cv is None:
+            return COMPARISON_COEFFICIENT
+        if op in ("lt", "lte"):
+            return _range_fraction(c, None, cv)
+        return _range_fraction(c, cv, None)
+    if op == "between" and len(args) == 3:
+        ch = _field_ch(args[0])
+        lo, hi = _const_val(args[1]), _const_val(args[2])
+        if ch is None or lo is None or hi is None:
+            return COMPARISON_COEFFICIENT
+        return _range_fraction(stats.col(ch), lo, hi)
+    if op == "in":
+        ch = _field_ch(args[0])
+        n_values = len(args) - 1
+        if ch is not None and stats.col(ch).ndv:
+            return min(n_values / stats.col(ch).ndv, 1.0)
+        return min(0.1 * n_values, 0.5)
+    if op == "lut":
+        # dictionary-LUT predicates (LIKE/equality over encoded strings): the
+        # LUT's true-count over the dictionary is the exact value selectivity
+        import numpy as np
+
+        ch = _field_ch(args[0])
+        lut = args[1].value if isinstance(args[1], ir.Constant) else None
+        if lut is not None and getattr(lut, "dtype", None) is not None \
+                and lut.dtype == np.bool_ and lut.size:
+            return float(np.count_nonzero(lut)) / float(lut.size)
+        return COMPARISON_COEFFICIENT
+    return UNKNOWN_FILTER_COEFFICIENT
+
+
+# ---------------------------------------------------------------------------- joins
+def join_stats(left: RelStats, right: RelStats, left_keys, right_keys,
+               build_unique: bool = False) -> RelStats:
+    """Equi-join output estimate.
+
+    Unique build keys (FK -> PK, the dominant analytic shape): containment —
+    every probe row matches unless the build side was filtered, so
+    |out| = |L| * (|R| / |R_base|).  The NDV independence formula is hopeless
+    here: composite PKs like partsupp's (partkey, suppkey) have correlated key
+    columns and the per-key product under-estimates by orders of magnitude.
+
+    Otherwise the reference's NDV formula (cost/JoinStatsRule.java):
+    |L||R| / max(ndv_l, ndv_r) on the most selective clause, additional
+    clauses sqrt-dampened (correlated-clause correction)."""
+    if build_unique:
+        frac = 1.0
+        if right.base_rows and right.base_rows > 0:
+            frac = min(right.rows / right.base_rows, 1.0)
+        rows = max(left.rows * frac, 1.0)
+        return RelStats(rows, list(left.cols) + list(right.cols),
+                        known=left.known and right.known)
+    denoms = []
+    for lk, rk in zip(left_keys, right_keys):
+        ndv_l = left.col(lk).ndv if lk is not None else None
+        ndv_r = right.col(rk).ndv if rk is not None else None
+        ndv_l = min(ndv_l, left.rows) if ndv_l else None
+        ndv_r = min(ndv_r, right.rows) if ndv_r else None
+        cands = [n for n in (ndv_l, ndv_r) if n]
+        denoms.append(max(max(cands), 1.0) if cands
+                      else max(min(left.rows, right.rows), 1.0))
+    denoms.sort(reverse=True)
+    denom = 1.0
+    for j, d in enumerate(denoms):
+        denom *= d if j == 0 else d ** 0.5
+    rows = max(left.rows * right.rows / max(denom, 1.0), 1.0)
+    return RelStats(rows, list(left.cols) + list(right.cols),
+                    known=left.known and right.known)
