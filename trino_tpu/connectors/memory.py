@@ -13,7 +13,9 @@ import dataclasses
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.arrays import ArrayData, encode_arrays, pack_span, span_len
 from ..page import Page, Schema
+from ..types import ArrayType
 from .tpch import Dictionary
 
 __all__ = ["MemoryConnector"]
@@ -51,9 +53,10 @@ class _GrowableDict:
 @dataclasses.dataclass
 class _MemTable:
     schema: Schema
-    columns: list  # np arrays (string cols: int32 dict ids)
+    columns: list  # np arrays (string cols: int32 dict ids; array cols: spans)
     nulls: list  # np bool arrays | None
-    growable: dict  # column name -> _GrowableDict (string columns)
+    growable: dict  # column name -> _GrowableDict (string columns + array elems)
+    heaps: dict = dataclasses.field(default_factory=dict)  # array col -> element heap
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,7 +81,19 @@ class MemoryConnector:
 
     def dictionaries(self, table: str) -> dict:
         t = self._tables[table]
-        return {name: gd.view() for name, gd in t.growable.items()}
+        out = {}
+        for f in t.schema.fields:
+            if isinstance(f.type, ArrayType):
+                heap = t.heaps[f.name]
+                gd = t.growable.get(f.name)
+                spans = t.columns[t.schema.index(f.name)]
+                max_len = int(span_len(spans).max()) if len(spans) else 0
+                out[f.name] = ArrayData(heap, f.type.element,
+                                        elem_dict=gd.view() if gd else None,
+                                        max_len=max_len)
+            elif f.name in t.growable:
+                out[f.name] = t.growable[f.name].view()
+        return out
 
     def row_count(self, table: str) -> int:
         t = self._tables[table]
@@ -94,10 +109,15 @@ class MemoryConnector:
             if if_not_exists:
                 return False
             raise ValueError(f"table {table} already exists")
-        growable = {f.name: _GrowableDict() for f in schema.fields if f.type.is_string}
+        growable = {
+            f.name: _GrowableDict() for f in schema.fields
+            if f.type.is_string
+            or (isinstance(f.type, ArrayType) and f.type.element.is_string)}
+        heaps = {f.name: np.zeros(0, np.dtype(f.type.element.dtype))
+                 for f in schema.fields if isinstance(f.type, ArrayType)}
         self._tables[table] = _MemTable(
             schema, [np.empty((0,), np.dtype(f.type.dtype)) for f in schema.fields],
-            [None] * len(schema.fields), growable)
+            [None] * len(schema.fields), growable, heaps)
         return True
 
     def drop_table(self, table: str, if_exists=False) -> None:
@@ -116,7 +136,19 @@ class MemoryConnector:
             vals = decoded_columns[i]
             nulls = np.array([v is None for v in vals], bool) if \
                 null_flags is None else np.asarray(null_flags[i], bool)
-            if f.type.is_string:
+            if isinstance(f.type, ArrayType):
+                # rows are python lists (or None); elements flatten into the
+                # column's heap, the span column gets (offset | len) entries
+                gd = t.growable.get(f.name)
+                if gd is not None:  # one dictionary-encode call per row
+                    vals = [None if r is None else gd.encode(list(r)).tolist()
+                            for r in vals]
+                spans, _, heap = encode_arrays(vals, t.heaps[f.name].dtype)
+                base = len(t.heaps[f.name])
+                spans = np.where(spans != 0, spans + pack_span(base, 0), spans)
+                t.heaps[f.name] = np.concatenate([t.heaps[f.name], heap])
+                arr = spans
+            elif f.type.is_string:
                 arr = t.growable[f.name].encode(vals)
             else:
                 arr = np.array([0 if v is None else v for v in vals],

@@ -203,6 +203,11 @@ class LocalExecutor:
                 return page, dicts
             child, dicts = self._execute_to_page(node.child)
             return _limit_page(child, node.count), dicts
+        if isinstance(node, P.Unnest):
+            child, dicts = self._execute_to_page(node.child)
+            page, odicts = _run_unnest(node, child, dicts)
+            self._record(node, page, t0)
+            return page, odicts
         if isinstance(node, P.Aggregate):
             page, dicts = self._run_aggregate(node)
             self._record(node, page, t0)
@@ -301,7 +306,8 @@ class LocalExecutor:
             return _Stream(node.schema, tuple(None for _ in node.schema.fields),
                            lambda: iter([page]), lambda c, n, v, aux: (c, n, v))
 
-        if isinstance(node, (P.Aggregate, P.Sort, P.Limit, P.Output, P.Window)):
+        if isinstance(node, (P.Aggregate, P.Sort, P.Limit, P.Output, P.Window,
+                             P.Unnest)):
             # blocking sub-plan feeding a streaming consumer: run it, emit its one
             # page.  The first execution (needed for dictionary metadata) is reused
             # once; later executions re-run the child so volatile sources (system
@@ -1397,6 +1403,53 @@ def _gather_build(table: JoinTable, row_ids, matched, kind):
     return tuple(cols), tuple(nulls)
 
 
+def _run_unnest(node: P.Unnest, child: Page, cdicts):
+    """Device-side UNNEST expansion (reference: operator/unnest/UnnestOperator.java,
+    re-designed as the searchsorted expansion map of ops/arrays.unnest_indices —
+    the same fixed-capacity pattern as the multi-match join).  Parallel arrays
+    zip by position; shorter ones pad with NULL."""
+    from ..ops.arrays import span_len, span_start, unnest_indices
+
+    valid = child.valid_mask()
+    spans = [child.columns[ch] for ch in node.unnest_channels]
+    span_nulls = [child.null_masks[ch] for ch in node.unnest_channels]
+    lens = None
+    per_ch_lens = []
+    for sp, nm in zip(spans, span_nulls):
+        ln = span_len(sp)
+        if nm is not None:
+            ln = jnp.where(nm, 0, ln)
+        ln = jnp.where(valid, ln, 0)
+        per_ch_lens.append(ln)
+        lens = ln if lens is None else jnp.maximum(lens, ln)
+    total = int(jnp.sum(lens))  # one host sync; unnest is a blocking operator
+    cap = max(1 << max(total - 1, 1).bit_length(), 16)
+    row, ordinal, in_range = unnest_indices(lens, cap)
+
+    out_cols, out_nulls = [], []
+    dicts = []
+    for ch in node.replicate:
+        out_cols.append(child.columns[ch][row])
+        nm = child.null_masks[ch]
+        out_nulls.append(None if nm is None else nm[row])
+        dicts.append(cdicts[ch] if cdicts and ch < len(cdicts) else None)
+    for sp, ln_c, data in zip(spans, per_ch_lens, node.array_datas):
+        heap = jnp.asarray(data.values)
+        start = span_start(sp)[row]
+        pos = jnp.clip(start + ordinal, 0, max(heap.shape[0] - 1, 0))
+        val = heap[pos] if heap.shape[0] else jnp.zeros(cap, heap.dtype)
+        out_cols.append(val)
+        short = ordinal >= ln_c[row]  # zipped shorter array pads with NULL
+        out_nulls.append(short if bool(jnp.any(short)) else None)
+        dicts.append(data.elem_dict)
+    if node.ordinality:
+        out_cols.append((ordinal + 1).astype(jnp.int64))
+        out_nulls.append(None)
+        dicts.append(None)
+    page = Page(node.schema, tuple(out_cols), tuple(out_nulls), in_range)
+    return page, tuple(dicts)
+
+
 def _values_page(node: P.Values) -> Page:
     cols = []
     for ci, f in enumerate(node.schema.fields):
@@ -1525,6 +1578,11 @@ def _materialize(page: Page, dicts) -> MaterializedResult:
             dec = arr.astype(np.float64) / (10**f.type.scale)
         elif f.type.is_string and dicts[i] is not None:
             dec = dicts[i].decode(arr)
+        else:
+            from ..types import ArrayType, MapType
+
+            if isinstance(f.type, (ArrayType, MapType)) and dicts[i] is not None:
+                dec = dicts[i].decode(arr)  # spans -> python lists / dicts
         if pnulls[i] is not None:
             nm = pnulls[i][valid]
             dec = np.array([None if m else v for v, m in zip(dec.tolist(), nm)], dtype=object) \

@@ -1,0 +1,127 @@
+"""Array/map value runtime: span-packed columns over element heaps.
+
+TPU-first re-design of the reference's nested blocks (spi/block/ArrayBlock.java,
+MapBlock.java): a column of array(T) is ONE fixed-width int64 device column of
+packed spans (start << 24 | length) referencing an element heap.  The heap is
+position-independent, so every row-shuffling operator (filter compaction, join
+gather, sort, exchange) moves 8-byte spans and never touches elements — the
+same late-materialization trick as dictionary strings.  Heaps ride the
+planner's per-channel dictionary slot (ColumnInfo.dict / Project.dicts), whose
+``decode`` hook the result path already calls.
+
+Element access (subscript, contains, unnest) gathers from the heap, embedded in
+the traced program as a constant — like the dictionary LUTs, acceptable for the
+SQL-surface scale arrays run at (the columnar hot path stays span-only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SPAN_BITS", "ArrayData", "MapData", "pack_span", "span_start",
+           "span_len", "encode_arrays"]
+
+SPAN_BITS = 24  # max 16M elements per array; 2^39 heap rows
+_LEN_MASK = (1 << SPAN_BITS) - 1
+
+
+def pack_span(start, length):
+    return (start << SPAN_BITS) | length
+
+
+def span_start(span):
+    return span >> SPAN_BITS
+
+
+def span_len(span):
+    return span & _LEN_MASK
+
+
+@dataclasses.dataclass
+class ArrayData:
+    """Heap for one array(T) column (host-side numpy; device-transferred at the
+    access sites).  ``elem_dict`` decodes string elements; plugged into the
+    engine's dictionary slot so results decode through the normal path."""
+
+    values: np.ndarray  # flattened element heap
+    elem_type: object
+    elem_dict: object = None
+    max_len: int = 0
+
+    def decode(self, spans: np.ndarray) -> np.ndarray:
+        """Span column -> object array of python lists (result materialization)."""
+        starts = np.asarray(span_start(spans))
+        lens = np.asarray(span_len(spans))
+        vals = self.values
+        if self.elem_dict is not None:
+            vals = self.elem_dict.decode(vals.astype(np.int64))
+        elif getattr(self.elem_type, "is_decimal", False):
+            vals = vals.astype(np.float64) / (10 ** self.elem_type.scale)
+        out = np.empty(len(starts), dtype=object)
+        for i, (s, l) in enumerate(zip(starts.tolist(), lens.tolist())):
+            out[i] = list(vals[s:s + l].tolist())
+        return out
+
+
+@dataclasses.dataclass
+class MapData:
+    """Parallel key/value heaps for one map(K, V) column."""
+
+    keys: np.ndarray
+    values: np.ndarray
+    key_type: object
+    value_type: object
+    key_dict: object = None
+    value_dict: object = None
+    max_len: int = 0
+
+    def decode(self, spans: np.ndarray) -> np.ndarray:
+        starts = np.asarray(span_start(spans))
+        lens = np.asarray(span_len(spans))
+        ks, vs = self.keys, self.values
+        if self.key_dict is not None:
+            ks = self.key_dict.decode(ks.astype(np.int64))
+        if self.value_dict is not None:
+            vs = self.value_dict.decode(vs.astype(np.int64))
+        out = np.empty(len(starts), dtype=object)
+        for i, (s, l) in enumerate(zip(starts.tolist(), lens.tolist())):
+            out[i] = dict(zip(ks[s:s + l].tolist(), vs[s:s + l].tolist()))
+        return out
+
+
+def encode_arrays(rows, elem_dtype, encoder=None):
+    """Python lists (None allowed) -> (spans int64, null mask, heap ndarray).
+
+    The storage path (memory connector INSERT, literal folding): elements
+    flatten into one heap in row order; each row's span points at its slice."""
+    spans = np.zeros(len(rows), np.int64)
+    nulls = np.zeros(len(rows), bool)
+    flat: list = []
+    for i, r in enumerate(rows):
+        if r is None:
+            nulls[i] = True
+            continue
+        vals = [encoder(v) for v in r] if encoder else list(r)
+        spans[i] = pack_span(len(flat), len(vals))
+        flat.extend(vals)
+    heap = np.asarray(flat, dtype=elem_dtype) if flat else np.zeros(0, elem_dtype)
+    return spans, (nulls if nulls.any() else None), heap
+
+
+def unnest_indices(lens, total: int):
+    """Expansion map for UNNEST (device): output slot j -> (input row i,
+    ordinal k, in_range).  Same searchsorted shape as the multi-match join
+    expansion (reference: operator/unnest/UnnestOperator.java's per-position
+    entry counts).  ``lens`` = per input row output count (0 for invalid rows);
+    ``total`` is the static output capacity."""
+    incl = jnp.cumsum(lens)
+    j = jnp.arange(total, dtype=incl.dtype)
+    row = jnp.searchsorted(incl, j, side="right").astype(jnp.int32)
+    row_safe = jnp.minimum(row, lens.shape[0] - 1)
+    before = incl[row_safe] - lens[row_safe]
+    ordinal = (j - before).astype(jnp.int32)
+    in_range = j < incl[-1] if lens.shape[0] else jnp.zeros((total,), bool)
+    return row_safe, ordinal, in_range

@@ -72,7 +72,10 @@ def connector_table_stats(conn, table: str) -> TableStats:
             except Exception:
                 pass
         d = dicts.get(f.name)
-        if d is not None and getattr(d, "values", None) is not None:
+        # only STRING dictionaries carry value-set NDV; an ArrayData element
+        # heap also rides the dictionary slot but its length is not an NDV
+        if f.type.is_string and d is not None \
+                and getattr(d, "values", None) is not None:
             ndv = float(len(d.values))
         if rows is not None:
             ndv = min(ndv, rows) if ndv is not None else None

@@ -409,15 +409,103 @@ class Planner:
             return RelPlan(P.Values(((0,),), schema), [ColumnInfo(None, "dummy", BIGINT)])
         relations: list[tuple] = []  # (RelPlan, rows_estimate)
         explicit_joins: list = []
+        self._pending_unnests = []
         self._flatten_from(q.from_, relations, explicit_joins)
         conjuncts = _split_conjuncts(q.where)
         # subquery predicates (IN/EXISTS/correlated scalar) apply after the base join tree
         sub_conjs = [c for c in conjuncts if _has_subquery(c)]
         conjuncts = [c for c in conjuncts if not _has_subquery(c)]
-        rel = self._plan_from_base(relations, explicit_joins, conjuncts, q)
+        unnests, self._pending_unnests = self._pending_unnests, []
+        deferred = []
+        if unnests:
+            # conjuncts naming unnest output columns resolve only after expansion
+            out_names = set()
+            for un in unnests:
+                out_names.update(un.columns)
+                if un.alias:
+                    out_names.add(un.alias)
+            def mentions_unnest(c):
+                found = []
+
+                def walk(n):
+                    if isinstance(n, A.Identifier) and (
+                            n.parts[-1] in out_names
+                            or (len(n.parts) > 1 and n.parts[-2] in out_names)):
+                        found.append(n)
+                    for f in getattr(n, "__dataclass_fields__", ()):
+                        v = getattr(n, f)
+                        if isinstance(v, A.Node):
+                            walk(v)
+                        elif isinstance(v, tuple):
+                            for x in v:
+                                if isinstance(x, A.Node):
+                                    walk(x)
+
+                walk(c)
+                return bool(found)
+
+            deferred = [c for c in conjuncts if mentions_unnest(c)]
+            conjuncts = [c for c in conjuncts if c not in deferred]
+        drop_base = False
+        if not relations and not explicit_joins and unnests:
+            # FROM UNNEST(...) alone: expand over a synthetic single row
+            schema = Schema.of(("dummy", BIGINT))
+            rel = RelPlan(P.Values(((0,),), schema),
+                          [ColumnInfo(None, "dummy", BIGINT)])
+            deferred = conjuncts + deferred
+            drop_base = True
+        else:
+            rel = self._plan_from_base(relations, explicit_joins, conjuncts, q)
+        for un in unnests:
+            rel = self._apply_unnest(un, rel, drop_base=drop_base)
+            drop_base = False
+        for c in deferred:
+            e, _ = self.translate(c, rel.cols)
+            rel = RelPlan(P.Filter(rel.node, e), rel.cols, rel.unique_sets)
         for c in sub_conjs:
             rel = self._apply_subquery_conjunct(c, rel)
         return rel
+
+    def _apply_unnest(self, un: A.UnnestRef, rel: RelPlan,
+                      drop_base: bool = False) -> RelPlan:
+        """Expand array-typed expressions over ``rel`` (the CROSS JOIN UNNEST
+        shape; reference: sql/planner/plan/UnnestNode.java).  Multiple arrays
+        zip positionally, shorter ones padding with NULL (the reference's
+        parallel-unnest semantics)."""
+        from ..types import ArrayType
+
+        node = rel.node
+        channels, datas = [], []
+        for expr_ast in un.exprs:
+            e, d = self.translate(expr_ast, rel.cols)
+            if not isinstance(e.type, ArrayType) or d is None:
+                raise SemanticError("UNNEST expects array-typed arguments")
+            ch, node = _ensure_channel(node, e, rel.cols)
+            channels.append(ch)
+            datas.append(d)
+        n_child = len(node.schema.fields)
+        replicate = tuple(range(n_child)) if not drop_base else ()
+        names = list(un.columns)
+        while len(names) < len(channels) + (1 if un.ordinality else 0):
+            names.append(f"col{len(names) + 1}" if names or len(channels) > 1
+                         else "col")
+        elem_fields = [Field(names[i], d.elem_type) for i, d in enumerate(datas)]
+        out_fields = ([f for i, f in enumerate(node.schema.fields)
+                       if i in replicate] + elem_fields
+                      + ([Field(names[len(channels)], BIGINT)]
+                         if un.ordinality else []))
+        schema = Schema(tuple(out_fields))
+        unode = P.Unnest(node, replicate, tuple(channels), tuple(datas),
+                         un.ordinality, schema)
+        pad = [ColumnInfo(None, "", f.type)
+               for f in node.schema.fields[len(rel.cols):]]
+        base_cols = [] if drop_base else list(rel.cols) + pad
+        cols = base_cols + [
+            ColumnInfo(un.alias, names[i], d.elem_type, d.elem_dict)
+            for i, d in enumerate(datas)]
+        if un.ordinality:
+            cols.append(ColumnInfo(un.alias, names[len(channels)], BIGINT))
+        return RelPlan(unode, cols, [])
 
     def _plan_from_base(self, relations, explicit_joins, conjuncts, q) -> RelPlan:
 
@@ -855,6 +943,11 @@ class Planner:
                 self._flatten_from(node.right, relations, explicit_joins)
             else:
                 explicit_joins.append(node)
+        elif isinstance(node, A.UnnestRef):
+            # lateral: UNNEST args may reference sibling relations' columns, so
+            # expansion applies AFTER the base join (reference: UnnestNode under
+            # the correlated-join rewrite, CROSS JOIN UNNEST shape)
+            self._pending_unnests.append(node)
         else:
             rel = self._plan_relation(node)
             relations.append((rel, self._estimate_stats(node, rel)))
@@ -1267,6 +1360,165 @@ class Planner:
                                         agg_cols, [])
 
     # ---------------------------------------------------------------- expression translation
+    # ---------------------------------------------------------------- arrays/maps/rows
+    def _translate_array_literal(self, ast: A.ArrayLiteral, cols):
+        """ARRAY[c1, ..., ck] with constant elements -> a span constant + a
+        plan-time element heap (ops/arrays.ArrayData riding the dictionary
+        slot).  Reference: sql/ir constant folding of ArrayConstructor."""
+        from ..connectors.tpch import Dictionary
+        from ..ops.arrays import ArrayData, pack_span
+        from ..types import ArrayType, VARCHAR
+
+        items = ast.items
+        if items and all(isinstance(i, A.StringLit) for i in items):
+            values = np.array(sorted({i.value for i in items}), dtype=object)
+            d = Dictionary(values=values)
+            heap = np.array([d.lookup(i.value) for i in items], np.int32)
+            t = VARCHAR
+            return (ir.Constant(pack_span(0, len(items)), ArrayType.of(t)),
+                    ArrayData(heap, t, elem_dict=d, max_len=len(items)))
+        consts = []
+        for it in items:
+            e, _ = self._translate(it, cols)
+            if not isinstance(e, ir.Constant) or e.value is None:
+                raise SemanticError(
+                    "array literal elements must be non-NULL constants")
+            consts.append(e)
+        t = BIGINT if not consts else consts[0].type
+        for e in consts[1:]:
+            t = common_super_type(t, e.type)
+        vals = []
+        for e in consts:
+            v = e.value
+            if t.is_floating and not e.type.is_floating:
+                scale = 10 ** e.type.scale if e.type.is_decimal else 1
+                v = float(v) / scale
+            elif t.is_decimal:
+                v = int(v) * 10 ** (t.scale - (e.type.scale if e.type.is_decimal else 0))
+            vals.append(v)
+        heap = np.asarray(vals, dtype=np.dtype(t.dtype)) if vals \
+            else np.zeros(0, np.dtype(t.dtype))
+        return (ir.Constant(pack_span(0, len(vals)), ArrayType.of(t)),
+                ArrayData(heap, t, max_len=len(vals)))
+
+    def _translate_subscript(self, ast: A.Subscript, cols):
+        """base[i] — arrays/maps gather from the heap; ROW field access folds
+        at plan time (struct-of-columns: the i-th constructor argument IS the
+        field)."""
+        from ..types import ArrayType, MapType
+
+        if isinstance(ast.base, A.FuncCall) and ast.base.name == "row":
+            if not isinstance(ast.index, A.NumberLit):
+                raise SemanticError("row subscript must be a literal ordinal")
+            i = int(ast.index.text)
+            if not (1 <= i <= len(ast.base.args)):
+                raise SemanticError(f"row field ordinal {i} out of range")
+            return self._translate(ast.base.args[i - 1], cols)
+        base, bd = self._translate(ast.base, cols)
+        if isinstance(base.type, ArrayType):
+            if bd is None:
+                raise SemanticError("array value carries no element heap")
+            idx, _ = self._translate(ast.index, cols)
+            e = ir.Call("array_get",
+                        (base, _coerce(idx, BIGINT),
+                         ir.Constant(np.asarray(bd.values), UNKNOWN)),
+                        bd.elem_type)
+            return e, bd.elem_dict
+        if isinstance(base.type, MapType):
+            return self._translate_map_get(base, bd, ast.index, cols)
+        raise SemanticError(f"cannot subscript a value of type {base.type}")
+
+    def _translate_map_get(self, base, md, key_ast, cols):
+        if md is None:
+            raise SemanticError("map value carries no element heaps")
+        if isinstance(key_ast, A.StringLit):
+            if md.key_dict is None:
+                raise SemanticError("string key over a non-string map")
+            key = ir.Constant(md.key_dict.lookup(key_ast.value), VarcharType.of(None))
+        else:
+            key, _ = self._translate(key_ast, cols)
+        e = ir.Call("map_get",
+                    (base, key, ir.Constant(np.asarray(md.keys), UNKNOWN),
+                     ir.Constant(np.asarray(md.values), UNKNOWN)),
+                    md.value_type, meta=(max(md.max_len, 1),))
+        return e, md.value_dict
+
+    def _translate_collection_func(self, ast: A.FuncCall, cols):
+        """cardinality/element_at/contains/sequence/map/map_keys/map_values/row
+        (reference: operator/scalar/ArrayFunctions, MapFunctions,
+        SequenceFunction)."""
+        from ..ops.arrays import ArrayData, MapData, pack_span
+        from ..types import ArrayType, MapType, RowType
+
+        name, args = ast.name, ast.args
+        if name == "cardinality":
+            e, d = self._translate(args[0], cols)
+            if not isinstance(e.type, (ArrayType, MapType)):
+                raise SemanticError("cardinality expects an array or map")
+            return ir.Call("span_len", (e,), BIGINT), None
+        if name == "element_at":
+            return self._translate_subscript(
+                A.Subscript(args[0], args[1]), cols)
+        if name == "contains":
+            base, bd = self._translate(args[0], cols)
+            if not isinstance(base.type, ArrayType) or bd is None:
+                raise SemanticError("contains expects an array")
+            if isinstance(args[1], A.StringLit):
+                if bd.elem_dict is None:
+                    raise SemanticError("string needle over a non-string array")
+                needle = ir.Constant(bd.elem_dict.lookup(args[1].value),
+                                     VarcharType.of(None))
+            else:
+                needle, _ = self._translate(args[1], cols)
+            e = ir.Call("array_contains",
+                        (base, needle, ir.Constant(np.asarray(bd.values), UNKNOWN)),
+                        BOOLEAN, meta=(max(bd.max_len, 1),))
+            return e, None
+        if name == "sequence":
+            vals = []
+            for a in args:
+                e, _ = self._translate(a, cols)
+                if not isinstance(e, ir.Constant):
+                    raise SemanticError("sequence bounds must be constants")
+                vals.append(int(e.value))
+            lo, hi = vals[0], vals[1]
+            step = vals[2] if len(vals) > 2 else 1
+            if step == 0:
+                raise SemanticError("sequence step must not be zero")
+            heap = np.arange(lo, hi + (1 if step > 0 else -1), step, dtype=np.int64)
+            return (ir.Constant(pack_span(0, len(heap)), ArrayType.of(BIGINT)),
+                    ArrayData(heap, BIGINT, max_len=len(heap)))
+        if name == "map":
+            (ke, kd) = self._translate(args[0], cols)
+            (ve, vd) = self._translate(args[1], cols)
+            if not (isinstance(ke, ir.Constant) and isinstance(ve, ir.Constant)
+                    and isinstance(ke.type, ArrayType)
+                    and isinstance(ve.type, ArrayType)):
+                raise SemanticError("map() expects constant array arguments")
+            if len(kd.values) != len(vd.values):
+                raise SemanticError("map keys/values length mismatch")
+            md = MapData(kd.values, vd.values, kd.elem_type, vd.elem_type,
+                         kd.elem_dict, vd.elem_dict, max_len=kd.max_len)
+            t = MapType.of(kd.elem_type, vd.elem_type)
+            return ir.Constant(int(ke.value), t), md
+        if name in ("map_keys", "map_values"):
+            e, md = self._translate(args[0], cols)
+            if not isinstance(e.type, MapType) or md is None:
+                raise SemanticError(f"{name} expects a map")
+            arr = (ArrayData(md.keys, md.key_type, md.key_dict, md.max_len)
+                   if name == "map_keys"
+                   else ArrayData(md.values, md.value_type, md.value_dict,
+                                  md.max_len))
+            t = ArrayType.of(arr.elem_type)
+            return dataclasses.replace(e, type=t), arr
+        if name == "row":
+            # struct-of-columns: a row value only exists through field access
+            # (folded in _translate_subscript); reaching here means it escaped
+            raise SemanticError(
+                "row(...) values must be field-accessed (row(...)[n]); "
+                "standalone row channels flatten at plan time")
+        raise SemanticError(f"unknown collection function {name}")
+
     def _try_translate(self, ast, cols):
         try:
             e, _ = self.translate(ast, cols)
@@ -1290,6 +1542,10 @@ class Planner:
             return ir.Constant(None, UNKNOWN), None
         if isinstance(ast, A.BoolLit):
             return ir.Constant(ast.value, BOOLEAN), None
+        if isinstance(ast, A.ArrayLiteral):
+            return self._translate_array_literal(ast, cols)
+        if isinstance(ast, A.Subscript):
+            return self._translate_subscript(ast, cols)
         if isinstance(ast, A.Identifier):
             ch = _resolve_column(ast, cols)
             c = cols[ch]
@@ -1474,10 +1730,15 @@ class Planner:
     _MATH_DOUBLE_FUNCS = ("sqrt", "exp", "ln", "log10", "log2", "sin", "cos", "tan",
                           "asin", "acos", "atan", "cbrt", "degrees", "radians")
 
+    _COLLECTION_FUNCS = ("cardinality", "element_at", "contains", "sequence",
+                         "map", "map_keys", "map_values", "row")
+
     def _translate_func(self, ast: A.FuncCall, cols):
         name = ast.name
         if name in AGG_FUNCS:
             raise SemanticError(f"aggregate {name} in scalar context")
+        if name in self._COLLECTION_FUNCS:
+            return self._translate_collection_func(ast, cols)
         if name == "round" and len(ast.args) == 2:
             v, _ = self._translate(ast.args[0], cols)
             if not isinstance(ast.args[1], A.NumberLit):
@@ -2028,7 +2289,8 @@ def _arith(op: str, l: ir.Expr, r: ir.Expr) -> ir.Expr:
 
 
 def _type_from_name(name: str, params) -> Type:
-    from ..types import BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, SMALLINT, TINYINT
+    from ..types import (ArrayType, BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER,
+                         MapType, REAL, RowType, SMALLINT, TINYINT)
 
     m = {"bigint": BIGINT, "integer": INTEGER, "int": INTEGER, "smallint": SMALLINT,
          "tinyint": TINYINT, "double": DOUBLE, "real": REAL, "boolean": BOOLEAN, "date": DATE}
@@ -2040,6 +2302,14 @@ def _type_from_name(name: str, params) -> Type:
         return DecimalType.of(min(p, 18), s)
     if name in ("varchar", "char"):
         return VarcharType.of(params[0] if params else None)
+    if name == "array" and params:
+        return ArrayType.of(_type_from_name(*params[0]))
+    if name == "map" and len(params) == 2:
+        return MapType.of(_type_from_name(*params[0]), _type_from_name(*params[1]))
+    if name == "row" and params:
+        names = [fn for fn, _ in params]
+        types = [_type_from_name(*tn) for _, tn in params]
+        return RowType.of(types, names)
     raise SemanticError(f"unknown type {name}")
 
 

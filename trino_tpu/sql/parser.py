@@ -69,6 +69,22 @@ class Star(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class ArrayLiteral(Node):
+    """ARRAY[e1, ..., en] (reference: grammar arrayConstructor)."""
+
+    items: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Subscript(Node):
+    """base[index] — 1-based array/map/row element access
+    (reference: grammar subscript -> SubscriptExpression)."""
+
+    base: Node
+    index: Node
+
+
+@dataclasses.dataclass(frozen=True)
 class BinaryOp(Node):
     op: str
     left: Node
@@ -181,6 +197,17 @@ class SubqueryRef(Node):
     query: "Select"
     alias: Optional[str]
     columns: tuple = ()  # derived-table column alias list: x (a, b, ...)
+
+
+@dataclasses.dataclass(frozen=True)
+class UnnestRef(Node):
+    """UNNEST(a1, ..., ak) [WITH ORDINALITY] [AS t(c1, ...)]
+    (reference: grammar unnest -> sql/planner/plan/UnnestNode.java)."""
+
+    exprs: tuple
+    alias: Optional[str] = None
+    columns: tuple = ()
+    ordinality: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -337,7 +364,7 @@ _TOKEN_RE = re.compile(
   | (?P<number>\d+(\.\d*)?([eE][+-]?\d+)?|\.\d+)
   | (?P<string>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*|"(?:[^"]|"")*")
-  | (?P<op><=|>=|<>|!=|\|\||[-+*/%(),.;<>=?])
+  | (?P<op><=|>=|<>|!=|\|\||[-+*/%(),.;<>=?\[\]])
     """,
     re.VERBOSE,
 )
@@ -760,6 +787,22 @@ class Parser:
             ref = self.parse_table_ref()
             self.expect(")")
             return ref
+        if self.peek().kind == "ident" and self.peek().value == "unnest" \
+                and self.peek(1).kind == "op" and self.peek(1).value == "(":
+            self.next()
+            self.next()
+            exprs = [self.parse_expr()]
+            while self.accept(","):
+                exprs.append(self.parse_expr())
+            self.expect(")")
+            ordinality = False
+            if self.peek().value == "with" and self.peek(1).value == "ordinality":
+                self.next()
+                self.next()
+                ordinality = True
+            alias = self._table_alias()
+            cols = self._column_alias_list() if alias else ()
+            return UnnestRef(tuple(exprs), alias, cols, ordinality)
         name = [self.expect_kind("ident").value]
         while self.accept("."):
             name.append(self.expect_kind("ident").value)
@@ -915,7 +958,13 @@ class Parser:
             return UnaryOp("negate", self.parse_unary())
         if self.accept("+"):
             return self.parse_unary()
-        return self.parse_primary()
+        e = self.parse_primary()
+        while self.peek().kind == "op" and self.peek().value == "[":
+            self.next()
+            idx = self.parse_expr()
+            self.expect("]")
+            e = Subscript(e, idx)
+        return e
 
     def parse_primary(self) -> Node:
         t = self.peek()
@@ -998,6 +1047,17 @@ class Parser:
             # keywords that are also builtin function names in call position
             t = Token("ident", t.value, t.pos)
             self.tokens[self.i] = t
+        if t.kind == "ident" and t.value == "array" \
+                and self.peek(1).kind == "op" and self.peek(1).value == "[":
+            self.next()
+            self.next()
+            items = []
+            if not (self.peek().kind == "op" and self.peek().value == "]"):
+                items = [self.parse_expr()]
+                while self.accept(","):
+                    items.append(self.parse_expr())
+            self.expect("]")
+            return ArrayLiteral(tuple(items))
         if t.kind == "ident":
             # function call or (qualified) identifier
             if self.peek(1).kind == "op" and self.peek(1).value == "(":
@@ -1076,9 +1136,17 @@ class Parser:
         name = t.value.lower()
         params = []
         if self.accept("("):
-            params.append(int(self.expect_kind("number").value))
-            while self.accept(","):
-                params.append(int(self.expect_kind("number").value))
+            while True:
+                if self.peek().kind == "number":
+                    params.append(int(self.next().value))
+                elif name == "row":
+                    # row(field type, ...) — named fields
+                    fname = self.expect_kind("ident").value
+                    params.append((fname, self.parse_type_name()))
+                else:
+                    params.append(self.parse_type_name())  # nested type
+                if not self.accept(","):
+                    break
             self.expect(")")
         return name, tuple(params)
 

@@ -193,6 +193,28 @@ class Window(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class Unnest(PlanNode):
+    """reference: sql/planner/plan/UnnestNode.java / operator/unnest/UnnestOperator.java.
+
+    Expands array-typed channels into one output row per element: replicate
+    channels repeat per element (the CROSS JOIN UNNEST shape), unnest channels
+    emit their elements; optional ordinality channel appends the 1-based
+    element index.  Expansion uses the searchsorted map of ops/arrays.py —
+    the same device pattern as the multi-match join."""
+
+    child: PlanNode
+    replicate: tuple  # child channel indices carried through (repeated)
+    unnest_channels: tuple  # child channel indices of array columns to expand
+    array_datas: tuple  # ops.arrays.ArrayData per unnest channel (element heaps)
+    ordinality: bool
+    schema: Schema
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
 class Union(PlanNode):
     """UNION ALL: concatenates child streams (reference: sql/planner/plan/UnionNode.java;
     distinct/intersect/except are planned as aggregation/joins on top, like the

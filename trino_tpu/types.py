@@ -35,6 +35,9 @@ __all__ = [
     "DecimalType",
     "CharType",
     "VarcharType",
+    "ArrayType",
+    "MapType",
+    "RowType",
     "UNKNOWN",
     "common_super_type",
     "parse_date_literal",
@@ -125,6 +128,58 @@ class CharType(Type):
     @staticmethod
     def of(length: int) -> "CharType":
         return CharType(name=f"char({length})", dtype=jnp.int32, length=length)
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrayType(Type):
+    """array(T) — TPU-first layout: the column stores a packed int64 SPAN
+    (start << 24 | length) into a host/plan-side element heap (ops/arrays.py
+    ArrayData).  Row-shuffling operators (filter/join/sort) move only the
+    8-byte spans; elements materialize late, exactly like dictionary strings.
+    Reference: spi/block/ArrayBlock.java (offsets + flattened values block).
+    """
+
+    element: Type = None
+
+    @staticmethod
+    def of(element: Type) -> "ArrayType":
+        return ArrayType(name=f"array({element.name})", dtype=jnp.int64,
+                         element=element)
+
+
+@dataclasses.dataclass(frozen=True)
+class MapType(Type):
+    """map(K, V): one span column into parallel key/value heaps
+    (reference: spi/block/MapBlock.java)."""
+
+    key: Type = None
+    value: Type = None
+
+    @staticmethod
+    def of(key: Type, value: Type) -> "MapType":
+        return MapType(name=f"map({key.name}, {value.name})", dtype=jnp.int64,
+                       key=key, value=value)
+
+
+@dataclasses.dataclass(frozen=True)
+class RowType(Type):
+    """row(f1 T1, ...) — struct-of-columns: a row-typed value is FLATTENED into
+    one page channel per field at plan time (the page already is a struct of
+    columns), so row construction/field access are planner rewrites with no
+    runtime representation.  Reference: spi/block/RowBlock.java (one child
+    block per field).
+    """
+
+    field_types: tuple = ()
+    field_names: tuple = ()
+
+    @staticmethod
+    def of(field_types, field_names=None) -> "RowType":
+        names = tuple(field_names) if field_names else tuple(
+            f"f{i}" for i in range(len(field_types)))
+        sig = ", ".join(f"{n} {t.name}" for n, t in zip(names, field_types))
+        return RowType(name=f"row({sig})", dtype=jnp.int8,
+                       field_types=tuple(field_types), field_names=names)
 
 
 BIGINT = Type("bigint", jnp.int64)
