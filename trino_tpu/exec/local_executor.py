@@ -1410,6 +1410,12 @@ def _run_unnest(node: P.Unnest, child: Page, cdicts):
     zip by position; shorter ones pad with NULL."""
     from ..ops.arrays import span_len, span_start, unnest_indices
 
+    if child.capacity == 0:
+        # zero-row child: expansion map has nothing to gather from; pad to one
+        # invalid row so the fixed-shape kernel runs (yielding zero rows out)
+        child = Page(child.schema,
+                     tuple(jnp.zeros((1,), c.dtype) for c in child.columns),
+                     tuple(None for _ in child.columns), jnp.zeros((1,), bool))
     valid = child.valid_mask()
     spans = [child.columns[ch] for ch in node.unnest_channels]
     span_nulls = [child.null_masks[ch] for ch in node.unnest_channels]
@@ -1439,8 +1445,9 @@ def _run_unnest(node: P.Unnest, child: Page, cdicts):
         pos = jnp.clip(start + ordinal, 0, max(heap.shape[0] - 1, 0))
         val = heap[pos] if heap.shape[0] else jnp.zeros(cap, heap.dtype)
         out_cols.append(val)
-        short = ordinal >= ln_c[row]  # zipped shorter array pads with NULL
-        out_nulls.append(short if bool(jnp.any(short)) else None)
+        # zipped shorter arrays pad with NULL; attaching the mask untested
+        # avoids a per-channel device sync (all-False masks are harmless)
+        out_nulls.append(ordinal >= ln_c[row])
         dicts.append(data.elem_dict)
     if node.ordinality:
         out_cols.append((ordinal + 1).astype(jnp.int64))
@@ -1657,6 +1664,25 @@ def _window_kernel(specs, cols, nulls):
             cache[ck] = (perm, part_new, peer_new)
         perm, part_new, peer_new = cache[ck]
         framed = bool(s.order)  # ORDER BY -> running frame; else whole partition
+        # explicit ROWS/RANGE BETWEEN frame (reference: FramedWindowFunction):
+        # per-row [lo, hi] bounds; empty frames (hi < lo) are legal and NULL
+        frame = getattr(s, "frame", None)
+        lo_f = hi_f = empty_f = None
+        if frame is not None:
+            lo_f, hi_f = W.frame_bounds(part_new, peer_new, frame)
+            empty_f = hi_f < lo_f
+
+        def wsum(v, dt=None):
+            if frame is not None:
+                return W.framed_sum(v, lo_f, hi_f, dt)
+            return (W.segmented_scan_sum(v, part_new, peer_new, dt) if framed
+                    else W.partition_total(v, part_new, dt))
+
+        def wminmax(v, kind):
+            if frame is not None:
+                return W.framed_minmax(v, lo_f, hi_f, kind)
+            return W.segmented_scan_minmax(
+                v, part_new, peer_new if framed else part_new, kind)
 
         vals = None
         vmask = None  # True where the input value counts
@@ -1676,27 +1702,23 @@ def _window_kernel(specs, cols, nulls):
             ones = jnp.ones((n,), jnp.int64)
             if s.kind == "count" and vmask is not None:
                 ones = jnp.where(vmask, 1, 0)
-            res = (W.segmented_scan_sum(ones, part_new, peer_new) if framed
-                   else W.partition_total(ones, part_new))
+            res = wsum(ones)  # empty frames count 0 (framed_sum yields 0)
         elif s.kind in ("sum", "avg"):
             acc_dt = jnp.float64 if s.type.is_floating else jnp.int64
             v = vals if vmask is None else jnp.where(vmask, vals, 0)
-            total = (W.segmented_scan_sum(v, part_new, peer_new, acc_dt) if framed
-                     else W.partition_total(v, part_new, acc_dt))
+            total = wsum(v, acc_dt)
             nn_cnt = None
             if vmask is not None:
-                nn = jnp.where(vmask, 1, 0)
-                nn_cnt = (W.segmented_scan_sum(nn, part_new, peer_new) if framed
-                          else W.partition_total(nn, part_new))
-                null_out = nn_cnt == 0  # all-NULL frame -> NULL, not 0
+                nn_cnt = wsum(jnp.where(vmask, 1, 0))
+                null_out = nn_cnt == 0  # all-NULL (or empty) frame -> NULL
+            elif empty_f is not None:
+                null_out = empty_f
             if s.kind == "sum":
                 res = total
             else:
                 cnt = nn_cnt
                 if cnt is None:
-                    ones = jnp.ones((n,), jnp.int64)
-                    cnt = (W.segmented_scan_sum(ones, part_new, peer_new) if framed
-                           else W.partition_total(ones, part_new))
+                    cnt = wsum(jnp.ones((n,), jnp.int64))
                 cnt_safe = jnp.maximum(cnt, 1)
                 if s.type.is_floating:
                     res = total / cnt_safe
@@ -1708,12 +1730,11 @@ def _window_kernel(specs, cols, nulls):
             if vmask is not None:
                 ident = hashagg._extreme(vals.dtype, 1 if s.kind == "min" else -1)
                 v = jnp.where(vmask, vals, ident)
-                nn = jnp.where(vmask, 1, 0)
-                nn_cnt = (W.segmented_scan_sum(nn, part_new, peer_new) if framed
-                          else W.partition_total(nn, part_new))
+                nn_cnt = wsum(jnp.where(vmask, 1, 0))
                 null_out = nn_cnt == 0  # all-NULL frame -> NULL, not the sentinel
-            res = W.segmented_scan_minmax(v, part_new,
-                                          peer_new if framed else part_new, s.kind)
+            elif empty_f is not None:
+                null_out = empty_f
+            res = wminmax(v, s.kind)
         elif s.kind in ("lag", "lead"):
             off = s.offset if s.kind == "lag" else -s.offset
             fill = (jnp.zeros((), vals.dtype) if s.default is None
@@ -1749,23 +1770,29 @@ def _window_kernel(specs, cols, nulls):
                             (rn - 1) // jnp.maximum(q + 1, 1),
                             r + (rn - 1 - boundary) // jnp.maximum(q, 1)) + 1
         elif s.kind == "nth_value":
-            # default frame RANGE UNBOUNDED PRECEDING..CURRENT ROW: a row whose
-            # frame holds fewer than k rows yields NULL (reference:
-            # operator/window/NthValueFunction.java frame bounds check)
+            # a row whose frame holds fewer than k rows yields NULL (reference:
+            # operator/window/NthValueFunction.java frame bounds check); the
+            # default frame is RANGE UNBOUNDED PRECEDING..CURRENT ROW
             k = s.offset
-            starts = W._starts(part_new)
-            frame_size = W._ends(peer_new) - starts + 1
-            idx = jnp.minimum(starts + (k - 1), n - 1)
+            starts = lo_f if frame is not None else W._starts(part_new)
+            frame_end = hi_f if frame is not None else W._ends(peer_new)
+            frame_size = frame_end - starts + 1
+            idx = jnp.clip(starts + (k - 1), 0, n - 1)
             res = vals[idx]
-            null_out = frame_size < k  # frame shorter than k -> NULL
+            null_out = frame_size < k  # frame shorter than k (or empty) -> NULL
             if vmask is not None:
                 null_out = null_out | ~vmask[idx]
         elif s.kind in ("first_value", "last_value"):
-            idx = (W._starts(part_new) if s.kind == "first_value"
-                   else W._ends(peer_new if framed else part_new))
+            if frame is not None:
+                idx = jnp.clip(lo_f if s.kind == "first_value" else hi_f, 0, n - 1)
+                null_out = empty_f
+            else:
+                idx = (W._starts(part_new) if s.kind == "first_value"
+                       else W._ends(peer_new if framed else part_new))
             res = vals[idx]
             if vmask is not None:
-                null_out = ~vmask[idx]
+                miss = ~vmask[idx]
+                null_out = miss if null_out is None else (null_out | miss)
         else:
             raise NotImplementedError(s.kind)
 

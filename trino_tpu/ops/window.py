@@ -112,6 +112,69 @@ def partition_total(vals, part_new, dtype=None):
     return csum[_ends(part_new)] - base
 
 
+# ---------------------------------------------------------------------------- frames
+# Explicit ROWS/RANGE BETWEEN frames (reference: operator/window/
+# FramedWindowFunction.java + WindowPartition frame evaluation).  Bound kinds:
+# "up" UNBOUNDED PRECEDING | "p" k PRECEDING | "cr" CURRENT ROW |
+# "f" k FOLLOWING | "uf" UNBOUNDED FOLLOWING.
+
+
+def frame_bounds(part_new, peer_new, frame):
+    """Per-row inclusive [lo, hi] global sorted indices of the frame.
+
+    ROWS frames are index arithmetic clamped to the partition; RANGE frames
+    with non-offset bounds use peer-group edges (CURRENT ROW in RANGE means
+    "through my peers").  hi < lo encodes an empty frame."""
+    unit, s_type, s_k, e_type, e_k = frame
+    n = part_new.shape[0]
+    i = jnp.arange(n, dtype=jnp.int32)
+    p_start, p_end = _starts(part_new), _ends(part_new)
+    if unit == "rows":
+        lo = {"up": p_start, "p": i - s_k, "cr": i, "f": i + s_k}[s_type]
+        hi = {"uf": p_end, "p": i - e_k, "cr": i, "f": i + e_k}[e_type]
+    else:  # range: peer-group granularity
+        lo = {"up": p_start, "cr": _starts(peer_new)}[s_type]
+        hi = {"uf": p_end, "cr": _ends(peer_new)}[e_type]
+    lo = jnp.maximum(lo, p_start)
+    hi = jnp.minimum(hi, p_end)
+    return lo, hi
+
+
+def framed_sum(vals, lo, hi, dtype=None):
+    """Sum over each row's [lo, hi] via difference of inclusive prefix sums
+    (empty frames — hi < lo — yield 0)."""
+    v = vals if dtype is None else vals.astype(dtype)
+    csum = jnp.cumsum(v)
+    hi_c = jnp.clip(hi, 0, v.shape[0] - 1)
+    s = csum[hi_c] - jnp.where(lo > 0, csum[jnp.maximum(lo - 1, 0)],
+                               jnp.zeros((), v.dtype))
+    return jnp.where(hi >= lo, s, jnp.zeros((), v.dtype))
+
+
+def framed_minmax(vals, lo, hi, kind: str):
+    """Min/max over each row's [lo, hi] with a doubling sparse table:
+    st[k][i] = min(v[i .. i+2^k-1]), query = combine of two overlapping
+    power-of-two blocks — O(n log n) build, O(1) gathers per row, no
+    data-dependent shapes.  Caller masks empty frames."""
+    op = jnp.minimum if kind == "min" else jnp.maximum
+    n = vals.shape[0]
+    levels = max(int(n - 1).bit_length(), 1)
+    st = [vals]
+    for k in range(1, levels):
+        half = 1 << (k - 1)
+        prev = st[-1]
+        shifted = jnp.concatenate([prev[half:], prev[-1:].repeat(half)])
+        st.append(op(prev, shifted))
+    stk = jnp.stack(st)  # [levels, n]
+    length = jnp.maximum(hi - lo + 1, 1)
+    # floor(log2(length)) via bit arithmetic (exact, unlike float log2)
+    j = (jnp.ceil(jnp.log2(length.astype(jnp.float64) + 0.5)) - 1).astype(jnp.int32)
+    j = jnp.clip(j, 0, levels - 1)
+    lo_c = jnp.clip(lo, 0, n - 1)
+    b = jnp.clip(hi - (1 << j) + 1, 0, n - 1)
+    return op(stk[j, lo_c], stk[j, b])
+
+
 def shift_in_partition(vals, part_new, offset: int, default):
     """lag (offset>0) / lead (offset<0) within the partition, sorted order."""
     n = vals.shape[0]

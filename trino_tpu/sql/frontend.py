@@ -373,8 +373,26 @@ class Planner:
                 t = _agg_type(kind, arg_t)
             else:
                 t = arg_t
+            frame = getattr(w, "frame", None)
+            if frame is not None:
+                unit, s_type, s_k, e_type, e_k = frame
+                if unit == "range" and ("p" in (s_type, e_type)
+                                        or "f" in (s_type, e_type)):
+                    raise SemanticError(
+                        "RANGE frames with offset bounds are not supported "
+                        "(use ROWS, or UNBOUNDED/CURRENT ROW bounds)")
+                # statically-ordered bounds: start must not follow end
+                # (reference: the analyzer rejects reversed frames outright)
+                rank = {"up": float("-inf"), "uf": float("inf"), "cr": 0.0}
+                s_rank = rank.get(s_type, -s_k if s_type == "p" else s_k)
+                e_rank = rank.get(e_type, -e_k if e_type == "p" else e_k)
+                if e_rank < s_rank:
+                    raise SemanticError("frame start/end bounds are reversed")
+                if kind in ("row_number", "rank", "dense_rank", "percent_rank",
+                            "cume_dist", "ntile", "lag", "lead"):
+                    frame = None  # ranking/offset functions ignore the frame
             specs.append(P.WindowSpec(kind, arg_ch, pchs, order, f"#w{j}", t, offset,
-                                      default))
+                                      default, frame))
             out_info.append((f"#w{j}", t,
                              arg_d if kind in ("min", "max", "lag", "lead",
                                                "first_value", "last_value",

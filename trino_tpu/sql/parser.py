@@ -106,11 +106,16 @@ class FuncCall(Node):
 
 @dataclasses.dataclass(frozen=True)
 class WindowCall(Node):
-    """func(args) OVER (PARTITION BY ... ORDER BY ...)."""
+    """func(args) OVER (PARTITION BY ... ORDER BY ... [ROWS|RANGE frame]).
+
+    ``frame`` = (unit, start_type, start_k, end_type, end_k) with bound types
+    "up"/"p"/"cr"/"f"/"uf" (UNBOUNDED PRECEDING, k PRECEDING, CURRENT ROW,
+    k FOLLOWING, UNBOUNDED FOLLOWING), or None for the default frame."""
 
     func: "FuncCall"
     partition_by: tuple
     order_by: tuple  # SortItem...
+    frame: tuple = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1107,8 +1112,9 @@ class Parser:
                         order = [self.parse_sort_item()]
                         while self.accept(","):
                             order.append(self.parse_sort_item())
+                    frame = self._parse_frame_clause()
                     self.expect(")")
-                    return WindowCall(fc, tuple(partition), tuple(order))
+                    return WindowCall(fc, tuple(partition), tuple(order), frame)
                 return fc
             parts = [self.next().value]
             while self.peek().kind == "op" and self.peek().value == "." and self.peek(1).kind == "ident":
@@ -1116,6 +1122,46 @@ class Parser:
                 parts.append(self.next().value)
             return Identifier(tuple(parts))
         raise ParseError(f"unexpected token {t.value!r} at pos {t.pos}")
+
+    def _parse_frame_clause(self):
+        """[ROWS | RANGE] [BETWEEN b AND b | b] — frame bounds (contextual
+        identifiers; reference: grammar windowFrame)."""
+        t = self.peek()
+        if t.kind != "ident" or t.value not in ("rows", "range"):
+            return None
+        unit = self.next().value
+
+        def bound(is_start):
+            if self.peek().value == "unbounded":
+                self.next()
+                which = self.next().value
+                if which not in ("preceding", "following"):
+                    raise ParseError(f"expected PRECEDING/FOLLOWING at {self.peek().pos}")
+                return ("up" if which == "preceding" else "uf"), 0
+            if self.peek().value == "current":
+                self.next()
+                if self.next().value != "row":
+                    raise ParseError("expected CURRENT ROW")
+                return "cr", 0
+            tok = self.expect_kind("number")
+            if not tok.value.isdigit():
+                raise ParseError(f"frame offset must be an integer, got {tok.value!r}")
+            k = int(tok.value)
+            which = self.next().value
+            if which == "preceding":
+                return "p", k
+            if which == "following":
+                return "f", k
+            raise ParseError(f"expected PRECEDING/FOLLOWING, got {which!r}")
+
+        if self.accept("between"):
+            s_type, s_k = bound(True)
+            self.expect("and")
+            e_type, e_k = bound(False)
+        else:
+            s_type, s_k = bound(True)
+            e_type, e_k = "cr", 0
+        return (unit, s_type, s_k, e_type, e_k)
 
     def parse_case(self) -> CaseExpr:
         self.expect("case")

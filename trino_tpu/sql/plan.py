@@ -176,6 +176,8 @@ class WindowSpec:
     type: Type
     offset: int = 1  # lag/lead distance
     default: object = None  # lag/lead third argument (raw constant), None = NULL
+    frame: tuple = None  # explicit (unit, s_type, s_k, e_type, e_k) frame spec
+    # (parser.WindowCall.frame); None = default RANGE UNBOUNDED..CURRENT ROW
 
 
 @dataclasses.dataclass(frozen=True)
