@@ -94,3 +94,75 @@ def test_fte_join_query_via_engine(tmp_path):
     expected = e.execute_sql(q, s).rows()
     got = e.execute_sql(q, s, fault_tolerant=True).rows()
     assert got == expected
+
+
+# ------------------------------------------------------------------- fragments
+# round-2 generalization: the retryable unit is any blocking plan fragment
+# (joins, windows, sorts included), not just scan-fed aggregations
+# (reference: EventDrivenFaultTolerantQueryScheduler schedules arbitrary
+# fragments whose inputs are replayable TaskDescriptors / spooled exchanges)
+
+QJOIN = """select o_orderpriority, count(*) c
+           from lineitem, orders
+           where l_orderkey = o_orderkey and o_totalprice > 100000
+           group by o_orderpriority order by o_orderpriority"""
+
+QWINDOW = """select o_custkey, o_orderkey,
+                    row_number() over (partition by o_custkey
+                                       order by o_orderkey) rn
+             from orders where o_custkey < 100
+             order by o_custkey, o_orderkey limit 50"""
+
+
+def _setup_q(tmp_path, sql, **kw):
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.01, split_rows=1 << 11))
+    s = e.create_session("tpch")
+    plan = compile_sql(sql, e, s)
+    inj = FailureInjector()
+    ex = FaultTolerantExecutor(e.catalogs, str(tmp_path / "spool"),
+                               injector=inj, **kw)
+    expected = e.execute_sql(sql, s).rows()
+    return plan, inj, ex, expected
+
+
+def test_fte_mid_join_task_kill(tmp_path):
+    """A join fragment task dies twice mid-execution and recovers — its inputs
+    (scan splits) replay, its committed output dedups."""
+    plan, inj, ex, expected = _setup_q(tmp_path, QJOIN)
+    inj.inject("frag0", "TASK_FAILURE", times=2)  # frag0 = the join fragment
+    assert ex.execute(plan).rows() == expected
+    assert ex.task_attempts["frag0"] == 3
+
+
+def test_fte_join_post_commit_failure_no_duplicates(tmp_path):
+    plan, inj, ex, expected = _setup_q(tmp_path, QJOIN)
+    inj.inject("frag0", "POST_COMMIT_FAILURE", times=1)
+    inj.inject("frag1", "TASK_GET_RESULTS_FAILURE", times=1)
+    assert ex.execute(plan).rows() == expected
+
+
+def test_fte_window_fragment_retries(tmp_path):
+    plan, inj, ex, expected = _setup_q(tmp_path, QWINDOW)
+    inj.inject("frag0", "TASK_FAILURE", times=1)  # the window fragment
+    assert ex.execute(plan).rows() == expected
+    assert ex.task_attempts["frag0"] == 2
+
+
+def test_fte_join_exhausted_retries(tmp_path):
+    plan, inj, ex, _ = _setup_q(tmp_path, QJOIN, max_attempts=2)
+    inj.inject("frag0", "TASK_FAILURE", times=5)
+    with pytest.raises(RuntimeError, match="failed after 2 attempts"):
+        ex.execute(plan)
+
+
+def test_fte_engine_join_fault_tolerant(tmp_path):
+    """Engine-level fault_tolerant execution of a join+window plan matches the
+    plain path."""
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.01, split_rows=1 << 11))
+    s = e.create_session("tpch")
+    q = QJOIN
+    expected = e.execute_sql(q, s).rows()
+    got = e.execute_sql(q, s, fault_tolerant=True).rows()
+    assert got == expected

@@ -10,12 +10,14 @@ duplicate attempt output is deduplicated
 (operator/DeduplicatingDirectExchangeBuffer.java).  Failure injection hooks
 mirror execution/FailureInjector.java:53.
 
-TPU translation: a task = a partial aggregation over a split subset, jit-run on
-the accelerator; its compacted partial-state page spools to the local
-filesystem with an atomic first-commit-wins rename; the downstream stage merges
-spooled partials (count->sum etc.) and the rest of the plan runs locally.
-Plans without a scan-fed aggregation run non-fault-tolerantly (the retry unit
-needs replayable inputs + mergeable outputs).
+TPU translation: every BLOCKING plan node (aggregate, join, window, sort,
+unnest) is a retryable fragment — its inputs are replayable (leaf scans
+re-generate from splits; interior fragments read their children's spooled
+pages), its compacted output spools to the local filesystem with an atomic
+first-commit-wins rename, and a failed attempt retries against the same
+replayable inputs.  Scan-fed aggregations additionally decompose into
+fine-grained per-split-batch tasks whose partial-state pages merge downstream
+(the reference's partial/final aggregation pair over the spooled exchange).
 """
 
 from __future__ import annotations
@@ -98,10 +100,10 @@ class FailureInjector:
     def __init__(self):
         self._plans: dict = {}  # (task_id, point) -> remaining failure count
 
-    def inject(self, task_id: int, point: str, times: int = 1) -> None:
+    def inject(self, task_id, point: str, times: int = 1) -> None:
         self._plans[(task_id, point)] = times
 
-    def maybe_fail(self, task_id: int, point: str) -> None:
+    def maybe_fail(self, task_id, point: str) -> None:
         left = self._plans.get((task_id, point), 0)
         if left > 0:
             self._plans[(task_id, point)] = left - 1
@@ -118,10 +120,10 @@ class SpoolingExchange:
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
 
-    def _final(self, task_id: int) -> str:
+    def _final(self, task_id) -> str:
         return os.path.join(self.directory, f"task_{task_id}.page")
 
-    def commit(self, task_id: int, attempt: int, data: bytes) -> bool:
+    def commit(self, task_id, attempt: int, data: bytes) -> bool:
         """Returns False when an earlier attempt already committed."""
         if os.path.exists(self._final(task_id)):
             return False
@@ -136,10 +138,10 @@ class SpoolingExchange:
             os.unlink(tmp)
             return False
 
-    def is_committed(self, task_id: int) -> bool:
+    def is_committed(self, task_id) -> bool:
         return os.path.exists(self._final(task_id))
 
-    def read(self, task_id: int) -> bytes:
+    def read(self, task_id) -> bytes:
         with open(self._final(task_id), "rb") as f:
             return f.read()
 
@@ -155,10 +157,18 @@ class TaskDescriptor:
 
 
 class FaultTolerantExecutor:
-    """Executes plans with task-level retries when the plan has a scan-fed
-    aggregation (the common analytics shape); other plans run locally without
-    retries.  max_attempts mirrors the reference's task retry policy
-    (RetryPolicy.TASK, task_retry_attempts_per_task)."""
+    """Executes plans with task-level retries: every BLOCKING plan node
+    (aggregate, join, window, sort, unnest) is a retryable fragment whose
+    inputs are replayable — leaf scans re-generate from splits, interior
+    fragments read their children's spooled output.  Scan-fed aggregations
+    additionally split into fine-grained per-split-batch tasks (partial
+    states spooled, merged downstream).  max_attempts mirrors the reference's
+    task retry policy (RetryPolicy.TASK, task_retry_attempts_per_task;
+    fragment scheduling: EventDrivenFaultTolerantQueryScheduler.java:209,
+    replayable inputs: TaskDescriptorStorage.java:66)."""
+
+    # fragment roots: blocking operators whose output spools durably
+    _FRAGMENT_NODES = (P.Aggregate, P.Join, P.Window, P.Sort, P.Unnest)
 
     def __init__(self, catalogs: dict, spool_dir: str,
                  injector: Optional[FailureInjector] = None,
@@ -171,9 +181,8 @@ class FaultTolerantExecutor:
         self.local = LocalExecutor(catalogs)
         self._exchange_seq = 0
         self.task_attempts: dict[int, int] = {}  # observability: task -> attempts used
-        # the substitution below patches the shared LocalExecutor instance;
-        # concurrent FTE queries would race on the patch/restore pair, so FTE
-        # execution is serialized (admission allows concurrency at the engine)
+        # fragment outputs install into the private LocalExecutor's overrides;
+        # FTE execution is serialized (admission allows engine concurrency)
         import threading
 
         self._lock = threading.Lock()
@@ -181,42 +190,112 @@ class FaultTolerantExecutor:
     # -- public ----------------------------------------------------------------
     def execute(self, plan: P.PlanNode):
         with self._lock:
-            return self._execute_locked(plan)
+            self.local._overrides = {}
+            self._task_seq = 0
+            self._exchange_seq += 1
+            self._exchange = SpoolingExchange(
+                os.path.join(self.spool_dir, f"exchange_{self._exchange_seq}"))
+            try:
+                self.local.stats = {}
+                self._exec_ft(plan)
+                page, dd = self.local._execute_to_page(plan)
+                return _materialize(page, dd)
+            finally:
+                self.local._overrides = {}
+                # fragment pages were deserialized into memory above; the
+                # spool is query-scoped durable state, not a cache — a
+                # long-lived server must not grow temp disk per query
+                import shutil
 
-    def _execute_locked(self, plan: P.PlanNode):
-        agg = self._find_fte_aggregate(plan)
-        if agg is None:
-            return self.local.execute(plan)
-        merged_page, dicts = self._run_fte_aggregate(agg)
-        # run the rest of the plan with the aggregate's result substituted
-        orig = self.local._execute_to_page
+                shutil.rmtree(self._exchange.directory, ignore_errors=True)
 
-        def patched(node, _orig=orig, agg=agg, page=merged_page, dicts=dicts):
-            if node is agg:
-                return page, dicts
-            return _orig(node)
-
-        self.local._execute_to_page = patched
-        try:
-            self.local.stats = {}
-            page, dd = self.local._execute_to_page(plan)
-            return _materialize(page, dd)
-        finally:
-            self.local._execute_to_page = orig
-
-    # -- task planning ----------------------------------------------------------
-    def _find_fte_aggregate(self, node):
-        """Topmost Aggregate whose child is a pure stream over one scan."""
-        if isinstance(node, P.Aggregate) and node.keys:
-            stream = self.local._compile_stream(node.child)
-            if stream.scan_info is not None and stream.scan_info.splits:
-                return node
-            return None
+    # -- fragment decomposition --------------------------------------------------
+    def _exec_ft(self, node: P.PlanNode) -> None:
+        """Bottom-up: make every blocking fragment's output durable, so each
+        fragment task's inputs are replayable (children are already spooled;
+        leaf scans replay from splits)."""
         for c in node.children:
-            found = self._find_fte_aggregate(c)
-            if found is not None:
-                return found
-        return None
+            self._exec_ft(c)
+        if not isinstance(node, self._FRAGMENT_NODES):
+            return
+        # fragment task ids live in their own namespace ("frag0", "frag1", ...)
+        # so the fine-grained split tasks inside an aggregation keep the plain
+        # integer ids tests and operators address
+        tid = f"frag{self._task_seq}"
+        self._task_seq += 1
+        if isinstance(node, P.Aggregate) and node.keys \
+                and self._scan_fed(node.child):
+            # fine-grained path: per-split-batch partial-aggregation tasks,
+            # merged into one durable page (the round-1 FTE shape, retained)
+            page, agg_dicts = self._run_fte_aggregate(node)
+            data = self._serialize_result(page)
+            dicts = self._commit_with_retries(tid, lambda: (data, agg_dicts))
+        else:
+            def compute(node=node, tid=tid):
+                self.injector.maybe_fail(tid, "TASK_FAILURE")
+                page, dd = self.local._execute_to_page(node)
+                data = self._serialize_result(page)
+                self.injector.maybe_fail(tid, "TASK_GET_RESULTS_FAILURE")
+                return data, dd
+            dicts = self._commit_with_retries(tid, compute)
+        cols, nulls = deserialize_page(self._exchange.read(tid))
+        page = Page(node.schema,
+                    tuple(jnp.asarray(c) for c in cols),
+                    tuple(None if n is None else jnp.asarray(n) for n in nulls),
+                    None)
+        self.local._overrides[id(node)] = (page, dicts)
+
+    def _scan_fed(self, node) -> bool:
+        """True when the subtree is a pure stream over one scan and contains NO
+        blocking fragments anywhere below — a join-fed aggregate must read the
+        join's spooled output (generic path), not replay the join from base
+        scans (which would orphan the spooled fragment and run the most
+        expensive operator twice)."""
+        def has_fragment(n):
+            return isinstance(n, self._FRAGMENT_NODES) \
+                or any(has_fragment(c) for c in n.children)
+
+        if has_fragment(node):
+            return False
+        try:
+            stream = self.local._compile_stream(node)
+        except NotImplementedError:
+            return False
+        return stream.scan_info is not None and bool(stream.scan_info.splits)
+
+    def _serialize_result(self, page: Page) -> bytes:
+        """Compact (valid rows only) + frame a fragment output page."""
+        from .local_executor import _host_page
+
+        valid, pcols, pnulls = _host_page(page)
+        cols = [c[valid] for c in pcols]
+        nulls = [None if (n is None or not n[valid].any()) else n[valid]
+                 for n in pnulls]
+        return serialize_page(cols, nulls)
+
+    def _commit_with_retries(self, task_id, compute):
+        """Run a fragment task with the retry/dedup protocol; returns the side
+        payload (dicts) from the last successful compute, or None when an
+        earlier attempt already committed."""
+        last_error = None
+        extra = None
+        for attempt in range(self.max_attempts):
+            self.task_attempts[task_id] = attempt + 1
+            try:
+                out = compute()
+                data, extra = out if isinstance(out, tuple) else (out, None)
+                self._exchange.commit(task_id, attempt, data)
+                # a post-commit failure must not duplicate output on retry
+                self.injector.maybe_fail(task_id, "POST_COMMIT_FAILURE")
+                return extra
+            except InjectedFailure as e:
+                last_error = e
+                if self._exchange.is_committed(task_id):
+                    return extra  # output durable; a retry would dedup anyway
+                continue
+        raise RuntimeError(
+            f"task {task_id} failed after {self.max_attempts} attempts: "
+            f"{last_error}")
 
     # -- stage 1: partial aggregation tasks -------------------------------------
     def _run_fte_aggregate(self, node: P.Aggregate):
@@ -230,9 +309,10 @@ class FaultTolerantExecutor:
                                                    len(splits)))))
                  for i in range((len(splits) + self.splits_per_task - 1)
                                 // self.splits_per_task)]
-        self._exchange_seq += 1
+        # nested under the query's exchange directory so query-completion
+        # cleanup removes the fine-grained partials too
         exchange = SpoolingExchange(
-            os.path.join(self.spool_dir, f"exchange_{self._exchange_seq}"))
+            os.path.join(self._exchange.directory, f"agg_{self._task_seq}"))
 
         for task in tasks:
             self._run_task_with_retries(task, exchange, node, stream, key_types,

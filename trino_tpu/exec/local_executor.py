@@ -119,6 +119,11 @@ class LocalExecutor:
         self._stream_cache: dict = {}  # id(node) -> (node, _Stream)
         self._agg_cache: dict = {}  # id(node) -> compiled aggregation artifacts
         self.stats: dict = {}  # id(node) -> {"rows": int, "wall_s": float}
+        # node-result substitutions: id(node) -> (Page, dicts).  The FTE
+        # executor installs durable (spooled) fragment outputs here so the
+        # remainder of the plan consumes them instead of re-executing the
+        # subtree (reference: ExchangeOperator reading spooled task output)
+        self._overrides: dict = {}
         # HBM accounting: operators reserve before allocating device state and
         # switch to partitioned (Grace) strategies when the pool says no
         # (reference: MemoryPool + MemoryRevokingScheduler -> spill)
@@ -177,6 +182,10 @@ class LocalExecutor:
         """Run a (sub)plan to completion, returning one host-side Page + dicts."""
         import time as _time
 
+        if self._overrides:
+            hit = self._overrides.get(id(node))
+            if hit is not None:
+                return hit
         t0 = _time.perf_counter()
         if isinstance(node, P.Output):
             child, dicts = self._execute_to_page(node.child)
@@ -1074,6 +1083,8 @@ class LocalExecutor:
 
     def _execute_to_page_streamed(self, node):
         """Materialize a sub-plan into one device page (join build side)."""
+        if self._overrides and id(node) in self._overrides:
+            return self._overrides[id(node)]
         if isinstance(node, (P.Aggregate, P.Sort, P.Limit, P.Output, P.Window)):
             return self._execute_to_page(node)
         stream = self._compile_stream(node)

@@ -381,8 +381,11 @@ class Planner:
                     raise SemanticError(
                         "RANGE frames with offset bounds are not supported "
                         "(use ROWS, or UNBOUNDED/CURRENT ROW bounds)")
-                # statically-ordered bounds: start must not follow end
+                # statically-ordered bounds: start must not follow end, and
+                # UNBOUNDED FOLLOWING/PRECEDING are end-only/start-only
                 # (reference: the analyzer rejects reversed frames outright)
+                if s_type == "uf" or e_type == "up":
+                    raise SemanticError("frame start/end bounds are reversed")
                 rank = {"up": float("-inf"), "uf": float("inf"), "cr": 0.0}
                 s_rank = rank.get(s_type, -s_k if s_type == "p" else s_k)
                 e_rank = rank.get(e_type, -e_k if e_type == "p" else e_k)
