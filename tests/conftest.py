@@ -18,6 +18,11 @@ jax.config.update("jax_enable_x64", True)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process integration tests (subprocess workers)")
+
+
 @pytest.fixture(scope="session")
 def tpch_sf001():
     from trino_tpu.connectors.tpch import TpchConnector

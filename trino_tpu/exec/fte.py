@@ -346,68 +346,93 @@ class FaultTolerantExecutor:
 
     def _execute_task(self, task: TaskDescriptor, node, stream, key_types, acc_specs,
                       step) -> bytes:
-        """Partial aggregation over the task's splits -> serialized partial page
-        (keys + raw accumulator columns)."""
-        si = stream.scan_info
-        capacity = node.capacity or 1 << 16
-        while True:
-            state = hashagg.groupby_init(capacity, tuple(t.dtype for t in key_types),
-                                         acc_specs)
-            for split in task.splits:
-                page = si.conn.generate(split, list(si.scan_columns))
-                state = step(state, page, stream.aux)
-            if not bool(state.overflow):
-                break
-            capacity *= 4
-        n_groups = int(hashagg.group_count(state))
-        bucket = max(1 << max(n_groups - 1, 1).bit_length(), 64)
-        keys, key_nulls, accs = hashagg.compact_groups(state, bucket)
-        got = _host(list(keys) + list(key_nulls) + list(accs))
-        nk = len(keys)
-        cols = [g[:n_groups] for g in got[:nk]] + [g[:n_groups] for g in got[2 * nk:]]
-        nulls = [g[:n_groups] for g in got[nk:2 * nk]] + [None] * len(accs)
-        nulls = [n if (n is not None and n.any()) else None for n in nulls]
-        return serialize_page(cols, nulls)
+        return run_partial_aggregate_splits(node, stream, key_types, acc_specs,
+                                            step, task.splits)
 
     # -- stage 2: merge ----------------------------------------------------------
     def _merge_spooled(self, exchange, tasks, node, stream, key_types, acc_specs,
                        acc_kinds):
-        merge_kinds = [_MERGE_KIND[k] for k in acc_kinds]
-        nk = len(node.keys)
-        capacity = 1 << 16
-        while True:
-            state = hashagg.groupby_init(capacity, tuple(t.dtype for t in key_types),
-                                         acc_specs)
-            overflow = False
-            for task in tasks:
-                cols, nulls = deserialize_page(exchange.read(task.task_id))
-                kcols = tuple(jnp.asarray(c) for c in cols[:nk])
-                knulls = tuple(None if n is None else jnp.asarray(n)
-                               for n in nulls[:nk])
-                accs = [(jnp.asarray(c), None) for c in cols[nk:]]
-                valid = jnp.ones((cols[0].shape[0],), bool) if cols[0].shape[0] \
-                    else jnp.zeros((0,), bool)
-                if cols[0].shape[0] == 0:
-                    continue
-                state = hashagg.groupby_insert(state, kcols, key_types, valid,
-                                               accs, merge_kinds, knulls)
-            overflow = bool(state.overflow)
-            if not overflow:
-                break
-            capacity *= 4
+        payloads = [exchange.read(t.task_id) for t in tasks]
+        return merge_partial_pages(node, stream, key_types, acc_specs, acc_kinds,
+                                   payloads)
 
-        n_groups = int(hashagg.group_count(state))
-        bucket = max(1 << max(n_groups - 1, 1).bit_length(), 64)
-        keys, key_nulls, accs = hashagg.compact_groups(state, bucket)
-        got = _host(list(keys) + list(key_nulls) + list(accs))
-        key_cols = [k[:n_groups] for k in got[:nk]]
-        key_null_cols = [kn[:n_groups] for kn in got[nk:2 * nk]]
-        acc_cols = [a[:n_groups] for a in got[2 * nk:]]
-        out_cols = key_cols + _finalize_aggs(node.aggs, acc_cols, n_groups)
-        arrays = [np.asarray(c) for c in out_cols]
-        out_nulls = tuple(kn if kn.any() else None for kn in key_null_cols) \
-            + tuple(None for _ in node.aggs)
-        page = Page(node.schema, tuple(arrays), out_nulls, None)
-        dicts = tuple(stream.dicts[i] for i in node.keys) \
-            + tuple(None for _ in node.aggs)
-        return page, dicts
+
+# ---------------------------------------------------------------------------- task bodies
+# Module-level so remote worker processes (server/cluster.py) run the SAME code
+# the in-process FTE tasks run (reference: one binary, role split by config —
+# server/CoordinatorModule.java vs WorkerModule.java).
+
+
+def run_partial_aggregate_splits(node, stream, key_types, acc_specs, step,
+                                 splits) -> bytes:
+    """Partial aggregation over a split subset -> serialized partial page
+    (keys + raw accumulator columns)."""
+    si = stream.scan_info
+    capacity = node.capacity or 1 << 16
+    while True:
+        state = hashagg.groupby_init(capacity, tuple(t.dtype for t in key_types),
+                                     acc_specs)
+        for split in splits:
+            page = si.conn.generate(split, list(si.scan_columns))
+            state = step(state, page, stream.aux)
+        if not bool(state.overflow):
+            break
+        capacity *= 4
+    n_groups = int(hashagg.group_count(state))
+    bucket = max(1 << max(n_groups - 1, 1).bit_length(), 64)
+    keys, key_nulls, accs = hashagg.compact_groups(state, bucket)
+    got = _host(list(keys) + list(key_nulls) + list(accs))
+    nk = len(keys)
+    cols = [g[:n_groups] for g in got[:nk]] + [g[:n_groups] for g in got[2 * nk:]]
+    nulls = [g[:n_groups] for g in got[nk:2 * nk]] + [None] * len(accs)
+    nulls = [n if (n is not None and n.any()) else None for n in nulls]
+    return serialize_page(cols, nulls)
+
+
+def run_partial_aggregate(local: LocalExecutor, node, splits) -> bytes:
+    """Worker entry: compile the aggregation on this process's executor and run
+    the partial task over ``splits``."""
+    stream, key_types, acc_specs, _, _, step = local._agg_compiled(node)
+    return run_partial_aggregate_splits(node, stream, key_types, acc_specs, step,
+                                        splits)
+
+
+def merge_partial_pages(node, stream, key_types, acc_specs, acc_kinds,
+                        payloads):
+    """Final aggregation over serialized partial pages (coordinator side)."""
+    merge_kinds = [_MERGE_KIND[k] for k in acc_kinds]
+    nk = len(node.keys)
+    capacity = 1 << 16
+    while True:
+        state = hashagg.groupby_init(capacity, tuple(t.dtype for t in key_types),
+                                     acc_specs)
+        for data in payloads:
+            cols, nulls = deserialize_page(data)
+            if cols[0].shape[0] == 0:
+                continue
+            kcols = tuple(jnp.asarray(c) for c in cols[:nk])
+            knulls = tuple(None if n is None else jnp.asarray(n)
+                           for n in nulls[:nk])
+            accs = [(jnp.asarray(c), None) for c in cols[nk:]]
+            valid = jnp.ones((cols[0].shape[0],), bool)
+            state = hashagg.groupby_insert(state, kcols, key_types, valid,
+                                           accs, merge_kinds, knulls)
+        if not bool(state.overflow):
+            break
+        capacity *= 4
+
+    n_groups = int(hashagg.group_count(state))
+    bucket = max(1 << max(n_groups - 1, 1).bit_length(), 64)
+    keys, key_nulls, accs = hashagg.compact_groups(state, bucket)
+    got = _host(list(keys) + list(key_nulls) + list(accs))
+    key_cols = [k[:n_groups] for k in got[:nk]]
+    key_null_cols = [kn[:n_groups] for kn in got[nk:2 * nk]]
+    acc_cols = [a[:n_groups] for a in got[2 * nk:]]
+    out_cols = key_cols + _finalize_aggs(node.aggs, acc_cols, n_groups)
+    arrays = [np.asarray(c) for c in out_cols]
+    out_nulls = tuple(kn if kn.any() else None for kn in key_null_cols) \
+        + tuple(None for _ in node.aggs)
+    page = Page(node.schema, tuple(arrays), out_nulls, None)
+    dicts = tuple(stream.dicts[i] for i in node.keys) \
+        + tuple(None for _ in node.aggs)
+    return page, dicts

@@ -1,0 +1,463 @@
+"""Multi-process control plane: coordinator + worker processes over HTTP.
+
+Reference architecture (SURVEY.md §2.6/§2.7/§3.2-3.3):
+- worker registration/announcement -> CoordinatorNodeManager
+  (node/CoordinatorNodeManager.java:56) + Airlift announcements;
+- fragment dispatch -> HttpRemoteTask POSTing a TaskUpdateRequest
+  (server/remotetask/HttpRemoteTask.java:137,743; the fragment ships once,
+  split batches address it);
+- task REST surface -> /v1/task create + status poll
+  (server/TaskResource.java:142,229);
+- heartbeat failure detection -> HeartbeatFailureDetector
+  (failuredetector/HeartbeatFailureDetector.java:77), simplified from the
+  exponential-decay ratio to a consecutive-miss threshold;
+- inter-process data plane -> the spooled filesystem exchange
+  (plugin/trino-exchange-filesystem), shared with the FTE executor: workers
+  commit partial pages first-commit-wins; the coordinator merges.
+
+TPU translation: one worker process = one accelerator's host runtime.  The
+fragment a worker receives is a pickled plan subtree (this engine's
+TaskUpdateRequest; trusted-cluster transport, like the reference's
+internal-communication channel) plus its split assignment; the worker runs the
+same jit-compiled partial-aggregation task body the in-process FTE uses
+(exec/fte.run_partial_aggregate), so coordinator-local and remote execution
+share one code path — the reference's single-binary role split.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os as _os
+import pickle
+import threading
+import time
+import traceback
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+# workers are separate OS processes; select the platform via jax.config (the
+# env-var route hangs the axon plugin's discovery — see tests/conftest.py)
+if _os.environ.pop("TRINO_TPU_WORKER_CPU", None):
+    _os.environ.pop("JAX_PLATFORMS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+from ..exec.fte import SpoolingExchange, merge_partial_pages, run_partial_aggregate
+from ..exec.local_executor import LocalExecutor, _materialize
+from ..sql import plan as P
+
+__all__ = ["WorkerServer", "ClusterCoordinator", "build_catalogs"]
+
+
+def build_catalogs(config: dict) -> dict:
+    """Instantiate connectors from a declarative config — the analog of
+    catalog properties files loaded by the CatalogManager at bootstrap
+    (connector/CoordinatorDynamicCatalogManager.java)."""
+    from ..connectors.tpch import TpchConnector
+
+    factories = {"tpch": TpchConnector}
+    try:
+        from ..connectors.tpcds import TpcdsConnector
+
+        factories["tpcds"] = TpcdsConnector
+    except ImportError:  # pragma: no cover
+        pass
+    out = {}
+    for name, spec in config.items():
+        kind = spec["connector"]
+        kwargs = {k: v for k, v in spec.items() if k != "connector"}
+        out[name] = factories[kind](**kwargs)
+    return out
+
+
+def _http(url: str, data: Optional[bytes] = None, timeout: float = 10.0) -> bytes:
+    req = urllib.request.Request(url, data=data,
+                                 method="POST" if data is not None else "GET")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read()
+
+
+# ---------------------------------------------------------------------------- worker
+@dataclasses.dataclass
+class _TaskState:
+    state: str = "running"  # running | done | failed
+    error: Optional[str] = None
+
+
+class WorkerServer:
+    """A worker process: executes dispatched fragments over its own executor
+    and spools output pages to the shared exchange directory."""
+
+    def __init__(self, catalogs_config: dict, spool_dir: str,
+                 host: str = "127.0.0.1", port: int = 0,
+                 coordinator_url: Optional[str] = None, node_id: str = "worker",
+                 announce_interval: float = 0.5):
+        self.catalogs = build_catalogs(catalogs_config)
+        self.local = LocalExecutor(self.catalogs)
+        self.spool_dir = spool_dir
+        self.host, self.port = host, port
+        self.node_id = node_id
+        self.coordinator_url = coordinator_url
+        self.announce_interval = announce_interval
+        from collections import OrderedDict
+
+        # the fragment ships ONCE per query (reference: HttpRemoteTask sends
+        # the PlanFragment once, then split batches address it); tasks carry a
+        # fragment id.  Both registries are bounded so a long-lived worker's
+        # memory does not grow with queries served; evicting a fragment also
+        # evicts its compiled artifacts from the executor caches.
+        self.fragments: OrderedDict = OrderedDict()  # fragment_id -> plan node
+        self.tasks: OrderedDict = OrderedDict()  # task_id -> _TaskState
+        self.max_fragments = 32
+        self.max_task_states = 256
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle -------------------------------------------------------------
+    def start(self) -> str:
+        worker = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/v1/info":
+                    return self._reply(200, {"node_id": worker.node_id,
+                                             "state": "active"})
+                if self.path.startswith("/v1/task/"):
+                    tid = self.path.rsplit("/", 1)[-1]
+                    st = worker.tasks.get(tid)
+                    if st is None:
+                        return self._reply(404, {"error": "no such task"})
+                    return self._reply(200, {"state": st.state, "error": st.error})
+                self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path == "/v1/fragment":
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = pickle.loads(self.rfile.read(n))
+                    worker._register_fragment(req["fragment_id"], req["plan"])
+                    return self._reply(200, {"ok": True})
+                if self.path == "/v1/task":
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = pickle.loads(self.rfile.read(n))
+                    if req["fragment_id"] not in worker.fragments:
+                        return self._reply(409, {"error": "unknown fragment"})
+                    worker._start_task(req)
+                    return self._reply(200, {"accepted": req["task_id"]})
+                self._reply(404, {"error": "not found"})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_port
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        if self.coordinator_url:
+            threading.Thread(target=self._announce_loop, daemon=True).start()
+        return self.url
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self):
+        self._stop.set()
+        if self._httpd:
+            self._httpd.shutdown()
+
+    def _announce_loop(self):
+        while not self._stop.is_set():
+            try:
+                _http(f"{self.coordinator_url}/v1/announce",
+                      json.dumps({"node_id": self.node_id,
+                                  "url": self.url}).encode())
+            except Exception:
+                pass  # coordinator not up yet / transient
+            self._stop.wait(self.announce_interval)
+
+    # -- task execution ----------------------------------------------------------
+    def _register_fragment(self, frag_id: str, plan) -> None:
+        if frag_id in self.fragments:
+            return
+        self.fragments[frag_id] = plan
+        while len(self.fragments) > self.max_fragments:
+            _, old = self.fragments.popitem(last=False)
+            self.local.forget_plan(old)  # drop its compiled artifacts too
+
+    def _start_task(self, req: dict):
+        tid = str(req["task_id"])
+        self.tasks[tid] = st = _TaskState()
+        while len(self.tasks) > self.max_task_states:
+            self.tasks.popitem(last=False)
+
+        def run():
+            try:
+                node = self.fragments[req["fragment_id"]]
+                data = run_partial_aggregate(self.local, node, req["splits"])
+                SpoolingExchange(req["exchange_dir"]).commit(
+                    req["task_id"], req.get("attempt", 0), data)
+                st.state = "done"
+            except Exception as e:  # pragma: no cover - surfaced via status
+                st.state = "failed"
+                st.error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+
+        threading.Thread(target=run, daemon=True).start()
+
+
+# ---------------------------------------------------------------------------- coordinator
+@dataclasses.dataclass
+class _WorkerInfo:
+    node_id: str
+    url: str
+    last_seen: float
+    misses: int = 0
+    alive: bool = True
+
+
+class ClusterCoordinator:
+    """Coordinator process: accepts worker announcements, detects failures by
+    heartbeat, plans queries, dispatches scan-fed aggregation fragments as
+    remote tasks, merges spooled partials, finishes the plan locally."""
+
+    def __init__(self, engine, spool_dir: str, host: str = "127.0.0.1",
+                 port: int = 0, heartbeat_interval: float = 0.5,
+                 max_misses: int = 3, max_attempts: int = 3,
+                 splits_per_task: int = 2, task_timeout: float = 120.0):
+        self.engine = engine
+        self.spool_dir = spool_dir
+        self.host, self.port = host, port
+        self.workers: dict[str, _WorkerInfo] = {}
+        self.heartbeat_interval = heartbeat_interval
+        self.max_misses = max_misses
+        self.max_attempts = max_attempts
+        self.splits_per_task = splits_per_task
+        self.task_timeout = task_timeout
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._exchange_seq = 0
+
+    # -- lifecycle ---------------------------------------------------------------
+    def start(self) -> str:
+        coord = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path == "/v1/announce":
+                    n = int(self.headers.get("Content-Length", 0))
+                    msg = json.loads(self.rfile.read(n))
+                    coord._announce(msg["node_id"], msg["url"])
+                    return self._reply(200, {"ok": True})
+                self._reply(404, {"error": "not found"})
+
+            def do_GET(self):
+                if self.path == "/v1/nodes":
+                    with coord._lock:
+                        nodes = [{"node_id": w.node_id, "url": w.url,
+                                  "alive": w.alive} for w in
+                                 coord.workers.values()]
+                    return self._reply(200, {"nodes": nodes})
+                self._reply(404, {"error": "not found"})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_port
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        threading.Thread(target=self._heartbeat_loop, daemon=True).start()
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self):
+        self._stop.set()
+        if self._httpd:
+            self._httpd.shutdown()
+
+    def _announce(self, node_id: str, url: str):
+        with self._lock:
+            w = self.workers.get(node_id)
+            if w is None:
+                self.workers[node_id] = _WorkerInfo(node_id, url, time.time())
+            else:
+                w.url, w.last_seen, w.misses, w.alive = url, time.time(), 0, True
+
+    def _heartbeat_loop(self):
+        """HeartbeatFailureDetector (simplified): probe /v1/info; max_misses
+        consecutive failures gates the worker out of scheduling."""
+        while not self._stop.is_set():
+            with self._lock:
+                snapshot = list(self.workers.values())
+            for w in snapshot:
+                try:
+                    _http(f"{w.url}/v1/info", timeout=2.0)
+                    with self._lock:
+                        w.misses, w.alive, w.last_seen = 0, True, time.time()
+                except Exception:
+                    with self._lock:
+                        w.misses += 1
+                        if w.misses >= self.max_misses:
+                            w.alive = False
+            self._stop.wait(self.heartbeat_interval)
+
+    def live_workers(self) -> list:
+        with self._lock:
+            return [w for w in self.workers.values() if w.alive]
+
+    def wait_for_workers(self, n: int, timeout: float = 20.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if len(self.live_workers()) >= n:
+                return
+            time.sleep(0.1)
+        raise TimeoutError(f"{n} workers not registered within {timeout}s")
+
+    # -- distributed query -------------------------------------------------------
+    def execute_sql(self, sql: str, session=None):
+        """Plan on the coordinator; dispatch the scan-fed aggregation fragment
+        as remote tasks across live workers; merge spooled partials; run the
+        remainder locally (reference: SqlQueryExecution.planDistribution ->
+        per-stage task scheduling, SURVEY §3.2)."""
+        from ..sql.frontend import compile_sql
+
+        sess = session or self.engine.create_session(
+            next(iter(self.engine.catalogs)))
+        plan = compile_sql(sql, self.engine, sess)
+        local = LocalExecutor(self.engine.catalogs)
+        agg = self._find_distributable_aggregate(local, plan)
+        if agg is None or not self.live_workers():
+            return local.execute(plan)
+        page, dicts = self._run_distributed_aggregate(local, agg)
+        local._overrides[id(agg)] = (page, dicts)
+        try:
+            out_page, dd = local._execute_to_page(plan)
+            return _materialize(out_page, dd)
+        finally:
+            local._overrides = {}
+
+    def _find_distributable_aggregate(self, local, node):
+        if isinstance(node, P.Aggregate) and node.keys:
+            try:
+                stream = local._compile_stream(node.child)
+            except NotImplementedError:
+                return None
+            if stream.scan_info is not None and stream.scan_info.splits:
+                return node
+            return None
+        for c in node.children:
+            found = self._find_distributable_aggregate(local, c)
+            if found is not None:
+                return found
+        return None
+
+    def _run_distributed_aggregate(self, local, node):
+        import os
+
+        stream, key_types, acc_specs, _, acc_kinds, _ = local._agg_compiled(node)
+        splits = list(stream.scan_info.splits)
+        tasks = [(i, tuple(splits[j] for j in
+                           range(i * self.splits_per_task,
+                                 min((i + 1) * self.splits_per_task, len(splits)))))
+                 for i in range((len(splits) + self.splits_per_task - 1)
+                                // self.splits_per_task)]
+        with self._lock:
+            self._exchange_seq += 1
+            seq = self._exchange_seq
+        exchange_dir = os.path.join(self.spool_dir, f"cluster_exchange_{seq}")
+        exchange = SpoolingExchange(exchange_dir)
+        frag_id = f"frag_{seq}"
+        frag_blob = pickle.dumps({"fragment_id": frag_id, "plan": node})
+        frag_sent: set = set()  # worker URLs (a restart changes the url)
+
+        pending = {tid: sp for tid, sp in tasks}
+        attempts: dict = {tid: 0 for tid, _ in tasks}
+        assigned: dict = {}  # task_id -> (worker, splits, deadline)
+        while pending or assigned:
+            # (re)assign pending tasks round-robin over live workers; the
+            # fragment ships once per worker URL, tasks address it by id
+            live = self.live_workers()
+            if not live:
+                raise RuntimeError("no live workers")
+            for i, (tid, sp) in enumerate(list(pending.items())):
+                w = live[i % len(live)]
+                try:
+                    if w.url not in frag_sent:
+                        _http(f"{w.url}/v1/fragment", frag_blob)
+                        frag_sent.add(w.url)
+                    req = pickle.dumps({"task_id": tid, "fragment_id": frag_id,
+                                        "splits": sp, "attempt": attempts[tid],
+                                        "exchange_dir": exchange_dir})
+                    _http(f"{w.url}/v1/task", req)
+                    assigned[tid] = (w, sp, time.time() + self.task_timeout)
+                    del pending[tid]
+                except Exception:
+                    continue  # worker unreachable; heartbeat will gate it out
+            # poll assigned tasks
+            time.sleep(0.05)
+            for tid, (w, sp, deadline) in list(assigned.items()):
+                if exchange.is_committed(tid):
+                    del assigned[tid]
+                    continue
+                failed = time.time() > deadline  # wedged task: reassign
+                try:
+                    st = json.loads(_http(f"{w.url}/v1/task/{tid}", timeout=2.0))
+                    failed = failed or st.get("state") == "failed"
+                except Exception:
+                    # unreachable OR task unknown (404: the worker restarted
+                    # and lost its in-memory state) -> the attempt is gone
+                    failed = True
+                if failed and not exchange.is_committed(tid):
+                    del assigned[tid]
+                    attempts[tid] += 1
+                    if attempts[tid] >= self.max_attempts:
+                        raise RuntimeError(
+                            f"task {tid} failed after {attempts[tid]} attempts")
+                    pending[tid] = sp
+        payloads = [exchange.read(tid) for tid, _ in tasks]
+        return merge_partial_pages(node, stream, key_types, acc_specs, acc_kinds,
+                                   payloads)
+
+
+def main(argv=None):  # pragma: no cover - exercised via subprocess in tests
+    """Worker process entry: ``python -m trino_tpu.server.cluster --port N
+    --coordinator URL --catalogs JSON --spool DIR --node-id ID``."""
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--coordinator", default=None)
+    ap.add_argument("--catalogs", required=True)
+    ap.add_argument("--spool", required=True)
+    ap.add_argument("--node-id", default="worker")
+    args = ap.parse_args(argv)
+    w = WorkerServer(json.loads(args.catalogs), args.spool, port=args.port,
+                     coordinator_url=args.coordinator, node_id=args.node_id)
+    url = w.start()
+    print(f"worker {args.node_id} listening on {url}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        w.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()
