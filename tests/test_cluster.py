@@ -7,6 +7,7 @@ HTTP tasks cross)."""
 
 import json
 import os
+import pathlib
 import subprocess
 import sys
 import time
@@ -33,7 +34,8 @@ def _engine():
 def _spawn_worker(tmp_path, coord_url, node_id):
     env = dict(os.environ)
     env["TRINO_TPU_WORKER_CPU"] = "1"
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     return subprocess.Popen(
         [sys.executable, "-m", "trino_tpu.server.cluster",
          "--coordinator", coord_url, "--catalogs", json.dumps(CATALOGS),

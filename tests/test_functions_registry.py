@@ -1,0 +1,104 @@
+"""Function registry SPI + JSON functions (reference:
+metadata/SystemFunctionBundle.java:384 declarative catalog;
+operator/scalar/json/ + the jsonpath/ engine).
+
+JSON documents are dictionary-encoded varchar, so each path evaluates once per
+distinct document on the host and becomes a device-side id -> result gather."""
+
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.sql.frontend import SemanticError
+from trino_tpu.sql.functions import (REGISTRY, eval_json_path, lookup,
+                                     parse_json_path)
+
+
+@pytest.fixture()
+def json_engine():
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table ev (id bigint, doc varchar)", s)
+    e.execute_sql("""insert into ev values
+      (1, '{"user": {"name": "ada", "age": 36}, "tags": [1,2,3]}'),
+      (2, '{"user": {"name": "bob"}, "tags": []}'),
+      (3, 'not json'),
+      (4, '{"user": {"name": "ada", "age": 36}, "tags": [1,2,3]}')""", s)
+    return e, s
+
+
+def test_json_path_parser():
+    assert parse_json_path("$.a.b[2]") == ["a", "b", 2]
+    assert parse_json_path('$["odd key"].x') == ["odd key", "x"]
+    with pytest.raises(ValueError):
+        parse_json_path("a.b")
+    doc = '{"a": {"b": [10, 20]}}'
+    assert eval_json_path(doc, ["a", "b", 1]) == 20
+    assert eval_json_path(doc, ["a", "missing"]) is None
+    assert eval_json_path("not json", ["a"]) is None
+
+
+def test_json_extract_scalar(json_engine):
+    e, s = json_engine
+    rows = e.execute_sql(
+        "select id, json_extract_scalar(doc, '$.user.name') n "
+        "from ev order by id", s).rows()
+    assert rows == [(1, "ada"), (2, "bob"), (3, None), (4, "ada")]
+    # numbers stringify; missing members and structures are NULL
+    rows = e.execute_sql(
+        "select id, json_extract_scalar(doc, '$.user.age') a "
+        "from ev order by id", s).rows()
+    assert rows == [(1, "36"), (2, None), (3, None), (4, "36")]
+    rows = e.execute_sql(
+        "select json_extract_scalar(doc, '$.user') u from ev where id = 1",
+        s).rows()
+    assert rows == [(None,)]  # structure -> NULL for the scalar form
+
+
+def test_json_extract_and_lengths(json_engine):
+    e, s = json_engine
+    rows = e.execute_sql(
+        "select json_extract(doc, '$.user') u from ev where id = 1", s).rows()
+    assert rows == [('{"name":"ada","age":36}',)]
+    rows = e.execute_sql(
+        "select id, json_array_length(doc, '$.tags') l from ev order by id",
+        s).rows()
+    assert rows == [(1, 3), (2, 0), (3, None), (4, 3)]
+    rows = e.execute_sql(
+        "select id, json_size(doc, '$.user') z from ev order by id", s).rows()
+    assert rows == [(1, 2), (2, 1), (3, None), (4, 2)]
+
+
+def test_json_in_predicates_and_groupby(json_engine):
+    """Extracted values behave as first-class columns (filter, group by)."""
+    e, s = json_engine
+    rows = e.execute_sql(
+        "select count(*) c from ev "
+        "where json_extract_scalar(doc, '$.user.name') = 'ada'", s).rows()
+    assert rows == [(2,)]
+    rows = e.execute_sql(
+        "select json_extract_scalar(doc, '$.user.name') n, count(*) c "
+        "from ev group by 1 order by 1 nulls last", s).rows()
+    assert rows == [("ada", 2), ("bob", 1), (None, 1)]
+
+
+def test_registry_show_functions(json_engine):
+    """SHOW FUNCTIONS reads the one registry: json + legacy families listed
+    with category/arity metadata."""
+    e, s = json_engine
+    rows = e.execute_sql("show functions", s).rows()
+    by_name = {r[0]: r for r in rows}
+    assert by_name["json_extract_scalar"][1] == "json"
+    assert by_name["json_extract_scalar"][2] == "2"
+    assert by_name["sum"][1] in ("aggregate", "window")
+    assert "cardinality" in by_name and "upper" in by_name
+    assert len(rows) > 60
+
+
+def test_registry_arity_validation(json_engine):
+    e, s = json_engine
+    with pytest.raises(SemanticError, match="expects 2 arguments"):
+        e.execute_sql("select json_extract_scalar(doc) from ev", s)
+    assert lookup("json_extract").arity == (2, 2)
+    assert "json_size" in REGISTRY

@@ -38,13 +38,17 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 # workers are separate OS processes; select the platform via jax.config (the
-# env-var route hangs the axon plugin's discovery — see tests/conftest.py)
+# env-var route hangs the axon plugin's discovery — see tests/conftest.py).
+# x64 is unconditional: the whole engine (int64 accumulators, splitmix64 key
+# hashing, serialized page dtypes) assumes the global x64 session.
 if _os.environ.pop("TRINO_TPU_WORKER_CPU", None):
     _os.environ.pop("JAX_PLATFORMS", None)
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_enable_x64", True)
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
 
 from ..exec.fte import SpoolingExchange, merge_partial_pages, run_partial_aggregate
 from ..exec.local_executor import LocalExecutor, _materialize
@@ -114,6 +118,9 @@ class WorkerServer:
         self.tasks: OrderedDict = OrderedDict()  # task_id -> _TaskState
         self.max_fragments = 32
         self.max_task_states = 256
+        self._wlock = threading.Lock()  # handler threads + task threads share
+        # the registries; eviction must also never drop state still in use
+        self._running_frags: dict = {}  # fragment_id -> running task count
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._stop = threading.Event()
 
@@ -154,9 +161,10 @@ class WorkerServer:
                 if self.path == "/v1/task":
                     n = int(self.headers.get("Content-Length", 0))
                     req = pickle.loads(self.rfile.read(n))
-                    if req["fragment_id"] not in worker.fragments:
+                    try:
+                        worker._start_task(req)
+                    except KeyError:
                         return self._reply(409, {"error": "unknown fragment"})
-                    worker._start_task(req)
                     return self._reply(200, {"accepted": req["task_id"]})
                 self._reply(404, {"error": "not found"})
 
@@ -188,22 +196,36 @@ class WorkerServer:
 
     # -- task execution ----------------------------------------------------------
     def _register_fragment(self, frag_id: str, plan) -> None:
-        if frag_id in self.fragments:
-            return
-        self.fragments[frag_id] = plan
-        while len(self.fragments) > self.max_fragments:
-            _, old = self.fragments.popitem(last=False)
-            self.local.forget_plan(old)  # drop its compiled artifacts too
+        with self._wlock:
+            if frag_id in self.fragments:
+                return
+            self.fragments[frag_id] = plan
+            evictable = [f for f in self.fragments
+                         if not self._running_frags.get(f)]
+            while len(self.fragments) > self.max_fragments and evictable:
+                old_id = evictable.pop(0)
+                if old_id == frag_id:
+                    continue
+                old = self.fragments.pop(old_id)
+                self.local.forget_plan(old)  # drop its compiled artifacts too
 
     def _start_task(self, req: dict):
         tid = str(req["task_id"])
-        self.tasks[tid] = st = _TaskState()
-        while len(self.tasks) > self.max_task_states:
-            self.tasks.popitem(last=False)
+        frag_id = req["fragment_id"]
+        with self._wlock:
+            node = self.fragments.get(frag_id)
+            if node is None:
+                raise KeyError(frag_id)
+            self.tasks[tid] = st = _TaskState()
+            self._running_frags[frag_id] = self._running_frags.get(frag_id, 0) + 1
+            # prune only TERMINAL task states: a running entry evicted here
+            # would read as lost to the coordinator and burn a retry
+            done = [t for t, s in self.tasks.items() if s.state != "running"]
+            while len(self.tasks) > self.max_task_states and done:
+                self.tasks.pop(done.pop(0), None)
 
         def run():
             try:
-                node = self.fragments[req["fragment_id"]]
                 data = run_partial_aggregate(self.local, node, req["splits"])
                 SpoolingExchange(req["exchange_dir"]).commit(
                     req["task_id"], req.get("attempt", 0), data)
@@ -211,6 +233,13 @@ class WorkerServer:
             except Exception as e:  # pragma: no cover - surfaced via status
                 st.state = "failed"
                 st.error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+            finally:
+                with self._wlock:
+                    n = self._running_frags.get(frag_id, 1) - 1
+                    if n <= 0:
+                        self._running_frags.pop(frag_id, None)
+                    else:
+                        self._running_frags[frag_id] = n
 
         threading.Thread(target=run, daemon=True).start()
 
@@ -247,6 +276,11 @@ class ClusterCoordinator:
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self._exchange_seq = 0
+        # long-lived executor + sql->plan cache: repeated queries reuse one
+        # plan object, so the id(node)-keyed compiled-pipeline caches hit
+        # instead of re-tracing per query
+        self._local = LocalExecutor(engine.catalogs)
+        self._plan_cache: dict = {}
 
     # -- lifecycle ---------------------------------------------------------------
     def start(self) -> str:
@@ -340,8 +374,11 @@ class ClusterCoordinator:
 
         sess = session or self.engine.create_session(
             next(iter(self.engine.catalogs)))
-        plan = compile_sql(sql, self.engine, sess)
-        local = LocalExecutor(self.engine.catalogs)
+        plan = self._plan_cache.get(sql)
+        if plan is None:
+            plan = compile_sql(sql, self.engine, sess)
+            self._plan_cache[sql] = plan
+        local = self._local
         agg = self._find_distributable_aggregate(local, plan)
         if agg is None or not self.live_workers():
             return local.execute(plan)
@@ -409,7 +446,17 @@ class ClusterCoordinator:
                     assigned[tid] = (w, sp, time.time() + self.task_timeout)
                     del pending[tid]
                 except Exception:
-                    continue  # worker unreachable; heartbeat will gate it out
+                    # unreachable worker, or 409 after a restart/fragment
+                    # eviction: the fragment must re-ship, and the failed
+                    # dispatch burns an attempt so a permanently broken
+                    # worker set cannot spin this loop forever
+                    frag_sent.discard(w.url)
+                    attempts[tid] += 1
+                    if attempts[tid] >= self.max_attempts:
+                        raise RuntimeError(
+                            f"task {tid} failed to dispatch after "
+                            f"{attempts[tid]} attempts")
+                    continue
             # poll assigned tasks
             time.sleep(0.05)
             for tid, (w, sp, deadline) in list(assigned.items()):

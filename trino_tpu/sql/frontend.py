@@ -1758,6 +1758,20 @@ class Planner:
         name = ast.name
         if name in AGG_FUNCS:
             raise SemanticError(f"aggregate {name} in scalar context")
+        # registry-native functions first (reference: the analyzer resolving
+        # against the registered catalog, metadata/SystemFunctionBundle);
+        # legacy translations below migrate into the registry over time
+        from .functions import lookup
+
+        fdef = lookup(name)
+        if fdef is not None and fdef.builder is not None:
+            lo, hi = fdef.arity
+            if len(ast.args) < lo or (hi is not None and len(ast.args) > hi):
+                raise SemanticError(
+                    f"{name} expects {lo}"
+                    + ("" if hi == lo else f"..{hi if hi is not None else 'n'}")
+                    + f" arguments, got {len(ast.args)}")
+            return fdef.builder(self, ast, cols)
         if name in self._COLLECTION_FUNCS:
             return self._translate_collection_func(ast, cols)
         if name == "round" and len(ast.args) == 2:

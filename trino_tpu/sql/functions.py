@@ -1,0 +1,238 @@
+"""Declarative function registry + JSON functions.
+
+Reference: the engine-side function catalog assembled in one place —
+metadata/SystemFunctionBundle.java:384 registers every builtin through a
+declarative surface that SHOW FUNCTIONS and the analyzer read; the annotation
+framework (spi/function/@ScalarFunction + operator/annotations/) turns each
+definition into an invocable.  Here a FunctionDef maps name -> arity,
+category, description, and an optional BUILDER (planner, ast, cols) ->
+(ir.Expr, dict); legacy if-chain translations register metadata-only entries
+until they migrate, so the catalog has ONE source of truth either way.
+
+JSON functions (reference: operator/scalar/json/ + the jsonpath/ engine) are
+the first registry-native family.  TPU design: JSON documents are
+dictionary-encoded varchar, so a JSON path evaluates ONCE PER DISTINCT
+DOCUMENT on the host at plan time and becomes an id -> result lookup table —
+the device does one gather, the same trick the LIKE matcher uses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json as _json
+import re
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..types import BIGINT, BOOLEAN, Type, VarcharType
+from . import ir
+from . import parser as A
+
+__all__ = ["FunctionDef", "REGISTRY", "register", "catalog_rows", "JSON"]
+
+# json type: dictionary-encoded like varchar (reference: io.trino.type.JsonType)
+JSON = VarcharType(name="json", dtype=VarcharType.of(None).dtype, length=None)
+
+
+@dataclasses.dataclass(frozen=True)
+class FunctionDef:
+    """One catalog entry (reference: spi/function/FunctionMetadata)."""
+
+    name: str
+    category: str  # scalar | aggregate | window | collection | json
+    description: str
+    arity: tuple = (0, None)  # (min, max|None)
+    builder: Optional[Callable] = None  # (planner, ast, cols) -> (expr, dict)
+
+
+REGISTRY: dict = {}
+
+
+def register(name: str, category: str, description: str, arity=(0, None),
+             builder=None) -> None:
+    REGISTRY[name] = FunctionDef(name, category, description, tuple(arity),
+                                 builder)
+
+
+def lookup(name: str) -> Optional[FunctionDef]:
+    return REGISTRY.get(name)
+
+
+def catalog_rows():
+    """(name, category, arity, description) rows — SHOW FUNCTIONS reads these
+    (reference: the information_schema/SHOW FUNCTIONS surface over the
+    registered catalog)."""
+    out = []
+    for name in sorted(REGISTRY):
+        f = REGISTRY[name]
+        lo, hi = f.arity
+        arity = f"{lo}" if hi == lo else (f"{lo}+" if hi is None else f"{lo}-{hi}")
+        out.append((name, f.category, arity, f.description))
+    return out
+
+
+# ---------------------------------------------------------------------------- json path
+_PATH_RE = re.compile(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]|\[\"([^\"]+)\"\]")
+
+
+def parse_json_path(path: str):
+    """'$.store.book[0].title' -> steps; subset of the reference's JsonPath
+    grammar (core/trino-grammar JsonPath.g4): member access + array subscript,
+    lax semantics (missing -> NULL)."""
+    if not path.startswith("$"):
+        raise ValueError(f"JSON path must start with '$': {path!r}")
+    steps = []
+    pos = 1
+    while pos < len(path):
+        m = _PATH_RE.match(path, pos)
+        if not m:
+            raise ValueError(f"invalid JSON path at {pos}: {path!r}")
+        if m.group(1) is not None:
+            steps.append(m.group(1))
+        elif m.group(2) is not None:
+            steps.append(int(m.group(2)))
+        else:
+            steps.append(m.group(3))
+        pos = m.end()
+    return steps
+
+
+def eval_json_path(doc: str, steps) -> object:
+    """Apply path steps to one JSON document (lax: any miss -> None)."""
+    try:
+        v = _json.loads(doc)
+    except (ValueError, TypeError):
+        return None
+    for s in steps:
+        if isinstance(s, int):
+            if not isinstance(v, list) or not (0 <= s < len(v)):
+                return None
+            v = v[s]
+        else:
+            if not isinstance(v, dict) or s not in v:
+                return None
+            v = v[s]
+    return v
+
+
+def _scalar_to_str(v) -> Optional[str]:
+    """json_extract_scalar semantics: scalars stringify, structures -> NULL."""
+    if v is None or isinstance(v, (dict, list)):
+        return None
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return str(v)
+
+
+def _json_lut(planner, ast, cols, to_value, out_type):
+    """Shared JSON builder: evaluate the path over every distinct document,
+    emit (id -> result) LUT expression + result dictionary."""
+    from ..connectors.tpch import Dictionary
+
+    v, d = planner._require_dict(ast.args[0], cols, ast.name)
+    steps = parse_json_path(planner._literal_str(ast.args[1], ast.name))
+    outs = [to_value(eval_json_path(str(doc), steps)) for doc in d.values]
+    if out_type is BIGINT:
+        table = np.array([-1 if o is None else int(o) for o in outs], np.int64)
+        miss = np.array([o is None for o in outs])
+        e = ir.Call("lut", (v, ir.Constant(table, BIGINT)), BIGINT)
+        if miss.any():
+            flag = ir.Call("lut", (v, ir.Constant(miss, BOOLEAN)), BOOLEAN)
+            e = ir.Call("null_if_flag", (e, flag), BIGINT)
+        return e, None
+    # string-valued: build a result dictionary; path misses -> NULL
+    strs = ["" if o is None else str(o) for o in outs]
+    uniq, inv = np.unique(np.array(strs, dtype=object), return_inverse=True)
+    lut = inv.astype(np.int32)
+    miss = np.array([o is None for o in outs])
+    e = ir.Call("lut", (v, ir.Constant(lut, out_type)), out_type)
+    if miss.any():
+        flag = ir.Call("lut", (v, ir.Constant(miss, BOOLEAN)), BOOLEAN)
+        e = ir.Call("null_if_flag", (e, flag), out_type)
+    return e, Dictionary(values=uniq)
+
+
+def _build_json_extract_scalar(planner, ast, cols):
+    return _json_lut(planner, ast, cols, _scalar_to_str, VarcharType.of(None))
+
+
+def _build_json_extract(planner, ast, cols):
+    def fmt(v):
+        return None if v is None else _json.dumps(v, separators=(",", ":"))
+
+    return _json_lut(planner, ast, cols, fmt, JSON)
+
+
+def _build_json_array_length(planner, ast, cols):
+    def length(v):
+        return len(v) if isinstance(v, list) else None
+
+    if len(ast.args) == 1:
+        # whole document form: path '$'
+        ast = A.FuncCall(ast.name, (ast.args[0], A.StringLit("$")))
+    return _json_lut(planner, ast, cols, length, BIGINT)
+
+
+def _build_json_size(planner, ast, cols):
+    def size(v):
+        if isinstance(v, (list, dict)):
+            return len(v)
+        return None
+
+    return _json_lut(planner, ast, cols, size, BIGINT)
+
+
+def _register_json():
+    register("json_extract_scalar", "json",
+             "Extract a scalar (varchar) at a JSON path", (2, 2),
+             _build_json_extract_scalar)
+    register("json_extract", "json",
+             "Extract the JSON value at a JSON path", (2, 2),
+             _build_json_extract)
+    register("json_array_length", "json",
+             "Length of a JSON array (at an optional path)", (1, 2),
+             _build_json_array_length)
+    register("json_size", "json",
+             "Number of members of the object/array at a JSON path", (2, 2),
+             _build_json_size)
+
+
+_register_json()
+
+
+_LEGACY_REGISTERED = False
+
+
+def ensure_legacy_registered() -> None:
+    """Metadata-only catalog entries for functions still translated by the
+    planner's legacy if-chain — SHOW FUNCTIONS reads ONE registry either way.
+    Lazy (called from the SHOW surface) to avoid a frontend import cycle."""
+    global _LEGACY_REGISTERED
+    if _LEGACY_REGISTERED:
+        return
+    _LEGACY_REGISTERED = True
+    from . import frontend as F
+
+    def meta(names, category, desc):
+        for n in names:
+            if n not in REGISTRY:
+                register(n, category, desc)
+
+    meta(F.AGG_FUNCS, "aggregate", "Aggregate function")
+    meta(F.Planner.WINDOW_FUNCS, "window", "Window function")
+    meta(F.Planner._STRING_MAP_FUNCS, "scalar",
+         "String function (dictionary-domain)")
+    meta(F.Planner._MATH_DOUBLE_FUNCS, "scalar", "Double math function")
+    meta(F.Planner._COLLECTION_FUNCS, "collection", "Array/map/row function")
+    meta(("abs", "round", "ceil", "ceiling", "floor", "sign", "trunc", "power",
+          "pow", "mod"), "scalar", "Numeric function")
+    meta(("substring", "length", "concat", "strpos", "replace", "split_part",
+          "regexp_like", "codepoint", "chr", "left", "right"), "scalar",
+         "String function")
+    meta(("coalesce", "nullif", "if", "greatest", "least", "try_cast", "cast",
+          "typeof"), "scalar", "Conditional/conversion function")
+    meta(("extract", "date_add", "date_diff", "year", "month", "day"),
+         "scalar", "Date/time function")
