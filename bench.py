@@ -236,6 +236,34 @@ def main():
                "unit": "rows/s", "vs_baseline": 0}
 
     try:
+        # a wedged accelerator tunnel hangs INSIDE backend init, where the
+        # plugin's retry loop swallows our signal-raised exceptions (observed:
+        # axon init absorbing SIGTERM/SIGALRM indefinitely).  Probe device init
+        # in a SUBPROCESS first: if it cannot come up within the probe budget,
+        # emit the failure JSON instead of hanging into an rc=124 null.  Inside
+        # this try: so a driver SIGTERM during the probe still reaches the
+        # JSON-emitting finally below.
+        if not _force_cpu:
+            import subprocess
+
+            probe_s = float(os.environ.get("BENCH_DEVICE_PROBE_TIMEOUT", "240"))
+            try:
+                probe = subprocess.run(
+                    [sys.executable, "-c",
+                     "import jax; print(jax.devices()[0].platform)"],
+                    capture_output=True, timeout=probe_s)
+                ok = probe.returncode == 0
+                if not ok:
+                    print(f"bench: device probe failed: "
+                          f"{probe.stderr.decode()[-300:]}", file=sys.stderr)
+            except subprocess.TimeoutExpired:
+                ok = False
+                print(f"bench: device init did not finish in {probe_s:.0f}s "
+                      f"(wedged tunnel?)", file=sys.stderr)
+            if not ok:
+                payload["metric"] = f"tpch_sf{SF:g}_bench_failed_no_device"
+                return  # the finally below prints the payload
+
         from trino_tpu import Engine
         from trino_tpu.connectors.tpch import TpchConnector
 

@@ -230,8 +230,11 @@ class ParquetConnector:
         if pa.types.is_dictionary(col.type):
             # local dictionary -> table-wide ids: one python pass PER DISTINCT
             # VALUE, then a vectorized gather over the index vector
+            # a value missing from the cached table-wide map means the file
+            # changed under a stale _PqTable cache: fail LOUDLY (a .get(v, 0)
+            # default would silently alias rows to the first dictionary value)
             local = col.dictionary.to_pylist()
-            remap = np.fromiter((id_map.get(v, 0) for v in local), np.int32,
+            remap = np.fromiter((id_map[v] for v in local), np.int32,
                                 count=len(local))
             idx = col.indices.fill_null(0)
             return remap[np.asarray(idx).astype(np.int64)] if len(local) \
@@ -334,7 +337,15 @@ class ParquetConnector:
             elif ty.name == "date":
                 arrays.append(pa.array(col, type=pa.int32()).cast(pa.date32()))
             else:
-                arrays.append(pa.array(col))
+                # declared type, NOT value inference: an all-null column would
+                # otherwise persist as arrow null (unreadable table) and
+                # integer/real would widen to bigint/double on rewrite
+                at = (pa.string() if ty.is_string else
+                      {"bigint": pa.int64(), "integer": pa.int32(),
+                       "smallint": pa.int16(), "tinyint": pa.int8(),
+                       "double": pa.float64(), "real": pa.float32(),
+                       "boolean": pa.bool_()}[ty.name])
+                arrays.append(pa.array(col, type=at))
         os.makedirs(self.directory, exist_ok=True)
         path = os.path.join(self.directory, f"{table}.parquet")
         pq.write_table(pa.table(dict(zip(names, arrays))), path)
