@@ -45,6 +45,10 @@ def _arrow_to_type(at):
         return BOOLEAN
     if pa.types.is_date32(at):
         return DATE
+    if pa.types.is_timestamp(at):
+        from ..types import TIMESTAMP
+
+        return TIMESTAMP
     if pa.types.is_decimal(at):
         if at.precision > 18:
             raise ValueError(f"decimal precision {at.precision} > 18 not supported")
@@ -257,7 +261,9 @@ class ParquetConnector:
             return {"bigint": pa.int64(), "integer": pa.int32(),
                     "smallint": pa.int16(), "tinyint": pa.int8(),
                     "double": pa.float64(), "real": pa.float32(),
-                    "boolean": pa.bool_(), "date": pa.date32()}[ty.name]
+                    "boolean": pa.bool_(), "date": pa.date32(),
+                    "timestamp(6)": pa.timestamp("us"),
+                    "unknown": pa.int8()}[ty.name]
 
         return pa.schema([(f.name, at(f.type)) for f in schema.fields])
 
@@ -344,7 +350,9 @@ class ParquetConnector:
                       {"bigint": pa.int64(), "integer": pa.int32(),
                        "smallint": pa.int16(), "tinyint": pa.int8(),
                        "double": pa.float64(), "real": pa.float32(),
-                       "boolean": pa.bool_()}[ty.name])
+                       "boolean": pa.bool_(),
+                       "timestamp(6)": pa.timestamp("us"),
+                       "unknown": pa.int8()}[ty.name])
                 arrays.append(pa.array(col, type=at))
         os.makedirs(self.directory, exist_ok=True)
         path = os.path.join(self.directory, f"{table}.parquet")

@@ -65,8 +65,11 @@ class CoordinatorServer:
     CoordinatorModule vs WorkerModule role split)."""
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 8080,
-                 dispatch_threads: int = 4):
+                 dispatch_threads: int = 4, passwords: Optional[dict] = None):
         self.engine = engine
+        # user -> password; None = open access (reference: optional password
+        # authenticator plugins; file-based password auth)
+        self.passwords = passwords
         self.host = host
         self.port = port
         self.queries: dict = {}
@@ -100,13 +103,26 @@ class CoordinatorServer:
                 if self.path != "/v1/statement":
                     self._send(404, {"error": "not found"})
                     return
+                user = self.headers.get("X-Trino-User")
+                if not server._authenticate(self.headers, user):
+                    self.send_response(401)
+                    self.send_header("WWW-Authenticate", "Basic")
+                    self.end_headers()
+                    return
+                if user is None:
+                    user = server._principal(self.headers) or "user"
                 n = int(self.headers.get("Content-Length", 0))
                 sql = self.rfile.read(n).decode()
                 session_catalog = self.headers.get("X-Trino-Catalog")
-                q = server._submit(sql, session_catalog)
+                q = server._submit(sql, session_catalog, user)
                 self._send(200, server._queued_response(q))
 
             def do_GET(self):
+                if not server._authenticate(self.headers, None):
+                    self.send_response(401)
+                    self.send_header("WWW-Authenticate", "Basic")
+                    self.end_headers()
+                    return
                 parts = self.path.strip("/").split("/")
                 # /v1/statement/executing/{id}/{token}
                 if len(parts) == 5 and parts[:3] == ["v1", "statement", "executing"]:
@@ -128,9 +144,35 @@ class CoordinatorServer:
                     self._send(200, {"coordinator": True, "running": True,
                                      "nodeVersion": {"version": "trino-tpu-0"}})
                     return
+                if parts == ["v1", "metrics"]:
+                    # reference: JmxOpenMetricsModule — a Prometheus text
+                    # exposition of engine counters
+                    body = server._metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if parts == ["ui"] or parts == ["ui", ""]:
+                    # reference: core/trino-web-ui's cluster overview, reduced
+                    # to a self-contained status page over the same query data
+                    body = server._ui_html().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 self._send(404, {"error": "not found"})
 
             def do_DELETE(self):
+                if not server._authenticate(self.headers, None):
+                    self.send_response(401)
+                    self.send_header("WWW-Authenticate", "Basic")
+                    self.end_headers()
+                    return
                 parts = self.path.strip("/").split("/")
                 qid = None
                 if len(parts) >= 5 and parts[:3] == ["v1", "statement", "executing"]:
@@ -162,12 +204,92 @@ class CoordinatorServer:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
+    # -- auth (reference: password authenticators + InternalAuthenticationManager;
+    # a password map gates access when configured, else open) ----------------------
+    def _authenticate(self, headers, user) -> bool:
+        """Basic credentials against the password map (constant-time compare).
+        When an X-Trino-User is given it must match the authenticated
+        principal (reference: the authenticated user gates the session user);
+        result/cancel/metrics GETs authenticate the principal alone."""
+        if self.passwords is None:
+            return True
+        import base64
+        import hmac
+
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Basic "):
+            return False
+        try:
+            decoded = base64.b64decode(auth[6:]).decode()
+            auth_user, _, pw = decoded.partition(":")
+        except Exception:
+            return False
+        expected = self.passwords.get(auth_user)
+        if expected is None or not hmac.compare_digest(expected, pw):
+            return False
+        return user is None or auth_user == user
+
+    def _principal(self, headers):
+        import base64
+
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Basic "):
+            return None
+        try:
+            return base64.b64decode(auth[6:]).decode().partition(":")[0]
+        except Exception:
+            return None
+
+    def _metrics_text(self) -> str:
+        with self._queries_lock:
+            qs = list(self.queries.values())
+        by_state: dict = {}
+        for q in qs:
+            by_state[q.state] = by_state.get(q.state, 0) + 1
+        lines = [
+            "# TYPE trino_tpu_queries_total counter",
+            f"trino_tpu_queries_total {len(qs)}",
+            "# TYPE trino_tpu_queries_by_state gauge",
+        ]
+        for state, n in sorted(by_state.items()):
+            lines.append(
+                f'trino_tpu_queries_by_state{{state="{state}"}} {n}')
+        done = [q for q in qs if q.finished_at is not None]
+        if done:
+            total = sum(q.finished_at - q.created_at for q in done)
+            lines += ["# TYPE trino_tpu_query_seconds_total counter",
+                      f"trino_tpu_query_seconds_total {total:.3f}"]
+        return "\n".join(lines) + "\n"
+
+    def _ui_html(self) -> str:
+        with self._queries_lock:
+            qs = sorted(self.queries.values(), key=lambda q: q.created_at,
+                        reverse=True)[:50]
+        import html as _html
+
+        rows = "".join(
+            f"<tr><td>{_html.escape(q.query_id)}</td>"
+            f"<td>{_html.escape(q.state)}</td>"
+            f"<td>{(q.finished_at or time.time()) - q.created_at:.2f}s</td>"
+            f"<td><code>{_html.escape(q.sql[:120])}</code></td></tr>"
+            for q in qs)
+        return ("<!doctype html><title>trino-tpu</title>"
+                "<style>body{font-family:sans-serif;margin:2em}"
+                "table{border-collapse:collapse}td,th{border:1px solid #ccc;"
+                "padding:4px 8px;text-align:left}</style>"
+                "<h1>trino-tpu coordinator</h1>"
+                f"<p>{len(self.queries)} queries tracked | "
+                f"<a href='/v1/metrics'>metrics</a></p>"
+                "<table><tr><th>query</th><th>state</th><th>elapsed</th>"
+                f"<th>sql</th></tr>{rows}</table>")
+
     # -- dispatch -----------------------------------------------------------------
-    def _submit(self, sql: str, catalog: Optional[str]) -> _Query:
+    def _submit(self, sql: str, catalog: Optional[str],
+                user: str = "user") -> _Query:
         q = _Query(query_id=f"q{next(_qids)}", sql=sql)
         with self._queries_lock:
             self.queries[q.query_id] = q
-        self._pool.submit(self._run, q, catalog)
+        self._pool.submit(self._run, q, catalog, user)
         return q
 
     def _set_state(self, q: _Query, new: str) -> bool:
@@ -179,12 +301,14 @@ class CoordinatorServer:
             q.state = new
             return True
 
-    def _run(self, q: _Query, catalog: Optional[str]) -> None:
+    def _run(self, q: _Query, catalog: Optional[str],
+             user: str = "user") -> None:
         try:
             with self._engine_lock:
                 if not self._set_state(q, "PLANNING"):
                     return  # canceled while queued: never execute
                 session = self.engine.create_session(catalog)
+                session.user = user
                 if not self._set_state(q, "RUNNING"):
                     return
                 res = self.engine.execute_sql(q.sql, session)

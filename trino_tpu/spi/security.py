@@ -1,0 +1,120 @@
+"""Access-control SPI + file-style rule engine.
+
+Reference: core/trino-spi spi/security — SystemAccessControl's checkCan*
+surface (denials raise AccessDeniedException) — and the file-based access
+control plugin (plugin/trino-base-jdbc's is unrelated; the model here is
+trino's file-based SystemAccessControl: ordered rules, first match wins,
+user regex + catalog/table scoping, allow = all | read-only | none).
+
+The engine holds one AccessControl; enforcement points mirror the reference's:
+query admission (DispatchManager), table SELECT at planning time (the analyzer
+resolving each table), DML/DDL statement tasks, and SHOW TABLES filtering
+(filterTables)."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+__all__ = ["AccessDeniedError", "AccessControl", "AllowAllAccessControl",
+           "RuleBasedAccessControl"]
+
+
+class AccessDeniedError(PermissionError):
+    """reference: spi/security/AccessDeniedException.java."""
+
+
+class AccessControl:
+    """Default-allow base (reference: SystemAccessControl's default methods)."""
+
+    def check_can_execute_query(self, user: str) -> None:
+        pass
+
+    def check_can_select(self, user: str, catalog: str, table: str) -> None:
+        pass
+
+    def check_can_write(self, user: str, catalog: str, table: str,
+                        operation: str) -> None:
+        """INSERT/DELETE/UPDATE/CREATE/DROP — the reference splits these into
+        per-operation checks; the rule engine here gates them all on write
+        access, so one hook carries the operation name for the error."""
+
+    def check_can_set_session_property(self, user: str, name: str) -> None:
+        pass
+
+    def filter_tables(self, user: str, catalog: str, tables):
+        return list(tables)
+
+
+class AllowAllAccessControl(AccessControl):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class _Rule:
+    user_re: re.Pattern
+    catalog_re: re.Pattern
+    table_re: Optional[re.Pattern]  # None = catalog-level rule
+    allow: str  # all | read-only | none
+
+
+class RuleBasedAccessControl(AccessControl):
+    """Ordered first-match-wins rules (reference: file-based access control's
+    catalog + table rules).  Config shape::
+
+        {"catalogs": [{"user": "ana.*", "catalog": "tpch", "allow": "read-only"},
+                      {"catalog": ".*", "allow": "all"}],
+         "tables":   [{"user": ".*", "catalog": "mem", "table": "secret.*",
+                       "allow": "none"}]}
+
+    Omitted keys default to match-everything; an empty rule list allows all.
+    """
+
+    def __init__(self, config: dict):
+        def compile_rules(entries, with_table):
+            out = []
+            for e in entries:
+                out.append(_Rule(
+                    re.compile(e.get("user", ".*") + r"\Z"),
+                    re.compile(e.get("catalog", ".*") + r"\Z"),
+                    re.compile(e.get("table", ".*") + r"\Z") if with_table else None,
+                    e.get("allow", "all")))
+            return out
+
+        self.catalog_rules = compile_rules(config.get("catalogs", ()), False)
+        self.table_rules = compile_rules(config.get("tables", ()), True)
+
+    def _catalog_access(self, user: str, catalog: str) -> str:
+        for r in self.catalog_rules:
+            if r.user_re.match(user) and r.catalog_re.match(catalog):
+                return r.allow
+        return "all" if not self.catalog_rules else "none"
+
+    def _table_access(self, user: str, catalog: str, table: str) -> str:
+        for r in self.table_rules:
+            if r.user_re.match(user) and r.catalog_re.match(catalog) \
+                    and r.table_re.match(table):
+                return r.allow
+        return "all"  # table rules only narrow; catalog rules gate overall
+
+    def _effective(self, user: str, catalog: str, table: str) -> str:
+        cat = self._catalog_access(user, catalog)
+        tab = self._table_access(user, catalog, table)
+        order = {"none": 0, "read-only": 1, "all": 2}
+        return min(cat, tab, key=lambda a: order[a])
+
+    def check_can_select(self, user: str, catalog: str, table: str) -> None:
+        if self._effective(user, catalog, table) == "none":
+            raise AccessDeniedError(
+                f"Access Denied: Cannot select from {catalog}.{table}")
+
+    def check_can_write(self, user: str, catalog: str, table: str,
+                        operation: str) -> None:
+        if self._effective(user, catalog, table) != "all":
+            raise AccessDeniedError(
+                f"Access Denied: Cannot {operation} {catalog}.{table}")
+
+    def filter_tables(self, user: str, catalog: str, tables):
+        return [t for t in tables
+                if self._effective(user, catalog, t) != "none"]
