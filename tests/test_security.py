@@ -115,3 +115,61 @@ def test_http_auth_and_metrics(tpch_sf001):
         assert "trino-tpu coordinator" in html
     finally:
         srv.stop()
+
+
+def test_materialized_views(tpch_sf001):
+    """CREATE/REFRESH/DROP MATERIALIZED VIEW: queries read the storage table
+    (results as of the last refresh), REFRESH re-materializes (reference:
+    CreateMaterializedViewTask / RefreshMaterializedViewTask + MV storage
+    tables)."""
+    e = Engine()
+    e.register_catalog("tpch", tpch_sf001)
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table src (k bigint)", s)
+    e.execute_sql("insert into src values (1), (2)", s)
+    e.execute_sql("create materialized view mv as "
+                  "select count(*) c, sum(k) s from src", s)
+    assert e.execute_sql("select c, s from mv", s).rows() == [(2, 3)]
+    # base-table changes are invisible until REFRESH
+    e.execute_sql("insert into src values (10)", s)
+    assert e.execute_sql("select c, s from mv", s).rows() == [(2, 3)]
+    e.execute_sql("refresh materialized view mv", s)
+    assert e.execute_sql("select c, s from mv", s).rows() == [(3, 13)]
+    # listed by SHOW TABLES, storage table hidden
+    names = [t for (t,) in e.execute_sql("show tables", s).rows()]
+    assert "mv" in names and "__mv_mv" not in names
+    e.execute_sql("drop materialized view mv", s)
+    with pytest.raises(Exception):
+        e.execute_sql("select * from mv", s)
+
+
+def test_grant_revoke(tpch_sf001):
+    """GRANT/REVOKE against the grant-based access control: default-closed,
+    privileges arrive per table per user, REVOKE removes them (reference:
+    GrantTask/RevokeTask + spi/security/Privilege)."""
+    from trino_tpu.spi.security import GrantBasedAccessControl
+
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    e.access_control = GrantBasedAccessControl(admins=("admin",))
+    admin = e.create_session("mem")
+    admin.user = "admin"
+    e.execute_sql("create table t1 (x bigint)", admin)
+    e.execute_sql("insert into t1 values (7)", admin)
+    bob = e.create_session("mem")
+    bob.user = "bob"
+    with pytest.raises(AccessDeniedError):
+        e.execute_sql("select * from t1", bob)
+    e.execute_sql("grant select on t1 to bob", admin)
+    assert e.execute_sql("select x from t1", bob).rows() == [(7,)]
+    with pytest.raises(AccessDeniedError):  # select does not confer insert
+        e.execute_sql("insert into t1 values (8)", bob)
+    e.execute_sql("grant insert on table t1 to bob", admin)
+    e.execute_sql("insert into t1 values (8)", bob)
+    e.execute_sql("revoke all privileges on t1 from bob", admin)
+    with pytest.raises(AccessDeniedError):
+        e.execute_sql("select * from t1", bob)
+    # non-admins may not administer grants
+    with pytest.raises(AccessDeniedError):
+        e.execute_sql("grant select on t1 to eve", bob)

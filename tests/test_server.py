@@ -111,3 +111,50 @@ def test_cancel_terminal(coordinator):
             f"{coordinator.url}/v1/statement/executing/{qid}/0")
         body = json.loads(resp.read())
         assert "nextUri" not in body  # terminal: client stops polling
+
+
+def test_spooled_result_protocol(tmp_path, tpch_sf001):
+    """Results at/above the spool threshold return segment descriptors instead
+    of inline pages; the client fetches and decompresses segment payloads by
+    URI (reference: server/protocol/spooling + spi/spool/SpoolingManager,
+    client OkHttpSegmentLoader)."""
+    import json as _json
+    import urllib.request
+    import zlib
+
+    from trino_tpu import Engine
+    from trino_tpu.server.client import Client
+    from trino_tpu.server.server import CoordinatorServer
+
+    e = Engine()
+    e.register_catalog("tpch", tpch_sf001)
+    srv = CoordinatorServer(e, spool_dir=str(tmp_path / "spool"),
+                            spool_threshold_rows=100)
+    srv.start()
+    try:
+        c = Client(srv.url, catalog="tpch")
+        res = c.execute("select c_custkey from customer order by c_custkey")
+        n = len(res.rows)
+        assert n == 1500
+        assert [r[0] for r in res.rows[:3]] == [1, 2, 3]
+        # raw protocol surface: the executing response carries segments
+        out = _json.loads(urllib.request.urlopen(
+            urllib.request.Request(f"{srv.url}/v1/statement", method="POST",
+                                   data=b"select c_custkey from customer",
+                                   headers={"X-Trino-Catalog": "tpch"}),
+            timeout=30).read())
+        import time as _t
+
+        while out.get("nextUri") and "segments" not in out:
+            _t.sleep(0.05)
+            out = _json.loads(urllib.request.urlopen(out["nextUri"],
+                                                     timeout=10).read())
+        assert out["segments"] and out["segments"][0]["encoding"] == "json+zlib"
+        seg = out["segments"][0]
+        payload = urllib.request.urlopen(seg["uri"], timeout=10).read()
+        assert len(_json.loads(zlib.decompress(payload))) == seg["rowCount"]
+        # small results stay inline
+        res2 = c.execute("select count(*) c from region")
+        assert res2.rows == [[5]]
+    finally:
+        srv.stop()

@@ -61,6 +61,10 @@ class Client:
             if out.get("columns"):
                 columns = out["columns"]
             rows.extend(out.get("data") or [])
+            for seg in out.get("segments") or ():
+                # spooled protocol: fetch the segment payload by URI
+                # (reference: OkHttpSegmentLoader following spooled segments)
+                rows.extend(self._fetch_segment(seg))
             nxt = out.get("nextUri")
             if nxt is None:
                 break
@@ -71,6 +75,17 @@ class Client:
                 time.sleep(self.poll_interval)
             out = self._request(nxt)
         return ClientResult(columns or [], rows)
+
+    def _fetch_segment(self, seg: dict) -> list:
+        import zlib
+
+        req = urllib.request.Request(seg["uri"],
+                                     headers={"X-Trino-User": self.user})
+        with urllib.request.urlopen(req) as resp:
+            data = resp.read()
+        if seg.get("encoding") == "json+zlib":
+            data = zlib.decompress(data)
+        return json.loads(data)
 
     def cancel(self, query_id: str) -> None:
         self._request(f"{self.base_url}/v1/statement/{query_id}", "DELETE")

@@ -38,6 +38,8 @@ class _Query:
     error: Optional[str] = None
     columns: Optional[list] = None  # [{name, type}]
     rows: Optional[list] = None  # list of row tuples (json-ready)
+    segments: Optional[list] = None  # spooled result descriptors
+    user: str = "user"  # submitting principal: result reads require it
     created_at: float = dataclasses.field(default_factory=time.time)
     finished_at: Optional[float] = None
     lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
@@ -65,11 +67,19 @@ class CoordinatorServer:
     CoordinatorModule vs WorkerModule role split)."""
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 8080,
-                 dispatch_threads: int = 4, passwords: Optional[dict] = None):
+                 dispatch_threads: int = 4, passwords: Optional[dict] = None,
+                 spool_dir: Optional[str] = None,
+                 spool_threshold_rows: int = 10_000):
         self.engine = engine
         # user -> password; None = open access (reference: optional password
         # authenticator plugins; file-based password auth)
         self.passwords = passwords
+        # spooled client protocol (reference: server/protocol/spooling + the
+        # SpoolingManager SPI, spi/spool/SpoolingManager.java): results at or
+        # above the threshold write as compressed segments the client fetches
+        # by URI instead of inline JSON pages.  None disables spooling.
+        self.spool_dir = spool_dir
+        self.spool_threshold_rows = spool_threshold_rows
         self.host = host
         self.port = port
         self.queries: dict = {}
@@ -131,6 +141,9 @@ class CoordinatorServer:
                     if q is None:
                         self._send(404, {"error": f"unknown query {qid}"})
                         return
+                    if not server._owns(self.headers, q):
+                        self._send(403, {"error": "not your query"})
+                        return
                     self._send(200, server._results_response(q, token))
                     return
                 if len(parts) == 3 and parts[:2] == ["v1", "query"]:
@@ -143,6 +156,24 @@ class CoordinatorServer:
                 if parts == ["v1", "info"]:
                     self._send(200, {"coordinator": True, "running": True,
                                      "nodeVersion": {"version": "trino-tpu-0"}})
+                    return
+                # /v1/spooled/{qid}/{seg} — spooled result segment payload
+                # (reference: the client fetching spooled segments by URI,
+                # client/trino-client/.../OkHttpSegmentLoader.java)
+                if len(parts) == 4 and parts[:2] == ["v1", "spooled"]:
+                    q = server.queries.get(parts[2])
+                    if q is not None and not server._owns(self.headers, q):
+                        self._send(403, {"error": "not your query"})
+                        return
+                    data = server._read_segment(parts[2], parts[3])
+                    if data is None:
+                        self._send(404, {"error": "unknown segment"})
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
                     return
                 if parts == ["v1", "metrics"]:
                     # reference: JmxOpenMetricsModule — a Prometheus text
@@ -181,6 +212,9 @@ class CoordinatorServer:
                     qid = parts[2]
                 if qid is not None:
                     q = server.queries.get(qid)
+                    if q is not None and not server._owns(self.headers, q):
+                        self._send(403, {"error": "not your query"})
+                        return
                     if q is not None:
                         with q.lock:
                             if q.state not in ("FINISHED", "FAILED"):
@@ -228,6 +262,15 @@ class CoordinatorServer:
         if expected is None or not hmac.compare_digest(expected, pw):
             return False
         return user is None or auth_user == user
+
+    def _owns(self, headers, q) -> bool:
+        """Result reads and cancels belong to the submitting principal: query
+        ids are guessable, and per-table access control would otherwise be
+        moot for any data another user has already queried.  Open servers
+        (no password map) skip the check."""
+        if self.passwords is None:
+            return True
+        return self._principal(headers) == q.user
 
     def _principal(self, headers):
         import base64
@@ -286,11 +329,19 @@ class CoordinatorServer:
     # -- dispatch -----------------------------------------------------------------
     def _submit(self, sql: str, catalog: Optional[str],
                 user: str = "user") -> _Query:
-        q = _Query(query_id=f"q{next(_qids)}", sql=sql)
+        q = _Query(query_id=f"q{next(_qids)}", sql=sql, user=user)
         with self._queries_lock:
             self.queries[q.query_id] = q
         self._pool.submit(self._run, q, catalog, user)
         return q
+
+    def _drop_spool(self, query_id: str) -> None:
+        import os
+        import shutil
+
+        if self.spool_dir is not None:
+            shutil.rmtree(os.path.join(self.spool_dir, query_id),
+                          ignore_errors=True)
 
     def _set_state(self, q: _Query, new: str) -> bool:
         """Transition unless a cancel already landed (q.lock guards the race between
@@ -319,11 +370,20 @@ class CoordinatorServer:
                 columns = [{"name": n, "type": t.name}
                            for n, t in zip(res.names, res.types)]
                 rows = [[_json_value(v) for v in row] for row in res.rows()]
+            if self.spool_dir is not None and len(rows) >= self.spool_threshold_rows:
+                segments = self._spool_rows(q.query_id, rows)
+                rows = []  # spooled results live on disk, not inline
+            else:
+                segments = None
             with q.lock:
-                if q.state != "CANCELED":
+                canceled = q.state == "CANCELED"
+                if not canceled:
+                    q.segments = segments
                     q.columns = columns
                     q.rows = rows
                     q.state = "FINISHED"
+            if canceled and segments:
+                self._drop_spool(q.query_id)  # orphaned mid-cancel segments
         except Exception as e:  # noqa: BLE001 - protocol surface reports all failures
             with q.lock:
                 if q.state != "CANCELED":
@@ -343,6 +403,7 @@ class CoordinatorServer:
             done.sort(key=lambda q: q.finished_at or 0)
             for q in done[:-keep] if len(done) > keep else []:
                 self.queries.pop(q.query_id, None)
+                self._drop_spool(q.query_id)
 
     # -- responses ----------------------------------------------------------------
     def _queued_response(self, q: _Query) -> dict:
@@ -363,6 +424,22 @@ class CoordinatorServer:
             # still running: client re-polls the same token (long-poll analog)
             return {"id": q.query_id, "stats": {"state": q.state},
                     "nextUri": f"{self.url}/v1/statement/executing/{q.query_id}/{token}"}
+        if q.segments is not None:
+            # spooled protocol: one response carries every segment descriptor;
+            # the client fetches payloads straight from the spool URIs
+            # (reference: server/protocol/spooling/ — segments of
+            # json+zstd/json+lz4; the in-tree codec here is json+zlib)
+            return {
+                "id": q.query_id,
+                "columns": q.columns,
+                "segments": [
+                    {"uri": f"{self.url}/v1/spooled/{q.query_id}/{i}",
+                     "encoding": "json+zlib", "rowCount": seg["rows"],
+                     "uncompressedSize": seg["raw_bytes"]}
+                    for i, seg in enumerate(q.segments)],
+                "stats": {"state": q.state,
+                          "totalRows": sum(s["rows"] for s in q.segments)},
+            }
         lo = token * DATA_ROWS_PER_FETCH
         hi = lo + DATA_ROWS_PER_FETCH
         out = {
@@ -375,6 +452,36 @@ class CoordinatorServer:
             out["nextUri"] = (
                 f"{self.url}/v1/statement/executing/{q.query_id}/{token + 1}")
         return out
+
+    def _spool_rows(self, query_id: str, rows) -> list:
+        """Write result rows as compressed JSON segments; returns descriptors.
+        Segment size follows the inline page size so the client's memory
+        profile matches the paged path."""
+        import os
+        import zlib
+
+        d = os.path.join(self.spool_dir, query_id)
+        os.makedirs(d, exist_ok=True)
+        segments = []
+        for i in range(0, max(len(rows), 1), DATA_ROWS_PER_FETCH):
+            chunk = rows[i:i + DATA_ROWS_PER_FETCH]
+            raw = json.dumps(chunk).encode()
+            with open(os.path.join(d, f"seg_{len(segments)}"), "wb") as f:
+                f.write(zlib.compress(raw, 1))
+            segments.append({"rows": len(chunk), "raw_bytes": len(raw)})
+        return segments
+
+    def _read_segment(self, query_id: str, seg: str):
+        import os
+
+        if self.spool_dir is None or not seg.isdigit() \
+                or query_id not in self.queries:
+            return None
+        path = os.path.join(self.spool_dir, query_id, f"seg_{int(seg)}")
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
 
     def _query_info(self, q: _Query) -> dict:
         return {

@@ -18,7 +18,7 @@ import re
 from typing import Optional
 
 __all__ = ["AccessDeniedError", "AccessControl", "AllowAllAccessControl",
-           "RuleBasedAccessControl"]
+           "RuleBasedAccessControl", "GrantBasedAccessControl"]
 
 
 class AccessDeniedError(PermissionError):
@@ -46,9 +46,77 @@ class AccessControl:
     def filter_tables(self, user: str, catalog: str, tables):
         return list(tables)
 
+    def grant(self, grantor: str, grantee: str, catalog: str, table: str,
+              privileges: set) -> None:
+        raise NotImplementedError("this access control does not support GRANT")
+
+    def revoke(self, grantor: str, grantee: str, catalog: str, table: str,
+               privileges: set) -> None:
+        raise NotImplementedError("this access control does not support REVOKE")
+
 
 class AllowAllAccessControl(AccessControl):
     pass
+
+
+class GrantBasedAccessControl(AccessControl):
+    """Privilege grants managed through SQL GRANT/REVOKE (reference:
+    execution/GrantTask + spi/security/Privilege): default-closed for
+    non-admin users; admins hold every privilege and administer grants."""
+
+    _WRITE_PRIVS = {"insert into": "insert", "delete from": "delete",
+                    "update": "update", "create table": "create",
+                    "drop table": "drop"}
+    _ALL = frozenset({"select", "insert", "delete", "update", "create", "drop"})
+
+    def __init__(self, admins=("admin",)):
+        self.admins = set(admins)
+        self.grants: dict = {}  # (catalog, table) -> {grantee: set(privs)}
+
+    def _privs(self, user: str, catalog: str, table: str) -> set:
+        return self.grants.get((catalog, table), {}).get(user, set())
+
+    def _expand(self, privileges) -> set:
+        # ALL stores EXPANDED so a later REVOKE of one privilege removes
+        # exactly that privilege (an opaque "all" marker would make
+        # REVOKE SELECT a silent no-op)
+        out = set()
+        for p in privileges:
+            out |= self._ALL if p == "all" else {p}
+        return out
+
+    def grant(self, grantor, grantee, catalog, table, privileges) -> None:
+        if grantor not in self.admins:
+            raise AccessDeniedError("Access Denied: only admins may GRANT")
+        self.grants.setdefault((catalog, table), {}) \
+            .setdefault(grantee, set()).update(self._expand(privileges))
+
+    def revoke(self, grantor, grantee, catalog, table, privileges) -> None:
+        if grantor not in self.admins:
+            raise AccessDeniedError("Access Denied: only admins may REVOKE")
+        held = self.grants.get((catalog, table), {}).get(grantee)
+        if held is not None:
+            held -= self._expand(privileges)
+
+    def check_can_select(self, user, catalog, table) -> None:
+        if user in self.admins:
+            return
+        if "select" not in self._privs(user, catalog, table):
+            raise AccessDeniedError(
+                f"Access Denied: Cannot select from {catalog}.{table}")
+
+    def check_can_write(self, user, catalog, table, operation) -> None:
+        if user in self.admins:
+            return
+        need = self._WRITE_PRIVS.get(operation, operation)
+        if need not in self._privs(user, catalog, table):
+            raise AccessDeniedError(
+                f"Access Denied: Cannot {operation} {catalog}.{table}")
+
+    def filter_tables(self, user, catalog, tables):
+        if user in self.admins:
+            return list(tables)
+        return [t for t in tables if self._privs(user, catalog, t)]
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1034,6 +1034,13 @@ class Planner:
                 if view is not None:
                     cols, sub = view
                     return self._plan_subquery_rel(sub, node.alias or name, cols)
+                mv = getattr(self.engine, "materialized_views", {}).get(name)
+                if mv is not None:
+                    # materialized views read their STORAGE table (results as
+                    # of the last refresh; reference: MV scan redirection)
+                    rel = self._plan_relation(A.TableRef(
+                        (mv["catalog"], mv["storage"]), node.alias or name))
+                    return rel
             catalog, conn = self._resolve_table(node.name)
             schema = conn.schema(name)
             dicts = conn.dictionaries(name)

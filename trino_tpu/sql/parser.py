@@ -289,6 +289,40 @@ class CreateView(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class CreateMaterializedView(Node):
+    """reference: execution/CreateMaterializedViewTask.java — the definition
+    stores alongside a storage table holding the materialized rows."""
+
+    name: str
+    query: Node
+    or_replace: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class RefreshMaterializedView(Node):
+    """reference: execution/RefreshMaterializedViewTask.java."""
+
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DropMaterializedView(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Grant(Node):
+    """GRANT/REVOKE privileges (reference: execution/GrantTask.java /
+    RevokeTask.java; spi/security/Privilege)."""
+
+    privileges: tuple  # ("select", "insert", ...) or ("all",)
+    table: str
+    grantee: str
+    revoke: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class DropView(Node):
     name: str
     if_exists: bool = False
@@ -485,6 +519,13 @@ class Parser:
             if self.accept("or"):
                 self.expect("replace")
                 or_replace = True
+            if self.peek().kind == "ident" and self.peek().value == "materialized":
+                self.next()
+                self.expect("view")
+                name = self.expect_kind("ident").value
+                self.expect("as")
+                return CreateMaterializedView(name, self.parse_subquery(),
+                                              or_replace)
             if self.accept("view"):
                 name = self.expect_kind("ident").value
                 self.expect("as")
@@ -569,6 +610,14 @@ class Parser:
             where = self.parse_expr() if self.accept("where") else None
             return Update(name, tuple(assigns), where)
         if self.accept("drop"):
+            if self.peek().kind == "ident" and self.peek().value == "materialized":
+                self.next()
+                self.expect("view")
+                ie = False
+                if self.accept("if"):
+                    self.expect("exists")
+                    ie = True
+                return DropMaterializedView(self.expect_kind("ident").value, ie)
             is_view = bool(self.accept("view"))
             if not is_view:
                 self.expect("table")
@@ -578,6 +627,35 @@ class Parser:
                 ie = True
             name = self.expect_kind("ident").value
             return (DropView(name, ie) if is_view else DropTable(name, ie))
+        if self.peek().kind == "ident" and self.peek().value == "refresh":
+            self.next()
+            self._expect_ident("materialized")
+            self.expect("view")
+            return RefreshMaterializedView(self.expect_kind("ident").value)
+        if self.peek().kind == "ident" and self.peek().value in ("grant", "revoke"):
+            revoke = self.next().value == "revoke"
+            privs = []
+            while True:
+                t = self.next()
+                if t.kind == "keyword" and t.value == "all":
+                    if self.peek().kind == "ident" \
+                            and self.peek().value == "privileges":
+                        self.next()
+                    privs.append("all")
+                else:
+                    privs.append(t.value.lower())
+                if not self.accept(","):
+                    break
+            self.expect("on")
+            if self.peek().kind == "keyword" and self.peek().value == "table":
+                self.next()
+            table = self.expect_kind("ident").value
+            if revoke:
+                self.expect("from")  # FROM is a keyword token
+            else:
+                self._expect_ident("to")
+            grantee = self.expect_kind("ident").value
+            return Grant(tuple(privs), table, grantee, revoke)
         return self.parse_subquery()
 
     def _parse_session_statement(self) -> Node:
