@@ -1,0 +1,97 @@
+"""MATCH_RECOGNIZE row-pattern matching (reference: SQL:2016 pattern
+recognition — grammar patternRecognition, sql/planner/plan/
+PatternRecognitionNode.java, operator/window/matcher/Matcher.java).
+
+Subset under test: linear patterns with ?/*/+ quantifiers (greedy with
+backtracking), DEFINE with PREV/NEXT navigation, MEASURES FIRST/LAST/var.col,
+ONE ROW PER MATCH, AFTER MATCH SKIP PAST LAST ROW."""
+
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.memory import MemoryConnector
+
+
+@pytest.fixture()
+def px_engine():
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table px (sym varchar, d bigint, price double)", s)
+    e.execute_sql("""insert into px values
+      ('a',1,10),('a',2,8),('a',3,7),('a',4,9),('a',5,12),('a',6,11),
+      ('b',1,5),('b',2,6),('b',3,4),('b',4,3),('b',5,8)""", s)
+    return e, s
+
+
+def test_v_shape_pattern(px_engine):
+    """The canonical V-shape (price falls then recovers) from the reference
+    docs (docs/src/main/sphinx/sql/match-recognize.md)."""
+    e, s = px_engine
+    rows = e.execute_sql("""
+        select * from px match_recognize (
+          partition by sym order by d
+          measures first(a.price) as start_price,
+                   last(b.price) as bottom_price,
+                   last(c.price) as end_price
+          one row per match
+          after match skip past last row
+          pattern (a b+ c+)
+          define b as price < prev(price), c as price > prev(price)
+        ) as m order by sym""", s).rows()
+    assert rows == [("a", 10.0, 7.0, 12.0), ("b", 6.0, 3.0, 8.0)]
+
+
+def test_quantifiers_and_multiple_matches(px_engine):
+    """* matches zero-or-more (greedy); non-overlapping matches advance past
+    the last matched row."""
+    e, s = px_engine
+    # every maximal strictly-decreasing run of length >= 2 (s = the row the
+    # run starts from, d+ = the strictly-lower continuation rows)
+    rows = e.execute_sql("""
+        select * from px match_recognize (
+          partition by sym order by d
+          measures first(s.price) as top, last(d.price) as low
+          pattern (s d+)
+          define d as price < prev(price)
+        ) as m order by sym, top""", s).rows()
+    assert ("a", 10.0, 7.0) in rows  # 10 > 8 > 7
+    assert ("a", 12.0, 11.0) in rows  # 12 > 11
+    assert ("b", 6.0, 3.0) in rows  # 6 > 4 > 3
+    # optional tail: c? after the run (greedy, may be absent)
+    rows = e.execute_sql("""
+        select * from px match_recognize (
+          order by sym, d
+          measures first(r.price) as p0, last(r.price) as p1
+          pattern (r r?)
+          define r as true
+        ) as m""", s).rows()
+    # pairs consumed greedily over the whole (single) partition: 11 rows -> 6
+    assert len(rows) == 6
+
+
+def test_unmatched_optional_variable_is_null(px_engine):
+    e, s = px_engine
+    rows = e.execute_sql("""
+        select * from px match_recognize (
+          partition by sym order by d
+          measures last(z.price) as spike
+          pattern (s z?)
+          define z as price > 100
+        ) as m order by sym""", s).rows()
+    # z never matches: one (s) match per row, spike NULL everywhere
+    assert len(rows) == 11 and all(r[1] is None for r in rows)
+
+
+def test_next_navigation(px_engine):
+    e, s = px_engine
+    rows = e.execute_sql("""
+        select * from px match_recognize (
+          partition by sym order by d
+          measures first(t.d) as at_day
+          pattern (t)
+          define t as price < next(price)
+        ) as m order by sym, at_day""", s).rows()
+    # rows whose NEXT price is higher (one-row matches)
+    a_days = [r[1] for r in rows if r[0] == "a"]
+    assert a_days == [3, 4]  # 7<9, 9<12

@@ -217,6 +217,11 @@ class LocalExecutor:
             page, odicts = _run_unnest(node, child, dicts)
             self._record(node, page, t0)
             return page, odicts
+        if isinstance(node, P.MatchRecognize):
+            child, dicts = self._execute_to_page(node.child)
+            page, odicts = _run_match_recognize(node, child, dicts)
+            self._record(node, page, t0)
+            return page, odicts
         if isinstance(node, P.Aggregate):
             page, dicts = self._run_aggregate(node)
             self._record(node, page, t0)
@@ -316,7 +321,7 @@ class LocalExecutor:
                            lambda: iter([page]), lambda c, n, v, aux: (c, n, v))
 
         if isinstance(node, (P.Aggregate, P.Sort, P.Limit, P.Output, P.Window,
-                             P.Unnest)):
+                             P.Unnest, P.MatchRecognize)):
             # blocking sub-plan feeding a streaming consumer: run it, emit its one
             # page.  The first execution (needed for dictionary metadata) is reused
             # once; later executions re-run the child so volatile sources (system
@@ -1412,6 +1417,164 @@ def _gather_build(table: JoinTable, row_ids, matched, kind):
         base = jnp.zeros_like(matched) if nmask is None else nmask[safe]
         nulls.append((base | ~matched) if kind == "left" else (None if nmask is None else base))
     return tuple(cols), tuple(nulls)
+
+
+def _run_match_recognize(node: P.MatchRecognize, child: Page, cdicts):
+    """Row-pattern matching over sorted partitions (reference:
+    operator/window/matcher/ — the compiled NFA programs of
+    IrRowPatternToProgramRewriter + Matcher.java; this subset runs a
+    backtracking matcher over per-row DEFINE condition vectors).
+
+    Device side: sorting and DEFINE predicate evaluation (one boolean vector
+    per pattern variable, navigation channels as shifted columns).  Host side:
+    the sequential match assembly — non-overlapping greedy matches with
+    skip-past-last-row are inherently order-dependent."""
+    keys = tuple(P.SortKey(ch, True, False) for ch in node.partition) \
+        + tuple(node.order)
+    sorted_page = _sort_page(child, keys, cdicts)
+    valid, cols, nulls = _host_page(sorted_page)
+    cols = [c[valid] for c in cols]
+    nulls = [None if nm is None else nm[valid] for nm in nulls]
+    n = len(cols[0]) if cols else 0
+
+    # partition boundaries over the sorted rows.  NULL keys group together
+    # (one partition), so the raw-value comparison only applies where BOTH
+    # rows are non-null — null lanes hold arbitrary fill values
+    new_part = np.zeros(n, bool)
+    if n:
+        new_part[0] = True
+        for ch in node.partition:
+            c = cols[ch]
+            diff = c[1:] != c[:-1]
+            nm = nulls[ch]
+            if nm is not None:
+                diff = (diff & ~(nm[1:] | nm[:-1])) | (nm[1:] != nm[:-1])
+            new_part[1:] |= diff
+
+    # navigation channels: shifted within the partition, NULL across edges
+    ext_cols = list(cols)
+    ext_nulls = list(nulls)
+    part_id = np.cumsum(new_part)
+    for ch, off in node.nav:
+        src_idx = np.arange(n) + off  # off<0 = PREV, >0 = NEXT
+        ok = (src_idx >= 0) & (src_idx < n)
+        safe = np.clip(src_idx, 0, max(n - 1, 0))
+        if n:
+            ok &= part_id[safe] == part_id
+        shifted = cols[ch][safe] if n else cols[ch]
+        nm = nulls[ch]
+        base_null = np.zeros(n, bool) if nm is None else nm[safe]
+        ext_cols.append(shifted)
+        ext_nulls.append(base_null | ~ok)
+
+    # one boolean vector per variable (undefined variables match any row);
+    # device inputs convert once, not per variable
+    conds = {}
+    defined = dict(node.defines)
+    jc = [jnp.asarray(c) for c in ext_cols]
+    jn = [None if m is None else jnp.asarray(m) for m in ext_nulls]
+    for var, _ in node.pattern:
+        e = defined.get(var)
+        if e is None:
+            conds[var] = np.ones(n, bool)
+        else:
+            v, nu = evaluate(e, jc, jn)
+            arr = np.asarray(jnp.broadcast_to(v, (n,)))
+            if nu is not None:
+                arr = arr & ~np.asarray(jnp.broadcast_to(nu, (n,)))
+            conds[var] = arr.astype(bool)
+
+    def find_match(start, end):
+        """Greedy with backtracking (regex semantics); returns
+        (stop, [(row, var), ...]) or None."""
+        pat = node.pattern
+
+        def rec(i, pi):
+            if pi == len(pat):
+                return i, []
+            var, q = pat[pi]
+            ok = conds[var]
+            if q is None:
+                if i < end and ok[i]:
+                    r = rec(i + 1, pi + 1)
+                    if r is not None:
+                        return r[0], [(i, var)] + r[1]
+                return None
+            if q == "?":
+                if i < end and ok[i]:
+                    r = rec(i + 1, pi + 1)
+                    if r is not None:
+                        return r[0], [(i, var)] + r[1]
+                return rec(i, pi + 1)
+            j = i
+            while j < end and ok[j]:
+                j += 1
+            lo = i + (1 if q == "+" else 0)
+            while j >= lo:
+                r = rec(j, pi + 1)
+                if r is not None:
+                    return r[0], [(k, var) for k in range(i, j)] + r[1]
+                j -= 1
+            return None
+
+        return rec(start, 0)
+
+    # non-overlapping matches, AFTER MATCH SKIP PAST LAST ROW
+    starts = list(np.nonzero(new_part)[0]) + [n]
+    out_rows: list = []
+    for pi in range(len(starts) - 1):
+        s, e = int(starts[pi]), int(starts[pi + 1])
+        i = s
+        while i < e:
+            m = find_match(i, e)
+            if m is None or m[0] == i:  # no match / empty match: advance
+                i += 1
+                continue
+            stop, assign = m
+            by_var: dict = {}
+            for row, var in assign:
+                by_var.setdefault(var, []).append(row)
+            vals = []
+            for kind, var, ch, _ in node.measures:
+                if kind == "col":
+                    row = stop - 1
+                elif var is not None:
+                    rows_v = by_var.get(var)
+                    if not rows_v:
+                        vals.append(None)
+                        continue
+                    row = rows_v[0] if kind == "first" else rows_v[-1]
+                else:
+                    row = i if kind == "first" else stop - 1
+                nm = nulls[ch]
+                vals.append(None if (nm is not None and nm[row])
+                            else cols[ch][row])
+            pvals = tuple(
+                None if (nulls[ch] is not None and nulls[ch][i])
+                else cols[ch][i] for ch in node.partition)
+            out_rows.append(pvals + tuple(vals))
+            i = stop
+
+    # assemble the output page
+    n_out = len(out_rows)
+    out_cols, out_nulls = [], []
+    for j, f in enumerate(node.schema.fields):
+        dt = np.dtype(f.type.dtype)
+        arr = np.zeros(n_out, dt)
+        nm = np.zeros(n_out, bool)
+        for r, row in enumerate(out_rows):
+            if row[j] is None:
+                nm[r] = True
+            else:
+                arr[r] = row[j]
+        out_cols.append(jnp.asarray(arr))
+        out_nulls.append(jnp.asarray(nm) if nm.any() else None)
+    dicts = tuple(cdicts[ch] if cdicts and ch < len(cdicts) else None
+                  for ch in node.partition) \
+        + tuple(cdicts[ch] if cdicts and ch < len(cdicts) else None
+                for _, _, ch, _ in node.measures)
+    page = Page(node.schema, tuple(out_cols), tuple(out_nulls), None)
+    return page, dicts
 
 
 def _run_unnest(node: P.Unnest, child: Page, cdicts):

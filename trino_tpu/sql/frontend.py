@@ -1058,7 +1058,151 @@ class Planner:
             return RelPlan(scan, cols, unique_sets)
         if isinstance(node, A.SubqueryRef):
             return self._plan_subquery_rel(node.query, node.alias, node.columns)
+        if isinstance(node, A.MatchRecognizeRef):
+            return self._plan_match_recognize(node)
         raise SemanticError(f"unsupported relation {node}")
+
+    def _plan_match_recognize(self, node: A.MatchRecognizeRef) -> RelPlan:
+        """reference: StatementAnalyzer's pattern-recognition analysis +
+        PatternRecognitionNode planning; see plan.MatchRecognize for the
+        supported subset."""
+        rel = self._plan_relation(node.input)
+        var_names = {v for v, _ in node.pattern}
+        for v, _ in node.defines:
+            if v not in var_names:
+                raise SemanticError(f"DEFINE variable {v} not in PATTERN")
+
+        def rewrite_tree(ast, fn):
+            """Apply fn top-down over every Node, recursing through nested
+            tuples too (CaseExpr.whens holds (cond, value) PAIRS)."""
+            def walk(v):
+                if isinstance(v, A.Node):
+                    out = fn(v)
+                    if out is not v:
+                        return out
+                    changed = {}
+                    for f in v.__dataclass_fields__:
+                        fv = getattr(v, f)
+                        nv = walk(fv)
+                        if nv is not fv:
+                            changed[f] = nv
+                    return dataclasses.replace(v, **changed) if changed else v
+                if isinstance(v, tuple):
+                    items = tuple(walk(x) for x in v)
+                    return items if any(a is not b for a, b in zip(items, v)) \
+                        else v
+                return v
+
+            return walk(ast)
+
+        def strip_vars(ast):
+            """b.price -> price (variable-qualified refs read the current row)."""
+            def fn(n):
+                if isinstance(n, A.Identifier) and len(n.parts) == 2 \
+                        and n.parts[0] in var_names:
+                    return A.Identifier((n.parts[1],))
+                return n
+
+            return rewrite_tree(ast, fn)
+
+        # PREV/NEXT navigation -> synthetic shifted channels appended to the
+        # sorted input (the reference evaluates navigation against the
+        # partition's row frame; shifting the sorted columns is the columnar
+        # equivalent)
+        nav: list = []
+        nav_cols: list = []
+
+        def extract_nav(ast):
+            def fn(node_ast):
+                if isinstance(node_ast, A.FuncCall) \
+                        and node_ast.name in ("prev", "next"):
+                    inner = strip_vars(node_ast.args[0])
+                    if not isinstance(inner, A.Identifier):
+                        raise SemanticError("PREV/NEXT take a plain column")
+                    ch = _resolve_column(inner, rel.cols)
+                    n = 1
+                    if len(node_ast.args) > 1:
+                        if not isinstance(node_ast.args[1], A.NumberLit):
+                            raise SemanticError(
+                                "PREV/NEXT offset must be a literal")
+                        n = int(node_ast.args[1].text)
+                    off = -n if node_ast.name == "prev" else n
+                    key = (ch, off)
+                    if key not in nav:
+                        nav.append(key)
+                        c = rel.cols[ch]
+                        nav_cols.append(ColumnInfo(None, f"#nav{len(nav)}",
+                                                   c.type, c.dict))
+                    return A.Identifier((f"#nav{nav.index(key) + 1}",))
+                return node_ast
+
+            return rewrite_tree(ast, fn)
+
+        define_asts = [(v, extract_nav(strip_vars(e))) for v, e in node.defines]
+        ext_cols = list(rel.cols) + nav_cols
+        defines = []
+        for v, e_ast in define_asts:
+            e, _ = self.translate(e_ast, ext_cols)
+            defines.append((v, e))
+
+        # v1 subset: partition keys are plain columns — a computed key would
+        # append a projection channel AFTER the nav channels were numbered,
+        # desynchronizing the DEFINE translation from the executor's layout
+        pchs = []
+        pnode = rel.node
+        for e_ast in node.partition_by:
+            e, _ = self.translate(e_ast, rel.cols)
+            if not isinstance(e, ir.FieldRef):
+                raise SemanticError(
+                    "MATCH_RECOGNIZE PARTITION BY must be plain columns")
+            pchs.append(e.index)
+        order = []
+        for s in node.order_by:
+            e, _ = self.translate(strip_vars(s.expr), rel.cols)
+            if not isinstance(e, ir.FieldRef):
+                raise SemanticError("MATCH_RECOGNIZE ORDER BY must be columns")
+            order.append(P.SortKey(e.index, s.ascending,
+                                   bool(s.nulls_first)))
+
+        measures = []
+        out_infos = []
+        for m_ast, m_name in node.measures:
+            kind, var, ch = self._measure_spec(m_ast, var_names, rel.cols)
+            c = rel.cols[ch]
+            measures.append((kind, var, ch, m_name))
+            out_infos.append(ColumnInfo(node.alias, m_name, c.type, c.dict))
+
+        part_fields = [Field(rel.cols[ch].name or f"p{i}", rel.cols[ch].type)
+                       for i, ch in enumerate(pchs)]
+        schema = Schema(tuple(part_fields)
+                        + tuple(Field(n, rel.cols[ch].type)
+                                for _, _, ch, n in measures))
+        mr = P.MatchRecognize(pnode, tuple(pchs), tuple(order), node.pattern,
+                              tuple(defines), tuple(nav), tuple(measures),
+                              schema)
+        cols = [ColumnInfo(node.alias, rel.cols[ch].name, rel.cols[ch].type,
+                           rel.cols[ch].dict) for ch in pchs] + out_infos
+        return RelPlan(mr, cols, [])
+
+    def _measure_spec(self, ast, var_names, cols):
+        """FIRST(v.col) | LAST(v.col) | v.col | col -> (kind, var, channel)."""
+        if isinstance(ast, A.FuncCall) and ast.name in ("first", "last") \
+                and len(ast.args) == 1:
+            inner = ast.args[0]
+            if isinstance(inner, A.Identifier) and len(inner.parts) == 2 \
+                    and inner.parts[0] in var_names:
+                ch = _resolve_column(A.Identifier((inner.parts[1],)), cols)
+                return ast.name, inner.parts[0], ch
+            if isinstance(inner, A.Identifier):
+                ch = _resolve_column(inner, cols)
+                return ast.name, None, ch
+        if isinstance(ast, A.Identifier):
+            if len(ast.parts) == 2 and ast.parts[0] in var_names:
+                ch = _resolve_column(A.Identifier((ast.parts[1],)), cols)
+                return "last", ast.parts[0], ch
+            return "col", None, _resolve_column(ast, cols)
+        raise SemanticError(
+            "MEASURES supports FIRST/LAST(var.col), var.col, or plain columns")
 
     def _plan_subquery_rel(self, sub: A.Select, alias, columns=()) -> RelPlan:
         saved = self.ctes

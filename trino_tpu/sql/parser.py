@@ -216,6 +216,26 @@ class UnnestRef(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class MatchRecognizeRef(Node):
+    """t MATCH_RECOGNIZE (PARTITION BY ... ORDER BY ... MEASURES ...
+    PATTERN (...) DEFINE ...) — reference: grammar patternRecognition ->
+    sql/planner/plan/PatternRecognitionNode.java + operator/window/matcher/.
+
+    Subset: linear patterns of variables with ?/*/+ quantifiers, per-row
+    DEFINE conditions with PREV/NEXT column navigation, MEASURES of
+    FIRST/LAST(var.col) / var.col / bare columns, ONE ROW PER MATCH,
+    AFTER MATCH SKIP PAST LAST ROW."""
+
+    input: Node
+    partition_by: tuple
+    order_by: tuple  # SortItem...
+    measures: tuple  # ((expr, name), ...)
+    pattern: tuple  # ((var, quantifier|None), ...)
+    defines: tuple  # ((var, expr), ...)
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class JoinRef(Node):
     kind: str  # inner | left | right | full | cross
     left: Node
@@ -889,7 +909,73 @@ class Parser:
         name = [self.expect_kind("ident").value]
         while self.accept("."):
             name.append(self.expect_kind("ident").value)
+        base = TableRef(tuple(name), None)
+        if self.peek().kind == "ident" and self.peek().value == "match_recognize":
+            return self._parse_match_recognize(base)
         return TableRef(tuple(name), self._table_alias())
+
+    def _parse_match_recognize(self, base) -> "MatchRecognizeRef":
+        self.next()  # match_recognize
+        self.expect("(")
+        partition = []
+        if self.accept("partition"):
+            self.expect("by")
+            partition = [self.parse_expr()]
+            while self.accept(","):
+                partition.append(self.parse_expr())
+        order = []
+        if self.accept("order"):
+            self.expect("by")
+            order = [self.parse_sort_item()]
+            while self.accept(","):
+                order.append(self.parse_sort_item())
+        measures = []
+        if self.peek().value == "measures":
+            self.next()
+            while True:
+                e = self.parse_expr()
+                self.expect("as")
+                measures.append((e, self.expect_kind("ident").value))
+                if not self.accept(","):
+                    break
+        if self.peek().value == "one":  # ONE ROW PER MATCH (the default)
+            self.next()
+            self._expect_ident("row")
+            self._expect_ident("per")
+            self._expect_ident("match")
+        if self.peek().value == "after":  # AFTER MATCH SKIP PAST LAST ROW only
+            self.next()
+            self._expect_ident("match")
+            self._expect_ident("skip")
+            self._expect_ident("past")
+            self.expect("last")
+            self._expect_ident("row")
+        if self.peek().value != "pattern":
+            raise ParseError(f"expected PATTERN at pos {self.peek().pos}")
+        self.next()
+        self.expect("(")
+        pattern = []
+        while not (self.peek().kind == "op" and self.peek().value == ")"):
+            var = self.expect_kind("ident").value
+            quant = None
+            t = self.peek()
+            if t.kind == "op" and t.value in ("*", "+", "?"):
+                quant = self.next().value
+            pattern.append((var, quant))
+        self.expect(")")
+        defines = []
+        if self.peek().value == "define":
+            self.next()
+            while True:
+                var = self.expect_kind("ident").value
+                self.expect("as")
+                defines.append((var, self.parse_expr()))
+                if not self.accept(","):
+                    break
+        self.expect(")")
+        return MatchRecognizeRef(base, tuple(partition), tuple(order),
+                                 tuple(measures), tuple(pattern),
+                                 tuple(defines), self._table_alias())
 
     def _table_alias(self) -> Optional[str]:
         if self.accept("as"):
@@ -1125,9 +1211,11 @@ class Parser:
             e = self.parse_expr()
             self.expect(")")
             return e
-        if t.kind == "keyword" and t.value in ("replace", "if", "left", "right") \
+        if t.kind == "keyword" \
+                and t.value in ("replace", "if", "left", "right", "first", "last") \
                 and self.peek(1).kind == "op" and self.peek(1).value == "(":
             # keywords that are also builtin function names in call position
+            # (FIRST/LAST are MATCH_RECOGNIZE navigation functions)
             t = Token("ident", t.value, t.pos)
             self.tokens[self.i] = t
         if t.kind == "ident" and t.value == "array" \

@@ -195,6 +195,31 @@ class Window(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class MatchRecognize(PlanNode):
+    """reference: sql/planner/plan/PatternRecognitionNode.java + the matcher
+    programs of operator/window/matcher/ (compiled NFA over sorted partitions).
+
+    Subset semantics: linear PATTERN of variables with ?/*/+ quantifiers
+    (greedy, with backtracking), per-row DEFINE conditions evaluated over the
+    sorted input extended with PREV/NEXT-shifted navigation channels, ONE ROW
+    PER MATCH output (partition keys + measures), AFTER MATCH SKIP PAST LAST
+    ROW; empty matches are skipped."""
+
+    child: PlanNode
+    partition: tuple  # child channel indices
+    order: tuple  # SortKey over child channels
+    pattern: tuple  # ((var, quantifier|None), ...)
+    defines: tuple  # ((var, ir.Expr over extended channels), ...)
+    nav: tuple  # ((base_channel, offset), ...) appended shifted channels
+    measures: tuple  # ((kind 'first'|'last'|'col', var|None, channel, name), ...)
+    schema: Schema  # partition fields + measure fields
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
 class Unnest(PlanNode):
     """reference: sql/planner/plan/UnnestNode.java / operator/unnest/UnnestOperator.java.
 
