@@ -27,7 +27,7 @@ def host(eng):
     conn = e.catalogs["tpcds"]
     out = {}
     for t in ("store_sales", "date_dim", "item", "promotion",
-              "customer_demographics"):
+              "customer_demographics", "customer", "customer_address"):
         schema = conn.schema(t)
         dicts = conn.dictionaries(t)
         cols = {}
@@ -180,3 +180,107 @@ def test_split_pruning_on_date_dim(eng):
     r = e.execute_sql(
         "select count(*) from date_dim where d_date_sk < 2450100", s).rows()
     assert r[0][0] == 100
+
+
+def test_q89_monthly_category_window(eng, host):
+    """Q89 shape: per (category, brand, month) sales vs the category's average
+    monthly sales via a window AVG — exercises windows over the DS star
+    (reference: tpcds q89)."""
+    e, s = eng
+    got = e.execute_sql("""
+        select i_category, i_brand, d_moy, sum_sales, avg_monthly_sales
+        from (
+          select i_category, i_brand, d_moy,
+                 sum(ss_sales_price) as sum_sales,
+                 -- cast: decimal avg rounds half-up to the input scale
+                 -- (Trino semantics); the float oracle needs double math
+                 avg(cast(sum(ss_sales_price) as double))
+                     over (partition by i_category, i_brand)
+                     as avg_monthly_sales
+          from store_sales, item, date_dim
+          where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+            and d_year = 2000 and i_category = 'Books'
+          group by i_category, i_brand, d_moy
+        ) x
+        where avg_monthly_sales > 0
+          and abs(sum_sales - avg_monthly_sales) / avg_monthly_sales > 0.1
+        order by i_brand, d_moy limit 50""", s).to_pandas()
+    ss, it, dd = host["store_sales"], host["item"], host["date_dim"]
+    j = ss.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    j = j.merge(dd[dd.d_year == 2000], left_on="ss_sold_date_sk",
+                right_on="d_date_sk")
+    j = j[j.i_category == "Books"]
+    g = (j.groupby(["i_category", "i_brand", "d_moy"])
+         .ss_sales_price.sum().div(100).reset_index(name="sum_sales"))
+    g["avg_monthly_sales"] = g.groupby(["i_category", "i_brand"])[
+        "sum_sales"].transform("mean")
+    g = g[(g.avg_monthly_sales > 0)
+          & ((g.sum_sales - g.avg_monthly_sales).abs()
+             / g.avg_monthly_sales > 0.1)]
+    exp = g.sort_values(["i_brand", "d_moy"]).head(50).reset_index(drop=True)
+    assert len(got) == len(exp)
+    np.testing.assert_allclose(got["sum_sales"].to_numpy(),
+                               exp["sum_sales"].to_numpy(), rtol=1e-9)
+    np.testing.assert_allclose(got["avg_monthly_sales"].to_numpy(),
+                               exp["avg_monthly_sales"].to_numpy(), rtol=1e-9)
+
+
+def test_q98_class_revenue_ratio(eng, host):
+    """Q98 shape: per-item revenue share of its class via a window SUM
+    (reference: tpcds q98)."""
+    e, s = eng
+    got = e.execute_sql("""
+        select i_item_id, i_class, revenue,
+               revenue * 100.0 / sum(revenue) over (partition by i_class)
+                   as revenueratio
+        from (
+          select i_item_id, i_class, sum(ss_ext_sales_price) as revenue
+          from store_sales, item, date_dim
+          where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+            and i_category = 'Music' and d_year = 2001 and d_moy = 5
+          group by i_item_id, i_class
+        ) x order by i_class, i_item_id""", s).to_pandas()
+    ss, it, dd = host["store_sales"], host["item"], host["date_dim"]
+    j = ss.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    j = j.merge(dd[(dd.d_year == 2001) & (dd.d_moy == 5)],
+                left_on="ss_sold_date_sk", right_on="d_date_sk")
+    j = j[j.i_category == "Music"]
+    g = (j.groupby(["i_item_id", "i_class"]).ss_ext_sales_price.sum().div(100)
+         .reset_index(name="revenue"))
+    g["revenueratio"] = (g.revenue * 100.0
+                         / g.groupby("i_class").revenue.transform("sum"))
+    g = g.sort_values(["i_class", "i_item_id"]).reset_index(drop=True)
+    assert len(got) == len(g)
+    np.testing.assert_allclose(got["revenue"].to_numpy(),
+                               g["revenue"].to_numpy(), rtol=1e-9)
+    np.testing.assert_allclose(got["revenueratio"].to_numpy(),
+                               g["revenueratio"].to_numpy(), rtol=1e-9)
+
+
+def test_q6_state_price_comparison(eng, host):
+    """Q6 shape: customers' states whose purchased items cost >= 1.2x the
+    category average — correlated scalar-aggregate subquery over the star
+    (reference: tpcds q6)."""
+    e, s = eng
+    got = e.execute_sql("""
+        select ca_state, count(*) cnt
+        from customer_address, customer, store_sales, item
+        where ca_address_sk = c_current_addr_sk
+          and c_customer_sk = ss_customer_sk
+          and ss_item_sk = i_item_sk
+          and i_current_price / 1.2 > (
+              select avg(j.i_current_price) from item j
+              where j.i_category = item.i_category)
+        group by ca_state having count(*) >= 10
+        order by cnt, ca_state limit 10""", s).to_pandas()
+    ss, it = host["store_sales"], host["item"]
+    ca, cu = host["customer_address"], host["customer"]
+    cat_avg = it.groupby("i_category").i_current_price.mean()
+    it2 = it[it.i_current_price > 1.2 * it.i_category.map(cat_avg)]
+    j = ss.merge(it2, left_on="ss_item_sk", right_on="i_item_sk")
+    j = j.merge(cu, left_on="ss_customer_sk", right_on="c_customer_sk")
+    j = j.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+    g = j.groupby("ca_state").size().reset_index(name="cnt")
+    g = g[g.cnt >= 10].sort_values(["cnt", "ca_state"]).head(10)
+    assert got["cnt"].tolist() == g["cnt"].tolist()
+    assert got["ca_state"].tolist() == g["ca_state"].tolist()

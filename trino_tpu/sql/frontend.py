@@ -127,6 +127,21 @@ class Planner:
     def _plan_select(self, q):
         if isinstance(q, A.SetOp):
             return self._plan_setop(q)
+        # windows over aggregation output rewrite BEFORE any planning (the
+        # FROM tree would otherwise plan twice); stars never combine with
+        # GROUP BY so the AST-only detection is complete
+        if q.items and not any(isinstance(it.expr, A.Star) for it in q.items):
+            aggs0, wins0 = [], []
+            for it in q.items:
+                _collect_aggs(it.expr, aggs0)
+                _collect_windows(it.expr, wins0)
+            for s in q.order_by:
+                _collect_aggs(s.expr, aggs0)
+            if q.having is not None:
+                _collect_aggs(q.having, aggs0)
+            if wins0 and (q.group_by or aggs0):
+                return self._plan_select(
+                    self._rewrite_windowed_aggregation(q, list(q.items)))
         self._last_projection = None
         rel = self._plan_from(q)
         # expand stars
@@ -156,8 +171,11 @@ class Planner:
 
         if has_group or agg_calls:
             if win_calls:
+                # star-expanded windowed aggregation: unreachable (stars are
+                # invalid with GROUP BY; the AST rewrite above caught the rest)
                 raise SemanticError(
-                    "window functions over aggregated queries not supported yet")
+                    "window functions over aggregated queries require "
+                    "explicit select items")
             rel, out_names, out_exprs_ast = self._plan_aggregation(q, rel, items, agg_calls)
         else:
             if win_calls:
@@ -182,6 +200,83 @@ class Planner:
                           [frozenset(range(n))])
             self._last_projection = None  # DISTINCT output: no hidden ORDER BY columns
         return rel, out_names, out_exprs_ast
+
+    def _rewrite_windowed_aggregation(self, q: A.Select, items) -> A.Select:
+        """``win(agg(x)) OVER (...)`` with GROUP BY -> nested query: the inner
+        SELECT materializes group keys and every aggregate call, the outer
+        runs the windows over those plain columns (semantically identical;
+        reference: the window stage sits ABOVE the aggregation in
+        LogicalPlanner's operator order)."""
+        def resolve_group(g):
+            """GROUP BY ordinals and select-list aliases resolve to the
+            referenced expressions (the aggregation path does this through
+            _resolve_group_ast; the rewrite needs it pre-planning)."""
+            if isinstance(g, A.NumberLit):
+                i = int(g.text)
+                if not (1 <= i <= len(items)):
+                    raise SemanticError(f"GROUP BY position {i} out of range")
+                return items[i - 1].expr
+            if isinstance(g, A.Identifier) and len(g.parts) == 1:
+                for it in items:
+                    if it.alias == g.parts[0]:
+                        return it.expr
+            return g
+
+        group_exprs = tuple(resolve_group(g) for g in q.group_by)
+        agg_calls: list = []
+        for it in items:
+            _collect_aggs(it.expr, agg_calls)
+        for s in q.order_by:
+            _collect_aggs(s.expr, agg_calls)
+        if q.having is not None:
+            _collect_aggs(q.having, agg_calls)
+        # _collect_aggs stops at WindowCall boundaries (sum() OVER is a window,
+        # not an agg) — the aggregates INSIDE window args/partition/order are
+        # exactly what this rewrite materializes, so collect them explicitly
+        win_calls: list = []
+        for it in items:
+            _collect_windows(it.expr, win_calls)
+        for s in q.order_by:
+            _collect_windows(s.expr, win_calls)
+        for w in win_calls:
+            for a in w.func.args:
+                _collect_aggs(a, agg_calls)
+            for p in w.partition_by:
+                _collect_aggs(p, agg_calls)
+            for s in w.order_by:
+                _collect_aggs(s.expr, agg_calls)
+        uniq_aggs: list = []
+        for a in agg_calls:
+            if a not in uniq_aggs:
+                uniq_aggs.append(a)
+
+        inner_items = []
+        mapping: dict = {}  # old AST -> replacement Identifier
+        used: set = set()
+        for i, g in enumerate(group_exprs):
+            name = g.parts[-1] if isinstance(g, A.Identifier) else f"#g{i}"
+            if name in used:  # a.k and b.k must not collide in the inner scope
+                name = f"#g{i}"
+            used.add(name)
+            inner_items.append(A.SelectItem(g, name))
+            mapping[g] = A.Identifier((name,))
+        for j, a in enumerate(uniq_aggs):
+            inner_items.append(A.SelectItem(a, f"#a{j}"))
+            mapping[a] = A.Identifier((f"#a{j}",))
+
+        inner = A.Select(tuple(inner_items), q.from_, q.where,
+                         tuple(group_exprs), q.having, (), None,
+                         False, q.ctes)
+        out_items = tuple(
+            A.SelectItem(_replace_nodes(it.expr, mapping),
+                         it.alias or _derive_name(it.expr, i))
+            for i, it in enumerate(items))
+        order = tuple(
+            A.SortItem(_replace_nodes(resolve_group(s.expr), mapping),
+                       s.ascending, s.nulls_first)
+            for s in q.order_by)
+        return A.Select(out_items, A.SubqueryRef(inner, "#aggwin"), None, (),
+                        None, order, q.limit, q.distinct, ())
 
     # ---------------------------------------------------------------- set operations
     def _plan_setop(self, q: A.SetOp):
@@ -2252,22 +2347,22 @@ def _collect_windows(ast, out: list):
 
 
 def _replace_nodes(ast, mapping: dict):
-    """Structurally rebuild an AST with ``mapping`` substitutions (frozen dataclasses)."""
-    if ast in mapping:
-        return mapping[ast]
+    """Structurally rebuild an AST with ``mapping`` substitutions (frozen
+    dataclasses).  Recurses through NESTED tuples too — CaseExpr.whens holds
+    (cond, value) pairs, so a substitution target can sit two tuples deep."""
+    if isinstance(ast, tuple):
+        nv = tuple(_replace_nodes(x, mapping) for x in ast)
+        return ast if nv == ast else nv
     if not dataclasses.is_dataclass(ast):
         return ast
+    if ast in mapping:
+        return mapping[ast]
     changes = {}
     for f in dataclasses.fields(ast):
         v = getattr(ast, f.name)
-        if isinstance(v, A.Node):
+        if isinstance(v, (A.Node, tuple)):
             nv = _replace_nodes(v, mapping)
-            if nv is not v:
-                changes[f.name] = nv
-        elif isinstance(v, tuple):
-            nv = tuple(_replace_nodes(x, mapping) if isinstance(x, A.Node) else x
-                       for x in v)
-            if nv != v:
+            if nv is not v and nv != v:
                 changes[f.name] = nv
     return dataclasses.replace(ast, **changes) if changes else ast
 
