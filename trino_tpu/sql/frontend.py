@@ -1989,13 +1989,6 @@ class Planner:
             out = ir.Call("if", (c, _coerce(v, t), out), t)
         return out, None
 
-    _STRING_MAP_FUNCS = {
-        "upper": str.upper, "lower": str.lower, "trim": str.strip,
-        "ltrim": str.lstrip, "rtrim": str.rstrip,
-        "reverse": lambda s: s[::-1],
-    }
-    _MATH_DOUBLE_FUNCS = ("sqrt", "exp", "ln", "log10", "log2", "sin", "cos", "tan",
-                          "asin", "acos", "atan", "cbrt", "degrees", "radians")
 
     _COLLECTION_FUNCS = ("cardinality", "element_at", "contains", "sequence",
                          "map", "map_keys", "map_values", "row")
@@ -2026,8 +2019,8 @@ class Planner:
                 raise SemanticError("round() scale must be a literal")
             n = int(ast.args[1].text)
             return ir.Call("round_n", (_coerce(v, DOUBLE),), DOUBLE, meta=(n,)), None
-        if name in ("abs", "sqrt", "floor", "ceil", "ceiling", "exp", "ln", "round",
-                    "sign", "trunc") and name not in self._STRING_MAP_FUNCS:
+        if name in ("abs", "floor", "ceil", "ceiling", "round",
+                    "sign", "trunc"):
             args = [self._translate(a, cols)[0] for a in ast.args]
             op = "ceil" if name == "ceiling" else name
             t = args[0].type if name in ("abs", "round", "sign", "trunc") else DOUBLE
@@ -2039,17 +2032,7 @@ class Planner:
                 # raw scaled ints would round/truncate in raw units; compute in double
                 # (documented deviation, like decimal division)
                 return ir.Call(op, (_coerce(args[0], DOUBLE),), DOUBLE), None
-            if name == "sqrt" or (name in ("exp", "ln")):
-                return ir.Call(op, (_coerce(args[0], DOUBLE),), DOUBLE), None
             return ir.Call(op, tuple(args), t), None
-        if name in self._MATH_DOUBLE_FUNCS:
-            v, _ = self._translate(ast.args[0], cols)
-            return ir.Call(name, (_coerce(v, DOUBLE),), DOUBLE), None
-        if name in ("power", "pow"):
-            a, _ = self._translate(ast.args[0], cols)
-            b, _ = self._translate(ast.args[1], cols)
-            return ir.Call("power", (_coerce(a, DOUBLE), _coerce(b, DOUBLE)),
-                           DOUBLE), None
         if name == "atan2":
             a, _ = self._translate(ast.args[0], cols)
             b, _ = self._translate(ast.args[1], cols)
@@ -2100,14 +2083,6 @@ class Planner:
 
             return ir.Constant((datetime.date.today()
                                 - datetime.date(1970, 1, 1)).days, DATE), None
-        if name in self._STRING_MAP_FUNCS:
-            v, d = self._require_dict(ast.args[0], cols, name)
-            lut, nd = d.map_values(self._STRING_MAP_FUNCS[name])
-            return ir.Call("lut", (v, ir.Constant(lut, v.type)), v.type), nd
-        if name == "length":
-            v, d = self._require_dict(ast.args[0], cols, name)
-            table = np.array([len(str(s)) for s in d.values], np.int64)
-            return ir.Call("lut", (v, ir.Constant(table, BIGINT)), BIGINT), None
         if name == "regexp_like":
             # dictionary-domain regex (reference: operator/scalar/JoniRegexpFunctions;
             # strings are dict ids, so the pattern evaluates once per distinct value)

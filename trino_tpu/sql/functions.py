@@ -25,7 +25,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from ..types import BIGINT, BOOLEAN, Type, VarcharType
+from ..types import BIGINT, BOOLEAN, DOUBLE, Type, VarcharType
 from . import ir
 from . import parser as A
 
@@ -203,6 +203,67 @@ def _register_json():
 _register_json()
 
 
+# ---------------------------------------------------------------------------- scalar families
+# Migrated out of the planner's legacy if-chain: table-driven families whose
+# translation is mechanical (reference: the annotation-driven registration of
+# operator/scalar/MathFunctions.java + the dictionary-domain string functions).
+
+_MATH_DOUBLE = ("sqrt", "exp", "ln", "log10", "log2", "sin", "cos", "tan",
+                "asin", "acos", "atan", "cbrt", "degrees", "radians")
+
+_STRING_MAP = {
+    "upper": str.upper, "lower": str.lower, "trim": str.strip,
+    "ltrim": str.lstrip, "rtrim": str.rstrip,
+    "reverse": lambda s: s[::-1],
+}
+
+
+def _build_math_double(planner, ast, cols):
+    from .frontend import _coerce  # lazy: breaks the frontend import cycle
+
+    v, _ = planner._translate(ast.args[0], cols)
+    return ir.Call(ast.name, (_coerce(v, DOUBLE),), DOUBLE), None
+
+
+def _build_power(planner, ast, cols):
+    from .frontend import _coerce  # lazy: breaks the frontend import cycle
+
+    a, _ = planner._translate(ast.args[0], cols)
+    b, _ = planner._translate(ast.args[1], cols)
+    return ir.Call("power", (_coerce(a, DOUBLE), _coerce(b, DOUBLE)), DOUBLE), None
+
+
+def _build_string_map(planner, ast, cols):
+    """Dictionary-domain string function: the python transform runs once per
+    distinct value at plan time; the device gathers through an id->id LUT."""
+    v, d = planner._require_dict(ast.args[0], cols, ast.name)
+    lut, nd = d.map_values(_STRING_MAP[ast.name])
+    return ir.Call("lut", (v, ir.Constant(lut, v.type)), v.type), nd
+
+
+def _build_length(planner, ast, cols):
+    v, d = planner._require_dict(ast.args[0], cols, ast.name)
+    table = np.array([len(str(s)) for s in d.values], np.int64)
+    return ir.Call("lut", (v, ir.Constant(table, BIGINT)), BIGINT), None
+
+
+def _register_scalar_families():
+    for name in _MATH_DOUBLE:
+        register(name, "scalar", f"Double math function {name}(x)", (1, 1),
+                 _build_math_double)
+    register("power", "scalar", "x raised to the power y", (2, 2), _build_power)
+    register("pow", "scalar", "Alias of power", (2, 2), _build_power)
+    for name in _STRING_MAP:
+        register(name, "scalar",
+                 f"String function {name} (dictionary-domain LUT)", (1, 1),
+                 _build_string_map)
+    register("length", "scalar", "String length (dictionary-domain LUT)",
+             (1, 1), _build_length)
+
+
+_register_scalar_families()
+
+
 _LEGACY_REGISTERED = False
 
 
@@ -223,9 +284,6 @@ def ensure_legacy_registered() -> None:
 
     meta(F.AGG_FUNCS, "aggregate", "Aggregate function")
     meta(F.Planner.WINDOW_FUNCS, "window", "Window function")
-    meta(F.Planner._STRING_MAP_FUNCS, "scalar",
-         "String function (dictionary-domain)")
-    meta(F.Planner._MATH_DOUBLE_FUNCS, "scalar", "Double math function")
     meta(F.Planner._COLLECTION_FUNCS, "collection", "Array/map/row function")
     meta(("abs", "round", "ceil", "ceiling", "floor", "sign", "trunc", "power",
           "pow", "mod"), "scalar", "Numeric function")
