@@ -719,7 +719,8 @@ class Planner:
                     est2 = join_stats(rstats[ii], rstats[jj], pks, bks)
                     rels[ii] = self._make_join(
                         "inner", rels[ii], rels[jj], eqs2,
-                        build_rows=rstats[jj].rows if rstats[jj].known else None)
+                        build_rows=rstats[jj].rows if rstats[jj].known else None,
+                        est_rows=est2.rows if est2.known else None)
                     rstats[ii] = est2
                     residual = rest2
                     pending.remove(jj)
@@ -740,7 +741,8 @@ class Planner:
                 candidates, key=lambda c: (c[0], c[1], c[2]))
             current = self._make_join(
                 "inner", current, rels[i], eqs,
-                build_rows=rstats[i].rows if rstats[i].known else None)
+                build_rows=rstats[i].rows if rstats[i].known else None,
+                est_rows=est.rows if est.known else None)
             cur_stats = est
             residual = rest
             joined.add(i)
@@ -1398,7 +1400,7 @@ class Planner:
         return "replicated"
 
     def _make_join(self, kind, probe: RelPlan, build: RelPlan, eqs,
-                   filter_expr=None, build_rows=None) -> RelPlan:
+                   filter_expr=None, build_rows=None, est_rows=None) -> RelPlan:
         probe_node, build_node = probe.node, build.node
         pkeys, bkeys = [], []
         for pe, be in eqs:
@@ -1421,7 +1423,8 @@ class Planner:
         ))
         node = P.Join(kind, probe_node, build_node, tuple(pkeys), tuple(bkeys), schema,
                       filter=filter_expr,
-                      distribution=self._join_distribution(build_rows))
+                      distribution=self._join_distribution(build_rows),
+                      est_rows=est_rows)
         cols = probe_cols + build_cols
         # a many-to-one join preserves probe-row multiplicity -> probe unique sets survive
         return RelPlan(node, cols, list(probe.unique_sets))

@@ -157,6 +157,8 @@ class Join(PlanNode):
     filter: Optional[Expr] = None  # over concatenated channels
     distribution: str = "replicated"
     null_aware: bool = False  # IN/NOT IN 3VL semantics (NULL build keys -> UNKNOWN)
+    est_rows: Optional[float] = None  # CBO output-cardinality estimate
+    # (EXPLAIN surface; reference: PlanNodeStatsEstimate in PlanPrinter)
 
     @property
     def children(self):

@@ -50,8 +50,10 @@ def _fmt(node: P.PlanNode, lines: list, depth: int, stats: dict) -> None:
         keys = ", ".join(f"${l} = ${r}" for l, r in zip(node.left_keys, node.right_keys))
         extra = f", filter: {node.filter}" if node.filter is not None else ""
         na = ", null-aware" if node.null_aware else ""
+        est = (f", est: {int(node.est_rows):,} rows"
+               if node.est_rows is not None else "")
         lines.append(f"{pad}{node.kind.capitalize()}Join[{keys}{extra}{na}, "
-                     f"{node.distribution}] => {_schema_str(node)}")
+                     f"{node.distribution}{est}] => {_schema_str(node)}")
     elif isinstance(node, P.Filter):
         lines.append(f"{pad}Filter[{node.predicate}]")
     elif isinstance(node, P.Project):
