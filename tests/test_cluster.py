@@ -100,6 +100,44 @@ def test_worker_death_reassigns_tasks(tmp_path):
                 w.wait(timeout=10)
 
 
+def test_task_endpoints_require_hmac(tmp_path):
+    """The fragment/task envelope is pickled — an unauthenticated body must be
+    rejected BEFORE deserialization (reference: internal-communication shared
+    secret).  Signed traffic passes end-to-end."""
+    import pickle
+    import urllib.error
+    import urllib.request
+
+    e = _engine()
+    coord = ClusterCoordinator(e, str(tmp_path / "spool"),
+                               heartbeat_interval=0.2, secret="s3cret")
+    url = coord.start()
+    w = WorkerServer(CATALOGS, str(tmp_path / "spool"), coordinator_url=url,
+                     node_id="sec", secret="s3cret")
+    w.start()
+    try:
+        coord.wait_for_workers(1, timeout=20)
+        blob = pickle.dumps({"fragment_id": "x", "plan": None})
+        # unsigned and mis-signed POSTs bounce with 403
+        for headers in ({}, {"X-Trino-Internal-Signature": "0" * 64}):
+            req = urllib.request.Request(f"{w.url}/v1/fragment", data=blob,
+                                         headers=headers)
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=5)
+            assert exc.value.code == 403
+        # the coordinator signs with the shared secret: full query runs
+        assert coord.execute_sql(Q).rows() == e.execute_sql(Q).rows()
+    finally:
+        w.stop()
+        coord.stop()
+
+
+def test_worker_refuses_unauthenticated_nonloopback(tmp_path, monkeypatch):
+    monkeypatch.delenv("TRINO_TPU_CLUSTER_SECRET", raising=False)
+    with pytest.raises(ValueError, match="loopback"):
+        WorkerServer(CATALOGS, str(tmp_path / "spool"), host="0.0.0.0")
+
+
 def test_in_process_worker_roundtrip(tmp_path):
     """WorkerServer driven in-process (fast path for CI): announce, dispatch,
     status poll, spooled commit."""

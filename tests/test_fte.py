@@ -156,6 +156,29 @@ def test_fte_join_exhausted_retries(tmp_path):
         ex.execute(plan)
 
 
+def test_fte_consumes_spooled_join_output(tmp_path):
+    """The aggregate above a join fragment must read the join's SPOOLED page,
+    not re-execute the join from its cached stream (the join would silently run
+    twice): under FTE every scan split generates exactly as many pages as one
+    local execution pulls."""
+    from trino_tpu.exec.local_executor import LocalExecutor
+
+    plan, inj, ex, expected = _setup_q(tmp_path, QJOIN)
+    conn = ex.catalogs["tpch"]
+    calls = []
+    orig = conn.generate
+    conn.generate = lambda *a, **k: (calls.append(1), orig(*a, **k))[1]
+    try:
+        assert ex.execute(plan).rows() == expected
+        fte_calls = len(calls)
+        calls.clear()
+        LocalExecutor(ex.catalogs).execute(plan)
+        local_calls = len(calls)
+    finally:
+        del conn.generate
+    assert fte_calls == local_calls
+
+
 def test_fte_engine_join_fault_tolerant(tmp_path):
     """Engine-level fault_tolerant execution of a join+window plan matches the
     plain path."""

@@ -237,7 +237,27 @@ class LocalExecutor:
         return page, stream.dicts
 
     # -- streaming segment compilation ---------------------------------------
+    def _subtree_overridden(self, node) -> bool:
+        return id(node) in self._overrides \
+            or any(self._subtree_overridden(c) for c in node.children)
+
     def _compile_stream(self, node: P.PlanNode) -> _Stream:
+        if self._overrides:
+            if id(node) in self._overrides:
+                # a durable fragment output (FTE spool / remote task)
+                # substitutes for the subtree: stream it as one page so
+                # streaming consumers (aggregates over joins, probe pipelines)
+                # read the spooled result instead of re-executing the fragment.
+                page, dicts = self._overrides[id(node)]
+                return _Stream(node.schema, dicts,
+                               lambda page=page: iter((page,)),
+                               lambda c, n, v, aux: (c, n, v))
+            if self._subtree_overridden(node):
+                # anything composed over an override closes over THIS query's
+                # spooled page — caching it would pin the page for the plan
+                # lifetime and serve it to the next execution (overrides are
+                # query-scoped; both caches are plan-lifetime)
+                return self._compile_stream_uncached(node)
         hit = self._stream_cache.get(id(node))
         if hit is not None:
             return hit[1]
@@ -341,9 +361,19 @@ class LocalExecutor:
         raise NotImplementedError(f"node {type(node).__name__}")
 
     # -- aggregation sink ----------------------------------------------------
+    def _agg_cacheable(self, node) -> bool:
+        """Aggregation caches (compiled steps closing over stream.transform,
+        tuples pinning the stream's page source) must be BYPASSED — both lookup
+        and store — while the child subtree is overridden: the override stream's
+        transform differs from the plan's normal pipeline, so a step cached in
+        one mode applied in the other computes garbage, and a cached stream
+        would pin + replay this query's spooled page on the next execution."""
+        return not (self._overrides and self._subtree_overridden(node.child))
+
     def _agg_compiled(self, node: P.Aggregate):
         """Per-node compiled aggregation artifacts (cached across executions)."""
-        hit = self._agg_cache.get(id(node))
+        cacheable = self._agg_cacheable(node)
+        hit = self._agg_cache.get(id(node)) if cacheable else None
         if hit is not None:
             return hit[1:]
         stream = self._compile_stream(node.child)
@@ -373,7 +403,8 @@ class LocalExecutor:
             )
 
         out = (stream, key_types, acc_specs, acc_exprs, acc_kinds, step)
-        self._agg_cache[id(node)] = (node,) + out
+        if cacheable:
+            self._agg_cache[id(node)] = (node,) + out
         return out
 
     def _key_ranges(self, stream, node):
@@ -407,7 +438,8 @@ class LocalExecutor:
 
     def _direct_step(self, node, cfg, stream, key_types, acc_exprs, acc_kinds):
         """Jitted direct-indexed insert step (cached per (node, cfg))."""
-        hit = self._agg_cache.get(("direct", id(node), cfg))
+        cacheable = self._agg_cacheable(node)
+        hit = self._agg_cache.get(("direct", id(node), cfg)) if cacheable else None
         if hit is not None:
             return hit[1]
 
@@ -426,7 +458,8 @@ class LocalExecutor:
                 state, cfg, key_vals, valid, inputs, acc_kinds, key_nulls
             )
 
-        self._agg_cache[("direct", id(node), cfg)] = (node, dstep)
+        if cacheable:
+            self._agg_cache[("direct", id(node), cfg)] = (node, dstep)
         return dstep
 
     def _run_aggregate(self, node: P.Aggregate):
@@ -545,7 +578,8 @@ class LocalExecutor:
         live-row bucket (reference analog: SelectedPositions feeding the
         aggregator, operator/project/SelectedPositions.java).  Live-row counts
         sync to the host in CHUNKS: on tunneled devices every sync costs an RTT."""
-        arts = self._agg_cache.get(("hashpage", id(node)))
+        cacheable = self._agg_cacheable(node)
+        arts = self._agg_cache.get(("hashpage", id(node))) if cacheable else None
         if arts is None:
             @jax.jit
             def prepare(page, aux, stream=stream, node=node, acc_exprs=acc_exprs):
@@ -571,7 +605,8 @@ class LocalExecutor:
                                               acc_kinds, knulls)
 
             arts = (node, prepare, insert_compact, insert_masked)
-            self._agg_cache[("hashpage", id(node))] = arts
+            if cacheable:
+                self._agg_cache[("hashpage", id(node))] = arts
         _, prepare, insert_compact, insert_masked = arts
         staged: list = []
 
@@ -716,7 +751,8 @@ class LocalExecutor:
 
     def _run_global_aggregate(self, node, stream, acc_exprs, acc_kinds):
         """Ungrouped aggregation (reference: AggregationOperator) — pure jnp reductions."""
-        hit = self._agg_cache.get(("global", id(node)))
+        cacheable = self._agg_cacheable(node)
+        hit = self._agg_cache.get(("global", id(node))) if cacheable else None
         if hit is not None:
             step = hit[1]
             return self._finish_global(node, stream, acc_exprs, acc_kinds, step)
@@ -749,7 +785,8 @@ class LocalExecutor:
                     raise NotImplementedError(kind)
             return tuple(out)
 
-        self._agg_cache[("global", id(node))] = (node, step)
+        if cacheable:
+            self._agg_cache[("global", id(node))] = (node, step)
         return self._finish_global(node, stream, acc_exprs, acc_kinds, step)
 
     def _finish_global(self, node, stream, acc_exprs, acc_kinds, step):

@@ -240,7 +240,9 @@ def _probe_insert(table, packed, valid):
     # program, a zeros slot vector) is "unvarying" and the while_loop rejects
     # the carry once the body mixes it with per-worker data.  Adding a zeroed
     # varying term is a no-op numerically but inherits the varying axis.
-    table = table + (packed[:1] & 0)
+    # (a reduction keeps the varying axis and, unlike packed[:1], broadcasts
+    # against the table even when the page has zero rows)
+    table = table + (jnp.sum(packed) & 0)
     slot = (h0 * 0 + C).astype(jnp.int32)  # default: overflow sink
     placed = ~valid  # invalid rows are trivially "done" (routed to sink)
 

@@ -36,16 +36,31 @@ class ClientResult:
 
 class Client:
     def __init__(self, base_url: str, catalog: Optional[str] = None,
-                 user: str = "user", poll_interval: float = 0.05):
+                 user: str = "user", password: Optional[str] = None,
+                 poll_interval: float = 0.05):
         self.base_url = base_url.rstrip("/")
         self.catalog = catalog
         self.user = user
         self.poll_interval = poll_interval
+        # Basic credentials (reference: client BasicAuthInterceptor attaching
+        # Authorization on every request, including segment fetches)
+        self._auth = None
+        if password is not None:
+            import base64
+
+            token = base64.b64encode(f"{user}:{password}".encode()).decode()
+            self._auth = f"Basic {token}"
+
+    def _headers(self, catalog: bool = True) -> dict:
+        headers = {"X-Trino-User": self.user}
+        if catalog and self.catalog:
+            headers["X-Trino-Catalog"] = self.catalog
+        if self._auth:
+            headers["Authorization"] = self._auth
+        return headers
 
     def _request(self, url: str, method: str = "GET", body: bytes = None) -> dict:
-        headers = {"X-Trino-User": self.user}
-        if self.catalog:
-            headers["X-Trino-Catalog"] = self.catalog
+        headers = self._headers()
         req = urllib.request.Request(url, data=body, method=method, headers=headers)
         with urllib.request.urlopen(req) as resp:
             payload = resp.read()
@@ -80,7 +95,7 @@ class Client:
         import zlib
 
         req = urllib.request.Request(seg["uri"],
-                                     headers={"X-Trino-User": self.user})
+                                     headers=self._headers(catalog=False))
         with urllib.request.urlopen(req) as resp:
             data = resp.read()
         if seg.get("encoding") == "json+zlib":

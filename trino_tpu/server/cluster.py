@@ -27,6 +27,8 @@ share one code path — the reference's single-binary role split.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import hmac
 import json
 import os as _os
 import pickle
@@ -78,11 +80,21 @@ def build_catalogs(config: dict) -> dict:
     return out
 
 
-def _http(url: str, data: Optional[bytes] = None, timeout: float = 10.0) -> bytes:
+def _http(url: str, data: Optional[bytes] = None, timeout: float = 10.0,
+          secret: Optional[str] = None) -> bytes:
     req = urllib.request.Request(url, data=data,
                                  method="POST" if data is not None else "GET")
+    if secret and data is not None:
+        req.add_header("X-Trino-Internal-Signature", _sign(secret, data))
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return r.read()
+
+
+def _sign(secret: str, body: bytes) -> str:
+    return hmac.new(secret.encode(), body, hashlib.sha256).hexdigest()
+
+
+_LOOPBACK = ("127.0.0.1", "localhost", "::1")
 
 
 # ---------------------------------------------------------------------------- worker
@@ -99,7 +111,20 @@ class WorkerServer:
     def __init__(self, catalogs_config: dict, spool_dir: str,
                  host: str = "127.0.0.1", port: int = 0,
                  coordinator_url: Optional[str] = None, node_id: str = "worker",
-                 announce_interval: float = 0.5):
+                 announce_interval: float = 0.5, secret: Optional[str] = None):
+        # the fragment envelope is pickled (arbitrary-code-execution on
+        # deserialize), so the task endpoints are authenticated like the
+        # reference's internal communication channel
+        # (internal-communication.shared-secret): every POST body carries an
+        # HMAC of the cluster secret.  Without a secret the worker refuses to
+        # listen beyond loopback.
+        self.secret = secret if secret is not None \
+            else _os.environ.get("TRINO_TPU_CLUSTER_SECRET")
+        if self.secret is None and host not in _LOOPBACK:
+            raise ValueError(
+                f"refusing to serve unauthenticated task endpoints on {host}: "
+                "set TRINO_TPU_CLUSTER_SECRET (or pass secret=) to bind "
+                "beyond loopback")
         self.catalogs = build_catalogs(catalogs_config)
         self.local = LocalExecutor(self.catalogs)
         self.spool_dir = spool_dir
@@ -152,15 +177,30 @@ class WorkerServer:
                     return self._reply(200, {"state": st.state, "error": st.error})
                 self._reply(404, {"error": "not found"})
 
+            def _read_verified(self):
+                """Read the body and verify its HMAC BEFORE unpickling —
+                pickle.loads on an unauthenticated body is arbitrary code
+                execution."""
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                if worker.secret is not None:
+                    got = self.headers.get("X-Trino-Internal-Signature", "")
+                    want = _sign(worker.secret, body)
+                    if not hmac.compare_digest(got, want):
+                        return None
+                return pickle.loads(body)
+
             def do_POST(self):
                 if self.path == "/v1/fragment":
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = pickle.loads(self.rfile.read(n))
+                    req = self._read_verified()
+                    if req is None:
+                        return self._reply(403, {"error": "bad signature"})
                     worker._register_fragment(req["fragment_id"], req["plan"])
                     return self._reply(200, {"ok": True})
                 if self.path == "/v1/task":
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = pickle.loads(self.rfile.read(n))
+                    req = self._read_verified()
+                    if req is None:
+                        return self._reply(403, {"error": "bad signature"})
                     try:
                         worker._start_task(req)
                     except KeyError:
@@ -189,7 +229,8 @@ class WorkerServer:
             try:
                 _http(f"{self.coordinator_url}/v1/announce",
                       json.dumps({"node_id": self.node_id,
-                                  "url": self.url}).encode())
+                                  "url": self.url}).encode(),
+                      secret=self.secret)
             except Exception:
                 pass  # coordinator not up yet / transient
             self._stop.wait(self.announce_interval)
@@ -262,11 +303,20 @@ class ClusterCoordinator:
     def __init__(self, engine, spool_dir: str, host: str = "127.0.0.1",
                  port: int = 0, heartbeat_interval: float = 0.5,
                  max_misses: int = 3, max_attempts: int = 3,
-                 splits_per_task: int = 2, task_timeout: float = 120.0):
+                 splits_per_task: int = 2, task_timeout: float = 120.0,
+                 secret: Optional[str] = None):
         self.engine = engine
         self.spool_dir = spool_dir
+        self.secret = secret if secret is not None \
+            else _os.environ.get("TRINO_TPU_CLUSTER_SECRET")
+        if self.secret is None and host not in _LOOPBACK:
+            raise ValueError(
+                f"refusing to serve unauthenticated announcements on {host}: "
+                "set TRINO_TPU_CLUSTER_SECRET (or pass secret=) to bind "
+                "beyond loopback")
         self.host, self.port = host, port
         self.workers: dict[str, _WorkerInfo] = {}
+        self.max_workers = 256  # announce registry bound (untrusted input)
         self.heartbeat_interval = heartbeat_interval
         self.max_misses = max_misses
         self.max_attempts = max_attempts
@@ -280,7 +330,14 @@ class ClusterCoordinator:
         # plan object, so the id(node)-keyed compiled-pipeline caches hit
         # instead of re-tracing per query
         self._local = LocalExecutor(engine.catalogs)
-        self._plan_cache: dict = {}
+        from collections import OrderedDict
+
+        # (sql, catalog) -> (plan, version snapshot): same identity + staleness
+        # rules as Engine._plan_cache, plus an LRU bound (the coordinator is a
+        # long-lived process; an unbounded text-keyed dict pins one compiled
+        # pipeline set per distinct query string forever)
+        self._plan_cache: OrderedDict = OrderedDict()
+        self._plan_cache_max = 128
 
     # -- lifecycle ---------------------------------------------------------------
     def start(self) -> str:
@@ -301,7 +358,16 @@ class ClusterCoordinator:
             def do_POST(self):
                 if self.path == "/v1/announce":
                     n = int(self.headers.get("Content-Length", 0))
-                    msg = json.loads(self.rfile.read(n))
+                    body = self.rfile.read(n)
+                    if coord.secret is not None:
+                        # registration feeds the scheduler: a poisoned entry
+                        # burns task attempts, so announcements authenticate
+                        # with the same cluster secret as task dispatch
+                        got = self.headers.get("X-Trino-Internal-Signature", "")
+                        if not hmac.compare_digest(got,
+                                                   _sign(coord.secret, body)):
+                            return self._reply(403, {"error": "bad signature"})
+                    msg = json.loads(body)
                     coord._announce(msg["node_id"], msg["url"])
                     return self._reply(200, {"ok": True})
                 self._reply(404, {"error": "not found"})
@@ -330,6 +396,13 @@ class ClusterCoordinator:
         with self._lock:
             w = self.workers.get(node_id)
             if w is None:
+                if len(self.workers) >= self.max_workers:
+                    # shed long-dead entries before refusing a fresh node
+                    for nid in [n for n, i in self.workers.items()
+                                if not i.alive]:
+                        self.workers.pop(nid)
+                if len(self.workers) >= self.max_workers:
+                    return
                 self.workers[node_id] = _WorkerInfo(node_id, url, time.time())
             else:
                 w.url, w.last_seen, w.misses, w.alive = url, time.time(), 0, True
@@ -370,14 +443,9 @@ class ClusterCoordinator:
         as remote tasks across live workers; merge spooled partials; run the
         remainder locally (reference: SqlQueryExecution.planDistribution ->
         per-stage task scheduling, SURVEY §3.2)."""
-        from ..sql.frontend import compile_sql
-
         sess = session or self.engine.create_session(
             next(iter(self.engine.catalogs)))
-        plan = self._plan_cache.get(sql)
-        if plan is None:
-            plan = compile_sql(sql, self.engine, sess)
-            self._plan_cache[sql] = plan
+        plan = self._cached_plan(sql, sess)
         local = self._local
         agg = self._find_distributable_aggregate(local, plan)
         if agg is None or not self.live_workers():
@@ -389,6 +457,42 @@ class ClusterCoordinator:
             return _materialize(out_page, dd)
         finally:
             local._overrides = {}
+
+    def _cached_plan(self, sql: str, sess):
+        """Versioned, bounded plan cache keyed by (sql, catalog) — the same
+        identity/staleness rules as Engine._cache_lookup (a plan embeds the
+        session catalog's table resolution and dictionary LUTs)."""
+        from ..sql.frontend import compile_sql
+
+        key = (sql, sess.catalog)
+        with self._lock:
+            entry = self._plan_cache.get(key)
+            if entry is not None:
+                plan, versions = entry
+                stale = any(
+                    self.engine.catalogs.get(name) is None
+                    or self.engine.catalogs[name].plan_version() != ver
+                    for name, ver in versions)
+                if stale:
+                    self._plan_cache.pop(key, None)
+                    self._local.forget_plan(plan)
+                else:
+                    self._plan_cache.move_to_end(key)
+                    return plan
+        plan = compile_sql(sql, self.engine, sess)
+        with self._lock:
+            raced = self._plan_cache.get(key)
+            if raced is not None:
+                # another thread compiled the same key meanwhile: keep ITS
+                # entry (its compiled artifacts may already be in _local's
+                # caches) and use it; our duplicate was never executed, so it
+                # left nothing to forget
+                return raced[0]
+            self._plan_cache[key] = (plan, self.engine._plan_versions(plan))
+            while len(self._plan_cache) > self._plan_cache_max:
+                _, (old, _v) = self._plan_cache.popitem(last=False)
+                self._local.forget_plan(old)
+        return plan
 
     def _find_distributable_aggregate(self, local, node):
         if isinstance(node, P.Aggregate) and node.keys:
@@ -437,12 +541,13 @@ class ClusterCoordinator:
                 w = live[i % len(live)]
                 try:
                     if w.url not in frag_sent:
-                        _http(f"{w.url}/v1/fragment", frag_blob)
+                        _http(f"{w.url}/v1/fragment", frag_blob,
+                              secret=self.secret)
                         frag_sent.add(w.url)
                     req = pickle.dumps({"task_id": tid, "fragment_id": frag_id,
                                         "splits": sp, "attempt": attempts[tid],
                                         "exchange_dir": exchange_dir})
-                    _http(f"{w.url}/v1/task", req)
+                    _http(f"{w.url}/v1/task", req, secret=self.secret)
                     assigned[tid] = (w, sp, time.time() + self.task_timeout)
                     del pending[tid]
                 except Exception:
