@@ -156,6 +156,60 @@ def test_fte_join_exhausted_retries(tmp_path):
         ex.execute(plan)
 
 
+class _FlakyGenerate:
+    """Connector shim whose generate raises a REAL exception for the first
+    ``fail_times`` calls — the reference's flaky-connector recovery shape
+    (BaseFailureRecoveryTest exercises real task failures, not only injected
+    ones)."""
+
+    def __init__(self, conn, exc_factory, fail_times):
+        self._orig = conn.generate
+        self._exc = exc_factory
+        self.left = fail_times
+
+    def __call__(self, *a, **k):
+        if self.left > 0:
+            self.left -= 1
+            raise self._exc()
+        return self._orig(*a, **k)
+
+
+def test_fte_retries_real_connector_failures(tmp_path):
+    """A connector raising a genuine OSError mid-scan recovers under FTE (the
+    retry loop classifies it retryable) but fails the plain executor."""
+    from trino_tpu.exec.local_executor import LocalExecutor
+
+    plan, inj, ex, expected = _setup(tmp_path)
+    conn = ex.catalogs["tpch"]
+    conn.generate = _FlakyGenerate(conn, lambda: OSError("simulated io loss"), 2)
+    try:
+        assert ex.execute(plan).rows() == expected
+    finally:
+        del conn.generate
+    # without fault tolerance the same flake kills the query
+    conn.generate = _FlakyGenerate(conn, lambda: OSError("simulated io loss"), 2)
+    try:
+        with pytest.raises(OSError):
+            LocalExecutor(ex.catalogs).execute(plan)
+    finally:
+        del conn.generate
+
+
+def test_fte_deterministic_errors_do_not_retry(tmp_path):
+    """SemanticError-class failures would fail identically every attempt:
+    they surface immediately instead of burning the retry budget."""
+    plan, inj, ex, _ = _setup(tmp_path)
+    conn = ex.catalogs["tpch"]
+    conn.generate = _FlakyGenerate(
+        conn, lambda: NotImplementedError("unsupported encoding"), 99)
+    try:
+        with pytest.raises(NotImplementedError):
+            ex.execute(plan)
+    finally:
+        del conn.generate
+    assert max(ex.task_attempts.values()) == 1  # no retries burned
+
+
 def test_fte_consumes_spooled_join_output(tmp_path):
     """The aggregate above a join fragment must read the join's SPOOLED page,
     not re-execute the join from its cached stream (the join would silently run

@@ -38,7 +38,8 @@ from ..sql import plan as P
 from .local_executor import LocalExecutor, _finalize_aggs, _host, _materialize
 
 __all__ = ["FailureInjector", "InjectedFailure", "SpoolingExchange",
-           "FaultTolerantExecutor", "serialize_page", "deserialize_page"]
+           "FaultTolerantExecutor", "serialize_page", "deserialize_page",
+           "is_retryable_failure"]
 
 _MERGE_KIND = {"sum": "sum", "count": "sum", "count_star": "sum",
                "min": "min", "max": "max", "sum_sq": "sum"}
@@ -90,6 +91,24 @@ def deserialize_page(data: bytes):
 # ---------------------------------------------------------------------------- injection
 class InjectedFailure(RuntimeError):
     pass
+
+
+def is_retryable_failure(e: BaseException) -> bool:
+    """Task-retry classification (reference: retry policies consult the error
+    kind — StandardErrorCode USER_ERROR vs INTERNAL/EXTERNAL categories via
+    ErrorType, spi/ErrorType.java; FailureInjector.java:53 models the injectable
+    external kinds).  DETERMINISTIC errors — bad SQL, unsupported features,
+    planner bugs — would fail identically on every attempt, so retrying them
+    burns the budget and hides the real message; everything else (connector
+    IO, transient device/runtime errors, injected faults) retries."""
+    from ..spi.security import AccessDeniedError
+    from ..sql.frontend import SemanticError
+    from ..sql.parser import ParseError
+
+    deterministic = (SemanticError, ParseError, AccessDeniedError,
+                     NotImplementedError, AssertionError, AttributeError,
+                     NameError)
+    return isinstance(e, Exception) and not isinstance(e, deterministic)
 
 
 class FailureInjector:
@@ -277,6 +296,13 @@ class FaultTolerantExecutor:
         """Run a fragment task with the retry/dedup protocol; returns the side
         payload (dicts) from the last successful compute, or None when an
         earlier attempt already committed."""
+        return self._retry_loop(task_id, self._exchange, compute)
+
+    def _retry_loop(self, task_id, exchange, compute):
+        """The one retry/classify/dedup/exhaust policy both task shapes share.
+        ``compute`` returns bytes or (bytes, side_payload); the side payload of
+        the successful attempt is returned (None when an earlier attempt's
+        commit made this one redundant)."""
         last_error = None
         extra = None
         for attempt in range(self.max_attempts):
@@ -284,18 +310,23 @@ class FaultTolerantExecutor:
             try:
                 out = compute()
                 data, extra = out if isinstance(out, tuple) else (out, None)
-                self._exchange.commit(task_id, attempt, data)
+                exchange.commit(task_id, attempt, data)
                 # a post-commit failure must not duplicate output on retry
                 self.injector.maybe_fail(task_id, "POST_COMMIT_FAILURE")
                 return extra
-            except InjectedFailure as e:
+            except Exception as e:
+                # real failures retry too (connector IO, transient runtime) —
+                # "fault tolerant" must not mean "tolerant only of test
+                # faults"; deterministic errors surface immediately
+                if not is_retryable_failure(e):
+                    raise
                 last_error = e
-                if self._exchange.is_committed(task_id):
+                if exchange.is_committed(task_id):
                     return extra  # output durable; a retry would dedup anyway
                 continue
         raise RuntimeError(
             f"task {task_id} failed after {self.max_attempts} attempts: "
-            f"{last_error}")
+            f"{last_error}") from last_error
 
     # -- stage 1: partial aggregation tasks -------------------------------------
     def _run_fte_aggregate(self, node: P.Aggregate):
@@ -323,26 +354,14 @@ class FaultTolerantExecutor:
 
     def _run_task_with_retries(self, task, exchange, node, stream, key_types,
                                acc_specs, step):
-        last_error = None
-        for attempt in range(self.max_attempts):
-            self.task_attempts[task.task_id] = attempt + 1
-            try:
-                self.injector.maybe_fail(task.task_id, "TASK_FAILURE")
-                data = self._execute_task(task, node, stream, key_types, acc_specs,
-                                          step)
-                self.injector.maybe_fail(task.task_id, "TASK_GET_RESULTS_FAILURE")
-                exchange.commit(task.task_id, attempt, data)
-                # a post-commit failure must not duplicate output on retry
-                self.injector.maybe_fail(task.task_id, "POST_COMMIT_FAILURE")
-                return
-            except InjectedFailure as e:
-                last_error = e
-                if exchange.is_committed(task.task_id):
-                    return  # output durable; the retry would dedup anyway
-                continue
-        raise RuntimeError(
-            f"task {task.task_id} failed after {self.max_attempts} attempts: "
-            f"{last_error}")
+        def compute():
+            self.injector.maybe_fail(task.task_id, "TASK_FAILURE")
+            data = self._execute_task(task, node, stream, key_types, acc_specs,
+                                      step)
+            self.injector.maybe_fail(task.task_id, "TASK_GET_RESULTS_FAILURE")
+            return data
+
+        self._retry_loop(task.task_id, exchange, compute)
 
     def _execute_task(self, task: TaskDescriptor, node, stream, key_types, acc_specs,
                       step) -> bytes:

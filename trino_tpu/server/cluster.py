@@ -52,7 +52,8 @@ import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)
 
-from ..exec.fte import SpoolingExchange, merge_partial_pages, run_partial_aggregate
+from ..exec.fte import (SpoolingExchange, is_retryable_failure,
+                        merge_partial_pages, run_partial_aggregate)
 from ..exec.local_executor import LocalExecutor, _materialize
 from ..sql import plan as P
 
@@ -102,6 +103,7 @@ _LOOPBACK = ("127.0.0.1", "localhost", "::1")
 class _TaskState:
     state: str = "running"  # running | done | failed
     error: Optional[str] = None
+    retryable: bool = True  # False: deterministic failure, do not re-dispatch
 
 
 class WorkerServer:
@@ -174,7 +176,8 @@ class WorkerServer:
                     st = worker.tasks.get(tid)
                     if st is None:
                         return self._reply(404, {"error": "no such task"})
-                    return self._reply(200, {"state": st.state, "error": st.error})
+                    return self._reply(200, {"state": st.state, "error": st.error,
+                                             "retryable": st.retryable})
                 self._reply(404, {"error": "not found"})
 
             def _read_verified(self):
@@ -271,8 +274,9 @@ class WorkerServer:
                 SpoolingExchange(req["exchange_dir"]).commit(
                     req["task_id"], req.get("attempt", 0), data)
                 st.state = "done"
-            except Exception as e:  # pragma: no cover - surfaced via status
+            except Exception as e:
                 st.state = "failed"
+                st.retryable = is_retryable_failure(e)
                 st.error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
             finally:
                 with self._wlock:
@@ -572,6 +576,16 @@ class ClusterCoordinator:
                 try:
                     st = json.loads(_http(f"{w.url}/v1/task/{tid}", timeout=2.0))
                     failed = failed or st.get("state") == "failed"
+                    if st.get("state") == "failed" \
+                            and not st.get("retryable", True):
+                        # deterministic failure: every re-dispatch would hit
+                        # the identical error — surface it now instead of
+                        # burning the attempt budget across workers
+                        raise RuntimeError(
+                            f"task {tid} failed deterministically: "
+                            f"{st.get('error')}")
+                except RuntimeError:
+                    raise
                 except Exception:
                     # unreachable OR task unknown (404: the worker restarted
                     # and lost its in-memory state) -> the attempt is gone
