@@ -1997,12 +1997,14 @@ class Planner:
                          "map", "map_keys", "map_values", "row")
 
     def _translate_func(self, ast: A.FuncCall, cols):
+        """Registry dispatch (reference: the analyzer resolving calls against
+        the one registered catalog, metadata/SystemFunctionBundle.java:384).
+        Every executable scalar lives in sql/functions.py as a builder-backed
+        FunctionDef; only genuinely structural forms (CASE, IN, casts,
+        subscripts) translate outside the registry."""
         name = ast.name
         if name in AGG_FUNCS:
             raise SemanticError(f"aggregate {name} in scalar context")
-        # registry-native functions first (reference: the analyzer resolving
-        # against the registered catalog, metadata/SystemFunctionBundle);
-        # legacy translations below migrate into the registry over time
         from .functions import lookup
 
         fdef = lookup(name)
@@ -2016,177 +2018,6 @@ class Planner:
             return fdef.builder(self, ast, cols)
         if name in self._COLLECTION_FUNCS:
             return self._translate_collection_func(ast, cols)
-        if name == "round" and len(ast.args) == 2:
-            v, _ = self._translate(ast.args[0], cols)
-            if not isinstance(ast.args[1], A.NumberLit):
-                raise SemanticError("round() scale must be a literal")
-            n = int(ast.args[1].text)
-            return ir.Call("round_n", (_coerce(v, DOUBLE),), DOUBLE, meta=(n,)), None
-        if name in ("abs", "floor", "ceil", "ceiling", "round",
-                    "sign", "trunc"):
-            args = [self._translate(a, cols)[0] for a in ast.args]
-            op = "ceil" if name == "ceiling" else name
-            t = args[0].type if name in ("abs", "round", "sign", "trunc") else DOUBLE
-            if name in ("floor", "ceil", "ceiling"):
-                t = args[0].type if args[0].type.is_integer else BIGINT
-                if isinstance(args[0].type, DecimalType) or args[0].type.is_floating:
-                    return ir.Call(op, (_coerce(args[0], DOUBLE),), DOUBLE), None
-            if name in ("round", "trunc") and isinstance(args[0].type, DecimalType):
-                # raw scaled ints would round/truncate in raw units; compute in double
-                # (documented deviation, like decimal division)
-                return ir.Call(op, (_coerce(args[0], DOUBLE),), DOUBLE), None
-            return ir.Call(op, tuple(args), t), None
-        if name == "atan2":
-            a, _ = self._translate(ast.args[0], cols)
-            b, _ = self._translate(ast.args[1], cols)
-            return ir.Call("atan2", (_coerce(a, DOUBLE), _coerce(b, DOUBLE)),
-                           DOUBLE), None
-        if name == "mod":
-            a, _ = self._translate(ast.args[0], cols)
-            b, _ = self._translate(ast.args[1], cols)
-            return _arith("modulus", a, b), None
-        if name == "pi":
-            import math
-
-            return ir.Constant(math.pi, DOUBLE), None
-        if name == "width_bucket":
-            args = [self._translate(a, cols)[0] for a in ast.args]
-            return ir.Call("width_bucket",
-                           (_coerce(args[0], DOUBLE), _coerce(args[1], DOUBLE),
-                            _coerce(args[2], DOUBLE), _coerce(args[3], BIGINT)),
-                           BIGINT), None
-        if name == "nullif":
-            a, ad = self._translate(ast.args[0], cols)
-            b, _ = self._translate(ast.args[1], cols)
-            t = common_super_type(a.type, b.type)
-            return ir.Call("nullif", (_coerce(a, t), _coerce(b, t)), t), ad
-        if name == "if":
-            whens = ((ast.args[0], ast.args[1]),)
-            default = ast.args[2] if len(ast.args) > 2 else None
-            return self._translate_case(A.CaseExpr(None, whens, default), cols)
-        if name in ("year", "month", "day", "quarter"):
-            v, _ = self._translate(ast.args[0], cols)
-            return ir.Call(f"extract_{name}", (v,), BIGINT), None
-        if name in ("day_of_week", "dow"):
-            v, _ = self._translate(ast.args[0], cols)
-            return ir.Call("day_of_week", (v,), BIGINT), None
-        if name in ("day_of_year", "doy"):
-            v, _ = self._translate(ast.args[0], cols)
-            return ir.Call("day_of_year", (v,), BIGINT), None
-        if name == "date_trunc":
-            if not isinstance(ast.args[0], A.StringLit):
-                raise SemanticError("date_trunc unit must be a literal")
-            unit = ast.args[0].value.lower()
-            if unit not in ("year", "quarter", "month", "week", "day"):
-                raise SemanticError(f"date_trunc unit {unit} not supported")
-            v, _ = self._translate(ast.args[1], cols)
-            return ir.Call(f"date_trunc_{unit}", (v,), DATE), None
-        if name == "current_date":
-            import datetime
-
-            return ir.Constant((datetime.date.today()
-                                - datetime.date(1970, 1, 1)).days, DATE), None
-        if name == "regexp_like":
-            # dictionary-domain regex (reference: operator/scalar/JoniRegexpFunctions;
-            # strings are dict ids, so the pattern evaluates once per distinct value)
-            import re as _re
-
-            v, d = self._require_dict(ast.args[0], cols, name)
-            pat = _re.compile(self._literal_str(ast.args[1], name))
-            lutb = d.match(lambda s: bool(pat.search(s)))
-            return ir.Call("lut", (v, ir.Constant(lutb, BOOLEAN)), BOOLEAN), None
-        if name == "split_part":
-            v, d = self._require_dict(ast.args[0], cols, name)
-            delim = self._literal_str(ast.args[1], name)
-            if not isinstance(ast.args[2], A.NumberLit):
-                raise SemanticError("split_part index must be a literal")
-            ix = int(ast.args[2].text)
-
-            def part(s, delim=delim, ix=ix):
-                ps = str(s).split(delim)
-                return ps[ix - 1] if 0 < ix <= len(ps) else ""
-
-            lut, nd = d.map_values(part)
-            return ir.Call("lut", (v, ir.Constant(lut, v.type)), v.type), nd
-        if name == "codepoint":
-            sval = self._literal_str(ast.args[0], name)
-            return ir.Constant(ord(sval[0]), BIGINT), None
-        if name in ("date_add", "date_diff"):
-            unit = self._literal_str(ast.args[0], name).lower()
-            if unit not in ("day", "week", "month", "year"):
-                raise SemanticError(f"{name} unit {unit!r} not supported")
-            a, _ = self._translate(ast.args[1], cols)
-            b, _ = self._translate(ast.args[2], cols)
-            if name == "date_add":
-                return ir.Call("date_add_unit", (_coerce(a, BIGINT), b), DATE,
-                               meta=(unit,)), None
-            return ir.Call("date_diff_unit", (a, b), BIGINT, meta=(unit,)), None
-        if name == "strpos":
-            v, d = self._require_dict(ast.args[0], cols, name)
-            pat = self._literal_str(ast.args[1], name)
-            table = np.array([str(s).find(pat) + 1 for s in d.values], np.int64)
-            return ir.Call("lut", (v, ir.Constant(table, BIGINT)), BIGINT), None
-        if name == "starts_with":
-            v, d = self._require_dict(ast.args[0], cols, name)
-            pat = self._literal_str(ast.args[1], name)
-            lutb = d.match(lambda s: s.startswith(pat))
-            return ir.Call("lut", (v, ir.Constant(lutb, BOOLEAN)), BOOLEAN), None
-        if name == "replace":
-            v, d = self._require_dict(ast.args[0], cols, name)
-            pat = self._literal_str(ast.args[1], name)
-            rep = self._literal_str(ast.args[2], name) if len(ast.args) > 2 else ""
-            lut, nd = d.map_values(lambda s: s.replace(pat, rep))
-            return ir.Call("lut", (v, ir.Constant(lut, v.type)), v.type), nd
-        if name in ("lpad", "rpad"):
-            v, d = self._require_dict(ast.args[0], cols, name)
-            if not isinstance(ast.args[1], A.NumberLit):
-                raise SemanticError(f"{name} size must be a literal")
-            size = int(ast.args[1].text)
-            fill = self._literal_str(ast.args[2], name) if len(ast.args) > 2 else " "
-            if not fill:
-                raise SemanticError(f"{name} padding string must not be empty")
-
-            def pad(s, left=(name == "lpad"), size=size, fill=fill):
-                if len(s) >= size:
-                    return s[:size]
-                padding = (fill * size)[:size - len(s)]  # repeating pattern fill
-                return padding + s if left else s + padding
-
-            lut, nd = d.map_values(pad)
-            t = VarcharType.of(size)
-            return ir.Call("lut", (v, ir.Constant(lut, t)), t), nd
-        if name == "concat":
-            return self._translate_concat(ast.args, cols)
-        if name in ("greatest", "least"):
-            args = [self._translate(a, cols)[0] for a in ast.args]
-            t = args[0].type
-            for a in args[1:]:
-                t = common_super_type(t, a.type)
-            return ir.Call(name, tuple(_coerce(a, t) for a in args), t), None
-        if name == "coalesce":
-            args = [self._translate(a, cols)[0] for a in ast.args]
-            t = args[0].type
-            for a in args[1:]:
-                t = common_super_type(t, a.type)
-            return ir.Call("coalesce", tuple(_coerce(a, t) for a in args), t), None
-        if name == "substr":
-            ast = dataclasses.replace(ast, name="substring")
-            name = "substring"
-        if name == "substring":
-            # string functions over dictionary columns compile to an id->id lookup table
-            # plus a derived dictionary (planner-side; device only maps ids — the
-            # dictionary-processing analog of DictionaryAwarePageProjection.java)
-            v, d = self._translate(ast.args[0], cols)
-            if d is None or d.values is None:
-                raise SemanticError("substring requires an enumerable dictionary column")
-            if not all(isinstance(a, A.NumberLit) for a in ast.args[1:]):
-                raise SemanticError("substring start/length must be literals")
-            start = int(ast.args[1].text)
-            length = int(ast.args[2].text) if len(ast.args) > 2 else None
-            end = None if length is None else start - 1 + length
-            lut, nd = d.map_values(lambda s: s[start - 1:end])
-            t = VarcharType.of(length)
-            return ir.Call("lut", (v, ir.Constant(lut, t)), t), nd
         raise SemanticError(f"function {name} not supported")
 
     def _require_dict(self, arg_ast, cols, fname):

@@ -25,7 +25,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from ..types import BIGINT, BOOLEAN, DOUBLE, Type, VarcharType
+from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, DecimalType, Type,
+                     VarcharType)
 from . import ir
 from . import parser as A
 
@@ -264,13 +265,374 @@ def _register_scalar_families():
 _register_scalar_families()
 
 
+# ---------------------------------------------------------------------------- numeric family
+# The remaining if-chain families, migrated: every entry below is the single
+# source of truth for both SHOW FUNCTIONS and translation (reference:
+# SystemFunctionBundle.java:384 — one declarative catalog feeding both the
+# analyzer and the metadata surface).
+
+
+def _rt():
+    """Planner runtime helpers (lazy: functions.py loads before frontend.py)."""
+    from . import frontend as F
+
+    return F
+
+
+def _args(planner, ast, cols):
+    return [planner._translate(a, cols)[0] for a in ast.args]
+
+
+def _build_round(planner, ast, cols):
+    F = _rt()
+    if len(ast.args) == 2:
+        if not isinstance(ast.args[1], A.NumberLit):
+            raise F.SemanticError("round() scale must be a literal")
+        n = int(ast.args[1].text)
+        v, _ = planner._translate(ast.args[0], cols)
+        return ir.Call("round_n", (F._coerce(v, DOUBLE),), DOUBLE,
+                       meta=(n,)), None
+    return _build_unary_numeric(planner, ast, cols)
+
+
+def _build_unary_numeric(planner, ast, cols):
+    F = _rt()
+    name = ast.name
+    args = _args(planner, ast, cols)
+    op = "ceil" if name == "ceiling" else name
+    t = args[0].type if name in ("abs", "round", "sign", "trunc") else DOUBLE
+    if name in ("floor", "ceil", "ceiling"):
+        t = args[0].type if args[0].type.is_integer else BIGINT
+        if isinstance(args[0].type, DecimalType) or args[0].type.is_floating:
+            return ir.Call(op, (F._coerce(args[0], DOUBLE),), DOUBLE), None
+    if name in ("round", "trunc") and isinstance(args[0].type, DecimalType):
+        # raw scaled ints would round/truncate in raw units; compute in double
+        # (documented deviation, like decimal division)
+        return ir.Call(op, (F._coerce(args[0], DOUBLE),), DOUBLE), None
+    return ir.Call(op, tuple(args), t), None
+
+
+def _build_atan2(planner, ast, cols):
+    F = _rt()
+    a, b = _args(planner, ast, cols)
+    return ir.Call("atan2", (F._coerce(a, DOUBLE), F._coerce(b, DOUBLE)),
+                   DOUBLE), None
+
+
+def _build_mod(planner, ast, cols):
+    F = _rt()
+    a, b = _args(planner, ast, cols)
+    return F._arith("modulus", a, b), None
+
+
+def _build_pi(planner, ast, cols):
+    import math
+
+    return ir.Constant(math.pi, DOUBLE), None
+
+
+def _build_width_bucket(planner, ast, cols):
+    F = _rt()
+    args = _args(planner, ast, cols)
+    return ir.Call("width_bucket",
+                   (F._coerce(args[0], DOUBLE), F._coerce(args[1], DOUBLE),
+                    F._coerce(args[2], DOUBLE), F._coerce(args[3], BIGINT)),
+                   BIGINT), None
+
+
+# ---------------------------------------------------------------------------- conditional family
+def _build_nullif(planner, ast, cols):
+    F = _rt()
+    a, ad = planner._translate(ast.args[0], cols)
+    b, _ = planner._translate(ast.args[1], cols)
+    t = F.common_super_type(a.type, b.type)
+    return ir.Call("nullif", (F._coerce(a, t), F._coerce(b, t)), t), ad
+
+
+def _build_if(planner, ast, cols):
+    whens = ((ast.args[0], ast.args[1]),)
+    default = ast.args[2] if len(ast.args) > 2 else None
+    return planner._translate_case(A.CaseExpr(None, whens, default), cols)
+
+
+def _build_variadic_super(planner, ast, cols):
+    """coalesce / greatest / least: common-supertype folding over all args."""
+    F = _rt()
+    args = _args(planner, ast, cols)
+    t = args[0].type
+    for a in args[1:]:
+        t = F.common_super_type(t, a.type)
+    return ir.Call(ast.name, tuple(F._coerce(a, t) for a in args), t), None
+
+
+def _build_typeof(planner, ast, cols):
+    from ..connectors.tpch import Dictionary
+
+    v, _ = planner._translate(ast.args[0], cols)
+    t = VarcharType.of(None)
+    return ir.Constant(0, t), Dictionary(
+        values=np.array([getattr(v.type, "name", str(v.type))], dtype=object))
+
+
+# ---------------------------------------------------------------------------- date/time family
+_EXTRACT_ALIASES = {"dow": "day_of_week", "doy": "day_of_year"}
+
+
+def _build_extract_part(planner, ast, cols):
+    v, _ = planner._translate(ast.args[0], cols)
+    part = _EXTRACT_ALIASES.get(ast.name, ast.name)
+    op = part if part in ("day_of_week", "day_of_year") else f"extract_{part}"
+    return ir.Call(op, (v,), BIGINT), None
+
+
+def _build_date_trunc(planner, ast, cols):
+    F = _rt()
+    if not isinstance(ast.args[0], A.StringLit):
+        raise F.SemanticError("date_trunc unit must be a literal")
+    unit = ast.args[0].value.lower()
+    if unit not in ("year", "quarter", "month", "week", "day"):
+        raise F.SemanticError(f"date_trunc unit {unit} not supported")
+    v, _ = planner._translate(ast.args[1], cols)
+    return ir.Call(f"date_trunc_{unit}", (v,), DATE), None
+
+
+def _build_current_date(planner, ast, cols):
+    import datetime
+
+    return ir.Constant((datetime.date.today()
+                        - datetime.date(1970, 1, 1)).days, DATE), None
+
+
+def _build_date_arith(planner, ast, cols):
+    F = _rt()
+    name = ast.name
+    unit = planner._literal_str(ast.args[0], name).lower()
+    if unit not in ("day", "week", "month", "year"):
+        raise F.SemanticError(f"{name} unit {unit!r} not supported")
+    a, _ = planner._translate(ast.args[1], cols)
+    b, _ = planner._translate(ast.args[2], cols)
+    if name == "date_add":
+        return ir.Call("date_add_unit", (F._coerce(a, BIGINT), b), DATE,
+                       meta=(unit,)), None
+    return ir.Call("date_diff_unit", (a, b), BIGINT, meta=(unit,)), None
+
+
+# ---------------------------------------------------------------------------- string family
+# Strings are dictionary ids on device: each function runs its python transform
+# once per DISTINCT value at plan time and ships an id->id/value LUT
+# (reference analog: DictionaryAwarePageProjection).
+
+
+def _build_regexp_like(planner, ast, cols):
+    v, d = planner._require_dict(ast.args[0], cols, ast.name)
+    pat = re.compile(planner._literal_str(ast.args[1], ast.name))
+    lutb = d.match(lambda s: bool(pat.search(s)))
+    return ir.Call("lut", (v, ir.Constant(lutb, BOOLEAN)), BOOLEAN), None
+
+
+def _build_starts_with(planner, ast, cols):
+    v, d = planner._require_dict(ast.args[0], cols, ast.name)
+    pat = planner._literal_str(ast.args[1], ast.name)
+    lutb = d.match(lambda s: s.startswith(pat))
+    return ir.Call("lut", (v, ir.Constant(lutb, BOOLEAN)), BOOLEAN), None
+
+
+def _build_split_part(planner, ast, cols):
+    F = _rt()
+    v, d = planner._require_dict(ast.args[0], cols, ast.name)
+    delim = planner._literal_str(ast.args[1], ast.name)
+    if not isinstance(ast.args[2], A.NumberLit):
+        raise F.SemanticError("split_part index must be a literal")
+    ix = int(ast.args[2].text)
+
+    def part(s, delim=delim, ix=ix):
+        ps = str(s).split(delim)
+        return ps[ix - 1] if 0 < ix <= len(ps) else ""
+
+    lut, nd = d.map_values(part)
+    return ir.Call("lut", (v, ir.Constant(lut, v.type)), v.type), nd
+
+
+def _build_codepoint(planner, ast, cols):
+    F = _rt()
+    sval = planner._literal_str(ast.args[0], ast.name)
+    if not sval:
+        raise F.SemanticError("codepoint argument must not be empty")
+    return ir.Constant(ord(sval[0]), BIGINT), None
+
+
+def _build_chr(planner, ast, cols):
+    F = _rt()
+    from ..connectors.tpch import Dictionary
+
+    if not isinstance(ast.args[0], A.NumberLit):
+        raise F.SemanticError("chr argument must be a literal")
+    try:
+        ch = chr(int(ast.args[0].text))
+    except ValueError as e:
+        raise F.SemanticError(f"chr argument invalid: {e}") from e
+    t = VarcharType.of(1)
+    return ir.Constant(0, t), Dictionary(values=np.array([ch], dtype=object))
+
+
+def _build_strpos(planner, ast, cols):
+    v, d = planner._require_dict(ast.args[0], cols, ast.name)
+    pat = planner._literal_str(ast.args[1], ast.name)
+    table = np.array([str(s).find(pat) + 1 for s in d.values], np.int64)
+    return ir.Call("lut", (v, ir.Constant(table, BIGINT)), BIGINT), None
+
+
+def _build_replace(planner, ast, cols):
+    v, d = planner._require_dict(ast.args[0], cols, ast.name)
+    pat = planner._literal_str(ast.args[1], ast.name)
+    rep = planner._literal_str(ast.args[2], ast.name) \
+        if len(ast.args) > 2 else ""
+    lut, nd = d.map_values(lambda s: s.replace(pat, rep))
+    return ir.Call("lut", (v, ir.Constant(lut, v.type)), v.type), nd
+
+
+def _build_pad(planner, ast, cols):
+    F = _rt()
+    name = ast.name
+    v, d = planner._require_dict(ast.args[0], cols, name)
+    if not isinstance(ast.args[1], A.NumberLit):
+        raise F.SemanticError(f"{name} size must be a literal")
+    size = int(ast.args[1].text)
+    fill = planner._literal_str(ast.args[2], name) if len(ast.args) > 2 else " "
+    if not fill:
+        raise F.SemanticError(f"{name} padding string must not be empty")
+
+    def pad(s, left=(name == "lpad"), size=size, fill=fill):
+        if len(s) >= size:
+            return s[:size]
+        padding = (fill * size)[:size - len(s)]  # repeating pattern fill
+        return padding + s if left else s + padding
+
+    lut, nd = d.map_values(pad)
+    t = VarcharType.of(size)
+    return ir.Call("lut", (v, ir.Constant(lut, t)), t), nd
+
+
+def _build_left_right(planner, ast, cols):
+    F = _rt()
+    name = ast.name
+    v, d = planner._require_dict(ast.args[0], cols, name)
+    if not isinstance(ast.args[1], A.NumberLit):
+        raise F.SemanticError(f"{name} length must be a literal")
+    n = int(ast.args[1].text)
+
+    def take(s, left=(name == "left"), n=n):
+        if n <= 0:
+            return ""
+        return s[:n] if left else s[-n:]
+
+    lut, nd = d.map_values(take)
+    t = VarcharType.of(n)
+    return ir.Call("lut", (v, ir.Constant(lut, t)), t), nd
+
+
+def _build_substring(planner, ast, cols):
+    F = _rt()
+    v, d = planner._translate(ast.args[0], cols)
+    if d is None or d.values is None:
+        raise F.SemanticError(
+            "substring requires an enumerable dictionary column")
+    if not all(isinstance(a, A.NumberLit) for a in ast.args[1:]):
+        raise F.SemanticError("substring start/length must be literals")
+    start = int(ast.args[1].text)
+    length = int(ast.args[2].text) if len(ast.args) > 2 else None
+    end = None if length is None else start - 1 + length
+    lut, nd = d.map_values(lambda s: s[start - 1:end])
+    t = VarcharType.of(length)
+    return ir.Call("lut", (v, ir.Constant(lut, t)), t), nd
+
+
+def _build_concat(planner, ast, cols):
+    return planner._translate_concat(ast.args, cols)
+
+
+def _register_migrated_families():
+    register("round", "scalar", "Round to integer or to a literal scale",
+             (1, 2), _build_round)
+    for n, desc in (("abs", "Absolute value"), ("floor", "Round down"),
+                    ("ceil", "Round up"), ("ceiling", "Round up"),
+                    ("sign", "Signum"), ("trunc", "Truncate toward zero")):
+        register(n, "scalar", desc, (1, 1), _build_unary_numeric)
+    register("atan2", "scalar", "Arc tangent of y/x", (2, 2), _build_atan2)
+    register("mod", "scalar", "Modulus (remainder)", (2, 2), _build_mod)
+    register("pi", "scalar", "The constant pi", (0, 0), _build_pi)
+    register("width_bucket", "scalar",
+             "Bucket index in an equi-width histogram", (4, 4),
+             _build_width_bucket)
+
+    register("nullif", "scalar", "NULL when both arguments are equal", (2, 2),
+             _build_nullif)
+    register("if", "scalar", "Conditional value", (2, 3), _build_if)
+    register("coalesce", "scalar", "First non-null argument", (1, None),
+             _build_variadic_super)
+    register("greatest", "scalar", "Largest argument", (1, None),
+             _build_variadic_super)
+    register("least", "scalar", "Smallest argument", (1, None),
+             _build_variadic_super)
+    register("typeof", "scalar", "Type of the argument as varchar", (1, 1),
+             _build_typeof)
+
+    for n in ("year", "quarter", "month", "day", "day_of_week", "dow",
+              "day_of_year", "doy"):
+        register(n, "scalar", f"Extract {_EXTRACT_ALIASES.get(n, n)} from a date",
+                 (1, 1), _build_extract_part)
+    register("date_trunc", "scalar", "Truncate a date to a unit", (2, 2),
+             _build_date_trunc)
+    register("current_date", "scalar", "Current date (at plan time)", (0, 0),
+             _build_current_date)
+    register("date_add", "scalar", "Add N units to a date", (3, 3),
+             _build_date_arith)
+    register("date_diff", "scalar", "Difference between dates in units",
+             (3, 3), _build_date_arith)
+
+    register("regexp_like", "scalar",
+             "Regex match (dictionary-domain LUT)", (2, 2), _build_regexp_like)
+    register("starts_with", "scalar",
+             "Prefix test (dictionary-domain LUT)", (2, 2), _build_starts_with)
+    register("split_part", "scalar",
+             "N-th field of a delimited string", (3, 3), _build_split_part)
+    register("codepoint", "scalar", "Code point of a literal character",
+             (1, 1), _build_codepoint)
+    register("chr", "scalar", "Character for a literal code point", (1, 1),
+             _build_chr)
+    register("strpos", "scalar", "Position of a literal substring", (2, 2),
+             _build_strpos)
+    register("replace", "scalar", "Replace a literal substring", (2, 3),
+             _build_replace)
+    register("lpad", "scalar", "Left-pad to a literal size", (2, 3),
+             _build_pad)
+    register("rpad", "scalar", "Right-pad to a literal size", (2, 3),
+             _build_pad)
+    register("left", "scalar", "Leading characters (literal count)", (2, 2),
+             _build_left_right)
+    register("right", "scalar", "Trailing characters (literal count)", (2, 2),
+             _build_left_right)
+    register("substring", "scalar",
+             "Substring at literal start/length", (2, 3), _build_substring)
+    register("substr", "scalar", "Alias of substring", (2, 3),
+             _build_substring)
+    register("concat", "scalar",
+             "Concatenate one string column with literals", (1, None),
+             _build_concat)
+
+
+_register_migrated_families()
+
+
 _LEGACY_REGISTERED = False
 
 
 def ensure_legacy_registered() -> None:
-    """Metadata-only catalog entries for functions still translated by the
-    planner's legacy if-chain — SHOW FUNCTIONS reads ONE registry either way.
-    Lazy (called from the SHOW surface) to avoid a frontend import cycle."""
+    """Catalog entries for callables that are NOT FuncCall-dispatched —
+    aggregates, window functions, collection functions, and the parser-level
+    structural forms (CAST/TRY_CAST/EXTRACT are AST nodes, not function
+    calls).  Everything else in SHOW FUNCTIONS is builder-backed.  Lazy
+    (called from the SHOW surface) to avoid a frontend import cycle."""
     global _LEGACY_REGISTERED
     if _LEGACY_REGISTERED:
         return
@@ -285,12 +647,5 @@ def ensure_legacy_registered() -> None:
     meta(F.AGG_FUNCS, "aggregate", "Aggregate function")
     meta(F.Planner.WINDOW_FUNCS, "window", "Window function")
     meta(F.Planner._COLLECTION_FUNCS, "collection", "Array/map/row function")
-    meta(("abs", "round", "ceil", "ceiling", "floor", "sign", "trunc", "power",
-          "pow", "mod"), "scalar", "Numeric function")
-    meta(("substring", "length", "concat", "strpos", "replace", "split_part",
-          "regexp_like", "codepoint", "chr", "left", "right"), "scalar",
-         "String function")
-    meta(("coalesce", "nullif", "if", "greatest", "least", "try_cast", "cast",
-          "typeof"), "scalar", "Conditional/conversion function")
-    meta(("extract", "date_add", "date_diff", "year", "month", "day"),
-         "scalar", "Date/time function")
+    meta(("cast", "try_cast", "extract"), "scalar",
+         "Structural form (dedicated syntax)")
