@@ -103,9 +103,9 @@ class Planner:
                 node = P.Sort(node, tuple(keys))
             if q.limit is not None:
                 node = P.Limit(node, q.limit)
-            from .optimizer import prune_columns
+            from .rules import optimize_plan
 
-            return prune_columns(P.Output(node, tuple(out_names)))
+            return optimize_plan(P.Output(node, tuple(out_names)))
         finally:
             self.ctes = saved
 

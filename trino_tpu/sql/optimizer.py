@@ -176,11 +176,6 @@ def _closed(child, req: set):
 
 
 def _replace_children(node: P.PlanNode, new_kids: tuple) -> P.PlanNode:
-    if isinstance(node, (P.Filter, P.Project, P.Aggregate, P.Sort, P.Limit,
-                         P.Window, P.Output)):
-        return dataclasses.replace(node, child=new_kids[0])
-    if isinstance(node, P.Join):
-        return dataclasses.replace(node, left=new_kids[0], right=new_kids[1])
-    if isinstance(node, P.Union):
-        return dataclasses.replace(node, inputs=tuple(new_kids))
-    return node
+    from .rules import _replace_children as shared
+
+    return shared(node, new_kids)

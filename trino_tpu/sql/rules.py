@@ -1,0 +1,390 @@
+"""Iterative rule-based optimizer over a Memo.
+
+Reference architecture: sql/planner/iterative/IterativeOptimizer.java:66 runs a
+rule set to FIXPOINT over a Memo (iterative/Memo.java:64) — each plan node
+lives in a GROUP whose children are group references, so a rule rewrite
+replaces one group's content without copying the whole tree, and the rules
+pattern-match through a Lookup that resolves group references on demand
+(iterative/Lookup.java, lib/trino-matching patterns).
+
+TPU translation: identical control plane, minimal surface.  Rules here are
+the rewrites whose payoff on this engine is real kernel time: merged filters
+fuse into one predicate evaluation, limit-zero short-circuits whole
+pipelines, redundant sorts skip device lexsorts (sorts are blocking
+materializations on this executor), identity projects remove a fused-map
+layer, and join-key filter inference cuts scatter lanes on the other side of
+an exchange before the join runs.  Global passes that need whole-tree channel
+bookkeeping (column pruning, optimizer.py) stay plan-level passes, the
+reference's PlanOptimizer-vs-Rule split.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Optional
+
+from ..page import Schema
+from . import ir
+from . import plan as P
+from ..types import BOOLEAN
+
+__all__ = ["Memo", "GroupRef", "Rule", "IterativeOptimizer", "DEFAULT_RULES",
+           "optimize_plan"]
+
+
+# ---------------------------------------------------------------------------- memo
+@dataclasses.dataclass(frozen=True)
+class GroupRef(P.PlanNode):
+    """Placeholder child pointing at a memo group (reference:
+    iterative/GroupReference.java)."""
+
+    group_id: int
+    schema: Schema
+
+    @property
+    def children(self):
+        return ()
+
+
+def _replace_children(node: P.PlanNode, kids: tuple) -> P.PlanNode:
+    """Rebuild ``node`` with new children (schema-preserving)."""
+    if not node.children:
+        return node
+    if isinstance(node, P.Join):
+        return dataclasses.replace(node, left=kids[0], right=kids[1])
+    if isinstance(node, P.Union):
+        return dataclasses.replace(node, inputs=tuple(kids))
+    return dataclasses.replace(node, child=kids[0])
+
+
+class Memo:
+    """Groups of plan nodes; children stored as GroupRefs (Memo.java:64)."""
+
+    def __init__(self, root: P.PlanNode):
+        self._ids = itertools.count()
+        self.groups: dict[int, P.PlanNode] = {}
+        self.root_group = self._insert(root)
+
+    def _insert(self, node: P.PlanNode) -> int:
+        gid = next(self._ids)
+        kids = tuple(GroupRef(self._insert(c), c.schema)
+                     for c in node.children)
+        self.groups[gid] = _replace_children(node, kids)
+        return gid
+
+    def node(self, gid: int) -> P.PlanNode:
+        """Group content, following alias chains (a rule that returns a bare
+        GroupRef — e.g. splicing a child group in place of its parent —
+        aliases the group)."""
+        n = self.groups[gid]
+        while isinstance(n, GroupRef):
+            n = self.groups[n.group_id]
+        return n
+
+    def resolve(self, node: P.PlanNode) -> P.PlanNode:
+        """Lookup: a GroupRef becomes its group's node (children stay refs) —
+        rules use this for depth-2 patterns (Lookup.java)."""
+        if isinstance(node, GroupRef):
+            return self.node(node.group_id)
+        return node
+
+    def replace(self, gid: int, new_node: P.PlanNode) -> None:
+        """Swap a group's content.  Concrete children of the replacement are
+        inserted as fresh groups; GroupRef children are kept (so a rule can
+        splice existing groups into the new shape)."""
+        if isinstance(new_node, GroupRef):
+            self.groups[gid] = new_node  # alias; node() follows the chain
+            return
+        kids = tuple(c if isinstance(c, GroupRef)
+                     else GroupRef(self._insert(c), c.schema)
+                     for c in new_node.children)
+        self.groups[gid] = _replace_children(new_node, kids)
+
+    def extract(self, gid: Optional[int] = None) -> P.PlanNode:
+        """Rebuild the concrete plan from the memo."""
+        node = self.node(self.root_group if gid is None else gid)
+        kids = tuple(self.extract(c.group_id) if isinstance(c, GroupRef)
+                     else c for c in node.children)
+        return _replace_children(node, kids)
+
+
+# ---------------------------------------------------------------------------- rule protocol
+class Rule:
+    """Pattern-matched rewrite (reference: iterative/Rule.java + the
+    lib/trino-matching Pattern).  ``pattern`` is the node class(es) the rule
+    roots at; ``apply`` returns a replacement node (whose children may be the
+    matched node's GroupRefs, or fresh concrete subtrees) or None."""
+
+    pattern: tuple = (P.PlanNode,)
+
+    def apply(self, node: P.PlanNode, memo: Memo) -> Optional[P.PlanNode]:
+        raise NotImplementedError
+
+
+class IterativeOptimizer:
+    """Run rules to fixpoint over the memo (IterativeOptimizer.java:66
+    exploreGroup/exploreNode: re-explore a group until no rule fires, then its
+    children; re-explore the parent when a child changed)."""
+
+    def __init__(self, rules: tuple, max_iterations: int = 10_000):
+        self.rules = tuple(rules)
+        self.max_iterations = max_iterations
+
+    def run(self, plan: P.PlanNode) -> P.PlanNode:
+        memo = Memo(plan)
+        self._budget = self.max_iterations
+        self._explore_group(memo, memo.root_group)
+        return memo.extract()
+
+    def _explore_group(self, memo: Memo, gid: int) -> bool:
+        progress = self._explore_node(memo, gid)
+        done = False
+        while not done:
+            done = True
+            if self._explore_children(memo, gid):
+                progress = True
+                # a child rewrite can expose a new match at this node
+                if self._explore_node(memo, gid):
+                    done = False
+        return progress
+
+    def _explore_node(self, memo: Memo, gid: int) -> bool:
+        progress = False
+        fired = True
+        while fired:
+            fired = False
+            node = memo.node(gid)
+            for rule in self.rules:
+                if not isinstance(node, tuple(rule.pattern)):
+                    continue
+                if self._budget <= 0:
+                    return progress
+                self._budget -= 1
+                out = rule.apply(node, memo)
+                if out is not None:
+                    memo.replace(gid, out)
+                    node = memo.node(gid)
+                    fired = progress = True
+        return progress
+
+    def _explore_children(self, memo: Memo, gid: int) -> bool:
+        progress = False
+        for c in memo.node(gid).children:
+            if isinstance(c, GroupRef) and self._explore_group(memo, c.group_id):
+                progress = True
+        return progress
+
+
+# ---------------------------------------------------------------------------- helpers
+def _conjuncts(e: ir.Expr) -> list:
+    if isinstance(e, ir.Call) and e.op == "and":
+        return [c for a in e.args for c in _conjuncts(a)]
+    return [e]
+
+
+def _and_all(conjuncts) -> ir.Expr:
+    out = conjuncts[0]
+    for c in conjuncts[1:]:
+        out = ir.Call("and", (out, c), BOOLEAN)
+    return out
+
+
+_CMP_OPS = ("eq", "lt", "lte", "gt", "gte")
+
+
+def _key_comparison(conjunct, key_channels: tuple):
+    """-> (key_position, op, constant) when the conjunct is a comparison of a
+    single join-key channel against a PYTHON-SCALAR constant (LUT/array
+    constants and string dictionary ids are side-local and must not cross)."""
+    if not (isinstance(conjunct, ir.Call) and conjunct.op in _CMP_OPS):
+        return None
+    a, b = conjunct.args
+    flip = {"lt": "gt", "lte": "gte", "gt": "lt", "gte": "lte", "eq": "eq"}
+    if isinstance(a, ir.Constant) and isinstance(b, ir.FieldRef):
+        a, b = b, a
+        op = flip[conjunct.op]
+    elif isinstance(a, ir.FieldRef) and isinstance(b, ir.Constant):
+        op = conjunct.op
+    else:
+        return None
+    if not isinstance(b.value, (int, float, bool)) or a.type.is_string:
+        return None
+    if a.index not in key_channels:
+        return None
+    return key_channels.index(a.index), op, b
+
+
+# ---------------------------------------------------------------------------- rules
+class MergeFilters(Rule):
+    """Filter(Filter(x, p1), p2) -> Filter(x, p1 AND p2) — one fused predicate
+    evaluation (reference: iterative/rule/MergeFilters.java)."""
+
+    pattern = (P.Filter,)
+
+    def apply(self, node, memo):
+        child = memo.resolve(node.child)
+        if not isinstance(child, P.Filter):
+            return None
+        pred = ir.Call("and", (child.predicate, node.predicate), BOOLEAN)
+        return P.Filter(child.child, pred)
+
+
+class MergeLimits(Rule):
+    """Limit(Limit(x, a), b) -> Limit(x, min(a, b)) (reference:
+    iterative/rule/MergeLimits.java)."""
+
+    pattern = (P.Limit,)
+
+    def apply(self, node, memo):
+        child = memo.resolve(node.child)
+        if not isinstance(child, P.Limit):
+            return None
+        return P.Limit(child.child, min(node.count, child.count))
+
+
+class EliminateLimitZero(Rule):
+    """LIMIT 0 -> empty Values: the whole pipeline under it never runs
+    (reference: iterative/rule/EvaluateZeroLimit... -> empty ValuesNode)."""
+
+    pattern = (P.Limit,)
+
+    def apply(self, node, memo):
+        if node.count != 0:
+            return None
+        child = memo.resolve(node.child)
+        if isinstance(child, P.Values) and not child.rows:
+            return None  # already done
+        return P.Values((), node.schema)
+
+
+class RemoveIdentityProject(Rule):
+    """Project that forwards every child channel unchanged -> child
+    (reference: iterative/rule/RemoveRedundantIdentityProjections.java)."""
+
+    pattern = (P.Project,)
+
+    def apply(self, node, memo):
+        child = memo.resolve(node.child)
+        if len(node.exprs) != len(child.schema.fields):
+            return None
+        for i, e in enumerate(node.exprs):
+            if not (isinstance(e, ir.FieldRef) and e.index == i):
+                return None
+        if node.dicts and any(d is not None for d in node.dicts):
+            return None  # projection installs derived dictionaries: load-bearing
+        if tuple(f.type for f in node.schema.fields) != tuple(
+                f.type for f in child.schema.fields):
+            return None
+        if tuple(f.name for f in node.schema.fields) != tuple(
+                f.name for f in child.schema.fields):
+            return None  # renames feed name resolution above (Output hiding)
+        return node.child  # splice the child GROUP, not a copy
+
+
+class EliminateSortUnderOrderDestroyer(Rule):
+    """A Sort feeding a hash aggregation or a hash join input is wasted work:
+    both destroy order, and this executor's sort is a blocking device lexsort
+    (reference: iterative/rule/RemoveRedundantSort... family; SQL makes no
+    ordering guarantee through these operators)."""
+
+    pattern = (P.Aggregate, P.Join)
+
+    def apply(self, node, memo):
+        new_kids = []
+        changed = False
+        for c in node.children:
+            stripped = self._strip_sort(c, memo)
+            if stripped is not None:
+                new_kids.append(stripped)
+                changed = True
+            else:
+                new_kids.append(c)
+        if not changed:
+            return None
+        return _replace_children(node, tuple(new_kids))
+
+    def _strip_sort(self, c, memo):
+        """Remove the topmost Sort reachable through order-transparent unary
+        nodes (Project/Filter — NOT Limit: Limit(Sort) is TopN semantics).
+        Returns the rewritten child, or None when there is nothing to do."""
+        rc = memo.resolve(c)
+        if isinstance(rc, P.Sort):
+            return rc.child  # splice the sort's input group
+        if isinstance(rc, (P.Project, P.Filter)):
+            inner = self._strip_sort(rc.child, memo)
+            if inner is not None:
+                return _replace_children(rc, (inner,))
+        return None
+
+
+class InferJoinSideFilters(Rule):
+    """Transitive filter inference across equi-join keys: a constant
+    comparison on one side's key implies the same comparison on the other
+    side's key (reference: PredicatePushDown's equality-inference via
+    EqualityInference.java — here the memo-rule slice of it).  Cuts the other
+    side's rows BEFORE the join/exchange, which on TPU means fewer scatter
+    lanes and a smaller routed build."""
+
+    pattern = (P.Join,)
+
+    def apply(self, node, memo):
+        if node.kind not in ("inner", "semi"):
+            return None
+        left = memo.resolve(node.left)
+        right = memo.resolve(node.right)
+        out = None
+        inferred_r = self._inferred(left, node.left_keys, node.right_keys,
+                                    right, memo)
+        if inferred_r is not None:
+            out = dataclasses.replace(
+                node, right=P.Filter(node.right, inferred_r))
+        inferred_l = self._inferred(right, node.right_keys, node.left_keys,
+                                    left, memo)
+        if inferred_l is not None:
+            out = dataclasses.replace(
+                out or node, left=P.Filter(node.left, inferred_l))
+        return out
+
+    def _inferred(self, src, src_keys, dst_keys, dst, memo) -> Optional[ir.Expr]:
+        if not isinstance(src, P.Filter):
+            return None
+        # dedup key: (channel, op, constant value) — structural repr would
+        # never match planner-built refs (they carry column names)
+        have = set()
+        n = dst
+        while isinstance(n, P.Filter):
+            for c in _conjuncts(n.predicate):
+                kc = _key_comparison(c, dst_keys)
+                if kc is not None:
+                    have.add((dst_keys[kc[0]], kc[1], kc[2].value))
+            n = memo.resolve(n.child)
+        new = []
+        for c in _conjuncts(src.predicate):
+            kc = _key_comparison(c, src_keys)
+            if kc is None:
+                continue
+            pos, op, const = kc
+            dst_ch = dst_keys[pos]
+            if (dst_ch, op, const.value) in have:
+                continue
+            have.add((dst_ch, op, const.value))
+            dst_type = dst.schema.fields[dst_ch].type
+            new.append(ir.Call(op, (ir.FieldRef(dst_ch, dst_type), const),
+                               BOOLEAN))
+        return _and_all(new) if new else None
+
+
+DEFAULT_RULES = (MergeFilters(), MergeLimits(), EliminateLimitZero(),
+                 RemoveIdentityProject(), EliminateSortUnderOrderDestroyer(),
+                 InferJoinSideFilters())
+
+
+def optimize_plan(root: P.PlanNode) -> P.PlanNode:
+    """The optimizer pipeline: iterative rules to fixpoint, then the global
+    column-pruning pass (reference: PlanOptimizers.java ordering — rule sets
+    first, then passes needing whole-tree bookkeeping)."""
+    from .optimizer import prune_columns
+
+    out = IterativeOptimizer(DEFAULT_RULES).run(root)
+    return prune_columns(out)
