@@ -36,6 +36,7 @@ from jax import shard_map
 from ..ops import hashagg
 from ..ops.exchange import bucketize, exchange_all_to_all, partition_ids
 from ..ops.hashing import EMPTY_KEY, pack_keys
+from ..ops.hashjoin import expand_counts, multi_build, probe_slots
 from ..page import Field, Page, Schema
 from ..parallel.mesh import WORKER_AXIS, worker_mesh
 from ..sql import plan as P
@@ -50,20 +51,40 @@ def _route_rows(cols, nulls, valid, pid, n_parts: int, bucket: int, axis_name):
     """Hash-route one page of rows across the mesh: pack columns + present null
     masks, bucketize by partition id, all_to_all, and re-slot the null masks on
     the receive side.  The one routing protocol both the partitioned-join build
-    and its per-batch probe exchange speak."""
+    and its per-batch probe exchange speak.
+
+    Returns (cols, nulls, valid, overflow): ``overflow`` is this worker's
+    SEND-side drop flag (a partition got more rows than ``bucket``); the stream
+    contract carries it to the driver, which retries at a bigger bucket —
+    exchange backpressure, re-planned as a host-level retry."""
     payload = list(cols)
     null_slots = []
     for ci, nm in enumerate(nulls):
         if nm is not None:
             null_slots.append(ci)
             payload.append(nm)
-    packed, pvalid, _ = bucketize(tuple(payload), valid, pid, n_parts, bucket)
+    packed, pvalid, oflow = bucketize(tuple(payload), valid, pid, n_parts, bucket)
     recv, recv_valid = exchange_all_to_all(packed, pvalid, axis_name, n_parts)
     rcols = list(recv[:len(cols)])
     rnulls = [None] * len(cols)
     for j, ci in enumerate(null_slots):
         rnulls[ci] = recv[len(cols) + j]
-    return rcols, rnulls, recv_valid
+    return rcols, rnulls, recv_valid, oflow
+
+
+def _false(valid):
+    """A worker-VARYING False scalar: under shard_map a fresh constant is
+    unvarying and cannot join varying carries/outputs; deriving from the data
+    inherits the axis."""
+    return jnp.any(valid) & False
+
+
+# (probe_bucket_factor, expand_factor) retry ladder: probe exchange buckets
+# start at ~2n/W (factor 2) instead of the always-safe n, trading a W/2-times
+# smaller receive tensor for a rare retry under hash skew; expansion buckets
+# for multi-match joins grow alongside.  ``None`` = exact (bucket = n, no
+# probe-side overflow possible).
+_EXCHANGE_LADDER = ((2, 4), (4, 8), (None, 16), (None, 64))
 
 __all__ = ["DistributedExecutor"]
 
@@ -126,6 +147,71 @@ def _has_duplicate_keys(build_page: Page, key_channels, key_types) -> bool:
     return len(np.unique(vals)) < n
 
 
+def _multi_probe_expand(node, mt, build_key_types, cols, nulls, valid,
+                        expand_size: int, build_null_stats, semi: bool):
+    """Per-shard multi-match probe: slot-grouped lookup (ops/hashjoin
+    MultiJoinTable — the position-links analog) + searchsorted expansion at a
+    STATIC expansion bucket.  Data-dependent output size cannot sync to the
+    host inside a shard_map step, so a too-small bucket reports overflow
+    through the stream contract instead (driver retries bigger).  Returns
+    (cols, nulls, valid, oflow); traced (runs inside the fragment jit)."""
+    keys = tuple(cols[i] for i in node.left_keys)
+    kvalid = valid
+    for i in node.left_keys:
+        if nulls[i] is not None:
+            kvalid = kvalid & ~nulls[i]
+    slot, matched = probe_slots(mt.table, keys, build_key_types, kvalid)
+    matched = matched & kvalid
+    cnt = jnp.where(matched, mt.counts[slot], 0)
+    if semi and node.filter is None:
+        # existence test only: no expansion needed
+        if node.kind == "semi":
+            out_valid = valid & matched
+        else:
+            out_valid = _null_aware_anti(node, valid & ~matched, nulls,
+                                         *build_null_stats)
+        return tuple(cols), tuple(nulls), out_valid, _false(valid)
+    n = valid.shape[0]
+    if node.kind == "left":
+        out_cnt = jnp.where(valid, jnp.maximum(cnt, 1), 0)
+    else:
+        out_cnt = cnt
+    incl = jnp.cumsum(out_cnt, dtype=jnp.int32)
+    oflow = incl[n - 1] > expand_size
+    pidx, k, in_range = expand_counts(incl, out_cnt, expand_size)
+    is_match = matched[pidx] & (k < cnt[pidx]) & in_range
+    brow = mt.order[jnp.clip(mt.starts[slot[pidx]] + k, 0,
+                             mt.order.shape[0] - 1)]
+    brow = jnp.where(is_match, brow, 0)
+    ocols = tuple(c[pidx] for c in cols) \
+        + tuple(c[brow] for c in mt.build_columns)
+    onulls = tuple(None if nm is None else nm[pidx] for nm in nulls) \
+        + tuple(None if nm is None else nm[brow]
+                for nm in mt.build_null_masks)
+    if node.filter is not None:
+        passed = evaluate_predicate(node.filter, ocols, onulls, is_match)
+    else:
+        passed = is_match
+    if semi:
+        mark = jnp.zeros((n,), jnp.int32).at[pidx].max(
+            passed.astype(jnp.int32)).astype(bool)
+        if node.kind == "semi":
+            out_valid = valid & mark
+        else:
+            out_valid = _null_aware_anti(node, valid & ~mark, nulls,
+                                         *build_null_stats)
+        return tuple(cols), tuple(nulls), out_valid, oflow
+    if node.kind == "left":
+        any_pass = jnp.zeros((n,), jnp.int32).at[pidx].max(
+            passed.astype(jnp.int32)).astype(bool)
+        keep = passed | ((k == 0) & ~any_pass[pidx] & in_range & valid[pidx])
+        onulls = onulls[:len(cols)] + tuple(
+            (jnp.zeros_like(passed) if nm is None else nm) | ~passed
+            for nm in onulls[len(cols):])
+        return ocols, onulls, keep, oflow
+    return ocols, onulls, passed, oflow  # inner
+
+
 @dataclasses.dataclass
 class _DStream:
     """A distributed streaming fragment: per-worker scan source + fused transform."""
@@ -134,7 +220,10 @@ class _DStream:
     dicts: tuple
     scan_lo_batches: list  # list of np.ndarray [n_workers] of per-worker row offsets
     scan_fn: Callable  # (lo_scalar) -> (cols, nulls, valid); traced per worker
-    transform: Callable  # (cols, nulls, valid, aux) -> (cols, nulls, valid)
+    transform: Callable  # (cols, nulls, valid, aux) -> (cols, nulls, valid, oflow)
+    # oflow: per-worker bool scalar — True when an exchange/expansion bucket in
+    # the fragment dropped rows this batch; the consumer retries the whole run
+    # at a bigger bucket (_retry_exchange)
     aux: tuple = ()  # device state (join tables) threaded as a jit ARGUMENT —
     # closed-over constants degrade every later dispatch on tunneled TPUs
     aux_specs: object = PS()  # shard_map in_specs pytree (prefix) for aux:
@@ -155,11 +244,35 @@ class DistributedExecutor:
         # exchange) instead of broadcast (reference: DetermineJoinDistributionType's
         # size-based choice, iterative/rule/DetermineJoinDistributionType.java:51)
         self.partition_threshold = partition_threshold
+        self._probe_factor, self._expand_factor = _EXCHANGE_LADDER[0]
+        # per-execute build artifacts (pages, join tables) keyed by plan-node
+        # id: the retry ladder recompiles only the probe side — build-side
+        # local execution and the build-exchange compile are rung-invariant
+        self._build_cache: dict = {}
 
     # ------------------------------------------------------------------ public
     def execute(self, node: P.PlanNode) -> MaterializedResult:
+        self._build_cache = {}
         page, dicts = self._execute_to_page(node)
         return _materialize(page, dicts)
+
+    # ---------------------------------------------------------------- retries
+    def _retry_exchange(self, run_once):
+        """The overflow side-channel's host half: run a compiled fragment; when
+        any worker reports an exchange/expansion bucket overflow, climb the
+        ladder (bigger buckets) and re-run from scratch — the same
+        grow-and-retry pattern as aggregation capacity growth.  Returns the
+        result, or None when the fragment is not distributable (caller falls
+        back to local)."""
+        for pf, ef in _EXCHANGE_LADDER:
+            self._probe_factor, self._expand_factor = pf, ef
+            out = run_once()
+            if out is None:
+                return None
+            result, oflow = out
+            if not oflow:
+                return result
+        return None  # pathological expansion: let the local executor handle it
 
     # ---------------------------------------------------------------- plan walk
     def _execute_to_page(self, node: P.PlanNode):
@@ -174,16 +287,29 @@ class DistributedExecutor:
                 # TopN over a streamable fragment: per-worker topN + single
                 # ordered merge (reference: TopNOperator per task +
                 # MergeOperator at the gather stage)
-                stream = self._compile_stream(node.child.child)
-                if stream is not None:
+                def once(node=node):
+                    stream = self._compile_stream(node.child.child)
+                    if stream is None:
+                        return None
                     return self._run_topn(stream, node.child.keys, node.count)
+
+                out = self._retry_exchange(once)
+                if out is not None:
+                    return out
             child, dicts = self._execute_to_page(node.child)
             return _limit_page(child, node.count), dicts
         if isinstance(node, P.Aggregate):
             return self._run_aggregate(node)
-        stream = self._compile_stream(node)
-        if stream is not None:
+
+        def once(node=node):
+            stream = self._compile_stream(node)
+            if stream is None:
+                return None
             return self._materialize_dstream(stream)
+
+        out = self._retry_exchange(once)
+        if out is not None:
+            return out
         if isinstance(node, (P.Project, P.Filter)):
             # a Project/Filter ABOVE a blocking operator (post-aggregation
             # projections, HAVING filters) is not part of a scan-fed stream;
@@ -232,7 +358,7 @@ class DistributedExecutor:
                 return cols, nulls, valid
 
             return _DStream(node.schema, dicts, lo_batches, scan_fn,
-                            lambda c, n, v, aux: (c, n, v))
+                            lambda c, n, v, aux: (c, n, v, _false(v)))
 
         if isinstance(node, P.Filter):
             up = self._compile_stream(node.child)
@@ -240,8 +366,8 @@ class DistributedExecutor:
                 return None
 
             def transform(cols, nulls, valid, aux, up=up, pred=node.predicate):
-                cols, nulls, valid = up.transform(cols, nulls, valid, aux)
-                return cols, nulls, evaluate_predicate(pred, cols, nulls, valid)
+                cols, nulls, valid, of = up.transform(cols, nulls, valid, aux)
+                return cols, nulls, evaluate_predicate(pred, cols, nulls, valid), of
 
             return dataclasses.replace(up, transform=transform)
 
@@ -252,9 +378,9 @@ class DistributedExecutor:
             dicts = _resolve_project_dicts(node, up.dicts)
 
             def transform(cols, nulls, valid, aux, up=up, exprs=node.exprs):
-                cols, nulls, valid = up.transform(cols, nulls, valid, aux)
+                cols, nulls, valid, of = up.transform(cols, nulls, valid, aux)
                 vs, ns = _eval_project(exprs, cols, nulls, valid.shape)
-                return vs, ns, valid
+                return vs, ns, valid, of
 
             return _DStream(node.schema, dicts, up.scan_lo_batches, up.scan_fn, transform,
                             aux=up.aux, aux_specs=up.aux_specs)
@@ -263,8 +389,12 @@ class DistributedExecutor:
             up = self._compile_stream(node.left)
             if up is None:
                 return None
-            # build side: local (blocking) execution
-            build_page, build_dicts = self.local._execute_to_page_streamed(node.right)
+            # build side: local (blocking) execution, cached across ladder rungs
+            hit = self._build_cache.get(("page", id(node)))
+            if hit is None:
+                hit = self.local._execute_to_page_streamed(node.right)
+                self._build_cache[("page", id(node))] = hit
+            build_page, build_dicts = hit
             build_key_types = tuple(node.right.schema.fields[i].type for i in node.right_keys)
             if build_page.capacity == 0:
                 # empty build joins flow through the normal probe path against a
@@ -272,10 +402,8 @@ class DistributedExecutor:
                 # keep every probe row (round-1 VERDICT weak #3: this shape
                 # silently fell back to local)
                 build_page = _pad_page(build_page, 16)
-            if _has_duplicate_keys(build_page, node.right_keys, build_key_types):
-                # duplicate build keys need the multi-match strategy, which is
-                # data-dependent-shape -> local fallback for now
-                return None
+            multi = _has_duplicate_keys(build_page, node.right_keys,
+                                        build_key_types)
             # NOT IN 3VL facts, host-side (shared with the local executor's
             # null-aware anti: _build_null_stats / _null_aware_anti)
             build_null_stats = _build_null_stats(build_page, node.right_keys)
@@ -288,9 +416,17 @@ class DistributedExecutor:
                            or (hint != "broadcast"
                                and n_build >= self.partition_threshold))
             if partitioned:
+                if multi:
+                    return self._compile_partitioned_multi_join(
+                        node, up, build_page, build_dicts, build_key_types,
+                        build_null_stats)
                 return self._compile_partitioned_join(node, up, build_page, build_dicts,
                                                       build_key_types,
                                                       build_null_stats)
+            if multi:
+                return self._compile_broadcast_multi_join(
+                    node, up, build_page, build_dicts, build_key_types,
+                    build_null_stats)
             table = self.local._build_join_table(build_page, node.right_keys,
                                                  build_key_types)
             if table is None:
@@ -302,7 +438,7 @@ class DistributedExecutor:
                           build_key_types=build_key_types, semi=semi,
                           build_null_stats=build_null_stats):
                 up_aux, table = aux
-                cols, nulls, valid = up.transform(cols, nulls, valid, up_aux)
+                cols, nulls, valid, of = up.transform(cols, nulls, valid, up_aux)
                 keys = tuple(cols[i] for i in node.left_keys)
                 row_ids, matched = probe(table, keys, build_key_types, valid)
                 for i in node.left_keys:
@@ -321,11 +457,11 @@ class DistributedExecutor:
                 elif node.kind in ("inner", "semi"):
                     valid = valid & matched
                 if semi:
-                    return cols, nulls, valid
+                    return cols, nulls, valid, of
                 bcols, bnulls = _gather_build(table, row_ids, matched, node.kind)
                 out_cols = tuple(cols) + bcols
                 out_nulls = tuple(nulls) + bnulls
-                return out_cols, out_nulls, valid
+                return out_cols, out_nulls, valid, of
 
             dicts = up.dicts if semi else up.dicts + build_dicts
             return _DStream(node.schema, dicts, up.scan_lo_batches, up.scan_fn, transform,
@@ -348,46 +484,9 @@ class DistributedExecutor:
         from ..ops.hashjoin import build_insert, build_table_init, probe
 
         W = self.n_workers
-        mesh = self.mesh
         semi = node.kind in ("semi", "anti")
-        sharded = NamedSharding(mesh, PS(WORKER_AXIS))
 
-        # shard the materialized build page [W, chunk] across workers
-        n_b = build_page.capacity
-        chunk = max((n_b + W - 1) // W, 4)
-        padded = _pad_page(build_page, W * chunk)
-        bcols_g = tuple(jax.device_put(c.reshape(W, chunk), sharded)
-                        for c in padded.columns)
-        bnull_slots = [ci for ci, m in enumerate(padded.null_masks) if m is not None]
-        bnulls_g = tuple(jax.device_put(padded.null_masks[ci].reshape(W, chunk), sharded)
-                         for ci in bnull_slots)
-        bvalid_g = jax.device_put(padded.valid_mask().reshape(W, chunk), sharded)
-        ncols_b = len(padded.columns)
-
-        def build_exchange(bcols_l, bnulls_l, bvalid_l, cap_r, node=node):
-            """Per-worker: route my build chunk to its hash owners, receive my
-            partition, compact it to cap_r rows, build my table.  Runs inside
-            shard_map.  The receive tensor is transiently [W*chunk] wide, but
-            the RESIDENT state (table + captured build columns) is O(cap_r) ≈
-            O(build/W) per chip — the point of sharding the build."""
-            keys = tuple(bcols_l[ch] for ch in node.right_keys)
-            kvalid = bvalid_l
-            for j, ci in enumerate(bnull_slots):
-                if ci in node.right_keys:
-                    kvalid = kvalid & ~bnulls_l[j]
-            pid = partition_ids(keys, W)
-            full_nulls = [None] * ncols_b
-            for j, ci in enumerate(bnull_slots):
-                full_nulls[ci] = bnulls_l[j]
-            rcols, rnulls, recv_valid = _route_rows(
-                tuple(bcols_l), tuple(full_nulls), kvalid, pid, W, chunk,
-                WORKER_AXIS)
-            n_recv = jnp.sum(recv_valid, dtype=jnp.int32)
-            ccols, cnulls = _compact_part(tuple(rcols), tuple(rnulls),
-                                          recv_valid, cap_r)
-            # n_recv derives from the exchanged data, so cvalid already carries
-            # the worker-varying axis
-            cvalid = jnp.arange(cap_r, dtype=jnp.int32) < n_recv
+        def make_table(ccols, cnulls, cvalid, cap_r, n_recv, node=node):
             rpage = Page(node.right.schema, ccols, cnulls, cvalid)
             jt = build_table_init(2 * cap_r, rpage)
             jt = build_insert(jt, tuple(ccols[ch] for ch in node.right_keys),
@@ -395,37 +494,28 @@ class DistributedExecutor:
             # skew overflow: more rows hashed to this worker than cap_r holds
             return dataclasses.replace(jt, overflow=jt.overflow | (n_recv > cap_r))
 
-        # shared static per-worker capacity; grow together on any overflow
-        # (host checks the per-worker flags once per attempt).  Start at ~2x the
-        # balanced share to absorb moderate hash skew without a retry.
-        cap_r = max(1 << max(2 * chunk - 1, 1).bit_length(), 32)
-        while True:
-            fn = partial(build_exchange, cap_r=cap_r)
-            table_g = jax.jit(
-                shard_map(
-                    lambda bc, bn, bv: jax.tree.map(
-                        lambda x: None if x is None else x[None],
-                        fn(tuple(c[0] for c in bc), tuple(m[0] for m in bn), bv[0]),
-                        is_leaf=lambda x: x is None),
-                    mesh=mesh, in_specs=(PS(WORKER_AXIS),) * 3,
-                    out_specs=PS(WORKER_AXIS)))(bcols_g, bnulls_g, bvalid_g)
-            if not bool(np.any(np.asarray(table_g.overflow))):
-                break
-            cap_r *= 4
+        table_g = self._build_cache.get(("ptable", id(node)))
+        if table_g is None:
+            table_g = self._sharded_build_exchange(node, build_page, make_table)
+            self._build_cache[("ptable", id(node))] = table_g
+
+        probe_bucket_of = self._probe_bucket
 
         def transform(cols, nulls, valid, aux, up=up, node=node):
             up_aux, table_g = aux
-            cols, nulls, valid = up.transform(cols, nulls, valid, up_aux)
+            cols, nulls, valid, of = up.transform(cols, nulls, valid, up_aux)
             n = valid.shape[0]
             pkeys = tuple(cols[i] for i in node.left_keys)
             rpid = partition_ids(pkeys, W)
             # NULL probe keys never match but must SURVIVE for left/anti: route them
             # (to their hash bucket) like any other row; matching excludes them below.
-            # bucket = n guarantees no overflow drops at the cost of a W-times padded
-            # receive tensor; an adaptive ~2n/W bucket needs an overflow side-channel
-            # the stream contract doesn't carry yet.
-            rcols, rnulls, recv_valid = _route_rows(tuple(cols), tuple(nulls),
-                                                    valid, rpid, W, n, WORKER_AXIS)
+            # The bucket starts at ~2n/W (a W/2-times smaller receive tensor than
+            # the always-safe n); skewed batches report overflow through the
+            # stream contract and the driver retries bigger (_EXCHANGE_LADDER).
+            rcols, rnulls, recv_valid, r_of = _route_rows(
+                tuple(cols), tuple(nulls), valid, rpid, W,
+                probe_bucket_of(n), WORKER_AXIS)
+            of = of | r_of
             # this worker's table shard arrives as [1, ...] under aux_specs
             jt = jax.tree.map(lambda x: None if x is None else x[0], table_g,
                               is_leaf=lambda x: x is None)
@@ -450,15 +540,182 @@ class DistributedExecutor:
             else:  # left
                 out_valid = recv_valid
             if semi:
-                return tuple(rcols), tuple(rnulls), out_valid
+                return tuple(rcols), tuple(rnulls), out_valid, of
             gcols, gnulls = _gather_build(jt, row_ids, matched, node.kind)
             out_cols = tuple(rcols) + gcols
             out_nulls = tuple(rnulls) + gnulls
-            return (out_cols, out_nulls, out_valid)
+            return (out_cols, out_nulls, out_valid, of)
 
         dicts = up.dicts if semi else up.dicts + build_dicts
         return _DStream(node.schema, dicts, up.scan_lo_batches, up.scan_fn, transform,
                         aux=(up.aux, table_g),
+                        aux_specs=(up.aux_specs, PS(WORKER_AXIS)))
+
+    def _probe_bucket(self, n: int) -> int:
+        """Per-partition probe-exchange bucket for an n-row batch: ~(factor/W)·n
+        on the ladder's adaptive rungs, exact n on the safe rung."""
+        pf = self._probe_factor
+        if pf is None:
+            return n
+        return max(min(n, -(-n * pf // self.n_workers)), 1)
+
+    def _sharded_build_exchange(self, node: P.Join, build_page, make_table):
+        """The partitioned-join build scaffold both table layouts share: shard
+        the materialized build page [W, chunk] across workers; per worker,
+        route the chunk to its hash owners, compact the received partition to
+        cap_r rows, and call ``make_table(ccols, cnulls, cvalid, cap_r,
+        n_recv)`` (traced, per shard) to build that worker's table.  The
+        receive tensor is transiently [W*chunk] wide, but the RESIDENT state
+        is O(cap_r) ≈ O(build/W) per chip — the point of sharding the build.
+        cap_r grows on the host until no worker overflows."""
+        W = self.n_workers
+        mesh = self.mesh
+        sharded = NamedSharding(mesh, PS(WORKER_AXIS))
+        n_b = build_page.capacity
+        chunk = max((n_b + W - 1) // W, 4)
+        padded = _pad_page(build_page, W * chunk)
+        bcols_g = tuple(jax.device_put(c.reshape(W, chunk), sharded)
+                        for c in padded.columns)
+        bnull_slots = [ci for ci, m in enumerate(padded.null_masks)
+                       if m is not None]
+        bnulls_g = tuple(
+            jax.device_put(padded.null_masks[ci].reshape(W, chunk), sharded)
+            for ci in bnull_slots)
+        bvalid_g = jax.device_put(padded.valid_mask().reshape(W, chunk), sharded)
+        ncols_b = len(padded.columns)
+
+        def build_exchange(bcols_l, bnulls_l, bvalid_l, cap_r, node=node):
+            # send bucket = chunk can never overflow: each worker sends at
+            # most its chunk rows in total
+            keys = tuple(bcols_l[ch] for ch in node.right_keys)
+            kvalid = bvalid_l
+            for j, ci in enumerate(bnull_slots):
+                if ci in node.right_keys:
+                    kvalid = kvalid & ~bnulls_l[j]
+            pid = partition_ids(keys, W)
+            full_nulls = [None] * ncols_b
+            for j, ci in enumerate(bnull_slots):
+                full_nulls[ci] = bnulls_l[j]
+            rcols, rnulls, recv_valid, _ = _route_rows(
+                tuple(bcols_l), tuple(full_nulls), kvalid, pid, W, chunk,
+                WORKER_AXIS)
+            n_recv = jnp.sum(recv_valid, dtype=jnp.int32)
+            ccols, cnulls = _compact_part(tuple(rcols), tuple(rnulls),
+                                          recv_valid, cap_r)
+            # n_recv derives from the exchanged data, so cvalid already
+            # carries the worker-varying axis
+            cvalid = jnp.arange(cap_r, dtype=jnp.int32) < n_recv
+            return make_table(ccols, cnulls, cvalid, cap_r, n_recv)
+
+        # shared static per-worker capacity; grow together on any overflow
+        # (host checks the per-worker flags once per attempt).  Start at ~2x
+        # the balanced share to absorb moderate hash skew without a retry.
+        cap_r = max(1 << max(2 * chunk - 1, 1).bit_length(), 32)
+        while True:
+            fn = partial(build_exchange, cap_r=cap_r)
+            table_g = jax.jit(
+                shard_map(
+                    lambda bc, bn, bv: jax.tree.map(
+                        lambda x: None if x is None else x[None],
+                        fn(tuple(c[0] for c in bc), tuple(m[0] for m in bn),
+                           bv[0]),
+                        is_leaf=lambda x: x is None),
+                    mesh=mesh, in_specs=(PS(WORKER_AXIS),) * 3,
+                    out_specs=PS(WORKER_AXIS)))(bcols_g, bnulls_g, bvalid_g)
+            if not bool(np.any(np.asarray(table_g.overflow))):
+                break
+            cap_r *= 4
+        return table_g
+
+    # ---------------------------------------------------------------- multi-match joins
+    def _compile_broadcast_multi_join(self, node: P.Join, up: _DStream,
+                                      build_page, build_dicts, build_key_types,
+                                      build_null_stats) -> _DStream:
+        """Duplicate-key build, replicated: one slot-grouped MultiJoinTable
+        (ops/hashjoin.multi_build — the PositionLinks analog) broadcast to
+        every worker; each worker expands its own probe batch at a static
+        bucket (overflow -> driver retry)."""
+        semi = node.kind in ("semi", "anti")
+        # (no empty-build branch: _has_duplicate_keys needs >= 2 equal-key rows,
+        # and the Join branch pads empty builds before the multi check)
+        mt = self._build_cache.get(("bmtable", id(node)))
+        if mt is None:
+            capacity = max(1 << max(build_page.capacity - 1, 1).bit_length(),
+                           16) * 2
+            mt = multi_build(capacity, build_page, node.right_keys,
+                             build_key_types)
+            self._build_cache[("bmtable", id(node))] = mt
+        ef = self._expand_factor
+
+        def transform(cols, nulls, valid, aux, up=up, node=node, ef=ef,
+                      build_key_types=build_key_types, semi=semi,
+                      build_null_stats=build_null_stats):
+            up_aux, mt = aux
+            cols, nulls, valid, of = up.transform(cols, nulls, valid, up_aux)
+            E = max(ef * valid.shape[0], 1024)
+            ocols, onulls, ovalid, m_of = _multi_probe_expand(
+                node, mt, build_key_types, tuple(cols), tuple(nulls), valid,
+                E, build_null_stats, semi)
+            return ocols, onulls, ovalid, of | m_of
+
+        dicts = up.dicts if semi else up.dicts + build_dicts
+        return _DStream(node.schema, dicts, up.scan_lo_batches, up.scan_fn,
+                        transform, aux=(up.aux, mt),
+                        aux_specs=(up.aux_specs, PS()))
+
+    def _compile_partitioned_multi_join(self, node: P.Join, up: _DStream,
+                                        build_page, build_dicts,
+                                        build_key_types,
+                                        build_null_stats) -> _DStream:
+        """Duplicate-key build, partitioned: the build page routes through the
+        same all-to-all exchange as the unique path, but each worker builds a
+        slot-grouped MultiJoinTable over ITS key partition; probe batches route
+        per batch and expand per shard.  Resident state stays O(build/W) per
+        chip.  (Reference: per-task PositionLinks over the FIXED_HASH
+        exchange, DefaultPagesHash.java:159-197.)"""
+        from ..ops.hashjoin import MultiJoinTable, _multi_build_step
+
+        W = self.n_workers
+        semi = node.kind in ("semi", "anti")
+
+        def make_table(ccols, cnulls, cvalid, cap_r, n_recv, node=node):
+            table0 = jnp.full((2 * cap_r + 1,), EMPTY_KEY, jnp.int64)
+            ckeys = tuple(ccols[ch] for ch in node.right_keys)
+            table, counts, starts, order, boflow = _multi_build_step(
+                table0, ckeys, build_key_types, cvalid)
+            return MultiJoinTable(table, counts, starts, order, ccols, cnulls,
+                                  boflow | (n_recv > cap_r))
+
+        mt_g = self._build_cache.get(("pmtable", id(node)))
+        if mt_g is None:
+            mt_g = self._sharded_build_exchange(node, build_page, make_table)
+            self._build_cache[("pmtable", id(node))] = mt_g
+
+        probe_bucket = self._probe_bucket
+        ef = self._expand_factor
+
+        def transform(cols, nulls, valid, aux, up=up, node=node, ef=ef,
+                      build_key_types=build_key_types, semi=semi,
+                      build_null_stats=build_null_stats):
+            up_aux, mt_g = aux
+            cols, nulls, valid, of = up.transform(cols, nulls, valid, up_aux)
+            n = valid.shape[0]
+            pkeys = tuple(cols[i] for i in node.left_keys)
+            rpid = partition_ids(pkeys, W)
+            rcols, rnulls, recv_valid, r_of = _route_rows(
+                tuple(cols), tuple(nulls), valid, rpid, W, probe_bucket(n),
+                WORKER_AXIS)
+            mt = jax.tree.map(lambda x: None if x is None else x[0], mt_g,
+                              is_leaf=lambda x: x is None)
+            E = max(ef * n, 1024)
+            ocols, onulls, ovalid, m_of = _multi_probe_expand(
+                node, mt, build_key_types, tuple(rcols), tuple(rnulls),
+                recv_valid, E, build_null_stats, semi)
+            return ocols, onulls, ovalid, of | r_of | m_of
+
+        dicts = up.dicts if semi else up.dicts + build_dicts
+        return _DStream(node.schema, dicts, up.scan_lo_batches, up.scan_fn,
+                        transform, aux=(up.aux, mt_g),
                         aux_specs=(up.aux_specs, PS(WORKER_AXIS)))
 
     # ---------------------------------------------------------------- topN
@@ -515,7 +772,8 @@ class DistributedExecutor:
         state_valid = jnp.zeros((W, k), bool)
         state = (jax.device_put(state_cols, sharded),
                  jax.device_put(state_nulls, sharded),
-                 jax.device_put(state_valid, sharded))
+                 jax.device_put(state_valid, sharded),
+                 jax.device_put(jnp.zeros((W,), bool), sharded))  # oflow acc
         luts_t = dict(luts)
 
         @partial(shard_map, mesh=mesh,
@@ -525,8 +783,9 @@ class DistributedExecutor:
             scols = tuple(c[0] for c in state_g[0])
             snulls = tuple(m[0] for m in state_g[1])
             svalid = state_g[2][0]
+            s_of = state_g[3][0]
             cols, nulls, valid = stream.scan_fn(lo_g[0])
-            cols, nulls, valid = stream.transform(cols, nulls, valid, aux)
+            cols, nulls, valid, of = stream.transform(cols, nulls, valid, aux)
             cat_cols = tuple(jnp.concatenate([sc, c.astype(sc.dtype)])
                              for sc, c in zip(scols, cols))
             cat_nulls = tuple(
@@ -536,12 +795,14 @@ class DistributedExecutor:
             idx = topn_select(cat_cols, cat_nulls, cat_valid, luts_t)
             return (tuple(c[idx][None] for c in cat_cols),
                     tuple(m[idx][None] for m in cat_nulls),
-                    cat_valid[idx][None])
+                    cat_valid[idx][None],
+                    (s_of | of)[None])
 
         step = jax.jit(step)
         for lo in stream.scan_lo_batches:
             state = step(state, jax.device_put(lo, sharded), stream.aux, luts_t)
 
+        oflow = bool(np.any(np.asarray(state[3])))
         # host merge: W*k candidate rows -> final top-k (ordered merge stage)
         cols_np = [np.asarray(c).reshape(-1) for c in state[0]]
         nulls_np = [np.asarray(m).reshape(-1) for m in state[1]]
@@ -550,13 +811,22 @@ class DistributedExecutor:
                     tuple(jnp.asarray(c) for c in cols_np),
                     tuple(jnp.asarray(m) if m.any() else None for m in nulls_np),
                     jnp.asarray(valid_np))
-        return _topn_page(page, sort_keys, count, stream.dicts), stream.dicts
+        return (_topn_page(page, sort_keys, count, stream.dicts),
+                stream.dicts), oflow
 
     # ---------------------------------------------------------------- aggregation
     def _run_aggregate(self, node: P.Aggregate):
+        out = self._retry_exchange(lambda: self._run_aggregate_once(node))
+        if out is None:
+            return self.local._run_aggregate(node)
+        return out
+
+    def _run_aggregate_once(self, node: P.Aggregate):
+        """One ladder attempt: returns ((page, dicts), oflow) or None when the
+        child has no distributable scan spine."""
         stream = self._compile_stream(node.child)
         if stream is None:
-            return self.local._run_aggregate(node)
+            return None
         child_schema = stream.schema
         key_types = tuple(child_schema.fields[i].type for i in node.keys)
         if not node.keys:
@@ -577,27 +847,33 @@ class DistributedExecutor:
 
         while True:
             state = self._global_state_init(capacity, key_types, acc_specs)
+            of_acc = jax.device_put(jnp.zeros((W,), bool), sharded)
 
             @partial(shard_map, mesh=mesh,
-                     in_specs=(PS(WORKER_AXIS), PS(WORKER_AXIS), stream.aux_specs),
+                     in_specs=(PS(WORKER_AXIS),) * 2 + (PS(WORKER_AXIS), stream.aux_specs),
                      out_specs=PS(WORKER_AXIS))
-            def step(state_g, lo_g, aux, stream=stream, node=node,
+            def step(state_g, of_g, lo_g, aux, stream=stream, node=node,
                      key_types=key_types, acc_exprs=acc_exprs, acc_kinds=acc_kinds):
                 state = jax.tree.map(lambda x: x[0], state_g,
                                      is_leaf=lambda x: x is None)
                 cols, nulls, valid = stream.scan_fn(lo_g[0])
-                cols, nulls, valid = stream.transform(cols, nulls, valid, aux)
+                cols, nulls, valid, of = stream.transform(cols, nulls, valid, aux)
                 key_vals = tuple(cols[i] for i in node.keys)
                 inputs = [(None, None) if e is None else evaluate(e, cols, nulls)
                           for e in acc_exprs]
                 new = hashagg.groupby_insert(state, key_vals, key_types, valid, inputs,
                                              acc_kinds)
-                return jax.tree.map(lambda x: x[None], new, is_leaf=lambda x: x is None)
+                return (jax.tree.map(lambda x: x[None], new,
+                                     is_leaf=lambda x: x is None),
+                        (of_g[0] | of)[None])
 
             step = jax.jit(step)
             for lo in stream.scan_lo_batches:
-                state = step(state, jax.device_put(lo, sharded), stream.aux)
+                state, of_acc = step(state, of_acc, jax.device_put(lo, sharded),
+                                     stream.aux)
 
+            if bool(np.any(np.asarray(of_acc))):
+                return None, True  # exchange bucket overflow: ladder retry
             merged = self._merge_states(state, key_types, acc_specs, merge_kinds, capacity)
             overflow = bool(np.any(np.asarray(merged.overflow))) or bool(
                 np.any(np.asarray(state.overflow)))
@@ -616,7 +892,7 @@ class DistributedExecutor:
         arrays = [jnp.asarray(c) for c in out_cols]
         page = Page(node.schema, tuple(arrays), tuple(None for _ in arrays), None)
         dicts = tuple(stream.dicts[i] for i in node.keys) + tuple(None for _ in node.aggs)
-        return page, dicts
+        return (page, dicts), False
 
     def _global_state_init(self, capacity, key_types, acc_specs) -> hashagg.GroupByState:
         """[n_workers, ...] sharded state with identical empty contents per worker."""
@@ -678,16 +954,17 @@ class DistributedExecutor:
                                 if k in ("min", "max") else (init or 0), dt)[None], (W,)),
                 sharded)
             for (dt, init), k in zip(acc_specs, acc_kinds)
-        )
+        ) + (jax.device_put(jnp.zeros((W,), bool), sharded),)  # oflow acc
 
         @partial(shard_map, mesh=mesh,
                  in_specs=(PS(WORKER_AXIS), PS(WORKER_AXIS), stream.aux_specs),
                  out_specs=PS(WORKER_AXIS))
         def step(state_g, lo_g, aux, stream=stream, acc_exprs=acc_exprs,
                  acc_kinds=acc_kinds):
-            st = tuple(s[0] for s in state_g)
+            st = tuple(s[0] for s in state_g[:-1])
+            s_of = state_g[-1][0]
             cols, nulls, valid = stream.scan_fn(lo_g[0])
-            cols, nulls, valid = stream.transform(cols, nulls, valid, aux)
+            cols, nulls, valid, of = stream.transform(cols, nulls, valid, aux)
             out = []
             for s, e, kind in zip(st, acc_exprs, acc_kinds):
                 if kind == "count_star":
@@ -703,15 +980,17 @@ class DistributedExecutor:
                     out.append(jnp.minimum(s, jnp.min(jnp.where(mask, v, hashagg._extreme(s.dtype, 1)))))
                 elif kind == "max":
                     out.append(jnp.maximum(s, jnp.max(jnp.where(mask, v, hashagg._extreme(s.dtype, -1)))))
-            return tuple(o[None] for o in out)
+            return tuple(o[None] for o in out) + ((s_of | of)[None],)
 
         step = jax.jit(step)
         for lo in stream.scan_lo_batches:
             state = step(state, jax.device_put(lo, sharded), stream.aux)
 
+        if bool(np.any(np.asarray(state[-1]))):
+            return None, True  # exchange bucket overflow: ladder retry
         # cross-worker combine on host (W scalars)
         finals = []
-        for s, kind in zip(state, acc_kinds):
+        for s, kind in zip(state[:-1], acc_kinds):
             v = np.asarray(s)
             if kind in ("sum", "count", "count_star"):
                 finals.append(v.sum(axis=0, keepdims=False)[None] if v.ndim == 0 else
@@ -723,7 +1002,7 @@ class DistributedExecutor:
         out_cols = _finalize_aggs(node.aggs, finals, 1)
         arrays = [jnp.asarray(c) for c in out_cols]
         page = Page(node.schema, tuple(arrays), tuple(None for _ in arrays), None)
-        return page, tuple(None for _ in node.aggs)
+        return (page, tuple(None for _ in node.aggs)), False
 
     # ---------------------------------------------------------------- materialize
     def _materialize_dstream(self, stream: _DStream):
@@ -735,16 +1014,20 @@ class DistributedExecutor:
                  out_specs=PS(WORKER_AXIS))
         def run(lo_g, aux, stream=stream):
             cols, nulls, valid = stream.scan_fn(lo_g[0])
-            cols, nulls, valid = stream.transform(cols, nulls, valid, aux)
+            cols, nulls, valid, of = stream.transform(cols, nulls, valid, aux)
             nulls = tuple(jnp.zeros(c.shape, bool) if n is None else n
                           for c, n in zip(cols, nulls))
             return (tuple(c[None] for c in cols), tuple(n[None] for n in nulls),
-                    valid[None])
+                    valid[None], of[None])
 
         run = jax.jit(run)
         parts_cols, parts_nulls, parts_valid = [], [], []
+        oflow = False
         for lo in stream.scan_lo_batches:
-            cols, nulls, valid = run(jax.device_put(lo, sharded), stream.aux)
+            cols, nulls, valid, of = run(jax.device_put(lo, sharded), stream.aux)
+            oflow = oflow or bool(np.any(np.asarray(of)))
+            if oflow:
+                return None, True  # exchange bucket overflow: ladder retry
             v = np.asarray(valid).reshape(-1)
             parts_valid.append(v)
             parts_cols.append([np.asarray(c).reshape(-1)[v] for c in cols])
@@ -755,4 +1038,4 @@ class DistributedExecutor:
         nulls_np = [np.concatenate([p[i] for p in parts_nulls]) for i in range(ncols)]
         nulls = tuple(jnp.asarray(n) if n.any() else None for n in nulls_np)
         page = Page(stream.schema, cols, nulls, None)
-        return page, stream.dicts
+        return (page, stream.dicts), False
