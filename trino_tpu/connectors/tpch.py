@@ -45,10 +45,16 @@ class Dictionary:
 
     values: Optional[np.ndarray] = None  # small enum dictionaries
     formatter: Optional[Callable[[np.ndarray], np.ndarray]] = None  # key-derived names
+    # printf-style key-derived names ("Customer#%09d"): equivalent to a
+    # formatter but PICKLABLE, so fragment outputs can ship dictionaries
+    # across worker processes
+    pattern: Optional[str] = None
 
     def decode(self, ids: np.ndarray) -> np.ndarray:
         if self.values is not None:
             return self.values[ids]
+        if self.pattern is not None:
+            return np.char.mod(self.pattern, ids)
         return self.formatter(ids)
 
     def lookup(self, s: str) -> int:
@@ -81,7 +87,7 @@ def _enum(*vals):
 
 
 def _fmt(pattern):
-    return Dictionary(formatter=lambda ids: np.char.mod(pattern, ids))
+    return Dictionary(pattern=pattern)
 
 
 SEGMENTS = _enum("AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD")

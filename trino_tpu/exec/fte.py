@@ -35,7 +35,8 @@ import numpy as np
 from ..ops import hashagg
 from ..page import Page
 from ..sql import plan as P
-from .local_executor import LocalExecutor, _finalize_aggs, _host, _materialize
+from .local_executor import (LocalExecutor, _accumulators_for, _finalize_aggs,
+                             _host, _materialize)
 
 __all__ = ["FailureInjector", "InjectedFailure", "SpoolingExchange",
            "FaultTolerantExecutor", "serialize_page", "deserialize_page",
@@ -67,6 +68,32 @@ def serialize_page(columns: list, null_masks: list, compress: bool = True) -> by
     head = _MAGIC + bytes([codec]) + crc.to_bytes(4, "little") \
         + len(payload).to_bytes(8, "little")
     return head + payload
+
+
+def serialize_fragment_output(cols, nulls, dicts) -> bytes:
+    """Fragment output envelope: framed page + pickled output dictionaries
+    (string columns are dictionary ids on the wire; the consumer needs the
+    id->value mapping the producing plan derived).  The pickle rides the
+    HMAC-authenticated internal channel / trusted spool directory only."""
+    import pickle
+
+    return serialize_page(cols, nulls) + pickle.dumps(dicts)
+
+
+def _split_envelope(data: bytes):
+    """-> (framed_page_bytes, tail) using the frame header's payload length —
+    the ONE place that knows the envelope layout."""
+    length = int.from_bytes(data[9:17], "little")
+    return data[:17 + length], data[17 + length:]
+
+
+def deserialize_fragment_output(data: bytes):
+    """-> (columns, null_masks, dicts)."""
+    import pickle
+
+    frame, tail = _split_envelope(data)
+    cols, nulls = deserialize_page(frame)
+    return cols, nulls, pickle.loads(tail)
 
 
 def deserialize_page(data: bytes):
@@ -410,15 +437,170 @@ def run_partial_aggregate_splits(node, stream, key_types, acc_specs, step,
 
 def run_partial_aggregate(local: LocalExecutor, node, splits) -> bytes:
     """Worker entry: compile the aggregation on this process's executor and run
-    the partial task over ``splits``."""
+    the partial task over ``splits``; the output envelope carries the group
+    keys' dictionaries so the coordinator can merge without compiling the
+    child stream itself."""
+    import pickle
+
     stream, key_types, acc_specs, _, _, step = local._agg_compiled(node)
-    return run_partial_aggregate_splits(node, stream, key_types, acc_specs, step,
-                                        splits)
+    data = run_partial_aggregate_splits(node, stream, key_types, acc_specs,
+                                        step, splits)
+    key_dicts = tuple(stream.dicts[i] for i in node.keys)
+    return data + pickle.dumps(key_dicts)
+
+
+# -- generic fragment task bodies (cluster plane) -------------------------------
+def read_fragment_outputs(exchange: SpoolingExchange, task_ids, schema):
+    """Concatenate the spooled outputs of a fragment's tasks into one override
+    page (the ExchangeOperator's gather, filesystem edition).  An empty
+    task set (zero-split source) yields an empty page."""
+    ncols = len(schema.fields)
+    if not task_ids:
+        cols = tuple(jnp.asarray(
+            np.empty((0,), np.dtype(f.type.dtype))) for f in schema.fields)
+        return (Page(schema, cols, tuple(None for _ in cols), None),
+                tuple(None for _ in range(ncols)))
+    parts = [deserialize_fragment_output(exchange.read(t)) for t in task_ids]
+    cols, nulls = [], []
+    for i in range(ncols):
+        cols.append(np.concatenate([p[0][i] for p in parts]))
+        ms = [p[1][i] for p in parts]
+        if all(m is None for m in ms):
+            nulls.append(None)
+        else:
+            nulls.append(np.concatenate(
+                [m if m is not None else np.zeros(p[0][i].shape[0], bool)
+                 for m, p in zip(ms, parts)]))
+    page = Page(schema,
+                tuple(jnp.asarray(c) for c in cols),
+                tuple(None if m is None else jnp.asarray(m) for m in nulls),
+                None)
+    return page, parts[0][2]
+
+
+def resolve_remote_sources(exchange_dir: str, node) -> dict:
+    """Overrides for every RemoteSource in the subtree: each one's task outputs
+    are read from the spool and concatenated (reference: ExchangeOperator
+    reading the source stage's spooled output)."""
+    from ..sql.plan import RemoteSource
+
+    overrides = {}
+
+    def walk(n):
+        if isinstance(n, RemoteSource):
+            ex = SpoolingExchange(exchange_dir)
+            overrides[id(n)] = read_fragment_outputs(ex, n.task_ids, n.schema)
+        for c in n.children:
+            walk(c)
+
+    walk(node)
+    return overrides
+
+
+def run_fragment(local: LocalExecutor, node, exchange_dir: str) -> bytes:
+    """Worker entry: execute a generic blocking fragment (sort, window, join,
+    non-scan-fed aggregate...) whose RemoteSource leaves resolve from the
+    spool; returns the serialized output envelope.  Caller holds the worker's
+    execution lock (overrides are executor-global)."""
+    from .local_executor import _host_page
+
+    saved = local._overrides
+    local._overrides = resolve_remote_sources(exchange_dir, node)
+    try:
+        page, dicts = local._execute_to_page(node)
+    finally:
+        local._overrides = saved
+    valid, pcols, pnulls = _host_page(page)
+    cols = [c[valid] for c in pcols]
+    nulls = [None if (n is None or not n[valid].any()) else n[valid]
+             for n in pnulls]
+    return serialize_fragment_output(cols, nulls, dicts)
+
+
+def run_stream_splits(local: LocalExecutor, node, exchange_dir: str,
+                      splits) -> bytes:
+    """Worker entry: run a STREAMING fragment (a join's probe pipeline) over a
+    subset of its scan splits — the probe-side task shape (reference: one
+    HttpRemoteTask per split batch through the fragment's pipeline).  Build
+    sides execute on this worker; spooled children resolve via overrides."""
+    saved = local._overrides
+    local._overrides = resolve_remote_sources(exchange_dir, node)
+    try:
+        stream = local._compile_stream(node)
+        si = stream.scan_info
+        jitted = stream.jitted()
+        parts = []
+        for split in splits:
+            page = si.conn.generate(split, list(si.scan_columns))
+            cols, nulls, valid = jitted(page)
+            got = _host([valid] + list(cols)
+                        + [n for n in nulls if n is not None])
+            v = got[0]
+            ncols = len(cols)
+            ccols = [c[v] for c in got[1:1 + ncols]]
+            rest = got[1 + ncols:]
+            cnulls = []
+            for n in nulls:
+                cnulls.append(None if n is None else rest.pop(0)[v])
+            parts.append((ccols, cnulls))
+        dicts = stream.dicts
+    finally:
+        local._overrides = saved
+    ncols = len(stream.schema.fields)
+    cols, nulls = [], []
+    for i in range(ncols):
+        cols.append(np.concatenate([p[0][i] for p in parts]) if parts
+                    else np.empty((0,), np.dtype(stream.schema.fields[i].type.dtype)))
+        ms = [p[1][i] for p in parts]
+        if not parts or all(m is None for m in ms):
+            nulls.append(None)
+        else:
+            nulls.append(np.concatenate(
+                [m if m is not None else np.zeros(p[0][i].shape[0], bool)
+                 for m, p in zip(ms, parts)]))
+    nulls = [None if (m is None or not m.any()) else m for m in nulls]
+    return serialize_fragment_output(cols, nulls, dicts)
+
+
+def merge_partial_outputs(node, payloads):
+    """Final aggregation over partial-output ENVELOPES (coordinator side):
+    merge configuration derives from the plan alone — key types from the
+    child schema, accumulators from the agg specs, key dictionaries from the
+    producing workers' envelopes — so the coordinator never compiles the
+    child stream (which would build join tables locally just to merge)."""
+    import pickle
+
+    key_types = tuple(node.child.schema.fields[i].type for i in node.keys)
+    acc_specs, acc_kinds = [], []
+    for spec in node.aggs:
+        for kind, dtype, init in _accumulators_for(spec):
+            acc_specs.append((dtype, init))
+            acc_kinds.append(kind)
+    key_dicts = None
+    pages = []
+    for data in payloads:
+        frame, tail = _split_envelope(data)
+        pages.append(frame)
+        if key_dicts is None:
+            key_dicts = pickle.loads(tail)
+    page, _ = _merge_partial_cols(node, key_types, acc_specs, acc_kinds, pages)
+    dicts = tuple(key_dicts or (None,) * len(node.keys)) \
+        + tuple(None for _ in node.aggs)
+    return page, dicts
 
 
 def merge_partial_pages(node, stream, key_types, acc_specs, acc_kinds,
                         payloads):
     """Final aggregation over serialized partial pages (coordinator side)."""
+    page, _ = _merge_partial_cols(node, key_types, acc_specs, acc_kinds,
+                                  payloads)
+    dicts = tuple(stream.dicts[i] for i in node.keys) \
+        + tuple(None for _ in node.aggs)
+    return page, dicts
+
+
+def _merge_partial_cols(node, key_types, acc_specs, acc_kinds, payloads):
+    """Shared final-aggregation merge over framed partial pages."""
     merge_kinds = [_MERGE_KIND[k] for k in acc_kinds]
     nk = len(node.keys)
     capacity = 1 << 16
@@ -452,6 +634,4 @@ def merge_partial_pages(node, stream, key_types, acc_specs, acc_kinds,
     out_nulls = tuple(kn if kn.any() else None for kn in key_null_cols) \
         + tuple(None for _ in node.aggs)
     page = Page(node.schema, tuple(arrays), out_nulls, None)
-    dicts = tuple(stream.dicts[i] for i in node.keys) \
-        + tuple(None for _ in node.aggs)
-    return page, dicts
+    return page, None

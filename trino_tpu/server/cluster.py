@@ -53,7 +53,10 @@ import jax  # noqa: E402
 jax.config.update("jax_enable_x64", True)
 
 from ..exec.fte import (SpoolingExchange, is_retryable_failure,
-                        merge_partial_pages, run_partial_aggregate)
+                        merge_partial_outputs, read_fragment_outputs,
+                        resolve_remote_sources, run_fragment,
+                        run_partial_aggregate, run_stream_splits,
+                        serialize_fragment_output)
 from ..exec.local_executor import LocalExecutor, _materialize
 from ..sql import plan as P
 
@@ -147,6 +150,7 @@ class WorkerServer:
         self.max_task_states = 256
         self._wlock = threading.Lock()  # handler threads + task threads share
         # the registries; eviction must also never drop state still in use
+        self._exec_lock = threading.Lock()  # one fragment executes at a time
         self._running_frags: dict = {}  # fragment_id -> running task count
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._stop = threading.Event()
@@ -270,8 +274,30 @@ class WorkerServer:
 
         def run():
             try:
-                data = run_partial_aggregate(self.local, node, req["splits"])
-                SpoolingExchange(req["exchange_dir"]).commit(
+                kind = req.get("kind", "partial_agg")
+                xdir = req["exchange_dir"]
+                # overrides are executor-global: one fragment executes at a
+                # time per worker (the reference serializes differently —
+                # task-local state — but one accelerator per worker makes
+                # serial execution the right default here anyway)
+                with self._exec_lock:
+                    if kind == "partial_agg":
+                        saved = self.local._overrides
+                        self.local._overrides = resolve_remote_sources(
+                            xdir, node)
+                        try:
+                            data = run_partial_aggregate(self.local, node,
+                                                         req["splits"])
+                        finally:
+                            self.local._overrides = saved
+                    elif kind == "stream_splits":
+                        data = run_stream_splits(self.local, node, xdir,
+                                                 req["splits"])
+                    elif kind == "fragment":
+                        data = run_fragment(self.local, node, xdir)
+                    else:
+                        raise ValueError(f"unknown task kind {kind!r}")
+                SpoolingExchange(xdir).commit(
                     req["task_id"], req.get("attempt", 0), data)
                 st.state = "done"
             except Exception as e:
@@ -329,6 +355,7 @@ class ClusterCoordinator:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
+        self._query_lock = threading.Lock()  # one distributed query at a time
         self._exchange_seq = 0
         # long-lived executor + sql->plan cache: repeated queries reuse one
         # plan object, so the id(node)-keyed compiled-pipeline caches hit
@@ -442,25 +469,217 @@ class ClusterCoordinator:
         raise TimeoutError(f"{n} workers not registered within {timeout}s")
 
     # -- distributed query -------------------------------------------------------
+    # fragment roots (the FTE decomposition, SURVEY §3.5): every blocking node
+    # runs as remote task(s) whose inputs are replayable — leaf scans from
+    # splits, interior fragments from their children's spooled outputs
+    _FRAGMENT_NODES = (P.Aggregate, P.Join, P.Window, P.Sort, P.Unnest)
+
     def execute_sql(self, sql: str, session=None):
-        """Plan on the coordinator; dispatch the scan-fed aggregation fragment
-        as remote tasks across live workers; merge spooled partials; run the
+        """Plan on the coordinator; schedule EVERY blocking fragment as remote
+        tasks across live workers (scan-fed aggregates and join probes fan out
+        by split batches; other fragments run as single tasks), with the
+        spooled filesystem exchange between fragments; finish the streaming
         remainder locally (reference: SqlQueryExecution.planDistribution ->
-        per-stage task scheduling, SURVEY §3.2)."""
+        per-stage task scheduling, EventDrivenFaultTolerantQueryScheduler's
+        spooled inter-stage exchange, SURVEY §3.2/§3.5)."""
+        import shutil
+
         sess = session or self.engine.create_session(
             next(iter(self.engine.catalogs)))
         plan = self._cached_plan(sql, sess)
         local = self._local
-        agg = self._find_distributable_aggregate(local, plan)
-        if agg is None or not self.live_workers():
-            return local.execute(plan)
-        page, dicts = self._run_distributed_aggregate(local, agg)
-        local._overrides[id(agg)] = (page, dicts)
+        with self._query_lock:  # overrides are executor-global
+            if not self.live_workers():
+                return local.execute(plan)
+            with self._lock:
+                self._exchange_seq += 1
+                seq = self._exchange_seq
+            exchange_dir = _os.path.join(self.spool_dir,
+                                         f"cluster_exchange_{seq}")
+            exchange = SpoolingExchange(exchange_dir)
+            self._task_seq = 0
+            spooled: dict = {}  # id(node) -> (task_ids, node)
+            self._mem_results = {}  # id(node) -> (page, dicts) merged locally
+            try:
+                try:
+                    self._exec_fragments(plan, exchange, exchange_dir, spooled,
+                                         nested=False)
+                except Exception:
+                    # a fragment the workers cannot run (unsupported shape,
+                    # exhausted retries, cluster-wide death) must not fail a
+                    # query the local executor can answer — degrade to local;
+                    # genuine query errors re-raise from there identically
+                    local._overrides = {}
+                    return local.execute(plan)
+                if not spooled:
+                    return local.execute(plan)
+                overrides = {}
+                for nid in self._top_fragments(plan, spooled):
+                    hit = self._mem_results.get(nid)
+                    if hit is None:
+                        task_ids, n = spooled[nid]
+                        hit = read_fragment_outputs(exchange, task_ids,
+                                                    n.schema)
+                    overrides[nid] = hit
+                local._overrides = overrides
+                out_page, dd = local._execute_to_page(plan)
+                return _materialize(out_page, dd)
+            finally:
+                local._overrides = {}
+                self._mem_results = {}
+                shutil.rmtree(exchange_dir, ignore_errors=True)
+
+    # -- fragment scheduling -----------------------------------------------------
+    def _exec_fragments(self, node, exchange, exchange_dir, spooled,
+                        nested: bool) -> None:
+        """Bottom-up: schedule every blocking fragment's tasks; descendants'
+        outputs are already spooled, so each fragment plan replaces them with
+        RemoteSource leaves (the PlanFragmenter's RemoteSourceNode).
+        ``nested``: a fragment ancestor exists — this fragment's output will
+        be consumed REMOTELY, so coordinator-merged results must spool."""
+        child_nested = nested or isinstance(node, self._FRAGMENT_NODES)
+        for c in node.children:
+            self._exec_fragments(c, exchange, exchange_dir, spooled,
+                                 child_nested)
+        if not isinstance(node, self._FRAGMENT_NODES):
+            return
+        frag = self._substitute(node, spooled, root=True)
+        if isinstance(node, P.Aggregate) and node.keys:
+            spine = self._scan_spine(frag.child)
+            if spine is not None:
+                task_ids = self._run_split_tasks(frag, spine, exchange_dir,
+                                                 "partial_agg")
+                if task_ids is not None:
+                    page, dicts = merge_partial_outputs(
+                        frag, [exchange.read(t) for t in task_ids])
+                    tid = f"t{self._task_seq}"
+                    self._task_seq += 1
+                    if nested:
+                        # a remote parent consumes this: spool the merged page
+                        from ..exec.local_executor import _host_page
+
+                        valid, pcols, pnulls = _host_page(page)
+                        cols = [c[valid] for c in pcols]
+                        nulls = [None if (m is None or not m[valid].any())
+                                 else m[valid] for m in pnulls]
+                        exchange.commit(
+                            tid, 0,
+                            serialize_fragment_output(cols, nulls, dicts))
+                    else:
+                        # only the local finish reads it: skip the
+                        # serialize/spool/deserialize round trip
+                        self._mem_results[id(node)] = (page, dicts)
+                    spooled[id(node)] = ((tid,), node)
+                    return
+        if isinstance(node, P.Join):
+            spine = self._scan_spine(frag.left)
+            if spine is not None:
+                task_ids = self._run_split_tasks(frag, spine, exchange_dir,
+                                                 "stream_splits")
+                if task_ids is not None:
+                    spooled[id(node)] = (task_ids, node)
+                    return
+        task_ids = self._run_single_task(frag, exchange_dir)
+        spooled[id(node)] = (task_ids, node)
+
+    def _substitute(self, node, spooled, root=False):
+        """Copy a subtree with spooled descendant fragments replaced by
+        RemoteSource leaves."""
+        if not root:
+            hit = spooled.get(id(node))
+            if hit is not None:
+                return P.RemoteSource(tuple(hit[0]), node.schema)
+        kids = tuple(self._substitute(c, spooled) for c in node.children)
+        if all(k is c for k, c in zip(kids, node.children)):
+            return node
+        from ..sql.rules import _replace_children
+
+        return _replace_children(node, kids)
+
+    def _scan_spine(self, node):
+        """The fragment's probe-side TableScan, reached through streaming
+        nodes (Filter/Project and join probe sides) — the split-parallel
+        spine.  Returns (scan, chain_top): ``chain_top`` is the highest node
+        of the PURE Filter/Project chain directly over the scan, used to
+        compile a cheap scan-only stream whose static split pruning
+        (tuple-domain vs split stats) the dispatcher inherits.  None when the
+        stream is fed by a RemoteSource (the fragment then runs as one task
+        over the spooled input)."""
+        out = self._spine_walk(node)
+        if out is None:
+            return None
+        scan, chain_top, _ = out
+        return scan, chain_top
+
+    def _spine_walk(self, node):
+        if isinstance(node, P.TableScan):
+            return node, node, True
+        if isinstance(node, (P.Filter, P.Project)):
+            sub = self._spine_walk(node.child)
+            if sub is None:
+                return None
+            scan, top, pure = sub
+            return (scan, node, True) if pure else (scan, top, False)
+        if isinstance(node, P.Join):
+            sub = self._spine_walk(node.left)
+            if sub is None:
+                return None
+            scan, top, _ = sub
+            return scan, top, False
+        return None
+
+    def _top_fragments(self, plan, spooled) -> list:
+        """Fragment roots the LOCAL finish consumes (not nested under another
+        fragment — nested ones are consumed remotely via RemoteSource)."""
+        out: list = []
+
+        def walk(n):
+            if id(n) in spooled:
+                out.append(id(n))
+                return
+            for c in n.children:
+                walk(c)
+
+        walk(plan)
+        return out
+
+    def _run_split_tasks(self, frag, spine, exchange_dir, kind):
+        """Fan a fragment out across workers by split batches (reference:
+        SourcePartitionedScheduler split placement + the dynamic-filter split
+        pruning the scan-only stream compile provides).  Returns the task ids,
+        or None for a zero-split source (caller degrades to a single task)."""
+        scan, chain_top = spine
+        splits = None
         try:
-            out_page, dd = local._execute_to_page(plan)
-            return _materialize(out_page, dd)
-        finally:
-            local._overrides = {}
+            # compiling ONLY the Filter/Project chain over the scan is cheap
+            # (no join builds) and inherits the executor's tuple-domain split
+            # pruning: a selective predicate ships fewer splits to workers
+            stream = self._local._compile_stream(chain_top)
+            if stream.scan_info is not None:
+                splits = list(stream.scan_info.splits)
+        except NotImplementedError:
+            pass
+        if splits is None:
+            splits = list(self.engine.catalogs[scan.catalog].splits(scan.table))
+        if not splits:
+            return None
+        tasks = []
+        for i in range((len(splits) + self.splits_per_task - 1)
+                       // self.splits_per_task):
+            tid = f"t{self._task_seq}"
+            self._task_seq += 1
+            sp = tuple(splits[j] for j in
+                       range(i * self.splits_per_task,
+                             min((i + 1) * self.splits_per_task, len(splits))))
+            tasks.append((tid, {"splits": sp}))
+        self._dispatch_tasks(frag, tasks, exchange_dir, kind)
+        return tuple(t for t, _ in tasks)
+
+    def _run_single_task(self, frag, exchange_dir) -> tuple:
+        tid = f"t{self._task_seq}"
+        self._task_seq += 1
+        self._dispatch_tasks(frag, [(tid, {})], exchange_dir, "fragment")
+        return (tid,)
 
     def _cached_plan(self, sql: str, sess):
         """Versioned, bounded plan cache keyed by (sql, catalog) — the same
@@ -498,50 +717,30 @@ class ClusterCoordinator:
                 self._local.forget_plan(old)
         return plan
 
-    def _find_distributable_aggregate(self, local, node):
-        if isinstance(node, P.Aggregate) and node.keys:
-            try:
-                stream = local._compile_stream(node.child)
-            except NotImplementedError:
-                return None
-            if stream.scan_info is not None and stream.scan_info.splits:
-                return node
-            return None
-        for c in node.children:
-            found = self._find_distributable_aggregate(local, c)
-            if found is not None:
-                return found
-        return None
-
-    def _run_distributed_aggregate(self, local, node):
-        import os
-
-        stream, key_types, acc_specs, _, acc_kinds, _ = local._agg_compiled(node)
-        splits = list(stream.scan_info.splits)
-        tasks = [(i, tuple(splits[j] for j in
-                           range(i * self.splits_per_task,
-                                 min((i + 1) * self.splits_per_task, len(splits)))))
-                 for i in range((len(splits) + self.splits_per_task - 1)
-                                // self.splits_per_task)]
-        with self._lock:
-            self._exchange_seq += 1
-            seq = self._exchange_seq
-        exchange_dir = os.path.join(self.spool_dir, f"cluster_exchange_{seq}")
+    def _dispatch_tasks(self, frag_plan, tasks, exchange_dir, kind) -> None:
+        """Dispatch a fragment's tasks across live workers and drive them to
+        committed outputs: round-robin placement, status polling, timeout/
+        death reassignment under an attempt budget, deterministic-failure
+        fast-fail.  (Reference: HttpRemoteTask.java:137,743 — the fragment
+        ships once per worker, split batches address it — plus the
+        coordinator's task tracking.)  ``tasks``: [(task_id, extra_fields)]."""
         exchange = SpoolingExchange(exchange_dir)
-        frag_id = f"frag_{seq}"
-        frag_blob = pickle.dumps({"fragment_id": frag_id, "plan": node})
+        with self._lock:
+            self._frag_seq = getattr(self, "_frag_seq", 0) + 1
+            frag_id = f"frag_{self._frag_seq}"
+        frag_blob = pickle.dumps({"fragment_id": frag_id, "plan": frag_plan})
         frag_sent: set = set()  # worker URLs (a restart changes the url)
 
-        pending = {tid: sp for tid, sp in tasks}
+        pending = dict(tasks)
         attempts: dict = {tid: 0 for tid, _ in tasks}
-        assigned: dict = {}  # task_id -> (worker, splits, deadline)
+        assigned: dict = {}  # task_id -> (worker, extra, deadline)
         while pending or assigned:
             # (re)assign pending tasks round-robin over live workers; the
             # fragment ships once per worker URL, tasks address it by id
             live = self.live_workers()
             if not live:
                 raise RuntimeError("no live workers")
-            for i, (tid, sp) in enumerate(list(pending.items())):
+            for i, (tid, extra) in enumerate(list(pending.items())):
                 w = live[i % len(live)]
                 try:
                     if w.url not in frag_sent:
@@ -549,26 +748,37 @@ class ClusterCoordinator:
                               secret=self.secret)
                         frag_sent.add(w.url)
                     req = pickle.dumps({"task_id": tid, "fragment_id": frag_id,
-                                        "splits": sp, "attempt": attempts[tid],
-                                        "exchange_dir": exchange_dir})
+                                        "kind": kind,
+                                        "attempt": attempts[tid],
+                                        "exchange_dir": exchange_dir, **extra})
                     _http(f"{w.url}/v1/task", req, secret=self.secret)
-                    assigned[tid] = (w, sp, time.time() + self.task_timeout)
+                    assigned[tid] = (w, extra, time.time() + self.task_timeout)
                     del pending[tid]
                 except Exception:
                     # unreachable worker, or 409 after a restart/fragment
-                    # eviction: the fragment must re-ship, and the failed
-                    # dispatch burns an attempt so a permanently broken
-                    # worker set cannot spin this loop forever
+                    # eviction: the fragment must re-ship.  The failure also
+                    # counts as a missed heartbeat so a dead worker gates out
+                    # of scheduling IMMEDIATELY instead of the dispatch loop
+                    # burning the whole attempt budget against it before the
+                    # detector notices; a worker that stays alive (reachable
+                    # but broken) still burns an attempt so a permanently
+                    # broken worker set cannot spin this loop forever.
                     frag_sent.discard(w.url)
-                    attempts[tid] += 1
-                    if attempts[tid] >= self.max_attempts:
-                        raise RuntimeError(
-                            f"task {tid} failed to dispatch after "
-                            f"{attempts[tid]} attempts")
+                    with self._lock:
+                        w.misses += 1
+                        if w.misses >= self.max_misses:
+                            w.alive = False
+                        still_alive = w.alive
+                    if still_alive:
+                        attempts[tid] += 1
+                        if attempts[tid] >= self.max_attempts:
+                            raise RuntimeError(
+                                f"task {tid} failed to dispatch after "
+                                f"{attempts[tid]} attempts")
                     continue
             # poll assigned tasks
             time.sleep(0.05)
-            for tid, (w, sp, deadline) in list(assigned.items()):
+            for tid, (w, extra, deadline) in list(assigned.items()):
                 if exchange.is_committed(tid):
                     del assigned[tid]
                     continue
@@ -596,10 +806,7 @@ class ClusterCoordinator:
                     if attempts[tid] >= self.max_attempts:
                         raise RuntimeError(
                             f"task {tid} failed after {attempts[tid]} attempts")
-                    pending[tid] = sp
-        payloads = [exchange.read(tid) for tid, _ in tasks]
-        return merge_partial_pages(node, stream, key_types, acc_specs, acc_kinds,
-                                   payloads)
+                    pending[tid] = extra
 
 
 def main(argv=None):  # pragma: no cover - exercised via subprocess in tests

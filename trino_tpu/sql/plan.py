@@ -17,7 +17,7 @@ from .ir import Expr
 
 __all__ = ["PlanNode", "TableScan", "Filter", "Project", "AggSpec", "Aggregate",
            "SortKey", "Sort", "Limit", "Join", "Union", "Values", "Output",
-           "WindowSpec", "Window"]
+           "WindowSpec", "Window", "RemoteSource"]
 
 
 class PlanNode:
@@ -262,6 +262,19 @@ class Values(PlanNode):
     """reference: sql/planner/plan/ValuesNode.java; rows of python literals."""
 
     rows: tuple
+    schema: Schema
+
+
+@dataclasses.dataclass(frozen=True)
+class RemoteSource(PlanNode):
+    """A fragment input read from the exchange: the subtree it replaces ran as
+    remote task(s) whose spooled outputs concatenate to this node's rows
+    (reference: sql/planner/plan/RemoteSourceNode.java — a fragment's leaf
+    standing for the exchange from its source stage).  The executor never
+    evaluates this node directly; the task runner resolves it to an override
+    page before execution."""
+
+    task_ids: tuple  # spooled task outputs to concatenate, in order
     schema: Schema
 
 
