@@ -435,25 +435,37 @@ def run_partial_aggregate_splits(node, stream, key_types, acc_specs, step,
     return serialize_page(cols, nulls)
 
 
-def run_partial_aggregate(local: LocalExecutor, node, splits) -> bytes:
+def run_partial_aggregate(local: LocalExecutor, node, splits,
+                          exchange_dir: str = None) -> bytes:
     """Worker entry: compile the aggregation on this process's executor and run
     the partial task over ``splits``; the output envelope carries the group
     keys' dictionaries so the coordinator can merge without compiling the
-    child stream itself."""
+    child stream itself.  Like its sibling task bodies, it resolves the
+    fragment's RemoteSource children itself when given the exchange."""
     import pickle
 
-    stream, key_types, acc_specs, _, _, step = local._agg_compiled(node)
-    data = run_partial_aggregate_splits(node, stream, key_types, acc_specs,
-                                        step, splits)
-    key_dicts = tuple(stream.dicts[i] for i in node.keys)
+    saved = local._overrides
+    if exchange_dir is not None:
+        local._overrides = resolve_remote_sources(exchange_dir, node)
+    try:
+        stream, key_types, acc_specs, _, _, step = local._agg_compiled(node)
+        data = run_partial_aggregate_splits(node, stream, key_types, acc_specs,
+                                            step, splits)
+        key_dicts = tuple(stream.dicts[i] for i in node.keys)
+    finally:
+        local._overrides = saved
     return data + pickle.dumps(key_dicts)
 
 
 # -- generic fragment task bodies (cluster plane) -------------------------------
 def read_fragment_outputs(exchange: SpoolingExchange, task_ids, schema):
     """Concatenate the spooled outputs of a fragment's tasks into one override
-    page (the ExchangeOperator's gather, filesystem edition).  An empty
-    task set (zero-split source) yields an empty page."""
+    page (the ExchangeOperator's gather, filesystem edition), padded to a
+    power-of-two shape bucket — spooled lengths are data-dependent, and every
+    distinct raw shape would cost a fresh XLA compile in the consuming
+    pipeline.  An empty task set (zero-split source) yields an empty page."""
+    from .spill import concat_host_chunks, padded_page
+
     ncols = len(schema.fields)
     if not task_ids:
         cols = tuple(jnp.asarray(
@@ -461,21 +473,8 @@ def read_fragment_outputs(exchange: SpoolingExchange, task_ids, schema):
         return (Page(schema, cols, tuple(None for _ in cols), None),
                 tuple(None for _ in range(ncols)))
     parts = [deserialize_fragment_output(exchange.read(t)) for t in task_ids]
-    cols, nulls = [], []
-    for i in range(ncols):
-        cols.append(np.concatenate([p[0][i] for p in parts]))
-        ms = [p[1][i] for p in parts]
-        if all(m is None for m in ms):
-            nulls.append(None)
-        else:
-            nulls.append(np.concatenate(
-                [m if m is not None else np.zeros(p[0][i].shape[0], bool)
-                 for m, p in zip(ms, parts)]))
-    page = Page(schema,
-                tuple(jnp.asarray(c) for c in cols),
-                tuple(None if m is None else jnp.asarray(m) for m in nulls),
-                None)
-    return page, parts[0][2]
+    cols, nulls = concat_host_chunks(schema, [(p[0], p[1]) for p in parts])
+    return padded_page(schema, cols, nulls), parts[0][2]
 
 
 def resolve_remote_sources(exchange_dir: str, node) -> dict:
@@ -546,19 +545,9 @@ def run_stream_splits(local: LocalExecutor, node, exchange_dir: str,
         dicts = stream.dicts
     finally:
         local._overrides = saved
-    ncols = len(stream.schema.fields)
-    cols, nulls = [], []
-    for i in range(ncols):
-        cols.append(np.concatenate([p[0][i] for p in parts]) if parts
-                    else np.empty((0,), np.dtype(stream.schema.fields[i].type.dtype)))
-        ms = [p[1][i] for p in parts]
-        if not parts or all(m is None for m in ms):
-            nulls.append(None)
-        else:
-            nulls.append(np.concatenate(
-                [m if m is not None else np.zeros(p[0][i].shape[0], bool)
-                 for m, p in zip(ms, parts)]))
-    nulls = [None if (m is None or not m.any()) else m for m in nulls]
+    from .spill import concat_host_chunks
+
+    cols, nulls = concat_host_chunks(stream.schema, parts)
     return serialize_fragment_output(cols, nulls, dicts)
 
 

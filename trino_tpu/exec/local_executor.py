@@ -692,30 +692,48 @@ class LocalExecutor:
         return page, dicts
 
     def _run_aggregate_partitioned(self, node: P.Aggregate, parts: int):
-        """Grace-style partitioned aggregation: P passes over the input, pass p keeping
-        only rows whose key hash routes to partition p; results concatenate (disjoint
-        key sets).  Trades scan recompute for bounded table memory."""
+        """Grace-partitioned aggregation over the HOST-RAM spill tier
+        (exec/spill.py): ONE pass transforms the input and hash-routes rows to
+        per-partition host buffers; partitions then aggregate one at a time
+        from host — the input (a file-backed scan in the worst case) is read
+        and decoded exactly once, unlike a Grace re-scan.  Reference:
+        SpillableHashAggregationBuilder + FileSingleStreamSpiller."""
         from ..ops.exchange import partition_ids
+        from .spill import SpilledPartitions
 
         stream, key_types, acc_specs, acc_exprs, acc_kinds, _ = self._agg_compiled(node)
 
         @jax.jit
-        def pstep(state, page, p, aux, stream=stream, node=node, key_types=key_types,
-                  acc_exprs=acc_exprs, acc_kinds=acc_kinds, parts=parts):
+        def route(page, aux, stream=stream, node=node, parts=parts):
             cols, nulls, valid = stream.transform(
                 page.columns, page.null_masks, page.valid_mask(), aux)
             key_vals = tuple(cols[i] for i in node.keys)
             key_nulls = tuple(nulls[i] for i in node.keys)
-            # canonicalize NULL key lanes before hashing, exactly like groupby_insert:
-            # the SQL NULL group must land in ONE partition
-            routed = tuple(kv if kn is None else jnp.where(kn, jnp.zeros((), kv.dtype),
-                                                           kv)
+            # canonicalize NULL key lanes before hashing, exactly like
+            # groupby_insert: the SQL NULL group must land in ONE partition
+            routed = tuple(kv if kn is None
+                           else jnp.where(kn, jnp.zeros((), kv.dtype), kv)
                            for kv, kn in zip(key_vals, key_nulls))
-            valid = valid & (partition_ids(routed, parts) == p)
+            return cols, nulls, valid, partition_ids(routed, parts)
+
+        spill = SpilledPartitions(stream.schema, parts)
+        for page in stream.pages():
+            cols, nulls, valid, pid = route(page, stream.aux)
+            spill.add_page(cols, nulls, valid, pid)
+        st = self.stats.setdefault(id(node), {"rows": 0, "wall_s": 0.0})
+        st["spilled_bytes"] = spill.spilled_bytes
+        st["spill_partitions"] = parts
+
+        @jax.jit
+        def insert(state, page, node=node, key_types=key_types,
+                   acc_exprs=acc_exprs, acc_kinds=acc_kinds):
+            cols, nulls, valid = page.columns, page.null_masks, page.valid_mask()
+            key_vals = tuple(cols[i] for i in node.keys)
+            key_nulls = tuple(nulls[i] for i in node.keys)
             inputs = [(None, None) if e is None else evaluate(e, cols, nulls)
                       for e in acc_exprs]
-            return hashagg.groupby_insert(state, key_vals, key_types, valid, inputs,
-                                          acc_kinds, key_nulls)
+            return hashagg.groupby_insert(state, key_vals, key_types, valid,
+                                          inputs, acc_kinds, key_nulls)
 
         pages_out, dicts = [], None
         for p in range(parts):
@@ -723,8 +741,9 @@ class LocalExecutor:
             while True:
                 state = hashagg.groupby_init(
                     capacity, tuple(t.dtype for t in key_types), acc_specs)
-                for page in stream.pages():
-                    state = pstep(state, page, jnp.int32(p), stream.aux)
+                # capacity retries replay from HOST buffers, never the source
+                for page in spill.partition_pages(p):
+                    state = insert(state, page)
                 if not bool(state.overflow):
                     break
                 if capacity >= MAX_GROUP_CAPACITY:
@@ -732,7 +751,8 @@ class LocalExecutor:
                         raise MemoryError(
                             f"aggregation exceeds {MAX_GROUP_CAPACITY} groups per "
                             f"partition even at {parts} partitions")
-                    # a partition still blew the ceiling: restart with more partitions
+                    # a partition still blew the ceiling: restart with more
+                    # partitions (the one remaining source re-scan)
                     return self._run_aggregate_partitioned(node, parts * 4)
                 capacity *= 4
             page, dicts = self._finalize_groups(node, stream, state)
@@ -1037,51 +1057,58 @@ class LocalExecutor:
     def _compile_partitioned_local_join(self, node: P.Join, build_page, build_dicts,
                                         probe_stream, build_key_types,
                                         parts: int) -> _Stream:
-        """Grace-partitioned join: hash-partition BOTH sides on the join keys and
-        process one partition's build table at a time, re-streaming the probe per
-        partition (reference: the spilling join's partition-at-a-time consumption,
-        operator/join/spilling/PartitionedConsumption.java).  Each probe row
+        """Grace-partitioned join over the HOST-RAM spill tier (exec/spill.py):
+        hash-partition BOTH sides on the join keys into host buffers — the
+        build page immediately (freeing its HBM), the probe in ONE transformed
+        pass — then join one partition at a time from host.  Each probe row
         belongs to exactly one partition, so inner/left/semi semantics hold
-        part-locally; trades probe recompute for bounded build memory."""
+        part-locally, and the probe input (a file-backed scan in the worst
+        case) is read and decoded exactly once instead of once per partition.
+        Reference: the spilling join's partition-at-a-time consumption
+        (operator/join/spilling/PartitionedConsumption.java) over
+        FileSingleStreamSpiller partitions."""
         from ..ops.exchange import partition_ids
+        from .spill import SpilledPartitions
 
         bkeys = tuple(build_page.columns[i] for i in node.right_keys)
         bknulls = tuple(build_page.null_masks[i] for i in node.right_keys)
         routed = tuple(kv if kn is None else jnp.where(kn, jnp.zeros((), kv.dtype), kv)
                        for kv, kn in zip(bkeys, bknulls))
         bpid = partition_ids(routed, parts)
-        bvalid = build_page.valid_mask()
-        # one batched sync for every partition's build row count
-        counts = [int(c) for c in _host(
-            [jnp.sum(bvalid & (bpid == p), dtype=jnp.int32) for p in range(parts)])]
+        build_spill = SpilledPartitions(build_page.schema, parts)
+        build_spill.add_page(build_page.columns, build_page.null_masks,
+                             build_page.valid_mask(), bpid)
+        # from here the build lives on the HOST; its device arrays free with
+        # this frame (the point of spilling: O(build/parts) resident HBM)
 
-        compact = jax.jit(_compact_part, static_argnums=3)
-
-        def build_part(p: int) -> Page:
-            n = counts[p]
-            bucket = max(1 << max(n - 1, 1).bit_length(), 16)
-            ccols, cnulls = compact(build_page.columns, build_page.null_masks,
-                                    bvalid & (bpid == p), bucket)
-            return Page(build_page.schema, ccols, cnulls,
-                        jnp.arange(bucket) < n)
-
-        def probe_part(p: int) -> _Stream:
-            def transform(cols, nulls, valid, aux, up=probe_stream, node=node, p=p):
-                cols, nulls, valid = up.transform(cols, nulls, valid, aux)
-                keys = tuple(cols[i] for i in node.left_keys)
-                knulls = tuple(nulls[i] for i in node.left_keys)
-                rt = tuple(kv if kn is None
-                           else jnp.where(kn, jnp.zeros((), kv.dtype), kv)
-                           for kv, kn in zip(keys, knulls))
-                return cols, nulls, valid & (partition_ids(rt, parts) == p)
-
-            return _Stream(probe_stream.schema, probe_stream.dicts,
-                           probe_stream.pages, transform, aux=probe_stream.aux)
+        @jax.jit
+        def probe_route(page, aux, up=probe_stream, node=node, parts=parts):
+            cols, nulls, valid = up.transform(page.columns, page.null_masks,
+                                              page.valid_mask(), aux)
+            keys = tuple(cols[i] for i in node.left_keys)
+            knulls = tuple(nulls[i] for i in node.left_keys)
+            rt = tuple(kv if kn is None
+                       else jnp.where(kn, jnp.zeros((), kv.dtype), kv)
+                       for kv, kn in zip(keys, knulls))
+            return cols, nulls, valid, partition_ids(rt, parts)
 
         def pages(self=self, node=node):
+            # spill pass: one read of the probe source per execution
+            probe_spill = SpilledPartitions(probe_stream.schema, parts)
+            for page in probe_stream.pages():
+                cols, nulls, valid, pid = probe_route(page, probe_stream.aux)
+                probe_spill.add_page(cols, nulls, valid, pid)
+            st = self.stats.setdefault(id(node), {"rows": 0, "wall_s": 0.0})
+            st["spilled_bytes"] = (build_spill.spilled_bytes
+                                   + probe_spill.spilled_bytes)
+            st["spill_partitions"] = parts
             for p in range(parts):
-                sub = self._join_with_build(node, build_part(p), build_dicts,
-                                            probe_part(p), build_key_types)
+                sub_stream = _Stream(probe_stream.schema, probe_stream.dicts,
+                                     partial(probe_spill.partition_pages, p),
+                                     lambda c, n, v, aux: (c, n, v))
+                sub = self._join_with_build(node, build_spill.partition_page(p),
+                                            build_dicts, sub_stream,
+                                            build_key_types)
                 jt = sub.jitted()
                 for page in sub.pages():
                     cols, nulls, valid = jt(page)

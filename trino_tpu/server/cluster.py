@@ -52,9 +52,9 @@ import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)
 
-from ..exec.fte import (SpoolingExchange, is_retryable_failure,
-                        merge_partial_outputs, read_fragment_outputs,
-                        resolve_remote_sources, run_fragment,
+from ..exec.fte import (FaultTolerantExecutor, SpoolingExchange,
+                        is_retryable_failure, merge_partial_outputs,
+                        read_fragment_outputs, run_fragment,
                         run_partial_aggregate, run_stream_splits,
                         serialize_fragment_output)
 from ..exec.local_executor import LocalExecutor, _materialize
@@ -282,14 +282,8 @@ class WorkerServer:
                 # serial execution the right default here anyway)
                 with self._exec_lock:
                     if kind == "partial_agg":
-                        saved = self.local._overrides
-                        self.local._overrides = resolve_remote_sources(
-                            xdir, node)
-                        try:
-                            data = run_partial_aggregate(self.local, node,
-                                                         req["splits"])
-                        finally:
-                            self.local._overrides = saved
+                        data = run_partial_aggregate(self.local, node,
+                                                     req["splits"], xdir)
                     elif kind == "stream_splits":
                         data = run_stream_splits(self.local, node, xdir,
                                                  req["splits"])
@@ -469,10 +463,10 @@ class ClusterCoordinator:
         raise TimeoutError(f"{n} workers not registered within {timeout}s")
 
     # -- distributed query -------------------------------------------------------
-    # fragment roots (the FTE decomposition, SURVEY §3.5): every blocking node
-    # runs as remote task(s) whose inputs are replayable — leaf scans from
-    # splits, interior fragments from their children's spooled outputs
-    _FRAGMENT_NODES = (P.Aggregate, P.Join, P.Window, P.Sort, P.Unnest)
+    # fragment roots: the SAME decomposition the in-process FTE uses (every
+    # blocking node runs as remote task(s) whose inputs are replayable — leaf
+    # scans from splits, interior fragments from children's spooled outputs)
+    _FRAGMENT_NODES = FaultTolerantExecutor._FRAGMENT_NODES
 
     def execute_sql(self, sql: str, session=None):
         """Plan on the coordinator; schedule EVERY blocking fragment as remote
@@ -605,27 +599,19 @@ class ClusterCoordinator:
         (tuple-domain vs split stats) the dispatcher inherits.  None when the
         stream is fed by a RemoteSource (the fragment then runs as one task
         over the spooled input)."""
-        out = self._spine_walk(node)
-        if out is None:
-            return None
-        scan, chain_top, _ = out
-        return scan, chain_top
+        return self._spine_walk(node)
 
     def _spine_walk(self, node):
+        # (no Join case: every Join is itself a fragment root, so by the time
+        # a fragment plan reaches here its joins are already RemoteSources)
         if isinstance(node, P.TableScan):
-            return node, node, True
+            return node, node
         if isinstance(node, (P.Filter, P.Project)):
             sub = self._spine_walk(node.child)
             if sub is None:
                 return None
-            scan, top, pure = sub
-            return (scan, node, True) if pure else (scan, top, False)
-        if isinstance(node, P.Join):
-            sub = self._spine_walk(node.left)
-            if sub is None:
-                return None
-            scan, top, _ = sub
-            return scan, top, False
+            scan, _ = sub
+            return scan, node
         return None
 
     def _top_fragments(self, plan, spooled) -> list:

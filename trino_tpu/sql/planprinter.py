@@ -74,5 +74,10 @@ def _fmt(node: P.PlanNode, lines: list, depth: int, stats: dict) -> None:
     if s is not None and len(lines) > before:
         # row counts may still live on device (deferred device->host sync)
         lines[before] += f"  [rows: {int(s['rows'])}, {s['wall_s'] * 1000:.1f} ms]"
+        if s.get("spilled_bytes"):
+            # the host-RAM spill tier ran (reference: operator spill metrics
+            # in OperatorStats — spilledDataSize)
+            lines[before] += (f" [spilled: {s['spilled_bytes'] / 1e6:.1f} MB, "
+                              f"{s['spill_partitions']} partitions]")
     for c in node.children:
         _fmt(c, lines, depth + 1, stats)
