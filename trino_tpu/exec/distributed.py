@@ -90,7 +90,10 @@ __all__ = ["DistributedExecutor"]
 
 # merge kind for re-aggregating exchanged accumulator entries
 _MERGE_KIND = {"sum": "sum", "count": "sum", "count_star": "sum", "min": "min",
-               "max": "max", "sum_sq": "sum"}
+               "max": "max", "sum_sq": "sum",
+               # two-limb partial sums merge by PLAIN addition (the limbs are
+               # already split; splitting again would corrupt them)
+               "sum_hi32": "sum", "sum_lo32": "sum"}
 
 
 def _eval_project(exprs, cols, nulls, shape):
@@ -889,7 +892,8 @@ class DistributedExecutor:
         acc_cols = [np.concatenate([np.asarray(a)[w, :capacity][occ[w]] for w in range(W)])
                     for a in merged.accs]
         out_cols = key_cols + _finalize_aggs(node.aggs, acc_cols, occ.sum())
-        arrays = [jnp.asarray(c) for c in out_cols]
+        # host output (exact wide-decimal columns must never reach the device)
+        arrays = [np.asarray(c) for c in out_cols]
         page = Page(node.schema, tuple(arrays), tuple(None for _ in arrays), None)
         dicts = tuple(stream.dicts[i] for i in node.keys) + tuple(None for _ in node.aggs)
         return (page, dicts), False
@@ -976,6 +980,9 @@ class DistributedExecutor:
                     out.append(s + jnp.sum(mask, dtype=s.dtype))
                 elif kind == "sum":
                     out.append(s + jnp.sum(jnp.where(mask, v, 0), dtype=s.dtype))
+                elif kind in ("sum_hi32", "sum_lo32"):
+                    h = (v >> 32) if kind == "sum_hi32" else (v & 0xFFFFFFFF)
+                    out.append(s + jnp.sum(jnp.where(mask, h, 0), dtype=s.dtype))
                 elif kind == "min":
                     out.append(jnp.minimum(s, jnp.min(jnp.where(mask, v, hashagg._extreme(s.dtype, 1)))))
                 elif kind == "max":
@@ -992,7 +999,7 @@ class DistributedExecutor:
         finals = []
         for s, kind in zip(state[:-1], acc_kinds):
             v = np.asarray(s)
-            if kind in ("sum", "count", "count_star"):
+            if kind in ("sum", "count", "count_star", "sum_hi32", "sum_lo32"):
                 finals.append(v.sum(axis=0, keepdims=False)[None] if v.ndim == 0 else
                               np.asarray([v.sum()]))
             elif kind == "min":
@@ -1000,7 +1007,8 @@ class DistributedExecutor:
             else:
                 finals.append(np.asarray([v.max()]))
         out_cols = _finalize_aggs(node.aggs, finals, 1)
-        arrays = [jnp.asarray(c) for c in out_cols]
+        # host output (exact wide-decimal columns must never reach the device)
+        arrays = [np.asarray(c) for c in out_cols]
         page = Page(node.schema, tuple(arrays), tuple(None for _ in arrays), None)
         return (page, tuple(None for _ in node.aggs)), False
 

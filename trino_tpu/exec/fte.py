@@ -43,7 +43,10 @@ __all__ = ["FailureInjector", "InjectedFailure", "SpoolingExchange",
            "is_retryable_failure"]
 
 _MERGE_KIND = {"sum": "sum", "count": "sum", "count_star": "sum",
-               "min": "min", "max": "max", "sum_sq": "sum"}
+               "min": "min", "max": "max", "sum_sq": "sum",
+               # two-limb partial sums merge by PLAIN addition (the limbs are
+               # already split; splitting again would corrupt them)
+               "sum_hi32": "sum", "sum_lo32": "sum"}
 
 _MAGIC = b"TTPG"
 
@@ -108,7 +111,10 @@ def deserialize_page(data: bytes):
         raise ValueError("page frame checksum mismatch")
     if codec == 1:
         payload = zlib.decompress(payload)
-    z = np.load(io.BytesIO(payload))
+    # allow_pickle: exact wide-decimal (object) columns serialize via pickle
+    # inside the npz; the spool/exchange is trusted (local disk or the
+    # HMAC-authenticated internal channel)
+    z = np.load(io.BytesIO(payload), allow_pickle=True)
     n = int(z["ncols"])
     cols = [z[f"c{i}"] for i in range(n)]
     nulls = [z[f"n{i}"] if f"n{i}" in z.files else None for i in range(n)]
@@ -286,7 +292,8 @@ class FaultTolerantExecutor:
             dicts = self._commit_with_retries(tid, compute)
         cols, nulls = deserialize_page(self._exchange.read(tid))
         page = Page(node.schema,
-                    tuple(jnp.asarray(c) for c in cols),
+                    tuple(c if c.dtype == object else jnp.asarray(c)
+                          for c in cols),
                     tuple(None if n is None else jnp.asarray(n) for n in nulls),
                     None)
         self.local._overrides[id(node)] = (page, dicts)

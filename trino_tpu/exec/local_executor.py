@@ -98,7 +98,25 @@ class _Stream:
         if self._jitted is None:
             f = jax.jit(lambda page, aux: self.transform(
                 page.columns, page.null_masks, page.valid_mask(), aux))
-            self._jitted = lambda page: f(page, self.aux)
+
+            def run(page, f=f):
+                if any(isinstance(c, np.ndarray) and c.dtype == object
+                       for c in page.columns):
+                    # exact wide-decimal (object) columns cannot trace; run the
+                    # transform eagerly — they only ever pass through FieldRef
+                    # projections at the result surface (jnp ops on the other
+                    # channels execute op-by-op)
+                    try:
+                        return self.transform(page.columns, page.null_masks,
+                                              page.valid_mask(), self.aux)
+                    except (TypeError, OverflowError) as e:
+                        raise NotImplementedError(
+                            "expressions over an exact wide-decimal aggregate "
+                            "(sum beyond 2^63) are not supported yet — such "
+                            "sums can only be output directly") from e
+                return f(page, self.aux)
+
+            self._jitted = run
         return self._jitted
 
 
@@ -752,19 +770,25 @@ class LocalExecutor:
                             f"aggregation exceeds {MAX_GROUP_CAPACITY} groups per "
                             f"partition even at {parts} partitions")
                     # a partition still blew the ceiling: restart with more
-                    # partitions (the one remaining source re-scan)
+                    # partitions (the one remaining source re-scan).  Free
+                    # THIS spill's host buffers first — the restart re-spools
+                    # the whole input, and holding both doubles peak host RAM
+                    # in the one path that runs under memory pressure.
+                    del spill
                     return self._run_aggregate_partitioned(node, parts * 4)
                 capacity *= 4
             page, dicts = self._finalize_groups(node, stream, state)
             pages_out.append(page)
-        cols = tuple(jnp.concatenate([p.columns[i] for p in pages_out])
+        # host-side concat: partition outputs are tiny host arrays, and exact
+        # wide-decimal (object) columns must never reach the device
+        cols = tuple(np.concatenate([np.asarray(p.columns[i]) for p in pages_out])
                      for i in range(len(node.schema.fields)))
         nulls = []
         for i in range(len(node.schema.fields)):
             if any(p.null_masks[i] is not None for p in pages_out):
-                nulls.append(jnp.concatenate([
-                    p.null_masks[i] if p.null_masks[i] is not None
-                    else jnp.zeros((p.capacity,), bool) for p in pages_out]))
+                nulls.append(np.concatenate([
+                    np.asarray(p.null_masks[i]) if p.null_masks[i] is not None
+                    else np.zeros((p.capacity,), bool) for p in pages_out]))
             else:
                 nulls.append(None)
         return Page(node.schema, cols, tuple(nulls), None), dicts
@@ -793,6 +817,9 @@ class LocalExecutor:
                     out.append(st + jnp.sum(mask, dtype=st.dtype))
                 elif kind == "sum":
                     out.append(st + jnp.sum(jnp.where(mask, v, 0), dtype=st.dtype))
+                elif kind in ("sum_hi32", "sum_lo32"):
+                    h = (v >> 32) if kind == "sum_hi32" else (v & 0xFFFFFFFF)
+                    out.append(st + jnp.sum(jnp.where(mask, h, 0), dtype=st.dtype))
                 elif kind == "sum_sq":
                     vv = v.astype(st.dtype)
                     out.append(st + jnp.sum(jnp.where(mask, vv * vv, 0),
@@ -824,10 +851,18 @@ class LocalExecutor:
             for st, (kind, dtype, _) in zip(state, acc_specs)
         )
         for page in stream.pages():
-            state = step(state, page, stream.aux)
+            if any(isinstance(c, np.ndarray) and c.dtype == object
+                   for c in page.columns):
+                # exact wide-decimal input channel (count over a wide-sum
+                # subquery): jit cannot accept the page — run the step
+                # eagerly; the untouched object channel passes through
+                state = step.__wrapped__(state, page, stream.aux)
+            else:
+                state = step(state, page, stream.aux)
         acc_cols = [np.asarray(s)[None] for s in state]
         out_cols = _finalize_aggs(node.aggs, acc_cols, 1)
-        arrays = [jnp.asarray(c) for c in out_cols]
+        # host output (exact wide-decimal columns must never reach the device)
+        arrays = [np.asarray(c) for c in out_cols]
         page = Page(node.schema, tuple(arrays), tuple(None for _ in arrays), None)
         return page, tuple(None for _ in node.aggs)
 
@@ -1217,10 +1252,18 @@ def _accumulators_for(spec: P.AggSpec):
     if spec.kind == "count_star" or spec.kind == "count":
         return [(spec.kind, jnp.int64, 0)]
     if spec.kind == "sum":
+        if isinstance(t, DecimalType):
+            # exact wide sum: two int64 limbs (hi = v>>32, lo = v&0xFFFFFFFF)
+            # accumulate separately and recombine exactly at finalization
+            # (reference: Int128 state, DecimalSumAggregation.java)
+            return [("sum_hi32", jnp.int64, 0), ("sum_lo32", jnp.int64, 0)]
         dtype = jnp.float64 if t.is_floating else jnp.int64
         return [("sum", dtype, 0)]
     if spec.kind == "avg":
         in_t = spec.arg.type
+        if isinstance(in_t, DecimalType):
+            return [("sum_hi32", jnp.int64, 0), ("sum_lo32", jnp.int64, 0),
+                    ("count", jnp.int64, 0)]
         dtype = jnp.float64 if in_t.is_floating else jnp.int64
         return [("sum", dtype, 0), ("count", jnp.int64, 0)]
     if spec.kind in ("min", "max"):
@@ -1242,21 +1285,45 @@ def _accumulators_for(spec: P.AggSpec):
     raise NotImplementedError(spec.kind)
 
 
+def _combine_limbs(hi, lo):
+    """Exact Python-int recombination of two-limb sums (host, n_groups-sized)."""
+    return [int(h) * (1 << 32) + int(l)
+            for h, l in zip(np.asarray(hi).tolist(), np.asarray(lo).tolist())]
+
+
 def _finalize_aggs(aggs, acc_cols, n_groups):
-    """Combine accumulator columns into final output columns (host-side, small)."""
+    """Combine accumulator columns into final output columns (host-side, small).
+
+    Wide decimal sums recombine their two limbs as EXACT Python ints; values
+    still inside int64 emit a normal device-safe column, anything past 2^63
+    emits an object column that lives on the host through the result surface
+    (the reference's Int128 -> long-decimal block)."""
     out = []
     i = 0
     for spec in aggs:
-        if spec.kind == "avg":
+        if spec.kind == "avg" and spec.arg is not None \
+                and isinstance(spec.arg.type, DecimalType):
+            exact = _combine_limbs(acc_cols[i], acc_cols[i + 1])
+            c = np.asarray(acc_cols[i + 2]).tolist()
+            i += 3
+            vals = []
+            for s, n in zip(exact, c):
+                n = max(int(n), 1)
+                q, r = divmod(abs(s), n)
+                vals.append((q + (2 * r >= n)) * (1 if s >= 0 else -1))
+            out.append(np.array(vals, np.int64))  # avg fits the input type
+        elif spec.kind == "avg":
             s, c = acc_cols[i], acc_cols[i + 1]
             i += 2
             c_safe = np.where(c == 0, 1, c)
-            if isinstance(spec.type, DecimalType):
-                q, r = np.divmod(np.abs(s), c_safe)
-                val = (q + (2 * r >= c_safe)) * np.sign(s)
-                out.append(val.astype(np.int64))
+            out.append((s / c_safe).astype(np.float64))
+        elif spec.kind == "sum" and isinstance(spec.type, DecimalType):
+            exact = _combine_limbs(acc_cols[i], acc_cols[i + 1])
+            i += 2
+            if all(-(1 << 63) <= v < (1 << 63) for v in exact):
+                out.append(np.array(exact, np.int64))
             else:
-                out.append((s / c_safe).astype(np.float64))
+                out.append(np.array(exact, dtype=object))
         elif spec.kind in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
             s, ssq, c = acc_cols[i], acc_cols[i + 1], acc_cols[i + 2]
             i += 3
@@ -1302,6 +1369,15 @@ def _concat_stream(stream: _Stream) -> Page:
         for (cols, nulls, valid), n in zip(staged, [int(c) for c in _host(sums)]):
             if n == 0:
                 continue
+            if any(isinstance(c, np.ndarray) and c.dtype == object
+                   for c in cols):
+                # exact wide-decimal columns: host compaction (cannot trace)
+                v = np.asarray(valid)
+                ccols = tuple(np.asarray(c)[v] for c in cols)
+                cnulls = tuple(None if m is None else np.asarray(m)[v]
+                               for m in nulls)
+                parts.append((ccols, cnulls, n))
+                continue
             bucket = max(1 << max(n - 1, 1).bit_length(), 1024)
             ccols, cnulls = _compact_part(cols, nulls, valid,
                                           min(bucket, valid.shape[0]))
@@ -1325,6 +1401,17 @@ def _concat_stream(stream: _Stream) -> Page:
     ncols = len(parts[0][0])
     has_null = tuple(any(cnulls[ci] is not None for _, cnulls, _ in parts)
                      for ci in range(ncols))
+    if any(isinstance(c, np.ndarray) and c.dtype == object
+           for c in parts[0][0]):
+        # host concat for exact wide-decimal parts (host-compacted above)
+        cols_out = tuple(np.concatenate([p[0][ci] for p in parts])
+                         for ci in range(ncols))
+        nulls_out = tuple(
+            np.concatenate([p[1][ci] if p[1][ci] is not None
+                            else np.zeros(p[0][ci].shape[0], bool)
+                            for p in parts]) if has_null[ci] else None
+            for ci in range(ncols))
+        return Page(stream.schema, cols_out, nulls_out, None)
     ns = jnp.asarray([n for _, _, n in parts], jnp.int32)
     cols_out, nulls_out, valid = _concat_all(
         tuple((ccols, cnulls) for ccols, cnulls, _ in parts), ns, has_null)
@@ -1820,7 +1907,16 @@ def _materialize(page: Page, dicts) -> MaterializedResult:
         raw.append(arr)
         dec = arr
         if isinstance(f.type, DecimalType):
-            dec = arr.astype(np.float64) / (10**f.type.scale)
+            if arr.dtype == object:
+                # exact wide-decimal sums (Python ints past 2^63): decode via
+                # decimal.Decimal so no precision is lost at the surface
+                from decimal import Decimal
+
+                q = Decimal(10) ** f.type.scale
+                dec = np.array([Decimal(int(v)) / q for v in arr.tolist()],
+                               dtype=object)
+            else:
+                dec = arr.astype(np.float64) / (10**f.type.scale)
         elif f.type.is_string and dicts[i] is not None:
             dec = dicts[i].decode(arr)
         else:

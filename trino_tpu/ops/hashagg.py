@@ -213,6 +213,10 @@ def _onehot_agg_update(acc, kind, onehot, vals_nulls):
     if kind == "sum":
         delta = jnp.sum(jnp.where(mask, vals[:, None], 0), axis=0).astype(acc.dtype)
         return acc.at[:C].add(delta)
+    if kind in ("sum_hi32", "sum_lo32"):
+        v = (vals >> 32) if kind == "sum_hi32" else (vals & 0xFFFFFFFF)
+        delta = jnp.sum(jnp.where(mask, v[:, None], 0), axis=0).astype(acc.dtype)
+        return acc.at[:C].add(delta)
     if kind == "sum_sq":
         v = vals.astype(acc.dtype)
         delta = jnp.sum(jnp.where(mask, (v * v)[:, None], 0), axis=0)
@@ -350,6 +354,13 @@ def agg_update(acc, kind, slot, live, vals_nulls):
         return acc.at[idx].add(jnp.where(mask, 1, 0).astype(acc.dtype))
     if kind == "sum":
         return acc.at[idx].add(jnp.where(mask, vals, 0).astype(acc.dtype))
+    if kind in ("sum_hi32", "sum_lo32"):
+        # two-limb exact decimal sum (reference: Int128 state in
+        # DecimalSumAggregation): each int64 input splits as
+        # v == (v >> 32) * 2^32 + (v & 0xFFFFFFFF); the halves accumulate
+        # separately without overflow and recombine exactly on the host
+        v = (vals >> 32) if kind == "sum_hi32" else (vals & 0xFFFFFFFF)
+        return acc.at[idx].add(jnp.where(mask, v, 0).astype(acc.dtype))
     if kind == "sum_sq":
         v = vals.astype(acc.dtype)
         return acc.at[idx].add(jnp.where(mask, v * v, 0))
@@ -379,7 +390,9 @@ AGG_INITS = {
 
 
 _REHASH_KIND = {"sum": "sum", "count": "sum", "count_star": "sum",
-                "min": "min", "max": "max", "sum_sq": "sum"}
+                "min": "min", "max": "max", "sum_sq": "sum",
+                # limb accumulators re-insert by plain addition (already split)
+                "sum_hi32": "sum", "sum_lo32": "sum"}
 
 
 @partial(jax.jit, static_argnums=(1, 2))

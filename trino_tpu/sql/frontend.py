@@ -2194,7 +2194,10 @@ def _agg_type(kind: str, in_type: Type) -> Type:
         return BIGINT
     if kind == "sum":
         if isinstance(in_type, DecimalType):
-            return DecimalType.of(18, in_type.scale)
+            # reference: sum(decimal(p,s)) -> decimal(38,s)
+            # (DecimalSumAggregation with Int128 state); the two-limb
+            # accumulators make the wide sum exact
+            return DecimalType.of(38, in_type.scale)
         return DOUBLE if in_type.is_floating else BIGINT
     if kind == "avg":
         if isinstance(in_type, DecimalType):

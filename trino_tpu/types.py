@@ -93,14 +93,19 @@ class DecimalType(Type):
 
     Reference: spi/type/DecimalType.java; arithmetic rules follow
     spi/type/DecimalOperators semantics for the subset we support.
-    """
+    precision > 18 (the reference's Int128 long decimals) is supported as an
+    AGGREGATION RESULT type: sum/avg accumulate in two int64 limbs
+    (ops/hashagg sum_hi32/sum_lo32, the Int128 state of
+    DecimalSumAggregation.java) and finalize exactly on the host; wide
+    decimal COLUMN STORAGE (connector values past 18 digits) remains
+    unsupported and is rejected at the decode sites."""
 
     precision: int = 18
     scale: int = 0
 
     def __post_init__(self):
-        if self.precision > 18:
-            raise NotImplementedError("long decimals (precision>18) not supported yet")
+        if self.precision > 38:
+            raise NotImplementedError(f"decimal precision {self.precision} > 38")
 
     @staticmethod
     def of(precision: int, scale: int) -> "DecimalType":
@@ -210,7 +215,7 @@ def common_super_type(a: Type, b: Type) -> Type:
     if a.is_decimal and b.is_decimal:
         scale = max(a.scale, b.scale)
         intd = max(a.precision - a.scale, b.precision - b.scale)
-        return DecimalType.of(min(intd + scale, 18), scale)
+        return DecimalType.of(min(intd + scale, 38), scale)
     if a.is_decimal and b.is_integer:
         return common_super_type(a, DecimalType.of(18, 0))
     if b.is_decimal and a.is_integer:
