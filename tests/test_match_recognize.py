@@ -95,3 +95,62 @@ def test_next_navigation(px_engine):
     # rows whose NEXT price is higher (one-row matches)
     a_days = [r[1] for r in rows if r[0] == "a"]
     assert a_days == [3, 4]  # 7<9, 9<12
+
+
+# ---------------------------------------------------------------- round 3
+def test_alternation_group(px_engine):
+    """(U|D)+ — alternation inside a quantified group (reference: pattern
+    alternation, leftmost-preferred): classify every move as up or down."""
+    e, s = px_engine
+    rows = e.execute_sql("""
+        select * from px match_recognize (
+          partition by sym order by d
+          measures first(m.price) as st, last(u.price) as lastup,
+                   last(dn.price) as lastdn
+          pattern (m (u|dn)+)
+          define u as price > prev(price), dn as price < prev(price)
+        ) as x order by sym""", s).rows()
+    # one maximal match per partition: every subsequent row is up or down
+    assert len(rows) == 2
+    a = [r for r in rows if r[0] == "a"][0]
+    assert a[1] == 10.0 and a[2] == 12.0 and a[3] == 11.0  # d=6: 11 < 12
+    b = [r for r in rows if r[0] == "b"][0]
+    assert b[1] == 5.0 and b[2] == 8.0 and b[3] == 3.0
+
+
+def test_all_rows_per_match(px_engine):
+    """ALL ROWS PER MATCH: every matched input row survives with its input
+    columns plus RUNNING-semantics measures (the reference's ALL ROWS
+    default: each row sees the match only up to itself)."""
+    e, s = px_engine
+    rows = e.execute_sql("""
+        select sym, d, price, low from px match_recognize (
+          partition by sym order by d
+          measures last(dn.price) as low
+          all rows per match
+          pattern (st dn+)
+          define dn as price < prev(price)
+        ) as x order by sym, d""", s).rows()
+    # partition a: match rows d=1..3 (10 > 8 > 7); partition b: d=2..4 (6>4>3)
+    a_rows = [r for r in rows if r[0] == "a"]
+    assert a_rows == [("a", 1, 10.0, None), ("a", 2, 8.0, 8.0),
+                      ("a", 3, 7.0, 7.0),
+                      ("a", 5, 12.0, None), ("a", 6, 11.0, 11.0)]
+    b_rows = [r for r in rows if r[0] == "b"]
+    assert b_rows == [("b", 2, 6.0, None), ("b", 3, 4.0, 4.0),
+                      ("b", 4, 3.0, 3.0)]
+
+
+def test_alternation_all_rows_combined(px_engine):
+    e, s = px_engine
+    rows = e.execute_sql("""
+        select sym, d, price from px match_recognize (
+          partition by sym order by d
+          measures first(m.price) as st
+          all rows per match
+          pattern (m (u|dn)+)
+          define u as price > prev(price), dn as price < prev(price)
+        ) as x order by sym, d""", s).rows()
+    # the whole series matches in each partition (every step is up or down)
+    assert len([r for r in rows if r[0] == "a"]) == 6
+    assert len([r for r in rows if r[0] == "b"]) == 5

@@ -292,10 +292,21 @@ def test_window_frame_errors(engine):
     import pytest
 
     s = engine.create_session("tpch")
-    with pytest.raises(SemanticError, match="RANGE frames with offset"):
+    # RANGE offset frames are supported (round 3) — but still require exactly
+    # one numeric/date ORDER BY key
+    with pytest.raises(SemanticError, match="exactly one ORDER BY"):
         engine.execute_sql(
-            "select sum(n_nationkey) over (order by n_nationkey "
+            "select sum(n_nationkey) over (order by n_regionkey, n_nationkey "
             "range between 2 preceding and current row) from nation", s)
+    with pytest.raises(SemanticError, match="numeric or date"):
+        engine.execute_sql(
+            "select sum(n_nationkey) over (order by n_name "
+            "range between 2 preceding and current row) from nation", s)
+    rows = engine.execute_sql(
+        "select n_nationkey, sum(n_nationkey) over (order by n_nationkey "
+        "range between 2 preceding and current row) s "
+        "from nation order by n_nationkey", s).rows()
+    assert rows[5] == (5, 3 + 4 + 5)
     with pytest.raises(SemanticError, match="reversed"):
         engine.execute_sql(
             "select sum(n_nationkey) over (order by n_nationkey "

@@ -1624,7 +1624,9 @@ def _run_match_recognize(node: P.MatchRecognize, child: Page, cdicts):
     defined = dict(node.defines)
     jc = [jnp.asarray(c) for c in ext_cols]
     jn = [None if m is None else jnp.asarray(m) for m in ext_nulls]
-    for var, _ in node.pattern:
+    all_vars = [v for el, _ in node.pattern
+                for v in (el if isinstance(el, tuple) else (el,))]
+    for var in all_vars:
         e = defined.get(var)
         if e is None:
             conds[var] = np.ones(n, bool)
@@ -1635,27 +1637,46 @@ def _run_match_recognize(node: P.MatchRecognize, child: Page, cdicts):
                 arr = arr & ~np.asarray(jnp.broadcast_to(nu, (n,)))
             conds[var] = arr.astype(bool)
 
+    def elem_conds(el):
+        """(row-acceptance vector, per-row matched variable).  Alternation
+        prefers the LEFTMOST alternative whose condition holds at each row —
+        the reference's alternation preference order."""
+        if not isinstance(el, tuple):
+            return conds[el], None
+        ok = np.zeros(n, bool)
+        who = np.empty(n, object)
+        for v in reversed(el):
+            c = conds[v]
+            who[c] = v
+            ok |= c
+        return ok, who
+
+    pat_info = [elem_conds(el) + (q, el) for el, q in node.pattern]
+
     def find_match(start, end):
         """Greedy with backtracking (regex semantics); returns
         (stop, [(row, var), ...]) or None."""
-        pat = node.pattern
+        pat = pat_info
 
         def rec(i, pi):
             if pi == len(pat):
                 return i, []
-            var, q = pat[pi]
-            ok = conds[var]
+            ok, who, q, el = pat[pi]
+
+            def tag(k):
+                return who[k] if who is not None else el
+
             if q is None:
                 if i < end and ok[i]:
                     r = rec(i + 1, pi + 1)
                     if r is not None:
-                        return r[0], [(i, var)] + r[1]
+                        return r[0], [(i, tag(i))] + r[1]
                 return None
             if q == "?":
                 if i < end and ok[i]:
                     r = rec(i + 1, pi + 1)
                     if r is not None:
-                        return r[0], [(i, var)] + r[1]
+                        return r[0], [(i, tag(i))] + r[1]
                 return rec(i, pi + 1)
             j = i
             while j < end and ok[j]:
@@ -1664,7 +1685,7 @@ def _run_match_recognize(node: P.MatchRecognize, child: Page, cdicts):
             while j >= lo:
                 r = rec(j, pi + 1)
                 if r is not None:
-                    return r[0], [(k, var) for k in range(i, j)] + r[1]
+                    return r[0], [(k, tag(k)) for k in range(i, j)] + r[1]
                 j -= 1
             return None
 
@@ -1700,10 +1721,37 @@ def _run_match_recognize(node: P.MatchRecognize, child: Page, cdicts):
                 nm = nulls[ch]
                 vals.append(None if (nm is not None and nm[row])
                             else cols[ch][row])
-            pvals = tuple(
-                None if (nulls[ch] is not None and nulls[ch][i])
-                else cols[ch][i] for ch in node.partition)
-            out_rows.append(pvals + tuple(vals))
+            if getattr(node, "all_rows", False):
+                # ALL ROWS PER MATCH: one output row per matched input row —
+                # all input columns plus RUNNING-semantics measures (the
+                # reference's default for ALL ROWS: each row sees the match
+                # only up to itself, RowsPerMatch + RUNNING evaluation)
+                for r, _var in assign:
+                    vals_r = []
+                    for kind, var, ch, _ in node.measures:
+                        if kind == "col":
+                            row = r
+                        elif var is not None:
+                            rows_v = [x for x in by_var.get(var, ())
+                                      if x <= r]
+                            if not rows_v:
+                                vals_r.append(None)
+                                continue
+                            row = rows_v[0] if kind == "first" else rows_v[-1]
+                        else:
+                            row = i if kind == "first" else r
+                        nm = nulls[ch]
+                        vals_r.append(None if (nm is not None and nm[row])
+                                      else cols[ch][row])
+                    rvals = tuple(
+                        None if (nulls[ch] is not None and nulls[ch][r])
+                        else cols[ch][r] for ch in range(len(cols)))
+                    out_rows.append(rvals + tuple(vals_r))
+            else:
+                pvals = tuple(
+                    None if (nulls[ch] is not None and nulls[ch][i])
+                    else cols[ch][i] for ch in node.partition)
+                out_rows.append(pvals + tuple(vals))
             i = stop
 
     # assemble the output page
@@ -1720,10 +1768,14 @@ def _run_match_recognize(node: P.MatchRecognize, child: Page, cdicts):
                 arr[r] = row[j]
         out_cols.append(jnp.asarray(arr))
         out_nulls.append(jnp.asarray(nm) if nm.any() else None)
-    dicts = tuple(cdicts[ch] if cdicts and ch < len(cdicts) else None
-                  for ch in node.partition) \
-        + tuple(cdicts[ch] if cdicts and ch < len(cdicts) else None
-                for _, _, ch, _ in node.measures)
+    measure_dicts = tuple(cdicts[ch] if cdicts and ch < len(cdicts) else None
+                          for _, _, ch, _ in node.measures)
+    if getattr(node, "all_rows", False):
+        dicts = tuple(cdicts[ch] if cdicts and ch < len(cdicts) else None
+                      for ch in range(len(cols))) + measure_dicts
+    else:
+        dicts = tuple(cdicts[ch] if cdicts and ch < len(cdicts) else None
+                      for ch in node.partition) + measure_dicts
     page = Page(node.schema, tuple(out_cols), tuple(out_nulls), None)
     return page, dicts
 
@@ -2003,7 +2055,26 @@ def _window_kernel(specs, cols, nulls):
         frame = getattr(s, "frame", None)
         lo_f = hi_f = empty_f = None
         if frame is not None:
-            lo_f, hi_f = W.frame_bounds(part_new, peer_new, frame)
+            order_vals = None
+            if frame[0] == "range" and (frame[1] in ("p", "f")
+                                        or frame[3] in ("p", "f")):
+                # value-offset RANGE bounds: the single ORDER BY key's sorted
+                # values, ascending-normalized, with NULL rows pushed past the
+                # reachable range so they frame only among themselves
+                k0 = s.order[0]
+                ov = cols[k0.channel][perm]
+                if not k0.ascending:
+                    ov = -ov
+                nm0 = nulls[k0.channel]
+                if nm0 is not None:
+                    nmv = nm0[perm]
+                    gap = 2 * (max(frame[2], frame[4]) + 1)
+                    nn_min = jnp.min(jnp.where(nmv, jnp.max(ov), ov))
+                    nn_max = jnp.max(jnp.where(nmv, jnp.min(ov), ov))
+                    sent = nn_min - gap if bool(k0.nulls_first) else nn_max + gap
+                    ov = jnp.where(nmv, sent, ov)
+                order_vals = ov
+            lo_f, hi_f = W.frame_bounds(part_new, peer_new, frame, order_vals)
             empty_f = hi_f < lo_f
 
         def wsum(v, dt=None):
@@ -2073,16 +2144,28 @@ def _window_kernel(specs, cols, nulls):
             off = s.offset if s.kind == "lag" else -s.offset
             fill = (jnp.zeros((), vals.dtype) if s.default is None
                     else jnp.asarray(s.default, vals.dtype))
-            res, miss = W.shift_in_partition(vals, part_new, off, fill)
-            if s.default is None:
-                null_out = miss
+            if getattr(s, "ignore_nulls", False) and vmask is not None:
+                # navigate over NON-NULL rows only (reference: the ignoreNulls
+                # walk of operator/window/LagFunction.java, here rank
+                # arithmetic over a nonnull-position index)
+                res, miss = W.shift_ignore_nulls(vals, vmask, part_new, off,
+                                                 fill)
+                if s.default is None:
+                    null_out = miss
+                else:
+                    res = jnp.where(miss, fill, res)
+                    null_out = jnp.zeros((n,), bool)
             else:
-                res = jnp.where(miss, fill, res)
-                null_out = jnp.zeros((n,), bool)
-            if vmask is not None:
-                shifted_null, _ = W.shift_in_partition(
-                    (~vmask), part_new, off, jnp.zeros((), bool))
-                null_out = null_out | (shifted_null & ~miss)
+                res, miss = W.shift_in_partition(vals, part_new, off, fill)
+                if s.default is None:
+                    null_out = miss
+                else:
+                    res = jnp.where(miss, fill, res)
+                    null_out = jnp.zeros((n,), bool)
+                if vmask is not None:
+                    shifted_null, _ = W.shift_in_partition(
+                        (~vmask), part_new, off, jnp.zeros((), bool))
+                    null_out = null_out | (shifted_null & ~miss)
         elif s.kind in ("percent_rank", "cume_dist"):
             size = W.partition_total(jnp.ones((n,), jnp.int64), part_new)
             if s.kind == "percent_rank":
@@ -2110,23 +2193,34 @@ def _window_kernel(specs, cols, nulls):
             k = s.offset
             starts = lo_f if frame is not None else W._starts(part_new)
             frame_end = hi_f if frame is not None else W._ends(peer_new)
-            frame_size = frame_end - starts + 1
-            idx = jnp.clip(starts + (k - 1), 0, n - 1)
-            res = vals[idx]
-            null_out = frame_size < k  # frame shorter than k (or empty) -> NULL
-            if vmask is not None:
-                null_out = null_out | ~vmask[idx]
-        elif s.kind in ("first_value", "last_value"):
-            if frame is not None:
-                idx = jnp.clip(lo_f if s.kind == "first_value" else hi_f, 0, n - 1)
-                null_out = empty_f
+            if getattr(s, "ignore_nulls", False) and vmask is not None:
+                res, miss = W.framed_nth_nonnull(vals, vmask, starts,
+                                                 frame_end, k)
+                null_out = miss
             else:
-                idx = (W._starts(part_new) if s.kind == "first_value"
-                       else W._ends(peer_new if framed else part_new))
-            res = vals[idx]
-            if vmask is not None:
-                miss = ~vmask[idx]
-                null_out = miss if null_out is None else (null_out | miss)
+                frame_size = frame_end - starts + 1
+                idx = jnp.clip(starts + (k - 1), 0, n - 1)
+                res = vals[idx]
+                null_out = frame_size < k  # frame shorter than k -> NULL
+                if vmask is not None:
+                    null_out = null_out | ~vmask[idx]
+        elif s.kind in ("first_value", "last_value"):
+            starts = lo_f if frame is not None else W._starts(part_new)
+            frame_end = (hi_f if frame is not None
+                         else W._ends(peer_new if framed else part_new))
+            if getattr(s, "ignore_nulls", False) and vmask is not None:
+                res, miss = W.framed_nth_nonnull(
+                    vals, vmask, starts, frame_end, 1,
+                    from_end=(s.kind == "last_value"))
+                null_out = miss
+            else:
+                idx = jnp.clip(starts if s.kind == "first_value" else frame_end,
+                               0, n - 1)
+                null_out = empty_f
+                res = vals[idx]
+                if vmask is not None:
+                    miss = ~vmask[idx]
+                    null_out = miss if null_out is None else (null_out | miss)
         else:
             raise NotImplementedError(s.kind)
 

@@ -119,12 +119,17 @@ def partition_total(vals, part_new, dtype=None):
 # "f" k FOLLOWING | "uf" UNBOUNDED FOLLOWING.
 
 
-def frame_bounds(part_new, peer_new, frame):
+def frame_bounds(part_new, peer_new, frame, order_vals=None):
     """Per-row inclusive [lo, hi] global sorted indices of the frame.
 
     ROWS frames are index arithmetic clamped to the partition; RANGE frames
     with non-offset bounds use peer-group edges (CURRENT ROW in RANGE means
-    "through my peers").  hi < lo encodes an empty frame."""
+    "through my peers"); RANGE ``k PRECEDING/FOLLOWING`` bounds need
+    ``order_vals`` — the single ORDER BY key's values in sorted order,
+    ascending-normalized — and resolve by searchsorted over a
+    partition-offset monotonic key (one global binary search instead of
+    per-partition scans; reference: WindowPartition's value-based frame
+    positions in operator/window/).  hi < lo encodes an empty frame."""
     unit, s_type, s_k, e_type, e_k = frame
     n = part_new.shape[0]
     i = jnp.arange(n, dtype=jnp.int32)
@@ -132,12 +137,89 @@ def frame_bounds(part_new, peer_new, frame):
     if unit == "rows":
         lo = {"up": p_start, "p": i - s_k, "cr": i, "f": i + s_k}[s_type]
         hi = {"uf": p_end, "p": i - e_k, "cr": i, "f": i + e_k}[e_type]
+    elif s_type in ("p", "f") or e_type in ("p", "f"):
+        # value-offset RANGE bounds: build a globally-monotonic key
+        # w = (v - vmin) + seg * span, where span exceeds any in-partition
+        # value range plus the largest offset — values stay ordered within a
+        # partition and every partition's keys sit strictly above the last
+        v = order_vals
+        seg = jnp.cumsum(part_new.astype(v.dtype if jnp.issubdtype(
+            v.dtype, jnp.floating) else jnp.int64))
+        vmin = jnp.min(v)
+        span = (jnp.max(v) - vmin) + (max(s_k, e_k) + 1)
+        base = (v - vmin) + seg * span
+        w = base  # rows are sorted by (partition, v): w is non-decreasing
+
+        def at(delta, side):
+            q = base + delta
+            r = jnp.searchsorted(w, q, side=side).astype(jnp.int32)
+            return r if side == "left" else r - 1
+
+        lo = {"up": p_start, "cr": _starts(peer_new)}.get(s_type)
+        if lo is None:
+            lo = at(-s_k if s_type == "p" else s_k, "left")
+        hi = {"uf": p_end, "cr": _ends(peer_new)}.get(e_type)
+        if hi is None:
+            hi = at(e_k if e_type == "f" else -e_k, "right")
     else:  # range: peer-group granularity
         lo = {"up": p_start, "cr": _starts(peer_new)}[s_type]
         hi = {"uf": p_end, "cr": _ends(peer_new)}[e_type]
     lo = jnp.maximum(lo, p_start)
     hi = jnp.minimum(hi, p_end)
     return lo, hi
+
+
+# ------------------------------------------------------------------ IGNORE NULLS
+def nonnull_positions(valid):
+    """(g, P): g[i] = 1-based count of non-null rows through i (global, sorted
+    order); P[r] = global index of the r-th non-null row (P[0] is a sink).
+    The navigation-function primitives below resolve IGNORE NULLS by rank
+    arithmetic over (g, P) — dense cumsum + scatter + gather, no row loops
+    (reference: the ignoreNulls paths of operator/window/LagFunction.java
+    and friends, which walk row-by-row)."""
+    n = valid.shape[0]
+    g = jnp.cumsum(valid.astype(jnp.int32))
+    P = jnp.zeros((n + 1,), jnp.int32).at[
+        jnp.where(valid, g, 0)].set(jnp.arange(n, dtype=jnp.int32))
+    return g, P
+
+
+def shift_ignore_nulls(vals, valid, part_new, offset: int, default):
+    """lag/lead over NON-NULL rows only: the k-th non-null row before (after)
+    each row within its partition.  offset > 0 = lag, < 0 = lead."""
+    if offset == 0:
+        # offset 0 addresses the CURRENT row (reference: LagFunction with
+        # offset 0); a NULL current value stays NULL even under IGNORE NULLS
+        return vals, ~valid
+    if offset < 0:
+        # lead = lag over the reversed order; partition boundaries flip from
+        # first-of-group marks to (reversed) last-of-group marks
+        is_last = jnp.concatenate([part_new[1:], jnp.ones((1,), bool)])
+        res, miss = shift_ignore_nulls(jnp.flip(vals), jnp.flip(valid),
+                                       jnp.flip(is_last), -offset, default)
+        return jnp.flip(res), jnp.flip(miss)
+    n = vals.shape[0]
+    g, P = nonnull_positions(valid)
+    # rank of the target: non-nulls strictly before me, minus (offset-1)
+    target = g - valid.astype(jnp.int32) - (offset - 1)
+    cand = P[jnp.clip(target, 0, n)]
+    ok = (target >= 1) & (cand >= _starts(part_new))
+    return jnp.where(ok, vals[jnp.clip(cand, 0, n - 1)], default), ~ok
+
+
+def framed_nth_nonnull(vals, valid, lo, hi, k: int, from_end: bool = False):
+    """(value, missing): the k-th non-null row inside each row's [lo, hi]
+    frame, counted from the start (or from the end for last_value)."""
+    n = vals.shape[0]
+    g, P = nonnull_positions(valid)
+    before_lo = jnp.where(lo > 0, g[jnp.maximum(lo - 1, 0)], 0)
+    in_frame = g[jnp.clip(hi, 0, n - 1)] - before_lo
+    rank = jnp.where(jnp.asarray(from_end), before_lo + in_frame - (k - 1),
+                     before_lo + k)
+    cand = P[jnp.clip(rank, 0, n)]
+    ok = (hi >= lo) & (in_frame >= k) & (rank >= 1)
+    return jnp.where(ok, vals[jnp.clip(cand, 0, n - 1)],
+                     jnp.zeros((), vals.dtype)), ~ok
 
 
 def framed_sum(vals, lo, hi, dtype=None):

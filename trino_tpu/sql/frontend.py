@@ -400,8 +400,10 @@ class Planner:
                     f"DISTINCT in window aggregate {name} not supported yet")
             pchs = tuple(channel_of(p)[0] for p in w.partition_by)
             order = []
+            order_types = []
             for s in w.order_by:
-                och, _, od = channel_of(s.expr)
+                och, _ot, od = channel_of(s.expr)
+                order_types.append(_ot)
                 if od is not None and od.values is not None:
                     # dictionary ids are not collation-ordered: order by a projected
                     # id->collation-rank channel instead (same reason _sort_page
@@ -473,9 +475,24 @@ class Planner:
                 unit, s_type, s_k, e_type, e_k = frame
                 if unit == "range" and ("p" in (s_type, e_type)
                                         or "f" in (s_type, e_type)):
-                    raise SemanticError(
-                        "RANGE frames with offset bounds are not supported "
-                        "(use ROWS, or UNBOUNDED/CURRENT ROW bounds)")
+                    # value-offset RANGE bounds (reference: the analyzer's
+                    # frame-type checks): exactly one numeric/date sort key;
+                    # decimal offsets scale to the key's raw representation
+                    if len(order) != 1:
+                        raise SemanticError(
+                            "RANGE offset frames need exactly one ORDER BY key")
+                    ot = order_types[0]
+                    if isinstance(ot, DecimalType):
+                        if s_type in ("p", "f"):
+                            s_k *= 10 ** ot.scale
+                        if e_type in ("p", "f"):
+                            e_k *= 10 ** ot.scale
+                        frame = (unit, s_type, s_k, e_type, e_k)
+                    elif not (ot.is_integer or ot.is_floating
+                              or ot.name == "date"):
+                        raise SemanticError(
+                            "RANGE offset frames need a numeric or date "
+                            f"ORDER BY key, got {ot.name}")
                 # statically-ordered bounds: start must not follow end, and
                 # UNBOUNDED FOLLOWING/PRECEDING are end-only/start-only
                 # (reference: the analyzer rejects reversed frames outright)
@@ -489,8 +506,14 @@ class Planner:
                 if kind in ("row_number", "rank", "dense_rank", "percent_rank",
                             "cume_dist", "ntile", "lag", "lead"):
                     frame = None  # ranking/offset functions ignore the frame
+            ignore_nulls = bool(getattr(w, "ignore_nulls", False))
+            if ignore_nulls and kind not in ("lag", "lead", "first_value",
+                                             "last_value", "nth_value"):
+                raise SemanticError(
+                    f"IGNORE NULLS is only valid for navigation functions, "
+                    f"not {name}")
             specs.append(P.WindowSpec(kind, arg_ch, pchs, order, f"#w{j}", t, offset,
-                                      default, frame))
+                                      default, frame, ignore_nulls))
             out_info.append((f"#w{j}", t,
                              arg_d if kind in ("min", "max", "lag", "lead",
                                                "first_value", "last_value",
@@ -1164,7 +1187,8 @@ class Planner:
         PatternRecognitionNode planning; see plan.MatchRecognize for the
         supported subset."""
         rel = self._plan_relation(node.input)
-        var_names = {v for v, _ in node.pattern}
+        var_names = {v for el, _ in node.pattern
+                     for v in (el if isinstance(el, tuple) else (el,))}
         for v, _ in node.defines:
             if v not in var_names:
                 raise SemanticError(f"DEFINE variable {v} not in PATTERN")
@@ -1269,16 +1293,31 @@ class Planner:
             measures.append((kind, var, ch, m_name))
             out_infos.append(ColumnInfo(node.alias, m_name, c.type, c.dict))
 
-        part_fields = [Field(rel.cols[ch].name or f"p{i}", rel.cols[ch].type)
-                       for i, ch in enumerate(pchs)]
-        schema = Schema(tuple(part_fields)
-                        + tuple(Field(n, rel.cols[ch].type)
-                                for _, _, ch, n in measures))
+        all_rows = bool(getattr(node, "all_rows", False))
+        if all_rows:
+            # ALL ROWS PER MATCH: every matched input row, all input columns,
+            # plus the (FINAL-semantics) measures (reference:
+            # RowsPerMatch.ALL_SHOW_EMPTY minus empty-match output)
+            base_fields = [Field(c.name or f"c{i}", c.type)
+                           for i, c in enumerate(rel.cols)]
+            schema = Schema(tuple(base_fields)
+                            + tuple(Field(n, rel.cols[ch].type)
+                                    for _, _, ch, n in measures))
+            cols = [ColumnInfo(node.alias, c.name, c.type, c.dict)
+                    for c in rel.cols] + out_infos
+        else:
+            part_fields = [Field(rel.cols[ch].name or f"p{i}",
+                                 rel.cols[ch].type)
+                           for i, ch in enumerate(pchs)]
+            schema = Schema(tuple(part_fields)
+                            + tuple(Field(n, rel.cols[ch].type)
+                                    for _, _, ch, n in measures))
+            cols = [ColumnInfo(node.alias, rel.cols[ch].name,
+                               rel.cols[ch].type, rel.cols[ch].dict)
+                    for ch in pchs] + out_infos
         mr = P.MatchRecognize(pnode, tuple(pchs), tuple(order), node.pattern,
                               tuple(defines), tuple(nav), tuple(measures),
-                              schema)
-        cols = [ColumnInfo(node.alias, rel.cols[ch].name, rel.cols[ch].type,
-                           rel.cols[ch].dict) for ch in pchs] + out_infos
+                              schema, all_rows)
         return RelPlan(mr, cols, [])
 
     def _measure_spec(self, ast, var_names, cols):

@@ -116,6 +116,7 @@ class WindowCall(Node):
     partition_by: tuple
     order_by: tuple  # SortItem...
     frame: tuple = None
+    ignore_nulls: bool = False  # lag(x) IGNORE NULLS OVER (...)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -230,9 +231,11 @@ class MatchRecognizeRef(Node):
     partition_by: tuple
     order_by: tuple  # SortItem...
     measures: tuple  # ((expr, name), ...)
-    pattern: tuple  # ((var, quantifier|None), ...)
+    pattern: tuple  # ((element, quantifier|None), ...); element = variable
+    # name, or a tuple of variable names for an alternation group (A|B)
     defines: tuple  # ((var, expr), ...)
     alias: Optional[str] = None
+    all_rows: bool = False  # ALL ROWS PER MATCH (default: ONE ROW PER MATCH)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -423,7 +426,7 @@ _TOKEN_RE = re.compile(
   | (?P<number>\d+(\.\d*)?([eE][+-]?\d+)?|\.\d+)
   | (?P<string>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*|"(?:[^"]|"")*")
-  | (?P<op><=|>=|<>|!=|\|\||[-+*/%(),.;<>=?\[\]])
+  | (?P<op><=|>=|<>|!=|\|\||[-+*/%(),.;<>=?\[\]|])
     """,
     re.VERBOSE,
 )
@@ -938,11 +941,18 @@ class Parser:
                 measures.append((e, self.expect_kind("ident").value))
                 if not self.accept(","):
                     break
+        all_rows = False
         if self.peek().value == "one":  # ONE ROW PER MATCH (the default)
             self.next()
             self._expect_ident("row")
             self._expect_ident("per")
             self._expect_ident("match")
+        elif self.peek().value == "all":  # ALL ROWS PER MATCH
+            self.next()
+            self._expect_ident("rows")
+            self._expect_ident("per")
+            self._expect_ident("match")
+            all_rows = True
         if self.peek().value == "after":  # AFTER MATCH SKIP PAST LAST ROW only
             self.next()
             self._expect_ident("match")
@@ -956,12 +966,23 @@ class Parser:
         self.expect("(")
         pattern = []
         while not (self.peek().kind == "op" and self.peek().value == ")"):
-            var = self.expect_kind("ident").value
+            if self.peek().kind == "op" and self.peek().value == "(":
+                # alternation group (A|B|...) — reference grammar
+                # patternAlternation; subset: single variables per branch
+                self.next()
+                alts = [self.expect_kind("ident").value]
+                while self.peek().kind == "op" and self.peek().value == "|":
+                    self.next()
+                    alts.append(self.expect_kind("ident").value)
+                self.expect(")")
+                element = tuple(alts)
+            else:
+                element = self.expect_kind("ident").value
             quant = None
             t = self.peek()
             if t.kind == "op" and t.value in ("*", "+", "?"):
                 quant = self.next().value
-            pattern.append((var, quant))
+            pattern.append((element, quant))
         self.expect(")")
         defines = []
         if self.peek().value == "define":
@@ -975,7 +996,7 @@ class Parser:
         self.expect(")")
         return MatchRecognizeRef(base, tuple(partition), tuple(order),
                                  tuple(measures), tuple(pattern),
-                                 tuple(defines), self._table_alias())
+                                 tuple(defines), self._table_alias(), all_rows)
 
     def _table_alias(self) -> Optional[str]:
         if self.accept("as"):
@@ -1264,6 +1285,13 @@ class Parser:
                     args = tuple(arg_list)
                 self.expect(")")
                 fc = FuncCall(name, args, distinct)
+                # null-treatment clause for navigation functions (reference
+                # grammar: nullTreatment before OVER)
+                ignore_nulls = False
+                if self.peek().value in ("ignore", "respect") \
+                        and self.peek(1).value == "nulls":
+                    ignore_nulls = self.next().value == "ignore"
+                    self.next()
                 if self.accept("over"):
                     self.expect("(")
                     partition = []
@@ -1280,7 +1308,10 @@ class Parser:
                             order.append(self.parse_sort_item())
                     frame = self._parse_frame_clause()
                     self.expect(")")
-                    return WindowCall(fc, tuple(partition), tuple(order), frame)
+                    return WindowCall(fc, tuple(partition), tuple(order), frame,
+                                      ignore_nulls)
+                if ignore_nulls:
+                    raise ParseError("IGNORE NULLS requires an OVER clause")
                 return fc
             parts = [self.next().value]
             while self.peek().kind == "op" and self.peek().value == "." and self.peek(1).kind == "ident":

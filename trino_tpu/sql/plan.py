@@ -180,6 +180,7 @@ class WindowSpec:
     default: object = None  # lag/lead third argument (raw constant), None = NULL
     frame: tuple = None  # explicit (unit, s_type, s_k, e_type, e_k) frame spec
     # (parser.WindowCall.frame); None = default RANGE UNBOUNDED..CURRENT ROW
+    ignore_nulls: bool = False  # navigation functions skip NULL inputs
 
 
 @dataclasses.dataclass(frozen=True)
@@ -210,11 +211,15 @@ class MatchRecognize(PlanNode):
     child: PlanNode
     partition: tuple  # child channel indices
     order: tuple  # SortKey over child channels
-    pattern: tuple  # ((var, quantifier|None), ...)
+    pattern: tuple  # ((element, quantifier|None), ...); element = var name or
+    # tuple of var names (alternation group, leftmost-preferred like the
+    # reference's pattern alternation)
     defines: tuple  # ((var, ir.Expr over extended channels), ...)
     nav: tuple  # ((base_channel, offset), ...) appended shifted channels
     measures: tuple  # ((kind 'first'|'last'|'col', var|None, channel, name), ...)
-    schema: Schema  # partition fields + measure fields
+    schema: Schema  # ONE ROW: partition + measure fields;
+    # ALL ROWS: child fields + measure fields
+    all_rows: bool = False  # ALL ROWS PER MATCH output mode
 
     @property
     def children(self):
