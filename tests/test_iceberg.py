@@ -1,0 +1,180 @@
+"""Iceberg connector: hadoop-table layout metadata -> manifests -> parquet
+data files, with file-level bound pruning (reference:
+plugin/trino-iceberg/.../IcebergMetadata.java:466, IcebergSplitSource;
+manifest reading via the avro container format).
+
+The fixture fabricates a spec-shaped table: v1 metadata JSON +
+version-hint.text, an avro manifest list, an avro manifest whose entries
+carry per-file record counts and lower/upper bounds (iceberg single-value
+serialization), and parquet data files — including a DELETED entry that must
+be skipped and two live files with disjoint key ranges for pruning."""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.iceberg import IcebergConnector
+from trino_tpu.formats.avro import write_container
+
+KV = {"type": "record", "name": "kv", "fields": [
+    {"name": "key", "type": "int"}, {"name": "value", "type": "bytes"}]}
+
+MANIFEST_ENTRY = {"type": "record", "name": "manifest_entry", "fields": [
+    {"name": "status", "type": "int"},
+    {"name": "snapshot_id", "type": ["null", "long"]},
+    {"name": "data_file", "type": {"type": "record", "name": "r2", "fields": [
+        {"name": "content", "type": "int"},
+        {"name": "file_path", "type": "string"},
+        {"name": "file_format", "type": "string"},
+        {"name": "record_count", "type": "long"},
+        {"name": "file_size_in_bytes", "type": "long"},
+        {"name": "lower_bounds", "type": ["null", {"type": "array",
+                                                   "items": KV}]},
+        {"name": "upper_bounds", "type": ["null", {"type": "array",
+                                                   "items": KV}]},
+    ]}},
+]}
+
+MANIFEST_FILE = {"type": "record", "name": "manifest_file", "fields": [
+    {"name": "manifest_path", "type": "string"},
+    {"name": "manifest_length", "type": "long"},
+    {"name": "partition_spec_id", "type": "int"},
+]}
+
+
+def _long(v):
+    return struct.pack("<q", v)
+
+
+@pytest.fixture(scope="module")
+def warehouse(tmp_path_factory):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    root = tmp_path_factory.mktemp("icewh")
+    tdir = root / "events"
+    (tdir / "metadata").mkdir(parents=True)
+    (tdir / "data").mkdir()
+
+    def datafile(name, ids, names, amounts):
+        path = tdir / "data" / name
+        pq.write_table(pa.table({
+            "id": pa.array(ids, pa.int64()),
+            "name": pa.array(names),
+            "amount": pa.array(amounts, pa.float64()),
+        }), path, row_group_size=4)
+        return str(path)
+
+    f1 = datafile("f1.parquet", list(range(0, 10)),
+                  [f"u{i % 3}" for i in range(10)],
+                  [float(i) for i in range(10)])
+    f2 = datafile("f2.parquet", list(range(100, 110)),
+                  [f"u{i % 5}" for i in range(10)],
+                  [float(i) * 2 for i in range(10)])
+    f3 = datafile("f3.parquet", [999], ["dead"], [0.0])  # DELETED entry
+
+    def bounds(lo_id, hi_id):
+        return ([{"key": 1, "value": _long(lo_id)}],
+                [{"key": 1, "value": _long(hi_id)}])
+
+    entries = []
+    for status, path, n, (lo, hi) in (
+            (1, f1, 10, bounds(0, 9)),
+            (1, f2, 10, bounds(100, 109)),
+            (2, f3, 1, bounds(999, 999))):  # status 2 = deleted
+        entries.append({
+            "status": status, "snapshot_id": 7,
+            "data_file": {
+                "content": 0, "file_path": path, "file_format": "PARQUET",
+                "record_count": n,
+                "file_size_in_bytes": os.path.getsize(path),
+                "lower_bounds": lo, "upper_bounds": hi,
+            }})
+    mpath = str(tdir / "metadata" / "m1.avro")
+    write_container(mpath, MANIFEST_ENTRY, entries, codec="deflate")
+    mlist = str(tdir / "metadata" / "snap-7.avro")
+    write_container(mlist, MANIFEST_FILE,
+                    [{"manifest_path": mpath,
+                      "manifest_length": os.path.getsize(mpath),
+                      "partition_spec_id": 0}])
+
+    meta = {
+        "format-version": 1,
+        "table-uuid": "0000-test",
+        "location": str(tdir),
+        "current-schema-id": 0,
+        "schemas": [{"schema-id": 0, "type": "struct", "fields": [
+            {"id": 1, "name": "id", "type": "long", "required": True},
+            {"id": 2, "name": "name", "type": "string", "required": False},
+            {"id": 3, "name": "amount", "type": "double", "required": False},
+        ]}],
+        "current-snapshot-id": 7,
+        "snapshots": [{"snapshot-id": 7, "manifest-list": mlist}],
+    }
+    with open(tdir / "metadata" / "v3.metadata.json", "w") as f:
+        json.dump(meta, f)
+    with open(tdir / "metadata" / "version-hint.text", "w") as f:
+        f.write("3")
+    return str(root)
+
+
+@pytest.fixture(scope="module")
+def ice_engine(warehouse):
+    e = Engine()
+    e.register_catalog("ice", IcebergConnector(warehouse))
+    return e, e.create_session("ice")
+
+
+def test_iceberg_scan_skips_deleted(ice_engine):
+    e, s = ice_engine
+    rows = e.execute_sql("select count(*) c, sum(id) si from events", s).rows()
+    # 20 live rows; the deleted file's id=999 must not appear
+    assert rows == [(20, sum(range(10)) + sum(range(100, 110)))]
+
+
+def test_iceberg_strings_unified_across_files(ice_engine):
+    e, s = ice_engine
+    rows = e.execute_sql(
+        "select name, count(*) c from events group by name order by name",
+        s).rows()
+    names = [r[0] for r in rows]
+    assert names == sorted(set(f"u{i % 3}" for i in range(10))
+                           | set(f"u{i % 5}" for i in range(10)))
+    assert sum(r[1] for r in rows) == 20
+    assert "dead" not in names
+
+
+def test_iceberg_file_pruning(ice_engine, warehouse):
+    """A selective predicate on id must skip the other file's splits entirely
+    (manifest bounds + row-group stats feed tuple-domain split pruning)."""
+    e, s = ice_engine
+    conn = e.catalogs["ice"]
+    generated = []
+    orig = conn.generate
+    conn.generate = lambda sp, cols: (generated.append(sp), orig(sp, cols))[1]
+    try:
+        rows = e.execute_sql(
+            "select count(*) c from events where id >= 100", s).rows()
+    finally:
+        del conn.generate
+    assert rows == [(10,)]
+    assert generated, "expected at least one split scanned"
+    assert all(sp.file_index == 1 for sp in generated), \
+        "file f1's splits were not pruned"
+
+
+def test_iceberg_column_range_and_tables(ice_engine, warehouse):
+    e, s = ice_engine
+    conn = e.catalogs["ice"]
+    assert conn.tables() == ["events"]
+    assert conn.column_range("events", "id") == (0, 109)
+    # joins against other catalogs work through the same page machinery
+    rows = e.execute_sql(
+        "select count(*) c from events a, events b "
+        "where a.id = b.id and a.amount > 3", s).rows()
+    # amount > 3: f1 has 6 rows (4..9), f2 has 8 (amounts 4,6,...,18)
+    assert rows == [(14,)]

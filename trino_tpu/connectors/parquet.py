@@ -103,6 +103,9 @@ class ParquetConnector:
     def __init__(self, directory: str):
         self.directory = directory
         self._tables: dict = {}
+        # explicit path registrations: table-format connectors (Iceberg) map
+        # manifest-listed data FILES onto this connector's decode machinery
+        self._paths: dict = {}
 
     # -- metadata ----------------------------------------------------------------
     def tables(self):
@@ -119,11 +122,17 @@ class ParquetConnector:
             return t
         import pyarrow.parquet as pq
 
-        path = os.path.join(self.directory, f"{table}.parquet")
+        path = self._paths.get(table) \
+            or os.path.join(self.directory, f"{table}.parquet")
         pf = pq.ParquetFile(path)
         fields, dicts, id_maps = [], {}, {}
         for fld in pf.schema_arrow:
-            ty = _arrow_to_type(fld.type)
+            try:
+                ty = _arrow_to_type(fld.type)
+            except (ValueError, NotImplementedError):
+                # unsupported physical types (structs, raw binary, fixed) are
+                # not exposed as columns; the table stays readable for the rest
+                continue
             fields.append(Field(fld.name, ty))
             if ty.is_string:
                 # table-wide dictionary: one pass over the column's distinct values
