@@ -1,0 +1,98 @@
+"""SQL routines (CREATE FUNCTION) + table functions (round-3 VERDICT missing
+item; reference: sql/routine/SqlRoutineCompiler.java:108,
+spi/function/table/ConnectorTableFunction.java)."""
+
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.sql.frontend import SemanticError
+
+
+@pytest.fixture()
+def eng():
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.01, split_rows=1 << 12))
+    return e, e.create_session("tpch")
+
+
+def test_create_function_inline(eng):
+    e, s = eng
+    e.execute_sql("create function taxed(p double, r double) "
+                  "returns double return p * (1 + r)", s)
+    rows = e.execute_sql("select taxed(100.0, 0.1) t", s).rows()
+    assert rows == [(pytest.approx(110.0),)]
+    # routines work over columns and inside aggregations
+    got = e.execute_sql(
+        "select sum(taxed(o_totalprice, 0.05)) st from orders "
+        "where o_orderkey < 100", s).rows()
+    base = e.execute_sql(
+        "select sum(o_totalprice * 1.05) st from orders "
+        "where o_orderkey < 100", s).rows()
+    assert got[0][0] == pytest.approx(base[0][0])
+
+
+def test_function_composition_and_show(eng):
+    e, s = eng
+    e.execute_sql("create function twice(x bigint) returns bigint "
+                  "return x * 2", s)
+    e.execute_sql("create function quad(x bigint) returns bigint "
+                  "return twice(twice(x))", s)
+    assert e.execute_sql("select quad(3) q", s).rows() == [(12,)]
+    fns = e.execute_sql("show functions", s).rows()
+    routines = {r[0]: r for r in fns if r[1] == "routine"}
+    assert set(routines) == {"twice", "quad"}
+    # replace + drop
+    e.execute_sql("create or replace function twice(x bigint) "
+                  "returns bigint return x * 3", s)
+    assert e.execute_sql("select twice(2) t", s).rows() == [(6,)]
+    e.execute_sql("drop function quad", s)
+    with pytest.raises(SemanticError, match="not supported"):
+        e.execute_sql("select quad(1)", s)
+    with pytest.raises(ValueError, match="does not exist"):
+        e.execute_sql("drop function quad", s)
+    e.execute_sql("drop function if exists quad", s)  # no-op
+
+
+def test_function_errors(eng):
+    e, s = eng
+    e.execute_sql("create function f1(x bigint) returns bigint return x", s)
+    with pytest.raises(ValueError, match="already exists"):
+        e.execute_sql("create function f1(x bigint) returns bigint "
+                      "return x", s)
+    with pytest.raises(SemanticError, match="expects 1 arguments"):
+        e.execute_sql("select f1(1, 2)", s)
+    # recursion guard: a self-referential routine can't loop the planner
+    e.execute_sql("create or replace function f1(x bigint) returns bigint "
+                  "return f1(x)", s)
+    with pytest.raises(SemanticError, match="recursion"):
+        e.execute_sql("select f1(1)", s)
+
+
+def test_table_function_sequence(eng):
+    e, s = eng
+    rows = e.execute_sql(
+        "select * from table(sequence(1, 5))", s).rows()
+    assert rows == [(1,), (2,), (3,), (4,), (5,)]
+    rows = e.execute_sql(
+        "select sum(n) sn from table(sequence(0, 100, 10)) as t (n)",
+        s).rows()
+    assert rows == [(550,)]
+    # join against a real table
+    rows = e.execute_sql(
+        "select count(*) c from table(sequence(0, 4)) t(k), nation "
+        "where t.k = n_regionkey", s).rows()
+    assert rows == [(25,)]
+    with pytest.raises(SemanticError, match="step must not be zero"):
+        e.execute_sql("select * from table(sequence(1, 5, 0))", s)
+
+
+def test_routine_param_coercion_and_builtin_conflict(eng):
+    e, s = eng
+    e.execute_sql("create function half(x double) returns double "
+                  "return x / 2", s)
+    # the bigint literal coerces to the declared double param: 2.5, not 2
+    assert e.execute_sql("select half(5) h", s).rows() == [(2.5,)]
+    with pytest.raises(ValueError, match="conflicts with a built-in"):
+        e.execute_sql("create function abs(x bigint) returns bigint "
+                      "return x + 1", s)

@@ -66,6 +66,29 @@ class RelPlan:
     # build side, DetermineJoinDistributionType.java:51)
 
 
+def _rewrite_ast(ast, fn):
+    """Apply fn top-down over every parser Node, recursing through nested
+    tuples (CaseExpr.whens holds (cond, value) pairs)."""
+    def walk(v):
+        if isinstance(v, A.Node):
+            out = fn(v)
+            if out is not v:
+                return out
+            changed = {}
+            for f in v.__dataclass_fields__:
+                fv = getattr(v, f)
+                nv = walk(fv)
+                if nv is not fv:
+                    changed[f] = nv
+            return dataclasses.replace(v, **changed) if changed else v
+        if isinstance(v, tuple):
+            items = tuple(walk(x) for x in v)
+            return items if any(a is not b for a, b in zip(items, v)) else v
+        return v
+
+    return walk(ast)
+
+
 def compile_sql(sql: str, engine, session) -> P.PlanNode:
     ast = A.parse(sql)
     return Planner(engine, session).plan_query(ast)
@@ -1180,7 +1203,46 @@ class Planner:
             return self._plan_subquery_rel(node.query, node.alias, node.columns)
         if isinstance(node, A.MatchRecognizeRef):
             return self._plan_match_recognize(node)
+        if isinstance(node, A.TableFunctionRef):
+            return self._plan_table_function(node)
         raise SemanticError(f"unsupported relation {node}")
+
+    def _plan_table_function(self, node: A.TableFunctionRef) -> RelPlan:
+        """TABLE(fn(...)) invocations (reference:
+        spi/function/table/ConnectorTableFunction.java; sequence() mirrors
+        the built-in SequenceFunction)."""
+        fn = node.func
+
+        def lit_int(e, what):
+            neg = False
+            while isinstance(e, A.UnaryOp) and e.op == "negate":
+                neg = not neg
+                e = e.operand
+            if not isinstance(e, A.NumberLit) or "." in e.text \
+                    or "e" in e.text.lower():
+                raise SemanticError(f"sequence {what} must be an integer literal")
+            v = int(e.text)
+            return -v if neg else v
+
+        if fn.name == "sequence":
+            if not 2 <= len(fn.args) <= 3:
+                raise SemanticError("sequence(start, stop[, step])")
+            start = lit_int(fn.args[0], "start")
+            stop = lit_int(fn.args[1], "stop")
+            step = lit_int(fn.args[2], "step") if len(fn.args) > 2 else 1
+            if step == 0:
+                raise SemanticError("sequence step must not be zero")
+            n = max((stop - start) // step + 1, 0)
+            if n > (1 << 20):
+                raise SemanticError(
+                    f"sequence produces {n} rows (limit {1 << 20})")
+            col = node.column_aliases[0] if node.column_aliases \
+                else "sequential_number"
+            schema = Schema((Field(col, BIGINT),))
+            rows = tuple((start + i * step,) for i in range(n))
+            return RelPlan(P.Values(rows, schema),
+                           [ColumnInfo(node.alias, col, BIGINT, None)], [])
+        raise SemanticError(f"table function {fn.name} not supported")
 
     def _plan_match_recognize(self, node: A.MatchRecognizeRef) -> RelPlan:
         """reference: StatementAnalyzer's pattern-recognition analysis +
@@ -2057,7 +2119,38 @@ class Planner:
             return fdef.builder(self, ast, cols)
         if name in self._COLLECTION_FUNCS:
             return self._translate_collection_func(ast, cols)
+        routine = getattr(self.engine, "sql_routines", {}).get(name)
+        if routine is not None:
+            return self._inline_routine(name, routine, ast, cols)
         raise SemanticError(f"function {name} not supported")
+
+    def _inline_routine(self, name, routine, ast, cols):
+        """Inline a CREATE FUNCTION routine body at the call site: parameter
+        identifiers substitute with the argument ASTs, then the rewritten body
+        translates like any expression (reference:
+        sql/routine/SqlRoutineCompiler.java:108 — an expression-bodied routine
+        reduces to exactly this inlining)."""
+        params, rt, body = routine
+        if len(ast.args) != len(params):
+            raise SemanticError(
+                f"{name} expects {len(params)} arguments, got {len(ast.args)}")
+        depth = getattr(self, "_routine_depth", 0)
+        if depth >= 16:
+            raise SemanticError(f"SQL routine recursion too deep at {name}")
+        # arguments coerce to the DECLARED parameter types before substitution
+        # (Trino semantics: half(5) with half(x double) divides as double)
+        pmap = {pn: A.Cast(arg, tn, tuple(tp or ()))
+                for (pn, tn, tp), arg in zip(params, ast.args)}
+        rewritten = _rewrite_ast(
+            body, lambda n: pmap.get(n.parts[0], n)
+            if isinstance(n, A.Identifier) and len(n.parts) == 1 else n)
+        self._routine_depth = depth + 1
+        try:
+            e, d = self._translate(rewritten, cols)
+        finally:
+            self._routine_depth = depth
+        declared = _type_from_name(*rt)
+        return _coerce(e, declared), (d if declared.is_string else None)
 
     def _require_dict(self, arg_ast, cols, fname):
         v, d = self._translate(arg_ast, cols)

@@ -312,6 +312,36 @@ class CreateView(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class CreateFunction(Node):
+    """CREATE FUNCTION name(p type, ...) RETURNS type RETURN expr — the
+    single-RETURN-expression SQL routine subset (reference: sql/routine/ —
+    SqlRoutineCompiler.java:108 compiles routine bodies; an expression body
+    inlines at call sites here)."""
+
+    name: str
+    params: tuple  # ((name, type_name, type_params), ...)
+    return_type: tuple  # (type_name, params)
+    body: Node  # expression AST
+    or_replace: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropFunction(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class TableFunctionRef(Node):
+    """FROM TABLE(fn(args)) — a table function invocation (reference:
+    spi/function/table/ConnectorTableFunction.java)."""
+
+    func: "FuncCall"
+    alias: Optional[str] = None
+    column_aliases: tuple = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class CreateMaterializedView(Node):
     """reference: execution/CreateMaterializedViewTask.java — the definition
     stores alongside a storage table holding the materialized rows."""
@@ -553,6 +583,24 @@ class Parser:
                 name = self.expect_kind("ident").value
                 self.expect("as")
                 return CreateView(name, self.parse_subquery(), or_replace)
+            if self.peek().kind == "ident" and self.peek().value == "function":
+                self.next()
+                name = self.expect_kind("ident").value
+                self.expect("(")
+                params = []
+                if not (self.peek().kind == "op" and self.peek().value == ")"):
+                    while True:
+                        pn = self.expect_kind("ident").value
+                        tn, tp = self.parse_type_name()
+                        params.append((pn, tn, tp))
+                        if not self.accept(","):
+                            break
+                self.expect(")")
+                self._expect_ident("returns")
+                rt = self.parse_type_name()
+                self._expect_ident("return")
+                return CreateFunction(name, tuple(params), rt,
+                                      self.parse_expr(), or_replace)
             self.expect("table")
             ine = False
             if self.accept("if"):
@@ -633,6 +681,13 @@ class Parser:
             where = self.parse_expr() if self.accept("where") else None
             return Update(name, tuple(assigns), where)
         if self.accept("drop"):
+            if self.peek().kind == "ident" and self.peek().value == "function":
+                self.next()
+                ie = False
+                if self.accept("if"):
+                    self.expect("exists")
+                    ie = True
+                return DropFunction(self.expect_kind("ident").value, ie)
             if self.peek().kind == "ident" and self.peek().value == "materialized":
                 self.next()
                 self.expect("view")
@@ -909,6 +964,18 @@ class Parser:
             alias = self._table_alias()
             cols = self._column_alias_list() if alias else ()
             return UnnestRef(tuple(exprs), alias, cols, ordinality)
+        if self.peek().value == "table" and self.peek(1).kind == "op" \
+                and self.peek(1).value == "(":
+            # FROM TABLE(fn(args)) — table function invocation
+            self.next()
+            self.next()
+            fn = self.parse_expr()
+            self.expect(")")
+            if not isinstance(fn, FuncCall):
+                raise ParseError("TABLE(...) requires a function call")
+            alias = self._table_alias()
+            cols = self._column_alias_list() if alias else ()
+            return TableFunctionRef(fn, alias, tuple(cols or ()))
         name = [self.expect_kind("ident").value]
         while self.accept("."):
             name.append(self.expect_kind("ident").value)
