@@ -1,0 +1,90 @@
+"""DB-API federation connector (the JDBC-family analog; reference:
+plugin/trino-base-jdbc BaseJdbcClient) over sqlite3."""
+
+import sqlite3
+
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.dbapi import DbapiConnector
+from trino_tpu.connectors.tpch import TpchConnector
+
+
+@pytest.fixture(scope="module")
+def remote_db(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("db") / "remote.db")
+    con = sqlite3.connect(path)
+    con.execute("create table users (uid integer, region integer, "
+                "name text, balance real)")
+    rows = [(i, i % 5, None if i % 11 == 0 else f"user-{i % 7}",
+             round(i * 1.5, 2)) for i in range(1000)]
+    con.executemany("insert into users values (?,?,?,?)", rows)
+    con.execute("create table tiny (k integer, v text)")
+    con.executemany("insert into tiny values (?,?)",
+                    [(1, "a"), (2, "b"), (3, None)])
+    con.commit()
+    con.close()
+    return path
+
+
+@pytest.fixture(scope="module")
+def fed_engine(remote_db):
+    e = Engine()
+    e.register_catalog("db", DbapiConnector(
+        lambda: sqlite3.connect(remote_db), split_rows=256))
+    e.register_catalog("tpch", TpchConnector(sf=0.01, split_rows=1 << 12))
+    return e, e.create_session("db")
+
+
+def test_remote_scan_and_aggregate(fed_engine):
+    e, s = fed_engine
+    rows = e.execute_sql(
+        "select region, count(*) c, sum(balance) sb from users "
+        "group by region order by region", s).rows()
+    assert len(rows) == 5
+    assert sum(r[1] for r in rows) == 1000
+    assert rows[0][2] == pytest.approx(sum(i * 1.5 for i in range(0, 1000, 5)))
+
+
+def test_remote_strings_and_nulls(fed_engine):
+    e, s = fed_engine
+    rows = e.execute_sql(
+        "select name, count(*) c from users group by name "
+        "order by name nulls last", s).rows()
+    names = [r[0] for r in rows]
+    assert names[-1] is None  # the NULL group survives
+    assert set(n for n in names if n is not None) == \
+        {f"user-{i}" for i in range(7)}
+    assert e.execute_sql("select v from tiny where k = 3", s).rows() == \
+        [(None,)]
+
+
+def test_remote_federated_join(fed_engine):
+    """A remote table joins a generator-connector table — cross-catalog
+    federation through the shared page machinery."""
+    e, s = fed_engine
+    rows = e.execute_sql(
+        "select count(*) c from db.users, tpch.nation "
+        "where users.region = nation.n_regionkey and users.uid < 100",
+        s).rows()
+    assert rows == [(100 * 5,)]
+
+
+def test_remote_metadata_and_splits(fed_engine, remote_db):
+    e, s = fed_engine
+    conn = e.catalogs["db"]
+    assert conn.tables() == ["tiny", "users"]
+    assert conn.row_count("users") == 1000
+    assert conn.column_range("users", "uid") == (0, 999)
+    splits = conn.splits("users")
+    assert sum(1 for _ in splits) >= 4  # rowid ranges cover the table
+    # churn detection: a new string value after the snapshot errors clearly
+    import sqlite3 as _sq
+    con = _sq.connect(remote_db)
+    con.execute("update tiny set v='brand-new' where k=1")
+    con.commit(); con.close()
+    with pytest.raises(RuntimeError, match="changed since"):
+        for sp in conn.splits("tiny"):
+            conn.generate(sp, ["v"])
+    with pytest.raises(ValueError, match="unsupported remote identifier"):
+        conn.column_range('users"; drop table users; --', "uid")
