@@ -158,3 +158,39 @@ def test_spooled_result_protocol(tmp_path, tpch_sf001):
         assert res2.rows == [[5]]
     finally:
         srv.stop()
+
+
+def test_ui_query_drilldown(tpch_sf001):
+    """The web UI's per-query page shows SQL, state, timings, and the plan
+    (reference: core/trino-web-ui's query detail, reduced to server-rendered
+    HTML)."""
+    import urllib.request
+
+    from trino_tpu import Engine
+    from trino_tpu.server.server import CoordinatorServer
+
+    e = Engine()
+    e.register_catalog("tpch", tpch_sf001)
+    srv = CoordinatorServer(e)
+    srv.start()
+    try:
+        from trino_tpu.server.client import Client
+
+        c = Client(srv.url, catalog="tpch")
+        c.execute("select count(*) c from region")
+        overview = urllib.request.urlopen(f"{srv.url}/ui", timeout=10
+                                          ).read().decode()
+        assert "/ui/query/q" in overview  # drill-down links present
+        qid = next(iter(srv.queries))
+        page = urllib.request.urlopen(f"{srv.url}/ui/query/{qid}",
+                                      timeout=30).read().decode()
+        assert "select count(*) c from region" in page
+        assert "FINISHED" in page and "plan" in page
+        assert "Aggregate" in page  # the EXPLAIN plan rendered
+        import pytest
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{srv.url}/ui/query/nope", timeout=10)
+    finally:
+        srv.stop()

@@ -30,6 +30,13 @@ DATA_ROWS_PER_FETCH = 4096
 _qids = itertools.count(1)
 
 
+_UI_STYLE = ("<!doctype html><title>trino-tpu</title>"
+             "<style>body{font-family:sans-serif;margin:2em}"
+             "table{border-collapse:collapse}td,th{border:1px solid #ccc;"
+             "padding:4px 8px;text-align:left}"
+             "pre{background:#f6f6f6;padding:8px;overflow-x:auto}</style>")
+
+
 @dataclasses.dataclass
 class _Query:
     query_id: str
@@ -196,6 +203,20 @@ class CoordinatorServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                if len(parts) == 3 and parts[:2] == ["ui", "query"]:
+                    # per-query drill-down (reference: the web UI's query
+                    # detail page — SQL, state, timings, plan)
+                    html_q = server._ui_query_html(parts[2])
+                    if html_q is None:
+                        self._send(404, {"error": "unknown query"})
+                        return
+                    body = html_q.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 self._send(404, {"error": "not found"})
 
             def do_DELETE(self):
@@ -311,20 +332,64 @@ class CoordinatorServer:
         import html as _html
 
         rows = "".join(
-            f"<tr><td>{_html.escape(q.query_id)}</td>"
+            f"<tr><td><a href='/ui/query/{_html.escape(q.query_id)}'>"
+            f"{_html.escape(q.query_id)}</a></td>"
             f"<td>{_html.escape(q.state)}</td>"
+            f"<td>{_html.escape(q.user)}</td>"
             f"<td>{(q.finished_at or time.time()) - q.created_at:.2f}s</td>"
+            f"<td>{len(q.rows) if q.rows is not None else ''}</td>"
             f"<td><code>{_html.escape(q.sql[:120])}</code></td></tr>"
             for q in qs)
-        return ("<!doctype html><title>trino-tpu</title>"
-                "<style>body{font-family:sans-serif;margin:2em}"
-                "table{border-collapse:collapse}td,th{border:1px solid #ccc;"
-                "padding:4px 8px;text-align:left}</style>"
-                "<h1>trino-tpu coordinator</h1>"
-                f"<p>{len(self.queries)} queries tracked | "
+        pool = getattr(getattr(self.engine, "_executor", None),
+                       "memory_pool", None)
+        pool_line = ""
+        if pool is not None:
+            info = pool.info()
+            pool_line = (f" | memory {info['reserved'] / 1e6:.0f}"
+                         f"/{info['max_bytes'] / 1e6:.0f} MB")
+        catalogs = ", ".join(sorted(self.engine.catalogs))
+        return (_UI_STYLE + "<h1>trino-tpu coordinator</h1>"
+                f"<p>{len(self.queries)} queries tracked | catalogs: "
+                f"{_html.escape(catalogs)}{pool_line} | "
                 f"<a href='/v1/metrics'>metrics</a></p>"
-                "<table><tr><th>query</th><th>state</th><th>elapsed</th>"
-                f"<th>sql</th></tr>{rows}</table>")
+                "<table><tr><th>query</th><th>state</th><th>user</th>"
+                f"<th>elapsed</th><th>rows</th><th>sql</th></tr>{rows}</table>")
+
+    def _ui_query_html(self, qid: str):
+        """Query drill-down: full SQL, lifecycle timings, output columns, the
+        error if any, and a best-effort EXPLAIN of the statement (reference:
+        the web UI query page's livePlan tab, reduced to the text plan)."""
+        q = self.queries.get(qid)
+        if q is None:
+            return None
+        import html as _html
+
+        elapsed = (q.finished_at or time.time()) - q.created_at
+        parts = [_UI_STYLE, f"<h1>query {_html.escape(q.query_id)}</h1>",
+                 "<p><a href='/ui'>&larr; all queries</a></p>",
+                 "<table>",
+                 f"<tr><th>state</th><td>{_html.escape(q.state)}</td></tr>",
+                 f"<tr><th>user</th><td>{_html.escape(q.user)}</td></tr>",
+                 f"<tr><th>elapsed</th><td>{elapsed:.3f}s</td></tr>"]
+        if q.rows is not None:
+            parts.append(f"<tr><th>result rows</th><td>{len(q.rows)}</td></tr>")
+        if q.columns:
+            cols = ", ".join(f"{c['name']} {c['type']}" for c in q.columns)
+            parts.append(f"<tr><th>columns</th><td>{_html.escape(cols)}</td>"
+                         "</tr>")
+        parts.append("</table>")
+        parts.append(f"<h2>sql</h2><pre>{_html.escape(q.sql)}</pre>")
+        if q.error:
+            parts.append(f"<h2>error</h2><pre>{_html.escape(q.error)}</pre>")
+        else:
+            try:
+                r = self.engine.execute_sql(f"explain {q.sql}")
+                plan_text = "\n".join(str(row[0]) for row in r.rows())
+                parts.append(f"<h2>plan</h2><pre>{_html.escape(plan_text)}"
+                             "</pre>")
+            except Exception:
+                pass  # DDL/statements EXPLAIN can't cover: omit the section
+        return "".join(parts)
 
     # -- dispatch -----------------------------------------------------------------
     def _submit(self, sql: str, catalog: Optional[str],
