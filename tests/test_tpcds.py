@@ -284,3 +284,113 @@ def test_q6_state_price_comparison(eng, host):
     g = g[g.cnt >= 10].sort_values(["cnt", "ca_state"]).head(10)
     assert got["cnt"].tolist() == g["cnt"].tolist()
     assert got["ca_state"].tolist() == g["ca_state"].tolist()
+
+
+# --------------------------------------------------------- round 3: channels
+@pytest.fixture(scope="module")
+def host2(eng):
+    """Host copies of the new channel tables (projected columns only)."""
+    e, _ = eng
+    conn = e.catalogs["tpcds"]
+    wanted = {
+        "catalog_sales": ["cs_sold_date_sk", "cs_item_sk", "cs_ext_sales_price",
+                          "cs_call_center_sk", "cs_quantity"],
+        "web_sales": ["ws_sold_date_sk", "ws_item_sk", "ws_ext_sales_price",
+                      "ws_web_site_sk"],
+        "store_returns": ["sr_item_sk", "sr_return_amt", "sr_reason_sk"],
+        "inventory": ["inv_date_sk", "inv_item_sk", "inv_warehouse_sk",
+                      "inv_quantity_on_hand"],
+        "date_dim": ["d_date_sk", "d_year", "d_moy"],
+        "item": ["i_item_sk", "i_category"],
+        "warehouse": ["w_warehouse_sk", "w_warehouse_name"],
+    }
+    out = {}
+    for t, names in wanted.items():
+        dicts = conn.dictionaries(t)
+        cols = {}
+        for name in names:
+            parts = [np.asarray(conn.generate(sp, [name]).column(name))
+                     for sp in conn.splits(t)]
+            arr = np.concatenate(parts)
+            if dicts.get(name) is not None:
+                arr = dicts[name].decode(arr)
+            cols[name] = arr
+        out[t] = pd.DataFrame(cols)
+    return out
+
+
+def test_catalog_channel_by_year(eng, host2):
+    """Catalog-channel revenue by year (the Q20/Q26-family shape over
+    catalog_sales ⋈ date_dim)."""
+    e, s = eng
+    got = e.execute_sql(
+        "select d_year, sum(cs_ext_sales_price) rev, count(*) c "
+        "from catalog_sales, date_dim where cs_sold_date_sk = d_date_sk "
+        "and d_year between 1998 and 2000 group by d_year order by d_year",
+        s).to_pandas()
+    cs, dd = host2["catalog_sales"], host2["date_dim"]
+    j = cs.merge(dd, left_on="cs_sold_date_sk", right_on="d_date_sk")
+    j = j[(j.d_year >= 1998) & (j.d_year <= 2000)]
+    ref = j.groupby("d_year").agg(
+        rev=("cs_ext_sales_price", "sum"), c=("d_year", "size")).reset_index()
+    assert got.d_year.tolist() == sorted(ref.d_year.tolist())
+    np.testing.assert_allclose(got.rev.to_numpy(),
+                               ref.sort_values("d_year").rev.to_numpy() / 100,
+                               rtol=1e-9)
+
+
+def test_cross_channel_union(eng, host2):
+    """Store+catalog+web revenue per item category (the Q33/Q56 cross-channel
+    UNION shape)."""
+    e, s = eng
+    got = e.execute_sql("""
+        select i_category, sum(rev) total from (
+          select ws_item_sk item_sk, sum(ws_ext_sales_price) rev
+          from web_sales group by ws_item_sk
+          union all
+          select cs_item_sk, sum(cs_ext_sales_price) from catalog_sales
+          group by cs_item_sk
+        ) u, item where u.item_sk = i_item_sk
+        group by i_category order by i_category""", s).to_pandas()
+    ws, cs, it = host2["web_sales"], host2["catalog_sales"], host2["item"]
+    w = ws.groupby("ws_item_sk").ws_ext_sales_price.sum().rename("rev")
+    c = cs.groupby("cs_item_sk").cs_ext_sales_price.sum().rename("rev")
+    u = pd.concat([w.reset_index().rename(columns={"ws_item_sk": "k"}),
+                   c.reset_index().rename(columns={"cs_item_sk": "k"})])
+    j = u.merge(it, left_on="k", right_on="i_item_sk")
+    ref = j.groupby("i_category").rev.sum().reset_index().sort_values(
+        "i_category")
+    assert got.i_category.tolist() == ref.i_category.tolist()
+    np.testing.assert_allclose(got.total.to_numpy(),
+                               ref.rev.to_numpy() / 100, rtol=1e-9)
+
+
+def test_q22_inventory_by_warehouse(eng, host2):
+    """Average quantity on hand per warehouse (the Q22 inventory rollup
+    shape)."""
+    e, s = eng
+    got = e.execute_sql(
+        "select w_warehouse_name, avg(inv_quantity_on_hand) q "
+        "from inventory, warehouse where inv_warehouse_sk = w_warehouse_sk "
+        "group by w_warehouse_name order by w_warehouse_name", s).to_pandas()
+    inv, w = host2["inventory"], host2["warehouse"]
+    j = inv.merge(w, left_on="inv_warehouse_sk", right_on="w_warehouse_sk")
+    ref = j.groupby("w_warehouse_name").inv_quantity_on_hand.mean() \
+        .reset_index().sort_values("w_warehouse_name")
+    assert got.w_warehouse_name.tolist() == ref.w_warehouse_name.tolist()
+    np.testing.assert_allclose(got.q.to_numpy(),
+                               ref.inv_quantity_on_hand.to_numpy(), rtol=1e-9)
+
+
+def test_returns_join_reason(eng, host2):
+    e, s = eng
+    got = e.execute_sql(
+        "select r_reason_desc, sum(sr_return_amt) amt from store_returns, "
+        "reason where sr_reason_sk = r_reason_sk "
+        "group by r_reason_desc order by amt desc limit 5", s).rows()
+    assert len(got) == 5
+    sr = host2["store_returns"]
+    ref = sr.groupby("sr_reason_sk").sr_return_amt.sum().sort_values(
+        ascending=False)
+    np.testing.assert_allclose(
+        [r[1] for r in got], (ref.head(5) / 100).to_numpy(), rtol=1e-9)
