@@ -246,3 +246,70 @@ def test_in_process_worker_roundtrip(tmp_path):
     finally:
         w.stop()
         coord.stop()
+
+
+def test_speculative_execution_of_stragglers(tmp_path):
+    """Once every task is dispatched, a straggler re-dispatches to another
+    worker; first-commit-wins dedup makes the duplicate harmless and the
+    query finishes at the fast worker's pace (reference: the FTE scheduler's
+    SPECULATIVE task class, TaskExecutionClass.java)."""
+    e = _engine()
+    coord = ClusterCoordinator(e, str(tmp_path / "spool"),
+                               heartbeat_interval=0.2,
+                               speculative_factor=2.0, task_timeout=60.0)
+    url = coord.start()
+    w1 = WorkerServer(CATALOGS, str(tmp_path / "spool"), coordinator_url=url,
+                      node_id="fast")
+    w2 = WorkerServer(CATALOGS, str(tmp_path / "spool"), coordinator_url=url,
+                      node_id="slow")
+    w1.start()
+    w2.start()
+    try:
+        coord.wait_for_workers(2, timeout=30)
+        expected = e.execute_sql(Q).rows()
+        coord.execute_sql(Q)  # warm both workers' compile caches
+        orig = w2.local._agg_compiled
+        w2.local._agg_compiled = lambda node, _o=orig: (time.sleep(6),
+                                                        _o(node))[1]
+        t0 = time.time()
+        got = coord.execute_sql(Q).rows()
+        elapsed = time.time() - t0
+        assert got == expected
+        assert coord.speculative_tasks >= 1, "no speculation happened"
+        assert elapsed < 6.0, f"query waited out the straggler ({elapsed:.1f}s)"
+    finally:
+        w1.stop()
+        w2.stop()
+        coord.stop()
+
+
+def test_fte_memory_failure_bisects_task(tmp_path):
+    """A device-memory failure inside a partial-aggregation task bisects its
+    split set and merges the halves (the memory-growth retry analog:
+    ExponentialGrowthPartitionMemoryEstimator)."""
+    from trino_tpu.exec import fte as F
+
+    e = _engine()
+    s = e.create_session("tpch")
+    from trino_tpu.sql.frontend import compile_sql
+
+    plan = compile_sql(Q, e, s)
+    expected = e.execute_sql(Q, s).rows()
+    ex = F.FaultTolerantExecutor(e.catalogs, str(tmp_path / "spool"))
+    calls = []
+    orig = F._partial_once
+
+    def flaky(node, stream, key_types, acc_specs, step, splits):
+        calls.append(len(splits))
+        if len(splits) > 1:
+            raise MemoryError("synthetic RESOURCE_EXHAUSTED")
+        return orig(node, stream, key_types, acc_specs, step, splits)
+
+    F._partial_once = flaky
+    try:
+        got = ex.execute(plan).rows()
+    finally:
+        F._partial_once = orig
+    assert got == expected
+    assert any(c > 1 for c in calls) and any(c == 1 for c in calls), \
+        "bisection never recursed"

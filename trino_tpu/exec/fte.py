@@ -416,10 +416,40 @@ class FaultTolerantExecutor:
 # server/CoordinatorModule.java vs WorkerModule.java).
 
 
+def _is_memory_failure(e: BaseException) -> bool:
+    """Device/host memory exhaustion (reference: the retry classification
+    feeding ExponentialGrowthPartitionMemoryEstimator.java:57 — memory
+    failures retry at a different memory footprint, not just again)."""
+    from ..memory import MemoryPoolExhaustedError
+
+    if isinstance(e, (MemoryError, MemoryPoolExhaustedError)):
+        return True
+    return type(e).__name__ == "XlaRuntimeError" \
+        and "RESOURCE_EXHAUSTED" in str(e)
+
+
 def run_partial_aggregate_splits(node, stream, key_types, acc_specs, step,
                                  splits) -> bytes:
     """Partial aggregation over a split subset -> serialized partial page
-    (keys + raw accumulator columns)."""
+    (keys + raw accumulator columns).  A MEMORY failure bisects the split set
+    and merges the halves' partial states — the task retries at half the
+    working set instead of failing identically (the memory-growth retry of
+    ExponentialGrowthPartitionMemoryEstimator, inverted: rather than asking
+    the scheduler for a bigger node, the task shrinks itself)."""
+    try:
+        return _partial_once(node, stream, key_types, acc_specs, step, splits)
+    except Exception as e:
+        if not _is_memory_failure(e) or len(splits) <= 1:
+            raise
+        mid = len(splits) // 2
+        a = run_partial_aggregate_splits(node, stream, key_types, acc_specs,
+                                         step, splits[:mid])
+        b = run_partial_aggregate_splits(node, stream, key_types, acc_specs,
+                                         step, splits[mid:])
+        return _merge_partial_raw(node, key_types, acc_specs, [a, b])
+
+
+def _partial_once(node, stream, key_types, acc_specs, step, splits) -> bytes:
     si = stream.scan_info
     capacity = node.capacity or 1 << 16
     while True:
@@ -431,15 +461,54 @@ def run_partial_aggregate_splits(node, stream, key_types, acc_specs, step,
         if not bool(state.overflow):
             break
         capacity *= 4
+    return _serialize_partial_state(node, state, len(node.keys))
+
+
+def _serialize_partial_state(node, state, nk) -> bytes:
     n_groups = int(hashagg.group_count(state))
     bucket = max(1 << max(n_groups - 1, 1).bit_length(), 64)
     keys, key_nulls, accs = hashagg.compact_groups(state, bucket)
     got = _host(list(keys) + list(key_nulls) + list(accs))
-    nk = len(keys)
     cols = [g[:n_groups] for g in got[:nk]] + [g[:n_groups] for g in got[2 * nk:]]
     nulls = [g[:n_groups] for g in got[nk:2 * nk]] + [None] * len(accs)
     nulls = [n if (n is not None and n.any()) else None for n in nulls]
     return serialize_page(cols, nulls)
+
+
+def _merge_partial_state(key_types, acc_specs, merge_kinds, nk, payloads):
+    """The one deserialize/insert/grow loop both merge shapes share: framed
+    partial pages -> one populated group-by state."""
+    capacity = 1 << 16
+    while True:
+        state = hashagg.groupby_init(capacity,
+                                     tuple(t.dtype for t in key_types),
+                                     acc_specs)
+        for data in payloads:
+            cols, nulls = deserialize_page(data)
+            if cols[0].shape[0] == 0:
+                continue
+            kcols = tuple(jnp.asarray(c) for c in cols[:nk])
+            knulls = tuple(None if n is None else jnp.asarray(n)
+                           for n in nulls[:nk])
+            accs = [(jnp.asarray(c), None) for c in cols[nk:]]
+            valid = jnp.ones((cols[0].shape[0],), bool)
+            state = hashagg.groupby_insert(state, kcols, key_types, valid,
+                                           accs, merge_kinds, knulls)
+        if not bool(state.overflow):
+            return state
+        capacity *= 4
+
+
+def _merge_partial_raw(node, key_types, acc_specs, payloads) -> bytes:
+    """Merge serialized PARTIAL pages into one serialized partial page
+    (accumulators stay raw — the downstream final merge finalizes)."""
+    acc_kinds = [kind for spec in node.aggs
+                 for kind, _dt, _init in _accumulators_for(spec)]
+    merge_kinds = [_MERGE_KIND[k] for k in acc_kinds]
+    nk = len(node.keys)
+    state = _merge_partial_state(key_types, acc_specs, merge_kinds, nk,
+                                 payloads)
+    return _serialize_partial_state(node, state, nk)
 
 
 def run_partial_aggregate(local: LocalExecutor, node, splits,
@@ -599,25 +668,8 @@ def _merge_partial_cols(node, key_types, acc_specs, acc_kinds, payloads):
     """Shared final-aggregation merge over framed partial pages."""
     merge_kinds = [_MERGE_KIND[k] for k in acc_kinds]
     nk = len(node.keys)
-    capacity = 1 << 16
-    while True:
-        state = hashagg.groupby_init(capacity, tuple(t.dtype for t in key_types),
-                                     acc_specs)
-        for data in payloads:
-            cols, nulls = deserialize_page(data)
-            if cols[0].shape[0] == 0:
-                continue
-            kcols = tuple(jnp.asarray(c) for c in cols[:nk])
-            knulls = tuple(None if n is None else jnp.asarray(n)
-                           for n in nulls[:nk])
-            accs = [(jnp.asarray(c), None) for c in cols[nk:]]
-            valid = jnp.ones((cols[0].shape[0],), bool)
-            state = hashagg.groupby_insert(state, kcols, key_types, valid,
-                                           accs, merge_kinds, knulls)
-        if not bool(state.overflow):
-            break
-        capacity *= 4
-
+    state = _merge_partial_state(key_types, acc_specs, merge_kinds, nk,
+                                 payloads)
     n_groups = int(hashagg.group_count(state))
     bucket = max(1 << max(n_groups - 1, 1).bit_length(), 64)
     keys, key_nulls, accs = hashagg.compact_groups(state, bucket)

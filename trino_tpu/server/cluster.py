@@ -328,7 +328,8 @@ class ClusterCoordinator:
                  port: int = 0, heartbeat_interval: float = 0.5,
                  max_misses: int = 3, max_attempts: int = 3,
                  splits_per_task: int = 2, task_timeout: float = 120.0,
-                 secret: Optional[str] = None):
+                 secret: Optional[str] = None,
+                 speculative_factor: float = 3.0):
         self.engine = engine
         self.spool_dir = spool_dir
         self.secret = secret if secret is not None \
@@ -346,6 +347,13 @@ class ClusterCoordinator:
         self.max_attempts = max_attempts
         self.splits_per_task = splits_per_task
         self.task_timeout = task_timeout
+        # straggler mitigation: once every task of a fragment is dispatched, a
+        # task running longer than speculative_factor x the median completed
+        # duration re-dispatches to ANOTHER worker; first-commit-wins dedup
+        # keeps duplicates harmless (reference: TaskExecutionClass.java's
+        # SPECULATIVE class in the FTE scheduler)
+        self.speculative_factor = speculative_factor
+        self.speculative_tasks = 0  # observability counter
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
@@ -720,6 +728,9 @@ class ClusterCoordinator:
         pending = dict(tasks)
         attempts: dict = {tid: 0 for tid, _ in tasks}
         assigned: dict = {}  # task_id -> (worker, extra, deadline)
+        started: dict = {}  # task_id -> dispatch time (speculation baseline)
+        durations: list = []  # completed task durations this fragment
+        speculated: set = set()
         while pending or assigned:
             # (re)assign pending tasks round-robin over live workers; the
             # fragment ships once per worker URL, tasks address it by id
@@ -739,6 +750,7 @@ class ClusterCoordinator:
                                         "exchange_dir": exchange_dir, **extra})
                     _http(f"{w.url}/v1/task", req, secret=self.secret)
                     assigned[tid] = (w, extra, time.time() + self.task_timeout)
+                    started[tid] = time.time()
                     del pending[tid]
                 except Exception:
                     # unreachable worker, or 409 after a restart/fragment
@@ -766,8 +778,45 @@ class ClusterCoordinator:
             time.sleep(0.05)
             for tid, (w, extra, deadline) in list(assigned.items()):
                 if exchange.is_committed(tid):
+                    if tid not in speculated:
+                        # rescued stragglers would inflate the median and
+                        # weaken later straggler detection
+                        durations.append(
+                            time.time() - started.get(tid, time.time()))
                     del assigned[tid]
                     continue
+                # speculation: every task dispatched, siblings finishing, this
+                # one a straggler -> duplicate it on a DIFFERENT worker (the
+                # spool dedups whichever commit lands second)
+                if not pending and durations and tid not in speculated:
+                    med = sorted(durations)[len(durations) // 2]
+                    if time.time() - started.get(tid, 0) \
+                            > self.speculative_factor * max(med, 0.2):
+                        others = [o for o in self.live_workers()
+                                  if o.url != w.url]
+                        if others:
+                            o = others[(len(speculated))
+                                       % len(others)]
+                            try:
+                                if o.url not in frag_sent:
+                                    _http(f"{o.url}/v1/fragment", frag_blob,
+                                          secret=self.secret)
+                                    frag_sent.add(o.url)
+                                req = pickle.dumps(
+                                    {"task_id": tid, "fragment_id": frag_id,
+                                     "kind": kind,
+                                     "attempt": attempts[tid] + 100,
+                                     "exchange_dir": exchange_dir, **extra})
+                                _http(f"{o.url}/v1/task", req,
+                                      secret=self.secret)
+                                speculated.add(tid)
+                                with self._lock:
+                                    self.speculative_tasks += 1
+                            except Exception:
+                                # best-effort, but a failed ship means the
+                                # fragment must re-send next time (409 loop
+                                # otherwise — same rule as the main dispatch)
+                                frag_sent.discard(o.url)
                 failed = time.time() > deadline  # wedged task: reassign
                 try:
                     st = json.loads(_http(f"{w.url}/v1/task/{tid}", timeout=2.0))
