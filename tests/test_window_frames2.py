@@ -168,3 +168,35 @@ def test_ignore_nulls_rejected_for_rankings(weng):
     with pytest.raises(SemanticError, match="navigation"):
         e.execute_sql(
             "select row_number() ignore nulls over (order by x) from m", s)
+
+
+def test_window_over_partially_filled_page():
+    """A scan split that doesn't divide the row count leaves the materialized
+    page with trailing INVALID rows; the window kernel must isolate them from
+    real partitions (regression: pads joined whichever partition matched their
+    fill values, inflating row_number by hundreds)."""
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    e = Engine()
+    conn = TpchConnector(sf=0.01, split_rows=2048)  # 15000 orders -> 8 ragged pages
+    e.register_catalog("tpch", conn)
+    s = e.create_session("tpch")
+    got = e.execute_sql(
+        """select o_custkey, o_orderkey,
+                  row_number() over (partition by o_custkey
+                    order by o_totalprice desc, o_orderkey) rn,
+                  count(*) over (partition by o_custkey) cnt
+           from orders order by o_custkey, o_orderkey""", s).to_pandas()
+    # oracle from a full-page engine read (single split -> no pad rows)
+    e2 = Engine()
+    e2.register_catalog("tpch", TpchConnector(sf=0.01))
+    s2 = e2.create_session("tpch")
+    df = e2.execute_sql("select o_custkey, o_orderkey, o_totalprice from orders",
+                        s2).to_pandas()
+    df = df.sort_values(["o_totalprice", "o_orderkey"],
+                        ascending=[False, True])
+    df["rn"] = df.groupby("o_custkey").cumcount() + 1
+    df["cnt"] = df.groupby("o_custkey")["o_orderkey"].transform("size")
+    df = df.sort_values(["o_custkey", "o_orderkey"])
+    np.testing.assert_array_equal(got["rn"].to_numpy(), df["rn"].to_numpy())
+    np.testing.assert_array_equal(got["cnt"].to_numpy(), df["cnt"].to_numpy())

@@ -872,10 +872,7 @@ class LocalExecutor:
         (ops/window.py; reference: WindowOperator over a sorted PagesIndex)."""
         page, dicts = self._execute_to_page_streamed(node.child)
         n = page.capacity
-        spec_dicts = tuple(
-            dicts[s.arg] if s.kind in ("min", "max", "lag", "lead", "first_value",
-                                       "last_value") and s.arg is not None else None
-            for s in node.specs)
+        spec_dicts = _window_spec_dicts(node.specs, dicts)
         if n == 0:
             cols = tuple(page.columns) + tuple(
                 jnp.zeros((0,), s.type.dtype) for s in node.specs)
@@ -885,12 +882,15 @@ class LocalExecutor:
 
         hit = self._agg_cache.get(("window", id(node)))
         if hit is None:
-            kernel = jax.jit(lambda cols, nulls, specs=node.specs:
-                             _window_kernel(specs, cols, nulls))
+            # valid matters: a partially-filled page's invalid rows must not
+            # join real partitions (they'd inflate ranks/sums); the kernel
+            # isolates them into a pad partition
+            kernel = jax.jit(lambda cols, nulls, valid, specs=node.specs:
+                             _window_kernel(specs, cols, nulls, valid))
             self._agg_cache[("window", id(node))] = (node, kernel)
         else:
             kernel = hit[1]
-        out_cols, out_nulls = kernel(page.columns, page.null_masks)
+        out_cols, out_nulls = kernel(page.columns, page.null_masks, page.valid)
         cols = tuple(page.columns) + out_cols
         nulls = tuple(page.null_masks) + out_nulls
         return Page(node.schema, cols, nulls, page.valid), tuple(dicts) + spec_dicts
@@ -1986,14 +1986,30 @@ def _materialize(page: Page, dicts) -> MaterializedResult:
     return MaterializedResult(tuple(names), tuple(types), columns, raw)
 
 
-def _window_kernel(specs, cols, nulls):
+def _window_spec_dicts(specs, dicts):
+    """Output dictionaries per window spec: value-passing kinds inherit the
+    argument channel's dictionary (shared by the local and distributed paths)."""
+    return tuple(
+        dicts[s.arg] if s.kind in ("min", "max", "lag", "lead", "first_value",
+                                   "last_value") and s.arg is not None else None
+        for s in specs)
+
+
+def _window_kernel(specs, cols, nulls, valid=None):
     """Evaluate all window specs over one materialized page (ops/window primitives).
 
     Sort permutations are shared across specs with the same (partition, order) clause
-    (reference: WindowOperator groups functions by window specification)."""
+    (reference: WindowOperator groups functions by window specification).
+
+    ``valid`` (optional) marks live rows: invalid (pad) rows are isolated into
+    their own partition — they sort last, never join a real partition's
+    segments, and their outputs are garbage the caller drops.  This is what
+    lets the distributed executor run the kernel per mesh shard over
+    ragged-and-padded row counts."""
     from ..ops import window as W
 
     n = cols[0].shape[0]
+    pad = None if valid is None else ~valid
     cache: dict = {}
 
     def keyed(ch):
@@ -2009,6 +2025,9 @@ def _window_kernel(specs, cols, nulls):
         ck = (s.partition, s.order)
         if ck not in cache:
             kcols, desc = [], []
+            if pad is not None:
+                kcols.append(pad)  # pads sort after every live row
+                desc.append(False)
             for c in s.partition:
                 for ind, v in keyed(c):
                     if ind is not None:
@@ -2038,8 +2057,11 @@ def _window_kernel(specs, cols, nulls):
                         out.append(v[perm])
                 return out
 
+            pad_seg = [] if pad is None else [pad[perm]]
             if s.partition:
-                part_new = W.segments(seg_cols(s.partition))
+                part_new = W.segments(pad_seg + seg_cols(s.partition))
+            elif pad is not None:
+                part_new = W.segments(pad_seg)
             else:
                 part_new = jnp.zeros((n,), bool).at[0].set(True)
             if s.order:
