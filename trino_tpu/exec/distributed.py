@@ -1242,7 +1242,11 @@ class DistributedExecutor:
     def _merge_states(self, state, key_types, acc_specs, merge_kinds, capacity):
         """Hash-exchange group entries across workers and re-insert (final aggregation)."""
         W = self.n_workers
-        bucket = capacity  # worst case: every local group routes to one worker
+        # worst case: every local group routes to one worker.  Use the ACTUAL
+        # (pow2-rounded) table capacity, not the requested one — bucketize
+        # truncates rows beyond the bucket, so an undersized bucket would
+        # silently drop groups under skew
+        bucket = state.table.shape[-1] - 1
 
         @partial(shard_map, mesh=self.mesh, in_specs=PS(WORKER_AXIS),
                  out_specs=PS(WORKER_AXIS))

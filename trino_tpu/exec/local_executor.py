@@ -26,6 +26,7 @@ import numpy as np
 
 from ..connectors.tpch import Dictionary
 from ..ops import hashagg
+from ..ops.hashing import ceil_pow2
 from ..ops.hashjoin import (DIRECT_JOIN_RANGE_MAX, DirectJoinTable,
                             DirectMultiJoinTable, JoinTable, MultiJoinTable,
                             build_insert, build_table_init, direct_build,
@@ -72,6 +73,10 @@ class _ScanInfo:
     splits: list
     scan_columns: tuple  # column names requested from the connector
     columns: tuple  # per OUTPUT channel: source column name | None (through projects)
+    replayable: bool = True  # False once a boundary (compaction) transformed the
+    # pages: column metadata stays valid for stats/ranges, but pruning must NOT
+    # rebuild pages from the splits (the downstream chain expects the
+    # transformed layout, not raw scan pages)
 
 
 @dataclasses.dataclass
@@ -90,6 +95,9 @@ class _Stream:
     transform: Callable  # (cols, nulls, valid, aux) -> (cols, nulls, valid)
     scan_info: Optional[_ScanInfo] = None
     aux: tuple = ()  # pytree of device state threaded through jit as an argument
+    compacted: bool = False  # a compaction boundary already shrank this chain's
+    # lanes to ~its estimated rows; a second boundary would pay materialization
+    # for no further reduction
     _jitted: Callable = None  # cached jit of transform applied to a Page
 
     def jitted(self):
@@ -254,6 +262,89 @@ class LocalExecutor:
         self._record(node, page, t0)
         return page, stream.dicts
 
+    # -- page compaction at pipeline boundaries ------------------------------
+    def _compactable_fraction(self, node) -> bool:
+        """Should this streaming subtree's output be compacted before an
+        expensive consumer?  Gate on the CBO's estimated surviving fraction of
+        the scan's lanes (<= 1/8): compaction breaks operator fusion and
+        materializes the boundary, so it must only fire when the lane
+        reduction dwarfs that cost — a runtime-adaptive gate was measured to
+        2.5x-regress dense streams (Q3) via zero-reduction pipeline breaks."""
+        cur = node
+        while isinstance(cur, (P.Project, P.Filter)):
+            cur = cur.child
+        if not isinstance(cur, P.Join) or cur.est_rows is None:
+            return False
+        scan = cur
+        while not isinstance(scan, P.TableScan):
+            if isinstance(scan, P.Join):
+                scan = scan.left
+            elif isinstance(scan, (P.Project, P.Filter)):
+                scan = scan.child
+            else:
+                return False
+        conn = self.catalogs.get(scan.catalog)
+        if conn is None or not hasattr(conn, "row_count"):
+            return False
+        rows = float(conn.row_count(scan.table))
+        return float(cur.est_rows) <= rows / 8.0
+
+    def _compacted_stream(self, up: _Stream) -> _Stream:
+        """Adaptive page compaction at a pipeline boundary (join probe, agg
+        input): upstream filters/selective joins leave most lanes invalid, but
+        the fixed-shape fusion model would drag every dead lane through all
+        downstream probes/inserts.  Per batch: run the upstream chain, read the
+        surviving-row count (one scalar sync), and gather valid rows into the
+        smallest quantized bucket (n/4, n/16, n/64) that holds them.  Buckets
+        are pow2-quantized so the downstream pipeline compiles at most a
+        handful of shape classes, and a batch that stays dense flows through
+        untouched.  Reference: operators emit DENSE pages after selective
+        filters (FilterAndProjectOperator) — compaction is where the reference
+        gets its selectivity win, re-planned for static shapes."""
+        compact_jits: dict = {}
+
+        def pages(up=up):
+            run = up.jitted()
+            for pg in up.pages():
+                cols, nulls, valid = run(pg)
+                n = int(valid.shape[0])
+                count = int(jnp.sum(valid))
+                bucket = n
+                for sh in (6, 4, 2):  # smallest sufficient bucket wins
+                    if count <= (n >> sh):
+                        bucket = max(n >> sh, 1)
+                        break
+                if bucket >= n:
+                    yield Page(up.schema, cols, nulls, valid)
+                    continue
+                jc = compact_jits.get(bucket)
+                if jc is None:
+                    def jc_fn(cols, nulls, valid, bucket=bucket):
+                        # cumsum-scatter pack: linear, no sort; dst slots are
+                        # unique so last-wins scatter is exact
+                        pos = jnp.cumsum(valid) - 1
+                        dst = jnp.where(valid & (pos < bucket), pos,
+                                        bucket).astype(jnp.int32)
+                        total = jnp.sum(valid)
+
+                        def pack(a):
+                            return jnp.zeros((bucket + 1,), a.dtype)                                 .at[dst].set(a)[:bucket]
+
+                        cvalid = jnp.arange(bucket) < total
+                        return (tuple(pack(c) for c in cols),
+                                tuple(None if m is None else pack(m)
+                                      for m in nulls), cvalid)
+                    jc = jax.jit(jc_fn)
+                    compact_jits[bucket] = jc
+                ccols, cnulls, cvalid = jc(cols, nulls, valid)
+                yield Page(up.schema, ccols, cnulls, cvalid)
+
+        si = up.scan_info
+        if si is not None:
+            si = dataclasses.replace(si, replayable=False)
+        return _Stream(up.schema, up.dicts, pages,
+                       lambda c, n, v, aux: (c, n, v), si, compacted=True)
+
     # -- streaming segment compilation ---------------------------------------
     def _subtree_overridden(self, node) -> bool:
         return id(node) in self._overrides \
@@ -307,7 +398,8 @@ class LocalExecutor:
 
             pruned = _static_pruned_stream(up, pred)
             pages, si = pruned if pruned is not None else (up.pages, up.scan_info)
-            return _Stream(up.schema, up.dicts, pages, transform, si, aux=up.aux)
+            return _Stream(up.schema, up.dicts, pages, transform, si, aux=up.aux,
+                           compacted=up.compacted)
 
         if isinstance(node, P.Project):
             up = self._compile_stream(node.child)
@@ -335,7 +427,8 @@ class LocalExecutor:
                 si = dataclasses.replace(up.scan_info, columns=tuple(
                     up.scan_info.columns[e.index] if isinstance(e, FieldRef) else None
                     for e in node.exprs))
-            return _Stream(node.schema, dicts, up.pages, transform, si, aux=up.aux)
+            return _Stream(node.schema, dicts, up.pages, transform, si, aux=up.aux,
+                           compacted=up.compacted)
 
         if isinstance(node, P.Join):
             return self._compile_join(node)
@@ -539,6 +632,8 @@ class LocalExecutor:
         # re-checked on every capacity growth.
         key_w = sum(np.dtype(t.dtype).itemsize + 1 for t in key_types)
         acc_w = sum(np.dtype(dt).itemsize for dt, _ in acc_specs)
+        capacity = ceil_pow2(capacity)  # groupby_init allocates the rounded
+        # size; reserving the raw request would under-account by up to 2x
         state_bytes = lambda cap: (cap + 1) * (8 + key_w + acc_w)
         if cfg is not None and not self.memory_pool.try_reserve(
                 state_bytes(cfg.capacity), "group-by"):
@@ -908,12 +1003,19 @@ class LocalExecutor:
             if pruned is not None:
                 probe_stream = dataclasses.replace(probe_stream, pages=pruned,
                                                    _jitted=None)
+        if not probe_stream.compacted and self._compactable_fraction(node.left):
+            # probe cost scales with LANES: don't drag dead rows from upstream
+            # filters/joins through this join's probe rounds
+            probe_stream = self._compacted_stream(probe_stream)
 
         # memory gate: build-side state (columns + table/order layout) is
         # device-resident and pinned by the stream cache.  When it cannot fit the
         # pool, switch to the Grace-partitioned strategy (the HBM analog of the
         # reference's spilling join, operator/join/spilling/HashBuilderOperator.java)
-        need = _page_bytes(build_page) * 3
+        # build page x2 (columns + compaction copies) + the 4x-pow2 probe table
+        # (8B packed key + 4B row id per slot)
+        need = _page_bytes(build_page) * 2 \
+            + 12 * 4 * ceil_pow2(max(build_page.capacity, 16))
         partitionable = (node.kind in ("inner", "left", "semi") and node.left_keys
                          and node.filter is None)
         if not self.memory_pool.try_reserve(need, "join-build"):
@@ -981,7 +1083,8 @@ class LocalExecutor:
                 probe_stream.scan_info,
                 columns=tuple(probe_stream.scan_info.columns) + (None,) * n_build)
         return _Stream(node.schema, dicts, probe_stream.pages, transform, si,
-                       aux=(probe_stream.aux, table))
+                       aux=(probe_stream.aux, table),
+                       compacted=probe_stream.compacted)
 
     def _compile_multi_join(self, node: P.Join, build_page, build_dicts, probe_stream,
                             build_key_types, span=None) -> _Stream:
@@ -1003,7 +1106,7 @@ class LocalExecutor:
             mt = jax.jit(direct_multi_build, static_argnums=(0, 1, 3))(
                 span[0], span[1], build_page, node.right_keys[0])
         if mt is None:
-            capacity = max(1 << max(build_page.capacity - 1, 1).bit_length(), 16) * 2
+            capacity = max(1 << max(build_page.capacity - 1, 1).bit_length(), 16) * 4
             mt = multi_build(capacity, build_page, node.right_keys, build_key_types)
 
         @jax.jit
@@ -1218,7 +1321,10 @@ class LocalExecutor:
 
     def _build_join_table(self, build_page: Page, key_channels, key_types, span=None):
         n = build_page.capacity
-        capacity = max(1 << max(n - 1, 1).bit_length(), 16) * 2
+        # 4x build rows (load <= 0.25): the lockstep batch probe pays the WORST
+        # row's chain length every round, and halving the load roughly halves
+        # the max double-hash chain (measured 15 -> 8 rounds on a 6M-row probe)
+        capacity = max(1 << max(n - 1, 1).bit_length(), 16) * 4
         keys = tuple(build_page.columns[i] for i in key_channels)
         # join keys never match NULL: drop null-keyed build rows
         valid = build_page.valid_mask()
@@ -1285,10 +1391,19 @@ def _accumulators_for(spec: P.AggSpec):
     raise NotImplementedError(spec.kind)
 
 
-def _combine_limbs(hi, lo):
-    """Exact Python-int recombination of two-limb sums (host, n_groups-sized)."""
-    return [int(h) * (1 << 32) + int(l)
-            for h, l in zip(np.asarray(hi).tolist(), np.asarray(lo).tolist())]
+def _combine_limbs_vec(hi, lo):
+    """Recombine two-limb sums: vectorized int64 when every result fits (the
+    int64 computation is exact mod 2^64, so intermediate wraps don't matter),
+    else (None, exact-Python-int list).  The Python path only runs when a sum
+    actually exceeds ~2^62 — a per-row host loop over a million groups was the
+    dominant cost of decimal aggregation finalize."""
+    hi = np.asarray(hi)
+    lo = np.asarray(lo)
+    approx = hi.astype(np.float64) * 4294967296.0 + lo.astype(np.float64)
+    if np.all(np.abs(approx) < float(1 << 62)):
+        return hi.astype(np.int64) * (1 << 32) + lo.astype(np.int64), None
+    return None, [int(h) * (1 << 32) + int(l)
+                  for h, l in zip(hi.tolist(), lo.tolist())]
 
 
 def _finalize_aggs(aggs, acc_cols, n_groups):
@@ -1303,24 +1418,32 @@ def _finalize_aggs(aggs, acc_cols, n_groups):
     for spec in aggs:
         if spec.kind == "avg" and spec.arg is not None \
                 and isinstance(spec.arg.type, DecimalType):
-            exact = _combine_limbs(acc_cols[i], acc_cols[i + 1])
-            c = np.asarray(acc_cols[i + 2]).tolist()
+            vec, exact = _combine_limbs_vec(acc_cols[i], acc_cols[i + 1])
+            c = np.asarray(acc_cols[i + 2])
             i += 3
-            vals = []
-            for s, n in zip(exact, c):
-                n = max(int(n), 1)
-                q, r = divmod(abs(s), n)
-                vals.append((q + (2 * r >= n)) * (1 if s >= 0 else -1))
-            out.append(np.array(vals, np.int64))  # avg fits the input type
+            if vec is not None:  # HALF_UP rounding, vectorized
+                n = np.maximum(c.astype(np.int64), 1)
+                q, r = np.divmod(np.abs(vec), n)
+                out.append(((q + (2 * r >= n)) *
+                            np.where(vec >= 0, 1, -1)).astype(np.int64))
+            else:
+                vals = []
+                for s, n in zip(exact, c.tolist()):
+                    n = max(int(n), 1)
+                    q, r = divmod(abs(s), n)
+                    vals.append((q + (2 * r >= n)) * (1 if s >= 0 else -1))
+                out.append(np.array(vals, np.int64))  # avg fits the input type
         elif spec.kind == "avg":
             s, c = acc_cols[i], acc_cols[i + 1]
             i += 2
             c_safe = np.where(c == 0, 1, c)
             out.append((s / c_safe).astype(np.float64))
         elif spec.kind == "sum" and isinstance(spec.type, DecimalType):
-            exact = _combine_limbs(acc_cols[i], acc_cols[i + 1])
+            vec, exact = _combine_limbs_vec(acc_cols[i], acc_cols[i + 1])
             i += 2
-            if all(-(1 << 63) <= v < (1 << 63) for v in exact):
+            if vec is not None:
+                out.append(vec)
+            elif all(-(1 << 63) <= v < (1 << 63) for v in exact):
                 out.append(np.array(exact, np.int64))
             else:
                 out.append(np.array(exact, dtype=object))
@@ -1449,7 +1572,7 @@ def _static_pruned_stream(up: _Stream, pred):
     via ConnectorMetadata.applyFilter / per-split TupleDomain stats).  Returns
     (pages, scan_info) with the pruned split list, or None when nothing prunes."""
     si = up.scan_info
-    if si is None or not hasattr(si.conn, "split_range"):
+    if si is None or not si.replayable or not hasattr(si.conn, "split_range"):
         return None
     from ..sql.domain_translator import (domain_to_split_pruner, extract_domains,
                                          split_conjuncts)
@@ -1486,7 +1609,7 @@ def _dynamic_pruned_pages(probe_stream: _Stream, node, build_page: Page):
     (inner/semi joins only — outer/anti joins must keep unmatched probe rows).
     Returns None when no pruning is possible."""
     si = probe_stream.scan_info
-    if si is None or not hasattr(si.conn, "split_range"):
+    if si is None or not si.replayable or not hasattr(si.conn, "split_range"):
         return None
     bvalid = np.asarray(build_page.valid_mask()) if build_page.capacity else \
         np.zeros((0,), bool)

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..types import BOOLEAN as _BOOL_KEY
-from .hashing import EMPTY_KEY, pack_keys, splitmix64
+from .hashing import ceil_pow2, probe_step, EMPTY_KEY, pack_keys, splitmix64
 
 __all__ = ["GroupByState", "groupby_init", "groupby_insert", "AGG_INITS", "agg_update",
            "agg_finalize", "DirectConfig", "direct_config", "direct_groupby_init",
@@ -73,6 +73,7 @@ class GroupByState:
 
 def groupby_init(capacity: int, key_dtypes, acc_specs) -> GroupByState:
     """acc_specs: sequence of (dtype, init_scalar) per accumulator array."""
+    capacity = ceil_pow2(capacity)  # double-hash coverage needs a pow2 table
     table = jnp.full((capacity + 1,), EMPTY_KEY, dtype=jnp.int64)
     key_cols = tuple(jnp.zeros((capacity + 1,), dt) for dt in key_dtypes)
     key_nulls = tuple(jnp.zeros((capacity + 1,), bool) for _ in key_dtypes)
@@ -239,6 +240,7 @@ def _probe_insert(table, packed, valid):
     deterministically. Returns (table, slot[int32], placed[bool])."""
     C = table.shape[0] - 1
     h0 = splitmix64(packed)
+    stp = probe_step(h0)
     # derive every loop carry from the (possibly device-varying) inputs: under
     # shard_map a fresh constant (a groupby_init table built inside the traced
     # program, a zeros slot vector) is "unvarying" and the while_loop rejects
@@ -258,7 +260,7 @@ def _probe_insert(table, packed, valid):
 
     def body(carry):
         p, table, slot, placed = carry
-        idx = (jnp.abs(h0 + p) % C).astype(jnp.int32)
+        idx = ((h0 + p * stp) & (C - 1)).astype(jnp.int32)
         idx = jnp.where(placed, C, idx)
         cur = table[idx]
         hit = (cur == packed) & ~placed

@@ -25,7 +25,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-from .hashing import EMPTY_KEY, pack_keys, splitmix64
+from .hashing import EMPTY_KEY, ceil_pow2, pack_keys, probe_step, splitmix64
 
 __all__ = ["JoinTable", "build_table_init", "build_insert", "probe", "MAX_PROBES",
            "MultiJoinTable", "multi_build", "probe_slots", "expand_counts",
@@ -63,6 +63,7 @@ class JoinTable:
 
 
 def build_table_init(capacity: int, build_page) -> JoinTable:
+    capacity = ceil_pow2(capacity)  # double-hash coverage needs a pow2 table
     return JoinTable(
         table=jnp.full((capacity + 1,), EMPTY_KEY, jnp.int64),
         rows=jnp.full((capacity + 1,), 2**31 - 1, jnp.int32),  # min-claim: first row wins
@@ -107,6 +108,7 @@ def probe(jt: JoinTable, key_cols, key_types, valid):
     packed, _ = pack_keys(key_cols, key_types)
     C = jt.capacity
     h0 = splitmix64(packed)
+    stp = probe_step(h0)
     # derive the loop carries from the (possibly device-varying) probe inputs:
     # under shard_map, fresh constants are "unvarying" and the while_loop would
     # reject the carry when the body mixes them with per-worker data
@@ -120,7 +122,7 @@ def probe(jt: JoinTable, key_cols, key_types, valid):
 
     def body(carry):
         p, row_ids, matched, done = carry
-        idx = (jnp.abs(h0 + p) % C).astype(jnp.int32)
+        idx = ((h0 + p * stp) & (C - 1)).astype(jnp.int32)
         cur = jt.table[idx]
         hit = (cur == packed) & ~done
         row_ids = jnp.where(hit, jt.rows[idx], row_ids)
@@ -319,6 +321,7 @@ def multi_build(capacity: int, build_page, key_channels, key_types) -> MultiJoin
         if nm is not None:
             valid = valid & ~nm
     step = _multi_build_jit
+    capacity = ceil_pow2(capacity)  # double-hash coverage needs a pow2 table
     while True:
         table0 = jnp.full((capacity + 1,), EMPTY_KEY, jnp.int64)
         table, counts, starts, order, overflow = step(table0, key_cols, key_types, valid)
@@ -334,6 +337,7 @@ def probe_slots(table, key_cols, key_types, valid):
     packed, _ = pack_keys(key_cols, key_types)
     C = table.shape[0] - 1
     h0 = splitmix64(packed)
+    stp = probe_step(h0)
     # carries derive from probe inputs so they inherit shard_map's varying axis
     # (see probe() above)
     slot = (h0 * 0).astype(jnp.int32)
@@ -346,7 +350,7 @@ def probe_slots(table, key_cols, key_types, valid):
 
     def body(carry):
         p, slot, matched, done = carry
-        idx = (jnp.abs(h0 + p) % C).astype(jnp.int32)
+        idx = ((h0 + p * stp) & (C - 1)).astype(jnp.int32)
         cur = table[idx]
         hit = (cur == packed) & ~done
         slot = jnp.where(hit, idx, slot)
