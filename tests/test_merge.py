@@ -1,0 +1,119 @@
+"""MERGE INTO statement (reference: sql/tree/Merge.java planned through
+MergeWriterOperator's RowChangeOperations; test model: the MERGE cases of
+testing/trino-testing/.../AbstractTestEngineOnlyQueries)."""
+
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.memory import MemoryConnector
+
+
+@pytest.fixture()
+def meng():
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table tgt (id bigint, name varchar, qty bigint)", s)
+    e.execute_sql(
+        "insert into tgt values (1, 'a', 10), (2, 'b', 20), (3, 'c', 30)", s)
+    e.execute_sql("create table src (id bigint, name varchar, qty bigint)", s)
+    e.execute_sql(
+        "insert into src values (2, 'B', 200), (3, 'c', -1), (4, 'd', 40)", s)
+    return e, s
+
+
+def test_merge_update_delete_insert(meng):
+    e, s = meng
+    e.execute_sql("""
+        merge into tgt t using src s on t.id = s.id
+        when matched and s.qty < 0 then delete
+        when matched then update set name = s.name, qty = t.qty + s.qty
+        when not matched then insert (id, name, qty) values (s.id, s.name, s.qty)
+    """, s)
+    r = e.execute_sql("select id, name, qty from tgt order by id", s).to_pandas()
+    assert r.values.tolist() == [[1, "a", 10], [2, "B", 220], [4, "d", 40]]
+
+
+def test_merge_clause_priority_first_match_wins(meng):
+    e, s = meng
+    # both clauses' conditions hold for id=2; the FIRST must win
+    e.execute_sql("""
+        merge into tgt t using src s on t.id = s.id
+        when matched and s.qty > 100 then update set qty = 111
+        when matched and s.qty > 0 then update set qty = 222
+    """, s)
+    r = e.execute_sql("select qty from tgt where id = 2", s).to_pandas()
+    assert r.iloc[0, 0] == 111
+
+
+def test_merge_duplicate_source_match_errors(meng):
+    e, s = meng
+    e.execute_sql("insert into src values (2, 'x', 1)", s)
+    with pytest.raises(ValueError, match="more than one source row"):
+        e.execute_sql(
+            "merge into tgt using src on tgt.id = src.id "
+            "when matched then delete", s)
+
+
+def test_merge_subquery_source_and_missing_insert_columns(meng):
+    e, s = meng
+    e.execute_sql("""
+        merge into tgt using (select id + 100 as sid, qty from src) s
+          on tgt.id = s.sid
+        when not matched and s.qty > 30 then insert (id, qty)
+          values (s.sid, s.qty)
+    """, s)
+    r = e.execute_sql("select id, name, qty from tgt order by id", s).to_pandas()
+    assert r["id"].tolist() == [1, 2, 3, 102, 104]
+    # unspecified insert columns are NULL
+    assert r["name"].isna().tolist() == [False, False, False, True, True]
+    assert r["qty"].tolist() == [10, 20, 30, 200, 40]
+
+
+def test_merge_null_keys_never_match(meng):
+    e, s = meng
+    e.execute_sql("insert into tgt values (null, 'n', 0)", s)
+    e.execute_sql("insert into src values (null, 'N', 99)", s)
+    e.execute_sql("""
+        merge into tgt t using src s on t.id = s.id
+        when matched then update set qty = 1
+        when not matched then insert (id, name) values (s.id, s.name)
+    """, s)
+    r = e.execute_sql("select name, qty from tgt order by qty, name", s).to_pandas()
+    # NULL target keeps qty 0; NULL source row INSERTS (not matched)
+    assert ("n", 0) in set(map(tuple, r.values.tolist()))
+    assert ("N", None) in set((a, None if b != b else b)
+                              for a, b in r.values.tolist())
+
+
+def test_merge_multiple_when_not_matched(meng):
+    e, s = meng
+    e.execute_sql("""
+        merge into tgt using src on tgt.id = src.id
+        when not matched and src.qty > 100 then insert (id, qty) values (src.id, 0)
+        when not matched then insert (id, qty) values (src.id, src.qty)
+    """, s)
+    # only id=4 is unmatched; qty 40 <= 100 -> second clause
+    r = e.execute_sql("select qty from tgt where id = 4", s).to_pandas()
+    assert r.iloc[0, 0] == 40
+
+
+def test_merge_cross_scale_decimal_keys_match(meng):
+    e, s = meng
+    e.execute_sql("create table dt (k decimal(10,2), v bigint)", s)
+    e.execute_sql("insert into dt values (1.00, 1)", s)
+    e.execute_sql("create table ds (k decimal(4,1), v bigint)", s)
+    e.execute_sql("insert into ds values (1.0, 99)", s)
+    # raw storage differs (100 vs 10); ON keys compare post-decode
+    e.execute_sql(
+        "merge into dt using ds on dt.k = ds.k "
+        "when matched then update set v = ds.v", s)
+    assert e.execute_sql("select v from dt", s).to_pandas().iloc[0, 0] == 99
+
+
+def test_merge_set_rejects_source_qualifier(meng):
+    e, s = meng
+    with pytest.raises(ValueError, match="not the target alias"):
+        e.execute_sql(
+            "merge into tgt t using src s on t.id = s.id "
+            "when matched then update set s.qty = 1", s)

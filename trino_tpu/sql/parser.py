@@ -412,6 +412,32 @@ class Update(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class MergeClause(Node):
+    """One WHEN [NOT] MATCHED [AND cond] THEN <action> branch (reference:
+    sql/tree/MergeInsert|MergeUpdate|MergeDelete)."""
+
+    matched: bool
+    condition: object  # expr | None
+    action: str  # "update" | "delete" | "insert"
+    assignments: tuple = ()  # update: ((column, expr), ...)
+    columns: tuple = ()  # insert target columns (() = schema order)
+    values: tuple = ()  # insert value exprs
+
+
+@dataclasses.dataclass(frozen=True)
+class Merge(Node):
+    """MERGE INTO target USING source ON cond WHEN ... (reference:
+    sql/tree/Merge.java; planned as RowChangeOperation in MergeWriterOperator)."""
+
+    target: str
+    target_alias: str
+    source: object  # table name str | Select subquery
+    source_alias: str
+    on: object
+    clauses: tuple  # MergeClause...
+
+
+@dataclasses.dataclass(frozen=True)
 class SetSession(Node):
     name: str
     value: object  # literal node
@@ -667,6 +693,8 @@ class Parser:
             name = self.expect_kind("ident").value
             where = self.parse_expr() if self.accept("where") else None
             return Delete(name, where)
+        if t.kind == "ident" and t.value == "merge":
+            return self._parse_merge()
         if t.kind == "ident" and t.value == "update":
             self.next()
             name = self.expect_kind("ident").value
@@ -793,6 +821,79 @@ class Parser:
             cols.append(self.expect_kind("ident").value)
         self.expect(")")
         return tuple(cols)
+
+    def _parse_merge(self):
+        """MERGE INTO t [AS a] USING (s | (subquery)) [AS b] ON cond
+        WHEN [NOT] MATCHED [AND cond] THEN UPDATE SET ... | DELETE |
+        INSERT [(cols)] VALUES (...)  (reference: SqlParser rule for Merge)"""
+        self.next()  # 'merge'
+        self.expect("into")
+        target = self.expect_kind("ident").value
+        talias = target
+        if self.accept("as"):
+            talias = self.expect_kind("ident").value
+        elif self.peek().kind == "ident" and self.peek().value != "using":
+            talias = self.next().value
+        self._expect_ident("using")
+        if self.peek().kind == "op" and self.peek().value == "(":
+            self.next()
+            source = self.parse_subquery()
+            self.expect(")")
+            salias = "__source__"
+        else:
+            source = self.expect_kind("ident").value
+            salias = source
+        if self.accept("as"):
+            salias = self.expect_kind("ident").value
+        elif self.peek().kind == "ident" and self.peek().value != "on":
+            salias = self.next().value
+        self.expect("on")
+        on = self.parse_expr()
+        clauses = []
+        while self.accept("when"):
+            matched = not self.accept("not")
+            self._expect_ident("matched")
+            cond = self.parse_expr() if self.accept("and") else None
+            self.expect("then")
+            nxt = self.peek()
+            if matched and nxt.kind == "ident" and nxt.value == "update":
+                self.next()
+                self._expect_ident("set")
+                assigns = []
+                while True:
+                    col = self.expect_kind("ident").value
+                    if self.accept("."):
+                        if col != talias:  # SET may only write the target
+                            raise ParseError(
+                                f"MERGE SET column qualifier {col!r} is not "
+                                f"the target alias {talias!r}")
+                        col = self.expect_kind("ident").value
+                    self.expect("=")
+                    assigns.append((col, self.parse_expr()))
+                    if not self.accept(","):
+                        break
+                clauses.append(MergeClause(True, cond, "update",
+                                           assignments=tuple(assigns)))
+            elif matched and nxt.kind == "ident" and nxt.value == "delete":
+                self.next()
+                clauses.append(MergeClause(True, cond, "delete"))
+            elif not matched and self.accept("insert"):
+                cols = self._column_alias_list()
+                self.expect("values")
+                self.expect("(")
+                vals = [self.parse_expr()]
+                while self.accept(","):
+                    vals.append(self.parse_expr())
+                self.expect(")")
+                clauses.append(MergeClause(False, cond, "insert",
+                                           columns=cols or (), values=tuple(vals)))
+            else:
+                raise ParseError(
+                    "expected UPDATE/DELETE after WHEN MATCHED or INSERT "
+                    "after WHEN NOT MATCHED")
+        if not clauses:
+            raise ParseError("MERGE requires at least one WHEN clause")
+        return Merge(target, talias, source, salias, on, tuple(clauses))
 
     def parse_subquery(self) -> Select:
         """A query body: optional WITH, then SELECTs joined by set operations, then
