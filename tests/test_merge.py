@@ -117,3 +117,29 @@ def test_merge_set_rejects_source_qualifier(meng):
         e.execute_sql(
             "merge into tgt t using src s on t.id = s.id "
             "when matched then update set s.qty = 1", s)
+
+
+def test_merge_insert_arity_error_precedes_mutation(meng):
+    e, s = meng
+    with pytest.raises(ValueError, match="columns but"):
+        e.execute_sql("""
+            merge into tgt t using src s on t.id = s.id
+            when matched then update set qty = 0
+            when not matched then insert (id) values (s.id, s.qty)
+        """, s)
+    # the matched update must NOT have been applied (no partial MERGE)
+    r = e.execute_sql("select qty from tgt order by id", s).to_pandas()
+    assert r["qty"].tolist() == [10, 20, 30]
+
+
+def test_merge_int64_keys_past_2_53(meng):
+    e, s = meng
+    big = (1 << 53) + 1
+    e.execute_sql(f"insert into tgt values ({1 << 53}, 'p', 1)", s)
+    e.execute_sql(f"insert into src values ({big}, 'q', 2)", s)
+    # 2^53 and 2^53+1 are distinct keys (float flattening would collide them)
+    e.execute_sql(
+        "merge into tgt t using src s on t.id = s.id "
+        "when matched then update set qty = 999", s)
+    r = e.execute_sql(f"select qty from tgt where id = {1 << 53}", s).to_pandas()
+    assert r["qty"].tolist() == [1]
