@@ -1,0 +1,128 @@
+"""Extended scalar families: math/bitwise/regexp/url/datetime/string-distance
+(reference: operator/scalar/MathFunctions, BitwiseFunctions,
+JoniRegexpFunctions, UrlFunctions, DateTimeFunctions test models)."""
+
+import datetime
+import math
+
+import numpy as np
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.memory import MemoryConnector
+
+
+@pytest.fixture(scope="module")
+def feng():
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table t (x double, n bigint, s varchar, d date)", s)
+    e.execute_sql("""insert into t values
+        (1.5, 5, 'http://example.com:8080/a/b?q=1&r=two#frag', date '2024-02-29'),
+        (-2.25, 12, 'https://trino.io/docs', date '2021-01-01'),
+        (0.5, 255, 'abc-123-xyz', date '2020-12-31')""", s)
+    return e, s
+
+
+def _one(feng, expr, where="n = 5"):
+    e, s = feng
+    r = e.execute_sql(f"select {expr} v from t where {where}", s).to_pandas()
+    return r.iloc[0, 0]
+
+
+def test_hyperbolic_and_log(feng):
+    assert abs(_one(feng, "sinh(x)") - math.sinh(1.5)) < 1e-12
+    assert abs(_one(feng, "cosh(x)") - math.cosh(1.5)) < 1e-12
+    assert abs(_one(feng, "tanh(x)") - math.tanh(1.5)) < 1e-12
+    assert abs(_one(feng, "log(2, 8)") - 3.0) < 1e-12
+    assert abs(_one(feng, "e()") - math.e) < 1e-12
+
+
+def test_float_tests_and_truncate(feng):
+    assert bool(_one(feng, "is_nan(nan())"))
+    assert not bool(_one(feng, "is_finite(infinity())"))
+    assert bool(_one(feng, "is_infinite(infinity())"))
+    assert _one(feng, "truncate(1.999)") == 1.0
+    assert abs(_one(feng, "truncate(1.987, 2)") - 1.98) < 1e-12
+    assert abs(_one(feng, "truncate(-1.987, 2)") - (-1.98)) < 1e-12
+
+
+def test_bitwise_family(feng):
+    assert _one(feng, "bitwise_and(n, 3)") == 5 & 3
+    assert _one(feng, "bitwise_or(n, 3)") == 5 | 3
+    assert _one(feng, "bitwise_xor(n, 3)") == 5 ^ 3
+    assert _one(feng, "bitwise_not(n)") == ~5
+    assert _one(feng, "bitwise_left_shift(n, 2)") == 20
+    assert _one(feng, "bitwise_right_shift(n, 1)") == 2
+    # logical shift of a negative value zero-fills
+    assert _one(feng, "bitwise_right_shift(-8, 1)") == (2**64 - 8) >> 1
+    assert _one(feng, "bitwise_right_shift_arithmetic(-8, 1)") == -4
+    assert _one(feng, "bit_count(255, 64)", "n = 255") == 8
+    assert _one(feng, "bit_count(-1, 8)") == 8
+
+
+def test_regexp_family(feng):
+    assert _one(feng, "regexp_extract(s, '\\d+')", "n = 255") == "123"
+    assert _one(feng, "regexp_extract(s, '([a-z]+)-(\\d+)', 2)",
+                "n = 255") == "123"
+    # no match -> NULL
+    v = _one(feng, "regexp_extract(s, 'ZZZ')", "n = 255")
+    assert v is None or (isinstance(v, float) and np.isnan(v)) or v != v
+    assert _one(feng, "regexp_replace(s, '\\d', '#')", "n = 255") == "abc-###-xyz"
+    assert _one(feng, "regexp_replace(s, '(\\d+)', '<$1>')",
+                "n = 255") == "abc-<123>-xyz"
+    assert _one(feng, "regexp_count(s, '\\d')", "n = 255") == 3
+    assert _one(feng, "regexp_position(s, '1')", "n = 255") == 5
+    assert _one(feng, "regexp_position(s, 'ZZZ')", "n = 255") == -1
+
+
+def test_string_distance_and_misc(feng):
+    assert _one(feng, "levenshtein_distance(s, 'abc-124-xyz')", "n = 255") == 1
+    assert _one(feng, "hamming_distance(s, 'abc-124-xyz')", "n = 255") == 1
+    assert bool(_one(feng, "ends_with(s, 'xyz')", "n = 255"))
+    assert not bool(_one(feng, "ends_with(s, 'abc')", "n = 255"))
+    assert _one(feng, "translate(s, 'abc', 'AB')", "n = 255") == "AB-123-xyz"
+
+
+def test_url_family(feng):
+    url = "n = 5"
+    assert _one(feng, "url_extract_protocol(s)", url) == "http"
+    assert _one(feng, "url_extract_host(s)", url) == "example.com"
+    assert _one(feng, "url_extract_port(s)", url) == 8080
+    assert _one(feng, "url_extract_path(s)", url) == "/a/b"
+    assert _one(feng, "url_extract_query(s)", url) == "q=1&r=two"
+    assert _one(feng, "url_extract_fragment(s)", url) == "frag"
+    assert _one(feng, "url_extract_parameter(s, 'r')", url) == "two"
+    # port absent -> NULL
+    v = _one(feng, "url_extract_port(s)", "n = 12")
+    assert v is None or v != v
+    assert _one(feng, "url_encode('a b&c')", url) == "a+b%26c"
+    assert _one(feng, "url_decode('a+b%26c')", url) == "a b&c"
+
+
+def test_datetime_breadth(feng):
+    epoch = datetime.date(1970, 1, 1)
+    assert _one(feng, "last_day_of_month(d)") == \
+        (datetime.date(2024, 2, 29) - epoch).days
+    assert _one(feng, "last_day_of_month(d)", "n = 12") == \
+        (datetime.date(2021, 1, 31) - epoch).days
+    # ISO week boundaries: 2021-01-01 is week 53 of ISO year 2020
+    assert _one(feng, "week(d)", "n = 12") == 53
+    assert _one(feng, "year_of_week(d)", "n = 12") == 2020
+    assert _one(feng, "week_of_year(d)") == \
+        datetime.date(2024, 2, 29).isocalendar()[0:2][1]
+    assert _one(feng, "yow(d)", "n = 255") == \
+        datetime.date(2020, 12, 31).isocalendar()[0]
+    assert _one(feng, "day_of_month(d)") == 29
+    assert _one(feng, "from_iso8601_date('2023-07-04')") == \
+        (datetime.date(2023, 7, 4) - epoch).days
+
+
+def test_show_functions_lists_new_families(feng):
+    e, s = feng
+    r = e.execute_sql("show functions", s).to_pandas()
+    names = set(r.iloc[:, 0])
+    for n in ("bitwise_and", "regexp_extract", "url_extract_host",
+              "levenshtein_distance", "week_of_year", "sinh"):
+        assert n in names, n

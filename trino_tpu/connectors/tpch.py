@@ -81,6 +81,18 @@ class Dictionary:
         uniq, inv = np.unique(mapped, return_inverse=True)
         return inv.astype(np.int32), Dictionary(values=uniq)
 
+    def map_values_nullable(self, fn: Callable[[str], Optional[str]]):
+        """Like map_values for transforms that can yield SQL NULL: returns
+        ((id->new_id lut, id->is_null lut), new Dictionary) — the IR's
+        lut_nullable gathers both tables."""
+        if self.values is None:
+            raise KeyError("cannot enumerate a formatter dictionary")
+        mapped = [fn(str(v)) for v in self.values]
+        nulls = np.array([m is None for m in mapped])
+        filled = np.array(["" if m is None else m for m in mapped])
+        uniq, inv = np.unique(filled, return_inverse=True)
+        return (inv.astype(np.int32), nulls), Dictionary(values=uniq)
+
 
 def _enum(*vals):
     return Dictionary(values=np.array(vals))

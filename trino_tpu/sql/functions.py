@@ -623,6 +623,9 @@ def _register_migrated_families():
 
 _register_migrated_families()
 
+# extended families (math/bitwise/regexp/url/datetime/string-distance) live in
+# their own module; importing registers them into THIS registry
+from . import functions_ext  # noqa: E402,F401  (import-for-registration)
 
 _LEGACY_REGISTERED = False
 
