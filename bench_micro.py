@@ -1,0 +1,209 @@
+"""Kernel-level microbenchmarks (the JMH-suite analog).
+
+Reference: core/trino-main/src/test/java/io/trino/operator/Benchmark*.java
+(BenchmarkHashAndStreamingAggregationOperators, BenchmarkHashJoinOperators,
+BenchmarkGroupByHash, ...) — per-operator throughput isolated from SQL.
+
+Runs on whatever backend is available (CPU by default; the real TPU when
+JAX_PLATFORMS is left at its axon default).  Prints one JSON line per kernel:
+  {"kernel": ..., "rows": N, "ms": median_ms, "rows_per_sec": r}
+
+Usage:  python bench_micro.py [--rows 4000000] [--kernels a,b,...]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_force_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
+if _force_cpu:
+    os.environ.pop("JAX_PLATFORMS")
+
+import jax
+
+if _force_cpu:
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _timeit(fn, *args, runs=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def bench_hashagg_insert(n):
+    """Group-by insert: n rows into ~n/4 distinct int64 keys."""
+    from trino_tpu.ops import hashagg
+    from trino_tpu.types import BIGINT
+
+    rng = np.random.default_rng(0)
+    keys = jnp.asarray(rng.integers(0, n // 4, n))
+    vals = jnp.asarray(rng.random(n))
+    state = hashagg.groupby_init(n, (np.int64,), ((np.float64, 0.0),))
+
+    @jax.jit
+    def step(state, keys, vals):
+        return hashagg.groupby_insert(
+            state, (keys,), (BIGINT,), jnp.ones((n,), bool),
+            [(vals, None)], ["sum"])
+
+    return _timeit(step, state, keys, vals)
+
+
+def bench_join_build(n):
+    from trino_tpu.ops.hashjoin import build_insert, build_table_init
+    from trino_tpu.page import Field, Page, Schema
+    from trino_tpu.types import BIGINT
+
+    key = jnp.asarray((np.arange(n, dtype=np.int64) * 7919) % (1 << 40))
+    page = Page(Schema((Field("k", BIGINT),)), (key,), (None,), None)
+
+    @jax.jit
+    def build(key):
+        jt = build_table_init(4 * n, page)
+        return build_insert(jt, (key,), (BIGINT,), jnp.ones((n,), bool))
+
+    return _timeit(build, key)
+
+
+def bench_join_probe(n):
+    from trino_tpu.ops.hashjoin import build_insert, build_table_init, probe
+    from trino_tpu.page import Field, Page, Schema
+    from trino_tpu.types import BIGINT
+
+    nb = max(n // 8, 1)
+    rng = np.random.default_rng(0)
+    bkey = np.unique((np.arange(nb, dtype=np.int64) * 7919) % (1 << 40))
+    page = Page(Schema((Field("k", BIGINT),)), (jnp.asarray(bkey),), (None,),
+                None)
+    jt = jax.jit(lambda k: build_insert(
+        build_table_init(4 * len(bkey), page), (k,), (BIGINT,),
+        jnp.ones((len(bkey),), bool)))(jnp.asarray(bkey))
+    pkeys = jnp.asarray(rng.choice(bkey, n))
+
+    @jax.jit
+    def run(jt, pkeys):
+        return probe(jt, (pkeys,), (BIGINT,), jnp.ones((n,), bool))
+
+    return _timeit(run, jt, pkeys)
+
+
+def bench_exchange_route(n):
+    """bucketize + all_to_all over an 8-worker mesh (or fewer devices)."""
+    from functools import partial
+
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+    from jax import shard_map
+
+    from trino_tpu.ops.exchange import bucketize, exchange_all_to_all
+    from trino_tpu.parallel.mesh import WORKER_AXIS, worker_mesh
+
+    W = min(8, len(jax.devices()))
+    if W < 2:
+        return None
+    mesh = worker_mesh(W)
+    per = n // W
+    rng = np.random.default_rng(0)
+    cols = jnp.asarray(rng.integers(0, 1 << 40, (W, per)))
+    sharded = NamedSharding(mesh, PS(WORKER_AXIS))
+    cols = jax.device_put(cols, sharded)
+
+    @partial(shard_map, mesh=mesh, in_specs=PS(WORKER_AXIS),
+             out_specs=PS(WORKER_AXIS))
+    def route(c):
+        c = c[0]
+        pid = (c % W).astype(jnp.int32)
+        packed, pvalid, _ = bucketize((c,), jnp.ones_like(c, bool), pid, W,
+                                      per)
+        recv, rvalid = exchange_all_to_all(packed, pvalid, WORKER_AXIS, W)
+        return recv[0][None], rvalid[None]
+
+    return _timeit(jax.jit(route), cols)
+
+
+def bench_sort(n):
+    rng = np.random.default_rng(0)
+    keys = jnp.asarray(rng.integers(0, 1 << 40, n))
+    return _timeit(jax.jit(jnp.sort), keys)
+
+
+def bench_window_scan(n):
+    """Segmented prefix sums over ~n/64 partitions (the window-frame core)."""
+    from trino_tpu.ops import window as W
+
+    rng = np.random.default_rng(0)
+    part = np.sort(rng.integers(0, n // 64, n))
+    starts = jnp.asarray(np.concatenate([[True], part[1:] != part[:-1]]))
+    vals = jnp.asarray(rng.random(n))
+
+    @jax.jit
+    def run(vals, starts):
+        return W.segmented_scan_sum(vals, starts, starts)
+
+    return _timeit(run, vals, starts)
+
+
+def bench_compact(n):
+    """The pipeline-boundary scatter-pack at 1/16 selectivity."""
+    rng = np.random.default_rng(0)
+    valid = jnp.asarray(rng.random(n) < 1 / 16)
+    col = jnp.asarray(rng.integers(0, 1 << 40, n))
+    bucket = n // 8
+
+    @jax.jit
+    def run(col, valid):
+        pos = jnp.cumsum(valid) - 1
+        dst = jnp.where(valid & (pos < bucket), pos, bucket).astype(jnp.int32)
+        return jnp.zeros((bucket + 1,), col.dtype).at[dst].set(col)[:bucket]
+
+    return _timeit(run, col, valid)
+
+
+KERNELS = {
+    "hashagg_insert": bench_hashagg_insert,
+    "join_build": bench_join_build,
+    "join_probe": bench_join_probe,
+    "exchange_route": bench_exchange_route,
+    "sort": bench_sort,
+    "window_scan": bench_window_scan,
+    "compact": bench_compact,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=4_000_000)
+    ap.add_argument("--kernels", type=str, default=",".join(KERNELS))
+    args = ap.parse_args()
+    for name in args.kernels.split(","):
+        fn = KERNELS.get(name.strip())
+        if fn is None:
+            continue
+        try:
+            t = fn(args.rows)
+        except Exception as e:  # one kernel must not kill the suite
+            print(json.dumps({"kernel": name, "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+            continue
+        if t is None:
+            continue
+        print(json.dumps({
+            "kernel": name, "rows": args.rows, "ms": round(t * 1000, 3),
+            "rows_per_sec": round(args.rows / t),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

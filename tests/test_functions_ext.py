@@ -126,3 +126,20 @@ def test_show_functions_lists_new_families(feng):
     for n in ("bitwise_and", "regexp_extract", "url_extract_host",
               "levenshtein_distance", "week_of_year", "sinh"):
         assert n in names, n
+
+
+def test_regexp_replace_dollar_zero_and_backslash(feng):
+    assert _one(feng, "regexp_replace(s, '\\d+', '[$0]')",
+                "n = 255") == "abc-[123]-xyz"
+    with pytest.raises(Exception, match="cannot access group"):
+        _one(feng, "regexp_replace(s, '(\\d)', '$9')", "n = 255")
+
+
+def test_translate_first_mapping_wins(feng):
+    assert _one(feng, "translate(s, 'aa', 'bc')", "n = 255") == "bbc-123-xyz"
+
+
+def test_truncate_negative_scale_and_bad_literals(feng):
+    assert _one(feng, "truncate(1987.6, -2)") == 1900.0
+    with pytest.raises(Exception, match="integer literal"):
+        _one(feng, "truncate(1.9, 1.5)")

@@ -34,6 +34,22 @@ def _args(planner, ast, cols):
     return [planner._translate(a, cols)[0] for a in ast.args]
 
 
+def _int_literal(arg, what: str) -> int:
+    """An integer literal argument (negative allowed via unary minus);
+    anything else is a SemanticError, not a raw ValueError."""
+    F = _rt()
+    neg = False
+    if isinstance(arg, A.UnaryOp) and arg.op in ("-", "negate"):
+        neg, arg = True, arg.operand
+    if not isinstance(arg, A.NumberLit):
+        raise F.SemanticError(f"{what} must be an integer literal")
+    try:
+        v = int(arg.text)
+    except ValueError:
+        raise F.SemanticError(f"{what} must be an integer literal") from None
+    return -v if neg else v
+
+
 # ---------------------------------------------------------------------------- math
 def _build_unary_double(planner, ast, cols):
     F = _rt()
@@ -64,9 +80,7 @@ def _build_truncate(planner, ast, cols):
     args = _args(planner, ast, cols)
     if len(args) == 1:
         return ir.Call("trunc", (F._coerce(args[0], DOUBLE),), DOUBLE), None
-    if not isinstance(ast.args[1], A.NumberLit):
-        raise F.SemanticError("truncate scale must be a literal")
-    n = int(ast.args[1].text)
+    n = _int_literal(ast.args[1], "truncate scale")
     return ir.Call("truncate_n", (F._coerce(args[0], DOUBLE),), DOUBLE,
                    meta=(n,)), None
 
@@ -88,9 +102,7 @@ def _build_bitwise_not(planner, ast, cols):
 def _build_bit_count(planner, ast, cols):
     F = _rt()
     a, _ = _args(planner, ast, cols)
-    if not isinstance(ast.args[1], A.NumberLit):
-        raise F.SemanticError("bit_count bits must be a literal")
-    bits = int(ast.args[1].text)
+    bits = _int_literal(ast.args[1], "bit_count bits")
     if not 2 <= bits <= 64:
         raise F.SemanticError("bit_count bits must be in [2, 64]")
     return ir.Call("bit_count", (F._coerce(a, BIGINT),), BIGINT,
@@ -104,9 +116,7 @@ def _build_regexp_extract(planner, ast, cols):
     pat = re.compile(planner._literal_str(ast.args[1], ast.name))
     group = 0
     if len(ast.args) > 2:
-        if not isinstance(ast.args[2], A.NumberLit):
-            raise F.SemanticError("regexp_extract group must be a literal")
-        group = int(ast.args[2].text)
+        group = _int_literal(ast.args[2], "regexp_extract group")
         if not 0 <= group <= pat.groups:
             raise F.SemanticError(
                 f"pattern has {pat.groups} groups; cannot access group "
@@ -132,8 +142,14 @@ def _build_regexp_replace(planner, ast, cols):
     pat = re.compile(planner._literal_str(ast.args[1], ast.name))
     rep = planner._literal_str(ast.args[2], ast.name) \
         if len(ast.args) > 2 else ""
-    # Trino uses $1 group references; python re uses \1
-    rep = re.sub(r"\$(\d+)", r"\\\1", rep)
+    # Trino uses $N group references (incl. $0 = whole match); python re wants
+    # \g<N>, literal backslashes must be escaped, and group refs validate at
+    # plan time (the reference raises on out-of-range groups)
+    for g in re.findall(r"\$(\d+)", rep):
+        if int(g) > pat.groups:
+            raise _rt().SemanticError(
+                f"pattern has {pat.groups} groups; cannot access group {g}")
+    rep = re.sub(r"\$(\d+)", r"\\g<\1>", rep.replace("\\", "\\\\"))
     lut, nd = d.map_values(lambda s: pat.sub(rep, str(s)))
     return ir.Call("lut", (v, ir.Constant(lut, v.type)), v.type), nd
 
@@ -204,9 +220,11 @@ def _build_translate(planner, ast, cols):
     v, d = planner._require_dict(ast.args[0], cols, ast.name)
     src = planner._literal_str(ast.args[1], ast.name)
     dst = planner._literal_str(ast.args[2], ast.name)
-    # chars beyond dst's length DELETE (SQL translate semantics)
-    table = {ord(c): (dst[i] if i < len(dst) else None)
-             for i, c in enumerate(src)}
+    # chars beyond dst's length DELETE; duplicate source chars: the FIRST
+    # mapping wins (reference: StringFunctions.translate)
+    table: dict = {}
+    for i, c in enumerate(src):
+        table.setdefault(ord(c), dst[i] if i < len(dst) else None)
     lut, nd = d.map_values(lambda s: str(s).translate(table))
     return ir.Call("lut", (v, ir.Constant(lut, v.type)), v.type), nd
 
