@@ -1814,6 +1814,18 @@ def _run_match_recognize(node: P.MatchRecognize, child: Page, cdicts):
 
         return rec(start, 0)
 
+    # vectorized fast path: when greedy backtracking provably reduces to
+    # run-length jumps (ops/matcher.py), match geometry for EVERY start
+    # computes in one device pass and the host only walks actual matches
+    vm = None
+    if not getattr(node, "all_rows", False):
+        from ..ops.matcher import vector_match
+
+        measure_vars = {var for _, var, _, _ in node.measures
+                        if var is not None}
+        vm = vector_match(node.pattern, conds, np.asarray(new_part),
+                          measure_vars)
+
     # non-overlapping matches, AFTER MATCH SKIP PAST LAST ROW
     starts = list(np.nonzero(new_part)[0]) + [n]
     out_rows: list = []
@@ -1821,14 +1833,23 @@ def _run_match_recognize(node: P.MatchRecognize, child: Page, cdicts):
         s, e = int(starts[pi]), int(starts[pi + 1])
         i = s
         while i < e:
-            m = find_match(i, e)
+            if vm is not None:
+                i = int(vm.nxt[i])  # jump straight to the next usable start
+                if i >= e:
+                    break
+                m = (int(vm.end[i]), None)
+            else:
+                m = find_match(i, e)
             if m is None or m[0] == i:  # no match / empty match: advance
                 i += 1
                 continue
             stop, assign = m
-            by_var: dict = {}
-            for row, var in assign:
-                by_var.setdefault(var, []).append(row)
+            if assign is None:  # vectorized: first/last rows per measure var
+                by_var = vm.by_var(i)
+            else:
+                by_var = {}
+                for row, var in assign:
+                    by_var.setdefault(var, []).append(row)
             vals = []
             for kind, var, ch, _ in node.measures:
                 if kind == "col":

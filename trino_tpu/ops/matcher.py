@@ -1,0 +1,152 @@
+"""Vectorized row-pattern matching (the device story for MATCH_RECOGNIZE).
+
+Reference: operator/window/matcher/ — IrRowPatternToProgramRewriter compiles
+patterns to NFA programs that Matcher.java runs per row.  The TPU re-design
+observes that for the dominant class of patterns, greedy backtracking
+collapses to pure run-length arithmetic that vectorizes over EVERY candidate
+start simultaneously:
+
+    If every quantified element's condition is row-disjoint from every LATER
+    element's condition, a greedy quantifier never benefits from giving rows
+    back — any row it released would have to satisfy some later element,
+    which disjointness forbids.  Maximal-run assignment IS the backtracking
+    assignment.
+
+Under that (runtime-checked) gate, a match starting at row i is a chain of
+per-element run-length jumps: pos_0 = i, pos_{k+1} = pos_k + clip(run_k(pos_k)),
+all computed with gathers over precomputed run-length arrays — one jnp pass
+for every start at once, no per-row Python.  The canonical patterns (V-shapes
+``DOWN+ UP+``, spike detection, session stitching) all satisfy the gate since
+their DEFINE conditions are mutually exclusive comparisons.  Patterns outside
+the subset (overlapping quantified conditions, ALL ROWS PER MATCH) keep the
+exact host backtracker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["vector_match", "VectorMatch"]
+
+
+@dataclasses.dataclass
+class VectorMatch:
+    """Precomputed match geometry: usable[i] = a non-empty match starts at i;
+    end[i] = its exclusive stop row; pos[k][i] = row where element k's span
+    begins (pos[P][i] = end).  ``nxt[i]`` = first usable start at or after i
+    (the skip-past-last-row jump table)."""
+
+    usable: np.ndarray
+    end: np.ndarray
+    pos: np.ndarray  # [P+1, n]
+    nxt: np.ndarray  # [n+1]
+    var_element: dict  # var -> element index (single-element vars only)
+
+    def by_var(self, i: int) -> dict:
+        """first/last rows per measure-referenced variable for the match at i
+        (enough for FIRST()/LAST() measure evaluation)."""
+        out = {}
+        for var, k in self.var_element.items():
+            lo, hi = int(self.pos[k, i]), int(self.pos[k + 1, i])
+            if hi > lo:
+                out[var] = [lo, hi - 1]
+        return out
+
+
+def _reverse_cummin(x):
+    import jax
+
+    return jax.lax.cummin(x, reverse=True)
+
+
+def vector_match(pattern, conds: dict, new_part: np.ndarray,
+                 measure_vars) -> VectorMatch | None:
+    """Build the vectorized matcher, or None when the pattern/conditions fall
+    outside the provably-equivalent subset (caller uses the host matcher)."""
+    n = len(new_part)
+    if n == 0:
+        return None
+    els = []
+    for el, q in pattern:
+        if q not in (None, "?", "+", "*"):
+            return None
+        els.append((el if isinstance(el, tuple) else (el,), q))
+
+    ok_list = []
+    for vars_, _ in els:
+        ok = np.zeros(n, bool)
+        for v in vars_:
+            ok |= np.asarray(conds[v], bool)
+        ok_list.append(ok)
+
+    # gate: quantified elements must be disjoint from every later element
+    for k, (_, q) in enumerate(els):
+        if q is None:
+            continue
+        for m in range(k + 1, len(els)):
+            if np.any(ok_list[k] & ok_list[m]):
+                return None
+
+    # gate: measure-referenced variables must live in exactly one
+    # non-alternation element (their spans are then [pos_k, pos_{k+1}))
+    var_element: dict = {}
+    for k, (vars_, _) in enumerate(els):
+        for v in vars_:
+            var_element[v] = None if v in var_element or len(vars_) > 1 else k
+    for v in measure_vars:
+        if var_element.get(v) is None:
+            return None
+    var_element = {v: k for v, k in var_element.items()
+                   if k is not None and v in measure_vars}
+
+    # --- device pass: run lengths + the per-start jump chain
+    idx = jnp.arange(n, dtype=jnp.int32)
+    npart = jnp.asarray(new_part)
+    # next partition start STRICTLY after i (runs must not cross it)
+    starts_at = jnp.where(npart, idx, n)
+    boundary = jnp.concatenate(
+        [_reverse_cummin(starts_at[1:]), jnp.full((1,), n, jnp.int32)])
+
+    runlens = []
+    for ok in ok_list:
+        okj = jnp.asarray(ok)
+        nf = jnp.where(~okj, idx, n)
+        nxt_false = _reverse_cummin(nf)
+        stop = jnp.minimum(nxt_false, boundary)
+        rl = jnp.maximum(stop - idx, 0)
+        runlens.append(jnp.concatenate([rl, jnp.zeros((1,), rl.dtype)]))
+
+    pos = idx
+    match_ok = jnp.ones((n,), bool)
+    pos_stack = [pos]
+    for (vars_, q), rl in zip(els, runlens):
+        r = rl[jnp.clip(pos, 0, n)]
+        # bound by the START row's partition: when a quantified element's run
+        # was clipped at the boundary, pos sits on the NEXT partition's first
+        # row and the gathered run length belongs to that partition — without
+        # this mask, later elements would match across the boundary (matches
+        # must live wholly inside the start row's partition)
+        r = jnp.where(pos >= boundary, 0, r)
+        if q in (None, "?"):
+            take = jnp.minimum(r, 1)
+        else:
+            take = r
+        need = 1 if q in (None, "+") else 0
+        match_ok = match_ok & (r >= need)
+        pos = pos + jnp.where(match_ok, take, 0).astype(jnp.int32)
+        pos_stack.append(pos)
+
+    end = pos
+    usable = match_ok & (end > idx)
+
+    usable_np = np.asarray(usable)
+    end_np = np.asarray(end)
+    pos_np = np.stack([np.asarray(p) for p in pos_stack])
+    iarr = np.arange(n)
+    nxt = np.concatenate([
+        np.minimum.accumulate(np.where(usable_np, iarr, n)[::-1])[::-1],
+        [n]]).astype(np.int64)
+    return VectorMatch(usable_np, end_np, pos_np, nxt, var_element)
