@@ -1,0 +1,156 @@
+"""timestamp(p) and char(n) semantics (reference: spi/type/TimestampType
+short encoding, spi/type/CharType + Chars.padSpaces; test models:
+TestTimestamp, TestCharType in core/trino-main)."""
+
+import datetime
+
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.memory import MemoryConnector
+
+
+@pytest.fixture(scope="module")
+def teng():
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql(
+        "create table ev (id bigint, ts timestamp(6), ts3 timestamp(3), "
+        "name varchar)", s)
+    e.execute_sql("""insert into ev values
+        (1, timestamp '2024-03-15 10:30:45.123456',
+            timestamp '2024-03-15 10:30:45.123', 'alpha'),
+        (2, timestamp '2021-01-01 00:00:00',
+            timestamp '2021-01-01 00:00:00', 'beta  '),
+        (3, timestamp '1969-12-31 23:59:59',
+            timestamp '1969-12-31 23:59:59', 'gamma')""", s)
+    return e, s
+
+
+def _micros(y, mo, d, h=0, mi=0, se=0, us=0):
+    dt = datetime.datetime(y, mo, d, h, mi, se, us)
+    return round((dt - datetime.datetime(1970, 1, 1)).total_seconds()
+                 * 1_000_000)
+
+
+def test_timestamp_literal_storage_and_comparison(teng):
+    e, s = teng
+    r = e.execute_sql("select ts from ev where id = 1", s).to_pandas()
+    assert int(r.iloc[0, 0]) == _micros(2024, 3, 15, 10, 30, 45, 123456)
+    r = e.execute_sql(
+        "select id from ev where ts > timestamp '2023-01-01 00:00:00'",
+        s).to_pandas()
+    assert r["id"].tolist() == [1]
+    # pre-epoch timestamps stay exact
+    r = e.execute_sql("select ts from ev where id = 3", s).to_pandas()
+    assert int(r.iloc[0, 0]) == -1_000_000
+
+
+def test_timestamp_extract_parts(teng):
+    e, s = teng
+    r = e.execute_sql(
+        "select extract(year from ts) y, extract(month from ts) mo, "
+        "extract(day from ts) d, extract(hour from ts) h, "
+        "extract(minute from ts) mi, extract(second from ts) se, "
+        "hour(ts) h2, minute(ts) mi2, second(ts) se2, millisecond(ts) ms "
+        "from ev where id = 1", s).to_pandas()
+    assert r.iloc[0].tolist() == [2024, 3, 15, 10, 30, 45, 10, 30, 45, 123]
+
+
+def test_timestamp_precision_cast_rescales(teng):
+    e, s = teng
+    r = e.execute_sql(
+        "select cast(ts as timestamp(3)) t3, cast(ts3 as timestamp(6)) t6, "
+        "cast(ts as timestamp(0)) t0 from ev where id = 1", s).to_pandas()
+    base = datetime.datetime(2024, 3, 15, 10, 30, 45)
+    secs = round((base - datetime.datetime(1970, 1, 1)).total_seconds())
+    assert int(r["t3"].iloc[0]) == secs * 1000 + 123  # .123456 rounds to .123
+    assert int(r["t6"].iloc[0]) == (secs * 1000 + 123) * 1000
+    assert int(r["t0"].iloc[0]) == secs  # .123456 rounds down at p=0
+
+
+def test_timestamp_date_casts(teng):
+    e, s = teng
+    r = e.execute_sql(
+        "select cast(ts as date) d, "
+        "cast(date '2024-03-15' as timestamp(6)) t from ev where id = 1",
+        s).to_pandas()
+    days = (datetime.date(2024, 3, 15) - datetime.date(1970, 1, 1)).days
+    assert int(r["d"].iloc[0]) == days
+    assert int(r["t"].iloc[0]) == days * 86400 * 1_000_000
+    # pre-epoch: floor to the CIVIL day, not toward zero
+    r = e.execute_sql("select cast(ts as date) d from ev where id = 3",
+                      s).to_pandas()
+    assert int(r["d"].iloc[0]) == -1
+
+
+def test_timestamp_group_and_order(teng):
+    e, s = teng
+    r = e.execute_sql(
+        "select id from ev order by ts desc", s).to_pandas()
+    assert r["id"].tolist() == [1, 2, 3]
+
+
+def test_char_cast_pads_and_compares_space_blind(teng):
+    e, s = teng
+    r = e.execute_sql(
+        "select cast(name as char(8)) c from ev order by id", s).to_pandas()
+    assert r["c"].tolist() == ["alpha   ", "beta    ", "gamma   "]
+    # trailing spaces in the column value are insignificant for char equality
+    r = e.execute_sql(
+        "select id from ev where cast(name as char(8)) = 'beta'",
+        s).to_pandas()
+    assert r["id"].tolist() == [2]
+    # truncation past the declared length
+    r = e.execute_sql(
+        "select cast(name as char(3)) c from ev where id = 1", s).to_pandas()
+    assert r["c"].iloc[0] == "alp"
+
+
+def test_current_timestamp_is_sane(teng):
+    e, s = teng
+    r = e.execute_sql("select current_timestamp() ct from ev where id = 1",
+                      s).to_pandas()
+    now_us = round((datetime.datetime.now(datetime.timezone.utc)
+                    .replace(tzinfo=None)
+                    - datetime.datetime(1970, 1, 1)).total_seconds() * 1e6)
+    assert abs(int(r.iloc[0, 0]) - now_us) < 3600 * 1_000_000
+
+
+def test_pre_epoch_fractional_literal():
+    """The fraction advances time FORWARD even before the epoch (review
+    regression: 23:59:59.5 was parsed a full second early)."""
+    from trino_tpu.types import parse_timestamp_literal
+
+    v, ty = parse_timestamp_literal("1969-12-31 23:59:59.500")
+    assert ty.precision == 3
+    assert v == -500
+
+
+def test_char_column_create_insert_compare():
+    """char(n) columns created via DDL store space-padded values, so equality
+    against unpadded literals works (review regression: stored unpadded)."""
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table c (k char(3), v bigint)", s)
+    e.execute_sql("insert into c values ('ab', 1), ('xyz', 2)", s)
+    r = e.execute_sql("select v from c where k = 'ab'", s).to_pandas()
+    assert r["v"].tolist() == [1]
+    r = e.execute_sql("select k from c order by v", s).to_pandas()
+    assert r["k"].tolist() == ["ab ", "xyz"]
+
+
+def test_finer_literal_never_equals_coarser_column(teng):
+    e, s = teng
+    # ts3 has millis precision; a micros-precision literal between ticks
+    # must NOT equal (comparison happens at the finer precision)
+    r = e.execute_sql(
+        "select id from ev where ts3 = '2021-01-01 00:00:00.000500'",
+        s).to_pandas()
+    assert r["id"].tolist() == []
+    r = e.execute_sql(
+        "select id from ev where ts3 > '2020-12-31 23:59:59.999999'",
+        s).to_pandas()
+    assert 2 in r["id"].tolist()

@@ -1909,6 +1909,14 @@ class Planner:
             raise SemanticError(f"string literal {ast.value!r} outside comparison context")
         if isinstance(ast, A.DateLit):
             return ir.Constant(parse_date_literal(ast.value), DATE), None
+        if isinstance(ast, A.TimestampLit):
+            from ..types import parse_timestamp_literal
+
+            try:
+                v, ty = parse_timestamp_literal(ast.value)
+            except ValueError as e:
+                raise SemanticError(str(e)) from e
+            return ir.Constant(v, ty), None
         if isinstance(ast, A.NullLit):
             return ir.Constant(None, UNKNOWN), None
         if isinstance(ast, A.BoolLit):
@@ -1959,21 +1967,37 @@ class Planner:
         if isinstance(ast, A.CaseExpr):
             return self._translate_case(ast, cols)
         if isinstance(ast, A.Cast):
+            from ..types import CharType
+
             t = _type_from_name(ast.type_name, ast.params)
             if getattr(ast, "safe", False):
                 return self._try_cast(ast.value, t, cols)
+            if isinstance(t, CharType):
+                # char(n) semantics: truncate past n, SPACE-PAD to n — the
+                # padded form makes char comparisons trailing-space-blind
+                # (reference: spi/type/CharType + Chars.padSpaces)
+                if isinstance(ast.value, A.StringLit):
+                    from ..connectors.tpch import Dictionary
+
+                    padded = ast.value.value[:t.length].ljust(t.length)
+                    return ir.Constant(0, t), Dictionary(
+                        values=np.array([padded], dtype=object))
+                v, d = self._translate(ast.value, cols)
+                if d is None or getattr(d, "values", None) is None:
+                    raise SemanticError(
+                        "cast to char needs a dictionary-backed string source")
+                lut, nd = d.map_values(
+                    lambda s, n_=t.length: str(s)[:n_].ljust(n_))
+                return ir.Call("lut", (v, ir.Constant(lut, t)), t), nd
             v, d = self._translate(ast.value, cols)
             return _coerce(v, t), (d if t.is_string else None)
         if isinstance(ast, A.Extract):
+            from .functions import timestamp_part
+
             v, _ = self._translate(ast.value, cols)
-            field = {"dow": "day_of_week", "doy": "day_of_year",
-                     "day_of_week": "day_of_week", "day_of_year": "day_of_year"}.get(
+            field = {"dow": "day_of_week", "doy": "day_of_year"}.get(
                 ast.field, ast.field)
-            if field in ("day_of_week", "day_of_year"):
-                return ir.Call(field, (v,), BIGINT), None
-            if field not in ("year", "month", "day", "quarter"):
-                raise SemanticError(f"extract({ast.field}) not supported")
-            return ir.Call(f"extract_{field}", (v,), BIGINT), None
+            return timestamp_part(v, field), None
         if isinstance(ast, A.FuncCall):
             return self._translate_func(ast, cols)
         if isinstance(ast, A.ScalarSubquery):
@@ -1984,10 +2008,26 @@ class Planner:
         """Translate ``ast`` in the context of comparison against ``other`` (resolves string
         literals to dictionary ids)."""
         if isinstance(ast, A.StringLit):
+            from ..types import CharType, TimestampType
+
+            if isinstance(other.type, CharType) and other_dict is not None:
+                # char comparison ignores trailing spaces: both sides live
+                # space-padded to the declared length in the dictionary
+                n_ = other.type.length
+                return ir.Constant(
+                    other_dict.lookup(ast.value[:n_].ljust(n_)), other.type)
             if other.type.is_string and other_dict is not None:
                 return ir.Constant(other_dict.lookup(ast.value), other.type)
             if other.type.name == "date":
                 return ir.Constant(parse_date_literal(ast.value), DATE)
+            if isinstance(other.type, TimestampType):
+                from ..types import parse_timestamp_literal
+
+                # keep the literal's OWN precision: the comparison path
+                # coerces both sides to the common (finer) precision, so a
+                # sub-unit literal never falsely equals a coarser column
+                v, ty = parse_timestamp_literal(ast.value)
+                return ir.Constant(v, ty)
             raise SemanticError(f"cannot compare string literal to {other.type}")
         e, _ = self._translate(ast, cols)
         return e
@@ -2525,7 +2565,15 @@ def _type_from_name(name: str, params) -> Type:
         p = params[0] if params else 18
         s = params[1] if len(params) > 1 else 0
         return DecimalType.of(min(p, 18), s)
-    if name in ("varchar", "char"):
+    if name == "timestamp":
+        from ..types import TimestampType
+
+        return TimestampType.of(params[0] if params else 3)
+    if name == "char":
+        from ..types import CharType
+
+        return CharType.of(params[0] if params else 1)
+    if name == "varchar":
         return VarcharType.of(params[0] if params else None)
     if name == "array" and params:
         return ArrayType.of(_type_from_name(*params[0]))

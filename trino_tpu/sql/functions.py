@@ -378,11 +378,48 @@ def _build_typeof(planner, ast, cols):
 _EXTRACT_ALIASES = {"dow": "day_of_week", "doy": "day_of_year"}
 
 
+TS_PARTS = ("year", "quarter", "month", "day", "hour", "minute", "second",
+            "millisecond", "day_of_week", "day_of_year")
+
+
+def timestamp_part(v, part: str):
+    """One shared extract-a-part planner for date/timestamp expressions (the
+    frontend's EXTRACT, year()/month()-style calls, and hour()/minute() all
+    route here).  Returns the ir expression, or raises SemanticError."""
+    from ..types import TimestampType
+    from . import frontend as F
+
+    if isinstance(v.type, TimestampType):
+        if part not in TS_PARTS:
+            raise F.SemanticError(f"extract({part}) not supported")
+        if part in ("day_of_week", "day_of_year"):
+            d = ir.Call("ts_to_date", (v,), DATE, meta=(v.type.precision,))
+            return ir.Call(part, (d,), BIGINT)
+        return ir.Call("ts_extract", (v,), BIGINT,
+                       meta=(part, v.type.precision))
+    if part in ("hour", "minute", "second", "millisecond"):
+        return ir.Constant(0, BIGINT)  # dates have no time of day
+    if part in ("day_of_week", "day_of_year"):
+        return ir.Call(part, (v,), BIGINT)
+    if part not in ("year", "quarter", "month", "day"):
+        raise F.SemanticError(f"extract({part}) not supported")
+    return ir.Call(f"extract_{part}", (v,), BIGINT)
+
+
+def ts_to_date_expr(v):
+    """Timestamp -> its civil date (shared by date-domain functions that
+    accept timestamp arguments)."""
+    from ..types import TimestampType
+
+    if isinstance(v.type, TimestampType):
+        return ir.Call("ts_to_date", (v,), DATE, meta=(v.type.precision,))
+    return v
+
+
 def _build_extract_part(planner, ast, cols):
     v, _ = planner._translate(ast.args[0], cols)
     part = _EXTRACT_ALIASES.get(ast.name, ast.name)
-    op = part if part in ("day_of_week", "day_of_year") else f"extract_{part}"
-    return ir.Call(op, (v,), BIGINT), None
+    return timestamp_part(v, part), None
 
 
 def _build_date_trunc(planner, ast, cols):
@@ -393,7 +430,7 @@ def _build_date_trunc(planner, ast, cols):
     if unit not in ("year", "quarter", "month", "week", "day"):
         raise F.SemanticError(f"date_trunc unit {unit} not supported")
     v, _ = planner._translate(ast.args[1], cols)
-    return ir.Call(f"date_trunc_{unit}", (v,), DATE), None
+    return ir.Call(f"date_trunc_{unit}", (ts_to_date_expr(v),), DATE), None
 
 
 def _build_current_date(planner, ast, cols):
@@ -411,6 +448,7 @@ def _build_date_arith(planner, ast, cols):
         raise F.SemanticError(f"{name} unit {unit!r} not supported")
     a, _ = planner._translate(ast.args[1], cols)
     b, _ = planner._translate(ast.args[2], cols)
+    b = ts_to_date_expr(b)
     if name == "date_add":
         return ir.Call("date_add_unit", (F._coerce(a, BIGINT), b), DATE,
                        meta=(unit,)), None

@@ -302,13 +302,35 @@ def _build_url_codec(planner, ast, cols):
 
 # ---------------------------------------------------------------------------- datetime breadth
 def _build_date_unary(planner, ast, cols):
+    from .functions import ts_to_date_expr
+
     op = {"last_day_of_month": "last_day_of_month",
           "week": "week_of_year", "week_of_year": "week_of_year",
           "year_of_week": "year_of_week", "yow": "year_of_week",
           "day_of_month": "extract_day"}[ast.name]
     (v,) = _args(planner, ast, cols)
+    v = ts_to_date_expr(v)  # timestamps convert to their civil date first
     t = DATE if op == "last_day_of_month" else BIGINT
     return ir.Call(op, (v,), t), None
+
+
+def _build_ts_part(planner, ast, cols):
+    from .functions import timestamp_part
+
+    (v,) = _args(planner, ast, cols)
+    return timestamp_part(v, ast.name), None
+
+
+def _build_current_timestamp(planner, ast, cols):
+    import datetime
+
+    from ..types import TimestampType
+
+    ty = TimestampType.of(6)
+    now = datetime.datetime.now(datetime.timezone.utc).replace(tzinfo=None)
+    epoch = datetime.datetime(1970, 1, 1)
+    micros = round((now - epoch).total_seconds() * 1_000_000)
+    return ir.Constant(micros, ty), None
 
 
 def _build_date_parse(planner, ast, cols):
@@ -409,6 +431,15 @@ def register_extended_families() -> None:
                     ("yow", "ISO week-numbering year"),
                     ("day_of_month", "Day of month")):
         register(n, "scalar", desc, (1, 1), _build_date_unary)
+    for n in ("hour", "minute", "second", "millisecond"):
+        register(n, "scalar", f"Extract {n} from a timestamp", (1, 1),
+                 _build_ts_part)
+    register("current_timestamp", "scalar",
+             "Current timestamp(6) at plan time", (0, 0),
+             _build_current_timestamp)
+    register("localtimestamp", "scalar",
+             "Current timestamp(6) at plan time", (0, 0),
+             _build_current_timestamp)
     register("from_iso8601_date", "scalar",
              "Parse an ISO-8601 date string (dictionary LUT)", (1, 1),
              _build_date_parse)

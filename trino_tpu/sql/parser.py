@@ -47,6 +47,11 @@ class DateLit(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class TimestampLit(Node):
+    value: str  # 'YYYY-MM-DD HH:MM:SS[.fffffffff]'; precision = fraction digits
+
+
+@dataclasses.dataclass(frozen=True)
 class IntervalLit(Node):
     value: str
     unit: str
@@ -1332,6 +1337,10 @@ class Parser:
         if t.kind == "string":
             self.next()
             return StringLit(t.value)
+        if t.kind == "ident" and t.value == "timestamp" \
+                and self.peek(1).kind == "string":
+            self.next()
+            return TimestampLit(self.expect_kind("string").value)
         if t.kind == "keyword":
             if t.value == "null":
                 self.next()

@@ -136,6 +136,22 @@ class CharType(Type):
 
 
 @dataclasses.dataclass(frozen=True)
+class TimestampType(Type):
+    """timestamp(p): int64 epoch count in units of 10^-p seconds (reference:
+    spi/type/TimestampType short encoding — micros at p=6)."""
+
+    precision: int = 6
+
+    @staticmethod
+    def of(precision: int) -> "TimestampType":
+        if not 0 <= precision <= 9:
+            raise NotImplementedError(
+                f"timestamp precision {precision} outside [0, 9]")
+        return TimestampType(name=f"timestamp({precision})", dtype=jnp.int64,
+                             precision=precision)
+
+
+@dataclasses.dataclass(frozen=True)
 class ArrayType(Type):
     """array(T) — TPU-first layout: the column stores a packed int64 SPAN
     (start << 24 | length) into a host/plan-side element heap (ops/arrays.py
@@ -196,8 +212,9 @@ REAL = Type("real", jnp.float32)
 BOOLEAN = Type("boolean", jnp.bool_)
 # days since 1970-01-01, mirroring spi/type/DateType.java
 DATE = Type("date", jnp.int32)
-# microseconds since epoch (timestamp(6)), mirroring spi/type/TimestampType.java short form
-TIMESTAMP = Type("timestamp(6)", jnp.int64)
+# epoch units of 10^-p seconds, mirroring spi/type/TimestampType.java's short
+# form (p <= 9 here; the reference's LongTimestamp long form is not supported)
+TIMESTAMP = TimestampType.of(6)
 VARCHAR = VarcharType.of(None)
 UNKNOWN = Type("unknown", jnp.int8, comparable=False, orderable=False)
 
@@ -229,6 +246,12 @@ def common_super_type(a: Type, b: Type) -> Type:
         return [TINYINT, SMALLINT, INTEGER, BIGINT, REAL, DOUBLE][idx]
     if a.is_string and b.is_string:
         return VARCHAR
+    if isinstance(a, TimestampType) and isinstance(b, TimestampType):
+        return a if a.precision >= b.precision else b
+    if isinstance(a, TimestampType) and b.name == "date":
+        return a
+    if isinstance(b, TimestampType) and a.name == "date":
+        return b
     if a.name == "unknown":
         return b
     if b.name == "unknown":
@@ -237,6 +260,39 @@ def common_super_type(a: Type, b: Type) -> Type:
 
 
 _EPOCH = np.datetime64("1970-01-01", "D")
+
+
+def parse_timestamp_literal(text: str):
+    """'YYYY-MM-DD[ HH:MM[:SS[.f...]]]' -> (value, TimestampType): precision =
+    number of fraction digits (reference: timestamp literal typing), value in
+    epoch units of 10^-p seconds."""
+    import datetime
+
+    t = text.strip()
+    frac_digits = 0
+    frac = 0
+    if "." in t:
+        t, f = t.split(".", 1)
+        if not f.isdigit() or len(f) > 9:
+            raise ValueError(f"invalid timestamp literal {text!r}")
+        frac_digits = len(f)
+        frac = int(f)
+    try:
+        if " " in t:
+            dt = datetime.datetime.strptime(
+                t, "%Y-%m-%d %H:%M:%S" if t.count(":") == 2
+                else "%Y-%m-%d %H:%M")
+        else:
+            d = datetime.date.fromisoformat(t)
+            dt = datetime.datetime(d.year, d.month, d.day)
+    except ValueError as e:
+        raise ValueError(f"invalid timestamp literal {text!r}") from e
+    epoch = datetime.datetime(1970, 1, 1)
+    secs = int((dt - epoch).total_seconds())
+    ty = TimestampType.of(frac_digits)
+    # the fraction always advances time FORWARD, pre-epoch included
+    # (23:59:59.5 is half a second AFTER 23:59:59)
+    return secs * 10 ** frac_digits + frac, ty
 
 
 def parse_date_literal(text: str) -> int:
