@@ -313,3 +313,74 @@ def test_fte_memory_failure_bisects_task(tmp_path):
     assert got == expected
     assert any(c > 1 for c in calls) and any(c == 1 for c in calls), \
         "bisection never recursed"
+
+
+def test_graceful_shutdown_drains_and_leaves(tmp_path):
+    """Graceful shutdown (reference: GracefulShutdownHandler): the worker
+    finishes running tasks, refuses new ones with 503, reports
+    shutting_down, and leaves the cluster; queries keep succeeding on the
+    remaining worker."""
+    import urllib.request
+
+    e = _engine()
+    coord = ClusterCoordinator(e, str(tmp_path / "spool"),
+                               heartbeat_interval=0.1)
+    url = coord.start()
+    w1 = WorkerServer(CATALOGS, str(tmp_path / "spool"), coordinator_url=url,
+                      node_id="w1", announce_interval=0.1)
+    w2 = WorkerServer(CATALOGS, str(tmp_path / "spool"), coordinator_url=url,
+                      node_id="w2", announce_interval=0.1)
+    w1.start()
+    w2.start()
+    try:
+        coord.wait_for_workers(2, timeout=20)
+        expected = e.execute_sql(Q).rows()
+        assert coord.execute_sql(Q).rows() == expected
+
+        w1.shutdown_gracefully()
+        info = json.loads(urllib.request.urlopen(
+            f"{w1.url}/v1/info", timeout=5).read())
+        assert info["state"] == "shutting_down"
+        # the coordinator drains w1 out of scheduling within an announce tick
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            live = {w.node_id for w in coord.live_workers()}
+            if live == {"w2"}:
+                break
+            time.sleep(0.05)
+        assert {w.node_id for w in coord.live_workers()} == {"w2"}
+        # queries still work on the remaining worker
+        assert coord.execute_sql(Q).rows() == expected
+        # the drained worker eventually leaves entirely (announce "gone")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with coord._lock:
+                if "w1" not in coord.workers:
+                    break
+            time.sleep(0.05)
+        with coord._lock:
+            assert "w1" not in coord.workers
+    finally:
+        w2.stop()
+        coord.stop()
+
+
+def test_task_admission_backpressure(tmp_path):
+    """A worker at max_concurrent_tasks refuses with 429; the coordinator
+    re-offers instead of burning retry attempts, and the query completes."""
+    e = _engine()
+    coord = ClusterCoordinator(e, str(tmp_path / "spool"),
+                               heartbeat_interval=0.2, splits_per_task=1,
+                               max_attempts=2)
+    url = coord.start()
+    w = WorkerServer(CATALOGS, str(tmp_path / "spool"), coordinator_url=url,
+                     node_id="slow", announce_interval=0.1)
+    w.max_concurrent_tasks = 1  # every concurrent dispatch beyond 1 -> 429
+    w.start()
+    try:
+        coord.wait_for_workers(1, timeout=20)
+        expected = e.execute_sql(Q).rows()
+        assert coord.execute_sql(Q).rows() == expected
+    finally:
+        w.stop()
+        coord.stop()

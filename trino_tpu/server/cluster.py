@@ -35,6 +35,7 @@ import pickle
 import threading
 import time
 import traceback
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -101,6 +102,14 @@ def _sign(secret: str, body: bytes) -> str:
 _LOOPBACK = ("127.0.0.1", "localhost", "::1")
 
 
+class _WorkerBusy(Exception):
+    """Task admission refused: queue depth at max (backpressure)."""
+
+
+class _WorkerDraining(Exception):
+    """Task admission refused: graceful shutdown in progress."""
+
+
 # ---------------------------------------------------------------------------- worker
 @dataclasses.dataclass
 class _TaskState:
@@ -152,6 +161,13 @@ class WorkerServer:
         # the registries; eviction must also never drop state still in use
         self._exec_lock = threading.Lock()  # one fragment executes at a time
         self._running_frags: dict = {}  # fragment_id -> running task count
+        self._running_tasks = 0
+        # admission backpressure: tasks beyond this queue depth are refused
+        # with 429 and the coordinator re-offers them (the OutputBuffer-full /
+        # isFull() producer blocking of the reference, re-planned as admission
+        # control at the task boundary)
+        self.max_concurrent_tasks = 8
+        self._draining = False  # graceful shutdown: no NEW work, finish running
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._stop = threading.Event()
 
@@ -173,8 +189,9 @@ class WorkerServer:
 
             def do_GET(self):
                 if self.path == "/v1/info":
+                    state = "shutting_down" if worker._draining else "active"
                     return self._reply(200, {"node_id": worker.node_id,
-                                             "state": "active"})
+                                             "state": state})
                 if self.path.startswith("/v1/task/"):
                     tid = self.path.rsplit("/", 1)[-1]
                     st = worker.tasks.get(tid)
@@ -212,7 +229,17 @@ class WorkerServer:
                         worker._start_task(req)
                     except KeyError:
                         return self._reply(409, {"error": "unknown fragment"})
+                    except _WorkerDraining:
+                        return self._reply(503, {"error": "shutting down"})
+                    except _WorkerBusy:
+                        return self._reply(429, {"error": "task queue full"})
                     return self._reply(200, {"accepted": req["task_id"]})
+                if self.path == "/v1/shutdown":
+                    req = self._read_verified()
+                    if req is None:
+                        return self._reply(403, {"error": "bad signature"})
+                    worker.shutdown_gracefully()
+                    return self._reply(200, {"state": "shutting_down"})
                 self._reply(404, {"error": "not found"})
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
@@ -234,9 +261,11 @@ class WorkerServer:
     def _announce_loop(self):
         while not self._stop.is_set():
             try:
+                state = "shutting_down" if self._draining else "active"
                 _http(f"{self.coordinator_url}/v1/announce",
                       json.dumps({"node_id": self.node_id,
-                                  "url": self.url}).encode(),
+                                  "url": self.url,
+                                  "state": state}).encode(),
                       secret=self.secret)
             except Exception:
                 pass  # coordinator not up yet / transient
@@ -261,9 +290,16 @@ class WorkerServer:
         tid = str(req["task_id"])
         frag_id = req["fragment_id"]
         with self._wlock:
+            # under _wlock: the drain thread checks _running_tasks under the
+            # same lock, so no task can slip in after it observed zero
+            if self._draining:
+                raise _WorkerDraining()
             node = self.fragments.get(frag_id)
             if node is None:
                 raise KeyError(frag_id)
+            if self._running_tasks >= self.max_concurrent_tasks:
+                raise _WorkerBusy()
+            self._running_tasks += 1
             self.tasks[tid] = st = _TaskState()
             self._running_frags[frag_id] = self._running_frags.get(frag_id, 0) + 1
             # prune only TERMINAL task states: a running entry evicted here
@@ -300,6 +336,7 @@ class WorkerServer:
                 st.error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
             finally:
                 with self._wlock:
+                    self._running_tasks -= 1
                     n = self._running_frags.get(frag_id, 1) - 1
                     if n <= 0:
                         self._running_frags.pop(frag_id, None)
@@ -307,6 +344,34 @@ class WorkerServer:
                         self._running_frags[frag_id] = n
 
         threading.Thread(target=run, daemon=True).start()
+
+    # -- graceful shutdown (reference: server/GracefulShutdownHandler.java:
+    # SHUTTING_DOWN gates new work, active tasks drain, then the process
+    # exits; the coordinator drains the node out of scheduling on its next
+    # announce/heartbeat) ------------------------------------------------------
+    def shutdown_gracefully(self, poll: float = 0.1) -> None:
+        if self._draining:
+            return
+        self._draining = True
+
+        def drain():
+            while True:
+                with self._wlock:
+                    if self._running_tasks == 0:
+                        break
+                time.sleep(poll)
+            if self.coordinator_url:  # final notice: leave the cluster NOW
+                try:
+                    _http(f"{self.coordinator_url}/v1/announce",
+                          json.dumps({"node_id": self.node_id,
+                                      "url": self.url,
+                                      "state": "gone"}).encode(),
+                          secret=self.secret)
+                except Exception:
+                    pass  # heartbeats will notice eventually
+            self.stop()
+
+        threading.Thread(target=drain, daemon=True).start()
 
 
 # ---------------------------------------------------------------------------- coordinator
@@ -317,6 +382,7 @@ class _WorkerInfo:
     last_seen: float
     misses: int = 0
     alive: bool = True
+    draining: bool = False  # graceful shutdown: reachable but not schedulable
 
 
 class ClusterCoordinator:
@@ -401,7 +467,8 @@ class ClusterCoordinator:
                                                    _sign(coord.secret, body)):
                             return self._reply(403, {"error": "bad signature"})
                     msg = json.loads(body)
-                    coord._announce(msg["node_id"], msg["url"])
+                    coord._announce(msg["node_id"], msg["url"],
+                                    msg.get("state", "active"))
                     return self._reply(200, {"ok": True})
                 self._reply(404, {"error": "not found"})
 
@@ -425,8 +492,12 @@ class ClusterCoordinator:
         if self._httpd:
             self._httpd.shutdown()
 
-    def _announce(self, node_id: str, url: str):
+    def _announce(self, node_id: str, url: str, state: str = "active"):
         with self._lock:
+            if state == "gone":  # graceful exit: leave the cluster NOW
+                self.workers.pop(node_id, None)
+                return
+            draining = (state == "shutting_down")
             w = self.workers.get(node_id)
             if w is None:
                 if len(self.workers) >= self.max_workers:
@@ -436,9 +507,11 @@ class ClusterCoordinator:
                         self.workers.pop(nid)
                 if len(self.workers) >= self.max_workers:
                     return
-                self.workers[node_id] = _WorkerInfo(node_id, url, time.time())
+                self.workers[node_id] = _WorkerInfo(node_id, url, time.time(),
+                                                    draining=draining)
             else:
                 w.url, w.last_seen, w.misses, w.alive = url, time.time(), 0, True
+                w.draining = draining
 
     def _heartbeat_loop(self):
         """HeartbeatFailureDetector (simplified): probe /v1/info; max_misses
@@ -448,9 +521,10 @@ class ClusterCoordinator:
                 snapshot = list(self.workers.values())
             for w in snapshot:
                 try:
-                    _http(f"{w.url}/v1/info", timeout=2.0)
+                    info = json.loads(_http(f"{w.url}/v1/info", timeout=2.0))
                     with self._lock:
                         w.misses, w.alive, w.last_seen = 0, True, time.time()
+                        w.draining = info.get("state") == "shutting_down"
                 except Exception:
                     with self._lock:
                         w.misses += 1
@@ -459,8 +533,12 @@ class ClusterCoordinator:
             self._stop.wait(self.heartbeat_interval)
 
     def live_workers(self) -> list:
+        """Schedulable workers: alive and not draining (a gracefully
+        shutting-down node finishes its running tasks but takes no new
+        ones — reference: NodeState.SHUTTING_DOWN excluded from scheduling)."""
         with self._lock:
-            return [w for w in self.workers.values() if w.alive]
+            return [w for w in self.workers.values()
+                    if w.alive and not w.draining]
 
     def wait_for_workers(self, n: int, timeout: float = 20.0):
         deadline = time.time() + timeout
@@ -727,6 +805,7 @@ class ClusterCoordinator:
 
         pending = dict(tasks)
         attempts: dict = {tid: 0 for tid, _ in tasks}
+        refused_since: dict = {}  # tid -> first 429/503 of the current streak
         assigned: dict = {}  # task_id -> (worker, extra, deadline)
         started: dict = {}  # task_id -> dispatch time (speculation baseline)
         durations: list = []  # completed task durations this fragment
@@ -751,7 +830,33 @@ class ClusterCoordinator:
                     _http(f"{w.url}/v1/task", req, secret=self.secret)
                     assigned[tid] = (w, extra, time.time() + self.task_timeout)
                     started[tid] = time.time()
+                    refused_since.pop(tid, None)
                     del pending[tid]
+                except urllib.error.HTTPError as he:
+                    if he.code in (429, 503):
+                        # backpressure/draining, not failure: leave the task
+                        # pending; the next loop pass re-offers it (likely to
+                        # another worker as the rotation advances).  Sustained
+                        # refusal past task_timeout burns an attempt so a
+                        # permanently-full cluster cannot spin this loop
+                        # forever
+                        t0 = refused_since.setdefault(tid, time.time())
+                        if time.time() - t0 > self.task_timeout:
+                            refused_since.pop(tid, None)
+                            attempts[tid] += 1
+                            if attempts[tid] >= self.max_attempts:
+                                raise RuntimeError(
+                                    f"task {tid} refused by every worker for "
+                                    f"{self.task_timeout:.0f}s "
+                                    f"({attempts[tid]} attempts)")
+                        continue
+                    frag_sent.discard(w.url)
+                    attempts[tid] += 1
+                    if attempts[tid] >= self.max_attempts:
+                        raise RuntimeError(
+                            f"task {tid} failed to dispatch after "
+                            f"{attempts[tid]} attempts")
+                    continue
                 except Exception:
                     # unreachable worker, or 409 after a restart/fragment
                     # eviction: the fragment must re-ship.  The failure also
