@@ -194,3 +194,45 @@ def test_ui_query_drilldown(tpch_sf001):
             urllib.request.urlopen(f"{srv.url}/ui/query/nope", timeout=10)
     finally:
         srv.stop()
+
+
+def test_concurrent_queries_share_the_engine_safely(coordinator):
+    """Concurrent queries check out SEPARATE executors from the engine's pool
+    (one query's host gaps overlap another's device work; a shared executor's
+    per-query state would race).  Results must match serial execution."""
+    import threading
+
+    from trino_tpu.server import Client
+
+    queries = [
+        "select count(*) c from lineitem",
+        "select l_returnflag, sum(l_quantity) q from lineitem "
+        "group by l_returnflag order by l_returnflag",
+        "select max(l_extendedprice) m from lineitem",
+        "select count(*) c from orders where o_custkey < 100",
+    ]
+    c = Client(coordinator.url, catalog="tpch")
+    expected = [c.execute(q).rows for q in queries]
+
+    results = [None] * len(queries) * 3
+    errors = []
+
+    def run(i, q):
+        try:
+            results[i] = Client(coordinator.url, catalog="tpch").execute(q).rows
+        except Exception as e:  # pragma: no cover - the assertion reports it
+            errors.append(e)
+
+    threads = []
+    for k in range(3):
+        for j, q in enumerate(queries):
+            threads.append(threading.Thread(
+                target=run, args=(k * len(queries) + j, q)))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    for k in range(3):
+        for j in range(len(queries)):
+            assert results[k * len(queries) + j] == expected[j]

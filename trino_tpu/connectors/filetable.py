@@ -58,6 +58,9 @@ class MultiFileConnector:
     file list + partition metadata); everything else — splits, pruning,
     dictionary unification, constant-column synthesis — is shared."""
 
+    HOST_DECODE = True  # parquet delegate decodes on the host: scans benefit
+    # from background-thread split prefetch
+
     def __init__(self, fs=None):
         self.fs = fs if fs is not None else LocalFileSystem()
         self._tables: dict = {}

@@ -95,6 +95,8 @@ def _decode_bound(ty, raw: bytes):
 
 class IcebergConnector:
     name = "iceberg"
+    HOST_DECODE = True  # pages decode on the host: scans benefit from
+    # background-thread split prefetch (see local_executor._prefetched_pages)
 
     def __init__(self, warehouse: str):
         self.warehouse = warehouse

@@ -43,6 +43,7 @@ class _OrcTable:
 
 class OrcConnector:
     name = "orc"
+    HOST_DECODE = True  # pyarrow stripe decode on the host: prefetchable
 
     def __init__(self, directory: str):
         self.directory = directory

@@ -99,6 +99,8 @@ class _PqTable:
 
 class ParquetConnector:
     name = "parquet"
+    HOST_DECODE = True  # pages decode on the host: scans benefit from
+    # background-thread split prefetch (see local_executor._prefetched_pages)
 
     def __init__(self, directory: str):
         self.directory = directory

@@ -17,6 +17,7 @@ between sources and sinks is streaming.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from functools import partial
 from typing import Callable, Optional
 
@@ -385,6 +386,13 @@ class LocalExecutor:
                 for s in splits:
                     yield conn.generate(s, node.columns)
 
+            if getattr(conn, "HOST_DECODE", False):
+                # file connectors decode on the HOST: prefetch the next split
+                # on a background thread so decode overlaps device compute
+                # (the local-exchange producer/consumer overlap of the
+                # reference, operator/exchange/LocalExchange.java — re-planned
+                # at the split boundary)
+                pages = _prefetched_pages(pages)
             si = _ScanInfo(conn, splits, tuple(node.columns), tuple(node.columns))
             return _Stream(node.schema, dicts, pages, lambda c, n, v, aux: (c, n, v), si)
 
@@ -1992,6 +2000,37 @@ def _page_bytes(page: Page) -> int:
         total += page.capacity * np.dtype(c.dtype).itemsize
     total += sum(page.capacity for n in page.null_masks if n is not None)
     return total
+
+
+def _prefetched_pages(pages_fn, depth: int = 2):
+    """Wrap a page generator with background-thread prefetch: up to ``depth``
+    pages decode ahead of the consumer.  Exceptions re-raise at the consume
+    site; an abandoned consumer (LIMIT) leaves at most ``depth`` extra decoded
+    pages behind on a daemon thread."""
+    import queue as _queue
+
+    def pages():
+        q: _queue.Queue = _queue.Queue(maxsize=depth)
+        done = object()
+
+        def producer():
+            try:
+                for p in pages_fn():
+                    q.put(p)
+                q.put(done)
+            except BaseException as e:  # surfaces in the consumer
+                q.put(e)
+
+        threading.Thread(target=producer, daemon=True).start()
+        while True:
+            item = q.get()
+            if item is done:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    return pages
 
 
 def _host(arrays):

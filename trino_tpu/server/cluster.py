@@ -360,6 +360,10 @@ class WorkerServer:
                     if self._running_tasks == 0:
                         break
                 time.sleep(poll)
+            # halt the periodic announce loop BEFORE 'gone': a
+            # shutting_down announce landing after it would re-register
+            # the departed worker as a ghost entry
+            self._stop.set()
             if self.coordinator_url:  # final notice: leave the cluster NOW
                 try:
                     _http(f"{self.coordinator_url}/v1/announce",
@@ -806,6 +810,7 @@ class ClusterCoordinator:
         pending = dict(tasks)
         attempts: dict = {tid: 0 for tid, _ in tasks}
         refused_since: dict = {}  # tid -> first 429/503 of the current streak
+        spin = 0  # placement rotation: re-offered tasks must try OTHER workers
         assigned: dict = {}  # task_id -> (worker, extra, deadline)
         started: dict = {}  # task_id -> dispatch time (speculation baseline)
         durations: list = []  # completed task durations this fragment
@@ -816,8 +821,9 @@ class ClusterCoordinator:
             live = self.live_workers()
             if not live:
                 raise RuntimeError("no live workers")
+            spin += 1
             for i, (tid, extra) in enumerate(list(pending.items())):
-                w = live[i % len(live)]
+                w = live[(i + spin) % len(live)]
                 try:
                     if w.url not in frag_sent:
                         _http(f"{w.url}/v1/fragment", frag_blob,

@@ -340,8 +340,9 @@ class CoordinatorServer:
             f"<td>{len(q.rows) if q.rows is not None else ''}</td>"
             f"<td><code>{_html.escape(q.sql[:120])}</code></td></tr>"
             for q in qs)
-        pool = getattr(getattr(self.engine, "_executor", None),
-                       "memory_pool", None)
+        pool = next((ex.memory_pool
+                     for ex in getattr(self.engine, "_all_executors", ())
+                     if hasattr(ex, "memory_pool")), None)
         pool_line = ""
         if pool is not None:
             info = pool.info()
