@@ -62,6 +62,13 @@ QUERIES = {
       and l_shipdate > date '1995-03-15'
     group by l_orderkey, o_orderdate, o_shippriority
     order by revenue desc, o_orderdate limit 10""",
+    "q4": """
+    select o_orderpriority, count(*) as order_count from orders
+    where o_orderdate >= date '1993-07-01'
+      and o_orderdate < date '1993-07-01' + interval '3' month
+      and exists (select 1 from lineitem where l_orderkey = o_orderkey
+                  and l_commitdate < l_receiptdate)
+    group by o_orderpriority order by o_orderpriority""",
     "q9": """
     select nation, o_year, sum(amount) as sum_profit from (
       select n_name as nation, extract(year from o_orderdate) as o_year,
@@ -85,6 +92,7 @@ QUERIES = {
 QUERY_TABLES = {
     "q1": ["lineitem"],
     "q3": ["customer", "orders", "lineitem"],
+    "q4": ["orders", "lineitem"],
     "q9": ["part", "supplier", "lineitem", "partsupp", "orders", "nation"],
     "q18": ["customer", "orders", "lineitem"],
 }
@@ -95,10 +103,10 @@ QUERY_TABLES = {
 BASELINE_COLUMNS = {
     "lineitem": ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
                  "l_discount", "l_tax", "l_shipdate", "l_orderkey", "l_partkey",
-                 "l_suppkey"],
+                 "l_suppkey", "l_commitdate", "l_receiptdate"],
     "customer": ["c_custkey", "c_mktsegment", "c_name"],
     "orders": ["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority",
-               "o_totalprice"],
+               "o_totalprice", "o_orderpriority"],
     "part": ["p_partkey", "p_name"],
     "supplier": ["s_suppkey", "s_nationkey"],
     "partsupp": ["ps_partkey", "ps_suppkey", "ps_supplycost"],
@@ -174,6 +182,19 @@ def cpu_q3(T):
     return r.sort_values(["revenue", "o_orderdate"], ascending=[False, True]).head(10)
 
 
+def cpu_q4(T):
+    o = T["orders"]; l = T["lineitem"]
+    lo = (np.datetime64("1993-07-01") - np.datetime64("1970-01-01")).astype(np.int64)
+    hi = (np.datetime64("1993-10-01") - np.datetime64("1970-01-01")).astype(np.int64)
+    od = o["o_orderdate"].to_numpy()
+    o2 = o[(od >= lo) & (od < hi)]
+    late = l[l["l_commitdate"].to_numpy() < l["l_receiptdate"].to_numpy()]
+    keys = np.unique(late["l_orderkey"].to_numpy())
+    m = o2[np.isin(o2["o_orderkey"].to_numpy(), keys)]
+    r = m.groupby("o_orderpriority").size().reset_index(name="order_count")
+    return r.sort_values("o_orderpriority")
+
+
 def cpu_q9(T):
     p = T["part"]; s = T["supplier"]; l = T["lineitem"]
     ps = T["partsupp"]; o = T["orders"]; n = T["nation"]
@@ -205,7 +226,8 @@ def cpu_q18(T):
                          ascending=[False, True]).head(100)
 
 
-CPU_QUERIES = {"q1": cpu_q1, "q3": cpu_q3, "q9": cpu_q9, "q18": cpu_q18}
+CPU_QUERIES = {"q1": cpu_q1, "q3": cpu_q3, "q4": cpu_q4, "q9": cpu_q9,
+               "q18": cpu_q18}
 
 
 class _BudgetExceeded(Exception):
@@ -274,7 +296,7 @@ def main():
         T = _HostTables(conn)
 
         names = [q.strip() for q in
-                 os.environ.get("BENCH_QUERIES", "q1,q3,q9,q18").split(",")
+                 os.environ.get("BENCH_QUERIES", "q1,q3,q4,q9,q18").split(",")
                  if q.strip() in QUERIES]
         for name in names:
             if remaining() < 30:
