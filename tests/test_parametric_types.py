@@ -154,3 +154,19 @@ def test_finer_literal_never_equals_coarser_column(teng):
         "select id from ev where ts3 > '2020-12-31 23:59:59.999999'",
         s).to_pandas()
     assert 2 in r["id"].tolist()
+
+
+def test_timestamp_interval_arithmetic(teng):
+    e, s = teng
+    r = e.execute_sql(
+        "select ts + interval '2' hour a, ts - interval '90' second b "
+        "from ev where id = 2", s).to_pandas()
+    base = _micros(2021, 1, 1)
+    assert int(r["a"].iloc[0]) == base + 2 * 3600 * 1_000_000
+    assert int(r["b"].iloc[0]) == base - 90 * 1_000_000
+    # comparison with shifted bounds
+    r = e.execute_sql(
+        "select id from ev where ts > timestamp '2021-01-01 00:00:00' "
+        "- interval '1' minute and ts < timestamp '2021-01-01 00:00:00' "
+        "+ interval '1' minute", s).to_pandas()
+    assert r["id"].tolist() == [2]

@@ -2054,10 +2054,28 @@ class Planner:
                 raise SemanticError("ordering comparison on strings not supported yet")
             return ir.Call(op, (_coerce(l, t), _coerce(r, t)), BOOLEAN), None
         # arithmetic, incl. date +/- interval constant folding
-        l_const_date = isinstance(ast.left, A.DateLit)
         r_interval = isinstance(ast.right, A.IntervalLit)
         if r_interval:
+            from ..types import TimestampType
+
             l, _ = self._translate(ast.left, cols)
+            if isinstance(l.type, TimestampType):
+                # timestamp +/- interval: scale the interval to the value's
+                # precision units (day-time intervals only; month/year would
+                # need civil-calendar arithmetic on device)
+                if op not in ("add", "subtract"):
+                    raise SemanticError(
+                        f"invalid timestamp/interval arithmetic {op}")
+                secs = _interval_seconds(ast.right)
+                if secs is None:
+                    raise SemanticError(
+                        "timestamp +/- year-month intervals not supported yet")
+                delta = secs * 10 ** l.type.precision
+                delta = delta if op == "add" else -delta
+                if isinstance(l, ir.Constant):
+                    return ir.Constant(l.value + delta, l.type), None
+                return ir.Call("add", (l, ir.Constant(delta, BIGINT)),
+                               l.type), None
             days = _interval_days(ast.right)
             if days is not None:
                 delta = days if op == "add" else -days
@@ -2592,14 +2610,17 @@ def _derive_name(ast, i: int) -> str:
     return f"_col{i}"
 
 
-def _interval_days(iv: A.IntervalLit):
-    unit = iv.unit
+def _interval_seconds(iv: A.IntervalLit):
+    """Day-time interval -> whole seconds, or None for year-month units."""
     n = int(iv.value) * (-1 if iv.negative else 1)
-    if unit == "day":
-        return n
-    if unit == "week":
-        return n * 7
-    return None
+    scale = {"second": 1, "minute": 60, "hour": 3600, "day": 86400,
+             "week": 7 * 86400}.get(iv.unit)
+    return None if scale is None else n * scale
+
+
+def _interval_days(iv: A.IntervalLit):
+    s = _interval_seconds(iv)
+    return None if s is None or s % 86400 else s // 86400
 
 
 def _interval_months(iv: A.IntervalLit) -> int:
