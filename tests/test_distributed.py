@@ -101,6 +101,14 @@ QUERIES = {
                lag(o_orderkey) over (partition by o_custkey order by o_orderdate,
                                      o_orderkey) prev
         from orders order by o_custkey, o_orderkey""",
+    # north-star Q4: EXISTS semi join distributed (bench suite member)
+    "q4": """
+        select o_orderpriority, count(*) as order_count from orders
+        where o_orderdate >= date '1993-07-01'
+          and o_orderdate < date '1993-07-01' + interval '3' month
+          and exists (select 1 from lineitem where l_orderkey = o_orderkey
+                      and l_commitdate < l_receiptdate)
+        group by o_orderpriority order by o_orderpriority""",
     "window_dist_frame": """
         select o_custkey, o_orderkey,
                sum(o_totalprice) over (partition by o_custkey

@@ -394,3 +394,90 @@ def test_returns_join_reason(eng, host2):
         ascending=False)
     np.testing.assert_allclose(
         [r[1] for r in got], (ref.head(5) / 100).to_numpy(), rtol=1e-9)
+
+
+@pytest.fixture(scope="module")
+def host3(eng):
+    """Host copies for the Q19/Q65 store-geography shapes."""
+    e, _ = eng
+    conn = e.catalogs["tpcds"]
+    wanted = {
+        "store_sales": ["ss_sold_date_sk", "ss_item_sk", "ss_customer_sk",
+                        "ss_store_sk", "ss_ext_sales_price", "ss_sales_price"],
+        "date_dim": ["d_date_sk", "d_year", "d_moy"],
+        "item": ["i_item_sk", "i_brand_id", "i_brand", "i_manager_id",
+                 "i_item_desc"],
+        "customer": ["c_customer_sk", "c_current_addr_sk"],
+        "customer_address": ["ca_address_sk"],
+        "store": ["s_store_sk", "s_store_name"],
+    }
+    out = {}
+    for t, names in wanted.items():
+        dicts = conn.dictionaries(t)
+        cols = {}
+        for name in names:
+            parts = [np.asarray(conn.generate(sp, [name]).column(name))
+                     for sp in conn.splits(t)]
+            arr = np.concatenate(parts)
+            if dicts.get(name) is not None:
+                arr = dicts[name].decode(arr)
+            cols[name] = arr
+        out[t] = pd.DataFrame(cols)
+    return out
+
+
+def test_q19_brand_revenue_by_geography(eng, host3):
+    """Q19 shape: brand ext-price for a manager's items in one month, joined
+    through customer geography and store (6-table star join)."""
+    e, s = eng
+    got = e.execute_sql("""
+        select i_brand_id, i_brand, sum(ss_ext_sales_price) ext_price
+        from date_dim, store_sales, item, customer, customer_address, store
+        where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+          and i_manager_id = 8 and d_moy = 11 and d_year = 1998
+          and ss_customer_sk = c_customer_sk
+          and c_current_addr_sk = ca_address_sk and ss_store_sk = s_store_sk
+        group by i_brand_id, i_brand
+        order by ext_price desc, i_brand_id limit 10""", s).to_pandas()
+
+    ss = host3["store_sales"]; dd = host3["date_dim"]; it = host3["item"]
+    cu = host3["customer"]; ca = host3["customer_address"]; st = host3["store"]
+    j = ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    j = j[(j.i_manager_id == 8) & (j.d_moy == 11) & (j.d_year == 1998)]
+    j = j.merge(cu, left_on="ss_customer_sk", right_on="c_customer_sk")
+    j = j.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+    j = j.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+    exp = (j.assign(p=j.ss_ext_sales_price / 100.0)
+           .groupby(["i_brand_id", "i_brand"])["p"].sum().reset_index()
+           .sort_values(["p", "i_brand_id"], ascending=[False, True])
+           .head(10))
+    assert len(got) == len(exp)
+    np.testing.assert_allclose(got["ext_price"].to_numpy().astype(float),
+                               exp["p"].to_numpy(), rtol=1e-9)
+    assert got["i_brand_id"].tolist() == exp["i_brand_id"].tolist()
+
+
+def test_q65_store_item_revenue(eng, host3):
+    """Q65 shape: per (store, item) revenue from a derived aggregate, joined
+    back to dimensions (subquery-in-FROM + two joins)."""
+    e, s = eng
+    got = e.execute_sql("""
+        select s_store_name, i_item_desc, sc.revenue
+        from store, item,
+         (select ss_store_sk, ss_item_sk, sum(ss_sales_price) as revenue
+          from store_sales group by ss_store_sk, ss_item_sk) sc
+        where sc.ss_store_sk = s_store_sk and sc.ss_item_sk = i_item_sk
+        order by s_store_name, revenue desc, i_item_desc limit 25""",
+        s).to_pandas()
+
+    ss = host3["store_sales"]; it = host3["item"]; st = host3["store"]
+    agg = (ss.assign(p=ss.ss_sales_price / 100.0)
+           .groupby(["ss_store_sk", "ss_item_sk"])["p"].sum().reset_index())
+    j = agg.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    exp = j.sort_values(["s_store_name", "p", "i_item_desc"],
+                        ascending=[True, False, True]).head(25)
+    np.testing.assert_allclose(got["revenue"].to_numpy().astype(float),
+                               exp["p"].to_numpy(), rtol=1e-9)
+    assert got["s_store_name"].tolist() == exp["s_store_name"].tolist()
