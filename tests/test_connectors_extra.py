@@ -57,3 +57,34 @@ def test_orc_connector(tmp_path):
     r3 = e.execute_sql("select sum(price) from sales where tag = 'tag1'", s).rows()
     expect3 = sum(i * 0.5 for i in range(n) if i % 7 != 0 and i % 5 == 1)
     assert abs(r3[0][0] - expect3) < 1e-6
+
+
+def test_information_schema_tables_and_columns():
+    """ANSI information_schema introspection (reference:
+    connector/informationschema) — the surface BI tools query."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table inv (id bigint, price decimal(10,2), "
+                  "name varchar)", s)
+    r = e.execute_sql(
+        "select table_catalog, table_name from information_schema.tables "
+        "where table_catalog = 'mem'", s).to_pandas()
+    assert r.values.tolist() == [["mem", "inv"]]
+    r = e.execute_sql(
+        "select column_name, ordinal_position, data_type "
+        "from information_schema.columns where table_name = 'inv' "
+        "order by ordinal_position", s).to_pandas()
+    assert r["column_name"].tolist() == ["id", "price", "name"]
+    assert r["data_type"].tolist() == ["bigint", "decimal(10,2)", "varchar"]
+    r = e.execute_sql(
+        "select count(*) c from information_schema.schemata", s).to_pandas()
+    assert int(r.iloc[0, 0]) >= 3  # mem + system + information_schema
+
+    e.execute_sql("create view v_inv as select id from inv", s)
+    r = e.execute_sql(
+        "select table_name from information_schema.views", s).to_pandas()
+    assert r["table_name"].tolist() == ["v_inv"]

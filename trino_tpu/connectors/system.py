@@ -22,7 +22,7 @@ from ..page import Field, Page, Schema
 from ..types import BIGINT, DOUBLE, VarcharType
 from .tpch import Dictionary
 
-__all__ = ["SystemConnector"]
+__all__ = ["SystemConnector", "InformationSchemaConnector"]
 
 _V = VarcharType.of(None)
 
@@ -107,7 +107,7 @@ class SystemConnector:
         # whose recorded version is newer than its embedded LUTs).
         with self.engine._plan_lock:
             rows = self._rows(table)
-            schema = SCHEMAS[table]
+            schema = self.schema(table)
             out = {}
             for ci, f in enumerate(schema.fields):
                 if f.type.is_string:
@@ -179,7 +179,7 @@ class SystemConnector:
             return self._generate_locked(split, columns)
 
     def _generate_locked(self, split: SystemSplit, columns=None) -> Page:
-        schema = SCHEMAS[split.table]
+        schema = self.schema(split.table)
         names = columns if columns is not None else schema.names
         rows = self._rows(split.table)
         n = len(rows)
@@ -202,3 +202,68 @@ class SystemConnector:
             nulls.append(jnp.asarray(nullmask) if nullmask.any() else None)
         valid = jnp.asarray(np.arange(cap) < n)
         return Page(out_schema, tuple(cols), tuple(nulls), valid)
+
+
+# ---------------------------------------------------------------------------- information_schema
+IS_SCHEMAS = {
+    "schemata": Schema((
+        Field("catalog_name", _V), Field("schema_name", _V),
+    )),
+    "tables": Schema((
+        Field("table_catalog", _V), Field("table_schema", _V),
+        Field("table_name", _V), Field("table_type", _V),
+    )),
+    "columns": Schema((
+        Field("table_catalog", _V), Field("table_schema", _V),
+        Field("table_name", _V), Field("column_name", _V),
+        Field("ordinal_position", BIGINT), Field("data_type", _V),
+        Field("is_nullable", _V),
+    )),
+    "views": Schema((
+        Field("table_catalog", _V), Field("table_name", _V),
+    )),
+}
+
+
+class InformationSchemaConnector(SystemConnector):
+    """ANSI information_schema over the engine's catalogs (reference:
+    connector/informationschema/InformationSchemaMetadata — per-catalog there,
+    one flat catalog here to match the engine's flat namespace; the surface BI
+    tools introspect: schemata/tables/columns/views)."""
+
+    name = "information_schema"
+
+    def tables(self):
+        return sorted(IS_SCHEMAS)
+
+    def schema(self, table: str) -> Schema:
+        return IS_SCHEMAS[table]
+
+    def _rows(self, table: str) -> list:
+        e = self.engine
+        cats = sorted((n, c) for n, c in e.catalogs.items())
+        if table == "schemata":
+            return [(name, "default") for name, _ in cats]
+        if table == "tables":
+            out = []
+            for name, c in cats:
+                for t in sorted(c.tables()):
+                    out.append((name, "default", t, "BASE TABLE"))
+            for v in sorted(getattr(e, "views", ())):
+                out.append(("", "default", v, "VIEW"))
+            return out
+        if table == "columns":
+            out = []
+            for name, c in cats:
+                for t in sorted(c.tables()):
+                    try:
+                        sch = c.schema(t)
+                    except Exception:
+                        continue  # discovery failure must not hide the rest
+                    for i, f in enumerate(sch.fields, 1):
+                        out.append((name, "default", t, f.name, i,
+                                    f.type.name, "YES"))
+            return out
+        if table == "views":
+            return [("", v) for v in sorted(getattr(e, "views", ()))]
+        raise KeyError(table)
