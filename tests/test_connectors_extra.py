@@ -88,3 +88,16 @@ def test_information_schema_tables_and_columns():
     r = e.execute_sql(
         "select table_name from information_schema.views", s).to_pandas()
     assert r["table_name"].tolist() == ["v_inv"]
+
+
+def test_show_create_table():
+    from trino_tpu import Engine
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table t (id bigint, p decimal(10,2), n varchar)", s)
+    ddl = e.execute_sql("show create table t", s).to_pandas().iloc[0, 0]
+    assert ddl == ("CREATE TABLE mem.t (\n   id bigint,\n"
+                   "   p decimal(10,2),\n   n varchar\n)")

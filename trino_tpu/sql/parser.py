@@ -808,6 +808,9 @@ class Parser:
         if what == "stats":
             self.expect("for")
             return Show("stats", self.expect_kind("ident").value)
+        if what == "create":
+            self.expect("table")
+            return Show("create_table", self.expect_kind("ident").value)
         raise ParseError(f"unsupported SHOW {what!r}")
 
     def _expect_ident(self, value: str) -> None:
