@@ -112,3 +112,21 @@ def test_partitioned_aggregation_fallback(tpch_sf001, monkeypatch):
         "group by o_custkey order by o_custkey")
     import numpy as np
     assert int(np.sum(r.columns[1])) == 15000
+
+
+def test_init_multihost_noop_single_host(monkeypatch):
+    """Without multi-host configuration, init_multihost is a no-op returning
+    False (jax.distributed.initialize must NOT be called single-host)."""
+    from trino_tpu.parallel import mesh as M
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID", "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(var, raising=False)
+    called = []
+    monkeypatch.setattr(M.jax.distributed, "initialize",
+                        lambda *a, **k: called.append(1))
+    assert M.init_multihost() is False
+    assert not called
+    # explicit multi-host config routes through jax.distributed.initialize
+    assert M.init_multihost("10.0.0.1:8476", 2, 0) is True
+    assert called

@@ -34,3 +34,37 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def row_sharded(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(WORKER_AXIS))
+
+
+def init_multihost(coordinator: str | None = None,
+                   num_processes: int | None = None,
+                   process_id: int | None = None) -> bool:
+    """Join a multi-host jax.distributed job and return True when this process
+    is part of one (False = single-host, a no-op).
+
+    The reference scales out by adding worker NODES over HTTP/DCN; the
+    TPU-native equivalent is one global device mesh spanning hosts — the same
+    shard_map programs run unchanged, XLA routes the all_to_all exchanges over
+    ICI within a slice and DCN across slices (the scaling-book recipe: pick a
+    mesh, annotate shardings, let XLA insert collectives).
+
+    Configuration comes from arguments or the standard env vars
+    (JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES, JAX_PROCESS_ID); on TPU pods
+    jax.distributed.initialize() autodetects all three.  After initialization,
+    ``worker_mesh()`` builds over jax.devices(), which now spans every host."""
+    import os
+
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    num = num_processes if num_processes is not None else \
+        int(os.environ.get("JAX_NUM_PROCESSES", "0") or 0)
+    pid = process_id if process_id is not None else \
+        int(os.environ.get("JAX_PROCESS_ID", "-1") or -1)
+    on_pod = os.environ.get("TPU_WORKER_HOSTNAMES") is not None
+    if not on_pod and (coordinator is None or num <= 1 or pid < 0):
+        return False  # single-host: local mesh only
+    if on_pod and coordinator is None:
+        jax.distributed.initialize()  # TPU pod: autodetected
+    else:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num, process_id=pid)
+    return True
