@@ -48,6 +48,7 @@ class OrcConnector:
     def __init__(self, directory: str):
         self.directory = directory
         self._tables: dict = {}
+        self._paths: dict = {}  # explicit registrations (table-format reuse)
 
     def tables(self):
         names = set(self._tables)
@@ -63,9 +64,10 @@ class OrcConnector:
             return t
         from pyarrow import orc
 
-        path = os.path.join(self.directory, f"{table}.orc")
+        path = self._paths.get(table) \
+            or os.path.join(self.directory, f"{table}.orc")
         of = orc.ORCFile(path)
-        fields, dicts, id_maps = [], {}, {}
+        fields, dicts, id_maps, ranges = [], {}, {}, {}
         for fld in of.schema:
             ty = _arrow_to_type(fld.type)
             fields.append(Field(fld.name, ty))
@@ -76,8 +78,24 @@ class OrcConnector:
                 uniq = sorted(v for v in pc.unique(col).to_pylist() if v is not None)
                 dicts[fld.name] = Dictionary(values=np.array(uniq or [""], dtype=object))
                 id_maps[fld.name] = {v: i for i, v in enumerate(uniq)}
+            elif ty.is_integer or ty.name == "date":
+                # pyarrow's ORC reader exposes no stripe statistics: compute
+                # FILE-level bounds once at open (CBO ranges + direct-index
+                # sizing; the file is being footer-read here anyway)
+                import pyarrow.compute as pc
+
+                col = of.read(columns=[fld.name]).column(0)
+                lo, hi = pc.min(col).as_py(), pc.max(col).as_py()
+                if ty.name == "date" and lo is not None:
+                    import datetime
+
+                    epoch = datetime.date(1970, 1, 1)
+                    lo, hi = (lo - epoch).days, (hi - epoch).days
+                if lo is not None:
+                    ranges[fld.name] = (lo, hi)
         t = _OrcTable(path, Schema(tuple(fields)), of.nrows, of.nstripes,
                       dicts, id_maps)
+        t.ranges = ranges
         self._tables[table] = t
         return t
 
@@ -91,7 +109,8 @@ class OrcConnector:
         return self._open(table).n_rows
 
     def column_range(self, table: str, column: str):
-        return (None, None)
+        return getattr(self._open(table), "ranges", {}).get(column,
+                                                            (None, None))
 
     def splits(self, table: str, n_hint: int = 0):
         t = self._open(table)
@@ -111,9 +130,21 @@ class OrcConnector:
             arr = batch.column(cname)
             null_np = np.asarray(arr.is_null())
             if f.type.is_string:
+                # one python pass per DISTINCT stripe value, vectorized gather
+                # for the rows (same shape as the parquet dictionary decode)
+                import pyarrow as pa
+
                 idm = t.id_maps[cname]
-                vals = arr.to_pylist()
-                ids = np.array([0 if v is None else idm[v] for v in vals], np.int32)
+                if isinstance(arr, pa.ChunkedArray):
+                    arr = arr.combine_chunks()
+                enc = arr if pa.types.is_dictionary(arr.type) \
+                    else arr.dictionary_encode()
+                local = enc.dictionary.to_pylist()
+                remap = np.fromiter((0 if v is None else idm[v]
+                                     for v in local), np.int32,
+                                    count=len(local))
+                idx = np.asarray(enc.indices.fill_null(0)).astype(np.int64)
+                ids = remap[idx] if len(local) else np.zeros(len(arr), np.int32)
                 cols.append(jnp.asarray(ids))
             else:
                 np_arr = arr.to_numpy(zero_copy_only=False)
@@ -125,3 +156,34 @@ class OrcConnector:
                     np.asarray(jnp.zeros(0, f.type.dtype)).dtype)))
             nulls.append(jnp.asarray(null_np) if null_np.any() else None)
         return Page(out_schema, tuple(cols), tuple(nulls), None)
+
+    # -- write (CTAS/INSERT target parity with the parquet connector) ----------
+    def write_table(self, table: str, names, types, columns) -> str:
+        import decimal
+
+        import pyarrow as pa
+        from pyarrow import orc
+
+        from ..types import DecimalType
+
+        arrays = []
+        for col, ty in zip(columns, types):
+            if isinstance(ty, DecimalType):
+                q = decimal.Decimal(1).scaleb(-ty.scale)
+                arrays.append(pa.array(
+                    [None if v is None else decimal.Decimal(str(v)).quantize(q)
+                     for v in col], type=pa.decimal128(18, ty.scale)))
+            elif ty.name == "date":
+                arrays.append(pa.array(col, type=pa.int32()).cast(pa.date32()))
+            else:
+                at = (pa.string() if ty.is_string else
+                      {"bigint": pa.int64(), "integer": pa.int32(),
+                       "smallint": pa.int16(), "tinyint": pa.int8(),
+                       "double": pa.float64(), "real": pa.float32(),
+                       "boolean": pa.bool_()}[ty.name])
+                arrays.append(pa.array(col, type=at))
+        os.makedirs(self.directory, exist_ok=True)
+        path = os.path.join(self.directory, f"{table}.orc")
+        orc.write_table(pa.table(dict(zip(names, arrays))), path)
+        self._tables.pop(table, None)
+        return path
