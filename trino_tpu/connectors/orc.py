@@ -48,7 +48,6 @@ class OrcConnector:
     def __init__(self, directory: str):
         self.directory = directory
         self._tables: dict = {}
-        self._paths: dict = {}  # explicit registrations (table-format reuse)
 
     def tables(self):
         names = set(self._tables)
@@ -64,27 +63,33 @@ class OrcConnector:
             return t
         from pyarrow import orc
 
-        path = self._paths.get(table) \
-            or os.path.join(self.directory, f"{table}.orc")
+        path = os.path.join(self.directory, f"{table}.orc")
         of = orc.ORCFile(path)
         fields, dicts, id_maps, ranges = [], {}, {}, {}
+        types_by_name = {}
         for fld in of.schema:
             ty = _arrow_to_type(fld.type)
             fields.append(Field(fld.name, ty))
+            types_by_name[fld.name] = ty
+        # ONE decode pass builds string dictionaries AND numeric file-level
+        # bounds (pyarrow's ORC reader exposes no stripe statistics, and the
+        # file is being opened anyway; per-column reads would decompress the
+        # stripes once per column)
+        wanted = [n for n, ty in types_by_name.items()
+                  if ty.is_string or ty.is_integer or ty.name == "date"]
+        tbl = of.read(columns=wanted) if wanted else None
+        for n in wanted:
+            import pyarrow.compute as pc
+
+            ty = types_by_name[n]
+            col = tbl.column(n)
             if ty.is_string:
-                import pyarrow.compute as pc
-
-                col = of.read(columns=[fld.name]).column(0)
-                uniq = sorted(v for v in pc.unique(col).to_pylist() if v is not None)
-                dicts[fld.name] = Dictionary(values=np.array(uniq or [""], dtype=object))
-                id_maps[fld.name] = {v: i for i, v in enumerate(uniq)}
-            elif ty.is_integer or ty.name == "date":
-                # pyarrow's ORC reader exposes no stripe statistics: compute
-                # FILE-level bounds once at open (CBO ranges + direct-index
-                # sizing; the file is being footer-read here anyway)
-                import pyarrow.compute as pc
-
-                col = of.read(columns=[fld.name]).column(0)
+                uniq = sorted(v for v in pc.unique(col).to_pylist()
+                              if v is not None)
+                dicts[n] = Dictionary(values=np.array(uniq or [""],
+                                                      dtype=object))
+                id_maps[n] = {v: i for i, v in enumerate(uniq)}
+            else:
                 lo, hi = pc.min(col).as_py(), pc.max(col).as_py()
                 if ty.name == "date" and lo is not None:
                     import datetime
@@ -92,7 +97,7 @@ class OrcConnector:
                     epoch = datetime.date(1970, 1, 1)
                     lo, hi = (lo - epoch).days, (hi - epoch).days
                 if lo is not None:
-                    ranges[fld.name] = (lo, hi)
+                    ranges[n] = (lo, hi)
         t = _OrcTable(path, Schema(tuple(fields)), of.nrows, of.nstripes,
                       dicts, id_maps)
         t.ranges = ranges
@@ -159,29 +164,12 @@ class OrcConnector:
 
     # -- write (CTAS/INSERT target parity with the parquet connector) ----------
     def write_table(self, table: str, names, types, columns) -> str:
-        import decimal
-
         import pyarrow as pa
         from pyarrow import orc
 
-        from ..types import DecimalType
+        from .parquet import arrow_arrays
 
-        arrays = []
-        for col, ty in zip(columns, types):
-            if isinstance(ty, DecimalType):
-                q = decimal.Decimal(1).scaleb(-ty.scale)
-                arrays.append(pa.array(
-                    [None if v is None else decimal.Decimal(str(v)).quantize(q)
-                     for v in col], type=pa.decimal128(18, ty.scale)))
-            elif ty.name == "date":
-                arrays.append(pa.array(col, type=pa.int32()).cast(pa.date32()))
-            else:
-                at = (pa.string() if ty.is_string else
-                      {"bigint": pa.int64(), "integer": pa.int32(),
-                       "smallint": pa.int16(), "tinyint": pa.int8(),
-                       "double": pa.float64(), "real": pa.float32(),
-                       "boolean": pa.bool_()}[ty.name])
-                arrays.append(pa.array(col, type=at))
+        arrays = arrow_arrays(types, columns)
         os.makedirs(self.directory, exist_ok=True)
         path = os.path.join(self.directory, f"{table}.orc")
         orc.write_table(pa.table(dict(zip(names, arrays))), path)

@@ -342,31 +342,47 @@ class ParquetConnector:
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        import decimal
-
-        arrays = []
-        for col, ty in zip(columns, types):
-            if isinstance(ty, DecimalType):
-                q = decimal.Decimal(1).scaleb(-ty.scale)
-                arrays.append(pa.array(
-                    [None if v is None else decimal.Decimal(str(v)).quantize(q)
-                     for v in col], type=pa.decimal128(18, ty.scale)))
-            elif ty.name == "date":
-                arrays.append(pa.array(col, type=pa.int32()).cast(pa.date32()))
-            else:
-                # declared type, NOT value inference: an all-null column would
-                # otherwise persist as arrow null (unreadable table) and
-                # integer/real would widen to bigint/double on rewrite
-                at = (pa.string() if ty.is_string else
-                      {"bigint": pa.int64(), "integer": pa.int32(),
-                       "smallint": pa.int16(), "tinyint": pa.int8(),
-                       "double": pa.float64(), "real": pa.float32(),
-                       "boolean": pa.bool_(),
-                       "timestamp(6)": pa.timestamp("us"),
-                       "unknown": pa.int8()}[ty.name])
-                arrays.append(pa.array(col, type=at))
+        arrays = arrow_arrays(types, columns)
         os.makedirs(self.directory, exist_ok=True)
         path = os.path.join(self.directory, f"{table}.parquet")
         pq.write_table(pa.table(dict(zip(names, arrays))), path)
         self._tables.pop(table, None)
         return path
+
+
+def arrow_arrays(types, columns) -> list:
+    """Decoded host columns -> typed arrow arrays (shared by the parquet and
+    ORC writers).  Declared types, NOT value inference: an all-null column
+    would otherwise persist as arrow null (unreadable table) and integer/real
+    would widen to bigint/double on rewrite."""
+    import decimal
+
+    import pyarrow as pa
+
+    arrays = []
+    for col, ty in zip(columns, types):
+        if isinstance(ty, DecimalType):
+            q = decimal.Decimal(1).scaleb(-ty.scale)
+            arrays.append(pa.array(
+                [None if v is None else decimal.Decimal(str(v)).quantize(q)
+                 for v in col], type=pa.decimal128(18, ty.scale)))
+        elif ty.name == "date":
+            arrays.append(pa.array(col, type=pa.int32()).cast(pa.date32()))
+        elif ty.name.startswith("timestamp"):
+            p = getattr(ty, "precision", 6)
+            unit = "s" if p == 0 else ("ms" if p <= 3 else
+                                       ("us" if p <= 6 else "ns"))
+            scale = {"s": 1, "ms": 10 ** (3 - p) if p <= 3 else 1,
+                     "us": 10 ** (6 - p) if p <= 6 else 1,
+                     "ns": 10 ** (9 - p)}[unit]
+            arrays.append(pa.array(
+                [None if v is None else int(v) * scale for v in col],
+                type=pa.timestamp(unit)))
+        else:
+            at = (pa.string() if ty.is_string else
+                  {"bigint": pa.int64(), "integer": pa.int32(),
+                   "smallint": pa.int16(), "tinyint": pa.int8(),
+                   "double": pa.float64(), "real": pa.float32(),
+                   "boolean": pa.bool_(), "unknown": pa.int8()}[ty.name])
+            arrays.append(pa.array(col, type=at))
+    return arrays

@@ -60,11 +60,16 @@ def init_multihost(coordinator: str | None = None,
     pid = process_id if process_id is not None else \
         int(os.environ.get("JAX_PROCESS_ID", "-1") or -1)
     on_pod = os.environ.get("TPU_WORKER_HOSTNAMES") is not None
-    if not on_pod and (coordinator is None or num <= 1 or pid < 0):
+    explicit = coordinator is not None and num > 1 and pid >= 0
+    if not on_pod and not explicit:
+        if coordinator is not None or num > 0 or pid >= 0:
+            raise ValueError(
+                "partial multi-host configuration: need coordinator address, "
+                "num_processes > 1 AND process_id >= 0 together")
         return False  # single-host: local mesh only
-    if on_pod and coordinator is None:
-        jax.distributed.initialize()  # TPU pod: autodetected
-    else:
+    if explicit:
         jax.distributed.initialize(coordinator_address=coordinator,
                                    num_processes=num, process_id=pid)
+    else:
+        jax.distributed.initialize()  # TPU pod: everything autodetected
     return True
