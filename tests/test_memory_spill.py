@@ -155,3 +155,29 @@ def test_group_by_spills_to_partitioned():
     full, _ = _q(sql)
     small, _ = _q(sql, pool_bytes=100_000)
     assert small == full
+
+
+def test_query_max_memory_kills_query():
+    """Per-query memory kill policy (reference: query.max-memory ->
+    ExceededMemoryLimitException): exceeding the per-query limit fails the
+    query hard, while the node pool merely triggers the Grace fallback."""
+    import pytest
+
+    from trino_tpu import Engine
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.memory import QueryMemoryLimitError
+
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.01))
+    s = e.create_session("tpch")
+    e.execute_sql("set session query_max_memory = 1024", s)  # 1KB: join must die
+    with pytest.raises(QueryMemoryLimitError, match="query_max_memory"):
+        e.execute_sql(
+            "select count(*) c from lineitem, orders "
+            "where l_orderkey = o_orderkey", s)
+    # reset: the same query runs fine
+    e.execute_sql("reset session query_max_memory", s)
+    r = e.execute_sql(
+        "select count(*) c from lineitem, orders "
+        "where l_orderkey = o_orderkey", s).to_pandas()
+    assert int(r.iloc[0, 0]) > 0

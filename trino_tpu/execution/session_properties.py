@@ -79,6 +79,11 @@ SYSTEM_SESSION_PROPERTIES = {p.name: p for p in [
                      "Allow partitioned re-execution when state exceeds device "
                      "memory (reference: spiller/*)", "boolean", True),
     PropertyMetadata("query_priority", "Scheduling priority", "integer", 1, _positive),
+    PropertyMetadata("query_max_memory",
+                     "Per-query device memory limit in bytes (0 = node limit "
+                     "only; reference: query.max-memory + "
+                     "ExceededMemoryLimitException)", "integer", 0,
+                     lambda v: None if v >= 0 else "must be >= 0"),
 ]}
 
 

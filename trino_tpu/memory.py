@@ -15,11 +15,18 @@ import threading
 from typing import Optional
 
 __all__ = ["MemoryPool", "AggregatedMemoryContext", "LocalMemoryContext",
-           "MemoryPoolExhaustedError", "device_memory_budget"]
+           "MemoryPoolExhaustedError", "QueryMemoryLimitError",
+           "device_memory_budget"]
 
 
 class MemoryPoolExhaustedError(MemoryError):
     pass
+
+
+class QueryMemoryLimitError(MemoryError):
+    """The QUERY exceeded its query_max_memory limit — a hard kill, not a
+    spill trigger (reference: ExceededMemoryLimitException +
+    memory/MemoryPool per-query tracking feeding the kill policy)."""
 
 
 def device_memory_budget(fraction: float = 0.75) -> int:
@@ -48,12 +55,29 @@ class MemoryPool:
         self.reserved = 0
         self._lock = threading.Lock()
         self._by_tag: dict[str, int] = {}
+        # per-query accounting (one executor serves one query at a time):
+        # exceeding the query limit is a KILL, while exceeding node capacity
+        # merely returns False so operators fall back to their Grace strategy
+        self.query_limit: Optional[int] = None
+        self.query_reserved = 0
+
+    def begin_query(self, limit: Optional[int]) -> None:
+        with self._lock:
+            self.query_limit = limit
+            self.query_reserved = 0
 
     def try_reserve(self, nbytes: int, tag: str = "") -> bool:
         with self._lock:
+            if self.query_limit is not None \
+                    and self.query_reserved + nbytes > self.query_limit:
+                raise QueryMemoryLimitError(
+                    f"query exceeded query_max_memory: requested {nbytes} "
+                    f"bytes with {self.query_reserved} already reserved of "
+                    f"{self.query_limit}")
             if self.reserved + nbytes > self.max_bytes:
                 return False
             self.reserved += nbytes
+            self.query_reserved += nbytes
             if tag:
                 self._by_tag[tag] = self._by_tag.get(tag, 0) + nbytes
             return True
@@ -67,6 +91,7 @@ class MemoryPool:
     def free(self, nbytes: int, tag: str = "") -> None:
         with self._lock:
             self.reserved = max(self.reserved - nbytes, 0)
+            self.query_reserved = max(self.query_reserved - nbytes, 0)
             if tag and tag in self._by_tag:
                 self._by_tag[tag] = max(self._by_tag[tag] - nbytes, 0)
 
