@@ -456,6 +456,23 @@ class TpchConnector:
     def schema(self, table: str) -> Schema:
         return TPCH_SCHEMAS[table]
 
+    _SORT_ORDER = {
+        # the generators emit rows in primary-key order (row index -> key is
+        # monotone); declared so the engine's streaming (sorted-input)
+        # aggregation can skip the hash table for matching GROUP BYs
+        "lineitem": ("l_orderkey",),
+        "orders": ("o_orderkey",),
+        "customer": ("c_custkey",),
+        "part": ("p_partkey",),
+        "supplier": ("s_suppkey",),
+        "partsupp": ("ps_partkey", "ps_suppkey"),
+        "nation": ("n_nationkey",),
+        "region": ("r_regionkey",),
+    }
+
+    def sort_order(self, table: str) -> tuple:
+        return self._SORT_ORDER.get(table, ())
+
     def dictionaries(self, table: str) -> dict[str, Dictionary]:
         return DICTIONARIES[table]
 

@@ -96,6 +96,9 @@ class _Stream:
     transform: Callable  # (cols, nulls, valid, aux) -> (cols, nulls, valid)
     scan_info: Optional[_ScanInfo] = None
     aux: tuple = ()  # pytree of device state threaded through jit as an argument
+    ordered_by: tuple = ()  # SOURCE column names the scan's rows are sorted
+    # by (connector-declared); filters/projects preserve row order, so the
+    # flag survives them and gates the streaming (sorted-input) aggregation
     compacted: bool = False  # a compaction boundary already shrank this chain's
     # lanes to ~its estimated rows; a second boundary would pay materialization
     # for no further reduction
@@ -323,13 +326,12 @@ class LocalExecutor:
                     def jc_fn(cols, nulls, valid, bucket=bucket):
                         # cumsum-scatter pack: linear, no sort; dst slots are
                         # unique so last-wins scatter is exact
-                        pos = jnp.cumsum(valid) - 1
-                        dst = jnp.where(valid & (pos < bucket), pos,
-                                        bucket).astype(jnp.int32)
-                        total = jnp.sum(valid)
+                        dst, total = _compact_pack(valid)
+                        dst = jnp.minimum(dst, bucket)
 
                         def pack(a):
-                            return jnp.zeros((bucket + 1,), a.dtype)                                 .at[dst].set(a)[:bucket]
+                            return jnp.zeros((bucket + 1,),
+                                             a.dtype).at[dst].set(a)[:bucket]
 
                         cvalid = jnp.arange(bucket) < total
                         return (tuple(pack(c) for c in cols),
@@ -394,7 +396,11 @@ class LocalExecutor:
                 # at the split boundary)
                 pages = _prefetched_pages(pages)
             si = _ScanInfo(conn, splits, tuple(node.columns), tuple(node.columns))
-            return _Stream(node.schema, dicts, pages, lambda c, n, v, aux: (c, n, v), si)
+            ordered = tuple(conn.sort_order(node.table)) \
+                if hasattr(conn, "sort_order") else ()
+            return _Stream(node.schema, dicts, pages,
+                           lambda c, n, v, aux: (c, n, v), si,
+                           ordered_by=ordered)
 
         if isinstance(node, P.Filter):
             up = self._compile_stream(node.child)
@@ -407,7 +413,7 @@ class LocalExecutor:
             pruned = _static_pruned_stream(up, pred)
             pages, si = pruned if pruned is not None else (up.pages, up.scan_info)
             return _Stream(up.schema, up.dicts, pages, transform, si, aux=up.aux,
-                           compacted=up.compacted)
+                           ordered_by=up.ordered_by, compacted=up.compacted)
 
         if isinstance(node, P.Project):
             up = self._compile_stream(node.child)
@@ -436,7 +442,7 @@ class LocalExecutor:
                     up.scan_info.columns[e.index] if isinstance(e, FieldRef) else None
                     for e in node.exprs))
             return _Stream(node.schema, dicts, up.pages, transform, si, aux=up.aux,
-                           compacted=up.compacted)
+                           ordered_by=up.ordered_by, compacted=up.compacted)
 
         if isinstance(node, P.Join):
             return self._compile_join(node)
@@ -635,6 +641,19 @@ class LocalExecutor:
                     capacity = max(capacity, min(target, 1 << 20))
         pages_once = itertools.chain([first], page_iter) if first is not None else ()
 
+        # streaming (sorted-input) aggregation: the scan's declared sort order
+        # makes every group's rows CONTIGUOUS, so segmented reduces replace
+        # the hash probe loop entirely (reference: the streaming aggregation
+        # operator over pre-grouped input); the dense direct-index path still
+        # wins when it applies, so this gates on cfg is None
+        if cfg is None and self._streaming_agg_order(stream, node) is not None:
+            key_w0 = sum(np.dtype(t.dtype).itemsize + 1 for t in key_types)
+            acc_w0 = sum(np.dtype(dt).itemsize for dt, _ in acc_specs)
+            return self._run_streaming_aggregate(
+                node, stream, key_types, acc_specs, acc_exprs, acc_kinds,
+                capacity, pages_once,
+                lambda cap, kw=key_w0, aw=acc_w0: (cap + 1) * (8 + kw + aw))
+
         # memory gate: group-by state is device-resident; if it cannot fit the
         # pool, go to partitioned passes (the HBM spill analog).  Reservation is
         # re-checked on every capacity growth.
@@ -788,6 +807,129 @@ class LocalExecutor:
                     return state
         state, _ = drain(state)
         return state
+
+    def _streaming_agg_order(self, stream, node):
+        """Group-key source names when the stream's declared sort order makes
+        every group's rows contiguous (the keys are a permutation of a
+        sort-order prefix), else None.  Filters/projects/compaction preserve
+        row order, so ordered_by survives them; joins clear it."""
+        if not stream.ordered_by or stream.scan_info is None:
+            return None
+        si = stream.scan_info
+        names = []
+        for ch in node.keys:
+            nm = si.columns[ch] if ch < len(si.columns) else None
+            if nm is None:
+                return None
+            names.append(nm)
+        nk = len(names)
+        if len(set(names)) != nk or set(names) != set(stream.ordered_by[:nk]):
+            return None
+        return tuple(names)
+
+    def _run_streaming_aggregate(self, node, stream, key_types, acc_specs,
+                                 acc_exprs, acc_kinds, capacity, pages_once,
+                                 state_bytes):
+        """Sorted-input aggregation (reference: streaming aggregation over
+        pre-grouped input, operator/aggregation/).  Per page: valid rows
+        compact to the front (order-preserving), key-change boundaries mark
+        segments, and every accumulator reduces with ONE masked segmented
+        scatter — no probe loop, no per-row hashing.  The per-segment partial
+        rows (a handful per page) then merge through the ordinary hash insert
+        with MERGE kinds, which also stitches groups spanning page
+        boundaries."""
+        from .fte import _MERGE_KIND
+
+        merge_kinds = [_MERGE_KIND[k] for k in acc_kinds]
+        key_dtypes = tuple(t.dtype for t in key_types)
+
+        cacheable = self._agg_cacheable(node)
+        hit = self._agg_cache.get(("streamagg", id(node))) if cacheable else None
+        if hit is None:
+            @jax.jit
+            def pstep(page, aux, stream=stream, node=node):
+                cols, nulls, valid = stream.transform(
+                    page.columns, page.null_masks, page.valid_mask(), aux)
+                n = valid.shape[0]
+                # order-preserving compaction (cumsum-scatter)
+                dst, count = _compact_pack(valid)
+                live = jnp.arange(n) < count
+
+                def pack(a):
+                    return jnp.zeros((n + 1,), a.dtype).at[dst].set(a)[:n]
+
+                kcols, knulls = [], []
+                for ch in node.keys:
+                    kcols.append(pack(cols[ch]))
+                    nm = nulls[ch]
+                    knulls.append(pack(nm) if nm is not None
+                                  else jnp.zeros((n,), bool))
+                # segment starts: first live row, or any key (value OR null
+                # flag) differing from the previous live row
+                new = jnp.zeros((n,), bool).at[0].set(True)
+                for k, kn in zip(kcols, knulls):
+                    kv = jnp.where(kn, jnp.zeros((), k.dtype), k)
+                    d = jnp.concatenate([jnp.ones((1,), bool),
+                                         (kv[1:] != kv[:-1])
+                                         | (kn[1:] != kn[:-1])])
+                    new = new | d
+                new = new & live
+                seg = (jnp.cumsum(new) - 1).astype(jnp.int32)
+                seg = jnp.clip(seg, 0, n - 1)
+                accs = []
+                for e, (dt, init), kind in zip(acc_exprs, acc_specs, acc_kinds):
+                    if e is None:
+                        vn = None
+                    else:
+                        v, nu = evaluate(e, cols, nulls)
+                        v = jnp.broadcast_to(v, valid.shape) if v.ndim == 0 else v
+                        if nu is not None and nu.ndim == 0:
+                            nu = jnp.broadcast_to(nu, valid.shape)
+                        vn = (pack(v), None if nu is None else pack(nu))
+                    acc0 = jnp.full((n + 1,), init, dtype=dt)
+                    # segment ids play the slot role: agg_update IS the
+                    # segmented reduce (pads mask to the sink row)
+                    total = hashagg.agg_update(acc0, kind, seg, live, vn)
+                    accs.append(total[seg])  # per-row gather of its segment total
+                return tuple(kcols), tuple(knulls), tuple(accs), new
+
+            @jax.jit
+            def mstep(state, kcols, knulls, accs, new,
+                      key_types=key_types, merge_kinds=tuple(merge_kinds)):
+                return hashagg.groupby_insert(
+                    state, kcols, key_types, new,
+                    [(a, None) for a in accs], list(merge_kinds), knulls)
+
+            if cacheable:
+                self._agg_cache[("streamagg", id(node))] = (node, pstep, mstep)
+        else:
+            _, pstep, mstep = hit
+
+        capacity = ceil_pow2(capacity)
+        if not self.memory_pool.try_reserve(state_bytes(capacity), "group-by"):
+            return self._run_aggregate_partitioned(node, parts=4)
+        resv = state_bytes(capacity)
+        try:
+            pages = pages_once
+            while True:
+                state = hashagg.groupby_init(capacity, key_dtypes, acc_specs)
+                for page in pages:
+                    kcols, knulls, accs, new = pstep(page, stream.aux)
+                    state = mstep(state, kcols, knulls, accs, new)
+                if not bool(state.overflow):
+                    return self._finalize_groups(node, stream, state)
+                # merge-state overflow: grow and re-stream (rare — capacity is
+                # stats-sized upstream like the hash path)
+                grown = ceil_pow2(capacity * 4)
+                delta = state_bytes(grown) - resv
+                if grown > MAX_GROUP_CAPACITY or \
+                        not self.memory_pool.try_reserve(delta, "group-by"):
+                    return self._run_aggregate_partitioned(node, parts=4)
+                resv += delta
+                capacity = grown
+                pages = stream.pages()
+        finally:
+            self.memory_pool.free(resv, "group-by")
 
     def _finalize_groups(self, node: P.Aggregate, stream, state):
         # compact occupied groups ON DEVICE before any host transfer: the table is
@@ -2000,6 +2142,17 @@ def _page_bytes(page: Page) -> int:
         total += page.capacity * np.dtype(c.dtype).itemsize
     total += sum(page.capacity for n in page.null_masks if n is not None)
     return total
+
+
+def _compact_pack(valid):
+    """Order-preserving compaction targets: (dst, count) — row i scatters to
+    dst[i] (invalid rows to the sink at n), live rows occupy [0, count).  The
+    one cumsum-scatter pack the boundary compaction and the streaming
+    aggregation share."""
+    n = valid.shape[0]
+    pos = jnp.cumsum(valid) - 1
+    dst = jnp.where(valid, pos, n).astype(jnp.int32)
+    return dst, jnp.sum(valid)
 
 
 def _prefetched_pages(pages_fn, depth: int = 2):
