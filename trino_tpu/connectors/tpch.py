@@ -456,10 +456,12 @@ class TpchConnector:
     def schema(self, table: str) -> Schema:
         return TPCH_SCHEMAS[table]
 
-    _SORT_ORDER = {
-        # the generators emit rows in primary-key order (row index -> key is
-        # monotone); declared so the engine's streaming (sorted-input)
-        # aggregation can skip the hash table for matching GROUP BYs
+    _CLUSTERED_BY = {
+        # the generators emit each key prefix's rows CONTIGUOUSLY (row index
+        # -> key is monotone on the first column; within a part, partsupp's
+        # four supplier rows are adjacent but NOT sorted — this is a
+        # clustering contract, not a total order); the engine's streaming
+        # aggregation needs exactly group contiguity
         "lineitem": ("l_orderkey",),
         "orders": ("o_orderkey",),
         "customer": ("c_custkey",),
@@ -470,8 +472,10 @@ class TpchConnector:
         "region": ("r_regionkey",),
     }
 
-    def sort_order(self, table: str) -> tuple:
-        return self._SORT_ORDER.get(table, ())
+    def clustered_by(self, table: str) -> tuple:
+        """Columns whose equal-value rows are CONTIGUOUS in scan order
+        (weaker than sorted: no cross-group ordering promise)."""
+        return self._CLUSTERED_BY.get(table, ())
 
     def dictionaries(self, table: str) -> dict[str, Dictionary]:
         return DICTIONARIES[table]

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import hashagg
-from ..page import Page
+from ..page import Page, Schema
 from ..sql import plan as P
 from .local_executor import (LocalExecutor, _accumulators_for, _finalize_aggs,
                              _host, _materialize)
@@ -283,7 +283,9 @@ class FaultTolerantExecutor:
             data = self._serialize_result(page)
             dicts = self._commit_with_retries(tid, lambda: (data, agg_dicts))
         else:
-            def compute(node=node, tid=tid):
+            exec_node = self._maybe_swap_join(node)
+
+            def compute(node=exec_node, tid=tid):
                 self.injector.maybe_fail(tid, "TASK_FAILURE")
                 page, dd = self.local._execute_to_page(node)
                 data = self._serialize_result(page)
@@ -297,6 +299,48 @@ class FaultTolerantExecutor:
                     tuple(None if n is None else jnp.asarray(n) for n in nulls),
                     None)
         self.local._overrides[id(node)] = (page, dicts)
+
+    def _maybe_swap_join(self, node):
+        """Adaptive replanning (reference: AdaptivePlanner.java:121 — FTE
+        re-optimizes remaining stages once upstream stages finish): when BOTH
+        join children are materialized fragments, their ACTUAL row counts
+        replace the optimizer's estimates.  A build side that materialized
+        clearly LARGER than the probe swaps sides (join commutation) with a
+        projection restoring the original column order; the swapped plan runs
+        under the original fragment id, so parents are unaffected."""
+        from ..sql import ir
+
+        if not isinstance(node, P.Join) or node.kind != "inner" \
+                or node.filter is not None or not node.left_keys:
+            return node
+
+        def actual_rows(child):
+            # look through row-preserving wrappers (column-pruning projects)
+            # to the materialized fragment beneath
+            while isinstance(child, P.Project):
+                child = child.child
+            hit = self.local._overrides.get(id(child))
+            if hit is None:
+                return None
+            page = hit[0]
+            if page.valid is None:
+                return page.capacity
+            return int(jnp.sum(page.valid))
+
+        lr, rr = actual_rows(node.left), actual_rows(node.right)
+        if lr is None or rr is None or rr <= 2 * max(lr, 1):
+            return node  # no inversion (or unknown): keep the planned sides
+        self.adaptive_swaps = getattr(self, "adaptive_swaps", 0) + 1
+        lf = tuple(node.left.schema.fields)
+        rf = tuple(node.right.schema.fields)
+        swapped = P.Join("inner", node.right, node.left, node.right_keys,
+                         node.left_keys, Schema(rf + lf),
+                         distribution=node.distribution,
+                         est_rows=node.est_rows)
+        exprs = tuple(ir.FieldRef(len(rf) + i, f.type, f.name)
+                      for i, f in enumerate(lf)) \
+            + tuple(ir.FieldRef(i, f.type, f.name) for i, f in enumerate(rf))
+        return P.Project(swapped, exprs, node.schema)
 
     def _scan_fed(self, node) -> bool:
         """True when the subtree is a pure stream over one scan and contains NO

@@ -96,9 +96,11 @@ class _Stream:
     transform: Callable  # (cols, nulls, valid, aux) -> (cols, nulls, valid)
     scan_info: Optional[_ScanInfo] = None
     aux: tuple = ()  # pytree of device state threaded through jit as an argument
-    ordered_by: tuple = ()  # SOURCE column names the scan's rows are sorted
-    # by (connector-declared); filters/projects preserve row order, so the
-    # flag survives them and gates the streaming (sorted-input) aggregation
+    clustered_by: tuple = ()  # SOURCE column names whose equal-value rows
+    # are CONTIGUOUS in scan order (connector-declared; weaker than sorted —
+    # no cross-group order promise).  Filters/projects/compaction preserve
+    # row order, so the flag survives them; joins clear it.  Gates the
+    # streaming aggregation, which needs exactly group contiguity.
     compacted: bool = False  # a compaction boundary already shrank this chain's
     # lanes to ~its estimated rows; a second boundary would pay materialization
     # for no further reduction
@@ -346,7 +348,8 @@ class LocalExecutor:
         if si is not None:
             si = dataclasses.replace(si, replayable=False)
         return _Stream(up.schema, up.dicts, pages,
-                       lambda c, n, v, aux: (c, n, v), si, compacted=True)
+                       lambda c, n, v, aux: (c, n, v), si,
+                       clustered_by=up.clustered_by, compacted=True)
 
     # -- streaming segment compilation ---------------------------------------
     def _subtree_overridden(self, node) -> bool:
@@ -396,11 +399,11 @@ class LocalExecutor:
                 # at the split boundary)
                 pages = _prefetched_pages(pages)
             si = _ScanInfo(conn, splits, tuple(node.columns), tuple(node.columns))
-            ordered = tuple(conn.sort_order(node.table)) \
-                if hasattr(conn, "sort_order") else ()
+            clustered = tuple(conn.clustered_by(node.table)) \
+                if hasattr(conn, "clustered_by") else ()
             return _Stream(node.schema, dicts, pages,
                            lambda c, n, v, aux: (c, n, v), si,
-                           ordered_by=ordered)
+                           clustered_by=clustered)
 
         if isinstance(node, P.Filter):
             up = self._compile_stream(node.child)
@@ -413,7 +416,7 @@ class LocalExecutor:
             pruned = _static_pruned_stream(up, pred)
             pages, si = pruned if pruned is not None else (up.pages, up.scan_info)
             return _Stream(up.schema, up.dicts, pages, transform, si, aux=up.aux,
-                           ordered_by=up.ordered_by, compacted=up.compacted)
+                           clustered_by=up.clustered_by, compacted=up.compacted)
 
         if isinstance(node, P.Project):
             up = self._compile_stream(node.child)
@@ -442,7 +445,7 @@ class LocalExecutor:
                     up.scan_info.columns[e.index] if isinstance(e, FieldRef) else None
                     for e in node.exprs))
             return _Stream(node.schema, dicts, up.pages, transform, si, aux=up.aux,
-                           ordered_by=up.ordered_by, compacted=up.compacted)
+                           clustered_by=up.clustered_by, compacted=up.compacted)
 
         if isinstance(node, P.Join):
             return self._compile_join(node)
@@ -809,11 +812,11 @@ class LocalExecutor:
         return state
 
     def _streaming_agg_order(self, stream, node):
-        """Group-key source names when the stream's declared sort order makes
+        """Group-key source names when the stream's declared CLUSTERING makes
         every group's rows contiguous (the keys are a permutation of a
-        sort-order prefix), else None.  Filters/projects/compaction preserve
-        row order, so ordered_by survives them; joins clear it."""
-        if not stream.ordered_by or stream.scan_info is None:
+        clustering prefix), else None.  Filters/projects/compaction preserve
+        row order, so clustered_by survives them; joins clear it."""
+        if not stream.clustered_by or stream.scan_info is None:
             return None
         si = stream.scan_info
         names = []
@@ -823,7 +826,7 @@ class LocalExecutor:
                 return None
             names.append(nm)
         nk = len(names)
-        if len(set(names)) != nk or set(names) != set(stream.ordered_by[:nk]):
+        if len(set(names)) != nk or set(names) != set(stream.clustered_by[:nk]):
             return None
         return tuple(names)
 
