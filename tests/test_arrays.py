@@ -148,3 +148,31 @@ def test_array_type_ddl_roundtrip(mem_engine):
     e.execute_sql("create table tt (a array(bigint), m bigint)", s)
     cols = e.execute_sql("show columns from tt", s).rows()
     assert cols[0] == ("a", "array(bigint)")
+
+
+def test_array_reductions_and_position():
+    """array_min/max/sum/average + array_position (reference:
+    operator/scalar/ArrayMinFunction family, ArrayPositionFunction)."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table t (id bigint, a array(bigint))", s)
+    e.execute_sql("insert into t values (1, array[3,1,2]), (2, array[10]), "
+                  "(3, array[]), (4, null)", s)
+    r = e.execute_sql(
+        "select id, array_min(a) mn, array_max(a) mx, array_sum(a) sm, "
+        "array_average(a) av, array_position(a, 2) p from t order by id",
+        s).to_pandas()
+    assert r["mn"].tolist()[:2] == [1, 10]
+    assert r["mx"].tolist()[:2] == [3, 10]
+    assert r["sm"].tolist()[:2] == [6, 10]
+    assert r["av"].tolist()[:2] == [2.0, 10.0]
+    # 1-based position; 0 = absent; empty arrays -> NULL reductions
+    assert r["p"].tolist()[:3] == [3, 0, 0]
+    assert r["mn"].isna().tolist() == [False, False, True, True]
+    # filters over reductions
+    r = e.execute_sql("select id from t where array_sum(a) > 7", s).to_pandas()
+    assert r["id"].tolist() == [2]

@@ -1845,6 +1845,36 @@ class Planner:
                         (base, needle, ir.Constant(np.asarray(bd.values), UNKNOWN)),
                         BOOLEAN, meta=(max(bd.max_len, 1),))
             return e, None
+        if name in ("array_min", "array_max", "array_sum", "array_average"):
+            base, bd = self._translate(args[0], cols)
+            if not isinstance(base.type, ArrayType) or bd is None:
+                raise SemanticError(f"{name} expects an array")
+            kind = name[len("array_"):].replace("average", "avg")
+            et = base.type.element
+            out_t = DOUBLE if kind == "avg" else \
+                (BIGINT if et.is_integer else et)
+            if et.is_string and kind in ("min", "max"):
+                raise SemanticError(f"{name} over string arrays not supported")
+            e = ir.Call("array_reduce",
+                        (base, ir.Constant(np.asarray(bd.values), UNKNOWN)),
+                        out_t, meta=(max(bd.max_len, 1), kind))
+            return e, None
+        if name == "array_position":
+            base, bd = self._translate(args[0], cols)
+            if not isinstance(base.type, ArrayType) or bd is None:
+                raise SemanticError("array_position expects an array")
+            if isinstance(args[1], A.StringLit):
+                if bd.elem_dict is None:
+                    raise SemanticError("string needle over a non-string array")
+                needle = ir.Constant(bd.elem_dict.lookup(args[1].value),
+                                     VarcharType.of(None))
+            else:
+                needle, _ = self._translate(args[1], cols)
+            e = ir.Call("array_position",
+                        (base, needle,
+                         ir.Constant(np.asarray(bd.values), UNKNOWN)),
+                        BIGINT, meta=(max(bd.max_len, 1),))
+            return e, None
         if name == "sequence":
             vals = []
             for a in args:
@@ -2153,7 +2183,9 @@ class Planner:
 
 
     _COLLECTION_FUNCS = ("cardinality", "element_at", "contains", "sequence",
-                         "map", "map_keys", "map_values", "row")
+                         "map", "map_keys", "map_values", "row",
+                         "array_min", "array_max", "array_sum",
+                         "array_average", "array_position")
 
     def _translate_func(self, ast: A.FuncCall, cols):
         """Registry dispatch (reference: the analyzer resolving calls against
