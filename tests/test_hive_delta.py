@@ -193,3 +193,52 @@ def test_memory_filesystem_roundtrip():
     assert fs.list_dir("/wh/t") == ["_delta_log"]
     assert fs.read_text("/wh/t/_delta_log/x.json") == "{}"
     assert not fs.exists("/wh/t/missing")
+
+
+def test_delta_checkpoint_replay(tmp_path):
+    """A checkpointed (vacuumed) delta log: replay starts at the checkpoint
+    parquet, JSON commits after it apply, commits at/before it are absent
+    (reference: TransactionLogAccess + _last_checkpoint)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    wh = str(tmp_path / "cwh")
+    tdir = os.path.join(wh, "ck")
+    _write_parquet(os.path.join(tdir, "a.parquet"),
+                   {"id": [1, 2], "v": [1.0, 2.0]})
+    _write_parquet(os.path.join(tdir, "b.parquet"), {"id": [3], "v": [3.0]})
+    log = os.path.join(tdir, "_delta_log")
+    os.makedirs(log)
+    schema_string = json.dumps({
+        "type": "struct",
+        "fields": [
+            {"name": "id", "type": "long", "nullable": True, "metadata": {}},
+            {"name": "v", "type": "double", "nullable": True, "metadata": {}},
+        ]})
+    # checkpoint at version 1 holds metaData + the live 'a' file; NO JSON
+    # commits exist at or before version 1 (vacuumed away)
+    ck_rows = [
+        {"metaData": {"id": "m", "schemaString": schema_string,
+                      "partitionColumns": []},
+         "add": None},
+        {"metaData": None,
+         "add": {"path": "a.parquet", "partitionValues": [],
+                 "stats": json.dumps({"minValues": {"id": 1},
+                                      "maxValues": {"id": 2}})}},
+    ]
+    pq.write_table(pa.Table.from_pylist(ck_rows),
+                   os.path.join(log, f"{1:020d}.checkpoint.parquet"))
+    with open(os.path.join(log, "_last_checkpoint"), "w") as f:
+        f.write(json.dumps({"version": 1}))
+    # commit 2 (after the checkpoint) adds file 'b'
+    with open(os.path.join(log, f"{2:020d}.json"), "w") as f:
+        f.write(json.dumps({"add": {"path": "b.parquet", "dataChange": True,
+                                    "partitionValues": {}}}))
+
+    from trino_tpu import Engine
+
+    e = Engine()
+    e.register_catalog("delta", DeltaConnector(wh))
+    s = e.create_session("delta")
+    r = e.execute_sql("select id, v from ck order by id", s).to_pandas()
+    assert r["id"].tolist() == [1, 2, 3]

@@ -69,6 +69,21 @@ class DeltaConnector(MultiFileConnector):
                          if f.endswith(".json") and f[:-5].isdigit())
         meta = None
         live: dict = {}  # path -> add action (log replay)
+
+        # checkpoint: the compacted log state at some version — replay starts
+        # there and only JSON commits AFTER it apply (reference:
+        # TransactionLogAccess reading _last_checkpoint + checkpoint parquet;
+        # vacuumed tables have no JSON commits before the checkpoint)
+        ckpt_version = -1
+        lc = os.path.join(log_dir, "_last_checkpoint")
+        if self.fs.exists(lc):
+            try:
+                ckpt_version = int(json.loads(self.fs.read_text(lc))["version"])
+            except (ValueError, KeyError):
+                ckpt_version = -1
+        if ckpt_version >= 0:
+            meta, live = self._read_checkpoint(log_dir, ckpt_version)
+            commits = [c for c in commits if int(c[:-5]) > ckpt_version]
         for c in commits:
             text = self.fs.read_text(os.path.join(log_dir, c))
             for line in text.splitlines():
@@ -137,6 +152,31 @@ class DeltaConnector(MultiFileConnector):
             raise ValueError(f"table {table} has no live data files")
         data_schema = self._pq._open(files[0].pseudo).schema
         return _FTable(data_schema, part_fields, files, part_dicts, 0)
+
+    def _read_checkpoint(self, log_dir: str, version: int):
+        """Checkpoint parquet -> (metaData dict, live add actions): each row
+        holds at most one action as a nested struct (add / remove / metaData
+        columns); remove rows are tombstones already applied at write time."""
+        import pyarrow.parquet as pq
+
+        path = os.path.join(log_dir, f"{version:020d}.checkpoint.parquet")
+        tbl = pq.read_table(path)
+        rows = tbl.to_pylist()
+        meta = None
+        live: dict = {}
+        for r in rows:
+            md = r.get("metaData")
+            if md and md.get("schemaString"):
+                meta = md
+            a = r.get("add")
+            if a and a.get("path"):
+                # partitionValues may arrive as a list of {key,value} structs
+                pv = a.get("partitionValues")
+                if isinstance(pv, list):
+                    a = dict(a)
+                    a["partitionValues"] = {e["key"]: e["value"] for e in pv}
+                live[a["path"]] = a
+        return meta, live
 
     @staticmethod
     def _stats_bounds(add: dict, data_fields) -> tuple:
