@@ -93,3 +93,33 @@ def test_var_samp_single_row_is_undefined(eng):
     r = e.execute_sql("""select var_samp(l_quantity) from lineitem
                          where l_orderkey = 1 and l_linenumber = 1""", s).rows()[0]
     assert np.isnan(r[0])  # <2 samples (SQL NULL; surfaced as NaN)
+
+
+def test_count_if_and_geometric_mean():
+    """Sugar aggregates rewrite to supported compositions (reference:
+    CountIfAggregation, GeometricMeanAggregations)."""
+    import math
+
+    from trino_tpu import Engine
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table t (g bigint, x double, b boolean)", s)
+    e.execute_sql("insert into t values (1, 2.0, true), (1, 8.0, false), "
+                  "(2, 3.0, true), (2, 9.0, true), (2, 1.0, null)", s)
+    r = e.execute_sql(
+        "select g, count_if(b) ci, geometric_mean(x) gm from t "
+        "group by g order by g", s).to_pandas()
+    assert r["ci"].tolist() == [1, 2]  # NULL conditions count as false
+    assert abs(r["gm"].iloc[0] - 4.0) < 1e-9
+    assert abs(r["gm"].iloc[1] - 27.0 ** (1 / 3)) < 1e-9
+    r = e.execute_sql(
+        "select g from t group by g having count_if(b) >= 2", s).to_pandas()
+    assert r["g"].tolist() == [2]
+    # scalar math over aggregate results in the post-agg scope
+    r = e.execute_sql(
+        "select g, sqrt(var_pop(x)) sd from t group by g order by g",
+        s).to_pandas()
+    assert abs(r["sd"].iloc[0] - 3.0) < 1e-9

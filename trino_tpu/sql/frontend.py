@@ -150,6 +150,7 @@ class Planner:
     def _plan_select(self, q):
         if isinstance(q, A.SetOp):
             return self._plan_setop(q)
+        q = _rewrite_agg_sugar_query(q)
         # windows over aggregation output rewrite BEFORE any planning (the
         # FROM tree would otherwise plan twice); stars never combine with
         # GROUP BY so the AST-only detection is complete
@@ -2337,7 +2338,75 @@ class _PostAggScope:
             return _coerce(self.translate(ast.value), _type_from_name(ast.type_name, ast.params))
         if isinstance(ast, A.ScalarSubquery):
             return self.planner._eager_scalar(ast.query)
+        if isinstance(ast, A.FuncCall) and len(ast.args) == 1 \
+                and ast.name in ("exp", "ln", "sqrt", "abs", "floor", "ceil",
+                                 "round", "sign", "log10", "log2"):
+            # scalar math over aggregate results (sqrt(variance),
+            # exp(avg(ln)) from the geometric_mean rewrite, ...)
+            e = self.translate(ast.args[0])
+            if ast.name in ("abs", "round", "sign"):
+                return ir.Call(ast.name, (e,), e.type)
+            return ir.Call(ast.name, (_coerce(e, DOUBLE),), DOUBLE)
+        if isinstance(ast, A.FuncCall) and ast.name in ("power", "pow") \
+                and len(ast.args) == 2:
+            a = _coerce(self.translate(ast.args[0]), DOUBLE)
+            b = _coerce(self.translate(ast.args[1]), DOUBLE)
+            return ir.Call("power", (a, b), DOUBLE)
         raise SemanticError(f"expression must appear in GROUP BY: {ast}")
+
+
+_AGG_SUGAR = {"count_if", "geometric_mean"}
+
+
+def _rewrite_agg_sugar(node):
+    """Aggregate sugar rewrites to supported compositions (reference:
+    operator/aggregation/CountIfAggregation, GeometricMeanAggregations —
+    both reduce to existing aggregates):
+      count_if(x)       -> sum(CASE WHEN x THEN 1 ELSE 0 END)
+      geometric_mean(x) -> exp(avg(ln(x)))
+    Deterministic over frozen ASTs, so repeated rewrites of equal expressions
+    stay structurally equal (the post-aggregation scope matches by equality)."""
+    if isinstance(node, A.FuncCall) and node.name in _AGG_SUGAR:
+        args = tuple(_rewrite_agg_sugar(a) for a in node.args)
+        if node.name == "count_if" and len(args) == 1:
+            return A.FuncCall("sum", (A.CaseExpr(
+                None, ((args[0], A.NumberLit("1")),), A.NumberLit("0")),))
+        if node.name == "geometric_mean" and len(args) == 1:
+            return A.FuncCall("exp", (A.FuncCall(
+                "avg", (A.FuncCall("ln", (args[0],)),)),))
+        return dataclasses.replace(node, args=args)
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        changes = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            nv = _rewrite_sugar_any(v)
+            if nv is not v:
+                changes[f.name] = nv
+        return dataclasses.replace(node, **changes) if changes else node
+    return node
+
+
+def _rewrite_sugar_any(v):
+    if isinstance(v, tuple):
+        out = tuple(_rewrite_sugar_any(x) for x in v)
+        return v if out == v else out
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return _rewrite_agg_sugar(v)
+    return v
+
+
+def _rewrite_agg_sugar_query(q):
+    """Rewrite sugar in the query's own expressions (items/having/order_by);
+    subqueries rewrite when their own planning reaches _plan_select."""
+    items = tuple(dataclasses.replace(it, expr=_rewrite_agg_sugar(it.expr))
+                  for it in q.items)
+    having = None if q.having is None else _rewrite_agg_sugar(q.having)
+    order_by = tuple(dataclasses.replace(s, expr=_rewrite_agg_sugar(s.expr))
+                     for s in q.order_by)
+    if items == q.items and having == q.having and order_by == q.order_by:
+        return q
+    return dataclasses.replace(q, items=items, having=having,
+                               order_by=order_by)
 
 
 def _collect_aggs(ast, out: list):
