@@ -92,7 +92,8 @@ def test_var_samp_single_row_is_undefined(eng):
     e, s = eng
     r = e.execute_sql("""select var_samp(l_quantity) from lineitem
                          where l_orderkey = 1 and l_linenumber = 1""", s).rows()[0]
-    assert np.isnan(r[0])  # <2 samples (SQL NULL; surfaced as NaN)
+    # <2 samples -> SQL NULL (aggregate outputs carry real null masks now)
+    assert r[0] is None
 
 
 def test_count_if_and_geometric_mean():
@@ -123,3 +124,30 @@ def test_count_if_and_geometric_mean():
         "select g, sqrt(var_pop(x)) sd from t group by g order by g",
         s).to_pandas()
     assert abs(r["sd"].iloc[0] - 3.0) < 1e-9
+
+
+def test_all_null_and_empty_groups_are_null(eng):
+    """SQL aggregates over all-NULL or empty inputs are NULL, not 0/sentinel
+    (reference: the null flags of the aggregation states)."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    e2 = Engine()
+    e2.register_catalog("mem", MemoryConnector())
+    s2 = e2.create_session("mem")
+    e2.execute_sql("create table t (g bigint, x bigint, d decimal(10,2))", s2)
+    e2.execute_sql("insert into t values (1, null, null), (2, 5, 1.50)", s2)
+    r = e2.execute_sql(
+        "select g, sum(x) s, min(x) mn, max(x) mx, avg(x) a, sum(d) sd "
+        "from t group by g order by g", s2).to_pandas()
+    assert r.iloc[0, 1:].isna().all()  # all-NULL group
+    assert r.iloc[1, 1:].tolist() == [5, 5, 5, 5.0, 1.5]
+    # empty global aggregation
+    r = e2.execute_sql("select sum(x) s, min(x) mn, count(x) c from t "
+                       "where g = 99", s2).to_pandas()
+    assert r["s"].isna().all() and r["mn"].isna().all()
+    assert r["c"].tolist() == [0]  # count stays 0, never NULL
+    # count_if of zero rows is 0 (a count), not NULL
+    r = e2.execute_sql("select count_if(x > 0) ci from t where g = 99",
+                       s2).to_pandas()
+    assert r["ci"].tolist() == [0]

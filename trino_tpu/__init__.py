@@ -27,13 +27,23 @@ if not _os.environ.get("TRINO_TPU_NO_COMPILE_CACHE"):
         import hashlib
         import platform
 
-        probe = platform.machine() + platform.processor()
+        probe = platform.machine() + platform.processor() + platform.node()
         try:
             with open("/proc/cpuinfo") as f:
                 for line in f:
                     if line.startswith("flags"):
                         probe += line
                         break
+        except OSError:
+            pass
+        try:
+            # boot identity: cpuinfo flags do NOT capture the compile-time
+            # machine features XLA bakes into cached executables — loading an
+            # entry compiled on a different host SEGFAULTS (observed).  Keying
+            # by boot keeps the in-session cross-process reuse (workers,
+            # subprocess tests, bench) and forfeits risky cross-host reuse.
+            with open("/proc/sys/kernel/random/boot_id") as f:
+                probe += f.read()
         except OSError:
             pass
         return hashlib.sha1(probe.encode()).hexdigest()[:12]

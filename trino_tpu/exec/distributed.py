@@ -1221,10 +1221,13 @@ class DistributedExecutor:
                     for k in merged.key_cols]
         acc_cols = [np.concatenate([np.asarray(a)[w, :capacity][occ[w]] for w in range(W)])
                     for a in merged.accs]
-        out_cols = key_cols + _finalize_aggs(node.aggs, acc_cols, occ.sum())
+        fin_cols, fin_nulls = _finalize_aggs(node.aggs, acc_cols, occ.sum())
+        out_cols = key_cols + fin_cols
         # host output (exact wide-decimal columns must never reach the device)
         arrays = [np.asarray(c) for c in out_cols]
-        page = Page(node.schema, tuple(arrays), tuple(None for _ in arrays), None)
+        # grouped keys from generator scans carry no nulls on this path
+        page = Page(node.schema, tuple(arrays),
+                    tuple(None for _ in key_cols) + tuple(fin_nulls), None)
         dicts = tuple(stream.dicts[i] for i in node.keys) + tuple(None for _ in node.aggs)
         return (page, dicts), False
 
@@ -1317,10 +1320,16 @@ class DistributedExecutor:
                 elif kind in ("sum_hi32", "sum_lo32"):
                     h = (v >> 32) if kind == "sum_hi32" else (v & 0xFFFFFFFF)
                     out.append(s + jnp.sum(jnp.where(mask, h, 0), dtype=s.dtype))
+                elif kind == "sum_sq":
+                    vv = v.astype(s.dtype)
+                    out.append(s + jnp.sum(jnp.where(mask, vv * vv, 0),
+                                           dtype=s.dtype))
                 elif kind == "min":
                     out.append(jnp.minimum(s, jnp.min(jnp.where(mask, v, hashagg._extreme(s.dtype, 1)))))
                 elif kind == "max":
                     out.append(jnp.maximum(s, jnp.max(jnp.where(mask, v, hashagg._extreme(s.dtype, -1)))))
+                else:
+                    raise NotImplementedError(f"global agg kind {kind}")
             return tuple(o[None] for o in out) + ((s_of | of)[None],)
 
         step = jax.jit(step)
@@ -1340,10 +1349,10 @@ class DistributedExecutor:
                 finals.append(np.asarray([v.min()]))
             else:
                 finals.append(np.asarray([v.max()]))
-        out_cols = _finalize_aggs(node.aggs, finals, 1)
+        out_cols, out_nulls = _finalize_aggs(node.aggs, finals, 1)
         # host output (exact wide-decimal columns must never reach the device)
         arrays = [np.asarray(c) for c in out_cols]
-        page = Page(node.schema, tuple(arrays), tuple(None for _ in arrays), None)
+        page = Page(node.schema, tuple(arrays), tuple(out_nulls), None)
         return (page, tuple(None for _ in node.aggs)), False
 
     # ---------------------------------------------------------------- materialize

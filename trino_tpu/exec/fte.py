@@ -721,9 +721,10 @@ def _merge_partial_cols(node, key_types, acc_specs, acc_kinds, payloads):
     key_cols = [k[:n_groups] for k in got[:nk]]
     key_null_cols = [kn[:n_groups] for kn in got[nk:2 * nk]]
     acc_cols = [a[:n_groups] for a in got[2 * nk:]]
-    out_cols = key_cols + _finalize_aggs(node.aggs, acc_cols, n_groups)
+    fin_cols, fin_nulls = _finalize_aggs(node.aggs, acc_cols, n_groups)
+    out_cols = key_cols + fin_cols
     arrays = [np.asarray(c) for c in out_cols]
     out_nulls = tuple(kn if kn.any() else None for kn in key_null_cols) \
-        + tuple(None for _ in node.aggs)
+        + tuple(fin_nulls)
     page = Page(node.schema, tuple(arrays), out_nulls, None)
     return page, None

@@ -949,10 +949,11 @@ class LocalExecutor:
         # keep the (tiny) aggregate output on the host: downstream breakers
         # (sort/limit/materialize) are host-side, and a jitted parent transform
         # device-puts automatically — pushing eagerly would buy extra round-trips
-        out_cols = key_cols + _finalize_aggs(node.aggs, acc_cols, n_groups)
+        fin_cols, fin_nulls = _finalize_aggs(node.aggs, acc_cols, n_groups)
+        out_cols = key_cols + fin_cols
         arrays = [np.asarray(c) for c in out_cols]
         out_nulls = tuple(kn if kn.any() else None for kn in key_null_cols
-                          ) + tuple(None for _ in node.aggs)
+                          ) + tuple(fin_nulls)
         page = Page(node.schema, tuple(arrays), out_nulls, None)
         dicts = tuple(stream.dicts[i] for i in node.keys) + tuple(None for _ in node.aggs)
         return page, dicts
@@ -1108,10 +1109,10 @@ class LocalExecutor:
             else:
                 state = step(state, page, stream.aux)
         acc_cols = [np.asarray(s)[None] for s in state]
-        out_cols = _finalize_aggs(node.aggs, acc_cols, 1)
+        out_cols, out_nulls = _finalize_aggs(node.aggs, acc_cols, 1)
         # host output (exact wide-decimal columns must never reach the device)
         arrays = [np.asarray(c) for c in out_cols]
-        page = Page(node.schema, tuple(arrays), tuple(None for _ in arrays), None)
+        page = Page(node.schema, tuple(arrays), tuple(out_nulls), None)
         return page, tuple(None for _ in node.aggs)
 
     # -- window functions ----------------------------------------------------
@@ -1511,13 +1512,17 @@ def _accumulators_for(spec: P.AggSpec):
     if spec.kind == "count_star" or spec.kind == "count":
         return [(spec.kind, jnp.int64, 0)]
     if spec.kind == "sum":
+        # the trailing count accumulator distinguishes an all-NULL (or empty)
+        # group from a genuine zero sum: SQL sum over no non-null rows is
+        # NULL, not 0 (reference: the null flag of LongSumAggregation state)
         if isinstance(t, DecimalType):
             # exact wide sum: two int64 limbs (hi = v>>32, lo = v&0xFFFFFFFF)
             # accumulate separately and recombine exactly at finalization
             # (reference: Int128 state, DecimalSumAggregation.java)
-            return [("sum_hi32", jnp.int64, 0), ("sum_lo32", jnp.int64, 0)]
+            return [("sum_hi32", jnp.int64, 0), ("sum_lo32", jnp.int64, 0),
+                    ("count", jnp.int64, 0)]
         dtype = jnp.float64 if t.is_floating else jnp.int64
-        return [("sum", dtype, 0)]
+        return [("sum", dtype, 0), ("count", jnp.int64, 0)]
     if spec.kind == "avg":
         in_t = spec.arg.type
         if isinstance(in_t, DecimalType):
@@ -1565,8 +1570,15 @@ def _finalize_aggs(aggs, acc_cols, n_groups):
     Wide decimal sums recombine their two limbs as EXACT Python ints; values
     still inside int64 emit a normal device-safe column, anything past 2^63
     emits an object column that lives on the host through the result surface
-    (the reference's Int128 -> long-decimal block)."""
+    (the reference's Int128 -> long-decimal block).
+
+    Returns (columns, null_masks): SQL aggregates over an all-NULL (or empty)
+    group are NULL — sums/avgs detect it from their count accumulator,
+    min/max/arbitrary/bool_* from a surviving init sentinel (a real value
+    colliding with the sentinel is the accepted int64-extreme collision
+    class)."""
     out = []
+    nulls = []
     i = 0
     for spec in aggs:
         if spec.kind == "avg" and spec.arg is not None \
@@ -1586,20 +1598,29 @@ def _finalize_aggs(aggs, acc_cols, n_groups):
                     q, r = divmod(abs(s), n)
                     vals.append((q + (2 * r >= n)) * (1 if s >= 0 else -1))
                 out.append(np.array(vals, np.int64))  # avg fits the input type
+            nulls.append(np.asarray(c) == 0)
         elif spec.kind == "avg":
             s, c = acc_cols[i], acc_cols[i + 1]
             i += 2
             c_safe = np.where(c == 0, 1, c)
             out.append((s / c_safe).astype(np.float64))
+            nulls.append(np.asarray(c) == 0)
         elif spec.kind == "sum" and isinstance(spec.type, DecimalType):
             vec, exact = _combine_limbs_vec(acc_cols[i], acc_cols[i + 1])
-            i += 2
+            c = np.asarray(acc_cols[i + 2])
+            i += 3
             if vec is not None:
                 out.append(vec)
             elif all(-(1 << 63) <= v < (1 << 63) for v in exact):
                 out.append(np.array(exact, np.int64))
             else:
                 out.append(np.array(exact, dtype=object))
+            nulls.append(c == 0)
+        elif spec.kind == "sum":
+            s, c = acc_cols[i], acc_cols[i + 1]
+            i += 2
+            out.append(np.asarray(s).astype(np.dtype(spec.type.dtype)))
+            nulls.append(np.asarray(c) == 0)
         elif spec.kind in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
             s, ssq, c = acc_cols[i], acc_cols[i + 1], acc_cols[i + 2]
             i += 3
@@ -1607,15 +1628,23 @@ def _finalize_aggs(aggs, acc_cols, n_groups):
             m2 = np.maximum(ssq - s * s / c_safe, 0.0)  # clamp fp cancellation
             if spec.kind.endswith("_pop"):
                 var = m2 / c_safe
+                null = np.asarray(c) == 0
             else:
                 var = m2 / np.where(c < 2, 1, c - 1)
-                var = np.where(c < 2, np.nan, var)  # samp undefined below 2 rows
+                var = np.where(c < 2, 0.0, var)
+                null = np.asarray(c) < 2  # samp undefined below 2 rows
             out.append(np.sqrt(var) if spec.kind.startswith("stddev") else var)
+            nulls.append(null)
         else:
             col = acc_cols[i]
             i += 1
             out.append(col.astype(np.dtype(spec.type.dtype)))
-    return out
+            if spec.kind in ("min", "max", "arbitrary", "bool_and", "bool_or"):
+                k0, dt0, init0 = _accumulators_for(spec)[0][:3]
+                nulls.append(np.asarray(col) == np.asarray(init0))
+            else:  # counts are 0 for empty groups, never NULL
+                nulls.append(None)
+    return out, [None if (m is None or not m.any()) else m for m in nulls]
 
 
 @partial(jax.jit, static_argnums=(3,))

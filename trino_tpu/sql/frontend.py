@@ -2352,6 +2352,13 @@ class _PostAggScope:
             a = _coerce(self.translate(ast.args[0]), DOUBLE)
             b = _coerce(self.translate(ast.args[1]), DOUBLE)
             return ir.Call("power", (a, b), DOUBLE)
+        if isinstance(ast, A.FuncCall) and ast.name == "coalesce" \
+                and ast.args:
+            args = [self.translate(a) for a in ast.args]
+            t = args[0].type
+            for a in args[1:]:
+                t = common_super_type(t, a.type)
+            return ir.Call("coalesce", tuple(_coerce(a, t) for a in args), t)
         raise SemanticError(f"expression must appear in GROUP BY: {ast}")
 
 
@@ -2369,8 +2376,11 @@ def _rewrite_agg_sugar(node):
     if isinstance(node, A.FuncCall) and node.name in _AGG_SUGAR:
         args = tuple(_rewrite_agg_sugar(a) for a in node.args)
         if node.name == "count_if" and len(args) == 1:
-            return A.FuncCall("sum", (A.CaseExpr(
-                None, ((args[0], A.NumberLit("1")),), A.NumberLit("0")),))
+            # coalesce: count_if of ZERO rows is 0 (a count), while the
+            # underlying sum over an empty group is SQL NULL
+            return A.FuncCall("coalesce", (A.FuncCall("sum", (A.CaseExpr(
+                None, ((args[0], A.NumberLit("1")),), A.NumberLit("0")),)),
+                A.NumberLit("0")))
         if node.name == "geometric_mean" and len(args) == 1:
             return A.FuncCall("exp", (A.FuncCall(
                 "avg", (A.FuncCall("ln", (args[0],)),)),))
