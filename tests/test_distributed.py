@@ -109,6 +109,10 @@ QUERIES = {
           and exists (select 1 from lineitem where l_orderkey = o_orderkey
                       and l_commitdate < l_receiptdate)
         group by o_orderpriority order by o_orderpriority""",
+    # global variance distributed (sum_sq accumulator through psum merge)
+    "var_global": """
+        select var_pop(l_discount) v, stddev_samp(l_quantity) s,
+               sum(l_tax) t from lineitem where l_orderkey < 1000""",
     "window_dist_frame": """
         select o_custkey, o_orderkey,
                sum(o_totalprice) over (partition by o_custkey
