@@ -433,6 +433,8 @@ class ClusterCoordinator:
         # plan object, so the id(node)-keyed compiled-pipeline caches hit
         # instead of re-tracing per query
         self._local = LocalExecutor(engine.catalogs)
+        self._compile_lock = threading.Lock()  # shared-executor stream compiles
+        self._query_abort = threading.Event()  # fail-fast across sibling stages
         from collections import OrderedDict
 
         # (sql, catalog) -> (plan, version snapshot): same identity + staleness
@@ -582,6 +584,7 @@ class ClusterCoordinator:
                                          f"cluster_exchange_{seq}")
             exchange = SpoolingExchange(exchange_dir)
             self._task_seq = 0
+            self._query_abort.clear()
             spooled: dict = {}  # id(node) -> (task_ids, node)
             self._mem_results = {}  # id(node) -> (page, dicts) merged locally
             try:
@@ -622,9 +625,33 @@ class ClusterCoordinator:
         ``nested``: a fragment ancestor exists — this fragment's output will
         be consumed REMOTELY, so coordinator-merged results must spool."""
         child_nested = nested or isinstance(node, self._FRAGMENT_NODES)
-        for c in node.children:
-            self._exec_fragments(c, exchange, exchange_dir, spooled,
-                                 child_nested)
+        kids = list(node.children)
+        if len(kids) > 1:
+            # independent sibling subtrees (join sides, set-op inputs)
+            # schedule CONCURRENTLY: their tasks interleave across workers
+            # instead of one stage idling the cluster while the other runs
+            # (reference: stages run in parallel under
+            # PipelinedQueryScheduler; this walk previously serialized them)
+            import concurrent.futures as _futures
+
+            def run_child(c):
+                try:
+                    self._exec_fragments(c, exchange, exchange_dir, spooled,
+                                         child_nested)
+                except BaseException:
+                    # fail-fast: siblings stop dispatching instead of running
+                    # their whole stage for a query that will be abandoned
+                    self._query_abort.set()
+                    raise
+
+            with _futures.ThreadPoolExecutor(max_workers=len(kids)) as pool:
+                futs = [pool.submit(run_child, c) for c in kids]
+                for f in futs:
+                    f.result()
+        else:
+            for c in kids:
+                self._exec_fragments(c, exchange, exchange_dir, spooled,
+                                     child_nested)
         if not isinstance(node, self._FRAGMENT_NODES):
             return
         frag = self._substitute(node, spooled, root=True)
@@ -636,8 +663,7 @@ class ClusterCoordinator:
                 if task_ids is not None:
                     page, dicts = merge_partial_outputs(
                         frag, [exchange.read(t) for t in task_ids])
-                    tid = f"t{self._task_seq}"
-                    self._task_seq += 1
+                    tid = self._next_tid()
                     if nested:
                         # a remote parent consumes this: spool the merged page
                         from ..exec.local_executor import _host_page
@@ -719,6 +745,13 @@ class ClusterCoordinator:
         walk(plan)
         return out
 
+    def _next_tid(self) -> str:
+        """Task ids under the lock: sibling fragments dispatch concurrently."""
+        with self._lock:
+            tid = f"t{self._task_seq}"
+            self._task_seq += 1
+            return tid
+
     def _run_split_tasks(self, frag, spine, exchange_dir, kind):
         """Fan a fragment out across workers by split batches (reference:
         SourcePartitionedScheduler split placement + the dynamic-filter split
@@ -730,7 +763,10 @@ class ClusterCoordinator:
             # compiling ONLY the Filter/Project chain over the scan is cheap
             # (no join builds) and inherits the executor's tuple-domain split
             # pruning: a selective predicate ships fewer splits to workers
-            stream = self._local._compile_stream(chain_top)
+            with self._compile_lock:  # shared executor: one compile at a
+                # time — NOT self._lock, which heartbeats/announce/dispatch
+                # bookkeeping need while a trace runs
+                stream = self._local._compile_stream(chain_top)
             if stream.scan_info is not None:
                 splits = list(stream.scan_info.splits)
         except NotImplementedError:
@@ -742,8 +778,7 @@ class ClusterCoordinator:
         tasks = []
         for i in range((len(splits) + self.splits_per_task - 1)
                        // self.splits_per_task):
-            tid = f"t{self._task_seq}"
-            self._task_seq += 1
+            tid = self._next_tid()
             sp = tuple(splits[j] for j in
                        range(i * self.splits_per_task,
                              min((i + 1) * self.splits_per_task, len(splits))))
@@ -752,8 +787,7 @@ class ClusterCoordinator:
         return tuple(t for t, _ in tasks)
 
     def _run_single_task(self, frag, exchange_dir) -> tuple:
-        tid = f"t{self._task_seq}"
-        self._task_seq += 1
+        tid = self._next_tid()
         self._dispatch_tasks(frag, [(tid, {})], exchange_dir, "fragment")
         return (tid,)
 
@@ -816,6 +850,9 @@ class ClusterCoordinator:
         durations: list = []  # completed task durations this fragment
         speculated: set = set()
         while pending or assigned:
+            if self._query_abort.is_set():
+                raise RuntimeError(
+                    "sibling stage failed: aborting this stage's dispatch")
             # (re)assign pending tasks round-robin over live workers; the
             # fragment ships once per worker URL, tasks address it by id
             live = self.live_workers()
