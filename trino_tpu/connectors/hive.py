@@ -49,6 +49,35 @@ class HiveConnector(MultiFileConnector):
                     out.append(d)
         return sorted(set(out) | set(self._tables))
 
+    # -- pending DDL: declared tables with no data files yet serve their
+    # declared schema (discovery takes over at the first append) -----------------
+    def _pending(self, table: str):
+        pending = getattr(self, "_pending_ddl", {})
+        if table not in pending:
+            return None
+        found: list = []
+        try:
+            self._walk(os.path.join(self.warehouse, table), (), out=found)
+        except FileNotFoundError:
+            pass
+        if found:  # data landed: discovery owns the table from here on
+            pending.pop(table, None)
+            return None
+        return pending[table]
+
+    def schema(self, table: str):
+        p = self._pending(table)
+        return p[0] if p is not None else super().schema(table)
+
+    def dictionaries(self, table: str) -> dict:
+        return {} if self._pending(table) is not None             else super().dictionaries(table)
+
+    def row_count(self, table: str) -> int:
+        return 0 if self._pending(table) is not None             else super().row_count(table)
+
+    def splits(self, table: str, n_hint: int = 0):
+        return [] if self._pending(table) is not None             else super().splits(table, n_hint)
+
     # -- discovery ---------------------------------------------------------------
     def _walk(self, d: str, parts: tuple, out: list) -> None:
         for name in self.fs.list_dir(d):
@@ -134,10 +163,18 @@ class HiveConnector(MultiFileConnector):
             if if_not_exists:
                 return False
             raise ValueError(f"table {table} already exists")
-        unknown = [c for c in partitioned_by
-                   if c not in [f.name for f in schema.fields]]
+        names = [f.name for f in schema.fields]
+        unknown = [c for c in partitioned_by if c not in names]
         if unknown:
             raise ValueError(f"partition columns {unknown} not in schema")
+        if partitioned_by and \
+                tuple(names[-len(partitioned_by):]) != tuple(partitioned_by):
+            # discovery appends partition columns LAST; a different declared
+            # order would silently flip positional column meaning at the
+            # first write
+            raise ValueError(
+                "partition columns must be the trailing columns, in order: "
+                f"declare (... , {', '.join(partitioned_by)})")
         self.fs.mkdirs(table_dir)
         self._pending_ddl = getattr(self, "_pending_ddl", {})
         self._pending_ddl[table] = (schema, tuple(partitioned_by))

@@ -283,12 +283,15 @@ class Select(Node):
 
 @dataclasses.dataclass(frozen=True)
 class CreateTable(Node):
-    """CREATE TABLE name (col type, ...) | CREATE TABLE name AS query."""
+    """CREATE TABLE name (col type, ...) [WITH (props)] | ... AS query."""
 
     name: str
     columns: tuple  # ((name, type_name, params), ...); empty for CTAS
     as_query: Optional[Node] = None
     if_not_exists: bool = False
+    properties: tuple = ()  # WITH (name = value, ...); values: literal or
+    # ARRAY['a', ...] of string literals (reference: tableProperties in the
+    # grammar -> connector table properties like hive's partitioned_by)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -650,7 +653,10 @@ class Parser:
                 if not self.accept(","):
                     break
             self.expect(")")
-            return CreateTable(name, tuple(cols), None, ine)
+            props = self._parse_table_properties()
+            if self.accept("as"):  # CREATE TABLE t (...) WITH ... AS query? no
+                raise ParseError("column list and AS query are exclusive")
+            return CreateTable(name, tuple(cols), None, ine, props)
         if self.accept("insert"):
             self.expect("into")
             name = self.expect_kind("ident").value
@@ -829,6 +835,46 @@ class Parser:
             cols.append(self.expect_kind("ident").value)
         self.expect(")")
         return tuple(cols)
+
+    def _parse_table_properties(self) -> tuple:
+        """WITH (name = value, ...) — values: number/string/bool literals or
+        ARRAY['a', 'b'] of strings."""
+        if not self.accept("with"):
+            return ()
+        self.expect("(")
+        props = []
+        while True:
+            pname = self.expect_kind("ident").value
+            self.expect("=")
+            t = self.peek()
+            if t.kind == "string":
+                self.next()
+                val = t.value
+            elif t.kind == "number":
+                self.next()
+                val = float(t.value) if "." in t.value else int(t.value)
+            elif t.kind == "keyword" and t.value in ("true", "false"):
+                self.next()
+                val = t.value == "true"
+            elif t.kind == "ident" and t.value == "array":
+                self.next()
+                self.expect("[")
+                items = []
+                if not (self.peek().kind == "op" and self.peek().value == "]"):
+                    while True:
+                        items.append(self.expect_kind("string").value)
+                        if not self.accept(","):
+                            break
+                self.expect("]")
+                val = tuple(items)
+            else:
+                raise ParseError(
+                    f"unsupported table property value at pos {t.pos}")
+            props.append((pname, val))
+            if not self.accept(","):
+                break
+        self.expect(")")
+        return tuple(props)
 
     def _parse_merge(self):
         """MERGE INTO t [AS a] USING (s | (subquery)) [AS b] ON cond
