@@ -123,3 +123,17 @@ def test_orc_write_read_roundtrip_and_ranges(tmp_path):
     assert conn.column_range("t", "id") == (1, 3)
     r = e.execute_sql("select count(*) c from t where s = 'b'", s).to_pandas()
     assert int(r.iloc[0, 0]) == 2
+
+
+def test_describe_and_show_schemas():
+    from trino_tpu import Engine
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table t (id bigint, name varchar)", s)
+    r = e.execute_sql("describe t", s).to_pandas()
+    assert r.values.tolist() == [["id", "bigint"], ["name", "varchar"]]
+    r = e.execute_sql("show schemas", s).to_pandas()
+    assert "mem" in r.iloc[:, 0].tolist()

@@ -706,6 +706,10 @@ class Parser:
             return Delete(name, where)
         if t.kind == "ident" and t.value == "merge":
             return self._parse_merge()
+        if t.kind == "ident" and t.value in ("describe", "desc") \
+                and self.peek(1).kind == "ident":
+            self.next()
+            return Show("columns", self.expect_kind("ident").value)
         if t.kind == "ident" and t.value == "update":
             self.next()
             name = self.expect_kind("ident").value
@@ -817,6 +821,8 @@ class Parser:
         if what == "create":
             self.expect("table")
             return Show("create_table", self.expect_kind("ident").value)
+        if what == "schemas":
+            return Show("schemas")
         raise ParseError(f"unsupported SHOW {what!r}")
 
     def _expect_ident(self, value: str) -> None:
