@@ -481,3 +481,69 @@ def test_q65_store_item_revenue(eng, host3):
     np.testing.assert_allclose(got["revenue"].to_numpy().astype(float),
                                exp["p"].to_numpy(), rtol=1e-9)
     assert got["s_store_name"].tolist() == exp["s_store_name"].tolist()
+
+
+def test_q26_catalog_demographics(eng):
+    """Q26 shape: catalog-channel averages for a demographic slice with a
+    promotion-channel OR predicate (5-table star)."""
+    e, s = eng
+    conn = e.catalogs["tpcds"]
+    got = e.execute_sql("""
+        select i_item_id, avg(cs_quantity) agg1, avg(cs_list_price) agg2,
+               avg(cs_coupon_amt) agg3, avg(cs_sales_price) agg4
+        from catalog_sales, customer_demographics, date_dim, item, promotion
+        where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+          and cs_bill_cdemo_sk = cd_demo_sk and cs_promo_sk = p_promo_sk
+          and cd_gender = 'M' and cd_marital_status = 'S'
+          and cd_education_status = 'College'
+          and (p_channel_email = 'N' or p_channel_event = 'N')
+          and d_year = 2000
+        group by i_item_id order by i_item_id limit 10""", s).to_pandas()
+
+    wanted = {
+        "catalog_sales": ["cs_sold_date_sk", "cs_item_sk", "cs_bill_cdemo_sk",
+                          "cs_promo_sk", "cs_quantity", "cs_list_price",
+                          "cs_coupon_amt", "cs_sales_price"],
+        "customer_demographics": ["cd_demo_sk", "cd_gender",
+                                  "cd_marital_status", "cd_education_status"],
+        "date_dim": ["d_date_sk", "d_year"],
+        "item": ["i_item_sk", "i_item_id"],
+        "promotion": ["p_promo_sk", "p_channel_email", "p_channel_event"],
+    }
+    T = {}
+    for t, names in wanted.items():
+        dicts = conn.dictionaries(t)
+        cols = {}
+        for name in names:
+            parts = [np.asarray(conn.generate(sp, [name]).column(name))
+                     for sp in conn.splits(t)]
+            arr = np.concatenate(parts)
+            if dicts.get(name) is not None:
+                arr = dicts[name].decode(arr)
+            cols[name] = arr
+        T[t] = pd.DataFrame(cols)
+
+    j = T["catalog_sales"].merge(
+        T["date_dim"], left_on="cs_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(T["item"], left_on="cs_item_sk", right_on="i_item_sk")
+    j = j.merge(T["customer_demographics"], left_on="cs_bill_cdemo_sk",
+                right_on="cd_demo_sk")
+    j = j.merge(T["promotion"], left_on="cs_promo_sk", right_on="p_promo_sk")
+    j = j[(j.cd_gender == "M") & (j.cd_marital_status == "S")
+          & (j.cd_education_status == "College")
+          & ((j.p_channel_email == "N") | (j.p_channel_event == "N"))
+          & (j.d_year == 2000)]
+    for c in ("cs_list_price", "cs_coupon_amt", "cs_sales_price"):
+        j[c] = j[c] / 100.0
+    exp = (j.groupby("i_item_id")
+           .agg(agg1=("cs_quantity", "mean"), agg2=("cs_list_price", "mean"),
+                agg3=("cs_coupon_amt", "mean"), agg4=("cs_sales_price", "mean"))
+           .reset_index().sort_values("i_item_id").head(10))
+    assert got["i_item_id"].tolist() == exp["i_item_id"].tolist()
+    np.testing.assert_allclose(got["agg1"].to_numpy().astype(float),
+                               exp["agg1"].to_numpy(), rtol=1e-9)
+    # decimal avgs round HALF_UP to the input scale (cents)
+    np.testing.assert_allclose(got["agg2"].to_numpy().astype(float),
+                               exp["agg2"].to_numpy(), atol=0.005)
+    np.testing.assert_allclose(got["agg4"].to_numpy().astype(float),
+                               exp["agg4"].to_numpy(), atol=0.005)
