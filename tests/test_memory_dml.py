@@ -127,3 +127,23 @@ def test_delete_and_update():
     assert [x[0] for x in r] == [1, 2]
     e.execute_sql("delete from emp", s)
     assert e.execute_sql("select count(*) from emp", s).rows()[0][0] == 0
+
+
+def test_dml_returns_affected_row_counts():
+    """INSERT/UPDATE/DELETE surface their affected-row counts (reference:
+    the client protocol's updateCount)."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table t (a bigint)", s)
+    assert e.execute_sql("insert into t values (1), (2), (3)",
+                         s).to_pandas().values.tolist() == [[3]]
+    assert e.execute_sql("update t set a = a + 1 where a >= 2",
+                         s).to_pandas().values.tolist() == [[2]]
+    assert e.execute_sql("delete from t where a = 4",
+                         s).to_pandas().values.tolist() == [[1]]
+    assert e.execute_sql("delete from t where a = 999",
+                         s).to_pandas().values.tolist() == [[0]]
