@@ -1,11 +1,11 @@
 #!/bin/bash
 # Probe the axon TPU tunnel; the moment it answers, capture bench numbers
-# (SF1 then SF10) into BENCH_local_r03.json artifacts.  Exits 0 after capture,
+# (SF1 then SF10) into BENCH_local_r04.json artifacts.  Exits 0 after capture,
 # 1 if the tunnel never recovered within ~11.5h.
 cd /root/repo
 LOG=scripts/tpu_watch.log
-echo "$(date -Is) watcher start" >> "$LOG"
-for i in $(seq 1 200); do
+echo "$(date -Is) watcher start (r04)" >> "$LOG"
+for i in $(seq 1 220); do
   if timeout 150 python -c "import jax; d=jax.devices()[0]; assert d.platform != 'cpu', d" >> "$LOG" 2>&1; then
     echo "$(date -Is) TPU UP on probe $i — starting capture" >> "$LOG"
     BENCH_BUDGET=1800 BENCH_SF=1 timeout 2100 python bench.py \
@@ -24,9 +24,9 @@ for sf in ("sf1", "sf10"):
         out[sf] = json.load(open(f"scripts/bench_{sf}.json"))
     except Exception as e:
         out[sf] = {"error": str(e)}
-json.dump(out, open("BENCH_local_r03.json", "w"), indent=1)
+json.dump(out, open("BENCH_local_r04.json", "w"), indent=1)
 PY
-    echo "$(date -Is) wrote BENCH_local_r03.json" >> "$LOG"
+    echo "$(date -Is) wrote BENCH_local_r04.json" >> "$LOG"
     exit 0
   fi
   echo "$(date -Is) probe $i: tunnel down" >> "$LOG"

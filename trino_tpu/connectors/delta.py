@@ -3,12 +3,15 @@
 Reference: plugin/trino-delta-lake — the transaction log under ``_delta_log/``
 is the table's source of truth (TransactionLogAccess.java): JSON commit files
 hold ``metaData`` (schemaString + partitionColumns), ``add`` and ``remove``
-file actions; the live file set is the log replay.  This subset replays
-JSON commits in version order (checkpoint-parquet compaction is not read, so
-vacuumed/checkpointed-away history must still have its JSON commits present),
-maps each live ``add`` to a parquet split, synthesizes partition columns as
-constants, and prunes splits with the add action's ``stats`` min/max
-(TransactionLogParser + DeltaLakeSplitManager's stats-based pruning).
+file actions; the live file set is the log replay.  This subset reads the
+``_last_checkpoint`` pointer and its checkpoint parquet (single-file or
+multi-part via the ``parts`` field), replays the JSON commits after it in
+version order (falling back to full JSON replay when the checkpoint files are
+absent or unreadable), maps each live ``add`` to a parquet split, synthesizes
+partition columns as constants, and prunes splits with the add action's
+``stats`` min/max (TransactionLogParser + DeltaLakeSplitManager's stats-based
+pruning).  Action paths arrive percent-encoded and are decoded before
+resolution (reference: TransactionLogParser URL-decoding of add paths).
 """
 
 from __future__ import annotations
@@ -16,6 +19,7 @@ from __future__ import annotations
 import datetime
 import json
 import os
+from urllib.parse import unquote
 
 import numpy as np
 
@@ -74,16 +78,28 @@ class DeltaConnector(MultiFileConnector):
         # there and only JSON commits AFTER it apply (reference:
         # TransactionLogAccess reading _last_checkpoint + checkpoint parquet;
         # vacuumed tables have no JSON commits before the checkpoint)
-        ckpt_version = -1
+        ckpt_version, ckpt_parts = -1, None
         lc = os.path.join(log_dir, "_last_checkpoint")
         if self.fs.exists(lc):
             try:
-                ckpt_version = int(json.loads(self.fs.read_text(lc))["version"])
+                lc_doc = json.loads(self.fs.read_text(lc))
+                ckpt_version = int(lc_doc["version"])
+                ckpt_parts = lc_doc.get("parts")
             except (ValueError, KeyError):
                 ckpt_version = -1
         if ckpt_version >= 0:
-            meta, live = self._read_checkpoint(log_dir, ckpt_version)
-            commits = [c for c in commits if int(c[:-5]) > ckpt_version]
+            try:
+                meta, live = self._read_checkpoint(log_dir, ckpt_version,
+                                                   ckpt_parts)
+                commits = [c for c in commits if int(c[:-5]) > ckpt_version]
+            except (FileNotFoundError, OSError, ValueError) as e:
+                # stale/corrupt checkpoint pointer: full JSON replay still
+                # yields the correct state as long as the commits are present
+                meta, live = None, {}
+                if not commits:
+                    raise ValueError(
+                        f"table {table}: checkpoint at version {ckpt_version} "
+                        f"unreadable ({e}) and no JSON commits to replay")
         for c in commits:
             text = self.fs.read_text(os.path.join(log_dir, c))
             for line in text.splitlines():
@@ -93,10 +109,11 @@ class DeltaConnector(MultiFileConnector):
                 if "metaData" in action:
                     meta = action["metaData"]
                 elif "add" in action:
-                    a = action["add"]
+                    a = dict(action["add"])
+                    a["path"] = unquote(a["path"])
                     live[a["path"]] = a
                 elif "remove" in action:
-                    live.pop(action["remove"]["path"], None)
+                    live.pop(unquote(action["remove"]["path"]), None)
         if meta is None:
             raise ValueError(f"table {table}: no metaData action in log")
 
@@ -153,15 +170,25 @@ class DeltaConnector(MultiFileConnector):
         data_schema = self._pq._open(files[0].pseudo).schema
         return _FTable(data_schema, part_fields, files, part_dicts, 0)
 
-    def _read_checkpoint(self, log_dir: str, version: int):
+    def _read_checkpoint(self, log_dir: str, version: int, parts=None):
         """Checkpoint parquet -> (metaData dict, live add actions): each row
         holds at most one action as a nested struct (add / remove / metaData
-        columns); remove rows are tombstones already applied at write time."""
+        columns); remove rows are tombstones already applied at write time.
+        Multi-part checkpoints (``parts`` in _last_checkpoint) split the rows
+        over ``<v>.checkpoint.<i>.<n>.parquet`` files — the union of all parts
+        is the state (reference: CheckpointEntryIterator over every part)."""
         import pyarrow.parquet as pq
 
-        path = os.path.join(log_dir, f"{version:020d}.checkpoint.parquet")
-        tbl = pq.read_table(path)
-        rows = tbl.to_pylist()
+        if parts:
+            n = int(parts)
+            paths = [os.path.join(
+                log_dir, f"{version:020d}.checkpoint.{i:010d}.{n:010d}.parquet")
+                for i in range(1, n + 1)]
+        else:
+            paths = [os.path.join(log_dir, f"{version:020d}.checkpoint.parquet")]
+        rows = []
+        for path in paths:
+            rows.extend(pq.read_table(path).to_pylist())
         meta = None
         live: dict = {}
         for r in rows:
@@ -172,9 +199,10 @@ class DeltaConnector(MultiFileConnector):
             if a and a.get("path"):
                 # partitionValues may arrive as a list of {key,value} structs
                 pv = a.get("partitionValues")
+                a = dict(a)
                 if isinstance(pv, list):
-                    a = dict(a)
                     a["partitionValues"] = {e["key"]: e["value"] for e in pv}
+                a["path"] = unquote(a["path"])
                 live[a["path"]] = a
         return meta, live
 

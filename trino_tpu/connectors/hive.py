@@ -231,13 +231,11 @@ class HiveConnector(MultiFileConnector):
                       columns)
 
     def drop_table(self, table: str, if_exists=False) -> None:
-        import shutil
-
         table_dir = os.path.join(self.warehouse, table)
         if not self.fs.is_dir(table_dir):
             if if_exists:
                 return
             raise ValueError(f"table {table} does not exist")
-        shutil.rmtree(table_dir)
+        self.fs.delete_dir(table_dir)
         self._tables.pop(table, None)
         getattr(self, "_pending_ddl", {}).pop(table, None)

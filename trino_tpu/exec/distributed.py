@@ -249,7 +249,9 @@ def _lex_indices(sort_keys, luts_t, cols, nulls, valid):
         if c.dtype == jnp.bool_:
             c = c.astype(jnp.int8)
         if not sk.ascending:
-            c = -c
+            # bitwise complement is order-reversing AND total on ints
+            # (arithmetic negation wraps -INT64_MIN back to itself)
+            c = ~c if jnp.issubdtype(c.dtype, jnp.integer) else -c
         nm = nulls[sk.channel]
         ni = nm.astype(jnp.int8) if nm is not None \
             else jnp.zeros(c.shape, jnp.int8)

@@ -2190,30 +2190,46 @@ def _compact_pack(valid):
 def _prefetched_pages(pages_fn, depth: int = 2):
     """Wrap a page generator with background-thread prefetch: up to ``depth``
     pages decode ahead of the consumer.  Exceptions re-raise at the consume
-    site; an abandoned consumer (LIMIT) leaves at most ``depth`` extra decoded
-    pages behind on a daemon thread."""
+    site.  An abandoned consumer (LIMIT short-circuit, error unwind) closes the
+    generator; the producer observes the ``closed`` flag on its next bounded
+    put and exits, releasing its decoded pages and file handles instead of
+    blocking on the full queue for the process lifetime."""
     import queue as _queue
 
     def pages():
         q: _queue.Queue = _queue.Queue(maxsize=depth)
         done = object()
+        closed = threading.Event()
 
         def producer():
+            def put(item) -> bool:
+                while not closed.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        return True
+                    except _queue.Full:
+                        continue
+                return False
+
             try:
                 for p in pages_fn():
-                    q.put(p)
-                q.put(done)
+                    if not put(p):
+                        return
+                put(done)
             except BaseException as e:  # surfaces in the consumer
-                q.put(e)
+                put(e)
 
         threading.Thread(target=producer, daemon=True).start()
-        while True:
-            item = q.get()
-            if item is done:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is done:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            closed.set()
 
     return pages
 

@@ -42,6 +42,10 @@ class FileSystem:
     def mkdirs(self, path: str) -> None:
         raise NotImplementedError
 
+    def delete_dir(self, path: str) -> None:
+        """Recursively delete a directory tree (TrinoFileSystem.deleteDirectory)."""
+        raise NotImplementedError
+
 
 class LocalFileSystem(FileSystem):
     def exists(self, path: str) -> bool:
@@ -63,6 +67,11 @@ class LocalFileSystem(FileSystem):
 
     def mkdirs(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
+
+    def delete_dir(self, path: str) -> None:
+        import shutil
+
+        shutil.rmtree(path, ignore_errors=True)
 
 
 class MemoryFileSystem(FileSystem):
@@ -100,3 +109,8 @@ class MemoryFileSystem(FileSystem):
 
     def mkdirs(self, path: str) -> None:
         pass  # directories are implicit
+
+    def delete_dir(self, path: str) -> None:
+        prefix = self._norm(path) + "/"
+        for f in [f for f in self._files if f.startswith(prefix)]:
+            del self._files[f]
