@@ -80,6 +80,25 @@ class _ScanInfo:
     # transformed layout, not raw scan pages)
 
 
+@dataclasses.dataclass(frozen=True)
+class _TracedSrc:
+    """Trace-time provenance of a stream's pages: when present, every page the
+    stream yields equals ``conn.generate_traced(table, split.lo, length, cols)``
+    pushed through ``stages`` (prior pipeline boundaries, e.g. a compaction
+    whose packing is semantically a no-op) and then the stream's own transform.
+    Sinks that see this can run the ENTIRE scan inside one ``lax.scan`` over
+    split offsets — O(1) host dispatches instead of O(splits), the difference
+    between tunnel-latency-bound and compute-bound on remote TPUs (reference
+    analog: the zero-per-page scheduler cost of operator/Driver.java:372-481)."""
+
+    conn: object
+    table: str
+    splits: tuple  # uniform-length split ranges (post static/dynamic pruning)
+    scan_cols: tuple  # column names generate_traced must produce
+    stages: tuple = ()  # prior _Streams whose (transform, aux) apply in order
+    # BEFORE the owning stream's transform (aux always passed as jit arguments)
+
+
 @dataclasses.dataclass
 class _Stream:
     """A streaming pipeline segment: a source of raw pages + a fused transform.
@@ -104,7 +123,10 @@ class _Stream:
     compacted: bool = False  # a compaction boundary already shrank this chain's
     # lanes to ~its estimated rows; a second boundary would pay materialization
     # for no further reduction
+    traced_src: Optional[_TracedSrc] = None  # on-device regenerable provenance
     _jitted: Callable = None  # cached jit of transform applied to a Page
+    _fused_cache: dict = dataclasses.field(default_factory=dict)  # compiled
+    # whole-scan artifacts (fused concat passes), keyed by shape class
 
     def jitted(self):
         """Jit-compiled page->(cols,nulls,valid) function, cached on the stream so
@@ -347,9 +369,16 @@ class LocalExecutor:
         si = up.scan_info
         if si is not None:
             si = dataclasses.replace(si, replayable=False)
+        # compaction only re-packs live lanes — semantically a no-op for any
+        # mask-respecting consumer — so traced regeneration stays valid: the
+        # upstream chain becomes a prior stage applied to raw pages
+        tsrc = up.traced_src
+        if tsrc is not None:
+            tsrc = dataclasses.replace(tsrc, stages=tsrc.stages + (up,))
         return _Stream(up.schema, up.dicts, pages,
                        lambda c, n, v, aux: (c, n, v), si,
-                       clustered_by=up.clustered_by, compacted=True)
+                       clustered_by=up.clustered_by, compacted=True,
+                       traced_src=tsrc)
 
     # -- streaming segment compilation ---------------------------------------
     def _subtree_overridden(self, node) -> bool:
@@ -401,9 +430,16 @@ class LocalExecutor:
             si = _ScanInfo(conn, splits, tuple(node.columns), tuple(node.columns))
             clustered = tuple(conn.clustered_by(node.table)) \
                 if hasattr(conn, "clustered_by") else ()
+            tsrc = None
+            if (hasattr(conn, "generate_traced")
+                    and not getattr(conn, "HOST_DECODE", False) and splits
+                    and all(hasattr(s, "lo") and hasattr(s, "hi") for s in splits)
+                    and len({s.hi - s.lo for s in splits}) == 1):
+                tsrc = _TracedSrc(conn, node.table, tuple(splits),
+                                  tuple(node.columns))
             return _Stream(node.schema, dicts, pages,
                            lambda c, n, v, aux: (c, n, v), si,
-                           clustered_by=clustered)
+                           clustered_by=clustered, traced_src=tsrc)
 
         if isinstance(node, P.Filter):
             up = self._compile_stream(node.child)
@@ -415,8 +451,12 @@ class LocalExecutor:
 
             pruned = _static_pruned_stream(up, pred)
             pages, si = pruned if pruned is not None else (up.pages, up.scan_info)
+            tsrc = up.traced_src
+            if pruned is not None and tsrc is not None:
+                tsrc = dataclasses.replace(tsrc, splits=tuple(si.splits))
             return _Stream(up.schema, up.dicts, pages, transform, si, aux=up.aux,
-                           clustered_by=up.clustered_by, compacted=up.compacted)
+                           clustered_by=up.clustered_by, compacted=up.compacted,
+                           traced_src=tsrc)
 
         if isinstance(node, P.Project):
             up = self._compile_stream(node.child)
@@ -445,7 +485,8 @@ class LocalExecutor:
                     up.scan_info.columns[e.index] if isinstance(e, FieldRef) else None
                     for e in node.exprs))
             return _Stream(node.schema, dicts, up.pages, transform, si, aux=up.aux,
-                           clustered_by=up.clustered_by, compacted=up.compacted)
+                           clustered_by=up.clustered_by, compacted=up.compacted,
+                           traced_src=up.traced_src)
 
         if isinstance(node, P.Join):
             return self._compile_join(node)
@@ -590,11 +631,208 @@ class LocalExecutor:
             self._agg_cache[("direct", id(node), cfg)] = (node, dstep)
         return dstep
 
+    # -- scan-fused aggregation ----------------------------------------------
+    def _traced_chain(self, stream):
+        """(chain_fn, split_offsets, stage_auxes) for a traced-regenerable
+        stream, or None.  chain_fn(lo, auxes) regenerates one split's raw page
+        on device and pushes it through every pipeline stage — pure, so a
+        ``lax.scan`` over the offsets runs the WHOLE scan in one dispatch.
+        Stage aux pytrees are jit ARGUMENTS (the no-closed-over-aux rule)."""
+        ts = stream.traced_src
+        if ts is None or not ts.splits:
+            return None
+        stages = ts.stages + (stream,)
+        length = int(ts.splits[0].hi - ts.splits[0].lo)
+        los = jnp.asarray([int(s.lo) for s in ts.splits], jnp.int64)
+        auxes = tuple(st.aux for st in stages)
+
+        def chain(lo, auxes, ts=ts, stages=stages, length=length):
+            cols, valid = ts.conn.generate_traced(ts.table, lo, length,
+                                                  ts.scan_cols)
+            nulls = tuple(None for _ in cols)
+            for st, aux in zip(stages, auxes):
+                cols, nulls, valid = st.transform(cols, nulls, valid, aux)
+            return cols, nulls, valid
+
+        return chain, los, auxes
+
+    def _agg_capacity_estimate(self, stream, node, key_ranges):
+        """Upper-bound estimate of group count from static key ranges and the
+        source table's row bound (reference: stats-driven GroupByHash
+        expectedSize).  Estimates saturate at MAX_GROUP_CAPACITY."""
+        est = None
+        prod = 1
+        for r in key_ranges:
+            if r is None:
+                prod = None
+                break
+            prod = min(prod * max(int(r[1]) - int(r[0]) + 1, 1),
+                       MAX_GROUP_CAPACITY)
+        if prod is not None:
+            est = prod
+        si = stream.scan_info
+        if si is not None and si.splits \
+                and hasattr(si.conn, "row_count") \
+                and hasattr(si.splits[0], "table"):
+            bound = int(si.conn.row_count(si.splits[0].table))
+            est = bound if est is None else min(est, bound)
+        return est
+
+    def _run_aggregate_scan_fused(self, node, stream, key_types, acc_specs,
+                                  acc_exprs, acc_kinds):
+        """Whole-scan grouped aggregation in ONE device dispatch: generate →
+        transform (filters/projects/single-match join probes) → group insert,
+        all inside a ``lax.scan`` over split offsets.  On tunneled TPUs the
+        per-page loop pays a host round-trip per dispatch (~70ms measured);
+        this path pays one.  Growth cannot happen mid-scan (static shapes), so
+        the table is pre-sized from stats and overflow re-runs the scan at 4x —
+        regeneration is device compute, far cheaper than O(splits) dispatches.
+        Returns None when the stream is not traced-regenerable."""
+        traced = self._traced_chain(stream)
+        if traced is None:
+            return None
+        chain, los, auxes = traced
+        key_dtypes = tuple(t.dtype for t in key_types)
+        key_ranges = self._key_ranges(stream, node)
+        cfg = None
+        if all(r is not None for r in key_ranges):
+            try:
+                _, onulls, _ = jax.eval_shape(chain, jnp.int64(0), auxes)
+            except Exception:
+                return None
+            key_nullable = tuple(onulls[i] is not None for i in node.keys)
+            cfg = hashagg.direct_config(key_ranges, key_nullable)
+
+        cacheable = self._agg_cacheable(node)
+
+        def make_run(insert):
+            def run(state, los, auxes, insert=insert):
+                def body(st, lo):
+                    cols, nulls, valid = chain(lo, auxes)
+                    key_vals = tuple(cols[i] for i in node.keys)
+                    key_nulls = tuple(nulls[i] for i in node.keys)
+                    inputs = [(None, None) if e is None
+                              else evaluate(e, cols, nulls) for e in acc_exprs]
+                    return insert(st, key_vals, key_nulls, inputs, valid), None
+
+                state, _ = jax.lax.scan(body, state, los)
+                return state
+
+            return jax.jit(run, donate_argnums=(0,))
+
+        def cached_run(mode, insert):
+            key = ("scanfused", id(node), mode)
+            hit = self._agg_cache.get(key) if cacheable else None
+            if hit is not None:
+                return hit[1]
+            run = make_run(insert)
+            if cacheable:
+                self._agg_cache[key] = (node, run)
+            return run
+
+        key_w = sum(np.dtype(t.dtype).itemsize + 1 for t in key_types)
+        acc_w = sum(np.dtype(dt).itemsize for dt, _ in acc_specs)
+        state_bytes = lambda cap: (cap + 1) * (8 + key_w + acc_w)
+
+        if cfg is not None:
+            if self.memory_pool.try_reserve(state_bytes(cfg.capacity),
+                                            "group-by"):
+                try:
+                    run = cached_run(("direct", cfg),
+                                     lambda st, kv, kn, inp, v, cfg=cfg:
+                                     hashagg.direct_groupby_insert(
+                                         st, cfg, kv, v, inp, acc_kinds, kn))
+                    state = run(hashagg.direct_groupby_init(
+                        cfg, key_dtypes, acc_specs), los, auxes)
+                    if not bool(state.overflow):
+                        return self._finalize_groups(node, stream, state)
+                finally:
+                    self.memory_pool.free(state_bytes(cfg.capacity), "group-by")
+            # stale stats / no memory: fall through to hash mode
+
+        if self._streaming_agg_order(stream, node) is not None:
+            est = self._agg_capacity_estimate(stream, node, key_ranges)
+            if est is None or 2 * est > MAX_GROUP_CAPACITY:
+                # clustered input with a huge/unknown group count: the
+                # streaming (sorted) aggregation's bounded merge state scales
+                # past any hash-table ceiling — let it take the query
+                return None
+
+        capacity = node.capacity or DEFAULT_GROUP_CAPACITY
+        if not node.capacity:
+            est = self._agg_capacity_estimate(stream, node, key_ranges)
+            if est is not None:
+                # a higher cap than the page-loop path (1<<20): an overflow
+                # here costs a full re-scan + recompile, so undershoot is the
+                # expensive direction
+                target = 1 << max(2 * est - 1, 1).bit_length()
+                capacity = max(capacity, min(target, 1 << 22))
+        capacity = ceil_pow2(capacity)
+        if not self.memory_pool.try_reserve(state_bytes(capacity), "group-by"):
+            return self._run_aggregate_partitioned(node, parts=4)
+        resv = state_bytes(capacity)
+        try:
+            run = cached_run("hash",
+                             lambda st, kv, kn, inp, v:
+                             hashagg.groupby_insert(st, kv, key_types, v, inp,
+                                                    acc_kinds, kn))
+            while True:
+                state = run(hashagg.groupby_init(capacity, key_dtypes,
+                                                 acc_specs), los, auxes)
+                if not bool(state.overflow):
+                    return self._finalize_groups(node, stream, state)
+                grown = capacity * 4
+                delta = state_bytes(grown) - state_bytes(capacity)
+                if grown > MAX_GROUP_CAPACITY or \
+                        not self.memory_pool.try_reserve(delta, "group-by"):
+                    return self._run_aggregate_partitioned(node, parts=4)
+                resv += delta
+                capacity = grown
+        finally:
+            self.memory_pool.free(resv, "group-by")
+
+    def _run_global_scan_fused(self, node, stream, acc_exprs, acc_kinds):
+        """Ungrouped-aggregation variant of the scan-fused path: the
+        accumulator tuple is the scan carry."""
+        traced = self._traced_chain(stream)
+        if traced is None:
+            return None
+        chain, los, auxes = traced
+        cacheable = self._agg_cacheable(node)
+        key = ("globalfused", id(node))
+        hit = self._agg_cache.get(key) if cacheable else None
+        if hit is not None:
+            run = hit[1]
+        else:
+            def run(state, los, auxes):
+                def body(st, lo):
+                    cols, nulls, valid = chain(lo, auxes)
+                    return _global_agg_update(st, cols, nulls, valid,
+                                              acc_exprs, acc_kinds), None
+
+                state, _ = jax.lax.scan(body, state, los)
+                return state
+
+            run = jax.jit(run, donate_argnums=(0,))
+            if cacheable:
+                self._agg_cache[key] = (node, run)
+        state = run(_global_init_state(node), los, auxes)
+        acc_cols = [np.asarray(s)[None] for s in state]
+        out_cols, out_nulls = _finalize_aggs(node.aggs, acc_cols, 1)
+        arrays = [np.asarray(c) for c in out_cols]
+        page = Page(node.schema, tuple(arrays), tuple(out_nulls), None)
+        return page, tuple(None for _ in node.aggs)
+
     def _run_aggregate(self, node: P.Aggregate):
         stream, key_types, acc_specs, acc_exprs, acc_kinds, step = self._agg_compiled(node)
         capacity = node.capacity or DEFAULT_GROUP_CAPACITY
         if not node.keys:
             return self._run_global_aggregate(node, stream, acc_exprs, acc_kinds)
+
+        fused = self._run_aggregate_scan_fused(node, stream, key_types,
+                                               acc_specs, acc_exprs, acc_kinds)
+        if fused is not None:
+            return fused
 
         # direct-indexed fast path: slot = packed key when static ranges are narrow
         # (reference: BigintGroupByHash, operator/GroupByHash.java:90-99)
@@ -618,22 +856,7 @@ class LocalExecutor:
                 # through grow-by-4x retries, each a full re-stream (reference:
                 # stats-driven GroupByHash expectedSize).  Estimates saturate —
                 # an overflowing product still sizes to the cap.
-                est = None
-                prod = 1
-                for r in key_ranges:
-                    if r is None:
-                        prod = None
-                        break
-                    prod = min(prod * max(int(r[1]) - int(r[0]) + 1, 1),
-                               MAX_GROUP_CAPACITY)
-                if prod is not None:
-                    est = prod
-                si = stream.scan_info
-                if si is not None and si.splits \
-                        and hasattr(si.conn, "row_count") \
-                        and hasattr(si.splits[0], "table"):
-                    bound = int(si.conn.row_count(si.splits[0].table))
-                    est = bound if est is None else min(est, bound)
+                est = self._agg_capacity_estimate(stream, node, key_ranges)
                 if est is not None:
                     # cap the stats-derived size: estimates overshoot true NDV
                     # (post-filter group counts are unknown); growth-on-overflow
@@ -1044,6 +1267,9 @@ class LocalExecutor:
 
     def _run_global_aggregate(self, node, stream, acc_exprs, acc_kinds):
         """Ungrouped aggregation (reference: AggregationOperator) — pure jnp reductions."""
+        fused = self._run_global_scan_fused(node, stream, acc_exprs, acc_kinds)
+        if fused is not None:
+            return fused
         cacheable = self._agg_cacheable(node)
         hit = self._agg_cache.get(("global", id(node))) if cacheable else None
         if hit is not None:
@@ -1055,50 +1281,15 @@ class LocalExecutor:
                  acc_kinds=acc_kinds):
             cols, nulls, valid = stream.transform(page.columns, page.null_masks,
                                                   page.valid_mask(), aux)
-            out = []
-            for st, e, kind in zip(state, acc_exprs, acc_kinds):
-                if kind == "count_star":
-                    out.append(st + jnp.sum(valid, dtype=st.dtype))
-                    continue
-                v, nu = evaluate(e, cols, nulls)
-                mask = valid if nu is None else (valid & ~nu)
-                if kind == "count":
-                    out.append(st + jnp.sum(mask, dtype=st.dtype))
-                elif kind == "sum":
-                    out.append(st + jnp.sum(jnp.where(mask, v, 0), dtype=st.dtype))
-                elif kind in ("sum_hi32", "sum_lo32"):
-                    h = (v >> 32) if kind == "sum_hi32" else (v & 0xFFFFFFFF)
-                    out.append(st + jnp.sum(jnp.where(mask, h, 0), dtype=st.dtype))
-                elif kind == "sum_sq":
-                    vv = v.astype(st.dtype)
-                    out.append(st + jnp.sum(jnp.where(mask, vv * vv, 0),
-                                            dtype=st.dtype))
-                elif kind == "min":
-                    out.append(jnp.minimum(st, jnp.min(jnp.where(mask, v, hashagg._extreme(st.dtype, 1)))))
-                elif kind == "max":
-                    out.append(jnp.maximum(st, jnp.max(jnp.where(mask, v, hashagg._extreme(st.dtype, -1)))))
-                else:
-                    raise NotImplementedError(kind)
-            return tuple(out)
+            return _global_agg_update(state, cols, nulls, valid, acc_exprs,
+                                      acc_kinds)
 
         if cacheable:
             self._agg_cache[("global", id(node))] = (node, step)
         return self._finish_global(node, stream, acc_exprs, acc_kinds, step)
 
     def _finish_global(self, node, stream, acc_exprs, acc_kinds, step):
-        acc_specs = []
-        for spec in node.aggs:
-            acc_specs.extend(_accumulators_for(spec))
-        state = tuple(
-            jnp.asarray(init if init is not None else 0, dtype)
-            for _, dtype, init in acc_specs
-        )
-        # min/max identity
-        state = tuple(
-            jnp.asarray(hashagg._extreme(dtype, 1 if kind == "min" else -1), dtype)
-            if kind in ("min", "max") else st
-            for st, (kind, dtype, _) in zip(state, acc_specs)
-        )
+        state = _global_init_state(node)
         for page in stream.pages():
             if any(isinstance(c, np.ndarray) and c.dtype == object
                    for c in page.columns):
@@ -1155,8 +1346,15 @@ class LocalExecutor:
             # probe-side scans; here domains prune whole splits via connector ranges)
             pruned = _dynamic_pruned_pages(probe_stream, node, build_page)
             if pruned is not None:
-                probe_stream = dataclasses.replace(probe_stream, pages=pruned,
-                                                   _jitted=None)
+                pages_fn, kept = pruned
+                repl = {"pages": pages_fn, "_jitted": None}
+                if probe_stream.scan_info is not None:
+                    repl["scan_info"] = dataclasses.replace(
+                        probe_stream.scan_info, splits=list(kept))
+                if probe_stream.traced_src is not None:
+                    repl["traced_src"] = dataclasses.replace(
+                        probe_stream.traced_src, splits=tuple(kept))
+                probe_stream = dataclasses.replace(probe_stream, **repl)
         if not probe_stream.compacted and self._compactable_fraction(node.left):
             # probe cost scales with LANES: don't drag dead rows from upstream
             # filters/joins through this join's probe rounds
@@ -1238,7 +1436,8 @@ class LocalExecutor:
                 columns=tuple(probe_stream.scan_info.columns) + (None,) * n_build)
         return _Stream(node.schema, dicts, probe_stream.pages, transform, si,
                        aux=(probe_stream.aux, table),
-                       compacted=probe_stream.compacted)
+                       compacted=probe_stream.compacted,
+                       traced_src=probe_stream.traced_src)
 
     def _compile_multi_join(self, node: P.Join, build_page, build_dicts, probe_stream,
                             build_key_types, span=None) -> _Stream:
@@ -1495,15 +1694,68 @@ class LocalExecutor:
         while True:
             table = build_table_init(capacity, build_page)
             table = jax.jit(build_insert, static_argnums=(2,))(table, keys, key_types, valid)
-            if not bool(table.overflow):
+            # ONE batched sync for both flags (each separate int()/bool() pays
+            # a device->host RTT on tunneled links)
+            overflow, dups = (int(x) for x in
+                              _host([table.overflow, table.dup_count]))
+            if not overflow:
                 break
             capacity *= 4
-        if int(table.dup_count) > 0:
+        if dups > 0:
             return None  # caller falls back to the multi-match strategy
         return table
 
 
 # -- helpers ------------------------------------------------------------------------------
+
+
+def _global_agg_update(state, cols, nulls, valid, acc_exprs, acc_kinds):
+    """One page folded into the ungrouped-aggregation accumulator tuple — the
+    shared body of the per-page step and the scan-fused whole-scan runner."""
+    out = []
+    for st, e, kind in zip(state, acc_exprs, acc_kinds):
+        if kind == "count_star":
+            out.append(st + jnp.sum(valid, dtype=st.dtype))
+            continue
+        v, nu = evaluate(e, cols, nulls)
+        mask = valid if nu is None else (valid & ~nu)
+        if kind == "count":
+            out.append(st + jnp.sum(mask, dtype=st.dtype))
+        elif kind == "sum":
+            out.append(st + jnp.sum(jnp.where(mask, v, 0), dtype=st.dtype))
+        elif kind in ("sum_hi32", "sum_lo32"):
+            h = (v >> 32) if kind == "sum_hi32" else (v & 0xFFFFFFFF)
+            out.append(st + jnp.sum(jnp.where(mask, h, 0), dtype=st.dtype))
+        elif kind == "sum_sq":
+            vv = v.astype(st.dtype)
+            out.append(st + jnp.sum(jnp.where(mask, vv * vv, 0),
+                                    dtype=st.dtype))
+        elif kind == "min":
+            out.append(jnp.minimum(st, jnp.min(
+                jnp.where(mask, v, hashagg._extreme(st.dtype, 1)))))
+        elif kind == "max":
+            out.append(jnp.maximum(st, jnp.max(
+                jnp.where(mask, v, hashagg._extreme(st.dtype, -1)))))
+        else:
+            raise NotImplementedError(kind)
+    return tuple(out)
+
+
+def _global_init_state(node):
+    """Initial accumulator tuple for an ungrouped aggregation."""
+    acc_specs = []
+    for spec in node.aggs:
+        acc_specs.extend(_accumulators_for(spec))
+    state = tuple(
+        jnp.asarray(init if init is not None else 0, dtype)
+        for _, dtype, init in acc_specs
+    )
+    # min/max identity
+    return tuple(
+        jnp.asarray(hashagg._extreme(dtype, 1 if kind == "min" else -1), dtype)
+        if kind in ("min", "max") else st
+        for st, (kind, dtype, _) in zip(state, acc_specs)
+    )
 
 
 def _accumulators_for(spec: P.AggSpec):
@@ -1656,6 +1908,81 @@ def _compact_part(cols, nulls, valid, size: int):
     return out_cols, out_nulls
 
 
+def _concat_traced(stream: _Stream):
+    """Whole-scan materialization for traced-regenerable streams in two device
+    dispatches + one scalar sync: a counting ``lax.scan`` sizes the output, a
+    filling scan packs every split's surviving rows into one buffer.  The
+    page-loop version pays ~2 dispatches and a chunked sync per split; on
+    tunneled TPUs those round-trips dominate join-build time.  Regenerating the
+    scan twice is deliberate: device compute is cheap, dispatches are not."""
+    ts = stream.traced_src
+    if ts is None or not ts.splits:
+        return None
+    stages = ts.stages + (stream,)
+    length = int(ts.splits[0].hi - ts.splits[0].lo)
+    los = jnp.asarray([int(s.lo) for s in ts.splits], jnp.int64)
+    auxes = tuple(st.aux for st in stages)
+
+    def chain(lo, auxes):
+        cols, valid = ts.conn.generate_traced(ts.table, lo, length,
+                                              ts.scan_cols)
+        nulls = tuple(None for _ in cols)
+        for st, aux in zip(stages, auxes):
+            cols, nulls, valid = st.transform(cols, nulls, valid, aux)
+        return cols, nulls, valid
+
+    key = ("concat", length, tuple(id(st) for st in stages))
+    arts = stream._fused_cache.get(key)
+    if arts is None:
+        try:
+            cshapes, nshapes, _ = jax.eval_shape(chain, jnp.int64(0), auxes)
+        except Exception:
+            return None
+        col_dtypes = tuple(c.dtype for c in cshapes)
+        has_null = tuple(n is not None for n in nshapes)
+
+        @jax.jit
+        def count_pass(los, auxes):
+            def body(tot, lo):
+                _, _, valid = chain(lo, auxes)
+                return tot + jnp.sum(valid, dtype=jnp.int64), None
+
+            tot, _ = jax.lax.scan(body, jnp.int64(0), los)
+            return tot
+
+        def fill_pass(los, auxes, total, cap):
+            def body(carry, lo):
+                off, bufs, nbufs = carry
+                cols, nulls, valid = chain(lo, auxes)
+                pos = jnp.cumsum(valid) - 1
+                dst = jnp.where(valid, off + pos, cap)  # invalid -> sink slot
+                bufs = tuple(b.at[dst].set(c) for b, c in zip(bufs, cols))
+                nbufs = tuple(nb if nb is None else nb.at[dst].set(m)
+                              for nb, m in zip(nbufs, nulls))
+                return (off + jnp.sum(valid, dtype=jnp.int64), bufs, nbufs), None
+
+            bufs0 = tuple(jnp.zeros((cap + 1,), d) for d in col_dtypes)
+            nbufs0 = tuple(jnp.zeros((cap + 1,), bool) if h else None
+                           for h in has_null)
+            (_, bufs, nbufs), _ = jax.lax.scan(
+                body, (jnp.int64(0), bufs0, nbufs0), los)
+            valid = jnp.arange(cap) < total
+            return (tuple(b[:cap] for b in bufs),
+                    tuple(None if nb is None else nb[:cap] for nb in nbufs),
+                    valid)
+
+        arts = (count_pass, jax.jit(fill_pass, static_argnums=(3,)))
+        stream._fused_cache[key] = arts
+    count_pass, fill_pass = arts
+    total = int(count_pass(los, auxes))
+    if total == 0:
+        cols = tuple(jnp.zeros((0,), f.type.dtype) for f in stream.schema.fields)
+        return Page(stream.schema, cols, tuple(None for _ in cols), None)
+    cap = max(1 << max(total - 1, 1).bit_length(), 1024)
+    cols, nulls, valid = fill_pass(los, auxes, jnp.int64(total), cap)
+    return Page(stream.schema, cols, nulls, valid)
+
+
 def _concat_stream(stream: _Stream) -> Page:
     """Materialize a streaming segment into a single device page (compacted).
 
@@ -1663,6 +1990,9 @@ def _concat_stream(stream: _Stream) -> Page:
     never cross to the host between pipeline-breaking stages — device->host bandwidth
     is the scarce resource, not FLOPs (reference analog: pages stay in worker memory
     between operators)."""
+    fused = _concat_traced(stream)
+    if fused is not None:
+        return fused
     step = stream.jitted()
     parts = []
     staged, sums = [], []
@@ -1787,20 +2117,30 @@ def _static_pruned_stream(up: _Stream, pred):
 
 
 def _dynamic_pruned_pages(probe_stream: _Stream, node, build_page: Page):
-    """Page source skipping probe splits disjoint from the build keys' value domain
-    (inner/semi joins only — outer/anti joins must keep unmatched probe rows).
-    Returns None when no pruning is possible."""
+    """(page source, kept splits) skipping probe splits disjoint from the build
+    keys' value domain (inner/semi joins only — outer/anti joins must keep
+    unmatched probe rows).  Returns None when no pruning is possible."""
     si = probe_stream.scan_info
     if si is None or not si.replayable or not hasattr(si.conn, "split_range"):
         return None
-    bvalid = np.asarray(build_page.valid_mask()) if build_page.capacity else \
+    exact_ok = build_page.capacity <= 65536
+    bvalid = np.asarray(build_page.valid_mask()) if (build_page.capacity
+                                                     and exact_ok) else \
         np.zeros((0,), bool)
-    if not bvalid.any():
-        return lambda: iter(())  # empty build: no probe row can match
+    nonempty = bvalid.any() if exact_ok else (
+        build_page.capacity > 0 and bool(jnp.any(build_page.valid_mask())))
+    if not nonempty:
+        return (lambda: iter(())), ()  # empty build: no probe row can match
     from ..spi.predicate import UNION_LIMIT, Domain, Range
     from ..sql.domain_translator import domain_to_split_pruner
 
     domains = {}
+    # large build sides never yield an exact value set (UNION_LIMIT), so don't
+    # pull megabyte columns across the tunnel to discover that: compute the
+    # min/max span ON DEVICE and sync two scalars per key instead (reference:
+    # DynamicFilterSourceOperator's value-set -> min/max fallback at its size
+    # limits, applied before the device->host hop rather than after)
+    span_stats, span_cols = [], []
     for pch, bch in zip(node.left_keys, node.right_keys):
         col = si.columns[pch] if pch < len(si.columns) else None
         if col is None:
@@ -1808,44 +2148,66 @@ def _dynamic_pruned_pages(probe_stream: _Stream, node, build_page: Page):
         f = node.right.schema.fields[bch]
         if f.type.is_string or f.type.is_floating:
             continue
-        vals = np.asarray(build_page.columns[bch])[bvalid]
-        nm = build_page.null_masks[bch]
-        if nm is not None:
-            vals = vals[~np.asarray(nm)[bvalid]]
-        if len(vals) == 0:
-            continue
-        # small build sides collect an exact discrete domain, large ones the
-        # min/max span (reference: DynamicFilterSourceOperator's value-set ->
-        # min/max fallback at its size limits)
-        uniq = np.unique(vals)
-        if len(uniq) <= UNION_LIMIT:
-            domains[col] = Domain.multiple_values([int(v) for v in uniq])
+        if exact_ok:
+            vals = np.asarray(build_page.columns[bch])[bvalid]
+            nm = build_page.null_masks[bch]
+            if nm is not None:
+                vals = vals[~np.asarray(nm)[bvalid]]
+            if len(vals) == 0:
+                continue
+            uniq = np.unique(vals)
+            if len(uniq) <= UNION_LIMIT:
+                domains[col] = Domain.multiple_values([int(v) for v in uniq])
+            else:
+                domains[col] = Domain.from_range(
+                    Range.between(int(vals.min()), int(vals.max())))
         else:
-            domains[col] = Domain.from_range(
-                Range.between(int(vals.min()), int(vals.max())))
+            c = build_page.columns[bch]
+            live = build_page.valid_mask()
+            nm = build_page.null_masks[bch]
+            if nm is not None:
+                live = live & ~nm
+            c64 = c.astype(jnp.int64)
+            imax, imin = jnp.iinfo(jnp.int64).max, jnp.iinfo(jnp.int64).min
+            span_stats.extend([jnp.min(jnp.where(live, c64, imax)),
+                               jnp.max(jnp.where(live, c64, imin)),
+                               jnp.any(live)])
+            span_cols.append(col)
+    if span_cols:
+        got = _host(span_stats)
+        for i, col in enumerate(span_cols):
+            lo, hi, any_live = (int(got[3 * i]), int(got[3 * i + 1]),
+                                bool(got[3 * i + 2]))
+            if any_live:
+                domains[col] = Domain.from_range(Range.between(lo, hi))
     if not domains:
         return None
     keep = domain_to_split_pruner(domains, si.conn)
-    conn, splits, scan_cols = si.conn, si.splits, si.scan_columns
+    conn, scan_cols = si.conn, si.scan_columns
+    kept = tuple(s for s in si.splits if keep(s))
 
     def pages():
-        for s in splits:
-            if keep(s):
-                yield conn.generate(s, list(scan_cols))
+        for s in kept:
+            yield conn.generate(s, list(scan_cols))
 
-    return pages
+    return pages, kept
 
 
 def _build_null_stats(build_page: Page, key_channels):
-    """(build_has_null_key, build_nonempty) — host-side, for null-aware anti joins."""
-    valid = np.asarray(build_page.valid_mask()) if build_page.capacity else \
-        np.zeros((0,), bool)
-    nonempty = bool(valid.any())
-    has_null = False
+    """(build_has_null_key, build_nonempty) for null-aware anti joins — device
+    reductions, ONE batched scalar sync (pulling capacity-sized masks to host
+    costs megabytes over a tunneled link)."""
+    if build_page.capacity == 0:
+        return False, False
+    valid = build_page.valid_mask()
+    stats = [jnp.any(valid)]
     for ch in key_channels:
         nm = build_page.null_masks[ch]
-        if nm is not None and bool((np.asarray(nm) & valid).any()):
-            has_null = True
+        if nm is not None:
+            stats.append(jnp.any(nm & valid))
+    got = _host(stats)
+    nonempty = bool(got[0])
+    has_null = any(bool(x) for x in got[1:])
     return has_null, nonempty
 
 
