@@ -1,0 +1,147 @@
+"""Distribution beyond the TPC-H generator (VERDICT r3 missing #2): TPC-DS
+traced scans and HOST-FED scans (memory/parquet connectors: coordinator-side
+split queues decoded into stacked fixed-shape batches) shard across the mesh,
+and the executor's fragment-mode trace makes every fallback visible
+(reference: SourcePartitionedScheduler.java:55 scheduling any connector's
+splits; sql/planner/planprinter fragment output)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+
+from trino_tpu import Engine
+from trino_tpu.parallel.mesh import worker_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    return worker_mesh(8)
+
+
+def _frames_equal(a: pd.DataFrame, b: pd.DataFrame):
+    assert len(a) == len(b)
+    for ca, cb in zip(a.columns, b.columns):
+        ga, gb = a[ca].to_numpy(), b[cb].to_numpy()
+        if ga.dtype == object or gb.dtype == object:
+            assert list(ga) == list(gb), ca
+        else:
+            np.testing.assert_allclose(ga.astype(np.float64),
+                                       gb.astype(np.float64), rtol=1e-12,
+                                       err_msg=ca)
+
+
+@pytest.fixture(scope="module")
+def ds_engine():
+    from trino_tpu.connectors.tpcds import TpcdsConnector
+
+    e = Engine()
+    e.register_catalog("tpcds", TpcdsConnector(sf=0.01, split_rows=1 << 13))
+    return e, e.create_session("tpcds")
+
+
+def test_tpcds_star_distributed(ds_engine, mesh8):
+    e, s = ds_engine
+    sql = ("select i_category, sum(ss_ext_sales_price) rev, count(*) c "
+           "from store_sales, date_dim, item "
+           "where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk "
+           "and d_year = 2000 group by i_category order by rev desc, i_category")
+    local = e.execute_sql(sql, s).to_pandas()
+    dist = e.execute_sql(sql, s, distributed=True, mesh=mesh8).to_pandas()
+    _frames_equal(dist, local)
+
+
+def test_tpcds_global_agg_distributed(ds_engine, mesh8):
+    e, s = ds_engine
+    sql = ("select count(*) c, sum(ss_quantity) q from store_sales "
+           "where ss_quantity between 1 and 50")
+    local = e.execute_sql(sql, s).to_pandas()
+    dist = e.execute_sql(sql, s, distributed=True, mesh=mesh8).to_pandas()
+    _frames_equal(dist, local)
+
+
+@pytest.fixture(scope="module")
+def mem_engine():
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    e = Engine()
+    mem = MemoryConnector()
+    e.register_catalog("mem", mem)
+    s = e.create_session("mem")
+    e.execute_sql("create table t (k bigint, v double, tag varchar)", s)
+    rng = np.random.default_rng(7)
+    n = 30000
+    ks = (rng.integers(0, 251, n)).tolist()
+    vs = np.round(rng.uniform(0, 1000, n), 3).tolist()
+    tags = [f"tag{int(x) % 7}" for x in ks]
+    mem.append("t", [ks, vs, tags])
+    return e, s
+
+
+def test_memory_hostfed_groupby(mem_engine, mesh8):
+    e, s = mem_engine
+    sql = ("select k, sum(v) sv, count(*) c from t "
+           "group by k order by k")
+    local = e.execute_sql(sql, s).to_pandas()
+    dist = e.execute_sql(sql, s, distributed=True, mesh=mesh8).to_pandas()
+    _frames_equal(dist, local)
+
+
+def test_memory_hostfed_filter_topn(mem_engine, mesh8):
+    e, s = mem_engine
+    sql = ("select k, v from t where v > 500 "
+           "order by v desc, k limit 25")
+    local = e.execute_sql(sql, s).to_pandas()
+    dist = e.execute_sql(sql, s, distributed=True, mesh=mesh8).to_pandas()
+    _frames_equal(dist, local)
+
+
+def test_parquet_hostfed_distributed(tmp_path_factory, mesh8):
+    from trino_tpu.connectors.parquet import ParquetConnector
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    d = tmp_path_factory.mktemp("pq_dist")
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.01, split_rows=1 << 13))
+    e.register_catalog("pq", ParquetConnector(str(d)))
+    s = e.create_session("pq")
+    e.execute_sql("create table po as select o_custkey, o_totalprice, "
+                  "o_orderkey from tpch.orders", s)
+    sql = ("select o_custkey, sum(o_totalprice) sp, count(*) c from po "
+           "group by o_custkey order by o_custkey limit 40")
+    local = e.execute_sql(sql, s).to_pandas()
+    dist = e.execute_sql(sql, s, distributed=True, mesh=mesh8).to_pandas()
+    _frames_equal(dist, local)
+
+
+def test_exec_trace_reports_modes(mem_engine, mesh8):
+    """EXPLAIN ANALYZE on a distributed run prints each fragment's actual
+    execution mode with fallback reasons (no silent fallback)."""
+    e, s = mem_engine
+    r = e.execute_sql("explain analyze select k, sum(v) sv from t "
+                      "group by k order by k", s,
+                      distributed=True, mesh=mesh8)
+    text = "\n".join(r.columns[0].tolist())
+    assert "Fragment execution (distributed run):" in text
+    assert "[mesh] Aggregate" in text
+
+
+def test_north_star_no_unintended_fallback(mesh8):
+    """The north-star TPC-H suite must distribute its aggregation fragments on
+    the mesh — zero 'local' modes in the trace (VERDICT r3 item 4)."""
+    from trino_tpu.exec.distributed import DistributedExecutor
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.sql.frontend import compile_sql
+    import __graft_entry__ as G
+
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.001, split_rows=1 << 12))
+    s = e.create_session("tpch")
+    for sql in (G.Q1, G.Q9, G.Q18):
+        ex = DistributedExecutor(e.catalogs, mesh=mesh8)
+        ex.execute(compile_sql(sql, e, s))
+        local_modes = [t for t in ex.exec_trace if t[1] == "local"]
+        assert not local_modes, (sql[:60], local_modes)
+        assert any(t[1] == "mesh" for t in ex.exec_trace), sql[:60]

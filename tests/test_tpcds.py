@@ -35,7 +35,10 @@ def host(eng):
             parts = []
             for sp in conn.splits(t):
                 pg = conn.generate(sp, [f.name])
-                parts.append(np.asarray(pg.column(f.name)))
+                a = np.asarray(pg.column(f.name))
+                if pg.valid is not None:  # uniform splits mask the overshoot
+                    a = a[np.asarray(pg.valid_mask())]
+                parts.append(a)
             arr = np.concatenate(parts)
             d = dicts.get(f.name)
             if d is not None:
@@ -309,8 +312,13 @@ def host2(eng):
         dicts = conn.dictionaries(t)
         cols = {}
         for name in names:
-            parts = [np.asarray(conn.generate(sp, [name]).column(name))
-                     for sp in conn.splits(t)]
+            parts = []
+            for sp in conn.splits(t):
+                pg = conn.generate(sp, [name])
+                a = np.asarray(pg.column(name))
+                if pg.valid is not None:  # uniform splits mask the overshoot
+                    a = a[np.asarray(pg.valid_mask())]
+                parts.append(a)
             arr = np.concatenate(parts)
             if dicts.get(name) is not None:
                 arr = dicts[name].decode(arr)
@@ -416,8 +424,13 @@ def host3(eng):
         dicts = conn.dictionaries(t)
         cols = {}
         for name in names:
-            parts = [np.asarray(conn.generate(sp, [name]).column(name))
-                     for sp in conn.splits(t)]
+            parts = []
+            for sp in conn.splits(t):
+                pg = conn.generate(sp, [name])
+                a = np.asarray(pg.column(name))
+                if pg.valid is not None:  # uniform splits mask the overshoot
+                    a = a[np.asarray(pg.valid_mask())]
+                parts.append(a)
             arr = np.concatenate(parts)
             if dicts.get(name) is not None:
                 arr = dicts[name].decode(arr)
@@ -515,8 +528,13 @@ def test_q26_catalog_demographics(eng):
         dicts = conn.dictionaries(t)
         cols = {}
         for name in names:
-            parts = [np.asarray(conn.generate(sp, [name]).column(name))
-                     for sp in conn.splits(t)]
+            parts = []
+            for sp in conn.splits(t):
+                pg = conn.generate(sp, [name])
+                a = np.asarray(pg.column(name))
+                if pg.valid is not None:  # uniform splits mask the overshoot
+                    a = a[np.asarray(pg.valid_mask())]
+                parts.append(a)
             arr = np.concatenate(parts)
             if dicts.get(name) is not None:
                 arr = dicts[name].decode(arr)

@@ -1264,10 +1264,17 @@ class TpcdsConnector:
         return (None, None)
 
     def splits(self, table: str, n_hint: int = 0):
+        """Equal-size split ranges (one XLA shape class per table scan; the
+        trailing overshoot past ``row_count`` is masked via the page's valid
+        mask — same contract as the TPC-H connector, which is what lets the
+        scan-fused and shard_map paths drive every split through one traced
+        program)."""
         n = self.row_count(table)
         step = min(self.split_rows, max(n, 1))
         nsplits = -(-n // step)
-        return [TpcdsSplit(table, s * step, min((s + 1) * step, n))
+        if n_hint:
+            nsplits = -(-nsplits // n_hint) * n_hint  # multiple of SPMD batch
+        return [TpcdsSplit(table, s * step, (s + 1) * step)
                 for s in range(nsplits)]
 
     def split_range(self, split: TpcdsSplit, column: str):
@@ -1281,17 +1288,32 @@ class TpcdsConnector:
         schema = SCHEMAS[split.table]
         names = tuple(columns) if columns is not None else schema.names
         length = split.hi - split.lo
-        cols = _jit_generate(split.table, self.sf, split.lo, length, names)
+        n = self.row_count(split.table)
+        cols, valid = _jit_generate(split.table, self.sf, split.lo, length,
+                                    names, n if split.hi > n else 0)
         out_schema = Schema(tuple(schema.field(c) for c in names))
-        return Page(out_schema, cols, tuple(None for _ in cols), None)
+        return Page(out_schema, cols, tuple(None for _ in cols), valid)
+
+    def generate_traced(self, table: str, lo, length: int, columns):
+        """Trace-time generation with traced ``lo`` and static ``length`` (the
+        scan-fused / in-shard_map sharded scan contract shared with
+        TpchConnector.generate_traced): returns (cols tuple, valid)."""
+        all_cols = GENERATORS[table](self.sf, lo, length)
+        schema = SCHEMAS[table]
+        cols = tuple(all_cols[c].astype(schema.field(c).type.dtype)
+                     for c in columns)
+        valid = (jnp.arange(length, dtype=jnp.int64) + lo) < self.row_count(table)
+        return cols, valid
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
-def _jit_generate(table: str, sf: float, lo: int, length: int, names: tuple):
+@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+def _jit_generate(table: str, sf: float, lo: int, length: int, names: tuple,
+                  n: int = 0):
     all_cols = GENERATORS[table](sf, lo, length)
     schema = SCHEMAS[table]
     out = []
     for c in names:
         v = all_cols[c]
         out.append(v.astype(schema.field(c).type.dtype))
-    return tuple(out)
+    valid = None if n == 0 else (jnp.arange(length, dtype=jnp.int64) + lo) < n
+    return tuple(out), valid
