@@ -128,6 +128,37 @@ def test_exec_trace_reports_modes(mem_engine, mesh8):
     assert "[mesh] Aggregate" in text
 
 
+def test_rollup_distributes_per_branch(mesh8):
+    """Grouping sets plan to a Union of aggregate branches; each branch must
+    run on the mesh with the union gathered on the coordinator."""
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.exec.distributed import DistributedExecutor
+    from trino_tpu.sql.frontend import compile_sql
+
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.005, split_rows=1 << 12))
+    s = e.create_session("tpch")
+    sql = ("select l_returnflag, l_linestatus, sum(l_quantity) q, count(*) c "
+           "from lineitem group by rollup (l_returnflag, l_linestatus) "
+           "order by l_returnflag, l_linestatus")
+    local = e.execute_sql(sql, s).to_pandas()
+    ex = DistributedExecutor(e.catalogs, mesh=mesh8)
+    from trino_tpu.exec.local_executor import _sort_page  # noqa: F401 (plan shape doc)
+    dist = e.execute_sql(sql, s, distributed=True, mesh=mesh8).to_pandas()
+    assert local.shape == dist.shape
+    for c in local.columns:
+        a, b = local[c], dist[c]
+        try:
+            np.testing.assert_allclose(a.astype(float), b.astype(float))
+        except (ValueError, TypeError):
+            assert a.fillna("~").tolist() == b.fillna("~").tolist()
+    # trace: every aggregate branch on the mesh, union gathered
+    ex.execute(compile_sql(sql, e, s))
+    agg_modes = [m for label, m, _ in ex.exec_trace if label == "Aggregate"]
+    assert agg_modes and all(m == "mesh" for m in agg_modes)
+    assert ("Union", "coordinator") in [(l, m) for l, m, _ in ex.exec_trace]
+
+
 def test_north_star_no_unintended_fallback(mesh8):
     """The north-star TPC-H suite must distribute its aggregation fragments on
     the mesh — zero 'local' modes in the trace (VERDICT r3 item 4)."""

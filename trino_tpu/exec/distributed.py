@@ -501,6 +501,31 @@ class DistributedExecutor:
             return _limit_page(child, node.count), dicts
         if isinstance(node, P.Aggregate):
             return self._run_aggregate(node)
+        if isinstance(node, P.Union):
+            # grouping sets (and set-op ALL) plan to a Union of aggregate
+            # branches: run EACH branch distributed, gather the (small,
+            # post-agg) pages on the coordinator — each grouping set is its
+            # own aggregation stage in the reference too (grouping-set plans
+            # via MarkDistinct/GroupId stages; the union edge is a gather)
+            parts = [self._execute_to_page(c) for c in node.inputs]
+            self._trace(node, "coordinator", "gather of distributed branches")
+            cols_list, nulls_list = [], []
+            for pg, _ in parts:
+                v = np.asarray(pg.valid_mask())
+                cols_list.append([np.asarray(c)[v] for c in pg.columns])
+                nulls_list.append([None if m is None else np.asarray(m)[v]
+                                   for m in pg.null_masks])
+            ncols = len(node.schema.fields)
+            out_cols = tuple(np.concatenate([p[i] for p in cols_list])
+                             for i in range(ncols))
+            out_nulls = tuple(
+                np.concatenate([
+                    n[i] if n[i] is not None else np.zeros(len(c[i]), bool)
+                    for n, c in zip(nulls_list, cols_list)])
+                if any(n[i] is not None for n in nulls_list) else None
+                for i in range(ncols))
+            return (Page(node.schema, out_cols, out_nulls, None),
+                    parts[0][1])
 
         def once(node=node):
             stream = self._compile_stream(node)
