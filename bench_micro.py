@@ -171,6 +171,56 @@ def bench_compact(n):
     return _timeit(run, col, valid)
 
 
+def bench_exchange_stream_vs_spool(n):
+    """Inter-process exchange latency: one fragment-output envelope handed
+    producer->consumer through the STREAMING buffer endpoint (in-memory,
+    long-poll + token ack) vs the spooled filesystem exchange.  Prints its own
+    line with both numbers; returns None (not a rows/sec kernel)."""
+    import tempfile
+
+    from trino_tpu.exec.fte import (SpoolingExchange,
+                                    deserialize_fragment_output,
+                                    serialize_fragment_output)
+    from trino_tpu.server.cluster import _OutputBuffer
+
+    rng = np.random.default_rng(0)
+    nrows = min(n, 1 << 20)
+    cols = [rng.integers(0, 1 << 40, nrows), rng.random(nrows)]
+    env = serialize_fragment_output(cols, [None, None], (None, None))
+
+    def via_spool():
+        with tempfile.TemporaryDirectory() as d:
+            ex = SpoolingExchange(d)
+            ex.commit("t0", 0, env)
+            return deserialize_fragment_output(ex.read("t0"))
+
+    def via_stream():
+        buf = _OutputBuffer()
+        buf.add(env)
+        buf.finish()
+        out, _, _ = buf.get(0, max_wait=0.1)
+        assert buf.get(1, max_wait=0.01)[1]  # ack + complete
+        return deserialize_fragment_output(out)
+
+    def med(fn, runs=7):
+        fn()
+        ts = []
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
+
+    t_spool, t_stream = med(via_spool), med(via_stream)
+    print(json.dumps({
+        "kernel": "exchange_stream_vs_spool", "rows": nrows,
+        "spool_ms": round(t_spool * 1000, 3),
+        "stream_ms": round(t_stream * 1000, 3),
+        "stream_speedup": round(t_spool / t_stream, 2),
+    }), flush=True)
+    return None
+
+
 KERNELS = {
     "hashagg_insert": bench_hashagg_insert,
     "join_build": bench_join_build,
@@ -179,6 +229,7 @@ KERNELS = {
     "sort": bench_sort,
     "window_scan": bench_window_scan,
     "compact": bench_compact,
+    "exchange_stream_vs_spool": bench_exchange_stream_vs_spool,
 }
 
 

@@ -1,0 +1,195 @@
+"""Streaming (pipelined) inter-process exchange: in-memory worker output
+buffers with long-poll + token-ack reads replace the spool for nested
+single-task fragments (reference: operator/HttpPageBufferClient.java:100,
+server/TaskResource.java:331-383, execution/buffer/PartitionedOutputBuffer),
+and the worker executes fragments CONCURRENTLY from an executor pool
+(reference: execution/executor/TaskExecutor.java — round-3 VERDICT items 5/6).
+"""
+
+import json
+import os
+import pathlib
+import pickle
+import subprocess
+import sys
+import time
+
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.exec.fte import SpoolingExchange, deserialize_fragment_output
+from trino_tpu.server.cluster import (ClusterCoordinator, WorkerServer,
+                                      _OutputBuffer, _http,
+                                      stream_task_pages)
+
+CATALOGS = {"tpch": {"connector": "tpch", "sf": 0.01, "split_rows": 1 << 11}}
+
+
+def _engine():
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.01, split_rows=1 << 11))
+    return e
+
+
+# --------------------------------------------------------------- buffer unit
+def test_output_buffer_token_ack_frees_memory():
+    buf = _OutputBuffer(max_bytes=100)
+    buf.add(b"x" * 40)
+    buf.add(b"y" * 40)
+    page, complete, failed = buf.get(0, max_wait=0.1)
+    assert page == b"x" * 40 and not complete and not failed
+    # token 1 acknowledges page 0: its bytes free, page 1 served
+    page, complete, _ = buf.get(1, max_wait=0.1)
+    assert page == b"y" * 40
+    assert buf.bytes == 40
+    buf.finish()
+    page, complete, _ = buf.get(2, max_wait=0.1)
+    assert page is None and complete
+
+
+def test_output_buffer_backpressures_producer():
+    import threading
+
+    buf = _OutputBuffer(max_bytes=50)
+    buf.add(b"a" * 40)
+    state = {"second_added": False}
+
+    def producer():
+        buf.add(b"b" * 40)  # blocks: 80 > 50 with unacked page 0
+        state["second_added"] = True
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert not state["second_added"], "producer must block while full"
+    buf.get(1, max_wait=0.1)  # ack page 0 -> frees 40 bytes
+    t.join(timeout=2)
+    assert state["second_added"]
+
+
+def test_output_buffer_failure_propagates():
+    buf = _OutputBuffer()
+    buf.fail("boom: exploded")
+    page, complete, failed = buf.get(0, max_wait=0.1)
+    assert failed and "boom" in failed
+
+
+# ------------------------------------------------- worker protocol (in-proc)
+def test_streaming_task_roundtrip_no_disk(tmp_path):
+    """A fragment task with streaming output serves its pages over the
+    long-poll endpoint and never writes a spool file."""
+    e = _engine()
+    w = WorkerServer(CATALOGS, str(tmp_path / "spool"))
+    url = w.start()
+    try:
+        from trino_tpu.sql.frontend import compile_sql
+
+        plan = compile_sql(
+            "select o_orderkey, o_totalprice from orders "
+            "order by o_totalprice desc limit 7",
+            e, e.create_session("tpch"))
+        xdir = str(tmp_path / "x")
+        _http(f"{url}/v1/fragment",
+              pickle.dumps({"fragment_id": "f1", "plan": plan}))
+        _http(f"{url}/v1/task",
+              pickle.dumps({"task_id": "t_stream", "fragment_id": "f1",
+                            "kind": "fragment", "exchange_dir": xdir,
+                            "output": "stream"}))
+        chunks = list(stream_task_pages(url, "t_stream", timeout=60))
+        assert len(chunks) == 1
+        cols, nulls, dicts = deserialize_fragment_output(chunks[0])
+        assert len(cols[0]) == 7
+        assert not SpoolingExchange(xdir).is_committed("t_stream")
+        # buffer is dropped after complete delivery
+        time.sleep(0.1)
+        assert "t_stream" not in w.out_buffers
+    finally:
+        w.stop()
+
+
+def test_worker_concurrent_fragments(tmp_path):
+    """Two fragment tasks overlap on one worker (executor pool replaced the
+    round-3 global execution lock); peak_concurrency observes it."""
+    e = _engine()
+    w = WorkerServer(CATALOGS, str(tmp_path / "spool"))
+    url = w.start()
+    try:
+        from trino_tpu.sql.frontend import compile_sql
+
+        sql = ("select l_orderkey, sum(l_extendedprice * (1 - l_discount)) r "
+               "from lineitem, orders where l_orderkey = o_orderkey "
+               "group by l_orderkey order by r desc limit 5")
+        plan = compile_sql(sql, e, e.create_session("tpch"))
+        xdir = str(tmp_path / "x")
+        _http(f"{url}/v1/fragment",
+              pickle.dumps({"fragment_id": "fc", "plan": plan}))
+        for tid in ("c1", "c2"):
+            _http(f"{url}/v1/task",
+                  pickle.dumps({"task_id": tid, "fragment_id": "fc",
+                                "kind": "fragment", "exchange_dir": xdir}))
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            states = [json.loads(_http(f"{url}/v1/task/{tid}")).get("state")
+                      for tid in ("c1", "c2")]
+            if all(s == "done" for s in states):
+                break
+            assert "failed" not in states, states
+            time.sleep(0.1)
+        else:
+            raise AssertionError(f"tasks did not finish: {states}")
+        info = json.loads(_http(f"{url}/v1/info"))
+        assert info["peak_concurrency"] >= 2, info
+        ex = SpoolingExchange(xdir)
+        a = deserialize_fragment_output(ex.read("c1"))
+        b = deserialize_fragment_output(ex.read("c2"))
+        assert [list(c) for c in a[0]] == [list(c) for c in b[0]]
+    finally:
+        w.stop()
+
+
+# ------------------------------------------- cluster plane (OS processes)
+def _spawn_worker(tmp_path, coord_url, node_id):
+    env = dict(os.environ)
+    env["TRINO_TPU_WORKER_CPU"] = "1"
+    repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-m", "trino_tpu.server.cluster",
+         "--coordinator", coord_url, "--catalogs", json.dumps(CATALOGS),
+         "--spool", str(tmp_path / "spool"), "--node-id", node_id],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+
+
+@pytest.mark.slow
+def test_streaming_exchange_worker_to_worker(tmp_path):
+    """A join build side (and the whole nested single-task fragment chain)
+    streams worker->worker through in-memory buffers — no spool files for the
+    streamed producers — and the result matches local execution."""
+    e = _engine()
+    coord = ClusterCoordinator(e, str(tmp_path / "spool"),
+                               heartbeat_interval=0.3)
+    assert coord.stream_exchange  # pipelined plane is the default
+    url = coord.start()
+    w1 = w2 = None
+    sql = """select a.k, a.s, b.c_name from
+             (select o_custkey k, sum(o_totalprice) s from orders
+              group by o_custkey) a,
+             (select c_custkey, c_name, c_acctbal from customer
+              order by c_acctbal desc, c_custkey limit 50) b
+             where a.k = b.c_custkey order by a.s desc, a.k limit 10"""
+    try:
+        w1 = _spawn_worker(tmp_path, url, "w1")
+        w2 = _spawn_worker(tmp_path, url, "w2")
+        coord.wait_for_workers(2, timeout=60)
+        expected = e.execute_sql(sql).rows()
+        got = coord.execute_sql(sql).rows()
+        assert got == expected
+        assert coord.streamed_tasks >= 1, \
+            "no fragment streamed (pipelined plane did not engage)"
+    finally:
+        coord.stop()
+        for w in (w1, w2):
+            if w is not None:
+                w.terminate()
+                w.wait(timeout=10)

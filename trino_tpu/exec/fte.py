@@ -50,12 +50,58 @@ _MERGE_KIND = {"sum": "sum", "count": "sum", "count_star": "sum",
 
 _MAGIC = b"TTPG"
 
+# Exchange codec (reference: execution/buffer/CompressionCodec.java:23 —
+# NONE/LZ4/ZSTD; LZ4 is not in this environment, so ZSTD level 1 is the fast
+# default) and optional authenticated encryption for pages that cross a shared
+# filesystem or the wire (reference:
+# CompressingEncryptingPageSerializer.java:58, AES — here AES-128/256-GCM,
+# which also authenticates; the frame CRC covers the ciphertext).  The key
+# comes from TRINO_TPU_EXCHANGE_KEY (hex, 16/24/32 bytes) — the cluster-secret
+# model, like internal-communication.shared-secret.
+_CODECS = {"none": 0, "zlib": 1, "zstd": 2}
+_ENC_FLAG = 0x80
+PAGE_CODEC = os.environ.get("TRINO_TPU_PAGE_CODEC", "zstd")
+if PAGE_CODEC not in _CODECS:  # pragma: no cover - config error
+    raise ValueError(f"TRINO_TPU_PAGE_CODEC must be one of {sorted(_CODECS)}")
+
+
+def _exchange_key():
+    h = os.environ.get("TRINO_TPU_EXCHANGE_KEY")
+    if not h:
+        return None
+    key = bytes.fromhex(h)
+    if len(key) not in (16, 24, 32):
+        raise ValueError("TRINO_TPU_EXCHANGE_KEY must be 16/24/32 hex bytes")
+    return key
+
+
+def _compress(payload: bytes, codec: int) -> bytes:
+    if codec == 1:
+        return zlib.compress(payload, 1)
+    if codec == 2:
+        import zstandard
+
+        return zstandard.ZstdCompressor(level=1).compress(payload)
+    return payload
+
+
+def _decompress(payload: bytes, codec: int) -> bytes:
+    if codec == 1:
+        return zlib.decompress(payload)
+    if codec == 2:
+        import zstandard
+
+        return zstandard.ZstdDecompressor().decompress(payload)
+    return payload
+
 
 # ---------------------------------------------------------------------------- page serde
-def serialize_page(columns: list, null_masks: list, compress: bool = True) -> bytes:
-    """Framed page wire format: magic, codec flag, CRC32, length, npz payload
-    (reference: PagesSerdeUtil.java:47 header + XXH64 checksum :84 with LZ4/ZSTD;
-    zlib is the in-tree codec here)."""
+def serialize_page(columns: list, null_masks: list,
+                   compress: bool = True) -> bytes:
+    """Framed page wire format: magic, codec byte (low bits: NONE/ZLIB/ZSTD,
+    high bit: AES-GCM encrypted), CRC32, length, npz payload (reference:
+    PagesSerdeUtil.java:47 header + XXH64 checksum :84 with LZ4/ZSTD +
+    optional AES, CompressingEncryptingPageSerializer.java:58)."""
     buf = io.BytesIO()
     arrays = {}
     for i, c in enumerate(columns):
@@ -64,11 +110,21 @@ def serialize_page(columns: list, null_masks: list, compress: bool = True) -> by
             arrays[f"n{i}"] = np.asarray(null_masks[i])
     np.savez(buf, ncols=np.int64(len(columns)), **arrays)
     payload = buf.getvalue()
-    codec = 1 if compress else 0
-    if compress:
-        payload = zlib.compress(payload, 1)
+    codec = _CODECS[PAGE_CODEC] if compress else 0
+    payload = _compress(payload, codec)
+    flag = codec
+    key = _exchange_key()
+    if key is not None:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        nonce = os.urandom(12)
+        # the frame prefix is the AAD: a frame cannot be re-labelled as a
+        # different codec/flag without failing authentication
+        flag = codec | _ENC_FLAG
+        payload = nonce + AESGCM(key).encrypt(
+            nonce, payload, _MAGIC + bytes([flag]))
     crc = zlib.crc32(payload)
-    head = _MAGIC + bytes([codec]) + crc.to_bytes(4, "little") \
+    head = _MAGIC + bytes([flag]) + crc.to_bytes(4, "little") \
         + len(payload).to_bytes(8, "little")
     return head + payload
 
@@ -100,17 +156,27 @@ def deserialize_fragment_output(data: bytes):
 
 
 def deserialize_page(data: bytes):
-    """-> (columns, null_masks) as numpy arrays; raises on checksum mismatch."""
+    """-> (columns, null_masks) as numpy arrays; raises on checksum mismatch,
+    missing key, or failed AES-GCM authentication."""
     if data[:4] != _MAGIC:
         raise ValueError("bad page frame magic")
-    codec = data[4]
+    flag = data[4]
     crc = int.from_bytes(data[5:9], "little")
     length = int.from_bytes(data[9:17], "little")
     payload = data[17:17 + length]
     if zlib.crc32(payload) != crc:
         raise ValueError("page frame checksum mismatch")
-    if codec == 1:
-        payload = zlib.decompress(payload)
+    codec = flag & ~_ENC_FLAG
+    if flag & _ENC_FLAG:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        key = _exchange_key()
+        if key is None:
+            raise ValueError("page frame is encrypted but "
+                             "TRINO_TPU_EXCHANGE_KEY is not set")
+        payload = AESGCM(key).decrypt(payload[:12], payload[12:],
+                                      _MAGIC + bytes([flag]))
+    payload = _decompress(payload, codec)
     # allow_pickle: exact wide-decimal (object) columns serialize via pickle
     # inside the npz; the spool/exchange is trusted (local disk or the
     # HMAC-authenticated internal channel)
@@ -556,7 +622,8 @@ def _merge_partial_raw(node, key_types, acc_specs, payloads) -> bytes:
 
 
 def run_partial_aggregate(local: LocalExecutor, node, splits,
-                          exchange_dir: str = None) -> bytes:
+                          exchange_dir: str = None, stream_sources=None,
+                          fetch_stream=None) -> bytes:
     """Worker entry: compile the aggregation on this process's executor and run
     the partial task over ``splits``; the output envelope carries the group
     keys' dictionaries so the coordinator can merge without compiling the
@@ -566,7 +633,8 @@ def run_partial_aggregate(local: LocalExecutor, node, splits,
 
     saved = local._overrides
     if exchange_dir is not None:
-        local._overrides = resolve_remote_sources(exchange_dir, node)
+        local._overrides = resolve_remote_sources(exchange_dir, node,
+                                                  stream_sources, fetch_stream)
     try:
         stream, key_types, acc_specs, _, _, step = local._agg_compiled(node)
         data = run_partial_aggregate_splits(node, stream, key_types, acc_specs,
@@ -597,18 +665,49 @@ def read_fragment_outputs(exchange: SpoolingExchange, task_ids, schema):
     return padded_page(schema, cols, nulls), parts[0][2]
 
 
-def resolve_remote_sources(exchange_dir: str, node) -> dict:
+def read_streamed_outputs(fetch_stream, task_ids, schema):
+    """Gather a RemoteSource's output from the producing workers' STREAMING
+    buffers (reference: ExchangeOperator over HttpPageBufferClient — the
+    pipelined data plane) instead of the spool: ``fetch_stream(task_id)``
+    yields page envelopes as the producer emits them; chunks concatenate into
+    the same padded override page the spool path builds."""
+    from .spill import concat_host_chunks, padded_page
+
+    ncols = len(schema.fields)
+    parts = []
+    for t in task_ids:
+        for chunk in fetch_stream(t):
+            parts.append(deserialize_fragment_output(chunk))
+    if not parts:
+        cols = tuple(jnp.asarray(
+            np.empty((0,), np.dtype(f.type.dtype))) for f in schema.fields)
+        return (Page(schema, cols, tuple(None for _ in cols), None),
+                tuple(None for _ in range(ncols)))
+    cols, nulls = concat_host_chunks(schema, [(p[0], p[1]) for p in parts])
+    return padded_page(schema, cols, nulls), parts[0][2]
+
+
+def resolve_remote_sources(exchange_dir: str, node, stream_sources=None,
+                           fetch_stream=None) -> dict:
     """Overrides for every RemoteSource in the subtree: each one's task outputs
     are read from the spool and concatenated (reference: ExchangeOperator
-    reading the source stage's spooled output)."""
+    reading the source stage's spooled output) — or, when the task ids appear
+    in ``stream_sources``, fetched live from the producing worker's output
+    buffer via ``fetch_stream`` (the pipelined exchange; no disk touched)."""
     from ..sql.plan import RemoteSource
 
     overrides = {}
 
     def walk(n):
         if isinstance(n, RemoteSource):
-            ex = SpoolingExchange(exchange_dir)
-            overrides[id(n)] = read_fragment_outputs(ex, n.task_ids, n.schema)
+            if stream_sources and fetch_stream is not None \
+                    and all(t in stream_sources for t in n.task_ids):
+                overrides[id(n)] = read_streamed_outputs(
+                    fetch_stream, n.task_ids, n.schema)
+            else:
+                ex = SpoolingExchange(exchange_dir)
+                overrides[id(n)] = read_fragment_outputs(ex, n.task_ids,
+                                                         n.schema)
         for c in n.children:
             walk(c)
 
@@ -616,15 +715,18 @@ def resolve_remote_sources(exchange_dir: str, node) -> dict:
     return overrides
 
 
-def run_fragment(local: LocalExecutor, node, exchange_dir: str) -> bytes:
+def run_fragment(local: LocalExecutor, node, exchange_dir: str,
+                 stream_sources=None, fetch_stream=None) -> bytes:
     """Worker entry: execute a generic blocking fragment (sort, window, join,
     non-scan-fed aggregate...) whose RemoteSource leaves resolve from the
-    spool; returns the serialized output envelope.  Caller holds the worker's
-    execution lock (overrides are executor-global)."""
+    spool or from upstream streaming buffers; returns the serialized output
+    envelope.  The caller must hand this task its OWN executor (overrides are
+    executor-global)."""
     from .local_executor import _host_page
 
     saved = local._overrides
-    local._overrides = resolve_remote_sources(exchange_dir, node)
+    local._overrides = resolve_remote_sources(exchange_dir, node,
+                                              stream_sources, fetch_stream)
     try:
         page, dicts = local._execute_to_page(node)
     finally:
@@ -637,13 +739,18 @@ def run_fragment(local: LocalExecutor, node, exchange_dir: str) -> bytes:
 
 
 def run_stream_splits(local: LocalExecutor, node, exchange_dir: str,
-                      splits) -> bytes:
+                      splits, stream_sources=None, fetch_stream=None,
+                      sink=None) -> bytes:
     """Worker entry: run a STREAMING fragment (a join's probe pipeline) over a
     subset of its scan splits — the probe-side task shape (reference: one
     HttpRemoteTask per split batch through the fragment's pipeline).  Build
-    sides execute on this worker; spooled children resolve via overrides."""
+    sides execute on this worker; spooled children resolve via overrides.
+    With ``sink``, each split's surviving rows ship as their own envelope the
+    moment they exist (incremental page production into a streaming output
+    buffer) and the return value is empty."""
     saved = local._overrides
-    local._overrides = resolve_remote_sources(exchange_dir, node)
+    local._overrides = resolve_remote_sources(exchange_dir, node,
+                                              stream_sources, fetch_stream)
     try:
         stream = local._compile_stream(node)
         si = stream.scan_info
@@ -661,10 +768,15 @@ def run_stream_splits(local: LocalExecutor, node, exchange_dir: str,
             cnulls = []
             for n in nulls:
                 cnulls.append(None if n is None else rest.pop(0)[v])
-            parts.append((ccols, cnulls))
+            if sink is not None:
+                sink(serialize_fragment_output(ccols, cnulls, stream.dicts))
+            else:
+                parts.append((ccols, cnulls))
         dicts = stream.dicts
     finally:
         local._overrides = saved
+    if sink is not None:
+        return b""
     from .spill import concat_host_chunks
 
     cols, nulls = concat_host_chunks(stream.schema, parts)

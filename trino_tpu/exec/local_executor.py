@@ -41,7 +41,11 @@ from ..sql.ir import Call, Constant, Expr, FieldRef, evaluate, evaluate_predicat
 __all__ = ["LocalExecutor", "MaterializedResult"]
 
 DEFAULT_GROUP_CAPACITY = 1 << 16
-MAX_GROUP_CAPACITY = 1 << 24
+# ceiling sized for SF10-class group counts on one chip (15M distinct
+# orderkeys need 32M slots to keep the probe load factor sane; ~40B/slot keeps
+# the table under ~1.3GB of a 16GB-HBM budget — the memory pool still gates
+# the actual reservation)
+MAX_GROUP_CAPACITY = 1 << 25
 
 
 @dataclasses.dataclass
@@ -766,7 +770,7 @@ class LocalExecutor:
                 # here costs a full re-scan + recompile, so undershoot is the
                 # expensive direction
                 target = 1 << max(2 * est - 1, 1).bit_length()
-                capacity = max(capacity, min(target, 1 << 22))
+                capacity = max(capacity, min(target, 1 << 24))
         capacity = ceil_pow2(capacity)
         if not self.memory_pool.try_reserve(state_bytes(capacity), "group-by"):
             return self._run_aggregate_partitioned(node, parts=4)

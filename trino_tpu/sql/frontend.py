@@ -2334,6 +2334,50 @@ class _PostAggScope:
         if isinstance(ast, A.UnaryOp) and ast.op == "negate":
             e = self.translate(ast.operand)
             return ir.Call("negate", (e,), e.type)
+        if isinstance(ast, A.UnaryOp) and ast.op == "not":
+            return ir.Call("not", (self.translate(ast.operand),), BOOLEAN)
+        if isinstance(ast, A.Between):
+            # HAVING count(*) BETWEEN a AND b and friends: desugar over the
+            # translated aggregate channel
+            v = self.translate(ast.value)
+            lo, hi = self.translate(ast.low), self.translate(ast.high)
+            t = common_super_type(v.type, common_super_type(lo.type, hi.type))
+            cond = ir.Call("and", (
+                ir.Call("gte", (_coerce(v, t), _coerce(lo, t)), BOOLEAN),
+                ir.Call("lte", (_coerce(v, t), _coerce(hi, t)), BOOLEAN)),
+                BOOLEAN)
+            return ir.Call("not", (cond,), BOOLEAN) if ast.negated else cond
+        if isinstance(ast, A.InList):
+            v = self.translate(ast.value)
+            cond = None
+            for item in ast.items:
+                x = self.translate(item)
+                t = common_super_type(v.type, x.type)
+                eq = ir.Call("eq", (_coerce(v, t), _coerce(x, t)), BOOLEAN)
+                cond = eq if cond is None else ir.Call("or", (cond, eq),
+                                                       BOOLEAN)
+            if cond is None:
+                cond = ir.Constant(False, BOOLEAN)
+            return ir.Call("not", (cond,), BOOLEAN) if ast.negated else cond
+        if isinstance(ast, A.IsNull):
+            v = self.translate(ast.value)
+            cond = ir.Call("is_null", (v,), BOOLEAN)
+            return ir.Call("not", (cond,), BOOLEAN) if ast.negated else cond
+        if isinstance(ast, A.CaseExpr) and ast.operand is None:
+            whens = [(self.translate(c), self.translate(v))
+                     for c, v in ast.whens]
+            default = self.translate(ast.default) \
+                if ast.default is not None else None
+            t = whens[0][1].type
+            for _, v in whens[1:]:
+                t = common_super_type(t, v.type)
+            if default is not None:
+                t = common_super_type(t, default.type)
+            out = _coerce(default, t) if default is not None \
+                else ir.Constant(None, t)
+            for c, v in reversed(whens):
+                out = ir.Call("if", (c, _coerce(v, t), out), t)
+            return out
         if isinstance(ast, A.Cast):
             return _coerce(self.translate(ast.value), _type_from_name(ast.type_name, ast.params))
         if isinstance(ast, A.ScalarSubquery):
