@@ -102,11 +102,12 @@ def test_url_family(feng):
 
 
 def test_datetime_breadth(feng):
-    epoch = datetime.date(1970, 1, 1)
-    assert _one(feng, "last_day_of_month(d)") == \
-        (datetime.date(2024, 2, 29) - epoch).days
-    assert _one(feng, "last_day_of_month(d)", "n = 12") == \
-        (datetime.date(2021, 1, 31) - epoch).days
+    import pandas as pd
+
+    assert pd.Timestamp(_one(feng, "last_day_of_month(d)")) == \
+        pd.Timestamp("2024-02-29")
+    assert pd.Timestamp(_one(feng, "last_day_of_month(d)", "n = 12")) == \
+        pd.Timestamp("2021-01-31")
     # ISO week boundaries: 2021-01-01 is week 53 of ISO year 2020
     assert _one(feng, "week(d)", "n = 12") == 53
     assert _one(feng, "year_of_week(d)", "n = 12") == 2020
@@ -115,8 +116,8 @@ def test_datetime_breadth(feng):
     assert _one(feng, "yow(d)", "n = 255") == \
         datetime.date(2020, 12, 31).isocalendar()[0]
     assert _one(feng, "day_of_month(d)") == 29
-    assert _one(feng, "from_iso8601_date('2023-07-04')") == \
-        (datetime.date(2023, 7, 4) - epoch).days
+    assert pd.Timestamp(_one(feng, "from_iso8601_date('2023-07-04')")) == \
+        pd.Timestamp("2023-07-04")
 
 
 def test_show_functions_lists_new_families(feng):

@@ -47,10 +47,12 @@ def test_hive_partition_discovery_and_scan(hive_wh):
     assert r["id"].tolist() == [1, 2, 3, 4, 5, 6]
     assert r["region"].tolist() == ["emea", "emea", "apac", "emea", "emea",
                                     "emea"]
-    # ds inferred as DATE from the partition path strings (engine surface
-    # convention: dates are epoch days)
-    d1 = (datetime.date(2024, 1, 1) - datetime.date(1970, 1, 1)).days
-    assert r["ds"].tolist() == [d1, d1, d1, d1 + 1, d1 + 1, d1 + 1]
+    # ds inferred as DATE from the partition path strings; dates decode to
+    # datetime64 at the result surface
+    import pandas as pd
+
+    d1, d2 = pd.Timestamp("2024-01-01"), pd.Timestamp("2024-01-02")
+    assert [pd.Timestamp(v) for v in r["ds"]] == [d1, d1, d1, d2, d2, d2]
 
 
 def test_hive_partition_pruning_prunes_splits(hive_wh):
@@ -156,8 +158,9 @@ def test_delta_log_replay_and_scan(delta_wh):
                       s).to_pandas()
     # removed file's id=99 must NOT appear (log replay)
     assert r["id"].tolist() == [1, 2, 3]
-    d2 = (datetime.date(2024, 1, 2) - datetime.date(1970, 1, 1)).days
-    assert int(r["ds"].iloc[2]) == d2
+    import pandas as pd
+
+    assert pd.Timestamp(r["ds"].iloc[2]) == pd.Timestamp("2024-01-02")
 
 
 def test_delta_partition_and_stats_pruning(delta_wh):

@@ -10,6 +10,20 @@ from trino_tpu import Engine
 from trino_tpu.connectors.memory import MemoryConnector
 
 
+def _us(v):
+    """Decoded timestamp (pandas Timestamp / np.datetime64) -> epoch micros."""
+    import pandas as pd
+
+    return int(pd.Timestamp(v).value // 1000)
+
+
+def _days(v):
+    """Decoded date -> epoch days."""
+    import pandas as pd
+
+    return int(pd.Timestamp(v).value // (86_400 * 10**9))
+
+
 @pytest.fixture(scope="module")
 def teng():
     e = Engine()
@@ -37,14 +51,14 @@ def _micros(y, mo, d, h=0, mi=0, se=0, us=0):
 def test_timestamp_literal_storage_and_comparison(teng):
     e, s = teng
     r = e.execute_sql("select ts from ev where id = 1", s).to_pandas()
-    assert int(r.iloc[0, 0]) == _micros(2024, 3, 15, 10, 30, 45, 123456)
+    assert _us(r.iloc[0, 0]) == _micros(2024, 3, 15, 10, 30, 45, 123456)
     r = e.execute_sql(
         "select id from ev where ts > timestamp '2023-01-01 00:00:00'",
         s).to_pandas()
     assert r["id"].tolist() == [1]
     # pre-epoch timestamps stay exact
     r = e.execute_sql("select ts from ev where id = 3", s).to_pandas()
-    assert int(r.iloc[0, 0]) == -1_000_000
+    assert _us(r.iloc[0, 0]) == -1_000_000
 
 
 def test_timestamp_extract_parts(teng):
@@ -65,9 +79,9 @@ def test_timestamp_precision_cast_rescales(teng):
         "cast(ts as timestamp(0)) t0 from ev where id = 1", s).to_pandas()
     base = datetime.datetime(2024, 3, 15, 10, 30, 45)
     secs = round((base - datetime.datetime(1970, 1, 1)).total_seconds())
-    assert int(r["t3"].iloc[0]) == secs * 1000 + 123  # .123456 rounds to .123
-    assert int(r["t6"].iloc[0]) == (secs * 1000 + 123) * 1000
-    assert int(r["t0"].iloc[0]) == secs  # .123456 rounds down at p=0
+    assert _us(r["t3"].iloc[0]) == (secs * 1000 + 123) * 1000  # .123456 -> .123
+    assert _us(r["t6"].iloc[0]) == (secs * 1000 + 123) * 1000
+    assert _us(r["t0"].iloc[0]) == secs * 1_000_000  # rounds down at p=0
 
 
 def test_timestamp_date_casts(teng):
@@ -77,12 +91,12 @@ def test_timestamp_date_casts(teng):
         "cast(date '2024-03-15' as timestamp(6)) t from ev where id = 1",
         s).to_pandas()
     days = (datetime.date(2024, 3, 15) - datetime.date(1970, 1, 1)).days
-    assert int(r["d"].iloc[0]) == days
-    assert int(r["t"].iloc[0]) == days * 86400 * 1_000_000
+    assert _days(r["d"].iloc[0]) == days
+    assert _us(r["t"].iloc[0]) == days * 86400 * 1_000_000
     # pre-epoch: floor to the CIVIL day, not toward zero
     r = e.execute_sql("select cast(ts as date) d from ev where id = 3",
                       s).to_pandas()
-    assert int(r["d"].iloc[0]) == -1
+    assert _days(r["d"].iloc[0]) == -1
 
 
 def test_timestamp_group_and_order(teng):
@@ -115,7 +129,7 @@ def test_current_timestamp_is_sane(teng):
     now_us = round((datetime.datetime.now(datetime.timezone.utc)
                     .replace(tzinfo=None)
                     - datetime.datetime(1970, 1, 1)).total_seconds() * 1e6)
-    assert abs(int(r.iloc[0, 0]) - now_us) < 3600 * 1_000_000
+    assert abs(_us(r.iloc[0, 0]) - now_us) < 3600 * 1_000_000
 
 
 def test_pre_epoch_fractional_literal():
@@ -162,8 +176,8 @@ def test_timestamp_interval_arithmetic(teng):
         "select ts + interval '2' hour a, ts - interval '90' second b "
         "from ev where id = 2", s).to_pandas()
     base = _micros(2021, 1, 1)
-    assert int(r["a"].iloc[0]) == base + 2 * 3600 * 1_000_000
-    assert int(r["b"].iloc[0]) == base - 90 * 1_000_000
+    assert _us(r["a"].iloc[0]) == base + 2 * 3600 * 1_000_000
+    assert _us(r["b"].iloc[0]) == base - 90 * 1_000_000
     # comparison with shifted bounds
     r = e.execute_sql(
         "select id from ev where ts > timestamp '2021-01-01 00:00:00' "

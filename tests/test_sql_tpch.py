@@ -95,10 +95,10 @@ def test_q3(engine, tpch_pandas):
     got2 = got.drop(columns=["o_orderdate"])
     exp2 = exp.drop(columns=["o_orderdate"])
     assert_frames_close(got2, exp2, rtol=1e-9)
-    # dates come back as day-numbers; compare against epoch days
-    exp_days = (exp["o_orderdate"].to_numpy().astype("datetime64[D]")
-                - D("1970-01-01")).astype(np.int64)
-    np.testing.assert_array_equal(got["o_orderdate"].to_numpy().astype(np.int64), exp_days)
+    # dates decode to datetime64 at the result surface
+    np.testing.assert_array_equal(
+        got["o_orderdate"].to_numpy().astype("datetime64[D]"),
+        exp["o_orderdate"].to_numpy().astype("datetime64[D]"))
 
 
 def test_q5(engine, tpch_pandas):

@@ -50,8 +50,8 @@ def _arrow_to_type(at):
 
         return TIMESTAMP
     if pa.types.is_decimal(at):
-        if at.precision > 18:
-            raise ValueError(f"decimal precision {at.precision} > 18 not supported")
+        if at.precision > 38:
+            raise ValueError(f"decimal precision {at.precision} > 38 not supported")
         return DecimalType.of(at.precision, at.scale)
     if pa.types.is_string(at) or pa.types.is_large_string(at) or \
             pa.types.is_dictionary(at):
@@ -59,12 +59,16 @@ def _arrow_to_type(at):
     raise ValueError(f"unsupported parquet type {at}")
 
 
-def _decimal_int64(col, null_np) -> np.ndarray:
+def _decimal_int64(col, null_np, check_fit: bool = False) -> np.ndarray:
     """decimal128 arrow array -> scaled int64, straight from the buffer.
 
-    Arrow stores decimal128 as 16-byte little-endian two's-complement; for
-    precision <= 18 every value fits the LOW word, whose int64 view is already
+    Arrow stores decimal128 as 16-byte little-endian two's-complement; values
+    within +-2^63 live in the LOW word, whose int64 view is already
     sign-correct — one frombuffer + stride, no per-value Decimal objects.
+    With ``check_fit`` (declared precision > 18), the HIGH word must be the
+    low word's sign extension: wider actual values are rejected with a clear
+    error instead of silently truncating (declared decimal(38,x) columns are
+    supported for the int64 value domain; see DecimalType docstring).
     ``null_np`` is the caller's already-materialized null mask."""
     n = len(col)
     if n == 0:
@@ -74,6 +78,13 @@ def _decimal_int64(col, null_np) -> np.ndarray:
         return np.zeros(n, np.int64)
     words = np.frombuffer(buf, dtype=np.int64)
     lo = words[2 * col.offset:2 * (col.offset + n):2].copy()
+    if check_fit:
+        hi = words[2 * col.offset + 1:2 * (col.offset + n) + 1:2]
+        live = ~null_np
+        if not np.array_equal(hi[live], (lo >> 63)[live]):
+            raise ValueError(
+                "decimal value beyond 2^63: Int128 column storage is not "
+                "supported (declared wide precision is, for values that fit)")
     if null_np.any():
         lo[null_np] = 0
     return lo
@@ -227,7 +238,8 @@ class ParquetConnector:
             if f.type.is_string:
                 arr = self._decode_string_ids(t, n, col)
             elif isinstance(f.type, DecimalType):
-                arr = _decimal_int64(col, null_np)
+                arr = _decimal_int64(col, null_np,
+                                     check_fit=f.type.precision > 18)
             elif f.type.name == "date":
                 arr = np.asarray(col.cast("int32").fill_null(0)).astype(np.int32)
             else:

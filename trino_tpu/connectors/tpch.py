@@ -162,7 +162,11 @@ MFGRS = _enum(*[f"Manufacturer#{m}" for m in range(1, 6)])
 
 STARTDATE = parse_date_literal("1992-01-01")
 CURRENTDATE = parse_date_literal("1995-06-17")
-ENDDATE = parse_date_literal("1998-08-02")
+# spec 4.2.3: ENDDATE = 1998-12-31; o_orderdate spans [STARTDATE,
+# ENDDATE - 151] (max 1998-08-02).  Round-4 invariants caught ENDDATE set to
+# 1998-08-02 directly, which applied the -151 twice and compressed every
+# date-window selectivity (Q1's 90-day filter matched 100% of lineitem).
+ENDDATE = parse_date_literal("1998-12-31")
 
 # -- RNG ----------------------------------------------------------------------------------
 
@@ -326,7 +330,13 @@ def gen_lineitem(sf: float, order_lo, length: int, n: int = 0):
         "l_extendedprice": qty * _retailprice_raw(partkey),
         "l_discount": _uniform(27, uid, 0, 10),
         "l_tax": _uniform(28, uid, 0, 8),
-        "l_returnflag": jnp.where(returnable, _uniform(29, uid, 0, 1), 2).astype(jnp.int32),
+        # spec 4.2.3: receipt <= CURRENTDATE -> 'R' or 'A' (50/50), else 'N'
+        # (dict ids: A=0, N=1, R=2).  Round-4 invariants caught the previous
+        # mapping handing the returnable rows to {A, N} and the open rows to R
+        # — which fabricated an impossible R/O Q1 group (R needs receipt <=
+        # CURRENTDATE, O needs ship > it, and receipt is always after ship).
+        "l_returnflag": jnp.where(returnable, 2 * _uniform(29, uid, 0, 1),
+                                  1).astype(jnp.int32),
         "l_linestatus": jnp.where(shipdate > CURRENTDATE, 1, 0).astype(jnp.int32),
         "l_shipdate": shipdate.astype(jnp.int32),
         "l_commitdate": commitdate.astype(jnp.int32),

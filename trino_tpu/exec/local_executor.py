@@ -1735,11 +1735,11 @@ def _global_agg_update(state, cols, nulls, valid, acc_exprs, acc_kinds):
             out.append(st + jnp.sum(jnp.where(mask, vv * vv, 0),
                                     dtype=st.dtype))
         elif kind == "min":
-            out.append(jnp.minimum(st, jnp.min(
-                jnp.where(mask, v, hashagg._extreme(st.dtype, 1)))))
+            out.append(jnp.minimum(st, jnp.min(jnp.where(
+                mask, v, hashagg._extreme(st.dtype, 1))).astype(st.dtype)))
         elif kind == "max":
-            out.append(jnp.maximum(st, jnp.max(
-                jnp.where(mask, v, hashagg._extreme(st.dtype, -1)))))
+            out.append(jnp.maximum(st, jnp.max(jnp.where(
+                mask, v, hashagg._extreme(st.dtype, -1))).astype(st.dtype)))
         else:
             raise NotImplementedError(kind)
     return tuple(out)
@@ -2722,10 +2722,19 @@ def _materialize(page: Page, dicts) -> MaterializedResult:
         elif f.type.is_string and dicts[i] is not None:
             dec = dicts[i].decode(arr)
         else:
-            from ..types import ArrayType, MapType
+            from ..types import ArrayType, MapType, TimestampType
 
             if isinstance(f.type, (ArrayType, MapType)) and dicts[i] is not None:
                 dec = dicts[i].decode(arr)  # spans -> python lists / dicts
+            elif f.type.name == "date":
+                # epoch days -> date at the result surface (reference: client
+                # protocol returns DATE values, not their day encoding)
+                dec = arr.astype("datetime64[D]")
+            elif isinstance(f.type, TimestampType):
+                p = f.type.precision
+                dec = (arr * 10 ** (6 - p)).astype("datetime64[us]") \
+                    if p <= 6 else \
+                    (arr * 10 ** (9 - p)).astype("datetime64[ns]")
         if pnulls[i] is not None:
             nm = pnulls[i][valid]
             dec = np.array([None if m else v for v, m in zip(dec.tolist(), nm)], dtype=object) \

@@ -151,6 +151,10 @@ class Page:
             elif isinstance(f.type, (VarcharType, CharType)) and dictionaries and f.name in dictionaries:
                 d = dictionaries[f.name]
                 arr = d.decode(arr) if hasattr(d, "decode") else np.asarray(d)[arr]
+            elif f.type.name == "date":
+                # decode epoch days like the engine's result surface, so
+                # pandas oracles built from pages compare like-for-like
+                arr = arr.astype("datetime64[D]")
             if nulls is not None:
                 n = np.asarray(nulls)[valid]
                 arr = np.where(n, None, arr) if arr.dtype == object else np.ma.masked_array(arr, n)

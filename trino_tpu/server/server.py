@@ -65,6 +65,8 @@ def _json_value(v):
         return bool(v)
     if isinstance(v, np.str_):
         return str(v)
+    if isinstance(v, np.datetime64):
+        return str(v)  # ISO date/timestamp text on the wire
     return v
 
 

@@ -2706,13 +2706,13 @@ def _arith(op: str, l: ir.Expr, r: ir.Expr) -> ir.Expr:
     if isinstance(lt, DecimalType) and isinstance(rt, DecimalType):
         if op in ("add", "subtract"):
             s = max(lt.scale, rt.scale)
-            t = DecimalType.of(min(max(lt.precision - lt.scale, rt.precision - rt.scale) + s + 1, 18), s)
+            t = DecimalType.of(min(max(lt.precision - lt.scale, rt.precision - rt.scale) + s + 1, 38), s)
             return ir.Call(op, (_coerce(l, DecimalType.of(18, s)), _coerce(r, DecimalType.of(18, s))), t)
         if op == "multiply":
             s = lt.scale + rt.scale
             if s > 12:
                 return ir.Call("multiply", (_coerce(l, DOUBLE), _coerce(r, DOUBLE)), DOUBLE)
-            return ir.Call(op, (l, r), DecimalType.of(min(lt.precision + rt.precision, 18), s))
+            return ir.Call(op, (l, r), DecimalType.of(min(lt.precision + rt.precision + 1, 38), s))
         if op == "divide":
             # deviation: decimal division computes in double (documented in module docstring)
             return ir.Call("divide", (_coerce(l, DOUBLE), _coerce(r, DOUBLE)), DOUBLE)
@@ -2735,9 +2735,13 @@ def _type_from_name(name: str, params) -> Type:
     if name in m:
         return m[name]
     if name == "decimal":
+        # declared precision up to 38 (reference: spi/type/DecimalType with
+        # Int128 long decimals).  Storage stays scaled int64 — value-domain
+        # |v| < 2^63 is checked at ingest — while SUMS beyond 2^63 stay exact
+        # via the two-limb accumulators (ops/hashagg sum_hi32/sum_lo32).
         p = params[0] if params else 18
         s = params[1] if len(params) > 1 else 0
-        return DecimalType.of(min(p, 18), s)
+        return DecimalType.of(p, s)
     if name == "timestamp":
         from ..types import TimestampType
 
