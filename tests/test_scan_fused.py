@@ -14,8 +14,11 @@ from trino_tpu.connectors.tpch import TpchConnector
 @pytest.fixture()
 def feng(monkeypatch):
     """Engine with a counter on the fused path: calls['n'] counts fused-path
-    executions that actually took the query (returned a result)."""
+    executions that actually took the query (returned a result).  The fused
+    paths gate off on the CPU backend by default; force them on here."""
     import trino_tpu.exec.local_executor as LE
+
+    monkeypatch.setenv("TRINO_TPU_SCAN_FUSED", "1")
 
     calls = {"n": 0, "global": 0}
     orig = LE.LocalExecutor._run_aggregate_scan_fused

@@ -637,6 +637,11 @@ class LocalExecutor:
 
     # -- scan-fused aggregation ----------------------------------------------
     def _traced_chain(self, stream):
+        if not _scan_fused_enabled():
+            return None
+        return self._traced_chain_always(stream)
+
+    def _traced_chain_always(self, stream):
         """(chain_fn, split_offsets, stage_auxes) for a traced-regenerable
         stream, or None.  chain_fn(lo, auxes) regenerates one split's raw page
         on device and pushes it through every pipeline stage — pure, so a
@@ -1713,6 +1718,20 @@ class LocalExecutor:
 # -- helpers ------------------------------------------------------------------------------
 
 
+def _scan_fused_enabled() -> bool:
+    """Scan-fused paths trade RE-GENERATING the scan on device (free-ish on
+    TPU) for collapsing host dispatches (the tunneled-TPU bottleneck).  On the
+    CPU backend generation IS the dominant cost and dispatches are ~free, so
+    the page-loop paths win there — fuse only on accelerators by default.
+    TRINO_TPU_SCAN_FUSED=1/0 forces either way (tests force-enable on CPU)."""
+    import os
+
+    mode = os.environ.get("TRINO_TPU_SCAN_FUSED")
+    if mode is not None:
+        return mode not in ("0", "false", "no")
+    return jax.default_backend() != "cpu"
+
+
 def _global_agg_update(state, cols, nulls, valid, acc_exprs, acc_kinds):
     """One page folded into the ungrouped-aggregation accumulator tuple — the
     shared body of the per-page step and the scan-fused whole-scan runner."""
@@ -1920,7 +1939,7 @@ def _concat_traced(stream: _Stream):
     tunneled TPUs those round-trips dominate join-build time.  Regenerating the
     scan twice is deliberate: device compute is cheap, dispatches are not."""
     ts = stream.traced_src
-    if ts is None or not ts.splits:
+    if ts is None or not ts.splits or not _scan_fused_enabled():
         return None
     stages = ts.stages + (stream,)
     length = int(ts.splits[0].hi - ts.splits[0].lo)
