@@ -1,0 +1,59 @@
+"""Higher-order array functions (reference: operator/scalar/
+ArrayTransformFunction, ArrayFilterFunction, ArrayAnyMatchFunction + the
+grammar's lambda expressions).  TPU re-design: the element heap is a
+plan-time constant, so lambdas evaluate once over the whole heap (the string
+LUT trick) and the device-side work stays span-only — filter remaps spans
+through an exclusive cumsum of kept elements, never touching elements."""
+
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.memory import MemoryConnector
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table t (id bigint)", s)
+    e.execute_sql("insert into t values (1), (2)", s)
+    return e, s
+
+
+def _one(eng, expr):
+    e, s = eng
+    return e.execute_sql(f"select {expr} v from t where id = 1", s).rows()[0][0]
+
+
+def test_transform(eng):
+    assert _one(eng, "transform(array[1,2,3], x -> x * 2 + 1)") == [3, 5, 7]
+    assert _one(eng, "transform(array[1.5, 2.5], x -> x * 2)") == [3.0, 5.0]
+
+
+def test_filter(eng):
+    assert _one(eng, "filter(array[5,-2,7,0], x -> x > 0)") == [5, 7]
+    assert _one(eng, "filter(array[1,2,3], x -> x > 9)") == []
+    assert _one(eng, "cardinality(filter(array[5,-2,7,0], x -> x >= 5))") == 2
+
+
+def test_matches(eng):
+    assert bool(_one(eng, "any_match(array[1,2,3], x -> x > 2)"))
+    assert not bool(_one(eng, "any_match(array[1,2,3], x -> x > 9)"))
+    assert bool(_one(eng, "all_match(array[1,2,3], x -> x > 0)"))
+    assert not bool(_one(eng, "all_match(array[1,2,3], x -> x > 1)"))
+    assert bool(_one(eng, "none_match(array[1,2,3], x -> x > 9)"))
+    assert not bool(_one(eng, "none_match(array[1,2,3], x -> x = 2)"))
+
+
+def test_compose(eng):
+    assert _one(eng, "transform(filter(array[1,2,3,4], x -> x % 2 = 0), "
+                     "y -> y * 10)") == [20, 40]
+    assert _one(eng, "array_sum(transform(array[1,2,3], x -> x * x))") == 14
+
+
+def test_two_param_lambda_rejected_cleanly(eng):
+    e, s = eng
+    with pytest.raises(Exception, match="one-parameter"):
+        e.execute_sql("select transform(array[1], (a, b) -> a + b) v "
+                      "from t where id = 1", s)

@@ -1,0 +1,96 @@
+"""Cluster-wide memory management (reference:
+memory/ClusterMemoryManager.java:92): workers report their node pool through
+announces/heartbeats, the coordinator aggregates a cluster view, and a
+nearly-full pool refuses task admission (429) so the coordinator re-offers
+elsewhere instead of OOMing the node."""
+
+import json
+import pickle
+import time
+
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.server.cluster import ClusterCoordinator, WorkerServer, _http
+
+CATALOGS = {"tpch": {"connector": "tpch", "sf": 0.01, "split_rows": 1 << 11}}
+
+
+def test_worker_reports_pool_and_refuses_when_full(tmp_path):
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.01, split_rows=1 << 11))
+    w = WorkerServer(CATALOGS, str(tmp_path / "spool"))
+    url = w.start()
+    try:
+        info = json.loads(_http(f"{url}/v1/info"))
+        assert info["mem_max"] > 0 and info["mem_reserved"] >= 0
+
+        from trino_tpu.sql.frontend import compile_sql
+
+        plan = compile_sql("select count(*) from lineitem", e,
+                           e.create_session("tpch"))
+        _http(f"{url}/v1/fragment",
+              pickle.dumps({"fragment_id": "f1", "plan": plan}))
+        # fill the pool past the admission threshold: new tasks refuse 429
+        w.memory_pool.try_reserve(
+            int(w.memory_pool.max_bytes * 0.95), "test-fill")
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _http(f"{url}/v1/task",
+                  pickle.dumps({"task_id": "t1", "fragment_id": "f1",
+                                "kind": "fragment",
+                                "exchange_dir": str(tmp_path / "x")}))
+        assert exc.value.code == 429
+        w.memory_pool.free(int(w.memory_pool.max_bytes * 0.95), "test-fill")
+        # with the pool freed the same task admits and completes
+        _http(f"{url}/v1/task",
+              pickle.dumps({"task_id": "t1", "fragment_id": "f1",
+                            "kind": "fragment",
+                            "exchange_dir": str(tmp_path / "x")}))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            st = json.loads(_http(f"{url}/v1/task/t1"))
+            if st["state"] == "done":
+                break
+            assert st["state"] != "failed", st
+            time.sleep(0.1)
+        else:
+            raise AssertionError("task did not finish")
+    finally:
+        w.stop()
+
+
+def test_coordinator_aggregates_cluster_memory(tmp_path):
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.01, split_rows=1 << 11))
+    coord = ClusterCoordinator(e, str(tmp_path / "spool"),
+                               heartbeat_interval=0.2)
+    url = coord.start()
+    w = None
+    try:
+        w = WorkerServer(CATALOGS, str(tmp_path / "spool"),
+                         coordinator_url=url, node_id="wmem",
+                         announce_interval=0.2)
+        w.start()
+        coord.wait_for_workers(1, timeout=30)
+        w.memory_pool.try_reserve(12345, "test")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            mem = coord.cluster_memory()
+            byid = {x["node_id"]: x for x in mem["workers"]}
+            if byid.get("wmem", {}).get("mem_reserved", 0) >= 12345:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"memory never aggregated: {mem}")
+        assert mem["total_max"] > 0
+        assert mem["total_reserved"] >= 12345
+        # the HTTP surface serves the same view
+        via_http = json.loads(_http(f"{url}/v1/memory"))
+        assert via_http["total_max"] == mem["total_max"]
+    finally:
+        coord.stop()
+        if w is not None:
+            w.stop()

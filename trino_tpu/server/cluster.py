@@ -238,7 +238,13 @@ class WorkerServer:
                 "set TRINO_TPU_CLUSTER_SECRET (or pass secret=) to bind "
                 "beyond loopback")
         self.catalogs = build_catalogs(catalogs_config)
-        self.local = LocalExecutor(self.catalogs)
+        # ONE node-level pool shared by every pooled executor: per-executor
+        # pools would overcommit the single accelerator's HBM (reference:
+        # memory/MemoryPool.java is per-node, not per-driver)
+        from ..memory import MemoryPool
+
+        self.memory_pool = MemoryPool()
+        self.local = LocalExecutor(self.catalogs, memory_pool=self.memory_pool)
         self.spool_dir = spool_dir
         self.host, self.port = host, port
         self.node_id = node_id
@@ -279,6 +285,7 @@ class WorkerServer:
         # isFull() producer blocking of the reference, re-planned as admission
         # control at the task boundary)
         self.max_concurrent_tasks = 8
+        self.memory_admission_fraction = 0.9  # refuse tasks past this pool use
         self._draining = False  # graceful shutdown: no NEW work, finish running
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._stop = threading.Event()
@@ -302,10 +309,13 @@ class WorkerServer:
             def do_GET(self):
                 if self.path == "/v1/info":
                     state = "shutting_down" if worker._draining else "active"
+                    pool = worker.memory_pool
                     return self._reply(200, {"node_id": worker.node_id,
                                              "state": state,
                                              "peak_concurrency":
-                                                 worker.peak_concurrency})
+                                                 worker.peak_concurrency,
+                                             "mem_reserved": pool.reserved,
+                                             "mem_max": pool.max_bytes})
                 if "/results/" in self.path and self.path.startswith("/v1/task/"):
                     # streamed page read: /v1/task/{tid}/results/{token}
                     # (reference: TaskResource.java:331 long-poll page fetch);
@@ -411,7 +421,10 @@ class WorkerServer:
                 _http(f"{self.coordinator_url}/v1/announce",
                       json.dumps({"node_id": self.node_id,
                                   "url": self.url,
-                                  "state": state}).encode(),
+                                  "state": state,
+                                  "mem_reserved": self.memory_pool.reserved,
+                                  "mem_max": self.memory_pool.max_bytes,
+                                  }).encode(),
                       secret=self.secret)
             except Exception:
                 pass  # coordinator not up yet / transient
@@ -425,7 +438,7 @@ class WorkerServer:
         with self._wlock:
             if self._executor_pool:
                 return self._executor_pool.pop()
-            ex = LocalExecutor(self.catalogs)
+            ex = LocalExecutor(self.catalogs, memory_pool=self.memory_pool)
             self._all_executors.append(ex)
             return ex
 
@@ -461,6 +474,12 @@ class WorkerServer:
             if node is None:
                 raise KeyError(frag_id)
             if self._running_tasks >= self.max_concurrent_tasks:
+                raise _WorkerBusy()
+            # memory-aware admission (the node half of the reference's
+            # ClusterMemoryManager: a nearly-full pool refuses work instead of
+            # OOMing it; the coordinator re-offers elsewhere)
+            if self.memory_pool.reserved > \
+                    self.memory_admission_fraction * self.memory_pool.max_bytes:
                 raise _WorkerBusy()
             self._running_tasks += 1
             self.tasks[tid] = st = _TaskState()
@@ -579,6 +598,8 @@ class _WorkerInfo:
     misses: int = 0
     alive: bool = True
     draining: bool = False  # graceful shutdown: reachable but not schedulable
+    mem_reserved: int = 0  # last announced pool reservation (bytes)
+    mem_max: int = 0  # last announced pool capacity (bytes)
 
 
 class ClusterCoordinator:
@@ -676,7 +697,9 @@ class ClusterCoordinator:
                             return self._reply(403, {"error": "bad signature"})
                     msg = json.loads(body)
                     coord._announce(msg["node_id"], msg["url"],
-                                    msg.get("state", "active"))
+                                    msg.get("state", "active"),
+                                    msg.get("mem_reserved"),
+                                    msg.get("mem_max"))
                     return self._reply(200, {"ok": True})
                 self._reply(404, {"error": "not found"})
 
@@ -687,6 +710,11 @@ class ClusterCoordinator:
                                   "alive": w.alive} for w in
                                  coord.workers.values()]
                     return self._reply(200, {"nodes": nodes})
+                if self.path == "/v1/memory":
+                    # cluster-wide memory view (reference:
+                    # memory/ClusterMemoryManager.java:92 polling worker
+                    # pools into one aggregate the kill policy reads)
+                    return self._reply(200, coord.cluster_memory())
                 self._reply(404, {"error": "not found"})
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
@@ -700,7 +728,24 @@ class ClusterCoordinator:
         if self._httpd:
             self._httpd.shutdown()
 
-    def _announce(self, node_id: str, url: str, state: str = "active"):
+    def cluster_memory(self) -> dict:
+        """Aggregate worker pool state (ClusterMemoryManager's cluster view);
+        workers report through their periodic announces, so this is poll-free
+        on the read path."""
+        with self._lock:
+            per = [{"node_id": w.node_id, "mem_reserved": w.mem_reserved,
+                    "mem_max": w.mem_max, "alive": w.alive}
+                   for w in self.workers.values()]
+        live = [w for w in per if w["alive"]]
+        return {"workers": per,
+                "total_reserved": sum(w["mem_reserved"] for w in live),
+                "total_max": sum(w["mem_max"] for w in live),
+                "blocked_nodes": [w["node_id"] for w in live
+                                  if w["mem_max"]
+                                  and w["mem_reserved"] > 0.9 * w["mem_max"]]}
+
+    def _announce(self, node_id: str, url: str, state: str = "active",
+                  mem_reserved=None, mem_max=None):
         with self._lock:
             if state == "gone":  # graceful exit: leave the cluster NOW
                 self.workers.pop(node_id, None)
@@ -715,11 +760,15 @@ class ClusterCoordinator:
                         self.workers.pop(nid)
                 if len(self.workers) >= self.max_workers:
                     return
-                self.workers[node_id] = _WorkerInfo(node_id, url, time.time(),
-                                                    draining=draining)
+                w = self.workers[node_id] = _WorkerInfo(
+                    node_id, url, time.time(), draining=draining)
             else:
                 w.url, w.last_seen, w.misses, w.alive = url, time.time(), 0, True
                 w.draining = draining
+            if mem_reserved is not None:
+                w.mem_reserved = int(mem_reserved)
+            if mem_max is not None:
+                w.mem_max = int(mem_max)
 
     def _heartbeat_loop(self):
         """HeartbeatFailureDetector (simplified): probe /v1/info; max_misses
@@ -733,6 +782,9 @@ class ClusterCoordinator:
                     with self._lock:
                         w.misses, w.alive, w.last_seen = 0, True, time.time()
                         w.draining = info.get("state") == "shutting_down"
+                        if "mem_reserved" in info:
+                            w.mem_reserved = int(info["mem_reserved"])
+                            w.mem_max = int(info.get("mem_max", 0))
                 except Exception:
                     with self._lock:
                         w.misses += 1

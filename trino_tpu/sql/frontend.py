@@ -1919,7 +1919,75 @@ class Planner:
             raise SemanticError(
                 "row(...) values must be field-accessed (row(...)[n]); "
                 "standalone row channels flatten at plan time")
+        if name in ("transform", "filter", "any_match", "all_match",
+                    "none_match"):
+            # higher-order array lambdas (reference:
+            # operator/scalar/ArrayTransformFunction, ArrayFilterFunction,
+            # ArrayAnyMatchFunction...).  The heap is a plan-time constant, so
+            # the lambda evaluates ONCE over the whole element heap here —
+            # the same per-distinct-value trick as the string LUTs — and the
+            # device-side work stays span-only: transform reuses the spans
+            # over a rewritten heap; filter maps spans through the kept-
+            # element exclusive cumsum (two gathers, no heap traffic).
+            base, bd = self._translate(args[0], cols)
+            if not isinstance(base.type, ArrayType) or bd is None:
+                raise SemanticError(f"{name} expects an array")
+            lam = args[1] if len(args) > 1 else None
+            if not isinstance(lam, A.Lambda) or len(lam.params) != 1:
+                raise SemanticError(f"{name} expects a one-parameter lambda")
+            body_ir, out_vals, out_nulls = self._eval_lambda_on_heap(lam, bd)
+            if name == "transform":
+                if out_nulls is not None:
+                    raise SemanticError(
+                        "transform lambdas yielding NULLs are not supported")
+                heap = np.asarray(out_vals)
+                from ..ops.arrays import ArrayData
+
+                t = ArrayType.of(body_ir.type)
+                # spans are unchanged; only the element heap (and type) moves
+                return (ir.Call("span_id", (base,), t),
+                        ArrayData(heap, body_ir.type, None,
+                                  max_len=bd.max_len))
+            if body_ir.type.name != "boolean":
+                raise SemanticError(f"{name} lambda must return boolean")
+            keep = np.asarray(out_vals).astype(bool)
+            if out_nulls is not None:  # NULL predicate = no match
+                keep = keep & ~np.asarray(out_nulls)
+            excl = np.zeros(len(keep) + 1, np.int64)
+            np.cumsum(keep, out=excl[1:])
+            filt = ir.Call("span_filter",
+                           (base, ir.Constant(excl, UNKNOWN)),
+                           base.type)
+            if name == "filter":
+                from ..ops.arrays import ArrayData
+
+                heap = np.asarray(bd.values)[keep]
+                return filt, ArrayData(heap, bd.elem_type, bd.elem_dict,
+                                       max_len=bd.max_len)
+            kept_len = ir.Call("span_len", (filt,), BIGINT)
+            if name == "any_match":
+                return ir.Call("gt", (kept_len, ir.Constant(0, BIGINT)),
+                               BOOLEAN), None
+            if name == "none_match":
+                return ir.Call("eq", (kept_len, ir.Constant(0, BIGINT)),
+                               BOOLEAN), None
+            total_len = ir.Call("span_len", (base,), BIGINT)
+            return ir.Call("eq", (kept_len, total_len), BOOLEAN), None
         raise SemanticError(f"unknown collection function {name}")
+
+    def _eval_lambda_on_heap(self, lam, bd):
+        """Translate a one-parameter lambda against an array's element heap
+        and evaluate it EAGERLY over every heap element (plan-time, like the
+        string-function LUTs).  Returns (body_ir, values, null_mask|None)."""
+        elem_cols = [ColumnInfo(None, lam.params[0], bd.elem_type,
+                                bd.elem_dict)]
+        body_ir, _ = self._translate(lam.body, elem_cols)
+        import jax.numpy as jnp
+
+        heap = jnp.asarray(np.asarray(bd.values))
+        vals, nulls = ir.evaluate(body_ir, (heap,), (None,))
+        return (body_ir, np.asarray(vals),
+                None if nulls is None else np.asarray(nulls))
 
     def _try_translate(self, ast, cols):
         try:
@@ -1965,6 +2033,10 @@ class Planner:
                 e, _ = self._translate(ast.operand, cols)
                 return ir.Call("not", (e,), BOOLEAN), None
             e, _ = self._translate(ast.operand, cols)
+            if isinstance(e, ir.Constant) and e.value is not None:
+                # fold so negative literals stay constants (array literals,
+                # sequence bounds, IN lists expect constant elements)
+                return ir.Constant(-e.value, e.type), None
             return ir.Call("negate", (e,), e.type), None
         if isinstance(ast, A.BinaryOp):
             return self._translate_binary(ast, cols)
@@ -2186,7 +2258,9 @@ class Planner:
     _COLLECTION_FUNCS = ("cardinality", "element_at", "contains", "sequence",
                          "map", "map_keys", "map_values", "row",
                          "array_min", "array_max", "array_sum",
-                         "array_average", "array_position")
+                         "array_average", "array_position",
+                         "transform", "filter", "any_match", "all_match",
+                         "none_match")
 
     def _translate_func(self, ast: A.FuncCall, cols):
         """Registry dispatch (reference: the analyzer resolving calls against

@@ -132,6 +132,15 @@ class CaseExpr(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class Lambda(Node):
+    """``x -> expr`` / ``(a, b) -> expr`` in call-argument position
+    (reference: grammar lambda -> LambdaExpression)."""
+
+    params: tuple  # parameter names
+    body: Node
+
+
+@dataclasses.dataclass(frozen=True)
 class Between(Node):
     value: Node
     low: Node
@@ -490,7 +499,7 @@ _TOKEN_RE = re.compile(
   | (?P<number>\d+(\.\d*)?([eE][+-]?\d+)?|\.\d+)
   | (?P<string>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*|"(?:[^"]|"")*")
-  | (?P<op><=|>=|<>|!=|\|\||[-+*/%(),.;<>=?\[\]|])
+  | (?P<op><=|>=|<>|!=|\|\||->|[-+*/%(),.;<>=?\[\]|])
     """,
     re.VERBOSE,
 )
@@ -1384,6 +1393,32 @@ class Parser:
             e = Subscript(e, idx)
         return e
 
+    def _parse_call_arg(self) -> Node:
+        """A function-call argument: a lambda (``x -> e`` / ``(a, b) -> e``)
+        or an ordinary expression."""
+        t = self.peek()
+        if t.kind == "ident" and self.peek(1).kind == "op" \
+                and self.peek(1).value == "->":
+            name = self.next().value
+            self.next()
+            return Lambda((name,), self.parse_expr())
+        if t.kind == "op" and t.value == "(":
+            j, params = self.i + 1, []
+            while self.tokens[j].kind == "ident":
+                params.append(self.tokens[j].value)
+                j += 1
+                if self.tokens[j].kind == "op" and self.tokens[j].value == ",":
+                    j += 1
+                    continue
+                break
+            if params and self.tokens[j].kind == "op" \
+                    and self.tokens[j].value == ")" \
+                    and self.tokens[j + 1].kind == "op" \
+                    and self.tokens[j + 1].value == "->":
+                self.i = j + 2
+                return Lambda(tuple(params), self.parse_expr())
+        return self.parse_expr()
+
     def parse_primary(self) -> Node:
         t = self.peek()
         if t.kind == "number":
@@ -1511,9 +1546,9 @@ class Parser:
                     self.next()
                     args = (Star(),)
                 elif not (self.peek().kind == "op" and self.peek().value == ")"):
-                    arg_list = [self.parse_expr()]
+                    arg_list = [self._parse_call_arg()]
                     while self.accept(","):
-                        arg_list.append(self.parse_expr())
+                        arg_list.append(self._parse_call_arg())
                     args = tuple(arg_list)
                 self.expect(")")
                 fc = FuncCall(name, args, distinct)
