@@ -191,3 +191,71 @@ def test_sql_subquery_sort_removed_under_group_by(tpch_engine):
         "select l_returnflag, count(*) c from lineitem "
         "group by l_returnflag order by l_returnflag", s).rows()
     assert rows == expected
+
+
+def test_push_filter_through_project():
+    proj = P.Project(_scan(), (ir.FieldRef(1, BIGINT, "b"),
+                               ir.FieldRef(0, BIGINT, "a")),
+                     Schema((Field("b", BIGINT), Field("a", BIGINT))))
+    plan = P.Filter(proj, _pred(0, "lt", 5))  # filters on OUTPUT channel 0 = b
+    out = _opt(plan)
+    assert isinstance(out, P.Project)
+    filt = _find(out, P.Filter)
+    assert len(filt) == 1
+    # the rewritten predicate references INPUT channel 1 (column b)
+    assert filt[0].predicate.args[0].index == 1
+    assert isinstance(filt[0].child, P.TableScan)
+
+
+def test_push_limit_through_project_keeps_topn():
+    proj = P.Project(_scan(), (ir.FieldRef(0, BIGINT, "a"),
+                               ir.FieldRef(1, BIGINT, "b")),
+                     Schema((Field("a", BIGINT), Field("b", BIGINT))))
+    out = _opt(P.Limit(proj, 7))
+    # identity project is ALSO removed; the limit must sit under any project
+    lims = _find(out, P.Limit)
+    assert len(lims) == 1 and isinstance(lims[0].child, P.TableScan)
+    # Limit(Project(Sort)) stays a TopN shape: the limit must NOT split from
+    # its sort
+    srt = P.Sort(_scan(), (P.SortKey(0, True, False),))
+    proj2 = P.Project(srt, (ir.FieldRef(0, BIGINT, "a"),
+                            ir.FieldRef(1, BIGINT, "bb")),
+                      Schema((Field("a", BIGINT), Field("bb", BIGINT))))
+    out2 = _opt(P.Limit(proj2, 7))
+    lims2 = _find(out2, P.Limit)
+    assert len(lims2) == 1
+
+
+def test_remove_trivial_filter():
+    t = _opt(P.Filter(_scan(), ir.Constant(True, BOOLEAN)))
+    assert isinstance(t, P.TableScan)
+    f = _opt(P.Filter(_scan(), ir.Constant(False, BOOLEAN)))
+    assert isinstance(f, P.Values) and len(f.rows) == 0
+
+
+def test_merge_unions_flattens():
+    s = _scan()
+    inner = P.Union((s, _scan()), s.schema)
+    outer = P.Union((inner, _scan()), s.schema)
+    out = _opt(outer)
+    assert isinstance(out, P.Union)
+    assert len(out.inputs) == 3
+    assert all(isinstance(c, P.TableScan) for c in out.inputs)
+
+
+def test_push_limit_through_union():
+    s = _scan()
+    u = P.Union((s, _scan()), s.schema)
+    out = _opt(P.Limit(u, 5))
+    assert isinstance(out, P.Limit)
+    inner = out.child
+    assert isinstance(inner, P.Union)
+    assert all(isinstance(c, P.Limit) and c.count == 5 for c in inner.inputs)
+
+
+def test_remove_redundant_limit_over_global_agg():
+    agg = P.Aggregate(_scan(), (), (P.AggSpec("count_star", None, "c",
+                                              BIGINT),),
+                      Schema((Field("c", BIGINT),)))
+    out = _opt(P.Limit(agg, 10))
+    assert isinstance(out, P.Aggregate)

@@ -375,9 +375,140 @@ class InferJoinSideFilters(Rule):
         return _and_all(new) if new else None
 
 
+def _substitute_refs(e: ir.Expr, exprs: tuple) -> Optional[ir.Expr]:
+    """Rewrite ``e`` with every FieldRef i replaced by ``exprs[i]`` (the
+    inverse projection).  Returns None when the expression holds a node kind
+    we cannot substitute through."""
+    if isinstance(e, ir.FieldRef):
+        if e.index >= len(exprs):
+            return None
+        return exprs[e.index]
+    if isinstance(e, ir.Constant):
+        return e
+    if isinstance(e, ir.Call):
+        args = []
+        for a in e.args:
+            s = _substitute_refs(a, exprs)
+            if s is None:
+                return None
+            args.append(s)
+        return dataclasses.replace(e, args=tuple(args))
+    return None
+
+
+class PushFilterThroughProject(Rule):
+    """Filter(Project(x)) -> Project(Filter'(x)) with the predicate rewritten
+    through the projection (reference: iterative/rule/
+    PushDownFilterThroughProject / PredicatePushDown) — moves predicates next
+    to the scan where static split pruning and lane masking see them."""
+
+    pattern = (P.Filter,)
+
+    def apply(self, node, memo):
+        child = memo.resolve(node.child)
+        if not isinstance(child, P.Project):
+            return None
+        pred = _substitute_refs(node.predicate, child.exprs)
+        if pred is None:
+            return None
+        return _replace_children(child, (P.Filter(child.child, pred),))
+
+
+class PushLimitThroughProject(Rule):
+    """Limit(Project(x)) -> Project(Limit(x)) (reference:
+    iterative/rule/PushLimitThroughProject) — lets the limit short-circuit
+    the page stream below the projection."""
+
+    pattern = (P.Limit,)
+
+    def apply(self, node, memo):
+        child = memo.resolve(node.child)
+        if not isinstance(child, P.Project):
+            return None
+        inner = memo.resolve(child.child)
+        if isinstance(inner, P.Sort):
+            return None  # keep Limit(Sort) visible: that shape IS TopN
+        return _replace_children(
+            child, (dataclasses.replace(node, child=child.child),))
+
+
+class RemoveTrivialFilter(Rule):
+    """Filter(TRUE) -> child; Filter(FALSE) -> empty Values (reference:
+    iterative/rule/RemoveTrivialFilters)."""
+
+    pattern = (P.Filter,)
+
+    def apply(self, node, memo):
+        p = node.predicate
+        if isinstance(p, ir.Constant):
+            if p.value:
+                return memo.resolve(node.child)
+            return P.Values((), node.schema)
+        return None
+
+
+class MergeUnions(Rule):
+    """Union(Union(a, b), c) -> Union(a, b, c) (reference:
+    iterative/rule/MergeUnion) — one gather instead of a cascade."""
+
+    pattern = (P.Union,)
+
+    def apply(self, node, memo):
+        new_inputs, changed = [], False
+        for c in node.children:
+            rc = memo.resolve(c)
+            if isinstance(rc, P.Union):
+                new_inputs.extend(rc.children)
+                changed = True
+            else:
+                new_inputs.append(c)
+        if not changed:
+            return None
+        return dataclasses.replace(node, inputs=tuple(new_inputs))
+
+
+class PushLimitThroughUnion(Rule):
+    """Limit(n, Union(a, b)) -> Limit(n, Union(Limit(n, a), Limit(n, b)))
+    (reference: iterative/rule/PushLimitThroughUnion) — each branch stops
+    producing after n rows instead of materializing fully."""
+
+    pattern = (P.Limit,)
+
+    def apply(self, node, memo):
+        child = memo.resolve(node.child)
+        if not isinstance(child, P.Union):
+            return None
+        if any(isinstance(memo.resolve(c), P.Limit)
+               for c in child.children):
+            return None  # already pushed (fixpoint guard)
+        limited = tuple(P.Limit(c, node.count) for c in child.children)
+        return dataclasses.replace(
+            node, child=dataclasses.replace(child, inputs=limited))
+
+
+class RemoveRedundantLimit(Rule):
+    """Limit over a source that cannot exceed the count: ungrouped aggregates
+    yield one row; Values yields len(rows) (reference:
+    iterative/rule/RemoveRedundantLimit)."""
+
+    pattern = (P.Limit,)
+
+    def apply(self, node, memo):
+        child = memo.resolve(node.child)
+        if isinstance(child, P.Aggregate) and not child.keys \
+                and node.count >= 1:
+            return child
+        if isinstance(child, P.Values) and len(child.rows) <= node.count:
+            return child
+        return None
+
+
 DEFAULT_RULES = (MergeFilters(), MergeLimits(), EliminateLimitZero(),
                  RemoveIdentityProject(), EliminateSortUnderOrderDestroyer(),
-                 InferJoinSideFilters())
+                 InferJoinSideFilters(), PushFilterThroughProject(),
+                 PushLimitThroughProject(), RemoveTrivialFilter(),
+                 MergeUnions(), PushLimitThroughUnion(),
+                 RemoveRedundantLimit())
 
 
 def optimize_plan(root: P.PlanNode) -> P.PlanNode:
