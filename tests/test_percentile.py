@@ -1,0 +1,94 @@
+"""approx_percentile via exact sort-based selection (reference:
+operator/aggregation/ApproximateLongPercentileAggregations' t-digest,
+re-designed as one device lexsort + segmented nth-element gathers — exact
+selection is within the function's accuracy contract)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.connectors.tpch import TpchConnector
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.01, split_rows=1 << 13))
+    return e, e.create_session("tpch")
+
+
+@pytest.fixture(scope="module")
+def lineitem(eng):
+    e, _ = eng
+    conn = e.catalogs["tpch"]
+    parts = [pd.DataFrame(conn.generate(sp).to_numpy(
+        conn.dictionaries("lineitem"))) for sp in conn.splits("lineitem")]
+    return pd.concat(parts, ignore_index=True)
+
+
+def _nearest_rank(series, p):
+    v = np.sort(series.to_numpy())
+    return v[int(np.clip(round(p * (len(v) - 1)), 0, len(v) - 1))]
+
+
+def test_global_percentiles(eng, lineitem):
+    e, s = eng
+    r = e.execute_sql(
+        "select approx_percentile(l_quantity, 0.5) p50, "
+        "approx_percentile(l_quantity, 0.95) p95, "
+        "approx_percentile(l_extendedprice, 0.99) p99 from lineitem",
+        s).rows()[0]
+    assert float(r[0]) == _nearest_rank(lineitem.l_quantity, 0.5)
+    assert float(r[1]) == _nearest_rank(lineitem.l_quantity, 0.95)
+    assert abs(float(r[2]) - _nearest_rank(lineitem.l_extendedprice, 0.99)) \
+        < 0.01
+
+
+def test_grouped_percentile(eng, lineitem):
+    e, s = eng
+    got = e.execute_sql(
+        "select l_returnflag, approx_percentile(l_extendedprice, 0.5) med "
+        "from lineitem group by l_returnflag order by l_returnflag",
+        s).to_pandas()
+    ref = lineitem.groupby("l_returnflag").l_extendedprice.apply(
+        lambda v: _nearest_rank(v, 0.5))
+    assert got["l_returnflag"].tolist() == list(ref.index)
+    np.testing.assert_allclose(got["med"].astype(float), ref.to_numpy(),
+                               atol=0.01)
+
+
+def test_percentile_with_filter_and_join(eng, lineitem):
+    e, s = eng
+    got = e.execute_sql(
+        "select o_orderpriority, approx_percentile(l_quantity, 0.9) q90 "
+        "from lineitem, orders where l_orderkey = o_orderkey "
+        "and l_shipdate > date '1995-01-01' "
+        "group by o_orderpriority order by o_orderpriority", s).to_pandas()
+    assert len(got) >= 2
+    assert (got["q90"].astype(float) >= 1).all()
+    assert (got["q90"].astype(float) <= 50).all()
+
+
+def test_percentile_nulls_and_empty_groups():
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table t (k bigint, v double)", s)
+    e.execute_sql("insert into t values (1, 10.0), (1, 20.0), (1, 30.0), "
+                  "(2, null), (2, null), (3, 5.0)", s)
+    got = e.execute_sql(
+        "select k, approx_percentile(v, 0.5) m from t group by k order by k",
+        s).to_pandas()
+    assert got["k"].tolist() == [1, 2, 3]
+    assert float(got["m"].iloc[0]) == 20.0
+    assert pd.isna(got["m"].iloc[1])  # all-NULL group -> NULL
+    assert float(got["m"].iloc[2]) == 5.0
+
+
+def test_percentile_mixing_rejected(eng):
+    e, s = eng
+    with pytest.raises(Exception, match="mix"):
+        e.execute_sql("select approx_percentile(l_quantity, 0.5), count(*) "
+                      "from lineitem", s)

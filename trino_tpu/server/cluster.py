@@ -910,7 +910,9 @@ class ClusterCoordinator:
         if not isinstance(node, self._FRAGMENT_NODES):
             return
         frag = self._substitute(node, spooled, root=True)
-        if isinstance(node, P.Aggregate) and node.keys:
+        if isinstance(node, P.Aggregate) and node.keys \
+                and not any(s.kind == "approx_percentile"
+                            for s in node.aggs):
             spine = self._scan_spine(frag.child)
             if spine is not None:
                 # split-fanout tasks resolve RemoteSources from the SPOOL and

@@ -45,7 +45,7 @@ class SemanticError(ValueError):
 AGG_FUNCS = {"count", "sum", "avg", "min", "max",
              "stddev", "stddev_pop", "stddev_samp", "variance", "var_pop", "var_samp",
              "approx_distinct", "bool_and", "bool_or", "every", "arbitrary",
-             "any_value"}
+             "any_value", "approx_percentile"}
 
 
 @dataclasses.dataclass
@@ -1637,10 +1637,25 @@ class Planner:
                     # sums of raw scaled-decimal ints would square the scale;
                     # variance is computed over double values
                     e = _coerce(e, DOUBLE)
+                param = None
+                if kind == "approx_percentile":
+                    if len(a.args) < 2:
+                        raise SemanticError(
+                            "approx_percentile(x, percentile) needs a "
+                            "percentile argument")
+                    pe, _ = self.translate(a.args[1], rel.cols)
+                    if not isinstance(pe, ir.Constant):
+                        raise SemanticError(
+                            "approx_percentile's percentile must be constant")
+                    param = float(pe.value)
+                    if pe.type.is_decimal:
+                        param /= 10 ** pe.type.scale
+                    if not 0.0 <= param <= 1.0:
+                        raise SemanticError("percentile must be in [0, 1]")
                 ch = len(proj_exprs)
                 proj_exprs.append(e)
                 specs.append(P.AggSpec(kind, ir.FieldRef(ch, e.type), f"agg{j}",
-                                       _agg_type(kind, e.type)))
+                                       _agg_type(kind, e.type), param=param))
         proj_schema = Schema(tuple(Field(f"c{i}", e.type)
                                    for i, e in enumerate(proj_exprs)))
         proj = P.Project(rel.node, tuple(proj_exprs), proj_schema,
@@ -2626,7 +2641,7 @@ def _agg_type(kind: str, in_type: Type) -> Type:
         return DOUBLE
     if kind in ("bool_and", "bool_or"):
         return BOOLEAN
-    return in_type  # min/max/arbitrary
+    return in_type  # min/max/arbitrary/approx_percentile
 
 
 def _split_conjuncts(where) -> list:

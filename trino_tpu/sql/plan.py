@@ -82,6 +82,7 @@ class AggSpec:
     name: str
     type: Type
     distinct: bool = False
+    param: object = None  # extra static argument (approx_percentile's p)
 
 
 @dataclasses.dataclass(frozen=True)
