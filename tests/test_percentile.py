@@ -92,3 +92,36 @@ def test_percentile_mixing_rejected(eng):
     with pytest.raises(Exception, match="mix"):
         e.execute_sql("select approx_percentile(l_quantity, 0.5), count(*) "
                       "from lineitem", s)
+
+
+def test_listagg_grouped_ordered(eng):
+    e, s = eng
+    r = e.execute_sql(
+        "select r_name, listagg(n_name, ', ') within group (order by n_name) "
+        "nations from nation, region where n_regionkey = r_regionkey "
+        "group by r_name order by r_name", s).to_pandas()
+    assert r["nations"].iloc[0] == \
+        "ALGERIA, ETHIOPIA, KENYA, MOROCCO, MOZAMBIQUE"
+    assert len(r) == 5
+
+
+def test_listagg_global_desc(eng):
+    e, s = eng
+    r = e.execute_sql(
+        "select listagg(r_name, '|') within group (order by r_name desc) x "
+        "from region", s).rows()[0][0]
+    assert r == "MIDDLE EAST|EUROPE|ASIA|AMERICA|AFRICA"
+
+
+def test_listagg_null_values_skipped():
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table t (k bigint, v varchar)", s)
+    e.execute_sql("insert into t values (1, 'b'), (1, null), (1, 'a'), "
+                  "(2, null)", s)
+    got = e.execute_sql(
+        "select k, listagg(v, '+') within group (order by v) x from t "
+        "group by k order by k", s).to_pandas()
+    assert got["x"].iloc[0] == "a+b"
+    assert pd.isna(got["x"].iloc[1])  # all-NULL group -> NULL

@@ -1316,9 +1316,9 @@ class DistributedExecutor:
     def _run_aggregate_once(self, node: P.Aggregate):
         """One ladder attempt: returns ((page, dicts), oflow) or None when the
         child has no distributable scan spine."""
-        if any(s.kind == "approx_percentile" for s in node.aggs):
-            return self._decline(node, "approx_percentile runs the sort-based "
-                                       "local selection")
+        if any(s.kind in ("approx_percentile", "listagg") for s in node.aggs):
+            return self._decline(node, "approx_percentile/listagg run the "
+                                       "sort-based local selection")
         stream = self._compile_stream(node.child)
         if stream is None:
             return None

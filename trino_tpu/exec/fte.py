@@ -342,7 +342,8 @@ class FaultTolerantExecutor:
         tid = f"frag{self._task_seq}"
         self._task_seq += 1
         if isinstance(node, P.Aggregate) and node.keys \
-                and not any(s.kind == "approx_percentile" for s in node.aggs) \
+                and not any(s.kind in ("approx_percentile", "listagg")
+                            for s in node.aggs) \
                 and self._scan_fed(node.child):
             # fine-grained path: per-split-batch partial-aggregation tasks,
             # merged into one durable page (the round-1 FTE shape, retained)

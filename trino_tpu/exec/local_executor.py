@@ -808,12 +808,13 @@ class LocalExecutor:
         selection is within the function's accuracy contract, and a device
         lexsort beats sketch maintenance when sorts are one fused kernel)."""
         for s in node.aggs:
-            if s.kind != "approx_percentile":
+            if s.kind not in ("approx_percentile", "listagg"):
                 raise NotImplementedError(
-                    "approx_percentile cannot mix with other aggregates yet")
+                    "approx_percentile/listagg cannot mix with other "
+                    "aggregates yet")
             if not isinstance(s.arg, FieldRef):
                 raise NotImplementedError(
-                    "approx_percentile argument must be a plain column")
+                    f"{s.kind} argument must be a plain column")
         stream = self._compile_stream(node.child)
         page = _concat_stream(stream)
         n = page.capacity
@@ -892,21 +893,105 @@ class LocalExecutor:
                 gknulls.append(None if kn is None else rest.pop(0))
             return gkeys, gknulls, vals, out_null
 
+        def sorted_listagg(spec):
+            """listagg(x, sep) WITHIN GROUP (ORDER BY o): the same key-major
+            sort, then per-group decode + join on the host (the string result
+            lives at the result surface only, like wide-decimal finals).
+            Reference: operator/aggregation/listagg."""
+            from ..connectors.tpch import Dictionary
+
+            sep, order_ch, asc = spec.param
+            vch = spec.arg.index
+            d = stream.dicts[vch]
+            if d is None:
+                raise NotImplementedError(
+                    "listagg needs a dictionary-encoded string channel")
+            v = page.columns[vch]
+            vn = page.null_masks[vch]
+            vnull = jnp.zeros((n,), bool) if vn is None else vn
+            okey = page.columns[order_ch] if order_ch is not None else v
+            od = stream.dicts[order_ch] if order_ch is not None \
+                else stream.dicts[vch]
+            if od is not None and getattr(od, "values", None) is not None:
+                # dictionary ids are insertion-ordered; ORDER BY compares
+                # decoded values — rank through a collation LUT
+                rank = np.empty(len(od.values), np.int64)
+                rank[np.argsort(np.asarray(od.values, dtype=object))] = \
+                    np.arange(len(od.values))
+                okey = jnp.asarray(rank)[jnp.clip(okey, 0, len(rank) - 1)]
+            if not asc:
+                okey = ~okey if jnp.issubdtype(okey.dtype, jnp.integer) \
+                    else -okey
+            lex = [okey, vnull]
+            for k, kn in zip(reversed(kcols), reversed(knulls)):
+                lex.append(k)
+                if kn is not None:
+                    lex.append(kn)
+            lex.append(~valid)
+            idx = jnp.lexsort(tuple(lex))
+            sk = [k[idx] for k in kcols]
+            skn = [None if kn is None else kn[idx] for kn in knulls]
+            svalid = valid[idx]
+            pos = jnp.arange(n)
+            new_group = svalid & (pos == 0)
+            for k, kn in zip(sk, skn):
+                prev = jnp.concatenate([k[:1], k[:-1]])
+                diff = (k != prev) & (pos > 0)
+                if kn is not None:
+                    pn = jnp.concatenate([kn[:1], kn[:-1]])
+                    diff = (diff & ~(kn & pn)) | ((kn != pn) & (pos > 0))
+                new_group = new_group | (svalid & diff)
+            if not key_chs:
+                new_group = svalid & (pos == 0)
+            m = int(jnp.sum(valid))
+            g = int(jnp.sum(new_group)) if key_chs else (1 if m else 0)
+            if g == 0:
+                return [], [], np.zeros((0,), np.int32), np.ones((0,), bool), \
+                    Dictionary(values=np.array([], dtype=object))
+            starts = np.asarray(
+                jnp.nonzero(new_group, size=g, fill_value=n)[0])
+            ends = np.concatenate([starts[1:], [m]])
+            got = _host([v[idx], vnull[idx]]
+                        + [k[jnp.asarray(starts)] for k in sk]
+                        + [kn[jnp.asarray(starts)] for kn in skn
+                           if kn is not None])
+            sval_np, svnull_np = got[0], got[1]
+            gkeys = got[2:2 + len(sk)]
+            rest = got[2 + len(sk):]
+            gknulls = []
+            for kn in skn:
+                gknulls.append(None if kn is None else rest.pop(0))
+            joined, out_null = [], np.zeros(g, bool)
+            for gi, (s0, e0) in enumerate(zip(starts, ends)):
+                ids = sval_np[s0:e0][~svnull_np[s0:e0]]
+                if len(ids) == 0:
+                    out_null[gi] = True
+                    joined.append("")
+                else:
+                    joined.append(sep.join(str(x) for x in d.decode(ids)))
+            out_d = Dictionary(values=np.array(joined, dtype=object))
+            return (gkeys, gknulls, np.arange(g, dtype=np.int32), out_null,
+                    out_d)
+
         out_key_cols = out_key_nulls = None
-        agg_vals, agg_nulls = [], []
+        agg_vals, agg_nulls, agg_dicts = [], [], []
         for s in node.aggs:
-            gkeys, gknulls, vals, vnull = sorted_select(s.arg.index,
-                                                        float(s.param))
+            if s.kind == "listagg":
+                gkeys, gknulls, vals, vnull, d_out = sorted_listagg(s)
+            else:
+                gkeys, gknulls, vals, vnull = sorted_select(s.arg.index,
+                                                            float(s.param))
+                d_out = None
             if out_key_cols is None:
                 out_key_cols, out_key_nulls = gkeys, gknulls
             agg_vals.append(vals)
             agg_nulls.append(vnull if vnull.any() else None)
+            agg_dicts.append(d_out)
         cols = list(out_key_cols) + agg_vals
         nulls = [None if kn is None or not kn.any() else kn
                  for kn in out_key_nulls] + agg_nulls
         arrays = [np.asarray(c) for c in cols]
-        dicts = tuple(stream.dicts[i] for i in key_chs) \
-            + tuple(None for _ in node.aggs)
+        dicts = tuple(stream.dicts[i] for i in key_chs) + tuple(agg_dicts)
         return Page(node.schema, tuple(arrays), tuple(nulls), None), dicts
 
     def _run_global_scan_fused(self, node, stream, acc_exprs, acc_kinds):
@@ -942,7 +1027,7 @@ class LocalExecutor:
         return page, tuple(None for _ in node.aggs)
 
     def _run_aggregate(self, node: P.Aggregate):
-        if any(s.kind == "approx_percentile" for s in node.aggs):
+        if any(s.kind in ("approx_percentile", "listagg") for s in node.aggs):
             return self._run_percentile_aggregate(node)
         stream, key_types, acc_specs, acc_exprs, acc_kinds, step = self._agg_compiled(node)
         capacity = node.capacity or DEFAULT_GROUP_CAPACITY

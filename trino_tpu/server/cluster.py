@@ -911,7 +911,7 @@ class ClusterCoordinator:
             return
         frag = self._substitute(node, spooled, root=True)
         if isinstance(node, P.Aggregate) and node.keys \
-                and not any(s.kind == "approx_percentile"
+                and not any(s.kind in ("approx_percentile", "listagg")
                             for s in node.aggs):
             spine = self._scan_spine(frag.child)
             if spine is not None:

@@ -45,7 +45,7 @@ class SemanticError(ValueError):
 AGG_FUNCS = {"count", "sum", "avg", "min", "max",
              "stddev", "stddev_pop", "stddev_samp", "variance", "var_pop", "var_samp",
              "approx_distinct", "bool_and", "bool_or", "every", "arbitrary",
-             "any_value", "approx_percentile"}
+             "any_value", "approx_percentile", "listagg"}
 
 
 @dataclasses.dataclass
@@ -1652,8 +1652,26 @@ class Planner:
                         param /= 10 ** pe.type.scale
                     if not 0.0 <= param <= 1.0:
                         raise SemanticError("percentile must be in [0, 1]")
+                if kind == "listagg":
+                    if not e.type.is_string:
+                        raise SemanticError("listagg expects a string argument")
+                    sep = ", "
+                    if len(a.args) > 1:
+                        if not isinstance(a.args[1], A.StringLit):
+                            raise SemanticError(
+                                "listagg separator must be a string literal")
+                        sep = a.args[1].value
+                    order_ch, asc = None, True
+                    if a.within_group:
+                        si = a.within_group[0]
+                        oe, _ = self.translate(si.expr, rel.cols)
+                        order_ch = len(proj_exprs) + 1
+                        asc = si.ascending
+                    param = (sep, order_ch, asc)
                 ch = len(proj_exprs)
                 proj_exprs.append(e)
+                if kind == "listagg" and param[1] is not None:
+                    proj_exprs.append(oe)
                 specs.append(P.AggSpec(kind, ir.FieldRef(ch, e.type), f"agg{j}",
                                        _agg_type(kind, e.type), param=param))
         proj_schema = Schema(tuple(Field(f"c{i}", e.type)
@@ -2641,6 +2659,8 @@ def _agg_type(kind: str, in_type: Type) -> Type:
         return DOUBLE
     if kind in ("bool_and", "bool_or"):
         return BOOLEAN
+    if kind == "listagg":
+        return VarcharType.of(None)
     return in_type  # min/max/arbitrary/approx_percentile
 
 

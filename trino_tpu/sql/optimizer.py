@@ -122,14 +122,25 @@ def _prune(node: P.PlanNode, required):
         for spec in node.aggs:
             if spec.arg is not None:
                 _expr_channels(spec.arg, child_req)
+            if spec.kind == "listagg" and spec.param \
+                    and spec.param[1] is not None:
+                child_req.add(spec.param[1])  # WITHIN GROUP order channel
         child, m = _prune(node.child, _closed(node.child, child_req))
         if m:
             keys = tuple(m[k] for k in node.keys)
-            aggs = tuple(
-                spec if spec.arg is None
-                else dataclasses.replace(spec, arg=_remap_expr(spec.arg, m))
-                for spec in node.aggs)
-            return dataclasses.replace(node, child=child, keys=keys, aggs=aggs), None
+            aggs = []
+            for spec in node.aggs:
+                if spec.arg is not None:
+                    spec = dataclasses.replace(
+                        spec, arg=_remap_expr(spec.arg, m))
+                if spec.kind == "listagg" and spec.param \
+                        and spec.param[1] is not None:
+                    sep, och, asc = spec.param
+                    spec = dataclasses.replace(spec,
+                                               param=(sep, m[och], asc))
+                aggs.append(spec)
+            return dataclasses.replace(node, child=child, keys=keys,
+                                       aggs=tuple(aggs)), None
         return dataclasses.replace(node, child=child), None
 
     if isinstance(node, P.Join):

@@ -107,6 +107,8 @@ class FuncCall(Node):
     name: str
     args: tuple
     distinct: bool = False
+    within_group: tuple = ()  # WITHIN GROUP (ORDER BY ...) sort items
+    # (reference: grammar listagg / orderedSetAggregation)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1552,6 +1554,19 @@ class Parser:
                     args = tuple(arg_list)
                 self.expect(")")
                 fc = FuncCall(name, args, distinct)
+                if self.peek().value == "within" \
+                        and self.peek(1).value == "group":
+                    # WITHIN GROUP (ORDER BY ...) — ordered-set aggregates
+                    # (listagg; reference grammar: listAggOverflowBehavior)
+                    self.next(), self.next()
+                    self.expect("(")
+                    self.expect("order")
+                    self.expect("by")
+                    wg = [self.parse_sort_item()]
+                    while self.accept(","):
+                        wg.append(self.parse_sort_item())
+                    self.expect(")")
+                    fc = FuncCall(name, args, distinct, tuple(wg))
                 # null-treatment clause for navigation functions (reference
                 # grammar: nullTreatment before OVER)
                 ignore_nulls = False
