@@ -199,3 +199,35 @@ def test_grant_revoke(tpch_sf001):
     # non-admins may not administer grants
     with pytest.raises(AccessDeniedError):
         e.execute_sql("grant select on t1 to eve", bob)
+
+
+def test_row_filter_and_column_mask():
+    """Access-control ViewExpressions (reference: spi/security
+    SystemAccessControl.getRowFilters/getColumnMasks): the planner splices a
+    row filter and column masks over the table per user; plans cache per
+    user."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.spi.security import RuleBasedAccessControl
+
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.001))
+    e.access_control = RuleBasedAccessControl({
+        "tables": [{"user": "analyst", "table": "nation",
+                    "filter": "n_regionkey = 1",
+                    "column_masks": {"n_comment": "null"}}]})
+    s_admin = e.create_session("tpch")
+    s_admin.user = "admin"
+    s_an = e.create_session("tpch")
+    s_an.user = "analyst"
+    sql = "select n_name, n_comment, n_regionkey from nation order by n_name"
+    admin_rows = e.execute_sql(sql, s_admin).rows()
+    assert len(admin_rows) == 25
+    assert any(r[1] is not None for r in admin_rows)
+    rows = e.execute_sql(sql, s_an).rows()
+    assert len(rows) == 5
+    assert {int(r[2]) for r in rows} == {1}
+    assert all(r[1] is None for r in rows)
+    # same SQL again for the unfiltered user: per-user plan cache keys keep
+    # the filtered plan from leaking across users
+    assert len(e.execute_sql(sql, s_admin).rows()) == 25

@@ -1150,7 +1150,7 @@ class ClusterCoordinator:
         session catalog's table resolution and dictionary LUTs)."""
         from ..sql.frontend import compile_sql
 
-        key = (sql, sess.catalog)
+        key = (sql, sess.catalog, sess.user)
         with self._lock:
             entry = self._plan_cache.get(key)
             if entry is not None:

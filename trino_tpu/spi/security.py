@@ -46,6 +46,17 @@ class AccessControl:
     def filter_tables(self, user: str, catalog: str, tables):
         return list(tables)
 
+    def get_row_filter(self, user: str, catalog: str, table: str):
+        """SQL predicate text restricting the rows ``user`` may see, or None
+        (reference: SystemAccessControl.getRowFilters -> ViewExpression;
+        the analyzer wraps the table in the filter before the query sees it)."""
+        return None
+
+    def get_column_masks(self, user: str, catalog: str, table: str) -> dict:
+        """{column -> SQL expression text} replacing column values for
+        ``user`` (reference: SystemAccessControl.getColumnMasks)."""
+        return {}
+
     def grant(self, grantor: str, grantee: str, catalog: str, table: str,
               privileges: set) -> None:
         raise NotImplementedError("this access control does not support GRANT")
@@ -125,6 +136,8 @@ class _Rule:
     catalog_re: re.Pattern
     table_re: Optional[re.Pattern]  # None = catalog-level rule
     allow: str  # all | read-only | none
+    row_filter: Optional[str] = None  # SQL predicate text (table rules only)
+    column_masks: tuple = ()  # ((column, SQL expr text), ...)
 
 
 class RuleBasedAccessControl(AccessControl):
@@ -134,9 +147,15 @@ class RuleBasedAccessControl(AccessControl):
         {"catalogs": [{"user": "ana.*", "catalog": "tpch", "allow": "read-only"},
                       {"catalog": ".*", "allow": "all"}],
          "tables":   [{"user": ".*", "catalog": "mem", "table": "secret.*",
-                       "allow": "none"}]}
+                       "allow": "none"},
+                      {"user": "analyst", "table": "orders",
+                       "filter": "o_totalprice < 1000",
+                       "column_masks": {"o_comment": "null"}}]}
 
     Omitted keys default to match-everything; an empty rule list allows all.
+    ``filter`` / ``column_masks`` (table rules) are the reference's
+    ViewExpression row filters and column masks — SQL text the planner splices
+    over the table before the query sees it.
     """
 
     def __init__(self, config: dict):
@@ -147,11 +166,27 @@ class RuleBasedAccessControl(AccessControl):
                     re.compile(e.get("user", ".*") + r"\Z"),
                     re.compile(e.get("catalog", ".*") + r"\Z"),
                     re.compile(e.get("table", ".*") + r"\Z") if with_table else None,
-                    e.get("allow", "all")))
+                    e.get("allow", "all"),
+                    e.get("filter"),
+                    tuple(sorted((e.get("column_masks") or {}).items()))))
             return out
 
         self.catalog_rules = compile_rules(config.get("catalogs", ()), False)
         self.table_rules = compile_rules(config.get("tables", ()), True)
+
+    def get_row_filter(self, user: str, catalog: str, table: str):
+        for r in self.table_rules:
+            if r.row_filter and r.user_re.match(user) \
+                    and r.catalog_re.match(catalog) and r.table_re.match(table):
+                return r.row_filter
+        return None
+
+    def get_column_masks(self, user: str, catalog: str, table: str) -> dict:
+        for r in self.table_rules:
+            if r.column_masks and r.user_re.match(user) \
+                    and r.catalog_re.match(catalog) and r.table_re.match(table):
+                return dict(r.column_masks)
+        return {}
 
     def _catalog_access(self, user: str, catalog: str) -> str:
         for r in self.catalog_rules:

@@ -1199,7 +1199,8 @@ class Planner:
                     unique_sets.append(frozenset(schema.index(c) for c in pk))
                 except KeyError:
                     pass
-            return RelPlan(scan, cols, unique_sets)
+            return self._apply_security_views(
+                RelPlan(scan, cols, unique_sets), catalog, name)
         if isinstance(node, A.SubqueryRef):
             return self._plan_subquery_rel(node.query, node.alias, node.columns)
         if isinstance(node, A.MatchRecognizeRef):
@@ -1207,6 +1208,51 @@ class Planner:
         if isinstance(node, A.TableFunctionRef):
             return self._plan_table_function(node)
         raise SemanticError(f"unsupported relation {node}")
+
+    def _apply_security_views(self, rel: RelPlan, catalog: str,
+                              table: str) -> RelPlan:
+        """Row filters and column masks from access control (reference:
+        spi/security ViewExpression — SystemAccessControl.getRowFilters /
+        getColumnMasks, applied by StatementAnalyzer before the query sees the
+        table).  Expressions are SQL text evaluated in the table's scope; a
+        masked column's expression replaces it in a projection directly over
+        the scan, a row filter wraps the scan in a Filter."""
+        ac = getattr(self.engine, "access_control", None)
+        user = getattr(self.session, "user", "user")
+        if ac is None or not (hasattr(ac, "get_row_filter")
+                              or hasattr(ac, "get_column_masks")):
+            return rel
+        node, cols = rel.node, rel.cols
+        rf = ac.get_row_filter(user, catalog, table) \
+            if hasattr(ac, "get_row_filter") else None
+        if rf:
+            pred_ast = A.Parser(rf).parse_expr()
+            pred, _ = self._translate(pred_ast, cols)
+            node = P.Filter(node, pred)
+        masks = ac.get_column_masks(user, catalog, table) \
+            if hasattr(ac, "get_column_masks") else None
+        if masks:
+            exprs, out_dicts, new_cols = [], [], []
+            for i, c in enumerate(cols):
+                m = masks.get(c.name)
+                if m is None:
+                    exprs.append(ir.FieldRef(i, c.type, c.name))
+                    out_dicts.append(c.dict)
+                    new_cols.append(c)
+                else:
+                    e, d = self._translate(A.Parser(m).parse_expr(), cols)
+                    e = _coerce(e, c.type) if not c.type.is_string else e
+                    exprs.append(e)
+                    out_dicts.append(d)
+                    new_cols.append(ColumnInfo(c.alias, c.name, e.type, d))
+            schema = Schema(tuple(Field(c.name, e.type)
+                                  for c, e in zip(new_cols, exprs)))
+            node = P.Project(node, tuple(exprs), schema, tuple(out_dicts))
+            cols = new_cols
+        if node is rel.node:
+            return rel
+        # masked/filtered relations lose PK uniqueness guarantees conservatively
+        return RelPlan(node, cols, rel.unique_sets if not masks else [])
 
     def _plan_table_function(self, node: A.TableFunctionRef) -> RelPlan:
         """TABLE(fn(...)) invocations (reference:
