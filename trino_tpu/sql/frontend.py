@@ -34,26 +34,18 @@ from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, UNKNOWN, DecimalTyp
 from . import ir
 from . import parser as A
 from . import plan as P
+from .analyzer import (AGG_FUNCS, ColumnInfo, ExpressionAnalyzer, SemanticError,
+                       _add_months_const, _arith, _coerce, _interval_days,
+                       _interval_months, _interval_seconds, _literal_number,
+                       _resolve_column, _rewrite_ast, _type_from_name)
 
 __all__ = ["compile_sql", "SemanticError"]
 
 
-class SemanticError(ValueError):
-    pass
 
 
-AGG_FUNCS = {"count", "sum", "avg", "min", "max",
-             "stddev", "stddev_pop", "stddev_samp", "variance", "var_pop", "var_samp",
-             "approx_distinct", "bool_and", "bool_or", "every", "arbitrary",
-             "any_value", "approx_percentile", "listagg"}
 
 
-@dataclasses.dataclass
-class ColumnInfo:
-    alias: Optional[str]  # relation alias
-    name: str  # column name
-    type: Type
-    dict: object = None  # Dictionary | None
 
 
 @dataclasses.dataclass
@@ -66,27 +58,6 @@ class RelPlan:
     # build side, DetermineJoinDistributionType.java:51)
 
 
-def _rewrite_ast(ast, fn):
-    """Apply fn top-down over every parser Node, recursing through nested
-    tuples (CaseExpr.whens holds (cond, value) pairs)."""
-    def walk(v):
-        if isinstance(v, A.Node):
-            out = fn(v)
-            if out is not v:
-                return out
-            changed = {}
-            for f in v.__dataclass_fields__:
-                fv = getattr(v, f)
-                nv = walk(fv)
-                if nv is not fv:
-                    changed[f] = nv
-            return dataclasses.replace(v, **changed) if changed else v
-        if isinstance(v, tuple):
-            items = tuple(walk(x) for x in v)
-            return items if any(a is not b for a, b in zip(items, v)) else v
-        return v
-
-    return walk(ast)
 
 
 def compile_sql(sql: str, engine, session) -> P.PlanNode:
@@ -94,7 +65,7 @@ def compile_sql(sql: str, engine, session) -> P.PlanNode:
     return Planner(engine, session).plan_query(ast)
 
 
-class Planner:
+class Planner(ExpressionAnalyzer):
     def __init__(self, engine, session):
         self.engine = engine
         self.session = session
@@ -1810,647 +1781,6 @@ class Planner:
         return self._finish_aggregation(q, node, items, all_asts, uniq_aggs,
                                         agg_cols, [])
 
-    # ---------------------------------------------------------------- expression translation
-    # ---------------------------------------------------------------- arrays/maps/rows
-    def _translate_array_literal(self, ast: A.ArrayLiteral, cols):
-        """ARRAY[c1, ..., ck] with constant elements -> a span constant + a
-        plan-time element heap (ops/arrays.ArrayData riding the dictionary
-        slot).  Reference: sql/ir constant folding of ArrayConstructor."""
-        from ..connectors.tpch import Dictionary
-        from ..ops.arrays import ArrayData, pack_span
-        from ..types import ArrayType, VARCHAR
-
-        items = ast.items
-        if items and all(isinstance(i, A.StringLit) for i in items):
-            values = np.array(sorted({i.value for i in items}), dtype=object)
-            d = Dictionary(values=values)
-            heap = np.array([d.lookup(i.value) for i in items], np.int32)
-            t = VARCHAR
-            return (ir.Constant(pack_span(0, len(items)), ArrayType.of(t)),
-                    ArrayData(heap, t, elem_dict=d, max_len=len(items)))
-        consts = []
-        for it in items:
-            e, _ = self._translate(it, cols)
-            if not isinstance(e, ir.Constant) or e.value is None:
-                raise SemanticError(
-                    "array literal elements must be non-NULL constants")
-            consts.append(e)
-        t = BIGINT if not consts else consts[0].type
-        for e in consts[1:]:
-            t = common_super_type(t, e.type)
-        vals = []
-        for e in consts:
-            v = e.value
-            if t.is_floating and not e.type.is_floating:
-                scale = 10 ** e.type.scale if e.type.is_decimal else 1
-                v = float(v) / scale
-            elif t.is_decimal:
-                v = int(v) * 10 ** (t.scale - (e.type.scale if e.type.is_decimal else 0))
-            vals.append(v)
-        heap = np.asarray(vals, dtype=np.dtype(t.dtype)) if vals \
-            else np.zeros(0, np.dtype(t.dtype))
-        return (ir.Constant(pack_span(0, len(vals)), ArrayType.of(t)),
-                ArrayData(heap, t, max_len=len(vals)))
-
-    def _translate_subscript(self, ast: A.Subscript, cols):
-        """base[i] — arrays/maps gather from the heap; ROW field access folds
-        at plan time (struct-of-columns: the i-th constructor argument IS the
-        field)."""
-        from ..types import ArrayType, MapType
-
-        if isinstance(ast.base, A.FuncCall) and ast.base.name == "row":
-            if not isinstance(ast.index, A.NumberLit):
-                raise SemanticError("row subscript must be a literal ordinal")
-            i = int(ast.index.text)
-            if not (1 <= i <= len(ast.base.args)):
-                raise SemanticError(f"row field ordinal {i} out of range")
-            return self._translate(ast.base.args[i - 1], cols)
-        base, bd = self._translate(ast.base, cols)
-        if isinstance(base.type, ArrayType):
-            if bd is None:
-                raise SemanticError("array value carries no element heap")
-            idx, _ = self._translate(ast.index, cols)
-            e = ir.Call("array_get",
-                        (base, _coerce(idx, BIGINT),
-                         ir.Constant(np.asarray(bd.values), UNKNOWN)),
-                        bd.elem_type)
-            return e, bd.elem_dict
-        if isinstance(base.type, MapType):
-            return self._translate_map_get(base, bd, ast.index, cols)
-        raise SemanticError(f"cannot subscript a value of type {base.type}")
-
-    def _translate_map_get(self, base, md, key_ast, cols):
-        if md is None:
-            raise SemanticError("map value carries no element heaps")
-        if isinstance(key_ast, A.StringLit):
-            if md.key_dict is None:
-                raise SemanticError("string key over a non-string map")
-            key = ir.Constant(md.key_dict.lookup(key_ast.value), VarcharType.of(None))
-        else:
-            key, _ = self._translate(key_ast, cols)
-        e = ir.Call("map_get",
-                    (base, key, ir.Constant(np.asarray(md.keys), UNKNOWN),
-                     ir.Constant(np.asarray(md.values), UNKNOWN)),
-                    md.value_type, meta=(max(md.max_len, 1),))
-        return e, md.value_dict
-
-    def _translate_collection_func(self, ast: A.FuncCall, cols):
-        """cardinality/element_at/contains/sequence/map/map_keys/map_values/row
-        (reference: operator/scalar/ArrayFunctions, MapFunctions,
-        SequenceFunction)."""
-        from ..ops.arrays import ArrayData, MapData, pack_span
-        from ..types import ArrayType, MapType, RowType
-
-        name, args = ast.name, ast.args
-        if name == "cardinality":
-            e, d = self._translate(args[0], cols)
-            if not isinstance(e.type, (ArrayType, MapType)):
-                raise SemanticError("cardinality expects an array or map")
-            return ir.Call("span_len", (e,), BIGINT), None
-        if name == "element_at":
-            return self._translate_subscript(
-                A.Subscript(args[0], args[1]), cols)
-        if name == "contains":
-            base, bd = self._translate(args[0], cols)
-            if not isinstance(base.type, ArrayType) or bd is None:
-                raise SemanticError("contains expects an array")
-            if isinstance(args[1], A.StringLit):
-                if bd.elem_dict is None:
-                    raise SemanticError("string needle over a non-string array")
-                needle = ir.Constant(bd.elem_dict.lookup(args[1].value),
-                                     VarcharType.of(None))
-            else:
-                needle, _ = self._translate(args[1], cols)
-            e = ir.Call("array_contains",
-                        (base, needle, ir.Constant(np.asarray(bd.values), UNKNOWN)),
-                        BOOLEAN, meta=(max(bd.max_len, 1),))
-            return e, None
-        if name in ("array_min", "array_max", "array_sum", "array_average"):
-            base, bd = self._translate(args[0], cols)
-            if not isinstance(base.type, ArrayType) or bd is None:
-                raise SemanticError(f"{name} expects an array")
-            kind = name[len("array_"):].replace("average", "avg")
-            et = base.type.element
-            out_t = DOUBLE if kind == "avg" else \
-                (BIGINT if et.is_integer else et)
-            if et.is_string and kind in ("min", "max"):
-                raise SemanticError(f"{name} over string arrays not supported")
-            e = ir.Call("array_reduce",
-                        (base, ir.Constant(np.asarray(bd.values), UNKNOWN)),
-                        out_t, meta=(max(bd.max_len, 1), kind))
-            return e, None
-        if name == "array_position":
-            base, bd = self._translate(args[0], cols)
-            if not isinstance(base.type, ArrayType) or bd is None:
-                raise SemanticError("array_position expects an array")
-            if isinstance(args[1], A.StringLit):
-                if bd.elem_dict is None:
-                    raise SemanticError("string needle over a non-string array")
-                needle = ir.Constant(bd.elem_dict.lookup(args[1].value),
-                                     VarcharType.of(None))
-            else:
-                needle, _ = self._translate(args[1], cols)
-            e = ir.Call("array_position",
-                        (base, needle,
-                         ir.Constant(np.asarray(bd.values), UNKNOWN)),
-                        BIGINT, meta=(max(bd.max_len, 1),))
-            return e, None
-        if name == "sequence":
-            vals = []
-            for a in args:
-                e, _ = self._translate(a, cols)
-                if not isinstance(e, ir.Constant):
-                    raise SemanticError("sequence bounds must be constants")
-                vals.append(int(e.value))
-            lo, hi = vals[0], vals[1]
-            step = vals[2] if len(vals) > 2 else 1
-            if step == 0:
-                raise SemanticError("sequence step must not be zero")
-            heap = np.arange(lo, hi + (1 if step > 0 else -1), step, dtype=np.int64)
-            return (ir.Constant(pack_span(0, len(heap)), ArrayType.of(BIGINT)),
-                    ArrayData(heap, BIGINT, max_len=len(heap)))
-        if name == "map":
-            (ke, kd) = self._translate(args[0], cols)
-            (ve, vd) = self._translate(args[1], cols)
-            if not (isinstance(ke, ir.Constant) and isinstance(ve, ir.Constant)
-                    and isinstance(ke.type, ArrayType)
-                    and isinstance(ve.type, ArrayType)):
-                raise SemanticError("map() expects constant array arguments")
-            if len(kd.values) != len(vd.values):
-                raise SemanticError("map keys/values length mismatch")
-            md = MapData(kd.values, vd.values, kd.elem_type, vd.elem_type,
-                         kd.elem_dict, vd.elem_dict, max_len=kd.max_len)
-            t = MapType.of(kd.elem_type, vd.elem_type)
-            return ir.Constant(int(ke.value), t), md
-        if name in ("map_keys", "map_values"):
-            e, md = self._translate(args[0], cols)
-            if not isinstance(e.type, MapType) or md is None:
-                raise SemanticError(f"{name} expects a map")
-            arr = (ArrayData(md.keys, md.key_type, md.key_dict, md.max_len)
-                   if name == "map_keys"
-                   else ArrayData(md.values, md.value_type, md.value_dict,
-                                  md.max_len))
-            t = ArrayType.of(arr.elem_type)
-            return dataclasses.replace(e, type=t), arr
-        if name == "row":
-            # struct-of-columns: a row value only exists through field access
-            # (folded in _translate_subscript); reaching here means it escaped
-            raise SemanticError(
-                "row(...) values must be field-accessed (row(...)[n]); "
-                "standalone row channels flatten at plan time")
-        if name in ("transform", "filter", "any_match", "all_match",
-                    "none_match"):
-            # higher-order array lambdas (reference:
-            # operator/scalar/ArrayTransformFunction, ArrayFilterFunction,
-            # ArrayAnyMatchFunction...).  The heap is a plan-time constant, so
-            # the lambda evaluates ONCE over the whole element heap here —
-            # the same per-distinct-value trick as the string LUTs — and the
-            # device-side work stays span-only: transform reuses the spans
-            # over a rewritten heap; filter maps spans through the kept-
-            # element exclusive cumsum (two gathers, no heap traffic).
-            base, bd = self._translate(args[0], cols)
-            if not isinstance(base.type, ArrayType) or bd is None:
-                raise SemanticError(f"{name} expects an array")
-            lam = args[1] if len(args) > 1 else None
-            if not isinstance(lam, A.Lambda) or len(lam.params) != 1:
-                raise SemanticError(f"{name} expects a one-parameter lambda")
-            body_ir, out_vals, out_nulls = self._eval_lambda_on_heap(lam, bd)
-            if name == "transform":
-                if out_nulls is not None:
-                    raise SemanticError(
-                        "transform lambdas yielding NULLs are not supported")
-                heap = np.asarray(out_vals)
-                from ..ops.arrays import ArrayData
-
-                t = ArrayType.of(body_ir.type)
-                # spans are unchanged; only the element heap (and type) moves
-                return (ir.Call("span_id", (base,), t),
-                        ArrayData(heap, body_ir.type, None,
-                                  max_len=bd.max_len))
-            if body_ir.type.name != "boolean":
-                raise SemanticError(f"{name} lambda must return boolean")
-            keep = np.asarray(out_vals).astype(bool)
-            if out_nulls is not None:  # NULL predicate = no match
-                keep = keep & ~np.asarray(out_nulls)
-            excl = np.zeros(len(keep) + 1, np.int64)
-            np.cumsum(keep, out=excl[1:])
-            filt = ir.Call("span_filter",
-                           (base, ir.Constant(excl, UNKNOWN)),
-                           base.type)
-            if name == "filter":
-                from ..ops.arrays import ArrayData
-
-                heap = np.asarray(bd.values)[keep]
-                return filt, ArrayData(heap, bd.elem_type, bd.elem_dict,
-                                       max_len=bd.max_len)
-            kept_len = ir.Call("span_len", (filt,), BIGINT)
-            if name == "any_match":
-                return ir.Call("gt", (kept_len, ir.Constant(0, BIGINT)),
-                               BOOLEAN), None
-            if name == "none_match":
-                return ir.Call("eq", (kept_len, ir.Constant(0, BIGINT)),
-                               BOOLEAN), None
-            total_len = ir.Call("span_len", (base,), BIGINT)
-            return ir.Call("eq", (kept_len, total_len), BOOLEAN), None
-        raise SemanticError(f"unknown collection function {name}")
-
-    def _eval_lambda_on_heap(self, lam, bd):
-        """Translate a one-parameter lambda against an array's element heap
-        and evaluate it EAGERLY over every heap element (plan-time, like the
-        string-function LUTs).  Returns (body_ir, values, null_mask|None)."""
-        elem_cols = [ColumnInfo(None, lam.params[0], bd.elem_type,
-                                bd.elem_dict)]
-        body_ir, _ = self._translate(lam.body, elem_cols)
-        import jax.numpy as jnp
-
-        heap = jnp.asarray(np.asarray(bd.values))
-        vals, nulls = ir.evaluate(body_ir, (heap,), (None,))
-        return (body_ir, np.asarray(vals),
-                None if nulls is None else np.asarray(nulls))
-
-    def _try_translate(self, ast, cols):
-        try:
-            e, _ = self.translate(ast, cols)
-            return e
-        except SemanticError:
-            return None
-
-    def translate(self, ast, cols) -> tuple:
-        """AST expr -> (ir.Expr, Dictionary|None)."""
-        t = self._translate(ast, cols)
-        return t
-
-    def _translate(self, ast, cols):
-        if isinstance(ast, A.NumberLit):
-            return _literal_number(ast.text), None
-        if isinstance(ast, A.StringLit):
-            raise SemanticError(f"string literal {ast.value!r} outside comparison context")
-        if isinstance(ast, A.DateLit):
-            return ir.Constant(parse_date_literal(ast.value), DATE), None
-        if isinstance(ast, A.TimestampLit):
-            from ..types import parse_timestamp_literal
-
-            try:
-                v, ty = parse_timestamp_literal(ast.value)
-            except ValueError as e:
-                raise SemanticError(str(e)) from e
-            return ir.Constant(v, ty), None
-        if isinstance(ast, A.NullLit):
-            return ir.Constant(None, UNKNOWN), None
-        if isinstance(ast, A.BoolLit):
-            return ir.Constant(ast.value, BOOLEAN), None
-        if isinstance(ast, A.ArrayLiteral):
-            return self._translate_array_literal(ast, cols)
-        if isinstance(ast, A.Subscript):
-            return self._translate_subscript(ast, cols)
-        if isinstance(ast, A.Identifier):
-            ch = _resolve_column(ast, cols)
-            c = cols[ch]
-            return ir.FieldRef(ch, c.type, c.name), c.dict
-        if isinstance(ast, A.UnaryOp):
-            if ast.op == "not":
-                e, _ = self._translate(ast.operand, cols)
-                return ir.Call("not", (e,), BOOLEAN), None
-            e, _ = self._translate(ast.operand, cols)
-            if isinstance(e, ir.Constant) and e.value is not None:
-                # fold so negative literals stay constants (array literals,
-                # sequence bounds, IN lists expect constant elements)
-                return ir.Constant(-e.value, e.type), None
-            return ir.Call("negate", (e,), e.type), None
-        if isinstance(ast, A.BinaryOp):
-            return self._translate_binary(ast, cols)
-        if isinstance(ast, A.Between):
-            v, vd = self._translate(ast.value, cols)
-            lo = self._translate_vs(ast.low, v, vd, cols)
-            hi = self._translate_vs(ast.high, v, vd, cols)
-            t = common_super_type(common_super_type(v.type, lo.type), hi.type)
-            e = ir.Call("between", (_coerce(v, t), _coerce(lo, t), _coerce(hi, t)), BOOLEAN)
-            if ast.negated:
-                e = ir.Call("not", (e,), BOOLEAN)
-            return e, None
-        if isinstance(ast, A.InList):
-            v, vd = self._translate(ast.value, cols)
-            lits = [self._translate_vs(item, v, vd, cols) for item in ast.items]
-            t = v.type
-            for l in lits:
-                t = common_super_type(t, l.type)
-            e = ir.Call("in", tuple([_coerce(v, t)] + [_coerce(l, t) for l in lits]), BOOLEAN)
-            if ast.negated:
-                e = ir.Call("not", (e,), BOOLEAN)
-            return e, None
-        if isinstance(ast, A.Like):
-            return self._translate_like(ast, cols)
-        if isinstance(ast, A.IsNull):
-            v, _ = self._translate(ast.value, cols)
-            e = ir.Call("is_null", (v,), BOOLEAN)
-            if ast.negated:
-                e = ir.Call("not", (e,), BOOLEAN)
-            return e, None
-        if isinstance(ast, A.CaseExpr):
-            return self._translate_case(ast, cols)
-        if isinstance(ast, A.Cast):
-            from ..types import CharType
-
-            t = _type_from_name(ast.type_name, ast.params)
-            if getattr(ast, "safe", False):
-                return self._try_cast(ast.value, t, cols)
-            if isinstance(t, CharType):
-                # char(n) semantics: truncate past n, SPACE-PAD to n — the
-                # padded form makes char comparisons trailing-space-blind
-                # (reference: spi/type/CharType + Chars.padSpaces)
-                if isinstance(ast.value, A.StringLit):
-                    from ..connectors.tpch import Dictionary
-
-                    padded = ast.value.value[:t.length].ljust(t.length)
-                    return ir.Constant(0, t), Dictionary(
-                        values=np.array([padded], dtype=object))
-                v, d = self._translate(ast.value, cols)
-                if d is None or getattr(d, "values", None) is None:
-                    raise SemanticError(
-                        "cast to char needs a dictionary-backed string source")
-                lut, nd = d.map_values(
-                    lambda s, n_=t.length: str(s)[:n_].ljust(n_))
-                return ir.Call("lut", (v, ir.Constant(lut, t)), t), nd
-            v, d = self._translate(ast.value, cols)
-            return _coerce(v, t), (d if t.is_string else None)
-        if isinstance(ast, A.Extract):
-            from .functions import timestamp_part
-
-            v, _ = self._translate(ast.value, cols)
-            field = {"dow": "day_of_week", "doy": "day_of_year"}.get(
-                ast.field, ast.field)
-            return timestamp_part(v, field), None
-        if isinstance(ast, A.FuncCall):
-            return self._translate_func(ast, cols)
-        if isinstance(ast, A.ScalarSubquery):
-            return self._eager_scalar(ast.query), None
-        raise SemanticError(f"unsupported expression {ast}")
-
-    def _translate_vs(self, ast, other: ir.Expr, other_dict, cols) -> ir.Expr:
-        """Translate ``ast`` in the context of comparison against ``other`` (resolves string
-        literals to dictionary ids)."""
-        if isinstance(ast, A.StringLit):
-            from ..types import CharType, TimestampType
-
-            if isinstance(other.type, CharType) and other_dict is not None:
-                # char comparison ignores trailing spaces: both sides live
-                # space-padded to the declared length in the dictionary
-                n_ = other.type.length
-                return ir.Constant(
-                    other_dict.lookup(ast.value[:n_].ljust(n_)), other.type)
-            if other.type.is_string and other_dict is not None:
-                return ir.Constant(other_dict.lookup(ast.value), other.type)
-            if other.type.name == "date":
-                return ir.Constant(parse_date_literal(ast.value), DATE)
-            if isinstance(other.type, TimestampType):
-                from ..types import parse_timestamp_literal
-
-                # keep the literal's OWN precision: the comparison path
-                # coerces both sides to the common (finer) precision, so a
-                # sub-unit literal never falsely equals a coarser column
-                v, ty = parse_timestamp_literal(ast.value)
-                return ir.Constant(v, ty)
-            raise SemanticError(f"cannot compare string literal to {other.type}")
-        e, _ = self._translate(ast, cols)
-        return e
-
-    def _translate_binary(self, ast: A.BinaryOp, cols):
-        op = ast.op
-        if op in ("and", "or"):
-            l, _ = self._translate(ast.left, cols)
-            r, _ = self._translate(ast.right, cols)
-            return ir.Call(op, (l, r), BOOLEAN), None
-        if op in ("eq", "neq", "lt", "lte", "gt", "gte"):
-            # string-literal side gets dictionary resolution
-            if isinstance(ast.right, A.StringLit) and not isinstance(ast.left, A.StringLit):
-                l, ld = self._translate(ast.left, cols)
-                r = self._translate_vs(ast.right, l, ld, cols)
-            elif isinstance(ast.left, A.StringLit) and not isinstance(ast.right, A.StringLit):
-                r, rd = self._translate(ast.right, cols)
-                l = self._translate_vs(ast.left, r, rd, cols)
-            else:
-                l, _ = self._translate(ast.left, cols)
-                r, _ = self._translate(ast.right, cols)
-            t = common_super_type(l.type, r.type)
-            if t.is_string and op not in ("eq", "neq"):
-                raise SemanticError("ordering comparison on strings not supported yet")
-            return ir.Call(op, (_coerce(l, t), _coerce(r, t)), BOOLEAN), None
-        # arithmetic, incl. date +/- interval constant folding
-        r_interval = isinstance(ast.right, A.IntervalLit)
-        if r_interval:
-            from ..types import TimestampType
-
-            l, _ = self._translate(ast.left, cols)
-            if isinstance(l.type, TimestampType):
-                # timestamp +/- interval: scale the interval to the value's
-                # precision units (day-time intervals only; month/year would
-                # need civil-calendar arithmetic on device)
-                if op not in ("add", "subtract"):
-                    raise SemanticError(
-                        f"invalid timestamp/interval arithmetic {op}")
-                secs = _interval_seconds(ast.right)
-                if secs is None:
-                    raise SemanticError(
-                        "timestamp +/- year-month intervals not supported yet")
-                delta = secs * 10 ** l.type.precision
-                delta = delta if op == "add" else -delta
-                if isinstance(l, ir.Constant):
-                    return ir.Constant(l.value + delta, l.type), None
-                return ir.Call("add", (l, ir.Constant(delta, BIGINT)),
-                               l.type), None
-            days = _interval_days(ast.right)
-            if days is not None:
-                delta = days if op == "add" else -days
-                if isinstance(l, ir.Constant):
-                    return ir.Constant(l.value + delta, DATE), None
-                return ir.Call("add", (l, ir.Constant(delta, INTEGER)), DATE), None
-            months = _interval_months(ast.right)
-            if isinstance(l, ir.Constant):
-                return ir.Constant(_add_months_const(l.value, months if op == "add" else -months), DATE), None
-            raise SemanticError("runtime date +/- month interval not supported yet")
-        l, _ = self._translate(ast.left, cols)
-        r, _ = self._translate(ast.right, cols)
-        return _arith(op, l, r), None
-
-    def _translate_like(self, ast: A.Like, cols):
-        v, d = self._translate(ast.value, cols)
-        if not isinstance(ast.pattern, A.StringLit):
-            raise SemanticError("only literal LIKE patterns supported")
-        if d is None:
-            raise SemanticError("LIKE on non-dictionary expression not supported")
-        pat = ast.pattern.value
-        rx = re.compile("^" + "".join(
-            ".*" if ch == "%" else "." if ch == "_" else re.escape(ch) for ch in pat) + "$")
-        lut = d.match(lambda s: bool(rx.match(s)))
-        e = ir.Call("lut", (v, ir.Constant(lut, BOOLEAN)), BOOLEAN)
-        if ast.negated:
-            e = ir.Call("not", (e,), BOOLEAN)
-        return e, None
-
-    def _translate_case(self, ast: A.CaseExpr, cols):
-        # string-literal result branches build a small derived dictionary so values stay
-        # ids on device (reference analog: VARCHAR constants in generated projections)
-        value_asts = [v for _, v in ast.whens] + (
-            [ast.default] if ast.default is not None else [])
-        if all(isinstance(v, (A.StringLit, A.NullLit)) for v in value_asts) and any(
-                isinstance(v, A.StringLit) for v in value_asts):
-            from ..connectors.tpch import Dictionary
-
-            uniq = sorted({v.value for v in value_asts if isinstance(v, A.StringLit)})
-            d = Dictionary(values=np.array(uniq, dtype=object))
-            t = VarcharType.of(None)
-
-            def as_const(v):
-                if isinstance(v, A.NullLit):
-                    return ir.Constant(None, t)
-                return ir.Constant(uniq.index(v.value), t)
-
-            out = (as_const(ast.default) if ast.default is not None
-                   else ir.Constant(None, t))
-            for cond, val in reversed(ast.whens):
-                if ast.operand is not None:
-                    cond = A.BinaryOp("eq", ast.operand, cond)
-                c, _ = self._translate(cond, cols)
-                out = ir.Call("if", (c, as_const(val), out), t)
-            return out, d
-        whens = []
-        for cond, val in ast.whens:
-            if ast.operand is not None:
-                cond = A.BinaryOp("eq", ast.operand, cond)
-            c, _ = self._translate(cond, cols)
-            v, _ = self._translate(val, cols)
-            whens.append((c, v))
-        default = None
-        if ast.default is not None:
-            default, _ = self._translate(ast.default, cols)
-        t = whens[0][1].type
-        for _, v in whens[1:]:
-            t = common_super_type(t, v.type)
-        if default is not None:
-            t = common_super_type(t, default.type)
-        out = _coerce(default, t) if default is not None else ir.Constant(None, t)
-        for c, v in reversed(whens):
-            out = ir.Call("if", (c, _coerce(v, t), out), t)
-        return out, None
-
-
-    _COLLECTION_FUNCS = ("cardinality", "element_at", "contains", "sequence",
-                         "map", "map_keys", "map_values", "row",
-                         "array_min", "array_max", "array_sum",
-                         "array_average", "array_position",
-                         "transform", "filter", "any_match", "all_match",
-                         "none_match")
-
-    def _translate_func(self, ast: A.FuncCall, cols):
-        """Registry dispatch (reference: the analyzer resolving calls against
-        the one registered catalog, metadata/SystemFunctionBundle.java:384).
-        Every executable scalar lives in sql/functions.py as a builder-backed
-        FunctionDef; only genuinely structural forms (CASE, IN, casts,
-        subscripts) translate outside the registry."""
-        name = ast.name
-        if name in AGG_FUNCS:
-            raise SemanticError(f"aggregate {name} in scalar context")
-        from .functions import lookup
-
-        fdef = lookup(name)
-        if fdef is not None and fdef.builder is not None:
-            lo, hi = fdef.arity
-            if len(ast.args) < lo or (hi is not None and len(ast.args) > hi):
-                raise SemanticError(
-                    f"{name} expects {lo}"
-                    + ("" if hi == lo else f"..{hi if hi is not None else 'n'}")
-                    + f" arguments, got {len(ast.args)}")
-            return fdef.builder(self, ast, cols)
-        if name in self._COLLECTION_FUNCS:
-            return self._translate_collection_func(ast, cols)
-        routine = getattr(self.engine, "sql_routines", {}).get(name)
-        if routine is not None:
-            return self._inline_routine(name, routine, ast, cols)
-        raise SemanticError(f"function {name} not supported")
-
-    def _inline_routine(self, name, routine, ast, cols):
-        """Inline a CREATE FUNCTION routine body at the call site: parameter
-        identifiers substitute with the argument ASTs, then the rewritten body
-        translates like any expression (reference:
-        sql/routine/SqlRoutineCompiler.java:108 — an expression-bodied routine
-        reduces to exactly this inlining)."""
-        params, rt, body = routine
-        if len(ast.args) != len(params):
-            raise SemanticError(
-                f"{name} expects {len(params)} arguments, got {len(ast.args)}")
-        depth = getattr(self, "_routine_depth", 0)
-        if depth >= 16:
-            raise SemanticError(f"SQL routine recursion too deep at {name}")
-        # arguments coerce to the DECLARED parameter types before substitution
-        # (Trino semantics: half(5) with half(x double) divides as double)
-        pmap = {pn: A.Cast(arg, tn, tuple(tp or ()))
-                for (pn, tn, tp), arg in zip(params, ast.args)}
-        rewritten = _rewrite_ast(
-            body, lambda n: pmap.get(n.parts[0], n)
-            if isinstance(n, A.Identifier) and len(n.parts) == 1 else n)
-        self._routine_depth = depth + 1
-        try:
-            e, d = self._translate(rewritten, cols)
-        finally:
-            self._routine_depth = depth
-        declared = _type_from_name(*rt)
-        return _coerce(e, declared), (d if declared.is_string else None)
-
-    def _require_dict(self, arg_ast, cols, fname):
-        v, d = self._translate(arg_ast, cols)
-        if d is None or d.values is None:
-            raise SemanticError(
-                f"{fname} requires an enumerable dictionary-encoded string column")
-        return v, d
-
-    @staticmethod
-    def _literal_str(arg_ast, fname) -> str:
-        if not isinstance(arg_ast, A.StringLit):
-            raise SemanticError(f"{fname} pattern arguments must be string literals")
-        return arg_ast.value
-
-    def _translate_concat(self, args, cols):
-        """concat / ||: one dictionary column combined with any number of string
-        literals (two dictionary columns would need a product dictionary)."""
-        parts = []  # ("lit", str) | ("col", expr, dict)
-        for a in args:
-            if isinstance(a, A.StringLit):
-                parts.append(("lit", a.value))
-                continue
-            v, d = self._require_dict(a, cols, "concat")
-            parts.append(("col", v, d))
-        col_parts = [p for p in parts if p[0] == "col"]
-        if len(col_parts) != 1:
-            raise SemanticError(
-                "concat supports exactly one string column plus literals for now")
-        _, v, d = col_parts[0]
-        prefix = "".join(p[1] for p in parts[:parts.index(col_parts[0])]
-                         if p[0] == "lit")
-        suffix = "".join(p[1] for p in parts[parts.index(col_parts[0]) + 1:]
-                         if p[0] == "lit")
-        lut, nd = d.map_values(lambda s: f"{prefix}{s}{suffix}")
-        t = VarcharType.of(None)
-        return ir.Call("lut", (v, ir.Constant(lut, t)), t), nd
-
-    # ---------------------------------------------------------------- output resolution
-    def _resolve_output_channel(self, expr, out_names, out_exprs_ast) -> int:
-        if isinstance(expr, A.NumberLit):
-            return int(expr.text) - 1
-        if isinstance(expr, A.Identifier) and len(expr.parts) == 1:
-            if expr.parts[0] in out_names:
-                return out_names.index(expr.parts[0])
-        for i, e in enumerate(out_exprs_ast):
-            if e == expr:
-                return i
-        # single-part identifier that matches an output column name suffix
-        if isinstance(expr, A.Identifier):
-            for i, e in enumerate(out_exprs_ast):
-                if isinstance(e, A.Identifier) and e.parts[-1] == expr.parts[-1]:
-                    return i
-        raise SemanticError(f"ORDER BY expression not in output: {expr}")
-
-
-# ---------------------------------------------------------------------- helpers
 
 
 class _PostAggScope:
@@ -2788,134 +2118,14 @@ def _ensure_channel(node: P.PlanNode, expr: ir.Expr, cols):
     return len(schema.fields), P.Project(node, exprs, new_schema)
 
 
-def _resolve_column(ident: A.Identifier, cols) -> int:
-    parts = ident.parts
-    if len(parts) >= 2:
-        alias, name = parts[-2], parts[-1]
-        for i, c in enumerate(cols):
-            if c.alias == alias and c.name == name:
-                return i
-        raise SemanticError(f"column {'.'.join(parts)} not found")
-    name = parts[0]
-    hits = [i for i, c in enumerate(cols) if c.name == name]
-    if len(hits) == 1:
-        return hits[0]
-    if not hits:
-        raise SemanticError(f"column {name} not found")
-    raise SemanticError(f"column {name} is ambiguous")
 
 
-def _literal_number(text: str) -> ir.Constant:
-    if "e" in text.lower():
-        return ir.Constant(float(text), DOUBLE)
-    if "." in text:
-        frac = text.split(".")[1]
-        scale = len(frac)
-        digits = text.replace(".", "").lstrip("0") or "0"
-        return ir.Constant(int(text.replace(".", "")), DecimalType.of(max(len(digits), scale + 1), scale))
-    v = int(text)
-    return ir.Constant(v, INTEGER if -(2**31) <= v < 2**31 else BIGINT)
 
 
-def _coerce(e: ir.Expr, t: Type) -> ir.Expr:
-    if e.type.name == t.name:
-        return e
-    if isinstance(e, ir.Constant) and e.value is None:
-        return ir.Constant(None, t)
-    if isinstance(t, DecimalType) and isinstance(e.type, DecimalType):
-        if isinstance(e, ir.Constant):
-            diff = t.scale - e.type.scale
-            v = e.value * (10**diff) if diff >= 0 else round(e.value / 10**-diff)
-            return ir.Constant(v, t)
-        return ir.Call("cast", (e,), t)
-    if isinstance(e, ir.Constant) and not isinstance(e.value, np.ndarray):
-        # fold constant casts
-        if isinstance(t, DecimalType):
-            if e.type.is_integer:
-                return ir.Constant(int(e.value) * 10**t.scale, t)
-            if e.type.is_floating:
-                return ir.Constant(round(e.value * 10**t.scale), t)
-        if t.is_floating:
-            if isinstance(e.type, DecimalType):
-                return ir.Constant(e.value / 10**e.type.scale, t)
-            return ir.Constant(float(e.value), t)
-        if t.is_integer:
-            return ir.Constant(int(e.value), t)
-    return ir.Call("cast", (e,), t)
 
 
-def _arith(op: str, l: ir.Expr, r: ir.Expr) -> ir.Expr:
-    lt, rt = l.type, r.type
-    if lt.name == "date" or rt.name == "date":
-        if op in ("add", "subtract") and (lt.name == "date") != (rt.name == "date"):
-            return ir.Call(op, (l, r), DATE)
-        if op == "subtract" and lt.name == rt.name == "date":
-            return ir.Call(op, (l, r), BIGINT)
-        raise SemanticError(f"invalid date arithmetic {op}")
-    if isinstance(lt, DecimalType) and rt.is_integer:
-        r = _coerce(r, DecimalType.of(18, 0))
-        rt = r.type
-    if isinstance(rt, DecimalType) and lt.is_integer:
-        l = _coerce(l, DecimalType.of(18, 0))
-        lt = l.type
-    if isinstance(lt, DecimalType) and isinstance(rt, DecimalType):
-        if op in ("add", "subtract"):
-            s = max(lt.scale, rt.scale)
-            t = DecimalType.of(min(max(lt.precision - lt.scale, rt.precision - rt.scale) + s + 1, 38), s)
-            return ir.Call(op, (_coerce(l, DecimalType.of(18, s)), _coerce(r, DecimalType.of(18, s))), t)
-        if op == "multiply":
-            s = lt.scale + rt.scale
-            if s > 12:
-                return ir.Call("multiply", (_coerce(l, DOUBLE), _coerce(r, DOUBLE)), DOUBLE)
-            return ir.Call(op, (l, r), DecimalType.of(min(lt.precision + rt.precision + 1, 38), s))
-        if op == "divide":
-            # deviation: decimal division computes in double (documented in module docstring)
-            return ir.Call("divide", (_coerce(l, DOUBLE), _coerce(r, DOUBLE)), DOUBLE)
-        if op == "modulus":
-            s = max(lt.scale, rt.scale)
-            return ir.Call(op, (_coerce(l, DecimalType.of(18, s)), _coerce(r, DecimalType.of(18, s))),
-                           DecimalType.of(18, s))
-    t = common_super_type(lt, rt)
-    if op == "divide" and t.is_integer:
-        return ir.Call(op, (_coerce(l, t), _coerce(r, t)), t)
-    return ir.Call(op, (_coerce(l, t), _coerce(r, t)), t)
 
 
-def _type_from_name(name: str, params) -> Type:
-    from ..types import (ArrayType, BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER,
-                         MapType, REAL, RowType, SMALLINT, TINYINT)
-
-    m = {"bigint": BIGINT, "integer": INTEGER, "int": INTEGER, "smallint": SMALLINT,
-         "tinyint": TINYINT, "double": DOUBLE, "real": REAL, "boolean": BOOLEAN, "date": DATE}
-    if name in m:
-        return m[name]
-    if name == "decimal":
-        # declared precision up to 38 (reference: spi/type/DecimalType with
-        # Int128 long decimals).  Storage stays scaled int64 — value-domain
-        # |v| < 2^63 is checked at ingest — while SUMS beyond 2^63 stay exact
-        # via the two-limb accumulators (ops/hashagg sum_hi32/sum_lo32).
-        p = params[0] if params else 18
-        s = params[1] if len(params) > 1 else 0
-        return DecimalType.of(p, s)
-    if name == "timestamp":
-        from ..types import TimestampType
-
-        return TimestampType.of(params[0] if params else 3)
-    if name == "char":
-        from ..types import CharType
-
-        return CharType.of(params[0] if params else 1)
-    if name == "varchar":
-        return VarcharType.of(params[0] if params else None)
-    if name == "array" and params:
-        return ArrayType.of(_type_from_name(*params[0]))
-    if name == "map" and len(params) == 2:
-        return MapType.of(_type_from_name(*params[0]), _type_from_name(*params[1]))
-    if name == "row" and params:
-        names = [fn for fn, _ in params]
-        types = [_type_from_name(*tn) for _, tn in params]
-        return RowType.of(types, names)
-    raise SemanticError(f"unknown type {name}")
 
 
 def _derive_name(ast, i: int) -> str:
@@ -2924,31 +2134,9 @@ def _derive_name(ast, i: int) -> str:
     return f"_col{i}"
 
 
-def _interval_seconds(iv: A.IntervalLit):
-    """Day-time interval -> whole seconds, or None for year-month units."""
-    n = int(iv.value) * (-1 if iv.negative else 1)
-    scale = {"second": 1, "minute": 60, "hour": 3600, "day": 86400,
-             "week": 7 * 86400}.get(iv.unit)
-    return None if scale is None else n * scale
 
 
-def _interval_days(iv: A.IntervalLit):
-    s = _interval_seconds(iv)
-    return None if s is None or s % 86400 else s // 86400
 
 
-def _interval_months(iv: A.IntervalLit) -> int:
-    n = int(iv.value) * (-1 if iv.negative else 1)
-    if iv.unit == "month":
-        return n
-    if iv.unit == "year":
-        return n * 12
-    raise SemanticError(f"interval unit {iv.unit}")
 
 
-def _add_months_const(days: int, months: int) -> int:
-    d = np.datetime64("1970-01-01", "D") + np.timedelta64(int(days), "D")
-    month = np.datetime64(d, "M")
-    dom = (d - np.datetime64(month, "D")).astype(int)
-    out = np.datetime64(month + np.timedelta64(months, "M"), "D") + np.timedelta64(int(dom), "D")
-    return int((out - np.datetime64("1970-01-01", "D")).astype(np.int64))
