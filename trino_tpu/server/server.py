@@ -351,7 +351,9 @@ class CoordinatorServer:
             pool_line = (f" | memory {info['reserved'] / 1e6:.0f}"
                          f"/{info['max_bytes'] / 1e6:.0f} MB")
         catalogs = ", ".join(sorted(self.engine.catalogs))
-        return (_UI_STYLE + "<h1>trino-tpu coordinator</h1>"
+        return (_UI_STYLE
+                + "<meta http-equiv='refresh' content='5'>"  # live overview
+                + "<h1>trino-tpu coordinator</h1>"
                 f"<p>{len(self.queries)} queries tracked | catalogs: "
                 f"{_html.escape(catalogs)}{pool_line} | "
                 f"<a href='/v1/metrics'>metrics</a></p>"
