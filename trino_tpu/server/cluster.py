@@ -133,11 +133,23 @@ class _OutputBuffer:
         self.failed: Optional[str] = None
         self.cv = threading.Condition()
 
-    def add(self, data: bytes) -> None:
+    def add(self, data: bytes, stall_timeout: float = 120.0) -> None:
+        """Blocks while the buffer is full of unacknowledged pages.  A
+        consumer that vanished mid-stream would otherwise pin this producer
+        (and its executor slot) forever — after ``stall_timeout`` with no ack
+        the buffer fails and the producer unwinds."""
+        deadline = time.time() + stall_timeout
         with self.cv:
             while self.bytes > 0 and self.bytes + len(data) > self.max_bytes \
                     and not self.failed:
+                if time.time() > deadline:
+                    self.failed = "consumer stalled: no acknowledgement " \
+                                  f"for {stall_timeout:.0f}s"
+                    self.cv.notify_all()
+                    break
                 self.cv.wait(0.05)
+            if self.failed:
+                raise RuntimeError(f"output buffer failed: {self.failed}")
             self.pages[self.next_index] = data
             self.next_index += 1
             self.bytes += len(data)
