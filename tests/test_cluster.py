@@ -390,3 +390,49 @@ def test_task_admission_backpressure(tmp_path):
     finally:
         w.stop()
         coord.stop()
+
+
+@pytest.mark.slow
+def test_cluster_tpcds_star(tmp_path):
+    """The OS-process control plane schedules a TPC-DS star query: worker
+    build_catalogs instantiates the TPC-DS connector, split tasks fan out
+    over store_sales, and the coordinator merges partials (round 4: the
+    cluster plane is no longer TPC-H-only)."""
+    from trino_tpu.connectors.tpcds import TpcdsConnector
+
+    cats = {"tpcds": {"connector": "tpcds", "sf": 0.01,
+                      "split_rows": 1 << 12}}
+    e = Engine()
+    e.register_catalog("tpcds", TpcdsConnector(sf=0.01, split_rows=1 << 12))
+    coord = ClusterCoordinator(e, str(tmp_path / "spool"),
+                               heartbeat_interval=0.3)
+    url = coord.start()
+    w1 = w2 = None
+    sql = ("select i_category, sum(ss_ext_sales_price) rev, count(*) c "
+           "from store_sales, item, date_dim "
+           "where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk "
+           "and d_year = 2000 group by i_category "
+           "order by rev desc, i_category")
+    try:
+        env = dict(os.environ)
+        env["TRINO_TPU_WORKER_CPU"] = "1"
+        repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        procs = []
+        for nid in ("dsw1", "dsw2"):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "trino_tpu.server.cluster",
+                 "--coordinator", url, "--catalogs", json.dumps(cats),
+                 "--spool", str(tmp_path / "spool"), "--node-id", nid],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL))
+        w1, w2 = procs
+        coord.wait_for_workers(2, timeout=60)
+        expected = e.execute_sql(sql).rows()
+        got = coord.execute_sql(sql).rows()
+        assert got == expected and len(got) > 3
+    finally:
+        coord.stop()
+        for w in (w1, w2):
+            if w is not None:
+                w.terminate()
+                w.wait(timeout=10)
