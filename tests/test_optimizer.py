@@ -211,3 +211,35 @@ def test_show_stats_uses_table_stats():
     assert by_col["o_orderkey"][1] == "1500001" or by_col["o_orderkey"][1] == "1500000"
     assert by_col["o_orderdate"][2] != ""  # date range known
     assert rows[-1][4] == "1500000"  # summary row_count
+
+
+def test_count_star_pushdown_exact():
+    """Global count(*) over a bare scan answers from connector metadata
+    (ConnectorMetadata.applyAggregation's count slice) — and must be EXACT,
+    including lineitem whose cardinality is data-dependent."""
+    import trino_tpu.exec.local_executor as LE
+    from trino_tpu import Engine
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.01))
+    s = e.create_session("tpch")
+    calls = {"n": 0}
+    orig = LE.LocalExecutor._run_global_aggregate
+
+    def counting(self, *a, **k):
+        calls["n"] += 1
+        return orig(self, *a, **k)
+
+    LE.LocalExecutor._run_global_aggregate = counting
+    try:
+        pushed = int(e.execute_sql("select count(*) from lineitem",
+                                   s).rows()[0][0])
+        assert calls["n"] == 0, "count(*) should not execute an aggregation"
+        real = int(e.execute_sql("select count(*) c from lineitem "
+                                 "where 1 = 1", s).rows()[0][0])
+        assert pushed == real
+        # filters disable the pushdown
+        assert calls["n"] >= 1
+    finally:
+        LE.LocalExecutor._run_global_aggregate = orig

@@ -109,6 +109,7 @@ class _PqTable:
 
 
 class ParquetConnector:
+    supports_count_pushdown = True  # exact footer row counts; DDL/DML bumps plan_version
     name = "parquet"
     HOST_DECODE = True  # pages decode on the host: scans benefit from
     # background-thread split prefetch (see local_executor._prefetched_pages)
@@ -171,6 +172,9 @@ class ParquetConnector:
 
     def row_count(self, table: str) -> int:
         return self._open(table).n_rows
+
+    def exact_row_count(self, table: str) -> int:
+        return self._open(table).n_rows  # footer metadata is exact
 
     def column_range(self, table: str, column: str):
         return (None, None)

@@ -1238,6 +1238,10 @@ class TpcdsSplit:
 
 class TpcdsConnector:
     name = "tpcds"
+    supports_count_pushdown = True  # row counts are index-derived (exact)
+
+    def exact_row_count(self, table: str) -> int:
+        return self.row_count(table)
 
     def __init__(self, sf: float = 1.0, split_rows: int = 1 << 20):
         self.sf = sf

@@ -97,9 +97,11 @@ class Planner(ExpressionAnalyzer):
                 node = P.Sort(node, tuple(keys))
             if q.limit is not None:
                 node = P.Limit(node, q.limit)
+            from .optimizer import pushdown_aggregations
             from .rules import optimize_plan
 
-            return optimize_plan(P.Output(node, tuple(out_names)))
+            out = optimize_plan(P.Output(node, tuple(out_names)))
+            return pushdown_aggregations(out, self.engine.catalogs)
         finally:
             self.ctes = saved
 

@@ -190,3 +190,40 @@ def _replace_children(node: P.PlanNode, new_kids: tuple) -> P.PlanNode:
     from .rules import _replace_children as shared
 
     return shared(node, new_kids)
+
+
+def pushdown_aggregations(root, catalogs):
+    """Connector aggregate pushdown, count(*) slice (reference:
+    ConnectorMetadata.applyAggregation, spi/connector/ConnectorMetadata.java:1595):
+    a global count(*) over a bare scan — no Filter; Projects do not change
+    cardinality — is answered from connector metadata without scanning.
+    Connectors opt in with ``supports_count_pushdown`` (exact row counts that
+    invalidate cached plans on mutation)."""
+    import dataclasses as _dc
+
+    from . import plan as P
+
+    def walk(n):
+        if isinstance(n, P.Aggregate) and not n.keys and n.aggs \
+                and all(s.kind == "count_star" for s in n.aggs):
+            c = n.child
+            while isinstance(c, P.Project):
+                c = c.child
+            if isinstance(c, P.TableScan):
+                conn = catalogs.get(c.catalog)
+                if conn is not None and getattr(conn,
+                                                "supports_count_pushdown",
+                                                False) \
+                        and hasattr(conn, "exact_row_count"):
+                    # row_count() is a stats ESTIMATE on some connectors
+                    # (tpch lineitem); count(*) must be exact
+                    nrows = int(conn.exact_row_count(c.table))
+                    return P.Values((tuple(nrows for _ in n.aggs),), n.schema)
+        kids = tuple(walk(k) for k in n.children)
+        if all(a is b for a, b in zip(kids, n.children)):
+            return n
+        from .rules import _replace_children
+
+        return _replace_children(n, kids)
+
+    return walk(root)
