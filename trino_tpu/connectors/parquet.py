@@ -120,6 +120,12 @@ class ParquetConnector:
         # explicit path registrations: table-format connectors (Iceberg) map
         # manifest-listed data FILES onto this connector's decode machinery
         self._paths: dict = {}
+        self._version = 0  # bumped on every write: cached plans embed split
+        # lists (and pushed-down counts) — the engine's plan-version snapshot
+        # replans when this moves
+
+    def plan_version(self) -> int:
+        return self._version
 
     # -- metadata ----------------------------------------------------------------
     def tables(self):
@@ -310,6 +316,7 @@ class ParquetConnector:
                                 schema=aschema),
                        os.path.join(self.directory, f"{table}.parquet"))
         self._tables.pop(table, None)
+        self._version += 1
         return True
 
     def append(self, table: str, decoded_columns, null_flags=None) -> None:
@@ -363,6 +370,7 @@ class ParquetConnector:
         path = os.path.join(self.directory, f"{table}.parquet")
         pq.write_table(pa.table(dict(zip(names, arrays))), path)
         self._tables.pop(table, None)
+        self._version += 1
         return path
 
 
