@@ -311,3 +311,38 @@ def test_window_frame_errors(engine):
         engine.execute_sql(
             "select sum(n_nationkey) over (order by n_nationkey "
             "rows between unbounded following and current row) from nation", s)
+
+
+def test_right_and_full_outer_joins():
+    """RIGHT OUTER plans as a flipped LEFT (re-projected) and FULL OUTER as
+    left-join UNION ALL right-anti NULL-padded rows (round 4: these kinds
+    previously fell through to the inner-join transform and returned wrong
+    rows silently)."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table ja (k bigint, x bigint)", s)
+    e.execute_sql("create table jb (k bigint, y varchar)", s)
+    e.execute_sql("insert into ja values (1, 10), (2, 20), (2, 21)", s)
+    e.execute_sql("insert into jb values (2, 'two'), (3, 'three'), "
+                  "(null, 'none')", s)
+    r = e.execute_sql(
+        "select ja.k, x, jb.k, y from ja right join jb on ja.k = jb.k "
+        "order by y", s).rows()
+    assert r == [(None, None, None, "none"), (None, None, 3, "three"),
+                 (2, 20, 2, "two"), (2, 21, 2, "two")]
+    r = e.execute_sql(
+        "select ja.k, x, jb.k, y from ja full outer join jb on ja.k = jb.k "
+        "order by coalesce(ja.k, jb.k), x", s).rows()
+    assert (1, 10, None, None) in r
+    assert (2, 20, 2, "two") in r and (2, 21, 2, "two") in r
+    assert (None, None, 3, "three") in r
+    assert (None, None, None, "none") in r  # null build key never matches
+    assert len(r) == 5
+    counts = e.execute_sql(
+        "select count(*) c, count(x) cx, count(y) cy from ja "
+        "full outer join jb on ja.k = jb.k", s).rows()[0]
+    assert tuple(int(v) for v in counts) == (5, 3, 4)

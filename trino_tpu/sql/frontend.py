@@ -1134,6 +1134,67 @@ class Planner(ExpressionAnalyzer):
                 rel = RelPlan(dataclasses.replace(rel.node, filter=filt), rel.cols,
                               rel.unique_sets)
             return rel
+        if node.kind == "right":
+            # RIGHT OUTER = LEFT OUTER with flipped sides (the executor's
+            # outer machinery keeps PROBE rows), re-projected back to the
+            # original (left..., right...) channel order.  Round-4 invariant:
+            # right/full previously fell through to the inner-join transform
+            # and returned silently WRONG rows.
+            push, keep = [], []
+            for c in residual:
+                (push if self._resolves(c, left.cols) else keep).append(c)
+            for c in push:
+                e, _ = self.translate(c, left.cols)
+                left = RelPlan(P.Filter(left.node, e), left.cols,
+                               left.unique_sets)
+            rel = self._make_join("left", right, left,
+                                  [(be, pe) for pe, be in eqs])
+            if keep:
+                filt = None
+                for c in keep:
+                    e, _ = self.translate(c, rel.cols)
+                    filt = e if filt is None else ir.Call("and", (filt, e),
+                                                          BOOLEAN)
+                rel = RelPlan(dataclasses.replace(rel.node, filter=filt),
+                              rel.cols, rel.unique_sets)
+            probe_total = len(rel.node.left.schema.fields)
+            vis = list(left.cols) + list(right.cols)
+            exprs = tuple(
+                [ir.FieldRef(probe_total + i, c.type, c.name)
+                 for i, c in enumerate(left.cols)]
+                + [ir.FieldRef(i, c.type, c.name)
+                   for i, c in enumerate(right.cols)])
+            schema = Schema(tuple(Field(c.name, c.type) for c in vis))
+            dicts = tuple(c.dict for c in vis)
+            return RelPlan(P.Project(rel.node, exprs, schema, dicts),
+                           [dataclasses.replace(c) for c in vis], [])
+        if node.kind == "full":
+            # FULL OUTER = LEFT OUTER union-all the right side's unmatched
+            # rows padded with NULL left columns (reference planner models
+            # FULL directly; the union form reuses the left + anti machinery)
+            if residual:
+                raise SemanticError(
+                    "FULL OUTER JOIN with non-equi conditions not supported yet")
+            vis = list(left.cols) + list(right.cols)
+            schema = Schema(tuple(Field(c.name, c.type) for c in vis))
+            dicts = tuple(c.dict for c in vis)
+            left_rel = self._make_join("left", left, right, eqs)
+            pt = len(left_rel.node.left.schema.fields)
+            lexprs = tuple(
+                [ir.FieldRef(i, c.type, c.name)
+                 for i, c in enumerate(left.cols)]
+                + [ir.FieldRef(pt + i, c.type, c.name)
+                   for i, c in enumerate(right.cols)])
+            lproj = P.Project(left_rel.node, lexprs, schema, dicts)
+            anti = self._make_join("anti", right, left,
+                                   [(be, pe) for pe, be in eqs])
+            aexprs = tuple(
+                [ir.Constant(None, c.type) for c in left.cols]
+                + [ir.FieldRef(i, c.type, c.name)
+                   for i, c in enumerate(right.cols)])
+            aproj = P.Project(anti.node, aexprs, schema, dicts)
+            return RelPlan(P.Union((lproj, aproj), schema),
+                           [dataclasses.replace(c) for c in vis], [])
         rel = self._make_join(node.kind, left, right, eqs)
         out = rel.node
         for c in residual:
