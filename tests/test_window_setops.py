@@ -346,3 +346,28 @@ def test_right_and_full_outer_joins():
         "select count(*) c, count(x) cx, count(y) cy from ja "
         "full outer join jb on ja.k = jb.k", s).rows()[0]
     assert tuple(int(v) for v in counts) == (5, 3, 4)
+
+
+def test_join_using_and_qualified_star():
+    """JOIN ... USING (c): equi-join with the column carried ONCE in the
+    output scope; alias.* expands one relation's columns (reference:
+    StatementAnalyzer joinUsing + qualified asterisk)."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table ua (k bigint, x bigint)", s)
+    e.execute_sql("create table ub (k bigint, y bigint)", s)
+    e.execute_sql("insert into ua values (1, 10), (2, 20)", s)
+    e.execute_sql("insert into ub values (2, 200), (3, 300)", s)
+    r = e.execute_sql("select * from ua join ub using (k)", s).to_pandas()
+    assert r.columns.tolist() == ["k", "x", "y"]  # k deduped
+    assert r.values.tolist() == [[2, 20, 200]]
+    r = e.execute_sql("select k, y from ua left join ub using (k) "
+                      "order by k", s).rows()
+    assert r == [(1, None), (2, 200)]
+    r = e.execute_sql("select ub.*, ua.x from ua join ub on ua.k = ub.k",
+                      s).to_pandas()
+    assert r.columns.tolist() == ["k", "y", "x"]

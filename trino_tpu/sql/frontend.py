@@ -145,11 +145,19 @@ class Planner(ExpressionAnalyzer):
         items = []
         for it in q.items:
             if isinstance(it.expr, A.Star):
+                qual = it.expr.qualifier
+                matched = False
                 for i, c in enumerate(rel.cols):
                     if not c.name:
                         continue  # anonymous helper channels (computed join keys)
+                    if qual and c.alias != qual[0]:
+                        continue  # alias.*: that relation's columns only
+                    matched = True
                     items.append(A.SelectItem(A.Identifier(
                         (c.alias, c.name) if c.alias else (c.name,)), None))
+                if qual and not matched:
+                    raise SemanticError(
+                        f"relation {qual[0]} not found for {qual[0]}.*")
             else:
                 items.append(it)
 
@@ -1095,6 +1103,34 @@ class Planner(ExpressionAnalyzer):
             return self._plan_relation(node)
         left = self._plan_explicit(node.left)
         right = self._plan_explicit(node.right)
+        if getattr(node, "using", ()):
+            # JOIN USING (c, ...): equi-join on the named columns of BOTH
+            # sides; the output carries the column ONCE (left's copy), so a
+            # bare reference stays unambiguous and SELECT * dedups — the
+            # reference's USING output scope (StatementAnalyzer joinUsing)
+            if node.kind not in ("inner", "left"):
+                raise SemanticError(
+                    f"USING with {node.kind.upper()} JOIN not supported yet")
+            eqs = []
+            for cname in node.using:
+                le = self._try_translate(A.Identifier((cname,)), left.cols)
+                re_ = self._try_translate(A.Identifier((cname,)), right.cols)
+                if le is None or re_ is None:
+                    raise SemanticError(
+                        f"USING column {cname} must exist on both sides")
+                eqs.append((le, re_))
+            rel = self._make_join(node.kind, left, right, eqs)
+            drop = {len(left.cols) + i for i, c in enumerate(right.cols)
+                    if c.name in node.using}
+            vis = [c for i, c in enumerate(rel.cols)
+                   if i not in drop and c.name]
+            exprs = tuple(ir.FieldRef(i, c.type, c.name)
+                          for i, c in enumerate(rel.cols)
+                          if i not in drop and c.name)
+            schema = Schema(tuple(Field(c.name, c.type) for c in vis))
+            return RelPlan(P.Project(rel.node, exprs, schema,
+                                     tuple(c.dict for c in vis)),
+                           [dataclasses.replace(c) for c in vis], [])
         conjuncts = _split_conjuncts(node.on)
         eqs, residual = [], []
         for c in conjuncts:

@@ -260,6 +260,7 @@ class JoinRef(Node):
     left: Node
     right: Node
     on: Optional[Node]
+    using: tuple = ()  # JOIN ... USING (c1, ...); empty for ON joins
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1071,6 +1072,12 @@ class Parser:
         if self.peek().value == "*" and self.peek().kind == "op":
             self.next()
             return SelectItem(Star(), None)
+        # qualified star: alias.* (reference grammar: qualifiedName '.' ASTERISK)
+        if self.peek().kind == "ident" and self.peek(1).value == "." \
+                and self.peek(2).value == "*" and self.peek(2).kind == "op":
+            parts = [self.next().value]
+            self.next(), self.next()
+            return SelectItem(Star(tuple(parts)), None)
         expr = self.parse_expr()
         alias = None
         if self.accept("as"):
@@ -1105,6 +1112,16 @@ class Parser:
                 return left
             self.expect("join")
             right = self.parse_table_primary()
+            if self.peek().kind == "ident" and self.peek().value == "using":
+                # JOIN ... USING (c1, ...) (reference grammar: joinCriteria)
+                self.next()
+                self.expect("(")
+                using = [self.expect_kind("ident").value]
+                while self.accept(","):
+                    using.append(self.expect_kind("ident").value)
+                self.expect(")")
+                left = JoinRef(kind, left, right, None, tuple(using))
+                continue
             self.expect("on")
             on = self.parse_expr()
             left = JoinRef(kind, left, right, on)
@@ -1240,7 +1257,8 @@ class Parser:
     def _table_alias(self) -> Optional[str]:
         if self.accept("as"):
             return self.expect_kind("ident").value
-        if self.peek().kind == "ident":
+        if self.peek().kind == "ident" and self.peek().value != "using":
+            # 'using' introduces JOIN ... USING (...), never an alias
             return self.next().value
         return None
 
