@@ -186,7 +186,9 @@ def test_ui_query_drilldown(tpch_sf001):
                                       timeout=30).read().decode()
         assert "select count(*) c from region" in page
         assert "FINISHED" in page and "plan" in page
-        assert "Aggregate" in page  # the EXPLAIN plan rendered
+        # the EXPLAIN plan rendered (count(*) pushdown folds the aggregate
+        # into a Values constant)
+        assert "Aggregate" in page or "Values" in page
         import pytest
         import urllib.error
 

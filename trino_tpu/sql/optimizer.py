@@ -218,7 +218,8 @@ def pushdown_aggregations(root, catalogs):
                     # row_count() is a stats ESTIMATE on some connectors
                     # (tpch lineitem); count(*) must be exact
                     nrows = int(conn.exact_row_count(c.table))
-                    return P.Values((tuple(nrows for _ in n.aggs),), n.schema)
+                    return P.Values((tuple(nrows for _ in n.aggs),), n.schema,
+                                    source_tables=((c.catalog, c.table),))
         kids = tuple(walk(k) for k in n.children)
         if all(a is b for a, b in zip(kids, n.children)):
             return n

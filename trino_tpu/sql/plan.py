@@ -269,6 +269,9 @@ class Values(PlanNode):
 
     rows: tuple
     schema: Schema
+    source_tables: tuple = ()  # (catalog, table) provenance when an optimizer
+    # rewrite (count(*) pushdown) replaced a scan: access control must still
+    # see the table it came from
 
 
 @dataclasses.dataclass(frozen=True)
