@@ -371,3 +371,32 @@ def test_join_using_and_qualified_star():
     r = e.execute_sql("select ub.*, ua.x from ua join ub on ua.k = ub.k",
                       s).to_pandas()
     assert r.columns.tolist() == ["k", "y", "x"]
+
+
+def test_grouping_function_rollup():
+    """grouping(c...) bitmasks distinguish rollup totals from genuine NULL
+    keys (reference: the grouping() rewrite over GroupIdOperator); constant
+    per grouping-set branch in the union-of-aggregations planning."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.001))
+    s = e.create_session("tpch")
+    r = e.execute_sql(
+        "select r_name, n_name, grouping(r_name) gr, "
+        "grouping(r_name, n_name) grn, count(*) c "
+        "from nation, region where n_regionkey = r_regionkey "
+        "group by rollup (r_name, n_name) "
+        "order by grn desc, r_name, n_name", s).to_pandas()
+    assert len(r) == 25 + 5 + 1
+    total = r.iloc[0]
+    assert int(total["grn"]) == 3 and int(total["c"]) == 25
+    per_region = r[(r["grn"] == 1)]
+    assert len(per_region) == 5 and int(per_region["c"].sum()) == 25
+    assert (r[r["grn"] == 0]["gr"] == 0).all()
+    r2 = e.execute_sql(
+        "select r_name, count(*) c from nation, region "
+        "where n_regionkey = r_regionkey group by rollup (r_name) "
+        "having grouping(r_name) = 1", s).rows()
+    assert r2 == [(None, 25)]

@@ -1853,9 +1853,47 @@ class Planner(ExpressionAnalyzer):
             rel, all_asts, agg_calls)
         if any(a.distinct for a in uniq_aggs):
             raise SemanticError("DISTINCT aggregates with grouping sets not supported")
+
+        # grouping(c1, ..., cm) is a CONSTANT per grouping set (bit j set when
+        # argument j is NOT grouped in that set — reference:
+        # operator/GroupIdOperator + the grouping() rewrite): collect the
+        # calls, ride one extra union channel each, resolve in _PostAggScope
+        grouping_calls: list = []
+
+        def collect_grouping(ast):
+            if isinstance(ast, A.FuncCall) and ast.name == "grouping":
+                if ast not in grouping_calls:
+                    grouping_calls.append(ast)
+                return
+            for f in dataclasses.fields(ast) if dataclasses.is_dataclass(ast) \
+                    else ():
+                v = getattr(ast, f.name)
+                if isinstance(v, A.Node):
+                    collect_grouping(v)
+                elif isinstance(v, tuple):
+                    for x in v:
+                        if isinstance(x, A.Node):
+                            collect_grouping(x)
+
+        for it in items:
+            collect_grouping(it.expr)
+        if q.having is not None:
+            collect_grouping(q.having)
+        gcall_idxs = []
+        for gc in grouping_calls:
+            idxs = []
+            for arg in gc.args:
+                a = self._resolve_group_ast(arg, items, rel)
+                if a not in all_asts:
+                    raise SemanticError(
+                        "grouping() arguments must be grouping columns")
+                idxs.append(all_asts.index(a))
+            gcall_idxs.append(idxs)
+
         uni_schema = Schema(tuple(
             [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
-            + [Field(s.name, s.type) for s in specs]))
+            + [Field(s.name, s.type) for s in specs]
+            + [Field(f"g{j}", BIGINT) for j in range(len(grouping_calls))]))
         branches = []
         for s in sets:
             schema_s = Schema(tuple(
@@ -1870,14 +1908,23 @@ class Planner(ExpressionAnalyzer):
                     uni_exprs.append(ir.Constant(None, ke.type))
             for j, sp in enumerate(specs):
                 uni_exprs.append(ir.FieldRef(len(s) + j, sp.type))
+            for idxs in gcall_idxs:
+                m = len(idxs)
+                val = sum(1 << (m - 1 - j)
+                          for j, ki in enumerate(idxs) if ki not in s)
+                uni_exprs.append(ir.Constant(val, BIGINT))
             branches.append(P.Project(agg_n, tuple(uni_exprs), uni_schema,
                                       tuple(key_dicts)
-                                      + tuple(None for _ in specs)))
+                                      + tuple(None for _ in specs)
+                                      + tuple(None for _ in grouping_calls)))
         node = P.Union(tuple(branches), uni_schema)
         agg_cols = ([ColumnInfo(None, f"k{i}", e.type, d)
                      for i, (e, d) in enumerate(zip(key_exprs, key_dicts))]
-                    + [ColumnInfo(None, sp.name, sp.type, None) for sp in specs])
-        return self._finish_aggregation(q, node, items, all_asts, uniq_aggs,
+                    + [ColumnInfo(None, sp.name, sp.type, None) for sp in specs]
+                    + [ColumnInfo(None, f"g{j}", BIGINT, None)
+                       for j in range(len(grouping_calls))])
+        return self._finish_aggregation(q, node, items, all_asts,
+                                        list(uniq_aggs) + grouping_calls,
                                         agg_cols, [])
 
 
