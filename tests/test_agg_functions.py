@@ -151,3 +151,41 @@ def test_all_null_and_empty_groups_are_null(eng):
     r = e2.execute_sql("select count_if(x > 0) ci from t where g = 99",
                        s2).to_pandas()
     assert r["ci"].tolist() == [0]
+
+
+def test_mixed_distinct_aggregates(engine, tpch_pandas):
+    """count(distinct x) beside plain aggregates and multiple distinct args
+    (reference: MultipleDistinctAggregationToMarkDistinct — re-planned as
+    per-part aggregations joined on the group keys)."""
+    li = tpch_pandas["lineitem"]
+    got = engine.execute_sql(
+        "select l_returnflag, count(*) n, count(distinct l_suppkey) ds, "
+        "count(distinct l_shipmode) dm, sum(l_quantity) q from lineitem "
+        "group by l_returnflag order by l_returnflag").to_pandas()
+    ref = li.groupby("l_returnflag").agg(
+        n=("l_orderkey", "size"), ds=("l_suppkey", "nunique"),
+        dm=("l_shipmode", "nunique"), q=("l_quantity", "sum")).reset_index()
+    assert got["l_returnflag"].tolist() == ref["l_returnflag"].tolist()
+    for c in ("n", "ds", "dm", "q"):
+        np.testing.assert_allclose(got[c].astype(float), ref[c].astype(float))
+    g = engine.execute_sql(
+        "select count(*) n, count(distinct l_orderkey) o from lineitem"
+    ).rows()[0]
+    assert int(g[0]) == len(li) and int(g[1]) == li.l_orderkey.nunique()
+
+
+def test_mixed_distinct_null_group_keys(engine):
+    """NULL group keys survive the part-join composition (IS NOT DISTINCT
+    FROM via coalesce-to-sentinel join keys)."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table md (k bigint, v bigint, w bigint)", s)
+    e.execute_sql("insert into md values (1, 5, 7), (1, 5, 8), "
+                  "(null, 6, 9), (null, 7, 9)", s)
+    r = e.execute_sql("select k, count(*) c, count(distinct v) dv, sum(w) sw "
+                      "from md group by k order by k", s).rows()
+    assert r == [(1, 2, 1, 15), (None, 2, 2, 18)]

@@ -1671,12 +1671,16 @@ class Planner(ExpressionAnalyzer):
         distinct_aggs = [a for a in uniq_aggs
                          if (a.distinct or a.name == "approx_distinct")
                          and a.name not in ("min", "max")]
+        if distinct_aggs and (len(uniq_aggs) != len(distinct_aggs)
+                              or len({a.args for a in distinct_aggs}) != 1):
+            # mixed distinct/non-distinct (or several distinct args): compose
+            # per-part aggregations joined back on the group keys (reference:
+            # the MarkDistinct/MultipleDistinctAggregationToMarkDistinct
+            # family — re-planned as a join of single-purpose aggregations,
+            # each of which the engine already runs well)
+            return self._plan_mixed_distinct(q, rel, items, group_asts,
+                                             uniq_aggs, distinct_aggs)
         if distinct_aggs:
-            if len(uniq_aggs) != len(distinct_aggs) or \
-                    len({a.args for a in distinct_aggs}) != 1:
-                raise SemanticError(
-                    "mixed distinct/non-distinct or multi-argument distinct aggregates "
-                    "not supported yet")
             arg_ast = distinct_aggs[0].args[0]
             de, _ = self.translate(arg_ast, rel.cols)
             proj_exprs = list(key_exprs) + [de]
@@ -1715,6 +1719,115 @@ class Planner(ExpressionAnalyzer):
         agg_unique = [frozenset(range(len(key_exprs)))] if key_exprs else []
         return self._finish_aggregation(q, agg, items, group_asts, uniq_aggs,
                                         agg_cols, agg_unique)
+
+    def _plan_mixed_distinct(self, q, rel: RelPlan, items, group_asts,
+                             uniq_aggs, distinct_aggs):
+        """count(distinct x) alongside plain aggregates (and/or several
+        distinct argument sets): each part — the non-distinct aggregates, and
+        one distinct-rewrite per argument — aggregates separately over the
+        same input, then the parts join back on the group keys (single-match:
+        keys are unique per part).  NULL group keys join via coalesce-to-
+        sentinel (IS NOT DISTINCT FROM semantics).  Reference:
+        MultipleDistinctAggregationToMarkDistinct + MarkDistinct planning."""
+        import numpy as np
+
+        nd_aggs = [a for a in uniq_aggs if a not in distinct_aggs]
+        darg_groups: list = []  # (args tuple, [agg asts])
+        for a in distinct_aggs:
+            for args, lst in darg_groups:
+                if args == a.args:
+                    lst.append(a)
+                    break
+            else:
+                darg_groups.append((a.args, [a]))
+
+        K = len(group_asts)
+        key_exprs, key_dicts = [], []
+        for g in group_asts:
+            e, d = self.translate(g, rel.cols)
+            key_exprs.append(e)
+            key_dicts.append(d)
+
+        parts = []  # (plan node, [agg asts], [result types])
+        if nd_aggs:
+            proj, _, _, nd_uniq, nd_specs = self._build_agg_projection(
+                rel, group_asts, nd_aggs)
+            schema = Schema(tuple(
+                [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
+                + [Field(s.name, s.type) for s in nd_specs]))
+            node = P.Aggregate(proj, tuple(range(K)), tuple(nd_specs), schema)
+            parts.append((node, list(nd_uniq), [s.type for s in nd_specs]))
+        for args, lst in darg_groups:
+            de, _ = self.translate(args[0], rel.cols)
+            pexprs = list(key_exprs) + [de]
+            pschema = Schema(tuple(Field(f"c{i}", e.type)
+                                   for i, e in enumerate(pexprs)))
+            proj = P.Project(rel.node, tuple(pexprs), pschema,
+                             tuple(key_dicts) + (None,))
+            dist = P.Aggregate(proj, tuple(range(len(pexprs))), (), pschema)
+            specs = []
+            for j, a in enumerate(lst):
+                kind, _ = _agg_kind(a)
+                if kind == "approx_distinct":
+                    kind = "count"
+                specs.append(P.AggSpec(kind, ir.FieldRef(K, de.type),
+                                       f"d{j}", _agg_type(kind, de.type)))
+            schema = Schema(tuple(
+                [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
+                + [Field(s.name, s.type) for s in specs]))
+            node = P.Aggregate(dist, tuple(range(K)), tuple(specs), schema)
+            parts.append((node, list(lst), [s.type for s in specs]))
+
+        def relplan(node):
+            cols = [ColumnInfo(None, f.name, f.type,
+                               key_dicts[i] if i < K else None)
+                    for i, f in enumerate(node.schema.fields)]
+            return RelPlan(node, cols, [frozenset(range(K))] if K else [])
+
+        base = relplan(parts[0][0])
+        part_start = [0]
+        for node, _, _ in parts[1:]:
+            rp = relplan(node)
+            if K == 0:
+                # the cross join rides a constant-key join, whose helper
+                # channels pad the probe side: the build payload starts at the
+                # JOIN node's probe width, not the pre-join width
+                base = self._make_cross_join(base, rp)
+                start = len(base.node.left.schema.fields)
+            else:
+                eqs = []
+                for i in range(K):
+                    t = base.cols[i].type
+                    if t.is_floating:
+                        raise SemanticError(
+                            "mixed distinct aggregates over floating group "
+                            "keys not supported")
+                    sent = -(1 << 62) + 7 \
+                        if np.dtype(t.dtype).itemsize >= 8 else -(1 << 30) + 7
+                    eqs.append((
+                        ir.Call("coalesce", (ir.FieldRef(i, t),
+                                             ir.Constant(sent, t)), t),
+                        ir.Call("coalesce", (ir.FieldRef(i, t),
+                                             ir.Constant(sent, t)), t)))
+                base = self._make_join("inner", base, rp, eqs)
+                start = len(base.node.left.schema.fields)
+            part_start.append(start)
+
+        lay_exprs = [ir.FieldRef(i, key_exprs[i].type) for i in range(K)]
+        agg_cols = [ColumnInfo(None, f"k{i}", key_exprs[i].type, key_dicts[i])
+                    for i in range(K)]
+        for a in uniq_aggs:
+            p, j = next((pi, lst.index(a)) for pi, (_, lst, _)
+                        in enumerate(parts) if a in lst)
+            t = parts[p][2][j]
+            lay_exprs.append(ir.FieldRef(part_start[p] + K + j, t))
+            agg_cols.append(ColumnInfo(None, f"a{len(agg_cols)}", t, None))
+        schema = Schema(tuple(Field(c.name, c.type) for c in agg_cols))
+        node = P.Project(base.node, tuple(lay_exprs), schema,
+                         tuple(c.dict for c in agg_cols))
+        return self._finish_aggregation(q, node, items, group_asts, uniq_aggs,
+                                        agg_cols,
+                                        [frozenset(range(K))] if K else [])
 
     def _resolve_group_ast(self, g, items, rel: RelPlan):
         """GROUP BY element resolution: ordinals and select-list aliases bind before
