@@ -400,3 +400,26 @@ def test_grouping_function_rollup():
         "where n_regionkey = r_regionkey group by rollup (r_name) "
         "having grouping(r_name) = 1", s).rows()
     assert r2 == [(None, 25)]
+
+
+def test_intersect_except_all_multiplicity():
+    """INTERSECT ALL keeps min(l, r) copies, EXCEPT ALL keeps l - r copies
+    (reference: SetOperationNodeTranslator's row_number-based ALL rewrite)."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table sa (v bigint, w bigint)", s)
+    e.execute_sql("create table sb (v bigint, w bigint)", s)
+    e.execute_sql("insert into sa values (1, 7), (1, 7), (1, 7), "
+                  "(2, 8), (3, 9)", s)
+    e.execute_sql("insert into sb values (1, 7), (1, 7), (2, 8), "
+                  "(2, 8), (4, 10)", s)
+    r = sorted((int(a), int(b)) for a, b in e.execute_sql(
+        "select v, w from sa intersect all select v, w from sb", s).rows())
+    assert r == [(1, 7), (1, 7), (2, 8)]
+    r = sorted((int(a), int(b)) for a, b in e.execute_sql(
+        "select v, w from sa except all select v, w from sb", s).rows())
+    assert r == [(1, 7), (3, 9)]

@@ -323,9 +323,34 @@ class Planner(ExpressionAnalyzer):
             if not q.all:
                 rel = RelPlan(P.Aggregate(node, tuple(range(len(cols))), (), schema),
                               cols, [frozenset(range(len(cols)))])
+        elif q.all:
+            # INTERSECT/EXCEPT ALL: multiplicity semantics by pairing the k-th
+            # copy of each row — row_number() partitioned by all channels on
+            # both sides, then semi (min(l,r) copies survive) / anti (l-r
+            # copies survive) on (cols..., rn).  Reference: the reference's
+            # row_number-based ALL rewrite in SetOperationNodeTranslator.
+            n = len(cols)
+
+            def numbered(node_):
+                spec = P.WindowSpec("row_number", None, tuple(range(n)), (),
+                                    "rn", BIGINT)
+                wschema = Schema(tuple(node_.schema.fields)
+                                 + (Field("rn", BIGINT),))
+                return P.Window(node_, (spec,), wschema)
+
+            ltypes = list(types) + [BIGINT]
+            probe = RelPlan(numbered(lnode),
+                            cols + [ColumnInfo(None, "rn", BIGINT, None)], [])
+            inner = RelPlan(numbered(rnode),
+                            [ColumnInfo(None, f"r{i}", t)
+                             for i, t in enumerate(ltypes)], [])
+            pairs = [(ir.FieldRef(i, t), ir.FieldRef(i, t))
+                     for i, t in enumerate(ltypes)]
+            rel = self._semi_anti_join(probe, inner, pairs, q.kind == "except")
+            exprs = tuple(ir.FieldRef(i, t) for i, t in enumerate(types))
+            rel = RelPlan(P.Project(rel.node, exprs, schema,
+                                    tuple(c.dict for c in cols)), cols, [])
         else:
-            if q.all:
-                raise SemanticError(f"{q.kind} ALL not supported yet")
             probe = RelPlan(P.Aggregate(lnode, tuple(range(len(cols))), (), schema),
                             cols, [frozenset(range(len(cols)))])
             inner = RelPlan(rnode, [ColumnInfo(None, f"r{i}", t)
