@@ -423,3 +423,27 @@ def test_intersect_except_all_multiplicity():
     r = sorted((int(a), int(b)) for a, b in e.execute_sql(
         "select v, w from sa except all select v, w from sb", s).rows())
     assert r == [(1, 7), (3, 9)]
+
+
+def test_string_set_ops_merge_dictionaries():
+    """Set operations over string columns from DIFFERENT tables merge the
+    dictionaries and remap ids through LUT projections, so equality compares
+    values (round 4: previously raised 'differently-encoded')."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table dx (t varchar)", s)
+    e.execute_sql("create table dy (t varchar)", s)
+    e.execute_sql("insert into dx values ('a'), ('b'), ('b'), ('c')", s)
+    e.execute_sql("insert into dy values ('b'), ('d')", s)
+    q = lambda sql: sorted(r[0] for r in e.execute_sql(sql, s).rows())
+    assert q("select t from dx union select t from dy") == \
+        ["a", "b", "c", "d"]
+    assert q("select t from dx union all select t from dy") == \
+        ["a", "b", "b", "b", "c", "d"]
+    assert q("select t from dx intersect select t from dy") == ["b"]
+    assert q("select t from dx except all select t from dy") == \
+        ["a", "b", "c"]

@@ -298,25 +298,52 @@ class Planner(ExpressionAnalyzer):
             raise SemanticError("set operation operands have different column counts")
         types = [common_super_type(lc.type, rc.type)
                  for lc, rc in zip(lrel.cols, rrel.cols)]
-        for lc, rc, t in zip(lrel.cols, rrel.cols, types):
-            if t.is_string and lc.dict is not rc.dict:
+        # differently-encoded string channels: MERGE the dictionaries and
+        # remap each side's ids through a LUT projection, so set-operation
+        # equality compares VALUES (reference: set ops operate on values;
+        # dictionary ids are this engine's storage detail)
+        merged_dicts: dict = {}
+        remap_l: dict = {}
+        remap_r: dict = {}
+        for i, (lc, rc, t) in enumerate(zip(lrel.cols, rrel.cols, types)):
+            if not t.is_string or lc.dict is rc.dict:
+                continue
+            from ..connectors.tpch import Dictionary
+
+            ld, rd = lc.dict, rc.dict
+            if ld is None or rd is None or \
+                    getattr(ld, "values", None) is None or \
+                    getattr(rd, "values", None) is None:
                 raise SemanticError(
-                    "set operations over differently-encoded string columns not "
-                    "supported yet (dictionary merge)")
+                    "set operations over formatter-dictionary string columns "
+                    "not supported yet")
+            lv = [str(v) for v in ld.values]
+            rv = [str(v) for v in rd.values]
+            uniq = sorted(set(lv) | set(rv))
+            pos = {v: j for j, v in enumerate(uniq)}
+            md = Dictionary(values=np.array(uniq, dtype=object))
+            merged_dicts[i] = md
+            remap_l[i] = np.array([pos[v] for v in lv], np.int32)
+            remap_r[i] = np.array([pos[v] for v in rv], np.int32)
         schema = Schema(tuple(Field(n, t) for n, t in zip(lnames, types)))
 
-        def coerced(rel):
-            exprs = tuple(_coerce(ir.FieldRef(i, c.type), t)
-                          for i, (c, t) in enumerate(zip(rel.cols, types)))
+        def coerced(rel, remap):
+            exprs = []
+            for i, (c, t) in enumerate(zip(rel.cols, types)):
+                e = _coerce(ir.FieldRef(i, c.type), t)
+                if i in remap:
+                    e = ir.Call("lut", (e, ir.Constant(remap[i], t)), t)
+                exprs.append(e)
             if all(isinstance(e, ir.FieldRef) for e in exprs) and \
                     len(rel.cols) == len(rel.node.schema):
                 return rel.node
-            return P.Project(rel.node, exprs, schema,
-                             tuple(c.dict for c in rel.cols))
+            dicts = tuple(merged_dicts.get(i, c.dict)
+                          for i, c in enumerate(rel.cols))
+            return P.Project(rel.node, tuple(exprs), schema, dicts)
 
-        lnode, rnode = coerced(lrel), coerced(rrel)
-        cols = [ColumnInfo(None, n, t, lc.dict)
-                for n, t, lc in zip(lnames, types, lrel.cols)]
+        lnode, rnode = coerced(lrel, remap_l), coerced(rrel, remap_r)
+        cols = [ColumnInfo(None, n, t, merged_dicts.get(i, lc.dict))
+                for i, (n, t, lc) in enumerate(zip(lnames, types, lrel.cols))]
         if q.kind == "union":
             node = P.Union((lnode, rnode), schema)
             rel = RelPlan(node, cols)
