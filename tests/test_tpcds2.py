@@ -367,3 +367,74 @@ def test_q42_category_revenue_rollup_by_year(eng, host):
         ref["i_category"].fillna("~").tolist()
     np.testing.assert_allclose(got["rev"].astype(float),
                                ref["rev"].astype(float), rtol=1e-9)
+
+
+@pytest.fixture(scope="module")
+def host_margin(eng):
+    e, _ = eng
+    conn = e.catalogs["tpcds"]
+    return {
+        "store_sales": _table(conn, "store_sales", [
+            "ss_sold_date_sk", "ss_item_sk", "ss_ext_sales_price",
+            "ss_net_profit"]),
+        "web_sales": _table(conn, "web_sales", [
+            "ws_sold_date_sk", "ws_item_sk", "ws_net_profit",
+            "ws_ext_sales_price"]),
+        "item": _table(conn, "item", ["i_item_sk", "i_category", "i_class"]),
+        "date_dim": _table(conn, "date_dim", ["d_date_sk", "d_year"]),
+    }
+
+
+def test_q36_gross_margin_rollup(eng, host_margin):
+    """Q36 family: gross-margin ROLLUP over (category, class) with grouping()
+    exposing the aggregation level."""
+    e, s = eng
+    got = e.execute_sql(
+        "select sum(ss_net_profit) / sum(ss_ext_sales_price) gm, "
+        "i_category, i_class, grouping(i_category, i_class) lvl "
+        "from store_sales, date_dim, item "
+        "where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk "
+        "and d_year = 2001 "
+        "group by rollup (i_category, i_class) "
+        "order by lvl desc, i_category, i_class limit 50", s).to_pandas()
+    ss, dd, it = (host_margin["store_sales"], host_margin["date_dim"],
+                  host_margin["item"])
+    j = ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk") \
+        .merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    j = j[j.d_year == 2001]
+    total_gm = j.ss_net_profit.sum() / j.ss_ext_sales_price.sum()
+    assert int(got["lvl"].iloc[0]) == 3
+    np.testing.assert_allclose(float(got["gm"].iloc[0]), total_gm, rtol=1e-9)
+    by_cat = j.groupby("i_category").agg(p=("ss_net_profit", "sum"),
+                                         s=("ss_ext_sales_price", "sum"))
+    cat_rows = got[got["lvl"] == 1].set_index("i_category")
+    assert len(cat_rows) == len(by_cat)
+    for cat, row in by_cat.iterrows():
+        np.testing.assert_allclose(float(cat_rows.loc[cat, "gm"]),
+                                   row.p / row.s, rtol=1e-9)
+
+
+def test_q86_web_rollup_counts(eng, host_margin):
+    """Q86 family: web-channel profit ROLLUP over (category, class); level
+    cardinalities and grand total must reconcile."""
+    e, s = eng
+    got = e.execute_sql(
+        "select sum(ws_net_profit) profit, i_category, i_class, "
+        "grouping(i_category, i_class) lvl "
+        "from web_sales, date_dim, item "
+        "where ws_sold_date_sk = d_date_sk and ws_item_sk = i_item_sk "
+        "and d_year = 2000 group by rollup (i_category, i_class) "
+        "order by lvl desc, i_category, i_class", s).to_pandas()
+    ws, dd, it = (host_margin["web_sales"], host_margin["date_dim"],
+                  host_margin["item"])
+    j = ws.merge(dd, left_on="ws_sold_date_sk", right_on="d_date_sk") \
+        .merge(it, left_on="ws_item_sk", right_on="i_item_sk")
+    j = j[j.d_year == 2000]
+    n_cat = j.i_category.nunique()
+    n_pairs = j.groupby(["i_category", "i_class"]).ngroups
+    assert len(got) == 1 + n_cat + n_pairs
+    np.testing.assert_allclose(float(got["profit"].iloc[0]),
+                               j.ws_net_profit.sum(), rtol=1e-9)
+    mid = got[got["lvl"] == 1]
+    np.testing.assert_allclose(mid["profit"].astype(float).sum(),
+                               j.ws_net_profit.sum(), rtol=1e-9)
