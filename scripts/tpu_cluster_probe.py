@@ -1,0 +1,109 @@
+"""Drive the real TPU through the cluster plane once (round-4 verdict item 9).
+
+The coordinator process pins itself to the CPU backend (the tunnel wedges when
+two processes touch the device, CLAUDE.md), spawns ONE worker process WITHOUT
+TRINO_TPU_WORKER_CPU so the worker initialises the default (axon TPU) platform,
+and runs one aggregate query through fragment dispatch + spooled exchange.
+Writes scripts/tpu_cluster_probe.json {ok, rows_match, worker_saw_axon, ...}.
+
+On SIGTERM (the watcher's `timeout`) the handler raises so the finally block
+still reaps the worker — an orphaned worker would keep holding the device and
+wedge the tunnel for the next probe.
+"""
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.pop("JAX_PLATFORMS", None)
+os.environ.pop("TRINO_TPU_WORKER_CPU", None)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # coordinator stays off the device
+jax.config.update("jax_enable_x64", True)
+
+REPO = str(pathlib.Path(__file__).resolve().parents[1])
+sys.path.insert(0, REPO)
+
+from trino_tpu import Engine  # noqa: E402
+from trino_tpu.connectors.tpch import TpchConnector  # noqa: E402
+from trino_tpu.server.cluster import ClusterCoordinator  # noqa: E402
+
+CATALOGS = {"tpch": {"connector": "tpch", "sf": 0.05, "split_rows": 1 << 13}}
+Q = """select l_returnflag, l_linestatus, sum(l_quantity) qty, count(*) c
+       from lineitem where l_shipdate <= date '1998-09-02'
+       group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus"""
+
+
+def _sigterm(signum, frame):  # noqa: ARG001
+    raise SystemExit(143)
+
+
+signal.signal(signal.SIGTERM, _sigterm)
+
+out = {"ok": False, "started_at": time.strftime("%Y-%m-%dT%H:%M:%S%z")}
+worker = None
+coord = None
+tmp = tempfile.mkdtemp(prefix="tpu_cluster_probe_")
+wlog_path = os.path.join(REPO, "scripts", "tpu_cluster_worker.log")
+try:
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.05, split_rows=1 << 13))
+    coord = ClusterCoordinator(e, os.path.join(tmp, "spool"),
+                               heartbeat_interval=0.5)
+    url = coord.start()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # NO TRINO_TPU_WORKER_CPU: the worker takes the default (axon) platform.
+    # Logs go to a file, not a pipe — an unread pipe fills and deadlocks the
+    # worker mid-query.  start_new_session lets us kill the whole group.
+    with open(wlog_path, "w") as wlog:
+        worker = subprocess.Popen(
+            [sys.executable, "-m", "trino_tpu.server.cluster",
+             "--coordinator", url, "--catalogs", json.dumps(CATALOGS),
+             "--spool", os.path.join(tmp, "spool"), "--node-id", "tpu-w1"],
+            env=env, stdout=wlog, stderr=subprocess.STDOUT,
+            start_new_session=True)
+    coord.wait_for_workers(1, timeout=300)  # first TPU init is slow
+    t0 = time.time()
+    expected = e.execute_sql(Q).rows()
+    got = coord.execute_sql(Q).rows()
+    out["query_seconds"] = round(time.time() - t0, 3)
+    out["rows_match"] = got == expected
+    out["n_rows"] = len(got)
+    out["ok"] = bool(out["rows_match"])
+except BaseException as exc:  # noqa: BLE001 — artifact must always be written
+    out["error"] = f"{type(exc).__name__}: {exc}"
+finally:
+    try:
+        if coord is not None:
+            coord.stop()
+    except Exception:
+        pass
+    if worker is not None:
+        try:
+            os.killpg(worker.pid, signal.SIGTERM)
+        except OSError:
+            pass
+        try:
+            worker.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(worker.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            worker.wait(timeout=20)
+        try:
+            wtext = open(wlog_path, "rb").read().decode("utf-8", "replace")
+        except OSError:
+            wtext = ""
+        out["worker_saw_axon"] = "axon" in wtext  # full log, not the tail
+        out["worker_log_tail"] = wtext[-1500:]
+    with open(os.path.join(REPO, "scripts", "tpu_cluster_probe.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out)[:2000])

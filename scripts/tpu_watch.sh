@@ -1,35 +1,63 @@
 #!/bin/bash
-# Probe the axon TPU tunnel; the moment it answers, capture bench numbers
-# (SF1 then SF10) into BENCH_local_r04.json artifacts.  Exits 0 after capture,
-# 1 if the tunnel never recovered within ~11.5h.
+# Probe the axon TPU tunnel; the moment it answers, capture the round-5
+# A/B bench matrix (SF1/SF10 x scan-fused on/off) into BENCH_local_r05.json,
+# then drive the real chip through the cluster plane once
+# (scripts/tpu_cluster_probe.py).  Exits 0 after capture, 1 if the tunnel
+# never recovered within the probe window (250 probes, ~150-190s each:
+# ~11h when probes fail fast, up to ~21h if every probe eats its timeout).
+# Single-instance: flock on scripts/tpu_watch.lock — a second watcher
+# touching the device can wedge the tunnel (CLAUDE.md).
 cd /root/repo
 LOG=scripts/tpu_watch.log
-echo "$(date -Is) watcher start (r04)" >> "$LOG"
-for i in $(seq 1 220); do
+exec 9> scripts/tpu_watch.lock
+if ! flock -n 9; then
+  echo "$(date -Is) another watcher holds the lock; exiting" >> "$LOG"
+  exit 2
+fi
+echo "$(date -Is) watcher start (r05)" >> "$LOG"
+for i in $(seq 1 250); do
   if timeout 150 python -c "import jax; d=jax.devices()[0]; assert d.platform != 'cpu', d" >> "$LOG" 2>&1; then
-    echo "$(date -Is) TPU UP on probe $i — starting capture" >> "$LOG"
-    BENCH_BUDGET=1800 BENCH_SF=1 timeout 2100 python bench.py \
-      > scripts/bench_sf1.json 2> scripts/bench_sf1.log
-    echo "$(date -Is) SF1 done rc=$? : $(cat scripts/bench_sf1.json)" >> "$LOG"
-    BENCH_BUDGET=2400 BENCH_SF=10 timeout 2700 python bench.py \
-      > scripts/bench_sf10.json 2> scripts/bench_sf10.log
-    echo "$(date -Is) SF10 done rc=$? : $(cat scripts/bench_sf10.json)" >> "$LOG"
+    echo "$(date -Is) TPU UP on probe $i — starting r05 A/B capture" >> "$LOG"
+    for cfg in "sf1_fused:1:1:900:1200" "sf1_unfused:1:0:900:1200" \
+               "sf10_fused:10:1:1500:1800" "sf10_unfused:10:0:1500:1800"; do
+      IFS=: read -r name sf fused budget tmo <<< "$cfg"
+      # -k: a wedged axon call absorbs SIGTERM indefinitely (bench.py notes);
+      # SIGKILL after 60s keeps the watcher itself from hanging.
+      BENCH_BUDGET=$budget BENCH_SF=$sf TRINO_TPU_SCAN_FUSED=$fused \
+        timeout -k 60 "$tmo" python bench.py \
+        > "scripts/bench_${name}.json" 2> "scripts/bench_${name}.log"
+      rc=$?
+      echo "$(date -Is) $name done rc=$rc : $(cat scripts/bench_${name}.json)" >> "$LOG"
+    done
+    rm -f scripts/tpu_cluster_probe.json  # never embed a stale probe artifact
+    timeout -k 30 900 python scripts/tpu_cluster_probe.py \
+      > scripts/tpu_cluster_probe.out 2>&1
+    rc=$?
+    echo "$(date -Is) cluster probe rc=$rc" >> "$LOG"
     python - <<'PY'
 import json, subprocess, time
-out = {"captured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
-       "device": subprocess.run(["python","-c","import jax; print(jax.devices()[0])"],
-                                capture_output=True, text=True, timeout=180).stdout.strip()}
-for sf in ("sf1", "sf10"):
+out = {"captured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z")}
+try:
+    out["device"] = subprocess.run(
+        ["python", "-c", "import jax; print(jax.devices()[0])"],
+        capture_output=True, text=True, timeout=180).stdout.strip()
+except Exception as e:
+    out["device"] = f"probe-error: {e}"
+for name in ("sf1_fused", "sf1_unfused", "sf10_fused", "sf10_unfused"):
     try:
-        out[sf] = json.load(open(f"scripts/bench_{sf}.json"))
+        out[name] = json.load(open(f"scripts/bench_{name}.json"))
     except Exception as e:
-        out[sf] = {"error": str(e)}
-json.dump(out, open("BENCH_local_r04.json", "w"), indent=1)
+        out[name] = {"error": str(e)}
+try:
+    out["cluster_tpu_probe"] = json.load(open("scripts/tpu_cluster_probe.json"))
+except Exception as e:
+    out["cluster_tpu_probe"] = {"error": str(e)}
+json.dump(out, open("BENCH_local_r05.json", "w"), indent=1)
 PY
-    echo "$(date -Is) wrote BENCH_local_r04.json" >> "$LOG"
+    echo "$(date -Is) wrote BENCH_local_r05.json" >> "$LOG"
     exit 0
   fi
   echo "$(date -Is) probe $i: tunnel down" >> "$LOG"
-  sleep 180
+  sleep 150
 done
 exit 1
