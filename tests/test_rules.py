@@ -3,6 +3,8 @@ rule + fixpoint behavior (reference test model: the per-rule BaseRuleTest
 subclasses under sql/planner/iterative/rule/, e.g. TestMergeFilters, each
 asserting on the rewritten plan shape)."""
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -259,3 +261,175 @@ def test_remove_redundant_limit_over_global_agg():
                       Schema((Field("c", BIGINT),)))
     out = _opt(P.Limit(agg, 10))
     assert isinstance(out, P.Aggregate)
+
+
+# ---------------------------------------------------------------- round-5 rules
+def _join(kind="inner"):
+    l = _scan()
+    r_schema = Schema((Field("c", BIGINT), Field("d", BIGINT)))
+    r = P.TableScan("cat", "u", ("c", "d"), r_schema)
+    schema = Schema((Field("l0", BIGINT), Field("l1", BIGINT),
+                     Field("r0", BIGINT), Field("r1", BIGINT)))
+    if kind in ("semi", "anti"):
+        schema = Schema((Field("l0", BIGINT), Field("l1", BIGINT)))
+    return P.Join(kind, l, r, (0,), (0,), schema)
+
+
+def test_push_filter_through_join_splits_sides():
+    pred = ir.Call("and", (_pred(1, "gt", 5), _pred(3, "lt", 9)), BOOLEAN)
+    out = _opt(P.Filter(_join("inner"), pred))
+    join = _find(out, P.Join)[0]
+    assert isinstance(out, P.Join) or not isinstance(out, P.Filter)
+    lf = _find(join.left, P.Filter)
+    rf = _find(join.right, P.Filter)
+    assert lf and rf, "both side-local conjuncts must push below the join"
+    # the right conjunct's channel remapped into build-side coordinates
+    assert "$1" in repr(rf[0].predicate)
+
+
+def test_push_filter_through_outer_join_keeps_build_conjunct():
+    pred = ir.Call("and", (_pred(1, "gt", 5), _pred(3, "lt", 9)), BOOLEAN)
+    out = _opt(P.Filter(_join("left"), pred))
+    join = _find(out, P.Join)[0]
+    assert _find(join.left, P.Filter), "probe conjunct pushes"
+    assert not _find(join.right, P.Filter), \
+        "NULL-extended build conjunct must NOT push below a left join"
+    assert isinstance(out, P.Filter), "build conjunct stays above"
+
+
+def test_push_filter_through_aggregate_keys_only():
+    agg_schema = Schema((Field("a", BIGINT), Field("n", BIGINT)))
+    agg = P.Aggregate(_scan(), (0,),
+                      (P.AggSpec("count_star", None, "n", BIGINT),),
+                      agg_schema)
+    # key-channel conjunct pushes; agg-output conjunct stays
+    pred = ir.Call("and", (_pred(0, "gt", 3), _pred(1, "lt", 100)), BOOLEAN)
+    out = _opt(P.Filter(agg, pred))
+    assert isinstance(out, P.Filter), "agg-output conjunct stays above"
+    agg2 = _find(out, P.Aggregate)[0]
+    inner_f = _find(agg2.child, P.Filter) + (
+        [agg2.child] if isinstance(agg2.child, P.Filter) else [])
+    assert inner_f, "key conjunct must push below the aggregation"
+
+
+def test_push_filter_through_window_partition_keys():
+    w_schema = Schema((Field("a", BIGINT), Field("b", BIGINT),
+                       Field("rn", BIGINT)))
+    spec = P.WindowSpec("row_number", None, (0,), (P.SortKey(1),),
+                        "rn", BIGINT)
+    win = P.Window(_scan(), (spec,), w_schema)
+    pred = ir.Call("and", (_pred(0, "eq", 7), _pred(1, "gt", 2)), BOOLEAN)
+    out = _opt(P.Filter(win, pred))
+    assert isinstance(out, P.Filter), "non-partition conjunct stays above"
+    win2 = _find(out, P.Window)[0]
+    assert isinstance(win2.child, P.Filter), \
+        "partition-key conjunct pushes below the window"
+
+
+def test_push_filter_through_union_and_sort():
+    u_schema = Schema((Field("a", BIGINT), Field("b", BIGINT)))
+    u = P.Union((_scan(), _scan()), u_schema)
+    out = _opt(P.Filter(u, _pred(0, "gt", 1)))
+    assert not isinstance(out, P.Filter)
+    union = _find(out, P.Union)[0]
+    for c in union.children:
+        assert _find(c, P.Filter) or isinstance(c, P.Filter)
+    out2 = _opt(P.Filter(P.Sort(_scan(), (P.SortKey(0),)), _pred(0, "gt", 1)))
+    assert isinstance(out2, P.Sort), "filter moves below the sort"
+
+
+def test_empty_propagation_collapses_pipeline():
+    # LIMIT 0 seeds an empty Values; everything above collapses with it
+    plan = P.Sort(P.Filter(P.Limit(_scan(), 0), _pred(0, "gt", 1)),
+                  (P.SortKey(0),))
+    out = _opt(plan)
+    assert isinstance(out, P.Values) and not out.rows
+    # inner join with an empty side collapses too
+    j = _join("inner")
+    j = dataclasses.replace(j, right=P.Values((), j.right.schema))
+    out2 = _opt(j)
+    assert isinstance(out2, P.Values) and not out2.rows
+
+
+def test_merge_adjacent_projects():
+    s = _scan()
+    inner = P.Project(s, (ir.FieldRef(1, BIGINT), ir.FieldRef(0, BIGINT)),
+                      Schema((Field("x", BIGINT), Field("y", BIGINT))))
+    outer = P.Project(inner, (ir.Call("add", (ir.FieldRef(0, BIGINT),
+                                              ir.FieldRef(1, BIGINT)),
+                                      BIGINT),),
+                      Schema((Field("z", BIGINT),)))
+    out = _opt(outer)
+    projs = _find(out, P.Project)
+    assert len(projs) == 1, "adjacent projects must merge"
+    assert "add" in repr(projs[0].exprs[0])
+
+
+def test_simplify_constant_predicate():
+    t = ir.Call("lt", (ir.Constant(1, BIGINT), ir.Constant(2, BIGINT)),
+                BOOLEAN)
+    out = _opt(P.Filter(_scan(), t))
+    assert isinstance(out, P.TableScan), "1<2 folds to TRUE -> filter gone"
+    f = ir.Call("gt", (ir.Constant(1, BIGINT), ir.Constant(2, BIGINT)),
+                BOOLEAN)
+    out2 = _opt(P.Filter(_scan(), f))
+    assert isinstance(out2, P.Values) and not out2.rows
+
+
+def test_values_folding_filter_and_limit():
+    schema = Schema((Field("a", BIGINT),))
+    vals = P.Values(((1,), (5,), (9,)), schema)
+    out = _opt(P.Filter(vals, _pred(0, "gt", 4)))
+    assert isinstance(out, P.Values) and out.rows == ((5,), (9,))
+    out2 = _opt(P.Limit(P.Values(((1,), (2,), (3,)), schema), 2))
+    assert isinstance(out2, P.Values) and out2.rows == ((1,), (2,))
+
+
+def test_dedup_sort_and_join_keys():
+    s = P.Sort(_scan(), (P.SortKey(0), P.SortKey(1), P.SortKey(0, False)))
+    out = _opt(s)
+    assert tuple(k.channel for k in out.keys) == (0, 1)
+    j = P.Join("inner", _scan(), _scan(), (0, 1, 0), (0, 1, 0),
+               Schema((Field("l0", BIGINT), Field("l1", BIGINT),
+                       Field("r0", BIGINT), Field("r1", BIGINT))))
+    out2 = _opt(j)
+    assert out2.left_keys == (0, 1) and out2.right_keys == (0, 1)
+
+
+def test_distinct_over_distinct_collapses():
+    inner_schema = Schema((Field("a", BIGINT),))
+    inner = P.Aggregate(_scan(), (0,), (), inner_schema)
+    outer = P.Aggregate(inner, (0,), (), inner_schema)
+    out = _opt(outer)
+    aggs = _find(out, P.Aggregate)
+    assert len(aggs) == 1, "stacked DISTINCT must collapse to one"
+
+
+def test_push_filter_through_union_with_existing_branch_filter():
+    """A branch's own unrelated filter must not block pushing a NEW predicate
+    into every branch (round-5 review finding)."""
+    u_schema = Schema((Field("a", BIGINT), Field("b", BIGINT)))
+    filtered_branch = P.Filter(_scan(), _pred(1, "lt", 100))
+    u = P.Union((filtered_branch, _scan()), u_schema)
+    out = _opt(P.Filter(u, _pred(0, "gt", 1)))
+    assert not isinstance(out, P.Filter), "predicate must push below the union"
+    union = _find(out, P.Union)[0]
+    for c in union.children:
+        preds = repr([f.predicate for f in _find(c, P.Filter)]
+                     + ([c.predicate] if isinstance(c, P.Filter) else []))
+        assert "gt" in preds, f"branch missing pushed predicate: {preds}"
+
+
+def test_merge_projects_guards_duplicated_expensive_expr():
+    """A non-trivial inner expression referenced twice above must NOT inline
+    (exponential-growth guard, InlineProjections analog)."""
+    s = _scan()
+    inner = P.Project(s, (ir.Call("mul", (ir.FieldRef(0, BIGINT),
+                                          ir.FieldRef(1, BIGINT)), BIGINT),),
+                      Schema((Field("x", BIGINT),)))
+    outer = P.Project(inner, (ir.Call("add", (ir.FieldRef(0, BIGINT),
+                                              ir.FieldRef(0, BIGINT)),
+                                      BIGINT),),
+                      Schema((Field("z", BIGINT),)))
+    out = _opt(outer)
+    assert len(_find(out, P.Project)) == 2, "double-use inner expr must stay"

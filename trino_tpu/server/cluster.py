@@ -119,19 +119,34 @@ class _OutputBuffer:
     """In-memory task output buffer with long-poll reads and token
     acknowledgement (reference: execution/buffer/PartitionedOutputBuffer.java
     + the TaskResource long-poll protocol, server/TaskResource.java:331-383):
-    GET of token T acknowledges every page below T (freeing its memory) and
-    waits up to the poll budget for page T.  ``add`` blocks while the buffer
-    holds more than ``max_bytes`` of unacknowledged pages — the producer-side
-    backpressure the reference gets from OutputBuffer.isFull()."""
+    GET of token T by reader R acknowledges every page below T *for that
+    reader* and waits up to the poll budget for page T.  A page's memory
+    frees once EVERY (non-abandoned) reader has acknowledged it — with
+    ``n_readers`` > 1 this is the broadcast buffer a split-fanout consumer
+    stage reads (reference: execution/buffer/BroadcastOutputBuffer.java).
+    ``add`` blocks while the buffer holds more than ``max_bytes`` of
+    unacknowledged pages — the producer-side backpressure the reference gets
+    from OutputBuffer.isFull()."""
 
-    def __init__(self, max_bytes: int = 64 << 20):
+    def __init__(self, max_bytes: int = 64 << 20, n_readers: int = 1):
         self.pages: dict = {}  # index -> serialized page envelope
         self.next_index = 0
         self.bytes = 0
         self.max_bytes = max_bytes
         self.done = False
         self.failed: Optional[str] = None
+        self.n_readers = n_readers
+        self.acked = [0] * n_readers  # per reader: pages < acked[r] are free
+        self.completed = [False] * n_readers  # reader saw the complete marker
+        self.abandoned = [False] * n_readers  # reader gone; don't retain for it
         self.cv = threading.Condition()
+
+    def _free_acked(self) -> None:
+        """Drop every page all live readers acknowledged (call under cv)."""
+        floors = [a for a, gone in zip(self.acked, self.abandoned) if not gone]
+        floor = min(floors) if floors else self.next_index
+        for i in [i for i in self.pages if i < floor]:
+            self.bytes -= len(self.pages.pop(i))
 
     def add(self, data: bytes, stall_timeout: float = 120.0) -> None:
         """Blocks while the buffer is full of unacknowledged pages.  A
@@ -165,13 +180,29 @@ class _OutputBuffer:
             self.failed = error
             self.cv.notify_all()
 
-    def get(self, token: int, max_wait: float = 1.0):
-        """(page | None, complete, failed): acknowledge pages < token, then
-        long-poll for page ``token``."""
+    def abandon(self, reader: int) -> None:
+        """A consumer died and will be retried against a FRESH producer: stop
+        retaining pages for its reader slot so the surviving readers' floor
+        governs memory again."""
+        with self.cv:
+            if 0 <= reader < self.n_readers:
+                self.abandoned[reader] = True
+                self._free_acked()
+                self.cv.notify_all()
+
+    @property
+    def fully_delivered(self) -> bool:
+        return all(c or a for c, a in zip(self.completed, self.abandoned))
+
+    def get(self, token: int, max_wait: float = 1.0, reader: int = 0):
+        """(page | None, complete, failed): acknowledge pages < token for
+        ``reader``, then long-poll for page ``token``."""
         deadline = time.time() + max_wait
         with self.cv:
-            for i in [i for i in self.pages if i < token]:
-                self.bytes -= len(self.pages.pop(i))
+            if not 0 <= reader < self.n_readers:
+                return None, False, f"unknown reader {reader}"
+            self.acked[reader] = max(self.acked[reader], token)
+            self._free_acked()
             self.cv.notify_all()  # acks may unblock the producer
             while True:
                 if self.failed:
@@ -179,6 +210,8 @@ class _OutputBuffer:
                 if token in self.pages:
                     return self.pages[token], False, None
                 if self.done and token >= self.next_index:
+                    self.completed[reader] = True
+                    self.cv.notify_all()
                     return None, True, None
                 left = deadline - time.time()
                 if left <= 0:
@@ -187,16 +220,26 @@ class _OutputBuffer:
 
 
 def stream_task_pages(url: str, task_id: str, secret: Optional[str] = None,
-                      timeout: float = 60.0):
+                      timeout: float = 60.0, reader: int = 0):
     """Client half of the streaming exchange (reference:
     operator/HttpPageBufferClient.java:100): long-poll the producing worker's
     output buffer, yielding page envelopes; advancing the token acknowledges
-    delivery so the producer can free (and keep producing past) them."""
+    delivery *for this reader slot* so the producer can free (and keep
+    producing past) them once every reader of a broadcast buffer has."""
     token = 0
     deadline = time.time() + timeout
     while True:
-        body, headers = _http_stream_get(
-            f"{url}/v1/task/{task_id}/results/{token}", secret)
+        try:
+            body, headers = _http_stream_get(
+                f"{url}/v1/task/{task_id}/results/{reader}/{token}", secret)
+        except urllib.error.HTTPError as he:
+            if he.code == 404 and time.time() < deadline:
+                # the producer task was dispatched but its thread has not
+                # registered the buffer yet (or a respawned producer is still
+                # starting): poll again within the no-progress budget
+                time.sleep(0.1)
+                continue
+            raise
         if headers.get("X-Trino-Buffer-Failed"):
             raise RuntimeError(
                 f"stream source {task_id} failed: "
@@ -329,20 +372,28 @@ class WorkerServer:
                                              "mem_reserved": pool.reserved,
                                              "mem_max": pool.max_bytes})
                 if "/results/" in self.path and self.path.startswith("/v1/task/"):
-                    # streamed page read: /v1/task/{tid}/results/{token}
-                    # (reference: TaskResource.java:331 long-poll page fetch);
-                    # page data is cluster-internal — the path must be signed
+                    # streamed page read:
+                    #   /v1/task/{tid}/results/{reader}/{token}
+                    # (legacy single-reader form /v1/task/{tid}/results/{token}
+                    # maps to reader 0).  Reference: TaskResource.java:331
+                    # long-poll page fetch; page data is cluster-internal —
+                    # the path must be signed
                     if worker.secret is not None:
                         got = self.headers.get("X-Trino-Internal-Signature", "")
                         want = _sign(worker.secret, self.path.encode())
                         if not hmac.compare_digest(got, want):
                             return self._reply(403, {"error": "bad signature"})
                     parts = self.path.split("/")
-                    tid, token = parts[3], int(parts[5])
+                    tid = parts[3]
+                    if len(parts) >= 7:
+                        reader, token = int(parts[5]), int(parts[6])
+                    else:
+                        reader, token = 0, int(parts[5])
                     buf = worker.out_buffers.get(tid)
                     if buf is None:
                         return self._reply(404, {"error": "no such buffer"})
-                    page, complete, failed = buf.get(token, max_wait=1.0)
+                    page, complete, failed = buf.get(token, max_wait=1.0,
+                                                     reader=reader)
                     body = page or b""
                     self.send_response(200)
                     self.send_header("Content-Type",
@@ -357,8 +408,8 @@ class WorkerServer:
                                          failed.splitlines()[0][:200])
                     self.end_headers()
                     self.wfile.write(body)
-                    if complete:
-                        worker.out_buffers.pop(tid, None)  # fully delivered
+                    if complete and buf.fully_delivered:
+                        worker.out_buffers.pop(tid, None)  # all readers done
                     return
                 if self.path.startswith("/v1/task/"):
                     tid = self.path.rsplit("/", 1)[-1]
@@ -408,6 +459,24 @@ class WorkerServer:
                         return self._reply(403, {"error": "bad signature"})
                     worker.shutdown_gracefully()
                     return self._reply(200, {"state": "shutting_down"})
+                if self.path.startswith("/v1/task/") \
+                        and self.path.endswith("/abandon"):
+                    # /v1/task/{tid}/results/{reader}/abandon — a consumer
+                    # died and retries against a fresh producer; release this
+                    # reader slot so surviving readers govern page retention.
+                    # Signed like the stream reads (path signature, no body).
+                    if worker.secret is not None:
+                        got = self.headers.get("X-Trino-Internal-Signature", "")
+                        want = _sign(worker.secret, self.path.encode())
+                        if not hmac.compare_digest(got, want):
+                            return self._reply(403, {"error": "bad signature"})
+                    parts = self.path.split("/")
+                    buf = worker.out_buffers.get(parts[3])
+                    if buf is not None:
+                        buf.abandon(int(parts[5]))
+                        if buf.fully_delivered:
+                            worker.out_buffers.pop(parts[3], None)
+                    return self._reply(200, {"ok": True})
                 self._reply(404, {"error": "not found"})
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
@@ -506,20 +575,34 @@ class WorkerServer:
             stream_out = req.get("output") == "stream"
             buf = None
             if stream_out:
-                buf = _OutputBuffer()
+                buf = _OutputBuffer(n_readers=int(req.get("n_readers", 1)))
                 with self._wlock:
                     self.out_buffers[tid] = buf
-                    done_bufs = [t for t, b in self.out_buffers.items()
-                                 if b.done or b.failed]
+                    # evict buffers nothing will read again first; if the
+                    # registry is still over its bound, fall back to oldest
+                    # DONE buffers (a consumer stage that never dispatched —
+                    # degraded query — would otherwise pin them forever)
+                    dead = [t for t, b in self.out_buffers.items()
+                            if b.failed or b.fully_delivered]
+                    done = [t for t, b in self.out_buffers.items()
+                            if b.done and t not in dead]
                     while len(self.out_buffers) > self.max_out_buffers \
-                            and done_bufs:
-                        self.out_buffers.pop(done_bufs.pop(0), None)
+                            and (dead or done):
+                        victim = dead.pop(0) if dead else done.pop(0)
+                        self.out_buffers.pop(victim, None)
             sources = req.get("stream_sources") or {}
             fetch = None
             if sources:
                 def fetch(t, sources=sources):
-                    return stream_task_pages(sources[t], t,
-                                             secret=self.secret)
+                    # source values: plain url (reader 0 of task t) or a dict
+                    # {"url", "task", "reader"} — the broadcast/retry form
+                    # where the serving task id and reader slot differ
+                    v = sources[t]
+                    if isinstance(v, str):
+                        return stream_task_pages(v, t, secret=self.secret)
+                    return stream_task_pages(
+                        v["url"], v.get("task", t), secret=self.secret,
+                        reader=int(v.get("reader", 0)))
             ex = self._checkout_executor()
             try:
                 with self._wlock:
@@ -551,7 +634,9 @@ class WorkerServer:
                 st.state = "done"
             except Exception as e:
                 st.state = "failed"
-                st.retryable = is_retryable_failure(e) and not stream_out
+                # streaming no longer forces non-retryable: the coordinator
+                # replays the streaming subtree (fresh producers) on retry
+                st.retryable = is_retryable_failure(e)
                 st.error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
                 if buf is not None:
                     buf.fail(st.error)
@@ -626,15 +711,27 @@ class ClusterCoordinator:
                  secret: Optional[str] = None,
                  speculative_factor: float = 3.0,
                  stream_exchange: bool = True):
-        # stream_exchange: nested single-task fragments ship their output
-        # through in-memory worker buffers (long-poll + token ack) instead of
-        # the spool — the reference's default PIPELINED data plane; the spool
-        # stays for split-fanout stages and the FTE plane.  Streaming tasks do
-        # not retry (pipelined mode has no task retry in the reference either:
-        # failures degrade to the local/spool path at the query level).
+        # stream_exchange: nested fragments ship their output through
+        # in-memory worker buffers (long-poll + token ack) instead of the
+        # spool — the reference's default PIPELINED data plane.  Single-task
+        # consumers read reader slot 0; split-FANOUT consumers read a
+        # broadcast buffer (n_readers = task count, one reader slot per
+        # consumer task).  A failed streaming task retries by REPLAYING its
+        # producer chain: fresh dedicated producers re-execute (outputs are
+        # deterministic, the FTE invariant), the dead reader slot is
+        # abandoned on any surviving old producer, and first-commit-wins
+        # dedup absorbs stragglers from the earlier attempt (reference:
+        # HttpPageBufferClient + DeduplicatingDirectExchangeBuffer).
         self.stream_exchange = stream_exchange
+        self.fanout_stream = _os.environ.get(
+            "TRINO_TPU_FANOUT_STREAM", "1") != "0"  # kill-switch / A-B knob
         self._stream_pending: dict = {}  # id(plan node) -> substituted frag
+        self._stream_producers: dict = {}  # task_id -> replay record
         self.streamed_tasks = 0  # observability: producers launched streaming
+        self.stream_retries = 0  # observability: replayed producer chains
+        self.broadcast_streams = 0  # observability: fan-out producers launched
+        self.local_fallbacks = 0  # observability: queries degraded to local
+        self.last_fallback_error: Optional[str] = None  # why (traceback)
         self.engine = engine
         self.spool_dir = spool_dir
         self.secret = secret if secret is not None \
@@ -852,6 +949,7 @@ class ClusterCoordinator:
             self._task_seq = 0
             self._query_abort.clear()
             self._stream_pending = {}
+            self._stream_producers = {}
             spooled: dict = {}  # id(node) -> (task_ids, node)
             self._mem_results = {}  # id(node) -> (page, dicts) merged locally
             try:
@@ -863,6 +961,8 @@ class ClusterCoordinator:
                     # exhausted retries, cluster-wide death) must not fail a
                     # query the local executor can answer — degrade to local;
                     # genuine query errors re-raise from there identically
+                    self.local_fallbacks += 1
+                    self.last_fallback_error = traceback.format_exc()
                     local._overrides = {}
                     return local.execute(plan)
                 if not spooled:
@@ -927,12 +1027,12 @@ class ClusterCoordinator:
                             for s in node.aggs):
             spine = self._scan_spine(frag.child)
             if spine is not None:
-                # split-fanout tasks resolve RemoteSources from the SPOOL and
-                # would multi-consume a streaming buffer: materialize any
-                # stream-pending children first
-                self._materialize_pending(node, spooled, exchange_dir)
+                # stream-pending children broadcast-stream into the fanout
+                # tasks (one reader slot per task); with the fanout-stream
+                # knob off they materialize through the spool instead
                 task_ids = self._run_split_tasks(frag, spine, exchange_dir,
-                                                 "partial_agg")
+                                                 "partial_agg", fanout=node,
+                                                 spooled=spooled)
                 if task_ids is not None:
                     page, dicts = merge_partial_outputs(
                         frag, [exchange.read(t) for t in task_ids])
@@ -957,9 +1057,9 @@ class ClusterCoordinator:
         if isinstance(node, P.Join):
             spine = self._scan_spine(frag.left)
             if spine is not None:
-                self._materialize_pending(node, spooled, exchange_dir)
                 task_ids = self._run_split_tasks(frag, spine, exchange_dir,
-                                                 "stream_splits")
+                                                 "stream_splits", fanout=node,
+                                                 spooled=spooled)
                 if task_ids is not None:
                     spooled[id(node)] = (task_ids, node)
                     return
@@ -1037,11 +1137,15 @@ class ClusterCoordinator:
             self._task_seq += 1
             return tid
 
-    def _run_split_tasks(self, frag, spine, exchange_dir, kind):
+    def _run_split_tasks(self, frag, spine, exchange_dir, kind,
+                         fanout=None, spooled=None):
         """Fan a fragment out across workers by split batches (reference:
         SourcePartitionedScheduler split placement + the dynamic-filter split
         pruning the scan-only stream compile provides).  Returns the task ids,
-        or None for a zero-split source (caller degrades to a single task)."""
+        or None for a zero-split source (caller degrades to a single task).
+        ``fanout``/``spooled``: the original plan node — its stream-pending
+        child fragments launch as BROADCAST producers (one reader slot per
+        split task) instead of materializing through the spool."""
         scan, chain_top = spine
         splits = None
         try:
@@ -1060,14 +1164,27 @@ class ClusterCoordinator:
             splits = list(self.engine.catalogs[scan.catalog].splits(scan.table))
         if not splits:
             return None
+        n_tasks = (len(splits) + self.splits_per_task - 1) \
+            // self.splits_per_task
+        base_sources = None
+        if fanout is not None and self._collect_pending(fanout, spooled):
+            if self.fanout_stream:
+                base_sources = self._stream_fanout_sources(
+                    fanout, spooled, exchange_dir, n_readers=n_tasks)
+            else:
+                self._materialize_pending(fanout, spooled, exchange_dir)
         tasks = []
-        for i in range((len(splits) + self.splits_per_task - 1)
-                       // self.splits_per_task):
+        for i in range(n_tasks):
             tid = self._next_tid()
             sp = tuple(splits[j] for j in
                        range(i * self.splits_per_task,
                              min((i + 1) * self.splits_per_task, len(splits))))
-            tasks.append((tid, {"splits": sp}))
+            extra = {"splits": sp}
+            if base_sources:
+                extra["stream_sources"] = {
+                    pt: {"url": u, "task": pt, "reader": i}
+                    for pt, u in base_sources.items()}
+            tasks.append((tid, extra))
         self._dispatch_tasks(frag, tasks, exchange_dir, kind)
         return tuple(t for t, _ in tasks)
 
@@ -1113,23 +1230,51 @@ class ClusterCoordinator:
         return sources
 
     def _materialize_pending(self, node, spooled, exchange_dir) -> None:
-        """Run each directly-pending child fragment to a SPOOLED output (for
-        consumers that fan out as split tasks — multiple readers need the
-        durable copy); the child's own pending descendants still stream into
-        it."""
+        """Run each directly-pending child fragment to a SPOOLED output (the
+        fanout-stream kill-switch path: multiple readers share the durable
+        copy); the child's own pending descendants still stream into it."""
         for c in self._collect_pending(node, spooled):
             frag = self._stream_pending.pop(id(c))
             srcs = self._dispatch_stream_tree(c, spooled, exchange_dir)
             tid = spooled[id(c)][0][0]
             self._run_single_task(frag, exchange_dir, tid=tid, sources=srcs)
 
+    def _stream_fanout_sources(self, node, spooled, exchange_dir,
+                               n_readers: int) -> dict:
+        """Launch each directly-pending child fragment as a BROADCAST
+        streaming producer whose buffer serves ``n_readers`` consumer tasks
+        (reference: BroadcastOutputBuffer feeding a replicated-exchange
+        consumer stage).  Returns {task_id: producer url}; the caller assigns
+        one reader slot per consumer task."""
+        sources: dict = {}
+        for c in self._collect_pending(node, spooled):
+            frag = self._stream_pending.pop(id(c))
+            child_sources = self._dispatch_stream_tree(c, spooled,
+                                                       exchange_dir)
+            tid = spooled[id(c)][0][0]
+            sources[tid] = self._dispatch_stream_producer(
+                frag, tid, exchange_dir, child_sources, n_readers=n_readers)
+            with self._lock:
+                self.broadcast_streams += 1
+        return sources
+
     def _dispatch_stream_producer(self, frag, tid, exchange_dir,
-                                  sources) -> str:
+                                  sources, n_readers: int = 1) -> str:
         """Ship a fragment + streaming-output task to one worker WITHOUT
         waiting for completion — the consumer's long-poll reads drive overlap;
         delivery is confirmed by the consumer finishing (reference: pipelined
         stages run concurrently under PipelinedQueryScheduler).  Returns the
-        producer's url."""
+        producer's url.  Records a replay entry so a failed consumer can
+        respawn the producer chain.
+
+        INVARIANT the broadcast mode relies on: these producers run kind
+        "fragment", which emits ONE envelope page (the first ``add`` into an
+        empty buffer always succeeds regardless of size), so a reader set
+        larger than the cluster's concurrent admission capacity cannot
+        deadlock the producer against its max_bytes backpressure.  An
+        INCREMENTAL multi-page producer (run_stream_splits' sink) must never
+        be dispatched with n_readers > 1 without revisiting that backpressure
+        (undispatched readers hold the retention floor at zero)."""
         live = self.live_workers()
         if not live:
             raise RuntimeError("no live workers")
@@ -1139,7 +1284,7 @@ class ClusterCoordinator:
         frag_blob = pickle.dumps({"fragment_id": frag_id, "plan": frag})
         req = {"task_id": tid, "fragment_id": frag_id, "kind": "fragment",
                "attempt": 0, "exchange_dir": exchange_dir,
-               "output": "stream"}
+               "output": "stream", "n_readers": n_readers}
         if sources:
             req["stream_sources"] = sources
         last_err = None
@@ -1150,11 +1295,66 @@ class ClusterCoordinator:
                       secret=self.secret)
                 with self._lock:
                     self.streamed_tasks += 1
+                    self._stream_producers[tid] = {
+                        "frag": frag, "child_tids": list(sources or ()),
+                        "exchange_dir": exchange_dir, "url": w.url}
                 return w.url
             except Exception as e:  # busy/draining/unreachable: try the next
                 last_err = e
         raise RuntimeError(f"no worker accepted streaming task {tid}: "
                            f"{last_err}")
+
+    # -- streaming retry (replay) ---------------------------------------------
+    def _replay_stream_sources(self, sources: dict, attempt: int,
+                               consumer: str = "") -> dict:
+        """A stream-consumer task failed mid-drain.  Its producers' buffers
+        are partially acknowledged (pages already freed for its reader slot),
+        so the retried consumer cannot re-read them: re-dispatch a FRESH
+        dedicated producer chain per source — fragment outputs are
+        deterministic (the same FTE invariant speculation relies on), so the
+        replacement produces identical pages — and abandon the dead reader
+        slot on any surviving old producer so its retention floor recovers.
+        (Reference: HttpPageBufferClient failure handling +
+        DeduplicatingDirectExchangeBuffer replay dedup.)"""
+        new = {}
+        for ptid, v in sources.items():
+            old = v if isinstance(v, dict) \
+                else {"url": v, "task": ptid, "reader": 0}
+            self._abandon_reader(old)
+            new[ptid] = self._respawn_producer(ptid, attempt, consumer)
+        with self._lock:
+            self.stream_retries += 1
+        return new
+
+    def _abandon_reader(self, src: dict) -> None:
+        path = (f"/v1/task/{src.get('task')}/results/"
+                f"{int(src.get('reader', 0))}/abandon")
+        try:
+            req = urllib.request.Request(src["url"] + path, data=b"",
+                                         method="POST")
+            if self.secret:
+                req.add_header("X-Trino-Internal-Signature",
+                               _sign(self.secret, path.encode()))
+            urllib.request.urlopen(req, timeout=2.0).read()
+        except Exception:
+            pass  # best-effort: the old producer may be dead with its worker
+
+    def _respawn_producer(self, ptid: str, attempt: int,
+                          consumer: str = "") -> dict:
+        """Fresh dedicated (n_readers=1) instance of producer ``ptid`` under a
+        new task id, recursively respawning its own producer chain.  The id
+        embeds the retried CONSUMER's task id: two consumers of one broadcast
+        producer failing at the same attempt number must not collide on the
+        respawned task id (a collision overwrites the worker's buffer and
+        cross-drains reader 0)."""
+        rec = self._stream_producers[ptid]
+        child_sources = {c: self._respawn_producer(c, attempt, consumer)
+                         for c in rec["child_tids"]}
+        newtid = f"{ptid}~{consumer}a{attempt}"
+        url = self._dispatch_stream_producer(rec["frag"], newtid,
+                                             rec["exchange_dir"],
+                                             child_sources, n_readers=1)
+        return {"url": url, "task": newtid, "reader": 0}
 
     def _cached_plan(self, sql: str, sess):
         """Versioned, bounded plan cache keyed by (sql, catalog) — the same
@@ -1352,18 +1552,19 @@ class ClusterCoordinator:
                     # and lost its in-memory state) -> the attempt is gone
                     failed = True
                 if failed and not exchange.is_committed(tid):
-                    if "stream_sources" in extra:
-                        # pipelined mode has no task retry: the producer's
-                        # buffer is partially drained — fail the stage (the
-                        # query degrades to the local path)
-                        raise RuntimeError(
-                            f"stream-consumer task {tid} failed; "
-                            "pipelined stages do not retry")
                     del assigned[tid]
                     attempts[tid] += 1
                     if attempts[tid] >= self.max_attempts:
                         raise RuntimeError(
                             f"task {tid} failed after {attempts[tid]} attempts")
+                    if extra.get("stream_sources"):
+                        # the consumer partially drained its producers'
+                        # ack-once buffers: replay the producer chain fresh
+                        # and point the retried consumer at the replacements
+                        extra = dict(extra)
+                        extra["stream_sources"] = self._replay_stream_sources(
+                            extra["stream_sources"], attempts[tid],
+                            consumer=tid)
                     pending[tid] = extra
 
 

@@ -503,12 +503,520 @@ class RemoveRedundantLimit(Rule):
         return None
 
 
+def _map_refs(e: ir.Expr, mapping: dict) -> Optional[ir.Expr]:
+    """Rewrite FieldRef channels through ``mapping`` (old index -> new index);
+    None when a referenced channel has no image (the expression cannot move
+    across this boundary)."""
+    if isinstance(e, ir.FieldRef):
+        if e.index not in mapping:
+            return None
+        return dataclasses.replace(e, index=mapping[e.index])
+    if isinstance(e, ir.Constant):
+        return e
+    if isinstance(e, ir.Call):
+        args = []
+        for a in e.args:
+            m = _map_refs(a, mapping)
+            if m is None:
+                return None
+            args.append(m)
+        return dataclasses.replace(e, args=tuple(args))
+    return None
+
+
+def _ref_channels(e: ir.Expr, out: set) -> None:
+    if isinstance(e, ir.FieldRef):
+        out.add(e.index)
+    elif isinstance(e, ir.Call):
+        for a in e.args:
+            _ref_channels(a, out)
+
+
+class PushFilterThroughJoin(Rule):
+    """Split a filter above an equi-join into side-local conjuncts pushed
+    below the join (reference: optimizations/PredicatePushDown.java:113 — the
+    rule slice that moves single-side conjuncts to their input).  Probe-side
+    conjuncts cut scatter lanes before the join; build-side conjuncts shrink
+    the routed/replicated table.  Outer-join build conjuncts stay put (the
+    NULL-extended rows they see do not exist below the join)."""
+
+    pattern = (P.Filter,)
+
+    def apply(self, node, memo):
+        join = memo.resolve(node.child)
+        if not isinstance(join, P.Join):
+            return None
+        n_left = len(memo.resolve(join.left).schema.fields)
+        push_left, push_right, keep = [], [], []
+        right_ok = join.kind == "inner"  # outer/semi/anti: build rows differ
+        left_ok = join.kind in ("inner", "left", "semi", "anti")
+        for c in _conjuncts(node.predicate):
+            chans: set = set()
+            _ref_channels(c, chans)
+            if chans and max(chans) < n_left and left_ok:
+                push_left.append(c)
+            elif chans and min(chans) >= n_left and right_ok:
+                m = _map_refs(c, {i: i - n_left for i in chans})
+                if m is not None:
+                    push_right.append(m)
+                else:
+                    keep.append(c)
+            else:
+                keep.append(c)
+        if not push_left and not push_right:
+            return None
+        left = P.Filter(join.left, _and_all(push_left)) if push_left \
+            else join.left
+        right = P.Filter(join.right, _and_all(push_right)) if push_right \
+            else join.right
+        out = dataclasses.replace(join, left=left, right=right)
+        return P.Filter(out, _and_all(keep)) if keep else out
+
+
+class PushFilterThroughAggregate(Rule):
+    """Conjuncts over GROUP BY key channels filter the groups' input rows
+    identically (reference: iterative/rule/PushPredicateThroughProjectIntoRowNumber
+    family / PredicatePushDown through aggregations): push them below so the
+    group table never materializes pruned groups."""
+
+    pattern = (P.Filter,)
+
+    def apply(self, node, memo):
+        agg = memo.resolve(node.child)
+        if not isinstance(agg, P.Aggregate) or not agg.keys:
+            return None
+        nk = len(agg.keys)
+        mapping = {i: agg.keys[i] for i in range(nk)}
+        push, keep = [], []
+        for c in _conjuncts(node.predicate):
+            chans: set = set()
+            _ref_channels(c, chans)
+            m = _map_refs(c, mapping) if chans and max(chans) < nk else None
+            if m is not None:
+                push.append(m)
+            else:
+                keep.append(c)
+        if not push:
+            return None
+        out = _replace_children(agg, (P.Filter(agg.child, _and_all(push)),))
+        return P.Filter(out, _and_all(keep)) if keep else out
+
+
+class PushFilterThroughWindow(Rule):
+    """Conjuncts over channels partitioning EVERY window spec remove whole
+    partitions, so they commute with the window computation (reference:
+    iterative/rule/PushPredicateThroughProjectIntoWindow.java /
+    PushdownFilterIntoWindow)."""
+
+    pattern = (P.Filter,)
+
+    def apply(self, node, memo):
+        win = memo.resolve(node.child)
+        if not isinstance(win, P.Window) or not win.specs:
+            return None
+        shared = set(win.specs[0].partition)
+        for s in win.specs[1:]:
+            shared &= set(s.partition)
+        if not shared:
+            return None
+        n_child = len(node.schema.fields) - len(win.specs)
+        push, keep = [], []
+        for c in _conjuncts(node.predicate):
+            chans: set = set()
+            _ref_channels(c, chans)
+            if chans and chans <= shared and max(chans) < n_child:
+                push.append(c)
+            else:
+                keep.append(c)
+        if not push:
+            return None
+        out = _replace_children(win, (P.Filter(win.child, _and_all(push)),))
+        return P.Filter(out, _and_all(keep)) if keep else out
+
+
+class PushFilterThroughUnion(Rule):
+    """Filter(Union(a, b, ...)) -> Union(Filter(a), Filter(b), ...)
+    (reference: iterative/rule/PushFilterThroughUnion via PredicatePushDown):
+    each branch masks its own lanes; set-op dictionary merge projections sit
+    at the branch roots, so dictionary-id constants stay valid per branch."""
+
+    pattern = (P.Filter,)
+
+    def apply(self, node, memo):
+        u = memo.resolve(node.child)
+        if not isinstance(u, P.Union):
+            return None
+        # fixpoint guard: skip only when THIS predicate already sits at a
+        # branch root (repr proxy — structural eq can trip on array-valued
+        # LUT constants); a branch's own unrelated filter must not block the
+        # push (MergeFilters collapses the stack below)
+        want = repr(node.predicate)
+        if any(isinstance(rc := memo.resolve(c), P.Filter)
+               and repr(rc.predicate) == want for c in u.children):
+            return None
+        filtered = tuple(P.Filter(c, node.predicate) for c in u.children)
+        return dataclasses.replace(u, inputs=filtered)
+
+
+class PushFilterThroughSort(Rule):
+    """Filter(Sort(x)) -> Sort(Filter(x)): same multiset, same order, fewer
+    rows through the blocking device lexsort (reference: PredicatePushDown —
+    sorts are order-transparent for predicates)."""
+
+    pattern = (P.Filter,)
+
+    def apply(self, node, memo):
+        s = memo.resolve(node.child)
+        if not isinstance(s, P.Sort):
+            return None
+        return _replace_children(s, (P.Filter(s.child, node.predicate),))
+
+
+def _empty(node) -> bool:
+    return isinstance(node, P.Values) and not node.rows
+
+
+class PropagateEmptyUnary(Rule):
+    """A row-preserving-or-reducing unary node over zero rows is zero rows
+    (reference: the iterative/rule/EvaluateEmpty* / RemoveEmpty* family, e.g.
+    EvaluateZeroSample, PruneEmptyUnionBranches groundwork).  Ungrouped
+    aggregates are excluded: they emit one row from empty input."""
+
+    pattern = (P.Filter, P.Project, P.Sort, P.Limit, P.Window, P.Unnest)
+
+    def apply(self, node, memo):
+        if not _empty(memo.resolve(node.children[0])):
+            return None
+        return P.Values((), node.schema)
+
+
+class EliminateEmptyJoin(Rule):
+    """Joins with a statically-empty input collapse (reference:
+    iterative/rule/EvaluateEmptyIntersect / RemoveRedundantJoin family):
+    inner/semi with either side empty, left-outer/anti with an empty probe."""
+
+    pattern = (P.Join,)
+
+    def apply(self, node, memo):
+        lempty = _empty(memo.resolve(node.left))
+        rempty = _empty(memo.resolve(node.right))
+        if node.kind == "inner" and (lempty or rempty):
+            return P.Values((), node.schema)
+        if node.kind == "semi" and (lempty or rempty):
+            return P.Values((), node.schema)
+        if node.kind in ("left", "anti") and lempty:
+            return P.Values((), node.schema)
+        return None
+
+
+class DropEmptyUnionInputs(Rule):
+    """Union inputs that are statically empty contribute nothing (reference:
+    iterative/rule/PruneEmptyUnionBranches analog)."""
+
+    pattern = (P.Union,)
+
+    def apply(self, node, memo):
+        live = [c for c in node.children if not _empty(memo.resolve(c))]
+        if len(live) == len(node.children):
+            return None
+        if not live:
+            return P.Values((), node.schema)
+        if len(live) == 1:
+            # single survivor must still present the union's channel names
+            survivor = memo.resolve(live[0])
+            if survivor.schema == node.schema:
+                return live[0]
+            exprs = tuple(ir.FieldRef(i, f.type)
+                          for i, f in enumerate(survivor.schema.fields))
+            return P.Project(live[0], exprs, node.schema)
+        return dataclasses.replace(node, inputs=tuple(live))
+
+
+class MergeAdjacentProjects(Rule):
+    """Project(Project(x)) -> one Project with outer expressions substituted
+    through the inner ones (reference: iterative/rule/InlineProjections.java).
+    Guarded on dictionary channels: planner-derived dictionaries ride the
+    projection, so merging only happens when they provably carry through."""
+
+    pattern = (P.Project,)
+
+    def apply(self, node, memo):
+        inner = memo.resolve(node.child)
+        if not isinstance(inner, P.Project):
+            return None
+        # use-count guard (InlineProjections.java's rule): a non-trivial
+        # inner expression referenced more than once would be DUPLICATED by
+        # substitution — chained merges then grow the tree exponentially
+        uses: dict = {}
+
+        def count(e):
+            if isinstance(e, ir.FieldRef):
+                uses[e.index] = uses.get(e.index, 0) + 1
+            elif isinstance(e, ir.Call):
+                for a in e.args:
+                    count(a)
+
+        for e in node.exprs:
+            count(e)
+        for c, n in uses.items():
+            if n > 1 and c < len(inner.exprs) \
+                    and not isinstance(inner.exprs[c],
+                                       (ir.FieldRef, ir.Constant)):
+                return None
+        inner_dicts = inner.dicts if inner.dicts else \
+            tuple(None for _ in inner.exprs)
+        outer_dicts = node.dicts if node.dicts else \
+            tuple(None for _ in node.exprs)
+        exprs, dicts = [], []
+        for j, e in enumerate(node.exprs):
+            sub = _substitute_refs(e, inner.exprs)
+            if sub is None:
+                return None
+            d = outer_dicts[j]
+            if d is None and isinstance(e, ir.FieldRef) \
+                    and e.index < len(inner_dicts):
+                d = inner_dicts[e.index]  # pass-through keeps the derived dict
+            elif d is None and not isinstance(e, ir.FieldRef):
+                # a computed outer expr consuming a dict-deriving inner
+                # channel: the substituted tree still sees the same ids, but
+                # only merge when the consumed channels derive NO dictionary
+                chans: set = set()
+                _ref_channels(e, chans)
+                if any(c < len(inner_dicts) and inner_dicts[c] is not None
+                       for c in chans):
+                    return None
+            exprs.append(sub)
+            dicts.append(d)
+        use_dicts = tuple(dicts) if any(d is not None for d in dicts) else ()
+        return P.Project(inner.child, tuple(exprs), node.schema, use_dicts)
+
+
+# -- constant folding ----------------------------------------------------------
+_FOLD_SCALARS = (bool, int, float)
+
+
+def _kleene_and(vals):
+    if any(v is False for v in vals):
+        return False
+    if any(v is None for v in vals):
+        return None
+    return True
+
+
+def _kleene_or(vals):
+    if any(v is True for v in vals):
+        return True
+    if any(v is None for v in vals):
+        return None
+    return False
+
+
+def _fold(e: ir.Expr):
+    """-> (value, ok): evaluate a constant expression over whitelisted pure
+    ops with SQL three-valued logic (None = NULL).  ok=False when the tree
+    holds anything non-constant or outside the whitelist."""
+    if isinstance(e, ir.Constant):
+        v = e.value
+        if v is None or isinstance(v, _FOLD_SCALARS):
+            return v, True
+        return None, False
+    if not isinstance(e, ir.Call):
+        return None, False
+    vals = []
+    for a in e.args:
+        v, ok = _fold(a)
+        if not ok:
+            return None, False
+        vals.append(v)
+    op = e.op
+    if op == "and":
+        return _kleene_and(vals), True
+    if op == "or":
+        return _kleene_or(vals), True
+    if op == "not":
+        return (None if vals[0] is None else not vals[0]), True
+    if any(v is None for v in vals):  # NULL propagates through scalar ops
+        return None, True
+    try:
+        if op == "add":
+            return vals[0] + vals[1], True
+        if op == "sub":
+            return vals[0] - vals[1], True
+        if op == "mul":
+            return vals[0] * vals[1], True
+        if op == "eq":
+            return vals[0] == vals[1], True
+        if op == "neq":
+            return vals[0] != vals[1], True
+        if op == "lt":
+            return vals[0] < vals[1], True
+        if op == "lte":
+            return vals[0] <= vals[1], True
+        if op == "gt":
+            return vals[0] > vals[1], True
+        if op == "gte":
+            return vals[0] >= vals[1], True
+    except TypeError:
+        return None, False
+    return None, False
+
+
+class SimplifyFilterPredicate(Rule):
+    """Fold constant conjuncts at plan time (reference:
+    iterative/rule/SimplifyExpressions.java + ExpressionInterpreter): TRUE
+    conjuncts vanish, a FALSE/NULL conjunct empties the filter (NULL predicate
+    drops the row in SQL), constant comparisons collapse."""
+
+    pattern = (P.Filter,)
+
+    def apply(self, node, memo):
+        changed = False
+        keep = []
+        for c in _conjuncts(node.predicate):
+            v, ok = _fold(c)
+            if not ok:
+                keep.append(c)
+                continue
+            changed = True
+            if v is True:
+                continue  # TRUE conjunct: drop
+            # FALSE or NULL conjunct: no row survives
+            return P.Values((), node.schema)
+        if not changed:
+            return None
+        if not keep:
+            return node.child  # every conjunct was TRUE: splice the child
+        return P.Filter(node.child, _and_all(keep))
+
+
+class RemoveRedundantDistinct(Rule):
+    """DISTINCT over DISTINCT: the outer grouping re-groups rows that are
+    already unique on the same keys (reference:
+    iterative/rule/RemoveRedundantDistinct... / MultipleDistinctAggregationToMarkDistinct
+    groundwork).  Matches Aggregate(keys=identity, aggs=()) over
+    Aggregate(aggs=()) whose key fields ARE the child schema."""
+
+    pattern = (P.Aggregate,)
+
+    def apply(self, node, memo):
+        if node.aggs or not node.keys:
+            return None
+        inner = memo.resolve(node.child)
+        if not isinstance(inner, P.Aggregate) or inner.aggs:
+            return None
+        # inner distinct output schema = its key fields; the outer is
+        # redundant when it groups by exactly those channels (any order)
+        if sorted(node.keys) != list(range(len(inner.schema.fields))):
+            return None
+        if tuple(node.keys) == tuple(range(len(inner.schema.fields))):
+            return node.child  # identical key order: splice
+        return None  # reordered keys change the output schema: keep
+
+
+class EvaluateFilterOverValues(Rule):
+    """Filter(Values) with a foldable predicate evaluates at plan time
+    (reference: iterative/rule/EvaluateFilterOverValues... the
+    ValuesNode-folding family).  Only literal scalar rows participate —
+    string channels carry dictionary ids and stay untouched."""
+
+    pattern = (P.Filter,)
+
+    def apply(self, node, memo):
+        vals = memo.resolve(node.child)
+        if not isinstance(vals, P.Values) or not vals.rows:
+            return None
+        chans: set = set()
+        _ref_channels(node.predicate, chans)
+        if any(vals.schema.fields[c].type.is_string for c in chans):
+            return None
+        kept = []
+        for row in vals.rows:
+            sub = _substitute_refs(
+                node.predicate,
+                tuple(ir.Constant(v, vals.schema.fields[i].type)
+                      for i, v in enumerate(row)))
+            if sub is None:
+                return None
+            v, ok = _fold(sub)
+            if not ok:
+                return None
+            if v is True:
+                kept.append(row)
+        if len(kept) == len(vals.rows):
+            return node.child  # nothing filtered: splice
+        return dataclasses.replace(vals, rows=tuple(kept))
+
+
+class EvaluateLimitOverValues(Rule):
+    """Limit(Values) truncates the literal rows at plan time (reference:
+    iterative/rule/EvaluateLimitOverValues analog; RemoveRedundantLimit
+    already handles len <= count)."""
+
+    pattern = (P.Limit,)
+
+    def apply(self, node, memo):
+        vals = memo.resolve(node.child)
+        if not isinstance(vals, P.Values) or len(vals.rows) <= node.count:
+            return None
+        return dataclasses.replace(vals, rows=tuple(vals.rows[:node.count]))
+
+
+class DedupSortKeys(Rule):
+    """Sorting twice by the same channel is one comparator (reference:
+    the RemoveRedundantSort family's key normalization): later duplicates
+    can never break ties the first occurrence left."""
+
+    pattern = (P.Sort,)
+
+    def apply(self, node, memo):
+        seen: set = set()
+        keys = []
+        for k in node.keys:
+            if k.channel in seen:
+                continue
+            seen.add(k.channel)
+            keys.append(k)
+        if len(keys) == len(node.keys):
+            return None
+        return dataclasses.replace(node, keys=tuple(keys))
+
+
+class DedupJoinKeys(Rule):
+    """Duplicate equi-key pairs state the same constraint twice; dropping
+    them narrows the hashed key tuple (reference: join-clause normalization
+    in PredicatePushDown/EqualityInference)."""
+
+    pattern = (P.Join,)
+
+    def apply(self, node, memo):
+        seen: set = set()
+        lk, rk = [], []
+        for a, b in zip(node.left_keys, node.right_keys):
+            if (a, b) in seen:
+                continue
+            seen.add((a, b))
+            lk.append(a)
+            rk.append(b)
+        if len(lk) == len(node.left_keys):
+            return None
+        return dataclasses.replace(node, left_keys=tuple(lk),
+                                   right_keys=tuple(rk))
+
+
 DEFAULT_RULES = (MergeFilters(), MergeLimits(), EliminateLimitZero(),
                  RemoveIdentityProject(), EliminateSortUnderOrderDestroyer(),
                  InferJoinSideFilters(), PushFilterThroughProject(),
                  PushLimitThroughProject(), RemoveTrivialFilter(),
                  MergeUnions(), PushLimitThroughUnion(),
-                 RemoveRedundantLimit())
+                 RemoveRedundantLimit(),
+                 # round-5 expansion (VERDICT item 4): pushdown + folding
+                 PushFilterThroughJoin(), PushFilterThroughAggregate(),
+                 PushFilterThroughWindow(), PushFilterThroughUnion(),
+                 PushFilterThroughSort(), PropagateEmptyUnary(),
+                 EliminateEmptyJoin(), DropEmptyUnionInputs(),
+                 MergeAdjacentProjects(), SimplifyFilterPredicate(),
+                 RemoveRedundantDistinct(), EvaluateFilterOverValues(),
+                 EvaluateLimitOverValues(), DedupSortKeys(), DedupJoinKeys())
 
 
 def optimize_plan(root: P.PlanNode) -> P.PlanNode:
