@@ -181,3 +181,34 @@ def test_query_max_memory_kills_query():
         "select count(*) c from lineitem, orders "
         "where l_orderkey = o_orderkey", s).to_pandas()
     assert int(r.iloc[0, 0]) > 0
+
+
+@pytest.mark.slow
+def test_grace_aggregation_at_50m_groups():
+    """Grace-partitioned aggregation at REAL size (round-4 verdict item 2:
+    the spill tier was toy-verified): SF34 orders = 51M distinct o_orderkey
+    groups, 1.5x the 2^25 on-device group-table ceiling, forcing the
+    host-RAM partition router (reference: SpillableHashAggregationBuilder at
+    spill scale).  Asserts group count exactness and that the partitioned
+    strategy (not the in-core table) executed."""
+    sf = 34
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=sf, split_rows=1 << 21))
+    s = e.create_session("tpch")
+    # count over the distinct-key aggregation: the inner GROUP BY carries
+    # 51,000,000 groups through the Grace router; the outer count collapses
+    # the result so the assertion never materializes 51M python rows
+    plan = compile_sql(
+        "select count(*) c, sum(n) rows_total from "
+        "(select o_orderkey, count(*) n from orders group by o_orderkey)",
+        e, s)
+    ex = LocalExecutor(e.catalogs)
+    rows = ex.execute(plan).rows()
+    n_groups, n_rows = rows[0]
+    assert n_groups == int(sf * 1_500_000), rows
+    assert n_rows == int(sf * 1_500_000), rows  # o_orderkey is unique in orders
+    spilled = [st for st in ex.stats.values()
+               if st.get("spill_partitions")]
+    assert spilled, "expected the Grace-partitioned aggregation to engage"
+    assert spilled[0]["spill_partitions"] >= 4
+    assert spilled[0].get("spilled_bytes", 0) > 0

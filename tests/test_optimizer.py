@@ -145,8 +145,12 @@ def test_cbo_distribution_hints_scale_with_stats():
     dist = dict(_join_chain(compile_sql(Q9, e, s)))
     assert dist["orders"] == "partitioned"
     assert dist["partsupp"] == "partitioned"
-    assert dist["nation"] == "replicated"
-    assert dist["part"] == "replicated"
+    # round 5: the AddExchanges pass resolves small KNOWN builds against the
+    # huge probe side to an explicit broadcast (replicating 25 nations x the
+    # mesh beats routing the probe); 'replicated' now only survives where
+    # stats are unknown or the traffic model is a wash
+    assert dist["nation"] == "broadcast"
+    assert dist["part"] in ("replicated", "broadcast")
 
     q = "select count(*) c from lineitem, orders where l_orderkey = o_orderkey"
     s2 = e.create_session("tpch")
@@ -236,8 +240,12 @@ def test_count_star_pushdown_exact():
         pushed = int(e.execute_sql("select count(*) from lineitem",
                                    s).rows()[0][0])
         assert calls["n"] == 0, "count(*) should not execute an aggregation"
+        # NOTE a '1 = 1' filter no longer works as the control here: round-5
+        # constant folding (SimplifyFilterPredicate) erases it at plan time
+        # and the pushdown legitimately applies.  A data-dependent filter
+        # still disables the pushdown and executes the aggregation.
         real = int(e.execute_sql("select count(*) c from lineitem "
-                                 "where 1 = 1", s).rows()[0][0])
+                                 "where l_quantity > -1", s).rows()[0][0])
         assert pushed == real
         # filters disable the pushdown
         assert calls["n"] >= 1

@@ -1362,7 +1362,9 @@ class ClusterCoordinator:
         session catalog's table resolution and dictionary LUTs)."""
         from ..sql.frontend import compile_sql
 
-        key = (sql, sess.catalog, sess.user)
+        from ..engine import _plan_shape_props
+
+        key = (sql, sess.catalog, sess.user, _plan_shape_props(sess))
         with self._lock:
             entry = self._plan_cache.get(key)
             if entry is not None:

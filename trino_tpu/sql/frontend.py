@@ -97,11 +97,19 @@ class Planner(ExpressionAnalyzer):
                 node = P.Sort(node, tuple(keys))
             if q.limit is not None:
                 node = P.Limit(node, q.limit)
+            from .exchanges import resolve_distributions
             from .optimizer import pushdown_aggregations
             from .rules import optimize_plan
 
             out = optimize_plan(P.Output(node, tuple(out_names)))
-            return pushdown_aggregations(out, self.engine.catalogs)
+            out = pushdown_aggregations(out, self.engine.catalogs)
+            # global distribution planning (AddExchanges product 1): resolve
+            # every join's partitioning from the cost model over the whole
+            # optimized tree — the per-join frontend estimate only saw its
+            # own build side
+            return resolve_distributions(
+                out, self.engine.catalogs,
+                getattr(self.session, "properties", None))
         finally:
             self.ctes = saved
 
@@ -1651,8 +1659,9 @@ class Planner(ExpressionAnalyzer):
         one = ir.Constant(1, BIGINT)
         return self._make_join("inner", probe, build, [(one, one)])
 
-    PARTITIONED_JOIN_THRESHOLD = 1 << 17  # estimated build rows; mirrors the
-    # distributed executor's actual-size default (DetermineJoinDistributionType)
+    from .stats import PARTITIONED_JOIN_THRESHOLD  # one constant shared with
+    # the AddExchanges pass; the distributed executor's actual-size default
+    # is the matching runtime knob (DetermineJoinDistributionType)
 
     def _join_distribution(self, build_rows) -> str:
         """'replicated' | 'partitioned' | 'broadcast' (forced) from the session's

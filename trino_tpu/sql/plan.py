@@ -288,6 +288,32 @@ class RemoteSource(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class Exchange(PlanNode):
+    """PHYSICAL data-movement marker (reference: sql/planner/plan/ExchangeNode.java
+    placed by optimizations/AddExchanges.java:145).  The execution plan never
+    contains these — on TPU the movement is an XLA collective fused into the
+    surrounding jitted program (all_to_all / all_gather over the mesh), not an
+    operator.  ``exchanges.physical_plan`` inserts them for EXPLAIN so the
+    chosen placement and partitioning handle are visible and testable.
+
+    kind: 'broadcast' (replicate to every device) | 'hash' (route by key
+    hash — the bucketize + all_to_all protocol) | 'gather' (collect partials
+    to the merge site)."""
+
+    child: PlanNode
+    kind: str
+    keys: tuple = ()  # child channel indices for 'hash'
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
 class Output(PlanNode):
     """reference: sql/planner/plan/OutputNode.java; renames channels for the client."""
 

@@ -54,6 +54,12 @@ def _fmt(node: P.PlanNode, lines: list, depth: int, stats: dict) -> None:
                if node.est_rows is not None else "")
         lines.append(f"{pad}{node.kind.capitalize()}Join[{keys}{extra}{na}, "
                      f"{node.distribution}{est}] => {_schema_str(node)}")
+    elif isinstance(node, P.Exchange):
+        # physical placement marker (AddExchanges product; on TPU this is the
+        # XLA collective fused into the surrounding program, not an operator)
+        keys = f" on [{', '.join(f'${k}' for k in node.keys)}]" \
+            if node.keys else ""
+        lines.append(f"{pad}Exchange[{node.kind}{keys}]")
     elif isinstance(node, P.Filter):
         lines.append(f"{pad}Filter[{node.predicate}]")
     elif isinstance(node, P.Project):

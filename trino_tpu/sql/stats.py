@@ -21,6 +21,10 @@ __all__ = ["RelStats", "scan_stats", "filter_selectivity", "join_stats"]
 UNKNOWN_FILTER_COEFFICIENT = 0.9  # reference: FilterStatsCalculator
 COMPARISON_COEFFICIENT = 0.25  # un-estimatable range predicate
 DEFAULT_ROWS = float(1 << 20)  # relations with no stats (subqueries, views)
+PARTITIONED_JOIN_THRESHOLD = 1 << 17  # estimated build rows past which a join
+# plans partitioned (shared by the frontend's per-join estimate and the
+# AddExchanges pass; the distributed executor's partition_threshold is the
+# matching ACTUAL-size runtime knob — DetermineJoinDistributionType)
 
 
 @dataclasses.dataclass
