@@ -268,15 +268,19 @@ def test_speculative_execution_of_stragglers(tmp_path):
         coord.wait_for_workers(2, timeout=30)
         expected = e.execute_sql(Q).rows()
         coord.execute_sql(Q)  # warm both workers' compile caches
+        # 20s straggler cost: big enough that "the query finished in well
+        # under one straggler" stays unambiguous on a loaded 1-core box
+        # (wall-clock margins below that were flaky under background load)
         orig = w2.local._agg_compiled
-        w2.local._agg_compiled = lambda node, _o=orig: (time.sleep(6),
+        w2.local._agg_compiled = lambda node, _o=orig: (time.sleep(20),
                                                         _o(node))[1]
         t0 = time.time()
         got = coord.execute_sql(Q).rows()
         elapsed = time.time() - t0
         assert got == expected
         assert coord.speculative_tasks >= 1, "no speculation happened"
-        assert elapsed < 6.0, f"query waited out the straggler ({elapsed:.1f}s)"
+        assert elapsed < 19.0, \
+            f"query waited out the straggler ({elapsed:.1f}s)"
     finally:
         w1.stop()
         w2.stop()
@@ -299,11 +303,11 @@ def test_fte_memory_failure_bisects_task(tmp_path):
     calls = []
     orig = F._partial_once
 
-    def flaky(node, stream, key_types, acc_specs, step, splits):
+    def flaky(node, stream, key_types, acc_specs, step, splits, tick=None):
         calls.append(len(splits))
         if len(splits) > 1:
             raise MemoryError("synthetic RESOURCE_EXHAUSTED")
-        return orig(node, stream, key_types, acc_specs, step, splits)
+        return orig(node, stream, key_types, acc_specs, step, splits, tick)
 
     F._partial_once = flaky
     try:

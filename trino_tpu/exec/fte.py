@@ -541,7 +541,7 @@ def _is_memory_failure(e: BaseException) -> bool:
 
 
 def run_partial_aggregate_splits(node, stream, key_types, acc_specs, step,
-                                 splits) -> bytes:
+                                 splits, tick=None) -> bytes:
     """Partial aggregation over a split subset -> serialized partial page
     (keys + raw accumulator columns).  A MEMORY failure bisects the split set
     and merges the halves' partial states — the task retries at half the
@@ -549,19 +549,21 @@ def run_partial_aggregate_splits(node, stream, key_types, acc_specs, step,
     ExponentialGrowthPartitionMemoryEstimator, inverted: rather than asking
     the scheduler for a bigger node, the task shrinks itself)."""
     try:
-        return _partial_once(node, stream, key_types, acc_specs, step, splits)
+        return _partial_once(node, stream, key_types, acc_specs, step, splits,
+                             tick)
     except Exception as e:
         if not _is_memory_failure(e) or len(splits) <= 1:
             raise
         mid = len(splits) // 2
         a = run_partial_aggregate_splits(node, stream, key_types, acc_specs,
-                                         step, splits[:mid])
+                                         step, splits[:mid], tick)
         b = run_partial_aggregate_splits(node, stream, key_types, acc_specs,
-                                         step, splits[mid:])
+                                         step, splits[mid:], tick)
         return _merge_partial_raw(node, key_types, acc_specs, [a, b])
 
 
-def _partial_once(node, stream, key_types, acc_specs, step, splits) -> bytes:
+def _partial_once(node, stream, key_types, acc_specs, step, splits,
+                  tick=None) -> bytes:
     si = stream.scan_info
     capacity = node.capacity or 1 << 16
     while True:
@@ -570,6 +572,8 @@ def _partial_once(node, stream, key_types, acc_specs, step, splits) -> bytes:
         for split in splits:
             page = si.conn.generate(split, list(si.scan_columns))
             state = step(state, page, stream.aux)
+            if tick is not None:
+                tick()  # split-boundary preemption point (fair scheduler)
         if not bool(state.overflow):
             break
         capacity *= 4
@@ -625,7 +629,7 @@ def _merge_partial_raw(node, key_types, acc_specs, payloads) -> bytes:
 
 def run_partial_aggregate(local: LocalExecutor, node, splits,
                           exchange_dir: str = None, stream_sources=None,
-                          fetch_stream=None) -> bytes:
+                          fetch_stream=None, tick=None) -> bytes:
     """Worker entry: compile the aggregation on this process's executor and run
     the partial task over ``splits``; the output envelope carries the group
     keys' dictionaries so the coordinator can merge without compiling the
@@ -640,7 +644,7 @@ def run_partial_aggregate(local: LocalExecutor, node, splits,
     try:
         stream, key_types, acc_specs, _, _, step = local._agg_compiled(node)
         data = run_partial_aggregate_splits(node, stream, key_types, acc_specs,
-                                            step, splits)
+                                            step, splits, tick)
         key_dicts = tuple(stream.dicts[i] for i in node.keys)
     finally:
         local._overrides = saved
@@ -742,7 +746,7 @@ def run_fragment(local: LocalExecutor, node, exchange_dir: str,
 
 def run_stream_splits(local: LocalExecutor, node, exchange_dir: str,
                       splits, stream_sources=None, fetch_stream=None,
-                      sink=None) -> bytes:
+                      sink=None, tick=None) -> bytes:
     """Worker entry: run a STREAMING fragment (a join's probe pipeline) over a
     subset of its scan splits — the probe-side task shape (reference: one
     HttpRemoteTask per split batch through the fragment's pipeline).  Build
@@ -774,6 +778,8 @@ def run_stream_splits(local: LocalExecutor, node, exchange_dir: str,
                 sink(serialize_fragment_output(ccols, cnulls, stream.dicts))
             else:
                 parts.append((ccols, cnulls))
+            if tick is not None:
+                tick()  # split-boundary preemption point (fair scheduler)
         dicts = stream.dicts
     finally:
         local._overrides = saved
