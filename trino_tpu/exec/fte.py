@@ -200,13 +200,14 @@ def is_retryable_failure(e: BaseException) -> bool:
     planner bugs — would fail identically on every attempt, so retrying them
     burns the budget and hides the real message; everything else (connector
     IO, transient device/runtime errors, injected faults) retries."""
+    from ..memory import QueryKilledError, QueryMemoryLimitError
     from ..spi.security import AccessDeniedError
     from ..sql.frontend import SemanticError
     from ..sql.parser import ParseError
 
     deterministic = (SemanticError, ParseError, AccessDeniedError,
                      NotImplementedError, AssertionError, AttributeError,
-                     NameError)
+                     NameError, QueryKilledError, QueryMemoryLimitError)
     return isinstance(e, Exception) and not isinstance(e, deterministic)
 
 

@@ -16,7 +16,7 @@ from typing import Optional
 
 __all__ = ["MemoryPool", "AggregatedMemoryContext", "LocalMemoryContext",
            "MemoryPoolExhaustedError", "QueryMemoryLimitError",
-           "device_memory_budget"]
+           "QueryKilledError", "device_memory_budget"]
 
 
 class MemoryPoolExhaustedError(MemoryError):
@@ -27,6 +27,15 @@ class QueryMemoryLimitError(MemoryError):
     """The QUERY exceeded its query_max_memory limit — a hard kill, not a
     spill trigger (reference: ExceededMemoryLimitException +
     memory/MemoryPool per-query tracking feeding the kill policy)."""
+
+
+class QueryKilledError(MemoryError):
+    """The cluster low-memory policy chose this query as the victim
+    (reference: memory/LowMemoryKiller + ClusterMemoryManager.java:92).
+    Deterministic: retrying would hit the same cluster pressure."""
+
+
+_SCOPE = threading.local()  # current query key for per-query attribution
 
 
 def device_memory_budget(fraction: float = 0.75) -> int:
@@ -60,14 +69,69 @@ class MemoryPool:
         # merely returns False so operators fall back to their Grace strategy
         self.query_limit: Optional[int] = None
         self.query_reserved = 0
+        # cluster-killer surfaces: per-query attribution via the thread's
+        # query scope (reference: MemoryPool.java:46 taggedMemoryAllocations
+        # feeding ClusterMemoryManager), and the killed-query poison entries.
+        # Poison is BOUNDED-FIFO rather than cleared with the query's last
+        # local task: clearing on task exit would un-poison a victim whose
+        # sibling tasks are still being re-offered to this node, and a victim
+        # that never returns would leak its entry forever.
+        self._by_query: dict[str, int] = {}
+        self._killed: dict = {}  # insertion-ordered; oldest evicted past cap
+        self._killed_cap = 64
 
     def begin_query(self, limit: Optional[int]) -> None:
         with self._lock:
             self.query_limit = limit
             self.query_reserved = 0
 
-    def try_reserve(self, nbytes: int, tag: str = "") -> bool:
+    # -- per-query scope (cluster kill policy surfaces) -----------------------
+    def query_scope(self, key: str):
+        """Context manager: reservations on THIS THREAD attribute to ``key``
+        (worker task bodies run inside their query's scope)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _scope():
+            prev = getattr(_SCOPE, "key", None)
+            _SCOPE.key = key
+            try:
+                yield
+            finally:
+                _SCOPE.key = prev
+
+        return _scope()
+
+    def kill_query(self, key: str) -> None:
+        """Poison a query: its next reservation (any thread) raises
+        QueryKilledError; held memory frees as its tasks unwind."""
         with self._lock:
+            self._killed[key] = True
+            while len(self._killed) > self._killed_cap:
+                self._killed.pop(next(iter(self._killed)))
+
+    def check_killed(self) -> None:
+        """Raise if the current thread's query scope has been killed — called
+        at preemption points so even reservation-free phases terminate."""
+        key = getattr(_SCOPE, "key", None)
+        with self._lock:
+            if key is not None and key in self._killed:
+                raise QueryKilledError(
+                    f"query {key} killed by the cluster low-memory policy")
+
+    def clear_query(self, key: str) -> None:
+        """Drop a finished query's ATTRIBUTION on this node.  Poison entries
+        deliberately survive (see _killed above) so re-offered sibling tasks
+        of a killed query still die here; the bounded FIFO retires them."""
+        with self._lock:
+            self._by_query.pop(key, None)
+
+    def try_reserve(self, nbytes: int, tag: str = "") -> bool:
+        qkey = getattr(_SCOPE, "key", None)
+        with self._lock:
+            if qkey is not None and qkey in self._killed:
+                raise QueryKilledError(
+                    f"query {qkey} killed by the cluster low-memory policy")
             if self.query_limit is not None \
                     and self.query_reserved + nbytes > self.query_limit:
                 raise QueryMemoryLimitError(
@@ -80,6 +144,8 @@ class MemoryPool:
             self.query_reserved += nbytes
             if tag:
                 self._by_tag[tag] = self._by_tag.get(tag, 0) + nbytes
+            if qkey is not None:
+                self._by_query[qkey] = self._by_query.get(qkey, 0) + nbytes
             return True
 
     def reserve(self, nbytes: int, tag: str = "") -> None:
@@ -89,20 +155,28 @@ class MemoryPool:
                 f"{self.max_bytes - self.reserved} free of {self.max_bytes}")
 
     def free(self, nbytes: int, tag: str = "") -> None:
+        qkey = getattr(_SCOPE, "key", None)
         with self._lock:
             self.reserved = max(self.reserved - nbytes, 0)
             self.query_reserved = max(self.query_reserved - nbytes, 0)
             if tag and tag in self._by_tag:
                 self._by_tag[tag] = max(self._by_tag[tag] - nbytes, 0)
+            if qkey is not None and qkey in self._by_query:
+                self._by_query[qkey] = max(self._by_query[qkey] - nbytes, 0)
 
     def free_bytes(self) -> int:
         with self._lock:
             return self.max_bytes - self.reserved
 
+    def by_query(self) -> dict:
+        with self._lock:
+            return dict(self._by_query)
+
     def info(self) -> dict:
         with self._lock:
             return {"max_bytes": self.max_bytes, "reserved": self.reserved,
-                    "by_tag": dict(self._by_tag)}
+                    "by_tag": dict(self._by_tag),
+                    "by_query": dict(self._by_query)}
 
 
 class AggregatedMemoryContext:

@@ -334,6 +334,7 @@ class WorkerServer:
         self._executor_pool: list = [self.local]
         self._all_executors: list = [self.local]
         self._running_frags: dict = {}  # fragment_id -> running task count
+        self._running_queries: dict = {}  # exchange_dir -> running task count
         self._running_tasks = 0
         self._executing = 0  # tasks currently holding an executor
         self.peak_concurrency = 0  # high-water mark of _executing (observable)
@@ -376,6 +377,7 @@ class WorkerServer:
                                                  worker.peak_concurrency,
                                              "mem_reserved": pool.reserved,
                                              "mem_max": pool.max_bytes,
+                                             "mem_by_query": pool.by_query(),
                                              "scheduler":
                                                  worker.scheduler.info()})
                 if "/results/" in self.path and self.path.startswith("/v1/task/"):
@@ -466,6 +468,15 @@ class WorkerServer:
                         return self._reply(403, {"error": "bad signature"})
                     worker.shutdown_gracefully()
                     return self._reply(200, {"state": "shutting_down"})
+                if self.path == "/v1/kill_query":
+                    # cluster low-memory policy chose a victim: poison its
+                    # reservations + preemption points node-wide (reference:
+                    # ClusterMemoryManager -> worker killQuery RPC)
+                    req = self._read_verified()
+                    if req is None:
+                        return self._reply(403, {"error": "bad signature"})
+                    worker.memory_pool.kill_query(req["query_key"])
+                    return self._reply(200, {"killed": req["query_key"]})
                 if self.path.startswith("/v1/task/") \
                         and self.path.endswith("/abandon"):
                     # /v1/task/{tid}/results/{reader}/abandon — a consumer
@@ -618,25 +629,36 @@ class WorkerServer:
             # wedged-task re-dispatch of the same tid must hold its own slot
             token = self.scheduler.new_token(tid)
             ex = self._checkout_executor(query_key=xdir, token=token)
-            tick = (lambda t=token: self.scheduler.tick(t))
+
+            def tick(t=token):
+                # preemption point doubles as the kill checkpoint: a query
+                # the cluster policy poisoned dies here even between
+                # reservations (reference: driver yield + query-killed check)
+                self.memory_pool.check_killed()
+                self.scheduler.tick(t)
+
             try:
                 with self._wlock:
                     self._executing += 1
                     self.peak_concurrency = max(self.peak_concurrency,
                                                 self._executing)
+                    self._running_queries[xdir] = \
+                        self._running_queries.get(xdir, 0) + 1
                 kind = req.get("kind", "partial_agg")
-                if kind == "partial_agg":
-                    data = run_partial_aggregate(ex, node, req["splits"],
-                                                 xdir, sources, fetch,
-                                                 tick=tick)
-                elif kind == "stream_splits":
-                    data = run_stream_splits(
-                        ex, node, xdir, req["splits"], sources, fetch,
-                        sink=buf.add if buf is not None else None, tick=tick)
-                elif kind == "fragment":
-                    data = run_fragment(ex, node, xdir, sources, fetch)
-                else:
-                    raise ValueError(f"unknown task kind {kind!r}")
+                with self.memory_pool.query_scope(xdir):
+                    if kind == "partial_agg":
+                        data = run_partial_aggregate(ex, node, req["splits"],
+                                                     xdir, sources, fetch,
+                                                     tick=tick)
+                    elif kind == "stream_splits":
+                        data = run_stream_splits(
+                            ex, node, xdir, req["splits"], sources, fetch,
+                            sink=buf.add if buf is not None else None,
+                            tick=tick)
+                    elif kind == "fragment":
+                        data = run_fragment(ex, node, xdir, sources, fetch)
+                    else:
+                        raise ValueError(f"unknown task kind {kind!r}")
                 if stream_out:
                     # pipelined output: pages live in the in-memory buffer
                     # behind the long-poll endpoint; nothing touches disk
@@ -664,6 +686,16 @@ class WorkerServer:
                         self._running_frags.pop(frag_id, None)
                     else:
                         self._running_frags[frag_id] = n
+                    nq = self._running_queries.get(xdir, 1) - 1
+                    if nq <= 0:
+                        self._running_queries.pop(xdir, None)
+                        # last task of the query on this node: drop its
+                        # attribution + poison entries (compiled-state caches
+                        # may still hold device memory; they free through
+                        # forget_plan eviction, tracked under op tags)
+                        self.memory_pool.clear_query(xdir)
+                    else:
+                        self._running_queries[xdir] = nq
                 self._release_executor(ex, token=token)
 
         threading.Thread(target=run, daemon=True).start()
@@ -712,6 +744,8 @@ class _WorkerInfo:
     draining: bool = False  # graceful shutdown: reachable but not schedulable
     mem_reserved: int = 0  # last announced pool reservation (bytes)
     mem_max: int = 0  # last announced pool capacity (bytes)
+    mem_by_query: dict = dataclasses.field(default_factory=dict)  # per-query
+    # attribution from the worker pool (feeds the low-memory kill policy)
 
 
 class ClusterCoordinator:
@@ -725,7 +759,8 @@ class ClusterCoordinator:
                  splits_per_task: int = 2, task_timeout: float = 120.0,
                  secret: Optional[str] = None,
                  speculative_factor: float = 3.0,
-                 stream_exchange: bool = True):
+                 stream_exchange: bool = True,
+                 low_memory_killer=None):
         # stream_exchange: nested fragments ship their output through
         # in-memory worker buffers (long-poll + token ack) instead of the
         # spool — the reference's default PIPELINED data plane.  Single-task
@@ -747,6 +782,19 @@ class ClusterCoordinator:
         self.broadcast_streams = 0  # observability: fan-out producers launched
         self.local_fallbacks = 0  # observability: queries degraded to local
         self.last_fallback_error: Optional[str] = None  # why (traceback)
+        # cluster low-memory kill policy (reference:
+        # ClusterMemoryManager.java:92 + LowMemoryKiller): consulted from the
+        # heartbeat loop once a node has sat blocked for two consecutive
+        # passes (debounce — transient spikes resolve via Grace fallbacks)
+        from ..execution.memory_killer import \
+            TotalReservationOnBlockedNodesKiller
+
+        self.low_memory_killer = low_memory_killer \
+            if low_memory_killer is not None \
+            else TotalReservationOnBlockedNodesKiller()
+        self._blocked_streak = 0
+        self.oom_kills = 0  # observability: victims chosen
+        self.last_oom_victim: Optional[str] = None
         self.engine = engine
         self.spool_dir = spool_dir
         self.secret = secret if secret is not None \
@@ -896,7 +944,9 @@ class ClusterCoordinator:
 
     def _heartbeat_loop(self):
         """HeartbeatFailureDetector (simplified): probe /v1/info; max_misses
-        consecutive failures gates the worker out of scheduling."""
+        consecutive failures gates the worker out of scheduling.  The same
+        pass feeds the cluster memory view and, after a debounced blocked
+        streak, the low-memory kill policy."""
         while not self._stop.is_set():
             with self._lock:
                 snapshot = list(self.workers.values())
@@ -909,12 +959,49 @@ class ClusterCoordinator:
                         if "mem_reserved" in info:
                             w.mem_reserved = int(info["mem_reserved"])
                             w.mem_max = int(info.get("mem_max", 0))
+                        w.mem_by_query = info.get("mem_by_query") or {}
                 except Exception:
                     with self._lock:
                         w.misses += 1
                         if w.misses >= self.max_misses:
                             w.alive = False
+            self._run_memory_killer()
             self._stop.wait(self.heartbeat_interval)
+
+    def _run_memory_killer(self) -> None:
+        """One ClusterMemoryManager pass: blocked nodes for two consecutive
+        heartbeats -> ask the policy for a victim -> poison it on every live
+        worker (reference: ClusterMemoryManager.java:92 callOomKiller)."""
+        from ..execution.memory_killer import BLOCKED_FRACTION
+
+        with self._lock:
+            nodes = [{"node_id": w.node_id, "url": w.url,
+                      "mem_reserved": w.mem_reserved, "mem_max": w.mem_max,
+                      "mem_by_query": w.mem_by_query}
+                     for w in self.workers.values() if w.alive]
+        blocked = [n for n in nodes
+                   if n["mem_max"]
+                   and n["mem_reserved"] > BLOCKED_FRACTION * n["mem_max"]]
+        if not blocked:
+            self._blocked_streak = 0
+            return
+        self._blocked_streak += 1
+        if self._blocked_streak < 2:  # debounce: give Grace fallbacks a beat
+            return
+        victim = self.low_memory_killer.pick_victim(nodes)
+        if victim is None:
+            return
+        self._blocked_streak = 0
+        with self._lock:
+            self.oom_kills += 1
+            self.last_oom_victim = victim
+        for n in nodes:
+            try:
+                _http(f"{n['url']}/v1/kill_query",
+                      pickle.dumps({"query_key": victim}),
+                      secret=self.secret)
+            except Exception:
+                pass  # a dead worker frees its memory with its process
 
     def live_workers(self) -> list:
         """Schedulable workers: alive and not draining (a gracefully
@@ -971,7 +1058,14 @@ class ClusterCoordinator:
                 try:
                     self._exec_fragments(plan, exchange, exchange_dir, spooled,
                                          nested=False)
-                except Exception:
+                except Exception as exc:
+                    if "QueryKilledError" in str(exc):
+                        # the cluster low-memory policy killed THIS query:
+                        # rerunning it locally would defeat the kill (and
+                        # likely OOM the coordinator too) — surface it
+                        from ..memory import QueryKilledError
+
+                        raise QueryKilledError(str(exc)) from exc
                     # a fragment the workers cannot run (unsupported shape,
                     # exhausted retries, cluster-wide death) must not fail a
                     # query the local executor can answer — degrade to local;

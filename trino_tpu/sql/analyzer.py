@@ -394,7 +394,7 @@ class ExpressionAnalyzer:
             heap = np.arange(lo, hi + (1 if step > 0 else -1), step, dtype=np.int64)
             return (ir.Constant(pack_span(0, len(heap)), ArrayType.of(BIGINT)),
                     ArrayData(heap, BIGINT, max_len=len(heap)))
-        if name == "map":
+        if name in ("map", "map_from_arrays"):
             (ke, kd) = self._translate(args[0], cols)
             (ve, vd) = self._translate(args[1], cols)
             if not (isinstance(ke, ir.Constant) and isinstance(ve, ir.Constant)
@@ -423,6 +423,52 @@ class ExpressionAnalyzer:
             raise SemanticError(
                 "row(...) values must be field-accessed (row(...)[n]); "
                 "standalone row channels flatten at plan time")
+        if name == "reduce":
+            # reduce(array, init, (s, x) -> combiner[, s -> finalizer])
+            # (reference: operator/scalar/ArrayReduceFunction).  TPU design:
+            # the element heap is a plan-time constant but SPANS are runtime,
+            # so the fold runs as an UNROLLED masked loop of max_len steps —
+            # state is vectorized across rows, each step gathers element i of
+            # every row's span and applies the combiner where i < length
+            # (static trip count, fully jittable; no data-dependent control
+            # flow reaches XLA).
+            base, bd = self._translate(args[0], cols)
+            if not isinstance(base.type, ArrayType) or bd is None:
+                raise SemanticError("reduce expects an array")
+            if bd.max_len > 1024:
+                raise SemanticError(
+                    f"reduce over arrays longer than 1024 elements "
+                    f"(max_len={bd.max_len}) is not supported")
+            if bd.elem_dict is not None:
+                raise SemanticError("reduce over string arrays not supported")
+            init, _ = self._translate(args[1], cols)
+            lam = args[2] if len(args) > 2 else None
+            if not isinstance(lam, A.Lambda) or len(lam.params) != 2:
+                raise SemanticError("reduce expects a two-parameter lambda")
+            state_col = ColumnInfo(None, lam.params[0], init.type, None)
+            elem_col = ColumnInfo(None, lam.params[1], bd.elem_type, None)
+            body, _ = self._translate(lam.body, [state_col, elem_col])
+            init = _coerce(init, body.type)
+            out = ir.Call(
+                "span_reduce_lambda",
+                (base, init,
+                 ir.Constant(np.asarray(bd.values), UNKNOWN)),
+                body.type, meta=(max(bd.max_len, 1), body))
+            fin = args[3] if len(args) > 3 else None
+            if fin is not None:
+                if not isinstance(fin, A.Lambda) or len(fin.params) != 1:
+                    raise SemanticError(
+                        "reduce finalizer must be a one-parameter lambda")
+                fcol = ColumnInfo(None, fin.params[0], body.type, None)
+                fbody, _ = self._translate(fin.body, [fcol])
+                from .rules import _substitute_refs
+
+                out2 = _substitute_refs(fbody, (out,))
+                if out2 is None:
+                    raise SemanticError(
+                        "reduce finalizer expression not supported")
+                out = out2
+            return out, None
         if name in ("transform", "filter", "any_match", "all_match",
                     "none_match"):
             # higher-order array lambdas (reference:
@@ -760,11 +806,11 @@ class ExpressionAnalyzer:
 
 
     _COLLECTION_FUNCS = ("cardinality", "element_at", "contains", "sequence",
-                         "map", "map_keys", "map_values", "row",
-                         "array_min", "array_max", "array_sum",
+                         "map", "map_from_arrays", "map_keys", "map_values",
+                         "row", "array_min", "array_max", "array_sum",
                          "array_average", "array_position",
                          "transform", "filter", "any_match", "all_match",
-                         "none_match")
+                         "none_match", "reduce")
 
     def _translate_func(self, ast: A.FuncCall, cols):
         """Registry dispatch (reference: the analyzer resolving calls against

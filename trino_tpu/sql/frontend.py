@@ -2206,18 +2206,159 @@ class _PostAggScope:
             for a in args[1:]:
                 t = common_super_type(t, a.type)
             return ir.Call("coalesce", tuple(_coerce(a, t) for a in args), t)
+        if isinstance(ast, A.FuncCall) and ast.name == "nullif" \
+                and len(ast.args) == 2:
+            # the statistical-aggregate finalizers divide by nullif(n, 0)
+            a = self.translate(ast.args[0])
+            b = self.translate(ast.args[1])
+            t = common_super_type(a.type, b.type)
+            return ir.Call("nullif", (_coerce(a, t), _coerce(b, t)), t)
         raise SemanticError(f"expression must appear in GROUP BY: {ast}")
 
 
-_AGG_SUGAR = {"count_if", "geometric_mean"}
+_STATS2_AGGS = {"covar_pop", "covar_samp", "corr", "regr_slope",
+                "regr_intercept", "regr_count", "regr_avgx", "regr_avgy",
+                "regr_sxx", "regr_syy", "regr_sxy", "regr_r2"}
+_AGG_SUGAR = {"count_if", "geometric_mean", "skewness", "kurtosis"} \
+    | _STATS2_AGGS
+
+
+def _stats2_rewrite(name: str, y: A.Node, x: A.Node) -> A.Node:
+    """Two-argument statistical aggregates decomposed into MOMENT SUMS over
+    pairwise-non-null rows + a finalize expression (reference:
+    operator/aggregation/ CovarianceAggregation / RegressionAggregation /
+    CorrelationAggregation keep the same running moments in their state; on
+    TPU the moments are plain sum/count aggregates the scan-fused partial
+    machinery already distributes, and the finalize is a scalar expression).
+
+    Signature order matches the reference: f(y, x) — y dependent, x
+    independent (AggregationUtils.java's y/x naming)."""
+    pair = A.BinaryOp("and", A.IsNull(y, True), A.IsNull(x, True))
+
+    def when(v):
+        return A.CaseExpr(None, ((pair, v),), None)
+
+    def dbl(e):
+        return A.Cast(e, "double")
+
+    xd, yd = dbl(x), dbl(y)
+    n = A.Cast(A.FuncCall("count", (when(A.NumberLit("1")),)), "double")
+    sx = A.FuncCall("sum", (when(xd),))
+    sy = A.FuncCall("sum", (when(yd),))
+    sxy = A.FuncCall("sum", (when(A.BinaryOp("multiply", xd, yd)),))
+    sxx = A.FuncCall("sum", (when(A.BinaryOp("multiply", xd, xd)),))
+    syy = A.FuncCall("sum", (when(A.BinaryOp("multiply", yd, yd)),))
+
+    def sub(a, b):
+        return A.BinaryOp("subtract", a, b)
+
+    def mul(a, b):
+        return A.BinaryOp("multiply", a, b)
+
+    def div(a, b):
+        # NULL on a zero denominator (SQL contract: undefined moments = NULL)
+        return A.BinaryOp("divide", a, A.FuncCall("nullif", (b, A.NumberLit("0"))))
+
+    c_sxy = sub(sxy, div(mul(sx, sy), n))  # n*cov_pop
+    c_sxx = sub(sxx, div(mul(sx, sx), n))  # n*var_pop(x)
+    c_syy = sub(syy, div(mul(sy, sy), n))  # n*var_pop(y)
+    if name == "regr_count":
+        return A.FuncCall("count", (when(A.NumberLit("1")),))
+    if name == "regr_avgx":
+        return div(sx, n)
+    if name == "regr_avgy":
+        return div(sy, n)
+    if name == "regr_sxx":
+        return c_sxx
+    if name == "regr_syy":
+        return c_syy
+    if name == "regr_sxy":
+        return c_sxy
+    if name == "covar_pop":
+        return div(c_sxy, n)
+    if name == "covar_samp":
+        return div(c_sxy, sub(n, A.NumberLit("1")))
+    if name == "regr_slope":
+        return div(c_sxy, c_sxx)
+    if name == "regr_intercept":
+        return div(sub(sy, mul(div(c_sxy, c_sxx), sx)), n)
+    if name == "corr":
+        return div(c_sxy, A.FuncCall("sqrt", (mul(c_sxx, c_syy),)))
+    if name == "regr_r2":
+        # r² = corr², except a CONSTANT dependent variable (var(y)=0 with
+        # var(x)>0) is a perfect fit: 1.0 (SQL contract); var(x)=0 stays NULL
+        # through the nullif-guarded division
+        r = div(c_sxy, A.FuncCall("sqrt", (mul(c_sxx, c_syy),)))
+        # "var(y)=0" must tolerate catastrophic cancellation in syy - sy²/n:
+        # compare against the raw second moment's scale, not exact zero
+        const_y = A.BinaryOp(
+            "and",
+            A.BinaryOp("lte", c_syy, mul(A.NumberLit("1e-12"), syy)),
+            A.BinaryOp("gt", c_sxx, mul(A.NumberLit("1e-12"), sxx)))
+        return A.CaseExpr(None, ((const_y, A.NumberLit("1.0")),), mul(r, r))
+    raise SemanticError(f"unknown statistical aggregate {name}")
+
+
+def _moments_rewrite(name: str, x: A.Node) -> A.Node:
+    """skewness/kurtosis from raw moments (reference:
+    operator/aggregation/CentralMomentsAggregation — same moments, here as
+    plain distributable sums + a finalize expression)."""
+    xd = A.Cast(x, "double")
+    n = A.Cast(A.FuncCall("count", (x,)), "double")
+    s1 = A.FuncCall("sum", (xd,))
+    s2 = A.FuncCall("sum", (A.BinaryOp("multiply", xd, xd),))
+    s3 = A.FuncCall("sum", (A.BinaryOp("multiply", A.BinaryOp("multiply", xd, xd), xd),))
+
+    def div(a, b):
+        return A.BinaryOp("divide", a, A.FuncCall("nullif", (b, A.NumberLit("0"))))
+
+    mean = div(s1, n)
+    m2 = A.BinaryOp("subtract", div(s2, n), A.BinaryOp("multiply", mean, mean))  # var_pop
+    if name == "skewness":
+        # E[x³] - 3·mean·E[x²] + 2·mean³, normalized by var_pop^{3/2}
+        ex3 = div(s3, n)
+        ex2 = div(s2, n)
+        m3 = A.BinaryOp(
+            "subtract",
+            A.BinaryOp("add", ex3,
+                       A.BinaryOp("multiply", A.NumberLit("2.0"),
+                                  A.BinaryOp("multiply", mean, A.BinaryOp(
+                                      "multiply", mean, mean)))),
+            A.BinaryOp("multiply", A.NumberLit("3.0"), A.BinaryOp("multiply", mean, ex2)))
+        return div(m3, A.FuncCall(
+            "power", (m2, A.NumberLit("1.5"))))
+    if name == "kurtosis":
+        x2 = A.BinaryOp("multiply", xd, xd)
+        s4 = A.FuncCall("sum", (A.BinaryOp("multiply", x2, x2),))
+        ex4, ex3, ex2 = div(s4, n), div(s3, n), div(s2, n)
+        m4 = A.BinaryOp(
+            "subtract",
+            A.BinaryOp(
+                "add", ex4,
+                A.BinaryOp(
+                    "subtract",
+                    A.BinaryOp("multiply", A.NumberLit("6.0"),
+                               A.BinaryOp("multiply", A.BinaryOp("multiply", mean, mean),
+                                          ex2)),
+                    A.BinaryOp("multiply", A.NumberLit("3.0"),
+                               A.BinaryOp("multiply", A.BinaryOp("multiply", mean, mean),
+                                          A.BinaryOp("multiply", mean, mean))))),
+            A.BinaryOp("multiply", A.NumberLit("4.0"), A.BinaryOp("multiply", mean, ex3)))
+        # excess-kurtosis-free definition (the reference's kurtosis):
+        # n*m4/m2² - 3 with the sample correction folded by the caller; we
+        # return the population kurtosis m4/m2² (documented deviation)
+        return div(m4, A.BinaryOp("multiply", m2, m2))
+    raise SemanticError(f"unknown moment aggregate {name}")
 
 
 def _rewrite_agg_sugar(node):
     """Aggregate sugar rewrites to supported compositions (reference:
-    operator/aggregation/CountIfAggregation, GeometricMeanAggregations —
-    both reduce to existing aggregates):
+    operator/aggregation/CountIfAggregation, GeometricMeanAggregations,
+    CovarianceAggregation family — all reduce to existing aggregates):
       count_if(x)       -> sum(CASE WHEN x THEN 1 ELSE 0 END)
       geometric_mean(x) -> exp(avg(ln(x)))
+      covar_/regr_/corr -> moment sums + finalize (_stats2_rewrite)
+      skewness/kurtosis -> raw moments + finalize (_moments_rewrite)
     Deterministic over frozen ASTs, so repeated rewrites of equal expressions
     stay structurally equal (the post-aggregation scope matches by equality)."""
     if isinstance(node, A.FuncCall) and node.name in _AGG_SUGAR:
@@ -2231,6 +2372,10 @@ def _rewrite_agg_sugar(node):
         if node.name == "geometric_mean" and len(args) == 1:
             return A.FuncCall("exp", (A.FuncCall(
                 "avg", (A.FuncCall("ln", (args[0],)),)),))
+        if node.name in _STATS2_AGGS and len(args) == 2:
+            return _stats2_rewrite(node.name, args[0], args[1])
+        if node.name in ("skewness", "kurtosis") and len(args) == 1:
+            return _moments_rewrite(node.name, args[0])
         return dataclasses.replace(node, args=args)
     if dataclasses.is_dataclass(node) and not isinstance(node, type):
         changes = {}

@@ -686,6 +686,8 @@ def ensure_legacy_registered() -> None:
                 register(n, category, desc)
 
     meta(F.AGG_FUNCS, "aggregate", "Aggregate function")
+    meta(F._AGG_SUGAR, "aggregate",
+         "Aggregate function (rewritten to distributable moment sums)")
     meta(F.Planner.WINDOW_FUNCS, "window", "Window function")
     meta(F.Planner._COLLECTION_FUNCS, "collection", "Array/map/row function")
     meta(("cast", "try_cast", "extract"), "scalar",

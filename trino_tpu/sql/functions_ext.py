@@ -446,3 +446,335 @@ def register_extended_families() -> None:
 
 
 register_extended_families()
+
+
+# --------------------------------------------------------------- date formats
+# date_format (MySQL patterns, DateTimeFunctions.dateFormat) and
+# format_datetime (Joda patterns, DateTimeFunctions.formatDatetime) produce
+# STRINGS from date-domain values.  TPU design: runtime string construction is
+# impossible on device (strings are dictionary ids), but a date-granularity
+# pattern's codomain is small — one entry per civil day/month/year in the
+# supported range — so the whole output dictionary is built at plan time and
+# the device gathers day_index -> unique-string-id (the LUT design, applied to
+# a numeric domain instead of an input dictionary).  Time-of-day components
+# raise SemanticError (unbounded codomain); the supported day range is
+# 1900-01-01..2199-12-31.
+
+import datetime as _dt
+
+_DAY_LO = (_dt.date(1900, 1, 1) - _dt.date(1970, 1, 1)).days
+_DAY_HI = (_dt.date(2199, 12, 31) - _dt.date(1970, 1, 1)).days
+
+_MYSQL_TIME = ("%H", "%h", "%I", "%i", "%s", "%S", "%T", "%r", "%p", "%f")
+_JODA_TIME = ("H", "h", "K", "k", "m", "s", "S", "a", "A")
+
+
+def _mysql_formatter(fmt: str):
+    """MySQL date pattern -> python fn(date) -> str."""
+    F = _rt()
+    for tok in _MYSQL_TIME:
+        if tok in fmt:
+            raise F.SemanticError(
+                f"date_format: time-of-day component {tok!r} not supported "
+                "(date granularity only)")
+
+    def render(d: _dt.date, fmt=fmt) -> str:
+        out, i = [], 0
+        while i < len(fmt):
+            c = fmt[i]
+            if c == "%" and i + 1 < len(fmt):
+                t = fmt[i + 1]
+                i += 2
+                if t == "Y":
+                    out.append(f"{d.year:04d}")
+                elif t == "y":
+                    out.append(f"{d.year % 100:02d}")
+                elif t == "m":
+                    out.append(f"{d.month:02d}")
+                elif t == "c":
+                    out.append(str(d.month))
+                elif t == "d":
+                    out.append(f"{d.day:02d}")
+                elif t == "e":
+                    out.append(str(d.day))
+                elif t == "j":
+                    out.append(f"{d.timetuple().tm_yday:03d}")
+                elif t == "a":
+                    out.append(d.strftime("%a"))
+                elif t == "W":
+                    out.append(d.strftime("%A"))
+                elif t == "b":
+                    out.append(d.strftime("%b"))
+                elif t == "M":
+                    out.append(d.strftime("%B"))
+                elif t == "%":
+                    out.append("%")
+                else:
+                    raise _rt().SemanticError(
+                        f"date_format: pattern %{t} not supported")
+            else:
+                out.append(c)
+                i += 1
+        return "".join(out)
+
+    render(_dt.date(2000, 1, 31))  # validate the pattern eagerly
+    return render
+
+
+def _joda_token(letter: str, n: int):
+    """One Joda token = a RUN of the same pattern letter; the run length
+    selects the representation (Joda DateTimeFormat contract: text fields
+    switch short/full at 4, numbers zero-pad to the run length)."""
+    if letter == "y":
+        if n == 2:
+            return lambda d: f"{d.year % 100:02d}"
+        return lambda d, n=max(n, 1): f"{d.year:0{n}d}"
+    if letter == "M":
+        if n >= 4:
+            return lambda d: d.strftime("%B")
+        if n == 3:
+            return lambda d: d.strftime("%b")
+        return lambda d, n=n: f"{d.month:0{n}d}"
+    if letter == "d":
+        return lambda d, n=n: f"{d.day:0{n}d}"
+    if letter == "E":
+        if n >= 4:
+            return lambda d: d.strftime("%A")
+        return lambda d: d.strftime("%a")
+    if letter == "D":
+        return lambda d, n=n: f"{d.timetuple().tm_yday:0{n}d}"
+    return None
+
+
+def _joda_formatter(fmt: str):
+    """Joda date pattern -> python fn(date) -> str (format_datetime)."""
+    F = _rt()
+    parts, i = [], 0
+    while i < len(fmt):
+        if fmt[i] == "'":  # quoted literal ('T' etc.; '' = literal quote)
+            j = fmt.find("'", i + 1)
+            if j == i + 1:
+                parts.append(("lit", "'"))
+                i += 2
+                continue
+            if j < 0:
+                raise F.SemanticError("format_datetime: unterminated quote")
+            parts.append(("lit", fmt[i + 1:j]))
+            i = j + 1
+            continue
+        c = fmt[i]
+        if c.isalpha():
+            n = 1
+            while i + n < len(fmt) and fmt[i + n] == c:
+                n += 1
+            fn = _joda_token(c, n)
+            if fn is None:
+                raise F.SemanticError(
+                    f"format_datetime: pattern component {c!r} not supported "
+                    "(date granularity only)")
+            parts.append(("fn", fn))
+            i += n
+        else:
+            parts.append(("lit", c))
+            i += 1
+
+    def render(d: _dt.date, parts=tuple(parts)) -> str:
+        return "".join(p if kind == "lit" else p(d) for kind, p in parts)
+
+    return render
+
+
+_DAY_TABLE_CACHE: dict = {}  # (func, fmt) -> (day->uid int64, unique strings)
+# rendering 110k day strings costs hundreds of ms of plan latency; one table
+# per distinct pattern per process amortizes it across queries
+
+
+def _build_date_format(planner, ast, cols):
+    """date_format/format_datetime: day-table dictionary + LUT gather."""
+    from ..connectors.tpch import Dictionary
+    from .functions import ts_to_date_expr
+
+    F = _rt()
+    v, _d = planner._translate(ast.args[0], cols)
+    fmt = planner._literal_str(ast.args[1], ast.name)
+    day = ts_to_date_expr(v)
+    if day.type.name != "date":
+        raise F.SemanticError(f"{ast.name} expects a date or timestamp")
+    key = (ast.name, fmt)
+    hit = _DAY_TABLE_CACHE.get(key)
+    if hit is None:
+        render = _mysql_formatter(fmt) if ast.name == "date_format" \
+            else _joda_formatter(fmt)
+        epoch = _dt.date(1970, 1, 1)
+        strings = np.array([render(epoch + _dt.timedelta(days=int(i)))
+                            for i in range(_DAY_LO, _DAY_HI + 1)], dtype=object)
+        uniq, inv = np.unique(strings.astype(str), return_inverse=True)
+        hit = _DAY_TABLE_CACHE[key] = (inv.astype(np.int64),
+                                       uniq.astype(object))
+        while len(_DAY_TABLE_CACHE) > 64:  # bound the per-process cache
+            _DAY_TABLE_CACHE.pop(next(iter(_DAY_TABLE_CACHE)))
+    inv, uniq = hit
+    # day -> unique-string id (dictionary values must be UNIQUE: duplicate
+    # values would break literal-comparison id lookup)
+    day64 = F._coerce(day, BIGINT)
+    day_ix = ir.Call("subtract", (day64, ir.Constant(_DAY_LO, BIGINT)),
+                     BIGINT)
+    t = VarcharType.of(None)
+    expr = ir.Call("lut", (day_ix, ir.Constant(inv, t)), t)
+    # out-of-range days must surface as NULL, not the clamped boundary string
+    oob = ir.Call("or", (
+        ir.Call("lt", (day64, ir.Constant(_DAY_LO, BIGINT)), BOOLEAN),
+        ir.Call("gt", (day64, ir.Constant(_DAY_HI, BIGINT)), BOOLEAN)),
+        BOOLEAN)
+    expr = ir.Call("null_if_flag", (expr, oob), t)
+    return expr, Dictionary(values=uniq)
+
+
+def _build_date_parse_mysql(planner, ast, cols):
+    """date_parse(varchar, mysql_fmt) -> timestamp(3): the input is a
+    dictionary column, so parsing runs once per DISTINCT value at plan time
+    (lut_nullable; unparsable values yield NULL — documented deviation from
+    the reference's error, matching TRY semantics)."""
+    from ..types import TimestampType
+
+    F = _rt()
+    v, d = planner._require_dict(ast.args[0], cols, ast.name)
+    fmt = planner._literal_str(ast.args[1], ast.name)
+    # MySQL -> strptime TOKEN translation (a blind replace left %M = month
+    # name aliased to strptime minutes — silent all-NULL columns)
+    mysql_map = {"Y": "%Y", "y": "%y", "m": "%m", "c": "%m", "d": "%d",
+                 "e": "%d", "j": "%j", "M": "%B", "b": "%b", "a": "%a",
+                 "W": "%A", "H": "%H", "h": "%I", "I": "%I", "i": "%M",
+                 "s": "%S", "S": "%S", "T": "%H:%M:%S", "r": "%I:%M:%S %p",
+                 "p": "%p", "%": "%%"}
+    out, i = [], 0
+    while i < len(fmt):
+        if fmt[i] == "%" and i + 1 < len(fmt):
+            tok = fmt[i + 1]
+            if tok not in mysql_map:
+                raise F.SemanticError(
+                    f"date_parse: pattern %{tok} not supported")
+            out.append(mysql_map[tok])
+            i += 2
+        else:
+            out.append(fmt[i].replace("%", "%%"))
+            i += 1
+    strp = "".join(out)
+
+    def parse(s: str):
+        try:
+            dt = _dt.datetime.strptime(str(s).strip(), strp)
+        except ValueError:
+            return None
+        return int((dt - _dt.datetime(1970, 1, 1)).total_seconds() * 1000)
+
+    vals, nulls = [], []
+    for s in d.values:
+        p = parse(s)
+        nulls.append(p is None)
+        vals.append(0 if p is None else p)
+    t = TimestampType.of(3)
+    return ir.Call("lut_nullable",
+                   (v, ir.Constant(np.array(vals, np.int64), t),
+                    ir.Constant(np.array(nulls, bool), BOOLEAN)), t), None
+
+
+def register_datetime_format_family() -> None:
+    register("date_format", "scalar",
+             "Format a date/timestamp with a MySQL pattern (day-table LUT)",
+             (2, 2), _build_date_format)
+    register("format_datetime", "scalar",
+             "Format a date/timestamp with a Joda pattern (day-table LUT)",
+             (2, 2), _build_date_format)
+    register("date_parse", "scalar",
+             "Parse a varchar with a MySQL pattern to timestamp(3)",
+             (2, 2), _build_date_parse_mysql)
+
+
+register_datetime_format_family()
+
+
+# ------------------------------------------------------------ unixtime + hash
+def _build_from_unixtime(planner, ast, cols):
+    """from_unixtime(double_seconds) -> timestamp(3) (DateTimeFunctions.fromUnixTime)."""
+    from ..types import TimestampType
+
+    F = _rt()
+    v, _ = planner._translate(ast.args[0], cols)
+    t = TimestampType.of(3)
+    ms = ir.Call("multiply", (F._coerce(v, DOUBLE),
+                              ir.Constant(1000.0, DOUBLE)), DOUBLE)
+    return ir.Call("as_timestamp", (ms,), t), None
+
+
+def _build_to_unixtime(planner, ast, cols):
+    """to_unixtime(timestamp) -> double seconds (DateTimeFunctions.toUnixTime)."""
+    from ..types import TimestampType
+
+    F = _rt()
+    v, _ = planner._translate(ast.args[0], cols)
+    if not isinstance(v.type, TimestampType):
+        raise F.SemanticError("to_unixtime expects a timestamp")
+    scale = float(10 ** v.type.precision)
+    return ir.Call("divide", (F._coerce(v, DOUBLE),
+                              ir.Constant(scale, DOUBLE)), DOUBLE), None
+
+
+def _build_cot(planner, ast, cols):
+    F = _rt()
+    v, _ = planner._translate(ast.args[0], cols)
+    v = F._coerce(v, DOUBLE)
+    return ir.Call("divide", (ir.Call("cos", (v,), DOUBLE),
+                              ir.Call("sin", (v,), DOUBLE)), DOUBLE), None
+
+
+def _dict_string_fn(name, fn):
+    """Builder factory: a pure python string->string transform applied once
+    per DISTINCT value (the dictionary-LUT design every string function uses)."""
+
+    def build(planner, ast, cols, fn=fn, name=name):
+        v, d = planner._require_dict(ast.args[0], cols, name)
+        lut, nd = d.map_values(fn)
+        return ir.Call("lut", (v, ir.Constant(lut, v.type)), v.type), nd
+
+    return build
+
+
+def _hex_digest(algo):
+    import hashlib
+
+    def fn(s, algo=algo):
+        h = hashlib.new(algo)
+        h.update(str(s).encode())
+        return h.hexdigest()
+
+    return fn
+
+
+def register_unixtime_hash_family() -> None:
+    register("from_unixtime", "scalar",
+             "Epoch seconds to timestamp(3)", (1, 1), _build_from_unixtime)
+    register("to_unixtime", "scalar",
+             "Timestamp to epoch seconds (double)", (1, 1),
+             _build_to_unixtime)
+    register("cot", "scalar", "Cotangent", (1, 1), _build_cot)
+    import unicodedata
+
+    register("normalize", "scalar",
+             "Unicode NFC normalization (dictionary LUT)", (1, 1),
+             _dict_string_fn("normalize",
+                             lambda s: unicodedata.normalize("NFC", str(s))))
+    register("to_hex", "scalar", "UTF-8 bytes to hex (dictionary LUT)",
+             (1, 1), _dict_string_fn("to_hex",
+                                     lambda s: str(s).encode().hex().upper()))
+    register("from_hex", "scalar", "Hex to UTF-8 string (dictionary LUT)",
+             (1, 1), _dict_string_fn(
+                 "from_hex",
+                 lambda s: bytes.fromhex(str(s)).decode("utf-8", "replace")))
+    register("md5", "scalar", "MD5 hex digest (dictionary LUT)", (1, 1),
+             _dict_string_fn("md5", _hex_digest("md5")))
+    register("sha256", "scalar", "SHA-256 hex digest (dictionary LUT)",
+             (1, 1), _dict_string_fn("sha256", _hex_digest("sha256")))
+
+
+register_unixtime_hash_family()
