@@ -533,8 +533,14 @@ def _is_memory_failure(e: BaseException) -> bool:
     """Device/host memory exhaustion (reference: the retry classification
     feeding ExponentialGrowthPartitionMemoryEstimator.java:57 — memory
     failures retry at a different memory footprint, not just again)."""
-    from ..memory import MemoryPoolExhaustedError
+    from ..memory import (MemoryPoolExhaustedError, QueryKilledError,
+                          QueryMemoryLimitError)
 
+    if isinstance(e, (QueryKilledError, QueryMemoryLimitError)):
+        # a policy kill / query limit is NOT shrinkable: bisecting the split
+        # set would re-raise at the first reservation of every leaf while the
+        # victim keeps pinning the blocked node
+        return False
     if isinstance(e, (MemoryError, MemoryPoolExhaustedError)):
         return True
     return type(e).__name__ == "XlaRuntimeError" \

@@ -155,6 +155,12 @@ class MemoryPool:
                 f"{self.max_bytes - self.reserved} free of {self.max_bytes}")
 
     def free(self, nbytes: int, tag: str = "") -> None:
+        # NOTE per-query attribution is POLL-GRADE approximate (the reference's
+        # cluster view is too): frees attribute to the freeing THREAD's scope.
+        # Out-of-scope frees (plan-cache eviction from coordinator threads)
+        # leave the entry inflated until clear_query at the query's last task
+        # exit; in-scope frees of another query's bytes clamp at zero.  Exact
+        # attribution would need reservation handles at every call site.
         qkey = getattr(_SCOPE, "key", None)
         with self._lock:
             self.reserved = max(self.reserved - nbytes, 0)

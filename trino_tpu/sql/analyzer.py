@@ -539,6 +539,75 @@ class ExpressionAnalyzer:
         return (body_ir, np.asarray(vals),
                 None if nulls is None else np.asarray(nulls))
 
+    def _translate_in_subquery_eager(self, ast, cols):
+        """IN (subquery) OUTSIDE the top-level conjunct position — under OR,
+        NOT, or CASE — where the semi-join rewrite cannot apply.  The
+        reference plans these as MARK semi-joins producing a boolean channel
+        (planner/TransformUncorrelatedInPredicateSubqueryToSemiJoin's
+        mark variant); for an UNCORRELATED subquery, eager evaluation into a
+        sorted membership table is equivalent and the device does one
+        searchsorted probe (ir op "in_array").  Correlated subqueries raise
+        from plan_query (unresolved columns).  Documented deviation: a NULL
+        in the subquery's result makes non-member rows UNKNOWN in SQL; in
+        WHERE position both filter identically, and the negated form with
+        NULLs raises rather than return wrong rows."""
+        if not hasattr(self, "plan_query"):
+            raise SemanticError(
+                "IN (subquery) is not supported in this expression context")
+        v, vd = self._translate(ast.value, cols)
+        plan = self.plan_query(ast.query)
+        res = self.engine.execute_plan(plan, cache=False)
+        if len(res.columns) != 1:
+            raise SemanticError("IN subquery must return exactly one column")
+        raw = [r[0] for r in res.rows()]
+        has_null = any(x is None for x in raw)
+        if has_null and ast.negated:
+            raise SemanticError(
+                "NOT IN (subquery) with NULLs in the subquery result is not "
+                "supported in this expression context (3VL would reject "
+                "every row)")
+        vals = [x for x in raw if x is not None]
+        sub_t = res.types[0]
+        from ..types import DecimalType, TimestampType
+        if sub_t.is_string:
+            # result-surface values are DECODED strings; the probe lane holds
+            # the OUTER dictionary's ids — map through vd.lookup
+            if vd is None:
+                raise SemanticError(
+                    "string IN-subquery over a non-dictionary expression")
+            ids = [vd.lookup(x) for x in vals]
+            table = np.unique(np.array([i for i in ids if i >= 0], np.int64))
+        elif sub_t.name == "date" or isinstance(sub_t, TimestampType):
+            # result surface decodes DATE/TIMESTAMP to datetime64 (CLAUDE.md);
+            # convert back to the probe lane's raw epoch domain
+            if isinstance(v.type, TimestampType):
+                unit = {0: "s", 3: "ms", 6: "us", 9: "ns"}.get(
+                    v.type.precision)
+                if unit is None:
+                    raise SemanticError(
+                        f"IN-subquery over timestamp({v.type.precision}) "
+                        "not supported in this context")
+                table = np.unique(np.asarray(
+                    vals, dtype=f"datetime64[{unit}]").astype(np.int64))
+            elif v.type.name == "date":
+                table = np.unique(np.asarray(
+                    vals, dtype="datetime64[D]").astype(np.int64))
+            else:
+                raise SemanticError(
+                    "IN-subquery type mismatch (date vs non-date)")
+        elif isinstance(sub_t, DecimalType) or isinstance(v.type, DecimalType) \
+                or sub_t.is_floating or v.type.is_floating:
+            # decimals decode to floats at the result surface while the lane
+            # holds SCALED ints: compare both sides in the double domain
+            table = np.unique(np.asarray([float(x) for x in vals], np.float64))
+            v = _coerce(v, DOUBLE)
+        else:
+            table = np.unique(np.asarray([int(x) for x in vals], np.int64))
+        e = ir.Call("in_array", (v, ir.Constant(table, UNKNOWN)), BOOLEAN)
+        if ast.negated:
+            e = ir.Call("not", (e,), BOOLEAN)
+        return e, None
+
     def _try_translate(self, ast, cols):
         try:
             e, _ = self.translate(ast, cols)
@@ -611,6 +680,8 @@ class ExpressionAnalyzer:
             return e, None
         if isinstance(ast, A.Like):
             return self._translate_like(ast, cols)
+        if isinstance(ast, A.InSubquery):
+            return self._translate_in_subquery_eager(ast, cols)
         if isinstance(ast, A.IsNull):
             v, _ = self._translate(ast.value, cols)
             e = ir.Call("is_null", (v,), BOOLEAN)

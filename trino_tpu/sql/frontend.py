@@ -2289,12 +2289,14 @@ def _stats2_rewrite(name: str, y: A.Node, x: A.Node) -> A.Node:
         # var(x)>0) is a perfect fit: 1.0 (SQL contract); var(x)=0 stays NULL
         # through the nullif-guarded division
         r = div(c_sxy, A.FuncCall("sqrt", (mul(c_sxx, c_syy),)))
-        # "var(y)=0" must tolerate catastrophic cancellation in syy - sy²/n:
-        # compare against the raw second moment's scale, not exact zero
+        # "var(y)=0" must tolerate catastrophic cancellation in syy - sy²/n,
+        # but ONLY at the float64 rounding floor (~20 ulp of the raw second
+        # moment): a looser bound (1e-12) fabricated perfect fits for data
+        # with mean/stddev beyond ~1e6 (epoch millis, large ids)
         const_y = A.BinaryOp(
             "and",
-            A.BinaryOp("lte", c_syy, mul(A.NumberLit("1e-12"), syy)),
-            A.BinaryOp("gt", c_sxx, mul(A.NumberLit("1e-12"), sxx)))
+            A.BinaryOp("lte", c_syy, mul(A.NumberLit("4e-15"), syy)),
+            A.BinaryOp("gt", c_sxx, mul(A.NumberLit("4e-15"), sxx)))
         return A.CaseExpr(None, ((const_y, A.NumberLit("1.0")),), mul(r, r))
     raise SemanticError(f"unknown statistical aggregate {name}")
 
