@@ -109,12 +109,18 @@ def probe(jt: JoinTable, key_cols, key_types, valid):
     C = jt.capacity
     h0 = splitmix64(packed)
     stp = probe_step(h0)
-    # derive the loop carries from the (possibly device-varying) probe inputs:
-    # under shard_map, fresh constants are "unvarying" and the while_loop would
-    # reject the carry when the body mixes them with per-worker data
-    row_ids = (h0 * 0).astype(jnp.int32)
-    matched = valid & False
-    done = ~valid
+    # derive the loop carries from BOTH operands' varying axes: under
+    # shard_map, fresh constants are "unvarying" and the while_loop rejects a
+    # carry the body mixes with per-worker data.  Keys alone are not enough —
+    # a CONSTANT join key (select 1 k ... join ... on l.k = n.k) folds to an
+    # unvarying array while the TABLE is still per-worker, so the zero must
+    # also touch the table (caught by the r05 AddExchanges distribution flip).
+    vzero = (h0 * 0).astype(jnp.int32) \
+        + (jt.table[jnp.zeros((), jnp.int32)] * 0).astype(jnp.int32) \
+        + (valid.astype(jnp.int32) * 0)
+    row_ids = vzero
+    matched = (valid & False) | (vzero != 0)
+    done = ~valid | (vzero != 0)
 
     def cond(carry):
         p, row_ids, matched, done = carry
@@ -338,11 +344,15 @@ def probe_slots(table, key_cols, key_types, valid):
     C = table.shape[0] - 1
     h0 = splitmix64(packed)
     stp = probe_step(h0)
-    # carries derive from probe inputs so they inherit shard_map's varying axis
-    # (see probe() above)
-    slot = (h0 * 0).astype(jnp.int32)
-    matched = valid & False
-    done = ~valid
+    # carries derive from BOTH operands so they inherit every varying axis a
+    # body output can carry (see probe() above: constant keys + per-worker
+    # table would otherwise mismatch the while_loop carry types)
+    vzero = (h0 * 0).astype(jnp.int32) \
+        + (table[jnp.zeros((), jnp.int32)] * 0).astype(jnp.int32) \
+        + (valid.astype(jnp.int32) * 0)
+    slot = vzero
+    matched = (valid & False) | (vzero != 0)
+    done = ~valid | (vzero != 0)
 
     def cond(carry):
         p, slot, matched, done = carry

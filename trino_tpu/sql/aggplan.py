@@ -1,0 +1,429 @@
+"""Aggregation planning methods: GROUP BY / grouping sets planning, distinct
+aggregates, HAVING/ORDER BY resolution over the post-aggregation scope.
+
+Reference: the aggregation half of sql/planner/QueryPlanner.java — split out
+of the one-pass frontend (round-4 verdict item 5)."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+import numpy as np
+
+from ..page import Field, Schema
+from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, UNKNOWN, DecimalType, Type,
+                     VarcharType, common_super_type, parse_date_literal)
+from . import ir
+from . import parser as A
+from . import plan as P
+from .analyzer import (AGG_FUNCS, ColumnInfo, SemanticError,
+                       _add_months_const, _arith, _coerce, _interval_days,
+                       _interval_months, _interval_seconds, _literal_number,
+                       _resolve_column, _rewrite_ast, _type_from_name)
+
+from .planbase import RelPlan, _split_conjuncts, _and_all, _derive_name
+from .aggsugar import (_PostAggScope, _agg_kind, _agg_type, _collect_aggs,
+                       _collect_windows, _replace_nodes, _rewrite_agg_sugar,
+                       _rewrite_agg_sugar_query, _AGG_ALIASES, _AGG_SUGAR)
+
+
+class AggregationPlannerMixin:
+    """Planner methods for aggregation (mixed into Planner)."""
+
+    # ---------------------------------------------------------------- aggregation
+    def _plan_aggregation(self, q, rel: RelPlan, items, agg_calls):
+        if len(q.group_by) == 1 and isinstance(q.group_by[0], A.GroupingSets):
+            return self._plan_grouping_sets(q, rel, items, agg_calls, q.group_by[0])
+        group_asts = [self._resolve_group_ast(g, items, rel) for g in q.group_by]
+
+        key_exprs, key_dicts = [], []
+        for g in group_asts:
+            e, d = self.translate(g, rel.cols)
+            key_exprs.append(e)
+            key_dicts.append(d)
+
+        # dedup aggregate calls structurally
+        uniq_aggs = []
+        for a in agg_calls:
+            if a not in uniq_aggs:
+                uniq_aggs.append(a)
+
+        # DISTINCT aggregates (min/max ignore distinct): rewrite agg(distinct x) GROUP BY k
+        # into a pre-aggregation on (k, x) followed by plain agg(x) GROUP BY k (reference:
+        # iterative/rule/SingleDistinctAggregationToGroupBy.java)
+        distinct_aggs = [a for a in uniq_aggs
+                         if (a.distinct or a.name == "approx_distinct")
+                         and a.name not in ("min", "max")]
+        if distinct_aggs and (len(uniq_aggs) != len(distinct_aggs)
+                              or len({a.args for a in distinct_aggs}) != 1):
+            # mixed distinct/non-distinct (or several distinct args): compose
+            # per-part aggregations joined back on the group keys (reference:
+            # the MarkDistinct/MultipleDistinctAggregationToMarkDistinct
+            # family — re-planned as a join of single-purpose aggregations,
+            # each of which the engine already runs well)
+            return self._plan_mixed_distinct(q, rel, items, group_asts,
+                                             uniq_aggs, distinct_aggs)
+        if distinct_aggs:
+            arg_ast = distinct_aggs[0].args[0]
+            de, _ = self.translate(arg_ast, rel.cols)
+            proj_exprs = list(key_exprs) + [de]
+            proj_schema = Schema(tuple(Field(f"c{i}", e.type)
+                                       for i, e in enumerate(proj_exprs)))
+            proj = P.Project(rel.node, tuple(proj_exprs), proj_schema,
+                             tuple(key_dicts) + (None,))
+            dist = P.Aggregate(proj, tuple(range(len(proj_exprs))), (), proj_schema)
+            specs = []
+            for j, a in enumerate(uniq_aggs):
+                kind, _ = _agg_kind(a)
+                if kind == "approx_distinct":
+                    # approx_distinct(x) = count(distinct x) over the pre-aggregated
+                    # distinct groups (exact — a valid "approximation"; reference:
+                    # ApproximateCountDistinctAggregation returns estimates, ours
+                    # exercises the same distinct-rewrite machinery)
+                    kind = "count"
+                specs.append(P.AggSpec(kind, ir.FieldRef(len(key_exprs), de.type),
+                                       f"agg{j}", _agg_type(kind, de.type)))
+            agg_schema = Schema(tuple(
+                [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
+                + [Field(s.name, s.type) for s in specs]
+            ))
+            agg = P.Aggregate(dist, tuple(range(len(key_exprs))), tuple(specs), agg_schema)
+        else:
+            proj, key_exprs, key_dicts, uniq_aggs, specs = self._build_agg_projection(
+                rel, group_asts, agg_calls)
+            agg_schema = Schema(tuple(
+                [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
+                + [Field(s.name, s.type) for s in specs]
+            ))
+            agg = P.Aggregate(proj, tuple(range(len(key_exprs))), tuple(specs), agg_schema)
+        agg_cols = ([ColumnInfo(None, f"k{i}", e.type, d)
+                     for i, (e, d) in enumerate(zip(key_exprs, key_dicts))]
+                    + [ColumnInfo(None, s.name, s.type, None) for s in specs])
+        agg_unique = [frozenset(range(len(key_exprs)))] if key_exprs else []
+        return self._finish_aggregation(q, agg, items, group_asts, uniq_aggs,
+                                        agg_cols, agg_unique)
+
+    def _plan_mixed_distinct(self, q, rel: RelPlan, items, group_asts,
+                             uniq_aggs, distinct_aggs):
+        """count(distinct x) alongside plain aggregates (and/or several
+        distinct argument sets): each part — the non-distinct aggregates, and
+        one distinct-rewrite per argument — aggregates separately over the
+        same input, then the parts join back on the group keys (single-match:
+        keys are unique per part).  NULL group keys join via coalesce-to-
+        sentinel (IS NOT DISTINCT FROM semantics).  Reference:
+        MultipleDistinctAggregationToMarkDistinct + MarkDistinct planning."""
+        import numpy as np
+
+        nd_aggs = [a for a in uniq_aggs if a not in distinct_aggs]
+        darg_groups: list = []  # (args tuple, [agg asts])
+        for a in distinct_aggs:
+            for args, lst in darg_groups:
+                if args == a.args:
+                    lst.append(a)
+                    break
+            else:
+                darg_groups.append((a.args, [a]))
+
+        K = len(group_asts)
+        key_exprs, key_dicts = [], []
+        for g in group_asts:
+            e, d = self.translate(g, rel.cols)
+            key_exprs.append(e)
+            key_dicts.append(d)
+
+        parts = []  # (plan node, [agg asts], [result types])
+        if nd_aggs:
+            proj, _, _, nd_uniq, nd_specs = self._build_agg_projection(
+                rel, group_asts, nd_aggs)
+            schema = Schema(tuple(
+                [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
+                + [Field(s.name, s.type) for s in nd_specs]))
+            node = P.Aggregate(proj, tuple(range(K)), tuple(nd_specs), schema)
+            parts.append((node, list(nd_uniq), [s.type for s in nd_specs]))
+        for args, lst in darg_groups:
+            de, _ = self.translate(args[0], rel.cols)
+            pexprs = list(key_exprs) + [de]
+            pschema = Schema(tuple(Field(f"c{i}", e.type)
+                                   for i, e in enumerate(pexprs)))
+            proj = P.Project(rel.node, tuple(pexprs), pschema,
+                             tuple(key_dicts) + (None,))
+            dist = P.Aggregate(proj, tuple(range(len(pexprs))), (), pschema)
+            specs = []
+            for j, a in enumerate(lst):
+                kind, _ = _agg_kind(a)
+                if kind == "approx_distinct":
+                    kind = "count"
+                specs.append(P.AggSpec(kind, ir.FieldRef(K, de.type),
+                                       f"d{j}", _agg_type(kind, de.type)))
+            schema = Schema(tuple(
+                [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
+                + [Field(s.name, s.type) for s in specs]))
+            node = P.Aggregate(dist, tuple(range(K)), tuple(specs), schema)
+            parts.append((node, list(lst), [s.type for s in specs]))
+
+        def relplan(node):
+            cols = [ColumnInfo(None, f.name, f.type,
+                               key_dicts[i] if i < K else None)
+                    for i, f in enumerate(node.schema.fields)]
+            return RelPlan(node, cols, [frozenset(range(K))] if K else [])
+
+        base = relplan(parts[0][0])
+        part_start = [0]
+        for node, _, _ in parts[1:]:
+            rp = relplan(node)
+            if K == 0:
+                # the cross join rides a constant-key join, whose helper
+                # channels pad the probe side: the build payload starts at the
+                # JOIN node's probe width, not the pre-join width
+                base = self._make_cross_join(base, rp)
+                start = len(base.node.left.schema.fields)
+            else:
+                eqs = []
+                for i in range(K):
+                    t = base.cols[i].type
+                    if t.is_floating:
+                        raise SemanticError(
+                            "mixed distinct aggregates over floating group "
+                            "keys not supported")
+                    sent = -(1 << 62) + 7 \
+                        if np.dtype(t.dtype).itemsize >= 8 else -(1 << 30) + 7
+                    eqs.append((
+                        ir.Call("coalesce", (ir.FieldRef(i, t),
+                                             ir.Constant(sent, t)), t),
+                        ir.Call("coalesce", (ir.FieldRef(i, t),
+                                             ir.Constant(sent, t)), t)))
+                base = self._make_join("inner", base, rp, eqs)
+                start = len(base.node.left.schema.fields)
+            part_start.append(start)
+
+        lay_exprs = [ir.FieldRef(i, key_exprs[i].type) for i in range(K)]
+        agg_cols = [ColumnInfo(None, f"k{i}", key_exprs[i].type, key_dicts[i])
+                    for i in range(K)]
+        for a in uniq_aggs:
+            p, j = next((pi, lst.index(a)) for pi, (_, lst, _)
+                        in enumerate(parts) if a in lst)
+            t = parts[p][2][j]
+            lay_exprs.append(ir.FieldRef(part_start[p] + K + j, t))
+            agg_cols.append(ColumnInfo(None, f"a{len(agg_cols)}", t, None))
+        schema = Schema(tuple(Field(c.name, c.type) for c in agg_cols))
+        node = P.Project(base.node, tuple(lay_exprs), schema,
+                         tuple(c.dict for c in agg_cols))
+        return self._finish_aggregation(q, node, items, group_asts, uniq_aggs,
+                                        agg_cols,
+                                        [frozenset(range(K))] if K else [])
+
+    def _resolve_group_ast(self, g, items, rel: RelPlan):
+        """GROUP BY element resolution: ordinals and select-list aliases bind before
+        source columns (reference: StatementAnalyzer's groupingElement analysis)."""
+        if isinstance(g, A.NumberLit):
+            return items[int(g.text) - 1].expr
+        if isinstance(g, A.Identifier) and len(g.parts) == 1 and \
+                self._try_translate(g, rel.cols) is None:
+            match = [it.expr for it in items if it.alias == g.parts[0]]
+            if not match:
+                raise SemanticError(f"cannot resolve group key {g}")
+            return match[0]
+        return g
+
+    def _build_agg_projection(self, rel: RelPlan, key_asts, agg_calls):
+        """(proj node, key_exprs, key_dicts, uniq_aggs, specs): the shared input
+        projection of group keys + aggregate arguments."""
+        key_exprs, key_dicts = [], []
+        for g in key_asts:
+            e, d = self.translate(g, rel.cols)
+            key_exprs.append(e)
+            key_dicts.append(d)
+        uniq_aggs = []
+        for a in agg_calls:
+            if a not in uniq_aggs:
+                uniq_aggs.append(a)
+        proj_exprs = list(key_exprs)
+        specs = []
+        for j, a in enumerate(uniq_aggs):
+            kind, arg_ast = _agg_kind(a)
+            if arg_ast is None:
+                specs.append(P.AggSpec("count_star", None, f"agg{j}", BIGINT))
+            else:
+                e, _ = self.translate(arg_ast, rel.cols)
+                if kind in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+                    # sums of raw scaled-decimal ints would square the scale;
+                    # variance is computed over double values
+                    e = _coerce(e, DOUBLE)
+                param = None
+                if kind == "approx_percentile":
+                    if len(a.args) < 2:
+                        raise SemanticError(
+                            "approx_percentile(x, percentile) needs a "
+                            "percentile argument")
+                    pe, _ = self.translate(a.args[1], rel.cols)
+                    if not isinstance(pe, ir.Constant):
+                        raise SemanticError(
+                            "approx_percentile's percentile must be constant")
+                    param = float(pe.value)
+                    if pe.type.is_decimal:
+                        param /= 10 ** pe.type.scale
+                    if not 0.0 <= param <= 1.0:
+                        raise SemanticError("percentile must be in [0, 1]")
+                if kind == "listagg":
+                    if not e.type.is_string:
+                        raise SemanticError("listagg expects a string argument")
+                    sep = ", "
+                    if len(a.args) > 1:
+                        if not isinstance(a.args[1], A.StringLit):
+                            raise SemanticError(
+                                "listagg separator must be a string literal")
+                        sep = a.args[1].value
+                    order_ch, asc = None, True
+                    if a.within_group:
+                        si = a.within_group[0]
+                        oe, _ = self.translate(si.expr, rel.cols)
+                        order_ch = len(proj_exprs) + 1
+                        asc = si.ascending
+                    param = (sep, order_ch, asc)
+                ch = len(proj_exprs)
+                proj_exprs.append(e)
+                if kind == "listagg" and param[1] is not None:
+                    proj_exprs.append(oe)
+                specs.append(P.AggSpec(kind, ir.FieldRef(ch, e.type), f"agg{j}",
+                                       _agg_type(kind, e.type), param=param))
+        proj_schema = Schema(tuple(Field(f"c{i}", e.type)
+                                   for i, e in enumerate(proj_exprs)))
+        proj = P.Project(rel.node, tuple(proj_exprs), proj_schema,
+                         tuple(key_dicts) + tuple(
+                             None for _ in range(len(proj_exprs) - len(key_exprs))))
+        return proj, key_exprs, key_dicts, uniq_aggs, specs
+
+    def _finish_aggregation(self, q, node, items, group_asts, uniq_aggs, agg_cols,
+                            agg_unique):
+        """Shared tail: HAVING + output projection over (group keys + agg calls)."""
+        post = _PostAggScope(group_asts, uniq_aggs, agg_cols, self)
+        if q.having is not None:
+            node = P.Filter(node, post.translate(q.having))
+        out_exprs, out_names = [], []
+        for i, it in enumerate(items):
+            out_exprs.append(post.translate(it.expr))
+            out_names.append(it.alias or _derive_name(it.expr, i))
+        out_schema = Schema(tuple(Field(n, e.type) for n, e in zip(out_names, out_exprs)))
+        cols = []
+        for n, e in zip(out_names, out_exprs):
+            d = None
+            if isinstance(e, ir.FieldRef):
+                d = agg_cols[e.index].dict
+            cols.append(ColumnInfo(None, n, e.type, d))
+        node = P.Project(node, tuple(out_exprs), out_schema,
+                         tuple(c.dict for c in cols))
+        # remap unique key channels through the output projection
+        out_unique = []
+        for u in agg_unique:
+            mapped = [i for i, e in enumerate(out_exprs)
+                      if isinstance(e, ir.FieldRef) and e.index in u]
+            if len({out_exprs[i].index for i in mapped}) == len(u):
+                out_unique.append(frozenset(mapped))
+        return RelPlan(node, cols, out_unique), out_names, [it.expr for it in items]
+
+    def _plan_grouping_sets(self, q, rel: RelPlan, items, agg_calls, gs):
+        """GROUP BY ROLLUP/CUBE/GROUPING SETS: one aggregation per set over a shared
+        input projection, projected to a uniform layout (absent keys become typed
+        NULLs) and UNION ALLed (reference: GroupIdOperator feeding one aggregation;
+        the union-of-aggregations form is equivalent and keeps each table small)."""
+        if gs.kind == "rollup":
+            all_asts = [self._resolve_group_ast(g, items, rel) for g in gs.exprs]
+            sets = [tuple(range(k)) for k in range(len(all_asts), -1, -1)]
+        elif gs.kind == "cube":
+            all_asts = [self._resolve_group_ast(g, items, rel) for g in gs.exprs]
+            n = len(all_asts)
+            sets = [tuple(i for i in range(n) if mask >> i & 1)
+                    for mask in range((1 << n) - 1, -1, -1)]
+        else:
+            all_asts, sets = [], []
+            for s in gs.sets:
+                idxs = []
+                for e in s:
+                    e = self._resolve_group_ast(e, items, rel)
+                    if e not in all_asts:
+                        all_asts.append(e)
+                    idxs.append(all_asts.index(e))
+                sets.append(tuple(idxs))
+
+        proj, key_exprs, key_dicts, uniq_aggs, specs = self._build_agg_projection(
+            rel, all_asts, agg_calls)
+        if any(a.distinct for a in uniq_aggs):
+            raise SemanticError("DISTINCT aggregates with grouping sets not supported")
+
+        # grouping(c1, ..., cm) is a CONSTANT per grouping set (bit j set when
+        # argument j is NOT grouped in that set — reference:
+        # operator/GroupIdOperator + the grouping() rewrite): collect the
+        # calls, ride one extra union channel each, resolve in _PostAggScope
+        grouping_calls: list = []
+
+        def collect_grouping(ast):
+            if isinstance(ast, A.FuncCall) and ast.name == "grouping":
+                if ast not in grouping_calls:
+                    grouping_calls.append(ast)
+                return
+            for f in dataclasses.fields(ast) if dataclasses.is_dataclass(ast) \
+                    else ():
+                v = getattr(ast, f.name)
+                if isinstance(v, A.Node):
+                    collect_grouping(v)
+                elif isinstance(v, tuple):
+                    for x in v:
+                        if isinstance(x, A.Node):
+                            collect_grouping(x)
+
+        for it in items:
+            collect_grouping(it.expr)
+        if q.having is not None:
+            collect_grouping(q.having)
+        gcall_idxs = []
+        for gc in grouping_calls:
+            idxs = []
+            for arg in gc.args:
+                a = self._resolve_group_ast(arg, items, rel)
+                if a not in all_asts:
+                    raise SemanticError(
+                        "grouping() arguments must be grouping columns")
+                idxs.append(all_asts.index(a))
+            gcall_idxs.append(idxs)
+
+        uni_schema = Schema(tuple(
+            [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
+            + [Field(s.name, s.type) for s in specs]
+            + [Field(f"g{j}", BIGINT) for j in range(len(grouping_calls))]))
+        branches = []
+        for s in sets:
+            schema_s = Schema(tuple(
+                [Field(f"k{i}", key_exprs[i].type) for i in s]
+                + [Field(sp.name, sp.type) for sp in specs]))
+            agg_n = P.Aggregate(proj, s, tuple(specs), schema_s)
+            uni_exprs = []
+            for i, ke in enumerate(key_exprs):
+                if i in s:
+                    uni_exprs.append(ir.FieldRef(s.index(i), ke.type))
+                else:
+                    uni_exprs.append(ir.Constant(None, ke.type))
+            for j, sp in enumerate(specs):
+                uni_exprs.append(ir.FieldRef(len(s) + j, sp.type))
+            for idxs in gcall_idxs:
+                m = len(idxs)
+                val = sum(1 << (m - 1 - j)
+                          for j, ki in enumerate(idxs) if ki not in s)
+                uni_exprs.append(ir.Constant(val, BIGINT))
+            branches.append(P.Project(agg_n, tuple(uni_exprs), uni_schema,
+                                      tuple(key_dicts)
+                                      + tuple(None for _ in specs)
+                                      + tuple(None for _ in grouping_calls)))
+        node = P.Union(tuple(branches), uni_schema)
+        agg_cols = ([ColumnInfo(None, f"k{i}", e.type, d)
+                     for i, (e, d) in enumerate(zip(key_exprs, key_dicts))]
+                    + [ColumnInfo(None, sp.name, sp.type, None) for sp in specs]
+                    + [ColumnInfo(None, f"g{j}", BIGINT, None)
+                       for j in range(len(grouping_calls))])
+        return self._finish_aggregation(q, node, items, all_asts,
+                                        list(uniq_aggs) + grouping_calls,
+                                        agg_cols, [])
+
+
+

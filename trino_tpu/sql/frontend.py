@@ -41,23 +41,19 @@ from .analyzer import (AGG_FUNCS, ColumnInfo, ExpressionAnalyzer, SemanticError,
 
 __all__ = ["compile_sql", "SemanticError"]
 
-
-
-
-
-
-
-
-@dataclasses.dataclass
-class RelPlan:
-    node: P.PlanNode
-    cols: list  # ColumnInfo per channel
-    unique_sets: list = dataclasses.field(default_factory=list)
-    # unique_sets: frozensets of channel indices known unique (PKs, group-by keys); used to
-    # keep hash-join build sides duplicate-free (reference analog: stats-based CBO choosing
-    # build side, DetermineJoinDistributionType.java:51)
-
-
+from .planbase import (RelPlan, _split_conjuncts, _split_disjuncts, _and_all,
+                       _has_subquery, _flip_cmp, _find_equi_conjuncts,
+                       _ensure_channel, _derive_name)  # noqa: F401 (shared
+# planner substrate; re-exported for the existing import surface)
+from .aggsugar import (_PostAggScope, _agg_kind, _agg_type, _collect_aggs,
+                       _collect_windows, _replace_nodes, _rewrite_agg_sugar,
+                       _rewrite_agg_sugar_query, _stats2_rewrite,
+                       _moments_rewrite, _AGG_ALIASES, _AGG_SUGAR,
+                       _STATS2_AGGS)  # noqa: F401
+from .aggplan import AggregationPlannerMixin
+from .relations import RelationPlannerMixin
+from .subqueries import SubqueryPlannerMixin
+from .analyzer import ExpressionAnalyzer  # noqa: F401
 
 
 def compile_sql(sql: str, engine, session) -> P.PlanNode:
@@ -65,7 +61,8 @@ def compile_sql(sql: str, engine, session) -> P.PlanNode:
     return Planner(engine, session).plan_query(ast)
 
 
-class Planner(ExpressionAnalyzer):
+class Planner(SubqueryPlannerMixin, RelationPlannerMixin,
+              AggregationPlannerMixin, ExpressionAnalyzer):
     def __init__(self, engine, session):
         self.engine = engine
         self.session = session
@@ -605,2005 +602,4 @@ class Planner(ExpressionAnalyzer):
             rel = self._plan_subquery_rel(side, None)
             return rel, [c.name for c in rel.cols], [None] * len(rel.cols)
         return self._plan_select(side)
-
-    # ---------------------------------------------------------------- FROM / joins
-    def _plan_from(self, q: A.Select) -> RelPlan:
-        if q.from_ is None:
-            schema = Schema.of(("dummy", BIGINT))
-            return RelPlan(P.Values(((0,),), schema), [ColumnInfo(None, "dummy", BIGINT)])
-        relations: list[tuple] = []  # (RelPlan, rows_estimate)
-        explicit_joins: list = []
-        self._pending_unnests = []
-        self._flatten_from(q.from_, relations, explicit_joins)
-        conjuncts = _split_conjuncts(q.where)
-        # subquery predicates (IN/EXISTS/correlated scalar) apply after the base join tree
-        sub_conjs = [c for c in conjuncts if _has_subquery(c)]
-        conjuncts = [c for c in conjuncts if not _has_subquery(c)]
-        unnests, self._pending_unnests = self._pending_unnests, []
-        deferred = []
-        if unnests:
-            # conjuncts naming unnest output columns resolve only after expansion
-            out_names = set()
-            for un in unnests:
-                out_names.update(un.columns)
-                if un.alias:
-                    out_names.add(un.alias)
-            def mentions_unnest(c):
-                found = []
-
-                def walk(n):
-                    if isinstance(n, A.Identifier) and (
-                            n.parts[-1] in out_names
-                            or (len(n.parts) > 1 and n.parts[-2] in out_names)):
-                        found.append(n)
-                    for f in getattr(n, "__dataclass_fields__", ()):
-                        v = getattr(n, f)
-                        if isinstance(v, A.Node):
-                            walk(v)
-                        elif isinstance(v, tuple):
-                            for x in v:
-                                if isinstance(x, A.Node):
-                                    walk(x)
-
-                walk(c)
-                return bool(found)
-
-            deferred = [c for c in conjuncts if mentions_unnest(c)]
-            conjuncts = [c for c in conjuncts if c not in deferred]
-        drop_base = False
-        if not relations and not explicit_joins and unnests:
-            # FROM UNNEST(...) alone: expand over a synthetic single row
-            schema = Schema.of(("dummy", BIGINT))
-            rel = RelPlan(P.Values(((0,),), schema),
-                          [ColumnInfo(None, "dummy", BIGINT)])
-            deferred = conjuncts + deferred
-            drop_base = True
-        else:
-            rel = self._plan_from_base(relations, explicit_joins, conjuncts, q)
-        for un in unnests:
-            rel = self._apply_unnest(un, rel, drop_base=drop_base)
-            drop_base = False
-        for c in deferred:
-            e, _ = self.translate(c, rel.cols)
-            rel = RelPlan(P.Filter(rel.node, e), rel.cols, rel.unique_sets)
-        for c in sub_conjs:
-            rel = self._apply_subquery_conjunct(c, rel)
-        return rel
-
-    def _apply_unnest(self, un: A.UnnestRef, rel: RelPlan,
-                      drop_base: bool = False) -> RelPlan:
-        """Expand array-typed expressions over ``rel`` (the CROSS JOIN UNNEST
-        shape; reference: sql/planner/plan/UnnestNode.java).  Multiple arrays
-        zip positionally, shorter ones padding with NULL (the reference's
-        parallel-unnest semantics)."""
-        from ..types import ArrayType
-
-        node = rel.node
-        channels, datas = [], []
-        for expr_ast in un.exprs:
-            e, d = self.translate(expr_ast, rel.cols)
-            if not isinstance(e.type, ArrayType) or d is None:
-                raise SemanticError("UNNEST expects array-typed arguments")
-            ch, node = _ensure_channel(node, e, rel.cols)
-            channels.append(ch)
-            datas.append(d)
-        n_child = len(node.schema.fields)
-        replicate = tuple(range(n_child)) if not drop_base else ()
-        names = list(un.columns)
-        while len(names) < len(channels) + (1 if un.ordinality else 0):
-            names.append(f"col{len(names) + 1}" if names or len(channels) > 1
-                         else "col")
-        elem_fields = [Field(names[i], d.elem_type) for i, d in enumerate(datas)]
-        out_fields = ([f for i, f in enumerate(node.schema.fields)
-                       if i in replicate] + elem_fields
-                      + ([Field(names[len(channels)], BIGINT)]
-                         if un.ordinality else []))
-        schema = Schema(tuple(out_fields))
-        unode = P.Unnest(node, replicate, tuple(channels), tuple(datas),
-                         un.ordinality, schema)
-        pad = [ColumnInfo(None, "", f.type)
-               for f in node.schema.fields[len(rel.cols):]]
-        base_cols = [] if drop_base else list(rel.cols) + pad
-        cols = base_cols + [
-            ColumnInfo(un.alias, names[i], d.elem_type, d.elem_dict)
-            for i, d in enumerate(datas)]
-        if un.ordinality:
-            cols.append(ColumnInfo(un.alias, names[len(channels)], BIGINT))
-        return RelPlan(unode, cols, [])
-
-    def _plan_from_base(self, relations, explicit_joins, conjuncts, q) -> RelPlan:
-
-        if explicit_joins:
-            # explicit JOIN ... ON syntax: left-deep in written order
-            rel = self._plan_explicit(q.from_)
-            remaining = []
-            for c in conjuncts:
-                ch = self._try_translate(c, rel.cols)
-                if ch is None:
-                    raise SemanticError(f"cannot resolve predicate {c}")
-                remaining.append(ch)
-            node = rel.node
-            for pred in remaining:
-                node = P.Filter(node, pred)
-            return RelPlan(node, rel.cols, rel.unique_sets)
-
-        from .stats import filter_selectivity, join_stats
-
-        # comma-join planning with pushdown + cost-ranked ordering (reference:
-        # stats-driven join ordering, iterative/rule/ReorderJoins.java:98 —
-        # greedy minimum-intermediate-cardinality over connector statistics)
-        rels = [r for r, _ in relations]
-        rstats = [s for _, s in relations]
-        # push single-relation conjuncts onto their relation, scaling its stats
-        # by the predicate's estimated selectivity (cost/FilterStatsCalculator)
-        residual = []
-        for c in conjuncts:
-            placed = False
-            for i, r in enumerate(rels):
-                e = self._try_translate(c, r.cols)
-                if e is not None:
-                    rels[i] = RelPlan(P.Filter(r.node, e), r.cols, r.unique_sets)
-                    rstats[i] = rstats[i].scaled(filter_selectivity(e, rstats[i]))
-                    placed = True
-                    break
-            if not placed:
-                residual.append(c)
-        if len(rels) == 1:
-            node = rels[0].node
-            for c in residual:
-                e, _ = self.translate(c, rels[0].cols)
-                node = P.Filter(node, e)
-            return RelPlan(node, rels[0].cols, rels[0].unique_sets)
-
-        def _key_channels(eqs):
-            return ([pe.index if isinstance(pe, ir.FieldRef) else None
-                     for pe, _ in eqs],
-                    [be.index if isinstance(be, ir.FieldRef) else None
-                     for _, be in eqs])
-
-        # probe spine = largest estimated post-filter relation; each step joins
-        # the connected candidate whose estimated OUTPUT cardinality is lowest
-        # (unique-key build as the tiebreak — duplicate builds force the
-        # multi-match strategy at runtime)
-        order = sorted(range(len(rels)), key=lambda i: -rstats[i].rows)
-        current = rels[order[0]]
-        cur_stats = rstats[order[0]]
-        joined = {order[0]}
-        pending = [i for i in order[1:]]
-        while pending:
-            candidates = []
-            for i in pending:
-                cand = rels[i]
-                eqs, rest = _find_equi_conjuncts(self, residual, current, cand)
-                if not eqs:
-                    continue
-                build_chs = frozenset(
-                    e.index for _, e in eqs if isinstance(e, ir.FieldRef))
-                unique = any(u <= build_chs for u in cand.unique_sets)
-                pks, bks = _key_channels(eqs)
-                est = join_stats(cur_stats, rstats[i], pks, bks,
-                                 build_unique=unique)
-                candidates.append((est.rows, not unique, rstats[i].rows, i, eqs,
-                                   rest, est))
-            if not candidates:
-                # no pending relation connects to the spine; join equi-connected
-                # PENDING pairs first so cross products happen over the smallest
-                # possible component results
-                pair = None
-                for ii in pending:
-                    for jj in pending:
-                        if ii == jj:
-                            continue
-                        eqs2, rest2 = _find_equi_conjuncts(self, residual,
-                                                           rels[ii], rels[jj])
-                        if eqs2:
-                            pair = (ii, jj, eqs2, rest2)
-                            break
-                    if pair:
-                        break
-                if pair is not None:
-                    ii, jj, eqs2, rest2 = pair
-                    pks, bks = _key_channels(eqs2)
-                    est2 = join_stats(rstats[ii], rstats[jj], pks, bks)
-                    rels[ii] = self._make_join(
-                        "inner", rels[ii], rels[jj], eqs2,
-                        build_rows=rstats[jj].rows if rstats[jj].known else None,
-                        est_rows=est2.rows if est2.known else None)
-                    rstats[ii] = est2
-                    residual = rest2
-                    pending.remove(jj)
-                    continue
-                # genuinely unconnected: CROSS JOIN the smallest pending relation
-                # (constant-key join -> full multi-match expansion; theta predicates
-                # apply afterwards as filters — reference: JoinNode with CROSS type)
-                i = min(pending, key=lambda i: rstats[i].rows)
-                current = self._make_cross_join(current, rels[i])
-                from .stats import RelStats
-
-                cur_stats = RelStats(cur_stats.rows * rstats[i].rows,
-                                     list(cur_stats.cols) + list(rstats[i].cols))
-                joined.add(i)
-                pending.remove(i)
-                continue
-            _, _, _, i, eqs, rest, est = min(
-                candidates, key=lambda c: (c[0], c[1], c[2]))
-            current = self._make_join(
-                "inner", current, rels[i], eqs,
-                build_rows=rstats[i].rows if rstats[i].known else None,
-                est_rows=est.rows if est.known else None)
-            cur_stats = est
-            residual = rest
-            joined.add(i)
-            pending.remove(i)
-        node = current.node
-        still = []
-        for c in residual:
-            e = self._try_translate(c, current.cols)
-            if e is None:
-                still.append(c)
-            else:
-                node = P.Filter(node, e)
-        if still:
-            raise SemanticError(f"unresolvable predicates: {still}")
-        return RelPlan(node, current.cols, current.unique_sets)
-
-    # ---------------------------------------------------------------- subquery predicates
-    def _apply_subquery_conjunct(self, c, rel: RelPlan) -> RelPlan:
-        """Plan one IN/EXISTS/scalar-subquery predicate against the joined relation.
-
-        Reference: subquery planning + decorrelation in SubqueryPlanner/
-        TransformCorrelated* rules (sql/planner/SubqueryPlanner.java,
-        iterative/rule/TransformCorrelated*.java) — here specialized to the equi-correlated
-        patterns (semi/anti joins; correlated scalar aggregates join on their correlation
-        keys)."""
-        neg = False
-        while isinstance(c, A.UnaryOp) and c.op == "not":
-            neg = not neg
-            c = c.operand
-        if isinstance(c, A.InSubquery):
-            # _plan_subquery_rel applies the subquery's ORDER BY/LIMIT (a LIMITed IN-list
-            # is order-sensitive and must not build on the full table)
-            inner = self._plan_subquery_rel(c.query, None)
-            if len(inner.cols) != 1:
-                raise SemanticError("IN subquery must produce one column")
-            value, _ = self.translate(c.value, rel.cols)
-            negated = c.negated != neg
-            return self._semi_anti_join(rel, inner, [(value, ir.FieldRef(
-                0, inner.cols[0].type, inner.cols[0].name))], negated,
-                null_aware=True)
-        if isinstance(c, A.Exists):
-            negated = c.negated != neg
-            return self._plan_exists(c.query, rel, negated)
-        if isinstance(c, A.BinaryOp) and c.op in ("eq", "neq", "lt", "lte", "gt", "gte"):
-            # correlated scalar aggregate comparison (uncorrelated ones fold in translate)
-            sub = c.right if isinstance(c.right, A.ScalarSubquery) else c.left
-            other_ast = c.left if sub is c.right else c.right
-            if not isinstance(sub, A.ScalarSubquery):
-                raise SemanticError(f"unsupported subquery predicate {c}")
-            op = c.op if sub is c.right else _flip_cmp(c.op)
-            if neg:
-                op = {"eq": "neq", "neq": "eq", "lt": "gte", "lte": "gt",
-                      "gt": "lte", "gte": "lt"}[op]
-            # uncorrelated subqueries fold eagerly; ONLY the correlation probe (planning)
-            # may fail over to decorrelation — cardinality/translation errors are real
-            try:
-                plan = self.plan_query(sub.query)
-            except SemanticError:
-                plan = None  # correlated: unresolvable outer references
-            if plan is not None:
-                const = self._scalar_from_plan(plan)
-                other, od = self.translate(other_ast, rel.cols)
-                t = common_super_type(other.type, const.type)
-                return RelPlan(P.Filter(rel.node, ir.Call(
-                    op, (_coerce(other, t), _coerce(const, t)), BOOLEAN)),
-                    rel.cols, rel.unique_sets)
-            rel2, agg_expr = self._join_correlated_agg(sub.query, rel)
-            other, _ = self.translate(other_ast, rel2.cols[:len(rel.cols)])
-            t = common_super_type(other.type, agg_expr.type)
-            pred = ir.Call(op, (_coerce(other, t), _coerce(agg_expr, t)), BOOLEAN)
-            return RelPlan(P.Filter(rel2.node, pred), rel2.cols, rel2.unique_sets)
-        raise SemanticError(f"unsupported subquery predicate {c}")
-
-    def _semi_anti_join(self, rel: RelPlan, inner: RelPlan, pairs, negated: bool,
-                        null_aware: bool = False) -> RelPlan:
-        """rel ⋉/▷ inner on (outer_expr = inner_expr) pairs.
-
-        ``null_aware`` (IN/NOT IN semantics): NULLs among the build keys must make
-        NOT IN yield UNKNOWN for otherwise-unmatched rows (reference: null-aware anti
-        join in SemiJoinNode planning).  The group-by dedup erases null masks, so
-        null-aware builds skip it and let the executor's hash table dedup instead."""
-        # coerce BOTH sides to the common key type (packed-key equality is exact, so a
-        # scale/width mismatch would silently never match), project inner to its key
-        # columns, then distinct (unique build keys)
-        types = [common_super_type(pe.type, be.type) for pe, be in pairs]
-        key_exprs = [_coerce(be, t) for (_, be), t in zip(pairs, types)]
-        schema = Schema(tuple(Field(f"sk{i}", e.type) for i, e in enumerate(key_exprs)))
-        build = P.Project(inner.node, tuple(key_exprs), schema)
-        if not null_aware:
-            build = P.Aggregate(build, tuple(range(len(key_exprs))), (), schema)
-        probe_node = rel.node
-        pkeys, bkeys = [], []
-        for i, ((pe, _), t) in enumerate(zip(pairs, types)):
-            pch, probe_node = _ensure_channel(probe_node, _coerce(pe, t), rel.cols)
-            pkeys.append(pch)
-            bkeys.append(i)
-        kind = "anti" if negated else "semi"
-        join = P.Join(kind, probe_node, build, tuple(pkeys), tuple(bkeys),
-                      probe_node.schema, null_aware=null_aware)
-        # semi/anti output keeps all probe channels (incl. any helper join-key channels;
-        # harmless — downstream refers to the original ones)
-        cols = list(rel.cols) + [ColumnInfo(None, f.name, f.type)
-                                 for f in probe_node.schema.fields[len(rel.cols):]]
-        return RelPlan(join, cols, rel.unique_sets)
-
-    def _plan_exists(self, q: A.Select, rel: RelPlan, negated: bool) -> RelPlan:
-        if q.having is not None:
-            raise SemanticError("HAVING inside correlated EXISTS not supported yet")
-        if q.limit == 0:
-            # EXISTS (... LIMIT 0) is constant-false
-            keep = negated
-            return rel if keep else RelPlan(
-                P.Filter(rel.node, ir.Constant(False, BOOLEAN)), rel.cols, rel.unique_sets)
-        if not q.group_by:
-            aggs: list = []
-            for it in q.items:
-                if not isinstance(it.expr, A.Star):
-                    _collect_aggs(it.expr, aggs)
-            if aggs:
-                # an ungrouped aggregate query yields exactly one row regardless of
-                # input: EXISTS is constant-true
-                keep = not negated
-                return rel if keep else RelPlan(
-                    P.Filter(rel.node, ir.Constant(False, BOOLEAN)),
-                    rel.cols, rel.unique_sets)
-        # GROUP BY without HAVING does not change row existence; drop it below
-        inner_cols = self._inner_columns(q.from_)
-        inner_only, corr_pairs_ast, residual_ast = [], [], []
-        for cj in _split_conjuncts(q.where):
-            if self._resolves(cj, inner_cols):
-                inner_only.append(cj)
-                continue
-            pair = self._split_correlated_equi(cj, rel.cols, inner_cols)
-            if pair is None:
-                residual_ast.append(cj)
-                continue
-            corr_pairs_ast.append(pair)
-        if residual_ast:
-            # non-equi correlated predicates (Q21's l2.l_suppkey <> l1.l_suppkey) ride the
-            # join as a residual match filter over probe+build channels; the build side
-            # stays un-deduplicated (every inner row is a match candidate)
-            if not corr_pairs_ast:
-                raise SemanticError("correlated EXISTS without an equi conjunct")
-            inner_rel = self._plan_from(dataclasses.replace(q, where=_and_all(inner_only)))
-            return self._semi_anti_join_residual(rel, inner_rel, corr_pairs_ast,
-                                                 residual_ast, negated)
-        if not corr_pairs_ast:
-            # uncorrelated EXISTS: evaluate once
-            sub = dataclasses.replace(q, items=(A.SelectItem(A.NumberLit("1"), None),),
-                                      where=_and_all(inner_only), limit=1,
-                                      order_by=(), group_by=q.group_by)
-            res = self.engine.execute_plan(self.plan_query(sub), cache=False)
-            exists = len(res) > 0
-            keep = exists != negated
-            if keep:
-                return rel
-            return RelPlan(P.Filter(rel.node, ir.Constant(False, BOOLEAN)),
-                           rel.cols, rel.unique_sets)
-        inner_sel = dataclasses.replace(
-            q, items=tuple(A.SelectItem(inner_ast, None) for _, inner_ast in corr_pairs_ast),
-            where=_and_all(inner_only), group_by=(), having=None, order_by=(), limit=None)
-        inner_rel, _, _ = self._plan_select(inner_sel)
-        pairs = []
-        for i, (outer_ast, _) in enumerate(corr_pairs_ast):
-            oe, _ = self.translate(outer_ast, rel.cols)
-            c = inner_rel.cols[i]
-            pairs.append((oe, ir.FieldRef(i, c.type, c.name)))
-        return self._semi_anti_join(rel, inner_rel, pairs, negated)
-
-    def _semi_anti_join_residual(self, rel: RelPlan, inner_rel: RelPlan, pairs_ast,
-                                 residual_ast, negated: bool) -> RelPlan:
-        """Semi/anti join with per-candidate residual filter (reference:
-        JoinFilterFunction on semijoins; executed by the multi-match probe)."""
-        probe_node, build_node = rel.node, inner_rel.node
-        pkeys, bkeys = [], []
-        for outer_ast, inner_ast in pairs_ast:
-            oe, _ = self.translate(outer_ast, rel.cols)
-            be, _ = self.translate(inner_ast, inner_rel.cols)
-            t = common_super_type(oe.type, be.type)
-            pch, probe_node = _ensure_channel(probe_node, _coerce(oe, t), rel.cols)
-            bch, build_node = _ensure_channel(build_node, _coerce(be, t), inner_rel.cols)
-            pkeys.append(pch)
-            bkeys.append(bch)
-        probe_cols = list(rel.cols) + [ColumnInfo(None, "", f.type)
-                                       for f in probe_node.schema.fields[len(rel.cols):]]
-        build_cols = list(inner_rel.cols) + [
-            ColumnInfo(None, "", f.type)
-            for f in build_node.schema.fields[len(inner_rel.cols):]]
-        comb = probe_cols + build_cols
-        filt = None
-        for c in residual_ast:
-            e, _ = self.translate(c, comb)
-            filt = e if filt is None else ir.Call("and", (filt, e), BOOLEAN)
-        kind = "anti" if negated else "semi"
-        join = P.Join(kind, probe_node, build_node, tuple(pkeys), tuple(bkeys),
-                      probe_node.schema, filter=filt)
-        return RelPlan(join, probe_cols, rel.unique_sets)
-
-    def _inner_columns(self, from_) -> list:
-        """Column scope of a subquery's FROM without planning its joins."""
-        relations, explicit = [], []
-        self._flatten_from(from_, relations, explicit)
-        cols = []
-        for r, _ in relations:
-            cols.extend(r.cols)
-        for j in explicit:
-            cols.extend(self._join_ref_columns(j))
-        return cols
-
-    def _join_ref_columns(self, j: A.JoinRef) -> list:
-        """All leaf-relation columns under a (possibly nested) explicit-join tree."""
-        cols = []
-        for side in (j.left, j.right):
-            if isinstance(side, A.JoinRef):
-                cols.extend(self._join_ref_columns(side))
-            else:
-                cols.extend(self._plan_relation(side).cols)
-        return cols
-
-    def _resolves(self, ast, cols) -> bool:
-        return self._try_translate(ast, cols) is not None
-
-    def _split_correlated_equi(self, cj, outer_cols, inner_cols):
-        """a = b with one side outer, one side inner -> (outer_ast, inner_ast).
-
-        SQL scoping: a name resolvable in the inner scope binds there even if the outer
-        scope also has it (StatementAnalyzer's scope chain) — so the inner-resolvable side
-        is the inner one, and the other side must resolve in the outer scope."""
-        if not (isinstance(cj, A.BinaryOp) and cj.op == "eq"):
-            return None
-        l_inner = self._resolves(cj.left, inner_cols)
-        r_inner = self._resolves(cj.right, inner_cols)
-        l_outer = self._resolves(cj.left, outer_cols)
-        r_outer = self._resolves(cj.right, outer_cols)
-        if l_inner and not r_inner and r_outer:
-            return (cj.right, cj.left)
-        if r_inner and not l_inner and l_outer:
-            return (cj.left, cj.right)
-        return None
-
-    def _eager_scalar(self, q: A.Select) -> ir.Constant:
-        """Execute an uncorrelated scalar subquery at plan time -> Constant.
-
-        (The reference plans these as joins — EnforceSingleRowNode; eager evaluation is
-        equivalent for uncorrelated subqueries and keeps fragments simple.)"""
-        plan = self.plan_query(q)  # raises SemanticError if correlated (unresolved cols)
-        return self._scalar_from_plan(plan)
-
-    def _scalar_from_plan(self, plan) -> ir.Constant:
-        res = self.engine.execute_plan(plan, cache=False)
-        if len(res) != 1 or len(res.columns) != 1:
-            raise SemanticError("scalar subquery must return exactly one value")
-        t = res.types[0]
-        raw = res.raw_columns[0][0]
-        return ir.Constant(raw.item() if hasattr(raw, "item") else raw, t)
-
-    def _join_correlated_agg(self, q: A.Select, rel: RelPlan):
-        """Decorrelate `(select agg(..) from .. where inner.k = outer.k and ..)`:
-        plan the inner as GROUP BY its correlation keys, LEFT-join on them (an outer
-        row with an empty group must see the aggregate over an empty input: NULL for
-        sum/avg/min/max — which any comparison rejects — and 0 for count; reference:
-        TransformCorrelatedScalarAggregationToJoin + AggregationNode default values).
-        Returns (joined rel, ir expression for the aggregate value)."""
-        if len(q.items) != 1 or q.group_by:
-            raise SemanticError("unsupported correlated subquery shape")
-        item_expr = q.items[0].expr
-        item_aggs: list = []
-        _collect_aggs(item_expr, item_aggs)
-        is_bare_count = (isinstance(item_expr, A.FuncCall) and item_expr.name == "count")
-        if any(a.name == "count" for a in item_aggs) and not is_bare_count:
-            # count nested inside a larger expression: the empty-group value would be
-            # expr(count=0, ...) which NULL-propagation cannot reproduce
-            raise SemanticError(
-                "correlated subquery mixing count() into an expression not supported yet")
-        inner_cols = self._inner_columns(q.from_)
-        inner_only, corr_pairs_ast = [], []
-        for cj in _split_conjuncts(q.where):
-            if self._resolves(cj, inner_cols):
-                inner_only.append(cj)
-                continue
-            pair = self._split_correlated_equi(cj, rel.cols, inner_cols)
-            if pair is None:
-                raise SemanticError(f"unsupported correlated predicate {cj}")
-            corr_pairs_ast.append(pair)
-        if not corr_pairs_ast:
-            raise SemanticError("not correlated")
-        inner_sel = dataclasses.replace(
-            q,
-            items=tuple(A.SelectItem(ia, f"ck{i}") for i, (_, ia) in enumerate(corr_pairs_ast))
-            + (A.SelectItem(q.items[0].expr, "#aggv"),),  # '#' keeps it un-referenceable
-            where=_and_all(inner_only),
-            group_by=tuple(ia for _, ia in corr_pairs_ast),
-            having=None, order_by=(), limit=None)
-        inner_rel, _, _ = self._plan_select(inner_sel)
-        eqs = []
-        for i, (outer_ast, _) in enumerate(corr_pairs_ast):
-            oe, _ = self.translate(outer_ast, rel.cols)
-            c = inner_rel.cols[i]
-            eqs.append((oe, ir.FieldRef(i, c.type, c.name)))
-        joined = self._make_join("left", rel, inner_rel, eqs)
-        # locate the aggregate channel by name: _make_join may have appended helper
-        # channels to the probe side (computed/coerced correlation keys), shifting the
-        # build-side columns right
-        agg_ch = next(i for i, c in enumerate(joined.cols) if c.name == "#aggv")
-        agg_col = joined.cols[agg_ch]
-        agg_expr: ir.Expr = ir.FieldRef(agg_ch, agg_col.type)
-        if is_bare_count:
-            agg_expr = ir.Call("coalesce",
-                               (agg_expr, ir.Constant(0, agg_col.type)), agg_col.type)
-        return joined, agg_expr
-
-    def _flatten_from(self, node, relations, explicit_joins):
-        if isinstance(node, A.JoinRef):
-            if node.kind == "cross" and node.on is None:
-                self._flatten_from(node.left, relations, explicit_joins)
-                self._flatten_from(node.right, relations, explicit_joins)
-            else:
-                explicit_joins.append(node)
-        elif isinstance(node, A.UnnestRef):
-            # lateral: UNNEST args may reference sibling relations' columns, so
-            # expansion applies AFTER the base join (reference: UnnestNode under
-            # the correlated-join rewrite, CROSS JOIN UNNEST shape)
-            self._pending_unnests.append(node)
-        else:
-            rel = self._plan_relation(node)
-            relations.append((rel, self._estimate_stats(node, rel)))
-
-    def _plan_explicit(self, node) -> RelPlan:
-        if not isinstance(node, A.JoinRef):
-            return self._plan_relation(node)
-        left = self._plan_explicit(node.left)
-        right = self._plan_explicit(node.right)
-        if getattr(node, "using", ()):
-            # JOIN USING (c, ...): equi-join on the named columns of BOTH
-            # sides; the output carries the column ONCE (left's copy), so a
-            # bare reference stays unambiguous and SELECT * dedups — the
-            # reference's USING output scope (StatementAnalyzer joinUsing)
-            if node.kind not in ("inner", "left"):
-                raise SemanticError(
-                    f"USING with {node.kind.upper()} JOIN not supported yet")
-            eqs = []
-            for cname in node.using:
-                le = self._try_translate(A.Identifier((cname,)), left.cols)
-                re_ = self._try_translate(A.Identifier((cname,)), right.cols)
-                if le is None or re_ is None:
-                    raise SemanticError(
-                        f"USING column {cname} must exist on both sides")
-                eqs.append((le, re_))
-            rel = self._make_join(node.kind, left, right, eqs)
-            drop = {len(left.cols) + i for i, c in enumerate(right.cols)
-                    if c.name in node.using}
-            vis = [c for i, c in enumerate(rel.cols)
-                   if i not in drop and c.name]
-            exprs = tuple(ir.FieldRef(i, c.type, c.name)
-                          for i, c in enumerate(rel.cols)
-                          if i not in drop and c.name)
-            schema = Schema(tuple(Field(c.name, c.type) for c in vis))
-            return RelPlan(P.Project(rel.node, exprs, schema,
-                                     tuple(c.dict for c in vis)),
-                           [dataclasses.replace(c) for c in vis], [])
-        conjuncts = _split_conjuncts(node.on)
-        eqs, residual = [], []
-        for c in conjuncts:
-            pair = self._match_equi(c, left, right)
-            if pair is not None:
-                eqs.append(pair)
-            else:
-                residual.append(c)
-        if not eqs:
-            if node.kind != "inner":
-                raise SemanticError("non-equi outer joins not supported yet")
-            # theta join: cross product then filter (reference: cross JoinNode with
-            # the predicate as a post-join filter)
-            rel = self._make_cross_join(left, right)
-            out = rel.node
-            for c in residual:
-                e, _ = self.translate(c, rel.cols)
-                out = P.Filter(out, e)
-            return RelPlan(out, rel.cols, rel.unique_sets)
-        if node.kind == "left":
-            # ON residuals are match conditions, not post-filters, for outer joins.
-            # Build-side-only conjuncts push below the join (a build row failing one can
-            # never match — reference: PredicatePushDown's outer-join inner-side push);
-            # the rest become the join's residual match filter.
-            push, keep = [], []
-            for c in residual:
-                (push if self._resolves(c, right.cols) else keep).append(c)
-            for c in push:
-                e, _ = self.translate(c, right.cols)
-                right = RelPlan(P.Filter(right.node, e), right.cols, right.unique_sets)
-            rel = self._make_join("left", left, right, eqs)
-            if keep:
-                filt = None
-                for c in keep:
-                    e, _ = self.translate(c, rel.cols)
-                    filt = e if filt is None else ir.Call("and", (filt, e), BOOLEAN)
-                rel = RelPlan(dataclasses.replace(rel.node, filter=filt), rel.cols,
-                              rel.unique_sets)
-            return rel
-        if node.kind == "right":
-            # RIGHT OUTER = LEFT OUTER with flipped sides (the executor's
-            # outer machinery keeps PROBE rows), re-projected back to the
-            # original (left..., right...) channel order.  Round-4 invariant:
-            # right/full previously fell through to the inner-join transform
-            # and returned silently WRONG rows.
-            push, keep = [], []
-            for c in residual:
-                (push if self._resolves(c, left.cols) else keep).append(c)
-            for c in push:
-                e, _ = self.translate(c, left.cols)
-                left = RelPlan(P.Filter(left.node, e), left.cols,
-                               left.unique_sets)
-            rel = self._make_join("left", right, left,
-                                  [(be, pe) for pe, be in eqs])
-            if keep:
-                filt = None
-                for c in keep:
-                    e, _ = self.translate(c, rel.cols)
-                    filt = e if filt is None else ir.Call("and", (filt, e),
-                                                          BOOLEAN)
-                rel = RelPlan(dataclasses.replace(rel.node, filter=filt),
-                              rel.cols, rel.unique_sets)
-            probe_total = len(rel.node.left.schema.fields)
-            vis = list(left.cols) + list(right.cols)
-            exprs = tuple(
-                [ir.FieldRef(probe_total + i, c.type, c.name)
-                 for i, c in enumerate(left.cols)]
-                + [ir.FieldRef(i, c.type, c.name)
-                   for i, c in enumerate(right.cols)])
-            schema = Schema(tuple(Field(c.name, c.type) for c in vis))
-            dicts = tuple(c.dict for c in vis)
-            return RelPlan(P.Project(rel.node, exprs, schema, dicts),
-                           [dataclasses.replace(c) for c in vis], [])
-        if node.kind == "full":
-            # FULL OUTER = LEFT OUTER union-all the right side's unmatched
-            # rows padded with NULL left columns (reference planner models
-            # FULL directly; the union form reuses the left + anti machinery)
-            if residual:
-                raise SemanticError(
-                    "FULL OUTER JOIN with non-equi conditions not supported yet")
-            vis = list(left.cols) + list(right.cols)
-            schema = Schema(tuple(Field(c.name, c.type) for c in vis))
-            dicts = tuple(c.dict for c in vis)
-            left_rel = self._make_join("left", left, right, eqs)
-            pt = len(left_rel.node.left.schema.fields)
-            lexprs = tuple(
-                [ir.FieldRef(i, c.type, c.name)
-                 for i, c in enumerate(left.cols)]
-                + [ir.FieldRef(pt + i, c.type, c.name)
-                   for i, c in enumerate(right.cols)])
-            lproj = P.Project(left_rel.node, lexprs, schema, dicts)
-            anti = self._make_join("anti", right, left,
-                                   [(be, pe) for pe, be in eqs])
-            aexprs = tuple(
-                [ir.Constant(None, c.type) for c in left.cols]
-                + [ir.FieldRef(i, c.type, c.name)
-                   for i, c in enumerate(right.cols)])
-            aproj = P.Project(anti.node, aexprs, schema, dicts)
-            return RelPlan(P.Union((lproj, aproj), schema),
-                           [dataclasses.replace(c) for c in vis], [])
-        rel = self._make_join(node.kind, left, right, eqs)
-        out = rel.node
-        for c in residual:
-            e, _ = self.translate(c, rel.cols)
-            out = P.Filter(out, e)
-        return RelPlan(out, rel.cols, rel.unique_sets)
-
-    def _plan_relation(self, node) -> RelPlan:
-        if isinstance(node, A.TableRef):
-            name = node.name[-1]
-            if len(node.name) == 1:
-                # CTE / view expansion (reference: StatementAnalyzer WITH resolution +
-                # view expansion in analyzeView)
-                view = self.ctes.get(name) or getattr(self.engine, "views", {}).get(name)
-                if view is not None:
-                    cols, sub = view
-                    return self._plan_subquery_rel(sub, node.alias or name, cols)
-                mv = getattr(self.engine, "materialized_views", {}).get(name)
-                if mv is not None:
-                    # materialized views read their STORAGE table (results as
-                    # of the last refresh; reference: MV scan redirection)
-                    rel = self._plan_relation(A.TableRef(
-                        (mv["catalog"], mv["storage"]), node.alias or name))
-                    return rel
-            catalog, conn = self._resolve_table(node.name)
-            schema = conn.schema(name)
-            dicts = conn.dictionaries(name)
-            alias = node.alias or name
-            scan = P.TableScan(catalog, name, schema.names, schema)
-            cols = [ColumnInfo(alias, f.name, f.type, dicts.get(f.name))
-                    for f in schema.fields]
-            unique_sets = []
-            if hasattr(conn, "primary_key"):
-                try:
-                    pk = conn.primary_key(name)
-                    unique_sets.append(frozenset(schema.index(c) for c in pk))
-                except KeyError:
-                    pass
-            return self._apply_security_views(
-                RelPlan(scan, cols, unique_sets), catalog, name)
-        if isinstance(node, A.SubqueryRef):
-            return self._plan_subquery_rel(node.query, node.alias, node.columns)
-        if isinstance(node, A.MatchRecognizeRef):
-            return self._plan_match_recognize(node)
-        if isinstance(node, A.TableFunctionRef):
-            return self._plan_table_function(node)
-        raise SemanticError(f"unsupported relation {node}")
-
-    def _apply_security_views(self, rel: RelPlan, catalog: str,
-                              table: str) -> RelPlan:
-        """Row filters and column masks from access control (reference:
-        spi/security ViewExpression — SystemAccessControl.getRowFilters /
-        getColumnMasks, applied by StatementAnalyzer before the query sees the
-        table).  Expressions are SQL text evaluated in the table's scope; a
-        masked column's expression replaces it in a projection directly over
-        the scan, a row filter wraps the scan in a Filter."""
-        ac = getattr(self.engine, "access_control", None)
-        user = getattr(self.session, "user", "user")
-        if ac is None or not (hasattr(ac, "get_row_filter")
-                              or hasattr(ac, "get_column_masks")):
-            return rel
-        node, cols = rel.node, rel.cols
-        rf = ac.get_row_filter(user, catalog, table) \
-            if hasattr(ac, "get_row_filter") else None
-        if rf:
-            pred_ast = A.Parser(rf).parse_expr()
-            pred, _ = self._translate(pred_ast, cols)
-            node = P.Filter(node, pred)
-        masks = ac.get_column_masks(user, catalog, table) \
-            if hasattr(ac, "get_column_masks") else None
-        if masks:
-            exprs, out_dicts, new_cols = [], [], []
-            for i, c in enumerate(cols):
-                m = masks.get(c.name)
-                if m is None:
-                    exprs.append(ir.FieldRef(i, c.type, c.name))
-                    out_dicts.append(c.dict)
-                    new_cols.append(c)
-                else:
-                    e, d = self._translate(A.Parser(m).parse_expr(), cols)
-                    e = _coerce(e, c.type) if not c.type.is_string else e
-                    exprs.append(e)
-                    out_dicts.append(d)
-                    new_cols.append(ColumnInfo(c.alias, c.name, e.type, d))
-            schema = Schema(tuple(Field(c.name, e.type)
-                                  for c, e in zip(new_cols, exprs)))
-            node = P.Project(node, tuple(exprs), schema, tuple(out_dicts))
-            cols = new_cols
-        if node is rel.node:
-            return rel
-        # masked/filtered relations lose PK uniqueness guarantees conservatively
-        return RelPlan(node, cols, rel.unique_sets if not masks else [])
-
-    def _plan_table_function(self, node: A.TableFunctionRef) -> RelPlan:
-        """TABLE(fn(...)) invocations (reference:
-        spi/function/table/ConnectorTableFunction.java; sequence() mirrors
-        the built-in SequenceFunction)."""
-        fn = node.func
-
-        def lit_int(e, what):
-            neg = False
-            while isinstance(e, A.UnaryOp) and e.op == "negate":
-                neg = not neg
-                e = e.operand
-            if not isinstance(e, A.NumberLit) or "." in e.text \
-                    or "e" in e.text.lower():
-                raise SemanticError(f"sequence {what} must be an integer literal")
-            v = int(e.text)
-            return -v if neg else v
-
-        if fn.name == "sequence":
-            if not 2 <= len(fn.args) <= 3:
-                raise SemanticError("sequence(start, stop[, step])")
-            start = lit_int(fn.args[0], "start")
-            stop = lit_int(fn.args[1], "stop")
-            step = lit_int(fn.args[2], "step") if len(fn.args) > 2 else 1
-            if step == 0:
-                raise SemanticError("sequence step must not be zero")
-            n = max((stop - start) // step + 1, 0)
-            if n > (1 << 20):
-                raise SemanticError(
-                    f"sequence produces {n} rows (limit {1 << 20})")
-            col = node.column_aliases[0] if node.column_aliases \
-                else "sequential_number"
-            schema = Schema((Field(col, BIGINT),))
-            rows = tuple((start + i * step,) for i in range(n))
-            return RelPlan(P.Values(rows, schema),
-                           [ColumnInfo(node.alias, col, BIGINT, None)], [])
-        raise SemanticError(f"table function {fn.name} not supported")
-
-    def _plan_match_recognize(self, node: A.MatchRecognizeRef) -> RelPlan:
-        """reference: StatementAnalyzer's pattern-recognition analysis +
-        PatternRecognitionNode planning; see plan.MatchRecognize for the
-        supported subset."""
-        rel = self._plan_relation(node.input)
-        var_names = {v for el, _ in node.pattern
-                     for v in (el if isinstance(el, tuple) else (el,))}
-        for v, _ in node.defines:
-            if v not in var_names:
-                raise SemanticError(f"DEFINE variable {v} not in PATTERN")
-
-        def rewrite_tree(ast, fn):
-            """Apply fn top-down over every Node, recursing through nested
-            tuples too (CaseExpr.whens holds (cond, value) PAIRS)."""
-            def walk(v):
-                if isinstance(v, A.Node):
-                    out = fn(v)
-                    if out is not v:
-                        return out
-                    changed = {}
-                    for f in v.__dataclass_fields__:
-                        fv = getattr(v, f)
-                        nv = walk(fv)
-                        if nv is not fv:
-                            changed[f] = nv
-                    return dataclasses.replace(v, **changed) if changed else v
-                if isinstance(v, tuple):
-                    items = tuple(walk(x) for x in v)
-                    return items if any(a is not b for a, b in zip(items, v)) \
-                        else v
-                return v
-
-            return walk(ast)
-
-        def strip_vars(ast):
-            """b.price -> price (variable-qualified refs read the current row)."""
-            def fn(n):
-                if isinstance(n, A.Identifier) and len(n.parts) == 2 \
-                        and n.parts[0] in var_names:
-                    return A.Identifier((n.parts[1],))
-                return n
-
-            return rewrite_tree(ast, fn)
-
-        # PREV/NEXT navigation -> synthetic shifted channels appended to the
-        # sorted input (the reference evaluates navigation against the
-        # partition's row frame; shifting the sorted columns is the columnar
-        # equivalent)
-        nav: list = []
-        nav_cols: list = []
-
-        def extract_nav(ast):
-            def fn(node_ast):
-                if isinstance(node_ast, A.FuncCall) \
-                        and node_ast.name in ("prev", "next"):
-                    inner = strip_vars(node_ast.args[0])
-                    if not isinstance(inner, A.Identifier):
-                        raise SemanticError("PREV/NEXT take a plain column")
-                    ch = _resolve_column(inner, rel.cols)
-                    n = 1
-                    if len(node_ast.args) > 1:
-                        if not isinstance(node_ast.args[1], A.NumberLit):
-                            raise SemanticError(
-                                "PREV/NEXT offset must be a literal")
-                        n = int(node_ast.args[1].text)
-                    off = -n if node_ast.name == "prev" else n
-                    key = (ch, off)
-                    if key not in nav:
-                        nav.append(key)
-                        c = rel.cols[ch]
-                        nav_cols.append(ColumnInfo(None, f"#nav{len(nav)}",
-                                                   c.type, c.dict))
-                    return A.Identifier((f"#nav{nav.index(key) + 1}",))
-                return node_ast
-
-            return rewrite_tree(ast, fn)
-
-        define_asts = [(v, extract_nav(strip_vars(e))) for v, e in node.defines]
-        ext_cols = list(rel.cols) + nav_cols
-        defines = []
-        for v, e_ast in define_asts:
-            e, _ = self.translate(e_ast, ext_cols)
-            defines.append((v, e))
-
-        # v1 subset: partition keys are plain columns — a computed key would
-        # append a projection channel AFTER the nav channels were numbered,
-        # desynchronizing the DEFINE translation from the executor's layout
-        pchs = []
-        pnode = rel.node
-        for e_ast in node.partition_by:
-            e, _ = self.translate(e_ast, rel.cols)
-            if not isinstance(e, ir.FieldRef):
-                raise SemanticError(
-                    "MATCH_RECOGNIZE PARTITION BY must be plain columns")
-            pchs.append(e.index)
-        order = []
-        for s in node.order_by:
-            e, _ = self.translate(strip_vars(s.expr), rel.cols)
-            if not isinstance(e, ir.FieldRef):
-                raise SemanticError("MATCH_RECOGNIZE ORDER BY must be columns")
-            order.append(P.SortKey(e.index, s.ascending,
-                                   bool(s.nulls_first)))
-
-        measures = []
-        out_infos = []
-        for m_ast, m_name in node.measures:
-            kind, var, ch = self._measure_spec(m_ast, var_names, rel.cols)
-            c = rel.cols[ch]
-            measures.append((kind, var, ch, m_name))
-            out_infos.append(ColumnInfo(node.alias, m_name, c.type, c.dict))
-
-        all_rows = bool(getattr(node, "all_rows", False))
-        if all_rows:
-            # ALL ROWS PER MATCH: every matched input row, all input columns,
-            # plus the (FINAL-semantics) measures (reference:
-            # RowsPerMatch.ALL_SHOW_EMPTY minus empty-match output)
-            base_fields = [Field(c.name or f"c{i}", c.type)
-                           for i, c in enumerate(rel.cols)]
-            schema = Schema(tuple(base_fields)
-                            + tuple(Field(n, rel.cols[ch].type)
-                                    for _, _, ch, n in measures))
-            cols = [ColumnInfo(node.alias, c.name, c.type, c.dict)
-                    for c in rel.cols] + out_infos
-        else:
-            part_fields = [Field(rel.cols[ch].name or f"p{i}",
-                                 rel.cols[ch].type)
-                           for i, ch in enumerate(pchs)]
-            schema = Schema(tuple(part_fields)
-                            + tuple(Field(n, rel.cols[ch].type)
-                                    for _, _, ch, n in measures))
-            cols = [ColumnInfo(node.alias, rel.cols[ch].name,
-                               rel.cols[ch].type, rel.cols[ch].dict)
-                    for ch in pchs] + out_infos
-        mr = P.MatchRecognize(pnode, tuple(pchs), tuple(order), node.pattern,
-                              tuple(defines), tuple(nav), tuple(measures),
-                              schema, all_rows)
-        return RelPlan(mr, cols, [])
-
-    def _measure_spec(self, ast, var_names, cols):
-        """FIRST(v.col) | LAST(v.col) | v.col | col -> (kind, var, channel)."""
-        if isinstance(ast, A.FuncCall) and ast.name in ("first", "last") \
-                and len(ast.args) == 1:
-            inner = ast.args[0]
-            if isinstance(inner, A.Identifier) and len(inner.parts) == 2 \
-                    and inner.parts[0] in var_names:
-                ch = _resolve_column(A.Identifier((inner.parts[1],)), cols)
-                return ast.name, inner.parts[0], ch
-            if isinstance(inner, A.Identifier):
-                ch = _resolve_column(inner, cols)
-                return ast.name, None, ch
-        if isinstance(ast, A.Identifier):
-            if len(ast.parts) == 2 and ast.parts[0] in var_names:
-                ch = _resolve_column(A.Identifier((ast.parts[1],)), cols)
-                return "last", ast.parts[0], ch
-            return "col", None, _resolve_column(ast, cols)
-        raise SemanticError(
-            "MEASURES supports FIRST/LAST(var.col), var.col, or plain columns")
-
-    def _plan_subquery_rel(self, sub: A.Select, alias, columns=()) -> RelPlan:
-        saved = self.ctes
-        self.ctes = {**saved, **{name: (cols_, s) for name, cols_, s in sub.ctes}}
-        try:
-            return self._plan_subquery_rel_inner(sub, alias, columns)
-        finally:
-            self.ctes = saved
-
-    def _plan_subquery_rel_inner(self, sub: A.Select, alias, columns=()) -> RelPlan:
-        rel, out_names, _ = self._plan_select(sub)
-        plan_node = rel.node
-        if sub.order_by:
-            keys = []
-            for s in sub.order_by:
-                ch = self._resolve_output_channel(s.expr, out_names, [None] * len(out_names))
-                keys.append(P.SortKey(ch, s.ascending, bool(s.nulls_first)))
-            plan_node = P.Sort(plan_node, tuple(keys))
-        if sub.limit is not None:
-            plan_node = P.Limit(plan_node, sub.limit)
-        if columns:
-            if len(columns) != len(out_names):
-                raise SemanticError("column alias list length mismatch")
-            out_names = list(columns)
-        cols = [ColumnInfo(alias, n, c.type, c.dict)
-                for n, c in zip(out_names, rel.cols)]
-        return RelPlan(plan_node, cols)
-
-    def _resolve_table(self, name_parts) -> tuple:
-        """(catalog, connector) for a table name: qualified name wins, then the session
-        catalog, then any catalog exposing the table (reference: MetadataManager's
-        catalog resolution against the session)."""
-        name = name_parts[-1]
-        if len(name_parts) > 1:
-            if name_parts[0] not in self.engine.catalogs:
-                raise SemanticError(f"catalog {name_parts[0]} is not registered")
-            return name_parts[0], self.engine.catalogs[name_parts[0]]
-        cat = self.session.catalog or "tpch"
-        conn = self.engine.catalogs.get(cat)
-        if conn is not None and name in conn.tables():
-            return cat, conn
-        for cn, c in self.engine.catalogs.items():
-            if name in c.tables():
-                return cn, c
-        raise SemanticError(f"table {name} not found in any catalog")
-
-    def _estimate_stats(self, node, rel):
-        """RelStats for a base relation (reference: cost/StatsCalculator — scan
-        stats flow from connector TableStatistics; subqueries get unknowns)."""
-        from ..spi.statistics import connector_table_stats
-        from .stats import scan_stats, unknown_stats
-
-        if isinstance(node, A.TableRef) and isinstance(rel.node, P.TableScan):
-            try:
-                _, conn = self._resolve_table(node.name)
-                ts = connector_table_stats(conn, node.name[-1])
-                return scan_stats(ts, rel.node.columns)
-            except Exception:
-                pass
-        return unknown_stats(len(rel.cols))
-
-    def _match_equi(self, conjunct, left: RelPlan, right: RelPlan):
-        """a.x = b.y with sides in different relations -> (left_expr, right_expr)."""
-        if not (isinstance(conjunct, A.BinaryOp) and conjunct.op == "eq"):
-            return None
-        l_in_left = self._try_translate(conjunct.left, left.cols)
-        r_in_right = self._try_translate(conjunct.right, right.cols)
-        if l_in_left is not None and r_in_right is not None:
-            return (l_in_left, r_in_right)
-        l_in_right = self._try_translate(conjunct.left, right.cols)
-        r_in_left = self._try_translate(conjunct.right, left.cols)
-        if l_in_right is not None and r_in_left is not None:
-            return (r_in_left, l_in_right)
-        return None
-
-    def _make_cross_join(self, probe: RelPlan, build: RelPlan) -> RelPlan:
-        """Cross product: a constant-key equi join — every probe row matches every
-        build row through the multi-match expansion."""
-        one = ir.Constant(1, BIGINT)
-        return self._make_join("inner", probe, build, [(one, one)])
-
-    from .stats import PARTITIONED_JOIN_THRESHOLD  # one constant shared with
-    # the AddExchanges pass; the distributed executor's actual-size default
-    # is the matching runtime knob (DetermineJoinDistributionType)
-
-    def _join_distribution(self, build_rows) -> str:
-        """'replicated' | 'partitioned' | 'broadcast' (forced) from the session's
-        join_distribution_type + estimated build cardinality (reference:
-        iterative/rule/DetermineJoinDistributionType.java:51 — AUTOMATIC sizes
-        the decision from stats; explicit settings force it)."""
-        props = getattr(self.session, "properties", None) or {}
-        mode = str(props.get("join_distribution_type", "AUTOMATIC")).upper()
-        if mode == "BROADCAST":
-            return "broadcast"
-        if mode == "PARTITIONED":
-            return "partitioned"
-        if build_rows is not None and build_rows >= self.PARTITIONED_JOIN_THRESHOLD:
-            return "partitioned"
-        return "replicated"
-
-    def _make_join(self, kind, probe: RelPlan, build: RelPlan, eqs,
-                   filter_expr=None, build_rows=None, est_rows=None) -> RelPlan:
-        probe_node, build_node = probe.node, build.node
-        pkeys, bkeys = [], []
-        for pe, be in eqs:
-            t = common_super_type(pe.type, be.type)
-            pe = _coerce(pe, t)
-            be = _coerce(be, t)
-            pch, probe_node = _ensure_channel(probe_node, pe, probe.cols)
-            bch, build_node = _ensure_channel(build_node, be, build.cols)
-            pkeys.append(pch)
-            bkeys.append(bch)
-        # computed join keys append helper channels to either side: the runtime emits the
-        # full child schemas, so planner-side cols must cover them (anonymous, unresolvable)
-        probe_cols = list(probe.cols) + [ColumnInfo(None, "", f.type)
-                                         for f in probe_node.schema.fields[len(probe.cols):]]
-        build_cols = list(build.cols) + [ColumnInfo(None, "", f.type)
-                                         for f in build_node.schema.fields[len(build.cols):]]
-        schema = Schema(tuple(
-            [Field(f"l{i}", c.type) for i, c in enumerate(probe_cols)]
-            + [Field(f"r{i}", c.type) for i, c in enumerate(build_cols)]
-        ))
-        node = P.Join(kind, probe_node, build_node, tuple(pkeys), tuple(bkeys), schema,
-                      filter=filter_expr,
-                      distribution=self._join_distribution(build_rows),
-                      est_rows=est_rows)
-        cols = probe_cols + build_cols
-        # a many-to-one join preserves probe-row multiplicity -> probe unique sets survive
-        return RelPlan(node, cols, list(probe.unique_sets))
-
-    # ---------------------------------------------------------------- aggregation
-    def _plan_aggregation(self, q, rel: RelPlan, items, agg_calls):
-        if len(q.group_by) == 1 and isinstance(q.group_by[0], A.GroupingSets):
-            return self._plan_grouping_sets(q, rel, items, agg_calls, q.group_by[0])
-        group_asts = [self._resolve_group_ast(g, items, rel) for g in q.group_by]
-
-        key_exprs, key_dicts = [], []
-        for g in group_asts:
-            e, d = self.translate(g, rel.cols)
-            key_exprs.append(e)
-            key_dicts.append(d)
-
-        # dedup aggregate calls structurally
-        uniq_aggs = []
-        for a in agg_calls:
-            if a not in uniq_aggs:
-                uniq_aggs.append(a)
-
-        # DISTINCT aggregates (min/max ignore distinct): rewrite agg(distinct x) GROUP BY k
-        # into a pre-aggregation on (k, x) followed by plain agg(x) GROUP BY k (reference:
-        # iterative/rule/SingleDistinctAggregationToGroupBy.java)
-        distinct_aggs = [a for a in uniq_aggs
-                         if (a.distinct or a.name == "approx_distinct")
-                         and a.name not in ("min", "max")]
-        if distinct_aggs and (len(uniq_aggs) != len(distinct_aggs)
-                              or len({a.args for a in distinct_aggs}) != 1):
-            # mixed distinct/non-distinct (or several distinct args): compose
-            # per-part aggregations joined back on the group keys (reference:
-            # the MarkDistinct/MultipleDistinctAggregationToMarkDistinct
-            # family — re-planned as a join of single-purpose aggregations,
-            # each of which the engine already runs well)
-            return self._plan_mixed_distinct(q, rel, items, group_asts,
-                                             uniq_aggs, distinct_aggs)
-        if distinct_aggs:
-            arg_ast = distinct_aggs[0].args[0]
-            de, _ = self.translate(arg_ast, rel.cols)
-            proj_exprs = list(key_exprs) + [de]
-            proj_schema = Schema(tuple(Field(f"c{i}", e.type)
-                                       for i, e in enumerate(proj_exprs)))
-            proj = P.Project(rel.node, tuple(proj_exprs), proj_schema,
-                             tuple(key_dicts) + (None,))
-            dist = P.Aggregate(proj, tuple(range(len(proj_exprs))), (), proj_schema)
-            specs = []
-            for j, a in enumerate(uniq_aggs):
-                kind, _ = _agg_kind(a)
-                if kind == "approx_distinct":
-                    # approx_distinct(x) = count(distinct x) over the pre-aggregated
-                    # distinct groups (exact — a valid "approximation"; reference:
-                    # ApproximateCountDistinctAggregation returns estimates, ours
-                    # exercises the same distinct-rewrite machinery)
-                    kind = "count"
-                specs.append(P.AggSpec(kind, ir.FieldRef(len(key_exprs), de.type),
-                                       f"agg{j}", _agg_type(kind, de.type)))
-            agg_schema = Schema(tuple(
-                [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
-                + [Field(s.name, s.type) for s in specs]
-            ))
-            agg = P.Aggregate(dist, tuple(range(len(key_exprs))), tuple(specs), agg_schema)
-        else:
-            proj, key_exprs, key_dicts, uniq_aggs, specs = self._build_agg_projection(
-                rel, group_asts, agg_calls)
-            agg_schema = Schema(tuple(
-                [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
-                + [Field(s.name, s.type) for s in specs]
-            ))
-            agg = P.Aggregate(proj, tuple(range(len(key_exprs))), tuple(specs), agg_schema)
-        agg_cols = ([ColumnInfo(None, f"k{i}", e.type, d)
-                     for i, (e, d) in enumerate(zip(key_exprs, key_dicts))]
-                    + [ColumnInfo(None, s.name, s.type, None) for s in specs])
-        agg_unique = [frozenset(range(len(key_exprs)))] if key_exprs else []
-        return self._finish_aggregation(q, agg, items, group_asts, uniq_aggs,
-                                        agg_cols, agg_unique)
-
-    def _plan_mixed_distinct(self, q, rel: RelPlan, items, group_asts,
-                             uniq_aggs, distinct_aggs):
-        """count(distinct x) alongside plain aggregates (and/or several
-        distinct argument sets): each part — the non-distinct aggregates, and
-        one distinct-rewrite per argument — aggregates separately over the
-        same input, then the parts join back on the group keys (single-match:
-        keys are unique per part).  NULL group keys join via coalesce-to-
-        sentinel (IS NOT DISTINCT FROM semantics).  Reference:
-        MultipleDistinctAggregationToMarkDistinct + MarkDistinct planning."""
-        import numpy as np
-
-        nd_aggs = [a for a in uniq_aggs if a not in distinct_aggs]
-        darg_groups: list = []  # (args tuple, [agg asts])
-        for a in distinct_aggs:
-            for args, lst in darg_groups:
-                if args == a.args:
-                    lst.append(a)
-                    break
-            else:
-                darg_groups.append((a.args, [a]))
-
-        K = len(group_asts)
-        key_exprs, key_dicts = [], []
-        for g in group_asts:
-            e, d = self.translate(g, rel.cols)
-            key_exprs.append(e)
-            key_dicts.append(d)
-
-        parts = []  # (plan node, [agg asts], [result types])
-        if nd_aggs:
-            proj, _, _, nd_uniq, nd_specs = self._build_agg_projection(
-                rel, group_asts, nd_aggs)
-            schema = Schema(tuple(
-                [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
-                + [Field(s.name, s.type) for s in nd_specs]))
-            node = P.Aggregate(proj, tuple(range(K)), tuple(nd_specs), schema)
-            parts.append((node, list(nd_uniq), [s.type for s in nd_specs]))
-        for args, lst in darg_groups:
-            de, _ = self.translate(args[0], rel.cols)
-            pexprs = list(key_exprs) + [de]
-            pschema = Schema(tuple(Field(f"c{i}", e.type)
-                                   for i, e in enumerate(pexprs)))
-            proj = P.Project(rel.node, tuple(pexprs), pschema,
-                             tuple(key_dicts) + (None,))
-            dist = P.Aggregate(proj, tuple(range(len(pexprs))), (), pschema)
-            specs = []
-            for j, a in enumerate(lst):
-                kind, _ = _agg_kind(a)
-                if kind == "approx_distinct":
-                    kind = "count"
-                specs.append(P.AggSpec(kind, ir.FieldRef(K, de.type),
-                                       f"d{j}", _agg_type(kind, de.type)))
-            schema = Schema(tuple(
-                [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
-                + [Field(s.name, s.type) for s in specs]))
-            node = P.Aggregate(dist, tuple(range(K)), tuple(specs), schema)
-            parts.append((node, list(lst), [s.type for s in specs]))
-
-        def relplan(node):
-            cols = [ColumnInfo(None, f.name, f.type,
-                               key_dicts[i] if i < K else None)
-                    for i, f in enumerate(node.schema.fields)]
-            return RelPlan(node, cols, [frozenset(range(K))] if K else [])
-
-        base = relplan(parts[0][0])
-        part_start = [0]
-        for node, _, _ in parts[1:]:
-            rp = relplan(node)
-            if K == 0:
-                # the cross join rides a constant-key join, whose helper
-                # channels pad the probe side: the build payload starts at the
-                # JOIN node's probe width, not the pre-join width
-                base = self._make_cross_join(base, rp)
-                start = len(base.node.left.schema.fields)
-            else:
-                eqs = []
-                for i in range(K):
-                    t = base.cols[i].type
-                    if t.is_floating:
-                        raise SemanticError(
-                            "mixed distinct aggregates over floating group "
-                            "keys not supported")
-                    sent = -(1 << 62) + 7 \
-                        if np.dtype(t.dtype).itemsize >= 8 else -(1 << 30) + 7
-                    eqs.append((
-                        ir.Call("coalesce", (ir.FieldRef(i, t),
-                                             ir.Constant(sent, t)), t),
-                        ir.Call("coalesce", (ir.FieldRef(i, t),
-                                             ir.Constant(sent, t)), t)))
-                base = self._make_join("inner", base, rp, eqs)
-                start = len(base.node.left.schema.fields)
-            part_start.append(start)
-
-        lay_exprs = [ir.FieldRef(i, key_exprs[i].type) for i in range(K)]
-        agg_cols = [ColumnInfo(None, f"k{i}", key_exprs[i].type, key_dicts[i])
-                    for i in range(K)]
-        for a in uniq_aggs:
-            p, j = next((pi, lst.index(a)) for pi, (_, lst, _)
-                        in enumerate(parts) if a in lst)
-            t = parts[p][2][j]
-            lay_exprs.append(ir.FieldRef(part_start[p] + K + j, t))
-            agg_cols.append(ColumnInfo(None, f"a{len(agg_cols)}", t, None))
-        schema = Schema(tuple(Field(c.name, c.type) for c in agg_cols))
-        node = P.Project(base.node, tuple(lay_exprs), schema,
-                         tuple(c.dict for c in agg_cols))
-        return self._finish_aggregation(q, node, items, group_asts, uniq_aggs,
-                                        agg_cols,
-                                        [frozenset(range(K))] if K else [])
-
-    def _resolve_group_ast(self, g, items, rel: RelPlan):
-        """GROUP BY element resolution: ordinals and select-list aliases bind before
-        source columns (reference: StatementAnalyzer's groupingElement analysis)."""
-        if isinstance(g, A.NumberLit):
-            return items[int(g.text) - 1].expr
-        if isinstance(g, A.Identifier) and len(g.parts) == 1 and \
-                self._try_translate(g, rel.cols) is None:
-            match = [it.expr for it in items if it.alias == g.parts[0]]
-            if not match:
-                raise SemanticError(f"cannot resolve group key {g}")
-            return match[0]
-        return g
-
-    def _build_agg_projection(self, rel: RelPlan, key_asts, agg_calls):
-        """(proj node, key_exprs, key_dicts, uniq_aggs, specs): the shared input
-        projection of group keys + aggregate arguments."""
-        key_exprs, key_dicts = [], []
-        for g in key_asts:
-            e, d = self.translate(g, rel.cols)
-            key_exprs.append(e)
-            key_dicts.append(d)
-        uniq_aggs = []
-        for a in agg_calls:
-            if a not in uniq_aggs:
-                uniq_aggs.append(a)
-        proj_exprs = list(key_exprs)
-        specs = []
-        for j, a in enumerate(uniq_aggs):
-            kind, arg_ast = _agg_kind(a)
-            if arg_ast is None:
-                specs.append(P.AggSpec("count_star", None, f"agg{j}", BIGINT))
-            else:
-                e, _ = self.translate(arg_ast, rel.cols)
-                if kind in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
-                    # sums of raw scaled-decimal ints would square the scale;
-                    # variance is computed over double values
-                    e = _coerce(e, DOUBLE)
-                param = None
-                if kind == "approx_percentile":
-                    if len(a.args) < 2:
-                        raise SemanticError(
-                            "approx_percentile(x, percentile) needs a "
-                            "percentile argument")
-                    pe, _ = self.translate(a.args[1], rel.cols)
-                    if not isinstance(pe, ir.Constant):
-                        raise SemanticError(
-                            "approx_percentile's percentile must be constant")
-                    param = float(pe.value)
-                    if pe.type.is_decimal:
-                        param /= 10 ** pe.type.scale
-                    if not 0.0 <= param <= 1.0:
-                        raise SemanticError("percentile must be in [0, 1]")
-                if kind == "listagg":
-                    if not e.type.is_string:
-                        raise SemanticError("listagg expects a string argument")
-                    sep = ", "
-                    if len(a.args) > 1:
-                        if not isinstance(a.args[1], A.StringLit):
-                            raise SemanticError(
-                                "listagg separator must be a string literal")
-                        sep = a.args[1].value
-                    order_ch, asc = None, True
-                    if a.within_group:
-                        si = a.within_group[0]
-                        oe, _ = self.translate(si.expr, rel.cols)
-                        order_ch = len(proj_exprs) + 1
-                        asc = si.ascending
-                    param = (sep, order_ch, asc)
-                ch = len(proj_exprs)
-                proj_exprs.append(e)
-                if kind == "listagg" and param[1] is not None:
-                    proj_exprs.append(oe)
-                specs.append(P.AggSpec(kind, ir.FieldRef(ch, e.type), f"agg{j}",
-                                       _agg_type(kind, e.type), param=param))
-        proj_schema = Schema(tuple(Field(f"c{i}", e.type)
-                                   for i, e in enumerate(proj_exprs)))
-        proj = P.Project(rel.node, tuple(proj_exprs), proj_schema,
-                         tuple(key_dicts) + tuple(
-                             None for _ in range(len(proj_exprs) - len(key_exprs))))
-        return proj, key_exprs, key_dicts, uniq_aggs, specs
-
-    def _finish_aggregation(self, q, node, items, group_asts, uniq_aggs, agg_cols,
-                            agg_unique):
-        """Shared tail: HAVING + output projection over (group keys + agg calls)."""
-        post = _PostAggScope(group_asts, uniq_aggs, agg_cols, self)
-        if q.having is not None:
-            node = P.Filter(node, post.translate(q.having))
-        out_exprs, out_names = [], []
-        for i, it in enumerate(items):
-            out_exprs.append(post.translate(it.expr))
-            out_names.append(it.alias or _derive_name(it.expr, i))
-        out_schema = Schema(tuple(Field(n, e.type) for n, e in zip(out_names, out_exprs)))
-        cols = []
-        for n, e in zip(out_names, out_exprs):
-            d = None
-            if isinstance(e, ir.FieldRef):
-                d = agg_cols[e.index].dict
-            cols.append(ColumnInfo(None, n, e.type, d))
-        node = P.Project(node, tuple(out_exprs), out_schema,
-                         tuple(c.dict for c in cols))
-        # remap unique key channels through the output projection
-        out_unique = []
-        for u in agg_unique:
-            mapped = [i for i, e in enumerate(out_exprs)
-                      if isinstance(e, ir.FieldRef) and e.index in u]
-            if len({out_exprs[i].index for i in mapped}) == len(u):
-                out_unique.append(frozenset(mapped))
-        return RelPlan(node, cols, out_unique), out_names, [it.expr for it in items]
-
-    def _plan_grouping_sets(self, q, rel: RelPlan, items, agg_calls, gs):
-        """GROUP BY ROLLUP/CUBE/GROUPING SETS: one aggregation per set over a shared
-        input projection, projected to a uniform layout (absent keys become typed
-        NULLs) and UNION ALLed (reference: GroupIdOperator feeding one aggregation;
-        the union-of-aggregations form is equivalent and keeps each table small)."""
-        if gs.kind == "rollup":
-            all_asts = [self._resolve_group_ast(g, items, rel) for g in gs.exprs]
-            sets = [tuple(range(k)) for k in range(len(all_asts), -1, -1)]
-        elif gs.kind == "cube":
-            all_asts = [self._resolve_group_ast(g, items, rel) for g in gs.exprs]
-            n = len(all_asts)
-            sets = [tuple(i for i in range(n) if mask >> i & 1)
-                    for mask in range((1 << n) - 1, -1, -1)]
-        else:
-            all_asts, sets = [], []
-            for s in gs.sets:
-                idxs = []
-                for e in s:
-                    e = self._resolve_group_ast(e, items, rel)
-                    if e not in all_asts:
-                        all_asts.append(e)
-                    idxs.append(all_asts.index(e))
-                sets.append(tuple(idxs))
-
-        proj, key_exprs, key_dicts, uniq_aggs, specs = self._build_agg_projection(
-            rel, all_asts, agg_calls)
-        if any(a.distinct for a in uniq_aggs):
-            raise SemanticError("DISTINCT aggregates with grouping sets not supported")
-
-        # grouping(c1, ..., cm) is a CONSTANT per grouping set (bit j set when
-        # argument j is NOT grouped in that set — reference:
-        # operator/GroupIdOperator + the grouping() rewrite): collect the
-        # calls, ride one extra union channel each, resolve in _PostAggScope
-        grouping_calls: list = []
-
-        def collect_grouping(ast):
-            if isinstance(ast, A.FuncCall) and ast.name == "grouping":
-                if ast not in grouping_calls:
-                    grouping_calls.append(ast)
-                return
-            for f in dataclasses.fields(ast) if dataclasses.is_dataclass(ast) \
-                    else ():
-                v = getattr(ast, f.name)
-                if isinstance(v, A.Node):
-                    collect_grouping(v)
-                elif isinstance(v, tuple):
-                    for x in v:
-                        if isinstance(x, A.Node):
-                            collect_grouping(x)
-
-        for it in items:
-            collect_grouping(it.expr)
-        if q.having is not None:
-            collect_grouping(q.having)
-        gcall_idxs = []
-        for gc in grouping_calls:
-            idxs = []
-            for arg in gc.args:
-                a = self._resolve_group_ast(arg, items, rel)
-                if a not in all_asts:
-                    raise SemanticError(
-                        "grouping() arguments must be grouping columns")
-                idxs.append(all_asts.index(a))
-            gcall_idxs.append(idxs)
-
-        uni_schema = Schema(tuple(
-            [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
-            + [Field(s.name, s.type) for s in specs]
-            + [Field(f"g{j}", BIGINT) for j in range(len(grouping_calls))]))
-        branches = []
-        for s in sets:
-            schema_s = Schema(tuple(
-                [Field(f"k{i}", key_exprs[i].type) for i in s]
-                + [Field(sp.name, sp.type) for sp in specs]))
-            agg_n = P.Aggregate(proj, s, tuple(specs), schema_s)
-            uni_exprs = []
-            for i, ke in enumerate(key_exprs):
-                if i in s:
-                    uni_exprs.append(ir.FieldRef(s.index(i), ke.type))
-                else:
-                    uni_exprs.append(ir.Constant(None, ke.type))
-            for j, sp in enumerate(specs):
-                uni_exprs.append(ir.FieldRef(len(s) + j, sp.type))
-            for idxs in gcall_idxs:
-                m = len(idxs)
-                val = sum(1 << (m - 1 - j)
-                          for j, ki in enumerate(idxs) if ki not in s)
-                uni_exprs.append(ir.Constant(val, BIGINT))
-            branches.append(P.Project(agg_n, tuple(uni_exprs), uni_schema,
-                                      tuple(key_dicts)
-                                      + tuple(None for _ in specs)
-                                      + tuple(None for _ in grouping_calls)))
-        node = P.Union(tuple(branches), uni_schema)
-        agg_cols = ([ColumnInfo(None, f"k{i}", e.type, d)
-                     for i, (e, d) in enumerate(zip(key_exprs, key_dicts))]
-                    + [ColumnInfo(None, sp.name, sp.type, None) for sp in specs]
-                    + [ColumnInfo(None, f"g{j}", BIGINT, None)
-                       for j in range(len(grouping_calls))])
-        return self._finish_aggregation(q, node, items, all_asts,
-                                        list(uniq_aggs) + grouping_calls,
-                                        agg_cols, [])
-
-
-
-class _PostAggScope:
-    """Rewrites post-aggregation expressions over (group keys + agg calls) channels."""
-
-    def __init__(self, group_asts, agg_asts, agg_cols, planner):
-        self.group_asts = group_asts
-        self.agg_asts = agg_asts
-        self.agg_cols = agg_cols
-        self.planner = planner
-
-    def translate(self, ast) -> ir.Expr:
-        for i, g in enumerate(self.group_asts):
-            if ast == g:
-                c = self.agg_cols[i]
-                return ir.FieldRef(i, c.type, c.name)
-        for j, a in enumerate(self.agg_asts):
-            if ast == a:
-                ch = len(self.group_asts) + j
-                c = self.agg_cols[ch]
-                return ir.FieldRef(ch, c.type, c.name)
-        # recurse structurally
-        if isinstance(ast, A.BinaryOp):
-            l = self.translate(ast.left)
-            r = self.translate(ast.right)
-            if ast.op in ("and", "or"):
-                return ir.Call(ast.op, (l, r), BOOLEAN)
-            if ast.op in ("eq", "neq", "lt", "lte", "gt", "gte"):
-                t = common_super_type(l.type, r.type)
-                return ir.Call(ast.op, (_coerce(l, t), _coerce(r, t)), BOOLEAN)
-            return _arith(ast.op, l, r)
-        if isinstance(ast, A.NumberLit):
-            return _literal_number(ast.text)
-        if isinstance(ast, A.UnaryOp) and ast.op == "negate":
-            e = self.translate(ast.operand)
-            return ir.Call("negate", (e,), e.type)
-        if isinstance(ast, A.UnaryOp) and ast.op == "not":
-            return ir.Call("not", (self.translate(ast.operand),), BOOLEAN)
-        if isinstance(ast, A.Between):
-            # HAVING count(*) BETWEEN a AND b and friends: desugar over the
-            # translated aggregate channel
-            v = self.translate(ast.value)
-            lo, hi = self.translate(ast.low), self.translate(ast.high)
-            t = common_super_type(v.type, common_super_type(lo.type, hi.type))
-            cond = ir.Call("and", (
-                ir.Call("gte", (_coerce(v, t), _coerce(lo, t)), BOOLEAN),
-                ir.Call("lte", (_coerce(v, t), _coerce(hi, t)), BOOLEAN)),
-                BOOLEAN)
-            return ir.Call("not", (cond,), BOOLEAN) if ast.negated else cond
-        if isinstance(ast, A.InList):
-            v = self.translate(ast.value)
-            cond = None
-            for item in ast.items:
-                x = self.translate(item)
-                t = common_super_type(v.type, x.type)
-                eq = ir.Call("eq", (_coerce(v, t), _coerce(x, t)), BOOLEAN)
-                cond = eq if cond is None else ir.Call("or", (cond, eq),
-                                                       BOOLEAN)
-            if cond is None:
-                cond = ir.Constant(False, BOOLEAN)
-            return ir.Call("not", (cond,), BOOLEAN) if ast.negated else cond
-        if isinstance(ast, A.IsNull):
-            v = self.translate(ast.value)
-            cond = ir.Call("is_null", (v,), BOOLEAN)
-            return ir.Call("not", (cond,), BOOLEAN) if ast.negated else cond
-        if isinstance(ast, A.CaseExpr) and ast.operand is None:
-            whens = [(self.translate(c), self.translate(v))
-                     for c, v in ast.whens]
-            default = self.translate(ast.default) \
-                if ast.default is not None else None
-            t = whens[0][1].type
-            for _, v in whens[1:]:
-                t = common_super_type(t, v.type)
-            if default is not None:
-                t = common_super_type(t, default.type)
-            out = _coerce(default, t) if default is not None \
-                else ir.Constant(None, t)
-            for c, v in reversed(whens):
-                out = ir.Call("if", (c, _coerce(v, t), out), t)
-            return out
-        if isinstance(ast, A.Cast):
-            return _coerce(self.translate(ast.value), _type_from_name(ast.type_name, ast.params))
-        if isinstance(ast, A.ScalarSubquery):
-            return self.planner._eager_scalar(ast.query)
-        if isinstance(ast, A.FuncCall) and len(ast.args) == 1 \
-                and ast.name in ("exp", "ln", "sqrt", "abs", "floor", "ceil",
-                                 "round", "sign", "log10", "log2"):
-            # scalar math over aggregate results (sqrt(variance),
-            # exp(avg(ln)) from the geometric_mean rewrite, ...)
-            e = self.translate(ast.args[0])
-            if ast.name in ("abs", "round", "sign"):
-                return ir.Call(ast.name, (e,), e.type)
-            return ir.Call(ast.name, (_coerce(e, DOUBLE),), DOUBLE)
-        if isinstance(ast, A.FuncCall) and ast.name in ("power", "pow") \
-                and len(ast.args) == 2:
-            a = _coerce(self.translate(ast.args[0]), DOUBLE)
-            b = _coerce(self.translate(ast.args[1]), DOUBLE)
-            return ir.Call("power", (a, b), DOUBLE)
-        if isinstance(ast, A.FuncCall) and ast.name == "coalesce" \
-                and ast.args:
-            args = [self.translate(a) for a in ast.args]
-            t = args[0].type
-            for a in args[1:]:
-                t = common_super_type(t, a.type)
-            return ir.Call("coalesce", tuple(_coerce(a, t) for a in args), t)
-        if isinstance(ast, A.FuncCall) and ast.name == "nullif" \
-                and len(ast.args) == 2:
-            # the statistical-aggregate finalizers divide by nullif(n, 0)
-            a = self.translate(ast.args[0])
-            b = self.translate(ast.args[1])
-            t = common_super_type(a.type, b.type)
-            return ir.Call("nullif", (_coerce(a, t), _coerce(b, t)), t)
-        raise SemanticError(f"expression must appear in GROUP BY: {ast}")
-
-
-_STATS2_AGGS = {"covar_pop", "covar_samp", "corr", "regr_slope",
-                "regr_intercept", "regr_count", "regr_avgx", "regr_avgy",
-                "regr_sxx", "regr_syy", "regr_sxy", "regr_r2"}
-_AGG_SUGAR = {"count_if", "geometric_mean", "skewness", "kurtosis"} \
-    | _STATS2_AGGS
-
-
-def _stats2_rewrite(name: str, y: A.Node, x: A.Node) -> A.Node:
-    """Two-argument statistical aggregates decomposed into MOMENT SUMS over
-    pairwise-non-null rows + a finalize expression (reference:
-    operator/aggregation/ CovarianceAggregation / RegressionAggregation /
-    CorrelationAggregation keep the same running moments in their state; on
-    TPU the moments are plain sum/count aggregates the scan-fused partial
-    machinery already distributes, and the finalize is a scalar expression).
-
-    Signature order matches the reference: f(y, x) — y dependent, x
-    independent (AggregationUtils.java's y/x naming)."""
-    pair = A.BinaryOp("and", A.IsNull(y, True), A.IsNull(x, True))
-
-    def when(v):
-        return A.CaseExpr(None, ((pair, v),), None)
-
-    def dbl(e):
-        return A.Cast(e, "double")
-
-    xd, yd = dbl(x), dbl(y)
-    n = A.Cast(A.FuncCall("count", (when(A.NumberLit("1")),)), "double")
-    sx = A.FuncCall("sum", (when(xd),))
-    sy = A.FuncCall("sum", (when(yd),))
-    sxy = A.FuncCall("sum", (when(A.BinaryOp("multiply", xd, yd)),))
-    sxx = A.FuncCall("sum", (when(A.BinaryOp("multiply", xd, xd)),))
-    syy = A.FuncCall("sum", (when(A.BinaryOp("multiply", yd, yd)),))
-
-    def sub(a, b):
-        return A.BinaryOp("subtract", a, b)
-
-    def mul(a, b):
-        return A.BinaryOp("multiply", a, b)
-
-    def div(a, b):
-        # NULL on a zero denominator (SQL contract: undefined moments = NULL)
-        return A.BinaryOp("divide", a, A.FuncCall("nullif", (b, A.NumberLit("0"))))
-
-    c_sxy = sub(sxy, div(mul(sx, sy), n))  # n*cov_pop
-    c_sxx = sub(sxx, div(mul(sx, sx), n))  # n*var_pop(x)
-    c_syy = sub(syy, div(mul(sy, sy), n))  # n*var_pop(y)
-    if name == "regr_count":
-        return A.FuncCall("count", (when(A.NumberLit("1")),))
-    if name == "regr_avgx":
-        return div(sx, n)
-    if name == "regr_avgy":
-        return div(sy, n)
-    if name == "regr_sxx":
-        return c_sxx
-    if name == "regr_syy":
-        return c_syy
-    if name == "regr_sxy":
-        return c_sxy
-    if name == "covar_pop":
-        return div(c_sxy, n)
-    if name == "covar_samp":
-        return div(c_sxy, sub(n, A.NumberLit("1")))
-    if name == "regr_slope":
-        return div(c_sxy, c_sxx)
-    if name == "regr_intercept":
-        return div(sub(sy, mul(div(c_sxy, c_sxx), sx)), n)
-    if name == "corr":
-        return div(c_sxy, A.FuncCall("sqrt", (mul(c_sxx, c_syy),)))
-    if name == "regr_r2":
-        # r² = corr², except a CONSTANT dependent variable (var(y)=0 with
-        # var(x)>0) is a perfect fit: 1.0 (SQL contract); var(x)=0 stays NULL
-        # through the nullif-guarded division
-        r = div(c_sxy, A.FuncCall("sqrt", (mul(c_sxx, c_syy),)))
-        # "var(y)=0" must tolerate catastrophic cancellation in syy - sy²/n,
-        # but ONLY at the float64 rounding floor (~20 ulp of the raw second
-        # moment): a looser bound (1e-12) fabricated perfect fits for data
-        # with mean/stddev beyond ~1e6 (epoch millis, large ids)
-        const_y = A.BinaryOp(
-            "and",
-            A.BinaryOp("lte", c_syy, mul(A.NumberLit("4e-15"), syy)),
-            A.BinaryOp("gt", c_sxx, mul(A.NumberLit("4e-15"), sxx)))
-        return A.CaseExpr(None, ((const_y, A.NumberLit("1.0")),), mul(r, r))
-    raise SemanticError(f"unknown statistical aggregate {name}")
-
-
-def _moments_rewrite(name: str, x: A.Node) -> A.Node:
-    """skewness/kurtosis from raw moments (reference:
-    operator/aggregation/CentralMomentsAggregation — same moments, here as
-    plain distributable sums + a finalize expression)."""
-    xd = A.Cast(x, "double")
-    n = A.Cast(A.FuncCall("count", (x,)), "double")
-    s1 = A.FuncCall("sum", (xd,))
-    s2 = A.FuncCall("sum", (A.BinaryOp("multiply", xd, xd),))
-    s3 = A.FuncCall("sum", (A.BinaryOp("multiply", A.BinaryOp("multiply", xd, xd), xd),))
-
-    def div(a, b):
-        return A.BinaryOp("divide", a, A.FuncCall("nullif", (b, A.NumberLit("0"))))
-
-    mean = div(s1, n)
-    m2 = A.BinaryOp("subtract", div(s2, n), A.BinaryOp("multiply", mean, mean))  # var_pop
-    if name == "skewness":
-        # E[x³] - 3·mean·E[x²] + 2·mean³, normalized by var_pop^{3/2}
-        ex3 = div(s3, n)
-        ex2 = div(s2, n)
-        m3 = A.BinaryOp(
-            "subtract",
-            A.BinaryOp("add", ex3,
-                       A.BinaryOp("multiply", A.NumberLit("2.0"),
-                                  A.BinaryOp("multiply", mean, A.BinaryOp(
-                                      "multiply", mean, mean)))),
-            A.BinaryOp("multiply", A.NumberLit("3.0"), A.BinaryOp("multiply", mean, ex2)))
-        return div(m3, A.FuncCall(
-            "power", (m2, A.NumberLit("1.5"))))
-    if name == "kurtosis":
-        x2 = A.BinaryOp("multiply", xd, xd)
-        s4 = A.FuncCall("sum", (A.BinaryOp("multiply", x2, x2),))
-        ex4, ex3, ex2 = div(s4, n), div(s3, n), div(s2, n)
-        m4 = A.BinaryOp(
-            "subtract",
-            A.BinaryOp(
-                "add", ex4,
-                A.BinaryOp(
-                    "subtract",
-                    A.BinaryOp("multiply", A.NumberLit("6.0"),
-                               A.BinaryOp("multiply", A.BinaryOp("multiply", mean, mean),
-                                          ex2)),
-                    A.BinaryOp("multiply", A.NumberLit("3.0"),
-                               A.BinaryOp("multiply", A.BinaryOp("multiply", mean, mean),
-                                          A.BinaryOp("multiply", mean, mean))))),
-            A.BinaryOp("multiply", A.NumberLit("4.0"), A.BinaryOp("multiply", mean, ex3)))
-        # excess-kurtosis-free definition (the reference's kurtosis):
-        # n*m4/m2² - 3 with the sample correction folded by the caller; we
-        # return the population kurtosis m4/m2² (documented deviation)
-        return div(m4, A.BinaryOp("multiply", m2, m2))
-    raise SemanticError(f"unknown moment aggregate {name}")
-
-
-def _rewrite_agg_sugar(node):
-    """Aggregate sugar rewrites to supported compositions (reference:
-    operator/aggregation/CountIfAggregation, GeometricMeanAggregations,
-    CovarianceAggregation family — all reduce to existing aggregates):
-      count_if(x)       -> sum(CASE WHEN x THEN 1 ELSE 0 END)
-      geometric_mean(x) -> exp(avg(ln(x)))
-      covar_/regr_/corr -> moment sums + finalize (_stats2_rewrite)
-      skewness/kurtosis -> raw moments + finalize (_moments_rewrite)
-    Deterministic over frozen ASTs, so repeated rewrites of equal expressions
-    stay structurally equal (the post-aggregation scope matches by equality)."""
-    if isinstance(node, A.FuncCall) and node.name in _AGG_SUGAR:
-        args = tuple(_rewrite_agg_sugar(a) for a in node.args)
-        if node.name == "count_if" and len(args) == 1:
-            # coalesce: count_if of ZERO rows is 0 (a count), while the
-            # underlying sum over an empty group is SQL NULL
-            return A.FuncCall("coalesce", (A.FuncCall("sum", (A.CaseExpr(
-                None, ((args[0], A.NumberLit("1")),), A.NumberLit("0")),)),
-                A.NumberLit("0")))
-        if node.name == "geometric_mean" and len(args) == 1:
-            return A.FuncCall("exp", (A.FuncCall(
-                "avg", (A.FuncCall("ln", (args[0],)),)),))
-        if node.name in _STATS2_AGGS and len(args) == 2:
-            return _stats2_rewrite(node.name, args[0], args[1])
-        if node.name in ("skewness", "kurtosis") and len(args) == 1:
-            return _moments_rewrite(node.name, args[0])
-        return dataclasses.replace(node, args=args)
-    if dataclasses.is_dataclass(node) and not isinstance(node, type):
-        changes = {}
-        for f in dataclasses.fields(node):
-            v = getattr(node, f.name)
-            nv = _rewrite_sugar_any(v)
-            if nv is not v:
-                changes[f.name] = nv
-        return dataclasses.replace(node, **changes) if changes else node
-    return node
-
-
-def _rewrite_sugar_any(v):
-    if isinstance(v, tuple):
-        out = tuple(_rewrite_sugar_any(x) for x in v)
-        return v if out == v else out
-    if dataclasses.is_dataclass(v) and not isinstance(v, type):
-        return _rewrite_agg_sugar(v)
-    return v
-
-
-def _rewrite_agg_sugar_query(q):
-    """Rewrite sugar in the query's own expressions (items/having/order_by);
-    subqueries rewrite when their own planning reaches _plan_select."""
-    items = tuple(dataclasses.replace(it, expr=_rewrite_agg_sugar(it.expr))
-                  for it in q.items)
-    having = None if q.having is None else _rewrite_agg_sugar(q.having)
-    order_by = tuple(dataclasses.replace(s, expr=_rewrite_agg_sugar(s.expr))
-                     for s in q.order_by)
-    if items == q.items and having == q.having and order_by == q.order_by:
-        return q
-    return dataclasses.replace(q, items=items, having=having,
-                               order_by=order_by)
-
-
-def _collect_aggs(ast, out: list):
-    if isinstance(ast, A.FuncCall) and ast.name in AGG_FUNCS:
-        out.append(ast)
-        return
-    if isinstance(ast, (A.ScalarSubquery, A.InSubquery, A.Exists, A.SubqueryRef, A.Select,
-                        A.WindowCall)):
-        return  # subquery scopes own their aggregates; sum() OVER is a window, not an agg
-    for f in dataclasses.fields(ast) if dataclasses.is_dataclass(ast) else ():
-        v = getattr(ast, f.name)
-        if isinstance(v, A.Node):
-            _collect_aggs(v, out)
-        elif isinstance(v, tuple):
-            for x in v:
-                if isinstance(x, A.Node):
-                    _collect_aggs(x, out)
-                elif isinstance(x, tuple):
-                    for y in x:
-                        if isinstance(y, A.Node):
-                            _collect_aggs(y, out)
-
-
-def _collect_windows(ast, out: list):
-    if isinstance(ast, A.WindowCall):
-        out.append(ast)
-        return
-    if isinstance(ast, (A.ScalarSubquery, A.InSubquery, A.Exists, A.SubqueryRef, A.Select)):
-        return
-    for f in dataclasses.fields(ast) if dataclasses.is_dataclass(ast) else ():
-        v = getattr(ast, f.name)
-        if isinstance(v, A.Node):
-            _collect_windows(v, out)
-        elif isinstance(v, tuple):
-            for x in v:
-                if isinstance(x, A.Node):
-                    _collect_windows(x, out)
-
-
-def _replace_nodes(ast, mapping: dict):
-    """Structurally rebuild an AST with ``mapping`` substitutions (frozen
-    dataclasses).  Recurses through NESTED tuples too — CaseExpr.whens holds
-    (cond, value) pairs, so a substitution target can sit two tuples deep."""
-    if isinstance(ast, tuple):
-        nv = tuple(_replace_nodes(x, mapping) for x in ast)
-        return ast if nv == ast else nv
-    if not dataclasses.is_dataclass(ast):
-        return ast
-    if ast in mapping:
-        return mapping[ast]
-    changes = {}
-    for f in dataclasses.fields(ast):
-        v = getattr(ast, f.name)
-        if isinstance(v, (A.Node, tuple)):
-            nv = _replace_nodes(v, mapping)
-            if nv is not v and nv != v:
-                changes[f.name] = nv
-    return dataclasses.replace(ast, **changes) if changes else ast
-
-
-_AGG_ALIASES = {"every": "bool_and", "any_value": "arbitrary",
-                "variance": "var_samp", "stddev": "stddev_samp"}
-
-
-def _agg_kind(ast: A.FuncCall):
-    name = _AGG_ALIASES.get(ast.name, ast.name)
-    if name == "count":
-        if not ast.args or isinstance(ast.args[0], A.Star):
-            return "count_star", None
-        return "count", ast.args[0]
-    return name, ast.args[0]
-
-
-def _agg_type(kind: str, in_type: Type) -> Type:
-    if kind in ("count", "count_star", "approx_distinct"):
-        return BIGINT
-    if kind == "sum":
-        if isinstance(in_type, DecimalType):
-            # reference: sum(decimal(p,s)) -> decimal(38,s)
-            # (DecimalSumAggregation with Int128 state); the two-limb
-            # accumulators make the wide sum exact
-            return DecimalType.of(38, in_type.scale)
-        return DOUBLE if in_type.is_floating else BIGINT
-    if kind == "avg":
-        if isinstance(in_type, DecimalType):
-            return in_type
-        return DOUBLE
-    if kind in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
-        return DOUBLE
-    if kind in ("bool_and", "bool_or"):
-        return BOOLEAN
-    if kind == "listagg":
-        return VarcharType.of(None)
-    return in_type  # min/max/arbitrary/approx_percentile
-
-
-def _split_conjuncts(where) -> list:
-    """AND-split, factoring conjuncts common to every OR branch out of ORs (needed for
-    Q19-style `(k = j and ...) or (k = j and ...)` so the equi-join condition surfaces;
-    reference: ExtractCommonPredicatesExpressionRewriter)."""
-    if where is None:
-        return []
-    if isinstance(where, A.BinaryOp) and where.op == "and":
-        return _split_conjuncts(where.left) + _split_conjuncts(where.right)
-    if isinstance(where, A.BinaryOp) and where.op == "or":
-        branches = _split_disjuncts(where)
-        branch_conjs = [_split_conjuncts(b) for b in branches]
-        common = [c for c in branch_conjs[0] if all(c in bc for bc in branch_conjs[1:])]
-        if common:
-            rest_branches = []
-            for bc in branch_conjs:
-                rest = [c for c in bc if c not in common]
-                rest_branches.append(_and_all(rest) or A.BoolLit(True))
-            out = list(common)
-            if not all(isinstance(r, A.BoolLit) and r.value for r in rest_branches):
-                rem = rest_branches[0]
-                for r in rest_branches[1:]:
-                    rem = A.BinaryOp("or", rem, r)
-                out.append(rem)
-            return out
-    return [where]
-
-
-def _split_disjuncts(e) -> list:
-    if isinstance(e, A.BinaryOp) and e.op == "or":
-        return _split_disjuncts(e.left) + _split_disjuncts(e.right)
-    return [e]
-
-
-def _and_all(conjs):
-    if not conjs:
-        return None
-    out = conjs[0]
-    for c in conjs[1:]:
-        out = A.BinaryOp("and", out, c)
-    return out
-
-
-def _has_subquery(ast) -> bool:
-    if isinstance(ast, (A.InSubquery, A.Exists, A.ScalarSubquery)):
-        return True
-    if isinstance(ast, A.BinaryOp) and ast.op in ("eq", "neq", "lt", "lte", "gt", "gte"):
-        # comparison against a subquery is a subquery conjunct ONLY if one side is one
-        return isinstance(ast.left, A.ScalarSubquery) or isinstance(ast.right, A.ScalarSubquery)
-    if isinstance(ast, A.UnaryOp) and ast.op == "not":
-        return _has_subquery(ast.operand)
-    return False
-
-
-def _flip_cmp(op: str) -> str:
-    return {"eq": "eq", "neq": "neq", "lt": "gt", "lte": "gte", "gt": "lt", "gte": "lte"}[op]
-
-
-def _find_equi_conjuncts(planner: Planner, conjuncts, left: RelPlan, right: RelPlan):
-    eqs, rest = [], []
-    for c in conjuncts:
-        pair = planner._match_equi(c, left, right)
-        if pair is not None:
-            eqs.append(pair)
-        else:
-            rest.append(c)
-    return eqs, rest
-
-
-def _ensure_channel(node: P.PlanNode, expr: ir.Expr, cols):
-    """Join keys must be plain channels; wrap in a Project if the key is computed."""
-    if isinstance(expr, ir.FieldRef):
-        return expr.index, node
-    schema = node.schema
-    exprs = tuple(ir.FieldRef(i, f.type, f.name) for i, f in enumerate(schema.fields)) + (expr,)
-    new_schema = Schema(tuple(schema.fields) + (Field(f"jk{len(schema.fields)}", expr.type),))
-    return len(schema.fields), P.Project(node, exprs, new_schema)
-
-
-
-
-
-
-
-
-
-
-
-
-def _derive_name(ast, i: int) -> str:
-    if isinstance(ast, A.Identifier) and not ast.parts[-1].startswith("#"):
-        return ast.parts[-1]
-    return f"_col{i}"
-
-
-
-
-
-
-
 

@@ -1,0 +1,136 @@
+"""Shared planner substrate: the RelPlan carrier + predicate/channel helpers.
+
+Reference: the utility layer under sql/planner/ (PlanNodeSearcher,
+ExpressionUtils.extractConjuncts, SymbolAllocator) that every planner stage
+shares — split out of the one-pass frontend (round-4 verdict item 5: the
+relational planner must not be one 2.5k-line module).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+import numpy as np
+
+from ..page import Field, Schema
+from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, UNKNOWN, DecimalType, Type,
+                     VarcharType, common_super_type, parse_date_literal)
+from . import ir
+from . import parser as A
+from . import plan as P
+from .analyzer import (AGG_FUNCS, ColumnInfo, SemanticError,
+                       _add_months_const, _arith, _coerce, _interval_days,
+                       _interval_months, _interval_seconds, _literal_number,
+                       _resolve_column, _rewrite_ast, _type_from_name)
+
+
+@dataclasses.dataclass
+class RelPlan:
+    node: P.PlanNode
+    cols: list  # ColumnInfo per channel
+    unique_sets: list = dataclasses.field(default_factory=list)
+    # unique_sets: frozensets of channel indices known unique (PKs, group-by keys); used to
+    # keep hash-join build sides duplicate-free (reference analog: stats-based CBO choosing
+    # build side, DetermineJoinDistributionType.java:51)
+
+
+def _split_conjuncts(where) -> list:
+    """AND-split, factoring conjuncts common to every OR branch out of ORs (needed for
+    Q19-style `(k = j and ...) or (k = j and ...)` so the equi-join condition surfaces;
+    reference: ExtractCommonPredicatesExpressionRewriter)."""
+    if where is None:
+        return []
+    if isinstance(where, A.BinaryOp) and where.op == "and":
+        return _split_conjuncts(where.left) + _split_conjuncts(where.right)
+    if isinstance(where, A.BinaryOp) and where.op == "or":
+        branches = _split_disjuncts(where)
+        branch_conjs = [_split_conjuncts(b) for b in branches]
+        common = [c for c in branch_conjs[0] if all(c in bc for bc in branch_conjs[1:])]
+        if common:
+            rest_branches = []
+            for bc in branch_conjs:
+                rest = [c for c in bc if c not in common]
+                rest_branches.append(_and_all(rest) or A.BoolLit(True))
+            out = list(common)
+            if not all(isinstance(r, A.BoolLit) and r.value for r in rest_branches):
+                rem = rest_branches[0]
+                for r in rest_branches[1:]:
+                    rem = A.BinaryOp("or", rem, r)
+                out.append(rem)
+            return out
+    return [where]
+
+
+def _split_disjuncts(e) -> list:
+    if isinstance(e, A.BinaryOp) and e.op == "or":
+        return _split_disjuncts(e.left) + _split_disjuncts(e.right)
+    return [e]
+
+
+def _and_all(conjs):
+    if not conjs:
+        return None
+    out = conjs[0]
+    for c in conjs[1:]:
+        out = A.BinaryOp("and", out, c)
+    return out
+
+
+def _has_subquery(ast) -> bool:
+    if isinstance(ast, (A.InSubquery, A.Exists, A.ScalarSubquery)):
+        return True
+    if isinstance(ast, A.BinaryOp) and ast.op in ("eq", "neq", "lt", "lte", "gt", "gte"):
+        # comparison against a subquery is a subquery conjunct ONLY if one side is one
+        return isinstance(ast.left, A.ScalarSubquery) or isinstance(ast.right, A.ScalarSubquery)
+    if isinstance(ast, A.UnaryOp) and ast.op == "not":
+        return _has_subquery(ast.operand)
+    return False
+
+
+def _flip_cmp(op: str) -> str:
+    return {"eq": "eq", "neq": "neq", "lt": "gt", "lte": "gte", "gt": "lt", "gte": "lte"}[op]
+
+
+def _find_equi_conjuncts(planner, conjuncts, left: RelPlan, right: RelPlan):
+    eqs, rest = [], []
+    for c in conjuncts:
+        pair = planner._match_equi(c, left, right)
+        if pair is not None:
+            eqs.append(pair)
+        else:
+            rest.append(c)
+    return eqs, rest
+
+
+def _ensure_channel(node: P.PlanNode, expr: ir.Expr, cols):
+    """Join keys must be plain channels; wrap in a Project if the key is computed."""
+    if isinstance(expr, ir.FieldRef):
+        return expr.index, node
+    schema = node.schema
+    exprs = tuple(ir.FieldRef(i, f.type, f.name) for i, f in enumerate(schema.fields)) + (expr,)
+    new_schema = Schema(tuple(schema.fields) + (Field(f"jk{len(schema.fields)}", expr.type),))
+    return len(schema.fields), P.Project(node, exprs, new_schema)
+
+
+
+
+
+
+
+
+
+
+
+
+def _derive_name(ast, i: int) -> str:
+    if isinstance(ast, A.Identifier) and not ast.parts[-1].startswith("#"):
+        return ast.parts[-1]
+    return f"_col{i}"
+
+
+
+
+
+

@@ -1,0 +1,843 @@
+"""Relation planning: FROM flattening, CBO join ordering and distribution,
+explicit joins, UNNEST, MATCH_RECOGNIZE, table functions, security views,
+table resolution.
+
+Reference: sql/planner/RelationPlanner.java + ReorderJoins.java:98 +
+DetermineJoinDistributionType.java:51 — split out of the one-pass frontend
+(round-4 verdict item 5)."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+import numpy as np
+
+from ..page import Field, Schema
+from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, UNKNOWN, DecimalType, Type,
+                     VarcharType, common_super_type, parse_date_literal)
+from . import ir
+from . import parser as A
+from . import plan as P
+from .analyzer import (AGG_FUNCS, ColumnInfo, SemanticError,
+                       _add_months_const, _arith, _coerce, _interval_days,
+                       _interval_months, _interval_seconds, _literal_number,
+                       _resolve_column, _rewrite_ast, _type_from_name)
+
+from .planbase import (RelPlan, _split_conjuncts, _split_disjuncts, _and_all,
+                       _has_subquery, _flip_cmp, _find_equi_conjuncts,
+                       _ensure_channel, _derive_name)
+
+
+class RelationPlannerMixin:
+    """Planner methods for FROM/relations (mixed into Planner)."""
+
+    # ---------------------------------------------------------------- FROM / joins
+    def _plan_from(self, q: A.Select) -> RelPlan:
+        if q.from_ is None:
+            schema = Schema.of(("dummy", BIGINT))
+            return RelPlan(P.Values(((0,),), schema), [ColumnInfo(None, "dummy", BIGINT)])
+        relations: list[tuple] = []  # (RelPlan, rows_estimate)
+        explicit_joins: list = []
+        self._pending_unnests = []
+        self._flatten_from(q.from_, relations, explicit_joins)
+        conjuncts = _split_conjuncts(q.where)
+        # subquery predicates (IN/EXISTS/correlated scalar) apply after the base join tree
+        sub_conjs = [c for c in conjuncts if _has_subquery(c)]
+        conjuncts = [c for c in conjuncts if not _has_subquery(c)]
+        unnests, self._pending_unnests = self._pending_unnests, []
+        deferred = []
+        if unnests:
+            # conjuncts naming unnest output columns resolve only after expansion
+            out_names = set()
+            for un in unnests:
+                out_names.update(un.columns)
+                if un.alias:
+                    out_names.add(un.alias)
+            def mentions_unnest(c):
+                found = []
+
+                def walk(n):
+                    if isinstance(n, A.Identifier) and (
+                            n.parts[-1] in out_names
+                            or (len(n.parts) > 1 and n.parts[-2] in out_names)):
+                        found.append(n)
+                    for f in getattr(n, "__dataclass_fields__", ()):
+                        v = getattr(n, f)
+                        if isinstance(v, A.Node):
+                            walk(v)
+                        elif isinstance(v, tuple):
+                            for x in v:
+                                if isinstance(x, A.Node):
+                                    walk(x)
+
+                walk(c)
+                return bool(found)
+
+            deferred = [c for c in conjuncts if mentions_unnest(c)]
+            conjuncts = [c for c in conjuncts if c not in deferred]
+        drop_base = False
+        if not relations and not explicit_joins and unnests:
+            # FROM UNNEST(...) alone: expand over a synthetic single row
+            schema = Schema.of(("dummy", BIGINT))
+            rel = RelPlan(P.Values(((0,),), schema),
+                          [ColumnInfo(None, "dummy", BIGINT)])
+            deferred = conjuncts + deferred
+            drop_base = True
+        else:
+            rel = self._plan_from_base(relations, explicit_joins, conjuncts, q)
+        for un in unnests:
+            rel = self._apply_unnest(un, rel, drop_base=drop_base)
+            drop_base = False
+        for c in deferred:
+            e, _ = self.translate(c, rel.cols)
+            rel = RelPlan(P.Filter(rel.node, e), rel.cols, rel.unique_sets)
+        for c in sub_conjs:
+            rel = self._apply_subquery_conjunct(c, rel)
+        return rel
+
+    def _apply_unnest(self, un: A.UnnestRef, rel: RelPlan,
+                      drop_base: bool = False) -> RelPlan:
+        """Expand array-typed expressions over ``rel`` (the CROSS JOIN UNNEST
+        shape; reference: sql/planner/plan/UnnestNode.java).  Multiple arrays
+        zip positionally, shorter ones padding with NULL (the reference's
+        parallel-unnest semantics)."""
+        from ..types import ArrayType
+
+        node = rel.node
+        channels, datas = [], []
+        for expr_ast in un.exprs:
+            e, d = self.translate(expr_ast, rel.cols)
+            if not isinstance(e.type, ArrayType) or d is None:
+                raise SemanticError("UNNEST expects array-typed arguments")
+            ch, node = _ensure_channel(node, e, rel.cols)
+            channels.append(ch)
+            datas.append(d)
+        n_child = len(node.schema.fields)
+        replicate = tuple(range(n_child)) if not drop_base else ()
+        names = list(un.columns)
+        while len(names) < len(channels) + (1 if un.ordinality else 0):
+            names.append(f"col{len(names) + 1}" if names or len(channels) > 1
+                         else "col")
+        elem_fields = [Field(names[i], d.elem_type) for i, d in enumerate(datas)]
+        out_fields = ([f for i, f in enumerate(node.schema.fields)
+                       if i in replicate] + elem_fields
+                      + ([Field(names[len(channels)], BIGINT)]
+                         if un.ordinality else []))
+        schema = Schema(tuple(out_fields))
+        unode = P.Unnest(node, replicate, tuple(channels), tuple(datas),
+                         un.ordinality, schema)
+        pad = [ColumnInfo(None, "", f.type)
+               for f in node.schema.fields[len(rel.cols):]]
+        base_cols = [] if drop_base else list(rel.cols) + pad
+        cols = base_cols + [
+            ColumnInfo(un.alias, names[i], d.elem_type, d.elem_dict)
+            for i, d in enumerate(datas)]
+        if un.ordinality:
+            cols.append(ColumnInfo(un.alias, names[len(channels)], BIGINT))
+        return RelPlan(unode, cols, [])
+
+    def _plan_from_base(self, relations, explicit_joins, conjuncts, q) -> RelPlan:
+
+        if explicit_joins:
+            # explicit JOIN ... ON syntax: left-deep in written order
+            rel = self._plan_explicit(q.from_)
+            remaining = []
+            for c in conjuncts:
+                ch = self._try_translate(c, rel.cols)
+                if ch is None:
+                    raise SemanticError(f"cannot resolve predicate {c}")
+                remaining.append(ch)
+            node = rel.node
+            for pred in remaining:
+                node = P.Filter(node, pred)
+            return RelPlan(node, rel.cols, rel.unique_sets)
+
+        from .stats import filter_selectivity, join_stats
+
+        # comma-join planning with pushdown + cost-ranked ordering (reference:
+        # stats-driven join ordering, iterative/rule/ReorderJoins.java:98 —
+        # greedy minimum-intermediate-cardinality over connector statistics)
+        rels = [r for r, _ in relations]
+        rstats = [s for _, s in relations]
+        # push single-relation conjuncts onto their relation, scaling its stats
+        # by the predicate's estimated selectivity (cost/FilterStatsCalculator)
+        residual = []
+        for c in conjuncts:
+            placed = False
+            for i, r in enumerate(rels):
+                e = self._try_translate(c, r.cols)
+                if e is not None:
+                    rels[i] = RelPlan(P.Filter(r.node, e), r.cols, r.unique_sets)
+                    rstats[i] = rstats[i].scaled(filter_selectivity(e, rstats[i]))
+                    placed = True
+                    break
+            if not placed:
+                residual.append(c)
+        if len(rels) == 1:
+            node = rels[0].node
+            for c in residual:
+                e, _ = self.translate(c, rels[0].cols)
+                node = P.Filter(node, e)
+            return RelPlan(node, rels[0].cols, rels[0].unique_sets)
+
+        def _key_channels(eqs):
+            return ([pe.index if isinstance(pe, ir.FieldRef) else None
+                     for pe, _ in eqs],
+                    [be.index if isinstance(be, ir.FieldRef) else None
+                     for _, be in eqs])
+
+        # probe spine = largest estimated post-filter relation; each step joins
+        # the connected candidate whose estimated OUTPUT cardinality is lowest
+        # (unique-key build as the tiebreak — duplicate builds force the
+        # multi-match strategy at runtime)
+        order = sorted(range(len(rels)), key=lambda i: -rstats[i].rows)
+        current = rels[order[0]]
+        cur_stats = rstats[order[0]]
+        joined = {order[0]}
+        pending = [i for i in order[1:]]
+        while pending:
+            candidates = []
+            for i in pending:
+                cand = rels[i]
+                eqs, rest = _find_equi_conjuncts(self, residual, current, cand)
+                if not eqs:
+                    continue
+                build_chs = frozenset(
+                    e.index for _, e in eqs if isinstance(e, ir.FieldRef))
+                unique = any(u <= build_chs for u in cand.unique_sets)
+                pks, bks = _key_channels(eqs)
+                est = join_stats(cur_stats, rstats[i], pks, bks,
+                                 build_unique=unique)
+                candidates.append((est.rows, not unique, rstats[i].rows, i, eqs,
+                                   rest, est))
+            if not candidates:
+                # no pending relation connects to the spine; join equi-connected
+                # PENDING pairs first so cross products happen over the smallest
+                # possible component results
+                pair = None
+                for ii in pending:
+                    for jj in pending:
+                        if ii == jj:
+                            continue
+                        eqs2, rest2 = _find_equi_conjuncts(self, residual,
+                                                           rels[ii], rels[jj])
+                        if eqs2:
+                            pair = (ii, jj, eqs2, rest2)
+                            break
+                    if pair:
+                        break
+                if pair is not None:
+                    ii, jj, eqs2, rest2 = pair
+                    pks, bks = _key_channels(eqs2)
+                    est2 = join_stats(rstats[ii], rstats[jj], pks, bks)
+                    rels[ii] = self._make_join(
+                        "inner", rels[ii], rels[jj], eqs2,
+                        build_rows=rstats[jj].rows if rstats[jj].known else None,
+                        est_rows=est2.rows if est2.known else None)
+                    rstats[ii] = est2
+                    residual = rest2
+                    pending.remove(jj)
+                    continue
+                # genuinely unconnected: CROSS JOIN the smallest pending relation
+                # (constant-key join -> full multi-match expansion; theta predicates
+                # apply afterwards as filters — reference: JoinNode with CROSS type)
+                i = min(pending, key=lambda i: rstats[i].rows)
+                current = self._make_cross_join(current, rels[i])
+                from .stats import RelStats
+
+                cur_stats = RelStats(cur_stats.rows * rstats[i].rows,
+                                     list(cur_stats.cols) + list(rstats[i].cols))
+                joined.add(i)
+                pending.remove(i)
+                continue
+            _, _, _, i, eqs, rest, est = min(
+                candidates, key=lambda c: (c[0], c[1], c[2]))
+            current = self._make_join(
+                "inner", current, rels[i], eqs,
+                build_rows=rstats[i].rows if rstats[i].known else None,
+                est_rows=est.rows if est.known else None)
+            cur_stats = est
+            residual = rest
+            joined.add(i)
+            pending.remove(i)
+        node = current.node
+        still = []
+        for c in residual:
+            e = self._try_translate(c, current.cols)
+            if e is None:
+                still.append(c)
+            else:
+                node = P.Filter(node, e)
+        if still:
+            raise SemanticError(f"unresolvable predicates: {still}")
+        return RelPlan(node, current.cols, current.unique_sets)
+
+
+    def _flatten_from(self, node, relations, explicit_joins):
+        if isinstance(node, A.JoinRef):
+            if node.kind == "cross" and node.on is None:
+                self._flatten_from(node.left, relations, explicit_joins)
+                self._flatten_from(node.right, relations, explicit_joins)
+            else:
+                explicit_joins.append(node)
+        elif isinstance(node, A.UnnestRef):
+            # lateral: UNNEST args may reference sibling relations' columns, so
+            # expansion applies AFTER the base join (reference: UnnestNode under
+            # the correlated-join rewrite, CROSS JOIN UNNEST shape)
+            self._pending_unnests.append(node)
+        else:
+            rel = self._plan_relation(node)
+            relations.append((rel, self._estimate_stats(node, rel)))
+
+    def _plan_explicit(self, node) -> RelPlan:
+        if not isinstance(node, A.JoinRef):
+            return self._plan_relation(node)
+        left = self._plan_explicit(node.left)
+        right = self._plan_explicit(node.right)
+        if getattr(node, "using", ()):
+            # JOIN USING (c, ...): equi-join on the named columns of BOTH
+            # sides; the output carries the column ONCE (left's copy), so a
+            # bare reference stays unambiguous and SELECT * dedups — the
+            # reference's USING output scope (StatementAnalyzer joinUsing)
+            if node.kind not in ("inner", "left"):
+                raise SemanticError(
+                    f"USING with {node.kind.upper()} JOIN not supported yet")
+            eqs = []
+            for cname in node.using:
+                le = self._try_translate(A.Identifier((cname,)), left.cols)
+                re_ = self._try_translate(A.Identifier((cname,)), right.cols)
+                if le is None or re_ is None:
+                    raise SemanticError(
+                        f"USING column {cname} must exist on both sides")
+                eqs.append((le, re_))
+            rel = self._make_join(node.kind, left, right, eqs)
+            drop = {len(left.cols) + i for i, c in enumerate(right.cols)
+                    if c.name in node.using}
+            vis = [c for i, c in enumerate(rel.cols)
+                   if i not in drop and c.name]
+            exprs = tuple(ir.FieldRef(i, c.type, c.name)
+                          for i, c in enumerate(rel.cols)
+                          if i not in drop and c.name)
+            schema = Schema(tuple(Field(c.name, c.type) for c in vis))
+            return RelPlan(P.Project(rel.node, exprs, schema,
+                                     tuple(c.dict for c in vis)),
+                           [dataclasses.replace(c) for c in vis], [])
+        conjuncts = _split_conjuncts(node.on)
+        eqs, residual = [], []
+        for c in conjuncts:
+            pair = self._match_equi(c, left, right)
+            if pair is not None:
+                eqs.append(pair)
+            else:
+                residual.append(c)
+        if not eqs:
+            if node.kind != "inner":
+                raise SemanticError("non-equi outer joins not supported yet")
+            # theta join: cross product then filter (reference: cross JoinNode with
+            # the predicate as a post-join filter)
+            rel = self._make_cross_join(left, right)
+            out = rel.node
+            for c in residual:
+                e, _ = self.translate(c, rel.cols)
+                out = P.Filter(out, e)
+            return RelPlan(out, rel.cols, rel.unique_sets)
+        if node.kind == "left":
+            # ON residuals are match conditions, not post-filters, for outer joins.
+            # Build-side-only conjuncts push below the join (a build row failing one can
+            # never match — reference: PredicatePushDown's outer-join inner-side push);
+            # the rest become the join's residual match filter.
+            push, keep = [], []
+            for c in residual:
+                (push if self._resolves(c, right.cols) else keep).append(c)
+            for c in push:
+                e, _ = self.translate(c, right.cols)
+                right = RelPlan(P.Filter(right.node, e), right.cols, right.unique_sets)
+            rel = self._make_join("left", left, right, eqs)
+            if keep:
+                filt = None
+                for c in keep:
+                    e, _ = self.translate(c, rel.cols)
+                    filt = e if filt is None else ir.Call("and", (filt, e), BOOLEAN)
+                rel = RelPlan(dataclasses.replace(rel.node, filter=filt), rel.cols,
+                              rel.unique_sets)
+            return rel
+        if node.kind == "right":
+            # RIGHT OUTER = LEFT OUTER with flipped sides (the executor's
+            # outer machinery keeps PROBE rows), re-projected back to the
+            # original (left..., right...) channel order.  Round-4 invariant:
+            # right/full previously fell through to the inner-join transform
+            # and returned silently WRONG rows.
+            push, keep = [], []
+            for c in residual:
+                (push if self._resolves(c, left.cols) else keep).append(c)
+            for c in push:
+                e, _ = self.translate(c, left.cols)
+                left = RelPlan(P.Filter(left.node, e), left.cols,
+                               left.unique_sets)
+            rel = self._make_join("left", right, left,
+                                  [(be, pe) for pe, be in eqs])
+            if keep:
+                filt = None
+                for c in keep:
+                    e, _ = self.translate(c, rel.cols)
+                    filt = e if filt is None else ir.Call("and", (filt, e),
+                                                          BOOLEAN)
+                rel = RelPlan(dataclasses.replace(rel.node, filter=filt),
+                              rel.cols, rel.unique_sets)
+            probe_total = len(rel.node.left.schema.fields)
+            vis = list(left.cols) + list(right.cols)
+            exprs = tuple(
+                [ir.FieldRef(probe_total + i, c.type, c.name)
+                 for i, c in enumerate(left.cols)]
+                + [ir.FieldRef(i, c.type, c.name)
+                   for i, c in enumerate(right.cols)])
+            schema = Schema(tuple(Field(c.name, c.type) for c in vis))
+            dicts = tuple(c.dict for c in vis)
+            return RelPlan(P.Project(rel.node, exprs, schema, dicts),
+                           [dataclasses.replace(c) for c in vis], [])
+        if node.kind == "full":
+            # FULL OUTER = LEFT OUTER union-all the right side's unmatched
+            # rows padded with NULL left columns (reference planner models
+            # FULL directly; the union form reuses the left + anti machinery)
+            if residual:
+                raise SemanticError(
+                    "FULL OUTER JOIN with non-equi conditions not supported yet")
+            vis = list(left.cols) + list(right.cols)
+            schema = Schema(tuple(Field(c.name, c.type) for c in vis))
+            dicts = tuple(c.dict for c in vis)
+            left_rel = self._make_join("left", left, right, eqs)
+            pt = len(left_rel.node.left.schema.fields)
+            lexprs = tuple(
+                [ir.FieldRef(i, c.type, c.name)
+                 for i, c in enumerate(left.cols)]
+                + [ir.FieldRef(pt + i, c.type, c.name)
+                   for i, c in enumerate(right.cols)])
+            lproj = P.Project(left_rel.node, lexprs, schema, dicts)
+            anti = self._make_join("anti", right, left,
+                                   [(be, pe) for pe, be in eqs])
+            aexprs = tuple(
+                [ir.Constant(None, c.type) for c in left.cols]
+                + [ir.FieldRef(i, c.type, c.name)
+                   for i, c in enumerate(right.cols)])
+            aproj = P.Project(anti.node, aexprs, schema, dicts)
+            return RelPlan(P.Union((lproj, aproj), schema),
+                           [dataclasses.replace(c) for c in vis], [])
+        rel = self._make_join(node.kind, left, right, eqs)
+        out = rel.node
+        for c in residual:
+            e, _ = self.translate(c, rel.cols)
+            out = P.Filter(out, e)
+        return RelPlan(out, rel.cols, rel.unique_sets)
+
+    def _plan_relation(self, node) -> RelPlan:
+        if isinstance(node, A.TableRef):
+            name = node.name[-1]
+            if len(node.name) == 1:
+                # CTE / view expansion (reference: StatementAnalyzer WITH resolution +
+                # view expansion in analyzeView)
+                view = self.ctes.get(name) or getattr(self.engine, "views", {}).get(name)
+                if view is not None:
+                    cols, sub = view
+                    return self._plan_subquery_rel(sub, node.alias or name, cols)
+                mv = getattr(self.engine, "materialized_views", {}).get(name)
+                if mv is not None:
+                    # materialized views read their STORAGE table (results as
+                    # of the last refresh; reference: MV scan redirection)
+                    rel = self._plan_relation(A.TableRef(
+                        (mv["catalog"], mv["storage"]), node.alias or name))
+                    return rel
+            catalog, conn = self._resolve_table(node.name)
+            schema = conn.schema(name)
+            dicts = conn.dictionaries(name)
+            alias = node.alias or name
+            scan = P.TableScan(catalog, name, schema.names, schema)
+            cols = [ColumnInfo(alias, f.name, f.type, dicts.get(f.name))
+                    for f in schema.fields]
+            unique_sets = []
+            if hasattr(conn, "primary_key"):
+                try:
+                    pk = conn.primary_key(name)
+                    unique_sets.append(frozenset(schema.index(c) for c in pk))
+                except KeyError:
+                    pass
+            return self._apply_security_views(
+                RelPlan(scan, cols, unique_sets), catalog, name)
+        if isinstance(node, A.SubqueryRef):
+            return self._plan_subquery_rel(node.query, node.alias, node.columns)
+        if isinstance(node, A.MatchRecognizeRef):
+            return self._plan_match_recognize(node)
+        if isinstance(node, A.TableFunctionRef):
+            return self._plan_table_function(node)
+        raise SemanticError(f"unsupported relation {node}")
+
+    def _apply_security_views(self, rel: RelPlan, catalog: str,
+                              table: str) -> RelPlan:
+        """Row filters and column masks from access control (reference:
+        spi/security ViewExpression — SystemAccessControl.getRowFilters /
+        getColumnMasks, applied by StatementAnalyzer before the query sees the
+        table).  Expressions are SQL text evaluated in the table's scope; a
+        masked column's expression replaces it in a projection directly over
+        the scan, a row filter wraps the scan in a Filter."""
+        ac = getattr(self.engine, "access_control", None)
+        user = getattr(self.session, "user", "user")
+        if ac is None or not (hasattr(ac, "get_row_filter")
+                              or hasattr(ac, "get_column_masks")):
+            return rel
+        node, cols = rel.node, rel.cols
+        rf = ac.get_row_filter(user, catalog, table) \
+            if hasattr(ac, "get_row_filter") else None
+        if rf:
+            pred_ast = A.Parser(rf).parse_expr()
+            pred, _ = self._translate(pred_ast, cols)
+            node = P.Filter(node, pred)
+        masks = ac.get_column_masks(user, catalog, table) \
+            if hasattr(ac, "get_column_masks") else None
+        if masks:
+            exprs, out_dicts, new_cols = [], [], []
+            for i, c in enumerate(cols):
+                m = masks.get(c.name)
+                if m is None:
+                    exprs.append(ir.FieldRef(i, c.type, c.name))
+                    out_dicts.append(c.dict)
+                    new_cols.append(c)
+                else:
+                    e, d = self._translate(A.Parser(m).parse_expr(), cols)
+                    e = _coerce(e, c.type) if not c.type.is_string else e
+                    exprs.append(e)
+                    out_dicts.append(d)
+                    new_cols.append(ColumnInfo(c.alias, c.name, e.type, d))
+            schema = Schema(tuple(Field(c.name, e.type)
+                                  for c, e in zip(new_cols, exprs)))
+            node = P.Project(node, tuple(exprs), schema, tuple(out_dicts))
+            cols = new_cols
+        if node is rel.node:
+            return rel
+        # masked/filtered relations lose PK uniqueness guarantees conservatively
+        return RelPlan(node, cols, rel.unique_sets if not masks else [])
+
+    def _plan_table_function(self, node: A.TableFunctionRef) -> RelPlan:
+        """TABLE(fn(...)) invocations (reference:
+        spi/function/table/ConnectorTableFunction.java; sequence() mirrors
+        the built-in SequenceFunction)."""
+        fn = node.func
+
+        def lit_int(e, what):
+            neg = False
+            while isinstance(e, A.UnaryOp) and e.op == "negate":
+                neg = not neg
+                e = e.operand
+            if not isinstance(e, A.NumberLit) or "." in e.text \
+                    or "e" in e.text.lower():
+                raise SemanticError(f"sequence {what} must be an integer literal")
+            v = int(e.text)
+            return -v if neg else v
+
+        if fn.name == "sequence":
+            if not 2 <= len(fn.args) <= 3:
+                raise SemanticError("sequence(start, stop[, step])")
+            start = lit_int(fn.args[0], "start")
+            stop = lit_int(fn.args[1], "stop")
+            step = lit_int(fn.args[2], "step") if len(fn.args) > 2 else 1
+            if step == 0:
+                raise SemanticError("sequence step must not be zero")
+            n = max((stop - start) // step + 1, 0)
+            if n > (1 << 20):
+                raise SemanticError(
+                    f"sequence produces {n} rows (limit {1 << 20})")
+            col = node.column_aliases[0] if node.column_aliases \
+                else "sequential_number"
+            schema = Schema((Field(col, BIGINT),))
+            rows = tuple((start + i * step,) for i in range(n))
+            return RelPlan(P.Values(rows, schema),
+                           [ColumnInfo(node.alias, col, BIGINT, None)], [])
+        raise SemanticError(f"table function {fn.name} not supported")
+
+    def _plan_match_recognize(self, node: A.MatchRecognizeRef) -> RelPlan:
+        """reference: StatementAnalyzer's pattern-recognition analysis +
+        PatternRecognitionNode planning; see plan.MatchRecognize for the
+        supported subset."""
+        rel = self._plan_relation(node.input)
+        var_names = {v for el, _ in node.pattern
+                     for v in (el if isinstance(el, tuple) else (el,))}
+        for v, _ in node.defines:
+            if v not in var_names:
+                raise SemanticError(f"DEFINE variable {v} not in PATTERN")
+
+        def rewrite_tree(ast, fn):
+            """Apply fn top-down over every Node, recursing through nested
+            tuples too (CaseExpr.whens holds (cond, value) PAIRS)."""
+            def walk(v):
+                if isinstance(v, A.Node):
+                    out = fn(v)
+                    if out is not v:
+                        return out
+                    changed = {}
+                    for f in v.__dataclass_fields__:
+                        fv = getattr(v, f)
+                        nv = walk(fv)
+                        if nv is not fv:
+                            changed[f] = nv
+                    return dataclasses.replace(v, **changed) if changed else v
+                if isinstance(v, tuple):
+                    items = tuple(walk(x) for x in v)
+                    return items if any(a is not b for a, b in zip(items, v)) \
+                        else v
+                return v
+
+            return walk(ast)
+
+        def strip_vars(ast):
+            """b.price -> price (variable-qualified refs read the current row)."""
+            def fn(n):
+                if isinstance(n, A.Identifier) and len(n.parts) == 2 \
+                        and n.parts[0] in var_names:
+                    return A.Identifier((n.parts[1],))
+                return n
+
+            return rewrite_tree(ast, fn)
+
+        # PREV/NEXT navigation -> synthetic shifted channels appended to the
+        # sorted input (the reference evaluates navigation against the
+        # partition's row frame; shifting the sorted columns is the columnar
+        # equivalent)
+        nav: list = []
+        nav_cols: list = []
+
+        def extract_nav(ast):
+            def fn(node_ast):
+                if isinstance(node_ast, A.FuncCall) \
+                        and node_ast.name in ("prev", "next"):
+                    inner = strip_vars(node_ast.args[0])
+                    if not isinstance(inner, A.Identifier):
+                        raise SemanticError("PREV/NEXT take a plain column")
+                    ch = _resolve_column(inner, rel.cols)
+                    n = 1
+                    if len(node_ast.args) > 1:
+                        if not isinstance(node_ast.args[1], A.NumberLit):
+                            raise SemanticError(
+                                "PREV/NEXT offset must be a literal")
+                        n = int(node_ast.args[1].text)
+                    off = -n if node_ast.name == "prev" else n
+                    key = (ch, off)
+                    if key not in nav:
+                        nav.append(key)
+                        c = rel.cols[ch]
+                        nav_cols.append(ColumnInfo(None, f"#nav{len(nav)}",
+                                                   c.type, c.dict))
+                    return A.Identifier((f"#nav{nav.index(key) + 1}",))
+                return node_ast
+
+            return rewrite_tree(ast, fn)
+
+        define_asts = [(v, extract_nav(strip_vars(e))) for v, e in node.defines]
+        ext_cols = list(rel.cols) + nav_cols
+        defines = []
+        for v, e_ast in define_asts:
+            e, _ = self.translate(e_ast, ext_cols)
+            defines.append((v, e))
+
+        # v1 subset: partition keys are plain columns — a computed key would
+        # append a projection channel AFTER the nav channels were numbered,
+        # desynchronizing the DEFINE translation from the executor's layout
+        pchs = []
+        pnode = rel.node
+        for e_ast in node.partition_by:
+            e, _ = self.translate(e_ast, rel.cols)
+            if not isinstance(e, ir.FieldRef):
+                raise SemanticError(
+                    "MATCH_RECOGNIZE PARTITION BY must be plain columns")
+            pchs.append(e.index)
+        order = []
+        for s in node.order_by:
+            e, _ = self.translate(strip_vars(s.expr), rel.cols)
+            if not isinstance(e, ir.FieldRef):
+                raise SemanticError("MATCH_RECOGNIZE ORDER BY must be columns")
+            order.append(P.SortKey(e.index, s.ascending,
+                                   bool(s.nulls_first)))
+
+        measures = []
+        out_infos = []
+        for m_ast, m_name in node.measures:
+            kind, var, ch = self._measure_spec(m_ast, var_names, rel.cols)
+            c = rel.cols[ch]
+            measures.append((kind, var, ch, m_name))
+            out_infos.append(ColumnInfo(node.alias, m_name, c.type, c.dict))
+
+        all_rows = bool(getattr(node, "all_rows", False))
+        if all_rows:
+            # ALL ROWS PER MATCH: every matched input row, all input columns,
+            # plus the (FINAL-semantics) measures (reference:
+            # RowsPerMatch.ALL_SHOW_EMPTY minus empty-match output)
+            base_fields = [Field(c.name or f"c{i}", c.type)
+                           for i, c in enumerate(rel.cols)]
+            schema = Schema(tuple(base_fields)
+                            + tuple(Field(n, rel.cols[ch].type)
+                                    for _, _, ch, n in measures))
+            cols = [ColumnInfo(node.alias, c.name, c.type, c.dict)
+                    for c in rel.cols] + out_infos
+        else:
+            part_fields = [Field(rel.cols[ch].name or f"p{i}",
+                                 rel.cols[ch].type)
+                           for i, ch in enumerate(pchs)]
+            schema = Schema(tuple(part_fields)
+                            + tuple(Field(n, rel.cols[ch].type)
+                                    for _, _, ch, n in measures))
+            cols = [ColumnInfo(node.alias, rel.cols[ch].name,
+                               rel.cols[ch].type, rel.cols[ch].dict)
+                    for ch in pchs] + out_infos
+        mr = P.MatchRecognize(pnode, tuple(pchs), tuple(order), node.pattern,
+                              tuple(defines), tuple(nav), tuple(measures),
+                              schema, all_rows)
+        return RelPlan(mr, cols, [])
+
+    def _measure_spec(self, ast, var_names, cols):
+        """FIRST(v.col) | LAST(v.col) | v.col | col -> (kind, var, channel)."""
+        if isinstance(ast, A.FuncCall) and ast.name in ("first", "last") \
+                and len(ast.args) == 1:
+            inner = ast.args[0]
+            if isinstance(inner, A.Identifier) and len(inner.parts) == 2 \
+                    and inner.parts[0] in var_names:
+                ch = _resolve_column(A.Identifier((inner.parts[1],)), cols)
+                return ast.name, inner.parts[0], ch
+            if isinstance(inner, A.Identifier):
+                ch = _resolve_column(inner, cols)
+                return ast.name, None, ch
+        if isinstance(ast, A.Identifier):
+            if len(ast.parts) == 2 and ast.parts[0] in var_names:
+                ch = _resolve_column(A.Identifier((ast.parts[1],)), cols)
+                return "last", ast.parts[0], ch
+            return "col", None, _resolve_column(ast, cols)
+        raise SemanticError(
+            "MEASURES supports FIRST/LAST(var.col), var.col, or plain columns")
+
+    def _plan_subquery_rel(self, sub: A.Select, alias, columns=()) -> RelPlan:
+        saved = self.ctes
+        self.ctes = {**saved, **{name: (cols_, s) for name, cols_, s in sub.ctes}}
+        try:
+            return self._plan_subquery_rel_inner(sub, alias, columns)
+        finally:
+            self.ctes = saved
+
+    def _plan_subquery_rel_inner(self, sub: A.Select, alias, columns=()) -> RelPlan:
+        rel, out_names, _ = self._plan_select(sub)
+        plan_node = rel.node
+        if sub.order_by:
+            keys = []
+            for s in sub.order_by:
+                ch = self._resolve_output_channel(s.expr, out_names, [None] * len(out_names))
+                keys.append(P.SortKey(ch, s.ascending, bool(s.nulls_first)))
+            plan_node = P.Sort(plan_node, tuple(keys))
+        if sub.limit is not None:
+            plan_node = P.Limit(plan_node, sub.limit)
+        if columns:
+            if len(columns) != len(out_names):
+                raise SemanticError("column alias list length mismatch")
+            out_names = list(columns)
+        cols = [ColumnInfo(alias, n, c.type, c.dict)
+                for n, c in zip(out_names, rel.cols)]
+        return RelPlan(plan_node, cols)
+
+    def _resolve_table(self, name_parts) -> tuple:
+        """(catalog, connector) for a table name: qualified name wins, then the session
+        catalog, then any catalog exposing the table (reference: MetadataManager's
+        catalog resolution against the session)."""
+        name = name_parts[-1]
+        if len(name_parts) > 1:
+            if name_parts[0] not in self.engine.catalogs:
+                raise SemanticError(f"catalog {name_parts[0]} is not registered")
+            return name_parts[0], self.engine.catalogs[name_parts[0]]
+        cat = self.session.catalog or "tpch"
+        conn = self.engine.catalogs.get(cat)
+        if conn is not None and name in conn.tables():
+            return cat, conn
+        for cn, c in self.engine.catalogs.items():
+            if name in c.tables():
+                return cn, c
+        raise SemanticError(f"table {name} not found in any catalog")
+
+    def _estimate_stats(self, node, rel):
+        """RelStats for a base relation (reference: cost/StatsCalculator — scan
+        stats flow from connector TableStatistics; subqueries get unknowns)."""
+        from ..spi.statistics import connector_table_stats
+        from .stats import scan_stats, unknown_stats
+
+        if isinstance(node, A.TableRef) and isinstance(rel.node, P.TableScan):
+            try:
+                _, conn = self._resolve_table(node.name)
+                ts = connector_table_stats(conn, node.name[-1])
+                return scan_stats(ts, rel.node.columns)
+            except Exception:
+                pass
+        return unknown_stats(len(rel.cols))
+
+    def _match_equi(self, conjunct, left: RelPlan, right: RelPlan):
+        """a.x = b.y with sides in different relations -> (left_expr, right_expr)."""
+        if not (isinstance(conjunct, A.BinaryOp) and conjunct.op == "eq"):
+            return None
+        l_in_left = self._try_translate(conjunct.left, left.cols)
+        r_in_right = self._try_translate(conjunct.right, right.cols)
+        if l_in_left is not None and r_in_right is not None:
+            return (l_in_left, r_in_right)
+        l_in_right = self._try_translate(conjunct.left, right.cols)
+        r_in_left = self._try_translate(conjunct.right, left.cols)
+        if l_in_right is not None and r_in_left is not None:
+            return (r_in_left, l_in_right)
+        return None
+
+    def _make_cross_join(self, probe: RelPlan, build: RelPlan) -> RelPlan:
+        """Cross product: a constant-key equi join — every probe row matches every
+        build row through the multi-match expansion."""
+        one = ir.Constant(1, BIGINT)
+        return self._make_join("inner", probe, build, [(one, one)])
+
+    from .stats import PARTITIONED_JOIN_THRESHOLD  # one constant shared with
+    # the AddExchanges pass; the distributed executor's actual-size default
+    # is the matching runtime knob (DetermineJoinDistributionType)
+
+    def _join_distribution(self, build_rows) -> str:
+        """'replicated' | 'partitioned' | 'broadcast' (forced) from the session's
+        join_distribution_type + estimated build cardinality (reference:
+        iterative/rule/DetermineJoinDistributionType.java:51 — AUTOMATIC sizes
+        the decision from stats; explicit settings force it)."""
+        props = getattr(self.session, "properties", None) or {}
+        mode = str(props.get("join_distribution_type", "AUTOMATIC")).upper()
+        if mode == "BROADCAST":
+            return "broadcast"
+        if mode == "PARTITIONED":
+            return "partitioned"
+        if build_rows is not None and build_rows >= self.PARTITIONED_JOIN_THRESHOLD:
+            return "partitioned"
+        return "replicated"
+
+    def _make_join(self, kind, probe: RelPlan, build: RelPlan, eqs,
+                   filter_expr=None, build_rows=None, est_rows=None) -> RelPlan:
+        probe_node, build_node = probe.node, build.node
+        pkeys, bkeys = [], []
+        for pe, be in eqs:
+            t = common_super_type(pe.type, be.type)
+            pe = _coerce(pe, t)
+            be = _coerce(be, t)
+            pch, probe_node = _ensure_channel(probe_node, pe, probe.cols)
+            bch, build_node = _ensure_channel(build_node, be, build.cols)
+            pkeys.append(pch)
+            bkeys.append(bch)
+        # computed join keys append helper channels to either side: the runtime emits the
+        # full child schemas, so planner-side cols must cover them (anonymous, unresolvable)
+        probe_cols = list(probe.cols) + [ColumnInfo(None, "", f.type)
+                                         for f in probe_node.schema.fields[len(probe.cols):]]
+        build_cols = list(build.cols) + [ColumnInfo(None, "", f.type)
+                                         for f in build_node.schema.fields[len(build.cols):]]
+        schema = Schema(tuple(
+            [Field(f"l{i}", c.type) for i, c in enumerate(probe_cols)]
+            + [Field(f"r{i}", c.type) for i, c in enumerate(build_cols)]
+        ))
+        node = P.Join(kind, probe_node, build_node, tuple(pkeys), tuple(bkeys), schema,
+                      filter=filter_expr,
+                      distribution=self._join_distribution(build_rows),
+                      est_rows=est_rows)
+        cols = probe_cols + build_cols
+        # a many-to-one join preserves probe-row multiplicity -> probe unique sets survive
+        return RelPlan(node, cols, list(probe.unique_sets))
+

@@ -1,0 +1,329 @@
+"""Subquery predicate planning: IN/EXISTS semi-anti joins, correlated
+scalar-aggregate decorrelation, eager uncorrelated scalars.
+
+Reference: sql/planner/SubqueryPlanner.java + the TransformCorrelated* rule
+family (iterative/rule/TransformCorrelated*.java) — split out of the one-pass
+frontend (round-4 verdict item 5)."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+import numpy as np
+
+from ..page import Field, Schema
+from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, UNKNOWN, DecimalType, Type,
+                     VarcharType, common_super_type, parse_date_literal)
+from . import ir
+from . import parser as A
+from . import plan as P
+from .analyzer import (AGG_FUNCS, ColumnInfo, SemanticError,
+                       _add_months_const, _arith, _coerce, _interval_days,
+                       _interval_months, _interval_seconds, _literal_number,
+                       _resolve_column, _rewrite_ast, _type_from_name)
+
+from .planbase import (RelPlan, _split_conjuncts, _split_disjuncts, _and_all,
+                       _has_subquery, _flip_cmp, _ensure_channel, _derive_name)
+from .aggsugar import _collect_aggs
+
+
+class SubqueryPlannerMixin:
+    """Planner methods for subquery predicates (mixed into Planner)."""
+
+    # ---------------------------------------------------------------- subquery predicates
+    def _apply_subquery_conjunct(self, c, rel: RelPlan) -> RelPlan:
+        """Plan one IN/EXISTS/scalar-subquery predicate against the joined relation.
+
+        Reference: subquery planning + decorrelation in SubqueryPlanner/
+        TransformCorrelated* rules (sql/planner/SubqueryPlanner.java,
+        iterative/rule/TransformCorrelated*.java) — here specialized to the equi-correlated
+        patterns (semi/anti joins; correlated scalar aggregates join on their correlation
+        keys)."""
+        neg = False
+        while isinstance(c, A.UnaryOp) and c.op == "not":
+            neg = not neg
+            c = c.operand
+        if isinstance(c, A.InSubquery):
+            # _plan_subquery_rel applies the subquery's ORDER BY/LIMIT (a LIMITed IN-list
+            # is order-sensitive and must not build on the full table)
+            inner = self._plan_subquery_rel(c.query, None)
+            if len(inner.cols) != 1:
+                raise SemanticError("IN subquery must produce one column")
+            value, _ = self.translate(c.value, rel.cols)
+            negated = c.negated != neg
+            return self._semi_anti_join(rel, inner, [(value, ir.FieldRef(
+                0, inner.cols[0].type, inner.cols[0].name))], negated,
+                null_aware=True)
+        if isinstance(c, A.Exists):
+            negated = c.negated != neg
+            return self._plan_exists(c.query, rel, negated)
+        if isinstance(c, A.BinaryOp) and c.op in ("eq", "neq", "lt", "lte", "gt", "gte"):
+            # correlated scalar aggregate comparison (uncorrelated ones fold in translate)
+            sub = c.right if isinstance(c.right, A.ScalarSubquery) else c.left
+            other_ast = c.left if sub is c.right else c.right
+            if not isinstance(sub, A.ScalarSubquery):
+                raise SemanticError(f"unsupported subquery predicate {c}")
+            op = c.op if sub is c.right else _flip_cmp(c.op)
+            if neg:
+                op = {"eq": "neq", "neq": "eq", "lt": "gte", "lte": "gt",
+                      "gt": "lte", "gte": "lt"}[op]
+            # uncorrelated subqueries fold eagerly; ONLY the correlation probe (planning)
+            # may fail over to decorrelation — cardinality/translation errors are real
+            try:
+                plan = self.plan_query(sub.query)
+            except SemanticError:
+                plan = None  # correlated: unresolvable outer references
+            if plan is not None:
+                const = self._scalar_from_plan(plan)
+                other, od = self.translate(other_ast, rel.cols)
+                t = common_super_type(other.type, const.type)
+                return RelPlan(P.Filter(rel.node, ir.Call(
+                    op, (_coerce(other, t), _coerce(const, t)), BOOLEAN)),
+                    rel.cols, rel.unique_sets)
+            rel2, agg_expr = self._join_correlated_agg(sub.query, rel)
+            other, _ = self.translate(other_ast, rel2.cols[:len(rel.cols)])
+            t = common_super_type(other.type, agg_expr.type)
+            pred = ir.Call(op, (_coerce(other, t), _coerce(agg_expr, t)), BOOLEAN)
+            return RelPlan(P.Filter(rel2.node, pred), rel2.cols, rel2.unique_sets)
+        raise SemanticError(f"unsupported subquery predicate {c}")
+
+    def _semi_anti_join(self, rel: RelPlan, inner: RelPlan, pairs, negated: bool,
+                        null_aware: bool = False) -> RelPlan:
+        """rel ⋉/▷ inner on (outer_expr = inner_expr) pairs.
+
+        ``null_aware`` (IN/NOT IN semantics): NULLs among the build keys must make
+        NOT IN yield UNKNOWN for otherwise-unmatched rows (reference: null-aware anti
+        join in SemiJoinNode planning).  The group-by dedup erases null masks, so
+        null-aware builds skip it and let the executor's hash table dedup instead."""
+        # coerce BOTH sides to the common key type (packed-key equality is exact, so a
+        # scale/width mismatch would silently never match), project inner to its key
+        # columns, then distinct (unique build keys)
+        types = [common_super_type(pe.type, be.type) for pe, be in pairs]
+        key_exprs = [_coerce(be, t) for (_, be), t in zip(pairs, types)]
+        schema = Schema(tuple(Field(f"sk{i}", e.type) for i, e in enumerate(key_exprs)))
+        build = P.Project(inner.node, tuple(key_exprs), schema)
+        if not null_aware:
+            build = P.Aggregate(build, tuple(range(len(key_exprs))), (), schema)
+        probe_node = rel.node
+        pkeys, bkeys = [], []
+        for i, ((pe, _), t) in enumerate(zip(pairs, types)):
+            pch, probe_node = _ensure_channel(probe_node, _coerce(pe, t), rel.cols)
+            pkeys.append(pch)
+            bkeys.append(i)
+        kind = "anti" if negated else "semi"
+        join = P.Join(kind, probe_node, build, tuple(pkeys), tuple(bkeys),
+                      probe_node.schema, null_aware=null_aware)
+        # semi/anti output keeps all probe channels (incl. any helper join-key channels;
+        # harmless — downstream refers to the original ones)
+        cols = list(rel.cols) + [ColumnInfo(None, f.name, f.type)
+                                 for f in probe_node.schema.fields[len(rel.cols):]]
+        return RelPlan(join, cols, rel.unique_sets)
+
+    def _plan_exists(self, q: A.Select, rel: RelPlan, negated: bool) -> RelPlan:
+        if q.having is not None:
+            raise SemanticError("HAVING inside correlated EXISTS not supported yet")
+        if q.limit == 0:
+            # EXISTS (... LIMIT 0) is constant-false
+            keep = negated
+            return rel if keep else RelPlan(
+                P.Filter(rel.node, ir.Constant(False, BOOLEAN)), rel.cols, rel.unique_sets)
+        if not q.group_by:
+            aggs: list = []
+            for it in q.items:
+                if not isinstance(it.expr, A.Star):
+                    _collect_aggs(it.expr, aggs)
+            if aggs:
+                # an ungrouped aggregate query yields exactly one row regardless of
+                # input: EXISTS is constant-true
+                keep = not negated
+                return rel if keep else RelPlan(
+                    P.Filter(rel.node, ir.Constant(False, BOOLEAN)),
+                    rel.cols, rel.unique_sets)
+        # GROUP BY without HAVING does not change row existence; drop it below
+        inner_cols = self._inner_columns(q.from_)
+        inner_only, corr_pairs_ast, residual_ast = [], [], []
+        for cj in _split_conjuncts(q.where):
+            if self._resolves(cj, inner_cols):
+                inner_only.append(cj)
+                continue
+            pair = self._split_correlated_equi(cj, rel.cols, inner_cols)
+            if pair is None:
+                residual_ast.append(cj)
+                continue
+            corr_pairs_ast.append(pair)
+        if residual_ast:
+            # non-equi correlated predicates (Q21's l2.l_suppkey <> l1.l_suppkey) ride the
+            # join as a residual match filter over probe+build channels; the build side
+            # stays un-deduplicated (every inner row is a match candidate)
+            if not corr_pairs_ast:
+                raise SemanticError("correlated EXISTS without an equi conjunct")
+            inner_rel = self._plan_from(dataclasses.replace(q, where=_and_all(inner_only)))
+            return self._semi_anti_join_residual(rel, inner_rel, corr_pairs_ast,
+                                                 residual_ast, negated)
+        if not corr_pairs_ast:
+            # uncorrelated EXISTS: evaluate once
+            sub = dataclasses.replace(q, items=(A.SelectItem(A.NumberLit("1"), None),),
+                                      where=_and_all(inner_only), limit=1,
+                                      order_by=(), group_by=q.group_by)
+            res = self.engine.execute_plan(self.plan_query(sub), cache=False)
+            exists = len(res) > 0
+            keep = exists != negated
+            if keep:
+                return rel
+            return RelPlan(P.Filter(rel.node, ir.Constant(False, BOOLEAN)),
+                           rel.cols, rel.unique_sets)
+        inner_sel = dataclasses.replace(
+            q, items=tuple(A.SelectItem(inner_ast, None) for _, inner_ast in corr_pairs_ast),
+            where=_and_all(inner_only), group_by=(), having=None, order_by=(), limit=None)
+        inner_rel, _, _ = self._plan_select(inner_sel)
+        pairs = []
+        for i, (outer_ast, _) in enumerate(corr_pairs_ast):
+            oe, _ = self.translate(outer_ast, rel.cols)
+            c = inner_rel.cols[i]
+            pairs.append((oe, ir.FieldRef(i, c.type, c.name)))
+        return self._semi_anti_join(rel, inner_rel, pairs, negated)
+
+    def _semi_anti_join_residual(self, rel: RelPlan, inner_rel: RelPlan, pairs_ast,
+                                 residual_ast, negated: bool) -> RelPlan:
+        """Semi/anti join with per-candidate residual filter (reference:
+        JoinFilterFunction on semijoins; executed by the multi-match probe)."""
+        probe_node, build_node = rel.node, inner_rel.node
+        pkeys, bkeys = [], []
+        for outer_ast, inner_ast in pairs_ast:
+            oe, _ = self.translate(outer_ast, rel.cols)
+            be, _ = self.translate(inner_ast, inner_rel.cols)
+            t = common_super_type(oe.type, be.type)
+            pch, probe_node = _ensure_channel(probe_node, _coerce(oe, t), rel.cols)
+            bch, build_node = _ensure_channel(build_node, _coerce(be, t), inner_rel.cols)
+            pkeys.append(pch)
+            bkeys.append(bch)
+        probe_cols = list(rel.cols) + [ColumnInfo(None, "", f.type)
+                                       for f in probe_node.schema.fields[len(rel.cols):]]
+        build_cols = list(inner_rel.cols) + [
+            ColumnInfo(None, "", f.type)
+            for f in build_node.schema.fields[len(inner_rel.cols):]]
+        comb = probe_cols + build_cols
+        filt = None
+        for c in residual_ast:
+            e, _ = self.translate(c, comb)
+            filt = e if filt is None else ir.Call("and", (filt, e), BOOLEAN)
+        kind = "anti" if negated else "semi"
+        join = P.Join(kind, probe_node, build_node, tuple(pkeys), tuple(bkeys),
+                      probe_node.schema, filter=filt)
+        return RelPlan(join, probe_cols, rel.unique_sets)
+
+    def _inner_columns(self, from_) -> list:
+        """Column scope of a subquery's FROM without planning its joins."""
+        relations, explicit = [], []
+        self._flatten_from(from_, relations, explicit)
+        cols = []
+        for r, _ in relations:
+            cols.extend(r.cols)
+        for j in explicit:
+            cols.extend(self._join_ref_columns(j))
+        return cols
+
+    def _join_ref_columns(self, j: A.JoinRef) -> list:
+        """All leaf-relation columns under a (possibly nested) explicit-join tree."""
+        cols = []
+        for side in (j.left, j.right):
+            if isinstance(side, A.JoinRef):
+                cols.extend(self._join_ref_columns(side))
+            else:
+                cols.extend(self._plan_relation(side).cols)
+        return cols
+
+    def _resolves(self, ast, cols) -> bool:
+        return self._try_translate(ast, cols) is not None
+
+    def _split_correlated_equi(self, cj, outer_cols, inner_cols):
+        """a = b with one side outer, one side inner -> (outer_ast, inner_ast).
+
+        SQL scoping: a name resolvable in the inner scope binds there even if the outer
+        scope also has it (StatementAnalyzer's scope chain) — so the inner-resolvable side
+        is the inner one, and the other side must resolve in the outer scope."""
+        if not (isinstance(cj, A.BinaryOp) and cj.op == "eq"):
+            return None
+        l_inner = self._resolves(cj.left, inner_cols)
+        r_inner = self._resolves(cj.right, inner_cols)
+        l_outer = self._resolves(cj.left, outer_cols)
+        r_outer = self._resolves(cj.right, outer_cols)
+        if l_inner and not r_inner and r_outer:
+            return (cj.right, cj.left)
+        if r_inner and not l_inner and l_outer:
+            return (cj.left, cj.right)
+        return None
+
+    def _eager_scalar(self, q: A.Select) -> ir.Constant:
+        """Execute an uncorrelated scalar subquery at plan time -> Constant.
+
+        (The reference plans these as joins — EnforceSingleRowNode; eager evaluation is
+        equivalent for uncorrelated subqueries and keeps fragments simple.)"""
+        plan = self.plan_query(q)  # raises SemanticError if correlated (unresolved cols)
+        return self._scalar_from_plan(plan)
+
+    def _scalar_from_plan(self, plan) -> ir.Constant:
+        res = self.engine.execute_plan(plan, cache=False)
+        if len(res) != 1 or len(res.columns) != 1:
+            raise SemanticError("scalar subquery must return exactly one value")
+        t = res.types[0]
+        raw = res.raw_columns[0][0]
+        return ir.Constant(raw.item() if hasattr(raw, "item") else raw, t)
+
+    def _join_correlated_agg(self, q: A.Select, rel: RelPlan):
+        """Decorrelate `(select agg(..) from .. where inner.k = outer.k and ..)`:
+        plan the inner as GROUP BY its correlation keys, LEFT-join on them (an outer
+        row with an empty group must see the aggregate over an empty input: NULL for
+        sum/avg/min/max — which any comparison rejects — and 0 for count; reference:
+        TransformCorrelatedScalarAggregationToJoin + AggregationNode default values).
+        Returns (joined rel, ir expression for the aggregate value)."""
+        if len(q.items) != 1 or q.group_by:
+            raise SemanticError("unsupported correlated subquery shape")
+        item_expr = q.items[0].expr
+        item_aggs: list = []
+        _collect_aggs(item_expr, item_aggs)
+        is_bare_count = (isinstance(item_expr, A.FuncCall) and item_expr.name == "count")
+        if any(a.name == "count" for a in item_aggs) and not is_bare_count:
+            # count nested inside a larger expression: the empty-group value would be
+            # expr(count=0, ...) which NULL-propagation cannot reproduce
+            raise SemanticError(
+                "correlated subquery mixing count() into an expression not supported yet")
+        inner_cols = self._inner_columns(q.from_)
+        inner_only, corr_pairs_ast = [], []
+        for cj in _split_conjuncts(q.where):
+            if self._resolves(cj, inner_cols):
+                inner_only.append(cj)
+                continue
+            pair = self._split_correlated_equi(cj, rel.cols, inner_cols)
+            if pair is None:
+                raise SemanticError(f"unsupported correlated predicate {cj}")
+            corr_pairs_ast.append(pair)
+        if not corr_pairs_ast:
+            raise SemanticError("not correlated")
+        inner_sel = dataclasses.replace(
+            q,
+            items=tuple(A.SelectItem(ia, f"ck{i}") for i, (_, ia) in enumerate(corr_pairs_ast))
+            + (A.SelectItem(q.items[0].expr, "#aggv"),),  # '#' keeps it un-referenceable
+            where=_and_all(inner_only),
+            group_by=tuple(ia for _, ia in corr_pairs_ast),
+            having=None, order_by=(), limit=None)
+        inner_rel, _, _ = self._plan_select(inner_sel)
+        eqs = []
+        for i, (outer_ast, _) in enumerate(corr_pairs_ast):
+            oe, _ = self.translate(outer_ast, rel.cols)
+            c = inner_rel.cols[i]
+            eqs.append((oe, ir.FieldRef(i, c.type, c.name)))
+        joined = self._make_join("left", rel, inner_rel, eqs)
+        # locate the aggregate channel by name: _make_join may have appended helper
+        # channels to the probe side (computed/coerced correlation keys), shifting the
+        # build-side columns right
+        agg_ch = next(i for i, c in enumerate(joined.cols) if c.name == "#aggv")
+        agg_col = joined.cols[agg_ch]
+        agg_expr: ir.Expr = ir.FieldRef(agg_ch, agg_col.type)
+        if is_bare_count:
+            agg_expr = ir.Call("coalesce",
+                               (agg_expr, ir.Constant(0, agg_col.type)), agg_col.type)
+        return joined, agg_expr
+
