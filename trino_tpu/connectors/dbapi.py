@@ -38,6 +38,10 @@ class DbapiSplit:
     table: str
     lo: int  # inclusive remote rowid range [lo, hi]
     hi: int
+    pushed_spec: tuple = None  # serialized virtual-handle spec (sorted item
+    # pairs): cluster WORKERS build their own connector instances, so a
+    # pushed handle must travel WITH the split, not live only in the
+    # planning process's registry
 
 
 @dataclasses.dataclass
@@ -74,6 +78,109 @@ class DbapiConnector:
         self._connect = connect
         self.split_rows = split_rows
         self._tables: dict = {}
+        # virtual handles from optimizer pushdowns (applyTopN / applyJoin,
+        # spi/connector/ConnectorMetadata.java:1637,1663): handle -> spec,
+        # content-deduped (replanning the same query reuses its handle) and
+        # bounded (a long-lived server plans unbounded distinct SQL texts)
+        self._pushed: dict = {}
+        self._pushed_by_content: dict = {}
+        self._pushed_cap = 512
+        self._pushed_seq = 0
+        self.pushed_queries = 0  # observability: remote pushed-handle reads
+
+    # -- optimizer pushdown surfaces (applyTopN / applyJoin) ---------------------
+    supports_topn_pushdown = True
+    supports_join_pushdown = True
+
+    def is_pushdown_handle(self, table: str) -> bool:
+        """Interface-level test the optimizer uses instead of reaching into
+        connector-private state (a handle is not itself pushable-over)."""
+        return table in self._pushed
+
+    def _register_pushed(self, prefix: str, spec: dict) -> str:
+        key = tuple(sorted((k, tuple(v) if isinstance(v, list) else v)
+                    for k, v in spec.items()))
+        hit = self._pushed_by_content.get(key)
+        if hit is not None:
+            return hit
+        self._pushed_seq += 1
+        handle = f"{prefix}{self._pushed_seq}"
+        self._pushed[handle] = spec
+        self._pushed_by_content[key] = handle
+        while len(self._pushed) > self._pushed_cap:
+            old = next(iter(self._pushed))
+            self._pushed.pop(old)
+            self._pushed_by_content = {k: h for k, h
+                                       in self._pushed_by_content.items()
+                                       if h != old}
+        return handle
+
+    def _resolve_spec(self, table: str, split=None):
+        """Handle spec from the local registry, or — on a WORKER that never
+        planned the query — from the split's serialized copy."""
+        spec = self._pushed.get(table)
+        if spec is None and split is not None \
+                and getattr(split, "pushed_spec", None):
+            spec = {k: list(v) if isinstance(v, tuple) else v
+                    for k, v in split.pushed_spec}
+            self._pushed[table] = spec  # cache for metadata calls
+        return spec
+
+    def apply_topn(self, table: str, order: list, n: int) -> str:
+        """TopN pushdown (ConnectorMetadata.applyTopN:1663): returns a handle
+        whose scan issues ORDER BY ... LIMIT n remotely, shipping n rows
+        instead of the table.  The engine keeps its local Sort+Limit above
+        (the reference's topNGuarantee — remote collation may differ)."""
+        base = self._open(table)
+        parts = []
+        for col, asc, nulls_first in order:
+            base.schema.field(col)  # validate
+            parts.append(f"{_q(col)} {'asc' if asc else 'desc'} "
+                         f"nulls {'first' if nulls_first else 'last'}")
+        return self._register_pushed(
+            f"{table}#topn",
+            {"kind": "topn", "base": table,
+             "order_sql": ", ".join(parts), "n": int(n)})
+
+    def apply_join(self, left: str, right: str, pairs: list, out_names: list,
+                   left_cols: list, right_cols: list) -> str:
+        """Equi-join pushdown (ConnectorMetadata.applyJoin:1637): both sides
+        live in THIS remote database, so the join runs there — the engine
+        scans the joined result (split by the left side's rowid ranges).
+        ``pairs``: [(left_col, right_col)]; ``out_names``: output field
+        names aligned to ``left_cols`` then ``right_cols`` (the sides'
+        PROJECTED column lists, which may subset/reorder the tables)."""
+        lt, rt = self._open(left), self._open(right)
+        for lc, rc in pairs:
+            lt.schema.field(lc)
+            rt.schema.field(rc)
+        for c in left_cols:
+            lt.schema.field(c)
+        for c in right_cols:
+            rt.schema.field(c)
+        return self._register_pushed(
+            f"{left}#join",
+            {"kind": "join", "left": left, "right": right,
+             "pairs": [tuple(p) for p in pairs],
+             "out_names": list(out_names),
+             "left_cols": list(left_cols), "right_cols": list(right_cols)})
+
+    def _handle_schema(self, spec) -> Schema:
+        if spec["kind"] == "topn":
+            return self._open(spec["base"]).schema
+        lt, rt = self._open(spec["left"]), self._open(spec["right"])
+        src = [lt.schema.field(c) for c in spec["left_cols"]] \
+            + [rt.schema.field(c) for c in spec["right_cols"]]
+        return Schema(tuple(Field(n, f.type)
+                            for n, f in zip(spec["out_names"], src)))
+
+    def _handle_sources(self, spec) -> list:
+        """[(source_table, source_column)] per output channel."""
+        if spec["kind"] == "topn":
+            return [(spec["base"], f.name)
+                    for f in self._open(spec["base"]).schema.fields]
+        return ([(spec["left"], c) for c in spec["left_cols"]]
+                + [(spec["right"], c) for c in spec["right_cols"]])
 
     # -- dialect hooks (override for non-sqlite drivers) -------------------------
     def _table_names(self, cur) -> list:
@@ -132,15 +239,35 @@ class DbapiConnector:
             con.close()
 
     def schema(self, table: str) -> Schema:
+        spec = self._pushed.get(table)
+        if spec is not None:
+            return self._handle_schema(spec)
         return self._open(table).schema
 
     def dictionaries(self, table: str) -> dict:
+        spec = self._pushed.get(table)
+        if spec is not None:
+            out = {}
+            for name, (src_t, src_c) in zip(
+                    [f.name for f in self._handle_schema(spec).fields],
+                    self._handle_sources(spec)):
+                d = self._open(src_t).dicts.get(src_c)
+                if d is not None:
+                    out[name] = d
+            return out
         return dict(self._open(table).dicts)
 
     def row_count(self, table: str) -> int:
+        spec = self._pushed.get(table)
+        if spec is not None:
+            if spec["kind"] == "topn":
+                return min(spec["n"], self._open(spec["base"]).n_rows)
+            return self._open(spec["left"]).n_rows  # estimate
         return self._open(table).n_rows
 
     def column_range(self, table: str, column: str):
+        if table in self._pushed:
+            return (None, None)
         t = self._open(table)
         if t.schema.field(column).type.is_string:
             return (None, None)
@@ -161,43 +288,94 @@ class DbapiConnector:
         """Contiguous rowid ranges sized so a UNIFORM id distribution yields
         ~split_rows rows each (sparse rowids give uneven but correct splits);
         each range reads independently — O(n) total remote work."""
-        t = self._open(table)
+        spec = self._pushed.get(table)
+        wire = None
+        if spec is not None:
+            # the spec travels with every split: cluster workers never saw
+            # the planning pass and must reconstruct the handle from it
+            wire = tuple(sorted(
+                (k, tuple(v) if isinstance(v, list) else v)
+                for k, v in spec.items()))
+            if spec["kind"] == "topn":
+                # ORDER BY ... LIMIT is a single remote cursor by nature
+                return [DbapiSplit(table, 0, -1, wire)]
+            # joined scans parallelize by the LEFT side's rowid ranges
+            base = spec["left"]
+        else:
+            base = table
+        t = self._open(base)
         if t.n_rows == 0 or t.rid_max < t.rid_min:
-            return [DbapiSplit(table, 0, -1)]
+            return [DbapiSplit(table, 0, -1, wire)]
         span = t.rid_max - t.rid_min + 1
         n_splits = max((t.n_rows + self.split_rows - 1) // self.split_rows, 1)
         step = max((span + n_splits - 1) // n_splits, 1)
-        return [DbapiSplit(table, lo, min(lo + step - 1, t.rid_max))
+        return [DbapiSplit(table, lo, min(lo + step - 1, t.rid_max), wire)
                 for lo in range(t.rid_min, t.rid_max + 1, step)]
+
+    def _pushed_query(self, spec, names, split):
+        """(sql, params) for a virtual handle read, projecting ``names``."""
+        schema = self._handle_schema(spec)
+        srcs = dict(zip([f.name for f in schema.fields],
+                        self._handle_sources(spec)))
+        rid = self._rowid_expr()
+        if spec["kind"] == "topn":
+            sel = ", ".join(f"{_q(srcs[n][1])} as {_q(n)}" for n in names)
+            return (f"select {sel} from {_q(spec['base'])} "
+                    f"order by {spec['order_sql']} limit {spec['n']}", ())
+        sel = ", ".join(
+            f"{'a' if srcs[n][0] == spec['left'] else 'b'}.{_q(srcs[n][1])} "
+            f"as {_q(n)}" for n in names)
+        on = " and ".join(f"a.{_q(lc)} = b.{_q(rc)}"
+                          for lc, rc in spec["pairs"])
+        return (f"select {sel} from {_q(spec['left'])} a "
+                f"join {_q(spec['right'])} b on {on} "
+                f"where a.{rid} between ? and ?", (split.lo, split.hi))
 
     def generate(self, split: DbapiSplit, columns=None) -> Page:
         """One remote query per split: SELECT <projected columns> WHERE the
         rowid range (projection pushdown + split-ranged reads; reference:
-        BaseJdbcClient column pushdown)."""
+        BaseJdbcClient column pushdown).  Virtual handles from applyTopN /
+        applyJoin read their pushed remote query instead."""
         import jax.numpy as jnp
 
-        t = self._open(split.table)
-        names = list(columns) if columns else [f.name for f in t.schema.fields]
-        sel = ", ".join(_q(c) for c in names)
+        spec = self._resolve_spec(split.table, split)
+        if spec is not None:
+            schema = self._handle_schema(spec)
+            srcs = dict(zip([f.name for f in schema.fields],
+                            self._handle_sources(spec)))
+            names = list(columns) if columns \
+                else [f.name for f in schema.fields]
+            sql, params = self._pushed_query(spec, names, split)
+            self.pushed_queries += 1
+        else:
+            t0 = self._open(split.table)
+            schema, srcs = t0.schema, None
+            names = list(columns) if columns \
+                else [f.name for f in schema.fields]
+            sel = ", ".join(_q(c) for c in names)
+            sql = (f"select {sel} from {_q(split.table)} "
+                   f"where {self._rowid_expr()} between ? and ?")
+            params = (split.lo, split.hi)
         con = self._connect()
         try:
             cur = con.cursor()
-            cur.execute(
-                f"select {sel} from {_q(split.table)} "
-                f"where {self._rowid_expr()} between ? and ?",
-                (split.lo, split.hi))
+            cur.execute(sql, params)
             rows = cur.fetchall()
         finally:
             con.close()
         n = len(rows)
         cols_out, nulls_out, fields = [], [], []
         for ci, name in enumerate(names):
-            fld = t.schema.field(name)
+            fld = schema.field(name)
             fields.append(fld)
             raw = [r[ci] for r in rows]
             nm = np.array([v is None for v in raw])
             if fld.type.is_string:
-                idm = t.id_maps[name]
+                if srcs is None:
+                    idm = self._open(split.table).id_maps[name]
+                else:
+                    src_t, src_c = srcs[name]
+                    idm = self._open(src_t).id_maps[src_c]
                 arr = np.empty(n, np.int32)
                 for i, v in enumerate(raw):
                     if v is None:

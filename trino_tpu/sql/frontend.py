@@ -95,11 +95,18 @@ class Planner(SubqueryPlannerMixin, RelationPlannerMixin,
             if q.limit is not None:
                 node = P.Limit(node, q.limit)
             from .exchanges import resolve_distributions
-            from .optimizer import pushdown_aggregations
+            from .optimizer import (pushdown_aggregations, pushdown_joins,
+                                    pushdown_topn)
             from .rules import optimize_plan
 
             out = optimize_plan(P.Output(node, tuple(out_names)))
             out = pushdown_aggregations(out, self.engine.catalogs)
+            # connector pushdowns.  applyJoin runs first; pushdown_topn then
+            # declines handle scans (is_pushdown_handle) — composing a TopN
+            # OVER a pushed join is future work, the v1 contract pushes one
+            # layer per scan
+            out = pushdown_joins(out, self.engine.catalogs)
+            out = pushdown_topn(out, self.engine.catalogs)
             # global distribution planning (AddExchanges product 1): resolve
             # every join's partitioning from the cost model over the whole
             # optimized tree — the per-join frontend estimate only saw its

@@ -113,7 +113,10 @@ def _prune(node: P.PlanNode, required):
             return node, None
         scan = P.TableScan(node.catalog, node.table,
                            tuple(node.columns[i] for i in keep),
-                           Schema(tuple(node.schema.fields[i] for i in keep)))
+                           Schema(tuple(node.schema.fields[i] for i in keep)),
+                           source_tables=node.source_tables)  # provenance
+        # (virtual pushdown handles) must survive pruning: access control
+        # checks it instead of the handle name
         return scan, {old: new for new, old in enumerate(keep)}
 
     if isinstance(node, P.Aggregate):
@@ -226,5 +229,145 @@ def pushdown_aggregations(root, catalogs):
         from .rules import _replace_children
 
         return _replace_children(n, kids)
+
+    return walk(root)
+
+
+def pushdown_topn(root, catalogs):
+    """Connector TopN pushdown (reference: ConnectorMetadata.applyTopN,
+    spi/connector/ConnectorMetadata.java:1663): Limit(Sort(scan-chain)) over
+    a connector that opts in (``supports_topn_pushdown``) rewrites the scan
+    to a virtual handle whose remote read issues ORDER BY ... LIMIT n — n
+    rows cross the wire instead of the table.  The local Sort+Limit STAYS
+    (the reference's topNGuarantee: remote collation may differ), so this is
+    pure transfer savings, never a semantics change."""
+    import dataclasses as _dc
+
+    from . import plan as P
+    from .rules import _replace_children
+
+    def chain_to_scan(n):
+        """-> (scan, channel->column-name map) through pure FieldRef
+        projects; None when anything else intervenes."""
+        if isinstance(n, P.TableScan):
+            return n, {i: c for i, c in enumerate(n.columns)}
+        if isinstance(n, P.Project):
+            sub = chain_to_scan(n.child)
+            if sub is None:
+                return None
+            scan, m = sub
+            out = {}
+            from . import ir as _ir
+
+            for i, e in enumerate(n.exprs):
+                if isinstance(e, _ir.FieldRef) and e.index in m:
+                    out[i] = m[e.index]
+            return scan, out
+        return None
+
+    def walk(n):
+        if isinstance(n, P.Limit) and isinstance(n.child, P.Sort):
+            sort = n.child
+            sub = chain_to_scan(sort.child)
+            if sub is not None:
+                scan, colmap = sub
+                conn = catalogs.get(scan.catalog)
+                if conn is not None \
+                        and getattr(conn, "supports_topn_pushdown", False) \
+                        and not getattr(conn, "is_pushdown_handle",
+                                        lambda t: False)(scan.table) \
+                        and all(k.channel in colmap for k in sort.keys):
+                    order = [(colmap[k.channel], k.ascending, k.nulls_first)
+                             for k in sort.keys]
+                    handle = conn.apply_topn(scan.table, order, n.count)
+                    new_scan = _dc.replace(
+                        scan, table=handle,
+                        source_tables=((scan.catalog, scan.table),))
+                    replaced = _replace_subtree(sort, scan, new_scan)
+                    return _dc.replace(n, child=replaced)
+        kids = tuple(walk(k) for k in n.children)
+        if all(a is b for a, b in zip(kids, n.children)):
+            return n
+        from .rules import _replace_children as _rc
+
+        return _rc(n, kids)
+
+    return walk(root)
+
+
+def _replace_subtree(root, target, replacement):
+    """Rebuild ``root`` with the node ``target`` (by identity) replaced."""
+    import dataclasses as _dc
+
+    from .rules import _replace_children
+
+    if root is target:
+        return replacement
+    kids = tuple(_replace_subtree(c, target, replacement)
+                 for c in root.children)
+    if all(a is b for a, b in zip(kids, root.children)):
+        return root
+    return _replace_children(root, kids)
+
+
+def pushdown_joins(root, catalogs):
+    """Connector join pushdown (reference: ConnectorMetadata.applyJoin,
+    spi/connector/ConnectorMetadata.java:1637): an INNER equi-join whose
+    both sides are bare scans (or FieldRef projections of scans) of the SAME
+    opting-in catalog runs remotely; the engine scans the joined result,
+    split by the left side's rowid ranges.  Residual filters or computed
+    keys block the pushdown (the classic applyJoin contract)."""
+    import dataclasses as _dc
+
+    from . import ir as _ir
+    from . import plan as P
+    from .rules import _replace_children
+
+    def side_info(n):
+        """-> (scan, [output column names per channel]) for a pushable side:
+        bare scan or ONE pure-FieldRef project over a scan covering every
+        output channel."""
+        if isinstance(n, P.TableScan):
+            return n, list(n.columns)
+        if isinstance(n, P.Project) and isinstance(n.child, P.TableScan):
+            scan = n.child
+            names = []
+            for e in n.exprs:
+                if not isinstance(e, _ir.FieldRef) \
+                        or e.index >= len(scan.columns):
+                    return None
+                names.append(scan.columns[e.index])
+            return scan, names
+        return None
+
+    def walk(n):
+        kids = tuple(walk(k) for k in n.children)
+        if not all(a is b for a, b in zip(kids, n.children)):
+            n = _replace_children(n, kids)
+        if isinstance(n, P.Join) and n.kind == "inner" \
+                and n.filter is None and not n.null_aware:
+            ls, rs = side_info(n.left), side_info(n.right)
+            if ls is not None and rs is not None:
+                (lscan, lnames), (rscan, rnames) = ls, rs
+                conn = catalogs.get(lscan.catalog)
+                is_handle = getattr(conn, "is_pushdown_handle",
+                                    lambda t: False) if conn else None
+                if lscan.catalog == rscan.catalog and conn is not None \
+                        and getattr(conn, "supports_join_pushdown", False) \
+                        and not is_handle(lscan.table) \
+                        and not is_handle(rscan.table) \
+                        and all(k < len(lnames) for k in n.left_keys) \
+                        and all(k < len(rnames) for k in n.right_keys) \
+                        and len(n.schema.fields) == len(lnames) + len(rnames):
+                    pairs = [(lnames[a], rnames[b])
+                             for a, b in zip(n.left_keys, n.right_keys)]
+                    out_names = [f.name for f in n.schema.fields]
+                    handle = conn.apply_join(lscan.table, rscan.table, pairs,
+                                             out_names, lnames, rnames)
+                    return P.TableScan(
+                        lscan.catalog, handle, tuple(out_names), n.schema,
+                        source_tables=((lscan.catalog, lscan.table),
+                                       (rscan.catalog, rscan.table)))
+        return n
 
     return walk(root)

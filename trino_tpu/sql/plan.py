@@ -30,12 +30,17 @@ class PlanNode:
 
 @dataclasses.dataclass(frozen=True)
 class TableScan(PlanNode):
-    """reference: sql/planner/plan/TableScanNode.java"""
+    """reference: sql/planner/plan/TableScanNode.java
+
+    ``source_tables``: (catalog, table) provenance when ``table`` is a
+    VIRTUAL connector handle from an optimizer pushdown (applyTopN /
+    applyJoin) — access control checks these instead of the handle."""
 
     catalog: str
     table: str
     columns: tuple  # column names in the connector table
     schema: Schema
+    source_tables: tuple = ()
 
 
 @dataclasses.dataclass(frozen=True)
