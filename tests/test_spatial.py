@@ -1,0 +1,150 @@
+"""Spatial distance join (reference: operator/SpatialJoinOperator.java +
+plugin/trino-geospatial ST_* scalars — round-4 verdict missing item 7).
+
+TPU re-design: points never materialize (st_point is a planner macro);
+ST_Distance lowers to one canonical ir op; a distance-radius predicate over a
+cross join rewrites to a grid-bucketed EQUI-join (cells of size r, build side
+expanded 9x into the 3x3 neighbor shifts via UNION ALL) with the exact
+distance kept as the residual filter — the KDB-tree partitioning of the
+reference, re-planned as one hash join the existing machinery runs."""
+
+import numpy as np
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.sql import plan as P
+from trino_tpu.sql.frontend import compile_sql
+
+
+@pytest.fixture(scope="module")
+def geo():
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    rng = np.random.default_rng(7)
+    n, m = 400, 300
+    A = rng.uniform(0, 100, (n, 2))
+    B = rng.uniform(0, 100, (m, 2))
+    e.execute_sql("create table pts_a (aid bigint, ax double, ay double)")
+    e.execute_sql("create table pts_b (bid bigint, qx double, qy double)")
+    e.execute_sql("insert into pts_a values " + ", ".join(
+        f"({i}, {A[i, 0]:.6f}, {A[i, 1]:.6f})" for i in range(n)))
+    e.execute_sql("insert into pts_b values " + ", ".join(
+        f"({i}, {B[i, 0]:.6f}, {B[i, 1]:.6f})" for i in range(m)))
+    d = np.sqrt(((A[:, None, :] - B[None, :, :]) ** 2).sum(-1))
+    return e, A, B, d
+
+
+def test_distance_scalars(geo):
+    e, A, B, d = geo
+    r = e.execute_sql(
+        "select st_distance(st_point(0.0, 0.0), st_point(3.0, 4.0)) v"
+    ).rows()
+    assert float(r[0][0]) == pytest.approx(5.0)
+    r = e.execute_sql(
+        "select st_x(st_point(ax, ay)) x, st_y(st_point(ax, ay)) y "
+        "from pts_a where aid = 3").rows()
+    assert float(r[0][0]) == pytest.approx(A[3, 0], abs=1e-6)
+    assert float(r[0][1]) == pytest.approx(A[3, 1], abs=1e-6)
+
+
+def test_spatial_join_matches_bruteforce(geo):
+    e, A, B, d = geo
+    for radius in (2.0, 5.0, 11.5):
+        got = int(e.execute_sql(
+            f"""select count(*) c from pts_a, pts_b
+                where st_distance(st_point(ax, ay), st_point(qx, qy))
+                      <= {radius}""").rows()[0][0])
+        assert got == int((d <= radius).sum()), radius
+
+
+def test_spatial_join_plan_uses_grid(geo):
+    e, *_ = geo
+    plan = compile_sql(
+        """select aid, bid from pts_a, pts_b
+           where st_distance(st_point(ax, ay), st_point(qx, qy)) <= 5.0""",
+        e, e.create_session("mem"))
+
+    unions, joins = [], []
+
+    def walk(n):
+        if isinstance(n, P.Union):
+            unions.append(n)
+        if isinstance(n, P.Join):
+            joins.append(n)
+        for c in n.children:
+            walk(c)
+
+    walk(plan)
+    assert unions and len(unions[0].inputs) == 9, "3x3 cell expansion missing"
+    assert joins and joins[0].filter is not None, \
+        "exact distance residual must remain on the join"
+
+
+def test_spatial_join_pairs_unique_and_exact(geo):
+    """Pair-level correctness: no duplicates from the 9-cell expansion, and
+    boundary distances stay exact through the residual filter."""
+    e, A, B, d = geo
+    rows = e.execute_sql(
+        """select aid, bid from pts_a, pts_b
+           where st_distance(st_point(ax, ay), st_point(qx, qy)) <= 3.0
+           order by aid, bid""").rows()
+    got = [(int(a), int(b)) for a, b in rows]
+    assert len(got) == len(set(got)), "duplicate pairs from cell expansion"
+    ai, bi = np.nonzero(d <= 3.0)
+    assert got == sorted(zip(ai.tolist(), bi.tolist()))
+
+
+def test_spatial_join_with_extra_conjuncts(geo):
+    e, A, B, d = geo
+    got = int(e.execute_sql(
+        """select count(*) c from pts_a, pts_b
+           where st_distance(st_point(ax, ay), st_point(qx, qy)) <= 5.0
+             and aid < 200 and bid >= 10""").rows()[0][0])
+    assert got == int((d[:200, 10:] <= 5.0).sum())
+
+
+def test_st_point_standalone_rejected(geo):
+    e, *_ = geo
+    with pytest.raises(Exception, match="st_point"):
+        e.execute_sql("select st_point(1.0, 2.0) p from pts_a limit 1")
+
+
+def test_degenerate_constant_join_not_rewritten(geo):
+    """ON 1 = 2 is an always-empty join, not a cross join: the grid rewrite
+    must not invent rows (post-review hardening)."""
+    e, *_ = geo
+    got = e.execute_sql(
+        """select count(*) c from pts_a a join pts_b b on 1 = 2
+           where st_distance(st_point(ax, ay), st_point(qx, qy)) <= 50.0"""
+    ).rows()
+    assert int(got[0][0]) == 0
+
+
+def test_large_coordinates_stay_exact(geo):
+    """Cell packing runs in int64: coordinates ~4e6 with r=1 (cells ~2^22,
+    past the double-packing precision cliff) must not duplicate pairs."""
+    e, *_ = geo
+    import numpy as np
+
+    e.execute_sql("create table big_a (i bigint, x double, y double)")
+    e.execute_sql("create table big_b (j bigint, x double, y double)")
+    base = 4.0e6
+    A = [(i, base + i * 0.4, base - i * 0.3) for i in range(60)]
+    B = [(j, base + j * 0.4 + 0.05, base - j * 0.3 + 0.05) for j in range(60)]
+    e.execute_sql("insert into big_a values " + ", ".join(
+        f"({i}, {x:.6f}, {y:.6f})" for i, x, y in A))
+    e.execute_sql("insert into big_b values " + ", ".join(
+        f"({j}, {x:.6f}, {y:.6f})" for j, x, y in B))
+    rows = e.execute_sql(
+        """select i, j from big_a, big_b
+           where st_distance(st_point(big_a.x, big_a.y),
+                             st_point(big_b.x, big_b.y)) <= 1.0
+           order by i, j""").rows()
+    got = [(int(a), int(b)) for a, b in rows]
+    assert len(got) == len(set(got)), "duplicate pairs at large coordinates"
+    a = np.array([(x, y) for _, x, y in A])
+    b = np.array([(x, y) for _, x, y in B])
+    d = np.sqrt(((a[:, None, :] - b[None, :, :]) ** 2).sum(-1))
+    ai, bi = np.nonzero(d <= 1.0)
+    assert got == sorted(zip(ai.tolist(), bi.tolist()))

@@ -778,3 +778,58 @@ def register_unixtime_hash_family() -> None:
 
 
 register_unixtime_hash_family()
+
+
+# ------------------------------------------------------------------ geometry
+# Planar-point geometry (reference: plugin/trino-geospatial's ST_* scalars +
+# operator/SpatialJoinOperator.java).  TPU design: a POINT never materializes
+# as a value — st_point(x, y) is a planner MACRO that only exists inside the
+# functions consuming it, so coordinates stay plain double channels and
+# ST_Distance lowers to ONE canonical ir op the spatial-join rule can
+# pattern-match into the grid-bucketed join rewrite (rules.SpatialDistanceJoin).
+
+
+def _point_args(planner, ast_arg, cols, fn_name):
+    F = _rt()
+    if not (isinstance(ast_arg, A.FuncCall) and ast_arg.name == "st_point"
+            and len(ast_arg.args) == 2):
+        raise F.SemanticError(
+            f"{fn_name} expects st_point(x, y) arguments (points are "
+            "planner-level; they do not materialize as values)")
+    x, _ = planner._translate(ast_arg.args[0], cols)
+    y, _ = planner._translate(ast_arg.args[1], cols)
+    return F._coerce(x, DOUBLE), F._coerce(y, DOUBLE)
+
+
+def _build_st_distance(planner, ast, cols):
+    ax, ay = _point_args(planner, ast.args[0], cols, "st_distance")
+    bx, by = _point_args(planner, ast.args[1], cols, "st_distance")
+    return ir.Call("st_distance", (ax, ay, bx, by), DOUBLE), None
+
+
+def _build_st_xy(planner, ast, cols):
+    x, y = _point_args(planner, ast.args[0], cols, ast.name)
+    return (x if ast.name == "st_x" else y), None
+
+
+def _build_st_point(planner, ast, cols):
+    F = _rt()
+    raise F.SemanticError(
+        "st_point(x, y) only exists inside consuming functions "
+        "(st_distance/st_x/st_y); points do not materialize as values")
+
+
+def register_geometry_family() -> None:
+    register("st_point", "scalar",
+             "Planar point constructor (planner macro)", (2, 2),
+             _build_st_point)
+    register("st_distance", "scalar",
+             "Euclidean distance between two st_point values", (2, 2),
+             _build_st_distance)
+    register("st_x", "scalar", "X coordinate of an st_point", (1, 1),
+             _build_st_xy)
+    register("st_y", "scalar", "Y coordinate of an st_point", (1, 1),
+             _build_st_xy)
+
+
+register_geometry_family()

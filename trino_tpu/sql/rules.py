@@ -24,10 +24,10 @@ import dataclasses
 import itertools
 from typing import Optional
 
-from ..page import Schema
+from ..page import Field, Schema
 from . import ir
 from . import plan as P
-from ..types import BOOLEAN
+from ..types import BIGINT, BOOLEAN
 
 __all__ = ["Memo", "GroupRef", "Rule", "IterativeOptimizer", "DEFAULT_RULES",
            "optimize_plan"]
@@ -1003,6 +1003,178 @@ class DedupJoinKeys(Rule):
                                    right_keys=tuple(rk))
 
 
+class SpatialDistanceJoin(Rule):
+    """Rewrite a CROSS join filtered by ``st_distance(...) <= r`` into a
+    grid-bucketed equi-join (reference: operator/SpatialJoinOperator.java +
+    SpatialJoinUtils — the reference partitions geometries with a KDB tree;
+    the TPU re-design buckets points into r-sized grid CELLS and joins on
+    cell id, which is one equi-join the existing hash machinery runs).
+
+    Shape: probe side gains a cell-id channel floor(x/r)*2^32 + floor(y/r);
+    the build side expands 9x (a UNION of the 3x3 neighbor shifts) so every
+    candidate pair shares exactly ONE cell id — no duplicate pairs, since
+    the nine shifted copies of a build row land in nine DISTINCT cells.  The
+    original distance conjunct stays as the join's residual filter for
+    exactness.  O(n*m) cross-join work becomes O(n + 9m + matches).
+
+    Matches Filter(cross Join) — the planner leaves the two-sided distance
+    conjunct as a residual filter ABOVE the cross join — and fires only on
+    the cross-join shape (constant equi keys) so the rewritten join, whose
+    keys are real cell ids, can never re-match."""
+
+    pattern = (P.Filter,)
+
+    _CELL = 1 << 32  # collision-free int64 (cx, cy) packing for |cy| < 2^31
+
+    def apply(self, fnode, memo):
+        node = memo.resolve(fnode.child)
+        if not isinstance(node, P.Join) or node.kind != "inner" \
+                or node.filter is not None:
+            return None
+        if not self._is_cross_shape(node, memo):
+            return None
+        left = memo.resolve(node.left)
+        right = memo.resolve(node.right)
+        n_left = len(left.schema.fields)
+        n_right = len(right.schema.fields)
+        # no instance state: DEFAULT_RULES instances are shared across
+        # concurrently-planning threads
+        hit, dist_conjunct, rest = None, None, []
+        for c in _conjuncts(fnode.predicate):
+            if hit is None:
+                hit = self._match_distance(c, n_left)
+                if hit is not None:
+                    dist_conjunct = c
+                    continue
+            rest.append(c)
+        if hit is None:
+            return None
+        (ax, ay), (bx, by), r = hit
+
+        def cell(x, y, dx, dy):
+            # floor(x/r) (+shift) packed with floor(y/r).  The PACKING runs
+            # in INT64 (cast each floored cell first): packing in doubles
+            # loses ulps past |cell| ~ 2^21 and two neighbor shifts could
+            # round to one id — duplicate pairs both passing the residual.
+            # int64 packing is exact for |cell| < 2^31.
+            fx = ir.Call("cast", (ir.Call("floor", (ir.Call(
+                "divide", (x, ir.Constant(float(r), x.type)), x.type),),
+                x.type),), BIGINT)
+            fy = ir.Call("cast", (ir.Call("floor", (ir.Call(
+                "divide", (y, ir.Constant(float(r), y.type)), y.type),),
+                x.type),), BIGINT)
+            if dx:
+                fx = ir.Call("add", (fx, ir.Constant(int(dx), BIGINT)),
+                             BIGINT)
+            if dy:
+                fy = ir.Call("add", (fy, ir.Constant(int(dy), BIGINT)),
+                             BIGINT)
+            return ir.Call("add", (ir.Call(
+                "multiply", (fx, ir.Constant(int(self._CELL), BIGINT)),
+                BIGINT), fy), BIGINT)
+
+        idf = Field("#cell", BIGINT)  # hidden by the restoring projection
+        lproj = P.Project(
+            node.left,
+            tuple(ir.FieldRef(i, f.type)
+                  for i, f in enumerate(left.schema.fields))
+            + (cell(ax, ay, 0, 0),),
+            Schema(tuple(left.schema.fields) + (idf,)))
+        branches = []
+        bschema = Schema(tuple(right.schema.fields) + (idf,))
+        for dx in (-1, 0, 1):
+            for dy in (-1, 0, 1):
+                branches.append(P.Project(
+                    node.right,
+                    tuple(ir.FieldRef(i, f.type)
+                          for i, f in enumerate(right.schema.fields))
+                    + (cell(bx, by, dx, dy),),
+                    bschema))
+        union = P.Union(tuple(branches), bschema)
+        # the distance conjunct becomes the join's RESIDUAL filter (cell
+        # neighbors can exceed r): left channels unchanged, right channels
+        # shift past the probe-side cell channel
+        remap = {i: i for i in range(n_left)}
+        remap.update({n_left + j: n_left + 1 + j for j in range(n_right)})
+        filt = _map_refs(dist_conjunct, remap)
+        if filt is None:
+            return None
+        jschema = Schema(tuple(lproj.schema.fields)
+                         + tuple(bschema.fields))
+        inner = dataclasses.replace(
+            node, left=lproj, right=union,
+            left_keys=(n_left,), right_keys=(n_right,),
+            schema=jschema, filter=filt)
+        # restore the original channel layout for consumers
+        out_exprs = tuple(
+            ir.FieldRef(i, f.type)
+            for i, f in enumerate(left.schema.fields)) + tuple(
+            ir.FieldRef(n_left + 1 + j, f.type)
+            for j, f in enumerate(right.schema.fields))
+        out = P.Project(inner, out_exprs, node.schema)
+        # remaining conjuncts stay above the restored layout
+        return P.Filter(out, _and_all(rest)) if rest else out
+
+    def _is_cross_shape(self, node, memo) -> bool:
+        """Both equi keys resolve to appended CONSTANT projection channels
+        (the _make_cross_join shape)."""
+        if len(node.left_keys) != 1 or len(node.right_keys) != 1:
+            return False
+        lv = self._key_const(memo.resolve(node.left), node.left_keys[0])
+        rv = self._key_const(memo.resolve(node.right), node.right_keys[0])
+        # both keys constant AND equal non-NULL: ON 1 = 2 is a degenerate
+        # always-empty join, NOT a cross join — rewriting it would invent rows
+        return lv is not None and rv is not None and lv == rv
+
+    @staticmethod
+    def _key_const(child, ch):
+        if isinstance(child, P.Project) and ch < len(child.exprs) \
+                and isinstance(child.exprs[ch], ir.Constant):
+            return child.exprs[ch].value
+        return None
+
+    def _match_distance(self, c, n_left):
+        """-> ((ax, ay), (bx, by), r) with a-side strictly left channels and
+        b-side strictly right (remapped to right-child coordinates)."""
+        if not (isinstance(c, ir.Call) and c.op in ("lt", "lte")):
+            return None
+        d, lim = c.args
+        if not (isinstance(d, ir.Call) and d.op == "st_distance"
+                and isinstance(lim, ir.Constant)
+                and isinstance(lim.value, (int, float)) and lim.value > 0):
+            return None
+        ax, ay, bx, by = d.args
+
+        def side(e):
+            chans: set = set()
+            _ref_channels(e, chans)
+            if not chans:
+                return None
+            if max(chans) < n_left:
+                return "l"
+            if min(chans) >= n_left:
+                return "r"
+            return None
+
+        sides = tuple(side(e) for e in (ax, ay, bx, by))
+        if sides == ("l", "l", "r", "r"):
+            pass
+        elif sides == ("r", "r", "l", "l"):
+            ax, ay, bx, by = bx, by, ax, ay
+        else:
+            return None
+        bmap = {}
+        for e in (bx, by):
+            chans: set = set()
+            _ref_channels(e, chans)
+            bmap.update({ch: ch - n_left for ch in chans})
+        bx = _map_refs(bx, bmap)
+        by = _map_refs(by, bmap)
+        if bx is None or by is None:
+            return None
+        return (ax, ay), (bx, by), float(lim.value)
+
+
 DEFAULT_RULES = (MergeFilters(), MergeLimits(), EliminateLimitZero(),
                  RemoveIdentityProject(), EliminateSortUnderOrderDestroyer(),
                  InferJoinSideFilters(), PushFilterThroughProject(),
@@ -1016,7 +1188,8 @@ DEFAULT_RULES = (MergeFilters(), MergeLimits(), EliminateLimitZero(),
                  EliminateEmptyJoin(), DropEmptyUnionInputs(),
                  MergeAdjacentProjects(), SimplifyFilterPredicate(),
                  RemoveRedundantDistinct(), EvaluateFilterOverValues(),
-                 EvaluateLimitOverValues(), DedupSortKeys(), DedupJoinKeys())
+                 EvaluateLimitOverValues(), DedupSortKeys(), DedupJoinKeys(),
+                 SpatialDistanceJoin())
 
 
 def optimize_plan(root: P.PlanNode) -> P.PlanNode:
