@@ -160,10 +160,13 @@ def test_spooled_result_protocol(tmp_path, tpch_sf001):
         srv.stop()
 
 
-def test_ui_query_drilldown(tpch_sf001):
-    """The web UI's per-query page shows SQL, state, timings, and the plan
-    (reference: core/trino-web-ui's query detail, reduced to server-rendered
-    HTML)."""
+def test_ui_spa_and_json_api(tpch_sf001):
+    """The web UI is a single-page app over JSON endpoints (reference:
+    core/trino-web-ui's React SPA, reduced to one dependency-free page):
+    /ui serves the shell, /ui/api/overview the live query list, and
+    /ui/api/query/<id> the drill-down with SQL/state/plan; the legacy
+    server-rendered /ui/query/<id> deep link still works."""
+    import json as _json
     import urllib.request
 
     from trino_tpu import Engine
@@ -178,22 +181,30 @@ def test_ui_query_drilldown(tpch_sf001):
 
         c = Client(srv.url, catalog="tpch")
         c.execute("select count(*) c from region")
-        overview = urllib.request.urlopen(f"{srv.url}/ui", timeout=10
-                                          ).read().decode()
-        assert "/ui/query/q" in overview  # drill-down links present
-        qid = next(iter(srv.queries))
+        shell = urllib.request.urlopen(f"{srv.url}/ui", timeout=10
+                                       ).read().decode()
+        assert "/ui/api/overview" in shell  # the SPA polls the JSON api
+        assert "/v1/statement" in shell  # the console speaks the protocol
+        over = _json.loads(urllib.request.urlopen(
+            f"{srv.url}/ui/api/overview", timeout=10).read())
+        assert "tpch" in over["catalogs"]
+        assert over["queries"] and over["queries"][0]["state"] == "FINISHED"
+        qid = over["queries"][0]["query_id"]
+        det = _json.loads(urllib.request.urlopen(
+            f"{srv.url}/ui/api/query/{qid}", timeout=30).read())
+        assert det["sql"] == "select count(*) c from region"
+        assert det["state"] == "FINISHED" and det["rows"] == 1
+        assert "Aggregate" in det.get("plan", "") \
+            or "Values" in det.get("plan", "")
+        # legacy server-rendered deep link stays alive
         page = urllib.request.urlopen(f"{srv.url}/ui/query/{qid}",
                                       timeout=30).read().decode()
         assert "select count(*) c from region" in page
-        assert "FINISHED" in page and "plan" in page
-        # the EXPLAIN plan rendered (count(*) pushdown folds the aggregate
-        # into a Values constant)
-        assert "Aggregate" in page or "Values" in page
         import pytest
         import urllib.error
 
         with pytest.raises(urllib.error.HTTPError):
-            urllib.request.urlopen(f"{srv.url}/ui/query/nope", timeout=10)
+            urllib.request.urlopen(f"{srv.url}/ui/api/query/nope", timeout=10)
     finally:
         srv.stop()
 

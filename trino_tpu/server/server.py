@@ -36,6 +36,115 @@ _UI_STYLE = ("<!doctype html><title>trino-tpu</title>"
              "padding:4px 8px;text-align:left}"
              "pre{background:#f6f6f6;padding:8px;overflow-x:auto}</style>")
 
+# the single-page web UI (reference: core/trino-web-ui's React SPA, reduced
+# to one dependency-free page): client-side rendering over /ui/api/*, a
+# query drill-down, and a SQL console that speaks the public /v1/statement
+# protocol (nextUri paging) like every other client.
+_UI_APP = """<!doctype html><html><head><meta charset="utf-8">
+<title>trino-tpu</title><style>
+body{font-family:system-ui,sans-serif;margin:0;background:#f4f5f7;color:#172b4d}
+header{background:#172b4d;color:#fff;padding:10px 24px;display:flex;gap:24px;
+  align-items:baseline}
+header h1{font-size:18px;margin:0}
+header .stat{font-size:13px;opacity:.85}
+main{padding:16px 24px;display:grid;grid-template-columns:1fr 1fr;gap:16px}
+section{background:#fff;border-radius:6px;padding:12px 16px;
+  box-shadow:0 1px 2px rgba(9,30,66,.15)}
+section h2{font-size:14px;margin:0 0 8px;text-transform:uppercase;
+  letter-spacing:.04em;color:#6b778c}
+table{border-collapse:collapse;width:100%;font-size:13px}
+td,th{border-bottom:1px solid #ebecf0;padding:5px 8px;text-align:left}
+tr.q{cursor:pointer}tr.q:hover{background:#f0f4ff}
+.st{padding:1px 7px;border-radius:9px;font-size:11px;font-weight:600}
+.st-FINISHED{background:#e3fcef;color:#006644}
+.st-FAILED,.st-CANCELED{background:#ffebe6;color:#bf2600}
+.st-RUNNING,.st-QUEUED{background:#deebff;color:#0747a6}
+pre{background:#f6f6f6;padding:8px;overflow-x:auto;font-size:12px;
+  white-space:pre-wrap}
+textarea{width:100%;box-sizing:border-box;font-family:ui-monospace,monospace;
+  font-size:13px;min-height:70px}
+button{background:#0052cc;color:#fff;border:0;border-radius:4px;
+  padding:6px 14px;cursor:pointer}
+#results{max-height:320px;overflow:auto}
+</style></head><body>
+<header><h1>trino-tpu</h1><span class="stat" id="stats">loading…</span></header>
+<main>
+<section style="grid-column:1/3"><h2>SQL console</h2>
+<textarea id="sql" placeholder="select …"></textarea>
+<p><button onclick="run()">Run</button> <span id="runstate"></span></p>
+<div id="results"></div></section>
+<section><h2>Queries</h2><table id="qs"><tr><th>id</th><th>state</th>
+<th>user</th><th>elapsed</th><th>rows</th><th>sql</th></tr></table></section>
+<section><h2>Query detail</h2><div id="detail">select a query…</div></section>
+</main><script>
+const esc = s => String(s ?? '').replace(/[&<>"]/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;'}[c]));
+async function refresh(){
+  try{
+    const o = await (await fetch('/ui/api/overview')).json();
+    const mb = o.memory.max_bytes ?
+      ` | memory ${(o.memory.reserved/1e6).toFixed(0)}/` +
+      `${(o.memory.max_bytes/1e6).toFixed(0)} MB` : '';
+    document.getElementById('stats').textContent =
+      `${o.queries.length} queries | catalogs: ${o.catalogs.join(', ')}${mb}`;
+    const t = document.getElementById('qs');
+    t.querySelectorAll('tr.q').forEach(r => r.remove());
+    for(const q of o.queries){
+      const tr = document.createElement('tr');
+      tr.className = 'q';
+      tr.onclick = () => detail(q.query_id);
+      tr.innerHTML = `<td>${esc(q.query_id)}</td>` +
+        `<td><span class="st st-${esc(q.state)}">${esc(q.state)}</span></td>` +
+        `<td>${esc(q.user)}</td><td>${q.elapsed}s</td>` +
+        `<td>${q.rows ?? ''}</td><td><code>${esc(q.sql)}</code></td>`;
+      t.appendChild(tr);
+    }
+  }catch(e){ /* poll again */ }
+}
+async function detail(id){
+  const d = await (await fetch('/ui/api/query/' + encodeURIComponent(id)))
+    .json();
+  let h = `<table><tr><th>state</th><td>${esc(d.state)}</td></tr>` +
+    `<tr><th>user</th><td>${esc(d.user)}</td></tr>` +
+    `<tr><th>elapsed</th><td>${d.elapsed}s</td></tr>` +
+    (d.rows != null ? `<tr><th>rows</th><td>${d.rows}</td></tr>` : '') +
+    `</table><h3>sql</h3><pre>${esc(d.sql)}</pre>`;
+  if(d.error) h += `<h3>error</h3><pre>${esc(d.error)}</pre>`;
+  if(d.plan) h += `<h3>plan</h3><pre>${esc(d.plan)}</pre>`;
+  document.getElementById('detail').innerHTML = h;
+}
+async function run(){
+  const sql = document.getElementById('sql').value.trim();
+  if(!sql) return;
+  const rs = document.getElementById('runstate');
+  rs.textContent = 'running…';
+  try{
+    let r = await (await fetch('/v1/statement',
+      {method:'POST', body: sql})).json();
+    let cols = null, rows = [];
+    while(true){
+      if(r.columns) cols = r.columns;
+      if(r.data) rows.push(...r.data);
+      if(r.error){ rs.textContent = ''; document.getElementById('results')
+        .innerHTML = `<pre>${esc(r.error.message || r.error)}</pre>`; return; }
+      if(!r.nextUri) break;
+      if(!r.data) await new Promise(s => setTimeout(s, 200));  // poll pacing
+      r = await (await fetch(r.nextUri)).json();
+    }
+    rs.textContent = `${rows.length} rows`;
+    let h = '<table><tr>' + (cols||[]).map(
+      c => `<th>${esc(c.name)}</th>`).join('') + '</tr>';
+    for(const row of rows.slice(0, 200))
+      h += '<tr>' + row.map(v => `<td>${esc(v)}</td>`).join('') + '</tr>';
+    document.getElementById('results').innerHTML =
+      h + '</table>' + (rows.length > 200 ?
+        `<p>… ${rows.length - 200} more rows</p>` : '');
+    refresh();
+  }catch(e){ rs.textContent = String(e); }
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>"""
+
 
 @dataclasses.dataclass
 class _Query:
@@ -196,14 +305,27 @@ class CoordinatorServer:
                     self.wfile.write(body)
                     return
                 if parts == ["ui"] or parts == ["ui", ""]:
-                    # reference: core/trino-web-ui's cluster overview, reduced
-                    # to a self-contained status page over the same query data
-                    body = server._ui_html().encode()
+                    # reference: core/trino-web-ui's SPA, reduced to ONE
+                    # self-contained page (inline JS, no build tooling) that
+                    # polls the JSON api below — live overview, per-query
+                    # drill-down, and a SQL console speaking the same
+                    # /v1/statement protocol as every other client
+                    body = _UI_APP.encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/html; charset=utf-8")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                    return
+                if parts == ["ui", "api", "overview"]:
+                    self._send(200, server._ui_overview())
+                    return
+                if len(parts) == 4 and parts[:3] == ["ui", "api", "query"]:
+                    detail = server._ui_query_json(parts[3])
+                    if detail is None:
+                        self._send(404, {"error": "unknown query"})
+                        return
+                    self._send(200, detail)
                     return
                 if len(parts) == 3 and parts[:2] == ["ui", "query"]:
                     # per-query drill-down (reference: the web UI's query
@@ -327,38 +449,60 @@ class CoordinatorServer:
                       f"trino_tpu_query_seconds_total {total:.3f}"]
         return "\n".join(lines) + "\n"
 
-    def _ui_html(self) -> str:
+    def _query_row_count(self, q):
+        """Result row count for UI surfaces: spooled queries hold their rows
+        in segments, not q.rows (which _run empties after spooling)."""
+        if q.segments:
+            return sum(s["rows"] for s in q.segments)
+        return len(q.rows) if q.rows is not None else None
+
+    def _plan_text(self, q):
+        """Best-effort EXPLAIN under the engine lock (every other execution
+        path holds it; planning against catalogs mid-DDL is a race)."""
+        try:
+            with self._engine_lock:
+                r = self.engine.execute_sql(f"explain {q.sql}")
+            return "\n".join(str(row[0]) for row in r.rows())
+        except Exception:
+            return None  # DDL/statements EXPLAIN can't cover
+
+    def _ui_overview(self) -> dict:
+        """JSON cluster overview the SPA polls (reference: the web UI's
+        /ui/api/stats + query list endpoints)."""
         with self._queries_lock:
             qs = sorted(self.queries.values(), key=lambda q: q.created_at,
-                        reverse=True)[:50]
-        import html as _html
-
-        rows = "".join(
-            f"<tr><td><a href='/ui/query/{_html.escape(q.query_id)}'>"
-            f"{_html.escape(q.query_id)}</a></td>"
-            f"<td>{_html.escape(q.state)}</td>"
-            f"<td>{_html.escape(q.user)}</td>"
-            f"<td>{(q.finished_at or time.time()) - q.created_at:.2f}s</td>"
-            f"<td>{len(q.rows) if q.rows is not None else ''}</td>"
-            f"<td><code>{_html.escape(q.sql[:120])}</code></td></tr>"
-            for q in qs)
+                        reverse=True)[:100]
         pool = next((ex.memory_pool
                      for ex in getattr(self.engine, "_all_executors", ())
                      if hasattr(ex, "memory_pool")), None)
-        pool_line = ""
-        if pool is not None:
-            info = pool.info()
-            pool_line = (f" | memory {info['reserved'] / 1e6:.0f}"
-                         f"/{info['max_bytes'] / 1e6:.0f} MB")
-        catalogs = ", ".join(sorted(self.engine.catalogs))
-        return (_UI_STYLE
-                + "<meta http-equiv='refresh' content='5'>"  # live overview
-                + "<h1>trino-tpu coordinator</h1>"
-                f"<p>{len(self.queries)} queries tracked | catalogs: "
-                f"{_html.escape(catalogs)}{pool_line} | "
-                f"<a href='/v1/metrics'>metrics</a></p>"
-                "<table><tr><th>query</th><th>state</th><th>user</th>"
-                f"<th>elapsed</th><th>rows</th><th>sql</th></tr>{rows}</table>")
+        mem = pool.info() if pool is not None else {}
+        return {
+            "catalogs": sorted(self.engine.catalogs),
+            "memory": {"reserved": mem.get("reserved", 0),
+                       "max_bytes": mem.get("max_bytes", 0)},
+            "queries": [{
+                "query_id": q.query_id, "state": q.state, "user": q.user,
+                "elapsed": round((q.finished_at or time.time())
+                                 - q.created_at, 3),
+                "rows": self._query_row_count(q),
+                "sql": q.sql[:200]} for q in qs],
+        }
+
+    def _ui_query_json(self, qid: str):
+        q = self.queries.get(qid)
+        if q is None:
+            return None
+        out = {"query_id": q.query_id, "state": q.state, "user": q.user,
+               "elapsed": round((q.finished_at or time.time())
+                                - q.created_at, 3),
+               "sql": q.sql, "error": q.error,
+               "columns": list(q.columns or ()),
+               "rows": self._query_row_count(q)}
+        if not q.error:
+            plan = self._plan_text(q)
+            if plan is not None:
+                out["plan"] = plan
+        return out
 
     def _ui_query_html(self, qid: str):
         """Query drill-down: full SQL, lifecycle timings, output columns, the
@@ -387,13 +531,10 @@ class CoordinatorServer:
         if q.error:
             parts.append(f"<h2>error</h2><pre>{_html.escape(q.error)}</pre>")
         else:
-            try:
-                r = self.engine.execute_sql(f"explain {q.sql}")
-                plan_text = "\n".join(str(row[0]) for row in r.rows())
+            plan_text = self._plan_text(q)
+            if plan_text is not None:
                 parts.append(f"<h2>plan</h2><pre>{_html.escape(plan_text)}"
                              "</pre>")
-            except Exception:
-                pass  # DDL/statements EXPLAIN can't cover: omit the section
         return "".join(parts)
 
     # -- dispatch -----------------------------------------------------------------

@@ -1062,7 +1062,7 @@ class SpatialDistanceJoin(Rule):
                 x.type),), BIGINT)
             fy = ir.Call("cast", (ir.Call("floor", (ir.Call(
                 "divide", (y, ir.Constant(float(r), y.type)), y.type),),
-                x.type),), BIGINT)
+                y.type),), BIGINT)
             if dx:
                 fx = ir.Call("add", (fx, ir.Constant(int(dx), BIGINT)),
                              BIGINT)
