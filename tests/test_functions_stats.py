@@ -190,3 +190,30 @@ def test_post_review_hardening(eng):
 
 def rows_iter(rows):
     return rows
+
+
+def test_approx_most_frequent(eng):
+    """approx_most_frequent(k, v, cap) -> map(v, bigint) of the top-k value
+    counts (reference: ApproximateMostFrequentHistogram; exact counting over
+    the key-major sort is within the accuracy contract)."""
+    rows = eng.execute_sql(
+        """select l_returnflag, approx_most_frequent(3, l_linenumber, 100) m
+           from lineitem group by l_returnflag order by l_returnflag""").rows()
+    df = eng.execute_sql(
+        "select l_returnflag f, l_linenumber n from lineitem").to_pandas()
+    for flag, m in rows:
+        counts = df[df.f == flag].n.value_counts()
+        want = {int(k): int(v) for k, v in counts.head(3).items()}
+        assert {int(k): int(v) for k, v in m.items()} == want, flag
+    # string-valued global histogram decodes keys through the dictionary
+    g = eng.execute_sql(
+        "select approx_most_frequent(2, o_orderpriority, 10) m from orders"
+    ).rows()[0][0]
+    oc = eng.execute_sql(
+        "select o_orderpriority p from orders").to_pandas().p.value_counts()
+    assert {k: int(v) for k, v in g.items()} \
+        == {k: int(v) for k, v in oc.head(2).items()}
+    # buckets must be a positive integer constant
+    with pytest.raises(Exception, match="buckets"):
+        eng.execute_sql(
+            "select approx_most_frequent(0, l_linenumber, 9) from lineitem")

@@ -1316,7 +1316,8 @@ class DistributedExecutor:
     def _run_aggregate_once(self, node: P.Aggregate):
         """One ladder attempt: returns ((page, dicts), oflow) or None when the
         child has no distributable scan spine."""
-        if any(s.kind in ("approx_percentile", "listagg") for s in node.aggs):
+        if any(s.kind in ("approx_percentile", "listagg",
+                          "approx_most_frequent") for s in node.aggs):
             return self._decline(node, "approx_percentile/listagg run the "
                                        "sort-based local selection")
         stream = self._compile_stream(node.child)

@@ -808,7 +808,8 @@ class LocalExecutor:
         selection is within the function's accuracy contract, and a device
         lexsort beats sketch maintenance when sorts are one fused kernel)."""
         for s in node.aggs:
-            if s.kind not in ("approx_percentile", "listagg"):
+            if s.kind not in ("approx_percentile", "listagg",
+                              "approx_most_frequent"):
                 raise NotImplementedError(
                     "approx_percentile/listagg cannot mix with other "
                     "aggregates yet")
@@ -973,11 +974,95 @@ class LocalExecutor:
             return (gkeys, gknulls, np.arange(g, dtype=np.int32), out_null,
                     out_d)
 
+        def sorted_amf(spec):
+            """approx_most_frequent(buckets, v[, capacity]): the top-k value
+            counts per group as a map(V, bigint).  Reference:
+            operator/aggregation/ApproximateMostFrequentHistogram — a
+            stream-summary sketch; exact counting over the shared key-major
+            sort is within the accuracy contract, the same trade
+            approx_percentile makes (one device lexsort beats sketch
+            maintenance when sorts are one fused kernel)."""
+            from ..ops.arrays import MapData, pack_span
+
+            buckets = int(spec.param)
+            vch = spec.arg.index
+            d = stream.dicts[vch]
+            v = page.columns[vch]
+            vn = page.null_masks[vch]
+            vnull = jnp.zeros((n,), bool) if vn is None else vn
+            lex = [v.astype(jnp.float64) if v.dtype == jnp.float64 else v,
+                   vnull]
+            for k, kn in zip(reversed(kcols), reversed(knulls)):
+                lex.append(k)
+                if kn is not None:
+                    lex.append(kn)
+            lex.append(~valid)
+            idx = jnp.lexsort(tuple(lex))
+            sk = [k[idx] for k in kcols]
+            skn = [None if kn is None else kn[idx] for kn in knulls]
+            svalid = valid[idx]
+            pos = jnp.arange(n)
+            new_group = svalid & (pos == 0)
+            for k, kn in zip(sk, skn):
+                prev = jnp.concatenate([k[:1], k[:-1]])
+                diff = (k != prev) & (pos > 0)
+                if kn is not None:
+                    pn = jnp.concatenate([kn[:1], kn[:-1]])
+                    diff = (diff & ~(kn & pn)) | ((kn != pn) & (pos > 0))
+                new_group = new_group | (svalid & diff)
+            if not key_chs:
+                new_group = svalid & (pos == 0)
+            m = int(jnp.sum(valid))
+            g = int(jnp.sum(new_group)) if key_chs else (1 if m else 0)
+            empty_map = MapData(np.zeros((0,), np.asarray(v).dtype),
+                                np.zeros((0,), np.int64),
+                                spec.arg.type, BIGINT, key_dict=d)
+            if g == 0:
+                return [], [], np.zeros((0,), np.int64), \
+                    np.zeros((0,), bool), empty_map
+            starts = np.asarray(
+                jnp.nonzero(new_group, size=g, fill_value=n)[0])
+            ends = np.concatenate([starts[1:], [m]])
+            got = _host([v[idx], vnull[idx]]
+                        + [k[jnp.asarray(starts)] for k in sk]
+                        + [kn[jnp.asarray(starts)] for kn in skn
+                           if kn is not None])
+            sval_np, svnull_np = got[0], got[1]
+            gkeys = got[2:2 + len(sk)]
+            rest = got[2 + len(sk):]
+            gknulls = []
+            for kn in skn:
+                gknulls.append(None if kn is None else rest.pop(0))
+            key_heap, cnt_heap, spans = [], [], np.zeros(g, np.int64)
+            out_null = np.zeros(g, bool)
+            max_len = 0
+            for gi, (s0, e0) in enumerate(zip(starts, ends)):
+                vv = sval_np[s0:e0][~svnull_np[s0:e0]]
+                start = len(key_heap)
+                if len(vv):
+                    uniq, cnts = np.unique(vv, return_counts=True)
+                    top = np.lexsort((uniq, -cnts))[:buckets]
+                    key_heap.extend(uniq[top].tolist())
+                    cnt_heap.extend(cnts[top].tolist())
+                else:
+                    # NULL-only group: the reference's histogram state is
+                    # never initialized -> NULL (not an empty map)
+                    out_null[gi] = True
+                spans[gi] = pack_span(start, len(key_heap) - start)
+                max_len = max(max_len, len(key_heap) - start)
+            md = MapData(np.asarray(key_heap,
+                                    dtype=np.asarray(sval_np).dtype),
+                         np.asarray(cnt_heap, np.int64),
+                         spec.arg.type, BIGINT, key_dict=d, max_len=max_len)
+            return gkeys, gknulls, spans, out_null, md
+
         out_key_cols = out_key_nulls = None
         agg_vals, agg_nulls, agg_dicts = [], [], []
         for s in node.aggs:
             if s.kind == "listagg":
                 gkeys, gknulls, vals, vnull, d_out = sorted_listagg(s)
+            elif s.kind == "approx_most_frequent":
+                gkeys, gknulls, vals, vnull, d_out = sorted_amf(s)
             else:
                 gkeys, gknulls, vals, vnull = sorted_select(s.arg.index,
                                                             float(s.param))
@@ -1027,7 +1112,8 @@ class LocalExecutor:
         return page, tuple(None for _ in node.aggs)
 
     def _run_aggregate(self, node: P.Aggregate):
-        if any(s.kind in ("approx_percentile", "listagg") for s in node.aggs):
+        if any(s.kind in ("approx_percentile", "listagg",
+                          "approx_most_frequent") for s in node.aggs):
             return self._run_percentile_aggregate(node)
         stream, key_types, acc_specs, acc_exprs, acc_kinds, step = self._agg_compiled(node)
         capacity = node.capacity or DEFAULT_GROUP_CAPACITY

@@ -78,14 +78,30 @@ class MapData:
     value_dict: object = None
     max_len: int = 0
 
+    @staticmethod
+    def _decode_side(vals, d, t):
+        """Dictionary ids -> strings; scaled decimals -> floats; DATE /
+        TIMESTAMP epoch ints -> datetime64 (CLAUDE.md: temporal values decode
+        at every result surface)."""
+        if d is not None:
+            return d.decode(vals.astype(np.int64))
+        if getattr(t, "is_decimal", False):
+            return vals.astype(np.float64) / (10 ** t.scale)
+        name = getattr(t, "name", "")
+        if name == "date":
+            return vals.astype(np.int64).astype("datetime64[D]")
+        if name.startswith("timestamp"):
+            unit = {0: "s", 3: "ms", 6: "us", 9: "ns"}.get(
+                getattr(t, "precision", None))
+            if unit:
+                return vals.astype(np.int64).astype(f"datetime64[{unit}]")
+        return vals
+
     def decode(self, spans: np.ndarray) -> np.ndarray:
         starts = np.asarray(span_start(spans))
         lens = np.asarray(span_len(spans))
-        ks, vs = self.keys, self.values
-        if self.key_dict is not None:
-            ks = self.key_dict.decode(ks.astype(np.int64))
-        if self.value_dict is not None:
-            vs = self.value_dict.decode(vs.astype(np.int64))
+        ks = self._decode_side(self.keys, self.key_dict, self.key_type)
+        vs = self._decode_side(self.values, self.value_dict, self.value_type)
         out = np.empty(len(starts), dtype=object)
         for i, (s, l) in enumerate(zip(starts.tolist(), lens.tolist())):
             out[i] = dict(zip(ks[s:s + l].tolist(), vs[s:s + l].tolist()))

@@ -1132,7 +1132,8 @@ class ClusterCoordinator:
             return
         frag = self._substitute(node, spooled, root=True)
         if isinstance(node, P.Aggregate) and node.keys \
-                and not any(s.kind in ("approx_percentile", "listagg")
+                and not any(s.kind in ("approx_percentile", "listagg",
+                                       "approx_most_frequent")
                             for s in node.aggs):
             spine = self._scan_spine(frag.child)
             if spine is not None:

@@ -266,6 +266,33 @@ class AggregationPlannerMixin:
                         param /= 10 ** pe.type.scale
                     if not 0.0 <= param <= 1.0:
                         raise SemanticError("percentile must be in [0, 1]")
+                if kind == "approx_most_frequent":
+                    def _lit_int(arg, what):
+                        le, _ = self.translate(arg, rel.cols)
+                        # type check too: 2.5 parses as a SCALED decimal int
+                        # constant and would silently read as 25
+                        if not isinstance(le, ir.Constant) \
+                                or not le.type.is_integer \
+                                or not isinstance(le.value, int):
+                            raise SemanticError(
+                                f"approx_most_frequent {what} must be an "
+                                "integer constant")
+                        return int(le.value)
+
+                    buckets = _lit_int(a.args[0], "buckets")
+                    if buckets <= 0:
+                        raise SemanticError(
+                            "approx_most_frequent buckets must be positive")
+                    if len(a.args) > 2:
+                        cap = _lit_int(a.args[2], "capacity")
+                        # the exact computation needs no sketch capacity, but
+                        # the reference rejects capacity < buckets — accepting
+                        # it would break queries on a future sketch impl
+                        if cap < buckets:
+                            raise SemanticError(
+                                "approx_most_frequent capacity must be >= "
+                                "buckets")
+                    param = buckets
                 if kind == "listagg":
                     if not e.type.is_string:
                         raise SemanticError("listagg expects a string argument")

@@ -422,6 +422,13 @@ def _agg_kind(ast: A.FuncCall):
         if not ast.args or isinstance(ast.args[0], A.Star):
             return "count_star", None
         return "count", ast.args[0]
+    if name == "approx_most_frequent":
+        # approx_most_frequent(buckets, value, capacity): VALUE is arg 2
+        if len(ast.args) < 2:
+            raise SemanticError(
+                "approx_most_frequent(buckets, value[, capacity]) needs a "
+                "value argument")
+        return name, ast.args[1]
     return name, ast.args[0]
 
 
@@ -445,6 +452,10 @@ def _agg_type(kind: str, in_type: Type) -> Type:
         return BOOLEAN
     if kind == "listagg":
         return VarcharType.of(None)
+    if kind == "approx_most_frequent":
+        from ..types import MapType
+
+        return MapType.of(in_type, BIGINT)
     return in_type  # min/max/arbitrary/approx_percentile
 
 

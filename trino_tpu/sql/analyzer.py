@@ -35,7 +35,8 @@ class SemanticError(ValueError):
 AGG_FUNCS = {"count", "sum", "avg", "min", "max",
              "stddev", "stddev_pop", "stddev_samp", "variance", "var_pop", "var_samp",
              "approx_distinct", "bool_and", "bool_or", "every", "arbitrary",
-             "any_value", "approx_percentile", "listagg"}
+             "any_value", "approx_percentile", "listagg",
+             "approx_most_frequent"}
 
 
 @dataclasses.dataclass
