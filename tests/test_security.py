@@ -112,7 +112,7 @@ def test_http_auth_and_metrics(tpch_sf001):
         html = urllib.request.urlopen(
             urllib.request.Request(f"{srv.url}/ui", headers=authed),
             timeout=5).read().decode()
-        assert "trino-tpu coordinator" in html
+        assert "<h1>trino-tpu</h1>" in html  # the SPA shell serves authed
     finally:
         srv.stop()
 
