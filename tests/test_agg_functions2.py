@@ -1,0 +1,193 @@
+"""Second aggregate batch: max_by/min_by, array_agg, histogram, map_agg,
+checksum, bitwise_*_agg (reference: operator/aggregation/minmaxby/,
+ArrayAggregation, MapHistogramAggregation, MapAggAggregation,
+ChecksumAggregationFunction, BitwiseAndAggregation test models)."""
+
+import numpy as np
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.memory import MemoryConnector
+
+
+@pytest.fixture(scope="module")
+def aeng():
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table t (g bigint, n bigint, x double, s varchar)", s)
+    e.execute_sql("""insert into t values
+        (1, 10, 1.5, 'a'),
+        (1, 30, 0.5, 'b'),
+        (1, 20, 2.5, 'c'),
+        (2, 7,  9.0, 'd'),
+        (2, 5,  8.0, 'd'),
+        (3, null, 1.0, null)""", s)
+    return e, s
+
+
+def _rows(aeng, sql):
+    e, s = aeng
+    return e.execute_sql(sql, s).to_pandas()
+
+
+def test_max_by_min_by(aeng):
+    r = _rows(aeng, "select g, max_by(s, n) mx, min_by(s, n) mn from t "
+                    "group by g order by g")
+    assert list(r["mx"])[:2] == ["b", "d"]
+    assert list(r["mn"]) [:2]== ["a", "d"]
+    # group 3: ranking value all NULL -> NULL payload
+    assert r["mx"].iloc[2] is None or r["mx"].isna().iloc[2]
+
+
+def test_max_by_numeric_payload(aeng):
+    r = _rows(aeng, "select g, max_by(x, n) v from t group by g order by g")
+    assert list(r["v"])[:2] == [0.5, 9.0]
+
+
+def test_max_by_global(aeng):
+    r = _rows(aeng, "select max_by(s, n) v from t")
+    assert r["v"].iloc[0] == "b"
+
+
+def test_array_agg(aeng):
+    r = _rows(aeng, "select g, array_agg(n) a from t group by g order by g")
+    assert sorted(r["a"].iloc[0]) == [10, 20, 30]
+    assert sorted(r["a"].iloc[1]) == [5, 7]
+    assert r["a"].iloc[2] is None or not isinstance(r["a"].iloc[2], list)
+
+
+def test_array_agg_strings(aeng):
+    r = _rows(aeng, "select g, array_agg(s) a from t group by g order by g")
+    assert sorted(r["a"].iloc[0]) == ["a", "b", "c"]
+
+
+def test_histogram(aeng):
+    r = _rows(aeng, "select g, histogram(s) h from t group by g order by g")
+    assert r["h"].iloc[0] == {"a": 1, "b": 1, "c": 1}
+    assert r["h"].iloc[1] == {"d": 2}
+
+
+def test_map_agg(aeng):
+    r = _rows(aeng, "select g, map_agg(s, n) m from t group by g order by g")
+    assert r["m"].iloc[0] == {"a": 10, "b": 30, "c": 20}
+    # duplicate key 'd': first value kept (documented deviation)
+    assert set(r["m"].iloc[1].keys()) == {"d"}
+
+
+def test_checksum(aeng):
+    r = _rows(aeng, "select g, checksum(n) c from t group by g order by g")
+    # deterministic, order-insensitive, non-trivial
+    r2 = _rows(aeng, "select g, checksum(n) c from (select * from t order by n desc) "
+                     "group by g order by g")
+    assert list(r["c"])[:2] == list(r2["c"])[:2]
+    assert r["c"].iloc[0] != r["c"].iloc[1]
+    # all-NULL group -> NULL
+    assert r["c"].isna().iloc[2]
+
+
+def test_checksum_global_mixes_with_others(aeng):
+    r = _rows(aeng, "select checksum(n) c, count(*) k, sum(n) s from t")
+    assert r["k"].iloc[0] == 6
+    assert r["s"].iloc[0] == 72
+    assert not r["c"].isna().iloc[0]
+
+
+def test_bitwise_aggs(aeng):
+    r = _rows(aeng, "select g, bitwise_and_agg(n) a, bitwise_or_agg(n) o, "
+                    "bitwise_xor_agg(n) x from t group by g order by g")
+    assert list(r["a"])[:2] == [10 & 30 & 20, 7 & 5]
+    assert list(r["o"])[:2] == [10 | 30 | 20, 7 | 5]
+    assert list(r["x"])[:2] == [10 ^ 30 ^ 20, 7 ^ 5]
+    assert r["a"].isna().iloc[2]
+
+
+def test_max_by_string_ranking_is_lexicographic(aeng):
+    """Dictionary ids are insertion-ordered; the ranking must follow VALUES
+    (code-review catch: 'zebra' inserted first must still rank highest)."""
+    e, s = aeng
+    e.execute_sql("create table rk (p varchar, s varchar)", s)
+    e.execute_sql("insert into rk values ('pz', 'zebra'), ('pa', 'apple'), "
+                  "('pm', 'mango')", s)
+    r = e.execute_sql("select max_by(p, s) mx, min_by(p, s) mn from rk",
+                      s).to_pandas()
+    assert r["mx"].iloc[0] == "pz"
+    assert r["mn"].iloc[0] == "pa"
+
+
+def test_checksum_distributed_matches_local():
+    """Distributed accumulators must hash checksum inputs exactly like the
+    local path (code-review catch: raw-sum drift on the mesh)."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual mesh")
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.01))
+    s = e.create_session("tpch")
+    sql = ("select l_returnflag, checksum(l_quantity) c from lineitem "
+           "group by l_returnflag order by l_returnflag")
+    dist = e.execute_sql(sql, s, distributed=True).to_pandas()
+    local = e.execute_sql(sql, s).to_pandas()
+    assert list(dist["c"]) == list(local["c"])
+
+
+def test_sorted_aggs_mix_with_hash_aggs(aeng):
+    """max_by + count/sum in ONE query: planned as per-part aggregations
+    joined on the group keys (the mixed-distinct composition)."""
+    r = _rows(aeng, "select g, max_by(s, n) mx, count(*) k, sum(n) t "
+                    "from t group by g order by g")
+    assert list(r["mx"])[:2] == ["b", "d"]
+    assert list(r["k"]) == [3, 2, 1]
+    assert list(r["t"])[:2] == [60, 12]
+
+
+def test_sorted_agg_mix_global(aeng):
+    r = _rows(aeng, "select max_by(s, n) mx, count(*) k from t")
+    assert r["mx"].iloc[0] == "b"
+    assert r["k"].iloc[0] == 6
+
+
+def test_sorted_agg_all_rows_filtered_out(aeng):
+    """Filters mask lanes without shrinking pages; g==0 with GROUP BY keys
+    must still emit an arity-correct (empty) result (code-review catch)."""
+    r = _rows(aeng, "select g, max_by(s, n) mx from t where n > 100 group by g")
+    assert len(r) == 0
+    assert list(r.columns) == ["g", "mx"]
+    r = _rows(aeng, "select g, approx_percentile(x, 0.5) p from t "
+                    "where n > 100 group by g")
+    assert len(r) == 0 and list(r.columns) == ["g", "p"]
+
+
+def test_mixed_sorted_distinct_rejected(aeng):
+    e, s = aeng
+    with pytest.raises(Exception, match="DISTINCT"):
+        e.execute_sql("select g, approx_distinct(n), max_by(s, n) from t "
+                      "group by g", s)
+
+
+def test_agg_arity_errors(aeng):
+    e, s = aeng
+    for bad in ("checksum()", "histogram()", "array_agg()"):
+        with pytest.raises(Exception, match="argument"):
+            e.execute_sql(f"select {bad} from t", s)
+
+
+def test_wilson_z_zero(aeng):
+    e, s = aeng
+    r = e.execute_sql("select wilson_interval_lower(20, 100, 0) lo, "
+                      "wilson_interval_upper(20, 100, 0) hi from t "
+                      "where n = 5", s).to_pandas()
+    assert abs(r["lo"].iloc[0] - 0.2) < 1e-12
+    assert abs(r["hi"].iloc[0] - 0.2) < 1e-12
+
+
+def test_show_functions_has_new_aggs(aeng):
+    e, s = aeng
+    r = e.execute_sql("show functions", s).to_pandas()
+    names = set(r.iloc[:, 0])
+    for n in ("max_by", "min_by", "array_agg", "histogram", "map_agg",
+              "checksum", "bitwise_and_agg"):
+        assert n in names, n

@@ -1,0 +1,126 @@
+"""Second extended function batch: digests/codecs, HMAC, statistical CDFs,
+JSON parse/format, ISO-8601 breadth, soundex/luhn/concat_ws/from_base
+(reference: operator/scalar/VarbinaryFunctions, MathFunctions, JsonFunctions,
+DateTimeFunctions test models)."""
+
+import base64
+import hashlib
+import hmac
+import math
+import zlib
+
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.memory import MemoryConnector
+
+
+@pytest.fixture(scope="module")
+def feng():
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table t (x double, n bigint, s varchar, j varchar)", s)
+    e.execute_sql("""insert into t values
+        (0.25, 1, 'hello', '[1, 2, 3]'),
+        (0.5,  2, 'world', '{"a": {"b": 7}}'),
+        (0.75, 3, 'MTIzNDU2', '"scalar"'),
+        (0.9,  4, '79927398713', 'not json')""", s)
+    return e, s
+
+
+def _col(feng, expr, order="n"):
+    e, s = feng
+    r = e.execute_sql(f"select {expr} v from t order by {order}", s).to_pandas()
+    return list(r["v"])
+
+
+def _one(feng, expr, where="n = 1"):
+    e, s = feng
+    r = e.execute_sql(f"select {expr} v from t where {where}", s).to_pandas()
+    return r.iloc[0, 0]
+
+
+def test_digests(feng):
+    assert _one(feng, "sha1(s)") == hashlib.sha1(b"hello").hexdigest()
+    assert _one(feng, "sha512(s)") == hashlib.sha512(b"hello").hexdigest()
+    assert _one(feng, "crc32(s)") == zlib.crc32(b"hello") & 0xFFFFFFFF
+    # xxhash64 of 'hello' (public XXH64 vector, seed 0)
+    assert _one(feng, "xxhash64(s)") == 0x26C7827D889F6DA3
+
+
+def test_hmac(feng):
+    for algo in ("md5", "sha1", "sha256", "sha512"):
+        want = hmac.new(b"key", b"hello", algo).hexdigest()
+        assert _one(feng, f"hmac_{algo}(s, 'key')") == want
+
+
+def test_base64(feng):
+    assert _one(feng, "to_base64(s)") == base64.b64encode(b"hello").decode()
+    assert _one(feng, "from_base64(s)", "n = 3") == "123456"
+    assert _one(feng, "to_base64url(s)") == \
+        base64.urlsafe_b64encode(b"hello").decode()
+    assert _one(feng, "from_base64url(s)", "n = 3") == "123456"
+
+
+def test_from_base(feng):
+    assert _one(feng, "from_base('ff', 16)") == 255
+    assert _one(feng, "from_base('101', 2)") == 5
+    assert _one(feng, "from_base(s, 16)") is None  # 'hello' is not hex
+
+
+def test_soundex_luhn(feng):
+    assert _one(feng, "soundex('Robert')") == "R163"
+    assert _one(feng, "soundex(s)", "n = 2") == "W643"  # world
+    assert bool(_one(feng, "luhn_check(s)", "n = 4"))
+    assert _one(feng, "luhn_check(s)", "n = 1") is None  # not digits
+
+
+def test_concat_ws(feng):
+    assert _one(feng, "concat_ws('-', s, 'x')") == "hello-x"
+    assert _one(feng, "concat_ws(', ', 'a', 'b', 'c')") == "a, b, c"
+
+
+def test_json_family(feng):
+    assert _one(feng, "json_parse(j)") == "[1,2,3]"
+    assert _one(feng, "json_parse(j)", "n = 4") is None
+    assert _one(feng, "json_format(j)", "n = 2") == '{"a":{"b":7}}'
+    assert bool(_one(feng, "is_json_scalar(j)", "n = 3"))
+    assert not bool(_one(feng, "is_json_scalar(j)", "n = 1"))
+    assert bool(_one(feng, "json_array_contains(j, 2)"))
+    assert not bool(_one(feng, "json_array_contains(j, 9)"))
+    assert _one(feng, "json_array_get(j, 1)") == "2"
+    assert _one(feng, "json_array_get(j, -1)") == "3"
+    assert _one(feng, "json_array_get(j, 7)") is None
+
+
+def test_iso8601(feng):
+    assert _one(feng, "to_iso8601(date '2024-02-29')") == "2024-02-29"
+    got = _one(feng, "from_iso8601_timestamp('2024-02-29T12:30:45')")
+    assert str(got).startswith("2024-02-29 12:30:45")
+
+
+def test_cdfs(feng):
+    assert abs(_one(feng, "normal_cdf(0, 1, 0)") - 0.5) < 1e-12
+    assert abs(_one(feng, "normal_cdf(0, 1, 1.96)") - 0.9750021) < 1e-6
+    assert abs(_one(feng, "inverse_normal_cdf(0, 1, 0.975)") - 1.959964) < 1e-5
+    assert abs(_one(feng, "beta_cdf(2, 2, 0.5)") - 0.5) < 1e-9
+    lo = _one(feng, "wilson_interval_lower(20, 100, 1.96)")
+    hi = _one(feng, "wilson_interval_upper(20, 100, 1.96)")
+    # known Wilson bounds for 20/100 at z=1.96
+    assert abs(lo - 0.1333) < 5e-4, lo
+    assert abs(hi - 0.2888) < 5e-4, hi
+    assert lo < 0.2 < hi
+
+
+def test_cdf_on_column(feng):
+    got = _col(feng, "normal_cdf(0, 1, x)")
+    want = [0.5 * (1 + math.erf(v / math.sqrt(2)))
+            for v in (0.25, 0.5, 0.75, 0.9)]
+    for g, w in zip(got, want):
+        assert abs(g - w) < 1e-12
+
+
+def test_now(feng):
+    got = _one(feng, "now()")
+    assert str(got).startswith("20")

@@ -42,7 +42,8 @@ from ..parallel.mesh import WORKER_AXIS, worker_mesh
 from ..sql import plan as P
 from ..sql.ir import evaluate, evaluate_predicate
 from .local_executor import (DEFAULT_GROUP_CAPACITY, MAX_GROUP_CAPACITY, LocalExecutor,
-                             MaterializedResult, _accumulators_for, _build_null_stats,
+                             MaterializedResult, _acc_input_expr,
+                             _accumulators_for, _build_null_stats,
                              _compact_part, _finalize_aggs, _gather_build, _limit_page,
                              _materialize, _null_aware_anti, _sort_page,
                              _window_spec_dicts)
@@ -1316,10 +1317,9 @@ class DistributedExecutor:
     def _run_aggregate_once(self, node: P.Aggregate):
         """One ladder attempt: returns ((page, dicts), oflow) or None when the
         child has no distributable scan spine."""
-        if any(s.kind in ("approx_percentile", "listagg",
-                          "approx_most_frequent") for s in node.aggs):
-            return self._decline(node, "approx_percentile/listagg run the "
-                                       "sort-based local selection")
+        if any(s.kind in P.SORTED_AGG_KINDS for s in node.aggs):
+            return self._decline(node, "sort-based aggregates run the "
+                                       "local selection runner")
         stream = self._compile_stream(node.child)
         if stream is None:
             return None
@@ -1330,9 +1330,10 @@ class DistributedExecutor:
 
         acc_specs, acc_exprs, acc_kinds = [], [], []
         for spec in node.aggs:
+            arg = _acc_input_expr(spec)
             for kind, dtype, init in _accumulators_for(spec):
                 acc_specs.append((dtype, init))
-                acc_exprs.append(spec.arg)
+                acc_exprs.append(arg)
                 acc_kinds.append(kind)
         merge_kinds = [_MERGE_KIND[k] for k in acc_kinds]
 
@@ -1443,9 +1444,10 @@ class DistributedExecutor:
         mesh (reference: partial+final AggregationOperator pair)."""
         acc_specs, acc_exprs, acc_kinds = [], [], []
         for spec in node.aggs:
+            arg = _acc_input_expr(spec)
             for kind, dtype, init in _accumulators_for(spec):
                 acc_specs.append((dtype, init))
-                acc_exprs.append(spec.arg)
+                acc_exprs.append(arg)
                 acc_kinds.append(kind)
 
         mesh = self.mesh

@@ -343,8 +343,7 @@ class FaultTolerantExecutor:
         tid = f"frag{self._task_seq}"
         self._task_seq += 1
         if isinstance(node, P.Aggregate) and node.keys \
-                and not any(s.kind in ("approx_percentile", "listagg",
-                                       "approx_most_frequent")
+                and not any(s.kind in P.SORTED_AGG_KINDS
                             for s in node.aggs) \
                 and self._scan_fed(node.child):
             # fine-grained path: per-split-batch partial-aggregation tasks,

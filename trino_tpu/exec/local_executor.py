@@ -555,9 +555,10 @@ class LocalExecutor:
         # expand avg -> (sum, count); build accumulator specs
         acc_specs, acc_exprs, acc_kinds = [], [], []
         for spec in node.aggs:
+            arg = _acc_input_expr(spec)
             for kind, dtype, init in _accumulators_for(spec):
                 acc_specs.append((dtype, init))
-                acc_exprs.append(spec.arg)
+                acc_exprs.append(arg)
                 acc_kinds.append(kind)
 
         @jax.jit
@@ -808,10 +809,10 @@ class LocalExecutor:
         selection is within the function's accuracy contract, and a device
         lexsort beats sketch maintenance when sorts are one fused kernel)."""
         for s in node.aggs:
-            if s.kind not in ("approx_percentile", "listagg",
-                              "approx_most_frequent"):
+            if s.kind not in P.SORTED_AGG_KINDS:
                 raise NotImplementedError(
-                    "approx_percentile/listagg cannot mix with other "
+                    "sort-based aggregates (approx_percentile/listagg/"
+                    "max_by/array_agg/...) cannot mix with other "
                     "aggregates yet")
             if not isinstance(s.arg, FieldRef):
                 raise NotImplementedError(
@@ -838,60 +839,32 @@ class LocalExecutor:
         # ONE key-major sort orders every value channel identically, so the
         # per-agg segment structure is shared: sort by (~valid, keys...,
         # value_null, value) per agg — keys primary, null values last
+        def live_counts(idx, vnull, starts, ends):
+            """Non-null-value rows per [start, end) segment via cumsum of
+            sorted liveness."""
+            live = np.asarray(jnp.cumsum(
+                ((valid & ~vnull)[idx]).astype(jnp.int64)))
+            live_at = lambda i: live[i - 1] if i > 0 else 0
+            return np.array([live_at(e) - live_at(s)
+                             for s, e in zip(starts, ends)])
+
         def sorted_select(vch, p):
             v = page.columns[vch]
             vn = page.null_masks[vch]
             vnull = jnp.zeros((n,), bool) if vn is None else vn
-            lex = [v.astype(jnp.float64) if v.dtype == jnp.float64 else v,
-                   vnull]
-            for k, kn in zip(reversed(kcols), reversed(knulls)):
-                lex.append(k)
-                if kn is not None:
-                    lex.append(kn)
-            lex.append(~valid)
-            idx = jnp.lexsort(tuple(lex))
-            sk = [k[idx] for k in kcols]
-            skn = [None if kn is None else kn[idx] for kn in knulls]
-            sval = v[idx]
-            svnull = vnull[idx]
-            svalid = valid[idx]
-            pos = jnp.arange(n)
-            new_group = svalid & (pos == 0)
-            for k, kn in zip(sk, skn):
-                prev = jnp.concatenate([k[:1], k[:-1]])
-                diff = (k != prev) & (pos > 0)
-                if kn is not None:
-                    pn = jnp.concatenate([kn[:1], kn[:-1]])
-                    diff = (diff & ~(kn & pn)) | ((kn != pn) & (pos > 0))
-                new_group = new_group | (svalid & diff)
-            if not key_chs:
-                new_group = svalid & (pos == 0)
-            m = int(jnp.sum(valid))
-            g = int(jnp.sum(new_group)) if key_chs else (1 if m else 0)
+            idx, sk, skn, starts, ends, m, g = seg_sort(v, vnull)
             if g == 0:
-                return [], [], np.zeros((0,)), np.ones((0,), bool)
-            starts = np.asarray(
-                jnp.nonzero(new_group, size=g, fill_value=n)[0])
-            ends = np.concatenate([starts[1:], [m]])
-            # non-null-value count per group via cumsum of sorted liveness
-            live = np.asarray(jnp.cumsum((svalid & ~svnull).astype(jnp.int64)))
-            live_at = lambda i: live[i - 1] if i > 0 else 0
-            counts = np.array([live_at(e) - live_at(s)
-                               for s, e in zip(starts, ends)])
+                gk, gn = empty_keys()
+                return gk, gn, np.zeros((0,)), np.ones((0,), bool)
+            counts = live_counts(idx, vnull, starts, ends)
             tgt = starts + np.clip(np.round(p * np.maximum(counts - 1, 0)), 0,
                                    np.maximum(counts - 1, 0)).astype(np.int64)
             out_null = counts == 0
             tgt = np.clip(tgt, 0, n - 1)
-            got = _host([sval[jnp.asarray(tgt)]]
-                        + [k[jnp.asarray(starts)] for k in sk]
-                        + [kn[jnp.asarray(starts)] for kn in skn
-                           if kn is not None])
+            got = _host([v[idx][jnp.asarray(tgt)]]
+                        + key_fetches(sk, skn, starts))
             vals = got[0]
-            gkeys = got[1:1 + len(sk)]
-            rest = got[1 + len(sk):]
-            gknulls = []
-            for kn in skn:
-                gknulls.append(None if kn is None else rest.pop(0))
+            gkeys, gknulls = host_group_keys(got, 1, sk, skn, starts)
             return gkeys, gknulls, vals, out_null
 
         def sorted_listagg(spec):
@@ -923,45 +896,15 @@ class LocalExecutor:
             if not asc:
                 okey = ~okey if jnp.issubdtype(okey.dtype, jnp.integer) \
                     else -okey
-            lex = [okey, vnull]
-            for k, kn in zip(reversed(kcols), reversed(knulls)):
-                lex.append(k)
-                if kn is not None:
-                    lex.append(kn)
-            lex.append(~valid)
-            idx = jnp.lexsort(tuple(lex))
-            sk = [k[idx] for k in kcols]
-            skn = [None if kn is None else kn[idx] for kn in knulls]
-            svalid = valid[idx]
-            pos = jnp.arange(n)
-            new_group = svalid & (pos == 0)
-            for k, kn in zip(sk, skn):
-                prev = jnp.concatenate([k[:1], k[:-1]])
-                diff = (k != prev) & (pos > 0)
-                if kn is not None:
-                    pn = jnp.concatenate([kn[:1], kn[:-1]])
-                    diff = (diff & ~(kn & pn)) | ((kn != pn) & (pos > 0))
-                new_group = new_group | (svalid & diff)
-            if not key_chs:
-                new_group = svalid & (pos == 0)
-            m = int(jnp.sum(valid))
-            g = int(jnp.sum(new_group)) if key_chs else (1 if m else 0)
+            idx, sk, skn, starts, ends, m, g = seg_sort(okey, vnull)
             if g == 0:
-                return [], [], np.zeros((0,), np.int32), np.ones((0,), bool), \
+                gk, gn = empty_keys()
+                return gk, gn, np.zeros((0,), np.int32), \
+                    np.ones((0,), bool), \
                     Dictionary(values=np.array([], dtype=object))
-            starts = np.asarray(
-                jnp.nonzero(new_group, size=g, fill_value=n)[0])
-            ends = np.concatenate([starts[1:], [m]])
-            got = _host([v[idx], vnull[idx]]
-                        + [k[jnp.asarray(starts)] for k in sk]
-                        + [kn[jnp.asarray(starts)] for kn in skn
-                           if kn is not None])
+            got = _host([v[idx], vnull[idx]] + key_fetches(sk, skn, starts))
             sval_np, svnull_np = got[0], got[1]
-            gkeys = got[2:2 + len(sk)]
-            rest = got[2 + len(sk):]
-            gknulls = []
-            for kn in skn:
-                gknulls.append(None if kn is None else rest.pop(0))
+            gkeys, gknulls = host_group_keys(got, 2, sk, skn, starts)
             joined, out_null = [], np.zeros(g, bool)
             for gi, (s0, e0) in enumerate(zip(starts, ends)):
                 ids = sval_np[s0:e0][~svnull_np[s0:e0]]
@@ -974,65 +917,31 @@ class LocalExecutor:
             return (gkeys, gknulls, np.arange(g, dtype=np.int32), out_null,
                     out_d)
 
-        def sorted_amf(spec):
-            """approx_most_frequent(buckets, v[, capacity]): the top-k value
-            counts per group as a map(V, bigint).  Reference:
-            operator/aggregation/ApproximateMostFrequentHistogram — a
-            stream-summary sketch; exact counting over the shared key-major
-            sort is within the accuracy contract, the same trade
-            approx_percentile makes (one device lexsort beats sketch
-            maintenance when sorts are one fused kernel)."""
+        def sorted_amf(spec, buckets):
+            """approx_most_frequent(buckets, v[, capacity]) / histogram(v)
+            (buckets=None): value counts per group as a map(V, bigint).
+            Reference: operator/aggregation/ApproximateMostFrequentHistogram
+            (a stream-summary sketch; exact counting over the shared
+            key-major sort is within the accuracy contract, the same trade
+            approx_percentile makes) and MapHistogramAggregation."""
             from ..ops.arrays import MapData, pack_span
 
-            buckets = int(spec.param)
             vch = spec.arg.index
             d = stream.dicts[vch]
             v = page.columns[vch]
             vn = page.null_masks[vch]
             vnull = jnp.zeros((n,), bool) if vn is None else vn
-            lex = [v.astype(jnp.float64) if v.dtype == jnp.float64 else v,
-                   vnull]
-            for k, kn in zip(reversed(kcols), reversed(knulls)):
-                lex.append(k)
-                if kn is not None:
-                    lex.append(kn)
-            lex.append(~valid)
-            idx = jnp.lexsort(tuple(lex))
-            sk = [k[idx] for k in kcols]
-            skn = [None if kn is None else kn[idx] for kn in knulls]
-            svalid = valid[idx]
-            pos = jnp.arange(n)
-            new_group = svalid & (pos == 0)
-            for k, kn in zip(sk, skn):
-                prev = jnp.concatenate([k[:1], k[:-1]])
-                diff = (k != prev) & (pos > 0)
-                if kn is not None:
-                    pn = jnp.concatenate([kn[:1], kn[:-1]])
-                    diff = (diff & ~(kn & pn)) | ((kn != pn) & (pos > 0))
-                new_group = new_group | (svalid & diff)
-            if not key_chs:
-                new_group = svalid & (pos == 0)
-            m = int(jnp.sum(valid))
-            g = int(jnp.sum(new_group)) if key_chs else (1 if m else 0)
-            empty_map = MapData(np.zeros((0,), np.asarray(v).dtype),
-                                np.zeros((0,), np.int64),
-                                spec.arg.type, BIGINT, key_dict=d)
+            idx, sk, skn, starts, ends, m, g = seg_sort(v, vnull)
             if g == 0:
-                return [], [], np.zeros((0,), np.int64), \
-                    np.zeros((0,), bool), empty_map
-            starts = np.asarray(
-                jnp.nonzero(new_group, size=g, fill_value=n)[0])
-            ends = np.concatenate([starts[1:], [m]])
-            got = _host([v[idx], vnull[idx]]
-                        + [k[jnp.asarray(starts)] for k in sk]
-                        + [kn[jnp.asarray(starts)] for kn in skn
-                           if kn is not None])
+                gk, gn = empty_keys()
+                return gk, gn, np.zeros((0,), np.int64), \
+                    np.zeros((0,), bool), \
+                    MapData(np.zeros((0,), np.asarray(v).dtype),
+                            np.zeros((0,), np.int64),
+                            spec.arg.type, BIGINT, key_dict=d)
+            got = _host([v[idx], vnull[idx]] + key_fetches(sk, skn, starts))
             sval_np, svnull_np = got[0], got[1]
-            gkeys = got[2:2 + len(sk)]
-            rest = got[2 + len(sk):]
-            gknulls = []
-            for kn in skn:
-                gknulls.append(None if kn is None else rest.pop(0))
+            gkeys, gknulls = host_group_keys(got, 2, sk, skn, starts)
             key_heap, cnt_heap, spans = [], [], np.zeros(g, np.int64)
             out_null = np.zeros(g, bool)
             max_len = 0
@@ -1041,7 +950,8 @@ class LocalExecutor:
                 start = len(key_heap)
                 if len(vv):
                     uniq, cnts = np.unique(vv, return_counts=True)
-                    top = np.lexsort((uniq, -cnts))[:buckets]
+                    top = np.arange(len(uniq)) if buckets is None \
+                        else np.lexsort((uniq, -cnts))[:buckets]
                     key_heap.extend(uniq[top].tolist())
                     cnt_heap.extend(cnts[top].tolist())
                 else:
@@ -1056,13 +966,261 @@ class LocalExecutor:
                          spec.arg.type, BIGINT, key_dict=d, max_len=max_len)
             return gkeys, gknulls, spans, out_null, md
 
+        def seg_sort(primary, pnull):
+            """Shared segmentation: key-major lexsort with ``primary``
+            ordered inside each group; returns the permutation, sorted keys,
+            and [start, end) group segments."""
+            lex = [primary, pnull]
+            for k, kn in zip(reversed(kcols), reversed(knulls)):
+                lex.append(k)
+                if kn is not None:
+                    lex.append(kn)
+            lex.append(~valid)
+            idx = jnp.lexsort(tuple(lex))
+            sk = [k[idx] for k in kcols]
+            skn = [None if kn is None else kn[idx] for kn in knulls]
+            svalid = valid[idx]
+            pos = jnp.arange(n)
+            new_group = svalid & (pos == 0)
+            for k, kn in zip(sk, skn):
+                prev = jnp.concatenate([k[:1], k[:-1]])
+                diff = (k != prev) & (pos > 0)
+                if kn is not None:
+                    pn2 = jnp.concatenate([kn[:1], kn[:-1]])
+                    diff = (diff & ~(kn & pn2)) | ((kn != pn2) & (pos > 0))
+                new_group = new_group | (svalid & diff)
+            if not key_chs:
+                new_group = svalid & (pos == 0)
+            m = int(jnp.sum(valid))
+            g = int(jnp.sum(new_group)) if key_chs else (1 if m else 0)
+            if g == 0:
+                return (idx, sk, skn, np.zeros(0, np.int64),
+                        np.zeros(0, np.int64), m, 0)
+            starts = np.asarray(
+                jnp.nonzero(new_group, size=g, fill_value=n)[0])
+            ends = np.concatenate([starts[1:], [m]])
+            return idx, sk, skn, starts, ends, m, g
+
+        def host_group_keys(got, ofs, sk, skn, starts):
+            gkeys = got[ofs:ofs + len(sk)]
+            rest = list(got[ofs + len(sk):])
+            gknulls = []
+            for kn in skn:
+                gknulls.append(None if kn is None else rest.pop(0))
+            return gkeys, gknulls
+
+        def key_fetches(sk, skn, starts):
+            return [k[jnp.asarray(starts)] for k in sk] + \
+                [kn[jnp.asarray(starts)] for kn in skn if kn is not None]
+
+        def empty_keys():
+            """Arity-correct zero-group key columns: every helper's g==0
+            return must still carry one (empty) column per GROUP BY key or
+            the assembled page's columns fall short of its schema."""
+            gk = [np.zeros((0,), np.dtype(k.dtype)) for k in kcols]
+            gn = [None if kn is None else np.zeros((0,), bool)
+                  for kn in knulls]
+            return gk, gn
+
+        def sorted_extreme_by(spec):
+            """max_by(x, y)/min_by(x, y): the payload x at each group's
+            extreme ranking value y — the segment boundary of the shared
+            key-major sort (reference:
+            operator/aggregation/minmaxby/MaxByAggregationFunction)."""
+            vch = spec.arg.index
+            pch = int(spec.param)
+            v = page.columns[vch]
+            vd = stream.dicts[vch]
+            if vd is not None and getattr(vd, "values", None) is not None:
+                # string ranking: dictionary ids are insertion-ordered, not
+                # lexicographic — remap through a collation rank LUT (the
+                # sorted_listagg trick) so max_by orders by VALUE
+                rank = np.empty(len(vd.values), np.int64)
+                rank[np.argsort(np.asarray(vd.values, dtype=object))] = \
+                    np.arange(len(vd.values))
+                v = jnp.asarray(rank)[jnp.clip(v, 0, len(rank) - 1)]
+            vn = page.null_masks[vch]
+            vnull = jnp.zeros((n,), bool) if vn is None else vn
+            idx, sk, skn, starts, ends, m, g = seg_sort(v, vnull)
+            d_out = stream.dicts[pch]
+            if g == 0:
+                gk, gn = empty_keys()
+                return gk, gn, np.zeros((0,), np.int64), \
+                    np.zeros((0,), bool), d_out
+            counts = live_counts(idx, vnull, starts, ends)
+            tgt = starts + np.maximum(counts - 1, 0) \
+                if spec.kind == "max_by" else starts
+            out_null = counts == 0
+            tgt = np.clip(tgt, 0, n - 1)
+            pl = page.columns[pch][idx]
+            pn0 = page.null_masks[pch]
+            fetch = [pl[jnp.asarray(tgt)]]
+            if pn0 is not None:
+                fetch.append(pn0[idx][jnp.asarray(tgt)])
+            got = _host(fetch + key_fetches(sk, skn, starts))
+            vals = got[0]
+            ofs = 1
+            if pn0 is not None:
+                out_null = out_null | got[1]
+                ofs = 2
+            gkeys, gknulls = host_group_keys(got, ofs, sk, skn, starts)
+            return gkeys, gknulls, vals, out_null, d_out
+
+        def sorted_array_agg(spec):
+            """array_agg(v): per-group element lists as a span column over an
+            ArrayData heap (reference: operator/aggregation/ArrayAggregation;
+            deviation: NULL elements are dropped and element order is the
+            value order — the spec leaves order undefined without WITHIN
+            GROUP)."""
+            from ..ops.arrays import ArrayData, pack_span
+
+            vch = spec.arg.index
+            d = stream.dicts[vch]
+            elem_t = stream.schema.fields[vch].type
+            v = page.columns[vch]
+            vn = page.null_masks[vch]
+            vnull = jnp.zeros((n,), bool) if vn is None else vn
+            idx, sk, skn, starts, ends, m, g = seg_sort(v, vnull)
+            if g == 0:
+                empty = ArrayData(np.zeros((0,), np.asarray(v).dtype),
+                                  elem_t, elem_dict=d)
+                gk, gn = empty_keys()
+                return gk, gn, np.zeros((0,), np.int64), \
+                    np.zeros((0,), bool), empty
+            got = _host([v[idx], vnull[idx]] + key_fetches(sk, skn, starts))
+            sval_np, svnull_np = got[0], got[1]
+            gkeys, gknulls = host_group_keys(got, 2, sk, skn, starts)
+            heap, spans = [], np.zeros(g, np.int64)
+            out_null = np.zeros(g, bool)
+            max_len = 0
+            for gi, (s0, e0) in enumerate(zip(starts, ends)):
+                vv = sval_np[s0:e0][~svnull_np[s0:e0]]
+                start = len(heap)
+                if len(vv):
+                    heap.extend(vv.tolist())
+                else:
+                    out_null[gi] = True
+                spans[gi] = pack_span(start, len(heap) - start)
+                max_len = max(max_len, len(heap) - start)
+            ad = ArrayData(np.asarray(heap, dtype=np.asarray(sval_np).dtype),
+                           elem_t, elem_dict=d, max_len=max_len)
+            return gkeys, gknulls, spans, out_null, ad
+
+        def sorted_map_agg(spec):
+            """map_agg(k, v): per-group key/value pairs as a span column over
+            MapData heaps (reference: operator/aggregation/MapAggAggregation;
+            deviations: NULL keys are skipped — as the reference does — and
+            duplicate keys keep the FIRST value instead of raising)."""
+            from ..ops.arrays import MapData, pack_span
+
+            kch = spec.arg.index
+            vch2 = int(spec.param)
+            kcol = page.columns[kch]
+            kn0 = page.null_masks[kch]
+            knull = jnp.zeros((n,), bool) if kn0 is None else kn0
+            idx, sk, skn, starts, ends, m, g = seg_sort(kcol, knull)
+            key_t = stream.schema.fields[kch].type
+            val_t = stream.schema.fields[vch2].type
+            kd, vd = stream.dicts[kch], stream.dicts[vch2]
+            if g == 0:
+                empty = MapData(np.zeros((0,), np.asarray(kcol).dtype),
+                                np.zeros((0,), np.int64), key_t, val_t,
+                                key_dict=kd, value_dict=vd)
+                gk, gn = empty_keys()
+                return gk, gn, np.zeros((0,), np.int64), \
+                    np.zeros((0,), bool), empty
+            vcol = page.columns[vch2][idx]
+            vn0 = page.null_masks[vch2]
+            fetch = [kcol[idx], knull[idx], vcol]
+            if vn0 is not None:
+                fetch.append(vn0[idx])
+            got = _host(fetch + key_fetches(sk, skn, starts))
+            skey, sknull, sval = got[0], got[1], got[2]
+            ofs = 3
+            if vn0 is not None:
+                svnul = got[3]
+                ofs = 4
+            else:
+                svnul = np.zeros(len(skey), bool)
+            gkeys, gknulls = host_group_keys(got, ofs, sk, skn, starts)
+            key_heap, val_heap, spans = [], [], np.zeros(g, np.int64)
+            out_null = np.zeros(g, bool)
+            max_len = 0
+            for gi, (s0, e0) in enumerate(zip(starts, ends)):
+                seg = slice(s0, e0)
+                live = ~sknull[seg]
+                kk = skey[seg][live]
+                vv = sval[seg][live]
+                vvn = svnul[seg][live]
+                start = len(key_heap)
+                if len(kk):
+                    # segment is key-sorted: first occurrence of each key
+                    uniq, first = np.unique(kk, return_index=True)
+                    key_heap.extend(uniq.tolist())
+                    # a NULL value decodes to None through the result path
+                    vals = vv[first].astype(object)
+                    vals[vvn[first]] = None
+                    val_heap.extend(vals.tolist())
+                else:
+                    out_null[gi] = True
+                spans[gi] = pack_span(start, len(key_heap) - start)
+                max_len = max(max_len, len(key_heap) - start)
+            vh = np.asarray(val_heap, dtype=object)
+            if not any(x is None for x in val_heap):
+                vh = np.asarray(val_heap, dtype=np.asarray(sval).dtype)
+            md = MapData(np.asarray(key_heap, dtype=np.asarray(skey).dtype),
+                         vh, key_t, val_t, key_dict=kd, value_dict=vd,
+                         max_len=max_len)
+            return gkeys, gknulls, spans, out_null, md
+
+        def sorted_bitwise(spec):
+            """bitwise_and_agg/or_agg/xor_agg: host fold over the shared
+            key-major segments (reference:
+            operator/aggregation/BitwiseAndAggregation et al.)."""
+            fold = {"bitwise_and_agg": np.bitwise_and,
+                    "bitwise_or_agg": np.bitwise_or,
+                    "bitwise_xor_agg": np.bitwise_xor}[spec.kind]
+            vch = spec.arg.index
+            v = page.columns[vch]
+            vn = page.null_masks[vch]
+            vnull = jnp.zeros((n,), bool) if vn is None else vn
+            idx, sk, skn, starts, ends, m, g = seg_sort(v, vnull)
+            if g == 0:
+                gk, gn = empty_keys()
+                return gk, gn, np.zeros((0,), np.int64), np.zeros((0,), bool)
+            got = _host([v[idx], vnull[idx]] + key_fetches(sk, skn, starts))
+            sval_np, svnull_np = got[0], got[1]
+            gkeys, gknulls = host_group_keys(got, 2, sk, skn, starts)
+            vals = np.zeros(g, np.int64)
+            out_null = np.zeros(g, bool)
+            for gi, (s0, e0) in enumerate(zip(starts, ends)):
+                vv = sval_np[s0:e0][~svnull_np[s0:e0]]
+                if len(vv):
+                    vals[gi] = fold.reduce(vv.astype(np.int64))
+                else:
+                    out_null[gi] = True
+            return gkeys, gknulls, vals, out_null
+
         out_key_cols = out_key_nulls = None
         agg_vals, agg_nulls, agg_dicts = [], [], []
         for s in node.aggs:
             if s.kind == "listagg":
                 gkeys, gknulls, vals, vnull, d_out = sorted_listagg(s)
             elif s.kind == "approx_most_frequent":
-                gkeys, gknulls, vals, vnull, d_out = sorted_amf(s)
+                gkeys, gknulls, vals, vnull, d_out = sorted_amf(
+                    s, int(s.param))
+            elif s.kind == "histogram":
+                gkeys, gknulls, vals, vnull, d_out = sorted_amf(s, None)
+            elif s.kind in ("max_by", "min_by"):
+                gkeys, gknulls, vals, vnull, d_out = sorted_extreme_by(s)
+            elif s.kind == "array_agg":
+                gkeys, gknulls, vals, vnull, d_out = sorted_array_agg(s)
+            elif s.kind == "map_agg":
+                gkeys, gknulls, vals, vnull, d_out = sorted_map_agg(s)
+            elif s.kind in ("bitwise_and_agg", "bitwise_or_agg",
+                            "bitwise_xor_agg"):
+                gkeys, gknulls, vals, vnull = sorted_bitwise(s)
+                d_out = None
             else:
                 gkeys, gknulls, vals, vnull = sorted_select(s.arg.index,
                                                             float(s.param))
@@ -1112,8 +1270,7 @@ class LocalExecutor:
         return page, tuple(None for _ in node.aggs)
 
     def _run_aggregate(self, node: P.Aggregate):
-        if any(s.kind in ("approx_percentile", "listagg",
-                          "approx_most_frequent") for s in node.aggs):
+        if any(s.kind in P.SORTED_AGG_KINDS for s in node.aggs):
             return self._run_percentile_aggregate(node)
         stream, key_types, acc_specs, acc_exprs, acc_kinds, step = self._agg_compiled(node)
         capacity = node.capacity or DEFAULT_GROUP_CAPACITY
@@ -2063,6 +2220,20 @@ def _global_init_state(node):
     )
 
 
+def _acc_input_expr(spec: P.AggSpec):
+    """The expression accumulators actually consume for one agg call.
+
+    Lives NEXT TO _accumulators_for because every executor building
+    (acc_specs, acc_exprs) must apply the same transform: checksum
+    accumulates the modular sum of per-row HASHES, not raw values — a
+    builder using spec.arg directly would silently disagree with the
+    local path's results."""
+    arg = spec.arg
+    if spec.kind == "checksum" and arg is not None:
+        arg = Call("hash", (arg,), BIGINT)
+    return arg
+
+
 def _accumulators_for(spec: P.AggSpec):
     """(kind, dtype, init) accumulator list for one agg call."""
     t = spec.type
@@ -2103,6 +2274,13 @@ def _accumulators_for(spec: P.AggSpec):
     if spec.kind == "arbitrary":
         dtype = spec.arg.type.dtype
         return [("min", dtype, hashagg._extreme(dtype, 1))]
+    if spec.kind == "checksum":
+        # order-insensitive MODULAR SUM of splitmix64 row hashes (reference:
+        # ChecksumAggregationFunction combines xxhash64 values; wraparound
+        # int64 sum is the same merge-friendly commutative algebra).
+        # Documented deviations: bigint rendering instead of varbinary, and
+        # string arguments hash their per-query dictionary ids
+        return [("sum", jnp.int64, 0), ("count", jnp.int64, 0)]
     raise NotImplementedError(spec.kind)
 
 
@@ -2173,7 +2351,7 @@ def _finalize_aggs(aggs, acc_cols, n_groups):
             else:
                 out.append(np.array(exact, dtype=object))
             nulls.append(c == 0)
-        elif spec.kind == "sum":
+        elif spec.kind in ("sum", "checksum"):
             s, c = acc_cols[i], acc_cols[i + 1]
             i += 2
             out.append(np.asarray(s).astype(np.dtype(spec.type.dtype)))

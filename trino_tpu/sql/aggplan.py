@@ -53,6 +53,19 @@ class AggregationPlannerMixin:
         # DISTINCT aggregates (min/max ignore distinct): rewrite agg(distinct x) GROUP BY k
         # into a pre-aggregation on (k, x) followed by plain agg(x) GROUP BY k (reference:
         # iterative/rule/SingleDistinctAggregationToGroupBy.java)
+        # sorted-runner aggregates mixing with hash aggregates: compose as
+        # per-part aggregations joined on the group keys
+        sorted_aggs = [a for a in uniq_aggs
+                       if _agg_kind(a)[0] in P.SORTED_AGG_KINDS]
+        if sorted_aggs and len(sorted_aggs) != len(uniq_aggs):
+            if any(a.distinct or a.name == "approx_distinct"
+                   for a in uniq_aggs):
+                raise SemanticError(
+                    "DISTINCT aggregates cannot mix with sort-based "
+                    "aggregates (max_by/array_agg/...) yet")
+            return self._plan_mixed_sorted(q, rel, items, group_asts,
+                                           uniq_aggs, sorted_aggs)
+
         distinct_aggs = [a for a in uniq_aggs
                          if (a.distinct or a.name == "approx_distinct")
                          and a.name not in ("min", "max")]
@@ -163,6 +176,18 @@ class AggregationPlannerMixin:
             node = P.Aggregate(dist, tuple(range(K)), tuple(specs), schema)
             parts.append((node, list(lst), [s.type for s in specs]))
 
+        return self._join_agg_parts(q, items, group_asts, uniq_aggs,
+                                    key_exprs, key_dicts, parts)
+
+    def _join_agg_parts(self, q, items, group_asts, uniq_aggs, key_exprs,
+                        key_dicts, parts):
+        """Join per-part aggregations back on the group keys (single-match:
+        keys are unique per part) and lay the agg outputs back out in call
+        order.  NULL group keys join via coalesce-to-sentinel (IS NOT
+        DISTINCT FROM semantics).  Shared by the mixed-distinct and the
+        mixed sorted/hash compositions."""
+        K = len(group_asts)
+
         def relplan(node):
             cols = [ColumnInfo(None, f.name, f.type,
                                key_dicts[i] if i < K else None)
@@ -185,7 +210,7 @@ class AggregationPlannerMixin:
                     t = base.cols[i].type
                     if t.is_floating:
                         raise SemanticError(
-                            "mixed distinct aggregates over floating group "
+                            "composed aggregate parts over floating group "
                             "keys not supported")
                     sent = -(1 << 62) + 7 \
                         if np.dtype(t.dtype).itemsize >= 8 else -(1 << 30) + 7
@@ -213,6 +238,34 @@ class AggregationPlannerMixin:
         return self._finish_aggregation(q, node, items, group_asts, uniq_aggs,
                                         agg_cols,
                                         [frozenset(range(K))] if K else [])
+
+    def _plan_mixed_sorted(self, q, rel: RelPlan, items, group_asts,
+                           uniq_aggs, sorted_aggs):
+        """Sorted-runner aggregates (max_by/array_agg/histogram/...) alongside
+        hash aggregates: each class aggregates separately over the same input
+        and the parts join back on the group keys — the mixed-distinct
+        composition applied to execution-strategy mixing (reference: the
+        reference runs these in ONE AggregationOperator via per-call
+        accumulators, operator/aggregation/GroupedAggregator; here the two
+        accumulator families live in different runners by design)."""
+        K = len(group_asts)
+        key_exprs, key_dicts = [], []
+        for g in group_asts:
+            e, d = self.translate(g, rel.cols)
+            key_exprs.append(e)
+            key_dicts.append(d)
+        parts = []
+        hash_aggs = [a for a in uniq_aggs if a not in sorted_aggs]
+        for lst in (hash_aggs, sorted_aggs):
+            proj, _, _, p_uniq, p_specs = self._build_agg_projection(
+                rel, group_asts, lst)
+            schema = Schema(tuple(
+                [Field(f"k{i}", e.type) for i, e in enumerate(key_exprs)]
+                + [Field(s.name, s.type) for s in p_specs]))
+            node = P.Aggregate(proj, tuple(range(K)), tuple(p_specs), schema)
+            parts.append((node, list(p_uniq), [s.type for s in p_specs]))
+        return self._join_agg_parts(q, items, group_asts, uniq_aggs,
+                                    key_exprs, key_dicts, parts)
 
     def _resolve_group_ast(self, g, items, rel: RelPlan):
         """GROUP BY element resolution: ordinals and select-list aliases bind before
@@ -309,12 +362,29 @@ class AggregationPlannerMixin:
                         order_ch = len(proj_exprs) + 1
                         asc = si.ascending
                     param = (sep, order_ch, asc)
+                out_type = None
+                extra = None
+                if kind in ("max_by", "min_by"):
+                    # payload x of max_by(x, y) rides the channel after the
+                    # ranking value y; output type is the payload's
+                    extra, _xd = self.translate(a.args[0], rel.cols)
+                    param = len(proj_exprs) + 1
+                    out_type = extra.type
+                elif kind == "map_agg":
+                    from ..types import MapType
+
+                    extra, _xd = self.translate(a.args[1], rel.cols)
+                    param = len(proj_exprs) + 1
+                    out_type = MapType.of(e.type, extra.type)
                 ch = len(proj_exprs)
                 proj_exprs.append(e)
                 if kind == "listagg" and param[1] is not None:
                     proj_exprs.append(oe)
+                if extra is not None:
+                    proj_exprs.append(extra)
                 specs.append(P.AggSpec(kind, ir.FieldRef(ch, e.type), f"agg{j}",
-                                       _agg_type(kind, e.type), param=param))
+                                       out_type or _agg_type(kind, e.type),
+                                       param=param))
         proj_schema = Schema(tuple(Field(f"c{i}", e.type)
                                    for i, e in enumerate(proj_exprs)))
         proj = P.Project(rel.node, tuple(proj_exprs), proj_schema,

@@ -429,6 +429,18 @@ def _agg_kind(ast: A.FuncCall):
                 "approx_most_frequent(buckets, value[, capacity]) needs a "
                 "value argument")
         return name, ast.args[1]
+    if name in ("max_by", "min_by"):
+        # max_by(x, y): the RANKING argument y drives the segment sort; the
+        # payload x rides an extra projected channel (aggplan)
+        if len(ast.args) != 2:
+            raise SemanticError(f"{name}(x, y) takes exactly two arguments")
+        return name, ast.args[1]
+    if name == "map_agg":
+        if len(ast.args) != 2:
+            raise SemanticError("map_agg(key, value) takes two arguments")
+        return name, ast.args[0]
+    if not ast.args:
+        raise SemanticError(f"{name} requires an argument")
     return name, ast.args[0]
 
 
@@ -456,6 +468,19 @@ def _agg_type(kind: str, in_type: Type) -> Type:
         from ..types import MapType
 
         return MapType.of(in_type, BIGINT)
+    if kind == "histogram":
+        from ..types import MapType
+
+        return MapType.of(in_type, BIGINT)
+    if kind == "array_agg":
+        from ..types import ArrayType
+
+        return ArrayType.of(in_type)
+    if kind in ("checksum", "bitwise_and_agg", "bitwise_or_agg",
+                "bitwise_xor_agg"):
+        return BIGINT
+    # max_by/min_by/map_agg output types depend on the OTHER argument's
+    # channel; aggplan overrides the spec type after planning it
     return in_type  # min/max/arbitrary/approx_percentile
 
 

@@ -36,7 +36,10 @@ AGG_FUNCS = {"count", "sum", "avg", "min", "max",
              "stddev", "stddev_pop", "stddev_samp", "variance", "var_pop", "var_samp",
              "approx_distinct", "bool_and", "bool_or", "every", "arbitrary",
              "any_value", "approx_percentile", "listagg",
-             "approx_most_frequent"}
+             "approx_most_frequent",
+             "max_by", "min_by", "array_agg", "histogram", "map_agg",
+             "checksum", "bitwise_and_agg", "bitwise_or_agg",
+             "bitwise_xor_agg"}
 
 
 @dataclasses.dataclass

@@ -664,6 +664,7 @@ _register_migrated_families()
 # extended families (math/bitwise/regexp/url/datetime/string-distance) live in
 # their own module; importing registers them into THIS registry
 from . import functions_ext  # noqa: E402,F401  (import-for-registration)
+from . import functions_ext2  # noqa: E402,F401  (import-for-registration)
 
 _LEGACY_REGISTERED = False
 

@@ -128,6 +128,8 @@ def _prune(node: P.PlanNode, required):
             if spec.kind == "listagg" and spec.param \
                     and spec.param[1] is not None:
                 child_req.add(spec.param[1])  # WITHIN GROUP order channel
+            if spec.kind in ("max_by", "min_by", "map_agg"):
+                child_req.add(int(spec.param))  # payload/value channel
         child, m = _prune(node.child, _closed(node.child, child_req))
         if m:
             keys = tuple(m[k] for k in node.keys)
@@ -141,6 +143,9 @@ def _prune(node: P.PlanNode, required):
                     sep, och, asc = spec.param
                     spec = dataclasses.replace(spec,
                                                param=(sep, m[och], asc))
+                if spec.kind in ("max_by", "min_by", "map_agg"):
+                    spec = dataclasses.replace(spec,
+                                               param=m[int(spec.param)])
                 aggs.append(spec)
             return dataclasses.replace(node, child=child, keys=keys,
                                        aggs=tuple(aggs)), None

@@ -78,6 +78,16 @@ class Project(PlanNode):
         return (self.child,)
 
 
+# Aggregates executed by the sort-based local selection runner (one key-major
+# device lexsort + segment walks) rather than the scatter hash-aggregation
+# path; distributed/FTE planners decline these and route to the local runner.
+SORTED_AGG_KINDS = frozenset({
+    "approx_percentile", "listagg", "approx_most_frequent",
+    "max_by", "min_by", "array_agg", "histogram", "map_agg",
+    "bitwise_and_agg", "bitwise_or_agg", "bitwise_xor_agg",
+})
+
+
 @dataclasses.dataclass(frozen=True)
 class AggSpec:
     """One aggregate call (reference: plan/AggregationNode.Aggregation)."""
