@@ -1,0 +1,182 @@
+"""Tunnel diagnosis: decompose where join-query wall-clock goes on the real
+TPU.  Run on first tunnel contact, BEFORE the bench matrix (fast: ~3 min).
+
+Measures
+  1. per-dispatch overhead: tiny jitted call, chained async calls, scalar
+     device_put, bool() sync, small/large device->host transfers
+  2. a warm TPC-H Q3/Q18 at SF1 with every _host()/__bool__ call site traced
+     and timed, so the per-site tunnel cost is attributable line-by-line.
+
+Writes one JSON blob to scripts/tpu_diag.json (and a readable log to stdout).
+"""
+
+import collections
+import json
+import os
+import sys
+import time
+import traceback
+
+os.environ.pop("JAX_PLATFORMS", None)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+out: dict = {"started_at": time.strftime("%Y-%m-%dT%H:%M:%S%z")}
+
+
+def timed(fn, reps=20, warm=2):
+    for _ in range(warm):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    dev = jax.devices()[0]
+    out["device"] = str(dev)
+    print("device:", dev, flush=True)
+
+    # --- 1. primitive costs -------------------------------------------------
+    @jax.jit
+    def tiny(x):
+        return x + 1
+
+    x = jnp.zeros((8,), jnp.int64)
+    tiny(x).block_until_ready()
+    out["tiny_call_sync_s"] = timed(lambda: tiny(x).block_until_ready())
+
+    def chain10():
+        y = x
+        for _ in range(10):
+            y = tiny(y)
+        y.block_until_ready()
+
+    out["chain10_sync_s"] = timed(chain10, reps=10)
+
+    out["device_put_scalar_s"] = timed(
+        lambda: jax.device_put(np.int64(7)).block_until_ready())
+    big = np.zeros((1 << 20,), np.int64)  # 8 MB
+    out["device_put_8mb_s"] = timed(
+        lambda: jax.device_put(big).block_until_ready(), reps=5)
+
+    db = jax.device_put(big)
+    db.block_until_ready()
+    out["host_pull_8mb_s"] = timed(lambda: np.asarray(db), reps=5)
+    small = jax.device_put(np.zeros((16,), np.int64))
+    small.block_until_ready()
+    out["host_pull_small_s"] = timed(lambda: np.asarray(small))
+    flag = jax.device_put(np.bool_(True))
+    flag.block_until_ready()
+    out["bool_sync_s"] = timed(lambda: bool(flag))
+
+    # async pipelining: N launches then one sync — if per-launch RPC is
+    # pipelined this approaches one RTT, if serial it is N RTTs
+    def launches(n):
+        ys = [tiny(x + i) for i in range(n)]
+        for y in ys:
+            y.block_until_ready()
+
+    out["launch20_pipelined_s"] = timed(lambda: launches(20), reps=5)
+
+    print(json.dumps({k: v for k, v in out.items() if k != "sites"},
+                     indent=1), flush=True)
+
+    # --- 2. traced Q3/Q18 ---------------------------------------------------
+    import trino_tpu.exec.local_executor as LE
+
+    site_time = collections.Counter()
+    site_calls = collections.Counter()
+    site_bytes = collections.Counter()
+    _orig_host = LE._host
+
+    def host_traced(arrs):
+        st = traceback.extract_stack(limit=7)
+        site = " <- ".join(f"{f.name}:{f.lineno}" for f in st[-4:-1])
+        t0 = time.perf_counter()
+        got = _orig_host(arrs)
+        site_time[site] += time.perf_counter() - t0
+        site_calls[site] += 1
+        site_bytes[site] += sum(a.nbytes for a in got if a is not None)
+        return got
+
+    LE._host = host_traced
+
+    import jax._src.array as jarr
+
+    _ob = jarr.ArrayImpl.__bool__
+
+    def bool_traced(self):
+        st = traceback.extract_stack(limit=7)
+        site = "BOOL " + " <- ".join(f"{f.name}:{f.lineno}" for f in st[-4:-1])
+        t0 = time.perf_counter()
+        r = _ob(self)
+        site_time[site] += time.perf_counter() - t0
+        site_calls[site] += 1
+        return r
+
+    jarr.ArrayImpl.__bool__ = bool_traced
+
+    from trino_tpu import Engine
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=1, split_rows=1 << 21))
+    s = e.create_session("tpch")
+    queries = {
+        "q3": """select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+            o_orderdate, o_shippriority from customer, orders, lineitem
+            where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+            and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+            and l_shipdate > date '1995-03-15'
+            group by l_orderkey, o_orderdate, o_shippriority
+            order by revenue desc, o_orderdate limit 10""",
+        "q18": """select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+            sum(l_quantity) from customer, orders, lineitem
+            where o_orderkey in (select l_orderkey from lineitem group by l_orderkey
+                                 having sum(l_quantity) > 300)
+            and c_custkey = o_custkey and o_orderkey = l_orderkey
+            group by 1,2,3,4,5 order by o_totalprice desc, o_orderdate limit 100""",
+    }
+    out["queries"] = {}
+    for name, sql in queries.items():
+        t0 = time.perf_counter()
+        e.execute_sql(sql, s)
+        cold = time.perf_counter() - t0
+        site_time.clear(); site_calls.clear(); site_bytes.clear()
+        t0 = time.perf_counter()
+        e.execute_sql(sql, s)
+        warm = time.perf_counter() - t0
+        traced = sum(site_time.values())
+        sites = [
+            {"site": k, "calls": site_calls[k],
+             "s": round(site_time[k], 4), "bytes": site_bytes.get(k, 0)}
+            for k, _ in site_time.most_common(12)]
+        out["queries"][name] = {
+            "cold_s": round(cold, 2), "warm_s": round(warm, 3),
+            "traced_sync_s": round(traced, 3),
+            "untraced_s": round(warm - traced, 3), "sites": sites}
+        print(f"{name}: cold {cold:.1f}s warm {warm:.3f}s "
+              f"traced-sync {traced:.3f}s untraced {warm - traced:.3f}s",
+              flush=True)
+        for rec in sites:
+            print(f"   {rec['s']:8.4f}s {rec['calls']:3d}x "
+                  f"{rec['bytes']:>10d}B  {rec['site']}", flush=True)
+
+
+try:
+    main()
+except Exception as ex:  # always leave a record
+    out["error"] = f"{type(ex).__name__}: {ex}"
+    traceback.print_exc()
+finally:
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tpu_diag.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote scripts/tpu_diag.json", flush=True)

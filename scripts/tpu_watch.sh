@@ -18,6 +18,11 @@ echo "$(date -Is) watcher start (r05)" >> "$LOG"
 for i in $(seq 1 250); do
   if timeout 150 python -c "import jax; d=jax.devices()[0]; assert d.platform != 'cpu', d" >> "$LOG" 2>&1; then
     echo "$(date -Is) TPU UP on probe $i — starting r05 A/B capture" >> "$LOG"
+    # tunnel diagnosis FIRST (fast): per-dispatch overhead + traced Q3/Q18
+    # sync sites — the data that decides the round-trip-reduction work
+    timeout -k 60 1500 python scripts/tpu_diag.py \
+      > scripts/tpu_diag.out 2>&1
+    echo "$(date -Is) tpu_diag rc=$? : $(tail -c 300 scripts/tpu_diag.json 2>/dev/null)" >> "$LOG"
     for cfg in "sf1_fused:1:1:900:1200" "sf1_unfused:1:0:900:1200" \
                "sf10_fused:10:1:1500:1800" "sf10_unfused:10:0:1500:1800"; do
       IFS=: read -r name sf fused budget tmo <<< "$cfg"
