@@ -184,6 +184,19 @@ def test_wilson_z_zero(aeng):
     assert abs(r["hi"].iloc[0] - 0.2) < 1e-12
 
 
+def test_device_topn_null_ties_break_on_secondary_key(aeng):
+    """NULL primary-key rows must order by the secondary key, not by their
+    arbitrary lane fill values (code-review catch on the device TopN)."""
+    e, s = aeng
+    e.execute_sql("create table nt (a bigint, b bigint)", s)
+    e.execute_sql("insert into nt values (null, 3), (null, 1), (null, 2), "
+                  "(5, 0), (6, 0)", s)
+    r = e.execute_sql("select a, b from nt order by a nulls first, b limit 3",
+                      s).to_pandas()
+    assert list(r["b"]) == [1, 2, 3]
+    assert r["a"].isna().all()
+
+
 def test_show_functions_has_new_aggs(aeng):
     e, s = aeng
     r = e.execute_sql("show functions", s).to_pandas()

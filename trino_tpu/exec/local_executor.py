@@ -256,9 +256,15 @@ class LocalExecutor:
         if isinstance(node, P.Limit):
             if isinstance(node.child, P.Sort):
                 # TopN fusion (reference: LimitPushDown rewrites Sort+Limit to
-                # TopNOperator): select the top N before the full ordering
+                # TopNOperator): select the top N before the full ordering.
+                # Device-resident inputs sort on device and transfer only the
+                # top rows; host pages keep the argpartition path
                 child, dicts = self._execute_to_page(node.child.child)
-                page = _topn_page(child, node.child.keys, node.count, dicts)
+                page = _topn_page_device(child, node.child.keys, node.count,
+                                         dicts)
+                if page is None:
+                    page = _topn_page(child, node.child.keys, node.count,
+                                      dicts)
                 self._record(node, page, t0)
                 return page, dicts
             if not isinstance(node.child, (P.Aggregate, P.Sort, P.Output, P.Window,
@@ -887,11 +893,7 @@ class LocalExecutor:
             od = stream.dicts[order_ch] if order_ch is not None \
                 else stream.dicts[vch]
             if od is not None and getattr(od, "values", None) is not None:
-                # dictionary ids are insertion-ordered; ORDER BY compares
-                # decoded values — rank through a collation LUT
-                rank = np.empty(len(od.values), np.int64)
-                rank[np.argsort(np.asarray(od.values, dtype=object))] = \
-                    np.arange(len(od.values))
+                rank = _collation_rank_lut(od)
                 okey = jnp.asarray(rank)[jnp.clip(okey, 0, len(rank) - 1)]
             if not asc:
                 okey = ~okey if jnp.issubdtype(okey.dtype, jnp.integer) \
@@ -1032,12 +1034,9 @@ class LocalExecutor:
             v = page.columns[vch]
             vd = stream.dicts[vch]
             if vd is not None and getattr(vd, "values", None) is not None:
-                # string ranking: dictionary ids are insertion-ordered, not
-                # lexicographic — remap through a collation rank LUT (the
-                # sorted_listagg trick) so max_by orders by VALUE
-                rank = np.empty(len(vd.values), np.int64)
-                rank[np.argsort(np.asarray(vd.values, dtype=object))] = \
-                    np.arange(len(vd.values))
+                # string ranking: ids are insertion-ordered, not
+                # lexicographic — remap so max_by orders by VALUE
+                rank = _collation_rank_lut(vd)
                 v = jnp.asarray(rank)[jnp.clip(v, 0, len(rank) - 1)]
             vn = page.null_masks[vch]
             vnull = jnp.zeros((n,), bool) if vn is None else vn
@@ -1605,6 +1604,22 @@ class LocalExecutor:
         finally:
             self.memory_pool.free(resv, "group-by")
 
+    def _device_finalize(self, node: P.Aggregate):
+        """Jitted device finalization for one Aggregate's accumulator layout,
+        or None when an agg kind needs the host-exact path.  Cached per node."""
+        hit = self._agg_cache.get(("devfin", id(node)))
+        if hit is not None:
+            return hit[1]
+        try:
+            _device_finalize_plan(node.aggs)  # probe support outside jit
+        except NotImplementedError:
+            self._agg_cache[("devfin", id(node))] = (node, None)
+            return None
+        fin = jax.jit(lambda accs, aggs=node.aggs:
+                      _finalize_aggs_device(aggs, accs))
+        self._agg_cache[("devfin", id(node))] = (node, fin)
+        return fin
+
     def _finalize_groups(self, node: P.Aggregate, stream, state):
         # compact occupied groups ON DEVICE before any host transfer: the table is
         # capacity-sized but group counts are usually tiny, and device->host bandwidth
@@ -1613,20 +1628,36 @@ class LocalExecutor:
         bucket = max(1 << max(n_groups - 1, 1).bit_length(), 64)
         keys, key_nulls, accs = hashagg.compact_groups(state, bucket)
         nk = len(keys)
+        dicts = tuple(stream.dicts[i] for i in node.keys) + tuple(None for _ in node.aggs)
+
+        # DEVICE-RESIDENT finalize (round-5 tunnel fix): the aggregate output
+        # stays on device, so a downstream projection/join/topn consumes it
+        # without the pull-down + re-upload pair the host page costs on
+        # tunneled links (measured: the full-width _host pull here was the
+        # single largest Q3 transfer).  One scalar sync checks the
+        # wide-decimal exact-int64 envelope; outside it, fall through to the
+        # host-exact path below (the _combine_limbs_vec fallback class).
+        fin = self._device_finalize(node)
+        if fin is not None:
+            fin_cols, fin_nulls, bad = fin(tuple(accs))
+            if not bool(bad):
+                out_cols = tuple(k[:n_groups] for k in keys) \
+                    + tuple(c[:n_groups] for c in fin_cols)
+                out_nulls = tuple(kn[:n_groups] for kn in key_nulls) + tuple(
+                    None if fn is None else fn[:n_groups] for fn in fin_nulls)
+                page = Page(node.schema, out_cols, out_nulls, None)
+                return page, dicts
+
         got = _host(list(keys) + list(key_nulls) + list(accs))
         key_cols = [k[:n_groups] for k in got[:nk]]
         key_null_cols = [kn[:n_groups] for kn in got[nk:2 * nk]]
         acc_cols = [a[:n_groups] for a in got[2 * nk:]]
-        # keep the (tiny) aggregate output on the host: downstream breakers
-        # (sort/limit/materialize) are host-side, and a jitted parent transform
-        # device-puts automatically — pushing eagerly would buy extra round-trips
         fin_cols, fin_nulls = _finalize_aggs(node.aggs, acc_cols, n_groups)
         out_cols = key_cols + fin_cols
         arrays = [np.asarray(c) for c in out_cols]
         out_nulls = tuple(kn if kn.any() else None for kn in key_null_cols
                           ) + tuple(fin_nulls)
         page = Page(node.schema, tuple(arrays), out_nulls, None)
-        dicts = tuple(stream.dicts[i] for i in node.keys) + tuple(None for _ in node.aggs)
         return page, dicts
 
     def _run_aggregate_partitioned(self, node: P.Aggregate, parts: int):
@@ -1699,16 +1730,31 @@ class LocalExecutor:
                 capacity *= 4
             page, dicts = self._finalize_groups(node, stream, state)
             pages_out.append(page)
-        # host-side concat: partition outputs are tiny host arrays, and exact
-        # wide-decimal (object) columns must never reach the device
-        cols = tuple(np.concatenate([np.asarray(p.columns[i]) for p in pages_out])
-                     for i in range(len(node.schema.fields)))
+        # host-side concat.  Device-resident finalize makes partition outputs
+        # jnp arrays: pull EVERY partition's columns in one batched _host
+        # call (a serial per-column np.asarray would pay parts x columns
+        # RTTs on tunneled links); exact wide-decimal (object) columns come
+        # from the host-fallback finalize and pass through unchanged
+        flat = []
+        for p in pages_out:
+            flat.extend(p.columns)
+            flat.extend(p.null_masks)
+        flat = _host(flat)
+        w = len(node.schema.fields)
+        host_pages = []
+        for pi in range(len(pages_out)):
+            base = pi * 2 * w
+            host_pages.append((flat[base:base + w],
+                               flat[base + w:base + 2 * w]))
+        cols = tuple(np.concatenate([hp[0][i] for hp in host_pages])
+                     for i in range(w))
         nulls = []
-        for i in range(len(node.schema.fields)):
-            if any(p.null_masks[i] is not None for p in pages_out):
+        for i in range(w):
+            if any(hp[1][i] is not None for hp in host_pages):
                 nulls.append(np.concatenate([
-                    np.asarray(p.null_masks[i]) if p.null_masks[i] is not None
-                    else np.zeros((p.capacity,), bool) for p in pages_out]))
+                    hp[1][i] if hp[1][i] is not None
+                    else np.zeros((len(hp[0][i]),), bool)
+                    for hp in host_pages]))
             else:
                 nulls.append(None)
         return Page(node.schema, cols, tuple(nulls), None), dicts
@@ -2380,6 +2426,92 @@ def _finalize_aggs(aggs, acc_cols, n_groups):
             else:  # counts are 0 for empty groups, never NULL
                 nulls.append(None)
     return out, [None if (m is None or not m.any()) else m for m in nulls]
+
+
+def _device_finalize_plan(aggs):
+    """Raise NotImplementedError when any agg kind lacks a device finalize.
+    Mirrors the branch structure of _finalize_aggs_device."""
+    for spec in aggs:
+        if spec.kind in ("avg", "sum", "checksum", "count", "count_star",
+                         "var_pop", "var_samp", "stddev_pop", "stddev_samp",
+                         "min", "max", "arbitrary", "bool_and", "bool_or"):
+            continue
+        raise NotImplementedError(spec.kind)
+
+
+def _limbs_device(hi, lo):
+    """Two-limb decimal sum recombination on device: exact int64 when the
+    value is inside the +-2^62 envelope (same gate as _combine_limbs_vec);
+    the returned flag marks the out-of-envelope case for host fallback."""
+    approx = hi.astype(jnp.float64) * 4294967296.0 + lo.astype(jnp.float64)
+    bad = jnp.any(jnp.abs(approx) >= float(1 << 62))
+    return hi * (1 << 32) + lo, bad
+
+
+def _finalize_aggs_device(aggs, acc_cols):
+    """Device (jnp) analog of _finalize_aggs: returns (cols, nulls, bad)
+    with ``bad`` a scalar bool — True when a wide-decimal sum leaves the
+    exact-int64 envelope and the caller must redo finalization host-side.
+    Keeping the output on device is the round-5 tunnel fix: the aggregate
+    page feeds downstream jitted consumers without a host round-trip."""
+    out, nulls = [], []
+    bad = jnp.zeros((), bool)
+    i = 0
+    for spec in aggs:
+        if spec.kind == "avg" and spec.arg is not None \
+                and isinstance(spec.arg.type, DecimalType):
+            hi, lo, c = acc_cols[i], acc_cols[i + 1], acc_cols[i + 2]
+            i += 3
+            v, b = _limbs_device(hi, lo)
+            bad = bad | b
+            n = jnp.maximum(c.astype(jnp.int64), 1)
+            a = jnp.abs(v)
+            q = a // n
+            r = a - q * n
+            res = (q + (2 * r >= n)) * jnp.where(v >= 0, 1, -1)
+            out.append(res.astype(jnp.int64))
+            nulls.append(c == 0)
+        elif spec.kind == "avg":
+            s, c = acc_cols[i], acc_cols[i + 1]
+            i += 2
+            out.append((s / jnp.where(c == 0, 1, c)).astype(jnp.float64))
+            nulls.append(c == 0)
+        elif spec.kind == "sum" and isinstance(spec.type, DecimalType):
+            hi, lo, c = acc_cols[i], acc_cols[i + 1], acc_cols[i + 2]
+            i += 3
+            v, b = _limbs_device(hi, lo)
+            bad = bad | b
+            out.append(v)
+            nulls.append(c == 0)
+        elif spec.kind in ("sum", "checksum"):
+            s, c = acc_cols[i], acc_cols[i + 1]
+            i += 2
+            out.append(s.astype(spec.type.dtype))
+            nulls.append(c == 0)
+        elif spec.kind in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+            s, ssq, c = acc_cols[i], acc_cols[i + 1], acc_cols[i + 2]
+            i += 3
+            c_safe = jnp.where(c == 0, 1, c).astype(jnp.float64)
+            m2 = jnp.maximum(ssq - s * s / c_safe, 0.0)
+            if spec.kind.endswith("_pop"):
+                var = m2 / c_safe
+                null = c == 0
+            else:
+                var = jnp.where(c < 2, 0.0, m2 / jnp.where(c < 2, 1, c - 1))
+                null = c < 2
+            out.append(jnp.sqrt(var) if spec.kind.startswith("stddev")
+                       else var)
+            nulls.append(null)
+        else:
+            col = acc_cols[i]
+            i += 1
+            out.append(col.astype(spec.type.dtype))
+            if spec.kind in ("min", "max", "arbitrary", "bool_and", "bool_or"):
+                k0, dt0, init0 = _accumulators_for(spec)[0][:3]
+                nulls.append(col == jnp.asarray(init0, col.dtype))
+            else:  # counts are 0 for empty groups, never NULL
+                nulls.append(None)
+    return tuple(out), tuple(nulls), bad
 
 
 @partial(jax.jit, static_argnums=(3,))
@@ -3171,6 +3303,73 @@ def _topn_page(page: Page, keys, count: int, dicts=None) -> Page:
                         tuple(col[mask] for col in pcols),
                         tuple(None if m is None else m[mask] for m in pnulls), None)
     return _limit_page(_sort_page(page, keys, dicts), count)
+
+
+def _collation_rank_lut(d):
+    """id -> collation-rank LUT for a values dictionary, cached on the
+    Dictionary instance (ids are insertion-ordered, ORDER BY compares decoded
+    values).  Shared by listagg ordering, max_by/min_by ranking, and device
+    TopN."""
+    lut = getattr(d, "_rank_lut", None)
+    if lut is None or len(lut) != len(d.values):
+        lut = np.empty(len(d.values), np.int64)
+        lut[np.argsort(np.asarray(d.values, dtype=object))] = \
+            np.arange(len(d.values))
+        try:
+            object.__setattr__(d, "_rank_lut", lut)
+        except Exception:
+            pass
+    return lut
+
+
+def _topn_page_device(page: Page, keys, count: int, dicts=None):
+    """Device-side TopN: one lexsort over collation-ranked keys, gather the
+    top ``count`` rows, transfer ONLY those.  The host path pulls the whole
+    input page (often a 100k+-row aggregate output) before sorting — on a
+    tunneled device that transfer dominates join-query wall clock (round-5
+    Q3 finding).  Returns None when the page is host-resident or a sort key
+    cannot rank on device (formatter dictionaries, object-dtype decimals);
+    the caller falls back to the host path."""
+    if not page.capacity \
+            or not all(isinstance(c, jax.Array) for c in page.columns):
+        return None
+    lex = []
+    for k in reversed(keys):
+        c = page.columns[k.channel]
+        t = page.schema.fields[k.channel].type
+        d = dicts[k.channel] if dicts is not None else None
+        if t.is_string:
+            if d is None or getattr(d, "values", None) is None:
+                return None
+            rank = _collation_rank_lut(d)
+            c = jnp.asarray(rank)[jnp.clip(c, 0, max(len(rank) - 1, 0))]
+        if c.dtype == bool:
+            c = c.astype(jnp.int8)
+        nm = page.null_masks[k.channel]
+        if nm is not None:
+            # NULL lanes hold arbitrary fill values: pin them to one constant
+            # so secondary keys keep breaking ties among NULL rows (the host
+            # path's equivalent pin in _sort_page)
+            c = jnp.where(nm, jnp.zeros((), c.dtype), c)
+        if not k.ascending:
+            c = ~c if jnp.issubdtype(c.dtype, jnp.integer) else -c
+        lex.append(c)
+        # null placement outranks the value ordering for this key
+        ind = jnp.zeros(c.shape, jnp.int8) if nm is None \
+            else nm.astype(jnp.int8)
+        lex.append(-ind if k.nulls_first else ind)
+    valid = page.valid_mask()
+    lex.append(~valid)  # invalid lanes last — top-count rows are live ones
+    idx = jnp.lexsort(tuple(lex))[:count]
+    nc = len(page.columns)
+    fetch = [c[idx] for c in page.columns] \
+        + [None if nm is None else nm[idx] for nm in page.null_masks] \
+        + [valid[idx]]
+    got = _host(fetch)
+    v = got[-1]
+    cols = tuple(c[v] for c in got[:nc])
+    nulls = tuple(None if nm is None else nm[v] for nm in got[nc:2 * nc])
+    return Page(page.schema, cols, nulls, None)
 
 
 def _limit_page(page: Page, count: int) -> Page:
