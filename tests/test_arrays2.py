@@ -1,0 +1,91 @@
+"""Array function batch 2: arrays_overlap, slice, trim_array, array_remove,
+array_distinct, array_sort, repeat (reference: operator/scalar/
+ArraysOverlapFunction, ArraySliceFunction, ArrayTrimFunction,
+ArrayRemoveFunction, ArrayDistinctFunction, ArraySortFunction,
+RepeatFunction)."""
+
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.memory import MemoryConnector
+
+
+@pytest.fixture(scope="module")
+def aeng():
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table t (a array(bigint), b array(bigint), "
+                  "sa array(varchar), n bigint)", s)
+    e.execute_sql("insert into t values "
+                  "(array[1,2,3], array[3,4], array['b','a','b'], 1), "
+                  "(array[5,6], array[7,8], array['z'], 2), "
+                  "(null, array[1], null, 3)", s)
+    return e, s
+
+
+def _rows(aeng, sql):
+    e, s = aeng
+    return e.execute_sql(sql, s).to_pandas()
+
+
+def test_arrays_overlap(aeng):
+    r = _rows(aeng, "select n, arrays_overlap(a, b) o from t order by n")
+    assert list(r["o"])[:2] == [True, False]
+    assert r["o"].iloc[2] is None or r["o"].isna().iloc[2]
+
+
+def test_slice(aeng):
+    r = _rows(aeng, "select n, slice(a, 2, 2) s, slice(a, -2, 2) s2 "
+                    "from t order by n")
+    assert r["s"].iloc[0] == [2, 3]
+    assert r["s"].iloc[1] == [6]
+    assert r["s2"].iloc[0] == [2, 3]
+    # start = 0 is invalid -> NULL (reference raises; LUT design yields NULL)
+    r = _rows(aeng, "select slice(a, 0, 1) s from t where n = 1")
+    assert r["s"].iloc[0] is None or r["s"].isna().iloc[0]
+
+
+def test_trim_array(aeng):
+    r = _rows(aeng, "select n, trim_array(a, 1) tr from t order by n")
+    assert r["tr"].iloc[0] == [1, 2]
+    assert r["tr"].iloc[1] == [5]
+    r = _rows(aeng, "select trim_array(a, 9) tr from t where n = 1")
+    assert r["tr"].iloc[0] == []
+
+
+def test_array_remove(aeng):
+    r = _rows(aeng, "select array_remove(a, 3) x from t order by n")
+    assert r["x"].iloc[0] == [1, 2]
+    assert r["x"].iloc[1] == [5, 6]
+    r = _rows(aeng, "select array_remove(sa, 'b') x from t where n = 1")
+    assert r["x"].iloc[0] == ["a"]
+
+
+def test_array_distinct_sort_repeat(aeng):
+    r = _rows(aeng, "select array_distinct(array[3,1,3,2,1]) d, "
+                    "array_sort(array[3,1,2]) s, "
+                    "array_sort(array['b','a','c']) ss, "
+                    "repeat(7, 3) rp from t where n = 1")
+    assert r["d"].iloc[0] == [3, 1, 2]
+    assert r["s"].iloc[0] == [1, 2, 3]
+    assert list(r["ss"].iloc[0]) == ["a", "b", "c"]
+    assert r["rp"].iloc[0] == [7, 7, 7]
+
+
+def test_slice_negative_start_past_head(aeng):
+    """|negative start| > cardinality selects nothing (code-review catch)."""
+    r = _rows(aeng, "select slice(a, -5, 2) s from t where n = 1")
+    assert r["s"].iloc[0] == []
+
+
+def test_array_remove_null_value(aeng):
+    """array_remove(arr, NULL) is NULL (code-review catch)."""
+    r = _rows(aeng, "select array_remove(a, null) x from t where n = 1")
+    assert r["x"].iloc[0] is None or r["x"].isna().iloc[0]
+
+
+def test_composition_with_lambdas(aeng):
+    r = _rows(aeng, "select cardinality(filter(slice(a, 1, 3), x -> x > 1)) c "
+                    "from t where n = 1")
+    assert r["c"].iloc[0] == 2

@@ -1,0 +1,461 @@
+"""TPC-DS query breadth, round 5 batch 2: demographic band predicates,
+inventory pivots, channel set-ops (INTERSECT/EXCEPT), correlated
+excess-discount, order-shipping semi/anti joins, income-band lookups.
+Reference corpus: testing/trino-benchmark-queries/ + plugin/trino-tpcds."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.tpcds import TpcdsConnector
+
+from test_tpcds2 import _table
+from test_tpcds3 import _check
+
+SF = 0.01
+
+
+def _dec2(x):
+    """Engine avg over scale-2 decimals rounds HALF_UP to scale 2; mirror it
+    so float means compare exactly."""
+    return np.floor(np.asarray(x, dtype=float) * 100 + 0.5) / 100
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = Engine()
+    e.register_catalog("tpcds", TpcdsConnector(sf=SF, split_rows=1 << 14))
+    return e, e.create_session("tpcds")
+
+
+@pytest.fixture(scope="module")
+def host(eng):
+    e, _ = eng
+    conn = e.catalogs["tpcds"]
+    return {
+        "store_sales": _table(conn, "store_sales", [
+            "ss_sold_date_sk", "ss_item_sk", "ss_store_sk", "ss_customer_sk",
+            "ss_cdemo_sk", "ss_hdemo_sk", "ss_addr_sk", "ss_ticket_number",
+            "ss_quantity", "ss_list_price", "ss_sales_price",
+            "ss_ext_sales_price", "ss_ext_wholesale_cost", "ss_coupon_amt",
+            "ss_net_profit"]),
+        "store_returns": _table(conn, "store_returns", [
+            "sr_returned_date_sk", "sr_item_sk", "sr_customer_sk",
+            "sr_ticket_number", "sr_return_quantity", "sr_reason_sk"]),
+        "catalog_sales": _table(conn, "catalog_sales", [
+            "cs_sold_date_sk", "cs_item_sk", "cs_bill_customer_sk",
+            "cs_warehouse_sk", "cs_order_number", "cs_quantity",
+            "cs_sales_price", "cs_ext_discount_amt", "cs_ext_sales_price",
+            "cs_ext_ship_cost", "cs_net_profit"]),
+        "catalog_returns": _table(conn, "catalog_returns", [
+            "cr_returned_date_sk", "cr_item_sk", "cr_order_number",
+            "cr_return_quantity", "cr_return_amount", "cr_call_center_sk",
+            "cr_returning_customer_sk"]),
+        "web_sales": _table(conn, "web_sales", [
+            "ws_sold_date_sk", "ws_ship_date_sk", "ws_item_sk",
+            "ws_bill_customer_sk", "ws_warehouse_sk", "ws_order_number",
+            "ws_ext_ship_cost", "ws_net_profit", "ws_ext_sales_price",
+            "ws_ship_addr_sk", "ws_web_site_sk"]),
+        "web_returns": _table(conn, "web_returns", [
+            "wr_order_number", "wr_item_sk", "wr_return_amt",
+            "wr_returning_customer_sk", "wr_returned_date_sk",
+            "wr_refunded_cdemo_sk", "wr_reason_sk", "wr_return_quantity"]),
+        "inventory": _table(conn, "inventory", [
+            "inv_date_sk", "inv_item_sk", "inv_warehouse_sk",
+            "inv_quantity_on_hand"]),
+        "item": _table(conn, "item", [
+            "i_item_sk", "i_item_id", "i_item_desc", "i_current_price",
+            "i_manufact_id", "i_category", "i_brand", "i_color",
+            "i_product_name", "i_manager_id"]),
+        "date_dim": _table(conn, "date_dim", [
+            "d_date_sk", "d_year", "d_moy", "d_month_seq", "d_qoy",
+            "d_dom"]),
+        "customer": _table(conn, "customer", [
+            "c_customer_sk", "c_customer_id", "c_current_cdemo_sk",
+            "c_current_hdemo_sk", "c_current_addr_sk", "c_first_name",
+            "c_last_name"]),
+        "customer_address": _table(conn, "customer_address", [
+            "ca_address_sk", "ca_city", "ca_state", "ca_country"]),
+        "customer_demographics": _table(conn, "customer_demographics", [
+            "cd_demo_sk", "cd_gender", "cd_marital_status",
+            "cd_education_status", "cd_dep_count"]),
+        "household_demographics": _table(conn, "household_demographics", [
+            "hd_demo_sk", "hd_income_band_sk", "hd_dep_count",
+            "hd_vehicle_count", "hd_buy_potential"]),
+        "income_band": _table(conn, "income_band", [
+            "ib_income_band_sk", "ib_lower_bound", "ib_upper_bound"]),
+        "warehouse": _table(conn, "warehouse", [
+            "w_warehouse_sk", "w_warehouse_name", "w_state"]),
+        "call_center": _table(conn, "call_center", [
+            "cc_call_center_sk", "cc_name", "cc_manager"]),
+        "reason": _table(conn, "reason", ["r_reason_sk", "r_reason_desc"]),
+    }
+
+
+def test_q13_demographic_band_averages(eng, host):
+    """Q13 shape: averages under OR'd demographic bands."""
+    e, s = eng
+    got = e.execute_sql("""
+        select avg(ss_quantity) aq, avg(ss_ext_sales_price) ap,
+               sum(ss_ext_wholesale_cost) sw
+        from store_sales, customer_demographics, household_demographics,
+             date_dim
+        where ss_cdemo_sk = cd_demo_sk and ss_hdemo_sk = hd_demo_sk
+          and ss_sold_date_sk = d_date_sk and d_year = 2001
+          and ((cd_marital_status = 'M' and hd_dep_count = 3)
+            or (cd_marital_status = 'S' and hd_dep_count = 1))""",
+        s).to_pandas()
+    ss, cd, hd, dd = (host["store_sales"], host["customer_demographics"],
+                      host["household_demographics"], host["date_dim"])
+    j = ss.merge(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk") \
+          .merge(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk") \
+          .merge(dd[dd.d_year == 2001], left_on="ss_sold_date_sk",
+                 right_on="d_date_sk")
+    j = j[((j.cd_marital_status == "M") & (j.hd_dep_count == 3))
+          | ((j.cd_marital_status == "S") & (j.hd_dep_count == 1))]
+    assert len(got) == 1
+    if len(j):
+        assert abs(got["aq"].iloc[0] - j.ss_quantity.mean()) < 1e-6
+        assert abs(got["ap"].iloc[0] - _dec2(j.ss_ext_sales_price.mean())) \
+            < 1e-9
+        assert abs(got["sw"].iloc[0] - j.ss_ext_wholesale_cost.sum()) < 1e-4
+    else:
+        assert got["aq"].isna().iloc[0]
+
+
+def test_q21_inventory_before_after(eng, host):
+    """Q21 shape: inventory split before/after a pivot date per warehouse."""
+    e, s = eng
+    got = e.execute_sql("""
+        select w_warehouse_name, i_item_id,
+          sum(case when d_date_sk < 2451200 then inv_quantity_on_hand
+              else 0 end) before_qty,
+          sum(case when d_date_sk >= 2451200 then inv_quantity_on_hand
+              else 0 end) after_qty
+        from inventory, warehouse, item, date_dim
+        where inv_item_sk = i_item_sk and inv_warehouse_sk = w_warehouse_sk
+          and inv_date_sk = d_date_sk and i_current_price between 0.99 and 49.99
+        group by w_warehouse_name, i_item_id
+        order by w_warehouse_name, i_item_id limit 50""", s).to_pandas()
+    inv, w, it, dd = (host["inventory"], host["warehouse"], host["item"],
+                      host["date_dim"])
+    j = inv.merge(w, left_on="inv_warehouse_sk", right_on="w_warehouse_sk") \
+        .merge(it[(it.i_current_price >= 0.99)
+                  & (it.i_current_price <= 49.99)],
+               left_on="inv_item_sk", right_on="i_item_sk") \
+        .merge(dd, left_on="inv_date_sk", right_on="d_date_sk")
+    j["before_qty"] = np.where(j.d_date_sk < 2451200,
+                               j.inv_quantity_on_hand, 0)
+    j["after_qty"] = np.where(j.d_date_sk >= 2451200,
+                              j.inv_quantity_on_hand, 0)
+    ref = j.groupby(["w_warehouse_name", "i_item_id"], as_index=False)[
+        ["before_qty", "after_qty"]].sum()
+    ref = ref.sort_values(["w_warehouse_name", "i_item_id"]).head(50) \
+        .reset_index(drop=True)
+    _check(got, ref, set())
+
+
+def test_q28_price_band_buckets(eng, host):
+    """Q28 shape: per-band avg/count/count-distinct joined as one row."""
+    e, s = eng
+    got = e.execute_sql("""
+        select b1.a a1, b1.c c1, b1.d d1, b2.a a2, b2.c c2, b2.d d2
+        from (select avg(ss_list_price) a, count(ss_list_price) c,
+                     count(distinct ss_list_price) d
+              from store_sales where ss_quantity between 0 and 5) b1,
+             (select avg(ss_list_price) a, count(ss_list_price) c,
+                     count(distinct ss_list_price) d
+              from store_sales where ss_quantity between 6 and 10) b2""",
+        s).to_pandas()
+    ss = host["store_sales"]
+    b1 = ss[(ss.ss_quantity >= 0) & (ss.ss_quantity <= 5)].ss_list_price
+    b2 = ss[(ss.ss_quantity >= 6) & (ss.ss_quantity <= 10)].ss_list_price
+    assert got["c1"].iloc[0] == b1.count()
+    assert got["d1"].iloc[0] == b1.nunique()
+    assert abs(got["a1"].iloc[0] - _dec2(b1.mean())) < 1e-9
+    assert got["c2"].iloc[0] == b2.count()
+    assert got["d2"].iloc[0] == b2.nunique()
+    assert abs(got["a2"].iloc[0] - _dec2(b2.mean())) < 1e-9
+
+
+def test_q32_excess_discount(eng, host):
+    """Q32 shape: correlated scalar subquery — discounts above 1.3x the
+    item's average."""
+    e, s = eng
+    got = e.execute_sql("""
+        select sum(cs_ext_discount_amt) excess
+        from catalog_sales, item
+        where i_item_sk = cs_item_sk and i_manufact_id = 77
+          and cs_ext_discount_amt > (
+            select 1.3 * avg(cs_ext_discount_amt) from catalog_sales
+            where cs_item_sk = i_item_sk)""", s).to_pandas()
+    cs, it = host["catalog_sales"], host["item"]
+    sel = it[it.i_manufact_id == 77]
+    j = cs.merge(sel[["i_item_sk"]], left_on="cs_item_sk",
+                 right_on="i_item_sk")
+    avg = cs.groupby("cs_item_sk").cs_ext_discount_amt.mean()
+    j = j[j.cs_ext_discount_amt > 1.3 * j.cs_item_sk.map(avg)]
+    want = j.cs_ext_discount_amt.sum()
+    if len(j):
+        assert abs(got["excess"].iloc[0] - want) < 1e-4
+    else:
+        assert got["excess"].isna().iloc[0]
+
+
+def test_q37_inventory_price_band(eng, host):
+    """Q37 shape: items in a price band currently in inventory and sold by
+    catalog."""
+    e, s = eng
+    got = e.execute_sql("""
+        select i_item_id, i_current_price
+        from item, inventory, catalog_sales
+        where i_current_price between 10 and 40
+          and inv_item_sk = i_item_sk and cs_item_sk = i_item_sk
+          and inv_quantity_on_hand between 100 and 500
+        group by i_item_id, i_current_price
+        order by i_item_id limit 30""", s).to_pandas()
+    it, inv, cs = host["item"], host["inventory"], host["catalog_sales"]
+    sel = it[(it.i_current_price >= 10) & (it.i_current_price <= 40)]
+    has_inv = set(inv[(inv.inv_quantity_on_hand >= 100)
+                      & (inv.inv_quantity_on_hand <= 500)].inv_item_sk)
+    has_cs = set(cs.cs_item_sk)
+    sel = sel[sel.i_item_sk.isin(has_inv) & sel.i_item_sk.isin(has_cs)]
+    ref = sel[["i_item_id", "i_current_price"]].drop_duplicates() \
+        .groupby("i_item_id", as_index=False).i_current_price.first()
+    ref = sel.groupby(["i_item_id", "i_current_price"], as_index=False) \
+        .size()[["i_item_id", "i_current_price"]]
+    ref = ref.sort_values("i_item_id").head(30).reset_index(drop=True)
+    _check(got, ref, {"i_current_price"})
+
+
+def test_q38_channel_intersect(eng, host):
+    """Q38 shape: customers present in all three channels (INTERSECT)."""
+    e, s = eng
+    got = e.execute_sql("""
+        select count(*) n from (
+          select distinct ss_customer_sk from store_sales, date_dim
+          where ss_sold_date_sk = d_date_sk and d_year = 2000
+          intersect
+          select distinct cs_bill_customer_sk from catalog_sales, date_dim
+          where cs_sold_date_sk = d_date_sk and d_year = 2000
+          intersect
+          select distinct ws_bill_customer_sk from web_sales, date_dim
+          where ws_sold_date_sk = d_date_sk and d_year = 2000)""",
+        s).to_pandas()
+    dd = host["date_dim"]
+    days = set(dd[dd.d_year == 2000].d_date_sk)
+    ss = host["store_sales"]; cs = host["catalog_sales"]; ws = host["web_sales"]
+    a = set(ss[ss.ss_sold_date_sk.isin(days)].ss_customer_sk)
+    b = set(cs[cs.cs_sold_date_sk.isin(days)].cs_bill_customer_sk)
+    c = set(ws[ws.ws_sold_date_sk.isin(days)].ws_bill_customer_sk)
+    assert got["n"].iloc[0] == len(a & b & c)
+
+
+def test_q40_returns_adjusted_pivot(eng, host):
+    """Q40 shape: catalog sales net of returns, before/after a pivot date."""
+    e, s = eng
+    got = e.execute_sql("""
+        select w_state, i_item_id,
+          sum(case when d_date_sk < 2451200
+              then cs_sales_price - coalesce(cr_return_amount, 0)
+              else 0 end) before_amt,
+          sum(case when d_date_sk >= 2451200
+              then cs_sales_price - coalesce(cr_return_amount, 0)
+              else 0 end) after_amt
+        from catalog_sales
+          left join catalog_returns on cs_order_number = cr_order_number
+            and cs_item_sk = cr_item_sk,
+          warehouse, item, date_dim
+        where i_item_sk = cs_item_sk and cs_warehouse_sk = w_warehouse_sk
+          and cs_sold_date_sk = d_date_sk
+        group by w_state, i_item_id
+        order by w_state, i_item_id limit 40""", s).to_pandas()
+    cs, cr, w, it, dd = (host["catalog_sales"], host["catalog_returns"],
+                         host["warehouse"], host["item"], host["date_dim"])
+    j = cs.merge(cr[["cr_order_number", "cr_item_sk", "cr_return_amount"]],
+                 left_on=["cs_order_number", "cs_item_sk"],
+                 right_on=["cr_order_number", "cr_item_sk"], how="left")
+    j = j.merge(w, left_on="cs_warehouse_sk", right_on="w_warehouse_sk") \
+        .merge(it, left_on="cs_item_sk", right_on="i_item_sk") \
+        .merge(dd, left_on="cs_sold_date_sk", right_on="d_date_sk")
+    amt = j.cs_sales_price - j.cr_return_amount.fillna(0)
+    j["before_amt"] = np.where(j.d_date_sk < 2451200, amt, 0)
+    j["after_amt"] = np.where(j.d_date_sk >= 2451200, amt, 0)
+    ref = j.groupby(["w_state", "i_item_id"], as_index=False)[
+        ["before_amt", "after_amt"]].sum()
+    ref = ref.sort_values(["w_state", "i_item_id"]).head(40) \
+        .reset_index(drop=True)
+    _check(got, ref, {"before_amt", "after_amt"})
+
+
+def test_q41_manufact_exists(eng, host):
+    """Q41 shape: distinct product names whose manufacturer also makes an
+    item matching color conditions (EXISTS as semi-join)."""
+    e, s = eng
+    got = e.execute_sql("""
+        select distinct i_product_name
+        from item i1
+        where i_manufact_id between 700 and 740
+          and exists (select 1 from item i2
+                      where i2.i_manufact = i1.i_manufact
+                        and i2.i_color in ('red', 'blue'))
+        order by i_product_name limit 25""", s).to_pandas()
+    it = _table(eng[0].catalogs["tpcds"], "item",
+                ["i_manufact", "i_manufact_id", "i_color", "i_product_name"])
+    sel = it[(it.i_manufact_id >= 700) & (it.i_manufact_id <= 740)]
+    good = set(it[it.i_color.isin(["red", "blue"])].i_manufact)
+    names = sorted(set(sel[sel.i_manufact.isin(good)].i_product_name))[:25]
+    assert list(got["i_product_name"]) == names
+
+
+def test_q66_warehouse_monthly(eng, host):
+    """Q66 shape: warehouse sales pivoted into months."""
+    e, s = eng
+    got = e.execute_sql("""
+        select w_warehouse_name,
+          sum(case when d_moy = 1 then ws_ext_sales_price else 0 end) jan,
+          sum(case when d_moy = 2 then ws_ext_sales_price else 0 end) feb,
+          sum(case when d_moy = 12 then ws_ext_sales_price else 0 end) dec
+        from web_sales, warehouse, date_dim
+        where ws_warehouse_sk = w_warehouse_sk and ws_sold_date_sk = d_date_sk
+          and d_year = 2001
+        group by w_warehouse_name order by w_warehouse_name""",
+        s).to_pandas()
+    ws, w, dd = host["web_sales"], host["warehouse"], host["date_dim"]
+    j = ws.merge(w, left_on="ws_warehouse_sk", right_on="w_warehouse_sk") \
+        .merge(dd[dd.d_year == 2001], left_on="ws_sold_date_sk",
+               right_on="d_date_sk")
+    for m, name in ((1, "jan"), (2, "feb"), (12, "dec")):
+        j[name] = np.where(j.d_moy == m, j.ws_ext_sales_price, 0)
+    ref = j.groupby("w_warehouse_name", as_index=False)[
+        ["jan", "feb", "dec"]].sum().sort_values("w_warehouse_name") \
+        .reset_index(drop=True)
+    _check(got, ref, {"jan", "feb", "dec"})
+
+
+def test_q84_income_band_customers(eng, host):
+    """Q84 shape: customers in an income band via hd -> ib lookups."""
+    e, s = eng
+    got = e.execute_sql("""
+        select c_customer_id, c_last_name, c_first_name
+        from customer, customer_address, household_demographics, income_band
+        where c_current_addr_sk = ca_address_sk
+          and c_current_hdemo_sk = hd_demo_sk
+          and hd_income_band_sk = ib_income_band_sk
+          and ib_lower_bound >= 20000 and ib_upper_bound <= 60000
+        order by c_customer_id limit 30""", s).to_pandas()
+    c, ca, hd, ib = (host["customer"], host["customer_address"],
+                     host["household_demographics"], host["income_band"])
+    j = c.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk") \
+        .merge(hd, left_on="c_current_hdemo_sk", right_on="hd_demo_sk") \
+        .merge(ib[(ib.ib_lower_bound >= 20000)
+                  & (ib.ib_upper_bound <= 60000)],
+               left_on="hd_income_band_sk", right_on="ib_income_band_sk")
+    ref = j.sort_values("c_customer_id").head(30)[
+        ["c_customer_id", "c_last_name", "c_first_name"]] \
+        .reset_index(drop=True)
+    _check(got, ref, set())
+
+
+def test_q85_web_returns_reasons(eng, host):
+    """Q85 shape: web return reasons by refunding demographic bands."""
+    e, s = eng
+    got = e.execute_sql("""
+        select r_reason_desc, avg(wr_return_quantity) q, avg(wr_return_amt) a
+        from web_returns, reason, customer_demographics
+        where wr_reason_sk = r_reason_sk
+          and wr_refunded_cdemo_sk = cd_demo_sk
+          and cd_education_status in ('College', 'Primary')
+        group by r_reason_desc order by r_reason_desc limit 20""",
+        s).to_pandas()
+    wr, r, cd = host["web_returns"], host["reason"], \
+        host["customer_demographics"]
+    j = wr.merge(r, left_on="wr_reason_sk", right_on="r_reason_sk") \
+        .merge(cd[cd.cd_education_status.isin(["College", "Primary"])],
+               left_on="wr_refunded_cdemo_sk", right_on="cd_demo_sk")
+    ref = j.groupby("r_reason_desc", as_index=False).agg(
+        q=("wr_return_quantity", "mean"), a=("wr_return_amt", "mean"))
+    ref["a"] = _dec2(ref["a"])  # engine decimal avg rounds HALF_UP to scale 2
+    ref = ref.sort_values("r_reason_desc").head(20).reset_index(drop=True)
+    _check(got, ref, {"q", "a"})
+
+
+def test_q87_channel_except(eng, host):
+    """Q87 shape: customers in store but NOT catalog channel (EXCEPT)."""
+    e, s = eng
+    got = e.execute_sql("""
+        select count(*) n from (
+          select distinct ss_customer_sk from store_sales, date_dim
+          where ss_sold_date_sk = d_date_sk and d_year = 2000
+          except
+          select distinct cs_bill_customer_sk from catalog_sales, date_dim
+          where cs_sold_date_sk = d_date_sk and d_year = 2000)""",
+        s).to_pandas()
+    dd = host["date_dim"]
+    days = set(dd[dd.d_year == 2000].d_date_sk)
+    ss, cs = host["store_sales"], host["catalog_sales"]
+    a = set(ss[ss.ss_sold_date_sk.isin(days)].ss_customer_sk)
+    b = set(cs[cs.cs_sold_date_sk.isin(days)].cs_bill_customer_sk)
+    assert got["n"].iloc[0] == len(a - b)
+
+
+def test_q91_call_center_losses(eng, host):
+    """Q91 shape: call-center return losses by manager."""
+    e, s = eng
+    got = e.execute_sql("""
+        select cc_name, cc_manager, sum(cr_return_amount) loss
+        from catalog_returns, call_center, date_dim
+        where cr_call_center_sk = cc_call_center_sk
+          and cr_returned_date_sk = d_date_sk and d_year = 2000
+        group by cc_name, cc_manager order by loss desc, cc_name limit 10""",
+        s).to_pandas()
+    cr, cc, dd = (host["catalog_returns"], host["call_center"],
+                  host["date_dim"])
+    j = cr.merge(cc, left_on="cr_call_center_sk",
+                 right_on="cc_call_center_sk") \
+        .merge(dd[dd.d_year == 2000], left_on="cr_returned_date_sk",
+               right_on="d_date_sk")
+    ref = j.groupby(["cc_name", "cc_manager"], as_index=False) \
+        .cr_return_amount.sum().rename(columns={"cr_return_amount": "loss"})
+    ref = ref.sort_values(["loss", "cc_name"],
+                          ascending=[False, True]).head(10) \
+        .reset_index(drop=True)[["cc_name", "cc_manager", "loss"]]
+    _check(got, ref, {"loss"})
+
+
+def test_q94_ship_anti_join(eng, host):
+    """Q94 shape: web orders shipped from one site with no returns
+    (NOT EXISTS as anti-join) and a shipping window."""
+    e, s = eng
+    got = e.execute_sql("""
+        select count(distinct ws_order_number) orders,
+               sum(ws_ext_ship_cost) ship, sum(ws_net_profit) profit
+        from web_sales ws1
+        where ws_ship_date_sk between 2450900 and 2451000
+          and not exists (select 1 from web_returns
+                          where wr_order_number = ws1.ws_order_number)""",
+        s).to_pandas()
+    ws, wr = host["web_sales"], host["web_returns"]
+    sel = ws[(ws.ws_ship_date_sk >= 2450900) & (ws.ws_ship_date_sk <= 2451000)]
+    sel = sel[~sel.ws_order_number.isin(set(wr.wr_order_number))]
+    assert got["orders"].iloc[0] == sel.ws_order_number.nunique()
+    if len(sel):
+        assert abs(got["ship"].iloc[0] - sel.ws_ext_ship_cost.sum()) < 1e-4
+        assert abs(got["profit"].iloc[0] - sel.ws_net_profit.sum()) < 1e-4
+
+
+def test_q95_repeat_ship_sites(eng, host):
+    """Q95 shape: orders that ship across multiple warehouses (EXISTS
+    self-join on a different warehouse)."""
+    e, s = eng
+    got = e.execute_sql("""
+        select count(distinct ws_order_number) n
+        from web_sales ws1
+        where exists (select 1 from web_sales ws2
+                      where ws2.ws_order_number = ws1.ws_order_number
+                        and ws2.ws_warehouse_sk <> ws1.ws_warehouse_sk)""",
+        s).to_pandas()
+    ws = host["web_sales"]
+    g = ws.groupby("ws_order_number").ws_warehouse_sk.nunique()
+    assert got["n"].iloc[0] == int((g > 1).sum())

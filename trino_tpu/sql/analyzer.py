@@ -507,17 +507,9 @@ class ExpressionAnalyzer:
             keep = np.asarray(out_vals).astype(bool)
             if out_nulls is not None:  # NULL predicate = no match
                 keep = keep & ~np.asarray(out_nulls)
-            excl = np.zeros(len(keep) + 1, np.int64)
-            np.cumsum(keep, out=excl[1:])
-            filt = ir.Call("span_filter",
-                           (base, ir.Constant(excl, UNKNOWN)),
-                           base.type)
+            filt, fdata = self._span_filtered(base, bd, keep)
             if name == "filter":
-                from ..ops.arrays import ArrayData
-
-                heap = np.asarray(bd.values)[keep]
-                return filt, ArrayData(heap, bd.elem_type, bd.elem_dict,
-                                       max_len=bd.max_len)
+                return filt, fdata
             kept_len = ir.Call("span_len", (filt,), BIGINT)
             if name == "any_match":
                 return ir.Call("gt", (kept_len, ir.Constant(0, BIGINT)),
@@ -527,7 +519,124 @@ class ExpressionAnalyzer:
                                BOOLEAN), None
             total_len = ir.Call("span_len", (base,), BIGINT)
             return ir.Call("eq", (kept_len, total_len), BOOLEAN), None
+        if name == "arrays_overlap":
+            from ..types import ArrayType
+
+            a, ad = self._translate(args[0], cols)
+            b, bd2 = self._translate(args[1], cols)
+            if not isinstance(a.type, ArrayType) \
+                    or not isinstance(b.type, ArrayType) \
+                    or ad is None or bd2 is None:
+                raise SemanticError("arrays_overlap expects two arrays")
+            if (ad.elem_dict is not None or bd2.elem_dict is not None) \
+                    and ad.elem_dict is not bd2.elem_dict:
+                raise SemanticError(
+                    "arrays_overlap over differently-encoded string arrays "
+                    "is not supported")
+            return ir.Call(
+                "arrays_overlap",
+                (a, b, ir.Constant(np.asarray(ad.values), UNKNOWN),
+                 ir.Constant(np.asarray(bd2.values), UNKNOWN)),
+                BOOLEAN, meta=(max(ad.max_len, 1), max(bd2.max_len, 1))), None
+        if name == "slice":
+            from ..types import ArrayType
+
+            base, bd = self._translate(args[0], cols)
+            if not isinstance(base.type, ArrayType):
+                raise SemanticError("slice expects an array")
+            st, _ = self._translate(args[1], cols)
+            ln, _ = self._translate(args[2], cols)
+            return ir.Call("span_slice",
+                           (base, _coerce(st, BIGINT), _coerce(ln, BIGINT)),
+                           base.type), bd
+        if name == "trim_array":
+            from ..types import ArrayType
+
+            base, bd = self._translate(args[0], cols)
+            if not isinstance(base.type, ArrayType):
+                raise SemanticError("trim_array expects an array")
+            n, _ = self._translate(args[1], cols)
+            return ir.Call("span_trim", (base, _coerce(n, BIGINT)),
+                           base.type), bd
+        if name == "array_remove":
+            from ..types import ArrayType
+
+            base, bd = self._translate(args[0], cols)
+            if not isinstance(base.type, ArrayType) or bd is None:
+                raise SemanticError("array_remove expects an array")
+            if isinstance(args[1], A.StringLit):
+                if bd.elem_dict is None:
+                    raise SemanticError(
+                        "array_remove: string value over a non-string array")
+                val = bd.elem_dict.lookup(args[1].value)
+            else:
+                lit, _ = self._translate(args[1], cols)
+                if not isinstance(lit, ir.Constant):
+                    raise SemanticError(
+                        "array_remove value must be a constant")
+                val = lit.value
+            if val is None:  # reference: NULL element -> NULL result
+                return ir.Constant(None, base.type), bd
+            return self._span_filtered(base, bd,
+                                       np.asarray(bd.values) != val)
+        if name in ("array_distinct", "array_sort"):
+            # plan-time fold over a CONSTANT span (array literals, folded
+            # expressions); arbitrary array columns would need per-row heap
+            # segmentation the span layout does not record
+            from ..ops.arrays import ArrayData, pack_span, span_len, span_start
+            from ..types import ArrayType
+
+            base, bd = self._translate(args[0], cols)
+            if not isinstance(base.type, ArrayType) or bd is None:
+                raise SemanticError(f"{name} expects an array")
+            if not isinstance(base, ir.Constant):
+                raise SemanticError(
+                    f"{name} supports literal/folded arrays only")
+            start = int(span_start(int(base.value)))
+            ln = int(span_len(int(base.value)))
+            seg = np.asarray(bd.values)[start:start + ln]
+            if name == "array_distinct":  # keep FIRST occurrences, in order
+                _, first = np.unique(seg, return_index=True)
+                seg = seg[np.sort(first)]
+            else:
+                if bd.elem_dict is not None:
+                    order = np.argsort(np.asarray(
+                        bd.elem_dict.decode(seg.astype(np.int64)),
+                        dtype=object))
+                    seg = seg[order]
+                else:
+                    seg = np.sort(seg)
+            return (ir.Constant(pack_span(0, len(seg)), base.type),
+                    ArrayData(seg, bd.elem_type, bd.elem_dict,
+                              max_len=len(seg)))
+        if name == "repeat":
+            from ..ops.arrays import ArrayData, pack_span
+            from ..types import ArrayType
+
+            v, _ = self._translate(args[0], cols)
+            n, _ = self._translate(args[1], cols)
+            if not isinstance(v, ir.Constant) or not isinstance(n, ir.Constant):
+                raise SemanticError("repeat expects constant arguments")
+            cnt = int(n.value)
+            if cnt < 0 or cnt > 10000:
+                raise SemanticError("repeat count out of range [0, 10000]")
+            heap = np.full(cnt, v.value, dtype=np.dtype(v.type.dtype))
+            return (ir.Constant(pack_span(0, cnt), ArrayType.of(v.type)),
+                    ArrayData(heap, v.type, max_len=cnt))
         raise SemanticError(f"unknown collection function {name}")
+
+    def _span_filtered(self, base, bd, keep):
+        """Element-filtered array: spans remap through the exclusive cumsum
+        of ``keep`` (len(heap)+1 entries) and the heap drops removed elements
+        — the span-remap invariant shared by filter() and array_remove."""
+        from ..ops.arrays import ArrayData
+
+        excl = np.zeros(len(keep) + 1, np.int64)
+        np.cumsum(keep, out=excl[1:])
+        filt = ir.Call("span_filter", (base, ir.Constant(excl, UNKNOWN)),
+                       base.type)
+        return filt, ArrayData(np.asarray(bd.values)[keep], bd.elem_type,
+                               bd.elem_dict, max_len=bd.max_len)
 
     def _eval_lambda_on_heap(self, lam, bd):
         """Translate a one-parameter lambda against an array's element heap
@@ -885,7 +994,10 @@ class ExpressionAnalyzer:
                          "row", "array_min", "array_max", "array_sum",
                          "array_average", "array_position",
                          "transform", "filter", "any_match", "all_match",
-                         "none_match", "reduce")
+                         "none_match", "reduce",
+                         "arrays_overlap", "slice", "trim_array",
+                         "array_remove", "array_distinct", "array_sort",
+                         "repeat")
 
     def _translate_func(self, ast: A.FuncCall, cols):
         """Registry dispatch (reference: the analyzer resolving calls against

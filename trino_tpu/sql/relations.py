@@ -140,6 +140,18 @@ class RelationPlannerMixin:
 
     def _plan_from_base(self, relations, explicit_joins, conjuncts, q) -> RelPlan:
 
+        if explicit_joins and relations:
+            # mixed comma + explicit-join FROM (`a left join b on ..., c`):
+            # each explicit subtree plans as ONE pre-joined base relation and
+            # the comma CBO machinery below joins the components through the
+            # WHERE equi-predicates — routing the whole tree through the
+            # written-order path would cross-product the comma components
+            from .stats import unknown_stats
+
+            for j in explicit_joins:
+                rel = self._plan_explicit(j)
+                relations.append((rel, unknown_stats(len(rel.cols))))
+            explicit_joins = []
         if explicit_joins:
             # explicit JOIN ... ON syntax: left-deep in written order
             rel = self._plan_explicit(q.from_)
@@ -296,6 +308,12 @@ class RelationPlannerMixin:
             return self._plan_relation(node)
         left = self._plan_explicit(node.left)
         right = self._plan_explicit(node.right)
+        if node.kind == "cross" and node.on is None:
+            # comma/CROSS JOIN mixed into an explicit-join tree: once any
+            # ON-join is present the whole FROM plans here, so the comma
+            # node itself must cross-join (it previously fell through to the
+            # outer-join kind check and mis-raised "non-equi outer join")
+            return self._make_cross_join(left, right)
         if getattr(node, "using", ()):
             # JOIN USING (c, ...): equi-join on the named columns of BOTH
             # sides; the output carries the column ONCE (left's copy), so a
