@@ -32,7 +32,9 @@ from trino_tpu import Engine  # noqa: E402
 from trino_tpu.connectors.tpch import TpchConnector  # noqa: E402
 
 SF = float(os.environ.get("BENCH_SF", "100"))
-ORDER = ["q1", "q3", "q18", "q9"]  # simplest first; deepest join tree last
+# SF100_QUERIES=q18,q9 resumes a partial run without repeating finished ones
+ORDER = [q.strip() for q in os.environ.get(
+    "SF100_QUERIES", "q1,q3,q18,q9").split(",") if q.strip() in QUERIES]
 OUT = os.path.join(REPO, f"SF100_cpu_r05.json")
 
 out = {

@@ -89,3 +89,17 @@ def test_composition_with_lambdas(aeng):
     r = _rows(aeng, "select cardinality(filter(slice(a, 1, 3), x -> x > 1)) c "
                     "from t where n = 1")
     assert r["c"].iloc[0] == 2
+
+
+def test_map_lambdas(aeng):
+    """map_filter / transform_keys / transform_values over plan-time heaps
+    (reference: MapFilterFunction, MapTransformKeys/ValuesFunction)."""
+    r = _rows(aeng, """select
+        transform_values(map(array[1,2,3], array[10,20,30]),
+                         (k, v) -> v * k) tv,
+        transform_keys(map(array[1,2], array[10,20]), (k, v) -> k + 100) tk,
+        map_filter(map(array[1,2,3], array[10,20,30]), (k, v) -> v > 15) mf
+      from t where n = 1""")
+    assert r["tv"].iloc[0] == {1: 10, 2: 40, 3: 90}
+    assert r["tk"].iloc[0] == {101: 10, 102: 20}
+    assert r["mf"].iloc[0] == {2: 20, 3: 30}

@@ -609,6 +609,59 @@ class ExpressionAnalyzer:
             return (ir.Constant(pack_span(0, len(seg)), base.type),
                     ArrayData(seg, bd.elem_type, bd.elem_dict,
                               max_len=len(seg)))
+        if name in ("map_filter", "transform_keys", "transform_values"):
+            # map lambdas over the plan-time key/value heaps — the map analog
+            # of the array transform/filter family (reference:
+            # operator/scalar/MapFilterFunction, MapTransformKeysFunction,
+            # MapTransformValuesFunction); spans stay untouched (or remap
+            # through the shared exclusive-cumsum) and elements never move
+            # at runtime
+            from ..ops.arrays import MapData
+            from ..types import MapType
+
+            base, md = self._translate(args[0], cols)
+            if not isinstance(base.type, MapType) or md is None:
+                raise SemanticError(f"{name} expects a map")
+            lam = args[1] if len(args) > 1 else None
+            if not isinstance(lam, A.Lambda) or len(lam.params) != 2:
+                raise SemanticError(f"{name} expects a two-parameter lambda")
+            kcol = ColumnInfo(None, lam.params[0], base.type.key,
+                              md.key_dict)
+            vcol = ColumnInfo(None, lam.params[1], base.type.value,
+                              md.value_dict)
+            body_ir, _ = self._translate(lam.body, [kcol, vcol])
+            import jax.numpy as jnp
+
+            kh = jnp.asarray(np.asarray(md.keys))
+            vh = jnp.asarray(np.asarray(md.values))
+            vals, vnulls = ir.evaluate(body_ir, (kh, vh), (None, None))
+            vals = np.asarray(vals)
+            if name == "map_filter":
+                if body_ir.type.name != "boolean":
+                    raise SemanticError("map_filter lambda must be boolean")
+                keep = vals.astype(bool)
+                if vnulls is not None:
+                    keep = keep & ~np.asarray(vnulls)
+                excl = np.zeros(len(keep) + 1, np.int64)
+                np.cumsum(keep, out=excl[1:])
+                filt = ir.Call("span_filter",
+                               (base, ir.Constant(excl, UNKNOWN)), base.type)
+                return filt, MapData(np.asarray(md.keys)[keep],
+                                     np.asarray(md.values)[keep],
+                                     md.key_type, md.value_type,
+                                     md.key_dict, md.value_dict, md.max_len)
+            if vnulls is not None and np.asarray(vnulls).any():
+                raise SemanticError(
+                    f"{name} lambdas yielding NULLs are not supported")
+            if name == "transform_values":
+                t = MapType.of(base.type.key, body_ir.type)
+                return (ir.Call("span_id", (base,), t),
+                        MapData(np.asarray(md.keys), vals, md.key_type,
+                                body_ir.type, md.key_dict, None, md.max_len))
+            t = MapType.of(body_ir.type, base.type.value)
+            return (ir.Call("span_id", (base,), t),
+                    MapData(vals, np.asarray(md.values), body_ir.type,
+                            md.value_type, None, md.value_dict, md.max_len))
         if name == "repeat":
             from ..ops.arrays import ArrayData, pack_span
             from ..types import ArrayType
@@ -997,7 +1050,8 @@ class ExpressionAnalyzer:
                          "none_match", "reduce",
                          "arrays_overlap", "slice", "trim_array",
                          "array_remove", "array_distinct", "array_sort",
-                         "repeat")
+                         "repeat",
+                         "map_filter", "transform_keys", "transform_values")
 
     def _translate_func(self, ast: A.FuncCall, cols):
         """Registry dispatch (reference: the analyzer resolving calls against
