@@ -86,11 +86,17 @@ class DbapiConnector:
         self._pushed_by_content: dict = {}
         self._pushed_cap = 512
         self._pushed_seq = 0
+        import threading
+
+        # index lookups register handles on the EXECUTION path, where pooled
+        # executors run concurrently — the registry mutates under this lock
+        self._pushed_lock = threading.Lock()
         self.pushed_queries = 0  # observability: remote pushed-handle reads
 
     # -- optimizer pushdown surfaces (applyTopN / applyJoin) ---------------------
     supports_topn_pushdown = True
     supports_join_pushdown = True
+    supports_index_lookup = True
 
     def is_pushdown_handle(self, table: str) -> bool:
         """Interface-level test the optimizer uses instead of reaching into
@@ -100,20 +106,21 @@ class DbapiConnector:
     def _register_pushed(self, prefix: str, spec: dict) -> str:
         key = tuple(sorted((k, tuple(v) if isinstance(v, list) else v)
                     for k, v in spec.items()))
-        hit = self._pushed_by_content.get(key)
-        if hit is not None:
-            return hit
-        self._pushed_seq += 1
-        handle = f"{prefix}{self._pushed_seq}"
-        self._pushed[handle] = spec
-        self._pushed_by_content[key] = handle
-        while len(self._pushed) > self._pushed_cap:
-            old = next(iter(self._pushed))
-            self._pushed.pop(old)
-            self._pushed_by_content = {k: h for k, h
-                                       in self._pushed_by_content.items()
-                                       if h != old}
-        return handle
+        with self._pushed_lock:
+            hit = self._pushed_by_content.get(key)
+            if hit is not None:
+                return hit
+            self._pushed_seq += 1
+            handle = f"{prefix}{self._pushed_seq}"
+            self._pushed[handle] = spec
+            self._pushed_by_content[key] = handle
+            while len(self._pushed) > self._pushed_cap:
+                old = next(iter(self._pushed))
+                self._pushed.pop(old)
+                self._pushed_by_content = {k: h for k, h
+                                           in self._pushed_by_content.items()
+                                           if h != old}
+            return handle
 
     def _resolve_spec(self, table: str, split=None):
         """Handle spec from the local registry, or — on a WORKER that never
@@ -142,6 +149,19 @@ class DbapiConnector:
             {"kind": "topn", "base": table,
              "order_sql": ", ".join(parts), "n": int(n)})
 
+    def apply_index_lookup(self, table: str, key_col: str, keys) -> str:
+        """Index-join lookup (reference: operator/index/IndexLoader — fetch
+        only the build rows matching the probe's key set): a handle whose
+        scan issues ``WHERE key_col IN (...)`` remotely, shipping the
+        matching rows instead of the table.  ``keys`` are remote-domain
+        values (strings already decoded)."""
+        t = self._open(table)
+        t.schema.field(key_col)  # validate
+        return self._register_pushed(
+            f"{table}#idx",
+            {"kind": "index", "base": table, "key_col": key_col,
+             "keys": tuple(keys)})
+
     def apply_join(self, left: str, right: str, pairs: list, out_names: list,
                    left_cols: list, right_cols: list) -> str:
         """Equi-join pushdown (ConnectorMetadata.applyJoin:1637): both sides
@@ -166,7 +186,7 @@ class DbapiConnector:
              "left_cols": list(left_cols), "right_cols": list(right_cols)})
 
     def _handle_schema(self, spec) -> Schema:
-        if spec["kind"] == "topn":
+        if spec["kind"] in ("topn", "index"):
             return self._open(spec["base"]).schema
         lt, rt = self._open(spec["left"]), self._open(spec["right"])
         src = [lt.schema.field(c) for c in spec["left_cols"]] \
@@ -176,7 +196,7 @@ class DbapiConnector:
 
     def _handle_sources(self, spec) -> list:
         """[(source_table, source_column)] per output channel."""
-        if spec["kind"] == "topn":
+        if spec["kind"] in ("topn", "index"):
             return [(spec["base"], f.name)
                     for f in self._open(spec["base"]).schema.fields]
         return ([(spec["left"], c) for c in spec["left_cols"]]
@@ -262,6 +282,8 @@ class DbapiConnector:
         if spec is not None:
             if spec["kind"] == "topn":
                 return min(spec["n"], self._open(spec["base"]).n_rows)
+            if spec["kind"] == "index":
+                return self._open(spec["base"]).n_rows  # conservative bound
             return self._open(spec["left"]).n_rows  # estimate
         return self._open(table).n_rows
 
@@ -296,8 +318,9 @@ class DbapiConnector:
             wire = tuple(sorted(
                 (k, tuple(v) if isinstance(v, list) else v)
                 for k, v in spec.items()))
-            if spec["kind"] == "topn":
-                # ORDER BY ... LIMIT is a single remote cursor by nature
+            if spec["kind"] in ("topn", "index"):
+                # ORDER BY...LIMIT and keyed IN-lookups are single remote
+                # cursors by nature
                 return [DbapiSplit(table, 0, -1, wire)]
             # joined scans parallelize by the LEFT side's rowid ranges
             base = spec["left"]
@@ -322,6 +345,15 @@ class DbapiConnector:
             sel = ", ".join(f"{_q(srcs[n][1])} as {_q(n)}" for n in names)
             return (f"select {sel} from {_q(spec['base'])} "
                     f"order by {spec['order_sql']} limit {spec['n']}", ())
+        if spec["kind"] == "index":
+            sel = ", ".join(f"{_q(srcs[n][1])} as {_q(n)}" for n in names)
+            keys = spec["keys"]
+            if not keys:
+                return (f"select {sel} from {_q(spec['base'])} where 1 = 0",
+                        ())
+            ph = ", ".join("?" for _ in keys)
+            return (f"select {sel} from {_q(spec['base'])} "
+                    f"where {_q(spec['key_col'])} in ({ph})", tuple(keys))
         sel = ", ".join(
             f"{'a' if srcs[n][0] == spec['left'] else 'b'}.{_q(srcs[n][1])} "
             f"as {_q(n)}" for n in names)

@@ -1830,15 +1830,117 @@ class LocalExecutor:
         return Page(node.schema, cols, nulls, page.valid), tuple(dicts) + spec_dicts
 
     # -- join ---------------------------------------------------------------
+    # maximum distinct probe keys shipped into a connector index lookup
+    # (sqlite's default bound-parameter cap is 999; chunking past ~500 keys
+    # rarely beats just scanning the remote table)
+    INDEX_JOIN_MAX_KEYS = 500
+    # probe-side row bound above which materializing the probe first (the
+    # index join's inversion of build/probe order) is not worth attempting
+    INDEX_JOIN_MAX_PROBE = 1 << 16
+
+    def _index_lookup_stream(self, probe_stream, node: P.Join, build_page,
+                             build_dicts):
+        """Connector-backed index join (reference: operator/index/
+        IndexLoader + IndexJoinOptimizer): when the PROBE side scans a
+        connector with keyed-lookup support and the build side's distinct
+        join keys are few, replace the probe's full-table splits with one
+        WHERE-IN lookup split — dynamic filtering taken to the source (key
+        SET pruning instead of min/max split pruning).  Returns a
+        replacement probe stream or None."""
+        import os
+
+        if os.environ.get("TRINO_TPU_INDEX_JOIN", "1") == "0":
+            return None
+        if len(node.right_keys) != 1:
+            return None
+        si = probe_stream.scan_info
+        if si is None or not si.replayable or not si.splits \
+                or not hasattr(si.splits[0], "table"):
+            return None
+        conn = si.conn
+        table = si.splits[0].table
+        if not getattr(conn, "supports_index_lookup", False) \
+                or getattr(conn, "is_pushdown_handle", lambda t: False)(table):
+            return None
+        pk = node.left_keys[0]
+        key_col = si.columns[pk] if pk < len(si.columns) else None
+        if key_col is None:
+            return None
+        key_t = probe_stream.schema.fields[pk].type
+        if not (key_t.is_integer or key_t.is_string):
+            return None
+        if build_page.capacity == 0 \
+                or build_page.capacity > self.INDEX_JOIN_MAX_PROBE:
+            return None
+        try:
+            remote_rows = int(conn.row_count(table))
+        except Exception:
+            return None
+        bk = node.right_keys[0]
+        v = build_page.columns[bk]
+        nm = build_page.null_masks[bk]
+        live = build_page.valid_mask()
+        if nm is not None:
+            live = live & ~nm
+        # dead lanes collapse onto v[0]; a spurious key only over-fetches
+        # (the local join still filters), truncation would LOSE rows — so
+        # request MAX+1 distinct and bail when the budget fills.  The live
+        # count and distinct set sync together (one batched transfer)
+        uniq = jnp.unique(jnp.where(live, jnp.asarray(v), jnp.asarray(v)[0]),
+                          size=min(int(build_page.capacity),
+                                   self.INDEX_JOIN_MAX_KEYS + 1))
+        got = _host([uniq, jnp.sum(live, dtype=jnp.int64)])
+        if int(got[1]) == 0:
+            # all-dead build: fall through to _dynamic_pruned_pages' empty-
+            # build short-circuit (zero remote work) instead of shipping a
+            # garbage lane value as a lookup key
+            return None
+        keys = np.unique(got[0])
+        if len(keys) > self.INDEX_JOIN_MAX_KEYS:
+            return None
+        # profitability on the ACTUAL lookup size, not the lane count: a
+        # sparse filtered build with few distinct keys is the ideal case
+        if remote_rows < 4 * len(keys):
+            return None
+        bd = build_dicts[bk]
+        if key_t.is_string:
+            if bd is None or getattr(bd, "values", None) is None:
+                return None
+            keys = [str(x) for x in bd.decode(keys.astype(np.int64))]
+        else:
+            keys = [int(x) for x in keys.tolist()]
+        handle = conn.apply_index_lookup(table, key_col, keys)
+        new_splits = conn.splits(handle)
+        scan_cols = si.scan_columns
+
+        def pages(conn=conn, splits=new_splits, cols=scan_cols):
+            for s in splits:
+                yield conn.generate(s, list(cols))
+
+        st = self.stats.setdefault(id(node), {"rows": 0, "wall_s": 0.0})
+        st["index_join_keys"] = len(keys)
+        repl = {"pages": pages,
+                "scan_info": dataclasses.replace(si, splits=list(new_splits))}
+        if probe_stream.traced_src is not None:
+            repl["traced_src"] = None  # handle scans are host-fed
+        return dataclasses.replace(probe_stream, **repl)
+
     def _compile_join(self, node: P.Join) -> _Stream:
         build_page, build_dicts = self._execute_to_page_streamed(node.right)
         probe_stream = self._compile_stream(node.left)
         build_key_types = tuple(node.right.schema.fields[i].type for i in node.right_keys)
         if node.kind in ("inner", "semi") and node.filter is None:
+            # connector index lookup first (key-SET pruning at the source);
+            # falls back to min/max dynamic split pruning
+            ix = self._index_lookup_stream(probe_stream, node, build_page,
+                                           build_dicts)
+            if ix is not None:
+                probe_stream = ix
             # dynamic filtering: prune probe splits outside the build keys' min/max
             # domain (reference: DynamicFilterService.createDynamicFilter:260 narrowing
             # probe-side scans; here domains prune whole splits via connector ranges)
-            pruned = _dynamic_pruned_pages(probe_stream, node, build_page)
+            pruned = None if ix is not None else \
+                _dynamic_pruned_pages(probe_stream, node, build_page)
             if pruned is not None:
                 pages_fn, kept = pruned
                 repl = {"pages": pages_fn, "_jitted": None}
