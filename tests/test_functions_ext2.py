@@ -124,3 +124,39 @@ def test_cdf_on_column(feng):
 def test_now(feng):
     got = _one(feng, "now()")
     assert str(got).startswith("20")
+
+
+def test_split_family(feng):
+    e, s = feng
+    e.execute_sql("create table sp (s varchar, n bigint)", s)
+    e.execute_sql("insert into sp values ('a,b,c', 1), ('x', 2), "
+                  "('k1=v1;k2=v2', 3)", s)
+    r = e.execute_sql("select n, split(s, ',') v from sp order by n",
+                      s).to_pandas()
+    assert list(r["v"].iloc[0]) == ["a", "b", "c"]
+    assert list(r["v"].iloc[1]) == ["x"]
+    r = e.execute_sql("select split(s, ',')[2] v, "
+                      "cardinality(split(s, ',')) c from sp where n = 1",
+                      s).to_pandas()
+    assert r["v"].iloc[0] == "b" and r["c"].iloc[0] == 3
+    r = e.execute_sql("select split('a,b,c,d', ',', 2) v from sp where n = 1",
+                      s).to_pandas()
+    assert list(r["v"].iloc[0]) == ["a", "b,c,d"]
+    r = e.execute_sql("select split_to_map(s, ';', '=') m from sp where n = 3",
+                      s).to_pandas()
+    assert r["m"].iloc[0] == {"k1": "v1", "k2": "v2"}
+
+
+def test_datetime_batch3(feng):
+    got = _one(feng, "parse_datetime('2024-02-29 12:30', 'yyyy-MM-dd HH:mm')")
+    assert str(got).startswith("2024-02-29 12:30")
+    assert _one(feng, "parse_datetime('junk', 'yyyy-MM-dd')") is None
+    assert _one(feng, "current_timezone()") == "UTC"
+    assert _one(feng, "timezone_hour(now())") == 0
+    assert _one(feng, "timezone_minute(now())") == 0
+    assert str(_one(feng, "version()")).startswith("trino-tpu")
+
+
+def test_base32(feng):
+    assert _one(feng, "from_base32(to_base32('hello'))") == "hello"
+    assert _one(feng, "to_base32(s)") is not None

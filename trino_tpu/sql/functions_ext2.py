@@ -216,6 +216,14 @@ def _from_base64url(s: str):
         return None
 
 
+def _from_base32(s: str):
+    try:
+        pad = s + "=" * (-len(s) % 8)
+        return base64.b32decode(pad).decode("utf-8", errors="replace")
+    except (binascii.Error, ValueError):
+        return None
+
+
 # ------------------------------------------------------------------ strings
 _SOUNDEX_CODES = {}
 for _chars, _code in (("BFPV", "1"), ("CGJKQSXZ", "2"), ("DT", "3"),
@@ -429,6 +437,173 @@ def _build_cdf3(planner, ast, cols):
                               F._coerce(c, DOUBLE)), DOUBLE), None
 
 
+# ------------------------------------------------------------------ split
+def _build_split(planner, ast, cols):
+    """split(str, delim[, limit]) -> array(varchar): per-distinct-value
+    tokenization becomes an id -> SPAN LUT over a shared token heap (the
+    dictionary-LUT design lifted to array outputs; reference:
+    operator/scalar/SplitFunction)."""
+    from ..connectors.tpch import Dictionary
+    from ..ops.arrays import ArrayData, pack_span
+    from ..types import ArrayType
+
+    F = _rt()
+    delim = planner._literal_str(ast.args[1], "split")
+    if not delim:
+        raise F.SemanticError("split delimiter must be non-empty")
+    limit = None
+    if len(ast.args) > 2:
+        limit = _int_literal(ast.args[2], "split limit")
+        if limit <= 0:
+            raise F.SemanticError("split limit must be positive")
+    lit = _string_lit(ast)
+    if lit is not None:  # literal: fold to a constant span + token heap
+        parts = lit.split(delim) if limit is None \
+            else lit.split(delim, limit - 1)
+        uniq0 = sorted(set(parts))
+        td = Dictionary(values=np.array(uniq0 or [""], dtype=object))
+        im = {t0: i for i, t0 in enumerate(uniq0)}
+        t = ArrayType.of(VarcharType.of(None))
+        return (ir.Constant(pack_span(0, len(parts)), t),
+                ArrayData(np.asarray([im[t0] for t0 in parts], np.int32),
+                          VarcharType.of(None), elem_dict=td,
+                          max_len=len(parts)))
+    v, d = planner._require_dict(ast.args[0], cols, "split")
+    toks_per_value = [
+        str(s).split(delim) if limit is None
+        else str(s).split(delim, limit - 1) for s in d.values]
+    uniq = sorted({t for parts in toks_per_value for t in parts})
+    tdict = Dictionary(values=np.array(uniq or [""], dtype=object))
+    idmap = {t: i for i, t in enumerate(uniq)}
+    spans = np.zeros(len(d.values), np.int64)
+    heap: list = []
+    max_len = 0
+    for i, parts in enumerate(toks_per_value):
+        spans[i] = pack_span(len(heap), len(parts))
+        heap.extend(idmap[t] for t in parts)
+        max_len = max(max_len, len(parts))
+    t = ArrayType.of(VarcharType.of(None))
+    expr = ir.Call("lut", (v, ir.Constant(spans, t)), t)
+    return expr, ArrayData(np.asarray(heap, np.int32), VarcharType.of(None),
+                           elem_dict=tdict, max_len=max_len)
+
+
+def _build_split_to_map(planner, ast, cols):
+    """split_to_map(str, entryDelim, kvDelim) -> map(varchar, varchar) via
+    the same id -> span LUT over parallel key/value heaps (reference:
+    operator/scalar/SplitToMapFunction; duplicate keys keep the FIRST value
+    — documented deviation from the reference's error)."""
+    from ..connectors.tpch import Dictionary
+    from ..ops.arrays import MapData, pack_span
+    from ..types import MapType
+
+    F = _rt()
+    ed = planner._literal_str(ast.args[1], "split_to_map")
+    kd = planner._literal_str(ast.args[2], "split_to_map")
+    if not ed or not kd:
+        raise F.SemanticError("split_to_map delimiters must be non-empty")
+    v, d = planner._require_dict(ast.args[0], cols, "split_to_map")
+    pairs_per_value = []
+    for s in d.values:
+        pairs, seen = [], set()
+        for entry in str(s).split(ed):
+            if not entry:
+                continue
+            k, _, val = entry.partition(kd)
+            if k in seen:
+                continue
+            seen.add(k)
+            pairs.append((k, val))
+        pairs_per_value.append(pairs)
+    ku = sorted({k for ps in pairs_per_value for k, _ in ps})
+    vu = sorted({x for ps in pairs_per_value for _, x in ps})
+    kdict = Dictionary(values=np.array(ku or [""], dtype=object))
+    vdict = Dictionary(values=np.array(vu or [""], dtype=object))
+    kmap = {x: i for i, x in enumerate(ku)}
+    vmap = {x: i for i, x in enumerate(vu)}
+    spans = np.zeros(len(d.values), np.int64)
+    kheap: list = []
+    vheap: list = []
+    max_len = 0
+    for i, ps in enumerate(pairs_per_value):
+        spans[i] = pack_span(len(kheap), len(ps))
+        kheap.extend(kmap[k] for k, _ in ps)
+        vheap.extend(vmap[x] for _, x in ps)
+        max_len = max(max_len, len(ps))
+    vc = VarcharType.of(None)
+    t = MapType.of(vc, vc)
+    expr = ir.Call("lut", (v, ir.Constant(spans, t)), t)
+    return expr, MapData(np.asarray(kheap, np.int32),
+                         np.asarray(vheap, np.int32), vc, vc,
+                         key_dict=kdict, value_dict=vdict, max_len=max_len)
+
+
+_JODA_MAP = {"yyyy": "%Y", "yy": "%y", "MM": "%m", "dd": "%d", "HH": "%H",
+             "mm": "%M", "ss": "%S", "SSS": "%f", "EEE": "%a", "MMM": "%b"}
+
+
+def _build_parse_datetime(planner, ast, cols):
+    """parse_datetime(varchar, joda_pattern) -> timestamp(3) via the
+    dictionary LUT (inverse of format_datetime; reference:
+    DateTimeFunctions.parseDatetime)."""
+    import datetime as _dt
+
+    from ..types import TimestampType
+
+    fmt = planner._literal_str(ast.args[1], ast.name)
+    out, i = [], 0
+    while i < len(fmt):
+        for tok in ("SSS", "yyyy", "EEE", "MMM", "yy", "MM", "dd", "HH",
+                    "mm", "ss"):
+            if fmt.startswith(tok, i):
+                out.append(_JODA_MAP[tok])
+                i += len(tok)
+                break
+        else:
+            out.append(fmt[i])
+            i += 1
+    py_fmt = "".join(out)
+    t = TimestampType.of(3)
+
+    def parse(s):
+        try:
+            x = _dt.datetime.strptime(str(s), py_fmt)
+        except ValueError:
+            return None
+        epoch = _dt.datetime(1970, 1, 1)
+        return round((x - epoch).total_seconds() * 1000)
+
+    lit = _string_lit(ast)
+    if lit is not None:
+        return ir.Constant(parse(lit), t), None
+    v, d = planner._require_dict(ast.args[0], cols, ast.name)
+    vals = [parse(str(s)) for s in d.values]
+    table = np.array([0 if x is None else x for x in vals], np.int64)
+    nulls = np.array([x is None for x in vals], bool)
+    return ir.Call("lut_nullable", (v, ir.Constant(table, t),
+                                    ir.Constant(nulls, BOOLEAN)), t), None
+
+
+def _build_from_unixtime_nanos(planner, ast, cols):
+    from ..types import TimestampType
+
+    F = _rt()
+    v, _ = planner._translate(ast.args[0], cols)
+    t = TimestampType.of(9)
+    return ir.Call("as_timestamp", (F._coerce(v, BIGINT),), t), None
+
+
+def _build_const_str(value):
+    def build(planner, ast, cols, value=value):
+        return _const_string(value)
+
+    return build
+
+
+def _build_const_zero(planner, ast, cols):
+    return ir.Constant(0, BIGINT), None
+
+
 def register_batch2() -> None:
     register("sha1", "scalar", "SHA-1 hex digest (dictionary LUT)", (1, 1),
              _dict_string_fn("sha1", _hex_digest("sha1")))
@@ -495,6 +670,33 @@ def register_batch2() -> None:
     register("from_iso8601_timestamp", "scalar",
              "Parse an ISO-8601 timestamp (dictionary LUT)", (1, 1),
              _build_from_iso8601_timestamp)
+
+    register("split", "scalar",
+             "Tokenize by a literal delimiter into array(varchar)", (2, 3),
+             _build_split)
+    register("split_to_map", "scalar",
+             "Parse entry/kv-delimited text into map(varchar, varchar)",
+             (3, 3), _build_split_to_map)
+    register("parse_datetime", "scalar",
+             "Parse a Joda-pattern timestamp (dictionary LUT)", (2, 2),
+             _build_parse_datetime)
+    register("from_unixtime_nanos", "scalar",
+             "Epoch nanoseconds to timestamp(9)", (1, 1),
+             _build_from_unixtime_nanos)
+    register("current_timezone", "scalar",
+             "Session time zone (always UTC)", (0, 0),
+             _build_const_str("UTC"))
+    register("version", "scalar", "Engine version", (0, 0),
+             _build_const_str("trino-tpu-0.5"))
+    for n in ("timezone_hour", "timezone_minute"):
+        register(n, "scalar", f"{n.replace('_', ' ')} (UTC: always 0)",
+                 (1, 1), _build_const_zero)
+    register("to_base32", "scalar", "Base32 of the UTF-8 bytes", (1, 1),
+             _dict_string_fn("to_base32",
+                             lambda s: base64.b32encode(s.encode()).decode()))
+    register("from_base32", "scalar", "Decode base32 to text (NULL on error)",
+             (1, 1),
+             _dict_string_nullable_fn("from_base32", _from_base32))
 
     for n, desc in (
             ("normal_cdf", "Normal CDF(mean, sd, value)"),
