@@ -85,5 +85,8 @@ def _fmt(node: P.PlanNode, lines: list, depth: int, stats: dict) -> None:
             # in OperatorStats — spilledDataSize)
             lines[before] += (f" [spilled: {s['spilled_bytes'] / 1e6:.1f} MB, "
                               f"{s['spill_partitions']} partitions]")
+        if s.get("index_join_keys"):
+            # the probe scan collapsed to a connector keyed lookup
+            lines[before] += f" [index lookup: {s['index_join_keys']} keys]"
     for c in node.children:
         _fmt(c, lines, depth + 1, stats)
