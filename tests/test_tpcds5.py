@@ -1,0 +1,285 @@
+"""TPC-DS query breadth, round 5 batch 3: revenue-ratio reports, window
+averages over case pivots, quarter-over-quarter growth, multi-channel
+EXISTS demographics, ranked return ratios, city-pair customer reports.
+Reference corpus: testing/trino-benchmark-queries/ + plugin/trino-tpcds."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.tpcds import TpcdsConnector
+
+from test_tpcds2 import _table
+from test_tpcds3 import _check
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = Engine()
+    e.register_catalog("tpcds", TpcdsConnector(sf=SF, split_rows=1 << 14))
+    return e, e.create_session("tpcds")
+
+
+@pytest.fixture(scope="module")
+def host(eng):
+    e, _ = eng
+    conn = e.catalogs["tpcds"]
+    return {
+        "store_sales": _table(conn, "store_sales", [
+            "ss_sold_date_sk", "ss_item_sk", "ss_store_sk", "ss_customer_sk",
+            "ss_hdemo_sk", "ss_addr_sk", "ss_ticket_number", "ss_quantity",
+            "ss_ext_sales_price", "ss_sales_price", "ss_ext_list_price",
+            "ss_coupon_amt", "ss_net_profit"]),
+        "web_sales": _table(conn, "web_sales", [
+            "ws_sold_date_sk", "ws_item_sk", "ws_bill_customer_sk",
+            "ws_ext_sales_price", "ws_net_paid"]),
+        "catalog_sales": _table(conn, "catalog_sales", [
+            "cs_sold_date_sk", "cs_item_sk", "cs_bill_customer_sk",
+            "cs_ext_sales_price"]),
+        "store_returns": _table(conn, "store_returns", [
+            "sr_returned_date_sk", "sr_item_sk", "sr_return_quantity",
+            "sr_return_amt", "sr_ticket_number", "sr_customer_sk"]),
+        "item": _table(conn, "item", [
+            "i_item_sk", "i_item_id", "i_item_desc", "i_category", "i_class",
+            "i_current_price", "i_manufact_id", "i_brand"]),
+        "date_dim": _table(conn, "date_dim", [
+            "d_date_sk", "d_year", "d_moy", "d_qoy", "d_month_seq",
+            "d_week_seq"]),
+        "customer": _table(conn, "customer", [
+            "c_customer_sk", "c_customer_id", "c_current_addr_sk",
+            "c_current_hdemo_sk", "c_first_name", "c_last_name"]),
+        "customer_address": _table(conn, "customer_address", [
+            "ca_address_sk", "ca_city", "ca_county"]),
+        "household_demographics": _table(conn, "household_demographics", [
+            "hd_demo_sk", "hd_dep_count", "hd_vehicle_count"]),
+    }
+
+
+def test_q12_category_revenue_ratio(eng, host):
+    """Q12 shape: per-item revenue share of its class via a window sum."""
+    e, s = eng
+    got = e.execute_sql("""
+        select i_item_id, i_class,
+          sum(ws_ext_sales_price) itemrevenue,
+          sum(ws_ext_sales_price) * 100.0 /
+            sum(sum(ws_ext_sales_price)) over (partition by i_class) ratio
+        from web_sales, item, date_dim
+        where ws_item_sk = i_item_sk and i_category = 'Books'
+          and ws_sold_date_sk = d_date_sk and d_year = 2000
+        group by i_item_id, i_class
+        order by i_class, i_item_id limit 40""", s).to_pandas()
+    ws, it, dd = host["web_sales"], host["item"], host["date_dim"]
+    j = ws.merge(it[it.i_category == "Books"], left_on="ws_item_sk",
+                 right_on="i_item_sk") \
+        .merge(dd[dd.d_year == 2000], left_on="ws_sold_date_sk",
+               right_on="d_date_sk")
+    g = j.groupby(["i_item_id", "i_class"], as_index=False) \
+        .ws_ext_sales_price.sum() \
+        .rename(columns={"ws_ext_sales_price": "itemrevenue"})
+    g["ratio"] = g.itemrevenue * 100.0 / \
+        g.groupby("i_class").itemrevenue.transform("sum")
+    ref = g.sort_values(["i_class", "i_item_id"]).head(40) \
+        .reset_index(drop=True)[["i_item_id", "i_class", "itemrevenue",
+                                 "ratio"]]
+    _check(got, ref, {"itemrevenue", "ratio"})
+
+
+def test_q17_sales_returns_stats(eng, host):
+    """Q17 shape: quantity statistics joining sales to their returns."""
+    e, s = eng
+    got = e.execute_sql("""
+        select i_item_id, count(ss_quantity) cnt, avg(ss_quantity) a,
+               stddev_samp(ss_quantity) sd
+        from store_sales, store_returns, item
+        where ss_ticket_number = sr_ticket_number
+          and ss_item_sk = sr_item_sk and ss_item_sk = i_item_sk
+        group by i_item_id order by i_item_id limit 25""", s).to_pandas()
+    ss, sr, it = host["store_sales"], host["store_returns"], host["item"]
+    j = ss.merge(sr, left_on=["ss_ticket_number", "ss_item_sk"],
+                 right_on=["sr_ticket_number", "sr_item_sk"]) \
+        .merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    ref = j.groupby("i_item_id", as_index=False).agg(
+        cnt=("ss_quantity", "count"), a=("ss_quantity", "mean"),
+        sd=("ss_quantity", lambda x: x.std(ddof=1)))
+    ref["sd"] = ref["sd"].fillna(0)
+    ref = ref.sort_values("i_item_id").head(25).reset_index(drop=True)
+    got["sd"] = got["sd"].fillna(0)
+    _check(got, ref, {"a", "sd"})
+
+
+def test_q31_county_quarter_growth(eng, host):
+    """Q31 shape: store-sales by county and quarter via CTE self-joins."""
+    e, s = eng
+    got = e.execute_sql("""
+        with ss as (
+          select ca_county, d_qoy, sum(ss_ext_sales_price) sales
+          from store_sales, date_dim, customer_address
+          where ss_sold_date_sk = d_date_sk and ss_addr_sk = ca_address_sk
+            and d_year = 2000 group by ca_county, d_qoy)
+        select s1.ca_county, s1.sales q1_sales, s2.sales q2_sales
+        from ss s1, ss s2
+        where s1.ca_county = s2.ca_county and s1.d_qoy = 1 and s2.d_qoy = 2
+          and s2.sales > s1.sales
+        order by s1.ca_county limit 25""", s).to_pandas()
+    ss, dd, ca = (host["store_sales"], host["date_dim"],
+                  host["customer_address"])
+    j = ss.merge(dd[dd.d_year == 2000], left_on="ss_sold_date_sk",
+                 right_on="d_date_sk") \
+        .merge(ca, left_on="ss_addr_sk", right_on="ca_address_sk")
+    g = j.groupby(["ca_county", "d_qoy"], as_index=False) \
+        .ss_ext_sales_price.sum() \
+        .rename(columns={"ss_ext_sales_price": "sales"})
+    q1 = g[g.d_qoy == 1][["ca_county", "sales"]] \
+        .rename(columns={"sales": "q1_sales"})
+    q2 = g[g.d_qoy == 2][["ca_county", "sales"]] \
+        .rename(columns={"sales": "q2_sales"})
+    ref = q1.merge(q2, on="ca_county")
+    ref = ref[ref.q2_sales > ref.q1_sales].sort_values("ca_county") \
+        .head(25).reset_index(drop=True)
+    _check(got, ref, {"q1_sales", "q2_sales"})
+
+
+def test_q35_multi_channel_exists(eng, host):
+    """Q35 shape: customers active in store AND (web OR catalog)."""
+    e, s = eng
+    got = e.execute_sql("""
+        select count(*) n from customer c
+        where exists (select 1 from store_sales
+                      where ss_customer_sk = c.c_customer_sk)
+          and (c_customer_sk in (select ws_bill_customer_sk from web_sales)
+            or c_customer_sk in
+               (select cs_bill_customer_sk from catalog_sales))""",
+        s).to_pandas()
+    c, ss, ws, cs = (host["customer"], host["store_sales"],
+                     host["web_sales"], host["catalog_sales"])
+    in_ss = c.c_customer_sk.isin(set(ss.ss_customer_sk))
+    in_ws = c.c_customer_sk.isin(set(ws.ws_bill_customer_sk))
+    in_cs = c.c_customer_sk.isin(set(cs.cs_bill_customer_sk))
+    assert got["n"].iloc[0] == int((in_ss & (in_ws | in_cs)).sum())
+
+
+def test_q49_ranked_return_ratios(eng, host):
+    """Q49 shape: items ranked by return-quantity ratio."""
+    e, s = eng
+    got = e.execute_sql("""
+        select item_sk, rnk from (
+          select ss_item_sk item_sk,
+            row_number() over (order by sum(sr_return_quantity) * 1.0 /
+                               sum(ss_quantity), ss_item_sk) rnk
+          from store_sales, store_returns
+          where ss_ticket_number = sr_ticket_number
+            and ss_item_sk = sr_item_sk
+          group by ss_item_sk)
+        where rnk <= 10 order by rnk""", s).to_pandas()
+    ss, sr = host["store_sales"], host["store_returns"]
+    j = ss.merge(sr, left_on=["ss_ticket_number", "ss_item_sk"],
+                 right_on=["sr_ticket_number", "sr_item_sk"])
+    g = j.groupby("ss_item_sk", as_index=False).agg(
+        rq=("sr_return_quantity", "sum"), sq=("ss_quantity", "sum"))
+    g["ratio"] = g.rq * 1.0 / g.sq
+    g = g.sort_values(["ratio", "ss_item_sk"]).reset_index(drop=True)
+    ref = pd.DataFrame({"item_sk": g.ss_item_sk.head(10).to_numpy(),
+                        "rnk": np.arange(1, min(len(g), 10) + 1)})
+    _check(got, ref, set())
+
+
+def test_q53_manufact_window_avg(eng, host):
+    """Q53 shape: quarterly manufacturer sales vs their yearly average
+    (window avg over the aggregate)."""
+    e, s = eng
+    got = e.execute_sql("""
+        select i_manufact_id, d_qoy, sum_sales, avg_quarterly
+        from (select i_manufact_id, d_qoy,
+                sum(ss_ext_sales_price) sum_sales,
+                avg(sum(ss_ext_sales_price))
+                  over (partition by i_manufact_id) avg_quarterly
+              from store_sales, item, date_dim
+              where ss_item_sk = i_item_sk and ss_sold_date_sk = d_date_sk
+                and d_year = 2000 and i_manufact_id between 1 and 20
+              group by i_manufact_id, d_qoy)
+        order by i_manufact_id, d_qoy limit 40""", s).to_pandas()
+    ss, it, dd = host["store_sales"], host["item"], host["date_dim"]
+    j = ss.merge(it[(it.i_manufact_id >= 1) & (it.i_manufact_id <= 20)],
+                 left_on="ss_item_sk", right_on="i_item_sk") \
+        .merge(dd[dd.d_year == 2000], left_on="ss_sold_date_sk",
+               right_on="d_date_sk")
+    g = j.groupby(["i_manufact_id", "d_qoy"], as_index=False) \
+        .ss_ext_sales_price.sum() \
+        .rename(columns={"ss_ext_sales_price": "sum_sales"})
+    # engine decimal avg rounds HALF_UP to scale 2
+    g["avg_quarterly"] = np.floor(g.groupby("i_manufact_id")
+                                  .sum_sales.transform("mean") * 100
+                                  + 0.5) / 100
+    ref = g.sort_values(["i_manufact_id", "d_qoy"]).head(40) \
+        .reset_index(drop=True)
+    _check(got, ref, {"sum_sales", "avg_quarterly"})
+
+
+def test_q68_city_pair_tickets(eng, host):
+    """Q68 shape: per-ticket extended summaries joined back to customers."""
+    e, s = eng
+    got = e.execute_sql("""
+        select c_last_name, c_first_name, ca_city, bought_city,
+               ss_ticket_number, extended_price
+        from (select ss_ticket_number, ss_customer_sk, ca_city bought_city,
+                sum(ss_ext_sales_price) extended_price
+              from store_sales, date_dim, customer_address,
+                   household_demographics
+              where ss_sold_date_sk = d_date_sk
+                and ss_addr_sk = ca_address_sk
+                and ss_hdemo_sk = hd_demo_sk
+                and hd_dep_count = 5 and d_year = 2000
+              group by ss_ticket_number, ss_customer_sk, ca_city) dn,
+             customer, customer_address current_addr
+        where ss_customer_sk = c_customer_sk
+          and c_current_addr_sk = current_addr.ca_address_sk
+        order by ss_ticket_number, extended_price, c_last_name
+        limit 20""", s).to_pandas()
+    ss, dd, ca, hd, c = (host["store_sales"], host["date_dim"],
+                         host["customer_address"],
+                         host["household_demographics"], host["customer"])
+    j = ss.merge(dd[dd.d_year == 2000], left_on="ss_sold_date_sk",
+                 right_on="d_date_sk") \
+        .merge(ca, left_on="ss_addr_sk", right_on="ca_address_sk") \
+        .merge(hd[hd.hd_dep_count == 5], left_on="ss_hdemo_sk",
+               right_on="hd_demo_sk")
+    dn = j.groupby(["ss_ticket_number", "ss_customer_sk", "ca_city"],
+                   as_index=False).ss_ext_sales_price.sum() \
+        .rename(columns={"ca_city": "bought_city",
+                         "ss_ext_sales_price": "extended_price"})
+    m = dn.merge(c, left_on="ss_customer_sk", right_on="c_customer_sk") \
+        .merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+    ref = m.sort_values(["ss_ticket_number", "extended_price",
+                         "c_last_name"]).head(20).reset_index(drop=True)[
+        ["c_last_name", "c_first_name", "ca_city", "bought_city",
+         "ss_ticket_number", "extended_price"]]
+    _check(got, ref, {"extended_price"})
+
+
+def test_q20_catalog_revenue_ratio(eng, host):
+    """Q20 shape: catalog revenue share within class (window over agg)."""
+    e, s = eng
+    got = e.execute_sql("""
+        select i_item_id,
+          sum(cs_ext_sales_price) rev,
+          sum(sum(cs_ext_sales_price)) over (partition by i_class) class_rev
+        from catalog_sales, item, date_dim
+        where cs_item_sk = i_item_sk and i_category = 'Music'
+          and cs_sold_date_sk = d_date_sk and d_year = 2001
+        group by i_item_id, i_class
+        order by i_item_id limit 30""", s).to_pandas()
+    cs, it, dd = host["catalog_sales"], host["item"], host["date_dim"]
+    j = cs.merge(it[it.i_category == "Music"], left_on="cs_item_sk",
+                 right_on="i_item_sk") \
+        .merge(dd[dd.d_year == 2001], left_on="cs_sold_date_sk",
+               right_on="d_date_sk")
+    g = j.groupby(["i_item_id", "i_class"], as_index=False) \
+        .cs_ext_sales_price.sum().rename(
+            columns={"cs_ext_sales_price": "rev"})
+    g["class_rev"] = g.groupby("i_class").rev.transform("sum")
+    ref = g.sort_values("i_item_id").head(30).reset_index(drop=True)[
+        ["i_item_id", "rev", "class_rev"]]
+    _check(got, ref, {"rev", "class_rev"})
