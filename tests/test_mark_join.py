@@ -1,0 +1,109 @@
+"""EXISTS in expression position via MARK joins (reference:
+SubqueryPlanner's correlatedExists -> SemiJoinNode semiJoinOutput symbol;
+executor 'mark' kind appends the matched boolean channel)."""
+
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.memory import MemoryConnector
+
+
+@pytest.fixture(scope="module")
+def meng():
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table c (ck bigint, nm varchar)", s)
+    e.execute_sql("create table w (wk bigint)", s)
+    e.execute_sql("create table g (gk bigint)", s)
+    e.execute_sql("insert into c values (1,'a'), (2,'b'), (3,'c'), (4,'d')", s)
+    e.execute_sql("insert into w values (1), (3)", s)
+    e.execute_sql("insert into g values (2), (3)", s)
+    return e, s
+
+
+def _col(meng, sql):
+    e, s = meng
+    return list(e.execute_sql(sql, s).to_pandas().iloc[:, 0])
+
+
+def test_or_of_two_exists(meng):
+    assert _col(meng, """select ck from c
+        where exists (select 1 from w where wk = c.ck)
+           or exists (select 1 from g where gk = c.ck)
+        order by ck""") == [1, 2, 3]
+
+
+def test_not_over_or_of_exists(meng):
+    assert _col(meng, """select ck from c
+        where not (exists (select 1 from w where wk = c.ck)
+                or exists (select 1 from g where gk = c.ck))""") == [4]
+
+
+def test_exists_or_plain_predicate(meng):
+    assert _col(meng, """select ck from c
+        where ck = 4 or exists (select 1 from w where wk = c.ck)
+        order by ck""") == [1, 3, 4]
+
+
+def test_exists_inside_case(meng):
+    assert _col(meng, """select ck from c
+        where case when exists (select 1 from w where wk = c.ck)
+              then 1 else 0 end = 1 order by ck""") == [1, 3]
+
+
+def test_negated_exists_under_or(meng):
+    assert _col(meng, """select ck from c
+        where (not exists (select 1 from w where wk = c.ck)) or ck = 1
+        order by ck""") == [1, 2, 4]
+
+
+def test_uncorrelated_exists_under_or_folds(meng):
+    assert _col(meng, """select ck from c
+        where exists (select 1 from w where wk > 100) or ck = 2""") == [2]
+
+
+def test_mark_mixes_with_in_subquery(meng):
+    assert _col(meng, """select ck from c
+        where ck in (select gk from g)
+           or exists (select 1 from w where wk = c.ck)
+        order by ck""") == [1, 2, 3]
+
+
+def test_plain_exists_still_semi_join(meng):
+    # top-level EXISTS must keep the semi-join path (no mark overhead)
+    assert _col(meng, """select ck from c
+        where exists (select 1 from w where wk = c.ck)
+        order by ck""") == [1, 3]
+
+
+def test_select_star_hides_mark_channel(meng):
+    """SELECT * must not leak the synthetic $markN channel (review catch)."""
+    e, s = meng
+    r = e.execute_sql("""select * from c
+        where exists (select 1 from w where wk = c.ck) or ck = 2
+        order by ck""", s).to_pandas()
+    assert list(r.columns) == ["ck", "nm"]
+    assert list(r["ck"]) == [1, 2, 3]
+
+
+def test_or_of_in_subqueries_still_works(meng):
+    """Nested IN-subqueries without EXISTS keep the eager fold (review
+    catch: the deepened routing must not break them)."""
+    assert _col(meng, """select ck from c
+        where ck in (select wk from w) or ck in (select gk from g)
+        order by ck""") == [1, 2, 3]
+
+
+def test_ungrouped_aggregate_exists_constant_true(meng):
+    """EXISTS over an ungrouped aggregate is constant-true even in
+    expression position (review catch)."""
+    assert _col(meng, """select ck from c
+        where ck = 4 or exists (select max(wk) from w where wk = c.ck)
+        order by ck""") == [1, 2, 3, 4]
+
+
+def test_grouped_exists_in_expression_position(meng):
+    assert _col(meng, """select ck from c
+        where ck = 4 or exists (select wk from w where wk = c.ck group by wk)
+        order by ck""") == [1, 3, 4]

@@ -149,9 +149,10 @@ def test_q35_multi_channel_exists(eng, host):
         select count(*) n from customer c
         where exists (select 1 from store_sales
                       where ss_customer_sk = c.c_customer_sk)
-          and (c_customer_sk in (select ws_bill_customer_sk from web_sales)
-            or c_customer_sk in
-               (select cs_bill_customer_sk from catalog_sales))""",
+          and (exists (select 1 from web_sales
+                       where ws_bill_customer_sk = c.c_customer_sk)
+            or exists (select 1 from catalog_sales
+                       where cs_bill_customer_sk = c.c_customer_sk))""",
         s).to_pandas()
     c, ss, ws, cs = (host["customer"], host["store_sales"],
                      host["web_sales"], host["catalog_sales"])

@@ -638,6 +638,10 @@ class DistributedExecutor:
                             aux=up.aux, aux_specs=up.aux_specs)
 
         if isinstance(node, P.Join):
+            if node.kind == "mark":
+                return self._decline(
+                    node, "mark joins (EXISTS in expression position) run "
+                          "the local executor")
             up = self._compile_stream(node.left)
             if up is None:
                 return None

@@ -1982,7 +1982,10 @@ class LocalExecutor:
 
     def _join_with_build(self, node: P.Join, build_page, build_dicts, probe_stream,
                          build_key_types) -> _Stream:
-        semi = node.kind in ("semi", "anti")
+        # "mark" (reference: semi-join MARKER output, planner/plan/
+        # SemiJoinNode's semiJoinOutput): probe channels + one boolean
+        # matched channel, no lane filtering — EXISTS in expression position
+        semi = node.kind in ("semi", "anti", "mark")
         build_has_null, build_nonempty = _build_null_stats(build_page, node.right_keys)
         span = self._direct_join_span(build_page, node.right_keys, build_key_types)
         table = None
@@ -2013,6 +2016,9 @@ class LocalExecutor:
                 valid = valid & ~matched
                 valid = _null_aware_anti(node, valid, nulls, build_has_null,
                                          build_nonempty)
+            if node.kind == "mark":
+                return (tuple(cols) + (matched & valid,),
+                        tuple(nulls) + (None,), valid)
             if semi:
                 return cols, nulls, valid
             bcols, bnulls = _gather_build(table, row_ids, matched, node.kind)
@@ -2020,13 +2026,15 @@ class LocalExecutor:
             out_nulls = tuple(nulls) + bnulls
             return out_cols, out_nulls, valid
 
-        dicts = (probe_stream.dicts if semi
+        dicts = (probe_stream.dicts + (None,) if node.kind == "mark"
+                 else probe_stream.dicts if semi
                  else probe_stream.dicts + build_dicts)
         # propagate probe-side scan provenance: downstream aggregations use it for
         # row-bound table sizing, and further joins for dynamic split pruning
         si = None
         if probe_stream.scan_info is not None:
-            n_build = 0 if semi else len(build_page.columns)
+            n_build = (1 if node.kind == "mark"
+                       else 0 if semi else len(build_page.columns))
             si = dataclasses.replace(
                 probe_stream.scan_info,
                 columns=tuple(probe_stream.scan_info.columns) + (None,) * n_build)
@@ -2044,7 +2052,7 @@ class LocalExecutor:
         (ops/hashjoin.multi_build) + searchsorted expansion; output page size is
         data-dependent, so the expansion crosses a host sync per page and re-jits per
         power-of-two output bucket (shape-class caching keeps recompiles bounded)."""
-        semi = node.kind in ("semi", "anti")
+        semi = node.kind in ("semi", "anti", "mark")
         if build_page.capacity == 0:
             # empty build: pad one never-matching dummy row so gathers stay well-defined
             cols = tuple(jnp.zeros((1,), f.type.dtype) for f in node.right.schema.fields)
@@ -2119,6 +2127,11 @@ class LocalExecutor:
                 cols, nulls, valid, slot, matched, cnt, out_cnt, incl = \
                     count_step(page, mt, probe_stream.aux)
                 if semi and node.filter is None:
+                    if node.kind == "mark":
+                        yield Page(node.schema,
+                                   tuple(cols) + (matched & valid,),
+                                   tuple(nulls) + (None,), valid)
+                        continue
                     if node.kind == "semi":
                         v = valid & matched
                     else:
@@ -2132,13 +2145,19 @@ class LocalExecutor:
                                  incl, mt)
                 if semi:
                     mark = out
+                    if node.kind == "mark":
+                        yield Page(node.schema, tuple(cols) + (mark & valid,),
+                                   tuple(nulls) + (None,), valid)
+                        continue
                     v = valid & mark if node.kind == "semi" else valid & ~mark
                     yield Page(probe_stream.schema, cols, nulls, v)
                 else:
                     ocols, onulls, ovalid = out
                     yield Page(node.schema, ocols, onulls, ovalid)
 
-        dicts = (probe_stream.dicts if semi else probe_stream.dicts + build_dicts)
+        dicts = (probe_stream.dicts + (None,) if node.kind == "mark"
+                 else probe_stream.dicts if semi
+                 else probe_stream.dicts + build_dicts)
         return _Stream(node.schema, dicts, pages, lambda c, n, v, aux: (c, n, v))
 
     def _compile_partitioned_local_join(self, node: P.Join, build_page, build_dicts,

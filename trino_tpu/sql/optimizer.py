@@ -152,6 +152,31 @@ def _prune(node: P.PlanNode, required):
         return dataclasses.replace(node, child=child), None
 
     if isinstance(node, P.Join):
+        if node.kind == "mark":
+            # probe channels + one appended boolean mark channel (always
+            # last): prune both sides like a semi join, then remap the mark
+            # channel onto the new probe width
+            n_left = len(node.left.schema.fields)
+            left_req = {c for c in required if c < n_left} \
+                | set(node.left_keys)
+            right_req = set(node.right_keys)
+            left, lm = _prune(node.left, _closed(node.left, left_req))
+            right, rm = _prune(node.right, _closed(node.right, right_req))
+            lmf = lm if lm else {c: c for c in range(n_left)}
+            rmf = rm if rm else \
+                {c: c for c in range(len(node.right.schema.fields))}
+            left_keys = tuple(lmf[c] for c in node.left_keys)
+            right_keys = tuple(rmf[c] for c in node.right_keys)
+            new_n_left = len(left.schema.fields)
+            schema = Schema(tuple(left.schema.fields)
+                            + (node.schema.fields[-1],))
+            comb = dict(lmf)
+            comb[n_left] = new_n_left  # the mark channel itself
+            out_map = None if all(comb.get(i, i) == i
+                                  for i in range(n_left + 1)) else comb
+            return dataclasses.replace(
+                node, left=left, right=right, left_keys=left_keys,
+                right_keys=right_keys, schema=schema), out_map
         semi = node.kind in ("semi", "anti")
         n_left = len(node.left.schema.fields)
         left_req = {c for c in required if c < n_left} | set(node.left_keys)

@@ -79,14 +79,26 @@ def _and_all(conjs):
 
 
 def _has_subquery(ast) -> bool:
-    if isinstance(ast, (A.InSubquery, A.Exists, A.ScalarSubquery)):
-        return True
-    if isinstance(ast, A.BinaryOp) and ast.op in ("eq", "neq", "lt", "lte", "gt", "gte"):
-        # comparison against a subquery is a subquery conjunct ONLY if one side is one
-        return isinstance(ast.left, A.ScalarSubquery) or isinstance(ast.right, A.ScalarSubquery)
-    if isinstance(ast, A.UnaryOp) and ast.op == "not":
-        return _has_subquery(ast.operand)
-    return False
+    """Deep: a conjunct with a subquery ANYWHERE (under OR/NOT/CASE) routes
+    to subquery planning — the top-level patterns match directly, anything
+    else goes through the EXISTS mark-join rewrite.  Nested Select bodies
+    don't count (they are the subqueries themselves, not outer references);
+    CASE's (cond, value) pairs sit two tuples deep, hence the generic
+    value walk."""
+    import dataclasses as _dc
+
+    def walk(v) -> bool:
+        if isinstance(v, (A.InSubquery, A.Exists, A.ScalarSubquery)):
+            return True
+        if isinstance(v, A.Select):
+            return False
+        if isinstance(v, tuple):
+            return any(walk(x) for x in v)
+        if _dc.is_dataclass(v) and isinstance(v, A.Node):
+            return any(walk(getattr(v, f.name)) for f in _dc.fields(v))
+        return False
+
+    return walk(ast)
 
 
 def _flip_cmp(op: str) -> str:

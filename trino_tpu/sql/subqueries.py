@@ -64,7 +64,11 @@ class SubqueryPlannerMixin:
             sub = c.right if isinstance(c.right, A.ScalarSubquery) else c.left
             other_ast = c.left if sub is c.right else c.right
             if not isinstance(sub, A.ScalarSubquery):
-                raise SemanticError(f"unsupported subquery predicate {c}")
+                # subquery buried deeper (CASE WHEN EXISTS ... = 1):
+                # the mark rewrite handles expression-position EXISTS
+                if neg:
+                    c = A.UnaryOp("not", c)
+                return self._apply_mark_rewrite(c, rel)
             op = c.op if sub is c.right else _flip_cmp(c.op)
             if neg:
                 op = {"eq": "neq", "neq": "eq", "lt": "gte", "lte": "gt",
@@ -87,7 +91,158 @@ class SubqueryPlannerMixin:
             t = common_super_type(other.type, agg_expr.type)
             pred = ir.Call(op, (_coerce(other, t), _coerce(agg_expr, t)), BOOLEAN)
             return RelPlan(P.Filter(rel2.node, pred), rel2.cols, rel2.unique_sets)
-        raise SemanticError(f"unsupported subquery predicate {c}")
+        if neg:
+            c = A.UnaryOp("not", c)
+        return self._apply_mark_rewrite(c, rel)
+
+    def _apply_mark_rewrite(self, c, rel: RelPlan) -> RelPlan:
+        """EXISTS in general expression position (under OR/NOT/CASE): each
+        Exists node becomes a MARK join's boolean channel and the rewritten
+        conjunct filters on it (reference: SubqueryPlanner's
+        correlatedExists -> SemiJoinNode with semiJoinOutput symbol;
+        uncorrelated IN/scalar subqueries inside the same expression keep
+        folding through the eager translate paths)."""
+        import dataclasses as _dc
+
+        from .aggsugar import _replace_nodes
+
+        exists_nodes: list = []
+
+        def collect(v):
+            if isinstance(v, A.Exists):
+                if v not in exists_nodes:
+                    exists_nodes.append(v)
+                return
+            if isinstance(v, A.Select):
+                return
+            if isinstance(v, tuple):
+                for x in v:
+                    collect(x)
+                return
+            if _dc.is_dataclass(v) and isinstance(v, A.Node):
+                for f in _dc.fields(v):
+                    collect(getattr(v, f.name))
+
+        collect(c)
+        n_orig = len(rel.cols)
+        orig_cols = list(rel.cols)
+        mapping = {}
+        for ex in exists_nodes:
+            rel, repl = self._mark_exists(ex.query, rel)
+            if ex.negated:
+                repl = A.UnaryOp("not", repl)
+            mapping[ex] = repl
+        # no Exists nodes: nested IN/scalar subqueries fold through the
+        # eager translate paths below (the pre-mark behavior)
+        c2 = _replace_nodes(c, mapping) if mapping else c
+        e, _ = self.translate(c2, rel.cols)
+        node = P.Filter(rel.node, e)
+        if len(rel.cols) > n_orig:
+            # project the synthetic $mark/helper channels back out — they
+            # must not leak through SELECT *
+            exprs = tuple(ir.FieldRef(i, ci.type, ci.name)
+                          for i, ci in enumerate(orig_cols))
+            schema = Schema(tuple(Field(ci.name or f"c{i}", ci.type)
+                                  for i, ci in enumerate(orig_cols)))
+            node = P.Project(node, exprs, schema,
+                             tuple(ci.dict for ci in orig_cols))
+            return RelPlan(node, orig_cols, rel.unique_sets)
+        return RelPlan(node, rel.cols, rel.unique_sets)
+
+    def _mark_exists(self, q: A.Select, rel: RelPlan):
+        """(rel', replacement AST) for one EXISTS in expression position:
+        a mark join appends a boolean matched channel named uniquely so the
+        replacement Identifier resolves to it."""
+        if q.having is not None:
+            raise SemanticError(
+                "HAVING inside EXISTS in expression position not supported")
+        if q.limit == 0:
+            return rel, A.BoolLit(False)
+        if not q.group_by:
+            aggs: list = []
+            for it in q.items:
+                if not isinstance(it.expr, A.Star):
+                    _collect_aggs(it.expr, aggs)
+            if aggs:
+                # an ungrouped aggregate query yields exactly one row
+                # regardless of input: EXISTS is constant-true
+                return rel, A.BoolLit(True)
+        # GROUP BY without HAVING does not change row existence; dropped in
+        # the inner select below
+        inner_cols = self._inner_columns(q.from_)
+        inner_only, corr_pairs_ast = [], []
+        for cj in _split_conjuncts(q.where):
+            if self._resolves(cj, inner_cols):
+                inner_only.append(cj)
+                continue
+            pair = self._split_correlated_equi(cj, rel.cols, inner_cols)
+            if pair is None:
+                raise SemanticError(
+                    "non-equi correlated EXISTS in expression position not "
+                    "supported")
+            corr_pairs_ast.append(pair)
+        if not corr_pairs_ast:
+            # uncorrelated: evaluate once, splice the constant
+            sub = dataclasses.replace(
+                q, items=(A.SelectItem(A.NumberLit("1"), None),),
+                where=_and_all(inner_only), limit=1, order_by=(), group_by=())
+            res = self.engine.execute_plan(self.plan_query(sub), cache=False)
+            return rel, A.BoolLit(len(res) > 0)
+        inner_sel = dataclasses.replace(
+            q, items=tuple(A.SelectItem(inner_ast, None)
+                           for _, inner_ast in corr_pairs_ast),
+            where=_and_all(inner_only), group_by=(), having=None,
+            order_by=(), limit=None)
+        inner_rel, _, _ = self._plan_select(inner_sel)
+        pairs = []
+        for i, (outer_ast, _) in enumerate(corr_pairs_ast):
+            oe, _ = self.translate(outer_ast, rel.cols)
+            ic = inner_rel.cols[i]
+            pairs.append((oe, ir.FieldRef(i, ic.type, ic.name)))
+        mark_name = f"$mark{len(rel.cols)}"
+        rel2 = self._mark_join(rel, inner_rel, pairs, mark_name)
+        return rel2, A.Identifier((mark_name,))
+
+    def _equi_build_probe(self, rel: RelPlan, inner: RelPlan, pairs,
+                          null_aware: bool = False):
+        """(build, probe_node, pkeys, bkeys): coerce BOTH sides to the
+        common key type (packed-key equality is exact, so a scale/width
+        mismatch would silently never match), project inner to its key
+        columns, then distinct (unique build keys; null-aware builds skip
+        the dedup so the executor's hash table sees NULLs).  Shared by
+        semi/anti and mark joins."""
+        types = [common_super_type(pe.type, be.type) for pe, be in pairs]
+        key_exprs = [_coerce(be, t) for (_, be), t in zip(pairs, types)]
+        schema = Schema(tuple(Field(f"sk{i}", e.type)
+                              for i, e in enumerate(key_exprs)))
+        build = P.Project(inner.node, tuple(key_exprs), schema)
+        if not null_aware:
+            build = P.Aggregate(build, tuple(range(len(key_exprs))), (),
+                                schema)
+        probe_node = rel.node
+        pkeys, bkeys = [], []
+        for i, ((pe, _), t) in enumerate(zip(pairs, types)):
+            pch, probe_node = _ensure_channel(probe_node, _coerce(pe, t),
+                                              rel.cols)
+            pkeys.append(pch)
+            bkeys.append(i)
+        return build, probe_node, pkeys, bkeys
+
+    def _mark_join(self, rel: RelPlan, inner: RelPlan, pairs,
+                   mark_name: str) -> RelPlan:
+        """rel with an appended boolean channel: TRUE where an inner row
+        matches on the equi pairs (the executor's 'mark' join kind)."""
+        build, probe_node, pkeys, bkeys = self._equi_build_probe(
+            rel, inner, pairs)
+        out_schema = Schema(tuple(probe_node.schema.fields)
+                            + (Field(mark_name, BOOLEAN),))
+        join = P.Join("mark", probe_node, build, tuple(pkeys), tuple(bkeys),
+                      out_schema)
+        cols = (list(rel.cols)
+                + [ColumnInfo(None, f.name, f.type)
+                   for f in probe_node.schema.fields[len(rel.cols):]]
+                + [ColumnInfo(None, mark_name, BOOLEAN)])
+        return RelPlan(join, cols, rel.unique_sets)
 
     def _semi_anti_join(self, rel: RelPlan, inner: RelPlan, pairs, negated: bool,
                         null_aware: bool = False) -> RelPlan:
@@ -97,21 +252,8 @@ class SubqueryPlannerMixin:
         NOT IN yield UNKNOWN for otherwise-unmatched rows (reference: null-aware anti
         join in SemiJoinNode planning).  The group-by dedup erases null masks, so
         null-aware builds skip it and let the executor's hash table dedup instead."""
-        # coerce BOTH sides to the common key type (packed-key equality is exact, so a
-        # scale/width mismatch would silently never match), project inner to its key
-        # columns, then distinct (unique build keys)
-        types = [common_super_type(pe.type, be.type) for pe, be in pairs]
-        key_exprs = [_coerce(be, t) for (_, be), t in zip(pairs, types)]
-        schema = Schema(tuple(Field(f"sk{i}", e.type) for i, e in enumerate(key_exprs)))
-        build = P.Project(inner.node, tuple(key_exprs), schema)
-        if not null_aware:
-            build = P.Aggregate(build, tuple(range(len(key_exprs))), (), schema)
-        probe_node = rel.node
-        pkeys, bkeys = [], []
-        for i, ((pe, _), t) in enumerate(zip(pairs, types)):
-            pch, probe_node = _ensure_channel(probe_node, _coerce(pe, t), rel.cols)
-            pkeys.append(pch)
-            bkeys.append(i)
+        build, probe_node, pkeys, bkeys = self._equi_build_probe(
+            rel, inner, pairs, null_aware)
         kind = "anti" if negated else "semi"
         join = P.Join(kind, probe_node, build, tuple(pkeys), tuple(bkeys),
                       probe_node.schema, null_aware=null_aware)
