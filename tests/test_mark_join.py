@@ -107,3 +107,17 @@ def test_grouped_exists_in_expression_position(meng):
     assert _col(meng, """select ck from c
         where ck = 4 or exists (select wk from w where wk = c.ck group by wk)
         order by ck""") == [1, 3, 4]
+
+
+def test_exists_in_select_list(meng):
+    """EXISTS as a projection expression (CASE WHEN EXISTS ... in SELECT)."""
+    e, s = meng
+    r = e.execute_sql("""select ck,
+        case when exists (select 1 from w where wk = c.ck)
+             then 'w' else 'x' end tag from c order by ck""", s).to_pandas()
+    assert list(r["tag"]) == ["w", "x", "w", "x"]
+    assert list(r.columns) == ["ck", "tag"]
+    r = e.execute_sql("""select ck,
+        exists (select 1 from g where gk = c.ck) m from c order by ck""",
+        s).to_pandas()
+    assert [bool(x) for x in r["m"]] == [False, True, True, False]

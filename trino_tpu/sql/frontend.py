@@ -197,6 +197,10 @@ class Planner(SubqueryPlannerMixin, RelationPlannerMixin,
         else:
             if win_calls:
                 rel, items = self._plan_windows(rel, items, win_calls)
+            if any(_has_subquery(it.expr) for it in items
+                   if not isinstance(it.expr, A.Star)):
+                # EXISTS inside projection expressions -> mark joins
+                rel, items = self._rewrite_select_exists(rel, items)
             exprs, dicts, names = [], [], []
             for i, it in enumerate(items):
                 e, d = self.translate(it.expr, rel.cols)

@@ -29,8 +29,53 @@ from .planbase import (RelPlan, _split_conjuncts, _split_disjuncts, _and_all,
 from .aggsugar import _collect_aggs
 
 
+def _collect_exists(v, out: list) -> None:
+    """Deep-collect A.Exists nodes, skipping nested Select bodies (their
+    subqueries belong to THEIR planning, not this expression's)."""
+    if isinstance(v, A.Exists):
+        if v not in out:
+            out.append(v)
+        return
+    if isinstance(v, A.Select):
+        return
+    if isinstance(v, tuple):
+        for x in v:
+            _collect_exists(x, out)
+        return
+    if dataclasses.is_dataclass(v) and isinstance(v, A.Node):
+        for f in dataclasses.fields(v):
+            _collect_exists(getattr(v, f.name), out)
+
+
 class SubqueryPlannerMixin:
     """Planner methods for subquery predicates (mixed into Planner)."""
+
+    def _rewrite_select_exists(self, rel: RelPlan, items):
+        """EXISTS inside SELECT-list expressions: each becomes a mark join's
+        boolean channel; the output projection then simply excludes the
+        synthetic channels (reference: SubqueryPlanner handling subqueries
+        in any expression position)."""
+        from .aggsugar import _replace_nodes
+
+        new_items = []
+        for it in items:
+            if isinstance(it.expr, A.Star):
+                new_items.append(it)
+                continue
+            exists_nodes: list = []
+            _collect_exists(it.expr, exists_nodes)
+            if not exists_nodes:
+                new_items.append(it)
+                continue
+            mapping = {}
+            for ex in exists_nodes:
+                rel, repl = self._mark_exists(ex.query, rel)
+                if ex.negated:
+                    repl = A.UnaryOp("not", repl)
+                mapping[ex] = repl
+            new_items.append(dataclasses.replace(
+                it, expr=_replace_nodes(it.expr, mapping)))
+        return rel, new_items
 
     # ---------------------------------------------------------------- subquery predicates
     def _apply_subquery_conjunct(self, c, rel: RelPlan) -> RelPlan:
@@ -102,28 +147,10 @@ class SubqueryPlannerMixin:
         correlatedExists -> SemiJoinNode with semiJoinOutput symbol;
         uncorrelated IN/scalar subqueries inside the same expression keep
         folding through the eager translate paths)."""
-        import dataclasses as _dc
-
         from .aggsugar import _replace_nodes
 
         exists_nodes: list = []
-
-        def collect(v):
-            if isinstance(v, A.Exists):
-                if v not in exists_nodes:
-                    exists_nodes.append(v)
-                return
-            if isinstance(v, A.Select):
-                return
-            if isinstance(v, tuple):
-                for x in v:
-                    collect(x)
-                return
-            if _dc.is_dataclass(v) and isinstance(v, A.Node):
-                for f in _dc.fields(v):
-                    collect(getattr(v, f.name))
-
-        collect(c)
+        _collect_exists(c, exists_nodes)
         n_orig = len(rel.cols)
         orig_cols = list(rel.cols)
         mapping = {}
