@@ -121,3 +121,22 @@ def test_exists_in_select_list(meng):
         exists (select 1 from g where gk = c.ck) m from c order by ck""",
         s).to_pandas()
     assert [bool(x) for x in r["m"]] == [False, True, True, False]
+
+
+def test_correlated_scalar_in_select_list(meng):
+    """Correlated scalar aggregates project through the left-join
+    decorrelation channel (reference:
+    TransformCorrelatedScalarAggregationToJoin in projection position)."""
+    e, s = meng
+    e.execute_sql("create table b2 (k bigint, v bigint)", s)
+    e.execute_sql("insert into b2 values (1, 10), (1, 20), (3, 5)", s)
+    r = e.execute_sql("select ck, (select sum(v) from b2 where b2.k = c.ck) sv "
+                      "from c order by ck", s).to_pandas()
+    vals = [None if x != x or x is None else int(x) for x in r["sv"]]
+    assert vals == [30, None, 5, None]
+    r = e.execute_sql("select ck, (select count(*) from b2 where b2.k = c.ck) n "
+                      "from c order by ck", s).to_pandas()
+    assert list(r["n"]) == [2, 0, 1, 0]
+    r = e.execute_sql("select * from c where ck in (select k from b2) order by ck",
+                      s).to_pandas()
+    assert list(r.columns) == ["ck", "nm"]

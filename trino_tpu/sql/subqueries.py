@@ -47,14 +47,34 @@ def _collect_exists(v, out: list) -> None:
             _collect_exists(getattr(v, f.name), out)
 
 
+def _collect_scalar_subs(v, out: list) -> None:
+    """Deep-collect A.ScalarSubquery nodes, skipping nested Select bodies."""
+    if isinstance(v, A.ScalarSubquery):
+        if v not in out:
+            out.append(v)
+        return
+    if isinstance(v, A.Select):
+        return
+    if isinstance(v, tuple):
+        for x in v:
+            _collect_scalar_subs(x, out)
+        return
+    if dataclasses.is_dataclass(v) and isinstance(v, A.Node):
+        for f in dataclasses.fields(v):
+            _collect_scalar_subs(getattr(v, f.name), out)
+
+
 class SubqueryPlannerMixin:
     """Planner methods for subquery predicates (mixed into Planner)."""
 
     def _rewrite_select_exists(self, rel: RelPlan, items):
-        """EXISTS inside SELECT-list expressions: each becomes a mark join's
-        boolean channel; the output projection then simply excludes the
-        synthetic channels (reference: SubqueryPlanner handling subqueries
-        in any expression position)."""
+        """Subqueries inside SELECT-list expressions: EXISTS becomes a mark
+        join's boolean channel; a CORRELATED scalar aggregate decorrelates
+        through the left-join rewrite and rides a projected channel.
+        Uncorrelated scalars keep their eager fold in translate.  The
+        output projection then simply excludes the synthetic channels
+        (reference: SubqueryPlanner handling subqueries in any expression
+        position)."""
         from .aggsugar import _replace_nodes
 
         new_items = []
@@ -64,7 +84,9 @@ class SubqueryPlannerMixin:
                 continue
             exists_nodes: list = []
             _collect_exists(it.expr, exists_nodes)
-            if not exists_nodes:
+            scalar_nodes: list = []
+            _collect_scalar_subs(it.expr, scalar_nodes)
+            if not exists_nodes and not scalar_nodes:
                 new_items.append(it)
                 continue
             mapping = {}
@@ -73,9 +95,41 @@ class SubqueryPlannerMixin:
                 if ex.negated:
                     repl = A.UnaryOp("not", repl)
                 mapping[ex] = repl
+            for sq in scalar_nodes:
+                try:
+                    self.plan_query(sq.query)
+                    continue  # uncorrelated: translate folds it eagerly
+                except SemanticError:
+                    pass
+                name = f"$sub{len(rel.cols)}"
+                rel = self._scalar_sub_channel(sq.query, rel, name)
+                mapping[sq] = A.Identifier((name,))
+            if not mapping:
+                new_items.append(it)
+                continue
             new_items.append(dataclasses.replace(
                 it, expr=_replace_nodes(it.expr, mapping)))
         return rel, new_items
+
+    def _scalar_sub_channel(self, q: A.Select, rel: RelPlan,
+                            name: str) -> RelPlan:
+        """rel with an appended channel holding the correlated scalar
+        aggregate's value (NULL / 0-for-count on empty groups, via the
+        left-join decorrelation)."""
+        joined, agg_expr = self._join_correlated_agg(q, rel)
+        agg_dict = None
+        if isinstance(agg_expr, ir.FieldRef):
+            agg_dict = joined.cols[agg_expr.index].dict
+        exprs = tuple(ir.FieldRef(i, ci.type, ci.name)
+                      for i, ci in enumerate(joined.cols)) + (agg_expr,)
+        schema = Schema(tuple(Field(ci.name or f"c{i}", ci.type)
+                              for i, ci in enumerate(joined.cols))
+                        + (Field(name, agg_expr.type),))
+        node = P.Project(joined.node, exprs, schema,
+                         tuple(ci.dict for ci in joined.cols) + (agg_dict,))
+        cols = (list(joined.cols)
+                + [ColumnInfo(None, name, agg_expr.type, agg_dict)])
+        return RelPlan(node, cols, rel.unique_sets)
 
     # ---------------------------------------------------------------- subquery predicates
     def _apply_subquery_conjunct(self, c, rel: RelPlan) -> RelPlan:
