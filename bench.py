@@ -35,6 +35,16 @@ import jax
 
 if _force_cpu:
     jax.config.update("jax_platforms", "cpu")
+else:
+    # Real-device runs: persist compiled executables across processes.  The
+    # axon tunnel stays up ~30 min per contact (CLAUDE.md) and a cold Q1
+    # compile alone eats ~110s of it; with this cache the next contact's
+    # bench spends its window executing, not compiling.  CPU smoke runs skip
+    # it (thousands of tiny programs would bloat the cache on the 1-core box).
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

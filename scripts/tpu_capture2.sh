@@ -1,0 +1,54 @@
+#!/bin/bash
+# Second round-5 capture: tunnel answered 2026-08-02T15:33Z.  The Aug-1
+# capture predates the device-resident finalize (850a1b7) and device TopN
+# (_topn_page_device) work, and scan-fused is already proven slower on
+# device — so this run measures ONLY unfused SF1/SF10 plus the cluster
+# probe, in priority order, inside the ~30-min tunnel-life window.
+cd /root/repo
+LOG=scripts/tpu_watch.log
+exec 9> scripts/tpu_watch.lock
+if ! flock -n 9; then
+  echo "$(date -Is) capture2: another watcher holds the lock; exiting" >> "$LOG"
+  exit 2
+fi
+echo "$(date -Is) capture2 start (tunnel known up)" >> "$LOG"
+for cfg in "sf1_unfused:1:0:540:720" "sf10_unfused:10:0:1200:1500"; do
+  IFS=: read -r name sf fused budget tmo <<< "$cfg"
+  BENCH_BUDGET=$budget BENCH_SF=$sf TRINO_TPU_SCAN_FUSED=$fused \
+    timeout -k 60 "$tmo" python bench.py \
+    > "scripts/bench_${name}_c2.json" 2> "scripts/bench_${name}_c2.log"
+  rc=$?
+  echo "$(date -Is) capture2 $name rc=$rc : $(cat scripts/bench_${name}_c2.json)" >> "$LOG"
+done
+rm -f scripts/tpu_cluster_probe.json
+timeout -k 30 700 python scripts/tpu_cluster_probe.py \
+  > scripts/tpu_cluster_probe.out 2>&1
+echo "$(date -Is) capture2 cluster probe rc=$?" >> "$LOG"
+python - <<'PY'
+import json, subprocess, time
+out = {"captured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+       "note": "second r05 capture: unfused-only, post device-finalize/device-TopN"}
+try:
+    out["device"] = subprocess.run(
+        ["python", "-c", "import jax; print(jax.devices()[0])"],
+        capture_output=True, text=True, timeout=180).stdout.strip()
+except Exception as e:
+    out["device"] = f"probe-error: {e}"
+for name in ("sf1_unfused", "sf10_unfused"):
+    try:
+        out[name] = json.load(open(f"scripts/bench_{name}_c2.json"))
+    except Exception as e:
+        out[name] = {"error": str(e)}
+try:
+    out["cluster_tpu_probe"] = json.load(open("scripts/tpu_cluster_probe.json"))
+except Exception as e:
+    out["cluster_tpu_probe"] = {"error": str(e)}
+prev = {}
+try:
+    prev = json.load(open("BENCH_local_r05.json"))
+except Exception:
+    pass
+out["aug1_capture"] = {k: prev.get(k) for k in ("captured_at", "sf1_unfused", "sf1_fused")}
+json.dump(out, open("BENCH_local_r05b.json", "w"), indent=1)
+PY
+echo "$(date -Is) capture2 wrote BENCH_local_r05b.json" >> "$LOG"

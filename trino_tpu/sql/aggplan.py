@@ -400,7 +400,7 @@ class AggregationPlannerMixin:
             node = P.Filter(node, post.translate(q.having))
         out_exprs, out_names = [], []
         for i, it in enumerate(items):
-            out_exprs.append(post.translate(it.expr))
+            out_exprs.append(post.translate_output(it.expr))
             out_names.append(it.alias or _derive_name(it.expr, i))
         out_schema = Schema(tuple(Field(n, e.type) for n, e in zip(out_names, out_exprs)))
         cols = []
@@ -408,6 +408,8 @@ class AggregationPlannerMixin:
             d = None
             if isinstance(e, ir.FieldRef):
                 d = agg_cols[e.index].dict
+            else:
+                d = post.const_dicts.get(id(e))
             cols.append(ColumnInfo(None, n, e.type, d))
         node = P.Project(node, tuple(out_exprs), out_schema,
                          tuple(c.dict for c in cols))

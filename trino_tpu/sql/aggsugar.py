@@ -37,6 +37,27 @@ class _PostAggScope:
         self.agg_asts = agg_asts
         self.agg_cols = agg_cols
         self.planner = planner
+        # id(returned Constant) -> Dictionary for string literals in the
+        # output list (global-agg channel tags: select 'tot', count(*) ...)
+        self.const_dicts: dict = {}
+
+    def translate_output(self, ast) -> ir.Expr:
+        """A SELECT-list item: like translate(), plus top-level string
+        literals (channel tags) whose dictionary the caller recovers from
+        const_dicts by the returned Constant's id()."""
+        if isinstance(ast, A.StringLit):
+            from .analyzer import _string_const
+
+            e, d = _string_const(ast.value)
+            self.const_dicts[id(e)] = d
+            return e
+        return self.translate(ast)
+
+    def _dict_of(self, e):
+        """Dictionary of a translated channel ref, if any."""
+        if isinstance(e, ir.FieldRef) and e.index < len(self.agg_cols):
+            return self.agg_cols[e.index].dict
+        return None
 
     def translate(self, ast) -> ir.Expr:
         for i, g in enumerate(self.group_asts):
@@ -50,6 +71,22 @@ class _PostAggScope:
                 return ir.FieldRef(ch, c.type, c.name)
         # recurse structurally
         if isinstance(ast, A.BinaryOp):
+            if ast.op in ("eq", "neq") and (
+                    isinstance(ast.left, A.StringLit)
+                    ^ isinstance(ast.right, A.StringLit)):
+                # HAVING min(status) = 'shipped': resolve the literal against
+                # the channel's dictionary (ordering comparisons stay
+                # unsupported — id order is not collation order)
+                lit, other_ast = (ast.left, ast.right) \
+                    if isinstance(ast.left, A.StringLit) \
+                    else (ast.right, ast.left)
+                other = self.translate(other_ast)
+                d = self._dict_of(other)
+                if d is None:
+                    raise SemanticError(
+                        "string comparison needs a dictionary-backed channel")
+                c = ir.Constant(d.lookup(lit.value), other.type)
+                return ir.Call(ast.op, (other, c), BOOLEAN)
             l = self.translate(ast.left)
             r = self.translate(ast.right)
             if ast.op in ("and", "or"):
@@ -60,6 +97,14 @@ class _PostAggScope:
             return _arith(ast.op, l, r)
         if isinstance(ast, A.NumberLit):
             return _literal_number(ast.text)
+        if isinstance(ast, A.StringLit):
+            # nested string literals would need the enclosing expression to
+            # thread a dictionary; only top-level output tags
+            # (translate_output) and dictionary-resolved comparisons
+            # (_translate_cmp) support them
+            raise SemanticError(
+                f"string literal {ast.value!r} in post-aggregation "
+                "expression context")
         if isinstance(ast, A.UnaryOp) and ast.op == "negate":
             e = self.translate(ast.operand)
             return ir.Call("negate", (e,), e.type)

@@ -102,6 +102,51 @@ def _literal_number(text: str) -> ir.Constant:
     return ir.Constant(v, INTEGER if -(2**31) <= v < 2**31 else BIGINT)
 
 
+def _string_const(value: str):
+    """A string literal in value position: id 0 in a private one-entry
+    dictionary — the same representation cast-to-char literals and typeof()
+    use.  Callers MUST thread the returned Dictionary to the output column
+    (or into a dictionary union); discarding it mixes id spaces."""
+    from ..types import VARCHAR
+    from ..connectors.tpch import Dictionary
+
+    return ir.Constant(0, VARCHAR), Dictionary(
+        values=np.array([value], dtype=object))
+
+
+def _union_string_dicts(pairs, t):
+    """Branches of one string-valued expression (CASE arms, coalesce args)
+    with possibly different dictionaries -> (remapped exprs, union
+    Dictionary).  Constants fold at plan time; columns remap through a LUT;
+    NULL constants pass through.  Mirrors the set-operation dictionary merge
+    (frontend's coerced()): expression semantics are over VALUES, ids are
+    storage."""
+    from ..connectors.tpch import Dictionary
+
+    vals = []
+    for e, d in pairs:
+        if isinstance(e, ir.Constant) and e.value is None:
+            continue
+        if d is None or getattr(d, "values", None) is None:
+            raise SemanticError(
+                "string branches mixing dictionary-less expressions "
+                "not supported yet")
+        vals.append([str(v) for v in d.values])
+    uniq = sorted(set().union(*vals)) if vals else []
+    pos = {v: j for j, v in enumerate(uniq)}
+    out = []
+    for e, d in pairs:
+        if isinstance(e, ir.Constant) and e.value is None:
+            out.append(ir.Constant(None, t))
+            continue
+        lut = np.array([pos[str(v)] for v in d.values], np.int32)
+        if isinstance(e, ir.Constant):
+            out.append(ir.Constant(int(lut[e.value]), t))
+        else:
+            out.append(ir.Call("lut", (e, ir.Constant(lut, t)), t))
+    return out, Dictionary(values=np.array(uniq, dtype=object))
+
+
 def _coerce(e: ir.Expr, t: Type) -> ir.Expr:
     if e.type.name == t.name:
         return e
@@ -790,7 +835,11 @@ class ExpressionAnalyzer:
         if isinstance(ast, A.NumberLit):
             return _literal_number(ast.text), None
         if isinstance(ast, A.StringLit):
-            raise SemanticError(f"string literal {ast.value!r} outside comparison context")
+            # value position (SELECT-list channel tags, UNION branch labels):
+            # a one-entry dictionary with every lane at id 0; comparison
+            # contexts intercept string literals BEFORE this fallback and
+            # resolve them against the column dictionary instead
+            return _string_const(ast.value)
         if isinstance(ast, A.DateLit):
             return ir.Constant(parse_date_literal(ast.value), DATE), None
         if isinstance(ast, A.TimestampLit):
@@ -930,6 +979,14 @@ class ExpressionAnalyzer:
             return ir.Call(op, (l, r), BOOLEAN), None
         if op in ("eq", "neq", "lt", "lte", "gt", "gte"):
             # string-literal side gets dictionary resolution
+            if isinstance(ast.left, A.StringLit) and isinstance(ast.right, A.StringLit):
+                # literal-vs-literal folds at plan time (templated SQL);
+                # translating both sides would compare ids from two private
+                # dictionaries (always 0 == 0)
+                l, r = ast.left.value, ast.right.value
+                res = {"eq": l == r, "neq": l != r, "lt": l < r,
+                       "lte": l <= r, "gt": l > r, "gte": l >= r}[op]
+                return ir.Constant(bool(res), BOOLEAN), None
             if isinstance(ast.right, A.StringLit) and not isinstance(ast.left, A.StringLit):
                 l, ld = self._translate(ast.left, cols)
                 r = self._translate_vs(ast.right, l, ld, cols)
@@ -1022,20 +1079,35 @@ class ExpressionAnalyzer:
                 out = ir.Call("if", (c, as_const(val), out), t)
             return out, d
         whens = []
+        branch_dicts = []
         for cond, val in ast.whens:
             if ast.operand is not None:
                 cond = A.BinaryOp("eq", ast.operand, cond)
             c, _ = self._translate(cond, cols)
-            v, _ = self._translate(val, cols)
+            v, vd = self._translate(val, cols)
             whens.append((c, v))
-        default = None
+            branch_dicts.append(vd)
+        default = default_d = None
         if ast.default is not None:
-            default, _ = self._translate(ast.default, cols)
+            default, default_d = self._translate(ast.default, cols)
         t = whens[0][1].type
         for _, v in whens[1:]:
             t = common_super_type(t, v.type)
         if default is not None:
             t = common_super_type(t, default.type)
+        if t.is_string and (any(d is not None for d in branch_dicts)
+                            or default_d is not None):
+            # mixed literal/column string branches: merge the branch
+            # dictionaries into one id space and remap each branch
+            pairs = [(v, d) for (_, v), d in zip(whens, branch_dicts)]
+            if default is not None:
+                pairs.append((default, default_d))
+            exprs, md = _union_string_dicts(pairs, t)
+            out = exprs[-1] if default is not None else ir.Constant(None, t)
+            arm_exprs = exprs[:len(whens)] if default is not None else exprs
+            for (c, _), v in zip(reversed(whens), reversed(arm_exprs)):
+                out = ir.Call("if", (c, v, out), t)
+            return out, md
         out = _coerce(default, t) if default is not None else ir.Constant(None, t)
         for c, v in reversed(whens):
             out = ir.Call("if", (c, _coerce(v, t), out), t)

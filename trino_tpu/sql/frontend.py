@@ -37,7 +37,8 @@ from . import plan as P
 from .analyzer import (AGG_FUNCS, ColumnInfo, ExpressionAnalyzer, SemanticError,
                        _add_months_const, _arith, _coerce, _interval_days,
                        _interval_months, _interval_seconds, _literal_number,
-                       _resolve_column, _rewrite_ast, _type_from_name)
+                       _resolve_column, _rewrite_ast, _string_const,
+                       _type_from_name, _union_string_dicts)
 
 __all__ = ["compile_sql", "SemanticError"]
 

@@ -358,10 +358,19 @@ def _build_if(planner, ast, cols):
 def _build_variadic_super(planner, ast, cols):
     """coalesce / greatest / least: common-supertype folding over all args."""
     F = _rt()
-    args = _args(planner, ast, cols)
+    pairs = [planner._translate(a, cols) for a in ast.args]
+    args = [e for e, _ in pairs]
     t = args[0].type
     for a in args[1:]:
         t = F.common_super_type(t, a.type)
+    if t.is_string and any(d is not None for _, d in pairs):
+        if ast.name != "coalesce":
+            raise F.SemanticError(
+                f"{ast.name}() over dictionary strings not supported "
+                "(id order is not collation order)")
+        # coalesce over mixed literal/column strings: one union id space
+        exprs, md = F._union_string_dicts(pairs, t)
+        return ir.Call(ast.name, tuple(exprs), t), md
     return ir.Call(ast.name, tuple(F._coerce(a, t) for a in args), t), None
 
 
