@@ -264,6 +264,7 @@ def main():
     engine_times: dict = {}
     cpu_times: dict = {}
     row_counts: dict = {}
+    query_counters: dict = {}
     payload = {"metric": f"tpch_sf{SF:g}_bench_failed", "value": 0,
                "unit": "rows/s", "vs_baseline": 0}
 
@@ -326,6 +327,13 @@ def main():
                     engine.execute_sql(sql, session)
                     times.append(time.perf_counter() - t0)
                 med = sorted(times)[len(times) // 2]
+                # device-boundary counters of the LAST warm run: the
+                # dispatch/transfer budget this query actually spent
+                # (engine.last_query_counters — execution/tracing)
+                try:
+                    query_counters[name] = engine.last_query_counters.as_dict()
+                except Exception:
+                    pass
                 print(f"bench: {name} engine cold={cold_s:.2f}s warm={med:.3f}s "
                       f"({len(times)} runs, {remaining():.0f}s left)", file=sys.stderr)
 
@@ -381,6 +389,20 @@ def main():
                 "unit": "rows/s",
                 "vs_baseline": round(geomean, 3),
             }
+            # per-query breakdown: both sides timed in THIS process (the
+            # pandas baseline is recomputed alongside the engine run, never
+            # copied from an earlier capture) plus each query's warm
+            # device-boundary counters
+            payload["per_query"] = {
+                q: {"engine_warm_s": round(engine_times[q], 3),
+                    "cpu_warm_s": round(cpu_times[q], 3),
+                    **query_counters.get(q, {})} for q in done}
+        try:
+            from benchenv import env_info
+
+            payload["env"] = env_info()
+        except Exception:
+            pass
         print(json.dumps(payload), flush=True)
 
 

@@ -23,6 +23,8 @@ if _force_cpu:
 
 import jax
 
+from benchenv import env_info
+
 if _force_cpu:
     jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
@@ -105,8 +107,9 @@ def bench_exchange_route(n):
     from functools import partial
 
     from jax.sharding import NamedSharding, PartitionSpec as PS
-    from jax import shard_map
 
+    # version-shimmed import (top-level jax.shard_map only exists on jax>=0.6)
+    from trino_tpu.exec.distributed import shard_map
     from trino_tpu.ops.exchange import bucketize, exchange_all_to_all
     from trino_tpu.parallel.mesh import WORKER_AXIS, worker_mesh
 
@@ -217,6 +220,7 @@ def bench_exchange_stream_vs_spool(n):
         "spool_ms": round(t_spool * 1000, 3),
         "stream_ms": round(t_stream * 1000, 3),
         "stream_speedup": round(t_spool / t_stream, 2),
+        "env": env_info(),
     }), flush=True)
     return None
 
@@ -238,6 +242,7 @@ def main():
     ap.add_argument("--rows", type=int, default=4_000_000)
     ap.add_argument("--kernels", type=str, default=",".join(KERNELS))
     args = ap.parse_args()
+    env = env_info()
     for name in args.kernels.split(","):
         fn = KERNELS.get(name.strip())
         if fn is None:
@@ -245,14 +250,15 @@ def main():
         try:
             t = fn(args.rows)
         except Exception as e:  # one kernel must not kill the suite
-            print(json.dumps({"kernel": name, "error": f"{type(e).__name__}: {e}"}),
+            print(json.dumps({"kernel": name, "error": f"{type(e).__name__}: {e}",
+                              "env": env}),
                   flush=True)
             continue
         if t is None:
             continue
         print(json.dumps({
             "kernel": name, "rows": args.rows, "ms": round(t * 1000, 3),
-            "rows_per_sec": round(args.rows / t),
+            "rows_per_sec": round(args.rows / t), "env": env,
         }), flush=True)
 
 

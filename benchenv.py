@@ -1,0 +1,26 @@
+"""Shared provenance stamp for benchmark records (bench.py / bench_micro.py).
+
+Round-5 found a 4x unexplained pandas-baseline drift between captures that
+could not be attributed after the fact — host identity, core count, and
+library versions make captures comparable (and incomparable ones visible).
+Kept stdlib-only and jax-free so importing it never races the callers'
+jax platform/x64 configuration dance.
+"""
+
+import os
+
+
+def env_info() -> dict:
+    import platform
+    import socket
+
+    info = {"hostname": socket.gethostname(),
+            "cpu_count": os.cpu_count(),
+            "platform": platform.platform(),
+            "python": platform.python_version()}
+    for mod in ("numpy", "pandas", "jax"):
+        try:
+            info[mod] = __import__(mod).__version__
+        except Exception:
+            info[mod] = None
+    return info

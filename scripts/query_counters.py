@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Per-query device-boundary counter trace: the tool that derives (and
+re-derives) the budget numbers pinned in tests/test_query_budgets.py.
+
+Runs the TPC-H north-star queries (bench.py's QUERIES) through the engine
+twice — cold (plan + XLA compile) and warm (cached plan, compiled pipelines)
+— and prints one JSON line per query with the QueryCounters snapshot of each
+run: device_dispatches, host_transfers, host_bytes_pulled.
+
+The WARM numbers are the budget: a warm query's dispatch count is its tunnel
+round-trip bill and its pulled bytes are its transfer bill (CLAUDE.md round-5
+facts).  To re-derive the test ceilings after an executor change:
+
+    JAX_PLATFORMS=cpu python scripts/query_counters.py
+
+and copy the warm numbers (with the headroom noted in the test) into
+tests/test_query_budgets.py.  TRACE_SF / TRACE_QUERIES / TRACE_SPLIT_ROWS
+override the scale factor (default 1, matching the tests), query subset, and
+split size (default 1<<21, matching bench.py).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_force_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
+if _force_cpu:
+    os.environ.pop("JAX_PLATFORMS")
+
+import jax  # noqa: E402
+
+if _force_cpu:
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+
+def main():
+    from bench import QUERIES
+    from trino_tpu import Engine
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    sf = float(os.environ.get("TRACE_SF", "1"))
+    split_rows = int(os.environ.get("TRACE_SPLIT_ROWS", str(1 << 21)))
+    names = [q.strip() for q in
+             os.environ.get("TRACE_QUERIES", ",".join(QUERIES)).split(",")
+             if q.strip() in QUERIES]
+
+    engine = Engine()
+    engine.register_catalog("tpch", TpchConnector(sf=sf, split_rows=split_rows))
+    session = engine.create_session("tpch")
+
+    for name in names:
+        rec = {"query": name, "sf": sf, "split_rows": split_rows}
+        for phase in ("cold", "warm"):
+            t0 = time.perf_counter()
+            engine.execute_sql(QUERIES[name], session)
+            rec[phase] = {"wall_s": round(time.perf_counter() - t0, 3),
+                          **engine.last_query_counters.as_dict()}
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

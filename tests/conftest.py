@@ -48,6 +48,27 @@ def pytest_configure(config):
         "markers", "slow: multi-process integration tests (subprocess workers)")
 
 
+# Modules that dominate suite wall-clock on the 1-2 core build box: the
+# 8-device-mesh distributed/FTE/cluster integration families (minutes of real
+# SPMD work each since the jax-0.4.x shard_map shim made them run again) and
+# the SF1 budget module (~100s of XLA compiles).  Scheduled LAST, cheapest
+# first, so the driver's wall-clock-capped tier-1 run spends its budget on
+# broad coverage before the expensive integration tail.
+_HEAVY_TAIL = ("test_query_budgets", "test_fte", "test_cluster",
+               "test_distributed")
+
+
+def pytest_collection_modifyitems(config, items):
+    def tail_rank(item):
+        name = item.fspath.basename
+        for i, prefix in enumerate(_HEAVY_TAIL):
+            if name.startswith(prefix):
+                return i + 1
+        return 0
+
+    items.sort(key=tail_rank)  # stable: in-module order is untouched
+
+
 @pytest.fixture(scope="session")
 def tpch_sf001():
     from trino_tpu.connectors.tpch import TpchConnector

@@ -215,6 +215,46 @@ def test_aging_prevents_starvation():
     assert got == ["etl"], "aged waiter starved behind fresh queries"
 
 
+def test_acquire_wakes_on_notify_without_polling():
+    """A blocked acquire must wake by condition-variable NOTIFICATION, not by
+    a poll interval elapsing: the pre-fix code re-checked every 50ms, adding
+    up to a poll interval of latency per grant.  Waits may carry only the
+    coarse aging-boundary backstop (>= 10 quanta — liveness against
+    aging flipping the grant order with no notify), never a sub-second poll,
+    and the grant arrives as soon as release() notifies."""
+    s = FairScheduler(slots=1, quantum=10.0)
+    waits = []
+    orig_wait = s._cv.wait
+
+    def recording_wait(timeout=None):
+        waits.append(timeout)
+        return orig_wait(timeout)
+
+    s._cv.wait = recording_wait
+    tok1, tok2 = s.new_token("a"), s.new_token("b")
+    s.acquire("qa", tok1)
+    granted = []
+
+    def blocked():
+        s.acquire("qb", tok2)
+        granted.append(time.monotonic())
+        s.release(tok2)
+
+    th = threading.Thread(target=blocked)
+    th.start()
+    time.sleep(0.1)  # the waiter is parked inside a backstop-only cv.wait
+    assert not granted
+    released_at = time.monotonic()
+    s.release(tok1)
+    th.join(timeout=5)
+    assert granted, "blocked acquire never woke after release"
+    assert waits and all(t is None or t >= 10.0 * s.quantum for t in waits), (
+        f"acquire used short (polling) wait timeouts: {waits}")
+    # notification latency, not a 50ms poll boundary (generous bound for a
+    # loaded 1-core box; the wait-timeout assertion above is the real proof)
+    assert granted[0] - released_at < 1.0
+
+
 def test_sched_time_is_bounded():
     from trino_tpu.execution.fair_scheduler import MAX_TRACKED_QUERIES
 

@@ -141,3 +141,43 @@ def test_try_cast():
                          ).rows()[0][0] == 2
     # numeric-to-numeric try_cast reduces to plain coercion
     assert e.execute_sql("select try_cast(5 as double)", s).rows() == [(5.0,)]
+
+
+def test_nullif_string_literal_resolves_dictionary(engine):
+    """nullif over a string column and a literal compares VALUES, not raw
+    storage ids: the literal's private one-entry dictionary assigns it id 0,
+    so the pre-fix raw-id comparison NULLed whichever column value happened
+    to hold id 0 (functions._build_nullif now merges both sides into one
+    union id space)."""
+    r = engine.execute_sql(
+        "select n_name, nullif(n_name, 'FRANCE') v from nation order by n_name")
+    for name, v in r.rows():
+        assert v == (None if name == "FRANCE" else name), (name, v)
+    # reversed argument order: the LITERAL is the surviving value
+    r = engine.execute_sql(
+        "select n_name, nullif('FRANCE', n_name) v from nation order by n_name")
+    for name, v in r.rows():
+        assert v == (None if name == "FRANCE" else "FRANCE"), (name, v)
+
+
+def test_nullif_string_literal_absent_from_dictionary(engine):
+    """A literal that appears nowhere in the column never equals any value:
+    no row may come back NULL (the id-0 bug NULLed one arbitrary value)."""
+    r = engine.execute_sql(
+        "select n_name, nullif(n_name, 'banana') v from nation order by n_name")
+    assert len(r) == 25
+    for name, v in r.rows():
+        assert v == name, (name, v)
+
+
+def test_having_string_literal_over_formatter_dict_raises(engine):
+    """HAVING <string-agg> = 'lit' over a formatter (non-enumerable)
+    dictionary must fail with the analyzer's SemanticError, not a bare
+    KeyError from Dictionary.lookup (aggsugar._dict_of filters
+    values=None dictionaries)."""
+    from trino_tpu.sql.frontend import SemanticError
+
+    with pytest.raises(SemanticError):
+        engine.execute_sql(
+            "select c_nationkey, min(c_name) m from customer "
+            "group by c_nationkey having min(c_name) = 'nobody'")

@@ -249,3 +249,23 @@ def test_concurrent_queries_share_the_engine_safely(coordinator):
     for k in range(3):
         for j in range(len(queries)):
             assert results[k * len(queries) + j] == expected[j]
+
+
+def test_metrics_exposes_device_boundary_counters(coordinator):
+    """/v1/metrics exports the engine's lifetime device-boundary totals
+    (dispatches / host transfers / bytes pulled) alongside the query gauges."""
+    import urllib.request
+
+    from trino_tpu.server import Client
+
+    c = Client(coordinator.url, catalog="tpch")
+    c.execute("select count(*) from nation")
+    body = urllib.request.urlopen(
+        coordinator.url + "/v1/metrics").read().decode()
+    for metric in ("trino_tpu_device_dispatches_total",
+                   "trino_tpu_host_transfers_total",
+                   "trino_tpu_host_bytes_pulled_total"):
+        lines = [l for l in body.splitlines()
+                 if l.startswith(metric) and not l.startswith("# ")]
+        assert lines, f"{metric} missing from /v1/metrics"
+        assert float(lines[0].split()[-1]) > 0, lines

@@ -31,7 +31,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as PS
-from jax import shard_map
+try:  # jax >= 0.6 exports shard_map at the top level
+    from jax import shard_map
+except ImportError:  # older jax (this container's 0.4.x): experimental home
+    from jax.experimental.shard_map import shard_map
+
+    import inspect as _inspect
+
+    if "check_rep" in _inspect.signature(shard_map).parameters:
+        # 0.4.x's replication checker has no rule for lax.while_loop (the
+        # hash-table probe loops); the documented workaround is to disable
+        # the static check — out_specs below are all explicit anyway
+        shard_map = partial(shard_map, check_rep=False)
 
 from ..ops import hashagg
 from ..ops.exchange import bucketize, exchange_all_to_all, partition_ids

@@ -63,6 +63,15 @@ _ENC_FLAG = 0x80
 PAGE_CODEC = os.environ.get("TRINO_TPU_PAGE_CODEC", "zstd")
 if PAGE_CODEC not in _CODECS:  # pragma: no cover - config error
     raise ValueError(f"TRINO_TPU_PAGE_CODEC must be one of {sorted(_CODECS)}")
+if PAGE_CODEC == "zstd" and os.environ.get("TRINO_TPU_PAGE_CODEC") is None:
+    # the zstd DEFAULT degrades to zlib when the python binding is absent
+    # (stdlib-only container); an EXPLICIT zstd request still fails loudly at
+    # use — a configured codec silently changing would corrupt expectations
+    # about frames already on disk
+    try:
+        import zstandard  # noqa: F401
+    except ImportError:
+        PAGE_CODEC = "zlib"
 
 
 def _exchange_key():

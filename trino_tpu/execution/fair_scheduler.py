@@ -93,17 +93,31 @@ class FairScheduler:
 
     def acquire(self, query_key: str, token: str) -> None:
         """Block until this task holds a slot; grants go to the waiter whose
-        query sits at the lowest (aged) feedback level, FIFO within one."""
+        query sits at the lowest (aged) feedback level, FIFO within one.
+
+        Condition-variable notification, not a poll interval: every state
+        change that can grant a slot notifies (release(), tick()'s yield, and
+        the grant below — taking one of several free slots changes who is
+        best, so the NEXT waiter must re-evaluate), so a blocked acquire
+        wakes in notify latency, not at a 50ms poll boundary.  One wrinkle:
+        the grant ORDER also depends on wall-clock aging, which can flip
+        which waiter is "best" with no accompanying notify (two waiters each
+        conclude "not me" around an aging boundary and both sleep over a free
+        slot).  A coarse backstop wait at the aging-boundary granularity
+        (10 quanta — the rate at which _effective_level can change at all)
+        self-heals that stranding without reintroducing per-grant polling."""
+        backstop = max(10.0 * self.quantum, 0.5)
         with self._cv:
             self._seq += 1
             w = (query_key, self._seq, token, time.monotonic())
             self._waiters.append(w)
             while not (len(self._running) < self.slots
                        and self._best_waiter() is w):
-                self._cv.wait(0.05)
+                self._cv.wait(backstop)
             self._waiters.remove(w)
             now = time.monotonic()
             self._running[token] = (query_key, now, now)
+            self._cv.notify_all()  # remaining free slots go to the next-best
 
     def release(self, token: str) -> None:
         with self._cv:

@@ -16,7 +16,88 @@ import threading
 import time
 from typing import Optional
 
-__all__ = ["Span", "Tracer", "NOOP_TRACER"]
+__all__ = ["Span", "Tracer", "NOOP_TRACER", "QueryCounters", "track_counters",
+           "current_counters", "record_dispatch", "record_host_pull"]
+
+
+# -- per-query device-boundary counters ---------------------------------------
+#
+# Host<->device round-trips, not FLOPs, bound warm join queries on tunneled
+# TPUs (round 3-5 captures), and the wins that fixed it (device finalize,
+# device TopN) are one stray np.asarray away from silently reverting.  These
+# counters make the boundary a first-class, testable quantity: every jitted
+# dispatch and every batched device->host pull in the local executor records
+# here, the engine snapshots them per query, and tests/test_query_budgets.py
+# pins warm TPC-H ceilings (the moral analog of Trino's zero-per-page driver
+# pump, operator/Driver.java:372-481 — the scheduler cost budget is CODE, not
+# a trace note).
+
+
+@dataclasses.dataclass
+class QueryCounters:
+    """Cheap always-on counters at the two device-boundary chokepoints:
+    jitted-function invocations (``device_dispatches`` — each is one XLA
+    program launch, one tunnel round-trip on remote devices) and batched
+    device->host pulls (``host_transfers`` calls moving ``host_bytes_pulled``
+    bytes through ``_host``)."""
+
+    device_dispatches: int = 0
+    host_transfers: int = 0
+    host_bytes_pulled: int = 0
+
+    def reset(self) -> None:
+        self.device_dispatches = 0
+        self.host_transfers = 0
+        self.host_bytes_pulled = 0
+
+    def merge(self, other: "QueryCounters") -> None:
+        self.device_dispatches += other.device_dispatches
+        self.host_transfers += other.host_transfers
+        self.host_bytes_pulled += other.host_bytes_pulled
+
+    def snapshot(self) -> "QueryCounters":
+        return QueryCounters(self.device_dispatches, self.host_transfers,
+                             self.host_bytes_pulled)
+
+    def as_dict(self) -> dict:
+        return {"device_dispatches": self.device_dispatches,
+                "host_transfers": self.host_transfers,
+                "host_bytes_pulled": self.host_bytes_pulled}
+
+
+_counter_local = threading.local()
+
+
+def current_counters() -> Optional[QueryCounters]:
+    return getattr(_counter_local, "counters", None)
+
+
+@contextlib.contextmanager
+def track_counters(counters: QueryCounters):
+    """Make ``counters`` the recording target for this thread; on exit the
+    previous target (or None) is restored, so nested executions on one
+    thread each charge their own counters.  NOTE: plan-time eager subqueries
+    run during PLANNING, before the outer executor enters its context — they
+    charge the throwaway executor that runs them, not the outer query."""
+    prev = getattr(_counter_local, "counters", None)
+    _counter_local.counters = counters
+    try:
+        yield counters
+    finally:
+        _counter_local.counters = prev
+
+
+def record_dispatch(n: int = 1) -> None:
+    c = getattr(_counter_local, "counters", None)
+    if c is not None:
+        c.device_dispatches += n
+
+
+def record_host_pull(nbytes: int, transfers: int = 1) -> None:
+    c = getattr(_counter_local, "counters", None)
+    if c is not None:
+        c.host_transfers += transfers
+        c.host_bytes_pulled += nbytes
 
 
 @dataclasses.dataclass

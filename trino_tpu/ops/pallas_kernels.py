@@ -39,6 +39,11 @@ import jax.numpy as jnp
 
 __all__ = ["fused_segment_agg", "ONEHOT_BLOCK"]
 
+try:  # jax >= 0.5 exports the x64-scoping context manager at the top level
+    _enable_x64 = jax.enable_x64
+except AttributeError:  # older jax (this container's 0.4.x)
+    from jax.experimental import enable_x64 as _enable_x64
+
 ONEHOT_BLOCK = 2048
 
 
@@ -92,7 +97,7 @@ def fused_segment_agg(slot, valid, value_cols, n_slots: int, interpret: bool = F
             (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         sum_ref[...] += part
 
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         counts, sums = pl.pallas_call(
             kernel,
             grid=(slot.shape[0] // blk,),

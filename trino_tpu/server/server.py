@@ -447,6 +447,18 @@ class CoordinatorServer:
             total = sum(q.finished_at - q.created_at for q in done)
             lines += ["# TYPE trino_tpu_query_seconds_total counter",
                       f"trino_tpu_query_seconds_total {total:.3f}"]
+        # device-boundary totals (execution/tracing.QueryCounters): the
+        # dispatch/transfer budget spent across every local plan execution
+        ct = getattr(self.engine, "counters_total", None)
+        if ct is not None:
+            lines += [
+                "# TYPE trino_tpu_device_dispatches_total counter",
+                f"trino_tpu_device_dispatches_total {ct.device_dispatches}",
+                "# TYPE trino_tpu_host_transfers_total counter",
+                f"trino_tpu_host_transfers_total {ct.host_transfers}",
+                "# TYPE trino_tpu_host_bytes_pulled_total counter",
+                f"trino_tpu_host_bytes_pulled_total {ct.host_bytes_pulled}",
+            ]
         return "\n".join(lines) + "\n"
 
     def _query_row_count(self, q):

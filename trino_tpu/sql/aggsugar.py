@@ -54,9 +54,14 @@ class _PostAggScope:
         return self.translate(ast)
 
     def _dict_of(self, e):
-        """Dictionary of a translated channel ref, if any."""
+        """ENUMERABLE dictionary of a translated channel ref, if any.  A
+        formatter/pattern dictionary (values=None) cannot resolve a literal
+        — returning it would turn the caller's SemanticError into a bare
+        KeyError from Dictionary.lookup."""
         if isinstance(e, ir.FieldRef) and e.index < len(self.agg_cols):
-            return self.agg_cols[e.index].dict
+            d = self.agg_cols[e.index].dict
+            if d is not None and getattr(d, "values", None) is not None:
+                return d
         return None
 
     def translate(self, ast) -> ir.Expr:

@@ -37,8 +37,10 @@ from . import plan as P
 from .analyzer import (AGG_FUNCS, ColumnInfo, ExpressionAnalyzer, SemanticError,
                        _add_months_const, _arith, _coerce, _interval_days,
                        _interval_months, _interval_seconds, _literal_number,
-                       _resolve_column, _rewrite_ast, _string_const,
-                       _type_from_name, _union_string_dicts)
+                       _resolve_column, _rewrite_ast, _type_from_name,
+                       _union_string_dicts)  # noqa: F401 (_union_string_dicts
+# is re-exported: registry builders reach it as F._union_string_dicts via
+# functions._rt())
 
 __all__ = ["compile_sql", "SemanticError"]
 

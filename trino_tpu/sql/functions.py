@@ -344,8 +344,16 @@ def _build_width_bucket(planner, ast, cols):
 def _build_nullif(planner, ast, cols):
     F = _rt()
     a, ad = planner._translate(ast.args[0], cols)
-    b, _ = planner._translate(ast.args[1], cols)
+    b, bd = planner._translate(ast.args[1], cols)
     t = F.common_super_type(a.type, b.type)
+    if t.is_string and ad is not bd:
+        # string sides carry DIFFERENT dictionaries (a literal's private
+        # one-entry dict vs the column's, or two columns): raw storage ids
+        # are not comparable across id spaces — nullif(s, 'banana') would
+        # NULL whichever value happens to hold id 0.  Remap both sides into
+        # one union id space and compare there (the coalesce/CASE-arm merge).
+        exprs, md = F._union_string_dicts([(a, ad), (b, bd)], t)
+        return ir.Call("nullif", tuple(exprs), t), md
     return ir.Call("nullif", (F._coerce(a, t), F._coerce(b, t)), t), ad
 
 

@@ -12,11 +12,19 @@ from . import plan as P
 __all__ = ["format_plan"]
 
 
-def format_plan(node: P.PlanNode, stats: dict = None) -> str:
+def format_plan(node: P.PlanNode, stats: dict = None, counters=None) -> str:
     """``stats``: optional id(node) -> {rows, wall_s} from an EXPLAIN ANALYZE run
-    (reference: PlanPrinter's textDistributedPlan with OperatorStats)."""
+    (reference: PlanPrinter's textDistributedPlan with OperatorStats).
+    ``counters``: optional per-query device-boundary counters
+    (execution/tracing.QueryCounters) appended as a summary line — the
+    dispatch/transfer budget the query actually spent."""
     lines: list = []
     _fmt(node, lines, 0, stats or {})
+    if counters is not None:
+        lines.append(
+            f"Device boundary: {counters.device_dispatches} dispatches, "
+            f"{counters.host_transfers} host transfers, "
+            f"{counters.host_bytes_pulled} bytes pulled")
     return "\n".join(lines)
 
 
