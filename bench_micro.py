@@ -225,6 +225,42 @@ def bench_exchange_stream_vs_spool(n):
     return None
 
 
+def bench_dispatch_coalesce(nrows):
+    """Dispatch-coalescing overhead curve: a fixed-size grouped aggregation
+    over 16 uniform splits, executed at batch K in {1,2,4,8,16} — the
+    per-dispatch overhead is (warm wall at K=1 - warm wall at K=16)/Δdispatch.
+    On the CPU mesh the deltas are python+dispatch overhead (~ms); on a
+    tunneled TPU each saved dispatch is a full round-trip, which is the curve
+    this benchmark exists to capture on the next tunnel window."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    n_splits = 16
+    sf = max(nrows / 1_500_000, 16 / 1_500_000)  # orders rows = 1.5M * sf
+    engine = Engine()
+    engine.register_catalog(
+        "tpch", TpchConnector(sf=sf, split_rows=max(nrows // n_splits, 1)))
+    sql = ("select o_orderstatus, count(*) c, sum(o_totalprice) s "
+           "from orders group by o_orderstatus order by o_orderstatus")
+    curve = []
+    for k in (1, 2, 4, 8, 16):
+        s = engine.create_session("tpch")
+        engine.session_properties.set_property(s, "dispatch_batch", k)
+        engine.execute_sql(sql, s)  # cold: plan + XLA compile
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            engine.execute_sql(sql, s)
+            ts.append(time.perf_counter() - t0)
+        c = engine.last_query_counters
+        curve.append({"batch": k, "warm_ms": round(sorted(ts)[1] * 1000, 3),
+                      **c.as_dict()})
+    print(json.dumps({"kernel": "dispatch_coalesce", "rows": nrows,
+                      "splits": n_splits, "curve": curve, "env": env_info()}),
+          flush=True)
+    return None
+
+
 KERNELS = {
     "hashagg_insert": bench_hashagg_insert,
     "join_build": bench_join_build,
@@ -234,6 +270,7 @@ KERNELS = {
     "window_scan": bench_window_scan,
     "compact": bench_compact,
     "exchange_stream_vs_spool": bench_exchange_stream_vs_spool,
+    "dispatch_coalesce": bench_dispatch_coalesce,
 }
 
 

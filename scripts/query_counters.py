@@ -38,9 +38,18 @@ jax.config.update("jax_enable_x64", True)
 
 
 def main():
+    import argparse
+
     from bench import QUERIES
     from trino_tpu import Engine
     from trino_tpu.connectors.tpch import TpchConnector
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=None, metavar="N",
+                    help="also trace with dispatch_batch=N and print batch=1 "
+                         "vs batch=N side by side (coalescing A/B; default: "
+                         "trace only the session default)")
+    args = ap.parse_args()
 
     sf = float(os.environ.get("TRACE_SF", "1"))
     split_rows = int(os.environ.get("TRACE_SPLIT_ROWS", str(1 << 21)))
@@ -50,16 +59,42 @@ def main():
 
     engine = Engine()
     engine.register_catalog("tpch", TpchConnector(sf=sf, split_rows=split_rows))
-    session = engine.create_session("tpch")
 
-    for name in names:
-        rec = {"query": name, "sf": sf, "split_rows": split_rows}
+    def trace(session, name):
+        out = {}
         for phase in ("cold", "warm"):
             t0 = time.perf_counter()
             engine.execute_sql(QUERIES[name], session)
-            rec[phase] = {"wall_s": round(time.perf_counter() - t0, 3),
+            out[phase] = {"wall_s": round(time.perf_counter() - t0, 3),
                           **engine.last_query_counters.as_dict()}
-        print(json.dumps(rec), flush=True)
+        return out
+
+    if args.batch is None:
+        session = engine.create_session("tpch")
+        for name in names:
+            print(json.dumps({"query": name, "sf": sf,
+                              "split_rows": split_rows, **trace(session, name)}),
+                  flush=True)
+        return
+
+    # side-by-side: batch=1 (exact per-split) vs --batch N.  Separate sessions:
+    # dispatch_batch is plan-shaping, so each mode keys (and compiles) its own
+    # plan; the warm dispatch delta is the coalescing win the budget test pins.
+    s1 = engine.create_session("tpch")
+    engine.session_properties.set_property(s1, "dispatch_batch", 1)
+    sn = engine.create_session("tpch")
+    engine.session_properties.set_property(sn, "dispatch_batch", args.batch)
+    for name in names:
+        r1 = trace(s1, name)
+        rn = trace(sn, name)
+        print(json.dumps({"query": name, "sf": sf, "split_rows": split_rows,
+                          "batch1": r1, f"batch{args.batch}": rn}), flush=True)
+        w1, wn = r1["warm"], rn["warm"]
+        print(f"# {name}: warm dispatches {w1['device_dispatches']} -> "
+              f"{wn['device_dispatches']} "
+              f"({wn['coalesced_splits']} splits coalesced), "
+              f"bytes {w1['host_bytes_pulled']} -> {wn['host_bytes_pulled']}",
+              flush=True)
 
 
 if __name__ == "__main__":

@@ -1,12 +1,15 @@
 #!/bin/bash
-# Probe the axon TPU tunnel; the moment it answers, capture the round-5
-# A/B bench matrix (SF1/SF10 x scan-fused on/off) into BENCH_local_r05.json,
-# then drive the real chip through the cluster plane once
-# (scripts/tpu_cluster_probe.py).  Exits 0 after capture, 1 if the tunnel
-# never recovered within the probe window (250 probes, ~150-190s each:
-# ~11h when probes fail fast, up to ~21h if every probe eats its timeout).
-# Single-instance: flock on scripts/tpu_watch.lock — a second watcher
-# touching the device can wedge the tunnel (CLAUDE.md).
+# Probe the axon TPU tunnel; the moment it answers, capture the round-6
+# matrix into BENCH_local_r06.json: tunnel diagnosis, the dispatch-coalescing
+# microbench curve (batch K in {1,2,4,8,16} — the per-dispatch overhead this
+# round's whole design bets on), then SF1/SF10 bench A/B at dispatch batch
+# 4 vs 1 (scan-fused stays OFF everywhere: the r05 capture proved on-device
+# regeneration loses on the tunnel; coalescing batches HOST-generated pages
+# instead).  Capture order is priority order — the tunnel historically wedges
+# within ~30 min of first contact, so the cheap, decision-driving runs go
+# first.  Exits 0 after capture, 1 if the tunnel never recovered within the
+# probe window.  Single-instance: flock on scripts/tpu_watch.lock — a second
+# watcher touching the device can wedge the tunnel (CLAUDE.md).
 cd /root/repo
 LOG=scripts/tpu_watch.log
 exec 9> scripts/tpu_watch.lock
@@ -14,21 +17,29 @@ if ! flock -n 9; then
   echo "$(date -Is) another watcher holds the lock; exiting" >> "$LOG"
   exit 2
 fi
-echo "$(date -Is) watcher start (r05)" >> "$LOG"
+echo "$(date -Is) watcher start (r06)" >> "$LOG"
 for i in $(seq 1 250); do
   if timeout 150 python -c "import jax; d=jax.devices()[0]; assert d.platform != 'cpu', d" >> "$LOG" 2>&1; then
-    echo "$(date -Is) TPU UP on probe $i — starting r05 A/B capture" >> "$LOG"
+    echo "$(date -Is) TPU UP on probe $i — starting r06 capture" >> "$LOG"
     # tunnel diagnosis FIRST (fast): per-dispatch overhead + traced Q3/Q18
     # sync sites — the data that decides the round-trip-reduction work
     timeout -k 60 1500 python scripts/tpu_diag.py \
       > scripts/tpu_diag.out 2>&1
     echo "$(date -Is) tpu_diag rc=$? : $(tail -c 300 scripts/tpu_diag.json 2>/dev/null)" >> "$LOG"
-    for cfg in "sf1_fused:1:1:900:1200" "sf1_unfused:1:0:900:1200" \
-               "sf10_fused:10:1:1500:1800" "sf10_unfused:10:0:1500:1800"; do
-      IFS=: read -r name sf fused budget tmo <<< "$cfg"
+    # dispatch-coalescing overhead curve (NEW in r06): fixed rows, batch K
+    # sweep — on the tunnel each saved dispatch is a full round-trip, so this
+    # is the direct measurement of the win the budget tests pin on CPU
+    timeout -k 60 1200 python bench_micro.py --rows 4000000 \
+      --kernels dispatch_coalesce \
+      > scripts/bench_micro_coalesce.json 2> scripts/bench_micro_coalesce.log
+    echo "$(date -Is) micro coalesce rc=$? : $(tail -c 300 scripts/bench_micro_coalesce.json)" >> "$LOG"
+    for cfg in "sf1_batch4:1:4:900:1200" "sf1_batch1:1:1:900:1200" \
+               "sf10_batch4:10:4:1500:1800" "sf10_batch1:10:1:1500:1800"; do
+      IFS=: read -r name sf batch budget tmo <<< "$cfg"
       # -k: a wedged axon call absorbs SIGTERM indefinitely (bench.py notes);
       # SIGKILL after 60s keeps the watcher itself from hanging.
-      BENCH_BUDGET=$budget BENCH_SF=$sf TRINO_TPU_SCAN_FUSED=$fused \
+      BENCH_BUDGET=$budget BENCH_SF=$sf TRINO_TPU_SCAN_FUSED=0 \
+        TRINO_TPU_DISPATCH_BATCH=$batch \
         timeout -k 60 "$tmo" python bench.py \
         > "scripts/bench_${name}.json" 2> "scripts/bench_${name}.log"
       rc=$?
@@ -48,7 +59,12 @@ try:
         capture_output=True, text=True, timeout=180).stdout.strip()
 except Exception as e:
     out["device"] = f"probe-error: {e}"
-for name in ("sf1_fused", "sf1_unfused", "sf10_fused", "sf10_unfused"):
+try:
+    out["dispatch_coalesce_curve"] = json.load(
+        open("scripts/bench_micro_coalesce.json"))
+except Exception as e:
+    out["dispatch_coalesce_curve"] = {"error": str(e)}
+for name in ("sf1_batch4", "sf1_batch1", "sf10_batch4", "sf10_batch1"):
     try:
         out[name] = json.load(open(f"scripts/bench_{name}.json"))
     except Exception as e:
@@ -57,9 +73,9 @@ try:
     out["cluster_tpu_probe"] = json.load(open("scripts/tpu_cluster_probe.json"))
 except Exception as e:
     out["cluster_tpu_probe"] = {"error": str(e)}
-json.dump(out, open("BENCH_local_r05.json", "w"), indent=1)
+json.dump(out, open("BENCH_local_r06.json", "w"), indent=1)
 PY
-    echo "$(date -Is) wrote BENCH_local_r05.json" >> "$LOG"
+    echo "$(date -Is) wrote BENCH_local_r06.json" >> "$LOG"
     exit 0
   fi
   echo "$(date -Is) probe $i: tunnel down" >> "$LOG"

@@ -1,0 +1,120 @@
+"""Static device-boundary lint over ``trino_tpu/exec/*.py``.
+
+CLAUDE.md's rule — executor code MUST go through ``_jit`` (not bare
+``jax.jit``) and ``_host`` (never a loose ``np.asarray`` of device values) or
+the dispatch/transfer is invisible to the per-query budget counters — was a
+doc note until round 6.  This test makes it an enforced invariant:
+
+- ``jax.jit(`` may appear only inside the ``_jit`` helper itself (the one
+  place the accounting wrapper is built).
+- ``np.asarray(`` may appear only
+  (a) inside a small set of allowlisted HOST-SIDE helpers (below, each with
+      the reason it is exempt), or
+  (b) on a line annotated ``# host-ok[: reason]`` asserting the value is
+      already host-resident (python lists, dictionary values, arrays
+      previously pulled through ``_host``/``jax.device_get``).
+
+A new un-annotated np.asarray is treated as an unaccounted device pull until
+proven otherwise — the failure mode this PR's sweep fixed dozens of times
+over (per-column pulls in exchange/serialize/merge paths that never showed on
+the budget).  If your np.asarray really is host-side, say so with the marker;
+if it isn't, batch it through ``_host``.
+"""
+
+import ast
+import pathlib
+
+import pytest
+
+EXEC_DIR = pathlib.Path(__file__).resolve().parent.parent / "trino_tpu" / "exec"
+
+# functions whose BODY may use np.asarray freely, with why:
+ASARRAY_ALLOWED_FUNCS = {
+    "_host",              # the accounting chokepoint itself
+    "_host_page",         # batched page pull built on _host
+    "_page_to_device",    # host->device direction (no pull)
+    "_finalize_aggs",     # host finalize over accumulators its callers pulled
+    "_combine_limbs_vec",  # host two-limb recombine (input already pulled)
+}
+
+MARKER = "# host-ok"
+
+
+def _exec_files():
+    files = sorted(EXEC_DIR.glob("*.py"))
+    assert files, EXEC_DIR
+    return files
+
+
+class _Scan(ast.NodeVisitor):
+    def __init__(self, lines):
+        self.lines = lines
+        self.func_stack = []
+        self.jit_hits = []      # (lineno, enclosing function)
+        self.asarray_hits = []  # (lineno, enclosing function)
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            where = self.func_stack[-1] if self.func_stack else "<module>"
+            if f.value.id == "jax" and f.attr == "jit":
+                if "_jit" not in self.func_stack:
+                    self.jit_hits.append((node.lineno, where))
+            if f.value.id == "np" and f.attr == "asarray":
+                if not (set(self.func_stack) & ASARRAY_ALLOWED_FUNCS) \
+                        and MARKER not in self.lines[node.lineno - 1]:
+                    self.asarray_hits.append((node.lineno, where))
+        self.generic_visit(node)
+
+
+def _scan(path):
+    src = path.read_text()
+    s = _Scan(src.splitlines())
+    s.visit(ast.parse(src))
+    return s
+
+
+@pytest.mark.parametrize("path", _exec_files(), ids=lambda p: p.name)
+def test_no_bare_jax_jit(path):
+    s = _scan(path)
+    assert not s.jit_hits, (
+        f"{path.name}: bare jax.jit at "
+        + ", ".join(f"line {ln} (in {fn})" for ln, fn in s.jit_hits)
+        + " — use exec.local_executor._jit so the dispatch is counted "
+          "against the query budget")
+
+
+@pytest.mark.parametrize("path", _exec_files(), ids=lambda p: p.name)
+def test_no_loose_np_asarray(path):
+    s = _scan(path)
+    assert not s.asarray_hits, (
+        f"{path.name}: loose np.asarray at "
+        + ", ".join(f"line {ln} (in {fn})" for ln, fn in s.asarray_hits)
+        + " — a device value must pull through _host (batched, counted); "
+          "a host value needs a '# host-ok: <reason>' annotation")
+
+
+def test_lint_catches_violations(tmp_path):
+    """The lint must actually flag what it claims to (guards against the
+    visitor silently matching nothing after a refactor)."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax, numpy as np\n"
+        "def f(x):\n"
+        "    g = jax.jit(lambda a: a)\n"
+        "    return np.asarray(x)\n"
+        "def _jit(fn):\n"
+        "    return jax.jit(fn)\n"
+        "def _host(arrays):\n"
+        "    return [np.asarray(a) for a in arrays]\n"
+        "ok = np.asarray([1, 2])  # host-ok: literal\n")
+    s = _scan(bad)
+    assert [ln for ln, _ in s.jit_hits] == [3]
+    assert [ln for ln, _ in s.asarray_hits] == [4]

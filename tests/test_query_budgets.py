@@ -11,21 +11,26 @@ scheduler cost of Trino's driver pump, operator/Driver.java:372-481, enforced
 instead of assumed).
 
 Ceilings were derived with scripts/query_counters.py on the 8-device CPU mesh
-(SF1, split_rows=1<<21, 2026-08-03) and carry ~15-20% headroom over the
-measured warm trace:
+(SF1, split_rows=1<<21, 2026-08-03, `--batch 4` A/B) and carry ~20-25%
+headroom over the measured warm trace at the DEFAULT dispatch batch (4):
 
-    measured warm:  q1 10/277B   q3 22/278B   q9 29/3069B   q18 20/2851B
-    pre-PR warm:    q1 10/332B   q3 22/318B   q9 29/4228B   q18 20/3271B
+    measured warm (batch=4): q1  6/285B   q3 10/262B   q9 10/3057B   q18 10/2835B
+    measured warm (batch=1): q1 10/285B   q3 22/278B   q9 29/3077B   q18 20/2851B
+    pre-coalescing PR trace: q1 10/277B   q3 22/278B   q9 29/3069B   q18 20/2851B
 
-q9's byte ceiling (3600) sits BELOW its pre-PR trace (4228): the device full
-sort + dictionary-id narrowing + bit-packed masks of this PR are load-bearing,
-and reverting any of them fails this suite.  A reintroduced bulk pull (the
-device-finalize or device-TopN regressions) overshoots by KBs; a per-split
-sync loop overshoots the dispatch ceiling.  Counters are NOT env-dependent:
-split geometry is pinned by sf/split_rows and page shapes are pow2-quantized.
+The dispatch ceilings now sit BELOW the batch=1 trace: dispatch coalescing
+(exec/local_executor._coalesced_batches stacking shape-uniform split pages
+into one jit dispatch) is load-bearing, and silently losing it — a consumer
+loop bypassing _coalesced_batches, a stream shape change that breaks the
+uniformity signature — fails this suite just like a reintroduced per-split
+sync would.  Byte ceilings are UNCHANGED from the pre-coalescing PR (the
+round-5 device-sort/narrowing/bit-packing protections).  A reintroduced bulk
+pull (the device-finalize or device-TopN regressions) overshoots by KBs.
+Counters are NOT env-dependent: split geometry is pinned by sf/split_rows and
+page shapes are pow2-quantized.
 
 Re-derive after an intentional executor change:
-    JAX_PLATFORMS=cpu python scripts/query_counters.py
+    JAX_PLATFORMS=cpu python scripts/query_counters.py --batch 4
 """
 
 import pytest
@@ -73,12 +78,15 @@ QUERIES = {
     order by o_totalprice desc, o_orderdate limit 100""",
 }
 
-# (max device dispatches, max host bytes pulled) per WARM query
+# (max device dispatches, max host bytes pulled) per WARM query at the
+# default dispatch batch.  Dispatch ceilings enforce the >=40% coalescing win
+# over the PR-1 trace (22/29/20 for q3/q9/q18): q3 <= 12 (was 22), q9 <= 15
+# (was 29), q18 <= 12 (was 20).
 BUDGETS = {
-    "q1": (12, 400),
-    "q3": (26, 450),
-    "q9": (34, 3600),   # pre-PR trace: 4228 bytes — must stay below it
-    "q18": (24, 3400),
+    "q1": (8, 400),
+    "q3": (12, 450),
+    "q9": (15, 3600),   # pre-round-6 trace: 4228 bytes — must stay below it
+    "q18": (12, 3400),
 }
 
 

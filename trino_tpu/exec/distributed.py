@@ -53,6 +53,7 @@ from ..parallel.mesh import WORKER_AXIS, worker_mesh
 from ..sql import plan as P
 from ..sql.ir import evaluate, evaluate_predicate
 from .local_executor import (DEFAULT_GROUP_CAPACITY, MAX_GROUP_CAPACITY, LocalExecutor,
+                             _host, _host_page, _jit,
                              MaterializedResult, _acc_input_expr,
                              _accumulators_for, _build_null_stats,
                              _compact_part, _finalize_aggs, _gather_build, _limit_page,
@@ -147,17 +148,18 @@ def _pad_page(page: Page, cap: int) -> Page:
 def _has_duplicate_keys(build_page: Page, key_channels, key_types) -> bool:
     """Host-side duplicate-key check on the materialized build page (cheaper than
     building a throwaway device hash table just to read its dup counter)."""
-    valid = np.asarray(build_page.valid_mask())
-    for ch in key_channels:
-        nm = build_page.null_masks[ch]
-        if nm is not None:
-            valid = valid & ~np.asarray(nm)
+    nms = [build_page.null_masks[ch] for ch in key_channels
+           if build_page.null_masks[ch] is not None]
+    got = _host([build_page.valid_mask()] + nms)  # one batched pull
+    valid = got[0]
+    for nm in got[1:]:
+        valid = valid & ~nm
     n = int(valid.sum())
     if n == 0:
         return False
     keys = tuple(build_page.columns[ch] for ch in key_channels)
     packed, exact = pack_keys(keys, key_types)
-    vals = np.asarray(packed)[valid]
+    vals = _host([packed])[0][valid]
     # for inexact (fingerprint) packing a hash collision reads as a duplicate, which
     # is the conservative direction: the caller falls back to the general path
     return len(np.unique(vals)) < n
@@ -289,20 +291,42 @@ class _HostFedBatches:
         pages = [self.conn.generate(s, list(self.columns)) for s in group]
         rows = [p.capacity for p in pages]
         cap = max(1 << max(max(rows, default=1) - 1, 1).bit_length(), 1024)
+        # ONE batched pull for the whole W-split group (was 2-3 loose pulls
+        # per column, then one _host per page): on tunneled links each _host
+        # call is a round-trip, so the group's W pages share one
+        layout, flat = [], []
+        for p in pages:
+            nm_idx = [i for i, m in enumerate(p.null_masks) if m is not None]
+            flat += list(p.columns) + [p.null_masks[i] for i in nm_idx]
+            if p.valid is not None:
+                flat.append(p.valid)
+            layout.append((len(p.columns), nm_idx, p.valid is not None))
+        got = _host(flat)
+        hpages, pos = [], 0
+        for (ncols, nm_idx, has_valid), p in zip(layout, pages):
+            pcols = got[pos:pos + ncols]
+            pos += ncols
+            pnulls = [None] * ncols
+            for i in nm_idx:
+                pnulls[i] = got[pos]
+                pos += 1
+            pv = got[pos] if has_valid else np.ones((p.capacity,), bool)
+            pos += 1 if has_valid else 0
+            hpages.append((pv, pcols, pnulls))
         cols, nulls = [], []
         for ci, dt in enumerate(self.dtypes):
             arr = np.zeros((W, cap), dt)
             nm = np.zeros((W, cap), bool)
-            for w, p in enumerate(pages):
-                arr[w, :rows[w]] = np.asarray(p.columns[ci], dtype=dt)
-                m = p.null_masks[ci]
+            for w, (_, pcols, pnulls) in enumerate(hpages):
+                arr[w, :rows[w]] = pcols[ci].astype(dt, copy=False)
+                m = pnulls[ci]
                 if m is not None:
-                    nm[w, :rows[w]] = np.asarray(m)
+                    nm[w, :rows[w]] = m
             cols.append(arr)
             nulls.append(nm)
         valid = np.zeros((W, cap), bool)
-        for w, p in enumerate(pages):
-            valid[w, :rows[w]] = np.asarray(p.valid_mask())
+        for w, (pv, _, _) in enumerate(hpages):
+            valid[w, :rows[w]] = pv
         return (tuple(cols), tuple(nulls), valid)
 
 
@@ -314,7 +338,7 @@ def _collation_luts(sort_keys, fields, dicts):
     for sk in sort_keys:
         d = dicts[sk.channel]
         if d is not None and fields[sk.channel].type.is_string:
-            vals = np.asarray(d.values).astype(str)
+            vals = np.asarray(d.values).astype(str)  # host-ok: dict values
             rank = np.empty(len(vals), np.int64)
             rank[np.argsort(vals)] = np.arange(len(vals))
             luts[sk.channel] = jnp.asarray(rank)
@@ -375,11 +399,10 @@ def _page_from_shards(schema, cols_g, nulls_g, counts):
     contributes its counts[w] head rows, workers concatenated in mesh order."""
     W = len(counts)
     out_cols, out_nulls = [], []
-    for a in cols_g:
-        a_np = np.asarray(a)
+    got = _host(list(cols_g) + list(nulls_g))  # one batched shard pull
+    for a_np in got[:len(cols_g)]:
         out_cols.append(np.concatenate([a_np[w][:counts[w]] for w in range(W)]))
-    for m in nulls_g:
-        m_np = np.asarray(m)
+    for m_np in got[len(cols_g):]:
         out_nulls.append(np.concatenate([m_np[w][:counts[w]] for w in range(W)]))
     return Page(schema,
                 tuple(jnp.asarray(c) for c in out_cols),
@@ -410,11 +433,18 @@ class DistributedExecutor:
     """Executes plans SPMD across the mesh; falls back to LocalExecutor for blocking
     sub-plans (join build sides, small inputs)."""
 
-    def __init__(self, catalogs: dict, mesh=None, partition_threshold: int = 1 << 17):
+    def __init__(self, catalogs: dict, mesh=None, partition_threshold: int = 1 << 17,
+                 dispatch_batch=None):
         self.catalogs = catalogs
         self.mesh = mesh if mesh is not None else worker_mesh()
         self.n_workers = self.mesh.devices.size
         self.local = LocalExecutor(catalogs)
+        # session dispatch-coalescing width threads into the fallback local
+        # executor: blocking sub-plans (join builds, small fragments) coalesce
+        # their per-split dispatches exactly like a purely local query.  The
+        # SPMD paths are already whole-mesh batched (one dispatch per batch of
+        # W splits), so only the local side needs the knob.
+        self.local.dispatch_batch = dispatch_batch
         # build sides at/above this row count join PARTITIONED (all-to-all probe
         # exchange) instead of broadcast (reference: DetermineJoinDistributionType's
         # size-based choice, iterative/rule/DetermineJoinDistributionType.java:51)
@@ -523,10 +553,10 @@ class DistributedExecutor:
             self._trace(node, "coordinator", "gather of distributed branches")
             cols_list, nulls_list = [], []
             for pg, _ in parts:
-                v = np.asarray(pg.valid_mask())
-                cols_list.append([np.asarray(c)[v] for c in pg.columns])
-                nulls_list.append([None if m is None else np.asarray(m)[v]
-                                   for m in pg.null_masks])
+                v, pcols, pnulls = _host_page(pg)  # one batched pull per branch
+                cols_list.append([c[v] for c in pcols])
+                nulls_list.append([None if m is None else m[v]
+                                   for m in pnulls])
             ncols = len(node.schema.fields)
             out_cols = tuple(np.concatenate([p[i] for p in cols_list])
                              for i in range(ncols))
@@ -608,8 +638,8 @@ class DistributedExecutor:
             step = splits[0].hi - splits[0].lo
             n_batches = len(splits) // self.n_workers
             lo_batches = [
-                np.asarray([splits[b * self.n_workers + d].lo for d in range(self.n_workers)],
-                           dtype=np.int64)
+                np.asarray([splits[b * self.n_workers + d].lo  # host-ok: split list
+                            for d in range(self.n_workers)], dtype=np.int64)
                 for b in range(n_batches)
             ]
 
@@ -677,7 +707,8 @@ class DistributedExecutor:
             # distribution: the planner's stats-driven hint (CBO,
             # DetermineJoinDistributionType) decides when present; AUTOMATIC
             # plans ('replicated' hint) fall back to the actual build size
-            n_build = int(np.asarray(build_page.valid_mask()).sum())
+            n_build = int(_host([jnp.sum(build_page.valid_mask(),
+                                         dtype=jnp.int64)])[0])
             hint = getattr(node, "distribution", "replicated")
             partitioned = (hint == "partitioned"
                            or (hint != "broadcast"
@@ -883,7 +914,7 @@ class DistributedExecutor:
         cap_r = max(1 << max(2 * chunk - 1, 1).bit_length(), 32)
         while True:
             fn = partial(build_exchange, cap_r=cap_r)
-            table_g = jax.jit(
+            table_g = _jit(
                 shard_map(
                     lambda bc, bn, bv: jax.tree.map(
                         lambda x: None if x is None else x[None],
@@ -892,7 +923,7 @@ class DistributedExecutor:
                         is_leaf=lambda x: x is None),
                     mesh=mesh, in_specs=(PS(WORKER_AXIS),) * 3,
                     out_specs=PS(WORKER_AXIS)))(bcols_g, bnulls_g, bvalid_g)
-            if not bool(np.any(np.asarray(table_g.overflow))):
+            if not bool(np.any(_host([table_g.overflow])[0])):
                 break
             cap_r *= 4
         return table_g
@@ -1035,15 +1066,17 @@ class DistributedExecutor:
             return (tuple(c[None] for c in cols), tuple(m[None] for m in nulls),
                     valid[None], of[None])
 
-        c0, n0, v0, of0 = jax.jit(sample)(
+        c0, n0, v0, of0 = _jit(sample)(
             jax.device_put(stream.scan_lo_batches[0], sharded), stream.aux)
-        if bool(np.any(np.asarray(of0))):
+        got = _host(list(c0) + list(n0) + [v0, of0]
+                    + ([luts[ch]] if ch in luts else []))
+        if bool(np.any(got[len(c0) + len(n0) + 1])):
             return None, True
-        cols0 = [np.asarray(c).reshape(-1) for c in c0]
-        nulls0 = [np.asarray(m).reshape(-1) for m in n0]
-        valid0 = np.asarray(v0).reshape(-1)
+        cols0 = [c.reshape(-1) for c in got[:len(c0)]]
+        nulls0 = [m.reshape(-1) for m in got[len(c0):len(c0) + len(n0)]]
+        valid0 = got[len(c0) + len(n0)].reshape(-1)
 
-        lut_np = None if ch not in luts else np.asarray(luts[ch])
+        lut_np = None if ch not in luts else got[-1]
 
         def rank_host(c):
             if lut_np is not None:
@@ -1112,7 +1145,7 @@ class DistributedExecutor:
             return (tuple(c[idx][None] for c in cols),
                     tuple(m[idx][None] for m in nulls_), valid[idx][None])
 
-        scols, snulls, _ = jax.jit(sort_shard)(
+        scols, snulls, _ = _jit(sort_shard)(
             tuple(jax.device_put(c, sharded) for c in cols_g),
             tuple(jax.device_put(m, sharded) for m in nulls_g),
             jax.device_put(valid_g, sharded), luts_t)
@@ -1185,7 +1218,7 @@ class DistributedExecutor:
                            for m in onulls)
             return (tuple(c[None] for c in ocols), tuple(m[None] for m in onulls))
 
-        ocols, onulls = jax.jit(wstep)(
+        ocols, onulls = _jit(wstep)(
             tuple(jax.device_put(c, sharded) for c in cols_g),
             tuple(jax.device_put(m, sharded) for m in nulls_g),
             jax.device_put(valid_g, sharded))
@@ -1227,7 +1260,7 @@ class DistributedExecutor:
                     tuple(m[None] for m in rnulls),
                     rvalid[None], (of | r_of)[None])
 
-        step = jax.jit(step)
+        step = _jit(step)
         if seed is not None:
             per_cols, per_nulls = seed
         else:
@@ -1236,11 +1269,12 @@ class DistributedExecutor:
         for lo in stream.scan_lo_batches[skip_batches:]:
             rcols, rnulls, rvalid, of = step(
                 jax.device_put(lo, sharded), stream.aux, route_aux)
-            if bool(np.any(np.asarray(of))):
+            got = _host(list(rcols) + list(rnulls) + [rvalid, of])
+            if bool(np.any(got[-1])):
                 return None
-            v = np.asarray(rvalid)
-            cols_np = [np.asarray(c) for c in rcols]
-            nulls_np = [np.asarray(m) for m in rnulls]
+            v = got[-2]
+            cols_np = got[:len(rcols)]
+            nulls_np = got[len(rcols):len(rcols) + len(rnulls)]
             for w in range(W):
                 vw = v[w]
                 for i in range(ncols):
@@ -1304,15 +1338,17 @@ class DistributedExecutor:
                     cat_valid[idx][None],
                     (s_of | of)[None])
 
-        step = jax.jit(step)
+        step = _jit(step)
         for lo in stream.scan_lo_batches:
             state = step(state, jax.device_put(lo, sharded), stream.aux, luts_t)
 
-        oflow = bool(np.any(np.asarray(state[3])))
+        got = _host(list(state[0]) + list(state[1]) + [state[2], state[3]])
+        oflow = bool(np.any(got[-1]))
         # host merge: W*k candidate rows -> final top-k (ordered merge stage)
-        cols_np = [np.asarray(c).reshape(-1) for c in state[0]]
-        nulls_np = [np.asarray(m).reshape(-1) for m in state[1]]
-        valid_np = np.asarray(state[2]).reshape(-1)
+        nc = len(state[0])
+        cols_np = [c.reshape(-1) for c in got[:nc]]
+        nulls_np = [m.reshape(-1) for m in got[nc:nc + len(state[1])]]
+        valid_np = got[-2].reshape(-1)
         page = Page(stream.schema,
                     tuple(jnp.asarray(c) for c in cols_np),
                     tuple(jnp.asarray(m) if m.any() else None for m in nulls_np),
@@ -1379,31 +1415,34 @@ class DistributedExecutor:
                                      is_leaf=lambda x: x is None),
                         (of_g[0] | of)[None])
 
-            step = jax.jit(step)
+            step = _jit(step)
             for lo in stream.scan_lo_batches:
                 state, of_acc = step(state, of_acc, jax.device_put(lo, sharded),
                                      stream.aux)
 
-            if bool(np.any(np.asarray(of_acc))):
+            if bool(np.any(_host([of_acc])[0])):
                 return None, True  # exchange bucket overflow: ladder retry
             merged = self._merge_states(state, key_types, acc_specs, merge_kinds, capacity)
-            overflow = bool(np.any(np.asarray(merged.overflow))) or bool(
-                np.any(np.asarray(state.overflow)))
+            of2 = _host([merged.overflow, state.overflow])
+            overflow = bool(np.any(of2[0])) or bool(np.any(of2[1]))
             if not overflow or capacity >= MAX_GROUP_CAPACITY:
                 break
             capacity *= 4
 
         # concat per-worker final partitions on host
-        table_np = np.asarray(merged.table)  # [W, C+1]
+        got = _host([merged.table] + list(merged.key_cols)
+                    + list(merged.accs))  # one batched table pull
+        table_np = got[0]  # [W, C+1]
         occ = table_np[:, :capacity] != EMPTY_KEY
-        key_cols = [np.concatenate([np.asarray(k)[w, :capacity][occ[w]] for w in range(W)])
-                    for k in merged.key_cols]
-        acc_cols = [np.concatenate([np.asarray(a)[w, :capacity][occ[w]] for w in range(W)])
-                    for a in merged.accs]
+        nk = len(merged.key_cols)
+        key_cols = [np.concatenate([k[w, :capacity][occ[w]] for w in range(W)])
+                    for k in got[1:1 + nk]]
+        acc_cols = [np.concatenate([a[w, :capacity][occ[w]] for w in range(W)])
+                    for a in got[1 + nk:]]
         fin_cols, fin_nulls = _finalize_aggs(node.aggs, acc_cols, occ.sum())
         out_cols = key_cols + fin_cols
         # host output (exact wide-decimal columns must never reach the device)
-        arrays = [np.asarray(c) for c in out_cols]
+        arrays = [np.asarray(c) for c in out_cols]  # host-ok: post-_host finalize
         # grouped keys from generator scans carry no nulls on this path
         page = Page(node.schema, tuple(arrays),
                     tuple(None for _ in key_cols) + tuple(fin_nulls), None)
@@ -1452,7 +1491,7 @@ class DistributedExecutor:
             merged = dataclasses.replace(merged, overflow=merged.overflow | state.overflow)
             return jax.tree.map(lambda x: x[None], merged, is_leaf=lambda x: x is None)
 
-        return jax.jit(merge)(state)
+        return _jit(merge)(state)
 
     def _run_global_aggregate(self, node, stream: _DStream):
         """Ungrouped aggregation: per-worker jnp reductions + psum/pmin/pmax across the
@@ -1512,26 +1551,26 @@ class DistributedExecutor:
                     raise NotImplementedError(f"global agg kind {kind}")
             return tuple(o[None] for o in out) + ((s_of | of)[None],)
 
-        step = jax.jit(step)
+        step = _jit(step)
         for lo in stream.scan_lo_batches:
             state = step(state, jax.device_put(lo, sharded), stream.aux)
 
-        if bool(np.any(np.asarray(state[-1]))):
+        got = _host(list(state))  # one batched pull of the W-scalar states
+        if bool(np.any(got[-1])):
             return None, True  # exchange bucket overflow: ladder retry
         # cross-worker combine on host (W scalars)
         finals = []
-        for s, kind in zip(state[:-1], acc_kinds):
-            v = np.asarray(s)
+        for v, kind in zip(got[:-1], acc_kinds):
             if kind in ("sum", "count", "count_star", "sum_hi32", "sum_lo32"):
                 finals.append(v.sum(axis=0, keepdims=False)[None] if v.ndim == 0 else
-                              np.asarray([v.sum()]))
+                              np.asarray([v.sum()]))  # host-ok
             elif kind == "min":
-                finals.append(np.asarray([v.min()]))
+                finals.append(np.asarray([v.min()]))  # host-ok
             else:
-                finals.append(np.asarray([v.max()]))
+                finals.append(np.asarray([v.max()]))  # host-ok
         out_cols, out_nulls = _finalize_aggs(node.aggs, finals, 1)
         # host output (exact wide-decimal columns must never reach the device)
-        arrays = [np.asarray(c) for c in out_cols]
+        arrays = [np.asarray(c) for c in out_cols]  # host-ok: post-_host finalize
         page = Page(node.schema, tuple(arrays), tuple(out_nulls), None)
         return (page, tuple(None for _ in node.aggs)), False
 
@@ -1551,18 +1590,20 @@ class DistributedExecutor:
             return (tuple(c[None] for c in cols), tuple(n[None] for n in nulls),
                     valid[None], of[None])
 
-        run = jax.jit(run)
+        run = _jit(run)
         parts_cols, parts_nulls, parts_valid = [], [], []
         oflow = False
         for lo in stream.scan_lo_batches:
             cols, nulls, valid, of = run(jax.device_put(lo, sharded), stream.aux)
-            oflow = oflow or bool(np.any(np.asarray(of)))
+            got = _host(list(cols) + list(nulls) + [valid, of])
+            oflow = oflow or bool(np.any(got[-1]))
             if oflow:
                 return None, True  # exchange bucket overflow: ladder retry
-            v = np.asarray(valid).reshape(-1)
+            v = got[-2].reshape(-1)
             parts_valid.append(v)
-            parts_cols.append([np.asarray(c).reshape(-1)[v] for c in cols])
-            parts_nulls.append([np.asarray(n).reshape(-1)[v] for n in nulls])
+            parts_cols.append([c.reshape(-1)[v] for c in got[:len(cols)]])
+            parts_nulls.append([n.reshape(-1)[v]
+                                for n in got[len(cols):len(cols) + len(nulls)]])
         ncols = len(stream.schema.fields)
         cols = tuple(jnp.asarray(np.concatenate([p[i] for p in parts_cols]))
                      for i in range(ncols))

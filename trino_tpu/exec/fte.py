@@ -113,10 +113,14 @@ def serialize_page(columns: list, null_masks: list,
     optional AES, CompressingEncryptingPageSerializer.java:58)."""
     buf = io.BytesIO()
     arrays = {}
-    for i, c in enumerate(columns):
-        arrays[f"c{i}"] = np.asarray(c)
+    # ONE batched device->host pull for the whole page (serialization is a
+    # transfer chokepoint on tunneled links, and it must show on the counters)
+    host = _host(list(columns) + [m for m in null_masks if m is not None])
+    hcols, rest = host[:len(columns)], host[len(columns):]
+    for i, c in enumerate(hcols):
+        arrays[f"c{i}"] = c
         if null_masks[i] is not None:
-            arrays[f"n{i}"] = np.asarray(null_masks[i])
+            arrays[f"n{i}"] = rest.pop(0)
     np.savez(buf, ncols=np.int64(len(columns)), **arrays)
     payload = buf.getvalue()
     codec = _CODECS[PAGE_CODEC] if compress else 0
@@ -316,8 +320,11 @@ class FaultTolerantExecutor:
         self._lock = threading.Lock()
 
     # -- public ----------------------------------------------------------------
-    def execute(self, plan: P.PlanNode):
+    def execute(self, plan: P.PlanNode, dispatch_batch=None):
         with self._lock:
+            # per-query dispatch-coalescing width (the executor is engine-
+            # cached across queries; None = TRINO_TPU_DISPATCH_BATCH default)
+            self.local.dispatch_batch = dispatch_batch
             self.local._overrides = {}
             self._task_seq = 0
             self._exchange_seq += 1
@@ -859,7 +866,7 @@ def _merge_partial_cols(node, key_types, acc_specs, acc_kinds, payloads):
     acc_cols = [a[:n_groups] for a in got[2 * nk:]]
     fin_cols, fin_nulls = _finalize_aggs(node.aggs, acc_cols, n_groups)
     out_cols = key_cols + fin_cols
-    arrays = [np.asarray(c) for c in out_cols]
+    arrays = [np.asarray(c) for c in out_cols]  # host-ok: post-_host finalize
     out_nulls = tuple(kn if kn.any() else None for kn in key_null_cols) \
         + tuple(fin_nulls)
     page = Page(node.schema, tuple(arrays), out_nulls, None)

@@ -59,6 +59,114 @@ def _jit(fn, **kwargs):
     return run
 
 
+def _dispatch_batch_default() -> int:
+    """Engine-wide dispatch-coalescing width: how many shape-uniform scan
+    splits fold into ONE device dispatch.  On tunneled TPUs each dispatch is a
+    host round-trip, so batch K divides the per-split dispatch bill by ~K with
+    zero regeneration cost (pages are still produced once per split — the
+    lesson of the failed scan-fused path, which re-generated on device).
+    ``TRINO_TPU_DISPATCH_BATCH=1`` restores exact per-split behavior; the
+    ``dispatch_batch`` session property overrides per query (and rides the
+    plan-cache key via engine._plan_shape_props)."""
+    import os
+
+    try:
+        v = int(os.environ.get("TRINO_TPU_DISPATCH_BATCH", "4"))
+    except ValueError:
+        return 4
+    return max(v, 1)
+
+
+def _page_batch_sig(page):
+    """Shape-class signature for dispatch coalescing, or None when the page
+    must never coalesce (exact wide-decimal object columns run eagerly; an
+    empty page has nothing to batch).  Pages group only with identical
+    signatures, so a stacked batch is one XLA shape class."""
+    for c in page.columns:
+        if isinstance(c, np.ndarray) and c.dtype == object:
+            return None
+    if page.capacity == 0:
+        return None
+    return (tuple((str(c.dtype), tuple(c.shape)) for c in page.columns),
+            tuple(m is not None for m in page.null_masks),
+            page.valid is not None)
+
+
+def _coalesced_batches(pages_iter, batch: int):
+    """Group consecutive shape-uniform pages for dispatch coalescing.
+
+    Yields ``(pages, live)``: a singleton ``([page], None)`` runs the ordinary
+    per-page path; a group runs the batched path with ``pages`` padded to
+    EXACTLY ``batch`` entries (short remainders repeat their last page) and
+    ``live`` a [batch] bool mask zeroing the padding's validity inside the
+    trace.  Fixed-K groups mean ONE compiled batch executable per page shape
+    — group-size-shaped executables (a 4-batch AND a 2-batch, etc.) would
+    multiply cold-compile time across every multi-split query.  Padding is
+    masked work the engine's mask-respecting operators already skip
+    semantically; it costs device FLOPs only, never a dispatch.  ``batch<=1``
+    degrades to singleton groups — byte-identical to un-batched iteration.
+    Groups record their REAL split count on the query counters (EXPLAIN
+    ANALYZE's "splits coalesced")."""
+    if batch <= 1:
+        for pg in pages_iter:
+            yield [pg], None
+        return
+    buf: list = []
+    sig = None
+
+    def flush():
+        while buf:
+            group, buf[:] = buf[:batch], buf[batch:]
+            if len(group) == 1:
+                yield group, None
+                continue
+            tracing.record_coalesced(len(group))
+            live = np.arange(batch) < len(group)
+            while len(group) < batch:  # pad: repeated page, live=False
+                group.append(group[-1])
+            yield group, live
+
+    for pg in pages_iter:
+        s = _page_batch_sig(pg)
+        if s is None:
+            yield from flush()
+            sig = None
+            yield [pg], None
+            continue
+        if sig is not None and s != sig:
+            yield from flush()
+        sig = s
+        buf.append(pg)
+        if len(buf) >= batch:
+            yield from flush()
+    yield from flush()
+
+
+def _stack_pages(pages, live=None):
+    """Concatenate K uniform pages into one (cols, nulls, valid) triple INSIDE
+    a trace: the coalescing itself costs no dispatch, and row order is split
+    order, so every row-wise stream transform (filters, projections, LUT
+    gathers, join probes) computes exactly what K per-page runs would — the
+    engine's masks-not-shrinking page model is what makes plain concatenation
+    sound.  ``live`` ([K] bool) invalidates padding pages appended by
+    ``_coalesced_batches`` to hold the group at a fixed K.  Called only under
+    jit (from jitted_batch / the batched agg steps)."""
+    ncol = len(pages[0].columns)
+    n = pages[0].capacity
+    cols = tuple(jnp.concatenate([p.columns[ci] for p in pages])
+                 for ci in range(ncol))
+    nulls = tuple(
+        None if all(p.null_masks[ci] is None for p in pages)
+        else jnp.concatenate([
+            p.null_masks[ci] if p.null_masks[ci] is not None
+            else jnp.zeros((p.columns[ci].shape[0],), bool) for p in pages])
+        for ci in range(ncol))
+    valid = jnp.concatenate([p.valid_mask() for p in pages])
+    if live is not None:
+        valid = valid & jnp.repeat(jnp.asarray(live), n)
+    return cols, nulls, valid
+
+
 DEFAULT_GROUP_CAPACITY = 1 << 16
 # ceiling sized for SF10-class group counts on one chip (15M distinct
 # orderkeys need 32M slots to keep the probe load factor sane; ~40B/slot keeps
@@ -148,6 +256,8 @@ class _Stream:
     # for no further reduction
     traced_src: Optional[_TracedSrc] = None  # on-device regenerable provenance
     _jitted: Callable = None  # cached jit of transform applied to a Page
+    _batch_jitted: Callable = None  # cached jit of transform over a STACKED
+    # group of uniform pages (dispatch coalescing; retraces per group arity)
     _fused_cache: dict = dataclasses.field(default_factory=dict)  # compiled
     # whole-scan artifacts (fused concat passes), keyed by shape class
 
@@ -178,6 +288,25 @@ class _Stream:
             self._jitted = run
         return self._jitted
 
+    def jitted_batch(self):
+        """One-dispatch transform of a GROUP of shape-uniform pages: the pages
+        stack (concatenate) inside the trace and the fused transform runs once
+        over the [K*n] rows — K splits, one tunnel round-trip.  Groups come
+        from ``_coalesced_batches`` (object-dtype pages never group, so the
+        eager wide-decimal path stays on ``jitted()``), which pads every group
+        to exactly K pages with a ``live`` mask — fixed arity, so ONE compiled
+        executable per page shape (do not "optimize" the padding away: size-
+        shaped groups would retrace per arity and multiply cold compiles)."""
+        if self._batch_jitted is None:
+            f = _jit(lambda pages, live, aux: self.transform(
+                *_stack_pages(pages, live), aux))
+
+            def run(pages, live, f=f):
+                return f(tuple(pages), live, self.aux)
+
+            self._batch_jitted = run
+        return self._batch_jitted
+
 
 class LocalExecutor:
     """Executes a plan tree on the local device set (one chip or CPU).
@@ -193,6 +322,12 @@ class LocalExecutor:
         from ..memory import MemoryPool
 
         self.catalogs = catalogs
+        # dispatch-coalescing width for this executor's queries: None resolves
+        # to TRINO_TPU_DISPATCH_BATCH (default 4).  The engine sets it per
+        # query from the ``dispatch_batch`` session property, which rides the
+        # plan-cache key — so a cached plan's compiled batch artifacts always
+        # match the batch the plan was keyed under.
+        self.dispatch_batch = None
         self._stream_cache: dict = {}  # id(node) -> (node, _Stream)
         self._agg_cache: dict = {}  # id(node) -> compiled aggregation artifacts
         self.stats: dict = {}  # id(node) -> {"rows": int, "wall_s": float}
@@ -208,6 +343,13 @@ class LocalExecutor:
         # switch to partitioned (Grace) strategies when the pool says no
         # (reference: MemoryPool + MemoryRevokingScheduler -> spill)
         self.memory_pool = memory_pool if memory_pool is not None else MemoryPool()
+
+    def _batch(self) -> int:
+        """Effective dispatch-coalescing width (>=1; 1 = per-split)."""
+        b = self.dispatch_batch
+        if b is None or int(b) <= 0:
+            return _dispatch_batch_default()
+        return int(b)
 
     def forget_plan(self, plan: P.PlanNode) -> None:
         """Evict compiled artifacts for a plan the engine is replacing (its
@@ -324,7 +466,7 @@ class LocalExecutor:
             return page, dicts
         # streaming leaf reached directly (scan/filter/project/join-probe): materialize
         stream = self._compile_stream(node)
-        page = _concat_stream(stream)
+        page = _concat_stream(stream, self._batch())
         self._record(node, page, t0)
         return page, stream.dicts
 
@@ -369,10 +511,13 @@ class LocalExecutor:
         gets its selectivity win, re-planned for static shapes."""
         compact_jits: dict = {}
 
-        def pages(up=up):
+        def pages(up=up, self=self):
             run = up.jitted()
-            for pg in up.pages():
-                cols, nulls, valid = run(pg)
+            batch = self._batch()
+            brun = up.jitted_batch() if batch > 1 else None
+            for group, live in _coalesced_batches(up.pages(), batch):
+                cols, nulls, valid = run(group[0]) if live is None \
+                    else brun(group, live)
                 n = int(valid.shape[0])
                 count = int(jnp.sum(valid))
                 bucket = n
@@ -463,8 +608,23 @@ class LocalExecutor:
                 # on a background thread so decode overlaps device compute
                 # (the local-exchange producer/consumer overlap of the
                 # reference, operator/exchange/LocalExchange.java — re-planned
-                # at the split boundary)
-                pages = _prefetched_pages(pages)
+                # at the split boundary); to_device moves each decoded page
+                # host->device on the producer thread too, so the transfer
+                # overlaps instead of serializing into the next dispatch
+                pages = _prefetched_pages(pages, to_device=True)
+            elif len(splits) > 1 and self._batch() > 1:
+                # dispatch-coalescing double buffer: while the device executes
+                # batch k, a background thread generates (and device_puts)
+                # batch k+1's pages — overlapping the two dominant latencies
+                # on tunneled TPUs.  The producer runs ONLY connector code
+                # (conn.generate), never executor state, so it is safe off the
+                # query thread; it dies with the query via generator close
+                # (the consumer's finally / GC), never outliving the
+                # single-query LocalExecutor that started it.  warmup=2: a
+                # LIMIT short-circuit that stops within two pages must not
+                # have generated a single split beyond what it consumed.
+                pages = _prefetched_pages(pages, depth=self._batch(),
+                                          to_device=True, warmup=2)
             si = _ScanInfo(conn, splits, tuple(node.columns), tuple(node.columns))
             clustered = tuple(conn.clustered_by(node.table)) \
                 if hasattr(conn, "clustered_by") else ()
@@ -645,18 +805,18 @@ class LocalExecutor:
         return tuple(out)
 
     def _direct_step(self, node, cfg, stream, key_types, acc_exprs, acc_kinds):
-        """Jitted direct-indexed insert step (cached per (node, cfg))."""
+        """Jitted direct-indexed insert steps (cached per (node, cfg)):
+        ``(dstep, bdstep)`` — per-page, and dispatch-coalesced over a group of
+        shape-uniform pages (the group stacks inside the trace and inserts
+        once; direct-indexed slots are key-determined, so batch width cannot
+        change the result)."""
         cacheable = self._agg_cacheable(node)
         hit = self._agg_cache.get(("direct", id(node), cfg)) if cacheable else None
         if hit is not None:
-            return hit[1]
+            return hit[1], hit[2]
 
-        @_jit
-        def dstep(state, page, aux, stream=stream, node=node, cfg=cfg,
-                  acc_exprs=acc_exprs, acc_kinds=acc_kinds):
-            cols, nulls, valid = stream.transform(
-                page.columns, page.null_masks, page.valid_mask(), aux
-            )
+        def body(state, cols, nulls, valid, stream=stream, node=node, cfg=cfg,
+                 acc_exprs=acc_exprs, acc_kinds=acc_kinds):
             key_vals = tuple(cols[i] for i in node.keys)
             key_nulls = tuple(nulls[i] for i in node.keys)
             inputs = [
@@ -666,9 +826,19 @@ class LocalExecutor:
                 state, cfg, key_vals, valid, inputs, acc_kinds, key_nulls
             )
 
+        @_jit
+        def dstep(state, page, aux, stream=stream):
+            return body(state, *stream.transform(
+                page.columns, page.null_masks, page.valid_mask(), aux))
+
+        @_jit
+        def bdstep(state, pages, live, aux, stream=stream):
+            return body(state, *stream.transform(*_stack_pages(pages, live),
+                                                 aux))
+
         if cacheable:
-            self._agg_cache[("direct", id(node), cfg)] = (node, dstep)
-        return dstep
+            self._agg_cache[("direct", id(node), cfg)] = (node, dstep, bdstep)
+        return dstep, bdstep
 
     # -- scan-fused aggregation ----------------------------------------------
     def _traced_chain(self, stream):
@@ -852,7 +1022,7 @@ class LocalExecutor:
                 raise NotImplementedError(
                     f"{s.kind} argument must be a plain column")
         stream = self._compile_stream(node.child)
-        page = _concat_stream(stream)
+        page = _concat_stream(stream, self._batch())
         n = page.capacity
         key_chs = list(node.keys)
         if n == 0:
@@ -968,7 +1138,7 @@ class LocalExecutor:
                 gk, gn = empty_keys()
                 return gk, gn, np.zeros((0,), np.int64), \
                     np.zeros((0,), bool), \
-                    MapData(np.zeros((0,), np.asarray(v).dtype),
+                    MapData(np.zeros((0,), np.dtype(v.dtype)),
                             np.zeros((0,), np.int64),
                             spec.arg.type, BIGINT, key_dict=d)
             got = _host([v[idx], vnull[idx]] + key_fetches(sk, skn, starts))
@@ -992,9 +1162,9 @@ class LocalExecutor:
                     out_null[gi] = True
                 spans[gi] = pack_span(start, len(key_heap) - start)
                 max_len = max(max_len, len(key_heap) - start)
-            md = MapData(np.asarray(key_heap,
-                                    dtype=np.asarray(sval_np).dtype),
-                         np.asarray(cnt_heap, np.int64),
+            md = MapData(np.asarray(key_heap,  # host-ok: python list
+                                    dtype=sval_np.dtype),
+                         np.asarray(cnt_heap, np.int64),  # host-ok: python list
                          spec.arg.type, BIGINT, key_dict=d, max_len=max_len)
             return gkeys, gknulls, spans, out_null, md
 
@@ -1032,8 +1202,7 @@ class LocalExecutor:
             if g == 0:
                 return (idx, sk, skn, np.zeros(0, np.int64),
                         np.zeros(0, np.int64), m, 0)
-            starts = np.asarray(
-                jnp.nonzero(new_group, size=g, fill_value=n)[0])
+            starts = _host([jnp.nonzero(new_group, size=g, fill_value=n)[0]])[0]
             ends = np.concatenate([starts[1:], [m]])
             return idx, sk, skn, starts, ends, m, g
 
@@ -1115,7 +1284,7 @@ class LocalExecutor:
             vnull = jnp.zeros((n,), bool) if vn is None else vn
             idx, sk, skn, starts, ends, m, g = seg_sort(v, vnull)
             if g == 0:
-                empty = ArrayData(np.zeros((0,), np.asarray(v).dtype),
+                empty = ArrayData(np.zeros((0,), np.dtype(v.dtype)),
                                   elem_t, elem_dict=d)
                 gk, gn = empty_keys()
                 return gk, gn, np.zeros((0,), np.int64), \
@@ -1135,7 +1304,7 @@ class LocalExecutor:
                     out_null[gi] = True
                 spans[gi] = pack_span(start, len(heap) - start)
                 max_len = max(max_len, len(heap) - start)
-            ad = ArrayData(np.asarray(heap, dtype=np.asarray(sval_np).dtype),
+            ad = ArrayData(np.asarray(heap, dtype=sval_np.dtype),  # host-ok: python list
                            elem_t, elem_dict=d, max_len=max_len)
             return gkeys, gknulls, spans, out_null, ad
 
@@ -1156,7 +1325,7 @@ class LocalExecutor:
             val_t = stream.schema.fields[vch2].type
             kd, vd = stream.dicts[kch], stream.dicts[vch2]
             if g == 0:
-                empty = MapData(np.zeros((0,), np.asarray(kcol).dtype),
+                empty = MapData(np.zeros((0,), np.dtype(kcol.dtype)),
                                 np.zeros((0,), np.int64), key_t, val_t,
                                 key_dict=kd, value_dict=vd)
                 gk, gn = empty_keys()
@@ -1198,10 +1367,10 @@ class LocalExecutor:
                     out_null[gi] = True
                 spans[gi] = pack_span(start, len(key_heap) - start)
                 max_len = max(max_len, len(key_heap) - start)
-            vh = np.asarray(val_heap, dtype=object)
+            vh = np.asarray(val_heap, dtype=object)  # host-ok: python list
             if not any(x is None for x in val_heap):
-                vh = np.asarray(val_heap, dtype=np.asarray(sval).dtype)
-            md = MapData(np.asarray(key_heap, dtype=np.asarray(skey).dtype),
+                vh = np.asarray(val_heap, dtype=sval.dtype)  # host-ok: python list
+            md = MapData(np.asarray(key_heap, dtype=skey.dtype),  # host-ok: python list
                          vh, key_t, val_t, key_dict=kd, value_dict=vd,
                          max_len=max_len)
             return gkeys, gknulls, spans, out_null, md
@@ -1266,7 +1435,7 @@ class LocalExecutor:
         cols = list(out_key_cols) + agg_vals
         nulls = [None if kn is None or not kn.any() else kn
                  for kn in out_key_nulls] + agg_nulls
-        arrays = [np.asarray(c) for c in cols]
+        arrays = [np.asarray(c) for c in cols]  # host-ok: sorted-agg host outputs
         dicts = tuple(stream.dicts[i] for i in key_chs) + tuple(agg_dicts)
         return Page(node.schema, tuple(arrays), tuple(nulls), None), dicts
 
@@ -1300,7 +1469,7 @@ class LocalExecutor:
         # would pay one RTT per accumulator on tunneled links)
         acc_cols = [a[None] for a in _host(list(state))]
         out_cols, out_nulls = _finalize_aggs(node.aggs, acc_cols, 1)
-        arrays = [np.asarray(c) for c in out_cols]
+        arrays = [np.asarray(c) for c in out_cols]  # host-ok: post-_host finalize
         page = Page(node.schema, tuple(arrays), tuple(out_nulls), None)
         return page, tuple(None for _ in node.aggs)
 
@@ -1385,10 +1554,14 @@ class LocalExecutor:
                 if cfg is not None:
                     state = hashagg.direct_groupby_init(
                         cfg, tuple(t.dtype for t in key_types), acc_specs)
-                    dstep = self._direct_step(node, cfg, stream, key_types, acc_exprs,
-                                              acc_kinds)
-                    for page in pages_once:
-                        state = dstep(state, page, stream.aux)
+                    dstep, bdstep = self._direct_step(node, cfg, stream,
+                                                      key_types, acc_exprs,
+                                                      acc_kinds)
+                    for group, live in _coalesced_batches(pages_once,
+                                                          self._batch()):
+                        state = dstep(state, group[0], stream.aux) \
+                            if live is None \
+                            else bdstep(state, tuple(group), live, stream.aux)
                     if not bool(state.overflow):
                         break
                     # stale stats put keys out of range: hash mode
@@ -1430,15 +1603,24 @@ class LocalExecutor:
         cacheable = self._agg_cacheable(node)
         arts = self._agg_cache.get(("hashpage", id(node))) if cacheable else None
         if arts is None:
-            @_jit
-            def prepare(page, aux, stream=stream, node=node, acc_exprs=acc_exprs):
-                cols, nulls, valid = stream.transform(
-                    page.columns, page.null_masks, page.valid_mask(), aux)
+            def prep_body(cols, nulls, valid, node=node, acc_exprs=acc_exprs):
                 keys = tuple(cols[i] for i in node.keys)
                 knulls = tuple(nulls[i] for i in node.keys)
                 inputs = tuple((None, None) if e is None else evaluate(e, cols, nulls)
                                for e in acc_exprs)
                 return keys, knulls, inputs, valid, jnp.sum(valid, dtype=jnp.int32)
+
+            @_jit
+            def prepare(page, aux, stream=stream):
+                return prep_body(*stream.transform(
+                    page.columns, page.null_masks, page.valid_mask(), aux))
+
+            @_jit
+            def bprepare(pages, live, aux, stream=stream):
+                # dispatch coalescing: K uniform pages stack inside the trace
+                # and the whole transform+staging runs as ONE dispatch
+                return prep_body(*stream.transform(
+                    *_stack_pages(pages, live), aux))
 
             @_jit
             def insert_compact(state, keys, knulls, inputs, n, key_types=key_types,
@@ -1453,10 +1635,10 @@ class LocalExecutor:
                 return hashagg.groupby_insert(state, keys, key_types, valid, inputs,
                                               acc_kinds, knulls)
 
-            arts = (node, prepare, insert_compact, insert_masked)
+            arts = (node, prepare, bprepare, insert_compact, insert_masked)
             if cacheable:
                 self._agg_cache[("hashpage", id(node))] = arts
-        _, prepare, insert_compact, insert_masked = arts
+        _, prepare, bprepare, insert_compact, insert_masked = arts
         staged: list = []
 
         def insert_chunk(state, counts):
@@ -1508,8 +1690,9 @@ class LocalExecutor:
                 resv["bytes"] += delta
                 state = hashagg.rehash(start_state, grown, tuple(acc_kinds))
 
-        for page in pages_iter:
-            staged.append(prepare(page, stream.aux))
+        for group, live in _coalesced_batches(pages_iter, self._batch()):
+            staged.append(prepare(group[0], stream.aux) if live is None
+                          else bprepare(tuple(group), live, stream.aux))
             if len(staged) >= 4:
                 state, ceiling = drain(state)
                 if ceiling:
@@ -1555,10 +1738,7 @@ class LocalExecutor:
         cacheable = self._agg_cacheable(node)
         hit = self._agg_cache.get(("streamagg", id(node))) if cacheable else None
         if hit is None:
-            @_jit
-            def pstep(page, aux, stream=stream, node=node):
-                cols, nulls, valid = stream.transform(
-                    page.columns, page.null_masks, page.valid_mask(), aux)
+            def pstep_body(cols, nulls, valid, node=node):
                 n = valid.shape[0]
                 # order-preserving compaction (cumsum-scatter)
                 dst, count = _compact_pack(valid)
@@ -1603,6 +1783,20 @@ class LocalExecutor:
                 return tuple(kcols), tuple(knulls), tuple(accs), new
 
             @_jit
+            def pstep(page, aux, stream=stream):
+                return pstep_body(*stream.transform(
+                    page.columns, page.null_masks, page.valid_mask(), aux))
+
+            @_jit
+            def bpstep(pages, live, aux, stream=stream):
+                # dispatch coalescing: the stacked group keeps scan row order,
+                # so clustering (group contiguity) holds across the K splits
+                # and the segmented reduce even merges groups spanning the
+                # original page boundaries before mstep sees them
+                return pstep_body(*stream.transform(
+                    *_stack_pages(pages, live), aux))
+
+            @_jit
             def mstep(state, kcols, knulls, accs, new,
                       key_types=key_types, merge_kinds=tuple(merge_kinds)):
                 return hashagg.groupby_insert(
@@ -1610,9 +1804,10 @@ class LocalExecutor:
                     [(a, None) for a in accs], list(merge_kinds), knulls)
 
             if cacheable:
-                self._agg_cache[("streamagg", id(node))] = (node, pstep, mstep)
+                self._agg_cache[("streamagg", id(node))] = (node, pstep,
+                                                            bpstep, mstep)
         else:
-            _, pstep, mstep = hit
+            _, pstep, bpstep, mstep = hit
 
         capacity = ceil_pow2(capacity)
         if not self.memory_pool.try_reserve(state_bytes(capacity), "group-by"):
@@ -1622,8 +1817,10 @@ class LocalExecutor:
             pages = pages_once
             while True:
                 state = hashagg.groupby_init(capacity, key_dtypes, acc_specs)
-                for page in pages:
-                    kcols, knulls, accs, new = pstep(page, stream.aux)
+                for group, live in _coalesced_batches(pages, self._batch()):
+                    kcols, knulls, accs, new = \
+                        pstep(group[0], stream.aux) if live is None \
+                        else bpstep(tuple(group), live, stream.aux)
                     state = mstep(state, kcols, knulls, accs, new)
                 if not bool(state.overflow):
                     return self._finalize_groups(node, stream, state)
@@ -1690,7 +1887,7 @@ class LocalExecutor:
         acc_cols = [a[:n_groups] for a in got[2 * nk:]]
         fin_cols, fin_nulls = _finalize_aggs(node.aggs, acc_cols, n_groups)
         out_cols = key_cols + fin_cols
-        arrays = [np.asarray(c) for c in out_cols]
+        arrays = [np.asarray(c) for c in out_cols]  # host-ok: post-_host finalize
         out_nulls = tuple(kn if kn.any() else None for kn in key_null_cols
                           ) + tuple(fin_nulls)
         page = Page(node.schema, tuple(arrays), out_nulls, None)
@@ -1803,8 +2000,8 @@ class LocalExecutor:
         cacheable = self._agg_cacheable(node)
         hit = self._agg_cache.get(("global", id(node))) if cacheable else None
         if hit is not None:
-            step = hit[1]
-            return self._finish_global(node, stream, acc_exprs, acc_kinds, step)
+            return self._finish_global(node, stream, acc_exprs, acc_kinds,
+                                       hit[1], hit[2])
 
         @_jit
         def step(state, page, aux, stream=stream, acc_exprs=acc_exprs,
@@ -1814,28 +2011,43 @@ class LocalExecutor:
             return _global_agg_update(state, cols, nulls, valid, acc_exprs,
                                       acc_kinds)
 
-        if cacheable:
-            self._agg_cache[("global", id(node))] = (node, step)
-        return self._finish_global(node, stream, acc_exprs, acc_kinds, step)
+        @_jit
+        def bstep(state, pages, live, aux, stream=stream, acc_exprs=acc_exprs,
+                  acc_kinds=acc_kinds):
+            # dispatch coalescing: fold a group of uniform pages in ONE
+            # dispatch — reductions run over the stacked rows
+            cols, nulls, valid = stream.transform(*_stack_pages(pages, live),
+                                                  aux)
+            return _global_agg_update(state, cols, nulls, valid, acc_exprs,
+                                      acc_kinds)
 
-    def _finish_global(self, node, stream, acc_exprs, acc_kinds, step):
+        if cacheable:
+            self._agg_cache[("global", id(node))] = (node, step, bstep)
+        return self._finish_global(node, stream, acc_exprs, acc_kinds, step,
+                                   bstep)
+
+    def _finish_global(self, node, stream, acc_exprs, acc_kinds, step, bstep):
         state = _global_init_state(node)
-        for page in stream.pages():
-            if any(isinstance(c, np.ndarray) and c.dtype == object
-                   for c in page.columns):
+        for group, live in _coalesced_batches(stream.pages(), self._batch()):
+            page = group[0]
+            if live is not None:
+                state = bstep(state, tuple(group), live, stream.aux)
+            elif any(isinstance(c, np.ndarray) and c.dtype == object
+                     for c in page.columns):
                 # exact wide-decimal input channel (count over a wide-sum
                 # subquery): jit cannot accept the page — run the step
                 # eagerly; the untouched object channel passes through
+                # (object pages never coalesce, so the eager path survives)
                 state = step.__wrapped__(state, page, stream.aux)
             else:
                 state = step(state, page, stream.aux)
         # ONE batched pull for every accumulator scalar (serial np.asarray
         # would pay one RTT per accumulator on tunneled links); exact
         # wide-decimal (object) accumulators pass through _host unchanged
-        acc_cols = [np.asarray(a)[None] for a in _host(list(state))]
+        acc_cols = [np.asarray(a)[None] for a in _host(list(state))]  # host-ok
         out_cols, out_nulls = _finalize_aggs(node.aggs, acc_cols, 1)
         # host output (exact wide-decimal columns must never reach the device)
-        arrays = [np.asarray(c) for c in out_cols]
+        arrays = [np.asarray(c) for c in out_cols]  # host-ok: post-_host finalize
         page = Page(node.schema, tuple(arrays), tuple(out_nulls), None)
         return page, tuple(None for _ in node.aggs)
 
@@ -1982,7 +2194,8 @@ class LocalExecutor:
                 _dynamic_pruned_pages(probe_stream, node, build_page)
             if pruned is not None:
                 pages_fn, kept = pruned
-                repl = {"pages": pages_fn, "_jitted": None}
+                repl = {"pages": pages_fn, "_jitted": None,
+                        "_batch_jitted": None}
                 if probe_stream.scan_info is not None:
                     repl["scan_info"] = dataclasses.replace(
                         probe_stream.scan_info, splits=list(kept))
@@ -2302,7 +2515,7 @@ class LocalExecutor:
         if isinstance(node, (P.Aggregate, P.Sort, P.Limit, P.Output, P.Window)):
             return self._execute_to_page(node)
         stream = self._compile_stream(node)
-        return _concat_stream(stream), stream.dicts
+        return _concat_stream(stream, self._batch()), stream.dicts
 
     def _direct_join_span(self, build_page: Page, key_channels, key_types):
         """(lo, span) when the build keys form a single dense integer range small
@@ -2758,17 +2971,20 @@ def _concat_traced(stream: _Stream):
     return Page(stream.schema, cols, nulls, valid)
 
 
-def _concat_stream(stream: _Stream) -> Page:
+def _concat_stream(stream: _Stream, batch: int = 1) -> Page:
     """Materialize a streaming segment into a single device page (compacted).
 
     Compaction runs ON DEVICE (nonzero-gather per page, then a device concat): pages
     never cross to the host between pipeline-breaking stages — device->host bandwidth
     is the scarce resource, not FLOPs (reference analog: pages stay in worker memory
-    between operators)."""
+    between operators).  ``batch``>1 coalesces shape-uniform pages: each group
+    of K splits runs its transform in ONE dispatch (and its compaction and
+    live-count sync amortize K-fold with it)."""
     fused = _concat_traced(stream)
     if fused is not None:
         return fused
     step = stream.jitted()
+    bstep = stream.jitted_batch() if batch > 1 else None
     parts = []
     staged, sums = [], []
 
@@ -2781,10 +2997,13 @@ def _concat_stream(stream: _Stream) -> Page:
                 continue
             if any(isinstance(c, np.ndarray) and c.dtype == object
                    for c in cols):
-                # exact wide-decimal columns: host compaction (cannot trace)
-                v = np.asarray(valid)
-                ccols = tuple(np.asarray(c)[v] for c in cols)
-                cnulls = tuple(None if m is None else np.asarray(m)[v]
+                # exact wide-decimal columns: host compaction (cannot trace);
+                # the object columns are host-resident — one batched pull
+                # covers the masks (eager jnp ops may have produced them)
+                got = _host([valid] + [m for m in nulls if m is not None])
+                v, rest = got[0], got[1:]
+                ccols = tuple(np.asarray(c)[v] for c in cols)  # host-ok: object cols
+                cnulls = tuple(None if m is None else rest.pop(0)[v]
                                for m in nulls)
                 parts.append((ccols, cnulls, n))
                 continue
@@ -2795,8 +3014,9 @@ def _concat_stream(stream: _Stream) -> Page:
         staged.clear()
         sums.clear()
 
-    for page in stream.pages():
-        cols, nulls, valid = step(page)
+    for group, live in _coalesced_batches(stream.pages(), batch):
+        cols, nulls, valid = step(group[0]) if live is None \
+            else bstep(group, live)
         staged.append((cols, nulls, valid))
         sums.append(jnp.sum(valid, dtype=jnp.int32))
         if len(staged) >= 8:
@@ -2899,7 +3119,7 @@ def _dynamic_pruned_pages(probe_stream: _Stream, node, build_page: Page):
     if si is None or not si.replayable or not hasattr(si.conn, "split_range"):
         return None
     exact_ok = build_page.capacity <= 65536
-    bvalid = np.asarray(build_page.valid_mask()) if (build_page.capacity
+    bvalid = _host([build_page.valid_mask()])[0] if (build_page.capacity
                                                      and exact_ok) else \
         np.zeros((0,), bool)
     nonempty = bvalid.any() if exact_ok else (
@@ -2924,10 +3144,12 @@ def _dynamic_pruned_pages(probe_stream: _Stream, node, build_page: Page):
         if f.type.is_string or f.type.is_floating:
             continue
         if exact_ok:
-            vals = np.asarray(build_page.columns[bch])[bvalid]
             nm = build_page.null_masks[bch]
+            got = _host([build_page.columns[bch]]
+                        + ([nm] if nm is not None else []))
+            vals = got[0][bvalid]
             if nm is not None:
-                vals = vals[~np.asarray(nm)[bvalid]]
+                vals = vals[~got[1][bvalid]]
             if len(vals) == 0:
                 continue
             uniq = np.unique(vals)
@@ -3074,9 +3296,14 @@ def _run_match_recognize(node: P.MatchRecognize, child: Page, cdicts):
             conds[var] = np.ones(n, bool)
         else:
             v, nu = evaluate(e, jc, jn)
-            arr = np.asarray(jnp.broadcast_to(v, (n,)))
+            # match_recognize's NFA walks rows on the host: one batched pull
+            # per DEFINE variable (was two loose per-variable np.asarray)
+            got = _host([jnp.broadcast_to(v, (n,))]
+                        + ([jnp.broadcast_to(nu, (n,))] if nu is not None
+                           else []))
+            arr = got[0]
             if nu is not None:
-                arr = arr & ~np.asarray(jnp.broadcast_to(nu, (n,)))
+                arr = arr & ~got[1]
             conds[var] = arr.astype(bool)
 
     def elem_conds(el):
@@ -3142,7 +3369,7 @@ def _run_match_recognize(node: P.MatchRecognize, child: Page, cdicts):
 
         measure_vars = {var for _, var, _, _ in node.measures
                         if var is not None}
-        vm = vector_match(node.pattern, conds, np.asarray(new_part),
+        vm = vector_match(node.pattern, conds, np.asarray(new_part),  # host-ok
                           measure_vars)
 
     # non-overlapping matches, AFTER MATCH SKIP PAST LAST ROW
@@ -3324,16 +3551,32 @@ def _compact_pack(valid):
     return dst, jnp.sum(valid)
 
 
-def _prefetched_pages(pages_fn, depth: int = 2):
+def _prefetched_pages(pages_fn, depth: int = 2, to_device: bool = False,
+                      warmup: int = 0):
     """Wrap a page generator with background-thread prefetch: up to ``depth``
-    pages decode ahead of the consumer.  Exceptions re-raise at the consume
-    site.  An abandoned consumer (LIMIT short-circuit, error unwind) closes the
-    generator; the producer observes the ``closed`` flag on its next bounded
-    put and exits, releasing its decoded pages and file handles instead of
-    blocking on the full queue for the process lifetime."""
+    pages decode ahead of the consumer.  ``to_device`` additionally moves each
+    page's host (numpy) arrays onto the device FROM THE PRODUCER THREAD
+    (async host->device pipelining: the copy overlaps the consumer's current
+    dispatch instead of serializing in front of the next one; object-dtype
+    wide-decimal columns stay host-side).  ``warmup`` pages are produced
+    SYNCHRONOUSLY before the thread starts: a short-circuiting consumer
+    (LIMIT) that stops within the warmup window generates exactly the pages
+    it consumed — the thread only runs ahead once the consumer proved it
+    wants a long scan.  Exceptions re-raise at the consume site.  An abandoned
+    consumer (LIMIT short-circuit, error unwind) closes the generator; the
+    producer observes the ``closed`` flag on its next bounded put and exits,
+    releasing its decoded pages and file handles instead of blocking on the
+    full queue for the process lifetime."""
     import queue as _queue
 
     def pages():
+        it = pages_fn()
+        for _ in range(warmup):
+            try:
+                p = next(it)
+            except StopIteration:
+                return
+            yield _page_to_device(p) if to_device else p
         q: _queue.Queue = _queue.Queue(maxsize=depth)
         done = object()
         closed = threading.Event()
@@ -3349,7 +3592,9 @@ def _prefetched_pages(pages_fn, depth: int = 2):
                 return False
 
             try:
-                for p in pages_fn():
+                for p in it:
+                    if to_device:
+                        p = _page_to_device(p)
                     if not put(p):
                         return
                 put(done)
@@ -3369,6 +3614,25 @@ def _prefetched_pages(pages_fn, depth: int = 2):
             closed.set()
 
     return pages
+
+
+def _page_to_device(page: Page) -> Page:
+    """Start async host->device copies for a page's numpy arrays (device
+    arrays pass through; object columns cannot live on device).  device_put is
+    an enqueue, not a sync — safe from the prefetch thread, and by the time
+    the consumer dispatches over the page the copy has overlapped."""
+    def up(a):
+        if isinstance(a, np.ndarray) and a.dtype != object:
+            return jax.device_put(a)
+        return a
+
+    if not any(isinstance(c, np.ndarray) and c.dtype != object
+               for c in tuple(page.columns) + tuple(
+                   m for m in page.null_masks if m is not None)):
+        return page
+    return Page(page.schema, tuple(up(c) for c in page.columns),
+                tuple(None if m is None else up(m) for m in page.null_masks),
+                None if page.valid is None else up(page.valid))
 
 
 def _host(arrays):
@@ -3481,8 +3745,8 @@ def _collation_rank_lut(d):
     lut = getattr(d, "_rank_lut", None)
     if lut is None or len(lut) != len(d.values):
         lut = np.empty(len(d.values), np.int64)
-        lut[np.argsort(np.asarray(d.values, dtype=object))] = \
-            np.arange(len(d.values))
+        order = np.argsort(np.asarray(d.values, dtype=object))  # host-ok: dict values
+        lut[order] = np.arange(len(d.values))
         try:
             object.__setattr__(d, "_rank_lut", lut)
         except Exception:
@@ -3595,7 +3859,7 @@ def _topn_page_device(page: Page, keys, count, dicts=None):
     m = len(got[0]) if nc else 0
 
     def unpack(b):
-        return np.unpackbits(np.asarray(b, np.uint8))[:m].astype(bool)
+        return np.unpackbits(np.asarray(b, np.uint8))[:m].astype(bool)  # host-ok
 
     pos = nc
     nulls = []

@@ -86,12 +86,12 @@ class SpilledPartitions:
             lo, hi = int(b[p]), int(b[p + 1])
             if hi <= lo:
                 continue
-            pcols = [np.asarray(c[lo:hi]) for c in got[:ncols]]
+            pcols = [np.asarray(c[lo:hi]) for c in got[:ncols]]  # host-ok: post-device_get
             rest = list(got[ncols:])
             pnulls = []
             for i in range(ncols):
                 if i in null_slots:
-                    m = np.asarray(rest[null_slots.index(i)][lo:hi])
+                    m = np.asarray(rest[null_slots.index(i)][lo:hi])  # host-ok
                     pnulls.append(m if m.any() else None)
                 else:
                     pnulls.append(None)

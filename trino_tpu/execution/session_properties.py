@@ -79,6 +79,12 @@ SYSTEM_SESSION_PROPERTIES = {p.name: p for p in [
                      "Allow partitioned re-execution when state exceeds device "
                      "memory (reference: spiller/*)", "boolean", True),
     PropertyMetadata("query_priority", "Scheduling priority", "integer", 1, _positive),
+    PropertyMetadata("dispatch_batch",
+                     "Coalesce up to K shape-uniform scan splits into one "
+                     "device dispatch (0 = engine default from "
+                     "TRINO_TPU_DISPATCH_BATCH, 1 = exact per-split "
+                     "execution).  Plan-shaping: rides the plan-cache key",
+                     "integer", 0, lambda v: None if v >= 0 else "must be >= 0"),
     PropertyMetadata("query_max_memory",
                      "Per-query device memory limit in bytes (0 = node limit "
                      "only; reference: query.max-memory + "

@@ -17,7 +17,8 @@ import time
 from typing import Optional
 
 __all__ = ["Span", "Tracer", "NOOP_TRACER", "QueryCounters", "track_counters",
-           "current_counters", "record_dispatch", "record_host_pull"]
+           "current_counters", "record_dispatch", "record_host_pull",
+           "record_coalesced"]
 
 
 # -- per-query device-boundary counters ---------------------------------------
@@ -44,25 +45,33 @@ class QueryCounters:
     device_dispatches: int = 0
     host_transfers: int = 0
     host_bytes_pulled: int = 0
+    # splits whose per-page work ran inside a coalesced multi-split dispatch
+    # (exec/local_executor._coalesced_batches): the batching that turns K
+    # per-split dispatches into one — visible so EXPLAIN ANALYZE / bench can
+    # show HOW a query met its dispatch budget, not just that it did
+    coalesced_splits: int = 0
 
     def reset(self) -> None:
         self.device_dispatches = 0
         self.host_transfers = 0
         self.host_bytes_pulled = 0
+        self.coalesced_splits = 0
 
     def merge(self, other: "QueryCounters") -> None:
         self.device_dispatches += other.device_dispatches
         self.host_transfers += other.host_transfers
         self.host_bytes_pulled += other.host_bytes_pulled
+        self.coalesced_splits += other.coalesced_splits
 
     def snapshot(self) -> "QueryCounters":
         return QueryCounters(self.device_dispatches, self.host_transfers,
-                             self.host_bytes_pulled)
+                             self.host_bytes_pulled, self.coalesced_splits)
 
     def as_dict(self) -> dict:
         return {"device_dispatches": self.device_dispatches,
                 "host_transfers": self.host_transfers,
-                "host_bytes_pulled": self.host_bytes_pulled}
+                "host_bytes_pulled": self.host_bytes_pulled,
+                "coalesced_splits": self.coalesced_splits}
 
 
 _counter_local = threading.local()
@@ -98,6 +107,12 @@ def record_host_pull(nbytes: int, transfers: int = 1) -> None:
     if c is not None:
         c.host_transfers += transfers
         c.host_bytes_pulled += nbytes
+
+
+def record_coalesced(n_splits: int) -> None:
+    c = getattr(_counter_local, "counters", None)
+    if c is not None:
+        c.coalesced_splits += n_splits
 
 
 @dataclasses.dataclass

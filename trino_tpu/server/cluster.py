@@ -629,6 +629,9 @@ class WorkerServer:
             # wedged-task re-dispatch of the same tid must hold its own slot
             token = self.scheduler.new_token(tid)
             ex = self._checkout_executor(query_key=xdir, token=token)
+            # the session's coalescing width rides the task request: worker
+            # executors batch per-split dispatches like the coordinator's
+            ex.dispatch_batch = req.get("dispatch_batch")
 
             def tick(t=token):
                 # preemption point doubles as the kill checkpoint: a query
@@ -696,6 +699,7 @@ class WorkerServer:
                         self.memory_pool.clear_query(xdir)
                     else:
                         self._running_queries[xdir] = nq
+                ex.dispatch_batch = None  # per-task setting; executor is pooled
                 self._release_executor(ex, token=token)
 
         threading.Thread(target=run, daemon=True).start()
@@ -1035,11 +1039,19 @@ class ClusterCoordinator:
         spooled inter-stage exchange, SURVEY §3.2/§3.5)."""
         import shutil
 
+        from ..engine import _effective_dispatch_batch
+
         sess = session or self.engine.create_session(
             next(iter(self.engine.catalogs)))
         plan = self._cached_plan(sql, sess)
         local = self._local
         with self._query_lock:  # overrides are executor-global
+            # session dispatch-coalescing width: applied to the coordinator's
+            # local finish AND shipped inside every task request so worker
+            # executors coalesce the same way (queries serialize on
+            # _query_lock, so the per-query stash is race-free)
+            self._dispatch_batch = _effective_dispatch_batch(sess)
+            local.dispatch_batch = self._dispatch_batch
             if not self.live_workers():
                 return local.execute(plan)
             with self._lock:
@@ -1394,7 +1406,8 @@ class ClusterCoordinator:
         frag_blob = pickle.dumps({"fragment_id": frag_id, "plan": frag})
         req = {"task_id": tid, "fragment_id": frag_id, "kind": "fragment",
                "attempt": 0, "exchange_dir": exchange_dir,
-               "output": "stream", "n_readers": n_readers}
+               "output": "stream", "n_readers": n_readers,
+               "dispatch_batch": getattr(self, "_dispatch_batch", None)}
         if sources:
             req["stream_sources"] = sources
         last_err = None
@@ -1546,7 +1559,10 @@ class ClusterCoordinator:
                     req = pickle.dumps({"task_id": tid, "fragment_id": frag_id,
                                         "kind": kind,
                                         "attempt": attempts[tid],
-                                        "exchange_dir": exchange_dir, **extra})
+                                        "exchange_dir": exchange_dir,
+                                        "dispatch_batch":
+                                            getattr(self, "_dispatch_batch",
+                                                    None), **extra})
                     _http(f"{w.url}/v1/task", req, secret=self.secret)
                     assigned[tid] = (w, extra, time.time() + self.task_timeout)
                     started[tid] = time.time()

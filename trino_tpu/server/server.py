@@ -458,6 +458,9 @@ class CoordinatorServer:
                 f"trino_tpu_host_transfers_total {ct.host_transfers}",
                 "# TYPE trino_tpu_host_bytes_pulled_total counter",
                 f"trino_tpu_host_bytes_pulled_total {ct.host_bytes_pulled}",
+                "# TYPE trino_tpu_coalesced_splits_total counter",
+                f"trino_tpu_coalesced_splits_total "
+                f"{getattr(ct, 'coalesced_splits', 0)}",
             ]
         return "\n".join(lines) + "\n"
 

@@ -24,7 +24,8 @@ def format_plan(node: P.PlanNode, stats: dict = None, counters=None) -> str:
         lines.append(
             f"Device boundary: {counters.device_dispatches} dispatches, "
             f"{counters.host_transfers} host transfers, "
-            f"{counters.host_bytes_pulled} bytes pulled")
+            f"{counters.host_bytes_pulled} bytes pulled, "
+            f"{getattr(counters, 'coalesced_splits', 0)} splits coalesced")
     return "\n".join(lines)
 
 
