@@ -329,9 +329,21 @@ def main():
                 med = sorted(times)[len(times) // 2]
                 # device-boundary counters of the LAST warm run: the
                 # dispatch/transfer budget this query actually spent
-                # (engine.last_query_counters — execution/tracing)
+                # (engine.last_query_counters — execution/tracing), including
+                # the per-site attribution + dispatch-latency histogram, plus
+                # a span-tree summary (engine.last_query_trace) — enough to
+                # tell "wedging tunnel" (p99 blown, counts stalled) from
+                # "slow plan" straight from the bench record
                 try:
-                    query_counters[name] = engine.last_query_counters.as_dict()
+                    qc = engine.last_query_counters
+                    query_counters[name] = qc.as_dict()
+                    tr = engine.last_query_trace or {}
+                    query_counters[name]["trace"] = {
+                        "spans": len(tr.get("spans", ())),
+                        "root_span_s": tr.get("root_span_s"),
+                        "dispatch_p50_s": qc.dispatch_latency.quantile(0.5),
+                        "dispatch_p99_s": qc.dispatch_latency.quantile(0.99),
+                    }
                 except Exception:
                     pass
                 print(f"bench: {name} engine cold={cold_s:.2f}s warm={med:.3f}s "

@@ -49,6 +49,11 @@ def main():
                     help="also trace with dispatch_batch=N and print batch=1 "
                          "vs batch=N side by side (coalescing A/B; default: "
                          "trace only the session default)")
+    ap.add_argument("--sites", action="store_true",
+                    help="print each warm query's per-site attribution table "
+                         "(operator/call-site -> dispatches, transfers, "
+                         "bytes) — the breakdown the budget-test docstrings "
+                         "cite when a ceiling regresses")
     args = ap.parse_args()
 
     sf = float(os.environ.get("TRACE_SF", "1"))
@@ -65,8 +70,19 @@ def main():
         for phase in ("cold", "warm"):
             t0 = time.perf_counter()
             engine.execute_sql(QUERIES[name], session)
+            counters = engine.last_query_counters.as_dict()
+            sites = counters.pop("sites", {})
+            counters.pop("dispatch_latency", None)  # histogram: JSON noise here
             out[phase] = {"wall_s": round(time.perf_counter() - t0, 3),
-                          **engine.last_query_counters.as_dict()}
+                          **counters}
+            if args.sites and phase == "warm":
+                print(f"# {name} warm per-site attribution "
+                      "(dispatches/transfers/bytes):", flush=True)
+                for key in sorted(sites, key=lambda k: (
+                        -sites[k]["dispatches"], -sites[k]["bytes"], k)):
+                    s = sites[key]
+                    print(f"#   {key:<44} {s['dispatches']:>4} "
+                          f"{s['transfers']:>4} {s['bytes']:>8}", flush=True)
         return out
 
     if args.batch is None:

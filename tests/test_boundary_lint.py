@@ -19,6 +19,15 @@ proven otherwise — the failure mode this PR's sweep fixed dozens of times
 over (per-column pulls in exchange/serialize/merge paths that never showed on
 the budget).  If your np.asarray really is host-side, say so with the marker;
 if it isn't, batch it through ``_host``.
+
+Round 7 adds the ATTRIBUTION rule over the same files (local_executor.py,
+distributed.py, fte.py, ...): every ``_host(...)`` call must pass a
+``site=`` tag (or carry ``# site-ok: <reason>`` on the call line), and every
+``_jit(...)`` call whose function argument is anonymous (a lambda/closure
+expression) must too — a named function self-labels through ``__name__``.
+Without this, per-site boundary attribution (EXPLAIN ANALYZE's site table,
+the budget-failure dump, /v1/metrics site series) silently rots to
+"untagged" as new call sites land.
 """
 
 import ast
@@ -46,12 +55,22 @@ def _exec_files():
     return files
 
 
+SITE_MARKER = "# site-ok"
+
+# functions whose BODY may call _host/_jit without a site tag (the helpers
+# that thread their caller's site through):
+SITE_ALLOWED_FUNCS = {
+    "_host_page",  # passes its own ``site`` parameter through to _host
+}
+
+
 class _Scan(ast.NodeVisitor):
     def __init__(self, lines):
         self.lines = lines
         self.func_stack = []
         self.jit_hits = []      # (lineno, enclosing function)
         self.asarray_hits = []  # (lineno, enclosing function)
+        self.site_hits = []     # (lineno, enclosing function, callee)
 
     def visit_FunctionDef(self, node):
         self.func_stack.append(node.name)
@@ -60,8 +79,25 @@ class _Scan(ast.NodeVisitor):
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
+    def _check_site(self, node, callee):
+        """_host calls always need site=/marker; _jit calls need one unless
+        the wrapped function is a NAME (self-labeling via __name__)."""
+        if set(self.func_stack) & SITE_ALLOWED_FUNCS:
+            return
+        if any(kw.arg == "site" for kw in node.keywords):
+            return
+        if SITE_MARKER in self.lines[node.lineno - 1]:
+            return
+        if callee == "_jit" and node.args \
+                and isinstance(node.args[0], (ast.Name, ast.Attribute)):
+            return  # named step fn: _jit derives the site from __name__
+        where = self.func_stack[-1] if self.func_stack else "<module>"
+        self.site_hits.append((node.lineno, where, callee))
+
     def visit_Call(self, node):
         f = node.func
+        if isinstance(f, ast.Name) and f.id in ("_jit", "_host"):
+            self._check_site(node, f.id)
         if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
             where = self.func_stack[-1] if self.func_stack else "<module>"
             if f.value.id == "jax" and f.attr == "jit":
@@ -101,6 +137,20 @@ def test_no_loose_np_asarray(path):
           "a host value needs a '# host-ok: <reason>' annotation")
 
 
+@pytest.mark.parametrize("path", _exec_files(), ids=lambda p: p.name)
+def test_every_boundary_call_is_attributed(path):
+    """Every _jit/_host call site carries a site tag (or is self-labeling /
+    explicitly marked), so per-site boundary attribution cannot silently rot
+    back to 'untagged' as new executor code lands."""
+    s = _scan(path)
+    assert not s.site_hits, (
+        f"{path.name}: unattributed boundary call at "
+        + ", ".join(f"line {ln} ({callee} in {fn})"
+                    for ln, fn, callee in s.site_hits)
+        + " — pass site=\"<op.tag>\" (or '# site-ok: <reason>' if the call "
+          "is intentionally untagged); named functions self-label for _jit")
+
+
 def test_lint_catches_violations(tmp_path):
     """The lint must actually flag what it claims to (guards against the
     visitor silently matching nothing after a refactor)."""
@@ -114,7 +164,17 @@ def test_lint_catches_violations(tmp_path):
         "    return jax.jit(fn)\n"
         "def _host(arrays):\n"
         "    return [np.asarray(a) for a in arrays]\n"
-        "ok = np.asarray([1, 2])  # host-ok: literal\n")
+        "ok = np.asarray([1, 2])  # host-ok: literal\n"
+        "def g(x, step):\n"
+        "    a = _host([x])\n"                      # missing site -> flagged
+        "    b = _host([x], site='g.pull')\n"        # tagged -> ok
+        "    c = _host([x])  # site-ok: test\n"      # marked -> ok
+        "    d = _jit(lambda v: v)\n"                # anonymous -> flagged
+        "    e = _jit(step)\n"                       # named -> self-labels
+        "    f2 = _jit(lambda v: v, site='g.step')\n"  # tagged -> ok
+        "    return a, b, c, d, e, f2\n")
     s = _scan(bad)
     assert [ln for ln, _ in s.jit_hits] == [3]
     assert [ln for ln, _ in s.asarray_hits] == [4]
+    assert [(ln, callee) for ln, _, callee in s.site_hits] == \
+        [(11, "_host"), (14, "_jit")]

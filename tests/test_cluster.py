@@ -248,6 +248,52 @@ def test_in_process_worker_roundtrip(tmp_path):
         coord.stop()
 
 
+def test_distributed_query_merges_worker_counters(tmp_path):
+    """Round-7 acceptance: a distributed run reports MERGED coordinator +
+    worker device-boundary counters.  Worker tasks record their own
+    QueryCounters, ship them on the task status response, and the coordinator
+    folds every harvested snapshot (plus its own local spend) into
+    last_query_counters and the engine totals — so distributed queries are no
+    longer invisible to the budget surfaces."""
+    e = _engine()
+    coord = ClusterCoordinator(e, str(tmp_path / "spool"),
+                               heartbeat_interval=0.2)
+    url = coord.start()
+    w = WorkerServer(CATALOGS, str(tmp_path / "spool"), coordinator_url=url,
+                     node_id="inproc")
+    w.start()
+    try:
+        coord.wait_for_workers(1, timeout=60)
+        expected = e.execute_sql(Q9).rows()
+        before = e.counters_total.device_dispatches
+        got = coord.execute_sql(Q9).rows()
+        assert got == expected
+        assert coord.local_fallbacks == 0, coord.last_fallback_error
+        merged = coord.last_query_counters
+        workers = coord._qc_workers
+        # the worker half actually arrived (not just coordinator-local spend)
+        assert workers.device_dispatches > 0, "no worker counters harvested"
+        assert workers.host_bytes_pulled > 0
+        # merged totals = coordinator-local + harvested worker snapshots
+        # (the merge is constructed that way; assert both halves are present
+        # and the engine totals advanced by the merged amount)
+        assert merged.device_dispatches >= workers.device_dispatches
+        assert e.counters_total.device_dispatches - before \
+            == merged.device_dispatches
+        # worker sites flow through the merge with their fte/stream tags
+        assert any(k.startswith(("fte.", "step", "dist."))
+                   or "/" in k for k in merged.sites), merged.sites
+        # worker span trees ride back too (task root + dispatch children)
+        names = {s["name"] for s in coord.last_query_worker_spans}
+        assert "task" in names and "dispatch" in names, names
+        # per-site sums still equal the merged totals after the cluster merge
+        assert sum(v["dispatches"] for v in merged.sites.values()) \
+            == merged.device_dispatches
+    finally:
+        w.stop()
+        coord.stop()
+
+
 def test_speculative_execution_of_stragglers(tmp_path):
     """Once every task is dispatched, a straggler re-dispatches to another
     worker; first-commit-wins dedup makes the duplicate harmless and the

@@ -1,0 +1,370 @@
+"""Query-level profiling: span trees with per-operator device-boundary
+attribution, cluster counter flow surfaces, and dispatch-latency histograms.
+
+What round 7 added on top of the round-6 QueryCounters:
+- every ``_jit`` dispatch / ``_host`` pull carries a call-site tag and lands
+  under the active operator scope -> ``counters.sites`` and the executor's
+  per-node ``boundary`` dict (EXPLAIN ANALYZE attribution);
+- the engine's Tracer is ACTIVATED per statement, so executor internals emit
+  dispatch spans, prefetch-thread spans (explicit cross-thread parent), and
+  exchange-segment spans under the query's root span
+  (``engine.last_query_trace``, ``GET /v1/query/{id}/trace`` OTLP JSON);
+- dispatch wall times feed fixed-bucket histograms (per query + engine
+  totals) exported as a proper Prometheus histogram in ``/v1/metrics``.
+
+The SF1 acceptance tests (warm q3 span tree, warm q9 EXPLAIN ANALYZE
+attribution) live in tests/test_query_budgets.py with the other SF1 runs;
+this module covers the same invariants at test scale plus the HTTP and
+format surfaces.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from trino_tpu.execution.tracing import (LATENCY_BUCKETS_S, LatencyHistogram,
+                                         QueryCounters, Tracer, span_dict,
+                                         spans_to_otlp)
+
+
+# ---------------------------------------------------------------- unit layer
+def test_tracer_explicit_parent_across_threads():
+    """Satellite: thread-local parenting orphaned background-thread spans;
+    ``parent=`` carries the query-thread span across explicitly."""
+    tr = Tracer()
+    out = {}
+    with tr.span("root", trace_id="q") as root:
+        parent = tr.current()
+        assert parent is root
+
+        def worker():
+            with tr.span("bg", parent=parent) as s:
+                out["trace_id"] = s.trace_id
+                out["parent_id"] = s.parent_id
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        # without parent=, the background thread has NO current span -> orphan
+        def orphan():
+            with tr.span("orphan") as s:
+                out["orphan_parent"] = s.parent_id
+
+        t2 = threading.Thread(target=orphan)
+        t2.start()
+        t2.join()
+    assert out["parent_id"] == root.span_id
+    assert out["trace_id"] == "q"  # trace id inherited through the parent
+    assert out["orphan_parent"] is None
+    names = {s.name for s in tr.spans_for("q")}
+    assert names == {"root", "bg"}
+
+
+def test_latency_histogram_buckets_and_quantiles():
+    h = LatencyHistogram()
+    for v in (0.0002, 0.0002, 0.003, 0.2, 20.0):
+        h.record(v)
+    d = h.as_dict()
+    assert d["count"] == 5 and sum(d["buckets"]) == 5
+    assert d["buckets"][-1] == 1  # 20s -> +Inf bucket
+    assert h.quantile(0.5) <= 0.005
+    assert h.quantile(0.99) == LATENCY_BUCKETS_S[-1]
+    # merge_dict (the cluster wire form) preserves totals
+    h2 = LatencyHistogram()
+    h2.merge_dict(d)
+    assert h2.as_dict() == d
+
+
+def test_counters_dict_roundtrip_and_merge():
+    a = QueryCounters()
+    a.device_dispatches = 3
+    a.host_transfers = 2
+    a.host_bytes_pulled = 100
+    a.sites["Agg#0/step"] = {"dispatches": 3, "transfers": 0, "bytes": 0}
+    a.sites["Sort#1/sort.pull"] = {"dispatches": 0, "transfers": 2,
+                                   "bytes": 100}
+    a.dispatch_latency.record(0.01)
+    b = QueryCounters.from_dict(a.as_dict())
+    assert b.as_dict() == a.as_dict()
+    b.merge_dict(a.as_dict())
+    assert b.device_dispatches == 6
+    assert b.sites["Agg#0/step"]["dispatches"] == 6
+    assert b.dispatch_latency.total == 2
+
+
+def test_spans_to_otlp_shape():
+    tr = Tracer()
+    with tr.span("query", trace_id="qx", sql="select 1"):
+        with tr.span("execution"):
+            tr.add_completed("dispatch", 0.005, site="stream.page")
+    payload = spans_to_otlp(tr.spans_for("qx"))
+    spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert {s["name"] for s in spans} == {"query", "execution", "dispatch"}
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["query"]["parentSpanId"] == ""
+    assert by_name["execution"]["parentSpanId"] == \
+        by_name["query"]["spanId"]
+    assert by_name["dispatch"]["parentSpanId"] == \
+        by_name["execution"]["spanId"]
+    for s in spans:
+        assert re.fullmatch(r"[0-9a-f]{32}", s["traceId"])
+        assert re.fullmatch(r"[0-9a-f]{16}", s["spanId"])
+        assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+    # dicts (the worker-span wire form) render identically to Span objects
+    again = spans_to_otlp([span_dict(s) for s in tr.spans_for("qx")])
+    assert again == payload
+
+
+# ---------------------------------------------------------------- engine layer
+QUERY = """select l_returnflag, sum(l_quantity) q, count(*) c
+           from lineitem where l_shipdate <= date '1998-09-02'
+           group by l_returnflag order by l_returnflag"""
+
+
+def test_per_site_sums_equal_totals(engine):
+    s = engine.create_session("tpch")
+    engine.execute_sql(QUERY, s)
+    engine.execute_sql(QUERY, s)  # warm
+    c = engine.last_query_counters
+    assert c.device_dispatches > 0 and c.sites
+    assert sum(v["dispatches"] for v in c.sites.values()) \
+        == c.device_dispatches
+    assert sum(v["transfers"] for v in c.sites.values()) == c.host_transfers
+    assert sum(v["bytes"] for v in c.sites.values()) == c.host_bytes_pulled
+    # every dispatch was timed into the per-query histogram
+    assert c.dispatch_latency.total == c.device_dispatches
+    # attribution keys carry the operator scope ("<Op>#<k>/<site>")
+    assert any("/" in k and "#" in k.split("/")[0] for k in c.sites)
+
+
+def test_span_tree_shape_and_parent_integrity(engine):
+    s = engine.create_session("tpch")
+    # a unique alias makes a fresh plan-cache key: this run is genuinely COLD
+    # even on the shared module engine, so the planner span must appear
+    engine.execute_sql(QUERY.replace("sum(l_quantity) q", "sum(l_quantity) q0"),
+                       s)
+    cold = engine.last_query_trace
+    cold_names = [sp["name"] for sp in cold["spans"]]
+    assert "planner" in cold_names and "query" in cold_names
+    engine.execute_sql(QUERY, s)  # ensure the shared-key plan exists
+    engine.execute_sql(QUERY, s)  # warm: cached plan, execution span present
+    t = engine.last_query_trace
+    names = [sp["name"] for sp in t["spans"]]
+    assert names.count("query") == 1
+    assert "execution" in names
+    assert names.count("dispatch") == engine.last_query_counters \
+        .device_dispatches
+    ids = {sp["span_id"] for sp in t["spans"]}
+    roots = [sp for sp in t["spans"] if sp["parent_id"] is None]
+    assert len(roots) == 1 and roots[0]["name"] == "query"
+    for sp in t["spans"]:
+        if sp["parent_id"] is not None:
+            assert sp["parent_id"] in ids, sp
+        assert sp["end_s"] is not None
+    assert t["root_span_s"] > 0
+
+
+def test_prefetch_spans_parent_across_thread():
+    """The coalescing prefetch producer runs on a background thread; its span
+    must still parent into the query's tree (explicit parent handoff)."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    e = Engine()
+    # small splits -> multi-split scan -> the dispatch-coalescing double
+    # buffer engages its producer thread
+    e.register_catalog("tpch", TpchConnector(sf=0.01, split_rows=1 << 11))
+    s = e.create_session("tpch")
+    e.execute_sql(QUERY, s)
+    e.execute_sql(QUERY, s)
+    qid = e.last_query_trace["query_id"]
+    # the producer's span closes on ITS thread right after the consumer
+    # drains; allow it a beat to land in the tracer
+    spans = []
+    for _ in range(50):
+        spans = e.tracer.spans_for(qid)
+        if any(sp.name == "prefetch" for sp in spans):
+            break
+        time.sleep(0.02)
+    prefetch = [sp for sp in spans if sp.name == "prefetch"]
+    assert prefetch, [sp.name for sp in spans]
+    ids = {sp.span_id for sp in spans}
+    for sp in prefetch:
+        assert sp.parent_id in ids  # NOT an orphan
+        assert sp.attributes.get("pages", 0) > 0
+    e._invalidate()
+
+
+def test_explain_analyze_per_operator_attribution(engine):
+    """Per-node [boundary: ...] rows and per-site lines sum to the query's
+    counter totals (the small-scale version of the SF1 q9 acceptance test in
+    test_query_budgets.py)."""
+    r = engine.execute_sql(f"explain analyze {QUERY}",
+                           engine.create_session("tpch"))
+    text = "\n".join(str(row[0]) for row in r.rows())
+    c = engine.last_query_counters
+    assert "Device boundary:" in text
+    m = re.search(r"Device boundary: (\d+) dispatches, (\d+) host transfers, "
+                  r"(\d+) bytes pulled", text)
+    assert m, text
+    assert (int(m.group(1)), int(m.group(2)), int(m.group(3))) == \
+        (c.device_dispatches, c.host_transfers, c.host_bytes_pulled)
+    sites = re.findall(r"site (\S+): (\d+) dispatches, (\d+) transfers, "
+                       r"(\d+) bytes", text)
+    assert sites, text
+    assert sum(int(d) for _, d, _t, _b in sites) == c.device_dispatches
+    assert sum(int(b) for _, _d, _t, b in sites) == c.host_bytes_pulled
+    # per-operator rows on the plan nodes themselves
+    op_rows = re.findall(r"\[boundary: (\d+) dispatches, (\d+) transfers, "
+                         r"(\d+) bytes\]", text)
+    assert op_rows, text
+
+
+def test_query_completed_event_carries_boundary_profile(engine):
+    from trino_tpu.execution.eventlistener import EventListener
+
+    got = []
+
+    class L(EventListener):
+        def query_completed(self, event):
+            got.append(event)
+
+    listener = L()
+    engine.event_listeners.add(listener)
+    try:
+        s = engine.create_session("tpch")
+        engine.execute_sql("select count(*) from nation", s)
+        ev = got[-1]
+        assert ev.counters is not None
+        assert ev.counters["device_dispatches"] > 0
+        assert ev.counters["sites"]
+        assert ev.root_span_s is not None and ev.root_span_s > 0
+        # a statement that executes no plan leaves counters unset
+        engine.execute_sql("set session dispatch_batch = 2", s)
+        assert got[-1].counters is None
+        assert got[-1].root_span_s is not None
+    finally:
+        engine.event_listeners.listeners.remove(listener)
+
+
+# ---------------------------------------------------------------- HTTP layer
+def _parse_prometheus(body: str) -> dict:
+    """Strict-ish Prometheus text-format parse: every sample line must match
+    the exposition grammar, every sampled metric must have a # TYPE, label
+    values must be quoted/escaped.  Returns {metric: [(labels, value)]}."""
+    types, helps, samples = {}, {}, {}
+    sample_re = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+        r'(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*)\})?'
+        r' (-?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|NaN|[+-]Inf))$')
+    for line in body.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, kind = rest.split()
+            types[name] = kind
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            helps[rest.split()[0]] = rest
+            continue
+        assert not line.startswith("#"), f"unparseable comment: {line!r}"
+        m = sample_re.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name = m.group(1)
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name in types or base in types, \
+            f"sample {name} has no # TYPE"
+        labels = dict(re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"',
+                                 m.group(2) or ""))
+        samples.setdefault(name, []).append((labels, float(m.group(3))))
+    return {"types": types, "helps": helps, "samples": samples}
+
+
+@pytest.fixture()
+def profiling_server(engine):
+    from trino_tpu.server.server import CoordinatorServer
+
+    srv = CoordinatorServer(engine, port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_metrics_histogram_passes_format_check(profiling_server, engine):
+    from trino_tpu.server import Client
+
+    c = Client(profiling_server.url, catalog="tpch")
+    c.execute("select count(*) from nation")
+    body = urllib.request.urlopen(
+        profiling_server.url + "/v1/metrics", timeout=10).read().decode()
+    parsed = _parse_prometheus(body)
+    # HELP/TYPE metadata present (satellite: bare counter lines rejected by
+    # stricter scrapers)
+    assert parsed["types"]["trino_tpu_queries_total"] == "counter"
+    assert "trino_tpu_device_dispatches_total" in parsed["helps"]
+    # the dispatch-latency histogram: TYPE histogram, cumulative buckets
+    # ending at +Inf == _count, _sum present
+    assert parsed["types"]["trino_tpu_dispatch_latency_seconds"] == \
+        "histogram"
+    buckets = parsed["samples"]["trino_tpu_dispatch_latency_seconds_bucket"]
+    assert buckets[-1][0]["le"] == "+Inf"
+    values = [v for _, v in buckets]
+    assert values == sorted(values), "histogram buckets must be cumulative"
+    count = parsed["samples"]["trino_tpu_dispatch_latency_seconds_count"][0][1]
+    assert buckets[-1][1] == count and count > 0
+    assert parsed["samples"]["trino_tpu_dispatch_latency_seconds_sum"][0][1] \
+        >= 0
+    # per-site series carry escaped label values
+    assert any(s[0].get("site")
+               for s in parsed["samples"]
+               .get("trino_tpu_site_dispatches_total", []))
+
+
+def test_label_escaping():
+    from trino_tpu.server.server import CoordinatorServer
+
+    esc = CoordinatorServer._escape_label
+    assert esc('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+
+
+def test_trace_endpoint_round_trip(profiling_server, engine):
+    from trino_tpu.server import Client
+
+    c = Client(profiling_server.url, catalog="tpch")
+    c.execute("select count(*) from region")
+    # find the server-side query id (the most recent FINISHED one)
+    qs = [q for q in profiling_server.queries.values()
+          if q.state == "FINISHED"]
+    qid = sorted(qs, key=lambda q: q.created_at)[-1].query_id
+    payload = json.loads(urllib.request.urlopen(
+        profiling_server.url + f"/v1/query/{qid}/trace",
+        timeout=10).read().decode())
+    spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    names = {s["name"] for s in spans}
+    assert "query" in names and "dispatch" in names
+    roots = [s for s in spans if s["parentSpanId"] == ""]
+    assert len(roots) == 1 and roots[0]["name"] == "query"
+    # unknown id -> 404
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(
+            profiling_server.url + "/v1/query/nope/trace", timeout=10)
+    assert exc.value.code == 404
+
+
+def test_engine_query_id_trace_lookup(profiling_server, engine):
+    """The trace endpoint also resolves ENGINE query ids (query_N) straight
+    from the live tracer — the embedded-engine escape hatch."""
+    s = engine.create_session("tpch")
+    engine.execute_sql("select count(*) from region", s)
+    qid = engine.last_query_trace["query_id"]
+    payload = json.loads(urllib.request.urlopen(
+        profiling_server.url + f"/v1/query/{qid}/trace",
+        timeout=10).read().decode())
+    spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert any(s["name"] == "query" for s in spans)

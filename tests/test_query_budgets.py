@@ -101,6 +101,17 @@ def sf1(request):
     engine._invalidate()
 
 
+def _sites_table(c) -> str:
+    """Per-site attribution dump for budget-failure messages: a tripped
+    ceiling names the exact operator/call-site that regressed (re-derive with
+    scripts/query_counters.py --sites)."""
+    rows = sorted(c.sites.items(),
+                  key=lambda kv: (-kv[1]["dispatches"], -kv[1]["bytes"]))
+    return "\n".join(f"  {k}: {v['dispatches']} dispatches, "
+                     f"{v['transfers']} transfers, {v['bytes']} bytes"
+                     for k, v in rows)
+
+
 @pytest.mark.parametrize("name", sorted(BUDGETS))
 def test_warm_query_stays_within_budget(sf1, name):
     engine, session = sf1
@@ -113,10 +124,78 @@ def test_warm_query_stays_within_budget(sf1, name):
     assert c.device_dispatches > 0 and c.host_transfers > 0, c
     assert c.device_dispatches <= max_disp, (
         f"{name}: {c.device_dispatches} warm device dispatches > budget "
-        f"{max_disp} — a per-page/per-split dispatch crept into the warm path")
+        f"{max_disp} — a per-page/per-split dispatch crept into the warm "
+        f"path; per-site attribution:\n{_sites_table(c)}")
     assert c.host_bytes_pulled <= max_bytes, (
         f"{name}: {c.host_bytes_pulled} warm host bytes > budget {max_bytes} "
-        f"— a bulk device->host pull crept into the warm path")
+        f"— a bulk device->host pull crept into the warm path; per-site "
+        f"attribution:\n{_sites_table(c)}")
+
+
+def test_warm_q3_span_tree(sf1):
+    """Round-7 acceptance: the warm SF1 q3 span tree — one root, an execution
+    span, one dispatch span per counted dispatch, and prefetch-thread spans
+    that parent INTO the tree (explicit cross-thread handoff; they were
+    orphans when parenting was thread-local)."""
+    import time as _time
+
+    engine, session = sf1
+    engine.execute_sql(QUERIES["q3"], session)  # plan cache warm (cheap if
+    engine.execute_sql(QUERIES["q3"], session)  # the budget tests ran first)
+    c = engine.last_query_counters
+    t = engine.last_query_trace
+    qid = t["query_id"]
+    names = [sp["name"] for sp in t["spans"]]
+    roots = [sp for sp in t["spans"] if sp["parent_id"] is None]
+    assert len(roots) == 1 and roots[0]["name"] == "query"
+    assert "execution" in names
+    assert names.count("dispatch") == c.device_dispatches
+    # per-site sums == totals (the attribution invariant)
+    assert sum(v["dispatches"] for v in c.sites.values()) \
+        == c.device_dispatches
+    assert sum(v["bytes"] for v in c.sites.values()) == c.host_bytes_pulled
+    # prefetch spans land slightly after the query returns (producer-thread
+    # close): poll the tracer, then check parents resolve inside the trace
+    spans = engine.tracer.spans_for(qid)
+    for _ in range(50):
+        spans = engine.tracer.spans_for(qid)
+        if any(sp.name == "prefetch" for sp in spans):
+            break
+        _time.sleep(0.02)
+    prefetch = [sp for sp in spans if sp.name == "prefetch"]
+    assert prefetch, \
+        f"no prefetch span in {sorted({s.name for s in spans})}"
+    ids = {sp.span_id for sp in spans}
+    for sp in prefetch:
+        assert sp.parent_id in ids, "prefetch span is an orphan"
+
+
+def test_explain_analyze_q9_per_operator_attribution(sf1):
+    """Round-7 acceptance: EXPLAIN ANALYZE on warm SF1 q9 shows per-operator
+    and per-site dispatch/byte attribution whose sums equal the query's
+    QueryCounters totals exactly."""
+    import re
+
+    engine, session = sf1
+    r = engine.execute_sql(f"explain analyze {QUERIES['q9']}", session)
+    text = "\n".join(str(row[0]) for row in r.rows())
+    c = engine.last_query_counters
+    m = re.search(r"Device boundary: (\d+) dispatches, (\d+) host transfers, "
+                  r"(\d+) bytes pulled", text)
+    assert m, text
+    assert (int(m.group(1)), int(m.group(2)), int(m.group(3))) == \
+        (c.device_dispatches, c.host_transfers, c.host_bytes_pulled), text
+    # per-site lines sum to the totals
+    sites = re.findall(r"site (\S+): (\d+) dispatches, (\d+) transfers, "
+                       r"(\d+) bytes", text)
+    assert sites, text
+    assert sum(int(d) for _, d, _t, _b in sites) == c.device_dispatches, text
+    assert sum(int(b) for _, _d, _t, b in sites) == c.host_bytes_pulled, text
+    # per-operator rows attribute the join/aggregate pipeline itself
+    op_rows = re.findall(r"\[boundary: (\d+) dispatches, (\d+) transfers, "
+                         r"(\d+) bytes\]", text)
+    assert op_rows, text
+    assert sum(int(d) for d, _t, _b in op_rows) > 0
 
 
 def test_explain_analyze_shows_device_boundary(engine):

@@ -67,7 +67,11 @@ def test_query_info(coordinator):
 
     c = Client(coordinator.url, catalog="tpch")
     c.execute("select 1 as one from region limit 1")
-    qid = sorted(coordinator.queries)[-1]
+    # newest by creation time, NOT string order: query ids are a process-wide
+    # sequence ("q9" > "q10" lexically), so the string sort picks a stale —
+    # possibly FAILED — query once the module's ids cross a digit boundary
+    qid = max(coordinator.queries.values(),
+              key=lambda q: q.created_at).query_id
     with urllib.request.urlopen(f"{coordinator.url}/v1/query/{qid}") as resp:
         info = json.loads(resp.read())
     assert info["state"] == "FINISHED"

@@ -150,7 +150,8 @@ def _has_duplicate_keys(build_page: Page, key_channels, key_types) -> bool:
     building a throwaway device hash table just to read its dup counter)."""
     nms = [build_page.null_masks[ch] for ch in key_channels
            if build_page.null_masks[ch] is not None]
-    got = _host([build_page.valid_mask()] + nms)  # one batched pull
+    got = _host([build_page.valid_mask()] + nms,
+                site="dist.build.dupcheck")  # one batched pull
     valid = got[0]
     for nm in got[1:]:
         valid = valid & ~nm
@@ -159,7 +160,7 @@ def _has_duplicate_keys(build_page: Page, key_channels, key_types) -> bool:
         return False
     keys = tuple(build_page.columns[ch] for ch in key_channels)
     packed, exact = pack_keys(keys, key_types)
-    vals = _host([packed])[0][valid]
+    vals = _host([packed], site="dist.build.dupcheck")[0][valid]
     # for inexact (fingerprint) packing a hash collision reads as a duplicate, which
     # is the conservative direction: the caller falls back to the general path
     return len(np.unique(vals)) < n
@@ -301,7 +302,7 @@ class _HostFedBatches:
             if p.valid is not None:
                 flat.append(p.valid)
             layout.append((len(p.columns), nm_idx, p.valid is not None))
-        got = _host(flat)
+        got = _host(flat, site="dist.hostfed.pull")
         hpages, pos = [], 0
         for (ncols, nm_idx, has_valid), p in zip(layout, pages):
             pcols = got[pos:pos + ncols]
@@ -399,7 +400,8 @@ def _page_from_shards(schema, cols_g, nulls_g, counts):
     contributes its counts[w] head rows, workers concatenated in mesh order."""
     W = len(counts)
     out_cols, out_nulls = [], []
-    got = _host(list(cols_g) + list(nulls_g))  # one batched shard pull
+    got = _host(list(cols_g) + list(nulls_g),
+                site="dist.shards.pull")  # one batched shard pull
     for a_np in got[:len(cols_g)]:
         out_cols.append(np.concatenate([a_np[w][:counts[w]] for w in range(W)]))
     for m_np in got[len(cols_g):]:
@@ -456,16 +458,26 @@ class DistributedExecutor:
         self._build_cache: dict = {}
         self.exec_trace: list = []
         self._decline_reason = None
+        # per-query device-boundary counters: mesh dispatches/pulls record
+        # exactly like the local executor's so distributed EXPLAIN ANALYZE and
+        # the engine totals see the SPMD half too (sites carry dist.* tags)
+        from ..execution.tracing import QueryCounters
+
+        self.counters = QueryCounters()
 
     # ------------------------------------------------------------------ public
     def execute(self, node: P.PlanNode) -> MaterializedResult:
+        from ..execution import tracing
+
         self._build_cache = {}
         self.exec_trace = []  # [(node label, mode, reason)] — runtime truth of
         # which fragments ran on the mesh vs fell back (VERDICT r3 weak #3:
         # silent local fallback); EXPLAIN ANALYZE prints it
         self._decline_reason = None
-        page, dicts = self._execute_to_page(node)
-        return _materialize(page, dicts)
+        self.counters.reset()
+        with tracing.track_counters(self.counters):
+            page, dicts = self._execute_to_page(node)
+            return _materialize(page, dicts)
 
     def _decline(self, node, reason: str):
         """Record why a fragment cannot compile for the mesh (deepest cause
@@ -708,7 +720,8 @@ class DistributedExecutor:
             # DetermineJoinDistributionType) decides when present; AUTOMATIC
             # plans ('replicated' hint) fall back to the actual build size
             n_build = int(_host([jnp.sum(build_page.valid_mask(),
-                                         dtype=jnp.int64)])[0])
+                                         dtype=jnp.int64)],
+                                site="dist.join.buildsize")[0])
             hint = getattr(node, "distribution", "replicated")
             partitioned = (hint == "partitioned"
                            or (hint != "broadcast"
@@ -914,7 +927,7 @@ class DistributedExecutor:
         cap_r = max(1 << max(2 * chunk - 1, 1).bit_length(), 32)
         while True:
             fn = partial(build_exchange, cap_r=cap_r)
-            table_g = _jit(
+            table_g = _jit(site="dist.join.build_exchange", fn=
                 shard_map(
                     lambda bc, bn, bv: jax.tree.map(
                         lambda x: None if x is None else x[None],
@@ -923,7 +936,8 @@ class DistributedExecutor:
                         is_leaf=lambda x: x is None),
                     mesh=mesh, in_specs=(PS(WORKER_AXIS),) * 3,
                     out_specs=PS(WORKER_AXIS)))(bcols_g, bnulls_g, bvalid_g)
-            if not bool(np.any(_host([table_g.overflow])[0])):
+            if not bool(np.any(_host([table_g.overflow],
+                                     site="dist.join.overflow")[0])):
                 break
             cap_r *= 4
         return table_g
@@ -1069,7 +1083,8 @@ class DistributedExecutor:
         c0, n0, v0, of0 = _jit(sample)(
             jax.device_put(stream.scan_lo_batches[0], sharded), stream.aux)
         got = _host(list(c0) + list(n0) + [v0, of0]
-                    + ([luts[ch]] if ch in luts else []))
+                    + ([luts[ch]] if ch in luts else []),
+                    site="dist.sort.sample")
         if bool(np.any(got[len(c0) + len(n0) + 1])):
             return None, True
         cols0 = [c.reshape(-1) for c in got[:len(c0)]]
@@ -1269,7 +1284,8 @@ class DistributedExecutor:
         for lo in stream.scan_lo_batches[skip_batches:]:
             rcols, rnulls, rvalid, of = step(
                 jax.device_put(lo, sharded), stream.aux, route_aux)
-            got = _host(list(rcols) + list(rnulls) + [rvalid, of])
+            got = _host(list(rcols) + list(rnulls) + [rvalid, of],
+                        site="dist.exchange.collect")
             if bool(np.any(got[-1])):
                 return None
             v = got[-2]
@@ -1342,7 +1358,8 @@ class DistributedExecutor:
         for lo in stream.scan_lo_batches:
             state = step(state, jax.device_put(lo, sharded), stream.aux, luts_t)
 
-        got = _host(list(state[0]) + list(state[1]) + [state[2], state[3]])
+        got = _host(list(state[0]) + list(state[1])
+                    + [state[2], state[3]], site="dist.topn.states")
         oflow = bool(np.any(got[-1]))
         # host merge: W*k candidate rows -> final top-k (ordered merge stage)
         nc = len(state[0])
@@ -1420,10 +1437,12 @@ class DistributedExecutor:
                 state, of_acc = step(state, of_acc, jax.device_put(lo, sharded),
                                      stream.aux)
 
-            if bool(np.any(_host([of_acc])[0])):
+            if bool(np.any(_host([of_acc],
+                                 site="dist.agg.overflow")[0])):
                 return None, True  # exchange bucket overflow: ladder retry
             merged = self._merge_states(state, key_types, acc_specs, merge_kinds, capacity)
-            of2 = _host([merged.overflow, state.overflow])
+            of2 = _host([merged.overflow, state.overflow],
+                        site="dist.agg.overflow")
             overflow = bool(np.any(of2[0])) or bool(np.any(of2[1]))
             if not overflow or capacity >= MAX_GROUP_CAPACITY:
                 break
@@ -1431,7 +1450,8 @@ class DistributedExecutor:
 
         # concat per-worker final partitions on host
         got = _host([merged.table] + list(merged.key_cols)
-                    + list(merged.accs))  # one batched table pull
+                    + list(merged.accs),
+                    site="dist.agg.groups")  # one batched table pull
         table_np = got[0]  # [W, C+1]
         occ = table_np[:, :capacity] != EMPTY_KEY
         nk = len(merged.key_cols)
@@ -1555,7 +1575,8 @@ class DistributedExecutor:
         for lo in stream.scan_lo_batches:
             state = step(state, jax.device_put(lo, sharded), stream.aux)
 
-        got = _host(list(state))  # one batched pull of the W-scalar states
+        got = _host(list(state),
+                    site="dist.agg.states")  # one batched pull
         if bool(np.any(got[-1])):
             return None, True  # exchange bucket overflow: ladder retry
         # cross-worker combine on host (W scalars)
@@ -1595,7 +1616,8 @@ class DistributedExecutor:
         oflow = False
         for lo in stream.scan_lo_batches:
             cols, nulls, valid, of = run(jax.device_put(lo, sharded), stream.aux)
-            got = _host(list(cols) + list(nulls) + [valid, of])
+            got = _host(list(cols) + list(nulls) + [valid, of],
+                        site="dist.stream.collect")
             oflow = oflow or bool(np.any(got[-1]))
             if oflow:
                 return None, True  # exchange bucket overflow: ladder retry

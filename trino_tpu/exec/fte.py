@@ -32,6 +32,7 @@ from typing import Optional
 import jax.numpy as jnp
 import numpy as np
 
+from ..execution import tracing
 from ..ops import hashagg
 from ..page import Page, Schema
 from ..sql import plan as P
@@ -115,7 +116,8 @@ def serialize_page(columns: list, null_masks: list,
     arrays = {}
     # ONE batched device->host pull for the whole page (serialization is a
     # transfer chokepoint on tunneled links, and it must show on the counters)
-    host = _host(list(columns) + [m for m in null_masks if m is not None])
+    host = _host(list(columns) + [m for m in null_masks if m is not None],
+                 site="fte.serialize")
     hcols, rest = host[:len(columns)], host[len(columns):]
     for i, c in enumerate(hcols):
         arrays[f"c{i}"] = c
@@ -332,6 +334,7 @@ class FaultTolerantExecutor:
                 os.path.join(self.spool_dir, f"exchange_{self._exchange_seq}"))
             try:
                 self.local.stats = {}
+                self.local.boundary = {}
                 self._exec_ft(plan)
                 page, dd = self.local._execute_to_page(plan)
                 return _materialize(page, dd)
@@ -607,7 +610,8 @@ def _serialize_partial_state(node, state, nk) -> bytes:
     n_groups = int(hashagg.group_count(state))
     bucket = max(1 << max(n_groups - 1, 1).bit_length(), 64)
     keys, key_nulls, accs = hashagg.compact_groups(state, bucket)
-    got = _host(list(keys) + list(key_nulls) + list(accs))
+    got = _host(list(keys) + list(key_nulls) + list(accs),
+                site="fte.partial.groups")
     cols = [g[:n_groups] for g in got[:nk]] + [g[:n_groups] for g in got[2 * nk:]]
     nulls = [g[:n_groups] for g in got[nk:2 * nk]] + [None] * len(accs)
     nulls = [n if (n is not None and n.any()) else None for n in nulls]
@@ -689,7 +693,9 @@ def read_fragment_outputs(exchange: SpoolingExchange, task_ids, schema):
             np.empty((0,), np.dtype(f.type.dtype))) for f in schema.fields)
         return (Page(schema, cols, tuple(None for _ in cols), None),
                 tuple(None for _ in range(ncols)))
-    parts = [deserialize_fragment_output(exchange.read(t)) for t in task_ids]
+    with tracing.maybe_span("exchange.read", tasks=len(task_ids)):
+        parts = [deserialize_fragment_output(exchange.read(t))
+                 for t in task_ids]
     cols, nulls = concat_host_chunks(schema, [(p[0], p[1]) for p in parts])
     return padded_page(schema, cols, nulls), parts[0][2]
 
@@ -705,8 +711,14 @@ def read_streamed_outputs(fetch_stream, task_ids, schema):
     ncols = len(schema.fields)
     parts = []
     for t in task_ids:
-        for chunk in fetch_stream(t):
-            parts.append(deserialize_fragment_output(chunk))
+        # one span per exchange stream segment (a producing task's page
+        # stream): on a distributed profile this is where worker->worker
+        # pipelining time lives, distinct from device dispatches
+        with tracing.maybe_span("exchange.stream", task=str(t)) as sp:
+            n0 = len(parts)
+            for chunk in fetch_stream(t):
+                parts.append(deserialize_fragment_output(chunk))
+            sp.attributes["pages"] = len(parts) - n0
     if not parts:
         cols = tuple(jnp.asarray(
             np.empty((0,), np.dtype(f.type.dtype))) for f in schema.fields)
@@ -789,7 +801,8 @@ def run_stream_splits(local: LocalExecutor, node, exchange_dir: str,
             page = si.conn.generate(split, list(si.scan_columns))
             cols, nulls, valid = jitted(page)
             got = _host([valid] + list(cols)
-                        + [n for n in nulls if n is not None])
+                        + [n for n in nulls if n is not None],
+                        site="fte.stream.split")
             v = got[0]
             ncols = len(cols)
             ccols = [c[v] for c in got[1:1 + ncols]]
@@ -860,7 +873,8 @@ def _merge_partial_cols(node, key_types, acc_specs, acc_kinds, payloads):
     n_groups = int(hashagg.group_count(state))
     bucket = max(1 << max(n_groups - 1, 1).bit_length(), 64)
     keys, key_nulls, accs = hashagg.compact_groups(state, bucket)
-    got = _host(list(keys) + list(key_nulls) + list(accs))
+    got = _host(list(keys) + list(key_nulls) + list(accs),
+                site="fte.merge.groups")
     key_cols = [k[:n_groups] for k in got[:nk]]
     key_null_cols = [kn[:n_groups] for kn in got[nk:2 * nk]]
     acc_cols = [a[:n_groups] for a in got[2 * nk:]]

@@ -42,18 +42,30 @@ from ..sql.ir import Call, Constant, Expr, FieldRef, evaluate, evaluate_predicat
 __all__ = ["LocalExecutor", "MaterializedResult"]
 
 
-def _jit(fn, **kwargs):
+def _jit(fn, site=None, **kwargs):
     """``jax.jit`` + per-query dispatch accounting: every invocation of the
     compiled function records one device dispatch on the active query's
     counters (execution/tracing.QueryCounters).  On tunneled devices each
     dispatch is a host round-trip, so this count IS the latency budget the
-    warm-query tests pin.  ``__wrapped__`` stays the original python function
-    (callers use it to run the step eagerly for untraceable object columns)."""
+    warm-query tests pin.  ``site`` labels the call site for per-site
+    attribution (defaults to the wrapped function's name — bare ``@_jit`` on a
+    named step function self-labels; lambdas must pass ``site=``, enforced by
+    tests/test_boundary_lint.py); each invocation's wall time also feeds the
+    per-query + engine-total dispatch-latency histograms.  ``__wrapped__``
+    stays the original python function (callers use it to run the step eagerly
+    for untraceable object columns)."""
+    import time as _time
+
     compiled = jax.jit(fn, **kwargs)
+    label = site or getattr(fn, "__name__", "jit")
 
     def run(*args, **kw):
-        tracing.record_dispatch()
-        return compiled(*args, **kw)
+        t0 = _time.perf_counter()
+        try:
+            return compiled(*args, **kw)
+        finally:
+            tracing.record_dispatch(site=label,
+                                    seconds=_time.perf_counter() - t0)
 
     run.__wrapped__ = getattr(compiled, "__wrapped__", fn)
     return run
@@ -266,7 +278,8 @@ class _Stream:
         repeated executions of a cached plan reuse the XLA executable."""
         if self._jitted is None:
             f = _jit(lambda page, aux: self.transform(
-                page.columns, page.null_masks, page.valid_mask(), aux))
+                page.columns, page.null_masks, page.valid_mask(), aux),
+                site="stream.page")
 
             def run(page, f=f):
                 if any(isinstance(c, np.ndarray) and c.dtype == object
@@ -299,7 +312,7 @@ class _Stream:
         shaped groups would retrace per arity and multiply cold compiles)."""
         if self._batch_jitted is None:
             f = _jit(lambda pages, live, aux: self.transform(
-                *_stack_pages(pages, live), aux))
+                *_stack_pages(pages, live), aux), site="stream.batch")
 
             def run(pages, live, f=f):
                 return f(tuple(pages), live, self.aux)
@@ -334,6 +347,13 @@ class LocalExecutor:
         # per-query device-boundary counters (reset at execute()): dispatches
         # + host pulls recorded via execution/tracing while this executor runs
         self.counters = tracing.QueryCounters()
+        # per-operator boundary attribution (reset at execute()): id(node) ->
+        # {"label", "dispatches", "transfers", "bytes"}, plus a "result" entry
+        # for the final materialization pull.  Innermost-scope-wins, so the
+        # per-operator sums equal the query's counter totals exactly —
+        # EXPLAIN ANALYZE renders these beside the per-node stats
+        self.boundary: dict = {}
+        self._op_labels: dict = {}  # id(node) -> stable "<Type>#<k>" label
         # node-result substitutions: id(node) -> (Page, dicts).  The FTE
         # executor installs durable (spooled) fragment outputs here so the
         # remainder of the plan consumes them instead of re-executing the
@@ -350,6 +370,20 @@ class LocalExecutor:
         if b is None or int(b) <= 0:
             return _dispatch_batch_default()
         return int(b)
+
+    def _rewrap_pruned_pages(self, pages_fn, conn, n_splits: int):
+        """Re-apply the scan's prefetch policy to a pruner-replaced page
+        source: split pruning builds a bare generator, losing whichever wrap
+        the TableScan compiled with.  HOST_DECODE connectors prefetch
+        regardless of batch width (host decode must overlap device compute);
+        device generators get the coalescing double buffer when multi-split
+        and coalescing is on."""
+        if conn is not None and getattr(conn, "HOST_DECODE", False):
+            return _prefetched_pages(pages_fn, to_device=True)
+        if n_splits > 1 and self._batch() > 1:
+            return _prefetched_pages(pages_fn, depth=self._batch(),
+                                     to_device=True, warmup=2)
+        return pages_fn
 
     def forget_plan(self, plan: P.PlanNode) -> None:
         """Evict compiled artifacts for a plan the engine is replacing (its
@@ -382,10 +416,31 @@ class LocalExecutor:
     # ------------------------------------------------------------------ public
     def execute(self, node: P.PlanNode) -> MaterializedResult:
         self.stats = {}
+        self.boundary = {}
+        self._op_labels = {}
         self.counters.reset()
         with tracing.track_counters(self.counters):
             page, dicts = self._execute_to_page(node)
-            return _materialize(page, dicts)
+            # the result pull is real boundary spend outside any plan node:
+            # attribute it to a synthetic "Result" operator so the per-op sums
+            # still equal the query totals
+            with tracing.operator_scope("Result",
+                                        self._boundary_sink("result", "Result")):
+                return _materialize(page, dicts)
+
+    def _op_label(self, node) -> str:
+        lbl = self._op_labels.get(id(node))
+        if lbl is None:
+            lbl = f"{type(node).__name__}#{len(self._op_labels)}"
+            self._op_labels[id(node)] = lbl
+        return lbl
+
+    def _boundary_sink(self, key, label: str) -> dict:
+        sink = self.boundary.get(key)
+        if sink is None:
+            sink = self.boundary[key] = {"label": label, "dispatches": 0,
+                                         "transfers": 0, "bytes": 0}
+        return sink
 
     def _record(self, node, page, t0) -> None:
         """Blocking-operator stats (reference: OperatorStats via OperationTimer,
@@ -403,13 +458,25 @@ class LocalExecutor:
 
     # ---------------------------------------------------------------- internal
     def _execute_to_page(self, node: P.PlanNode):
-        """Run a (sub)plan to completion, returning one host-side Page + dicts."""
-        import time as _time
-
+        """Run a (sub)plan to completion, returning one host-side Page + dicts.
+        Every dispatch/pull recorded while a node executes attributes to that
+        node's boundary record (innermost blocking operator wins — streaming
+        chains charge the sink that drives them, the same pipeline-breaker
+        granularity as ``stats``)."""
         if self._overrides:
             hit = self._overrides.get(id(node))
             if hit is not None:
                 return hit
+        label = self._op_label(node)
+        with tracing.operator_scope(label,
+                                    self._boundary_sink(id(node), label)):
+            return self._execute_node(node)
+
+    def _execute_node(self, node: P.PlanNode):
+        # (no overrides check here: _execute_to_page, the only caller, already
+        # returned any override hit before opening the operator scope)
+        import time as _time
+
         t0 = _time.perf_counter()
         if isinstance(node, P.Output):
             child, dicts = self._execute_to_page(node.child)
@@ -597,7 +664,9 @@ class LocalExecutor:
         if isinstance(node, P.TableScan):
             conn = self.catalogs[node.catalog]
             dicts = tuple(conn.dictionaries(node.table).get(c) for c in node.columns)
-            splits = conn.splits(node.table)
+            with tracing.maybe_span("split-generation", table=node.table) as sp:
+                splits = conn.splits(node.table)
+                sp.attributes["splits"] = len(splits)
 
             def pages(conn=conn, splits=splits, node=node):
                 for s in splits:
@@ -648,6 +717,15 @@ class LocalExecutor:
                 return cols, nulls, evaluate_predicate(pred, cols, nulls, valid)
 
             pruned = _static_pruned_stream(up, pred)
+            if pruned is not None:
+                # the pruner replaces the scan's prefetched generator
+                # wholesale: restore the wrap the TableScan compiled with —
+                # HOST_DECODE sources prefetch unconditionally (decode
+                # overlap), device generators get the coalescing double
+                # buffer when multi-split
+                pruned = (self._rewrap_pruned_pages(pruned[0], pruned[1].conn,
+                                                    len(pruned[1].splits)),
+                          pruned[1])
             pages, si = pruned if pruned is not None else (up.pages, up.scan_info)
             tsrc = up.traced_src
             if pruned is not None and tsrc is not None:
@@ -1067,7 +1145,8 @@ class LocalExecutor:
                 0, jnp.maximum(counts - 1, 0))
             tgt = jnp.clip(tgt, 0, n - 1)
             got = _host([v[idx][tgt], counts]
-                        + key_fetches(sk, skn, starts))
+                        + key_fetches(sk, skn, starts),
+                        site="agg.sorted.select")
             vals = got[0]
             out_null = got[1] == 0
             gkeys, gknulls = host_group_keys(got, 2, sk, skn, starts)
@@ -1104,7 +1183,9 @@ class LocalExecutor:
                 return gk, gn, np.zeros((0,), np.int32), \
                     np.ones((0,), bool), \
                     Dictionary(values=np.array([], dtype=object))
-            got = _host([v[idx], vnull[idx]] + key_fetches(sk, skn, starts))
+            got = _host([v[idx], vnull[idx]]
+                        + key_fetches(sk, skn, starts),
+                        site="agg.sorted.fetch")
             sval_np, svnull_np = got[0], got[1]
             gkeys, gknulls = host_group_keys(got, 2, sk, skn, starts)
             joined, out_null = [], np.zeros(g, bool)
@@ -1141,7 +1222,9 @@ class LocalExecutor:
                     MapData(np.zeros((0,), np.dtype(v.dtype)),
                             np.zeros((0,), np.int64),
                             spec.arg.type, BIGINT, key_dict=d)
-            got = _host([v[idx], vnull[idx]] + key_fetches(sk, skn, starts))
+            got = _host([v[idx], vnull[idx]]
+                        + key_fetches(sk, skn, starts),
+                        site="agg.sorted.fetch")
             sval_np, svnull_np = got[0], got[1]
             gkeys, gknulls = host_group_keys(got, 2, sk, skn, starts)
             key_heap, cnt_heap, spans = [], [], np.zeros(g, np.int64)
@@ -1196,13 +1279,16 @@ class LocalExecutor:
             # ONE batched sync for both scalars (each bare int() pays a
             # device->host RTT on tunneled links)
             mg = _host([jnp.sum(valid, dtype=jnp.int64),
-                        jnp.sum(new_group, dtype=jnp.int64)])
+                        jnp.sum(new_group, dtype=jnp.int64)],
+                       site="agg.sorted.counts")
             m = int(mg[0])
             g = int(mg[1]) if key_chs else (1 if m else 0)
             if g == 0:
                 return (idx, sk, skn, np.zeros(0, np.int64),
                         np.zeros(0, np.int64), m, 0)
-            starts = _host([jnp.nonzero(new_group, size=g, fill_value=n)[0]])[0]
+            starts = _host([jnp.nonzero(new_group, size=g,
+                                        fill_value=n)[0]],
+                           site="agg.sorted.starts")[0]
             ends = np.concatenate([starts[1:], [m]])
             return idx, sk, skn, starts, ends, m, g
 
@@ -1258,7 +1344,8 @@ class LocalExecutor:
             fetch = [pl[tgt], counts]
             if pn0 is not None:
                 fetch.append(pn0[idx][tgt])
-            got = _host(fetch + key_fetches(sk, skn, starts))
+            got = _host(fetch + key_fetches(sk, skn, starts),
+                        site="agg.sorted.fetch")
             vals = got[0]
             out_null = got[1] == 0
             ofs = 2
@@ -1289,7 +1376,9 @@ class LocalExecutor:
                 gk, gn = empty_keys()
                 return gk, gn, np.zeros((0,), np.int64), \
                     np.zeros((0,), bool), empty
-            got = _host([v[idx], vnull[idx]] + key_fetches(sk, skn, starts))
+            got = _host([v[idx], vnull[idx]]
+                        + key_fetches(sk, skn, starts),
+                        site="agg.sorted.fetch")
             sval_np, svnull_np = got[0], got[1]
             gkeys, gknulls = host_group_keys(got, 2, sk, skn, starts)
             heap, spans = [], np.zeros(g, np.int64)
@@ -1336,7 +1425,8 @@ class LocalExecutor:
             fetch = [kcol[idx], knull[idx], vcol]
             if vn0 is not None:
                 fetch.append(vn0[idx])
-            got = _host(fetch + key_fetches(sk, skn, starts))
+            got = _host(fetch + key_fetches(sk, skn, starts),
+                        site="agg.sorted.fetch")
             skey, sknull, sval = got[0], got[1], got[2]
             ofs = 3
             if vn0 is not None:
@@ -1390,7 +1480,9 @@ class LocalExecutor:
             if g == 0:
                 gk, gn = empty_keys()
                 return gk, gn, np.zeros((0,), np.int64), np.zeros((0,), bool)
-            got = _host([v[idx], vnull[idx]] + key_fetches(sk, skn, starts))
+            got = _host([v[idx], vnull[idx]]
+                        + key_fetches(sk, skn, starts),
+                        site="agg.sorted.fetch")
             sval_np, svnull_np = got[0], got[1]
             gkeys, gknulls = host_group_keys(got, 2, sk, skn, starts)
             vals = np.zeros(g, np.int64)
@@ -1467,7 +1559,8 @@ class LocalExecutor:
         state = run(_global_init_state(node), los, auxes)
         # ONE batched pull for every accumulator scalar (serial np.asarray
         # would pay one RTT per accumulator on tunneled links)
-        acc_cols = [a[None] for a in _host(list(state))]
+        acc_cols = [a[None] for a in _host(list(state),
+                                           site="agg.global.accs")]
         out_cols, out_nulls = _finalize_aggs(node.aggs, acc_cols, 1)
         arrays = [np.asarray(c) for c in out_cols]  # host-ok: post-_host finalize
         page = Page(node.schema, tuple(arrays), tuple(out_nulls), None)
@@ -1670,7 +1763,8 @@ class LocalExecutor:
         def drain(state):
             if not staged:
                 return state, False
-            counts = [int(c) for c in _host([st[-1] for st in staged])]
+            counts = [int(c) for c in _host([st[-1] for st in staged],
+                                            site="agg.stream.counts")]
             while True:
                 # snapshot-and-replay growth (reference: FlatHash#rehash): jax
                 # arrays are immutable, so the pre-chunk state is a free snapshot;
@@ -1849,7 +1943,8 @@ class LocalExecutor:
             self._agg_cache[("devfin", id(node))] = (node, None)
             return None
         fin = _jit(lambda accs, aggs=node.aggs:
-                      _finalize_aggs_device(aggs, accs))
+                      _finalize_aggs_device(aggs, accs),
+                   site="agg.finalize")
         self._agg_cache[("devfin", id(node))] = (node, fin)
         return fin
 
@@ -1881,7 +1976,8 @@ class LocalExecutor:
                 page = Page(node.schema, out_cols, out_nulls, None)
                 return page, dicts
 
-        got = _host(list(keys) + list(key_nulls) + list(accs))
+        got = _host(list(keys) + list(key_nulls) + list(accs),
+                    site="agg.groups")
         key_cols = [k[:n_groups] for k in got[:nk]]
         key_null_cols = [kn[:n_groups] for kn in got[nk:2 * nk]]
         acc_cols = [a[:n_groups] for a in got[2 * nk:]]
@@ -1972,7 +2068,7 @@ class LocalExecutor:
         for p in pages_out:
             flat.extend(p.columns)
             flat.extend(p.null_masks)
-        flat = _host(flat)
+        flat = _host(flat, site="agg.stream.pull")
         w = len(node.schema.fields)
         host_pages = []
         for pi in range(len(pages_out)):
@@ -2044,7 +2140,8 @@ class LocalExecutor:
         # ONE batched pull for every accumulator scalar (serial np.asarray
         # would pay one RTT per accumulator on tunneled links); exact
         # wide-decimal (object) accumulators pass through _host unchanged
-        acc_cols = [np.asarray(a)[None] for a in _host(list(state))]  # host-ok
+        acc_cols = [np.asarray(a)[None]  # host-ok
+                    for a in _host(list(state), site="agg.global.accs")]
         out_cols, out_nulls = _finalize_aggs(node.aggs, acc_cols, 1)
         # host output (exact wide-decimal columns must never reach the device)
         arrays = [np.asarray(c) for c in out_cols]  # host-ok: post-_host finalize
@@ -2070,7 +2167,8 @@ class LocalExecutor:
             # valid matters: a partially-filled page's invalid rows must not
             # join real partitions (they'd inflate ranks/sums); the kernel
             # isolates them into a pad partition
-            kernel = _jit(lambda cols, nulls, valid, specs=node.specs:
+            kernel = _jit(site="window.kernel",
+                      fn=lambda cols, nulls, valid, specs=node.specs:
                              _window_kernel(specs, cols, nulls, valid))
             self._agg_cache[("window", id(node))] = (node, kernel)
         else:
@@ -2140,7 +2238,8 @@ class LocalExecutor:
         uniq = jnp.unique(jnp.where(live, jnp.asarray(v), jnp.asarray(v)[0]),
                           size=min(int(build_page.capacity),
                                    self.INDEX_JOIN_MAX_KEYS + 1))
-        got = _host([uniq, jnp.sum(live, dtype=jnp.int64)])
+        got = _host([uniq, jnp.sum(live, dtype=jnp.int64)],
+                    site="join.index.keys")
         if int(got[1]) == 0:
             # all-dead build: fall through to _dynamic_pruned_pages' empty-
             # build short-circuit (zero remote work) instead of shipping a
@@ -2194,6 +2293,14 @@ class LocalExecutor:
                 _dynamic_pruned_pages(probe_stream, node, build_page)
             if pruned is not None:
                 pages_fn, kept = pruned
+                si_conn = probe_stream.scan_info.conn \
+                    if probe_stream.scan_info is not None else None
+                # the pruned replacement must keep the prefetch the original
+                # scan compiled with (round-6 double buffer / HOST_DECODE
+                # decode overlap) — dynamic pruning was silently dropping it,
+                # serializing generation back into the probe dispatches
+                pages_fn = self._rewrap_pruned_pages(pages_fn, si_conn,
+                                                     len(kept))
                 repl = {"pages": pages_fn, "_jitted": None,
                         "_batch_jitted": None}
                 if probe_stream.scan_info is not None:
@@ -2533,7 +2640,8 @@ class LocalExecutor:
         imax, imin = jnp.iinfo(jnp.int64).max, jnp.iinfo(jnp.int64).min
         got = _host([jnp.min(jnp.where(valid, k64, imax)),
                      jnp.max(jnp.where(valid, k64, imin)),
-                     jnp.sum(valid, dtype=jnp.int64)])
+                     jnp.sum(valid, dtype=jnp.int64)],
+                    site="join.direct.range")
         kmin, kmax, nlive = (int(x) for x in got)
         if nlive == 0 or kmax - kmin + 1 > DIRECT_JOIN_RANGE_MAX:
             return None
@@ -2564,7 +2672,8 @@ class LocalExecutor:
             # ONE batched sync for both flags (each separate int()/bool() pays
             # a device->host RTT on tunneled links)
             overflow, dups = (int(x) for x in
-                              _host([table.overflow, table.dup_count]))
+                              _host([table.overflow, table.dup_count],
+                                    site="join.build.flags"))
             if not overflow:
                 break
             capacity *= 4
@@ -2992,7 +3101,8 @@ def _concat_stream(stream: _Stream, batch: int = 1) -> Page:
         # one batched host sync per chunk of pages (per-page int() pays a
         # device->host RTT per page on tunneled links); chunking bounds how many
         # uncompacted pages sit on device at once
-        for (cols, nulls, valid), n in zip(staged, [int(c) for c in _host(sums)]):
+        for (cols, nulls, valid), n in zip(
+                staged, [int(c) for c in _host(sums, site="compact.counts")]):
             if n == 0:
                 continue
             if any(isinstance(c, np.ndarray) and c.dtype == object
@@ -3000,7 +3110,8 @@ def _concat_stream(stream: _Stream, batch: int = 1) -> Page:
                 # exact wide-decimal columns: host compaction (cannot trace);
                 # the object columns are host-resident — one batched pull
                 # covers the masks (eager jnp ops may have produced them)
-                got = _host([valid] + [m for m in nulls if m is not None])
+                got = _host([valid] + [m for m in nulls if m is not None],
+                            site="compact.object")
                 v, rest = got[0], got[1:]
                 ccols = tuple(np.asarray(c)[v] for c in cols)  # host-ok: object cols
                 cnulls = tuple(None if m is None else rest.pop(0)[v]
@@ -3119,7 +3230,8 @@ def _dynamic_pruned_pages(probe_stream: _Stream, node, build_page: Page):
     if si is None or not si.replayable or not hasattr(si.conn, "split_range"):
         return None
     exact_ok = build_page.capacity <= 65536
-    bvalid = _host([build_page.valid_mask()])[0] if (build_page.capacity
+    bvalid = _host([build_page.valid_mask()],
+                   site="join.prune.valid")[0] if (build_page.capacity
                                                      and exact_ok) else \
         np.zeros((0,), bool)
     nonempty = bvalid.any() if exact_ok else (
@@ -3146,7 +3258,8 @@ def _dynamic_pruned_pages(probe_stream: _Stream, node, build_page: Page):
         if exact_ok:
             nm = build_page.null_masks[bch]
             got = _host([build_page.columns[bch]]
-                        + ([nm] if nm is not None else []))
+                        + ([nm] if nm is not None else []),
+                        site="join.prune.keys")
             vals = got[0][bvalid]
             if nm is not None:
                 vals = vals[~got[1][bvalid]]
@@ -3171,7 +3284,7 @@ def _dynamic_pruned_pages(probe_stream: _Stream, node, build_page: Page):
                                jnp.any(live)])
             span_cols.append(col)
     if span_cols:
-        got = _host(span_stats)
+        got = _host(span_stats, site="join.prune.span")
         for i, col in enumerate(span_cols):
             lo, hi, any_live = (int(got[3 * i]), int(got[3 * i + 1]),
                                 bool(got[3 * i + 2]))
@@ -3202,7 +3315,7 @@ def _build_null_stats(build_page: Page, key_channels):
         nm = build_page.null_masks[ch]
         if nm is not None:
             stats.append(jnp.any(nm & valid))
-    got = _host(stats)
+    got = _host(stats, site="join.build.nulls")
     nonempty = bool(got[0])
     has_null = any(bool(x) for x in got[1:])
     return has_null, nonempty
@@ -3300,7 +3413,7 @@ def _run_match_recognize(node: P.MatchRecognize, child: Page, cdicts):
             # per DEFINE variable (was two loose per-variable np.asarray)
             got = _host([jnp.broadcast_to(v, (n,))]
                         + ([jnp.broadcast_to(nu, (n,))] if nu is not None
-                           else []))
+                           else []), site="mr.define")
             arr = got[0]
             if nu is not None:
                 arr = arr & ~got[1]
@@ -3580,6 +3693,13 @@ def _prefetched_pages(pages_fn, depth: int = 2, to_device: bool = False,
         q: _queue.Queue = _queue.Queue(maxsize=depth)
         done = object()
         closed = threading.Event()
+        # explicit parent handoff: Tracer parenting is thread-local, so the
+        # producer thread's spans would be orphans — capture the consumer
+        # thread's active span HERE (first iteration, on the query thread) and
+        # pass it across.  The producer's span parents correctly into the
+        # query's tree even though it opens on another thread.
+        tracer = tracing.current_tracer()
+        parent = tracer.current() if tracer is not None else None
 
         def producer():
             def put(item) -> bool:
@@ -3591,15 +3711,28 @@ def _prefetched_pages(pages_fn, depth: int = 2, to_device: bool = False,
                         continue
                 return False
 
-            try:
-                for p in it:
-                    if to_device:
-                        p = _page_to_device(p)
-                    if not put(p):
-                        return
-                put(done)
-            except BaseException as e:  # surfaces in the consumer
-                put(e)
+            def pump(span):
+                n = 0
+                try:
+                    for p in it:
+                        if to_device:
+                            p = _page_to_device(p)
+                        n += 1
+                        if not put(p):
+                            return
+                    put(done)
+                except BaseException as e:  # surfaces in the consumer
+                    put(e)
+                finally:
+                    if span is not None:
+                        span.attributes["pages"] = n
+
+            if tracer is None:
+                pump(None)
+            else:
+                with tracer.span("prefetch", parent=parent,
+                                 to_device=to_device) as span:
+                    pump(span)
 
         threading.Thread(target=producer, daemon=True).start()
         try:
@@ -3635,7 +3768,7 @@ def _page_to_device(page: Page) -> Page:
                 None if page.valid is None else up(page.valid))
 
 
-def _host(arrays):
+def _host(arrays, site=None):
     """Device->host transfer of many arrays with ONE round-trip of latency: start
     async copies for every array first, then materialize.  On tunneled/remote
     device links each serial np.asarray pays a full RTT (~100ms); batching is the
@@ -3644,7 +3777,9 @@ def _host(arrays):
     This is THE transfer chokepoint (CLAUDE.md: batch ALL transfers through
     ``_host``): each call records one host transfer and the device bytes it
     pulls on the active query's counters, which the warm-query budget tests
-    assert against — a stray bulk pull added anywhere upstream fails them."""
+    assert against — a stray bulk pull added anywhere upstream fails them.
+    ``site`` labels the pull for per-site attribution (every call site must
+    pass one or carry a ``# site-ok`` marker — tests/test_boundary_lint.py)."""
     nbytes = 0
     for a in arrays:
         if hasattr(a, "copy_to_host_async"):
@@ -3653,17 +3788,17 @@ def _host(arrays):
                 nbytes += a.nbytes
             except Exception:
                 pass
-    tracing.record_host_pull(nbytes)
+    tracing.record_host_pull(nbytes, site=site)
     return [None if a is None else np.asarray(a) for a in arrays]
 
 
-def _host_page(page: Page):
+def _host_page(page: Page, site="page"):
     """(valid, cols, nulls) as numpy, fetched in ONE batched transfer.  A page with
     no validity mask gets a host-side ones() — no device fetch fabricated for it."""
     nc = len(page.columns)
     has_valid = page.valid is not None
     got = _host(list(page.columns) + list(page.null_masks)
-                + ([page.valid] if has_valid else []))
+                + ([page.valid] if has_valid else []), site=site)
     valid = got[-1] if has_valid else np.ones((page.capacity,), bool)
     return valid, got[:nc], got[nc:nc + len(page.null_masks)]
 
@@ -3827,7 +3962,8 @@ def _topn_page_device(page: Page, keys, count, dicts=None):
     # wasted round-trip first.
     all_live = count is None
     if all_live:
-        count = int(_host([jnp.sum(valid, dtype=jnp.int64)])[0])
+        count = int(_host([jnp.sum(valid, dtype=jnp.int64)],
+                          site="sort.count")[0])
     idx = jnp.lexsort(tuple(lex))[:count]
     nc = len(page.columns)
     # transfer-narrow dictionary-id columns (id bound known from the dict, no
@@ -3855,7 +3991,7 @@ def _topn_page_device(page: Page, keys, count, dicts=None):
               if nm is not None]
     if not all_live:
         fetch.append(jnp.packbits(valid[idx]))
-    got = _host(fetch)
+    got = _host(fetch, site="sort.pull")
     m = len(got[0]) if nc else 0
 
     def unpack(b):

@@ -37,6 +37,14 @@ class QueryCompletedEvent:
     wall_s: Optional[float]
     rows: Optional[int]
     error: Optional[str]
+    # device-boundary profile of the statement (QueryCounters.as_dict(),
+    # including per-site attribution and the dispatch-latency histogram);
+    # None for statements that executed no plan (DDL, SET SESSION).
+    # Reference: QueryCompletedEvent.statistics (QueryStatistics carries
+    # cpu/scheduled time and operator summaries)
+    counters: Optional[dict] = None
+    # duration of the query's root tracing span (parse->results, seconds)
+    root_span_s: Optional[float] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,4 +92,6 @@ class EventListenerManager:
         self._fire("query_completed", QueryCompletedEvent(
             qsm.query_id, qsm.sql, qsm.user, qsm.catalog, info.state,
             qsm.created_s, qsm.ended_s or time.time(), info.wall_s, info.rows,
-            qsm.error))
+            qsm.error,
+            counters=getattr(qsm, "counters", None),
+            root_span_s=getattr(qsm, "root_span_duration_s", None)))

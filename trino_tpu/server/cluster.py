@@ -60,6 +60,8 @@ from ..exec.fte import (FaultTolerantExecutor, SpoolingExchange,
                         run_partial_aggregate, run_stream_splits,
                         serialize_fragment_output)
 from ..exec.local_executor import LocalExecutor, _materialize
+from ..execution import tracing
+from ..execution.tracing import QueryCounters, Tracer
 from ..sql import plan as P
 
 __all__ = ["WorkerServer", "ClusterCoordinator", "build_catalogs"]
@@ -269,6 +271,12 @@ class _TaskState:
     state: str = "running"  # running | done | failed
     error: Optional[str] = None
     retryable: bool = True  # False: deterministic failure, do not re-dispatch
+    # device-boundary profile of the task (QueryCounters.as_dict(), set BEFORE
+    # the output commits so a coordinator that just observed the commit reads
+    # it) and the task's finished span tree — the worker half of the
+    # cluster-wide counter flow the coordinator merges per query
+    counters: Optional[dict] = None
+    spans: Optional[list] = None
 
 
 class WorkerServer:
@@ -300,6 +308,10 @@ class WorkerServer:
 
         self.memory_pool = MemoryPool()
         self.local = LocalExecutor(self.catalogs, memory_pool=self.memory_pool)
+        # worker-local tracer: each task runs under a root span (trace id =
+        # task id) whose finished tree rides the status response back to the
+        # coordinator
+        self.tracer = Tracer()
         self.spool_dir = spool_dir
         self.host, self.port = host, port
         self.node_id = node_id
@@ -425,8 +437,14 @@ class WorkerServer:
                     st = worker.tasks.get(tid)
                     if st is None:
                         return self._reply(404, {"error": "no such task"})
+                    # the task's QueryCounters snapshot + finished spans ride
+                    # the status response so the coordinator's per-query merge
+                    # sees the whole cluster (reference: TaskStatus carrying
+                    # task stats back to the coordinator)
                     return self._reply(200, {"state": st.state, "error": st.error,
-                                             "retryable": st.retryable})
+                                             "retryable": st.retryable,
+                                             "counters": st.counters,
+                                             "spans": st.spans})
                 self._reply(404, {"error": "not found"})
 
             def _read_verified(self):
@@ -648,7 +666,16 @@ class WorkerServer:
                     self._running_queries[xdir] = \
                         self._running_queries.get(xdir, 0) + 1
                 kind = req.get("kind", "partial_agg")
-                with self.memory_pool.query_scope(xdir):
+                # worker half of the cluster counter flow: the task body runs
+                # under its own QueryCounters + a task root span, so every
+                # _jit dispatch / _host pull on this worker is attributed and
+                # shippable back to the coordinator
+                counters = QueryCounters()
+                with tracing.activate_tracer(self.tracer), \
+                        self.tracer.span("task", trace_id=tid, task=tid,
+                                         kind=kind, node=self.node_id), \
+                        tracing.track_counters(counters), \
+                        self.memory_pool.query_scope(xdir):
                     if kind == "partial_agg":
                         data = run_partial_aggregate(ex, node, req["splits"],
                                                      xdir, sources, fetch,
@@ -662,6 +689,11 @@ class WorkerServer:
                         data = run_fragment(ex, node, xdir, sources, fetch)
                     else:
                         raise ValueError(f"unknown task kind {kind!r}")
+                # snapshot BEFORE the output becomes visible: a coordinator
+                # that just observed the commit must find the stats populated
+                st.counters = counters.as_dict()
+                st.spans = [tracing.span_dict(s)
+                            for s in self.tracer.spans_for(tid)]
                 if stream_out:
                     # pipelined output: pages live in the in-memory buffer
                     # behind the long-poll endpoint; nothing touches disk
@@ -674,6 +706,8 @@ class WorkerServer:
                 st.state = "done"
             except Exception as e:
                 st.state = "failed"
+                if st.counters is None and "counters" in locals():
+                    st.counters = counters.as_dict()  # partial spend: still real
                 # streaming no longer forces non-retryable: the coordinator
                 # replays the streaming subtree (fresh producers) on retry
                 st.retryable = is_retryable_failure(e)
@@ -842,6 +876,18 @@ class ClusterCoordinator:
         # pipeline set per distinct query string forever)
         self._plan_cache: OrderedDict = OrderedDict()
         self._plan_cache_max = 128
+        # cluster-wide per-query profile: worker task counters merge here as
+        # their commits are observed (plus the coordinator's own local spend),
+        # published per query as last_query_counters and folded into
+        # engine.counters_total so /v1/metrics sees the whole cluster
+        self.last_query_counters = QueryCounters()
+        self.last_query_worker_spans: list = []
+        self._qc_workers = QueryCounters()
+        self._qc_children: list = []  # sibling-stage threads' coordinator-side
+        # counters (thread-local recording: each dispatch thread tracks its
+        # own and the query merge folds them in)
+        self._worker_spans: list = []
+        self._harvested: set = set()  # task ids already merged this query
 
     # -- lifecycle ---------------------------------------------------------------
     def start(self) -> str:
@@ -1052,57 +1098,131 @@ class ClusterCoordinator:
             # _query_lock, so the per-query stash is race-free)
             self._dispatch_batch = _effective_dispatch_batch(sess)
             local.dispatch_batch = self._dispatch_batch
-            if not self.live_workers():
-                return local.execute(plan)
-            with self._lock:
-                self._exchange_seq += 1
-                seq = self._exchange_seq
-            exchange_dir = _os.path.join(self.spool_dir,
-                                         f"cluster_exchange_{seq}")
-            exchange = SpoolingExchange(exchange_dir)
-            self._task_seq = 0
-            self._query_abort.clear()
-            self._stream_pending = {}
-            self._stream_producers = {}
-            spooled: dict = {}  # id(node) -> (task_ids, node)
-            self._mem_results = {}  # id(node) -> (page, dicts) merged locally
+            # per-query cluster profile: worker counters merge in as commits
+            # are observed; the finally below publishes coordinator + workers
+            self._qc_workers = QueryCounters()
+            self._qc_children = []
+            self._worker_spans = []
+            self._harvested = set()
             try:
+                if not self.live_workers():
+                    return local.execute(plan)
+                with self._lock:
+                    self._exchange_seq += 1
+                    seq = self._exchange_seq
+                exchange_dir = _os.path.join(self.spool_dir,
+                                             f"cluster_exchange_{seq}")
+                exchange = SpoolingExchange(exchange_dir)
+                self._task_seq = 0
+                self._query_abort.clear()
+                self._stream_pending = {}
+                self._stream_producers = {}
+                spooled: dict = {}  # id(node) -> (task_ids, node)
+                self._mem_results = {}  # id(node) -> (page, dicts) merged locally
+                local.counters.reset()
                 try:
-                    self._exec_fragments(plan, exchange, exchange_dir, spooled,
-                                         nested=False)
-                except Exception as exc:
-                    if "QueryKilledError" in str(exc):
-                        # the cluster low-memory policy killed THIS query:
-                        # rerunning it locally would defeat the kill (and
-                        # likely OOM the coordinator too) — surface it
-                        from ..memory import QueryKilledError
+                    with tracing.track_counters(local.counters):
+                        try:
+                            self._exec_fragments(plan, exchange, exchange_dir,
+                                                 spooled, nested=False)
+                        except Exception as exc:
+                            if "QueryKilledError" in str(exc):
+                                # the cluster low-memory policy killed THIS
+                                # query: rerunning it locally would defeat the
+                                # kill (and likely OOM the coordinator too) —
+                                # surface it
+                                from ..memory import QueryKilledError
 
-                        raise QueryKilledError(str(exc)) from exc
-                    # a fragment the workers cannot run (unsupported shape,
-                    # exhausted retries, cluster-wide death) must not fail a
-                    # query the local executor can answer — degrade to local;
-                    # genuine query errors re-raise from there identically
-                    self.local_fallbacks += 1
-                    self.last_fallback_error = traceback.format_exc()
+                                raise QueryKilledError(str(exc)) from exc
+                            # a fragment the workers cannot run (unsupported
+                            # shape, exhausted retries, cluster-wide death)
+                            # must not fail a query the local executor can
+                            # answer — degrade to local; genuine query errors
+                            # re-raise from there identically
+                            self.local_fallbacks += 1
+                            self.last_fallback_error = traceback.format_exc()
+                            local._overrides = {}
+                            # local.execute resets local.counters: carry the
+                            # coordinator-side spend already recorded for the
+                            # failed fragment run into the final snapshot
+                            pre = local.counters.snapshot()
+                            out = local.execute(plan)
+                            local.counters.merge(pre)
+                            return out
+                        if not spooled:
+                            pre = local.counters.snapshot()
+                            out = local.execute(plan)
+                            local.counters.merge(pre)
+                            return out
+                        overrides = {}
+                        for nid in self._top_fragments(plan, spooled):
+                            hit = self._mem_results.get(nid)
+                            if hit is None:
+                                task_ids, n = spooled[nid]
+                                hit = read_fragment_outputs(exchange, task_ids,
+                                                            n.schema)
+                            overrides[nid] = hit
+                        local._overrides = overrides
+                        out_page, dd = local._execute_to_page(plan)
+                        return _materialize(out_page, dd)
+                finally:
                     local._overrides = {}
-                    return local.execute(plan)
-                if not spooled:
-                    return local.execute(plan)
-                overrides = {}
-                for nid in self._top_fragments(plan, spooled):
-                    hit = self._mem_results.get(nid)
-                    if hit is None:
-                        task_ids, n = spooled[nid]
-                        hit = read_fragment_outputs(exchange, task_ids,
-                                                    n.schema)
-                    overrides[nid] = hit
-                local._overrides = overrides
-                out_page, dd = local._execute_to_page(plan)
-                return _materialize(out_page, dd)
+                    self._mem_results = {}
+                    self._harvest_stream_producers()
+                    shutil.rmtree(exchange_dir, ignore_errors=True)
             finally:
-                local._overrides = {}
-                self._mem_results = {}
-                shutil.rmtree(exchange_dir, ignore_errors=True)
+                # publish the merged cluster profile (coordinator local spend
+                # + sibling-stage dispatch threads + every harvested worker
+                # task) and fold it into the engine totals /v1/metrics reads
+                merged = local.counters.snapshot()
+                with self._lock:
+                    for sub in self._qc_children:
+                        merged.merge(sub)
+                    merged.merge(self._qc_workers)
+                    self.last_query_counters = merged
+                    self.last_query_worker_spans = list(self._worker_spans)
+                self.engine._account_counters(merged)
+
+    # -- cluster counter flow --------------------------------------------------
+    def _harvest_task_stats(self, worker_url: str, tid: str) -> None:
+        """Pull a finished task's QueryCounters snapshot + spans from its
+        worker and merge them into this query's cluster profile (best-effort:
+        a worker that died after committing keeps its output but loses its
+        stats).  Idempotent per task id — speculation and replay must not
+        double-count a task's spend."""
+        with self._lock:
+            if tid in self._harvested:
+                return
+        try:
+            st = json.loads(_http(f"{worker_url}/v1/task/{tid}", timeout=2.0))
+        except Exception:
+            return
+        counters = st.get("counters")
+        with self._lock:
+            if tid in self._harvested:
+                return
+            if counters is None and st.get("state") == "running":
+                # a speculated duplicate committed elsewhere while this
+                # worker's attempt still runs: nothing to merge from here
+                return
+            self._harvested.add(tid)
+            self._qc_workers.merge_dict(counters or {})
+            for s in st.get("spans") or ():
+                self._worker_spans.append(s)
+
+    def _harvest_stream_producers(self) -> None:
+        """Streaming producers commit no spool entry, so the dispatch loop
+        never observes them — collect their stats at query end (they have
+        finished by then: their consumers drained).  Workers the failure
+        detector already gated out are skipped: best-effort stats must not
+        add a per-dead-worker HTTP timeout to a query that has its answer."""
+        with self._lock:
+            producers = list(self._stream_producers.items())
+            dead = {w.url for w in self.workers.values() if not w.alive}
+        for tid, rec in producers:
+            if rec["url"] in dead:
+                continue
+            self._harvest_task_stats(rec["url"], tid)
 
     # -- fragment scheduling -----------------------------------------------------
     def _exec_fragments(self, node, exchange, exchange_dir, spooled,
@@ -1123,14 +1243,22 @@ class ClusterCoordinator:
             import concurrent.futures as _futures
 
             def run_child(c):
+                # counter recording is thread-local: each sibling-stage thread
+                # tracks its own coordinator-side spend (partial merges, spool
+                # reads) and the query-end merge folds it in
+                sub = QueryCounters()
                 try:
-                    self._exec_fragments(c, exchange, exchange_dir, spooled,
-                                         child_nested)
+                    with tracing.track_counters(sub):
+                        self._exec_fragments(c, exchange, exchange_dir,
+                                             spooled, child_nested)
                 except BaseException:
                     # fail-fast: siblings stop dispatching instead of running
                     # their whole stage for a query that will be abandoned
                     self._query_abort.set()
                     raise
+                finally:
+                    with self._lock:
+                        self._qc_children.append(sub)
 
             with _futures.ThreadPoolExecutor(max_workers=len(kids)) as pool:
                 futs = [pool.submit(run_child, c) for c in kids]
@@ -1624,6 +1752,10 @@ class ClusterCoordinator:
                         # weaken later straggler detection
                         durations.append(
                             time.time() - started.get(tid, time.time()))
+                    # worker-side counters ride back on the status response
+                    # the moment the commit is visible (the snapshot is
+                    # stored pre-commit on the worker)
+                    self._harvest_task_stats(w.url, tid)
                     del assigned[tid]
                     continue
                 # speculation: every task dispatched, siblings finishing, this

@@ -158,6 +158,9 @@ class _Query:
     user: str = "user"  # submitting principal: result reads require it
     created_at: float = dataclasses.field(default_factory=time.time)
     finished_at: Optional[float] = None
+    # engine span-tree summary captured at completion (engine.last_query_trace
+    # under the engine lock) — served OTLP-shaped by /v1/query/{id}/trace
+    trace: Optional[dict] = None
     lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
 
 
@@ -263,6 +266,17 @@ class CoordinatorServer:
                         self._send(403, {"error": "not your query"})
                         return
                     self._send(200, server._results_response(q, token))
+                    return
+                # /v1/query/{id}/trace — OTLP-shaped span tree of the query
+                # (reference: airlift TracingModule's OTLP export, served
+                # in-process so one curl profiles a finished statement)
+                if len(parts) == 4 and parts[:2] == ["v1", "query"] \
+                        and parts[3] == "trace":
+                    payload = server._query_trace(parts[2])
+                    if payload is None:
+                        self._send(404, {"error": "unknown query"})
+                        return
+                    self._send(200, payload)
                     return
                 if len(parts) == 3 and parts[:2] == ["v1", "query"]:
                     q = server.queries.get(parts[2])
@@ -428,40 +442,108 @@ class CoordinatorServer:
         except Exception:
             return None
 
+    @staticmethod
+    def _escape_label(v: str) -> str:
+        """Prometheus text-format label-value escaping (backslash, quote,
+        newline) — stricter scrapers reject unescaped values."""
+        return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
     def _metrics_text(self) -> str:
+        """Prometheus text exposition with # HELP / # TYPE metadata (the
+        format openmetrics-strict scrapers require; reference:
+        JmxOpenMetricsModule) including the device-boundary counters, the
+        per-site breakdown, and the dispatch-latency histogram — the wedge
+        signature (p99 exploding while the dispatch count stalls) is readable
+        from one curl of this endpoint."""
+        esc = self._escape_label
         with self._queries_lock:
             qs = list(self.queries.values())
         by_state: dict = {}
         for q in qs:
             by_state[q.state] = by_state.get(q.state, 0) + 1
         lines = [
+            "# HELP trino_tpu_queries_total Statements accepted by this "
+            "coordinator.",
             "# TYPE trino_tpu_queries_total counter",
             f"trino_tpu_queries_total {len(qs)}",
+            "# HELP trino_tpu_queries_by_state Tracked queries per lifecycle "
+            "state.",
             "# TYPE trino_tpu_queries_by_state gauge",
         ]
         for state, n in sorted(by_state.items()):
             lines.append(
-                f'trino_tpu_queries_by_state{{state="{state}"}} {n}')
+                f'trino_tpu_queries_by_state{{state="{esc(state)}"}} {n}')
         done = [q for q in qs if q.finished_at is not None]
         if done:
             total = sum(q.finished_at - q.created_at for q in done)
-            lines += ["# TYPE trino_tpu_query_seconds_total counter",
+            lines += ["# HELP trino_tpu_query_seconds_total Wall seconds of "
+                      "finished queries.",
+                      "# TYPE trino_tpu_query_seconds_total counter",
                       f"trino_tpu_query_seconds_total {total:.3f}"]
         # device-boundary totals (execution/tracing.QueryCounters): the
-        # dispatch/transfer budget spent across every local plan execution
+        # dispatch/transfer budget spent across every plan execution this
+        # engine accounted — on a cluster coordinator this includes merged
+        # worker-side counters (server/cluster.py task-response flow)
         ct = getattr(self.engine, "counters_total", None)
         if ct is not None:
             lines += [
+                "# HELP trino_tpu_device_dispatches_total Jitted XLA program "
+                "launches (one tunnel round-trip each on remote devices).",
                 "# TYPE trino_tpu_device_dispatches_total counter",
                 f"trino_tpu_device_dispatches_total {ct.device_dispatches}",
+                "# HELP trino_tpu_host_transfers_total Batched device->host "
+                "pulls through the _host chokepoint.",
                 "# TYPE trino_tpu_host_transfers_total counter",
                 f"trino_tpu_host_transfers_total {ct.host_transfers}",
+                "# HELP trino_tpu_host_bytes_pulled_total Device bytes moved "
+                "to host.",
                 "# TYPE trino_tpu_host_bytes_pulled_total counter",
                 f"trino_tpu_host_bytes_pulled_total {ct.host_bytes_pulled}",
+                "# HELP trino_tpu_coalesced_splits_total Splits executed "
+                "inside coalesced multi-split dispatches.",
                 "# TYPE trino_tpu_coalesced_splits_total counter",
                 f"trino_tpu_coalesced_splits_total "
                 f"{getattr(ct, 'coalesced_splits', 0)}",
             ]
+            sites = getattr(ct, "sites", None) or {}
+            if sites:
+                lines += ["# HELP trino_tpu_site_dispatches_total Device "
+                          "dispatches per operator/call-site.",
+                          "# TYPE trino_tpu_site_dispatches_total counter"]
+                for key in sorted(sites):
+                    lines.append(
+                        f'trino_tpu_site_dispatches_total{{site="{esc(key)}"}}'
+                        f' {sites[key]["dispatches"]}')
+                lines += ["# HELP trino_tpu_site_bytes_pulled_total Host "
+                          "bytes pulled per operator/call-site.",
+                          "# TYPE trino_tpu_site_bytes_pulled_total counter"]
+                for key in sorted(sites):
+                    lines.append(
+                        f'trino_tpu_site_bytes_pulled_total'
+                        f'{{site="{esc(key)}"}} {sites[key]["bytes"]}')
+            hist = getattr(ct, "dispatch_latency", None)
+            if hist is not None:
+                from ..execution.tracing import LATENCY_BUCKETS_S
+
+                h = hist.as_dict()
+                lines += ["# HELP trino_tpu_dispatch_latency_seconds Wall "
+                          "time of each jitted dispatch (process-wide).",
+                          "# TYPE trino_tpu_dispatch_latency_seconds "
+                          "histogram"]
+                cum = 0
+                for ub, c in zip(LATENCY_BUCKETS_S, h["buckets"]):
+                    cum += c
+                    lines.append(
+                        "trino_tpu_dispatch_latency_seconds_bucket"
+                        f'{{le="{ub}"}} {cum}')
+                lines.append(
+                    "trino_tpu_dispatch_latency_seconds_bucket"
+                    f'{{le="+Inf"}} {h["count"]}')
+                lines.append(
+                    f"trino_tpu_dispatch_latency_seconds_sum {h['sum_s']}")
+                lines.append(
+                    f"trino_tpu_dispatch_latency_seconds_count {h['count']}")
         return "\n".join(lines) + "\n"
 
     def _query_row_count(self, q):
@@ -588,7 +670,14 @@ class CoordinatorServer:
                 session.user = user
                 if not self._set_state(q, "RUNNING"):
                     return
-                res = self.engine.execute_sql(q.sql, session)
+                try:
+                    res = self.engine.execute_sql(q.sql, session)
+                finally:
+                    # still under the engine lock: last_query_trace is the
+                    # trace of THIS statement, not a concurrent one's — and
+                    # FAILED statements keep theirs too (a failed query is
+                    # when the trace is most wanted)
+                    q.trace = getattr(self.engine, "last_query_trace", None)
             if res is None:  # DDL
                 columns = [{"name": "result", "type": "boolean"}]
                 rows = [[True]]
@@ -708,6 +797,24 @@ class CoordinatorServer:
             return None
         with open(path, "rb") as f:
             return f.read()
+
+    def _query_trace(self, qid: str):
+        """OTLP/JSON trace for a server query id (captured trace), or for an
+        ENGINE query id (query_N: live lookup against the engine tracer —
+        useful when driving the engine embedded)."""
+        from ..execution.tracing import spans_to_otlp
+
+        q = self.queries.get(qid)
+        if q is not None:
+            if not q.trace:
+                return None
+            return spans_to_otlp(q.trace.get("spans", ()))
+        tracer = getattr(self.engine, "tracer", None)
+        if tracer is not None:
+            spans = tracer.spans_for(qid)
+            if spans:
+                return spans_to_otlp(spans)
+        return None
 
     def _query_info(self, q: _Query) -> dict:
         return {

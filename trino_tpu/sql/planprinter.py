@@ -12,21 +12,44 @@ from . import plan as P
 __all__ = ["format_plan"]
 
 
-def format_plan(node: P.PlanNode, stats: dict = None, counters=None) -> str:
+def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
+                boundary: dict = None) -> str:
     """``stats``: optional id(node) -> {rows, wall_s} from an EXPLAIN ANALYZE run
     (reference: PlanPrinter's textDistributedPlan with OperatorStats).
     ``counters``: optional per-query device-boundary counters
     (execution/tracing.QueryCounters) appended as a summary line — the
-    dispatch/transfer budget the query actually spent."""
+    dispatch/transfer budget the query actually spent — followed by the
+    per-call-site breakdown (``counters.sites``).  ``boundary``: optional
+    per-operator attribution (LocalExecutor.boundary: id(node) ->
+    {label, dispatches, transfers, bytes}, plus a "result" entry for the final
+    materialization pull); per-operator rows sum to the counter totals
+    exactly (innermost-scope attribution)."""
     lines: list = []
-    _fmt(node, lines, 0, stats or {})
+    _fmt(node, lines, 0, stats or {}, boundary or {})
     if counters is not None:
         lines.append(
             f"Device boundary: {counters.device_dispatches} dispatches, "
             f"{counters.host_transfers} host transfers, "
             f"{counters.host_bytes_pulled} bytes pulled, "
             f"{getattr(counters, 'coalesced_splits', 0)} splits coalesced")
+        res = (boundary or {}).get("result")
+        if res is not None and _boundary_nonzero(res):
+            lines.append("    result: " + _boundary_str(res))
+        sites = getattr(counters, "sites", None) or {}
+        for key in sorted(sites, key=lambda k: (-sites[k]["dispatches"],
+                                                -sites[k]["bytes"], k)):
+            lines.append(f"    site {key}: " + _boundary_str(sites[key]))
     return "\n".join(lines)
+
+
+def _boundary_nonzero(b: dict) -> bool:
+    return bool(b.get("dispatches") or b.get("transfers") or b.get("bytes"))
+
+
+def _boundary_str(b: dict) -> str:
+    return (f"{b.get('dispatches', 0)} dispatches, "
+            f"{b.get('transfers', 0)} transfers, "
+            f"{b.get('bytes', 0)} bytes")
 
 
 def _schema_str(node: P.PlanNode) -> str:
@@ -37,8 +60,10 @@ def _schema_str(node: P.PlanNode) -> str:
     return f"[{inner}]"
 
 
-def _fmt(node: P.PlanNode, lines: list, depth: int, stats: dict) -> None:
+def _fmt(node: P.PlanNode, lines: list, depth: int, stats: dict,
+         boundary: dict = None) -> None:
     pad = "    " * depth
+    boundary = boundary or {}
     before = len(lines)
     if isinstance(node, P.Output):
         lines.append(f"{pad}Output[{', '.join(node.names)}]")
@@ -97,5 +122,11 @@ def _fmt(node: P.PlanNode, lines: list, depth: int, stats: dict) -> None:
         if s.get("index_join_keys"):
             # the probe scan collapsed to a connector keyed lookup
             lines[before] += f" [index lookup: {s['index_join_keys']} keys]"
+    b = boundary.get(id(node))
+    if b is not None and _boundary_nonzero(b) and len(lines) > before:
+        # per-operator device-boundary attribution (the OperatorStats analog
+        # for the accelerator boundary): dispatches/pulls recorded while THIS
+        # operator (and the streaming chain it drives) executed
+        lines[before] += f" [boundary: {_boundary_str(b)}]"
     for c in node.children:
-        _fmt(c, lines, depth + 1, stats)
+        _fmt(c, lines, depth + 1, stats, boundary)
