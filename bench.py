@@ -15,6 +15,15 @@ value = summed TPC-H input rows / summed median wall-clock (rows/sec on one chip
 vs_baseline = geometric-mean per-query speedup over the CPU baseline.
 BENCH_SF overrides the scale factor (default 1); BENCH_QUERIES picks a subset
 (comma-separated, e.g. "q1,q3").
+
+``--baseline BENCH_xxx.json`` diffs this run's per_query wall/dispatch/bytes
+against a prior capture and prints a regression verdict line to stderr
+(>20% wall growth or any budget-counter growth flags); the diff also embeds
+in the JSON payload under "baseline".  BENCH_STATUS_PORT starts an HTTP
+status server on the engine (GET /v1/status: in-flight registry, stall
+report, running queries) so an external watcher — scripts/tpu_watch.sh — can
+capture a post-mortem artifact if the tunnel wedges mid-bench; pair it with
+TRINO_TPU_STALL_S to arm the engine's stall watchdog.
 """
 
 import json
@@ -244,7 +253,59 @@ class _BudgetExceeded(Exception):
     pass
 
 
-def main():
+# regression thresholds for --baseline: wall growth beyond the ratio flags;
+# any growth in these per-query budget counters flags (they are supposed to
+# be DETERMINISTIC warm-path quantities — growth means a real code change)
+WALL_REGRESSION_RATIO = 1.2
+BUDGET_COUNTERS = ("device_dispatches", "host_transfers", "host_bytes_pulled")
+
+
+def _baseline_diff(base_pq: dict, now_pq: dict) -> dict:
+    """Per-query diff of this run vs a prior capture's per_query payload.
+    Returns {"queries": {q: {...}}, "missing": [...], "regressions":
+    [summary...]} — a query regresses on >20% wall growth, ANY budget-counter
+    growth, or by DISAPPEARING from this run (a query that no longer finishes
+    is the worst regression of all)."""
+    queries, regressions = {}, []
+    missing = sorted(set(base_pq) - set(now_pq))
+    for q in missing:
+        regressions.append(f"{q}: missing from this run "
+                           "(present in baseline — crashed or timed out?)")
+    for q in sorted(set(base_pq) & set(now_pq)):
+        b, n = base_pq[q], now_pq[q]
+        d: dict = {}
+        flags = []
+        bw, nw = b.get("engine_warm_s"), n.get("engine_warm_s")
+        if bw and nw:
+            d["wall_s"] = {"base": bw, "now": nw,
+                           "ratio": round(nw / bw, 3)}
+            if nw > WALL_REGRESSION_RATIO * bw:
+                flags.append(f"wall +{(nw / bw - 1) * 100:.0f}% "
+                             f"({bw:.3f}s -> {nw:.3f}s)")
+        for k in BUDGET_COUNTERS:
+            bv, nv = b.get(k), n.get(k)
+            if bv is None or nv is None:
+                continue
+            d[k] = {"base": bv, "now": nv}
+            if nv > bv:
+                flags.append(f"{k} {bv} -> {nv}")
+        d["flags"] = flags
+        queries[q] = d
+        if flags:
+            regressions.append(f"{q}: " + "; ".join(flags))
+    return {"queries": queries, "missing": missing,
+            "regressions": regressions}
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=None, metavar="BENCH_JSON",
+                    help="prior bench JSON to diff per_query wall/dispatch/"
+                         "bytes against (prints a regression verdict line)")
+    args = ap.parse_args(argv)
+
     deadline = time.monotonic() + BUDGET
     remaining = lambda: deadline - time.monotonic()
 
@@ -305,6 +366,22 @@ def main():
         engine.register_catalog("tpch", conn)
         session = engine.create_session("tpch")
         T = _HostTables(conn)
+
+        # optional status sidecar (BENCH_STATUS_PORT): /v1/status serves the
+        # live in-flight registry + engine.last_stall_report so tpu_watch.sh
+        # can archive a post-mortem if the tunnel wedges mid-capture (the
+        # engine's stall watchdog arms via TRINO_TPU_STALL_S)
+        status_port = os.environ.get("BENCH_STATUS_PORT")
+        if status_port:
+            try:
+                from trino_tpu.server.server import CoordinatorServer
+
+                srv = CoordinatorServer(engine, port=int(status_port))
+                srv.start()
+                print(f"bench: status server at {srv.url}/v1/status",
+                      file=sys.stderr)
+            except Exception as se:
+                print(f"bench: status server failed: {se}", file=sys.stderr)
 
         names = [q.strip() for q in
                  os.environ.get("BENCH_QUERIES", "q1,q3,q4,q9,q18").split(",")
@@ -409,6 +486,25 @@ def main():
                 q: {"engine_warm_s": round(engine_times[q], 3),
                     "cpu_warm_s": round(cpu_times[q], 3),
                     **query_counters.get(q, {})} for q in done}
+        if args.baseline:
+            # BENCH trajectory comparison: diff against a prior capture and
+            # print a one-line verdict (stderr; stdout stays one JSON line)
+            try:
+                with open(args.baseline) as f:
+                    base = json.load(f)
+                diff = _baseline_diff(base.get("per_query") or {},
+                                      payload.get("per_query") or {})
+                payload["baseline"] = {"path": args.baseline, **diff}
+                if diff["regressions"]:
+                    print(f"bench: baseline REGRESSION vs {args.baseline} — "
+                          + " | ".join(diff["regressions"]), file=sys.stderr)
+                else:
+                    print(f"bench: baseline OK vs {args.baseline} "
+                          f"({len(diff['queries'])} queries compared)",
+                          file=sys.stderr)
+            except Exception as be:
+                print(f"bench: baseline diff failed: {type(be).__name__}: "
+                      f"{be}", file=sys.stderr)
         try:
             from benchenv import env_info
 

@@ -18,6 +18,33 @@ if ! flock -n 9; then
   exit 2
 fi
 echo "$(date -Is) watcher start (r06)" >> "$LOG"
+
+# Round 8: stall post-mortems.  Every bench run arms the engine's stall
+# watchdog (TRINO_TPU_STALL_S; 240s — cold Q1 compile alone is ~110s on the
+# tunnel, the threshold must clear any legit compile) and serves
+# GET /v1/status (BENCH_STATUS_PORT).  status_tail polls it in the
+# background and archives any "stalled" verdict — a wedge mid-capture
+# leaves scripts/stall_reports.jsonl (stuck site + thread stack) next to
+# the diag output instead of only an rc=124 null.
+STATUS_PORT=18923
+export TRINO_TPU_STALL_S="${TRINO_TPU_STALL_S:-240}"
+export BENCH_STATUS_PORT=$STATUS_PORT
+status_tail() {
+  while :; do
+    s=$(timeout 8 python -c "import urllib.request as u;print(u.urlopen('http://127.0.0.1:${STATUS_PORT}/v1/status',timeout=5).read().decode())" 2>/dev/null)
+    if [ -n "$s" ]; then
+      printf '%s\n' "$s" > scripts/stall_status_last.json
+      if printf '%s' "$s" | grep -q '"status": *"stalled"'; then
+        printf '%s\n' "$s" >> scripts/stall_reports.jsonl
+        echo "$(date -Is) STALL detected via /v1/status (archived to scripts/stall_reports.jsonl)" >> "$LOG"
+      fi
+    fi
+    sleep 20
+  done
+}
+status_tail &
+STATUS_TAIL_PID=$!
+trap 'kill $STATUS_TAIL_PID 2>/dev/null' EXIT
 for i in $(seq 1 250); do
   if timeout 150 python -c "import jax; d=jax.devices()[0]; assert d.platform != 'cpu', d" >> "$LOG" 2>&1; then
     echo "$(date -Is) TPU UP on probe $i — starting r06 capture" >> "$LOG"

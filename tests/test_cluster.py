@@ -294,6 +294,66 @@ def test_distributed_query_merges_worker_counters(tmp_path):
         coord.stop()
 
 
+def test_stalled_worker_marked_degraded_and_unscheduled(tmp_path):
+    """Round-8 acceptance: a worker whose stall watchdog reports a wedged
+    in-flight dispatch keeps answering HTTP (alive, harvestable, streams
+    drain/retry as before — the speculation and stream-RETRY paths covered
+    by the other tests in this module are untouched) but is marked DEGRADED:
+    the coordinator stops scheduling new tasks to it, the query completes
+    entirely on the healthy worker, and scheduling resumes once the stall
+    clears."""
+    e = _engine()
+    coord = ClusterCoordinator(e, str(tmp_path / "spool"),
+                               heartbeat_interval=0.1)
+    url = coord.start()
+    # realistic threshold: a genuine cold compile on this box takes seconds
+    # and must NOT read as a stall; the wedge below is injected as an entry
+    # aged far past it (the same record a _jit stuck on a dead tunnel holds)
+    wa = WorkerServer(CATALOGS, str(tmp_path / "spool"), coordinator_url=url,
+                      node_id="wa", stall_s=30.0)
+    wb = WorkerServer(CATALOGS, str(tmp_path / "spool"), coordinator_url=url,
+                      node_id="wb", stall_s=30.0)
+    wa.start()
+    wb.start()
+    try:
+        coord.wait_for_workers(2, timeout=60)
+        expected = e.execute_sql(Q).rows()
+        # wedge wa: an in-flight dispatch entry an hour old on ITS registry
+        tok = wa.inflight.enter("dispatch", site="probe.step")
+        wa.inflight._entries[tok].start_monotonic -= 3600.0
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with coord._lock:
+                w = coord.workers.get("wa")
+                if w is not None and w.degraded:
+                    break
+            time.sleep(0.05)
+        with coord._lock:
+            assert coord.workers["wa"].degraded, "wa never marked degraded"
+            assert coord.workers["wa"].alive, "degraded != dead"
+            assert coord.workers["wa"].health == "stalled"
+        assert {w.node_id for w in coord.live_workers()} == {"wb"}
+        # the query schedules ONLY onto the healthy worker and still succeeds
+        got = coord.execute_sql(Q).rows()
+        assert got == expected
+        assert coord.local_fallbacks == 0, coord.last_fallback_error
+        assert not wa.tasks, f"degraded worker received tasks: {list(wa.tasks)}"
+        assert wb.tasks, "healthy worker ran nothing"
+        # stall clears -> verdict recovers -> wa returns to scheduling
+        wa.inflight.exit(tok)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with coord._lock:
+                if not coord.workers["wa"].degraded:
+                    break
+            time.sleep(0.05)
+        assert {w.node_id for w in coord.live_workers()} == {"wa", "wb"}
+    finally:
+        wa.stop()
+        wb.stop()
+        coord.stop()
+
+
 def test_speculative_execution_of_stragglers(tmp_path):
     """Once every task is dispatched, a straggler re-dispatches to another
     worker; first-commit-wins dedup makes the duplicate harmless and the

@@ -368,3 +368,179 @@ def test_engine_query_id_trace_lookup(profiling_server, engine):
         timeout=10).read().decode())
     spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
     assert any(s["name"] == "query" for s in spans)
+
+
+# ------------------------------------------------- in-flight registry (round 8)
+def test_inflight_registry_entry_lifecycle():
+    """Entries carry the same "<Op>#<k>/<site>" label the counters' site
+    table uses, plus query id / thread / start time, and retire on exit."""
+    from trino_tpu.execution import tracing
+
+    reg = tracing.InflightRegistry()
+    with tracing.track_inflight(reg), tracing.query_scope("query_77"):
+        assert reg.depth() == 0
+        with tracing.operator_scope("Aggregate#3", None):
+            with tracing.inflight("dispatch", site="dstep"):
+                snap = reg.snapshot()
+                assert len(snap) == 1 and reg.depth() == 1
+                (e,) = snap
+                assert e["label"] == "Aggregate#3/dstep"
+                assert e["kind"] == "dispatch" and e["site"] == "dstep"
+                assert e["op"] == "Aggregate#3"
+                assert e["query_id"] == "query_77"
+                assert e["thread_id"] == threading.get_ident()
+                assert e["elapsed_s"] >= 0
+    assert reg.depth() == 0
+    # without an op scope the label degrades to the bare site
+    tok = reg.enter("host_pull", "agg.pull")
+    assert reg.snapshot()[0]["label"] == "agg.pull"
+    reg.exit(tok)
+    assert reg.depth() == 0
+
+
+def test_stall_watchdog_fake_clock_report_shape():
+    """Fake-clock stall detection: an entry 'aged' past the threshold yields
+    a structured report (label, query id, elapsed, stuck thread's stack,
+    extra memory info) and a live 'stalled' verdict; it clears on exit."""
+    from trino_tpu.execution import tracing
+
+    reg = tracing.InflightRegistry()
+    got = []
+    wd = tracing.StallWatchdog(registry=reg, stall_s=5.0, kill_s=0,
+                               on_stall=got.append,
+                               extra_info=lambda: {"memory": [{"pool": "p0"}]})
+    assert wd.enabled
+    with tracing.track_inflight(reg), tracing.query_scope("query_42"):
+        with tracing.operator_scope("HashJoin#2", None):
+            with tracing.inflight("dispatch", site="probe.step"):
+                now = time.monotonic() + 100.0  # fake clock: entry is 100s old
+                report = wd.check(now=now)
+                assert report is not None and wd.last_report is report
+                assert wd.stalled_now == 1 and got == [report]
+                assert wd.verdict(now=now) == ("stalled", 1)
+                assert report["threshold_s"] == 5.0
+                assert report["inflight_depth"] == 1
+                assert report["memory"] == [{"pool": "p0"}]
+                (e,) = report["stalled"]
+                assert e["label"] == "HashJoin#2/probe.step"
+                assert e["query_id"] == "query_42"
+                assert e["elapsed_s"] >= 100
+                # the stuck thread's live stack is in the report (it is THIS
+                # thread, so our own frame must appear)
+                assert e["stack"] and "test_stall_watchdog" in e["stack"]
+    # entry retired -> healthy again, gauge drops
+    assert wd.check(now=time.monotonic() + 100.0) is None
+    assert wd.stalled_now == 0
+    assert wd.verdict()[0] == "ok"
+    # a disabled watchdog (no threshold) never reports
+    off = tracing.StallWatchdog(registry=reg, stall_s=0)
+    assert not off.enabled and off.check() is None and off.verdict() == ("ok", 0)
+
+
+def test_slow_dispatch_stall_report_and_status_flip(profiling_server, engine):
+    """Acceptance: a deliberately-slowed dispatch (test hook) produces a
+    stall report naming the correct "<Op>#<k>/<site>" within one watchdog
+    period, and /v1/status reads "stalled" WHILE the dispatch hangs."""
+    from trino_tpu.execution import tracing
+
+    wd = engine.stall_watchdog
+    saved = (wd.stall_s, wd.poll_s)
+    wd.stall_s, wd.poll_s = 0.05, 0.01
+    engine.last_stall_report = None
+    status_seen = []
+
+    def hook(site):
+        # slow only the first two dispatches (enough for >1 watchdog period)
+        # and snapshot /v1/status from INSIDE the stall
+        if len(status_seen) < 2:
+            time.sleep(0.2)
+            status_seen.append(json.loads(urllib.request.urlopen(
+                profiling_server.url + "/v1/status", timeout=10)
+                .read().decode()))
+
+    try:
+        wd.start()
+        tracing.DISPATCH_TEST_HOOK = hook
+        s = engine.create_session("tpch")
+        engine.execute_sql(QUERY, s)
+    finally:
+        tracing.DISPATCH_TEST_HOOK = None
+        wd.stop()
+        wd.stall_s, wd.poll_s = saved
+    report = engine.last_stall_report
+    assert report is not None, "watchdog never reported"
+    labels = [e["label"] for e in report["stalled"]]
+    # the stuck site carries full operator attribution: "<Op>#<k>/<site>"
+    assert any("#" in lbl.split("/")[0] and "/" in lbl for lbl in labels), \
+        labels
+    assert any(e["stack"] for e in report["stalled"])
+    assert report.get("memory"), report.keys()
+    # the live status surface flipped while the dispatch hung
+    st = status_seen[0]
+    assert st["health"]["status"] == "stalled"
+    assert st["health"]["stalled"] >= 1
+    assert any(f["kind"] == "dispatch" for f in st["inflight"])
+    # the executing query is visible as RUNNING with its in-flight entries
+    running = [q for q in st["queries"] if q["state"] == "RUNNING"]
+    assert running and any(q["inflight"] for q in running)
+    # after the query finishes the verdict clears (watchdog still enabled at
+    # the lowered threshold inside the finally's restore window is fine —
+    # recompute against the restored config)
+    assert engine.health()["status"] == "ok"
+
+
+def test_status_endpoint_shape(profiling_server, engine):
+    from trino_tpu.server import Client
+
+    Client(profiling_server.url, catalog="tpch").execute(
+        "select count(*) from nation")
+    st = json.loads(urllib.request.urlopen(
+        profiling_server.url + "/v1/status", timeout=10).read().decode())
+    assert st["health"]["status"] == "ok"
+    assert st["health"]["watchdog"]["enabled"] in (True, False)
+    assert isinstance(st["inflight"], list)
+    assert isinstance(st["queries"], list)
+    # memory pools expose the MemoryPool snapshot dict, labeled
+    assert st["memory"], "no executor pools surfaced"
+    assert {"pool", "reserved", "max_bytes", "free"} <= set(st["memory"][0])
+
+
+def test_metrics_stall_memory_and_resource_group_gauges(profiling_server,
+                                                        engine):
+    """Round-8 satellite: MemoryPool snapshots + resource-group queue depths
+    + the stalled/in-flight gauges reach /v1/metrics as labeled gauges."""
+    from trino_tpu.server import Client
+
+    Client(profiling_server.url, catalog="tpch").execute(
+        "select count(*) from region")
+    body = urllib.request.urlopen(
+        profiling_server.url + "/v1/metrics", timeout=10).read().decode()
+    parsed = _parse_prometheus(body)
+    assert parsed["types"]["trino_tpu_stalled_dispatches"] == "gauge"
+    assert parsed["samples"]["trino_tpu_stalled_dispatches"][0][1] == 0
+    assert parsed["types"]["trino_tpu_inflight_entries"] == "gauge"
+    assert parsed["types"]["trino_tpu_memory_reserved_bytes"] == "gauge"
+    pools = parsed["samples"]["trino_tpu_memory_reserved_bytes"]
+    assert pools and all(lbl.get("pool") for lbl, _ in pools)
+    assert parsed["samples"]["trino_tpu_memory_max_bytes"][0][1] > 0
+    assert parsed["types"]["trino_tpu_resource_group_running"] == "gauge"
+    groups = parsed["samples"]["trino_tpu_resource_group_queued"]
+    assert groups and all(lbl.get("group") for lbl, _ in groups)
+
+
+def test_runtime_queries_boundary_columns(engine):
+    """Round-8 satellite: system.runtime.queries exposes device_dispatches /
+    host_bytes_pulled / elapsed_s so a SQL client sees spend without curling
+    /v1/metrics."""
+    s = engine.create_session("tpch")
+    engine.execute_sql("select count(*) from nation", s)
+    r = engine.execute_sql(
+        "select query_id, state, device_dispatches, host_bytes_pulled, "
+        "elapsed_s from system.queries", s)
+    rows = r.rows()
+    assert rows
+    finished = [row for row in rows if row[1] == "FINISHED"
+                and row[2] is not None]
+    assert finished, rows
+    qid, _, dd, hb, elapsed = finished[-1]
+    assert dd > 0 and hb > 0 and elapsed > 0

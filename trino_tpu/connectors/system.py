@@ -32,6 +32,11 @@ SCHEMAS = {
         Field("catalog", _V), Field("resource_group", _V), Field("query", _V),
         Field("rows", BIGINT), Field("queued_s", DOUBLE), Field("wall_s", DOUBLE),
         Field("error", _V),
+        # round 8: boundary spend visible to SQL clients — live counters for
+        # RUNNING queries (execution/tracing live registry), the completion
+        # snapshot afterwards; elapsed_s ticks from creation
+        Field("device_dispatches", BIGINT), Field("host_bytes_pulled", BIGINT),
+        Field("elapsed_s", DOUBLE),
     )),
     "nodes": Schema((
         Field("node_id", _V), Field("http_uri", _V), Field("node_version", _V),
@@ -144,11 +149,17 @@ class SystemConnector:
     def _rows(self, table: str) -> list[tuple]:
         e = self.engine
         if table == "queries":
+            from ..execution.tracing import live_query_counters
+
+            live = live_query_counters()
             out = []
             for q in e.query_tracker.all_queries():
                 i = q.info()
+                c = live.get(i.query_id) or getattr(q, "counters", None) or {}
                 out.append((i.query_id, i.state, i.user, i.catalog, i.resource_group,
-                            i.sql, i.rows, i.queued_s, i.wall_s, i.error))
+                            i.sql, i.rows, i.queued_s, i.wall_s, i.error,
+                            c.get("device_dispatches"),
+                            c.get("host_bytes_pulled"), i.elapsed_s))
             return out
         if table == "nodes":
             import jax

@@ -694,8 +694,14 @@ def read_fragment_outputs(exchange: SpoolingExchange, task_ids, schema):
         return (Page(schema, cols, tuple(None for _ in cols), None),
                 tuple(None for _ in range(ncols)))
     with tracing.maybe_span("exchange.read", tasks=len(task_ids)):
-        parts = [deserialize_fragment_output(exchange.read(t))
-                 for t in task_ids]
+        parts = []
+        for t in task_ids:
+            # one in-flight entry per task read: elapsed measures ONE
+            # potentially-wedging operation, so a long fan-in that is
+            # actively progressing never reads as a stall
+            with tracing.inflight("exchange-segment", site="exchange.read"):
+                data = exchange.read(t)
+            parts.append(deserialize_fragment_output(data))
     cols, nulls = concat_host_chunks(schema, [(p[0], p[1]) for p in parts])
     return padded_page(schema, cols, nulls), parts[0][2]
 
@@ -716,7 +722,16 @@ def read_streamed_outputs(fetch_stream, task_ids, schema):
         # pipelining time lives, distinct from device dispatches
         with tracing.maybe_span("exchange.stream", task=str(t)) as sp:
             n0 = len(parts)
-            for chunk in fetch_stream(t):
+            it = iter(fetch_stream(t))
+            while True:
+                # in-flight entry per CHUNK fetch: a multi-minute stream that
+                # keeps delivering pages must not age into a stall verdict —
+                # only an individual long-poll that never returns should
+                with tracing.inflight("exchange-segment",
+                                      site="exchange.stream"):
+                    chunk = next(it, None)
+                if chunk is None:
+                    break
                 parts.append(deserialize_fragment_output(chunk))
             sp.attributes["pages"] = len(parts) - n0
     if not parts:

@@ -60,10 +60,19 @@ def _jit(fn, site=None, **kwargs):
     label = site or getattr(fn, "__name__", "jit")
 
     def run(*args, **kw):
+        # in-flight registry entry/exit brackets the dispatch: a wedged
+        # tunnel round-trip is VISIBLE (site + operator + thread + elapsed)
+        # to the stall watchdog while it hangs, not just as a post-hoc
+        # latency-histogram blow-up
+        reg = tracing.current_inflight()
+        tok = reg.enter("dispatch", label)
         t0 = _time.perf_counter()
         try:
+            if tracing.DISPATCH_TEST_HOOK is not None:
+                tracing.DISPATCH_TEST_HOOK(label)
             return compiled(*args, **kw)
         finally:
+            reg.exit(tok)
             tracing.record_dispatch(site=label,
                                     seconds=_time.perf_counter() - t0)
 
@@ -664,7 +673,9 @@ class LocalExecutor:
         if isinstance(node, P.TableScan):
             conn = self.catalogs[node.catalog]
             dicts = tuple(conn.dictionaries(node.table).get(c) for c in node.columns)
-            with tracing.maybe_span("split-generation", table=node.table) as sp:
+            with tracing.maybe_span("split-generation", table=node.table) as sp, \
+                    tracing.inflight("split-generation",
+                                     site=f"scan.{node.table}"):
                 splits = conn.splits(node.table)
                 sp.attributes["splits"] = len(splits)
 
@@ -3779,17 +3790,24 @@ def _host(arrays, site=None):
     pulls on the active query's counters, which the warm-query budget tests
     assert against — a stray bulk pull added anywhere upstream fails them.
     ``site`` labels the pull for per-site attribution (every call site must
-    pass one or carry a ``# site-ok`` marker — tests/test_boundary_lint.py)."""
-    nbytes = 0
-    for a in arrays:
-        if hasattr(a, "copy_to_host_async"):
-            try:
-                a.copy_to_host_async()
-                nbytes += a.nbytes
-            except Exception:
-                pass
-    tracing.record_host_pull(nbytes, site=site)
-    return [None if a is None else np.asarray(a) for a in arrays]
+    pass one or carry a ``# site-ok`` marker — tests/test_boundary_lint.py).
+    Each pull also holds an in-flight registry entry while it runs, so a pull
+    wedged on a dead tunnel shows up in the stall watchdog's report."""
+    reg = tracing.current_inflight()
+    tok = reg.enter("host_pull", site)
+    try:
+        nbytes = 0
+        for a in arrays:
+            if hasattr(a, "copy_to_host_async"):
+                try:
+                    a.copy_to_host_async()
+                    nbytes += a.nbytes
+                except Exception:
+                    pass
+        tracing.record_host_pull(nbytes, site=site)
+        return [None if a is None else np.asarray(a) for a in arrays]
+    finally:
+        reg.exit(tok)
 
 
 def _host_page(page: Page, site="page"):

@@ -51,6 +51,9 @@ class QueryInfo:
     rows: Optional[int]
     wall_s: Optional[float]
     resource_group: Optional[str] = None
+    # wall since CREATION (queued time included) — still ticking for live
+    # queries; wall_s above only starts at RUNNING
+    elapsed_s: Optional[float] = None
 
     @property
     def queued_s(self) -> Optional[float]:
@@ -72,6 +75,12 @@ class QueryStateMachine:
         self.ended_s: Optional[float] = None
         self.error: Optional[str] = None
         self.rows: Optional[int] = None
+        # device-boundary profile set at completion by the engine
+        # (QueryCounters.as_dict(); None for statements that executed no
+        # plan) — system.runtime.queries falls back to it once the live
+        # counters deregister
+        self.counters: Optional[dict] = None
+        self.root_span_duration_s: Optional[float] = None
         self.machine: StateMachine[QueryState] = StateMachine(
             f"query {query_id}", QueryState.QUEUED, TERMINAL_STATES)
 
@@ -106,7 +115,8 @@ class QueryStateMachine:
             query_id=self.query_id, sql=self.sql, state=self.state.value,
             user=self.user, catalog=self.catalog, created_s=self.created_s,
             started_s=self.started_s, ended_s=self.ended_s, error=self.error,
-            rows=self.rows, wall_s=wall, resource_group=self.resource_group)
+            rows=self.rows, wall_s=wall, resource_group=self.resource_group,
+            elapsed_s=(self.ended_s or time.time()) - self.created_s)
 
 
 class QueryTracker:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import logging
 import threading
 import time
 from typing import Optional
@@ -20,7 +21,13 @@ __all__ = ["Span", "Tracer", "NOOP_TRACER", "QueryCounters", "track_counters",
            "current_counters", "record_dispatch", "record_host_pull",
            "record_coalesced", "LatencyHistogram", "LATENCY_BUCKETS_S",
            "operator_scope", "activate_tracer", "current_tracer",
-           "maybe_span", "span_dict", "spans_to_otlp"]
+           "maybe_span", "span_dict", "spans_to_otlp",
+           "InflightRegistry", "InflightEntry", "INFLIGHT", "inflight",
+           "track_inflight", "current_inflight", "query_scope",
+           "current_query_id", "live_query_counters", "StallWatchdog",
+           "StallKilledError", "DISPATCH_TEST_HOOK"]
+
+_log = logging.getLogger("trino_tpu.stall")
 
 
 # -- dispatch-latency histogram ------------------------------------------------
@@ -219,6 +226,14 @@ def current_counters() -> Optional[QueryCounters]:
     return getattr(_counter_local, "counters", None)
 
 
+# qid -> [QueryCounters...] currently recording (counters-so-far of RUNNING
+# queries): track_counters registers the thread's counters here whenever a
+# query scope is active, so /v1/status and system.runtime.queries can show a
+# live query's spend without waiting for it to finish
+_live_lock = threading.Lock()
+_live_counters: dict = {}
+
+
 @contextlib.contextmanager
 def track_counters(counters: QueryCounters):
     """Make ``counters`` the recording target for this thread; on exit the
@@ -228,10 +243,60 @@ def track_counters(counters: QueryCounters):
     charge the throwaway executor that runs them, not the outer query."""
     prev = getattr(_counter_local, "counters", None)
     _counter_local.counters = counters
+    qid = getattr(_counter_local, "query_id", None)
+    if qid is not None:
+        with _live_lock:
+            _live_counters.setdefault(qid, []).append(counters)
     try:
         yield counters
     finally:
         _counter_local.counters = prev
+        if qid is not None:
+            with _live_lock:
+                lst = _live_counters.get(qid)
+                if lst is not None:
+                    try:
+                        lst.remove(counters)
+                    except ValueError:
+                        pass
+                    if not lst:
+                        _live_counters.pop(qid, None)
+
+
+def live_query_counters() -> dict:
+    """query_id -> merged counters snapshot (``as_dict`` form) of every
+    counter set currently recording for that query.  Poll-grade approximate:
+    the owning threads keep incrementing while we read; a racing sites-dict
+    insert just skips that query this pass."""
+    with _live_lock:
+        items = {q: list(v) for q, v in _live_counters.items()}
+    out = {}
+    for qid, lst in items.items():
+        merged = QueryCounters()
+        try:
+            for c in lst:
+                merged.merge(c.snapshot())
+        except RuntimeError:  # sites dict resized mid-copy: skip this pass
+            continue
+        out[qid] = merged.as_dict()
+    return out
+
+
+@contextlib.contextmanager
+def query_scope(query_id: str):
+    """Tag this thread's boundary records and in-flight entries with the
+    executing query/task id (the engine wraps each statement; worker task
+    bodies wrap with their task id)."""
+    prev = getattr(_counter_local, "query_id", None)
+    _counter_local.query_id = query_id
+    try:
+        yield
+    finally:
+        _counter_local.query_id = prev
+
+
+def current_query_id() -> Optional[str]:
+    return getattr(_counter_local, "query_id", None)
 
 
 @contextlib.contextmanager
@@ -298,6 +363,288 @@ def record_coalesced(n_splits: int) -> None:
     c = getattr(_counter_local, "counters", None)
     if c is not None:
         c.coalesced_splits += n_splits
+
+
+# -- in-flight registry --------------------------------------------------------
+#
+# The counters/spans above are POST-HOC: a dispatch that never returns leaves
+# no record at all — on tunneled TPUs (round-5/7 captures) the dominant
+# failure mode is exactly that, a `_jit` round-trip wedged for hours while the
+# process looks idle.  The registry is the ground truth for "what is the
+# engine doing RIGHT NOW": every device dispatch, batched host pull,
+# split-generation pass and exchange segment records an entry on the way in
+# and retires it on the way out (the entry/exit lives INSIDE the _jit/_host
+# chokepoints, so the boundary lint that forces all executor code through
+# them guarantees registry coverage too).  The stall watchdog samples it;
+# /v1/status and worker heartbeats surface it.
+
+
+# Test hook: when set, called as hook(site_label) inside every in-flight
+# dispatch entry BEFORE the compiled function runs — the "deliberately-slowed
+# dispatch" the watchdog tests use.  Never set in production.
+DISPATCH_TEST_HOOK = None
+
+
+@dataclasses.dataclass
+class InflightEntry:
+    token: int
+    kind: str  # dispatch | host_pull | split-generation | exchange-segment
+    site: str
+    op: Optional[str]
+    label: str  # "<Op>#<k>/<site>" — same key shape as QueryCounters.sites
+    query_id: Optional[str]
+    thread_id: int
+    thread_name: str
+    start_monotonic: float
+
+    def as_dict(self, now: Optional[float] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        return {"kind": self.kind, "site": self.site, "op": self.op,
+                "label": self.label, "query_id": self.query_id,
+                "thread_id": self.thread_id, "thread_name": self.thread_name,
+                "elapsed_s": round(now - self.start_monotonic, 4)}
+
+
+class InflightRegistry:
+    """Live entries for work currently inside a device-boundary chokepoint.
+    Enter/exit cost is one lock + dict op each (microseconds against the
+    >100us a dispatch already costs) and adds NO dispatches or pulls, so the
+    warm-path budget ceilings are untouched."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict = {}
+        self._next = 1
+
+    def enter(self, kind: str, site: Optional[str] = None) -> int:
+        op = getattr(_counter_local, "op", None)
+        tag = site or "untagged"
+        label = f"{op[0]}/{tag}" if op is not None else tag
+        t = threading.current_thread()
+        with self._lock:
+            tok = self._next
+            self._next += 1
+            self._entries[tok] = InflightEntry(
+                tok, kind, tag, op[0] if op is not None else None, label,
+                getattr(_counter_local, "query_id", None),
+                t.ident, t.name, time.monotonic())
+        return tok
+
+    def exit(self, token: int) -> None:
+        with self._lock:
+            self._entries.pop(token, None)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot(self, now: Optional[float] = None) -> list:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            entries = sorted(self._entries.values(),
+                             key=lambda e: e.start_monotonic)
+        return [e.as_dict(now) for e in entries]
+
+    def stalled(self, threshold_s: float, now: Optional[float] = None) -> list:
+        """Entries older than ``threshold_s`` (InflightEntry objects)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return [e for e in self._entries.values()
+                    if now - e.start_monotonic >= threshold_s]
+
+
+INFLIGHT = InflightRegistry()
+
+
+def current_inflight() -> InflightRegistry:
+    """The thread's registry: the process-global INFLIGHT unless a scope
+    (an in-process WorkerServer's task body) installed its own."""
+    return getattr(_counter_local, "inflight", None) or INFLIGHT
+
+
+@contextlib.contextmanager
+def track_inflight(registry: InflightRegistry):
+    """Route this thread's in-flight entries to ``registry`` (worker task
+    bodies use their server's own registry so in-process test clusters don't
+    share stall state)."""
+    prev = getattr(_counter_local, "inflight", None)
+    _counter_local.inflight = registry
+    try:
+        yield registry
+    finally:
+        _counter_local.inflight = prev
+
+
+@contextlib.contextmanager
+def inflight(kind: str, site: Optional[str] = None):
+    """Record one in-flight entry around a potentially-wedging operation
+    (split generation, exchange segments; _jit/_host inline the same calls)."""
+    reg = current_inflight()
+    tok = reg.enter(kind, site)
+    try:
+        yield
+    finally:
+        reg.exit(tok)
+
+
+# -- stall watchdog ------------------------------------------------------------
+
+
+class StallKilledError(RuntimeError):
+    """Raised (asynchronously) in a thread whose in-flight entry exceeded
+    TRINO_TPU_STALL_KILL_S.  Python async exceptions deliver when the
+    interpreter resumes — a thread wedged inside one C-level XLA call dies
+    the moment the call finally returns, not before."""
+
+
+def _env_seconds(name: str) -> Optional[float]:
+    import os
+
+    try:
+        v = float(os.environ.get(name, "") or 0)
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
+class StallWatchdog:
+    """Samples an InflightRegistry for entries older than ``stall_s``
+    (TRINO_TPU_STALL_S; unset/0 = disabled, the CPU default) and emits a
+    structured stall report: the stuck "<Op>#<k>/<site>" labels, elapsed,
+    each stuck thread's ``sys._current_frames()`` stack, plus whatever
+    ``extra_info`` supplies (memory-pool snapshots).  ``kill_s``
+    (TRINO_TPU_STALL_KILL_S) optionally hard-aborts the stuck thread with an
+    async StallKilledError.  ``clock`` is injectable for fake-clock tests;
+    ``check(now=...)`` runs one sampling pass synchronously."""
+
+    def __init__(self, registry: Optional[InflightRegistry] = None,
+                 stall_s: Optional[float] = None,
+                 kill_s: Optional[float] = None,
+                 poll_s: Optional[float] = None,
+                 on_stall=None, clock=None, extra_info=None):
+        self.registry = registry if registry is not None else INFLIGHT
+        self.stall_s = stall_s if stall_s is not None \
+            else _env_seconds("TRINO_TPU_STALL_S")
+        self.kill_s = kill_s if kill_s is not None \
+            else _env_seconds("TRINO_TPU_STALL_KILL_S")
+        self.poll_s = poll_s if poll_s is not None else (
+            min(max(self.stall_s / 4, 0.05), 1.0) if self.stall_s else 1.0)
+        self.on_stall = on_stall
+        self.clock = clock or time.monotonic
+        self.extra_info = extra_info
+        self.last_report: Optional[dict] = None
+        self.stalled_now = 0  # gauge: entries over threshold at last check
+        self.reports = 0  # sampling passes that found stalls
+        self.kills = 0
+        self._killed: set = set()  # entry tokens already async-killed
+        self._last_labels: tuple = ()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.stall_s)
+
+    def verdict(self, now: Optional[float] = None):
+        """("ok"|"stalled", stalled_count) recomputed LIVE from the registry
+        — health surfaces read this so a wedge is visible without waiting for
+        the next watchdog pass."""
+        if not self.enabled:
+            return "ok", 0
+        n = len(self.registry.stalled(
+            self.stall_s, now if now is not None else self.clock()))
+        return ("stalled" if n else "ok"), n
+
+    def check(self, now: Optional[float] = None) -> Optional[dict]:
+        """One sampling pass; returns (and stores) the report when any entry
+        is over threshold, else None."""
+        if not self.enabled:
+            return None
+        now = self.clock() if now is None else now
+        stalled = self.registry.stalled(self.stall_s, now)
+        self.stalled_now = len(stalled)
+        if not stalled:
+            self._last_labels = ()
+            return None
+        report = self._build_report(stalled, now)
+        self.last_report = report
+        self.reports += 1
+        labels = tuple(sorted(e.label for e in stalled))
+        if labels != self._last_labels:  # log on change, not every poll
+            self._last_labels = labels
+            _log.warning("stall watchdog: %d in-flight entr%s over %.1fs: %s",
+                         len(stalled), "y" if len(stalled) == 1 else "ies",
+                         self.stall_s, ", ".join(labels))
+        if self.on_stall is not None:
+            try:
+                self.on_stall(report)
+            except Exception:
+                pass
+        if self.kill_s:
+            for e in stalled:
+                if now - e.start_monotonic >= self.kill_s \
+                        and e.token not in self._killed:
+                    self._killed.add(e.token)
+                    self._async_kill(e)
+        return report
+
+    def _build_report(self, stalled, now: float) -> dict:
+        import sys
+        import traceback
+
+        frames = sys._current_frames()
+        entries = []
+        for e in sorted(stalled, key=lambda x: x.start_monotonic):
+            f = frames.get(e.thread_id)
+            d = e.as_dict(now)
+            d["stack"] = "".join(traceback.format_stack(f)) \
+                if f is not None else None
+            entries.append(d)
+        report = {"detected_at_s": time.time(),
+                  "threshold_s": self.stall_s,
+                  "stalled": entries,
+                  "inflight_depth": self.registry.depth()}
+        if self.extra_info is not None:
+            try:
+                report.update(self.extra_info() or {})
+            except Exception:
+                pass
+        return report
+
+    def _async_kill(self, entry: InflightEntry) -> None:
+        import ctypes
+
+        self.kills += 1
+        _log.error("stall watchdog: hard-aborting thread %s (%s, wedged "
+                   "past %.1fs kill threshold)", entry.thread_name,
+                   entry.label, self.kill_s)
+        try:
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_long(entry.thread_id),
+                ctypes.py_object(StallKilledError))
+        except Exception:
+            pass
+
+    def start(self) -> None:
+        if not self.enabled or self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="stall-watchdog", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.check()
+            except Exception:  # a watchdog crash must never take the engine
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
 
 
 @dataclasses.dataclass

@@ -179,8 +179,14 @@ class MemoryPool:
             return dict(self._by_query)
 
     def info(self) -> dict:
+        """Snapshot dict — the shape /v1/status, the /v1/metrics pool gauges
+        and the stall watchdog's memory section all serve (round 8: this
+        finally reaches the observability endpoints instead of only the UI
+        overview)."""
         with self._lock:
             return {"max_bytes": self.max_bytes, "reserved": self.reserved,
+                    "free": self.max_bytes - self.reserved,
+                    "query_reserved": self.query_reserved,
                     "by_tag": dict(self._by_tag),
                     "by_query": dict(self._by_query)}
 

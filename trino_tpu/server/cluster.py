@@ -61,7 +61,8 @@ from ..exec.fte import (FaultTolerantExecutor, SpoolingExchange,
                         serialize_fragment_output)
 from ..exec.local_executor import LocalExecutor, _materialize
 from ..execution import tracing
-from ..execution.tracing import QueryCounters, Tracer
+from ..execution.tracing import (InflightRegistry, QueryCounters,
+                                 StallWatchdog, Tracer)
 from ..sql import plan as P
 
 __all__ = ["WorkerServer", "ClusterCoordinator", "build_catalogs"]
@@ -286,7 +287,8 @@ class WorkerServer:
     def __init__(self, catalogs_config: dict, spool_dir: str,
                  host: str = "127.0.0.1", port: int = 0,
                  coordinator_url: Optional[str] = None, node_id: str = "worker",
-                 announce_interval: float = 0.5, secret: Optional[str] = None):
+                 announce_interval: float = 0.5, secret: Optional[str] = None,
+                 stall_s: Optional[float] = None):
         # the fragment envelope is pickled (arbitrary-code-execution on
         # deserialize), so the task endpoints are authenticated like the
         # reference's internal communication channel
@@ -312,6 +314,20 @@ class WorkerServer:
         # task id) whose finished tree rides the status response back to the
         # coordinator
         self.tracer = Tracer()
+        # worker-local in-flight registry + stall watchdog (round 8): task
+        # bodies route their _jit/_host entries here (NOT the process-global
+        # INFLIGHT — in-process test clusters must not share stall state);
+        # the health verdict piggybacks on /v1/info and announces, so a
+        # wedged-but-HTTP-alive worker reads as "stalled" to the coordinator
+        # (reference: HeartbeatFailureDetector reading real node state, not
+        # just socket liveness).  stall_s falls back to TRINO_TPU_STALL_S;
+        # unset = watchdog off, health always "ok".
+        self.inflight = InflightRegistry()
+        self.last_stall_report: Optional[dict] = None
+        self.stall_watchdog = StallWatchdog(
+            registry=self.inflight, stall_s=stall_s,
+            on_stall=self._on_stall,
+            extra_info=lambda: {"memory": [self.memory_pool.info()]})
         self.spool_dir = spool_dir
         self.host, self.port = host, port
         self.node_id = node_id
@@ -383,6 +399,13 @@ class WorkerServer:
                 if self.path == "/v1/info":
                     state = "shutting_down" if worker._draining else "active"
                     pool = worker.memory_pool
+                    # health verdict rides the heartbeat: a wedged dispatch
+                    # flips this while the HTTP thread still answers.  The
+                    # stall report (stacks + memory dump) ships only WHILE
+                    # stalled — the coordinator keeps its last-seen copy, so
+                    # a resolved stall's post-mortem survives there without
+                    # every later heartbeat hauling a stale multi-KB dump
+                    health = worker._health()
                     return self._reply(200, {"node_id": worker.node_id,
                                              "state": state,
                                              "peak_concurrency":
@@ -391,7 +414,12 @@ class WorkerServer:
                                              "mem_max": pool.max_bytes,
                                              "mem_by_query": pool.by_query(),
                                              "scheduler":
-                                                 worker.scheduler.info()})
+                                                 worker.scheduler.info(),
+                                             **health,
+                                             "stall_report":
+                                                 worker.last_stall_report
+                                                 if health["health"]
+                                                 == "stalled" else None})
                 if "/results/" in self.path and self.path.startswith("/v1/task/"):
                     # streamed page read:
                     #   /v1/task/{tid}/results/{reader}/{token}
@@ -518,6 +546,7 @@ class WorkerServer:
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_port
         threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        self.stall_watchdog.start()  # no-op unless a threshold is configured
         if self.coordinator_url:
             threading.Thread(target=self._announce_loop, daemon=True).start()
         return self.url
@@ -528,8 +557,20 @@ class WorkerServer:
 
     def stop(self):
         self._stop.set()
+        self.stall_watchdog.stop()
         if self._httpd:
             self._httpd.shutdown()
+
+    def _on_stall(self, report: dict) -> None:
+        self.last_stall_report = report
+
+    def _health(self) -> dict:
+        """Live node-health verdict for heartbeats/announces: "stalled" when
+        any in-flight entry on THIS worker's registry exceeds the watchdog
+        threshold, recomputed per request (no watchdog-poll latency)."""
+        verdict, n = self.stall_watchdog.verdict()
+        return {"health": verdict, "stalled": n,
+                "inflight": self.inflight.depth()}
 
     def _announce_loop(self):
         while not self._stop.is_set():
@@ -541,6 +582,7 @@ class WorkerServer:
                                   "state": state,
                                   "mem_reserved": self.memory_pool.reserved,
                                   "mem_max": self.memory_pool.max_bytes,
+                                  **self._health(),
                                   }).encode(),
                       secret=self.secret)
             except Exception:
@@ -671,7 +713,13 @@ class WorkerServer:
                 # _jit dispatch / _host pull on this worker is attributed and
                 # shippable back to the coordinator
                 counters = QueryCounters()
-                with tracing.activate_tracer(self.tracer), \
+                # track_inflight: this task's dispatches/pulls register on
+                # the WORKER's registry (per-node stall attribution);
+                # query_scope tags the entries with the task id so a stall
+                # report names the wedged task
+                with tracing.track_inflight(self.inflight), \
+                        tracing.query_scope(tid), \
+                        tracing.activate_tracer(self.tracer), \
                         self.tracer.span("task", trace_id=tid, task=tid,
                                          kind=kind, node=self.node_id), \
                         tracing.track_counters(counters), \
@@ -784,6 +832,14 @@ class _WorkerInfo:
     mem_max: int = 0  # last announced pool capacity (bytes)
     mem_by_query: dict = dataclasses.field(default_factory=dict)  # per-query
     # attribution from the worker pool (feeds the low-memory kill policy)
+    # round 8: the worker's self-reported stall verdict.  degraded = the
+    # worker's watchdog says a device-boundary operation is wedged — its
+    # HTTP thread still answers (so `alive` stays True and running streams
+    # keep draining / retrying) but NEW tasks schedule elsewhere
+    health: str = "ok"
+    degraded: bool = False
+    inflight: int = 0  # worker-reported in-flight depth (observability)
+    stall_report: Optional[dict] = None  # last report seen on a heartbeat
 
 
 class ClusterCoordinator:
@@ -921,7 +977,9 @@ class ClusterCoordinator:
                     coord._announce(msg["node_id"], msg["url"],
                                     msg.get("state", "active"),
                                     msg.get("mem_reserved"),
-                                    msg.get("mem_max"))
+                                    msg.get("mem_max"),
+                                    health=msg.get("health"),
+                                    inflight=msg.get("inflight"))
                     return self._reply(200, {"ok": True})
                 self._reply(404, {"error": "not found"})
 
@@ -929,7 +987,9 @@ class ClusterCoordinator:
                 if self.path == "/v1/nodes":
                     with coord._lock:
                         nodes = [{"node_id": w.node_id, "url": w.url,
-                                  "alive": w.alive} for w in
+                                  "alive": w.alive, "health": w.health,
+                                  "degraded": w.degraded,
+                                  "inflight": w.inflight} for w in
                                  coord.workers.values()]
                     return self._reply(200, {"nodes": nodes})
                 if self.path == "/v1/memory":
@@ -967,7 +1027,7 @@ class ClusterCoordinator:
                                   and w["mem_reserved"] > 0.9 * w["mem_max"]]}
 
     def _announce(self, node_id: str, url: str, state: str = "active",
-                  mem_reserved=None, mem_max=None):
+                  mem_reserved=None, mem_max=None, health=None, inflight=None):
         with self._lock:
             if state == "gone":  # graceful exit: leave the cluster NOW
                 self.workers.pop(node_id, None)
@@ -991,6 +1051,11 @@ class ClusterCoordinator:
                 w.mem_reserved = int(mem_reserved)
             if mem_max is not None:
                 w.mem_max = int(mem_max)
+            if health is not None:
+                w.health = str(health)
+                w.degraded = (w.health == "stalled")
+            if inflight is not None:
+                w.inflight = int(inflight)
 
     def _heartbeat_loop(self):
         """HeartbeatFailureDetector (simplified): probe /v1/info; max_misses
@@ -1010,6 +1075,15 @@ class ClusterCoordinator:
                             w.mem_reserved = int(info["mem_reserved"])
                             w.mem_max = int(info.get("mem_max", 0))
                         w.mem_by_query = info.get("mem_by_query") or {}
+                        # the worker's self-reported stall verdict: a wedged
+                        # worker whose HTTP thread still answers must NOT
+                        # keep receiving tasks (reference: the failure
+                        # detector reading node state, not socket liveness)
+                        w.health = str(info.get("health", "ok"))
+                        w.degraded = (w.health == "stalled")
+                        w.inflight = int(info.get("inflight", 0) or 0)
+                        if info.get("stall_report"):
+                            w.stall_report = info["stall_report"]
                 except Exception:
                     with self._lock:
                         w.misses += 1
@@ -1054,12 +1128,16 @@ class ClusterCoordinator:
                 pass  # a dead worker frees its memory with its process
 
     def live_workers(self) -> list:
-        """Schedulable workers: alive and not draining (a gracefully
-        shutting-down node finishes its running tasks but takes no new
-        ones — reference: NodeState.SHUTTING_DOWN excluded from scheduling)."""
+        """Schedulable workers: alive, not draining, and not DEGRADED (a
+        gracefully shutting-down node finishes its running tasks but takes
+        no new ones — reference: NodeState.SHUTTING_DOWN excluded from
+        scheduling).  A degraded worker (its watchdog reported a stalled
+        in-flight entry) stays `alive` — status polls and stream drains keep
+        working, the existing timeout/stream-RETRY paths recover its running
+        tasks — but receives no new work until its verdict clears."""
         with self._lock:
             return [w for w in self.workers.values()
-                    if w.alive and not w.draining]
+                    if w.alive and not w.draining and not w.degraded]
 
     def wait_for_workers(self, n: int, timeout: float = 20.0):
         deadline = time.time() + timeout

@@ -164,6 +164,51 @@ class _Query:
     lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
 
 
+_device_stats_lock = threading.Lock()
+_device_stats_cache = {"stats": None, "at": 0.0, "probe_started": 0.0,
+                       "probing": False}
+
+
+def _device_memory_stats(max_age: float = 15.0, timeout: float = 2.0,
+                         rearm_s: float = 600.0):
+    """Device memory stats WITHOUT blocking the caller: the PJRT
+    ``memory_stats()`` call can itself hang on a wedged tunnel — exactly when
+    /v1/status is being polled for a post-mortem — so the probe runs on a
+    background thread with a join timeout and callers get the last good
+    snapshot.  A probe that never returns parks the ``probing`` flag;
+    ``rearm_s`` re-arms probing after a hang so a RECOVERED tunnel becomes
+    visible again (each re-arm risks one more parked thread, so the cap is
+    generous: a 3h wedge parks at most ~18)."""
+    now = time.time()
+    with _device_stats_lock:
+        if now - _device_stats_cache["at"] <= max_age:
+            return _device_stats_cache["stats"]
+        if _device_stats_cache["probing"] \
+                and now - _device_stats_cache["probe_started"] < rearm_s:
+            return _device_stats_cache["stats"]
+        _device_stats_cache["probing"] = True
+        _device_stats_cache["probe_started"] = now
+
+    def probe():
+        stats = None
+        try:
+            import jax
+
+            stats = jax.devices()[0].memory_stats()
+        except Exception:
+            pass
+        with _device_stats_lock:
+            _device_stats_cache["stats"] = stats
+            _device_stats_cache["at"] = time.time()
+            _device_stats_cache["probing"] = False
+
+    t = threading.Thread(target=probe, daemon=True, name="device-stats-probe")
+    t.start()
+    t.join(timeout)
+    with _device_stats_lock:
+        return _device_stats_cache["stats"]
+
+
 def _json_value(v):
     import numpy as np
 
@@ -288,6 +333,15 @@ class CoordinatorServer:
                 if parts == ["v1", "info"]:
                     self._send(200, {"coordinator": True, "running": True,
                                      "nodeVersion": {"version": "trino-tpu-0"}})
+                    return
+                if parts == ["v1", "status"]:
+                    # live in-flight introspection (round 8): running queries
+                    # with counters-so-far, the in-flight registry, health
+                    # verdict, stall report, memory pools + device stats —
+                    # the "what is the engine doing right now" surface the
+                    # tunnel-wedge post-mortems need (reference: QueryInfo/
+                    # TaskInfo live snapshots behind the web UI)
+                    self._send(200, server._status_json())
                     return
                 # /v1/spooled/{qid}/{seg} — spooled result segment payload
                 # (reference: the client fetching spooled segments by URI,
@@ -544,7 +598,94 @@ class CoordinatorServer:
                     f"trino_tpu_dispatch_latency_seconds_sum {h['sum_s']}")
                 lines.append(
                     f"trino_tpu_dispatch_latency_seconds_count {h['count']}")
+        # round 8: live in-flight / stall gauges — the wedge is visible as a
+        # nonzero stalled gauge WHILE it happens, not only as a post-hoc p99
+        from ..execution import tracing as _tracing
+
+        wd = getattr(self.engine, "stall_watchdog", None)
+        stalled = wd.verdict()[1] if wd is not None else 0
+        lines += [
+            "# HELP trino_tpu_inflight_entries Device-boundary operations "
+            "currently executing (dispatches, pulls, split generation, "
+            "exchange segments).",
+            "# TYPE trino_tpu_inflight_entries gauge",
+            f"trino_tpu_inflight_entries {_tracing.INFLIGHT.depth()}",
+            "# HELP trino_tpu_stalled_dispatches In-flight entries older "
+            "than the TRINO_TPU_STALL_S threshold (0 when the watchdog is "
+            "disabled).",
+            "# TYPE trino_tpu_stalled_dispatches gauge",
+            f"trino_tpu_stalled_dispatches {stalled}",
+        ]
+        # memory-pool snapshots as labeled gauges (the pool info dict finally
+        # reaches the metrics endpoint — round-8 satellite)
+        pools = self.engine.memory_info() \
+            if hasattr(self.engine, "memory_info") else []
+        if pools:
+            lines += ["# HELP trino_tpu_memory_reserved_bytes Bytes reserved "
+                      "in each executor memory pool.",
+                      "# TYPE trino_tpu_memory_reserved_bytes gauge"]
+            for d in pools:
+                lines.append(f'trino_tpu_memory_reserved_bytes'
+                             f'{{pool="{esc(d["pool"])}"}} {d["reserved"]}')
+            lines += ["# HELP trino_tpu_memory_max_bytes Capacity of each "
+                      "executor memory pool.",
+                      "# TYPE trino_tpu_memory_max_bytes gauge"]
+            for d in pools:
+                lines.append(f'trino_tpu_memory_max_bytes'
+                             f'{{pool="{esc(d["pool"])}"}} {d["max_bytes"]}')
+        # resource-group queue depths (reference: the resource-group JMX
+        # metrics the reference exports per group)
+        groups = []
+        try:
+            groups = self.engine.resource_groups.info()
+        except Exception:
+            pass
+        if groups:
+            lines += ["# HELP trino_tpu_resource_group_running Queries "
+                      "running per resource group.",
+                      "# TYPE trino_tpu_resource_group_running gauge"]
+            for g in groups:
+                lines.append(f'trino_tpu_resource_group_running'
+                             f'{{group="{esc(g["name"])}"}} {g["running"]}')
+            lines += ["# HELP trino_tpu_resource_group_queued Queries queued "
+                      "per resource group.",
+                      "# TYPE trino_tpu_resource_group_queued gauge"]
+            for g in groups:
+                lines.append(f'trino_tpu_resource_group_queued'
+                             f'{{group="{esc(g["name"])}"}} {g["queued"]}')
         return "\n".join(lines) + "\n"
+
+    def _status_json(self) -> dict:
+        """GET /v1/status payload: engine health + the live registry.  Reads
+        engine state lock-free (poll-grade snapshot; nothing here may block
+        on a running query — this endpoint exists precisely for when one is
+        wedged)."""
+        from ..execution import tracing
+
+        e = self.engine
+        health = e.health() if hasattr(e, "health") else {"status": "ok"}
+        live = tracing.live_query_counters()
+        inflight = tracing.INFLIGHT.snapshot()
+        queries = []
+        tracker = getattr(e, "query_tracker", None)
+        if tracker is not None:
+            for q in tracker.all_queries():
+                if q.is_done:
+                    continue
+                i = q.info()
+                queries.append({
+                    "query_id": i.query_id, "state": i.state, "user": i.user,
+                    "elapsed_s": round(i.elapsed_s or 0.0, 3),
+                    "sql": i.sql[:500],
+                    "counters": live.get(i.query_id),
+                    "inflight": [f for f in inflight
+                                 if f.get("query_id") == i.query_id]})
+        return {"health": health,
+                "stall_report": getattr(e, "last_stall_report", None),
+                "inflight": inflight,
+                "queries": queries,
+                "memory": e.memory_info() if hasattr(e, "memory_info") else [],
+                "device_memory": _device_memory_stats()}
 
     def _query_row_count(self, q):
         """Result row count for UI surfaces: spooled queries hold their rows
