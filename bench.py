@@ -304,7 +304,15 @@ def main(argv=None):
     ap.add_argument("--baseline", default=None, metavar="BENCH_JSON",
                     help="prior bench JSON to diff per_query wall/dispatch/"
                          "bytes against (prints a regression verdict line)")
+    ap.add_argument("--no-page-cache", action="store_true",
+                    help="force the device buffer pool OFF for this run "
+                         "(TRINO_TPU_PAGE_CACHE=0) — the cache-off half of "
+                         "an A/B pair; per_query embeds page_cache_hits/"
+                         "misses/bytes_saved either way, so diffing two runs "
+                         "quantifies exactly what the pool saved")
     args = ap.parse_args(argv)
+    if args.no_page_cache:
+        os.environ["TRINO_TPU_PAGE_CACHE"] = "0"
 
     deadline = time.monotonic() + BUDGET
     remaining = lambda: deadline - time.monotonic()
@@ -509,6 +517,16 @@ def main(argv=None):
             from benchenv import env_info
 
             payload["env"] = env_info()
+        except Exception:
+            pass
+        try:
+            # buffer-pool end-state: entries/bytes/hit totals (per_query
+            # already carries each query's page_cache_* counters via as_dict)
+            bp = getattr(engine, "buffer_pool", None)
+            if bp is not None:
+                bi = bp.info()
+                bi.pop("per_table", None)  # one JSON line: keep it flat-ish
+                payload["page_cache"] = bi
         except Exception:
             pass
         print(json.dumps(payload), flush=True)

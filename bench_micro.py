@@ -261,6 +261,40 @@ def bench_dispatch_coalesce(nrows):
     return None
 
 
+def bench_h2d_transfer(nrows):
+    """Host->device staging bandwidth curve over page sizes — the transfer
+    the device buffer pool's page tier saves on every warm scan.  For each
+    page size: median wall of jax.device_put(numpy int64 column) +
+    block_until_ready, reported as bytes/s.  On the CPU backend this is a
+    memcpy (upper bound); on a tunneled TPU it is the real H2D bill, and
+    (bytes_saved from bench.py per_query) / (bytes/s here) estimates the
+    wall-clock the cache bought — capture both on the next tunnel window."""
+    import jax
+
+    import numpy as np
+
+    curve = []
+    size = 1 << 16
+    while size <= max(nrows, 1 << 16):
+        arr = np.arange(size, dtype=np.int64)
+        def put(arr=arr):
+            jax.device_put(arr).block_until_ready()
+        put()  # warm: allocator + executable paths
+        ts = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            put()
+            ts.append(time.perf_counter() - t0)
+        med = sorted(ts)[len(ts) // 2]
+        curve.append({"rows": size, "bytes": size * 8,
+                      "ms": round(med * 1000, 4),
+                      "bytes_per_sec": round(size * 8 / med)})
+        size <<= 2
+    print(json.dumps({"kernel": "h2d_transfer", "rows": nrows,
+                      "curve": curve, "env": env_info()}), flush=True)
+    return None
+
+
 KERNELS = {
     "hashagg_insert": bench_hashagg_insert,
     "join_build": bench_join_build,
@@ -271,6 +305,7 @@ KERNELS = {
     "compact": bench_compact,
     "exchange_stream_vs_spool": bench_exchange_stream_vs_spool,
     "dispatch_coalesce": bench_dispatch_coalesce,
+    "h2d_transfer": bench_h2d_transfer,
 }
 
 

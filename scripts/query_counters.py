@@ -49,6 +49,12 @@ def main():
                     help="also trace with dispatch_batch=N and print batch=1 "
                          "vs batch=N side by side (coalescing A/B; default: "
                          "trace only the session default)")
+    ap.add_argument("--page-cache", type=int, default=None, metavar="BYTES",
+                    help="device buffer-pool budget for this trace "
+                         "(TRINO_TPU_PAGE_CACHE; 0 = off).  The round-9 "
+                         "budget ceilings derive with the cache ON — run "
+                         "once with the budget the test fixture sets and "
+                         "once with 0 for the A/B the docstring records")
     ap.add_argument("--sites", action="store_true",
                     help="print each warm query's per-site attribution table "
                          "(operator/call-site -> dispatches, transfers, "
@@ -56,6 +62,8 @@ def main():
                          "cite when a ceiling regresses")
     args = ap.parse_args()
 
+    if args.page_cache is not None:
+        os.environ["TRINO_TPU_PAGE_CACHE"] = str(args.page_cache)
     sf = float(os.environ.get("TRACE_SF", "1"))
     split_rows = int(os.environ.get("TRACE_SPLIT_ROWS", str(1 << 21)))
     names = [q.strip() for q in

@@ -1,15 +1,18 @@
 #!/bin/bash
-# Probe the axon TPU tunnel; the moment it answers, capture the round-6
-# matrix into BENCH_local_r06.json: tunnel diagnosis, the dispatch-coalescing
-# microbench curve (batch K in {1,2,4,8,16} — the per-dispatch overhead this
-# round's whole design bets on), then SF1/SF10 bench A/B at dispatch batch
-# 4 vs 1 (scan-fused stays OFF everywhere: the r05 capture proved on-device
-# regeneration loses on the tunnel; coalescing batches HOST-generated pages
-# instead).  Capture order is priority order — the tunnel historically wedges
-# within ~30 min of first contact, so the cheap, decision-driving runs go
-# first.  Exits 0 after capture, 1 if the tunnel never recovered within the
-# probe window.  Single-instance: flock on scripts/tpu_watch.lock — a second
-# watcher touching the device can wedge the tunnel (CLAUDE.md).
+# Probe the axon TPU tunnel; the moment it answers, capture the round-9
+# matrix into BENCH_local_r09.json: tunnel diagnosis, the H2D-transfer and
+# dispatch-coalescing microbench curves, then the BUFFER-POOL A/B — SF1 with
+# the device page cache on vs off (TRINO_TPU_PAGE_CACHE), then SF10 the same
+# (scan-fused stays OFF everywhere: the r05 capture proved on-device
+# regeneration loses on the tunnel; the pool keeps HOST-generated pages
+# RESIDENT instead, which should zero the per-split generation round-trips
+# warm).  Each bench JSON embeds per_query page_cache hits/misses/bytes_saved
+# — the hit-rate archive the round-9 issue asks for.  Capture order is
+# priority order — the tunnel historically wedges within ~30 min of first
+# contact, so the cheap, decision-driving runs go first.  Exits 0 after
+# capture, 1 if the tunnel never recovered within the probe window.
+# Single-instance: flock on scripts/tpu_watch.lock — a second watcher
+# touching the device can wedge the tunnel (CLAUDE.md).
 cd /root/repo
 LOG=scripts/tpu_watch.log
 exec 9> scripts/tpu_watch.lock
@@ -17,7 +20,7 @@ if ! flock -n 9; then
   echo "$(date -Is) another watcher holds the lock; exiting" >> "$LOG"
   exit 2
 fi
-echo "$(date -Is) watcher start (r06)" >> "$LOG"
+echo "$(date -Is) watcher start (r09)" >> "$LOG"
 
 # Round 8: stall post-mortems.  Every bench run arms the engine's stall
 # watchdog (TRINO_TPU_STALL_S; 240s — cold Q1 compile alone is ~110s on the
@@ -47,26 +50,28 @@ STATUS_TAIL_PID=$!
 trap 'kill $STATUS_TAIL_PID 2>/dev/null' EXIT
 for i in $(seq 1 250); do
   if timeout 150 python -c "import jax; d=jax.devices()[0]; assert d.platform != 'cpu', d" >> "$LOG" 2>&1; then
-    echo "$(date -Is) TPU UP on probe $i — starting r06 capture" >> "$LOG"
+    echo "$(date -Is) TPU UP on probe $i — starting r09 capture" >> "$LOG"
     # tunnel diagnosis FIRST (fast): per-dispatch overhead + traced Q3/Q18
     # sync sites — the data that decides the round-trip-reduction work
     timeout -k 60 1500 python scripts/tpu_diag.py \
       > scripts/tpu_diag.out 2>&1
     echo "$(date -Is) tpu_diag rc=$? : $(tail -c 300 scripts/tpu_diag.json 2>/dev/null)" >> "$LOG"
-    # dispatch-coalescing overhead curve (NEW in r06): fixed rows, batch K
-    # sweep — on the tunnel each saved dispatch is a full round-trip, so this
-    # is the direct measurement of the win the budget tests pin on CPU
-    timeout -k 60 1200 python bench_micro.py --rows 4000000 \
-      --kernels dispatch_coalesce \
-      > scripts/bench_micro_coalesce.json 2> scripts/bench_micro_coalesce.log
-    echo "$(date -Is) micro coalesce rc=$? : $(tail -c 300 scripts/bench_micro_coalesce.json)" >> "$LOG"
-    for cfg in "sf1_batch4:1:4:900:1200" "sf1_batch1:1:1:900:1200" \
-               "sf10_batch4:10:4:1500:1800" "sf10_batch1:10:1:1500:1800"; do
-      IFS=: read -r name sf batch budget tmo <<< "$cfg"
+    # H2D staging bandwidth + dispatch-coalescing curves (cheap, run first):
+    # bytes_saved/bandwidth prices the cache's savings in wall-clock, and
+    # each saved dispatch is a full tunnel round-trip
+    timeout -k 60 1200 python bench_micro.py --rows 16000000 \
+      --kernels h2d_transfer,dispatch_coalesce \
+      > scripts/bench_micro_r09.json 2> scripts/bench_micro_r09.log
+    echo "$(date -Is) micro h2d+coalesce rc=$? : $(tail -c 300 scripts/bench_micro_r09.json)" >> "$LOG"
+    # buffer-pool A/B (the round-9 capture): cache on (2GB budget) vs off,
+    # SF1 first — hit rates + bytes_saved embed in each bench JSON
+    for cfg in "sf1_cache:1:2147483648:900:1200" "sf1_nocache:1:0:900:1200" \
+               "sf10_cache:10:8589934592:1500:1800" "sf10_nocache:10:0:1500:1800"; do
+      IFS=: read -r name sf budget_b budget tmo <<< "$cfg"
       # -k: a wedged axon call absorbs SIGTERM indefinitely (bench.py notes);
       # SIGKILL after 60s keeps the watcher itself from hanging.
       BENCH_BUDGET=$budget BENCH_SF=$sf TRINO_TPU_SCAN_FUSED=0 \
-        TRINO_TPU_DISPATCH_BATCH=$batch \
+        TRINO_TPU_PAGE_CACHE=$budget_b \
         timeout -k 60 "$tmo" python bench.py \
         > "scripts/bench_${name}.json" 2> "scripts/bench_${name}.log"
       rc=$?
@@ -87,11 +92,12 @@ try:
 except Exception as e:
     out["device"] = f"probe-error: {e}"
 try:
-    out["dispatch_coalesce_curve"] = json.load(
-        open("scripts/bench_micro_coalesce.json"))
+    out["micro_curves"] = [json.loads(l) for l in
+                           open("scripts/bench_micro_r09.json")
+                           if l.strip()]
 except Exception as e:
-    out["dispatch_coalesce_curve"] = {"error": str(e)}
-for name in ("sf1_batch4", "sf1_batch1", "sf10_batch4", "sf10_batch1"):
+    out["micro_curves"] = {"error": str(e)}
+for name in ("sf1_cache", "sf1_nocache", "sf10_cache", "sf10_nocache"):
     try:
         out[name] = json.load(open(f"scripts/bench_{name}.json"))
     except Exception as e:
@@ -100,9 +106,9 @@ try:
     out["cluster_tpu_probe"] = json.load(open("scripts/tpu_cluster_probe.json"))
 except Exception as e:
     out["cluster_tpu_probe"] = {"error": str(e)}
-json.dump(out, open("BENCH_local_r06.json", "w"), indent=1)
+json.dump(out, open("BENCH_local_r09.json", "w"), indent=1)
 PY
-    echo "$(date -Is) wrote BENCH_local_r06.json" >> "$LOG"
+    echo "$(date -Is) wrote BENCH_local_r09.json" >> "$LOG"
     exit 0
   fi
   echo "$(date -Is) probe $i: tunnel down" >> "$LOG"

@@ -48,6 +48,15 @@ ASARRAY_ALLOWED_FUNCS = {
 
 MARKER = "# host-ok"
 
+# functions whose BODY may call jax.device_put freely, with why:
+DEVICE_PUT_ALLOWED_FUNCS = {
+    "_page_to_device",    # THE sanctioned H2D chokepoint: prefetch staging
+    # and buffer-pool stores funnel through it (execution/bufferpool has its
+    # own _to_device twin outside exec/)
+}
+
+DEVICE_MARKER = "# device-ok"
+
 
 def _exec_files():
     files = sorted(EXEC_DIR.glob("*.py"))
@@ -70,6 +79,7 @@ class _Scan(ast.NodeVisitor):
         self.func_stack = []
         self.jit_hits = []      # (lineno, enclosing function)
         self.asarray_hits = []  # (lineno, enclosing function)
+        self.device_put_hits = []  # (lineno, enclosing function)
         self.site_hits = []     # (lineno, enclosing function, callee)
 
     def visit_FunctionDef(self, node):
@@ -107,6 +117,10 @@ class _Scan(ast.NodeVisitor):
                 if not (set(self.func_stack) & ASARRAY_ALLOWED_FUNCS) \
                         and MARKER not in self.lines[node.lineno - 1]:
                     self.asarray_hits.append((node.lineno, where))
+            if f.value.id == "jax" and f.attr == "device_put":
+                if not (set(self.func_stack) & DEVICE_PUT_ALLOWED_FUNCS) \
+                        and DEVICE_MARKER not in self.lines[node.lineno - 1]:
+                    self.device_put_hits.append((node.lineno, where))
         self.generic_visit(node)
 
 
@@ -138,6 +152,20 @@ def test_no_loose_np_asarray(path):
 
 
 @pytest.mark.parametrize("path", _exec_files(), ids=lambda p: p.name)
+def test_no_bare_device_put(path):
+    """Round-9 rule: H2D staging goes through the sanctioned chokepoints
+    (_page_to_device / the buffer pool's store path) or carries a
+    '# device-ok: <reason>' annotation — a loose jax.device_put is H2D
+    traffic the page cache can neither serve nor account."""
+    s = _scan(path)
+    assert not s.device_put_hits, (
+        f"{path.name}: bare jax.device_put at "
+        + ", ".join(f"line {ln} (in {fn})" for ln, fn in s.device_put_hits)
+        + " — stage through _page_to_device (or the buffer pool) so cached "
+          "scans can serve it, or annotate '# device-ok: <reason>'")
+
+
+@pytest.mark.parametrize("path", _exec_files(), ids=lambda p: p.name)
 def test_every_boundary_call_is_attributed(path):
     """Every _jit/_host call site carries a site tag (or is self-labeling /
     explicitly marked), so per-site boundary attribution cannot silently rot
@@ -165,6 +193,12 @@ def test_lint_catches_violations(tmp_path):
         "def _host(arrays):\n"
         "    return [np.asarray(a) for a in arrays]\n"
         "ok = np.asarray([1, 2])  # host-ok: literal\n"
+        "def h(x):\n"
+        "    y = jax.device_put(x)\n"                  # bare -> flagged
+        "    z = jax.device_put(x)  # device-ok: test\n"
+        "    return y, z\n"
+        "def _page_to_device(p):\n"
+        "    return jax.device_put(p)\n"
         "def g(x, step):\n"
         "    a = _host([x])\n"                      # missing site -> flagged
         "    b = _host([x], site='g.pull')\n"        # tagged -> ok
@@ -176,5 +210,6 @@ def test_lint_catches_violations(tmp_path):
     s = _scan(bad)
     assert [ln for ln, _ in s.jit_hits] == [3]
     assert [ln for ln, _ in s.asarray_hits] == [4]
+    assert [ln for ln, _ in s.device_put_hits] == [11]
     assert [(ln, callee) for ln, _, callee in s.site_hits] == \
-        [(11, "_host"), (14, "_jit")]
+        [(17, "_host"), (20, "_jit")]

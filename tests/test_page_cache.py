@@ -1,0 +1,249 @@
+"""Device buffer pool (execution/bufferpool.DeviceBufferPool) — round 9.
+
+Covers the acceptance surface of the HBM page/build cache: byte-identical
+results cache on vs off, warm hits that actually collapse the dispatch bill,
+per-query counter attribution, concurrent pooled executors sharing one pool,
+LRU eviction under a tiny budget, full release on Engine._invalidate, and
+INSERT/DDL invalidation (a stale page is never served).
+
+The pool budget comes from TRINO_TPU_PAGE_CACHE, resolved lazily at first
+use — every test sets it via monkeypatch BEFORE building its Engine.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.tpch import TpchConnector
+
+# small but multi-split: sf=0.01 lineitem ~60k rows over ~7 splits
+SF, SPLIT_ROWS = 0.01, 1 << 14
+
+Q_JOIN = """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate limit 10"""
+
+Q_AGG = """
+select l_returnflag, l_linestatus, sum(l_quantity) s, count(*) c
+from lineitem where l_shipdate <= date '1998-09-02'
+group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus"""
+
+# q18-shaped: semi join over a grouped subquery + string/date/decimal output
+# surfaces — the dtype-decode paths a cached (concatenated) scan page must
+# reproduce exactly
+Q_SEMI = """
+select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice, sum(l_quantity)
+from customer, orders, lineitem
+where o_orderkey in (select l_orderkey from lineitem group by l_orderkey
+                     having sum(l_quantity) > 100)
+  and c_custkey = o_custkey and o_orderkey = l_orderkey
+group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+order by o_totalprice desc, o_orderdate limit 50"""
+
+
+def _engine(monkeypatch, budget=1 << 30):
+    monkeypatch.setenv("TRINO_TPU_PAGE_CACHE", str(budget))
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=SF, split_rows=SPLIT_ROWS))
+    return e
+
+
+def _cols(res):
+    return [np.asarray(c) for c in res.columns] + \
+        [np.asarray(c) for c in res.raw_columns]
+
+
+def _assert_same(a, b):
+    for x, y in zip(_cols(a), _cols(b)):
+        assert x.dtype == y.dtype
+        assert np.array_equal(x, y, equal_nan=x.dtype.kind == "f")
+
+
+@pytest.mark.parametrize("sql", [Q_JOIN, Q_SEMI], ids=["join", "semi"])
+def test_results_byte_identical_cache_on_off(monkeypatch, sql):
+    e = _engine(monkeypatch)
+    on = e.create_session("tpch")
+    off = e.create_session("tpch")
+    e.session_properties.set_property(off, "page_cache", False)
+    r_off = e.execute_sql(sql, off)
+    assert e.last_query_counters.page_cache_misses == 0  # property respected
+    r1 = e.execute_sql(sql, on)   # populates the pool
+    r2 = e.execute_sql(sql, on)   # warm: whole-scan hit
+    assert e.last_query_counters.page_cache_hits >= 1
+    _assert_same(r_off, r1)
+    _assert_same(r_off, r2)
+    e._invalidate()
+
+
+def test_warm_hit_collapses_dispatches(monkeypatch):
+    e = _engine(monkeypatch)
+    s = e.create_session("tpch")
+    off = e.create_session("tpch")
+    e.session_properties.set_property(off, "page_cache", False)
+    e.execute_sql(Q_JOIN, s)          # cold: plan + compile + store
+    e.execute_sql(Q_JOIN, off)        # warm baseline without the pool
+    base = e.last_query_counters.snapshot()
+    e.execute_sql(Q_JOIN, s)          # warm WITH the pool
+    c = e.last_query_counters
+    assert c.page_cache_hits >= 1
+    assert c.page_cache_bytes_saved > 0
+    # the whole probe scan arrives as ONE page: per-split consumer loops
+    # collapse, so the warm dispatch bill must strictly beat cache-off
+    assert c.device_dispatches < base.device_dispatches, \
+        (c.device_dispatches, base.device_dispatches)
+    # attribution: the hit landed on a "<Op>/scan.<table>.cache" site
+    assert any(k.endswith(".cache") and v.get("page_cache_hits")
+               for k, v in c.sites.items()), c.sites
+    e._invalidate()
+
+
+def test_hits_attributed_to_the_querys_own_counters(monkeypatch):
+    e = _engine(monkeypatch)
+    s = e.create_session("tpch")
+    e.execute_sql(Q_AGG, s)                      # populate lineitem entry
+    e.execute_sql("select count(*) from nation", s)
+    c = e.last_query_counters
+    assert c.page_cache_hits == 0, "nation query charged a lineitem hit"
+    e.execute_sql(Q_AGG, s)
+    assert e.last_query_counters.page_cache_hits >= 1
+    e._invalidate()
+
+
+def test_concurrent_pooled_executors_share_the_pool(monkeypatch):
+    e = _engine(monkeypatch)
+    s = e.create_session("tpch")
+    ref = e.execute_sql(Q_JOIN, s)  # plan + first store
+    results, errors = [None] * 4, []
+
+    def run(i):
+        try:
+            results[i] = e.execute_sql(Q_JOIN, e.create_session("tpch"))
+        except Exception as ex:  # surface in the main thread
+            errors.append(ex)
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors, errors
+    for r in results:
+        _assert_same(ref, r)
+    info = e.buffer_pool.info()
+    # concurrent checkouts compile on FRESH executors: their builds/scans
+    # must come from the shared pool, not be rebuilt per executor
+    assert info["hits"] >= 1
+    assert info["build_hits"] >= 1, info
+    # full release on invalidation: no device-memory leak across DDL
+    e._invalidate()
+    assert e.buffer_pool.info()["entries"] == 0
+    assert e.buffer_pool.memory_pool.reserved == 0
+
+
+def test_build_cache_checkout_across_executors(monkeypatch):
+    from trino_tpu.exec.local_executor import LocalExecutor
+    from trino_tpu.sql import parser as A
+    from trino_tpu.sql.frontend import Planner
+
+    e = _engine(monkeypatch)
+    sess = e.create_session("tpch")
+    plan = Planner(e, sess).plan_query(A.parse(Q_JOIN))
+    bp = e.buffer_pool
+    ex1 = LocalExecutor(e.catalogs, buffer_pool=bp)
+    ex2 = LocalExecutor(e.catalogs, buffer_pool=bp)
+    r1 = ex1.execute(plan)
+    h0 = bp.build_hits
+    r2 = ex2.execute(plan)
+    assert bp.build_hits > h0, "second executor rebuilt the cached build"
+    _assert_same(r1, r2)
+    e._invalidate()
+
+
+def test_lru_eviction_under_tiny_budget(monkeypatch):
+    # budget fits roughly one small scan: alternating tables must evict,
+    # never raise, and stay within the labeled pool's ceiling
+    e = _engine(monkeypatch, budget=64 << 10)
+    s = e.create_session("tpch")
+    for sql in ("select count(*) c from region group by r_regionkey",
+                "select count(*) c from nation group by n_nationkey",
+                "select count(*) c from region group by r_regionkey"):
+        e.execute_sql(sql, s)
+    info = e.buffer_pool.info()
+    assert info["evictions"] >= 1 or info["bytes"] <= 64 << 10
+    assert e.buffer_pool.memory_pool.reserved <= 64 << 10
+    # an entry larger than the whole budget is skipped, not an error
+    r = e.execute_sql(Q_AGG, s)
+    assert len(r) > 0
+    assert e.buffer_pool.memory_pool.reserved <= 64 << 10
+    e._invalidate()
+
+
+def test_insert_invalidates_stale_pages(monkeypatch):
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    monkeypatch.setenv("TRINO_TPU_PAGE_CACHE", str(1 << 30))
+    e = Engine()
+    e.register_catalog("mem", MemoryConnector())
+    s = e.create_session("mem")
+    e.execute_sql("create table t (k bigint, v bigint)", s)
+    e.execute_sql("insert into t values (1, 10), (2, 20)", s)
+    r1 = e.execute_sql("select sum(v) s from t", s)
+    assert int(r1.columns[0][0]) == 30
+    e.execute_sql("select sum(v) s from t", s)  # cached read
+    e.execute_sql("insert into t values (3, 70)", s)  # invalidates the pool
+    assert e.buffer_pool.info()["entries"] == 0
+    assert e.buffer_pool.memory_pool is None \
+        or e.buffer_pool.memory_pool.reserved == 0
+    r2 = e.execute_sql("select sum(v) s from t", s)
+    assert int(r2.columns[0][0]) == 100, "stale cached page served after INSERT"
+    e._invalidate()
+
+
+def test_cache_off_by_default_without_env(monkeypatch):
+    monkeypatch.delenv("TRINO_TPU_PAGE_CACHE", raising=False)
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=SF, split_rows=SPLIT_ROWS))
+    s = e.create_session("tpch")
+    e.execute_sql(Q_AGG, s)
+    e.execute_sql(Q_AGG, s)
+    c = e.last_query_counters
+    # CPU backend default: pool disabled — no lookups, no stores
+    assert c.page_cache_hits == 0 and c.page_cache_misses == 0
+    assert e.buffer_pool.info()["entries"] == 0
+    e._invalidate()
+
+
+def test_worker_owns_its_pool(monkeypatch, tmp_path):
+    """A WorkerServer caches what IT scans: its executors share the worker's
+    own DeviceBufferPool, never the coordinator engine's."""
+    from trino_tpu.server.cluster import WorkerServer
+
+    monkeypatch.setenv("TRINO_TPU_PAGE_CACHE", str(1 << 20))
+    w = WorkerServer({"tpch": {"connector": "tpch", "sf": 0.01}},
+                     str(tmp_path))
+    assert w.local.buffer_pool is w.buffer_pool
+    ex = w._checkout_executor(query_key="q", token="t0")
+    try:
+        assert ex.buffer_pool is w.buffer_pool
+    finally:
+        w._release_executor(ex, token="t0")
+    e = Engine()
+    assert e.buffer_pool is not w.buffer_pool
+
+
+def test_explain_analyze_shows_buffer_pool_line(monkeypatch):
+    e = _engine(monkeypatch)
+    s = e.create_session("tpch")
+    e.execute_sql(Q_AGG, s)  # populate
+    r = e.execute_sql(f"explain analyze {Q_AGG}", s)
+    text = "\n".join(str(row[0]) for row in r.rows())
+    assert "Buffer pool:" in text, text
+    e._invalidate()

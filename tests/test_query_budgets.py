@@ -10,27 +10,34 @@ recorded HERE, from a real capture (reference analog: the zero-per-page
 scheduler cost of Trino's driver pump, operator/Driver.java:372-481, enforced
 instead of assumed).
 
-Ceilings were derived with scripts/query_counters.py on the 8-device CPU mesh
-(SF1, split_rows=1<<21, 2026-08-03, `--batch 4` A/B) and carry ~20-25%
-headroom over the measured warm trace at the DEFAULT dispatch batch (4):
+Round 9: the budgets pin the DEVICE BUFFER POOL ON (TRINO_TPU_PAGE_CACHE set
+by the fixture — the production configuration on device backends).  Each
+query's cold run populates the pool; the warm budgeted run serves every scan
+as ONE resident page, so the per-split consumer dispatches collapse on top
+of the round-6 coalescing win.  Ceilings were re-derived with
+scripts/query_counters.py on the 8-device CPU mesh (SF1, split_rows=1<<21,
+2026-08-03, `--page-cache 6442450944`) and carry ~25-35% headroom over the
+measured warm trace:
 
-    measured warm (batch=4): q1  6/285B   q3 10/262B   q9 10/3057B   q18 10/2835B
-    measured warm (batch=1): q1 10/285B   q3 22/278B   q9 29/3077B   q18 20/2851B
-    pre-coalescing PR trace: q1 10/277B   q3 22/278B   q9 29/3069B   q18 20/2851B
+    measured warm (cache on):  q1 4/285B   q3  6/258B   q9  7/3057B   q18  6/2831B
+    measured warm (cache off): q1 6/285B   q3 10/262B   q9 10/3057B   q18 10/2835B
+    measured warm (batch=1):   q1 10/285B  q3 22/278B   q9 29/3077B   q18 20/2851B
 
-The dispatch ceilings now sit BELOW the batch=1 trace: dispatch coalescing
-(exec/local_executor._coalesced_batches stacking shape-uniform split pages
-into one jit dispatch) is load-bearing, and silently losing it — a consumer
-loop bypassing _coalesced_batches, a stream shape change that breaks the
-uniformity signature — fails this suite just like a reintroduced per-split
-sync would.  Byte ceilings are UNCHANGED from the pre-coalescing PR (the
-round-5 device-sort/narrowing/bit-packing protections).  A reintroduced bulk
-pull (the device-finalize or device-TopN regressions) overshoots by KBs.
-Counters are NOT env-dependent: split geometry is pinned by sf/split_rows and
-page shapes are pow2-quantized.
+The dispatch ceilings now sit BELOW the cache-off trace: losing the pool's
+whole-scan hit (a scan source bypassing _scan_pages_source, a put_scan that
+stops storing, a key that stops matching across runs) fails this suite just
+like losing coalescing or reintroducing a per-split sync would.  Entries are
+keyed per (table, splits, columns), and the four queries' scan specs are
+pairwise distinct, so the ceilings are test-order independent; 6GB budget
+fits the ~2GB SF1 working set with no eviction.  A reintroduced bulk pull
+(the device-finalize or device-TopN regressions) overshoots the byte
+ceilings by KBs.  Counters are NOT env-dependent beyond the fixture's own
+page-cache budget: split geometry is pinned by sf/split_rows and page shapes
+are pow2-quantized.
 
-Re-derive after an intentional executor change:
-    JAX_PLATFORMS=cpu python scripts/query_counters.py --batch 4
+Re-derive after an intentional executor change (cache-on and off):
+    JAX_PLATFORMS=cpu python scripts/query_counters.py --page-cache 6442450944
+    JAX_PLATFORMS=cpu python scripts/query_counters.py --page-cache 0
 """
 
 import pytest
@@ -78,27 +85,42 @@ QUERIES = {
     order by o_totalprice desc, o_orderdate limit 100""",
 }
 
-# (max device dispatches, max host bytes pulled) per WARM query at the
-# default dispatch batch.  Dispatch ceilings enforce the >=40% coalescing win
-# over the PR-1 trace (22/29/20 for q3/q9/q18): q3 <= 12 (was 22), q9 <= 15
-# (was 29), q18 <= 12 (was 20).
+# (max device dispatches, max host bytes pulled) per WARM query with the
+# buffer pool on.  Dispatch ceilings enforce the whole-scan cache hit on top
+# of coalescing — round-8 ceilings were q1 8, q3 12, q9 15, q18 12; the
+# cache-off warm trace (10/10/10 for q3/q9/q18) must now BREACH them, which
+# is exactly the protection: a silently dead cache fails the suite.
 BUDGETS = {
-    "q1": (8, 400),
-    "q3": (12, 450),
-    "q9": (15, 3600),   # pre-round-6 trace: 4228 bytes — must stay below it
-    "q18": (12, 3400),
+    "q1": (6, 400),
+    "q3": (8, 400),
+    "q9": (9, 3400),    # pre-round-6 trace: 4228 bytes — must stay below it
+    "q18": (8, 3200),
 }
 
 
 @pytest.fixture(scope="module")
 def sf1(request):
+    import os
+
+    # round 9: the budgets are pinned WITH the device buffer pool ON (the
+    # production configuration on device backends) — the cold run of each
+    # query populates the pool, the warm budgeted run serves every scan as
+    # one resident page.  6GB comfortably fits the SF1 working set
+    # (~2GB of distinct (table, splits, columns) entries), so no eviction
+    # perturbs the counters.
+    prev = os.environ.get("TRINO_TPU_PAGE_CACHE")
+    os.environ["TRINO_TPU_PAGE_CACHE"] = str(6 * 1024 * 1024 * 1024)
     engine = Engine()
     engine.register_catalog("tpch", TpchConnector(sf=1, split_rows=1 << 21))
     session = engine.create_session("tpch")
     yield engine, session
-    # SF1 compiled pipelines + build pages are device-resident: release them
-    # before the next module runs
+    # SF1 compiled pipelines + build pages + the buffer pool are
+    # device-resident: release them before the next module runs
     engine._invalidate()
+    if prev is None:
+        os.environ.pop("TRINO_TPU_PAGE_CACHE", None)
+    else:
+        os.environ["TRINO_TPU_PAGE_CACHE"] = prev
 
 
 def _sites_table(c) -> str:
@@ -140,6 +162,12 @@ def test_warm_q3_span_tree(sf1):
     import time as _time
 
     engine, session = sf1
+    # page_cache=false for THIS session: a buffer-pool hit serves the scan
+    # without ever starting a prefetch producer, and this test exists to
+    # pin the prefetch-thread span parenting (the property is
+    # non-plan-shaping, so the cached plan is reused either way)
+    session = engine.create_session("tpch")
+    engine.session_properties.set_property(session, "page_cache", False)
     engine.execute_sql(QUERIES["q3"], session)  # plan cache warm (cheap if
     engine.execute_sql(QUERIES["q3"], session)  # the budget tests ran first)
     c = engine.last_query_counters

@@ -60,6 +60,11 @@ class MultiFileConnector:
 
     HOST_DECODE = True  # parquet delegate decodes on the host: scans benefit
     # from background-thread split prefetch
+    CACHEABLE_SCANS = True  # host-decoded pages: the buffer pool saves
+    # BOTH the decode and the host->device staging on warm scans.  Files
+    # are assumed immutable between engine-visible DDL (the reference
+    # caching connectors' contract); out-of-band rewrites need an
+    # engine invalidation
 
     def __init__(self, fs=None):
         self.fs = fs if fs is not None else LocalFileSystem()

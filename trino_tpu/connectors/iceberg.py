@@ -94,6 +94,9 @@ def _decode_bound(ty, raw: bytes):
 
 
 class IcebergConnector:
+
+    CACHEABLE_SCANS = True  # file pages are immutable between DDL;
+    # the buffer pool keeps decoded columns device-resident across queries
     name = "iceberg"
     HOST_DECODE = True  # pages decode on the host: scans benefit from
     # background-thread split prefetch (see local_executor._prefetched_pages)

@@ -68,6 +68,10 @@ class MemorySplit:
 
 class MemoryConnector:
     name = "memory"
+    CACHEABLE_SCANS = True  # engine DML/DDL funnels through
+    # Engine._invalidate, which clears the buffer pool — mutations that
+    # bypass the engine (direct .append in library use) must invalidate
+    # manually, the same contract the plan cache already imposes
 
     def __init__(self):
         self._tables: dict = {}

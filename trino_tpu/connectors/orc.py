@@ -42,6 +42,9 @@ class _OrcTable:
 
 
 class OrcConnector:
+
+    CACHEABLE_SCANS = True  # file pages are immutable between DDL;
+    # the buffer pool keeps decoded columns device-resident across queries
     name = "orc"
     HOST_DECODE = True  # pyarrow stripe decode on the host: prefetchable
 

@@ -109,6 +109,9 @@ class _PqTable:
 
 
 class ParquetConnector:
+
+    CACHEABLE_SCANS = True  # file pages are immutable between DDL;
+    # the buffer pool keeps decoded columns device-resident across queries
     supports_count_pushdown = True  # exact footer row counts; DDL/DML bumps plan_version
     name = "parquet"
     HOST_DECODE = True  # pages decode on the host: scans benefit from

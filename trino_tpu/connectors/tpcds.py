@@ -1239,6 +1239,7 @@ class TpcdsSplit:
 class TpcdsConnector:
     name = "tpcds"
     supports_count_pushdown = True  # row counts are index-derived (exact)
+    CACHEABLE_SCANS = True  # deterministic generator (see TpchConnector)
 
     def exact_row_count(self, table: str) -> int:
         return self.row_count(table)

@@ -454,6 +454,9 @@ class TpchConnector:
     """Connector over generated TPC-H data (see trino_tpu.spi for the SPI contract)."""
 
     supports_count_pushdown = True  # via exact_row_count below
+    CACHEABLE_SCANS = True  # deterministic generator: a (table, split,
+    # columns) page is immutable for the life of the process, so the
+    # device buffer pool may serve it across queries
 
     name = "tpch"
 

@@ -888,14 +888,14 @@ class DistributedExecutor:
         n_b = build_page.capacity
         chunk = max((n_b + W - 1) // W, 4)
         padded = _pad_page(build_page, W * chunk)
-        bcols_g = tuple(jax.device_put(c.reshape(W, chunk), sharded)
+        bcols_g = tuple(jax.device_put(c.reshape(W, chunk), sharded)  # device-ok: mesh-sharded placement
                         for c in padded.columns)
         bnull_slots = [ci for ci, m in enumerate(padded.null_masks)
                        if m is not None]
         bnulls_g = tuple(
-            jax.device_put(padded.null_masks[ci].reshape(W, chunk), sharded)
+            jax.device_put(padded.null_masks[ci].reshape(W, chunk), sharded)  # device-ok: mesh-sharded placement
             for ci in bnull_slots)
-        bvalid_g = jax.device_put(padded.valid_mask().reshape(W, chunk), sharded)
+        bvalid_g = jax.device_put(padded.valid_mask().reshape(W, chunk), sharded)  # device-ok: mesh-sharded placement
         ncols_b = len(padded.columns)
 
         def build_exchange(bcols_l, bnulls_l, bvalid_l, cap_r, node=node):
@@ -1081,7 +1081,7 @@ class DistributedExecutor:
                     valid[None], of[None])
 
         c0, n0, v0, of0 = _jit(sample)(
-            jax.device_put(stream.scan_lo_batches[0], sharded), stream.aux)
+            jax.device_put(stream.scan_lo_batches[0], sharded), stream.aux)  # device-ok: mesh-sharded placement
         got = _host(list(c0) + list(n0) + [v0, of0]
                     + ([luts[ch]] if ch in luts else []),
                     site="dist.sort.sample")
@@ -1161,9 +1161,9 @@ class DistributedExecutor:
                     tuple(m[idx][None] for m in nulls_), valid[idx][None])
 
         scols, snulls, _ = _jit(sort_shard)(
-            tuple(jax.device_put(c, sharded) for c in cols_g),
-            tuple(jax.device_put(m, sharded) for m in nulls_g),
-            jax.device_put(valid_g, sharded), luts_t)
+            tuple(jax.device_put(c, sharded) for c in cols_g),  # device-ok: mesh-sharded placement
+            tuple(jax.device_put(m, sharded) for m in nulls_g),  # device-ok: mesh-sharded placement
+            jax.device_put(valid_g, sharded), luts_t)  # device-ok: mesh-sharded placement
         # sorted shards: valid rows lead (``~valid`` is the last lex key), so
         # worker w contributes exactly its counts[w] head rows, in rank order
         page = _page_from_shards(stream.schema, scols, snulls, counts)
@@ -1234,9 +1234,9 @@ class DistributedExecutor:
             return (tuple(c[None] for c in ocols), tuple(m[None] for m in onulls))
 
         ocols, onulls = _jit(wstep)(
-            tuple(jax.device_put(c, sharded) for c in cols_g),
-            tuple(jax.device_put(m, sharded) for m in nulls_g),
-            jax.device_put(valid_g, sharded))
+            tuple(jax.device_put(c, sharded) for c in cols_g),  # device-ok: mesh-sharded placement
+            tuple(jax.device_put(m, sharded) for m in nulls_g),  # device-ok: mesh-sharded placement
+            jax.device_put(valid_g, sharded))  # device-ok: mesh-sharded placement
         page = _page_from_shards(node.schema, tuple(cols_g) + tuple(ocols),
                                  tuple(nulls_g) + tuple(onulls), counts)
         return (page, stream.dicts + spec_dicts), False
@@ -1283,7 +1283,7 @@ class DistributedExecutor:
             per_nulls = [[[] for _ in range(ncols)] for _ in range(W)]
         for lo in stream.scan_lo_batches[skip_batches:]:
             rcols, rnulls, rvalid, of = step(
-                jax.device_put(lo, sharded), stream.aux, route_aux)
+                jax.device_put(lo, sharded), stream.aux, route_aux)  # device-ok: mesh-sharded placement
             got = _host(list(rcols) + list(rnulls) + [rvalid, of],
                         site="dist.exchange.collect")
             if bool(np.any(got[-1])):
@@ -1325,10 +1325,10 @@ class DistributedExecutor:
                            for f in fields)
         state_nulls = tuple(jnp.zeros((W, k), bool) for _ in fields)
         state_valid = jnp.zeros((W, k), bool)
-        state = (jax.device_put(state_cols, sharded),
-                 jax.device_put(state_nulls, sharded),
-                 jax.device_put(state_valid, sharded),
-                 jax.device_put(jnp.zeros((W,), bool), sharded))  # oflow acc
+        state = (jax.device_put(state_cols, sharded),  # device-ok: mesh-sharded placement
+                 jax.device_put(state_nulls, sharded),  # device-ok: mesh-sharded placement
+                 jax.device_put(state_valid, sharded),  # device-ok: mesh-sharded placement
+                 jax.device_put(jnp.zeros((W,), bool), sharded))  # oflow acc  # device-ok: mesh-sharded placement
         luts_t = dict(luts)
 
         @partial(shard_map, mesh=mesh,
@@ -1356,7 +1356,7 @@ class DistributedExecutor:
 
         step = _jit(step)
         for lo in stream.scan_lo_batches:
-            state = step(state, jax.device_put(lo, sharded), stream.aux, luts_t)
+            state = step(state, jax.device_put(lo, sharded), stream.aux, luts_t)  # device-ok: mesh-sharded placement
 
         got = _host(list(state[0]) + list(state[1])
                     + [state[2], state[3]], site="dist.topn.states")
@@ -1412,7 +1412,7 @@ class DistributedExecutor:
 
         while True:
             state = self._global_state_init(capacity, key_types, acc_specs)
-            of_acc = jax.device_put(jnp.zeros((W,), bool), sharded)
+            of_acc = jax.device_put(jnp.zeros((W,), bool), sharded)  # device-ok: mesh-sharded placement
 
             @partial(shard_map, mesh=mesh,
                      in_specs=(PS(WORKER_AXIS),) * 2 + (PS(WORKER_AXIS), stream.aux_specs),
@@ -1434,7 +1434,7 @@ class DistributedExecutor:
 
             step = _jit(step)
             for lo in stream.scan_lo_batches:
-                state, of_acc = step(state, of_acc, jax.device_put(lo, sharded),
+                state, of_acc = step(state, of_acc, jax.device_put(lo, sharded),  # device-ok: mesh-sharded placement
                                      stream.aux)
 
             if bool(np.any(_host([of_acc],
@@ -1475,7 +1475,7 @@ class DistributedExecutor:
         sharded = NamedSharding(self.mesh, PS(WORKER_AXIS))
 
         def tile(x):
-            return jax.device_put(jnp.broadcast_to(x[None], (W,) + x.shape), sharded)
+            return jax.device_put(jnp.broadcast_to(x[None], (W,) + x.shape), sharded)  # device-ok: mesh-sharded placement
 
         local = hashagg.groupby_init(capacity, tuple(t.dtype for t in key_types), acc_specs)
         return jax.tree.map(tile, local, is_leaf=lambda x: x is None)
@@ -1528,13 +1528,13 @@ class DistributedExecutor:
         W = self.n_workers
         sharded = NamedSharding(mesh, PS(WORKER_AXIS))
         state = tuple(
-            jax.device_put(
+            jax.device_put(  # device-ok: mesh-sharded placement
                 jnp.broadcast_to(
                     jnp.asarray(hashagg._extreme(dt, 1 if k == "min" else -1)
                                 if k in ("min", "max") else (init or 0), dt)[None], (W,)),
                 sharded)
             for (dt, init), k in zip(acc_specs, acc_kinds)
-        ) + (jax.device_put(jnp.zeros((W,), bool), sharded),)  # oflow acc
+        ) + (jax.device_put(jnp.zeros((W,), bool), sharded),)  # oflow acc  # device-ok: mesh-sharded placement
 
         @partial(shard_map, mesh=mesh,
                  in_specs=(PS(WORKER_AXIS), PS(WORKER_AXIS), stream.aux_specs),
@@ -1573,7 +1573,7 @@ class DistributedExecutor:
 
         step = _jit(step)
         for lo in stream.scan_lo_batches:
-            state = step(state, jax.device_put(lo, sharded), stream.aux)
+            state = step(state, jax.device_put(lo, sharded), stream.aux)  # device-ok: mesh-sharded placement
 
         got = _host(list(state),
                     site="dist.agg.states")  # one batched pull
@@ -1615,7 +1615,7 @@ class DistributedExecutor:
         parts_cols, parts_nulls, parts_valid = [], [], []
         oflow = False
         for lo in stream.scan_lo_batches:
-            cols, nulls, valid, of = run(jax.device_put(lo, sharded), stream.aux)
+            cols, nulls, valid, of = run(jax.device_put(lo, sharded), stream.aux)  # device-ok: mesh-sharded placement
             got = _host(list(cols) + list(nulls) + [valid, of],
                         site="dist.stream.collect")
             oflow = oflow or bool(np.any(got[-1]))

@@ -226,6 +226,9 @@ class _ScanInfo:
     splits: list
     scan_columns: tuple  # column names requested from the connector
     columns: tuple  # per OUTPUT channel: source column name | None (through projects)
+    catalog: str = ""  # catalog/table identity: split-pruning replacements
+    table: str = ""  # rebuild their page source through the executor's
+    # cache-aware _scan_pages_source, which keys the buffer pool on them
     replayable: bool = True  # False once a boundary (compaction) transformed the
     # pages: column metadata stays valid for stats/ranges, but pruning must NOT
     # rebuild pages from the splits (the downstream chain expects the
@@ -340,7 +343,7 @@ class LocalExecutor:
     true for generator connectors; mutating connectors must invalidate the engine's plan
     cache."""
 
-    def __init__(self, catalogs: dict, memory_pool=None):
+    def __init__(self, catalogs: dict, memory_pool=None, buffer_pool=None):
         from ..memory import MemoryPool
 
         self.catalogs = catalogs
@@ -350,6 +353,13 @@ class LocalExecutor:
         # plan-cache key — so a cached plan's compiled batch artifacts always
         # match the batch the plan was keyed under.
         self.dispatch_batch = None
+        # device buffer pool (execution/bufferpool.DeviceBufferPool), shared
+        # across the engine's pooled executors (a WorkerServer passes its
+        # own).  ``page_cache`` is the per-query session-property override
+        # (None = the pool's TRINO_TPU_PAGE_CACHE gate) — NON-plan-shaping:
+        # the cache only changes where scan pages come from, never the plan.
+        self.buffer_pool = buffer_pool
+        self.page_cache = None
         self._stream_cache: dict = {}  # id(node) -> (node, _Stream)
         self._agg_cache: dict = {}  # id(node) -> compiled aggregation artifacts
         self.stats: dict = {}  # id(node) -> {"rows": int, "wall_s": float}
@@ -393,6 +403,88 @@ class LocalExecutor:
             return _prefetched_pages(pages_fn, depth=self._batch(),
                                      to_device=True, warmup=2)
         return pages_fn
+
+    def _page_cache_on(self) -> bool:
+        """Does THIS query consult the device buffer pool?  The ``page_cache``
+        session property overrides per query; otherwise the pool's
+        TRINO_TPU_PAGE_CACHE budget decides (0 = off, the CPU default).  A
+        ``page_cache=true`` query against an unconfigured (zero-budget) pool
+        still gets nothing to read — the property gates USE of a configured
+        pool, it does not conjure a budget."""
+        bp = self.buffer_pool
+        if bp is None or not bp.enabled:
+            return False
+        if self.page_cache is not None:
+            return bool(self.page_cache)
+        return True
+
+    def _scan_pages_source(self, conn, catalog: str, table: str, splits,
+                           scan_cols):
+        """Cache-aware page source for a (possibly split-pruned) table scan.
+
+        Cache hit: the WHOLE completed scan is served as ONE device-resident
+        page — no host generation, no H2D staging, and every downstream
+        per-split consumer loop (stream transforms, agg inserts, compaction
+        syncs) collapses to a single dispatch per stage.  Row order is split
+        order, so the page computes exactly what the per-split stream would
+        (the _stack_pages soundness argument, applied once at store time).
+
+        Cache miss: the ordinary per-split path runs — with its prefetch /
+        double-buffer wrap — while the consumer-side loop below accumulates
+        the raw pages and stores the concatenated scan ONLY on clean
+        exhaustion (a LIMIT short-circuit or error unwind must never cache a
+        partial scan).  The lookup, the accounting and the store all run on
+        the QUERY thread (generator bodies execute at the consumer's next()),
+        so cache counters never race the prefetch producer."""
+        splits = list(splits)
+        scan_cols = tuple(scan_cols)
+
+        def raw(conn=conn, splits=splits, scan_cols=scan_cols):
+            for s in splits:
+                yield conn.generate(s, list(scan_cols))
+
+        wrapped = self._rewrap_pruned_pages(raw, conn, len(splits))
+        bp = self.buffer_pool
+
+        def pages(self=self):
+            key = None
+            if splits and bp is not None and self._page_cache_on() \
+                    and bp.cacheable(conn):
+                key = bp.page_key(catalog, conn, table, splits, scan_cols)
+                site = f"scan.{table}.cache"
+                hit = bp.get_page(key)
+                if hit is not None:
+                    page, nbytes = hit
+                    tracing.record_page_cache(hits=1, bytes_saved=nbytes,
+                                              site=site)
+                    yield page
+                    return
+                tracing.record_page_cache(misses=1, site=site)
+            acc = [] if key is not None and not bp.has_page(key) else None
+            acc_bytes = 0
+            for pg in wrapped():
+                if acc is not None:
+                    # stop pinning pages the pool would reject anyway: a scan
+                    # past the whole budget (or one with object columns that
+                    # cannot live on device) reverts to pure streaming —
+                    # pages release as consumed, exactly like cache-off
+                    acc_bytes += _page_bytes(pg)
+                    if acc_bytes > bp.budget() or any(
+                            isinstance(c, np.ndarray) and c.dtype == object
+                            for c in pg.columns):
+                        acc = None
+                    else:
+                        acc.append(pg)
+                yield pg
+            if acc:
+                # the store's staging can wedge like any other device work:
+                # hold an in-flight registry entry so the stall watchdog sees
+                # a hang here instead of an idle-looking query
+                with tracing.inflight("cache-store",
+                                      site=f"scan.{table}.store"):
+                    bp.put_page(key, _stage_scan_entry(acc))
+
+        return pages
 
     def forget_plan(self, plan: P.PlanNode) -> None:
         """Evict compiled artifacts for a plan the engine is replacing (its
@@ -679,33 +771,19 @@ class LocalExecutor:
                 splits = conn.splits(node.table)
                 sp.attributes["splits"] = len(splits)
 
-            def pages(conn=conn, splits=splits, node=node):
-                for s in splits:
-                    yield conn.generate(s, node.columns)
-
-            if getattr(conn, "HOST_DECODE", False):
-                # file connectors decode on the HOST: prefetch the next split
-                # on a background thread so decode overlaps device compute
-                # (the local-exchange producer/consumer overlap of the
-                # reference, operator/exchange/LocalExchange.java — re-planned
-                # at the split boundary); to_device moves each decoded page
-                # host->device on the producer thread too, so the transfer
-                # overlaps instead of serializing into the next dispatch
-                pages = _prefetched_pages(pages, to_device=True)
-            elif len(splits) > 1 and self._batch() > 1:
-                # dispatch-coalescing double buffer: while the device executes
-                # batch k, a background thread generates (and device_puts)
-                # batch k+1's pages — overlapping the two dominant latencies
-                # on tunneled TPUs.  The producer runs ONLY connector code
-                # (conn.generate), never executor state, so it is safe off the
-                # query thread; it dies with the query via generator close
-                # (the consumer's finally / GC), never outliving the
-                # single-query LocalExecutor that started it.  warmup=2: a
-                # LIMIT short-circuit that stops within two pages must not
-                # have generated a single split beyond what it consumed.
-                pages = _prefetched_pages(pages, depth=self._batch(),
-                                          to_device=True, warmup=2)
-            si = _ScanInfo(conn, splits, tuple(node.columns), tuple(node.columns))
+            # cache-aware page source over the prefetch policy the scan needs:
+            # HOST_DECODE connectors prefetch+device_put on a background
+            # thread (decode overlaps device compute), device generators get
+            # the dispatch-coalescing double buffer when multi-split (see
+            # _rewrap_pruned_pages).  The buffer-pool layer sits OUTSIDE the
+            # prefetch wrap, so a warm cache hit serves the whole scan as one
+            # resident page without ever starting a producer thread, and the
+            # double-buffer thread only runs for scans the pool cannot serve.
+            pages = self._scan_pages_source(conn, node.catalog, node.table,
+                                            splits, tuple(node.columns))
+            si = _ScanInfo(conn, splits, tuple(node.columns),
+                           tuple(node.columns), catalog=node.catalog,
+                           table=node.table)
             clustered = tuple(conn.clustered_by(node.table)) \
                 if hasattr(conn, "clustered_by") else ()
             tsrc = None
@@ -729,14 +807,16 @@ class LocalExecutor:
 
             pruned = _static_pruned_stream(up, pred)
             if pruned is not None:
-                # the pruner replaces the scan's prefetched generator
-                # wholesale: restore the wrap the TableScan compiled with —
-                # HOST_DECODE sources prefetch unconditionally (decode
-                # overlap), device generators get the coalescing double
-                # buffer when multi-split
-                pruned = (self._rewrap_pruned_pages(pruned[0], pruned[1].conn,
-                                                    len(pruned[1].splits)),
-                          pruned[1])
+                # the pruner replaces the scan's page source wholesale:
+                # rebuild it through _scan_pages_source so the replacement
+                # keeps the wrap the TableScan compiled with (HOST_DECODE
+                # prefetch / coalescing double buffer) AND stays buffer-pool
+                # aware — the pruned split list keys its own cache entry
+                psi = pruned[1]
+                pruned = (self._scan_pages_source(psi.conn, psi.catalog,
+                                                  psi.table, psi.splits,
+                                                  psi.scan_columns),
+                          psi)
             pages, si = pruned if pruned is not None else (up.pages, up.scan_info)
             tsrc = up.traced_src
             if pruned is not None and tsrc is not None:
@@ -2286,8 +2366,60 @@ class LocalExecutor:
             repl["traced_src"] = None  # handle scans are host-fed
         return dataclasses.replace(probe_stream, **repl)
 
+    def _build_cache_key(self, node: P.Join):
+        """Buffer-pool key for this join's build fragment, or None when the
+        build must not be cached: pool off for this query, fragment reads a
+        non-cacheable (volatile) connector, or the subtree is overridden by a
+        spooled fragment output (query-scoped data — caching it would serve
+        one query's spool to the next).  Key shape:
+        ("build", fingerprint, right_keys, catalogs, filter-is-none) — the
+        catalogs tuple at index 3 is what bufferpool.invalidate_catalog
+        matches, and plan_versions fold into the fingerprint so growable
+        catalogs never serve a stale build."""
+        bp = self.buffer_pool
+        if bp is None or not self._page_cache_on():
+            return None
+        if self._overrides and self._subtree_overridden(node.right):
+            return None
+        cats: set = set()
+        cacheable = True
+
+        def walk(n):
+            nonlocal cacheable
+            if isinstance(n, P.TableScan):
+                conn = self.catalogs.get(n.catalog)
+                if conn is None or not bp.cacheable(conn):
+                    cacheable = False
+                cats.add(n.catalog)
+            for c in n.children:
+                walk(c)
+
+        walk(node.right)
+        if not cacheable:
+            return None
+        fp = _plan_fingerprint(node.right, self.catalogs)
+        return ("build", fp, tuple(node.right_keys), tuple(sorted(cats)),
+                node.filter is None)
+
     def _compile_join(self, node: P.Join) -> _Stream:
-        build_page, build_dicts = self._execute_to_page_streamed(node.right)
+        # build-cache tier: a structurally identical build fragment finished
+        # by ANY executor sharing this pool (concurrent pooled queries, a
+        # different statement over the same subquery) checks out the
+        # materialized page + hash table instead of re-executing the fragment
+        # and re-inserting every row.  The checked-out table threads through
+        # _Stream.aux as a JIT ARGUMENT exactly like a fresh one (the
+        # no-closed-over-aux rule).
+        bkey = self._build_cache_key(node)
+        cached = None
+        if bkey is not None:
+            cached = self.buffer_pool.get_build(bkey)
+            tracing.record_build_cache(hits=1 if cached is not None else 0,
+                                       misses=0 if cached is not None else 1,
+                                       site="join.build.cache")
+        if cached is not None:
+            build_page, build_dicts = cached["page"], cached["dicts"]
+        else:
+            build_page, build_dicts = self._execute_to_page_streamed(node.right)
         probe_stream = self._compile_stream(node.left)
         build_key_types = tuple(node.right.schema.fields[i].type for i in node.right_keys)
         if node.kind in ("inner", "semi") and node.filter is None:
@@ -2304,14 +2436,16 @@ class LocalExecutor:
                 _dynamic_pruned_pages(probe_stream, node, build_page)
             if pruned is not None:
                 pages_fn, kept = pruned
-                si_conn = probe_stream.scan_info.conn \
-                    if probe_stream.scan_info is not None else None
-                # the pruned replacement must keep the prefetch the original
-                # scan compiled with (round-6 double buffer / HOST_DECODE
-                # decode overlap) — dynamic pruning was silently dropping it,
-                # serializing generation back into the probe dispatches
-                pages_fn = self._rewrap_pruned_pages(pages_fn, si_conn,
-                                                     len(kept))
+                psi = probe_stream.scan_info
+                if psi is not None:
+                    # rebuild the pruned replacement through the cache-aware
+                    # source: it keeps the prefetch the original scan
+                    # compiled with (round-6 double buffer / HOST_DECODE
+                    # decode overlap) and the kept split list keys its own
+                    # buffer-pool entry
+                    pages_fn = self._scan_pages_source(
+                        psi.conn, psi.catalog, psi.table, kept,
+                        psi.scan_columns)
                 repl = {"pages": pages_fn, "_jitted": None,
                         "_batch_jitted": None}
                 if probe_stream.scan_info is not None:
@@ -2331,38 +2465,57 @@ class LocalExecutor:
         # pool, switch to the Grace-partitioned strategy (the HBM analog of the
         # reference's spilling join, operator/join/spilling/HashBuilderOperator.java)
         # build page x2 (columns + compaction copies) + the 4x-pow2 probe table
-        # (8B packed key + 4B row id per slot)
-        need = _page_bytes(build_page) * 2 \
-            + 12 * 4 * ceil_pow2(max(build_page.capacity, 16))
-        partitionable = (node.kind in ("inner", "left", "semi") and node.left_keys
-                         and node.filter is None)
-        if not self.memory_pool.try_reserve(need, "join-build"):
-            if partitionable:
-                parts, free = 2, max(self.memory_pool.free_bytes(), 1)
-                while need // parts > free // 2 and parts < 64:
-                    parts *= 2
-                return self._compile_partitioned_local_join(
-                    node, build_page, build_dicts, probe_stream, build_key_types,
-                    parts)
-            # non-partitionable join shapes proceed best-effort (the pool is
-            # advisory; XLA raises if HBM is truly exhausted)
+        # (8B packed key + 4B row id per slot).  A build-cache hit skips the
+        # gate: the pool already accounts the resident bytes, and a cached
+        # build by definition fit when it was built.
+        if cached is None:
+            need = _page_bytes(build_page) * 2 \
+                + 12 * 4 * ceil_pow2(max(build_page.capacity, 16))
+            partitionable = (node.kind in ("inner", "left", "semi")
+                            and node.left_keys and node.filter is None)
+            if not self.memory_pool.try_reserve(need, "join-build"):
+                if partitionable:
+                    parts, free = 2, max(self.memory_pool.free_bytes(), 1)
+                    while need // parts > free // 2 and parts < 64:
+                        parts *= 2
+                    return self._compile_partitioned_local_join(
+                        node, build_page, build_dicts, probe_stream,
+                        build_key_types, parts)
+                # non-partitionable join shapes proceed best-effort (the pool
+                # is advisory; XLA raises if HBM is truly exhausted)
 
         return self._join_with_build(node, build_page, build_dicts, probe_stream,
-                                     build_key_types)
+                                     build_key_types, cache_key=bkey,
+                                     cached=cached)
 
     def _join_with_build(self, node: P.Join, build_page, build_dicts, probe_stream,
-                         build_key_types) -> _Stream:
+                         build_key_types, cache_key=None, cached=None) -> _Stream:
         # "mark" (reference: semi-join MARKER output, planner/plan/
         # SemiJoinNode's semiJoinOutput): probe channels + one boolean
         # matched channel, no lane filtering — EXISTS in expression position
         semi = node.kind in ("semi", "anti", "mark")
-        build_has_null, build_nonempty = _build_null_stats(build_page, node.right_keys)
-        span = self._direct_join_span(build_page, node.right_keys, build_key_types)
-        table = None
-        if node.filter is None and build_page.capacity > 0:
-            table = self._build_join_table(build_page, node.right_keys,
-                                           build_key_types, span)
-        if table is None:
+        if cached is not None:
+            # build-cache hit: the null stats, direct-span probe and table
+            # build (with their device syncs and insert dispatches) all
+            # happened when the entry was stored — check the results out
+            build_has_null, build_nonempty = cached["null_stats"]
+            span = cached["span"]
+            table = cached["table"]
+        else:
+            build_has_null, build_nonempty = _build_null_stats(build_page,
+                                                               node.right_keys)
+            span = self._direct_join_span(build_page, node.right_keys,
+                                          build_key_types)
+            table = None
+            if node.filter is None and build_page.capacity > 0:
+                table = self._build_join_table(build_page, node.right_keys,
+                                               build_key_types, span)
+            if cache_key is not None:
+                self.buffer_pool.put_build(cache_key, {
+                    "page": build_page, "dicts": build_dicts, "table": table,
+                    "span": span,
+                    "null_stats": (build_has_null, build_nonempty)})
+        if table is None or node.filter is not None:
             # duplicate build keys or residual join filter -> multi-match strategy
             return self._compile_multi_join(node, build_page, build_dicts, probe_stream,
                                             build_key_types, span)
@@ -3016,6 +3169,20 @@ def _compact_part(cols, nulls, valid, size: int):
     return out_cols, out_nulls
 
 
+@partial(_jit, static_argnums=(3,))
+def _compact_part_sized(cols, nulls, valid, size: int):
+    """_compact_part plus the compacted part's own validity mask
+    (``arange(size) < live``), computed INSIDE the same dispatch — what lets
+    _concat_stream's single-part fast path skip the _concat_all dispatch
+    without any uncounted eager device work."""
+    idx = jnp.nonzero(valid, size=size, fill_value=0)[0]
+    out_cols = tuple(c[idx] for c in cols)
+    out_nulls = tuple(None if n is None else n[idx] for n in nulls)
+    pvalid = jnp.arange(size, dtype=jnp.int32) < \
+        jnp.sum(valid, dtype=jnp.int32)
+    return out_cols, out_nulls, pvalid
+
+
 def _concat_traced(stream: _Stream):
     """Whole-scan materialization for traced-regenerable streams in two device
     dispatches + one scalar sync: a counting ``lax.scan`` sizes the output, a
@@ -3127,12 +3294,12 @@ def _concat_stream(stream: _Stream, batch: int = 1) -> Page:
                 ccols = tuple(np.asarray(c)[v] for c in cols)  # host-ok: object cols
                 cnulls = tuple(None if m is None else rest.pop(0)[v]
                                for m in nulls)
-                parts.append((ccols, cnulls, n))
+                parts.append((ccols, cnulls, None, n))
                 continue
             bucket = max(1 << max(n - 1, 1).bit_length(), 1024)
-            ccols, cnulls = _compact_part(cols, nulls, valid,
-                                          min(bucket, valid.shape[0]))
-            parts.append((ccols, cnulls, n))
+            ccols, cnulls, pvalid = _compact_part_sized(
+                cols, nulls, valid, min(bucket, valid.shape[0]))
+            parts.append((ccols, cnulls, pvalid, n))
         staged.clear()
         sums.clear()
 
@@ -3151,8 +3318,15 @@ def _concat_stream(stream: _Stream, batch: int = 1) -> Page:
     # host sync anywhere in the session makes every dispatch pay an RTT, so
     # column-by-column top-level concats are ~70ms each
     ncols = len(parts[0][0])
-    has_null = tuple(any(cnulls[ci] is not None for _, cnulls, _ in parts)
+    has_null = tuple(any(cnulls[ci] is not None for _, cnulls, _, _ in parts)
                      for ci in range(ncols))
+    if len(parts) == 1 and parts[0][2] is not None:
+        # single part (single-page stream, or a buffer-pool hit serving the
+        # whole scan as one page): there is nothing to concatenate — the
+        # compacted part IS the page, and its validity mask was computed
+        # inside the _compact_part_sized dispatch (no extra device op at all)
+        ccols, cnulls, pvalid, _ = parts[0]
+        return Page(stream.schema, ccols, cnulls, pvalid)
     if any(isinstance(c, np.ndarray) and c.dtype == object
            for c in parts[0][0]):
         # host concat for exact wide-decimal parts (host-compacted above)
@@ -3164,9 +3338,9 @@ def _concat_stream(stream: _Stream, batch: int = 1) -> Page:
                             for p in parts]) if has_null[ci] else None
             for ci in range(ncols))
         return Page(stream.schema, cols_out, nulls_out, None)
-    ns = jnp.asarray([n for _, _, n in parts], jnp.int32)
+    ns = jnp.asarray([n for _, _, _, n in parts], jnp.int32)
     cols_out, nulls_out, valid = _concat_all(
-        tuple((ccols, cnulls) for ccols, cnulls, _ in parts), ns, has_null)
+        tuple((ccols, cnulls) for ccols, cnulls, _, _ in parts), ns, has_null)
     return Page(stream.schema, cols_out, nulls_out, valid)
 
 
@@ -3662,6 +3836,66 @@ def _page_bytes(page: Page) -> int:
         total += page.capacity * np.dtype(c.dtype).itemsize
     total += sum(page.capacity for n in page.null_masks if n is not None)
     return total
+
+
+def _stage_scan_entry(pages):
+    """One device-resident page from a completed scan's page list, for the
+    buffer pool's page tier.  Host (HOST_DECODE / memory-connector) arrays
+    stage through _page_to_device — the sanctioned H2D chokepoint — and the
+    concatenation runs as ONE COUNTED _jit dispatch (row order = split
+    order, the _stack_pages soundness argument), so the cold path's store
+    cost shows up in the budget counters and per-site attribution instead of
+    hiding as eager device work.  Returns None when any column is an object
+    (exact wide-decimal) array — those cannot live on device."""
+    pages = [_page_to_device(p) for p in pages]
+    if any(isinstance(c, np.ndarray) and c.dtype == object
+           for p in pages for c in p.columns):
+        return None
+    if len(pages) == 1:
+        return pages[0]
+    stack = _jit(lambda ps: _stack_pages(ps), site="cache.store")
+    cols, nulls, valid = stack(tuple(pages))
+    return Page(pages[0].schema, cols, nulls, valid)
+
+
+def _plan_fingerprint(node: P.PlanNode, catalogs: dict) -> str:
+    """Structural fingerprint of a plan subtree — the build-cache key.
+
+    Two structurally identical build fragments (same operators, expressions,
+    schemas, scanned tables) must collide even when they come from DIFFERENT
+    plan objects (another executor compiling the same cached plan, a second
+    statement sharing the subquery), so the walk is content-based: dataclass
+    leaves print by value, plan children recurse, and TableScans carry their
+    catalog/table/columns plus the connector's plan_version (growable
+    catalogs — the system tables' dictionaries — never serve a stale build).
+    Opaque payloads (dictionary value arrays) print by IDENTITY: they are
+    connector-owned singletons, stable for the life of this process, and
+    printing megabyte arrays by content would be both slow and collision-
+    prone under numpy's truncating repr."""
+    def val(v):
+        if v is None or isinstance(v, (str, int, float, bool, bytes)):
+            return repr(v)
+        if isinstance(v, (tuple, list)):
+            return "(" + ",".join(val(x) for x in v) + ")"
+        if isinstance(v, P.PlanNode):
+            return fp(v)
+        if isinstance(v, np.ndarray):
+            return f"nd#{id(v)}"
+        if dataclasses.is_dataclass(v) and not isinstance(v, type):
+            return f"{type(v).__name__}(" + ",".join(
+                val(getattr(v, f.name)) for f in dataclasses.fields(v)) + ")"
+        return f"{type(v).__name__}#{id(v)}"
+
+    def fp(n):
+        if isinstance(n, P.TableScan):
+            conn = catalogs.get(n.catalog)
+            ver = conn.plan_version() if hasattr(conn, "plan_version") else 0
+            return (f"TableScan({n.catalog},{n.table},"
+                    f"{','.join(n.columns)},v{ver})")
+        return f"{type(n).__name__}(" + ";".join(
+            val(getattr(n, f.name)) for f in dataclasses.fields(n)) + ")"
+
+    return fp(node)
 
 
 def _compact_pack(valid):

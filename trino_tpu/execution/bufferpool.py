@@ -1,0 +1,325 @@
+"""HBM device buffer pool: page & join-build caching across queries.
+
+Trino-class engines treat a columnar buffer pool as table stakes; on tunneled
+TPUs the payoff is double — a cached scan skips host generation AND the
+host->device transfer, and (because the cached entry is the WHOLE scan as one
+device page) every downstream per-split consumer loop collapses to a single
+dispatch per stage.  TQP (arxiv 2203.01877) and "Accelerating Presto with
+GPUs" (arxiv 2606.24647) both report that keeping hot columnar data resident
+in accelerator memory, not re-staging it per query, is where warm wall-clock
+goes.
+
+Two tiers, one LRU:
+
+- **Page tier** — a completed scan's pages, concatenated into ONE
+  device-resident page, keyed on (catalog, table, split list, column set,
+  connector plan_version).  Raw pre-transform pages, so queries with
+  different filters/projections over the same scan share the entry.
+  Entries are only stored when the scan ran to completion (a LIMIT
+  short-circuit or error unwind must never cache a partial scan).
+- **Build tier** — finished join build state (the materialized build page,
+  its dictionaries, and the built hash table when the single-match strategy
+  applies), keyed on a structural fingerprint of the build fragment plus the
+  plan_versions of the catalogs it reads.  Checked out tables thread through
+  ``_Stream.aux`` as JIT ARGUMENTS (the no-closed-over-aux rule) exactly like
+  freshly built ones.
+
+Reservations flow through a private labeled :class:`~..memory.MemoryPool`
+(visible in ``/v1/status`` and ``/v1/metrics`` as pool "buffer-pool");
+pressure LRU-evicts instead of raising, and ``clear()`` releases every
+reservation (Engine._invalidate calls it, so DDL can never leak device
+memory through the pool).
+
+Gating: ``TRINO_TPU_PAGE_CACHE`` is the HBM byte budget (``0`` = off, the
+CPU-backend default — regeneration is cheap there and host RAM is the
+scarce resource); unset on an accelerator backend defaults to 25% of HBM.
+The non-plan-shaping ``page_cache`` session property opts single queries in
+or out of a configured pool.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+__all__ = ["DeviceBufferPool", "page_cache_budget"]
+
+
+def page_cache_budget() -> int:
+    """Resolve the pool byte budget: the TRINO_TPU_PAGE_CACHE env var when
+    set (plain bytes; 0 disables), else 0 on the CPU backend and a quarter of
+    the device memory budget on accelerators.  Resolved lazily (first use) so
+    importing this module never forces jax backend initialization."""
+    import os
+
+    raw = os.environ.get("TRINO_TPU_PAGE_CACHE")
+    if raw is not None:
+        try:
+            return max(int(raw), 0)
+        except ValueError:
+            return 0
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return 0
+    from ..memory import device_memory_budget
+
+    return device_memory_budget(0.25)
+
+
+def _page_nbytes(page) -> int:
+    """Device bytes a cached page pins (columns + null masks + valid)."""
+    import numpy as np
+
+    total = 0
+    n = page.capacity
+    for c in page.columns:
+        if getattr(c, "dtype", None) == object:
+            continue
+        total += n * np.dtype(c.dtype).itemsize
+    total += sum(n for m in page.null_masks if m is not None)
+    if page.valid is not None:
+        total += n
+    return total
+
+
+def _table_nbytes(table) -> int:
+    """Device bytes of a join table's array leaves (JoinTable /
+    DirectJoinTable pytrees).  build_columns may alias the build page's
+    buffers — the double count is a deliberate conservative over-estimate
+    (earlier eviction, never silent overcommit)."""
+    import dataclasses
+
+    import numpy as np
+
+    if table is None:
+        return 0
+    total = 0
+    for f in dataclasses.fields(table):
+        v = getattr(table, f.name)
+        leaves = v if isinstance(v, (tuple, list)) else (v,)
+        for leaf in leaves:
+            shape = getattr(leaf, "shape", None)
+            if shape is None or getattr(leaf, "dtype", None) == object:
+                continue
+            total += int(np.prod(shape, dtype=np.int64)) * \
+                np.dtype(leaf.dtype).itemsize
+    return total
+
+
+class _Entry:
+    __slots__ = ("kind", "catalog", "table", "payload", "nbytes")
+
+    def __init__(self, kind, catalog, table, payload, nbytes):
+        self.kind = kind  # "page" | "build"
+        self.catalog = catalog
+        self.table = table  # per-table breakdown / invalidation ("" for
+        # multi-table build fragments — they invalidate via clear()/versions)
+        self.payload = payload
+        self.nbytes = nbytes
+
+
+class DeviceBufferPool:
+    """Engine-owned two-tier HBM cache (page tier + join-build tier) with LRU
+    eviction accounted through a labeled MemoryPool.  One instance is shared
+    by every pooled executor under this lock; a WorkerServer owns its own."""
+
+    PAGE_TAG = "page-cache"
+    BUILD_TAG = "build-cache"
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        self._budget = budget_bytes  # None = resolve lazily from env/backend
+        self._lock = threading.RLock()
+        self._entries: OrderedDict = OrderedDict()  # key -> _Entry (LRU)
+        self.memory_pool = None  # created when the budget resolves nonzero
+        # lifetime stats (the /v1/metrics *_total series — independent of
+        # per-query counters so worker-merged totals don't double-count)
+        self.hits = 0
+        self.misses = 0
+        self.build_hits = 0
+        self.build_misses = 0
+        self.evictions = 0
+
+    # -- gating ----------------------------------------------------------------
+    def budget(self) -> int:
+        with self._lock:
+            if self._budget is None:
+                self._budget = page_cache_budget()
+            return self._budget
+
+    @property
+    def enabled(self) -> bool:
+        return self.budget() > 0
+
+    @staticmethod
+    def cacheable(conn) -> bool:
+        """Only connectors whose page generation is deterministic for a given
+        plan_version may cache (the same assumption the engine's plan cache
+        makes: immutable generators, DDL/DML invalidates).  Volatile sources
+        (system runtime tables, external dbapi databases) never opt in."""
+        return bool(getattr(conn, "CACHEABLE_SCANS", False))
+
+    def _pool(self):
+        if self.memory_pool is None:
+            from ..memory import MemoryPool
+
+            self.memory_pool = MemoryPool(max_bytes=self.budget())
+        return self.memory_pool
+
+    # -- keys ------------------------------------------------------------------
+    @staticmethod
+    def page_key(catalog: str, conn, table: str, splits, columns) -> tuple:
+        ver = conn.plan_version() if hasattr(conn, "plan_version") else 0
+        return ("page", catalog, table,
+                tuple((s.lo, s.hi) if hasattr(s, "lo") and hasattr(s, "hi")
+                      else repr(s) for s in splits),
+                tuple(columns), ver)
+
+    # -- page tier -------------------------------------------------------------
+    def get_page(self, key):
+        """-> (page, nbytes) or None; a hit refreshes LRU recency."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return e.payload, e.nbytes
+
+    def has_page(self, key) -> bool:
+        """Presence probe WITHOUT recency/stat side effects — the store path
+        uses it to skip staging an entry another executor already built."""
+        with self._lock:
+            return key in self._entries
+
+    def put_page(self, key, page) -> bool:
+        """Store a COMPLETED scan already staged as one device-resident page
+        (exec.local_executor._stage_scan_entry does the staging: host arrays
+        through the sanctioned _page_to_device chokepoint, concatenation as
+        one COUNTED _jit dispatch — device work here would be invisible to
+        the budget counters).  Never raises: an over-budget page is simply
+        not cached."""
+        if not self.enabled or page is None:
+            return False
+        with self._lock:
+            if key in self._entries:
+                return True  # another executor stored it first
+        nbytes = _page_nbytes(page)
+        return self._store(key, _Entry("page", key[1], key[2], page, nbytes),
+                           self.PAGE_TAG)
+
+    # -- build tier ------------------------------------------------------------
+    def get_build(self, key):
+        """-> payload dict or None.  Payload holds {"page", "dicts", "table",
+        "span", "null_stats"} — everything _compile_join derives from the
+        build fragment; "table" is None when the fragment needs the
+        multi-match strategy (duplicate keys / residual filter)."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.build_misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.build_hits += 1
+            return e.payload
+
+    def put_build(self, key, payload) -> bool:
+        """``key`` is ("build", fingerprint, right_keys, catalogs-tuple) —
+        the catalogs tuple (key[3]) is what invalidate_catalog matches."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            if key in self._entries:
+                return True
+        nbytes = _page_nbytes(payload["page"]) \
+            + _table_nbytes(payload.get("table"))
+        return self._store(
+            key, _Entry("build", ",".join(key[3]), "", payload, nbytes),
+            self.BUILD_TAG)
+
+    # -- storage / eviction ----------------------------------------------------
+    def _store(self, key, entry: _Entry, tag: str) -> bool:
+        pool = self._pool()
+        with self._lock:
+            if key in self._entries:
+                return True
+            if entry.nbytes > pool.max_bytes:
+                return False  # can never fit: don't flush everyone else first
+            while not pool.try_reserve(entry.nbytes, tag):
+                if not self._entries:
+                    return False
+                self._evict_lru()
+            self._entries[key] = entry
+            return True
+
+    def _evict_lru(self) -> None:
+        """Caller holds the lock.  Frees the oldest entry's reservation; the
+        device arrays free when the last stream/aux reference drops (jax
+        arrays are refcounted — an in-flight query holding the page keeps it
+        alive exactly as long as it needs it)."""
+        key, e = self._entries.popitem(last=False)
+        self.evictions += 1
+        self.memory_pool.free(
+            e.nbytes, self.PAGE_TAG if e.kind == "page" else self.BUILD_TAG)
+
+    # -- invalidation ----------------------------------------------------------
+    def invalidate_catalog(self, catalog: str) -> None:
+        """Drop every entry that reads ``catalog`` (version-stale plan
+        eviction path).  Build entries fingerprint their versions, so a stale
+        one would never SERVE — this releases its device memory too."""
+        with self._lock:
+            dead = [k for k, e in self._entries.items()
+                    if e.catalog == catalog
+                    or (e.kind == "build" and catalog in k[3])]
+            for k in dead:
+                e = self._entries.pop(k)
+                if self.memory_pool is not None:
+                    self.memory_pool.free(
+                        e.nbytes,
+                        self.PAGE_TAG if e.kind == "page" else self.BUILD_TAG)
+
+    def clear(self) -> None:
+        """Release everything (Engine._invalidate / DDL / register_catalog).
+        Reservations return to the pool so no device memory leaks across
+        DDL."""
+        with self._lock:
+            for e in self._entries.values():
+                if self.memory_pool is not None:
+                    self.memory_pool.free(
+                        e.nbytes,
+                        self.PAGE_TAG if e.kind == "page" else self.BUILD_TAG)
+            self._entries.clear()
+
+    # -- observability ---------------------------------------------------------
+    def info(self) -> dict:
+        """Snapshot for /v1/status's buffer_pool section and the
+        /v1/metrics page-cache gauges."""
+        with self._lock:
+            per_table: dict = {}
+            total = 0
+            pages = builds = 0
+            for e in self._entries.values():
+                total += e.nbytes
+                if e.kind == "page":
+                    pages += 1
+                else:
+                    builds += 1
+                label = f"{e.catalog}.{e.table}" if e.table else \
+                    (f"{e.catalog}.<build>" if e.catalog else "<build>")
+                t = per_table.setdefault(label, {"entries": 0, "bytes": 0})
+                t["entries"] += 1
+                t["bytes"] += e.nbytes
+            return {"budget_bytes": self._budget if self._budget is not None
+                    else None,
+                    "enabled": bool(self._budget) if self._budget is not None
+                    else None,
+                    "entries": len(self._entries),
+                    "page_entries": pages, "build_entries": builds,
+                    "bytes": total,
+                    "hits": self.hits, "misses": self.misses,
+                    "build_hits": self.build_hits,
+                    "build_misses": self.build_misses,
+                    "evictions": self.evictions,
+                    "per_table": per_table}

@@ -85,6 +85,11 @@ SYSTEM_SESSION_PROPERTIES = {p.name: p for p in [
                      "TRINO_TPU_DISPATCH_BATCH, 1 = exact per-split "
                      "execution).  Plan-shaping: rides the plan-cache key",
                      "integer", 0, lambda v: None if v >= 0 else "must be >= 0"),
+    PropertyMetadata("page_cache",
+                     "Serve scans / join builds from the device buffer pool "
+                     "(execution/bufferpool; pool budget from "
+                     "TRINO_TPU_PAGE_CACHE).  NON-plan-shaping: flipping it "
+                     "never re-plans or re-compiles", "boolean", True),
     PropertyMetadata("query_max_memory",
                      "Per-query device memory limit in bytes (0 = node limit "
                      "only; reference: query.max-memory + "

@@ -19,7 +19,8 @@ from typing import Optional
 
 __all__ = ["Span", "Tracer", "NOOP_TRACER", "QueryCounters", "track_counters",
            "current_counters", "record_dispatch", "record_host_pull",
-           "record_coalesced", "LatencyHistogram", "LATENCY_BUCKETS_S",
+           "record_coalesced", "record_page_cache", "record_build_cache",
+           "LatencyHistogram", "LATENCY_BUCKETS_S",
            "operator_scope", "activate_tracer", "current_tracer",
            "maybe_span", "span_dict", "spans_to_otlp",
            "InflightRegistry", "InflightEntry", "INFLIGHT", "inflight",
@@ -155,29 +156,39 @@ class QueryCounters:
     # per-split dispatches into one — visible so EXPLAIN ANALYZE / bench can
     # show HOW a query met its dispatch budget, not just that it did
     coalesced_splits: int = 0
-    # "<operator>/<site>" -> {"dispatches", "transfers", "bytes"}: the
-    # attribution EXPLAIN ANALYZE prints and budget failures dump
+    # round 9: device buffer pool (execution/bufferpool.DeviceBufferPool).
+    # A page hit means the whole scan was served from HBM — no host
+    # generation, no H2D staging, one page instead of K splits;
+    # bytes_saved is the served entry's device footprint.  A build hit means
+    # a join's build fragment (page + hash table) came from the pool.
+    page_cache_hits: int = 0
+    page_cache_misses: int = 0
+    page_cache_bytes_saved: int = 0
+    build_cache_hits: int = 0
+    # "<operator>/<site>" -> {"dispatches", "transfers", "bytes"} plus any
+    # cache keys the site recorded: the attribution EXPLAIN ANALYZE prints
+    # and budget failures dump
     sites: dict = dataclasses.field(default_factory=dict)
     dispatch_latency: LatencyHistogram = \
         dataclasses.field(default_factory=LatencyHistogram)
 
+    _INT_FIELDS = ("device_dispatches", "host_transfers", "host_bytes_pulled",
+                   "coalesced_splits", "page_cache_hits", "page_cache_misses",
+                   "page_cache_bytes_saved", "build_cache_hits")
+
     def reset(self) -> None:
-        self.device_dispatches = 0
-        self.host_transfers = 0
-        self.host_bytes_pulled = 0
-        self.coalesced_splits = 0
+        for f in self._INT_FIELDS:
+            setattr(self, f, 0)
         self.sites = {}
         self.dispatch_latency = LatencyHistogram()
 
     def merge(self, other: "QueryCounters") -> None:
-        self.device_dispatches += other.device_dispatches
-        self.host_transfers += other.host_transfers
-        self.host_bytes_pulled += other.host_bytes_pulled
-        self.coalesced_splits += other.coalesced_splits
+        for f in self._INT_FIELDS:
+            setattr(self, f, getattr(self, f) + getattr(other, f, 0))
         for key, rec in other.sites.items():
             mine = _site_entry(self.sites, key)
-            for k in ("dispatches", "transfers", "bytes"):
-                mine[k] += rec.get(k, 0)
+            for k, v in rec.items():  # union of keys: cache sites carry extras
+                mine[k] = mine.get(k, 0) + v
         self.dispatch_latency.merge(other.dispatch_latency)
 
     def merge_dict(self, d: dict) -> None:
@@ -185,32 +196,29 @@ class QueryCounters:
         task responses carry over the wire) into this one."""
         if not d:
             return
-        self.device_dispatches += int(d.get("device_dispatches", 0))
-        self.host_transfers += int(d.get("host_transfers", 0))
-        self.host_bytes_pulled += int(d.get("host_bytes_pulled", 0))
-        self.coalesced_splits += int(d.get("coalesced_splits", 0))
+        for f in self._INT_FIELDS:
+            setattr(self, f, getattr(self, f) + int(d.get(f, 0)))
         for key, rec in (d.get("sites") or {}).items():
             mine = _site_entry(self.sites, str(key))
-            for k in ("dispatches", "transfers", "bytes"):
-                mine[k] += int(rec.get(k, 0))
+            for k, v in rec.items():
+                mine[k] = mine.get(k, 0) + int(v)
         lat = d.get("dispatch_latency")
         if lat:
             self.dispatch_latency.merge_dict(lat)
 
     def snapshot(self) -> "QueryCounters":
-        out = QueryCounters(self.device_dispatches, self.host_transfers,
-                            self.host_bytes_pulled, self.coalesced_splits)
+        out = QueryCounters()
+        for f in self._INT_FIELDS:
+            setattr(out, f, getattr(self, f))
         out.sites = {k: dict(v) for k, v in self.sites.items()}
         out.dispatch_latency = self.dispatch_latency.snapshot()
         return out
 
     def as_dict(self) -> dict:
-        return {"device_dispatches": self.device_dispatches,
-                "host_transfers": self.host_transfers,
-                "host_bytes_pulled": self.host_bytes_pulled,
-                "coalesced_splits": self.coalesced_splits,
-                "sites": {k: dict(v) for k, v in self.sites.items()},
-                "dispatch_latency": self.dispatch_latency.as_dict()}
+        d = {f: getattr(self, f) for f in self._INT_FIELDS}
+        d["sites"] = {k: dict(v) for k, v in self.sites.items()}
+        d["dispatch_latency"] = self.dispatch_latency.as_dict()
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "QueryCounters":
@@ -363,6 +371,47 @@ def record_coalesced(n_splits: int) -> None:
     c = getattr(_counter_local, "counters", None)
     if c is not None:
         c.coalesced_splits += n_splits
+
+
+def _attribute_extra(site: Optional[str], **extras) -> None:
+    """Charge non-boundary extras (cache hits/misses/bytes saved) to the
+    active op scope's site record and boundary sink — same "<op>/<site>" key
+    shape as dispatches, extra keys alongside them."""
+    c = getattr(_counter_local, "counters", None)
+    op = getattr(_counter_local, "op", None)
+    tag = site or "untagged"
+    if c is not None:
+        key = f"{op[0]}/{tag}" if op is not None else tag
+        rec = _site_entry(c.sites, key)
+        for k, v in extras.items():
+            rec[k] = rec.get(k, 0) + v
+    if op is not None and op[1] is not None:
+        sink = op[1]
+        for k, v in extras.items():
+            sink[k] = sink.get(k, 0) + v
+
+
+def record_page_cache(hits: int = 0, misses: int = 0, bytes_saved: int = 0,
+                      site: Optional[str] = None) -> None:
+    """One buffer-pool page-tier lookup outcome (recorded on the QUERY
+    thread — the scan page source resolves the cache before any prefetch
+    thread starts, so these never race the thread-local counters)."""
+    c = getattr(_counter_local, "counters", None)
+    if c is not None:
+        c.page_cache_hits += hits
+        c.page_cache_misses += misses
+        c.page_cache_bytes_saved += bytes_saved
+    _attribute_extra(site, page_cache_hits=hits, page_cache_misses=misses,
+                     page_cache_bytes_saved=bytes_saved)
+
+
+def record_build_cache(hits: int = 0, misses: int = 0,
+                       site: Optional[str] = None) -> None:
+    """One buffer-pool build-tier lookup outcome."""
+    c = getattr(_counter_local, "counters", None)
+    if c is not None:
+        c.build_cache_hits += hits
+    _attribute_extra(site, build_cache_hits=hits, build_cache_misses=misses)
 
 
 # -- in-flight registry --------------------------------------------------------

@@ -309,7 +309,21 @@ class WorkerServer:
         from ..memory import MemoryPool
 
         self.memory_pool = MemoryPool()
-        self.local = LocalExecutor(self.catalogs, memory_pool=self.memory_pool)
+        # worker-local device buffer pool (round 9): tasks over the same
+        # table share scan pages / join builds across this worker's executor
+        # pool — each node caches what IT scans (the coordinator's engine
+        # pool is separate by design; there is no cross-node cache protocol).
+        # No DDL-invalidation protocol is needed YET: build_catalogs only
+        # instantiates immutable generator connectors (tpch/tpcds), whose
+        # pages never go stale.  A future MUTABLE worker connector must ship
+        # cache invalidation alongside its writes (clear this pool on the
+        # coordinator's invalidation broadcast) before it may set
+        # CACHEABLE_SCANS.
+        from ..execution.bufferpool import DeviceBufferPool
+
+        self.buffer_pool = DeviceBufferPool()
+        self.local = LocalExecutor(self.catalogs, memory_pool=self.memory_pool,
+                                   buffer_pool=self.buffer_pool)
         # worker-local tracer: each task runs under a root span (trace id =
         # task id) whose finished tree rides the status response back to the
         # coordinator
@@ -600,7 +614,8 @@ class WorkerServer:
         with self._wlock:
             if self._executor_pool:
                 return self._executor_pool.pop()
-            ex = LocalExecutor(self.catalogs, memory_pool=self.memory_pool)
+            ex = LocalExecutor(self.catalogs, memory_pool=self.memory_pool,
+                               buffer_pool=self.buffer_pool)
             self._all_executors.append(ex)
             return ex
 
@@ -692,6 +707,9 @@ class WorkerServer:
             # the session's coalescing width rides the task request: worker
             # executors batch per-split dispatches like the coordinator's
             ex.dispatch_batch = req.get("dispatch_batch")
+            # the session's page_cache override rides the task request too
+            # (None = this worker's TRINO_TPU_PAGE_CACHE gate)
+            ex.page_cache = req.get("page_cache")
 
             def tick(t=token):
                 # preemption point doubles as the kill checkpoint: a query
@@ -781,7 +799,8 @@ class WorkerServer:
                         self.memory_pool.clear_query(xdir)
                     else:
                         self._running_queries[xdir] = nq
-                ex.dispatch_batch = None  # per-task setting; executor is pooled
+                ex.dispatch_batch = None  # per-task settings; executor is pooled
+                ex.page_cache = None
                 self._release_executor(ex, token=token)
 
         threading.Thread(target=run, daemon=True).start()
@@ -921,7 +940,11 @@ class ClusterCoordinator:
         # long-lived executor + sql->plan cache: repeated queries reuse one
         # plan object, so the id(node)-keyed compiled-pipeline caches hit
         # instead of re-tracing per query
-        self._local = LocalExecutor(engine.catalogs)
+        # shares the engine's buffer pool: the coordinator's local finish
+        # (and the all-workers-degraded local fallback) caches like any
+        # pooled executor, and the per-query page_cache stash below applies
+        self._local = LocalExecutor(engine.catalogs,
+                                    buffer_pool=engine.buffer_pool)
         self._compile_lock = threading.Lock()  # shared-executor stream compiles
         self._query_abort = threading.Event()  # fail-fast across sibling stages
         from collections import OrderedDict
@@ -1176,6 +1199,10 @@ class ClusterCoordinator:
             # _query_lock, so the per-query stash is race-free)
             self._dispatch_batch = _effective_dispatch_batch(sess)
             local.dispatch_batch = self._dispatch_batch
+            from ..engine import _effective_page_cache
+
+            self._page_cache = _effective_page_cache(sess)
+            local.page_cache = self._page_cache
             # per-query cluster profile: worker counters merge in as commits
             # are observed; the finally below publishes coordinator + workers
             self._qc_workers = QueryCounters()
@@ -1613,7 +1640,8 @@ class ClusterCoordinator:
         req = {"task_id": tid, "fragment_id": frag_id, "kind": "fragment",
                "attempt": 0, "exchange_dir": exchange_dir,
                "output": "stream", "n_readers": n_readers,
-               "dispatch_batch": getattr(self, "_dispatch_batch", None)}
+               "dispatch_batch": getattr(self, "_dispatch_batch", None),
+               "page_cache": getattr(self, "_page_cache", None)}
         if sources:
             req["stream_sources"] = sources
         last_err = None
@@ -1768,6 +1796,9 @@ class ClusterCoordinator:
                                         "exchange_dir": exchange_dir,
                                         "dispatch_batch":
                                             getattr(self, "_dispatch_batch",
+                                                    None),
+                                        "page_cache":
+                                            getattr(self, "_page_cache",
                                                     None), **extra})
                     _http(f"{w.url}/v1/task", req, secret=self.secret)
                     assigned[tid] = (w, extra, time.time() + self.task_timeout)

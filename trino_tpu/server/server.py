@@ -616,6 +616,38 @@ class CoordinatorServer:
             "# TYPE trino_tpu_stalled_dispatches gauge",
             f"trino_tpu_stalled_dispatches {stalled}",
         ]
+        # device buffer pool (round 9): cache effectiveness is a first-class
+        # scrape — entries/bytes are gauges (they shrink on eviction and
+        # DDL), hit/miss counts are lifetime counters of this node's pool
+        bp = getattr(self.engine, "buffer_pool", None)
+        if bp is not None:
+            bi = bp.info()
+            lines += [
+                "# HELP trino_tpu_page_cache_bytes Device bytes resident in "
+                "the buffer pool (page + build tiers).",
+                "# TYPE trino_tpu_page_cache_bytes gauge",
+                f"trino_tpu_page_cache_bytes {bi['bytes']}",
+                "# HELP trino_tpu_page_cache_entries Entries resident in the "
+                "buffer pool.",
+                "# TYPE trino_tpu_page_cache_entries gauge",
+                f"trino_tpu_page_cache_entries {bi['entries']}",
+                "# HELP trino_tpu_page_cache_hits_total Buffer-pool page-"
+                "tier hits (whole scans served from device memory).",
+                "# TYPE trino_tpu_page_cache_hits_total counter",
+                f"trino_tpu_page_cache_hits_total {bi['hits']}",
+                "# HELP trino_tpu_page_cache_misses_total Buffer-pool page-"
+                "tier misses.",
+                "# TYPE trino_tpu_page_cache_misses_total counter",
+                f"trino_tpu_page_cache_misses_total {bi['misses']}",
+                "# HELP trino_tpu_build_cache_hits_total Buffer-pool build-"
+                "tier hits (join builds checked out instead of re-executed).",
+                "# TYPE trino_tpu_build_cache_hits_total counter",
+                f"trino_tpu_build_cache_hits_total {bi['build_hits']}",
+                "# HELP trino_tpu_page_cache_evictions_total LRU evictions "
+                "under buffer-pool memory pressure.",
+                "# TYPE trino_tpu_page_cache_evictions_total counter",
+                f"trino_tpu_page_cache_evictions_total {bi['evictions']}",
+            ]
         # memory-pool snapshots as labeled gauges (the pool info dict finally
         # reaches the metrics endpoint — round-8 satellite)
         pools = self.engine.memory_info() \
@@ -680,11 +712,16 @@ class CoordinatorServer:
                     "counters": live.get(i.query_id),
                     "inflight": [f for f in inflight
                                  if f.get("query_id") == i.query_id]})
+        bp = getattr(e, "buffer_pool", None)
         return {"health": health,
                 "stall_report": getattr(e, "last_stall_report", None),
                 "inflight": inflight,
                 "queries": queries,
                 "memory": e.memory_info() if hasattr(e, "memory_info") else [],
+                # buffer-pool section (round 9): entries/bytes/hit rates plus
+                # the per-table breakdown — "what is resident and is it
+                # earning its HBM" from one poll
+                "buffer_pool": bp.info() if bp is not None else None,
                 "device_memory": _device_memory_stats()}
 
     def _query_row_count(self, q):

@@ -32,6 +32,14 @@ def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
             f"{counters.host_transfers} host transfers, "
             f"{counters.host_bytes_pulled} bytes pulled, "
             f"{getattr(counters, 'coalesced_splits', 0)} splits coalesced")
+        pc_h = getattr(counters, "page_cache_hits", 0)
+        pc_m = getattr(counters, "page_cache_misses", 0)
+        bc_h = getattr(counters, "build_cache_hits", 0)
+        if pc_h or pc_m or bc_h:
+            lines.append(
+                f"Buffer pool: {pc_h} page hits, {pc_m} page misses, "
+                f"{getattr(counters, 'page_cache_bytes_saved', 0)} bytes "
+                f"saved, {bc_h} build hits")
         res = (boundary or {}).get("result")
         if res is not None and _boundary_nonzero(res):
             lines.append("    result: " + _boundary_str(res))
