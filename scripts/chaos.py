@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Standalone chaos matrix: the tests/test_chaos.py scenarios as a capture
+artifact.  Prints ONE JSON line — always, even on crash (finally block) —
+with per-scenario outcomes and the leak-check verdicts, same contract as
+bench.py, so scripts/tpu_watch.sh can capture a chaos pass on real hardware
+at the next tunnel contact (the fault paths most worth proving on device are
+exactly the ones the tunnel exercises for free: wedges, lost round-trips).
+
+Env knobs:
+    CHAOS_SF       TPC-H scale factor (default 0.1 — CPU-box friendly)
+    CHAOS_QUERIES  comma-separated subset of q1,q3,q9,q18 (default q1,q3)
+    CHAOS_BUDGET   wall-clock budget in seconds (default 600): remaining
+                   scenarios are skipped, not overrun
+    TRINO_TPU_PAGE_CACHE  honored as usual; defaulted to 1GB here so the
+                   cache fault classes have a cache to fault
+"""
+
+import json
+import os
+import sys
+import time
+
+_force_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
+if _force_cpu:
+    os.environ.pop("JAX_PLATFORMS")
+os.environ.setdefault("TRINO_TPU_PAGE_CACHE", str(1 << 30))
+
+import jax  # noqa: E402
+
+if _force_cpu:
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    t_start = time.time()
+    budget = float(os.environ.get("CHAOS_BUDGET", "600"))
+    sf = float(os.environ.get("CHAOS_SF", "0.1"))
+    names = [q.strip() for q in
+             os.environ.get("CHAOS_QUERIES", "q1,q3").split(",") if q.strip()]
+    payload = {"metric": "chaos_pass_fraction", "value": 0.0,
+               "unit": "fraction", "sf": sf, "scenarios": []}
+    rc = 1
+    try:
+        from benchenv import env_info
+
+        payload["env"] = env_info()
+    except Exception:
+        pass
+    try:
+        from trino_tpu import Engine
+        from trino_tpu.connectors.tpch import TpchConnector
+        from trino_tpu.execution import faults
+        # the scenario table + signature/leak helpers are SHARED with
+        # tests/test_chaos.py: one matrix, pinned by the suite, captured here
+        from trino_tpu.execution.chaos_matrix import (QUERIES, SCENARIOS,
+                                                      leak_report)
+        from trino_tpu.execution.chaos_matrix import result_signature as _sig
+        from trino_tpu.execution.faults import InjectedFaultError
+
+        engine = Engine()
+        # multi-split geometry at every scale: the generate/h2d classes fire
+        # on the 2nd+ split and the prefetch producer only exists for
+        # multi-split scans
+        split_rows = 1 << 21 if sf >= 1 else 1 << 16
+        engine.register_catalog("tpch",
+                                TpchConnector(sf=sf, split_rows=split_rows))
+        payload["split_rows"] = split_rows
+        session = engine.create_session("tpch")
+        nocache = engine.create_session("tpch")
+        engine.session_properties.set_property(nocache, "page_cache", False)
+        baselines = {}
+        for q in names:
+            engine.execute_sql(QUERIES[q], session)  # cold
+            baselines[q] = _sig(engine.execute_sql(QUERIES[q], session))
+        done = skipped = 0
+        for q in names:
+            for (name, spec, kind, clear_pool, cache_on) in SCENARIOS:
+                if time.time() - t_start > budget:
+                    skipped += 1
+                    continue
+                rec = {"query": q, "scenario": name, "kind": kind}
+                try:
+                    if clear_pool:
+                        engine.buffer_pool.clear()
+                    sess = session if cache_on else nocache
+                    with faults.injected(spec) as plan:
+                        if kind == "fail":
+                            try:
+                                engine.execute_sql(QUERIES[q], sess)
+                                rec["ok"] = False
+                                rec["detail"] = "no error raised"
+                            except InjectedFaultError:
+                                rec["ok"] = True
+                        else:
+                            got = _sig(engine.execute_sql(QUERIES[q], sess))
+                            rec["ok"] = got == baselines[q]
+                            if not rec["ok"]:
+                                rec["detail"] = "result diverged"
+                    rec["fires"] = plan.total_fires()
+                    if rec["fires"] < 1:
+                        rec["ok"] = False
+                        rec["detail"] = "scenario never fired"
+                    leftovers = leak_report(engine)
+                    if leftovers:
+                        rec["ok"] = False
+                        rec["leaks"] = leftovers
+                    if rec.get("ok"):
+                        # clean-rerun probe: no partial state survived
+                        again = _sig(engine.execute_sql(QUERIES[q], session))
+                        if again != baselines[q]:
+                            rec["ok"] = False
+                            rec["detail"] = "post-fault rerun diverged"
+                except Exception as e:  # scenario harness failure
+                    rec["ok"] = False
+                    rec["detail"] = f"{type(e).__name__}: {e}"
+                payload["scenarios"].append(rec)
+                done += 1
+        total = len(payload["scenarios"])
+        passed = sum(1 for r in payload["scenarios"] if r.get("ok"))
+        payload["value"] = (passed / total) if total else 0.0
+        payload["passed"], payload["total"] = passed, total
+        payload["skipped_over_budget"] = skipped
+        rc = 0 if total and passed == total else 1
+    except BaseException as e:
+        payload["error"] = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        payload["wall_s"] = round(time.time() - t_start, 1)
+        print(json.dumps(payload), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,15 @@ for i in $(seq 1 250); do
       rc=$?
       echo "$(date -Is) $name done rc=$rc : $(cat scripts/bench_${name}.json)" >> "$LOG"
     done
+    # round-10 chaos pass on the REAL device: the fault paths (wedges, lost
+    # round-trips, denied reservations) are exactly what the tunnel exercises
+    # for free — one JSON line, same contract as bench.py
+    CHAOS_SF=1 CHAOS_QUERIES=q1,q3 CHAOS_BUDGET=600 \
+      TRINO_TPU_PAGE_CACHE=1073741824 \
+      timeout -k 60 900 python scripts/chaos.py \
+      > scripts/chaos_r10.json 2> scripts/chaos_r10.log
+    rc=$?
+    echo "$(date -Is) chaos rc=$rc : $(tail -c 300 scripts/chaos_r10.json)" >> "$LOG"
     rm -f scripts/tpu_cluster_probe.json  # never embed a stale probe artifact
     timeout -k 30 900 python scripts/tpu_cluster_probe.py \
       > scripts/tpu_cluster_probe.out 2>&1
@@ -106,6 +115,10 @@ try:
     out["cluster_tpu_probe"] = json.load(open("scripts/tpu_cluster_probe.json"))
 except Exception as e:
     out["cluster_tpu_probe"] = {"error": str(e)}
+try:
+    out["chaos"] = json.load(open("scripts/chaos_r10.json"))
+except Exception as e:
+    out["chaos"] = {"error": str(e)}
 json.dump(out, open("BENCH_local_r09.json", "w"), indent=1)
 PY
     echo "$(date -Is) wrote BENCH_local_r09.json" >> "$LOG"

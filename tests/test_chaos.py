@@ -1,0 +1,560 @@
+"""Chaos suite: deterministic fault injection at every chokepoint, and the
+clean-failure contract it enforces.
+
+Rounds 6-9 built retries, speculation, stream replay, Grace fallbacks, a
+buffer pool and prefetch producer threads — none of which ever ran under an
+injected failure.  This matrix (execution/faults.py is the injector; rules
+arm through the ``faults.injected(...)`` context manager, never by
+monkeypatching internals — the DISPATCH_TEST_HOOK precedent) pins the
+contract the next arc (SPMD exchange, SF100) builds on:
+
+- a RECOVERABLE fault (cache denial, reservation denial, guarded store
+  failure, dispatch delay) yields results BYTE-IDENTICAL to the fault-free
+  run;
+- a NON-RECOVERABLE fault (dispatch/generate/pull/h2d errors on a local
+  query) yields a clean TYPED error (InjectedFaultError /
+  FatalInjectedFaultError), never a hang or a corrupt result;
+- after EVERY scenario the engine is clean: zero residual in-flight registry
+  entries, no surviving prefetch-producer thread, no executor holding a live
+  producer registration, buffer-pool reservations exactly equal to its
+  resident bytes (no orphaned reservation, no partial page), and a
+  subsequent fault-free run still byte-identical (no truncated cache entry
+  served).
+
+Tier-1 (``-m 'not slow'``) runs the q1/q3 local matrix plus the injector,
+backoff and regression tests; the q9/q18 matrix and the distributed matrix
+(worker faults, worker crash, dropped exchange commits, retry-budget
+exhaustion over an in-process cluster) are ``slow``.
+
+The scenario table, result signature and leak-report semantics are shared
+with the standalone capture harness (scripts/chaos.py) through
+execution/chaos_matrix.py — edit the matrix THERE so the test contract and
+the on-device artifact cannot drift apart.
+"""
+
+import time
+
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.execution import faults
+from trino_tpu.execution.chaos_matrix import (FAILING, QUERIES, RECOVERABLE,
+                                              leak_report)
+from trino_tpu.execution.chaos_matrix import result_signature as _sig
+from trino_tpu.execution.chaos_matrix import settle as _settle
+from trino_tpu.execution.faults import (FatalInjectedFaultError, FaultPlan,
+                                        InjectedFaultError)
+
+FAST_QUERIES = ("q1", "q3")
+SLOW_QUERIES = ("q9", "q18")
+
+
+def _leak_check(engine):
+    """The post-scenario contract: nothing survives the query."""
+    leftovers = leak_report(engine)
+    assert not leftovers, f"post-scenario leaks: {leftovers}"
+
+
+@pytest.fixture(scope="module")
+def sf1():
+    import os
+
+    prev = os.environ.get("TRINO_TPU_PAGE_CACHE")
+    os.environ["TRINO_TPU_PAGE_CACHE"] = str(6 * 1024 * 1024 * 1024)
+    engine = Engine()
+    engine.register_catalog("tpch", TpchConnector(sf=1, split_rows=1 << 21))
+    session = engine.create_session("tpch")
+    nocache = engine.create_session("tpch")
+    engine.session_properties.set_property(nocache, "page_cache", False)
+    state = {"baselines": {}}
+    yield engine, session, nocache, state
+    engine._invalidate()
+    if prev is None:
+        os.environ.pop("TRINO_TPU_PAGE_CACHE", None)
+    else:
+        os.environ["TRINO_TPU_PAGE_CACHE"] = prev
+
+
+def _baseline(sf1_tuple, name):
+    engine, session, _nocache, state = sf1_tuple
+    if name not in state["baselines"]:
+        engine.execute_sql(QUERIES[name], session)  # cold: plan + compile
+        state["baselines"][name] = \
+            _sig(engine.execute_sql(QUERIES[name], session))
+    return state["baselines"][name]
+
+
+def _run_recoverable(sf1_tuple, name, scenario):
+    engine, session, _nocache, _state = sf1_tuple
+    spec, clear_pool = RECOVERABLE[scenario]
+    base = _baseline(sf1_tuple, name)
+    if clear_pool:
+        engine.buffer_pool.clear()  # force the run to regenerate AND store
+    with faults.injected(spec) as plan:
+        got = _sig(engine.execute_sql(QUERIES[name], session))
+    assert plan.total_fires() >= 1, f"scenario never fired: {plan.stats()}"
+    assert got == base, f"{name} under {spec}: result diverged"
+    _leak_check(engine)
+    # and the engine is still healthy fault-free
+    assert _sig(engine.execute_sql(QUERIES[name], session)) == base
+
+
+def _run_failing(sf1_tuple, name, spec, cache_on):
+    engine, session, nocache, _state = sf1_tuple
+    base = _baseline(sf1_tuple, name)
+    sess = session if cache_on else nocache
+    with faults.injected(spec) as plan:
+        with pytest.raises(InjectedFaultError):
+            engine.execute_sql(QUERIES[name], sess)
+    assert plan.total_fires() >= 1, f"scenario never fired: {plan.stats()}"
+    _leak_check(engine)
+    # no partial page was cached, no state corrupted: the fault-free rerun
+    # regenerates and matches the baseline byte for byte
+    assert _sig(engine.execute_sql(QUERIES[name], session)) == base
+    _leak_check(engine)
+
+
+# ------------------------------------------------------------ local matrix
+@pytest.mark.parametrize("name", FAST_QUERIES)
+@pytest.mark.parametrize("scenario", sorted(RECOVERABLE))
+def test_recoverable_fault_is_invisible(sf1, name, scenario):
+    _run_recoverable(sf1, name, scenario)
+
+
+@pytest.mark.parametrize("name", FAST_QUERIES)
+@pytest.mark.parametrize("scenario", sorted(FAILING))
+def test_unrecoverable_fault_fails_clean(sf1, name, scenario):
+    spec, cache_on = FAILING[scenario]
+    _run_failing(sf1, name, spec, cache_on)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", SLOW_QUERIES)
+@pytest.mark.parametrize("scenario", sorted(RECOVERABLE))
+def test_recoverable_fault_is_invisible_slow(sf1, name, scenario):
+    _run_recoverable(sf1, name, scenario)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", SLOW_QUERIES)
+@pytest.mark.parametrize("scenario", sorted(FAILING))
+def test_unrecoverable_fault_fails_clean_slow(sf1, name, scenario):
+    spec, cache_on = FAILING[scenario]
+    _run_failing(sf1, name, spec, cache_on)
+
+
+def test_repeated_faulted_runs_hold_reservations_steady(sf1):
+    """Leaked reservations compound: run the same faulted scenario twice and
+    assert no labeled pool's reservation grew between the runs (compiled-
+    artifact reservations from the FIRST run may legitimately persist for
+    the plan-cache lifetime; growth across identical runs is the leak)."""
+    engine, session, _nocache, _state = sf1
+    _baseline(sf1, "q1")
+
+    def faulted_run():
+        with faults.injected("point=dispatch,action=error,nth=3"):
+            with pytest.raises(InjectedFaultError):
+                engine.execute_sql(QUERIES["q1"], session)
+        _settle()
+
+    faulted_run()
+    first = {d["pool"]: d["reserved"] for d in engine.memory_info()}
+    faulted_run()
+    second = {d["pool"]: d["reserved"] for d in engine.memory_info()}
+    assert second == first, (first, second)
+    _leak_check(engine)
+
+
+def test_faults_are_counted_and_explained(sf1):
+    """Observability satellite: faults_injected reaches the per-query
+    counters and EXPLAIN ANALYZE's Device boundary line names them, so a
+    chaos run is self-describing."""
+    engine, session, _nocache, _state = sf1
+    _baseline(sf1, "q1")
+    with faults.injected("point=dispatch,action=delay,s=0,every=1"):
+        r = engine.execute_sql(f"explain analyze {QUERIES['q1']}", session)
+    text = "\n".join(str(row[0]) for row in r.rows())
+    c = engine.last_query_counters
+    assert c.faults_injected > 0
+    assert f"{c.faults_injected} faults injected" in text, text
+    # disarmed queries keep the pristine line (budget-suite regexes etc.)
+    r = engine.execute_sql(f"explain analyze {QUERIES['q1']}", session)
+    text = "\n".join(str(row[0]) for row in r.rows())
+    assert "faults injected" not in text
+    assert engine.last_query_counters.faults_injected == 0
+
+
+# -------------------------------------------- prefetch-producer regression
+def test_mid_scan_fault_kills_prefetch_producer():
+    """Satellite regression: a dispatch fault raised mid-scan (while the
+    prefetch producer is pumping ahead of the consumer) must kill the
+    producer thread and clear its in-flight state even though the exception
+    traceback pins the consumer generators alive (pytest.raises holds it).
+    Before close_producers() this thread survived, pumping against a full
+    queue, until the traceback was released.  The query must take the
+    GROUPED aggregation path: its page iterator is a NAMED local
+    (page_iter/pages_once in _run_aggregate), which traceback frames pin —
+    a plain ``for page in gen():`` iterator lives on the value stack, which
+    CPython already clears during unwind (verified: the pre-fix leak
+    reproduces with this query and not with a global aggregate)."""
+    engine = Engine()
+    # many small splits so the producer is genuinely ahead when the consumer
+    # faults; page cache off so the scan actually streams
+    engine.register_catalog("tpch",
+                            TpchConnector(sf=0.1, split_rows=1 << 14))
+    session = engine.create_session("tpch")
+    engine.session_properties.set_property(session, "page_cache", False)
+    q = ("select l_returnflag, sum(l_quantity) from lineitem "
+         "group by l_returnflag")
+    engine.execute_sql(q, session)  # warm: compile outside the scenario
+    assert not _settle()
+    with faults.injected("point=dispatch,action=error,nth=3") as plan:
+        with pytest.raises(InjectedFaultError):
+            engine.execute_sql(q, session)
+    assert plan.total_fires() == 1
+    leftovers = _settle(timeout=4.0)
+    assert not leftovers, f"producer survived the faulted query: {leftovers}"
+    for ex in engine._all_executors:
+        assert not ex._producers
+    engine._invalidate()
+
+
+def test_generate_fault_on_producer_thread_fails_clean():
+    """A generation fault raised ON the producer thread surfaces at the
+    consume site as the typed error, the producer dies with it, and a
+    subsequent clean run regenerates correctly (no partial page cached)."""
+    import os
+
+    prev = os.environ.get("TRINO_TPU_PAGE_CACHE")
+    os.environ["TRINO_TPU_PAGE_CACHE"] = str(1 << 30)
+    try:
+        engine = Engine()
+        engine.register_catalog(
+            "tpch", TpchConnector(sf=0.05, split_rows=1 << 13))
+        session = engine.create_session("tpch")
+        q = "select count(*), sum(l_quantity) from lineitem"
+        base = _sig(engine.execute_sql(q, session))
+        engine._invalidate()  # drop the cached scan: force regeneration
+        # nth=4 lands past the 2-page synchronous warmup — producer thread
+        with faults.injected("point=generate,action=error,nth=4") as plan:
+            with pytest.raises(InjectedFaultError):
+                engine.execute_sql(q, session)
+        assert plan.total_fires() == 1
+        # the firing happened ON the producer thread: the counters handoff
+        # must still charge it to the query, or chaos runs over the default
+        # prefetch path would read 0 faults_injected
+        assert engine.last_query_counters.faults_injected == 1
+        assert not _settle()
+        # the errored scan must NOT have been admitted: the rerun generates
+        # and matches (a truncated cached page would change the aggregates)
+        assert _sig(engine.execute_sql(q, session)) == base
+        info = engine.buffer_pool.info()
+        if engine.buffer_pool.memory_pool is not None:
+            assert engine.buffer_pool.memory_pool.reserved == info["bytes"]
+        engine._invalidate()
+    finally:
+        if prev is None:
+            os.environ.pop("TRINO_TPU_PAGE_CACHE", None)
+        else:
+            os.environ["TRINO_TPU_PAGE_CACHE"] = prev
+
+
+# ------------------------------------------------------------ injector unit
+def test_fault_plan_triggers_are_deterministic():
+    p = FaultPlan.parse("point=dispatch,nth=2,action=error")
+    assert p.fire("dispatch", "x", None) is None
+    with pytest.raises(InjectedFaultError):
+        p.fire("dispatch", "x", None)
+    assert p.fire("dispatch", "x", None) is None  # nth implies times=1
+
+    p = FaultPlan.parse("point=reserve,action=deny,every=3")
+    fires = [p.fire("reserve", "t", None) for _ in range(9)]
+    assert fires == [None, None, "deny"] * 3
+
+    a = FaultPlan.parse("point=task,action=drop,p=0.3,seed=11,times=1000")
+    b = FaultPlan.parse("point=task,action=drop,p=0.3,seed=11,times=1000")
+    seq = [a.fire("task", "s", None) for _ in range(50)]
+    assert seq == [b.fire("task", "s", None) for _ in range(50)]
+    assert 0 < seq.count("drop") < 50  # actually probabilistic, not const
+
+    # site and query globs gate matching
+    p = FaultPlan.parse("point=dispatch,site=Agg*,action=error,query=q7")
+    assert p.fire("dispatch", "Join#0/probe", "q7") is None
+    assert p.fire("dispatch", "Aggregate#1/step", "q8") is None
+    with pytest.raises(InjectedFaultError):
+        p.fire("dispatch", "Aggregate#1/step", "q7")
+
+
+def test_fault_plan_parse_rejects_garbage():
+    for bad in ("", "action=error", "point=nope", "point=dispatch,wat=1",
+                "point=dispatch,action=explode"):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+
+def test_fatal_fault_is_classified_deterministic():
+    from trino_tpu.exec.fte import is_retryable_failure
+
+    assert is_retryable_failure(InjectedFaultError("x"))
+    assert not is_retryable_failure(FatalInjectedFaultError("x"))
+
+
+def test_disarmed_injector_is_inert():
+    assert faults.active() is None
+    assert faults.maybe_inject("dispatch", "anything") is None
+
+
+def test_fte_dropped_commit_is_retried(tmp_path):
+    """A LOST exchange commit (chaos ``exchange_write`` drop) on the local
+    FTE path must be detected by the retry loop — is_committed after commit —
+    recomputed and recommitted, never returned as success for output that
+    never became visible (the reader would hit a missing spool file)."""
+    from trino_tpu.exec.fte import FailureInjector, FaultTolerantExecutor
+    from trino_tpu.sql.frontend import compile_sql
+
+    engine = Engine()
+    engine.register_catalog("tpch",
+                            TpchConnector(sf=0.01, split_rows=1 << 11))
+    session = engine.create_session("tpch")
+    q = ("select l_returnflag, sum(l_quantity) q from lineitem "
+         "group by l_returnflag order by l_returnflag")
+    plan = compile_sql(q, engine, session)
+    expected = engine.execute_sql(q, session).rows()
+    ex = FaultTolerantExecutor(engine.catalogs, str(tmp_path / "spool"),
+                               injector=FailureInjector())
+    with faults.injected("point=exchange_write,action=drop,nth=1") as plan_f:
+        got = ex.execute(plan).rows()
+    assert plan_f.total_fires() == 1, plan_f.stats()
+    assert got == expected
+    assert max(ex.task_attempts.values()) >= 2, ex.task_attempts
+    _settle()
+
+
+# ------------------------------------------------------------- backoff unit
+def test_backoff_spacing_grows_and_is_deterministic():
+    from trino_tpu.server.cluster import _backoff_s
+
+    a = [_backoff_s("t42", k, base=0.1, cap=60.0) for k in range(1, 8)]
+    assert a == sorted(a) and a[0] < a[-1]  # grows
+    assert a == [_backoff_s("t42", k, base=0.1, cap=60.0)
+                 for k in range(1, 8)]  # deterministic
+    # jitter separates keys without breaking growth
+    b = [_backoff_s("t43", k, base=0.1, cap=60.0) for k in range(1, 8)]
+    assert a != b
+    # cap holds
+    assert _backoff_s("t42", 30, base=0.1, cap=2.5) == 2.5
+    # unbounded attempts (heartbeat misses of a never-returning worker) must
+    # not overflow float — pre-clamp this raised OverflowError at ~1025,
+    # killing the heartbeat daemon thread
+    assert _backoff_s("t42", 5000, base=0.25, cap=5.0) == 5.0
+
+
+def test_operator_targeted_site_glob_fires():
+    """The documented addressing contract: a rule's site glob matches the
+    composed "<Op>#<k>/<site>" label (operator targeting, the module
+    docstring's own example) AND the bare chokepoint tag.  Regression: the
+    chokepoints used to pass only the bare tag, so ``site=Aggregate*``
+    silently matched nothing and a chaos run passed vacuously."""
+    engine = Engine()
+    engine.register_catalog("tpch",
+                            TpchConnector(sf=0.01, split_rows=1 << 11))
+    session = engine.create_session("tpch")
+    sql = "select l_returnflag, count(*) c from lineitem group by l_returnflag"
+    expected = engine.execute_sql(sql, session).rows()
+    for glob in ("Aggregate*",        # operator-composed label
+                 "agg.*"):            # bare site tag
+        with faults.injected(
+                f"point=dispatch,site={glob},action=delay,s=0,every=1"
+        ) as plan:
+            got = engine.execute_sql(sql, session).rows()
+        assert plan.total_fires() >= 1, \
+            f"site={glob} matched no dispatch: {plan.stats()}"
+        assert got == expected
+    _leak_check(engine)
+    engine._invalidate()
+
+
+def test_reannounce_resets_heartbeat_probe_backoff(tmp_path):
+    """A worker that re-announces after a probe-failure streak must be
+    probe-able immediately: stale ``next_probe`` backoff otherwise blinds the
+    failure detector to a second death for the rest of the window."""
+    from trino_tpu.server.cluster import ClusterCoordinator
+
+    coord = ClusterCoordinator(Engine(), str(tmp_path / "spool"))
+    coord._announce("w0", "http://127.0.0.1:1")
+    w = coord.workers["w0"]
+    w.alive, w.misses, w.next_probe = False, 3, time.time() + 999.0
+    coord._announce("w0", "http://127.0.0.1:1")
+    assert w.alive and w.misses == 0
+    assert w.next_probe == 0.0
+
+
+def test_metrics_export_fault_and_retry_counters():
+    from trino_tpu.server.server import CoordinatorServer
+
+    engine = Engine()
+    engine.register_catalog("tpch",
+                            TpchConnector(sf=0.01, split_rows=1 << 11))
+    session = engine.create_session("tpch")
+    with faults.injected("point=dispatch,action=delay,s=0,every=1"):
+        engine.execute_sql("select count(*) from nation", session)
+    assert engine.counters_total.faults_injected > 0
+    text = CoordinatorServer(engine)._metrics_text()
+    assert "# TYPE trino_tpu_faults_injected_total counter" in text
+    assert "# TYPE trino_tpu_task_retries_total counter" in text
+    import re
+
+    m = re.search(r"^trino_tpu_faults_injected_total (\d+)$", text, re.M)
+    assert m and int(m.group(1)) > 0, text
+    engine._invalidate()
+
+
+# -------------------------------------------------------- distributed matrix
+CATALOGS = {"tpch": {"connector": "tpch", "sf": 0.01, "split_rows": 1 << 11}}
+
+
+def _cluster(tmp_path, n_workers=2, **coord_kw):
+    from trino_tpu.server.cluster import ClusterCoordinator, WorkerServer
+
+    engine = Engine()
+    engine.register_catalog("tpch",
+                            TpchConnector(sf=0.01, split_rows=1 << 11))
+    kw = dict(heartbeat_interval=0.2, retry_backoff_s=0.05,
+              retry_backoff_cap_s=1.0)
+    kw.update(coord_kw)
+    coord = ClusterCoordinator(engine, str(tmp_path / "spool"), **kw)
+    url = coord.start()
+    workers = []
+    for i in range(n_workers):
+        w = WorkerServer(CATALOGS, str(tmp_path / "spool"),
+                         coordinator_url=url, node_id=f"w{i}")
+        w.start()
+        workers.append(w)
+    coord.wait_for_workers(n_workers, timeout=60)
+    return engine, coord, workers
+
+
+def _stop_cluster(coord, workers):
+    for w in workers:
+        try:
+            w.stop()
+        except Exception:
+            pass
+    coord.stop()
+
+
+@pytest.mark.slow
+def test_distributed_q9_retries_injected_task_fault(tmp_path):
+    """A retryable worker-task fault burns one attempt; the coordinator
+    re-dispatches on the backoff curve and the distributed q9 still matches
+    local execution byte for byte.  task_retries reaches the merged query
+    counters and the retry schedule records the backoff."""
+    engine, coord, workers = _cluster(tmp_path)
+    try:
+        expected = engine.execute_sql(QUERIES["q9"]).rows()
+        with faults.injected("point=task,action=error,nth=1") as plan:
+            got = coord.execute_sql(QUERIES["q9"]).rows()
+        assert got == expected
+        assert plan.total_fires() == 1
+        assert coord.local_fallbacks == 0, coord.last_fallback_error
+        assert coord.last_query_counters.task_retries >= 1
+        assert coord.last_retry_schedule, "no backoff was scheduled"
+        _leak_check(engine)
+    finally:
+        _stop_cluster(coord, workers)
+
+
+@pytest.mark.slow
+def test_distributed_worker_crash_mid_query_recovers(tmp_path):
+    """kill_worker: one worker's HTTP plane dies mid-task (a crashed node,
+    not a drained one).  The failure detector gates it out on its backoff
+    schedule, the task re-dispatches to the survivor, and the query answer
+    is unchanged."""
+    engine, coord, workers = _cluster(tmp_path, task_timeout=8.0)
+    try:
+        expected = engine.execute_sql(QUERIES["q1"]).rows()
+        with faults.injected("point=task,action=kill_worker,nth=1"):
+            got = coord.execute_sql(QUERIES["q1"]).rows()
+        assert got == expected
+        # exactly one worker crashed; the detector notices within its window
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if sum(1 for w in coord.workers.values() if not w.alive) >= 1:
+                break
+            time.sleep(0.1)
+        assert sum(1 for w in coord.workers.values() if not w.alive) == 1
+        _leak_check(engine)
+    finally:
+        _stop_cluster(coord, workers)
+
+
+@pytest.mark.slow
+def test_distributed_dropped_commit_redispatches(tmp_path):
+    """exchange_write drop: a worker task completes but its spool commit is
+    silently lost.  The coordinator's deadline expires, the task burns an
+    attempt (with backoff) and the re-dispatch commits — the result is
+    unchanged and the retry is visible in the counters.  task_timeout must
+    clear the workers' cold fragment compiles (tasks REFUSED past the
+    timeout also burn attempts), and the retry budget is opened up so
+    compile-time refusals cannot exhaust it before the dropped commit's
+    deadline fires."""
+    engine, coord, workers = _cluster(tmp_path, task_timeout=25.0,
+                                      max_query_retries=1000)
+    try:
+        expected = engine.execute_sql(QUERIES["q1"]).rows()
+        with faults.injected(
+                "point=exchange_write,action=drop,nth=1") as plan:
+            got = coord.execute_sql(QUERIES["q1"]).rows()
+        assert got == expected
+        assert plan.total_fires() == 1
+        assert coord.local_fallbacks == 0, coord.last_fallback_error
+        assert coord.last_query_counters.task_retries >= 1
+        _leak_check(engine)
+    finally:
+        _stop_cluster(coord, workers)
+
+
+@pytest.mark.slow
+def test_distributed_redispatch_spacing_grows(tmp_path):
+    """Acceptance: re-dispatch attempt spacing GROWS.  Task t0 fails twice
+    (site-targeted injection), succeeds on the third attempt; the recorded
+    backoff schedule shows attempt 2's delay strictly above attempt 1's and
+    the query result is unchanged."""
+    engine, coord, workers = _cluster(tmp_path, max_attempts=10)
+    try:
+        expected = engine.execute_sql(QUERIES["q1"]).rows()
+        with faults.injected(
+                "point=task,site=*.t0,action=error,every=1,times=2") as plan:
+            got = coord.execute_sql(QUERIES["q1"]).rows()
+        assert got == expected
+        assert plan.total_fires() == 2
+        t0 = sorted((a, d) for tid, a, d in coord.last_retry_schedule
+                    if tid == "t0")
+        assert len(t0) >= 2, coord.last_retry_schedule
+        assert t0[1][1] > t0[0][1], t0  # spacing grew
+        _leak_check(engine)
+    finally:
+        _stop_cluster(coord, workers)
+
+
+@pytest.mark.slow
+def test_distributed_retry_budget_is_enforced(tmp_path):
+    """Acceptance: the per-query retry budget is enforced — a permanently
+    failing task set stops retrying at max_query_retries with the budget
+    named in the error (the coordinator then degrades to local execution,
+    its designed last resort, so the query still answers)."""
+    engine, coord, workers = _cluster(tmp_path, max_attempts=10,
+                                      max_query_retries=3)
+    try:
+        expected = engine.execute_sql(QUERIES["q1"]).rows()
+        with faults.injected("point=task,action=error,every=1,times=1000"):
+            got = coord.execute_sql(QUERIES["q1"]).rows()
+        assert got == expected  # local degrade answered
+        assert coord.local_fallbacks == 1
+        assert "retry budget exhausted" in (coord.last_fallback_error or "")
+        assert "max_query_retries=3" in coord.last_fallback_error
+        assert len(coord.last_retry_schedule) <= 3
+        _leak_check(engine)
+    finally:
+        _stop_cluster(coord, workers)

@@ -475,9 +475,14 @@ class DistributedExecutor:
         # silent local fallback); EXPLAIN ANALYZE prints it
         self._decline_reason = None
         self.counters.reset()
-        with tracing.track_counters(self.counters):
-            page, dicts = self._execute_to_page(node)
-            return _materialize(page, dicts)
+        try:
+            with tracing.track_counters(self.counters):
+                page, dicts = self._execute_to_page(node)
+                return _materialize(page, dicts)
+        finally:
+            # blocking sub-plans run on the embedded LocalExecutor, which may
+            # start prefetch producers: stop them on error paths too
+            self.local.close_producers()
 
     def _decline(self, node, reason: str):
         """Record why a fragment cannot compile for the mesh (deepest cause

@@ -32,7 +32,7 @@ from typing import Optional
 import jax.numpy as jnp
 import numpy as np
 
-from ..execution import tracing
+from ..execution import faults, tracing
 from ..ops import hashagg
 from ..page import Page, Schema
 from ..sql import plan as P
@@ -220,9 +220,12 @@ def is_retryable_failure(e: BaseException) -> bool:
     from ..sql.frontend import SemanticError
     from ..sql.parser import ParseError
 
+    from ..execution.faults import FatalInjectedFaultError
+
     deterministic = (SemanticError, ParseError, AccessDeniedError,
                      NotImplementedError, AssertionError, AttributeError,
-                     NameError, QueryKilledError, QueryMemoryLimitError)
+                     NameError, QueryKilledError, QueryMemoryLimitError,
+                     FatalInjectedFaultError)
     return isinstance(e, Exception) and not isinstance(e, deterministic)
 
 
@@ -258,8 +261,18 @@ class SpoolingExchange:
         return os.path.join(self.directory, f"task_{task_id}.page")
 
     def commit(self, task_id, attempt: int, data: bytes) -> bool:
-        """Returns False when an earlier attempt already committed."""
+        """Returns False when an earlier attempt already committed.  Chaos:
+        ``exchange_write`` faults land here — ``drop`` silently loses the
+        commit (the output never becomes visible, so the coordinator's
+        deadline/re-dispatch path must recover it), raises surface as a
+        retryable task failure."""
         if os.path.exists(self._final(task_id)):
+            return False
+        # inject only past the already-committed early-exit: a fire must mean
+        # a real store was attempted (same rule as DeviceBufferPool.put_page),
+        # or a speculative/retried duplicate commit burns the rule's budget
+        if faults.maybe_inject("exchange_write",
+                               f"task.{task_id}") == "drop":
             return False
         tmp = os.path.join(self.directory,
                            f".task_{task_id}.attempt_{attempt}.{random.random():.9f}")
@@ -276,6 +289,7 @@ class SpoolingExchange:
         return os.path.exists(self._final(task_id))
 
     def read(self, task_id) -> bytes:
+        faults.maybe_inject("exchange_read", f"task.{task_id}")
         with open(self._final(task_id), "rb") as f:
             return f.read()
 
@@ -340,6 +354,10 @@ class FaultTolerantExecutor:
                 return _materialize(page, dd)
             finally:
                 self.local._overrides = {}
+                # error or clean exit: no prefetch producer thread survives
+                # the query (FTE drives _execute_to_page directly, so the
+                # local executor's own execute()-time sweep never runs)
+                self.local.close_producers()
                 # fragment pages were deserialized into memory above; the
                 # spool is query-scoped durable state, not a cache — a
                 # long-lived server must not grow temp disk per query
@@ -469,14 +487,25 @@ class FaultTolerantExecutor:
         ``compute`` returns bytes or (bytes, side_payload); the side payload of
         the successful attempt is returned (None when an earlier attempt's
         commit made this one redundant)."""
+        from ..execution import tracing as _tracing
+
         last_error = None
         extra = None
         for attempt in range(self.max_attempts):
             self.task_attempts[task_id] = attempt + 1
+            if attempt:  # observability: retries charge the paying query
+                _tracing.record_task_retry(site="fte.task.retry")
             try:
                 out = compute()
                 data, extra = out if isinstance(out, tuple) else (out, None)
                 exchange.commit(task_id, attempt, data)
+                if not exchange.is_committed(task_id):
+                    # the commit was LOST (chaos exchange_write drop, torn
+                    # write): returning success here would hand the reader a
+                    # missing file later — recompute and recommit instead
+                    raise RuntimeError(
+                        f"task {task_id} output commit did not become "
+                        f"visible (attempt {attempt + 1})")
                 # a post-commit failure must not duplicate output on retry
                 self.injector.maybe_fail(task_id, "POST_COMMIT_FAILURE")
                 return extra

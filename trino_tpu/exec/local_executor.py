@@ -16,6 +16,7 @@ between sources and sinks is streaming.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 from functools import partial
@@ -26,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..connectors.tpch import Dictionary
-from ..execution import tracing
+from ..execution import faults, tracing
 from ..ops import hashagg
 from ..ops.hashing import ceil_pow2
 from ..ops.hashjoin import (DIRECT_JOIN_RANGE_MAX, DirectJoinTable,
@@ -70,6 +71,10 @@ def _jit(fn, site=None, **kwargs):
         try:
             if tracing.DISPATCH_TEST_HOOK is not None:
                 tracing.DISPATCH_TEST_HOOK(label)
+            # chaos chokepoint: an armed FaultPlan can raise/delay HERE, so
+            # every dispatch in the engine is injectable (disarmed = one
+            # global None test, nothing on the budget counters)
+            faults.maybe_inject("dispatch", label)
             return compiled(*args, **kw)
         finally:
             reg.exit(tok)
@@ -128,39 +133,49 @@ def _coalesced_batches(pages_iter, batch: int):
     degrades to singleton groups — byte-identical to un-batched iteration.
     Groups record their REAL split count on the query counters (EXPLAIN
     ANALYZE's "splits coalesced")."""
-    if batch <= 1:
+    # closing THIS generator closes its source too (the finally below):
+    # consumer loops that unwind on an exception propagate the close down to
+    # the prefetch wrapper, whose own finally stops the producer thread —
+    # without it, the traceback pins the loop frame and the producer would
+    # sit pumping against a full queue until the traceback is released
+    try:
+        if batch <= 1:
+            for pg in pages_iter:
+                yield [pg], None
+            return
+        buf: list = []
+        sig = None
+
+        def flush():
+            while buf:
+                group, buf[:] = buf[:batch], buf[batch:]
+                if len(group) == 1:
+                    yield group, None
+                    continue
+                tracing.record_coalesced(len(group))
+                live = np.arange(batch) < len(group)
+                while len(group) < batch:  # pad: repeated page, live=False
+                    group.append(group[-1])
+                yield group, live
+
         for pg in pages_iter:
-            yield [pg], None
-        return
-    buf: list = []
-    sig = None
-
-    def flush():
-        while buf:
-            group, buf[:] = buf[:batch], buf[batch:]
-            if len(group) == 1:
-                yield group, None
+            s = _page_batch_sig(pg)
+            if s is None:
+                yield from flush()
+                sig = None
+                yield [pg], None
                 continue
-            tracing.record_coalesced(len(group))
-            live = np.arange(batch) < len(group)
-            while len(group) < batch:  # pad: repeated page, live=False
-                group.append(group[-1])
-            yield group, live
-
-    for pg in pages_iter:
-        s = _page_batch_sig(pg)
-        if s is None:
-            yield from flush()
-            sig = None
-            yield [pg], None
-            continue
-        if sig is not None and s != sig:
-            yield from flush()
-        sig = s
-        buf.append(pg)
-        if len(buf) >= batch:
-            yield from flush()
-    yield from flush()
+            if sig is not None and s != sig:
+                yield from flush()
+            sig = s
+            buf.append(pg)
+            if len(buf) >= batch:
+                yield from flush()
+        yield from flush()
+    finally:
+        close = getattr(pages_iter, "close", None)
+        if close is not None:
+            close()
 
 
 def _stack_pages(pages, live=None):
@@ -382,6 +397,11 @@ class LocalExecutor:
         # switch to partitioned (Grace) strategies when the pool says no
         # (reference: MemoryPool + MemoryRevokingScheduler -> spill)
         self.memory_pool = memory_pool if memory_pool is not None else MemoryPool()
+        # live prefetch producers started for the CURRENT query: (stop flag,
+        # thread) pairs registered by _prefetched_pages.  close_producers()
+        # stops them on every exit path — clean or error — so a mid-query
+        # exception can never strand a producer thread behind its traceback
+        self._producers: list = []
 
     def _batch(self) -> int:
         """Effective dispatch-coalescing width (>=1; 1 = per-split)."""
@@ -398,10 +418,10 @@ class LocalExecutor:
         device generators get the coalescing double buffer when multi-split
         and coalescing is on."""
         if conn is not None and getattr(conn, "HOST_DECODE", False):
-            return _prefetched_pages(pages_fn, to_device=True)
+            return _prefetched_pages(pages_fn, to_device=True, owner=self)
         if n_splits > 1 and self._batch() > 1:
             return _prefetched_pages(pages_fn, depth=self._batch(),
-                                     to_device=True, warmup=2)
+                                     to_device=True, warmup=2, owner=self)
         return pages_fn
 
     def _page_cache_on(self) -> bool:
@@ -439,8 +459,12 @@ class LocalExecutor:
         splits = list(splits)
         scan_cols = tuple(scan_cols)
 
-        def raw(conn=conn, splits=splits, scan_cols=scan_cols):
+        def raw(conn=conn, splits=splits, scan_cols=scan_cols, table=table):
             for s in splits:
+                # chaos chokepoint: per-split generation faults surface here —
+                # on the PREFETCH PRODUCER thread when the scan is wrapped,
+                # which is exactly the path whose cleanup the chaos suite pins
+                faults.maybe_inject("generate", f"scan.{table}")
                 yield conn.generate(s, list(scan_cols))
 
         wrapped = self._rewrap_pruned_pages(raw, conn, len(splits))
@@ -479,10 +503,19 @@ class LocalExecutor:
             if acc:
                 # the store's staging can wedge like any other device work:
                 # hold an in-flight registry entry so the stall watchdog sees
-                # a hang here instead of an idle-looking query
-                with tracing.inflight("cache-store",
-                                      site=f"scan.{table}.store"):
-                    bp.put_page(key, _stage_scan_entry(acc))
+                # a hang here instead of an idle-looking query.  A store
+                # FAILURE (injected fault, staging error) must not fail a
+                # query whose scan already completed — and it must never
+                # leave a partial entry behind, so the store is all-or-
+                # nothing: put_page admits only the fully staged page
+                try:
+                    with tracing.inflight("cache-store",
+                                          site=f"scan.{table}.store"):
+                        bp.put_page(key, _stage_scan_entry(acc))
+                except tracing.StallKilledError:
+                    raise  # a watchdog kill must never be neutralized here
+                except Exception:
+                    pass  # uncached, not failed; the next query regenerates
 
         return pages
 
@@ -514,20 +547,48 @@ class LocalExecutor:
             for key in [k for k in list(cache) if dead(k)]:
                 cache.pop(key, None)
 
+    def close_producers(self, join_timeout: float = 2.0) -> int:
+        """Stop every prefetch producer this executor started for the current
+        query: set each stop flag, then briefly join the threads.  Called on
+        every execute() exit (and by the FTE/cluster drivers that call
+        _execute_to_page directly) — on the clean path the producers have
+        already exited and this is a no-op sweep; on an error path it is what
+        guarantees no producer thread survives the query.  Returns how many
+        producers were registered (the chaos suite asserts on thread death
+        separately)."""
+        import time as _time
+
+        procs, self._producers = self._producers, []
+        for stop, _t in procs:
+            stop.set()
+        deadline = _time.monotonic() + join_timeout
+        for _stop, t in procs:
+            if t.is_alive():
+                t.join(timeout=max(deadline - _time.monotonic(), 0.05))
+        return len(procs)
+
     # ------------------------------------------------------------------ public
     def execute(self, node: P.PlanNode) -> MaterializedResult:
         self.stats = {}
         self.boundary = {}
         self._op_labels = {}
         self.counters.reset()
-        with tracing.track_counters(self.counters):
-            page, dicts = self._execute_to_page(node)
-            # the result pull is real boundary spend outside any plan node:
-            # attribute it to a synthetic "Result" operator so the per-op sums
-            # still equal the query totals
-            with tracing.operator_scope("Result",
-                                        self._boundary_sink("result", "Result")):
-                return _materialize(page, dicts)
+        # sweep, don't discard: a producer somehow still registered (a driver
+        # path without the finally, an async kill mid-registration) must get
+        # its stop flag set, not be dropped to pump forever unseen
+        self.close_producers()
+        try:
+            with tracing.track_counters(self.counters):
+                page, dicts = self._execute_to_page(node)
+                # the result pull is real boundary spend outside any plan
+                # node: attribute it to a synthetic "Result" operator so the
+                # per-op sums still equal the query totals
+                with tracing.operator_scope(
+                        "Result", self._boundary_sink("result", "Result")):
+                    return _materialize(page, dicts)
+        finally:
+            # clean or error exit: no prefetch producer outlives the query
+            self.close_producers()
 
     def _op_label(self, node) -> str:
         lbl = self._op_labels.get(id(node))
@@ -2511,10 +2572,18 @@ class LocalExecutor:
                 table = self._build_join_table(build_page, node.right_keys,
                                                build_key_types, span)
             if cache_key is not None:
-                self.buffer_pool.put_build(cache_key, {
-                    "page": build_page, "dicts": build_dicts, "table": table,
-                    "span": span,
-                    "null_stats": (build_has_null, build_nonempty)})
+                # store-on-failure hardening: a failed admission (injected
+                # fault, pool error) must not fail a join whose build already
+                # completed — the build is simply not shared
+                try:
+                    self.buffer_pool.put_build(cache_key, {
+                        "page": build_page, "dicts": build_dicts,
+                        "table": table, "span": span,
+                        "null_stats": (build_has_null, build_nonempty)})
+                except tracing.StallKilledError:
+                    raise  # a watchdog kill must never be neutralized here
+                except Exception:
+                    pass
         if table is None or node.filter is not None:
             # duplicate build keys or residual join filter -> multi-match strategy
             return self._compile_multi_join(node, build_page, build_dicts, probe_stream,
@@ -3910,7 +3979,7 @@ def _compact_pack(valid):
 
 
 def _prefetched_pages(pages_fn, depth: int = 2, to_device: bool = False,
-                      warmup: int = 0):
+                      warmup: int = 0, owner=None):
     """Wrap a page generator with background-thread prefetch: up to ``depth``
     pages decode ahead of the consumer.  ``to_device`` additionally moves each
     page's host (numpy) arrays onto the device FROM THE PRODUCER THREAD
@@ -3924,7 +3993,13 @@ def _prefetched_pages(pages_fn, depth: int = 2, to_device: bool = False,
     consumer (LIMIT short-circuit, error unwind) closes the generator; the
     producer observes the ``closed`` flag on its next bounded put and exits,
     releasing its decoded pages and file handles instead of blocking on the
-    full queue for the process lifetime."""
+    full queue for the process lifetime.  ``owner`` (the LocalExecutor that
+    compiled the scan) additionally registers the producer's stop flag +
+    thread so ``close_producers()`` can stop it on exception paths where the
+    consumer generator is never closed — a mid-query error's traceback pins
+    the consumer frames (and so the generators) alive, which used to leave
+    the producer pumping against a full queue until the traceback was
+    released."""
     import queue as _queue
 
     def pages():
@@ -3945,6 +4020,16 @@ def _prefetched_pages(pages_fn, depth: int = 2, to_device: bool = False,
         # query's tree even though it opens on another thread.
         tracer = tracing.current_tracer()
         parent = tracer.current() if tracer is not None else None
+        # counters/query-id handoff, same idea as the span parent: generate
+        # and h2d fault injections fire ON this thread, and without the
+        # query's counters installed here record_fault would no-op — a chaos
+        # run over the default prefetch path would read 0 faults_injected.
+        # The producer still records nothing else and never touches executor
+        # state (the round-6 rule).  track_counters must enter BEFORE
+        # query_scope: live-counter registration keys on the qid active at
+        # entry, and the query thread already registered this counter set.
+        counters = tracing.current_counters()
+        qid = tracing.current_query_id()
 
         def producer():
             def put(item) -> bool:
@@ -3971,15 +4056,35 @@ def _prefetched_pages(pages_fn, depth: int = 2, to_device: bool = False,
                 finally:
                     if span is not None:
                         span.attributes["pages"] = n
+                    # the producer owns the source iterator once the thread
+                    # starts: close it HERE so connector state (file handles,
+                    # decode buffers) releases with the thread, not at GC
+                    close = getattr(it, "close", None)
+                    if close is not None:
+                        try:
+                            close()
+                        except Exception:
+                            pass
 
-            if tracer is None:
-                pump(None)
-            else:
-                with tracer.span("prefetch", parent=parent,
-                                 to_device=to_device) as span:
-                    pump(span)
+            with contextlib.ExitStack() as scopes:
+                if counters is not None:
+                    scopes.enter_context(tracing.track_counters(counters))
+                if qid is not None:
+                    scopes.enter_context(tracing.query_scope(qid))
+                if tracer is None:
+                    pump(None)
+                else:
+                    with tracer.span("prefetch", parent=parent,
+                                     to_device=to_device) as span:
+                        pump(span)
 
-        threading.Thread(target=producer, daemon=True).start()
+        # named so leak checks (tests/test_chaos.py, scripts/chaos.py) can
+        # assert "no prefetch producer survived the query" by thread name
+        t = threading.Thread(target=producer, daemon=True,
+                             name="prefetch-producer")
+        if owner is not None:
+            owner._producers.append((closed, t))
+        t.start()
         try:
             while True:
                 item = q.get()
@@ -3999,6 +4104,8 @@ def _page_to_device(page: Page) -> Page:
     arrays pass through; object columns cannot live on device).  device_put is
     an enqueue, not a sync — safe from the prefetch thread, and by the time
     the consumer dispatches over the page the copy has overlapped."""
+    faults.maybe_inject("h2d", "page_to_device")
+
     def up(a):
         if isinstance(a, np.ndarray) and a.dtype != object:
             return jax.device_put(a)
@@ -4030,6 +4137,7 @@ def _host(arrays, site=None):
     reg = tracing.current_inflight()
     tok = reg.enter("host_pull", site)
     try:
+        faults.maybe_inject("host_pull", site)
         nbytes = 0
         for a in arrays:
             if hasattr(a, "copy_to_host_async"):

@@ -43,6 +43,8 @@ import threading
 from collections import OrderedDict
 from typing import Optional
 
+from . import faults
+
 __all__ = ["DeviceBufferPool", "page_cache_budget"]
 
 
@@ -178,7 +180,13 @@ class DeviceBufferPool:
 
     # -- page tier -------------------------------------------------------------
     def get_page(self, key):
-        """-> (page, nbytes) or None; a hit refreshes LRU recency."""
+        """-> (page, nbytes) or None; a hit refreshes LRU recency.  Chaos:
+        ``cache_checkout`` faults land here — ``deny`` serves a miss (the
+        caller regenerates, the recoverable path), raises propagate."""
+        if faults.maybe_inject("cache_checkout", f"page.{key[2]}") == "deny":
+            with self._lock:
+                self.misses += 1
+            return None
         with self._lock:
             e = self._entries.get(key)
             if e is None:
@@ -199,13 +207,20 @@ class DeviceBufferPool:
         (exec.local_executor._stage_scan_entry does the staging: host arrays
         through the sanctioned _page_to_device chokepoint, concatenation as
         one COUNTED _jit dispatch — device work here would be invisible to
-        the budget counters).  Never raises: an over-budget page is simply
-        not cached."""
+        the budget counters).  Chaos: ``cache_store`` faults land here —
+        ``deny`` skips the admission (next query regenerates), raises
+        propagate to the scan source's store guard, which treats the scan as
+        uncacheable; either way no partial entry can be admitted."""
         if not self.enabled or page is None:
             return False
         with self._lock:
             if key in self._entries:
                 return True  # another executor stored it first
+        # inject only past the early-exits (duplicate store included): a fire
+        # must mean a real store was attempted, or chaos "fires>=1"
+        # assertions pass vacuously
+        if faults.maybe_inject("cache_store", f"page.{key[2]}") == "deny":
+            return False
         nbytes = _page_nbytes(page)
         return self._store(key, _Entry("page", key[1], key[2], page, nbytes),
                            self.PAGE_TAG)
@@ -216,6 +231,10 @@ class DeviceBufferPool:
         "span", "null_stats"} — everything _compile_join derives from the
         build fragment; "table" is None when the fragment needs the
         multi-match strategy (duplicate keys / residual filter)."""
+        if faults.maybe_inject("cache_checkout", "build") == "deny":
+            with self._lock:
+                self.build_misses += 1
+            return None
         with self._lock:
             e = self._entries.get(key)
             if e is None:
@@ -233,6 +252,8 @@ class DeviceBufferPool:
         with self._lock:
             if key in self._entries:
                 return True
+        if faults.maybe_inject("cache_store", "build") == "deny":
+            return False
         nbytes = _page_nbytes(payload["page"]) \
             + _table_nbytes(payload.get("table"))
         return self._store(

@@ -1,0 +1,138 @@
+"""The chaos matrix, shared between its two consumers.
+
+tests/test_chaos.py (the pinned clean-failure contract) and scripts/chaos.py
+(the standalone on-device capture harness) run the SAME scenarios with the
+SAME result-signature and leak-check semantics — so the scenario table and
+those helpers live here, once.  An edit here changes the test suite and the
+capture artifact together instead of silently diverging them.
+
+Host-only module: no jax import, safe to load before backend selection.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from . import tracing
+
+# the budget-suite north-star queries (inlined from the TPC-H spec for the
+# same reason test_query_budgets inlines them: the matrix must not drift with
+# a generator/benchmark edit)
+QUERIES = {
+    "q1": """
+    select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+           sum(l_extendedprice) as sum_base_price,
+           sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+           sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+           avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
+           avg(l_discount) as avg_disc, count(*) as count_order
+    from lineitem where l_shipdate <= date '1998-12-01' - interval '90' day
+    group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus""",
+    "q3": """
+    select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+           o_orderdate, o_shippriority
+    from customer, orders, lineitem
+    where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+      and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+      and l_shipdate > date '1995-03-15'
+    group by l_orderkey, o_orderdate, o_shippriority
+    order by revenue desc, o_orderdate limit 10""",
+    "q9": """
+    select nation, o_year, sum(amount) as sum_profit from (
+      select n_name as nation, extract(year from o_orderdate) as o_year,
+        l_extendedprice * (1 - l_discount) - ps_supplycost * l_quantity as amount
+      from part, supplier, lineitem, partsupp, orders, nation
+      where s_suppkey = l_suppkey and ps_suppkey = l_suppkey and ps_partkey = l_partkey
+        and p_partkey = l_partkey and o_orderkey = l_orderkey
+        and s_nationkey = n_nationkey and p_name like '%green%') as profit
+    group by nation, o_year order by nation, o_year desc""",
+    "q18": """
+    select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice, sum(l_quantity)
+    from customer, orders, lineitem
+    where o_orderkey in (select l_orderkey from lineitem group by l_orderkey
+                         having sum(l_quantity) > 300)
+      and c_custkey = o_custkey and o_orderkey = l_orderkey
+    group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+    order by o_totalprice desc, o_orderdate limit 100""",
+}
+
+# (name, spec, kind, clear_pool, cache_on).  kind "recover" asserts
+# byte-identical results, "fail" asserts the typed error.  clear_pool empties
+# the buffer pool first (store scenarios never fire against a warm pool —
+# a warm pool never stores); cache_on=False runs the page_cache=false session
+# for the generate/h2d classes (a warm pool hit never generates).
+SCENARIOS = [
+    ("cache-checkout-deny", "point=cache_checkout,action=deny,every=1",
+     "recover", False, True),
+    ("cache-store-error", "point=cache_store,action=error,every=1",
+     "recover", True, True),
+    ("reserve-deny", "point=reserve,action=deny,nth=1", "recover", False,
+     True),
+    ("dispatch-delay", "point=dispatch,action=delay,s=0.001,every=2",
+     "recover", False, True),
+    ("dispatch-error", "point=dispatch,action=error,nth=3", "fail", False,
+     True),
+    ("generate-error", "point=generate,action=error,nth=2", "fail", False,
+     False),
+    ("host-pull-fatal", "point=host_pull,action=fatal,nth=1", "fail", False,
+     True),
+    ("h2d-error", "point=h2d,action=error,nth=2", "fail", False, False),
+]
+
+# the test suite's parametrization views: recovery must be invisible
+# (name -> (spec, clear_pool)), local failure must be typed-clean
+# (name -> (spec, cache_on))
+RECOVERABLE = {name: (spec, clear_pool)
+               for name, spec, kind, clear_pool, _cache_on in SCENARIOS
+               if kind == "recover"}
+FAILING = {name: (spec, cache_on)
+           for name, spec, kind, _clear_pool, cache_on in SCENARIOS
+           if kind == "fail"}
+
+
+def result_signature(result):
+    """Byte-level result signature (dtype + raw bytes per column; object
+    columns — decoded strings — by value)."""
+    out = []
+    for c in result.columns:
+        a = np.asarray(c)
+        out.append((str(a.dtype),
+                    tuple(a.tolist()) if a.dtype == object else a.tobytes()))
+    return tuple(out)
+
+
+def settle(timeout: float = 8.0) -> list:
+    """Poll until no prefetch-producer thread is alive and the in-flight
+    registry is empty; returns the leftovers (empty = clean)."""
+    deadline = time.time() + timeout
+    while True:
+        leftovers = [t.name for t in threading.enumerate()
+                     if t.name.startswith("prefetch-producer")
+                     and t.is_alive()]
+        if tracing.INFLIGHT.depth() > 0:
+            leftovers += [e["label"] for e in tracing.INFLIGHT.snapshot()]
+        if not leftovers or time.time() >= deadline:
+            return leftovers
+        time.sleep(0.05)
+
+
+def leak_report(engine, timeout: float = 8.0) -> list:
+    """The post-scenario contract, as a list of violations (empty = clean):
+    no surviving prefetch-producer thread, zero residual in-flight entries,
+    no executor holding a live producer registration, and buffer-pool
+    reservations exactly equal to its resident bytes (an orphaned
+    reservation — store failed after reserving — or an unaccounted partial
+    page breaks the equality)."""
+    leftovers = settle(timeout)
+    for ex in getattr(engine, "_all_executors", []):
+        if ex._producers:
+            leftovers.append("executor-held-producers")
+    bp = engine.buffer_pool
+    pool = bp.memory_pool
+    if pool is not None and pool.reserved != bp.info()["bytes"]:
+        leftovers.append(f"pool-reservation-mismatch:{pool.reserved}!="
+                         f"{bp.info()['bytes']}")
+    return leftovers

@@ -20,6 +20,7 @@ from typing import Optional
 __all__ = ["Span", "Tracer", "NOOP_TRACER", "QueryCounters", "track_counters",
            "current_counters", "record_dispatch", "record_host_pull",
            "record_coalesced", "record_page_cache", "record_build_cache",
+           "record_fault", "record_task_retry",
            "LatencyHistogram", "LATENCY_BUCKETS_S",
            "operator_scope", "activate_tracer", "current_tracer",
            "maybe_span", "span_dict", "spans_to_otlp",
@@ -165,6 +166,13 @@ class QueryCounters:
     page_cache_misses: int = 0
     page_cache_bytes_saved: int = 0
     build_cache_hits: int = 0
+    # round 10: chaos accounting.  faults_injected counts fault-injector
+    # firings (execution/faults) attributed to this query — a chaos run is
+    # self-describing in EXPLAIN ANALYZE and bench output; task_retries
+    # counts retry-loop re-attempts (FTE task retries, coordinator task
+    # re-dispatches) charged to the query that paid them.
+    faults_injected: int = 0
+    task_retries: int = 0
     # "<operator>/<site>" -> {"dispatches", "transfers", "bytes"} plus any
     # cache keys the site recorded: the attribution EXPLAIN ANALYZE prints
     # and budget failures dump
@@ -174,7 +182,8 @@ class QueryCounters:
 
     _INT_FIELDS = ("device_dispatches", "host_transfers", "host_bytes_pulled",
                    "coalesced_splits", "page_cache_hits", "page_cache_misses",
-                   "page_cache_bytes_saved", "build_cache_hits")
+                   "page_cache_bytes_saved", "build_cache_hits",
+                   "faults_injected", "task_retries")
 
     def reset(self) -> None:
         for f in self._INT_FIELDS:
@@ -323,6 +332,15 @@ def operator_scope(label: str, sink: Optional[dict] = None):
         _counter_local.op = prev
 
 
+def full_site_label(site: str) -> str:
+    """The "<Op>#<k>/<site>" form of a bare site tag — the label the
+    in-flight registry shows and fault-rule site globs may address.  Bare
+    when no operator scope is active on this thread (producer threads,
+    engine-level pulls)."""
+    op = getattr(_counter_local, "op", None)
+    return f"{op[0]}/{site}" if op is not None else site
+
+
 def _attribute(site: Optional[str], dispatches=0, transfers=0, nbytes=0):
     """Charge one record to the active op scope's sink and the counters' site
     table under "<op>/<site>"."""
@@ -412,6 +430,24 @@ def record_build_cache(hits: int = 0, misses: int = 0,
     if c is not None:
         c.build_cache_hits += hits
     _attribute_extra(site, build_cache_hits=hits, build_cache_misses=misses)
+
+
+def record_fault(site: Optional[str] = None) -> None:
+    """One fault-injector firing (execution/faults) — attributed like cache
+    events so EXPLAIN ANALYZE's site table names where the chaos landed."""
+    c = getattr(_counter_local, "counters", None)
+    if c is not None:
+        c.faults_injected += 1
+    _attribute_extra(site, faults_injected=1)
+
+
+def record_task_retry(n: int = 1, site: Optional[str] = None) -> None:
+    """A task retry/re-dispatch charged to the query that paid for it (FTE
+    retry loop, coordinator task reassignment)."""
+    c = getattr(_counter_local, "counters", None)
+    if c is not None:
+        c.task_retries += n
+    _attribute_extra(site, task_retries=n)
 
 
 # -- in-flight registry --------------------------------------------------------

@@ -14,6 +14,8 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from .execution import faults
+
 __all__ = ["MemoryPool", "AggregatedMemoryContext", "LocalMemoryContext",
            "MemoryPoolExhaustedError", "QueryMemoryLimitError",
            "QueryKilledError", "device_memory_budget"]
@@ -127,6 +129,12 @@ class MemoryPool:
             self._by_query.pop(key, None)
 
     def try_reserve(self, nbytes: int, tag: str = "") -> bool:
+        # chaos chokepoint: an armed ``reserve`` fault can deny this
+        # reservation (the caller takes its Grace/partitioned fallback — the
+        # recoverable path the chaos suite pins) or raise a typed error;
+        # disarmed this is one module-global None test
+        if faults.maybe_inject("reserve", tag) == "deny":
+            return False
         qkey = getattr(_SCOPE, "key", None)
         with self._lock:
             if qkey is not None and qkey in self._killed:

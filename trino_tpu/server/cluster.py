@@ -60,7 +60,8 @@ from ..exec.fte import (FaultTolerantExecutor, SpoolingExchange,
                         run_partial_aggregate, run_stream_splits,
                         serialize_fragment_output)
 from ..exec.local_executor import LocalExecutor, _materialize
-from ..execution import tracing
+from ..execution import faults, tracing
+from ..execution.faults import InjectedFaultError
 from ..execution.tracing import (InflightRegistry, QueryCounters,
                                  StallWatchdog, Tracer)
 from ..sql import plan as P
@@ -101,6 +102,28 @@ def _http(url: str, data: Optional[bytes] = None, timeout: float = 10.0,
 
 def _sign(secret: str, body: bytes) -> str:
     return hmac.new(secret.encode(), body, hashlib.sha256).hexdigest()
+
+
+def _backoff_s(key: str, attempt: int, base: float = 0.25,
+               cap: float = 5.0) -> float:
+    """Exponential backoff with DETERMINISTIC jitter for retry scheduling
+    (task re-dispatch, heartbeat probes of a failing worker).  ``base *
+    2^(attempt-1)`` grows the spacing; the jitter factor (in [1.0, 1.5)) is a
+    hash of (key, attempt) — seeded from the task/node id, so two coordinators
+    retrying the same task space identically and a chaos run is reproducible,
+    while distinct tasks still de-synchronize instead of thundering back
+    together (reference: the backoff in HttpPageBufferClient / failure
+    detector probes, with the randomness made deterministic)."""
+    # attempt is UNBOUNDED on the heartbeat-misses path (a worker that dies
+    # without announcing keeps accumulating misses); 2**(attempt-1) crosses
+    # float range around attempt 1025 and the OverflowError would kill the
+    # heartbeat daemon thread.  base * 2**30 is already orders of magnitude
+    # past any sane cap, so clamping the exponent never changes the result.
+    d = base * (2 ** min(max(attempt - 1, 0), 30))
+    h = int.from_bytes(
+        hashlib.blake2b(f"{key}:{attempt}".encode(), digest_size=8).digest(),
+        "big")
+    return min(d * (1.0 + 0.5 * (h / 2.0 ** 64)), cap)
 
 
 _LOOPBACK = ("127.0.0.1", "localhost", "::1")
@@ -742,6 +765,15 @@ class WorkerServer:
                                          kind=kind, node=self.node_id), \
                         tracing.track_counters(counters), \
                         self.memory_pool.query_scope(xdir):
+                    # chaos chokepoint: the worker task body.  kill_worker
+                    # simulates a crashed node (HTTP goes dark, heartbeats
+                    # fail, the coordinator re-dispatches elsewhere on its
+                    # backoff curve); error/fatal fail just this task.
+                    act = faults.maybe_inject("task", f"{kind}.{tid}")
+                    if act == "kill_worker":
+                        self._simulate_crash()
+                        raise InjectedFaultError(
+                            f"injected worker crash during task {tid}")
                     if kind == "partial_agg":
                         data = run_partial_aggregate(ex, node, req["splits"],
                                                      xdir, sources, fetch,
@@ -801,9 +833,30 @@ class WorkerServer:
                         self._running_queries[xdir] = nq
                 ex.dispatch_batch = None  # per-task settings; executor is pooled
                 ex.page_cache = None
+                # no prefetch producer outlives its task: the executor is
+                # re-pooled the moment this releases, and a stranded producer
+                # from a FAILED task would race the next task's scan
+                ex.close_producers()
                 self._release_executor(ex, token=token)
 
         threading.Thread(target=run, daemon=True).start()
+
+    def _simulate_crash(self) -> None:
+        """Chaos ``kill_worker`` action: make this worker look CRASHED, not
+        drained — the HTTP server stops answering (status polls and heartbeat
+        probes fail, so the failure detector marks the node dead on its
+        backoff schedule) while the process and its in-flight task threads
+        live on, exactly like a wedged host whose socket died."""
+        self._stop.set()  # halt the announce loop
+        with self._wlock:
+            self._draining = True  # refuse anything that still gets through
+        httpd = self._httpd
+        if httpd is not None:
+            try:
+                httpd.shutdown()
+                httpd.server_close()
+            except Exception:
+                pass
 
     # -- graceful shutdown (reference: server/GracefulShutdownHandler.java:
     # SHUTTING_DOWN gates new work, active tasks drain, then the process
@@ -859,6 +912,11 @@ class _WorkerInfo:
     degraded: bool = False
     inflight: int = 0  # worker-reported in-flight depth (observability)
     stall_report: Optional[dict] = None  # last report seen on a heartbeat
+    # round 10: heartbeat probes of a FAILING worker back off exponentially
+    # (deterministic jitter seeded from the node id) instead of paying a
+    # fixed-interval 2s timeout against a dead node every pass — the probe
+    # is skipped until next_probe; success resets it to "every interval"
+    next_probe: float = 0.0
 
 
 class ClusterCoordinator:
@@ -873,7 +931,10 @@ class ClusterCoordinator:
                  secret: Optional[str] = None,
                  speculative_factor: float = 3.0,
                  stream_exchange: bool = True,
-                 low_memory_killer=None):
+                 low_memory_killer=None,
+                 retry_backoff_s: float = 0.25,
+                 retry_backoff_cap_s: float = 5.0,
+                 max_query_retries: int = 16):
         # stream_exchange: nested fragments ship their output through
         # in-memory worker buffers (long-poll + token ack) instead of the
         # spool — the reference's default PIPELINED data plane.  Single-task
@@ -932,6 +993,18 @@ class ClusterCoordinator:
         # SPECULATIVE class in the FTE scheduler)
         self.speculative_factor = speculative_factor
         self.speculative_tasks = 0  # observability counter
+        # round 10: re-dispatch backoff + per-query retry budget.  A retried
+        # task waits _backoff_s(task_id, attempt) before re-offering (spacing
+        # GROWS per attempt, jitter deterministic from the task id), and a
+        # query whose tasks burn more than max_query_retries retries IN TOTAL
+        # fails with the budget in the error — immediate fixed-interval
+        # retries against a sick cluster were indistinguishable from a hang.
+        self.retry_backoff_s = retry_backoff_s
+        self.retry_backoff_cap_s = retry_backoff_cap_s
+        self.max_query_retries = max_query_retries
+        self._query_retries = 0  # retries burned by the CURRENT query
+        self.last_retry_schedule: list = []  # (task_id, attempt, backoff_s)
+        # per query — the chaos suite asserts spacing grows
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
@@ -1069,6 +1142,10 @@ class ClusterCoordinator:
                     node_id, url, time.time(), draining=draining)
             else:
                 w.url, w.last_seen, w.misses, w.alive = url, time.time(), 0, True
+                # a recovered worker must be probe-able again NOW — a stale
+                # backoff deadline would blind the failure detector to a
+                # second death for the rest of the window
+                w.next_probe = 0.0
                 w.draining = draining
             if mem_reserved is not None:
                 w.mem_reserved = int(mem_reserved)
@@ -1089,10 +1166,16 @@ class ClusterCoordinator:
             with self._lock:
                 snapshot = list(self.workers.values())
             for w in snapshot:
+                if w.next_probe > time.time():
+                    # failing worker: probe on its backoff schedule, not every
+                    # pass — a dead node otherwise costs a 2s connect timeout
+                    # per heartbeat forever
+                    continue
                 try:
                     info = json.loads(_http(f"{w.url}/v1/info", timeout=2.0))
                     with self._lock:
                         w.misses, w.alive, w.last_seen = 0, True, time.time()
+                        w.next_probe = 0.0
                         w.draining = info.get("state") == "shutting_down"
                         if "mem_reserved" in info:
                             w.mem_reserved = int(info["mem_reserved"])
@@ -1112,6 +1195,12 @@ class ClusterCoordinator:
                         w.misses += 1
                         if w.misses >= self.max_misses:
                             w.alive = False
+                        # exponential probe backoff, jitter seeded from the
+                        # node id (deterministic; capped so a recovered node
+                        # is re-admitted within a bounded window)
+                        w.next_probe = time.time() + _backoff_s(
+                            w.node_id, w.misses, self.heartbeat_interval,
+                            max(self.heartbeat_interval * 16, 8.0))
             self._run_memory_killer()
             self._stop.wait(self.heartbeat_interval)
 
@@ -1209,6 +1298,10 @@ class ClusterCoordinator:
             self._qc_children = []
             self._worker_spans = []
             self._harvested = set()
+            # per-query retry budget + backoff schedule (queries serialize on
+            # _query_lock, so plain resets are race-free)
+            self._query_retries = 0
+            self.last_retry_schedule = []
             try:
                 if not self.live_workers():
                     return local.execute(plan)
@@ -1273,6 +1366,10 @@ class ClusterCoordinator:
                 finally:
                     local._overrides = {}
                     self._mem_results = {}
+                    # the coordinator drives _execute_to_page directly for
+                    # the local finish: stop any prefetch producer the query
+                    # started before releasing the shared executor
+                    local.close_producers()
                     self._harvest_stream_producers()
                     shutil.rmtree(exchange_dir, ignore_errors=True)
             finally:
@@ -1768,11 +1865,37 @@ class ClusterCoordinator:
         pending = dict(tasks)
         attempts: dict = {tid: 0 for tid, _ in tasks}
         refused_since: dict = {}  # tid -> first 429/503 of the current streak
+        not_before: dict = {}  # tid -> earliest re-offer time (backoff)
         spin = 0  # placement rotation: re-offered tasks must try OTHER workers
         assigned: dict = {}  # task_id -> (worker, extra, deadline)
         started: dict = {}  # task_id -> dispatch time (speculation baseline)
         durations: list = []  # completed task durations this fragment
         speculated: set = set()
+
+        def burn(tid: str, what: str) -> None:
+            """One retry burned: bump the task's attempt, charge the QUERY's
+            retry budget (surfaced in the error when exhausted), and schedule
+            the re-offer on the exponential-backoff curve — replacing the
+            old immediate fixed-interval re-dispatch."""
+            attempts[tid] += 1
+            tracing.record_task_retry(site="task.redispatch")
+            with self._lock:
+                self._query_retries += 1
+                burned = self._query_retries
+            if burned > self.max_query_retries:
+                raise RuntimeError(
+                    f"query retry budget exhausted: {burned} task retries > "
+                    f"max_query_retries={self.max_query_retries} "
+                    f"(last: task {tid} {what}, attempt {attempts[tid]})")
+            if attempts[tid] >= self.max_attempts:
+                raise RuntimeError(
+                    f"task {tid} {what} after {attempts[tid]} attempts")
+            delay = _backoff_s(tid, attempts[tid], self.retry_backoff_s,
+                               self.retry_backoff_cap_s)
+            not_before[tid] = time.time() + delay
+            with self._lock:
+                self.last_retry_schedule.append((tid, attempts[tid], delay))
+
         while pending or assigned:
             if self._query_abort.is_set():
                 raise RuntimeError(
@@ -1784,6 +1907,8 @@ class ClusterCoordinator:
                 raise RuntimeError("no live workers")
             spin += 1
             for i, (tid, extra) in enumerate(list(pending.items())):
+                if not_before.get(tid, 0.0) > time.time():
+                    continue  # backing off: re-offer when the window opens
                 w = live[(i + spin) % len(live)]
                 try:
                     if w.url not in frag_sent:
@@ -1816,19 +1941,10 @@ class ClusterCoordinator:
                         t0 = refused_since.setdefault(tid, time.time())
                         if time.time() - t0 > self.task_timeout:
                             refused_since.pop(tid, None)
-                            attempts[tid] += 1
-                            if attempts[tid] >= self.max_attempts:
-                                raise RuntimeError(
-                                    f"task {tid} refused by every worker for "
-                                    f"{self.task_timeout:.0f}s "
-                                    f"({attempts[tid]} attempts)")
+                            burn(tid, "refused by every worker")
                         continue
                     frag_sent.discard(w.url)
-                    attempts[tid] += 1
-                    if attempts[tid] >= self.max_attempts:
-                        raise RuntimeError(
-                            f"task {tid} failed to dispatch after "
-                            f"{attempts[tid]} attempts")
+                    burn(tid, "failed to dispatch")
                     continue
                 except Exception:
                     # unreachable worker, or 409 after a restart/fragment
@@ -1846,11 +1962,7 @@ class ClusterCoordinator:
                             w.alive = False
                         still_alive = w.alive
                     if still_alive:
-                        attempts[tid] += 1
-                        if attempts[tid] >= self.max_attempts:
-                            raise RuntimeError(
-                                f"task {tid} failed to dispatch after "
-                                f"{attempts[tid]} attempts")
+                        burn(tid, "failed to dispatch")
                     continue
             # poll assigned tasks
             time.sleep(0.05)
@@ -1922,10 +2034,7 @@ class ClusterCoordinator:
                     failed = True
                 if failed and not exchange.is_committed(tid):
                     del assigned[tid]
-                    attempts[tid] += 1
-                    if attempts[tid] >= self.max_attempts:
-                        raise RuntimeError(
-                            f"task {tid} failed after {attempts[tid]} attempts")
+                    burn(tid, "failed")
                     if extra.get("stream_sources"):
                         # the consumer partially drained its producers'
                         # ack-once buffers: replay the producer chain fresh

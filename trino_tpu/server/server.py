@@ -559,6 +559,17 @@ class CoordinatorServer:
                 "# TYPE trino_tpu_coalesced_splits_total counter",
                 f"trino_tpu_coalesced_splits_total "
                 f"{getattr(ct, 'coalesced_splits', 0)}",
+                "# HELP trino_tpu_faults_injected_total Chaos fault-injector "
+                "firings (execution/faults) accounted to queries.",
+                "# TYPE trino_tpu_faults_injected_total counter",
+                f"trino_tpu_faults_injected_total "
+                f"{getattr(ct, 'faults_injected', 0)}",
+                "# HELP trino_tpu_task_retries_total Task retries / "
+                "re-dispatches charged to queries (FTE retry loop, "
+                "coordinator reassignment).",
+                "# TYPE trino_tpu_task_retries_total counter",
+                f"trino_tpu_task_retries_total "
+                f"{getattr(ct, 'task_retries', 0)}",
             ]
             sites = getattr(ct, "sites", None) or {}
             if sites:

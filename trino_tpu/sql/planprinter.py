@@ -27,11 +27,18 @@ def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
     lines: list = []
     _fmt(node, lines, 0, stats or {}, boundary or {})
     if counters is not None:
-        lines.append(
+        boundary_line = (
             f"Device boundary: {counters.device_dispatches} dispatches, "
             f"{counters.host_transfers} host transfers, "
             f"{counters.host_bytes_pulled} bytes pulled, "
             f"{getattr(counters, 'coalesced_splits', 0)} splits coalesced")
+        # chaos runs are self-describing: injected faults and the retries
+        # they forced ride the boundary summary (zero = line unchanged)
+        fi = getattr(counters, "faults_injected", 0)
+        tr = getattr(counters, "task_retries", 0)
+        if fi or tr:
+            boundary_line += f", {fi} faults injected, {tr} task retries"
+        lines.append(boundary_line)
         pc_h = getattr(counters, "page_cache_hits", 0)
         pc_m = getattr(counters, "page_cache_misses", 0)
         bc_h = getattr(counters, "build_cache_hits", 0)
