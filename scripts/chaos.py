@@ -118,6 +118,37 @@ def main() -> int:
                     rec["detail"] = f"{type(e).__name__}: {e}"
                 payload["scenarios"].append(rec)
                 done += 1
+        # round 11: the memory-pressure matrix (tiered spill ladder) — same
+        # shared table the test suite pins (chaos_matrix.PRESSURE), run
+        # against the REAL q18 at this scale plus the distilled pressure
+        # query, inside the same wall-clock budget
+        import tempfile
+
+        from trino_tpu.execution.chaos_matrix import (PRESSURE,
+                                                      PRESSURE_QUERY,
+                                                      run_pressure_scenario)
+        from trino_tpu.exec.local_executor import LocalExecutor
+        from trino_tpu.sql.frontend import compile_sql
+
+        pressure_queries = {"pressure-agg": PRESSURE_QUERY}
+        if "q18" in names:
+            pressure_queries["q18"] = QUERIES["q18"]
+        for qname, sql in pressure_queries.items():
+            plan = compile_sql(sql, engine, session)
+            base = _sig(LocalExecutor(engine.catalogs).execute(plan))
+            for (name, cfg, spec, kind) in PRESSURE:
+                if time.time() - t_start > budget:
+                    skipped += 1
+                    continue
+                scratch = tempfile.mkdtemp(prefix="trino_tpu_chaos_spill_")
+                rec = run_pressure_scenario(engine, plan, base, name, cfg,
+                                            spec, kind, scratch)
+                rec["query"] = qname
+                payload["scenarios"].append(rec)
+                done += 1
+                import shutil
+
+                shutil.rmtree(scratch, ignore_errors=True)
         total = len(payload["scenarios"])
         passed = sum(1 for r in payload["scenarios"] if r.get("ok"))
         payload["value"] = (passed / total) if total else 0.0

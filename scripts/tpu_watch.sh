@@ -79,13 +79,31 @@ for i in $(seq 1 250); do
     done
     # round-10 chaos pass on the REAL device: the fault paths (wedges, lost
     # round-trips, denied reservations) are exactly what the tunnel exercises
-    # for free — one JSON line, same contract as bench.py
-    CHAOS_SF=1 CHAOS_QUERIES=q1,q3 CHAOS_BUDGET=600 \
+    # for free — one JSON line, same contract as bench.py.  q18 in the list
+    # also drives the round-11 PRESSURE matrix (tiered-spill ladder) against
+    # the real q18 on device.
+    CHAOS_SF=1 CHAOS_QUERIES=q1,q3,q18 CHAOS_BUDGET=900 \
       TRINO_TPU_PAGE_CACHE=1073741824 \
-      timeout -k 60 900 python scripts/chaos.py \
+      timeout -k 60 1200 python scripts/chaos.py \
       > scripts/chaos_r10.json 2> scripts/chaos_r10.log
     rc=$?
     echo "$(date -Is) chaos rc=$rc : $(tail -c 300 scripts/chaos_r10.json)" >> "$LOG"
+    # round-11 forced-spill A/B: q18 SF1 unconstrained vs TINY pool budgets
+    # (page cache shrunk to force the spill ladder's HBM tier, host watermark
+    # down to overflow into disk) — prices each tier's round-trip/wall cost
+    # on the real tunnel, the SF100 go/no-go datum
+    BENCH_BUDGET=900 BENCH_SF=1 BENCH_QUERIES=q18 TRINO_TPU_SCAN_FUSED=0 \
+      TRINO_TPU_PAGE_CACHE=33554432 TRINO_TPU_SPILL_HOST_BYTES=33554432 \
+      timeout -k 60 1200 python bench.py \
+      > scripts/bench_sf1_spill.json 2> scripts/bench_sf1_spill.log
+    echo "$(date -Is) spill A/B rc=$? : $(cat scripts/bench_sf1_spill.json 2>/dev/null | tail -c 300)" >> "$LOG"
+    # SF100 q18 (the capture the tiered spill exists for): hours-long on a
+    # good day, so it runs LAST — everything decision-driving is already on
+    # disk if the tunnel wedges mid-run
+    BENCH_BUDGET=14400 BENCH_SF=100 BENCH_QUERIES=q18 TRINO_TPU_SCAN_FUSED=0 \
+      timeout -k 60 18000 python bench.py \
+      > scripts/bench_sf100_q18.json 2> scripts/bench_sf100_q18.log
+    echo "$(date -Is) SF100 q18 rc=$? : $(cat scripts/bench_sf100_q18.json 2>/dev/null | tail -c 300)" >> "$LOG"
     rm -f scripts/tpu_cluster_probe.json  # never embed a stale probe artifact
     timeout -k 30 900 python scripts/tpu_cluster_probe.py \
       > scripts/tpu_cluster_probe.out 2>&1
@@ -119,6 +137,11 @@ try:
     out["chaos"] = json.load(open("scripts/chaos_r10.json"))
 except Exception as e:
     out["chaos"] = {"error": str(e)}
+for name in ("sf1_spill", "sf100_q18"):
+    try:
+        out[name] = json.load(open(f"scripts/bench_{name}.json"))
+    except Exception as e:
+        out[name] = {"error": str(e)}
 json.dump(out, open("BENCH_local_r09.json", "w"), indent=1)
 PY
     echo "$(date -Is) wrote BENCH_local_r09.json" >> "$LOG"

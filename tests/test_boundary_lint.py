@@ -5,8 +5,14 @@ CLAUDE.md's rule — executor code MUST go through ``_jit`` (not bare
 the dispatch/transfer is invisible to the per-query budget counters — was a
 doc note until round 6.  This test makes it an enforced invariant:
 
-- ``jax.jit(`` may appear only inside the ``_jit`` helper itself (the one
-  place the accounting wrapper is built).
+- ``jax.jit`` may be REFERENCED only inside the ``_jit`` helper itself (the
+  one place the accounting wrapper is built).  Round 11 tightened this from
+  call-sites to attribute references: ``partial(jax.jit, ...)`` smuggled an
+  uncounted/uninjectable dispatch past the call-only check for four rounds
+  (exec/spill's old ``_route_sorted`` was the escapee).
+- ``jax.device_get(`` is an unbatched, uncounted device->host pull — it may
+  appear only inside ``_host`` or on a line annotated ``# host-ok[: reason]``
+  asserting the value is already host-resident.
 - ``np.asarray(`` may appear only
   (a) inside a small set of allowlisted HOST-SIDE helpers (below, each with
       the reason it is exempt), or
@@ -48,6 +54,11 @@ ASARRAY_ALLOWED_FUNCS = {
 
 MARKER = "# host-ok"
 
+# functions whose BODY may call jax.device_get freely, with why:
+DEVICE_GET_ALLOWED_FUNCS = {
+    "_host",              # the accounting chokepoint itself
+}
+
 # functions whose BODY may call jax.device_put freely, with why:
 DEVICE_PUT_ALLOWED_FUNCS = {
     "_page_to_device",    # THE sanctioned H2D chokepoint: prefetch staging
@@ -80,6 +91,7 @@ class _Scan(ast.NodeVisitor):
         self.jit_hits = []      # (lineno, enclosing function)
         self.asarray_hits = []  # (lineno, enclosing function)
         self.device_put_hits = []  # (lineno, enclosing function)
+        self.device_get_hits = []  # (lineno, enclosing function)
         self.site_hits = []     # (lineno, enclosing function, callee)
 
     def visit_FunctionDef(self, node):
@@ -104,15 +116,26 @@ class _Scan(ast.NodeVisitor):
         where = self.func_stack[-1] if self.func_stack else "<module>"
         self.site_hits.append((node.lineno, where, callee))
 
+    def visit_Attribute(self, node):
+        # ATTRIBUTE references, not just calls: `partial(jax.jit, ...)` and
+        # `f = jax.device_get` alias the boundary away from the call-site
+        # checks, so the raw reference is what the lint must flag
+        if isinstance(node.value, ast.Name) and node.value.id == "jax":
+            where = self.func_stack[-1] if self.func_stack else "<module>"
+            if node.attr == "jit" and "_jit" not in self.func_stack:
+                self.jit_hits.append((node.lineno, where))
+            if node.attr == "device_get":
+                if not (set(self.func_stack) & DEVICE_GET_ALLOWED_FUNCS) \
+                        and MARKER not in self.lines[node.lineno - 1]:
+                    self.device_get_hits.append((node.lineno, where))
+        self.generic_visit(node)
+
     def visit_Call(self, node):
         f = node.func
         if isinstance(f, ast.Name) and f.id in ("_jit", "_host"):
             self._check_site(node, f.id)
         if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
             where = self.func_stack[-1] if self.func_stack else "<module>"
-            if f.value.id == "jax" and f.attr == "jit":
-                if "_jit" not in self.func_stack:
-                    self.jit_hits.append((node.lineno, where))
             if f.value.id == "np" and f.attr == "asarray":
                 if not (set(self.func_stack) & ASARRAY_ALLOWED_FUNCS) \
                         and MARKER not in self.lines[node.lineno - 1]:
@@ -135,10 +158,24 @@ def _scan(path):
 def test_no_bare_jax_jit(path):
     s = _scan(path)
     assert not s.jit_hits, (
-        f"{path.name}: bare jax.jit at "
+        f"{path.name}: bare jax.jit reference at "
         + ", ".join(f"line {ln} (in {fn})" for ln, fn in s.jit_hits)
         + " — use exec.local_executor._jit so the dispatch is counted "
-          "against the query budget")
+          "against the query budget (partial(jax.jit, ...) counts too)")
+
+
+@pytest.mark.parametrize("path", _exec_files(), ids=lambda p: p.name)
+def test_no_bare_device_get(path):
+    """Round-11 rule: jax.device_get is an unbatched, uncounted D2H pull —
+    invisible to the budget counters, the in-flight registry and the chaos
+    injector.  Pull through _host (batched, counted) or annotate
+    '# host-ok: <reason>' when the value is already host-resident."""
+    s = _scan(path)
+    assert not s.device_get_hits, (
+        f"{path.name}: bare jax.device_get at "
+        + ", ".join(f"line {ln} (in {fn})" for ln, fn in s.device_get_hits)
+        + " — batch the pull through _host, or annotate "
+          "'# host-ok: <reason>'")
 
 
 @pytest.mark.parametrize("path", _exec_files(), ids=lambda p: p.name)
@@ -185,31 +222,36 @@ def test_lint_catches_violations(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text(
         "import jax, numpy as np\n"
+        "from functools import partial\n"
         "def f(x):\n"
-        "    g = jax.jit(lambda a: a)\n"
-        "    return np.asarray(x)\n"
+        "    g = jax.jit(lambda a: a)\n"               # line 4: flagged
+        "    g2 = partial(jax.jit, static_argnames=('n',))\n"  # 5: flagged
+        "    return np.asarray(x)\n"                   # line 6: flagged
         "def _jit(fn):\n"
         "    return jax.jit(fn)\n"
         "def _host(arrays):\n"
         "    return [np.asarray(a) for a in arrays]\n"
         "ok = np.asarray([1, 2])  # host-ok: literal\n"
         "def h(x):\n"
-        "    y = jax.device_put(x)\n"                  # bare -> flagged
+        "    y = jax.device_put(x)\n"                  # line 13: flagged
         "    z = jax.device_put(x)  # device-ok: test\n"
-        "    return y, z\n"
+        "    w = jax.device_get(x)\n"                  # line 15: flagged
+        "    w2 = jax.device_get(x)  # host-ok: test\n"
+        "    return y, z, w, w2\n"
         "def _page_to_device(p):\n"
         "    return jax.device_put(p)\n"
         "def g(x, step):\n"
-        "    a = _host([x])\n"                      # missing site -> flagged
+        "    a = _host([x])\n"                  # line 21: missing site
         "    b = _host([x], site='g.pull')\n"        # tagged -> ok
         "    c = _host([x])  # site-ok: test\n"      # marked -> ok
-        "    d = _jit(lambda v: v)\n"                # anonymous -> flagged
+        "    d = _jit(lambda v: v)\n"            # line 24: anonymous
         "    e = _jit(step)\n"                       # named -> self-labels
         "    f2 = _jit(lambda v: v, site='g.step')\n"  # tagged -> ok
         "    return a, b, c, d, e, f2\n")
     s = _scan(bad)
-    assert [ln for ln, _ in s.jit_hits] == [3]
-    assert [ln for ln, _ in s.asarray_hits] == [4]
-    assert [ln for ln, _ in s.device_put_hits] == [11]
+    assert [ln for ln, _ in s.jit_hits] == [4, 5]
+    assert [ln for ln, _ in s.asarray_hits] == [6]
+    assert [ln for ln, _ in s.device_put_hits] == [13]
+    assert [ln for ln, _ in s.device_get_hits] == [15]
     assert [(ln, callee) for ln, _, callee in s.site_hits] == \
-        [(17, "_host"), (20, "_jit")]
+        [(21, "_host"), (24, "_jit")]

@@ -107,17 +107,19 @@ def _decompress(payload: bytes, codec: int) -> bytes:
 
 # ---------------------------------------------------------------------------- page serde
 def serialize_page(columns: list, null_masks: list,
-                   compress: bool = True) -> bytes:
+                   compress: bool = True, site: str = "fte.serialize") -> bytes:
     """Framed page wire format: magic, codec byte (low bits: NONE/ZLIB/ZSTD,
     high bit: AES-GCM encrypted), CRC32, length, npz payload (reference:
     PagesSerdeUtil.java:47 header + XXH64 checksum :84 with LZ4/ZSTD +
-    optional AES, CompressingEncryptingPageSerializer.java:58)."""
+    optional AES, CompressingEncryptingPageSerializer.java:58).  ``site``
+    labels the pull for callers outside the exchange (the disk spill tier
+    frames its partition files through this codec)."""
     buf = io.BytesIO()
     arrays = {}
     # ONE batched device->host pull for the whole page (serialization is a
     # transfer chokepoint on tunneled links, and it must show on the counters)
     host = _host(list(columns) + [m for m in null_masks if m is not None],
-                 site="fte.serialize")
+                 site=site)
     hcols, rest = host[:len(columns)], host[len(columns):]
     for i, c in enumerate(hcols):
         arrays[f"c{i}"] = c

@@ -402,6 +402,13 @@ class LocalExecutor:
         # stops them on every exit path — clean or error — so a mid-query
         # exception can never strand a producer thread behind its traceback
         self._producers: list = []
+        # live tiered spills (exec/spill.SpilledPartitions) registered by the
+        # Grace-partitioned paths: swept with the producers on every exit
+        # path so an error unwind can never strand a "spill" reservation or
+        # an on-disk partition file.  Persistent entries (the partitioned
+        # join's build side, cached with its compiled stream) survive the
+        # sweep and free via forget/GC.
+        self._spills: list = []
 
     def _batch(self) -> int:
         """Effective dispatch-coalescing width (>=1; 1 = per-split)."""
@@ -546,6 +553,18 @@ class LocalExecutor:
             # re-inserts on its next access.
             for key in [k for k in list(cache) if dead(k)]:
                 cache.pop(key, None)
+        # persistent spills (a partitioned join's build tier) live with the
+        # compiled stream being evicted: close them HERE — jax's global jit
+        # caches pin the closure graph, so waiting on GC/__del__ would leave
+        # their disk partitions and "spill-build" reservations around for
+        # the process lifetime
+        keep = []
+        for sp in self._spills:
+            if sp.persistent and sp.node_id in ids:
+                sp.close()
+            else:
+                keep.append(sp)
+        self._spills = keep
 
     def close_producers(self, join_timeout: float = 2.0) -> int:
         """Stop every prefetch producer this executor started for the current
@@ -565,6 +584,18 @@ class LocalExecutor:
         for _stop, t in procs:
             if t.is_alive():
                 t.join(timeout=max(deadline - _time.monotonic(), 0.05))
+        # sweep per-query tiered spills on the same exit paths (execute()
+        # clean/error, FTE/cluster drivers, engine release): close() is
+        # idempotent, so the normal in-path close costs nothing here, and an
+        # error unwind releases "spill" reservations + disk files instead of
+        # leaking them behind the traceback
+        spills, self._spills = self._spills, []
+        for sp in spills:
+            if sp.persistent:
+                self._spills.append(sp)  # cached join-build state: lives
+                # with the compiled stream, freed on forget/GC
+            else:
+                sp.close()
         return len(procs)
 
     # ------------------------------------------------------------------ public
@@ -2142,11 +2173,14 @@ class LocalExecutor:
         return page, dicts
 
     def _run_aggregate_partitioned(self, node: P.Aggregate, parts: int):
-        """Grace-partitioned aggregation over the HOST-RAM spill tier
-        (exec/spill.py): ONE pass transforms the input and hash-routes rows to
-        per-partition host buffers; partitions then aggregate one at a time
-        from host — the input (a file-backed scan in the worst case) is read
-        and decoded exactly once, unlike a Grace re-scan.  Reference:
+        """Grace-partitioned aggregation over the TIERED spill
+        (exec/spill.py, HBM -> host RAM -> disk): ONE pass transforms the
+        input and hash-routes rows into per-partition tier buffers;
+        partitions then aggregate one at a time — the input (a file-backed
+        scan in the worst case) is read and decoded exactly once, unlike a
+        Grace re-scan.  Device-resident (HBM-tier) partitions skip readback
+        staging entirely; host/disk readback overlaps device compute through
+        the round-6 prefetch double buffer.  Reference:
         SpillableHashAggregationBuilder + FileSingleStreamSpiller."""
         from ..ops.exchange import partition_ids
         from .spill import SpilledPartitions
@@ -2166,13 +2200,25 @@ class LocalExecutor:
                            for kv, kn in zip(key_vals, key_nulls))
             return cols, nulls, valid, partition_ids(routed, parts)
 
-        spill = SpilledPartitions(stream.schema, parts)
+        spill = SpilledPartitions(stream.schema, parts,
+                                  memory_pool=self.memory_pool,
+                                  buffer_pool=self.buffer_pool, owner=self)
+        try:
+            return self._consume_partitioned_agg(
+                node, stream, spill, parts, key_types, acc_specs, acc_exprs,
+                acc_kinds, route)
+        finally:
+            spill.close()
+
+    def _consume_partitioned_agg(self, node, stream, spill, parts, key_types,
+                                 acc_specs, acc_exprs, acc_kinds, route):
         for page in stream.pages():
             cols, nulls, valid, pid = route(page, stream.aux)
             spill.add_page(cols, nulls, valid, pid)
         st = self.stats.setdefault(id(node), {"rows": 0, "wall_s": 0.0})
         st["spilled_bytes"] = spill.spilled_bytes
         st["spill_partitions"] = parts
+        st["spill_tiers"] = dict(spill.tier_bytes)
 
         @_jit
         def insert(state, page, node=node, key_types=key_types,
@@ -2187,12 +2233,24 @@ class LocalExecutor:
 
         pages_out, dicts = [], None
         for p in range(parts):
-            capacity = MAX_GROUP_CAPACITY // 4
+            # the spill pass counted this partition's rows EXACTLY: seed the
+            # group table from them instead of the 2^23 worst-case (a 30k-row
+            # partition used to pay an 8M-slot init + scatter).  Groups <=
+            # rows always; 2x for probe headroom; the overflow retry loop
+            # still covers an undershoot, MAX_GROUP_CAPACITY still caps.
+            capacity = min(MAX_GROUP_CAPACITY // 4,
+                           ceil_pow2(max(2 * spill.rows[p], 1024)))
             while True:
                 state = hashagg.groupby_init(
                     capacity, tuple(t.dtype for t in key_types), acc_specs)
-                # capacity retries replay from HOST buffers, never the source
-                for page in spill.partition_pages(p):
+                # capacity retries replay from the spill tiers, never the
+                # source.  Host/disk chunks stage through the prefetch double
+                # buffer (decode/H2D overlaps the insert dispatches);
+                # HBM-tier chunks are already device-resident — no wrap.
+                src = partial(spill.partition_pages, p)
+                if spill.needs_staging(p):
+                    src = _prefetched_pages(src, to_device=True, owner=self)
+                for page in src():
                     state = insert(state, page)
                 if not bool(state.overflow):
                     break
@@ -2203,14 +2261,17 @@ class LocalExecutor:
                             f"partition even at {parts} partitions")
                     # a partition still blew the ceiling: restart with more
                     # partitions (the one remaining source re-scan).  Free
-                    # THIS spill's host buffers first — the restart re-spools
-                    # the whole input, and holding both doubles peak host RAM
-                    # in the one path that runs under memory pressure.
-                    del spill
+                    # THIS spill's buffers/reservations first — the restart
+                    # re-spools the whole input, and holding both doubles
+                    # peak spill footprint in the one path that runs under
+                    # memory pressure.
+                    spill.close()
                     return self._run_aggregate_partitioned(node, parts * 4)
                 capacity *= 4
             page, dicts = self._finalize_groups(node, stream, state)
             pages_out.append(page)
+            # consumed: release this partition's host reservation + disk file
+            spill.release_partition(p)
         # host-side concat.  Device-resident finalize makes partition outputs
         # jnp arrays: pull EVERY partition's columns in one batched _host
         # call (a serial per-column np.asarray would pay parts x columns
@@ -2773,10 +2834,21 @@ class LocalExecutor:
         routed = tuple(kv if kn is None else jnp.where(kn, jnp.zeros((), kv.dtype), kv)
                        for kv, kn in zip(bkeys, bknulls))
         bpid = partition_ids(routed, parts)
-        build_spill = SpilledPartitions(build_page.schema, parts)
+        # the build side is PERSISTENT spill state: it lives with this
+        # compiled stream across executions of a cached plan, so it skips
+        # the HBM tier (the point of partitioning the build is freeing its
+        # device residency) and stays UNACCOUNTED in the executor pool —
+        # reserving plan-cache-lifetime bytes there would hold the pool past
+        # BLOCKED_FRACTION forever, permanently engaging the admission gate
+        # and feeding the cluster killer innocent victims (pool reservations
+        # must mean live per-query state).  Its disk overflow still honors
+        # the watermark; forget_plan reclaims everything with the stream.
+        build_spill = SpilledPartitions(build_page.schema, parts,
+                                        owner=self, persistent=True,
+                                        tag="spill-build", node_id=id(node))
         build_spill.add_page(build_page.columns, build_page.null_masks,
                              build_page.valid_mask(), bpid)
-        # from here the build lives on the HOST; its device arrays free with
+        # from here the build lives off-device; its device arrays free with
         # this frame (the point of spilling: O(build/parts) resident HBM)
 
         @_jit
@@ -2792,25 +2864,44 @@ class LocalExecutor:
 
         def pages(self=self, node=node):
             # spill pass: one read of the probe source per execution
-            probe_spill = SpilledPartitions(probe_stream.schema, parts)
-            for page in probe_stream.pages():
-                cols, nulls, valid, pid = probe_route(page, probe_stream.aux)
-                probe_spill.add_page(cols, nulls, valid, pid)
-            st = self.stats.setdefault(id(node), {"rows": 0, "wall_s": 0.0})
-            st["spilled_bytes"] = (build_spill.spilled_bytes
-                                   + probe_spill.spilled_bytes)
-            st["spill_partitions"] = parts
-            for p in range(parts):
-                sub_stream = _Stream(probe_stream.schema, probe_stream.dicts,
-                                     partial(probe_spill.partition_pages, p),
-                                     lambda c, n, v, aux: (c, n, v))
-                sub = self._join_with_build(node, build_spill.partition_page(p),
-                                            build_dicts, sub_stream,
-                                            build_key_types)
-                jt = sub.jitted()
-                for page in sub.pages():
-                    cols, nulls, valid = jt(page)
-                    yield Page(node.schema, cols, nulls, valid)
+            probe_spill = SpilledPartitions(probe_stream.schema, parts,
+                                            memory_pool=self.memory_pool,
+                                            buffer_pool=self.buffer_pool,
+                                            owner=self)
+            try:
+                for page in probe_stream.pages():
+                    cols, nulls, valid, pid = probe_route(page,
+                                                          probe_stream.aux)
+                    probe_spill.add_page(cols, nulls, valid, pid)
+                st = self.stats.setdefault(id(node),
+                                           {"rows": 0, "wall_s": 0.0})
+                st["spilled_bytes"] = (build_spill.spilled_bytes
+                                       + probe_spill.spilled_bytes)
+                st["spill_partitions"] = parts
+                st["spill_tiers"] = {
+                    t: build_spill.tier_bytes[t] + probe_spill.tier_bytes[t]
+                    for t in probe_spill.tier_bytes}
+                for p in range(parts):
+                    # host/disk probe partitions stage back through the
+                    # prefetch double buffer; HBM-tier partitions are
+                    # already device-resident
+                    src = partial(probe_spill.partition_pages, p)
+                    if probe_spill.needs_staging(p):
+                        src = _prefetched_pages(src, to_device=True,
+                                                owner=self)
+                    sub_stream = _Stream(probe_stream.schema,
+                                         probe_stream.dicts, src,
+                                         lambda c, n, v, aux: (c, n, v))
+                    sub = self._join_with_build(
+                        node, build_spill.partition_page(p), build_dicts,
+                        sub_stream, build_key_types)
+                    jt = sub.jitted()
+                    for page in sub.pages():
+                        cols, nulls, valid = jt(page)
+                        yield Page(node.schema, cols, nulls, valid)
+                    probe_spill.release_partition(p)
+            finally:
+                probe_spill.close()
 
         semi = node.kind in ("semi", "anti")
         dicts = probe_stream.dicts if semi else probe_stream.dicts + build_dicts

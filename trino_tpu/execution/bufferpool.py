@@ -129,6 +129,7 @@ class DeviceBufferPool:
 
     PAGE_TAG = "page-cache"
     BUILD_TAG = "build-cache"
+    SPILL_TAG = "spill"
 
     def __init__(self, budget_bytes: Optional[int] = None):
         self._budget = budget_bytes  # None = resolve lazily from env/backend
@@ -284,6 +285,46 @@ class DeviceBufferPool:
         self.evictions += 1
         self.memory_pool.free(
             e.nbytes, self.PAGE_TAG if e.kind == "page" else self.BUILD_TAG)
+
+    # -- spill tier / pressure eviction (round 11) -----------------------------
+    def reserve_spill(self, nbytes: int) -> bool:
+        """Claim HBM for a device-resident spill chunk (exec/spill's first
+        tier).  Cache entries LRU-evict to make room — the escalation
+        ladder's first rung: cache gives way to live query state before
+        anything overflows to host RAM, queues, or dies — but spill can
+        never push the pool past its budget (overflow goes to the next
+        tier instead).  Reservations land under the "spill" tag of the
+        pool's labeled MemoryPool, so /v1/status and the leak checks see
+        device-resident spill alongside the cache tiers."""
+        if not self.enabled or nbytes <= 0:
+            return False
+        pool = self._pool()
+        with self._lock:
+            if nbytes > pool.max_bytes:
+                return False
+            while not pool.try_reserve(nbytes, self.SPILL_TAG):
+                if not self._entries:
+                    return False
+                self._evict_lru()
+            return True
+
+    def release_spill(self, nbytes: int) -> None:
+        """Return a spill reservation (partition consumed / spill closed)."""
+        if nbytes and self.memory_pool is not None:
+            self.memory_pool.free(nbytes, self.SPILL_TAG)
+
+    def evict_bytes(self, nbytes: int) -> int:
+        """LRU-evict cache entries until ``nbytes`` are freed or the cache is
+        empty (pressure shedding: worker admission refusal and the cluster
+        memory killer both try this rung before anything harsher).  Returns
+        the bytes actually freed."""
+        freed = 0
+        with self._lock:
+            while freed < nbytes and self._entries:
+                oldest = next(iter(self._entries.values()))
+                freed += oldest.nbytes
+                self._evict_lru()
+        return freed
 
     # -- invalidation ----------------------------------------------------------
     def invalidate_catalog(self, catalog: str) -> None:

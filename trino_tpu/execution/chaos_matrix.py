@@ -93,6 +93,156 @@ FAILING = {name: (spec, cache_on)
            if kind == "fail"}
 
 
+# -- memory-pressure matrix (round 11: the tiered-spill ladder) ---------------
+#
+# Each scenario runs the plan on a FRESH tiny-budget executor whose pool
+# forces the Grace/spill paths, with a per-scenario tier configuration and an
+# optional armed fault.  (name, cfg, spec, kind):
+#
+#   cfg["pool_bytes"]  executor MemoryPool capacity (small -> Grace + spill)
+#   cfg["page_cache"]  DeviceBufferPool budget: >0 enables the HBM spill
+#                      tier, 0 disables it (host tier next)
+#   cfg["spill_host"]  TRINO_TPU_SPILL_HOST_BYTES for the scenario (0 forces
+#                      disk; None = pool-limited only)
+#   cfg["expect_tier"] a tier whose per-query counter must be nonzero (the
+#                      forcing actually forced; None = don't care)
+#
+# "recover" pins byte-identical results vs the unconstrained baseline;
+# "fail" pins a typed error (InjectedFaultError / SpillCapacityError).
+# After EVERY scenario the extended leak check must pass: no live spill
+# file, "spill"-tag reservations back to zero in both the executor pool and
+# the scenario buffer pool, no executor-held spill registration.
+_POOL = 1 << 19  # 512KB: forces Grace agg + partitioned join at SF<=0.1
+PRESSURE = [
+    ("tier-hbm", {"pool_bytes": _POOL, "page_cache": 256 << 20,
+                  "spill_host": None, "expect_tier": "hbm"}, None, "recover"),
+    ("tier-host", {"pool_bytes": _POOL, "page_cache": 0,
+                   "spill_host": None, "expect_tier": "host"}, None,
+     "recover"),
+    ("tier-disk", {"pool_bytes": _POOL, "page_cache": 0,
+                   "spill_host": 0, "expect_tier": "disk"}, None, "recover"),
+    ("tier-mixed", {"pool_bytes": _POOL, "page_cache": 1 << 16,
+                    "spill_host": 1 << 16, "expect_tier": "disk"}, None,
+     "recover"),
+    ("hbm-deny-overflows", {"pool_bytes": _POOL, "page_cache": 256 << 20,
+                            "spill_host": None, "expect_tier": None},
+     "point=spill_write,site=spill.hbm,action=deny,every=1", "recover"),
+    ("spill-write-error", {"pool_bytes": _POOL, "page_cache": 0,
+                           "spill_host": 0, "expect_tier": None},
+     "point=spill_write,site=spill.disk,action=error,nth=2", "fail"),
+    ("disk-full", {"pool_bytes": _POOL, "page_cache": 0, "spill_host": 0,
+                   "expect_tier": None},
+     "point=spill_write,site=spill.disk,action=disk_full,nth=1", "fail"),
+    ("read-deny", {"pool_bytes": _POOL, "page_cache": 0,
+                   "spill_host": None, "expect_tier": None},
+     "point=spill_read,action=deny,nth=1", "fail"),
+]
+
+# the pressure query: a q18-style wide GROUP BY (one group per orderkey, the
+# shape whose device group table blows the tiny pool) — the full q18 runs in
+# the slow/capture matrices via QUERIES["q18"]
+PRESSURE_QUERY = """
+    select o_orderkey, count(*) n from orders
+    group by o_orderkey order by n desc, o_orderkey limit 13"""
+
+
+def run_pressure_scenario(engine, plan, baseline_sig, name, cfg, spec, kind,
+                          scratch_dir) -> dict:
+    """One pressure scenario against a compiled ``plan``: fresh tiny-budget
+    executor per cfg, fault armed, outcome + extended leak check folded into
+    the returned record ({"ok": bool, ...}) — shared by
+    tests/test_spill_tiers.py and scripts/chaos.py so the pinned contract
+    and the on-device capture cannot drift."""
+    import contextlib
+    import os
+
+    from ..exec import spill as spill_mod
+    from ..exec.local_executor import LocalExecutor
+    from ..exec.spill import SpillCapacityError
+    from ..execution.bufferpool import DeviceBufferPool
+    from ..memory import MemoryPool
+    from . import faults
+    from .faults import InjectedFaultError
+
+    rec = {"scenario": name, "kind": kind}
+    prev = {k: os.environ.get(k)
+            for k in ("TRINO_TPU_SPILL_HOST_BYTES", "TRINO_TPU_SPILL_DIR")}
+    os.environ["TRINO_TPU_SPILL_DIR"] = scratch_dir
+    if cfg.get("spill_host") is None:
+        os.environ.pop("TRINO_TPU_SPILL_HOST_BYTES", None)
+    else:
+        os.environ["TRINO_TPU_SPILL_HOST_BYTES"] = str(cfg["spill_host"])
+    bp = DeviceBufferPool(budget_bytes=cfg.get("page_cache", 0))
+    ex = LocalExecutor(engine.catalogs,
+                       memory_pool=MemoryPool(max_bytes=cfg["pool_bytes"]),
+                       buffer_pool=bp)
+    try:
+        ctx = faults.injected(spec) if spec else contextlib.nullcontext()
+        with ctx as plan_f:
+            if kind == "fail":
+                try:
+                    ex.execute(plan)
+                    rec["ok"] = False
+                    rec["detail"] = "no error raised"
+                except (InjectedFaultError, SpillCapacityError) as e:
+                    rec["ok"] = True
+                    rec["error_type"] = type(e).__name__
+            else:
+                got = result_signature(ex.execute(plan))
+                rec["ok"] = got == baseline_sig
+                if not rec["ok"]:
+                    rec["detail"] = "result diverged"
+        if spec:
+            rec["fires"] = plan_f.total_fires()
+            if rec["fires"] < 1:
+                rec["ok"] = False
+                rec["detail"] = "scenario never fired"
+        c = ex.counters
+        rec["tiers"] = {t: getattr(c, f"spill_tier_{t}")
+                        for t in ("hbm", "host", "disk")}
+        expect = cfg.get("expect_tier")
+        if kind == "recover" and expect and not rec["tiers"].get(expect):
+            rec["ok"] = False
+            rec["detail"] = f"tier {expect} never engaged: {rec['tiers']}"
+        ex.close_producers()  # the exit-path sweep (error unwinds included)
+        # a join-bearing plan (the real-q18 capture runs) leaves a
+        # PERSISTENT build spill with the compiled stream by design; this
+        # scenario executor is throwaway, so evict through the designed
+        # path first — then every check below may stay strict
+        ex.forget_plan(plan)
+        leaks = []
+        if ex._spills:
+            leaks.append("executor-held-spills")
+        n = ex.memory_pool.info()["by_tag"].get("spill", 0)
+        if n:
+            leaks.append(f"spill-reservation:{n}")
+        if bp.memory_pool is not None:
+            nb = bp.memory_pool.info()["by_tag"].get(
+                DeviceBufferPool.SPILL_TAG, 0)
+            if nb:
+                leaks.append(f"hbm-spill-reservation:{nb}")
+        files = spill_mod.live_spill_files()
+        if files:
+            leaks.append(f"live-spill-files:{len(files)}")
+        leftover = [f for f in os.listdir(scratch_dir)] \
+            if os.path.isdir(scratch_dir) else []
+        if leftover:
+            leaks.append(f"orphaned-spill-files:{leftover}")
+        if leaks:
+            rec["ok"] = False
+            rec["leaks"] = leaks
+    except Exception as e:  # scenario harness failure
+        rec["ok"] = False
+        rec["detail"] = f"{type(e).__name__}: {e}"
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return rec
+
+
 def result_signature(result):
     """Byte-level result signature (dtype + raw bytes per column; object
     columns — decoded strings — by value)."""
@@ -122,17 +272,35 @@ def settle(timeout: float = 8.0) -> list:
 def leak_report(engine, timeout: float = 8.0) -> list:
     """The post-scenario contract, as a list of violations (empty = clean):
     no surviving prefetch-producer thread, zero residual in-flight entries,
-    no executor holding a live producer registration, and buffer-pool
+    no executor holding a live producer registration, buffer-pool
     reservations exactly equal to its resident bytes (an orphaned
     reservation — store failed after reserving — or an unaccounted partial
-    page breaks the equality)."""
+    page breaks the equality), and (round 11) spill hygiene: no live spill
+    file, no executor-held per-query spill, every "spill"-tagged
+    reservation released.  Persistent join-build spills ("spill-build" tag)
+    legitimately survive with their cached streams and are exempt."""
     leftovers = settle(timeout)
     for ex in getattr(engine, "_all_executors", []):
         if ex._producers:
             leftovers.append("executor-held-producers")
+        if [sp for sp in getattr(ex, "_spills", ())
+                if not getattr(sp, "persistent", False)]:
+            leftovers.append("executor-held-spills")
+        pool = getattr(ex, "memory_pool", None)
+        if pool is not None:
+            n = pool.info()["by_tag"].get("spill", 0)
+            if n:
+                leftovers.append(f"spill-reservation:{n}")
     bp = engine.buffer_pool
     pool = bp.memory_pool
     if pool is not None and pool.reserved != bp.info()["bytes"]:
+        # the equality also catches an unreleased HBM-tier spill
+        # reservation: spill bytes never become resident cache entries
         leftovers.append(f"pool-reservation-mismatch:{pool.reserved}!="
                          f"{bp.info()['bytes']}")
+    from ..exec.spill import live_spill_files
+
+    files = live_spill_files()
+    if files:
+        leftovers.append(f"live-spill-files:{len(files)}")
     return leftovers

@@ -62,6 +62,16 @@ Injection points (the ``point`` vocabulary)::
     exchange_read  exec/fte.SpoolingExchange.read
     task           server/cluster worker task body
     reserve        memory.MemoryPool.try_reserve
+    spill_write    exec/spill tier admission/write (site spill.hbm/host/disk)
+    spill_read     exec/spill partition readback (site spill.<tier>.read)
+
+Round 11 adds the spill ladder's points and the ``disk_full`` action: a
+``deny`` at ``spill_write`` makes that TIER refuse (the chunk overflows to
+the next rung — recoverable by construction), while ``disk_full`` at the
+disk tier (the last rung) surfaces as the typed
+``exec.spill.SpillCapacityError``; at ``spill_read`` any non-raising action
+is enacted as a typed read failure (the data is only in that tier —
+there is nothing to fall back to locally).
 """
 
 from __future__ import annotations
@@ -79,9 +89,10 @@ __all__ = ["InjectedFaultError", "FatalInjectedFaultError", "FaultRule",
 
 POINTS = ("dispatch", "host_pull", "generate", "h2d", "cache_store",
           "cache_checkout", "exchange_write", "exchange_read", "task",
-          "reserve")
+          "reserve", "spill_write", "spill_read")
 
-ACTIONS = ("error", "fatal", "delay", "drop", "deny", "kill_worker")
+ACTIONS = ("error", "fatal", "delay", "drop", "deny", "kill_worker",
+           "disk_full")
 
 
 class InjectedFaultError(RuntimeError):
@@ -214,8 +225,8 @@ class FaultPlan:
         chokepoint tag; ``label`` the composed "<Op>#<k>/<site>" form when an
         operator scope is active — a rule's site glob may address either.
         Raises for error/fatal actions, sleeps for delay, returns
-        "drop"/"deny"/"kill_worker" for the chokepoint to enact (first such
-        action wins), else None."""
+        "drop"/"deny"/"kill_worker"/"disk_full" for the chokepoint to enact
+        (first such action wins), else None."""
         fired: list = []
         with self._lock:
             for r in self.rules:
@@ -256,7 +267,7 @@ class FaultPlan:
             if r.action == "delay":
                 time.sleep(r.seconds)
             elif result is None:
-                result = r.action  # drop | deny | kill_worker
+                result = r.action  # drop | deny | kill_worker | disk_full
         return result
 
     def stats(self) -> list:

@@ -65,9 +65,29 @@ class ResourceGroupManager:
     returned start callback when admitted (reference:
     InternalResourceGroupManager.submit, dispatcher/DispatchManager.java:256)."""
 
-    def __init__(self, root: Optional[ResourceGroup] = None):
+    def __init__(self, root: Optional[ResourceGroup] = None,
+                 admission_gate: Optional[Callable[[], bool]] = None):
         self.root = root or ResourceGroup("global")
         self._lock = threading.Lock()
+        # memory-pressure admission gate (round 11, the escalation ladder's
+        # "deny admission" rung): a callable returning False while the node
+        # should DEFER new admissions (engine pools blocked).  Deferral only
+        # engages while something is running — every finish() re-drains the
+        # queue, so progress is guaranteed and an idle tree always admits
+        # (queueing with nothing running would deadlock the queue).
+        self.admission_gate = admission_gate
+        self.memory_queued_total = 0  # lifetime count of gate deferrals
+
+    def _gate_blocks(self) -> bool:
+        """Caller holds the lock.  True = defer admission (memory pressure
+        with work still running that will drain the queue)."""
+        gate = self.admission_gate
+        if gate is None or self.root._total_running() == 0:
+            return False
+        try:
+            return not gate()
+        except Exception:  # a broken gate must never wedge admission
+            return False
 
     def get_or_create(self, path: str, **kw) -> ResourceGroup:
         g = self.root
@@ -77,18 +97,27 @@ class ResourceGroupManager:
         return g
 
     def submit(self, group: ResourceGroup, start: Callable[[], None],
-               queued: Optional[Callable[[], None]] = None) -> None:
-        """Run `start` now if the group tree has capacity, else queue it
-        (FIFO within a group, weighted-fair across groups).  Raises
-        QueryQueueFullError beyond max_queued."""
+               queued: Optional[Callable[[], None]] = None,
+               queued_on_memory: Optional[Callable[[], None]] = None) -> None:
+        """Run `start` now if the group tree has capacity AND the admission
+        gate passes, else queue it (FIFO within a group, weighted-fair
+        across groups).  ``queued_on_memory`` fires additionally when the
+        MEMORY gate (not concurrency) caused the deferral — the ladder's
+        per-query rung record.  Raises QueryQueueFullError beyond
+        max_queued."""
         with self._lock:
-            if group._can_run_more():
+            gate_blocked = self._gate_blocks()
+            if group._can_run_more() and not gate_blocked:
                 group._running += 1
             else:
                 if len(group._queue) >= group.max_queued:
                     raise QueryQueueFullError(
                         f"Too many queued queries for \"{group.full_name}\"")
                 group._queue.append(start)
+                if gate_blocked:
+                    self.memory_queued_total += 1
+                    if queued_on_memory is not None:
+                        queued_on_memory()
                 if queued is not None:
                     queued()
                 return
@@ -111,6 +140,9 @@ class ResourceGroupManager:
     def _next_runnable(self, group: ResourceGroup):
         """Weighted-fair pick: among eligible groups with queued queries, choose
         the one with the lowest running/weight ratio (reference: WeightedFairQueue)."""
+        if self._gate_blocks():
+            return None  # memory still blocked with work running: the next
+            # finish() (freed memory) re-drains; running==0 always drains
         best = None
         stack = [group]
         while stack:

@@ -20,7 +20,8 @@ from typing import Optional
 __all__ = ["Span", "Tracer", "NOOP_TRACER", "QueryCounters", "track_counters",
            "current_counters", "record_dispatch", "record_host_pull",
            "record_coalesced", "record_page_cache", "record_build_cache",
-           "record_fault", "record_task_retry",
+           "record_fault", "record_task_retry", "record_spill",
+           "SPILL_TIERS",
            "LatencyHistogram", "LATENCY_BUCKETS_S",
            "operator_scope", "activate_tracer", "current_tracer",
            "maybe_span", "span_dict", "spans_to_otlp",
@@ -173,6 +174,19 @@ class QueryCounters:
     # re-dispatches) charged to the query that paid them.
     faults_injected: int = 0
     task_retries: int = 0
+    # round 11: the memory-pressure escalation ladder.  spilled_bytes is the
+    # total the tiered spill (exec/spill.SpilledPartitions) routed out of the
+    # operator's working set, broken down by the tier each chunk landed in
+    # (hbm = device-resident under the buffer pool's budget — no readback
+    # staging; host = RAM under the executor pool's "spill" tag; disk =
+    # zstd-framed files in TRINO_TPU_SPILL_DIR).  admission_queued counts
+    # queries the engine deferred at admission because executor pools sat
+    # blocked (ladder rung: deny admission before anything is killed).
+    spilled_bytes: int = 0
+    spill_tier_hbm: int = 0
+    spill_tier_host: int = 0
+    spill_tier_disk: int = 0
+    admission_queued: int = 0
     # "<operator>/<site>" -> {"dispatches", "transfers", "bytes"} plus any
     # cache keys the site recorded: the attribution EXPLAIN ANALYZE prints
     # and budget failures dump
@@ -183,7 +197,9 @@ class QueryCounters:
     _INT_FIELDS = ("device_dispatches", "host_transfers", "host_bytes_pulled",
                    "coalesced_splits", "page_cache_hits", "page_cache_misses",
                    "page_cache_bytes_saved", "build_cache_hits",
-                   "faults_injected", "task_retries")
+                   "faults_injected", "task_retries",
+                   "spilled_bytes", "spill_tier_hbm", "spill_tier_host",
+                   "spill_tier_disk", "admission_queued")
 
     def reset(self) -> None:
         for f in self._INT_FIELDS:
@@ -439,6 +455,25 @@ def record_fault(site: Optional[str] = None) -> None:
     if c is not None:
         c.faults_injected += 1
     _attribute_extra(site, faults_injected=1)
+
+
+SPILL_TIERS = ("hbm", "host", "disk")  # the ladder's tier vocabulary: the
+# spill_tier_<t> counter fields and the /v1/metrics tier labels
+
+
+def record_spill(tier: str, nbytes: int, site: Optional[str] = None) -> None:
+    """One tiered-spill chunk admission (exec/spill): ``nbytes`` landed in
+    ``tier`` (one of SPILL_TIERS).  Attributed like boundary records so
+    EXPLAIN ANALYZE's site table names which operator spilled where.
+    NOTE the admission_queued counter has no record_ helper on purpose: the
+    deferral happens before any counters context exists, so the engine
+    stamps it onto the finished query's snapshot directly (execute_sql)."""
+    c = getattr(_counter_local, "counters", None)
+    if c is not None:
+        c.spilled_bytes += nbytes
+        field = f"spill_tier_{tier}"
+        setattr(c, field, getattr(c, field, 0) + nbytes)
+    _attribute_extra(site or f"spill.{tier}", spilled_bytes=nbytes)
 
 
 def record_task_retry(n: int = 1, site: Optional[str] = None) -> None:

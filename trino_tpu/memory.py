@@ -5,8 +5,9 @@ memory/context/), the node-level pool with per-query tracking
 (memory/MemoryPool.java:46), and the revocation trigger
 (execution/MemoryRevokingScheduler.java).  The TPU translation: the scarce
 resource is HBM; "spill" means switching an operator to its partitioned
-re-streaming strategy (Grace agg/join) instead of writing state to disk — the
-pool's job is to say WHEN, before an XLA allocation fails.
+re-streaming strategy (Grace agg/join) whose buffers then walk the tiered
+ladder (exec/spill: HBM -> host RAM under this pool's "spill" tag -> disk) —
+the pool's job is to say WHEN, before an XLA allocation fails.
 """
 
 from __future__ import annotations
@@ -181,6 +182,15 @@ class MemoryPool:
     def free_bytes(self) -> int:
         with self._lock:
             return self.max_bytes - self.reserved
+
+    def blocked(self, fraction: float) -> bool:
+        """Is this pool past ``fraction`` of capacity?  The one definition of
+        "blocked" the escalation ladder's rungs share: worker task admission
+        (server/cluster), the engine's admission gate (queue new queries
+        under pressure) and the cluster low-memory killer all read it."""
+        with self._lock:
+            return bool(self.max_bytes) \
+                and self.reserved > fraction * self.max_bytes
 
     def by_query(self) -> dict:
         with self._lock:

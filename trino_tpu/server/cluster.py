@@ -412,6 +412,8 @@ class WorkerServer:
         # control at the task boundary)
         self.max_concurrent_tasks = 8
         self.memory_admission_fraction = 0.9  # refuse tasks past this pool use
+        self.admission_denials = 0  # tasks refused at the memory rung
+        self.cache_sheds = 0  # buffer-pool evictions forced by pressure
         self._draining = False  # graceful shutdown: no NEW work, finish running
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._stop = threading.Event()
@@ -560,6 +562,17 @@ class WorkerServer:
                         return self._reply(403, {"error": "bad signature"})
                     worker.memory_pool.kill_query(req["query_key"])
                     return self._reply(200, {"killed": req["query_key"]})
+                if self.path == "/v1/evict_cache":
+                    # the coordinator's pre-kill rung: shed this node's
+                    # device buffer pool (cache is droppable; victims are
+                    # not) before the low-memory killer picks anyone
+                    req = self._read_verified()
+                    if req is None:
+                        return self._reply(403, {"error": "bad signature"})
+                    freed = worker.buffer_pool.evict_bytes(1 << 62)
+                    if freed:
+                        worker.cache_sheds += 1
+                    return self._reply(200, {"freed_bytes": freed})
                 if self.path.startswith("/v1/task/") \
                         and self.path.endswith("/abandon"):
                     # /v1/task/{tid}/results/{reader}/abandon — a consumer
@@ -677,9 +690,14 @@ class WorkerServer:
                 raise _WorkerBusy()
             # memory-aware admission (the node half of the reference's
             # ClusterMemoryManager: a nearly-full pool refuses work instead of
-            # OOMing it; the coordinator re-offers elsewhere)
-            if self.memory_pool.reserved > \
-                    self.memory_admission_fraction * self.memory_pool.max_bytes:
+            # OOMing it; the coordinator re-offers elsewhere).  Ladder order:
+            # shed this node's device cache FIRST (rung 1 — cached pages
+            # share the accelerator with live query state even though their
+            # budgets are separate pools), THEN refuse (rung: deny admission)
+            if self.memory_pool.blocked(self.memory_admission_fraction):
+                if self.buffer_pool.evict_bytes(1 << 62):
+                    self.cache_sheds += 1
+                self.admission_denials += 1
                 raise _WorkerBusy()
             self._running_tasks += 1
             self.tasks[tid] = st = _TaskState()
@@ -969,6 +987,13 @@ class ClusterCoordinator:
         self._blocked_streak = 0
         self.oom_kills = 0  # observability: victims chosen
         self.last_oom_victim: Optional[str] = None
+        # the escalation ladder's record (round 11): per-pass rung decisions
+        # ({"rung": "evict-cache"|"kill", ...}, bounded) and the rung each
+        # affected query landed on (victims -> "kill") — "the chosen rung
+        # recorded per query"; spill/queue rungs live on the query counters
+        self.pressure_events: list = []
+        self.query_pressure_rung: dict = {}
+        self._pressure_cap = 64
         self.engine = engine
         self.spool_dir = spool_dir
         self.secret = secret if secret is not None \
@@ -1205,9 +1230,15 @@ class ClusterCoordinator:
             self._stop.wait(self.heartbeat_interval)
 
     def _run_memory_killer(self) -> None:
-        """One ClusterMemoryManager pass: blocked nodes for two consecutive
-        heartbeats -> ask the policy for a victim -> poison it on every live
-        worker (reference: ClusterMemoryManager.java:92 callOomKiller)."""
+        """One ClusterMemoryManager pass, walking the escalation ladder:
+        blocked nodes for one heartbeat -> wait (Grace fallbacks + the
+        workers' own spill tiers get a beat); two -> ask the blocked nodes
+        to SHED THEIR DEVICE CACHES (evict, the cheapest rung); three ->
+        only then ask the policy for a victim and poison it on every live
+        worker (reference: ClusterMemoryManager.java:92 callOomKiller —
+        eviction + spill + queueing must have failed to free enough before
+        anyone dies).  Each rung decision is recorded on pressure_events,
+        and a victim's rung lands in query_pressure_rung."""
         from ..execution.memory_killer import BLOCKED_FRACTION
 
         with self._lock:
@@ -1222,7 +1253,24 @@ class ClusterCoordinator:
             self._blocked_streak = 0
             return
         self._blocked_streak += 1
-        if self._blocked_streak < 2:  # debounce: give Grace fallbacks a beat
+        if self._blocked_streak < 2:  # debounce: give Grace/spill a beat
+            return
+        if self._blocked_streak == 2:
+            # rung: evict — shed the blocked nodes' buffer pools.  This
+            # frees real device memory (the cache's labeled pool, not the
+            # executor pool the blocked signal reads), so it relieves HBM
+            # headroom for running queries and buys one more heartbeat of
+            # debounce; an executor pool still blocked at streak 3 holds
+            # LIVE per-query state that only a kill can free — the kill
+            # proceeding then is correct, not a failed eviction
+            self._record_pressure({"rung": "evict-cache",
+                                   "nodes": [n["node_id"] for n in blocked]})
+            for n in blocked:
+                try:
+                    _http(f"{n['url']}/v1/evict_cache", pickle.dumps({}),
+                          secret=self.secret)
+                except Exception:
+                    pass  # an unreachable node is the failure detector's job
             return
         victim = self.low_memory_killer.pick_victim(nodes)
         if victim is None:
@@ -1231,6 +1279,7 @@ class ClusterCoordinator:
         with self._lock:
             self.oom_kills += 1
             self.last_oom_victim = victim
+        self._record_pressure({"rung": "kill", "query": victim})
         for n in nodes:
             try:
                 _http(f"{n['url']}/v1/kill_query",
@@ -1238,6 +1287,19 @@ class ClusterCoordinator:
                       secret=self.secret)
             except Exception:
                 pass  # a dead worker frees its memory with its process
+
+    def _record_pressure(self, event: dict) -> None:
+        import time as _time
+
+        with self._lock:
+            event = dict(event, at=_time.time())
+            self.pressure_events.append(event)
+            del self.pressure_events[:-self._pressure_cap]
+            if event["rung"] == "kill":
+                self.query_pressure_rung[event["query"]] = "kill"
+                while len(self.query_pressure_rung) > self._pressure_cap:
+                    self.query_pressure_rung.pop(
+                        next(iter(self.query_pressure_rung)))
 
     def live_workers(self) -> list:
         """Schedulable workers: alive, not draining, and not DEGRADED (a

@@ -570,6 +570,28 @@ class CoordinatorServer:
                 "# TYPE trino_tpu_task_retries_total counter",
                 f"trino_tpu_task_retries_total "
                 f"{getattr(ct, 'task_retries', 0)}",
+                # round 11: the memory-pressure ladder.  Bytes the tiered
+                # spill routed out of operator working sets, per tier (hbm =
+                # device-resident, host = RAM under the "spill" tag, disk =
+                # codec-framed files), and admissions deferred at the queue
+                # rung.
+                "# HELP trino_tpu_spilled_bytes_total Bytes spilled by "
+                "Grace-partitioned operators, by destination tier.",
+                "# TYPE trino_tpu_spilled_bytes_total counter",
+            ]
+            from ..execution.tracing import SPILL_TIERS
+
+            for tier in SPILL_TIERS:
+                lines.append(
+                    f'trino_tpu_spilled_bytes_total{{tier="{tier}"}} '
+                    f'{getattr(ct, f"spill_tier_{tier}", 0)}')
+            lines += [
+                "# HELP trino_tpu_admission_queued_total Queries deferred "
+                "at admission under memory pressure (ladder rung: queue "
+                "before kill).",
+                "# TYPE trino_tpu_admission_queued_total counter",
+                f"trino_tpu_admission_queued_total "
+                f"{getattr(ct, 'admission_queued', 0)}",
             ]
             sites = getattr(ct, "sites", None) or {}
             if sites:

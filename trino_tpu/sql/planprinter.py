@@ -39,6 +39,19 @@ def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
         if fi or tr:
             boundary_line += f", {fi} faults injected, {tr} task retries"
         lines.append(boundary_line)
+        sp = getattr(counters, "spilled_bytes", 0)
+        aq = getattr(counters, "admission_queued", 0)
+        if sp or aq:
+            # the escalation ladder is self-describing: which tier the
+            # spilled bytes landed in, and whether admission deferred the
+            # query first (zero everywhere = no line, budget-suite regexes
+            # and non-spilling EXPLAINs unchanged)
+            lines.append(
+                f"Spill: {sp} bytes "
+                f"(hbm {getattr(counters, 'spill_tier_hbm', 0)}, "
+                f"host {getattr(counters, 'spill_tier_host', 0)}, "
+                f"disk {getattr(counters, 'spill_tier_disk', 0)}), "
+                f"{aq} admissions queued")
         pc_h = getattr(counters, "page_cache_hits", 0)
         pc_m = getattr(counters, "page_cache_misses", 0)
         bc_h = getattr(counters, "build_cache_hits", 0)
@@ -130,10 +143,15 @@ def _fmt(node: P.PlanNode, lines: list, depth: int, stats: dict,
         # row counts may still live on device (deferred device->host sync)
         lines[before] += f"  [rows: {int(s['rows'])}, {s['wall_s'] * 1000:.1f} ms]"
         if s.get("spilled_bytes"):
-            # the host-RAM spill tier ran (reference: operator spill metrics
-            # in OperatorStats — spilledDataSize)
+            # the tiered spill ran (reference: operator spill metrics in
+            # OperatorStats — spilledDataSize); tiers show where the bytes
+            # landed on the HBM -> host -> disk ladder
             lines[before] += (f" [spilled: {s['spilled_bytes'] / 1e6:.1f} MB, "
                               f"{s['spill_partitions']} partitions]")
+            tiers = s.get("spill_tiers")
+            if tiers and any(tiers.values()):
+                inner = ", ".join(f"{t} {b}" for t, b in tiers.items() if b)
+                lines[before] += f" [tiers: {inner}]"
         if s.get("index_join_keys"):
             # the probe scan collapsed to a connector keyed lookup
             lines[before] += f" [index lookup: {s['index_join_keys']} keys]"
