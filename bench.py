@@ -258,6 +258,12 @@ class _BudgetExceeded(Exception):
 # be DETERMINISTIC warm-path quantities — growth means a real code change)
 WALL_REGRESSION_RATIO = 1.2
 BUDGET_COUNTERS = ("device_dispatches", "host_transfers", "host_bytes_pulled")
+# cache-effectiveness counters diffed for VISIBILITY, never flagged: hit
+# deltas between captures are configuration (budgets, order), not
+# regressions — but a result-cache hit appearing here at all means the tier
+# leaked into an execute-path measurement (see the RESULT-CACHE pin in main)
+CACHE_COUNTERS = ("page_cache_hits", "page_cache_misses",
+                  "result_cache_hits", "result_cache_misses")
 
 
 def _baseline_diff(base_pq: dict, now_pq: dict) -> dict:
@@ -289,6 +295,11 @@ def _baseline_diff(base_pq: dict, now_pq: dict) -> dict:
             d[k] = {"base": bv, "now": nv}
             if nv > bv:
                 flags.append(f"{k} {bv} -> {nv}")
+        for k in CACHE_COUNTERS:
+            bv, nv = b.get(k), n.get(k)
+            if bv is None and nv is None:
+                continue
+            d[k] = {"base": bv, "now": nv}
         d["flags"] = flags
         queries[q] = d
         if flags:
@@ -313,6 +324,11 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.no_page_cache:
         os.environ["TRINO_TPU_PAGE_CACHE"] = "0"
+    # the RESULT cache (round 12) stays off unless a capture explicitly sets
+    # the env: this benchmark measures the EXECUTE path, and with the tier
+    # on every warm timed run would be answered from the cache in ~0 time
+    # (bench_serve.py is where that is measured on purpose)
+    os.environ.setdefault("TRINO_TPU_RESULT_CACHE", "0")
 
     deadline = time.monotonic() + BUDGET
     remaining = lambda: deadline - time.monotonic()

@@ -149,6 +149,35 @@ def main() -> int:
                 import shutil
 
                 shutil.rmtree(scratch, ignore_errors=True)
+        # round 12: the result-cache matrix — needs its OWN result-enabled
+        # engine (enabling the tier on the main engine would serve the warm
+        # statements from cache and the dispatch/generate fault classes
+        # above would never fire)
+        from trino_tpu.execution.bufferpool import DeviceBufferPool
+        from trino_tpu.execution.chaos_matrix import (RESULT_SCENARIOS,
+                                                      run_result_scenario)
+
+        if time.time() - t_start > budget:
+            skipped += len(RESULT_SCENARIOS)
+        else:
+            reng = Engine()
+            reng.buffer_pool = DeviceBufferPool(budget_bytes=1 << 30,
+                                                result_budget_bytes=256 << 20)
+            reng.register_catalog("tpch",
+                                  TpchConnector(sf=sf, split_rows=split_rows))
+            rsess = reng.create_session("tpch")
+            rsql = QUERIES[names[0]]
+            reng.execute_sql(rsql, rsess)  # cold
+            rbase = _sig(reng.execute_sql(rsql, rsess))
+            for (name, spec, kind) in RESULT_SCENARIOS:
+                if time.time() - t_start > budget:
+                    skipped += 1
+                    continue
+                rec = run_result_scenario(reng, rsql, rsess, rbase, name,
+                                          spec, kind)
+                rec["query"] = names[0]
+                payload["scenarios"].append(rec)
+                done += 1
         total = len(payload["scenarios"])
         passed = sum(1 for r in payload["scenarios"] if r.get("ok"))
         payload["value"] = (passed / total) if total else 0.0

@@ -55,6 +55,14 @@ def main():
                          "budget ceilings derive with the cache ON — run "
                          "once with the budget the test fixture sets and "
                          "once with 0 for the A/B the docstring records")
+    ap.add_argument("--result-cache", type=int, default=0, metavar="BYTES",
+                    help="result-cache tier budget (TRINO_TPU_RESULT_CACHE) "
+                         "for this trace.  DEFAULT 0 — the budget ceilings "
+                         "in tests/test_query_budgets.py pin the EXECUTE "
+                         "path and their fixture forces the tier off; a "
+                         "warm run with the tier on costs 0 dispatches "
+                         "(that's bench_serve.py's measurement, not this "
+                         "one's)")
     ap.add_argument("--sites", action="store_true",
                     help="print each warm query's per-site attribution table "
                          "(operator/call-site -> dispatches, transfers, "
@@ -64,6 +72,7 @@ def main():
 
     if args.page_cache is not None:
         os.environ["TRINO_TPU_PAGE_CACHE"] = str(args.page_cache)
+    os.environ["TRINO_TPU_RESULT_CACHE"] = str(args.result_cache)
     sf = float(os.environ.get("TRACE_SF", "1"))
     split_rows = int(os.environ.get("TRACE_SPLIT_ROWS", str(1 << 21)))
     names = [q.strip() for q in

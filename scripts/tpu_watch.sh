@@ -88,6 +88,17 @@ for i in $(seq 1 250); do
       > scripts/chaos_r10.json 2> scripts/chaos_r10.log
     rc=$?
     echo "$(date -Is) chaos rc=$rc : $(tail -c 300 scripts/chaos_r10.json)" >> "$LOG"
+    # round-12 serving A/B: concurrent mixed load against the coordinator
+    # HTTP protocol, result cache off vs on (bench_serve runs BOTH halves
+    # in one invocation and embeds per-class p50/p99 + hit rates + the
+    # zero-dispatch verification) — the first on-device datum for ROADMAP
+    # item 4's "serve traffic" goal.  Cheap relative to the SF100 tail, so
+    # it runs before the spill/SF100 captures.
+    SERVE_SF=1 SERVE_DURATION=60 SERVE_CLIENTS=4 SERVE_QPS=8 \
+      SERVE_BUDGET=900 TRINO_TPU_SCAN_FUSED=0 \
+      timeout -k 60 1200 python bench_serve.py \
+      > scripts/bench_serve_r12.json 2> scripts/bench_serve_r12.log
+    echo "$(date -Is) serve A/B rc=$? : $(tail -c 300 scripts/bench_serve_r12.json 2>/dev/null)" >> "$LOG"
     # round-11 forced-spill A/B: q18 SF1 unconstrained vs TINY pool budgets
     # (page cache shrunk to force the spill ladder's HBM tier, host watermark
     # down to overflow into disk) — prices each tier's round-trip/wall cost
@@ -137,6 +148,10 @@ try:
     out["chaos"] = json.load(open("scripts/chaos_r10.json"))
 except Exception as e:
     out["chaos"] = {"error": str(e)}
+try:
+    out["serve"] = json.load(open("scripts/bench_serve_r12.json"))
+except Exception as e:
+    out["serve"] = {"error": str(e)}
 for name in ("sf1_spill", "sf100_q18"):
     try:
         out[name] = json.load(open(f"scripts/bench_{name}.json"))

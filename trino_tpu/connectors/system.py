@@ -37,6 +37,9 @@ SCHEMAS = {
         # snapshot afterwards; elapsed_s ticks from creation
         Field("device_dispatches", BIGINT), Field("host_bytes_pulled", BIGINT),
         Field("elapsed_s", DOUBLE),
+        # round 12: statements answered whole from the buffer pool's result
+        # tier mark themselves (result_cache_hits > 0 => zero dispatches)
+        Field("result_cache_hits", BIGINT),
     )),
     "nodes": Schema((
         Field("node_id", _V), Field("http_uri", _V), Field("node_version", _V),
@@ -159,7 +162,8 @@ class SystemConnector:
                 out.append((i.query_id, i.state, i.user, i.catalog, i.resource_group,
                             i.sql, i.rows, i.queued_s, i.wall_s, i.error,
                             c.get("device_dispatches"),
-                            c.get("host_bytes_pulled"), i.elapsed_s))
+                            c.get("host_bytes_pulled"), i.elapsed_s,
+                            c.get("result_cache_hits")))
             return out
         if table == "nodes":
             import jax

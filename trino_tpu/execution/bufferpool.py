@@ -23,6 +23,20 @@ Two tiers, one LRU:
   plan_versions of the catalogs it reads.  Checked out tables thread through
   ``_Stream.aux`` as JIT ARGUMENTS (the no-closed-over-aux rule) exactly like
   freshly built ones.
+- **Result tier (round 12)** — completed ``MaterializedResult``s keyed on
+  (structural plan fingerprint, catalogs, plan-shaping session props): a
+  repeated dashboard-style statement is answered with ZERO device
+  dispatches, zero executor checkout, and zero host pulls.  Entries are
+  host-resident (numpy result columns), but accounting still rides this
+  pool's labeled MemoryPool (tag ``result-cache``) so /v1/status, the
+  metrics gauges and the leak checks see them next to the device tiers.
+  The tier has its OWN byte budget (``TRINO_TPU_RESULT_CACHE``; unset = 0
+  everywhere — results are host memory, there is no HBM fraction to steal,
+  and bench.py must keep measuring the execute path unless a capture
+  explicitly opts in) and a per-entry size cap
+  (``TRINO_TPU_RESULT_CACHE_MAX_ENTRY``, default budget/4).  Admission
+  policy (deterministic plans only, no volatile functions, cacheable
+  connectors) is the ENGINE's job — the pool stores what it is handed.
 
 Reservations flow through a private labeled :class:`~..memory.MemoryPool`
 (visible in ``/v1/status`` and ``/v1/metrics`` as pool "buffer-pool");
@@ -45,7 +59,7 @@ from typing import Optional
 
 from . import faults
 
-__all__ = ["DeviceBufferPool", "page_cache_budget"]
+__all__ = ["DeviceBufferPool", "page_cache_budget", "result_cache_budget"]
 
 
 def page_cache_budget() -> int:
@@ -68,6 +82,48 @@ def page_cache_budget() -> int:
     from ..memory import device_memory_budget
 
     return device_memory_budget(0.25)
+
+
+def result_cache_budget() -> int:
+    """Result-tier byte budget: TRINO_TPU_RESULT_CACHE (plain bytes; 0
+    disables), unset = 0 on EVERY backend.  Unlike the page tier there is no
+    accelerator default: result entries live in host RAM (no HBM fraction to
+    derive a default from) and an implicit default would silently turn
+    bench.py's warm runs into cache hits — serving deployments opt in
+    explicitly."""
+    import os
+
+    raw = os.environ.get("TRINO_TPU_RESULT_CACHE")
+    if raw is None:
+        return 0
+    try:
+        return max(int(raw), 0)
+    except ValueError:
+        return 0
+
+
+def _result_nbytes(result) -> int:
+    """Host bytes a cached MaterializedResult pins (decoded + raw columns,
+    deduped by identity — non-decoded columns ALIAS their raw array, and
+    double-counting them would halve the tier's effective capacity).
+    Object (string) columns estimate per-value payload + pointer overhead —
+    a conservative over-count, like _table_nbytes."""
+    import numpy as np
+
+    total = 0
+    seen: set = set()
+    for cols in (result.columns, result.raw_columns):
+        for c in cols:
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            a = np.asarray(c)
+            if a.dtype == object:
+                total += 8 * a.size + sum(
+                    len(str(v)) for v in a.ravel() if v is not None)
+            else:
+                total += a.nbytes
+    return total
 
 
 def _page_nbytes(page) -> int:
@@ -114,7 +170,7 @@ class _Entry:
     __slots__ = ("kind", "catalog", "table", "payload", "nbytes")
 
     def __init__(self, kind, catalog, table, payload, nbytes):
-        self.kind = kind  # "page" | "build"
+        self.kind = kind  # "page" | "build" | "result"
         self.catalog = catalog
         self.table = table  # per-table breakdown / invalidation ("" for
         # multi-table build fragments — they invalidate via clear()/versions)
@@ -129,10 +185,27 @@ class DeviceBufferPool:
 
     PAGE_TAG = "page-cache"
     BUILD_TAG = "build-cache"
+    RESULT_TAG = "result-cache"
     SPILL_TAG = "spill"
 
-    def __init__(self, budget_bytes: Optional[int] = None):
+    def __init__(self, budget_bytes: Optional[int] = None,
+                 result_budget_bytes: Optional[int] = None):
         self._budget = budget_bytes  # None = resolve lazily from env/backend
+        self._result_budget = result_budget_bytes  # None = lazy from env
+        # per-tier-group resident bytes: the shared MemoryPool's max is the
+        # SUM of both budgets, so each group enforces its own sub-budget —
+        # device entries (page/build, plus spill reservations) may never
+        # expand into the result budget's headroom (that would over-commit
+        # HBM) and host-resident results may never displace device entries
+        self._result_bytes = 0
+        self._device_bytes = 0
+        # invalidation epoch: clear()/invalidate_catalog bump it, and a
+        # result store presents the epoch its statement STARTED under — a
+        # DML that invalidated mid-execution makes the late store a no-op
+        # (the entry would otherwise outlive the invalidation that should
+        # have covered it; connectors without plan_version have no other
+        # staleness defense)
+        self.epoch = 0
         self._lock = threading.RLock()
         self._entries: OrderedDict = OrderedDict()  # key -> _Entry (LRU)
         self.memory_pool = None  # created when the budget resolves nonzero
@@ -142,6 +215,8 @@ class DeviceBufferPool:
         self.misses = 0
         self.build_hits = 0
         self.build_misses = 0
+        self.result_hits = 0
+        self.result_misses = 0
         self.evictions = 0
 
     # -- gating ----------------------------------------------------------------
@@ -155,6 +230,30 @@ class DeviceBufferPool:
     def enabled(self) -> bool:
         return self.budget() > 0
 
+    def result_budget(self) -> int:
+        with self._lock:
+            if self._result_budget is None:
+                self._result_budget = result_cache_budget()
+            return self._result_budget
+
+    @property
+    def result_enabled(self) -> bool:
+        return self.result_budget() > 0
+
+    def result_entry_cap(self) -> int:
+        """Per-entry admission cap for the result tier: a single giant result
+        (a full-table SELECT) must not monopolize — or thrash — the budget.
+        TRINO_TPU_RESULT_CACHE_MAX_ENTRY overrides; default budget/4."""
+        import os
+
+        raw = os.environ.get("TRINO_TPU_RESULT_CACHE_MAX_ENTRY")
+        if raw is not None:
+            try:
+                return max(int(raw), 0)
+            except ValueError:
+                pass
+        return max(self.result_budget() // 4, 1)
+
     @staticmethod
     def cacheable(conn) -> bool:
         """Only connectors whose page generation is deterministic for a given
@@ -167,8 +266,19 @@ class DeviceBufferPool:
         if self.memory_pool is None:
             from ..memory import MemoryPool
 
-            self.memory_pool = MemoryPool(max_bytes=self.budget())
+            # one labeled pool spans the device tiers AND the host-resident
+            # result tier: the result tier's own sub-budget (checked in
+            # put_result) keeps host entries from displacing device entries,
+            # while the shared pool keeps every tier visible/leak-checkable
+            # under one reserved==resident invariant
+            self.memory_pool = MemoryPool(
+                max_bytes=self.budget() + self.result_budget())
         return self.memory_pool
+
+    @classmethod
+    def _tag_of(cls, kind: str) -> str:
+        return {"page": cls.PAGE_TAG, "build": cls.BUILD_TAG,
+                "result": cls.RESULT_TAG}[kind]
 
     # -- keys ------------------------------------------------------------------
     @staticmethod
@@ -261,6 +371,66 @@ class DeviceBufferPool:
             key, _Entry("build", ",".join(key[3]), "", payload, nbytes),
             self.BUILD_TAG)
 
+    # -- result tier (round 12) ------------------------------------------------
+    def get_result(self, key):
+        """-> (MaterializedResult, nbytes) or None; a hit refreshes LRU
+        recency.  Chaos: ``cache_checkout`` faults with site ``result`` land
+        here — ``deny`` serves a miss (the caller executes the statement,
+        the recoverable path), raises propagate.  Served results are SHARED
+        numpy arrays: every engine surface treats results as immutable."""
+        if faults.maybe_inject("cache_checkout", "result") == "deny":
+            with self._lock:
+                self.result_misses += 1
+            return None
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.result_misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.result_hits += 1
+            return e.payload, e.nbytes
+
+    def put_result(self, key, result, epoch: Optional[int] = None) -> bool:
+        """Store a completed MaterializedResult.  ``key`` is ("result",
+        plan fingerprint, catalogs tuple, ...) — the catalogs tuple (key[2])
+        is what invalidate_catalog matches.  ``epoch`` is the pool epoch the
+        statement STARTED under: a mismatch means an invalidation landed
+        while the statement executed, and admitting its (possibly pre-DML)
+        result would resurrect state the invalidation cleared.  The
+        ADMISSION decision (deterministic plan, cacheable connectors, no
+        volatile functions) already happened in the engine; here only
+        sizing/staleness applies: entries over the per-entry cap are
+        skipped, and the tier LRU-evicts its own entries to stay inside its
+        sub-budget before reserving under the shared pool.  Chaos:
+        ``cache_store`` faults with site ``result`` — ``deny`` skips the
+        admission, raises propagate to the engine's store guard (the query
+        stays successful, the entry stays absent)."""
+        if not self.result_enabled or result is None:
+            return False
+        with self._lock:
+            if epoch is not None and epoch != self.epoch:
+                return False  # invalidated mid-statement: never store
+            if key in self._entries:
+                return True  # a concurrent statement stored it first
+        # past the early-exits: a fire must mean a real store was attempted
+        if faults.maybe_inject("cache_store", "result") == "deny":
+            return False
+        nbytes = _result_nbytes(result)
+        if nbytes > self.result_entry_cap():
+            return False
+        with self._lock:
+            # the tier's own sub-budget: evict RESULT entries (oldest first)
+            # until this one fits — device tiers are never displaced by a
+            # host-resident result, and vice versa (_store's symmetric
+            # device check)
+            while self._result_bytes + nbytes > self.result_budget():
+                if not self._evict_oldest(("result",)):
+                    return False
+            cats = ",".join(key[2]) if key[2] else ""
+            return self._store(key, _Entry("result", cats, "", result,
+                                           nbytes), self.RESULT_TAG)
+
     # -- storage / eviction ----------------------------------------------------
     def _store(self, key, entry: _Entry, tag: str) -> bool:
         pool = self._pool()
@@ -269,12 +439,54 @@ class DeviceBufferPool:
                 return True
             if entry.nbytes > pool.max_bytes:
                 return False  # can never fit: don't flush everyone else first
+            if entry.kind in ("page", "build"):
+                # device sub-budget: HBM entries plus device-resident spill
+                # reservations stay under budget() even while the (host)
+                # result budget sits underfull
+                while self._device_usage() + entry.nbytes > self.budget():
+                    if not self._evict_oldest(("page", "build")):
+                        return False
             while not pool.try_reserve(entry.nbytes, tag):
                 if not self._entries:
                     return False
                 self._evict_lru()
             self._entries[key] = entry
+            if entry.kind == "result":
+                self._result_bytes += entry.nbytes
+            else:
+                self._device_bytes += entry.nbytes
             return True
+
+    def _device_usage(self) -> int:
+        """Caller holds the lock: resident page/build bytes + live
+        device-resident spill reservations (the SPILL_TAG share of the
+        shared pool) — the quantity the device sub-budget bounds."""
+        spill = 0
+        if self.memory_pool is not None:
+            spill = self.memory_pool.info()["by_tag"].get(self.SPILL_TAG, 0)
+        return self._device_bytes + spill
+
+    def _forget(self, e: _Entry) -> None:
+        """Caller holds the lock: update tier bytes + pool reservation for a
+        removed entry."""
+        if e.kind == "result":
+            self._result_bytes -= e.nbytes
+        else:
+            self._device_bytes -= e.nbytes
+        if self.memory_pool is not None:
+            self.memory_pool.free(e.nbytes, self._tag_of(e.kind))
+
+    def _evict_oldest(self, kinds) -> bool:
+        """Caller holds the lock: evict the least-recently-used entry whose
+        kind is in ``kinds``.  False when no such entry remains."""
+        oldest = next((k for k, e in self._entries.items()
+                       if e.kind in kinds), None)
+        if oldest is None:
+            return False
+        e = self._entries.pop(oldest)
+        self.evictions += 1
+        self._forget(e)
+        return True
 
     def _evict_lru(self) -> None:
         """Caller holds the lock.  Frees the oldest entry's reservation; the
@@ -283,8 +495,7 @@ class DeviceBufferPool:
         alive exactly as long as it needs it)."""
         key, e = self._entries.popitem(last=False)
         self.evictions += 1
-        self.memory_pool.free(
-            e.nbytes, self.PAGE_TAG if e.kind == "page" else self.BUILD_TAG)
+        self._forget(e)
 
     # -- spill tier / pressure eviction (round 11) -----------------------------
     def reserve_spill(self, nbytes: int) -> bool:
@@ -300,8 +511,14 @@ class DeviceBufferPool:
             return False
         pool = self._pool()
         with self._lock:
-            if nbytes > pool.max_bytes:
+            # bounded by the DEVICE budget, not the pool's page+result sum:
+            # spill chunks are HBM-resident, so they evict device entries
+            # and may never expand into the host result tier's headroom
+            if nbytes > self.budget():
                 return False
+            while self._device_usage() + nbytes > self.budget():
+                if not self._evict_oldest(("page", "build")):
+                    return False
             while not pool.try_reserve(nbytes, self.SPILL_TAG):
                 if not self._entries:
                     return False
@@ -329,30 +546,29 @@ class DeviceBufferPool:
     # -- invalidation ----------------------------------------------------------
     def invalidate_catalog(self, catalog: str) -> None:
         """Drop every entry that reads ``catalog`` (version-stale plan
-        eviction path).  Build entries fingerprint their versions, so a stale
-        one would never SERVE — this releases its device memory too."""
+        eviction path).  Build and result entries fingerprint their versions,
+        so a stale one would never SERVE — this releases its memory too."""
         with self._lock:
+            self.epoch += 1
             dead = [k for k, e in self._entries.items()
                     if e.catalog == catalog
-                    or (e.kind == "build" and catalog in k[3])]
+                    or (e.kind == "build" and catalog in k[3])
+                    or (e.kind == "result" and catalog in k[2])]
             for k in dead:
-                e = self._entries.pop(k)
-                if self.memory_pool is not None:
-                    self.memory_pool.free(
-                        e.nbytes,
-                        self.PAGE_TAG if e.kind == "page" else self.BUILD_TAG)
+                self._forget(self._entries.pop(k))
 
     def clear(self) -> None:
         """Release everything (Engine._invalidate / DDL / register_catalog).
         Reservations return to the pool so no device memory leaks across
         DDL."""
         with self._lock:
+            self.epoch += 1
             for e in self._entries.values():
                 if self.memory_pool is not None:
-                    self.memory_pool.free(
-                        e.nbytes,
-                        self.PAGE_TAG if e.kind == "page" else self.BUILD_TAG)
+                    self.memory_pool.free(e.nbytes, self._tag_of(e.kind))
             self._entries.clear()
+            self._result_bytes = 0
+            self._device_bytes = 0
 
     # -- observability ---------------------------------------------------------
     def info(self) -> dict:
@@ -361,15 +577,18 @@ class DeviceBufferPool:
         with self._lock:
             per_table: dict = {}
             total = 0
-            pages = builds = 0
+            pages = builds = results = 0
             for e in self._entries.values():
                 total += e.nbytes
                 if e.kind == "page":
                     pages += 1
-                else:
+                elif e.kind == "build":
                     builds += 1
+                else:
+                    results += 1
+                kind_label = "<build>" if e.kind == "build" else "<result>"
                 label = f"{e.catalog}.{e.table}" if e.table else \
-                    (f"{e.catalog}.<build>" if e.catalog else "<build>")
+                    (f"{e.catalog}.{kind_label}" if e.catalog else kind_label)
                 t = per_table.setdefault(label, {"entries": 0, "bytes": 0})
                 t["entries"] += 1
                 t["bytes"] += e.nbytes
@@ -379,9 +598,14 @@ class DeviceBufferPool:
                     else None,
                     "entries": len(self._entries),
                     "page_entries": pages, "build_entries": builds,
+                    "result_entries": results,
+                    "result_bytes": self._result_bytes,
+                    "result_budget_bytes": self._result_budget,
                     "bytes": total,
                     "hits": self.hits, "misses": self.misses,
                     "build_hits": self.build_hits,
                     "build_misses": self.build_misses,
+                    "result_hits": self.result_hits,
+                    "result_misses": self.result_misses,
                     "evictions": self.evictions,
                     "per_table": per_table}

@@ -93,6 +93,75 @@ FAILING = {name: (spec, cache_on)
            if kind == "fail"}
 
 
+# -- result-cache matrix (round 12: the buffer pool's result tier) ------------
+#
+# Separate table from SCENARIOS on purpose: these need an engine whose
+# RESULT tier is enabled, and enabling it for the MAIN matrix would let warm
+# statements be answered from the cache — the dispatch/generate fault
+# classes would then never fire and the suite would fail vacuously.  Every
+# consumer (tests/test_result_cache.py, scripts/chaos.py) runs these on a
+# result-enabled engine via run_result_scenario below.
+#
+# (name, spec, kind): "recover" pins byte-identical results + >=1 fire +
+# leak check; the "errored queries never cache" contract is pinned by the
+# dedicated failing test (a typed dispatch error must leave no entry).
+RESULT_SCENARIOS = [
+    ("result-checkout-deny",
+     "point=cache_checkout,site=result,action=deny,every=1", "recover"),
+    ("result-store-deny",
+     "point=cache_store,site=result,action=deny,every=1", "recover"),
+    ("result-store-error",
+     "point=cache_store,site=result,action=error,nth=1", "recover"),
+]
+
+
+def run_result_scenario(engine, sql, session, baseline_sig, name, spec,
+                        kind) -> dict:
+    """One result-cache chaos scenario: arm ``spec``, run ``sql`` on a
+    result-enabled engine, pin byte-identity vs ``baseline_sig``, at least
+    one fire, the post-scenario leak check, and (store scenarios) that no
+    entry was admitted under the fault.  Returns {"ok": bool, ...} — shared
+    by tests/test_result_cache.py and scripts/chaos.py."""
+    from . import faults
+
+    rec = {"scenario": name, "kind": kind}
+    try:
+        # store scenarios must actually attempt a store; checkout scenarios
+        # must have an entry to be denied — one clean warm pass arranges
+        # both, then the store classes clear just the result tier
+        engine.execute_sql(sql, session)
+        if "store" in name:
+            engine.buffer_pool.clear()
+        with faults.injected(spec) as plan:
+            got = result_signature(engine.execute_sql(sql, session))
+        rec["ok"] = got == baseline_sig
+        if not rec["ok"]:
+            rec["detail"] = "result diverged"
+        rec["fires"] = plan.total_fires()
+        if rec["fires"] < 1:
+            rec["ok"] = False
+            rec["detail"] = "scenario never fired"
+        if "store" in name and rec.get("ok") \
+                and engine.buffer_pool.info()["result_entries"]:
+            rec["ok"] = False
+            rec["detail"] = "entry admitted under a store fault"
+        leaks = leak_report(engine)
+        if leaks:
+            rec["ok"] = False
+            rec["leaks"] = leaks
+        if rec.get("ok"):
+            # fault-free rerun: the denied/errored store left no partial
+            # state, and the next clean pass re-populates and still matches
+            again = result_signature(engine.execute_sql(sql, session))
+            if again != baseline_sig:
+                rec["ok"] = False
+                rec["detail"] = "post-fault rerun diverged"
+    except Exception as e:  # scenario harness failure
+        rec["ok"] = False
+        rec["detail"] = f"{type(e).__name__}: {e}"
+    return rec
+
+
 # -- memory-pressure matrix (round 11: the tiered-spill ladder) ---------------
 #
 # Each scenario runs the plan on a FRESH tiny-budget executor whose pool

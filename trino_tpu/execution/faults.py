@@ -56,14 +56,21 @@ Injection points (the ``point`` vocabulary)::
     host_pull      exec/local_executor._host    (every batched D2H pull)
     generate       _scan_pages_source           (per-split connector generate)
     h2d            _page_to_device              (H2D staging chokepoint)
-    cache_store    DeviceBufferPool.put_page/put_build
-    cache_checkout DeviceBufferPool.get_page/get_build
+    cache_store    DeviceBufferPool.put_page/put_build/put_result
+                   (sites: page.<table> | build | result)
+    cache_checkout DeviceBufferPool.get_page/get_build/get_result
+                   (sites: page.<table> | build | result)
     exchange_write exec/fte.SpoolingExchange.commit
     exchange_read  exec/fte.SpoolingExchange.read
     task           server/cluster worker task body
     reserve        memory.MemoryPool.try_reserve
     spill_write    exec/spill tier admission/write (site spill.hbm/host/disk)
     spill_read     exec/spill partition readback (site spill.<tier>.read)
+
+Round 12's result-cache tier reuses the cache points with site ``result``:
+a checkout ``deny`` serves a miss (the statement executes — recoverable,
+byte-identical), a store ``deny``/``error`` skips the admission (the engine's
+store guard keeps the query successful and the entry absent either way).
 
 Round 11 adds the spill ladder's points and the ``disk_full`` action: a
 ``deny`` at ``spill_write`` makes that TIER refuse (the chunk overflows to

@@ -90,6 +90,12 @@ SYSTEM_SESSION_PROPERTIES = {p.name: p for p in [
                      "(execution/bufferpool; pool budget from "
                      "TRINO_TPU_PAGE_CACHE).  NON-plan-shaping: flipping it "
                      "never re-plans or re-compiles", "boolean", True),
+    PropertyMetadata("result_cache",
+                     "Serve repeated deterministic statements from the "
+                     "buffer pool's result tier (execution/bufferpool; tier "
+                     "budget from TRINO_TPU_RESULT_CACHE).  NON-plan-"
+                     "shaping: flipping it never re-plans or re-compiles",
+                     "boolean", True),
     PropertyMetadata("query_max_memory",
                      "Per-query device memory limit in bytes (0 = node limit "
                      "only; reference: query.max-memory + "

@@ -167,6 +167,15 @@ class QueryCounters:
     page_cache_misses: int = 0
     page_cache_bytes_saved: int = 0
     build_cache_hits: int = 0
+    # round 12: result-cache tier (the buffer pool's third tier).  A result
+    # hit means the WHOLE statement was answered from a cached
+    # MaterializedResult — zero device dispatches, zero executor checkout,
+    # zero host pulls; bytes_saved is the served result's host footprint.
+    # Misses count only statements that were ADMISSIBLE (deterministic plan,
+    # cacheable connectors, cache enabled) but not resident.
+    result_cache_hits: int = 0
+    result_cache_misses: int = 0
+    result_cache_bytes_saved: int = 0
     # round 10: chaos accounting.  faults_injected counts fault-injector
     # firings (execution/faults) attributed to this query — a chaos run is
     # self-describing in EXPLAIN ANALYZE and bench output; task_retries
@@ -197,6 +206,8 @@ class QueryCounters:
     _INT_FIELDS = ("device_dispatches", "host_transfers", "host_bytes_pulled",
                    "coalesced_splits", "page_cache_hits", "page_cache_misses",
                    "page_cache_bytes_saved", "build_cache_hits",
+                   "result_cache_hits", "result_cache_misses",
+                   "result_cache_bytes_saved",
                    "faults_injected", "task_retries",
                    "spilled_bytes", "spill_tier_hbm", "spill_tier_host",
                    "spill_tier_disk", "admission_queued")
@@ -446,6 +457,23 @@ def record_build_cache(hits: int = 0, misses: int = 0,
     if c is not None:
         c.build_cache_hits += hits
     _attribute_extra(site, build_cache_hits=hits, build_cache_misses=misses)
+
+
+def record_result_cache(hits: int = 0, misses: int = 0, bytes_saved: int = 0,
+                        site: Optional[str] = None) -> None:
+    """One result-tier lookup outcome (round 12).  Hits record on a fresh
+    per-statement QueryCounters the engine accounts directly — a served
+    statement never enters the executor path, so there is no executor
+    counter set to attribute to; misses are stamped onto the statement's
+    snapshot post-execution (engine._execute_admitted), same pattern as
+    admission_queued."""
+    c = getattr(_counter_local, "counters", None)
+    if c is not None:
+        c.result_cache_hits += hits
+        c.result_cache_misses += misses
+        c.result_cache_bytes_saved += bytes_saved
+    _attribute_extra(site, result_cache_hits=hits, result_cache_misses=misses,
+                     result_cache_bytes_saved=bytes_saved)
 
 
 def record_fault(site: Optional[str] = None) -> None:

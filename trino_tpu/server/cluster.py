@@ -1334,14 +1334,48 @@ class ClusterCoordinator:
         spooled filesystem exchange between fragments; finish the streaming
         remainder locally (reference: SqlQueryExecution.planDistribution ->
         per-stage task scheduling, EventDrivenFaultTolerantQueryScheduler's
-        spooled inter-stage exchange, SURVEY §3.2/§3.5)."""
+        spooled inter-stage exchange, SURVEY §3.2/§3.5).
+
+        Round 12: the result cache is COORDINATOR-side — a repeated
+        deterministic statement is answered from the engine's buffer-pool
+        result tier before any fragment is scheduled (zero worker tasks,
+        zero exchange traffic, zero dispatches), and a clean completion
+        stores through the same engine guard the local path uses."""
+        sess = session or self.engine.create_session(
+            next(iter(self.engine.catalogs)))
+        plan = self._cached_plan(sql, sess)
+        rkey = self.engine._result_cache_key(sql, plan, sess)
+        epoch = self.engine.buffer_pool.epoch if rkey is not None else None
+        if rkey is not None:
+            served = self.engine._result_cache_fetch(rkey)
+            if served is not None:
+                # the fetch accounted a hit-only counter set; mirror the
+                # THREAD-LOCAL snapshot as this query's cluster profile
+                # (engine.last_query_counters is shared state a concurrent
+                # statement can overwrite between fetch and here)
+                snap = self.engine._thread_accounting.snap
+                if snap is not None:
+                    with self._lock:
+                        self.last_query_counters = snap
+                return served
+        out = self._execute_plan_cluster(plan, sess)
+        self.engine._result_cache_finish(rkey, out, epoch=epoch)
+        if rkey is not None:
+            # the miss was stamped onto the engine's thread-local SNAPSHOT
+            # (a copy taken by _account_counters) — mirror it so the
+            # coordinator's per-query counters show misses like they show
+            # hits, not an asymmetric zero
+            snap = self.engine._thread_accounting.snap
+            if snap is not None:
+                with self._lock:
+                    self.last_query_counters = snap
+        return out
+
+    def _execute_plan_cluster(self, plan, sess):
         import shutil
 
         from ..engine import _effective_dispatch_batch
 
-        sess = session or self.engine.create_session(
-            next(iter(self.engine.catalogs)))
-        plan = self._cached_plan(sql, sess)
         local = self._local
         with self._query_lock:  # overrides are executor-global
             # session dispatch-coalescing width: applied to the coordinator's

@@ -164,6 +164,77 @@ class _Query:
     lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
 
 
+class _StatementLock:
+    """Shared/exclusive gate for engine access (round 12).
+
+    The engine has been safe for CONCURRENT read statements since round 9
+    (per-query pooled executors, the plan lock, the shared buffer pool under
+    its own lock — tests/test_page_cache drives 4 threads through
+    execute_sql), but this server still serialized every statement behind
+    one mutex, which made the coordinator protocol single-file and any
+    concurrency benchmark meaningless.  Read statements (SELECT/SHOW/
+    EXPLAIN/VALUES/WITH) now run SHARED; DDL/DML and anything unrecognized
+    runs EXCLUSIVE (memory-connector writes + catalog mutation still assume
+    single-writer).  Writer-preference: a waiting writer blocks new readers,
+    so a stream of dashboard SELECTs cannot starve an INSERT."""
+
+    READ_KEYWORDS = ("select", "with", "show", "explain", "describe",
+                     "values", "table")
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    @classmethod
+    def is_read_statement(cls, sql: str) -> bool:
+        head = sql.lstrip().lstrip("(").lstrip()[:12].lower()
+        return any(head.startswith(k) for k in cls.READ_KEYWORDS)
+
+    def acquire_shared(self):
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_shared(self):
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_exclusive(self):
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    self._cond.wait()
+                self._writer = True
+            finally:
+                self._writers_waiting -= 1
+
+    def release_exclusive(self):
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+    def statement_scope(self, sql: str):
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            shared = self.is_read_statement(sql)
+            (self.acquire_shared if shared else self.acquire_exclusive)()
+            try:
+                yield
+            finally:
+                (self.release_shared if shared
+                 else self.release_exclusive)()
+
+        return scope()
+
+
 _device_stats_lock = threading.Lock()
 _device_stats_cache = {"stats": None, "at": 0.0, "probe_started": 0.0,
                        "probing": False}
@@ -251,10 +322,11 @@ class CoordinatorServer:
         self.queries: dict = {}
         self._pool = ThreadPoolExecutor(max_workers=dispatch_threads,
                                         thread_name_prefix="dispatch")
-        # the Engine (plan caches, executor state, memory-connector writes) is not
-        # thread-safe: queries queue concurrently but EXECUTE serially (the
-        # single-device analog of the reference's per-query resource-group admission)
-        self._engine_lock = threading.Lock()
+        # shared/exclusive statement gate (round 12): read statements execute
+        # CONCURRENTLY against the engine's executor pool (one dispatch
+        # thread per in-flight statement, up to dispatch_threads); DDL/DML
+        # still serialize exclusively — see _StatementLock
+        self._engine_lock = _StatementLock()
         self._queries_lock = threading.Lock()  # guards the queries registry itself
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -680,6 +752,27 @@ class CoordinatorServer:
                 "under buffer-pool memory pressure.",
                 "# TYPE trino_tpu_page_cache_evictions_total counter",
                 f"trino_tpu_page_cache_evictions_total {bi['evictions']}",
+                # result tier (round 12): statements answered whole from the
+                # cache — hits here are queries that cost ZERO dispatches
+                "# HELP trino_tpu_result_cache_bytes Host bytes resident in "
+                "the buffer pool's result tier.",
+                "# TYPE trino_tpu_result_cache_bytes gauge",
+                f"trino_tpu_result_cache_bytes {bi.get('result_bytes', 0)}",
+                "# HELP trino_tpu_result_cache_entries Cached statement "
+                "results resident in the buffer pool.",
+                "# TYPE trino_tpu_result_cache_entries gauge",
+                f"trino_tpu_result_cache_entries "
+                f"{bi.get('result_entries', 0)}",
+                "# HELP trino_tpu_result_cache_hits_total Statements served "
+                "whole from the result tier (zero device dispatches).",
+                "# TYPE trino_tpu_result_cache_hits_total counter",
+                f"trino_tpu_result_cache_hits_total "
+                f"{bi.get('result_hits', 0)}",
+                "# HELP trino_tpu_result_cache_misses_total Admissible "
+                "statements not resident in the result tier.",
+                "# TYPE trino_tpu_result_cache_misses_total counter",
+                f"trino_tpu_result_cache_misses_total "
+                f"{bi.get('result_misses', 0)}",
             ]
         # memory-pool snapshots as labeled gauges (the pool info dict finally
         # reaches the metrics endpoint — round-8 satellite)
@@ -768,7 +861,7 @@ class CoordinatorServer:
         """Best-effort EXPLAIN under the engine lock (every other execution
         path holds it; planning against catalogs mid-DDL is a race)."""
         try:
-            with self._engine_lock:
+            with self._engine_lock.statement_scope("explain"):
                 r = self.engine.execute_sql(f"explain {q.sql}")
             return "\n".join(str(row[0]) for row in r.rows())
         except Exception:
@@ -874,7 +967,7 @@ class CoordinatorServer:
     def _run(self, q: _Query, catalog: Optional[str],
              user: str = "user") -> None:
         try:
-            with self._engine_lock:
+            with self._engine_lock.statement_scope(q.sql):
                 if not self._set_state(q, "PLANNING"):
                     return  # canceled while queued: never execute
                 session = self.engine.create_session(catalog)
@@ -884,11 +977,15 @@ class CoordinatorServer:
                 try:
                     res = self.engine.execute_sql(q.sql, session)
                 finally:
-                    # still under the engine lock: last_query_trace is the
-                    # trace of THIS statement, not a concurrent one's — and
-                    # FAILED statements keep theirs too (a failed query is
-                    # when the trace is most wanted)
-                    q.trace = getattr(self.engine, "last_query_trace", None)
+                    # the engine publishes the trace on the executing THREAD
+                    # (concurrent read statements share last_query_trace, so
+                    # the global slot may already be another statement's) —
+                    # and FAILED statements keep theirs too (a failed query
+                    # is when the trace is most wanted).  No fallback to the
+                    # shared slot: a None here (statement failed before
+                    # admission) is honest, another statement's trace isn't.
+                    acct = getattr(self.engine, "_thread_accounting", None)
+                    q.trace = getattr(acct, "trace", None)
             if res is None:  # DDL
                 columns = [{"name": "result", "type": "boolean"}]
                 rows = [[True]]

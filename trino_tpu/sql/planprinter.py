@@ -60,6 +60,17 @@ def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
                 f"Buffer pool: {pc_h} page hits, {pc_m} page misses, "
                 f"{getattr(counters, 'page_cache_bytes_saved', 0)} bytes "
                 f"saved, {bc_h} build hits")
+        rc_h = getattr(counters, "result_cache_hits", 0)
+        rc_m = getattr(counters, "result_cache_misses", 0)
+        if rc_h or rc_m:
+            # the buffer pool's result tier (round 12): a hit means the
+            # WHOLE statement was served with zero dispatches; a miss means
+            # the statement was admissible and stored on completion (zero
+            # everywhere = no line, budget-suite regexes unchanged)
+            lines.append(
+                f"Result cache: {rc_h} hits, {rc_m} misses, "
+                f"{getattr(counters, 'result_cache_bytes_saved', 0)} bytes "
+                f"saved")
         res = (boundary or {}).get("result")
         if res is not None and _boundary_nonzero(res):
             lines.append("    result: " + _boundary_str(res))
