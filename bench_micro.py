@@ -102,6 +102,194 @@ def bench_join_probe(n):
     return _timeit(run, jt, pkeys)
 
 
+# ------------------------------------------------------- XLA-vs-Pallas A/B
+# Round-13 kernels (ops/pallas_kernels.py) benchmarked against the XLA paths
+# they shadow, with result equality asserted per the parity contract (probe/
+# compact byte-identical; build/insert observable-identical — slot layouts
+# are backend-private).  Each _ab kernel prints its own one-JSON-line payload
+# with both throughputs.  On CPU the pallas half runs INTERPRETED (correctness
+# signal only — the wall time is the interpreter's, not Mosaic's); the row
+# counts are capped so that stays tractable.  On TPU both halves are compiled
+# and the speedup column is the capture tpu_watch.sh archives.
+
+_AB_ROWS_CAP = 1 << 13
+
+
+def _ab_line(name, n, t_xla, t_pallas, extra=None):
+    import jax as _jax
+    rec = {"kernel": name, "rows": n,
+           "xla_ms": round(t_xla * 1000, 3),
+           "pallas_ms": round(t_pallas * 1000, 3),
+           "xla_rows_per_sec": round(n / t_xla),
+           "pallas_rows_per_sec": round(n / t_pallas),
+           "pallas_speedup": round(t_xla / t_pallas, 3),
+           "equal": True,
+           "interpret": _jax.default_backend() != "tpu",
+           "env": env_info()}
+    rec.update(extra or {})
+    print(json.dumps(rec), flush=True)
+
+
+def _per_backend(fn_builder):
+    """Build + run one timed closure per backend.  pallas_kernels.force is a
+    TRACE-time switch, so each backend gets its own freshly-traced jit."""
+    from trino_tpu.ops import pallas_kernels as pk
+
+    out = {}
+    for mode in (False, True):
+        pk.force(mode)
+        try:
+            out[mode] = fn_builder()
+        finally:
+            pk.force(None)
+    return out[False], out[True]
+
+
+def bench_join_probe_ab(n):
+    """hashjoin.probe: XLA while_loop gathers vs the Pallas inversion probe —
+    byte-identical (row_ids, matched) over the SAME table."""
+    import numpy as np
+
+    from trino_tpu.ops.hashjoin import build_insert, build_table_init, probe
+    from trino_tpu.page import Field, Page, Schema
+    from trino_tpu.types import BIGINT
+
+    n = min(n, _AB_ROWS_CAP)
+    nb = max(n // 8, 1)
+    rng = np.random.default_rng(0)
+    bkey = np.unique((np.arange(nb, dtype=np.int64) * 7919) % (1 << 40))
+    page = Page(Schema((Field("k", BIGINT),)), (jnp.asarray(bkey),), (None,),
+                None)
+    jt = jax.jit(lambda k: build_insert(
+        build_table_init(4 * len(bkey), page), (k,), (BIGINT,),
+        jnp.ones((len(bkey),), bool)))(jnp.asarray(bkey))
+    pkeys = jnp.asarray(rng.choice(bkey, n))
+
+    def build():
+        # all-ones masks build INSIDE the trace: a closed-over device
+        # constant degrades every dispatch on tunneled TPUs (CLAUDE.md,
+        # ~70ms/call) and would tax exactly the capture this A/B exists for
+        run = jax.jit(lambda jt, pkeys: probe(jt, (pkeys,), (BIGINT,),
+                                              jnp.ones((n,), bool)))
+        t = _timeit(run, jt, pkeys)
+        return t, run(jt, pkeys)
+
+    (t_x, (r_x, m_x)), (t_p, (r_p, m_p)) = _per_backend(build)
+    assert np.array_equal(np.asarray(r_x), np.asarray(r_p))
+    assert np.array_equal(np.asarray(m_x), np.asarray(m_p))
+    _ab_line("join_probe_ab", n, t_x, t_p,
+             {"capacity": int(jt.capacity), "hits": int(np.asarray(m_x).sum())})
+    return None
+
+
+def bench_join_build_ab(n):
+    """hashjoin build insertion: XLA scatter-min claims vs the Pallas in-kernel
+    claim loop — observable-identical (word sets, dup/overflow counters, probe
+    results over either table)."""
+    import numpy as np
+
+    from trino_tpu.ops.hashjoin import build_insert, build_table_init, probe
+    from trino_tpu.page import Field, Page, Schema
+    from trino_tpu.types import BIGINT
+
+    n = min(n, _AB_ROWS_CAP)
+    key = jnp.asarray((np.arange(n, dtype=np.int64) * 7919) % (1 << 40))
+    schema = Schema((Field("k", BIGINT),))
+
+    def build():
+        # the page is (re)built from the traced argument INSIDE the jit: a
+        # closed-over device page would bake its columns in as constants and
+        # tax every dispatch on tunneled TPUs (CLAUDE.md ~70ms/call) — the
+        # capture this A/B feeds must time the kernel, not constant uploads
+        run = jax.jit(lambda key: build_insert(
+            build_table_init(4 * n, Page(schema, (key,), (None,), None)),
+            (key,), (BIGINT,), jnp.ones((n,), bool)))
+        t = _timeit(run, key)
+        return t, run(key)
+
+    (t_x, jt_x), (t_p, jt_p) = _per_backend(build)
+    assert np.array_equal(np.sort(np.asarray(jt_x.table)),
+                          np.sort(np.asarray(jt_p.table)))
+    assert int(jt_x.dup_count) == int(jt_p.dup_count)
+    assert bool(jt_x.overflow) == bool(jt_p.overflow)
+    from trino_tpu.ops import pallas_kernels as pk
+    pk.force(False)
+    try:
+        px = jax.jit(lambda jt, key: probe(jt, (key,), (BIGINT,),
+                                           jnp.ones((n,), bool)))
+        r1, m1 = px(jt_x, key)
+        r2, m2 = px(jt_p, key)
+    finally:
+        pk.force(None)
+    assert np.array_equal(np.asarray(r1), np.asarray(r2))
+    assert np.array_equal(np.asarray(m1), np.asarray(m2))
+    _ab_line("join_build_ab", n, t_x, t_p, {"capacity": int(jt_x.capacity)})
+    return None
+
+
+def bench_hashagg_insert_ab(n):
+    """Group-by slot insertion: XLA rounds of gather + scatter-min vs the
+    Pallas claim kernel — identical key -> accumulator maps."""
+    import numpy as np
+
+    from trino_tpu.ops import hashagg
+    from trino_tpu.types import BIGINT
+
+    n = min(n, _AB_ROWS_CAP)
+    rng = np.random.default_rng(0)
+    keys = jnp.asarray(rng.integers(0, n // 4, n))
+    vals = jnp.asarray(rng.random(n))
+
+    def build():
+        def step_fn(state, keys, vals):
+            # mask built in-trace: no closed-over device constants (CLAUDE.md)
+            return hashagg.groupby_insert(state, (keys,), (BIGINT,),
+                                          jnp.ones((n,), bool),
+                                          [(vals, None)], ["sum"])
+        run = jax.jit(step_fn)
+        state = hashagg.groupby_init(n, (np.int64,), ((np.float64, 0.0),))
+        t = _timeit(run, state, keys, vals)
+        out = run(state, keys, vals)
+        occ, (k,), (acc,) = hashagg.agg_finalize(out)
+        occ = np.asarray(occ)
+        return t, dict(zip(np.asarray(k)[occ].tolist(),
+                           np.round(np.asarray(acc)[occ], 9).tolist()))
+
+    (t_x, g_x), (t_p, g_p) = _per_backend(build)
+    assert g_x == g_p
+    _ab_line("hashagg_insert_ab", n, t_x, t_p, {"groups": len(g_x)})
+    return None
+
+
+def bench_compact_ab(n):
+    """The pipeline-boundary masked-lane pack at 1/16 selectivity: XLA
+    cumsum-scatter vs the Pallas prefix-sum + one-hot matmul — byte-identical."""
+    import numpy as np
+
+    from trino_tpu.ops.arrays import compact_rows
+
+    n = min(n, 1 << 16)
+    rng = np.random.default_rng(0)
+    valid = jnp.asarray(rng.random(n) < 1 / 16)
+    cols = (jnp.asarray(rng.integers(0, 1 << 40, n)),
+            jnp.asarray(rng.random(n)),
+            jnp.asarray(rng.random(n) < 0.5))
+    bucket = n // 8
+
+    def build():
+        run = jax.jit(lambda cols, valid: compact_rows(cols, valid, bucket))
+        t = _timeit(run, cols, valid)
+        packed, total = run(cols, valid)
+        return t, ([np.asarray(p) for p in packed], int(total))
+
+    (t_x, (p_x, c_x)), (t_p, (p_p, c_p)) = _per_backend(build)
+    assert c_x == c_p
+    for a, b in zip(p_x, p_p):
+        assert np.array_equal(a, b)
+    _ab_line("compact_ab", n, t_x, t_p, {"bucket": bucket, "live": c_x})
+    return None
+
+
 def bench_exchange_route(n):
     """bucketize + all_to_all over an 8-worker mesh (or fewer devices)."""
     from functools import partial
@@ -306,14 +494,75 @@ KERNELS = {
     "exchange_stream_vs_spool": bench_exchange_stream_vs_spool,
     "dispatch_coalesce": bench_dispatch_coalesce,
     "h2d_transfer": bench_h2d_transfer,
+    # round-13 XLA-vs-Pallas A/B variants (result equality asserted)
+    "join_probe_ab": bench_join_probe_ab,
+    "join_build_ab": bench_join_build_ab,
+    "hashagg_insert_ab": bench_hashagg_insert_ab,
+    "compact_ab": bench_compact_ab,
 }
+
+
+def _filter_stderr():
+    """XLA:CPU's AOT cache floods fd 2 with 'cpu_aot_loader' warnings
+    (CLAUDE.md: harmless).  They come from C++ logging, so a python-level
+    sys.stderr wrapper never sees them — pump the real fd through a filter
+    thread so captured A/B output (tpu_watch.sh redirects 2> to a .log)
+    stays readable.  An atexit hook restores fd 2 and JOINS the pump: a
+    daemon thread alone dies at interpreter exit before forwarding whatever
+    is still in the pipe — which is exactly where a crashing run's traceback
+    sits, and an empty .log from the one-shot tunnel capture window is an
+    undiagnosable failure."""
+    import atexit
+    import threading
+
+    r, w = os.pipe()
+    orig = os.dup(2)
+    os.dup2(w, 2)
+    os.close(w)
+
+    def pump():
+        buf = b""
+        while True:
+            try:
+                chunk = os.read(r, 65536)
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf += chunk
+            *lines, buf = buf.split(b"\n")
+            for ln in lines:
+                if b"cpu_aot_loader" not in ln:
+                    os.write(orig, ln + b"\n")
+        if buf and b"cpu_aot_loader" not in buf:
+            os.write(orig, buf + b"\n")
+
+    t = threading.Thread(target=pump, daemon=True, name="stderr-filter")
+    t.start()
+
+    def restore():
+        try:
+            sys.stderr.flush()
+        except Exception:
+            pass
+        # putting orig back on fd 2 closes the pipe's only write end: the
+        # pump sees EOF, forwards the tail (e.g. an uncaught traceback
+        # printed during shutdown) to the real stderr, and exits
+        os.dup2(orig, 2)
+        t.join(timeout=10)
+
+    atexit.register(restore)
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=4_000_000)
-    ap.add_argument("--kernels", type=str, default=",".join(KERNELS))
+    ap.add_argument("--kernels", type=str, default=",".join(KERNELS),
+                    help="comma list from KERNELS; *_ab variants run the "
+                         "XLA-vs-Pallas comparison (row counts capped; "
+                         "interpret mode off-TPU)")
     args = ap.parse_args()
+    _filter_stderr()
     env = env_info()
     for name in args.kernels.split(","):
         fn = KERNELS.get(name.strip())

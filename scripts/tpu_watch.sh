@@ -63,6 +63,16 @@ for i in $(seq 1 250); do
       --kernels h2d_transfer,dispatch_coalesce \
       > scripts/bench_micro_r09.json 2> scripts/bench_micro_r09.log
     echo "$(date -Is) micro h2d+coalesce rc=$? : $(tail -c 300 scripts/bench_micro_r09.json)" >> "$LOG"
+    # round-13 Pallas A/B: per-kernel XLA-vs-Mosaic throughput + result
+    # equality for probe / build / agg-insert / compact, COMPILED for the
+    # first time (CPU runs only prove parity through the interpreter).  This
+    # is the go/no-go datum for keeping TRINO_TPU_PALLAS default-on for TPU —
+    # cheap, so it runs long before the SF100 tail (capture beats feature
+    # work inside the ~30-min wedge window).
+    timeout -k 60 1200 python bench_micro.py --rows 4000000 \
+      --kernels join_probe_ab,join_build_ab,hashagg_insert_ab,compact_ab \
+      > scripts/bench_micro_pallas.json 2> scripts/bench_micro_pallas.log
+    echo "$(date -Is) micro pallas A/B rc=$? : $(tail -c 300 scripts/bench_micro_pallas.json)" >> "$LOG"
     # buffer-pool A/B (the round-9 capture): cache on (2GB budget) vs off,
     # SF1 first — hit rates + bytes_saved embed in each bench JSON
     for cfg in "sf1_cache:1:2147483648:900:1200" "sf1_nocache:1:0:900:1200" \
@@ -135,6 +145,12 @@ try:
                            if l.strip()]
 except Exception as e:
     out["micro_curves"] = {"error": str(e)}
+try:
+    out["pallas_micro"] = [json.loads(l) for l in
+                           open("scripts/bench_micro_pallas.json")
+                           if l.strip()]
+except Exception as e:
+    out["pallas_micro"] = {"error": str(e)}
 for name in ("sf1_cache", "sf1_nocache", "sf10_cache", "sf10_nocache"):
     try:
         out[name] = json.load(open(f"scripts/bench_{name}.json"))

@@ -42,6 +42,7 @@ import pathlib
 import pytest
 
 EXEC_DIR = pathlib.Path(__file__).resolve().parent.parent / "trino_tpu" / "exec"
+OPS_DIR = pathlib.Path(__file__).resolve().parent.parent / "trino_tpu" / "ops"
 
 # functions whose BODY may use np.asarray freely, with why:
 ASARRAY_ALLOWED_FUNCS = {
@@ -216,6 +217,46 @@ def test_every_boundary_call_is_attributed(path):
           "is intentionally untagged); named functions self-label for _jit")
 
 
+def _pallas_call_hits(path):
+    """pallas_call(...) invocations missing an ``interpret=`` keyword —
+    both attribute form (pl.pallas_call) and a direct-imported name."""
+    src = path.read_text()
+    hits = []
+    for node in ast.walk(ast.parse(src)):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        named = (isinstance(f, ast.Attribute) and f.attr == "pallas_call") \
+            or (isinstance(f, ast.Name) and f.id == "pallas_call")
+        if named and not any(kw.arg == "interpret" for kw in node.keywords):
+            hits.append(node.lineno)
+    return hits
+
+
+def _ops_files():
+    files = sorted(OPS_DIR.glob("*.py"))
+    assert files, OPS_DIR
+    return files
+
+
+@pytest.mark.parametrize("path", _ops_files(), ids=lambda p: p.name)
+def test_pallas_call_plumbs_interpret(path):
+    """Round-13 rule: every pl.pallas_call in trino_tpu/ops/ must plumb an
+    ``interpret=`` parameter.  A hard-coded device-only kernel can never run
+    on the CPU mesh, which silently exempts it from the tier-1 parity tests —
+    the interpret knob is what makes a Mosaic kernel testable off-device
+    (pallas_kernels.pallas_interpret() is the standard source).  Kernel
+    DISPATCH accounting needs no extra rule: ops kernels only run inside
+    exec's _jit-wrapped step functions, which the exec-side lints above
+    already police, so counters/faults/in-flight coverage is automatic."""
+    hits = _pallas_call_hits(path)
+    assert not hits, (
+        f"{path.name}: pl.pallas_call without interpret= at line(s) "
+        + ", ".join(map(str, hits))
+        + " — plumb interpret (default pallas_kernels.pallas_interpret()) so "
+          "the kernel body runs under the CPU-mesh parity tests")
+
+
 def test_lint_catches_violations(tmp_path):
     """The lint must actually flag what it claims to (guards against the
     visitor silently matching nothing after a refactor)."""
@@ -255,3 +296,18 @@ def test_lint_catches_violations(tmp_path):
     assert [ln for ln, _ in s.device_get_hits] == [15]
     assert [(ln, callee) for ln, _, callee in s.site_hits] == \
         [(21, "_host"), (24, "_jit")]
+    kern = tmp_path / "kern.py"
+    kern.write_text(
+        "from jax.experimental import pallas as pl\n"
+        "from jax.experimental.pallas import pallas_call\n"
+        "def f(x):\n"
+        "    return pl.pallas_call(lambda r, o: None, out_shape=x)(x)\n"  # 4: flagged
+        "def g(x, interp):\n"
+        "    return pl.pallas_call(lambda r, o: None, out_shape=x,\n"
+        "                          interpret=interp)(x)\n"
+        "def h(x):\n"
+        "    return pallas_call(lambda r, o: None, out_shape=x)(x)\n"  # 9: flagged
+        "def k(x, interp):\n"
+        "    return pallas_call(lambda r, o: None, out_shape=x,\n"
+        "                       interpret=interp)(x)\n")
+    assert _pallas_call_hits(kern) == [4, 9]

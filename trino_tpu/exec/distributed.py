@@ -179,6 +179,12 @@ def _multi_probe_expand(node, mt, build_key_types, cols, nulls, valid,
     for i in node.left_keys:
         if nulls[i] is not None:
             kvalid = kvalid & ~nulls[i]
+    # probe_slots (and bucketize below in the partitioned path) pick their
+    # round-13 backend (XLA while_loop vs Pallas kernel) at TRACE time from
+    # static shapes + use_pallas(), so the choice bakes into the fragment
+    # executable exactly like every other plan-shaping fact; inside shard_map
+    # the Pallas path has no while_loop carry to seed, but table operands
+    # still thread through _Stream.aux as JIT arguments (the round-5 rule)
     slot, matched = probe_slots(mt.table, keys, build_key_types, kvalid)
     matched = matched & kvalid
     cnt = jnp.where(matched, mt.counts[slot], 0)

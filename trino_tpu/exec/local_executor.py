@@ -29,6 +29,7 @@ import numpy as np
 from ..connectors.tpch import Dictionary
 from ..execution import faults, tracing
 from ..ops import hashagg
+from ..ops.arrays import compact_rows
 from ..ops.hashing import ceil_pow2
 from ..ops.hashjoin import (DIRECT_JOIN_RANGE_MAX, DirectJoinTable,
                             DirectMultiJoinTable, JoinTable, MultiJoinTable,
@@ -791,19 +792,12 @@ class LocalExecutor:
                 jc = compact_jits.get(bucket)
                 if jc is None:
                     def jc_fn(cols, nulls, valid, bucket=bucket):
-                        # cumsum-scatter pack: linear, no sort; dst slots are
-                        # unique so last-wins scatter is exact
-                        dst, total = _compact_pack(valid)
-                        dst = jnp.minimum(dst, bucket)
-
-                        def pack(a):
-                            return jnp.zeros((bucket + 1,),
-                                             a.dtype).at[dst].set(a)[:bucket]
-
+                        # the shared masked-lane pack (ops/arrays.compact_rows:
+                        # XLA cumsum-scatter, or the round-13 Pallas kernel)
+                        packed, total = compact_rows(
+                            tuple(cols) + tuple(nulls), valid, bucket)
                         cvalid = jnp.arange(bucket) < total
-                        return (tuple(pack(c) for c in cols),
-                                tuple(None if m is None else pack(m)
-                                      for m in nulls), cvalid)
+                        return (packed[:len(cols)], packed[len(cols):], cvalid)
                     jc = _jit(jc_fn)
                     compact_jits[bucket] = jc
                 ccols, cnulls, cvalid = jc(cols, nulls, valid)
@@ -2017,19 +2011,28 @@ class LocalExecutor:
         if hit is None:
             def pstep_body(cols, nulls, valid, node=node):
                 n = valid.shape[0]
-                # order-preserving compaction (cumsum-scatter)
-                dst, count = _compact_pack(valid)
+                # order-preserving compaction of EVERY array this step reads,
+                # in one pack (ops/arrays.compact_rows: XLA cumsum-scatter or
+                # the round-13 Pallas kernel — one launch for the whole page)
+                vn_raw = []
+                for e in acc_exprs:
+                    if e is None:
+                        vn_raw.append(None)
+                        continue
+                    v, nu = evaluate(e, cols, nulls)
+                    v = jnp.broadcast_to(v, valid.shape) if v.ndim == 0 else v
+                    if nu is not None and nu.ndim == 0:
+                        nu = jnp.broadcast_to(nu, valid.shape)
+                    vn_raw.append((v, nu))
+                to_pack = [cols[ch] for ch in node.keys] \
+                    + [nulls[ch] for ch in node.keys] \
+                    + [a for vn in vn_raw if vn is not None for a in vn]
+                packed, count = compact_rows(tuple(to_pack), valid, n)
                 live = jnp.arange(n) < count
-
-                def pack(a):
-                    return jnp.zeros((n + 1,), a.dtype).at[dst].set(a)[:n]
-
-                kcols, knulls = [], []
-                for ch in node.keys:
-                    kcols.append(pack(cols[ch]))
-                    nm = nulls[ch]
-                    knulls.append(pack(nm) if nm is not None
-                                  else jnp.zeros((n,), bool))
+                it = iter(packed)
+                kcols = [next(it) for _ in node.keys]
+                knulls = [kn if kn is not None else jnp.zeros((n,), bool)
+                          for kn in (next(it) for _ in node.keys)]
                 # segment starts: first live row, or any key (value OR null
                 # flag) differing from the previous live row
                 new = jnp.zeros((n,), bool).at[0].set(True)
@@ -2043,15 +2046,8 @@ class LocalExecutor:
                 seg = (jnp.cumsum(new) - 1).astype(jnp.int32)
                 seg = jnp.clip(seg, 0, n - 1)
                 accs = []
-                for e, (dt, init), kind in zip(acc_exprs, acc_specs, acc_kinds):
-                    if e is None:
-                        vn = None
-                    else:
-                        v, nu = evaluate(e, cols, nulls)
-                        v = jnp.broadcast_to(v, valid.shape) if v.ndim == 0 else v
-                        if nu is not None and nu.ndim == 0:
-                            nu = jnp.broadcast_to(nu, valid.shape)
-                        vn = (pack(v), None if nu is None else pack(nu))
+                for vn_r, (dt, init), kind in zip(vn_raw, acc_specs, acc_kinds):
+                    vn = None if vn_r is None else (next(it), next(it))
                     acc0 = jnp.full((n + 1,), init, dtype=dt)
                     # segment ids play the slot role: agg_update IS the
                     # segmented reduce (pads mask to the sink row)
@@ -4056,17 +4052,6 @@ def _plan_fingerprint(node: P.PlanNode, catalogs: dict) -> str:
             val(getattr(n, f.name)) for f in dataclasses.fields(n)) + ")"
 
     return fp(node)
-
-
-def _compact_pack(valid):
-    """Order-preserving compaction targets: (dst, count) — row i scatters to
-    dst[i] (invalid rows to the sink at n), live rows occupy [0, count).  The
-    one cumsum-scatter pack the boundary compaction and the streaming
-    aggregation share."""
-    n = valid.shape[0]
-    pos = jnp.cumsum(valid) - 1
-    dst = jnp.where(valid, pos, n).astype(jnp.int32)
-    return dst, jnp.sum(valid)
 
 
 def _prefetched_pages(pages_fn, depth: int = 2, to_device: bool = False,

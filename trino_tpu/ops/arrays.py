@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["SPAN_BITS", "ArrayData", "MapData", "pack_span", "span_start",
-           "span_len", "encode_arrays"]
+           "span_len", "encode_arrays", "compact_rows"]
 
 SPAN_BITS = 24  # max 16M elements per array; 2^39 heap rows
 _LEN_MASK = (1 << SPAN_BITS) - 1
@@ -125,6 +125,43 @@ def encode_arrays(rows, elem_dtype, encoder=None):
         flat.extend(vals)
     heap = np.asarray(flat, dtype=elem_dtype) if flat else np.zeros(0, elem_dtype)
     return spans, (nulls if nulls.any() else None), heap
+
+
+def compact_rows(arrays, valid, out_len: int):
+    """Order-preserving masked-lane pack, THE shared filter->compaction step:
+    live lanes move to the front of ``out_len``-sized outputs (zeros beyond
+    the live count, overflow lanes dropped), ``None`` entries pass through.
+    Returns (packed tuple, live-count device scalar).
+
+    Consumers: the pipeline-boundary compaction and streaming-agg pre-pack
+    (exec/local_executor) and the exchange bucketizer (ops/exchange) — all
+    three used to hand-roll the same cumsum-scatter.  Round-13 backend split:
+    `pallas_kernels.compact_columns` (block prefix-sum + one-hot matmul, one
+    kernel launch for the whole page) when `use_pallas()` and the packed
+    output fits the VMEM gate; the XLA cumsum-scatter below otherwise.
+    Byte-identical by contract (tests/test_pallas_kernels.py pins it)."""
+    from . import pallas_kernels as pk
+
+    arrs = [a for a in arrays if a is not None]
+    if not arrs:
+        return tuple(arrays), jnp.sum(valid)
+    n = valid.shape[0]
+    if pk.compact_enabled(n, out_len, pk.compact_limbs(arrs)):
+        packed, total = pk.compact_columns(tuple(arrs), valid, out_len)
+        it = iter(packed)
+        return tuple(None if a is None else next(it) for a in arrays), total
+    # XLA path: cumsum-scatter pack — linear, no sort; dst slots are unique
+    # (plus the clamped drop sink) so last-wins scatter is exact.  Invalid
+    # rows route straight to the drop slot at out_len: clamping a shared
+    # where(..., n) would leak an invalid row's value INTO the output
+    # whenever out_len > n
+    pos = jnp.cumsum(valid) - 1
+    dst = jnp.where(valid, jnp.minimum(pos, out_len), out_len)
+    packed = tuple(
+        None if a is None
+        else jnp.zeros((out_len + 1,), a.dtype).at[dst].set(a)[:out_len]
+        for a in arrays)
+    return packed, jnp.sum(valid)
 
 
 def unnest_indices(lens, total: int):

@@ -39,8 +39,32 @@ def bucketize(cols, valid, pid, n_partitions: int, bucket: int):
 
     Returns (packed_cols, packed_valid, overflow): row r of partition p lands at
     p * bucket + rank_of_r_within_p; slots beyond a partition's row count are invalid.
+
+    Round-13 backend split: with `use_pallas()` the partitioned pack runs as
+    ``n_partitions`` sequential masked compactions (ops/arrays.compact_rows —
+    the block prefix-sum scatter kernel), one per destination bucket, instead
+    of one global stable sort; byte-identical layout (stable sort preserves
+    within-partition order, and so does each compaction).  Runs inside
+    shard_map on the distributed path — the python loop is trace-time static.
     """
+    from .arrays import compact_rows
+    from .pallas_kernels import compact_enabled, compact_limbs, use_pallas
+
     n = pid.shape[0]
+    if use_pallas() and n and compact_enabled(n, bucket, compact_limbs(cols)):
+        packed_p, counts = [], []
+        for p in range(n_partitions):
+            sel = valid & (pid == p)
+            pp, cnt = compact_rows(tuple(cols), sel, bucket)
+            packed_p.append(pp)
+            counts.append(cnt)
+        packed = tuple(
+            jnp.concatenate([pp[i] for pp in packed_p])
+            for i in range(len(cols)))
+        counts = jnp.stack(counts)
+        out_valid = (jnp.arange(bucket)[None, :]
+                     < jnp.minimum(counts, bucket)[:, None]).reshape(-1)
+        return packed, out_valid, jnp.any(counts > bucket)
     sort_key = jnp.where(valid, pid, n_partitions)  # invalid rows sort to the end
     order = jnp.argsort(sort_key, stable=True)
     sorted_pid = sort_key[order]

@@ -237,8 +237,21 @@ def _onehot_agg_update(acc, kind, onehot, vals_nulls):
 
 def _probe_insert(table, packed, valid):
     """Assign each valid row a slot whose table word == its packed key; claim empty slots
-    deterministically. Returns (table, slot[int32], placed[bool])."""
+    deterministically. Returns (table, slot[int32], placed[bool]).
+
+    Round-13 backend split: capacities within `PALLAS_TABLE_MAX` route to the
+    in-kernel claim loop (`pallas_kernels.hash_insert`).  Its contention
+    winner differs (min row index vs scatter-min over packed words) so the
+    slot LAYOUT may differ from this XLA protocol, but both preserve the
+    open-addressing chain invariant — probes and multi-page re-inserts against
+    either table are key-equivalent, which is the contract every consumer
+    (state threading, rehash, build tables) actually relies on.  Parity tests
+    pin the observables; never assert raw slot order across backends."""
+    from . import pallas_kernels as pk
+
     C = table.shape[0] - 1
+    if pk.table_kernels_enabled(C) and packed.shape[0]:
+        return pk.hash_insert(table, packed, valid, max_probes=MAX_PROBES)
     h0 = splitmix64(packed)
     stp = probe_step(h0)
     # derive every loop carry from the (possibly device-varying) inputs: under

@@ -104,11 +104,22 @@ def build_insert(jt: JoinTable, key_cols, key_types, valid) -> JoinTable:
 
 
 def probe(jt: JoinTable, key_cols, key_types, valid):
-    """Gather-only probe: returns (build_row_ids[int32], matched[bool]) per probe row."""
+    """Gather-only probe: returns (build_row_ids[int32], matched[bool]) per probe row.
+
+    Backend selection (round 13): small/medium tables route to the Pallas
+    tensor-program probe (`pallas_kernels.hash_probe` — same hash family,
+    same probe order, bit-identical outputs); the XLA while_loop below is the
+    fallback and the only path above `PALLAS_TABLE_MAX`.  The choice is
+    trace-time static (capacity is a shape), so compiled streams bake it in."""
+    from . import pallas_kernels as pk
+
     packed, _ = pack_keys(key_cols, key_types)
     C = jt.capacity
     h0 = splitmix64(packed)
     stp = probe_step(h0)
+    if pk.table_kernels_enabled(C) and packed.shape[0]:
+        return pk.hash_probe(jt.table[:C], jt.rows[:C], packed, h0, stp, valid,
+                             max_probes=MAX_PROBES)
     # derive the loop carries from BOTH operands' varying axes: under
     # shard_map, fresh constants are "unvarying" and the while_loop rejects a
     # carry the body mixes with per-worker data.  Keys alone are not enough —
@@ -339,11 +350,20 @@ def multi_build(capacity: int, build_page, key_channels, key_types) -> MultiJoin
 
 
 def probe_slots(table, key_cols, key_types, valid):
-    """Gather-only probe returning (slot[int32], matched[bool]) per probe row."""
+    """Gather-only probe returning (slot[int32], matched[bool]) per probe row.
+
+    Same round-13 backend split as probe(): the Pallas kernel returns the
+    matching slot itself (per-slot payload = iota), bit-identical to the
+    while_loop; XLA remains the fallback above the capacity cap."""
+    from . import pallas_kernels as pk
+
     packed, _ = pack_keys(key_cols, key_types)
     C = table.shape[0] - 1
     h0 = splitmix64(packed)
     stp = probe_step(h0)
+    if pk.table_kernels_enabled(C) and packed.shape[0]:
+        return pk.hash_probe(table[:C], jnp.arange(C, dtype=jnp.int32),
+                             packed, h0, stp, valid, max_probes=MAX_PROBES)
     # carries derive from BOTH operands so they inherit every varying axis a
     # body output can carry (see probe() above: constant keys + per-worker
     # table would otherwise mismatch the while_loop carry types)

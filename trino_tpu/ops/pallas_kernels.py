@@ -1,43 +1,87 @@
-"""Pallas TPU kernels for the aggregation hot path + the engineering record of
-what does and does not belong in Pallas for a SQL engine on TPU.
+"""Pallas TPU kernels for the scatter/gather-bound hot loops + the engineering
+record of what does and does not belong in Pallas for a SQL engine on TPU.
 
 The reference's native-performance surface is runtime bytecode generation and
 Java Vector-API SIMD (SURVEY.md §2: sql/gen/*, simd/BlockEncodingSimdSupport);
 the TPU build's equivalents are jit-traced XLA programs plus, where profitable,
-hand-written Mosaic kernels.  Findings from building these (measured on
-TPU v5e-1, 2M rows):
+hand-written Mosaic kernels.  Round 3 findings (kept below: fused_segment_agg);
+round 13 adds the three scatter/gather-bound inner loops as selectable backends
+behind the XLA paths (ROADMAP item 2):
 
-1. `fused_segment_agg` below computes EVERY accumulator of a <=128-slot
+1. `fused_segment_agg` computes EVERY accumulator of a <=128-slot
    direct-indexed GROUP BY in one pass (one-hot x values matmul per block,
    grid-accumulated in VMEM).  It compiles and runs at memory bandwidth —
    88us vs XLA's 57us for 8 accumulators: XLA's fusion of the masked-reduce
    form is already optimal, so the engine keeps the XLA path by default and
-   this kernel is the documented alternative (`use_pallas=True`).
-2. A VMEM-resident hash table (the FlatHash/JoinHash analog) is NOT
-   expressible in Mosaic today: per-element vector indexing of a ref raises
-   "Cannot do int indexing on TPU", and `jnp.take` lowers only for 2D
-   same-lane gathers.  Arbitrary cross-lane gathers are exactly what an
-   open-addressing probe needs, so hash probes stay XLA `gather`s in HBM —
-   and the planner's direct-index joins/group-bys (slot = key - lo) remove
-   the hash entirely for dense keys, which is the bigger win on TPU.
-3. Mosaic is 32-bit: under the engine's global x64 session, kernels must be
-   built inside `with jax.enable_x64(False)` and i64 key words must be split
-   into (hi32, lo32) pairs before entering a kernel.
+   this kernel is the documented alternative (`use_pallas=True` kwarg).
+2. A VMEM-resident hash table is NOT expressible as direct vector indexing:
+   per-element indexing of a ref raises "Cannot do int indexing on TPU", and
+   `jnp.take` lowers only for 2D same-lane gathers.  Round 13's answer is to
+   RESTATE the probe as a tensor program with no gather at all (the TQP move,
+   arxiv 2203.01877): because the double-hash step is ODD and capacities are
+   powers of two, the probe round at which row r visits slot s INVERTS in
+   closed form — p_r(s) = ((s - h0_r) * stp_r^{-1}) mod C, a few int32 ops —
+   so `hash_probe` streams the whole table through VMEM tiles ONCE, compares
+   every (row, slot) pair, and min-reduces the candidate rounds.  Hit iff the
+   matching slot's round precedes both MAX_PROBES and the nearest EMPTY
+   along the chain.  O(rows x capacity) VPU compares replace O(rows x rounds)
+   HBM gathers; `PALLAS_TABLE_MAX` caps the capacities where that trade can
+   win and the XLA path remains above it.
+3. `hash_insert` keeps the XLA claim protocol's shape (rounds of
+   probe/claim/re-check) but runs it block-sequentially over the TPU's
+   sequential grid with the table carried in VMEM; slot contention resolves
+   by MIN ROW INDEX (deterministic) instead of scatter-min over packed
+   words.  The resulting LAYOUT can differ from the XLA table, but both
+   protocols preserve the open-addressing chain invariant (a key sits on its
+   own probe chain behind no EMPTY slot), so probes against either table
+   return identical (row_ids, matched) and aggregation states are
+   key-equivalent — parity is defined on those observables, never on raw
+   slot order (tests/test_pallas_kernels.py pins both).
+4. `compact_rows_matrix` packs masked lanes to the front (the
+   filter->compaction step) as a block-local prefix-sum + one-hot matmul:
+   16-bit limbs make the f32 MXU products exact, and the running offset
+   rides an SMEM output across the sequential grid.  Columns of any dtype
+   ride one [n, limbs] int32 matrix (bitcast outside the kernel).
+5. Mosaic is 32-bit: under the engine's global x64 session, kernels are
+   built inside `with jax.enable_x64(False)` and i64 words are split into
+   (hi32, lo32) pairs before entering a kernel
+   (`jax.lax.bitcast_convert_type`, element 0 = low word).
 
-Precision contract: counts accumulate in int32 (exact to 2^31 rows); sums run
-on the MXU in float32 and are offered for DOUBLE inputs only (SQL float sums
-carry no exactness/ordering guarantee); decimal/bigint sums must stay on the
-exact XLA int64 path.
+Selection is the single chokepoint `use_pallas()`: default ON when the
+backend is TPU, OFF on CPU (the XLA fallback is unchanged);
+`TRINO_TPU_PALLAS=1/0` forces either way, with `interpret=True` whenever the
+backend is not TPU so tier-1 exercises the real kernel bodies on the CPU
+mesh.  The env var is read at TRACE time: flipping it in-process requires
+fresh executors plus `jax.clear_caches()` (module-level jits like
+hashjoin._multi_build_jit bake the choice into their cached executables) —
+which is also why there is deliberately NO session property: kernel choice
+shapes compiled streams, so any future property variant must ride
+`engine._plan_shape_props` (CLAUDE.md round-13 notes).
+
+Precision contract: fused_segment_agg counts accumulate in int32 (exact to
+2^31 rows); sums run on the MXU in float32 and are offered for DOUBLE inputs
+only.  The round-13 kernels are bit-exact by construction: table words
+compare as (hi32, lo32) pairs, compaction moves 16-bit limbs through f32
+one-hot matmuls whose products are exact, and every value re-enters the x64
+world by bitcast, not conversion.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["fused_segment_agg", "ONEHOT_BLOCK"]
+from .hashing import EMPTY_KEY, probe_step, splitmix64
+
+__all__ = ["fused_segment_agg", "ONEHOT_BLOCK", "use_pallas", "pallas_interpret",
+           "force", "table_kernels_enabled", "compact_enabled", "compact_limbs",
+           "hash_probe",
+           "hash_insert", "compact_rows_matrix", "compact_columns",
+           "PALLAS_TABLE_MAX", "PROBE_BLOCK", "INSERT_BLOCK", "COMPACT_BLOCK",
+           "TABLE_TILE", "COMPACT_VMEM_I32_MAX", "MAX_PROBES"]
 
 try:  # jax >= 0.5 exports the x64-scoping context manager at the top level
     _enable_x64 = jax.enable_x64
@@ -46,7 +90,491 @@ except AttributeError:  # older jax (this container's 0.4.x)
 
 ONEHOT_BLOCK = 2048
 
+MAX_PROBES = 64  # must match ops/hashjoin.py / ops/hashagg.py
 
+# Crossover caps.  hash_probe/hash_insert pay O(rows x capacity) VPU compares
+# for the gather-free formulation: past ~64K slots the table scan loses to
+# XLA's HBM gathers even on a tunneled device, and the VMEM-resident table
+# (3-4 int32 arrays) stops fitting comfortably anyway.  compact's packed
+# output block stays VMEM-resident across the grid, so its bound is the
+# resident int32 lane count.
+PALLAS_TABLE_MAX = 1 << 16
+COMPACT_VMEM_I32_MAX = 1 << 20  # 4MB of resident packed output
+
+PROBE_BLOCK = 256
+INSERT_BLOCK = 256
+COMPACT_BLOCK = 256
+TABLE_TILE = 512
+
+_FORCE: bool | None = None  # tests/bench override; trace-time, like the env
+
+
+def force(mode: bool | None) -> None:
+    """Test/bench override for `use_pallas()` (None = back to env/backend).
+    TRACE-time only: never flip it across calls of one jitted callable —
+    build a fresh jit per mode (bench_micro's *_ab kernels) or
+    `jax.clear_caches()` first (tests/test_pallas_kernels.py)."""
+    global _FORCE
+    _FORCE = mode
+
+
+def use_pallas() -> bool:
+    """THE backend-selection chokepoint (read at trace time)."""
+    if _FORCE is not None:
+        return _FORCE
+    env = os.environ.get("TRINO_TPU_PALLAS")
+    if env not in (None, ""):
+        return env not in ("0", "false", "off")
+    return jax.default_backend() == "tpu"
+
+
+def pallas_interpret() -> bool:
+    """Interpret mode whenever the backend cannot compile Mosaic: the CPU
+    mesh runs the REAL kernel bodies through the Pallas interpreter, which is
+    what makes the parity tests tier-1 instead of device-only."""
+    return jax.default_backend() != "tpu"
+
+
+def table_kernels_enabled(capacity: int) -> bool:
+    """Gate for hash_probe/hash_insert at a static table capacity."""
+    return use_pallas() and 2 <= capacity <= PALLAS_TABLE_MAX
+
+
+def compact_limbs(cols) -> int:
+    """int32 limbs one row occupies in compact_columns' [n, limbs] matrix —
+    THE shared definition for every compact gate (arrays.compact_rows,
+    exchange.bucketize): a drifted copy would let a caller commit to the
+    Pallas strategy while the inner pack silently falls back to XLA."""
+    return sum(2 if c.dtype.itemsize == 8 else 1 for c in cols) if cols else 1
+
+
+def compact_enabled(n_rows: int, out_len: int, n_limbs: int) -> bool:
+    """Gate for compact_rows_matrix: the packed output ([out_len + block,
+    n_limbs] int32) must stay comfortably VMEM-resident."""
+    return (use_pallas() and n_rows >= 1
+            and (out_len + COMPACT_BLOCK) * max(n_limbs, 1)
+            <= COMPACT_VMEM_I32_MAX)
+
+
+# ------------------------------------------------------------------ 32-bit prep
+# Mosaic is 32-bit; every 64-bit word crosses the kernel boundary as a
+# (hi32, lo32) pair via bitcast (element 0 = low word), never by conversion.
+
+# int64-max sentinel split into int32 words (plain python ints: no device
+# array may be built at import time — axon plugin discovery, CLAUDE.md)
+_EMPTY_HI32 = (1 << 31) - 1
+_EMPTY_LO32 = -1
+
+
+def _split32(x):
+    """int64 [n] -> (hi, lo) int32 pair."""
+    w = jax.lax.bitcast_convert_type(x, jnp.int32)
+    return w[..., 1], w[..., 0]
+
+
+def _lo32(x):
+    return jax.lax.bitcast_convert_type(x, jnp.int32)[..., 0]
+
+
+def _combine64(hi, lo):
+    return jax.lax.bitcast_convert_type(jnp.stack([lo, hi], axis=-1), jnp.int64)
+
+
+def _modinv_odd32(a):
+    """Inverse of an odd int32 word mod 2^32 (Newton; 3->6->12->24->48 bits).
+    probe_step() forces the double-hash step odd exactly so this exists."""
+    x = a
+    for _ in range(5):
+        x = x * (2 - a * x)
+    return x
+
+
+def _pad_to(block, *arrays):
+    n = arrays[0].shape[0]
+    pad = (-n) % block
+    if not pad:
+        return arrays
+    return tuple(jnp.concatenate([a, jnp.zeros((pad,) + a.shape[1:], a.dtype)])
+                 for a in arrays)
+
+
+def _tile_loop(n_tiles: int, body, init):
+    """int32-explicit counted loop for KERNEL bodies.  lax.fori_loop is a trap
+    here: interpret-mode kernels re-trace at LOWERING time, outside the
+    `_enable_x64(False)` scope, so fori's weak python-int bound/increment
+    constants materialize as i64 against an i32 induction variable and MLIR
+    verification fails ("op requires the same element type").  Every loop
+    constant below carries an explicit dtype, which is phase-robust."""
+
+    def cond(c):
+        return c[0] < jnp.int32(n_tiles)
+
+    def step(c):
+        t, carry = c
+        return (t + jnp.int32(1), body(t, carry))
+
+    return jax.lax.while_loop(cond, step, (jnp.int32(0), init))[1]
+
+
+# ------------------------------------------------------------------- hash probe
+@functools.partial(jax.jit, static_argnames=("max_probes", "interpret"))
+def hash_probe(table, vals, packed, h0, stp, valid, max_probes: int = MAX_PROBES,
+               interpret: bool | None = None):
+    """Open-addressed probe as a gather-free tensor program.
+
+    table:  [C] int64 packed keys (the [:capacity] slice, pow2 C)
+    vals:   [C] int32 per-slot payload (rows for probe(), iota for
+            probe_slots()) — the matching slot's value returns in-pass
+    packed/h0/stp: [n] int64 per-row key word, splitmix64 hash, odd step
+    valid:  [n] bool
+    returns (vals[match_slot] | 0, matched) — bit-identical to the XLA
+    while_loop probe over the same table.
+
+    Inner loop: stream table tiles through VMEM; for every (row, slot) pair
+    recover the probe round p = ((s - h0) * stp^-1) & (C-1) and min-reduce
+    the rounds of key-matching and EMPTY slots; a row matches iff its hit
+    round precedes both the nearest EMPTY and max_probes.  Work is
+    O(n x C) int32 VPU ops with zero gathers — see module docstring for the
+    crossover cap."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = pallas_interpret()
+    C = table.shape[0]
+    n = packed.shape[0]
+    T = min(TABLE_TILE, C)
+    B = PROBE_BLOCK
+    th, tl = _split32(table)
+    ph, plo = _split32(packed)
+    h0lo = _lo32(h0)
+    inv = _modinv_odd32(_lo32(stp))
+    ph, plo, h0lo, inv, valid = _pad_to(B, ph, plo, h0lo, inv, valid)
+
+    def kernel(th_ref, tl_ref, tv_ref, h0_ref, inv_ref, ph_ref, plo_ref, v_ref,
+               val_ref, m_ref):
+        rh0 = h0_ref[...]
+        rinv = inv_ref[...]
+        rph = ph_ref[...]
+        rplo = plo_ref[...]
+        cmask = jnp.int32(C - 1)
+        big = jnp.int32(2**31 - 1)
+
+        def tile(t, carry):
+            hitp, emptyp, val = carry
+            s0 = t * jnp.int32(T)
+            tth = th_ref[pl.ds(s0, T)]
+            ttl = tl_ref[pl.ds(s0, T)]
+            ttv = tv_ref[pl.ds(s0, T)]
+            svec = s0 + jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+            p_rs = ((svec - rh0[:, None]) * rinv[:, None]) & cmask
+            match = (tth[None, :] == rph[:, None]) & (ttl[None, :] == rplo[:, None])
+            empty = (tth == jnp.int32(_EMPTY_HI32)) & (ttl == jnp.int32(_EMPTY_LO32))
+            hitp = jnp.minimum(hitp, jnp.min(jnp.where(match, p_rs, big), axis=1))
+            emptyp = jnp.minimum(
+                emptyp, jnp.min(jnp.where(empty[None, :], p_rs, big), axis=1))
+            val = val + jnp.sum(jnp.where(match, ttv[None, :], jnp.int32(0)), axis=1)
+            return hitp, emptyp, val
+
+        init = (jnp.full((B,), big, jnp.int32), jnp.full((B,), big, jnp.int32),
+                jnp.zeros((B,), jnp.int32))
+        hitp, emptyp, val = _tile_loop(C // T, tile, init)
+        matched = v_ref[...] & (hitp < jnp.int32(max_probes)) & (hitp < emptyp)
+        m_ref[...] = matched.astype(jnp.int32)
+        val_ref[...] = jnp.where(matched, val, jnp.int32(0))
+
+    with _enable_x64(False):
+        val, matched = pl.pallas_call(
+            kernel,
+            grid=(ph.shape[0] // B,),
+            in_specs=[
+                pl.BlockSpec((C,), lambda i: (0,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((C,), lambda i: (0,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((C,), lambda i: (0,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.VMEM),
+            ],
+            out_specs=(
+                pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.VMEM),
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct((ph.shape[0],), jnp.int32),
+                jax.ShapeDtypeStruct((ph.shape[0],), jnp.int32),
+            ),
+            interpret=interpret,
+        )(th, tl, vals.astype(jnp.int32), h0lo, inv, ph, plo, valid)
+    return val[:n], matched[:n] != 0
+
+
+# ------------------------------------------------------------------ hash insert
+@functools.partial(jax.jit, static_argnames=("max_probes", "interpret"))
+def hash_insert(table, packed, valid, max_probes: int = MAX_PROBES,
+                interpret: bool | None = None):
+    """CAS-style claim loop for open-addressing insertion, in-kernel.
+
+    table: [C+1] int64 (sink last), packed/valid per row.  Returns
+    (table', slot[int32], placed[bool]) — the same contract as
+    hashagg._probe_insert.  Row blocks advance through the TPU's SEQUENTIAL
+    grid with the table carried in VMEM; per block the XLA protocol's rounds
+    run to completion (probe -> claim EMPTY by min row index -> re-check the
+    claimed word) before the next block starts.  Claim order therefore
+    differs from the XLA scatter-min protocol and the slot LAYOUT may too —
+    both keep the chain invariant, so the tables are probe-equivalent (see
+    module docstring; parity is pinned on observables)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = pallas_interpret()
+    C = table.shape[0] - 1
+    n = packed.shape[0]
+    T = min(TABLE_TILE, C)
+    B = INSERT_BLOCK
+    h0 = splitmix64(packed)
+    stp = probe_step(h0)
+    th0, tl0 = _split32(table[:C])
+    ph, plo = _split32(packed)
+    h0lo = _lo32(h0)
+    stplo = _lo32(stp)
+    ph, plo, h0lo, stplo, valid_p = _pad_to(B, ph, plo, h0lo, stplo, valid)
+
+    def kernel(th_in, tl_in, ph_ref, plo_ref, h0_ref, stp_ref, v_ref,
+               th_out, tl_out, slot_ref, placed_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == jnp.int32(0))
+        def _():
+            th_out[...] = th_in[...]
+            tl_out[...] = tl_in[...]
+
+        rph = ph_ref[...]
+        rplo = plo_ref[...]
+        rh0 = h0_ref[...]
+        rstp = stp_ref[...]
+        v = v_ref[...]
+        cmask = jnp.int32(C - 1)
+        bigr = jnp.int32(2**31 - 1)
+        rloc = jax.lax.broadcasted_iota(jnp.int32, (B, 1), 0)[:, 0]
+        th = th_out[...]
+        tl = tl_out[...]
+
+        def gather(th, tl, idx):
+            def tile(t, cur):
+                ch, cl = cur
+                s0 = t * jnp.int32(T)
+                tth = jax.lax.dynamic_slice(th, (s0,), (T,))
+                ttl = jax.lax.dynamic_slice(tl, (s0,), (T,))
+                svec = s0 + jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+                m = idx[:, None] == svec
+                ch = ch + jnp.sum(jnp.where(m, tth[None, :], jnp.int32(0)), axis=1)
+                cl = cl + jnp.sum(jnp.where(m, ttl[None, :], jnp.int32(0)), axis=1)
+                return ch, cl
+
+            z = jnp.zeros((B,), jnp.int32)
+            return _tile_loop(C // T, tile, (z, z))
+
+        def cond(carry):
+            p = carry[0]
+            placed = carry[3]
+            return (p < jnp.int32(max_probes)) & ~jnp.all(placed)
+
+        def body(carry):
+            p, th, tl, placed, slot = carry
+            idx = (rh0 + p * rstp) & cmask
+            ch, cl = gather(th, tl, idx)
+            hit = (ch == rph) & (cl == rplo) & ~placed
+            slot = jnp.where(hit, idx, slot)
+            placed = placed | hit
+            contend = ((ch == jnp.int32(_EMPTY_HI32))
+                       & (cl == jnp.int32(_EMPTY_LO32)) & ~placed)
+
+            def claim(t, carry2):
+                th, tl, c2h, c2l = carry2
+                s0 = t * jnp.int32(T)
+                tth = jax.lax.dynamic_slice(th, (s0,), (T,))
+                ttl = jax.lax.dynamic_slice(tl, (s0,), (T,))
+                svec = s0 + jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+                hits_t = idx[:, None] == svec
+                m = hits_t & contend[:, None]
+                win = jnp.min(jnp.where(m, rloc[:, None], bigr), axis=0)
+                claimed = win < bigr
+                wonrow = m & (rloc[:, None] == win[None, :])
+                wph = jnp.sum(jnp.where(wonrow, rph[:, None], jnp.int32(0)), axis=0)
+                wpl = jnp.sum(jnp.where(wonrow, rplo[:, None], jnp.int32(0)), axis=0)
+                nth = jnp.where(claimed, wph, tth)
+                ntl = jnp.where(claimed, wpl, ttl)
+                th = jax.lax.dynamic_update_slice(th, nth, (s0,))
+                tl = jax.lax.dynamic_update_slice(tl, ntl, (s0,))
+                c2h = c2h + jnp.sum(jnp.where(hits_t, nth[None, :], jnp.int32(0)), axis=1)
+                c2l = c2l + jnp.sum(jnp.where(hits_t, ntl[None, :], jnp.int32(0)), axis=1)
+                return th, tl, c2h, c2l
+
+            z = jnp.zeros((B,), jnp.int32)
+            th, tl, c2h, c2l = _tile_loop(C // T, claim, (th, tl, z, z))
+            won = contend & (c2h == rph) & (c2l == rplo)
+            slot = jnp.where(won, idx, slot)
+            placed = placed | won
+            return p + jnp.int32(1), th, tl, placed, slot
+
+        init = (jnp.int32(0), th, tl, ~v, jnp.full((B,), C, jnp.int32))
+        _, th, tl, placed, slot = jax.lax.while_loop(cond, body, init)
+        th_out[...] = th
+        tl_out[...] = tl
+        slot_ref[...] = slot
+        placed_ref[...] = placed.astype(jnp.int32)
+
+    with _enable_x64(False):
+        th2, tl2, slot, placed = pl.pallas_call(
+            kernel,
+            grid=(ph.shape[0] // B,),
+            in_specs=[
+                pl.BlockSpec((C,), lambda i: (0,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((C,), lambda i: (0,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.VMEM),
+            ],
+            out_specs=(
+                pl.BlockSpec((C,), lambda i: (0,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((C,), lambda i: (0,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.VMEM),
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct((C,), jnp.int32),
+                jax.ShapeDtypeStruct((C,), jnp.int32),
+                jax.ShapeDtypeStruct((ph.shape[0],), jnp.int32),
+                jax.ShapeDtypeStruct((ph.shape[0],), jnp.int32),
+            ),
+            interpret=interpret,
+        )(th0, tl0, ph, plo, h0lo, stplo, valid_p)
+    # sink word derives from the INPUT table (x*0 + sentinel), not a fresh
+    # constant: under shard_map a fresh constant is "unvarying" while the
+    # table is per-worker — the round-5 varying-axis seeding rule
+    sink = table[C:] * 0 + EMPTY_KEY
+    new_table = jnp.concatenate([_combine64(th2, tl2), sink])
+    return new_table, slot[:n], placed[:n] != 0
+
+
+# -------------------------------------------------------------- compaction pack
+@functools.partial(jax.jit, static_argnames=("out_len", "interpret"))
+def compact_rows_matrix(mat, valid, out_len: int, interpret: bool | None = None):
+    """Order-preserving masked-lane pack: [n, L] int32 -> [out_len, L].
+
+    Block-local prefix sum (lower-triangular one-hot matmul — exact in f32
+    for block counts << 2^24) places each live row; values move through a
+    [block, block] one-hot matmul over 16-bit limbs (exact products); the
+    running output offset rides an SMEM output across the sequential grid.
+    Rows past ``out_len`` drop into a write-and-discard pad zone — the same
+    semantics as the XLA cumsum-scatter's clamped sink.  Returns
+    (packed, total_live_count)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = pallas_interpret()
+    n, L = mat.shape
+    B = COMPACT_BLOCK
+    mat, valid = _pad_to(B, mat, valid)
+
+    def kernel(v_ref, m_ref, out_ref, off_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == jnp.int32(0))
+        def _():
+            out_ref[...] = jnp.zeros_like(out_ref)
+            off_ref[0] = jnp.int32(0)
+
+        v = v_ref[...]
+        vf = v.astype(jnp.float32)
+        tri = (jax.lax.broadcasted_iota(jnp.int32, (B, B), 0)
+               >= jax.lax.broadcasted_iota(jnp.int32, (B, B), 1)).astype(jnp.float32)
+        pos = jax.lax.dot_general(
+            tri, vf[:, None], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)[:, 0].astype(jnp.int32) - jnp.int32(1)
+        dst = jnp.where(v, pos, jnp.int32(B))
+        j = jax.lax.broadcasted_iota(jnp.int32, (B, 1), 0)[:, 0]
+        onehot = (dst[None, :] == j[:, None]).astype(jnp.float32)  # [out, in]
+        m = m_ref[...]
+        lo16 = (m & jnp.int32(0xFFFF)).astype(jnp.float32)
+        hi16 = ((m >> jnp.int32(16)) & jnp.int32(0xFFFF)).astype(jnp.float32)
+        plo = jax.lax.dot_general(
+            onehot, lo16, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(jnp.int32)
+        phi = jax.lax.dot_general(
+            onehot, hi16, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(jnp.int32)
+        pk = (phi << jnp.int32(16)) | plo
+        start = jnp.minimum(off_ref[0], jnp.int32(out_len))
+        out_ref[pl.ds(start, B), :] = pk
+        off_ref[0] = off_ref[0] + jnp.sum(v.astype(jnp.int32))
+
+    with _enable_x64(False):
+        out, off = pl.pallas_call(
+            kernel,
+            grid=(mat.shape[0] // B,),
+            in_specs=[
+                pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.VMEM),
+                pl.BlockSpec((B, L), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=(
+                pl.BlockSpec((out_len + B, L), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1,), lambda i: (0,), memory_space=pltpu.SMEM),
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct((out_len + B, L), jnp.int32),
+                jax.ShapeDtypeStruct((1,), jnp.int32),
+            ),
+            interpret=interpret,
+        )(valid, mat)
+    return out[:out_len], off[0]
+
+
+def compact_columns(cols, valid, out_len: int, interpret: bool | None = None):
+    """Dtype-generic wrapper over compact_rows_matrix: every column rides the
+    one [n, limbs] int32 matrix (64-bit and f32 words by bitcast — exact —
+    bool/narrow ints by widening), one kernel launch for the whole page.
+    Returns (packed column tuple, total live count)."""
+    parts, specs = [], []
+    for a in cols:
+        d = a.dtype
+        if d == jnp.bool_:
+            parts.append(a.astype(jnp.int32)[:, None])
+            specs.append((d, 1))
+        elif d.itemsize == 8:
+            parts.append(jax.lax.bitcast_convert_type(a, jnp.int32))
+            specs.append((d, 2))
+        elif d.itemsize == 4:
+            parts.append(jax.lax.bitcast_convert_type(a, jnp.int32)[:, None])
+            specs.append((d, 1))
+        else:  # int8/int16: widen exactly, narrow back after
+            parts.append(a.astype(jnp.int32)[:, None])
+            specs.append((d, 1))
+    mat = jnp.concatenate(parts, axis=1)
+    packed, total = compact_rows_matrix(mat, valid, out_len, interpret=interpret)
+    outs, o = [], 0
+    for d, w in specs:
+        seg = packed[:, o:o + w]
+        o += w
+        if d == jnp.bool_:
+            outs.append(seg[:, 0] != 0)
+        elif d.itemsize == 8:
+            outs.append(jax.lax.bitcast_convert_type(seg, d))
+        elif d.itemsize == 4:
+            outs.append(jax.lax.bitcast_convert_type(seg[:, 0], d))
+        else:
+            outs.append(seg[:, 0].astype(d))
+    return tuple(outs), total
+
+
+# --------------------------------------------------------- fused segment agg
 @functools.partial(jax.jit, static_argnames=("n_slots", "interpret"))
 def fused_segment_agg(slot, valid, value_cols, n_slots: int, interpret: bool = False):
     """All-in-one-pass segment aggregation for a direct-indexed group-by.
