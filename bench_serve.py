@@ -16,10 +16,12 @@ protocol, nextUri paging, real HTTP):
   each request timed from its SCHEDULED arrival (so queueing delay counts,
   the latency a user actually sees when the engine falls behind).
 
-The mixed workload has four classes (warm TPC-H + point lookups + short
+The mixed workload has five classes (warm TPC-H + point lookups with
+per-request DISTINCT constants + protocol-parameterized EXECUTE + short
 aggregations + one repeated dashboard statement), and the whole matrix runs
-TWICE — result cache OFF then ON (two engines, two servers, same connector)
-— so the JSON line prices exactly what the round-12 result tier buys:
+THREE times — plan templates OFF (substitution baseline), templates ON with
+result cache OFF (isolates the round-13 template win), then result cache ON
+— so the JSON line prices exactly what each tier buys:
 per-class p50/p99, achieved qps, buffer-pool/result-cache hit rates,
 admission/resource-group queueing, and (SERVE_WORKERS > 0) worker
 fair-scheduler preemption counts.  The cache-on half also verifies the
@@ -35,10 +37,14 @@ Env knobs:
     SERVE_DURATION      seconds per load phase (default 20)
     SERVE_CLIENTS       closed-loop concurrency (default 4)
     SERVE_QPS           open-loop arrival rate (default 8; 0 skips open loop)
-    SERVE_POINTS        point-lookup statement variants (default 4)
+    SERVE_POINTS        (unused since round 13: point/param constants are
+                        per-request distinct — the shape templates serve)
     SERVE_BUDGET        global wall-clock budget seconds (default 900)
     SERVE_RESULT_CACHE  result-tier bytes for the ON half (default 256MB)
     SERVE_PAGE_CACHE    page-tier bytes for BOTH halves (default 1GB)
+    SERVE_CLASSES       comma list restricting the schedule to named classes
+                        (e.g. "point,param" isolates the template A/B from
+                        cross-class contention; default: all)
     SERVE_WORKERS       in-process cluster workers (default 0 = single node;
                         >0 routes statements through a ClusterCoordinator so
                         worker fair-scheduler preemption becomes measurable)
@@ -72,6 +78,11 @@ BUDGET = float(os.environ.get("SERVE_BUDGET", "900"))
 RESULT_CACHE = int(os.environ.get("SERVE_RESULT_CACHE", str(256 << 20)))
 PAGE_CACHE = int(os.environ.get("SERVE_PAGE_CACHE", str(1 << 30)))
 WORKERS = int(os.environ.get("SERVE_WORKERS", "0"))
+# optional class filter ("point,param"): isolates one workload class for the
+# template A/B — under the mixed cycle on a small box, per-class latency is
+# dominated by cross-class contention, not the path under measurement
+CLASSES = [c.strip() for c in os.environ.get("SERVE_CLASSES", "").split(",")
+           if c.strip()]
 
 # TPC-H q1/q3 inlined (importing bench.py re-points the process-wide XLA
 # compile cache — the same reason test_query_budgets inlines them)
@@ -95,31 +106,57 @@ group by l_orderkey, o_orderdate, o_shippriority
 order by revenue desc, o_orderdate limit 10"""
 
 
+_POINT_SQL = "select c_name, c_acctbal, c_mktsegment from customer " \
+             "where c_custkey = "
+_CUSTOMERS = max(int(150000 * SF) - 1, 100)
+
+
 def workload():
-    """-> (classes: {name: [sql...]}, schedule: [(class, sql)...]).  The
-    schedule is a deterministic weighted cycle — repeat-heavy, the dashboard
-    shape the result cache exists for."""
+    """-> (classes: {name: [gen...]}, schedule: [(class, gen)...]) where each
+    ``gen(i) -> (sql, params|None)`` produces the i-th request.  The schedule
+    is a deterministic weighted cycle — repeat-heavy (the dashboard shape the
+    result cache exists for), with per-request DISTINCT constants on the
+    point/param classes (the millions-of-users shape plan templates exist
+    for: every request is a fresh SQL text, identical up to constants).
+
+    - ``point``: ad-hoc SELECT with an inline per-request constant —
+      exercises AUTO-parameterization (template hit without client opt-in);
+    - ``param``: the same statement with a ``?`` marker and the constant
+      bound via protocol parameters (X-Trino-Execute-Parameters)."""
+
+    def fixed(sql):
+        return lambda i, sql=sql: (sql, None)
+
+    def point(i):
+        return (_POINT_SQL + str(1 + (i * 97) % _CUSTOMERS), None)
+
+    def param(i):
+        return (_POINT_SQL + "?", [1 + (i * 61) % _CUSTOMERS])
+
     classes = {
         # THE repeated statement: identical text every time — result-tier bait
-        "repeat": [_Q3],
-        "point": [f"select c_name, c_acctbal, c_mktsegment from customer "
-                  f"where c_custkey = {1 + 97 * i}" for i in range(POINTS)],
+        "repeat": [fixed(_Q3)],
+        "point": [point],
+        "param": [param],
         "agg": [
-            "select l_returnflag, count(*) c, sum(l_quantity) q "
-            "from lineitem group by l_returnflag order by l_returnflag",
-            "select o_orderpriority, count(*) c from orders "
-            "group by o_orderpriority order by o_orderpriority",
+            fixed("select l_returnflag, count(*) c, sum(l_quantity) q "
+                  "from lineitem group by l_returnflag order by l_returnflag"),
+            fixed("select o_orderpriority, count(*) c from orders "
+                  "group by o_orderpriority order by o_orderpriority"),
         ],
-        "tpch": [_Q1],
+        "tpch": [fixed(_Q1)],
     }
     schedule = []
-    # 10-slot cycle: 4x repeat, 3x point, 2x agg, 1x tpch
-    weights = (("repeat", 4), ("point", 3), ("agg", 2), ("tpch", 1))
+    # 12-slot cycle: 4x repeat, 3x point, 2x param, 2x agg, 1x tpch
+    weights = (("repeat", 4), ("point", 3), ("param", 2), ("agg", 2),
+               ("tpch", 1))
     idx = {c: 0 for c in classes}
     for name, w in weights:
+        if CLASSES and name not in CLASSES:
+            continue
         for _ in range(w):
-            stmts = classes[name]
-            schedule.append((name, stmts[idx[name] % len(stmts)]))
+            gens = classes[name]
+            schedule.append((name, gens[idx[name] % len(gens)]))
             idx[name] += 1
     return classes, schedule
 
@@ -184,7 +221,8 @@ class _Sampler(threading.Thread):
 _COUNTER_KEYS = ("device_dispatches", "host_transfers", "host_bytes_pulled",
                  "result_cache_hits", "result_cache_misses",
                  "result_cache_bytes_saved", "page_cache_hits",
-                 "page_cache_misses", "admission_queued", "task_retries")
+                 "page_cache_misses", "admission_queued", "task_retries",
+                 "plan_template_hits", "plan_template_misses")
 
 
 def _counters_snapshot(engine):
@@ -210,11 +248,12 @@ def closed_loop(url, schedule, duration, clients, deadline):
         client = Client(url, catalog="tpch", poll_interval=0.002)
         i = offset  # stagger clients through the cycle so classes interleave
         while time.monotonic() < stop_at:
-            cls, sql = schedule[i % len(schedule)]
+            cls, gen = schedule[i % len(schedule)]
+            sql, params = gen(i)
             i += 1
             t0 = time.perf_counter()
             try:
-                client.execute(sql, timeout=120)
+                client.execute(sql, timeout=120, params=params)
             except Exception:
                 with lock:
                     errors[0] += 1
@@ -252,10 +291,10 @@ def open_loop(url, schedule, duration, qps, deadline):
     n = max(int(min(duration, max(deadline - time.monotonic(), 0)) * qps), 1)
     t0 = time.monotonic()
 
-    def fire(i, cls, sql, scheduled):
+    def fire(i, cls, sql, params, scheduled):
         client = Client(url, catalog="tpch", poll_interval=0.002)
         try:
-            client.execute(sql, timeout=120)
+            client.execute(sql, timeout=120, params=params)
         except Exception:
             with lock:
                 errors[0] += 1
@@ -274,8 +313,9 @@ def open_loop(url, schedule, duration, qps, deadline):
                 time.sleep(delay)
             if time.monotonic() > deadline:
                 break
-            cls, sql = schedule[i % len(schedule)]
-            futures.append(pool.submit(fire, i, cls, sql, scheduled))
+            cls, gen = schedule[i % len(schedule)]
+            sql, params = gen(i)
+            futures.append(pool.submit(fire, i, cls, sql, params, scheduled))
         for f in futures:
             f.result()
     wall = time.monotonic() - t0
@@ -286,17 +326,20 @@ def open_loop(url, schedule, duration, qps, deadline):
             "classes": _class_stats(samples)}
 
 
-def build_node(conn, result_cache_bytes, spool_root):
+def build_node(conn, result_cache_bytes, spool_root, templates=True):
     """One engine + coordinator server (+ optional in-process cluster).
-    Returns (engine, server, cluster_parts | None)."""
+    Returns (engine, server, cluster_parts | None).  ``templates=False``
+    disables the plan-template path (the substitution-baseline half of the
+    round-13 A/B)."""
     from trino_tpu import Engine
     from trino_tpu.execution.bufferpool import DeviceBufferPool
     from trino_tpu.server.server import CoordinatorServer
 
     engine = Engine()
-    # explicit pool budgets (never via env: two halves in one process)
+    # explicit pool budgets (never via env: three phases in one process)
     engine.buffer_pool = DeviceBufferPool(
         budget_bytes=PAGE_CACHE, result_budget_bytes=result_cache_bytes)
+    engine.plan_templates_enabled = templates
     engine.register_catalog("tpch", conn)
     cluster = None
     facade = engine
@@ -323,7 +366,13 @@ def build_node(conn, result_cache_bytes, spool_root):
                 self._coord = coordinator
                 self._engine = eng
 
-            def execute_sql(self, sql, session=None, **_kw):
+            def execute_sql(self, sql, session=None, parameters=None, **_kw):
+                if parameters is not None:
+                    # parameterized statements run on the coordinator's own
+                    # engine (the template path is local; the cluster task
+                    # protocol does not ship bindings)
+                    return self._engine.execute_sql(sql, session,
+                                                    parameters=parameters)
                 return self._coord.execute_sql(sql, session)
 
             def __getattr__(self, name):
@@ -342,10 +391,12 @@ def run_phase(engine, server, schedule, deadline):
 
     client = Client(server.url, catalog="tpch", poll_interval=0.002)
     seen = set()
-    for _cls, sql in schedule:  # warmup: one pass compiles + populates
-        if sql not in seen:
-            seen.add(sql)
-            client.execute(sql, timeout=600)
+    for _cls, gen in schedule:  # warmup: one pass compiles + populates
+        sql, params = gen(0)
+        k = (sql, None if params is None else tuple(params))
+        if k not in seen:
+            seen.add(k)
+            client.execute(sql, timeout=600, params=params)
     before = _counters_snapshot(engine)
     sampler = _Sampler(engine)
     sampler.start()
@@ -390,12 +441,18 @@ def main():
         spool_root = tempfile.mkdtemp(prefix="trino_tpu_serve_")
         phases = {}
         engines = {}
-        for label, budget in (("cache_off", 0), ("cache_on", RESULT_CACHE)):
+        # three phases: templates_off (result cache off, plan templates off —
+        # the substitution baseline), cache_off (templates on, result cache
+        # off — isolates the round-13 template win), cache_on (everything)
+        matrix = (("templates_off", 0, False), ("cache_off", 0, True),
+                  ("cache_on", RESULT_CACHE, True))
+        for label, budget, templates in matrix:
             if time.monotonic() > deadline - 10:
                 print(f"bench_serve: budget exhausted before {label}",
                       file=sys.stderr)
                 break
-            engine, server, cluster = build_node(conn, budget, spool_root)
+            engine, server, cluster = build_node(conn, budget, spool_root,
+                                                 templates=templates)
             servers.append(server)
             engines[label] = engine
             phases[label] = run_phase(engine, server, schedule, deadline)
@@ -411,17 +468,45 @@ def main():
         payload["duration_s"], payload["qps_target"] = DURATION, QPS
         payload["workers"] = WORKERS
 
+        # -- round-13 template A/B: substitution baseline vs templates ------
+        if "templates_off" in phases and "cache_off" in phases:
+            def _cls_stat(label, cls_, stat):
+                return (phases[label]["closed"]["classes"]
+                        .get(cls_, {}).get(stat))
+
+            for cls_ in ("point", "param"):
+                coff = _cls_stat("templates_off", cls_, "count")
+                con = _cls_stat("cache_off", cls_, "count")
+                woff = phases["templates_off"]["closed"]["wall_s"]
+                won = phases["cache_off"]["closed"]["wall_s"]
+                if coff and con and woff and won:
+                    payload[f"{cls_}_template_qps_speedup"] = round(
+                        (con / won) / (coff / woff), 2)
+                p_off = _cls_stat("templates_off", cls_, "p50_ms")
+                p_on = _cls_stat("cache_off", cls_, "p50_ms")
+                if p_off and p_on:
+                    payload[f"{cls_}_template_p50_speedup"] = round(
+                        p_off / p_on, 2)
+            ctr = phases["cache_off"]["counters"]
+            served = sum(_cls_stat("cache_off", c_, "count") or 0
+                         for c_ in ("point", "param"))
+            if served:
+                payload["template_hit_rate"] = round(
+                    ctr.get("plan_template_hits", 0) / served, 3)
+
         # -- acceptance verification (in-process, both engines live) --------
         if "cache_on" in engines and "cache_off" in engines:
             eng_on, eng_off = engines["cache_on"], engines["cache_off"]
-            repeat_sql = classes["repeat"][0]
+            repeat_sql = classes["repeat"][0](0)[0]
             # byte identity: every distinct statement, cache-on vs cache-off
             identical = True
-            for _cls, sql in schedule:
+            for _cls, gen in schedule:
+                sql, params = gen(0)
                 s_on = eng_on.create_session("tpch")
                 s_off = eng_off.create_session("tpch")
-                if _sig(eng_on.execute_sql(sql, s_on)) != \
-                        _sig(eng_off.execute_sql(sql, s_off)):
+                if _sig(eng_on.execute_sql(sql, s_on, parameters=params)) != \
+                        _sig(eng_off.execute_sql(sql, s_off,
+                                                 parameters=params)):
                     identical = False
                     print(f"bench_serve: MISMATCH cache on/off: {sql[:60]}",
                           file=sys.stderr)

@@ -63,6 +63,14 @@ def main():
                          "warm run with the tier on costs 0 dispatches "
                          "(that's bench_serve.py's measurement, not this "
                          "one's)")
+    ap.add_argument("--prepared", action="store_true",
+                    help="trace the PREPARE/EXECUTE point-lookup class "
+                         "instead of the TPC-H set: cold (template "
+                         "creation) then warm EXECUTEs with fresh bindings, "
+                         "against the substitution baseline (plan templates "
+                         "disabled).  The warm template numbers are the "
+                         "point-class ceilings — re-derive them here after "
+                         "any template-path change")
     ap.add_argument("--sites", action="store_true",
                     help="print each warm query's per-site attribution table "
                          "(operator/call-site -> dispatches, transfers, "
@@ -81,6 +89,10 @@ def main():
 
     engine = Engine()
     engine.register_catalog("tpch", TpchConnector(sf=sf, split_rows=split_rows))
+
+    if args.prepared:
+        _trace_prepared(engine, sf, split_rows)
+        return
 
     def trace(session, name):
         out = {}
@@ -128,6 +140,40 @@ def main():
               f"({wn['coalesced_splits']} splits coalesced), "
               f"bytes {w1['host_bytes_pulled']} -> {wn['host_bytes_pulled']}",
               flush=True)
+
+
+def _trace_prepared(engine, sf, split_rows):
+    """PREPARE/EXECUTE point-class trace: per phase, wall + counters (the
+    warm rows are the template-path budget; the baseline engine shows what
+    the substitution path pays for the same statements)."""
+    from trino_tpu import Engine
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    baseline = Engine()
+    baseline.plan_templates_enabled = False
+    baseline.register_catalog(
+        "tpch", TpchConnector(sf=sf, split_rows=split_rows))
+
+    point = ("select c_name, c_acctbal, c_mktsegment from customer "
+             "where c_custkey = ?")
+    for label, eng in (("template", engine), ("substitution", baseline)):
+        session = eng.create_session("tpch")
+        eng.execute_sql(f"prepare point from {point}", session)
+        out = {}
+        for phase, key in (("cold", 42), ("warm", 4242), ("warm2", 97)):
+            t0 = time.perf_counter()
+            eng.execute_sql(f"execute point using {key}", session)
+            counters = eng.last_query_counters.as_dict()
+            counters.pop("sites", None)
+            counters.pop("dispatch_latency", None)
+            out[phase] = {"wall_s": round(time.perf_counter() - t0, 4),
+                          **{k: v for k, v in counters.items() if v}}
+        print(json.dumps({"mode": label, "sf": sf,
+                          "split_rows": split_rows, **out}), flush=True)
+        w = out["warm2"]
+        print(f"# {label}: warm wall {w['wall_s'] * 1000:.1f} ms, "
+              f"{w.get('device_dispatches', 0)} dispatches, "
+              f"{w.get('plan_template_hits', 0)} template hits", flush=True)
 
 
 if __name__ == "__main__":

@@ -1,12 +1,19 @@
-"""bench_serve.py smoke (round-12 CI satellite): in-process server, tiny
-load, asserting the JSON-line contract — per-class p50/p99 for every
-workload class in both cache halves, cache hit rates, counter-verified
-``device_dispatches == 0`` across the warm cache-on phase, and cache-on
+"""bench_serve.py smoke (round-12 CI satellite, round-14 template phase):
+in-process server, tiny load, asserting the JSON-line contract — per-class
+p50/p99 for every workload class across the three phases, cache/template
+hit rates, the counter-verified zero-dispatch warm repeat hit, and cache-on
 results byte-identical to cache-off.
 
-The 5x-p50 acceptance ratio is NOT asserted here: the 1-core build box's
-load makes absolute latency ratios flaky at smoke scale — the ratio is
-recorded in the payload (``repeat_p50_speedup``) and captured for real by
+Since round 14 the point/param classes draw per-request DISTINCT constants
+(the millions-of-users shape plan templates serve), so the cache-on phase
+legitimately dispatches for first-sight bindings — the zero-dispatch
+contract is pinned on the REPEAT statement (``warm_hit_zero_dispatches``),
+not the whole phase.
+
+The 5x acceptance ratios are NOT asserted here: the 1-core build box's
+load makes absolute latency ratios flaky at smoke scale — the ratios are
+recorded in the payload (``repeat_p50_speedup``,
+``{point,param}_template_qps_speedup``) and captured for real by
 scripts/tpu_watch.sh's serve A/B.
 """
 
@@ -51,10 +58,10 @@ def test_json_line_contract(serve_payload):
     assert p["metric"].startswith("serve_sf0.01")
     assert p["unit"] == "qps" and p["value"] > 0
     assert "env" in p
-    for half in ("cache_off", "cache_on"):
+    for half in ("templates_off", "cache_off", "cache_on"):
         phase = p["phases"][half]
         classes = phase["closed"]["classes"]
-        for cls in ("repeat", "point", "agg", "tpch"):
+        for cls in ("repeat", "point", "param", "agg", "tpch"):
             assert cls in classes, (half, classes)
             if classes[cls]["count"]:
                 assert classes[cls]["p50_ms"] is not None
@@ -73,13 +80,23 @@ def test_warm_hits_cost_zero_dispatches_and_match(serve_payload):
     # the acceptance contract, counter-verified in-process by bench_serve
     assert p["warm_hit_zero_dispatches"] is True
     assert p["cache_identical"] is True
-    # the ENTIRE warm cache-on load phase ran without a single device
-    # dispatch or host pull: every statement was served from the result tier
+    # repeats serve from the result tier; DISTINCT point/param bindings
+    # execute (each is its own binding-specific entry), so the phase
+    # dispatches — but the repeat statement never does, and the tier is live
     on = p["phases"]["cache_on"]["counters"]
-    assert on["device_dispatches"] == 0, on
-    assert on["host_bytes_pulled"] == 0, on
-    assert on["result_cache_misses"] == 0, on
+    assert on["result_cache_hits"] > 0, on
     # and the off half actually executed (the A/B is a real A/B)
     off = p["phases"]["cache_off"]["counters"]
     assert off["device_dispatches"] > 0
     assert off["result_cache_hits"] == 0
+
+
+def test_template_phase_contract(serve_payload):
+    p = serve_payload
+    # the template A/B ran: substitution baseline shows zero template
+    # traffic, the template phase shows hits on the point/param classes
+    off = p["phases"]["templates_off"]["counters"]
+    assert off["plan_template_hits"] == 0, off
+    on = p["phases"]["cache_off"]["counters"]
+    assert on["plan_template_hits"] > 0, on
+    assert p["template_hit_rate"] > 0

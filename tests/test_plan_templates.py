@@ -1,0 +1,534 @@
+"""Plan templates (round 13): compile once, bind constants per request.
+
+Covers the acceptance surface of the parameterized-template path:
+
+- warm EXECUTE parity vs the cold substitution path across every bindable
+  literal type (ints, decimals incl. negatives, doubles, dictionary strings,
+  dates, timestamps, IN-lists of fixed arity, NULL bindings);
+- the zero-replanning claim, counter/span-verified: a warm EXECUTE records a
+  plan_template_hit, opens NO planner span, and spends exactly the same warm
+  dispatch count as the equivalent inline statement (templates change what
+  happens BEFORE dispatch, not how many dispatches);
+- bindability fallbacks: a LIMIT parameter (plan-shaping) stays on the
+  substitution path byte-identically; binding-specific impossibilities
+  (type-width overflow) fall back per execution while the template survives;
+- auto-parameterization: ad-hoc point SELECTs identical up to constants
+  share one template without opting in;
+- the result-cache interplay: template executions key on (template,
+  bound values) — two bindings never share an entry — and volatility is
+  tested on the TEMPLATE text, so a bound string containing "random(" still
+  caches;
+- plan-cache key normalization: comment/whitespace-reformatted repeats of
+  one statement stop re-planning;
+- concurrent EXECUTE of one template from multiple sessions;
+- typed errors for unsupported EXECUTE parameter AST kinds, DDL
+  invalidation, and the observability wiring (EXPLAIN ANALYZE / EXPLAIN
+  EXECUTE lines, /v1/metrics series, protocol-level parameters).
+"""
+
+import threading
+
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.execution.chaos_matrix import result_signature as _sig
+
+SF, SPLIT_ROWS = 0.01, 1 << 14
+
+
+@pytest.fixture(scope="module")
+def tpch_conn():
+    return TpchConnector(sf=SF, split_rows=SPLIT_ROWS)
+
+
+@pytest.fixture()
+def eng(tpch_conn, monkeypatch):
+    """Template-enabled engine, result/page tiers off (the template win must
+    be measured on the execute path, not the result tier)."""
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    monkeypatch.setenv("TRINO_TPU_RESULT_CACHE", "0")
+    monkeypatch.setenv("TRINO_TPU_PAGE_CACHE", "0")
+    e = Engine()
+    e.register_catalog("tpch", tpch_conn)
+    e.register_catalog("mem", MemoryConnector())
+    return e
+
+
+@pytest.fixture()
+def baseline(tpch_conn, monkeypatch):
+    """Substitution-only engine: the parity oracle for every template run."""
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    monkeypatch.setenv("TRINO_TPU_RESULT_CACHE", "0")
+    monkeypatch.setenv("TRINO_TPU_PAGE_CACHE", "0")
+    e = Engine()
+    e.plan_templates_enabled = False
+    e.register_catalog("tpch", tpch_conn)
+    e.register_catalog("mem", MemoryConnector())
+    return e
+
+
+def _span_names(engine):
+    trace = engine._thread_accounting.trace or {}
+    return [s.get("name") for s in trace.get("spans", ())]
+
+
+def _prepared_pair(eng, baseline, text):
+    s1, s2 = eng.create_session("tpch"), baseline.create_session("tpch")
+    eng.execute_sql(f"prepare p from {text}", s1)
+    baseline.execute_sql(f"prepare p from {text}", s2)
+    return s1, s2
+
+
+def _assert_parity(eng, baseline, s1, s2, stmt):
+    a = eng.execute_sql(stmt, s1)
+    b = baseline.execute_sql(stmt, s2)
+    assert _sig(a) == _sig(b), f"template/substitution mismatch for {stmt}"
+    return a
+
+
+# ---------------------------------------------------------------- parity
+@pytest.mark.parametrize("text,bindings", [
+    # integer key (the point-lookup shape)
+    ("select c_name, c_acctbal from customer where c_custkey = ?",
+     ["42", "97", "1", "null"]),
+    # decimal comparisons, incl. negative decimals (round-13 satellite).
+    # The FIRST binding types the template's decimal scale; later bindings
+    # share it (a scale-mismatched binding would fall back, also correct,
+    # but this case pins the template path)
+    ("select c_custkey from customer where c_acctbal < ? "
+     "order by c_custkey limit 5",
+     ["0.00", "-123.45", "9999.99"]),
+    # double arithmetic in a projection
+    ("select c_custkey, c_acctbal * ? from customer "
+     "where c_custkey < ? order by c_custkey limit 4",
+     ["1.5e0, 10", "0.25e1, 7"]),
+    # dictionary string equality (id resolved at BIND time)
+    ("select c_custkey from customer where c_mktsegment = ? "
+     "order by c_custkey limit 6",
+     ["'BUILDING'", "'AUTOMOBILE'", "'no-such-segment'", "null"]),
+    # dictionary string inequality
+    ("select c_custkey from customer where c_mktsegment <> ? "
+     "order by c_custkey limit 3",
+     ["'BUILDING'", "'MACHINERY'"]),
+    # date comparison
+    ("select o_orderkey from orders where o_orderdate < ? "
+     "order by o_orderkey limit 5",
+     ["date '1995-03-15'", "date '1992-06-01'"]),
+    # IN-list of fixed arity (ints and strings)
+    ("select c_custkey from customer where c_custkey in (?, ?, ?) "
+     "order by c_custkey",
+     ["3, 5, 7", "10, 11, 12"]),
+    ("select c_custkey from customer where c_mktsegment in (?, ?) "
+     "order by c_custkey limit 4",
+     ["'BUILDING', 'MACHINERY'", "'AUTOMOBILE', 'HOUSEHOLD'"]),
+    # BETWEEN bounds (decimal-typed first binding so later ones share it)
+    ("select c_custkey from customer where c_acctbal between ? and ? "
+     "order by c_custkey limit 5",
+     ["100.0, 500.0", "-100.5, 50"]),
+])
+def test_warm_execute_parity(eng, baseline, text, bindings):
+    s1, s2 = _prepared_pair(eng, baseline, text)
+    for i, b in enumerate(bindings):
+        _assert_parity(eng, baseline, s1, s2, f"execute p using {b}")
+        if i >= 1:
+            # past creation, every EXECUTE must ride the template
+            assert eng.last_query_counters.plan_template_hits == 1, \
+                f"binding {b} did not hit the template"
+
+
+def test_timestamp_parameter(eng, baseline):
+    sessions = {}
+    for e in (eng, baseline):
+        s = e.create_session("mem")
+        e.execute_sql("create table ts_t (id bigint, ts timestamp(3))", s)
+        e.execute_sql(
+            "insert into ts_t values (1, timestamp '2020-01-01 00:00:00'), "
+            "(2, timestamp '2020-06-01 12:30:00'), "
+            "(3, timestamp '2021-01-01 00:00:00')", s)
+        e.execute_sql(
+            "prepare p from select id from ts_t where ts < ? order by id", s)
+        sessions[id(e)] = s
+    s1, s2 = sessions[id(eng)], sessions[id(baseline)]
+    for b in ["timestamp '2020-06-01 12:30:00'",
+              "timestamp '2022-01-01 00:00:00'"]:
+        _assert_parity(eng, baseline, s1, s2, f"execute p using {b}")
+    assert eng.last_query_counters.plan_template_hits == 1
+
+
+# ------------------------------------------------- zero-replanning claims
+def test_warm_execute_no_planner_span_and_dispatch_parity(eng, baseline):
+    text = "select c_name, c_acctbal from customer where c_custkey = ?"
+    s1 = eng.create_session("tpch")
+    eng.execute_sql(f"prepare p from {text}", s1)
+    eng.execute_sql("execute p using 42", s1)  # creation
+    eng.execute_sql("execute p using 97", s1)  # warm
+    c = eng.last_query_counters
+    assert c.plan_template_hits == 1
+    assert c.plan_template_misses == 0
+    assert "planner" not in _span_names(eng), \
+        "warm EXECUTE must perform zero plan work"
+
+    # dispatch parity: the warm template EXECUTE spends exactly what the
+    # equivalent warm inline statement spends (templates change what happens
+    # BEFORE dispatch, not how many dispatches) — same binding on both sides
+    # so data-dependent steps (compaction) match too
+    s2 = baseline.create_session("tpch")
+    inline = "select c_name, c_acctbal from customer where c_custkey = 97"
+    baseline.execute_sql(inline, s2)
+    baseline.execute_sql(inline, s2)  # warm inline run
+    warm_inline = baseline.last_query_counters.device_dispatches
+    eng.execute_sql("execute p using 97", s1)
+    assert eng.last_query_counters.device_dispatches == warm_inline
+
+
+def test_warm_auto_param_no_planner_span(eng, baseline):
+    tmpl = "select c_name from customer where c_custkey = {}"
+    s1 = eng.create_session("tpch")
+    eng.execute_sql(tmpl.format(10), s1)  # creates the template
+    for k in (20, 30):
+        a = eng.execute_sql(tmpl.format(k), s1)
+        s2 = baseline.create_session("tpch")
+        b = baseline.execute_sql(tmpl.format(k), s2)
+        assert _sig(a) == _sig(b)
+        assert eng.last_query_counters.plan_template_hits == 1
+        assert "planner" not in _span_names(eng)
+
+
+def test_identical_repeat_spends_zero_plan_work(eng):
+    """An EXACT repeat of an auto-parameterized statement serves through the
+    template with zero parse/analyze/plan work (the first execution created
+    the template, so the plain plan cache never saw the text)."""
+    sql = "select c_name from customer where c_custkey = 77"
+    s = eng.create_session("tpch")
+    eng.execute_sql(sql, s)
+    eng.execute_sql(sql, s)
+    c = eng.last_query_counters
+    assert c.plan_template_hits == 1
+    assert "planner" not in _span_names(eng)
+
+
+# ------------------------------------------------------------- fallbacks
+def test_limit_parameter_falls_back_byte_identical(eng, baseline):
+    text = "select c_custkey from customer order by c_custkey limit ?"
+    s1, s2 = _prepared_pair(eng, baseline, text)
+    for b in ("3", "7"):
+        a = _assert_parity(eng, baseline, s1, s2, f"execute p using {b}")
+        assert len(a) == int(b)
+        # plan-shaping parameter: never a template hit
+        assert eng.last_query_counters.plan_template_hits == 0
+
+
+def test_typewidth_overflow_falls_back_then_template_survives(eng, baseline):
+    text = ("select c_custkey from customer where c_custkey = ? "
+            "or c_custkey + ? < 0")
+    s1, s2 = _prepared_pair(eng, baseline, text)
+    _assert_parity(eng, baseline, s1, s2, "execute p using 5, 1")
+    # 2^40 exceeds the template's INTEGER slot: this binding substitutes...
+    _assert_parity(eng, baseline, s1, s2,
+                   "execute p using 5, 1099511627776")
+    assert eng.last_query_counters.plan_template_hits == 0
+    # ...but the template still serves in-range bindings afterwards
+    _assert_parity(eng, baseline, s1, s2, "execute p using 9, 2")
+    assert eng.last_query_counters.plan_template_hits == 1
+
+
+def test_aggregate_statement_falls_back(eng, baseline):
+    text = ("select count(*) c from customer where c_mktsegment = ?")
+    s1, s2 = _prepared_pair(eng, baseline, text)
+    for b in ("'BUILDING'", "'MACHINERY'"):
+        _assert_parity(eng, baseline, s1, s2, f"execute p using {b}")
+        assert eng.last_query_counters.plan_template_hits == 0
+
+
+def test_unsupported_parameter_kind_typed_error(eng):
+    s = eng.create_session("tpch")
+    eng.execute_sql(
+        "prepare p from select c_custkey from customer where c_custkey = ?",
+        s)
+    with pytest.raises(ValueError, match="parameter"):
+        eng.execute_sql("execute p using c_custkey + 1", s)
+
+
+def test_arity_mismatch_raises(eng):
+    s = eng.create_session("tpch")
+    eng.execute_sql(
+        "prepare p from select c_custkey from customer where c_custkey = ?",
+        s)
+    with pytest.raises(Exception, match="parameter"):
+        eng.execute_sql("execute p using 1, 2", s)
+    with pytest.raises(Exception, match="parameter"):
+        eng.execute_sql("execute p", s)
+
+
+# ----------------------------------------------------------- concurrency
+def test_concurrent_execute_two_sessions(eng, baseline):
+    text = ("select c_name, c_acctbal from customer where c_custkey = ?")
+    s0 = eng.create_session("tpch")
+    eng.execute_sql(f"prepare p from {text}", s0)
+    eng.execute_sql("execute p using 1", s0)  # create + confirm
+
+    keys = list(range(1, 41))
+    sref = baseline.create_session("tpch")
+    expected = {}
+    for k in keys:
+        expected[k] = _sig(baseline.execute_sql(
+            text.replace("?", str(k)), sref))
+
+    errors: list = []
+
+    def worker(offset):
+        sess = eng.create_session("tpch")
+        eng.execute_sql(f"prepare p from {text}", sess)
+        for k in keys[offset::2]:
+            try:
+                got = eng.execute_sql(f"execute p using {k}", sess)
+                if _sig(got) != expected[k]:
+                    errors.append(f"mismatch at {k}")
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"{k}: {e!r}")
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+
+# ------------------------------------------------- result-cache interplay
+def _result_engine(tpch_conn, monkeypatch):
+    monkeypatch.setenv("TRINO_TPU_RESULT_CACHE", str(64 << 20))
+    monkeypatch.setenv("TRINO_TPU_PAGE_CACHE", "0")
+    e = Engine()
+    e.register_catalog("tpch", tpch_conn)
+    return e
+
+
+def test_result_cache_entries_are_binding_specific(tpch_conn, monkeypatch):
+    e = _result_engine(tpch_conn, monkeypatch)
+    s = e.create_session("tpch")
+    text = "select c_name, c_acctbal from customer where c_custkey = ?"
+    e.execute_sql(f"prepare p from {text}", s)
+    a1 = e.execute_sql("execute p using 42", s)
+    b1 = e.execute_sql("execute p using 97", s)
+    assert _sig(a1) != _sig(b1), "distinct bindings must differ (test data)"
+    # repeats serve from the result tier, each from ITS OWN entry
+    a2 = e.execute_sql("execute p using 42", s)
+    assert e.last_query_counters.result_cache_hits == 1
+    assert e.last_query_counters.device_dispatches == 0
+    b2 = e.execute_sql("execute p using 97", s)
+    assert e.last_query_counters.result_cache_hits == 1
+    assert _sig(a2) == _sig(a1)
+    assert _sig(b2) == _sig(b1)
+
+
+def test_volatile_check_on_template_text_not_binding(tpch_conn, monkeypatch):
+    """A bound string containing 'random(' must not disqualify caching —
+    volatility is tested on the TEMPLATE text, where values are markers."""
+    e = _result_engine(tpch_conn, monkeypatch)
+    s = e.create_session("tpch")
+    text = ("select c_custkey from customer where c_mktsegment = ? "
+            "order by c_custkey limit 3")
+    e.execute_sql(f"prepare p from {text}", s)
+    stmt = "execute p using 'random() now() uuid()'"
+    e.execute_sql(stmt, s)
+    e.execute_sql(stmt, s)
+    assert e.last_query_counters.result_cache_hits == 1
+    # while a template whose TEXT is volatile never caches (now() folds at
+    # plan time, so only the text can reveal it)
+    e.execute_sql("prepare pv from select c_custkey from customer "
+                  "where c_custkey = ? and now() is not null", s)
+    e.execute_sql("execute pv using 5", s)
+    e.execute_sql("execute pv using 5", s)
+    assert e.last_query_counters.result_cache_hits == 0
+
+
+# ------------------------------------------- plan-cache key normalization
+def test_plan_cache_key_normalization(eng):
+    s = eng.create_session("tpch")
+    a = eng.execute_sql(
+        "select c_name from customer where c_custkey = 123454321", s)
+    # same statement, reformatted + commented: must reuse the cached plan
+    b = eng.execute_sql(
+        "select  c_name\n  from customer   -- trailing comment\n"
+        " where /* block\n comment */ c_custkey =     123454321", s)
+    assert _sig(a) == _sig(b)
+    assert "planner" not in _span_names(eng), \
+        "reformatted repeat of a cached statement must not re-plan"
+
+
+# ------------------------------------------------------------ lifecycle
+def test_ddl_invalidates_templates(eng, baseline):
+    sessions = {}
+    for e in (eng, baseline):
+        s = e.create_session("mem")
+        e.execute_sql("create table inv_t (k bigint, v double)", s)
+        e.execute_sql("insert into inv_t values (1, 1.5), (2, 2.5)", s)
+        e.execute_sql("prepare p from select v from inv_t where k = ?", s)
+        sessions[id(e)] = s
+    s1, s2 = sessions[id(eng)], sessions[id(baseline)]
+    _assert_parity(eng, baseline, s1, s2, "execute p using 1")
+    for e, sess in ((eng, s1), (baseline, s2)):
+        e.execute_sql("insert into inv_t values (3, 9.5)", sess)
+    # the INSERT invalidated the template cache: a stale template would miss
+    # row 3; the re-created one must see it
+    got = _assert_parity(eng, baseline, s1, s2, "execute p using 3")
+    assert len(got) == 1
+
+
+def test_null_first_binding_does_not_poison_template(eng, baseline):
+    text = "select c_name from customer where c_custkey = ?"
+    s1, s2 = _prepared_pair(eng, baseline, text)
+    # NULL first: typed UNKNOWN — substitution fallback, no negative cache
+    _assert_parity(eng, baseline, s1, s2, "execute p using null")
+    # a later non-NULL binding still creates the template
+    _assert_parity(eng, baseline, s1, s2, "execute p using 7")
+    _assert_parity(eng, baseline, s1, s2, "execute p using 8")
+    assert eng.last_query_counters.plan_template_hits == 1
+    # and NULL now binds against the typed template at runtime
+    got = _assert_parity(eng, baseline, s1, s2, "execute p using null")
+    assert len(got) == 0
+
+
+def test_bind_time_split_pruning(monkeypatch):
+    """A parameterized point predicate prunes splits per EXECUTION from the
+    bound values — without it, the template path would scan every split on
+    exactly the shape templates exist to serve (review finding)."""
+    monkeypatch.setenv("TRINO_TPU_RESULT_CACHE", "0")
+    monkeypatch.setenv("TRINO_TPU_PAGE_CACHE", "0")
+    conn = TpchConnector(sf=0.05, split_rows=1 << 10)  # ~8 customer splits
+    e = Engine()
+    e.register_catalog("tpch", conn)
+    b = Engine()
+    b.plan_templates_enabled = False
+    b.register_catalog("tpch", conn)
+    s1, s2 = e.create_session("tpch"), b.create_session("tpch")
+    text = "select c_name, c_acctbal from customer where c_custkey = ?"
+    e.execute_sql(f"prepare p from {text}", s1)
+    b.execute_sql(f"prepare p from {text}", s2)
+    e.execute_sql("execute p using 42", s1)  # creation
+    got = e.execute_sql("execute p using 7000", s1)
+    want = b.execute_sql("execute p using 7000", s2)
+    assert _sig(got) == _sig(want) and len(got) == 1
+    assert e.last_query_counters.plan_template_hits == 1
+    # warm substitution run prunes statically; the template's bind-time
+    # pruning must land on the SAME dispatch count for the same binding
+    b.execute_sql("execute p using 7000", s2)
+    assert e.last_query_counters.device_dispatches == \
+        b.last_query_counters.device_dispatches
+
+
+def test_question_mark_inside_comment_substitutes(eng, baseline):
+    """The substitution fallback must not treat a '?' inside a comment as a
+    marker (the parser lexes comments away, so marker counts must agree)."""
+    text = ("select count(*) c from customer "
+            "where c_custkey > ? -- really?")
+    s1, s2 = _prepared_pair(eng, baseline, text)
+    # aggregate shape: both engines take the substitution path
+    a = eng.execute_sql("execute p using 1400", s1)
+    b = baseline.execute_sql("execute p using 1400", s2)
+    assert _sig(a) == _sig(b)
+
+
+def test_volatile_statement_never_templates(eng):
+    """now()/current_date fold to plan-time constants: a template would
+    serve the FIRST execution's fold frozen to every later binding, so
+    volatile texts must reject at creation (each distinct statement
+    re-plans and re-folds)."""
+    s = eng.create_session("tpch")
+    for k in (1, 2, 3):
+        eng.execute_sql(
+            f"select now(), c_name from customer where c_custkey = {k}", s)
+        c = eng.last_query_counters
+        assert c.plan_template_hits == 0, \
+            "volatile statement must never serve from a template"
+    # the prepared form rejects too
+    eng.execute_sql("prepare pv from "
+                    "select now(), c_name from customer "
+                    "where c_custkey = ?", s)
+    eng.execute_sql("execute pv using 5", s)
+    eng.execute_sql("execute pv using 6", s)
+    assert eng.last_query_counters.plan_template_hits == 0
+
+
+def test_illtyped_binding_does_not_poison_other_kinds(eng, baseline):
+    """The negative cache is scoped to the literal KINDS that failed: an
+    ill-typed numeric comparison against a string column must not demote the
+    well-typed string form that shares the same template text."""
+    s1 = eng.create_session("tpch")
+    # ill-typed ad-hoc statement (auto-parameterizes to c_mktsegment = ?)
+    with pytest.raises(Exception):
+        eng.execute_sql(
+            "select c_custkey from customer where c_mktsegment = 5 "
+            "order by c_custkey limit 3", s1)
+    # the well-typed string form of the SAME template text still templates
+    tmpl = ("select c_custkey from customer where c_mktsegment = '{}' "
+            "order by c_custkey limit 3")
+    s2 = baseline.create_session("tpch")
+    eng.execute_sql(tmpl.format("BUILDING"), s1)
+    a = eng.execute_sql(tmpl.format("MACHINERY"), s1)
+    b = baseline.execute_sql(tmpl.format("MACHINERY"), s2)
+    assert _sig(a) == _sig(b)
+    assert eng.last_query_counters.plan_template_hits == 1
+
+
+def test_protocol_float_parameter_stays_double(eng, baseline):
+    """A python float protocol parameter must type DOUBLE on both the
+    template and substitution paths (a bare '2.5' literal would re-parse as
+    decimal(2,1) and compute in exact scaled-int, diverging by an ulp)."""
+    sql = ("select c_custkey, c_acctbal * ? from customer "
+           "where c_custkey < ? order by c_custkey limit 3")
+    s1, s2 = eng.create_session("tpch"), baseline.create_session("tpch")
+    a = eng.execute_sql(sql, s1, parameters=[2.5, 10])
+    b = baseline.execute_sql(sql, s2, parameters=[2.5, 10])
+    assert a.types[1].name == "double"
+    assert b.types[1].name == "double"
+    assert _sig(a) == _sig(b)
+
+
+# --------------------------------------------------------- observability
+def test_explain_surfaces(eng):
+    s = eng.create_session("tpch")
+    text = "select c_name from customer where c_custkey = ?"
+    eng.execute_sql(f"prepare p from {text}", s)
+    plan0 = "\n".join(r[0] for r in
+                      eng.execute_sql("explain execute p", s).rows())
+    assert "not yet created" in plan0
+    eng.execute_sql("execute p using 3", s)
+    plan1 = "\n".join(r[0] for r in
+                      eng.execute_sql("explain execute p", s).rows())
+    assert "Plan template: cached" in plan1
+    assert "TableScan" in plan1
+    analyzed = "\n".join(r[0] for r in eng.execute_sql(
+        "explain analyze execute p using 5", s).rows())
+    assert "Plan template: 1 hits" in analyzed
+
+
+def test_protocol_parameters_and_metrics(eng):
+    from trino_tpu.server.client import Client
+    from trino_tpu.server.server import CoordinatorServer
+
+    server = CoordinatorServer(eng, port=0)
+    server.start()
+    try:
+        client = Client(server.url, catalog="tpch", poll_interval=0.002)
+        sql = "select c_name, c_acctbal from customer where c_custkey = ?"
+        r1 = client.execute(sql, params=[42])
+        r2 = client.execute(sql, params=[97])
+        assert r1.rows and r2.rows and r1.rows != r2.rows
+        assert r1.rows[0][0] == "Customer#000000042"
+        r3 = client.execute(sql, params=[42])
+        assert r3.rows == r1.rows
+        import urllib.request
+
+        with urllib.request.urlopen(server.url + "/v1/metrics") as resp:
+            body = resp.read().decode()
+        assert "trino_tpu_plan_template_hits_total" in body
+        hits = [line for line in body.splitlines()
+                if line.startswith("trino_tpu_plan_template_hits_total")]
+        assert hits and int(hits[0].split()[-1]) >= 1
+    finally:
+        server.stop()

@@ -86,6 +86,40 @@ def _jit(fn, site=None, **kwargs):
     return run
 
 
+_PARAM_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def _params_scope(values, host_values=()):
+    """Publish the CURRENT query's bound parameter values (tuple of
+    ``(0-d device value, 0-d device isnull)`` pairs, one per plan-template
+    slot) for this thread.  The jitted step wrappers read it at CALL time and
+    pass it into the compiled function as an argument — parameters ride every
+    dispatch exactly like ``_Stream.aux`` (never closed over; round-5
+    invariant), so a warm template re-executes the SAME XLA executable with
+    new inputs.  Empty tuple = no parameters (zero pytree leaves, identical
+    compiled signature).  ``host_values`` keeps the pre-staging numpy pairs:
+    host-side consumers (bind-time split pruning) read them without paying a
+    device->host sync."""
+    old = getattr(_PARAM_TLS, "values", ())
+    old_host = getattr(_PARAM_TLS, "host_values", ())
+    _PARAM_TLS.values = values
+    _PARAM_TLS.host_values = host_values
+    try:
+        yield
+    finally:
+        _PARAM_TLS.values = old
+        _PARAM_TLS.host_values = old_host
+
+
+def _current_params() -> tuple:
+    return getattr(_PARAM_TLS, "values", ())
+
+
+def _current_host_params() -> tuple:
+    return getattr(_PARAM_TLS, "host_values", ())
+
+
 def _dispatch_batch_default() -> int:
     """Engine-wide dispatch-coalescing width: how many shape-uniform scan
     splits fold into ONE device dispatch.  On tunneled TPUs each dispatch is a
@@ -305,9 +339,18 @@ class _Stream:
         """Jit-compiled page->(cols,nulls,valid) function, cached on the stream so
         repeated executions of a cached plan reuse the XLA executable."""
         if self._jitted is None:
-            f = _jit(lambda page, aux: self.transform(
-                page.columns, page.null_masks, page.valid_mask(), aux),
-                site="stream.page")
+            from ..sql import ir as _ir
+
+            def step(page, aux, params):
+                # params bind INSIDE the trace: ir.Parameter leaves read the
+                # traced argument, so bound values are runtime inputs — a
+                # warm template dispatch reuses this executable with new
+                # scalars instead of re-tracing (and never closes over them)
+                with _ir.bind_params(params):
+                    return self.transform(page.columns, page.null_masks,
+                                          page.valid_mask(), aux)
+
+            f = _jit(step, site="stream.page")
 
             def run(page, f=f):
                 if any(isinstance(c, np.ndarray) and c.dtype == object
@@ -317,14 +360,16 @@ class _Stream:
                     # projections at the result surface (jnp ops on the other
                     # channels execute op-by-op)
                     try:
-                        return self.transform(page.columns, page.null_masks,
-                                              page.valid_mask(), self.aux)
+                        with _ir.bind_params(_current_params()):
+                            return self.transform(page.columns,
+                                                  page.null_masks,
+                                                  page.valid_mask(), self.aux)
                     except (TypeError, OverflowError) as e:
                         raise NotImplementedError(
                             "expressions over an exact wide-decimal aggregate "
                             "(sum beyond 2^63) are not supported yet — such "
                             "sums can only be output directly") from e
-                return f(page, self.aux)
+                return f(page, self.aux, _current_params())
 
             self._jitted = run
         return self._jitted
@@ -339,11 +384,16 @@ class _Stream:
         executable per page shape (do not "optimize" the padding away: size-
         shaped groups would retrace per arity and multiply cold compiles)."""
         if self._batch_jitted is None:
-            f = _jit(lambda pages, live, aux: self.transform(
-                *_stack_pages(pages, live), aux), site="stream.batch")
+            from ..sql import ir as _ir
+
+            def bstep(pages, live, aux, params):
+                with _ir.bind_params(params):  # same contract as jitted()
+                    return self.transform(*_stack_pages(pages, live), aux)
+
+            f = _jit(bstep, site="stream.batch")
 
             def run(pages, live, f=f):
-                return f(tuple(pages), live, self.aux)
+                return f(tuple(pages), live, self.aux, _current_params())
 
             self._batch_jitted = run
         return self._batch_jitted
@@ -369,6 +419,12 @@ class LocalExecutor:
         # plan-cache key — so a cached plan's compiled batch artifacts always
         # match the batch the plan was keyed under.
         self.dispatch_batch = None
+        # bound plan-template parameters for the CURRENT query: tuple of
+        # (0-d numpy value, isnull) pairs, one per template slot (engine
+        # sets it per query like dispatch_batch; reset on release).  execute()
+        # stages them to the device once and publishes them thread-locally
+        # for the jitted step wrappers.
+        self.exec_params = None
         # device buffer pool (execution/bufferpool.DeviceBufferPool), shared
         # across the engine's pooled executors (a WorkerServer passes its
         # own).  ``page_cache`` is the per-query session-property override
@@ -609,8 +665,17 @@ class LocalExecutor:
         # path without the finally, an async kill mid-registration) must get
         # its stop flag set, not be dropped to pump forever unseen
         self.close_producers()
+        # bound template parameters: staged to the device ONCE per query
+        # (scalars — a handful of bytes), then threaded into every dispatch
+        # as jit arguments by the step wrappers.  jnp.asarray here is the
+        # sanctioned staging point for these scalars; pages keep going
+        # through _page_to_device.
+        dev_params = tuple(
+            (jnp.asarray(v), jnp.asarray(bool(isnull)))
+            for v, isnull in (self.exec_params or ()))
         try:
-            with tracing.track_counters(self.counters):
+            with _params_scope(dev_params, tuple(self.exec_params or ())), \
+                    tracing.track_counters(self.counters):
                 page, dicts = self._execute_to_page(node)
                 # the result pull is real boundary spend outside any plan
                 # node: attribute it to a synthetic "Result" operator so the
@@ -907,6 +972,18 @@ class LocalExecutor:
             tsrc = up.traced_src
             if pruned is not None and tsrc is not None:
                 tsrc = dataclasses.replace(tsrc, splits=tuple(si.splits))
+            # bind-time split pruning (plan templates): a Parameter in the
+            # predicate carries no plan-time value, so static pruning above
+            # cannot see it — prune per EXECUTION from the bound values, or
+            # the point-lookup class scans every split on exactly the path
+            # templates exist to serve.  Composes WITH static pruning: the
+            # runtime pass starts from the statically-kept split list (si is
+            # the pruned scan info when static pruning fired).
+            rt = self._param_pruned_source(up, pred, si)
+            if rt is not None:
+                pages = rt
+                tsrc = None  # split set varies per binding: no
+                # whole-scan traced regeneration
             return _Stream(up.schema, up.dicts, pages, transform, si, aux=up.aux,
                            clustered_by=up.clustered_by, compacted=up.compacted,
                            traced_src=tsrc)
@@ -2902,6 +2979,91 @@ class LocalExecutor:
         semi = node.kind in ("semi", "anti")
         dicts = probe_stream.dicts if semi else probe_stream.dicts + build_dicts
         return _Stream(node.schema, dicts, pages, lambda c, n, v, aux: (c, n, v))
+
+    def _param_pruned_source(self, up: _Stream, pred, si=None):
+        """Page source with BIND-TIME split pruning for parameterized
+        predicates, or None when not applicable.  A plan template's filter
+        holds ir.Parameter where the substituted plan held the constant that
+        _static_pruned_stream prunes on; this source re-derives the pruned
+        split list per EXECUTION from the bound values (host-side numpy
+        copies — no device sync) and routes the kept splits through the
+        cache-aware _scan_pages_source, so each binding keys its own
+        buffer-pool entry and keeps the scan's prefetch policy.  ``si``
+        defaults to the stream's scan info; callers that already pruned
+        statically pass the pruned info so both passes compose."""
+        if si is None:
+            si = up.scan_info
+        if si is None or not si.replayable \
+                or not hasattr(si.conn, "split_range"):
+            return None
+        from ..sql import ir as _ir
+
+        def has_params(e) -> bool:
+            if isinstance(e, _ir.Parameter):
+                return True
+            if isinstance(e, _ir.Call):
+                return any(has_params(a) for a in e.args)
+            return False
+
+        if pred is None or not has_params(pred):
+            return None
+        from ..sql.analyzer import _coerce
+        from ..sql.domain_translator import (domain_to_split_pruner,
+                                             extract_domains, split_conjuncts)
+
+        class _NullParam(Exception):
+            pass
+
+        def subst(e, host):
+            """Parameter -> Constant(bound value); constant casts fold so the
+            domain translator sees the bare Constant it pattern-matches."""
+            if isinstance(e, _ir.Parameter):
+                v, isnull = host[e.slot]
+                if isnull:
+                    raise _NullParam()  # NULL never prunes (conservative)
+                return _ir.Constant(v.item() if hasattr(v, "item") else v,
+                                    e.type)
+            if isinstance(e, _ir.Call):
+                args = tuple(subst(a, host) for a in e.args)
+                if e.op == "cast" and len(args) == 1 \
+                        and isinstance(args[0], _ir.Constant) \
+                        and not isinstance(args[0].value, np.ndarray) \
+                        and args[0].value is not None:
+                    folded = _coerce(args[0], e.type)
+                    if isinstance(folded, _ir.Constant):
+                        return folded
+                return dataclasses.replace(e, args=args)
+            return e
+
+        def pages(self=self, up=up, pred=pred, si=si):
+            host = _current_host_params()
+            kept = list(si.splits)
+            resolved = []
+            for c in split_conjuncts(pred):
+                try:
+                    resolved.append(subst(c, host))
+                except (_NullParam, IndexError):
+                    continue  # unprunable conjunct; the filter still applies
+            if resolved:
+                td = extract_domains(resolved).tuple_domain
+                if td.is_none:
+                    kept = []
+                elif not td.is_all:
+                    by_col: dict = {}
+                    for ch, dom in td.domains.items():
+                        col = si.columns[ch] if ch < len(si.columns) else None
+                        if col is not None \
+                                and not up.schema.fields[ch].type.is_floating:
+                            by_col[col] = dom.intersect(by_col[col]) \
+                                if col in by_col else dom
+                    if by_col:
+                        keep = domain_to_split_pruner(by_col, si.conn)
+                        kept = [s for s in si.splits if keep(s)]
+            src = self._scan_pages_source(si.conn, si.catalog, si.table,
+                                          kept, si.scan_columns)
+            yield from src()
+
+        return pages
 
     def _limited_stream_page(self, node: P.Limit):
         """LIMIT over a streaming child: pull pages only until `count` live rows

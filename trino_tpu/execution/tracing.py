@@ -196,6 +196,12 @@ class QueryCounters:
     spill_tier_host: int = 0
     spill_tier_disk: int = 0
     admission_queued: int = 0
+    # round 13: plan templates (engine._template_cache).  A hit means the
+    # statement was answered through an already-compiled parameterized plan
+    # — zero parse/analyze/plan work, zero re-compilation; a miss counts a
+    # template CREATION (the one planning that statement shape ever pays).
+    plan_template_hits: int = 0
+    plan_template_misses: int = 0
     # "<operator>/<site>" -> {"dispatches", "transfers", "bytes"} plus any
     # cache keys the site recorded: the attribution EXPLAIN ANALYZE prints
     # and budget failures dump
@@ -210,7 +216,8 @@ class QueryCounters:
                    "result_cache_bytes_saved",
                    "faults_injected", "task_retries",
                    "spilled_bytes", "spill_tier_hbm", "spill_tier_host",
-                   "spill_tier_disk", "admission_queued")
+                   "spill_tier_disk", "admission_queued",
+                   "plan_template_hits", "plan_template_misses")
 
     def reset(self) -> None:
         for f in self._INT_FIELDS:

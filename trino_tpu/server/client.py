@@ -59,15 +59,27 @@ class Client:
             headers["Authorization"] = self._auth
         return headers
 
-    def _request(self, url: str, method: str = "GET", body: bytes = None) -> dict:
+    def _request(self, url: str, method: str = "GET", body: bytes = None,
+                 extra_headers: Optional[dict] = None) -> dict:
         headers = self._headers()
+        if extra_headers:
+            headers.update(extra_headers)
         req = urllib.request.Request(url, data=body, method=method, headers=headers)
         with urllib.request.urlopen(req) as resp:
             payload = resp.read()
         return json.loads(payload) if payload else {}
 
-    def execute(self, sql: str, timeout: float = 600.0) -> ClientResult:
-        out = self._request(f"{self.base_url}/v1/statement", "POST", sql.encode())
+    def execute(self, sql: str, timeout: float = 600.0,
+                params: Optional[list] = None) -> ClientResult:
+        """``params``: protocol-level EXECUTE — ``sql`` is a parameterized
+        statement with ``?`` markers; the values ride the
+        X-Trino-Execute-Parameters header as JSON and bind server-side
+        (through the engine's plan-template path when one exists)."""
+        extra = None
+        if params is not None:
+            extra = {"X-Trino-Execute-Parameters": json.dumps(params)}
+        out = self._request(f"{self.base_url}/v1/statement", "POST",
+                            sql.encode(), extra_headers=extra)
         columns, rows = None, []
         deadline = time.time() + timeout
         while True:

@@ -1327,7 +1327,7 @@ class ClusterCoordinator:
     # scans from splits, interior fragments from children's spooled outputs)
     _FRAGMENT_NODES = FaultTolerantExecutor._FRAGMENT_NODES
 
-    def execute_sql(self, sql: str, session=None):
+    def execute_sql(self, sql: str, session=None, parameters=None):
         """Plan on the coordinator; schedule EVERY blocking fragment as remote
         tasks across live workers (scan-fed aggregates and join probes fan out
         by split batches; other fragments run as single tasks), with the
@@ -1340,7 +1340,16 @@ class ClusterCoordinator:
         deterministic statement is answered from the engine's buffer-pool
         result tier before any fragment is scheduled (zero worker tasks,
         zero exchange traffic, zero dispatches), and a clean completion
-        stores through the same engine guard the local path uses."""
+        stores through the same engine guard the local path uses.
+
+        Round 14: ``parameters`` (protocol-level EXECUTE) substitute as
+        literals here — plan templates are a coordinator/local-engine
+        optimization and the cluster task protocol does not ship bindings,
+        so the distributed path runs the substituted text."""
+        if parameters is not None:
+            from .dbapi import _substitute
+
+            sql = _substitute(sql, list(parameters))
         sess = session or self.engine.create_session(
             next(iter(self.engine.catalogs)))
         plan = self._cached_plan(sql, sess)

@@ -72,12 +72,31 @@ class Connection:
 
 
 def _quote(v) -> str:
+    import decimal
+
+    from ..sql.params import RawSql
+
     if v is None:
         return "NULL"
     if isinstance(v, bool):
         return "TRUE" if v else "FALSE"
-    if isinstance(v, (int, float)):
+    if isinstance(v, float):
+        # a python float is a SQL double and must stay one through
+        # substitution — sql/params.float_literal is THE shared rule (the
+        # plan-template path types protocol floats with the same helper)
+        from ..sql.params import float_literal
+
+        return float_literal(v)
+    if isinstance(v, int):
         return repr(v)
+    if isinstance(v, decimal.Decimal):
+        # exact decimal text (repr would add the Decimal(...) wrapper; float
+        # round-tripping would corrupt wide values)
+        return format(v, "f")
+    if isinstance(v, RawSql):
+        return v.sql  # pre-formed literal (timestamp text keeps precision)
+    if isinstance(v, datetime.datetime):  # BEFORE date: datetime is a date
+        return "timestamp '" + v.isoformat(sep=" ") + "'"
     if isinstance(v, datetime.date):
         return f"date '{v.isoformat()}'"
     s = str(v).replace("'", "''")
@@ -85,20 +104,47 @@ def _quote(v) -> str:
 
 
 def _substitute(sql: str, params) -> str:
-    """qmark substitution, quote-aware (no '?' inside string literals)."""
+    """qmark substitution, quote- and comment-aware: a ``?`` inside a string
+    literal, a ``--`` line comment, or a ``/* */`` block comment is text, not
+    a marker (the parser lexes exactly these forms away, so marker counts
+    must agree with what the parser sees)."""
     out, it = [], iter(params)
     in_str = False
-    for ch in sql:
-        if ch == "'":
-            in_str = not in_str
+    i, n = 0, len(sql)
+    while i < n:
+        ch = sql[i]
+        if in_str:
             out.append(ch)
-        elif ch == "?" and not in_str:
+            if ch == "'":
+                in_str = False
+            i += 1
+            continue
+        if ch == "'":
+            in_str = True
+            out.append(ch)
+            i += 1
+            continue
+        if ch == "-" and sql.startswith("--", i):
+            j = sql.find("\n", i)
+            j = n if j < 0 else j
+            out.append(sql[i:j])
+            i = j
+            continue
+        if ch == "/" and sql.startswith("/*", i):
+            j = sql.find("*/", i + 2)
+            j = n if j < 0 else j + 2
+            out.append(sql[i:j])
+            i = j
+            continue
+        if ch == "?":
             try:
                 out.append(_quote(next(it)))
             except StopIteration:
                 raise ProgrammingError("not enough parameters") from None
-        else:
-            out.append(ch)
+            i += 1
+            continue
+        out.append(ch)
+        i += 1
     leftover = sum(1 for _ in it)
     if leftover:
         raise ProgrammingError(f"{leftover} unused parameters")

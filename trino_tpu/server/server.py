@@ -161,6 +161,10 @@ class _Query:
     # engine span-tree summary captured at completion (engine.last_query_trace
     # under the engine lock) — served OTLP-shaped by /v1/query/{id}/trace
     trace: Optional[dict] = None
+    # protocol-level EXECUTE (round 13): python values bound into a
+    # parameterized statement (sql carries ? markers) — served through the
+    # engine's plan-template path when one exists
+    params: Optional[list] = None
     lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
 
 
@@ -362,7 +366,21 @@ class CoordinatorServer:
                 n = int(self.headers.get("Content-Length", 0))
                 sql = self.rfile.read(n).decode()
                 session_catalog = self.headers.get("X-Trino-Catalog")
-                q = server._submit(sql, session_catalog, user)
+                # protocol-level EXECUTE with parameters: the body is a
+                # parameterized statement (? markers), the header a JSON
+                # array of values to bind — the plan-template path answers
+                # repeats without re-planning (round 13)
+                params = None
+                raw = self.headers.get("X-Trino-Execute-Parameters")
+                if raw:
+                    try:
+                        params = json.loads(raw)
+                        if not isinstance(params, list):
+                            raise ValueError("parameters must be a JSON list")
+                    except ValueError as e:
+                        self._send(400, {"error": f"bad parameters: {e}"})
+                        return
+                q = server._submit(sql, session_catalog, user, params=params)
                 self._send(200, server._queued_response(q))
 
             def do_GET(self):
@@ -664,6 +682,22 @@ class CoordinatorServer:
                 "# TYPE trino_tpu_admission_queued_total counter",
                 f"trino_tpu_admission_queued_total "
                 f"{getattr(ct, 'admission_queued', 0)}",
+                # round 13: plan templates — statements answered through an
+                # already-compiled parameterized plan (hit = zero parse/
+                # analyze/plan work and zero re-compilation; miss = the one
+                # template creation a statement shape ever pays)
+                "# HELP trino_tpu_plan_template_hits_total Statements served "
+                "through a cached plan template (compile once, bind "
+                "constants per request).",
+                "# TYPE trino_tpu_plan_template_hits_total counter",
+                f"trino_tpu_plan_template_hits_total "
+                f"{getattr(ct, 'plan_template_hits', 0)}",
+                "# HELP trino_tpu_plan_template_misses_total Plan-template "
+                "creations (first sight of a parameterized statement "
+                "shape).",
+                "# TYPE trino_tpu_plan_template_misses_total counter",
+                f"trino_tpu_plan_template_misses_total "
+                f"{getattr(ct, 'plan_template_misses', 0)}",
             ]
             sites = getattr(ct, "sites", None) or {}
             if sites:
@@ -940,8 +974,9 @@ class CoordinatorServer:
 
     # -- dispatch -----------------------------------------------------------------
     def _submit(self, sql: str, catalog: Optional[str],
-                user: str = "user") -> _Query:
-        q = _Query(query_id=f"q{next(_qids)}", sql=sql, user=user)
+                user: str = "user", params: Optional[list] = None) -> _Query:
+        q = _Query(query_id=f"q{next(_qids)}", sql=sql, user=user,
+                   params=params)
         with self._queries_lock:
             self.queries[q.query_id] = q
         self._pool.submit(self._run, q, catalog, user)
@@ -975,7 +1010,11 @@ class CoordinatorServer:
                 if not self._set_state(q, "RUNNING"):
                     return
                 try:
-                    res = self.engine.execute_sql(q.sql, session)
+                    if q.params is not None:
+                        res = self.engine.execute_sql(q.sql, session,
+                                                      parameters=q.params)
+                    else:
+                        res = self.engine.execute_sql(q.sql, session)
                 finally:
                     # the engine publishes the trace on the executing THREAD
                     # (concurrent read statements share last_query_trace, so

@@ -73,6 +73,13 @@ def _rewrite_ast(ast, fn):
     return walk(ast)
 
 
+def _is_string_lit(n) -> bool:
+    """String-literal side for comparison-context dictionary resolution: a
+    plain literal, or a parameter whose representative binding is one."""
+    return isinstance(n, A.StringLit) or (
+        isinstance(n, A.ParamLit) and isinstance(n.inner, A.StringLit))
+
+
 def _resolve_column(ident: A.Identifier, cols) -> int:
     parts = ident.parts
     if len(parts) >= 2:
@@ -832,6 +839,12 @@ class ExpressionAnalyzer:
         return t
 
     def _translate(self, ast, cols):
+        if isinstance(ast, A.ParamLit):
+            return self._translate_param(ast, cols), None
+        if isinstance(ast, A.ParamMarker):
+            raise SemanticError(
+                "statement contains unbound parameter markers — run it "
+                "through PREPARE/EXECUTE or protocol parameters")
         if isinstance(ast, A.NumberLit):
             return _literal_number(ast.text), None
         if isinstance(ast, A.StringLit):
@@ -943,9 +956,100 @@ class ExpressionAnalyzer:
             return self._eager_scalar(ast.query), None
         raise SemanticError(f"unsupported expression {ast}")
 
+    # ------------------------------------------------------------ parameters
+    def _translate_param(self, ast: A.ParamLit, cols) -> ir.Expr:
+        """A bound parameter OUTSIDE a string-comparison context: type it
+        from the representative literal (exactly as the substituted statement
+        would) and mint a runtime slot.  String literals are unbindable here
+        — in value position their VALUE becomes a plan-time one-entry
+        dictionary (_string_const), which no runtime input can replace."""
+        from . import params as PRM
+        from ..types import TimestampType
+
+        reg = getattr(self, "param_registry", None)
+        if reg is None:
+            raise SemanticError(
+                "parameter markers are not supported in this context")
+        inner = ast.inner
+        if isinstance(inner, A.StringLit):
+            raise PRM.Unbindable(
+                "string parameter outside a dictionary comparison context")
+        try:
+            e, _d = self._translate(inner, cols)
+        except SemanticError as exc:
+            # the inner node is a LITERAL: a translation failure here is a
+            # malformed VALUE in this binding (bad timestamp text), not a
+            # structural property of the template — transient, so a later
+            # well-formed binding can still create it
+            raise PRM.Unbindable(str(exc), transient=True) from exc
+        if not isinstance(e, ir.Constant) or isinstance(e.value, np.ndarray):
+            raise PRM.Unbindable(
+                f"parameter {ast.ordinal + 1} does not fold to a scalar "
+                "constant")
+        if e.value is None:
+            # the template would be typed UNKNOWN; a later non-NULL binding
+            # can create it, so this failure must not negative-cache
+            raise PRM.Unbindable(
+                "NULL first binding carries no parameter type",
+                transient=True)
+        t = e.type
+        if isinstance(t, TimestampType):
+            slot = reg.register(ast.ordinal, t, "timestamp",
+                                precision=t.precision)
+        elif t.name == "date":
+            slot = reg.register(ast.ordinal, t, "date")
+        else:
+            slot = reg.register(ast.ordinal, t, "raw")
+        return ir.Parameter(slot, t)
+
+    def _translate_param_vs(self, ast: A.ParamLit, other: ir.Expr,
+                            other_dict, cols) -> ir.Expr:
+        """A string-literal-bound parameter in comparison context: the
+        bind-time analog of _translate_vs's plan-time resolution — the
+        runtime value arrives as a dictionary id (Binder looks the bound
+        string up at bind time), epoch days, or rescaled epoch units."""
+        from . import params as PRM
+        from ..types import CharType, TimestampType
+
+        reg = getattr(self, "param_registry", None)
+        if reg is None:
+            raise SemanticError(
+                "parameter markers are not supported in this context")
+        inner = ast.inner
+        if not isinstance(inner, A.StringLit):
+            return self._translate_param(ast, cols)
+        if isinstance(other.type, CharType) and other_dict is not None \
+                and getattr(other_dict, "values", None) is not None:
+            slot = reg.register(ast.ordinal, other.type, "char",
+                                dict=other_dict)
+            return ir.Parameter(slot, other.type)
+        if other.type.is_string and other_dict is not None \
+                and getattr(other_dict, "values", None) is not None:
+            slot = reg.register(ast.ordinal, other.type, "dict",
+                                dict=other_dict)
+            return ir.Parameter(slot, other.type)
+        if other.type.name == "date":
+            slot = reg.register(ast.ordinal, DATE, "date")
+            return ir.Parameter(slot, DATE)
+        if isinstance(other.type, TimestampType):
+            from ..types import parse_timestamp_literal
+
+            try:  # template precision = the representative literal's own
+                _v, ty = parse_timestamp_literal(inner.value)
+            except ValueError as e:
+                # malformed VALUE in this binding, not template structure
+                raise PRM.Unbindable(str(e), transient=True) from e
+            slot = reg.register(ast.ordinal, ty, "timestamp",
+                                precision=ty.precision)
+            return ir.Parameter(slot, ty)
+        raise PRM.Unbindable(
+            f"cannot bind a string parameter against {other.type.name}")
+
     def _translate_vs(self, ast, other: ir.Expr, other_dict, cols) -> ir.Expr:
         """Translate ``ast`` in the context of comparison against ``other`` (resolves string
         literals to dictionary ids)."""
+        if isinstance(ast, A.ParamLit) and isinstance(ast.inner, A.StringLit):
+            return self._translate_param_vs(ast, other, other_dict, cols)
         if isinstance(ast, A.StringLit):
             from ..types import CharType, TimestampType
 
@@ -978,8 +1082,17 @@ class ExpressionAnalyzer:
             r, _ = self._translate(ast.right, cols)
             return ir.Call(op, (l, r), BOOLEAN), None
         if op in ("eq", "neq", "lt", "lte", "gt", "gte"):
-            # string-literal side gets dictionary resolution
-            if isinstance(ast.left, A.StringLit) and isinstance(ast.right, A.StringLit):
+            # string-literal side gets dictionary resolution (a parameter
+            # bound to a string literal counts as the string-literal side:
+            # its id resolves at BIND time through the same dictionary)
+            if _is_string_lit(ast.left) and _is_string_lit(ast.right):
+                if isinstance(ast.left, A.ParamLit) \
+                        or isinstance(ast.right, A.ParamLit):
+                    from . import params as PRM
+
+                    raise PRM.Unbindable(
+                        "string parameter compared against a string literal "
+                        "folds at plan time")
                 # literal-vs-literal folds at plan time (templated SQL);
                 # translating both sides would compare ids from two private
                 # dictionaries (always 0 == 0)
@@ -987,10 +1100,10 @@ class ExpressionAnalyzer:
                 res = {"eq": l == r, "neq": l != r, "lt": l < r,
                        "lte": l <= r, "gt": l > r, "gte": l >= r}[op]
                 return ir.Constant(bool(res), BOOLEAN), None
-            if isinstance(ast.right, A.StringLit) and not isinstance(ast.left, A.StringLit):
+            if _is_string_lit(ast.right) and not _is_string_lit(ast.left):
                 l, ld = self._translate(ast.left, cols)
                 r = self._translate_vs(ast.right, l, ld, cols)
-            elif isinstance(ast.left, A.StringLit) and not isinstance(ast.right, A.StringLit):
+            elif _is_string_lit(ast.left) and not _is_string_lit(ast.right):
                 r, rd = self._translate(ast.right, cols)
                 l = self._translate_vs(ast.left, r, rd, cols)
             else:

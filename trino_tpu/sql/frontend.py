@@ -71,6 +71,11 @@ class Planner(SubqueryPlannerMixin, RelationPlannerMixin,
         self.session = session
         self.ctes: dict = {}  # name -> (column_aliases, Select AST)
         self._last_projection = None  # source scope of the latest final projection
+        # plan-template planning (engine._create_template): a
+        # sql/params.ParamRegistry collecting one Binder per runtime
+        # parameter slot.  None = ordinary planning; a ParamLit reaching the
+        # analyzer then raises SemanticError.
+        self.param_registry = None
 
     # ---------------------------------------------------------------- query planning
     def plan_query(self, q: A.Select) -> P.PlanNode:

@@ -69,6 +69,29 @@ class BoolLit(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class ParamMarker(Node):
+    """A ``?`` parameter placeholder (reference: grammar ``parameter`` ->
+    sql/tree/Parameter).  Ordinals are assigned in lexical order across the
+    whole statement, matching qmark substitution order."""
+
+    ordinal: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamLit(Node):
+    """A parameter marker BOUND to a representative literal for template
+    planning (sql/params.bind_markers).  The analyzer types the parameter
+    from ``inner`` exactly as the substituted statement would, but emits an
+    ``ir.Parameter`` runtime input instead of folding the value in; code
+    paths that must consume the literal's VALUE at plan time fail template
+    creation (sql/params.Unbindable) and the engine falls back to text
+    substitution."""
+
+    ordinal: int
+    inner: Node
+
+
+@dataclasses.dataclass(frozen=True)
 class Star(Node):
     qualifier: tuple = ()
 
@@ -498,7 +521,7 @@ class SetOp(Node):
 # ----------------------------------------------------------------------------- lexer
 _TOKEN_RE = re.compile(
     r"""
-    (?P<ws>\s+|--[^\n]*)
+    (?P<ws>\s+|--[^\n]*|/\*[^*]*(?:\*(?!/)[^*]*)*\*/)
   | (?P<number>\d+(\.\d*)?([eE][+-]?\d+)?|\.\d+)
   | (?P<string>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*|"(?:[^"]|"")*")
@@ -558,6 +581,8 @@ class Parser:
         self.sql = sql
         self.tokens = tokenize(sql)
         self.i = 0
+        # ? parameter markers, numbered in token order (qmark semantics)
+        self._param_seq = 0
 
     def _remaining_text(self) -> str:
         """Raw source from the current token to the end (PREPARE bodies)."""
@@ -1441,6 +1466,11 @@ class Parser:
 
     def parse_primary(self) -> Node:
         t = self.peek()
+        if t.kind == "op" and t.value == "?":
+            self.next()
+            m = ParamMarker(self._param_seq)
+            self._param_seq += 1
+            return m
         if t.kind == "number":
             self.next()
             return NumberLit(t.value)

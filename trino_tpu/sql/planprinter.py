@@ -60,6 +60,14 @@ def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
                 f"Buffer pool: {pc_h} page hits, {pc_m} page misses, "
                 f"{getattr(counters, 'page_cache_bytes_saved', 0)} bytes "
                 f"saved, {bc_h} build hits")
+        pt_h = getattr(counters, "plan_template_hits", 0)
+        pt_m = getattr(counters, "plan_template_misses", 0)
+        if pt_h or pt_m:
+            # plan templates (round 13): a hit answered the statement through
+            # an already-compiled parameterized plan — no parse/analyze/plan,
+            # no re-trace; a miss is the one-time template creation (zero
+            # everywhere = no line, budget-suite regexes unchanged)
+            lines.append(f"Plan template: {pt_h} hits, {pt_m} misses")
         rc_h = getattr(counters, "result_cache_hits", 0)
         rc_m = getattr(counters, "result_cache_misses", 0)
         if rc_h or rc_m:
