@@ -76,6 +76,13 @@ def main():
                          "(operator/call-site -> dispatches, transfers, "
                          "bytes) — the breakdown the budget-test docstrings "
                          "cite when a ceiling regresses")
+    ap.add_argument("--history", action="store_true",
+                    help="print each warm query's est-vs-actual table from "
+                         "the plan-actuals history (node path -> CBO "
+                         "estimate, actual rows, over/under factor) — the "
+                         "same re-derivation contract as --sites: the "
+                         "history feed adds ZERO dispatches/pulls, so the "
+                         "counters printed alongside are unchanged by it")
     args = ap.parse_args()
 
     if args.page_cache is not None:
@@ -112,6 +119,24 @@ def main():
                     s = sites[key]
                     print(f"#   {key:<44} {s['dispatches']:>4} "
                           f"{s['transfers']:>4} {s['bytes']:>8}", flush=True)
+            if args.history and phase == "warm":
+                actuals = engine.last_plan_actuals or {}
+                print(f"# {name} warm est-vs-actual "
+                      f"(plan {actuals.get('fingerprint', '?')}):",
+                      flush=True)
+                from trino_tpu.execution.history import misestimate
+                for path, r in sorted((actuals.get("nodes") or {}).items()):
+                    est = r.get("est_rows")
+                    actual = r.get("actual_rows", 0)
+                    if est is None:
+                        drift = "no estimate"
+                    else:
+                        ratio, direction = misestimate(est, actual)
+                        drift = "on estimate" if direction == "exact" \
+                            else f"{ratio:.1f}x {direction}"
+                    print(f"#   {path:<32} est "
+                          f"{'-' if est is None else format(int(est), ',')}"
+                          f"{'':<2} actual {actual:,}  {drift}", flush=True)
         return out
 
     if args.batch is None:

@@ -84,6 +84,14 @@ SITE_ALLOWED_FUNCS = {
     "_host_page",  # passes its own ``site`` parameter through to _host
 }
 
+STATS_MARKER = "# stats-ok"
+
+# functions whose BODY may touch ``.stats.setdefault`` directly, with why:
+STATS_ALLOWED_FUNCS = {
+    "_node_stats",  # THE registration chokepoint: captures the structural
+    # node path + CBO estimate the plan-history feed needs (round 15)
+}
+
 
 class _Scan(ast.NodeVisitor):
     def __init__(self, lines):
@@ -94,6 +102,7 @@ class _Scan(ast.NodeVisitor):
         self.device_put_hits = []  # (lineno, enclosing function)
         self.device_get_hits = []  # (lineno, enclosing function)
         self.site_hits = []     # (lineno, enclosing function, callee)
+        self.stats_hits = []    # (lineno, enclosing function)
 
     def visit_FunctionDef(self, node):
         self.func_stack.append(node.name)
@@ -145,6 +154,16 @@ class _Scan(ast.NodeVisitor):
                 if not (set(self.func_stack) & DEVICE_PUT_ALLOWED_FUNCS) \
                         and DEVICE_MARKER not in self.lines[node.lineno - 1]:
                     self.device_put_hits.append((node.lineno, where))
+        # round-15 rule: `<anything>.stats.setdefault(` outside _node_stats —
+        # a raw registration skips the structural-path/estimate capture the
+        # plan-history feed relies on
+        if isinstance(f, ast.Attribute) and f.attr == "setdefault" \
+                and isinstance(f.value, ast.Attribute) \
+                and f.value.attr == "stats":
+            where = self.func_stack[-1] if self.func_stack else "<module>"
+            if not (set(self.func_stack) & STATS_ALLOWED_FUNCS) \
+                    and STATS_MARKER not in self.lines[node.lineno - 1]:
+                self.stats_hits.append((node.lineno, where))
         self.generic_visit(node)
 
 
@@ -215,6 +234,22 @@ def test_every_boundary_call_is_attributed(path):
                     for ln, fn, callee in s.site_hits)
         + " — pass site=\"<op.tag>\" (or '# site-ok: <reason>' if the call "
           "is intentionally untagged); named functions self-label for _jit")
+
+
+@pytest.mark.parametrize("path", _exec_files(), ids=lambda p: p.name)
+def test_stats_register_via_node_stats(path):
+    """Round-15 rule: blocking operators register per-node stats through
+    LocalExecutor._node_stats, never a bare ``self.stats.setdefault(`` —
+    the helper captures the structural node path and CBO row estimate at
+    registration, which is what lets clean-completion plan-history
+    collection merge records across executors and the cluster.  Annotate
+    '# stats-ok: <reason>' for a deliberate bypass."""
+    s = _scan(path)
+    assert not s.stats_hits, (
+        f"{path.name}: bare self.stats.setdefault at "
+        + ", ".join(f"line {ln} (in {fn})" for ln, fn in s.stats_hits)
+        + " — register through _node_stats(node) so the plan-history feed "
+          "sees the node, or annotate '# stats-ok: <reason>'")
 
 
 def _pallas_call_hits(path):
@@ -288,7 +323,14 @@ def test_lint_catches_violations(tmp_path):
         "    d = _jit(lambda v: v)\n"            # line 24: anonymous
         "    e = _jit(step)\n"                       # named -> self-labels
         "    f2 = _jit(lambda v: v, site='g.step')\n"  # tagged -> ok
-        "    return a, b, c, d, e, f2\n")
+        "    return a, b, c, d, e, f2\n"
+        "class X:\n"
+        "    def reg(self, node):\n"
+        "        s = self.stats.setdefault(id(node), {})\n"  # line 30: flagged
+        "        s2 = self.stats.setdefault(id(node), {})  # stats-ok: test\n"
+        "        return s, s2\n"
+        "    def _node_stats(self, node):\n"
+        "        return self.stats.setdefault(id(node), {})\n")  # chokepoint
     s = _scan(bad)
     assert [ln for ln, _ in s.jit_hits] == [4, 5]
     assert [ln for ln, _ in s.asarray_hits] == [6]
@@ -296,6 +338,7 @@ def test_lint_catches_violations(tmp_path):
     assert [ln for ln, _ in s.device_get_hits] == [15]
     assert [(ln, callee) for ln, _, callee in s.site_hits] == \
         [(21, "_host"), (24, "_jit")]
+    assert [ln for ln, _ in s.stats_hits] == [30]
     kern = tmp_path / "kern.py"
     kern.write_text(
         "from jax.experimental import pallas as pl\n"

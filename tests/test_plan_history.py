@@ -1,0 +1,326 @@
+"""Plan-actuals history (round 15, execution/history.py): persistent
+est-vs-actual cardinality records per plan node.
+
+What these tests pin:
+- records MERGE across pooled executors (concurrent statements), across warm
+  re-executions of one cached plan, and across the in-process cluster harvest
+  (worker task snapshots re-anchored at the fragment root's full-plan path);
+- a deliberately mis-estimating query (correlated range predicates the CBO
+  multiplies as independent) lands a >1 over-estimate ratio in the store, in
+  EXPLAIN ANALYZE's "Misestimates:" summary, in system.runtime.plan_history,
+  and on /v1/metrics;
+- the feed is FREE at the device boundary: a warm re-execution bumps
+  ``executions`` without changing the statement's dispatch/pull counters
+  (the zero-extra-dispatches invariant test_query_budgets enforces at SF1 —
+  its ceilings are unchanged with the store enabled);
+- the round-8 double-arm hazard is fixed: a second armed watchdog over the
+  same in-flight registry skips sampling instead of racing.
+"""
+
+import threading
+
+import pytest
+
+from trino_tpu import Engine
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.execution import history as H
+
+AGG_Q = """select l_returnflag, l_linestatus, sum(l_quantity) qty, count(*) c
+           from lineitem where l_shipdate <= date '1998-09-02'
+           group by l_returnflag, l_linestatus
+           order by l_returnflag, l_linestatus"""
+
+# correlated range predicates: the CBO multiplies the two selectivities as
+# independent (~1/3 x ~3%), but the conjunction is unsatisfiable — the
+# canonical over-estimate
+MIS_Q = ("select c_custkey from customer "
+         "where c_custkey > 1000 and c_custkey < 50")
+
+
+def _engine():
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.01, split_rows=1 << 11))
+    return e
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return _engine()
+
+
+# ------------------------------------------------------------------ unit layer
+def test_node_paths_structural_and_translation():
+    from trino_tpu.page import Field, Schema
+    from trino_tpu.sql import plan as P
+    from trino_tpu.types import BIGINT
+
+    sch = Schema((Field("a", BIGINT),))
+    scan = P.TableScan("c", "t", ("a",), sch)
+    filt = P.Filter(scan, None)
+    lim = P.Limit(filt, 5)
+    paths = H.plan_node_paths(lim)
+    assert paths[id(lim)] == "Limit#0"
+    assert paths[id(filt)] == "Filter#0.0"
+    assert paths[id(scan)] == "TableScan#0.0.0"
+    # structurally identical trees -> identical paths (the merge property)
+    again = P.Limit(P.Filter(P.TableScan("c", "t", ("a",), sch), None), 5)
+    assert sorted(H.plan_node_paths(again).values()) == \
+        sorted(paths.values())
+    # fragment-relative chains re-anchor by prefix composition
+    assert H.translate_path("Filter#0", "0.2") == "Filter#0.2"
+    assert H.translate_path("Filter#0.1.0", "0.2") == "Filter#0.2.1.0"
+
+
+def test_misestimate_arithmetic():
+    ratio, d = H.misestimate(100, 10)
+    assert ratio == 10.0 and d == "over"
+    ratio, d = H.misestimate(10, 100)
+    assert ratio == 10.0 and d == "under"
+    assert H.misestimate(7, 7) == (1.0, "exact")
+    ratio, d = H.misestimate(50, 0)  # empty actual: denominator clamps at 1
+    assert ratio == 50.0 and d == "over"
+
+
+def test_store_bounded_and_lru():
+    st = H.PlanHistoryStore(max_plans=2)
+    rec = {"op": "Filter", "est_rows": 10.0, "actual_rows": 5, "wall_s": 0.0,
+           "spilled_bytes": 0, "spill_tiers": {}, "cache_hits": 0}
+    for fp in ("a", "b", "c"):
+        st.record(fp, {"Filter#0": dict(rec)})
+    assert st.get("a") is None  # oldest evicted
+    assert st.get("b") is not None and st.get("c") is not None
+    st.record("b", {"Filter#0": dict(rec)})  # touch b, then add d -> c evicts
+    st.record("d", {"Filter#0": dict(rec)})
+    assert st.get("c") is None and st.get("b") is not None
+    disabled = H.PlanHistoryStore(max_plans=0)
+    assert disabled.record("x", {"Filter#0": dict(rec)}) is None
+    assert not disabled.enabled
+
+
+def test_store_ewma_and_misestimate_counter():
+    st = H.PlanHistoryStore(max_plans=4)
+    mk = lambda a: {"Agg#0": {"op": "Agg", "est_rows": 100.0,
+                              "actual_rows": a, "wall_s": 0.1,
+                              "spilled_bytes": 0, "spill_tiers": {},
+                              "cache_hits": 0}}
+    st.record("f", mk(10))
+    node = st.get("f")["nodes"]["Agg#0"]
+    assert node["actual_rows_ewma"] == 10.0  # first observation seeds
+    assert node["misestimate_ratio"] == 10.0 and node["direction"] == "over"
+    assert st.misestimates_total == 1
+    st.record("f", mk(30))
+    node = st.get("f")["nodes"]["Agg#0"]
+    assert node["executions"] == 2 and node["actual_rows"] == 30
+    assert node["actual_rows_ewma"] == pytest.approx(0.25 * 30 + 0.75 * 10)
+    assert st.misestimates_total == 2
+    assert st.worst_ratio() == node["misestimate_ratio"]
+
+
+# ------------------------------------------------------------------ engine layer
+def test_records_accumulate_across_pooled_executors_and_warm_runs(engine):
+    ph = engine.plan_history
+    s1 = engine.create_session("tpch")
+    s2 = engine.create_session("tpch")
+    # concurrent statements check out DIFFERENT pooled executors; both runs
+    # must land on ONE store entry (structural fingerprint + node paths)
+    errs = []
+
+    def run(sess):
+        try:
+            engine.execute_sql(AGG_Q, sess)
+        except Exception as e:  # pragma: no cover - surfaced by assert below
+            errs.append(e)
+
+    threads = [threading.Thread(target=run, args=(s,)) for s in (s1, s2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    payload = engine.last_plan_actuals
+    assert payload is not None
+    ent = next(e for e in ph.snapshot()
+               if e["fingerprint"] == payload["fingerprint"])
+    base_execs = ent["executions"]
+    assert base_execs >= 2
+    # every recorded node path follows the structural "<Op>#<chain>" shape
+    for path, rec in ent["nodes"].items():
+        op, _, chain = path.partition("#")
+        assert rec["op"] == op and chain.startswith("0"), path
+        assert rec["actual_rows"] >= 0
+    # warm re-execution: executions bump, dispatch counters DON'T change
+    engine.execute_sql(AGG_Q, s1)
+    warm1 = engine.last_query_counters.snapshot()
+    engine.execute_sql(AGG_Q, s1)
+    warm2 = engine.last_query_counters.snapshot()
+    assert warm2.device_dispatches == warm1.device_dispatches
+    assert warm2.host_transfers == warm1.host_transfers
+    assert warm2.host_bytes_pulled == warm1.host_bytes_pulled
+    ent2 = next(e for e in ph.snapshot()
+                if e["fingerprint"] == payload["fingerprint"])
+    assert ent2["executions"] == base_execs + 2
+
+
+def test_misestimating_filter_pins_over_ratio(engine):
+    s = engine.create_session("tpch")
+    assert len(engine.execute_sql(MIS_Q, s)) == 0  # genuinely empty
+    payload = engine.last_plan_actuals
+    assert payload is not None
+    ent = next(e for e in engine.plan_history.snapshot()
+               if e["fingerprint"] == payload["fingerprint"])
+    worst = max(r["misestimate_ratio"] for r in ent["nodes"].values())
+    assert worst > 1.0
+    top = max(ent["nodes"].values(), key=lambda r: r["misestimate_ratio"])
+    assert top["direction"] == "over" and top["actual_rows"] == 0
+    assert top["est_rows"] and top["est_rows"] > 1
+    # the lifetime misestimate counter moved (the /v1/metrics source)
+    assert engine.plan_history.misestimates_total >= 1
+    assert engine.plan_history.worst_ratio() >= worst or \
+        engine.plan_history.worst_ratio() == pytest.approx(worst)
+
+
+def test_explain_analyze_annotations_and_summary(engine):
+    s = engine.create_session("tpch")
+    res = engine.execute_sql(f"explain analyze {MIS_Q}", s)
+    text = "\n".join(r[0] for r in res.rows())
+    assert "[est " in text and "x actual " in text, text
+    assert "Misestimates:" in text, text
+    # the summary names a structural node path and an over factor
+    mis = next(l for l in text.splitlines() if l.startswith("Misestimates:"))
+    assert "#" in mis and "over" in mis
+    # an on-estimate plan keeps its print free of the summary line
+    res2 = engine.execute_sql(
+        "explain analyze select count(*) from region", s)
+    text2 = "\n".join(r[0] for r in res2.rows())
+    assert "Misestimates:" not in text2, text2
+
+
+def test_system_table_and_event_payload(engine):
+    s = engine.create_session("tpch")
+    engine.execute_sql(MIS_Q, s)
+    from trino_tpu.execution.eventlistener import EventListener
+
+    seen = []
+
+    class L(EventListener):
+        def query_completed(self, ev):
+            seen.append(ev)
+
+    engine.event_listeners.add(L())
+    try:
+        rows = engine.execute_sql(
+            "select fingerprint, node_path, op, executions, est_rows, "
+            "actual_rows, misestimate_ratio, direction "
+            "from system.runtime.plan_history", s).rows()
+    finally:
+        engine.event_listeners.listeners.remove(
+            engine.event_listeners.listeners[-1])
+    assert rows, "system.runtime.plan_history is empty"
+    by_dir = {r[7] for r in rows}
+    assert "over" in by_dir
+    paths = {r[1] for r in rows}
+    assert any(p.startswith("Project#") or p.startswith("Filter#")
+               for p in paths), paths
+    # the completion event of the system-table query itself carries the
+    # per-execution payload (history feeds on EVERY clean local completion)
+    ev = seen[-1]
+    assert ev.plan_actuals is not None
+    assert set(ev.plan_actuals) == {"fingerprint", "nodes"}
+
+
+def test_history_disabled_store_records_nothing():
+    e = Engine()
+    e.register_catalog("tpch", TpchConnector(sf=0.01, split_rows=1 << 11))
+    e.plan_history = H.PlanHistoryStore(max_plans=0)
+    s = e.create_session("tpch")
+    e.execute_sql(AGG_Q, s)
+    assert e.plan_history.snapshot() == []
+    assert e.last_plan_actuals is None
+
+
+# ------------------------------------------------------------------ cluster
+@pytest.mark.slow
+def test_cluster_harvest_merges_with_local_records(tmp_path):
+    """Local then in-process-cluster execution of ONE statement: the store
+    entry merges both (same structural fingerprint), the fragment roots'
+    actuals arrive through the worker harvest / merged-output finals, and
+    the cluster result still matches local."""
+    from trino_tpu.server.cluster import ClusterCoordinator, WorkerServer
+
+    CATALOGS = {"tpch": {"connector": "tpch", "sf": 0.01,
+                         "split_rows": 1 << 11}}
+    e = _engine()
+    expected = e.execute_sql(AGG_Q).rows()
+    payload = e.last_plan_actuals
+    assert payload is not None
+    ent = next(x for x in e.plan_history.snapshot()
+               if x["fingerprint"] == payload["fingerprint"])
+    assert ent["executions"] == 1
+    local_paths = set(ent["nodes"])
+    coord = ClusterCoordinator(e, str(tmp_path / "spool"),
+                               heartbeat_interval=0.3)
+    url = coord.start()
+    w = None
+    try:
+        w = WorkerServer(CATALOGS, str(tmp_path / "spool"),
+                         coordinator_url=url, node_id="w1")
+        w.start()
+        coord.wait_for_workers(1, timeout=60)
+        assert coord.execute_sql(AGG_Q).rows() == expected
+        assert coord.local_fallbacks == 0
+    finally:
+        coord.stop()
+        if w is not None:
+            w.stop()
+    ent2 = next(x for x in e.plan_history.snapshot()
+                if x["fingerprint"] == payload["fingerprint"])
+    assert ent2["executions"] == 2
+    # the blocking nodes the local run recorded ALL merged a second
+    # observation from the cluster run (coordinator finish + worker
+    # harvest + fragment finals), on the same structural addresses
+    agg_paths = [p for p in local_paths if p.startswith("Aggregate#")]
+    assert agg_paths, local_paths
+    for p in agg_paths + [p for p in local_paths
+                          if p.startswith(("Sort#", "Project#"))]:
+        assert ent2["nodes"][p]["executions"] == 2, (p, ent2["nodes"][p])
+        assert ent2["nodes"][p]["actual_rows"] == \
+            ent["nodes"][p]["actual_rows"]
+
+
+# ------------------------------------------------------------------ watchdog
+def test_second_armed_watchdog_skips_sampling(caplog):
+    import logging
+
+    from trino_tpu.execution import tracing
+
+    reg = tracing.InflightRegistry()
+    wd1 = tracing.StallWatchdog(registry=reg, stall_s=5.0)
+    wd2 = tracing.StallWatchdog(registry=reg, stall_s=5.0)
+    try:
+        wd1.start()
+        assert wd1._thread is not None
+        with caplog.at_level(logging.WARNING, logger="trino_tpu.stall"):
+            wd2.start()
+        assert wd2._thread is None, \
+            "second watchdog over the same registry must not sample"
+        assert any("already sampled" in r.message for r in caplog.records)
+        # verdicts stay live on BOTH (recomputed from the registry)
+        assert wd2.verdict()[0] == "ok"
+        # a DIFFERENT registry arms independently
+        wd3 = tracing.StallWatchdog(registry=tracing.InflightRegistry(),
+                                    stall_s=5.0)
+        try:
+            wd3.start()
+            assert wd3._thread is not None
+        finally:
+            wd3.stop()
+    finally:
+        wd1.stop()
+        wd2.stop()
+    # once the owner stopped, the registry is free to arm again
+    wd4 = tracing.StallWatchdog(registry=reg, stall_s=5.0)
+    try:
+        wd4.start()
+        assert wd4._thread is not None
+    finally:
+        wd4.stop()

@@ -56,6 +56,19 @@ SCHEMAS = {
         Field("hard_concurrency_limit", BIGINT), Field("max_queued", BIGINT),
         Field("scheduling_weight", BIGINT),
     )),
+    # round 15: the plan-actuals history (execution/history.PlanHistoryStore)
+    # as SQL — one row per (plan fingerprint, structural node path), merged
+    # across executors / warm re-executions / the cluster harvest.  est_rows
+    # is NULL for nodes the CBO could not estimate (no bogus ratios).
+    "plan_history": Schema((
+        Field("fingerprint", _V), Field("node_path", _V), Field("op", _V),
+        Field("plan_executions", BIGINT), Field("executions", BIGINT),
+        Field("est_rows", DOUBLE), Field("actual_rows", BIGINT),
+        Field("actual_rows_ewma", DOUBLE),
+        Field("misestimate_ratio", DOUBLE), Field("direction", _V),
+        Field("wall_s", DOUBLE), Field("spilled_bytes", BIGINT),
+        Field("cache_hits", BIGINT),
+    )),
 }
 
 
@@ -187,6 +200,16 @@ class SystemConnector:
             return [(g["name"], g["running"], g["queued"], g["hard_concurrency_limit"],
                      g["max_queued"], g["scheduling_weight"])
                     for g in e.resource_groups.info()]
+        if table == "plan_history":
+            ph = getattr(e, "plan_history", None)
+            if ph is None:
+                return []
+            return [(r["fingerprint"], r["node_path"], r["op"],
+                     r["plan_executions"], r["executions"], r["est_rows"],
+                     r["actual_rows"], r["actual_rows_ewma"],
+                     r["misestimate_ratio"], r["direction"], r["wall_s"],
+                     r["spilled_bytes"], r["cache_hits"])
+                    for r in ph.rows()]
         raise KeyError(table)
 
     def generate(self, split: SystemSplit, columns=None) -> Page:

@@ -45,6 +45,12 @@ class QueryCompletedEvent:
     counters: Optional[dict] = None
     # duration of the query's root tracing span (parse->results, seconds)
     root_span_s: Optional[float] = None
+    # round 15: the statement's est-vs-actual cardinality record —
+    # {"fingerprint": <short plan fingerprint>, "nodes": {node_path ->
+    # {op, est_rows, actual_rows, wall_s, spilled_bytes, ...}}}, the same
+    # per-execution payload engine.plan_history merged.  None for DDL,
+    # non-local execution paths, or a disabled history store.
+    plan_actuals: Optional[dict] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,4 +100,5 @@ class EventListenerManager:
             qsm.created_s, qsm.ended_s or time.time(), info.wall_s, info.rows,
             qsm.error,
             counters=getattr(qsm, "counters", None),
-            root_span_s=getattr(qsm, "root_span_duration_s", None)))
+            root_span_s=getattr(qsm, "root_span_duration_s", None),
+            plan_actuals=getattr(qsm, "plan_actuals", None)))

@@ -644,6 +644,16 @@ def inflight(kind: str, site: Optional[str] = None):
 
 # -- stall watchdog ------------------------------------------------------------
 
+# one SAMPLING watchdog per registry (round-15 fix for the round-8 hazard):
+# two Engines armed via TRINO_TPU_STALL_S in one process would each run a
+# watchdog thread over the process-global INFLIGHT registry and cross-report
+# each other's queries (duplicate logs, racing last_stall_report, double
+# async-kills).  The first start() on a registry owns sampling; a second
+# watchdog's start() logs a warning and skips instead of racing.  verdict()
+# stays live everywhere — it recomputes from the registry, not the poll.
+_ARMED_LOCK = threading.Lock()
+_ARMED_WATCHDOGS: dict = {}  # id(registry) -> owning watchdog
+
 
 class StallKilledError(RuntimeError):
     """Raised (asynchronously) in a thread whose in-flight entry exceeded
@@ -783,6 +793,19 @@ class StallWatchdog:
     def start(self) -> None:
         if not self.enabled or self._thread is not None:
             return
+        with _ARMED_LOCK:
+            owner = _ARMED_WATCHDOGS.get(id(self.registry))
+            if owner is not None and owner is not self:
+                # second armed watchdog over the SAME registry (two env-armed
+                # Engines in one process): skip sampling instead of racing —
+                # the owner reports for everyone, and this instance's
+                # verdict()/health surfaces still recompute live
+                _log.warning(
+                    "stall watchdog: registry already sampled by another "
+                    "watchdog in this process; skipping (one armed Engine "
+                    "per process samples the global registry)")
+                return
+            _ARMED_WATCHDOGS[id(self.registry)] = self
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop,
                                         name="stall-watchdog", daemon=True)
@@ -796,6 +819,9 @@ class StallWatchdog:
                 pass
 
     def stop(self) -> None:
+        with _ARMED_LOCK:
+            if _ARMED_WATCHDOGS.get(id(self.registry)) is self:
+                del _ARMED_WATCHDOGS[id(self.registry)]
         self._stop.set()
         t, self._thread = self._thread, None
         if t is not None:

@@ -301,6 +301,26 @@ class _TaskState:
     # cluster-wide counter flow the coordinator merges per query
     counters: Optional[dict] = None
     spans: Optional[list] = None
+    # round 15: fragment-relative est-vs-actual node records
+    # (execution/history.collect_plan_actuals over the task's executor stats,
+    # node paths anchored at the FRAGMENT root) — the coordinator re-anchors
+    # them at the fragment's full-plan path and folds them into the engine's
+    # plan-history store
+    plan_stats: Optional[dict] = None
+
+
+def _subtree_ids(node) -> list:
+    """Every id() in a plan subtree (stale-stats scoping for pooled worker
+    executors, which reset stats only in execute())."""
+    out: list = []
+
+    def walk(n):
+        out.append(id(n))
+        for c in n.children:
+            walk(c)
+
+    walk(node)
+    return out
 
 
 class WorkerServer:
@@ -511,7 +531,8 @@ class WorkerServer:
                     return self._reply(200, {"state": st.state, "error": st.error,
                                              "retryable": st.retryable,
                                              "counters": st.counters,
-                                             "spans": st.spans})
+                                             "spans": st.spans,
+                                             "plan_stats": st.plan_stats})
                 self._reply(404, {"error": "not found"})
 
             def _read_verified(self):
@@ -675,6 +696,21 @@ class WorkerServer:
                 for ex in self._all_executors:  # drop compiled artifacts too
                     ex.forget_plan(old)
 
+    def _collect_task_plan_stats(self, node, ex) -> Optional[dict]:
+        """Fragment-relative plan-actuals for the task snapshot: whatever
+        blocking-operator stats this task's run left on its executor, keyed
+        by node paths anchored at the FRAGMENT root (the coordinator
+        re-anchors).  Best-effort and host-only — a collection failure loses
+        history, never the task."""
+        try:
+            from ..execution.history import collect_plan_actuals
+
+            return collect_plan_actuals(
+                node, ex.stats, boundary=ex.boundary, catalogs=self.catalogs,
+                paths=ex._node_paths, ests=ex._node_ests) or None
+        except Exception:
+            return None
+
     def _start_task(self, req: dict):
         tid = str(req["task_id"])
         frag_id = req["fragment_id"]
@@ -751,6 +787,16 @@ class WorkerServer:
             # the session's page_cache override rides the task request too
             # (None = this worker's TRINO_TPU_PAGE_CACHE gate)
             ex.page_cache = req.get("page_cache")
+            # plan-actuals scoping (round 15): pooled worker executors reset
+            # stats/boundary only in execute(), which the task drivers
+            # bypass — drop this fragment's stale entries (stats AND the
+            # boundary sinks cache_hits ride on) and stamp fragment-relative
+            # node paths + estimates so the task snapshot ships exactly THIS
+            # task's actuals
+            for _nid in _subtree_ids(node):
+                ex.stats.pop(_nid, None)
+                ex.boundary.pop(_nid, None)
+            ex.begin_plan(node)
 
             def tick(t=token):
                 # preemption point doubles as the kill checkpoint: a query
@@ -807,6 +853,7 @@ class WorkerServer:
                         raise ValueError(f"unknown task kind {kind!r}")
                 # snapshot BEFORE the output becomes visible: a coordinator
                 # that just observed the commit must find the stats populated
+                st.plan_stats = self._collect_task_plan_stats(node, ex)
                 st.counters = counters.as_dict()
                 st.spans = [tracing.span_dict(s)
                             for s in self.tracer.spans_for(tid)]
@@ -1065,6 +1112,9 @@ class ClusterCoordinator:
         # own and the query merge folds them in)
         self._worker_spans: list = []
         self._harvested: set = set()  # task ids already merged this query
+        self._task_plan_stats: dict = {}  # task id -> fragment-relative
+        # plan-actuals records harvested with the task counters (round 15)
+        self._fragment_rows: dict = {}  # id(node) -> nested-fragment rows
 
     # -- lifecycle ---------------------------------------------------------------
     def start(self) -> str:
@@ -1403,13 +1453,22 @@ class ClusterCoordinator:
             self._qc_children = []
             self._worker_spans = []
             self._harvested = set()
+            # harvested fragment-relative plan-actuals per task id (round
+            # 15): folded into the engine's plan-history store at clean
+            # completion, re-anchored at each fragment root's full-plan path
+            self._task_plan_stats = {}
+            self._fragment_rows = {}  # id(node) -> merged final row count
+            # for NESTED fragment roots (consumed remotely, so never in the
+            # local finish's overrides)
             # per-query retry budget + backoff schedule (queries serialize on
             # _query_lock, so plain resets are race-free)
             self._query_retries = 0
             self.last_retry_schedule = []
             try:
                 if not self.live_workers():
-                    return local.execute(plan)
+                    out = local.execute(plan)
+                    self.engine._record_plan_history(plan, local)
+                    return out
                 with self._lock:
                     self._exchange_seq += 1
                     seq = self._exchange_seq
@@ -1451,11 +1510,16 @@ class ClusterCoordinator:
                             pre = local.counters.snapshot()
                             out = local.execute(plan)
                             local.counters.merge(pre)
+                            # a degraded-to-local run is still a clean local
+                            # completion: feed the history store like the
+                            # engine's own local path
+                            self.engine._record_plan_history(plan, local)
                             return out
                         if not spooled:
                             pre = local.counters.snapshot()
                             out = local.execute(plan)
                             local.counters.merge(pre)
+                            self.engine._record_plan_history(plan, local)
                             return out
                         overrides = {}
                         for nid in self._top_fragments(plan, spooled):
@@ -1466,8 +1530,24 @@ class ClusterCoordinator:
                                                             n.schema)
                             overrides[nid] = hit
                         local._overrides = overrides
+                        # plan-actuals scoping for the SHARED coordinator
+                        # executor (stats/boundary reset only in execute(),
+                        # which this path bypasses): drop this plan's stale
+                        # entries — boundary too, or warm repeats would fold
+                        # CUMULATIVE cache-hit sinks into each run's record —
+                        # and stamp full-plan paths/estimates for the finish
+                        for _nid in _subtree_ids(plan):
+                            local.stats.pop(_nid, None)
+                            local.boundary.pop(_nid, None)
+                        local.begin_plan(plan)
                         out_page, dd = local._execute_to_page(plan)
-                        return _materialize(out_page, dd)
+                        out = _materialize(out_page, dd)
+                        # clean cluster completion: coordinator local-finish
+                        # stats + harvested worker records + fragment-root
+                        # finals fold into the engine's plan-history store
+                        self._record_cluster_history(plan, spooled, local,
+                                                     overrides)
+                        return out
                 finally:
                     local._overrides = {}
                     self._mem_results = {}
@@ -1489,6 +1569,75 @@ class ClusterCoordinator:
                     self.last_query_counters = merged
                     self.last_query_worker_spans = list(self._worker_spans)
                 self.engine._account_counters(merged)
+
+    def _record_cluster_history(self, plan, spooled, local,
+                                overrides) -> None:
+        """Fold one clean cluster execution's actuals into the engine's
+        plan-history store under the FULL plan's fingerprint: the
+        coordinator's local-finish stats, every harvested worker task's
+        fragment-relative records re-anchored at its fragment root's
+        full-plan path (split tasks of one fragment SUM — they partition one
+        logical node's input), and each consumed fragment root's FINAL row
+        count read from the override page the finish just materialized (the
+        coordinator-merged count, which worker partials can't supply).
+        Best-effort like every history feed: a failure here loses the
+        record, never the query."""
+        ph = getattr(self.engine, "plan_history", None)
+        if ph is None or not ph.enabled:
+            return
+        try:
+            from ..execution.history import (fold_records, plan_node_paths,
+                                             translate_path)
+
+            paths = plan_node_paths(plan)
+            ests = getattr(local, "_node_ests", None) or {}
+            extra: dict = {}
+            with self._lock:
+                task_stats = dict(self._task_plan_stats)
+            for nid, (tids, node) in spooled.items():
+                root_path = paths.get(id(node))
+                if root_path is None:
+                    continue
+                root_chain = root_path.partition("#")[2]
+                for tid in tids:
+                    for rel, rec in (task_stats.get(tid) or {}).items():
+                        fold_records(extra, translate_path(rel, root_chain),
+                                     rec)
+            # fragment-root FINALS: override pages the local finish consumed
+            # (top fragments) and the merged row counts stashed when nested
+            # fragment outputs spooled.  OVERWRITE, don't fold: worker
+            # partial counts sum to more than the merged output (partial-agg
+            # groups repeat per task).
+            finals: dict = dict(self._fragment_rows)
+            for nid, hit in (overrides or {}).items():
+                try:
+                    finals[nid] = int(hit[0].num_rows())
+                except Exception:
+                    pass
+            for nid, rows in finals.items():
+                root_path = paths.get(nid)
+                if root_path is None:
+                    continue
+                rec = extra.setdefault(root_path, {
+                    "op": root_path.partition("#")[0], "est_rows": None,
+                    "actual_rows": 0, "wall_s": 0.0, "spilled_bytes": 0,
+                    "spill_tiers": {}, "cache_hits": 0})
+                rec["actual_rows"] = rows
+            # worker-side estimates are fragment-blind (RemoteSource inputs
+            # estimate unknown): backfill from the coordinator's full-plan
+            # estimate map so harvested records carry ratios too
+            est_by_path: dict = {}
+            for nid, path in paths.items():
+                v = ests.get(nid)
+                if v is not None:
+                    est_by_path.setdefault(path, v)
+            for path, rec in extra.items():
+                if rec.get("est_rows") is None:
+                    rec["est_rows"] = est_by_path.get(path)
+            self.engine._record_plan_history(plan, local,
+                                             extra_records=extra)
+        except Exception:
+            pass
 
     # -- cluster counter flow --------------------------------------------------
     def _harvest_task_stats(self, worker_url: str, tid: str) -> None:
@@ -1514,6 +1663,9 @@ class ClusterCoordinator:
                 return
             self._harvested.add(tid)
             self._qc_workers.merge_dict(counters or {})
+            ps = st.get("plan_stats")
+            if ps:
+                self._task_plan_stats[tid] = ps
             for s in st.get("spans") or ():
                 self._worker_spans.append(s)
 
@@ -1599,6 +1751,11 @@ class ClusterCoordinator:
                         from ..exec.local_executor import _host_page
 
                         valid, pcols, pnulls = _host_page(page)
+                        # plan-actuals: the merged fragment output's FINAL
+                        # row count, free from the host mask this spool
+                        # already pulled (nested roots never appear in the
+                        # local finish's overrides)
+                        self._fragment_rows[id(node)] = int(valid.sum())
                         cols = [c[valid] for c in pcols]
                         nulls = [None if (m is None or not m[valid].any())
                                  else m[valid] for m in pnulls]

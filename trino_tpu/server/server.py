@@ -433,6 +433,14 @@ class CoordinatorServer:
                     # TaskInfo live snapshots behind the web UI)
                     self._send(200, server._status_json())
                     return
+                if parts == ["v1", "history"]:
+                    # round 15: the plan-actuals history — per-node est-vs-
+                    # actual records merged across executions (the JSON twin
+                    # of system.runtime.plan_history)
+                    ph = getattr(server.engine, "plan_history", None)
+                    self._send(200, ph.as_dict() if ph is not None
+                               else {"plans": []})
+                    return
                 # /v1/spooled/{qid}/{seg} — spooled result segment payload
                 # (reference: the client fetching spooled segments by URI,
                 # client/trino-client/.../OkHttpSegmentLoader.java)
@@ -699,6 +707,27 @@ class CoordinatorServer:
                 f"trino_tpu_plan_template_misses_total "
                 f"{getattr(ct, 'plan_template_misses', 0)}",
             ]
+            # round 15: cardinality-drift signal from the plan-actuals
+            # history — the worst est-vs-actual factor currently on record
+            # (gauge: it moves as records merge and plans evict) and the
+            # lifetime count of node executions past the misestimate
+            # threshold
+            ph = getattr(self.engine, "plan_history", None)
+            if ph is not None:
+                lines += [
+                    "# HELP trino_tpu_cardinality_misestimate_ratio Worst "
+                    "est-vs-actual row factor in the plan-actuals history "
+                    "(1.0 = everything on estimate).",
+                    "# TYPE trino_tpu_cardinality_misestimate_ratio gauge",
+                    f"trino_tpu_cardinality_misestimate_ratio "
+                    f"{ph.worst_ratio():.3f}",
+                    "# HELP trino_tpu_misestimated_nodes_total Plan-node "
+                    "executions recorded past the misestimate threshold "
+                    "(2x over/under).",
+                    "# TYPE trino_tpu_misestimated_nodes_total counter",
+                    f"trino_tpu_misestimated_nodes_total "
+                    f"{ph.misestimates_total}",
+                ]
             sites = getattr(ct, "sites", None) or {}
             if sites:
                 lines += ["# HELP trino_tpu_site_dispatches_total Device "

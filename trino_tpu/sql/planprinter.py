@@ -13,7 +13,8 @@ __all__ = ["format_plan"]
 
 
 def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
-                boundary: dict = None) -> str:
+                boundary: dict = None, ests: dict = None,
+                paths: dict = None) -> str:
     """``stats``: optional id(node) -> {rows, wall_s} from an EXPLAIN ANALYZE run
     (reference: PlanPrinter's textDistributedPlan with OperatorStats).
     ``counters``: optional per-query device-boundary counters
@@ -23,9 +24,17 @@ def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
     per-operator attribution (LocalExecutor.boundary: id(node) ->
     {label, dispatches, transfers, bytes}, plus a "result" entry for the final
     materialization pull); per-operator rows sum to the counter totals
-    exactly (innermost-scope attribution)."""
+    exactly (innermost-scope attribution).  ``ests``: optional id(node) ->
+    CBO row estimate (executor begin_plan maps, execution/history.py) —
+    nodes with both an estimate and actuals get an
+    ``[est N x actual M -> K.Kx over/under]`` annotation and the worst
+    offenders roll up into a "Misestimates:" summary line; ``paths`` names
+    them by structural node path."""
     lines: list = []
-    _fmt(node, lines, 0, stats or {}, boundary or {})
+    _fmt(node, lines, 0, stats or {}, boundary or {}, ests or {})
+    mis = _misestimate_summary(stats or {}, ests or {}, paths or {})
+    if mis:
+        lines.append(mis)
     if counters is not None:
         boundary_line = (
             f"Device boundary: {counters.device_dispatches} dispatches, "
@@ -89,6 +98,34 @@ def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
     return "\n".join(lines)
 
 
+def _misestimate_summary(stats: dict, ests: dict, paths: dict) -> str:
+    """One "Misestimates:" line naming the worst est-vs-actual offenders
+    (ratio >= MISESTIMATE_THRESHOLD, worst first, top 5) — the drift signal
+    an EXPLAIN ANALYZE reader scans for before the adaptive loop exists to
+    consume it.  Empty string when every node is within threshold (non-
+    analyze prints and on-estimate plans are unchanged)."""
+    from ..execution.history import MISESTIMATE_THRESHOLD, misestimate
+
+    worst: list = []
+    for nid, s in stats.items():
+        est = s.get("est_rows", ests.get(nid))
+        if est is None:
+            continue
+        actual = int(s["rows"])
+        ratio, direction = misestimate(est, actual)
+        if ratio < MISESTIMATE_THRESHOLD:
+            continue
+        label = s.get("path") or paths.get(nid) or s.get("op", "node")
+        worst.append((ratio, label, est, actual, direction))
+    if not worst:
+        return ""
+    worst.sort(key=lambda w: (-w[0], w[1]))
+    inner = "; ".join(
+        f"{label} est {int(est):,} actual {actual:,} ({ratio:.1f}x {d})"
+        for ratio, label, est, actual, d in worst[:5])
+    return f"Misestimates: {inner}"
+
+
 def _boundary_nonzero(b: dict) -> bool:
     return bool(b.get("dispatches") or b.get("transfers") or b.get("bytes"))
 
@@ -108,9 +145,10 @@ def _schema_str(node: P.PlanNode) -> str:
 
 
 def _fmt(node: P.PlanNode, lines: list, depth: int, stats: dict,
-         boundary: dict = None) -> None:
+         boundary: dict = None, ests: dict = None) -> None:
     pad = "    " * depth
     boundary = boundary or {}
+    ests = ests or {}
     before = len(lines)
     if isinstance(node, P.Output):
         lines.append(f"{pad}Output[{', '.join(node.names)}]")
@@ -174,6 +212,19 @@ def _fmt(node: P.PlanNode, lines: list, depth: int, stats: dict,
         if s.get("index_join_keys"):
             # the probe scan collapsed to a connector keyed lookup
             lines[before] += f" [index lookup: {s['index_join_keys']} keys]"
+        est = s.get("est_rows", ests.get(id(node)))
+        if est is not None:
+            # est-vs-actual drift annotation (round 15): what the CBO
+            # promised against what arrived, with the over/under factor —
+            # the per-node view of the plan-history record this run fed
+            from ..execution.history import misestimate
+
+            actual = int(s["rows"])
+            ratio, direction = misestimate(est, actual)
+            drift = "on estimate" if direction == "exact" \
+                else f"{ratio:.1f}x {direction}"
+            lines[before] += (f" [est {int(est):,} x actual {actual:,} "
+                              f"-> {drift}]")
     b = boundary.get(id(node))
     if b is not None and _boundary_nonzero(b) and len(lines) > before:
         # per-operator device-boundary attribution (the OperatorStats analog
@@ -181,4 +232,4 @@ def _fmt(node: P.PlanNode, lines: list, depth: int, stats: dict,
         # operator (and the streaming chain it drives) executed
         lines[before] += f" [boundary: {_boundary_str(b)}]"
     for c in node.children:
-        _fmt(c, lines, depth + 1, stats, boundary)
+        _fmt(c, lines, depth + 1, stats, boundary, ests)
