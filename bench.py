@@ -300,6 +300,16 @@ def _baseline_diff(base_pq: dict, now_pq: dict) -> dict:
             if bv is None and nv is None:
                 continue
             d[k] = {"base": bv, "now": nv}
+        # wall-breakdown buckets (round 16): diffed for VISIBILITY, never
+        # flagged — a regressed capture should show WHICH bucket moved
+        # (dispatch vs host_pull vs unattributed), but bucket drift between
+        # captures is timing, not by itself a verdict
+        bbd, nbd = b.get("wall_breakdown") or {}, n.get("wall_breakdown") or {}
+        if bbd or nbd:
+            d["wall_breakdown"] = {
+                k: {"base": bbd.get(k), "now": nbd.get(k)}
+                for k in sorted(set(bbd) | set(nbd))
+                if (bbd.get(k) or 0) > 0.0005 or (nbd.get(k) or 0) > 0.0005}
         d["flags"] = flags
         queries[q] = d
         if flags:
@@ -445,6 +455,13 @@ def main(argv=None):
                         "dispatch_p50_s": qc.dispatch_latency.quantile(0.5),
                         "dispatch_p99_s": qc.dispatch_latency.quantile(0.99),
                     }
+                    # round 16: the warm run's wall decomposed into named
+                    # buckets (device dispatch vs host pull vs generation vs
+                    # unattributed) — "where did the time go" rides every
+                    # capture, and --baseline diffs WHICH bucket moved
+                    bd = tr.get("wall_breakdown")
+                    if bd:
+                        query_counters[name]["wall_breakdown"] = bd
                 except Exception:
                     pass
                 print(f"bench: {name} engine cold={cold_s:.2f}s warm={med:.3f}s "

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Flight-recorder reader: post-mortem on a DEAD process's record directory.
+
+The recorder (trino_tpu/execution/flightrecorder.py) mirrors every statement
+record into an on-disk JSONL ring when TRINO_TPU_FLIGHT_DIR is set; this
+reader needs only that directory — no engine, no jax, no live process — so a
+wedged-tunnel capture window leaves an artifact this script can decompose
+hours later (the gap scripts/tpu_watch.sh has papered over with hand-rolled
+/v1/status tailing for three rounds).
+
+    python scripts/flight.py DIR                 # one summary line per record
+    python scripts/flight.py DIR --id query_7    # one record, full JSON
+    python scripts/flight.py DIR --json          # every record, JSON lines
+    python scripts/flight.py DIR --stalls        # stall events only
+
+Summary columns: query id, state, wall, dispatch/byte counters, and the top
+wall-breakdown bucket — "where did the time go" per statement, from disk.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_reader():
+    """Load flightrecorder.py DIRECTLY (not through the trino_tpu package,
+    whose __init__ imports jax): the module is stdlib-pure, so this reader
+    runs on boxes — and in moments — where jax cannot even initialize
+    (exactly when a post-mortem is wanted)."""
+    import importlib.util
+
+    path = os.path.join(_REPO, "trino_tpu", "execution", "flightrecorder.py")
+    spec = importlib.util.spec_from_file_location("_flightrecorder", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.read_flight_dir
+
+
+read_flight_dir = _load_reader()
+
+WALL_BUCKETS = ("plan", "admission_queue", "split_generation", "h2d",
+                "device_dispatch", "host_pull", "exchange_wait",
+                "retry_backoff", "unattributed")
+
+
+def _top_bucket(bd):
+    if not bd:
+        return "-"
+    best = max((b for b in WALL_BUCKETS), key=lambda b: bd.get(b) or 0.0)
+    v = bd.get(best) or 0.0
+    if v <= 0:
+        return "-"
+    wall = bd.get("wall_s") or 0.0
+    pct = f" ({v / wall * 100:.0f}%)" if wall else ""
+    return f"{best} {v * 1000:.1f}ms{pct}"
+
+
+def _summary_line(rec) -> str:
+    if rec.get("kind") == "stall":
+        stuck = ", ".join(e.get("label", "?")
+                          for e in rec.get("stalled") or [])[:60]
+        return (f"{'<stall>':<14} {'-':<9} {'-':>9} {'-':>6} {'-':>10}  "
+                f"stuck: {stuck}")
+    c = rec.get("counters") or {}
+    wall = rec.get("wall_s")
+    return (f"{rec.get('query_id') or '?':<14} "
+            f"{rec.get('state') or '?':<9} "
+            f"{('%.3fs' % wall) if wall is not None else '-':>9} "
+            f"{c.get('device_dispatches') or 0:>6} "
+            f"{c.get('host_bytes_pulled') or 0:>10}  "
+            f"{_top_bucket(rec.get('wall_breakdown'))}"
+            + (f"  ERROR: {rec['error'][:60]}" if rec.get("error") else ""))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dir", help="flight directory (TRINO_TPU_FLIGHT_DIR)")
+    ap.add_argument("--id", default=None,
+                    help="print ONE record (full JSON) by query id")
+    ap.add_argument("--json", action="store_true",
+                    help="dump every record as JSON lines")
+    ap.add_argument("--stalls", action="store_true",
+                    help="stall events only")
+    args = ap.parse_args(argv)
+    recs = read_flight_dir(args.dir)
+    if not recs:
+        print(f"no flight records under {args.dir}", file=sys.stderr)
+        return 1
+    if args.id is not None:
+        hits = [r for r in recs if r.get("query_id") == args.id]
+        if not hits:
+            print(f"no record for {args.id}", file=sys.stderr)
+            return 1
+        print(json.dumps(hits[-1], indent=1))
+        return 0
+    if args.stalls:
+        recs = [r for r in recs if r.get("kind") == "stall"]
+    if args.json:
+        for r in recs:
+            print(json.dumps(r))
+        return 0
+    print(f"{'query':<14} {'state':<9} {'wall':>9} {'disp':>6} "
+          f"{'bytes':>10}  top bucket")
+    for r in recs:
+        print(_summary_line(r))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # | head closed the pipe: not an error
+        sys.exit(0)

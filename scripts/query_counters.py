@@ -76,6 +76,14 @@ def main():
                          "(operator/call-site -> dispatches, transfers, "
                          "bytes) — the breakdown the budget-test docstrings "
                          "cite when a ceiling regresses")
+    ap.add_argument("--breakdown", action="store_true",
+                    help="print each warm query's wall-clock decomposition "
+                         "(execution/tracing.wall_breakdown over the span "
+                         "tree: plan / split generation / h2d / device "
+                         "dispatch / host pull / unattributed) — the same "
+                         "re-derivation contract as --sites/--history: the "
+                         "breakdown is computed from spans the run already "
+                         "emitted, zero extra dispatches/pulls")
     ap.add_argument("--history", action="store_true",
                     help="print each warm query's est-vs-actual table from "
                          "the plan-actuals history (node path -> CBO "
@@ -119,6 +127,20 @@ def main():
                     s = sites[key]
                     print(f"#   {key:<44} {s['dispatches']:>4} "
                           f"{s['transfers']:>4} {s['bytes']:>8}", flush=True)
+            if args.breakdown and phase == "warm":
+                from trino_tpu.execution.tracing import WALL_BUCKETS
+                bd = (engine.last_query_trace or {}).get("wall_breakdown") \
+                    or {}
+                print(f"# {name} warm wall breakdown "
+                      f"(total {bd.get('wall_s', 0.0) * 1000:.1f} ms):",
+                      flush=True)
+                for b in WALL_BUCKETS:
+                    v = bd.get(b) or 0.0
+                    if v <= 0:
+                        continue
+                    wall = bd.get("wall_s") or 1.0
+                    print(f"#   {b:<18} {v * 1000:>9.2f} ms "
+                          f"{v / wall * 100:>5.1f}%", flush=True)
             if args.history and phase == "warm":
                 actuals = engine.last_plan_actuals or {}
                 print(f"# {name} warm est-vs-actual "

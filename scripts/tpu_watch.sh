@@ -32,6 +32,19 @@ echo "$(date -Is) watcher start (r09)" >> "$LOG"
 STATUS_PORT=18923
 export TRINO_TPU_STALL_S="${TRINO_TPU_STALL_S:-240}"
 export BENCH_STATUS_PORT=$STATUS_PORT
+# Round 16: every capture run's FLIGHT RECORDER mirrors to disk — one JSONL
+# record per statement (counters, span tree, wall breakdown) plus stall
+# events, surviving the process.  scripts/flight.py reads the directory even
+# after a wedge kills the run; the status_tail below stays as a live
+# in-addition signal, but the recorder directory is the durable artifact.
+export TRINO_TPU_FLIGHT_DIR=scripts/flight_r16
+export TRINO_TPU_FLIGHT_BYTES=$((256 * 1024 * 1024))
+# NEVER delete a previous ring — it may be the only record of a wedged
+# session nobody has read yet.  Archive it timestamped, keep the last 3.
+if [ -d scripts/flight_r16 ]; then
+  mv scripts/flight_r16 "scripts/flight_r16.prev.$(date +%s)"
+fi
+ls -dt scripts/flight_r16.prev.* 2>/dev/null | tail -n +4 | xargs -r rm -rf
 status_tail() {
   while :; do
     s=$(timeout 8 python -c "import urllib.request as u;print(u.urlopen('http://127.0.0.1:${STATUS_PORT}/v1/status',timeout=5).read().decode())" 2>/dev/null)
@@ -173,9 +186,26 @@ for name in ("sf1_spill", "sf100_q18"):
         out[name] = json.load(open(f"scripts/bench_{name}.json"))
     except Exception as e:
         out[name] = {"error": str(e)}
+# round 16: flight-recorder summary — per-statement wall breakdowns + stall
+# events captured across every bench above, read straight from the disk ring
+# (the full directory scripts/flight_r16 stays on disk for scripts/flight.py)
+try:
+    import subprocess as _sp
+    flight = _sp.run(["python", "scripts/flight.py", "scripts/flight_r16",
+                      "--json"], capture_output=True, text=True, timeout=120)
+    recs = [json.loads(l) for l in flight.stdout.splitlines() if l.strip()]
+    out["flight"] = {"records": len(recs),
+                     "stalls": [r for r in recs if r.get("kind") == "stall"],
+                     "breakdowns": [
+                         {"query_id": r.get("query_id"),
+                          "state": r.get("state"),
+                          "wall_breakdown": r.get("wall_breakdown")}
+                         for r in recs if r.get("kind") == "query"][-40:]}
+except Exception as e:
+    out["flight"] = {"error": str(e)}
 json.dump(out, open("BENCH_local_r09.json", "w"), indent=1)
 PY
-    echo "$(date -Is) wrote BENCH_local_r09.json" >> "$LOG"
+    echo "$(date -Is) wrote BENCH_local_r09.json (flight ring: scripts/flight_r16)" >> "$LOG"
     exit 0
   fi
   echo "$(date -Is) probe $i: tunnel down" >> "$LOG"

@@ -526,6 +526,12 @@ def test_metrics_stall_memory_and_resource_group_gauges(profiling_server,
     assert parsed["types"]["trino_tpu_resource_group_running"] == "gauge"
     groups = parsed["samples"]["trino_tpu_resource_group_queued"]
     assert groups and all(lbl.get("group") for lbl, _ in groups)
+    # round-16 satellite: the flight-recorder series ride the same strict
+    # exposition (records/bytes gauges, lifetime + stitched-span counters)
+    assert parsed["types"]["trino_tpu_flight_records"] == "gauge"
+    assert parsed["samples"]["trino_tpu_flight_records"][0][1] > 0
+    assert parsed["types"]["trino_tpu_flight_spans_total"] == "counter"
+    assert parsed["types"]["trino_tpu_flight_worker_spans_total"] == "counter"
 
 
 def test_runtime_queries_boundary_columns(engine):

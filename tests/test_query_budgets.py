@@ -238,6 +238,33 @@ def test_explain_analyze_q9_per_operator_attribution(sf1):
     assert sum(int(d) for d, _t, _b in op_rows) > 0
 
 
+def test_warm_wall_breakdown_sums_to_wall(sf1):
+    """Round-16 acceptance: warm SF1 q3 and q18 wall-breakdown buckets sum
+    to within 5% of the measured wall (by construction: disjoint sweep
+    attribution + an explicit unattributed remainder), and the flight
+    recorder is ENABLED for every budgeted run in this module — its feed
+    adds zero dispatches/pulls, so the ceilings above are UNCHANGED."""
+    from trino_tpu.execution.tracing import WALL_BUCKETS
+
+    engine, session = sf1
+    assert engine.flight_recorder.enabled  # the budget runs record flights
+    for name in ("q3", "q18"):
+        engine.execute_sql(QUERIES[name], session)  # cold/warm-up
+        engine.execute_sql(QUERIES[name], session)  # warm: the measured run
+        t = engine.last_query_trace
+        bd = t.get("wall_breakdown")
+        assert bd, f"{name}: no wall breakdown on the warm trace"
+        total = sum(bd[b] for b in WALL_BUCKETS)
+        wall = bd["wall_s"]
+        assert wall > 0 and abs(total - wall) <= 0.05 * wall, \
+            (name, total, wall, bd)
+        # the dominant cost is named, not everything dumped in unattributed
+        assert bd["device_dispatch"] > 0, bd
+        # the statement's flight record carries the same decomposition
+        rec = engine.flight_recorder.get(t["query_id"])
+        assert rec is not None and rec["wall_breakdown"] == bd
+
+
 def test_explain_analyze_shows_device_boundary(engine):
     """EXPLAIN ANALYZE surfaces the per-query counters (sql/planprinter)."""
     r = engine.execute_sql(

@@ -56,6 +56,24 @@ SCHEMAS = {
         Field("hard_concurrency_limit", BIGINT), Field("max_queued", BIGINT),
         Field("scheduling_weight", BIGINT),
     )),
+    # round 16: the flight recorder (execution/flightrecorder.FlightRecorder)
+    # as SQL — one row per recorded statement (completed AND errored), with
+    # the boundary counters and the wall-clock decomposition flattened into
+    # per-bucket seconds.  NULL bucket columns mean no breakdown could be
+    # established (no closed root span), never a fabricated zero.
+    "query_log": Schema((
+        Field("query_id", _V), Field("state", _V), Field("query", _V),
+        Field("user", _V), Field("error", _V),
+        Field("wall_s", DOUBLE), Field("queued_s", DOUBLE),
+        Field("device_dispatches", BIGINT), Field("host_transfers", BIGINT),
+        Field("host_bytes_pulled", BIGINT),
+        Field("faults_injected", BIGINT), Field("task_retries", BIGINT),
+        Field("pressure_rung", _V), Field("spans", BIGINT),
+        Field("plan_s", DOUBLE), Field("split_generation_s", DOUBLE),
+        Field("h2d_s", DOUBLE), Field("device_dispatch_s", DOUBLE),
+        Field("host_pull_s", DOUBLE), Field("exchange_wait_s", DOUBLE),
+        Field("retry_backoff_s", DOUBLE), Field("unattributed_s", DOUBLE),
+    )),
     # round 15: the plan-actuals history (execution/history.PlanHistoryStore)
     # as SQL — one row per (plan fingerprint, structural node path), merged
     # across executors / warm re-executions / the cluster harvest.  est_rows
@@ -200,6 +218,29 @@ class SystemConnector:
             return [(g["name"], g["running"], g["queued"], g["hard_concurrency_limit"],
                      g["max_queued"], g["scheduling_weight"])
                     for g in e.resource_groups.info()]
+        if table == "query_log":
+            fr = getattr(e, "flight_recorder", None)
+            if fr is None:
+                return []
+            out = []
+            for rec in fr.snapshot(kind="query"):
+                c = rec.get("counters") or {}
+                bd = rec.get("wall_breakdown") or {}
+                out.append((
+                    rec.get("query_id"), rec.get("state"), rec.get("sql"),
+                    rec.get("user"), rec.get("error"),
+                    rec.get("wall_s"), rec.get("queued_s"),
+                    c.get("device_dispatches"), c.get("host_transfers"),
+                    c.get("host_bytes_pulled"),
+                    c.get("faults_injected"), c.get("task_retries"),
+                    rec.get("pressure_rung"),
+                    len((rec.get("trace") or {}).get("spans") or ()),
+                    bd.get("plan"), bd.get("split_generation"),
+                    bd.get("h2d"), bd.get("device_dispatch"),
+                    bd.get("host_pull"), bd.get("exchange_wait"),
+                    bd.get("retry_backoff"), bd.get("unattributed"),
+                ))
+            return out
         if table == "plan_history":
             ph = getattr(e, "plan_history", None)
             if ph is None:

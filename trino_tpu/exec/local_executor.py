@@ -4436,8 +4436,11 @@ def _host(arrays, site=None):
     pass one or carry a ``# site-ok`` marker — tests/test_boundary_lint.py).
     Each pull also holds an in-flight registry entry while it runs, so a pull
     wedged on a dead tunnel shows up in the stall watchdog's report."""
+    import time as _time
+
     reg = tracing.current_inflight()
     tok = reg.enter("host_pull", site)
+    t0 = _time.perf_counter()
     try:
         faults.maybe_inject("host_pull", site)
         nbytes = 0
@@ -4452,6 +4455,12 @@ def _host(arrays, site=None):
         return [None if a is None else np.asarray(a) for a in arrays]
     finally:
         reg.exit(tok)
+        # wall-decomposition feed: each batched pull is one "host_pull" span
+        # (same fast path as dispatch spans — no-op without an active tracer)
+        tr = tracing.current_tracer()
+        if tr is not None:
+            tr.add_completed("host_pull", _time.perf_counter() - t0,
+                             site=site or "")
 
 
 def _host_page(page: Page, site="page"):

@@ -28,7 +28,8 @@ __all__ = ["Span", "Tracer", "NOOP_TRACER", "QueryCounters", "track_counters",
            "InflightRegistry", "InflightEntry", "INFLIGHT", "inflight",
            "track_inflight", "current_inflight", "query_scope",
            "current_query_id", "live_query_counters", "StallWatchdog",
-           "StallKilledError", "DISPATCH_TEST_HOOK"]
+           "StallKilledError", "DISPATCH_TEST_HOOK",
+           "WALL_BUCKETS", "wall_breakdown"]
 
 _log = logging.getLogger("trino_tpu.stall")
 
@@ -1021,3 +1022,127 @@ def spans_to_otlp(spans, service: str = "trino_tpu") -> dict:
         "scopeSpans": [{"scope": {"name": "trino_tpu.execution.tracing"},
                         "spans": out}],
     }]}
+
+
+# -- wall-clock decomposition --------------------------------------------------
+#
+# "Join-query time is tunnel ROUND-TRIPS, not splits or FLOPs" (CLAUDE.md
+# real-TPU capture) — but until round 16 nothing decomposed one query's wall
+# into those causes.  ``wall_breakdown`` attributes the query root span's
+# window to named buckets from the finished span tree: each leaf span maps to
+# a bucket (dispatch -> device_dispatch, host_pull -> host_pull, ...) and a
+# sweep over the elementary time slices charges every covered slice to ONE
+# bucket (foreground work outranks overlapped background staging — the
+# prefetch double buffer h2d-stages WHILE the device executes, and time the
+# device was busy anyway is not h2d cost).  Buckets are therefore DISJOINT
+# and sum (with admission queue, retry backoff and the unattributed
+# remainder) to the reported wall exactly — the property the acceptance
+# criterion pins within 5%.
+
+WALL_BUCKETS = ("plan", "admission_queue", "split_generation", "h2d",
+                "device_dispatch", "host_pull", "exchange_wait",
+                "retry_backoff", "unattributed")
+
+# span name -> bucket.  Container spans (query/execution/task) and
+# unrecognized names stay out of the sweep: their time is the sum of their
+# children plus host-side glue, which lands in "unattributed" honestly.
+_SPAN_BUCKETS = {
+    "planner": "plan",
+    "dispatch": "device_dispatch",
+    "host_pull": "host_pull",
+    "split-generation": "split_generation",
+    "prefetch": "h2d",
+    "h2d": "h2d",
+    "exchange.read": "exchange_wait",
+    "exchange.stream": "exchange_wait",
+    "exchange.write": "exchange_wait",
+}
+
+# slice-attribution priority, highest first: when spans overlap (background
+# prefetch under a foreground dispatch; worker dispatches under an exchange
+# drain), the slice charges to the bucket that represents the FOREGROUND
+# cause of the wall
+_BUCKET_PRIORITY = ("device_dispatch", "host_pull", "exchange_wait",
+                    "split_generation", "plan", "h2d")
+
+
+def wall_breakdown(spans, window=None, queued_s: float = 0.0,
+                   retry_backoff_s: float = 0.0) -> Optional[dict]:
+    """Decompose a query's wall clock into WALL_BUCKETS seconds.
+
+    ``spans``: Span objects or span_dict dicts (the last_query_trace form,
+    worker spans included once stitched).  ``window``: explicit
+    (start_s, end_s) wall window; default = the root "query" span.
+    ``queued_s`` is measured OUTSIDE the window (admission wait precedes the
+    root span) and adds to the reported wall; ``retry_backoff_s`` happens
+    INSIDE it (the dispatch loop's backoff sleeps run under the root span),
+    so it is carved out of the unattributed remainder — never added on top,
+    which would double-count the same seconds.  Returns None when no
+    closed window can be established.  Host-only arithmetic — zero device
+    work (the flight-recorder feed discipline)."""
+    dicts = [s if isinstance(s, dict) else span_dict(s) for s in spans]
+    if window is None:
+        root = next((s for s in dicts
+                     if s.get("parent_id") is None
+                     and s.get("name") == "query"), None)
+        if root is None or root.get("end_s") is None:
+            return None
+        window = (root["start_s"], root["end_s"])
+    lo, hi = window
+    wall = max(float(hi) - float(lo), 0.0)
+    intervals = []
+    for s in dicts:
+        bucket = _SPAN_BUCKETS.get(s.get("name"))
+        if bucket is None or s.get("end_s") is None \
+                or s.get("start_s") is None:
+            continue
+        a = max(float(s["start_s"]), lo)
+        z = min(float(s["end_s"]), hi)
+        if z > a:
+            intervals.append((a, z, bucket))
+    buckets = {b: 0.0 for b in WALL_BUCKETS}
+    rank = {b: i for i, b in enumerate(_BUCKET_PRIORITY)}
+    # single event sweep with per-bucket active counts — O(n log n), not
+    # O(slices x intervals): a SF100 capture query's trace holds thousands
+    # of dispatch/generation/pull spans and this runs at every completion
+    events: list = []
+    for a, z, b in intervals:
+        events.append((a, 1, b))
+        events.append((z, -1, b))
+    events.sort(key=lambda ev: ev[0])
+    active = [0] * len(_BUCKET_PRIORITY)
+    prev = None
+    i, n = 0, len(events)
+    while i < n:
+        t = events[i][0]
+        if prev is not None and t > prev:
+            for j, b in enumerate(_BUCKET_PRIORITY):
+                if active[j]:
+                    buckets[b] += t - prev
+                    break
+        while i < n and events[i][0] == t:
+            active[rank[events[i][2]]] += events[i][1]
+            i += 1
+        prev = t
+    attributed = sum(buckets.values())
+    buckets["admission_queue"] = max(float(queued_s or 0.0), 0.0)
+    remainder = max(wall - attributed, 0.0)
+    # backoff sleeps are part of the window's otherwise-unattributed time:
+    # name them, capped at what the remainder can actually hold
+    buckets["retry_backoff"] = min(max(float(retry_backoff_s or 0.0), 0.0),
+                                   remainder)
+    buckets["unattributed"] = remainder - buckets["retry_backoff"]
+    out = {b: round(v, 6) for b, v in buckets.items()}
+    out["wall_s"] = round(wall + buckets["admission_queue"], 6)
+    return out
+
+
+def format_wall_breakdown(bd: dict) -> str:
+    """One-line render for EXPLAIN ANALYZE / scripts: non-zero buckets in
+    declaration order, milliseconds, total last."""
+    parts = [f"{b} {bd.get(b, 0.0) * 1000:.1f}ms"
+             for b in WALL_BUCKETS if bd.get(b, 0.0) > 0.0005]
+    if not parts:
+        parts = ["unattributed 0.0ms"]
+    return ("Wall breakdown: " + ", ".join(parts)
+            + f" (total {bd.get('wall_s', 0.0) * 1000:.1f}ms)")

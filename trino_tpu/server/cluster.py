@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import hmac
+import itertools
 import json
 import os as _os
 import pickle
@@ -67,6 +68,8 @@ from ..execution.tracing import (InflightRegistry, QueryCounters,
 from ..sql import plan as P
 
 __all__ = ["WorkerServer", "ClusterCoordinator", "build_catalogs"]
+
+_cluster_qids = itertools.count(1)  # coordinator query/trace ids (cluster_N)
 
 
 def build_catalogs(config: dict) -> dict:
@@ -307,6 +310,31 @@ class _TaskState:
     # them at the fragment's full-plan path and folds them into the engine's
     # plan-history store
     plan_stats: Optional[dict] = None
+
+
+def _span_subtree(tracer, trace_id: str, root_span_id: int) -> list:
+    """Finished spans of ``trace_id`` reachable from ``root_span_id``
+    (inclusive), start-ordered.  Scopes a task's shipped spans to its OWN
+    subtree even when sibling tasks of the same query share the worker
+    tracer's trace id (round-16 stitched traces)."""
+    spans = tracer.spans_for(trace_id)
+    children: dict = {}
+    by_id: dict = {}
+    for s in spans:
+        by_id[s.span_id] = s
+        children.setdefault(s.parent_id, []).append(s)
+    out, stack, seen = [], [root_span_id], set()
+    while stack:
+        sid = stack.pop()
+        if sid in seen:
+            continue
+        seen.add(sid)
+        s = by_id.get(sid)
+        if s is not None:
+            out.append(s)
+        stack.extend(c.span_id for c in children.get(sid, ()))
+    out.sort(key=lambda s: s.start_s)
+    return out
 
 
 def _subtree_ids(node) -> list:
@@ -818,6 +846,13 @@ class WorkerServer:
                 # _jit dispatch / _host pull on this worker is attributed and
                 # shippable back to the coordinator
                 counters = QueryCounters()
+                # stitched traces (round 16): the coordinator propagates the
+                # QUERY's trace id in the task request, so this task's span
+                # tree records under it (one trace per query, not one per
+                # task — the pod-as-one-machine view); tasks without a trace
+                # field (old coordinators, direct drivers) keep trace_id=tid
+                trace_req = req.get("trace") or {}
+                qtrace = str(trace_req.get("trace_id") or tid)
                 # track_inflight: this task's dispatches/pulls register on
                 # the WORKER's registry (per-node stall attribution);
                 # query_scope tags the entries with the task id so a stall
@@ -825,8 +860,9 @@ class WorkerServer:
                 with tracing.track_inflight(self.inflight), \
                         tracing.query_scope(tid), \
                         tracing.activate_tracer(self.tracer), \
-                        self.tracer.span("task", trace_id=tid, task=tid,
-                                         kind=kind, node=self.node_id), \
+                        self.tracer.span("task", trace_id=qtrace, task=tid,
+                                         kind=kind, node=self.node_id) \
+                        as task_span, \
                         tracing.track_counters(counters), \
                         self.memory_pool.query_scope(xdir):
                     # chaos chokepoint: the worker task body.  kill_worker
@@ -855,8 +891,13 @@ class WorkerServer:
                 # that just observed the commit must find the stats populated
                 st.plan_stats = self._collect_task_plan_stats(node, ex)
                 st.counters = counters.as_dict()
+                # ship exactly THIS task's span subtree: several tasks of one
+                # query on one worker share the query trace id, so a flat
+                # spans_for(trace) would double-ship sibling tasks' spans on
+                # every harvest
                 st.spans = [tracing.span_dict(s)
-                            for s in self.tracer.spans_for(tid)]
+                            for s in _span_subtree(self.tracer, qtrace,
+                                                   task_span.span_id)]
                 if stream_out:
                     # pipelined output: pages live in the in-memory buffer
                     # behind the long-poll endpoint; nothing touches disk
@@ -1106,6 +1147,19 @@ class ClusterCoordinator:
         # engine.counters_total so /v1/metrics sees the whole cluster
         self.last_query_counters = QueryCounters()
         self.last_query_worker_spans: list = []
+        # stitched distributed trace (round 16): the coordinator opens ONE
+        # root span per query on the ENGINE's tracer, ships its trace id +
+        # root span id inside every task request, and re-parents harvested
+        # worker spans under it at harvest time (worker span ids are remapped
+        # through the engine tracer's id space — two workers' local ids
+        # collide otherwise).  last_query_trace is the engine-shaped payload
+        # (query_id, root_span_s, spans incl. stitched worker spans,
+        # wall_breakdown) GET /v1/query/{id}/trace and the flight record
+        # serve for distributed queries.
+        self.last_query_trace: dict = {}
+        self._trace_qid = None  # set under _query_lock per query
+        self._trace_parent = None  # coordinator root span id (int)
+        self.stitched_spans_total = 0  # observability: worker spans stitched
         self._qc_workers = QueryCounters()
         self._qc_children: list = []  # sibling-stage threads' coordinator-side
         # counters (thread-local recording: each dispatch thread tracks its
@@ -1264,7 +1318,22 @@ class ClusterCoordinator:
                         w.degraded = (w.health == "stalled")
                         w.inflight = int(info.get("inflight", 0) or 0)
                         if info.get("stall_report"):
-                            w.stall_report = info["stall_report"]
+                            rep = info["stall_report"]
+                            # fold NEW worker stall reports into the engine's
+                            # flight recorder (once per report — the worker
+                            # re-ships the same dict every heartbeat while
+                            # stalled), node-attributed: the cluster's
+                            # post-mortems land in one durable ring
+                            prev = w.stall_report or {}
+                            if rep.get("detected_at_s") \
+                                    != prev.get("detected_at_s"):
+                                fr = getattr(self.engine, "flight_recorder",
+                                             None)
+                                if fr is not None:
+                                    fr.record_event(dict(
+                                        rep, kind="stall",
+                                        node_id=w.node_id))
+                            w.stall_report = rep
                 except Exception:
                     with self._lock:
                         w.misses += 1
@@ -1395,13 +1464,39 @@ class ClusterCoordinator:
         Round 14: ``parameters`` (protocol-level EXECUTE) substitute as
         literals here — plan templates are a coordinator/local-engine
         optimization and the cluster task protocol does not ship bindings,
-        so the distributed path runs the substituted text."""
+        so the distributed path runs the substituted text.
+
+        Round 16: ONE trace per distributed query.  The coordinator opens
+        the query root span on the engine's tracer, ships the trace context
+        inside every task request, and harvested worker spans re-parent
+        under the root (``last_query_trace``); completion — clean or errored
+        — lands a flight record in the engine's recorder."""
         if parameters is not None:
             from .dbapi import _substitute
 
             sql = _substitute(sql, list(parameters))
         sess = session or self.engine.create_session(
             next(iter(self.engine.catalogs)))
+        qid = f"cluster_{next(_cluster_qids)}"
+        # clear the engine thread-accounting slot this statement will read at
+        # publish time (a pooled caller thread may hold a previous
+        # statement's snapshot)
+        self.engine._thread_accounting.snap = None
+        t_created = time.time()
+        state, error = "FINISHED", None
+        try:
+            with tracing.query_scope(qid), \
+                    tracing.activate_tracer(self.engine.tracer), \
+                    self.engine.tracer.span("query", trace_id=qid, sql=sql):
+                return self._execute_sql_admitted(sql, sess)
+        except BaseException as e:
+            state, error = "FAILED", f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            self._publish_cluster_trace(qid, sql, sess, state, error,
+                                        t_created)
+
+    def _execute_sql_admitted(self, sql: str, sess):
         plan = self._cached_plan(sql, sess)
         rkey = self.engine._result_cache_key(sql, plan, sess)
         epoch = self.engine.buffer_pool.epoch if rkey is not None else None
@@ -1447,6 +1542,16 @@ class ClusterCoordinator:
 
             self._page_cache = _effective_page_cache(sess)
             local.page_cache = self._page_cache
+            # stitched-trace context (round 16): the root span execute_sql
+            # opened on THIS thread; task dispatch ships it so worker task
+            # spans record under the query's trace id and harvest re-parents
+            # them under this root.  None when a driver calls
+            # _execute_plan_cluster directly (no root span): dispatch then
+            # ships no trace field and worker spans pass through unstitched.
+            self._trace_qid = tracing.current_query_id()
+            _cur = self.engine.tracer.current()
+            self._trace_parent = _cur.span_id \
+                if (_cur is not None and self._trace_qid) else None
             # per-query cluster profile: worker counters merge in as commits
             # are observed; the finally below publishes coordinator + workers
             self._qc_workers = QueryCounters()
@@ -1666,8 +1771,94 @@ class ClusterCoordinator:
             ps = st.get("plan_stats")
             if ps:
                 self._task_plan_stats[tid] = ps
-            for s in st.get("spans") or ():
+            for s in self._stitch_spans(st.get("spans") or ()):
                 self._worker_spans.append(s)
+
+    def _stitch_spans(self, spans) -> list:
+        """Re-key one harvested task's span dicts into the query's stitched
+        trace (round 16): trace id becomes the QUERY's, span ids remap
+        through the ENGINE tracer's id space (two workers' local id
+        sequences collide), and task roots re-parent under the coordinator's
+        root span — the "every worker task span carries the query's trace id
+        and parents under the query root" invariant.  Without trace context
+        (a driver calling _execute_plan_cluster directly) spans pass through
+        untouched.  Caller holds self._lock."""
+        qid, parent = self._trace_qid, self._trace_parent
+        if qid is None or parent is None:
+            return [dict(s) for s in spans]
+        idmap = {s.get("span_id"): self.engine.tracer._new_id()
+                 for s in spans}
+        out = []
+        for s in spans:
+            d = dict(s)
+            d["trace_id"] = qid
+            d["span_id"] = idmap[s.get("span_id")]
+            d["parent_id"] = idmap.get(s.get("parent_id"), parent)
+            out.append(d)
+        self.stitched_spans_total += len(out)
+        return out
+
+    def _publish_cluster_trace(self, qid, sql, sess, state, error,
+                               t_created) -> None:
+        """Assemble the query's ONE stitched trace (coordinator spans +
+        re-parented worker spans), decompose its wall (retry-backoff sleeps
+        come from the dispatch loop's recorded schedule), publish it as
+        ``last_query_trace``, and land the flight record.  Guarded end to
+        end: trace/record assembly failure never fails the query."""
+        try:
+            spans = [tracing.span_dict(s)
+                     for s in self.engine.tracer.spans_for(qid)]
+            with self._lock:
+                wspans = [s for s in self.last_query_worker_spans
+                          if s.get("trace_id") == qid]
+                # retry schedule belongs to the query that DISPATCHED it:
+                # a result-cache hit (or a failure before dispatch) leaves
+                # the previous query's schedule in place — _trace_qid only
+                # matches when _execute_plan_cluster ran for THIS query
+                backoff = sum(d for _t, _a, d in self.last_retry_schedule) \
+                    if self._trace_qid == qid else 0.0
+                counters = self.last_query_counters.snapshot()
+            spans += wspans
+            root = next((s for s in spans if s.get("parent_id") is None
+                         and s.get("name") == "query"), None)
+            bd = tracing.wall_breakdown(spans, retry_backoff_s=backoff)
+            root_s = None
+            if root is not None and root.get("end_s") is not None:
+                root_s = root["end_s"] - root["start_s"]
+            trace = {"query_id": qid, "root_span_s": root_s, "spans": spans}
+            if bd is not None:
+                trace["wall_breakdown"] = bd
+            self.last_query_trace = trace
+            fr = getattr(self.engine, "flight_recorder", None)
+            if fr is None or not fr.enabled:
+                return
+            from ..execution.flightrecorder import pressure_rung
+            from ..sql.params import normalize_sql
+
+            snap = self.engine._thread_accounting.snap
+            cd = (snap.as_dict() if snap is not None
+                  else counters.as_dict())
+            try:
+                norm = normalize_sql(sql)
+            except Exception:
+                norm = sql
+            fr.record_query({
+                "query_id": qid, "state": state, "sql": norm,
+                "user": sess.user, "catalog": sess.catalog,
+                "error": error, "created_s": t_created,
+                "ended_s": time.time(),
+                "wall_s": time.time() - t_created,
+                "queued_s": 0.0,
+                "distributed": True,
+                "counters": cd,
+                "worker_spans": len(wspans),
+                "retry_backoff_s": backoff,
+                "pressure_rung": pressure_rung(cd),
+                "trace": {"root_span_s": root_s, "spans": spans},
+                "wall_breakdown": bd,
+            })
+        except Exception:
+            pass
 
     def _harvest_stream_producers(self) -> None:
         """Streaming producers commit no spool entry, so the dispatch loop
@@ -1851,6 +2042,16 @@ class ClusterCoordinator:
             self._task_seq += 1
             return tid
 
+    def _trace_ctx(self):
+        """The query's trace context as shipped in every /v1/task request
+        (round 16): the trace id worker task spans record under plus the
+        coordinator root span id harvest re-parents them to.  None outside a
+        traced query (direct _execute_plan_cluster drivers)."""
+        if self._trace_qid is None or self._trace_parent is None:
+            return None
+        return {"trace_id": self._trace_qid,
+                "parent_span_id": self._trace_parent}
+
     def _run_split_tasks(self, frag, spine, exchange_dir, kind,
                          fanout=None, spooled=None):
         """Fan a fragment out across workers by split batches (reference:
@@ -1999,6 +2200,7 @@ class ClusterCoordinator:
         req = {"task_id": tid, "fragment_id": frag_id, "kind": "fragment",
                "attempt": 0, "exchange_dir": exchange_dir,
                "output": "stream", "n_readers": n_readers,
+               "trace": self._trace_ctx(),
                "dispatch_batch": getattr(self, "_dispatch_batch", None),
                "page_cache": getattr(self, "_page_cache", None)}
         if sources:
@@ -2181,6 +2383,7 @@ class ClusterCoordinator:
                                         "kind": kind,
                                         "attempt": attempts[tid],
                                         "exchange_dir": exchange_dir,
+                                        "trace": self._trace_ctx(),
                                         "dispatch_batch":
                                             getattr(self, "_dispatch_batch",
                                                     None),
@@ -2265,6 +2468,7 @@ class ClusterCoordinator:
                                     {"task_id": tid, "fragment_id": frag_id,
                                      "kind": kind,
                                      "attempt": attempts[tid] + 100,
+                                     "trace": self._trace_ctx(),
                                      "exchange_dir": exchange_dir, **extra})
                                 _http(f"{o.url}/v1/task", req,
                                       secret=self.secret)

@@ -441,6 +441,22 @@ class CoordinatorServer:
                     self._send(200, ph.as_dict() if ph is not None
                                else {"plans": []})
                     return
+                if parts == ["v1", "flight"]:
+                    # round 16: the flight recorder — recorder state + a
+                    # summary line per retained record (the JSON twin of
+                    # system.runtime.query_log)
+                    self._send(200, server._flight_index())
+                    return
+                if len(parts) == 3 and parts[:2] == ["v1", "flight"]:
+                    # /v1/flight/{id} — one statement's full flight record
+                    # (counters, stitched span tree, wall breakdown,
+                    # plan-actuals) long after the statement finished
+                    rec = server._flight_record(parts[2])
+                    if rec is None:
+                        self._send(404, {"error": "unknown query"})
+                        return
+                    self._send(200, rec)
+                    return
                 # /v1/spooled/{qid}/{seg} — spooled result segment payload
                 # (reference: the client fetching spooled segments by URI,
                 # client/trino-client/.../OkHttpSegmentLoader.java)
@@ -784,6 +800,40 @@ class CoordinatorServer:
             "# TYPE trino_tpu_stalled_dispatches gauge",
             f"trino_tpu_stalled_dispatches {stalled}",
         ]
+        # round 16: flight recorder — the durable per-statement record ring.
+        # records/bytes are gauges (rings evict); the lifetime totals,
+        # stitched-span counts and guarded-store failures are counters.
+        fr = getattr(self.engine, "flight_recorder", None)
+        if fr is not None:
+            fi = fr.info()
+            lines += [
+                "# HELP trino_tpu_flight_records Statement/event records "
+                "resident in the flight recorder's in-memory ring.",
+                "# TYPE trino_tpu_flight_records gauge",
+                f"trino_tpu_flight_records {fi['records']}",
+                "# HELP trino_tpu_flight_disk_bytes Bytes resident in the "
+                "flight recorder's on-disk JSONL ring (0 = disk ring off).",
+                "# TYPE trino_tpu_flight_disk_bytes gauge",
+                f"trino_tpu_flight_disk_bytes {fi['disk_bytes']}",
+                "# HELP trino_tpu_flight_records_total Flight records "
+                "appended over this process's lifetime.",
+                "# TYPE trino_tpu_flight_records_total counter",
+                f"trino_tpu_flight_records_total {fi['records_total']}",
+                "# HELP trino_tpu_flight_spans_total Trace spans recorded "
+                "into flight records (stitched worker spans included).",
+                "# TYPE trino_tpu_flight_spans_total counter",
+                f"trino_tpu_flight_spans_total {fi['spans_total']}",
+                "# HELP trino_tpu_flight_worker_spans_total Harvested worker "
+                "spans stitched into coordinator query traces.",
+                "# TYPE trino_tpu_flight_worker_spans_total counter",
+                f"trino_tpu_flight_worker_spans_total "
+                f"{fi['worker_spans_total']}",
+                "# HELP trino_tpu_flight_record_failures_total Flight "
+                "records dropped by the recorder's guard (a failure never "
+                "fails the query it records).",
+                "# TYPE trino_tpu_flight_record_failures_total counter",
+                f"trino_tpu_flight_record_failures_total {fi['failures']}",
+            ]
         # device buffer pool (round 9): cache effectiveness is a first-class
         # scrape — entries/bytes are gauges (they shrink on eviction and
         # DDL), hit/miss counts are lifetime counters of this node's pool
@@ -1174,10 +1224,35 @@ class CoordinatorServer:
         with open(path, "rb") as f:
             return f.read()
 
+    def _flight_index(self) -> dict:
+        """GET /v1/flight payload: recorder info + one summary per retained
+        record (full records via /v1/flight/{id})."""
+        fr = getattr(self.engine, "flight_recorder", None)
+        if fr is None:
+            return {"info": {"enabled": False}, "records": []}
+        out = []
+        for rec in fr.snapshot():
+            out.append({
+                "kind": rec.get("kind"), "query_id": rec.get("query_id"),
+                "state": rec.get("state"), "wall_s": rec.get("wall_s"),
+                "error": (rec.get("error") or "")[:200] or None,
+                "recorded_at": rec.get("recorded_at"),
+                "spans": len((rec.get("trace") or {}).get("spans") or ()),
+                "sql": (rec.get("sql") or "")[:200] or None})
+        return {"info": fr.info(), "records": out}
+
+    def _flight_record(self, qid: str):
+        fr = getattr(self.engine, "flight_recorder", None)
+        return fr.get(qid) if fr is not None else None
+
     def _query_trace(self, qid: str):
-        """OTLP/JSON trace for a server query id (captured trace), or for an
-        ENGINE query id (query_N: live lookup against the engine tracer —
-        useful when driving the engine embedded)."""
+        """OTLP/JSON trace for a server query id (captured trace), an ENGINE
+        or CLUSTER query id served from the FLIGHT RECORDER (round-16
+        satellite: a completed statement's trace resolves long after the
+        next statement landed — and a distributed query's record carries the
+        stitched worker spans the live tracer never sees), or, last, a live
+        lookup against the engine tracer (running statements, recorder
+        disabled)."""
         from ..execution.tracing import spans_to_otlp
 
         q = self.queries.get(qid)
@@ -1185,6 +1260,12 @@ class CoordinatorServer:
             if not q.trace:
                 return None
             return spans_to_otlp(q.trace.get("spans", ()))
+        fr = getattr(self.engine, "flight_recorder", None)
+        if fr is not None:
+            rec = fr.get(qid)
+            spans = (rec.get("trace") or {}).get("spans") if rec else None
+            if spans:
+                return spans_to_otlp(spans)
         tracer = getattr(self.engine, "tracer", None)
         if tracer is not None:
             spans = tracer.spans_for(qid)

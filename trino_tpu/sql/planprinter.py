@@ -14,7 +14,7 @@ __all__ = ["format_plan"]
 
 def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
                 boundary: dict = None, ests: dict = None,
-                paths: dict = None) -> str:
+                paths: dict = None, breakdown: dict = None) -> str:
     """``stats``: optional id(node) -> {rows, wall_s} from an EXPLAIN ANALYZE run
     (reference: PlanPrinter's textDistributedPlan with OperatorStats).
     ``counters``: optional per-query device-boundary counters
@@ -29,12 +29,20 @@ def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
     nodes with both an estimate and actuals get an
     ``[est N x actual M -> K.Kx over/under]`` annotation and the worst
     offenders roll up into a "Misestimates:" summary line; ``paths`` names
-    them by structural node path."""
+    them by structural node path.  ``breakdown``: optional wall-clock
+    decomposition (execution/tracing.wall_breakdown over the analyze run's
+    window) rendered as one "Wall breakdown:" line — where the time went
+    (plan / split generation / h2d / device dispatch / host pull / exchange
+    wait / unattributed), not just how much there was."""
     lines: list = []
     _fmt(node, lines, 0, stats or {}, boundary or {}, ests or {})
     mis = _misestimate_summary(stats or {}, ests or {}, paths or {})
     if mis:
         lines.append(mis)
+    if breakdown:
+        from ..execution.tracing import format_wall_breakdown
+
+        lines.append(format_wall_breakdown(breakdown))
     if counters is not None:
         boundary_line = (
             f"Device boundary: {counters.device_dispatches} dispatches, "
