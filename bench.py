@@ -264,6 +264,12 @@ BUDGET_COUNTERS = ("device_dispatches", "host_transfers", "host_bytes_pulled")
 # leaked into an execute-path measurement (see the RESULT-CACHE pin in main)
 CACHE_COUNTERS = ("page_cache_hits", "page_cache_misses",
                   "result_cache_hits", "result_cache_misses")
+# round 17: compile census, diffed for VISIBILITY, never flagged — cold
+# compile counts/seconds move with XLA versions and cache state, but a WARM
+# compile appearing at all is the recompile-regression signature the budget
+# suite pins (warm compiles == 0), so the diff shows it without verdicting
+COMPILE_COUNTERS = ("compiles", "compile_s",
+                    "cold_compiles", "cold_compile_s")
 
 
 def _baseline_diff(base_pq: dict, now_pq: dict) -> dict:
@@ -295,7 +301,7 @@ def _baseline_diff(base_pq: dict, now_pq: dict) -> dict:
             d[k] = {"base": bv, "now": nv}
             if nv > bv:
                 flags.append(f"{k} {bv} -> {nv}")
-        for k in CACHE_COUNTERS:
+        for k in CACHE_COUNTERS + COMPILE_COUNTERS:
             bv, nv = b.get(k), n.get(k)
             if bv is None and nv is None:
                 continue
@@ -429,6 +435,16 @@ def main(argv=None):
                 t0 = time.perf_counter()
                 engine.execute_sql(sql, session)  # prewarm = the cold compile run
                 cold_s = time.perf_counter() - t0
+                # cold-run compile census (round 17): how many XLA
+                # compilations the cold run paid and what they cost — the
+                # cold-vs-warm split per_query carries (warm compiles ride
+                # the counters snapshot below and must be ZERO)
+                try:
+                    cc = engine.last_query_counters
+                    cold_compiles = cc.compiles
+                    cold_compile_s = round(cc.compile_s, 4)
+                except Exception:
+                    cold_compiles = cold_compile_s = None
                 # timed engine runs: as many of RUNS as the budget allows, min 1
                 times = []
                 for i in range(RUNS):
@@ -448,6 +464,10 @@ def main(argv=None):
                 try:
                     qc = engine.last_query_counters
                     query_counters[name] = qc.as_dict()
+                    # the cold/warm compile split: as_dict already carries
+                    # the WARM run's compiles/compile_s (expected 0/0.0)
+                    query_counters[name]["cold_compiles"] = cold_compiles
+                    query_counters[name]["cold_compile_s"] = cold_compile_s
                     tr = engine.last_query_trace or {}
                     query_counters[name]["trace"] = {
                         "spans": len(tr.get("spans", ())),

@@ -12,9 +12,11 @@ hours later (the gap scripts/tpu_watch.sh has papered over with hand-rolled
     python scripts/flight.py DIR --id query_7    # one record, full JSON
     python scripts/flight.py DIR --json          # every record, JSON lines
     python scripts/flight.py DIR --stalls        # stall events only
+    python scripts/flight.py DIR --compiles      # per-statement compile events
 
-Summary columns: query id, state, wall, dispatch/byte counters, and the top
-wall-breakdown bucket — "where did the time go" per statement, from disk.
+Summary columns: query id, state, wall, dispatch/byte counters, the compile
+census (count + seconds — round 17), and the top wall-breakdown bucket —
+"where did the time go" per statement, from disk.
 """
 
 import argparse
@@ -36,13 +38,13 @@ def _load_reader():
     spec = importlib.util.spec_from_file_location("_flightrecorder", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    return mod.read_flight_dir
+    return mod.read_flight_dir, mod.summarize_compiles
 
 
-read_flight_dir = _load_reader()
+read_flight_dir, summarize_compiles = _load_reader()
 
-WALL_BUCKETS = ("plan", "admission_queue", "split_generation", "h2d",
-                "device_dispatch", "host_pull", "exchange_wait",
+WALL_BUCKETS = ("plan", "compile", "admission_queue", "split_generation",
+                "h2d", "device_dispatch", "host_pull", "exchange_wait",
                 "retry_backoff", "unattributed")
 
 
@@ -62,17 +64,45 @@ def _summary_line(rec) -> str:
     if rec.get("kind") == "stall":
         stuck = ", ".join(e.get("label", "?")
                           for e in rec.get("stalled") or [])[:60]
-        return (f"{'<stall>':<14} {'-':<9} {'-':>9} {'-':>6} {'-':>10}  "
-                f"stuck: {stuck}")
+        return (f"{'<stall>':<14} {'-':<9} {'-':>9} {'-':>6} {'-':>10} "
+                f"{'-':>12}  stuck: {stuck}")
     c = rec.get("counters") or {}
     wall = rec.get("wall_s")
+    nc, cs = summarize_compiles(rec)
+    comp = f"{nc}/{cs:.2f}s" if nc else "-"
     return (f"{rec.get('query_id') or '?':<14} "
             f"{rec.get('state') or '?':<9} "
             f"{('%.3fs' % wall) if wall is not None else '-':>9} "
             f"{c.get('device_dispatches') or 0:>6} "
-            f"{c.get('host_bytes_pulled') or 0:>10}  "
+            f"{c.get('host_bytes_pulled') or 0:>10} "
+            f"{comp:>12}  "
             f"{_top_bucket(rec.get('wall_breakdown'))}"
             + (f"  ERROR: {rec['error'][:60]}" if rec.get("error") else ""))
+
+
+def _print_compiles(recs) -> None:
+    """--compiles detail: every statement record's compile events (site, op
+    label, signature, duration) from the census the engine embedded.  The
+    count is the CLUSTER truth (merged worker counters); the event lines
+    are coordinator-local — a distributed statement legitimately shows
+    fewer events than compilations (worker-side compiles live in the
+    workers' own census rings)."""
+    for rec in recs:
+        if rec.get("kind") != "query":
+            continue
+        nc, cs = summarize_compiles(rec)
+        events = rec.get("compile_events") or []
+        if not nc and not events:
+            continue
+        note = "" if len(events) >= nc else \
+            f" ({len(events)} local events; rest worker-side)"
+        print(f"{rec.get('query_id') or '?'}: {nc} compilations, "
+              f"{cs:.3f}s{note}")
+        for ev in events:
+            exe = f", exe {ev['exe_bytes']}B" if ev.get("exe_bytes") else ""
+            print(f"  {ev.get('label') or ev.get('site'):<44} "
+                  f"{(ev.get('duration_s') or 0.0) * 1000:>9.1f} ms{exe}  "
+                  f"sig: {(ev.get('signature') or '')[:70]}")
 
 
 def main(argv=None):
@@ -84,6 +114,9 @@ def main(argv=None):
                     help="dump every record as JSON lines")
     ap.add_argument("--stalls", action="store_true",
                     help="stall events only")
+    ap.add_argument("--compiles", action="store_true",
+                    help="per-statement compile events (site, signature, "
+                         "duration) from the embedded census")
     args = ap.parse_args(argv)
     recs = read_flight_dir(args.dir)
     if not recs:
@@ -96,6 +129,9 @@ def main(argv=None):
             return 1
         print(json.dumps(hits[-1], indent=1))
         return 0
+    if args.compiles:
+        _print_compiles(recs)
+        return 0
     if args.stalls:
         recs = [r for r in recs if r.get("kind") == "stall"]
     if args.json:
@@ -103,7 +139,7 @@ def main(argv=None):
             print(json.dumps(r))
         return 0
     print(f"{'query':<14} {'state':<9} {'wall':>9} {'disp':>6} "
-          f"{'bytes':>10}  top bucket")
+          f"{'bytes':>10} {'compiles':>12}  top bucket")
     for r in recs:
         print(_summary_line(r))
     return 0

@@ -84,6 +84,15 @@ def main():
                          "re-derivation contract as --sites/--history: the "
                          "breakdown is computed from spans the run already "
                          "emitted, zero extra dispatches/pulls")
+    ap.add_argument("--compiles", action="store_true",
+                    help="print each query's compile census (cold-vs-warm "
+                         "compile counts/seconds plus the per-site compile "
+                         "table from the attribution) — the re-derivation "
+                         "contract matches --sites/--breakdown: detection "
+                         "is a host-side set lookup, zero extra dispatches/"
+                         "pulls, and the WARM row must show 0 compiles "
+                         "(the recompile-regression guard "
+                         "tests/test_query_budgets.py pins)")
     ap.add_argument("--history", action="store_true",
                     help="print each warm query's est-vs-actual table from "
                          "the plan-actuals history (node path -> CBO "
@@ -127,6 +136,18 @@ def main():
                     s = sites[key]
                     print(f"#   {key:<44} {s['dispatches']:>4} "
                           f"{s['transfers']:>4} {s['bytes']:>8}", flush=True)
+            if args.compiles:
+                n = out[phase].get("compiles", 0)
+                cs = out[phase].get("compile_s", 0.0)
+                print(f"# {name} {phase} compiles: {n} "
+                      f"({cs * 1000:.1f} ms)", flush=True)
+                comp = {k: v for k, v in sites.items() if v.get("compiles")}
+                for key in sorted(comp, key=lambda k: (
+                        -comp[k].get("compile_s", 0.0), k)):
+                    s = comp[key]
+                    print(f"#   {key:<44} {s.get('compiles', 0):>4} "
+                          f"{s.get('compile_s', 0.0) * 1000:>9.1f} ms",
+                          flush=True)
             if args.breakdown and phase == "warm":
                 from trino_tpu.execution.tracing import WALL_BUCKETS
                 bd = (engine.last_query_trace or {}).get("wall_breakdown") \

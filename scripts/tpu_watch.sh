@@ -23,14 +23,19 @@ fi
 echo "$(date -Is) watcher start (r09)" >> "$LOG"
 
 # Round 8: stall post-mortems.  Every bench run arms the engine's stall
-# watchdog (TRINO_TPU_STALL_S; 240s — cold Q1 compile alone is ~110s on the
-# tunnel, the threshold must clear any legit compile) and serves
-# GET /v1/status (BENCH_STATUS_PORT).  status_tail polls it in the
-# background and archives any "stalled" verdict — a wedge mid-capture
-# leaves scripts/stall_reports.jsonl (stuck site + thread stack) next to
-# the diag output instead of only an rc=124 null.
+# watchdog and serves GET /v1/status (BENCH_STATUS_PORT).  status_tail
+# polls it in the background and archives any "stalled" verdict — a wedge
+# mid-capture leaves scripts/stall_reports.jsonl (stuck site + thread
+# stack) next to the diag output instead of only an rc=124 null.
+# Round 17: the watchdog is COMPILE-AWARE — a first-seen-signature dispatch
+# is judged against TRINO_TPU_STALL_COMPILE_S and verdicts "compiling", so
+# STALL_S finally sits at tight WEDGE scale (30s; a tunnel round-trip is
+# milliseconds) instead of the old 240s that had to clear the ~110s cold
+# Q1 compile.  COMPILE_S=600 clears any legit on-device compile; past it a
+# "compile" really is a wedge and reports stalled.
 STATUS_PORT=18923
-export TRINO_TPU_STALL_S="${TRINO_TPU_STALL_S:-240}"
+export TRINO_TPU_STALL_S="${TRINO_TPU_STALL_S:-30}"
+export TRINO_TPU_STALL_COMPILE_S="${TRINO_TPU_STALL_COMPILE_S:-600}"
 export BENCH_STATUS_PORT=$STATUS_PORT
 # Round 16: every capture run's FLIGHT RECORDER mirrors to disk — one JSONL
 # record per statement (counters, span tree, wall breakdown) plus stall
@@ -202,7 +207,26 @@ try:
                           "wall_breakdown": r.get("wall_breakdown")}
                          for r in recs if r.get("kind") == "query"][-40:]}
 except Exception as e:
+    recs = []
     out["flight"] = {"error": str(e)}
+# round 17: the ON-DEVICE compile census — per-statement compile
+# counts/seconds plus every retained compile event (site, signature,
+# XLA duration).  This is exactly the datum the capture matrix lacked:
+# what cold compilation actually costs on the tunnel, per operator.
+# Its OWN try: a torn/legacy record must not clobber the flight summary
+# above (and vice versa) — the two artifacts stay independent.
+try:
+    qrecs = [r for r in recs if r.get("kind") == "query"]
+    out["compile_census"] = {
+        "statements_with_compiles": sum(
+            1 for r in qrecs if (r.get("compiles") or 0) > 0),
+        "compiles_total": sum(r.get("compiles") or 0 for r in qrecs),
+        "compile_s_total": round(
+            sum(float(r.get("compile_s") or 0.0) for r in qrecs), 3),
+        "events": [e for r in qrecs
+                   for e in (r.get("compile_events") or [])][-200:]}
+except Exception as e:
+    out["compile_census"] = {"error": str(e)}
 json.dump(out, open("BENCH_local_r09.json", "w"), indent=1)
 PY
     echo "$(date -Is) wrote BENCH_local_r09.json (flight ring: scripts/flight_r16)" >> "$LOG"

@@ -252,6 +252,54 @@ def test_stats_register_via_node_stats(path):
           "sees the node, or annotate '# stats-ok: <reason>'")
 
 
+PKG_DIR = EXEC_DIR.parent
+COMPILE_MARKER = "# compile-ok"
+
+
+def _pkg_files_outside_exec():
+    """Every trino_tpu module OUTSIDE exec/ (exec/ has the stricter rule:
+    jax.jit is banned there outright — only _jit may build one)."""
+    files = sorted(p for p in PKG_DIR.rglob("*.py")
+                   if EXEC_DIR not in p.parents
+                   and "__pycache__" not in p.parts)
+    assert files, PKG_DIR
+    return files
+
+
+def _untracked_jit_refs(path):
+    """jax.jit attribute references outside exec/ missing a
+    ``# compile-ok: <reason>`` annotation — each is an XLA compilation the
+    round-17 compile observatory cannot see (no seen-signature detection,
+    no compile span, no census record, no compile-aware stall verdict)."""
+    src = path.read_text()
+    lines = src.splitlines()
+    hits = []
+    for node in ast.walk(ast.parse(src)):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "jax" and node.attr in ("jit", "pjit"):
+            if COMPILE_MARKER not in lines[node.lineno - 1]:
+                hits.append(node.lineno)
+    return hits
+
+
+@pytest.mark.parametrize("path", _pkg_files_outside_exec(),
+                         ids=lambda p: str(p.relative_to(PKG_DIR)))
+def test_jit_outside_exec_is_annotated(path):
+    """Round-17 rule: a ``jax.jit`` reference outside exec/ is an XLA
+    compile the observatory at the ``_jit`` chokepoint never sees — the new
+    loose np.asarray.  Route it through the tracked wrapper, or annotate
+    ``# compile-ok: <reason>`` stating why it is exempt (module-level
+    kernels dispatched inside exec's _jit steps, host-side generation)."""
+    hits = _untracked_jit_refs(path)
+    assert not hits, (
+        f"{path.relative_to(PKG_DIR)}: untracked jax.jit reference at "
+        f"line(s) {', '.join(map(str, hits))} — route through "
+        "exec.local_executor._jit so the compile is observed (counted, "
+        "span'd, census'd, compile-aware-stall-judged), or annotate "
+        "'# compile-ok: <reason>'")
+
+
 def _pallas_call_hits(path):
     """pallas_call(...) invocations missing an ``interpret=`` keyword —
     both attribute form (pl.pallas_call) and a direct-imported name."""
@@ -339,6 +387,20 @@ def test_lint_catches_violations(tmp_path):
     assert [(ln, callee) for ln, _, callee in s.site_hits] == \
         [(21, "_host"), (24, "_jit")]
     assert [ln for ln, _ in s.stats_hits] == [30]
+    # the round-17 outside-exec rule flags un-annotated jax.jit refs and
+    # accepts the compile-ok marker
+    jitmod = tmp_path / "jitmod.py"
+    jitmod.write_text(
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=(0,))\n"       # line 3: flagged
+        "def f(n, x):\n"
+        "    return x\n"
+        "@partial(jax.jit, static_argnums=(0,))  # compile-ok: test\n"
+        "def g(n, x):\n"
+        "    return x\n"
+        "h = jax.jit(lambda x: x)\n")                    # line 9: flagged
+    assert _untracked_jit_refs(jitmod) == [3, 9]
     kern = tmp_path / "kern.py"
     kern.write_text(
         "from jax.experimental import pallas as pl\n"

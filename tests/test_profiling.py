@@ -459,9 +459,14 @@ def test_slow_dispatch_stall_report_and_status_flip(profiling_server, engine):
                 .read().decode()))
 
     try:
+        s = engine.create_session("tpch")
+        # prewarm BEFORE arming the hook: the slowed dispatches must be
+        # warm (seen signatures) — a first-seen dispatch is flagged
+        # `compiling` and the round-17 compile-aware watchdog would verdict
+        # "compiling" instead of producing the stall report this test pins
+        engine.execute_sql(QUERY, s)
         wd.start()
         tracing.DISPATCH_TEST_HOOK = hook
-        s = engine.create_session("tpch")
         engine.execute_sql(QUERY, s)
     finally:
         tracing.DISPATCH_TEST_HOOK = None

@@ -35,6 +35,17 @@ ceilings by KBs.  Counters are NOT env-dependent beyond the fixture's own
 page-cache budget: split geometry is pinned by sf/split_rows and page shapes
 are pow2-quantized.
 
+Round 17: the budgets additionally pin warm ``compiles == 0`` (the compile
+observatory at the _jit chokepoint — detection is a host-side seen-signature
+set lookup, so the dispatch/byte ceilings are UNCHANGED with it enabled).  A
+warm compile is the recompile-regression signature: shape churn that used to
+ship silently as inflated warm walls now fails this suite by name.  The
+observatory's first catch was THIS SUITE's own 2-run structure: with the
+page cache on, run 2's whole-scan served page is a new shape class that
+recompiles the streams (q1 ~2s, q9 ~4.5s, measured 2026-08-04) — the
+budgeted "warm" run is now the THIRD execution, the first that is genuinely
+compile-free.  Re-derive with ``scripts/query_counters.py --compiles``.
+
 Re-derive after an intentional executor change (cache-on and off):
     JAX_PLATFORMS=cpu python scripts/query_counters.py --page-cache 6442450944
     JAX_PLATFORMS=cpu python scripts/query_counters.py --page-cache 0
@@ -150,12 +161,34 @@ def _sites_table(c) -> str:
 def test_warm_query_stays_within_budget(sf1, name):
     engine, session = sf1
     engine.execute_sql(QUERIES[name], session)  # cold: plan + XLA compile
+    cold = engine.last_query_counters
+    # round 17: the cold run is where the compiles live — the observatory
+    # must actually see them (a detection regression would silently pass
+    # the warm zero below)
+    assert cold.compiles > 0, cold.as_dict()
+    # second run: the first CACHE-HIT execution.  The observatory exposed a
+    # fact the 2-run structure had hidden: run 1 (cache miss) compiles the
+    # per-split page shapes, and run 2's whole-scan served page is a NEW
+    # shape class that compiles AGAIN (~2s q1 / ~4.5s q9 on this box,
+    # previously invisible inside "warm" wall).  The budgeted run below is
+    # therefore the THIRD execution — the first with zero compiles — and
+    # its dispatch/byte path is identical to run 2's (same cache-hit plan).
+    engine.execute_sql(QUERIES[name], session)
     engine.execute_sql(QUERIES[name], session)  # warm: the budgeted run
     c = engine.last_query_counters
     max_disp, max_bytes = BUDGETS[name]
     # the counters must actually be live (an accounting regression that stops
     # recording would otherwise pass every ceiling)
     assert c.device_dispatches > 0 and c.host_transfers > 0, c
+    # round 17: WARM queries compile NOTHING — every dispatch re-uses a
+    # seen signature.  A nonzero count here is the recompile-regression
+    # signature (shape churn from non-uniform splits, un-quantized size
+    # buckets, a cache that stopped keying) that previously shipped
+    # silently inside inflated warm walls.
+    assert c.compiles == 0, (
+        f"{name}: {c.compiles} warm compiles ({c.compile_s:.3f}s) — a "
+        f"recompile crept into the warm path; per-site attribution:\n"
+        f"{_sites_table(c)}")
     assert c.device_dispatches <= max_disp, (
         f"{name}: {c.device_dispatches} warm device dispatches > budget "
         f"{max_disp} — a per-page/per-split dispatch crept into the warm "

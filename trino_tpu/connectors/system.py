@@ -67,12 +67,24 @@ SCHEMAS = {
         Field("wall_s", DOUBLE), Field("queued_s", DOUBLE),
         Field("device_dispatches", BIGINT), Field("host_transfers", BIGINT),
         Field("host_bytes_pulled", BIGINT),
+        Field("compiles", BIGINT),
         Field("faults_injected", BIGINT), Field("task_retries", BIGINT),
         Field("pressure_rung", _V), Field("spans", BIGINT),
-        Field("plan_s", DOUBLE), Field("split_generation_s", DOUBLE),
+        Field("plan_s", DOUBLE), Field("compile_s", DOUBLE),
+        Field("split_generation_s", DOUBLE),
         Field("h2d_s", DOUBLE), Field("device_dispatch_s", DOUBLE),
         Field("host_pull_s", DOUBLE), Field("exchange_wait_s", DOUBLE),
         Field("retry_backoff_s", DOUBLE), Field("unattributed_s", DOUBLE),
+    )),
+    # round 17: the compile observatory (execution/tracing.CompileLog) as
+    # SQL — one row per retained XLA compilation: the operator site that
+    # triggered it, the query that paid it, the abstract arg signature, the
+    # XLA-reported duration, and the executable size when the opt-in
+    # memstats capture ran (NULL otherwise, never a fabricated zero).
+    "compilations": Schema((
+        Field("site", _V), Field("label", _V), Field("query_id", _V),
+        Field("signature", _V), Field("duration_s", DOUBLE),
+        Field("exe_bytes", BIGINT), Field("recorded_at", DOUBLE),
     )),
     # round 15: the plan-actuals history (execution/history.PlanHistoryStore)
     # as SQL — one row per (plan fingerprint, structural node path), merged
@@ -232,15 +244,25 @@ class SystemConnector:
                     rec.get("wall_s"), rec.get("queued_s"),
                     c.get("device_dispatches"), c.get("host_transfers"),
                     c.get("host_bytes_pulled"),
+                    c.get("compiles"),
                     c.get("faults_injected"), c.get("task_retries"),
                     rec.get("pressure_rung"),
                     len((rec.get("trace") or {}).get("spans") or ()),
-                    bd.get("plan"), bd.get("split_generation"),
+                    bd.get("plan"), bd.get("compile"),
+                    bd.get("split_generation"),
                     bd.get("h2d"), bd.get("device_dispatch"),
                     bd.get("host_pull"), bd.get("exchange_wait"),
                     bd.get("retry_backoff"), bd.get("unattributed"),
                 ))
             return out
+        if table == "compilations":
+            cl = getattr(e, "compile_log", None)
+            if cl is None:
+                return []
+            return [(r.get("site"), r.get("label"), r.get("query_id"),
+                     r.get("signature"), r.get("duration_s"),
+                     r.get("exe_bytes"), r.get("at"))
+                    for r in cl.snapshot()]
         if table == "plan_history":
             ph = getattr(e, "plan_history", None)
             if ph is None:

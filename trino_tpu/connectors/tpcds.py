@@ -1324,7 +1324,7 @@ class TpcdsConnector:
         return cols, valid
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+@partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))  # compile-ok: host-side table generation; dispatched from connector code outside the executor's _jit paths, one compile per (table, split shape)
 def _jit_generate(table: str, sf: float, lo: int, length: int, names: tuple,
                   n: int = 0):
     all_cols = GENERATORS[table](sf, lo, length)

@@ -647,6 +647,6 @@ def _generate_cols(table, sf, lo, length, n, names):
     return tuple(cols[c] for c in names), valid
 
 
-@partial(jax.jit, static_argnums=(0, 1, 3, 4, 5))
+@partial(jax.jit, static_argnums=(0, 1, 3, 4, 5))  # compile-ok: host-side table generation; dispatched from connector code outside the executor's _jit paths, one compile per (table, split shape)
 def _jit_generate(table: str, sf: float, lo: int, length: int, n: int, names: tuple):
     return _generate_cols(table, sf, lo, length, n, names)

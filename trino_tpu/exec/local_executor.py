@@ -44,6 +44,33 @@ from ..sql.ir import Call, Constant, Expr, FieldRef, evaluate, evaluate_predicat
 __all__ = ["LocalExecutor", "MaterializedResult"]
 
 
+_WRAPPER_SEQ = [0]  # monotonic _jit-wrapper ids (storm-detection identity)
+_WRAPPER_SEQ_LOCK = threading.Lock()
+
+
+def _compile_memstats_enabled() -> bool:
+    """Opt-in executable-size capture (TRINO_TPU_COMPILE_MEMSTATS=1): the
+    AOT ``lower().compile().memory_analysis()`` path is NOT served by the
+    jit cache, so reading the executable size pays a SECOND trace+compile
+    per first-seen signature — off by default, worth it only on device
+    captures where executable HBM footprint is the question."""
+    import os
+
+    return os.environ.get("TRINO_TPU_COMPILE_MEMSTATS", "") == "1"
+
+
+def _executable_bytes(compiled, args, kw):
+    """Generated-code size of the executable for this call signature via the
+    AOT memory_analysis(), or None when unavailable (CPU reports 0 — treated
+    as unavailable; any failure is swallowed: the census never fails a
+    dispatch)."""
+    try:
+        ma = compiled.lower(*args, **kw).compile().memory_analysis()
+        return int(getattr(ma, "generated_code_size_in_bytes", 0) or 0) or None
+    except Exception:
+        return None
+
+
 def _jit(fn, site=None, **kwargs):
     """``jax.jit`` + per-query dispatch accounting: every invocation of the
     compiled function records one device dispatch on the active query's
@@ -55,20 +82,63 @@ def _jit(fn, site=None, **kwargs):
     tests/test_boundary_lint.py); each invocation's wall time also feeds the
     per-query + engine-total dispatch-latency histograms.  ``__wrapped__``
     stays the original python function (callers use it to run the step eagerly
-    for untraceable object columns)."""
+    for untraceable object columns).
+
+    Round 17 — the compile observatory lives HERE, so the boundary lint that
+    forces all executor code through ``_jit`` guarantees compile coverage the
+    same way it guarantees counters/in-flight/faults coverage.  Each wrapper
+    keeps a seen-signature set of the ABSTRACT arg signatures it has
+    dispatched (tracing.arg_signature — a host-side pytree walk, zero
+    dispatches/pulls, so the warm budget ceilings are untouched).  A
+    first-seen signature is a compile: the in-flight entry is flagged
+    ``compiling`` (the stall watchdog judges it against
+    TRINO_TPU_STALL_COMPILE_S and verdicts "compiling", not "stalled"), the
+    jax.monitoring compile events captured on this thread supply the
+    authoritative XLA duration (fallback: the dispatch wall), and the event
+    records to the query counters, a "compile" span, and the process-global
+    CompileLog census."""
     import time as _time
 
     compiled = jax.jit(fn, **kwargs)
     label = site or getattr(fn, "__name__", "jit")
+    # two signature sets, both under `lock` (an unsynchronized check-then-
+    # act would double-record when concurrent queries race a shared
+    # MODULE-LEVEL wrapper's first dispatch):
+    #   claimed — signatures some in-flight dispatch owns RECORDING for
+    #             (claimed at entry, released on failure so the retry
+    #             re-claims and records THE compile);
+    #   done    — signatures that completed at least once.  The in-flight
+    #             `compiling` flag reads done, not claimed: a second
+    #             concurrent dispatch of a first-seen signature BLOCKS on
+    #             jax's compile just like the claimant, and must also read
+    #             as "compiling" to the watchdog, it just must not record
+    #             a second census event.
+    claimed: set = set()
+    done: set = set()
+    lock = threading.Lock()
+    # storm identity: distinct signatures are counted per WRAPPER (one
+    # compiled stream), not per label — "Aggregate#3" labels from different
+    # queries sharing one label must not pool into a phantom storm
+    with _WRAPPER_SEQ_LOCK:
+        _WRAPPER_SEQ[0] += 1
+        wrapper_id = _WRAPPER_SEQ[0]
 
     def run(*args, **kw):
+        sig_key = tracing.arg_signature(args, kw)
+        with lock:
+            owns = sig_key not in claimed
+            if owns:
+                claimed.add(sig_key)
+            compiling = sig_key not in done
         # in-flight registry entry/exit brackets the dispatch: a wedged
-        # tunnel round-trip is VISIBLE (site + operator + thread + elapsed)
-        # to the stall watchdog while it hangs, not just as a post-hoc
-        # latency-histogram blow-up
+        # tunnel round-trip is VISIBLE (site + operator + thread + elapsed
+        # + compiling flag) to the stall watchdog while it hangs, not just
+        # as a post-hoc latency-histogram blow-up
         reg = tracing.current_inflight()
-        tok = reg.enter("dispatch", label)
+        tok = reg.enter("dispatch", label, compiling=compiling)
+        cap = tracing.begin_compile_capture() if owns else None
         t0 = _time.perf_counter()
+        ok = False
         try:
             if tracing.DISPATCH_TEST_HOOK is not None:
                 tracing.DISPATCH_TEST_HOOK(label)
@@ -76,14 +146,42 @@ def _jit(fn, site=None, **kwargs):
             # every dispatch in the engine is injectable (disarmed = one
             # global None test, nothing on the budget counters)
             faults.maybe_inject("dispatch", label)
-            return compiled(*args, **kw)
+            out = compiled(*args, **kw)
+            ok = True
+            return out
         finally:
             reg.exit(tok)
-            tracing.record_dispatch(site=label,
-                                    seconds=_time.perf_counter() - t0)
+            dt = _time.perf_counter() - t0
+            if owns:
+                xla_s = tracing.end_compile_capture(cap)
+                if ok:
+                    with lock:
+                        done.add(sig_key)
+                    exe = _executable_bytes(compiled, args, kw) \
+                        if _compile_memstats_enabled() else None
+                    tracing.record_compile(
+                        xla_s if xla_s is not None else dt, site=label,
+                        signature=tracing.signature_summary(sig_key),
+                        sig_key=f"{hash(sig_key) & 0xffffffffffffffff:016x}",
+                        exe_bytes=exe, wrapper=wrapper_id)
+                else:
+                    # a first-seen dispatch that raises (injected fault,
+                    # transient device error) records nothing and releases
+                    # the claim — the RETRY is the run that really
+                    # compiles, and it must still flag `compiling` or a
+                    # tight STALL_S reads the legit compile as a wedge
+                    with lock:
+                        claimed.discard(sig_key)
+            tracing.record_dispatch(site=label, seconds=dt)
 
     run.__wrapped__ = getattr(compiled, "__wrapped__", fn)
     return run
+
+
+# one process-wide registration of the jax.monitoring compile-event listener
+# (the /jax/core/compile/* duration family): idempotent, and harmless when
+# the runtime lacks monitoring (captures then fall back to dispatch wall)
+tracing.install_compile_listener()
 
 
 _PARAM_TLS = threading.local()

@@ -7,8 +7,10 @@ capture window's most valuable profiles have been lost three rounds running.
 The recorder is the black box: one JSON record per COMPLETED or ERRORED
 statement — normalized SQL, counters + sites, the finished span tree
 (stitched worker spans included on a cluster coordinator), the wall-clock
-decomposition, plan-actuals payload, faults/retries, admission wait — plus
-event records for stall reports, appended off the hot path under the same
+decomposition, plan-actuals payload, faults/retries, admission wait, and
+(round 17) the statement's compile census (``compiles``/``compile_s`` plus
+the per-compilation ``compile_events`` list from the engine's CompileLog) —
+plus event records for stall reports, appended off the hot path under the same
 guard discipline as cache stores: a recorder failure never fails the query,
 and the feed adds ZERO ``_jit`` dispatches / ``_host`` pulls (everything it
 writes was already computed on the host — the PlanHistoryStore contract,
@@ -40,7 +42,8 @@ import uuid
 from collections import deque
 from typing import Optional
 
-__all__ = ["FlightRecorder", "read_flight_dir", "pressure_rung"]
+__all__ = ["FlightRecorder", "read_flight_dir", "pressure_rung",
+           "summarize_compiles"]
 
 DEFAULT_MAX_RECORDS = 256
 DEFAULT_DISK_BUDGET = 64 << 20
@@ -71,6 +74,23 @@ def pressure_rung(counters: Optional[dict]) -> Optional[str]:
     if c.get("admission_queued"):
         return "admission-queue"
     return None
+
+
+def summarize_compiles(rec: Optional[dict]):
+    """(count, seconds) of XLA compilations attributed to one statement
+    record — the round-17 top-level fields when the engine stamped them,
+    else the counters snapshot (older records: (0, 0.0), never None).
+    Stdlib-pure like the rest of this module: scripts/flight.py renders
+    compile columns on a dead process's ring through this."""
+    r = rec or {}
+    c = r.get("counters") or {}
+    n = r.get("compiles")
+    if n is None:
+        n = c.get("compiles")
+    s = r.get("compile_s")
+    if s is None:
+        s = c.get("compile_s")
+    return int(n or 0), float(s or 0.0)
 
 
 def read_flight_dir(path: str) -> list:

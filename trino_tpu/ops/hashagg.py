@@ -410,7 +410,7 @@ _REHASH_KIND = {"sum": "sum", "count": "sum", "count_star": "sum",
                 "sum_hi32": "sum", "sum_lo32": "sum"}
 
 
-@partial(jax.jit, static_argnums=(1, 2))
+@partial(jax.jit, static_argnums=(1, 2))  # compile-ok: module-level kernel invoked from exec's _jit-wrapped steps and driver loops; per-capacity compiles are bounded by pow2 growth
 def rehash(state: GroupByState, new_capacity: int, acc_kinds: tuple = ()) -> GroupByState:
     """Re-insert every occupied entry into a larger table (reference:
     FlatHash#rehash).  Accumulators re-insert as partial values (count -> sum).
@@ -479,7 +479,7 @@ def group_count(state: GroupByState):
     return jnp.sum(state.table[:C] != EMPTY_KEY, dtype=jnp.int32)
 
 
-@partial(jax.jit, static_argnums=(1,))
+@partial(jax.jit, static_argnums=(1,))  # compile-ok: module-level kernel; pow2 size buckets bound its compile count
 def compact_groups(state: GroupByState, size: int):
     """Gather the occupied groups into dense ``size``-bounded arrays ON DEVICE.
 

@@ -326,7 +326,7 @@ def _multi_build_step(table0, key_cols, key_types, valid):
     return table, counts, starts, order, overflow
 
 
-_multi_build_jit = jax.jit(_multi_build_step, static_argnums=(2,))
+_multi_build_jit = jax.jit(_multi_build_step, static_argnums=(2,))  # compile-ok: module-level build kernel shared across executors; exec-side dispatch accounting wraps its callers
 
 
 def multi_build(capacity: int, build_page, key_channels, key_types) -> MultiJoinTable:

@@ -217,7 +217,7 @@ def _tile_loop(n_tiles: int, body, init):
 
 
 # ------------------------------------------------------------------- hash probe
-@functools.partial(jax.jit, static_argnames=("max_probes", "interpret"))
+@functools.partial(jax.jit, static_argnames=("max_probes", "interpret"))  # compile-ok: module-level Pallas kernel entry; dispatched inside exec's _jit step fns
 def hash_probe(table, vals, packed, h0, stp, valid, max_probes: int = MAX_PROBES,
                interpret: bool | None = None):
     """Open-addressed probe as a gather-free tensor program.
@@ -311,7 +311,7 @@ def hash_probe(table, vals, packed, h0, stp, valid, max_probes: int = MAX_PROBES
 
 
 # ------------------------------------------------------------------ hash insert
-@functools.partial(jax.jit, static_argnames=("max_probes", "interpret"))
+@functools.partial(jax.jit, static_argnames=("max_probes", "interpret"))  # compile-ok: module-level Pallas kernel entry; dispatched inside exec's _jit step fns
 def hash_insert(table, packed, valid, max_probes: int = MAX_PROBES,
                 interpret: bool | None = None):
     """CAS-style claim loop for open-addressing insertion, in-kernel.
@@ -463,7 +463,7 @@ def hash_insert(table, packed, valid, max_probes: int = MAX_PROBES,
 
 
 # -------------------------------------------------------------- compaction pack
-@functools.partial(jax.jit, static_argnames=("out_len", "interpret"))
+@functools.partial(jax.jit, static_argnames=("out_len", "interpret"))  # compile-ok: module-level Pallas kernel entry; dispatched inside exec's _jit step fns
 def compact_rows_matrix(mat, valid, out_len: int, interpret: bool | None = None):
     """Order-preserving masked-lane pack: [n, L] int32 -> [out_len, L].
 
@@ -575,7 +575,7 @@ def compact_columns(cols, valid, out_len: int, interpret: bool | None = None):
 
 
 # --------------------------------------------------------- fused segment agg
-@functools.partial(jax.jit, static_argnames=("n_slots", "interpret"))
+@functools.partial(jax.jit, static_argnames=("n_slots", "interpret"))  # compile-ok: module-level Pallas kernel entry; dispatched inside exec's _jit step fns
 def fused_segment_agg(slot, valid, value_cols, n_slots: int, interpret: bool = False):
     """All-in-one-pass segment aggregation for a direct-indexed group-by.
 

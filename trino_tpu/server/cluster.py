@@ -666,9 +666,15 @@ class WorkerServer:
     def _health(self) -> dict:
         """Live node-health verdict for heartbeats/announces: "stalled" when
         any in-flight entry on THIS worker's registry exceeds the watchdog
-        threshold, recomputed per request (no watchdog-poll latency)."""
-        verdict, n = self.stall_watchdog.verdict()
-        return {"health": verdict, "stalled": n,
+        threshold, recomputed per request (no watchdog-poll latency).
+        Round 17: a worker whose over-threshold entries are all first-seen-
+        signature COMPILES (under TRINO_TPU_STALL_COMPILE_S) reports
+        "compiling" — the coordinator only degrades on "stalled", so a
+        cold-compiling worker keeps receiving work instead of being gated
+        out mid-warmup."""
+        verdict, stalled_n, compiling_n = self.stall_watchdog.status()
+        return {"health": verdict, "stalled": stalled_n,
+                "compiling": compiling_n,
                 "inflight": self.inflight.depth()}
 
     def _announce_loop(self):

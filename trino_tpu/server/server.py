@@ -457,6 +457,13 @@ class CoordinatorServer:
                         return
                     self._send(200, rec)
                     return
+                if parts == ["v1", "compiles"]:
+                    # round 17: the compile observatory — census state plus
+                    # the retained per-compilation records (site, op label,
+                    # query id, arg signature, duration, exe size), the JSON
+                    # twin of system.runtime.compilations
+                    self._send(200, server._compiles_json())
+                    return
                 # /v1/spooled/{qid}/{seg} — spooled result segment payload
                 # (reference: the client fetching spooled segments by URI,
                 # client/trino-client/.../OkHttpSegmentLoader.java)
@@ -787,7 +794,9 @@ class CoordinatorServer:
         from ..execution import tracing as _tracing
 
         wd = getattr(self.engine, "stall_watchdog", None)
-        stalled = wd.verdict()[1] if wd is not None else 0
+        stalled_n = compiling_n = 0
+        if wd is not None:
+            _, stalled_n, compiling_n = wd.status()
         lines += [
             "# HELP trino_tpu_inflight_entries Device-boundary operations "
             "currently executing (dispatches, pulls, split generation, "
@@ -795,11 +804,46 @@ class CoordinatorServer:
             "# TYPE trino_tpu_inflight_entries gauge",
             f"trino_tpu_inflight_entries {_tracing.INFLIGHT.depth()}",
             "# HELP trino_tpu_stalled_dispatches In-flight entries older "
-            "than the TRINO_TPU_STALL_S threshold (0 when the watchdog is "
-            "disabled).",
+            "than the TRINO_TPU_STALL_S threshold, excluding tolerated "
+            "compiles (0 when the watchdog is disabled).",
             "# TYPE trino_tpu_stalled_dispatches gauge",
-            f"trino_tpu_stalled_dispatches {stalled}",
+            f"trino_tpu_stalled_dispatches {stalled_n}",
+            "# HELP trino_tpu_compiling_dispatches First-seen-signature "
+            "dispatches past the stall threshold but under "
+            "TRINO_TPU_STALL_COMPILE_S (verdict: compiling, not stalled).",
+            "# TYPE trino_tpu_compiling_dispatches gauge",
+            f"trino_tpu_compiling_dispatches {compiling_n}",
         ]
+        # round 17: the compile observatory — lifetime compile count/seconds
+        # (counters), the compile wall-time histogram on its own
+        # seconds-to-minutes bucket scale, and recompile-storm detections
+        cl = getattr(self.engine, "compile_log", None)
+        if cl is not None:
+            ci = cl.info()
+            lines += [
+                "# HELP trino_tpu_compiles_total XLA compilations observed "
+                "at the _jit chokepoint (first-seen arg signatures).",
+                "# TYPE trino_tpu_compiles_total counter",
+                f"trino_tpu_compiles_total {ci['compiles_total']}",
+                "# HELP trino_tpu_recompile_storms_total Operator sites "
+                "that crossed the distinct-signature storm threshold "
+                "(shape churn defeating executable reuse).",
+                "# TYPE trino_tpu_recompile_storms_total counter",
+                f"trino_tpu_recompile_storms_total {ci['storms_total']}",
+            ]
+            h = cl.latency.as_dict()
+            lines += ["# HELP trino_tpu_compile_seconds Wall time of each "
+                      "observed XLA compilation.",
+                      "# TYPE trino_tpu_compile_seconds histogram"]
+            cum = 0
+            for ub, c in zip(cl.latency.buckets, h["buckets"]):
+                cum += c
+                lines.append(
+                    f'trino_tpu_compile_seconds_bucket{{le="{ub}"}} {cum}')
+            lines.append(
+                f'trino_tpu_compile_seconds_bucket{{le="+Inf"}} {h["count"]}')
+            lines.append(f"trino_tpu_compile_seconds_sum {h['sum_s']}")
+            lines.append(f"trino_tpu_compile_seconds_count {h['count']}")
         # round 16: flight recorder — the durable per-statement record ring.
         # records/bytes are gauges (rings evict); the lifetime totals,
         # stitched-span counts and guarded-store failures are counters.
@@ -1244,6 +1288,14 @@ class CoordinatorServer:
     def _flight_record(self, qid: str):
         fr = getattr(self.engine, "flight_recorder", None)
         return fr.get(qid) if fr is not None else None
+
+    def _compiles_json(self) -> dict:
+        """GET /v1/compiles payload: compile-census state (lifetime totals,
+        storm detections) + the retained per-compilation records."""
+        cl = getattr(self.engine, "compile_log", None)
+        if cl is None:
+            return {"info": {"enabled": False}, "records": []}
+        return {"info": cl.info(), "records": cl.snapshot()}
 
     def _query_trace(self, qid: str):
         """OTLP/JSON trace for a server query id (captured trace), an ENGINE

@@ -56,6 +56,14 @@ def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
         if fi or tr:
             boundary_line += f", {fi} faults injected, {tr} task retries"
         lines.append(boundary_line)
+        cm = getattr(counters, "compiles", 0)
+        if cm:
+            # the compile observatory (round 17): how many first-seen arg
+            # signatures this run compiled and what they cost — a WARM
+            # statement prints nothing here (zero = no line, budget-suite
+            # regexes unchanged), so the line itself is a cold-path marker
+            lines.append(f"Compile: {cm} compilations, "
+                         f"{getattr(counters, 'compile_s', 0.0):.3f}s")
         sp = getattr(counters, "spilled_bytes", 0)
         aq = getattr(counters, "admission_queued", 0)
         if sp or aq:
