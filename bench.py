@@ -16,6 +16,10 @@ vs_baseline = geometric-mean per-query speedup over the CPU baseline.
 BENCH_SF overrides the scale factor (default 1); BENCH_QUERIES picks a subset
 (comma-separated, e.g. "q1,q3").
 
+``--distributed`` benches the worker-mesh executor instead (rows/sec/chip
+across the mesh; forces the virtual 8-device mesh on CPU) and embeds the
+round-18 device-vs-spool exchange-byte A/B per query.
+
 ``--baseline BENCH_xxx.json`` diffs this run's per_query wall/dispatch/bytes
 against a prior capture and prints a regression verdict line to stderr
 (>20% wall growth or any budget-counter growth flags); the diff also embeds
@@ -39,6 +43,16 @@ import time
 _force_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
 if _force_cpu:
     os.environ.pop("JAX_PLATFORMS")
+
+# --distributed benches the worker-mesh executor: it needs >1 device, which
+# on the CPU backend means forcing the virtual 8-device mesh BEFORE jax
+# imports (same dance as tests/conftest.py; a no-op on a real multi-chip
+# backend, where jax.devices() reports the hardware)
+if "--distributed" in sys.argv and "host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
 
 import jax
 
@@ -324,6 +338,93 @@ def _baseline_diff(base_pq: dict, now_pq: dict) -> dict:
             "regressions": regressions}
 
 
+def _bench_distributed(engine, conn, session, names, remaining, payload):
+    """The --distributed bench: Q1/Q3/Q9/Q18 through DistributedExecutor on
+    the worker mesh (virtual 8-device CPU mesh locally, the real chips on
+    device).  value = rows/sec/CHIP (total input rows / summed warm median /
+    mesh size).  Each query also runs one cold+warm pair with the host-spool
+    exchange (TRINO_TPU_DEVICE_EXCHANGE=0 equivalent) so the capture carries
+    the round-18 A/B: per_query dist_site_bytes (device) vs
+    spool_site_bytes."""
+    from trino_tpu.exec.distributed import DistributedExecutor
+    from trino_tpu.parallel.mesh import worker_mesh
+    from trino_tpu.sql.frontend import compile_sql
+
+    n_dev = jax.device_count()
+    if n_dev < 2:
+        payload["metric"] = f"tpch_sf{SF:g}_distributed_skipped"
+        payload["detail"] = f"single-device backend ({n_dev})"
+        return
+    workers = min(n_dev, 8)
+    mesh = worker_mesh(workers)
+    payload["workers"] = workers
+
+    def _dist_bytes(c):
+        return sum(v["bytes"] for k, v in c.sites.items() if "dist." in k)
+
+    engine_times: dict = {}
+    row_counts: dict = {}
+    per_query: dict = {}
+    for name in names:
+        if remaining() < 30:
+            print(f"bench: budget exhausted before {name}", file=sys.stderr)
+            break
+        try:
+            plan = compile_sql(QUERIES[name], engine, session)
+            ex = DistributedExecutor(engine.catalogs, mesh=mesh)
+            t0 = time.perf_counter()
+            ex.execute(plan)  # prewarm = cold compile
+            cold_s = time.perf_counter() - t0
+            times = []
+            for _ in range(RUNS):
+                if times and remaining() < 3 * times[0]:
+                    break
+                t0 = time.perf_counter()
+                ex.execute(plan)
+                times.append(time.perf_counter() - t0)
+            med = sorted(times)[len(times) // 2]
+            c = ex.counters  # the last WARM run's counters
+            pq = {"engine_warm_s": round(med, 3),
+                  "engine_cold_s": round(cold_s, 3),
+                  "dist_site_bytes": _dist_bytes(c), **c.as_dict()}
+            # spool half of the A/B (one cold + one warm, budget permitting):
+            # the host-materializing exchange this round replaced
+            if remaining() > 30 + 2 * cold_s:
+                sp = DistributedExecutor(engine.catalogs, mesh=mesh,
+                                         device_exchange=False)
+                sp.execute(plan)
+                t0 = time.perf_counter()
+                sp.execute(plan)
+                pq["spool_warm_s"] = round(time.perf_counter() - t0, 3)
+                pq["spool_site_bytes"] = _dist_bytes(sp.counters)
+            engine_times[name] = med
+            per_query[name] = pq
+            for t in QUERY_TABLES[name]:
+                row_counts.setdefault(t, conn.row_count(t))
+            print(f"bench: {name} mesh({workers}) cold={cold_s:.2f}s "
+                  f"warm={med:.3f}s dist_bytes={pq['dist_site_bytes']}"
+                  + (f" spool_bytes={pq['spool_site_bytes']}"
+                     if "spool_site_bytes" in pq else "")
+                  + f" ({remaining():.0f}s left)", file=sys.stderr)
+        except _BudgetExceeded:
+            raise
+        except Exception as e:
+            print(f"bench: {name} failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    done = sorted(engine_times)
+    if done:
+        total_rows = sum(sum(row_counts[t] for t in QUERY_TABLES[q])
+                         for q in done)
+        total_t = sum(engine_times.values())
+        payload.update({
+            "metric": (f"tpch_sf{SF:g}_dist{workers}w_{'_'.join(done)}"
+                       "_rows_per_sec_per_chip"),
+            "value": round(total_rows / total_t / workers),
+            "unit": "rows/s",
+            "per_query": per_query,
+        })
+
+
 def main(argv=None):
     import argparse
 
@@ -337,6 +438,12 @@ def main(argv=None):
                          "an A/B pair; per_query embeds page_cache_hits/"
                          "misses/bytes_saved either way, so diffing two runs "
                          "quantifies exactly what the pool saved")
+    ap.add_argument("--distributed", action="store_true",
+                    help="bench the worker-mesh DistributedExecutor instead "
+                         "of the local engine: rows/sec/CHIP across the mesh "
+                         "plus the device-vs-spool exchange-byte A/B "
+                         "(round 18); on CPU this forces the virtual "
+                         "8-device mesh")
     args = ap.parse_args(argv)
     if args.no_page_cache:
         os.environ["TRINO_TPU_PAGE_CACHE"] = "0"
@@ -426,6 +533,13 @@ def main(argv=None):
         names = [q.strip() for q in
                  os.environ.get("BENCH_QUERIES", "q1,q3,q4,q9,q18").split(",")
                  if q.strip() in QUERIES]
+        if args.distributed:
+            # mesh bench: its own loop + payload (no pandas baseline — the
+            # comparison that matters there is device-vs-spool exchange A/B)
+            _bench_distributed(engine, conn, session,
+                               [n for n in names if n != "q4"],
+                               remaining, payload)
+            return  # the finally below prints the payload
         for name in names:
             if remaining() < 30:
                 print(f"bench: budget exhausted before {name}", file=sys.stderr)

@@ -324,6 +324,49 @@ def bench_exchange_route(n):
     return _timeit(jax.jit(route), cols)
 
 
+def bench_exchange_append(n):
+    """The round-18 device-resident exchange batch step: bucketize +
+    all_to_all + append_rows into the carried [cap+1] receive buffer — the
+    per-batch device cost that replaced a per-batch host materialize.  Pair
+    with exchange_route to price the append itself."""
+    from functools import partial
+
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+
+    from trino_tpu.exec.distributed import shard_map
+    from trino_tpu.ops.arrays import append_rows
+    from trino_tpu.ops.exchange import bucketize, exchange_all_to_all
+    from trino_tpu.parallel.mesh import WORKER_AXIS, worker_mesh
+
+    W = min(8, len(jax.devices()))
+    if W < 2:
+        return None
+    mesh = worker_mesh(W)
+    per = n // W
+    cap = 2 * per  # headroom for skewed receives, like the capacity ladder
+    rng = np.random.default_rng(0)
+    sharded = NamedSharding(mesh, PS(WORKER_AXIS))
+    cols = jax.device_put(jnp.asarray(rng.integers(0, 1 << 40, (W, per))),
+                          sharded)
+    bufs = jax.device_put(jnp.zeros((W, cap + 1), cols.dtype), sharded)
+    cursor = jax.device_put(jnp.zeros((W,), jnp.int64), sharded)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(PS(WORKER_AXIS),) * 3,
+             out_specs=(PS(WORKER_AXIS),) * 3)
+    def step(c, bufs, cursor):
+        c, bufs, cursor = c[0], bufs[0], cursor[0]
+        pid = (c % W).astype(jnp.int32)
+        packed, pvalid, _ = bucketize((c,), jnp.ones_like(c, bool), pid, W,
+                                      per)
+        recv, rvalid = exchange_all_to_all(packed, pvalid, WORKER_AXIS, W)
+        nb, ncur, of = append_rows((bufs,), cursor,
+                                   (recv[0].reshape(-1),), rvalid.reshape(-1))
+        return nb[0][None], ncur[None], of[None]
+
+    return _timeit(jax.jit(step), cols, bufs, cursor)
+
+
 def bench_sort(n):
     rng = np.random.default_rng(0)
     keys = jnp.asarray(rng.integers(0, 1 << 40, n))
@@ -488,6 +531,7 @@ KERNELS = {
     "join_build": bench_join_build,
     "join_probe": bench_join_probe,
     "exchange_route": bench_exchange_route,
+    "exchange_append": bench_exchange_append,
     "sort": bench_sort,
     "window_scan": bench_window_scan,
     "compact": bench_compact,

@@ -149,6 +149,32 @@ def main() -> int:
                 import shutil
 
                 shutil.rmtree(scratch, ignore_errors=True)
+        # round 18: the distributed-exchange matrix — the mesh exchange's
+        # fault points (exchange_write/exchange_read at the dist.* sites),
+        # run on the worker mesh (virtual CPU workers locally, the real
+        # mesh on device)
+        from trino_tpu.execution.chaos_matrix import (DIST_QUERIES,
+                                                      DIST_SCENARIOS,
+                                                      run_dist_scenario)
+        from trino_tpu.parallel.mesh import worker_mesh
+
+        n_dev = jax.device_count()
+        if n_dev < 2:
+            payload["dist_skipped"] = f"single-device backend ({n_dev})"
+        else:
+            mesh = worker_mesh(min(n_dev, 8))
+            dist_baselines = {k: _sig(engine.execute_sql(sql, session))
+                              for k, sql in DIST_QUERIES.items()}
+            for (name, qkey, spec, kind) in DIST_SCENARIOS:
+                if time.time() - t_start > budget:
+                    skipped += 1
+                    continue
+                rec = run_dist_scenario(engine, DIST_QUERIES[qkey], session,
+                                        mesh, dist_baselines[qkey], name,
+                                        spec, kind)
+                rec["query"] = f"dist-{qkey}"
+                payload["scenarios"].append(rec)
+                done += 1
         # round 12: the result-cache matrix — needs its OWN result-enabled
         # engine (enabling the tier on the main engine would serve the warm
         # statements from cache and the dispatch/generate fault classes

@@ -29,6 +29,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _force_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
 if _force_cpu:
     os.environ.pop("JAX_PLATFORMS")
+if "--distributed" in sys.argv and "host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    # the mesh trace needs the virtual 8-device CPU mesh, and the flag must
+    # land BEFORE jax import (same dance as tests/conftest.py)
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
 
 import jax  # noqa: E402
 
@@ -71,6 +78,17 @@ def main():
                          "disabled).  The warm template numbers are the "
                          "point-class ceilings — re-derive them here after "
                          "any template-path change")
+    ap.add_argument("--distributed", action="store_true",
+                    help="trace the WORKER-MESH path instead of the local "
+                         "executor: each query runs on the 8-device CPU "
+                         "mesh (virtual workers; the flag forces the device "
+                         "count before jax imports) cold+warm in BOTH "
+                         "exchange modes — device-resident receive buffers "
+                         "vs the host spool (TRINO_TPU_DEVICE_EXCHANGE "
+                         "A/B).  The warm device-mode numbers are the "
+                         "tests/test_distributed_budgets.py ceilings; the "
+                         "spool/device exchange-site byte ratio is the "
+                         "round-18 acceptance number")
     ap.add_argument("--sites", action="store_true",
                     help="print each warm query's per-site attribution table "
                          "(operator/call-site -> dispatches, transfers, "
@@ -116,6 +134,10 @@ def main():
 
     if args.prepared:
         _trace_prepared(engine, sf, split_rows)
+        return
+    if args.distributed:
+        _trace_distributed(engine, sf, split_rows, names, QUERIES,
+                           args.sites)
         return
 
     def trace(session, name):
@@ -208,6 +230,55 @@ def main():
               f"({wn['coalesced_splits']} splits coalesced), "
               f"bytes {w1['host_bytes_pulled']} -> {wn['host_bytes_pulled']}",
               flush=True)
+
+
+def _trace_distributed(engine, sf, split_rows, names, QUERIES, show_sites):
+    """Worker-mesh trace: cold+warm counters per query in both exchange
+    modes (device-resident vs host spool).  The warm device rows — total
+    dist.* site bytes and the per-site table — are what
+    tests/test_distributed_budgets.py pins; the spool:device byte ratio is
+    the exchange-elimination factor bench.py --distributed reports."""
+    from trino_tpu.exec.distributed import DistributedExecutor
+    from trino_tpu.parallel.mesh import worker_mesh
+    from trino_tpu.sql.frontend import compile_sql
+
+    mesh = worker_mesh(min(jax.device_count(), 8))
+    session = engine.create_session("tpch")
+    for name in names:
+        plan = compile_sql(QUERIES[name], engine, session)
+        rec = {"query": name, "sf": sf, "split_rows": split_rows,
+               "workers": int(mesh.devices.size)}
+        for mode, dev in (("device", True), ("spool", False)):
+            ex = DistributedExecutor(engine.catalogs, mesh=mesh,
+                                     device_exchange=dev)
+            out = {}
+            for phase in ("cold", "warm"):
+                t0 = time.perf_counter()
+                ex.execute(plan)
+                counters = ex.counters.as_dict()
+                sites = counters.pop("sites", {})
+                counters.pop("dispatch_latency", None)
+                dist = {k: v for k, v in sites.items() if "dist." in k}
+                out[phase] = {
+                    "wall_s": round(time.perf_counter() - t0, 3),
+                    "dist_site_bytes": sum(v["bytes"] for v in dist.values()),
+                    **{k: v for k, v in counters.items() if v}}
+                if show_sites and phase == "warm":
+                    print(f"# {name} warm {mode} dist sites "
+                          "(dispatches/transfers/bytes):", flush=True)
+                    for key in sorted(dist, key=lambda k: (
+                            -dist[k]["bytes"], k)):
+                        s = dist[key]
+                        print(f"#   {key:<44} {s['dispatches']:>4} "
+                              f"{s['transfers']:>4} {s['bytes']:>9}",
+                              flush=True)
+            rec[mode] = out
+        print(json.dumps(rec), flush=True)
+        db = rec["device"]["warm"]["dist_site_bytes"]
+        sb = rec["spool"]["warm"]["dist_site_bytes"]
+        ratio = (sb / db) if db else float("inf")
+        print(f"# {name}: warm exchange-site bytes spool {sb} -> "
+              f"device {db} ({ratio:.1f}x)", flush=True)
 
 
 def _trace_prepared(engine, sf, split_rows):

@@ -91,6 +91,26 @@ for i in $(seq 1 250); do
       --kernels join_probe_ab,join_build_ab,hashagg_insert_ab,compact_ab \
       > scripts/bench_micro_pallas.json 2> scripts/bench_micro_pallas.log
     echo "$(date -Is) micro pallas A/B rc=$? : $(tail -c 300 scripts/bench_micro_pallas.json)" >> "$LOG"
+    # round-18 mesh-exchange A/B: the distributed executor on the real chips,
+    # device-resident exchange (default) vs the host-spool path
+    # (TRINO_TPU_DEVICE_EXCHANGE=0).  Each half embeds per-query
+    # dist_site_bytes — the first on-device datum for whether the carried
+    # receive buffers pay off when a host pull costs a real tunnel
+    # round-trip, not CPU-mesh microseconds.  Cheap (SF1), so it runs before
+    # the SF10/SF100 tail; the route+append micro kernels price the
+    # all_to_all step itself.
+    timeout -k 60 900 python bench_micro.py --rows 4000000 \
+      --kernels exchange_route,exchange_append \
+      > scripts/bench_micro_exchange.json 2> scripts/bench_micro_exchange.log
+    echo "$(date -Is) micro exchange rc=$? : $(tail -c 300 scripts/bench_micro_exchange.json)" >> "$LOG"
+    for cfg in "dist_device: " "dist_spool:TRINO_TPU_DEVICE_EXCHANGE=0"; do
+      IFS=: read -r name envset <<< "$cfg"
+      env $envset BENCH_BUDGET=900 BENCH_SF=1 BENCH_QUERIES=q1,q3,q9,q18 \
+        TRINO_TPU_SCAN_FUSED=0 \
+        timeout -k 60 1200 python bench.py --distributed \
+        > "scripts/bench_${name}.json" 2> "scripts/bench_${name}.log"
+      echo "$(date -Is) $name rc=$? : $(tail -c 300 scripts/bench_${name}.json)" >> "$LOG"
+    done
     # buffer-pool A/B (the round-9 capture): cache on (2GB budget) vs off,
     # SF1 first — hit rates + bytes_saved embed in each bench JSON
     for cfg in "sf1_cache:1:2147483648:900:1200" "sf1_nocache:1:0:900:1200" \
@@ -169,6 +189,19 @@ try:
                            if l.strip()]
 except Exception as e:
     out["pallas_micro"] = {"error": str(e)}
+# round 18: the mesh-exchange A/B (device receive buffers vs host spool)
+# + the route/append micro kernels that price the all_to_all step
+try:
+    out["exchange_micro"] = [json.loads(l) for l in
+                             open("scripts/bench_micro_exchange.json")
+                             if l.strip()]
+except Exception as e:
+    out["exchange_micro"] = {"error": str(e)}
+for name in ("dist_device", "dist_spool"):
+    try:
+        out[name] = json.load(open(f"scripts/bench_{name}.json"))
+    except Exception as e:
+        out[name] = {"error": str(e)}
 for name in ("sf1_cache", "sf1_nocache", "sf10_cache", "sf10_nocache"):
     try:
         out[name] = json.load(open(f"scripts/bench_{name}.json"))

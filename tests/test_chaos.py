@@ -39,7 +39,8 @@ import pytest
 from trino_tpu import Engine
 from trino_tpu.connectors.tpch import TpchConnector
 from trino_tpu.execution import faults
-from trino_tpu.execution.chaos_matrix import (FAILING, QUERIES, RECOVERABLE,
+from trino_tpu.execution.chaos_matrix import (DIST_SCENARIOS, FAILING,
+                                              QUERIES, RECOVERABLE,
                                               leak_report)
 from trino_tpu.execution.chaos_matrix import result_signature as _sig
 from trino_tpu.execution.chaos_matrix import settle as _settle
@@ -373,6 +374,40 @@ def test_operator_targeted_site_glob_fires():
         assert got == expected
     _leak_check(engine)
     engine._invalidate()
+
+
+@pytest.fixture(scope="module")
+def dist_chaos():
+    """Throwaway small engine + 8-worker mesh + local baselines for the
+    distributed-exchange matrix (round 18): the mesh path must fail typed on
+    injected exchange faults and recover byte-identically from delays."""
+    import jax
+
+    from trino_tpu.execution.chaos_matrix import DIST_QUERIES
+    from trino_tpu.parallel.mesh import worker_mesh
+
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    engine = Engine()
+    engine.register_catalog("tpch",
+                            TpchConnector(sf=0.02, split_rows=1 << 12))
+    session = engine.create_session("tpch")
+    mesh = worker_mesh(8)
+    baselines = {k: _sig(engine.execute_sql(sql, session))
+                 for k, sql in DIST_QUERIES.items()}
+    return engine, session, mesh, baselines
+
+
+@pytest.mark.parametrize("name,query,spec,kind", DIST_SCENARIOS,
+                         ids=[s[0] for s in DIST_SCENARIOS])
+def test_distributed_exchange_fault_matrix(dist_chaos, name, query, spec,
+                                           kind):
+    from trino_tpu.execution.chaos_matrix import (DIST_QUERIES,
+                                                  run_dist_scenario)
+
+    engine, session, mesh, baselines = dist_chaos
+    rec = run_dist_scenario(engine, DIST_QUERIES[query], session, mesh,
+                            baselines[query], name, spec, kind)
+    assert rec.get("ok"), rec
 
 
 def test_reannounce_resets_heartbeat_probe_backoff(tmp_path):

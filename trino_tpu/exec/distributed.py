@@ -24,6 +24,7 @@ leading axis, so the whole multi-batch loop stays jit-compiled with no host roun
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 from typing import Callable, Optional
 
@@ -44,7 +45,10 @@ except ImportError:  # older jax (this container's 0.4.x): experimental home
         # the static check — out_specs below are all explicit anyway
         shard_map = partial(shard_map, check_rep=False)
 
+from ..execution import faults
+from ..execution.tracing import maybe_span
 from ..ops import hashagg
+from ..ops.arrays import append_rows, compact_rows
 from ..ops.exchange import bucketize, exchange_all_to_all, partition_ids
 from ..ops.hashing import EMPTY_KEY, pack_keys
 from ..ops.hashjoin import expand_counts, multi_build, probe_slots
@@ -57,8 +61,8 @@ from .local_executor import (DEFAULT_GROUP_CAPACITY, MAX_GROUP_CAPACITY, LocalEx
                              MaterializedResult, _acc_input_expr,
                              _accumulators_for, _build_null_stats,
                              _compact_part, _finalize_aggs, _gather_build, _limit_page,
-                             _materialize, _null_aware_anti, _sort_page,
-                             _window_spec_dicts)
+                             _materialize, _null_aware_anti, _page_to_device,
+                             _sort_page, _window_spec_dicts)
 
 
 def _route_rows(cols, nulls, valid, pid, n_parts: int, bucket: int, axis_name):
@@ -91,6 +95,21 @@ def _false(valid):
     unvarying and cannot join varying carries/outputs; deriving from the data
     inherits the axis."""
     return jnp.any(valid) & False
+
+
+def _exchange_fault(point: str, site: str):
+    """Chaos chokepoint for the mesh exchange — the ``exchange_write`` /
+    ``exchange_read`` fault points previously fired only on the HTTP
+    SpoolingExchange.  ``error``/``fatal``/``delay`` behave as everywhere
+    else (raise through maybe_inject / sleep); any RETURNED action
+    (drop/deny) also raises typed, because a mesh all-to-all is one SPMD
+    program — it cannot drop a commit or defer a reader the way the spooled
+    exchange can, so the clean-failure contract is a typed error."""
+    act = faults.maybe_inject(point, site)
+    if act:
+        raise faults.InjectedFaultError(
+            f"injected {point}:{act} at {site}: the mesh exchange cannot "
+            "drop or defer rows")
 
 
 # (probe_bucket_factor, expand_factor) retry ladder: probe exchange buckets
@@ -145,9 +164,34 @@ def _pad_page(page: Page, cap: int) -> Page:
     return Page(page.schema, cols, nulls, valid)
 
 
-def _has_duplicate_keys(build_page: Page, key_channels, key_types) -> bool:
-    """Host-side duplicate-key check on the materialized build page (cheaper than
-    building a throwaway device hash table just to read its dup counter)."""
+def _has_duplicate_keys(build_page: Page, key_channels, key_types,
+                        device: bool = False) -> bool:
+    """Duplicate-key check on the materialized build page (cheaper than
+    building a throwaway device hash table just to read its dup counter).
+    With ``device=True`` the whole check runs as ONE jitted sort-reduction
+    and pulls a single boolean — the device-resident discipline applied to
+    the build side (the host variant pulls masks + packed keys).  Both
+    variants treat a fingerprint collision as a duplicate, the conservative
+    direction (caller falls back to the general multi-match path)."""
+    if device:
+        keys = tuple(build_page.columns[ch] for ch in key_channels)
+        kmasks = tuple(build_page.null_masks[ch] for ch in key_channels
+                       if build_page.null_masks[ch] is not None)
+
+        def dupcheck(keys, kmasks, valid):
+            kvalid = valid
+            for nm in kmasks:
+                kvalid = kvalid & ~nm
+            packed, _ = pack_keys(keys, key_types)
+            # valid rows first, sorted by packed key: any adjacent equal pair
+            # of valid keys is a duplicate
+            order = jnp.lexsort((packed, (~kvalid).astype(jnp.int8)))
+            sp, sv = packed[order], kvalid[order]
+            return jnp.any((sp[1:] == sp[:-1]) & sv[1:] & sv[:-1])
+
+        dup = _jit(dupcheck, site="dist.build.dupcheck")(
+            keys, kmasks, build_page.valid_mask())
+        return bool(_host([dup], site="dist.build.dupcheck")[0])
     nms = [build_page.null_masks[ch] for ch in key_channels
            if build_page.null_masks[ch] is not None]
     got = _host([build_page.valid_mask()] + nms,
@@ -402,20 +446,46 @@ def _stack_shards(per_cols, per_nulls, counts, fields):
 
 
 def _page_from_shards(schema, cols_g, nulls_g, counts):
-    """Reassemble [W, nmax] device shard results into one flat page: worker w
-    contributes its counts[w] head rows, workers concatenated in mesh order."""
+    """Reassemble [W, nmax] shard results into one flat page: worker w
+    contributes its counts[w] head rows, workers concatenated in mesh order.
+
+    All-device shards assemble ON DEVICE (one fused order-preserving
+    compaction over the flattened [W*nmax] layout — compact_rows keeps
+    arrival order, so the result is byte-identical to the host concat) and
+    the page never round-trips.  Mixed/host shards take the host concat,
+    staged back through ``_page_to_device`` (counted, injectable H2D)."""
     W = len(counts)
+    cols_l, nulls_l = list(cols_g), list(nulls_g)
+    if cols_l and all(isinstance(a, jax.Array) for a in cols_l + nulls_l):
+        total = int(sum(counts))
+        nmax = cols_l[0].shape[1]
+        counts_t = jnp.asarray(counts).astype(jnp.int64)
+
+        def concat(cols_t, nulls_t, counts_t):
+            valid = (jnp.arange(nmax)[None, :]
+                     < counts_t[:, None]).reshape(-1)
+            arrs = tuple(c.reshape(-1) for c in cols_t) \
+                + tuple(m.reshape(-1) for m in nulls_t)
+            packed, _ = compact_rows(arrs, valid, max(total, 1))
+            return packed[:len(cols_t)], packed[len(cols_t):]
+
+        out_cols, out_nulls = _jit(concat, site="dist.shards.concat")(
+            tuple(cols_l), tuple(nulls_l), counts_t)
+        if total == 0:
+            # compact_rows needs out_len >= 1; trim the placeholder row
+            out_cols = tuple(c[:0] for c in out_cols)
+            out_nulls = tuple(m[:0] for m in out_nulls)
+        return Page(schema, tuple(out_cols), tuple(out_nulls), None)
     out_cols, out_nulls = [], []
-    got = _host(list(cols_g) + list(nulls_g),
+    got = _host(list(cols_l) + list(nulls_l),
                 site="dist.shards.pull")  # one batched shard pull
-    for a_np in got[:len(cols_g)]:
+    for a_np in got[:len(cols_l)]:
         out_cols.append(np.concatenate([a_np[w][:counts[w]] for w in range(W)]))
-    for m_np in got[len(cols_g):]:
+    for m_np in got[len(cols_l):]:
         out_nulls.append(np.concatenate([m_np[w][:counts[w]] for w in range(W)]))
-    return Page(schema,
-                tuple(jnp.asarray(c) for c in out_cols),
-                tuple(jnp.asarray(m) if m.any() else None for m in out_nulls),
-                None)
+    return _page_to_device(Page(
+        schema, tuple(out_cols),
+        tuple(m if m.any() else None for m in out_nulls), None))
 
 
 @dataclasses.dataclass
@@ -442,10 +512,20 @@ class DistributedExecutor:
     sub-plans (join build sides, small inputs)."""
 
     def __init__(self, catalogs: dict, mesh=None, partition_threshold: int = 1 << 17,
-                 dispatch_batch=None):
+                 dispatch_batch=None, device_exchange=None):
         self.catalogs = catalogs
         self.mesh = mesh if mesh is not None else worker_mesh()
         self.n_workers = self.mesh.devices.size
+        # device-resident exchange (round 18): routed rows append into carried
+        # [W, cap] device receive buffers INSIDE the routing shard_map and the
+        # blocking consumers (sort shard, window partition, final-agg merge,
+        # stream materialize) read sharded device buffers directly — per-batch
+        # host traffic is scalar cursor/overflow flags.  =0 restores the
+        # round-17 host spool (the A/B half bench.py --distributed prices).
+        if device_exchange is None:
+            device_exchange = os.environ.get(
+                "TRINO_TPU_DEVICE_EXCHANGE", "1") != "0"
+        self.device_exchange = bool(device_exchange)
         self.local = LocalExecutor(catalogs)
         # session dispatch-coalescing width threads into the fallback local
         # executor: blocking sub-plans (join builds, small fragments) coalesce
@@ -723,7 +803,8 @@ class DistributedExecutor:
                 # silently fell back to local)
                 build_page = _pad_page(build_page, 16)
             multi = _has_duplicate_keys(build_page, node.right_keys,
-                                        build_key_types)
+                                        build_key_types,
+                                        device=self.device_exchange)
             # NOT IN 3VL facts, host-side (shared with the local executor's
             # null-aware anti: _build_null_stats / _null_aware_anti)
             build_null_stats = _build_null_stats(build_page, node.right_keys)
@@ -938,15 +1019,17 @@ class DistributedExecutor:
         cap_r = max(1 << max(2 * chunk - 1, 1).bit_length(), 32)
         while True:
             fn = partial(build_exchange, cap_r=cap_r)
-            table_g = _jit(site="dist.join.build_exchange", fn=
-                shard_map(
-                    lambda bc, bn, bv: jax.tree.map(
-                        lambda x: None if x is None else x[None],
-                        fn(tuple(c[0] for c in bc), tuple(m[0] for m in bn),
-                           bv[0]),
-                        is_leaf=lambda x: x is None),
-                    mesh=mesh, in_specs=(PS(WORKER_AXIS),) * 3,
-                    out_specs=PS(WORKER_AXIS)))(bcols_g, bnulls_g, bvalid_g)
+            _exchange_fault("exchange_write", "dist.join.build_exchange")
+            with maybe_span("exchange.route"):
+                table_g = _jit(site="dist.join.build_exchange", fn=
+                    shard_map(
+                        lambda bc, bn, bv: jax.tree.map(
+                            lambda x: None if x is None else x[None],
+                            fn(tuple(c[0] for c in bc), tuple(m[0] for m in bn),
+                               bv[0]),
+                            is_leaf=lambda x: x is None),
+                        mesh=mesh, in_specs=(PS(WORKER_AXIS),) * 3,
+                        out_specs=PS(WORKER_AXIS)))(bcols_g, bnulls_g, bvalid_g)
             if not bool(np.any(_host([table_g.overflow],
                                      site="dist.join.overflow")[0])):
                 break
@@ -1078,31 +1161,58 @@ class DistributedExecutor:
                 c = c.astype(jnp.int8)
             return -c if not pk.ascending else c
 
-        # --- sample pass: materialize batch 0 once; its primary-key ranks give
-        # the W-1 range splitters AND its rows seed the collect buffers via
-        # host-side routing (so the device never re-runs batch 0)
-        @partial(shard_map, mesh=mesh,
-                 in_specs=(PS(WORKER_AXIS), stream.aux_specs),
-                 out_specs=PS(WORKER_AXIS))
-        def sample(lo_g, aux, stream=stream):
-            cols, nulls, valid, of = _stream_batch(stream, lo_g, aux)
-            nulls = tuple(jnp.zeros(c.shape, bool) if m is None else m
-                          for c, m in zip(cols, nulls))
-            return (tuple(c[None] for c in cols), tuple(m[None] for m in nulls),
-                    valid[None], of[None])
+        # --- sample pass: materialize batch 0's primary-key ranks once; they
+        # give the W-1 range splitters.  Device-resident mode pulls ONLY the
+        # key channel + validity (the sample pull shrinks ~1/ncols) and batch
+        # 0 re-routes on the mesh with every other batch; host-spool mode
+        # pulls the full batch and its rows seed the collect buffers via
+        # host-side routing (so the device never re-runs batch 0).
+        if self.device_exchange:
+            @partial(shard_map, mesh=mesh,
+                     in_specs=(PS(WORKER_AXIS), stream.aux_specs),
+                     out_specs=PS(WORKER_AXIS))
+            def sample_key(lo_g, aux, stream=stream):
+                cols, nulls, valid, of = _stream_batch(stream, lo_g, aux)
+                nm = nulls[ch] if nulls[ch] is not None \
+                    else jnp.zeros(valid.shape, bool)
+                return cols[ch][None], nm[None], valid[None], of[None]
 
-        c0, n0, v0, of0 = _jit(sample)(
-            jax.device_put(stream.scan_lo_batches[0], sharded), stream.aux)  # device-ok: mesh-sharded placement
-        got = _host(list(c0) + list(n0) + [v0, of0]
-                    + ([luts[ch]] if ch in luts else []),
-                    site="dist.sort.sample")
-        if bool(np.any(got[len(c0) + len(n0) + 1])):
-            return None, True
-        cols0 = [c.reshape(-1) for c in got[:len(c0)]]
-        nulls0 = [m.reshape(-1) for m in got[len(c0):len(c0) + len(n0)]]
-        valid0 = got[len(c0) + len(n0)].reshape(-1)
+            got = _host(list(_jit(sample_key)(
+                            jax.device_put(stream.scan_lo_batches[0], sharded),  # device-ok: mesh-sharded placement
+                            stream.aux))
+                        + ([luts[ch]] if ch in luts else []),
+                        site="dist.sort.sample")
+            if bool(np.any(got[3])):
+                return None, True
+            key0 = got[0].reshape(-1)
+            keynull0 = got[1].reshape(-1)
+            valid0 = got[2].reshape(-1)
+            lut_np = None if ch not in luts else got[-1]
+            seed, skip = None, 0
+        else:
+            @partial(shard_map, mesh=mesh,
+                     in_specs=(PS(WORKER_AXIS), stream.aux_specs),
+                     out_specs=PS(WORKER_AXIS))
+            def sample(lo_g, aux, stream=stream):
+                cols, nulls, valid, of = _stream_batch(stream, lo_g, aux)
+                nulls = tuple(jnp.zeros(c.shape, bool) if m is None else m
+                              for c, m in zip(cols, nulls))
+                return (tuple(c[None] for c in cols),
+                        tuple(m[None] for m in nulls),
+                        valid[None], of[None])
 
-        lut_np = None if ch not in luts else got[-1]
+            c0, n0, v0, of0 = _jit(sample)(
+                jax.device_put(stream.scan_lo_batches[0], sharded), stream.aux)  # device-ok: mesh-sharded placement
+            got = _host(list(c0) + list(n0) + [v0, of0]
+                        + ([luts[ch]] if ch in luts else []),
+                        site="dist.sort.sample")
+            if bool(np.any(got[len(c0) + len(n0) + 1])):
+                return None, True
+            cols0 = [c.reshape(-1) for c in got[:len(c0)]]
+            nulls0 = [m.reshape(-1) for m in got[len(c0):len(c0) + len(n0)]]
+            valid0 = got[len(c0) + len(n0)].reshape(-1)
+            key0, keynull0 = cols0[ch], nulls0[ch]
+            lut_np = None if ch not in luts else got[-1]
 
         def rank_host(c):
             if lut_np is not None:
@@ -1111,21 +1221,23 @@ class DistributedExecutor:
                 c = c.astype(np.int8)
             return -c if not pk.ascending else c
 
-        rv0 = rank_host(cols0[ch])
-        ok = valid0 & ~nulls0[ch]
+        rv0 = rank_host(key0)
+        ok = valid0 & ~keynull0
         ranks = np.sort(rv0[ok])
         if ranks.size:
             splitters = ranks[[(i * ranks.size) // W for i in range(1, W)]]
         else:
             splitters = np.zeros((W - 1,), rv0.dtype)
 
-        # batch 0 routes on the host (same searchsorted the device path runs)
-        pid0 = np.searchsorted(splitters, rv0, side="left").astype(np.int32)
-        pid0 = np.where(nulls0[ch], 0 if pk.nulls_first else W - 1, pid0)
-        seed = ([[ [cols0[i][valid0 & (pid0 == w)]] for i in range(len(fields))]
-                 for w in range(W)],
-                [[ [nulls0[i][valid0 & (pid0 == w)]] for i in range(len(fields))]
-                 for w in range(W)])
+        if not self.device_exchange:
+            # batch 0 routes on the host (same searchsorted the device runs)
+            pid0 = np.searchsorted(splitters, rv0, side="left").astype(np.int32)
+            pid0 = np.where(keynull0, 0 if pk.nulls_first else W - 1, pid0)
+            seed = ([[ [cols0[i][valid0 & (pid0 == w)]] for i in range(len(fields))]
+                     for w in range(W)],
+                    [[ [nulls0[i][valid0 & (pid0 == w)]] for i in range(len(fields))]
+                     for w in range(W)])
+            skip = 1
 
         splitters_t = jnp.asarray(splitters)
         luts_t = dict(luts)
@@ -1145,20 +1257,17 @@ class DistributedExecutor:
         # range), which would deterministically overflow the hash-uniform
         # ~2n/W heuristic and waste full ladder re-runs
         collected = self._exchange_collect(stream, pid_fn, (luts_t, splitters_t),
-                                           skip_batches=1, seed=seed,
+                                           skip_batches=skip, seed=seed,
                                            bucket_of=lambda n: n)
         if collected is None:
             return None, True
-        per_cols, per_nulls, counts = collected
+        cols_g, nulls_g, valid_g, counts = collected
         if sum(counts) == 0:
             page = Page(stream.schema,
                         tuple(jnp.zeros((0,), np.dtype(f.type.dtype))
                               for f in fields),
                         tuple(None for _ in fields), None)
             return (page, stream.dicts), False
-
-        cols_g, nulls_g, valid_g, nmax = _stack_shards(per_cols, per_nulls,
-                                                       counts, fields)
 
         @partial(shard_map, mesh=mesh,
                  in_specs=(PS(WORKER_AXIS), PS(WORKER_AXIS), PS(WORKER_AXIS), PS()),
@@ -1221,16 +1330,13 @@ class DistributedExecutor:
         collected = self._exchange_collect(stream, pid_fn, ())
         if collected is None:
             return None, True
-        per_cols, per_nulls, counts = collected
+        cols_g, nulls_g, valid_g, counts = collected
         if sum(counts) == 0:
             cols = tuple(jnp.zeros((0,), np.dtype(f.type.dtype))
                          for f in node.schema.fields)
             page = Page(node.schema, cols,
                         tuple(None for _ in node.schema.fields), None)
             return (page, stream.dicts + spec_dicts), False
-
-        cols_g, nulls_g, valid_g, nmax = _stack_shards(per_cols, per_nulls,
-                                                       counts, child_fields)
 
         @partial(shard_map, mesh=mesh,
                  in_specs=(PS(WORKER_AXIS), PS(WORKER_AXIS), PS(WORKER_AXIS)),
@@ -1255,20 +1361,34 @@ class DistributedExecutor:
     def _exchange_collect(self, stream: _DStream, pid_fn, route_aux,
                           skip_batches: int = 0, seed=None, bucket_of=None):
         """Run the stream batch by batch, hash/range-routing rows to their
-        owning worker, and collect each worker's received rows in host buffers
-        (the spooling side of a blocking exchange).  ``_route_rows`` leaves
-        invalid slot gaps in the receive layout, so buffers are compacted by
-        the receive-side valid mask here.  ``route_aux`` is threaded into the
-        jitted step as an ARGUMENT (closed-over device constants degrade every
-        later dispatch on tunneled TPUs); ``seed``/``skip_batches`` let a
-        caller that already materialized batch 0 (the sort's splitter sample)
-        pre-route it host-side instead of re-running it on device.  Returns
-        (per_worker_cols, per_worker_nulls, counts) or None on bucket
-        overflow."""
+        owning worker, and collect each worker's received rows — the blocking
+        exchange both the full sort and the window path consume.
+
+        Device-resident by default (round 18): routed batches append into
+        carried [W, cap] device receive buffers inside the SAME shard_map that
+        runs the all-to-all, and only scalar cursor/overflow flags sync per
+        run; ``TRINO_TPU_DEVICE_EXCHANGE=0`` (or a seeded/skip-batch caller —
+        the sort's host-spool splitter sample) restores the host spool.
+        ``_route_rows`` leaves invalid slot gaps in the receive layout, so the
+        device path compacts via ``append_rows`` and the host path via the
+        receive-side valid mask.  ``route_aux`` is threaded into the jitted
+        step as an ARGUMENT (closed-over device constants degrade every later
+        dispatch on tunneled TPUs).
+
+        Returns (cols_g, nulls_g, valid_g, counts): [W, nmax] shard arrays —
+        device-sharded jnp on the device path, host numpy on the spool path —
+        plus per-worker host row counts; or None on bucket overflow (ladder
+        retry)."""
         mesh, W = self.mesh, self.n_workers
         sharded = NamedSharding(mesh, PS(WORKER_AXIS))
         bucket_of = bucket_of if bucket_of is not None else self._probe_bucket
-        ncols = len(stream.schema.fields)
+        fields = stream.schema.fields
+        ncols = len(fields)
+        if (self.device_exchange and seed is None and not skip_batches
+                and len(stream.scan_lo_batches)
+                and not any(np.dtype(f.type.dtype) == object for f in fields)):
+            return self._exchange_collect_device(stream, pid_fn, route_aux,
+                                                 bucket_of)
 
         @partial(shard_map, mesh=mesh,
                  in_specs=(PS(WORKER_AXIS), stream.aux_specs, PS()),
@@ -1293,10 +1413,12 @@ class DistributedExecutor:
             per_cols = [[[] for _ in range(ncols)] for _ in range(W)]
             per_nulls = [[[] for _ in range(ncols)] for _ in range(W)]
         for lo in stream.scan_lo_batches[skip_batches:]:
-            rcols, rnulls, rvalid, of = step(
-                jax.device_put(lo, sharded), stream.aux, route_aux)  # device-ok: mesh-sharded placement
-            got = _host(list(rcols) + list(rnulls) + [rvalid, of],
-                        site="dist.exchange.collect")
+            _exchange_fault("exchange_write", "dist.exchange.route")
+            with maybe_span("exchange.route"):
+                rcols, rnulls, rvalid, of = step(
+                    jax.device_put(lo, sharded), stream.aux, route_aux)  # device-ok: mesh-sharded placement
+                got = _host(list(rcols) + list(rnulls) + [rvalid, of],
+                            site="dist.exchange.collect")
             if bool(np.any(got[-1])):
                 return None
             v = got[-2]
@@ -1312,7 +1434,129 @@ class DistributedExecutor:
         out_nulls = [[np.concatenate(per_nulls[w][i]) for i in range(ncols)]
                      for w in range(W)]
         counts = [len(out_cols[w][0]) if ncols else 0 for w in range(W)]
-        return out_cols, out_nulls, counts
+        _exchange_fault("exchange_read", "dist.exchange.read")
+        cols_g, nulls_g, valid_g, _ = _stack_shards(out_cols, out_nulls,
+                                                    counts, fields)
+        return cols_g, nulls_g, valid_g, counts
+
+    # ------------------------------------------------- device-resident exchange
+    def _batch_rows(self, stream: _DStream) -> int:
+        """Per-worker row capacity of one scan batch (static shape fact)."""
+        b0 = stream.scan_lo_batches[0]
+        if isinstance(b0, np.ndarray):  # traced scan: [W] offset vector
+            out = jax.eval_shape(stream.scan_fn,
+                                 jax.ShapeDtypeStruct((), b0.dtype))
+            return int(out[2].shape[0])
+        return int(b0[2].shape[1])  # host-fed: stacked [W, cap] pytree
+
+    def _recv_capacity(self, stream: _DStream) -> int:
+        """Initial receive-buffer capacity: 2x the scan's total per-worker rows
+        (absorbs moderate routing skew without a growth retry), pow2-rounded
+        for bounded jit shape classes."""
+        est = self._batch_rows(stream) * max(len(stream.scan_lo_batches), 1)
+        return max(1 << (max(2 * est, 1024) - 1).bit_length(), 1024)
+
+    def _recv_state_init(self, cap: int, dtypes):
+        """Zeroed receive-buffer carry, mesh-sharded: per-column [W, cap + 1]
+        value + null-mask buffers (the +1 slot is append_rows' drop sink),
+        [W] write cursors, [W] ladder-overflow and [W] capacity-overflow
+        flags."""
+        W = self.n_workers
+        sharded = NamedSharding(self.mesh, PS(WORKER_AXIS))
+
+        def put(a):
+            return jax.device_put(a, sharded)  # device-ok: mesh-sharded placement
+
+        return (tuple(put(np.zeros((W, cap + 1), dt)) for dt in dtypes),
+                tuple(put(np.zeros((W, cap + 1), bool)) for _ in dtypes),
+                put(np.zeros((W,), np.int64)),
+                put(np.zeros((W,), bool)),
+                put(np.zeros((W,), bool)))
+
+    def _slim_shards(self, state, counts, site: str):
+        """Trim carried [W, cap + 1] receive buffers to the smallest pow2 cover
+        of the largest shard and derive per-row validity from the cursors —
+        ONE dispatch, outputs stay device-sharded for the consumer."""
+        nmax = max(max(counts), 1)
+        nmax_p2 = 1 << (nmax - 1).bit_length()
+
+        @partial(shard_map, mesh=self.mesh, in_specs=(PS(WORKER_AXIS),) * 3,
+                 out_specs=PS(WORKER_AXIS))
+        def slim(bufs_g, nbufs_g, cursor_g):
+            cur = cursor_g[0]
+            cols = tuple(b[0][:nmax_p2] for b in bufs_g)
+            nulls = tuple(b[0][:nmax_p2] for b in nbufs_g)
+            valid = jnp.arange(nmax_p2, dtype=cur.dtype) < cur
+            return (tuple(c[None] for c in cols),
+                    tuple(m[None] for m in nulls), valid[None])
+
+        return _jit(slim, site=site)(state[0], state[1], state[2])
+
+    def _exchange_collect_device(self, stream: _DStream, pid_fn, route_aux,
+                                 bucket_of):
+        """The tentpole: route AND receive inside one shard_map program.  Each
+        batch bucketizes + all-to-alls as before, then ``append_rows`` packs
+        the received lanes into carried [W, cap + 1] device buffers at the
+        write cursor — the same [W, ...] carry discipline as the agg path's
+        group tables.  Host traffic per RUN (not per batch) is one scalar
+        pull of cursors + overflow flags; receive-capacity overflow grows cap
+        4x and re-runs (rows past cap collapsed into the drop sink, so no
+        partial state ever leaks), ladder overflow returns None exactly like
+        the host spool."""
+        mesh, W = self.mesh, self.n_workers
+        sharded = NamedSharding(mesh, PS(WORKER_AXIS))
+        dtypes = [np.dtype(f.type.dtype) for f in stream.schema.fields]
+        cap = self._recv_capacity(stream)
+        while True:
+            state = self._recv_state_init(cap, dtypes)
+
+            @partial(shard_map, mesh=mesh,
+                     in_specs=(PS(WORKER_AXIS), PS(WORKER_AXIS),
+                               stream.aux_specs, PS()),
+                     out_specs=PS(WORKER_AXIS))
+            def step(state_g, lo_g, aux, route_aux, stream=stream):
+                bufs = tuple(b[0] for b in state_g[0])
+                nbufs = tuple(b[0] for b in state_g[1])
+                cursor = state_g[2][0]
+                lad_of, recv_of = state_g[3][0], state_g[4][0]
+                cols, nulls, valid, of = _stream_batch(stream, lo_g, aux)
+                pid = pid_fn(cols, nulls, valid, route_aux)
+                rcols, rnulls, rvalid, r_of = _route_rows(
+                    tuple(cols), tuple(nulls), valid, pid, W,
+                    bucket_of(valid.shape[0]), WORKER_AXIS)
+                # cast to the schema dtypes the buffers were allocated at
+                # (same cast _stack_shards applies on the host path)
+                rcols = tuple(c.astype(dt) for c, dt in zip(rcols, dtypes))
+                rnulls = tuple(jnp.zeros(c.shape, bool) if m is None else m
+                               for c, m in zip(rcols, rnulls))
+                new, ncur, b_of = append_rows(bufs + nbufs, cursor,
+                                              rcols + rnulls, rvalid)
+                k = len(bufs)
+                return (tuple(b[None] for b in new[:k]),
+                        tuple(b[None] for b in new[k:]),
+                        ncur[None], (lad_of | of | r_of)[None],
+                        (recv_of | b_of)[None])
+
+            step = _jit(step, site="dist.exchange.route")
+            for lo in stream.scan_lo_batches:
+                _exchange_fault("exchange_write", "dist.exchange.route")
+                with maybe_span("exchange.route"):
+                    state = step(state, jax.device_put(lo, sharded),  # device-ok: mesh-sharded placement
+                                 stream.aux, route_aux)
+            cursor, lad_of, recv_of = _host(
+                [state[2], state[3], state[4]], site="dist.exchange.flags")
+            if bool(np.any(lad_of)):
+                return None  # exchange/expansion bucket overflow: ladder retry
+            if not bool(np.any(recv_of)):
+                break
+            cap *= 4
+            if cap > (1 << 28):
+                return None  # pathological skew: ladder / local fallback
+        counts = [int(c) for c in cursor]
+        _exchange_fault("exchange_read", "dist.exchange.read")
+        cols_g, nulls_g, valid_g = self._slim_shards(state, counts,
+                                                     "dist.exchange.slim")
+        return cols_g, nulls_g, valid_g, counts
 
     # ---------------------------------------------------------------- topN
     def _run_topn(self, stream: _DStream, sort_keys, count: int):
@@ -1377,10 +1621,9 @@ class DistributedExecutor:
         cols_np = [c.reshape(-1) for c in got[:nc]]
         nulls_np = [m.reshape(-1) for m in got[nc:nc + len(state[1])]]
         valid_np = got[-2].reshape(-1)
-        page = Page(stream.schema,
-                    tuple(jnp.asarray(c) for c in cols_np),
-                    tuple(jnp.asarray(m) if m.any() else None for m in nulls_np),
-                    jnp.asarray(valid_np))
+        page = _page_to_device(Page(
+            stream.schema, tuple(cols_np),
+            tuple(m if m.any() else None for m in nulls_np), valid_np))
         return (_topn_page(page, sort_keys, count, stream.dicts),
                 stream.dicts), oflow
 
@@ -1451,26 +1694,62 @@ class DistributedExecutor:
             if bool(np.any(_host([of_acc],
                                  site="dist.agg.overflow")[0])):
                 return None, True  # exchange bucket overflow: ladder retry
-            merged = self._merge_states(state, key_types, acc_specs, merge_kinds, capacity)
-            of2 = _host([merged.overflow, state.overflow],
+            merged, nocc_g = self._merge_states(state, key_types, acc_specs,
+                                                merge_kinds, capacity)
+            of2 = _host([merged.overflow, state.overflow, nocc_g],
                         site="dist.agg.overflow")
             overflow = bool(np.any(of2[0])) or bool(np.any(of2[1]))
             if not overflow or capacity >= MAX_GROUP_CAPACITY:
                 break
             capacity *= 4
 
-        # concat per-worker final partitions on host
-        got = _host([merged.table] + list(merged.key_cols)
-                    + list(merged.accs),
-                    site="dist.agg.groups")  # one batched table pull
-        table_np = got[0]  # [W, C+1]
-        occ = table_np[:, :capacity] != EMPTY_KEY
         nk = len(merged.key_cols)
-        key_cols = [np.concatenate([k[w, :capacity][occ[w]] for w in range(W)])
-                    for k in got[1:1 + nk]]
-        acc_cols = [np.concatenate([a[w, :capacity][occ[w]] for w in range(W)])
-                    for a in got[1 + nk:]]
-        fin_cols, fin_nulls = _finalize_aggs(node.aggs, acc_cols, occ.sum())
+        _exchange_fault("exchange_read", "dist.agg.groups")
+        if self.device_exchange:
+            # compact occupied groups ON DEVICE: the final pull is occupancy-
+            # sized (live keys + accumulators) instead of the full
+            # [W, capacity] tables — the bulk of q3/q9/q18's warm exchange
+            # bytes on the host-spool path.  compact_rows preserves slot
+            # order, so the concat below is byte-identical to the host
+            # boolean-mask indexing it replaces.
+            nocc = of2[2]  # [W] per-worker live-group counts
+            out_cap = 1 << (max(int(nocc.max()), 1) - 1).bit_length()
+
+            @partial(shard_map, mesh=mesh, in_specs=PS(WORKER_AXIS),
+                     out_specs=PS(WORKER_AXIS))
+            def compact_groups(state_g):
+                st = jax.tree.map(lambda x: x[0], state_g,
+                                  is_leaf=lambda x: x is None)
+                C = st.capacity
+                occ = st.table[:C] != EMPTY_KEY
+                packed, _ = compact_rows(
+                    tuple(k[:C] for k in st.key_cols)
+                    + tuple(a[:C] for a in st.accs), occ, out_cap)
+                return tuple(p[None] for p in packed)
+
+            got = _host(list(_jit(compact_groups,
+                                  site="dist.agg.compact")(merged)),
+                        site="dist.agg.groups")
+            key_cols = [np.concatenate([k[w][:nocc[w]] for w in range(W)])
+                        for k in got[:nk]]
+            acc_cols = [np.concatenate([a[w][:nocc[w]] for w in range(W)])
+                        for a in got[nk:]]
+            n_groups = int(nocc.sum())
+        else:
+            # concat per-worker final partitions on host (full-table pull)
+            got = _host([merged.table] + list(merged.key_cols)
+                        + list(merged.accs),
+                        site="dist.agg.groups")  # one batched table pull
+            table_np = got[0]  # [W, C+1]
+            occ = table_np[:, :capacity] != EMPTY_KEY
+            key_cols = [np.concatenate([k[w, :capacity][occ[w]]
+                                        for w in range(W)])
+                        for k in got[1:1 + nk]]
+            acc_cols = [np.concatenate([a[w, :capacity][occ[w]]
+                                        for w in range(W)])
+                        for a in got[1 + nk:]]
+            n_groups = occ.sum()
+        fin_cols, fin_nulls = _finalize_aggs(node.aggs, acc_cols, n_groups)
         out_cols = key_cols + fin_cols
         # host output (exact wide-decimal columns must never reach the device)
         arrays = [np.asarray(c) for c in out_cols]  # host-ok: post-_host finalize
@@ -1492,7 +1771,10 @@ class DistributedExecutor:
         return jax.tree.map(tile, local, is_leaf=lambda x: x is None)
 
     def _merge_states(self, state, key_types, acc_specs, merge_kinds, capacity):
-        """Hash-exchange group entries across workers and re-insert (final aggregation)."""
+        """Hash-exchange group entries across workers and re-insert (final
+        aggregation).  Returns (merged state, [W] live-group counts) — the
+        counts ride the overflow flag pull the driver already pays, sizing
+        the device-side group compaction without an extra sync."""
         W = self.n_workers
         # worst case: every local group routes to one worker.  Use the ACTUAL
         # (pow2-rounded) table capacity, not the requested one — bucketize
@@ -1520,9 +1802,13 @@ class DistributedExecutor:
                 fresh, rkeys, key_types, recv_valid,
                 [(a, None) for a in raccs], merge_kinds)
             merged = dataclasses.replace(merged, overflow=merged.overflow | state.overflow)
-            return jax.tree.map(lambda x: x[None], merged, is_leaf=lambda x: x is None)
+            nocc = jnp.sum(merged.table[:C] != EMPTY_KEY, dtype=jnp.int64)
+            return (jax.tree.map(lambda x: x[None], merged,
+                                 is_leaf=lambda x: x is None), nocc[None])
 
-        return _jit(merge)(state)
+        _exchange_fault("exchange_write", "dist.agg.merge")
+        with maybe_span("exchange.merge"):
+            return _jit(merge)(state)
 
     def _run_global_aggregate(self, node, stream: _DStream):
         """Ungrouped aggregation: per-worker jnp reductions + psum/pmin/pmax across the
@@ -1608,9 +1894,16 @@ class DistributedExecutor:
 
     # ---------------------------------------------------------------- materialize
     def _materialize_dstream(self, stream: _DStream):
-        """Run a streaming-only fragment and concat per-worker results on the host."""
+        """Run a streaming-only fragment.  Device-resident by default: batch
+        outputs append into carried [W, cap] device buffers (no routing — each
+        worker keeps its own rows) and the page assembles from device shards;
+        ``TRINO_TPU_DEVICE_EXCHANGE=0`` restores the per-batch host spool."""
         mesh = self.mesh
         sharded = NamedSharding(mesh, PS(WORKER_AXIS))
+        fields = stream.schema.fields
+        if (self.device_exchange and len(stream.scan_lo_batches)
+                and not any(np.dtype(f.type.dtype) == object for f in fields)):
+            return self._materialize_dstream_device(stream)
 
         @partial(shard_map, mesh=mesh, in_specs=(PS(WORKER_AXIS), stream.aux_specs),
                  out_specs=PS(WORKER_AXIS))
@@ -1638,9 +1931,67 @@ class DistributedExecutor:
             parts_nulls.append([n.reshape(-1)[v]
                                 for n in got[len(cols):len(cols) + len(nulls)]])
         ncols = len(stream.schema.fields)
-        cols = tuple(jnp.asarray(np.concatenate([p[i] for p in parts_cols]))
+        cols = tuple(np.concatenate([p[i] for p in parts_cols])
                      for i in range(ncols))
         nulls_np = [np.concatenate([p[i] for p in parts_nulls]) for i in range(ncols)]
-        nulls = tuple(jnp.asarray(n) if n.any() else None for n in nulls_np)
-        page = Page(stream.schema, cols, nulls, None)
+        nulls = tuple(n if n.any() else None for n in nulls_np)
+        # staged, counted, injectable H2D — not a bare jnp.asarray re-upload
+        page = _page_to_device(Page(stream.schema, cols, nulls, None))
+        return (page, stream.dicts), False
+
+    def _materialize_dstream_device(self, stream: _DStream):
+        """Device-resident materialize: the same carried receive-buffer state
+        as ``_exchange_collect_device`` minus the routing — each worker's
+        batch output packs (``append_rows``) into its own shard, only scalar
+        cursor/overflow flags sync per run, and the final page assembles on
+        device via ``_page_from_shards``."""
+        mesh, W = self.mesh, self.n_workers
+        sharded = NamedSharding(mesh, PS(WORKER_AXIS))
+        dtypes = [np.dtype(f.type.dtype) for f in stream.schema.fields]
+        cap = self._recv_capacity(stream)
+        while True:
+            state = self._recv_state_init(cap, dtypes)
+
+            @partial(shard_map, mesh=mesh,
+                     in_specs=(PS(WORKER_AXIS), PS(WORKER_AXIS),
+                               stream.aux_specs),
+                     out_specs=PS(WORKER_AXIS))
+            def run(state_g, lo_g, aux, stream=stream):
+                bufs = tuple(b[0] for b in state_g[0])
+                nbufs = tuple(b[0] for b in state_g[1])
+                cursor = state_g[2][0]
+                lad_of, recv_of = state_g[3][0], state_g[4][0]
+                cols, nulls, valid, of = _stream_batch(stream, lo_g, aux)
+                cols = tuple(c.astype(dt) for c, dt in zip(cols, dtypes))
+                nulls = tuple(jnp.zeros(c.shape, bool) if m is None else m
+                              for c, m in zip(cols, nulls))
+                new, ncur, b_of = append_rows(bufs + nbufs, cursor,
+                                              cols + nulls, valid)
+                k = len(bufs)
+                return (tuple(b[None] for b in new[:k]),
+                        tuple(b[None] for b in new[k:]),
+                        ncur[None], (lad_of | of)[None],
+                        (recv_of | b_of)[None])
+
+            run = _jit(run, site="dist.stream.route")
+            for lo in stream.scan_lo_batches:
+                state = run(state, jax.device_put(lo, sharded), stream.aux)  # device-ok: mesh-sharded placement
+            cursor, lad_of, recv_of = _host(
+                [state[2], state[3], state[4]], site="dist.stream.flags")
+            if bool(np.any(lad_of)):
+                return None, True  # exchange bucket overflow: ladder retry
+            if not bool(np.any(recv_of)):
+                break
+            cap *= 4
+            if cap > (1 << 28):
+                return None, True
+        counts = [int(c) for c in cursor]
+        if sum(counts) == 0:
+            page = Page(stream.schema,
+                        tuple(jnp.zeros((0,), dt) for dt in dtypes),
+                        tuple(None for _ in dtypes), None)
+            return (page, stream.dicts), False
+        cols_g, nulls_g, valid_g = self._slim_shards(state, counts,
+                                                     "dist.stream.slim")
+        page = _page_from_shards(stream.schema, cols_g, nulls_g, counts)
         return (page, stream.dicts), False

@@ -162,6 +162,105 @@ def run_result_scenario(engine, sql, session, baseline_sig, name, spec,
     return rec
 
 
+# -- distributed-exchange matrix (round 18: the device-resident mesh path) ----
+#
+# The exchange_write/exchange_read fault points used to fire only on the HTTP
+# SpoolingExchange; the mesh exchange (exec/distributed.py) now reports to the
+# same points at its dist.* sites.  The mesh contract is stricter than HTTP's:
+# rows live in carried device buffers inside one shard_map program, so a
+# RETURNED action (drop/deny) cannot silently lose or defer them — every
+# returned action raises typed (InjectedFaultError), and only the non-raising
+# actions (delay) are recoverable.  (name, query, spec, kind): "window" routes
+# every orders row through _exchange_collect (dist.exchange.route/.read),
+# "agg" takes the final-aggregation merge exchange (dist.agg.merge/.groups);
+# "recover" pins byte-identity vs the undistributed baseline, "fail" pins the
+# typed error; every scenario ends with the standard leak check + a
+# fault-free rerun.
+DIST_SCENARIOS = [
+    ("dist-route-delay", "window",
+     "point=exchange_write,site=dist.*,action=delay,s=0.001,every=1",
+     "recover"),
+    ("dist-route-error", "window",
+     "point=exchange_write,site=dist.exchange.route,action=error,nth=1",
+     "fail"),
+    ("dist-route-drop", "window",
+     "point=exchange_write,site=dist.exchange.route,action=drop,nth=1",
+     "fail"),
+    ("dist-read-error", "window",
+     "point=exchange_read,site=dist.exchange.read,action=error,nth=1",
+     "fail"),
+    ("dist-merge-deny", "agg",
+     "point=exchange_write,site=dist.agg.merge,action=deny,nth=1", "fail"),
+    ("dist-groups-error", "agg",
+     "point=exchange_read,site=dist.agg.groups,action=error,nth=1", "fail"),
+]
+
+# the distributed-exchange queries: a partitioned window (the
+# _exchange_collect receive-buffer path) and a distributed group-by (the
+# _merge_states hash exchange + compacted groups read)
+DIST_QUERIES = {
+    "window": """
+        select o_custkey, o_orderkey,
+               row_number() over (partition by o_custkey
+                   order by o_totalprice desc, o_orderkey) rk
+        from orders order by o_custkey, o_orderkey limit 29""",
+    "agg": """
+        select o_custkey, count(*) n, sum(o_totalprice) s from orders
+        group by o_custkey order by n desc, o_custkey limit 17""",
+}
+
+
+def run_dist_scenario(engine, sql, session, mesh, baseline_sig, name, spec,
+                      kind) -> dict:
+    """One distributed-exchange chaos scenario: arm ``spec``, run ``sql`` on
+    the worker mesh, pin the outcome (byte-identity for "recover", the typed
+    error for "fail"), at least one fire, the standard leak check, and a
+    fault-free distributed rerun.  Returns {"ok": bool, ...} — shared by
+    tests/test_chaos.py and scripts/chaos.py."""
+    from . import faults
+    from .faults import InjectedFaultError
+
+    rec = {"scenario": name, "kind": kind}
+    try:
+        with faults.injected(spec) as plan:
+            if kind == "fail":
+                try:
+                    engine.execute_sql(sql, session, distributed=True,
+                                       mesh=mesh)
+                    rec["ok"] = False
+                    rec["detail"] = "no error raised"
+                except InjectedFaultError as e:
+                    rec["ok"] = True
+                    rec["error_type"] = type(e).__name__
+            else:
+                got = result_signature(engine.execute_sql(
+                    sql, session, distributed=True, mesh=mesh))
+                rec["ok"] = got == baseline_sig
+                if not rec["ok"]:
+                    rec["detail"] = "result diverged"
+        rec["fires"] = plan.total_fires()
+        if rec["fires"] < 1:
+            rec["ok"] = False
+            rec["detail"] = "scenario never fired"
+        leaks = leak_report(engine)
+        if leaks:
+            rec["ok"] = False
+            rec["leaks"] = leaks
+        if rec.get("ok"):
+            # fault-free rerun: the raised exchange left no partial carried
+            # state behind (executors are per-statement; buffers die with
+            # the shard_map program)
+            again = result_signature(engine.execute_sql(
+                sql, session, distributed=True, mesh=mesh))
+            if again != baseline_sig:
+                rec["ok"] = False
+                rec["detail"] = "post-fault rerun diverged"
+    except Exception as e:  # scenario harness failure
+        rec["ok"] = False
+        rec["detail"] = f"{type(e).__name__}: {e}"
+    return rec
+
+
 # -- memory-pressure matrix (round 11: the tiered-spill ladder) ---------------
 #
 # Each scenario runs the plan on a FRESH tiny-budget executor whose pool

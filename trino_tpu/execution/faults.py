@@ -60,8 +60,15 @@ Injection points (the ``point`` vocabulary)::
                    (sites: page.<table> | build | result)
     cache_checkout DeviceBufferPool.get_page/get_build/get_result
                    (sites: page.<table> | build | result)
-    exchange_write exec/fte.SpoolingExchange.commit
-    exchange_read  exec/fte.SpoolingExchange.read
+    exchange_write exec/fte.SpoolingExchange.commit; mesh exchange route/merge
+                   steps (exec/distributed._exchange_fault — sites
+                   dist.exchange.route, dist.agg.merge,
+                   dist.join.build_exchange)
+    exchange_read  exec/fte.SpoolingExchange.read; mesh exchange consumer
+                   boundary (sites dist.exchange.read, dist.agg.groups).
+                   On the mesh any RETURNED action (drop/deny) raises typed:
+                   an all-to-all is one SPMD program, it cannot drop a
+                   commit or defer a reader
     task           server/cluster worker task body
     reserve        memory.MemoryPool.try_reserve
     spill_write    exec/spill tier admission/write (site spill.hbm/host/disk)

@@ -1454,6 +1454,11 @@ _SPAN_BUCKETS = {
     "exchange.read": "exchange_wait",
     "exchange.stream": "exchange_wait",
     "exchange.write": "exchange_wait",
+    # round 18: the mesh exchange (exec/distributed.py) opens these around its
+    # shard_map route/merge steps, so distributed statements attribute
+    # exchange time too (before, only the HTTP SpoolingExchange path did)
+    "exchange.route": "exchange_wait",
+    "exchange.merge": "exchange_wait",
 }
 
 # slice-attribution priority, highest first: when spans overlap (background

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["SPAN_BITS", "ArrayData", "MapData", "pack_span", "span_start",
-           "span_len", "encode_arrays", "compact_rows"]
+           "span_len", "encode_arrays", "compact_rows", "append_rows"]
 
 SPAN_BITS = 24  # max 16M elements per array; 2^39 heap rows
 _LEN_MASK = (1 << SPAN_BITS) - 1
@@ -162,6 +162,30 @@ def compact_rows(arrays, valid, out_len: int):
         else jnp.zeros((out_len + 1,), a.dtype).at[dst].set(a)[:out_len]
         for a in arrays)
     return packed, jnp.sum(valid)
+
+
+def append_rows(bufs, cursor, arrays, valid):
+    """Masked append into fixed-capacity receive buffers — the device-resident
+    exchange's accumulation step.  ``bufs[i]`` is a [cap + 1] buffer whose last
+    slot is a drop sink; live lanes of ``arrays`` (compacted via
+    ``compact_rows``, so arrival order is preserved) land at
+    ``cursor .. cursor + count - 1``.  Rows past ``cap`` collapse into the drop
+    sink — slots below the cursor are never corrupted, the overflow flag is the
+    only casualty — so the driver can discard the run and retry at a bigger
+    capacity, exactly like the exchange bucket ladder.  ``arrays`` must be
+    all-populated (callers fill absent null masks with zeros: buffer identity
+    across batches needs a uniform pytree).  Returns (new_bufs, new_cursor,
+    overflowed)."""
+    packed, cnt = compact_rows(tuple(arrays), valid, valid.shape[0])
+    cap = bufs[0].shape[0] - 1
+    idx = jnp.arange(valid.shape[0], dtype=cursor.dtype)
+    # live packed lanes (idx < cnt) write sequentially from the cursor; dead
+    # lanes and overflow lanes route to the drop sink at cap.  Destinations
+    # below cap are unique, so last-wins scatter is exact.
+    dst = jnp.where(idx < cnt, jnp.minimum(cursor + idx, cap), cap)
+    new_bufs = tuple(b.at[dst].set(p) for b, p in zip(bufs, packed))
+    new_cursor = cursor + cnt
+    return new_bufs, new_cursor, new_cursor > cap
 
 
 def unnest_indices(lens, total: int):
