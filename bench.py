@@ -284,6 +284,11 @@ CACHE_COUNTERS = ("page_cache_hits", "page_cache_misses",
 # suite pins (warm compiles == 0), so the diff shows it without verdicting
 COMPILE_COUNTERS = ("compiles", "compile_s",
                     "cold_compiles", "cold_compile_s")
+# round 19: adaptive decisions, diffed for VISIBILITY, never flagged — a
+# replan appearing between captures is the advisor doing its job (history
+# accumulated), not a regression; the warm-path cost of a BAD correction
+# shows up in the flagged budget counters above, which is where it belongs
+ADAPTIVE_COUNTERS = ("adaptive_replans", "adaptive_holds")
 
 
 def _baseline_diff(base_pq: dict, now_pq: dict) -> dict:
@@ -315,7 +320,7 @@ def _baseline_diff(base_pq: dict, now_pq: dict) -> dict:
             d[k] = {"base": bv, "now": nv}
             if nv > bv:
                 flags.append(f"{k} {bv} -> {nv}")
-        for k in CACHE_COUNTERS + COMPILE_COUNTERS:
+        for k in CACHE_COUNTERS + COMPILE_COUNTERS + ADAPTIVE_COUNTERS:
             bv, nv = b.get(k), n.get(k)
             if bv is None and nv is None:
                 continue

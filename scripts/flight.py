@@ -13,6 +13,7 @@ hours later (the gap scripts/tpu_watch.sh has papered over with hand-rolled
     python scripts/flight.py DIR --json          # every record, JSON lines
     python scripts/flight.py DIR --stalls        # stall events only
     python scripts/flight.py DIR --compiles      # per-statement compile events
+    python scripts/flight.py DIR --adaptive      # per-statement plan decisions
 
 Summary columns: query id, state, wall, dispatch/byte counters, the compile
 census (count + seconds — round 17), and the top wall-breakdown bucket —
@@ -105,6 +106,25 @@ def _print_compiles(recs) -> None:
                   f"sig: {(ev.get('signature') or '')[:70]}")
 
 
+def _print_adaptive(recs) -> None:
+    """--adaptive detail: the advisor decision each statement ran under
+    (round 19), from the record's embedded decision dict — verdict,
+    win-vs-price reasons, frozen corrections.  Statements the advisor had
+    no opinion on carry no field and are skipped."""
+    for rec in recs:
+        if rec.get("kind") != "query" or not rec.get("adaptive"):
+            continue
+        dec = rec["adaptive"]
+        win, price = dec.get("predicted_win_s"), dec.get("compile_price_s")
+        arith = "" if win is None else (
+            f"  win {win:.4f}s x {dec.get('horizon', 0):g} vs "
+            + (f"price {price:.4f}s" if price is not None else "unknown price"))
+        print(f"{rec.get('query_id') or '?'}: {dec.get('verdict', '?')}"
+              f"{arith}")
+        for r in (dec.get("reasons") or []):
+            print(f"  {r}")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("dir", help="flight directory (TRINO_TPU_FLIGHT_DIR)")
@@ -117,6 +137,10 @@ def main(argv=None):
     ap.add_argument("--compiles", action="store_true",
                     help="per-statement compile events (site, signature, "
                          "duration) from the embedded census")
+    ap.add_argument("--adaptive", action="store_true",
+                    help="per-statement adaptive decisions (verdict, "
+                         "win-vs-price reasons, corrections) from the "
+                         "embedded advisor decision")
     args = ap.parse_args(argv)
     recs = read_flight_dir(args.dir)
     if not recs:
@@ -131,6 +155,9 @@ def main(argv=None):
         return 0
     if args.compiles:
         _print_compiles(recs)
+        return 0
+    if args.adaptive:
+        _print_adaptive(recs)
         return 0
     if args.stalls:
         recs = [r for r in recs if r.get("kind") == "stall"]

@@ -111,6 +111,15 @@ def main():
                          "pulls, and the WARM row must show 0 compiles "
                          "(the recompile-regression guard "
                          "tests/test_query_budgets.py pins)")
+    ap.add_argument("--adaptive", action="store_true",
+                    help="print the adaptive advisor's per-statement "
+                         "decision trace after the runs (state, frozen "
+                         "corrections, win-vs-price reasons) — the warm run "
+                         "is execution 2, so a material misestimate recorded "
+                         "cold is exactly what the advisor judges here.  "
+                         "Consult/observe are host-only: the counters "
+                         "printed alongside are unchanged by the advisor "
+                         "(the budget suite pins that)")
     ap.add_argument("--history", action="store_true",
                     help="print each warm query's est-vs-actual table from "
                          "the plan-actuals history (node path -> CBO "
@@ -210,6 +219,8 @@ def main():
             print(json.dumps({"query": name, "sf": sf,
                               "split_rows": split_rows, **trace(session, name)}),
                   flush=True)
+        if args.adaptive:
+            _print_adaptive(engine)
         return
 
     # side-by-side: batch=1 (exact per-split) vs --batch N.  Separate sessions:
@@ -230,6 +241,25 @@ def main():
               f"({wn['coalesced_splits']} splits coalesced), "
               f"bytes {w1['host_bytes_pulled']} -> {wn['host_bytes_pulled']}",
               flush=True)
+
+
+def _print_adaptive(engine):
+    """Decision trace (--adaptive): one block per statement the advisor has
+    state for — what it decided and the win-vs-price arithmetic behind it."""
+    adv = getattr(engine, "adaptive_advisor", None)
+    info = adv.info() if adv is not None else {}
+    print(f"# adaptive decisions ({info.get('replans_total', 0)} replans, "
+          f"{info.get('holds_total', 0)} holds, "
+          f"{info.get('demotions_total', 0)} demotions, "
+          f"{info.get('confirms_total', 0)} confirms):", flush=True)
+    for row in (adv.decision_trace() if adv is not None else []):
+        sql = " ".join((row.get("sql") or "?").split())
+        if len(sql) > 72:
+            sql = sql[:69] + "..."
+        verdict = row.get("last_verdict") or "no verdict yet"
+        print(f"#   [{row['state']:<9}] {verdict:<7} {sql}", flush=True)
+        for r in (row.get("reasons") or []):
+            print(f"#       {r}", flush=True)
 
 
 def _trace_distributed(engine, sf, split_rows, names, QUERIES, show_sites):

@@ -340,6 +340,51 @@ def test_pallas_call_plumbs_interpret(path):
           "the kernel body runs under the CPU-mesh parity tests")
 
 
+SQL_DIR = PKG_DIR / "sql"
+ADAPTIVE_MARKER = "# adaptive-ok"
+
+
+def _adaptive_read_hits(path):
+    """``.plan_history`` / ``.compile_log`` attribute reads in exec/ or sql/
+    missing a ``# adaptive-ok: <reason>`` annotation.  Round-19 rule: the
+    AdaptiveAdvisor (execution/adaptive.py) is THE chokepoint where recorded
+    history and compile costs turn into plan decisions — an executor or
+    planner module reading the stores directly grows a second, unaccounted
+    decision path (no win-vs-price gate, no probation/demotion, no
+    counters/EXPLAIN/flight visibility)."""
+    src = path.read_text()
+    lines = src.splitlines()
+    hits = []
+    for node in ast.walk(ast.parse(src)):
+        if isinstance(node, ast.Attribute) \
+                and node.attr in ("plan_history", "compile_log"):
+            if ADAPTIVE_MARKER not in lines[node.lineno - 1]:
+                hits.append((node.lineno, node.attr))
+    return hits
+
+
+def _decision_input_files():
+    files = sorted(list(EXEC_DIR.glob("*.py")) + list(SQL_DIR.rglob("*.py")))
+    assert files, (EXEC_DIR, SQL_DIR)
+    return files
+
+
+@pytest.mark.parametrize("path", _decision_input_files(),
+                         ids=lambda p: str(p.relative_to(PKG_DIR)))
+def test_history_reads_route_through_advisor(path):
+    """Round-19 rule: nothing under trino_tpu/exec/ or trino_tpu/sql/ reads
+    ``plan_history``/``compile_log`` directly — decision logic lives in
+    execution/adaptive.py (the engine consults it at admission; the planner
+    consumes only the emitted correction facts).  Annotate
+    '# adaptive-ok: <reason>' for a deliberate, non-decision read."""
+    hits = _adaptive_read_hits(path)
+    assert not hits, (
+        f"{path.relative_to(PKG_DIR)}: direct decision-input read at "
+        + ", ".join(f"line {ln} (.{attr})" for ln, attr in hits)
+        + " — route the decision through execution.adaptive.AdaptiveAdvisor,"
+          " or annotate '# adaptive-ok: <reason>'")
+
+
 def test_lint_catches_violations(tmp_path):
     """The lint must actually flag what it claims to (guards against the
     visitor silently matching nothing after a refactor)."""
@@ -416,3 +461,14 @@ def test_lint_catches_violations(tmp_path):
         "    return pallas_call(lambda r, o: None, out_shape=x,\n"
         "                       interpret=interp)(x)\n")
     assert _pallas_call_hits(kern) == [4, 9]
+    # the round-19 rule flags un-annotated plan_history/compile_log reads
+    # and accepts the adaptive-ok marker
+    adap = tmp_path / "adap.py"
+    adap.write_text(
+        "def f(engine):\n"
+        "    h = engine.plan_history\n"                  # line 2: flagged
+        "    c = engine.compile_log.snapshot()\n"        # line 3: flagged
+        "    h2 = engine.plan_history  # adaptive-ok: test\n"
+        "    return h, c, h2\n")
+    assert _adaptive_read_hits(adap) == \
+        [(2, "plan_history"), (3, "compile_log")]

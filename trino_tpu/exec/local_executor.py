@@ -541,6 +541,16 @@ class LocalExecutor:
         self._node_paths: dict = {}
         self._node_ests: dict = {}
         self._est_cache: dict = {}  # id(root) -> (paths, ests)
+        # compile-time advisory facts for plan-history: id(node) ->
+        # (node, {"splits": n} | {"build_rows": <lazy device count>,
+        # "wall_s": s}).  Scans and join build sides are streaming — the
+        # stats dict never records them — but their observed shapes are
+        # exactly what the adaptive advisor needs (dispatch_batch tuning,
+        # broadcast-vs-partitioned truth).  Facts are static per compiled
+        # stream, so capturing at compile time covers every warm execution;
+        # the strong node ref keeps id() stable (the _stream_cache contract)
+        # and forget_plan sweeps entries with the other id-keyed caches.
+        self._plan_facts: dict = {}
         self._fp_cache: dict = {}  # id(root) -> structural fingerprint —
         # _plan_fingerprint is a content-based string walk; memoized so the
         # per-statement history record costs a dict lookup on warm plans
@@ -714,7 +724,7 @@ class LocalExecutor:
             return key in ids
 
         for cache in (self._stream_cache, self._agg_cache, self._est_cache,
-                      self._fp_cache):
+                      self._fp_cache, self._plan_facts):
             # list() snapshots the keys atomically (C-level, GIL-held) so a
             # concurrent query inserting into the same dict cannot raise
             # "dictionary changed size during iteration"; pop() tolerates keys
@@ -1084,6 +1094,10 @@ class LocalExecutor:
                                      site=f"scan.{node.table}"):
                 splits = conn.splits(node.table)
                 sp.attributes["splits"] = len(splits)
+            # advisory fact for the history record: split count is what the
+            # adaptive advisor tunes dispatch_batch K from (host int, static
+            # per compiled stream)
+            self._plan_facts[id(node)] = (node, {"splits": len(splits)})
 
             # cache-aware page source over the prefetch policy the scan needs:
             # HOST_DECODE connectors prefetch+device_put on a background
@@ -1478,7 +1492,7 @@ class LocalExecutor:
                 capacity = max(capacity, min(target, 1 << 24))
         capacity = ceil_pow2(capacity)
         if not self.memory_pool.try_reserve(state_bytes(capacity), "group-by"):
-            return self._run_aggregate_partitioned(node, parts=4)
+            return self._run_aggregate_partitioned(node, parts=node.grace_parts or 4)
         resv = state_bytes(capacity)
         try:
             run = cached_run("hash",
@@ -1494,7 +1508,7 @@ class LocalExecutor:
                 delta = state_bytes(grown) - state_bytes(capacity)
                 if grown > MAX_GROUP_CAPACITY or \
                         not self.memory_pool.try_reserve(delta, "group-by"):
-                    return self._run_aggregate_partitioned(node, parts=4)
+                    return self._run_aggregate_partitioned(node, parts=node.grace_parts or 4)
                 resv += delta
                 capacity = grown
         finally:
@@ -2056,7 +2070,7 @@ class LocalExecutor:
         resv = {"bytes": 0 if cfg is None else state_bytes(cfg.capacity)}
         if cfg is None:
             if not self.memory_pool.try_reserve(state_bytes(capacity), "group-by"):
-                return self._run_aggregate_partitioned(node, parts=4)
+                return self._run_aggregate_partitioned(node, parts=node.grace_parts or 4)
             resv = {"bytes": state_bytes(capacity)}
 
         try:
@@ -2079,7 +2093,7 @@ class LocalExecutor:
                     cfg, resv["bytes"] = None, 0
                     if not self.memory_pool.try_reserve(state_bytes(capacity),
                                                         "group-by"):
-                        return self._run_aggregate_partitioned(node, parts=4)
+                        return self._run_aggregate_partitioned(node, parts=node.grace_parts or 4)
                     resv["bytes"] = state_bytes(capacity)
                     pages_once = stream.pages()
                     continue
@@ -2095,7 +2109,7 @@ class LocalExecutor:
                 # reference's SpillableHashAggregationBuilder)
                 if not bool(state.overflow):
                     break
-                return self._run_aggregate_partitioned(node, parts=4)
+                return self._run_aggregate_partitioned(node, parts=node.grace_parts or 4)
 
             return self._finalize_groups(node, stream, state)
         finally:
@@ -2324,7 +2338,7 @@ class LocalExecutor:
 
         capacity = ceil_pow2(capacity)
         if not self.memory_pool.try_reserve(state_bytes(capacity), "group-by"):
-            return self._run_aggregate_partitioned(node, parts=4)
+            return self._run_aggregate_partitioned(node, parts=node.grace_parts or 4)
         resv = state_bytes(capacity)
         try:
             pages = pages_once
@@ -2343,7 +2357,7 @@ class LocalExecutor:
                 delta = state_bytes(grown) - resv
                 if grown > MAX_GROUP_CAPACITY or \
                         not self.memory_pool.try_reserve(delta, "group-by"):
-                    return self._run_aggregate_partitioned(node, parts=4)
+                    return self._run_aggregate_partitioned(node, parts=node.grace_parts or 4)
                 resv += delta
                 capacity = grown
                 pages = stream.pages()
@@ -2776,8 +2790,22 @@ class LocalExecutor:
                                        site="join.build.cache")
         if cached is not None:
             build_page, build_dicts = cached["page"], cached["dicts"]
+            build_wall = 0.0
         else:
+            import time as _time
+
+            t0 = _time.perf_counter()
             build_page, build_dicts = self._execute_to_page_streamed(node.right)
+            build_wall = _time.perf_counter() - t0
+        # advisory fact: the build side's ACTUAL row count (lazy device
+        # scalar, same deferred-sum pattern as _record — it joins the history
+        # collector's one batched value read, zero extra pulls).  Build
+        # children are streaming, so nothing else records them, and their
+        # est-vs-actual is precisely the broadcast-vs-partitioned input the
+        # adaptive advisor needs.
+        self._plan_facts[id(node.right)] = (node.right, {
+            "build_rows": jnp.sum(build_page.valid_mask(), dtype=jnp.int64),
+            "wall_s": build_wall})
         probe_stream = self._compile_stream(node.left)
         build_key_types = tuple(node.right.schema.fields[i].type for i in node.right_keys)
         if node.kind in ("inner", "semi") and node.filter is None:

@@ -221,14 +221,29 @@ def estimate_plan_rows(root, catalogs: dict) -> dict:
 def collect_plan_actuals(plan, stats: dict, boundary: Optional[dict] = None,
                          catalogs: Optional[dict] = None,
                          paths: Optional[dict] = None,
-                         ests: Optional[dict] = None) -> dict:
+                         ests: Optional[dict] = None,
+                         facts: Optional[dict] = None) -> dict:
     """{node_path: one-execution record} from an executor's per-node
     ``stats`` (id(node)-keyed) after a clean completion.  ``paths``/``ests``
     are the maps the executor stamped at ``begin_plan`` time (recomputed here
     only when a driver skipped begin_plan).  Row counts may still live on
     device (the executor defers the sync); they are fetched in ONE batched
-    value read — no new dispatches, no ``_host``-counted pulls."""
-    if not stats:
+    value read — no new dispatches, no ``_host``-counted pulls.
+
+    Each record carries an ``unestimated`` marker — True when the CBO had NO
+    estimate for the node — so a consumer (the adaptive advisor) can tell
+    "CBO was wrong" from "CBO was blind" and never fabricate a correction
+    from a blind node.
+
+    ``facts`` is the executor's compile-time advisory map
+    ({id(node): (node, fact)} — scan split counts, join build-side row
+    counts): nodes the streaming stats never record get SYNTHESIZED records
+    here.  Scan facts carry ``splits`` with ``est_rows=None`` (a splits-only
+    fact has no output-row observation — a real estimate against a zero
+    actual would fabricate a misestimate); build facts carry the measured
+    build rows against the node's real estimate plus a ``build`` marker, the
+    input the broadcast-vs-partitioned decision needs."""
+    if not stats and not facts:
         return {}
     if not paths:
         paths = plan_node_paths(plan)
@@ -237,7 +252,7 @@ def collect_plan_actuals(plan, stats: dict, boundary: Optional[dict] = None,
             if catalogs is not None else {}
     boundary = boundary or {}
     pending: list = []  # (path, record, raw rows value)
-    for nid, s in stats.items():
+    for nid, s in (stats or {}).items():
         # the CURRENT plan's path map is the authority: a pooled executor's
         # stats can hold residue from other plans/fragments (only execute()
         # resets; task bodies pop only their own subtree), and a stale
@@ -251,6 +266,7 @@ def collect_plan_actuals(plan, stats: dict, boundary: Optional[dict] = None,
         rec = {
             "op": s.get("op") or path.partition("#")[0],
             "est_rows": None if est is None else float(est),
+            "unestimated": est is None,
             "actual_rows": 0,
             "wall_s": float(s.get("wall_s", 0.0)),
             "spilled_bytes": int(s.get("spilled_bytes", 0)),
@@ -259,6 +275,26 @@ def collect_plan_actuals(plan, stats: dict, boundary: Optional[dict] = None,
                               + b.get("build_cache_hits", 0)),
         }
         pending.append((path, rec, s.get("rows", 0)))
+    seen = {p for p, _, _ in pending}
+    for nid, (node, fact) in (facts or {}).items():
+        path = paths.get(nid)
+        if path is None or path in seen:
+            continue  # stale fact from another plan, or stats already cover
+        if "splits" in fact:
+            rec = {"op": path.partition("#")[0], "est_rows": None,
+                   "unestimated": True, "actual_rows": 0,
+                   "wall_s": 0.0, "spilled_bytes": 0, "spill_tiers": {},
+                   "cache_hits": 0, "splits": int(fact["splits"])}
+            pending.append((path, rec, 0))
+        elif "build_rows" in fact:
+            est = ests.get(nid)
+            rec = {"op": path.partition("#")[0],
+                   "est_rows": None if est is None else float(est),
+                   "unestimated": est is None, "actual_rows": 0,
+                   "wall_s": float(fact.get("wall_s", 0.0)),
+                   "spilled_bytes": 0, "spill_tiers": {},
+                   "cache_hits": 0, "build": True}
+            pending.append((path, rec, fact["build_rows"]))
     if not pending:
         return {}
     import jax
@@ -289,6 +325,13 @@ def fold_records(dst: dict, path: str, rec: dict) -> None:
         cur["spill_tiers"][t] = cur["spill_tiers"].get(t, 0) + b
     if cur.get("est_rows") is None:
         cur["est_rows"] = rec.get("est_rows")
+    if cur.get("est_rows") is not None:
+        cur["unestimated"] = False
+    if rec.get("splits"):
+        cur["splits"] = max(int(cur.get("splits") or 0),
+                            int(rec["splits"]))
+    if rec.get("build"):
+        cur["build"] = True
     if not cur.get("op"):
         cur["op"] = rec.get("op")
 
@@ -359,7 +402,7 @@ class PlanHistoryStore:
         if node is None:
             node = nodes[path] = {
                 "op": rec.get("op") or path.partition("#")[0],
-                "executions": 0, "est_rows": None,
+                "executions": 0, "est_rows": None, "unestimated": True,
                 "actual_rows": 0, "actual_rows_ewma": float(actual),
                 "wall_s": 0.0, "wall_s_total": 0.0,
                 "spilled_bytes": 0, "spill_tiers": {}, "cache_hits": 0,
@@ -368,6 +411,15 @@ class PlanHistoryStore:
         est = rec.get("est_rows")
         if est is not None:
             node["est_rows"] = float(est)
+        # "CBO was blind" vs "CBO was wrong": the marker clears the moment
+        # ANY execution supplied an estimate (the advisor must never build a
+        # correction from a blind node)
+        node["unestimated"] = node["est_rows"] is None
+        if rec.get("splits"):
+            node["splits"] = max(int(node.get("splits") or 0),
+                                 int(rec["splits"]))
+        if rec.get("build"):
+            node["build"] = True
         node["actual_rows"] = actual
         node["actual_rows_ewma"] = (EWMA_ALPHA * actual
                                     + (1.0 - EWMA_ALPHA)
@@ -413,6 +465,35 @@ class PlanHistoryStore:
                             "node_path": path, **r,
                             "plan_executions": ent["executions"]})
         return out
+
+    def misestimated(self, fingerprint: str,
+                     min_ratio: float = MISESTIMATE_THRESHOLD) -> dict:
+        """Win-prediction query (the adaptive advisor's input): {path: node
+        record} for one plan's nodes whose EWMA-backed misestimate ratio is
+        at or past ``min_ratio`` AND whose estimate was real — ``unestimated``
+        (CBO-blind) nodes never qualify, whatever their actuals."""
+        ent = self.get(fingerprint)
+        if ent is None:
+            return {}
+        return {p: r for p, r in ent["nodes"].items()
+                if r.get("est_rows") is not None
+                and not r.get("unestimated")
+                and float(r.get("misestimate_ratio", 1.0)) >= min_ratio}
+
+    def predicted_win_s(self, fingerprint: str,
+                        min_ratio: float = MISESTIMATE_THRESHOLD,
+                        ratio_cap: float = 10.0) -> float:
+        """Misestimate-scaled fraction of the recorded warm wall: for each
+        qualifying node, its average recorded wall x (1 - 1/min(ratio, cap)).
+        The advisor compares this (amortized over its horizon) against the
+        re-plan's compile price."""
+        win = 0.0
+        for r in self.misestimated(fingerprint, min_ratio).values():
+            execs = max(int(r.get("executions", 1)), 1)
+            ratio = min(float(r.get("misestimate_ratio", 1.0)), ratio_cap)
+            win += (float(r.get("wall_s_total", 0.0)) / execs) \
+                * (1.0 - 1.0 / max(ratio, 1.0))
+        return win
 
     def worst(self, n: int = 5, min_ratio: float = MISESTIMATE_THRESHOLD) \
             -> list:

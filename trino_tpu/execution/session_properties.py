@@ -96,6 +96,12 @@ SYSTEM_SESSION_PROPERTIES = {p.name: p for p in [
                      "budget from TRINO_TPU_RESULT_CACHE).  NON-plan-"
                      "shaping: flipping it never re-plans or re-compiles",
                      "boolean", True),
+    PropertyMetadata("adaptive_execution",
+                     "Let the adaptive advisor (execution/adaptive) divert "
+                     "statements to history-corrected plans (env default "
+                     "TRINO_TPU_ADAPTIVE).  Plan-shaping: rides the "
+                     "plan-cache key, so flipping it escapes (or re-enters) "
+                     "the corrected plan", "boolean", True),
     PropertyMetadata("query_max_memory",
                      "Per-query device memory limit in bytes (0 = node limit "
                      "only; reference: query.max-memory + "

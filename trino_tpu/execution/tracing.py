@@ -224,6 +224,13 @@ class QueryCounters:
     # the recompile-regression guard test_query_budgets pins.
     compiles: int = 0
     compile_s: float = 0.0
+    # round 19: adaptive execution.  A replan means the statement ran a
+    # CORRECTED plan (the advisor's history-backed cardinality/capacity
+    # facts re-planned it); a hold means a material misestimate existed but
+    # the advisor declined — compile price above the predicted win, unknown
+    # price, or a demoted correction cooling down.
+    adaptive_replans: int = 0
+    adaptive_holds: int = 0
     # "<operator>/<site>" -> {"dispatches", "transfers", "bytes"} plus any
     # cache keys the site recorded: the attribution EXPLAIN ANALYZE prints
     # and budget failures dump
@@ -240,7 +247,7 @@ class QueryCounters:
                    "spilled_bytes", "spill_tier_hbm", "spill_tier_host",
                    "spill_tier_disk", "admission_queued",
                    "plan_template_hits", "plan_template_misses",
-                   "compiles")
+                   "compiles", "adaptive_replans", "adaptive_holds")
     _FLOAT_FIELDS = ("compile_s",)
 
     def reset(self) -> None:

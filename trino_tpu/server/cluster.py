@@ -1497,14 +1497,31 @@ class ClusterCoordinator:
                 return self._execute_sql_admitted(sql, sess)
         except BaseException as e:
             state, error = "FAILED", f"{type(e).__name__}: {e}"
+            # a failed CORRECTED execution demotes its correction (same
+            # contract as engine.execute_sql); guarded — bookkeeping never
+            # masks the real error
+            try:
+                ta = self.engine._thread_accounting
+                if getattr(ta, "adaptive_corrected", False):
+                    akey = getattr(ta, "adaptive_key", None)
+                    adv = getattr(self.engine, "adaptive_advisor", None)
+                    if adv is not None and akey is not None:
+                        adv.failed(akey)
+            except Exception:
+                pass
             raise
         finally:
             self._publish_cluster_trace(qid, sql, sess, state, error,
                                         t_created)
 
     def _execute_sql_admitted(self, sql: str, sess):
-        plan = self._cached_plan(sql, sess)
+        plan, adaptive = self._consulted_plan(sql, sess)
         rkey = self.engine._result_cache_key(sql, plan, sess)
+        if rkey is not None and adaptive is not None \
+                and adaptive.get("verdict") == "replan":
+            # corrected results key separately, same contract as the local
+            # path — a demotion must find the uncorrected entry intact
+            rkey = rkey + (("adaptive", adaptive["token"]),)
         epoch = self.engine.buffer_pool.epoch if rkey is not None else None
         if rkey is not None:
             served = self.engine._result_cache_fetch(rkey)
@@ -1543,6 +1560,14 @@ class ClusterCoordinator:
             # executors coalesce the same way (queries serialize on
             # _query_lock, so the per-query stash is race-free)
             self._dispatch_batch = _effective_dispatch_batch(sess)
+            adec = getattr(self.engine._thread_accounting, "adaptive", None)
+            if adec is not None and adec.get("verdict") == "replan":
+                # advisor-tuned coalescing width rides the SAME stash the
+                # session property uses: applied to the local finish and
+                # shipped inside every task request below
+                k = (adec.get("corrections") or {}).get("dispatch_batch")
+                if k:
+                    self._dispatch_batch = int(k)
             local.dispatch_batch = self._dispatch_batch
             from ..engine import _effective_page_cache
 
@@ -2280,15 +2305,63 @@ class ClusterCoordinator:
                                              child_sources, n_readers=1)
         return {"url": url, "task": newtid, "reader": 0}
 
-    def _cached_plan(self, sql: str, sess):
+    def _consulted_plan(self, sql: str, sess):
+        """The adaptive advisor's cluster entry (round 19): consult on the
+        coordinator's own statement key before planning — a frozen "replan"
+        decision compiles and caches the CORRECTED plan under the decision
+        token (corrected fragments then ship through the ordinary pickled-
+        plan dispatch; workers execute what they receive, the decision never
+        rides the task protocol).  Feedback slots on the engine's thread
+        accounting mark the execution for the observe hook inside
+        ``engine._record_plan_history`` — the cluster's clean completions
+        already route through it.  Returns (plan, decision-or-None)."""
+        from ..engine import _normalize_statement, _plan_shape_props
+
+        eng = self.engine
+        # cluster statements bypass engine.execute_sql: clear/claim the
+        # thread slots here (same discipline, one-shot consumers)
+        eng._thread_accounting.adaptive = None
+        eng._thread_accounting.adaptive_key = None
+        eng._thread_accounting.adaptive_corrected = False
+        eng._thread_accounting.history_sql = sql
+        key = (_normalize_statement(sql), sess.catalog, "cluster",
+               sess.user, _plan_shape_props(sess))
+        decision = eng._adaptive_consult(key, sess)
+        if decision is None:
+            eng._adaptive_note_base(key, sess)
+            return self._cached_plan(sql, sess), None
+        eng._thread_accounting.adaptive = decision
+        replan = decision.get("verdict") == "replan"
+        # the engine's execute_sql finally is not on this path: stamp the
+        # decision counter directly on the engine totals
+        field = "adaptive_replans" if replan else "adaptive_holds"
+        with eng._init_lock:
+            setattr(eng.counters_total, field,
+                    getattr(eng.counters_total, field) + 1)
+        if not replan:
+            eng._adaptive_note_base(key, sess)
+            return self._cached_plan(sql, sess), decision
+        eng._thread_accounting.adaptive_key = key
+        eng._thread_accounting.adaptive_corrected = True
+        return self._cached_plan(sql, sess, adaptive=decision), decision
+
+    def _cached_plan(self, sql: str, sess, adaptive=None):
         """Versioned, bounded plan cache keyed by (sql, catalog) — the same
         identity/staleness rules as Engine._cache_lookup (a plan embeds the
-        session catalog's table resolution and dictionary LUTs)."""
+        session catalog's table resolution and dictionary LUTs).
+        ``adaptive``: a frozen advisor "replan" decision — the key extends
+        with the correction token and compilation runs under a session
+        carrying the corrections (corrected and uncorrected plans never
+        collide)."""
         from ..sql.frontend import compile_sql
 
-        from ..engine import _plan_shape_props
+        from ..engine import _plan_shape_props, _session_with_corrections
 
         key = (sql, sess.catalog, sess.user, _plan_shape_props(sess))
+        if adaptive is not None:
+            key = key + (("adaptive", adaptive["token"]),)
+            sess = _session_with_corrections(
+                sess, adaptive.get("corrections") or {})
         with self._lock:
             entry = self._plan_cache.get(key)
             if entry is not None:

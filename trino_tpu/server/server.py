@@ -751,6 +751,32 @@ class CoordinatorServer:
                     f"trino_tpu_misestimated_nodes_total "
                     f"{ph.misestimates_total}",
                 ]
+            # round 19: the adaptive feedback loop — statements diverted to
+            # history-corrected plans, counted holds (material misestimate
+            # existed but the win did not cover the recompile price), and
+            # demoted corrections (regressed or failed on probation)
+            adv = getattr(self.engine, "adaptive_advisor", None)
+            if adv is not None:
+                ai = adv.info()
+                lines += [
+                    "# HELP trino_tpu_adaptive_replans_total Statements "
+                    "diverted to a history-corrected plan by the adaptive "
+                    "advisor.",
+                    "# TYPE trino_tpu_adaptive_replans_total counter",
+                    f"trino_tpu_adaptive_replans_total "
+                    f"{getattr(ct, 'adaptive_replans', 0)}",
+                    "# HELP trino_tpu_adaptive_holds_total Material "
+                    "misestimates the advisor declined to re-plan "
+                    "(win under compile price, or cooling down).",
+                    "# TYPE trino_tpu_adaptive_holds_total counter",
+                    f"trino_tpu_adaptive_holds_total "
+                    f"{getattr(ct, 'adaptive_holds', 0)}",
+                    "# HELP trino_tpu_adaptive_demotions_total Corrections "
+                    "demoted after regressing or failing on probation.",
+                    "# TYPE trino_tpu_adaptive_demotions_total counter",
+                    f"trino_tpu_adaptive_demotions_total "
+                    f"{ai['demotions_total']}",
+                ]
             sites = getattr(ct, "sites", None) or {}
             if sites:
                 lines += ["# HELP trino_tpu_site_dispatches_total Device "

@@ -54,6 +54,13 @@ class _Estimator:
     def rows(self, node) -> Optional[float]:
         return self.estimate(node)[0]
 
+    def set_fact(self, node, rows: float) -> None:
+        """Adaptive-advisor cardinality override: an OBSERVED row count for
+        this node from plan-actuals history — recorded truth, so it is
+        CONFIDENT (it may force a distribution the coefficient-derived guess
+        could only rank)."""
+        self._cache[id(node)] = (float(rows), True)
+
     def estimate(self, node) -> tuple:
         hit = self._cache.get(id(node))
         if hit is None:
@@ -146,17 +153,55 @@ def _decide(node: P.Join, est: _Estimator, props: dict) -> str:
 def resolve_distributions(plan: P.PlanNode, catalogs: dict,
                           props: dict = None) -> P.PlanNode:
     """Rewrite every Join's ``distribution`` from the global cost model
-    (product 1 of AddExchanges)."""
-    est = _Estimator(catalogs)
+    (product 1 of AddExchanges).
 
-    def walk(node):
-        kids = tuple(walk(c) for c in node.children)
+    When the session carries ``_adaptive_corrections`` (the adaptive
+    advisor's frozen facts, keyed by structural node path "<Op>#<chain>" —
+    the plan-history address), this pass is also where they apply:
+
+    - ``rows``: observed row counts become CONFIDENT estimator facts, so the
+      broadcast/partitioned thresholds below re-decide from recorded truth
+      (a corrected Join additionally has ``est_rows`` stamped, making the
+      correction durable in the plan content — and in the structural
+      fingerprint, so corrected plans key separately everywhere);
+    - ``capacity`` / ``grace_parts``: Aggregate hash-table capacity and
+      Grace partition seeds from observed group counts.
+
+    The chain walk here mirrors ``history.plan_node_paths`` (pre-order,
+    child-index chains from root "0") by construction — the corrections'
+    addresses are those paths."""
+    est = _Estimator(catalogs)
+    corr = (props or {}).get("_adaptive_corrections") or {}
+    rows_facts = corr.get("rows") or {}
+    cap_facts = corr.get("capacity") or {}
+    grace_facts = corr.get("grace_parts") or {}
+
+    def walk(node, chain="0"):
+        kids = tuple(walk(c, f"{chain}.{i}")
+                     for i, c in enumerate(node.children))
         if kids != tuple(node.children):
             node = _replace_children(node, kids)
+        path = f"{type(node).__name__}#{chain}"
+        fact = rows_facts.get(path)
+        if isinstance(node, P.Aggregate):
+            cap = int(cap_facts.get(path) or 0)
+            gp = int(grace_facts.get(path) or 0)
+            if (cap and cap != node.capacity) \
+                    or (gp and gp != node.grace_parts):
+                node = dataclasses.replace(
+                    node, capacity=cap or node.capacity,
+                    grace_parts=gp or node.grace_parts)
         if isinstance(node, P.Join):
+            if fact is not None and float(fact) != node.est_rows:
+                node = dataclasses.replace(node, est_rows=float(fact))
             dist = _decide(node, est, props)
             if dist != node.distribution:
                 node = dataclasses.replace(node, distribution=dist)
+        if fact is not None:
+            # children's facts were set when their walk returned, so the
+            # parent's _decide above already saw them; set this node's own
+            # fact LAST — dataclasses.replace minted a new object
+            est.set_fact(node, fact)
         return node
 
     return walk(plan)

@@ -109,6 +109,7 @@ class Aggregate(PlanNode):
     aggs: tuple  # AggSpec...
     schema: Schema  # key fields then agg fields
     capacity: int = 0  # group-table capacity bucket; 0 = planner default
+    grace_parts: int = 0  # Grace-fallback partition seed; 0 = executor default
 
     @property
     def children(self):

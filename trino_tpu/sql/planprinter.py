@@ -14,7 +14,8 @@ __all__ = ["format_plan"]
 
 def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
                 boundary: dict = None, ests: dict = None,
-                paths: dict = None, breakdown: dict = None) -> str:
+                paths: dict = None, breakdown: dict = None,
+                adaptive: dict = None) -> str:
     """``stats``: optional id(node) -> {rows, wall_s} from an EXPLAIN ANALYZE run
     (reference: PlanPrinter's textDistributedPlan with OperatorStats).
     ``counters``: optional per-query device-boundary counters
@@ -33,12 +34,22 @@ def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
     decomposition (execution/tracing.wall_breakdown over the analyze run's
     window) rendered as one "Wall breakdown:" line — where the time went
     (plan / split generation / h2d / device dispatch / host pull / exchange
-    wait / unattributed), not just how much there was."""
+    wait / unattributed), not just how much there was.  ``adaptive``:
+    optional adaptive-advisor decision dict (round 19) rendered as one
+    "Adaptive:" line with the win-vs-price arithmetic and the corrections —
+    why this statement's plan changed, or why the advisor held (no decision
+    = no line, budget-suite regexes unchanged)."""
     lines: list = []
     _fmt(node, lines, 0, stats or {}, boundary or {}, ests or {})
     mis = _misestimate_summary(stats or {}, ests or {}, paths or {})
     if mis:
         lines.append(mis)
+    if adaptive:
+        from ..execution.adaptive import describe_decision
+
+        desc = describe_decision(adaptive)
+        if desc:
+            lines.append(f"Adaptive: {desc}")
     if breakdown:
         from ..execution.tracing import format_wall_breakdown
 
@@ -117,8 +128,9 @@ def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
 def _misestimate_summary(stats: dict, ests: dict, paths: dict) -> str:
     """One "Misestimates:" line naming the worst est-vs-actual offenders
     (ratio >= MISESTIMATE_THRESHOLD, worst first, top 5) — the drift signal
-    an EXPLAIN ANALYZE reader scans for before the adaptive loop exists to
-    consume it.  Empty string when every node is within threshold (non-
+    an EXPLAIN ANALYZE reader scans for, and the input the adaptive advisor
+    (execution/adaptive.py) consumes through the history store.  Empty
+    string when every node is within threshold (non-
     analyze prints and on-estimate plans are unchanged)."""
     from ..execution.history import MISESTIMATE_THRESHOLD, misestimate
 
