@@ -392,6 +392,21 @@ def _bench_distributed(engine, conn, session, names, remaining, payload):
             pq = {"engine_warm_s": round(med, 3),
                   "engine_cold_s": round(cold_s, 3),
                   "dist_site_bytes": _dist_bytes(c), **c.as_dict()}
+            # round 20: shard-skew summary — worst max/mean load ratio and
+            # summed imbalance wall over the warm run's ShardStats (the raw
+            # records ride along in as_dict's shard_stats)
+            if c.shard_stats:
+                worst = max(c.shard_stats,
+                            key=lambda r: float(r.get("ratio") or 1.0))
+                pq["skew"] = {
+                    "worst_ratio": round(
+                        float(worst.get("ratio") or 1.0), 2),
+                    "worst_site": worst.get("site"),
+                    "worst_worker": int(worst.get("worker") or 0),
+                    "imbalance_s": round(
+                        sum(float(r.get("imbalance_s") or 0.0)
+                            for r in c.shard_stats), 4),
+                    "records": len(c.shard_stats)}
             # spool half of the A/B (one cold + one warm, budget permitting):
             # the host-materializing exchange this round replaced
             if remaining() > 30 + 2 * cold_s:

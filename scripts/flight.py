@@ -14,6 +14,7 @@ hours later (the gap scripts/tpu_watch.sh has papered over with hand-rolled
     python scripts/flight.py DIR --stalls        # stall events only
     python scripts/flight.py DIR --compiles      # per-statement compile events
     python scripts/flight.py DIR --adaptive      # per-statement plan decisions
+    python scripts/flight.py DIR --skew          # per-shard load / stragglers
 
 Summary columns: query id, state, wall, dispatch/byte counters, the compile
 census (count + seconds — round 17), and the top wall-breakdown bucket —
@@ -39,10 +40,10 @@ def _load_reader():
     spec = importlib.util.spec_from_file_location("_flightrecorder", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    return mod.read_flight_dir, mod.summarize_compiles
+    return mod.read_flight_dir, mod.summarize_compiles, mod.summarize_skew
 
 
-read_flight_dir, summarize_compiles = _load_reader()
+read_flight_dir, summarize_compiles, summarize_skew = _load_reader()
 
 WALL_BUCKETS = ("plan", "compile", "admission_queue", "split_generation",
                 "h2d", "device_dispatch", "host_pull", "exchange_wait",
@@ -125,6 +126,35 @@ def _print_adaptive(recs) -> None:
             print(f"  {r}")
 
 
+def _print_skew(recs) -> None:
+    """--skew detail: every statement record's per-shard attribution
+    (round 20) — one line per statement with the worst max/mean ratio and
+    summed recoverable imbalance wall, then one line per ShardStats record
+    (site, kind, per-worker rows, argmax worker).  Statements that never
+    crossed a mesh/cluster exchange carry no field and are skipped."""
+    for rec in recs:
+        if rec.get("kind") != "query":
+            continue
+        worst, imb, n = summarize_skew(rec)
+        if not n:
+            continue
+        stats = rec.get("shard_stats") \
+            or (rec.get("counters") or {}).get("shard_stats") or []
+        print(f"{rec.get('query_id') or '?'}: {n} shard records, "
+              f"worst {worst:.1f}x, {imb * 1000:.1f} ms imbalance")
+        for s in stats:
+            rows = s.get("rows") or []
+            rows_str = ",".join(str(int(v)) for v in rows[:16])
+            if len(rows) > 16:
+                rows_str += ",..."
+            lbl = s.get("op") or "-"
+            print(f"  {s.get('site', '?'):<28} {s.get('kind', '?'):<10} "
+                  f"{lbl:<12} {s.get('ratio', 1.0):>6.1f}x "
+                  f"worker {s.get('worker', 0):<3} "
+                  f"{s.get('imbalance_s', 0.0) * 1000:>8.1f} ms  "
+                  f"rows [{rows_str}]")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("dir", help="flight directory (TRINO_TPU_FLIGHT_DIR)")
@@ -141,6 +171,10 @@ def main(argv=None):
                     help="per-statement adaptive decisions (verdict, "
                          "win-vs-price reasons, corrections) from the "
                          "embedded advisor decision")
+    ap.add_argument("--skew", action="store_true",
+                    help="per-shard attribution (worker load per exchange, "
+                         "max/mean skew, imbalance wall, cluster straggler "
+                         "records) from the embedded shard stats")
     args = ap.parse_args(argv)
     recs = read_flight_dir(args.dir)
     if not recs:
@@ -158,6 +192,9 @@ def main(argv=None):
         return 0
     if args.adaptive:
         _print_adaptive(recs)
+        return 0
+    if args.skew:
+        _print_skew(recs)
         return 0
     if args.stalls:
         recs = [r for r in recs if r.get("kind") == "stall"]

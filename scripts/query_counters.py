@@ -120,6 +120,16 @@ def main():
                          "Consult/observe are host-only: the counters "
                          "printed alongside are unchanged by the advisor "
                          "(the budget suite pins that)")
+    ap.add_argument("--skew", action="store_true",
+                    help="print each warm query's per-shard attribution "
+                         "(site -> per-worker rows, max/mean ratio, argmax "
+                         "worker, imbalance wall) from the ShardStats the "
+                         "run already recorded — meaningful with "
+                         "--distributed (local statements carry no shard "
+                         "records).  Same re-derivation contract as "
+                         "--sites: the skew derivation consumes host ints "
+                         "already pulled at the existing dist.* sites, "
+                         "zero new pulls, counters unchanged")
     ap.add_argument("--history", action="store_true",
                     help="print each warm query's est-vs-actual table from "
                          "the plan-actuals history (node path -> CBO "
@@ -146,7 +156,7 @@ def main():
         return
     if args.distributed:
         _trace_distributed(engine, sf, split_rows, names, QUERIES,
-                           args.sites)
+                           args.sites, args.skew)
         return
 
     def trace(session, name):
@@ -262,7 +272,8 @@ def _print_adaptive(engine):
             print(f"#       {r}", flush=True)
 
 
-def _trace_distributed(engine, sf, split_rows, names, QUERIES, show_sites):
+def _trace_distributed(engine, sf, split_rows, names, QUERIES, show_sites,
+                       show_skew=False):
     """Worker-mesh trace: cold+warm counters per query in both exchange
     modes (device-resident vs host spool).  The warm device rows — total
     dist.* site bytes and the per-site table — are what
@@ -288,6 +299,7 @@ def _trace_distributed(engine, sf, split_rows, names, QUERIES, show_sites):
                 counters = ex.counters.as_dict()
                 sites = counters.pop("sites", {})
                 counters.pop("dispatch_latency", None)
+                shard = counters.pop("shard_stats", [])
                 dist = {k: v for k, v in sites.items() if "dist." in k}
                 out[phase] = {
                     "wall_s": round(time.perf_counter() - t0, 3),
@@ -302,6 +314,20 @@ def _trace_distributed(engine, sf, split_rows, names, QUERIES, show_sites):
                         print(f"#   {key:<44} {s['dispatches']:>4} "
                               f"{s['transfers']:>4} {s['bytes']:>9}",
                               flush=True)
+                if show_skew and phase == "warm":
+                    print(f"# {name} warm {mode} shard skew "
+                          "(site/kind -> per-worker rows, ratio):",
+                          flush=True)
+                    for s in shard:
+                        rows = ",".join(str(int(v))
+                                        for v in (s.get("rows") or [])[:16])
+                        print(f"#   {s.get('site', '?'):<28} "
+                              f"{s.get('kind', '?'):<10} "
+                              f"{s.get('op') or '-':<12} "
+                              f"{s.get('ratio', 1.0):>5.1f}x "
+                              f"worker {s.get('worker', 0):<3} "
+                              f"{s.get('imbalance_s', 0.0) * 1000:>7.1f} ms "
+                              f"[{rows}]", flush=True)
             rec[mode] = out
         print(json.dumps(rec), flush=True)
         db = rec["device"]["warm"]["dist_site_bytes"]

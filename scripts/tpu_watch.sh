@@ -111,6 +111,14 @@ for i in $(seq 1 250); do
         > "scripts/bench_${name}.json" 2> "scripts/bench_${name}.log"
       echo "$(date -Is) $name rc=$? : $(tail -c 300 scripts/bench_${name}.json)" >> "$LOG"
     done
+    # round-20 skewed-key capture: a hot-key sort (low-cardinality
+    # o_orderstatus — range partitioning piles ~half the table on boundary
+    # workers) vs a uniform control through the mesh at SF1, each warm run's
+    # ShardStats embedded — the first on-device skew/straggler datum.
+    # Cheap, so it rides right after the exchange A/B it decomposes.
+    SKEW_SF=1 timeout -k 60 900 python scripts/skew_capture.py \
+      > scripts/bench_dist_skew.json 2> scripts/bench_dist_skew.log
+    echo "$(date -Is) dist skew rc=$? : $(tail -c 300 scripts/bench_dist_skew.json)" >> "$LOG"
     # buffer-pool A/B (the round-9 capture): cache on (2GB budget) vs off,
     # SF1 first — hit rates + bytes_saved embed in each bench JSON
     for cfg in "sf1_cache:1:2147483648:900:1200" "sf1_nocache:1:0:900:1200" \
@@ -197,7 +205,7 @@ try:
                              if l.strip()]
 except Exception as e:
     out["exchange_micro"] = {"error": str(e)}
-for name in ("dist_device", "dist_spool"):
+for name in ("dist_device", "dist_spool", "dist_skew"):
     try:
         out[name] = json.load(open(f"scripts/bench_{name}.json"))
     except Exception as e:

@@ -385,6 +385,103 @@ def test_history_reads_route_through_advisor(path):
           " or annotate '# adaptive-ok: <reason>'")
 
 
+PULL_MARKER = "# pull-ok"
+
+# The FROZEN set of device->host pull sites in exec/distributed.py (round
+# 20).  The device-resident exchange's whole point is that the warm path
+# pulls at exactly these sites — the distributed-budget suite pins the warm
+# subset dynamically, and this rule pins the SITE NAMESPACE statically: a
+# new `_host(..., site="dist...")` call is a new pull site until proven
+# otherwise, the same failure mode the round-6 loose-np.asarray rule
+# closed for the local executor.  The round-20 skew derivation consumes
+# ints already pulled at these existing sites and must never need a new
+# one.  Adding a site here is a deliberate act that should come with a
+# budget-suite re-derivation (scripts/query_counters.py --distributed).
+DIST_PULL_SITES = {
+    "dist.build.dupcheck",
+    "dist.hostfed.pull",
+    "dist.shards.concat",
+    "dist.shards.pull",
+    "dist.join.buildsize",
+    "dist.join.build_exchange",
+    "dist.join.overflow",
+    "dist.sort.sample",
+    "dist.exchange.collect",
+    "dist.exchange.route",
+    "dist.exchange.flags",
+    "dist.topn.states",
+    "dist.agg.overflow",
+    "dist.agg.compact",
+    "dist.agg.groups",
+    "dist.agg.states",
+    "dist.stream.collect",
+    "dist.stream.route",
+    "dist.stream.flags",
+}
+
+
+def _dist_pull_hits(path, allowed=None):
+    """``_host(...)`` calls in exec/distributed.py whose ``site=`` literal is
+    NOT in the frozen pull-site set and whose line lacks a
+    ``# pull-ok: <reason>`` annotation.  A site= that is not a string
+    literal cannot be verified statically and needs the marker too."""
+    allowed = DIST_PULL_SITES if allowed is None else allowed
+    src = path.read_text()
+    lines = src.splitlines()
+    hits = []
+    for node in ast.walk(ast.parse(src)):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "_host"):
+            continue
+        site = None
+        for kw in node.keywords:
+            if kw.arg == "site":
+                if isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    site = kw.value.value
+                break
+        if site is not None and site in allowed:
+            continue
+        if PULL_MARKER in lines[node.lineno - 1]:
+            continue
+        hits.append((node.lineno, site))
+    return hits
+
+
+def test_distributed_pull_sites_frozen():
+    """Round-20 rule: the warm distributed path's host-pull bill is a
+    handful of known sites (one batched flags pull per exchange run, the
+    occupancy-sized agg pulls, ...).  Any NEW ``_host`` call in
+    exec/distributed.py must either reuse a frozen site name or carry
+    ``# pull-ok: <reason>`` — the per-shard skew derivation in particular
+    is required to consume ints already pulled at existing sites, never to
+    add a pull of its own."""
+    path = EXEC_DIR / "distributed.py"
+    hits = _dist_pull_hits(path)
+    assert not hits, (
+        f"distributed.py: _host call outside the frozen pull-site set at "
+        + ", ".join(f"line {ln} (site={site!r})" for ln, site in hits)
+        + " — reuse an existing dist.* site, or annotate "
+          "'# pull-ok: <reason>' and re-derive the distributed budget "
+          "ceilings (scripts/query_counters.py --distributed --sites)")
+
+
+def test_pull_site_lint_catches_violations(tmp_path):
+    """The pull-site rule must actually flag what it claims to."""
+    bad = tmp_path / "dist.py"
+    bad.write_text(
+        "def f(x, _host, s):\n"
+        "    a = _host([x], site='dist.exchange.flags')\n"   # frozen -> ok
+        "    b = _host([x], site='dist.skew.extra')\n"       # line 3: flagged
+        "    c = _host([x], site='dist.skew.extra')  # pull-ok: test\n"
+        "    d = _host([x], site=s)\n"                       # line 5: flagged
+        "    e = _host([x], site=s)  # pull-ok: test\n"
+        "    return a, b, c, d, e\n")
+    assert [(ln, site) for ln, site in _dist_pull_hits(bad)] == \
+        [(3, "dist.skew.extra"), (5, None)]
+
+
 def test_lint_catches_violations(tmp_path):
     """The lint must actually flag what it claims to (guards against the
     visitor silently matching nothing after a refactor)."""

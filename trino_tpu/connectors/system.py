@@ -75,6 +75,11 @@ SCHEMAS = {
         Field("h2d_s", DOUBLE), Field("device_dispatch_s", DOUBLE),
         Field("host_pull_s", DOUBLE), Field("exchange_wait_s", DOUBLE),
         Field("retry_backoff_s", DOUBLE), Field("unattributed_s", DOUBLE),
+        # round 20: per-shard skew — worst max/mean ratio and summed
+        # imbalance wall over the statement's shard records; NULL when the
+        # statement never crossed a mesh/cluster exchange, never a
+        # fabricated zero
+        Field("skew_ratio", DOUBLE), Field("skew_imbalance_s", DOUBLE),
     )),
     # round 17: the compile observatory (execution/tracing.CompileLog) as
     # SQL — one row per retained XLA compilation: the operator site that
@@ -238,6 +243,14 @@ class SystemConnector:
             for rec in fr.snapshot(kind="query"):
                 c = rec.get("counters") or {}
                 bd = rec.get("wall_breakdown") or {}
+                shard = rec.get("shard_stats") \
+                    or c.get("shard_stats") or []
+                skew_ratio = skew_imb = None
+                if shard:
+                    skew_ratio = max(float(s.get("ratio") or 1.0)
+                                     for s in shard)
+                    skew_imb = sum(float(s.get("imbalance_s") or 0.0)
+                                   for s in shard)
                 out.append((
                     rec.get("query_id"), rec.get("state"), rec.get("sql"),
                     rec.get("user"), rec.get("error"),
@@ -253,6 +266,7 @@ class SystemConnector:
                     bd.get("h2d"), bd.get("device_dispatch"),
                     bd.get("host_pull"), bd.get("exchange_wait"),
                     bd.get("retry_backoff"), bd.get("unattributed"),
+                    skew_ratio, skew_imb,
                 ))
             return out
         if table == "compilations":

@@ -43,7 +43,7 @@ from collections import deque
 from typing import Optional
 
 __all__ = ["FlightRecorder", "read_flight_dir", "pressure_rung",
-           "summarize_compiles"]
+           "summarize_compiles", "summarize_skew"]
 
 DEFAULT_MAX_RECORDS = 256
 DEFAULT_DISK_BUDGET = 64 << 20
@@ -91,6 +91,27 @@ def summarize_compiles(rec: Optional[dict]):
     if s is None:
         s = c.get("compile_s")
     return int(n or 0), float(s or 0.0)
+
+
+def summarize_skew(rec: Optional[dict]):
+    """(worst_ratio, imbalance_s, n_records) of the per-shard attribution in
+    one statement record (round 20) — the top-level ``shard_stats`` when the
+    engine stamped it, else the counters snapshot; (None, 0.0, 0) when the
+    statement never crossed a mesh/cluster exchange.  Stdlib-pure:
+    scripts/flight.py --skew renders a dead process's ring through this."""
+    r = rec or {}
+    stats = r.get("shard_stats")
+    if stats is None:
+        stats = (r.get("counters") or {}).get("shard_stats")
+    stats = stats or []
+    worst = None
+    imb = 0.0
+    for s in stats:
+        ratio = float(s.get("ratio") or 1.0)
+        if worst is None or ratio > worst:
+            worst = ratio
+        imb += float(s.get("imbalance_s") or 0.0)
+    return worst, imb, len(stats)
 
 
 def read_flight_dir(path: str) -> list:

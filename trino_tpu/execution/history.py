@@ -316,7 +316,18 @@ def fold_records(dst: dict, path: str, rec: dict) -> None:
     cur = dst.get(path)
     if cur is None:
         dst[path] = dict(rec, spill_tiers=dict(rec.get("spill_tiers") or {}))
+        if rec.get("skew"):
+            dst[path]["skew"] = dict(rec["skew"])
         return
+    if rec.get("skew"):
+        # worst shard wins when split tasks of one logical node fold: the
+        # slowest shard sets the SPMD wall, so the max ratio is the record
+        mine = cur.get("skew")
+        if mine is None or (rec["skew"].get("ratio", 1.0)
+                            > mine.get("ratio", 1.0)):
+            cur["skew"] = dict(rec["skew"])
+    if "actual_rows" not in rec:
+        return  # skew-only record (round 20): no cardinality arithmetic
     cur["actual_rows"] += int(rec.get("actual_rows", 0))
     cur["wall_s"] += float(rec.get("wall_s", 0.0))
     cur["spilled_bytes"] += int(rec.get("spilled_bytes", 0))
@@ -407,6 +418,29 @@ class PlanHistoryStore:
                 "wall_s": 0.0, "wall_s_total": 0.0,
                 "spilled_bytes": 0, "spill_tiers": {}, "cache_hits": 0,
                 "misestimate_ratio": 1.0, "direction": "exact"}
+        skew = rec.get("skew")
+        if skew is not None:
+            # round 20: per-exchange shard skew keyed by the same structural
+            # paths — EWMA on the ratio (one hot run must not dominate), the
+            # latest argmax worker, summed recoverable imbalance wall
+            cur = node.get("skew")
+            ratio = float(skew.get("ratio", 1.0))
+            if cur is None:
+                node["skew"] = {
+                    "ratio": ratio, "ratio_ewma": ratio,
+                    "worker": int(skew.get("worker", 0)),
+                    "workers": int(skew.get("workers", 0)),
+                    "imbalance_s": float(skew.get("imbalance_s", 0.0))}
+            else:
+                cur["ratio"] = ratio
+                cur["ratio_ewma"] = (EWMA_ALPHA * ratio
+                                     + (1.0 - EWMA_ALPHA)
+                                     * cur["ratio_ewma"])
+                cur["worker"] = int(skew.get("worker", cur["worker"]))
+                cur["workers"] = int(skew.get("workers", cur["workers"]))
+                cur["imbalance_s"] += float(skew.get("imbalance_s", 0.0))
+        if "actual_rows" not in rec:
+            return  # skew-only record: never touch the cardinality EWMAs
         node["executions"] += 1
         est = rec.get("est_rows")
         if est is not None:
@@ -448,7 +482,13 @@ class PlanHistoryStore:
 
     @staticmethod
     def _copy_entry(ent: dict) -> dict:
-        return {**ent, "nodes": {p: dict(r, spill_tiers=dict(r["spill_tiers"]))
+        def copy_node(r: dict) -> dict:
+            out = dict(r, spill_tiers=dict(r["spill_tiers"]))
+            if r.get("skew"):
+                out["skew"] = dict(r["skew"])
+            return out
+
+        return {**ent, "nodes": {p: copy_node(r)
                                  for p, r in ent["nodes"].items()}}
 
     def snapshot(self) -> list:

@@ -22,6 +22,7 @@ __all__ = ["Span", "Tracer", "NOOP_TRACER", "QueryCounters", "track_counters",
            "record_coalesced", "record_page_cache", "record_build_cache",
            "record_fault", "record_task_retry", "record_spill",
            "SPILL_TIERS",
+           "record_shard_stats", "shard_skew", "SHARD_STATS_MAX",
            "LatencyHistogram", "LATENCY_BUCKETS_S",
            "operator_scope", "activate_tracer", "current_tracer",
            "maybe_span", "span_dict", "spans_to_otlp",
@@ -231,6 +232,15 @@ class QueryCounters:
     # price, or a demoted correction cooling down.
     adaptive_replans: int = 0
     adaptive_holds: int = 0
+    # round 20: per-shard attribution for the distributed path.  Each entry
+    # is one blocking exchange / shard consumer's per-worker load, DERIVED
+    # from pulls the exchange already makes (receive cursors, occupancy
+    # counts — zero new warm pull sites): {"site", "kind", "op"?, "workers",
+    # "rows": [per-worker], "max", "mean", "ratio" (max/mean), "worker"
+    # (argmax), "wall_s", "imbalance_s" ((max-mean)/max x wall), "bytes"?,
+    # "labels"?}.  Bounded at SHARD_STATS_MAX per counter set (counters_total
+    # merges every query forever).
+    shard_stats: list = dataclasses.field(default_factory=list)
     # "<operator>/<site>" -> {"dispatches", "transfers", "bytes"} plus any
     # cache keys the site recorded: the attribution EXPLAIN ANALYZE prints
     # and budget failures dump
@@ -256,6 +266,7 @@ class QueryCounters:
         for f in self._FLOAT_FIELDS:
             setattr(self, f, 0.0)
         self.sites = {}
+        self.shard_stats = []
         self.dispatch_latency = LatencyHistogram()
 
     def merge(self, other: "QueryCounters") -> None:
@@ -263,6 +274,9 @@ class QueryCounters:
             setattr(self, f, getattr(self, f) + getattr(other, f, 0))
         for f in self._FLOAT_FIELDS:
             setattr(self, f, getattr(self, f) + getattr(other, f, 0.0))
+        if getattr(other, "shard_stats", None):
+            self.shard_stats.extend(dict(r) for r in other.shard_stats)
+            del self.shard_stats[:-SHARD_STATS_MAX]
         for key, rec in other.sites.items():
             mine = _site_entry(self.sites, key)
             for k, v in rec.items():  # union of keys: cache sites carry extras
@@ -284,6 +298,9 @@ class QueryCounters:
                 # site extras may be float (compile_s) — don't truncate them
                 mine[k] = mine.get(k, 0) + (float(v) if isinstance(v, float)
                                             else int(v))
+        if d.get("shard_stats"):
+            self.shard_stats.extend(dict(r) for r in d["shard_stats"])
+            del self.shard_stats[:-SHARD_STATS_MAX]
         lat = d.get("dispatch_latency")
         if lat:
             self.dispatch_latency.merge_dict(lat)
@@ -295,6 +312,7 @@ class QueryCounters:
         for f in self._FLOAT_FIELDS:
             setattr(out, f, getattr(self, f))
         out.sites = {k: dict(v) for k, v in self.sites.items()}
+        out.shard_stats = [dict(r) for r in self.shard_stats]
         out.dispatch_latency = self.dispatch_latency.snapshot()
         return out
 
@@ -303,6 +321,8 @@ class QueryCounters:
         for f in self._FLOAT_FIELDS:
             d[f] = getattr(self, f)
         d["sites"] = {k: dict(v) for k, v in self.sites.items()}
+        if self.shard_stats:
+            d["shard_stats"] = [dict(r) for r in self.shard_stats]
         d["dispatch_latency"] = self.dispatch_latency.as_dict()
         return d
 
@@ -561,6 +581,64 @@ def record_task_retry(n: int = 1, site: Optional[str] = None) -> None:
     if c is not None:
         c.task_retries += n
     _attribute_extra(site, task_retries=n)
+
+
+# -- shard skew (round 20) -----------------------------------------------------
+#
+# Per-shard attribution for the distributed path: on an SPMD machine
+# wall-clock is set by the SLOWEST shard, and the per-worker load that
+# decides it ALREADY crosses the host boundary — receive cursors at
+# dist.exchange.flags / dist.stream.flags, live-group occupancy at
+# dist.agg.overflow.  These helpers fold those host-side ints into
+# QueryCounters.shard_stats records (zero new pulls, zero device work);
+# the exchange wall comes from a host perf_counter around the batch loop,
+# so local statements and disarmed paths pay nothing.
+
+SHARD_STATS_MAX = 64  # records retained per counter set: counters_total
+# merges every query forever, so the list must be bounded (newest win)
+
+
+def shard_skew(per_worker) -> dict:
+    """Summarize a per-worker load vector (host ints — NEVER device arrays)
+    into the skew core every ShardStats record shares: max/mean ratio and
+    the argmax worker.  Empty or all-zero vectors read as balanced (1.0x)."""
+    vals = [int(v) for v in per_worker]
+    n = len(vals)
+    mx = max(vals) if vals else 0
+    mean = (sum(vals) / n) if n else 0.0
+    ratio = (mx / mean) if mean > 0 else 1.0
+    worker = vals.index(mx) if vals else 0
+    return {"workers": n, "rows": vals, "max": mx, "mean": mean,
+            "ratio": ratio, "worker": worker}
+
+
+def record_shard_stats(site: str, per_worker, wall_s: float = 0.0,
+                       kind: str = "exchange", op: Optional[str] = None,
+                       bytes_per_row: Optional[int] = None,
+                       labels=None) -> Optional[dict]:
+    """One blocking exchange / shard consumer's per-worker load, derived
+    from pulls the caller already made.  imbalance_s estimates the wall the
+    skew cost: the span ran at the slowest shard's pace, so a perfectly
+    rebalanced run would take mean/max of it — (max-mean)/max x wall is the
+    recoverable slice.  Returns the record (also appended to the current
+    query's counters) so callers can key it by plan node."""
+    rec = shard_skew(per_worker)
+    rec["site"] = site
+    rec["kind"] = kind
+    if op:
+        rec["op"] = op
+    rec["wall_s"] = float(wall_s)
+    mx, mean = rec["max"], rec["mean"]
+    rec["imbalance_s"] = ((mx - mean) / mx * float(wall_s)) if mx > 0 else 0.0
+    if bytes_per_row:
+        rec["bytes"] = [int(v) * int(bytes_per_row) for v in rec["rows"]]
+    if labels:
+        rec["labels"] = list(labels)
+    c = getattr(_counter_local, "counters", None)
+    if c is not None:
+        c.shard_stats.append(dict(rec))
+        del c.shard_stats[:-SHARD_STATS_MAX]
+    return rec
 
 
 # -- compile observatory -------------------------------------------------------

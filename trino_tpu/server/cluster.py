@@ -1174,6 +1174,7 @@ class ClusterCoordinator:
         self._harvested: set = set()  # task ids already merged this query
         self._task_plan_stats: dict = {}  # task id -> fragment-relative
         # plan-actuals records harvested with the task counters (round 15)
+        self._task_walls: dict = {}  # worker url -> [task wall s] (round 20)
         self._fragment_rows: dict = {}  # id(node) -> nested-fragment rows
 
     # -- lifecycle ---------------------------------------------------------------
@@ -1593,6 +1594,9 @@ class ClusterCoordinator:
             # 15): folded into the engine's plan-history store at clean
             # completion, re-anchored at each fragment root's full-plan path
             self._task_plan_stats = {}
+            # round 20: per-worker task walls (url -> [seconds]) observed at
+            # commit detection — the straggler record in the finally below
+            self._task_walls = {}
             self._fragment_rows = {}  # id(node) -> merged final row count
             # for NESTED fragment roots (consumed remotely, so never in the
             # local finish's overrides)
@@ -1702,6 +1706,27 @@ class ClusterCoordinator:
                     for sub in self._qc_children:
                         merged.merge(sub)
                     merged.merge(self._qc_workers)
+                    walls = {u: sum(ds)
+                             for u, ds in self._task_walls.items()}
+                    # round 20: one kind="task" straggler record built from
+                    # the per-worker walls the commit poll already observed —
+                    # coordinator-held state only, zero extra worker traffic.
+                    # Load vector = summed task wall per worker url (ms ints
+                    # so shard_skew's arithmetic applies unchanged).
+                    if walls:
+                        urls = sorted(walls)
+                        rec = tracing.shard_skew(
+                            [int(walls[u] * 1000.0) for u in urls])
+                        wall = max(walls.values())
+                        rec["site"] = "cluster.task.walls"
+                        rec["kind"] = "task"
+                        rec["wall_s"] = float(wall)
+                        mx, mean = rec["max"], rec["mean"]
+                        rec["imbalance_s"] = \
+                            ((mx - mean) / mx * wall) if mx > 0 else 0.0
+                        rec["labels"] = urls
+                        merged.shard_stats.append(rec)
+                        del merged.shard_stats[:-tracing.SHARD_STATS_MAX]
                     self.last_query_counters = merged
                     self.last_query_worker_spans = list(self._worker_spans)
                 self.engine._account_counters(merged)
@@ -2515,8 +2540,14 @@ class ClusterCoordinator:
                     if tid not in speculated:
                         # rescued stragglers would inflate the median and
                         # weaken later straggler detection
-                        durations.append(
-                            time.time() - started.get(tid, time.time()))
+                        dur = time.time() - started.get(tid, time.time())
+                        durations.append(dur)
+                        # round 20: per-worker wall accumulation feeds the
+                        # kind="task" straggler record at query completion —
+                        # coordinator-held state only, no new worker traffic
+                        with self._lock:
+                            self._task_walls.setdefault(w.url,
+                                                        []).append(dur)
                     # worker-side counters ride back on the status response
                     # the moment the commit is visible (the snapshot is
                     # stored pre-commit on the worker)

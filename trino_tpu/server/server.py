@@ -777,6 +777,31 @@ class CoordinatorServer:
                     f"trino_tpu_adaptive_demotions_total "
                     f"{ai['demotions_total']}",
                 ]
+            # round 20: per-shard skew — worst max/mean ratio over the
+            # retained window and the latest record's per-worker load
+            # vector (rows for mesh exchanges, ms for cluster task walls)
+            shard = getattr(ct, "shard_stats", None) or []
+            if shard:
+                worst = max(float(r.get("ratio") or 1.0) for r in shard)
+                lines += [
+                    "# HELP trino_tpu_exchange_skew_ratio Worst max/mean "
+                    "per-worker load ratio over retained shard records.",
+                    "# TYPE trino_tpu_exchange_skew_ratio gauge",
+                    f"trino_tpu_exchange_skew_ratio {worst}",
+                ]
+                last = shard[-1]
+                rows = last.get("rows") or []
+                if rows:
+                    lines += [
+                        "# HELP trino_tpu_shard_rows Per-worker load of the "
+                        "most recent shard record (rows, or ms for "
+                        "kind=task).",
+                        "# TYPE trino_tpu_shard_rows gauge"]
+                    site = esc(str(last.get("site") or "?"))
+                    for wi, v in enumerate(rows):
+                        lines.append(
+                            f'trino_tpu_shard_rows{{worker="{wi}",'
+                            f'site="{site}"}} {int(v)}')
             sites = getattr(ct, "sites", None) or {}
             if sites:
                 lines += ["# HELP trino_tpu_site_dispatches_total Device "

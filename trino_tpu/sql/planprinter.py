@@ -15,7 +15,7 @@ __all__ = ["format_plan"]
 def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
                 boundary: dict = None, ests: dict = None,
                 paths: dict = None, breakdown: dict = None,
-                adaptive: dict = None) -> str:
+                adaptive: dict = None, skew: dict = None) -> str:
     """``stats``: optional id(node) -> {rows, wall_s} from an EXPLAIN ANALYZE run
     (reference: PlanPrinter's textDistributedPlan with OperatorStats).
     ``counters``: optional per-query device-boundary counters
@@ -38,12 +38,19 @@ def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
     optional adaptive-advisor decision dict (round 19) rendered as one
     "Adaptive:" line with the win-vs-price arithmetic and the corrections —
     why this statement's plan changed, or why the advisor held (no decision
-    = no line, budget-suite regexes unchanged)."""
+    = no line, budget-suite regexes unchanged).  ``skew``: optional id(node)
+    -> ShardStats record (round 20, DistributedExecutor.skew_by_node) —
+    exchanges above the noise floor get a ``[skew: max/mean K.Kx worker N]``
+    annotation and the worst offenders roll up into a "Skew:" summary line
+    (balanced mesh = no annotation, no line)."""
     lines: list = []
-    _fmt(node, lines, 0, stats or {}, boundary or {}, ests or {})
+    _fmt(node, lines, 0, stats or {}, boundary or {}, ests or {}, skew or {})
     mis = _misestimate_summary(stats or {}, ests or {}, paths or {})
     if mis:
         lines.append(mis)
+    sk = _skew_summary(skew or {})
+    if sk:
+        lines.append(sk)
     if adaptive:
         from ..execution.adaptive import describe_decision
 
@@ -154,6 +161,40 @@ def _misestimate_summary(stats: dict, ests: dict, paths: dict) -> str:
     return f"Misestimates: {inner}"
 
 
+# per-node skew annotations and the summary line print only ABOVE this
+# ratio and row floor: a balanced mesh or a trivially small exchange stays
+# silent (budget-suite EXPLAIN regexes unchanged, same zero-is-no-line
+# discipline as every other summary here)
+SKEW_PRINT_THRESHOLD = 2.0
+SKEW_ROWS_FLOOR = 8
+
+
+def _skew_rec_visible(rec: dict) -> bool:
+    return (rec.get("ratio", 1.0) >= SKEW_PRINT_THRESHOLD
+            and rec.get("max", 0) >= SKEW_ROWS_FLOOR)
+
+
+def _skew_str(rec: dict) -> str:
+    return (f"max/mean {rec.get('ratio', 1.0):.1f}x "
+            f"worker {rec.get('worker', 0)}")
+
+
+def _skew_summary(skew: dict) -> str:
+    """One "Skew:" line naming the worst per-shard imbalances (round 20) —
+    which exchange sent most of its rows to one worker and roughly what
+    that slowest-shard wall cost.  Empty when every exchange is balanced."""
+    worst = [rec for rec in skew.values() if _skew_rec_visible(rec)]
+    if not worst:
+        return ""
+    worst.sort(key=lambda r: (-r.get("ratio", 1.0), r.get("site", "")))
+    inner = "; ".join(
+        f"{rec.get('op') or rec.get('site', 'exchange')} "
+        f"{_skew_str(rec)} ({rec.get('imbalance_s', 0.0) * 1000:.1f} ms "
+        f"imbalance)"
+        for rec in worst[:5])
+    return f"Skew: {inner}"
+
+
 def _boundary_nonzero(b: dict) -> bool:
     return bool(b.get("dispatches") or b.get("transfers") or b.get("bytes"))
 
@@ -173,10 +214,12 @@ def _schema_str(node: P.PlanNode) -> str:
 
 
 def _fmt(node: P.PlanNode, lines: list, depth: int, stats: dict,
-         boundary: dict = None, ests: dict = None) -> None:
+         boundary: dict = None, ests: dict = None,
+         skew: dict = None) -> None:
     pad = "    " * depth
     boundary = boundary or {}
     ests = ests or {}
+    skew = skew or {}
     before = len(lines)
     if isinstance(node, P.Output):
         lines.append(f"{pad}Output[{', '.join(node.names)}]")
@@ -259,5 +302,11 @@ def _fmt(node: P.PlanNode, lines: list, depth: int, stats: dict,
         # for the accelerator boundary): dispatches/pulls recorded while THIS
         # operator (and the streaming chain it drives) executed
         lines[before] += f" [boundary: {_boundary_str(b)}]"
+    sk = skew.get(id(node))
+    if sk is not None and _skew_rec_visible(sk) and len(lines) > before:
+        # per-shard imbalance at this operator's exchange (round 20): the
+        # slowest shard sets the SPMD wall, so the reader sees WHICH worker
+        # carried the heavy partition straight on the plan line
+        lines[before] += f" [skew: {_skew_str(sk)}]"
     for c in node.children:
-        _fmt(c, lines, depth + 1, stats, boundary, ests)
+        _fmt(c, lines, depth + 1, stats, boundary, ests, skew)
