@@ -18,16 +18,35 @@ protocol, nextUri paging, real HTTP):
 
 The mixed workload has five classes (warm TPC-H + point lookups with
 per-request DISTINCT constants + protocol-parameterized EXECUTE + short
-aggregations + one repeated dashboard statement), and the whole matrix runs
-THREE times — plan templates OFF (substitution baseline), templates ON with
-result cache OFF (isolates the round-13 template win), then result cache ON
-— so the JSON line prices exactly what each tier buys:
+aggregations + one repeated dashboard statement).  The point/param classes
+share one statement shape (_POINT_SQL, a customer point lookup): ``point``
+inlines a fresh constant per request (stride 97 over the customer keys —
+exercises AUTO-parameterization) and ``param`` binds one per request via
+protocol parameters (stride 61) — every request a distinct binding,
+identical up to constants, which is exactly the shape plan templates (and
+the round-21 template batcher) serve.  The matrix runs THREE times — plan
+templates OFF (substitution baseline), templates ON with result cache OFF
+(isolates the round-13 template win), then result cache ON — so the JSON
+line prices exactly what each tier buys:
 per-class p50/p99, achieved qps, buffer-pool/result-cache hit rates,
 admission/resource-group queueing, and (SERVE_WORKERS > 0) worker
 fair-scheduler preemption counts.  The cache-on half also verifies the
 acceptance contract in-process: the repeated statement's warm hit must show
 ``device_dispatches == 0`` on its counters and byte-identical results vs
 the cache-off engine.
+
+After the three-phase matrix, a round-21 template-batch A/B runs the
+point+param classes OPEN-LOOP at SERVE_BATCH_QPS (well above
+single-statement throughput, so the gather window actually fills) with the
+template batcher OFF then ON — latency still measured from SCHEDULED
+arrival, so gather-window queueing counts against p50/p99 — and the
+payload carries the per-class and total open-loop qps speedups, the
+``batched_requests`` counter delta, and batched-vs-serial byte identity.
+The A/B drives the ENGINE in-process (``open_loop_inproc``), not the HTTP
+protocol: on a small box the polling HTTP harness saturates near ~55 qps
+with ZERO dispatches (the cache-on phase measures exactly that ceiling),
+which would mask the fused path entirely — and both halves differ only in
+the batcher flag, so the protocol layer cancels out of the ratio anyway.
 
 Prints ONE JSON line — always, even on timeout/failure (finally block;
 SIGTERM/SIGALRM raise through it) — env-stamped, same contract as bench.py.
@@ -37,8 +56,19 @@ Env knobs:
     SERVE_DURATION      seconds per load phase (default 20)
     SERVE_CLIENTS       closed-loop concurrency (default 4)
     SERVE_QPS           open-loop arrival rate (default 8; 0 skips open loop)
-    SERVE_POINTS        (unused since round 13: point/param constants are
-                        per-request distinct — the shape templates serve)
+    SERVE_BATCH_QPS     in-process open-loop arrival rate for the
+                        template-batch A/B phases (default 256; 0 skips
+                        them — pick it well above the serial engine's
+                        point-lookup throughput or neither half saturates)
+    SERVE_BATCH_MAX     window cap for the A/B's ON half (default 16 —
+                        deeper windows LOSE on CPU where the vmapped
+                        program pays real per-lane compute; raise it on a
+                        device where a dispatch is a round-trip)
+    SERVE_BATCH_WINDOW_MS  gather-window for the ON half (default 0 =
+                        pure continuous batching: fuse whatever queued
+                        behind the running window, no artificial delay —
+                        measured fastest on CPU; the engine-wide
+                        TRINO_TPU_BATCH_WINDOW_MS default stays 2)
     SERVE_BUDGET        global wall-clock budget seconds (default 900)
     SERVE_RESULT_CACHE  result-tier bytes for the ON half (default 256MB)
     SERVE_PAGE_CACHE    page-tier bytes for BOTH halves (default 1GB)
@@ -73,7 +103,9 @@ SF = float(os.environ.get("SERVE_SF", "0.1"))
 DURATION = float(os.environ.get("SERVE_DURATION", "20"))
 CLIENTS = int(os.environ.get("SERVE_CLIENTS", "4"))
 QPS = float(os.environ.get("SERVE_QPS", "8"))
-POINTS = int(os.environ.get("SERVE_POINTS", "4"))
+BATCH_QPS = float(os.environ.get("SERVE_BATCH_QPS", "256"))
+BATCH_MAX = int(os.environ.get("SERVE_BATCH_MAX", "16"))
+BATCH_WINDOW_MS = float(os.environ.get("SERVE_BATCH_WINDOW_MS", "0"))
 BUDGET = float(os.environ.get("SERVE_BUDGET", "900"))
 RESULT_CACHE = int(os.environ.get("SERVE_RESULT_CACHE", str(256 << 20)))
 PAGE_CACHE = int(os.environ.get("SERVE_PAGE_CACHE", str(1 << 30)))
@@ -222,7 +254,8 @@ _COUNTER_KEYS = ("device_dispatches", "host_transfers", "host_bytes_pulled",
                  "result_cache_hits", "result_cache_misses",
                  "result_cache_bytes_saved", "page_cache_hits",
                  "page_cache_misses", "admission_queued", "task_retries",
-                 "plan_template_hits", "plan_template_misses")
+                 "plan_template_hits", "plan_template_misses",
+                 "batched_requests")
 
 
 def _counters_snapshot(engine):
@@ -305,6 +338,54 @@ def open_loop(url, schedule, duration, qps, deadline):
 
     with ThreadPoolExecutor(max_workers=32,
                             thread_name_prefix="serve-open") as pool:
+        futures = []
+        for i in range(n):
+            scheduled = t0 + i / qps
+            delay = scheduled - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            if time.monotonic() > deadline:
+                break
+            cls, gen = schedule[i % len(schedule)]
+            sql, params = gen(i)
+            futures.append(pool.submit(fire, i, cls, sql, params, scheduled))
+        for f in futures:
+            f.result()
+    wall = time.monotonic() - t0
+    total = sum(len(v) for v in samples.values())
+    return {"wall_s": round(wall, 2), "target_qps": qps,
+            "total": {"count": total, "errors": errors[0],
+                      "achieved_qps": round(total / wall, 2) if wall else None},
+            "classes": _class_stats(samples)}
+
+
+def open_loop_inproc(engine, schedule, duration, qps, deadline):
+    """open_loop minus the HTTP harness: fixed-rate arrivals fired straight
+    at ``engine.execute_sql`` with protocol parameters, latency from the
+    SCHEDULED arrival.  The template-batch A/B uses this so the measured
+    ratio is the fused serving path, not the polling client's ceiling."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    samples = {cls: [] for cls, _ in schedule}
+    errors = [0]
+    lock = threading.Lock()
+    n = max(int(min(duration, max(deadline - time.monotonic(), 0)) * qps), 1)
+    t0 = time.monotonic()
+
+    def fire(i, cls, sql, params, scheduled):
+        sess = engine.create_session("tpch")
+        try:
+            engine.execute_sql(sql, sess, parameters=params)
+        except Exception:
+            with lock:
+                errors[0] += 1
+            return
+        dt = time.monotonic() - scheduled
+        with lock:
+            samples[cls].append(dt)
+
+    with ThreadPoolExecutor(max_workers=32,
+                            thread_name_prefix="serve-inproc") as pool:
         futures = []
         for i in range(n):
             scheduled = t0 + i / qps
@@ -463,9 +544,90 @@ def main():
                     "workers": WORKERS}
             print(f"bench_serve: {label} done "
                   f"({phases[label]['closed']['total']})", file=sys.stderr)
+        # -- round-21 template-batch A/B: point+param open-loop at a rate ---
+        # well above single-statement throughput, batcher off vs on.  A
+        # fresh engine pair (templates on, result cache off) so the only
+        # difference is the fused path; latency still counts from SCHEDULED
+        # arrival, so the gather window's queueing is in the percentiles.
+        batch_sched = [("point", classes["point"][0]),
+                       ("param", classes["param"][0])]
+        batch_engines = {}
+        for label, batching in (("batch_off", False), ("batch_on", True)):
+            if BATCH_QPS <= 0 or time.monotonic() > deadline - 10:
+                if BATCH_QPS > 0:
+                    print(f"bench_serve: budget exhausted before {label}",
+                          file=sys.stderr)
+                break
+            engine, server, _cluster = build_node(conn, 0, spool_root,
+                                                  templates=True)
+            servers.append(server)
+            engine.template_batcher.enabled = batching
+            engine.template_batcher.max_batch = BATCH_MAX
+            engine.template_batcher.window_s = BATCH_WINDOW_MS / 1000.0
+            batch_engines[label] = engine
+            for k in (0, 1):  # two distinct bindings confirm the template
+                for _cls, gen in batch_sched:
+                    sql, params = gen(k)
+                    sess = engine.create_session("tpch")
+                    engine.execute_sql(sql, sess, parameters=params)
+            # unmeasured pre-storm: compiles the pow2 rung ladder (the ON
+            # half's analog of the serial half's already-warm plan — both
+            # phases measure warm execution, not compilation)
+            open_loop_inproc(engine, batch_sched, min(2.0, DURATION / 4),
+                            BATCH_QPS, deadline)
+            before = _counters_snapshot(engine)
+            res = open_loop_inproc(engine, batch_sched, DURATION, BATCH_QPS,
+                                   deadline)
+            phases[label] = {
+                "open": res,
+                "counters": _counters_delta(before,
+                                            _counters_snapshot(engine)),
+                "batcher": engine.template_batcher.info()}
+            print(f"bench_serve: {label} done ({res['total']})",
+                  file=sys.stderr)
+        if "batch_off" in phases and "batch_on" in phases:
+            def _open_qps(label, cls_):
+                ph = phases[label]["open"]
+                n = ph["classes"].get(cls_, {}).get("count") or 0
+                w = ph["wall_s"]
+                return (n / w) if (n and w) else None
+
+            for cls_ in ("point", "param"):
+                off_q, on_q = _open_qps("batch_off", cls_), \
+                    _open_qps("batch_on", cls_)
+                if off_q and on_q:
+                    payload[f"{cls_}_batch_qps_speedup"] = round(
+                        on_q / off_q, 2)
+            off_t = phases["batch_off"]["open"]["total"]["achieved_qps"]
+            on_t = phases["batch_on"]["open"]["total"]["achieved_qps"]
+            if off_t and on_t:
+                payload["batch_open_qps_speedup"] = round(on_t / off_t, 2)
+            payload["batched_requests"] = phases["batch_on"]["counters"] \
+                .get("batched_requests", 0)
+            # byte identity: the batched engine's answers vs the serial
+            # engine's, same requests (the load phase already counter-
+            # verified that fused batches actually served traffic)
+            identical = True
+            for i in range(4):
+                for _cls, gen in batch_sched:
+                    sql, params = gen(i)
+                    s_on = batch_engines["batch_on"].create_session("tpch")
+                    s_off = batch_engines["batch_off"].create_session("tpch")
+                    if _sig(batch_engines["batch_on"].execute_sql(
+                            sql, s_on, parameters=params)) != \
+                            _sig(batch_engines["batch_off"].execute_sql(
+                                sql, s_off, parameters=params)):
+                        identical = False
+                        print(f"bench_serve: MISMATCH batch on/off: "
+                              f"{sql[:60]}", file=sys.stderr)
+            payload["batch_identical"] = identical
+
         payload["phases"] = phases
         payload["sf"], payload["clients"] = SF, CLIENTS
         payload["duration_s"], payload["qps_target"] = DURATION, QPS
+        payload["batch_qps_target"] = BATCH_QPS
+        payload["batch_max"] = BATCH_MAX
+        payload["batch_window_ms"] = BATCH_WINDOW_MS
         payload["workers"] = WORKERS
 
         # -- round-13 template A/B: substitution baseline vs templates ------

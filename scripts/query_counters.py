@@ -78,6 +78,16 @@ def main():
                          "disabled).  The warm template numbers are the "
                          "point-class ceilings — re-derive them here after "
                          "any template-path change")
+    ap.add_argument("--serve-batch", action="store_true",
+                    help="trace the round-21 template batcher: fused "
+                         "windows of {1,4,16} concurrent EXECUTEs of one "
+                         "point-lookup template, printing total and "
+                         "PER-REQUEST warm dispatch counts per batch size "
+                         "(the fused window must land within 2x of ONE "
+                         "request's serial bill — the acceptance ratio).  "
+                         "Fusion is manufactured deterministically (the "
+                         "lane is held busy while the window enqueues), "
+                         "not raced against the wall-clock gather window")
     ap.add_argument("--distributed", action="store_true",
                     help="trace the WORKER-MESH path instead of the local "
                          "executor: each query runs on the 8-device CPU "
@@ -153,6 +163,9 @@ def main():
 
     if args.prepared:
         _trace_prepared(engine, sf, split_rows)
+        return
+    if args.serve_batch:
+        _trace_serve_batch(engine, sf, split_rows)
         return
     if args.distributed:
         _trace_distributed(engine, sf, split_rows, names, QUERIES,
@@ -335,6 +348,95 @@ def _trace_distributed(engine, sf, split_rows, names, QUERIES, show_sites,
         ratio = (sb / db) if db else float("inf")
         print(f"# {name}: warm exchange-site bytes spool {sb} -> "
               f"device {db} ({ratio:.1f}x)", flush=True)
+
+
+def _trace_serve_batch(engine, sf, split_rows):
+    """--serve-batch: dispatches-per-request through the template batcher at
+    fused window sizes {1, 4, 16}.  Each window runs twice; the SECOND
+    (warm — serial path and bindings-jit both compiled) run's counter delta
+    is the number that matters: the fused window of N must bill within 2x
+    of ONE serial request, not N times it.
+
+    Fusion is deterministic, not raced: the template's lane is marked busy
+    by hand, the N requests enqueue as members, and a manual handoff
+    promotes the first to driver — the same state the real gather window
+    produces, minus the wall clock."""
+    import threading
+
+    bt = engine.template_batcher
+    bt.enabled = True
+    bt.window_s = 0.2  # generous: members are already enqueued at handoff
+    point = ("select c_name, c_acctbal, c_mktsegment from customer "
+             "where c_custkey = ?")
+    ncust = max(int(150000 * sf) - 1, 100)
+    session = engine.create_session("tpch")
+    # create + CONFIRM the template through the real protocol path (the
+    # batcher only fuses confirmed templates), and warm the serial jits
+    engine.execute_sql(point, session, parameters=[42])
+    engine.execute_sql(point, session, parameters=[97])
+
+    def run_window(n):
+        keys = [1 + (i * 61) % ncust for i in range(n)]
+        errs: list = []
+
+        def fire(k):
+            s = engine.create_session("tpch")
+            try:
+                engine.execute_sql(point, s, parameters=[int(k)])
+            except Exception as e:  # surfaced after join
+                errs.append(e)
+
+        before = engine.counters_total.as_dict()
+        t0 = time.perf_counter()
+        if n == 1:
+            fire(keys[0])
+        else:
+            lane = next(iter(bt._lanes.values()))
+            with bt._lock:
+                lane.busy = True
+            threads = [threading.Thread(target=fire, args=(k,))
+                       for k in keys]
+            for t in threads:
+                t.start()
+            t_wait = time.monotonic()
+            while time.monotonic() - t_wait < 30:
+                with bt._lock:
+                    if len(lane.queue) >= n:
+                        break
+                time.sleep(0.001)
+            bt._handoff(lane)
+            for t in threads:
+                t.join()
+        wall = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        after = engine.counters_total.as_dict()
+        return {
+            "wall_s": round(wall, 4),
+            "device_dispatches": after["device_dispatches"]
+            - before["device_dispatches"],
+            "host_bytes_pulled": after["host_bytes_pulled"]
+            - before["host_bytes_pulled"],
+            "batched_requests": after.get("batched_requests", 0)
+            - before.get("batched_requests", 0)}
+
+    serial_d = None
+    for n in (1, 4, 16):
+        cold = run_window(n)   # first fused run compiles the rung's jit
+        warm = run_window(n)
+        rec = {"batch": n, "sf": sf, "split_rows": split_rows,
+               "cold": cold, "warm": warm,
+               "per_request_dispatches": round(
+                   warm["device_dispatches"] / n, 2)}
+        print(json.dumps(rec), flush=True)
+        if n == 1:
+            serial_d = warm["device_dispatches"]
+        ratio = (warm["device_dispatches"] / serial_d) if serial_d else None
+        print(f"# batch={n}: warm {warm['device_dispatches']} dispatches "
+              f"({rec['per_request_dispatches']}/request, "
+              f"{'-' if ratio is None else format(ratio, '.2f')}x one "
+              f"request's bill), {warm['batched_requests']} "
+              f"batched_requests", flush=True)
 
 
 def _trace_prepared(engine, sf, split_rows):

@@ -36,7 +36,7 @@ def serve_payload():
     mp.setattr(bench_serve, "DURATION", 1.2)
     mp.setattr(bench_serve, "CLIENTS", 2)
     mp.setattr(bench_serve, "QPS", 3.0)
-    mp.setattr(bench_serve, "POINTS", 2)
+    mp.setattr(bench_serve, "BATCH_QPS", 48.0)
     mp.setattr(bench_serve, "BUDGET", 480.0)
     mp.setattr(bench_serve, "RESULT_CACHE", 64 << 20)
     mp.setattr(bench_serve, "PAGE_CACHE", 1 << 30)

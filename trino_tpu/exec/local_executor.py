@@ -188,7 +188,7 @@ _PARAM_TLS = threading.local()
 
 
 @contextlib.contextmanager
-def _params_scope(values, host_values=()):
+def _params_scope(values, host_values=(), batch_hosts=()):
     """Publish the CURRENT query's bound parameter values (tuple of
     ``(0-d device value, 0-d device isnull)`` pairs, one per plan-template
     slot) for this thread.  The jitted step wrappers read it at CALL time and
@@ -198,16 +198,25 @@ def _params_scope(values, host_values=()):
     new inputs.  Empty tuple = no parameters (zero pytree leaves, identical
     compiled signature).  ``host_values`` keeps the pre-staging numpy pairs:
     host-side consumers (bind-time split pruning) read them without paying a
-    device->host sync."""
+    device->host sync.  ``batch_hosts`` (round 21, continuous template
+    batching) carries the numpy runtime tuples of EVERY request in a fused
+    same-template batch: split pruning takes the UNION of the batch's kept
+    splits so one scan feeds all the stacked predicates.  A fused batch
+    publishes ONLY batch_hosts — ``values`` stays empty so a code path that
+    consumes per-request scalars outside the bindings-vmapped step fails
+    loudly instead of silently computing one member's answer for all."""
     old = getattr(_PARAM_TLS, "values", ())
     old_host = getattr(_PARAM_TLS, "host_values", ())
+    old_batch = getattr(_PARAM_TLS, "batch_hosts", ())
     _PARAM_TLS.values = values
     _PARAM_TLS.host_values = host_values
+    _PARAM_TLS.batch_hosts = batch_hosts
     try:
         yield
     finally:
         _PARAM_TLS.values = old
         _PARAM_TLS.host_values = old_host
+        _PARAM_TLS.batch_hosts = old_batch
 
 
 def _current_params() -> tuple:
@@ -216,6 +225,12 @@ def _current_params() -> tuple:
 
 def _current_host_params() -> tuple:
     return getattr(_PARAM_TLS, "host_values", ())
+
+
+def _current_batch_host_params() -> tuple:
+    """Host runtime tuples of every member of the CURRENT fused template
+    batch, or () outside one (see _params_scope)."""
+    return getattr(_PARAM_TLS, "batch_hosts", ())
 
 
 def _dispatch_batch_default() -> int:
@@ -336,6 +351,33 @@ def _stack_pages(pages, live=None):
     return cols, nulls, valid
 
 
+class BatchUnsupported(Exception):
+    """A plan/page combination the fused bindings-batched path (round 21)
+    cannot run: plan shape outside the streaming subset, or an untraceable
+    object-dtype (exact wide-decimal) page mid-scan.  The engine marks the
+    template unbatchable and the batcher re-runs every window member on its
+    own serial path — byte-identically, just without the fusion win."""
+
+
+# test seam for per-lane demux failures: when set, called (lane, nlanes)
+# before each member's result decode — tests inject a one-lane error here to
+# pin the "a batch member that errors fails ONLY its own request" contract
+BATCH_LANE_TEST_HOOK = None
+
+
+def _batchable_plan(node) -> bool:
+    """Can this template plan run the fused bindings-batched path?  The
+    subset is the scan/filter/project streaming core (plus Union/Values):
+    one _compile_stream chain, no blocking operators.  Sort/Limit — although
+    inside the TEMPLATE subset — stay serial: their device kernels consume a
+    single [n] page, and a per-lane top-N over [R, n] is its own project
+    (the batcher falls back per window, so they lose nothing)."""
+    allowed = (P.Output, P.Project, P.Filter, P.TableScan, P.Union, P.Values)
+    if not isinstance(node, allowed):
+        return False
+    return all(_batchable_plan(c) for c in node.children)
+
+
 DEFAULT_GROUP_CAPACITY = 1 << 16
 # ceiling sized for SF10-class group counts on one chip (15M distinct
 # orderkeys need 32M slots to keep the probe load factor sane; ~40B/slot keeps
@@ -430,6 +472,8 @@ class _Stream:
     _jitted: Callable = None  # cached jit of transform applied to a Page
     _batch_jitted: Callable = None  # cached jit of transform over a STACKED
     # group of uniform pages (dispatch coalescing; retraces per group arity)
+    _bindings_jitted: Callable = None  # cached jit of transform vmapped over
+    # a BINDINGS batch (round 21: one dispatch serves R template requests)
     _fused_cache: dict = dataclasses.field(default_factory=dict)  # compiled
     # whole-scan artifacts (fused concat passes), keyed by shape class
 
@@ -495,6 +539,35 @@ class _Stream:
 
             self._batch_jitted = run
         return self._batch_jitted
+
+    def jitted_bindings(self):
+        """One-dispatch transform of one page under a BINDINGS batch (round
+        21, continuous template batching): the stacked parameter slots carry
+        a leading [R] requests axis, ``ir.bind_params`` opens per lane INSIDE
+        the trace, and the step vmaps over that axis — R same-template
+        requests, one tunnel round-trip.  The page and aux broadcast (they
+        are identical across lanes; vmap closes over the outer trace's
+        tracers), so outputs come back as [R, n] columns/nulls/validity the
+        demux slices per request.  Callers pad R to a pow2 rung, so this
+        compiles one executable per (plan, rung) — never per batch size."""
+        if self._bindings_jitted is None:
+            from ..sql import ir as _ir
+
+            def bindings_step(page, aux, stacked):
+                def one(params):
+                    with _ir.bind_params(params):
+                        return self.transform(page.columns, page.null_masks,
+                                              page.valid_mask(), aux)
+
+                return jax.vmap(one)(stacked)
+
+            f = _jit(bindings_step, site="stream.bindings")
+
+            def run(page, stacked, f=f):
+                return f(page, self.aux, stacked)
+
+            self._bindings_jitted = run
+        return self._bindings_jitted
 
 
 class LocalExecutor:
@@ -841,6 +914,116 @@ class LocalExecutor:
         finally:
             # clean or error exit: no prefetch producer outlives the query
             self.close_producers()
+
+    def execute_batched(self, node: P.PlanNode, runtimes) -> list:
+        """Round 21 — continuous template batching: ONE fused execution of a
+        template plan over R bound runtimes (each a tuple of per-slot
+        ``(numpy value, isnull)`` pairs).  The parameter slots stack with a
+        leading requests axis, the streaming chain runs once per page through
+        ``jitted_bindings`` (vmap over the lane axis), and the result surface
+        demultiplexes per lane from ONE batched pull.  Returns a list aligned
+        with ``runtimes``: MaterializedResult per member, or that member's
+        own Exception (per-lane decode failures never poison siblings).
+
+        Raises BatchUnsupported when the plan/pages cannot take this path —
+        the caller (execution/batcher via engine) re-runs every member
+        serially.  R pads to a pow2 rung by repeating the LAST member's
+        bindings (padding lanes are sliced away before decode), so the
+        compile census sees one signature per rung, never one per batch
+        size."""
+        if not runtimes or not runtimes[0]:
+            raise BatchUnsupported("empty batch / parameterless template")
+        if not _batchable_plan(node):
+            raise BatchUnsupported(
+                "plan shape outside the streaming bindings-batch subset")
+        self.stats = {}
+        self.boundary = {}
+        self._op_labels = {}
+        self.begin_plan(node)
+        self.counters.reset()
+        self.close_producers()
+        n = len(runtimes)
+        rung = 1 << max(n - 1, 0).bit_length()
+        padded = list(runtimes) + [runtimes[-1]] * (rung - n)
+        nslots = len(runtimes[0])
+        # stack the slots host-side (per-slot [R] value + [R] isnull), then
+        # stage once — jnp.asarray is the sanctioned scalar-staging idiom
+        # (same as execute()); np here touches only host-side bound scalars
+        stacked = tuple(
+            (jnp.asarray(np.stack([np.asarray(r[s][0]) for r in padded])),  # host-ok: pre-staging bound scalars
+             jnp.asarray(np.array([bool(r[s][1]) for r in padded])))
+            for s in range(nslots))
+        out_schema = node.schema if isinstance(node, P.Output) else None
+        inner = node.child if isinstance(node, P.Output) else node
+        try:
+            # device-value TLS stays EMPTY on purpose: any path that consumes
+            # per-request scalars outside the bindings-vmapped step (an eager
+            # object-column fallback, a stray _current_params() reader) fails
+            # loudly, and the batcher re-runs the window serially — it can
+            # never silently compute one member's answer for every lane
+            with _params_scope((), batch_hosts=tuple(tuple(r)
+                                                     for r in runtimes)), \
+                    tracing.track_counters(self.counters):
+                label = self._op_label(inner)
+                parts = []
+                with tracing.operator_scope(
+                        label, self._boundary_sink(id(inner), label)):
+                    stream = self._compile_stream(inner)
+                    brun = stream.jitted_bindings()
+                    for page in stream.pages():
+                        if any(isinstance(c, np.ndarray)
+                               and c.dtype == object for c in page.columns):
+                            raise BatchUnsupported(
+                                "object-dtype page cannot trace")
+                        parts.append(brun(page, stacked))
+                schema = out_schema if out_schema is not None \
+                    else stream.schema
+                with tracing.operator_scope(
+                        "Result", self._boundary_sink("result", "Result")):
+                    return self._demux_batched(schema, stream.dicts, parts,
+                                               n)
+        finally:
+            self.close_producers()
+
+    def _demux_batched(self, schema, dicts, parts, n: int) -> list:
+        """Per-request result decode for a fused bindings batch: ONE batched
+        pull of the [R, rows] columns/nulls/validity, then a per-lane numpy
+        slice through the shared host-side decode.  A lane whose decode
+        raises carries its own exception in the returned list."""
+        if parts:
+            if len(parts) == 1:
+                cols, nulls, valid = parts[0]
+            else:
+                ncols = len(parts[0][0])
+                has_null = tuple(any(p[1][ci] is not None for p in parts)
+                                 for ci in range(ncols))
+                cols, nulls, valid = _concat_bindings_parts(
+                    tuple(parts), has_null)
+            fetch = list(cols) + [m for m in nulls if m is not None] + [valid]
+            got = _host(fetch, site="result.batched")
+            ncols = len(cols)
+            hcols, rest = got[:ncols], got[ncols:]
+            hnulls = [None if m is None else rest.pop(0) for m in nulls]
+            hvalid = rest.pop(0)
+        results: list = []
+        hook = BATCH_LANE_TEST_HOOK
+        for lane in range(n):
+            try:
+                if hook is not None:
+                    hook(lane, n)
+                if not parts:
+                    empty = [np.zeros((0,), f.type.dtype)
+                             for f in schema.fields]
+                    results.append(_materialize_host(
+                        schema, np.ones((0,), bool), empty,
+                        [None] * len(empty), dicts))
+                    continue
+                results.append(_materialize_host(
+                    schema, hvalid[lane], [c[lane] for c in hcols],
+                    [None if m is None else m[lane] for m in hnulls], dicts))
+            except Exception as e:
+                results.append(e)
+        return results
 
     def _op_label(self, node) -> str:
         lbl = self._op_labels.get(id(node))
@@ -3225,9 +3408,11 @@ class LocalExecutor:
                 return dataclasses.replace(e, args=args)
             return e
 
-        def pages(self=self, up=up, pred=pred, si=si):
-            host = _current_host_params()
-            kept = list(si.splits)
+        def kept_idx_for(host, up=up, pred=pred, si=si):
+            """Indices into si.splits kept for ONE binding's host values
+            (split order preserved — the pruned scan must yield rows in the
+            same order the full scan would)."""
+            kept = list(range(len(si.splits)))
             resolved = []
             for c in split_conjuncts(pred):
                 try:
@@ -3248,7 +3433,26 @@ class LocalExecutor:
                                 if col in by_col else dom
                     if by_col:
                         keep = domain_to_split_pruner(by_col, si.conn)
-                        kept = [s for s in si.splits if keep(s)]
+                        kept = [i for i, s in enumerate(si.splits)
+                                if keep(s)]
+            return kept
+
+        def pages(self=self, si=si):
+            batch = _current_batch_host_params()
+            if batch:
+                # fused template batch (round 21): one scan feeds every
+                # stacked predicate — keep the UNION of the members' pruned
+                # split lists, in split order.  Rows a member's predicate
+                # would have pruned are masked invalid in that member's lane
+                # by the filter itself, so the union scan is byte-identical
+                # per lane to the member's own pruned scan.
+                idx: set = set()
+                for host in batch:
+                    idx.update(kept_idx_for(host))
+                kept = [si.splits[i] for i in sorted(idx)]
+            else:
+                kept = [si.splits[i]
+                        for i in kept_idx_for(_current_host_params())]
             src = self._scan_pages_source(si.conn, si.catalog, si.table,
                                           kept, si.scan_columns)
             yield from src()
@@ -3850,6 +4054,26 @@ def _concat_stream(stream: _Stream, batch: int = 1) -> Page:
     cols_out, nulls_out, valid = _concat_all(
         tuple((ccols, cnulls) for ccols, cnulls, _, _ in parts), ns, has_null)
     return Page(stream.schema, cols_out, nulls_out, valid)
+
+
+@partial(_jit, static_argnums=(1,))
+def _concat_bindings_parts(parts, has_null):
+    """ONE dispatch concatenating a fused bindings batch's per-page parts
+    along the ROW axis (axis 1 — axis 0 is the requests lane, round 21).
+    No per-part compaction: the batched path targets the pruned point-lookup
+    shape (one or a few splits after union pruning), where a compaction's
+    count sync would cost more round-trips than it saves lanes."""
+    ncols = len(parts[0][0])
+    cols = tuple(jnp.concatenate([p[0][ci] for p in parts], axis=1)
+                 for ci in range(ncols))
+    nulls = tuple(
+        jnp.concatenate([p[1][ci] if p[1][ci] is not None
+                         else jnp.zeros(p[0][ci].shape, bool)
+                         for p in parts], axis=1)
+        if has_null[ci] else None
+        for ci in range(ncols))
+    valid = jnp.concatenate([p[2] for p in parts], axis=1)
+    return cols, nulls, valid
 
 
 @partial(_jit, static_argnums=(2,))
@@ -4820,8 +5044,17 @@ def _limit_page(page: Page, count: int) -> Page:
 
 def _materialize(page: Page, dicts) -> MaterializedResult:
     valid, pcols, pnulls = _host_page(page)
+    return _materialize_host(page.schema, valid, pcols, pnulls, dicts)
+
+
+def _materialize_host(schema, valid, pcols, pnulls, dicts) \
+        -> MaterializedResult:
+    """Host-side result decode over already-pulled numpy arrays — shared by
+    the single-statement pull above and the batched demux (round 21), which
+    slices one [R, rows] pull into per-request lanes and decodes each lane
+    through this exact function (byte-identity with serial by construction)."""
     names, types, columns, raw = [], [], [], []
-    for i, f in enumerate(page.schema.fields):
+    for i, f in enumerate(schema.fields):
         arr = pcols[i][valid]
         raw.append(arr)
         dec = arr

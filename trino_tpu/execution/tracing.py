@@ -232,6 +232,13 @@ class QueryCounters:
     # price, or a demoted correction cooling down.
     adaptive_replans: int = 0
     adaptive_holds: int = 0
+    # round 21: continuous template batching (execution/batcher.py).  Each
+    # request served THROUGH a fused same-template batch counts one here —
+    # on the driver's counters (which also carry the batch's real device
+    # spend) and on every rider's otherwise-empty per-statement snapshot,
+    # so per-request accounting sums to the engine totals exactly (device
+    # spend folds once, via the driver).
+    batched_requests: int = 0
     # round 20: per-shard attribution for the distributed path.  Each entry
     # is one blocking exchange / shard consumer's per-worker load, DERIVED
     # from pulls the exchange already makes (receive cursors, occupancy
@@ -257,7 +264,8 @@ class QueryCounters:
                    "spilled_bytes", "spill_tier_hbm", "spill_tier_host",
                    "spill_tier_disk", "admission_queued",
                    "plan_template_hits", "plan_template_misses",
-                   "compiles", "adaptive_replans", "adaptive_holds")
+                   "compiles", "adaptive_replans", "adaptive_holds",
+                   "batched_requests")
     _FLOAT_FIELDS = ("compile_s",)
 
     def reset(self) -> None:

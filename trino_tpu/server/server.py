@@ -730,6 +730,44 @@ class CoordinatorServer:
                 f"trino_tpu_plan_template_misses_total "
                 f"{getattr(ct, 'plan_template_misses', 0)}",
             ]
+            # round 21: continuous template batching — fused same-template
+            # windows (one device program amortized over N requests), the
+            # per-request count, and the fused batch-size distribution
+            bt = getattr(self.engine, "template_batcher", None)
+            if bt is not None:
+                bi = bt.info()
+                lines += [
+                    "# HELP trino_tpu_template_batches_total Fused "
+                    "same-template execution windows (one device program "
+                    "serving the whole window).",
+                    "# TYPE trino_tpu_template_batches_total counter",
+                    f"trino_tpu_template_batches_total "
+                    f"{bi['batches_total']}",
+                    "# HELP trino_tpu_batched_requests_total Requests "
+                    "served through a fused template batch.",
+                    "# TYPE trino_tpu_batched_requests_total counter",
+                    f"trino_tpu_batched_requests_total "
+                    f"{getattr(ct, 'batched_requests', 0)}",
+                    "# HELP trino_tpu_template_batch_size Fused batch "
+                    "sizes (requests per window).",
+                    "# TYPE trino_tpu_template_batch_size histogram",
+                ]
+                sizes = bi["sizes"]
+                ub = 1
+                while ub <= max(bi["max_batch"], 1):
+                    cum = sum(c for s, c in sizes.items() if s <= ub)
+                    lines.append(
+                        f'trino_tpu_template_batch_size_bucket{{le="{ub}"}}'
+                        f' {cum}')
+                    ub *= 2
+                lines += [
+                    f'trino_tpu_template_batch_size_bucket{{le="+Inf"}} '
+                    f"{bi['batches_total']}",
+                    f"trino_tpu_template_batch_size_sum "
+                    f"{bi['batched_requests_total']}",
+                    f"trino_tpu_template_batch_size_count "
+                    f"{bi['batches_total']}",
+                ]
             # round 15: cardinality-drift signal from the plan-actuals
             # history — the worst est-vs-actual factor currently on record
             # (gauge: it moves as records merge and plans evict) and the

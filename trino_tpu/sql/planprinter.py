@@ -111,6 +111,14 @@ def format_plan(node: P.PlanNode, stats: dict = None, counters=None,
             # no re-trace; a miss is the one-time template creation (zero
             # everywhere = no line, budget-suite regexes unchanged)
             lines.append(f"Plan template: {pt_h} hits, {pt_m} misses")
+        br = getattr(counters, "batched_requests", 0)
+        if br:
+            # continuous template batching (round 21): this statement was
+            # served through a fused same-template batch — one device
+            # program amortized across the window's requests (zero = no
+            # line, budget-suite regexes unchanged)
+            lines.append(f"Batched: {br} requests served via fused "
+                         f"template batches")
         rc_h = getattr(counters, "result_cache_hits", 0)
         rc_m = getattr(counters, "result_cache_misses", 0)
         if rc_h or rc_m:
